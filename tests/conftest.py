@@ -1,0 +1,11 @@
+"""Test env: force CPU with 8 virtual devices so multi-chip sharding paths
+(mesh/pjit/shard_map) are exercised without TPU hardware. Must run before
+jax initializes a backend."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

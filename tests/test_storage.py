@@ -1,0 +1,132 @@
+"""Storage tier: MVCC, snapshot isolation, 2PC conflicts, meta, infoschema."""
+import pytest
+
+from tidb_tpu.storage import Storage, MemKV
+from tidb_tpu.meta import Mutator
+from tidb_tpu.infoschema import InfoSchemaCache
+from tidb_tpu.models import DBInfo, TableInfo, ColumnInfo
+from tidb_tpu.types import new_bigint_type, new_string_type
+from tidb_tpu.errors import WriteConflictError, TableExistsError
+
+
+def test_memkv_scan():
+    kv = MemKV()
+    for k in [b"c", b"a", b"b", b"e"]:
+        kv.put(k, k + b"!")
+    assert [k for k, _ in kv.scan(b"a", b"c")] == [b"a", b"b"]
+    assert [k for k, _ in kv.scan(b"b")] == [b"b", b"c", b"e"]
+    kv.delete(b"b")
+    assert [k for k, _ in kv.scan(b"a")] == [b"a", b"c", b"e"]
+
+
+def test_snapshot_isolation():
+    s = Storage()
+    t1 = s.begin()
+    t1.set(b"k", b"v1")
+    t1.commit()
+
+    t2 = s.begin()          # snapshot after v1
+    t3 = s.begin()
+    t3.set(b"k", b"v2")
+    t3.commit()
+    # t2 still sees v1
+    assert t2.get(b"k") == b"v1"
+    t4 = s.begin()
+    assert t4.get(b"k") == b"v2"
+
+
+def test_write_conflict():
+    s = Storage()
+    t0 = s.begin()
+    t0.set(b"k", b"v0")
+    t0.commit()
+
+    t1 = s.begin()
+    t2 = s.begin()
+    t1.set(b"k", b"v1")
+    t2.set(b"k", b"v2")
+    t1.commit()
+    with pytest.raises(WriteConflictError):
+        t2.commit()
+
+
+def test_txn_buffer_scan_merge():
+    s = Storage()
+    t0 = s.begin()
+    t0.set(b"a", b"1")
+    t0.set(b"c", b"3")
+    t0.commit()
+    t1 = s.begin()
+    t1.set(b"b", b"2")
+    t1.delete(b"c")
+    got = t1.scan(b"a", b"z")
+    assert got == [(b"a", b"1"), (b"b", b"2")]
+
+
+def test_delete_tombstone():
+    s = Storage()
+    t = s.begin()
+    t.set(b"k", b"v")
+    t.commit()
+    t = s.begin()
+    t.delete(b"k")
+    t.commit()
+    assert s.begin().get(b"k") is None
+
+
+def _mk_table(m, dbid, name):
+    tid = m.gen_global_id()
+    tbl = TableInfo(id=tid, name=name, columns=[
+        ColumnInfo(id=1, name="id", offset=0, ft=new_bigint_type()),
+        ColumnInfo(id=2, name="name", offset=1, ft=new_string_type(64)),
+    ])
+    m.create_table(dbid, tbl)
+    return tbl
+
+
+def test_meta_and_infoschema():
+    s = Storage()
+    txn = s.begin()
+    m = Mutator(txn)
+    dbid = m.gen_global_id()
+    m.create_database(DBInfo(id=dbid, name="test"))
+    _mk_table(m, dbid, "t1")
+    m.gen_schema_version()
+    txn.commit()
+
+    cache = InfoSchemaCache(s)
+    is1 = cache.current()
+    assert is1.has_schema("test")
+    t = is1.table_by_name("test", "t1")
+    assert [c.name for c in t.columns] == ["id", "name"]
+    assert cache.current() is is1  # same version -> cached
+
+    txn = s.begin()
+    m = Mutator(txn)
+    with pytest.raises(TableExistsError):
+        _mk_table(m, dbid, "T1")
+    txn.rollback()
+
+    txn = s.begin()
+    m = Mutator(txn)
+    _mk_table(m, dbid, "t2")
+    m.gen_schema_version()
+    txn.commit()
+    is2 = cache.current()
+    assert is2 is not is1
+    assert is2.has_table("test", "t2")
+    assert not is1.has_table("test", "t2")  # immutability
+
+
+def test_sysvars():
+    from tidb_tpu.session.sysvars import SessionVars
+    sv = SessionVars()
+    assert sv.tpu_exec is True
+    sv.set("tidb_enable_tpu_exec", "off")
+    assert sv.tpu_exec is False
+    sv.set("tidb_max_chunk_size", 999999999)
+    assert sv.max_chunk_size == 1 << 24  # clamped
+    g = {}
+    sv1, sv2 = SessionVars(g), SessionVars(g)
+    sv1.set("tidb_executor_concurrency", 4, is_global=True)
+    assert sv2.get("tidb_executor_concurrency") == 4

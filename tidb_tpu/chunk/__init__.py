@@ -1,0 +1,6 @@
+from .column import Column
+from .chunk import Chunk
+from .device import DeviceBatch, to_device_batch, shape_bucket, BUCKET_MIN
+
+__all__ = ["Column", "Chunk", "DeviceBatch", "to_device_batch",
+           "shape_bucket", "BUCKET_MIN"]

@@ -1,0 +1,69 @@
+"""Chunk: a batch of rows in columnar layout (pkg/util/chunk/chunk.go analog).
+
+No `sel` vector: selection is materialized via numpy boolean take on host, or
+carried as a validity mask on device (DeviceBatch.valid). requiredRows-style
+pull sizing is handled by executors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .column import Column
+from ..types import FieldType
+
+
+class Chunk:
+    __slots__ = ("columns",)
+
+    def __init__(self, columns: list[Column]):
+        self.columns = columns
+
+    @classmethod
+    def empty(cls, fts: list[FieldType]) -> "Chunk":
+        return cls([Column.empty(ft) for ft in fts])
+
+    def __len__(self):
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_cols(self):
+        return len(self.columns)
+
+    def field_types(self) -> list[FieldType]:
+        return [c.ft for c in self.columns]
+
+    def take(self, idx) -> "Chunk":
+        return Chunk([c.take(idx) for c in self.columns])
+
+    def filter(self, mask: np.ndarray) -> "Chunk":
+        idx = np.nonzero(mask)[0]
+        return self.take(idx)
+
+    def slice(self, begin: int, end: int) -> "Chunk":
+        return Chunk([c.slice(begin, end) for c in self.columns])
+
+    def concat(self, other: "Chunk") -> "Chunk":
+        if len(self) == 0:
+            return other
+        if len(other) == 0:
+            return self
+        return Chunk([a.concat(b) for a, b in zip(self.columns, other.columns)])
+
+    @staticmethod
+    def concat_all(chunks: list["Chunk"]) -> "Chunk":
+        chunks = [c for c in chunks if len(c) > 0]
+        if not chunks:
+            return None
+        out = chunks[0]
+        for c in chunks[1:]:
+            out = out.concat(c)
+        return out
+
+    def row_py(self, i: int) -> tuple:
+        return tuple(c.get_py(i) for c in self.columns)
+
+    def rows_py(self) -> list[tuple]:
+        return [self.row_py(i) for i in range(len(self))]
+
+    def __repr__(self):
+        return f"Chunk(rows={len(self)}, cols={self.num_cols})"

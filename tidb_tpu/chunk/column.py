@@ -1,0 +1,187 @@
+"""Columnar batch column (redesign of pkg/util/chunk/column.go).
+
+The reference Column is Arrow-flavored: {length, nullBitmap, offsets, data}.
+Here the host representation is numpy:
+
+    data  : np.ndarray       int64 / float64 / int32 (dict codes)
+    nulls : np.ndarray[bool] True = NULL (None when column is NOT NULL-clean)
+    dict  : StringDict       only for string columns — maps code <-> str
+
+Device lowering pads to bucketed static shapes with a validity mask
+(chunk/device.py). String columns travel as dict codes; the dictionary stays
+on host. Bit-packed null bitmaps (column.go:76) become plain bool arrays:
+TPU VPU lanes prefer bool/int8 masks over bit twiddling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import FieldType, TypeClass
+from ..types.datum import Datum, Kind, NULL
+from ..types.decimal import scaled_int_to_str, dec_to_scaled_int
+from ..types.time_types import (days_to_str, micros_to_str, parse_date,
+                                parse_datetime, duration_to_str)
+
+_TCLASS_DTYPE = {
+    TypeClass.INT: np.int64,
+    TypeClass.UINT: np.int64,
+    TypeClass.FLOAT: np.float64,
+    TypeClass.DECIMAL: np.int64,
+    TypeClass.DATE: np.int64,
+    TypeClass.DATETIME: np.int64,
+    TypeClass.TIMESTAMP: np.int64,
+    TypeClass.DURATION: np.int64,
+    TypeClass.BIT: np.int64,
+    TypeClass.ENUM: np.int64,
+    TypeClass.SET: np.int64,
+    TypeClass.STRING: object,  # host string array; dict-encoded lazily
+    TypeClass.JSON: object,
+    TypeClass.NULLT: np.int64,
+}
+
+
+def np_dtype_for(ft: FieldType):
+    return _TCLASS_DTYPE.get(ft.tclass, object)
+
+
+class Column:
+    __slots__ = ("ft", "data", "nulls")
+
+    def __init__(self, ft: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
+        self.ft = ft
+        self.data = data
+        self.nulls = nulls  # None means no NULLs present
+
+    # ---- constructors -------------------------------------------------
+    @classmethod
+    def empty(cls, ft: FieldType) -> "Column":
+        return cls(ft, np.empty(0, dtype=np_dtype_for(ft)), None)
+
+    @classmethod
+    def from_datums(cls, ft: FieldType, datums: list) -> "Column":
+        n = len(datums)
+        dt = np_dtype_for(ft)
+        nulls = np.zeros(n, dtype=bool)
+        if dt is object:
+            data = np.empty(n, dtype=object)
+            for i, d in enumerate(datums):
+                if d.is_null:
+                    nulls[i] = True
+                    data[i] = ""
+                else:
+                    v = d.val
+                    data[i] = v.decode("utf-8", "surrogateescape") if isinstance(v, bytes) else str(v)
+        else:
+            data = np.zeros(n, dtype=dt)
+            for i, d in enumerate(datums):
+                if d.is_null:
+                    nulls[i] = True
+                else:
+                    data[i] = dt(d.val) if dt is np.float64 else int(d.val)
+        return cls(ft, data, nulls if nulls.any() else None)
+
+    @classmethod
+    def from_py(cls, ft: FieldType, values: list) -> "Column":
+        """Fast path from python scalars (None => NULL). Strings parsed per ft."""
+        return cls.from_datums(ft, [py_to_datum_fast(v, ft) for v in values])
+
+    # ---- basics -------------------------------------------------------
+    def __len__(self):
+        return len(self.data)
+
+    @property
+    def null_mask(self) -> np.ndarray:
+        if self.nulls is None:
+            return np.zeros(len(self.data), dtype=bool)
+        return self.nulls
+
+    def is_null_at(self, i: int) -> bool:
+        return self.nulls is not None and bool(self.nulls[i])
+
+    def take(self, idx: np.ndarray) -> "Column":
+        nulls = self.nulls[idx] if self.nulls is not None else None
+        return Column(self.ft, self.data[idx], nulls)
+
+    def slice(self, begin: int, end: int) -> "Column":
+        nulls = self.nulls[begin:end] if self.nulls is not None else None
+        return Column(self.ft, self.data[begin:end], nulls)
+
+    def concat(self, other: "Column") -> "Column":
+        data = np.concatenate([self.data, other.data])
+        if self.nulls is None and other.nulls is None:
+            nulls = None
+        else:
+            nulls = np.concatenate([self.null_mask, other.null_mask])
+        return Column(self.ft, data, nulls)
+
+    # ---- scalar access (row path) ------------------------------------
+    def get_datum(self, i: int) -> Datum:
+        if self.is_null_at(i):
+            return NULL
+        v = self.data[i]
+        tc = self.ft.tclass
+        if tc in (TypeClass.INT, TypeClass.BIT, TypeClass.ENUM, TypeClass.SET):
+            return Datum(Kind.INT, int(v))
+        if tc == TypeClass.UINT:
+            return Datum(Kind.UINT, int(v))
+        if tc == TypeClass.FLOAT:
+            return Datum(Kind.FLOAT, float(v))
+        if tc == TypeClass.DECIMAL:
+            return Datum(Kind.DECIMAL, int(v), max(self.ft.decimal, 0))
+        if tc == TypeClass.DATE:
+            return Datum(Kind.DATE, int(v))
+        if tc == TypeClass.DATETIME:
+            return Datum(Kind.DATETIME, int(v), max(self.ft.decimal, 0))
+        if tc == TypeClass.TIMESTAMP:
+            return Datum(Kind.TIMESTAMP, int(v), max(self.ft.decimal, 0))
+        if tc == TypeClass.DURATION:
+            return Datum(Kind.DURATION, int(v), max(self.ft.decimal, 0))
+        return Datum(Kind.STRING, v if isinstance(v, str) else str(v))
+
+    def get_py(self, i: int):
+        """Formatted python value (for result sets)."""
+        if self.is_null_at(i):
+            return None
+        v = self.data[i]
+        tc = self.ft.tclass
+        if tc == TypeClass.DECIMAL:
+            return scaled_int_to_str(int(v), max(self.ft.decimal, 0))
+        if tc == TypeClass.DATE:
+            return days_to_str(int(v))
+        if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            return micros_to_str(int(v), max(self.ft.decimal, 0))
+        if tc == TypeClass.DURATION:
+            return duration_to_str(int(v), max(self.ft.decimal, 0))
+        if tc in (TypeClass.INT, TypeClass.UINT):
+            return int(v)
+        if tc == TypeClass.FLOAT:
+            return float(v)
+        return v
+
+
+def py_to_datum_fast(v, ft: FieldType) -> Datum:
+    """Convert+coerce a python literal to the column's storage Datum."""
+    if v is None:
+        return NULL
+    tc = ft.tclass
+    if tc == TypeClass.STRING or tc == TypeClass.JSON:
+        if isinstance(v, bytes):
+            return Datum(Kind.STRING, v.decode("utf-8", "surrogateescape"))
+        return Datum(Kind.STRING, str(v))
+    if tc == TypeClass.DATE:
+        if isinstance(v, str):
+            return Datum(Kind.DATE, parse_date(v))
+        return Datum(Kind.DATE, int(v))
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        if isinstance(v, str):
+            return Datum(Kind.DATETIME, parse_datetime(v))
+        return Datum(Kind.DATETIME, int(v))
+    if tc == TypeClass.DECIMAL:
+        return Datum(Kind.DECIMAL, dec_to_scaled_int(v, max(ft.decimal, 0)),
+                     max(ft.decimal, 0))
+    if tc == TypeClass.FLOAT:
+        return Datum(Kind.FLOAT, float(v))
+    # integer classes
+    if isinstance(v, str):
+        v = int(float(v)) if ("." in v or "e" in v.lower()) else int(v)
+    return Datum(Kind.UINT if ft.unsigned else Kind.INT, int(v))

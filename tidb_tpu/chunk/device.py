@@ -1,0 +1,155 @@
+"""Host <-> device bridge: padded, masked device batches.
+
+XLA compiles one program per (shapes, dtypes); dynamic row counts would
+recompile every batch. We pad every column to a bucketed static length and
+carry a validity mask — the device analog of the reference's `sel` vector +
+null bitmap (pkg/util/chunk/chunk.go:35). Kernels are cached by
+(expr fingerprint, bucket, dtypes) — the analog of the plan cache.
+
+String columns are dictionary-encoded: int32 codes on device, dictionary on
+host. Equality/grouping/join on codes is exact when both sides share a
+dictionary (ColumnarTable guarantees per-column global dicts); ad-hoc
+batches build a local dict on transfer.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .column import Column
+from ..types import FieldType, TypeClass
+
+BUCKET_MIN = 1024
+
+
+def shape_bucket(n: int) -> int:
+    """Round row count up to the next power of two (>= BUCKET_MIN)."""
+    if n <= BUCKET_MIN:
+        return BUCKET_MIN
+    return 1 << (n - 1).bit_length()
+
+
+class StringDict:
+    """Per-column string dictionary: code <-> str, append-only."""
+
+    __slots__ = ("values", "index", "sort_keys")
+
+    def __init__(self):
+        self.values: list[str] = []
+        self.index: dict[str, int] = {}
+        self.sort_keys = None  # lazily computed rank array for ordered compares
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        """Encode an object array of strings to int32 codes, extending dict."""
+        codes = np.empty(len(arr), dtype=np.int32)
+        idx = self.index
+        vals = self.values
+        for i, s in enumerate(arr):
+            c = idx.get(s)
+            if c is None:
+                c = len(vals)
+                idx[s] = c
+                vals.append(s)
+                self.sort_keys = None
+            codes[i] = c
+        return codes
+
+    def encode_one(self, s: str) -> int:
+        c = self.index.get(s)
+        if c is None:
+            c = len(self.values)
+            self.index[s] = c
+            self.values.append(s)
+            self.sort_keys = None
+        return c
+
+    def lookup(self, s: str) -> int:
+        """Code for s, or -1 if absent (predicates against unseen constants)."""
+        return self.index.get(s, -1)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(len(codes), dtype=object)
+        vals = self.values
+        for i, c in enumerate(codes):
+            out[i] = vals[c] if 0 <= c < len(vals) else None
+        return out
+
+    def ranks(self) -> np.ndarray:
+        """rank[code] = position in sorted order — makes <,>,ORDER BY on
+        dict codes a gather + int compare (collation sort keys precomputed
+        on host; reference pkg/util/collate)."""
+        if self.sort_keys is None or len(self.sort_keys) != len(self.values):
+            order = np.argsort(np.array(self.values, dtype=object), kind="stable")
+            ranks = np.empty(len(self.values), dtype=np.int64)
+            ranks[order] = np.arange(len(self.values))
+            self.sort_keys = ranks
+        return self.sort_keys
+
+
+class DeviceCol:
+    __slots__ = ("data", "nulls", "ft", "dict")
+
+    def __init__(self, data, nulls, ft: FieldType, sdict: StringDict | None = None):
+        self.data = data    # jnp array, padded
+        self.nulls = nulls  # jnp bool array or None
+        self.ft = ft
+        self.dict = sdict
+
+
+class DeviceBatch:
+    """A set of device columns + validity mask, all padded to `cap` rows."""
+
+    __slots__ = ("cols", "valid", "n", "cap")
+
+    def __init__(self, cols: dict, valid, n: int, cap: int):
+        self.cols = cols    # name/index -> DeviceCol
+        self.valid = valid  # jnp bool[cap]; True for real rows that pass filters
+        self.n = n          # real row count before padding
+        self.cap = cap
+
+
+_DEVICE_DTYPE = {
+    TypeClass.FLOAT: jnp.float64,
+}
+
+
+def _pad(a: np.ndarray, cap: int, fill=0):
+    if len(a) == cap:
+        return a
+    pad_width = cap - len(a)
+    return np.concatenate([a, np.full(pad_width, fill, dtype=a.dtype)])
+
+
+def lower_column(col: Column, cap: int, sdict: StringDict | None = None):
+    """Column -> (device data, device nulls|None, dict). Pads to cap."""
+    ft = col.ft
+    if ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+        d = sdict or StringDict()
+        codes = d.encode(col.data.astype(object))
+        data = jnp.asarray(_pad(codes, cap))
+        nulls = None
+        if col.nulls is not None:
+            nulls = jnp.asarray(_pad(col.nulls, cap, fill=True))
+        return DeviceCol(data, nulls, ft, d)
+    data_np = col.data
+    if data_np.dtype == object:
+        data_np = data_np.astype(np.float64)
+    data = jnp.asarray(_pad(data_np, cap))
+    nulls = None
+    if col.nulls is not None:
+        nulls = jnp.asarray(_pad(col.nulls, cap, fill=True))
+    return DeviceCol(data, nulls, ft)
+
+
+def to_device_batch(chunk, names: list | None = None,
+                    dicts: dict | None = None) -> DeviceBatch:
+    """Lower a host Chunk to a DeviceBatch with bucketed padding."""
+    n = len(chunk)
+    cap = shape_bucket(n)
+    cols = {}
+    for i, col in enumerate(chunk.columns):
+        key = names[i] if names else i
+        sdict = dicts.get(key) if dicts else None
+        cols[key] = lower_column(col, cap, sdict)
+    valid = jnp.asarray(_pad(np.ones(n, dtype=bool), cap, fill=False))
+    return DeviceBatch(cols, valid, n, cap)

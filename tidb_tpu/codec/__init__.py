@@ -1,0 +1,19 @@
+from .codec import (
+    encode_int, decode_int, encode_bytes, decode_bytes,
+    encode_datum_key, decode_datum_key, encode_datums_key,
+    encode_row_value, decode_row_value,
+)
+from .tablecodec import (
+    record_key, record_prefix, index_key, index_prefix, table_prefix,
+    decode_record_key, decode_index_key, meta_key,
+    RECORD_PREFIX_SEP, INDEX_PREFIX_SEP,
+)
+
+__all__ = [
+    "encode_int", "decode_int", "encode_bytes", "decode_bytes",
+    "encode_datum_key", "decode_datum_key", "encode_datums_key",
+    "encode_row_value", "decode_row_value",
+    "record_key", "record_prefix", "index_key", "index_prefix",
+    "table_prefix", "decode_record_key", "decode_index_key", "meta_key",
+    "RECORD_PREFIX_SEP", "INDEX_PREFIX_SEP",
+]

@@ -1,0 +1,244 @@
+"""Memcomparable datum codec (reference pkg/util/codec/codec.go).
+
+Keys must sort bytewise in datum order — that is the entire contract that
+makes range scans work. Encodings:
+
+    NULL    : 0x00
+    bytes   : 0x01 + groups of 8 bytes, each followed by a pad-count marker
+              (memcomparable string encoding, codec/bytes.go:EncodeBytes)
+    int     : 0x03 + 8 bytes big-endian with sign bit flipped
+    uint    : 0x04 + 8 bytes big-endian
+    float   : 0x05 + 8 bytes big-endian with order-preserving bit tricks
+    decimal : 0x06 + scale byte + sign-flipped scaled int (big-endian)
+    duration: 0x07 + int64
+    max     : 0xFF (range upper bounds)
+
+Values (row payloads) use a simple tagged encoding — they never need to be
+memcomparable (reference rowcodec is an efficiency play; here host numpy
+columnar storage is the hot path, the KV row codec serves the OLTP path).
+"""
+from __future__ import annotations
+
+import struct
+
+from ..types.datum import Datum, Kind, NULL, MAX_VALUE
+
+NIL_FLAG = 0x00
+BYTES_FLAG = 0x01
+COMPACT_BYTES_FLAG = 0x02
+INT_FLAG = 0x03
+UINT_FLAG = 0x04
+FLOAT_FLAG = 0x05
+DECIMAL_FLAG = 0x06
+DURATION_FLAG = 0x07
+MAX_FLAG = 0xFF
+
+_SIGN_MASK = 0x8000000000000000
+ENC_GROUP_SIZE = 8
+_PAD = b"\x00"
+
+
+def encode_int(buf: bytearray, v: int):
+    buf.append(INT_FLAG)
+    buf += struct.pack(">Q", (v + _SIGN_MASK) & 0xFFFFFFFFFFFFFFFF)
+
+
+def decode_int(b: bytes, pos: int):
+    (u,) = struct.unpack_from(">Q", b, pos)
+    return u - _SIGN_MASK, pos + 8
+
+
+def encode_uint(buf: bytearray, v: int):
+    buf.append(UINT_FLAG)
+    buf += struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF)
+
+
+def encode_float(buf: bytearray, v: float):
+    buf.append(FLOAT_FLAG)
+    u = struct.unpack(">Q", struct.pack(">d", v))[0]
+    if u & _SIGN_MASK:
+        u = ~u & 0xFFFFFFFFFFFFFFFF
+    else:
+        u |= _SIGN_MASK
+    buf += struct.pack(">Q", u)
+
+
+def decode_float(b: bytes, pos: int):
+    (u,) = struct.unpack_from(">Q", b, pos)
+    if u & _SIGN_MASK:
+        u &= ~_SIGN_MASK & 0xFFFFFFFFFFFFFFFF
+    else:
+        u = ~u & 0xFFFFFFFFFFFFFFFF
+    return struct.unpack(">d", struct.pack(">Q", u))[0], pos + 8
+
+
+def encode_bytes(buf: bytearray, data: bytes):
+    """Group-of-8 memcomparable bytes (codec/bytes.go EncodeBytes)."""
+    buf.append(BYTES_FLAG)
+    i = 0
+    n = len(data)
+    while True:
+        group = data[i:i + ENC_GROUP_SIZE]
+        pad = ENC_GROUP_SIZE - len(group)
+        buf += group
+        buf += _PAD * pad
+        buf.append(0xFF - pad)
+        i += ENC_GROUP_SIZE
+        if pad > 0 or i > n or (i == n):
+            if pad == 0 and i == n:
+                # full final group: emit an empty terminator group
+                buf += _PAD * ENC_GROUP_SIZE
+                buf.append(0xFF - ENC_GROUP_SIZE)
+            break
+
+
+def decode_bytes(b: bytes, pos: int):
+    out = bytearray()
+    while True:
+        group = b[pos:pos + ENC_GROUP_SIZE]
+        marker = b[pos + ENC_GROUP_SIZE]
+        pad = 0xFF - marker
+        pos += ENC_GROUP_SIZE + 1
+        out += group[:ENC_GROUP_SIZE - pad]
+        if pad > 0:
+            break
+    return bytes(out), pos
+
+
+def encode_datum_key(buf: bytearray, d: Datum):
+    k = d.kind
+    if k == Kind.NULL:
+        buf.append(NIL_FLAG)
+    elif k == Kind.MAX_VALUE:
+        buf.append(MAX_FLAG)
+    elif k == Kind.INT:
+        encode_int(buf, d.val)
+    elif k == Kind.UINT:
+        encode_uint(buf, d.val)
+    elif k == Kind.FLOAT:
+        encode_float(buf, d.val)
+    elif k in (Kind.DATE, Kind.DATETIME, Kind.TIMESTAMP):
+        encode_int(buf, d.val)
+    elif k == Kind.DURATION:
+        buf.append(DURATION_FLAG)
+        buf += struct.pack(">Q", (d.val + _SIGN_MASK) & 0xFFFFFFFFFFFFFFFF)
+    elif k == Kind.DECIMAL:
+        # order-preserving: fixed scale per column enforced by caller
+        buf.append(DECIMAL_FLAG)
+        buf.append(d.scale & 0xFF)
+        buf += struct.pack(">Q", (d.val + _SIGN_MASK) & 0xFFFFFFFFFFFFFFFF)
+    elif k == Kind.STRING:
+        encode_bytes(buf, d.val.encode("utf-8", "surrogateescape"))
+    elif k == Kind.BYTES:
+        encode_bytes(buf, d.val)
+    else:
+        raise ValueError(f"cannot key-encode datum kind {k}")
+
+
+def encode_datums_key(datums: list) -> bytes:
+    buf = bytearray()
+    for d in datums:
+        encode_datum_key(buf, d)
+    return bytes(buf)
+
+
+def decode_datum_key(b: bytes, pos: int = 0):
+    flag = b[pos]
+    pos += 1
+    if flag == NIL_FLAG:
+        return NULL, pos
+    if flag == MAX_FLAG:
+        return MAX_VALUE, pos
+    if flag == INT_FLAG:
+        v, pos = decode_int(b, pos)
+        return Datum(Kind.INT, v), pos
+    if flag == UINT_FLAG:
+        (u,) = struct.unpack_from(">Q", b, pos)
+        return Datum(Kind.UINT, u), pos + 8
+    if flag == FLOAT_FLAG:
+        v, pos = decode_float(b, pos)
+        return Datum(Kind.FLOAT, v), pos
+    if flag == DURATION_FLAG:
+        v, pos = decode_int(b, pos)
+        return Datum(Kind.DURATION, v), pos
+    if flag == DECIMAL_FLAG:
+        scale = b[pos]
+        v, pos = decode_int(b, pos + 1)
+        return Datum(Kind.DECIMAL, v, scale), pos
+    if flag == BYTES_FLAG:
+        v, pos = decode_bytes(b, pos)
+        return Datum(Kind.BYTES, v), pos
+    raise ValueError(f"bad key flag {flag}")
+
+
+# ---- row value codec (tagged, non-memcomparable) -----------------------
+
+def encode_row_value(datums: list) -> bytes:
+    """Row payload: count + per-datum tagged encoding."""
+    buf = bytearray()
+    buf += struct.pack("<I", len(datums))
+    for d in datums:
+        k = d.kind
+        if k == Kind.NULL:
+            buf.append(0)
+        elif k in (Kind.INT, Kind.DATE, Kind.DATETIME, Kind.TIMESTAMP,
+                   Kind.DURATION):
+            buf.append(1)
+            buf.append(int(k))
+            buf += struct.pack("<q", d.val)
+        elif k == Kind.UINT:
+            buf.append(2)
+            buf += struct.pack("<Q", d.val)
+        elif k == Kind.FLOAT:
+            buf.append(3)
+            buf += struct.pack("<d", d.val)
+        elif k == Kind.DECIMAL:
+            buf.append(4)
+            buf.append(d.scale & 0xFF)
+            buf += struct.pack("<q", d.val)
+        elif k in (Kind.STRING, Kind.BYTES):
+            raw = d.val.encode("utf-8", "surrogateescape") if k == Kind.STRING else d.val
+            buf.append(5 if k == Kind.STRING else 6)
+            buf += struct.pack("<I", len(raw))
+            buf += raw
+        else:
+            raise ValueError(f"cannot value-encode kind {k}")
+    return bytes(buf)
+
+
+def decode_row_value(b: bytes) -> list:
+    (n,) = struct.unpack_from("<I", b, 0)
+    pos = 4
+    out = []
+    for _ in range(n):
+        tag = b[pos]
+        pos += 1
+        if tag == 0:
+            out.append(NULL)
+        elif tag == 1:
+            kind = Kind(b[pos])
+            (v,) = struct.unpack_from("<q", b, pos + 1)
+            out.append(Datum(kind, v))
+            pos += 9
+        elif tag == 2:
+            (v,) = struct.unpack_from("<Q", b, pos)
+            out.append(Datum(Kind.UINT, v))
+            pos += 8
+        elif tag == 3:
+            (v,) = struct.unpack_from("<d", b, pos)
+            out.append(Datum(Kind.FLOAT, v))
+            pos += 8
+        elif tag == 4:
+            scale = b[pos]
+            (v,) = struct.unpack_from("<q", b, pos + 1)
+            out.append(Datum(Kind.DECIMAL, v, scale))
+            pos += 9
+        elif tag in (5, 6):
+            (ln,) = struct.unpack_from("<I", b, pos)
+            raw = b[pos + 4:pos + 4 + ln]
+            pos += 4 + ln
+            out.append(Datum(Kind.STRING, raw.decode("utf-8", "surrogateescape"))
+                       if tag == 5 else Datum(Kind.BYTES, raw))
+        else:
+            raise ValueError(f"bad value tag {tag}")
+    return out

@@ -1,0 +1,86 @@
+"""Table key layout (reference pkg/tablecodec/tablecodec.go:106,114,719).
+
+    row   key: t{tableID:int64-be}_r{handle:int64-be}
+    index key: t{tableID}_i{indexID:int64-be}{encoded datums}[{handle}]
+    meta  key: m{...}   (schema metadata namespace, pkg/meta)
+
+tableID/handle encode with sign-flipped big-endian so byte order == numeric
+order, matching the datum codec.
+"""
+from __future__ import annotations
+
+import struct
+
+from .codec import encode_datums_key, decode_datum_key, decode_int
+
+TABLE_PREFIX = b"t"
+META_PREFIX = b"m"
+RECORD_PREFIX_SEP = b"_r"
+INDEX_PREFIX_SEP = b"_i"
+_SIGN_MASK = 0x8000000000000000
+
+
+def _enc_i64(v: int) -> bytes:
+    return struct.pack(">Q", (v + _SIGN_MASK) & 0xFFFFFFFFFFFFFFFF)
+
+
+def _dec_i64(b: bytes) -> int:
+    (u,) = struct.unpack(">Q", b)
+    return u - _SIGN_MASK
+
+
+def table_prefix(table_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_i64(table_id)
+
+
+def record_prefix(table_id: int) -> bytes:
+    return table_prefix(table_id) + RECORD_PREFIX_SEP
+
+
+def record_key(table_id: int, handle: int) -> bytes:
+    return record_prefix(table_id) + _enc_i64(handle)
+
+
+def decode_record_key(key: bytes):
+    assert key[:1] == TABLE_PREFIX and key[9:11] == RECORD_PREFIX_SEP, key
+    return _dec_i64(key[1:9]), _dec_i64(key[11:19])
+
+
+def index_prefix(table_id: int, index_id: int) -> bytes:
+    return table_prefix(table_id) + INDEX_PREFIX_SEP + _enc_i64(index_id)
+
+
+def index_key(table_id: int, index_id: int, datums: list,
+              handle: int | None = None) -> bytes:
+    key = index_prefix(table_id, index_id) + encode_datums_key(datums)
+    if handle is not None:
+        # non-unique indexes append the handle for disambiguation
+        key += _enc_i64(handle)
+    return key
+
+
+def decode_index_key(key: bytes, n_cols: int):
+    """-> (table_id, index_id, [datums], trailing bytes)."""
+    table_id = _dec_i64(key[1:9])
+    index_id = _dec_i64(key[11:19])
+    pos = 19
+    datums = []
+    for _ in range(n_cols):
+        d, pos = decode_datum_key(key, pos)
+        datums.append(d)
+    return table_id, index_id, datums, key[pos:]
+
+
+def index_key_handle(key: bytes) -> int:
+    """Handle stored in the final 8 bytes of a non-unique index key."""
+    return _dec_i64(key[-8:])
+
+
+def meta_key(*parts: bytes) -> bytes:
+    buf = bytearray(META_PREFIX)
+    for p in parts:
+        if isinstance(p, str):
+            p = p.encode()
+        buf += struct.pack(">I", len(p))
+        buf += p
+    return bytes(buf)

@@ -1,0 +1,70 @@
+"""Error catalog (analog of reference pkg/errno + errors.toml).
+
+MySQL-compatible error codes so client behavior matches the reference
+(reference: pkg/errno/errcode.go, pkg/parser/mysql consts).
+"""
+from __future__ import annotations
+
+
+class TiDBError(Exception):
+    """Base error with a MySQL-compatible code and SQLSTATE."""
+
+    code = 1105  # ER_UNKNOWN_ERROR
+    sqlstate = "HY000"
+
+    def __init__(self, msg: str = "", *args):
+        if args:
+            msg = msg % args
+        super().__init__(msg)
+        self.msg = msg
+
+    def __str__(self):
+        return f"[{self.code}] {self.msg}"
+
+
+def _err(name, code, sqlstate="HY000"):
+    return type(name, (TiDBError,), {"code": code, "sqlstate": sqlstate})
+
+
+# Parser / syntax
+ParseError = _err("ParseError", 1064, "42000")
+# Schema
+DatabaseExistsError = _err("DatabaseExistsError", 1007)
+DatabaseNotExistsError = _err("DatabaseNotExistsError", 1049, "42000")
+TableExistsError = _err("TableExistsError", 1050, "42S01")
+TableNotExistsError = _err("TableNotExistsError", 1146, "42S02")
+ColumnNotExistsError = _err("ColumnNotExistsError", 1054, "42S22")
+DuplicateColumnError = _err("DuplicateColumnError", 1060, "42S21")
+IndexExistsError = _err("IndexExistsError", 1061, "42000")
+IndexNotExistsError = _err("IndexNotExistsError", 1176, "42000")
+NoDatabaseSelectedError = _err("NoDatabaseSelectedError", 1046, "3D000")
+# Data
+DuplicateKeyError = _err("DuplicateKeyError", 1062, "23000")
+DataTooLongError = _err("DataTooLongError", 1406, "22001")
+DataOutOfRangeError = _err("DataOutOfRangeError", 1264, "22003")
+DivisionByZeroError = _err("DivisionByZeroError", 1365, "22012")
+TruncatedWrongValueError = _err("TruncatedWrongValueError", 1292, "22007")
+BadNullError = _err("BadNullError", 1048, "23000")
+WrongValueCountError = _err("WrongValueCountError", 1136, "21S01")
+# Expression / planner
+UnknownFunctionError = _err("UnknownFunctionError", 1305, "42000")
+WrongArgCountError = _err("WrongArgCountError", 1582, "42000")
+NonUniqTableError = _err("NonUniqTableError", 1066, "42000")
+AmbiguousColumnError = _err("AmbiguousColumnError", 1052, "23000")
+InvalidGroupFuncError = _err("InvalidGroupFuncError", 1111, "HY000")
+MixOfGroupFuncAndFieldsError = _err("MixOfGroupFuncAndFieldsError", 1140, "42000")
+UnsupportedError = _err("UnsupportedError", 1235, "42000")
+# Transaction
+WriteConflictError = _err("WriteConflictError", 9007)
+TxnRetryableError = _err("TxnRetryableError", 8002)
+LockWaitTimeoutError = _err("LockWaitTimeoutError", 1205, "HY000")
+DeadlockError = _err("DeadlockError", 1213, "40001")
+# Variables
+UnknownSystemVariableError = _err("UnknownSystemVariableError", 1193, "HY000")
+WrongValueForVarError = _err("WrongValueForVarError", 1231, "42000")
+# Resource
+MemoryQuotaExceededError = _err("MemoryQuotaExceededError", 8175)
+QueryKilledError = _err("QueryKilledError", 1317, "70100")
+# Privilege
+AccessDeniedError = _err("AccessDeniedError", 1045, "28000")
+PrivilegeCheckFailError = _err("PrivilegeCheckFailError", 1142, "42000")

@@ -1,0 +1,3 @@
+from .infoschema import InfoSchema, InfoSchemaCache
+
+__all__ = ["InfoSchema", "InfoSchemaCache"]

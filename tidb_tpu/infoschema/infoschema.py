@@ -1,0 +1,85 @@
+"""Immutable snapshot schema cache (reference pkg/infoschema).
+
+One InfoSchema per schema version; lookups are dict hits, never KV reads.
+The cache reloads from meta when the version bumps (domain reload loop,
+reference pkg/domain/domain.go — collapsed to synchronous reload since DDL
+is in-process for now).
+"""
+from __future__ import annotations
+
+from ..meta import Mutator
+from ..models import DBInfo, TableInfo
+from ..errors import DatabaseNotExistsError, TableNotExistsError
+
+
+class InfoSchema:
+    def __init__(self, version: int, dbs: list[DBInfo],
+                 tables: dict[int, list[TableInfo]]):
+        self.version = version
+        self._dbs_by_name = {db.name.lower(): db for db in dbs}
+        self._tbl_by_name = {}
+        self._tbl_by_id = {}
+        self._db_of_table = {}
+        for dbid, tbls in tables.items():
+            db = next((d for d in dbs if d.id == dbid), None)
+            if db is None:
+                continue
+            for t in tbls:
+                self._tbl_by_name[(db.name.lower(), t.name.lower())] = t
+                self._tbl_by_id[t.id] = t
+                self._db_of_table[t.id] = db
+
+    def schema_by_name(self, name: str) -> DBInfo:
+        db = self._dbs_by_name.get(name.lower())
+        if db is None:
+            raise DatabaseNotExistsError("Unknown database '%s'", name)
+        return db
+
+    def has_schema(self, name: str) -> bool:
+        return name.lower() in self._dbs_by_name
+
+    def all_schemas(self) -> list[DBInfo]:
+        return list(self._dbs_by_name.values())
+
+    def table_by_name(self, db: str, tbl: str) -> TableInfo:
+        t = self._tbl_by_name.get((db.lower(), tbl.lower()))
+        if t is None:
+            if not self.has_schema(db):
+                raise DatabaseNotExistsError("Unknown database '%s'", db)
+            raise TableNotExistsError("Table '%s.%s' doesn't exist", db, tbl)
+        return t
+
+    def has_table(self, db: str, tbl: str) -> bool:
+        return (db.lower(), tbl.lower()) in self._tbl_by_name
+
+    def table_by_id(self, tid: int) -> TableInfo | None:
+        return self._tbl_by_id.get(tid)
+
+    def db_of_table(self, tid: int) -> DBInfo | None:
+        return self._db_of_table.get(tid)
+
+    def tables_in_schema(self, db: str) -> list[TableInfo]:
+        dbl = db.lower()
+        return [t for (d, _), t in self._tbl_by_name.items() if d == dbl]
+
+
+class InfoSchemaCache:
+    """Reloads an immutable InfoSchema snapshot when SchemaVersion changes."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self._cached: InfoSchema | None = None
+
+    def current(self) -> InfoSchema:
+        txn = self.storage.begin()
+        try:
+            m = Mutator(txn)
+            ver = m.schema_version()
+            if self._cached is not None and self._cached.version == ver:
+                return self._cached
+            dbs = m.list_databases()
+            tables = {db.id: m.list_tables(db.id) for db in dbs}
+            self._cached = InfoSchema(ver, dbs, tables)
+            return self._cached
+        finally:
+            txn.rollback()

@@ -1,0 +1,3 @@
+from .meta import Mutator
+
+__all__ = ["Mutator"]

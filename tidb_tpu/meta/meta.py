@@ -1,0 +1,131 @@
+"""Schema metadata persisted in the KV store itself (reference
+pkg/meta/meta.go:219 Mutator). Layout under the `m` prefix:
+
+    m[NextGlobalID]          -> int
+    m[SchemaVersion]         -> int
+    m[DBs]                   -> json list of db ids
+    m[DB:{id}]               -> DBInfo json
+    m[DB:{id}:TableList]     -> json list of table ids
+    m[DB:{id}:Table:{tid}]   -> TableInfo json
+
+All mutations ride the surrounding Transaction — schema changes are
+transactional exactly like the reference (meta rows live in TiKV itself).
+"""
+from __future__ import annotations
+
+import json
+
+from ..codec.tablecodec import meta_key
+from ..models import DBInfo, TableInfo
+from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
+                      TableExistsError, TableNotExistsError)
+
+_K_NEXT_ID = meta_key(b"NextGlobalID")
+_K_SCHEMA_VER = meta_key(b"SchemaVersion")
+_K_DBS = meta_key(b"DBs")
+
+
+class Mutator:
+    """Transactional accessor for schema metadata."""
+
+    def __init__(self, txn):
+        self.txn = txn
+
+    # ---- id / version allocation -------------------------------------
+    def gen_global_id(self) -> int:
+        cur = self.txn.get(_K_NEXT_ID)
+        nxt = (int(cur) if cur is not None else 0) + 1
+        self.txn.set(_K_NEXT_ID, str(nxt).encode())
+        return nxt
+
+    def schema_version(self) -> int:
+        v = self.txn.get(_K_SCHEMA_VER)
+        return int(v) if v is not None else 0
+
+    def gen_schema_version(self) -> int:
+        v = self.schema_version() + 1
+        self.txn.set(_K_SCHEMA_VER, str(v).encode())
+        return v
+
+    # ---- databases ----------------------------------------------------
+    def _db_ids(self) -> list[int]:
+        v = self.txn.get(_K_DBS)
+        return json.loads(v) if v is not None else []
+
+    def _set_db_ids(self, ids):
+        self.txn.set(_K_DBS, json.dumps(ids).encode())
+
+    def list_databases(self) -> list[DBInfo]:
+        out = []
+        for dbid in self._db_ids():
+            v = self.txn.get(meta_key(b"DB", str(dbid).encode()))
+            if v is not None:
+                out.append(DBInfo.deserialize(v))
+        return out
+
+    def get_database(self, dbid: int) -> DBInfo | None:
+        v = self.txn.get(meta_key(b"DB", str(dbid).encode()))
+        return DBInfo.deserialize(v) if v is not None else None
+
+    def create_database(self, db: DBInfo):
+        ids = self._db_ids()
+        for existing in self.list_databases():
+            if existing.name.lower() == db.name.lower():
+                raise DatabaseExistsError("Can't create database '%s'; database exists", db.name)
+        ids.append(db.id)
+        self._set_db_ids(ids)
+        self.txn.set(meta_key(b"DB", str(db.id).encode()), db.serialize())
+        self.txn.set(meta_key(b"DB", str(db.id).encode(), b"TableList"),
+                     json.dumps([]).encode())
+
+    def drop_database(self, dbid: int):
+        ids = [i for i in self._db_ids() if i != dbid]
+        self._set_db_ids(ids)
+        self.txn.delete(meta_key(b"DB", str(dbid).encode()))
+        self.txn.delete(meta_key(b"DB", str(dbid).encode(), b"TableList"))
+
+    # ---- tables -------------------------------------------------------
+    def _table_ids(self, dbid: int) -> list[int]:
+        v = self.txn.get(meta_key(b"DB", str(dbid).encode(), b"TableList"))
+        if v is None:
+            raise DatabaseNotExistsError("Unknown database id %d", dbid)
+        return json.loads(v)
+
+    def _set_table_ids(self, dbid: int, ids):
+        self.txn.set(meta_key(b"DB", str(dbid).encode(), b"TableList"),
+                     json.dumps(ids).encode())
+
+    def list_tables(self, dbid: int) -> list[TableInfo]:
+        out = []
+        for tid in self._table_ids(dbid):
+            v = self.txn.get(meta_key(b"DB", str(dbid).encode(),
+                                      b"Table", str(tid).encode()))
+            if v is not None:
+                out.append(TableInfo.deserialize(v))
+        return out
+
+    def get_table(self, dbid: int, tid: int) -> TableInfo | None:
+        v = self.txn.get(meta_key(b"DB", str(dbid).encode(),
+                                  b"Table", str(tid).encode()))
+        return TableInfo.deserialize(v) if v is not None else None
+
+    def create_table(self, dbid: int, tbl: TableInfo):
+        ids = self._table_ids(dbid)
+        for existing in self.list_tables(dbid):
+            if existing.name.lower() == tbl.name.lower():
+                raise TableExistsError("Table '%s' already exists", tbl.name)
+        ids.append(tbl.id)
+        self._set_table_ids(dbid, ids)
+        self.update_table(dbid, tbl)
+
+    def update_table(self, dbid: int, tbl: TableInfo):
+        self.txn.set(meta_key(b"DB", str(dbid).encode(),
+                              b"Table", str(tbl.id).encode()), tbl.serialize())
+
+    def drop_table(self, dbid: int, tid: int):
+        ids = self._table_ids(dbid)
+        if tid not in ids:
+            raise TableNotExistsError("Unknown table id %d", tid)
+        self._set_table_ids(dbid, [i for i in ids if i != tid])
+        self.txn.delete(meta_key(b"DB", str(dbid).encode(),
+                                 b"Table", str(tid).encode()))

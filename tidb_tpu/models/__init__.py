@@ -1,0 +1,3 @@
+from .schema import DBInfo, TableInfo, ColumnInfo, IndexInfo, SchemaState
+
+__all__ = ["DBInfo", "TableInfo", "ColumnInfo", "IndexInfo", "SchemaState"]

@@ -1,0 +1,166 @@
+"""Schema model structs (reference pkg/meta/model/{db,table,column,index}.go).
+
+Serialized as JSON into the meta KV namespace; SchemaState carries the F1
+online-DDL state machine states (reference pkg/meta/model/job.go).
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+
+from ..types import FieldType
+from ..types.field_type import TypeClass
+
+
+class SchemaState(enum.IntEnum):
+    NONE = 0
+    DELETE_ONLY = 1
+    WRITE_ONLY = 2
+    WRITE_REORG = 3
+    PUBLIC = 4
+
+
+@dataclass
+class ColumnInfo:
+    id: int
+    name: str
+    offset: int
+    ft: FieldType
+    state: SchemaState = SchemaState.PUBLIC
+    comment: str = ""
+
+    def to_json(self):
+        return {
+            "id": self.id, "name": self.name, "offset": self.offset,
+            "state": int(self.state), "comment": self.comment,
+            "ft": {
+                "tp": self.ft.tp, "tclass": int(self.ft.tclass),
+                "flen": self.ft.flen, "decimal": self.ft.decimal,
+                "unsigned": self.ft.unsigned, "not_null": self.ft.not_null,
+                "charset": self.ft.charset, "collate": self.ft.collate,
+                "elems": self.ft.elems,
+                "auto_increment": self.ft.auto_increment,
+                "primary_key": self.ft.primary_key,
+                "default_value": self.ft.default_value,
+                "has_default": self.ft.has_default,
+            },
+        }
+
+    @classmethod
+    def from_json(cls, j):
+        f = j["ft"]
+        ft = FieldType(
+            tp=f["tp"], tclass=TypeClass(f["tclass"]), flen=f["flen"],
+            decimal=f["decimal"], unsigned=f["unsigned"], not_null=f["not_null"],
+            charset=f["charset"], collate=f["collate"], elems=f["elems"],
+            auto_increment=f["auto_increment"], primary_key=f["primary_key"],
+            default_value=f["default_value"], has_default=f["has_default"])
+        return cls(id=j["id"], name=j["name"], offset=j["offset"], ft=ft,
+                   state=SchemaState(j["state"]), comment=j["comment"])
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    columns: list[str]          # column names in index order
+    unique: bool = False
+    primary: bool = False
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "columns": self.columns,
+                "unique": self.unique, "primary": self.primary,
+                "state": int(self.state)}
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(id=j["id"], name=j["name"], columns=j["columns"],
+                   unique=j["unique"], primary=j["primary"],
+                   state=SchemaState(j["state"]))
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: list[ColumnInfo] = field(default_factory=list)
+    indexes: list[IndexInfo] = field(default_factory=list)
+    pk_is_handle: bool = False   # clustered int PK stored as row handle
+    pk_col_name: str = ""
+    auto_inc_id: int = 0
+    state: SchemaState = SchemaState.PUBLIC
+    comment: str = ""
+
+    def find_column(self, name: str) -> ColumnInfo | None:
+        name = name.lower()
+        for c in self.columns:
+            if c.name.lower() == name:
+                return c
+        return None
+
+    def find_index(self, name: str) -> IndexInfo | None:
+        name = name.lower()
+        for idx in self.indexes:
+            if idx.name.lower() == name:
+                return idx
+        return None
+
+    def public_columns(self) -> list[ColumnInfo]:
+        return [c for c in self.columns if c.state == SchemaState.PUBLIC]
+
+    def writable_indexes(self) -> list[IndexInfo]:
+        return [i for i in self.indexes if i.state >= SchemaState.WRITE_ONLY]
+
+    def to_json(self):
+        return {
+            "id": self.id, "name": self.name,
+            "columns": [c.to_json() for c in self.columns],
+            "indexes": [i.to_json() for i in self.indexes],
+            "pk_is_handle": self.pk_is_handle, "pk_col_name": self.pk_col_name,
+            "auto_inc_id": self.auto_inc_id, "state": int(self.state),
+            "comment": self.comment,
+        }
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(
+            id=j["id"], name=j["name"],
+            columns=[ColumnInfo.from_json(c) for c in j["columns"]],
+            indexes=[IndexInfo.from_json(i) for i in j["indexes"]],
+            pk_is_handle=j["pk_is_handle"], pk_col_name=j["pk_col_name"],
+            auto_inc_id=j["auto_inc_id"], state=SchemaState(j["state"]),
+            comment=j.get("comment", ""))
+
+    def serialize(self) -> bytes:
+        return json.dumps(self.to_json()).encode()
+
+    @classmethod
+    def deserialize(cls, b: bytes) -> "TableInfo":
+        return cls.from_json(json.loads(b))
+
+
+@dataclass
+class DBInfo:
+    id: int
+    name: str
+    charset: str = "utf8mb4"
+    collate: str = "utf8mb4_bin"
+    state: SchemaState = SchemaState.PUBLIC
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "charset": self.charset,
+                "collate": self.collate, "state": int(self.state)}
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(id=j["id"], name=j["name"], charset=j["charset"],
+                   collate=j["collate"], state=SchemaState(j["state"]))
+
+    def serialize(self) -> bytes:
+        return json.dumps(self.to_json()).encode()
+
+    @classmethod
+    def deserialize(cls, b: bytes) -> "DBInfo":
+        return cls.from_json(json.loads(b))

@@ -1,0 +1,160 @@
+"""System variable registry (reference pkg/sessionctx/variable/sysvar.go +
+vardef/tidb_vars.go). Scopes: GLOBAL / SESSION / both. The TPU toggle
+`tidb_enable_tpu_exec` follows the reference's
+`tidb_enable_vectorized_expression` pattern (vardef/tidb_vars.go:672)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..errors import UnknownSystemVariableError, WrongValueForVarError
+
+SCOPE_GLOBAL = 1
+SCOPE_SESSION = 2
+SCOPE_BOTH = 3
+
+
+@dataclass
+class SysVar:
+    name: str
+    scope: int
+    default: object
+    type: str = "str"          # str | int | bool | float | enum
+    min_val: int | None = None
+    max_val: int | None = None
+    enum_vals: list = field(default_factory=list)
+    validate: Callable | None = None
+
+    def coerce(self, value):
+        if self.type == "bool":
+            if isinstance(value, bool):
+                return value
+            s = str(value).lower()
+            if s in ("1", "on", "true", "yes"):
+                return True
+            if s in ("0", "off", "false", "no"):
+                return False
+            raise WrongValueForVarError(
+                "Variable '%s' can't be set to the value of '%s'", self.name, value)
+        if self.type == "int":
+            try:
+                v = int(value)
+            except (TypeError, ValueError):
+                raise WrongValueForVarError(
+                    "Variable '%s' can't be set to the value of '%s'", self.name, value)
+            if self.min_val is not None:
+                v = max(v, self.min_val)
+            if self.max_val is not None:
+                v = min(v, self.max_val)
+            return v
+        if self.type == "float":
+            return float(value)
+        if self.type == "enum":
+            s = str(value).lower()
+            if s not in self.enum_vals:
+                raise WrongValueForVarError(
+                    "Variable '%s' can't be set to the value of '%s'", self.name, value)
+            return s
+        return str(value)
+
+
+_REGISTRY: dict[str, SysVar] = {}
+
+
+def register(var: SysVar):
+    _REGISTRY[var.name.lower()] = var
+
+
+def get_sysvar(name: str) -> SysVar:
+    v = _REGISTRY.get(name.lower())
+    if v is None:
+        raise UnknownSystemVariableError("Unknown system variable '%s'", name)
+    return v
+
+
+def all_sysvars():
+    return dict(_REGISTRY)
+
+
+for _v in [
+    SysVar("tidb_enable_tpu_exec", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_enable_vectorized_expression", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_max_chunk_size", SCOPE_BOTH, 1 << 17, "int", 32, 1 << 24),
+    SysVar("tidb_init_chunk_size", SCOPE_BOTH, 32, "int", 1, 32768),
+    SysVar("tidb_mem_quota_query", SCOPE_BOTH, 1 << 30, "int", 128 << 10, None),
+    SysVar("tidb_executor_concurrency", SCOPE_BOTH, 8, "int", 1, 256),
+    SysVar("tidb_distsql_scan_concurrency", SCOPE_BOTH, 8, "int", 1, 256),
+    SysVar("tidb_opt_agg_push_down", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_enable_mpp", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_allow_mpp", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH, 100 << 20, "int", 0, None),
+    SysVar("tidb_device_batch_rows", SCOPE_BOTH, 1 << 22, "int", 1 << 10, 1 << 26),
+    SysVar("tidb_txn_mode", SCOPE_BOTH, "pessimistic", "enum",
+           enum_vals=["optimistic", "pessimistic"]),
+    SysVar("tidb_retry_limit", SCOPE_BOTH, 10, "int", 0, 100),
+    SysVar("autocommit", SCOPE_BOTH, True, "bool"),
+    SysVar("sql_mode", SCOPE_BOTH, "STRICT_TRANS_TABLES", "str"),
+    SysVar("time_zone", SCOPE_BOTH, "SYSTEM", "str"),
+    SysVar("max_allowed_packet", SCOPE_BOTH, 67108864, "int", 1024, 1 << 30),
+    SysVar("div_precision_increment", SCOPE_BOTH, 4, "int", 0, 30),
+    SysVar("tidb_slow_log_threshold", SCOPE_BOTH, 300, "int", -1, None),
+    SysVar("tidb_enable_collect_execution_info", SCOPE_BOTH, True, "bool"),
+]:
+    register(_v)
+
+
+class SessionVars:
+    """Per-session variable values over the registry defaults + globals."""
+
+    def __init__(self, global_vars: dict | None = None):
+        self._globals = global_vars if global_vars is not None else {}
+        self._session: dict[str, object] = {}
+        self.current_db = ""
+        self.in_txn = False
+        self.last_insert_id = 0
+        self.affected_rows = 0
+        self.found_rows = 0
+        self.warnings: list = []
+
+    def get(self, name: str):
+        key = name.lower()
+        if key in self._session:
+            return self._session[key]
+        if key in self._globals:
+            return self._globals[key]
+        return get_sysvar(name).default
+
+    def set(self, name: str, value, is_global=False):
+        var = get_sysvar(name)
+        v = var.coerce(value)
+        if is_global:
+            if not var.scope & SCOPE_GLOBAL:
+                raise WrongValueForVarError(
+                    "Variable '%s' is a SESSION variable", name)
+            self._globals[name.lower()] = v
+        else:
+            if not var.scope & SCOPE_SESSION:
+                raise WrongValueForVarError(
+                    "Variable '%s' is a GLOBAL variable", name)
+            self._session[name.lower()] = v
+
+    # convenience accessors for hot flags
+    @property
+    def tpu_exec(self) -> bool:
+        return bool(self.get("tidb_enable_tpu_exec"))
+
+    @property
+    def max_chunk_size(self) -> int:
+        return int(self.get("tidb_max_chunk_size"))
+
+    @property
+    def mem_quota_query(self) -> int:
+        return int(self.get("tidb_mem_quota_query"))
+
+    @property
+    def div_precision_increment(self) -> int:
+        return int(self.get("div_precision_increment"))
+
+    @property
+    def autocommit(self) -> bool:
+        return bool(self.get("autocommit"))

@@ -1,0 +1,5 @@
+from .kv import MemKV, KVIter
+from .mvcc import MVCCStore
+from .txn import Oracle, Transaction, Storage
+
+__all__ = ["MemKV", "KVIter", "MVCCStore", "Oracle", "Transaction", "Storage"]

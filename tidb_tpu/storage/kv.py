@@ -1,0 +1,89 @@
+"""Sorted in-memory KV primitives.
+
+The embedded row engine (reference role: unistore's badger,
+pkg/store/mockstore/unistore). A sorted key list + dict gives O(log n) seek
+and O(n) insert — adequate for the OLTP/test path; the OLAP hot path reads
+the columnar engine, not this. Swappable later for a C++ skiplist/LSM behind
+the same interface.
+"""
+from __future__ import annotations
+
+import bisect
+
+
+class MemKV:
+    """Sorted map bytes -> object (values are opaque to this layer)."""
+
+    __slots__ = ("_keys", "_map")
+
+    def __init__(self):
+        self._keys: list[bytes] = []
+        self._map: dict[bytes, object] = {}
+
+    def get(self, key: bytes):
+        return self._map.get(key)
+
+    def put(self, key: bytes, value):
+        if key not in self._map:
+            bisect.insort(self._keys, key)
+        self._map[key] = value
+
+    def delete(self, key: bytes):
+        if key in self._map:
+            del self._map[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __contains__(self, key):
+        return key in self._map
+
+    def seek(self, key: bytes) -> int:
+        """Index of first key >= key."""
+        return bisect.bisect_left(self._keys, key)
+
+    def scan(self, start: bytes, end: bytes | None = None):
+        """Yield (key, value) for start <= key < end."""
+        i = self.seek(start)
+        keys = self._keys
+        m = self._map
+        n = len(keys)
+        while i < n:
+            k = keys[i]
+            if end is not None and k >= end:
+                break
+            yield k, m[k]
+            i += 1
+
+    def scan_keys(self, start: bytes, end: bytes | None = None):
+        i = self.seek(start)
+        keys = self._keys
+        n = len(keys)
+        while i < n:
+            k = keys[i]
+            if end is not None and k >= end:
+                break
+            yield k
+            i += 1
+
+
+class KVIter:
+    """Mergeable iterator facade used by UnionScan (txn buffer over snapshot)."""
+
+    def __init__(self, pairs):
+        self._pairs = list(pairs)
+        self._i = 0
+
+    def valid(self):
+        return self._i < len(self._pairs)
+
+    def key(self):
+        return self._pairs[self._i][0]
+
+    def value(self):
+        return self._pairs[self._i][1]
+
+    def next(self):
+        self._i += 1

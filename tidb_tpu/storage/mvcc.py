@@ -1,0 +1,133 @@
+"""MVCC store with Percolator-shaped commit protocol.
+
+Single-process analog of TiKV's txn layer (reference contract:
+pkg/kv/kv.go:764 Storage, unistore MVCC in
+pkg/store/mockstore/unistore/tikv). Versions are kept per key as an
+append-only list of (commit_ts, value|None); None is a delete tombstone.
+The prewrite/commit split is preserved so the seam to a distributed/C++
+engine stays intact — locks are real, conflicts are detected, but network
+hops are function calls.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+from .kv import MemKV
+from ..errors import WriteConflictError, LockWaitTimeoutError
+
+
+class _Versions:
+    __slots__ = ("ts_list", "values")
+
+    def __init__(self):
+        self.ts_list: list[int] = []   # ascending commit_ts
+        self.values: list = []
+
+    def add(self, ts: int, value):
+        i = bisect.bisect_left(self.ts_list, ts)
+        self.ts_list.insert(i, ts)
+        self.values.insert(i, value)
+
+    def get(self, read_ts: int):
+        """Latest value with commit_ts <= read_ts (None if none / tombstone)."""
+        i = bisect.bisect_right(self.ts_list, read_ts)
+        if i == 0:
+            return None
+        return self.values[i - 1]
+
+    def latest_ts(self) -> int:
+        return self.ts_list[-1] if self.ts_list else 0
+
+
+class Lock:
+    __slots__ = ("primary", "start_ts", "op")
+
+    def __init__(self, primary: bytes, start_ts: int, op: str):
+        self.primary = primary
+        self.start_ts = start_ts
+        self.op = op  # 'put' | 'del' | 'lock' (pessimistic)
+
+
+class MVCCStore:
+    def __init__(self):
+        self._kv = MemKV()           # key -> _Versions
+        self._locks: dict[bytes, Lock] = {}
+        self._mu = threading.Lock()
+        self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
+
+    # ---- reads --------------------------------------------------------
+    def get(self, key: bytes, read_ts: int):
+        vers = self._kv.get(key)
+        return vers.get(read_ts) if vers is not None else None
+
+    def scan(self, start: bytes, end: bytes | None, read_ts: int, limit: int = -1):
+        out = []
+        for k, vers in self._kv.scan(start, end):
+            v = vers.get(read_ts)
+            if v is not None:
+                out.append((k, v))
+                if 0 < limit <= len(out):
+                    break
+        return out
+
+    def latest_commit_ts(self, key: bytes) -> int:
+        vers = self._kv.get(key)
+        return vers.latest_ts() if vers is not None else 0
+
+    # ---- pessimistic locks -------------------------------------------
+    def acquire_pessimistic_lock(self, key: bytes, primary: bytes,
+                                 start_ts: int, for_update_ts: int):
+        with self._mu:
+            lock = self._locks.get(key)
+            if lock is not None and lock.start_ts != start_ts:
+                raise LockWaitTimeoutError(
+                    "lock wait timeout on key held by txn %d", lock.start_ts)
+            vers = self._kv.get(key)
+            if vers is not None and vers.latest_ts() > for_update_ts:
+                raise WriteConflictError(
+                    "write conflict on pessimistic lock, key committed at %d > %d",
+                    vers.latest_ts(), for_update_ts)
+            self._locks[key] = Lock(primary, start_ts, "lock")
+
+    # ---- 2PC ----------------------------------------------------------
+    def prewrite(self, mutations: list, primary: bytes, start_ts: int):
+        """mutations: [(key, value|None)]; value None = delete."""
+        with self._mu:
+            for key, _ in mutations:
+                lock = self._locks.get(key)
+                if lock is not None and lock.start_ts != start_ts:
+                    raise LockWaitTimeoutError(
+                        "key is locked by txn %d", lock.start_ts)
+                vers = self._kv.get(key)
+                if vers is not None and vers.latest_ts() > start_ts:
+                    raise WriteConflictError(
+                        "write conflict: key committed at ts %d > start_ts %d",
+                        vers.latest_ts(), start_ts)
+            for key, value in mutations:
+                op = "del" if value is None else "put"
+                self._locks[key] = Lock(primary, start_ts, op)
+
+    def commit(self, mutations: list, start_ts: int, commit_ts: int):
+        with self._mu:
+            for key, value in mutations:
+                lock = self._locks.get(key)
+                if lock is None or lock.start_ts != start_ts:
+                    raise WriteConflictError(
+                        "commit failed: lock missing for txn %d", start_ts)
+            for key, value in mutations:
+                vers = self._kv.get(key)
+                if vers is None:
+                    vers = _Versions()
+                    self._kv.put(key, vers)
+                vers.add(commit_ts, value)
+                del self._locks[key]
+        for hook in self.commit_hooks:
+            hook(commit_ts, mutations)
+
+    def rollback(self, keys: list, start_ts: int):
+        with self._mu:
+            for key in keys:
+                lock = self._locks.get(key)
+                if lock is not None and lock.start_ts == start_ts:
+                    del self._locks[key]

@@ -1,0 +1,140 @@
+"""Transaction layer: TSO + snapshot + memBuffer + 2PC driver.
+
+Reference seams preserved: kv.Storage (pkg/kv/kv.go:764), kv.Transaction
+(pkg/kv/txn.go), tikv/client-go twoPhaseCommitter. The TSO is the PD
+timestamp oracle collapsed to an in-process atomic counter (unistore/pd.go
+role) — the interface stays async-batchable for a future distributed PD.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .kv import MemKV
+from .mvcc import MVCCStore
+
+
+class Oracle:
+    """Timestamp oracle: strictly increasing int64 (physical<<18 | logical
+    layout deferred; monotonic counter is enough in-process)."""
+
+    def __init__(self):
+        self._counter = itertools.count(1)
+        self._mu = threading.Lock()
+
+    def get_ts(self) -> int:
+        with self._mu:
+            return next(self._counter)
+
+
+class Snapshot:
+    __slots__ = ("store", "read_ts")
+
+    def __init__(self, store: MVCCStore, read_ts: int):
+        self.store = store
+        self.read_ts = read_ts
+
+    def get(self, key: bytes):
+        return self.store.get(key, self.read_ts)
+
+    def scan(self, start: bytes, end: bytes | None = None, limit: int = -1):
+        return self.store.scan(start, end, self.read_ts, limit)
+
+
+class Transaction:
+    """Snapshot-isolation transaction with staged memBuffer."""
+
+    def __init__(self, storage: "Storage", start_ts: int, pessimistic=False):
+        self.storage = storage
+        self.start_ts = start_ts
+        self.for_update_ts = start_ts
+        self.pessimistic = pessimistic
+        self.snapshot = Snapshot(storage.mvcc, start_ts)
+        self.mem_buffer = MemKV()     # key -> value|None (None = delete)
+        self._dirty = False
+        self.committed = False
+        self.aborted = False
+
+    # ---- buffered reads/writes ---------------------------------------
+    def get(self, key: bytes):
+        if key in self.mem_buffer:
+            return self.mem_buffer.get(key)
+        return self.snapshot.get(key)
+
+    def set(self, key: bytes, value: bytes):
+        self.mem_buffer.put(key, value)
+        self._dirty = True
+
+    def delete(self, key: bytes):
+        self.mem_buffer.put(key, None)
+        self._dirty = True
+
+    def scan(self, start: bytes, end: bytes | None = None):
+        """Merge memBuffer over snapshot (UnionScan semantics,
+        reference pkg/executor/union_scan.go)."""
+        snap = self.snapshot.scan(start, end)
+        buf = list(self.mem_buffer.scan(start, end))
+        if not buf:
+            return snap
+        merged = []
+        bi = 0
+        overlay = dict(buf)
+        for k, v in snap:
+            if k in overlay:
+                continue
+            merged.append((k, v))
+        for k, v in buf:
+            if v is not None:
+                merged.append((k, v))
+        merged.sort(key=lambda kv: kv[0])
+        return merged
+
+    def lock_keys(self, keys, for_update_ts=None):
+        if for_update_ts is None:
+            for_update_ts = self.storage.oracle.get_ts()
+        self.for_update_ts = for_update_ts
+        primary = keys[0] if keys else b""
+        for k in keys:
+            self.storage.mvcc.acquire_pessimistic_lock(
+                k, primary, self.start_ts, for_update_ts)
+
+    # ---- 2PC ----------------------------------------------------------
+    def commit(self):
+        if not self._dirty:
+            self.committed = True
+            return
+        mutations = [(k, v) for k, v in self.mem_buffer.scan(b"")]
+        primary = mutations[0][0]
+        mvcc = self.storage.mvcc
+        mvcc.prewrite(mutations, primary, self.start_ts)
+        commit_ts = self.storage.oracle.get_ts()
+        mvcc.commit(mutations, self.start_ts, commit_ts)
+        self.committed = True
+        return commit_ts
+
+    def rollback(self):
+        keys = [k for k, _ in self.mem_buffer.scan(b"")]
+        self.storage.mvcc.rollback(keys, self.start_ts)
+        self.aborted = True
+
+    def is_dirty(self):
+        return self._dirty
+
+
+class Storage:
+    """Process-wide storage: MVCC row engine + oracle + columnar engines.
+
+    Columnar engines (tidb_tpu/storage/columnar.py) register per-table and
+    subscribe to commits via MVCCStore.commit_hooks — the TiFlash raft-learner
+    replication path collapsed to an in-process callback.
+    """
+
+    def __init__(self):
+        self.mvcc = MVCCStore()
+        self.oracle = Oracle()
+
+    def begin(self, pessimistic=False) -> Transaction:
+        return Transaction(self, self.oracle.get_ts(), pessimistic)
+
+    def current_ts(self) -> int:
+        return self.oracle.get_ts()

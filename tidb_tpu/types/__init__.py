@@ -1,0 +1,63 @@
+"""SQL type system (analog of reference pkg/types + pkg/parser/types).
+
+TPU-first representation policy:
+  * integers            -> int64 device arrays
+  * float/double        -> float32/float64 device arrays (f32 preferred on TPU)
+  * decimal(p, s)       -> scaled int64 ("fixed-point") device arrays; exact
+                           division and overflow promotion happen on host
+                           (reference: pkg/types/mydecimal.go, re-designed —
+                           base-1e9 limbs do not vectorize; scaled ints do)
+  * date/datetime/ts    -> int64 (days / microseconds since epoch)
+  * char/varchar        -> dictionary codes (int32) on device + host dict;
+                           collation-aware compares use precomputed sort keys
+  * null                -> bool mask array (True = NULL), never sentinel values
+"""
+from .field_type import (
+    FieldType,
+    TypeClass,
+    MYSQL_TYPE_NAMES,
+    new_int_type,
+    new_bigint_type,
+    new_double_type,
+    new_float_type,
+    new_decimal_type,
+    new_string_type,
+    new_date_type,
+    new_datetime_type,
+    new_timestamp_type,
+    agg_field_type,
+    merge_field_type,
+)
+from .datum import (
+    Datum,
+    NULL,
+    datum_from_py,
+    compare_datum,
+)
+from .decimal import (
+    dec_to_scaled_int,
+    scaled_int_to_str,
+    dec_round_scaled,
+    MAX_DECIMAL_PRECISION,
+)
+from .time_types import (
+    parse_date,
+    parse_datetime,
+    days_to_ymd,
+    ymd_to_days,
+    micros_to_str,
+    days_to_str,
+    DATE_EPOCH_YEAR,
+)
+
+__all__ = [
+    "FieldType", "TypeClass", "MYSQL_TYPE_NAMES",
+    "new_int_type", "new_bigint_type", "new_double_type", "new_float_type",
+    "new_decimal_type", "new_string_type", "new_date_type", "new_datetime_type",
+    "new_timestamp_type", "agg_field_type", "merge_field_type",
+    "Datum", "NULL", "datum_from_py", "compare_datum",
+    "dec_to_scaled_int", "scaled_int_to_str", "dec_round_scaled",
+    "MAX_DECIMAL_PRECISION",
+    "parse_date", "parse_datetime", "days_to_ymd", "ymd_to_days",
+    "micros_to_str", "days_to_str", "DATE_EPOCH_YEAR",
+]

@@ -1,0 +1,137 @@
+"""Datum: scalar SQL value for the row path (reference pkg/types/datum.go).
+
+The OLAP path never touches Datums — it works on column arrays. Datums serve
+the row path: constants in plans, point reads/writes, KV codec, comparisons
+in the planner. Representation is (kind, python value):
+
+    int/uint  -> int        decimal -> (int scaled, int scale)
+    float     -> float      string  -> str         bytes -> bytes
+    date      -> int days   datetime/ts -> int micros   duration -> int micros
+    null      -> None
+"""
+from __future__ import annotations
+
+import enum
+from .field_type import FieldType, TypeClass
+from .decimal import scaled_int_to_str, dec_to_scaled_int
+from .time_types import days_to_str, micros_to_str, duration_to_str
+
+
+class Kind(enum.IntEnum):
+    NULL = 0
+    INT = 1
+    UINT = 2
+    FLOAT = 3
+    STRING = 4
+    BYTES = 5
+    DECIMAL = 6
+    DATE = 7
+    DATETIME = 8
+    TIMESTAMP = 9
+    DURATION = 10
+    JSON = 11
+    MIN_NOT_NULL = 12
+    MAX_VALUE = 13
+
+
+class Datum:
+    __slots__ = ("kind", "val", "scale")
+
+    def __init__(self, kind: Kind, val=None, scale: int = 0):
+        self.kind = kind
+        self.val = val
+        self.scale = scale
+
+    @property
+    def is_null(self) -> bool:
+        return self.kind == Kind.NULL
+
+    def to_py(self):
+        """Python value for result sets / client formatting."""
+        if self.kind == Kind.NULL:
+            return None
+        if self.kind == Kind.DECIMAL:
+            return scaled_int_to_str(self.val, self.scale)
+        if self.kind == Kind.DATE:
+            return days_to_str(self.val)
+        if self.kind in (Kind.DATETIME, Kind.TIMESTAMP):
+            return micros_to_str(self.val, self.scale)
+        if self.kind == Kind.DURATION:
+            return duration_to_str(self.val, self.scale)
+        return self.val
+
+    def sort_key(self):
+        """Comparable key implementing MySQL cross-type ordering."""
+        k, v = self.kind, self.val
+        if k == Kind.NULL:
+            return (0, 0)
+        if k == Kind.MAX_VALUE:
+            return (9, 0)
+        if k in (Kind.INT, Kind.UINT):
+            return (1, v)
+        if k == Kind.FLOAT:
+            return (1, v)
+        if k == Kind.DECIMAL:
+            return (1, v / (10 ** self.scale))
+        if k in (Kind.DATE, Kind.DATETIME, Kind.TIMESTAMP, Kind.DURATION):
+            return (2, v)
+        if k == Kind.STRING:
+            return (3, v)
+        if k == Kind.BYTES:
+            return (3, v.decode("utf-8", "surrogateescape") if isinstance(v, bytes) else v)
+        return (4, str(v))
+
+    def __repr__(self):
+        return f"Datum({self.kind.name}, {self.val!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Datum) and compare_datum(self, other) == 0
+
+    def __hash__(self):
+        return hash(self.sort_key())
+
+
+NULL = Datum(Kind.NULL)
+MAX_VALUE = Datum(Kind.MAX_VALUE)
+MIN_NOT_NULL = Datum(Kind.MIN_NOT_NULL)
+
+
+def datum_from_py(v, ft: FieldType | None = None) -> Datum:
+    """Build a Datum from a python value, optionally guided by a FieldType."""
+    if v is None:
+        return NULL
+    if isinstance(v, Datum):
+        return v
+    if isinstance(v, bool):
+        return Datum(Kind.INT, int(v))
+    if isinstance(v, int):
+        if ft is not None and ft.tclass == TypeClass.DECIMAL:
+            return Datum(Kind.DECIMAL, dec_to_scaled_int(v, max(ft.decimal, 0)),
+                         max(ft.decimal, 0))
+        if ft is not None and ft.tclass == TypeClass.DATE:
+            return Datum(Kind.DATE, v)
+        if ft is not None and ft.tclass in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            return Datum(Kind.DATETIME, v)
+        return Datum(Kind.UINT if (ft and ft.unsigned) else Kind.INT, v)
+    if isinstance(v, float):
+        return Datum(Kind.FLOAT, v)
+    if isinstance(v, str):
+        return Datum(Kind.STRING, v)
+    if isinstance(v, bytes):
+        return Datum(Kind.BYTES, v)
+    raise TypeError(f"cannot convert {type(v)} to Datum")
+
+
+def compare_datum(a: Datum, b: Datum) -> int:
+    """-1/0/1 with NULL < everything (index-order semantics, reference
+    pkg/types/datum.go Compare)."""
+    if a.kind == Kind.NULL or b.kind == Kind.NULL:
+        if a.kind == b.kind:
+            return 0
+        return -1 if a.kind == Kind.NULL else 1
+    ka, kb = a.sort_key(), b.sort_key()
+    if ka < kb:
+        return -1
+    if ka > kb:
+        return 1
+    return 0

@@ -1,0 +1,101 @@
+"""Decimal as scaled integers (TPU-first redesign of pkg/types/mydecimal.go).
+
+The reference stores decimals as base-1e9 limb arrays — good for arbitrary
+precision on CPU, hopeless to vectorize. Here a DECIMAL(p, s) column is a
+single int64 holding value * 10^s. Device arithmetic (+, -, sum, compare) is
+plain int64; multiplication rescales; exact division falls back to host
+Python ints (arbitrary precision) — mirrors the reference's "hard parts"
+note in SURVEY.md §7.
+
+p <= 18 fits int64 exactly. p in (18, 38] uses host-side Python ints in the
+row path and float64 on the device path with a documented precision caveat
+(revisit: int32 hi/lo pair kernels).
+"""
+from __future__ import annotations
+
+from fractions import Fraction
+
+MAX_DECIMAL_PRECISION = 65
+INT64_SAFE_PRECISION = 18
+
+_POW10 = [10 ** i for i in range(38)]
+
+
+def dec_to_scaled_int(value, scale: int) -> int:
+    """Parse a decimal literal (str/int/float/Fraction) to value*10^scale,
+    rounding half away from zero (MySQL rounding)."""
+    if isinstance(value, int):
+        return value * _POW10[scale]
+    if isinstance(value, float):
+        value = repr(value)
+    if isinstance(value, Fraction):
+        num = value * _POW10[scale]
+        q, r = divmod(num.numerator, num.denominator)
+        if 2 * r >= num.denominator:
+            q += 1
+        return q
+    s = str(value).strip()
+    neg = s.startswith("-")
+    if neg or s.startswith("+"):
+        s = s[1:]
+    if "e" in s or "E" in s:
+        f = Fraction(s)
+        return (-1 if neg else 1) * dec_to_scaled_int(f, scale)
+    if "." in s:
+        ip, fp = s.split(".", 1)
+    else:
+        ip, fp = s, ""
+    ip = ip or "0"
+    fp = fp or ""
+    if len(fp) > scale:
+        keep, rest = fp[:scale], fp[scale:]
+        v = int(ip) * _POW10[scale] + (int(keep) if keep else 0)
+        if rest and int(rest[0]) >= 5:
+            v += 1
+    else:
+        v = int(ip) * _POW10[scale] + (int(fp) * _POW10[scale - len(fp)] if fp else 0)
+    return -v if neg else v
+
+
+def scaled_int_to_str(v: int, scale: int) -> str:
+    if scale <= 0:
+        return str(v)
+    neg = v < 0
+    v = abs(v)
+    ip, fp = divmod(v, _POW10[scale])
+    s = f"{ip}.{fp:0{scale}d}"
+    return "-" + s if neg else s
+
+
+def dec_round_scaled(v: int, scale: int, target_scale: int) -> int:
+    """Round a scaled int from `scale` to `target_scale` (half away from zero)."""
+    if target_scale >= scale:
+        return v * _POW10[target_scale - scale]
+    div = _POW10[scale - target_scale]
+    q, r = divmod(abs(v), div)
+    if 2 * r >= div:
+        q += 1
+    return -q if v < 0 else q
+
+
+def dec_add(a: int, sa: int, b: int, sb: int):
+    """Add two scaled ints; returns (value, scale)."""
+    s = max(sa, sb)
+    return a * _POW10[s - sa] + b * _POW10[s - sb], s
+
+
+def dec_mul(a: int, sa: int, b: int, sb: int):
+    return a * b, sa + sb
+
+
+def dec_div(a: int, sa: int, b: int, sb: int, incr_scale: int = 4):
+    """MySQL division: result scale = sa + div_precision_increment."""
+    if b == 0:
+        return None, sa + incr_scale
+    ts = sa + incr_scale
+    num = a * _POW10[ts - sa + sb]
+    q, r = divmod(abs(num), abs(b))
+    if 2 * r >= abs(b):
+        q += 1
+    sign = -1 if (a < 0) != (b < 0) else 1
+    return sign * q, ts

@@ -1,0 +1,209 @@
+"""Field types: SQL column/expression types and coercion rules.
+
+Analog of reference pkg/parser/types/field_type.go + pkg/expression type
+inference (aggFieldType / mergeFieldType). Collapsed to the type classes the
+device engine distinguishes; MySQL sub-types are kept for DDL fidelity.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+
+
+class TypeClass(enum.IntEnum):
+    """Device-relevant type class: what dtype the column lowers to."""
+
+    INT = 0        # int64 (all MySQL int widths, bool, year)
+    UINT = 1       # int64 with unsigned flag (compare/format differ)
+    FLOAT = 2      # float64 host / float32-or-64 device
+    DECIMAL = 3    # scaled int64
+    STRING = 4     # dictionary codes + host strings
+    DATE = 5       # int64 days since 1970-01-01
+    DATETIME = 6   # int64 microseconds since epoch
+    TIMESTAMP = 7  # int64 microseconds since epoch (UTC-normalized)
+    DURATION = 8   # int64 microseconds
+    JSON = 9       # host-only
+    BIT = 10       # int64
+    ENUM = 11      # int64 index + host values
+    SET = 12       # int64 bitmask + host values
+    NULLT = 13     # the type of literal NULL
+
+
+# MySQL type byte names (for SHOW/information_schema fidelity)
+MYSQL_TYPE_NAMES = {
+    "tinyint": TypeClass.INT, "smallint": TypeClass.INT,
+    "mediumint": TypeClass.INT, "int": TypeClass.INT, "integer": TypeClass.INT,
+    "bigint": TypeClass.INT, "bool": TypeClass.INT, "boolean": TypeClass.INT,
+    "year": TypeClass.INT,
+    "float": TypeClass.FLOAT, "double": TypeClass.FLOAT, "real": TypeClass.FLOAT,
+    "decimal": TypeClass.DECIMAL, "numeric": TypeClass.DECIMAL,
+    "char": TypeClass.STRING, "varchar": TypeClass.STRING,
+    "text": TypeClass.STRING, "tinytext": TypeClass.STRING,
+    "mediumtext": TypeClass.STRING, "longtext": TypeClass.STRING,
+    "binary": TypeClass.STRING, "varbinary": TypeClass.STRING,
+    "blob": TypeClass.STRING, "tinyblob": TypeClass.STRING,
+    "mediumblob": TypeClass.STRING, "longblob": TypeClass.STRING,
+    "date": TypeClass.DATE, "datetime": TypeClass.DATETIME,
+    "timestamp": TypeClass.TIMESTAMP, "time": TypeClass.DURATION,
+    "json": TypeClass.JSON, "bit": TypeClass.BIT,
+    "enum": TypeClass.ENUM, "set": TypeClass.SET,
+}
+
+_INT_WIDTH_LIMITS = {
+    "tinyint": (-(2**7), 2**7 - 1, 2**8 - 1),
+    "smallint": (-(2**15), 2**15 - 1, 2**16 - 1),
+    "mediumint": (-(2**23), 2**23 - 1, 2**24 - 1),
+    "int": (-(2**31), 2**31 - 1, 2**32 - 1),
+    "integer": (-(2**31), 2**31 - 1, 2**32 - 1),
+    "bigint": (-(2**63), 2**63 - 1, 2**64 - 1),
+}
+
+
+@dataclass
+class FieldType:
+    tp: str = "bigint"                  # MySQL type name (lowercase)
+    tclass: TypeClass = TypeClass.INT
+    flen: int = -1                      # display length / varchar length
+    decimal: int = -1                   # scale for decimal, fsp for time
+    unsigned: bool = False
+    not_null: bool = False
+    charset: str = "utf8mb4"
+    collate: str = "utf8mb4_bin"
+    elems: list = field(default_factory=list)  # enum/set values
+    auto_increment: bool = False
+    primary_key: bool = False
+    default_value: object = None
+    has_default: bool = False
+
+    def clone(self, **kw) -> "FieldType":
+        ft = replace(self)
+        for k, v in kw.items():
+            setattr(ft, k, v)
+        return ft
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.tclass in (TypeClass.INT, TypeClass.UINT, TypeClass.FLOAT,
+                               TypeClass.DECIMAL, TypeClass.BIT)
+
+    @property
+    def is_temporal(self) -> bool:
+        return self.tclass in (TypeClass.DATE, TypeClass.DATETIME,
+                               TypeClass.TIMESTAMP, TypeClass.DURATION)
+
+    def int_limits(self):
+        lo, hi, uhi = _INT_WIDTH_LIMITS.get(self.tp, _INT_WIDTH_LIMITS["bigint"])
+        return (0, uhi) if self.unsigned else (lo, hi)
+
+    def sql_string(self) -> str:
+        s = self.tp
+        if self.tclass == TypeClass.DECIMAL:
+            p = self.flen if self.flen > 0 else 10
+            d = self.decimal if self.decimal >= 0 else 0
+            s += f"({p},{d})"
+        elif self.tp in ("char", "varchar", "binary", "varbinary") and self.flen > 0:
+            s += f"({self.flen})"
+        if self.unsigned:
+            s += " unsigned"
+        return s
+
+    def __repr__(self):
+        return f"FieldType({self.sql_string()})"
+
+
+def _mk(tp, tclass, **kw):
+    return FieldType(tp=tp, tclass=tclass, **kw)
+
+
+def new_int_type(**kw):
+    return _mk("int", TypeClass.INT, **kw)
+
+
+def new_bigint_type(**kw):
+    return _mk("bigint", TypeClass.INT, **kw)
+
+
+def new_double_type(**kw):
+    return _mk("double", TypeClass.FLOAT, **kw)
+
+
+def new_float_type(**kw):
+    return _mk("float", TypeClass.FLOAT, **kw)
+
+
+def new_decimal_type(precision=10, scale=0, **kw):
+    return _mk("decimal", TypeClass.DECIMAL, flen=precision, decimal=scale, **kw)
+
+
+def new_string_type(flen=-1, tp="varchar", **kw):
+    return _mk(tp, TypeClass.STRING, flen=flen, **kw)
+
+
+def new_date_type(**kw):
+    return _mk("date", TypeClass.DATE, **kw)
+
+
+def new_datetime_type(fsp=0, **kw):
+    return _mk("datetime", TypeClass.DATETIME, decimal=fsp, **kw)
+
+
+def new_timestamp_type(fsp=0, **kw):
+    return _mk("timestamp", TypeClass.TIMESTAMP, decimal=fsp, **kw)
+
+
+def new_null_type():
+    return _mk("null", TypeClass.NULLT)
+
+
+_NUMERIC_ORDER = [TypeClass.INT, TypeClass.UINT, TypeClass.BIT,
+                  TypeClass.DECIMAL, TypeClass.FLOAT]
+
+
+def merge_field_type(a: FieldType, b: FieldType) -> FieldType:
+    """Result type of a binary arithmetic / comparison-context merge.
+
+    Simplified MySQL rules (reference pkg/expression/builtin_arithmetic.go
+    setType logic): float wins over decimal wins over int; temporal + int ->
+    temporal handled by callers; string in numeric context -> float.
+    """
+    ta, tb = a.tclass, b.tclass
+    if ta == TypeClass.NULLT:
+        return b.clone()
+    if tb == TypeClass.NULLT:
+        return a.clone()
+    if TypeClass.FLOAT in (ta, tb) or TypeClass.STRING in (ta, tb) \
+            or TypeClass.JSON in (ta, tb):
+        return new_double_type()
+    if TypeClass.DECIMAL in (ta, tb):
+        pa = a.flen if ta == TypeClass.DECIMAL else 20
+        sa = max(a.decimal if ta == TypeClass.DECIMAL else 0, 0)
+        pb = b.flen if tb == TypeClass.DECIMAL else 20
+        sb = max(b.decimal if tb == TypeClass.DECIMAL else 0, 0)
+        scale = max(sa, sb)
+        prec = min(max(pa - sa, pb - sb) + scale + 1, 65)
+        return new_decimal_type(precision=prec, scale=scale)
+    if a.is_temporal or b.is_temporal:
+        # temporal merged with anything numeric compares as int64 micros/days
+        return (a if a.is_temporal else b).clone()
+    ft = new_bigint_type()
+    ft.unsigned = a.unsigned and b.unsigned
+    return ft
+
+
+def agg_field_type(fts: list) -> FieldType:
+    """UNION/CASE/COALESCE result type (reference types/field_type.go AggFieldType)."""
+    out = fts[0]
+    for ft in fts[1:]:
+        if out.tclass == ft.tclass:
+            if out.tclass == TypeClass.DECIMAL:
+                out = merge_field_type(out, ft)
+            continue
+        if out.tclass == TypeClass.NULLT:
+            out = ft
+        elif ft.tclass == TypeClass.NULLT:
+            pass
+        elif TypeClass.STRING in (out.tclass, ft.tclass):
+            out = new_string_type()
+        else:
+            out = merge_field_type(out, ft)
+    return out

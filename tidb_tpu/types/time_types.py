@@ -1,0 +1,147 @@
+"""Temporal types as integers (redesign of pkg/types/time.go).
+
+DATE      -> int64 days since 1970-01-01 (proleptic Gregorian)
+DATETIME  -> int64 microseconds since 1970-01-01 00:00:00
+TIMESTAMP -> same, normalized to UTC
+DURATION  -> int64 microseconds
+
+Integer encodings make range predicates, EXTRACT, and date arithmetic pure
+int64 device ops (the reference packs bitfields in a uint64 core time —
+pkg/types/core_time.go — which serves the same goal on CPU).
+"""
+from __future__ import annotations
+
+from ..errors import TruncatedWrongValueError
+
+DATE_EPOCH_YEAR = 1970
+MICROS_PER_SEC = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SEC
+
+_DAYS_IN_MONTH = [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]
+
+
+def _is_leap(y: int) -> bool:
+    return y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)
+
+
+def _days_before_year(y: int) -> int:
+    """Days from 1970-01-01 to y-01-01 (can be negative)."""
+    y -= 1
+    # days from year 1 to y, minus days from year 1 to 1970
+    def db(yy):
+        return yy * 365 + yy // 4 - yy // 100 + yy // 400
+    return db(y) - db(1969)
+
+
+def ymd_to_days(y: int, m: int, d: int) -> int:
+    days = _days_before_year(y)
+    for i in range(m - 1):
+        days += _DAYS_IN_MONTH[i]
+    if m > 2 and _is_leap(y):
+        days += 1
+    return days + d - 1
+
+
+def days_to_ymd(days: int):
+    # coarse year guess then adjust
+    y = 1970 + days // 366
+    while _days_before_year(y + 1) <= days:
+        y += 1
+    rem = days - _days_before_year(y)
+    m = 1
+    for i, dim in enumerate(_DAYS_IN_MONTH):
+        dim = dim + 1 if (i == 1 and _is_leap(y)) else dim
+        if rem < dim:
+            m = i + 1
+            break
+        rem -= dim
+    return y, m, rem + 1
+
+
+def parse_date(s: str) -> int:
+    """'YYYY-MM-DD' (also YYYYMMDD, Y/M/D) -> days since epoch."""
+    s = s.strip()
+    seps = [c for c in s if not c.isdigit()]
+    try:
+        if not seps:
+            if len(s) == 8:
+                y, m, d = int(s[:4]), int(s[4:6]), int(s[6:8])
+            elif len(s) == 6:
+                yy = int(s[:2])
+                y = 2000 + yy if yy < 70 else 1900 + yy
+                m, d = int(s[2:4]), int(s[4:6])
+            else:
+                raise ValueError(s)
+        else:
+            import re
+            parts = re.split(r"[^0-9]+", s)
+            parts = [p for p in parts if p]
+            y, m, d = int(parts[0]), int(parts[1]), int(parts[2])
+            if y < 100:
+                y = 2000 + y if y < 70 else 1900 + y
+        if not (1 <= m <= 12 and 1 <= d <= 31):
+            raise ValueError(s)
+    except (ValueError, IndexError):
+        raise TruncatedWrongValueError("Incorrect date value: '%s'", s)
+    return ymd_to_days(y, m, d)
+
+
+def parse_datetime(s: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' -> microseconds since epoch."""
+    s = s.strip()
+    if "T" in s:
+        s = s.replace("T", " ", 1)
+    if " " in s:
+        dpart, tpart = s.split(" ", 1)
+    elif len(s) == 14 and s.isdigit():
+        dpart, tpart = s[:8], f"{s[8:10]}:{s[10:12]}:{s[12:14]}"
+    else:
+        dpart, tpart = s, "00:00:00"
+    days = parse_date(dpart)
+    frac = 0
+    if "." in tpart:
+        tpart, fracs = tpart.split(".", 1)
+        fracs = (fracs + "000000")[:6]
+        frac = int(fracs)
+    hms = tpart.split(":")
+    try:
+        h = int(hms[0]) if hms[0] else 0
+        mi = int(hms[1]) if len(hms) > 1 else 0
+        sec = int(hms[2]) if len(hms) > 2 else 0
+        if not (0 <= h < 24 and 0 <= mi < 60 and 0 <= sec < 62):
+            raise ValueError(s)
+    except (ValueError, IndexError):
+        raise TruncatedWrongValueError("Incorrect datetime value: '%s'", s)
+    return days * MICROS_PER_DAY + ((h * 60 + mi) * 60 + sec) * MICROS_PER_SEC + frac
+
+
+def days_to_str(days: int) -> str:
+    y, m, d = days_to_ymd(int(days))
+    return f"{y:04d}-{m:02d}-{d:02d}"
+
+
+def micros_to_str(us: int, fsp: int = 0) -> str:
+    us = int(us)
+    days, rem = divmod(us, MICROS_PER_DAY)
+    if rem < 0:  # negative datetimes
+        days -= 1
+        rem += MICROS_PER_DAY
+    secs, frac = divmod(rem, MICROS_PER_SEC)
+    h, rest = divmod(secs, 3600)
+    mi, sec = divmod(rest, 60)
+    base = f"{days_to_str(days)} {h:02d}:{mi:02d}:{sec:02d}"
+    if fsp > 0:
+        base += "." + f"{frac:06d}"[:fsp]
+    return base
+
+
+def duration_to_str(us: int, fsp: int = 0) -> str:
+    neg = us < 0
+    us = abs(int(us))
+    secs, frac = divmod(us, MICROS_PER_SEC)
+    h, rest = divmod(secs, 3600)
+    mi, sec = divmod(rest, 60)
+    base = f"{'-' if neg else ''}{h:02d}:{mi:02d}:{sec:02d}"
+    if fsp > 0:
+        base += "." + f"{frac:06d}"[:fsp]
+    return base
