@@ -1,0 +1,5 @@
+from .parser import parse, parse_one
+from .digester import normalize_digest
+from . import ast
+
+__all__ = ["parse", "parse_one", "normalize_digest", "ast"]

@@ -1,0 +1,425 @@
+"""SQL AST (reference pkg/parser/ast — redesigned as plain dataclasses).
+
+Expression nodes carry no types at parse time; the planner's expression
+rewriter binds columns and infers types (reference
+planner/core/expression_rewriter.go)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class Node:
+    pass
+
+
+class ExprNode(Node):
+    pass
+
+
+# ---------------- expressions ----------------
+
+@dataclass
+class Literal(ExprNode):
+    value: object            # python scalar | None
+
+    def __repr__(self):
+        return f"Lit({self.value!r})"
+
+
+@dataclass
+class ColumnRef(ExprNode):
+    name: str
+    table: str = ""
+    db: str = ""
+
+    def __repr__(self):
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class BinaryOp(ExprNode):
+    op: str                  # 'or','and','xor','+','-','*','/','div','%',
+                             # '=','<=>','<','<=','>','>=','!=','|','&','<<','>>','^'
+    left: ExprNode
+    right: ExprNode
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: str                  # '-','+','not','~','!'
+    operand: ExprNode
+
+
+@dataclass
+class FuncCall(ExprNode):
+    name: str
+    args: list = field(default_factory=list)
+
+
+@dataclass
+class AggFunc(ExprNode):
+    name: str                # count,sum,avg,min,max,group_concat,...
+    args: list = field(default_factory=list)
+    distinct: bool = False
+
+
+@dataclass
+class IsNull(ExprNode):
+    expr: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class IsTruth(ExprNode):
+    expr: ExprNode
+    truth: bool              # IS TRUE / IS FALSE
+    negated: bool = False
+
+
+@dataclass
+class Between(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class InList(ExprNode):
+    expr: ExprNode
+    items: list = field(default_factory=list)
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(ExprNode):
+    expr: ExprNode
+    subquery: "SelectStmt" = None
+    negated: bool = False
+
+
+@dataclass
+class ExistsSubquery(ExprNode):
+    subquery: "SelectStmt" = None
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery(ExprNode):
+    subquery: "SelectStmt" = None
+
+
+@dataclass
+class CompareSubquery(ExprNode):
+    """expr op ANY/ALL (subquery)"""
+    expr: ExprNode
+    op: str
+    quantifier: str          # 'any' | 'all'
+    subquery: "SelectStmt" = None
+
+
+@dataclass
+class Like(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+    escape: str = "\\"
+
+
+@dataclass
+class RegexpExpr(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class Case(ExprNode):
+    operand: ExprNode | None
+    when_clauses: list = field(default_factory=list)   # [(cond, result)]
+    else_clause: ExprNode | None = None
+
+
+@dataclass
+class Cast(ExprNode):
+    expr: ExprNode
+    to_type: str             # 'signed','unsigned','char','double','decimal','date','datetime'
+    flen: int = -1
+    decimal: int = -1
+
+
+@dataclass
+class IntervalExpr(ExprNode):
+    value: ExprNode
+    unit: str                # day, month, year, hour, minute, second, ...
+
+
+@dataclass
+class VariableExpr(ExprNode):
+    name: str
+    is_system: bool = False
+    is_global: bool = False
+
+
+@dataclass
+class RowExpr(ExprNode):
+    items: list = field(default_factory=list)
+
+
+@dataclass
+class DefaultExpr(ExprNode):
+    pass
+
+
+@dataclass
+class ParamMarker(ExprNode):
+    index: int = -1
+
+
+@dataclass
+class Wildcard(ExprNode):
+    table: str = ""
+    db: str = ""
+
+
+# ---------------- table refs ----------------
+
+@dataclass
+class TableName(Node):
+    name: str
+    db: str = ""
+    alias: str = ""
+    index_hints: list = field(default_factory=list)
+
+
+@dataclass
+class SubqueryTable(Node):
+    select: "SelectStmt"
+    alias: str = ""
+
+
+@dataclass
+class Join(Node):
+    left: Node
+    right: Node
+    join_type: str = "inner"     # inner | left | right | cross
+    on: ExprNode | None = None
+    using: list = field(default_factory=list)
+
+
+# ---------------- statements ----------------
+
+class StmtNode(Node):
+    pass
+
+
+@dataclass
+class SelectField(Node):
+    expr: ExprNode
+    alias: str = ""
+    text: str = ""           # original text for auto column names
+
+
+@dataclass
+class OrderItem(Node):
+    expr: ExprNode
+    desc: bool = False
+
+
+@dataclass
+class Limit(Node):
+    count: ExprNode | None = None
+    offset: ExprNode | None = None
+
+
+@dataclass
+class SelectStmt(StmtNode):
+    fields: list = field(default_factory=list)    # [SelectField|Wildcard]
+    distinct: bool = False
+    from_clause: Node | None = None
+    where: ExprNode | None = None
+    group_by: list = field(default_factory=list)
+    having: ExprNode | None = None
+    order_by: list = field(default_factory=list)  # [OrderItem]
+    limit: Limit | None = None
+    for_update: bool = False
+    # set operations chain: [('union'|'union all'|'except'|'intersect', SelectStmt)]
+    setops: list = field(default_factory=list)
+
+
+@dataclass
+class InsertStmt(StmtNode):
+    table: TableName = None
+    columns: list = field(default_factory=list)
+    values: list = field(default_factory=list)    # list of row expr lists
+    select: SelectStmt | None = None
+    is_replace: bool = False
+    on_duplicate: list = field(default_factory=list)  # [(col, expr)]
+    ignore: bool = False
+
+
+@dataclass
+class UpdateStmt(StmtNode):
+    table_refs: Node = None
+    assignments: list = field(default_factory=list)  # [(ColumnRef, expr)]
+    where: ExprNode | None = None
+    order_by: list = field(default_factory=list)
+    limit: Limit | None = None
+
+
+@dataclass
+class DeleteStmt(StmtNode):
+    table_refs: Node = None
+    where: ExprNode | None = None
+    order_by: list = field(default_factory=list)
+    limit: Limit | None = None
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    type_name: str
+    flen: int = -1
+    decimal: int = -1
+    unsigned: bool = False
+    not_null: bool = False
+    primary_key: bool = False
+    unique: bool = False
+    auto_increment: bool = False
+    default_value: object = None
+    has_default: bool = False
+    comment: str = ""
+    enum_vals: list = field(default_factory=list)
+
+
+@dataclass
+class IndexDef(Node):
+    name: str
+    columns: list = field(default_factory=list)
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTableStmt(StmtNode):
+    table: TableName = None
+    columns: list = field(default_factory=list)   # [ColumnDef]
+    indexes: list = field(default_factory=list)   # [IndexDef]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropTableStmt(StmtNode):
+    tables: list = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTableStmt(StmtNode):
+    table: TableName = None
+
+
+@dataclass
+class CreateDatabaseStmt(StmtNode):
+    name: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt(StmtNode):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
+class CreateIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None
+    columns: list = field(default_factory=list)
+    unique: bool = False
+
+
+@dataclass
+class DropIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None
+
+
+@dataclass
+class AlterTableStmt(StmtNode):
+    table: TableName = None
+    # list of (action, payload):
+    #   ('add_column', ColumnDef), ('drop_column', name),
+    #   ('add_index', IndexDef), ('drop_index', name),
+    #   ('modify_column', ColumnDef), ('rename', TableName)
+    actions: list = field(default_factory=list)
+
+
+@dataclass
+class RenameTableStmt(StmtNode):
+    pairs: list = field(default_factory=list)   # [(TableName, TableName)]
+
+
+@dataclass
+class UseStmt(StmtNode):
+    db: str = ""
+
+
+@dataclass
+class SetStmt(StmtNode):
+    # [(name, expr, is_global, is_system)]
+    assignments: list = field(default_factory=list)
+
+
+@dataclass
+class ShowStmt(StmtNode):
+    kind: str = ""          # databases|tables|columns|create_table|variables|index
+    table: TableName = None
+    db: str = ""
+    like: str = ""
+    where: ExprNode | None = None
+    full: bool = False
+    is_global: bool = False
+
+
+@dataclass
+class ExplainStmt(StmtNode):
+    stmt: StmtNode = None
+    analyze: bool = False
+    format: str = "row"
+
+
+@dataclass
+class BeginStmt(StmtNode):
+    pass
+
+
+@dataclass
+class CommitStmt(StmtNode):
+    pass
+
+
+@dataclass
+class RollbackStmt(StmtNode):
+    pass
+
+
+@dataclass
+class AnalyzeTableStmt(StmtNode):
+    tables: list = field(default_factory=list)
+
+
+@dataclass
+class DescTableStmt(StmtNode):
+    table: TableName = None
+
+
+@dataclass
+class ImportStmt(StmtNode):
+    """IMPORT INTO t FROM 'path' [WITH ...] — lightning-style bulk load
+    (reference pkg/executor/import_into.go)."""
+    table: TableName = None
+    path: str = ""
+    options: dict = field(default_factory=dict)

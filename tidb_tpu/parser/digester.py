@@ -1,0 +1,49 @@
+"""SQL digest/normalizer (reference pkg/parser/digester.go).
+
+Normalization: lowercase keywords/idents, literals -> '?', collapse IN
+lists / VALUES rows to a single '?' (reference NormalizeDigest). Digest =
+sha256 of normalized text; used by plan cache, statement summary, bindings.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from .lexer import tokenize, EOF
+
+
+def normalize_digest(sql: str):
+    toks = tokenize(sql)
+    out = []
+    i = 0
+    n = len(toks)
+    while i < n:
+        t = toks[i]
+        if t.kind == EOF:
+            break
+        if t.kind in ("NUMBER", "STRING", "HEX"):
+            # collapse literal lists: ?, ?, ? -> ... ?
+            if (out and out[-1] == "?" and i >= 1):
+                prev = toks[i - 1]
+                if prev.kind == "OP" and prev.text == ",":
+                    i += 1
+                    continue
+            if out and out[-1] == ",":
+                # pattern "?," already emitted then comma — collapse
+                j = len(out) - 2
+                if j >= 0 and out[j] == "?":
+                    out.pop()
+                    i += 1
+                    continue
+            out.append("?")
+        elif t.kind in ("IDENT",):
+            out.append(t.text.lower())
+        elif t.kind == "QIDENT":
+            out.append(t.text.lower())
+        elif t.kind == "HINT":
+            pass
+        else:
+            out.append(t.text)
+        i += 1
+    norm = " ".join(out)
+    digest = hashlib.sha256(norm.encode()).hexdigest()
+    return norm, digest

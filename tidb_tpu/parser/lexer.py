@@ -1,0 +1,161 @@
+"""SQL lexer (reference pkg/parser/lexer.go, hand-rolled).
+
+Token kinds: IDENT, QIDENT (`backquoted`), NUMBER, STRING, HEX, SYSVAR,
+USERVAR, PARAM, OP, EOF. Keywords are uppercase IDENT matches — keyword
+classification happens in the parser (MySQL keywords are mostly
+non-reserved)."""
+from __future__ import annotations
+
+from ..errors import ParseError
+
+_OPERATORS = [
+    "<=>", "<<", ">>", "<>", "!=", ">=", "<=", ":=", "||", "&&",
+    "(", ")", ",", ";", "+", "-", "*", "/", "%", "=", ">", "<",
+    ".", "|", "&", "^", "~", "!", "?", "@",
+]
+_OP_BY_FIRST = {}
+for _op in _OPERATORS:
+    _OP_BY_FIRST.setdefault(_op[0], []).append(_op)
+for _v in _OP_BY_FIRST.values():
+    _v.sort(key=len, reverse=True)
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.text!r})"
+
+
+EOF = "EOF"
+
+
+def tokenize(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        # comments
+        if c == "#" or (c == "-" and sql[i:i + 3] in ("-- ", "--\t", "--\n") or sql[i:i+2] == "--" and (i+2 >= n or sql[i+2] in " \t\n")):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "/" and sql[i:i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise ParseError("unterminated comment at %d", i)
+            # optimizer hints /*+ ... */ surface as HINT tokens
+            if sql[i + 2:i + 3] == "+":
+                toks.append(Token("HINT", sql[i + 3:j].strip(), i))
+            i = j + 2
+            continue
+        # strings
+        if c in "'\"":
+            quote = c
+            j = i + 1
+            buf = []
+            while j < n:
+                ch = sql[j]
+                if ch == "\\" and j + 1 < n and quote == "'":
+                    esc = sql[j + 1]
+                    buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0",
+                                "\\": "\\", "'": "'", '"': '"', "%": "\\%",
+                                "_": "\\_"}.get(esc, esc))
+                    j += 2
+                    continue
+                if ch == quote:
+                    if j + 1 < n and sql[j + 1] == quote:  # doubled quote
+                        buf.append(quote)
+                        j += 2
+                        continue
+                    break
+                buf.append(ch)
+                j += 1
+            if j >= n:
+                raise ParseError("unterminated string at %d", i)
+            toks.append(Token("STRING", "".join(buf), i))
+            i = j + 1
+            continue
+        # backquoted identifier
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise ParseError("unterminated identifier at %d", i)
+            toks.append(Token("QIDENT", sql[i + 1:j], i))
+            i = j + 1
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            if sql[j:j + 2].lower() == "0x":
+                j += 2
+                while j < n and sql[j] in "0123456789abcdefABCDEF":
+                    j += 1
+                toks.append(Token("HEX", sql[i:j], i))
+                i = j
+                continue
+            seen_dot = False
+            seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j + 1 < n and \
+                        (sql[j + 1].isdigit() or sql[j + 1] in "+-"):
+                    seen_exp = True
+                    j += 1
+                    if sql[j] in "+-":
+                        j += 1
+                else:
+                    break
+            toks.append(Token("NUMBER", sql[i:j], i))
+            i = j
+            continue
+        # identifiers / keywords
+        if c.isalpha() or c == "_" or c == "$":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] in "_$"):
+                j += 1
+            toks.append(Token("IDENT", sql[i:j], i))
+            i = j
+            continue
+        # variables: @@global.x, @@session.x, @@x, @x
+        if c == "@":
+            if sql[i:i + 2] == "@@":
+                j = i + 2
+                while j < n and (sql[j].isalnum() or sql[j] in "_.$"):
+                    j += 1
+                toks.append(Token("SYSVAR", sql[i + 2:j], i))
+                i = j
+                continue
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] in "_.$"):
+                j += 1
+            toks.append(Token("USERVAR", sql[i + 1:j], i))
+            i = j
+            continue
+        # operators
+        ops = _OP_BY_FIRST.get(c)
+        if ops:
+            for op in ops:
+                if sql.startswith(op, i):
+                    toks.append(Token("OP", op, i))
+                    i += len(op)
+                    break
+            else:
+                raise ParseError("unexpected character %r at %d", c, i)
+            continue
+        raise ParseError("unexpected character %r at %d", c, i)
+    toks.append(Token(EOF, "", n))
+    return toks

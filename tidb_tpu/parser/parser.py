@@ -1,0 +1,1243 @@
+"""Recursive-descent SQL parser (reference pkg/parser/parser.y, 17,950-line
+LALR grammar — re-designed as hand-written recursive descent with precedence
+climbing; grammar coverage grows with the engine).
+
+MySQL operator precedence (low -> high):
+    OR/|| < XOR < AND/&& < NOT < predicates/comparison < | < & < <</>>
+    < +,- < *,/,DIV,%,MOD < ^ < unary -,~,! < primary
+"""
+from __future__ import annotations
+
+from .lexer import tokenize, Token, EOF
+from . import ast
+from ..errors import ParseError
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max", "group_concat",
+             "bit_and", "bit_or", "bit_xor", "std", "stddev", "stddev_pop",
+             "var_pop", "variance", "any_value"}
+
+_CMP_OPS = {"=", "<=>", "<", "<=", ">", ">=", "!=", "<>"}
+
+_TIME_UNITS = {"microsecond", "second", "minute", "hour", "day", "week",
+               "month", "quarter", "year", "second_microsecond",
+               "minute_second", "hour_minute", "day_hour", "year_month"}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # ---- token helpers ------------------------------------------------
+    def peek(self, off=0) -> Token:
+        j = min(self.i + off, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != EOF:
+            self.i += 1
+        return t
+
+    def error(self, msg=""):
+        t = self.peek()
+        near = self.sql[t.pos:t.pos + 24]
+        raise ParseError("You have an error in your SQL syntax; %s near '%s'",
+                         msg or "unexpected " + (t.text or "end of input"), near)
+
+    def at_kw(self, *words) -> bool:
+        t = self.peek()
+        return t.kind == "IDENT" and t.text.lower() in words
+
+    def accept_kw(self, *words) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word):
+        if not self.accept_kw(word):
+            self.error(f"expected {word.upper()}")
+
+    def at_op(self, *ops) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.text in ops
+
+    def accept_op(self, *ops) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op):
+        if not self.accept_op(op):
+            self.error(f"expected '{op}'")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind in ("IDENT", "QIDENT"):
+            self.next()
+            return t.text
+        self.error("expected identifier")
+
+    # ==================== statements ===================================
+    def parse_stmts(self) -> list:
+        stmts = []
+        while self.peek().kind != EOF:
+            if self.accept_op(";"):
+                continue
+            stmts.append(self.parse_stmt())
+            if self.peek().kind != EOF:
+                self.expect_op(";")
+        return stmts
+
+    def parse_stmt(self) -> ast.StmtNode:
+        while self.peek().kind == "HINT":
+            self.next()  # statement-level hints: accepted, currently unused
+        t = self.peek()
+        if t.kind == "OP" and t.text == "(":
+            return self.parse_select()
+        if t.kind != "IDENT":
+            self.error()
+        kw = t.text.lower()
+        if kw in ("select", "with"):
+            return self.parse_select()
+        if kw == "insert" or kw == "replace":
+            return self.parse_insert()
+        if kw == "update":
+            return self.parse_update()
+        if kw == "delete":
+            return self.parse_delete()
+        if kw == "create":
+            return self.parse_create()
+        if kw == "drop":
+            return self.parse_drop()
+        if kw == "alter":
+            return self.parse_alter()
+        if kw == "rename":
+            return self.parse_rename()
+        if kw == "truncate":
+            self.next()
+            self.accept_kw("table")
+            return ast.TruncateTableStmt(table=self.parse_table_name())
+        if kw == "use":
+            self.next()
+            return ast.UseStmt(db=self.ident())
+        if kw == "set":
+            return self.parse_set()
+        if kw == "show":
+            return self.parse_show()
+        if kw in ("explain", "desc", "describe"):
+            return self.parse_explain()
+        if kw in ("begin",):
+            self.next()
+            return ast.BeginStmt()
+        if kw == "start":
+            self.next()
+            self.expect_kw("transaction")
+            return ast.BeginStmt()
+        if kw == "commit":
+            self.next()
+            return ast.CommitStmt()
+        if kw == "rollback":
+            self.next()
+            return ast.RollbackStmt()
+        if kw == "analyze":
+            self.next()
+            self.expect_kw("table")
+            tables = [self.parse_table_name()]
+            while self.accept_op(","):
+                tables.append(self.parse_table_name())
+            return ast.AnalyzeTableStmt(tables=tables)
+        if kw == "import":
+            return self.parse_import()
+        self.error(f"unsupported statement '{kw}'")
+
+    # ---- SELECT -------------------------------------------------------
+    def parse_select(self, allow_setops=True) -> ast.SelectStmt:
+        if self.accept_op("("):
+            sel = self.parse_select()
+            self.expect_op(")")
+        else:
+            self.expect_kw("select")
+            while self.peek().kind == "HINT":
+                self.next()
+            sel = ast.SelectStmt()
+            sel.distinct = bool(self.accept_kw("distinct"))
+            self.accept_kw("all")
+            sel.fields = self.parse_select_fields()
+            if self.accept_kw("from"):
+                sel.from_clause = self.parse_table_refs()
+            if self.accept_kw("where"):
+                sel.where = self.parse_expr()
+            if self.accept_kw("group"):
+                self.expect_kw("by")
+                sel.group_by.append(self.parse_expr())
+                while self.accept_op(","):
+                    sel.group_by.append(self.parse_expr())
+                self.accept_kw("with")  # WITH ROLLUP: parse, unsupported later
+            if self.accept_kw("having"):
+                sel.having = self.parse_expr()
+            sel.order_by = self.parse_order_by()
+            sel.limit = self.parse_limit()
+            if self.accept_kw("for"):
+                self.expect_kw("update")
+                sel.for_update = True
+            elif self.accept_kw("lock"):
+                self.expect_kw("in")
+                self.expect_kw("share")
+                self.expect_kw("mode")
+        if allow_setops:
+            while self.at_kw("union", "except", "intersect"):
+                op = self.next().text.lower()
+                if op == "union" and self.accept_kw("all"):
+                    op = "union all"
+                else:
+                    self.accept_kw("distinct")
+                rhs = self.parse_select(allow_setops=False)
+                sel.setops.append((op, rhs))
+            if sel.setops:
+                # trailing ORDER BY/LIMIT bound to the last branch applies to
+                # the whole union (MySQL semantics)
+                last = sel.setops[-1][1]
+                if last.order_by and not self.at_kw("order"):
+                    sel.order_by, last.order_by = last.order_by, []
+                if last.limit is not None and not self.at_kw("limit"):
+                    sel.limit, last.limit = last.limit, None
+                ob = self.parse_order_by()
+                lm = self.parse_limit()
+                if ob:
+                    sel.order_by = ob
+                if lm:
+                    sel.limit = lm
+        return sel
+
+    def parse_select_fields(self) -> list:
+        fields = []
+        while True:
+            start = self.peek().pos
+            if self.at_op("*"):
+                self.next()
+                fields.append(ast.Wildcard())
+            elif (self.peek().kind in ("IDENT", "QIDENT")
+                  and self.peek(1).kind == "OP" and self.peek(1).text == "."
+                  and self.peek(2).kind == "OP" and self.peek(2).text == "*"):
+                tbl = self.ident()
+                self.next()
+                self.next()
+                fields.append(ast.Wildcard(table=tbl))
+            else:
+                expr = self.parse_expr()
+                alias = ""
+                if self.accept_kw("as"):
+                    t = self.peek()
+                    alias = t.text if t.kind == "STRING" and not self.next() else self.ident() if t.kind != "STRING" else alias
+                elif self.peek().kind in ("IDENT", "QIDENT") and \
+                        not self.at_kw("from", "where", "group", "having",
+                                       "order", "limit", "union", "for",
+                                       "into", "except", "intersect", "on",
+                                       "inner", "left", "right", "join",
+                                       "cross", "lock", "when", "then",
+                                       "else", "end", "and", "or", "as",
+                                       "offset", "using", "set", "with",
+                                       "straight_join", "natural", "window"):
+                    alias = self.ident()
+                end = self.peek().pos
+                fields.append(ast.SelectField(
+                    expr=expr, alias=alias,
+                    text=self.sql[start:end].strip().rstrip(",").strip()))
+            if not self.accept_op(","):
+                break
+        return fields
+
+    def parse_order_by(self) -> list:
+        items = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                items.append(ast.OrderItem(expr=e, desc=desc))
+                if not self.accept_op(","):
+                    break
+        return items
+
+    def parse_limit(self) -> ast.Limit | None:
+        if not self.accept_kw("limit"):
+            return None
+        first = self.parse_expr()
+        if self.accept_op(","):
+            return ast.Limit(count=self.parse_expr(), offset=first)
+        if self.accept_kw("offset"):
+            return ast.Limit(count=first, offset=self.parse_expr())
+        return ast.Limit(count=first)
+
+    # ---- table refs ---------------------------------------------------
+    def parse_table_refs(self):
+        left = self.parse_table_factor()
+        while True:
+            if self.accept_op(","):
+                right = self.parse_table_factor()
+                left = ast.Join(left=left, right=right, join_type="cross")
+                continue
+            natural = self.accept_kw("natural")
+            jt = None
+            if self.accept_kw("inner"):
+                jt = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                jt = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                jt = "right"
+            elif self.accept_kw("cross"):
+                jt = "cross"
+            elif self.accept_kw("straight_join"):
+                jt = "inner"
+                right = self.parse_table_factor()
+                on = self.parse_expr() if self.accept_kw("on") else None
+                left = ast.Join(left=left, right=right, join_type=jt, on=on)
+                continue
+            if jt is None and not self.at_kw("join"):
+                if natural:
+                    self.error("expected JOIN after NATURAL")
+                break
+            self.expect_kw("join")
+            right = self.parse_table_factor()
+            on = None
+            using = []
+            if not natural:
+                if self.accept_kw("on"):
+                    on = self.parse_expr()
+                elif self.accept_kw("using"):
+                    self.expect_op("(")
+                    using.append(self.ident())
+                    while self.accept_op(","):
+                        using.append(self.ident())
+                    self.expect_op(")")
+            left = ast.Join(left=left, right=right, join_type=jt or "inner",
+                            on=on, using=using)
+        return left
+
+    def parse_table_factor(self):
+        if self.accept_op("("):
+            if self.at_kw("select") or self.at_op("("):
+                sel = self.parse_select()
+                self.expect_op(")")
+                alias = ""
+                self.accept_kw("as")
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    alias = self.ident()
+                return ast.SubqueryTable(select=sel, alias=alias)
+            refs = self.parse_table_refs()
+            self.expect_op(")")
+            return refs
+        if self.at_kw("select"):
+            # bare subquery (nonstandard but common in tests)
+            sel = self.parse_select()
+            alias = ""
+            if self.accept_kw("as"):
+                alias = self.ident()
+            return ast.SubqueryTable(select=sel, alias=alias)
+        tn = self.parse_table_name()
+        if self.accept_kw("as"):
+            tn.alias = self.ident()
+        elif self.peek().kind in ("IDENT", "QIDENT") and \
+                not self.at_kw("on", "where", "group", "having", "order",
+                               "limit", "union", "inner", "left", "right",
+                               "cross", "join", "set", "for", "using",
+                               "natural", "straight_join", "except",
+                               "intersect", "lock", "partition"):
+            tn.alias = self.ident()
+        # USE/IGNORE/FORCE INDEX hints
+        while self.at_kw("use", "ignore", "force"):
+            kind = self.next().text.lower()
+            if not self.accept_kw("index") and not self.accept_kw("key"):
+                self.error("expected INDEX")
+            self.expect_op("(")
+            names = []
+            if not self.at_op(")"):
+                names.append(self.ident())
+                while self.accept_op(","):
+                    names.append(self.ident())
+            self.expect_op(")")
+            tn.index_hints.append((kind, names))
+        return tn
+
+    def parse_table_name(self) -> ast.TableName:
+        a = self.ident()
+        if self.accept_op("."):
+            return ast.TableName(db=a, name=self.ident())
+        return ast.TableName(name=a)
+
+    # ---- DML ----------------------------------------------------------
+    def parse_insert(self) -> ast.InsertStmt:
+        is_replace = self.peek().text.lower() == "replace"
+        self.next()
+        ignore = self.accept_kw("ignore")
+        self.accept_kw("into")
+        stmt = ast.InsertStmt(table=self.parse_table_name(),
+                              is_replace=is_replace, ignore=ignore)
+        if self.at_op("(") :
+            # could be column list or (SELECT...)
+            save = self.i
+            self.next()
+            if self.at_kw("select"):
+                self.i = save
+            else:
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                stmt.columns = cols
+        if self.accept_kw("values") or self.accept_kw("value"):
+            while True:
+                self.expect_op("(")
+                row = []
+                if not self.at_op(")"):
+                    row.append(self.parse_expr())
+                    while self.accept_op(","):
+                        row.append(self.parse_expr())
+                self.expect_op(")")
+                stmt.values.append(row)
+                if not self.accept_op(","):
+                    break
+        elif self.at_kw("select") or self.at_op("("):
+            stmt.select = self.parse_select()
+        elif self.accept_kw("set"):
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                stmt.columns.append(col)
+                stmt.values.append(None)  # placeholder; rebuilt below
+                val = self.parse_expr()
+                stmt.values[-1] = val
+                if not self.accept_op(","):
+                    break
+            stmt.values = [list(stmt.values)]
+        else:
+            self.error("expected VALUES or SELECT")
+        if self.accept_kw("on"):
+            self.expect_kw("duplicate")
+            self.expect_kw("key")
+            self.expect_kw("update")
+            while True:
+                col = self.parse_column_ref()
+                self.expect_op("=")
+                stmt.on_duplicate.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+        return stmt
+
+    def parse_update(self) -> ast.UpdateStmt:
+        self.expect_kw("update")
+        stmt = ast.UpdateStmt(table_refs=self.parse_table_refs())
+        self.expect_kw("set")
+        while True:
+            col = self.parse_column_ref()
+            self.expect_op("=")
+            stmt.assignments.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        stmt.order_by = self.parse_order_by()
+        stmt.limit = self.parse_limit()
+        return stmt
+
+    def parse_delete(self) -> ast.DeleteStmt:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        stmt = ast.DeleteStmt(table_refs=self.parse_table_refs())
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        stmt.order_by = self.parse_order_by()
+        stmt.limit = self.parse_limit()
+        return stmt
+
+    # ---- DDL ----------------------------------------------------------
+    def parse_create(self):
+        self.expect_kw("create")
+        if self.accept_kw("database") or self.accept_kw("schema"):
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            name = self.ident()
+            # swallow charset options
+            while self.peek().kind == "IDENT" and not self.at_op(";"):
+                self.next()
+            return ast.CreateDatabaseStmt(name=name, if_not_exists=ine)
+        unique = self.accept_kw("unique")
+        if self.accept_kw("index") or self.accept_kw("key"):
+            name = self.ident()
+            self.expect_kw("on")
+            table = self.parse_table_name()
+            self.expect_op("(")
+            cols = [self.ident()]
+            self._skip_index_col_opts()
+            while self.accept_op(","):
+                cols.append(self.ident())
+                self._skip_index_col_opts()
+            self.expect_op(")")
+            return ast.CreateIndexStmt(index_name=name, table=table,
+                                       columns=cols, unique=unique)
+        if unique:
+            self.error("expected INDEX after UNIQUE")
+        self.accept_kw("temporary")
+        self.expect_kw("table")
+        ine = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            ine = True
+        stmt = ast.CreateTableStmt(table=self.parse_table_name(),
+                                   if_not_exists=ine)
+        if self.accept_kw("like"):
+            stmt.options["like"] = self.parse_table_name()
+            return stmt
+        if self.accept_kw("as") or self.at_kw("select"):
+            stmt.options["as_select"] = self.parse_select()
+            return stmt
+        self.expect_op("(")
+        while True:
+            if self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                cols = self._parse_paren_cols()
+                stmt.indexes.append(ast.IndexDef(
+                    name="PRIMARY", columns=cols, unique=True, primary=True))
+            elif self.at_kw("unique"):
+                self.next()
+                self.accept_kw("key") or self.accept_kw("index")
+                name = self.ident() if not self.at_op("(") else ""
+                cols = self._parse_paren_cols()
+                stmt.indexes.append(ast.IndexDef(
+                    name=name or f"uk_{'_'.join(cols)}", columns=cols, unique=True))
+            elif self.at_kw("key", "index"):
+                self.next()
+                name = self.ident() if not self.at_op("(") else ""
+                cols = self._parse_paren_cols()
+                stmt.indexes.append(ast.IndexDef(
+                    name=name or f"idx_{'_'.join(cols)}", columns=cols))
+            elif self.at_kw("constraint", "foreign", "check"):
+                self._skip_constraint()
+            else:
+                stmt.columns.append(self.parse_column_def())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        # table options: ENGINE=..., CHARSET=..., AUTO_INCREMENT=..., COMMENT=...
+        while self.peek().kind == "IDENT":
+            opt = self.next().text.lower()
+            if opt == "default":
+                continue
+            self.accept_op("=")
+            t = self.next()
+            stmt.options[opt] = t.text
+        return stmt
+
+    def _parse_paren_cols(self):
+        self.expect_op("(")
+        cols = [self.ident()]
+        self._skip_index_col_opts()
+        while self.accept_op(","):
+            cols.append(self.ident())
+            self._skip_index_col_opts()
+        self.expect_op(")")
+        return cols
+
+    def _skip_index_col_opts(self):
+        # key length "(10)" and ASC/DESC
+        if self.accept_op("("):
+            self.next()
+            self.expect_op(")")
+        self.accept_kw("asc") or self.accept_kw("desc")
+
+    def _skip_constraint(self):
+        # consume until balanced comma at depth 0 / closing paren
+        depth = 0
+        while True:
+            t = self.peek()
+            if t.kind == EOF:
+                self.error("unterminated constraint")
+            if t.kind == "OP" and t.text == "(":
+                depth += 1
+            elif t.kind == "OP" and t.text == ")":
+                if depth == 0:
+                    return
+                depth -= 1
+            elif t.kind == "OP" and t.text == "," and depth == 0:
+                return
+            self.next()
+
+    def parse_column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        tname = self.ident().lower()
+        cd = ast.ColumnDef(name=name, type_name=tname)
+        if self.accept_op("("):
+            if tname in ("enum", "set"):
+                cd.enum_vals.append(self.next().text)
+                while self.accept_op(","):
+                    cd.enum_vals.append(self.next().text)
+            else:
+                cd.flen = int(self.next().text)
+                if self.accept_op(","):
+                    cd.decimal = int(self.next().text)
+            self.expect_op(")")
+        while True:
+            if self.accept_kw("unsigned"):
+                cd.unsigned = True
+            elif self.accept_kw("signed") or self.accept_kw("zerofill"):
+                pass
+            elif self.at_kw("not"):
+                self.next()
+                self.expect_kw("null")
+                cd.not_null = True
+            elif self.accept_kw("null"):
+                pass
+            elif self.at_kw("default"):
+                self.next()
+                e = self.parse_expr()
+                cd.has_default = True
+                cd.default_value = e.value if isinstance(e, ast.Literal) else e
+            elif self.accept_kw("auto_increment"):
+                cd.auto_increment = True
+            elif self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                cd.primary_key = True
+            elif self.accept_kw("unique"):
+                self.accept_kw("key")
+                cd.unique = True
+            elif self.accept_kw("key"):
+                pass
+            elif self.at_kw("comment"):
+                self.next()
+                cd.comment = self.next().text
+            elif self.at_kw("collate"):
+                self.next()
+                self.next()
+            elif self.at_kw("character"):
+                self.next()
+                self.expect_kw("set")
+                self.next()
+            elif self.at_kw("charset"):
+                self.next()
+                self.next()
+            elif self.at_kw("on"):
+                # ON UPDATE CURRENT_TIMESTAMP
+                self.next()
+                self.expect_kw("update")
+                self.parse_expr()
+            elif self.at_kw("references"):
+                self._skip_constraint()
+            else:
+                break
+        return cd
+
+    def parse_drop(self):
+        self.expect_kw("drop")
+        if self.accept_kw("database") or self.accept_kw("schema"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            return ast.DropDatabaseStmt(name=self.ident(), if_exists=ie)
+        if self.accept_kw("index") or self.accept_kw("key"):
+            name = self.ident()
+            self.expect_kw("on")
+            return ast.DropIndexStmt(index_name=name,
+                                     table=self.parse_table_name())
+        self.accept_kw("temporary")
+        self.expect_kw("table")
+        ie = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            ie = True
+        tables = [self.parse_table_name()]
+        while self.accept_op(","):
+            tables.append(self.parse_table_name())
+        return ast.DropTableStmt(tables=tables, if_exists=ie)
+
+    def parse_alter(self):
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        stmt = ast.AlterTableStmt(table=self.parse_table_name())
+        while True:
+            if self.accept_kw("add"):
+                if self.accept_kw("index") or self.accept_kw("key"):
+                    name = self.ident() if not self.at_op("(") else ""
+                    cols = self._parse_paren_cols()
+                    stmt.actions.append(("add_index", ast.IndexDef(
+                        name=name or f"idx_{'_'.join(cols)}", columns=cols)))
+                elif self.accept_kw("unique"):
+                    self.accept_kw("key") or self.accept_kw("index")
+                    name = self.ident() if not self.at_op("(") else ""
+                    cols = self._parse_paren_cols()
+                    stmt.actions.append(("add_index", ast.IndexDef(
+                        name=name or f"uk_{'_'.join(cols)}", columns=cols,
+                        unique=True)))
+                elif self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    cols = self._parse_paren_cols()
+                    stmt.actions.append(("add_index", ast.IndexDef(
+                        name="PRIMARY", columns=cols, unique=True, primary=True)))
+                else:
+                    self.accept_kw("column")
+                    stmt.actions.append(("add_column", self.parse_column_def()))
+            elif self.accept_kw("drop"):
+                if self.accept_kw("index") or self.accept_kw("key"):
+                    stmt.actions.append(("drop_index", self.ident()))
+                elif self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    stmt.actions.append(("drop_index", "PRIMARY"))
+                else:
+                    self.accept_kw("column")
+                    stmt.actions.append(("drop_column", self.ident()))
+            elif self.accept_kw("modify"):
+                self.accept_kw("column")
+                stmt.actions.append(("modify_column", self.parse_column_def()))
+            elif self.accept_kw("rename"):
+                self.accept_kw("to") or self.accept_kw("as")
+                stmt.actions.append(("rename", self.parse_table_name()))
+            else:
+                self.error("unsupported ALTER action")
+            if not self.accept_op(","):
+                break
+        return stmt
+
+    def parse_rename(self):
+        self.expect_kw("rename")
+        self.expect_kw("table")
+        pairs = []
+        while True:
+            a = self.parse_table_name()
+            self.expect_kw("to")
+            pairs.append((a, self.parse_table_name()))
+            if not self.accept_op(","):
+                break
+        return ast.RenameTableStmt(pairs=pairs)
+
+    # ---- SET / SHOW / EXPLAIN ----------------------------------------
+    def parse_set(self):
+        self.expect_kw("set")
+        stmt = ast.SetStmt()
+        if self.accept_kw("names"):
+            self.next()
+            if self.accept_kw("collate"):
+                self.next()
+            return stmt
+        while True:
+            is_global = False
+            is_system = True
+            if self.accept_kw("global"):
+                is_global = True
+            elif self.accept_kw("session") or self.accept_kw("local"):
+                pass
+            t = self.peek()
+            if t.kind == "SYSVAR":
+                self.next()
+                name = t.text
+                low = name.lower()
+                if low.startswith("global."):
+                    is_global = True
+                    name = name[7:]
+                elif low.startswith("session."):
+                    name = name[8:]
+            elif t.kind == "USERVAR":
+                self.next()
+                name = t.text
+                is_system = False
+            else:
+                name = self.ident()
+            if not self.accept_op("="):
+                self.expect_op(":=")
+            if self.at_kw("on", "off") and self.peek(1).kind in ("OP", EOF):
+                val = ast.Literal(self.next().text)
+            else:
+                val = self.parse_expr()
+            stmt.assignments.append((name, val, is_global, is_system))
+            if not self.accept_op(","):
+                break
+        return stmt
+
+    def parse_show(self):
+        self.expect_kw("show")
+        stmt = ast.ShowStmt()
+        stmt.full = self.accept_kw("full")
+        if self.accept_kw("global"):
+            stmt.is_global = True
+        else:
+            self.accept_kw("session")
+        if self.accept_kw("databases") or self.accept_kw("schemas"):
+            stmt.kind = "databases"
+        elif self.accept_kw("tables"):
+            stmt.kind = "tables"
+            if self.accept_kw("from") or self.accept_kw("in"):
+                stmt.db = self.ident()
+        elif self.accept_kw("columns") or self.accept_kw("fields"):
+            stmt.kind = "columns"
+            self.accept_kw("from") or self.accept_kw("in")
+            stmt.table = self.parse_table_name()
+            if self.accept_kw("from") or self.accept_kw("in"):
+                stmt.db = self.ident()
+        elif self.accept_kw("create"):
+            self.expect_kw("table")
+            stmt.kind = "create_table"
+            stmt.table = self.parse_table_name()
+        elif self.accept_kw("variables"):
+            stmt.kind = "variables"
+        elif self.accept_kw("index") or self.accept_kw("indexes") or self.accept_kw("keys"):
+            stmt.kind = "index"
+            self.accept_kw("from") or self.accept_kw("in")
+            stmt.table = self.parse_table_name()
+        elif self.accept_kw("warnings"):
+            stmt.kind = "warnings"
+        elif self.accept_kw("processlist"):
+            stmt.kind = "processlist"
+        else:
+            self.error("unsupported SHOW")
+        if self.accept_kw("like"):
+            stmt.like = self.next().text
+        elif self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        return stmt
+
+    def parse_explain(self):
+        kw = self.next().text.lower()
+        if kw in ("desc", "describe") and self.peek().kind in ("IDENT", "QIDENT") \
+                and not self.at_kw("select", "insert", "update", "delete",
+                                   "analyze", "format"):
+            return ast.DescTableStmt(table=self.parse_table_name())
+        analyze = self.accept_kw("analyze")
+        fmt = "row"
+        if self.accept_kw("format"):
+            self.expect_op("=")
+            fmt = self.next().text.lower()
+        return ast.ExplainStmt(stmt=self.parse_stmt(), analyze=analyze,
+                               format=fmt)
+
+    def parse_import(self):
+        self.expect_kw("import")
+        self.expect_kw("into")
+        stmt = ast.ImportStmt(table=self.parse_table_name())
+        self.expect_kw("from")
+        stmt.path = self.next().text
+        if self.accept_kw("with"):
+            while True:
+                k = self.ident()
+                if self.accept_op("="):
+                    stmt.options[k] = self.next().text
+                else:
+                    stmt.options[k] = True
+                if not self.accept_op(","):
+                    break
+        return stmt
+
+    # ==================== expressions ==================================
+    def parse_expr(self) -> ast.ExprNode:
+        return self.parse_or()
+
+    def parse_or(self):
+        left = self.parse_xor()
+        while self.at_kw("or") or self.at_op("||"):
+            self.next()
+            left = ast.BinaryOp("or", left, self.parse_xor())
+        return left
+
+    def parse_xor(self):
+        left = self.parse_and()
+        while self.at_kw("xor"):
+            self.next()
+            left = ast.BinaryOp("xor", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.at_kw("and") or self.at_op("&&"):
+            self.next()
+            left = ast.BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self):
+        left = self.parse_bitor()
+        while True:
+            if self.at_kw("is"):
+                self.next()
+                neg = self.accept_kw("not")
+                if self.accept_kw("null"):
+                    left = ast.IsNull(left, negated=neg)
+                elif self.accept_kw("true"):
+                    left = ast.IsTruth(left, truth=True, negated=neg)
+                elif self.accept_kw("false"):
+                    left = ast.IsTruth(left, truth=False, negated=neg)
+                else:
+                    self.error("expected NULL/TRUE/FALSE after IS")
+                continue
+            neg = False
+            save = self.i
+            if self.at_kw("not"):
+                if self.peek(1).kind == "IDENT" and \
+                        self.peek(1).text.lower() in ("between", "in", "like",
+                                                      "regexp", "rlike"):
+                    self.next()
+                    neg = True
+                else:
+                    break
+            if self.accept_kw("between"):
+                low = self.parse_bitor()
+                self.expect_kw("and")
+                high = self.parse_bitor()
+                left = ast.Between(left, low, high, negated=neg)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select") or self.at_op("("):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, sub, negated=neg)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated=neg)
+                continue
+            if self.accept_kw("like"):
+                pat = self.parse_bitor()
+                esc = "\\"
+                if self.accept_kw("escape"):
+                    esc = self.next().text
+                left = ast.Like(left, pat, negated=neg, escape=esc)
+                continue
+            if self.accept_kw("regexp") or self.accept_kw("rlike"):
+                left = ast.RegexpExpr(left, self.parse_bitor(), negated=neg)
+                continue
+            if neg:
+                self.i = save
+                break
+            if self.peek().kind == "OP" and self.peek().text in _CMP_OPS:
+                op = self.next().text
+                if op == "<>":
+                    op = "!="
+                if self.at_kw("any", "some", "all"):
+                    quant = self.next().text.lower()
+                    if quant == "some":
+                        quant = "any"
+                    self.expect_op("(")
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    left = ast.CompareSubquery(left, op, quant, sub)
+                else:
+                    left = ast.BinaryOp(op, left, self.parse_bitor())
+                continue
+            break
+        return left
+
+    def parse_bitor(self):
+        left = self.parse_bitand()
+        while self.at_op("|"):
+            self.next()
+            left = ast.BinaryOp("|", left, self.parse_bitand())
+        return left
+
+    def parse_bitand(self):
+        left = self.parse_shift()
+        while self.at_op("&"):
+            self.next()
+            left = ast.BinaryOp("&", left, self.parse_shift())
+        return left
+
+    def parse_shift(self):
+        left = self.parse_add()
+        while self.at_op("<<", ">>"):
+            op = self.next().text
+            left = ast.BinaryOp(op, left, self.parse_add())
+        return left
+
+    def parse_add(self):
+        left = self.parse_mul()
+        while self.at_op("+", "-"):
+            op = self.next().text
+            if self.at_kw("interval"):
+                self.next()
+                val = self.parse_bitor()
+                unit = self.ident().lower()
+                right = ast.IntervalExpr(val, unit)
+                left = ast.FuncCall("date_add" if op == "+" else "date_sub",
+                                    [left, right])
+            else:
+                left = ast.BinaryOp(op, left, self.parse_mul())
+        return left
+
+    def parse_mul(self):
+        left = self.parse_unary()
+        while True:
+            if self.at_op("*", "/", "%"):
+                op = self.next().text
+            elif self.at_kw("div"):
+                self.next()
+                op = "div"
+            elif self.at_kw("mod"):
+                self.next()
+                op = "%"
+            else:
+                break
+            left = ast.BinaryOp(op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self):
+        if self.at_op("-", "+", "~", "!"):
+            op = self.next().text
+            operand = self.parse_unary()
+            if op == "+":
+                return operand
+            if op == "!":
+                return ast.UnaryOp("not", operand)
+            if op == "-" and isinstance(operand, ast.Literal) and \
+                    isinstance(operand.value, (int, float)):
+                return ast.Literal(-operand.value)
+            return ast.UnaryOp(op, operand)
+        if self.accept_kw("binary"):
+            return self.parse_unary()
+        return self.parse_pow()
+
+    def parse_pow(self):
+        left = self.parse_primary()
+        while self.at_op("^"):
+            self.next()
+            left = ast.BinaryOp("^", left, self.parse_primary())
+        return left
+
+    def parse_column_ref(self) -> ast.ColumnRef:
+        a = self.ident()
+        if self.accept_op("."):
+            b = self.ident()
+            if self.accept_op("."):
+                return ast.ColumnRef(name=self.ident(), table=b, db=a)
+            return ast.ColumnRef(name=b, table=a)
+        return ast.ColumnRef(name=a)
+
+    def parse_primary(self):
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            txt = t.text
+            if "." in txt or "e" in txt.lower():
+                # decimal literal stays exact as string; planner decides type
+                return ast.Literal(float(txt) if ("e" in txt.lower())
+                                   else _DecimalLiteral(txt))
+            return ast.Literal(int(txt))
+        if t.kind == "HEX":
+            self.next()
+            return ast.Literal(int(t.text, 16))
+        if t.kind == "STRING":
+            self.next()
+            return ast.Literal(t.text)
+        if t.kind == "SYSVAR":
+            self.next()
+            name = t.text
+            is_global = name.lower().startswith("global.")
+            if is_global:
+                name = name[7:]
+            elif name.lower().startswith("session."):
+                name = name[8:]
+            return ast.VariableExpr(name=name, is_system=True,
+                                    is_global=is_global)
+        if t.kind == "USERVAR":
+            self.next()
+            return ast.VariableExpr(name=t.text, is_system=False)
+        if t.kind == "OP":
+            if t.text == "(":
+                self.next()
+                if self.at_kw("select"):
+                    sub = self.parse_select()
+                    self.expect_op(")")
+                    return ast.ScalarSubquery(sub)
+                e = self.parse_expr()
+                if self.accept_op(","):
+                    items = [e, self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    return ast.RowExpr(items)
+                self.expect_op(")")
+                return e
+            if t.text == "*":
+                self.next()
+                return ast.Wildcard()
+            if t.text == "?":
+                self.next()
+                return ast.ParamMarker()
+        if t.kind in ("IDENT", "QIDENT"):
+            low = t.text.lower()
+            nxt = self.peek(1)
+            if low == "null" and t.kind == "IDENT":
+                self.next()
+                return ast.Literal(None)
+            if low in ("true", "false") and t.kind == "IDENT":
+                self.next()
+                return ast.Literal(low == "true")
+            if low == "exists" and nxt.kind == "OP" and nxt.text == "(":
+                self.next()
+                self.next()
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.ExistsSubquery(sub)
+            if low == "case" and t.kind == "IDENT":
+                return self.parse_case()
+            if low == "cast" and nxt.kind == "OP" and nxt.text == "(":
+                return self.parse_cast()
+            if low == "interval" and t.kind == "IDENT":
+                self.next()
+                val = self.parse_bitor()
+                unit = self.ident().lower()
+                return ast.IntervalExpr(val, unit)
+            if low in ("date", "time", "timestamp") and nxt.kind == "STRING":
+                self.next()
+                s = self.next().text
+                return ast.FuncCall("cast_str_to_" +
+                                    ("datetime" if low == "timestamp" else low),
+                                    [ast.Literal(s)])
+            if low == "default" and t.kind == "IDENT" and \
+                    not (nxt.kind == "OP" and nxt.text == "("):
+                self.next()
+                return ast.DefaultExpr()
+            if nxt.kind == "OP" and nxt.text == "(":
+                return self.parse_func_call()
+            # column ref (a | a.b | a.b.c)
+            return self.parse_column_ref()
+        self.error("expected expression")
+
+    def parse_case(self):
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        els = None
+        if self.accept_kw("else"):
+            els = self.parse_expr()
+        self.expect_kw("end")
+        return ast.Case(operand=operand, when_clauses=whens, else_clause=els)
+
+    def parse_cast(self):
+        self.next()  # cast
+        self.expect_op("(")
+        e = self.parse_expr()
+        self.expect_kw("as")
+        tname = self.ident().lower()
+        flen = dec = -1
+        if self.accept_op("("):
+            flen = int(self.next().text)
+            if self.accept_op(","):
+                dec = int(self.next().text)
+            self.expect_op(")")
+        self.accept_kw("unsigned")
+        if tname == "character" or tname == "char":
+            tname = "char"
+        self.expect_op(")")
+        return ast.Cast(expr=e, to_type=tname, flen=flen, decimal=dec)
+
+    def parse_func_call(self):
+        name = self.ident().lower()
+        self.expect_op("(")
+        if name in AGG_FUNCS:
+            distinct = self.accept_kw("distinct")
+            if name == "count" and self.accept_op("*"):
+                self.expect_op(")")
+                return ast.AggFunc("count", [ast.Wildcard()], distinct=False)
+            args = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            if name == "group_concat" and self.accept_kw("separator"):
+                args.append(ast.Literal(self.next().text))
+            self.expect_op(")")
+            return ast.AggFunc(name, args, distinct=distinct)
+        if name == "extract":
+            unit = self.ident().lower()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall("extract", [ast.Literal(unit), e])
+        if name in ("substring", "substr") and True:
+            e = self.parse_expr()
+            if self.accept_kw("from"):
+                start = self.parse_expr()
+                length = None
+                if self.accept_kw("for"):
+                    length = self.parse_expr()
+                self.expect_op(")")
+                args = [e, start] + ([length] if length else [])
+                return ast.FuncCall("substring", args)
+            args = [e]
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("substring", args)
+        if name == "trim":
+            # TRIM([BOTH|LEADING|TRAILING] [remstr] FROM str)
+            mode = "both"
+            if self.at_kw("both", "leading", "trailing"):
+                mode = self.next().text.lower()
+            if self.accept_kw("from"):
+                e = self.parse_expr()
+                self.expect_op(")")
+                return ast.FuncCall("trim", [e, ast.Literal(" "),
+                                             ast.Literal(mode)])
+            first = self.parse_expr()
+            if self.accept_kw("from"):
+                e = self.parse_expr()
+                self.expect_op(")")
+                return ast.FuncCall("trim", [e, first, ast.Literal(mode)])
+            self.expect_op(")")
+            return ast.FuncCall("trim", [first, ast.Literal(" "),
+                                         ast.Literal(mode)])
+        if name == "position":
+            sub = self.parse_bitor()
+            self.expect_kw("in")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall("locate", [sub, e])
+        args = []
+        if not self.at_op(")"):
+            args.append(self.parse_expr())
+            while self.accept_op(","):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        return ast.FuncCall(name, args)
+
+
+class _DecimalLiteral(str):
+    """Decimal literal kept as its exact source text (subclass of str so the
+    planner can sniff it and keep exact semantics)."""
+    __slots__ = ()
+
+
+def parse(sql: str) -> list:
+    return Parser(sql).parse_stmts()
+
+
+def parse_one(sql: str):
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError("expected exactly one statement, got %d", len(stmts))
+    return stmts[0]
