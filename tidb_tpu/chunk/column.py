@@ -45,12 +45,18 @@ def np_dtype_for(ft: FieldType):
 
 
 class Column:
-    __slots__ = ("ft", "data", "nulls")
+    """String columns may be dictionary-encoded: `data` holds int32 codes and
+    `dict` the shared StringDict (the columnar store's native form — one
+    representation for host numpy and device paths)."""
 
-    def __init__(self, ft: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
+    __slots__ = ("ft", "data", "nulls", "dict")
+
+    def __init__(self, ft: FieldType, data: np.ndarray, nulls: np.ndarray | None = None,
+                 sdict=None):
         self.ft = ft
         self.data = data
         self.nulls = nulls  # None means no NULLs present
+        self.dict = sdict
 
     # ---- constructors -------------------------------------------------
     @classmethod
@@ -100,25 +106,52 @@ class Column:
 
     def take(self, idx: np.ndarray) -> "Column":
         nulls = self.nulls[idx] if self.nulls is not None else None
-        return Column(self.ft, self.data[idx], nulls)
+        return Column(self.ft, self.data[idx], nulls, self.dict)
 
     def slice(self, begin: int, end: int) -> "Column":
         nulls = self.nulls[begin:end] if self.nulls is not None else None
-        return Column(self.ft, self.data[begin:end], nulls)
+        return Column(self.ft, self.data[begin:end], nulls, self.dict)
+
+    def decoded(self) -> "Column":
+        """Materialize dict codes back to an object array of strings."""
+        if self.dict is None:
+            return self
+        return Column(self.ft, self.dict.decode(self.data), self.nulls)
+
+    def encoded(self, sdict) -> "Column":
+        """Ensure this column uses `sdict` codes."""
+        if self.dict is sdict:
+            return self
+        if self.dict is None:
+            return Column(self.ft, sdict.encode(self.data.astype(object)),
+                          self.nulls, sdict)
+        # translate codes between dictionaries
+        trans = np.array([sdict.encode_one(v) for v in self.dict.values],
+                         dtype=np.int32)
+        codes = trans[self.data] if len(self.data) else self.data
+        return Column(self.ft, codes, self.nulls, sdict)
 
     def concat(self, other: "Column") -> "Column":
-        data = np.concatenate([self.data, other.data])
-        if self.nulls is None and other.nulls is None:
+        a, b = self, other
+        if a.dict is not None or b.dict is not None:
+            if a.dict is None:
+                a = a.encoded(b.dict)
+            else:
+                b = b.encoded(a.dict)
+        data = np.concatenate([a.data, b.data])
+        if a.nulls is None and b.nulls is None:
             nulls = None
         else:
-            nulls = np.concatenate([self.null_mask, other.null_mask])
-        return Column(self.ft, data, nulls)
+            nulls = np.concatenate([a.null_mask, b.null_mask])
+        return Column(a.ft, data, nulls, a.dict)
 
     # ---- scalar access (row path) ------------------------------------
     def get_datum(self, i: int) -> Datum:
         if self.is_null_at(i):
             return NULL
         v = self.data[i]
+        if self.dict is not None:
+            return Datum(Kind.STRING, self.dict.values[int(v)])
         tc = self.ft.tclass
         if tc in (TypeClass.INT, TypeClass.BIT, TypeClass.ENUM, TypeClass.SET):
             return Datum(Kind.INT, int(v))
@@ -143,6 +176,8 @@ class Column:
         if self.is_null_at(i):
             return None
         v = self.data[i]
+        if self.dict is not None:
+            return self.dict.values[int(v)]
         tc = self.ft.tclass
         if tc == TypeClass.DECIMAL:
             return scaled_int_to_str(int(v), max(self.ft.decimal, 0))

@@ -14,6 +14,8 @@ batches build a local dict on transfer.
 from __future__ import annotations
 
 import numpy as np
+
+from ..utils import jaxcfg  # noqa: F401  (must precede jnp import)
 import jax.numpy as jnp
 
 from .column import Column

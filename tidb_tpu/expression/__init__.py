@@ -1,0 +1,8 @@
+from .expr import (Expression, Column, Constant, ScalarFunc, AggDesc,
+                   const_from_py, const_null)
+from .vec import EvalCtx, eval_expr, eval_bool_mask
+from .fold import fold_constants
+
+__all__ = ["Expression", "Column", "Constant", "ScalarFunc", "AggDesc",
+           "const_from_py", "const_null", "EvalCtx", "eval_expr",
+           "eval_bool_mask", "fold_constants"]

@@ -1,0 +1,116 @@
+"""Bound expressions (reference pkg/expression/expression.go).
+
+The reference keeps dual row/vectorized eval per builtin
+(expression.go:129-189); here there is ONE vectorized eval
+(expression/vec.py) parameterized by array backend (numpy on host, jnp under
+jit) — the device path is the same code traced by XLA. Row eval = vectorized
+eval on length-1 arrays (used only for constant folding and point paths).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..types import FieldType
+from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
+                                new_null_type)
+from ..types.datum import Datum, Kind, NULL, datum_from_py
+
+
+class Expression:
+    ft: FieldType
+
+    def fingerprint(self) -> str:
+        raise NotImplementedError
+
+    def collect_columns(self, out: set):
+        pass
+
+
+@dataclass
+class Column(Expression):
+    """A resolved column: `idx` is the unique column id within the plan's
+    schema (reference expression.Column.UniqueID)."""
+
+    idx: int
+    ft: FieldType = None
+    name: str = ""      # display name ("t.a")
+
+    def fingerprint(self):
+        return f"c{self.idx}"
+
+    def collect_columns(self, out: set):
+        out.add(self.idx)
+
+    def __repr__(self):
+        return self.name or f"col#{self.idx}"
+
+
+@dataclass
+class Constant(Expression):
+    value: Datum = None
+    ft: FieldType = None
+
+    def fingerprint(self):
+        return f"k({self.value.kind},{self.value.val},{self.value.scale})"
+
+    def __repr__(self):
+        return repr(self.value.to_py())
+
+
+@dataclass
+class ScalarFunc(Expression):
+    op: str
+    args: list = field(default_factory=list)
+    ft: FieldType = None
+
+    def fingerprint(self):
+        return f"{self.op}({','.join(a.fingerprint() for a in self.args)})"
+
+    def collect_columns(self, out: set):
+        for a in self.args:
+            a.collect_columns(out)
+
+    def __repr__(self):
+        return f"{self.op}({', '.join(map(repr, self.args))})"
+
+
+@dataclass
+class AggDesc:
+    """Aggregate function descriptor (reference
+    pkg/expression/aggregation/descriptor.go). mode partial1/final supports
+    the coprocessor split: partial on device per partition, final merge."""
+
+    name: str                 # count,sum,avg,min,max,first_row
+    args: list = field(default_factory=list)
+    distinct: bool = False
+    ft: FieldType = None
+    mode: str = "complete"    # complete | partial1 | final
+
+    def fingerprint(self):
+        d = "d" if self.distinct else ""
+        return f"{self.name}{d}[{','.join(a.fingerprint() for a in self.args)}]"
+
+    def __repr__(self):
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+
+
+def const_from_py(v, ft: FieldType | None = None) -> Constant:
+    d = datum_from_py(v, ft)
+    if ft is None:
+        if d.kind in (Kind.INT, Kind.UINT):
+            ft = new_bigint_type()
+        elif d.kind == Kind.FLOAT:
+            ft = new_double_type()
+        elif d.kind == Kind.STRING:
+            from ..types.field_type import new_string_type
+            ft = new_string_type()
+        elif d.kind == Kind.NULL:
+            ft = new_null_type()
+        else:
+            ft = new_bigint_type()
+    return Constant(value=d, ft=ft)
+
+
+def const_null() -> Constant:
+    return Constant(value=NULL, ft=new_null_type())

@@ -1,0 +1,60 @@
+"""Constant folding (reference pkg/expression/constant_fold.go).
+
+Folds ScalarFuncs whose args are all constants by running the vectorized
+evaluator on numpy length-1 arrays. Date arithmetic like
+`date '1994-01-01' + interval 1 year` folds at plan time, which keeps
+month/year interval math off the device entirely for the common case.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.field_type import TypeClass
+from ..types.datum import Datum, Kind, NULL
+from .expr import Expression, Constant, ScalarFunc
+from .vec import EvalCtx, eval_expr, _HOST_ONLY
+
+_NONDETERMINISTIC = _HOST_ONLY | {"now", "current_timestamp", "curdate",
+                                  "current_date", "sysdate", "curtime"}
+
+
+def fold_constants(expr: Expression) -> Expression:
+    if not isinstance(expr, ScalarFunc):
+        return expr
+    expr.args = [fold_constants(a) for a in expr.args]
+    if expr.op in _NONDETERMINISTIC:
+        return expr
+    if not all(isinstance(a, Constant) for a in expr.args):
+        return expr
+    try:
+        ctx = EvalCtx(np, 1, {}, host=True)
+        data, nulls, sdict = eval_expr(ctx, expr)
+    except Exception:
+        return expr   # fold failure is not an error; evaluate at runtime
+    if nulls is True or (nulls is not None and nulls is not False
+                         and bool(np.asarray(nulls).reshape(-1)[0])):
+        return Constant(value=NULL, ft=expr.ft)
+    if sdict is not None:
+        code = int(np.asarray(data).reshape(-1)[0])
+        return Constant(value=Datum(Kind.STRING, sdict.values[code]), ft=expr.ft)
+    if isinstance(data, str):
+        return Constant(value=Datum(Kind.STRING, data), ft=expr.ft)
+    v = np.asarray(data).reshape(-1)[0] if not np.isscalar(data) else data
+    tc = expr.ft.tclass
+    if tc == TypeClass.DECIMAL:
+        d = Datum(Kind.DECIMAL, int(v), max(expr.ft.decimal, 0))
+    elif tc == TypeClass.FLOAT:
+        d = Datum(Kind.FLOAT, float(v))
+    elif tc == TypeClass.DATE:
+        d = Datum(Kind.DATE, int(v))
+    elif tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        d = Datum(Kind.DATETIME, int(v))
+    elif tc == TypeClass.DURATION:
+        d = Datum(Kind.DURATION, int(v))
+    elif tc == TypeClass.STRING:
+        d = Datum(Kind.STRING, str(v))
+    else:
+        if isinstance(v, (np.bool_, bool)):
+            v = int(v)
+        d = Datum(Kind.UINT if expr.ft.unsigned else Kind.INT, int(v))
+    return Constant(value=d, ft=expr.ft)

@@ -1,0 +1,1562 @@
+"""Vectorized expression evaluation over a pluggable array backend.
+
+ONE implementation serves both paths (reference has ~600 builtins with
+separate row + vectorized forms, pkg/expression/builtin_*_vec.go):
+
+  * host:   xp = numpy  -> immediate columnar eval (the CPU oracle)
+  * device: xp = jax.numpy inside jit -> traced into one fused XLA kernel
+
+Value representation: (data, nulls, sdict)
+  data  : xp array (or python scalar for constants)
+  nulls : None | bool scalar | xp bool array  (True = NULL)
+  sdict : StringDict when data holds dictionary codes
+
+String strategy (TPU-first): any string function/predicate over a
+dict-encoded column is computed ONCE over the dictionary values on host,
+then applied on device as a gather through the resulting lookup table.
+LIKE/regexp/lower/substr over millions of rows become one table build (size
+= #distinct) + one device gather. Dict versions key the kernel cache.
+
+NULL semantics: three-valued logic; comparisons propagate NULL, AND/OR are
+Kleene, filters treat NULL as false (eval_bool_mask).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..types.field_type import TypeClass, FieldType, new_double_type
+from ..types.datum import Kind
+from ..types.time_types import MICROS_PER_DAY, MICROS_PER_SEC
+from ..errors import UnknownFunctionError
+from .expr import Expression, Column, Constant, ScalarFunc
+from ..chunk.device import StringDict
+
+_POW10 = [10 ** i for i in range(19)]
+
+
+class EvalCtx:
+    def __init__(self, xp, n, cols, host=True, float_dtype=None,
+                 div_prec_incr=4):
+        self.xp = xp
+        self.n = n
+        self.cols = cols          # idx -> (data, nulls, sdict|None)
+        self.host = host
+        self.float_dtype = float_dtype or np.float64
+        self.div_prec_incr = div_prec_incr
+
+    def full(self, v, dtype=None):
+        return self.xp.full(self.n, v, dtype=dtype)
+
+
+# ---------------- null mask helpers ----------------
+
+def or_nulls(xp, *masks):
+    out = None
+    for m in masks:
+        if m is None:
+            continue
+        if m is True:
+            return True
+        if m is False:
+            continue
+        out = m if out is None else (out | m)
+    return out
+
+
+def materialize_nulls(ctx, nulls):
+    if nulls is None or nulls is False:
+        return ctx.xp.zeros(ctx.n, dtype=bool)
+    if nulls is True:
+        return ctx.xp.ones(ctx.n, dtype=bool)
+    return nulls
+
+
+def _not_mask(xp, m):
+    if m is None or m is False:
+        return None
+    if m is True:
+        return True
+    return ~m
+
+
+# ---------------- casting helpers ----------------
+
+def _dataclass_of(ft: FieldType):
+    tc = ft.tclass
+    if tc == TypeClass.FLOAT:
+        return "float"
+    if tc == TypeClass.DECIMAL:
+        return "decimal"
+    if tc in (TypeClass.STRING, TypeClass.JSON, TypeClass.ENUM, TypeClass.SET):
+        return "string"
+    return "int"   # ints, dates, times map to int64
+
+
+def _scale_of(ft: FieldType):
+    return max(ft.decimal, 0) if ft.tclass == TypeClass.DECIMAL else 0
+
+
+def _rescale_up(xp, v, k):
+    return v * _POW10[k] if k > 0 else v
+
+
+def _rescale_down_round(xp, v, k):
+    """Divide scaled int by 10^k, rounding half away from zero."""
+    if k <= 0:
+        return v
+    d = _POW10[k]
+    h = d // 2
+    pos = (v + h) // d
+    neg = -((-v + h) // d)
+    return xp.where(v >= 0, pos, neg)
+
+
+def _to_float(ctx, data, ft):
+    cls = _dataclass_of(ft)
+    xp = ctx.xp
+    if cls == "float":
+        return xp.asarray(data, dtype=ctx.float_dtype) if not np.isscalar(data) else data
+    if cls == "decimal":
+        s = _scale_of(ft)
+        return xp.asarray(data, dtype=ctx.float_dtype) / _POW10[s]
+    return xp.asarray(data, dtype=ctx.float_dtype) if not np.isscalar(data) \
+        else float(data)
+
+
+def coerce_numeric_pair(ctx, a, aft, b, bft):
+    """-> (a', b', cls, scale) with both sides in a common numeric class."""
+    ca, cb = _dataclass_of(aft), _dataclass_of(bft)
+    xp = ctx.xp
+    if "string" in (ca, cb):
+        # strings in numeric context -> float (host parse / dict transform
+        # happens before this point; here data is already numeric)
+        return _to_float(ctx, a, aft), _to_float(ctx, b, bft), "float", 0
+    if "float" in (ca, cb):
+        return _to_float(ctx, a, aft), _to_float(ctx, b, bft), "float", 0
+    if "decimal" in (ca, cb):
+        sa, sb = _scale_of(aft), _scale_of(bft)
+        s = max(sa, sb)
+        return (_rescale_up(xp, a, s - sa), _rescale_up(xp, b, s - sb),
+                "decimal", s)
+    return a, b, "int", 0
+
+
+# ---------------- main eval ----------------
+
+def eval_expr(ctx: EvalCtx, expr: Expression):
+    if isinstance(expr, Column):
+        val = ctx.cols.get(expr.idx)
+        if val is None:
+            raise KeyError(f"column #{expr.idx} not bound in eval context")
+        return val
+    if isinstance(expr, Constant):
+        return _eval_const(ctx, expr)
+    if isinstance(expr, ScalarFunc):
+        fn = _REGISTRY.get(expr.op)
+        if fn is None:
+            raise UnknownFunctionError("FUNCTION %s does not exist", expr.op)
+        return fn(ctx, expr)
+    raise TypeError(f"cannot eval {type(expr)}")
+
+
+def _eval_const(ctx, expr: Constant):
+    d = expr.value
+    if d.is_null:
+        return 0, True, None
+    if d.kind == Kind.STRING:
+        return d.val, None, None     # python str; consumers handle
+    if d.kind == Kind.FLOAT:
+        return d.val, None, None
+    return int(d.val), None, None
+
+
+def eval_bool_mask(ctx: EvalCtx, expr: Expression):
+    """Filter semantics: NULL -> false. Returns xp bool array of length n."""
+    data, nulls, _ = eval_expr(ctx, expr)
+    xp = ctx.xp
+    if np.isscalar(data) or getattr(data, "ndim", 1) == 0:
+        base = bool(data) and nulls is not True
+        m = ctx.full(base, dtype=bool)
+        if nulls is not None and nulls is not True and nulls is not False:
+            m = m & ~nulls
+        return m
+    if data.dtype != bool:
+        data = data != 0
+    if nulls is None or nulls is False:
+        return data
+    if nulls is True:
+        return ctx.xp.zeros(ctx.n, dtype=bool)
+    return data & ~nulls
+
+
+# ---------------- op registry ----------------
+
+_REGISTRY = {}
+
+
+def op(*names):
+    def deco(fn):
+        for n in names:
+            _REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def is_device_safe(expr: Expression) -> bool:
+    """Can this expression run inside a jit kernel? String ops qualify via
+    dict tables; only explicitly host-bound ops are excluded."""
+    if isinstance(expr, (Column, Constant)):
+        return True
+    if isinstance(expr, ScalarFunc):
+        if expr.op in _HOST_ONLY:
+            return False
+        if expr.op not in _REGISTRY:
+            return False
+        return all(is_device_safe(a) for a in expr.args)
+    return False
+
+
+_HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
+              "connection_id", "get_var", "found_rows", "row_count",
+              "last_insert_id"}
+
+
+# ---------------- string helpers ----------------
+
+def _is_string_val(val, expr):
+    data, _, sdict = val
+    return sdict is not None or isinstance(data, str) or \
+        (hasattr(data, "dtype") and data.dtype == object)
+
+
+def _dict_table(ctx, sdict: StringDict, fn, dtype):
+    """Host-compute fn over dictionary values -> lookup table (device const)."""
+    vals = sdict.values
+    tbl = np.empty(max(len(vals), 1), dtype=dtype)
+    for i, s in enumerate(vals):
+        tbl[i] = fn(s)
+    return ctx.xp.asarray(tbl) if not ctx.host else tbl
+
+
+def _dict_transform(ctx, codes, nulls, sdict, fn):
+    """String->string function over a dict column: build output dict on host,
+    gather mapping on device. Equal outputs share one code (grouping-safe)."""
+    out_dict = StringDict()
+    mapping = np.empty(max(len(sdict.values), 1), dtype=np.int32)
+    for i, s in enumerate(sdict.values):
+        mapping[i] = out_dict.encode_one(fn(s))
+    mtab = ctx.xp.asarray(mapping) if not ctx.host else mapping
+    return mtab[codes], nulls, out_dict
+
+
+def _string_elementwise(ctx, data, fn, dtype=object):
+    out = np.empty(len(data), dtype=dtype)
+    for i, s in enumerate(data):
+        out[i] = fn(s if s is not None else "")
+    return out
+
+
+def _apply_str_fn(ctx, val, fn, out_is_string=True):
+    """Apply python str->x over a string value (dict column, object array,
+    or scalar)."""
+    data, nulls, sdict = val
+    if isinstance(data, str):
+        r = fn(data)
+        return (r, nulls, None)
+    if sdict is not None:
+        if out_is_string:
+            return _dict_transform(ctx, data, nulls, sdict, fn)
+        tbl = _dict_table(ctx, sdict, fn, np.int64)
+        return tbl[data], nulls, None
+    # host object array
+    if out_is_string:
+        return _string_elementwise(ctx, data, fn), nulls, None
+    return _string_elementwise(ctx, data, fn, dtype=np.int64), nulls, None
+
+
+def _as_str_scalar(val):
+    data, nulls, sdict = val
+    if isinstance(data, str):
+        return data
+    return None
+
+
+# ---------------- arithmetic ----------------
+
+def _binary_vals(ctx, expr):
+    a = eval_expr(ctx, expr.args[0])
+    b = eval_expr(ctx, expr.args[1])
+    return a, b
+
+
+@op("+", "-")
+def op_addsub(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
+    r = a2 + b2 if expr.op == "+" else a2 - b2
+    # result ft may demand different scale
+    ts = _scale_of(expr.ft)
+    if cls == "decimal" and ts != s:
+        r = _rescale_up(ctx.xp, r, ts - s) if ts > s else \
+            _rescale_down_round(ctx.xp, r, s - ts)
+    return r, or_nulls(ctx.xp, an, bn), None
+
+
+@op("*")
+def op_mul(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    ca, cb = _dataclass_of(aft), _dataclass_of(bft)
+    xp = ctx.xp
+    if "float" in (ca, cb) or "string" in (ca, cb):
+        r = _to_float(ctx, a, aft) * _to_float(ctx, b, bft)
+        return r, or_nulls(xp, an, bn), None
+    if "decimal" in (ca, cb):
+        s = _scale_of(aft) + _scale_of(bft)
+        r = a * b
+        ts = _scale_of(expr.ft)
+        if ts != s:
+            r = _rescale_up(xp, r, ts - s) if ts > s else \
+                _rescale_down_round(xp, r, s - ts)
+        return r, or_nulls(xp, an, bn), None
+    return a * b, or_nulls(xp, an, bn), None
+
+
+@op("/")
+def op_div(ctx, expr):
+    """Division -> float result unless expr.ft says decimal (then exact
+    scaled arithmetic with div_precision_increment)."""
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    xp = ctx.xp
+    if expr.ft.tclass == TypeClass.DECIMAL:
+        sa, sb = _scale_of(aft), _scale_of(bft)
+        ts = _scale_of(expr.ft)
+        # a/b at target scale ts: (a * 10^(ts - sa + sb)) / b, rounded
+        k = ts - sa + sb
+        num = _rescale_up(xp, xp.asarray(a, dtype=np.int64), max(k, 0))
+        if k < 0:
+            num = _rescale_down_round(xp, num, -k)
+        bz = b == 0
+        den = xp.where(bz, 1, b)
+        q = num // den
+        r2 = num - q * den
+        # round half away from zero
+        adj = xp.where(2 * xp.abs(r2) >= xp.abs(den),
+                       xp.sign(num) * xp.sign(den), 0)
+        res = q + adj
+        # integer floor-div is toward -inf; fix toward-zero first
+        neg = (xp.sign(num) * xp.sign(den)) < 0
+        qtz = xp.where(neg & (num % den != 0), q + 1, q)
+        rem = num - qtz * den
+        res = qtz + xp.where(2 * xp.abs(rem) >= xp.abs(den),
+                             xp.sign(num) * xp.sign(den), 0)
+        return res, or_nulls(xp, an, bn, bz if bz is not False else None), None
+    fa, fb = _to_float(ctx, a, aft), _to_float(ctx, b, bft)
+    bz = fb == 0
+    r = fa / ctx.xp.where(bz, 1.0, fb)
+    return r, or_nulls(xp, an, bn, bz), None
+
+
+@op("div")
+def op_intdiv(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    xp = ctx.xp
+    a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
+    if cls == "float":
+        bz = b2 == 0
+        r = xp.asarray(a2 / xp.where(bz, 1.0, b2), dtype=np.int64)
+        return r, or_nulls(xp, an, bn, bz), None
+    bz = b2 == 0
+    den = xp.where(bz, 1, b2)
+    q = a2 // den
+    # MySQL DIV truncates toward zero
+    q = xp.where((xp.sign(a2) * xp.sign(den) < 0) & (a2 % den != 0), q + 1, q)
+    return q, or_nulls(xp, an, bn, bz), None
+
+
+@op("%", "mod")
+def op_mod(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    xp = ctx.xp
+    a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
+    bz = b2 == 0
+    den = xp.where(bz, 1, b2)
+    if cls == "float":
+        r = a2 - den * xp.trunc(a2 / den)
+    else:
+        r = a2 - den * xp.where(
+            (xp.sign(a2) * xp.sign(den) < 0) & (a2 % den != 0),
+            a2 // den + 1, a2 // den)
+    return r, or_nulls(xp, an, bn, bz), None
+
+
+@op("unary-")
+def op_neg(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return -a, an, None
+
+
+# ---------------- comparisons ----------------
+
+def _cmp_core(xp, op_name, a, b):
+    if op_name == "=":
+        return a == b
+    if op_name == "!=":
+        return a != b
+    if op_name == "<":
+        return a < b
+    if op_name == "<=":
+        return a <= b
+    if op_name == ">":
+        return a > b
+    if op_name == ">=":
+        return a >= b
+    raise ValueError(op_name)
+
+
+def _cmp_strings(ctx, expr, op_name, aval, bval):
+    xp = ctx.xp
+    (a, an, ad), (b, bn, bd) = aval, bval
+    # scalar const side(s)
+    if isinstance(a, str) and isinstance(b, str):
+        return _cmp_core(xp, op_name, a, b), or_nulls(xp, an, bn), None
+    if isinstance(b, str):
+        if ad is not None:
+            if op_name in ("=", "!="):
+                code = ad.lookup(b)
+                r = _cmp_core(xp, op_name, a, code)
+                return r, or_nulls(xp, an, bn), None
+            tbl = _dict_table(ctx, ad, lambda s: _cmp_core(np, op_name, s, b),
+                              np.bool_)
+            return tbl[a], or_nulls(xp, an, bn), None
+        r = _string_elementwise(ctx, a, lambda s: _cmp_core(np, op_name, s, b),
+                                dtype=np.bool_)
+        return r, or_nulls(xp, an, bn), None
+    if isinstance(a, str):
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        return _cmp_strings(ctx, expr, flip.get(op_name, op_name), bval, aval)
+    # column vs column
+    if ad is not None and bd is not None:
+        if ad is bd:
+            if op_name in ("=", "!="):
+                return _cmp_core(xp, op_name, a, b), or_nulls(xp, an, bn), None
+            ranks = ad.ranks()
+            rt = ctx.xp.asarray(ranks) if not ctx.host else ranks
+            return _cmp_core(xp, op_name, rt[a], rt[b]), or_nulls(xp, an, bn), None
+        # different dicts: merge both into a shared dict on host, then
+        # compare merged codes/ranks via device gathers
+        merged = StringDict()
+        ta = np.array([merged.encode_one(v) for v in ad.values] or [0],
+                      dtype=np.int64)
+        tb = np.array([merged.encode_one(v) for v in bd.values] or [0],
+                      dtype=np.int64)
+        if op_name not in ("=", "!="):
+            ranks = merged.ranks()
+            ta = ranks[ta]
+            tb = ranks[tb]
+        tat = xp.asarray(ta) if not ctx.host else ta
+        tbt = xp.asarray(tb) if not ctx.host else tb
+        return _cmp_core(xp, op_name, tat[a], tbt[b]), or_nulls(xp, an, bn), None
+    # host object arrays
+    out = np.empty(ctx.n, dtype=np.bool_)
+    for i in range(ctx.n):
+        out[i] = _cmp_core(np, op_name, a[i], b[i])
+    return out, or_nulls(xp, an, bn), None
+
+
+@op("=", "!=", "<", "<=", ">", ">=")
+def op_cmp(ctx, expr):
+    aval, bval = _binary_vals(ctx, expr)
+    if _is_string_val(aval, expr.args[0]) or _is_string_val(bval, expr.args[1]):
+        aft, bft = expr.args[0].ft, expr.args[1].ft
+        a_is = aft.tclass in (TypeClass.STRING, TypeClass.JSON)
+        b_is = bft.tclass in (TypeClass.STRING, TypeClass.JSON)
+        if a_is and b_is:
+            return _cmp_strings(ctx, expr, expr.op, aval, bval)
+        # mixed string/numeric: numeric context (host parse already applied)
+    (a, an, _), (b, bn, _) = aval, bval
+    a2, b2, _, _ = coerce_numeric_pair(ctx, a, expr.args[0].ft, b,
+                                       expr.args[1].ft)
+    return _cmp_core(ctx.xp, expr.op, a2, b2), or_nulls(ctx.xp, an, bn), None
+
+
+@op("<=>")
+def op_nullsafe_eq(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    xp = ctx.xp
+    anm = materialize_nulls(ctx, an)
+    bnm = materialize_nulls(ctx, bn)
+    a2, b2, _, _ = coerce_numeric_pair(ctx, a, expr.args[0].ft, b,
+                                       expr.args[1].ft)
+    eq = (a2 == b2) & ~anm & ~bnm
+    both_null = anm & bnm
+    return eq | both_null, None, None
+
+
+# ---------------- logic ----------------
+
+def _truthy(ctx, val, ft):
+    data, nulls, sdict = val
+    xp = ctx.xp
+    if isinstance(data, str):
+        try:
+            data = float(data)
+        except ValueError:
+            data = 0.0
+    if sdict is not None:
+        tbl = _dict_table(ctx, sdict, _str_truthy, np.bool_)
+        return tbl[data], nulls
+    if hasattr(data, "dtype") and data.dtype == object:
+        return _string_elementwise(ctx, data, _str_truthy, np.bool_), nulls
+    if np.isscalar(data):
+        return bool(data), nulls
+    if data.dtype == bool:
+        return data, nulls
+    return data != 0, nulls
+
+
+def _str_truthy(s):
+    try:
+        return float(s) != 0
+    except (ValueError, TypeError):
+        return False
+
+
+@op("and")
+def op_and(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    bv = eval_expr(ctx, expr.args[1])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    b, bn = _truthy(ctx, bv, expr.args[1].ft)
+    xp = ctx.xp
+    anm = materialize_nulls(ctx, an)
+    bnm = materialize_nulls(ctx, bn)
+    at = xp.asarray(a) if np.isscalar(a) else a
+    bt = xp.asarray(b) if np.isscalar(b) else b
+    val = at & bt & ~anm & ~bnm
+    # NULL unless one side is definite FALSE
+    a_false = ~anm & ~at
+    b_false = ~bnm & ~bt
+    nulls = (anm | bnm) & ~a_false & ~b_false
+    return val, nulls, None
+
+
+@op("or")
+def op_or(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    bv = eval_expr(ctx, expr.args[1])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    b, bn = _truthy(ctx, bv, expr.args[1].ft)
+    xp = ctx.xp
+    anm = materialize_nulls(ctx, an)
+    bnm = materialize_nulls(ctx, bn)
+    at = xp.asarray(a) if np.isscalar(a) else a
+    bt = xp.asarray(b) if np.isscalar(b) else b
+    a_true = ~anm & at
+    b_true = ~bnm & bt
+    val = a_true | b_true
+    nulls = (anm | bnm) & ~val
+    return val, nulls, None
+
+
+@op("xor")
+def op_xor(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    bv = eval_expr(ctx, expr.args[1])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    b, bn = _truthy(ctx, bv, expr.args[1].ft)
+    xp = ctx.xp
+    at = xp.asarray(a) if np.isscalar(a) else a
+    bt = xp.asarray(b) if np.isscalar(b) else b
+    return at ^ bt, or_nulls(xp, an, bn), None
+
+
+@op("not")
+def op_not(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    if np.isscalar(a):
+        return (not a), an, None
+    return ~a, an, None
+
+
+@op("isnull")
+def op_isnull(ctx, expr):
+    _, nulls, _ = eval_expr(ctx, expr.args[0])
+    return materialize_nulls(ctx, nulls), None, None
+
+
+@op("isnotnull")
+def op_isnotnull(ctx, expr):
+    _, nulls, _ = eval_expr(ctx, expr.args[0])
+    return ~materialize_nulls(ctx, nulls), None, None
+
+
+@op("istrue")
+def op_istrue(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    anm = materialize_nulls(ctx, an)
+    at = ctx.xp.asarray(a) if np.isscalar(a) else a
+    return at & ~anm, None, None
+
+
+@op("isfalse")
+def op_isfalse(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    a, an = _truthy(ctx, av, expr.args[0].ft)
+    anm = materialize_nulls(ctx, an)
+    at = ctx.xp.asarray(a) if np.isscalar(a) else a
+    return ~at & ~anm, None, None
+
+
+# ---------------- conditionals ----------------
+
+def _coerce_to_ft(ctx, val, from_ft, to_ft):
+    """Convert a value to the target ft's dataclass for WHERE/CASE merging."""
+    data, nulls, sdict = val
+    tc, fc = _dataclass_of(to_ft), _dataclass_of(from_ft)
+    xp = ctx.xp
+    if tc == "string":
+        return val
+    if tc == "float":
+        return _to_float(ctx, data, from_ft), nulls, None
+    if tc == "decimal":
+        if fc == "decimal":
+            k = _scale_of(to_ft) - _scale_of(from_ft)
+            if k >= 0:
+                return _rescale_up(xp, data, k), nulls, None
+            return _rescale_down_round(xp, data, -k), nulls, None
+        if fc == "int":
+            return data * _POW10[_scale_of(to_ft)], nulls, None
+        # float -> decimal
+        d = data * _POW10[_scale_of(to_ft)]
+        return xp.asarray(xp.round(d), dtype=np.int64), nulls, None
+    return data, nulls, None
+
+
+@op("if")
+def op_if(ctx, expr):
+    cond = eval_bool_mask(ctx, expr.args[0])
+    a = _coerce_to_ft(ctx, eval_expr(ctx, expr.args[1]), expr.args[1].ft, expr.ft)
+    b = _coerce_to_ft(ctx, eval_expr(ctx, expr.args[2]), expr.args[2].ft, expr.ft)
+    return _merge_where(ctx, cond, a, b, expr)
+
+
+def _merge_where(ctx, cond, a, b, expr):
+    xp = ctx.xp
+    (ad, an, asd), (bd, bn, bsd) = a, b
+    if asd is not None or bsd is not None or isinstance(ad, str) or \
+            isinstance(bd, str):
+        return _merge_where_strings(ctx, cond, a, b)
+    anm = materialize_nulls(ctx, an)
+    bnm = materialize_nulls(ctx, bn)
+    if np.isscalar(ad):
+        ad = ctx.full(ad)
+    if np.isscalar(bd):
+        bd = ctx.full(bd)
+    data = xp.where(cond, ad, bd)
+    nulls = xp.where(cond, anm, bnm)
+    return data, nulls, None
+
+
+def _merge_where_strings(ctx, cond, a, b):
+    (ad, an, asd), (bd, bn, bsd) = a, b
+    out = StringDict()
+    xp = ctx.xp
+
+    def to_codes(data, sdict):
+        if isinstance(data, str):
+            return out.encode_one(data)
+        if sdict is not None:
+            mapping = np.array([out.encode_one(v) for v in sdict.values]
+                               or [0], dtype=np.int32)
+            mt = xp.asarray(mapping) if not ctx.host else mapping
+            return mt[data]
+        return out.encode(data.astype(object))
+
+    ac = to_codes(ad, asd)
+    bc = to_codes(bd, bsd)
+    anm = materialize_nulls(ctx, an)
+    bnm = materialize_nulls(ctx, bn)
+    if np.isscalar(ac):
+        ac = ctx.full(ac, dtype=np.int32)
+    if np.isscalar(bc):
+        bc = ctx.full(bc, dtype=np.int32)
+    return xp.where(cond, ac, bc), xp.where(cond, anm, bnm), out
+
+
+@op("ifnull")
+def op_ifnull(ctx, expr):
+    a = eval_expr(ctx, expr.args[0])
+    cond = ~materialize_nulls(ctx, a[1])
+    av = _coerce_to_ft(ctx, a, expr.args[0].ft, expr.ft)
+    b = _coerce_to_ft(ctx, eval_expr(ctx, expr.args[1]), expr.args[1].ft, expr.ft)
+    return _merge_where(ctx, cond, av, b, expr)
+
+
+@op("nullif")
+def op_nullif(ctx, expr):
+    a = eval_expr(ctx, expr.args[0])
+    eq_expr = ScalarFunc("=", [expr.args[0], expr.args[1]], expr.ft)
+    eq = eval_bool_mask(ctx, eq_expr)
+    nulls = materialize_nulls(ctx, a[1]) | eq
+    return a[0], nulls, a[2]
+
+
+@op("coalesce")
+def op_coalesce(ctx, expr):
+    result = _coerce_to_ft(ctx, eval_expr(ctx, expr.args[0]),
+                           expr.args[0].ft, expr.ft)
+    for arg in expr.args[1:]:
+        nxt = _coerce_to_ft(ctx, eval_expr(ctx, arg), arg.ft, expr.ft)
+        cond = ~materialize_nulls(ctx, result[1])
+        result = _merge_where(ctx, cond, result, nxt, expr)
+    return result
+
+
+@op("case_when")
+def op_case_when(ctx, expr):
+    """args = [cond1, res1, cond2, res2, ..., else_res]."""
+    args = expr.args
+    has_else = len(args) % 2 == 1
+    else_val = (_coerce_to_ft(ctx, eval_expr(ctx, args[-1]), args[-1].ft,
+                              expr.ft) if has_else
+                else (ctx.full(0), ctx.xp.ones(ctx.n, dtype=bool), None))
+    pairs = args[:-1] if has_else else args
+    result = else_val
+    # evaluate in reverse so first matching WHEN wins
+    for i in range(len(pairs) - 2, -1, -2):
+        cond = eval_bool_mask(ctx, pairs[i])
+        val = _coerce_to_ft(ctx, eval_expr(ctx, pairs[i + 1]),
+                            pairs[i + 1].ft, expr.ft)
+        result = _merge_where(ctx, cond, val, result, expr)
+    return result
+
+
+@op("in")
+def op_in(ctx, expr):
+    """args[0] IN (args[1:]) — constants only on the list side here;
+    non-const IN is rewritten to ORs by the planner."""
+    av = eval_expr(ctx, expr.args[0])
+    a, an, asd = av
+    xp = ctx.xp
+    aft = expr.args[0].ft
+    if asd is not None or (hasattr(a, "dtype") and a.dtype == object):
+        # string IN list
+        consts = [c.value.val for c in expr.args[1:] if not c.value.is_null]
+        if asd is not None:
+            codes = np.array([asd.lookup(s) for s in consts] or [-2],
+                             dtype=np.int64)
+            ct = xp.asarray(codes) if not ctx.host else codes
+            r = xp.zeros(ctx.n, dtype=bool)
+            for c in (codes.tolist()):
+                r = r | (a == c)
+            return r, an, None
+        sset = set(consts)
+        r = _string_elementwise(ctx, a, lambda s: s in sset, np.bool_)
+        return r, an, None
+    consts = []
+    any_null = False
+    for c in expr.args[1:]:
+        if c.value.is_null:
+            any_null = True
+            continue
+        cv, _, _ = _eval_const(ctx, c)
+        c2, _, _, _ = coerce_numeric_pair(ctx, cv, c.ft, 0, aft)
+        a2, c2v, _, _ = coerce_numeric_pair(ctx, a, aft, cv, c.ft)
+        consts.append(c2v)
+    r = xp.zeros(ctx.n, dtype=bool)
+    a2 = a
+    for cv in consts:
+        a2c, cvc, _, _ = coerce_numeric_pair(ctx, a, aft, cv, expr.args[1].ft)
+        r = r | (a2c == cvc)
+    nulls = or_nulls(xp, an)
+    if any_null:
+        # x IN (.., NULL): false -> NULL
+        nm = materialize_nulls(ctx, nulls)
+        nulls = nm | ~r
+    return r, nulls, None
+
+
+# ---------------- LIKE / regexp ----------------
+
+def like_to_regex(pattern: str, escape: str = "\\") -> str:
+    out = []
+    i = 0
+    n = len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == escape and i + 1 < n:
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+@op("like")
+def op_like(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    pat = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if pat is None:
+        raise UnknownFunctionError("non-constant LIKE pattern unsupported")
+    esc = "\\"
+    if len(expr.args) > 2:
+        esc = _as_str_scalar(eval_expr(ctx, expr.args[2])) or "\\"
+    rx = re.compile(like_to_regex(pat, esc), re.DOTALL | re.IGNORECASE)
+    return _apply_str_fn(ctx, av, lambda s: rx.match(s) is not None,
+                         out_is_string=False)
+
+
+@op("regexp")
+def op_regexp(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    pat = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if pat is None:
+        raise UnknownFunctionError("non-constant REGEXP pattern unsupported")
+    rx = re.compile(pat)
+    return _apply_str_fn(ctx, av, lambda s: rx.search(s) is not None,
+                         out_is_string=False)
+
+
+# ---------------- string functions ----------------
+
+@op("lower", "lcase")
+def op_lower(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), str.lower)
+
+
+@op("upper", "ucase")
+def op_upper(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), str.upper)
+
+
+@op("length", "octet_length")
+def op_length(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: len(s.encode("utf-8")), out_is_string=False)
+
+
+@op("char_length", "character_length")
+def op_char_length(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), len,
+                         out_is_string=False)
+
+
+@op("concat")
+def op_concat(ctx, expr):
+    vals = [eval_expr(ctx, a) for a in expr.args]
+    # all-scalar fast path
+    if all(isinstance(v[0], str) for v in vals):
+        return "".join(v[0] for v in vals), or_nulls(ctx.xp, *[v[1] for v in vals]), None
+    # single column + scalars: dict transform
+    col_is = [i for i, v in enumerate(vals)
+              if not isinstance(v[0], str)]
+    nulls = or_nulls(ctx.xp, *[v[1] for v in vals])
+    if len(col_is) == 1:
+        ci = col_is[0]
+        pre = "".join(str(vals[i][0]) for i in range(ci))
+        post = "".join(str(vals[i][0]) for i in range(ci + 1, len(vals)))
+        r = _apply_str_fn(ctx, vals[ci], lambda s: pre + s + post)
+        return r[0], nulls, r[2]
+    # multi-column: host elementwise (device path decodes via copr fallback)
+    arrs = []
+    for v, a in zip(vals, expr.args):
+        d, _, sd = v
+        if isinstance(d, str):
+            arrs.append(None)
+        elif sd is not None:
+            arrs.append(sd.decode(np.asarray(d)))
+        else:
+            arrs.append(d)
+    out = np.empty(ctx.n, dtype=object)
+    for i in range(ctx.n):
+        parts = []
+        for v, arr in zip(vals, arrs):
+            parts.append(v[0] if arr is None else str(arr[i]))
+        out[i] = "".join(parts)
+    return out, nulls, None
+
+
+@op("substring", "substr", "mid")
+def op_substring(ctx, expr):
+    av = eval_expr(ctx, expr.args[0])
+    start = _const_int(ctx, expr.args[1])
+    length = _const_int(ctx, expr.args[2]) if len(expr.args) > 2 else None
+
+    def sub(s):
+        st = start
+        if st > 0:
+            st -= 1
+        elif st < 0:
+            st = len(s) + st
+            if st < 0:
+                return ""
+        if length is None:
+            return s[st:]
+        return s[st:st + max(length, 0)]
+    return _apply_str_fn(ctx, av, sub)
+
+
+@op("left")
+def op_left(ctx, expr):
+    n = _const_int(ctx, expr.args[1])
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), lambda s: s[:max(n, 0)])
+
+
+@op("right")
+def op_right(ctx, expr):
+    n = _const_int(ctx, expr.args[1])
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: s[-n:] if n > 0 else "")
+
+
+@op("trim")
+def op_trim(ctx, expr):
+    rem = _as_str_scalar(eval_expr(ctx, expr.args[1])) if len(expr.args) > 1 else " "
+    mode = _as_str_scalar(eval_expr(ctx, expr.args[2])) if len(expr.args) > 2 else "both"
+
+    def t(s):
+        if mode == "leading":
+            while s.startswith(rem):
+                s = s[len(rem):]
+            return s
+        if mode == "trailing":
+            while s.endswith(rem):
+                s = s[:-len(rem)]
+            return s
+        while s.startswith(rem):
+            s = s[len(rem):]
+        while s.endswith(rem):
+            s = s[:-len(rem)]
+        return s
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), t)
+
+
+@op("ltrim")
+def op_ltrim(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), str.lstrip)
+
+
+@op("rtrim")
+def op_rtrim(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), str.rstrip)
+
+
+@op("replace")
+def op_replace(ctx, expr):
+    old = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    new = _as_str_scalar(eval_expr(ctx, expr.args[2]))
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: s.replace(old, new))
+
+
+@op("locate", "instr")
+def op_locate(ctx, expr):
+    if expr.op == "instr":
+        sv = eval_expr(ctx, expr.args[0])
+        sub = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    else:
+        sub = _as_str_scalar(eval_expr(ctx, expr.args[0]))
+        sv = eval_expr(ctx, expr.args[1])
+    return _apply_str_fn(ctx, sv, lambda s: s.find(sub) + 1,
+                         out_is_string=False)
+
+
+@op("reverse")
+def op_reverse(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), lambda s: s[::-1])
+
+
+@op("lpad")
+def op_lpad(ctx, expr):
+    n = _const_int(ctx, expr.args[1])
+    pad = _as_str_scalar(eval_expr(ctx, expr.args[2]))
+
+    def f(s):
+        if len(s) >= n:
+            return s[:n]
+        need = n - len(s)
+        p = (pad * need)[:need]
+        return p + s
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("rpad")
+def op_rpad(ctx, expr):
+    n = _const_int(ctx, expr.args[1])
+    pad = _as_str_scalar(eval_expr(ctx, expr.args[2]))
+
+    def f(s):
+        if len(s) >= n:
+            return s[:n]
+        need = n - len(s)
+        return s + (pad * need)[:need]
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+def _const_int(ctx, expr):
+    v, _, _ = eval_expr(ctx, expr)
+    if not np.isscalar(v):
+        raise UnknownFunctionError("expected constant argument")
+    return int(v)
+
+
+# ---------------- math ----------------
+
+@op("abs")
+def op_abs(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return ctx.xp.abs(a), an, None
+
+
+@op("ceil", "ceiling")
+def op_ceil(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    xp = ctx.xp
+    if _dataclass_of(ft) == "decimal":
+        s = _scale_of(ft)
+        return -((-a) // _POW10[s]), an, None
+    if _dataclass_of(ft) == "float":
+        return xp.asarray(xp.ceil(a), dtype=np.int64), an, None
+    return a, an, None
+
+
+@op("floor")
+def op_floor(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    xp = ctx.xp
+    if _dataclass_of(ft) == "decimal":
+        return a // _POW10[_scale_of(ft)], an, None
+    if _dataclass_of(ft) == "float":
+        return xp.asarray(xp.floor(a), dtype=np.int64), an, None
+    return a, an, None
+
+
+@op("round")
+def op_round(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    d = _const_int(ctx, expr.args[1]) if len(expr.args) > 1 else 0
+    xp = ctx.xp
+    if _dataclass_of(ft) == "decimal":
+        s = _scale_of(ft)
+        ts = _scale_of(expr.ft)
+        if d >= s:
+            r = a
+        else:
+            r = _rescale_down_round(xp, a, s - d)
+            r = _rescale_up(xp, r, s - d)   # back to original scale grid
+        # adjust to result scale
+        if ts != s:
+            r = _rescale_up(xp, r, ts - s) if ts > s else \
+                _rescale_down_round(xp, r, s - ts)
+        return r, an, None
+    if _dataclass_of(ft) == "float":
+        m = 10.0 ** d
+        return xp.floor(xp.abs(a) * m + 0.5) / m * xp.sign(a), an, None
+    if d >= 0:
+        return a, an, None
+    m = _POW10[-d]
+    return _rescale_down_round(xp, a, -d) * m, an, None
+
+
+@op("truncate")
+def op_truncate(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    d = _const_int(ctx, expr.args[1])
+    xp = ctx.xp
+    if _dataclass_of(ft) == "decimal":
+        s = _scale_of(ft)
+        if d >= s:
+            return a, an, None
+        k = _POW10[s - d]
+        return (xp.sign(a)) * ((xp.abs(a) // k) * k), an, None
+    if _dataclass_of(ft) == "float":
+        m = 10.0 ** d
+        return xp.trunc(a * m) / m, an, None
+    if d >= 0:
+        return a, an, None
+    k = _POW10[-d]
+    return xp.sign(a) * ((xp.abs(a) // k) * k), an, None
+
+
+@op("sign")
+def op_sign(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return ctx.xp.asarray(ctx.xp.sign(a), dtype=np.int64), an, None
+
+
+@op("sqrt")
+def op_sqrt(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    f = _to_float(ctx, a, expr.args[0].ft)
+    neg = f < 0
+    r = ctx.xp.sqrt(ctx.xp.where(neg, 0.0, f))
+    return r, or_nulls(ctx.xp, an, neg), None
+
+
+@op("exp")
+def op_exp(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return ctx.xp.exp(_to_float(ctx, a, expr.args[0].ft)), an, None
+
+
+@op("ln", "log")
+def op_ln(ctx, expr):
+    if len(expr.args) == 2:     # log(base, x)
+        base, bn, _ = eval_expr(ctx, expr.args[0])
+        a, an, _ = eval_expr(ctx, expr.args[1])
+        fb = _to_float(ctx, base, expr.args[0].ft)
+        fa = _to_float(ctx, a, expr.args[1].ft)
+        bad = (fa <= 0) | (fb <= 0)
+        r = ctx.xp.log(ctx.xp.where(fa <= 0, 1.0, fa)) / \
+            ctx.xp.log(ctx.xp.where(fb <= 0, 2.0, fb))
+        return r, or_nulls(ctx.xp, an, bn, bad), None
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    f = _to_float(ctx, a, expr.args[0].ft)
+    bad = f <= 0
+    return ctx.xp.log(ctx.xp.where(bad, 1.0, f)), or_nulls(ctx.xp, an, bad), None
+
+
+@op("log2")
+def op_log2(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    f = _to_float(ctx, a, expr.args[0].ft)
+    bad = f <= 0
+    return ctx.xp.log2(ctx.xp.where(bad, 1.0, f)), or_nulls(ctx.xp, an, bad), None
+
+
+@op("log10")
+def op_log10(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    f = _to_float(ctx, a, expr.args[0].ft)
+    bad = f <= 0
+    return ctx.xp.log10(ctx.xp.where(bad, 1.0, f)), or_nulls(ctx.xp, an, bad), None
+
+
+@op("pow", "power")
+def op_pow(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    fa = _to_float(ctx, a, expr.args[0].ft)
+    fb = _to_float(ctx, b, expr.args[1].ft)
+    return fa ** fb, or_nulls(ctx.xp, an, bn), None
+
+
+@op("greatest")
+def op_greatest(ctx, expr):
+    return _minmax_n(ctx, expr, is_max=True)
+
+
+@op("least")
+def op_least(ctx, expr):
+    return _minmax_n(ctx, expr, is_max=False)
+
+
+def _minmax_n(ctx, expr, is_max):
+    xp = ctx.xp
+    result = None
+    nulls = None
+    for arg in expr.args:
+        v = _coerce_to_ft(ctx, eval_expr(ctx, arg), arg.ft, expr.ft)
+        d = ctx.full(v[0]) if np.isscalar(v[0]) else v[0]
+        nulls = or_nulls(xp, nulls, v[1])
+        if result is None:
+            result = d
+        else:
+            result = xp.where(d > result, d, result) if is_max else \
+                xp.where(d < result, d, result)
+    return result, nulls, None
+
+
+# ---------------- bit ops ----------------
+
+@op("&")
+def op_bitand(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    return a & b, or_nulls(ctx.xp, an, bn), None
+
+
+@op("|")
+def op_bitor(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    return a | b, or_nulls(ctx.xp, an, bn), None
+
+
+@op("^")
+def op_bitxor(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    return a ^ b, or_nulls(ctx.xp, an, bn), None
+
+
+@op("<<")
+def op_shl(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    return a << b, or_nulls(ctx.xp, an, bn), None
+
+
+@op(">>")
+def op_shr(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    return a >> b, or_nulls(ctx.xp, an, bn), None
+
+
+@op("~")
+def op_bitneg(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return ~a, an, None
+
+
+# ---------------- temporal ----------------
+
+def civil_from_days(xp, z):
+    """days-since-epoch -> (y, m, d); Hinnant's algorithm, pure int ops —
+    vectorizes on the VPU."""
+    z = z + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def days_from_civil(xp, y, m, d):
+    y = xp.where(m <= 2, y - 1, y)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_of(ctx, expr_arg):
+    """Evaluate a temporal arg to days-since-epoch."""
+    a, an, sd = eval_expr(ctx, expr_arg)
+    tc = expr_arg.ft.tclass
+    if sd is not None or isinstance(a, str) or \
+            (hasattr(a, "dtype") and a.dtype == object):
+        from ..types.time_types import parse_date
+        r = _apply_str_fn(ctx, (a, an, sd), parse_date, out_is_string=False)
+        return r[0], r[1]
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        return a // MICROS_PER_DAY, an
+    return a, an
+
+
+@op("year")
+def op_year(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    return y, an, None
+
+
+@op("month")
+def op_month(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    return m, an, None
+
+
+@op("day", "dayofmonth")
+def op_day(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    return d, an, None
+
+
+@op("quarter")
+def op_quarter(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    return (m - 1) // 3 + 1, an, None
+
+
+@op("dayofweek")
+def op_dayofweek(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    # 1970-01-01 is Thursday; MySQL: 1=Sunday
+    return (days + 4) % 7 + 1, an, None
+
+
+@op("weekday")
+def op_weekday(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    return (days + 3) % 7, an, None
+
+
+@op("dayofyear")
+def op_dayofyear(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    jan1 = days_from_civil(ctx.xp, y, ctx.xp.asarray(1), ctx.xp.asarray(1))
+    return days - jan1 + 1, an, None
+
+
+@op("hour")
+def op_hour(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    tc = expr.args[0].ft.tclass
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        a = a % MICROS_PER_DAY
+    return a // (3600 * MICROS_PER_SEC), an, None
+
+
+@op("minute")
+def op_minute(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    tc = expr.args[0].ft.tclass
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        a = a % MICROS_PER_DAY
+    return (a // (60 * MICROS_PER_SEC)) % 60, an, None
+
+
+@op("second")
+def op_second(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    tc = expr.args[0].ft.tclass
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        a = a % MICROS_PER_DAY
+    return (a // MICROS_PER_SEC) % 60, an, None
+
+
+@op("extract")
+def op_extract(ctx, expr):
+    unit = expr.args[0].value.val
+    inner = ScalarFunc({"year": "year", "month": "month", "day": "day",
+                        "quarter": "quarter", "hour": "hour",
+                        "minute": "minute", "second": "second",
+                        "week": "week"}.get(unit, unit),
+                       [expr.args[1]], expr.ft)
+    return eval_expr(ctx, inner)
+
+
+@op("date")
+def op_date(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    return days, an, None
+
+
+@op("datediff")
+def op_datediff(ctx, expr):
+    a, an = _days_of(ctx, expr.args[0])
+    b, bn = _days_of(ctx, expr.args[1])
+    return a - b, or_nulls(ctx.xp, an, bn), None
+
+
+@op("date_add", "date_sub", "adddate", "subdate")
+def op_date_add(ctx, expr):
+    """args: [date_expr, IntervalConst]; interval encoded by the planner as
+    a Constant whose ft carries the unit in ft.tp ('interval_day' etc.)."""
+    neg = expr.op in ("date_sub", "subdate")
+    base = expr.args[0]
+    iv = expr.args[1]
+    unit = iv.ft.tp.replace("interval_", "")
+    n_val, n_nulls, _ = eval_expr(ctx, iv)
+    xp = ctx.xp
+    tc = base.ft.tclass
+    if neg:
+        n_val = -n_val
+    if unit in ("day", "week"):
+        delta_days = n_val * (7 if unit == "week" else 1)
+        if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            a, an, _ = eval_expr(ctx, base)
+            return a + delta_days * MICROS_PER_DAY, or_nulls(xp, an, n_nulls), None
+        days, an = _days_of(ctx, base)
+        return days + delta_days, or_nulls(xp, an, n_nulls), None
+    if unit in ("hour", "minute", "second", "microsecond"):
+        mult = {"hour": 3600 * MICROS_PER_SEC, "minute": 60 * MICROS_PER_SEC,
+                "second": MICROS_PER_SEC, "microsecond": 1}[unit]
+        a, an, _ = eval_expr(ctx, base)
+        if tc == TypeClass.DATE:
+            a = a * MICROS_PER_DAY
+        return a + n_val * mult, or_nulls(xp, an, n_nulls), None
+    if unit in ("month", "quarter", "year"):
+        mmul = {"month": 1, "quarter": 3, "year": 12}[unit]
+        if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            a, an, _ = eval_expr(ctx, base)
+            days = a // MICROS_PER_DAY
+            tod = a % MICROS_PER_DAY
+        else:
+            days, an = _days_of(ctx, base)
+            tod = None
+        y, m, d = civil_from_days(xp, days)
+        tot = y * 12 + (m - 1) + n_val * mmul
+        ny = tot // 12
+        nm = tot % 12 + 1
+        # clamp day to month length
+        nm_days = _days_in_month(xp, ny, nm)
+        nd = xp.minimum(d, nm_days)
+        r = days_from_civil(xp, ny, nm, nd)
+        if tod is not None:
+            r = r * MICROS_PER_DAY + tod
+        return r, or_nulls(xp, an, n_nulls), None
+    raise UnknownFunctionError("unsupported interval unit %s", unit)
+
+
+def _days_in_month(xp, y, m):
+    base = xp.asarray(np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]))
+    leap = (y % 4 == 0) & ((y % 100 != 0) | (y % 400 == 0))
+    dim = base[m - 1]
+    return xp.where((m == 2) & leap, 29, dim)
+
+
+@op("week")
+def op_week(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(ctx.xp, days)
+    jan1 = days_from_civil(ctx.xp, y, ctx.xp.asarray(1), ctx.xp.asarray(1))
+    return (days - jan1 + ((jan1 + 4) % 7 + 1)) // 7, an, None
+
+
+@op("unix_timestamp")
+def op_unix_ts(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    tc = expr.args[0].ft.tclass
+    if tc == TypeClass.DATE:
+        return a * 86400, an, None
+    return a // MICROS_PER_SEC, an, None
+
+
+# ---------------- casts ----------------
+
+@op("cast_signed", "cast_unsigned")
+def op_cast_int(ctx, expr):
+    a, an, sd = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    xp = ctx.xp
+    if sd is not None or (hasattr(a, "dtype") and a.dtype == object) or \
+            isinstance(a, str):
+        def p(s):
+            try:
+                return int(float(s))
+            except (ValueError, TypeError):
+                return 0
+        return _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
+    cls = _dataclass_of(ft)
+    if cls == "float":
+        return xp.asarray(xp.round(a), dtype=np.int64), an, None
+    if cls == "decimal":
+        return _rescale_down_round(xp, a, _scale_of(ft)), an, None
+    return a, an, None
+
+
+@op("cast_double")
+def op_cast_double(ctx, expr):
+    a, an, sd = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    if sd is not None or (hasattr(a, "dtype") and a.dtype == object) or \
+            isinstance(a, str):
+        def p(s):
+            try:
+                return float(s)
+            except (ValueError, TypeError):
+                return 0.0
+        data, nulls, _ = _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
+        return ctx.xp.asarray(data, dtype=ctx.float_dtype), nulls, None
+    return _to_float(ctx, a, ft), an, None
+
+
+@op("cast_decimal")
+def op_cast_decimal(ctx, expr):
+    a, an, sd = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    ts = _scale_of(expr.ft)
+    xp = ctx.xp
+    if sd is not None or (hasattr(a, "dtype") and a.dtype == object) or \
+            isinstance(a, str):
+        from ..types.decimal import dec_to_scaled_int
+
+        def p(s):
+            try:
+                return dec_to_scaled_int(s, ts)
+            except Exception:
+                return 0
+        return _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
+    cls = _dataclass_of(ft)
+    if cls == "decimal":
+        k = ts - _scale_of(ft)
+        r = _rescale_up(xp, a, k) if k >= 0 else _rescale_down_round(xp, a, -k)
+        return r, an, None
+    if cls == "float":
+        return xp.asarray(xp.round(a * _POW10[ts]), dtype=np.int64), an, None
+    return a * _POW10[ts], an, None
+
+
+@op("cast_char")
+def op_cast_char(ctx, expr):
+    a, an, sd = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    if sd is not None or isinstance(a, str) or \
+            (hasattr(a, "dtype") and a.dtype == object):
+        return a, an, sd
+    # numeric -> string: host path only (data-dependent dictionary)
+    from ..types.decimal import scaled_int_to_str
+    from ..types.time_types import days_to_str, micros_to_str
+    cls = _dataclass_of(ft)
+    tc = ft.tclass
+    a_np = np.asarray(a)
+    out = np.empty(len(a_np), dtype=object)
+    for i, v in enumerate(a_np):
+        if tc == TypeClass.DATE:
+            out[i] = days_to_str(int(v))
+        elif tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            out[i] = micros_to_str(int(v), max(ft.decimal, 0))
+        elif cls == "decimal":
+            out[i] = scaled_int_to_str(int(v), _scale_of(ft))
+        elif cls == "float":
+            out[i] = repr(float(v))
+        else:
+            out[i] = str(int(v))
+    return out, an, None
+
+
+@op("cast_str_to_date")
+def op_cast_str_to_date(ctx, expr):
+    from ..types.time_types import parse_date
+    av = eval_expr(ctx, expr.args[0])
+    if isinstance(av[0], str):
+        return parse_date(av[0]), av[1], None
+    return _apply_str_fn(ctx, av, parse_date, out_is_string=False)
+
+
+@op("cast_str_to_datetime", "cast_str_to_time")
+def op_cast_str_to_datetime(ctx, expr):
+    from ..types.time_types import parse_datetime
+    av = eval_expr(ctx, expr.args[0])
+    if isinstance(av[0], str):
+        return parse_datetime(av[0]), av[1], None
+    return _apply_str_fn(ctx, av, parse_datetime, out_is_string=False)
+
+
+@op("cast_date_to_datetime")
+def op_cast_date_to_dt(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return a * MICROS_PER_DAY, an, None
+
+
+@op("cast_datetime_to_date")
+def op_cast_dt_to_date(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return a // MICROS_PER_DAY, an, None

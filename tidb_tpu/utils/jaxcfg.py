@@ -1,0 +1,7 @@
+"""JAX configuration for the engine. int64 semantics are load-bearing
+(scaled-decimal arithmetic, date micros, row handles), so x64 must be on
+before any jax array is created. Float columns still lower to float32 on
+TPU via the copr layer's dtype policy when profitable."""
+import jax
+
+jax.config.update("jax_enable_x64", True)
