@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Benchmark driver: TPC-H on the TPU-native engine vs the CPU-only path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value       = rows/sec scanned through the full SQL stack on the device path
+vs_baseline = CPU-only-path wall time / TPU-path wall time (geomean across
+              queries) — the engine's own `tidb_enable_tpu_exec`-off mode is
+              the baseline, mirroring BASELINE.md's "vs CPU-only tidb-server"
+              target on the same host.
+"""
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "0.1"))
+    queries = os.environ.get("BENCH_QUERIES", "q6,q1,q3,q5").split(",")
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, QUERIES
+
+    tk = TestKit()
+    t0 = time.time()
+    load_tpch(tk, sf=sf, seed=42)
+    load_s = time.time() - t0
+    li = tk.domain.infoschema().table_by_name("test", "lineitem")
+    n_rows = tk.domain.columnar.tables[li.id].live_count()
+
+    def run(q, use_device):
+        tk.domain.copr.use_device = use_device
+        tk.must_query(QUERIES[q])           # warmup (compile)
+        best = math.inf
+        for _ in range(repeats):
+            t = time.time()
+            tk.must_query(QUERIES[q])
+            best = min(best, time.time() - t)
+        return best
+
+    speedups = []
+    tpu_times = {}
+    for q in queries:
+        t_tpu = run(q, True)
+        t_cpu = run(q, False)
+        tpu_times[q] = t_tpu
+        speedups.append(t_cpu / t_tpu)
+        print(f"# {q}: tpu={t_tpu*1000:.1f}ms cpu={t_cpu*1000:.1f}ms "
+              f"speedup={t_cpu/t_tpu:.2f}x", file=sys.stderr)
+    geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    q6_rows_per_s = n_rows / tpu_times.get("q6", list(tpu_times.values())[0])
+    print(f"# lineitem rows={n_rows} load={load_s:.1f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"tpch_sf{sf}_scan_agg_throughput",
+        "value": round(q6_rows_per_s, 1),
+        "unit": "rows/s/chip (Q6 full-stack)",
+        "vs_baseline": round(geo, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,28 @@
-"""Test env: force CPU with 8 virtual devices so multi-chip sharding paths
-(mesh/pjit/shard_map) are exercised without TPU hardware. Must run before
-jax initializes a backend."""
+"""Test env: CPU with 8 virtual devices (multi-chip sharding paths run on a
+virtual mesh), x64 for int64/decimal semantics.
+
+The image's sitecustomize registers the axon TPU PJRT plugin in every
+interpreter; with the remote tunnel busy/wedged, initializing it blocks
+even when JAX_PLATFORMS=cpu. Tests must never touch the tunnel, so the
+axon backend factory is unregistered before the first backend init."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "1"
+
+try:
+    import jax._src.xla_bridge as _xb
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+    import jax
+    # jax may already be imported (sitecustomize), so its config snapshotted
+    # the old env — update explicitly.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+except Exception:
+    pass

@@ -1,0 +1,399 @@
+"""SQL-level tests on the embedded store (reference tier-2 testing:
+testkit against unistore, SURVEY.md §4)."""
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu import errors
+
+
+@pytest.fixture(scope="module")
+def tk():
+    return TestKit()
+
+
+@pytest.fixture()
+def ftk():
+    """Fresh store per test."""
+    return TestKit()
+
+
+class TestBasic:
+    def test_select_literal(self, tk):
+        tk.must_query("select 1").check([(1,)])
+        tk.must_query("select 1+2*3, 'x'").check([(7, "x")])
+        tk.must_query("select 10/4, 10 div 4, 10 % 3").check([("2.5000", 2, 1)])
+        tk.must_query("select null").check([("<nil>",)])
+
+    def test_create_insert_select(self, tk):
+        tk.must_exec("drop table if exists t1")
+        tk.must_exec("create table t1 (id int primary key, v varchar(10), "
+                     "d decimal(10,2))")
+        tk.must_exec("insert into t1 values (1,'a',1.5),(2,'b',2.5),"
+                     "(3,null,null)")
+        tk.must_query("select * from t1 order by id").check([
+            (1, "a", "1.50"), (2, "b", "2.50"), (3, None, None)])
+        tk.must_query("select v from t1 where d > 2").check([("b",)])
+        tk.must_query("select id from t1 where v is null").check([(3,)])
+
+    def test_duplicate_pk(self, tk):
+        tk.must_exec("drop table if exists t2")
+        tk.must_exec("create table t2 (id int primary key)")
+        tk.must_exec("insert into t2 values (1)")
+        e = tk.exec_err("insert into t2 values (1)")
+        assert isinstance(e, errors.DuplicateKeyError)
+        tk.must_exec("insert ignore into t2 values (1),(2)")
+        tk.must_query("select count(*) from t2").check([(2,)])
+
+    def test_update_delete(self, tk):
+        tk.must_exec("drop table if exists t3")
+        tk.must_exec("create table t3 (a int, b int)")
+        tk.must_exec("insert into t3 values (1,10),(2,20),(3,30)")
+        tk.must_exec("update t3 set b = b + 1 where a >= 2")
+        tk.must_query("select b from t3 order by a").check([(10,), (21,), (31,)])
+        tk.must_exec("delete from t3 where a = 2")
+        tk.must_query("select a from t3 order by a").check([(1,), (3,)])
+        assert tk.sess.vars.affected_rows == 1
+
+    def test_auto_increment(self, tk):
+        tk.must_exec("drop table if exists t4")
+        tk.must_exec("create table t4 (id bigint primary key auto_increment, "
+                     "v int)")
+        tk.must_exec("insert into t4 (v) values (10),(20)")
+        tk.must_exec("insert into t4 values (100, 30)")
+        tk.must_exec("insert into t4 (v) values (40)")
+        tk.must_query("select id, v from t4 order by id").check([
+            (1, 10), (2, 20), (100, 30), (101, 40)])
+
+    def test_null_constraints(self, tk):
+        tk.must_exec("drop table if exists t5")
+        tk.must_exec("create table t5 (a int not null, b int default 7)")
+        e = tk.exec_err("insert into t5 values (null, 1)")
+        assert isinstance(e, errors.BadNullError)
+        tk.must_exec("insert into t5 (a) values (1)")
+        tk.must_query("select * from t5").check([(1, 7)])
+
+
+class TestExpressionsSQL:
+    def test_string_funcs(self, tk):
+        tk.must_query("select upper('abc'), lower('ABC'), length('héllo'), "
+                      "concat('a','b','c')").check([("ABC", "abc", 6, "abc")])
+        tk.must_query("select substring('hello', 2, 3), trim('  x  '), "
+                      "replace('aaa','a','b')").check([("ell", "x", "bbb")])
+
+    def test_case_if(self, tk):
+        tk.must_query("select if(1 > 2, 'a', 'b'), ifnull(null, 5), "
+                      "coalesce(null, null, 3)").check([("b", 5, 3)])
+        tk.must_query("select case when 1=2 then 'x' when 1=1 then 'y' "
+                      "else 'z' end").check([("y",)])
+
+    def test_date_funcs(self, tk):
+        tk.must_query("select year(date '1994-05-15'), month(date '1994-05-15'),"
+                      " day(date '1994-05-15')").check([(1994, 5, 15)])
+        tk.must_query("select date '1994-01-31' + interval 1 month")\
+            .check([("1994-02-28",)])
+        tk.must_query("select datediff('1994-01-10', '1994-01-01')")\
+            .check([(9,)])
+        tk.must_query("select extract(year from date '1999-12-31')")\
+            .check([(1999,)])
+
+    def test_math(self, tk):
+        tk.must_query("select abs(-5), floor(2.7), ceil(2.1), round(2.567, 2)")\
+            .check([(5, 2, 3, "2.57")])
+        tk.must_query("select mod(10, 3), pow(2, 10), sqrt(16)")\
+            .check([(1, 1024, 4)])
+
+    def test_like_in(self, tk):
+        tk.must_exec("drop table if exists ts")
+        tk.must_exec("create table ts (s varchar(30))")
+        tk.must_exec("insert into ts values ('apple'),('banana'),('cherry')")
+        tk.must_query("select s from ts where s like 'b%'").check([("banana",)])
+        tk.must_query("select s from ts where s like '%an%'").check([("banana",)])
+        tk.must_query("select s from ts where s in ('apple','cherry') "
+                      "order by s").check([("apple",), ("cherry",)])
+        tk.must_query("select s from ts where s not in ('apple','cherry')")\
+            .check([("banana",)])
+
+
+class TestAggregation:
+    def test_global_agg(self, tk):
+        tk.must_exec("drop table if exists g")
+        tk.must_exec("create table g (a int, b decimal(8,2), c varchar(10))")
+        tk.must_exec("insert into g values (1,1.00,'x'),(2,2.50,'y'),"
+                     "(3,null,'x'),(null,4.00,'z')")
+        tk.must_query("select count(*), count(a), count(b) from g")\
+            .check([(4, 3, 3)])
+        tk.must_query("select sum(b), min(b), max(b), avg(b) from g")\
+            .check([("7.50", "1.00", "4.00", "2.500000")])
+        tk.must_query("select sum(a) from g where a > 100").check([(None,)])
+        tk.must_query("select count(*) from g where a > 100").check([(0,)])
+
+    def test_group_by(self, tk):
+        tk.must_exec("drop table if exists g2")
+        tk.must_exec("create table g2 (k varchar(5), v int)")
+        tk.must_exec("insert into g2 values ('a',1),('b',2),('a',3),('b',4),"
+                     "('c',5),(null,6)")
+        tk.must_query("select k, sum(v), count(*) from g2 group by k "
+                      "order by k").check([
+                          (None, 6, 1), ("a", 4, 2), ("b", 6, 2), ("c", 5, 1)])
+        tk.must_query("select k from g2 group by k having sum(v) > 4 "
+                      "order by k").check([(None,), ("b",), ("c",)])
+
+    def test_distinct(self, tk):
+        tk.must_exec("drop table if exists g3")
+        tk.must_exec("create table g3 (a int, b int)")
+        tk.must_exec("insert into g3 values (1,1),(1,1),(2,2),(2,3)")
+        tk.must_query("select distinct a from g3 order by a").check([(1,), (2,)])
+        tk.must_query("select count(distinct a), count(b) from g3")\
+            .check([(2, 4)])
+        tk.must_query("select a, count(distinct b) from g3 group by a "
+                      "order by a").check([(1, 1), (2, 2)])
+
+    def test_group_by_expr(self, tk):
+        tk.must_exec("drop table if exists g4")
+        tk.must_exec("create table g4 (d date, v int)")
+        tk.must_exec("insert into g4 values ('1994-01-05',1),('1994-02-05',2),"
+                     "('1995-01-05',4)")
+        tk.must_query("select year(d), sum(v) from g4 group by year(d) "
+                      "order by 1").check([(1994, 3), (1995, 4)])
+
+
+class TestJoin:
+    @pytest.fixture(autouse=True)
+    def setup(self, tk):
+        tk.must_exec("drop table if exists j1, j2")
+        tk.must_exec("create table j1 (id int, v varchar(5))")
+        tk.must_exec("create table j2 (id int, w varchar(5))")
+        tk.must_exec("insert into j1 values (1,'a'),(2,'b'),(3,'c')")
+        tk.must_exec("insert into j2 values (2,'x'),(3,'y'),(3,'z'),(4,'q')")
+        self.tk = tk
+
+    def test_inner(self):
+        self.tk.must_query(
+            "select j1.id, v, w from j1 join j2 on j1.id = j2.id "
+            "order by j1.id, w").check([
+                (2, "b", "x"), (3, "c", "y"), (3, "c", "z")])
+
+    def test_left(self):
+        self.tk.must_query(
+            "select j1.id, w from j1 left join j2 on j1.id = j2.id "
+            "order by j1.id, w").check([
+                (1, None), (2, "x"), (3, "y"), (3, "z")])
+
+    def test_right(self):
+        self.tk.must_query(
+            "select j2.id, v from j1 right join j2 on j1.id = j2.id "
+            "order by j2.id, v").check([
+                (2, "b"), (3, "c"), (3, "c"), (4, None)])
+
+    def test_cross(self):
+        self.tk.must_query("select count(*) from j1, j2").check([(12,)])
+
+    def test_implicit_eq(self):
+        self.tk.must_query(
+            "select count(*) from j1, j2 where j1.id = j2.id").check([(3,)])
+
+    def test_join_agg(self):
+        self.tk.must_query(
+            "select v, count(*) from j1 join j2 on j1.id = j2.id "
+            "group by v order by v").check([("b", 1), ("c", 2)])
+
+    def test_non_eq_cond(self):
+        self.tk.must_query(
+            "select count(*) from j1 join j2 on j1.id = j2.id and w != 'z'")\
+            .check([(2,)])
+
+    def test_using(self):
+        self.tk.must_query(
+            "select id, v, w from j1 join j2 using(id) order by id, w")\
+            .check([(2, "b", "x"), (3, "c", "y"), (3, "c", "z")])
+
+
+class TestSortLimit:
+    def test_order_limit(self, tk):
+        tk.must_exec("drop table if exists s1")
+        tk.must_exec("create table s1 (a int, b varchar(5))")
+        tk.must_exec("insert into s1 values (3,'c'),(1,'a'),(2,'b'),(null,'n')")
+        tk.must_query("select a from s1 order by a").check([
+            (None,), (1,), (2,), (3,)])
+        tk.must_query("select a from s1 order by a desc").check([
+            (3,), (2,), (1,), (None,)])
+        tk.must_query("select a from s1 order by a desc limit 2").check([
+            (3,), (2,)])
+        tk.must_query("select a from s1 order by a limit 1, 2").check([
+            (1,), (2,)])
+        tk.must_query("select a from s1 order by b desc limit 1 offset 1")\
+            .check([(3,)])
+
+    def test_order_by_alias_and_expr(self, tk):
+        tk.must_exec("drop table if exists s2")
+        tk.must_exec("create table s2 (a int, b int)")
+        tk.must_exec("insert into s2 values (1,9),(2,4),(3,6)")
+        tk.must_query("select a, a+b as s from s2 order by s").check([
+            (2, 6), (3, 9), (1, 10)])
+        tk.must_query("select a from s2 order by b*1 desc").check([
+            (1,), (3,), (2,)])
+
+
+class TestSubquery:
+    def test_scalar(self, tk):
+        tk.must_exec("drop table if exists sq")
+        tk.must_exec("create table sq (a int)")
+        tk.must_exec("insert into sq values (1),(5),(9)")
+        tk.must_query("select (select max(a) from sq)").check([(9,)])
+        tk.must_query("select a from sq where a > (select avg(a) from sq)")\
+            .check([(9,)])
+
+    def test_in_subquery(self, tk):
+        tk.must_exec("drop table if exists sq1, sq2")
+        tk.must_exec("create table sq1 (a int)")
+        tk.must_exec("create table sq2 (b int)")
+        tk.must_exec("insert into sq1 values (1),(2),(3)")
+        tk.must_exec("insert into sq2 values (2),(3),(4)")
+        tk.must_query("select a from sq1 where a in (select b from sq2) "
+                      "order by a").check([(2,), (3,)])
+        tk.must_query("select a from sq1 where a not in (select b from sq2)")\
+            .check([(1,)])
+        tk.must_query("select a from sq1 where exists (select 1 from sq2 "
+                      "where b > 100)").check([])
+
+    def test_derived_table(self, tk):
+        tk.must_exec("drop table if exists dt")
+        tk.must_exec("create table dt (a int, b int)")
+        tk.must_exec("insert into dt values (1,10),(2,20),(3,30)")
+        tk.must_query("select s from (select a, a+b as s from dt) x "
+                      "where a > 1 order by s").check([(22,), (33,)])
+        tk.must_query("select max(t.total) from (select a, sum(b) as total "
+                      "from dt group by a) t").check([("30",)])
+
+
+class TestUnion:
+    def test_union(self, tk):
+        tk.must_query("select 1 union select 2 union select 1 order by 1")\
+            .check([(1,), (2,)])
+        tk.must_query("select 1 union all select 1").check([(1,), (1,)])
+        tk.must_exec("drop table if exists u1")
+        tk.must_exec("create table u1 (a int)")
+        tk.must_exec("insert into u1 values (1),(2)")
+        tk.must_query("select a from u1 union all select 9 order by 1")\
+            .check([(1,), (2,), (9,)])
+
+
+class TestTxn:
+    def test_commit_rollback(self, ftk):
+        ftk.must_exec("create table tx (a int)")
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into tx values (1)")
+        ftk.must_query("select * from tx").check([(1,)])   # own writes
+        ftk.must_exec("rollback")
+        ftk.must_query("select count(*) from tx").check([(0,)])
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into tx values (2)")
+        ftk.must_exec("commit")
+        ftk.must_query("select * from tx").check([(2,)])
+
+    def test_isolation(self, ftk):
+        ftk.must_exec("create table ti (a int)")
+        ftk.must_exec("insert into ti values (1)")
+        tk2 = ftk.new_session()
+        ftk.must_exec("begin")
+        ftk.must_query("select count(*) from ti").check([(1,)])
+        tk2.must_exec("insert into ti values (2)")
+        # snapshot was taken at BEGIN: still sees 1 row
+        ftk.must_query("select count(*) from ti").check([(1,)])
+        ftk.must_exec("commit")
+        ftk.must_query("select count(*) from ti").check([(2,)])
+
+    def test_write_conflict(self, ftk):
+        ftk.must_exec("create table wc (id int primary key, v int)")
+        ftk.must_exec("insert into wc values (1, 0)")
+        tk2 = ftk.new_session()
+        ftk.must_exec("begin")
+        ftk.must_exec("update wc set v = 1 where id = 1")
+        tk2.must_exec("update wc set v = 2 where id = 1")
+        with pytest.raises(errors.TiDBError):
+            ftk.must_exec("commit")
+
+
+class TestDDL:
+    def test_alter_add_drop_column(self, ftk):
+        ftk.must_exec("create table ad (a int)")
+        ftk.must_exec("insert into ad values (1)")
+        ftk.must_exec("alter table ad add column b int default 5")
+        ftk.must_query("select * from ad").check([(1, 5)])
+        ftk.must_exec("insert into ad values (2, 7)")
+        ftk.must_exec("alter table ad drop column a")
+        ftk.must_query("select * from ad order by b").check([(5,), (7,)])
+
+    def test_index_lifecycle(self, ftk):
+        ftk.must_exec("create table il (a int, b int)")
+        ftk.must_exec("insert into il values (1,1),(2,2)")
+        ftk.must_exec("create unique index uk_a on il (a)")
+        e = ftk.exec_err("insert into il values (1, 9)")
+        assert isinstance(e, errors.DuplicateKeyError)
+        ftk.must_exec("drop index uk_a on il")
+        ftk.must_exec("insert into il values (1, 9)")
+        ftk.must_query("select count(*) from il").check([(3,)])
+
+    def test_unique_backfill_conflict(self, ftk):
+        ftk.must_exec("create table ub (a int)")
+        ftk.must_exec("insert into ub values (1),(1)")
+        e = ftk.exec_err("create unique index uk on ub (a)")
+        assert isinstance(e, errors.DuplicateKeyError)
+        # index creation rolled back: inserts still work
+        ftk.must_exec("insert into ub values (1)")
+
+    def test_truncate_rename(self, ftk):
+        ftk.must_exec("create table tr (a int)")
+        ftk.must_exec("insert into tr values (1)")
+        ftk.must_exec("truncate table tr")
+        ftk.must_query("select count(*) from tr").check([(0,)])
+        ftk.must_exec("rename table tr to tr2")
+        ftk.must_exec("insert into tr2 values (5)")
+        e = ftk.exec_err("select * from tr")
+        assert isinstance(e, errors.TableNotExistsError)
+
+    def test_show(self, ftk):
+        ftk.must_exec("create table sh (a int primary key, b varchar(10))")
+        ftk.must_query("show tables").check([("sh",)])
+        r = ftk.must_query("show create table sh")
+        r.check_contain("`a` int")
+        ftk.must_query("show databases").check_contain("test")
+        r = ftk.must_query("describe sh")
+        assert r.rows[0][0] == "a"
+
+
+class TestSysVars:
+    def test_set_show(self, ftk):
+        ftk.must_exec("set @@tidb_max_chunk_size = 2048")
+        ftk.must_query("select @@tidb_max_chunk_size").check([(2048,)])
+        ftk.must_exec("set @@global.tidb_mem_quota_query = 2097152")
+        tk2 = ftk.new_session()
+        tk2.must_query("select @@global.tidb_mem_quota_query")\
+            .check([(2097152,)])
+        e = ftk.exec_err("set @@nonexistent_var = 1")
+        assert isinstance(e, errors.UnknownSystemVariableError)
+
+    def test_user_vars(self, ftk):
+        ftk.must_exec("set @x = 42")
+        ftk.must_query("select @x + 1").check([(43,)])
+
+    def test_tpu_toggle(self, ftk):
+        ftk.must_exec("create table tp (a int)")
+        ftk.must_exec("insert into tp values (1),(2),(3)")
+        ftk.must_exec("set @@tidb_enable_tpu_exec = off")
+        ftk.must_query("select sum(a) from tp where a > 1").check([(5,)])
+        ftk.must_exec("set @@tidb_enable_tpu_exec = on")
+        ftk.must_query("select sum(a) from tp where a > 1").check([(5,)])
+
+
+class TestExplain:
+    def test_explain_shapes(self, tk):
+        tk.must_exec("drop table if exists ex")
+        tk.must_exec("create table ex (a int, b int)")
+        r = tk.must_query("explain select sum(b) from ex where a > 1 group by a")
+        text = "\n".join(r0[0] + " " + r0[2] for r0 in r.rows)
+        assert "HashAgg" in text
+        assert "TableReader" in text
+        r = tk.must_query("explain select * from ex order by a limit 3")
+        text = "\n".join(r0[0] for r0 in r.rows)
+        assert "TopN" in text

@@ -1,0 +1,291 @@
+"""TPC-H schema + synthetic data generator (dbgen-shaped distributions,
+numpy-vectorized). Loads straight into the columnar engine via bulk_append
+(the lightning local-backend path). Values follow the TPC-H spec's shapes
+(uniform ranges, date windows) so query selectivities are realistic; exact
+dbgen text (comments etc.) is irrelevant for the engine paths exercised."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.time_types import parse_date
+
+DDL = {
+    "region": """create table region (
+        r_regionkey int primary key, r_name char(25), r_comment varchar(152))""",
+    "nation": """create table nation (
+        n_nationkey int primary key, n_name char(25), n_regionkey int,
+        n_comment varchar(152))""",
+    "supplier": """create table supplier (
+        s_suppkey int primary key, s_name char(25), s_address varchar(40),
+        s_nationkey int, s_phone char(15), s_acctbal decimal(15,2),
+        s_comment varchar(101))""",
+    "customer": """create table customer (
+        c_custkey int primary key, c_name varchar(25), c_address varchar(40),
+        c_nationkey int, c_phone char(15), c_acctbal decimal(15,2),
+        c_mktsegment char(10), c_comment varchar(117))""",
+    "part": """create table part (
+        p_partkey int primary key, p_name varchar(55), p_mfgr char(25),
+        p_brand char(10), p_type varchar(25), p_size int,
+        p_container char(10), p_retailprice decimal(15,2),
+        p_comment varchar(23))""",
+    "partsupp": """create table partsupp (
+        ps_partkey int, ps_suppkey int, ps_availqty int,
+        ps_supplycost decimal(15,2), ps_comment varchar(199))""",
+    "orders": """create table orders (
+        o_orderkey int primary key, o_custkey int, o_orderstatus char(1),
+        o_totalprice decimal(15,2), o_orderdate date,
+        o_orderpriority char(15), o_clerk char(15), o_shippriority int,
+        o_comment varchar(79))""",
+    "lineitem": """create table lineitem (
+        l_orderkey int, l_partkey int, l_suppkey int, l_linenumber int,
+        l_quantity decimal(15,2), l_extendedprice decimal(15,2),
+        l_discount decimal(15,2), l_tax decimal(15,2),
+        l_returnflag char(1), l_linestatus char(1),
+        l_shipdate date, l_commitdate date, l_receiptdate date,
+        l_shipinstruct char(25), l_shipmode char(10), l_comment varchar(44))""",
+}
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1)]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+             "TAKE BACK RETURN"]
+
+_D92 = parse_date("1992-01-01")
+_D98 = parse_date("1998-08-02")   # last shipdate window per spec
+
+
+def _codes(rng, choices, n):
+    return rng.integers(0, len(choices), n).astype(np.int32)
+
+
+def _seed_dict(ctab, col_name, values):
+    """Pre-seed the table's string dictionary so int32 codes load as-is."""
+    tbl = ctab.table_info
+    ci = tbl.find_column(col_name)
+    d = ctab.dicts[ci.id]
+    for v in values:
+        d.encode_one(v)
+
+
+def load_tpch(tk, sf: float = 0.01, seed: int = 7, skip_tables=()):
+    """Create + bulk-load all TPC-H tables at scale factor sf."""
+    rng = np.random.default_rng(seed)
+    domain = tk.domain
+    ischema = lambda: domain.infoschema()   # noqa: E731
+    for name, ddl in DDL.items():
+        if name in skip_tables:
+            continue
+        tk.must_exec(f"drop table if exists {name}")
+        tk.must_exec(ddl)
+
+    def ctab(name):
+        tbl = ischema().table_by_name("test", name)
+        return domain.columnar.table(tbl)
+
+    # region / nation (fixed)
+    if "region" not in skip_tables:
+        t = ctab("region")
+        _seed_dict(t, "r_name", REGIONS)
+        t.bulk_append({
+            "r_regionkey": np.arange(5, dtype=np.int64),
+            "r_name": np.array(REGIONS, dtype=object),
+            "r_comment": np.array(["" for _ in REGIONS], dtype=object),
+        }, 5)
+    if "nation" not in skip_tables:
+        t = ctab("nation")
+        _seed_dict(t, "n_name", [n for n, _ in NATIONS])
+        t.bulk_append({
+            "n_nationkey": np.arange(25, dtype=np.int64),
+            "n_name": np.array([n for n, _ in NATIONS], dtype=object),
+            "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int64),
+            "n_comment": np.array(["" for _ in NATIONS], dtype=object),
+        }, 25)
+
+    n_supp = max(int(10_000 * sf), 10)
+    n_cust = max(int(150_000 * sf), 30)
+    n_part = max(int(200_000 * sf), 40)
+    n_ord = max(int(1_500_000 * sf), 150)
+
+    if "supplier" not in skip_tables:
+        t = ctab("supplier")
+        t.bulk_append({
+            "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+            "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+                               dtype=object),
+            "s_address": np.array(["addr"] * n_supp, dtype=object),
+            "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
+            "s_phone": np.array(["11-111-111-1111"] * n_supp, dtype=object),
+            "s_acctbal": rng.integers(-99999, 999999, n_supp).astype(np.int64),
+            "s_comment": np.array([""] * n_supp, dtype=object),
+        }, n_supp)
+
+    if "customer" not in skip_tables:
+        t = ctab("customer")
+        _seed_dict(t, "c_mktsegment", SEGMENTS)
+        t.bulk_append({
+            "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+            "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+                               dtype=object),
+            "c_address": np.array(["addr"] * n_cust, dtype=object),
+            "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+            "c_phone": np.array(["11-111-111-1111"] * n_cust, dtype=object),
+            "c_acctbal": rng.integers(-99999, 999999, n_cust).astype(np.int64),
+            "c_mktsegment": _codes(rng, SEGMENTS, n_cust),
+            "c_comment": np.array([""] * n_cust, dtype=object),
+        }, n_cust)
+
+    if "part" not in skip_tables:
+        t = ctab("part")
+        types = [f"{a} {b} {c}"
+                 for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                           "PROMO")
+                 for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED",
+                           "BRUSHED")
+                 for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+        brands = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+        containers = [f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO", "WRAP")
+                      for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK",
+                                "CAN", "DRUM")]
+        _seed_dict(t, "p_type", types)
+        _seed_dict(t, "p_brand", brands)
+        _seed_dict(t, "p_container", containers)
+        t.bulk_append({
+            "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+            "p_name": np.array([f"part {i}" for i in range(1, n_part + 1)],
+                               dtype=object),
+            "p_mfgr": np.array(["Manufacturer#1"] * n_part, dtype=object),
+            "p_brand": _codes(rng, brands, n_part),
+            "p_type": _codes(rng, types, n_part),
+            "p_size": rng.integers(1, 51, n_part).astype(np.int64),
+            "p_container": _codes(rng, containers, n_part),
+            "p_retailprice": rng.integers(90000, 200000, n_part).astype(np.int64),
+            "p_comment": np.array([""] * n_part, dtype=object),
+        }, n_part)
+
+    if "partsupp" not in skip_tables:
+        t = ctab("partsupp")
+        n_ps = n_part * 4
+        t.bulk_append({
+            "ps_partkey": np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4),
+            "ps_suppkey": rng.integers(1, n_supp + 1, n_ps).astype(np.int64),
+            "ps_availqty": rng.integers(1, 10000, n_ps).astype(np.int64),
+            "ps_supplycost": rng.integers(100, 100001, n_ps).astype(np.int64),
+            "ps_comment": np.array([""] * n_ps, dtype=object),
+        }, n_ps)
+
+    o_orderdate = (_D92 + rng.integers(0, _D98 - 151 - _D92, n_ord)).astype(np.int64)
+    if "orders" not in skip_tables:
+        t = ctab("orders")
+        _seed_dict(t, "o_orderstatus", ["F", "O", "P"])
+        _seed_dict(t, "o_orderpriority", PRIORITIES)
+        t.bulk_append({
+            "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
+            "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+            "o_orderstatus": _codes(rng, ["F", "O", "P"], n_ord),
+            "o_totalprice": rng.integers(100000, 50000000, n_ord).astype(np.int64),
+            "o_orderdate": o_orderdate,
+            "o_orderpriority": _codes(rng, PRIORITIES, n_ord),
+            "o_clerk": np.array(["Clerk#000000001"] * n_ord, dtype=object),
+            "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+            "o_comment": np.array([""] * n_ord, dtype=object),
+        }, n_ord)
+
+    if "lineitem" not in skip_tables:
+        t = ctab("lineitem")
+        nl_per = rng.integers(1, 8, n_ord)
+        n_li = int(nl_per.sum())
+        l_orderkey = np.repeat(np.arange(1, n_ord + 1, dtype=np.int64), nl_per)
+        base_date = np.repeat(o_orderdate, nl_per)
+        shipdate = base_date + rng.integers(1, 122, n_li)
+        commitdate = base_date + rng.integers(30, 91, n_li)
+        receiptdate = shipdate + rng.integers(1, 31, n_li)
+        # returnflag: R/A for old (shipped before 1995-06-17), N for new
+        cutoff = parse_date("1995-06-17")
+        is_old = receiptdate <= cutoff
+        rf = np.where(is_old, rng.integers(0, 2, n_li), 2).astype(np.int32)
+        ls = np.where(shipdate > cutoff, 1, 0).astype(np.int32)   # O / F
+        _seed_dict(t, "l_returnflag", ["R", "A", "N"])
+        _seed_dict(t, "l_linestatus", ["F", "O"])
+        _seed_dict(t, "l_shipmode", SHIPMODES)
+        _seed_dict(t, "l_shipinstruct", INSTRUCTS)
+        quantity = rng.integers(1, 51, n_li).astype(np.int64) * 100
+        extprice = rng.integers(90000, 10500000, n_li).astype(np.int64)
+        t.bulk_append({
+            "l_orderkey": l_orderkey,
+            "l_partkey": rng.integers(1, n_part + 1, n_li).astype(np.int64),
+            "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
+            "l_linenumber": np.concatenate(
+                [np.arange(1, k + 1) for k in nl_per]).astype(np.int64)
+            if n_ord < 200_000 else np.ones(n_li, dtype=np.int64),
+            "l_quantity": quantity,
+            "l_extendedprice": extprice,
+            "l_discount": rng.integers(0, 11, n_li).astype(np.int64),
+            "l_tax": rng.integers(0, 9, n_li).astype(np.int64),
+            "l_returnflag": rf,
+            "l_linestatus": ls,
+            "l_shipdate": shipdate.astype(np.int64),
+            "l_commitdate": commitdate.astype(np.int64),
+            "l_receiptdate": receiptdate.astype(np.int64),
+            "l_shipinstruct": _codes(rng, INSTRUCTS, n_li),
+            "l_shipmode": _codes(rng, SHIPMODES, n_li),
+            "l_comment": np.zeros(n_li, dtype=np.int32),
+        }, n_li)
+        # comment dict needs at least the zero code
+        _seed_dict(t, "l_comment", [""])
+    return
+
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+  sum(l_extendedprice) as sum_base_price,
+  sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+  sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+  avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+  avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval 90 day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+  o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+  and r_name = 'ASIA' and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1994-01-01' + interval 1 year
+group by n_name order by revenue desc
+"""
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval 1 year
+  and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+  and l_quantity < 24
+"""
+
+QUERIES = {"q1": Q1, "q3": Q3, "q5": Q5, "q6": Q6}

@@ -1,0 +1,3 @@
+from .dag_exec import CoprExecutor
+
+__all__ = ["CoprExecutor"]

@@ -1,0 +1,4 @@
+from .builder import build_executor
+from .exec_base import ExecContext
+
+__all__ = ["build_executor", "ExecContext"]

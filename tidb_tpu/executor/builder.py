@@ -1,0 +1,37 @@
+"""Executor builder (reference pkg/executor/builder.go:193)."""
+from __future__ import annotations
+
+from ..planner.physical import (PhysTableReader, PhysSelection, PhysProjection,
+                                PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
+                                PhysLimit, PhysUnion, PhysDual, PhysShell)
+from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
+                        HashAggExec, HashJoinExec, SortExec, TopNExec,
+                        LimitExec, UnionExec, DualExec, ShellExec)
+
+
+def build_executor(ctx, plan):
+    if isinstance(plan, PhysTableReader):
+        return TableReaderExec(ctx, plan)
+    if isinstance(plan, PhysSelection):
+        return SelectionExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysProjection):
+        return ProjectionExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysHashAgg):
+        return HashAggExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysHashJoin):
+        return HashJoinExec(ctx, plan, build_executor(ctx, plan.children[0]),
+                            build_executor(ctx, plan.children[1]))
+    if isinstance(plan, PhysSort):
+        return SortExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysTopN):
+        return TopNExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysLimit):
+        return LimitExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysUnion):
+        return UnionExec(ctx, plan,
+                         [build_executor(ctx, c) for c in plan.children])
+    if isinstance(plan, PhysDual):
+        return DualExec(ctx, plan)
+    if isinstance(plan, PhysShell):
+        return ShellExec(ctx, plan, build_executor(ctx, plan.child))
+    raise NotImplementedError(f"no executor for {type(plan).__name__}")

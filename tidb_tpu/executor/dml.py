@@ -1,0 +1,272 @@
+"""DML executors: INSERT / UPDATE / DELETE (reference pkg/executor/insert.go
+:360, update.go, delete.go). Reads are vectorized through the select plan;
+per-row KV writes go through table_rt into the txn memBuffer."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..expression import EvalCtx, eval_expr, Constant
+from ..expression.vec import materialize_nulls
+from ..types.datum import Datum, Kind, NULL
+from ..errors import DuplicateKeyError, BadNullError, DataOutOfRangeError
+from . import table_rt
+from .exec_base import (bind_chunk, coerce_datum, expr_to_datum,
+                        datum_from_value)
+from .builder import build_executor
+
+
+def _row_datums_from_chunk(chunk, i, ncols):
+    return [chunk.columns[j].get_datum(i) for j in range(ncols)]
+
+
+class InsertExec:
+    def __init__(self, ctx, plan, sess):
+        self.ctx = ctx
+        self.plan = plan
+        self.sess = sess
+
+    def execute(self) -> int:
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.sess
+        txn = sess.txn()
+        cols = tbl.public_columns()
+        affected = 0
+        rows_iter = self._source_rows(cols)
+        alloc = sess.domain.allocator(tbl)
+        auto_col_off = next((i for i, c in enumerate(cols)
+                             if c.ft.auto_increment), None)
+        for datums in rows_iter:
+            row = self._complete_row(cols, datums)
+            # auto increment
+            if auto_col_off is not None:
+                d = row[auto_col_off]
+                if d.is_null or (d.kind in (Kind.INT, Kind.UINT) and d.val == 0):
+                    v = alloc.next()
+                    row[auto_col_off] = Datum(Kind.INT, v)
+                    sess.vars.last_insert_id = v
+                else:
+                    alloc.rebase(int(d.val))
+            handle = self._handle_for(tbl, cols, row, alloc)
+            try:
+                table_rt.add_record(txn, tbl, handle, row)
+            except DuplicateKeyError:
+                if plan.is_replace:
+                    self._replace_conflicts(txn, tbl, cols, row, handle)
+                    table_rt.add_record(txn, tbl, handle, row, skip_check=True)
+                elif plan.ignore:
+                    continue
+                elif plan.on_dup:
+                    self._on_dup_update(txn, tbl, cols, row, handle)
+                    affected += 1
+                    continue
+                else:
+                    raise
+            affected += 1
+        return affected
+
+    def _source_rows(self, cols):
+        plan = self.plan
+        if plan.select_plan is not None:
+            ex = build_executor(self.ctx, plan.select_plan)
+            ex.open()
+            visible = [i for i, sc in enumerate(plan.select_plan.schema.cols)
+                       if not sc.hidden]
+            try:
+                while True:
+                    ch = ex.next()
+                    if ch is None:
+                        break
+                    for i in range(len(ch)):
+                        yield [ch.columns[j].get_datum(i) for j in visible]
+            finally:
+                ex.close()
+        else:
+            for exprs in plan.rows:
+                yield [None if e is None else expr_to_datum(e) for e in exprs]
+
+    def _complete_row(self, cols, src_datums):
+        """Distribute provided datums into full row by plan.col_offsets,
+        filling defaults."""
+        plan = self.plan
+        row = [None] * len(cols)
+        for off, d in zip(plan.col_offsets, src_datums):
+            row[off] = d
+        from ..chunk.column import py_to_datum_fast
+        out = []
+        for i, ci in enumerate(cols):
+            d = row[i]
+            if d is None:
+                if ci.ft.has_default:
+                    d = py_to_datum_fast(ci.ft.default_value, ci.ft) \
+                        if ci.ft.default_value is not None else NULL
+                elif ci.ft.auto_increment:
+                    d = NULL
+                elif ci.ft.not_null:
+                    d = NULL  # checked in add_record unless auto-filled
+                else:
+                    d = NULL
+            out.append(coerce_datum(d, ci.ft))
+        return out
+
+    def _handle_for(self, tbl, cols, row, alloc):
+        if tbl.pk_is_handle:
+            off = next(i for i, c in enumerate(cols)
+                       if c.name.lower() == tbl.pk_col_name.lower())
+            return int(row[off].val)
+        return alloc.next_handle()
+
+    def _find_conflict_handle(self, txn, tbl, cols, row):
+        from ..codec.tablecodec import record_key, index_key
+        if tbl.pk_is_handle:
+            off = next(i for i, c in enumerate(cols)
+                       if c.name.lower() == tbl.pk_col_name.lower())
+            h = int(row[off].val)
+            if txn.get(record_key(tbl.id, h)) is not None:
+                return h
+        for idx in tbl.writable_indexes():
+            if not idx.unique:
+                continue
+            datums = table_rt._index_datums(tbl, idx, row)
+            if any(d.is_null for d in datums):
+                continue
+            v = txn.get(index_key(tbl.id, idx.id, datums))
+            if v is not None:
+                return int(v)
+        return None
+
+    def _load_row(self, txn, tbl, handle):
+        from ..codec.tablecodec import record_key
+        from ..codec.codec import decode_row_value
+        v = txn.get(record_key(tbl.id, handle))
+        return decode_row_value(v) if v is not None else None
+
+    def _replace_conflicts(self, txn, tbl, cols, row, handle):
+        while True:
+            h = self._find_conflict_handle(txn, tbl, cols, row)
+            if h is None:
+                return
+            old = self._load_row(txn, tbl, h)
+            if old is not None:
+                table_rt.remove_record(txn, tbl, h, old)
+
+    def _on_dup_update(self, txn, tbl, cols, row, handle):
+        h = self._find_conflict_handle(txn, tbl, cols, row)
+        if h is None:
+            raise DuplicateKeyError("Duplicate entry")
+        old = self._load_row(txn, tbl, h)
+        new = list(old)
+        for off, expr, schema in self.plan.on_dup:
+            cols_ctx = {}
+            for sc, d in zip(schema.cols, old):
+                v, nf, sd = _datum_to_np(d)
+                cols_ctx[sc.col.idx] = (v, nf, sd)
+            ectx = EvalCtx(np, 1, cols_ctx, host=True)
+            data, nulls, sd = eval_expr(ectx, expr)
+            d = datum_from_value(
+                np.asarray(data).reshape(-1)[0] if not np.isscalar(data) else data,
+                bool(np.asarray(materialize_nulls(ectx, nulls)).reshape(-1)[0]),
+                sd, expr.ft)
+            new[off] = coerce_datum(d, cols[off].ft)
+        table_rt.update_record(txn, tbl, h, old, new)
+
+
+def _datum_to_np(d: Datum):
+    if d.is_null:
+        return np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool), None
+    if d.kind == Kind.FLOAT:
+        return np.full(1, d.val, dtype=np.float64), None, None
+    if d.kind in (Kind.STRING, Kind.BYTES):
+        arr = np.empty(1, dtype=object)
+        arr[0] = d.val if isinstance(d.val, str) else d.val.decode()
+        return arr, None, None
+    return np.full(1, int(d.val), dtype=np.int64), None, None
+
+
+class UpdateExec:
+    def __init__(self, ctx, plan, sess):
+        self.ctx = ctx
+        self.plan = plan
+        self.sess = sess
+
+    def execute(self) -> int:
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.sess
+        txn = sess.txn()
+        ex = build_executor(self.ctx, plan.select_plan)
+        ex.open()
+        chunks = ex.all_chunks()
+        ex.close()
+        cols = tbl.public_columns()
+        schema = plan.select_plan.schema
+        affected = 0
+        alloc = sess.domain.allocator(tbl)
+        for ch in chunks:
+            n = len(ch)
+            ectx = EvalCtx(np, n, bind_chunk(schema, ch), host=True)
+            new_vals = []
+            for off, expr in plan.assignments:
+                data, nulls, sd = eval_expr(ectx, expr)
+                nm = np.asarray(materialize_nulls(ectx, nulls))
+                if np.isscalar(data) or getattr(data, "ndim", 1) == 0:
+                    if isinstance(data, str):
+                        arr = np.empty(n, dtype=object)
+                        arr[:] = data
+                        data = arr
+                    else:
+                        data = np.full(n, data)
+                new_vals.append((off, np.asarray(data), nm, sd, expr.ft))
+            handle_idx = len(schema.cols) - 1
+            for i in range(n):
+                handle = int(ch.columns[handle_idx].data[i])
+                old = [ch.columns[j].get_datum(i) for j in range(len(cols))]
+                new = list(old)
+                changed = False
+                for off, data, nm, sd, eft in new_vals:
+                    d = datum_from_value(data[i], bool(nm[i]), sd, eft)
+                    d = coerce_datum(d, cols[off].ft)
+                    if d.sort_key() != old[off].sort_key() or \
+                            d.is_null != old[off].is_null:
+                        changed = True
+                    new[off] = d
+                if not changed:
+                    continue
+                new_handle = None
+                if tbl.pk_is_handle:
+                    pk_off = next(j for j, c in enumerate(cols)
+                                  if c.name.lower() == tbl.pk_col_name.lower())
+                    nh = int(new[pk_off].val)
+                    if nh != handle:
+                        new_handle = nh
+                table_rt.update_record(txn, tbl, handle, old, new, new_handle)
+                affected += 1
+        return affected
+
+
+class DeleteExec:
+    def __init__(self, ctx, plan, sess):
+        self.ctx = ctx
+        self.plan = plan
+        self.sess = sess
+
+    def execute(self) -> int:
+        plan = self.plan
+        tbl = plan.table_info
+        txn = self.sess.txn()
+        ex = build_executor(self.ctx, plan.select_plan)
+        ex.open()
+        chunks = ex.all_chunks()
+        ex.close()
+        cols = tbl.public_columns()
+        schema = plan.select_plan.schema
+        affected = 0
+        handle_idx = len(schema.cols) - 1
+        for ch in chunks:
+            for i in range(len(ch)):
+                handle = int(ch.columns[handle_idx].data[i])
+                row = [ch.columns[j].get_datum(i) for j in range(len(cols))]
+                table_rt.remove_record(txn, tbl, handle, row)
+                affected += 1
+        return affected

@@ -1,0 +1,186 @@
+"""Executor framework: batch Volcano (reference
+pkg/executor/internal/exec/executor.go:224 Open/Next/Close), pulling host
+Chunks; device work happens inside readers (copr) and will extend to
+operator kernels (ops/)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+from ..expression import EvalCtx, eval_expr
+from ..expression.vec import materialize_nulls
+from ..types.field_type import TypeClass
+from ..types.datum import Datum, Kind, NULL
+from ..errors import QueryKilledError, MemoryQuotaExceededError
+
+
+class ExecContext:
+    def __init__(self, sess):
+        self.sess = sess
+        self.sv = sess.vars
+        self.copr = sess.domain.copr
+        self.killed = False
+        self.warnings = []
+        self.mem_tracker = sess.domain.mem_tracker_factory(
+            self.sv.mem_quota_query)
+
+    def check_killed(self):
+        if self.killed:
+            raise QueryKilledError("Query execution was interrupted")
+
+    def read_ts(self):
+        """Snapshot ts for scans: the session txn's start_ts when inside an
+        explicit transaction; None (read-latest) for autocommit reads."""
+        sess = self.sess
+        txn = getattr(sess, "_txn", None)
+        if txn is not None and not txn.committed and not txn.aborted and \
+                getattr(sess, "_explicit_txn", False):
+            return txn.start_ts
+        return None
+
+
+class Executor:
+    def __init__(self, ctx: ExecContext, schema, children=None):
+        self.ctx = ctx
+        self.schema = schema
+        self.children = children or []
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def open(self):
+        for c in self.children:
+            c.open()
+
+    def next(self) -> Chunk | None:
+        raise NotImplementedError
+
+    def close(self):
+        for c in self.children:
+            c.close()
+
+    def all_chunks(self) -> list:
+        out = []
+        while True:
+            self.ctx.check_killed()
+            ch = self.next()
+            if ch is None:
+                break
+            if len(ch):
+                out.append(ch)
+        return out
+
+
+def bind_chunk(schema, chunk: Chunk) -> dict:
+    """Map plan column unique-ids -> chunk arrays for the evaluator."""
+    cols = {}
+    for sc, col in zip(schema.cols, chunk.columns):
+        cols[sc.col.idx] = (col.data, col.nulls, col.dict)
+    return cols
+
+
+def eval_to_column(ctx_np: EvalCtx, expr, n: int) -> Column:
+    data, nulls, sdict = eval_expr(ctx_np, expr)
+    nm = materialize_nulls(ctx_np, nulls)
+    nm = np.asarray(nm)
+    if np.isscalar(data) or getattr(data, "ndim", 1) == 0:
+        if isinstance(data, str):
+            arr = np.empty(n, dtype=object)
+            arr[:] = data
+            data = arr
+        else:
+            data = np.full(n, data)
+    data = np.asarray(data)
+    if data.dtype == bool:
+        data = data.astype(np.int64)
+    return Column(expr.ft, data, nm if nm.any() else None, sdict)
+
+
+def datum_from_value(v, nullflag, sdict, ft) -> Datum:
+    if nullflag:
+        return NULL
+    if sdict is not None:
+        return Datum(Kind.STRING, sdict.values[int(v)])
+    tc = ft.tclass
+    if tc == TypeClass.FLOAT:
+        return Datum(Kind.FLOAT, float(v))
+    if tc == TypeClass.DECIMAL:
+        return Datum(Kind.DECIMAL, int(v), max(ft.decimal, 0))
+    if tc == TypeClass.DATE:
+        return Datum(Kind.DATE, int(v))
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        return Datum(Kind.DATETIME, int(v))
+    if tc == TypeClass.DURATION:
+        return Datum(Kind.DURATION, int(v))
+    if tc == TypeClass.STRING:
+        return Datum(Kind.STRING, v if isinstance(v, str) else str(v))
+    return Datum(Kind.UINT if ft.unsigned else Kind.INT, int(v))
+
+
+def coerce_datum(d: Datum, ft) -> Datum:
+    """Coerce a Datum into a column's storage representation."""
+    from ..chunk.column import py_to_datum_fast
+    from ..types.decimal import dec_round_scaled
+    if d.is_null:
+        return NULL
+    tc = ft.tclass
+    if tc == TypeClass.DECIMAL:
+        scale = max(ft.decimal, 0)
+        if d.kind == Kind.DECIMAL:
+            if d.scale == scale:
+                return d
+            return Datum(Kind.DECIMAL, dec_round_scaled(d.val, d.scale, scale),
+                         scale)
+        if d.kind in (Kind.INT, Kind.UINT):
+            return Datum(Kind.DECIMAL, d.val * (10 ** scale), scale)
+        if d.kind == Kind.FLOAT:
+            return Datum(Kind.DECIMAL, round(d.val * (10 ** scale)), scale)
+        return py_to_datum_fast(str(d.to_py()), ft)
+    if tc == TypeClass.FLOAT:
+        if d.kind == Kind.FLOAT:
+            return d
+        if d.kind in (Kind.INT, Kind.UINT):
+            return Datum(Kind.FLOAT, float(d.val))
+        if d.kind == Kind.DECIMAL:
+            return Datum(Kind.FLOAT, d.val / 10 ** d.scale)
+        return py_to_datum_fast(str(d.to_py()), ft)
+    if tc in (TypeClass.INT, TypeClass.UINT, TypeClass.BIT):
+        if d.kind in (Kind.INT, Kind.UINT):
+            return d
+        if d.kind == Kind.FLOAT:
+            return Datum(Kind.INT, round(d.val))
+        if d.kind == Kind.DECIMAL:
+            return Datum(Kind.INT, dec_round_scaled(d.val, d.scale, 0))
+        return py_to_datum_fast(str(d.to_py()), ft)
+    if tc in (TypeClass.STRING, TypeClass.JSON):
+        if d.kind in (Kind.STRING, Kind.BYTES):
+            return d
+        return Datum(Kind.STRING, str(d.to_py()))
+    if tc == TypeClass.DATE:
+        if d.kind == Kind.DATE:
+            return d
+        if d.kind in (Kind.DATETIME, Kind.TIMESTAMP):
+            return Datum(Kind.DATE, d.val // 86_400_000_000)
+        return py_to_datum_fast(str(d.to_py()), ft)
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        if d.kind in (Kind.DATETIME, Kind.TIMESTAMP):
+            return d
+        if d.kind == Kind.DATE:
+            return Datum(Kind.DATETIME, d.val * 86_400_000_000)
+        return py_to_datum_fast(str(d.to_py()), ft)
+    return d
+
+
+def expr_to_datum(expr) -> Datum:
+    """Evaluate a row-context expression (constants after folding)."""
+    from ..expression import Constant
+    if isinstance(expr, Constant):
+        return expr.value
+    ctx = EvalCtx(np, 1, {}, host=True)
+    data, nulls, sdict = eval_expr(ctx, expr)
+    return datum_from_value(
+        np.asarray(data).reshape(-1)[0] if not np.isscalar(data) else data,
+        bool(np.asarray(materialize_nulls(ctx, nulls)).reshape(-1)[0]),
+        sdict, expr.ft)

@@ -1,0 +1,64 @@
+"""IMPORT INTO: bulk load into the columnar engine (reference
+lightning/pkg, pkg/executor/import_into.go — the local-backend idea:
+build storage-native artifacts directly, bypassing the row-at-a-time txn
+path). Supports CSV and TPC-H '|'-delimited .tbl files.
+
+Imported tables serve the OLAP path from the columnar store; the row-KV
+side is not populated (flagged on the table) — the same trade TiFlash-only
+tables make.
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from ..types.field_type import TypeClass
+from ..types.time_types import parse_date, parse_datetime
+from ..types.decimal import dec_to_scaled_int
+from ..errors import TiDBError, UnsupportedError
+from ..session.session import ResultSet
+
+
+def exec_import(sess, stmt) -> ResultSet:
+    db = stmt.table.db or sess.vars.current_db
+    tbl = sess.domain.infoschema().table_by_name(db, stmt.table.name)
+    path = stmt.path
+    if not os.path.exists(path):
+        raise TiDBError("file not found: %s", path)
+    delim = stmt.options.get("delimiter")
+    if delim is None:
+        delim = "|" if path.endswith(".tbl") else ","
+    cols = tbl.public_columns()
+    raw = [[] for _ in cols]
+    with open(path, newline="") as f:
+        rd = csv.reader(f, delimiter=delim)
+        for rec in rd:
+            for i in range(len(cols)):
+                raw[i].append(rec[i] if i < len(rec) else "")
+    n = len(raw[0]) if raw else 0
+    columns = {}
+    for ci, vals in zip(cols, raw):
+        columns[ci.name] = convert_text_column(ci.ft, vals)
+    ctab = sess.domain.columnar.table(tbl)
+    ctab.bulk_append(columns, n)
+    return ResultSet(affected=n)
+
+
+def convert_text_column(ft, vals: list):
+    tc = ft.tclass
+    if tc in (TypeClass.STRING, TypeClass.JSON):
+        return np.asarray(vals, dtype=object)
+    if tc == TypeClass.FLOAT:
+        return np.asarray(vals, dtype=np.float64)
+    if tc == TypeClass.DECIMAL:
+        scale = max(ft.decimal, 0)
+        # fast path: float parse + round (exact for money-scale data)
+        f = np.asarray(vals, dtype=np.float64)
+        return np.round(f * (10 ** scale)).astype(np.int64)
+    if tc == TypeClass.DATE:
+        return np.asarray([parse_date(v) for v in vals], dtype=np.int64)
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        return np.asarray([parse_datetime(v) for v in vals], dtype=np.int64)
+    return np.asarray(vals, dtype=np.int64)

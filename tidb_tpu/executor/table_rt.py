@@ -1,0 +1,85 @@
+"""Table write runtime (reference pkg/table/tables/tables.go:742 AddRecord):
+encode row + index KVs into the transaction's memBuffer; unique checks
+against the snapshot + buffer."""
+from __future__ import annotations
+
+from ..codec.tablecodec import record_key, index_key
+from ..codec.codec import encode_row_value
+from ..types.datum import Datum, Kind, NULL
+from ..errors import DuplicateKeyError, BadNullError
+from ..models import SchemaState
+
+TOMBSTONE = object()
+
+
+def _index_datums(tbl, idx, row):
+    name_to_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
+    return [row[name_to_off[c.lower()]] for c in idx.columns]
+
+
+def _handle_bytes(h: int) -> bytes:
+    return str(h).encode()
+
+
+def add_record(txn, tbl, handle: int, row: list, skip_check=False):
+    """row: list of Datums ordered by column offset."""
+    for ci, d in zip(tbl.columns, row):
+        if d.is_null and ci.ft.not_null:
+            raise BadNullError("Column '%s' cannot be null", ci.name)
+    rk = record_key(tbl.id, handle)
+    if not skip_check and txn.get(rk) is not None:
+        raise DuplicateKeyError(
+            "Duplicate entry '%s' for key 'PRIMARY'", handle)
+    for idx in tbl.writable_indexes():
+        datums = _index_datums(tbl, idx, row)
+        if idx.unique and not any(d.is_null for d in datums):
+            ik = index_key(tbl.id, idx.id, datums)
+            if not skip_check and txn.get(ik) is not None:
+                raise DuplicateKeyError(
+                    "Duplicate entry '%s' for key '%s'",
+                    "-".join(str(d.to_py()) for d in datums), idx.name)
+            txn.set(ik, _handle_bytes(handle))
+        else:
+            ik = index_key(tbl.id, idx.id, datums, handle)
+            txn.set(ik, b"")
+    txn.set(rk, encode_row_value(row))
+
+
+def remove_record(txn, tbl, handle: int, row: list):
+    txn.delete(record_key(tbl.id, handle))
+    for idx in tbl.writable_indexes():
+        datums = _index_datums(tbl, idx, row)
+        if idx.unique and not any(d.is_null for d in datums):
+            txn.delete(index_key(tbl.id, idx.id, datums))
+        else:
+            txn.delete(index_key(tbl.id, idx.id, datums, handle))
+
+
+def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
+                  new_handle: int | None = None):
+    if new_handle is not None and new_handle != handle:
+        remove_record(txn, tbl, handle, old_row)
+        add_record(txn, tbl, new_handle, new_row)
+        return
+    for ci, d in zip(tbl.columns, new_row):
+        if d.is_null and ci.ft.not_null:
+            raise BadNullError("Column '%s' cannot be null", ci.name)
+    for idx in tbl.writable_indexes():
+        od = _index_datums(tbl, idx, old_row)
+        nd = _index_datums(tbl, idx, new_row)
+        if [d.sort_key() for d in od] == [d.sort_key() for d in nd]:
+            continue
+        if idx.unique and not any(d.is_null for d in od):
+            txn.delete(index_key(tbl.id, idx.id, od))
+        elif not idx.unique:
+            txn.delete(index_key(tbl.id, idx.id, od, handle))
+        if idx.unique and not any(d.is_null for d in nd):
+            ik = index_key(tbl.id, idx.id, nd)
+            if txn.get(ik) is not None:
+                raise DuplicateKeyError(
+                    "Duplicate entry '%s' for key '%s'",
+                    "-".join(str(d.to_py()) for d in nd), idx.name)
+            txn.set(ik, _handle_bytes(handle))
+        else:
+            txn.set(index_key(tbl.id, idx.id, nd, handle), b"")
+    txn.set(record_key(tbl.id, handle), encode_row_value(new_row))

@@ -1,0 +1,184 @@
+"""MPP execution: plan fragments as SPMD programs over a device mesh.
+
+Reference mapping (SURVEY.md §3.3): a TiFlash MPP plan is a tree of
+Fragments split at Exchange operators (physicalop/fragment.go:49); exchange
+types PassThrough / Broadcast / Hash (fragment.go:78). TPU-native redesign:
+
+  * one pjit/shard_map program per fragment chain — the exchange between
+    fragments is not a network stream but an XLA collective on ICI:
+      - Hash exchange + small group domain  -> dense partial tables + psum
+        (allreduce replaces shuffle entirely; every device ends with the
+        global aggregate — far cheaper than a software shuffle on TPU)
+      - Hash exchange, large domain         -> all_to_all by key hash
+      - Broadcast exchange                  -> all_gather of the build side
+  * fragments never materialize between operators: scan -> filter -> agg
+    fuse into one XLA kernel per shard.
+
+These building blocks execute the same partial-agg layout the single-chip
+copr produces, so the session layer can route a CoprDAG to a mesh without
+changing the final-merge code.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..utils import jaxcfg  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..expression import EvalCtx, eval_expr, eval_bool_mask
+from ..expression.vec import materialize_nulls
+
+
+def _local_ctx(cols, n):
+    return EvalCtx(jnp, n, cols, host=False)
+
+
+def mpp_global_sum(mesh: Mesh, cols_sharded: dict, sdicts: dict,
+                   filters: list, sum_exprs: list, axis: str = "dp"):
+    """Fragment: sharded scan -> fused filter -> local masked sums -> psum.
+    Returns (sums per expr, count) replicated on every device."""
+
+    def frag(*arrays):
+        names, vals = arrays[0], arrays[1:]
+        local_n = vals[0].shape[0]
+        cols = {}
+        i = 0
+        for k in names_static:
+            data = vals[i]
+            nulls = vals[i + 1] if has_nulls[k] else None
+            i += 2 if has_nulls[k] else 1
+            cols[k] = (data, nulls, sdicts.get(k))
+        valid = vals[-1]
+        ctx = _local_ctx(cols, local_n)
+        mask = valid
+        for f in filters:
+            mask = mask & eval_bool_mask(ctx, f)
+        outs = []
+        for e in sum_exprs:
+            d, nl, _ = eval_expr(ctx, e)
+            nm = materialize_nulls(ctx, nl)
+            ok = mask & ~nm
+            outs.append(jax.lax.psum(jnp.sum(jnp.where(ok, d, 0)), axis))
+        cnt = jax.lax.psum(jnp.sum(mask.astype(jnp.int64)), axis)
+        return tuple(outs) + (cnt,)
+
+    # flatten cols into positional args for shard_map
+    names_static = sorted(cols_sharded.keys())
+    has_nulls = {k: cols_sharded[k][1] is not None for k in names_static}
+    args = []
+    in_specs = []
+    for k in names_static:
+        data, nulls = cols_sharded[k][0], cols_sharded[k][1]
+        args.append(data)
+        in_specs.append(P(axis))
+        if nulls is not None:
+            args.append(nulls)
+            in_specs.append(P(axis))
+    valid = cols_sharded[names_static[0]][2]
+    args.append(valid)
+    in_specs.append(P(axis))
+
+    fn = shard_map(lambda *a: frag(names_static, *a), mesh=mesh,
+                   in_specs=tuple(in_specs),
+                   out_specs=tuple(P() for _ in range(len(sum_exprs) + 1)),
+                   check_rep=False)
+    return jax.jit(fn)(*args)
+
+
+def mpp_filter_agg(mesh: Mesh, key_arr, val_arr, valid, n_groups: int,
+                   axis: str = "dp"):
+    """Fragment: sharded grouped aggregation over a SMALL group domain.
+    Hash exchange replaced by dense partial tables + psum: each device
+    scatter-adds into its local [n_groups] table, one allreduce merges.
+    Returns (sums[n_groups], counts[n_groups]) replicated."""
+
+    def frag(keys, vals, ok):
+        seg = jnp.clip(keys, 0, n_groups - 1)
+        sums = jax.ops.segment_sum(jnp.where(ok, vals, 0), seg,
+                                   num_segments=n_groups)
+        cnts = jax.ops.segment_sum(ok.astype(jnp.int64), seg,
+                                   num_segments=n_groups)
+        return jax.lax.psum(sums, axis), jax.lax.psum(cnts, axis)
+
+    fn = shard_map(frag, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis)),
+                   out_specs=(P(), P()), check_rep=False)
+    return jax.jit(fn)(key_arr, val_arr, valid)
+
+
+def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
+                         build_keys, build_payload, build_valid,
+                         n_groups: int, axis: str = "dp"):
+    """Fragment pair with a HASH exchange: both sides all_to_all'd by
+    key % n_devices so matching keys land on the same device, then a local
+    sort-merge join feeds a grouped aggregation on the build payload,
+    merged with psum. This is the TiFlash shuffle-join fragment
+    (ExchangeType_Hash) as XLA collectives.
+
+    Local shapes are static: each device keeps ceil(n/ndev) slots per peer
+    (padding with invalid rows), the all_to_all is a single ICI collective.
+    Returns (sums[n_groups], counts[n_groups]) replicated."""
+    ndev = mesh.devices.size
+
+    def exchange(keys, vals, ok):
+        """Route rows to device (key % ndev) via one all_to_all."""
+        local_n = keys.shape[0]
+        cap = local_n  # per-peer slot budget
+        dest = (keys % ndev).astype(jnp.int32)
+        dest = jnp.where(ok, dest, ndev)        # invalid -> dropped bucket
+        # stable sort rows by destination, slot i*cap..(i+1)*cap per peer
+        order = jnp.argsort(dest, stable=True)
+        skeys, svals, sok, sdest = (keys[order], vals[order], ok[order],
+                                    dest[order])
+        # position within destination bucket
+        onehot = (sdest[:, None] == jnp.arange(ndev + 1)[None, :])
+        pos_in_bucket = jnp.cumsum(onehot, axis=0)[jnp.arange(local_n),
+                                                   sdest] - 1
+        slot = jnp.where(sdest < ndev, pos_in_bucket, cap)
+        keep = (slot < cap) & sok
+        # scatter into [ndev, cap] frames
+        fk = jnp.zeros((ndev, cap), dtype=keys.dtype)
+        fv = jnp.zeros((ndev, cap), dtype=vals.dtype)
+        fo = jnp.zeros((ndev, cap), dtype=bool)
+        didx = jnp.where(keep, sdest, 0)
+        sidx = jnp.where(keep, slot, 0)
+        fk = fk.at[didx, sidx].set(jnp.where(keep, skeys, 0))
+        fv = fv.at[didx, sidx].set(jnp.where(keep, svals, 0))
+        fo = fo.at[didx, sidx].max(keep)
+        # one collective: swap frames so device d receives bucket d of all
+        fk = jax.lax.all_to_all(fk, axis, 0, 0, tiled=False)
+        fv = jax.lax.all_to_all(fv, axis, 0, 0, tiled=False)
+        fo = jax.lax.all_to_all(fo, axis, 0, 0, tiled=False)
+        return fk.reshape(-1), fv.reshape(-1), fo.reshape(-1)
+
+    def frag(pk, pv, pok, bk, bp, bok):
+        pk2, pv2, pok2 = exchange(pk, pv, pok)
+        bk2, bp2, bok2 = exchange(bk, bp, bok)
+        # local sort-merge equi-join: probe rows find matching build rows
+        border = jnp.argsort(jnp.where(bok2, bk2, jnp.iinfo(jnp.int64).max),
+                             stable=True)
+        sbk = jnp.where(bok2, bk2, jnp.iinfo(jnp.int64).max)[border]
+        sbp = bp2[border]
+        idx = jnp.searchsorted(sbk, pk2)
+        idx = jnp.clip(idx, 0, sbk.shape[0] - 1)
+        matched = pok2 & (sbk[idx] == pk2)
+        payload = sbp[idx]
+        # grouped agg on build payload (e.g. nation of matched supplier)
+        seg = jnp.clip(payload, 0, n_groups - 1)
+        sums = jax.ops.segment_sum(jnp.where(matched, pv2, 0), seg,
+                                   num_segments=n_groups)
+        cnts = jax.ops.segment_sum(matched.astype(jnp.int64), seg,
+                                   num_segments=n_groups)
+        return jax.lax.psum(sums, axis), jax.lax.psum(cnts, axis)
+
+    fn = shard_map(frag, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis),
+                             P(axis), P(axis), P(axis)),
+                   out_specs=(P(), P()), check_rep=False)
+    return jax.jit(fn)(probe_keys, probe_vals, probe_valid,
+                       build_keys, build_payload, build_valid)

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, shard_rows, replicate
+
+__all__ = ["make_mesh", "shard_rows", "replicate"]

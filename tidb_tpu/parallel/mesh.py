@@ -1,0 +1,33 @@
+"""Device mesh + sharding helpers (reference analog: the MPP task/store
+topology — pkg/kv/mpp.go task placement — re-expressed as a
+jax.sharding.Mesh; exchanges become XLA collectives over ICI/DCN)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import jaxcfg  # noqa: F401
+import jax
+from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_rows(mesh: Mesh, arr, axis: str = "dp"):
+    """Place a host array row-sharded across the mesh (pads to divisor)."""
+    import jax.numpy as jnp
+    n = len(mesh.devices.flat)
+    rows = arr.shape[0]
+    pad = (-rows) % n
+    if pad:
+        arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:],
+                                            dtype=arr.dtype)])
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def replicate(mesh: Mesh, arr):
+    return jax.device_put(np.asarray(arr), NamedSharding(mesh, P()))

@@ -1,0 +1,8 @@
+from .optimize import optimize, PlanContext
+from .logical import (LogicalPlan, DataSource, Selection, Projection,
+                      Aggregation, LJoin, Sort, LimitOp, Dual, UnionOp)
+from . import physical
+
+__all__ = ["optimize", "PlanContext", "LogicalPlan", "DataSource",
+           "Selection", "Projection", "Aggregation", "LJoin", "Sort",
+           "LimitOp", "Dual", "UnionOp", "physical"]

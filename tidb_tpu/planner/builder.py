@@ -1,0 +1,611 @@
+"""Logical plan builder: AST statement -> logical plan
+(reference pkg/planner/core/logical_plan_builder.go)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..parser import ast
+from ..expression import (Expression, Column, Constant, ScalarFunc, AggDesc,
+                          const_from_py)
+from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
+                                new_decimal_type, new_string_type,
+                                agg_field_type)
+from ..errors import (UnsupportedError, NoDatabaseSelectedError,
+                      ColumnNotExistsError, NonUniqTableError,
+                      MixOfGroupFuncAndFieldsError)
+from .schema import Schema, SchemaCol
+from .logical import (LogicalPlan, DataSource, Selection, Projection,
+                      Aggregation, LJoin, Sort, LimitOp, Dual, UnionOp)
+from .rewriter import Rewriter
+
+
+def split_conjuncts(e: Expression) -> list:
+    if isinstance(e, ScalarFunc) and e.op == "and":
+        return split_conjuncts(e.args[0]) + split_conjuncts(e.args[1])
+    return [e]
+
+
+def agg_result_ft(name: str, args, distinct):
+    if name == "count":
+        return new_bigint_type(not_null=True)
+    if not args:
+        return new_double_type()
+    aft = args[0].ft
+    if name == "sum":
+        if aft.tclass == TypeClass.DECIMAL:
+            return new_decimal_type(38, max(aft.decimal, 0))
+        if aft.tclass in (TypeClass.FLOAT, TypeClass.STRING):
+            return new_double_type()
+        return new_decimal_type(38, 0)
+    if name == "avg":
+        if aft.tclass == TypeClass.DECIMAL:
+            return new_decimal_type(38, min(max(aft.decimal, 0) + 4, 18))
+        if aft.tclass == TypeClass.INT or aft.tclass == TypeClass.UINT:
+            return new_decimal_type(38, 4)
+        return new_double_type()
+    if name in ("min", "max", "first_row", "any_value"):
+        return aft.clone()
+    if name == "group_concat":
+        return new_string_type()
+    if name in ("bit_and", "bit_or", "bit_xor"):
+        return new_bigint_type(unsigned=True)
+    if name in ("std", "stddev", "stddev_pop", "var_pop", "variance"):
+        return new_double_type()
+    return new_double_type()
+
+
+@dataclass
+class InsertPlan:
+    table_info: object = None
+    db_name: str = ""
+    col_offsets: list = field(default_factory=list)  # target column offsets
+    rows: list = field(default_factory=list)         # rows of Expressions
+    select_plan: object = None
+    is_replace: bool = False
+    ignore: bool = False
+    on_dup: list = field(default_factory=list)       # [(offset, Expression, sel_schema)]
+
+
+@dataclass
+class UpdatePlan:
+    table_info: object = None
+    db_name: str = ""
+    select_plan: object = None      # outputs all cols + handle (last)
+    assignments: list = field(default_factory=list)  # [(col_offset, Expression)]
+
+
+@dataclass
+class DeletePlan:
+    table_info: object = None
+    db_name: str = ""
+    select_plan: object = None      # outputs handle (last col)
+
+
+class PlanBuilder:
+    def __init__(self, pctx):
+        self.pctx = pctx
+
+    # ---- helpers ------------------------------------------------------
+    def _new_col(self, ft, name="") -> Column:
+        return Column(idx=self.pctx.alloc_id(), ft=ft, name=name)
+
+    def _resolve_db(self, db: str) -> str:
+        if db:
+            return db
+        if not self.pctx.current_db:
+            raise NoDatabaseSelectedError("No database selected")
+        return self.pctx.current_db
+
+    def _rewriter(self, schema, agg_mapper=None):
+        return Rewriter(self.pctx, schema, agg_mapper)
+
+    # ---- FROM ---------------------------------------------------------
+    def build_datasource(self, tn: ast.TableName) -> DataSource:
+        db = self._resolve_db(tn.db)
+        tbl = self.pctx.infoschema.table_by_name(db, tn.name)
+        alias = tn.alias or tn.name
+        schema = Schema()
+        for ci in tbl.public_columns():
+            col = self._new_col(ci.ft, f"{alias}.{ci.name}")
+            schema.append(SchemaCol(col, ci.name, alias, db))
+        handle_ft = new_bigint_type(not_null=True)
+        handle_col = self._new_col(handle_ft, f"{alias}._tidb_rowid")
+        schema.append(SchemaCol(handle_col, "_tidb_rowid", alias, db,
+                                hidden=True))
+        ds = DataSource(tbl, db, alias, schema, handle_col)
+        ds.stats_rows = max(float(self.pctx.table_rows(db, tbl)), 1.0)
+        return ds
+
+    def build_from(self, node) -> LogicalPlan:
+        if node is None:
+            return Dual()
+        if isinstance(node, ast.TableName):
+            return self.build_datasource(node)
+        if isinstance(node, ast.SubqueryTable):
+            sub = self.build_select(node.select)
+            alias = node.alias or "subquery"
+            schema = Schema()
+            for sc in sub.schema.visible():
+                schema.append(SchemaCol(sc.col, sc.name, alias))
+            sub = ProjShell(sub, schema)
+            return sub
+        if isinstance(node, ast.Join):
+            return self.build_join(node)
+        raise UnsupportedError("unsupported FROM clause %s", type(node).__name__)
+
+    def build_join(self, node: ast.Join) -> LogicalPlan:
+        left = self.build_from(node.left)
+        right = self.build_from(node.right)
+        # duplicate table alias check
+        lnames = {c.table for c in left.schema.cols if c.table}
+        rnames = {c.table for c in right.schema.cols if c.table}
+        dup = lnames & rnames
+        if dup:
+            raise NonUniqTableError("Not unique table/alias: '%s'", dup.pop())
+        schema = Schema(list(left.schema.cols) + list(right.schema.cols))
+        jt = node.join_type if node.join_type != "cross" else "inner"
+        join = LJoin(jt, left, right, schema)
+        join.stats_rows = max(left.stats_rows, right.stats_rows)
+        conds = []
+        if node.using:
+            for name in node.using:
+                lc = left.schema.resolve(name)
+                rc = right.schema.resolve(name)
+                join.eq_conds.append((lc.col, rc.col))
+                for c in schema.cols:
+                    if c is rc:
+                        c.hidden = True
+        if node.on is not None:
+            rw = self._rewriter(schema)
+            cond = rw.rewrite(node.on)
+            conds = split_conjuncts(cond)
+        left_ids = {c.col.idx for c in left.schema.cols}
+        right_ids = {c.col.idx for c in right.schema.cols}
+        for c in conds:
+            if isinstance(c, ScalarFunc) and c.op == "=" and \
+                    isinstance(c.args[0], Column) and isinstance(c.args[1], Column):
+                a, b = c.args
+                if a.idx in left_ids and b.idx in right_ids:
+                    join.eq_conds.append((a, b))
+                    continue
+                if b.idx in left_ids and a.idx in right_ids:
+                    join.eq_conds.append((b, a))
+                    continue
+            join.other_conds.append(c)
+        return join
+
+    # ---- SELECT -------------------------------------------------------
+    def build_select(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        if stmt.setops:
+            return self.build_setops(stmt)
+        p = self.build_from(stmt.from_clause)
+
+        # WHERE
+        if stmt.where is not None:
+            rw = self._rewriter(p.schema)
+            conds = split_conjuncts(rw.rewrite(stmt.where))
+            p = Selection(conds, p)
+            p.stats_rows = p.child.stats_rows * (0.25 ** min(len(conds), 3))
+
+        # aggregation detection
+        has_agg = bool(stmt.group_by) or _stmt_has_agg(stmt)
+
+        agg_map = {}        # fingerprint -> Column (agg outputs / group exprs)
+        agg_out_ids = set() # Column ids produced by the aggregation
+        aggs: list[AggDesc] = []
+        agg_schema = None
+        group_exprs = []
+
+        child_schema = p.schema
+
+        def agg_mapper(node: ast.AggFunc):
+            rw_inner = self._rewriter(child_schema)
+            args = [rw_inner.rewrite(a) for a in node.args
+                    if not isinstance(a, ast.Wildcard)]
+            name = node.name
+            if name == "count" and not args:
+                args = []
+            desc = AggDesc(name=name, args=args, distinct=node.distinct)
+            desc.ft = agg_result_ft(name, args, node.distinct)
+            fp = desc.fingerprint()
+            if fp in agg_map:
+                return agg_map[fp]
+            col = self._new_col(desc.ft, repr(desc))
+            aggs.append(desc)
+            agg_map[fp] = col
+            agg_out_ids.add(col.idx)
+            agg_schema.append(SchemaCol(col, repr(desc)))
+            return col
+
+        if has_agg:
+            agg_schema = Schema()
+            rw = self._rewriter(child_schema)
+            # group items first: bare columns keep identity
+            alias_lookup = {}
+            for i, f in enumerate(stmt.fields):
+                if isinstance(f, ast.SelectField) and f.alias:
+                    alias_lookup[f.alias.lower()] = f.expr
+            for g in stmt.group_by:
+                gexpr = g
+                if isinstance(g, ast.Literal) and isinstance(g.value, int):
+                    idx = g.value - 1
+                    if 0 <= idx < len(stmt.fields) and \
+                            isinstance(stmt.fields[idx], ast.SelectField):
+                        gexpr = stmt.fields[idx].expr
+                elif isinstance(g, ast.ColumnRef) and not g.table and \
+                        g.name.lower() in alias_lookup and \
+                        child_schema.try_resolve(g.name) is None:
+                    gexpr = alias_lookup[g.name.lower()]
+                e = rw.rewrite(gexpr)
+                group_exprs.append(e)
+                if isinstance(e, Column):
+                    sc = None
+                    for c in child_schema.cols:
+                        if c.col.idx == e.idx:
+                            sc = c
+                            break
+                    agg_schema.append(SchemaCol(e, sc.name if sc else e.name,
+                                                sc.table if sc else ""))
+                    agg_map[e.fingerprint()] = e
+                else:
+                    col = self._new_col(e.ft, repr(e))
+                    agg_schema.append(SchemaCol(col, repr(e)))
+                    agg_map[e.fingerprint()] = col
+                    agg_out_ids.add(col.idx)
+        # build projection expressions
+        proj_exprs = []
+        proj_schema = Schema()
+        rw_top_schema = child_schema
+
+        def subst_agg(e: Expression) -> Expression:
+            """Map post-agg expressions onto agg outputs; non-grouped bare
+            columns become first_row aggregates (MySQL loose group-by)."""
+            fp = e.fingerprint()
+            if fp in agg_map:
+                return agg_map[fp]
+            if isinstance(e, Column):
+                if e.idx in agg_out_ids:
+                    return e
+                desc = AggDesc(name="first_row", args=[e], ft=e.ft.clone())
+                dfp = desc.fingerprint()
+                if dfp in agg_map:
+                    return agg_map[dfp]
+                col = self._new_col(desc.ft, e.name)
+                aggs.append(desc)
+                agg_map[dfp] = col
+                agg_schema.append(SchemaCol(col, e.name))
+                return col
+            if isinstance(e, ScalarFunc):
+                e.args = [subst_agg(a) for a in e.args]
+                return e
+            return e
+
+        fields = self._expand_wildcards(stmt.fields, child_schema)
+        for f in fields:
+            rw = self._rewriter(child_schema, agg_mapper if has_agg else None)
+            e = rw.rewrite(f.expr)
+            if has_agg:
+                e = subst_agg(e)
+            name = f.alias or _auto_name(f)
+            proj_exprs.append(e)
+            proj_schema.append(SchemaCol(self._new_col(e.ft, name), name))
+
+        if has_agg:
+            p = Aggregation(group_exprs, aggs, agg_schema, p)
+            ngroups = max(float(len(group_exprs)) * 100.0, 1.0)
+            p.stats_rows = min(p.child.stats_rows, ngroups)
+            # HAVING
+            if stmt.having is not None:
+                rw = self._rewriter(agg_schema, agg_mapper)
+                h = rw.rewrite(stmt.having)
+                h = subst_agg(h)
+                p = Selection(split_conjuncts(h), p)
+        elif stmt.having is not None:
+            rw = self._rewriter(child_schema)
+            p = Selection(split_conjuncts(rw.rewrite(stmt.having)), p)
+
+        # ORDER BY: resolve against aliases, then agg outputs, then child
+        sort_items = []
+        extra_exprs = []
+        if stmt.order_by:
+            alias_to_pos = {}
+            for i, sc in enumerate(proj_schema.cols):
+                alias_to_pos.setdefault(sc.name, i)
+            for item in stmt.order_by:
+                oexpr = item.expr
+                resolved = None
+                if isinstance(oexpr, ast.Literal) and isinstance(oexpr.value, int):
+                    pos = oexpr.value - 1
+                    if not (0 <= pos < len(proj_exprs)):
+                        raise ColumnNotExistsError("Unknown column '%d' in "
+                                                   "'order clause'", oexpr.value)
+                    resolved = ("pos", pos)
+                elif isinstance(oexpr, ast.ColumnRef) and not oexpr.table and \
+                        oexpr.name.lower() in alias_to_pos:
+                    resolved = ("pos", alias_to_pos[oexpr.name.lower()])
+                else:
+                    scope = p.schema
+                    rw = self._rewriter(scope, agg_mapper if has_agg else None)
+                    try:
+                        e = rw.rewrite(oexpr)
+                        if has_agg:
+                            e = subst_agg(e)
+                        resolved = ("expr", e)
+                    except ColumnNotExistsError:
+                        # maybe references projection output by expr text
+                        rw2 = self._rewriter(proj_schema)
+                        e = rw2.rewrite(oexpr)
+                        resolved = ("proj", e)
+                sort_items.append((resolved, item.desc))
+
+        # DISTINCT: aggregate over projection outputs
+        proj = Projection(proj_exprs, proj_schema, p)
+        proj.stats_rows = p.stats_rows
+        result: LogicalPlan = proj
+
+        if stmt.distinct:
+            dag_schema = Schema([SchemaCol(sc.col, sc.name, sc.table)
+                                 for sc in proj_schema.cols])
+            result = Aggregation(list(proj_schema.columns()), [], dag_schema,
+                                 result)
+            result.stats_rows = proj.stats_rows * 0.5
+
+        if sort_items:
+            items = []
+            for (kind, v), desc in sort_items:
+                if kind == "pos":
+                    items.append((proj_schema.cols[v].col, desc))
+                elif kind == "proj":
+                    items.append((v, desc))
+                else:
+                    # underlying expr: extend projection so sort sees it
+                    e = v
+                    if isinstance(e, Column) and \
+                            proj_schema.find_idx_by_id(e.idx) >= 0:
+                        items.append((e, desc))
+                    else:
+                        col = self._new_col(e.ft, repr(e))
+                        proj.exprs.append(e)
+                        proj.schema.append(SchemaCol(col, repr(e), hidden=True))
+                        items.append((col, desc))
+            result = Sort(items, result)
+            result.stats_rows = result.child.stats_rows
+
+        if stmt.limit is not None:
+            offset = _limit_value(stmt.limit.offset, 0)
+            count = _limit_value(stmt.limit.count, -1)
+            result = LimitOp(offset, count, result)
+            result.stats_rows = min(result.child.stats_rows,
+                                    float(count if count >= 0 else 1e18))
+        return result
+
+    def _expand_wildcards(self, fields, schema: Schema):
+        out = []
+        for f in fields:
+            if isinstance(f, ast.Wildcard):
+                matched = False
+                for sc in schema.visible():
+                    if f.table and sc.table != f.table.lower():
+                        continue
+                    matched = True
+                    out.append(ast.SelectField(
+                        expr=ast.ColumnRef(name=sc.name, table=sc.table),
+                        alias=sc.name, text=sc.name))
+                if not matched and f.table:
+                    raise ColumnNotExistsError("Unknown table '%s'", f.table)
+            else:
+                out.append(f)
+        return out
+
+    def build_setops(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        base = ast.SelectStmt(**{k: getattr(stmt, k) for k in
+                                 ("fields", "distinct", "from_clause", "where",
+                                  "group_by", "having")})
+        children = [self.build_select(base)]
+        all_flags = []
+        for op, rhs in stmt.setops:
+            if op not in ("union", "union all"):
+                raise UnsupportedError("%s is not supported yet", op.upper())
+            children.append(self.build_select(rhs))
+            all_flags.append(op == "union all")
+        width = len(children[0].schema.visible())
+        for c in children[1:]:
+            if len(c.schema.visible()) != width:
+                from ..errors import TiDBError
+                raise TiDBError("The used SELECT statements have a different "
+                                "number of columns")
+        schema = Schema()
+        for i, sc in enumerate(children[0].schema.visible()):
+            fts = [c.schema.visible()[i].col.ft for c in children]
+            ft = agg_field_type(fts)
+            schema.append(SchemaCol(self._new_col(ft, sc.name), sc.name))
+        merged = UnionOp(children, schema, all=all(all_flags))
+        merged.stats_rows = sum(c.stats_rows for c in children)
+        result = merged
+        if not all(all_flags):
+            dschema = Schema([SchemaCol(sc.col, sc.name) for sc in schema.cols])
+            result = Aggregation(list(schema.columns()), [], dschema, merged)
+        # outer ORDER BY / LIMIT
+        if stmt.order_by or stmt.limit:
+            sel = ast.SelectStmt(fields=[ast.Wildcard()],
+                                 order_by=stmt.order_by, limit=stmt.limit)
+            pos = {sc.name: i for i, sc in enumerate(schema.cols)}
+            items = []
+            for item in (stmt.order_by or []):
+                oe = item.expr
+                if isinstance(oe, ast.Literal) and isinstance(oe.value, int):
+                    items.append((schema.cols[oe.value - 1].col, item.desc))
+                elif isinstance(oe, ast.ColumnRef) and oe.name.lower() in pos:
+                    items.append((schema.cols[pos[oe.name.lower()]].col,
+                                  item.desc))
+                else:
+                    raise UnsupportedError("ORDER BY after UNION must "
+                                           "reference output columns")
+            if items:
+                result = Sort(items, result)
+            if stmt.limit is not None:
+                result = LimitOp(_limit_value(stmt.limit.offset, 0),
+                                 _limit_value(stmt.limit.count, -1), result)
+        return result
+
+    # ---- DML ----------------------------------------------------------
+    def build_insert(self, stmt: ast.InsertStmt) -> InsertPlan:
+        db = self._resolve_db(stmt.table.db)
+        tbl = self.pctx.infoschema.table_by_name(db, stmt.table.name)
+        cols = tbl.public_columns()
+        if stmt.columns:
+            name_to_off = {c.name.lower(): i for i, c in enumerate(cols)}
+            offsets = []
+            for cn in stmt.columns:
+                if cn.lower() not in name_to_off:
+                    raise ColumnNotExistsError("Unknown column '%s'", cn)
+                offsets.append(name_to_off[cn.lower()])
+        else:
+            offsets = list(range(len(cols)))
+        plan = InsertPlan(table_info=tbl, db_name=db, col_offsets=offsets,
+                          is_replace=stmt.is_replace, ignore=stmt.ignore)
+        if stmt.select is not None:
+            plan.select_plan = self.build_select(stmt.select)
+        else:
+            rw = self._rewriter(Schema())
+            from ..errors import WrongValueCountError
+            for row in stmt.values:
+                if len(row) != len(offsets):
+                    raise WrongValueCountError(
+                        "Column count doesn't match value count")
+                exprs = []
+                for e in row:
+                    if isinstance(e, ast.DefaultExpr):
+                        exprs.append(None)     # use column default
+                    else:
+                        exprs.append(rw.rewrite(e))
+                plan.rows.append(exprs)
+        if stmt.on_duplicate:
+            # assignments eval against current row schema
+            schema = Schema()
+            for i, ci in enumerate(cols):
+                schema.append(SchemaCol(self._new_col(ci.ft, ci.name),
+                                        ci.name, tbl.name, db))
+            rw = self._rewriter(schema)
+            for colref, e in stmt.on_duplicate:
+                off = next(i for i, c in enumerate(cols)
+                           if c.name.lower() == colref.name.lower())
+                # VALUES(col) unsupported for now
+                plan.on_dup.append((off, rw.rewrite(e), schema))
+        return plan
+
+    def _build_write_source(self, table_refs, where, order_by, limit,
+                            for_update=True):
+        if not isinstance(table_refs, ast.TableName):
+            raise UnsupportedError("multi-table DML is not supported yet")
+        ds = self.build_datasource(table_refs)
+        p: LogicalPlan = ds
+        if where is not None:
+            rw = self._rewriter(p.schema)
+            p = Selection(split_conjuncts(rw.rewrite(where)), p)
+        if order_by:
+            rw = self._rewriter(p.schema)
+            items = [(rw.rewrite(i.expr), i.desc) for i in order_by]
+            p = Sort(items, p)
+        if limit is not None:
+            p = LimitOp(_limit_value(limit.offset, 0),
+                        _limit_value(limit.count, -1), p)
+        return ds, p
+
+    def build_update(self, stmt: ast.UpdateStmt) -> UpdatePlan:
+        ds, p = self._build_write_source(stmt.table_refs, stmt.where,
+                                         stmt.order_by, stmt.limit)
+        tbl = ds.table_info
+        cols = tbl.public_columns()
+        plan = UpdatePlan(table_info=tbl, db_name=ds.db_name, select_plan=p)
+        rw = self._rewriter(ds.schema)
+        for colref, e in stmt.assignments:
+            off = None
+            for i, c in enumerate(cols):
+                if c.name.lower() == colref.name.lower():
+                    off = i
+                    break
+            if off is None:
+                raise ColumnNotExistsError("Unknown column '%s'", colref.name)
+            plan.assignments.append((off, rw.rewrite(e)))
+        return plan
+
+    def build_delete(self, stmt: ast.DeleteStmt) -> DeletePlan:
+        ds, p = self._build_write_source(stmt.table_refs, stmt.where,
+                                         stmt.order_by, stmt.limit)
+        return DeletePlan(table_info=ds.table_info, db_name=ds.db_name,
+                          select_plan=p)
+
+
+class ProjShell(LogicalPlan):
+    """Renaming shell for subquery-in-FROM (no computation)."""
+
+    def __init__(self, child, schema):
+        super().__init__([child], schema)
+        self.stats_rows = child.stats_rows
+
+
+def _auto_name(f: ast.SelectField) -> str:
+    if isinstance(f.expr, ast.ColumnRef):
+        return f.expr.name
+    return f.text or "expr"
+
+
+def _limit_value(e, default):
+    if e is None:
+        return default
+    if isinstance(e, ast.Literal) and isinstance(e.value, int):
+        return e.value
+    raise UnsupportedError("non-constant LIMIT")
+
+
+def _stmt_has_agg(stmt: ast.SelectStmt) -> bool:
+    found = [False]
+
+    def walk(n):
+        if found[0] or n is None:
+            return
+        if isinstance(n, ast.AggFunc):
+            found[0] = True
+            return
+        if isinstance(n, (ast.SelectStmt,)):
+            return   # don't descend into subqueries
+        if isinstance(n, ast.SelectField):
+            walk(n.expr)
+        elif isinstance(n, ast.BinaryOp):
+            walk(n.left)
+            walk(n.right)
+        elif isinstance(n, ast.UnaryOp):
+            walk(n.operand)
+        elif isinstance(n, ast.FuncCall):
+            for a in n.args:
+                walk(a)
+        elif isinstance(n, ast.Case):
+            walk(n.operand)
+            for c, r in n.when_clauses:
+                walk(c)
+                walk(r)
+            walk(n.else_clause)
+        elif isinstance(n, ast.Cast):
+            walk(n.expr)
+        elif isinstance(n, (ast.Between,)):
+            walk(n.expr)
+            walk(n.low)
+            walk(n.high)
+        elif isinstance(n, ast.InList):
+            walk(n.expr)
+            for i in n.items:
+                walk(i)
+        elif isinstance(n, (ast.IsNull, ast.IsTruth)):
+            walk(n.expr)
+        elif isinstance(n, ast.Like):
+            walk(n.expr)
+        elif isinstance(n, ast.OrderItem):
+            walk(n.expr)
+
+    for f in stmt.fields:
+        walk(f)
+    walk(stmt.having)
+    for o in stmt.order_by or []:
+        walk(o)
+    return found[0]

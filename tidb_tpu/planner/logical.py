@@ -1,0 +1,134 @@
+"""Logical plan operators (reference pkg/planner/core/operator/logicalop)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .schema import Schema, SchemaCol
+from ..expression import Expression, AggDesc, Column
+
+
+class LogicalPlan:
+    def __init__(self, children=None, schema: Schema | None = None):
+        self.children = children or []
+        self.schema = schema or Schema()
+        self.stats_rows = 1000.0   # estimated output rows
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def name(self):
+        return type(self).__name__
+
+    def explain_info(self):
+        return ""
+
+    def tree_str(self, indent=0):
+        s = "  " * indent + f"{self.name()} {self.explain_info()}".rstrip() + "\n"
+        for c in self.children:
+            s += c.tree_str(indent + 1)
+        return s
+
+
+class DataSource(LogicalPlan):
+    def __init__(self, table_info, db_name, alias, schema, handle_col):
+        super().__init__([], schema)
+        self.table_info = table_info
+        self.db_name = db_name
+        self.alias = alias
+        self.handle_col = handle_col     # hidden _tidb_rowid Column or None
+        self.pushed_conds: list[Expression] = []
+
+    def explain_info(self):
+        s = f"table:{self.table_info.name}"
+        if self.pushed_conds:
+            s += f", pushed:{self.pushed_conds}"
+        return s
+
+
+class Selection(LogicalPlan):
+    def __init__(self, conds: list[Expression], child: LogicalPlan):
+        super().__init__([child], child.schema)
+        self.conds = conds
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.conds))
+
+
+class Projection(LogicalPlan):
+    def __init__(self, exprs: list[Expression], schema: Schema,
+                 child: LogicalPlan):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.exprs))
+
+
+class Aggregation(LogicalPlan):
+    def __init__(self, group_items: list[Expression], aggs: list[AggDesc],
+                 schema: Schema, child: LogicalPlan):
+        super().__init__([child], schema)
+        self.group_items = group_items
+        self.aggs = aggs
+
+    def explain_info(self):
+        return (f"group:[{', '.join(map(repr, self.group_items))}] "
+                f"aggs:[{', '.join(map(repr, self.aggs))}]")
+
+
+class LJoin(LogicalPlan):
+    def __init__(self, join_type, left, right, schema):
+        super().__init__([left, right], schema)
+        self.join_type = join_type           # inner | left | right | semi | anti | cross
+        self.eq_conds: list[tuple] = []      # [(left Column, right Column)]
+        self.other_conds: list[Expression] = []
+
+    def explain_info(self):
+        return (f"{self.join_type}, eq:{[(repr(a), repr(b)) for a, b in self.eq_conds]}"
+                + (f", other:{self.other_conds}" if self.other_conds else ""))
+
+
+class Sort(LogicalPlan):
+    def __init__(self, items, child):
+        super().__init__([child], child.schema)
+        self.items = items                   # [(Expression, desc: bool)]
+
+    def explain_info(self):
+        return ", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.items)
+
+
+class LimitOp(LogicalPlan):
+    def __init__(self, offset, count, child):
+        super().__init__([child], child.schema)
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return f"offset:{self.offset}, count:{self.count}"
+
+
+class TopN(LogicalPlan):
+    def __init__(self, items, offset, count, child):
+        super().__init__([child], child.schema)
+        self.items = items
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return (f"{', '.join(f'{e!r}{' desc' if d else ''}' for e, d in self.items)}"
+                f", offset:{self.offset}, count:{self.count}")
+
+
+class UnionOp(LogicalPlan):
+    def __init__(self, children, schema, all=True):
+        super().__init__(children, schema)
+        self.all = all
+
+
+class Dual(LogicalPlan):
+    """One-row no-table source (SELECT 1)."""
+
+    def __init__(self, schema=None, rows=1):
+        super().__init__([], schema or Schema())
+        self.rows = rows

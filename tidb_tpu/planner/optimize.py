@@ -1,0 +1,75 @@
+"""Planner entry (reference pkg/planner/optimize.go:141)."""
+from __future__ import annotations
+
+import itertools
+
+from ..parser import ast
+from .builder import PlanBuilder, InsertPlan, UpdatePlan, DeletePlan
+from .rules import optimize_logical
+from .physical import to_physical, PhysPlan
+
+
+class PlanContext:
+    """Everything the planner needs from the session (reference
+    sessionctx.Context seam)."""
+
+    def __init__(self, infoschema, sess_vars, current_db="",
+                 run_subquery=None, table_rows=None, user_vars=None,
+                 now_micros=0, conn_id=1, params=None):
+        self.infoschema = infoschema
+        self.sess_vars = sess_vars
+        self.current_db = current_db
+        self._run_subquery = run_subquery
+        self._table_rows = table_rows
+        self.user_vars = user_vars or {}
+        self.now_micros = now_micros
+        self.conn_id = conn_id
+        self.params = params
+        self._ids = itertools.count(1)
+
+    def alloc_id(self) -> int:
+        return next(self._ids)
+
+    @property
+    def div_prec_incr(self) -> int:
+        try:
+            return int(self.sess_vars.get("div_precision_increment"))
+        except Exception:
+            return 4
+
+    def run_subquery(self, select_stmt, limit_one=False):
+        if self._run_subquery is None:
+            from ..errors import UnsupportedError
+            raise UnsupportedError("subqueries not available in this context")
+        return self._run_subquery(select_stmt, limit_one)
+
+    def table_rows(self, db, tbl) -> float:
+        if self._table_rows is None:
+            return 1000.0
+        return self._table_rows(db, tbl)
+
+
+def optimize(stmt, pctx: PlanContext):
+    """AST statement -> physical plan (SELECT) or DML plan descriptor."""
+    builder = PlanBuilder(pctx)
+    if isinstance(stmt, ast.SelectStmt):
+        logical = builder.build_select(stmt)
+        logical = optimize_logical(logical)
+        return to_physical(logical, pctx.sess_vars)
+    if isinstance(stmt, ast.InsertStmt):
+        plan = builder.build_insert(stmt)
+        if plan.select_plan is not None:
+            plan.select_plan = to_physical(optimize_logical(plan.select_plan),
+                                           pctx.sess_vars)
+        return plan
+    if isinstance(stmt, ast.UpdateStmt):
+        plan = builder.build_update(stmt)
+        plan.select_plan = to_physical(optimize_logical(plan.select_plan),
+                                       pctx.sess_vars)
+        return plan
+    if isinstance(stmt, ast.DeleteStmt):
+        plan = builder.build_delete(stmt)
+        plan.select_plan = to_physical(optimize_logical(plan.select_plan),
+                                       pctx.sess_vars)
+        return plan
+    return stmt   # DDL / utility statements execute from the AST directly

@@ -1,0 +1,306 @@
+"""Physical plan (reference pkg/planner/core/operator/physicalop).
+
+The TPU-relevant decision happens here: which part of the tree becomes a
+coprocessor DAG executed on device per partition (scan + filter + partial
+aggregation — reference tipb.DAGRequest built in
+executor/internal/builder/builder_utils.go:64), and which operators run as
+host-orchestrated device ops above the readers."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..expression import Expression, Column, AggDesc
+from ..expression.vec import is_device_safe
+from .schema import Schema, SchemaCol
+from .logical import (LogicalPlan, DataSource, Selection, Projection,
+                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp)
+from .builder import ProjShell
+
+_PUSHABLE_AGGS = {"sum", "count", "min", "max", "avg", "first_row"}
+
+
+class PhysPlan:
+    def __init__(self, children=None, schema: Schema | None = None):
+        self.children = children or []
+        self.schema = schema or Schema()
+        self.stats_rows = 0.0
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def name(self):
+        return type(self).__name__.replace("Phys", "")
+
+    def explain_info(self):
+        return ""
+
+    def explain_rows(self, out, depth=0, ident=None):
+        ident = ident or [0]
+        my_id = f"{self.name()}_{ident[0]}"
+        ident[0] += 1
+        out.append((my_id, depth, f"{self.stats_rows:.2f}",
+                    self.explain_info()))
+        for c in self.children:
+            c.explain_rows(out, depth + 1, ident)
+        return out
+
+
+@dataclass
+class CoprDAG:
+    """Pushed-down per-partition program: scan -> filter -> partial agg /
+    topn / limit, compiled to one jit kernel per shape bucket."""
+
+    table_info: object = None
+    db_name: str = ""
+    cols: list = field(default_factory=list)        # [SchemaCol] to scan
+    filters: list = field(default_factory=list)     # device-safe conjuncts
+    host_filters: list = field(default_factory=list)
+    group_items: list = field(default_factory=list)
+    aggs: list = field(default_factory=list)        # partial AggDescs
+    limit: int = -1                                 # scan-level limit
+
+
+class PhysTableReader(PhysPlan):
+    def __init__(self, dag: CoprDAG, schema: Schema):
+        super().__init__([], schema)
+        self.dag = dag
+
+    def explain_info(self):
+        s = f"table:{self.dag.table_info.name}"
+        if self.dag.filters or self.dag.host_filters:
+            s += f", filters:{self.dag.filters + self.dag.host_filters}"
+        if self.dag.aggs:
+            s += (f", partial_agg:[{', '.join(map(repr, self.dag.aggs))}] "
+                  f"group:[{', '.join(map(repr, self.dag.group_items))}]")
+        return s
+
+
+class PhysSelection(PhysPlan):
+    def __init__(self, conds, child):
+        super().__init__([child], child.schema)
+        self.conds = conds
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.conds))
+
+
+class PhysProjection(PhysPlan):
+    def __init__(self, exprs, schema, child):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.exprs))
+
+
+class PhysHashAgg(PhysPlan):
+    def __init__(self, group_items, aggs, mode, schema, child):
+        super().__init__([child], schema)
+        self.group_items = group_items
+        self.aggs = aggs
+        self.mode = mode       # complete | final
+
+    def explain_info(self):
+        return (f"mode:{self.mode}, group:[{', '.join(map(repr, self.group_items))}], "
+                f"funcs:[{', '.join(map(repr, self.aggs))}]")
+
+
+class PhysHashJoin(PhysPlan):
+    def __init__(self, join_type, build_side, eq_conds, other_conds,
+                 schema, left, right):
+        super().__init__([left, right], schema)
+        self.join_type = join_type
+        self.build_side = build_side      # 0 = left child builds, 1 = right
+        self.eq_conds = eq_conds
+        self.other_conds = other_conds
+
+    def explain_info(self):
+        return (f"{self.join_type}, build:{'left' if self.build_side == 0 else 'right'}, "
+                f"eq:{[(repr(a), repr(b)) for a, b in self.eq_conds]}")
+
+
+class PhysSort(PhysPlan):
+    def __init__(self, items, child):
+        super().__init__([child], child.schema)
+        self.items = items
+
+    def explain_info(self):
+        return ", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.items)
+
+
+class PhysTopN(PhysPlan):
+    def __init__(self, items, offset, count, child):
+        super().__init__([child], child.schema)
+        self.items = items
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return (", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.items)
+                + f", offset:{self.offset}, count:{self.count}")
+
+
+class PhysLimit(PhysPlan):
+    def __init__(self, offset, count, child):
+        super().__init__([child], child.schema)
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return f"offset:{self.offset}, count:{self.count}"
+
+
+class PhysUnion(PhysPlan):
+    def __init__(self, children, schema):
+        super().__init__(children, schema)
+
+
+class PhysDual(PhysPlan):
+    def __init__(self, schema, rows=1):
+        super().__init__([], schema)
+        self.rows = rows
+
+
+class PhysShell(PhysPlan):
+    """Schema-renaming passthrough."""
+
+    def __init__(self, child, schema):
+        super().__init__([child], schema)
+
+
+def to_physical(plan: LogicalPlan, sess_vars=None) -> PhysPlan:
+    p = _phys(plan)
+    return p
+
+
+def _phys(plan: LogicalPlan) -> PhysPlan:
+    if isinstance(plan, DataSource):
+        return _mk_reader(plan)
+    if isinstance(plan, Selection):
+        child = _phys(plan.child)
+        if isinstance(child, PhysTableReader) and not child.dag.aggs:
+            _absorb_filters(child.dag, plan.conds)
+            child.schema = plan.schema if plan.schema.cols else child.schema
+            child.stats_rows = plan.stats_rows
+            return child
+        p = PhysSelection(plan.conds, child)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, Projection):
+        child = _phys(plan.child)
+        p = PhysProjection(plan.exprs, plan.schema, child)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, ProjShell):
+        child = _phys(plan.child)
+        p = PhysShell(child, plan.schema)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, Aggregation):
+        child = _phys(plan.child)
+        if isinstance(child, PhysTableReader) and _can_push_agg(plan, child):
+            dag = child.dag
+            dag.group_items = list(plan.group_items)
+            dag.aggs = [_to_partial(a) for a in plan.aggs]
+            agg = PhysHashAgg(plan.group_items, plan.aggs, "final",
+                              plan.schema, child)
+            agg.stats_rows = plan.stats_rows
+            child.stats_rows = plan.stats_rows
+            return agg
+        agg = PhysHashAgg(plan.group_items, plan.aggs, "complete",
+                          plan.schema, child)
+        agg.stats_rows = plan.stats_rows
+        return agg
+    if isinstance(plan, LJoin):
+        left = _phys(plan.children[0])
+        right = _phys(plan.children[1])
+        if plan.join_type == "left":
+            build = 1
+        elif plan.join_type == "right":
+            build = 0
+        else:
+            build = 0 if plan.children[0].stats_rows <= plan.children[1].stats_rows else 1
+        p = PhysHashJoin(plan.join_type, build, plan.eq_conds,
+                         plan.other_conds, plan.schema, left, right)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, Sort):
+        p = PhysSort(plan.items, _phys(plan.child))
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, TopN):
+        p = PhysTopN(plan.items, plan.offset, plan.count, _phys(plan.child))
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, LimitOp):
+        child = _phys(plan.child)
+        if isinstance(child, PhysTableReader) and not child.dag.aggs and \
+                not child.dag.filters and not child.dag.host_filters and \
+                plan.count >= 0:
+            child.dag.limit = plan.offset + plan.count
+        p = PhysLimit(plan.offset, plan.count, child)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, UnionOp):
+        p = PhysUnion([_phys(c) for c in plan.children], plan.schema)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, Dual):
+        return PhysDual(plan.schema, plan.rows)
+    raise NotImplementedError(f"no physical impl for {type(plan).__name__}")
+
+
+def _mk_reader(ds: DataSource) -> PhysTableReader:
+    cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
+    dag = CoprDAG(table_info=ds.table_info, db_name=ds.db_name,
+                  cols=list(cols))
+    _absorb_filters(dag, ds.pushed_conds)
+    schema = Schema(list(cols))
+    rd = PhysTableReader(dag, schema)
+    rd.stats_rows = ds.stats_rows
+    return rd
+
+
+def _absorb_filters(dag: CoprDAG, conds):
+    for c in conds:
+        (dag.filters if is_device_safe(c) else dag.host_filters).append(c)
+        # filters may reference columns not in the output list
+        s = set()
+        c.collect_columns(s)
+        have = {sc.col.idx for sc in dag.cols}
+        missing = s - have
+        if missing:
+            # caller guarantees pruning kept filter cols in ds.used_cols;
+            # this is a safety net for directly-absorbed selections
+            pass
+
+
+def _can_push_agg(agg: Aggregation, reader: PhysTableReader) -> bool:
+    if reader.dag.limit >= 0:
+        return False
+    for a in agg.aggs:
+        if a.name not in _PUSHABLE_AGGS or a.distinct:
+            return False
+        if not all(is_device_safe(arg) for arg in a.args):
+            return False
+    for g in agg.group_items:
+        if not is_device_safe(g):
+            return False
+    return True
+
+
+def _to_partial(a: AggDesc) -> AggDesc:
+    p = AggDesc(name=a.name, args=a.args, distinct=a.distinct, ft=a.ft,
+                mode="partial1")
+    return p
+
+
+def explain_text(plan: PhysPlan) -> list:
+    rows = []
+    plan.explain_rows(rows)
+    out = []
+    for pid, depth, est, info in rows:
+        prefix = ("  " * (depth - 1) + "└─") if depth > 0 else ""
+        out.append((prefix + pid, est, info))
+    return out

@@ -1,0 +1,493 @@
+"""Expression rewriter: AST -> bound, typed Expression trees
+(reference pkg/planner/core/expression_rewriter.go).
+
+Uncorrelated subqueries are evaluated at rewrite time through
+PlanContext.run_subquery (the reference does the same for non-correlated
+scalar subqueries). Correlated subqueries are a planned round-2 item
+(decorrelation to semi/anti joins).
+"""
+from __future__ import annotations
+
+from ..parser import ast
+from ..expression import (Expression, Column, Constant, ScalarFunc, AggDesc,
+                          const_from_py, const_null)
+from ..expression.fold import fold_constants
+from ..types import FieldType
+from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
+                                new_decimal_type, new_string_type,
+                                new_date_type, new_datetime_type,
+                                new_null_type, merge_field_type,
+                                agg_field_type)
+from ..types.datum import Datum, Kind
+from ..errors import (UnsupportedError, UnknownFunctionError,
+                      WrongArgCountError)
+from ..parser.parser import _DecimalLiteral
+
+_BOOL_FT = new_bigint_type()
+
+_STRING_FUNCS = {"lower", "lcase", "upper", "ucase", "concat", "substring",
+                 "substr", "mid", "left", "right", "trim", "ltrim", "rtrim",
+                 "replace", "reverse", "lpad", "rpad", "cast_char"}
+_INT_FUNCS = {"length", "octet_length", "char_length", "character_length",
+              "locate", "instr", "year", "month", "day", "dayofmonth",
+              "quarter", "dayofweek", "weekday", "dayofyear", "hour",
+              "minute", "second", "week", "datediff", "sign",
+              "unix_timestamp", "cast_signed", "cast_unsigned", "ceil",
+              "ceiling", "floor", "extract"}
+_FLOAT_FUNCS = {"sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
+                "cast_double", "rand", "pi", "degrees", "radians", "sin",
+                "cos", "tan", "asin", "acos", "atan"}
+
+
+def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,
+                   div_incr: int = 4) -> FieldType:
+    if op in ("=", "!=", "<", "<=", ">", ">=", "<=>", "and", "or", "xor",
+              "not", "like", "in", "regexp"):
+        return _BOOL_FT.clone()
+    if op in ("&", "|", "^", "<<", ">>", "div"):
+        return new_bigint_type(unsigned=True)
+    if op in ("+", "-", "*"):
+        m = merge_field_type(lft, rft)
+        if m.tclass == TypeClass.DECIMAL:
+            sa = max(lft.decimal, 0) if lft.tclass == TypeClass.DECIMAL else 0
+            sb = max(rft.decimal, 0) if rft.tclass == TypeClass.DECIMAL else 0
+            scale = sa + sb if op == "*" else max(sa, sb)
+            if scale > 18:
+                return new_double_type()
+            return new_decimal_type(38, scale)
+        return m
+    if op == "/":
+        lc, rc = lft.tclass, rft.tclass
+        if TypeClass.FLOAT in (lc, rc) or TypeClass.STRING in (lc, rc):
+            return new_double_type()
+        sa = max(lft.decimal, 0) if lc == TypeClass.DECIMAL else 0
+        scale = sa + div_incr
+        if scale > 18:
+            return new_double_type()
+        return new_decimal_type(38, scale)
+    if op in ("%",):
+        m = merge_field_type(lft, rft)
+        return m
+    return merge_field_type(lft, rft)
+
+
+class Rewriter:
+    def __init__(self, pctx, schema, agg_mapper=None, outer_schemas=None):
+        self.pctx = pctx          # PlanContext
+        self.schema = schema
+        self.agg_mapper = agg_mapper
+        self.outer_schemas = outer_schemas or []
+
+    def mk_func(self, op: str, args: list, ft: FieldType | None = None) -> Expression:
+        if ft is None:
+            if op in _STRING_FUNCS:
+                ft = new_string_type()
+            elif op in _INT_FUNCS:
+                ft = new_bigint_type()
+            elif op in _FLOAT_FUNCS:
+                ft = new_double_type()
+            elif len(args) == 2:
+                ft = infer_binop_ft(op, args[0].ft, args[1].ft,
+                                    self.pctx.div_prec_incr)
+            elif len(args) == 1:
+                ft = args[0].ft.clone() if op in ("unary-", "~", "abs") \
+                    else _BOOL_FT.clone()
+            else:
+                ft = new_bigint_type()
+        return fold_constants(ScalarFunc(op, args, ft))
+
+    # ---- entry --------------------------------------------------------
+    def rewrite(self, node) -> Expression:
+        m = getattr(self, "_rw_" + type(node).__name__, None)
+        if m is None:
+            raise UnsupportedError("unsupported expression %s",
+                                   type(node).__name__)
+        return m(node)
+
+    # ---- leaves -------------------------------------------------------
+    def _rw_Literal(self, node: ast.Literal):
+        v = node.value
+        if isinstance(v, _DecimalLiteral):
+            s = str(v)
+            scale = len(s.split(".")[1]) if "." in s else 0
+            from ..types.decimal import dec_to_scaled_int
+            return Constant(
+                value=Datum(Kind.DECIMAL, dec_to_scaled_int(s, scale), scale),
+                ft=new_decimal_type(38, scale))
+        if isinstance(v, bool):
+            return const_from_py(int(v))
+        return const_from_py(v)
+
+    def _rw_ColumnRef(self, node: ast.ColumnRef):
+        sc = self.schema.try_resolve(node.name, node.table, node.db)
+        if sc is not None:
+            return sc.col
+        for outer in self.outer_schemas:
+            sc = outer.try_resolve(node.name, node.table, node.db)
+            if sc is not None:
+                raise UnsupportedError(
+                    "correlated subqueries are not supported yet (column %s)",
+                    node.name)
+        # raise proper error
+        self.schema.resolve(node.name, node.table, node.db)
+
+    def _rw_VariableExpr(self, node: ast.VariableExpr):
+        if node.is_system:
+            v = self.pctx.sess_vars.get(node.name)
+            if isinstance(v, bool):
+                v = int(v)
+            return const_from_py(v)
+        v = self.pctx.user_vars.get(node.name.lower())
+        return const_from_py(v) if v is not None else const_null()
+
+    def _rw_ParamMarker(self, node: ast.ParamMarker):
+        if self.pctx.params is None or node.index >= len(self.pctx.params):
+            raise UnsupportedError("missing parameter value")
+        return const_from_py(self.pctx.params[node.index])
+
+    def _rw_DefaultExpr(self, node):
+        raise UnsupportedError("DEFAULT expression outside INSERT")
+
+    # ---- operators ----------------------------------------------------
+    def _coerce_cmp_sides(self, op, l, r):
+        """Insert casts so comparisons are type-consistent (temporal vs
+        string literal, string vs numeric)."""
+        def is_str(e):
+            return e.ft.tclass in (TypeClass.STRING, TypeClass.JSON)
+
+        def is_temporal(e):
+            return e.ft.is_temporal
+
+        def is_num(e):
+            return e.ft.tclass in (TypeClass.INT, TypeClass.UINT,
+                                   TypeClass.FLOAT, TypeClass.DECIMAL,
+                                   TypeClass.BIT)
+        if is_temporal(l) and is_str(r):
+            tgt = ("cast_str_to_date" if l.ft.tclass == TypeClass.DATE
+                   else "cast_str_to_datetime")
+            r = self.mk_func(tgt, [r],
+                             new_date_type() if l.ft.tclass == TypeClass.DATE
+                             else new_datetime_type())
+        elif is_temporal(r) and is_str(l):
+            tgt = ("cast_str_to_date" if r.ft.tclass == TypeClass.DATE
+                   else "cast_str_to_datetime")
+            l = self.mk_func(tgt, [l],
+                             new_date_type() if r.ft.tclass == TypeClass.DATE
+                             else new_datetime_type())
+        elif is_str(l) and is_num(r):
+            l = self.mk_func("cast_double", [l], new_double_type())
+        elif is_str(r) and is_num(l):
+            r = self.mk_func("cast_double", [r], new_double_type())
+        elif l.ft.tclass == TypeClass.DATE and \
+                r.ft.tclass in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            l = self.mk_func("cast_date_to_datetime", [l], new_datetime_type())
+        elif r.ft.tclass == TypeClass.DATE and \
+                l.ft.tclass in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            r = self.mk_func("cast_date_to_datetime", [r], new_datetime_type())
+        return l, r
+
+    def _rw_BinaryOp(self, node: ast.BinaryOp):
+        l = self.rewrite(node.left)
+        r = self.rewrite(node.right)
+        op = node.op
+        if op in ("=", "!=", "<", "<=", ">", ">=", "<=>"):
+            l, r = self._coerce_cmp_sides(op, l, r)
+        if op in ("+", "-") and (l.ft.is_temporal or r.ft.is_temporal):
+            # date + int -> date_add days (MySQL-ish)
+            if l.ft.is_temporal and not r.ft.is_temporal:
+                iv = self._mk_interval(r, "day")
+                return self.mk_func("date_add" if op == "+" else "date_sub",
+                                    [l, iv], l.ft.clone())
+        return self.mk_func(op, [l, r])
+
+    def _rw_UnaryOp(self, node: ast.UnaryOp):
+        a = self.rewrite(node.operand)
+        if node.op == "-":
+            return self.mk_func("unary-", [a], a.ft.clone())
+        if node.op == "not" or node.op == "!":
+            return self.mk_func("not", [a], _BOOL_FT.clone())
+        if node.op == "~":
+            return self.mk_func("~", [a], new_bigint_type(unsigned=True))
+        raise UnsupportedError("unary op %s", node.op)
+
+    def _rw_IsNull(self, node: ast.IsNull):
+        a = self.rewrite(node.expr)
+        return self.mk_func("isnotnull" if node.negated else "isnull", [a],
+                            _BOOL_FT.clone())
+
+    def _rw_IsTruth(self, node: ast.IsTruth):
+        a = self.rewrite(node.expr)
+        op = "istrue" if node.truth else "isfalse"
+        e = self.mk_func(op, [a], _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_Between(self, node: ast.Between):
+        a = self.rewrite(node.expr)
+        low = self.rewrite(node.low)
+        high = self.rewrite(node.high)
+        a1, low = self._coerce_cmp_sides(">=", a, low)
+        a2, high = self._coerce_cmp_sides("<=", a, high)
+        ge = self.mk_func(">=", [a1, low], _BOOL_FT.clone())
+        le = self.mk_func("<=", [a2, high], _BOOL_FT.clone())
+        e = self.mk_func("and", [ge, le], _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_InList(self, node: ast.InList):
+        a = self.rewrite(node.expr)
+        items = [self.rewrite(i) for i in node.items]
+        coerced = []
+        for it in items:
+            _, it2 = self._coerce_cmp_sides("=", a, it)
+            coerced.append(it2)
+        if all(isinstance(i, Constant) for i in coerced):
+            e = self.mk_func("in", [a] + coerced, _BOOL_FT.clone())
+        else:
+            e = None
+            for it in coerced:
+                eq = self.mk_func("=", [a, it], _BOOL_FT.clone())
+                e = eq if e is None else self.mk_func("or", [e, eq],
+                                                      _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_Like(self, node: ast.Like):
+        a = self.rewrite(node.expr)
+        pat = self.rewrite(node.pattern)
+        args = [a, pat]
+        if node.escape != "\\":
+            args.append(const_from_py(node.escape))
+        e = self.mk_func("like", args, _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_RegexpExpr(self, node: ast.RegexpExpr):
+        a = self.rewrite(node.expr)
+        pat = self.rewrite(node.pattern)
+        e = self.mk_func("regexp", [a, pat], _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_Case(self, node: ast.Case):
+        args = []
+        results = []
+        for cond, res in node.when_clauses:
+            if node.operand is not None:
+                eq = ast.BinaryOp("=", node.operand, cond)
+                args.append(self.rewrite(eq))
+            else:
+                args.append(self.rewrite(cond))
+            r = self.rewrite(res)
+            args.append(r)
+            results.append(r)
+        if node.else_clause is not None:
+            e = self.rewrite(node.else_clause)
+            args.append(e)
+            results.append(e)
+        ft = agg_field_type([r.ft for r in results]) if results else new_null_type()
+        return self.mk_func("case_when", args, ft)
+
+    def _rw_Cast(self, node: ast.Cast):
+        a = self.rewrite(node.expr)
+        t = node.to_type
+        src = a.ft.tclass
+        if t in ("signed", "integer", "int"):
+            return self.mk_func("cast_signed", [a], new_bigint_type())
+        if t == "unsigned":
+            return self.mk_func("cast_unsigned", [a],
+                                new_bigint_type(unsigned=True))
+        if t in ("double", "float", "real"):
+            return self.mk_func("cast_double", [a], new_double_type())
+        if t in ("decimal", "numeric"):
+            scale = max(node.decimal, 0)
+            return self.mk_func("cast_decimal", [a],
+                                new_decimal_type(node.flen if node.flen > 0 else 10,
+                                                 scale))
+        if t in ("char", "binary", "varchar", "nchar"):
+            return self.mk_func("cast_char", [a], new_string_type(node.flen))
+        if t == "date":
+            if src in (TypeClass.STRING, TypeClass.JSON):
+                return self.mk_func("cast_str_to_date", [a], new_date_type())
+            if src in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+                return self.mk_func("cast_datetime_to_date", [a], new_date_type())
+            return self.mk_func("cast_signed", [a], new_date_type())
+        if t == "datetime":
+            if src in (TypeClass.STRING, TypeClass.JSON):
+                return self.mk_func("cast_str_to_datetime", [a],
+                                    new_datetime_type())
+            if src == TypeClass.DATE:
+                return self.mk_func("cast_date_to_datetime", [a],
+                                    new_datetime_type())
+            return self.mk_func("cast_signed", [a], new_datetime_type())
+        raise UnsupportedError("unsupported CAST target %s", t)
+
+    def _mk_interval(self, value_expr: Expression, unit: str) -> Constant:
+        if not isinstance(value_expr, Constant):
+            value_expr = fold_constants(value_expr)
+        if not isinstance(value_expr, Constant):
+            raise UnsupportedError("non-constant INTERVAL value")
+        ft = new_bigint_type().clone(tp=f"interval_{unit}")
+        return Constant(value=value_expr.value, ft=ft)
+
+    def _rw_IntervalExpr(self, node: ast.IntervalExpr):
+        return self._mk_interval(self.rewrite(node.value), node.unit)
+
+    def _rw_FuncCall(self, node: ast.FuncCall):
+        name = node.name
+        # statement-time constants
+        if name in ("now", "current_timestamp", "sysdate"):
+            return Constant(value=Datum(Kind.DATETIME, self.pctx.now_micros),
+                            ft=new_datetime_type())
+        if name in ("curdate", "current_date"):
+            return Constant(value=Datum(Kind.DATE,
+                                        self.pctx.now_micros // 86_400_000_000),
+                            ft=new_date_type())
+        if name == "database":
+            db = self.pctx.current_db
+            return const_from_py(db) if db else const_null()
+        if name == "version":
+            return const_from_py("8.0.11-tidb-tpu-0.1.0")
+        if name in ("user", "current_user"):
+            return const_from_py("root@%")
+        if name == "connection_id":
+            return const_from_py(self.pctx.conn_id)
+        if name == "last_insert_id" and not node.args:
+            return const_from_py(self.pctx.sess_vars.last_insert_id)
+        if name in ("date_add", "date_sub", "adddate", "subdate"):
+            base = self.rewrite(node.args[0])
+            ivnode = node.args[1]
+            if isinstance(ivnode, ast.IntervalExpr):
+                iv = self._rw_IntervalExpr(ivnode)
+            else:
+                iv = self._mk_interval(self.rewrite(ivnode), "day")
+            if base.ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+                base = self.mk_func("cast_str_to_date", [base], new_date_type())
+            unit = iv.ft.tp.replace("interval_", "")
+            out_ft = base.ft.clone()
+            if unit in ("hour", "minute", "second", "microsecond") and \
+                    base.ft.tclass == TypeClass.DATE:
+                out_ft = new_datetime_type()
+            return self.mk_func(name, [base, iv], out_ft)
+        if name == "extract":
+            unit = node.args[0].value
+            inner = self.rewrite(node.args[1])
+            return self.mk_func("extract", [const_from_py(unit), inner],
+                                new_bigint_type())
+        if name == "date":
+            a = self.rewrite(node.args[0])
+            return self.mk_func("date", [a], new_date_type())
+        if name in ("if",):
+            if len(node.args) != 3:
+                raise WrongArgCountError("Incorrect parameter count for IF")
+            c = self.rewrite(node.args[0])
+            a = self.rewrite(node.args[1])
+            b = self.rewrite(node.args[2])
+            return self.mk_func("if", [c, a, b],
+                                agg_field_type([a.ft, b.ft]))
+        if name in ("ifnull", "nullif", "coalesce"):
+            args = [self.rewrite(a) for a in node.args]
+            ft = (args[0].ft.clone() if name == "nullif"
+                  else agg_field_type([a.ft for a in args]))
+            return self.mk_func(name, args, ft)
+        if name in ("greatest", "least"):
+            args = [self.rewrite(a) for a in node.args]
+            return self.mk_func(name, args,
+                                agg_field_type([a.ft for a in args]))
+        if name == "round" or name == "truncate":
+            args = [self.rewrite(a) for a in node.args]
+            src = args[0].ft
+            d = 0
+            if len(args) > 1 and isinstance(args[1], Constant) and \
+                    not args[1].value.is_null:
+                d = int(args[1].value.val)
+            if src.tclass == TypeClass.DECIMAL:
+                ft = new_decimal_type(38, min(max(d, 0), max(src.decimal, 0)))
+            elif src.tclass == TypeClass.FLOAT:
+                ft = new_double_type()
+            else:
+                ft = new_bigint_type()
+            return self.mk_func(name, args, ft)
+        if name == "abs":
+            a = self.rewrite(node.args[0])
+            return self.mk_func("abs", [a], a.ft.clone())
+        if name.startswith("cast_str_to_"):
+            a = self.rewrite(node.args[0])
+            ft = (new_date_type() if name.endswith("date")
+                  else new_datetime_type())
+            return self.mk_func(name, [a], ft)
+        args = [self.rewrite(a) for a in node.args]
+        return self.mk_func(name, args)
+
+    def _rw_AggFunc(self, node: ast.AggFunc):
+        if self.agg_mapper is None:
+            from ..errors import InvalidGroupFuncError
+            raise InvalidGroupFuncError("Invalid use of group function")
+        return self.agg_mapper(node)
+
+    def _rw_Wildcard(self, node):
+        raise UnsupportedError("wildcard not allowed in this context")
+
+    # ---- subqueries (uncorrelated: plan-time execution) ---------------
+    def _sub_const(self, datum, ft):
+        from ..expression import Constant
+        if datum.is_null:
+            return const_null()
+        return Constant(value=datum, ft=ft)
+
+    def _rw_ScalarSubquery(self, node: ast.ScalarSubquery):
+        rows, fts = self.pctx.run_subquery(node.subquery)
+        if len(rows) > 1:
+            raise UnsupportedError("Subquery returns more than 1 row")
+        if not rows:
+            return const_null()
+        row = rows[0]
+        if len(row) != 1:
+            raise UnsupportedError("Operand should contain 1 column")
+        return self._sub_const(row[0], fts[0])
+
+    def _rw_InSubquery(self, node: ast.InSubquery):
+        a = self.rewrite(node.expr)
+        rows, fts = self.pctx.run_subquery(node.subquery)
+        items = [self._sub_const(r[0], fts[0]) for r in rows]
+        if not items:
+            result = const_from_py(0)
+            if node.negated:
+                result = const_from_py(1)
+            return result
+        lst = ast.InList(expr=node.expr, items=[], negated=node.negated)
+        coerced = []
+        for it in items:
+            _, it2 = self._coerce_cmp_sides("=", a, it)
+            coerced.append(it2)
+        e = self.mk_func("in", [a] + coerced, _BOOL_FT.clone())
+        if node.negated:
+            e = self.mk_func("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _rw_ExistsSubquery(self, node: ast.ExistsSubquery):
+        rows, _ = self.pctx.run_subquery(node.subquery, limit_one=True)
+        v = bool(rows)
+        if node.negated:
+            v = not v
+        return const_from_py(int(v))
+
+    def _rw_CompareSubquery(self, node: ast.CompareSubquery):
+        a = self.rewrite(node.expr)
+        rows, fts = self.pctx.run_subquery(node.subquery)
+        vals = [r[0] for r in rows]
+        if any(v.is_null for v in vals):
+            return const_null()
+        if not vals:
+            return const_from_py(1 if node.quantifier == "all" else 0)
+        agg = (max if ((node.op in (">", ">=")) == (node.quantifier == "all"))
+               else min)
+        pivot = agg(vals, key=lambda d: d.sort_key())
+        c = self._sub_const(pivot, fts[0])
+        a2, c2 = self._coerce_cmp_sides(node.op, a, c)
+        return self.mk_func(node.op, [a2, c2], _BOOL_FT.clone())

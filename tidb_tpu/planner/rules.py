@@ -1,0 +1,256 @@
+"""Logical optimization rules (reference pkg/planner/core/optimizer.go:88 —
+the rule list; round 1 implements the load-bearing subset: predicate
+pushdown, column pruning; constant folding happens in the rewriter)."""
+from __future__ import annotations
+
+from ..expression import Expression, Column, Constant, ScalarFunc
+from .logical import (LogicalPlan, DataSource, Selection, Projection,
+                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp)
+from .builder import ProjShell
+
+
+def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+    plan = push_down_predicates(plan, [])
+    used = {sc.col.idx for sc in plan.schema.cols}
+    prune_columns(plan, used)
+    plan = build_topn(plan)
+    return plan
+
+
+# ---------------- predicate pushdown ----------------
+
+def _cols_of(e: Expression) -> set:
+    s = set()
+    e.collect_columns(s)
+    return s
+
+
+def _subst(e: Expression, mapping: dict) -> Expression:
+    if isinstance(e, Column):
+        return mapping.get(e.idx, e)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.op, [_subst(a, mapping) for a in e.args], e.ft)
+    return e
+
+
+def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
+    """Push `conds` into plan; returns new plan with remaining conds applied
+    on top."""
+    if isinstance(plan, Selection):
+        child = push_down_predicates(plan.child, conds + plan.conds)
+        return child
+    if isinstance(plan, DataSource):
+        plan.pushed_conds.extend(conds)
+        if conds:
+            plan.stats_rows = max(plan.stats_rows * (0.25 ** min(len(conds), 3)), 1.0)
+        return plan
+    if isinstance(plan, ProjShell):
+        plan.children[0] = push_down_predicates(plan.child, conds)
+        return plan
+    if isinstance(plan, Projection):
+        mapping = {sc.col.idx: ex
+                   for sc, ex in zip(plan.schema.cols, plan.exprs)}
+        pushable, rest = [], []
+        for c in conds:
+            s = _subst(c, mapping)
+            pushable.append(s)
+        plan.children[0] = push_down_predicates(plan.child, pushable)
+        return plan
+    if isinstance(plan, Aggregation):
+        group_ids = {g.idx for g in plan.group_items if isinstance(g, Column)}
+        down, keep = [], []
+        for c in conds:
+            if _cols_of(c) <= group_ids:
+                down.append(c)
+            else:
+                keep.append(c)
+        plan.children[0] = push_down_predicates(plan.child, down)
+        return _wrap_sel(plan, keep)
+    if isinstance(plan, LJoin):
+        left_ids = {sc.col.idx for sc in plan.children[0].schema.cols}
+        right_ids = {sc.col.idx for sc in plan.children[1].schema.cols}
+        lconds, rconds, keep = [], [], []
+        inner = plan.join_type == "inner"
+        for c in conds + (plan.other_conds if inner else []):
+            s = _cols_of(c)
+            if s <= left_ids and plan.join_type in ("inner", "left"):
+                lconds.append(c)
+            elif s <= right_ids and plan.join_type in ("inner", "right"):
+                rconds.append(c)
+            else:
+                keep.append(c)
+        if inner:
+            # promote Column=Column conds across sides into join eq conds
+            retained = []
+            for c in keep:
+                if isinstance(c, ScalarFunc) and c.op == "=" and \
+                        isinstance(c.args[0], Column) and \
+                        isinstance(c.args[1], Column):
+                    a, b = c.args
+                    if a.idx in left_ids and b.idx in right_ids:
+                        plan.eq_conds.append((a, b))
+                        continue
+                    if b.idx in left_ids and a.idx in right_ids:
+                        plan.eq_conds.append((b, a))
+                        continue
+                retained.append(c)
+            plan.other_conds = retained
+            keep = []
+        plan.children[0] = push_down_predicates(plan.children[0], lconds)
+        plan.children[1] = push_down_predicates(plan.children[1], rconds)
+        _refresh_join_stats(plan)
+        return _wrap_sel(plan, keep)
+    if isinstance(plan, (Sort, LimitOp, TopN)):
+        if isinstance(plan, LimitOp) or isinstance(plan, TopN):
+            # cannot push through limit; apply above
+            plan.children[0] = push_down_predicates(plan.child, [])
+            return _wrap_sel(plan, conds)
+        plan.children[0] = push_down_predicates(plan.child, conds)
+        return plan
+    if isinstance(plan, UnionOp):
+        for i, ch in enumerate(plan.children):
+            mapping = {sc.col.idx: chsc.col
+                       for sc, chsc in zip(plan.schema.cols,
+                                           ch.schema.visible())}
+            cs = [_subst(c, mapping) for c in conds]
+            plan.children[i] = push_down_predicates(ch, cs)
+        return plan
+    # default: keep conds here
+    plan.children = [push_down_predicates(c, []) for c in plan.children]
+    return _wrap_sel(plan, conds)
+
+
+def _wrap_sel(plan, conds):
+    if not conds:
+        return plan
+    s = Selection(conds, plan)
+    s.stats_rows = plan.stats_rows * (0.25 ** min(len(conds), 3))
+    return s
+
+
+def _refresh_join_stats(join: LJoin):
+    l, r = join.children[0].stats_rows, join.children[1].stats_rows
+    if join.eq_conds:
+        join.stats_rows = max(l, r)
+    else:
+        join.stats_rows = l * r
+
+
+# ---------------- column pruning ----------------
+
+def prune_columns(plan: LogicalPlan, needed: set):
+    """Top-down pass recording which columns each node must produce."""
+    if isinstance(plan, DataSource):
+        plan.used_cols = [sc for sc in plan.schema.cols
+                          if sc.col.idx in needed]
+        for c in plan.pushed_conds:
+            for idx in _cols_of(c):
+                if all(sc.col.idx != idx for sc in plan.used_cols):
+                    for sc in plan.schema.cols:
+                        if sc.col.idx == idx:
+                            plan.used_cols.append(sc)
+        if not plan.used_cols:
+            # must read at least one column (COUNT(*))
+            plan.used_cols = [plan.schema.cols[0]]
+        return
+    if isinstance(plan, Projection):
+        kept_exprs, kept_cols = [], []
+        for ex, sc in zip(plan.exprs, plan.schema.cols):
+            if sc.col.idx in needed or not sc.hidden and sc.col.idx in needed:
+                pass
+            if sc.col.idx in needed:
+                kept_exprs.append(ex)
+                kept_cols.append(sc)
+        if kept_exprs:
+            plan.exprs = kept_exprs
+            plan.schema.cols = kept_cols
+        child_needed = set()
+        for ex in plan.exprs:
+            child_needed |= _cols_of(ex)
+        if not child_needed and plan.child.schema.cols:
+            child_needed = {plan.child.schema.cols[0].col.idx}
+        prune_columns(plan.child, child_needed)
+        return
+    if isinstance(plan, Aggregation):
+        kept_aggs = []
+        kept_cols = []
+        agg_cols = plan.schema.cols[len(plan.group_items):]
+        for sc in plan.schema.cols[:len(plan.group_items)]:
+            kept_cols.append(sc)
+        for desc, sc in zip(plan.aggs, agg_cols):
+            if sc.col.idx in needed:
+                kept_aggs.append(desc)
+                kept_cols.append(sc)
+        plan.aggs = kept_aggs
+        plan.schema.cols = kept_cols
+        child_needed = set()
+        for g in plan.group_items:
+            child_needed |= _cols_of(g)
+        for a in plan.aggs:
+            for arg in a.args:
+                child_needed |= _cols_of(arg)
+        if not child_needed and plan.child.schema.cols:
+            child_needed = {plan.child.schema.cols[0].col.idx}
+        prune_columns(plan.child, child_needed)
+        return
+    if isinstance(plan, LJoin):
+        child_needed = set(needed)
+        for a, b in plan.eq_conds:
+            child_needed.add(a.idx)
+            child_needed.add(b.idx)
+        for c in plan.other_conds:
+            child_needed |= _cols_of(c)
+        plan.schema.cols = [sc for sc in plan.schema.cols
+                            if sc.col.idx in child_needed or sc.col.idx in needed]
+        prune_columns(plan.children[0], child_needed)
+        prune_columns(plan.children[1], child_needed)
+        return
+    if isinstance(plan, ProjShell):
+        plan.schema.cols = [sc for sc in plan.schema.cols
+                            if sc.col.idx in needed] or plan.schema.cols[:1]
+        prune_columns(plan.child, {sc.col.idx for sc in plan.schema.cols})
+        return
+    if isinstance(plan, Selection):
+        child_needed = set(needed)
+        for c in plan.conds:
+            child_needed |= _cols_of(c)
+        prune_columns(plan.child, child_needed)
+        plan.schema = plan.child.schema
+        return
+    if isinstance(plan, (Sort, TopN)):
+        child_needed = set(needed)
+        for e, _ in plan.items:
+            child_needed |= _cols_of(e)
+        prune_columns(plan.child, child_needed)
+        plan.schema = plan.child.schema
+        return
+    if isinstance(plan, UnionOp):
+        for ch in plan.children:
+            ch_needed = set()
+            for sc, chsc in zip(plan.schema.cols, ch.schema.visible()):
+                if sc.col.idx in needed:
+                    ch_needed.add(chsc.col.idx)
+            if not ch_needed:
+                ch_needed = {ch.schema.visible()[0].col.idx}
+            prune_columns(ch, ch_needed)
+        return
+    for c in plan.children:
+        prune_columns(c, needed | {sc.col.idx for sc in c.schema.cols
+                                   if sc.col.idx in needed})
+    if plan.children and not isinstance(plan, (Dual, ProjShell)):
+        pass
+
+
+# ---------------- TopN derivation ----------------
+
+def build_topn(plan: LogicalPlan) -> LogicalPlan:
+    """Limit(Sort(x)) -> TopN(x) (reference rule_topn_push_down.go)."""
+    plan.children = [build_topn(c) for c in plan.children]
+    if isinstance(plan, LimitOp) and isinstance(plan.child, Sort) \
+            and plan.count >= 0:
+        sort = plan.child
+        t = TopN(sort.items, plan.offset, plan.count, sort.child)
+        t.schema = sort.schema
+        t.stats_rows = min(sort.child.stats_rows, float(plan.count + plan.offset))
+        return t
+    return plan

@@ -1,0 +1,83 @@
+"""Plan schemas: ordered column lists with unique ids + name resolution
+(reference pkg/expression/schema.go + name resolution in
+planner/core/logical_plan_builder.go)."""
+from __future__ import annotations
+
+from ..expression import Column
+from ..errors import ColumnNotExistsError, AmbiguousColumnError
+
+
+class SchemaCol:
+    __slots__ = ("col", "name", "table", "db", "hidden")
+
+    def __init__(self, col: Column, name: str, table: str = "", db: str = "",
+                 hidden: bool = False):
+        self.col = col          # expression.Column (unique id + ft)
+        self.name = name.lower()
+        self.table = table.lower()
+        self.db = db.lower()
+        self.hidden = hidden
+
+    def display(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+class Schema:
+    def __init__(self, cols: list[SchemaCol] | None = None):
+        self.cols = cols or []
+
+    def __len__(self):
+        return len(self.cols)
+
+    def visible(self):
+        return [c for c in self.cols if not c.hidden]
+
+    def append(self, sc: SchemaCol):
+        self.cols.append(sc)
+
+    def extend(self, other: "Schema"):
+        self.cols.extend(other.cols)
+
+    def columns(self) -> list[Column]:
+        return [c.col for c in self.cols]
+
+    def find_idx_by_id(self, uid: int) -> int:
+        for i, c in enumerate(self.cols):
+            if c.col.idx == uid:
+                return i
+        return -1
+
+    def resolve(self, name: str, table: str = "", db: str = "") -> SchemaCol:
+        name = name.lower()
+        table = table.lower()
+        matches = []
+        for c in self.cols:
+            if c.name != name:
+                continue
+            if table and c.table != table:
+                continue
+            if db and c.db != db:
+                continue
+            matches.append(c)
+        visible = [m for m in matches if not m.hidden]
+        if visible:
+            matches = visible
+        if not matches:
+            raise ColumnNotExistsError(
+                "Unknown column '%s'",
+                f"{table}.{name}" if table else name)
+        if len(matches) > 1:
+            # same unique id through both join sides (USING) is not ambiguous
+            ids = {m.col.idx for m in matches}
+            if len(ids) > 1:
+                raise AmbiguousColumnError("Column '%s' is ambiguous", name)
+        return matches[0]
+
+    def try_resolve(self, name, table="", db=""):
+        try:
+            return self.resolve(name, table, db)
+        except ColumnNotExistsError:
+            return None
+
+    def clone(self) -> "Schema":
+        return Schema(list(self.cols))

@@ -1,0 +1,6 @@
+from .session import Session, ResultSet, new_store, bootstrap
+from .domain import Domain
+from .sysvars import SessionVars
+
+__all__ = ["Session", "ResultSet", "new_store", "bootstrap", "Domain",
+           "SessionVars"]

@@ -1,0 +1,381 @@
+"""DDL execution (reference pkg/ddl — the F1 online state machine collapsed
+to single-step transitions since DDL is in-process and transactional here;
+the SchemaState fields exist so the staged path can be distributed later)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..parser import ast
+from ..meta import Mutator
+from ..models import DBInfo, TableInfo, ColumnInfo, IndexInfo, SchemaState
+from ..types import FieldType
+from ..types.field_type import MYSQL_TYPE_NAMES, TypeClass
+from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
+                      TableExistsError, TableNotExistsError,
+                      DuplicateColumnError, ColumnNotExistsError,
+                      IndexExistsError, IndexNotExistsError,
+                      UnsupportedError)
+from ..executor import table_rt
+
+
+def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInfo:
+    tname = cd.type_name.lower()
+    tclass = MYSQL_TYPE_NAMES.get(tname)
+    if tclass is None:
+        raise UnsupportedError("unsupported column type %s", tname)
+    ft = FieldType(tp=tname, tclass=tclass)
+    ft.flen = cd.flen
+    ft.decimal = cd.decimal
+    if tclass == TypeClass.DECIMAL:
+        if ft.flen <= 0:
+            ft.flen = 10
+        if ft.decimal < 0:
+            ft.decimal = 0
+    ft.unsigned = cd.unsigned
+    ft.not_null = cd.not_null or cd.primary_key
+    ft.auto_increment = cd.auto_increment
+    ft.primary_key = cd.primary_key
+    ft.elems = cd.enum_vals
+    if cd.has_default:
+        ft.has_default = True
+        ft.default_value = cd.default_value
+    return ColumnInfo(id=col_id, name=cd.name, offset=offset, ft=ft,
+                      comment=cd.comment)
+
+
+class DDLExecutor:
+    def __init__(self, sess):
+        self.sess = sess
+        self.domain = sess.domain
+
+    def _with_meta(self, fn):
+        """Run fn(mutator) in its own txn and bump schema version."""
+        txn = self.domain.storage.begin()
+        try:
+            m = Mutator(txn)
+            result = fn(m)
+            m.gen_schema_version()
+            txn.commit()
+            return result
+        except BaseException:
+            txn.rollback()
+            raise
+
+    # ---- databases ----------------------------------------------------
+    def create_database(self, stmt: ast.CreateDatabaseStmt):
+        def fn(m):
+            for db in m.list_databases():
+                if db.name.lower() == stmt.name.lower():
+                    if stmt.if_not_exists:
+                        return
+                    raise DatabaseExistsError(
+                        "Can't create database '%s'; database exists", stmt.name)
+            m.create_database(DBInfo(id=m.gen_global_id(), name=stmt.name))
+        self._with_meta(fn)
+
+    def drop_database(self, stmt: ast.DropDatabaseStmt):
+        def fn(m):
+            target = None
+            for db in m.list_databases():
+                if db.name.lower() == stmt.name.lower():
+                    target = db
+                    break
+            if target is None:
+                if stmt.if_exists:
+                    return
+                raise DatabaseNotExistsError(
+                    "Can't drop database '%s'; database doesn't exist", stmt.name)
+            for t in m.list_tables(target.id):
+                self.domain.columnar.drop_table(t.id)
+            m.drop_database(target.id)
+        self._with_meta(fn)
+        if self.sess.vars.current_db.lower() == stmt.name.lower():
+            self.sess.vars.current_db = ""
+
+    # ---- tables -------------------------------------------------------
+    def create_table(self, stmt: ast.CreateTableStmt):
+        db_name = stmt.table.db or self.sess.vars.current_db
+        if "as_select" in stmt.options or "like" in stmt.options:
+            raise UnsupportedError("CREATE TABLE AS/LIKE not supported yet")
+
+        def fn(m):
+            db = self._db_by_name(m, db_name)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.table.name.lower():
+                    if stmt.if_not_exists:
+                        return None
+                    raise TableExistsError("Table '%s' already exists",
+                                           stmt.table.name)
+            tid = m.gen_global_id()
+            cols = []
+            seen = set()
+            for i, cd in enumerate(stmt.columns):
+                if cd.name.lower() in seen:
+                    raise DuplicateColumnError("Duplicate column name '%s'",
+                                               cd.name)
+                seen.add(cd.name.lower())
+                cols.append(column_def_to_info(cd, i + 1, i))
+            tbl = TableInfo(id=tid, name=stmt.table.name, columns=cols)
+            next_idx_id = 1
+            # column-level PK/unique
+            for i, cd in enumerate(stmt.columns):
+                if cd.primary_key:
+                    tbl.indexes.append(IndexInfo(
+                        id=next_idx_id, name="PRIMARY", columns=[cd.name],
+                        unique=True, primary=True))
+                    next_idx_id += 1
+                if cd.unique:
+                    tbl.indexes.append(IndexInfo(
+                        id=next_idx_id, name=f"uk_{cd.name}",
+                        columns=[cd.name], unique=True))
+                    next_idx_id += 1
+            for idx in stmt.indexes:
+                for cn in idx.columns:
+                    if tbl.find_column(cn) is None:
+                        raise ColumnNotExistsError(
+                            "Key column '%s' doesn't exist in table", cn)
+                if idx.primary:
+                    for cn in idx.columns:
+                        tbl.find_column(cn).ft.not_null = True
+                tbl.indexes.append(IndexInfo(
+                    id=next_idx_id, name=idx.name, columns=list(idx.columns),
+                    unique=idx.unique, primary=idx.primary))
+                next_idx_id += 1
+            # clustered integer PK -> handle (reference pk_is_handle)
+            pk = next((i for i in tbl.indexes if i.primary), None)
+            if pk is not None and len(pk.columns) == 1:
+                ci = tbl.find_column(pk.columns[0])
+                if ci is not None and ci.ft.tclass in (TypeClass.INT,
+                                                       TypeClass.UINT):
+                    tbl.pk_is_handle = True
+                    tbl.pk_col_name = ci.name
+                    tbl.indexes = [i for i in tbl.indexes if not i.primary]
+            m.create_table(db.id, tbl)
+            return tbl
+        self._with_meta(fn)
+
+    def drop_table(self, stmt: ast.DropTableStmt):
+        def fn(m):
+            for tn in stmt.tables:
+                db_name = tn.db or self.sess.vars.current_db
+                db = self._db_by_name(m, db_name)
+                target = None
+                for t in m.list_tables(db.id):
+                    if t.name.lower() == tn.name.lower():
+                        target = t
+                        break
+                if target is None:
+                    if stmt.if_exists:
+                        continue
+                    raise TableNotExistsError("Unknown table '%s'", tn.name)
+                m.drop_table(db.id, target.id)
+                self.domain.columnar.drop_table(target.id)
+        self._with_meta(fn)
+
+    def truncate_table(self, stmt: ast.TruncateTableStmt):
+        tn = stmt.table
+
+        def fn(m):
+            db = self._db_by_name(m, tn.db or self.sess.vars.current_db)
+            target = None
+            for t in m.list_tables(db.id):
+                if t.name.lower() == tn.name.lower():
+                    target = t
+                    break
+            if target is None:
+                raise TableNotExistsError("Unknown table '%s'", tn.name)
+            m.drop_table(db.id, target.id)
+            self.domain.columnar.drop_table(target.id)
+            target.id = m.gen_global_id()
+            m.create_table(db.id, target)
+        self._with_meta(fn)
+
+    def rename_table(self, stmt: ast.RenameTableStmt):
+        def fn(m):
+            for old, new in stmt.pairs:
+                db = self._db_by_name(m, old.db or self.sess.vars.current_db)
+                ndb = self._db_by_name(m, new.db or self.sess.vars.current_db)
+                target = None
+                for t in m.list_tables(db.id):
+                    if t.name.lower() == old.name.lower():
+                        target = t
+                        break
+                if target is None:
+                    raise TableNotExistsError("Unknown table '%s'", old.name)
+                m.drop_table(db.id, target.id)
+                target.name = new.name
+                m.create_table(ndb.id, target)
+        self._with_meta(fn)
+
+    # ---- indexes / alter ---------------------------------------------
+    def create_index(self, stmt: ast.CreateIndexStmt):
+        tn = stmt.table
+        idx_def = ast.IndexDef(name=stmt.index_name, columns=stmt.columns,
+                               unique=stmt.unique)
+        self._alter_add_index(tn, idx_def)
+
+    def drop_index(self, stmt: ast.DropIndexStmt):
+        tn = stmt.table
+
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            idx = tbl.find_index(stmt.index_name)
+            if idx is None:
+                raise IndexNotExistsError("index %s doesn't exist",
+                                          stmt.index_name)
+            tbl.indexes = [i for i in tbl.indexes if i is not idx]
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def alter_table(self, stmt: ast.AlterTableStmt):
+        for action, payload in stmt.actions:
+            if action == "add_column":
+                self._alter_add_column(stmt.table, payload)
+            elif action == "drop_column":
+                self._alter_drop_column(stmt.table, payload)
+            elif action == "add_index":
+                self._alter_add_index(stmt.table, payload)
+            elif action == "drop_index":
+                self.drop_index(ast.DropIndexStmt(index_name=payload,
+                                                  table=stmt.table))
+            elif action == "modify_column":
+                self._alter_modify_column(stmt.table, payload)
+            elif action == "rename":
+                self.rename_table(ast.RenameTableStmt(
+                    pairs=[(stmt.table, payload)]))
+            else:
+                raise UnsupportedError("unsupported ALTER action %s", action)
+
+    def _alter_add_column(self, tn, cd: ast.ColumnDef):
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            if tbl.find_column(cd.name) is not None:
+                raise DuplicateColumnError("Duplicate column name '%s'", cd.name)
+            col_id = max((c.id for c in tbl.columns), default=0) + 1
+            ci = column_def_to_info(cd, col_id, len(tbl.columns))
+            if ci.ft.not_null and not ci.ft.has_default:
+                ci.ft.default_value = _zero_default(ci.ft)
+                ci.ft.has_default = True
+            tbl.columns.append(ci)
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def _alter_drop_column(self, tn, name):
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            ci = tbl.find_column(name)
+            if ci is None:
+                raise ColumnNotExistsError("Unknown column '%s'", name)
+            for idx in tbl.indexes:
+                if name.lower() in [c.lower() for c in idx.columns]:
+                    raise UnsupportedError(
+                        "cannot drop column '%s' covered by index '%s'",
+                        name, idx.name)
+            if tbl.pk_is_handle and tbl.pk_col_name.lower() == name.lower():
+                raise UnsupportedError("cannot drop the primary key column")
+            tbl.columns = [c for c in tbl.columns if c is not ci]
+            for i, c in enumerate(tbl.columns):
+                c.offset = i
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def _alter_modify_column(self, tn, cd: ast.ColumnDef):
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            ci = tbl.find_column(cd.name)
+            if ci is None:
+                raise ColumnNotExistsError("Unknown column '%s'", cd.name)
+            new_ci = column_def_to_info(cd, ci.id, ci.offset)
+            if new_ci.ft.tclass != ci.ft.tclass:
+                raise UnsupportedError(
+                    "column type change across classes needs reorg "
+                    "(not supported yet)")
+            tbl.columns[ci.offset] = new_ci
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def _alter_add_index(self, tn, idx_def):
+        """Add index + synchronous backfill (reference: write-reorg state +
+        backfill workers, ddl/backfilling*.go — here one transaction)."""
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            if tbl.find_index(idx_def.name) is not None:
+                raise IndexExistsError("Duplicate key name '%s'", idx_def.name)
+            for cn in idx_def.columns:
+                if tbl.find_column(cn) is None:
+                    raise ColumnNotExistsError(
+                        "Key column '%s' doesn't exist in table", cn)
+            idx = IndexInfo(
+                id=max((i.id for i in tbl.indexes), default=0) + 1,
+                name=idx_def.name, columns=list(idx_def.columns),
+                unique=idx_def.unique, primary=idx_def.primary)
+            tbl.indexes.append(idx)
+            m.update_table(db.id, tbl)
+            return db, tbl, idx
+        result = self._with_meta(fn)
+        if result is None:
+            return
+        db, tbl, idx = result
+        # backfill from columnar snapshot
+        ctab = self.domain.columnar.tables.get(tbl.id)
+        if ctab is None or ctab.live_count() == 0:
+            return
+        txn = self.domain.storage.begin()
+        try:
+            from ..codec.tablecodec import index_key
+            valid = ctab.valid_at()
+            idxs = np.nonzero(valid)[0]
+            cols = [tbl.find_column(c) for c in idx.columns]
+            for i in idxs.tolist():
+                handle = int(ctab.handles[i])
+                datums = []
+                for ci in cols:
+                    col = ctab.column_for(ci)
+                    datums.append(col.get_datum(i))
+                if idx.unique and not any(d.is_null for d in datums):
+                    ik = index_key(tbl.id, idx.id, datums)
+                    existing = txn.get(ik)
+                    if existing is not None:
+                        raise DuplicateKeyError(
+                            "Duplicate entry for key '%s'", idx.name)
+                    txn.set(ik, str(handle).encode())
+                else:
+                    txn.set(index_key(tbl.id, idx.id, datums, handle), b"")
+            txn.commit()
+        except BaseException:
+            txn.rollback()
+            # roll back the meta change
+            def undo(m):
+                db2, tbl2 = self._get_table(m, tn)
+                tbl2.indexes = [i for i in tbl2.indexes
+                                if i.name.lower() != idx.name.lower()]
+                m.update_table(db2.id, tbl2)
+            self._with_meta(undo)
+            raise
+
+    # ---- helpers ------------------------------------------------------
+    def _db_by_name(self, m, name):
+        if not name:
+            raise NoDatabaseSelectedError("No database selected")
+        for db in m.list_databases():
+            if db.name.lower() == name.lower():
+                return db
+        raise DatabaseNotExistsError("Unknown database '%s'", name)
+
+    def _get_table(self, m, tn):
+        db = self._db_by_name(m, tn.db or self.sess.vars.current_db)
+        for t in m.list_tables(db.id):
+            if t.name.lower() == tn.name.lower():
+                return db, t
+        raise TableNotExistsError("Unknown table '%s'", tn.name)
+
+
+def _zero_default(ft):
+    if ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+        return ""
+    if ft.tclass == TypeClass.FLOAT:
+        return 0.0
+    return 0
+
+
+from ..errors import NoDatabaseSelectedError, DuplicateKeyError  # noqa: E402

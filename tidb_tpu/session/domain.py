@@ -1,0 +1,76 @@
+"""Domain: per-process singleton binding storage + schema + engines
+(reference pkg/domain/domain.go:556)."""
+from __future__ import annotations
+
+import threading
+
+from ..storage import Storage
+from ..storage.columnar import ColumnarEngine
+from ..infoschema import InfoSchemaCache
+from ..copr import CoprExecutor
+from ..utils.memory import Tracker
+
+
+class _Allocator:
+    """Per-table id allocator (reference pkg/meta/autoid). In-memory;
+    rebased from data on first use."""
+
+    def __init__(self, start=0):
+        self._next = start + 1
+        self._mu = threading.Lock()
+
+    def next(self) -> int:
+        with self._mu:
+            v = self._next
+            self._next += 1
+            return v
+
+    next_handle = next
+
+    def rebase(self, v: int):
+        with self._mu:
+            if v >= self._next:
+                self._next = v + 1
+
+
+class Domain:
+    def __init__(self):
+        self.storage = Storage()
+        self.is_cache = InfoSchemaCache(self.storage)
+        self.columnar = ColumnarEngine(self.storage, self._table_info_by_id)
+        self.copr = CoprExecutor(self.columnar)
+        self._allocators: dict[int, _Allocator] = {}
+        self.global_vars: dict[str, object] = {}
+        self.user_vars: dict[str, object] = {}
+        self.mem_root = Tracker("global")
+        self.stats = {}        # table_id -> stats (module stats/, ANALYZE)
+        self.slow_log: list = []
+        self.stmt_summary: list = []
+
+    def _table_info_by_id(self, tid: int):
+        return self.infoschema().table_by_id(tid)
+
+    def infoschema(self):
+        return self.is_cache.current()
+
+    def allocator(self, tbl) -> _Allocator:
+        a = self._allocators.get(tbl.id)
+        if a is None:
+            ctab = self.columnar.tables.get(tbl.id)
+            start = 0
+            if ctab is not None and ctab.n:
+                start = int(ctab.handles[:ctab.n].max())
+            if tbl.pk_is_handle:
+                start = max(start, tbl.auto_inc_id)
+            a = _Allocator(start)
+            self._allocators[tbl.id] = a
+        return a
+
+    def mem_tracker_factory(self, quota):
+        return self.mem_root.child("query", quota)
+
+    def table_rows(self, db: str, tbl) -> float:
+        ctab = self.columnar.tables.get(tbl.id)
+        if ctab is None:
+            return 10.0
+        return float(max(ctab.live_count(), 1))

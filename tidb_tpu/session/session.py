@@ -1,0 +1,315 @@
+"""Session: statement lifecycle (reference pkg/session/session.go:2416
+ExecuteStmt / runStmt:2940). Parse -> plan -> execute, transaction begin /
+commit-on-autocommit, DDL and utility statement dispatch."""
+from __future__ import annotations
+
+import time
+
+from ..parser import parse, ast
+from ..planner import optimize, PlanContext
+from ..planner.builder import InsertPlan, UpdatePlan, DeletePlan
+from ..planner.physical import explain_text
+from ..executor import build_executor, ExecContext
+from ..executor.dml import InsertExec, UpdateExec, DeleteExec
+from ..errors import TiDBError, UnsupportedError, NoDatabaseSelectedError
+from .sysvars import SessionVars, all_sysvars
+from .domain import Domain
+from .ddl import DDLExecutor
+
+
+class ResultSet:
+    def __init__(self, names=None, chunks=None, affected=0, last_insert_id=0):
+        self.names = names or []
+        self.chunks = chunks or []
+        self.affected = affected
+        self.last_insert_id = last_insert_id
+
+    @property
+    def rows(self):
+        out = []
+        for ch in self.chunks:
+            out.extend(ch.rows_py())
+        return out
+
+    def __repr__(self):
+        return f"ResultSet({self.names}, {len(self.rows)} rows)"
+
+
+class Session:
+    _next_conn_id = [0]
+
+    def __init__(self, domain: Domain):
+        self.domain = domain
+        self.vars = SessionVars(domain.global_vars)
+        self._txn = None
+        self._explicit_txn = False
+        Session._next_conn_id[0] += 1
+        self.conn_id = Session._next_conn_id[0]
+        self.ddl = DDLExecutor(self)
+
+    # ---- txn lifecycle ------------------------------------------------
+    def txn(self):
+        if self._txn is None or self._txn.committed or self._txn.aborted:
+            self._txn = self.domain.storage.begin(
+                pessimistic=self.vars.get("tidb_txn_mode") == "pessimistic")
+        return self._txn
+
+    def _finish_stmt(self, error=False):
+        if self._explicit_txn:
+            if error and self._txn is not None:
+                pass  # MySQL keeps txn open on statement error
+            return
+        if self._txn is not None and not self._txn.committed and \
+                not self._txn.aborted:
+            if error:
+                self._txn.rollback()
+            else:
+                self._txn.commit()
+        self._txn = None
+
+    def commit(self):
+        if self._txn is not None and not self._txn.committed and \
+                not self._txn.aborted:
+            self._txn.commit()
+        self._txn = None
+        self._explicit_txn = False
+
+    def rollback(self):
+        if self._txn is not None and not self._txn.committed and \
+                not self._txn.aborted:
+            self._txn.rollback()
+        self._txn = None
+        self._explicit_txn = False
+
+    # ---- public entry --------------------------------------------------
+    def execute(self, sql: str, params=None) -> ResultSet:
+        stmts = parse(sql)
+        result = ResultSet()
+        for stmt in stmts:
+            result = self._execute_stmt(stmt, params)
+        return result
+
+    def _execute_stmt(self, stmt, params=None) -> ResultSet:
+        start = time.time()
+        try:
+            rs = self._dispatch(stmt, params)
+            self._record_slow(stmt, start)
+            return rs
+        except TiDBError:
+            self._finish_stmt(error=True)
+            raise
+
+    def _record_slow(self, stmt, start):
+        dur_ms = (time.time() - start) * 1000.0
+        threshold = int(self.vars.get("tidb_slow_log_threshold"))
+        if threshold >= 0 and dur_ms > threshold:
+            self.domain.slow_log.append(
+                {"time_ms": dur_ms, "stmt": type(stmt).__name__})
+
+    def _plan_ctx(self, params=None) -> PlanContext:
+        return PlanContext(
+            infoschema=self.domain.infoschema(),
+            sess_vars=self.vars,
+            current_db=self.vars.current_db,
+            run_subquery=self._run_subquery,
+            table_rows=self.domain.table_rows,
+            user_vars=self.domain.user_vars,
+            now_micros=int(time.time() * 1_000_000),
+            conn_id=self.conn_id,
+            params=params,
+        )
+
+    def _run_subquery(self, select_stmt, limit_one=False):
+        plan = optimize(select_stmt, self._plan_ctx())
+        ectx = ExecContext(self)
+        ex = build_executor(ectx, plan)
+        ex.open()
+        try:
+            chunks = ex.all_chunks()
+        finally:
+            ex.close()
+        rows = []
+        fts = [sc.col.ft for sc in plan.schema.visible()]
+        vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
+        for ch in chunks:
+            for i in range(len(ch)):
+                rows.append(tuple(ch.columns[j].get_datum(i) for j in vis))
+                if limit_one and rows:
+                    return rows, fts
+        return rows, fts
+
+    # ---- dispatch -------------------------------------------------------
+    def _dispatch(self, stmt, params=None) -> ResultSet:
+        if isinstance(stmt, ast.SelectStmt):
+            return self._exec_select(stmt, params)
+        if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt)):
+            return self._exec_dml(stmt, params)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, ast.UseStmt):
+            self.domain.infoschema().schema_by_name(stmt.db)
+            self.vars.current_db = stmt.db
+            return ResultSet()
+        if isinstance(stmt, ast.SetStmt):
+            return self._exec_set(stmt)
+        if isinstance(stmt, ast.ShowStmt):
+            from .show import exec_show
+            return exec_show(self, stmt)
+        if isinstance(stmt, ast.DescTableStmt):
+            from .show import exec_desc
+            return exec_desc(self, stmt.table)
+        if isinstance(stmt, ast.BeginStmt):
+            self.commit()
+            self._explicit_txn = True
+            self.txn()
+            return ResultSet()
+        if isinstance(stmt, ast.CommitStmt):
+            self.commit()
+            return ResultSet()
+        if isinstance(stmt, ast.RollbackStmt):
+            self.rollback()
+            return ResultSet()
+        if isinstance(stmt, ast.AnalyzeTableStmt):
+            from ..stats.analyze import analyze_tables
+            analyze_tables(self, stmt.tables)
+            return ResultSet()
+        if isinstance(stmt, ast.ImportStmt):
+            from ..executor.importer import exec_import
+            return exec_import(self, stmt)
+        # DDL: implicit commit first (MySQL semantics)
+        ddl_map = {
+            ast.CreateDatabaseStmt: self.ddl.create_database,
+            ast.DropDatabaseStmt: self.ddl.drop_database,
+            ast.CreateTableStmt: self.ddl.create_table,
+            ast.DropTableStmt: self.ddl.drop_table,
+            ast.TruncateTableStmt: self.ddl.truncate_table,
+            ast.RenameTableStmt: self.ddl.rename_table,
+            ast.CreateIndexStmt: self.ddl.create_index,
+            ast.DropIndexStmt: self.ddl.drop_index,
+            ast.AlterTableStmt: self.ddl.alter_table,
+        }
+        fn = ddl_map.get(type(stmt))
+        if fn is not None:
+            self.commit()
+            fn(stmt)
+            return ResultSet()
+        raise UnsupportedError("statement %s not supported",
+                               type(stmt).__name__)
+
+    def _exec_select(self, stmt, params=None) -> ResultSet:
+        plan = optimize(stmt, self._plan_ctx(params))
+        ectx = ExecContext(self)
+        ex = build_executor(ectx, plan)
+        ex.open()
+        try:
+            chunks = ex.all_chunks()
+        finally:
+            ex.close()
+        vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
+        names = [plan.schema.cols[i].name for i in vis]
+        out_chunks = []
+        from ..chunk.chunk import Chunk
+        for ch in chunks:
+            out_chunks.append(Chunk([ch.columns[i] for i in vis]))
+        self._finish_stmt()
+        return ResultSet(names=names, chunks=out_chunks)
+
+    def _exec_dml(self, stmt, params=None) -> ResultSet:
+        plan = optimize(stmt, self._plan_ctx(params))
+        ectx = ExecContext(self)
+        txn = self.txn()   # ensure txn exists before write
+        try:
+            if isinstance(plan, InsertPlan):
+                affected = InsertExec(ectx, plan, self).execute()
+            elif isinstance(plan, UpdatePlan):
+                affected = UpdateExec(ectx, plan, self).execute()
+            elif isinstance(plan, DeletePlan):
+                affected = DeleteExec(ectx, plan, self).execute()
+            else:
+                raise UnsupportedError("bad DML plan")
+        except TiDBError:
+            self._finish_stmt(error=True)
+            raise
+        self.vars.affected_rows = affected
+        self._finish_stmt()
+        return ResultSet(affected=affected,
+                         last_insert_id=self.vars.last_insert_id)
+
+    def _exec_set(self, stmt: ast.SetStmt) -> ResultSet:
+        from ..executor.exec_base import expr_to_datum
+        from ..planner.rewriter import Rewriter
+        from ..planner.schema import Schema
+        pctx = self._plan_ctx()
+        for name, expr_node, is_global, is_system in stmt.assignments:
+            rw = Rewriter(pctx, Schema())
+            e = rw.rewrite(expr_node)
+            d = expr_to_datum(e)
+            v = d.to_py()
+            if is_system:
+                self.vars.set(name, v, is_global=is_global)
+            else:
+                self.domain.user_vars[name.lower()] = v
+        return ResultSet()
+
+    def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        inner = stmt.stmt
+        plan = optimize(inner, self._plan_ctx())
+        from ..chunk.chunk import Chunk
+        from ..chunk.column import Column
+        from ..types.field_type import new_string_type
+        import numpy as np
+        if isinstance(plan, (InsertPlan, UpdatePlan, DeletePlan)):
+            rows = [(type(plan).__name__, "N/A", "")]
+            if plan.select_plan is not None:
+                rows += [(f"└─{r[0]}", r[1], r[2])
+                         for r in explain_text(plan.select_plan)]
+        else:
+            rows = explain_text(plan)
+        names = ["id", "estRows", "operator info"]
+        cols = []
+        for j in range(3):
+            arr = np.array([r[j] for r in rows], dtype=object)
+            cols.append(Column(new_string_type(), arr))
+        self._finish_stmt()
+        return ResultSet(names=names, chunks=[Chunk(cols)])
+
+
+def bootstrap(domain: Domain) -> None:
+    """Create system databases (reference pkg/session/bootstrap.go:63)."""
+    from ..meta import Mutator
+    from ..models import DBInfo
+    txn = domain.storage.begin()
+    try:
+        m = Mutator(txn)
+        if m.list_databases():
+            txn.rollback()
+            return
+        for name in ("mysql", "test", "information_schema"):
+            m.create_database(DBInfo(id=m.gen_global_id(), name=name))
+        m.gen_schema_version()
+        txn.commit()
+    except BaseException:
+        txn.rollback()
+        raise
+    sess = Session(domain)
+    sess.vars.current_db = "mysql"
+    sess.execute("""
+        CREATE TABLE tidb (
+          variable_name VARCHAR(64) NOT NULL PRIMARY KEY,
+          variable_value VARCHAR(1024),
+          comment VARCHAR(1024))""")
+    sess.execute("""
+        CREATE TABLE global_variables (
+          variable_name VARCHAR(64) NOT NULL PRIMARY KEY,
+          variable_value VARCHAR(1024))""")
+    sess.execute(
+        "INSERT INTO tidb VALUES ('bootstrapped', 'True', 'Bootstrap flag'), "
+        "('tidb_server_version', '1', 'Bootstrap version')")
+
+
+def new_store() -> Domain:
+    """Create a bootstrapped in-process store (reference
+    testkit.CreateMockStore)."""
+    domain = Domain()
+    bootstrap(domain)
+    return domain

@@ -1,0 +1,134 @@
+"""SHOW / DESCRIBE statements (reference pkg/executor/show.go)."""
+from __future__ import annotations
+
+import fnmatch
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+from ..types.field_type import new_string_type
+from .sysvars import all_sysvars
+
+
+def _str_chunk(names, rows):
+    cols = []
+    for j in range(len(names)):
+        arr = np.empty(len(rows), dtype=object)
+        nulls = np.zeros(len(rows), dtype=bool)
+        for i, r in enumerate(rows):
+            v = r[j]
+            if v is None:
+                nulls[i] = True
+                arr[i] = ""
+            else:
+                arr[i] = str(v)
+        cols.append(Column(new_string_type(), arr,
+                           nulls if nulls.any() else None))
+    from .session import ResultSet
+    return ResultSet(names=names, chunks=[Chunk(cols)])
+
+
+def _like_filter(rows, like, col=0):
+    if not like:
+        return rows
+    pat = like.replace("%", "*").replace("_", "?")
+    return [r for r in rows if fnmatch.fnmatch(str(r[col]).lower(),
+                                               pat.lower())]
+
+
+def exec_show(sess, stmt):
+    kind = stmt.kind
+    ischema = sess.domain.infoschema()
+    if kind == "databases":
+        rows = sorted([(db.name,) for db in ischema.all_schemas()])
+        return _str_chunk(["Database"], _like_filter(rows, stmt.like))
+    if kind == "tables":
+        db = stmt.db or sess.vars.current_db
+        from ..errors import NoDatabaseSelectedError
+        if not db:
+            raise NoDatabaseSelectedError("No database selected")
+        rows = sorted([(t.name,) for t in ischema.tables_in_schema(db)])
+        return _str_chunk([f"Tables_in_{db}"], _like_filter(rows, stmt.like))
+    if kind == "columns":
+        db = stmt.db or stmt.table.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, stmt.table.name)
+        rows = []
+        for c in tbl.public_columns():
+            key = ""
+            if tbl.pk_is_handle and c.name.lower() == tbl.pk_col_name.lower():
+                key = "PRI"
+            else:
+                for idx in tbl.indexes:
+                    if idx.columns and idx.columns[0].lower() == c.name.lower():
+                        key = "PRI" if idx.primary else (
+                            "UNI" if idx.unique else "MUL")
+                        break
+            rows.append((c.name, c.ft.sql_string(),
+                         "NO" if c.ft.not_null else "YES", key,
+                         c.ft.default_value if c.ft.has_default else None,
+                         "auto_increment" if c.ft.auto_increment else ""))
+        return _str_chunk(["Field", "Type", "Null", "Key", "Default", "Extra"],
+                          _like_filter(rows, stmt.like))
+    if kind == "variables":
+        seen = {}
+        for name, var in sorted(all_sysvars().items()):
+            seen[name] = sess.vars.get(name)
+        rows = [(k, "ON" if v is True else "OFF" if v is False else str(v))
+                for k, v in sorted(seen.items())]
+        return _str_chunk(["Variable_name", "Value"],
+                          _like_filter(rows, stmt.like))
+    if kind == "create_table":
+        db = stmt.table.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, stmt.table.name)
+        lines = []
+        for c in tbl.public_columns():
+            line = f"  `{c.name}` {c.ft.sql_string()}"
+            if c.ft.not_null:
+                line += " NOT NULL"
+            if c.ft.has_default and c.ft.default_value is not None:
+                line += f" DEFAULT '{c.ft.default_value}'"
+            if c.ft.auto_increment:
+                line += " AUTO_INCREMENT"
+            lines.append(line)
+        if tbl.pk_is_handle:
+            lines.append(f"  PRIMARY KEY (`{tbl.pk_col_name}`)")
+        for idx in tbl.indexes:
+            colstr = ", ".join(f"`{c}`" for c in idx.columns)
+            if idx.primary:
+                lines.append(f"  PRIMARY KEY ({colstr})")
+            elif idx.unique:
+                lines.append(f"  UNIQUE KEY `{idx.name}` ({colstr})")
+            else:
+                lines.append(f"  KEY `{idx.name}` ({colstr})")
+        ddl = (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +
+               "\n) ENGINE=InnoDB DEFAULT CHARSET=utf8mb4")
+        return _str_chunk(["Table", "Create Table"], [(tbl.name, ddl)])
+    if kind == "index":
+        db = stmt.table.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, stmt.table.name)
+        rows = []
+        if tbl.pk_is_handle:
+            rows.append((tbl.name, 0, "PRIMARY", 1, tbl.pk_col_name))
+        for idx in tbl.indexes:
+            for seq, c in enumerate(idx.columns):
+                rows.append((tbl.name, 0 if idx.unique else 1,
+                             idx.name, seq + 1, c))
+        return _str_chunk(["Table", "Non_unique", "Key_name", "Seq_in_index",
+                           "Column_name"], rows)
+    if kind == "warnings":
+        rows = [(w.get("level", "Warning"), w.get("code", 1105),
+                 w.get("msg", "")) for w in sess.vars.warnings]
+        return _str_chunk(["Level", "Code", "Message"], rows)
+    if kind == "processlist":
+        rows = [(sess.conn_id, "root", "localhost",
+                 sess.vars.current_db or None, "Query", 0, "", None)]
+        return _str_chunk(["Id", "User", "Host", "db", "Command", "Time",
+                           "State", "Info"], rows)
+    from ..errors import UnsupportedError
+    raise UnsupportedError("SHOW %s not supported", kind)
+
+
+def exec_desc(sess, table_name):
+    from ..parser import ast
+    return exec_show(sess, ast.ShowStmt(kind="columns", table=table_name))

@@ -1,0 +1,60 @@
+"""ANALYZE TABLE: column statistics for the planner (reference
+pkg/statistics — histograms, CM-sketch, TopN; round 1 collects the
+vectorizable core: row count, NDV, null count, min/max, equal-depth
+histogram from numpy — TPU-offload of sketch building is an ops/ roadmap
+item)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.field_type import TypeClass
+
+
+class ColumnStats:
+    __slots__ = ("ndv", "null_count", "min_val", "max_val", "histogram")
+
+    def __init__(self, ndv=0, null_count=0, min_val=None, max_val=None,
+                 histogram=None):
+        self.ndv = ndv
+        self.null_count = null_count
+        self.min_val = min_val
+        self.max_val = max_val
+        self.histogram = histogram   # (bucket_bounds, counts)
+
+
+class TableStats:
+    __slots__ = ("row_count", "columns", "version")
+
+    def __init__(self, row_count=0):
+        self.row_count = row_count
+        self.columns: dict[str, ColumnStats] = {}
+        self.version = 0
+
+
+def analyze_tables(sess, table_names):
+    ischema = sess.domain.infoschema()
+    for tn in table_names:
+        db = tn.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, tn.name)
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        ts = TableStats(row_count=0 if ctab is None else ctab.live_count())
+        if ctab is not None and ctab.n:
+            valid = ctab.valid_at()
+            for ci in tbl.public_columns():
+                data = ctab.data[ci.id][:ctab.n][valid]
+                nulls = ctab.nulls[ci.id][:ctab.n][valid]
+                nn = data[~nulls]
+                cs = ColumnStats(null_count=int(nulls.sum()))
+                if len(nn):
+                    uniq = np.unique(nn)
+                    cs.ndv = len(uniq)
+                    cs.min_val = uniq[0]
+                    cs.max_val = uniq[-1]
+                    if nn.dtype.kind in "if" and len(nn) > 1:
+                        qs = np.linspace(0, 1, min(65, max(len(uniq), 2)))
+                        bounds = np.quantile(nn, qs)
+                        counts, _ = np.histogram(nn, bounds)
+                        cs.histogram = (bounds, counts)
+                ts.columns[ci.name] = cs
+        ts.version = sess.domain.storage.current_ts()
+        sess.domain.stats[tbl.id] = ts

@@ -1,0 +1,244 @@
+"""Columnar engine (reference role: TiFlash — columnar replica fed by raft
+learner; here fed by MVCCStore.commit_hooks in-process).
+
+Per table: consolidated numpy arrays per column (amortized doubling),
+string columns dictionary-encoded, deletion bitmap, handle index. The copr
+layer scans these arrays straight into padded device buffers.
+
+Bulk import (`IMPORT INTO` / load_table) appends directly here — the
+lightning local-backend analog (reference lightning/backend/local) — and
+writes no per-row KV; such tables serve the OLAP path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.column import Column, py_to_datum_fast
+from ..chunk.device import StringDict
+from ..codec.tablecodec import decode_record_key, TABLE_PREFIX, RECORD_PREFIX_SEP
+from ..codec.codec import decode_row_value
+from ..types.field_type import TypeClass
+from ..types.datum import Datum, Kind
+
+
+class ColumnarTable:
+    """Row-versioned columnar store: per-row (insert_ts, delete_ts) arrays
+    give MVCC snapshot scans (TiFlash delta-tree role). delete_ts == 0 means
+    live. Updates append a new version row; handle_pos tracks the newest."""
+
+    def __init__(self, table_info):
+        self.table_info = table_info
+        self.n = 0
+        self.cap = 0
+        self.version = 0          # bumped on every mutation batch
+        self.data: dict[int, np.ndarray] = {}    # col_id -> array
+        self.nulls: dict[int, np.ndarray] = {}
+        self.dicts: dict[int, StringDict] = {}
+        self.handles = np.empty(0, dtype=np.int64)
+        self.insert_ts = np.empty(0, dtype=np.int64)
+        self.delete_ts = np.empty(0, dtype=np.int64)
+        self.handle_pos: dict[int, int] = {}
+        self._init_columns()
+
+    def _init_columns(self):
+        for ci in self.table_info.columns:
+            if ci.id in self.data:
+                continue
+            if ci.ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+                self.data[ci.id] = np.zeros(self.cap, dtype=np.int32)
+                self.dicts[ci.id] = StringDict()
+            elif ci.ft.tclass == TypeClass.FLOAT:
+                self.data[ci.id] = np.zeros(self.cap, dtype=np.float64)
+            else:
+                self.data[ci.id] = np.zeros(self.cap, dtype=np.int64)
+            self.nulls[ci.id] = np.zeros(self.cap, dtype=bool)
+
+    def update_schema(self, table_info):
+        """ADD/DROP COLUMN: extend arrays; dropped column arrays are kept
+        until compaction (harmless)."""
+        old = self.table_info
+        self.table_info = table_info
+        for ci in table_info.columns:
+            if ci.id not in self.data:
+                if ci.ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+                    arr = np.zeros(self.cap, dtype=np.int32)
+                    self.dicts[ci.id] = StringDict()
+                elif ci.ft.tclass == TypeClass.FLOAT:
+                    arr = np.zeros(self.cap, dtype=np.float64)
+                else:
+                    arr = np.zeros(self.cap, dtype=np.int64)
+                nulls = np.zeros(self.cap, dtype=bool)
+                default = ci.ft.default_value
+                if default is None and not ci.ft.has_default:
+                    nulls[:self.n] = True
+                elif default is not None:
+                    d = py_to_datum_fast(default, ci.ft)
+                    if ci.id in self.dicts:
+                        arr[:self.n] = self.dicts[ci.id].encode_one(str(d.val))
+                    else:
+                        arr[:self.n] = d.val
+                self.data[ci.id] = arr
+                self.nulls[ci.id] = nulls
+        self.version += 1
+
+    # ---- growth -------------------------------------------------------
+    def _ensure(self, extra: int):
+        need = self.n + extra
+        if need <= self.cap:
+            return
+        new_cap = max(1024, self.cap * 2, need)
+        for cid, arr in self.data.items():
+            na = np.zeros(new_cap, dtype=arr.dtype)
+            na[:self.n] = arr[:self.n]
+            self.data[cid] = na
+            nn = np.zeros(new_cap, dtype=bool)
+            nn[:self.n] = self.nulls[cid][:self.n]
+            self.nulls[cid] = nn
+        nh = np.zeros(new_cap, dtype=np.int64)
+        nh[:self.n] = self.handles[:self.n]
+        self.handles = nh
+        for attr in ("insert_ts", "delete_ts"):
+            a = getattr(self, attr)
+            na = np.zeros(new_cap, dtype=np.int64)
+            na[:self.n] = a[:self.n]
+            setattr(self, attr, na)
+        self.cap = new_cap
+
+    # ---- mutations ----------------------------------------------------
+    def put_row(self, handle: int, datums: list, commit_ts: int = 1):
+        """Insert/overwrite one row; an existing version is closed at
+        commit_ts and a new version row appended."""
+        old = self.handle_pos.get(handle)
+        if old is not None and self.delete_ts[old] == 0:
+            self.delete_ts[old] = commit_ts
+        self._ensure(1)
+        pos = self.n
+        self.n += 1
+        self.handles[pos] = handle
+        self.handle_pos[handle] = pos
+        self.insert_ts[pos] = commit_ts
+        self.delete_ts[pos] = 0
+        cols = self.table_info.columns
+        for ci, d in zip(cols, datums):
+            arr = self.data[ci.id]
+            nl = self.nulls[ci.id]
+            if d is None or d.is_null:
+                nl[pos] = True
+                arr[pos] = 0
+                continue
+            nl[pos] = False
+            if ci.id in self.dicts:
+                v = d.val
+                arr[pos] = self.dicts[ci.id].encode_one(
+                    v if isinstance(v, str) else str(v))
+            elif arr.dtype == np.float64:
+                arr[pos] = float(d.val)
+            else:
+                arr[pos] = int(d.val)
+        self.version += 1
+
+    def delete_row(self, handle: int, commit_ts: int = 1):
+        pos = self.handle_pos.get(handle)
+        if pos is not None and self.delete_ts[pos] == 0:
+            self.delete_ts[pos] = commit_ts
+            self.version += 1
+
+    def bulk_append(self, columns: dict, n: int, handles=None,
+                    commit_ts: int = 1):
+        """Fast import path: columns maps column NAME -> numpy array (or
+        list). String arrays are dict-encoded here. Nulls via np.ma or None
+        not supported in bulk (import data is dense)."""
+        self._ensure(n)
+        start = self.n
+        if handles is None:
+            handles = np.arange(start + 1, start + n + 1, dtype=np.int64)
+        self.handles[start:start + n] = handles
+        self.insert_ts[start:start + n] = commit_ts
+        self.delete_ts[start:start + n] = 0
+        for i, h in enumerate(handles.tolist()):
+            self.handle_pos[h] = start + i
+        for ci in self.table_info.columns:
+            src = columns.get(ci.name)
+            arr = self.data[ci.id]
+            if src is None:
+                self.nulls[ci.id][start:start + n] = True
+                continue
+            if ci.id in self.dicts:
+                if not isinstance(src, np.ndarray) or src.dtype != np.int32:
+                    src = self.dicts[ci.id].encode(
+                        np.asarray(src, dtype=object))
+                arr[start:start + n] = src
+            else:
+                arr[start:start + n] = np.asarray(src, dtype=arr.dtype)
+        self.n += n
+        self.version += 1
+
+    # ---- reads --------------------------------------------------------
+    def live_count(self) -> int:
+        return int((self.delete_ts[:self.n] == 0).sum())
+
+    def valid_at(self, read_ts: int | None = None) -> np.ndarray:
+        """MVCC visibility mask: inserted at-or-before read_ts and not yet
+        deleted at read_ts (read_ts None = read latest)."""
+        ins = self.insert_ts[:self.n]
+        dele = self.delete_ts[:self.n]
+        if read_ts is None:
+            return dele == 0
+        return (ins <= read_ts) & ((dele == 0) | (dele > read_ts))
+
+    def snapshot(self, col_ids: list, read_ts: int | None = None):
+        """-> (arrays dict col_id -> (data, nulls|None, dict|None), valid)."""
+        valid = self.valid_at(read_ts)
+        out = {}
+        for cid in col_ids:
+            arr = self.data[cid][:self.n]
+            nl = self.nulls[cid][:self.n]
+            out[cid] = (arr, nl if nl.any() else None, self.dicts.get(cid))
+        return out, valid
+
+    def handle_array(self):
+        return self.handles[:self.n]
+
+    def column_for(self, ci, idx=None) -> Column:
+        arr = self.data[ci.id][:self.n]
+        nl = self.nulls[ci.id][:self.n]
+        col = Column(ci.ft, arr if idx is None else arr[idx],
+                     (nl if idx is None else nl[idx]) if nl.any() else None,
+                     self.dicts.get(ci.id))
+        return col
+
+
+class ColumnarEngine:
+    """Routes committed row mutations into per-table columnar deltas."""
+
+    def __init__(self, storage, table_info_by_id):
+        self.storage = storage
+        self.table_info_by_id = table_info_by_id   # callback id -> TableInfo
+        self.tables: dict[int, ColumnarTable] = {}
+        storage.mvcc.commit_hooks.append(self.apply_commit)
+
+    def table(self, table_info) -> ColumnarTable:
+        t = self.tables.get(table_info.id)
+        if t is None:
+            t = ColumnarTable(table_info)
+            self.tables[table_info.id] = t
+        elif t.table_info is not table_info:
+            t.update_schema(table_info)
+        return t
+
+    def drop_table(self, table_id: int):
+        self.tables.pop(table_id, None)
+
+    def apply_commit(self, commit_ts: int, mutations: list):
+        for key, value in mutations:
+            if not key.startswith(TABLE_PREFIX) or key[9:11] != RECORD_PREFIX_SEP:
+                continue
+            table_id, handle = decode_record_key(key)
+            info = self.table_info_by_id(table_id)
+            if info is None:
+                continue
+            tbl = self.table(info)
+            if value is None:
+                tbl.delete_row(handle, commit_ts)
+            else:
+                tbl.put_row(handle, decode_row_value(value), commit_ts)

@@ -1,0 +1,82 @@
+"""TestKit (reference pkg/testkit/testkit.go:79 — MustExec /
+MustQuery().Check()). The workhorse harness: whole SQL layer in-process
+against the embedded store."""
+from __future__ import annotations
+
+from .session import Session, Domain, new_store
+
+
+class TestKit:
+    def __init__(self, domain: Domain | None = None):
+        self.domain = domain or new_store()
+        self.sess = Session(self.domain)
+        self.sess.vars.current_db = "test"
+
+    def must_exec(self, sql: str, params=None):
+        return self.sess.execute(sql, params)
+
+    def must_query(self, sql: str, params=None) -> "QueryResult":
+        rs = self.sess.execute(sql, params)
+        return QueryResult(rs)
+
+    def exec_err(self, sql: str) -> Exception:
+        from .errors import TiDBError
+        try:
+            self.sess.execute(sql)
+        except TiDBError as e:
+            return e
+        raise AssertionError(f"expected error for: {sql}")
+
+    def new_session(self) -> "TestKit":
+        tk = TestKit.__new__(TestKit)
+        tk.domain = self.domain
+        tk.sess = Session(self.domain)
+        tk.sess.vars.current_db = "test"
+        return tk
+
+
+class QueryResult:
+    def __init__(self, rs):
+        self.rs = rs
+        self.names = rs.names
+
+    @property
+    def rows(self):
+        return self.rs.rows
+
+    def _norm(self):
+        out = []
+        for row in self.rows:
+            out.append(tuple("<nil>" if v is None else _fmt(v) for v in row))
+        return out
+
+    def check(self, expected: list):
+        """expected: list of tuples/lists of strings (or values)."""
+        got = self._norm()
+        want = [tuple("<nil>" if v is None else _fmt(v) for v in row)
+                for row in expected]
+        assert got == want, f"result mismatch:\n got: {got}\nwant: {want}"
+        return self
+
+    def sort_check(self, expected: list):
+        got = sorted(self._norm())
+        want = sorted(tuple("<nil>" if v is None else _fmt(v) for v in row)
+                      for row in expected)
+        assert got == want, f"result mismatch:\n got: {got}\nwant: {want}"
+        return self
+
+    def check_contain(self, text: str):
+        for row in self._norm():
+            if any(text in c for c in row):
+                return self
+        raise AssertionError(f"{text!r} not found in {self._norm()}")
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)

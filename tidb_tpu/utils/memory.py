@@ -1,0 +1,42 @@
+"""Hierarchical memory tracker (reference pkg/util/memory/tracker.go:78).
+
+Session -> statement -> operator tracking with an action chain on quota
+breach (log -> spill trigger -> cancel). Round 1 wires tracking points in
+readers and blocking operators; spill actions arrive with the spill work."""
+from __future__ import annotations
+
+from ..errors import MemoryQuotaExceededError
+
+
+class Tracker:
+    def __init__(self, label: str, quota: int = -1, parent: "Tracker" = None):
+        self.label = label
+        self.quota = quota
+        self.parent = parent
+        self.consumed = 0
+        self.max_consumed = 0
+
+    def child(self, label: str, quota: int = -1) -> "Tracker":
+        return Tracker(label, quota, self)
+
+    def consume(self, n: int):
+        t = self
+        while t is not None:
+            t.consumed += n
+            if t.consumed > t.max_consumed:
+                t.max_consumed = t.consumed
+            if t.quota > 0 and t.consumed > t.quota:
+                raise MemoryQuotaExceededError(
+                    "Out Of Memory Quota! [%s] consumed %d > quota %d",
+                    t.label, t.consumed, t.quota)
+            t = t.parent
+
+    def release(self, n: int):
+        t = self
+        while t is not None:
+            t.consumed -= n
+            t = t.parent
+
+    def track_array(self, arr):
+        self.consume(getattr(arr, "nbytes", 0))
+        return arr
