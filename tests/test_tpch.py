@@ -142,3 +142,21 @@ class TestQ3Q5:
         finally:
             tk.domain.copr.use_device = True
         assert r_dev == r_host
+
+
+from tidb_tpu.bench.tpch import ALL_QUERIES
+
+
+@pytest.mark.parametrize("qname", sorted(ALL_QUERIES.keys(),
+                                         key=lambda q: int(q[1:])))
+def test_all_queries_device_vs_host(tk, qname):
+    """Every TPC-H query runs end-to-end; device copr path agrees with the
+    host numpy path (the round-trip vec-vs-row oracle)."""
+    sql = ALL_QUERIES[qname]
+    r_dev = tk.must_query(sql).rows
+    tk.domain.copr.use_device = False
+    try:
+        r_host = tk.must_query(sql).rows
+    finally:
+        tk.domain.copr.use_device = True
+    assert r_dev == r_host

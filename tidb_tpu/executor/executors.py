@@ -722,7 +722,7 @@ class HashJoinExec(Executor):
         if probe is None:
             return Chunk.empty(out_fts)
         if build is None:
-            if outer:
+            if outer or jt == "anti":
                 return self._emit(probe, np.arange(len(probe)), None, None)
             return Chunk.empty(out_fts)
 
@@ -747,6 +747,8 @@ class HashJoinExec(Executor):
                     if len(un):
                         inner = self._emit(probe, pi, build, bi)
                         return inner.concat(self._emit(probe, un, None, None))
+            if jt in ("semi", "anti"):
+                return self._semi_result(probe, pi, jt)
             return self._emit(probe, pi, build, bi)
 
         shared = [None] * len(plan.eq_conds)
@@ -790,6 +792,8 @@ class HashJoinExec(Executor):
                 mask &= np.asarray(eval_bool_mask(ectx, c))
             pi, bi = pi[mask], bi[mask]
 
+        if jt in ("semi", "anti"):
+            return self._semi_result(probe, pi, jt)
         if outer:
             matched = np.zeros(len(probe), dtype=bool)
             matched[pi] = True
@@ -799,6 +803,12 @@ class HashJoinExec(Executor):
                 outer_part = self._emit(probe, un, None, None)
                 return inner.concat(outer_part)
         return self._emit(probe, pi, build, bi)
+
+    def _semi_result(self, probe, pi, jt):
+        matched = np.zeros(len(probe), dtype=bool)
+        matched[pi] = True
+        sel = np.nonzero(matched if jt == "semi" else ~matched)[0]
+        return self._emit(probe, sel, None, None)
 
     def _joined_schema(self):
         plan = self.plan

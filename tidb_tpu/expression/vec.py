@@ -762,21 +762,24 @@ def op_in(ctx, expr):
         sset = set(consts)
         r = _string_elementwise(ctx, a, lambda s: s in sset, np.bool_)
         return r, an, None
-    consts = []
+    pairs = []
     any_null = False
     for c in expr.args[1:]:
         if c.value.is_null:
             any_null = True
             continue
         cv, _, _ = _eval_const(ctx, c)
-        c2, _, _, _ = coerce_numeric_pair(ctx, cv, c.ft, 0, aft)
-        a2, c2v, _, _ = coerce_numeric_pair(ctx, a, aft, cv, c.ft)
-        consts.append(c2v)
-    r = xp.zeros(ctx.n, dtype=bool)
-    a2 = a
-    for cv in consts:
-        a2c, cvc, _, _ = coerce_numeric_pair(ctx, a, aft, cv, expr.args[1].ft)
-        r = r | (a2c == cvc)
+        a2c, cvc, _, _ = coerce_numeric_pair(ctx, a, aft, cv, c.ft)
+        pairs.append((a2c, cvc))
+    if len(pairs) > 8 and all(np.isscalar(cv) for _, cv in pairs):
+        # vectorized membership for long lists (decorrelated IN, Q18-style)
+        a2c = pairs[0][0]
+        table = np.array([cv for _, cv in pairs])
+        r = xp.isin(a2c, xp.asarray(table))
+    else:
+        r = xp.zeros(ctx.n, dtype=bool)
+        for a2c, cvc in pairs:
+            r = r | (a2c == cvc)
     nulls = or_nulls(xp, an)
     if any_null:
         # x IN (.., NULL): false -> NULL

@@ -180,12 +180,10 @@ class PlanBuilder:
             return self.build_setops(stmt)
         p = self.build_from(stmt.from_clause)
 
-        # WHERE
+        # WHERE (conjunct-wise: correlated subquery predicates decorrelate
+        # into semi/anti/inner joins — reference rule_decorrelate.go)
         if stmt.where is not None:
-            rw = self._rewriter(p.schema)
-            conds = split_conjuncts(rw.rewrite(stmt.where))
-            p = Selection(conds, p)
-            p.stats_rows = p.child.stats_rows * (0.25 ** min(len(conds), 3))
+            p = self._apply_where(stmt.where, p)
 
         # aggregation detection
         has_agg = bool(stmt.group_by) or _stmt_has_agg(stmt)
@@ -378,6 +376,208 @@ class PlanBuilder:
             result.stats_rows = min(result.child.stats_rows,
                                     float(count if count >= 0 else 1e18))
         return result
+
+    # ---- WHERE with decorrelation ------------------------------------
+    @staticmethod
+    def _ast_conjuncts(node):
+        if isinstance(node, ast.BinaryOp) and node.op == "and":
+            return (PlanBuilder._ast_conjuncts(node.left) +
+                    PlanBuilder._ast_conjuncts(node.right))
+        return [node]
+
+    def _apply_where(self, where_ast, p: LogicalPlan) -> LogicalPlan:
+        plain = []
+        for c in self._ast_conjuncts(where_ast):
+            transformed = None
+            if self._is_subquery_pred(c):
+                try:
+                    rw = self._rewriter(p.schema)
+                    plain.extend(split_conjuncts(rw.rewrite(c)))
+                    continue
+                except (ColumnNotExistsError, UnsupportedError):
+                    transformed = self._decorrelate_pred(c, p)
+            if transformed is not None:
+                p = transformed
+                continue
+            rw = self._rewriter(p.schema)
+            plain.extend(split_conjuncts(rw.rewrite(c)))
+        if plain:
+            sel = Selection(plain, p)
+            sel.stats_rows = p.stats_rows * (0.25 ** min(len(plain), 3))
+            p = sel
+        return p
+
+    @staticmethod
+    def _is_subquery_pred(c) -> bool:
+        if isinstance(c, (ast.ExistsSubquery, ast.InSubquery)):
+            return True
+        if isinstance(c, ast.BinaryOp) and c.op in ("=", "!=", "<", "<=",
+                                                    ">", ">="):
+            return isinstance(c.left, ast.ScalarSubquery) or \
+                isinstance(c.right, ast.ScalarSubquery)
+        if isinstance(c, ast.UnaryOp) and c.op == "not":
+            return PlanBuilder._is_subquery_pred(c.operand)
+        return False
+
+    def _decorrelate_pred(self, c, p: LogicalPlan) -> LogicalPlan | None:
+        """Correlated subquery predicate -> join. Returns the new plan."""
+        if isinstance(c, ast.UnaryOp) and c.op == "not":
+            inner = c.operand
+            if isinstance(inner, ast.ExistsSubquery):
+                c = ast.ExistsSubquery(subquery=inner.subquery,
+                                       negated=not inner.negated)
+            elif isinstance(inner, ast.InSubquery):
+                c = ast.InSubquery(expr=inner.expr, subquery=inner.subquery,
+                                   negated=not inner.negated)
+            else:
+                return None
+        if isinstance(c, ast.ExistsSubquery):
+            splan, eq_pairs, others, _ = self.build_corr_subquery(
+                c.subquery, p.schema, out_fields=False)
+            jt = "anti" if c.negated else "semi"
+            return self._mk_semi_join(jt, p, splan, eq_pairs, others)
+        if isinstance(c, ast.InSubquery):
+            splan, eq_pairs, others, outs = self.build_corr_subquery(
+                c.subquery, p.schema, out_fields=True)
+            rw = self._rewriter(p.schema)
+            outer_e = rw.rewrite(c.expr)
+            outer_e2, inner_e2 = rw._coerce_cmp_sides("=", outer_e, outs[0])
+            eq_pairs = eq_pairs + [(outer_e2, inner_e2)]
+            jt = "anti" if c.negated else "semi"
+            join = self._mk_semi_join(jt, p, splan, eq_pairs, others)
+            if c.negated:
+                # NOT IN: a NULL probe value compares NULL -> excluded
+                # (divergence note: an all-NULL inner side should null out
+                # every row; not modeled — matches common TPC-H-safe subset)
+                guard = rw.mk_func("isnotnull", [outer_e2])
+                sel = Selection([guard], join)
+                sel.stats_rows = join.stats_rows
+                return sel
+            return join
+        # comparison with correlated scalar subquery
+        if isinstance(c, ast.BinaryOp):
+            if isinstance(c.right, ast.ScalarSubquery):
+                sub, outer_ast, op = c.right.subquery, c.left, c.op
+            else:
+                sub, outer_ast, op = c.left.subquery, c.right, {
+                    "<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(c.op, c.op)
+            splan, eq_pairs, others, outs = self.build_corr_subquery(
+                sub, p.schema, out_fields=True)
+            schema = Schema(list(p.schema.cols) + list(splan.schema.cols))
+            join = LJoin("inner", p, splan, schema)
+            join.stats_rows = p.stats_rows
+            for a, b in eq_pairs:
+                join.eq_conds.append((a, b))
+            join.other_conds.extend(others)
+            rw = self._rewriter(schema)
+            outer_e = rw.rewrite(outer_ast)
+            a2, b2 = rw._coerce_cmp_sides(op, outer_e, outs[0])
+            cmp_cond = rw.mk_func(op, [a2, b2])
+            sel = Selection([cmp_cond], join)
+            sel.stats_rows = join.stats_rows * 0.25
+            return sel
+        return None
+
+    def _mk_semi_join(self, jt, p, splan, eq_pairs, others):
+        schema = Schema(list(p.schema.cols))
+        join = LJoin(jt, p, splan, schema)
+        join.stats_rows = max(p.stats_rows * 0.5, 1.0)
+        for a, b in eq_pairs:
+            join.eq_conds.append((a, b))
+        join.other_conds.extend(others)
+        return join
+
+    def build_corr_subquery(self, stmt: ast.SelectStmt, outer_schema,
+                            out_fields: bool):
+        """Build a correlated subquery as a joinable plan.
+
+        Returns (plan, eq_pairs [(outer_expr, inner_expr)], other_corr_conds,
+        out_exprs). Correlated conds are pulled out of the subquery's WHERE;
+        under aggregation, inner sides of correlated equalities become group
+        keys (the classic decorrelation rewrite)."""
+        if stmt.setops or stmt.limit or stmt.order_by:
+            raise UnsupportedError(
+                "correlated subquery with LIMIT/ORDER BY/UNION")
+        p = self.build_from(stmt.from_clause)
+        sub_ids = {sc.col.idx for sc in p.schema.cols}
+        corr = []
+        inner_conds = []
+        if stmt.where is not None:
+            for cj in self._ast_conjuncts(stmt.where):
+                rw = self._rewriter(p.schema)
+                rw.outer_schemas = [outer_schema]
+                e = rw.rewrite(cj)
+                (corr if rw.outer_used else inner_conds).append(e)
+        if inner_conds:
+            sel = Selection(inner_conds, p)
+            sel.stats_rows = p.stats_rows * (0.25 ** min(len(inner_conds), 3))
+            p = sel
+        # split correlated conds: inner-col = outer-col pairs vs general
+        eq_pairs = []
+        others = []
+        for e in corr:
+            if isinstance(e, ScalarFunc) and e.op == "=" and \
+                    isinstance(e.args[0], Column) and \
+                    isinstance(e.args[1], Column):
+                a, b = e.args
+                if a.idx in sub_ids and b.idx not in sub_ids:
+                    eq_pairs.append((b, a))       # (outer, inner)
+                    continue
+                if b.idx in sub_ids and a.idx not in sub_ids:
+                    eq_pairs.append((a, b))
+                    continue
+            others.append(e)
+        has_agg = bool(stmt.group_by) or _stmt_has_agg(stmt)
+        if not has_agg:
+            outs = []
+            if out_fields:
+                rw = self._rewriter(p.schema)
+                f = stmt.fields[0]
+                if isinstance(f, ast.Wildcard):
+                    outs = [p.schema.visible()[0].col]
+                else:
+                    outs = [rw.rewrite(f.expr)]
+            return p, eq_pairs, others, outs
+        # aggregation: group by the correlated inner columns
+        if stmt.group_by:
+            raise UnsupportedError(
+                "correlated subquery with explicit GROUP BY")
+        for e in others:
+            # general correlated conds under an aggregate change semantics
+            raise UnsupportedError(
+                "non-equality correlated condition under aggregate")
+        group_items = []
+        agg_schema = Schema()
+        seen_group = set()
+        for _, inner in eq_pairs:
+            if inner.idx not in seen_group:
+                seen_group.add(inner.idx)
+                group_items.append(inner)
+                agg_schema.append(SchemaCol(inner, inner.name or "gk"))
+        aggs = []
+        agg_map = {}
+
+        def agg_mapper(node: ast.AggFunc):
+            rw_inner = self._rewriter(p.schema)
+            args = [rw_inner.rewrite(a) for a in node.args
+                    if not isinstance(a, ast.Wildcard)]
+            desc = AggDesc(name=node.name, args=args, distinct=node.distinct)
+            desc.ft = agg_result_ft(node.name, args, node.distinct)
+            fp = desc.fingerprint()
+            if fp in agg_map:
+                return agg_map[fp]
+            col = self._new_col(desc.ft, repr(desc))
+            aggs.append(desc)
+            agg_map[fp] = col
+            agg_schema.append(SchemaCol(col, repr(desc)))
+            return col
+
+        rw = self._rewriter(p.schema, agg_mapper)
+        f = stmt.fields[0]
+        out_expr = rw.rewrite(f.expr)
+        agg = Aggregation(group_items, aggs, agg_schema, p)
+        agg.stats_rows = min(p.stats_rows, max(p.stats_rows * 0.1, 1.0))
+        return agg, eq_pairs, others, [out_expr]
 
     def _expand_wildcards(self, fields, schema: Schema):
         out = []

@@ -215,8 +215,8 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
     if isinstance(plan, LJoin):
         left = _phys(plan.children[0])
         right = _phys(plan.children[1])
-        if plan.join_type == "left":
-            build = 1
+        if plan.join_type in ("left", "semi", "anti"):
+            build = 1          # semi/anti: the subquery side always builds
         elif plan.join_type == "right":
             build = 0
         else:

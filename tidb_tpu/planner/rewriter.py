@@ -77,6 +77,7 @@ class Rewriter:
         self.schema = schema
         self.agg_mapper = agg_mapper
         self.outer_schemas = outer_schemas or []
+        self.outer_used = False   # set when a column resolved via outer scope
 
     def mk_func(self, op: str, args: list, ft: FieldType | None = None) -> Expression:
         if ft is None:
@@ -125,9 +126,10 @@ class Rewriter:
         for outer in self.outer_schemas:
             sc = outer.try_resolve(node.name, node.table, node.db)
             if sc is not None:
-                raise UnsupportedError(
-                    "correlated subqueries are not supported yet (column %s)",
-                    node.name)
+                # correlated reference: shares the outer plan's Column so
+                # decorrelation can join on it (reference decorrelate.go)
+                self.outer_used = True
+                return sc.col
         # raise proper error
         self.schema.resolve(node.name, node.table, node.db)
 

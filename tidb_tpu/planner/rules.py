@@ -73,7 +73,8 @@ def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
         inner = plan.join_type == "inner"
         for c in conds + (plan.other_conds if inner else []):
             s = _cols_of(c)
-            if s <= left_ids and plan.join_type in ("inner", "left"):
+            if s <= left_ids and plan.join_type in ("inner", "left", "semi",
+                                                    "anti"):
                 lconds.append(c)
             elif s <= right_ids and plan.join_type in ("inner", "right"):
                 rconds.append(c)
@@ -196,8 +197,9 @@ def prune_columns(plan: LogicalPlan, needed: set):
     if isinstance(plan, LJoin):
         child_needed = set(needed)
         for a, b in plan.eq_conds:
-            child_needed.add(a.idx)
-            child_needed.add(b.idx)
+            # eq sides may be expressions (decorrelated IN/scalar)
+            child_needed |= _cols_of(a)
+            child_needed |= _cols_of(b)
         for c in plan.other_conds:
             child_needed |= _cols_of(c)
         plan.schema.cols = [sc for sc in plan.schema.cols
