@@ -32,11 +32,44 @@ _I64_MAX = np.iinfo(np.int64).max
 class CoprExecutor:
     """Executes CoprDAGs against ColumnarTables; caches compiled kernels."""
 
-    def __init__(self, engine, device_rows=1 << 22, use_device=True):
+    def __init__(self, engine, device_rows=1 << 22, use_device=True,
+                 dev_cache_bytes=8 << 30):
         self.engine = engine            # ColumnarEngine
         self.device_rows = device_rows  # partition size (rows per jit call)
         self.use_device = use_device
         self._kernel_cache = {}
+        # device buffer pool: column slices resident in HBM across queries,
+        # keyed by (table, column, version, slice, cap) — the "per-query
+        # device buffer pool" of SURVEY.md §5 generalized to cross-query
+        # reuse; invalidated by the columnar version counter
+        self._dev_cache: dict = {}
+        self._dev_cache_order: list = []
+        self._dev_cache_bytes = 0
+        self._dev_cache_budget = dev_cache_bytes
+
+    def _dev_put(self, key, arr_np, pad_fill=0):
+        """Upload (padded) with LRU caching; returns the device array."""
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            self._dev_cache_order.remove(key)
+            self._dev_cache_order.append(key)
+            return hit
+        cap = key[-1]
+        if len(arr_np) != cap:
+            arr_np = np.concatenate(
+                [arr_np, np.full(cap - len(arr_np), pad_fill,
+                                 dtype=arr_np.dtype)])
+        dev = jnp.asarray(arr_np)
+        nbytes = dev.size * dev.dtype.itemsize
+        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
+               and self._dev_cache_order):
+            old = self._dev_cache_order.pop(0)
+            ev = self._dev_cache.pop(old)
+            self._dev_cache_bytes -= ev.size * ev.dtype.itemsize
+        self._dev_cache[key] = dev
+        self._dev_cache_order.append(key)
+        self._dev_cache_bytes += nbytes
+        return dev
 
     # ---- public -------------------------------------------------------
     def execute(self, dag, overlay=None, read_ts=None) -> list:
@@ -114,9 +147,13 @@ class CoprExecutor:
         return ci.id
 
     # ---- shared prep --------------------------------------------------
-    def _bind_cols(self, dag, tbl, arrays, part_slice, handles):
-        """-> cols mapping plan-col-idx -> (np data, np nulls, dict)."""
+    def _bind_cols(self, dag, tbl, arrays, part_slice, handles,
+                   cacheable=False):
+        """-> cols mapping plan-col-idx -> (np data, np nulls, dict).
+        When cacheable, also records device-cache keys per column in
+        self._bind_keys (cache valid only for pristine table arrays)."""
         cols = {}
+        self._bind_keys = {}
         for sc in dag.cols:
             cid = self._cid(dag, sc)
             if cid == -1:
@@ -126,6 +163,10 @@ class CoprExecutor:
             cols[sc.col.idx] = (data[part_slice],
                                 None if nulls is None else nulls[part_slice],
                                 sdict)
+            if cacheable:
+                self._bind_keys[sc.col.idx] = (
+                    id(tbl), cid, tbl.version, part_slice.start,
+                    part_slice.stop)
         return cols
 
     # ---- host (numpy) fallback ---------------------------------------
@@ -171,7 +212,8 @@ class CoprExecutor:
             sl = slice(start, min(start + step, n))
             m = sl.stop - sl.start
             cap = shape_bucket(m)
-            cols = self._bind_cols(dag, tbl, arrays, sl, handles)
+            cols = self._bind_cols(dag, tbl, arrays, sl, handles,
+                                   cacheable=(n == tbl.n))
             v = valid[sl]
             if dag.aggs:
                 res = self._run_agg_partition(dag, tbl, cols, v, m, cap)
@@ -198,16 +240,25 @@ class CoprExecutor:
 
     def _pad_upload(self, cols, v, m, cap):
         jcols = {}
+        bind_keys = getattr(self, "_bind_keys", {})
         for k, (data, nulls, sdict) in cols.items():
-            d = data
-            if len(d) != cap:
-                d = np.concatenate([d, np.zeros(cap - m, dtype=d.dtype)])
-            jd = jnp.asarray(d)
-            jn = None
-            if nulls is not None:
-                nl = np.concatenate([nulls, np.ones(cap - m, dtype=bool)]) \
-                    if len(nulls) != cap else nulls
-                jn = jnp.asarray(nl)
+            ck = bind_keys.get(k)
+            if ck is not None:
+                jd = self._dev_put(ck + ("d", cap), data)
+                jn = None
+                if nulls is not None:
+                    jn = self._dev_put(ck + ("n", cap), nulls, pad_fill=True)
+            else:
+                d = data
+                if len(d) != cap:
+                    d = np.concatenate([d, np.zeros(cap - m, dtype=d.dtype)])
+                jd = jnp.asarray(d)
+                jn = None
+                if nulls is not None:
+                    nl = np.concatenate(
+                        [nulls, np.ones(cap - m, dtype=bool)]) \
+                        if len(nulls) != cap else nulls
+                    jn = jnp.asarray(nl)
             jcols[k] = (jd, jn, sdict)
         vv = np.concatenate([v, np.zeros(cap - m, dtype=bool)]) \
             if len(v) != cap else v
