@@ -397,3 +397,47 @@ class TestExplain:
         r = tk.must_query("explain select * from ex order by a limit 3")
         text = "\n".join(r0[0] for r0 in r.rows)
         assert "TopN" in text
+
+
+class TestObservability:
+    def test_information_schema(self, ftk):
+        ftk.must_exec("create table obs (a int primary key, b varchar(10))")
+        ftk.must_exec("insert into obs values (1, 'x')")
+        r = ftk.must_query(
+            "select table_name, table_rows from information_schema.tables "
+            "where table_schema = 'test'")
+        assert ("obs", 1) in r.rows
+        r = ftk.must_query(
+            "select column_name from information_schema.columns "
+            "where table_name = 'obs' order by ordinal_position")
+        assert r.rows == [("a",), ("b",)]
+        r = ftk.must_query(
+            "select schema_name from information_schema.schemata "
+            "order by schema_name")
+        assert ("test",) in r.rows
+        # aggregation over a virtual table
+        r = ftk.must_query(
+            "select count(*) from information_schema.columns "
+            "where table_schema = 'test'")
+        assert r.rows[0][0] == 2
+
+    def test_statement_summary_and_slow_log(self, ftk):
+        ftk.must_exec("set @@tidb_slow_log_threshold = 0")
+        ftk.must_exec("create table sl (a int)")
+        ftk.must_exec("select * from sl")
+        r = ftk.must_query(
+            "select exec_count from information_schema.statements_summary "
+            "where digest_text like 'select * from sl%'")
+        assert len(r.rows) == 1 and r.rows[0][0] >= 1
+        r = ftk.must_query(
+            "select query from information_schema.slow_query")
+        assert any("sl" in q[0] for q in r.rows)
+
+    def test_explain_analyze(self, ftk):
+        ftk.must_exec("create table ea (a int, b int)")
+        ftk.must_exec("insert into ea values (1,1),(2,2),(3,3)")
+        r = ftk.must_query("explain analyze select sum(b) from ea where a > 1")
+        assert r.names == ["id", "estRows", "actRows", "time", "operator info"]
+        # the reader's actRows reflects the filtered partials and the agg
+        ids = [row[0] for row in r.rows]
+        assert any("HashAgg" in i for i in ids)

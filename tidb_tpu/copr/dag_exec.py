@@ -80,7 +80,11 @@ class CoprExecutor:
         memBuffer — UnionScan semantics (reference executor/builder.go:1473):
         deleted/updated committed rows are masked out, buffered rows are
         appended before filters run."""
-        tbl = self.engine.table(dag.table_info)
+        if dag.table_info.id < 0:
+            tbl = self._materialize_virtual(dag.table_info)
+            read_ts = None
+        else:
+            tbl = self.engine.table(dag.table_info)
         arrays, valid = tbl.snapshot(
             [cid for cid in (self._cid(dag, sc) for sc in dag.cols)
              if cid != -1], read_ts)
@@ -93,7 +97,8 @@ class CoprExecutor:
         handles = tbl.handle_array()
         if n != len(handles):
             handles = np.concatenate([handles, self._overlay_handles])
-        if not self.use_device or not _dag_device_ready(dag):
+        if not self.use_device or dag.table_info.id < 0 or \
+                not _dag_device_ready(dag):
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
         return self._execute_device(dag, tbl, arrays, valid, n, handles)
 
@@ -137,6 +142,24 @@ class CoprExecutor:
         valid = np.concatenate([valid, np.ones(m, dtype=bool)])
         self._overlay_handles = new_handles  # used by _bind_cols for _tidb_rowid
         return new_arrays, valid, n + m
+
+    def _materialize_virtual(self, table_info):
+        """INFORMATION_SCHEMA virtual table -> transient columnar table
+        (reference pkg/executor/infoschema_reader.go memtable reads)."""
+        from ..infoschema.virtual import virtual_rows
+        from ..storage.columnar import ColumnarTable
+        from ..chunk.column import py_to_datum_fast
+        domain = getattr(self, "domain", None)
+        tbl = ColumnarTable(table_info)
+        if domain is None:
+            return tbl
+        rows = virtual_rows(domain, table_info)
+        fts = [c.ft for c in table_info.columns]
+        for h, row in enumerate(rows, start=1):
+            datums = [None if v is None else py_to_datum_fast(v, ft)
+                      for v, ft in zip(row, fts)]
+            tbl.put_row(h, datums)
+        return tbl
 
     def _cid(self, dag, sc):
         """Map a plan SchemaCol to the storage column id by name."""

@@ -10,6 +10,14 @@ from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
 
 
 def build_executor(ctx, plan):
+    ex = _build(ctx, plan)
+    if getattr(ctx, "collect_stats", False):
+        from .runtime_stats import TimedExec
+        ex = TimedExec(ex)
+    return ex
+
+
+def _build(ctx, plan):
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(ctx, plan)
     if isinstance(plan, PhysSelection):

@@ -1,0 +1,76 @@
+"""Per-operator runtime statistics for EXPLAIN ANALYZE (reference
+pkg/util/execdetails — actRows/time shown per executor in EXPLAIN ANALYZE).
+"""
+from __future__ import annotations
+
+import time
+
+
+class TimedExec:
+    """Transparent wrapper recording rows produced + wall time per operator."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.act_rows = 0
+        self.wall_ms = 0.0
+        self.loops = 0
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    @property
+    def children(self):
+        return self.inner.children
+
+    @property
+    def ctx(self):
+        return self.inner.ctx
+
+    def open(self):
+        t = time.perf_counter()
+        self.inner.open()
+        self.wall_ms += (time.perf_counter() - t) * 1000
+
+    def next(self):
+        t = time.perf_counter()
+        ch = self.inner.next()
+        self.wall_ms += (time.perf_counter() - t) * 1000
+        self.loops += 1
+        if ch is not None:
+            self.act_rows += len(ch)
+        return ch
+
+    def close(self):
+        self.inner.close()
+
+    def all_chunks(self):
+        out = []
+        while True:
+            self.ctx.check_killed()
+            ch = self.next()
+            if ch is None:
+                break
+            if len(ch):
+                out.append(ch)
+        return out
+
+    def partials(self):
+        t = time.perf_counter()
+        res = self.inner.partials()
+        self.wall_ms += (time.perf_counter() - t) * 1000
+        self.act_rows += sum(p.ngroups for p in res)
+        return res
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def wrapped_children_stats(ex):
+    """Collect (act_rows, wall_ms) tree matching the plan tree shape."""
+    me = (ex.act_rows, ex.wall_ms) if isinstance(ex, TimedExec) else (0, 0.0)
+    kids = []
+    inner = ex.inner if isinstance(ex, TimedExec) else ex
+    for c in inner.children:
+        kids.append(wrapped_children_stats(c))
+    return (me, kids)

@@ -42,6 +42,11 @@ class InfoSchema:
         return list(self._dbs_by_name.values())
 
     def table_by_name(self, db: str, tbl: str) -> TableInfo:
+        if db.lower() == "information_schema":
+            from .virtual import virtual_table_info
+            t = virtual_table_info(tbl)
+            if t is not None:
+                return t
         t = self._tbl_by_name.get((db.lower(), tbl.lower()))
         if t is None:
             if not self.has_schema(db):
@@ -60,6 +65,9 @@ class InfoSchema:
 
     def tables_in_schema(self, db: str) -> list[TableInfo]:
         dbl = db.lower()
+        if dbl == "information_schema":
+            from .virtual import VIRTUAL_DEFS, virtual_table_info
+            return [virtual_table_info(n) for n in sorted(VIRTUAL_DEFS)]
         return [t for (d, _), t in self._tbl_by_name.items() if d == dbl]
 
 

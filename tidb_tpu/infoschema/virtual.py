@@ -1,0 +1,200 @@
+"""INFORMATION_SCHEMA virtual tables (reference pkg/infoschema/cluster.go +
+pkg/executor/infoschema_reader.go, slow_query.go, stmtsummary).
+
+Each virtual table = (columns, generator(domain) -> row tuples). Reads
+materialize on demand and then flow through the normal host copr path, so
+filters/joins/aggregation all work over them."""
+from __future__ import annotations
+
+import time
+
+from ..models import TableInfo, ColumnInfo
+from ..types.field_type import (new_bigint_type, new_double_type,
+                                new_string_type, new_datetime_type)
+
+_VIRTUAL_ID = {}
+_next_vid = [-1000]
+
+
+def _vt(name, cols, gen):
+    _next_vid[0] -= 1
+    VIRTUAL_TABLES[name] = (cols, gen)
+
+
+VIRTUAL_TABLES: dict = {}
+
+
+def _gen_schemata(domain):
+    for db in domain.infoschema().all_schemas():
+        yield ("def", db.name, db.charset, db.collate, None)
+
+
+def _gen_tables(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            ctab = domain.columnar.tables.get(t.id)
+            rows = ctab.live_count() if ctab else 0
+            yield ("def", db.name, t.name, "BASE TABLE", "InnoDB", t.id,
+                   rows, t.comment)
+
+
+def _gen_columns(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            for i, c in enumerate(t.public_columns()):
+                yield ("def", db.name, t.name, c.name, i + 1,
+                       c.ft.default_value if c.ft.has_default else None,
+                       "NO" if c.ft.not_null else "YES",
+                       c.ft.tp, c.ft.sql_string(), c.comment)
+
+
+def _gen_statistics(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            if t.pk_is_handle:
+                yield (db.name, t.name, 0, "PRIMARY", 1, t.pk_col_name)
+            for idx in t.indexes:
+                for seq, col in enumerate(idx.columns):
+                    yield (db.name, t.name, 0 if idx.unique else 1,
+                           idx.name, seq + 1, col)
+
+
+def _gen_slow_query(domain):
+    for e in domain.slow_log:
+        yield (e.get("time", 0.0), e.get("time_ms", 0.0) / 1000.0,
+               e.get("sql", ""), e.get("db", ""), e.get("conn", 0),
+               1 if e.get("success") else 0)
+
+
+def _gen_stmt_summary(domain):
+    for s in domain.stmt_summary_map.values():
+        cnt = max(s["exec_count"], 1)
+        yield (s["digest"], s["normalized"], s["exec_count"],
+               s["sum_ms"] / 1000.0, s["max_ms"] / 1000.0,
+               s["sum_ms"] / cnt / 1000.0, s["errors"])
+
+
+def _gen_metrics(domain):
+    for k, v in sorted(domain.metrics.items()):
+        yield (k, float(v))
+
+
+def _gen_engines(domain):
+    yield ("InnoDB", "DEFAULT", "TPU-native columnar + MVCC row engine",
+           "YES", "YES", "YES")
+
+
+def _gen_collations(domain):
+    yield ("utf8mb4_bin", "utf8mb4", 46, "", "Yes", 1)
+    yield ("utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1)
+
+
+def _gen_character_sets(domain):
+    yield ("utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4)
+
+
+def _gen_tidb_indexes(domain):
+    yield from _gen_statistics(domain)
+
+
+def _gen_cluster_info(domain):
+    yield ("tidb-tpu", "127.0.0.1:4000", "127.0.0.1:10080", "0.1.0", "none")
+
+
+def _gen_views(domain):
+    return iter(())
+
+
+def _gen_partitions(domain):
+    return iter(())
+
+
+_S = new_string_type
+_I = new_bigint_type
+_F = new_double_type
+
+
+def _cols(*specs):
+    return [(name, ft) for name, ft in specs]
+
+
+VIRTUAL_DEFS = {
+    "schemata": (_cols(("catalog_name", _S()), ("schema_name", _S()),
+                       ("default_character_set_name", _S()),
+                       ("default_collation_name", _S()),
+                       ("sql_path", _S())), _gen_schemata),
+    "tables": (_cols(("table_catalog", _S()), ("table_schema", _S()),
+                     ("table_name", _S()), ("table_type", _S()),
+                     ("engine", _S()), ("tidb_table_id", _I()),
+                     ("table_rows", _I()), ("table_comment", _S())),
+               _gen_tables),
+    "columns": (_cols(("table_catalog", _S()), ("table_schema", _S()),
+                      ("table_name", _S()), ("column_name", _S()),
+                      ("ordinal_position", _I()), ("column_default", _S()),
+                      ("is_nullable", _S()), ("data_type", _S()),
+                      ("column_type", _S()), ("column_comment", _S())),
+                _gen_columns),
+    "statistics": (_cols(("table_schema", _S()), ("table_name", _S()),
+                         ("non_unique", _I()), ("index_name", _S()),
+                         ("seq_in_index", _I()), ("column_name", _S())),
+                   _gen_statistics),
+    "slow_query": (_cols(("time", _F()), ("query_time", _F()),
+                         ("query", _S()), ("db", _S()), ("conn_id", _I()),
+                         ("succ", _I())), _gen_slow_query),
+    "statements_summary": (_cols(("digest", _S()), ("digest_text", _S()),
+                                 ("exec_count", _I()),
+                                 ("sum_latency", _F()), ("max_latency", _F()),
+                                 ("avg_latency", _F()), ("sum_errors", _I())),
+                           _gen_stmt_summary),
+    "metrics_summary": (_cols(("metrics_name", _S()), ("sum_value", _F())),
+                        _gen_metrics),
+    "engines": (_cols(("engine", _S()), ("support", _S()), ("comment", _S()),
+                      ("transactions", _S()), ("xa", _S()),
+                      ("savepoints", _S())), _gen_engines),
+    "collations": (_cols(("collation_name", _S()), ("character_set_name", _S()),
+                         ("id", _I()), ("is_default", _S()),
+                         ("is_compiled", _S()), ("sortlen", _I())),
+                   _gen_collations),
+    "character_sets": (_cols(("character_set_name", _S()),
+                             ("default_collate_name", _S()),
+                             ("description", _S()), ("maxlen", _I())),
+                       _gen_character_sets),
+    "tidb_indexes": (_cols(("table_schema", _S()), ("table_name", _S()),
+                           ("non_unique", _I()), ("key_name", _S()),
+                           ("seq_in_index", _I()), ("column_name", _S())),
+                     _gen_tidb_indexes),
+    "cluster_info": (_cols(("type", _S()), ("instance", _S()),
+                           ("status_address", _S()), ("version", _S()),
+                           ("git_hash", _S())), _gen_cluster_info),
+    "views": (_cols(("table_schema", _S()), ("table_name", _S()),
+                    ("view_definition", _S())), _gen_views),
+    "partitions": (_cols(("table_schema", _S()), ("table_name", _S()),
+                         ("partition_name", _S())), _gen_partitions),
+}
+
+_VIRT_INFO_CACHE: dict = {}
+
+
+def virtual_table_info(name: str) -> TableInfo | None:
+    name = name.lower()
+    d = VIRTUAL_DEFS.get(name)
+    if d is None:
+        return None
+    ti = _VIRT_INFO_CACHE.get(name)
+    if ti is not None:
+        return ti
+    cols_spec, _ = d
+    vid = -(1000 + list(VIRTUAL_DEFS.keys()).index(name))
+    cols = [ColumnInfo(id=i + 1, name=cn, offset=i, ft=ft)
+            for i, (cn, ft) in enumerate(cols_spec)]
+    ti = TableInfo(id=vid, name=name, columns=cols)
+    _VIRT_INFO_CACHE[name] = ti
+    return ti
+
+
+def virtual_rows(domain, table_info) -> list:
+    _, gen = VIRTUAL_DEFS[table_info.name.lower()]
+    return list(gen(domain))

@@ -39,13 +39,18 @@ class Domain:
         self.is_cache = InfoSchemaCache(self.storage)
         self.columnar = ColumnarEngine(self.storage, self._table_info_by_id)
         self.copr = CoprExecutor(self.columnar)
+        self.copr.domain = self   # virtual-table reads need domain state
         self._allocators: dict[int, _Allocator] = {}
         self.global_vars: dict[str, object] = {}
         self.user_vars: dict[str, object] = {}
         self.mem_root = Tracker("global")
         self.stats = {}        # table_id -> stats (module stats/, ANALYZE)
         self.slow_log: list = []
-        self.stmt_summary: list = []
+        self.stmt_summary_map: dict = {}
+        self.metrics: dict = {}   # counter name -> value (prometheus analog)
+
+    def inc_metric(self, name: str, v=1):
+        self.metrics[name] = self.metrics.get(name, 0) + v
 
     def _table_info_by_id(self, tid: int):
         return self.infoschema().table_by_id(tid)

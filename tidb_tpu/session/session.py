@@ -86,25 +86,43 @@ class Session:
         stmts = parse(sql)
         result = ResultSet()
         for stmt in stmts:
-            result = self._execute_stmt(stmt, params)
+            result = self._execute_stmt(stmt, params, sql)
         return result
 
-    def _execute_stmt(self, stmt, params=None) -> ResultSet:
+    def _execute_stmt(self, stmt, params=None, sql="") -> ResultSet:
         start = time.time()
         try:
             rs = self._dispatch(stmt, params)
-            self._record_slow(stmt, start)
+            self._observe(stmt, sql, start, ok=True)
             return rs
         except TiDBError:
+            self._observe(stmt, sql, start, ok=False)
             self._finish_stmt(error=True)
             raise
 
-    def _record_slow(self, stmt, start):
+    def _observe(self, stmt, sql, start, ok):
+        """Slow log + statement summary (reference slow_log.go:373 +
+        pkg/util/stmtsummary)."""
         dur_ms = (time.time() - start) * 1000.0
         threshold = int(self.vars.get("tidb_slow_log_threshold"))
         if threshold >= 0 and dur_ms > threshold:
-            self.domain.slow_log.append(
-                {"time_ms": dur_ms, "stmt": type(stmt).__name__})
+            self.domain.slow_log.append({
+                "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
+                "stmt": type(stmt).__name__, "conn": self.conn_id,
+                "db": self.vars.current_db, "success": ok})
+        try:
+            from ..parser import normalize_digest
+            norm, digest = normalize_digest(sql) if sql else ("", "")
+        except Exception:
+            norm, digest = "", ""
+        summ = self.domain.stmt_summary_map.setdefault(digest, {
+            "digest": digest, "normalized": norm[:1024],
+            "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0})
+        summ["exec_count"] += 1
+        summ["sum_ms"] += dur_ms
+        summ["max_ms"] = max(summ["max_ms"], dur_ms)
+        if not ok:
+            summ["errors"] += 1
 
     def _plan_ctx(self, params=None) -> PlanContext:
         return PlanContext(
@@ -258,7 +276,37 @@ class Session:
         from ..chunk.column import Column
         from ..types.field_type import new_string_type
         import numpy as np
-        if isinstance(plan, (InsertPlan, UpdatePlan, DeletePlan)):
+        is_dml = isinstance(plan, (InsertPlan, UpdatePlan, DeletePlan))
+        if stmt.analyze and not is_dml:
+            ectx = ExecContext(self)
+            ectx.collect_stats = True
+            ex = build_executor(ectx, plan)
+            ex.open()
+            try:
+                ex.all_chunks()
+            finally:
+                ex.close()
+            from ..executor.runtime_stats import wrapped_children_stats
+            stats = wrapped_children_stats(ex)
+            rows = []
+            base = explain_text(plan)
+
+            def flat(st, out):
+                out.append(st[0])
+                for k in st[1]:
+                    flat(k, out)
+            flat_stats = []
+            flat(stats, flat_stats)
+            for (pid, est, info), (arows, ms) in zip(base, flat_stats):
+                rows.append((pid, est, str(arows), f"{ms:.2f}ms", info))
+            names = ["id", "estRows", "actRows", "time", "operator info"]
+            cols = []
+            for j in range(5):
+                arr = np.array([r[j] for r in rows], dtype=object)
+                cols.append(Column(new_string_type(), arr))
+            self._finish_stmt()
+            return ResultSet(names=names, chunks=[Chunk(cols)])
+        if is_dml:
             rows = [(type(plan).__name__, "N/A", "")]
             if plan.select_plan is not None:
                 rows += [(f"└─{r[0]}", r[1], r[2])
