@@ -441,3 +441,59 @@ class TestObservability:
         # the reader's actRows reflects the filtered partials and the agg
         ids = [row[0] for row in r.rows]
         assert any("HashAgg" in i for i in ids)
+
+
+class TestWindow:
+    @pytest.fixture(autouse=True)
+    def setup(self, tk):
+        tk.must_exec("drop table if exists w")
+        tk.must_exec("create table w (g varchar(3), v int)")
+        tk.must_exec("insert into w values ('a',10),('a',20),('a',20),"
+                     "('b',5),('b',15),(null,7)")
+        self.tk = tk
+
+    def test_row_number(self):
+        self.tk.must_query(
+            "select g, v, row_number() over (partition by g order by v) "
+            "from w order by g, v").check([
+                (None, 7, 1), ("a", 10, 1), ("a", 20, 2), ("a", 20, 3),
+                ("b", 5, 1), ("b", 15, 2)])
+
+    def test_rank_dense(self):
+        self.tk.must_query(
+            "select v, rank() over (partition by g order by v), "
+            "dense_rank() over (partition by g order by v) "
+            "from w where g = 'a' order by v").check([
+                (10, 1, 1), (20, 2, 2), (20, 2, 2)])
+
+    def test_running_sum(self):
+        self.tk.must_query(
+            "select v, sum(v) over (partition by g order by v) "
+            "from w where g = 'a' order by v").check([
+                (10, "10"), (20, "50"), (20, "50")])  # peers share the frame
+
+    def test_whole_partition_agg(self):
+        self.tk.must_query(
+            "select g, sum(v) over (partition by g) from w "
+            "where g is not null order by g, v").check([
+                ("a", "50"), ("a", "50"), ("a", "50"),
+                ("b", "20"), ("b", "20")])
+
+    def test_lag_lead(self):
+        self.tk.must_query(
+            "select v, lag(v) over (order by v), "
+            "lead(v, 1, -1) over (order by v) from w where g = 'b' "
+            "order by v").check([(5, None, 15), (15, 5, -1)])
+
+    def test_first_last_value(self):
+        self.tk.must_query(
+            "select v, first_value(v) over (partition by g order by v), "
+            "last_value(v) over (partition by g order by v) "
+            "from w where g='a' order by v").check([
+                (10, 10, 10), (20, 10, 20), (20, 10, 20)])
+
+    def test_window_over_agg(self):
+        self.tk.must_query(
+            "select g, sum(v), rank() over (order by sum(v) desc) "
+            "from w where g is not null group by g order by g").check([
+                ("a", "50", 1), ("b", "20", 2)])

@@ -3,10 +3,12 @@ from __future__ import annotations
 
 from ..planner.physical import (PhysTableReader, PhysSelection, PhysProjection,
                                 PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
-                                PhysLimit, PhysUnion, PhysDual, PhysShell)
+                                PhysLimit, PhysUnion, PhysDual, PhysShell,
+                                PhysWindow)
 from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
                         HashAggExec, HashJoinExec, SortExec, TopNExec,
                         LimitExec, UnionExec, DualExec, ShellExec)
+from .window import WindowExec
 
 
 def build_executor(ctx, plan):
@@ -42,4 +44,6 @@ def _build(ctx, plan):
         return DualExec(ctx, plan)
     if isinstance(plan, PhysShell):
         return ShellExec(ctx, plan, build_executor(ctx, plan.child))
+    if isinstance(plan, PhysWindow):
+        return WindowExec(ctx, plan, build_executor(ctx, plan.child))
     raise NotImplementedError(f"no executor for {type(plan).__name__}")

@@ -1,0 +1,270 @@
+"""Window function executor (reference pkg/executor/window.go + pipelined
+window in pkg/executor/pipelined_window.go — re-designed as whole-partition
+vectorized numpy: one lexsort per window spec, segment-scan computations,
+scatter back to input order; no goroutine pipeline).
+
+Default frame semantics (MySQL): with ORDER BY the frame is RANGE UNBOUNDED
+PRECEDING..CURRENT ROW (peers included); without ORDER BY the frame is the
+whole partition."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+from ..expression import EvalCtx, eval_expr
+from ..expression.vec import materialize_nulls
+from ..types.field_type import TypeClass
+from ..types.decimal import _POW10
+from ..errors import UnsupportedError
+from .exec_base import Executor, bind_chunk
+from .executors import _sort_key_arrays
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+class WindowExec(Executor):
+    def __init__(self, ctx, plan, child):
+        super().__init__(ctx, plan.schema, [child])
+        self.descs = plan.descs
+        self._out = None
+
+    def next(self):
+        if self._out is None:
+            chunks = self.child.all_chunks()
+            merged = Chunk.concat_all(chunks)
+            if merged is None:
+                self._out = []
+            else:
+                self._out = [self._compute(merged)]
+        if not self._out:
+            return None
+        return self._out.pop(0)
+
+    def _compute(self, chunk: Chunk) -> Chunk:
+        n = len(chunk)
+        cols = bind_chunk(self.child.schema, chunk)
+        ectx = EvalCtx(np, n, cols, host=True)
+        by_idx = {sc.col.idx: col
+                  for sc, col in zip(self.child.schema.cols, chunk.columns)}
+        for d in self.descs:
+            by_idx[d.out_col.idx] = self._one_desc(d, ectx, chunk, n)
+        # emit in output-schema order (pruning may have reshaped it)
+        return Chunk([by_idx[sc.col.idx] for sc in self.schema.cols])
+
+    def _one_desc(self, d, ectx, chunk, n) -> Column:
+        items = [(e, False) for e in d.partition_by] + list(d.order_by)
+        if items:
+            keys = _sort_key_arrays(self.child.schema, chunk, items)
+            order = np.lexsort(list(reversed(keys)))
+        else:
+            order = np.arange(n)
+        # partition boundaries in sorted order
+        part_start_flag = np.zeros(n, dtype=bool)
+        if n:
+            part_start_flag[0] = True
+        for e in d.partition_by:
+            data, nulls, sd = eval_expr(ectx, e)
+            nm = np.asarray(materialize_nulls(ectx, nulls))
+            arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
+            if arr.dtype == object:
+                sarr = arr[order]
+                chg = np.ones(n, dtype=bool)
+                chg[1:] = sarr[1:] != sarr[:-1]
+            else:
+                key = np.where(nm, -(1 << 62), arr.astype(np.int64))
+                skey = key[order]
+                chg = np.ones(n, dtype=bool)
+                chg[1:] = skey[1:] != skey[:-1]
+            part_start_flag |= chg
+        part_id = np.cumsum(part_start_flag) - 1 if n else part_start_flag
+        part_start = np.zeros(n, dtype=np.int64)
+        starts = np.nonzero(part_start_flag)[0]
+        if n:
+            part_start = starts[part_id]
+        # partition end (exclusive)
+        ends = np.append(starts[1:], n) if n else np.array([], dtype=np.int64)
+        part_end = ends[part_id] if n else part_start
+        # peer groups: order-key change within partition
+        peer_start_flag = part_start_flag.copy()
+        for e, _desc in d.order_by:
+            data, nulls, sd = eval_expr(ectx, e)
+            nm = np.asarray(materialize_nulls(ectx, nulls))
+            arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
+            if arr.dtype == object:
+                sarr = arr[order]
+                chg = np.ones(n, dtype=bool)
+                chg[1:] = sarr[1:] != sarr[:-1]
+            else:
+                key = np.where(nm, -(1 << 62),
+                               arr.view(np.int64) if arr.dtype.kind == "f"
+                               else arr.astype(np.int64))
+                skey = key[order]
+                chg = np.ones(n, dtype=bool)
+                chg[1:] = skey[1:] != skey[:-1]
+            peer_start_flag |= chg
+        peer_id = np.cumsum(peer_start_flag) - 1 if n else peer_start_flag
+        pstarts = np.nonzero(peer_start_flag)[0]
+        peer_start = pstarts[peer_id] if n else np.zeros(0, dtype=np.int64)
+        pends = np.append(pstarts[1:], n) if n else np.array([], dtype=np.int64)
+        peer_end = np.minimum(pends[peer_id], part_end) if n else peer_start
+
+        seq = np.arange(n) - part_start          # 0-based row num in partition
+        size = part_end - part_start
+
+        name = d.name
+        if d.args:
+            adata, anulls, asd = eval_expr(ectx, d.args[0])
+            nm = np.asarray(materialize_nulls(ectx, anulls))
+            vals = np.asarray(adata) if not np.isscalar(adata) \
+                else np.full(n, adata)
+            svals = vals[order]
+            sok = (~nm)[order]
+        else:
+            svals = np.ones(n, dtype=np.int64)
+            sok = np.ones(n, dtype=bool)
+            asd = None
+
+        sorted_out, sorted_nulls = self._fn(
+            name, d, svals, sok, seq, size, part_start, part_end,
+            peer_start, peer_end, part_start_flag, n, ectx)
+
+        # scatter back to input row order
+        out = np.empty_like(sorted_out)
+        out[order] = sorted_out
+        nulls = None
+        if sorted_nulls is not None:
+            nulls = np.empty_like(sorted_nulls)
+            nulls[order] = sorted_nulls
+            if not nulls.any():
+                nulls = None
+        return Column(d.ft, out, nulls, asd if name in (
+            "lag", "lead", "first_value", "last_value", "min", "max") else None)
+
+    def _fn(self, name, d, svals, sok, seq, size, part_start, part_end,
+            peer_start, peer_end, part_flag, n, ectx):
+        if name == "row_number":
+            return seq + 1, None
+        if name == "rank":
+            return peer_start - part_start + 1, None
+        if name == "dense_rank":
+            # number of peer groups before current, within partition
+            peer_flag_int = np.zeros(n, dtype=np.int64)
+            peer_flag_int[np.nonzero(part_flag | (peer_start == np.arange(n)))] = 0
+            # dense rank = count of peer starts in partition up to current
+            starts_cum = np.cumsum((peer_start == np.arange(n)).astype(np.int64))
+            base = starts_cum[part_start]
+            return starts_cum[peer_start] - base + 1, None
+        if name == "percent_rank":
+            denom = np.maximum(size - 1, 1)
+            return (peer_start - part_start) / denom, None
+        if name == "cume_dist":
+            return (peer_end - part_start) / np.maximum(size, 1), None
+        if name == "ntile":
+            from ..expression import Constant
+            nt = int(d.args[0].value.val) if d.args else 1
+            q, r = np.divmod(size, max(nt, 1))
+            # first r buckets get q+1 rows
+            big = r * (q + 1)
+            in_big = seq < big
+            bucket = np.where(in_big, seq // np.maximum(q + 1, 1),
+                              r + (seq - big) // np.maximum(q, 1))
+            return bucket + 1, None
+        if name in ("lag", "lead"):
+            offset = 1
+            default = None
+            if len(d.args) > 1:
+                from ..expression import Constant
+                if isinstance(d.args[1], Constant):
+                    offset = int(d.args[1].value.val)
+            if len(d.args) > 2:
+                from ..expression import Constant
+                if isinstance(d.args[2], Constant) and \
+                        not d.args[2].value.is_null:
+                    default = d.args[2].value.val
+            shift = -offset if name == "lag" else offset
+            idx = np.arange(n) + shift
+            valid = (idx >= part_start) & (idx < part_end)
+            idx = np.clip(idx, 0, max(n - 1, 0))
+            out = svals[idx]
+            nulls = (~sok[idx]) | ~valid
+            if default is not None:
+                dv = default
+                if d.ft.tclass == TypeClass.DECIMAL:
+                    from ..types.decimal import dec_to_scaled_int
+                    dv = dec_to_scaled_int(dv, max(d.ft.decimal, 0))
+                out = np.where(valid, out, dv)
+                nulls = np.where(valid, nulls, False)
+            return out, nulls
+        if name == "first_value":
+            out = svals[part_start]
+            return out, ~sok[part_start]
+        if name == "last_value":
+            last = np.maximum(peer_end - 1, part_start)
+            return svals[last], ~sok[last]
+        if name == "count":
+            cnt_cum = np.cumsum(sok.astype(np.int64))
+            base = np.where(part_start > 0, cnt_cum[part_start - 1], 0)
+            if d.order_by:
+                return cnt_cum[peer_end - 1] - base, None
+            return cnt_cum[part_end - 1] - base, None
+        if name in ("sum", "avg"):
+            acc = np.cumsum(np.where(sok, svals, 0).astype(
+                np.float64 if svals.dtype.kind == "f" else np.int64))
+            cnt_cum = np.cumsum(sok.astype(np.int64))
+            base = np.where(part_start > 0, acc[part_start - 1], 0)
+            cbase = np.where(part_start > 0, cnt_cum[part_start - 1], 0)
+            end = (peer_end if d.order_by else part_end) - 1
+            s = acc[end] - base
+            c = cnt_cum[end] - cbase
+            nulls = c == 0
+            if name == "sum":
+                s = self._sum_scale(d, s)
+                return s, nulls
+            # avg
+            if d.ft.tclass == TypeClass.DECIMAL:
+                src = max(d.args[0].ft.decimal, 0) \
+                    if d.args[0].ft.tclass == TypeClass.DECIMAL else 0
+                tgt = max(d.ft.decimal, 0)
+                num = s.astype(np.int64) * _POW10[max(tgt - src, 0)]
+                safe = np.maximum(c, 1)
+                q = num // safe
+                r = num - q * safe
+                q = np.where(2 * np.abs(r) >= safe, q + np.sign(num), q)
+                return q, nulls
+            return s.astype(np.float64) / np.maximum(c, 1), nulls
+        if name in ("min", "max"):
+            out = np.empty_like(svals)
+            if svals.dtype.kind == "f":
+                ident = np.inf if name == "min" else -np.inf
+            else:
+                ident = _I64_MAX if name == "min" else -_I64_MAX
+            filled = np.where(sok, svals, ident)
+            starts = np.nonzero(part_flag)[0]
+            ends = np.append(starts[1:], n)
+            op = np.minimum if name == "min" else np.maximum
+            cnt_cum = np.cumsum(sok.astype(np.int64))
+            cbase = np.where(part_start > 0, cnt_cum[part_start - 1], 0)
+            for s0, e0 in zip(starts, ends):
+                out[s0:e0] = op.accumulate(filled[s0:e0])
+            if d.order_by:
+                # extend to peer end
+                out = out[np.maximum(peer_end - 1, part_start)]
+                c = cnt_cum[peer_end - 1] - cbase
+            else:
+                out = out[part_end - 1]
+                c = cnt_cum[part_end - 1] - cbase
+            return out, c == 0
+        raise UnsupportedError("window function %s not supported", name)
+
+    def _sum_scale(self, d, s):
+        if d.ft.tclass == TypeClass.DECIMAL:
+            src = max(d.args[0].ft.decimal, 0) \
+                if d.args[0].ft.tclass == TypeClass.DECIMAL else 0
+            tgt = max(d.ft.decimal, 0)
+            if tgt > src:
+                return s.astype(np.int64) * _POW10[tgt - src]
+            return s.astype(np.int64)
+        if d.ft.tclass == TypeClass.FLOAT and s.dtype.kind != "f":
+            return s.astype(np.float64)
+        return s

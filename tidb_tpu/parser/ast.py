@@ -65,6 +65,23 @@ class AggFunc(ExprNode):
 
 
 @dataclass
+class WindowFrame:
+    unit: str = "range"        # rows | range
+    start: str = "unbounded_preceding"
+    end: str = "current_row"
+
+
+@dataclass
+class WindowFunc(ExprNode):
+    name: str
+    args: list = field(default_factory=list)
+    partition_by: list = field(default_factory=list)
+    order_by: list = field(default_factory=list)   # [OrderItem]
+    frame: WindowFrame | None = None
+    distinct: bool = False
+
+
+@dataclass
 class IsNull(ExprNode):
     expr: ExprNode
     negated: bool = False

@@ -16,6 +16,10 @@ AGG_FUNCS = {"count", "sum", "avg", "min", "max", "group_concat",
              "bit_and", "bit_or", "bit_xor", "std", "stddev", "stddev_pop",
              "var_pop", "variance", "any_value"}
 
+WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "ntile", "lag",
+                     "lead", "first_value", "last_value", "nth_value",
+                     "percent_rank", "cume_dist"}
+
 _CMP_OPS = {"=", "<=>", "<", "<=", ">", ">=", "!=", "<>"}
 
 _TIME_UNITS = {"microsecond", "second", "minute", "hour", "day", "week",
@@ -1121,6 +1125,41 @@ class Parser:
             return self.parse_column_ref()
         self.error("expected expression")
 
+    def parse_over(self, name, args, distinct):
+        self.expect_kw("over")
+        self.expect_op("(")
+        w = ast.WindowFunc(name=name, args=args, distinct=distinct)
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            w.partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                w.partition_by.append(self.parse_expr())
+        w.order_by = self.parse_order_by()
+        if self.at_kw("rows", "range"):
+            unit = self.next().text.lower()
+            frame = ast.WindowFrame(unit=unit)
+
+            def bound():
+                if self.accept_kw("unbounded"):
+                    which = self.next().text.lower()  # preceding|following
+                    return f"unbounded_{which}"
+                if self.accept_kw("current"):
+                    self.expect_kw("row")
+                    return "current_row"
+                n = self.next().text
+                which = self.next().text.lower()
+                return f"{n}_{which}"
+            if self.accept_kw("between"):
+                frame.start = bound()
+                self.expect_kw("and")
+                frame.end = bound()
+            else:
+                frame.start = bound()
+                frame.end = "current_row"
+            w.frame = frame
+        self.expect_op(")")
+        return w
+
     def parse_case(self):
         self.expect_kw("case")
         operand = None
@@ -1158,19 +1197,27 @@ class Parser:
     def parse_func_call(self):
         name = self.ident().lower()
         self.expect_op("(")
-        if name in AGG_FUNCS:
+        if name in AGG_FUNCS or name in WINDOW_ONLY_FUNCS:
             distinct = self.accept_kw("distinct")
+            star = False
             if name == "count" and self.accept_op("*"):
-                self.expect_op(")")
-                return ast.AggFunc("count", [ast.Wildcard()], distinct=False)
-            args = []
-            if not self.at_op(")"):
-                args.append(self.parse_expr())
-                while self.accept_op(","):
+                star = True
+                args = [ast.Wildcard()]
+            else:
+                args = []
+                if not self.at_op(")"):
                     args.append(self.parse_expr())
-            if name == "group_concat" and self.accept_kw("separator"):
-                args.append(ast.Literal(self.next().text))
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                if name == "group_concat" and self.accept_kw("separator"):
+                    args.append(ast.Literal(self.next().text))
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self.parse_over(name, args, distinct)
+            if name in WINDOW_ONLY_FUNCS:
+                self.error(f"{name} requires an OVER clause")
+            if star:
+                return ast.AggFunc("count", [ast.Wildcard()], distinct=False)
             return ast.AggFunc(name, args, distinct=distinct)
         if name == "extract":
             unit = self.ident().lower()

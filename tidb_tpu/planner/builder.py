@@ -15,7 +15,8 @@ from ..errors import (UnsupportedError, NoDatabaseSelectedError,
                       MixOfGroupFuncAndFieldsError)
 from .schema import Schema, SchemaCol
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
-                      Aggregation, LJoin, Sort, LimitOp, Dual, UnionOp)
+                      Aggregation, LJoin, Sort, LimitOp, Dual, UnionOp,
+                      WindowOp, WindowDesc)
 from .rewriter import Rewriter
 
 
@@ -96,8 +97,9 @@ class PlanBuilder:
             raise NoDatabaseSelectedError("No database selected")
         return self.pctx.current_db
 
-    def _rewriter(self, schema, agg_mapper=None):
-        return Rewriter(self.pctx, schema, agg_mapper)
+    def _rewriter(self, schema, agg_mapper=None, window_mapper=None):
+        return Rewriter(self.pctx, schema, agg_mapper,
+                        window_mapper=window_mapper)
 
     # ---- FROM ---------------------------------------------------------
     def build_datasource(self, tn: ast.TableName) -> DataSource:
@@ -278,9 +280,42 @@ class PlanBuilder:
                 return e
             return e
 
+        # window functions (computed after GROUP BY/HAVING, before
+        # DISTINCT/ORDER BY — reference logical_window.go build order)
+        windows = []
+
+        def rw_window_part(e_ast):
+            r = self._rewriter(child_schema, agg_mapper if has_agg else None)
+            ex = r.rewrite(e_ast)
+            if has_agg:
+                ex = subst_agg(ex)
+            return ex
+
+        def window_mapper(node):
+            if node.frame is not None and not (
+                    node.frame.start == "unbounded_preceding"
+                    and node.frame.end == "current_row"):
+                raise UnsupportedError(
+                    "window frame %s..%s not supported yet",
+                    node.frame.start, node.frame.end)
+            args = [rw_window_part(a) for a in node.args
+                    if not isinstance(a, ast.Wildcard)]
+            part = [rw_window_part(e) for e in node.partition_by]
+            order = [(rw_window_part(oi.expr), oi.desc)
+                     for oi in node.order_by]
+            ft = window_result_ft(node.name, args)
+            col = self._new_col(ft, node.name)
+            desc = WindowDesc(node.name, args, part, order, ft, col)
+            windows.append(desc)
+            # window outputs are computed above the aggregation: keep
+            # subst_agg from wrapping them in first_row
+            agg_out_ids.add(col.idx)
+            return col
+
         fields = self._expand_wildcards(stmt.fields, child_schema)
         for f in fields:
-            rw = self._rewriter(child_schema, agg_mapper if has_agg else None)
+            rw = self._rewriter(child_schema, agg_mapper if has_agg else None,
+                                window_mapper=window_mapper)
             e = rw.rewrite(f.expr)
             if has_agg:
                 e = subst_agg(e)
@@ -301,6 +336,13 @@ class PlanBuilder:
         elif stmt.having is not None:
             rw = self._rewriter(child_schema)
             p = Selection(split_conjuncts(rw.rewrite(stmt.having)), p)
+
+        if windows:
+            wschema = Schema(list(p.schema.cols) +
+                             [SchemaCol(d.out_col, repr(d)) for d in windows])
+            w = WindowOp(windows, wschema, p)
+            w.stats_rows = p.stats_rows
+            p = w
 
         # ORDER BY: resolve against aliases, then agg outputs, then child
         sort_items = []
@@ -743,6 +785,17 @@ class ProjShell(LogicalPlan):
     def __init__(self, child, schema):
         super().__init__([child], schema)
         self.stats_rows = child.stats_rows
+
+
+def window_result_ft(name, args):
+    from ..types.field_type import new_bigint_type as _bi, new_double_type as _db
+    if name in ("row_number", "rank", "dense_rank", "ntile", "count"):
+        return _bi(not_null=True)
+    if name in ("percent_rank", "cume_dist"):
+        return _db()
+    if name in ("lag", "lead", "first_value", "last_value", "nth_value"):
+        return args[0].ft.clone() if args else _bi()
+    return agg_result_ft(name, args, False)
 
 
 def _auto_name(f: ast.SelectField) -> str:

@@ -120,6 +120,38 @@ class TopN(LogicalPlan):
                 f", offset:{self.offset}, count:{self.count}")
 
 
+class WindowDesc:
+    """One window function instance (reference
+    planner/core/operator/logicalop/logical_window.go WindowFuncDesc)."""
+
+    __slots__ = ("name", "args", "partition_by", "order_by", "ft", "out_col")
+
+    def __init__(self, name, args, partition_by, order_by, ft, out_col):
+        self.name = name
+        self.args = args
+        self.partition_by = partition_by
+        self.order_by = order_by          # [(expr, desc)]
+        self.ft = ft
+        self.out_col = out_col
+
+    def __repr__(self):
+        parts = f"{self.name}({', '.join(map(repr, self.args))}) over("
+        if self.partition_by:
+            parts += f"partition by {self.partition_by}"
+        if self.order_by:
+            parts += f" order by {[(repr(e), d) for e, d in self.order_by]}"
+        return parts + ")"
+
+
+class WindowOp(LogicalPlan):
+    def __init__(self, descs, schema, child):
+        super().__init__([child], schema)
+        self.descs = descs
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.descs))
+
+
 class UnionOp(LogicalPlan):
     def __init__(self, children, schema, all=True):
         super().__init__(children, schema)

@@ -13,7 +13,8 @@ from ..expression import Expression, Column, AggDesc
 from ..expression.vec import is_device_safe
 from .schema import Schema, SchemaCol
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
-                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp)
+                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp,
+                      WindowOp)
 from .builder import ProjShell
 
 _PUSHABLE_AGGS = {"sum", "count", "min", "max", "avg", "first_row"}
@@ -151,6 +152,15 @@ class PhysLimit(PhysPlan):
         return f"offset:{self.offset}, count:{self.count}"
 
 
+class PhysWindow(PhysPlan):
+    def __init__(self, descs, schema, child):
+        super().__init__([child], schema)
+        self.descs = descs
+
+    def explain_info(self):
+        return ", ".join(map(repr, self.descs))
+
+
 class PhysUnion(PhysPlan):
     def __init__(self, children, schema):
         super().__init__(children, schema)
@@ -240,6 +250,10 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
                 plan.count >= 0:
             child.dag.limit = plan.offset + plan.count
         p = PhysLimit(plan.offset, plan.count, child)
+        p.stats_rows = plan.stats_rows
+        return p
+    if isinstance(plan, WindowOp):
+        p = PhysWindow(plan.descs, plan.schema, _phys(plan.child))
         p.stats_rows = plan.stats_rows
         return p
     if isinstance(plan, UnionOp):

@@ -72,10 +72,12 @@ def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,
 
 
 class Rewriter:
-    def __init__(self, pctx, schema, agg_mapper=None, outer_schemas=None):
+    def __init__(self, pctx, schema, agg_mapper=None, outer_schemas=None,
+                 window_mapper=None):
         self.pctx = pctx          # PlanContext
         self.schema = schema
         self.agg_mapper = agg_mapper
+        self.window_mapper = window_mapper
         self.outer_schemas = outer_schemas or []
         self.outer_used = False   # set when a column resolved via outer scope
 
@@ -425,6 +427,12 @@ class Rewriter:
             return self.mk_func(name, [a], ft)
         args = [self.rewrite(a) for a in node.args]
         return self.mk_func(name, args)
+
+    def _rw_WindowFunc(self, node):
+        if self.window_mapper is None:
+            raise UnsupportedError(
+                "window function %s not allowed in this context", node.name)
+        return self.window_mapper(node)
 
     def _rw_AggFunc(self, node: ast.AggFunc):
         if self.agg_mapper is None:

@@ -5,7 +5,8 @@ from __future__ import annotations
 
 from ..expression import Expression, Column, Constant, ScalarFunc
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
-                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp)
+                      Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp,
+                      WindowOp)
 from .builder import ProjShell
 
 
@@ -101,6 +102,10 @@ def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
         plan.children[1] = push_down_predicates(plan.children[1], rconds)
         _refresh_join_stats(plan)
         return _wrap_sel(plan, keep)
+    if isinstance(plan, WindowOp):
+        # predicates cannot cross a window boundary safely; apply above
+        plan.children[0] = push_down_predicates(plan.child, [])
+        return _wrap_sel(plan, conds)
     if isinstance(plan, (Sort, LimitOp, TopN)):
         if isinstance(plan, LimitOp) or isinstance(plan, TopN):
             # cannot push through limit; apply above
@@ -211,6 +216,25 @@ def prune_columns(plan: LogicalPlan, needed: set):
         plan.schema.cols = [sc for sc in plan.schema.cols
                             if sc.col.idx in needed] or plan.schema.cols[:1]
         prune_columns(plan.child, {sc.col.idx for sc in plan.schema.cols})
+        return
+    if isinstance(plan, WindowOp):
+        kept = [d for d in plan.descs if d.out_col.idx in needed]
+        plan.descs = kept or plan.descs[:1]
+        out_ids = {d.out_col.idx for d in plan.descs}
+        child_needed = {i for i in needed if i not in out_ids}
+        for d in plan.descs:
+            for e in d.args:
+                child_needed |= _cols_of(e)
+            for e in d.partition_by:
+                child_needed |= _cols_of(e)
+            for e, _ in d.order_by:
+                child_needed |= _cols_of(e)
+        if not child_needed and plan.child.schema.cols:
+            child_needed = {plan.child.schema.cols[0].col.idx}
+        plan.schema.cols = [sc for sc in plan.schema.cols
+                            if sc.col.idx in needed or sc.col.idx in out_ids
+                            or sc.col.idx in child_needed]
+        prune_columns(plan.child, child_needed)
         return
     if isinstance(plan, Selection):
         child_needed = set(needed)
