@@ -1,0 +1,60 @@
+"""IMPORT INTO: native C++ loader vs python fallback parity."""
+import os
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.native.loader import native_available
+
+
+@pytest.fixture()
+def tk():
+    return TestKit()
+
+
+TBL = """1|7.5|12.34|1994-02-03|hello|1994-02-03 10:20:30
+2|-1.25|0.05|1999-12-31|world|1999-12-31 23:59:59.5
+3|0|-3.3|1970-01-01|hello|1970-01-01 00:00:00
+"""
+
+
+def _mk(tk, tmp_path):
+    tk.must_exec("create table imp (a int, f double, d decimal(10,2), "
+                 "dt date, s varchar(20), ts datetime)")
+    p = tmp_path / "data.tbl"
+    p.write_text(TBL)
+    return str(p)
+
+
+EXPECT = [
+    (1, 7.5, "12.34", "1994-02-03", "hello", "1994-02-03 10:20:30"),
+    (2, -1.25, "0.05", "1999-12-31", "world", "1999-12-31 23:59:59"),
+    (3, 0, "-3.30", "1970-01-01", "hello", "1970-01-01 00:00:00"),
+]
+
+
+def test_import_python_path(tk, tmp_path):
+    p = _mk(tk, tmp_path)
+    tk.must_exec(f"import into imp from '{p}' with force_python")
+    tk.must_query("select * from imp order by a").check(EXPECT)
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_import_native_path(tk, tmp_path):
+    p = _mk(tk, tmp_path)
+    r = tk.must_exec(f"import into imp from '{p}'")
+    assert r.affected == 3
+    tk.must_query("select * from imp order by a").check(EXPECT)
+    # dict-encoded strings grouped correctly
+    tk.must_query("select s, count(*) from imp group by s order by s").check([
+        ("hello", 2), ("world", 1)])
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+def test_native_decimal_rounding(tk, tmp_path):
+    tk.must_exec("create table nd (d decimal(10,2))")
+    p = tmp_path / "nd.csv"
+    p.write_text("1.005\n-1.005\n2.994\n")
+    tk.must_exec(f"import into nd from '{p}'")
+    tk.must_query("select d from nd order by d").check([
+        ("-1.01",), ("1.01",), ("2.99",)])

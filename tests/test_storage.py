@@ -130,3 +130,29 @@ def test_sysvars():
     sv1, sv2 = SessionVars(g), SessionVars(g)
     sv1.set("tidb_executor_concurrency", 4, is_global=True)
     assert sv2.get("tidb_executor_concurrency") == 4
+
+
+def test_native_memtable_parity():
+    """C++ memtable must behave exactly like the python MemKV."""
+    from tidb_tpu.native.memtable import NativeMemKV, native_available
+    import random
+    if not native_available():
+        import pytest
+        pytest.skip("no C++ toolchain")
+    rng = random.Random(3)
+    a, b = NativeMemKV(), MemKV()
+    keys = [bytes([rng.randrange(256) for _ in range(rng.randrange(1, 12))])
+            for _ in range(500)]
+    for i, k in enumerate(keys):
+        a.put(k, i)
+        b.put(k, i)
+    for k in rng.sample(keys, 100):
+        a.delete(k)
+        b.delete(k)
+    assert len(a) == len(b)
+    for k in rng.sample(keys, 50):
+        assert a.get(k) == b.get(k)
+        assert (k in a) == (k in b)
+    lo, hi = b"\x10", b"\xd0"
+    assert list(a.scan(lo, hi)) == list(b.scan(lo, hi))
+    assert list(a.scan(b"")) == list(b.scan(b""))

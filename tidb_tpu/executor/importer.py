@@ -31,6 +31,31 @@ def exec_import(sess, stmt) -> ResultSet:
     if delim is None:
         delim = "|" if path.endswith(".tbl") else ","
     cols = tbl.public_columns()
+    ctab = sess.domain.columnar.table(tbl)
+
+    # native C++ loader fast path (tidb_tpu/native/loader.cpp)
+    from ..native import loader as nl
+    parsed = None
+    if not stmt.options.get("force_python"):
+        parsed = nl.parse_file(path, [c.ft for c in cols], delim)
+    if parsed is not None:
+        n = 0
+        columns = {}
+        for ci, res in zip(cols, parsed):
+            if isinstance(res, tuple):
+                codes, values = res
+                d = ctab.dicts[ci.id]
+                mapping = np.array([d.encode_one(v) for v in values] or [0],
+                                   dtype=np.int32)
+                columns[ci.name] = mapping[codes]
+                n = len(codes)
+            else:
+                columns[ci.name] = res
+                n = len(res)
+        ctab.bulk_append(columns, n,
+                         commit_ts=sess.domain.storage.current_ts())
+        return ResultSet(affected=n)
+
     raw = [[] for _ in cols]
     with open(path, newline="") as f:
         rd = csv.reader(f, delimiter=delim)
@@ -41,8 +66,7 @@ def exec_import(sess, stmt) -> ResultSet:
     columns = {}
     for ci, vals in zip(cols, raw):
         columns[ci.name] = convert_text_column(ci.ft, vals)
-    ctab = sess.domain.columnar.table(tbl)
-    ctab.bulk_append(columns, n)
+    ctab.bulk_append(columns, n, commit_ts=sess.domain.storage.current_ts())
     return ResultSet(affected=n)
 
 

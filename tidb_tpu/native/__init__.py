@@ -1,0 +1,8 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Built lazily with g++ on first use and cached next to the sources; every
+caller has a pure-Python fallback so the engine works without a toolchain.
+"""
+from .build import load_library
+
+__all__ = ["load_library"]

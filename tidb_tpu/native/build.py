@@ -1,0 +1,33 @@
+"""Lazy g++ build + ctypes load for native components."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def load_library(name: str):
+    """Compile {name}.cpp -> lib{name}.so (cached by mtime) and dlopen it.
+    Returns None when no toolchain is available."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        so = os.path.join(_DIR, f"lib{name}.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", so, src],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            lib = None
+        _CACHE[name] = lib
+        return lib

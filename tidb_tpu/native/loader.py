@@ -1,0 +1,122 @@
+"""Python binding for the native bulk loader (loader.cpp)."""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..types.field_type import TypeClass
+from .build import load_library
+
+# type tags shared with loader.cpp
+T_INT, T_FLOAT, T_DECIMAL, T_DATE, T_DATETIME, T_STRING = range(6)
+
+
+def _type_tag(ft):
+    tc = ft.tclass
+    if tc in (TypeClass.STRING, TypeClass.JSON, TypeClass.ENUM, TypeClass.SET):
+        return T_STRING
+    if tc == TypeClass.FLOAT:
+        return T_FLOAT
+    if tc == TypeClass.DECIMAL:
+        return T_DECIMAL
+    if tc == TypeClass.DATE:
+        return T_DATE
+    if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+        return T_DATETIME
+    return T_INT
+
+
+_lib = None
+_inited = False
+
+
+def _get_lib():
+    global _lib, _inited
+    if not _inited:
+        _inited = True
+        lib = load_library("loader")
+        if lib is not None:
+            lib.tt_count_rows.restype = ctypes.c_int64
+            lib.tt_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.tt_parse.restype = ctypes.c_int64
+            lib.tt_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p)]
+            lib.tt_dict_size.restype = ctypes.c_int32
+            lib.tt_dict_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.tt_dict_blob_size.restype = ctypes.c_int64
+            lib.tt_dict_blob_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.tt_dict_fetch.restype = None
+            lib.tt_dict_fetch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.tt_free_state.restype = None
+            lib.tt_free_state.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def parse_file(path: str, fts: list, delim: str):
+    """Parse a delimited file -> list of per-column results:
+    numeric types -> numpy array; string types -> (codes int32, values list).
+    Returns None when the native library is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    with open(path, "rb") as f:
+        buf = f.read()
+    n = lib.tt_count_rows(buf, len(buf))
+    if n <= 0:
+        return [np.empty(0, dtype=np.int64) for _ in fts]
+    ncols = len(fts)
+    types = (ctypes.c_int32 * ncols)(*[_type_tag(ft) for ft in fts])
+    scales = (ctypes.c_int32 * ncols)(
+        *[max(ft.decimal, 0) if ft.tclass == TypeClass.DECIMAL else 0
+          for ft in fts])
+    arrays = []
+    outs = (ctypes.c_void_p * ncols)()
+    for i, ft in enumerate(fts):
+        tag = types[i]
+        if tag == T_FLOAT:
+            a = np.empty(n, dtype=np.float64)
+        elif tag == T_STRING:
+            a = np.empty(n, dtype=np.int32)
+        else:
+            a = np.empty(n, dtype=np.int64)
+        arrays.append(a)
+        outs[i] = a.ctypes.data_as(ctypes.c_void_p)
+    state = ctypes.c_void_p()
+    rows = lib.tt_parse(buf, len(buf), delim.encode()[:1], ncols, types,
+                        scales, outs, ctypes.byref(state))
+    if rows < 0:
+        return None
+    results = []
+    try:
+        for i, ft in enumerate(fts):
+            if types[i] == T_STRING:
+                k = lib.tt_dict_size(state, i)
+                bs = lib.tt_dict_blob_size(state, i)
+                blob = ctypes.create_string_buffer(max(int(bs), 1))
+                offs = np.empty(k + 1, dtype=np.int64)
+                lib.tt_dict_fetch(state, i, blob,
+                                  offs.ctypes.data_as(
+                                      ctypes.POINTER(ctypes.c_int64)))
+                raw = blob.raw[:bs]
+                values = [raw[offs[j]:offs[j + 1]].decode("utf-8",
+                                                          "surrogateescape")
+                          for j in range(k)]
+                results.append((arrays[i][:rows], values))
+            else:
+                results.append(arrays[i][:rows])
+    finally:
+        lib.tt_free_state(state)
+    return results

@@ -1,0 +1,97 @@
+// Native sorted memtable: ordered byte-string keys -> int64 slots.
+// (reference role: the memtable under unistore's badger / TiKV's RocksDB —
+// here the ordered index of the embedded row engine; Python keeps the value
+// objects, C++ owns ordering + lookup, replacing O(n) bisect insertion.)
+//
+// Values are int64 slot ids managed by the Python side; -1 = absent.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+
+namespace {
+struct MemTable {
+  std::map<std::string, int64_t> m;
+};
+struct Iter {
+  MemTable* mt;
+  std::map<std::string, int64_t>::iterator it;
+};
+}  // namespace
+
+extern "C" {
+
+void* mt_new() { return new MemTable(); }
+
+void mt_free(void* h) { delete static_cast<MemTable*>(h); }
+
+// returns previous slot or -1
+int64_t mt_put(void* h, const char* k, int64_t klen, int64_t slot) {
+  auto* mt = static_cast<MemTable*>(h);
+  std::string key(k, static_cast<size_t>(klen));
+  auto res = mt->m.emplace(std::move(key), slot);
+  if (!res.second) {
+    int64_t old = res.first->second;
+    res.first->second = slot;
+    return old;
+  }
+  return -1;
+}
+
+int64_t mt_get(void* h, const char* k, int64_t klen) {
+  auto* mt = static_cast<MemTable*>(h);
+  auto it = mt->m.find(std::string(k, static_cast<size_t>(klen)));
+  return it == mt->m.end() ? -1 : it->second;
+}
+
+// returns removed slot or -1
+int64_t mt_erase(void* h, const char* k, int64_t klen) {
+  auto* mt = static_cast<MemTable*>(h);
+  auto it = mt->m.find(std::string(k, static_cast<size_t>(klen)));
+  if (it == mt->m.end()) return -1;
+  int64_t old = it->second;
+  mt->m.erase(it);
+  return old;
+}
+
+int64_t mt_len(void* h) {
+  return static_cast<int64_t>(static_cast<MemTable*>(h)->m.size());
+}
+
+void* mt_seek(void* h, const char* k, int64_t klen) {
+  auto* mt = static_cast<MemTable*>(h);
+  Iter* it = new Iter();
+  it->mt = mt;
+  it->it = mt->m.lower_bound(std::string(k, static_cast<size_t>(klen)));
+  return it;
+}
+
+int mt_iter_valid(void* ih) {
+  Iter* it = static_cast<Iter*>(ih);
+  return it->it != it->mt->m.end() ? 1 : 0;
+}
+
+int64_t mt_iter_key_len(void* ih) {
+  Iter* it = static_cast<Iter*>(ih);
+  return static_cast<int64_t>(it->it->first.size());
+}
+
+void mt_iter_key(void* ih, char* out) {
+  Iter* it = static_cast<Iter*>(ih);
+  memcpy(out, it->it->first.data(), it->it->first.size());
+}
+
+int64_t mt_iter_slot(void* ih) {
+  Iter* it = static_cast<Iter*>(ih);
+  return it->it->second;
+}
+
+void mt_iter_next(void* ih) {
+  Iter* it = static_cast<Iter*>(ih);
+  ++it->it;
+}
+
+void mt_iter_free(void* ih) { delete static_cast<Iter*>(ih); }
+
+}  // extern "C"

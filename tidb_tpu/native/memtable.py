@@ -1,0 +1,130 @@
+"""Python binding for the native sorted memtable (memtable.cpp): the same
+interface as storage.kv.MemKV, with C++ owning key ordering and Python
+owning the value objects (slot list with a free-list)."""
+from __future__ import annotations
+
+import ctypes
+
+from .build import load_library
+
+_lib = None
+_inited = False
+
+
+def _get_lib():
+    global _lib, _inited
+    if not _inited:
+        _inited = True
+        lib = load_library("memtable")
+        if lib is not None:
+            i64, vp, cp = ctypes.c_int64, ctypes.c_void_p, ctypes.c_char_p
+            lib.mt_new.restype = vp
+            lib.mt_free.argtypes = [vp]
+            lib.mt_put.restype = i64
+            lib.mt_put.argtypes = [vp, cp, i64, i64]
+            lib.mt_get.restype = i64
+            lib.mt_get.argtypes = [vp, cp, i64]
+            lib.mt_erase.restype = i64
+            lib.mt_erase.argtypes = [vp, cp, i64]
+            lib.mt_len.restype = i64
+            lib.mt_len.argtypes = [vp]
+            lib.mt_seek.restype = vp
+            lib.mt_seek.argtypes = [vp, cp, i64]
+            lib.mt_iter_valid.restype = ctypes.c_int
+            lib.mt_iter_valid.argtypes = [vp]
+            lib.mt_iter_key_len.restype = i64
+            lib.mt_iter_key_len.argtypes = [vp]
+            lib.mt_iter_key.restype = None
+            lib.mt_iter_key.argtypes = [vp, cp]
+            lib.mt_iter_slot.restype = i64
+            lib.mt_iter_slot.argtypes = [vp]
+            lib.mt_iter_next.restype = None
+            lib.mt_iter_next.argtypes = [vp]
+            lib.mt_iter_free.restype = None
+            lib.mt_iter_free.argtypes = [vp]
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeMemKV:
+    """Drop-in for storage.kv.MemKV backed by the C++ sorted map."""
+
+    __slots__ = ("_h", "_vals", "_free", "_lib")
+
+    def __init__(self):
+        self._lib = _get_lib()
+        self._h = self._lib.mt_new()
+        self._vals: list = []
+        self._free: list = []
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.mt_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def _alloc(self, value) -> int:
+        if self._free:
+            slot = self._free.pop()
+            self._vals[slot] = value
+        else:
+            slot = len(self._vals)
+            self._vals.append(value)
+        return slot
+
+    def get(self, key: bytes):
+        slot = self._lib.mt_get(self._h, key, len(key))
+        return None if slot < 0 else self._vals[slot]
+
+    def put(self, key: bytes, value):
+        slot = self._alloc(value)
+        old = self._lib.mt_put(self._h, key, len(key), slot)
+        if old >= 0:
+            self._vals[old] = None
+            self._free.append(old)
+
+    def delete(self, key: bytes):
+        old = self._lib.mt_erase(self._h, key, len(key))
+        if old >= 0:
+            self._vals[old] = None
+            self._free.append(old)
+
+    def __len__(self):
+        return int(self._lib.mt_len(self._h))
+
+    def __contains__(self, key: bytes):
+        return self._lib.mt_get(self._h, key, len(key)) >= 0
+
+    def scan(self, start: bytes, end: bytes | None = None):
+        lib = self._lib
+        it = lib.mt_seek(self._h, start, len(start))
+        try:
+            while lib.mt_iter_valid(it):
+                klen = lib.mt_iter_key_len(it)
+                buf = ctypes.create_string_buffer(int(klen))
+                lib.mt_iter_key(it, buf)
+                k = buf.raw[:klen]
+                if end is not None and k >= end:
+                    break
+                yield k, self._vals[lib.mt_iter_slot(it)]
+                lib.mt_iter_next(it)
+        finally:
+            lib.mt_iter_free(it)
+
+    def scan_keys(self, start: bytes, end: bytes | None = None):
+        for k, _ in self.scan(start, end):
+            yield k
+
+
+def new_memkv():
+    """Best-available ordered map: native C++ when buildable, else python."""
+    if native_available():
+        return NativeMemKV()
+    from ..storage.kv import MemKV
+    return MemKV()

@@ -14,6 +14,7 @@ import bisect
 import threading
 
 from .kv import MemKV
+from ..native.memtable import new_memkv
 from ..errors import WriteConflictError, LockWaitTimeoutError
 
 
@@ -51,7 +52,8 @@ class Lock:
 
 class MVCCStore:
     def __init__(self):
-        self._kv = MemKV()           # key -> _Versions
+        self._kv = new_memkv()       # key -> _Versions (C++ sorted memtable
+                                     # when available; python fallback)
         self._locks: dict[bytes, Lock] = {}
         self._mu = threading.Lock()
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit

@@ -11,6 +11,7 @@ import itertools
 import threading
 
 from .kv import MemKV
+from ..native.memtable import new_memkv
 from .mvcc import MVCCStore
 
 
@@ -50,7 +51,7 @@ class Transaction:
         self.for_update_ts = start_ts
         self.pessimistic = pessimistic
         self.snapshot = Snapshot(storage.mvcc, start_ts)
-        self.mem_buffer = MemKV()     # key -> value|None (None = delete)
+        self.mem_buffer = new_memkv() # key -> value|None (None = delete)
         self._dirty = False
         self.committed = False
         self.aborted = False
