@@ -1,0 +1,133 @@
+"""Wire protocol: a minimal in-test MySQL 4.1 client against the server."""
+import socket
+import struct
+
+import pytest
+
+from tidb_tpu.session import new_store
+from tidb_tpu.server import Server
+from tidb_tpu.server import protocol as P
+
+
+class MiniClient:
+    def __init__(self, port, db=""):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.io = P.PacketIO(self.sock)
+        greeting = self.io.read_packet()
+        assert greeting[0] == 10
+        caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+        if db:
+            caps |= P.CLIENT_CONNECT_WITH_DB
+        resp = struct.pack("<IIB", caps, 1 << 24, 46) + b"\x00" * 23
+        resp += b"root\x00" + b"\x00"
+        if db:
+            resp += db.encode() + b"\x00"
+        self.io.write_packet(resp)
+        ok = self.io.read_packet()
+        assert ok[0] == 0x00, ok
+
+    def _read_lenenc(self, data, pos):
+        b = data[pos]
+        if b < 251:
+            return b, pos + 1
+        if b == 0xFB:
+            return None, pos + 1
+        if b == 0xFC:
+            return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+        if b == 0xFD:
+            return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+        return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+
+    def query(self, sql):
+        self.io.reset_seq()
+        self.io.write_packet(bytes([P.COM_QUERY]) + sql.encode())
+        first = self.io.read_packet()
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(f"server error {code}: "
+                               f"{first[9:].decode(errors='replace')}")
+        if first[0] == 0x00:
+            affected, pos = self._read_lenenc(first, 1)
+            return {"affected": affected}
+        ncols, _ = self._read_lenenc(first, 0)
+        cols = []
+        for _ in range(ncols):
+            pkt = self.io.read_packet()
+            # parse column name (5th lenenc string)
+            pos = 0
+            vals = []
+            for _ in range(5):
+                ln, pos = self._read_lenenc(pkt, pos)
+                vals.append(pkt[pos:pos + ln])
+                pos += ln
+            cols.append(vals[4].decode())
+        eof = self.io.read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self.io.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            row = []
+            pos = 0
+            while pos < len(pkt):
+                v, pos2 = self._read_lenenc(pkt, pos)
+                if v is None:
+                    row.append(None)
+                    pos = pos2
+                else:
+                    row.append(pkt[pos2:pos2 + v].decode())
+                    pos = pos2 + v
+            rows.append(tuple(row))
+        return {"cols": cols, "rows": rows}
+
+    def close(self):
+        try:
+            self.io.reset_seq()
+            self.io.write_packet(bytes([P.COM_QUIT]))
+        except OSError:
+            pass
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def server():
+    domain = new_store()
+    srv = Server(domain, port=0).start()
+    yield srv
+    srv.shutdown()
+
+
+def test_wire_basic(server):
+    c = MiniClient(server.port, db="test")
+    try:
+        r = c.query("select 1+1, 'hi'")
+        assert r["rows"] == [("2", "hi")]
+        c.query("create table wt (a int primary key, b varchar(10))")
+        r = c.query("insert into wt values (1,'x'),(2,null)")
+        assert r["affected"] == 2
+        r = c.query("select * from wt order by a")
+        assert r["cols"] == ["a", "b"]
+        assert r["rows"] == [("1", "x"), ("2", None)]
+    finally:
+        c.close()
+
+
+def test_wire_error_and_sessions(server):
+    c1 = MiniClient(server.port, db="test")
+    c2 = MiniClient(server.port, db="test")
+    try:
+        with pytest.raises(RuntimeError, match="1146"):
+            c1.query("select * from missing_table")
+        c1.query("create table ws (a int)")
+        c1.query("begin")
+        c1.query("insert into ws values (1)")
+        # other connection doesn't see uncommitted data
+        r = c2.query("select count(*) from ws")
+        assert r["rows"] == [("0",)]
+        c1.query("commit")
+        r = c2.query("select count(*) from ws")
+        assert r["rows"] == [("1",)]
+    finally:
+        c1.close()
+        c2.close()

@@ -1,0 +1,140 @@
+"""MySQL-protocol server (reference pkg/server/server.go:498 Run +
+conn.go:1157 clientConn.Run). Threaded accept loop; one Session per
+connection; graceful shutdown drains connections."""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from ..session import Session, Domain
+from ..errors import TiDBError
+from . import protocol as P
+
+
+class Server:
+    def __init__(self, domain: Domain, host="127.0.0.1", port=4000):
+        self.domain = domain
+        self.host = host
+        self.port = port
+        self._sock = None
+        self._threads: list = []
+        self._running = False
+
+    def start(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- per-connection ----------------------------------------------
+    def _serve_conn(self, sock):
+        sess = Session(self.domain)
+        io = P.PacketIO(sock)
+        try:
+            salt = os.urandom(20)
+            io.write_packet(P.handshake_packet(
+                sess.conn_id, salt, "8.0.11-tidb-tpu-0.1.0"))
+            resp = io.read_packet()
+            user, db, caps = P.parse_handshake_response(resp)
+            if db:
+                try:
+                    sess.domain.infoschema().schema_by_name(db)
+                    sess.vars.current_db = db
+                except TiDBError:
+                    pass
+            io.write_packet(P.ok_packet())
+            self._command_loop(sess, io)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            sess.rollback()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _command_loop(self, sess: Session, io: P.PacketIO):
+        while True:
+            io.reset_seq()
+            pkt = io.read_packet()
+            if not pkt:
+                return
+            cmd = pkt[0]
+            if cmd == P.COM_QUIT:
+                return
+            if cmd == P.COM_PING:
+                io.write_packet(P.ok_packet())
+                continue
+            if cmd == P.COM_INIT_DB:
+                dbname = pkt[1:].decode()
+                try:
+                    sess.execute(f"use `{dbname}`")
+                    io.write_packet(P.ok_packet())
+                except TiDBError as e:
+                    io.write_packet(P.err_packet(e.code, e.sqlstate, e.msg))
+                continue
+            if cmd == P.COM_FIELD_LIST:
+                io.write_packet(P.eof_packet())
+                continue
+            if cmd == P.COM_QUERY:
+                sql = pkt[1:].decode("utf-8", "surrogateescape")
+                self._handle_query(sess, io, sql)
+                continue
+            io.write_packet(P.err_packet(1047, "08S01", "unknown command"))
+
+    def _handle_query(self, sess: Session, io: P.PacketIO, sql: str):
+        try:
+            rs = sess.execute(sql)
+        except TiDBError as e:
+            io.write_packet(P.err_packet(e.code, e.sqlstate, e.msg))
+            return
+        except Exception as e:   # internal error -> protocol error packet
+            io.write_packet(P.err_packet(1105, "HY000", str(e)[:400]))
+            return
+        if not rs.names:
+            io.write_packet(P.ok_packet(
+                affected=rs.affected, last_insert_id=rs.last_insert_id))
+            return
+        io.write_packet(P.lenenc_int(len(rs.names)))
+        for name in rs.names:
+            io.write_packet(P.column_def(name))
+        io.write_packet(P.eof_packet())
+        for ch in rs.chunks:
+            for i in range(len(ch)):
+                io.write_packet(P.text_row(ch.row_py(i)))
+        io.write_packet(P.eof_packet())
+
+
+def serve(port=4000):
+    """Entry point: bootstrapped store + MySQL-protocol listener
+    (reference cmd/tidb-server/main.go:400)."""
+    from ..session import new_store
+    domain = new_store()
+    srv = Server(domain, port=port).start()
+    return srv
