@@ -434,6 +434,15 @@ class DescTableStmt(StmtNode):
 
 
 @dataclass
+class BRStmt(StmtNode):
+    """BACKUP/RESTORE DATABASE db TO/FROM 'path' (reference br/ + BRIE SQL,
+    pkg/executor/brie.go)."""
+    kind: str = "backup"       # backup | restore
+    db: str = ""               # empty = all user databases
+    path: str = ""
+
+
+@dataclass
 class ImportStmt(StmtNode):
     """IMPORT INTO t FROM 'path' [WITH ...] — lightning-style bulk load
     (reference pkg/executor/import_into.go)."""

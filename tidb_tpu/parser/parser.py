@@ -156,6 +156,17 @@ class Parser:
             return ast.AnalyzeTableStmt(tables=tables)
         if kw == "import":
             return self.parse_import()
+        if kw in ("backup", "restore"):
+            self.next()
+            stmt = ast.BRStmt(kind=kw)
+            if self.accept_kw("database") or self.accept_kw("schema"):
+                if not self.at_op("*"):
+                    stmt.db = self.ident()
+                else:
+                    self.next()
+            self.expect_kw("to") if kw == "backup" else self.expect_kw("from")
+            stmt.path = self.next().text
+            return stmt
         self.error(f"unsupported statement '{kw}'")
 
     # ---- SELECT -------------------------------------------------------

@@ -194,6 +194,14 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.BRStmt):
+            from ..tools import br
+            self.commit()
+            if stmt.kind == "backup":
+                n = br.backup(self.domain, stmt.db, stmt.path)
+            else:
+                n = br.restore(self.domain, stmt.db, stmt.path)
+            return ResultSet(affected=n)
         # DDL: implicit commit first (MySQL semantics)
         ddl_map = {
             ast.CreateDatabaseStmt: self.ddl.create_database,
