@@ -1,0 +1,55 @@
+"""DXF task framework, timers, TTL (reference pkg/dxf, pkg/timer, pkg/ttl)."""
+import time
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.dxf import TaskManager, TaskState
+from tidb_tpu.ttl import run_ttl_once
+
+
+def test_dxf_basic():
+    tm = TaskManager(total_slots=4)
+    results = []
+    t = tm.submit("demo", [lambda c, i=i: i * 10 for i in range(6)],
+                  concurrency=3)
+    assert tm.wait(t, timeout=30)
+    assert t.state == TaskState.SUCCEEDED
+    assert sorted(t.results()) == [0, 10, 20, 30, 40, 50]
+
+
+def test_dxf_failure_and_cancel():
+    tm = TaskManager()
+
+    def boom(cancel):
+        raise ValueError("nope")
+    t = tm.submit("bad", [boom])
+    assert tm.wait(t, timeout=30)
+    assert t.state == TaskState.FAILED
+    assert "nope" in t.error
+
+    import threading
+    started = threading.Event()
+
+    def slow(cancel):
+        started.set()
+        cancel.wait(20)
+        return "done"
+    t2 = tm.submit("slow", [slow])
+    started.wait(10)
+    tm.cancel(t2.id)
+    assert tm.wait(t2, timeout=30)
+
+
+def test_ttl():
+    tk = TestKit()
+    tk.must_exec("create table ev (id int primary key, created datetime) "
+                 "ttl = created + interval 1 day")
+    tk.must_exec("insert into ev values "
+                 "(1, '2000-01-01 00:00:00'), (2, '2099-01-01 00:00:00')")
+    tbl = tk.domain.infoschema().table_by_name("test", "ev")
+    assert tbl.ttl == {"col": "created", "value": 1, "unit": "day",
+                       "enable": True}
+    deleted = run_ttl_once(tk.domain)
+    assert deleted == 1
+    tk.must_query("select id from ev").check([(2,)])

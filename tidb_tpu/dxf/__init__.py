@@ -1,0 +1,3 @@
+from .framework import TaskManager, Task, TaskState
+
+__all__ = ["TaskManager", "Task", "TaskState"]

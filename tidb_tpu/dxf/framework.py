@@ -1,0 +1,164 @@
+"""Distributed execution framework analog (reference pkg/dxf —
+task -> steps -> parallel subtasks with slot-based scheduling,
+framework/doc.go:41-92). Single-process redesign: a slot-bounded worker
+pool executes subtask callables; task/subtask state machines and the
+owner/scheduler seam are kept so a multi-node dispatcher can replace the
+in-process pool later.
+
+States (framework/proto): pending -> running -> succeeded | failed |
+cancelled; subtasks same. Failed subtasks fail the task; cancellation is
+cooperative via the task's cancel flag. Completed-subtask progress is the
+checkpoint/resume record (reference dxf/framework/storage)."""
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+
+class TaskState(enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+class Subtask:
+    __slots__ = ("id", "fn", "state", "error", "result")
+
+    def __init__(self, sid, fn):
+        self.id = sid
+        self.fn = fn
+        self.state = TaskState.PENDING
+        self.error = None
+        self.result = None
+
+
+class Task:
+    def __init__(self, tid, kind, concurrency):
+        self.id = tid
+        self.kind = kind
+        self.concurrency = concurrency   # slots (1 slot = 1 worker)
+        self.state = TaskState.PENDING
+        self.subtasks: list[Subtask] = []
+        self.error = None
+        self.cancel_flag = threading.Event()
+        self.done_event = threading.Event()
+
+    @property
+    def progress(self):
+        done = sum(1 for s in self.subtasks
+                   if s.state in (TaskState.SUCCEEDED, TaskState.FAILED))
+        return done, len(self.subtasks)
+
+    def results(self):
+        return [s.result for s in self.subtasks]
+
+
+class TaskManager:
+    """Owner-side scheduler + in-process executor pool (reference
+    dxf/framework/scheduler + taskexecutor collapsed)."""
+
+    def __init__(self, total_slots: int = 8):
+        self.total_slots = total_slots
+        self.tasks: dict[int, Task] = {}
+        self._ids = itertools.count(1)
+        self._mu = threading.Lock()
+
+    def submit(self, kind: str, subtask_fns: list, concurrency: int = 4,
+               on_done=None) -> Task:
+        """Create a task whose subtasks run on a bounded pool; returns the
+        Task immediately (async)."""
+        t = Task(next(self._ids), kind, min(concurrency, self.total_slots))
+        for i, fn in enumerate(subtask_fns):
+            t.subtasks.append(Subtask(i, fn))
+        with self._mu:
+            self.tasks[t.id] = t
+
+        def run():
+            t.state = TaskState.RUNNING
+            try:
+                with ThreadPoolExecutor(max_workers=max(t.concurrency, 1)) as ex:
+                    futs = []
+                    for st in t.subtasks:
+                        futs.append(ex.submit(self._run_subtask, t, st))
+                    for f in futs:
+                        f.result()
+                if t.cancel_flag.is_set():
+                    t.state = TaskState.CANCELLED
+                elif any(s.state == TaskState.FAILED for s in t.subtasks):
+                    t.state = TaskState.FAILED
+                    t.error = next(s.error for s in t.subtasks
+                                   if s.state == TaskState.FAILED)
+                else:
+                    t.state = TaskState.SUCCEEDED
+            finally:
+                t.done_event.set()
+                if on_done is not None:
+                    try:
+                        on_done(t)
+                    except Exception:
+                        pass
+        threading.Thread(target=run, daemon=True).start()
+        return t
+
+    def _run_subtask(self, t: Task, st: Subtask):
+        if t.cancel_flag.is_set():
+            st.state = TaskState.CANCELLED
+            return
+        st.state = TaskState.RUNNING
+        try:
+            st.result = st.fn(t.cancel_flag)
+            st.state = TaskState.SUCCEEDED
+        except Exception as e:                    # noqa: BLE001
+            st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            st.state = TaskState.FAILED
+
+    def cancel(self, tid: int):
+        t = self.tasks.get(tid)
+        if t is not None:
+            t.cancel_flag.set()
+
+    def wait(self, task: Task, timeout=None) -> bool:
+        return task.done_event.wait(timeout)
+
+
+class Timer:
+    """Periodic timer framework (reference pkg/timer — persisted cron/
+    interval timers; in-process thread variant, same hook shape)."""
+
+    def __init__(self):
+        self._timers: dict[str, threading.Event] = {}
+        self._mu = threading.Lock()
+
+    def register(self, name: str, interval_s: float, fn) -> None:
+        stop = threading.Event()
+        with self._mu:
+            old = self._timers.pop(name, None)
+            if old is not None:
+                old.set()
+            self._timers[name] = stop
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    fn()
+                except Exception:
+                    pass
+        threading.Thread(target=loop, daemon=True).start()
+
+    def stop(self, name: str):
+        with self._mu:
+            ev = self._timers.pop(name, None)
+            if ev is not None:
+                ev.set()
+
+    def stop_all(self):
+        with self._mu:
+            for ev in self._timers.values():
+                ev.set()
+            self._timers.clear()

@@ -92,6 +92,7 @@ class TableInfo:
     auto_inc_id: int = 0
     state: SchemaState = SchemaState.PUBLIC
     comment: str = ""
+    ttl: dict | None = None        # {"col", "value", "unit", "enable"}
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -120,7 +121,7 @@ class TableInfo:
             "indexes": [i.to_json() for i in self.indexes],
             "pk_is_handle": self.pk_is_handle, "pk_col_name": self.pk_col_name,
             "auto_inc_id": self.auto_inc_id, "state": int(self.state),
-            "comment": self.comment,
+            "comment": self.comment, "ttl": self.ttl,
         }
 
     @classmethod
@@ -131,7 +132,7 @@ class TableInfo:
             indexes=[IndexInfo.from_json(i) for i in j["indexes"]],
             pk_is_handle=j["pk_is_handle"], pk_col_name=j["pk_col_name"],
             auto_inc_id=j["auto_inc_id"], state=SchemaState(j["state"]),
-            comment=j.get("comment", ""))
+            comment=j.get("comment", ""), ttl=j.get("ttl"))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

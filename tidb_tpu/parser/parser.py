@@ -548,12 +548,20 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        # table options: ENGINE=..., CHARSET=..., AUTO_INCREMENT=..., COMMENT=...
+        # table options: ENGINE=..., CHARSET=..., COMMENT=..., TTL=col+INTERVAL n unit
         while self.peek().kind == "IDENT":
             opt = self.next().text.lower()
             if opt == "default":
                 continue
             self.accept_op("=")
+            if opt == "ttl":
+                col = self.ident()
+                self.expect_op("+")
+                self.expect_kw("interval")
+                nval = int(self.next().text)
+                unit = self.ident().lower()
+                stmt.options["ttl"] = (col, nval, unit)
+                continue
             t = self.next()
             stmt.options[opt] = t.text
         return stmt

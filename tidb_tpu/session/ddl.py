@@ -150,6 +150,16 @@ class DDLExecutor:
                     tbl.pk_is_handle = True
                     tbl.pk_col_name = ci.name
                     tbl.indexes = [i for i in tbl.indexes if not i.primary]
+            if "ttl" in stmt.options:
+                col, nval, unit = stmt.options["ttl"]
+                ci = tbl.find_column(col)
+                if ci is None:
+                    raise ColumnNotExistsError(
+                        "Unknown TTL column '%s'", col)
+                if not ci.ft.is_temporal:
+                    raise UnsupportedError("TTL column must be a time type")
+                tbl.ttl = {"col": ci.name, "value": nval, "unit": unit,
+                           "enable": True}
             m.create_table(db.id, tbl)
             return tbl
         self._with_meta(fn)

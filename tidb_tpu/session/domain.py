@@ -8,6 +8,8 @@ from ..storage import Storage
 from ..storage.columnar import ColumnarEngine
 from ..infoschema import InfoSchemaCache
 from ..copr import CoprExecutor
+from ..dxf import TaskManager
+from ..dxf.framework import Timer
 from ..utils.memory import Tracker
 
 
@@ -44,6 +46,8 @@ class Domain:
         self.global_vars: dict[str, object] = {}
         self.user_vars: dict[str, object] = {}
         self.mem_root = Tracker("global")
+        self.dxf = TaskManager(total_slots=8)
+        self.timer = Timer()
         self.stats = {}        # table_id -> stats (module stats/, ANALYZE)
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}

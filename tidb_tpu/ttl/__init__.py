@@ -1,0 +1,3 @@
+from .worker import run_ttl_once, start_ttl_worker
+
+__all__ = ["run_ttl_once", "start_ttl_worker"]

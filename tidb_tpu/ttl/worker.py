@@ -1,0 +1,50 @@
+"""Row TTL (reference pkg/ttl/ttlworker/job_manager.go — scan + delete
+expired rows via internal SQL, paced by the timer framework; jobs run as
+DXF subtasks here)."""
+from __future__ import annotations
+
+import time
+
+_UNIT_SQL = {"second": "second", "minute": "minute", "hour": "hour",
+             "day": "day", "week": "week", "month": "month", "year": "year"}
+
+
+def _ttl_tables(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        if db.name.lower() in ("mysql", "information_schema"):
+            continue
+        for t in ischema.tables_in_schema(db.name):
+            if t.ttl and t.ttl.get("enable"):
+                yield db.name, t
+
+
+def run_ttl_once(domain) -> int:
+    """Scan all TTL tables, delete expired rows. Returns rows deleted."""
+    from ..session import Session
+    total = 0
+    jobs = list(_ttl_tables(domain))
+    if not jobs:
+        return 0
+
+    def one(db_name, t):
+        def fn(cancel):
+            sess = Session(domain)
+            sess.vars.current_db = db_name
+            unit = _UNIT_SQL.get(t.ttl["unit"], "day")
+            sql = (f"delete from `{db_name}`.`{t.name}` where "
+                   f"`{t.ttl['col']}` < now() - interval "
+                   f"{int(t.ttl['value'])} {unit}")
+            rs = sess.execute(sql)
+            return rs.affected
+        return fn
+    task = domain.dxf.submit("ttl", [one(db, t) for db, t in jobs],
+                             concurrency=2)
+    domain.dxf.wait(task, timeout=60)
+    total = sum(r or 0 for r in task.results())
+    domain.inc_metric("ttl_deleted_rows", total)
+    return total
+
+
+def start_ttl_worker(domain, interval_s: float = 600.0):
+    domain.timer.register("ttl", interval_s, lambda: run_ttl_once(domain))
