@@ -497,3 +497,46 @@ class TestWindow:
             "select g, sum(v), rank() over (order by sum(v) desc) "
             "from w where g is not null group by g order by g").check([
                 ("a", "50", 1), ("b", "20", 2)])
+
+
+class TestPlanCache:
+    def test_cache_hit_and_invalidation(self, ftk):
+        ftk.must_exec("create table pc (a int, b int)")
+        ftk.must_exec("insert into pc values (1,2),(3,4)")
+        q = "select a from pc where b > 1 order by a"
+        ftk.must_query(q).check([(1,), (3,)])
+        before = ftk.domain.metrics.get("plan_cache_hit", 0)
+        ftk.must_query(q).check([(1,), (3,)])
+        assert ftk.domain.metrics.get("plan_cache_hit", 0) == before + 1
+        # data changes flow through the cached plan
+        ftk.must_exec("insert into pc values (5,6)")
+        ftk.must_query(q).check([(1,), (3,), (5,)])
+        # DDL bumps schema version -> cached plan invalidated, still correct
+        ftk.must_exec("alter table pc add column c int default 9")
+        ftk.must_query(q).check([(1,), (3,), (5,)])
+
+    def test_uncacheable_subquery_plans(self, ftk):
+        ftk.must_exec("create table pcs (a int)")
+        ftk.must_exec("insert into pcs values (1)")
+        q = "select a from pcs where a = (select max(a) from pcs)"
+        ftk.must_query(q).check([(1,)])
+        ftk.must_exec("insert into pcs values (5)")
+        # plan embeds the subquery result; must NOT be cached
+        ftk.must_query(q).check([(5,)])
+
+
+class TestStatsPlanner:
+    def test_analyze_changes_estimates(self, ftk):
+        ftk.must_exec("create table st (a int, b int)")
+        ftk.must_exec("insert into st values " + ",".join(
+            f"({i % 10}, {i})" for i in range(200)))
+        ftk.must_exec("analyze table st")
+
+        def reader_est(r):
+            return float(next(row[1] for row in r.rows
+                              if "TableReader" in row[0]))
+        r = ftk.must_query("explain select * from st where a = 5")
+        # ndv(a)=10 over 200 rows -> ~20 estimated, not the pseudo 25%
+        assert 10 <= reader_est(r) <= 40
+        r = ftk.must_query("explain select * from st where b < 50")
+        assert 30 <= reader_est(r) <= 70   # ~25% via min-max interpolation

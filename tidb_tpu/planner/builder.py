@@ -116,6 +116,8 @@ class PlanBuilder:
                                 hidden=True))
         ds = DataSource(tbl, db, alias, schema, handle_col)
         ds.stats_rows = max(float(self.pctx.table_rows(db, tbl)), 1.0)
+        ds.tbl_stats = self.pctx.table_stats(tbl.id)
+        ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
         return ds
 
     def build_from(self, node) -> LogicalPlan:

@@ -15,17 +15,21 @@ class PlanContext:
 
     def __init__(self, infoschema, sess_vars, current_db="",
                  run_subquery=None, table_rows=None, user_vars=None,
-                 now_micros=0, conn_id=1, params=None):
+                 now_micros=0, conn_id=1, params=None, table_stats=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
         self._run_subquery = run_subquery
         self._table_rows = table_rows
+        self._table_stats = table_stats
         self.user_vars = user_vars or {}
         self.now_micros = now_micros
         self.conn_id = conn_id
         self.params = params
         self._ids = itertools.count(1)
+        # False once plan building consumed statement-time state (subquery
+        # results, now()); such plans must not be cached
+        self.cacheable = True
 
     def alloc_id(self) -> int:
         return next(self._ids)
@@ -38,6 +42,7 @@ class PlanContext:
             return 4
 
     def run_subquery(self, select_stmt, limit_one=False):
+        self.cacheable = False
         if self._run_subquery is None:
             from ..errors import UnsupportedError
             raise UnsupportedError("subqueries not available in this context")
@@ -47,6 +52,11 @@ class PlanContext:
         if self._table_rows is None:
             return 1000.0
         return self._table_rows(db, tbl)
+
+    def table_stats(self, table_id):
+        if self._table_stats is None:
+            return None
+        return self._table_stats(table_id)
 
 
 def optimize(stmt, pctx: PlanContext):

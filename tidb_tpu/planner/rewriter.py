@@ -346,9 +346,11 @@ class Rewriter:
         name = node.name
         # statement-time constants
         if name in ("now", "current_timestamp", "sysdate"):
+            self.pctx.cacheable = False
             return Constant(value=Datum(Kind.DATETIME, self.pctx.now_micros),
                             ft=new_datetime_type())
         if name in ("curdate", "current_date"):
+            self.pctx.cacheable = False
             return Constant(value=Datum(Kind.DATE,
                                         self.pctx.now_micros // 86_400_000_000),
                             ft=new_date_type())

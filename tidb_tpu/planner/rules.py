@@ -12,10 +12,150 @@ from .builder import ProjShell
 
 def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
     plan = push_down_predicates(plan, [])
+    plan = reorder_joins(plan)
     used = {sc.col.idx for sc in plan.schema.cols}
     prune_columns(plan, used)
     plan = build_topn(plan)
     return plan
+
+
+# ---------------- join reordering (greedy) ----------------
+
+def reorder_joins(plan: LogicalPlan) -> LogicalPlan:
+    """Greedy reorder of maximal inner-join regions by estimated rows
+    (reference planner/core/rule_join_reorder.go greedy solver). Outer/
+    semi/anti joins are barriers; their children reorder independently."""
+    if isinstance(plan, LJoin) and plan.join_type == "inner":
+        rels, eqs, others = [], [], []
+        _flatten_inner(plan, rels, eqs, others)
+        rels = [reorder_joins(r) for r in rels]
+        if len(rels) > 2:
+            return _greedy_build(rels, eqs, others)
+        # two relations: nothing to reorder; rebuild with recursed children
+        plan.children = rels
+        return plan
+    plan.children = [reorder_joins(c) for c in plan.children]
+    return plan
+
+
+def _flatten_inner(plan: LJoin, rels, eqs, others):
+    for child in plan.children:
+        if isinstance(child, LJoin) and child.join_type == "inner":
+            _flatten_inner(child, rels, eqs, others)
+        else:
+            rels.append(child)
+    eqs.extend(plan.eq_conds)
+    others.extend(plan.other_conds)
+
+
+def _greedy_build(rels, eqs, others):
+    id_of = {}
+    for i, r in enumerate(rels):
+        for sc in r.schema.cols:
+            id_of[sc.col.idx] = i
+
+    def rel_of(expr):
+        s = _cols_of(expr)
+        owners = {id_of.get(i, -1) for i in s}
+        return owners
+
+    remaining = set(range(len(rels)))
+    start = min(remaining, key=lambda i: rels[i].stats_rows)
+    joined_set = {start}
+    remaining.discard(start)
+    current = rels[start]
+    pending_eqs = list(eqs)
+    pending_others = list(others)
+    while remaining:
+        # candidates connected by an eq cond to the joined set
+        best = None
+        for i in remaining:
+            connected = False
+            for a, b in pending_eqs:
+                oa, ob = rel_of(a), rel_of(b)
+                side_sets = oa | ob
+                if i in side_sets and side_sets - {i} <= joined_set:
+                    connected = True
+                    break
+            score = (0 if connected else 1, rels[i].stats_rows)
+            if best is None or score < best[0]:
+                best = (score, i, connected)
+        _, nxt, connected = best
+        right = rels[nxt]
+        schema = Schema_(list(current.schema.cols) + list(right.schema.cols))
+        join = LJoin("inner", current, right, schema)
+        joined_set.add(nxt)
+        remaining.discard(nxt)
+        cur_ids = {sc.col.idx for sc in schema.cols}
+        still_eq = []
+        for a, b in pending_eqs:
+            ca, cb = _cols_of(a), _cols_of(b)
+            if ca | cb <= cur_ids:
+                left_ids = {sc.col.idx for sc in current.schema.cols}
+                if ca <= left_ids:
+                    join.eq_conds.append((a, b))
+                else:
+                    join.eq_conds.append((b, a))
+            else:
+                still_eq.append((a, b))
+        pending_eqs = still_eq
+        still_others = []
+        for c in pending_others:
+            if _cols_of(c) <= cur_ids:
+                join.other_conds.append(c)
+            else:
+                still_others.append(c)
+        pending_others = still_others
+        if join.eq_conds:
+            join.stats_rows = max(current.stats_rows, right.stats_rows)
+        else:
+            join.stats_rows = current.stats_rows * right.stats_rows
+        current = join
+    # any unplaced conds (shouldn't happen) wrap a selection
+    from ..types.field_type import new_bigint_type
+    leftovers = [ScalarFunc("=", [a, b], new_bigint_type())
+                 for a, b in pending_eqs] + pending_others
+    return _wrap_sel(current, leftovers)
+
+
+from .schema import Schema as Schema_  # noqa: E402
+
+
+# ---------------- selectivity (ANALYZE-driven when available) ----------
+
+def _cond_selectivity(ds, cond) -> float:
+    """Per-conjunct selectivity using column stats (reference
+    planner/cardinality — NDV for equality, histogram/min-max interpolation
+    for ranges; pseudo selectivities otherwise)."""
+    stats = getattr(ds, "tbl_stats", None)
+    if isinstance(cond, ScalarFunc) and len(cond.args) == 2:
+        col, const = cond.args
+        op = cond.op
+        if isinstance(const, Column) and isinstance(col, Constant):
+            col, const = const, col
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if isinstance(col, Column) and isinstance(const, Constant) and \
+                stats is not None:
+            name = getattr(ds, "col_name_of", {}).get(col.idx)
+            cs = stats.columns.get(name) if name else None
+            if cs is not None and stats.row_count > 0:
+                if op == "=":
+                    return max(1.0 / max(cs.ndv, 1), 1.0 / stats.row_count)
+                if op in ("<", "<=", ">", ">=") and cs.min_val is not None \
+                        and not const.value.is_null:
+                    try:
+                        v = float(const.value.val)
+                        lo, hi = float(cs.min_val), float(cs.max_val)
+                        if hi > lo:
+                            frac = min(max((v - lo) / (hi - lo), 0.0), 1.0)
+                            return frac if op in ("<", "<=") else 1.0 - frac
+                    except (TypeError, ValueError):
+                        pass
+    if isinstance(cond, ScalarFunc) and cond.op == "=":
+        return 0.1
+    if isinstance(cond, ScalarFunc) and cond.op == "in":
+        return min(0.1 * max(len(cond.args) - 1, 1), 1.0)
+    return 0.25
 
 
 # ---------------- predicate pushdown ----------------
@@ -43,7 +183,10 @@ def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
     if isinstance(plan, DataSource):
         plan.pushed_conds.extend(conds)
         if conds:
-            plan.stats_rows = max(plan.stats_rows * (0.25 ** min(len(conds), 3)), 1.0)
+            sel = 1.0
+            for c in conds:
+                sel *= _cond_selectivity(plan, c)
+            plan.stats_rows = max(plan.stats_rows * max(sel, 1e-6), 1.0)
         return plan
     if isinstance(plan, ProjShell):
         plan.children[0] = push_down_predicates(plan.child, conds)

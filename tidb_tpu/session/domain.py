@@ -52,6 +52,9 @@ class Domain:
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}
         self.metrics: dict = {}   # counter name -> value (prometheus analog)
+        self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
+        self.plan_cache_order: list = []
+        self.plan_cache_cap = 256
 
     def inc_metric(self, name: str, v=1):
         self.metrics[name] = self.metrics.get(name, 0) + v

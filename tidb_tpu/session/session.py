@@ -85,11 +85,15 @@ class Session:
     def execute(self, sql: str, params=None) -> ResultSet:
         stmts = parse(sql)
         result = ResultSet()
+        cache_key_ok = len(stmts) == 1   # multi-stmt text can't key the cache
         for stmt in stmts:
-            result = self._execute_stmt(stmt, params, sql)
+            result = self._execute_stmt(stmt, params, sql,
+                                        cacheable=cache_key_ok)
         return result
 
-    def _execute_stmt(self, stmt, params=None, sql="") -> ResultSet:
+    def _execute_stmt(self, stmt, params=None, sql="",
+                      cacheable=True) -> ResultSet:
+        self._cur_sql = sql if cacheable else ""
         start = time.time()
         try:
             rs = self._dispatch(stmt, params)
@@ -135,6 +139,7 @@ class Session:
             now_micros=int(time.time() * 1_000_000),
             conn_id=self.conn_id,
             params=params,
+            table_stats=lambda tid: self.domain.stats.get(tid),
         )
 
     def _run_subquery(self, select_stmt, limit_one=False):
@@ -159,7 +164,7 @@ class Session:
     # ---- dispatch -------------------------------------------------------
     def _dispatch(self, stmt, params=None) -> ResultSet:
         if isinstance(stmt, ast.SelectStmt):
-            return self._exec_select(stmt, params)
+            return self._exec_select(stmt, params, sql_key=self._cur_sql)
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt)):
             return self._exec_dml(stmt, params)
         if isinstance(stmt, ast.ExplainStmt):
@@ -222,8 +227,31 @@ class Session:
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
 
-    def _exec_select(self, stmt, params=None) -> ResultSet:
-        plan = optimize(stmt, self._plan_ctx(params))
+    def _plan_cache_key(self, sql_key):
+        return (sql_key, self.vars.current_db,
+                self.domain.infoschema().version, self.vars.tpu_exec)
+
+    def _exec_select(self, stmt, params=None, sql_key=None) -> ResultSet:
+        """sql_key: full statement text for the instance plan cache
+        (reference plan_cache.go:205 — here keyed by exact text since
+        constants fold into the plan)."""
+        plan = None
+        ck = None
+        dom = self.domain
+        if sql_key and params is None:
+            ck = self._plan_cache_key(sql_key)
+            plan = dom.plan_cache.get(ck)
+            if plan is not None:
+                dom.inc_metric("plan_cache_hit")
+        if plan is None:
+            pctx = self._plan_ctx(params)
+            plan = optimize(stmt, pctx)
+            if ck is not None and pctx.cacheable:
+                dom.plan_cache[ck] = plan
+                dom.plan_cache_order.append(ck)
+                while len(dom.plan_cache_order) > dom.plan_cache_cap:
+                    old = dom.plan_cache_order.pop(0)
+                    dom.plan_cache.pop(old, None)
         ectx = ExecContext(self)
         ex = build_executor(ectx, plan)
         ex.open()
