@@ -434,6 +434,34 @@ class DescTableStmt(StmtNode):
 
 
 @dataclass
+class UserSpec(Node):
+    user: str = ""
+    host: str = "%"
+    password: str = ""
+
+
+@dataclass
+class CreateUserStmt(StmtNode):
+    users: list = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt(StmtNode):
+    users: list = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt(StmtNode):
+    privs: list = field(default_factory=list)
+    db: str = ""               # "" = *
+    table: str = ""            # "" = *
+    users: list = field(default_factory=list)
+    is_revoke: bool = False
+
+
+@dataclass
 class BRStmt(StmtNode):
     """BACKUP/RESTORE DATABASE db TO/FROM 'path' (reference br/ + BRIE SQL,
     pkg/executor/brie.go)."""

@@ -156,6 +156,8 @@ class Parser:
             return ast.AnalyzeTableStmt(tables=tables)
         if kw == "import":
             return self.parse_import()
+        if kw in ("grant", "revoke"):
+            return self.parse_grant(kw == "revoke")
         if kw in ("backup", "restore"):
             self.next()
             stmt = ast.BRStmt(kind=kw)
@@ -476,8 +478,77 @@ class Parser:
         return stmt
 
     # ---- DDL ----------------------------------------------------------
+    def parse_user_spec(self):
+        t = self.peek()
+        if t.kind in ("STRING", "IDENT", "QIDENT"):
+            self.next()
+            user = t.text
+        else:
+            self.error("expected user name")
+        host = "%"
+        if self.accept_op("@"):
+            host = self.next().text
+        t = self.peek()
+        # the lexer produces USERVAR tokens for @host / @'host'
+        if t.kind == "USERVAR":
+            self.next()
+            host = t.text if t.text else self.next().text
+        elif self.accept_op("@"):
+            host = self.next().text
+        spec = ast.UserSpec(user=user, host=host)
+        if self.accept_kw("identified"):
+            self.expect_kw("by")
+            spec.password = self.next().text
+        return spec
+
+    def parse_grant(self, is_revoke):
+        self.next()
+        stmt = ast.GrantStmt(is_revoke=is_revoke)
+        while True:
+            name = self.next().text.lower()
+            if name == "all":
+                self.accept_kw("privileges")
+                stmt.privs.append("all")
+            elif name == "create" and self.at_kw("user"):
+                self.next()
+                stmt.privs.append("create_user")
+            else:
+                stmt.privs.append(name)
+            if not self.accept_op(","):
+                break
+        self.expect_kw("on")
+        if self.accept_op("*"):
+            if self.accept_op("."):
+                self.expect_op("*")
+        else:
+            a = self.ident()
+            if self.accept_op("."):
+                stmt.db = a
+                if self.accept_op("*"):
+                    pass
+                else:
+                    stmt.table = self.ident()
+            else:
+                stmt.table = a
+        self.expect_kw("from") if is_revoke else self.expect_kw("to")
+        stmt.users.append(self.parse_user_spec())
+        while self.accept_op(","):
+            stmt.users.append(self.parse_user_spec())
+        return stmt
+
     def parse_create(self):
         self.expect_kw("create")
+        if self.accept_kw("user"):
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            stmt = ast.CreateUserStmt(if_not_exists=ine)
+            stmt.users.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.users.append(self.parse_user_spec())
+            return stmt
         if self.accept_kw("database") or self.accept_kw("schema"):
             ine = False
             if self.accept_kw("if"):
@@ -667,6 +738,16 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("user"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            stmt = ast.DropUserStmt(if_exists=ie)
+            stmt.users.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.users.append(self.parse_user_spec())
+            return stmt
         if self.accept_kw("database") or self.accept_kw("schema"):
             ie = False
             if self.accept_kw("if"):

@@ -105,6 +105,9 @@ class PlanBuilder:
     def build_datasource(self, tn: ast.TableName) -> DataSource:
         db = self._resolve_db(tn.db)
         tbl = self.pctx.infoschema.table_by_name(db, tn.name)
+        self.pctx.read_tables.add((db, tbl.name))
+        if self.pctx.check_read is not None:
+            self.pctx.check_read(db, tbl.name)
         alias = tn.alias or tn.name
         schema = Schema()
         for ci in tbl.public_columns():

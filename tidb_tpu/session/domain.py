@@ -52,6 +52,8 @@ class Domain:
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}
         self.metrics: dict = {}   # counter name -> value (prometheus analog)
+        from ..privilege import PrivManager
+        self.priv = PrivManager(self)
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256

@@ -46,6 +46,8 @@ class Session:
         Session._next_conn_id[0] += 1
         self.conn_id = Session._next_conn_id[0]
         self.ddl = DDLExecutor(self)
+        self.user = "root"
+        self.host = "%"
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -140,7 +142,16 @@ class Session:
             conn_id=self.conn_id,
             params=params,
             table_stats=lambda tid: self.domain.stats.get(tid),
+            check_read=self._check_read,
         )
+
+    def check_priv(self, priv, db="", tbl=""):
+        self.domain.priv.check(self.user, self.host, priv, db, tbl)
+
+    def _check_read(self, db, tbl):
+        if db.lower() == "information_schema":
+            return
+        self.check_priv("select", db, tbl)
 
     def _run_subquery(self, select_stmt, limit_one=False):
         plan = optimize(select_stmt, self._plan_ctx())
@@ -199,6 +210,28 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.CreateUserStmt):
+            self.check_priv("create_user")
+            for u in stmt.users:
+                self.domain.priv.create_user(u.user, u.host, u.password,
+                                             stmt.if_not_exists)
+            return ResultSet()
+        if isinstance(stmt, ast.DropUserStmt):
+            self.check_priv("create_user")
+            for u in stmt.users:
+                self.domain.priv.drop_user(u.user, u.host, stmt.if_exists)
+            return ResultSet()
+        if isinstance(stmt, ast.GrantStmt):
+            self.check_priv("grant")
+            db = stmt.db or (self.vars.current_db if stmt.table else "")
+            for u in stmt.users:
+                if stmt.is_revoke:
+                    self.domain.priv.revoke(stmt.privs, db, stmt.table,
+                                            u.user, u.host)
+                else:
+                    self.domain.priv.grant(stmt.privs, db, stmt.table,
+                                           u.user, u.host)
+            return ResultSet()
         if isinstance(stmt, ast.BRStmt):
             from ..tools import br
             self.commit()
@@ -243,6 +276,8 @@ class Session:
             plan = dom.plan_cache.get(ck)
             if plan is not None:
                 dom.inc_metric("plan_cache_hit")
+                for rdb, rtbl in getattr(plan, "read_tables", ()):
+                    self._check_read(rdb, rtbl)
         if plan is None:
             pctx = self._plan_ctx(params)
             plan = optimize(stmt, pctx)
@@ -274,10 +309,13 @@ class Session:
         txn = self.txn()   # ensure txn exists before write
         try:
             if isinstance(plan, InsertPlan):
+                self.check_priv("insert", plan.db_name, plan.table_info.name)
                 affected = InsertExec(ectx, plan, self).execute()
             elif isinstance(plan, UpdatePlan):
+                self.check_priv("update", plan.db_name, plan.table_info.name)
                 affected = UpdateExec(ectx, plan, self).execute()
             elif isinstance(plan, DeletePlan):
+                self.check_priv("delete", plan.db_name, plan.table_info.name)
                 affected = DeleteExec(ectx, plan, self).execute()
             else:
                 raise UnsupportedError("bad DML plan")
@@ -382,6 +420,12 @@ def bootstrap(domain: Domain) -> None:
           variable_name VARCHAR(64) NOT NULL PRIMARY KEY,
           variable_value VARCHAR(1024),
           comment VARCHAR(1024))""")
+    sess.execute("""
+        CREATE TABLE user (
+          host VARCHAR(255) NOT NULL,
+          user VARCHAR(32) NOT NULL,
+          authentication_string VARCHAR(256),
+          KEY idx_user (user))""")
     sess.execute("""
         CREATE TABLE global_variables (
           variable_name VARCHAR(64) NOT NULL PRIMARY KEY,
