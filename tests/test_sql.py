@@ -540,3 +540,41 @@ class TestStatsPlanner:
         assert 10 <= reader_est(r) <= 40
         r = ftk.must_query("explain select * from st where b < 50")
         assert 30 <= reader_est(r) <= 70   # ~25% via min-max interpolation
+
+
+class TestPreparedAndGC:
+    def test_prepare_execute(self, ftk):
+        ftk.must_exec("create table pe (a int, b varchar(8))")
+        ftk.must_exec("insert into pe values (1,'x'),(2,'y'),(3,'z')")
+        ftk.must_exec("prepare s1 from 'select b from pe where a > ? order by a limit ?'")
+        ftk.must_exec("set @lo = 1")
+        ftk.must_exec("set @n = 1")
+        ftk.must_query("execute s1 using @lo, @n").check([("y",)])
+        ftk.must_exec("set @lo = 0")
+        ftk.must_exec("set @n = 3")
+        ftk.must_query("execute s1 using @lo, @n").check([("x",), ("y",), ("z",)])
+        ftk.must_exec("deallocate prepare s1")
+        e = ftk.exec_err("execute s1 using @lo, @n")
+
+    def test_api_params(self, ftk):
+        ftk.must_exec("create table pp (a int)")
+        ftk.must_exec("insert into pp values (1),(2),(3)")
+        r = ftk.must_query("select a from pp where a >= ? and a < ?",
+                           params=[2, 3])
+        r.check([(2,)])
+
+    def test_gc_compaction(self, ftk):
+        ftk.must_exec("create table gc1 (a int)")
+        ftk.must_exec("insert into gc1 values (1),(2),(3)")
+        ftk.must_exec("update gc1 set a = a + 10 where a <= 2")
+        ftk.must_exec("delete from gc1 where a = 3")
+        tbl = ftk.domain.infoschema().table_by_name("test", "gc1")
+        ctab = ftk.domain.columnar.tables[tbl.id]
+        assert ctab.n > ctab.live_count()     # old versions retained
+        compacted = ftk.domain.run_gc()
+        assert compacted >= 3                  # 2 old versions + 1 delete
+        assert ctab.n == ctab.live_count() == 2
+        ftk.must_query("select a from gc1 order by a").check([(11,), (12,)])
+        # table remains fully usable post-GC
+        ftk.must_exec("insert into gc1 values (99)")
+        ftk.must_query("select count(*) from gc1").check([(3,)])

@@ -434,6 +434,23 @@ class DescTableStmt(StmtNode):
 
 
 @dataclass
+class PrepareStmt(StmtNode):
+    name: str = ""
+    sql_text: str = ""
+
+
+@dataclass
+class ExecuteStmt(StmtNode):
+    name: str = ""
+    using: list = field(default_factory=list)   # user variable names
+
+
+@dataclass
+class DeallocateStmt(StmtNode):
+    name: str = ""
+
+
+@dataclass
 class UserSpec(Node):
     user: str = ""
     host: str = "%"

@@ -32,6 +32,7 @@ class Parser:
         self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
+        self.n_params = 0
 
     # ---- token helpers ------------------------------------------------
     def peek(self, off=0) -> Token:
@@ -156,6 +157,25 @@ class Parser:
             return ast.AnalyzeTableStmt(tables=tables)
         if kw == "import":
             return self.parse_import()
+        if kw == "prepare":
+            self.next()
+            name = self.ident()
+            self.expect_kw("from")
+            return ast.PrepareStmt(name=name, sql_text=self.next().text)
+        if kw == "execute":
+            self.next()
+            stmt = ast.ExecuteStmt(name=self.ident())
+            if self.accept_kw("using"):
+                while True:
+                    t = self.next()
+                    stmt.using.append(t.text)
+                    if not self.accept_op(","):
+                        break
+            return stmt
+        if kw == "deallocate":
+            self.next()
+            self.expect_kw("prepare")
+            return ast.DeallocateStmt(name=self.ident())
         if kw in ("grant", "revoke"):
             return self.parse_grant(kw == "revoke")
         if kw in ("backup", "restore"):
@@ -1184,7 +1204,9 @@ class Parser:
                 return ast.Wildcard()
             if t.text == "?":
                 self.next()
-                return ast.ParamMarker()
+                m = ast.ParamMarker(index=self.n_params)
+                self.n_params += 1
+                return m
         if t.kind in ("IDENT", "QIDENT"):
             low = t.text.lower()
             nxt = self.peek(1)

@@ -417,8 +417,8 @@ class PlanBuilder:
             result.stats_rows = result.child.stats_rows
 
         if stmt.limit is not None:
-            offset = _limit_value(stmt.limit.offset, 0)
-            count = _limit_value(stmt.limit.count, -1)
+            offset = _limit_value(stmt.limit.offset, 0, self.pctx)
+            count = _limit_value(stmt.limit.count, -1, self.pctx)
             result = LimitOp(offset, count, result)
             result.stats_rows = min(result.child.stats_rows,
                                     float(count if count >= 0 else 1e18))
@@ -691,8 +691,9 @@ class PlanBuilder:
             if items:
                 result = Sort(items, result)
             if stmt.limit is not None:
-                result = LimitOp(_limit_value(stmt.limit.offset, 0),
-                                 _limit_value(stmt.limit.count, -1), result)
+                result = LimitOp(_limit_value(stmt.limit.offset, 0, self.pctx),
+                                 _limit_value(stmt.limit.count, -1, self.pctx),
+                                 result)
         return result
 
     # ---- DML ----------------------------------------------------------
@@ -755,8 +756,8 @@ class PlanBuilder:
             items = [(rw.rewrite(i.expr), i.desc) for i in order_by]
             p = Sort(items, p)
         if limit is not None:
-            p = LimitOp(_limit_value(limit.offset, 0),
-                        _limit_value(limit.count, -1), p)
+            p = LimitOp(_limit_value(limit.offset, 0, self.pctx),
+                        _limit_value(limit.count, -1, self.pctx), p)
         return ds, p
 
     def build_update(self, stmt: ast.UpdateStmt) -> UpdatePlan:
@@ -809,11 +810,15 @@ def _auto_name(f: ast.SelectField) -> str:
     return f.text or "expr"
 
 
-def _limit_value(e, default):
+def _limit_value(e, default, pctx=None):
     if e is None:
         return default
     if isinstance(e, ast.Literal) and isinstance(e.value, int):
         return e.value
+    if isinstance(e, ast.ParamMarker) and pctx is not None and \
+            pctx.params is not None and e.index < len(pctx.params):
+        pctx.cacheable = False
+        return int(pctx.params[e.index])
     raise UnsupportedError("non-constant LIMIT")
 
 

@@ -58,6 +58,16 @@ class Domain:
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256
 
+    def run_gc(self, safepoint=None) -> int:
+        """MVCC GC across columnar tables (safepoint default: now)."""
+        if safepoint is None:
+            safepoint = self.storage.current_ts()
+        total = 0
+        for ctab in self.columnar.tables.values():
+            total += ctab.gc(safepoint)
+        self.inc_metric("gc_compacted_rows", total)
+        return total
+
     def inc_metric(self, name: str, v=1):
         self.metrics[name] = self.metrics.get(name, 0) + v
 

@@ -48,6 +48,7 @@ class Session:
         self.ddl = DDLExecutor(self)
         self.user = "root"
         self.host = "%"
+        self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -210,6 +211,24 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.PrepareStmt):
+            inner = parse(stmt.sql_text)
+            if len(inner) != 1:
+                raise UnsupportedError("PREPARE expects one statement")
+            self.prepared[stmt.name.lower()] = (inner[0], stmt.sql_text)
+            return ResultSet()
+        if isinstance(stmt, ast.ExecuteStmt):
+            entry = self.prepared.get(stmt.name.lower())
+            if entry is None:
+                raise UnsupportedError("Unknown prepared statement handler %s",
+                                       stmt.name)
+            inner, _text = entry
+            exec_params = [self.domain.user_vars.get(v.lower())
+                           for v in stmt.using]
+            return self._dispatch(inner, exec_params or None)
+        if isinstance(stmt, ast.DeallocateStmt):
+            self.prepared.pop(stmt.name.lower(), None)
+            return ResultSet()
         if isinstance(stmt, ast.CreateUserStmt):
             self.check_priv("create_user")
             for u in stmt.users:

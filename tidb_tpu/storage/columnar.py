@@ -173,6 +173,32 @@ class ColumnarTable:
         self.n += n
         self.version += 1
 
+    def gc(self, safepoint: int) -> int:
+        """Compact away versions deleted before `safepoint` (reference: TiKV
+        GC under gc_life_time). Rebuilds arrays densely; dictionaries keep
+        their codes."""
+        dead = (self.delete_ts[:self.n] != 0) & \
+               (self.delete_ts[:self.n] < safepoint)
+        ndead = int(dead.sum())
+        if ndead == 0:
+            return 0
+        keep = ~dead
+        idx = np.nonzero(keep)[0]
+        m = len(idx)
+        for cid in list(self.data):
+            self.data[cid][:m] = self.data[cid][idx]
+            self.nulls[cid][:m] = self.nulls[cid][idx]
+        self.handles[:m] = self.handles[idx]
+        self.insert_ts[:m] = self.insert_ts[idx]
+        self.delete_ts[:m] = self.delete_ts[idx]
+        self.n = m
+        self.handle_pos = {}
+        live = self.delete_ts[:m] == 0
+        for i in np.nonzero(live)[0].tolist():
+            self.handle_pos[int(self.handles[i])] = i
+        self.version += 1
+        return ndead
+
     # ---- reads --------------------------------------------------------
     def live_count(self) -> int:
         return int((self.delete_ts[:self.n] == 0).sum())
