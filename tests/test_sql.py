@@ -578,3 +578,19 @@ class TestPreparedAndGC:
         # table remains fully usable post-GC
         ftk.must_exec("insert into gc1 values (99)")
         ftk.must_query("select count(*) from gc1").check([(3,)])
+
+
+class TestSpill:
+    def test_sort_spills_and_stays_correct(self, ftk):
+        ftk.must_exec("create table sp (a int, s varchar(16))")
+        rows = ",".join(f"({(i * 7919) % 10007}, 'v{i % 97}')"
+                        for i in range(12000))
+        ftk.must_exec(f"insert into sp values {rows}")
+        expect = ftk.must_query("select a from sp order by a limit 5").rows
+        ftk.must_exec("set @@tidb_mem_quota_query = 131072")  # force spill
+        got_rs = ftk.must_query("select a, s from sp order by a, s")
+        vals = [r[0] for r in got_rs.rows]
+        assert vals == sorted(vals)
+        assert len(vals) == 12000
+        assert ftk.domain.metrics.get("sort_spill_count", 0) >= 1
+        assert [ (v,) for v in vals[:5] ] == expect

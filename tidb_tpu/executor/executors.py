@@ -211,24 +211,95 @@ def _sort_key_arrays(schema, chunk, items):
 
 
 class SortExec(Executor):
+    """Sort with spill: when accumulated input exceeds the memory quota,
+    chunk payloads spill to disk (reference sortexec/sort_spill.go under the
+    memory.Tracker action chain). Final ordering is computed over the sort
+    KEY arrays only; payload rows stream back from disk per source chunk
+    in sorted order (columnar external sort)."""
+
     def __init__(self, ctx, plan, child):
         super().__init__(ctx, plan.schema, [child])
         self.items = plan.items
         self._out = None
+        self.spilled = False
 
     def next(self):
         if self._out is None:
-            chunks = self.child.all_chunks()
-            merged = Chunk.concat_all(chunks)
-            if merged is None:
-                self._out = []
-            else:
-                keys = _sort_key_arrays(self.child.schema, merged, self.items)
-                order = np.lexsort(list(reversed(keys)))
-                self._out = [merged.take(order)]
+            self._fill()
         if not self._out:
             return None
         return self._out.pop(0)
+
+    def _fill(self):
+        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
+        in_mem = []
+        spool = None
+        key_parts = []          # per chunk: list of key arrays
+        consumed = 0
+        while True:
+            ch = self.child.next()
+            if ch is None:
+                break
+            if len(ch) == 0:
+                continue
+            keys = _sort_key_arrays(self.child.schema, ch, self.items)
+            key_parts.append(keys)
+            nbytes = sum(getattr(c.data, "nbytes", 0) for c in ch.columns)
+            consumed += nbytes
+            if spool is None and consumed > quota:
+                from ..utils.chunk_disk import ChunkSpool
+                spool = ChunkSpool("sort")
+                self.spilled = True
+                self.ctx.sess.domain.inc_metric("sort_spill_count")
+                for prev in in_mem:
+                    spool.append(prev)
+                in_mem = []
+            if spool is not None:
+                spool.append(ch)
+            else:
+                in_mem.append(ch)
+        if not key_parts:
+            self._out = []
+            return
+        if spool is None:
+            merged = Chunk.concat_all(in_mem)
+            keys = [np.concatenate([kp[i] for kp in key_parts])
+                    for i in range(len(self.items))]
+            order = np.lexsort(list(reversed(keys)))
+            self._out = [merged.take(order)]
+            return
+        # external path: global order over in-memory keys; gather payload
+        # from disk chunk by chunk
+        keys = [np.concatenate([kp[i] for kp in key_parts])
+                for i in range(len(self.items))]
+        order = np.lexsort(list(reversed(keys)))
+        chunk_of = np.concatenate(
+            [np.full(n, i, dtype=np.int64)
+             for i, n in enumerate(spool.rows)])
+        row_of = np.concatenate(
+            [np.arange(n, dtype=np.int64) for n in spool.rows])
+        out = []
+        batch = max(1, (1 << 20) // max(len(self.schema.cols), 1) // 8)
+        batch = max(batch, 65536)
+        for s in range(0, len(order), batch):
+            sel = order[s:s + batch]
+            pieces = []
+            src_chunks = chunk_of[sel]
+            src_rows = row_of[sel]
+            # gather from each source chunk, then restore sorted order
+            out_cols = None
+            perm = np.argsort(src_chunks, kind="stable")
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(len(perm))
+            gathered = []
+            for ci in np.unique(src_chunks):
+                mask = src_chunks[perm] == ci
+                rows = src_rows[perm][mask]
+                gathered.append(spool.load(int(ci)).take(rows))
+            part = Chunk.concat_all(gathered)
+            out.append(part.take(inv))
+        spool.close()
+        self._out = out
 
 
 class TopNExec(Executor):

@@ -1,0 +1,77 @@
+"""Chunk spill-to-disk (reference pkg/util/chunk/chunk_in_disk.go +
+sortexec/sort_spill.go — re-designed columnar: array payloads spill as npz
+files; FieldTypes/dictionaries stay in memory; reload re-attaches them)."""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+
+
+class ChunkSpool:
+    """Append-only on-disk chunk store with random chunk access."""
+
+    def __init__(self, label="spool"):
+        self.dir = tempfile.mkdtemp(prefix=f"tidb_tpu_{label}_")
+        self.metas = []      # per chunk: [(ft, dict, has_nulls)]
+        self.rows = []       # row count per chunk
+        self._closed = False
+
+    def append(self, chunk: Chunk) -> int:
+        idx = len(self.metas)
+        arrays = {}
+        meta = []
+        for j, col in enumerate(chunk.columns):
+            data = col.data
+            if data.dtype == object:
+                # spill object strings as codes via a transient dict
+                from ..chunk.device import StringDict
+                d = StringDict()
+                data = d.encode(data)
+                meta.append((col.ft, d, col.nulls is not None))
+            else:
+                meta.append((col.ft, col.dict, col.nulls is not None))
+            arrays[f"d{j}"] = data
+            if col.nulls is not None:
+                arrays[f"n{j}"] = col.nulls
+        np.savez(os.path.join(self.dir, f"c{idx}.npz"), **arrays)
+        self.metas.append(meta)
+        self.rows.append(len(chunk))
+        return idx
+
+    def load(self, idx: int) -> Chunk:
+        z = np.load(os.path.join(self.dir, f"c{idx}.npz"))
+        cols = []
+        for j, (ft, sdict, has_nulls) in enumerate(self.metas[idx]):
+            cols.append(Column(ft, z[f"d{j}"],
+                               z[f"n{j}"] if has_nulls else None, sdict))
+        return Chunk(cols)
+
+    @property
+    def num_chunks(self):
+        return len(self.metas)
+
+    @property
+    def total_rows(self):
+        return sum(self.rows)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for i in range(len(self.metas)):
+            try:
+                os.unlink(os.path.join(self.dir, f"c{i}.npz"))
+            except OSError:
+                pass
+        try:
+            os.rmdir(self.dir)
+        except OSError:
+            pass
+
+    def __del__(self):
+        self.close()
