@@ -1,0 +1,26 @@
+"""Pallas kernels (interpret mode on CPU) vs jnp reference."""
+import numpy as np
+import pytest
+
+from tidb_tpu.ops import masked_sums, pallas_available
+
+
+@pytest.mark.skipif(not pallas_available(), reason="no pallas")
+def test_masked_sums_kernel():
+    rng = np.random.default_rng(5)
+    n = 20000
+    a = rng.integers(0, 1000, n)
+    b = rng.integers(-500, 500, n)
+    mask = rng.random(n) < 0.3
+    sums, count = masked_sums([a, b], mask, interpret=True)
+    assert int(count) == int(mask.sum())
+    assert int(sums[0]) == int(a[mask].sum())
+    assert int(sums[1]) == int(b[mask].sum())
+
+
+@pytest.mark.skipif(not pallas_available(), reason="no pallas")
+def test_masked_sums_empty_mask():
+    n = 8192
+    a = np.arange(n)
+    sums, count = masked_sums([a], np.zeros(n, dtype=bool), interpret=True)
+    assert int(count) == 0 and int(sums[0]) == 0

@@ -1,0 +1,11 @@
+"""Device kernel library.
+
+The engine's hot ops today are expressed in jax.numpy and fused by XLA
+(filter+projection+partial-agg compile into one kernel per copr partition,
+tidb_tpu/copr/dag_exec.py). This package holds hand-written Pallas TPU
+kernels for the paths where explicit VMEM control beats XLA's scheduling;
+they run in interpret mode on CPU for tests.
+"""
+from .pallas_scan import masked_sums, pallas_available
+
+__all__ = ["masked_sums", "pallas_available"]

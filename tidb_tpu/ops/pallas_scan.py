@@ -1,0 +1,89 @@
+"""Pallas TPU kernels for scan-side reductions.
+
+masked_sums: the reduce stage of a filtered scan (Q6 shape — masked sums
+over k value columns + row count) as a single grid-reduction kernel:
+blocks stream HBM -> VMEM once; partial sums accumulate in a VMEM scratch
+across grid steps; one output tile. Avoids materializing per-column masked
+intermediates in HBM.
+
+On CPU (tests) the kernel runs in interpret mode; on TPU it compiles via
+Mosaic. See /opt/skills/guides/pallas_guide.md for the programming model.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils import jaxcfg  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:                      # pragma: no cover
+    _HAS_PALLAS = False
+
+_BLOCK = 8192
+
+
+def pallas_available() -> bool:
+    return _HAS_PALLAS
+
+
+def _kernel(k, data_ref, mask_ref, out_ref):
+    """Grid step: accumulate masked sums of this block into out_ref.
+
+    data_ref: [k, BLOCK] int64 VMEM tile; mask_ref: [1, BLOCK] bool;
+    out_ref: [k+1, 128] accumulator tile (lane-parallel partial sums;
+    column k holds the row count)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    mask = mask_ref[0, :]
+    m_i64 = mask.astype(jnp.int64)
+    # lane-parallel accumulation: reshape block into [BLOCK//128, 128]
+    for j in range(k):
+        vals = jnp.where(mask, data_ref[j, :], 0)
+        out_ref[j, :] += jnp.sum(vals.reshape(-1, 128), axis=0)
+    out_ref[k, :] += jnp.sum(m_i64.reshape(-1, 128), axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _masked_sums_impl(data, mask, interpret):
+    k, n = data.shape
+    grid = n // _BLOCK
+    out = pl.pallas_call(
+        functools.partial(_kernel, k),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((k, _BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, _BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k + 1, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k + 1, 128), jnp.int64),
+        interpret=interpret,
+    )(data, mask[None, :])
+    return jnp.sum(out, axis=1)   # reduce the 128 lanes
+
+
+def masked_sums(columns, mask, interpret: bool | None = None):
+    """sums of `columns` (list of int64 arrays) where mask, plus count.
+
+    Returns (sums: int64[k], count: int64). Pads to the block size; padded
+    rows are masked out."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    k = len(columns)
+    n = len(columns[0])
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    data = jnp.stack([
+        jnp.pad(jnp.asarray(c, dtype=jnp.int64), (0, padded - n))
+        for c in columns])
+    m = jnp.pad(jnp.asarray(mask, dtype=bool), (0, padded - n))
+    out = _masked_sums_impl(data, m, interpret)
+    return out[:k], out[k]
