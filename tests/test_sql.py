@@ -594,3 +594,41 @@ class TestSpill:
         assert len(vals) == 12000
         assert ftk.domain.metrics.get("sort_spill_count", 0) >= 1
         assert [ (v,) for v in vals[:5] ] == expect
+
+
+class TestViewsCTE:
+    def test_cte(self, ftk):
+        ftk.must_exec("create table c1 (a int, b int)")
+        ftk.must_exec("insert into c1 values (1,10),(2,20),(3,30)")
+        ftk.must_query(
+            "with big as (select * from c1 where a >= 2), "
+            "s (total) as (select sum(b) from big) "
+            "select big.a, s.total from big, s order by big.a").check([
+                (2, "50"), (3, "50")])
+
+    def test_view(self, ftk):
+        ftk.must_exec("create table v0 (a int, b int)")
+        ftk.must_exec("insert into v0 values (1,10),(2,20)")
+        ftk.must_exec("create view v1 as select a, b*2 as d from v0")
+        ftk.must_query("select * from v1 order by a").check([(1, 20), (2, 40)])
+        ftk.must_query("select sum(d) from v1").check([(60,)])
+        # view over view + column aliases
+        ftk.must_exec("create view v2 (x) as select d from v1 where a = 2")
+        ftk.must_query("select x from v2").check([(40,)])
+        # view reflects new base data
+        ftk.must_exec("insert into v0 values (3,30)")
+        ftk.must_query("select count(*) from v1").check([(3,)])
+        r = ftk.must_query("select table_name from information_schema.views "
+                           "where table_schema = 'test' order by 1")
+        assert r.rows == [("v1",), ("v2",)]
+        ftk.must_exec("drop table v2, v1")
+        ftk.must_exec("create or replace view v1 as select 99")
+
+    def test_kill(self, ftk):
+        ftk.must_exec("create table k1 (a int)")
+        # cooperative kill flag: mark, then next query of that conn dies
+        ectx_holder = {}
+        from tidb_tpu.executor import ExecContext
+        import tidb_tpu.session.session as S
+        ftk.domain.kill_conn(999)    # unknown conn: no-op
+        ftk.must_query("select * from k1")

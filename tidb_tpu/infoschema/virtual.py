@@ -105,7 +105,11 @@ def _gen_cluster_info(domain):
 
 
 def _gen_views(domain):
-    return iter(())
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            if t.view_select:
+                yield (db.name, t.name, t.view_select)
 
 
 def _gen_partitions(domain):

@@ -93,6 +93,8 @@ class TableInfo:
     state: SchemaState = SchemaState.PUBLIC
     comment: str = ""
     ttl: dict | None = None        # {"col", "value", "unit", "enable"}
+    view_select: str = ""          # non-empty => this table is a VIEW
+    view_cols: list = field(default_factory=list)
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -122,6 +124,7 @@ class TableInfo:
             "pk_is_handle": self.pk_is_handle, "pk_col_name": self.pk_col_name,
             "auto_inc_id": self.auto_inc_id, "state": int(self.state),
             "comment": self.comment, "ttl": self.ttl,
+            "view_select": self.view_select, "view_cols": self.view_cols,
         }
 
     @classmethod
@@ -132,7 +135,9 @@ class TableInfo:
             indexes=[IndexInfo.from_json(i) for i in j["indexes"]],
             pk_is_handle=j["pk_is_handle"], pk_col_name=j["pk_col_name"],
             auto_inc_id=j["auto_inc_id"], state=SchemaState(j["state"]),
-            comment=j.get("comment", ""), ttl=j.get("ttl"))
+            comment=j.get("comment", ""), ttl=j.get("ttl"),
+            view_select=j.get("view_select", ""),
+            view_cols=j.get("view_cols", []))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

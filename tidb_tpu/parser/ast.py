@@ -263,6 +263,8 @@ class SelectStmt(StmtNode):
     for_update: bool = False
     # set operations chain: [('union'|'union all'|'except'|'intersect', SelectStmt)]
     setops: list = field(default_factory=list)
+    # WITH clause: [(name, [col aliases], SelectStmt)]
+    ctes: list = field(default_factory=list)
 
 
 @dataclass
@@ -325,6 +327,14 @@ class CreateTableStmt(StmtNode):
     indexes: list = field(default_factory=list)   # [IndexDef]
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
+
+
+@dataclass
+class CreateViewStmt(StmtNode):
+    view: TableName = None
+    columns: list = field(default_factory=list)
+    select_text: str = ""
+    or_replace: bool = False
 
 
 @dataclass
@@ -476,6 +486,11 @@ class GrantStmt(StmtNode):
     table: str = ""            # "" = *
     users: list = field(default_factory=list)
     is_revoke: bool = False
+
+
+@dataclass
+class KillStmt(StmtNode):
+    conn_id: int = 0
 
 
 @dataclass

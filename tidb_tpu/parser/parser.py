@@ -106,7 +106,9 @@ class Parser:
         if t.kind != "IDENT":
             self.error()
         kw = t.text.lower()
-        if kw in ("select", "with"):
+        if kw == "with":
+            return self.parse_with_select()
+        if kw == "select":
             return self.parse_select()
         if kw == "insert" or kw == "replace":
             return self.parse_insert()
@@ -178,6 +180,10 @@ class Parser:
             return ast.DeallocateStmt(name=self.ident())
         if kw in ("grant", "revoke"):
             return self.parse_grant(kw == "revoke")
+        if kw == "kill":
+            self.next()
+            self.accept_kw("query") or self.accept_kw("connection")
+            return ast.KillStmt(conn_id=int(self.next().text))
         if kw in ("backup", "restore"):
             self.next()
             stmt = ast.BRStmt(kind=kw)
@@ -190,6 +196,30 @@ class Parser:
             stmt.path = self.next().text
             return stmt
         self.error(f"unsupported statement '{kw}'")
+
+    def parse_with_select(self) -> ast.SelectStmt:
+        """WITH name [(cols)] AS (select), ... SELECT ... (non-recursive)."""
+        self.expect_kw("with")
+        self.accept_kw("recursive")   # parsed; recursion itself unsupported
+        ctes = []
+        while True:
+            name = self.ident()
+            cols = []
+            if self.accept_op("("):
+                cols.append(self.ident())
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            ctes.append((name, cols, sub))
+            if not self.accept_op(","):
+                break
+        sel = self.parse_select()
+        sel.ctes = ctes
+        return sel
 
     # ---- SELECT -------------------------------------------------------
     def parse_select(self, allow_setops=True) -> ast.SelectStmt:
@@ -580,6 +610,12 @@ class Parser:
             while self.peek().kind == "IDENT" and not self.at_op(";"):
                 self.next()
             return ast.CreateDatabaseStmt(name=name, if_not_exists=ine)
+        if self.accept_kw("or"):
+            self.expect_kw("replace")
+            self.expect_kw("view")
+            return self._parse_create_view(or_replace=True)
+        if self.accept_kw("view"):
+            return self._parse_create_view(or_replace=False)
         unique = self.accept_kw("unique")
         if self.accept_kw("index") or self.accept_kw("key"):
             name = self.ident()
@@ -655,6 +691,23 @@ class Parser:
                 continue
             t = self.next()
             stmt.options[opt] = t.text
+        return stmt
+
+    def _parse_create_view(self, or_replace):
+        stmt = ast.CreateViewStmt(or_replace=or_replace)
+        stmt.view = self.parse_table_name()
+        if self.accept_op("("):
+            stmt.columns.append(self.ident())
+            while self.accept_op(","):
+                stmt.columns.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("as")
+        start = self.peek().pos
+        if self.at_kw("with"):
+            self.parse_with_select()
+        else:
+            self.parse_select()
+        stmt.select_text = self.sql[start:self.peek().pos].strip()
         return stmt
 
     def _parse_paren_cols(self):

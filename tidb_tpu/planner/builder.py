@@ -85,6 +85,8 @@ class DeletePlan:
 class PlanBuilder:
     def __init__(self, pctx):
         self.pctx = pctx
+        self.ctes: dict = {}        # name -> (cols, SelectStmt)
+        self._view_depth = 0
 
     # ---- helpers ------------------------------------------------------
     def _new_col(self, ft, name="") -> Column:
@@ -101,13 +103,40 @@ class PlanBuilder:
         return Rewriter(self.pctx, schema, agg_mapper,
                         window_mapper=window_mapper)
 
+    def _build_named_subplan(self, select_stmt, alias, col_aliases):
+        """Shared CTE/view expansion: plan the select, rename its outputs."""
+        sub = self.build_select(select_stmt)
+        schema = Schema()
+        vis = sub.schema.visible()
+        if col_aliases and len(col_aliases) != len(vis):
+            raise UnsupportedError(
+                "view/CTE column list length mismatch for %s", alias)
+        for i, sc in enumerate(vis):
+            name = col_aliases[i] if col_aliases else sc.name
+            schema.append(SchemaCol(sc.col, name, alias))
+        return ProjShell(sub, schema)
+
     # ---- FROM ---------------------------------------------------------
     def build_datasource(self, tn: ast.TableName) -> DataSource:
+        if not tn.db and tn.name.lower() in self.ctes:
+            cols, sel = self.ctes[tn.name.lower()]
+            return self._build_named_subplan(sel, tn.alias or tn.name, cols)
         db = self._resolve_db(tn.db)
         tbl = self.pctx.infoschema.table_by_name(db, tn.name)
         self.pctx.read_tables.add((db, tbl.name))
         if self.pctx.check_read is not None:
             self.pctx.check_read(db, tbl.name)
+        if tbl.view_select:
+            self._view_depth += 1
+            if self._view_depth > 16:
+                raise UnsupportedError("view nesting too deep (cycle?)")
+            try:
+                from ..parser import parse_one
+                vsel = parse_one(tbl.view_select)
+                return self._build_named_subplan(
+                    vsel, tn.alias or tn.name, tbl.view_cols)
+            finally:
+                self._view_depth -= 1
         alias = tn.alias or tn.name
         schema = Schema()
         for ci in tbl.public_columns():
@@ -183,6 +212,18 @@ class PlanBuilder:
 
     # ---- SELECT -------------------------------------------------------
     def build_select(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        saved_ctes = None
+        if stmt.ctes:
+            saved_ctes = dict(self.ctes)
+            for name, cols, sub in stmt.ctes:
+                self.ctes[name.lower()] = (cols, sub)
+        try:
+            return self._build_select_inner(stmt)
+        finally:
+            if saved_ctes is not None:
+                self.ctes = saved_ctes
+
+    def _build_select_inner(self, stmt: ast.SelectStmt) -> LogicalPlan:
         if stmt.setops:
             return self.build_setops(stmt)
         p = self.build_from(stmt.from_clause)

@@ -164,6 +164,29 @@ class DDLExecutor:
             return tbl
         self._with_meta(fn)
 
+    def create_view(self, stmt: ast.CreateViewStmt):
+        db_name = stmt.view.db or self.sess.vars.current_db
+        # validate the definition by planning it now
+        from ..parser import parse_one
+        from ..planner import optimize
+        sel = parse_one(stmt.select_text)
+        optimize(sel, self.sess._plan_ctx())
+
+        def fn(m):
+            db = self._db_by_name(m, db_name)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.view.name.lower():
+                    if stmt.or_replace:
+                        m.drop_table(db.id, t.id)
+                        break
+                    raise TableExistsError("Table '%s' already exists",
+                                           stmt.view.name)
+            tbl = TableInfo(id=m.gen_global_id(), name=stmt.view.name,
+                            view_select=stmt.select_text,
+                            view_cols=list(stmt.columns))
+            m.create_table(db.id, tbl)
+        self._with_meta(fn)
+
     def drop_table(self, stmt: ast.DropTableStmt):
         def fn(m):
             for tn in stmt.tables:

@@ -54,9 +54,25 @@ class Domain:
         self.metrics: dict = {}   # counter name -> value (prometheus analog)
         from ..privilege import PrivManager
         self.priv = PrivManager(self)
+        self._live_execs: dict = {}       # conn_id -> [ExecContext]
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256
+
+    def register_exec(self, conn_id, ectx):
+        self._live_execs.setdefault(conn_id, []).append(ectx)
+
+    def unregister_exec(self, conn_id, ectx):
+        lst = self._live_execs.get(conn_id, [])
+        if ectx in lst:
+            lst.remove(ectx)
+
+    def kill_conn(self, conn_id: int):
+        """Cooperative query kill (reference pkg/util/sqlkiller): running
+        executors observe the flag at their next pull."""
+        for ectx in self._live_execs.get(conn_id, []):
+            ectx.killed = True
+        self.inc_metric("killed_queries")
 
     def run_gc(self, safepoint=None) -> int:
         """MVCC GC across columnar tables (safepoint default: now)."""

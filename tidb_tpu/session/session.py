@@ -211,6 +211,10 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.KillStmt):
+            self.check_priv("super")
+            self.domain.kill_conn(stmt.conn_id)
+            return ResultSet()
         if isinstance(stmt, ast.PrepareStmt):
             inner = parse(stmt.sql_text)
             if len(inner) != 1:
@@ -264,6 +268,7 @@ class Session:
             ast.CreateDatabaseStmt: self.ddl.create_database,
             ast.DropDatabaseStmt: self.ddl.drop_database,
             ast.CreateTableStmt: self.ddl.create_table,
+            ast.CreateViewStmt: self.ddl.create_view,
             ast.DropTableStmt: self.ddl.drop_table,
             ast.TruncateTableStmt: self.ddl.truncate_table,
             ast.RenameTableStmt: self.ddl.rename_table,
@@ -307,12 +312,14 @@ class Session:
                     old = dom.plan_cache_order.pop(0)
                     dom.plan_cache.pop(old, None)
         ectx = ExecContext(self)
+        self.domain.register_exec(self.conn_id, ectx)
         ex = build_executor(ectx, plan)
         ex.open()
         try:
             chunks = ex.all_chunks()
         finally:
             ex.close()
+            self.domain.unregister_exec(self.conn_id, ectx)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
         names = [plan.schema.cols[i].name for i in vis]
         out_chunks = []
