@@ -327,11 +327,23 @@ class CoprExecutor:
                            group_bucket=1024):
         """Device partial aggregation; returns PartialAggResult."""
         while True:
-            key = self._cache_key(dag, tbl, "agg", cap, (group_bucket,))
-            kern = self._kernel_cache.get(key)
-            if kern is None:
-                kern = _build_agg_kernel(dag, cols, cap, group_bucket)
-                self._kernel_cache[key] = kern
+            kd, sd = capture_agg_dicts(dag, cols)
+            # dense fast path: all group keys are dictionary codes over a
+            # small combined domain -> direct scatter-add (segment_sum over
+            # the dense key product), no sort at all (Q1 shape)
+            strides = _dense_strides(dag, kd)
+            if strides is not None:
+                key = self._cache_key(dag, tbl, "dagg", cap, tuple(strides))
+                kern = self._kernel_cache.get(key)
+                if kern is None:
+                    kern = _build_dense_agg_kernel(dag, cols, cap, strides)
+                    self._kernel_cache[key] = kern
+            else:
+                key = self._cache_key(dag, tbl, "agg", cap, (group_bucket,))
+                kern = self._kernel_cache.get(key)
+                if kern is None:
+                    kern = _build_agg_kernel(dag, cols, cap, group_bucket)
+                    self._kernel_cache[key] = kern
             jcols, vv = self._pad_upload(cols, v, m, cap)
             jc = {k: (d, nl) for k, (d, nl, _) in jcols.items()}
             if dag.host_filters:
@@ -343,11 +355,12 @@ class CoprExecutor:
                     if m != cap else hm
                 vv = vv & jnp.asarray(hmp)
             res = kern(jc, vv)
+            if strides is not None:
+                return _compact_dense(dag, res, strides, kd, sd)
             ngroups = int(res["ngroups"])
             if ngroups > group_bucket:
                 group_bucket = shape_bucket(ngroups)
                 continue
-            kd, sd = capture_agg_dicts(dag, cols)
             return PartialAggResult(
                 ngroups=ngroups,
                 keys=[np.asarray(k)[:ngroups] for k in res["keys"]],
@@ -418,6 +431,118 @@ def _dag_device_ready(dag) -> bool:
         if not all(is_device_safe(arg) for arg in a.args):
             return False
     return True
+
+
+_DENSE_MAX = 4096
+
+
+def _dense_strides(dag, key_dicts):
+    """-> per-key domain sizes (+1 null slot) when every group key is a
+    small dictionary code, else None. Dict sizes are stable for the cached
+    kernel because the kernel cache key includes dict versions."""
+    if not dag.group_items or len(key_dicts) != len(dag.group_items):
+        return None
+    sizes = []
+    total = 1
+    for d in key_dicts:
+        if d is None:
+            return None
+        size = len(d.values) + 1          # slot 0 = NULL
+        sizes.append(size)
+        total *= size
+        if total > _DENSE_MAX:
+            return None
+    return sizes
+
+
+def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
+    """Partial agg via direct scatter-add into the dense key-product table."""
+    sdicts = {k: c[2] for k, c in sample_cols.items()}
+    group_items = list(dag.group_items)
+    aggs = list(dag.aggs)
+    nslots = 1
+    for s in sizes:
+        nslots *= s
+
+    @jax.jit
+    def kern(jc, vv):
+        full = {k: (d, nl, sdicts[k]) for k, (d, nl) in jc.items()}
+        ctx = EvalCtx(jnp, cap, full, host=False)
+        mask = vv
+        for f in dag.filters:
+            mask = mask & eval_bool_mask(ctx, f)
+        slot = jnp.zeros(cap, dtype=jnp.int64)
+        for g, size in zip(group_items, sizes):
+            d, nl, _ = eval_expr(ctx, g)
+            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                d = jnp.full(cap, d)
+            nm = materialize_nulls(ctx, nl)
+            code = jnp.where(nm, 0, d.astype(jnp.int64) + 1)
+            slot = slot * size + code
+        slot = jnp.where(mask, slot, nslots)      # invalid rows -> spill slot
+        states = []
+        for a in aggs:
+            if a.args:
+                d, nl, _ = eval_expr(ctx, a.args[0])
+                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                    d = jnp.full(cap, d)
+                nm = materialize_nulls(ctx, nl)
+                row_ok = mask & ~nm
+            else:
+                d = jnp.ones(cap, dtype=jnp.int64)
+                row_ok = mask
+            cnt = jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
+                                      num_segments=nslots + 1)[:nslots]
+            if a.name == "count":
+                states.append([cnt])
+            elif a.name in ("sum", "avg"):
+                s = jax.ops.segment_sum(jnp.where(row_ok, d, 0), slot,
+                                        num_segments=nslots + 1)[:nslots]
+                states.append([s, cnt])
+            elif a.name == "min":
+                big = (jnp.asarray(np.inf) if d.dtype.kind == "f"
+                       else jnp.asarray(_I64_MAX)).astype(d.dtype)
+                s = jax.ops.segment_min(jnp.where(row_ok, d, big), slot,
+                                        num_segments=nslots + 1)[:nslots]
+                states.append([s, cnt])
+            elif a.name == "max":
+                small = (jnp.asarray(-np.inf) if d.dtype.kind == "f"
+                         else jnp.asarray(-_I64_MAX)).astype(d.dtype)
+                s = jax.ops.segment_max(jnp.where(row_ok, d, small), slot,
+                                        num_segments=nslots + 1)[:nslots]
+                states.append([s, cnt])
+            elif a.name == "first_row":
+                fi = jax.ops.segment_min(
+                    jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
+                    num_segments=nslots + 1)[:nslots]
+                states.append([d[jnp.minimum(fi, cap - 1)], cnt])
+            else:
+                raise NotImplementedError(a.name)
+        present = jax.ops.segment_sum(mask.astype(jnp.int64), slot,
+                                      num_segments=nslots + 1)[:nslots]
+        return {"present": present, "states": states}
+    return kern
+
+
+def _compact_dense(dag, res, sizes, key_dicts, state_dicts):
+    """Compact the dense slot table (host side; <= _DENSE_MAX slots)."""
+    present = np.asarray(res["present"])
+    slots = np.nonzero(present > 0)[0]
+    ngroups = len(slots)
+    keys = []
+    key_nulls = []
+    rem = slots.copy()
+    for size in reversed(sizes):
+        code = rem % size
+        rem = rem // size
+        keys.append(np.where(code == 0, 0, code - 1).astype(np.int64))
+        key_nulls.append(code == 0)
+    keys.reverse()
+    key_nulls.reverse()
+    states = [[np.asarray(s)[slots] for s in st] for st in res["states"]]
+    return PartialAggResult(ngroups=ngroups, keys=keys, key_nulls=key_nulls,
+                            states=states, key_dicts=key_dicts,
+                            state_dicts=state_dicts)
 
 
 def _agg_identity(name):
