@@ -72,7 +72,8 @@ class CoprExecutor:
         return dev
 
     # ---- public -------------------------------------------------------
-    def execute(self, dag, overlay=None, read_ts=None) -> list:
+    def execute(self, dag, overlay=None, read_ts=None, use_mpp=False,
+                mpp_min_rows=1 << 16) -> list:
         """-> list of host Chunks (schema = dag.cols, or partial agg layout:
         [group_keys..., group_nullflags..., agg_states...]).
 
@@ -100,6 +101,11 @@ class CoprExecutor:
         if not self.use_device or dag.table_info.id < 0 or \
                 not _dag_device_ready(dag):
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
+        if use_mpp and dag.aggs and not overlay and not dag.host_filters \
+                and n >= mpp_min_rows:
+            res = self._try_execute_mpp(dag, tbl, arrays, valid, n, handles)
+            if res is not None:
+                return res
         return self._execute_device(dag, tbl, arrays, valid, n, handles)
 
     def _apply_overlay(self, dag, tbl, arrays, valid, n, overlay):
@@ -287,6 +293,88 @@ class CoprExecutor:
             if len(v) != cap else v
         return jcols, jnp.asarray(vv)
 
+    def _get_mesh(self):
+        import jax
+        if getattr(self, "_mesh", None) is None:
+            from ..parallel import make_mesh
+            if len(jax.devices()) < 2:
+                self._mesh = False
+            else:
+                self._mesh = make_mesh()
+        return self._mesh or None
+
+    def _dev_put_sharded(self, key, arr_np, mesh, cap, pad_fill=0):
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            self._dev_cache_order.remove(key)
+            self._dev_cache_order.append(key)
+            return hit
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if len(arr_np) != cap:
+            arr_np = np.concatenate(
+                [arr_np, np.full(cap - len(arr_np), pad_fill,
+                                 dtype=arr_np.dtype)])
+        dev = jax.device_put(arr_np, NamedSharding(mesh, P("dp")))
+        nbytes = dev.size * dev.dtype.itemsize
+        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
+               and self._dev_cache_order):
+            old = self._dev_cache_order.pop(0)
+            ev = self._dev_cache.pop(old)
+            self._dev_cache_bytes -= ev.size * ev.dtype.itemsize
+        self._dev_cache[key] = dev
+        self._dev_cache_order.append(key)
+        self._dev_cache_bytes += nbytes
+        return dev
+
+    def _try_execute_mpp(self, dag, tbl, arrays, valid, n, handles):
+        """MPP fragment path: shard rows across the mesh, run the dense
+        partial-agg kernel per shard inside shard_map, merge with psum
+        (the hash exchange collapsed into an allreduce over the dense key
+        domain — tidb_tpu/mpp design). Returns None when ineligible."""
+        mesh = self._get_mesh()
+        if mesh is None:
+            return None
+        kd, sd = capture_agg_dicts(
+            dag, self._bind_cols(dag, tbl, arrays, slice(0, min(n, 1)),
+                                 handles))
+        strides = _dense_strides(dag, kd)
+        if strides is None:
+            return None
+        ndev = int(mesh.devices.size)
+        lane = 128 * ndev
+        padded = ((n + lane - 1) // lane) * lane
+        local = padded // ndev
+        cols = self._bind_cols(dag, tbl, arrays, slice(0, n), handles)
+        names = sorted(cols.keys())
+        args = []
+        has_nulls = {}
+        for k in names:
+            data, nulls, sdict = cols[k]
+            ck_base = (id(tbl), k, tbl.version, "mpp", ndev, padded)
+            args.append(self._dev_put_sharded(ck_base + ("d",), data, mesh,
+                                              padded))
+            has_nulls[k] = nulls is not None
+            if nulls is not None:
+                args.append(self._dev_put_sharded(ck_base + ("n",), nulls,
+                                                  mesh, padded,
+                                                  pad_fill=True))
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        vpad = np.concatenate([valid[:n], np.zeros(padded - n, dtype=bool)]) \
+            if padded != n else valid[:n]
+        args.append(jax.device_put(vpad, NamedSharding(mesh, P("dp"))))
+        key = self._cache_key(dag, tbl, "mpp", padded,
+                              (tuple(strides), ndev,
+                               tuple(sorted(has_nulls.items()))))
+        kern = self._kernel_cache.get(key)
+        if kern is None:
+            kern = _build_dense_agg_kernel_mpp(
+                dag, cols, local, strides, mesh, names, has_nulls)
+            self._kernel_cache[key] = kern
+        res = kern(*args)
+        return [_compact_dense(dag, res, strides, kd, sd)]
+
     def _cache_key(self, dag, tbl, kind, cap, extra=()):
         dict_vers = tuple(sorted(
             (cid, len(d.values)) for cid, d in tbl.dicts.items()))
@@ -439,8 +527,11 @@ _DENSE_MAX = 4096
 def _dense_strides(dag, key_dicts):
     """-> per-key domain sizes (+1 null slot) when every group key is a
     small dictionary code, else None. Dict sizes are stable for the cached
-    kernel because the kernel cache key includes dict versions."""
-    if not dag.group_items or len(key_dicts) != len(dag.group_items):
+    kernel because the kernel cache key includes dict versions. A global
+    aggregation is the degenerate dense case (one slot, empty sizes)."""
+    if not dag.group_items:
+        return []
+    if len(key_dicts) != len(dag.group_items):
         return None
     sizes = []
     total = 1
@@ -522,6 +613,121 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
                                       num_segments=nslots + 1)[:nslots]
         return {"present": present, "states": states}
     return kern
+
+
+def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
+                                names, has_nulls):
+    """The dense partial-agg kernel wrapped in shard_map: each device
+    aggregates its row shard into the dense table; one psum merges —
+    the MPP hash exchange as an allreduce (tidb_tpu/mpp/exec.py design)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    sdicts = {k: c[2] for k, c in sample_cols.items()}
+    group_items = list(dag.group_items)
+    aggs = list(dag.aggs)
+    nslots = 1
+    for s in sizes:
+        nslots *= s
+
+    def frag(*flat):
+        cols = {}
+        i = 0
+        for k in names:
+            d = flat[i]
+            i += 1
+            nl = None
+            if has_nulls[k]:
+                nl = flat[i]
+                i += 1
+            cols[k] = (d, nl, sdicts[k])
+        vv = flat[-1]
+        cap = vv.shape[0]
+        ctx = EvalCtx(jnp, cap, cols, host=False)
+        mask = vv
+        for f in dag.filters:
+            mask = mask & eval_bool_mask(ctx, f)
+        slot = jnp.zeros(cap, dtype=jnp.int64)
+        for g, size in zip(group_items, sizes):
+            d, nl, _ = eval_expr(ctx, g)
+            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                d = jnp.full(cap, d)
+            nm = materialize_nulls(ctx, nl)
+            code = jnp.where(nm, 0, d.astype(jnp.int64) + 1)
+            slot = slot * size + code
+        slot = jnp.where(mask, slot, nslots)
+        states = []
+        for a in aggs:
+            if a.args:
+                d, nl, _ = eval_expr(ctx, a.args[0])
+                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                    d = jnp.full(cap, d)
+                nm = materialize_nulls(ctx, nl)
+                row_ok = mask & ~nm
+            else:
+                d = jnp.ones(cap, dtype=jnp.int64)
+                row_ok = mask
+            cnt = jax.lax.psum(
+                jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
+                                    num_segments=nslots + 1)[:nslots], "dp")
+            if a.name == "count":
+                states.append([cnt])
+            elif a.name in ("sum", "avg"):
+                s = jax.lax.psum(
+                    jax.ops.segment_sum(jnp.where(row_ok, d, 0), slot,
+                                        num_segments=nslots + 1)[:nslots],
+                    "dp")
+                states.append([s, cnt])
+            elif a.name == "min":
+                big = (jnp.asarray(np.inf) if d.dtype.kind == "f"
+                       else jnp.asarray(_I64_MAX)).astype(d.dtype)
+                s = jax.lax.pmin(
+                    jax.ops.segment_min(jnp.where(row_ok, d, big), slot,
+                                        num_segments=nslots + 1)[:nslots],
+                    "dp")
+                states.append([s, cnt])
+            elif a.name == "max":
+                small = (jnp.asarray(-np.inf) if d.dtype.kind == "f"
+                         else jnp.asarray(-_I64_MAX)).astype(d.dtype)
+                s = jax.lax.pmax(
+                    jax.ops.segment_max(jnp.where(row_ok, d, small), slot,
+                                        num_segments=nslots + 1)[:nslots],
+                    "dp")
+                states.append([s, cnt])
+            elif a.name == "first_row":
+                fi = jax.lax.pmin(
+                    jax.ops.segment_min(
+                        jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
+                        num_segments=nslots + 1)[:nslots], "dp")
+                # value at the globally-first index of the LOCAL shard is
+                # approximated by the local value (first_row is
+                # order-agnostic per SQL semantics)
+                lv = d[jnp.minimum(
+                    jax.ops.segment_min(
+                        jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
+                        num_segments=nslots + 1)[:nslots], cap - 1)]
+                lc = jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
+                                         num_segments=nslots + 1)[:nslots]
+                # pick the value from some shard that has rows: max over
+                # shards of (has_rows, value) pairs via where+pmax on value
+                v = jax.lax.pmax(jnp.where(lc > 0, lv, -_I64_MAX), "dp")
+                states.append([v, cnt])
+            else:
+                raise NotImplementedError(a.name)
+        present = jax.lax.psum(
+            jax.ops.segment_sum(mask.astype(jnp.int64), slot,
+                                num_segments=nslots + 1)[:nslots], "dp")
+        return {"present": present, "states": states}
+
+    nargs = sum(1 + (1 if has_nulls[k] else 0) for k in names) + 1
+    fn = shard_map(frag, mesh=mesh,
+                   in_specs=tuple(P("dp") for _ in range(nargs)),
+                   out_specs={"present": P(),
+                              "states": [[P() for _ in range(
+                                  2 if a.name != "count" else 1)]
+                                  for a in aggs]},
+                   check_rep=False)
+    return jax.jit(fn)
 
 
 def _compact_dense(dag, res, sizes, key_dicts, state_dicts):

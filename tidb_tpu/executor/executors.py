@@ -84,8 +84,11 @@ class TableReaderExec(Executor):
         return ch
 
     def partials(self):
-        return self.ctx.copr.execute(self.dag, self._overlay(),
-                                     self.ctx.read_ts())
+        sv = self.ctx.sv
+        return self.ctx.copr.execute(
+            self.dag, self._overlay(), self.ctx.read_ts(),
+            use_mpp=bool(sv.get("tidb_enable_mpp")),
+            mpp_min_rows=int(sv.get("tidb_mpp_min_rows")))
 
 
 class ShellExec(Executor):
