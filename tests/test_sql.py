@@ -632,3 +632,36 @@ class TestViewsCTE:
         import tidb_tpu.session.session as S
         ftk.domain.kill_conn(999)    # unknown conn: no-op
         ftk.must_query("select * from k1")
+
+
+class TestPointGet:
+    def test_pk_point_get(self, ftk):
+        ftk.must_exec("create table pg1 (id int primary key, v varchar(10))")
+        ftk.must_exec("insert into pg1 values (1,'a'),(2,'b'),(3,'c')")
+        r = ftk.must_query("explain select * from pg1 where id = 2")
+        assert any("PointGet" in row[0] for row in r.rows)
+        ftk.must_query("select * from pg1 where id = 2").check([(2, "b")])
+        ftk.must_query("select v from pg1 where id = 99").check([])
+        ftk.must_exec("update pg1 set v = 'bb' where id = 2")
+        ftk.must_query("select v from pg1 where id = 2").check([("bb",)])
+        ftk.must_exec("delete from pg1 where id = 2")
+        ftk.must_query("select * from pg1 where id = 2").check([])
+
+    def test_unique_index_point_get(self, ftk):
+        ftk.must_exec("create table pg2 (a int, u varchar(10) unique, v int)")
+        ftk.must_exec("insert into pg2 values (1,'x',10),(2,'y',20)")
+        r = ftk.must_query("explain select v from pg2 where u = 'y'")
+        assert any("PointGet" in row[0] for row in r.rows)
+        ftk.must_query("select v from pg2 where u = 'y'").check([(20,)])
+        ftk.must_query("select v from pg2 where u = 'zz'").check([])
+
+    def test_point_get_in_txn(self, ftk):
+        ftk.must_exec("create table pg3 (id int primary key, v int)")
+        ftk.must_exec("insert into pg3 values (1, 10)")
+        ftk.must_exec("begin")
+        ftk.must_exec("update pg3 set v = 99 where id = 1")
+        ftk.must_query("select v from pg3 where id = 1").check([(99,)])
+        tk2 = ftk.new_session()
+        tk2.must_query("select v from pg3 where id = 1").check([(10,)])
+        ftk.must_exec("commit")
+        tk2.must_query("select v from pg3 where id = 1").check([(99,)])

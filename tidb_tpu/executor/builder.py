@@ -1,13 +1,14 @@
 """Executor builder (reference pkg/executor/builder.go:193)."""
 from __future__ import annotations
 
-from ..planner.physical import (PhysTableReader, PhysSelection, PhysProjection,
+from ..planner.physical import (PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
                                 PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
                                 PhysLimit, PhysUnion, PhysDual, PhysShell,
                                 PhysWindow)
 from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
                         HashAggExec, HashJoinExec, SortExec, TopNExec,
-                        LimitExec, UnionExec, DualExec, ShellExec)
+                        LimitExec, UnionExec, DualExec, ShellExec,
+                        PointGetExec)
 from .window import WindowExec
 
 
@@ -20,6 +21,8 @@ def build_executor(ctx, plan):
 
 
 def _build(ctx, plan):
+    if isinstance(plan, PhysPointGet):
+        return PointGetExec(ctx, plan)
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(ctx, plan)
     if isinstance(plan, PhysSelection):

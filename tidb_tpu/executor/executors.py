@@ -91,6 +91,100 @@ class TableReaderExec(Executor):
             mpp_min_rows=int(sv.get("tidb_mpp_min_rows")))
 
 
+class PointGetExec(Executor):
+    """O(1) point read: clustered-PK handle -> columnar handle index (or
+    row KV for txn-buffered rows); unique index -> index KV -> handle."""
+
+    def __init__(self, ctx, plan):
+        super().__init__(ctx, plan.schema)
+        self.plan = plan
+        self._done = False
+
+    def open(self):
+        pass
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.ctx.sess
+        from .exec_base import expr_to_datum, coerce_datum
+        from ..codec.tablecodec import record_key, index_key
+        from ..codec.codec import decode_row_value
+        txn = getattr(sess, "_txn", None)
+        dirty = txn is not None and not txn.committed and not txn.aborted \
+            and txn.is_dirty()
+        handle = None
+        if plan.handle_expr is not None:
+            d = expr_to_datum(plan.handle_expr)
+            if d.is_null:
+                return Chunk.empty([sc.col.ft for sc in self.schema.cols])
+            handle = int(d.val)
+        else:
+            datums = []
+            for e, cn in zip(plan.index_vals, plan.index.columns):
+                ci = tbl.find_column(cn)
+                datums.append(coerce_datum(expr_to_datum(e), ci.ft))
+            ik = index_key(tbl.id, plan.index.id, datums)
+            v = (txn.get(ik) if dirty else
+                 sess.domain.storage.mvcc.get(
+                     ik, self.ctx.read_ts()
+                     or sess.domain.storage.current_ts()))
+            if v is None:
+                return Chunk.empty([sc.col.ft for sc in self.schema.cols])
+            handle = int(v)
+        # txn-buffered row wins (UnionScan semantics)
+        if dirty:
+            rv = txn.mem_buffer.get(record_key(tbl.id, handle))
+            if record_key(tbl.id, handle) in txn.mem_buffer:
+                if rv is None:
+                    return Chunk.empty(
+                        [sc.col.ft for sc in self.schema.cols])
+                row = decode_row_value(rv)
+                return self._from_row(row)
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        pos = None if ctab is None else ctab.handle_pos.get(handle)
+        if pos is None or ctab.delete_ts[pos] != 0:
+            return Chunk.empty([sc.col.ft for sc in self.schema.cols])
+        rts = self.ctx.read_ts()
+        if rts is not None and not (
+                ctab.insert_ts[pos] <= rts and
+                (ctab.delete_ts[pos] == 0 or ctab.delete_ts[pos] > rts)):
+            # find an older visible version by scanning versions of handle
+            mask = (ctab.handles[:ctab.n] == handle) & \
+                   (ctab.insert_ts[:ctab.n] <= rts) & \
+                   ((ctab.delete_ts[:ctab.n] == 0) |
+                    (ctab.delete_ts[:ctab.n] > rts))
+            idxs = np.nonzero(mask)[0]
+            if not len(idxs):
+                return Chunk.empty([sc.col.ft for sc in self.schema.cols])
+            pos = int(idxs[-1])
+        out = []
+        for sc in self.schema.cols:
+            ci = tbl.find_column(sc.name)
+            if ci is None:   # handle column
+                out.append(Column(sc.col.ft,
+                                  np.array([handle], dtype=np.int64)))
+            else:
+                out.append(ctab.column_for(ci, np.array([pos])))
+        return Chunk(out)
+
+    def _from_row(self, row):
+        tbl = self.plan.table_info
+        name_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
+        cols = []
+        for sc in self.schema.cols:
+            off = name_off.get(sc.name)
+            from ..chunk.column import Column as HostCol
+            if off is None:
+                cols.append(HostCol(sc.col.ft, np.zeros(1, dtype=np.int64)))
+            else:
+                cols.append(HostCol.from_datums(sc.col.ft, [row[off]]))
+        return Chunk(cols)
+
+
 class ShellExec(Executor):
     """Subquery-in-FROM renaming shell: aligns the child's output columns to
     the shell schema by column id (the child may carry extra/hidden cols)."""

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..expression import Expression, Column, AggDesc
+from ..expression import Expression, Column, Constant, ScalarFunc, AggDesc
 from ..expression.vec import is_device_safe
 from .schema import Schema, SchemaCol
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
@@ -75,6 +75,28 @@ class PhysTableReader(PhysPlan):
             s += (f", partial_agg:[{', '.join(map(repr, self.dag.aggs))}] "
                   f"group:[{', '.join(map(repr, self.dag.group_items))}]")
         return s
+
+
+class PhysPointGet(PhysPlan):
+    """Point read via clustered PK handle or unique index (reference
+    pkg/executor/point_get.go; planner fast path point_get_plan.go)."""
+
+    def __init__(self, table_info, db_name, cols, handle_expr, index,
+                 index_vals, schema):
+        super().__init__([], schema)
+        self.table_info = table_info
+        self.db_name = db_name
+        self.cols = cols                  # [SchemaCol] to output
+        self.handle_expr = handle_expr    # Constant handle (pk_is_handle)
+        self.index = index                # IndexInfo for unique-index gets
+        self.index_vals = index_vals      # [Constant] index column values
+        self.stats_rows = 1.0
+
+    def explain_info(self):
+        if self.handle_expr is not None:
+            return f"table:{self.table_info.name}, handle:{self.handle_expr!r}"
+        return (f"table:{self.table_info.name}, index:{self.index.name}"
+                f"({', '.join(map(repr, self.index_vals))})")
 
 
 class PhysSelection(PhysPlan):
@@ -184,6 +206,35 @@ def to_physical(plan: LogicalPlan, sess_vars=None) -> PhysPlan:
     return p
 
 
+def _try_point_get(ds: DataSource) -> PhysPlan | None:
+    """DataSource whose pushed conds form pk = const / unique-index match."""
+    tbl = ds.table_info
+    conds = ds.pushed_conds
+    if not conds or tbl.id < 0:
+        return None
+    eqs = {}
+    for c in conds:
+        if not (isinstance(c, ScalarFunc) and c.op == "=" and
+                isinstance(c.args[0], Column) and
+                isinstance(c.args[1], Constant)):
+            return None
+        name = getattr(ds, "col_name_of", {}).get(c.args[0].idx)
+        if name is None:
+            return None
+        eqs[name.lower()] = c.args[1]
+    cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
+    schema = Schema(list(cols))
+    if tbl.pk_is_handle and set(eqs) == {tbl.pk_col_name.lower()}:
+        return PhysPointGet(tbl, ds.db_name, cols,
+                            eqs[tbl.pk_col_name.lower()], None, None, schema)
+    for idx in tbl.indexes:
+        if idx.unique and set(eqs) == {c.lower() for c in idx.columns}:
+            vals = [eqs[c.lower()] for c in idx.columns]
+            return PhysPointGet(tbl, ds.db_name, cols, None, idx, vals,
+                                schema)
+    return None
+
+
 def _phys(plan: LogicalPlan) -> PhysPlan:
     if isinstance(plan, DataSource):
         return _mk_reader(plan)
@@ -265,7 +316,10 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
     raise NotImplementedError(f"no physical impl for {type(plan).__name__}")
 
 
-def _mk_reader(ds: DataSource) -> PhysTableReader:
+def _mk_reader(ds: DataSource) -> PhysPlan:
+    pg = _try_point_get(ds)
+    if pg is not None:
+        return pg
     cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
     dag = CoprDAG(table_info=ds.table_info, db_name=ds.db_name,
                   cols=list(cols))
