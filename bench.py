@@ -46,8 +46,74 @@ def _ensure_live_backend(probe_timeout=150):
         return False
 
 
+def htap_main():
+    """CH-benCHmark-style HTAP mix (BASELINE stage 5): OLTP threads doing
+    point reads + updates on orders while an OLAP thread loops TPC-H Q1.
+    Reports OLTP TPS alongside OLAP latency."""
+    import threading
+    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    seconds = float(os.environ.get("BENCH_SECONDS", "10"))
+    n_oltp = int(os.environ.get("BENCH_OLTP_THREADS", "2"))
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, QUERIES
+
+    tk = TestKit()
+    load_tpch(tk, sf=sf, seed=42)
+    n_ord = tk.domain.table_rows("test", tk.domain.infoschema()
+                                 .table_by_name("test", "orders"))
+    tk.must_query(QUERIES["q1"])       # warm OLAP kernels
+
+    stop = threading.Event()
+    oltp_counts = [0] * n_oltp
+    olap_lat = []
+
+    def oltp_worker(i):
+        s = tk.new_session()
+        rng = __import__("random").Random(i)
+        while not stop.is_set():
+            key = rng.randrange(1, int(n_ord))
+            if rng.random() < 0.5:
+                s.must_query(
+                    f"select o_totalprice from orders where o_orderkey = {key}")
+            else:
+                s.must_exec(
+                    f"update orders set o_shippriority = o_shippriority + 1 "
+                    f"where o_orderkey = {key}")
+            oltp_counts[i] += 1
+
+    def olap_worker():
+        s = tk.new_session()
+        while not stop.is_set():
+            t0 = time.time()
+            s.must_query(QUERIES["q1"])
+            olap_lat.append(time.time() - t0)
+
+    threads = [threading.Thread(target=oltp_worker, args=(i,), daemon=True)
+               for i in range(n_oltp)]
+    threads.append(threading.Thread(target=olap_worker, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    tps = sum(oltp_counts) / seconds
+    q1_ms = 1000 * sum(olap_lat) / max(len(olap_lat), 1)
+    print(f"# htap: oltp_tps={tps:.1f} q1_avg={q1_ms:.1f}ms "
+          f"olap_queries={len(olap_lat)}", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"ch_benchmark_sf{sf}_htap",
+        "value": round(tps, 1),
+        "unit": f"oltp ops/s with concurrent Q1 (avg {q1_ms:.0f}ms)",
+        "vs_baseline": round(q1_ms / 1000.0, 3),
+    }))
+
+
 def main():
     _ensure_live_backend()
+    if os.environ.get("BENCH_MODE") == "htap":
+        return htap_main()
     sf = float(os.environ.get("BENCH_SF", "0.1"))
     queries = os.environ.get("BENCH_QUERIES", "q6,q1,q3,q5").split(",")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
