@@ -52,6 +52,11 @@ class InsertExec:
             try:
                 table_rt.add_record(txn, tbl, handle, row)
             except DuplicateKeyError:
+                if tbl.partitions and (plan.is_replace or plan.on_dup):
+                    from ..errors import UnsupportedError
+                    raise UnsupportedError(
+                        "REPLACE/ON DUPLICATE KEY on partitioned tables "
+                        "is not supported yet")
                 if plan.is_replace:
                     self._replace_conflicts(txn, tbl, cols, row, handle)
                     table_rt.add_record(txn, tbl, handle, row, skip_check=True)

@@ -52,9 +52,10 @@ class TableReaderExec(Executor):
     def open(self):
         pass
 
-    def _overlay(self):
+    def _overlay(self, dag=None):
         """UnionScan overlay: uncommitted row mutations for this table from
         the session's dirty transaction."""
+        dag = dag or self.dag
         sess = self.ctx.sess
         txn = getattr(sess, "_txn", None)
         if txn is None or txn.committed or txn.aborted or not txn.is_dirty():
@@ -62,7 +63,7 @@ class TableReaderExec(Executor):
         from ..codec.tablecodec import (record_prefix, decode_record_key,
                                         table_prefix)
         from ..codec.codec import decode_row_value
-        pref = record_prefix(self.dag.table_info.id)
+        pref = record_prefix(dag.table_info.id)
         end = pref + b"\xff" * 9
         overlay = {}
         for k, v in txn.mem_buffer.scan(pref, end):
@@ -70,12 +71,30 @@ class TableReaderExec(Executor):
             overlay[handle] = decode_row_value(v) if v is not None else None
         return overlay or None
 
+    def _part_dags(self):
+        """One (sub)dag per physical table: the dag itself, or per-partition
+        clones after partition pruning."""
+        tbl = self.dag.table_info
+        if not tbl.partitions:
+            return [self.dag]
+        from ..storage.partition import (prune_partitions,
+                                         partition_table_info)
+        import dataclasses
+        col_name_of = {sc.col.idx: sc.name for sc in self.dag.cols}
+        pids = prune_partitions(tbl, self.dag.filters + self.dag.host_filters,
+                                col_name_of)
+        return [dataclasses.replace(self.dag,
+                                    table_info=partition_table_info(tbl, pid))
+                for pid in pids]
+
     def next(self):
         if self.dag.aggs:
             raise RuntimeError("partial-agg reader must be driven by HashAgg")
         if self._chunks is None:
-            self._chunks = self.ctx.copr.execute(self.dag, self._overlay(),
-                                                 self.ctx.read_ts())
+            self._chunks = []
+            for dag in self._part_dags():
+                self._chunks.extend(self.ctx.copr.execute(
+                    dag, self._overlay(dag), self.ctx.read_ts()))
             self._i = 0
         if self._i >= len(self._chunks):
             return None
@@ -85,10 +104,13 @@ class TableReaderExec(Executor):
 
     def partials(self):
         sv = self.ctx.sv
-        return self.ctx.copr.execute(
-            self.dag, self._overlay(), self.ctx.read_ts(),
-            use_mpp=bool(sv.get("tidb_enable_mpp")),
-            mpp_min_rows=int(sv.get("tidb_mpp_min_rows")))
+        out = []
+        for dag in self._part_dags():
+            out.extend(self.ctx.copr.execute(
+                dag, self._overlay(dag), self.ctx.read_ts(),
+                use_mpp=bool(sv.get("tidb_enable_mpp")),
+                mpp_min_rows=int(sv.get("tidb_mpp_min_rows"))))
+        return out
 
 
 class PointGetExec(Executor):

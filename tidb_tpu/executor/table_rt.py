@@ -8,8 +8,22 @@ from ..codec.codec import encode_row_value
 from ..types.datum import Datum, Kind, NULL
 from ..errors import DuplicateKeyError, BadNullError
 from ..models import SchemaState
+from ..storage.partition import route_partition
 
 TOMBSTONE = object()
+
+
+def physical_id(tbl, row) -> int:
+    """Physical table id for this row: the partition pid when partitioned
+    (reference tables/partition.go locatePartition), else the table id."""
+    if not tbl.partitions:
+        return tbl.id
+    pcol = tbl.partitions["col"].lower()
+    off = next(i for i, c in enumerate(tbl.columns)
+               if c.name.lower() == pcol)
+    d = row[off]
+    return route_partition(tbl, None if d is None or d.is_null
+                           else int(d.val))
 
 
 def _index_datums(tbl, idx, row):
@@ -26,7 +40,7 @@ def add_record(txn, tbl, handle: int, row: list, skip_check=False):
     for ci, d in zip(tbl.columns, row):
         if d.is_null and ci.ft.not_null:
             raise BadNullError("Column '%s' cannot be null", ci.name)
-    rk = record_key(tbl.id, handle)
+    rk = record_key(physical_id(tbl, row), handle)
     if not skip_check and txn.get(rk) is not None:
         raise DuplicateKeyError(
             "Duplicate entry '%s' for key 'PRIMARY'", handle)
@@ -46,7 +60,7 @@ def add_record(txn, tbl, handle: int, row: list, skip_check=False):
 
 
 def remove_record(txn, tbl, handle: int, row: list):
-    txn.delete(record_key(tbl.id, handle))
+    txn.delete(record_key(physical_id(tbl, row), handle))
     for idx in tbl.writable_indexes():
         datums = _index_datums(tbl, idx, row)
         if idx.unique and not any(d.is_null for d in datums):
@@ -60,6 +74,12 @@ def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
     if new_handle is not None and new_handle != handle:
         remove_record(txn, tbl, handle, old_row)
         add_record(txn, tbl, new_handle, new_row)
+        return
+    if tbl.partitions and \
+            physical_id(tbl, old_row) != physical_id(tbl, new_row):
+        # row moves between partitions (reference: exchange via delete+insert)
+        remove_record(txn, tbl, handle, old_row)
+        add_record(txn, tbl, handle, new_row, skip_check=True)
         return
     for ci, d in zip(tbl.columns, new_row):
         if d.is_null and ci.ft.not_null:
@@ -82,4 +102,5 @@ def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
             txn.set(ik, _handle_bytes(handle))
         else:
             txn.set(index_key(tbl.id, idx.id, nd, handle), b"")
-    txn.set(record_key(tbl.id, handle), encode_row_value(new_row))
+    txn.set(record_key(physical_id(tbl, new_row), handle),
+            encode_row_value(new_row))

@@ -95,6 +95,9 @@ class TableInfo:
     ttl: dict | None = None        # {"col", "value", "unit", "enable"}
     view_select: str = ""          # non-empty => this table is a VIEW
     view_cols: list = field(default_factory=list)
+    # partitioning: {"type": "range"|"hash", "col": name,
+    #   "parts": [{"name", "pid", "less_than": value|None}]}  (None=MAXVALUE)
+    partitions: dict | None = None
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -125,6 +128,7 @@ class TableInfo:
             "auto_inc_id": self.auto_inc_id, "state": int(self.state),
             "comment": self.comment, "ttl": self.ttl,
             "view_select": self.view_select, "view_cols": self.view_cols,
+            "partitions": self.partitions,
         }
 
     @classmethod
@@ -137,7 +141,8 @@ class TableInfo:
             auto_inc_id=j["auto_inc_id"], state=SchemaState(j["state"]),
             comment=j.get("comment", ""), ttl=j.get("ttl"),
             view_select=j.get("view_select", ""),
-            view_cols=j.get("view_cols", []))
+            view_cols=j.get("view_cols", []),
+            partitions=j.get("partitions"))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

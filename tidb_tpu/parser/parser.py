@@ -675,6 +675,38 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
+        if self.at_kw("partition"):
+            self.next()
+            self.expect_kw("by")
+            ptype = self.next().text.lower()       # range | hash
+            self.expect_op("(")
+            pcol = self.ident()
+            self.expect_op(")")
+            pdef = {"type": ptype, "col": pcol, "parts": []}
+            if ptype == "hash":
+                self.expect_kw("partitions")
+                pdef["num"] = int(self.next().text)
+            else:
+                self.expect_op("(")
+                while True:
+                    self.expect_kw("partition")
+                    pname = self.ident()
+                    self.expect_kw("values")
+                    self.expect_kw("less")
+                    self.expect_kw("than")
+                    if self.accept_kw("maxvalue"):
+                        lt = None
+                    else:
+                        self.expect_op("(")
+                        t = self.next()
+                        lt = (int(t.text) if t.kind == "NUMBER"
+                              else t.text)
+                        self.expect_op(")")
+                    pdef["parts"].append({"name": pname, "less_than": lt})
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            stmt.options["partition_by"] = pdef
         # table options: ENGINE=..., CHARSET=..., COMMENT=..., TTL=col+INTERVAL n unit
         while self.peek().kind == "IDENT":
             opt = self.next().text.lower()

@@ -210,7 +210,7 @@ def _try_point_get(ds: DataSource) -> PhysPlan | None:
     """DataSource whose pushed conds form pk = const / unique-index match."""
     tbl = ds.table_info
     conds = ds.pushed_conds
-    if not conds or tbl.id < 0:
+    if not conds or tbl.id < 0 or tbl.partitions:
         return None
     eqs = {}
     for c in conds:

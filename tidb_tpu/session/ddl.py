@@ -150,6 +150,33 @@ class DDLExecutor:
                     tbl.pk_is_handle = True
                     tbl.pk_col_name = ci.name
                     tbl.indexes = [i for i in tbl.indexes if not i.primary]
+            if "partition_by" in stmt.options:
+                pdef = dict(stmt.options["partition_by"])
+                pcol = tbl.find_column(pdef["col"])
+                if pcol is None:
+                    raise ColumnNotExistsError(
+                        "Unknown partition column '%s'", pdef["col"])
+                pdef["col"] = pcol.name
+                parts = []
+                if pdef["type"] == "hash":
+                    for i in range(int(pdef.get("num", 4))):
+                        parts.append({"name": f"p{i}",
+                                      "pid": m.gen_global_id(),
+                                      "less_than": None})
+                elif pdef["type"] == "range":
+                    from ..chunk.column import py_to_datum_fast
+                    for pd in pdef["parts"]:
+                        lt = pd["less_than"]
+                        if lt is not None:
+                            lt = py_to_datum_fast(lt, pcol.ft).val
+                        parts.append({"name": pd["name"],
+                                      "pid": m.gen_global_id(),
+                                      "less_than": lt})
+                else:
+                    raise UnsupportedError("PARTITION BY %s not supported",
+                                           pdef["type"])
+                pdef["parts"] = parts
+                tbl.partitions = pdef
             if "ttl" in stmt.options:
                 col, nval, unit = stmt.options["ttl"]
                 ci = tbl.find_column(col)

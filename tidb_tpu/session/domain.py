@@ -88,18 +88,36 @@ class Domain:
         self.metrics[name] = self.metrics.get(name, 0) + v
 
     def _table_info_by_id(self, tid: int):
-        return self.infoschema().table_by_id(tid)
+        info = self.infoschema().table_by_id(tid)
+        if info is not None:
+            return info
+        # partition pid -> physical clone of its logical table
+        from ..storage.partition import partition_table_info
+        ischema = self.infoschema()
+        for db in ischema.all_schemas():
+            for t in ischema.tables_in_schema(db.name):
+                if t.partitions:
+                    for p in t.partitions["parts"]:
+                        if p["pid"] == tid:
+                            return partition_table_info(t, tid)
+        return None
 
     def infoschema(self):
         return self.is_cache.current()
 
+    def _physical_ids(self, tbl):
+        if tbl.partitions:
+            return [p["pid"] for p in tbl.partitions["parts"]]
+        return [tbl.id]
+
     def allocator(self, tbl) -> _Allocator:
         a = self._allocators.get(tbl.id)
         if a is None:
-            ctab = self.columnar.tables.get(tbl.id)
             start = 0
-            if ctab is not None and ctab.n:
-                start = int(ctab.handles[:ctab.n].max())
+            for pid in self._physical_ids(tbl):
+                ctab = self.columnar.tables.get(pid)
+                if ctab is not None and ctab.n:
+                    start = max(start, int(ctab.handles[:ctab.n].max()))
             if tbl.pk_is_handle:
                 start = max(start, tbl.auto_inc_id)
             a = _Allocator(start)
@@ -110,7 +128,11 @@ class Domain:
         return self.mem_root.child("query", quota)
 
     def table_rows(self, db: str, tbl) -> float:
-        ctab = self.columnar.tables.get(tbl.id)
-        if ctab is None:
+        total = 0
+        for pid in self._physical_ids(tbl):
+            ctab = self.columnar.tables.get(pid)
+            if ctab is not None:
+                total += ctab.live_count()
+        if total == 0:
             return 10.0
-        return float(max(ctab.live_count(), 1))
+        return float(total)

@@ -1,0 +1,91 @@
+"""Table partitioning (reference pkg/table/tables/partition.go — RANGE and
+HASH partitions; each partition owns a physical table id (pid) whose row
+keyspace and columnar table are independent; indexes stay global on the
+logical table id)."""
+from __future__ import annotations
+
+import copy
+
+_PART_INFO_CACHE: dict = {}
+
+
+def partition_table_info(tbl, pid: int):
+    """TableInfo clone with id=pid (cached) — the physical table handed to
+    the columnar engine / copr for one partition."""
+    key = (id(tbl), pid)
+    hit = _PART_INFO_CACHE.get(key)
+    if hit is not None:
+        return hit
+    clone = copy.copy(tbl)
+    clone.id = pid
+    clone.partitions = None
+    _PART_INFO_CACHE[key] = clone
+    return clone
+
+
+def partition_ids(tbl) -> list:
+    return [p["pid"] for p in tbl.partitions["parts"]]
+
+
+def route_partition(tbl, part_val) -> int:
+    """-> pid for a row whose partition-column storage value is part_val
+    (int storage form; NULL routes to the first partition like MySQL)."""
+    pdef = tbl.partitions
+    parts = pdef["parts"]
+    if part_val is None:
+        return parts[0]["pid"]
+    if pdef["type"] == "hash":
+        return parts[int(part_val) % len(parts)]["pid"]
+    for p in parts:
+        if p["less_than"] is None or part_val < p["less_than"]:
+            return p["pid"]
+    from ..errors import TiDBError
+    raise TiDBError("Table has no partition for value %s", part_val)
+
+
+def prune_partitions(tbl, conds, col_name_of) -> list:
+    """Range-partition pruning from pushed conds of form pcol cmp const
+    (reference partition pruning rule). Returns pids to scan."""
+    pdef = tbl.partitions
+    parts = pdef["parts"]
+    if pdef["type"] != "range":
+        from ..expression import Column, Constant, ScalarFunc
+        for c in conds:   # hash pruning: pcol = const
+            if isinstance(c, ScalarFunc) and c.op == "=" and \
+                    isinstance(c.args[0], Column) and \
+                    isinstance(c.args[1], Constant) and \
+                    not c.args[1].value.is_null and \
+                    col_name_of.get(c.args[0].idx, "").lower() == \
+                    pdef["col"].lower():
+                return [route_partition(tbl, int(c.args[1].value.val))]
+        return [p["pid"] for p in parts]
+    lo, hi = None, None          # value bounds implied by conds
+    from ..expression import Column, Constant, ScalarFunc
+    for c in conds:
+        if not (isinstance(c, ScalarFunc) and
+                isinstance(c.args[0] if c.args else None, Column) and
+                len(c.args) == 2 and isinstance(c.args[1], Constant)):
+            continue
+        if col_name_of.get(c.args[0].idx, "").lower() != pdef["col"].lower():
+            continue
+        if c.args[1].value.is_null:
+            continue
+        v = c.args[1].value.val
+        if c.op in (">", ">="):
+            lo = v if lo is None else max(lo, v)
+        elif c.op in ("<", "<="):
+            hi = v if hi is None else min(hi, v)
+        elif c.op == "=":
+            lo = v if lo is None else max(lo, v)
+            hi = v if hi is None else min(hi, v)
+    out = []
+    prev = None
+    for p in parts:
+        p_lo, p_hi = prev, p["less_than"]      # [p_lo, p_hi)
+        prev = p["less_than"]
+        if lo is not None and p_hi is not None and lo >= p_hi:
+            continue
+        if hi is not None and p_lo is not None and hi < p_lo:
+            continue
+        out.append(p["pid"])
+    return out or [p["pid"] for p in parts]
