@@ -726,3 +726,39 @@ class TestPartitionedTables:
         ftk.must_query("select a from pt2 order by a").check([(5,), (50,)])
         ftk.must_exec("rollback")
         ftk.must_query("select count(*) from pt2").check([(0,)])
+
+
+class TestForeignKeys:
+    def test_fk_restrict(self, ftk):
+        ftk.must_exec("create table par (id int primary key, v int)")
+        ftk.must_exec("create table ch (a int, pid int, "
+                      "foreign key (pid) references par (id))")
+        ftk.must_exec("insert into par values (1, 10), (2, 20)")
+        ftk.must_exec("insert into ch values (1, 1), (2, null)")
+        e = ftk.exec_err("insert into ch values (3, 99)")
+        assert e.code == 1452
+        e = ftk.exec_err("delete from par where id = 1")
+        assert e.code == 1451
+        ftk.must_exec("delete from par where id = 2")  # unreferenced: ok
+        ftk.must_exec("delete from ch where a = 1")
+        ftk.must_exec("delete from par where id = 1")  # now ok
+
+    def test_fk_cascade(self, ftk):
+        ftk.must_exec("create table p2 (id int primary key)")
+        ftk.must_exec("create table c2 (x int, pid int, "
+                      "foreign key (pid) references p2 (id) "
+                      "on delete cascade)")
+        ftk.must_exec("insert into p2 values (1), (2)")
+        ftk.must_exec("insert into c2 values (10, 1), (11, 1), (12, 2)")
+        ftk.must_exec("delete from p2 where id = 1")
+        ftk.must_query("select x from c2 order by x").check([(12,)])
+
+    def test_fk_update_child(self, ftk):
+        ftk.must_exec("create table p3 (id int primary key)")
+        ftk.must_exec("create table c3 (pid int, "
+                      "foreign key (pid) references p3 (id))")
+        ftk.must_exec("insert into p3 values (1)")
+        ftk.must_exec("insert into c3 values (1)")
+        e = ftk.exec_err("update c3 set pid = 5")
+        assert e.code == 1452
+        ftk.must_exec("update c3 set pid = null")

@@ -49,6 +49,9 @@ class InsertExec:
                 else:
                     alloc.rebase(int(d.val))
             handle = self._handle_for(tbl, cols, row, alloc)
+            if tbl.foreign_keys:
+                from .fk import check_parent_exists
+                check_parent_exists(sess, txn, tbl, row)
             try:
                 table_rt.add_record(txn, tbl, handle, row)
             except DuplicateKeyError:
@@ -238,6 +241,17 @@ class UpdateExec:
                     new[off] = d
                 if not changed:
                     continue
+                if tbl.foreign_keys:
+                    from .fk import check_parent_exists
+                    check_parent_exists(sess, txn, tbl, new)
+                from .fk import referencing_fks, on_parent_delete
+                if referencing_fks(sess, tbl, plan.db_name):
+                    # key change on a referenced parent: treat as delete-check
+                    changed_ref = any(
+                        o.sort_key() != nn.sort_key()
+                        for o, nn in zip(old, new))
+                    if changed_ref:
+                        on_parent_delete(sess, txn, tbl, plan.db_name, old)
                 new_handle = None
                 if tbl.pk_is_handle:
                     pk_off = next(j for j, c in enumerate(cols)
@@ -268,10 +282,14 @@ class DeleteExec:
         schema = plan.select_plan.schema
         affected = 0
         handle_idx = len(schema.cols) - 1
+        from .fk import referencing_fks, on_parent_delete
+        has_children = bool(referencing_fks(self.sess, tbl, plan.db_name))
         for ch in chunks:
             for i in range(len(ch)):
                 handle = int(ch.columns[handle_idx].data[i])
                 row = [ch.columns[j].get_datum(i) for j in range(len(cols))]
+                if has_children:
+                    on_parent_delete(self.sess, txn, tbl, plan.db_name, row)
                 table_rt.remove_record(txn, tbl, handle, row)
                 affected += 1
         return affected

@@ -1,0 +1,113 @@
+"""Foreign key enforcement (reference pkg/executor/foreign_key.go).
+
+Child-side: INSERT/UPDATE verifies the parent row exists (via parent PK
+handle index or unique-index KV). Parent-side: DELETE/UPDATE verifies no
+child references (RESTRICT) or cascades deletes, via the child's FK index
+in the txn-merged keyspace."""
+from __future__ import annotations
+
+from ..codec.tablecodec import index_key, index_prefix, record_key
+from ..codec.codec import encode_datums_key
+from ..errors import TiDBError
+
+
+class FKViolationError(TiDBError):
+    code = 1452
+    sqlstate = "23000"
+
+
+class FKParentViolationError(TiDBError):
+    code = 1451
+    sqlstate = "23000"
+
+
+def check_parent_exists(sess, txn, tbl, row):
+    """Child write: every non-null FK value set must exist in the parent."""
+    name_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
+    for fk in tbl.foreign_keys:
+        vals = [row[name_off[c]] for c in fk["cols"]]
+        if any(v.is_null for v in vals):
+            continue
+        parent = sess.domain.infoschema().table_by_name(fk["ref_db"],
+                                                        fk["ref_table"])
+        if parent.pk_is_handle and fk["ref_cols"] == \
+                [parent.pk_col_name.lower()]:
+            h = int(vals[0].val)
+            ctab = sess.domain.columnar.tables.get(parent.id)
+            pos = None if ctab is None else ctab.handle_pos.get(h)
+            ok = pos is not None and ctab.delete_ts[pos] == 0
+            if not ok and txn.get(record_key(parent.id, h)) is not None:
+                ok = True
+            if not ok:
+                raise FKViolationError(
+                    "Cannot add or update a child row: a foreign key "
+                    "constraint fails (fk on %s)", fk["ref_table"])
+            continue
+        idx = next(i for i in parent.indexes if i.unique and
+                   [c.lower() for c in i.columns] == fk["ref_cols"])
+        from .exec_base import coerce_datum
+        pd = [coerce_datum(v, parent.find_column(c).ft)
+              for v, c in zip(vals, fk["ref_cols"])]
+        if txn.get(index_key(parent.id, idx.id, pd)) is None:
+            raise FKViolationError(
+                "Cannot add or update a child row: a foreign key "
+                "constraint fails (fk on %s)", fk["ref_table"])
+
+
+def referencing_fks(sess, parent_tbl, parent_db):
+    """[(child TableInfo, fk dict)] of FKs pointing at parent."""
+    out = []
+    ischema = sess.domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            for fk in t.foreign_keys:
+                if fk["ref_table"].lower() == parent_tbl.name.lower() and \
+                        fk["ref_db"].lower() == parent_db.lower():
+                    out.append((db.name, t, fk))
+    return out
+
+
+def on_parent_delete(sess, txn, parent_tbl, parent_db, row):
+    """Parent row deleted: RESTRICT or CASCADE per child FK."""
+    name_off = {c.name.lower(): i for i, c in enumerate(parent_tbl.columns)}
+    for child_db, child, fk in referencing_fks(sess, parent_tbl, parent_db):
+        key_vals = []
+        for rc in fk["ref_cols"]:
+            if parent_tbl.pk_is_handle and \
+                    rc == parent_tbl.pk_col_name.lower():
+                key_vals.append(row[name_off[rc]])
+            else:
+                key_vals.append(row[name_off[rc]])
+        idx = next((i for i in child.indexes if
+                    [c.lower() for c in i.columns[:len(fk["cols"])]] ==
+                    fk["cols"]), None)
+        if idx is None:
+            continue
+        from .exec_base import coerce_datum
+        cd = [coerce_datum(v, child.find_column(c).ft)
+              for v, c in zip(key_vals, fk["cols"])]
+        pref = index_prefix(child.id, idx.id) + encode_datums_key(cd)
+        hits = [(k, v) for k, v in txn.scan(pref, pref + b"\xff")]
+        if not hits:
+            continue
+        if fk["on_delete"] == "cascade":
+            from . import table_rt
+            from ..codec.tablecodec import index_key_handle
+            from ..codec.codec import decode_row_value
+            for k, v in hits:
+                h = int(v) if idx.unique and v not in (b"",) \
+                    else index_key_handle(k)
+                from .table_rt import physical_id
+                rv = txn.get(record_key(child.id, h))
+                if rv is None and child.partitions:
+                    continue
+                if rv is None:
+                    continue
+                crow = decode_row_value(rv)
+                on_parent_delete(sess, txn, child, child_db, crow)
+                table_rt.remove_record(txn, child, h, crow)
+        else:
+            raise FKParentViolationError(
+                "Cannot delete or update a parent row: a foreign key "
+                "constraint fails (%s referencing %s)", child.name,
+                parent_tbl.name)

@@ -98,6 +98,8 @@ class TableInfo:
     # partitioning: {"type": "range"|"hash", "col": name,
     #   "parts": [{"name", "pid", "less_than": value|None}]}  (None=MAXVALUE)
     partitions: dict | None = None
+    # FK defs: [{"name","cols","ref_db","ref_table","ref_cols","on_delete"}]
+    foreign_keys: list = field(default_factory=list)
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -129,6 +131,7 @@ class TableInfo:
             "comment": self.comment, "ttl": self.ttl,
             "view_select": self.view_select, "view_cols": self.view_cols,
             "partitions": self.partitions,
+            "foreign_keys": self.foreign_keys,
         }
 
     @classmethod
@@ -142,7 +145,8 @@ class TableInfo:
             comment=j.get("comment", ""), ttl=j.get("ttl"),
             view_select=j.get("view_select", ""),
             view_cols=j.get("view_cols", []),
-            partitions=j.get("partitions"))
+            partitions=j.get("partitions"),
+            foreign_keys=j.get("foreign_keys", []))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

@@ -321,10 +321,21 @@ class IndexDef(Node):
 
 
 @dataclass
+class ForeignKeyDef(Node):
+    name: str = ""
+    columns: list = field(default_factory=list)
+    ref_table: TableName = None
+    ref_columns: list = field(default_factory=list)
+    on_delete: str = "restrict"   # restrict | cascade | set_null | no_action
+    on_update: str = "restrict"
+
+
+@dataclass
 class CreateTableStmt(StmtNode):
     table: TableName = None
     columns: list = field(default_factory=list)   # [ColumnDef]
     indexes: list = field(default_factory=list)   # [IndexDef]
+    foreign_keys: list = field(default_factory=list)
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
 

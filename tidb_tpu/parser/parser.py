@@ -668,7 +668,39 @@ class Parser:
                 cols = self._parse_paren_cols()
                 stmt.indexes.append(ast.IndexDef(
                     name=name or f"idx_{'_'.join(cols)}", columns=cols))
-            elif self.at_kw("constraint", "foreign", "check"):
+            elif self.at_kw("constraint", "foreign"):
+                fk_name = ""
+                if self.accept_kw("constraint"):
+                    if not self.at_kw("foreign", "check", "primary", "unique"):
+                        fk_name = self.ident()
+                if self.at_kw("foreign"):
+                    self.next()
+                    self.expect_kw("key")
+                    if not self.at_op("("):
+                        fk_name = self.ident()
+                    fk = ast.ForeignKeyDef(name=fk_name)
+                    fk.columns = self._parse_paren_cols()
+                    self.expect_kw("references")
+                    fk.ref_table = self.parse_table_name()
+                    fk.ref_columns = self._parse_paren_cols()
+                    while self.accept_kw("on"):
+                        which = self.next().text.lower()   # delete | update
+                        if self.accept_kw("no"):
+                            self.expect_kw("action")
+                            action = "no_action"
+                        elif self.accept_kw("set"):
+                            self.expect_kw("null")
+                            action = "set_null"
+                        else:
+                            action = self.next().text.lower()
+                        if which == "delete":
+                            fk.on_delete = action
+                        else:
+                            fk.on_update = action
+                    stmt.foreign_keys.append(fk)
+                else:
+                    self._skip_constraint()
+            elif self.at_kw("check"):
                 self._skip_constraint()
             else:
                 stmt.columns.append(self.parse_column_def())

@@ -150,6 +150,44 @@ class DDLExecutor:
                     tbl.pk_is_handle = True
                     tbl.pk_col_name = ci.name
                     tbl.indexes = [i for i in tbl.indexes if not i.primary]
+            for fk in stmt.foreign_keys:
+                ref_db_name = fk.ref_table.db or db_name
+                ref_db = self._db_by_name(m, ref_db_name)
+                parent = None
+                for t in m.list_tables(ref_db.id):
+                    if t.name.lower() == fk.ref_table.name.lower():
+                        parent = t
+                        break
+                if parent is None:
+                    raise TableNotExistsError(
+                        "Failed to open the referenced table '%s'",
+                        fk.ref_table.name)
+                # referenced cols must be the parent PK or a unique index
+                refs = [c.lower() for c in fk.ref_columns]
+                ok = (parent.pk_is_handle and
+                      refs == [parent.pk_col_name.lower()]) or any(
+                    i.unique and [c.lower() for c in i.columns] == refs
+                    for i in parent.indexes)
+                if not ok:
+                    raise UnsupportedError(
+                        "FK must reference the parent PRIMARY/UNIQUE key")
+                for cn in fk.columns:
+                    if tbl.find_column(cn) is None:
+                        raise ColumnNotExistsError(
+                            "Unknown column '%s' in foreign key", cn)
+                # auto-create the child index (MySQL behavior)
+                have = any([c.lower() for c in i.columns[:len(fk.columns)]]
+                           == [c.lower() for c in fk.columns]
+                           for i in tbl.indexes)
+                if not have:
+                    tbl.indexes.append(IndexInfo(
+                        id=max((i.id for i in tbl.indexes), default=0) + 1,
+                        name=fk.name or f"fk_{'_'.join(fk.columns)}",
+                        columns=list(fk.columns)))
+                tbl.foreign_keys.append({
+                    "name": fk.name, "cols": [c.lower() for c in fk.columns],
+                    "ref_db": ref_db_name, "ref_table": parent.name,
+                    "ref_cols": refs, "on_delete": fk.on_delete})
             if "partition_by" in stmt.options:
                 pdef = dict(stmt.options["partition_by"])
                 pcol = tbl.find_column(pdef["col"])
