@@ -1,0 +1,53 @@
+"""Golden-file integration tests (reference tests/integrationtest run-tests
+pattern: statements in t/*.test, expected output in r/*.result; regenerate
+with RECORD_GOLDEN=1)."""
+import os
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+_DIR = os.path.join(os.path.dirname(__file__), "integration")
+
+
+def _run_file(path):
+    tk = TestKit()
+    out = []
+    sql_acc = ""
+    for line in open(path):
+        line = line.rstrip("\n")
+        if not line.strip() or line.strip().startswith("--"):
+            continue
+        sql_acc += (" " if sql_acc else "") + line
+        if not line.rstrip().endswith(";"):
+            continue
+        sql = sql_acc
+        sql_acc = ""
+        out.append(f"> {sql}")
+        try:
+            rs = tk.sess.execute(sql)
+            if rs.names:
+                out.append("\t".join(rs.names))
+                for row in rs.rows:
+                    out.append("\t".join(
+                        "NULL" if v is None else str(v) for v in row))
+            else:
+                out.append(f"ok ({rs.affected} rows affected)")
+        except Exception as e:                        # noqa: BLE001
+            out.append(f"ERROR: {type(e).__name__}")
+    return "\n".join(out) + "\n"
+
+
+@pytest.mark.parametrize("name", sorted(
+    f[:-5] for f in os.listdir(os.path.join(_DIR, "t"))
+    if f.endswith(".test")))
+def test_golden(name):
+    got = _run_file(os.path.join(_DIR, "t", name + ".test"))
+    rpath = os.path.join(_DIR, "r", name + ".result")
+    if os.environ.get("RECORD_GOLDEN") == "1" or not os.path.exists(rpath):
+        with open(rpath, "w") as f:
+            f.write(got)
+        return
+    want = open(rpath).read()
+    assert got == want, f"golden mismatch for {name}; " \
+        f"regenerate with RECORD_GOLDEN=1 if intended"
