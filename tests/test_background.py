@@ -53,3 +53,17 @@ def test_ttl():
     deleted = run_ttl_once(tk.domain)
     assert deleted == 1
     tk.must_query("select id from ev").check([(2,)])
+
+
+def test_auto_analyze():
+    tk = TestKit()
+    tk.must_exec("create table aa (a int)")
+    tk.must_exec("insert into aa values " + ",".join(
+        f"({i})" for i in range(100)))
+    n = tk.domain.auto_analyze_once()
+    assert n >= 1
+    tbl = tk.domain.infoschema().table_by_name("test", "aa")
+    ts = tk.domain.stats.get(tbl.id)
+    assert ts is not None and ts.row_count == 100
+    # fresh stats: no re-run
+    assert tk.domain.auto_analyze_once() == 0

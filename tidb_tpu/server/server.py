@@ -136,5 +136,6 @@ def serve(port=4000):
     (reference cmd/tidb-server/main.go:400)."""
     from ..session import new_store
     domain = new_store()
+    domain.start_background()
     srv = Server(domain, port=port).start()
     return srv

@@ -74,6 +74,44 @@ class Domain:
             ectx.killed = True
         self.inc_metric("killed_queries")
 
+    def start_background(self, ttl_interval=600.0, analyze_interval=300.0,
+                         gc_interval=600.0):
+        """Start background services (reference domain.Start: stats/ttl/gc
+        loops). Off by default in embedded/test use; the server entrypoint
+        calls this."""
+        from ..ttl import start_ttl_worker
+        start_ttl_worker(self, ttl_interval)
+        self.timer.register("auto_analyze", analyze_interval,
+                            self.auto_analyze_once)
+        self.timer.register("gc", gc_interval, self.run_gc)
+
+    def auto_analyze_once(self, stale_ratio=0.5):
+        """Re-ANALYZE tables whose row count drifted vs collected stats
+        (reference handle/autoanalyze)."""
+        from ..stats.analyze import analyze_tables
+        from ..parser import ast
+        from ..session import Session
+        sess = Session(self)
+        ischema = self.infoschema()
+        n = 0
+        for db in ischema.all_schemas():
+            if db.name.lower() in ("mysql", "information_schema"):
+                continue
+            for t in ischema.tables_in_schema(db.name):
+                if t.view_select:
+                    continue
+                rows = self.table_rows(db.name, t)
+                ts = self.stats.get(t.id)
+                if ts is None or (rows and abs(rows - ts.row_count)
+                                  / max(rows, 1) > stale_ratio):
+                    sess.vars.current_db = db.name
+                    analyze_tables(sess, [ast.TableName(name=t.name,
+                                                        db=db.name)])
+                    n += 1
+        if n:
+            self.inc_metric("auto_analyze_runs", n)
+        return n
+
     def run_gc(self, safepoint=None) -> int:
         """MVCC GC across columnar tables (safepoint default: now)."""
         if safepoint is None:
