@@ -762,3 +762,23 @@ class TestForeignKeys:
         e = ftk.exec_err("update c3 set pid = 5")
         assert e.code == 1452
         ftk.must_exec("update c3 set pid = null")
+
+
+class TestMoreBuiltins:
+    def test_math_trig(self, tk):
+        tk.must_query("select round(pi(), 4), round(degrees(pi()), 0), "
+                      "round(sin(0), 3), round(cos(0), 3)").check(
+            [("3.1416", "180", "0", "1")])
+        tk.must_query("select crc32('abc')").check([(891568578,)])
+
+    def test_string_extras(self, tk):
+        tk.must_query("select hex('AB'), unhex('4142'), bin(5), oct(9)")\
+            .check([("4142", "AB", "101", "11")])
+        tk.must_query("select ascii('A'), repeat('ab', 3), strcmp('a','b'), "
+                      "strcmp('b','a'), strcmp('a','a')").check(
+            [(65, "ababab", -1, 1, 0)])
+        tk.must_query("select md5('x') = 'deaf'").check([(0,)])
+        tk.must_query("select field('b', 'a', 'b', 'c'), elt(2, 'x', 'y')")\
+            .check([(2, "y")])
+        tk.must_query("select conv('ff', 16, 10), conv('10', 10, 2)")\
+            .check([("255", "1010")])

@@ -1563,3 +1563,204 @@ def op_cast_date_to_dt(ctx, expr):
 def op_cast_dt_to_date(ctx, expr):
     a, an, _ = eval_expr(ctx, expr.args[0])
     return a // MICROS_PER_DAY, an, None
+
+
+# ---------------- more math ----------------
+
+@op("pi")
+def op_pi(ctx, expr):
+    return float(np.pi), None, None
+
+
+@op("sin", "cos", "tan", "asin", "acos", "atan", "degrees", "radians")
+def op_trig(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    f = _to_float(ctx, a, expr.args[0].ft)
+    xp = ctx.xp
+    fn = {"sin": xp.sin, "cos": xp.cos, "tan": xp.tan, "asin": xp.arcsin,
+          "acos": xp.arccos, "atan": xp.arctan, "degrees": xp.degrees,
+          "radians": xp.radians}[expr.op]
+    return fn(f), an, None
+
+
+@op("atan2")
+def op_atan2(ctx, expr):
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    fa = _to_float(ctx, a, expr.args[0].ft)
+    fb = _to_float(ctx, b, expr.args[1].ft)
+    return ctx.xp.arctan2(fa, fb), or_nulls(ctx.xp, an, bn), None
+
+
+@op("crc32")
+def op_crc32(ctx, expr):
+    import zlib
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: zlib.crc32(s.encode()) & 0xFFFFFFFF,
+                         out_is_string=False)
+
+
+@op("conv")
+def op_conv(ctx, expr):
+    frm = _const_int(ctx, expr.args[1])
+    to = _const_int(ctx, expr.args[2])
+
+    def f(s):
+        try:
+            v = int(str(s), frm)
+        except ValueError:
+            return "0"
+        if to == 10:
+            return str(v)
+        digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        out = ""
+        n = abs(v)
+        while n:
+            out = digits[n % to] + out
+            n //= to
+        return ("-" if v < 0 else "") + (out or "0")
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+# ---------------- more string/byte functions ----------------
+
+@op("hex")
+def op_hex(ctx, expr):
+    aft = expr.args[0].ft
+    if _dataclass_of(aft) == "string":
+        return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                             lambda s: s.encode().hex().upper())
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return _int_to_str_col(ctx, a, an, lambda v: format(int(v), "X"))
+
+
+def _int_to_str_col(ctx, a, an, fn):
+    if np.isscalar(a):
+        return fn(a), an, None
+    arr = np.asarray(a)
+    out = np.empty(len(arr), dtype=object)
+    for i, v in enumerate(arr):
+        out[i] = fn(v)
+    return out, an, None
+
+
+@op("unhex")
+def op_unhex(ctx, expr):
+    def f(s):
+        try:
+            return bytes.fromhex(s).decode("utf-8", "surrogateescape")
+        except ValueError:
+            return ""
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("bin")
+def op_bin(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return _int_to_str_col(ctx, a, an, lambda v: format(int(v), "b"))
+
+
+@op("oct")
+def op_oct(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return _int_to_str_col(ctx, a, an, lambda v: format(int(v), "o"))
+
+
+@op("ascii", "ord")
+def op_ascii(ctx, expr):
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: ord(s[0]) if s else 0, out_is_string=False)
+
+
+@op("char")
+def op_char(ctx, expr):
+    parts = []
+    nulls = None
+    for a in expr.args:
+        v, an, _ = eval_expr(ctx, a)
+        parts.append(v)
+        nulls = or_nulls(ctx.xp, nulls, an)
+    if all(np.isscalar(p) for p in parts):
+        return "".join(chr(int(p) & 0xFF) for p in parts), nulls, None
+    raise UnknownFunctionError("CHAR over columns unsupported")
+
+
+@op("repeat")
+def op_repeat(ctx, expr):
+    n = _const_int(ctx, expr.args[1])
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: s * max(n, 0))
+
+
+@op("space")
+def op_space(ctx, expr):
+    n = _const_int(ctx, expr.args[0])
+    return " " * max(n, 0), None, None
+
+
+@op("strcmp")
+def op_strcmp(ctx, expr):
+    lt = ScalarFunc("<", expr.args, expr.ft)
+    gt = ScalarFunc(">", expr.args, expr.ft)
+    lv, ln_, _ = eval_expr(ctx, lt)
+    gv, gn, _ = eval_expr(ctx, gt)
+    xp = ctx.xp
+    lv = xp.asarray(lv) if not np.isscalar(lv) else lv
+    r = xp.where(lv, -1, xp.where(xp.asarray(gv), 1, 0)) \
+        if not np.isscalar(lv) else (-1 if lv else (1 if gv else 0))
+    return r, or_nulls(xp, ln_, gn), None
+
+
+@op("field")
+def op_field(ctx, expr):
+    target = eval_expr(ctx, expr.args[0])
+    xp = ctx.xp
+    result = None
+    for i, cand in enumerate(expr.args[1:], start=1):
+        eq = ScalarFunc("=", [expr.args[0], cand], expr.ft)
+        m = eval_bool_mask(ctx, eq)
+        pos = ctx.full(i, dtype=np.int64)
+        if result is None:
+            result = xp.where(m, pos, 0)
+        else:
+            result = xp.where((result == 0) & m, pos, result)
+    return (result if result is not None else 0), None, None
+
+
+@op("elt")
+def op_elt(ctx, expr):
+    idx = _const_int(ctx, expr.args[0])
+    if 1 <= idx < len(expr.args):
+        return eval_expr(ctx, expr.args[idx])
+    return 0, True, None
+
+
+@op("md5")
+def op_md5(ctx, expr):
+    import hashlib
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: hashlib.md5(s.encode()).hexdigest())
+
+
+@op("sha1", "sha")
+def op_sha1(ctx, expr):
+    import hashlib
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]),
+                         lambda s: hashlib.sha1(s.encode()).hexdigest())
+
+
+@op("format")
+def op_format(ctx, expr):
+    d = _const_int(ctx, expr.args[1]) if len(expr.args) > 1 else 0
+    a, an, sd = eval_expr(ctx, expr.args[0])
+    ft = expr.args[0].ft
+    if _dataclass_of(ft) == "decimal":
+        s = _scale_of(ft)
+
+        def f(v):
+            x = int(v) / _POW10[s]
+            return f"{x:,.{max(d, 0)}f}"
+        return _int_to_str_col(ctx, a, an, f)
+    return _int_to_str_col(ctx, a, an,
+                           lambda v: f"{float(v):,.{max(d, 0)}f}")
+
+
