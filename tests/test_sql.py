@@ -782,3 +782,26 @@ class TestMoreBuiltins:
             .check([(2, "y")])
         tk.must_query("select conv('ff', 16, 10), conv('10', 10, 2)")\
             .check([("255", "1010")])
+
+
+class TestSavepoints:
+    def test_savepoint_rollback(self, ftk):
+        ftk.must_exec("create table sv1 (a int)")
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into sv1 values (1)")
+        ftk.must_exec("savepoint s1")
+        ftk.must_exec("insert into sv1 values (2), (3)")
+        ftk.must_query("select count(*) from sv1").check([(3,)])
+        ftk.must_exec("rollback to s1")
+        ftk.must_query("select a from sv1").check([(1,)])
+        ftk.must_exec("insert into sv1 values (9)")
+        ftk.must_exec("commit")
+        ftk.must_query("select a from sv1 order by a").check([(1,), (9,)])
+
+    def test_savepoint_release_and_missing(self, ftk):
+        ftk.must_exec("create table sv2 (a int)")
+        ftk.must_exec("begin")
+        ftk.must_exec("savepoint sa")
+        ftk.must_exec("release savepoint sa")
+        e = ftk.exec_err("rollback to sa")
+        ftk.must_exec("commit")

@@ -441,7 +441,13 @@ class CommitStmt(StmtNode):
 
 @dataclass
 class RollbackStmt(StmtNode):
-    pass
+    to_savepoint: str = ""
+
+
+@dataclass
+class SavepointStmt(StmtNode):
+    name: str = ""
+    release: bool = False
 
 
 @dataclass

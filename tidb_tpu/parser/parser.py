@@ -149,7 +149,17 @@ class Parser:
             return ast.CommitStmt()
         if kw == "rollback":
             self.next()
+            if self.accept_kw("to"):
+                self.accept_kw("savepoint")
+                return ast.RollbackStmt(to_savepoint=self.ident())
             return ast.RollbackStmt()
+        if kw == "savepoint":
+            self.next()
+            return ast.SavepointStmt(name=self.ident())
+        if kw == "release":
+            self.next()
+            self.expect_kw("savepoint")
+            return ast.SavepointStmt(name=self.ident(), release=True)
         if kw == "analyze":
             self.next()
             self.expect_kw("table")

@@ -202,7 +202,22 @@ class Session:
             self.commit()
             return ResultSet()
         if isinstance(stmt, ast.RollbackStmt):
+            if stmt.to_savepoint:
+                txn = self._txn
+                if txn is None or not txn.rollback_to_savepoint(
+                        stmt.to_savepoint):
+                    raise TiDBError("SAVEPOINT %s does not exist",
+                                    stmt.to_savepoint)
+                return ResultSet()
             self.rollback()
+            return ResultSet()
+        if isinstance(stmt, ast.SavepointStmt):
+            txn = self.txn()
+            if stmt.release:
+                if not txn.release_savepoint(stmt.name):
+                    raise TiDBError("SAVEPOINT %s does not exist", stmt.name)
+            else:
+                txn.savepoint(stmt.name)
             return ResultSet()
         if isinstance(stmt, ast.AnalyzeTableStmt):
             from ..stats.analyze import analyze_tables

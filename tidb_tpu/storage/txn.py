@@ -55,6 +55,8 @@ class Transaction:
         self._dirty = False
         self.committed = False
         self.aborted = False
+        self._savepoints: list = []   # [(name, undo_len)]
+        self._undo: list = []         # [(key, had_key, prev_value)]
 
     # ---- buffered reads/writes ---------------------------------------
     def get(self, key: bytes):
@@ -62,13 +64,57 @@ class Transaction:
             return self.mem_buffer.get(key)
         return self.snapshot.get(key)
 
+    def _record_undo(self, key):
+        if not self._savepoints:
+            return
+        had = key in self.mem_buffer
+        self._undo.append((key, had,
+                           self.mem_buffer.get(key) if had else None))
+
     def set(self, key: bytes, value: bytes):
+        self._record_undo(key)
         self.mem_buffer.put(key, value)
         self._dirty = True
 
     def delete(self, key: bytes):
+        self._record_undo(key)
         self.mem_buffer.put(key, None)
         self._dirty = True
+
+    # ---- savepoints (reference pkg/sessiontxn savepoints over the
+    # memBuffer's staging mechanism; here an undo log) ------------------
+    def savepoint(self, name: str):
+        name = name.lower()
+        self._savepoints = [(n, ln) for n, ln in self._savepoints
+                            if n != name]
+        self._savepoints.append((name, len(self._undo)))
+
+    def rollback_to_savepoint(self, name: str) -> bool:
+        name = name.lower()
+        mark = None
+        for i, (n, ln) in enumerate(self._savepoints):
+            if n == name:
+                mark = (i, ln)
+                break
+        if mark is None:
+            return False
+        i, ln = mark
+        while len(self._undo) > ln:
+            key, had, prev = self._undo.pop()
+            if had:
+                self.mem_buffer.put(key, prev)
+            else:
+                self.mem_buffer.delete(key)
+        self._savepoints = self._savepoints[:i + 1]
+        return True
+
+    def release_savepoint(self, name: str) -> bool:
+        name = name.lower()
+        for i, (n, _) in enumerate(self._savepoints):
+            if n == name:
+                self._savepoints = self._savepoints[:i]
+                return True
+        return False
 
     def scan(self, start: bytes, end: bytes | None = None):
         """Merge memBuffer over snapshot (UnionScan semantics,
