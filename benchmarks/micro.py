@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Operator micro-benchmarks (reference pkg/executor/benchmark_test.go:204 +
+pkg/expression/bench_test.go — per-operator throughputs for daily tracking).
+
+Run: python benchmarks/micro.py [rows]
+Prints one line per benchmark: name, rows/s, ms/iter.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    import numpy as np
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch
+
+    tk = TestKit()
+    sf = rows / 6_000_000
+    load_tpch(tk, sf=sf, seed=1,
+              skip_tables=("part", "partsupp", "customer", "supplier"))
+
+    cases = {
+        "scan_filter": "select count(*) from lineitem where l_quantity < 25",
+        "scan_project_agg":
+            "select sum(l_extendedprice * (1 - l_discount)) from lineitem",
+        "group_small_domain":
+            "select l_returnflag, l_linestatus, count(*) from lineitem "
+            "group by l_returnflag, l_linestatus",
+        "group_large_domain":
+            "select l_orderkey, sum(l_quantity) from lineitem "
+            "group by l_orderkey",
+        "join_fk":
+            "select count(*) from lineitem join orders "
+            "on l_orderkey = o_orderkey",
+        "sort_topn":
+            "select l_orderkey from lineitem order by l_extendedprice desc "
+            "limit 100",
+        "window_rank":
+            "select max(r) from (select rank() over (partition by "
+            "l_returnflag order by l_extendedprice) as r from lineitem) x",
+        "string_like":
+            "select count(*) from lineitem where l_shipmode like 'A%'",
+        "date_extract":
+            "select year(l_shipdate), count(*) from lineitem "
+            "group by year(l_shipdate)",
+    }
+    n_li = tk.domain.table_rows(
+        "test", tk.domain.infoschema().table_by_name("test", "lineitem"))
+    print(f"# lineitem rows: {int(n_li)}")
+    for name, sql in cases.items():
+        tk.must_query(sql)          # warm (compile + caches)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            tk.must_query(sql)
+            best = min(best, time.perf_counter() - t0)
+        print(f"{name:24s} {n_li / best / 1e6:9.1f} Mrows/s   "
+              f"{best * 1000:8.1f} ms")
+
+
+if __name__ == "__main__":
+    run()

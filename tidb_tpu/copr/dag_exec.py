@@ -335,17 +335,16 @@ class CoprExecutor:
         mesh = self._get_mesh()
         if mesh is None:
             return None
-        kd, sd = capture_agg_dicts(
-            dag, self._bind_cols(dag, tbl, arrays, slice(0, min(n, 1)),
-                                 handles))
-        strides = _dense_strides(dag, kd)
+        cols_full = self._bind_cols(dag, tbl, arrays, slice(0, n), handles)
+        kd, sd = capture_agg_dicts(dag, cols_full)
+        strides = _dense_strides(dag, kd, cols_full, n)
         if strides is None:
             return None
         ndev = int(mesh.devices.size)
         lane = 128 * ndev
         padded = ((n + lane - 1) // lane) * lane
         local = padded // ndev
-        cols = self._bind_cols(dag, tbl, arrays, slice(0, n), handles)
+        cols = cols_full
         names = sorted(cols.keys())
         args = []
         has_nulls = {}
@@ -416,10 +415,10 @@ class CoprExecutor:
         """Device partial aggregation; returns PartialAggResult."""
         while True:
             kd, sd = capture_agg_dicts(dag, cols)
-            # dense fast path: all group keys are dictionary codes over a
-            # small combined domain -> direct scatter-add (segment_sum over
-            # the dense key product), no sort at all (Q1 shape)
-            strides = _dense_strides(dag, kd)
+            # dense fast path: group keys span a small combined domain
+            # (dict codes, or int keys after a runtime min/max pass) ->
+            # direct scatter-add, no sort (Q1 / year()-grouping shapes)
+            strides = _dense_strides(dag, kd, cols, m)
             if strides is not None:
                 key = self._cache_key(dag, tbl, "dagg", cap, tuple(strides))
                 kern = self._kernel_cache.get(key)
@@ -521,29 +520,60 @@ def _dag_device_ready(dag) -> bool:
     return True
 
 
-_DENSE_MAX = 4096
+_DENSE_MAX = 1 << 18
 
 
-def _dense_strides(dag, key_dicts):
-    """-> per-key domain sizes (+1 null slot) when every group key is a
-    small dictionary code, else None. Dict sizes are stable for the cached
-    kernel because the kernel cache key includes dict versions. A global
-    aggregation is the degenerate dense case (one slot, empty sizes)."""
+def _dense_strides(dag, key_dicts, cols=None, n=0):
+    """-> per-key (size, offset) when the combined group domain is small:
+    dictionary codes (offset 0, size = |dict|+1) or integer keys whose
+    runtime min/max span fits (offset = min). slot 0 per key = NULL. A
+    global aggregation is the degenerate dense case (empty layout)."""
     if not dag.group_items:
         return []
     if len(key_dicts) != len(dag.group_items):
         return None
-    sizes = []
+    layout = []
     total = 1
-    for d in key_dicts:
+    pending = []            # indexes needing a min/max host pass
+    for i, d in enumerate(key_dicts):
         if d is None:
-            return None
-        size = len(d.values) + 1          # slot 0 = NULL
-        sizes.append(size)
+            pending.append(i)
+            layout.append(None)
+            continue
+        size = len(d.values) + 1
+        layout.append((size, 0))
         total *= size
         if total > _DENSE_MAX:
             return None
-    return sizes
+    if pending:
+        if cols is None or n == 0:
+            return None
+        ctx = EvalCtx(np, n, cols, host=True)
+        for i in pending:
+            g = dag.group_items[i]
+            try:
+                data, nulls, sd = eval_expr(ctx, g)
+            except Exception:
+                return None
+            if sd is not None or np.isscalar(data):
+                return None
+            data = np.asarray(data)
+            if data.dtype.kind not in "iu" or len(data) == 0:
+                return None
+            nm = np.asarray(materialize_nulls(ctx, nulls))
+            live = data[~nm] if nm.any() else data
+            if len(live) == 0:
+                lo, hi = 0, 0
+            else:
+                lo, hi = int(live.min()), int(live.max())
+            size = hi - lo + 2
+            if size <= 0:
+                return None
+            layout[i] = (size, lo)
+            total *= size
+            if total > _DENSE_MAX:
+                return None
+    return layout
 
 
 def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
@@ -552,7 +582,7 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
     group_items = list(dag.group_items)
     aggs = list(dag.aggs)
     nslots = 1
-    for s in sizes:
+    for s, _off in sizes:
         nslots *= s
 
     @jax.jit
@@ -563,12 +593,13 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
         slot = jnp.zeros(cap, dtype=jnp.int64)
-        for g, size in zip(group_items, sizes):
+        for g, (size, off) in zip(group_items, sizes):
             d, nl, _ = eval_expr(ctx, g)
             if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
                 d = jnp.full(cap, d)
             nm = materialize_nulls(ctx, nl)
-            code = jnp.where(nm, 0, d.astype(jnp.int64) + 1)
+            code = jnp.clip(jnp.where(nm, 0, d.astype(jnp.int64) - off + 1),
+                            0, size - 1)
             slot = slot * size + code
         slot = jnp.where(mask, slot, nslots)      # invalid rows -> spill slot
         states = []
@@ -627,7 +658,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
     group_items = list(dag.group_items)
     aggs = list(dag.aggs)
     nslots = 1
-    for s in sizes:
+    for s, _off in sizes:
         nslots *= s
 
     def frag(*flat):
@@ -648,12 +679,13 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
         slot = jnp.zeros(cap, dtype=jnp.int64)
-        for g, size in zip(group_items, sizes):
+        for g, (size, off) in zip(group_items, sizes):
             d, nl, _ = eval_expr(ctx, g)
             if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
                 d = jnp.full(cap, d)
             nm = materialize_nulls(ctx, nl)
-            code = jnp.where(nm, 0, d.astype(jnp.int64) + 1)
+            code = jnp.clip(jnp.where(nm, 0, d.astype(jnp.int64) - off + 1),
+                            0, size - 1)
             slot = slot * size + code
         slot = jnp.where(mask, slot, nslots)
         states = []
@@ -738,10 +770,10 @@ def _compact_dense(dag, res, sizes, key_dicts, state_dicts):
     keys = []
     key_nulls = []
     rem = slots.copy()
-    for size in reversed(sizes):
+    for size, off in reversed(sizes):
         code = rem % size
         rem = rem // size
-        keys.append(np.where(code == 0, 0, code - 1).astype(np.int64))
+        keys.append(np.where(code == 0, 0, code - 1 + off).astype(np.int64))
         key_nulls.append(code == 0)
     keys.reverse()
     key_nulls.reverse()
