@@ -805,3 +805,41 @@ class TestSavepoints:
         ftk.must_exec("release savepoint sa")
         e = ftk.exec_err("rollback to sa")
         ftk.must_exec("commit")
+
+
+class TestConcurrency:
+    def test_concurrent_oltp_olap(self, ftk):
+        """Race smoke test (reference -race CI runs): writer threads insert
+        while readers aggregate; totals must reconcile at the end."""
+        import threading
+        ftk.must_exec("create table cc (id bigint primary key auto_increment,"
+                      " g int, v int)")
+        errors = []
+        N, T = 120, 3
+
+        def writer(t):
+            try:
+                s = ftk.new_session()
+                for i in range(N):
+                    s.must_exec(f"insert into cc (g, v) values ({t}, {i})")
+            except Exception as e:                     # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                s = ftk.new_session()
+                for _ in range(30):
+                    s.must_query("select g, count(*), sum(v) from cc "
+                                 "group by g")
+            except Exception as e:                     # noqa: BLE001
+                errors.append(e)
+
+        ths = [threading.Thread(target=writer, args=(t,)) for t in range(T)]
+        ths += [threading.Thread(target=reader) for _ in range(2)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert not errors, errors[:2]
+        ftk.must_query("select count(*), sum(v) from cc").check(
+            [(N * T, str(T * (N * (N - 1) // 2)))])

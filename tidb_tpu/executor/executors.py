@@ -892,6 +892,29 @@ class HashJoinExec(Executor):
             return None
         return self._out.pop(0)
 
+    @staticmethod
+    def _combine_keys(bk, pk):
+        """Multi-key: pack into one int64 when combined ranges fit, else
+        fall back to structured void compare."""
+        k = bk.shape[1]
+        los, spans = [], []
+        total_bits = 0
+        for j in range(k):
+            lo = min(bk[:, j].min(initial=0), pk[:, j].min(initial=0))
+            hi = max(bk[:, j].max(initial=0), pk[:, j].max(initial=0))
+            span = int(hi) - int(lo) + 1
+            los.append(int(lo))
+            spans.append(span)
+            total_bits += max(span, 1).bit_length()
+        if total_bits <= 62:
+            bv = np.zeros(len(bk), dtype=np.int64)
+            pv = np.zeros(len(pk), dtype=np.int64)
+            for j in range(k):
+                bv = bv * spans[j] + (bk[:, j] - los[j])
+                pv = pv * spans[j] + (pk[:, j] - los[j])
+            return bv, pv
+        return _void_view(bk), _void_view(pk)
+
     def _join(self):
         plan = self.plan
         build_exec = self.children[plan.build_side]
@@ -946,8 +969,13 @@ class HashJoinExec(Executor):
                                   shared)
         pk, pnull = self._keys_of(probe_exec.schema, probe, probe_keys_e,
                                   shared)
-        bv = _void_view(bk)
-        pv = _void_view(pk)
+        if bk.shape[1] == 1:
+            # single-key: plain int64 compare (structured/void compares are
+            # ~100x slower in searchsorted)
+            bv = bk[:, 0]
+            pv = pk[:, 0]
+        else:
+            bv, pv = self._combine_keys(bk, pk)
         border = np.argsort(bv, kind="stable")
         sbv = bv[border]
         lo = np.searchsorted(sbv, pv, side="left")

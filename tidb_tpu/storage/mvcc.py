@@ -59,18 +59,23 @@ class MVCCStore:
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
 
     # ---- reads --------------------------------------------------------
+    # Reads take the same mutex as commits: the sorted memtable (C++
+    # std::map or python bisect list) is not safe under concurrent
+    # write+read, and ctypes calls release the GIL.
     def get(self, key: bytes, read_ts: int):
-        vers = self._kv.get(key)
-        return vers.get(read_ts) if vers is not None else None
+        with self._mu:
+            vers = self._kv.get(key)
+            return vers.get(read_ts) if vers is not None else None
 
     def scan(self, start: bytes, end: bytes | None, read_ts: int, limit: int = -1):
         out = []
-        for k, vers in self._kv.scan(start, end):
-            v = vers.get(read_ts)
-            if v is not None:
-                out.append((k, v))
-                if 0 < limit <= len(out):
-                    break
+        with self._mu:
+            for k, vers in self._kv.scan(start, end):
+                v = vers.get(read_ts)
+                if v is not None:
+                    out.append((k, v))
+                    if 0 < limit <= len(out):
+                        break
         return out
 
     def latest_commit_ts(self, key: bytes) -> int:
