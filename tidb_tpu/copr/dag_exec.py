@@ -248,6 +248,16 @@ class CoprExecutor:
                 res = self._run_agg_partition(dag, tbl, cols, v, m, cap)
                 out.append(res)
                 continue
+            if dag.topn is not None:
+                idx = self._run_topn_partition(dag, tbl, cols, v, m, cap)
+                chunk_cols = []
+                for sc in dag.cols:
+                    data, nulls, sdict = cols[sc.col.idx]
+                    chunk_cols.append(Column(
+                        sc.col.ft, data[idx],
+                        None if nulls is None else nulls[idx], sdict))
+                out.append(Chunk(chunk_cols))
+                continue
             mask = self._run_filter_partition(dag, tbl, cols, v, m, cap)
             idx = np.nonzero(np.asarray(mask)[:m])[0]
             if dag.limit >= 0:
@@ -409,6 +419,59 @@ class CoprExecutor:
                 hm &= np.asarray(eval_bool_mask(ctx, f))
             return hm
         return np.asarray(mask)
+
+    def _run_topn_partition(self, dag, tbl, cols, v, m, cap):
+        """Fused filter + device top-k over the single sort key; returns
+        host indices of the top rows (<= k) in key order."""
+        (expr, desc), k = dag.topn
+        key = self._cache_key(dag, tbl, "topn", cap,
+                              (expr.fingerprint(), desc, k))
+        kern = self._kernel_cache.get(key)
+        sdicts = {kk: c[2] for kk, c in cols.items()}
+        if kern is None:
+            filters = list(dag.filters)
+
+            @jax.jit
+            def kern(jc, vv):
+                full = {kk: (d, nl, sdicts[kk]) for kk, (d, nl) in jc.items()}
+                ctx = EvalCtx(jnp, cap, full, host=False)
+                mask = vv
+                for f in filters:
+                    mask = mask & eval_bool_mask(ctx, f)
+                d, nl, sd = eval_expr(ctx, expr)
+                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                    d = jnp.full(cap, d)
+                nm = materialize_nulls(ctx, nl)
+                if sd is not None:
+                    ranks = jnp.asarray(sd.ranks())
+                    d = ranks[d]
+                if d.dtype.kind == "f":
+                    kv = d if desc else -d
+                    nullv = jnp.asarray(-np.inf if desc else np.inf)
+                    minus_inf = jnp.asarray(-np.inf)
+                else:
+                    kv = d.astype(jnp.int64)
+                    kv = kv if desc else -kv
+                    nullv = jnp.asarray(-_I64_MAX if desc else _I64_MAX)
+                    minus_inf = jnp.asarray(-_I64_MAX - 1)
+                kv = jnp.where(nm, nullv, kv)
+                kv = jnp.where(mask, kv, minus_inf)
+                _, top_idx = jax.lax.top_k(kv, min(k, cap))
+                cnt = jnp.minimum(jnp.sum(mask.astype(jnp.int64)), k)
+                return top_idx, cnt
+            self._kernel_cache[key] = kern
+        jcols, vv = self._pad_upload(cols, v, m, cap)
+        jc = {kk: (d, nl) for kk, (d, nl, _) in jcols.items()}
+        if dag.host_filters:
+            ctx = EvalCtx(np, m, cols, host=True)
+            hm = np.ones(m, dtype=bool)
+            for f in dag.host_filters:
+                hm &= np.asarray(eval_bool_mask(ctx, f))
+            hmp = np.concatenate([hm, np.zeros(cap - m, dtype=bool)]) \
+                if m != cap else hm
+            vv = vv & jnp.asarray(hmp)
+        top_idx, cnt = kern(jc, vv)
+        return np.asarray(top_idx)[:int(cnt)]
 
     def _run_agg_partition(self, dag, tbl, cols, v, m, cap,
                            group_bucket=1024):

@@ -506,6 +506,12 @@ class GrantStmt(StmtNode):
 
 
 @dataclass
+class TraceStmt(StmtNode):
+    stmt: StmtNode = None
+    format: str = "row"
+
+
+@dataclass
 class KillStmt(StmtNode):
     conn_id: int = 0
 

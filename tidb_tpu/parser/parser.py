@@ -137,6 +137,13 @@ class Parser:
             return self.parse_show()
         if kw in ("explain", "desc", "describe"):
             return self.parse_explain()
+        if kw == "trace":
+            self.next()
+            fmt = "row"
+            if self.accept_kw("format"):
+                self.expect_op("=")
+                fmt = self.next().text.lower()
+            return ast.TraceStmt(stmt=self.parse_stmt(), format=fmt)
         if kw in ("begin",):
             self.next()
             return ast.BeginStmt()

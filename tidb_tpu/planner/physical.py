@@ -60,6 +60,7 @@ class CoprDAG:
     group_items: list = field(default_factory=list)
     aggs: list = field(default_factory=list)        # partial AggDescs
     limit: int = -1                                 # scan-level limit
+    topn: tuple | None = None                       # ((expr, desc), k)
 
 
 class PhysTableReader(PhysPlan):
@@ -291,7 +292,15 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         p.stats_rows = plan.stats_rows
         return p
     if isinstance(plan, TopN):
-        p = PhysTopN(plan.items, plan.offset, plan.count, _phys(plan.child))
+        child = _phys(plan.child)
+        if isinstance(child, PhysTableReader) and not child.dag.aggs and \
+                child.dag.limit < 0 and len(plan.items) == 1 and \
+                plan.offset + plan.count <= 16384 and \
+                is_device_safe(plan.items[0][0]):
+            # per-partition device top-k; the root TopN merges partitions
+            # (reference: copr-pushed TopN under the root TopN)
+            child.dag.topn = (plan.items[0], plan.offset + plan.count)
+        p = PhysTopN(plan.items, plan.offset, plan.count, child)
         p.stats_rows = plan.stats_rows
         return p
     if isinstance(plan, LimitOp):

@@ -181,6 +181,11 @@ class Session:
             return self._exec_dml(stmt, params)
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, ast.TraceStmt):
+            # span-style trace = EXPLAIN ANALYZE over the wrapped statement
+            # (reference executor/trace.go renders span trees the same way)
+            return self._exec_explain(ast.ExplainStmt(stmt=stmt.stmt,
+                                                      analyze=True))
         if isinstance(stmt, ast.UseStmt):
             self.domain.infoschema().schema_by_name(stmt.db)
             self.vars.current_db = stmt.db
