@@ -424,6 +424,9 @@ class CoprExecutor:
         """Fused filter + device top-k over the single sort key; returns
         host indices of the top rows (<= k) in key order."""
         (expr, desc), k = dag.topn
+        if jax.default_backend() == "cpu":
+            # lax.top_k lowers poorly on CPU; numpy argpartition instead
+            return self._topn_host(dag, cols, v, m)
         key = self._cache_key(dag, tbl, "topn", cap,
                               (expr.fingerprint(), desc, k))
         kern = self._kernel_cache.get(key)
@@ -472,6 +475,43 @@ class CoprExecutor:
             vv = vv & jnp.asarray(hmp)
         top_idx, cnt = kern(jc, vv)
         return np.asarray(top_idx)[:int(cnt)]
+
+    def _topn_host(self, dag, cols, v, m):
+        (expr, desc), k = dag.topn
+        ctx = EvalCtx(np, m, cols, host=True)
+        mask = v[:m].copy()
+        for f in dag.filters + dag.host_filters:
+            mask &= np.asarray(eval_bool_mask(ctx, f))
+        d, nl, sd = eval_expr(ctx, expr)
+        if np.isscalar(d):
+            d = np.full(m, d)
+        d = np.asarray(d)
+        nm = np.asarray(materialize_nulls(ctx, nl))
+        if sd is not None:
+            d = sd.ranks()[d]
+        if d.dtype.kind == "f":
+            kv = d if desc else -d
+            nullv = -np.inf if desc else np.inf
+            sentinel = -np.inf
+        else:
+            kv = d.astype(np.int64)
+            kv = kv if desc else -kv
+            # NULLs: last on desc (near-min), first on asc (max);
+            # filtered rows: strictly below every real key. Values chosen
+            # so that negation in argpartition(-kv) cannot overflow.
+            nullv = (-_I64_MAX + 1) if desc else _I64_MAX
+            sentinel = -_I64_MAX
+        kv = np.where(nm, nullv, kv)
+        kv = np.where(mask, kv, sentinel)
+        cnt = min(int(mask.sum()), k)
+        if cnt == 0:
+            return np.empty(0, dtype=np.int64)
+        if k < m:
+            part = np.argpartition(-kv, k)[:k]
+        else:
+            part = np.arange(m)
+        order = part[np.argsort(-kv[part], kind="stable")]
+        return order[:cnt]
 
     def _run_agg_partition(self, dag, tbl, cols, v, m, cap,
                            group_bucket=1024):

@@ -413,7 +413,9 @@ def prune_columns(plan: LogicalPlan, needed: set):
 # ---------------- TopN derivation ----------------
 
 def build_topn(plan: LogicalPlan) -> LogicalPlan:
-    """Limit(Sort(x)) -> TopN(x) (reference rule_topn_push_down.go)."""
+    """Limit(Sort(x)) -> TopN(x), then TopN(Projection(x)) ->
+    Projection(TopN(x)) so the top-k can ride into the coprocessor
+    (reference rule_topn_push_down.go)."""
     plan.children = [build_topn(c) for c in plan.children]
     if isinstance(plan, LimitOp) and isinstance(plan.child, Sort) \
             and plan.count >= 0:
@@ -421,5 +423,28 @@ def build_topn(plan: LogicalPlan) -> LogicalPlan:
         t = TopN(sort.items, plan.offset, plan.count, sort.child)
         t.schema = sort.schema
         t.stats_rows = min(sort.child.stats_rows, float(plan.count + plan.offset))
-        return t
+        return build_topn(t)
+    if isinstance(plan, TopN) and isinstance(plan.child, Projection):
+        proj = plan.child
+        mapping = {sc.col.idx: ex
+                   for sc, ex in zip(proj.schema.cols, proj.exprs)}
+        new_items = [(_subst(e, mapping), d) for e, d in plan.items]
+        if all(_deterministic(e) for e, _ in new_items):
+            t = TopN(new_items, plan.offset, plan.count, proj.child)
+            t.schema = proj.child.schema
+            t.stats_rows = plan.stats_rows
+            proj.children = [build_topn(t)]
+            proj.stats_rows = plan.stats_rows
+            return proj
     return plan
+
+
+_NONDET_OPS = {"rand", "uuid", "sleep"}
+
+
+def _deterministic(e: Expression) -> bool:
+    if isinstance(e, ScalarFunc):
+        if e.op in _NONDET_OPS:
+            return False
+        return all(_deterministic(a) for a in e.args)
+    return True
