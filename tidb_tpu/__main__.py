@@ -1,0 +1,79 @@
+"""CLI: `python -m tidb_tpu` — interactive SQL shell on an embedded store,
+or `--serve [--port N]` to run the MySQL-protocol server
+(reference cmd/tidb-server)."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def repl(domain):
+    from .session import Session
+    sess = Session(domain)
+    sess.vars.current_db = "test"
+    print("tidb_tpu SQL shell (embedded store). \\q to quit.")
+    buf = ""
+    while True:
+        try:
+            prompt = "tidb> " if not buf else "   -> "
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        if line.strip() in ("\\q", "exit", "quit"):
+            return
+        buf += (" " if buf else "") + line
+        if not buf.rstrip().endswith(";"):
+            continue
+        sql, buf = buf, ""
+        try:
+            rs = sess.execute(sql)
+            if rs.names:
+                widths = [max(len(n), 8) for n in rs.names]
+                print(" | ".join(n.ljust(w) for n, w in zip(rs.names, widths)))
+                print("-+-".join("-" * w for w in widths))
+                for row in rs.rows:
+                    print(" | ".join(
+                        ("NULL" if v is None else str(v)).ljust(w)
+                        for v, w in zip(row, widths)))
+                print(f"{len(rs.rows)} row(s)")
+            else:
+                print(f"OK, {rs.affected} row(s) affected")
+        except Exception as e:                       # noqa: BLE001
+            print(f"ERROR: {e}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tidb_tpu")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the MySQL-protocol server")
+    ap.add_argument("--port", type=int, default=4000)
+    ap.add_argument("-e", "--execute", help="run one statement and exit")
+    args = ap.parse_args(argv)
+    from .session import new_store
+    domain = new_store()
+    if args.serve:
+        domain.start_background()
+        from .server import Server
+        srv = Server(domain, port=args.port).start()
+        print(f"listening on 127.0.0.1:{srv.port} (MySQL protocol)")
+        import time
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.shutdown()
+        return
+    if args.execute:
+        from .session import Session
+        sess = Session(domain)
+        sess.vars.current_db = "test"
+        rs = sess.execute(args.execute)
+        for row in rs.rows:
+            print("\t".join("NULL" if v is None else str(v) for v in row))
+        return
+    repl(domain)
+
+
+if __name__ == "__main__":
+    main()

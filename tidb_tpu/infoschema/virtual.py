@@ -35,7 +35,8 @@ def _gen_tables(domain):
         for t in ischema.tables_in_schema(db.name):
             ctab = domain.columnar.tables.get(t.id)
             rows = ctab.live_count() if ctab else 0
-            yield ("def", db.name, t.name, "BASE TABLE", "InnoDB", t.id,
+            ttype = "VIEW" if t.view_select else "BASE TABLE"
+            yield ("def", db.name, t.name, ttype, "InnoDB", t.id,
                    rows, t.comment)
 
 

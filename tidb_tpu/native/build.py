@@ -18,7 +18,9 @@ def load_library(name: str):
         if name in _CACHE:
             return _CACHE[name]
         src = os.path.join(_DIR, f"{name}.cpp")
-        so = os.path.join(_DIR, f"lib{name}.so")
+        out_dir = os.path.join(_DIR, "_build")
+        os.makedirs(out_dir, exist_ok=True)
+        so = os.path.join(out_dir, f"lib{name}.so")
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
