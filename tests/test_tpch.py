@@ -160,3 +160,62 @@ def test_all_queries_device_vs_host(tk, qname):
     finally:
         tk.domain.copr.use_device = True
     assert r_dev == r_host
+
+
+class TestMoreOracles:
+    def test_q12_vs_numpy(self, tk):
+        from tidb_tpu.bench.tpch import Q12
+        lkey = _raw(tk, "lineitem", "l_orderkey")
+        mode = _raw(tk, "lineitem", "l_shipmode")
+        commit = _raw(tk, "lineitem", "l_commitdate")
+        receipt = _raw(tk, "lineitem", "l_receiptdate")
+        ship = _raw(tk, "lineitem", "l_shipdate")
+        okey = _raw(tk, "orders", "o_orderkey")
+        oprio = _raw(tk, "orders", "o_orderpriority")
+        lo = parse_date("1994-01-01")
+        hi = parse_date("1995-01-01")
+        prio = {int(k): p for k, p in zip(okey, oprio)}
+        want = {}
+        for i in range(len(lkey)):
+            if mode[i] not in ("MAIL", "SHIP"):
+                continue
+            if not (commit[i] < receipt[i] and ship[i] < commit[i]
+                    and lo <= receipt[i] < hi):
+                continue
+            p = prio[int(lkey[i])]
+            h, l = want.setdefault(mode[i], [0, 0])
+            if p in ("1-URGENT", "2-HIGH"):
+                want[mode[i]][0] += 1
+            else:
+                want[mode[i]][1] += 1
+        rows = tk.must_query(Q12).rows
+        got = {r[0]: [int(r[1]), int(r[2])] for r in rows}
+        assert got == want
+
+    def test_q14_vs_numpy(self, tk):
+        from tidb_tpu.bench.tpch import Q14
+        pkey = _raw(tk, "part", "p_partkey")
+        ptype = _raw(tk, "part", "p_type")
+        lpart = _raw(tk, "lineitem", "l_partkey")
+        ship = _raw(tk, "lineitem", "l_shipdate")
+        price = _raw(tk, "lineitem", "l_extendedprice")
+        disc = _raw(tk, "lineitem", "l_discount")
+        lo = parse_date("1995-09-01")
+        hi = parse_date("1995-10-01")
+        promo_parts = {int(k) for k, t in zip(pkey, ptype)
+                       if str(t).startswith("PROMO")}
+        num = den = 0
+        for i in range(len(lpart)):
+            if not (lo <= ship[i] < hi):
+                continue
+            rev = int(price[i]) * (100 - int(disc[i]))
+            den += rev
+            if int(lpart[i]) in promo_parts:
+                num += rev
+        rows = tk.must_query(Q14).rows
+        if den == 0:
+            assert rows[0][0] is None
+        else:
+            got = float(rows[0][0])
+            want = 100.0 * num / den
+            assert abs(got - want) < 1e-6 * max(abs(want), 1)
