@@ -333,27 +333,21 @@ def op_div(ctx, expr):
     aft, bft = expr.args[0].ft, expr.args[1].ft
     xp = ctx.xp
     if expr.ft.tclass == TypeClass.DECIMAL:
-        sa, sb = _scale_of(aft), _scale_of(bft)
+        # Compute in float64 and round back to the target scale grid:
+        # rescaling the numerator in int64 overflows once
+        # |a| * 10^(ts-sa+sb) exceeds 2^63 (e.g. Q14's percentage over
+        # SF-scale revenue sums). float64 keeps ~15 significant digits,
+        # comfortably above DECIMAL display needs here; the exact integer
+        # path remains in AVG finalization (host, python ints).
         ts = _scale_of(expr.ft)
-        # a/b at target scale ts: (a * 10^(ts - sa + sb)) / b, rounded
-        k = ts - sa + sb
-        num = _rescale_up(xp, xp.asarray(a, dtype=np.int64), max(k, 0))
-        if k < 0:
-            num = _rescale_down_round(xp, num, -k)
-        bz = b == 0
-        den = xp.where(bz, 1, b)
-        q = num // den
-        r2 = num - q * den
-        # round half away from zero
-        adj = xp.where(2 * xp.abs(r2) >= xp.abs(den),
-                       xp.sign(num) * xp.sign(den), 0)
-        res = q + adj
-        # integer floor-div is toward -inf; fix toward-zero first
-        neg = (xp.sign(num) * xp.sign(den)) < 0
-        qtz = xp.where(neg & (num % den != 0), q + 1, q)
-        rem = num - qtz * den
-        res = qtz + xp.where(2 * xp.abs(rem) >= xp.abs(den),
-                             xp.sign(num) * xp.sign(den), 0)
+        fa = _to_float(ctx, a, aft)
+        fb = _to_float(ctx, b, bft)
+        bz = fb == 0
+        q = fa / xp.where(bz, 1.0, fb)
+        scaled = q * float(_POW10[ts])
+        res = xp.asarray(
+            xp.where(scaled >= 0, xp.floor(scaled + 0.5),
+                     xp.ceil(scaled - 0.5)), dtype=np.int64)
         return res, or_nulls(xp, an, bn, bz if bz is not False else None), None
     fa, fb = _to_float(ctx, a, aft), _to_float(ctx, b, bft)
     bz = fb == 0
