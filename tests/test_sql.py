@@ -843,3 +843,31 @@ class TestConcurrency:
         assert not errors, errors[:2]
         ftk.must_query("select count(*), sum(v) from cc").check(
             [(N * T, str(T * (N * (N - 1) // 2)))])
+
+
+class TestDeviceJoin:
+    def test_device_join_matches_host(self, ftk):
+        import numpy as np
+        ftk.must_exec("create table dj1 (id int, v int)")
+        ftk.must_exec("create table dj2 (id int, w int)")
+        rng = np.random.default_rng(9)
+        rows1 = ",".join(f"({int(a)}, {i})" for i, a in
+                         enumerate(rng.integers(0, 50, 300)))
+        rows2 = ",".join(f"({int(a)}, {i})" for i, a in
+                         enumerate(rng.integers(0, 50, 200)))
+        ftk.must_exec(f"insert into dj1 values {rows1}, (null, 999)")
+        ftk.must_exec(f"insert into dj2 values {rows2}, (null, 998)")
+        queries = [
+            "select count(*), sum(v), sum(w) from dj1 join dj2 "
+            "on dj1.id = dj2.id",
+            "select count(*) from dj1 left join dj2 on dj1.id = dj2.id",
+            "select count(*) from dj1 where id in (select id from dj2)",
+            "select count(*) from dj1 where id not in "
+            "(select dj2.id from dj2 where dj2.id is not null)",
+        ]
+        results = {}
+        for mode in ("host", "device"):
+            ftk.must_exec(f"set @@tidb_join_exec = {mode}")
+            ftk.domain.plan_cache.clear()
+            results[mode] = [ftk.must_query(q).rows for q in queries]
+        assert results["host"] == results["device"]

@@ -816,6 +816,14 @@ class HashAggExec(Executor):
 
 # ---------------- hash join ----------------
 
+def _backend_is_accel():
+    try:
+        import jax
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
 def _void_view(mat: np.ndarray):
     m = np.ascontiguousarray(mat)
     return m.view([("", m.dtype)] * m.shape[1]).ravel()
@@ -976,6 +984,29 @@ class HashJoinExec(Executor):
             pv = pk[:, 0]
         else:
             bv, pv = self._combine_keys(bk, pk)
+
+        mode = str(self.ctx.sv.get("tidb_join_exec"))
+        use_device = (mode == "device" or
+                      (mode == "auto" and _backend_is_accel()))
+        if use_device and bv.dtype == np.int64 and pv.dtype == np.int64 \
+                and not plan.other_conds:
+            from ..ops.device_join import device_join_index
+            if jt in ("semi", "anti"):
+                matched, _ = device_join_index(bv, bnull, pv, pnull,
+                                               semi_only=True)
+                sel = np.nonzero(matched if jt == "semi" else ~matched)[0]
+                return self._emit(probe, sel, None, None)
+            pi, bi = device_join_index(bv, bnull, pv, pnull)
+            if jt in ("semi", "anti"):
+                return self._semi_result(probe, pi, jt)
+            if outer:
+                matched = np.zeros(len(probe), dtype=bool)
+                matched[pi] = True
+                un = np.nonzero(~matched)[0]
+                if len(un):
+                    inner = self._emit(probe, pi, build, bi)
+                    return inner.concat(self._emit(probe, un, None, None))
+            return self._emit(probe, pi, build, bi)
         border = np.argsort(bv, kind="stable")
         sbv = bv[border]
         lo = np.searchsorted(sbv, pv, side="left")

@@ -379,10 +379,13 @@ class Session:
         from ..planner.schema import Schema
         pctx = self._plan_ctx()
         for name, expr_node, is_global, is_system in stmt.assignments:
-            rw = Rewriter(pctx, Schema())
-            e = rw.rewrite(expr_node)
-            d = expr_to_datum(e)
-            v = d.to_py()
+            if isinstance(expr_node, ast.ColumnRef) and not expr_node.table:
+                v = expr_node.name      # bare enum word: SET x = pessimistic
+            else:
+                rw = Rewriter(pctx, Schema())
+                e = rw.rewrite(expr_node)
+                d = expr_to_datum(e)
+                v = d.to_py()
             if is_system:
                 self.vars.set(name, v, is_global=is_global)
             else:
