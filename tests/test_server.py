@@ -131,3 +131,23 @@ def test_wire_error_and_sessions(server):
     finally:
         c1.close()
         c2.close()
+
+
+def test_status_port(server):
+    import json
+    import urllib.request
+    from tidb_tpu.server.status import start_status_server
+    st = start_status_server(server.domain, port=0)
+    try:
+        base = f"http://127.0.0.1:{st.bound_port}"
+        server.domain.inc_metric("unit_test_counter", 3)
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        assert b"tidb_tpu_unit_test_counter 3" in body
+        schema = json.loads(urllib.request.urlopen(
+            f"{base}/schema", timeout=10).read())
+        assert "test" in schema
+        status = json.loads(urllib.request.urlopen(
+            f"{base}/status", timeout=10).read())
+        assert "version" in status
+    finally:
+        st.shutdown()
