@@ -151,3 +151,53 @@ def test_status_port(server):
         assert "version" in status
     finally:
         st.shutdown()
+
+
+def test_binary_protocol_prepared(server):
+    c = MiniClient(server.port, db="test")
+    try:
+        c.query("create table bp (a int primary key, b varchar(10))")
+        c.query("insert into bp values (1,'x'),(2,'y'),(3,'z')")
+        # COM_STMT_PREPARE
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_PREPARE]) +
+                          b"select b from bp where a > ? order by a")
+        ok = c.io.read_packet()
+        assert ok[0] == 0x00
+        sid = int.from_bytes(ok[1:5], "little")
+        n_params = struct.unpack_from("<H", ok, 7)[0]
+        assert n_params == 1
+        for _ in range(n_params):
+            c.io.read_packet()
+        c.io.read_packet()   # eof
+        # COM_STMT_EXECUTE with param a > 1 (longlong)
+        c.io.reset_seq()
+        payload = (bytes([P.COM_STMT_EXECUTE]) +
+                   struct.pack("<I", sid) + b"\x00" +
+                   struct.pack("<I", 1) +
+                   b"\x00" +            # null bitmap
+                   b"\x01" +            # new params bound
+                   struct.pack("<H", 0x08) +
+                   struct.pack("<q", 1))
+        c.io.write_packet(payload)
+        first = c.io.read_packet()
+        assert first[0] != 0xFF, first
+        ncols, _ = c._read_lenenc(first, 0)
+        for _ in range(ncols):
+            c.io.read_packet()
+        c.io.read_packet()   # eof
+        rows = []
+        while True:
+            pkt = c.io.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            # binary row: 0x00 header + null bitmap + lenenc values
+            pos = 1 + (ncols + 9) // 8
+            ln, pos = c._read_lenenc(pkt, pos)
+            rows.append(pkt[pos:pos + ln].decode())
+        assert rows == ["y", "z"]
+        # close
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_CLOSE]) + struct.pack("<I", sid))
+    finally:
+        c.close()

@@ -160,6 +160,86 @@ def column_def(name: str, col_type=0xFD, charset=46, length=1024) -> bytes:
     return bytes(out)
 
 
+def stmt_prepare_ok(stmt_id: int, n_cols: int, n_params: int) -> bytes:
+    return (b"\x00" + struct.pack("<I", stmt_id) +
+            struct.pack("<HH", n_cols, n_params) + b"\x00" +
+            struct.pack("<H", 0))
+
+
+def parse_execute_params(data: bytes, n_params: int):
+    """COM_STMT_EXECUTE payload -> python param values (after the 1-byte
+    command): stmt_id(4) flags(1) iteration(4) [null bitmap, new-bound flag,
+    types, values]."""
+    pos = 0
+    stmt_id = struct.unpack_from("<I", data, pos)[0]
+    pos += 4 + 1 + 4
+    if n_params == 0:
+        return stmt_id, []
+    nb_len = (n_params + 7) // 8
+    null_bitmap = data[pos:pos + nb_len]
+    pos += nb_len
+    new_bound = data[pos]
+    pos += 1
+    types = []
+    if new_bound:
+        for _ in range(n_params):
+            t = struct.unpack_from("<H", data, pos)[0]
+            types.append(t & 0xFF)
+            pos += 2
+    params = []
+    for i in range(n_params):
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            params.append(None)
+            continue
+        t = types[i] if types else 0xFD
+        if t in (0x01,):                       # tiny
+            params.append(struct.unpack_from("<b", data, pos)[0]); pos += 1
+        elif t in (0x02,):                     # short
+            params.append(struct.unpack_from("<h", data, pos)[0]); pos += 2
+        elif t in (0x03,):                     # long
+            params.append(struct.unpack_from("<i", data, pos)[0]); pos += 4
+        elif t in (0x08,):                     # longlong
+            params.append(struct.unpack_from("<q", data, pos)[0]); pos += 8
+        elif t in (0x04,):                     # float
+            params.append(struct.unpack_from("<f", data, pos)[0]); pos += 4
+        elif t in (0x05,):                     # double
+            params.append(struct.unpack_from("<d", data, pos)[0]); pos += 8
+        else:                                  # lenenc string/decimal/etc.
+            ln, pos = _read_lenenc(data, pos)
+            params.append(data[pos:pos + ln].decode("utf-8",
+                                                    "surrogateescape"))
+            pos += ln
+    return stmt_id, params
+
+
+def _read_lenenc(data, pos):
+    b = data[pos]
+    if b < 251:
+        return b, pos + 1
+    if b == 0xFC:
+        return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+    if b == 0xFD:
+        return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+    return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+
+
+def binary_row(values) -> bytes:
+    """Binary-protocol row with every column typed VAR_STRING (lenenc)."""
+    n = len(values)
+    bitmap = bytearray((n + 9) // 8)
+    out = bytearray(b"\x00")
+    for i, v in enumerate(values):
+        if v is None:
+            bitmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+    out += bitmap
+    for v in values:
+        if v is None:
+            continue
+        s = v if isinstance(v, bytes) else str(v).encode()
+        out += lenenc_str(s)
+    return bytes(out)
+
+
 def text_row(values) -> bytes:
     out = bytearray()
     for v in values:

@@ -106,6 +106,43 @@ class Server:
                 sql = pkt[1:].decode("utf-8", "surrogateescape")
                 self._handle_query(sess, io, sql)
                 continue
+            if cmd == P.COM_STMT_PREPARE:
+                sql = pkt[1:].decode("utf-8", "surrogateescape")
+                try:
+                    sid, n_params = sess.prepare_wire(sql)
+                except TiDBError as e:
+                    io.write_packet(P.err_packet(e.code, e.sqlstate, e.msg))
+                    continue
+                io.write_packet(P.stmt_prepare_ok(sid, 0, n_params))
+                for _ in range(n_params):
+                    io.write_packet(P.column_def("?"))
+                if n_params:
+                    io.write_packet(P.eof_packet())
+                continue
+            if cmd == P.COM_STMT_EXECUTE:
+                sid = int.from_bytes(pkt[1:5], "little")
+                entry = sess.stmt_handles.get(sid)
+                if entry is None:
+                    io.write_packet(P.err_packet(1243, "HY000",
+                                                 "Unknown stmt handler"))
+                    continue
+                _, n_params = entry
+                try:
+                    _, params = P.parse_execute_params(pkt[1:], n_params)
+                    rs = sess.execute_wire(sid, params)
+                except TiDBError as e:
+                    io.write_packet(P.err_packet(e.code, e.sqlstate, e.msg))
+                    continue
+                except Exception as e:              # noqa: BLE001
+                    io.write_packet(P.err_packet(1105, "HY000",
+                                                 str(e)[:400]))
+                    continue
+                self._write_resultset(io, rs, binary=True)
+                continue
+            if cmd == P.COM_STMT_CLOSE:
+                sid = int.from_bytes(pkt[1:5], "little")
+                sess.close_wire(sid)
+                continue
             io.write_packet(P.err_packet(1047, "08S01", "unknown command"))
 
     def _handle_query(self, sess: Session, io: P.PacketIO, sql: str):
@@ -117,6 +154,9 @@ class Server:
         except Exception as e:   # internal error -> protocol error packet
             io.write_packet(P.err_packet(1105, "HY000", str(e)[:400]))
             return
+        self._write_resultset(io, rs, binary=False)
+
+    def _write_resultset(self, io, rs, binary):
         if not rs.names:
             io.write_packet(P.ok_packet(
                 affected=rs.affected, last_insert_id=rs.last_insert_id))
@@ -125,9 +165,10 @@ class Server:
         for name in rs.names:
             io.write_packet(P.column_def(name))
         io.write_packet(P.eof_packet())
+        enc = P.binary_row if binary else P.text_row
         for ch in rs.chunks:
             for i in range(len(ch)):
-                io.write_packet(P.text_row(ch.row_py(i)))
+                io.write_packet(enc(ch.row_py(i)))
         io.write_packet(P.eof_packet())
 
 

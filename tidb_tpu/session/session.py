@@ -49,6 +49,8 @@ class Session:
         self.user = "root"
         self.host = "%"
         self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
+        self.stmt_handles: dict = {} # wire stmt_id -> (stmt_ast, n_params)
+        self._next_stmt_id = 0
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -145,6 +147,27 @@ class Session:
             table_stats=lambda tid: self.domain.stats.get(tid),
             check_read=self._check_read,
         )
+
+    def prepare_wire(self, sql: str):
+        """Server-side PREPARE (COM_STMT_PREPARE): -> (stmt_id, n_params)."""
+        from ..parser.parser import Parser
+        p = Parser(sql)
+        stmts = p.parse_stmts()
+        if len(stmts) != 1:
+            raise UnsupportedError("can only prepare a single statement")
+        self._next_stmt_id += 1
+        self.stmt_handles[self._next_stmt_id] = (stmts[0], p.n_params)
+        return self._next_stmt_id, p.n_params
+
+    def execute_wire(self, stmt_id: int, params):
+        entry = self.stmt_handles.get(stmt_id)
+        if entry is None:
+            raise UnsupportedError("unknown statement handle %d", stmt_id)
+        stmt, _ = entry
+        return self._dispatch(stmt, params or None)
+
+    def close_wire(self, stmt_id: int):
+        self.stmt_handles.pop(stmt_id, None)
 
     def check_priv(self, priv, db="", tbl=""):
         self.domain.priv.check(self.user, self.host, priv, db, tbl)
