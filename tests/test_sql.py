@@ -871,3 +871,27 @@ class TestDeviceJoin:
             ftk.domain.plan_cache.clear()
             results[mode] = [ftk.must_query(q).rows for q in queries]
         assert results["host"] == results["device"]
+
+
+class TestSetOpsAndRunaway:
+    def test_except_intersect(self, ftk):
+        ftk.must_exec("create table so1 (a int)")
+        ftk.must_exec("create table so2 (a int)")
+        ftk.must_exec("insert into so1 values (1),(2),(2),(3)")
+        ftk.must_exec("insert into so2 values (2),(4)")
+        ftk.must_query("select a from so1 except select a from so2 "
+                       "order by 1").check([(1,), (3,)])
+        ftk.must_query("select a from so1 intersect select a from so2")\
+            .check([(2,)])
+
+    def test_max_execution_time(self, ftk):
+        ftk.must_exec("create table rt (a int)")
+        ftk.must_exec("insert into rt values (1)")
+        ftk.must_exec("set @@max_execution_time = 60000")
+        ftk.must_query("select * from rt").check([(1,)])  # fast query fine
+        ftk.must_exec("set @@max_execution_time = 0")
+
+    def test_processlist(self, ftk):
+        r = ftk.must_query("show processlist")
+        ids = [int(row[0]) for row in r.rows]
+        assert ftk.sess.conn_id in ids

@@ -17,6 +17,7 @@ from ..errors import QueryKilledError, MemoryQuotaExceededError
 
 class ExecContext:
     def __init__(self, sess):
+        import time as _time
         self.sess = sess
         self.sv = sess.vars
         self.copr = sess.domain.copr
@@ -24,10 +25,19 @@ class ExecContext:
         self.warnings = []
         self.mem_tracker = sess.domain.mem_tracker_factory(
             self.sv.mem_quota_query)
+        limit_ms = int(self.sv.get("max_execution_time"))
+        self.deadline = (_time.time() + limit_ms / 1000.0) if limit_ms else None
 
     def check_killed(self):
         if self.killed:
             raise QueryKilledError("Query execution was interrupted")
+        if self.deadline is not None:
+            import time as _time
+            if _time.time() > self.deadline:
+                self.sess.domain.inc_metric("runaway_queries")
+                raise QueryKilledError(
+                    "Query execution was interrupted, maximum statement "
+                    "execution time exceeded")
 
     def read_ts(self):
         """Snapshot ts for scans: the session txn's start_ts when inside an

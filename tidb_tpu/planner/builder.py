@@ -691,9 +691,10 @@ class PlanBuilder:
                                   "group_by", "having")})
         children = [self.build_select(base)]
         all_flags = []
+        setop_kinds = {op for op, _ in stmt.setops}
+        if setop_kinds - {"union", "union all"}:
+            return self._build_except_intersect(stmt)
         for op, rhs in stmt.setops:
-            if op not in ("union", "union all"):
-                raise UnsupportedError("%s is not supported yet", op.upper())
             children.append(self.build_select(rhs))
             all_flags.append(op == "union all")
         width = len(children[0].schema.visible())
@@ -736,6 +737,34 @@ class PlanBuilder:
                                  _limit_value(stmt.limit.count, -1, self.pctx),
                                  result)
         return result
+
+    def _build_except_intersect(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        """EXCEPT/INTERSECT (MySQL 8.0.31 semantics = DISTINCT): left
+        deduplicated, then anti/semi join on all output columns."""
+        base = ast.SelectStmt(**{k: getattr(stmt, k) for k in
+                                 ("fields", "distinct", "from_clause",
+                                  "where", "group_by", "having")})
+        left = self.build_select(base)
+        for op, rhs_stmt in stmt.setops:
+            right = self.build_select(rhs_stmt)
+            lvis = left.schema.visible()
+            rvis = right.schema.visible()
+            if len(lvis) != len(rvis):
+                from ..errors import TiDBError
+                raise TiDBError("The used SELECT statements have a "
+                                "different number of columns")
+            # dedup left (set semantics)
+            dschema = Schema([SchemaCol(sc.col, sc.name) for sc in lvis])
+            dedup = Aggregation([sc.col for sc in lvis], [], dschema, left)
+            dedup.stats_rows = left.stats_rows * 0.5
+            jt = "anti" if op.startswith("except") else "semi"
+            schema = Schema(list(dschema.cols))
+            join = LJoin(jt, dedup, right, schema)
+            join.stats_rows = dedup.stats_rows * 0.5
+            for lsc, rsc in zip(dschema.cols, rvis):
+                join.eq_conds.append((lsc.col, rsc.col))
+            left = join
+        return left
 
     # ---- DML ----------------------------------------------------------
     def build_insert(self, stmt: ast.InsertStmt) -> InsertPlan:

@@ -55,6 +55,7 @@ class Domain:
         from ..privilege import PrivManager
         self.priv = PrivManager(self)
         self._live_execs: dict = {}       # conn_id -> [ExecContext]
+        self.sessions: dict = {}          # conn_id -> weakref(Session)
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256

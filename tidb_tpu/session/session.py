@@ -49,6 +49,8 @@ class Session:
         self.user = "root"
         self.host = "%"
         self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
+        import weakref
+        domain.sessions[self.conn_id] = weakref.ref(self)
         self.stmt_handles: dict = {} # wire stmt_id -> (stmt_ast, n_params)
         self._next_stmt_id = 0
 

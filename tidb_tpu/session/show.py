@@ -121,8 +121,15 @@ def exec_show(sess, stmt):
                  w.get("msg", "")) for w in sess.vars.warnings]
         return _str_chunk(["Level", "Code", "Message"], rows)
     if kind == "processlist":
-        rows = [(sess.conn_id, "root", "localhost",
-                 sess.vars.current_db or None, "Query", 0, "", None)]
+        rows = []
+        for cid, ref in sorted(sess.domain.sessions.items()):
+            s = ref()
+            if s is None:
+                continue
+            busy = bool(sess.domain._live_execs.get(cid))
+            rows.append((cid, s.user, "localhost",
+                         s.vars.current_db or None,
+                         "Query" if busy else "Sleep", 0, "", None))
         return _str_chunk(["Id", "User", "Host", "db", "Command", "Time",
                            "State", "Info"], rows)
     from ..errors import UnsupportedError
