@@ -895,3 +895,29 @@ class TestSetOpsAndRunaway:
         r = ftk.must_query("show processlist")
         ids = [int(row[0]) for row in r.rows]
         assert ftk.sess.conn_id in ids
+
+
+class TestAdmin:
+    def test_admin_check_table(self, ftk):
+        ftk.must_exec("create table ac (id int primary key, v varchar(10), "
+                      "key idx_v (v))")
+        ftk.must_exec("insert into ac values (1,'a'),(2,'b')")
+        ftk.must_exec("update ac set v = 'bb' where id = 2")
+        ftk.must_exec("delete from ac where id = 1")
+        r = ftk.must_exec("admin check table ac")
+        assert r.affected == 1
+        # corrupt the columnar engine; check must fail
+        tbl = ftk.domain.infoschema().table_by_name("test", "ac")
+        ctab = ftk.domain.columnar.tables[tbl.id]
+        ci = tbl.find_column("v")
+        pos = ctab.handle_pos[2]
+        ctab.data[ci.id][pos] = 0   # wrong dict code
+        e = ftk.exec_err("admin check table ac")
+        assert "mismatch" in str(e)
+
+    def test_global_var_persisted(self, ftk):
+        ftk.must_exec("set @@global.tidb_executor_concurrency = 5")
+        r = ftk.must_query("select variable_value from "
+                           "mysql.global_variables where variable_name = "
+                           "'tidb_executor_concurrency'")
+        assert r.rows == [("5",)]

@@ -506,6 +506,12 @@ class GrantStmt(StmtNode):
 
 
 @dataclass
+class AdminStmt(StmtNode):
+    kind: str = "check_table"     # check_table | show_ddl
+    tables: list = field(default_factory=list)
+
+
+@dataclass
 class TraceStmt(StmtNode):
     stmt: StmtNode = None
     format: str = "row"

@@ -137,6 +137,18 @@ class Parser:
             return self.parse_show()
         if kw in ("explain", "desc", "describe"):
             return self.parse_explain()
+        if kw == "admin":
+            self.next()
+            if self.accept_kw("check"):
+                self.expect_kw("table")
+                tables = [self.parse_table_name()]
+                while self.accept_op(","):
+                    tables.append(self.parse_table_name())
+                return ast.AdminStmt(kind="check_table", tables=tables)
+            if self.accept_kw("show"):
+                self.expect_kw("ddl")
+                return ast.AdminStmt(kind="show_ddl")
+            self.error("unsupported ADMIN command")
         if kw == "trace":
             self.next()
             fmt = "row"

@@ -206,6 +206,16 @@ class Session:
             return self._exec_dml(stmt, params)
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, ast.AdminStmt):
+            if stmt.kind == "check_table":
+                from ..executor.admin import check_table
+                total = 0
+                for tn in stmt.tables:
+                    db = tn.db or self.vars.current_db
+                    tbl = self.domain.infoschema().table_by_name(db, tn.name)
+                    total += check_table(self, tbl, db)
+                return ResultSet(affected=total)
+            return ResultSet()
         if isinstance(stmt, ast.TraceStmt):
             # span-style trace = EXPLAIN ANALYZE over the wrapped statement
             # (reference executor/trace.go renders span trees the same way)
@@ -413,9 +423,25 @@ class Session:
                 v = d.to_py()
             if is_system:
                 self.vars.set(name, v, is_global=is_global)
+                if is_global:
+                    self._persist_global_var(name, v)
             else:
                 self.domain.user_vars[name.lower()] = v
         return ResultSet()
+
+    def _persist_global_var(self, name, v):
+        """GLOBAL sysvars persist to mysql.global_variables (reference
+        domain/sysvar_cache.go)."""
+        try:
+            s = Session(self.domain)
+            s.vars.current_db = "mysql"
+            val = str(int(v)) if isinstance(v, bool) else str(v)
+            s.execute(
+                "insert into global_variables values "
+                f"('{name.lower()}', '{val}') on duplicate key update "
+                f"variable_value = '{val}'")
+        except TiDBError:
+            pass
 
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         inner = stmt.stmt
