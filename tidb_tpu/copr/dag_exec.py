@@ -89,15 +89,20 @@ class CoprExecutor:
         arrays, valid = tbl.snapshot(
             [cid for cid in (self._cid(dag, sc) for sc in dag.cols)
              if cid != -1], read_ts)
-        n = tbl.n
+        n = len(valid)          # snapshot length, not live tbl.n
         if overlay:
             arrays, valid, n = self._apply_overlay(dag, tbl, arrays, valid,
                                                    n, overlay)
         if n == 0:
             return []
         handles = tbl.handle_array()
-        if n != len(handles):
-            handles = np.concatenate([handles, self._overlay_handles])
+        if len(handles) > n and not overlay:
+            handles = handles[:n]       # concurrent append after snapshot
+        elif n != len(handles):
+            handles = np.concatenate([handles[:n - len(self._overlay_handles)]
+                                      if len(handles) + len(self._overlay_handles) != n
+                                      else handles,
+                                      self._overlay_handles])
         if not self.use_device or dag.table_info.id < 0 or \
                 not _dag_device_ready(dag):
             return self._execute_host(dag, tbl, arrays, valid, n, handles)

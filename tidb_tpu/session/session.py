@@ -385,6 +385,22 @@ class Session:
         return ResultSet(names=names, chunks=out_chunks)
 
     def _exec_dml(self, stmt, params=None) -> ResultSet:
+        """DML with autocommit retry on write conflict (reference
+        session.go retry loop under tidb_retry_limit)."""
+        from ..errors import WriteConflictError, TxnRetryableError
+        retries = int(self.vars.get("tidb_retry_limit"))
+        attempt = 0
+        while True:
+            try:
+                return self._exec_dml_once(stmt, params)
+            except (WriteConflictError, TxnRetryableError):
+                attempt += 1
+                if self._explicit_txn or attempt > retries:
+                    raise
+                self._txn = None    # fresh snapshot, re-plan, re-execute
+                self.domain.inc_metric("txn_retry")
+
+    def _exec_dml_once(self, stmt, params=None) -> ResultSet:
         plan = optimize(stmt, self._plan_ctx(params))
         ectx = ExecContext(self)
         txn = self.txn()   # ensure txn exists before write

@@ -107,15 +107,15 @@ class ColumnarTable:
     # ---- mutations ----------------------------------------------------
     def put_row(self, handle: int, datums: list, commit_ts: int = 1):
         """Insert/overwrite one row; an existing version is closed at
-        commit_ts and a new version row appended."""
+        commit_ts and a new version row appended. Row data is fully
+        written BEFORE self.n is bumped so concurrent snapshot readers
+        never see a half-written row."""
         old = self.handle_pos.get(handle)
         if old is not None and self.delete_ts[old] == 0:
             self.delete_ts[old] = commit_ts
         self._ensure(1)
         pos = self.n
-        self.n += 1
         self.handles[pos] = handle
-        self.handle_pos[handle] = pos
         self.insert_ts[pos] = commit_ts
         self.delete_ts[pos] = 0
         cols = self.table_info.columns
@@ -135,6 +135,8 @@ class ColumnarTable:
                 arr[pos] = float(d.val)
             else:
                 arr[pos] = int(d.val)
+        self.n = pos + 1
+        self.handle_pos[handle] = pos
         self.version += 1
 
     def delete_row(self, handle: int, commit_ts: int = 1):
@@ -203,22 +205,29 @@ class ColumnarTable:
     def live_count(self) -> int:
         return int((self.delete_ts[:self.n] == 0).sum())
 
-    def valid_at(self, read_ts: int | None = None) -> np.ndarray:
+    def valid_at(self, read_ts: int | None = None, n: int | None = None
+                 ) -> np.ndarray:
         """MVCC visibility mask: inserted at-or-before read_ts and not yet
         deleted at read_ts (read_ts None = read latest)."""
-        ins = self.insert_ts[:self.n]
-        dele = self.delete_ts[:self.n]
+        if n is None:
+            n = self.n
+        ins = self.insert_ts[:n]
+        dele = self.delete_ts[:n]
         if read_ts is None:
             return dele == 0
         return (ins <= read_ts) & ((dele == 0) | (dele > read_ts))
 
     def snapshot(self, col_ids: list, read_ts: int | None = None):
-        """-> (arrays dict col_id -> (data, nulls|None, dict|None), valid)."""
-        valid = self.valid_at(read_ts)
+        """-> (arrays dict col_id -> (data, nulls|None, dict|None), valid).
+        Captures self.n ONCE so concurrent appends can't produce
+        inconsistent column lengths (copy-on-read consistency: rows below
+        the captured n are immutable apart from delete marks)."""
+        n = self.n
+        valid = self.valid_at(read_ts, n)
         out = {}
         for cid in col_ids:
-            arr = self.data[cid][:self.n]
-            nl = self.nulls[cid][:self.n]
+            arr = self.data[cid][:n]
+            nl = self.nulls[cid][:n]
             out[cid] = (arr, nl if nl.any() else None, self.dicts.get(cid))
         return out, valid
 
@@ -238,9 +247,13 @@ class ColumnarEngine:
     """Routes committed row mutations into per-table columnar deltas."""
 
     def __init__(self, storage, table_info_by_id):
+        import threading
         self.storage = storage
         self.table_info_by_id = table_info_by_id   # callback id -> TableInfo
         self.tables: dict[int, ColumnarTable] = {}
+        # commit hooks run outside the MVCC mutex; concurrent committers
+        # must not interleave put_row/_ensure on the same arrays
+        self._apply_mu = threading.Lock()
         storage.mvcc.commit_hooks.append(self.apply_commit)
 
     def table(self, table_info) -> ColumnarTable:
@@ -256,6 +269,10 @@ class ColumnarEngine:
         self.tables.pop(table_id, None)
 
     def apply_commit(self, commit_ts: int, mutations: list):
+        with self._apply_mu:
+            self._apply_locked(commit_ts, mutations)
+
+    def _apply_locked(self, commit_ts: int, mutations: list):
         for key, value in mutations:
             if not key.startswith(TABLE_PREFIX) or key[9:11] != RECORD_PREFIX_SEP:
                 continue
