@@ -921,3 +921,27 @@ class TestAdmin:
                            "mysql.global_variables where variable_name = "
                            "'tidb_executor_concurrency'")
         assert r.rows == [("5",)]
+
+
+class TestForUpdate:
+    def test_select_for_update_blocks_writer(self, ftk):
+        ftk.must_exec("create table fu (id int primary key, v int)")
+        ftk.must_exec("insert into fu values (1, 10)")
+        ftk.must_exec("begin")
+        ftk.must_query("select * from fu where id = 1 for update")
+        tk2 = ftk.new_session()
+        e = tk2.exec_err("update fu set v = 99 where id = 1")
+        assert isinstance(e, (errors.LockWaitTimeoutError,
+                              errors.WriteConflictError))
+        ftk.must_exec("commit")
+        tk2.must_exec("update fu set v = 99 where id = 1")
+        tk2.must_query("select v from fu").check([(99,)])
+
+    def test_load_data_alias(self, ftk, tmp_path):
+        ftk.must_exec("create table ld (a int, b varchar(5))")
+        p = tmp_path / "x.csv"
+        p.write_text("1,aa\n2,bb\n")
+        ftk.must_exec(f"load data infile '{p}' into table ld "
+                      "fields terminated by ','")
+        ftk.must_query("select * from ld order by a").check(
+            [(1, "aa"), (2, "bb")])

@@ -188,6 +188,22 @@ class Parser:
             return ast.AnalyzeTableStmt(tables=tables)
         if kw == "import":
             return self.parse_import()
+        if kw == "load":
+            self.next()
+            self.expect_kw("data")
+            self.accept_kw("local")
+            self.expect_kw("infile")
+            path = self.next().text
+            self.expect_kw("into")
+            self.expect_kw("table")
+            stmt = ast.ImportStmt(table=self.parse_table_name(), path=path)
+            while self.peek().kind == "IDENT" and not self.at_op(";"):
+                # FIELDS TERMINATED BY ... etc: accept and extract delimiter
+                word = self.next().text.lower()
+                if word == "terminated":
+                    self.expect_kw("by")
+                    stmt.options["delimiter"] = self.next().text
+            return stmt
         if kw == "prepare":
             self.next()
             name = self.ident()

@@ -70,6 +70,7 @@ def optimize(stmt, pctx: PlanContext):
         logical = optimize_logical(logical)
         phys = to_physical(logical, pctx.sess_vars)
         phys.read_tables = frozenset(pctx.read_tables)
+        phys.for_update = stmt.for_update
         return phys
     if isinstance(stmt, ast.InsertStmt):
         plan = builder.build_insert(stmt)

@@ -10,7 +10,7 @@ from .logical import (LogicalPlan, DataSource, Selection, Projection,
 from .builder import ProjShell
 
 
-def optimize_logical(plan: LogicalPlan) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, keep_handles=False) -> LogicalPlan:
     plan = push_down_predicates(plan, [])
     plan = reorder_joins(plan)
     used = {sc.col.idx for sc in plan.schema.cols}

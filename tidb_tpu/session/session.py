@@ -375,6 +375,8 @@ class Session:
         finally:
             ex.close()
             self.domain.unregister_exec(self.conn_id, ectx)
+        if getattr(plan, "for_update", False) and self._explicit_txn:
+            self._lock_for_update(plan, chunks)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
         names = [plan.schema.cols[i].name for i in vis]
         out_chunks = []
@@ -383,6 +385,45 @@ class Session:
             out_chunks.append(Chunk([ch.columns[i] for i in vis]))
         self._finish_stmt()
         return ResultSet(names=names, chunks=out_chunks)
+
+    def _lock_for_update(self, plan, chunks):
+        """SELECT ... FOR UPDATE: acquire pessimistic locks on the result
+        rows' record keys. PointGet plans lock the computed handle; reader
+        plans lock via the hidden _tidb_rowid column when present."""
+        from ..codec.tablecodec import record_key
+        from ..planner.physical import PhysPointGet
+        from ..executor.exec_base import expr_to_datum
+        keys = []
+
+        def walk(p):
+            if isinstance(p, PhysPointGet):
+                if p.handle_expr is not None:
+                    d = expr_to_datum(p.handle_expr)
+                    if not d.is_null:
+                        keys.append(record_key(p.table_info.id, int(d.val)))
+                else:
+                    # lock via the row just read (chunks carry it if found)
+                    for ch in chunks:
+                        pass
+            for c in p.children:
+                walk(c)
+        walk(plan)
+        tables = list(getattr(plan, "read_tables", ()))
+        if not keys and len(tables) == 1:
+            db, tname = tables[0]
+            tbl = self.domain.infoschema().table_by_name(db, tname)
+            if tbl.id > 0 and not tbl.partitions:
+                hidx = None
+                for i, sc in enumerate(plan.schema.cols):
+                    if sc.name == "_tidb_rowid":
+                        hidx = i
+                if hidx is not None:
+                    for ch in chunks:
+                        for i in range(len(ch)):
+                            keys.append(record_key(
+                                tbl.id, int(ch.columns[hidx].data[i])))
+        if keys:
+            self.txn().lock_keys(keys)
 
     def _exec_dml(self, stmt, params=None) -> ResultSet:
         """DML with autocommit retry on write conflict (reference

@@ -57,6 +57,7 @@ class Transaction:
         self.aborted = False
         self._savepoints: list = []   # [(name, undo_len)]
         self._undo: list = []         # [(key, had_key, prev_value)]
+        self._locked_keys: list = []  # pessimistic locks to release
 
     # ---- buffered reads/writes ---------------------------------------
     def get(self, key: bytes):
@@ -144,10 +145,20 @@ class Transaction:
         for k in keys:
             self.storage.mvcc.acquire_pessimistic_lock(
                 k, primary, self.start_ts, for_update_ts)
+            self._locked_keys.append(k)
 
     # ---- 2PC ----------------------------------------------------------
+    def _release_locks(self, written=()):
+        if not self._locked_keys:
+            return
+        leftover = [k for k in self._locked_keys if k not in written]
+        if leftover:
+            self.storage.mvcc.rollback(leftover, self.start_ts)
+        self._locked_keys = []
+
     def commit(self):
         if not self._dirty:
+            self._release_locks()
             self.committed = True
             return
         mutations = [(k, v) for k, v in self.mem_buffer.scan(b"")]
@@ -156,12 +167,14 @@ class Transaction:
         mvcc.prewrite(mutations, primary, self.start_ts)
         commit_ts = self.storage.oracle.get_ts()
         mvcc.commit(mutations, self.start_ts, commit_ts)
+        self._release_locks(written={k for k, _ in mutations})
         self.committed = True
         return commit_ts
 
     def rollback(self):
         keys = [k for k, _ in self.mem_buffer.scan(b"")]
         self.storage.mvcc.rollback(keys, self.start_ts)
+        self._release_locks()
         self.aborted = True
 
     def is_dirty(self):
