@@ -945,3 +945,32 @@ class TestForUpdate:
                       "fields terminated by ','")
         ftk.must_query("select * from ld order by a").check(
             [(1, "aa"), (2, "bb")])
+
+
+class TestWindowFrames:
+    def test_moving_sum_avg(self, ftk):
+        ftk.must_exec("create table wf (g int, v int)")
+        ftk.must_exec("insert into wf values (1,1),(1,2),(1,3),(1,4),(2,10)")
+        ftk.must_query(
+            "select v, sum(v) over (partition by g order by v "
+            "rows between 1 preceding and current row) from wf "
+            "where g = 1 order by v").check([
+                (1, "1"), (2, "3"), (3, "5"), (4, "7")])
+        ftk.must_query(
+            "select v, count(v) over (partition by g order by v "
+            "rows between 1 preceding and 1 following) from wf "
+            "where g = 1 order by v").check([
+                (1, 2), (2, 3), (3, 3), (4, 2)])
+
+    def test_moving_min_max_firstlast(self, ftk):
+        ftk.must_exec("create table wf2 (v int)")
+        ftk.must_exec("insert into wf2 values (5),(1),(4),(2),(3)")
+        ftk.must_query(
+            "select v, min(v) over (order by v rows between 1 preceding "
+            "and 1 following), max(v) over (order by v rows between "
+            "1 preceding and 1 following) from wf2 order by v").check([
+                (1, 1, 2), (2, 1, 3), (3, 2, 4), (4, 3, 5), (5, 4, 5)])
+        ftk.must_query(
+            "select v, first_value(v) over (order by v rows between "
+            "2 preceding and current row) from wf2 order by v").check([
+                (1, 1), (2, 1), (3, 1), (4, 2), (5, 3)])

@@ -125,9 +125,15 @@ class WindowExec(Executor):
             sok = np.ones(n, dtype=bool)
             asd = None
 
-        sorted_out, sorted_nulls = self._fn(
-            name, d, svals, sok, seq, size, part_start, part_end,
-            peer_start, peer_end, part_start_flag, n, ectx)
+        if d.frame is not None and name in ("sum", "avg", "count", "min",
+                                            "max", "first_value",
+                                            "last_value"):
+            sorted_out, sorted_nulls = self._fn_rows_frame(
+                d, svals, sok, part_start, part_end, n)
+        else:
+            sorted_out, sorted_nulls = self._fn(
+                name, d, svals, sok, seq, size, part_start, part_end,
+                peer_start, peer_end, part_start_flag, n, ectx)
 
         # scatter back to input row order
         out = np.empty_like(sorted_out)
@@ -140,6 +146,73 @@ class WindowExec(Executor):
                 nulls = None
         return Column(d.ft, out, nulls, asd if name in (
             "lag", "lead", "first_value", "last_value", "min", "max") else None)
+
+    def _fn_rows_frame(self, d, svals, sok, part_start, part_end, n):
+        """Bounded ROWS frame [i-prec, i+fol] clipped to the partition
+        (reference window frame executor). Sums/counts via prefix sums;
+        min/max via per-row reduction over frame indices (frame width
+        capped)."""
+        _, n_prec, n_fol = d.frame
+        idx = np.arange(n)
+        lo = part_start if n_prec is None else np.maximum(part_start,
+                                                          idx - n_prec)
+        hi_excl = part_end if n_fol is None else np.minimum(part_end,
+                                                            idx + n_fol + 1)
+        empty = hi_excl <= lo
+        name = d.name
+        if name == "first_value":
+            pos = np.clip(lo, 0, max(n - 1, 0))
+            return svals[pos], (~sok[pos]) | empty
+        if name == "last_value":
+            pos = np.clip(hi_excl - 1, 0, max(n - 1, 0))
+            return svals[pos], (~sok[pos]) | empty
+        if name in ("sum", "avg", "count"):
+            acc = np.cumsum(np.where(sok, svals, 0).astype(
+                np.float64 if svals.dtype.kind == "f" else np.int64))
+            cnt = np.cumsum(sok.astype(np.int64))
+            hi_i = np.clip(hi_excl - 1, 0, max(n - 1, 0))
+            lo_base = np.where(lo > 0, lo - 1, 0)
+            s = acc[hi_i] - np.where(lo > 0, acc[lo_base], 0)
+            c = cnt[hi_i] - np.where(lo > 0, cnt[lo_base], 0)
+            s = np.where(empty, 0, s)
+            c = np.where(empty, 0, c)
+            nulls = c == 0
+            if name == "count":
+                return c, None
+            if name == "sum":
+                return self._sum_scale(d, s), nulls
+            if d.ft.tclass == TypeClass.DECIMAL:
+                src = max(d.args[0].ft.decimal, 0) \
+                    if d.args[0].ft.tclass == TypeClass.DECIMAL else 0
+                tgt = max(d.ft.decimal, 0)
+                num = s.astype(np.int64) * _POW10[max(tgt - src, 0)]
+                safe = np.maximum(c, 1)
+                q = num // safe
+                r = num - q * safe
+                q = np.where(2 * np.abs(r) >= safe, q + np.sign(num), q)
+                return q, nulls
+            return s.astype(np.float64) / np.maximum(c, 1), nulls
+        # min/max: reduce over explicit frame offsets (width-capped)
+        prec = 0 if n_prec is None else n_prec
+        fol = 0 if n_fol is None else n_fol
+        if n_prec is None or n_fol is None or prec + fol > 4096:
+            raise UnsupportedError(
+                "ROWS frame too wide for min/max (cap 4096)")
+        if svals.dtype.kind == "f":
+            ident = np.inf if name == "min" else -np.inf
+        else:
+            ident = _I64_MAX if name == "min" else -_I64_MAX
+        filled = np.where(sok, svals, ident)
+        out = np.full(n, ident, dtype=filled.dtype)
+        cnt = np.zeros(n, dtype=np.int64)
+        op = np.minimum if name == "min" else np.maximum
+        for off in range(-prec, fol + 1):
+            j = idx + off
+            valid = (j >= lo) & (j < hi_excl) & (j >= 0) & (j < n)
+            jj = np.clip(j, 0, max(n - 1, 0))
+            out = np.where(valid, op(out, filled[jj]), out)
+            cnt += valid & sok[np.clip(j, 0, max(n - 1, 0))]
+        return out, cnt == 0
 
     def _fn(self, name, d, svals, sok, seq, size, part_start, part_end,
             peer_start, peer_end, part_flag, n, ectx):

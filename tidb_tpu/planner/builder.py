@@ -337,13 +337,36 @@ class PlanBuilder:
                 ex = subst_agg(ex)
             return ex
 
-        def window_mapper(node):
-            if node.frame is not None and not (
-                    node.frame.start == "unbounded_preceding"
-                    and node.frame.end == "current_row"):
+        def parse_frame(node):
+            f = node.frame
+            if f is None:
+                return None
+            if f.start == "unbounded_preceding" and f.end == "current_row":
+                return None            # default semantics
+            if f.unit != "rows":
                 raise UnsupportedError(
-                    "window frame %s..%s not supported yet",
-                    node.frame.start, node.frame.end)
+                    "RANGE frames with offsets not supported yet")
+
+            def bound(s, is_start):
+                if s == "current_row":
+                    return 0
+                if s == "unbounded_preceding":
+                    return None if is_start else None
+                if s == "unbounded_following":
+                    return None
+                n, which = s.rsplit("_", 1)
+                v = int(n)
+                return v if which == "preceding" else -v
+            start = bound(f.start, True)    # rows preceding (None=unbounded)
+            endb = bound(f.end, False)
+            n_prec = start
+            n_fol = (-endb) if endb is not None else None
+            if endb is not None and endb > 0:
+                n_fol = -endb               # "N preceding" as end
+            return ("rows", n_prec, n_fol)
+
+        def window_mapper(node):
+            frame = parse_frame(node)
             args = [rw_window_part(a) for a in node.args
                     if not isinstance(a, ast.Wildcard)]
             part = [rw_window_part(e) for e in node.partition_by]
@@ -351,7 +374,8 @@ class PlanBuilder:
                      for oi in node.order_by]
             ft = window_result_ft(node.name, args)
             col = self._new_col(ft, node.name)
-            desc = WindowDesc(node.name, args, part, order, ft, col)
+            desc = WindowDesc(node.name, args, part, order, ft, col,
+                              frame=frame)
             windows.append(desc)
             # window outputs are computed above the aggregation: keep
             # subst_agg from wrapping them in first_row

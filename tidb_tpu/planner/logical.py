@@ -122,17 +122,23 @@ class TopN(LogicalPlan):
 
 class WindowDesc:
     """One window function instance (reference
-    planner/core/operator/logicalop/logical_window.go WindowFuncDesc)."""
+    planner/core/operator/logicalop/logical_window.go WindowFuncDesc).
+    frame: None = default (RANGE UNBOUNDED..CURRENT with ORDER BY, whole
+    partition without); else ("rows", n_prec|None, n_fol|None) where None
+    means UNBOUNDED on that side."""
 
-    __slots__ = ("name", "args", "partition_by", "order_by", "ft", "out_col")
+    __slots__ = ("name", "args", "partition_by", "order_by", "ft", "out_col",
+                 "frame")
 
-    def __init__(self, name, args, partition_by, order_by, ft, out_col):
+    def __init__(self, name, args, partition_by, order_by, ft, out_col,
+                 frame=None):
         self.name = name
         self.args = args
         self.partition_by = partition_by
         self.order_by = order_by          # [(expr, desc)]
         self.ft = ft
         self.out_col = out_col
+        self.frame = frame
 
     def __repr__(self):
         parts = f"{self.name}({', '.join(map(repr, self.args))}) over("
