@@ -974,3 +974,34 @@ class TestWindowFrames:
             "select v, first_value(v) over (order by v rows between "
             "2 preceding and current row) from wf2 order by v").check([
                 (1, 1), (2, 1), (3, 1), (4, 2), (5, 3)])
+
+
+class TestRecursiveCTE:
+    def test_numbers(self, ftk):
+        ftk.must_query(
+            "with recursive nums (n) as ("
+            "  select 1 union all select n + 1 from nums where n < 5) "
+            "select * from nums order by n").check(
+            [(1,), (2,), (3,), (4,), (5,)])
+
+    def test_hierarchy(self, ftk):
+        ftk.must_exec("create table emp2 (id int, mgr int)")
+        ftk.must_exec("insert into emp2 values (1, null), (2, 1), (3, 1), "
+                      "(4, 2), (5, 4)")
+        ftk.must_query(
+            "with recursive chain (id) as ("
+            "  select id from emp2 where mgr is null "
+            "  union all "
+            "  select emp2.id from emp2 join chain on emp2.mgr = chain.id) "
+            "select count(*) from chain").check([(5,)])
+
+    def test_union_distinct_termination(self, ftk):
+        # cycle: a->b->a; UNION (distinct) must terminate
+        ftk.must_exec("create table edges (src int, dst int)")
+        ftk.must_exec("insert into edges values (1,2),(2,1),(2,3)")
+        ftk.must_query(
+            "with recursive reach (node) as ("
+            "  select 1 union "
+            "  select dst from edges join reach on src = node) "
+            "select node from reach order by node").check(
+            [(1,), (2,), (3,)])

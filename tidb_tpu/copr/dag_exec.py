@@ -81,11 +81,13 @@ class CoprExecutor:
         memBuffer — UnionScan semantics (reference executor/builder.go:1473):
         deleted/updated committed rows are masked out, buffered rows are
         appended before filters run."""
-        if dag.table_info.id < 0:
+        if dag.table_info.id <= -1000:      # INFORMATION_SCHEMA virtual
             tbl = self._materialize_virtual(dag.table_info)
             read_ts = None
         else:
             tbl = self.engine.table(dag.table_info)
+            if dag.table_info.id < 0:
+                read_ts = None              # session temp table: read latest
         arrays, valid = tbl.snapshot(
             [cid for cid in (self._cid(dag, sc) for sc in dag.cols)
              if cid != -1], read_ts)
@@ -103,7 +105,7 @@ class CoprExecutor:
                                       if len(handles) + len(self._overlay_handles) != n
                                       else handles,
                                       self._overlay_handles])
-        if not self.use_device or dag.table_info.id < 0 or \
+        if not self.use_device or dag.table_info.id <= -1000 or \
                 not _dag_device_ready(dag):
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
         if use_mpp and dag.aggs and not overlay and not dag.host_filters \

@@ -117,10 +117,31 @@ class PlanBuilder:
         return ProjShell(sub, schema)
 
     # ---- FROM ---------------------------------------------------------
+    def _temp_datasource(self, info, alias):
+        schema = Schema()
+        for ci in info.public_columns():
+            col = self._new_col(ci.ft, f"{alias}.{ci.name}")
+            schema.append(SchemaCol(col, ci.name, alias))
+        handle_col = self._new_col(new_bigint_type(not_null=True),
+                                   f"{alias}._tidb_rowid")
+        schema.append(SchemaCol(handle_col, "_tidb_rowid", alias,
+                                hidden=True))
+        ds = DataSource(info, "", alias, schema, handle_col)
+        ds.stats_rows = max(float(self.pctx.table_rows("", info)), 1.0)
+        ds.tbl_stats = None
+        ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
+        return ds
+
     def build_datasource(self, tn: ast.TableName) -> DataSource:
         if not tn.db and tn.name.lower() in self.ctes:
-            cols, sel = self.ctes[tn.name.lower()]
+            entry = self.ctes[tn.name.lower()]
+            if entry[0] == "temp":
+                return self._temp_datasource(entry[1], tn.alias or tn.name)
+            cols, sel = entry
             return self._build_named_subplan(sel, tn.alias or tn.name, cols)
+        if not tn.db and tn.name.lower() in self.pctx.temp_tables:
+            return self._temp_datasource(
+                self.pctx.temp_tables[tn.name.lower()], tn.alias or tn.name)
         db = self._resolve_db(tn.db)
         tbl = self.pctx.infoschema.table_by_name(db, tn.name)
         self.pctx.read_tables.add((db, tbl.name))
@@ -216,7 +237,11 @@ class PlanBuilder:
         if stmt.ctes:
             saved_ctes = dict(self.ctes)
             for name, cols, sub in stmt.ctes:
-                self.ctes[name.lower()] = (cols, sub)
+                if _stmt_refs_table(sub, name):
+                    info = self._materialize_recursive_cte(name, cols, sub)
+                    self.ctes[name.lower()] = ("temp", info)
+                else:
+                    self.ctes[name.lower()] = (cols, sub)
         try:
             return self._build_select_inner(stmt)
         finally:
@@ -691,6 +716,72 @@ class PlanBuilder:
         agg.stats_rows = min(p.stats_rows, max(p.stats_rows * 0.1, 1.0))
         return agg, eq_pairs, others, [out_expr]
 
+    def _materialize_recursive_cte(self, name, col_aliases, stmt):
+        """WITH RECURSIVE: iterate seed UNION [ALL] recursive-part at plan
+        time via temp tables (reference cteutil + executor/cte.go seed/
+        recursive iteration; here materialized through run_subquery)."""
+        if self.pctx.make_temp_table is None:
+            raise UnsupportedError("recursive CTE not available here")
+        branches = [ast.SelectStmt(**{k: getattr(stmt, k) for k in
+                                      ("fields", "distinct", "from_clause",
+                                       "where", "group_by", "having",
+                                       "order_by", "limit")})]
+        distinct = False
+        for op, rhs in stmt.setops:
+            branches.append(rhs)
+            if op == "union":
+                distinct = True
+        seeds = [b for b in branches if not _stmt_refs_table(b, name)]
+        recs = [b for b in branches if _stmt_refs_table(b, name)]
+        if not seeds or not recs:
+            raise UnsupportedError("recursive CTE needs seed and "
+                                   "recursive UNION branches")
+        all_rows = []
+        seen = set()
+        fts = None
+        for b in seeds:
+            rows, bfts = self.pctx.run_subquery(b)
+            fts = fts or bfts
+            for r in rows:
+                key = tuple(d.sort_key() for d in r)
+                if distinct:
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                all_rows.append(r)
+        names = (col_aliases if col_aliases else
+                 [f"c{i}" for i in range(len(fts))])
+        frontier = all_rows
+        for _ in range(1000):
+            if not frontier:
+                break
+            self.pctx.make_temp_table(name, fts, names, frontier)
+            new_rows = []
+            try:
+                for b in recs:
+                    rows, _ = self.pctx.run_subquery(b)
+                    new_rows.extend(rows)
+            finally:
+                self.pctx.drop_temp_table(name)
+            fresh = []
+            for r in new_rows:
+                key = tuple(d.sort_key() for d in r)
+                if distinct:
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                fresh.append(r)
+            if not fresh:
+                break
+            all_rows.extend(fresh)
+            frontier = fresh
+        else:
+            raise UnsupportedError("recursive CTE exceeded 1000 iterations")
+        final_name = f"__cte_final_{name.lower()}_{self.pctx.alloc_id()}"
+        info = self.pctx.make_temp_table(final_name, fts, names, all_rows)
+        self.pctx.cacheable = False
+        return info
+
     def _expand_wildcards(self, fields, schema: Schema):
         out = []
         for f in fields:
@@ -914,6 +1005,33 @@ def _limit_value(e, default, pctx=None):
         pctx.cacheable = False
         return int(pctx.params[e.index])
     raise UnsupportedError("non-constant LIMIT")
+
+
+def _stmt_refs_table(stmt: ast.SelectStmt, name: str) -> bool:
+    """Does this select reference `name` anywhere in its FROM trees?"""
+    name = name.lower()
+
+    def walk_from(node):
+        if node is None:
+            return False
+        if isinstance(node, ast.TableName):
+            return not node.db and node.name.lower() == name
+        if isinstance(node, ast.Join):
+            return walk_from(node.left) or walk_from(node.right)
+        if isinstance(node, ast.SubqueryTable):
+            return walk_sel(node.select)
+        return False
+
+    def walk_sel(s):
+        if s is None:
+            return False
+        if walk_from(s.from_clause):
+            return True
+        for _, rhs in s.setops:
+            if walk_from(rhs.from_clause):
+                return True
+        return False
+    return walk_sel(stmt)
 
 
 def _stmt_has_agg(stmt: ast.SelectStmt) -> bool:

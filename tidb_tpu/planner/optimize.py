@@ -16,7 +16,8 @@ class PlanContext:
     def __init__(self, infoschema, sess_vars, current_db="",
                  run_subquery=None, table_rows=None, user_vars=None,
                  now_micros=0, conn_id=1, params=None, table_stats=None,
-                 check_read=None):
+                 check_read=None, temp_tables=None, make_temp_table=None,
+                 drop_temp_table=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
@@ -24,6 +25,9 @@ class PlanContext:
         self._table_rows = table_rows
         self._table_stats = table_stats
         self.check_read = check_read
+        self.temp_tables = temp_tables or {}
+        self.make_temp_table = make_temp_table
+        self.drop_temp_table = drop_temp_table
         self.user_vars = user_vars or {}
         self.now_micros = now_micros
         self.conn_id = conn_id

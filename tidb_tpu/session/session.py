@@ -53,6 +53,8 @@ class Session:
         domain.sessions[self.conn_id] = weakref.ref(self)
         self.stmt_handles: dict = {} # wire stmt_id -> (stmt_ast, n_params)
         self._next_stmt_id = 0
+        self.temp_tables: dict = {}  # name -> TableInfo (negative id)
+        self._next_temp_id = [-2]
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -100,6 +102,9 @@ class Session:
 
     def _execute_stmt(self, stmt, params=None, sql="",
                       cacheable=True) -> ResultSet:
+        for tname in [t for t in self.temp_tables
+                      if t.startswith("__cte_final_")]:
+            self.drop_temp_table(tname)
         self._cur_sql = sql if cacheable else ""
         start = time.time()
         try:
@@ -148,7 +153,33 @@ class Session:
             params=params,
             table_stats=lambda tid: self.domain.stats.get(tid),
             check_read=self._check_read,
+            temp_tables=self.temp_tables,
+            make_temp_table=self.make_temp_table,
+            drop_temp_table=self.drop_temp_table,
         )
+
+    def make_temp_table(self, name: str, fts, col_names, rows):
+        """Materialize rows into a session temp table backed by the
+        columnar engine (negative table id; read-latest)."""
+        from ..models import TableInfo, ColumnInfo
+        from ..chunk.column import py_to_datum_fast
+        tid = self._next_temp_id[0]
+        self._next_temp_id[0] -= 1
+        cols = [ColumnInfo(id=i + 1, name=cn, offset=i, ft=ft.clone())
+                for i, (cn, ft) in enumerate(zip(col_names, fts))]
+        info = TableInfo(id=tid, name=name, columns=cols)
+        from ..storage.columnar import ColumnarTable
+        ctab = ColumnarTable(info)
+        for h, row in enumerate(rows, start=1):
+            ctab.put_row(h, list(row))
+        self.domain.columnar.tables[tid] = ctab
+        self.temp_tables[name.lower()] = info
+        return info
+
+    def drop_temp_table(self, name: str):
+        info = self.temp_tables.pop(name.lower(), None)
+        if info is not None:
+            self.domain.columnar.tables.pop(info.id, None)
 
     def prepare_wire(self, sql: str):
         """Server-side PREPARE (COM_STMT_PREPARE): -> (stmt_id, n_params)."""
