@@ -201,7 +201,7 @@ class CoprExecutor:
                                 sdict)
             if cacheable:
                 self._bind_keys[sc.col.idx] = (
-                    id(tbl), cid, tbl.version, part_slice.start,
+                    tbl.uid, cid, tbl.version, part_slice.start,
                     part_slice.stop)
         return cols
 
@@ -367,7 +367,7 @@ class CoprExecutor:
         has_nulls = {}
         for k in names:
             data, nulls, sdict = cols[k]
-            ck_base = (id(tbl), k, tbl.version, "mpp", ndev, padded)
+            ck_base = (tbl.uid, k, tbl.version, "mpp", ndev, padded)
             args.append(self._dev_put_sharded(ck_base + ("d",), data, mesh,
                                               padded))
             has_nulls[k] = nulls is not None
@@ -398,7 +398,7 @@ class CoprExecutor:
         gfps = tuple(g.fingerprint() for g in dag.group_items)
         afps = tuple(a.fingerprint() for a in dag.aggs)
         colsig = tuple(sorted((sc.col.idx, sc.name) for sc in dag.cols))
-        return (kind, id(tbl), cap, fps, gfps, afps, dict_vers, colsig, extra)
+        return (kind, tbl.uid, cap, fps, gfps, afps, dict_vers, colsig, extra)
 
     def _run_filter_partition(self, dag, tbl, cols, v, m, cap):
         key = self._cache_key(dag, tbl, "filter", cap)

@@ -21,12 +21,19 @@ from ..types.field_type import TypeClass
 from ..types.datum import Datum, Kind
 
 
+_CTAB_UID = [0]
+
+
 class ColumnarTable:
     """Row-versioned columnar store: per-row (insert_ts, delete_ts) arrays
     give MVCC snapshot scans (TiFlash delta-tree role). delete_ts == 0 means
-    live. Updates append a new version row; handle_pos tracks the newest."""
+    live. Updates append a new version row; handle_pos tracks the newest.
+    `uid` is globally unique (cache keys must NOT use id(self): CPython
+    recycles addresses and the kernel/buffer caches would collide)."""
 
     def __init__(self, table_info):
+        _CTAB_UID[0] += 1
+        self.uid = _CTAB_UID[0]
         self.table_info = table_info
         self.n = 0
         self.cap = 0
