@@ -1005,3 +1005,19 @@ class TestRecursiveCTE:
             "  select dst from edges join reach on src = node) "
             "select node from reach order by node").check(
             [(1,), (2,), (3,)])
+
+
+class TestJSONFuncs:
+    def test_json(self, ftk):
+        ftk.must_exec("create table js (doc json)")
+        ftk.must_exec("""insert into js values
+            ('{"a": 1, "b": [10, 20], "s": "x"}'), ('[1,2,3]'), ('oops')""")
+        ftk.must_query("select json_extract(doc, '$.a') from js "
+                       "where json_valid(doc) = 1 and json_length(doc) > 2 "
+                       "order by 1 desc").check([("1",), ("",)])
+        ftk.must_query(
+            "select json_unquote(json_extract(doc, '$.s')) from js "
+            "where json_extract(doc, '$.s') <> ''").check([("x",)])
+        ftk.must_query("select json_extract(doc, '$.b[1]') from js "
+                       "where json_valid(doc) = 1 order by 1")\
+            .check([("",), ("20",)])

@@ -1758,3 +1758,83 @@ def op_format(ctx, expr):
                            lambda v: f"{float(v):,.{max(d, 0)}f}")
 
 
+
+
+# ---------------- JSON (host/dict-table; stored as strings) -------------
+
+def _json_path_get(doc, path):
+    import json as _json
+    try:
+        obj = _json.loads(doc)
+    except Exception:
+        return None
+    if not path.startswith("$"):
+        return None
+    cur = obj
+    import re as _re
+    for part in _re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]",
+                            path[1:]):
+        name, idx = part
+        try:
+            if name:
+                cur = cur[name]
+            else:
+                cur = cur[int(idx)]
+        except (KeyError, IndexError, TypeError):
+            return None
+    return cur
+
+
+@op("json_extract")
+def op_json_extract(ctx, expr):
+    import json as _json
+    path = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if path is None:
+        raise UnknownFunctionError("non-constant JSON path unsupported")
+
+    def f(s):
+        v = _json_path_get(s, path)
+        return "" if v is None else _json.dumps(v)
+    data, nulls, sd = _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+    return data, nulls, sd
+
+
+@op("json_unquote")
+def op_json_unquote(ctx, expr):
+    def f(s):
+        if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+            import json as _json
+            try:
+                return str(_json.loads(s))
+            except Exception:
+                return s
+        return s
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("json_valid")
+def op_json_valid(ctx, expr):
+    import json as _json
+
+    def f(s):
+        try:
+            _json.loads(s)
+            return 1
+        except Exception:
+            return 0
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)
+
+
+@op("json_length")
+def op_json_length(ctx, expr):
+    import json as _json
+
+    def f(s):
+        try:
+            v = _json.loads(s)
+        except Exception:
+            return 0
+        return len(v) if isinstance(v, (list, dict)) else 1
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)

@@ -29,14 +29,15 @@ _STRING_FUNCS = {"lower", "lcase", "upper", "ucase", "concat", "substring",
                  "substr", "mid", "left", "right", "trim", "ltrim", "rtrim",
                  "replace", "reverse", "lpad", "rpad", "cast_char",
                  "hex", "unhex", "bin", "oct", "repeat", "space", "md5",
-                 "sha1", "sha", "format", "conv", "elt", "char"}
+                 "sha1", "sha", "format", "conv", "elt", "char",
+                 "json_extract", "json_unquote"}
 _INT_FUNCS = {"length", "octet_length", "char_length", "character_length",
               "locate", "instr", "year", "month", "day", "dayofmonth",
               "quarter", "dayofweek", "weekday", "dayofyear", "hour",
               "minute", "second", "week", "datediff", "sign",
               "unix_timestamp", "cast_signed", "cast_unsigned", "ceil",
               "ceiling", "floor", "extract", "ascii", "ord", "crc32",
-              "strcmp", "field"}
+              "strcmp", "field", "json_valid", "json_length"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
                 "cast_double", "rand", "pi", "degrees", "radians", "sin",
                 "cos", "tan", "asin", "acos", "atan", "atan2"}
