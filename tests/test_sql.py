@@ -1021,3 +1021,23 @@ class TestJSONFuncs:
         ftk.must_query("select json_extract(doc, '$.b[1]') from js "
                        "where json_valid(doc) = 1 order by 1")\
             .check([("",), ("20",)])
+
+
+class TestMultiTableDelete:
+    def test_delete_join(self, ftk):
+        ftk.must_exec("create table md1 (id int, v int)")
+        ftk.must_exec("create table md2 (ref int)")
+        ftk.must_exec("insert into md1 values (1,10),(2,20),(3,30)")
+        ftk.must_exec("insert into md2 values (1),(3)")
+        ftk.must_exec("delete md1 from md1 join md2 on md1.id = md2.ref")
+        ftk.must_query("select id from md1").check([(2,)])
+        ftk.must_query("select count(*) from md2").check([(2,)])
+
+    def test_delete_both_tables(self, ftk):
+        ftk.must_exec("create table mda (id int)")
+        ftk.must_exec("create table mdb (id int)")
+        ftk.must_exec("insert into mda values (1),(2)")
+        ftk.must_exec("insert into mdb values (2),(9)")
+        ftk.must_exec("delete mda, mdb from mda join mdb on mda.id = mdb.id")
+        ftk.must_query("select id from mda order by id").check([(1,)])
+        ftk.must_query("select id from mdb order by id").check([(9,)])

@@ -180,6 +180,21 @@ class InsertExec:
         table_rt.update_record(txn, tbl, h, old, new)
 
 
+def _multi_delete_rows(schema, chunks, offs, hidx):
+    pos = {sc.col.idx: i for i, sc in enumerate(schema.cols)}
+    out = []
+    seen = set()
+    for ch in chunks:
+        for i in range(len(ch)):
+            h = int(ch.columns[pos[hidx]].data[i])
+            if h in seen:
+                continue
+            seen.add(h)
+            row = [ch.columns[pos[o]].get_datum(i) for o in offs]
+            out.append((h, row))
+    return out
+
+
 def _datum_to_np(d: Datum):
     if d.is_null:
         return np.zeros(1, dtype=np.int64), np.ones(1, dtype=bool), None
@@ -271,6 +286,8 @@ class DeleteExec:
         self.sess = sess
 
     def execute(self) -> int:
+        if self.plan.multi:
+            return self._execute_multi()
         plan = self.plan
         tbl = plan.table_info
         txn = self.sess.txn()
@@ -293,3 +310,26 @@ class DeleteExec:
                 table_rt.remove_record(txn, tbl, handle, row)
                 affected += 1
         return affected
+
+
+def _delete_execute_multi(self):
+    plan = self.plan
+    txn = self.sess.txn()
+    ex = build_executor(self.ctx, plan.select_plan)
+    ex.open()
+    chunks = ex.all_chunks()
+    ex.close()
+    schema = plan.select_plan.schema
+    from .fk import referencing_fks, on_parent_delete
+    affected = 0
+    for tbl, db, offs, hidx in plan.multi:
+        has_children = bool(referencing_fks(self.sess, tbl, db))
+        for h, row in _multi_delete_rows(schema, chunks, offs, hidx):
+            if has_children:
+                on_parent_delete(self.sess, txn, tbl, db, row)
+            table_rt.remove_record(txn, tbl, h, row)
+            affected += 1
+    return affected
+
+
+DeleteExec._execute_multi = _delete_execute_multi

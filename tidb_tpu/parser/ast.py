@@ -293,6 +293,7 @@ class DeleteStmt(StmtNode):
     where: ExprNode | None = None
     order_by: list = field(default_factory=list)
     limit: Limit | None = None
+    targets: list = field(default_factory=list)   # multi-table DELETE t FROM
 
 
 @dataclass

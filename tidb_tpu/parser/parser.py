@@ -564,8 +564,14 @@ class Parser:
 
     def parse_delete(self) -> ast.DeleteStmt:
         self.expect_kw("delete")
+        targets = []
+        if not self.at_kw("from"):
+            targets.append(self.parse_table_name())
+            while self.accept_op(","):
+                targets.append(self.parse_table_name())
         self.expect_kw("from")
-        stmt = ast.DeleteStmt(table_refs=self.parse_table_refs())
+        stmt = ast.DeleteStmt(table_refs=self.parse_table_refs(),
+                              targets=targets)
         if self.accept_kw("where"):
             stmt.where = self.parse_expr()
         stmt.order_by = self.parse_order_by()

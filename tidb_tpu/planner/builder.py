@@ -80,6 +80,9 @@ class DeletePlan:
     table_info: object = None
     db_name: str = ""
     select_plan: object = None      # outputs handle (last col)
+    # multi-table form: [(table_info, db, col offsets in select schema,
+    #   handle col offset)]
+    multi: list = field(default_factory=list)
 
 
 class PlanBuilder:
@@ -927,6 +930,13 @@ class PlanBuilder:
                 plan.on_dup.append((off, rw.rewrite(e), schema))
         return plan
 
+    def _collect_sources(self, node, out):
+        if isinstance(node, ast.TableName):
+            out.append(node)
+        elif isinstance(node, ast.Join):
+            self._collect_sources(node.left, out)
+            self._collect_sources(node.right, out)
+
     def _build_write_source(self, table_refs, where, order_by, limit,
                             for_update=True):
         if not isinstance(table_refs, ast.TableName):
@@ -964,10 +974,43 @@ class PlanBuilder:
         return plan
 
     def build_delete(self, stmt: ast.DeleteStmt) -> DeletePlan:
+        if stmt.targets:
+            return self._build_multi_delete(stmt)
         ds, p = self._build_write_source(stmt.table_refs, stmt.where,
                                          stmt.order_by, stmt.limit)
         return DeletePlan(table_info=ds.table_info, db_name=ds.db_name,
                           select_plan=p)
+
+    def _build_multi_delete(self, stmt: ast.DeleteStmt) -> DeletePlan:
+        """DELETE t1[, t2] FROM <joined refs> WHERE ... (reference
+        multi-table delete, executor/delete.go)."""
+        p = self.build_from(stmt.table_refs)
+        if stmt.where is not None:
+            p = self._apply_where(stmt.where, p)
+        plan = DeletePlan(select_plan=None)
+        ischema = self.pctx.infoschema
+        for tn in stmt.targets:
+            alias = (tn.name if not tn.db else tn.name).lower()
+            # locate this target's columns + handle in the joined schema
+            cols = [sc for sc in p.schema.cols if sc.table == alias]
+            if not cols:
+                raise UnsupportedError("Unknown target table %s in DELETE",
+                                       tn.name)
+            handle_sc = next((sc for sc in cols
+                              if sc.name == "_tidb_rowid"), None)
+            if handle_sc is None:
+                raise UnsupportedError("target %s lacks a row handle",
+                                       tn.name)
+            db = next((sc.db for sc in cols if sc.db), self.pctx.current_db)
+            tbl = ischema.table_by_name(db, tn.name)
+            offs = []
+            for ci in tbl.public_columns():
+                sc = next(s for s in cols
+                          if s.name == ci.name.lower())
+                offs.append(sc.col.idx)
+            plan.multi.append((tbl, db, offs, handle_sc.col.idx))
+        plan.select_plan = p
+        return plan
 
 
 class ProjShell(LogicalPlan):

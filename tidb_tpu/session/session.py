@@ -484,7 +484,12 @@ class Session:
                 self.check_priv("update", plan.db_name, plan.table_info.name)
                 affected = UpdateExec(ectx, plan, self).execute()
             elif isinstance(plan, DeletePlan):
-                self.check_priv("delete", plan.db_name, plan.table_info.name)
+                if plan.multi:
+                    for tbl, db, _, _ in plan.multi:
+                        self.check_priv("delete", db, tbl.name)
+                else:
+                    self.check_priv("delete", plan.db_name,
+                                    plan.table_info.name)
                 affected = DeleteExec(ectx, plan, self).execute()
             else:
                 raise UnsupportedError("bad DML plan")
