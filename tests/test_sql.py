@@ -1041,3 +1041,27 @@ class TestMultiTableDelete:
         ftk.must_exec("delete mda, mdb from mda join mdb on mda.id = mdb.id")
         ftk.must_query("select id from mda order by id").check([(1,)])
         ftk.must_query("select id from mdb order by id").check([(9,)])
+
+
+class TestConstraintsDefaults:
+    def test_check_constraint(self, ftk):
+        ftk.must_exec("create table ck2 (a int, b int, check (a < b))")
+        ftk.must_exec("insert into ck2 values (1, 2)")
+        e = ftk.exec_err("insert into ck2 values (5, 2)")
+        assert e.code == 3819
+        e = ftk.exec_err("update ck2 set a = 99 where a = 1")
+        assert e.code == 3819
+        ftk.must_exec("insert into ck2 values (null, 2)")  # NULL passes
+
+    def test_current_timestamp_default(self, ftk):
+        ftk.must_exec("create table ts1 (id int, created datetime "
+                      "default current_timestamp)")
+        ftk.must_exec("insert into ts1 (id) values (1)")
+        r = ftk.must_query("select created >= '2020-01-01' from ts1")
+        r.check([(1,)])
+
+    def test_varchar_too_long(self, ftk):
+        ftk.must_exec("create table vc (s varchar(3))")
+        e = ftk.exec_err("insert into vc values ('abcdef')")
+        assert isinstance(e, errors.DataTooLongError)
+        ftk.must_exec("insert into vc values ('abc')")

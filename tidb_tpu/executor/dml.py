@@ -52,6 +52,8 @@ class InsertExec:
             if tbl.foreign_keys:
                 from .fk import check_parent_exists
                 check_parent_exists(sess, txn, tbl, row)
+            if tbl.checks:
+                _enforce_checks(sess, tbl, row)
             try:
                 table_rt.add_record(txn, tbl, handle, row)
             except DuplicateKeyError:
@@ -96,26 +98,39 @@ class InsertExec:
 
     def _complete_row(self, cols, src_datums):
         """Distribute provided datums into full row by plan.col_offsets,
-        filling defaults."""
+        filling defaults (incl. CURRENT_TIMESTAMP) and enforcing
+        char-length limits."""
+        import time as _time
         plan = self.plan
         row = [None] * len(cols)
         for off, d in zip(plan.col_offsets, src_datums):
             row[off] = d
         from ..chunk.column import py_to_datum_fast
+        from ..types.field_type import TypeClass
+        from ..errors import DataTooLongError
         out = []
         for i, ci in enumerate(cols):
             d = row[i]
             if d is None:
                 if ci.ft.has_default:
-                    d = py_to_datum_fast(ci.ft.default_value, ci.ft) \
-                        if ci.ft.default_value is not None else NULL
-                elif ci.ft.auto_increment:
-                    d = NULL
-                elif ci.ft.not_null:
-                    d = NULL  # checked in add_record unless auto-filled
+                    dv = ci.ft.default_value
+                    if dv == "__CURRENT_TIMESTAMP__":
+                        d = Datum(Kind.DATETIME,
+                                  int(_time.time() * 1_000_000))
+                    elif dv is not None:
+                        d = py_to_datum_fast(dv, ci.ft)
+                    else:
+                        d = NULL
                 else:
                     d = NULL
-            out.append(coerce_datum(d, ci.ft))
+            d = coerce_datum(d, ci.ft)
+            if ci.ft.tclass == TypeClass.STRING and ci.ft.flen > 0 and \
+                    not d.is_null and isinstance(d.val, str) and \
+                    len(d.val) > ci.ft.flen:
+                if ci.ft.tp in ("char", "varchar"):
+                    raise DataTooLongError(
+                        "Data too long for column '%s'", ci.name)
+            out.append(d)
         return out
 
     def _handle_for(self, tbl, cols, row, alloc):
@@ -178,6 +193,40 @@ class InsertExec:
                 sd, expr.ft)
             new[off] = coerce_datum(d, cols[off].ft)
         table_rt.update_record(txn, tbl, h, old, new)
+
+
+def _enforce_checks(sess, tbl, row):
+    """CHECK constraints evaluated per row (reference
+    pkg/table/constraint.go); error 3819 on violation."""
+    from ..parser import parse_one
+    from ..planner.rewriter import Rewriter
+    from ..planner.schema import Schema, SchemaCol
+    from ..expression import Column as ECol
+    from ..errors import TiDBError
+    for chk in tbl.checks:
+        sel = parse_one(f"select {chk}")
+        pctx = sess._plan_ctx()
+        schema = Schema()
+        cols_ctx = {}
+        for i, ci in enumerate(tbl.public_columns()):
+            col = ECol(idx=pctx.alloc_id(), ft=ci.ft, name=ci.name)
+            schema.append(SchemaCol(col, ci.name, tbl.name))
+            v, nf, sd = _datum_to_np(row[i])
+            cols_ctx[col.idx] = (v, nf, sd)
+        rw = Rewriter(pctx, schema)
+        e = rw.rewrite(sel.fields[0].expr)
+        from ..expression import EvalCtx as _ECtx, eval_bool_mask as _ebm
+        ectx = _ECtx(np, 1, cols_ctx, host=True)
+        ok = bool(np.asarray(_ebm(ectx, e)).reshape(-1)[0])
+        # NULL check result passes (SQL standard)
+        from ..expression.vec import materialize_nulls as _mn
+        from ..expression import eval_expr as _ee
+        _, nl, _ = _ee(ectx, e)
+        isnull = bool(np.asarray(_mn(ectx, nl)).reshape(-1)[0])
+        if not ok and not isnull:
+            err = TiDBError("Check constraint '%s' is violated", chk)
+            err.code = 3819
+            raise err
 
 
 def _multi_delete_rows(schema, chunks, offs, hidx):
@@ -259,6 +308,8 @@ class UpdateExec:
                 if tbl.foreign_keys:
                     from .fk import check_parent_exists
                     check_parent_exists(sess, txn, tbl, new)
+                if tbl.checks:
+                    _enforce_checks(sess, tbl, new)
                 from .fk import referencing_fks, on_parent_delete
                 if referencing_fks(sess, tbl, plan.db_name):
                     # key change on a referenced parent: treat as delete-check

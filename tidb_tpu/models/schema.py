@@ -100,6 +100,7 @@ class TableInfo:
     partitions: dict | None = None
     # FK defs: [{"name","cols","ref_db","ref_table","ref_cols","on_delete"}]
     foreign_keys: list = field(default_factory=list)
+    checks: list = field(default_factory=list)   # CHECK constraint SQL texts
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -132,6 +133,7 @@ class TableInfo:
             "view_select": self.view_select, "view_cols": self.view_cols,
             "partitions": self.partitions,
             "foreign_keys": self.foreign_keys,
+            "checks": self.checks,
         }
 
     @classmethod
@@ -146,7 +148,8 @@ class TableInfo:
             view_select=j.get("view_select", ""),
             view_cols=j.get("view_cols", []),
             partitions=j.get("partitions"),
-            foreign_keys=j.get("foreign_keys", []))
+            foreign_keys=j.get("foreign_keys", []),
+            checks=j.get("checks", []))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

@@ -752,7 +752,11 @@ class Parser:
                 else:
                     self._skip_constraint()
             elif self.at_kw("check"):
+                self.next()
+                start = self.peek().pos
                 self._skip_constraint()
+                stmt.options.setdefault("checks", []).append(
+                    self.sql[start:self.peek().pos].strip())
             else:
                 stmt.columns.append(self.parse_column_def())
             if not self.accept_op(","):

@@ -38,7 +38,17 @@ def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInf
     ft.elems = cd.enum_vals
     if cd.has_default:
         ft.has_default = True
-        ft.default_value = cd.default_value
+        dv = cd.default_value
+        from ..parser import ast as _ast
+        if (isinstance(dv, _ast.FuncCall) and dv.name in (
+                "now", "current_timestamp")) or \
+                (isinstance(dv, _ast.ColumnRef) and
+                 dv.name.lower() in ("current_timestamp", "now")):
+            dv = "__CURRENT_TIMESTAMP__"
+        elif isinstance(dv, _ast.ExprNode):
+            raise UnsupportedError(
+                "only literal / CURRENT_TIMESTAMP defaults supported")
+        ft.default_value = dv
     return ColumnInfo(id=col_id, name=cd.name, offset=offset, ft=ft,
                       comment=cd.comment)
 
@@ -150,6 +160,8 @@ class DDLExecutor:
                     tbl.pk_is_handle = True
                     tbl.pk_col_name = ci.name
                     tbl.indexes = [i for i in tbl.indexes if not i.primary]
+            for chk in stmt.options.get("checks", []):
+                tbl.checks.append(chk)
             for fk in stmt.foreign_keys:
                 ref_db_name = fk.ref_table.db or db_name
                 ref_db = self._db_by_name(m, ref_db_name)
