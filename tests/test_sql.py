@@ -1065,3 +1065,19 @@ class TestConstraintsDefaults:
         e = ftk.exec_err("insert into vc values ('abcdef')")
         assert isinstance(e, errors.DataTooLongError)
         ftk.must_exec("insert into vc values ('abc')")
+
+
+class TestCorrelatedSelectList:
+    def test_scalar_subquery_in_select(self, ftk):
+        ftk.must_exec("create table cs1 (id int, g int)")
+        ftk.must_exec("create table cs2 (g int, v int)")
+        ftk.must_exec("insert into cs1 values (1, 10), (2, 20), (3, 30)")
+        ftk.must_exec("insert into cs2 values (10, 1), (10, 2), (20, 5)")
+        ftk.must_query(
+            "select id, (select sum(v) from cs2 where cs2.g = cs1.g) "
+            "from cs1 order by id").check([
+                (1, "3"), (2, "5"), (3, None)])
+        ftk.must_query(
+            "select id, (select count(*) from cs2 where cs2.g = cs1.g) "
+            "from cs1 order by id").check([
+                (1, 2), (2, 1), (3, 0)])

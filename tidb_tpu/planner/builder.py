@@ -354,6 +354,11 @@ class PlanBuilder:
                 return e
             return e
 
+        # correlated scalar subqueries in the SELECT list decorrelate into
+        # LEFT JOINs against grouped subplans (reference decorrelation for
+        # projection-context subqueries)
+        p = self._decorrelate_select_list(stmt, p)
+
         # window functions (computed after GROUP BY/HAVING, before
         # DISTINCT/ORDER BY — reference logical_window.go build order)
         windows = []
@@ -617,6 +622,87 @@ class PlanBuilder:
             sel.stats_rows = join.stats_rows * 0.25
             return sel
         return None
+
+    def _decorrelate_select_list(self, stmt, p):
+        """Find correlated ScalarSubquery nodes in the select fields; for
+        each, LEFT JOIN a grouped subplan and register the output column
+        as the node's replacement expression."""
+        nodes = []
+
+        def walk(n):
+            if isinstance(n, ast.ScalarSubquery):
+                nodes.append(n)
+            elif isinstance(n, ast.BinaryOp):
+                walk(n.left)
+                walk(n.right)
+            elif isinstance(n, ast.UnaryOp):
+                walk(n.operand)
+            elif isinstance(n, ast.FuncCall):
+                for a in n.args:
+                    walk(a)
+            elif isinstance(n, ast.Case):
+                walk(n.operand)
+                for c, r in n.when_clauses:
+                    walk(c)
+                    walk(r)
+                walk(n.else_clause)
+            elif isinstance(n, ast.Cast):
+                walk(n.expr)
+        for f in stmt.fields:
+            if isinstance(f, ast.SelectField):
+                walk(f.expr)
+        if not nodes:
+            return p
+        repl = getattr(self.pctx, "subquery_replacements", None)
+        if repl is None:
+            repl = self.pctx.subquery_replacements = {}
+        for node in nodes:
+            # correlated? try a throwaway uncorrelated rewrite first
+            try:
+                rw = self._rewriter(Schema())
+                rw.rewrite(node)
+                continue            # uncorrelated: normal plan-time eval
+            except (ColumnNotExistsError, UnsupportedError):
+                pass
+            try:
+                splan, eq_pairs, others, outs = self.build_corr_subquery(
+                    node.subquery, p.schema, out_fields=True)
+            except (ColumnNotExistsError, UnsupportedError):
+                continue            # let the normal path raise its error
+            schema = Schema(list(p.schema.cols) + list(splan.schema.cols))
+            join = LJoin("left", p, splan, schema)
+            join.stats_rows = p.stats_rows
+            for a, b in eq_pairs:
+                join.eq_conds.append((a, b))
+            join.other_conds.extend(others)
+            out = outs[0]
+            # COUNT over an empty correlated group is 0, not NULL: the
+            # left join produces NULL for unmatched rows, so wrap count
+            # outputs in IFNULL(x, 0)
+            if isinstance(splan, Aggregation) and isinstance(out, Column):
+                agg_cols = splan.schema.cols[len(splan.group_items):]
+                for desc, sc in zip(splan.aggs, agg_cols):
+                    if sc.col.idx == out.idx and desc.name == "count":
+                        rw0 = self._rewriter(schema)
+                        out = rw0.mk_func("ifnull",
+                                          [out, const_from_py(0)], out.ft)
+                        break
+            # outer rows without a match read NULL (left join semantics)
+            if not isinstance(out, Column):
+                col = self._new_col(out.ft, repr(out))
+                join.schema.append(SchemaCol(col, repr(out), hidden=True))
+                # materialize via projection-on-top is avoided: agg schema
+                # already carries component cols; wrap in a shell projection
+                proj_exprs = [sc.col for sc in schema.cols] + [out]
+                pschema = Schema(list(schema.cols) +
+                                 [SchemaCol(col, repr(out), hidden=True)])
+                p = Projection(proj_exprs, pschema, join)
+                p.stats_rows = join.stats_rows
+                repl[id(node)] = col
+            else:
+                repl[id(node)] = out
+                p = join
+        return p
 
     def _mk_semi_join(self, jt, p, splan, eq_pairs, others):
         schema = Schema(list(p.schema.cols))

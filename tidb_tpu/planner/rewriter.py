@@ -457,6 +457,9 @@ class Rewriter:
         return Constant(value=datum, ft=ft)
 
     def _rw_ScalarSubquery(self, node: ast.ScalarSubquery):
+        repl = getattr(self.pctx, "subquery_replacements", None)
+        if repl is not None and id(node) in repl:
+            return repl[id(node)]
         rows, fts = self.pctx.run_subquery(node.subquery)
         if len(rows) > 1:
             raise UnsupportedError("Subquery returns more than 1 row")
