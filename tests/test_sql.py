@@ -1081,3 +1081,25 @@ class TestCorrelatedSelectList:
             "select id, (select count(*) from cs2 where cs2.g = cs1.g) "
             "from cs1 order by id").check([
                 (1, 2), (2, 1), (3, 0)])
+
+
+class TestSequences:
+    def test_sequence_basics(self, ftk):
+        ftk.must_exec("create sequence seq1 start with 10 increment by 2 "
+                      "cache 5")
+        ftk.must_query("select nextval(seq1)").check([(10,)])
+        ftk.must_query("select nextval(seq1), lastval(seq1)")
+        ftk.must_query("select nextval(seq1)").check([(14,)])
+        ftk.must_exec("create table st1 (id bigint primary key, v int)")
+        ftk.must_exec("insert into st1 values (nextval(seq1), 1), "
+                      "(nextval(seq1), 2)")
+        ftk.must_query("select id from st1 order by id").check(
+            [(16,), (18,)])
+        ftk.must_exec("drop sequence seq1")
+        e = ftk.exec_err("select nextval(seq1)")
+
+    def test_sequence_cache_persistence(self, ftk):
+        ftk.must_exec("create sequence s2 cache 3")
+        vals = [ftk.must_query("select nextval(s2)").rows[0][0]
+                for _ in range(7)]
+        assert vals == [1, 2, 3, 4, 5, 6, 7]

@@ -101,6 +101,8 @@ class TableInfo:
     # FK defs: [{"name","cols","ref_db","ref_table","ref_cols","on_delete"}]
     foreign_keys: list = field(default_factory=list)
     checks: list = field(default_factory=list)   # CHECK constraint SQL texts
+    # sequence object: {"start","increment","cache","value"(next unalloc)}
+    sequence: dict | None = None
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -134,6 +136,7 @@ class TableInfo:
             "partitions": self.partitions,
             "foreign_keys": self.foreign_keys,
             "checks": self.checks,
+            "sequence": self.sequence,
         }
 
     @classmethod
@@ -149,7 +152,8 @@ class TableInfo:
             view_cols=j.get("view_cols", []),
             partitions=j.get("partitions"),
             foreign_keys=j.get("foreign_keys", []),
-            checks=j.get("checks", []))
+            checks=j.get("checks", []),
+            sequence=j.get("sequence"))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

@@ -342,6 +342,21 @@ class CreateTableStmt(StmtNode):
 
 
 @dataclass
+class CreateSequenceStmt(StmtNode):
+    name: TableName = None
+    start: int = 1
+    increment: int = 1
+    cache: int = 1000
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequenceStmt(StmtNode):
+    name: TableName = None
+    if_exists: bool = False
+
+
+@dataclass
 class CreateViewStmt(StmtNode):
     view: TableName = None
     columns: list = field(default_factory=list)

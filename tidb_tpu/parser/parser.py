@@ -639,6 +639,32 @@ class Parser:
 
     def parse_create(self):
         self.expect_kw("create")
+        if self.accept_kw("sequence"):
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            stmt = ast.CreateSequenceStmt(name=self.parse_table_name(),
+                                          if_not_exists=ine)
+            while self.peek().kind == "IDENT" and not self.at_op(";"):
+                w = self.next().text.lower()
+                if w == "start":
+                    self.accept_kw("with")
+                    self.accept_op("=")
+                    stmt.start = int(self.next().text)
+                elif w == "increment":
+                    self.accept_kw("by")
+                    self.accept_op("=")
+                    stmt.increment = int(self.next().text)
+                elif w == "cache":
+                    self.accept_op("=")
+                    stmt.cache = int(self.next().text)
+                elif w in ("minvalue", "maxvalue"):
+                    self.next()
+                elif w in ("nocycle", "cycle", "nocache"):
+                    pass
+            return stmt
         if self.accept_kw("user"):
             ine = False
             if self.accept_kw("if"):
@@ -930,6 +956,13 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("sequence"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            return ast.DropSequenceStmt(name=self.parse_table_name(),
+                                        if_exists=ie)
         if self.accept_kw("user"):
             ie = False
             if self.accept_kw("if"):

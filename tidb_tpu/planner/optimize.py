@@ -17,7 +17,7 @@ class PlanContext:
                  run_subquery=None, table_rows=None, user_vars=None,
                  now_micros=0, conn_id=1, params=None, table_stats=None,
                  check_read=None, temp_tables=None, make_temp_table=None,
-                 drop_temp_table=None):
+                 drop_temp_table=None, seq_nextval=None, seq_lastval=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
@@ -28,6 +28,8 @@ class PlanContext:
         self.temp_tables = temp_tables or {}
         self.make_temp_table = make_temp_table
         self.drop_temp_table = drop_temp_table
+        self.seq_nextval = seq_nextval
+        self.seq_lastval = seq_lastval
         self.user_vars = user_vars or {}
         self.now_micros = now_micros
         self.conn_id = conn_id

@@ -369,6 +369,20 @@ class Rewriter:
             return const_from_py(self.pctx.conn_id)
         if name == "last_insert_id" and not node.args:
             return const_from_py(self.pctx.sess_vars.last_insert_id)
+        if name in ("nextval", "lastval") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.ColumnRef):
+                sdb, sname = arg.table or self.pctx.current_db, arg.name
+            elif isinstance(arg, ast.Literal):
+                sdb, sname = self.pctx.current_db, str(arg.value)
+            else:
+                raise UnsupportedError("bad sequence reference")
+            self.pctx.cacheable = False
+            fn = getattr(self.pctx, "seq_" + name, None)
+            if fn is None:
+                raise UnsupportedError("sequences not available here")
+            v = fn(sdb, sname)
+            return const_from_py(v) if v is not None else const_null()
         if name in ("date_add", "date_sub", "adddate", "subdate"):
             base = self.rewrite(node.args[0])
             ivnode = node.args[1]

@@ -241,6 +241,38 @@ class DDLExecutor:
             return tbl
         self._with_meta(fn)
 
+    def create_sequence(self, stmt: ast.CreateSequenceStmt):
+        db_name = stmt.name.db or self.sess.vars.current_db
+
+        def fn(m):
+            db = self._db_by_name(m, db_name)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.name.name.lower():
+                    if stmt.if_not_exists:
+                        return
+                    raise TableExistsError("Table '%s' already exists",
+                                           stmt.name.name)
+            tbl = TableInfo(id=m.gen_global_id(), name=stmt.name.name,
+                            sequence={"start": stmt.start,
+                                      "increment": stmt.increment,
+                                      "cache": max(stmt.cache, 1),
+                                      "value": stmt.start})
+            m.create_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def drop_sequence(self, stmt: ast.DropSequenceStmt):
+        def fn(m):
+            db = self._db_by_name(m, stmt.name.db or
+                                  self.sess.vars.current_db)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.name.name.lower() and t.sequence:
+                    m.drop_table(db.id, t.id)
+                    return
+            if not stmt.if_exists:
+                raise TableNotExistsError("Unknown SEQUENCE '%s'",
+                                          stmt.name.name)
+        self._with_meta(fn)
+
     def create_view(self, stmt: ast.CreateViewStmt):
         db_name = stmt.view.db or self.sess.vars.current_db
         # validate the definition by planning it now

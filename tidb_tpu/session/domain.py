@@ -60,6 +60,48 @@ class Domain:
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256
 
+    def seq_nextval(self, db_name: str, name: str) -> int:
+        """Sequence allocation with cache chunks persisted via meta
+        (reference pkg/meta sequence + docs/design/2020-04-17-sql-sequence)."""
+        from ..meta import Mutator
+        ischema = self.infoschema()
+        tbl = ischema.table_by_name(db_name, name)
+        if not tbl.sequence:
+            from ..errors import TiDBError
+            raise TiDBError("'%s' is not a SEQUENCE", name)
+        cache = getattr(self, "_seq_cache", None)
+        if cache is None:
+            cache = self._seq_cache = {}
+        cur = cache.get(tbl.id)
+        if cur is None or cur[0] >= cur[1]:
+            inc = tbl.sequence["increment"]
+            chunk = tbl.sequence["cache"] * inc
+            txn = self.storage.begin()
+            try:
+                m = Mutator(txn)
+                db = next(d for d in m.list_databases()
+                          if d.name.lower() == db_name.lower())
+                t2 = m.get_table(db.id, tbl.id)
+                start = t2.sequence["value"]
+                t2.sequence["value"] = start + chunk
+                m.update_table(db.id, t2)
+                m.gen_schema_version()
+                txn.commit()
+            except BaseException:
+                txn.rollback()
+                raise
+            cur = [start, start + chunk, inc]
+            cache[tbl.id] = cur
+        v = cur[0]
+        cur[0] += cur[2]
+        self._seq_last = getattr(self, "_seq_last", {})
+        self._seq_last[tbl.id] = v
+        return v
+
+    def seq_lastval(self, db_name: str, name: str):
+        tbl = self.infoschema().table_by_name(db_name, name)
+        return getattr(self, "_seq_last", {}).get(tbl.id)
+
     def register_exec(self, conn_id, ectx):
         self._live_execs.setdefault(conn_id, []).append(ectx)
 

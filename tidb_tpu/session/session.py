@@ -156,6 +156,8 @@ class Session:
             temp_tables=self.temp_tables,
             make_temp_table=self.make_temp_table,
             drop_temp_table=self.drop_temp_table,
+            seq_nextval=self.domain.seq_nextval,
+            seq_lastval=self.domain.seq_lastval,
         )
 
     def make_temp_table(self, name: str, fts, col_names, rows):
@@ -355,6 +357,8 @@ class Session:
             ast.DropDatabaseStmt: self.ddl.drop_database,
             ast.CreateTableStmt: self.ddl.create_table,
             ast.CreateViewStmt: self.ddl.create_view,
+            ast.CreateSequenceStmt: self.ddl.create_sequence,
+            ast.DropSequenceStmt: self.ddl.drop_sequence,
             ast.DropTableStmt: self.ddl.drop_table,
             ast.TruncateTableStmt: self.ddl.truncate_table,
             ast.RenameTableStmt: self.ddl.rename_table,
