@@ -1103,3 +1103,38 @@ class TestSequences:
         vals = [ftk.must_query("select nextval(s2)").rows[0][0]
                 for _ in range(7)]
         assert vals == [1, 2, 3, 4, 5, 6, 7]
+
+
+class TestIndexRange:
+    def test_index_range_scan(self, ftk):
+        ftk.must_exec("create table ir (id int primary key, k int, v int, "
+                      "key idx_k (k))")
+        rows = ",".join(f"({i}, {i % 1000}, {i})" for i in range(1, 5001))
+        ftk.must_exec(f"insert into ir values {rows}")
+        ftk.must_exec("analyze table ir")
+        r = ftk.must_query("explain select v from ir where k = 7")
+        assert any("IndexRange" in row[0] for row in r.rows), r.rows
+        got = ftk.must_query("select v from ir where k = 7 order by v").rows
+        assert got == [(i,) for i in range(7, 5001, 1000)]
+        # range form
+        got = ftk.must_query(
+            "select count(*) from ir where k >= 998 and k <= 999").rows
+        assert got == [(10,)]
+        # residual filter on top of the index range
+        got = ftk.must_query(
+            "select v from ir where k = 7 and v > 3000 order by v").rows
+        assert got == [(3007,), (4007,)]
+
+    def test_index_range_respects_txn(self, ftk):
+        ftk.must_exec("create table ir2 (id int primary key, k int, "
+                      "key ik (k))")
+        rows = ",".join(f"({i}, {i % 100})" for i in range(1, 2001))
+        ftk.must_exec(f"insert into ir2 values {rows}")
+        ftk.must_exec("analyze table ir2")
+        ftk.must_exec("begin")
+        before = ftk.must_query("select count(*) from ir2 where k = 5").rows
+        tk2 = ftk.new_session()
+        tk2.must_exec("insert into ir2 values (9001, 5)")
+        after = ftk.must_query("select count(*) from ir2 where k = 5").rows
+        assert before == after          # snapshot isolation holds
+        ftk.must_exec("commit")

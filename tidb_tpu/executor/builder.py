@@ -1,14 +1,14 @@
 """Executor builder (reference pkg/executor/builder.go:193)."""
 from __future__ import annotations
 
-from ..planner.physical import (PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
+from ..planner.physical import (PhysIndexRange, PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
                                 PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
                                 PhysLimit, PhysUnion, PhysDual, PhysShell,
                                 PhysWindow)
 from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
                         HashAggExec, HashJoinExec, SortExec, TopNExec,
                         LimitExec, UnionExec, DualExec, ShellExec,
-                        PointGetExec)
+                        PointGetExec, IndexRangeExec)
 from .window import WindowExec
 
 
@@ -23,6 +23,8 @@ def build_executor(ctx, plan):
 def _build(ctx, plan):
     if isinstance(plan, PhysPointGet):
         return PointGetExec(ctx, plan)
+    if isinstance(plan, PhysIndexRange):
+        return IndexRangeExec(ctx, plan)
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(ctx, plan)
     if isinstance(plan, PhysSelection):

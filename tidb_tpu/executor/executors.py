@@ -113,6 +113,105 @@ class TableReaderExec(Executor):
         return out
 
 
+class IndexRangeExec(Executor):
+    """Index range scan: scan index KV range at the read ts, collect
+    handles, gather rows from the columnar engine, apply residual filters.
+    Only chosen for fully KV-backed tables (bulk rows lack index KV)."""
+
+    def __init__(self, ctx, plan):
+        super().__init__(ctx, plan.schema)
+        self.plan = plan
+        self._done = False
+
+    def open(self):
+        pass
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.ctx.sess
+        from ..codec.tablecodec import index_prefix, index_key_handle
+        from ..codec.codec import encode_datums_key
+        from .exec_base import expr_to_datum, coerce_datum
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
+        if ctab is None:
+            return empty
+        if ctab.bulk_rows:
+            # safety net: planner shouldn't pick this path, but fall back
+            return self._fallback_scan()
+        ci = tbl.find_column(plan.index.columns[0])
+        pref = index_prefix(tbl.id, plan.index.id)
+        lo = pref
+        if plan.low is not None:
+            d = coerce_datum(expr_to_datum(plan.low), ci.ft)
+            lo = pref + encode_datums_key([d])
+            if not plan.low_inc:
+                lo += b"\xff"
+        hi = pref + b"\xff" * 9
+        if plan.high is not None:
+            d = coerce_datum(expr_to_datum(plan.high), ci.ft)
+            hi = pref + encode_datums_key([d])
+            hi = hi + (b"\xff" * 9 if plan.high_inc else b"")
+        read_ts = self.ctx.read_ts() or sess.domain.storage.current_ts()
+        entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts)
+        handles = []
+        for k, v in entries:
+            if plan.index.unique and v not in (b"",):
+                handles.append(int(v))
+            else:
+                handles.append(index_key_handle(k))
+        if not handles:
+            return empty
+        pos = [ctab.handle_pos.get(h) for h in handles]
+        pos = np.array([p for p in pos
+                        if p is not None and ctab.delete_ts[p] == 0],
+                       dtype=np.int64)
+        if not len(pos):
+            return empty
+        cols = []
+        for sc in self.schema.cols:
+            cinfo = tbl.find_column(sc.name)
+            if cinfo is None:
+                cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
+            else:
+                cols.append(ctab.column_for(cinfo, pos))
+        ch = Chunk(cols)
+        if plan.residual:
+            cols_ctx = bind_chunk(self.schema, ch)
+            ectx = EvalCtx(np, len(ch), cols_ctx, host=True)
+            mask = np.ones(len(ch), dtype=bool)
+            for c in plan.residual:
+                mask &= np.asarray(eval_bool_mask(ectx, c))
+            ch = ch.filter(mask)
+        return ch
+
+    def _fallback_scan(self):
+        from ..planner.physical import CoprDAG
+        dag = CoprDAG(table_info=self.plan.table_info,
+                      db_name=self.plan.db_name, cols=self.plan.cols,
+                      host_filters=list(self.plan.residual))
+        # re-apply the range as filters
+        from ..expression import ScalarFunc
+        from ..types.field_type import new_bigint_type
+        col = next(sc.col for sc in self.plan.cols
+                   if sc.name == self.plan.index.columns[0].lower())
+        if self.plan.low is not None:
+            dag.host_filters.append(ScalarFunc(
+                ">=" if self.plan.low_inc else ">", [col, self.plan.low],
+                new_bigint_type()))
+        if self.plan.high is not None:
+            dag.host_filters.append(ScalarFunc(
+                "<=" if self.plan.high_inc else "<", [col, self.plan.high],
+                new_bigint_type()))
+        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
+        return Chunk.concat_all(chunks) or Chunk.empty(
+            [sc.col.ft for sc in self.schema.cols])
+
+
 class PointGetExec(Executor):
     """O(1) point read: clustered-PK handle -> columnar handle index (or
     row KV for txn-buffered rows); unique index -> index KV -> handle."""

@@ -78,6 +78,30 @@ class PhysTableReader(PhysPlan):
         return s
 
 
+class PhysIndexRange(PhysPlan):
+    """Index range scan -> handle gather (reference IndexReader/IndexLookUp
+    executor/distsql.go; single-column leading prefix ranges, round 1)."""
+
+    def __init__(self, table_info, db_name, cols, index, low, high,
+                 low_inc, high_inc, residual, schema):
+        super().__init__([], schema)
+        self.table_info = table_info
+        self.db_name = db_name
+        self.cols = cols
+        self.index = index
+        self.low = low          # Constant|None
+        self.high = high
+        self.low_inc = low_inc
+        self.high_inc = high_inc
+        self.residual = residual   # remaining filter conjuncts (host eval)
+
+    def explain_info(self):
+        rng = f"{'[' if self.low_inc else '('}{self.low!r}, " \
+              f"{self.high!r}{']' if self.high_inc else ')'}"
+        return (f"table:{self.table_info.name}, index:{self.index.name}, "
+                f"range:{rng}")
+
+
 class PhysPointGet(PhysPlan):
     """Point read via clustered PK handle or unique index (reference
     pkg/executor/point_get.go; planner fast path point_get_plan.go)."""
@@ -325,10 +349,61 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
     raise NotImplementedError(f"no physical impl for {type(plan).__name__}")
 
 
+def _try_index_range(ds: DataSource) -> PhysPlan | None:
+    """Range conds on a single-column index -> index range scan, when the
+    table is fully KV-backed and the range is selective."""
+    tbl = ds.table_info
+    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds:
+        return None
+    stats_rows = getattr(ds, "stats_rows", 0)
+    base_rows = None
+    # selective enough? (post-selectivity estimate vs a fraction)
+    indexed_cols = {}
+    for idx in tbl.indexes:
+        if len(idx.columns) >= 1:
+            indexed_cols.setdefault(idx.columns[0].lower(), idx)
+    low = high = None
+    low_inc = high_inc = True
+    target_idx = None
+    residual = []
+    for c in ds.pushed_conds:
+        used = False
+        if isinstance(c, ScalarFunc) and len(c.args) == 2 and \
+                isinstance(c.args[0], Column) and \
+                isinstance(c.args[1], Constant) and \
+                c.op in ("=", "<", "<=", ">", ">="):
+            name = getattr(ds, "col_name_of", {}).get(c.args[0].idx, "")
+            idx = indexed_cols.get(name.lower())
+            if idx is not None and (target_idx is None or idx is target_idx):
+                target_idx = idx
+                v = c.args[1]
+                if c.op == "=":
+                    low = high = v
+                elif c.op in (">", ">="):
+                    low, low_inc = v, c.op == ">="
+                else:
+                    high, high_inc = v, c.op == "<="
+                used = True
+        if not used:
+            residual.append(c)
+    if target_idx is None or (low is None and high is None):
+        return None
+    cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
+    return PhysIndexRange(tbl, ds.db_name, cols, target_idx, low, high,
+                          low_inc, high_inc, residual, Schema(list(cols)))
+
+
 def _mk_reader(ds: DataSource) -> PhysPlan:
     pg = _try_point_get(ds)
     if pg is not None:
         return pg
+    # index range scan only when clearly selective (est < 2% of table)
+    raw = getattr(ds, "pre_filter_rows", None)
+    if ds.stats_rows > 0 and raw and ds.stats_rows <= max(raw * 0.02, 50):
+        ir = _try_index_range(ds)
+        if ir is not None:
+            ir.stats_rows = ds.stats_rows
+            return ir
     cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
     dag = CoprDAG(table_info=ds.table_info, db_name=ds.db_name,
                   cols=list(cols))

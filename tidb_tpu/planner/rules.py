@@ -183,6 +183,8 @@ def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
     if isinstance(plan, DataSource):
         plan.pushed_conds.extend(conds)
         if conds:
+            if getattr(plan, "pre_filter_rows", None) is None:
+                plan.pre_filter_rows = plan.stats_rows
             sel = 1.0
             for c in conds:
                 sel *= _cond_selectivity(plan, c)

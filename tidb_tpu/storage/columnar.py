@@ -45,6 +45,7 @@ class ColumnarTable:
         self.insert_ts = np.empty(0, dtype=np.int64)
         self.delete_ts = np.empty(0, dtype=np.int64)
         self.handle_pos: dict[int, int] = {}
+        self.bulk_rows = 0           # rows without row-KV/index entries
         self._init_columns()
 
     def _init_columns(self):
