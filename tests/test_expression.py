@@ -196,9 +196,14 @@ class TestStrings:
 
     def test_like(self):
         codes, d = self._col(["promo box", "small box", "PROMO pack"])
-        ft = new_string_type()
+        ft = new_string_type()        # default collate: utf8mb4_bin (cs)
         cols = {0: (codes, None, d)}
         e = ScalarFunc("like", [Column(0, ft), const_from_py("promo%")], ft_i)
+        r = check_agree(e, cols, 3)
+        assert list(np.asarray(r[0])) == [True, False, False]
+        ft_ci = new_string_type().clone(collate="utf8mb4_general_ci")
+        e = ScalarFunc("like", [Column(0, ft_ci), const_from_py("promo%")],
+                       ft_i)
         r = check_agree(e, cols, 3)
         assert list(np.asarray(r[0])) == [True, False, True]
 

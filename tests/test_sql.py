@@ -1138,3 +1138,24 @@ class TestIndexRange:
         after = ftk.must_query("select count(*) from ir2 where k = 5").rows
         assert before == after          # snapshot isolation holds
         ftk.must_exec("commit")
+
+
+class TestCollation:
+    def test_bin_default_case_sensitive(self, ftk):
+        ftk.must_exec("create table cl1 (s varchar(10))")
+        ftk.must_exec("insert into cl1 values ('Abc'), ('abc')")
+        ftk.must_query("select count(*) from cl1 where s = 'abc'")\
+            .check([(1,)])
+        ftk.must_query("select count(*) from cl1 where s like 'a%'")\
+            .check([(1,)])
+
+    def test_ci_collation(self, ftk):
+        ftk.must_exec("create table cl2 (s varchar(10) collate "
+                      "utf8mb4_general_ci)")
+        ftk.must_exec("insert into cl2 values ('Abc'), ('abc'), ('xyz')")
+        ftk.must_query("select count(*) from cl2 where s = 'ABC'")\
+            .check([(2,)])
+        ftk.must_query("select count(*) from cl2 where s like 'AB%'")\
+            .check([(2,)])
+        ftk.must_query("select count(*) from cl2 where s < 'M'")\
+            .check([(2,)])

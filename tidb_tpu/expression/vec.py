@@ -417,6 +417,40 @@ def _cmp_core(xp, op_name, a, b):
 def _cmp_strings(ctx, expr, op_name, aval, bval):
     xp = ctx.xp
     (a, an, ad), (b, bn, bd) = aval, bval
+    ci = _is_ci(expr.args[0].ft) or _is_ci(expr.args[1].ft)
+    if ci:
+        # case-insensitive: compare casefolded values via dict tables
+        def fold(s):
+            return s.casefold()
+        if isinstance(a, str) and isinstance(b, str):
+            return (_cmp_core(xp, op_name, fold(a), fold(b)),
+                    or_nulls(xp, an, bn), None)
+        if isinstance(b, str) and ad is not None:
+            tbl = _dict_table(ctx, ad,
+                              lambda s: _cmp_core(np, op_name, fold(s),
+                                                  fold(b)), np.bool_)
+            return tbl[a], or_nulls(xp, an, bn), None
+        if isinstance(a, str) and bd is not None:
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            tbl = _dict_table(ctx, bd,
+                              lambda s: _cmp_core(
+                                  np, flip.get(op_name, op_name),
+                                  fold(s), fold(a)), np.bool_)
+            return tbl[b], or_nulls(xp, an, bn), None
+        if ad is not None and bd is not None:
+            merged = StringDict()
+            ta = np.array([merged.encode_one(fold(v)) for v in ad.values]
+                          or [0], dtype=np.int64)
+            tb = np.array([merged.encode_one(fold(v)) for v in bd.values]
+                          or [0], dtype=np.int64)
+            if op_name not in ("=", "!="):
+                ranks = merged.ranks()
+                ta, tb = ranks[ta], ranks[tb]
+            tat = xp.asarray(ta) if not ctx.host else ta
+            tbt = xp.asarray(tb) if not ctx.host else tb
+            return (_cmp_core(xp, op_name, tat[a], tbt[b]),
+                    or_nulls(xp, an, bn), None)
+        # object-array host path falls through with folding below
     # scalar const side(s)
     if isinstance(a, str) and isinstance(b, str):
         return _cmp_core(xp, op_name, a, b), or_nulls(xp, an, bn), None
@@ -804,6 +838,10 @@ def like_to_regex(pattern: str, escape: str = "\\") -> str:
     return "^" + "".join(out) + "$"
 
 
+def _is_ci(ft) -> bool:
+    return ft is not None and str(getattr(ft, "collate", "")).endswith("_ci")
+
+
 @op("like")
 def op_like(ctx, expr):
     av = eval_expr(ctx, expr.args[0])
@@ -813,7 +851,8 @@ def op_like(ctx, expr):
     esc = "\\"
     if len(expr.args) > 2:
         esc = _as_str_scalar(eval_expr(ctx, expr.args[2])) or "\\"
-    rx = re.compile(like_to_regex(pat, esc), re.DOTALL | re.IGNORECASE)
+    flags = re.DOTALL | (re.IGNORECASE if _is_ci(expr.args[0].ft) else 0)
+    rx = re.compile(like_to_regex(pat, esc), flags)
     return _apply_str_fn(ctx, av, lambda s: rx.match(s) is not None,
                          out_is_string=False)
 

@@ -310,6 +310,7 @@ class ColumnDef(Node):
     default_value: object = None
     has_default: bool = False
     comment: str = ""
+    collate: str = ""
     enum_vals: list = field(default_factory=list)
 
 

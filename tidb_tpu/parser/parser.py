@@ -935,7 +935,7 @@ class Parser:
                 cd.comment = self.next().text
             elif self.at_kw("collate"):
                 self.next()
-                self.next()
+                cd.collate = self.next().text.lower()
             elif self.at_kw("character"):
                 self.next()
                 self.expect_kw("set")

@@ -36,6 +36,8 @@ def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInf
     ft.auto_increment = cd.auto_increment
     ft.primary_key = cd.primary_key
     ft.elems = cd.enum_vals
+    if cd.collate:
+        ft.collate = cd.collate
     if cd.has_default:
         ft.has_default = True
         dv = cd.default_value
