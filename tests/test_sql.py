@@ -1159,3 +1159,30 @@ class TestCollation:
             .check([(2,)])
         ftk.must_query("select count(*) from cl2 where s < 'M'")\
             .check([(2,)])
+
+
+class TestJoinSpill:
+    def test_grace_join(self, ftk):
+        import numpy as np
+        ftk.must_exec("create table gj1 (k int, v int)")
+        ftk.must_exec("create table gj2 (k int, w int)")
+        rng = np.random.default_rng(4)
+        r1 = ",".join(f"({int(a)},{i})" for i, a in
+                      enumerate(rng.integers(0, 3000, 9000)))
+        r2 = ",".join(f"({int(a)},{i})" for i, a in
+                      enumerate(rng.integers(0, 3000, 6000)))
+        ftk.must_exec(f"insert into gj1 values {r1}, (null, 1)")
+        ftk.must_exec(f"insert into gj2 values {r2}")
+        want = ftk.must_query(
+            "select count(*), sum(v), sum(w) from gj1 join gj2 "
+            "on gj1.k = gj2.k").rows
+        want_left = ftk.must_query(
+            "select count(*) from gj1 left join gj2 on gj1.k = gj2.k").rows
+        ftk.must_exec("set @@tidb_mem_quota_query = 131072")  # force spill
+        got = ftk.must_query(
+            "select count(*), sum(v), sum(w) from gj1 join gj2 "
+            "on gj1.k = gj2.k").rows
+        got_left = ftk.must_query(
+            "select count(*) from gj1 left join gj2 on gj1.k = gj2.k").rows
+        assert got == want and got_left == want_left
+        assert ftk.domain.metrics.get("join_spill_count", 0) >= 1

@@ -1023,13 +1023,75 @@ class HashJoinExec(Executor):
         return _void_view(bk), _void_view(pk)
 
     def _join(self):
+        """Collect inputs; in-memory join, or grace hash partitioning to
+        disk when the inputs exceed the memory quota (reference
+        hash_join_spill.go recursive-partition spill)."""
         plan = self.plan
         build_exec = self.children[plan.build_side]
         probe_exec = self.children[1 - plan.build_side]
         build_chunks = build_exec.all_chunks()
         probe_chunks = probe_exec.all_chunks()
+
+        def chunks_bytes(chs):
+            return sum(getattr(c.data, "nbytes", 0)
+                       for ch in chs for c in ch.columns)
+        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
+        if plan.eq_conds and \
+                chunks_bytes(build_chunks) + chunks_bytes(probe_chunks) > quota:
+            return self._grace_join(build_chunks, probe_chunks)
         build = Chunk.concat_all(build_chunks)
         probe = Chunk.concat_all(probe_chunks)
+        return self._join_pair(build, probe)
+
+    def _grace_join(self, build_chunks, probe_chunks, nparts=8):
+        from ..utils.chunk_disk import ChunkSpool
+        plan = self.plan
+        self.ctx.sess.domain.inc_metric("join_spill_count")
+        build_exec = self.children[plan.build_side]
+        probe_exec = self.children[1 - plan.build_side]
+        lex, rex = self._align_key_fts()
+        build_keys_e = lex if plan.build_side == 0 else rex
+        probe_keys_e = rex if plan.build_side == 0 else lex
+        shared = [None] * len(plan.eq_conds)
+        bspools = [ChunkSpool(f"join_b{i}") for i in range(nparts)]
+        pspools = [ChunkSpool(f"join_p{i}") for i in range(nparts)]
+
+        def partition(chunks, schema, key_exprs, spools):
+            for ch in chunks:
+                if not len(ch):
+                    continue
+                keys, nulls = self._keys_of(schema, ch, key_exprs, shared)
+                h = np.zeros(len(ch), dtype=np.uint64)
+                for j in range(keys.shape[1]):
+                    h = h * np.uint64(0x9E3779B97F4A7C15) + \
+                        keys[:, j].astype(np.uint64)
+                part = (h % np.uint64(nparts)).astype(np.int64)
+                part[nulls] = 0
+                for i in range(nparts):
+                    sub = ch.filter(part == i)
+                    if len(sub):
+                        spools[i].append(sub)
+        partition(build_chunks, build_exec.schema, build_keys_e, bspools)
+        partition(probe_chunks, probe_exec.schema, probe_keys_e, pspools)
+        results = []
+        for i in range(nparts):
+            b = Chunk.concat_all([bspools[i].load(j)
+                                  for j in range(bspools[i].num_chunks)])
+            p = Chunk.concat_all([pspools[i].load(j)
+                                  for j in range(pspools[i].num_chunks)])
+            bspools[i].close()
+            pspools[i].close()
+            if p is None:
+                continue
+            results.append(self._join_pair(b, p))
+        out = Chunk.concat_all(results)
+        return out if out is not None else Chunk.empty(
+            [sc.col.ft for sc in self.schema.cols])
+
+    def _join_pair(self, build, probe):
+        plan = self.plan
+        build_exec = self.children[plan.build_side]
+        probe_exec = self.children[1 - plan.build_side]
         out_fts = [sc.col.ft for sc in self.schema.cols]
         lex, rex = self._align_key_fts()
         build_keys_e = lex if plan.build_side == 0 else rex
