@@ -1186,3 +1186,22 @@ class TestJoinSpill:
             "select count(*) from gj1 left join gj2 on gj1.k = gj2.k").rows
         assert got == want and got_left == want_left
         assert ftk.domain.metrics.get("join_spill_count", 0) >= 1
+
+
+class TestAggExtras:
+    def test_group_concat_order(self, ftk):
+        ftk.must_exec("create table gc (g int, s varchar(5), o int)")
+        ftk.must_exec("insert into gc values (1,'b',2),(1,'a',1),(1,'c',3),"
+                      "(2,'z',1)")
+        ftk.must_query("select g, group_concat(s order by o separator '-') "
+                       "from gc group by g order by g").check([
+                           (1, "a-b-c"), (2, "z")])
+        ftk.must_query("select group_concat(s order by o desc) from gc "
+                       "where g = 1").check([("c,b,a",)])
+
+    def test_on_dup_values(self, ftk):
+        ftk.must_exec("create table od (id int primary key, v int)")
+        ftk.must_exec("insert into od values (1, 10)")
+        ftk.must_exec("insert into od values (1, 99) on duplicate key "
+                      "update v = values(v) + 1")
+        ftk.must_query("select v from od").check([(100,)])

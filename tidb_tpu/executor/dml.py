@@ -180,11 +180,16 @@ class InsertExec:
             raise DuplicateKeyError("Duplicate entry")
         old = self._load_row(txn, tbl, h)
         new = list(old)
+        new_schema = getattr(self.plan, "on_dup_new_schema", None)
         for off, expr, schema in self.plan.on_dup:
             cols_ctx = {}
             for sc, d in zip(schema.cols, old):
                 v, nf, sd = _datum_to_np(d)
                 cols_ctx[sc.col.idx] = (v, nf, sd)
+            if new_schema is not None:
+                for sc, d in zip(new_schema.cols, row):
+                    v, nf, sd = _datum_to_np(d)
+                    cols_ctx[sc.col.idx] = (v, nf, sd)
             ectx = EvalCtx(np, 1, cols_ctx, host=True)
             data, nulls, sd = eval_expr(ectx, expr)
             d = datum_from_value(

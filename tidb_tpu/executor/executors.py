@@ -766,7 +766,7 @@ class HashAggExec(Executor):
     def _complete(self):
         from ..copr.dag_exec import _host_partial_agg
         plan = self.plan
-        if any(d.distinct for d in plan.aggs):
+        if any(d.distinct or d.name == "group_concat" for d in plan.aggs):
             return self._complete_distinct()
 
         class _FakeDag:
@@ -904,11 +904,29 @@ class HashAggExec(Executor):
                           sd)
         if name == "group_concat":
             out = np.empty(g, dtype=object)
-            sep = ","
+            sep = desc.separator
             strs = (np.asarray([sd.values[c] for c in vals], dtype=object)
                     if sd is not None else vals.astype(str))
+            order_keys = None
+            if desc.order_by:
+                okeys = []
+                for e, dsc in desc.order_by:
+                    od, onl, osd = eval_expr(ectx, e)
+                    if np.isscalar(od):
+                        od = np.full(n, od)
+                    od = np.asarray(od)
+                    if osd is not None:
+                        od = osd.ranks()[od]
+                    od = od[np.nonzero(~nm)[0]] if desc.distinct is False \
+                        else od[np.nonzero(~nm)[0]]
+                    okeys.append(-od if dsc else od)
+                order_keys = np.lexsort(list(reversed(okeys)))
+                inv_sorted = inv2[order_keys]
+                strs_sorted = strs[order_keys]
+            else:
+                inv_sorted, strs_sorted = inv2, strs
             for gi in range(g):
-                out[gi] = sep.join(strs[inv2 == gi])
+                out[gi] = sep.join(strs_sorted[inv_sorted == gi])
             return Column(ft, out, (cnt == 0) if (cnt == 0).any() else None)
         raise UnsupportedError("agg %s unsupported", name)
 

@@ -85,6 +85,8 @@ class AggDesc:
     distinct: bool = False
     ft: FieldType = None
     mode: str = "complete"    # complete | partial1 | final
+    order_by: list = field(default_factory=list)  # group_concat: [(e, desc)]
+    separator: str = ","
 
     def fingerprint(self):
         d = "d" if self.distinct else ""

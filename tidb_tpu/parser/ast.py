@@ -62,6 +62,7 @@ class AggFunc(ExprNode):
     name: str                # count,sum,avg,min,max,group_concat,...
     args: list = field(default_factory=list)
     distinct: bool = False
+    order_by: list = field(default_factory=list)   # group_concat ORDER BY
 
 
 @dataclass

@@ -1536,6 +1536,9 @@ class Parser:
                     args.append(self.parse_expr())
                     while self.accept_op(","):
                         args.append(self.parse_expr())
+                gc_order = None
+                if name == "group_concat" and self.at_kw("order"):
+                    gc_order = self.parse_order_by()
                 if name == "group_concat" and self.accept_kw("separator"):
                     args.append(ast.Literal(self.next().text))
             self.expect_op(")")
@@ -1545,7 +1548,10 @@ class Parser:
                 self.error(f"{name} requires an OVER clause")
             if star:
                 return ast.AggFunc("count", [ast.Wildcard()], distinct=False)
-            return ast.AggFunc(name, args, distinct=distinct)
+            node = ast.AggFunc(name, args, distinct=distinct)
+            if name == "group_concat" and locals().get("gc_order"):
+                node.order_by = gc_order
+            return node
         if name == "extract":
             unit = self.ident().lower()
             self.expect_kw("from")

@@ -65,6 +65,7 @@ class InsertPlan:
     is_replace: bool = False
     ignore: bool = False
     on_dup: list = field(default_factory=list)       # [(offset, Expression, sel_schema)]
+    on_dup_new_schema: object = None                 # VALUES(col) bindings
 
 
 @dataclass
@@ -280,6 +281,14 @@ class PlanBuilder:
             if name == "count" and not args:
                 args = []
             desc = AggDesc(name=name, args=args, distinct=node.distinct)
+            if name == "group_concat":
+                if getattr(node, "order_by", None):
+                    desc.order_by = [(rw_inner.rewrite(oi.expr), oi.desc)
+                                     for oi in node.order_by]
+                from ..expression import Constant as _Const
+                if len(args) > 1 and isinstance(args[-1], _Const):
+                    desc.separator = str(args[-1].value.val)
+                    desc.args = args = args[:-1]
             desc.ft = agg_result_ft(name, args, node.distinct)
             fp = desc.fingerprint()
             if fp in agg_map:
@@ -1003,17 +1012,37 @@ class PlanBuilder:
                         exprs.append(rw.rewrite(e))
                 plan.rows.append(exprs)
         if stmt.on_duplicate:
-            # assignments eval against current row schema
+            # assignments eval against current row schema; VALUES(col)
+            # resolves to the to-be-inserted row via a parallel schema
             schema = Schema()
+            new_schema = Schema()
             for i, ci in enumerate(cols):
                 schema.append(SchemaCol(self._new_col(ci.ft, ci.name),
                                         ci.name, tbl.name, db))
+                new_schema.append(SchemaCol(self._new_col(ci.ft, ci.name),
+                                            ci.name))
+
+            def subst_values(node):
+                if isinstance(node, ast.FuncCall) and \
+                        node.name == "values" and len(node.args) == 1 and \
+                        isinstance(node.args[0], ast.ColumnRef):
+                    noff = next(i for i, c in enumerate(cols)
+                                if c.name.lower() ==
+                                node.args[0].name.lower())
+                    return new_schema.cols[noff].col
+                return None
             rw = self._rewriter(schema)
+            orig_funccall = rw._rw_FuncCall
+
+            def patched(node):
+                r = subst_values(node)
+                return r if r is not None else orig_funccall(node)
+            rw._rw_FuncCall = patched
             for colref, e in stmt.on_duplicate:
                 off = next(i for i, c in enumerate(cols)
                            if c.name.lower() == colref.name.lower())
-                # VALUES(col) unsupported for now
                 plan.on_dup.append((off, rw.rewrite(e), schema))
+            plan.on_dup_new_schema = new_schema
         return plan
 
     def _collect_sources(self, node, out):

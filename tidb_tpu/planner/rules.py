@@ -340,6 +340,8 @@ def prune_columns(plan: LogicalPlan, needed: set):
         for a in plan.aggs:
             for arg in a.args:
                 child_needed |= _cols_of(arg)
+            for e, _d in getattr(a, "order_by", []):
+                child_needed |= _cols_of(e)
         if not child_needed and plan.child.schema.cols:
             child_needed = {plan.child.schema.cols[0].col.idx}
         prune_columns(plan.child, child_needed)
