@@ -1205,3 +1205,28 @@ class TestAggExtras:
         ftk.must_exec("insert into od values (1, 99) on duplicate key "
                       "update v = values(v) + 1")
         ftk.must_query("select v from od").check([(100,)])
+
+
+class TestIntrospection:
+    def test_show_table_status(self, ftk):
+        ftk.must_exec("create table sts (a int)")
+        ftk.must_exec("insert into sts values (1),(2)")
+        r = ftk.must_query("show table status")
+        row = next(r0 for r0 in r.rows if r0[0] == "sts")
+        assert row[3] == "2"
+
+    def test_key_column_usage(self, ftk):
+        ftk.must_exec("create table p9 (id int primary key)")
+        ftk.must_exec("create table c9 (x int, pid int, "
+                      "constraint myfk foreign key (pid) "
+                      "references p9 (id))")
+        r = ftk.must_query(
+            "select constraint_name, column_name, referenced_table_name "
+            "from information_schema.key_column_usage "
+            "where table_name = 'c9'")
+        assert ("myfk", "pid", "p9") in r.rows
+        r = ftk.must_query(
+            "select delete_rule from "
+            "information_schema.referential_constraints "
+            "where constraint_name = 'myfk'")
+        assert r.rows == [("RESTRICT",)]

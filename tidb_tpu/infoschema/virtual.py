@@ -105,6 +105,35 @@ def _gen_cluster_info(domain):
     yield ("tidb-tpu", "127.0.0.1:4000", "127.0.0.1:10080", "0.1.0", "none")
 
 
+def _gen_key_column_usage(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            if t.pk_is_handle:
+                yield ("def", db.name, "PRIMARY", db.name, t.name,
+                       t.pk_col_name, 1, None, None, None)
+            for idx in t.indexes:
+                if idx.primary or idx.unique:
+                    for seq, c in enumerate(idx.columns):
+                        yield ("def", db.name,
+                               "PRIMARY" if idx.primary else idx.name,
+                               db.name, t.name, c, seq + 1, None, None, None)
+            for fk in t.foreign_keys:
+                for seq, c in enumerate(fk["cols"]):
+                    yield ("def", db.name, fk["name"] or "fk", db.name,
+                           t.name, c, seq + 1, fk["ref_db"],
+                           fk["ref_table"], fk["ref_cols"][seq])
+
+
+def _gen_referential_constraints(domain):
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        for t in ischema.tables_in_schema(db.name):
+            for fk in t.foreign_keys:
+                yield ("def", db.name, fk["name"] or "fk", db.name,
+                       fk["on_delete"].upper(), t.name, fk["ref_table"])
+
+
 def _gen_views(domain):
     ischema = domain.infoschema()
     for db in ischema.all_schemas():
@@ -181,6 +210,18 @@ VIRTUAL_DEFS = {
                            ("git_hash", _S())), _gen_cluster_info),
     "views": (_cols(("table_schema", _S()), ("table_name", _S()),
                     ("view_definition", _S())), _gen_views),
+    "key_column_usage": (_cols(
+        ("constraint_catalog", _S()), ("constraint_schema", _S()),
+        ("constraint_name", _S()), ("table_schema", _S()),
+        ("table_name", _S()), ("column_name", _S()),
+        ("ordinal_position", _I()), ("referenced_table_schema", _S()),
+        ("referenced_table_name", _S()), ("referenced_column_name", _S())),
+        _gen_key_column_usage),
+    "referential_constraints": (_cols(
+        ("constraint_catalog", _S()), ("constraint_schema", _S()),
+        ("constraint_name", _S()), ("unique_constraint_schema", _S()),
+        ("delete_rule", _S()), ("table_name", _S()),
+        ("referenced_table_name", _S())), _gen_referential_constraints),
     "partitions": (_cols(("table_schema", _S()), ("table_name", _S()),
                          ("partition_name", _S())), _gen_partitions),
 }

@@ -1105,7 +1105,11 @@ class Parser:
             stmt.is_global = True
         else:
             self.accept_kw("session")
-        if self.accept_kw("databases") or self.accept_kw("schemas"):
+        if self.accept_kw("table") and self.accept_kw("status"):
+            stmt.kind = "table_status"
+            if self.accept_kw("from") or self.accept_kw("in"):
+                stmt.db = self.ident()
+        elif self.accept_kw("databases") or self.accept_kw("schemas"):
             stmt.kind = "databases"
         elif self.accept_kw("tables"):
             stmt.kind = "tables"

@@ -116,6 +116,17 @@ def exec_show(sess, stmt):
                              idx.name, seq + 1, c))
         return _str_chunk(["Table", "Non_unique", "Key_name", "Seq_in_index",
                            "Column_name"], rows)
+    if kind == "table_status":
+        db = stmt.db or sess.vars.current_db
+        rows = []
+        for t in sorted(ischema.tables_in_schema(db), key=lambda x: x.name):
+            ctab = sess.domain.columnar.tables.get(t.id)
+            nrows = ctab.live_count() if ctab else 0
+            rows.append((t.name, "InnoDB", "Dynamic", nrows,
+                         "VIEW" if t.view_select else "BASE TABLE",
+                         t.comment))
+        return _str_chunk(["Name", "Engine", "Row_format", "Rows", "Type",
+                           "Comment"], _like_filter(rows, stmt.like))
     if kind == "warnings":
         rows = [(w.get("level", "Warning"), w.get("code", 1105),
                  w.get("msg", "")) for w in sess.vars.warnings]
