@@ -1230,3 +1230,22 @@ class TestIntrospection:
             "information_schema.referential_constraints "
             "where constraint_name = 'myfk'")
         assert r.rows == [("RESTRICT",)]
+
+
+class TestBatchPointGet:
+    def test_batch_get(self, ftk):
+        ftk.must_exec("create table bpg (id int primary key, v int)")
+        ftk.must_exec("insert into bpg values " + ",".join(
+            f"({i},{i*10})" for i in range(1, 51)))
+        r = ftk.must_query("explain select v from bpg where id in (3,7,99)")
+        assert any("BatchPointGet" in row[0] for row in r.rows)
+        ftk.must_query("select v from bpg where id in (3,7,99) order by v")\
+            .check([(30,), (70,)])
+
+    def test_explain_json(self, ftk):
+        ftk.must_exec("create table ej (a int)")
+        r = ftk.must_query("explain format = 'json' select * from ej "
+                           "where a > 1")
+        import json
+        tree = json.loads(r.rows[0][0])
+        assert "id" in tree and "children" in tree

@@ -1,14 +1,14 @@
 """Executor builder (reference pkg/executor/builder.go:193)."""
 from __future__ import annotations
 
-from ..planner.physical import (PhysIndexRange, PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
+from ..planner.physical import (PhysBatchPointGet, PhysIndexRange, PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
                                 PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
                                 PhysLimit, PhysUnion, PhysDual, PhysShell,
                                 PhysWindow)
 from .executors import (TableReaderExec, SelectionExec, ProjectionExec,
                         HashAggExec, HashJoinExec, SortExec, TopNExec,
                         LimitExec, UnionExec, DualExec, ShellExec,
-                        PointGetExec, IndexRangeExec)
+                        PointGetExec, IndexRangeExec, BatchPointGetExec)
 from .window import WindowExec
 
 
@@ -25,6 +25,8 @@ def _build(ctx, plan):
         return PointGetExec(ctx, plan)
     if isinstance(plan, PhysIndexRange):
         return IndexRangeExec(ctx, plan)
+    if isinstance(plan, PhysBatchPointGet):
+        return BatchPointGetExec(ctx, plan)
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(ctx, plan)
     if isinstance(plan, PhysSelection):

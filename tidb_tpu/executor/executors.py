@@ -113,6 +113,50 @@ class TableReaderExec(Executor):
         return out
 
 
+class BatchPointGetExec(Executor):
+    """Vectorized multi-handle lookup via the columnar handle index."""
+
+    def __init__(self, ctx, plan):
+        super().__init__(ctx, plan.schema)
+        self.plan = plan
+        self._done = False
+
+    def open(self):
+        pass
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.ctx.sess
+        from .exec_base import expr_to_datum
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
+        if ctab is None:
+            return empty
+        handles = []
+        for e in plan.handles:
+            d = expr_to_datum(e)
+            if not d.is_null:
+                handles.append(int(d.val))
+        pos = [ctab.handle_pos.get(h) for h in handles]
+        pos = np.array([p for p in pos
+                        if p is not None and ctab.delete_ts[p] == 0],
+                       dtype=np.int64)
+        if not len(pos):
+            return empty
+        cols = []
+        for sc in self.schema.cols:
+            ci = tbl.find_column(sc.name)
+            if ci is None:
+                cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
+            else:
+                cols.append(ctab.column_for(ci, pos))
+        return Chunk(cols)
+
+
 class IndexRangeExec(Executor):
     """Index range scan: scan index KV range at the read ts, collect
     handles, gather rows from the columnar engine, apply residual filters.

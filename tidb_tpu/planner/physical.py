@@ -102,6 +102,22 @@ class PhysIndexRange(PhysPlan):
                 f"range:{rng}")
 
 
+class PhysBatchPointGet(PhysPlan):
+    """pk IN (consts) -> batched handle lookups (reference
+    batch_point_get.go)."""
+
+    def __init__(self, table_info, db_name, cols, handles, schema):
+        super().__init__([], schema)
+        self.table_info = table_info
+        self.db_name = db_name
+        self.cols = cols
+        self.handles = handles     # [Constant]
+        self.stats_rows = float(len(handles))
+
+    def explain_info(self):
+        return f"table:{self.table_info.name}, handles:{len(self.handles)}"
+
+
 class PhysPointGet(PhysPlan):
     """Point read via clustered PK handle or unique index (reference
     pkg/executor/point_get.go; planner fast path point_get_plan.go)."""
@@ -237,6 +253,19 @@ def _try_point_get(ds: DataSource) -> PhysPlan | None:
     conds = ds.pushed_conds
     if not conds or tbl.id < 0 or tbl.partitions:
         return None
+    if tbl.pk_is_handle and len(conds) == 1 and \
+            isinstance(conds[0], ScalarFunc) and conds[0].op == "in":
+        cols0 = getattr(ds, "used_cols", None) or list(ds.schema.cols)
+        c0 = conds[0]
+        if isinstance(c0.args[0], Column) and \
+                getattr(ds, "col_name_of", {}).get(
+                    c0.args[0].idx, "").lower() == \
+                tbl.pk_col_name.lower() and \
+                all(isinstance(a, Constant) for a in c0.args[1:]) and \
+                len(c0.args) <= 1025:
+            return PhysBatchPointGet(tbl, ds.db_name, cols0,
+                                     list(c0.args[1:]),
+                                     Schema(list(cols0)))
     eqs = {}
     for c in conds:
         if not (isinstance(c, ScalarFunc) and c.op == "=" and

@@ -584,6 +584,20 @@ class Session:
                          for r in explain_text(plan.select_plan)]
         else:
             rows = explain_text(plan)
+        if stmt.format == "json" and not is_dml:
+            import json as _json
+
+            def tree(p):
+                return {"id": p.name(), "estRows": round(p.stats_rows, 2),
+                        "info": p.explain_info(),
+                        "children": [tree(c) for c in p.children]}
+            from ..chunk.chunk import Chunk as _Ck
+            from ..chunk.column import Column as _Cl
+            from ..types.field_type import new_string_type as _st
+            arr = np.array([_json.dumps(tree(plan), indent=2)], dtype=object)
+            self._finish_stmt()
+            return ResultSet(names=["EXPLAIN"],
+                             chunks=[_Ck([_Cl(_st(), arr)])])
         names = ["id", "estRows", "operator info"]
         cols = []
         for j in range(3):
