@@ -1249,3 +1249,30 @@ class TestBatchPointGet:
         import json
         tree = json.loads(r.rows[0][0])
         assert "id" in tree and "children" in tree
+
+
+class TestFastPathTxn:
+    def test_batch_get_in_txn(self, ftk):
+        ftk.must_exec("create table bpt (id int primary key, v int)")
+        ftk.must_exec("insert into bpt values (1,10),(2,20),(3,30)")
+        ftk.must_exec("begin")
+        ftk.must_exec("update bpt set v = 99 where id = 2")
+        ftk.must_exec("delete from bpt where id = 3")
+        ftk.must_query("select v from bpt where id in (1,2,3) order by v")\
+            .check([(10,), (99,)])
+        ftk.must_exec("rollback")
+        ftk.must_query("select v from bpt where id in (2,3) order by v")\
+            .check([(20,), (30,)])
+
+    def test_index_range_in_txn(self, ftk):
+        ftk.must_exec("create table irt (id int primary key, k int, "
+                      "key ik (k))")
+        rows = ",".join(f"({i}, {i % 50})" for i in range(1, 2001))
+        ftk.must_exec(f"insert into irt values {rows}")
+        ftk.must_exec("analyze table irt")
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into irt values (9001, 7)")
+        r = ftk.must_query("explain select count(*) from irt where k = 7")
+        got = ftk.must_query("select count(*) from irt where k = 7").rows
+        assert got == [(41,)], (got, r.rows)
+        ftk.must_exec("rollback")

@@ -132,29 +132,59 @@ class BatchPointGetExec(Executor):
         tbl = plan.table_info
         sess = self.ctx.sess
         from .exec_base import expr_to_datum
+        from ..codec.tablecodec import record_key
+        from ..codec.codec import decode_row_value
+        txn = getattr(sess, "_txn", None)
+        dirty = txn is not None and not txn.committed and not txn.aborted \
+            and txn.is_dirty()
         ctab = sess.domain.columnar.tables.get(tbl.id)
         empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
-        if ctab is None:
-            return empty
         handles = []
         for e in plan.handles:
             d = expr_to_datum(e)
             if not d.is_null:
                 handles.append(int(d.val))
-        pos = [ctab.handle_pos.get(h) for h in handles]
-        pos = np.array([p for p in pos
-                        if p is not None and ctab.delete_ts[p] == 0],
-                       dtype=np.int64)
-        if not len(pos):
-            return empty
-        cols = []
-        for sc in self.schema.cols:
-            ci = tbl.find_column(sc.name)
-            if ci is None:
-                cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
-            else:
-                cols.append(ctab.column_for(ci, pos))
-        return Chunk(cols)
+        buffered = []          # (handle, row datums)
+        live_handles = []
+        for h in handles:
+            if dirty and record_key(tbl.id, h) in txn.mem_buffer:
+                rv = txn.mem_buffer.get(record_key(tbl.id, h))
+                if rv is not None:
+                    buffered.append((h, decode_row_value(rv)))
+                continue       # buffered delete: skip
+            live_handles.append(h)
+        pos = []
+        if ctab is not None:
+            pos = [ctab.handle_pos.get(h) for h in live_handles]
+            pos = [p for p in pos
+                   if p is not None and ctab.delete_ts[p] == 0]
+        pos = np.array(pos, dtype=np.int64)
+        parts = []
+        if len(pos):
+            cols = []
+            for sc in self.schema.cols:
+                ci = tbl.find_column(sc.name)
+                if ci is None:
+                    cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
+                else:
+                    cols.append(ctab.column_for(ci, pos))
+            parts.append(Chunk(cols))
+        if buffered:
+            name_off = {c.name.lower(): i for i, c in
+                        enumerate(tbl.columns)}
+            from ..chunk.column import Column as HostCol
+            cols = []
+            for sc in self.schema.cols:
+                off = name_off.get(sc.name)
+                if off is None:
+                    cols.append(HostCol(sc.col.ft, np.array(
+                        [h for h, _ in buffered], dtype=np.int64)))
+                else:
+                    cols.append(HostCol.from_datums(
+                        sc.col.ft, [r[off] for _, r in buffered]))
+            parts.append(Chunk(cols))
+        out = Chunk.concat_all(parts)
+        return out if out is not None else empty
 
 
 class IndexRangeExec(Executor):
@@ -200,8 +230,15 @@ class IndexRangeExec(Executor):
             d = coerce_datum(expr_to_datum(plan.high), ci.ft)
             hi = pref + encode_datums_key([d])
             hi = hi + (b"\xff" * 9 if plan.high_inc else b"")
-        read_ts = self.ctx.read_ts() or sess.domain.storage.current_ts()
-        entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts)
+        txn = getattr(sess, "_txn", None)
+        dirty = txn is not None and not txn.committed and not txn.aborted \
+            and txn.is_dirty()
+        if dirty:
+            entries = txn.scan(lo, hi)     # memBuffer merged over snapshot
+        else:
+            read_ts = self.ctx.read_ts() or \
+                sess.domain.storage.current_ts()
+            entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts)
         handles = []
         for k, v in entries:
             if plan.index.unique and v not in (b"",):
@@ -210,20 +247,48 @@ class IndexRangeExec(Executor):
                 handles.append(index_key_handle(k))
         if not handles:
             return empty
-        pos = [ctab.handle_pos.get(h) for h in handles]
+        from ..codec.tablecodec import record_key
+        from ..codec.codec import decode_row_value
+        buffered = []
+        resident = []
+        for h in handles:
+            rk = record_key(tbl.id, h)
+            if dirty and rk in txn.mem_buffer:
+                rv = txn.mem_buffer.get(rk)
+                if rv is not None:
+                    buffered.append((h, decode_row_value(rv)))
+                continue
+            resident.append(h)
+        pos = [ctab.handle_pos.get(h) for h in resident]
         pos = np.array([p for p in pos
                         if p is not None and ctab.delete_ts[p] == 0],
                        dtype=np.int64)
-        if not len(pos):
+        parts = []
+        if len(pos):
+            cols = []
+            for sc in self.schema.cols:
+                cinfo = tbl.find_column(sc.name)
+                if cinfo is None:
+                    cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
+                else:
+                    cols.append(ctab.column_for(cinfo, pos))
+            parts.append(Chunk(cols))
+        if buffered:
+            name_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
+            from ..chunk.column import Column as HostCol
+            cols = []
+            for sc in self.schema.cols:
+                off = name_off.get(sc.name)
+                if off is None:
+                    cols.append(HostCol(sc.col.ft, np.array(
+                        [h for h, _ in buffered], dtype=np.int64)))
+                else:
+                    cols.append(HostCol.from_datums(
+                        sc.col.ft, [r[off] for _, r in buffered]))
+            parts.append(Chunk(cols))
+        ch = Chunk.concat_all(parts)
+        if ch is None:
             return empty
-        cols = []
-        for sc in self.schema.cols:
-            cinfo = tbl.find_column(sc.name)
-            if cinfo is None:
-                cols.append(Column(sc.col.ft, ctab.handles[pos].copy()))
-            else:
-                cols.append(ctab.column_for(cinfo, pos))
-        ch = Chunk(cols)
         if plan.residual:
             cols_ctx = bind_chunk(self.schema, ch)
             ectx = EvalCtx(np, len(ch), cols_ctx, host=True)
