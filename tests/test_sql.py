@@ -1276,3 +1276,25 @@ class TestFastPathTxn:
         got = ftk.must_query("select count(*) from irt where k = 7").rows
         assert got == [(41,)], (got, r.rows)
         ftk.must_exec("rollback")
+
+
+class TestCTAS:
+    def test_create_table_as_select(self, ftk):
+        ftk.must_exec("create table src1 (a int, b varchar(8), "
+                      "d decimal(8,2))")
+        ftk.must_exec("insert into src1 values (1,'x',1.50),(2,'y',2.25)")
+        ftk.must_exec("create table dst1 as select a, upper(b) ub, d * 2 dd "
+                      "from src1 where a >= 1")
+        ftk.must_query("select * from dst1 order by a").check([
+            (1, "X", "3.00"), (2, "Y", "4.50")])
+        ftk.must_exec("insert into dst1 values (9, 'z', 0.01)")
+
+    def test_create_table_like(self, ftk):
+        ftk.must_exec("create table src2 (id int primary key "
+                      "auto_increment, v varchar(5), key iv (v))")
+        ftk.must_exec("create table dst2 like src2")
+        ftk.must_exec("insert into dst2 (v) values ('a'), ('b')")
+        ftk.must_query("select id, v from dst2 order by id").check([
+            (1, "a"), (2, "b")])
+        r = ftk.must_query("show create table dst2")
+        r.check_contain("KEY `iv`")

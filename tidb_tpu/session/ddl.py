@@ -107,8 +107,10 @@ class DDLExecutor:
     # ---- tables -------------------------------------------------------
     def create_table(self, stmt: ast.CreateTableStmt):
         db_name = stmt.table.db or self.sess.vars.current_db
-        if "as_select" in stmt.options or "like" in stmt.options:
-            raise UnsupportedError("CREATE TABLE AS/LIKE not supported yet")
+        if "like" in stmt.options:
+            return self._create_table_like(stmt, db_name)
+        if "as_select" in stmt.options:
+            return self._create_table_as(stmt, db_name)
 
         def fn(m):
             db = self._db_by_name(m, db_name)
@@ -297,6 +299,73 @@ class DDLExecutor:
                             view_cols=list(stmt.columns))
             m.create_table(db.id, tbl)
         self._with_meta(fn)
+
+    def _create_table_like(self, stmt, db_name):
+        src_tn = stmt.options["like"]
+        src_db = src_tn.db or db_name
+        src_tbl = self.domain.infoschema().table_by_name(src_db, src_tn.name)
+
+        def fn(m):
+            db = self._db_by_name(m, db_name)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.table.name.lower():
+                    if stmt.if_not_exists:
+                        return
+                    raise TableExistsError("Table '%s' already exists",
+                                           stmt.table.name)
+            import copy
+            tbl = copy.deepcopy(src_tbl)
+            tbl.id = m.gen_global_id()
+            tbl.name = stmt.table.name
+            tbl.foreign_keys = []
+            m.create_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def _create_table_as(self, stmt, db_name):
+        """CTAS: infer columns from the select's output schema, create,
+        then INSERT...SELECT the rows."""
+        from ..planner import optimize
+        sel = stmt.options["as_select"]
+        pctx = self.sess._plan_ctx()
+        plan = optimize(sel, pctx)
+        vis = [sc for sc in plan.schema.cols if not sc.hidden]
+
+        def fn(m):
+            db = self._db_by_name(m, db_name)
+            for t in m.list_tables(db.id):
+                if t.name.lower() == stmt.table.name.lower():
+                    if stmt.if_not_exists:
+                        return None
+                    raise TableExistsError("Table '%s' already exists",
+                                           stmt.table.name)
+            cols = []
+            for i, sc in enumerate(vis):
+                ft = sc.col.ft.clone()
+                ft.auto_increment = False
+                ft.primary_key = False
+                name = sc.name or f"c{i}"
+                cols.append(ColumnInfo(id=i + 1, name=name, offset=i,
+                                       ft=ft))
+            tbl = TableInfo(id=m.gen_global_id(), name=stmt.table.name,
+                            columns=cols)
+            m.create_table(db.id, tbl)
+            return tbl
+        created = self._with_meta(fn)
+        if created is None:
+            return
+        # populate via the executor (fresh plan context/schema version)
+        from ..executor import build_executor, ExecContext
+        from ..executor.dml import InsertExec
+        from ..planner.builder import InsertPlan
+        new_tbl = self.domain.infoschema().table_by_name(db_name,
+                                                         stmt.table.name)
+        iplan = InsertPlan(table_info=new_tbl, db_name=db_name,
+                           col_offsets=list(range(len(new_tbl.columns))),
+                           select_plan=plan)
+        ectx = ExecContext(self.sess)
+        self.sess.txn()
+        InsertExec(ectx, iplan, self.sess).execute()
+        self.sess.commit()
 
     def drop_table(self, stmt: ast.DropTableStmt):
         def fn(m):
