@@ -1298,3 +1298,22 @@ class TestCTAS:
             (1, "a"), (2, "b")])
         r = ftk.must_query("show create table dst2")
         r.check_contain("KEY `iv`")
+
+
+class TestGeneratedAndGrants:
+    def test_generated_column(self, ftk):
+        ftk.must_exec("create table gen1 (a int, b int, "
+                      "c int as (a + b) stored)")
+        ftk.must_exec("insert into gen1 (a, b) values (1, 2), (10, 20)")
+        ftk.must_query("select c from gen1 order by c").check([(3,), (30,)])
+        ftk.must_exec("update gen1 set b = 100 where a = 1")
+        ftk.must_query("select c from gen1 where a = 1").check([(101,)])
+
+    def test_show_grants(self, ftk):
+        ftk.must_exec("create user gu")
+        ftk.must_exec("grant select, insert on test.* to gu")
+        r = ftk.must_query("show grants for gu")
+        assert any("INSERT, SELECT" in row[0] and "test.*" in row[0]
+                   for row in r.rows), r.rows
+        r2 = ftk.must_query("show grants")
+        assert any("ALL PRIVILEGES" in row[0] for row in r2.rows)

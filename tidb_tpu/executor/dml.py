@@ -49,6 +49,8 @@ class InsertExec:
                 else:
                     alloc.rebase(int(d.val))
             handle = self._handle_for(tbl, cols, row, alloc)
+            if any(c.generated for c in cols):
+                row = compute_generated(sess, tbl, row)
             if tbl.foreign_keys:
                 from .fk import check_parent_exists
                 check_parent_exists(sess, txn, tbl, row)
@@ -200,6 +202,42 @@ class InsertExec:
         table_rt.update_record(txn, tbl, h, old, new)
 
 
+def compute_generated(sess, tbl, row):
+    """Fill stored generated columns from the other fields (reference
+    pkg/table/column.go generated column eval)."""
+    gen_cols = [(i, ci) for i, ci in enumerate(tbl.public_columns())
+                if ci.generated]
+    if not gen_cols:
+        return row
+    from ..parser import parse_one
+    from ..planner.rewriter import Rewriter
+    from ..planner.schema import Schema, SchemaCol
+    from ..expression import Column as ECol, EvalCtx as _ECtx, \
+        eval_expr as _ee
+    from ..expression.vec import materialize_nulls as _mn
+    from .exec_base import datum_from_value
+    pctx = sess._plan_ctx()
+    schema = Schema()
+    cols_ctx = {}
+    for i, ci in enumerate(tbl.public_columns()):
+        col = ECol(idx=pctx.alloc_id(), ft=ci.ft, name=ci.name)
+        schema.append(SchemaCol(col, ci.name, tbl.name))
+        v, nf, sd = _datum_to_np(row[i])
+        cols_ctx[col.idx] = (v, nf, sd)
+    for off, ci in gen_cols:
+        sel = parse_one(f"select {ci.generated}")
+        rw = Rewriter(pctx, schema)
+        e = rw.rewrite(sel.fields[0].expr)
+        ectx = _ECtx(np, 1, cols_ctx, host=True)
+        data, nulls, sd = _ee(ectx, e)
+        d = datum_from_value(
+            np.asarray(data).reshape(-1)[0]
+            if not np.isscalar(data) else data,
+            bool(np.asarray(_mn(ectx, nulls)).reshape(-1)[0]), sd, e.ft)
+        row[off] = coerce_datum(d, ci.ft)
+    return row
+
+
 def _enforce_checks(sess, tbl, row):
     """CHECK constraints evaluated per row (reference
     pkg/table/constraint.go); error 3819 on violation."""
@@ -310,6 +348,8 @@ class UpdateExec:
                     new[off] = d
                 if not changed:
                     continue
+                if any(c.generated for c in cols):
+                    new = compute_generated(sess, tbl, new)
                 if tbl.foreign_keys:
                     from .fk import check_parent_exists
                     check_parent_exists(sess, txn, tbl, new)

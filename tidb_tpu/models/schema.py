@@ -29,11 +29,13 @@ class ColumnInfo:
     ft: FieldType
     state: SchemaState = SchemaState.PUBLIC
     comment: str = ""
+    generated: str = ""          # stored generated column expr (SQL text)
 
     def to_json(self):
         return {
             "id": self.id, "name": self.name, "offset": self.offset,
             "state": int(self.state), "comment": self.comment,
+            "generated": self.generated,
             "ft": {
                 "tp": self.ft.tp, "tclass": int(self.ft.tclass),
                 "flen": self.ft.flen, "decimal": self.ft.decimal,
@@ -57,7 +59,8 @@ class ColumnInfo:
             auto_increment=f["auto_increment"], primary_key=f["primary_key"],
             default_value=f["default_value"], has_default=f["has_default"])
         return cls(id=j["id"], name=j["name"], offset=j["offset"], ft=ft,
-                   state=SchemaState(j["state"]), comment=j["comment"])
+                   state=SchemaState(j["state"]), comment=j["comment"],
+                   generated=j.get("generated", ""))
 
 
 @dataclass

@@ -312,6 +312,7 @@ class ColumnDef(Node):
     has_default: bool = False
     comment: str = ""
     collate: str = ""
+    generated: str = ""          # stored generated column expr text
     enum_vals: list = field(default_factory=list)
 
 

@@ -943,6 +943,24 @@ class Parser:
             elif self.at_kw("charset"):
                 self.next()
                 self.next()
+            elif self.at_kw("as") and self.peek(1).kind == "OP" and \
+                    self.peek(1).text == "(":
+                self.next()
+                start = self.peek().pos
+                self.expect_op("(")
+                depth = 1
+                while depth and self.peek().kind != "EOF":
+                    t = self.next()
+                    if t.kind == "OP" and t.text == "(":
+                        depth += 1
+                    elif t.kind == "OP" and t.text == ")":
+                        depth -= 1
+                cd.generated = self.sql[start + 1:self.toks[self.i - 1].pos]
+                self.accept_kw("stored") or self.accept_kw("virtual")
+            elif self.at_kw("generated"):
+                self.next()
+                self.expect_kw("always")
+                # loops back to the AS ( ... ) branch
             elif self.at_kw("on"):
                 # ON UPDATE CURRENT_TIMESTAMP
                 self.next()
@@ -1131,6 +1149,11 @@ class Parser:
             stmt.kind = "index"
             self.accept_kw("from") or self.accept_kw("in")
             stmt.table = self.parse_table_name()
+        elif self.accept_kw("grants"):
+            stmt.kind = "grants"
+            if self.accept_kw("for"):
+                spec = self.parse_user_spec()
+                stmt.like = f"{spec.user}@{spec.host}"
         elif self.accept_kw("warnings"):
             stmt.kind = "warnings"
         elif self.accept_kw("processlist"):

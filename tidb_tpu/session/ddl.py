@@ -52,7 +52,8 @@ def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInf
                 "only literal / CURRENT_TIMESTAMP defaults supported")
         ft.default_value = dv
     return ColumnInfo(id=col_id, name=cd.name, offset=offset, ft=ft,
-                      comment=cd.comment)
+                      comment=cd.comment,
+                      generated=getattr(cd, "generated", ""))
 
 
 class DDLExecutor:

@@ -127,6 +127,32 @@ def exec_show(sess, stmt):
                          t.comment))
         return _str_chunk(["Name", "Engine", "Row_format", "Rows", "Type",
                            "Comment"], _like_filter(rows, stmt.like))
+    if kind == "grants":
+        pm = sess.domain.priv
+        if stmt.like:
+            user, host = stmt.like.rsplit("@", 1)
+        else:
+            user, host = sess.user, sess.host
+        k = (user.lower(), host)
+        rows = []
+        g = pm.global_privs.get(k) or pm.global_privs.get((user.lower(), "%"))
+        if g:
+            privs = "ALL PRIVILEGES" if g >= set(
+                __import__("tidb_tpu.privilege.privileges",
+                           fromlist=["ALL_PRIVS"]).ALL_PRIVS) else \
+                ", ".join(sorted(p.upper() for p in g))
+            rows.append((f"GRANT {privs} ON *.* TO '{user}'@'{host}'",))
+        for key, privs in pm.db_privs.items():
+            if key[0] == user.lower():
+                rows.append((f"GRANT {', '.join(sorted(p.upper() for p in privs))} "
+                             f"ON {key[2]}.* TO '{user}'@'{host}'",))
+        for key, privs in pm.table_privs.items():
+            if key[0] == user.lower():
+                rows.append((f"GRANT {', '.join(sorted(p.upper() for p in privs))} "
+                             f"ON {key[2]}.{key[3]} TO '{user}'@'{host}'",))
+        if not rows:
+            rows.append((f"GRANT USAGE ON *.* TO '{user}'@'{host}'",))
+        return _str_chunk([f"Grants for {user}@{host}"], rows)
     if kind == "warnings":
         rows = [(w.get("level", "Warning"), w.get("code", 1105),
                  w.get("msg", "")) for w in sess.vars.warnings]
