@@ -1317,3 +1317,25 @@ class TestGeneratedAndGrants:
                    for row in r.rows), r.rows
         r2 = ftk.must_query("show grants")
         assert any("ALL PRIVILEGES" in row[0] for row in r2.rows)
+
+
+class TestEnumAndGuards:
+    def test_enum(self, ftk):
+        ftk.must_exec("create table en (c enum('red','green','blue'))")
+        ftk.must_exec("insert into en values ('red'), ('blue')")
+        e = ftk.exec_err("insert into en values ('purple')")
+        assert isinstance(e, errors.TruncatedWrongValueError)
+        ftk.must_query("select c from en order by c").check(
+            [("blue",), ("red",)])
+
+    def test_insert_select_width(self, ftk):
+        ftk.must_exec("create table iw1 (a int, b int)")
+        ftk.must_exec("create table iw2 (x int)")
+        ftk.must_exec("insert into iw2 values (1)")
+        e = ftk.exec_err("insert into iw1 select x from iw2")
+        assert isinstance(e, errors.WrongValueCountError)
+
+    def test_readonly_targets(self, ftk):
+        e = ftk.exec_err("delete from information_schema.tables")
+        ftk.must_exec("create view rov as select 1 as x")
+        e = ftk.exec_err("update rov set x = 2")

@@ -85,6 +85,11 @@ class InsertExec:
             ex.open()
             visible = [i for i, sc in enumerate(plan.select_plan.schema.cols)
                        if not sc.hidden]
+            if len(visible) != len(plan.col_offsets):
+                from ..errors import WrongValueCountError
+                ex.close()
+                raise WrongValueCountError(
+                    "Column count doesn't match value count")
             try:
                 while True:
                     ch = ex.next()
@@ -132,6 +137,12 @@ class InsertExec:
                 if ci.ft.tp in ("char", "varchar"):
                     raise DataTooLongError(
                         "Data too long for column '%s'", ci.name)
+            if ci.ft.tp == "enum" and not d.is_null and ci.ft.elems and \
+                    str(d.val) not in ci.ft.elems:
+                from ..errors import TruncatedWrongValueError
+                raise TruncatedWrongValueError(
+                    "Incorrect enum value: '%s' for column '%s'",
+                    d.val, ci.name)
             out.append(d)
         return out
 

@@ -1057,6 +1057,9 @@ class PlanBuilder:
         if not isinstance(table_refs, ast.TableName):
             raise UnsupportedError("multi-table DML is not supported yet")
         ds = self.build_datasource(table_refs)
+        if not isinstance(ds, DataSource) or ds.table_info.id < 0 or \
+                ds.table_info.view_select:
+            raise UnsupportedError("the target is not an updatable table")
         p: LogicalPlan = ds
         if where is not None:
             rw = self._rewriter(p.schema)

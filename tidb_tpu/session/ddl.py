@@ -23,6 +23,9 @@ def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInf
     tclass = MYSQL_TYPE_NAMES.get(tname)
     if tclass is None:
         raise UnsupportedError("unsupported column type %s", tname)
+    if tclass in (TypeClass.ENUM, TypeClass.SET):
+        # store as dictionary-encoded strings validated against elems
+        tclass = TypeClass.STRING
     ft = FieldType(tp=tname, tclass=tclass)
     ft.flen = cd.flen
     ft.decimal = cd.decimal

@@ -7,6 +7,8 @@ from .session import Session, Domain, new_store
 
 
 class TestKit:
+    __test__ = False          # not a pytest test class
+
     def __init__(self, domain: Domain | None = None):
         self.domain = domain or new_store()
         self.sess = Session(self.domain)
@@ -36,6 +38,8 @@ class TestKit:
 
 
 class QueryResult:
+    __test__ = False
+
     def __init__(self, rs):
         self.rs = rs
         self.names = rs.names
