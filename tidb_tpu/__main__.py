@@ -49,9 +49,11 @@ def main(argv=None):
                     help="run the MySQL-protocol server")
     ap.add_argument("--port", type=int, default=4000)
     ap.add_argument("-e", "--execute", help="run one statement and exit")
+    ap.add_argument("--data-dir", default=None,
+                    help="persist commits to a WAL in this directory")
     args = ap.parse_args(argv)
     from .session import new_store
-    domain = new_store()
+    domain = new_store(args.data_dir)
     if args.serve:
         domain.start_background()
         from .server import Server

@@ -36,7 +36,8 @@ class _Allocator:
 
 
 class Domain:
-    def __init__(self):
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
         self.storage = Storage()
         self.is_cache = InfoSchemaCache(self.storage)
         self.columnar = ColumnarEngine(self.storage, self._table_info_by_id)
@@ -59,6 +60,22 @@ class Domain:
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256
+        if data_dir:
+            self._open_wal(data_dir)
+
+    def _open_wal(self, data_dir):
+        """Replay the commit log, then attach the writer (durability for
+        the row/meta engines; bulk columnar loads persist via BR)."""
+        import os
+        from ..storage.wal import WalWriter, replay
+        path = os.path.join(data_dir, "commit.wal")
+        for commit_ts, mutations in replay(path):
+            # keep the oracle ahead of replayed commits so the engine hooks
+            # (schema cache reads) see them
+            self.storage.oracle.fast_forward(commit_ts)
+            self.storage.mvcc.apply_replay(commit_ts, mutations)
+        self.is_cache._cached = None     # reload schema from replayed meta
+        self.storage.mvcc.wal = WalWriter(path)
 
     def seq_nextval(self, db_name: str, name: str) -> int:
         """Sequence allocation with cache chunks persisted via meta

@@ -646,9 +646,10 @@ def bootstrap(domain: Domain) -> None:
         "('tidb_server_version', '1', 'Bootstrap version')")
 
 
-def new_store() -> Domain:
+def new_store(data_dir: str | None = None) -> Domain:
     """Create a bootstrapped in-process store (reference
-    testkit.CreateMockStore)."""
-    domain = Domain()
+    testkit.CreateMockStore). With data_dir, commits persist to a WAL and
+    replay on reopen."""
+    domain = Domain(data_dir)
     bootstrap(domain)
     return domain

@@ -57,6 +57,7 @@ class MVCCStore:
         self._locks: dict[bytes, Lock] = {}
         self._mu = threading.Lock()
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
+        self.wal = None              # optional WalWriter
 
     # ---- reads --------------------------------------------------------
     # Reads take the same mutex as commits: the sorted memtable (C++
@@ -129,6 +130,20 @@ class MVCCStore:
                     self._kv.put(key, vers)
                 vers.add(commit_ts, value)
                 del self._locks[key]
+            if self.wal is not None:
+                self.wal.append(commit_ts, mutations)
+        for hook in self.commit_hooks:
+            hook(commit_ts, mutations)
+
+    def apply_replay(self, commit_ts: int, mutations: list):
+        """WAL replay: apply a committed frame directly (no locks/WAL)."""
+        with self._mu:
+            for key, value in mutations:
+                vers = self._kv.get(key)
+                if vers is None:
+                    vers = _Versions()
+                    self._kv.put(key, vers)
+                vers.add(commit_ts, value)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 

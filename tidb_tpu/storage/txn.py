@@ -27,6 +27,15 @@ class Oracle:
         with self._mu:
             return next(self._counter)
 
+    def fast_forward(self, ts: int):
+        """Advance past `ts` (WAL replay)."""
+        with self._mu:
+            cur = next(self._counter)
+            if ts >= cur:
+                self._counter = itertools.count(ts + 1)
+            else:
+                self._counter = itertools.count(cur)
+
 
 class Snapshot:
     __slots__ = ("store", "read_ts")
