@@ -1339,3 +1339,28 @@ class TestEnumAndGuards:
         e = ftk.exec_err("delete from information_schema.tables")
         ftk.must_exec("create view rov as select 1 as x")
         e = ftk.exec_err("update rov set x = 2")
+
+
+class TestMiscStatements:
+    def test_do_flush_alter_user(self, ftk):
+        ftk.must_exec("do 1 + 1, sleep_not_called(0) + 0"
+                      if False else "do 1 + 1")
+        ftk.must_exec("flush privileges")
+        ftk.must_exec("create user au identified by 'old'")
+        ftk.must_exec("alter user au identified by 'new'")
+        assert ftk.domain.priv.auth("au", "%", "new")
+        assert not ftk.domain.priv.auth("au", "%", "old")
+
+    def test_into_outfile(self, ftk, tmp_path):
+        ftk.must_exec("create table of1 (a int, s varchar(5))")
+        ftk.must_exec("insert into of1 values (1,'x'),(2,null)")
+        p = str(tmp_path / "out.tsv")
+        r = ftk.must_exec(f"select * from of1 order by a into outfile '{p}'")
+        assert r.affected == 2
+        content = open(p).read()
+        assert "1\tx" in content and "\\N" in content
+
+    def test_processlist_table(self, ftk):
+        r = ftk.must_query("select id, command from "
+                           "information_schema.processlist")
+        assert any(int(row[0]) == ftk.sess.conn_id for row in r.rows)

@@ -105,6 +105,16 @@ def _gen_cluster_info(domain):
     yield ("tidb-tpu", "127.0.0.1:4000", "127.0.0.1:10080", "0.1.0", "none")
 
 
+def _gen_processlist(domain):
+    for cid, ref in sorted(domain.sessions.items()):
+        s = ref()
+        if s is None:
+            continue
+        busy = bool(domain._live_execs.get(cid))
+        yield (cid, s.user, "localhost", s.vars.current_db or None,
+               "Query" if busy else "Sleep", 0, "")
+
+
 def _gen_key_column_usage(domain):
     ischema = domain.infoschema()
     for db in ischema.all_schemas():
@@ -205,6 +215,9 @@ VIRTUAL_DEFS = {
                            ("non_unique", _I()), ("key_name", _S()),
                            ("seq_in_index", _I()), ("column_name", _S())),
                      _gen_tidb_indexes),
+    "processlist": (_cols(("id", _I()), ("user", _S()), ("host", _S()),
+                          ("db", _S()), ("command", _S()), ("time", _I()),
+                          ("info", _S())), _gen_processlist),
     "cluster_info": (_cols(("type", _S()), ("instance", _S()),
                            ("status_address", _S()), ("version", _S()),
                            ("git_hash", _S())), _gen_cluster_info),

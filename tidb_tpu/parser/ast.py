@@ -253,6 +253,8 @@ class Limit(Node):
 
 @dataclass
 class SelectStmt(StmtNode):
+    # set via INTO OUTFILE 'path'
+    into_outfile: str = ""
     fields: list = field(default_factory=list)    # [SelectField|Wildcard]
     distinct: bool = False
     from_clause: Node | None = None
@@ -534,6 +536,21 @@ class AdminStmt(StmtNode):
 class TraceStmt(StmtNode):
     stmt: StmtNode = None
     format: str = "row"
+
+
+@dataclass
+class DoStmt(StmtNode):
+    exprs: list = field(default_factory=list)
+
+
+@dataclass
+class FlushStmt(StmtNode):
+    what: str = ""
+
+
+@dataclass
+class AlterUserStmt(StmtNode):
+    users: list = field(default_factory=list)
 
 
 @dataclass

@@ -225,6 +225,17 @@ class Parser:
             return ast.DeallocateStmt(name=self.ident())
         if kw in ("grant", "revoke"):
             return self.parse_grant(kw == "revoke")
+        if kw == "do":
+            self.next()
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            return ast.DoStmt(exprs=exprs)
+        if kw == "flush":
+            self.next()
+            what = self.next().text.lower() if self.peek().kind == "IDENT" \
+                else ""
+            return ast.FlushStmt(what=what)
         if kw == "kill":
             self.next()
             self.accept_kw("query") or self.accept_kw("connection")
@@ -293,6 +304,9 @@ class Parser:
                 sel.having = self.parse_expr()
             sel.order_by = self.parse_order_by()
             sel.limit = self.parse_limit()
+            if self.accept_kw("into"):
+                self.expect_kw("outfile")
+                sel.into_outfile = self.next().text
             if self.accept_kw("for"):
                 self.expect_kw("update")
                 sel.for_update = True
@@ -1015,6 +1029,12 @@ class Parser:
 
     def parse_alter(self):
         self.expect_kw("alter")
+        if self.accept_kw("user"):
+            stmt = ast.AlterUserStmt()
+            stmt.users.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.users.append(self.parse_user_spec())
+            return stmt
         self.expect_kw("table")
         stmt = ast.AlterTableStmt(table=self.parse_table_name())
         while True:

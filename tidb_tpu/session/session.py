@@ -299,6 +299,27 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.DoStmt):
+            from ..planner.rewriter import Rewriter
+            from ..planner.schema import Schema
+            pctx = self._plan_ctx()
+            for e in stmt.exprs:
+                Rewriter(pctx, Schema()).rewrite(e)   # evaluate, discard
+            return ResultSet()
+        if isinstance(stmt, ast.FlushStmt):
+            if stmt.what == "privileges":
+                pass      # privilege cache is always live
+            return ResultSet()
+        if isinstance(stmt, ast.AlterUserStmt):
+            self.check_priv("create_user")
+            for u in stmt.users:
+                k = (u.user.lower(), u.host)
+                info = self.domain.priv.users.get(k) or \
+                    self.domain.priv.users.get((u.user.lower(), "%"))
+                if info is None:
+                    raise TiDBError("Unknown user '%s'", u.user)
+                info["password"] = u.password
+            return ResultSet()
         if isinstance(stmt, ast.KillStmt):
             self.check_priv("super")
             self.domain.kill_conn(stmt.conn_id)
@@ -378,6 +399,15 @@ class Session:
         return (sql_key, self.vars.current_db,
                 self.domain.infoschema().version, self.vars.tpu_exec)
 
+    def _write_outfile(self, path, names, chunks):
+        import csv as _csv
+        with open(path, "w", newline="") as f:
+            w = _csv.writer(f, delimiter="\t")
+            for ch in chunks:
+                for i in range(len(ch)):
+                    w.writerow(["\\N" if v is None else v
+                                for v in ch.row_py(i)])
+
     def _exec_select(self, stmt, params=None, sql_key=None) -> ResultSet:
         """sql_key: full statement text for the instance plan cache
         (reference plan_cache.go:205 — here keyed by exact text since
@@ -419,6 +449,14 @@ class Session:
         for ch in chunks:
             out_chunks.append(Chunk([ch.columns[i] for i in vis]))
         self._finish_stmt()
+        if getattr(stmt, "into_outfile", ""):
+            import os as _os
+            if _os.path.exists(stmt.into_outfile):
+                raise TiDBError("File '%s' already exists",
+                                stmt.into_outfile)
+            self._write_outfile(stmt.into_outfile, names, out_chunks)
+            total = sum(len(c) for c in out_chunks)
+            return ResultSet(affected=total)
         return ResultSet(names=names, chunks=out_chunks)
 
     def _lock_for_update(self, plan, chunks):
