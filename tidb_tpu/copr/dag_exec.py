@@ -110,7 +110,11 @@ class CoprExecutor:
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
         if use_mpp and dag.aggs and not overlay and not dag.host_filters \
                 and n >= mpp_min_rows:
-            res = self._try_execute_mpp(dag, tbl, arrays, valid, n, handles)
+            try:
+                res = self._try_execute_mpp(dag, tbl, arrays, valid, n,
+                                            handles)
+            except Exception:               # noqa: BLE001
+                res = None                  # single-chip path always works
             if res is not None:
                 return res
         return self._execute_device(dag, tbl, arrays, valid, n, handles)
@@ -256,7 +260,10 @@ class CoprExecutor:
                 out.append(res)
                 continue
             if dag.topn is not None:
-                idx = self._run_topn_partition(dag, tbl, cols, v, m, cap)
+                try:
+                    idx = self._run_topn_partition(dag, tbl, cols, v, m, cap)
+                except Exception:           # noqa: BLE001
+                    idx = self._topn_host(dag, cols, v, m)
                 chunk_cols = []
                 for sc in dag.cols:
                     data, nulls, sdict = cols[sc.col.idx]

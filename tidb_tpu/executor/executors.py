@@ -1278,23 +1278,13 @@ class HashJoinExec(Executor):
                       (mode == "auto" and _backend_is_accel()))
         if use_device and bv.dtype == np.int64 and pv.dtype == np.int64 \
                 and not plan.other_conds:
-            from ..ops.device_join import device_join_index
-            if jt in ("semi", "anti"):
-                matched, _ = device_join_index(bv, bnull, pv, pnull,
-                                               semi_only=True)
-                sel = np.nonzero(matched if jt == "semi" else ~matched)[0]
-                return self._emit(probe, sel, None, None)
-            pi, bi = device_join_index(bv, bnull, pv, pnull)
-            if jt in ("semi", "anti"):
-                return self._semi_result(probe, pi, jt)
-            if outer:
-                matched = np.zeros(len(probe), dtype=bool)
-                matched[pi] = True
-                un = np.nonzero(~matched)[0]
-                if len(un):
-                    inner = self._emit(probe, pi, build, bi)
-                    return inner.concat(self._emit(probe, un, None, None))
-            return self._emit(probe, pi, build, bi)
+            try:
+                return self._device_join(plan, jt, outer, probe, build,
+                                         bv, bnull, pv, pnull)
+            except Exception:               # noqa: BLE001
+                # device kernels unavailable/failed: host path is always
+                # correct; record and continue
+                self.ctx.sess.domain.inc_metric("device_join_fallback")
         border = np.argsort(bv, kind="stable")
         sbv = bv[border]
         lo = np.searchsorted(sbv, pv, side="left")
@@ -1339,6 +1329,24 @@ class HashJoinExec(Executor):
                 inner = self._emit(probe, pi, build, bi)
                 outer_part = self._emit(probe, un, None, None)
                 return inner.concat(outer_part)
+        return self._emit(probe, pi, build, bi)
+
+    def _device_join(self, plan, jt, outer, probe, build, bv, bnull,
+                     pv, pnull):
+        from ..ops.device_join import device_join_index
+        if jt in ("semi", "anti"):
+            matched, _ = device_join_index(bv, bnull, pv, pnull,
+                                           semi_only=True)
+            sel = np.nonzero(matched if jt == "semi" else ~matched)[0]
+            return self._emit(probe, sel, None, None)
+        pi, bi = device_join_index(bv, bnull, pv, pnull)
+        if outer:
+            matched = np.zeros(len(probe), dtype=bool)
+            matched[pi] = True
+            un = np.nonzero(~matched)[0]
+            if len(un):
+                inner = self._emit(probe, pi, build, bi)
+                return inner.concat(self._emit(probe, un, None, None))
         return self._emit(probe, pi, build, bi)
 
     def _semi_result(self, probe, pi, jt):
