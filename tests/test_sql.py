@@ -234,6 +234,25 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestNullAwareAntiJoin:
+    def test_not_in_null_semantics(self, ftk):
+        ftk.must_exec("create table na_a (x int)")
+        ftk.must_exec("create table na_b (y int)")
+        ftk.must_exec("insert into na_a values (1),(2),(null)")
+        ftk.must_exec("insert into na_b values (2),(null)")
+        # inner side contains NULL: NOT IN is FALSE or NULL for every row
+        ftk.must_query("select x from na_a where x not in "
+                       "(select y from na_b)").check([])
+        ftk.must_exec("delete from na_b where y is null")
+        ftk.must_query("select x from na_a where x not in "
+                       "(select y from na_b) order by x").check([(1,)])
+        # empty inner side: NOT IN is TRUE even for a NULL probe
+        ftk.must_exec("delete from na_b")
+        ftk.must_query("select x from na_a where x not in "
+                       "(select y from na_b) order by x").check(
+            [(None,), (1,), (2,)])
+
+
 class TestSubquery:
     def test_scalar(self, tk):
         tk.must_exec("drop table if exists sq")
@@ -974,6 +993,35 @@ class TestWindowFrames:
             "select v, first_value(v) over (order by v rows between "
             "2 preceding and current row) from wf2 order by v").check([
                 (1, 1), (2, 1), (3, 1), (4, 2), (5, 3)])
+
+    def test_range_frames(self, ftk):
+        ftk.must_exec("create table wr (g int, k int, v int)")
+        ftk.must_exec("insert into wr values (1,1,10),(1,2,20),(1,4,40),"
+                      "(1,8,80),(2,1,5),(2,2,6),(1,null,99)")
+        # value-based frame: k=2 reaches k=1..3; k=4 reaches only itself
+        ftk.must_query(
+            "select g, k, sum(v) over (partition by g order by k range "
+            "between 1 preceding and 1 following) from wr "
+            "order by g, k").check([
+                (1, None, "99"), (1, 1, "30"), (1, 2, "30"), (1, 4, "40"),
+                (1, 8, "80"), (2, 1, "11"), (2, 2, "11")])
+        # unbounded start includes the NULL block; numeric end is by value
+        ftk.must_query(
+            "select k, count(*) over (order by k range between unbounded "
+            "preceding and 2 following) from wr where g = 1 "
+            "order by k").check([
+                (None, 1), (1, 3), (2, 4), (4, 4), (8, 5)])
+        # DESC: preceding/following run along the sort direction
+        ftk.must_query(
+            "select k, sum(v) over (order by k desc range between "
+            "1 preceding and 1 following) from wr where g = 1 and "
+            "k is not null order by k desc").check([
+                (8, "80"), (4, "40"), (2, "30"), (1, "30")])
+        # min/max over a value frame (sparse-table path)
+        ftk.must_query(
+            "select k, max(v) over (order by k range between 2 preceding "
+            "and 2 following) from wr where g = 1 and k is not null "
+            "order by k").check([(1, 20), (2, 40), (4, 40), (8, 80)])
 
 
 class TestRecursiveCTE:

@@ -1164,6 +1164,7 @@ class HashJoinExec(Executor):
                        for ch in chs for c in ch.columns)
         quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
         if plan.eq_conds and \
+                not getattr(plan, "null_aware", False) and \
                 chunks_bytes(build_chunks) + chunks_bytes(probe_chunks) > quota:
             return self._grace_join(build_chunks, probe_chunks)
         build = Chunk.concat_all(build_chunks)
@@ -1273,11 +1274,17 @@ class HashJoinExec(Executor):
         else:
             bv, pv = self._combine_keys(bk, pk)
 
+        naaj = jt == "anti" and getattr(plan, "null_aware", False)
+        if naaj and bnull.any():
+            # inner side contains NULL: x NOT IN S is FALSE (match) or
+            # NULL (no match) for every x -> empty result
+            return Chunk.empty(out_fts)
+
         mode = str(self.ctx.sv.get("tidb_join_exec"))
         use_device = (mode == "device" or
                       (mode == "auto" and _backend_is_accel()))
-        if use_device and bv.dtype == np.int64 and pv.dtype == np.int64 \
-                and not plan.other_conds:
+        if use_device and not naaj and bv.dtype == np.int64 \
+                and pv.dtype == np.int64 and not plan.other_conds:
             try:
                 return self._device_join(plan, jt, outer, probe, build,
                                          bv, bnull, pv, pnull)
@@ -1320,7 +1327,8 @@ class HashJoinExec(Executor):
             pi, bi = pi[mask], bi[mask]
 
         if jt in ("semi", "anti"):
-            return self._semi_result(probe, pi, jt)
+            return self._semi_result(probe, pi, jt,
+                                     pnull if naaj else None)
         if outer:
             matched = np.zeros(len(probe), dtype=bool)
             matched[pi] = True
@@ -1349,10 +1357,14 @@ class HashJoinExec(Executor):
                 return inner.concat(self._emit(probe, un, None, None))
         return self._emit(probe, pi, build, bi)
 
-    def _semi_result(self, probe, pi, jt):
+    def _semi_result(self, probe, pi, jt, exclude_null=None):
         matched = np.zeros(len(probe), dtype=bool)
         matched[pi] = True
-        sel = np.nonzero(matched if jt == "semi" else ~matched)[0]
+        keep = matched if jt == "semi" else ~matched
+        if exclude_null is not None:
+            # null-aware anti: NULL NOT IN <non-empty S> is NULL -> drop
+            keep = keep & ~exclude_null
+        sel = np.nonzero(keep)[0]
         return self._emit(probe, sel, None, None)
 
     def _joined_schema(self):

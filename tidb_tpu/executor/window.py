@@ -128,8 +128,13 @@ class WindowExec(Executor):
         if d.frame is not None and name in ("sum", "avg", "count", "min",
                                             "max", "first_value",
                                             "last_value"):
-            sorted_out, sorted_nulls = self._fn_rows_frame(
-                d, svals, sok, part_start, part_end, n)
+            if d.frame[0] == "range":
+                lo, hi_excl = self._range_bounds(d, part_start, part_end,
+                                                 n, ectx, order)
+            else:
+                lo, hi_excl = self._rows_bounds(d, part_start, part_end, n)
+            sorted_out, sorted_nulls = self._frame_eval(
+                d, svals, sok, lo, hi_excl, n)
         else:
             sorted_out, sorted_nulls = self._fn(
                 name, d, svals, sok, seq, size, part_start, part_end,
@@ -147,17 +152,76 @@ class WindowExec(Executor):
         return Column(d.ft, out, nulls, asd if name in (
             "lag", "lead", "first_value", "last_value", "min", "max") else None)
 
-    def _fn_rows_frame(self, d, svals, sok, part_start, part_end, n):
-        """Bounded ROWS frame [i-prec, i+fol] clipped to the partition
-        (reference window frame executor). Sums/counts via prefix sums;
-        min/max via per-row reduction over frame indices (frame width
-        capped)."""
+    def _rows_bounds(self, d, part_start, part_end, n):
+        """ROWS frame: [i-prec, i+fol] clipped to the partition."""
         _, n_prec, n_fol = d.frame
         idx = np.arange(n)
         lo = part_start if n_prec is None else np.maximum(part_start,
                                                           idx - n_prec)
         hi_excl = part_end if n_fol is None else np.minimum(part_end,
                                                             idx + n_fol + 1)
+        return lo, hi_excl
+
+    def _range_bounds(self, d, part_start, part_end, n, ectx, order):
+        """RANGE frame with numeric offsets (reference
+        pkg/executor/internal/vecgroupchecker + range framer semantics):
+        frame = rows in the partition whose single ORDER BY key lies within
+        [cur-prec, cur+fol] along the sort direction. NULL-key rows form
+        their own peer frame; numeric bounds never reach them. Per-partition
+        searchsorted over the (already sorted) key block."""
+        _, n_prec, n_fol = d.frame
+        if len(d.order_by) != 1:
+            raise UnsupportedError(
+                "RANGE frame with offsets requires exactly one ORDER BY")
+        e, desc = d.order_by[0]
+        data, nulls, sd = eval_expr(ectx, e)
+        nm = np.asarray(materialize_nulls(ectx, nulls))
+        arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
+        if sd is not None or arr.dtype == object:
+            raise UnsupportedError("RANGE frame ORDER BY key must be numeric")
+        scale = 1
+        if e.ft.tclass == TypeClass.DECIMAL:
+            scale = int(_POW10[max(e.ft.decimal, 0)])
+        keys = arr.astype(np.float64)
+        sign = -1.0 if desc else 1.0
+        k = (keys * sign)[order]
+        knull = nm[order]
+        lo = np.empty(n, dtype=np.int64)
+        hi = np.empty(n, dtype=np.int64)
+        starts = np.unique(part_start) if n else np.array([], dtype=np.int64)
+        for s0 in starts:
+            e0 = int(part_end[s0])
+            s0 = int(s0)
+            seg_null = knull[s0:e0]
+            nn = int(seg_null.sum())
+            if nn:
+                # sort keys put NULLs first (asc) / last (desc)
+                null_first = bool(seg_null[0])
+                nlo, nhi = (s0, s0 + nn) if null_first else (e0 - nn, e0)
+                lo[nlo:nhi] = nlo
+                hi[nlo:nhi] = nhi
+                vlo, vhi = (nhi, e0) if null_first else (s0, nlo)
+            else:
+                vlo, vhi = s0, e0
+            if vhi > vlo:
+                seg = k[vlo:vhi]
+                cur = seg
+                if n_prec is None:
+                    lo[vlo:vhi] = s0      # unbounded: includes NULL block
+                else:
+                    lo[vlo:vhi] = vlo + np.searchsorted(
+                        seg, cur - n_prec * scale * 1.0, side="left")
+                if n_fol is None:
+                    hi[vlo:vhi] = e0
+                else:
+                    hi[vlo:vhi] = vlo + np.searchsorted(
+                        seg, cur + n_fol * scale * 1.0, side="right")
+        return lo, hi
+
+    def _frame_eval(self, d, svals, sok, lo, hi_excl, n):
+        """Evaluate an aggregate over per-row frame bounds [lo, hi_excl).
+        Sums/counts via prefix sums; min/max via an O(n log n) sparse table
+        (vectorized range-reduce; no frame-width cap)."""
         empty = hi_excl <= lo
         name = d.name
         if name == "first_value":
@@ -192,27 +256,36 @@ class WindowExec(Executor):
                 q = np.where(2 * np.abs(r) >= safe, q + np.sign(num), q)
                 return q, nulls
             return s.astype(np.float64) / np.maximum(c, 1), nulls
-        # min/max: reduce over explicit frame offsets (width-capped)
-        prec = 0 if n_prec is None else n_prec
-        fol = 0 if n_fol is None else n_fol
-        if n_prec is None or n_fol is None or prec + fol > 4096:
-            raise UnsupportedError(
-                "ROWS frame too wide for min/max (cap 4096)")
+        # min/max: sparse-table range reduce over [lo, hi_excl)
         if svals.dtype.kind == "f":
             ident = np.inf if name == "min" else -np.inf
         else:
             ident = _I64_MAX if name == "min" else -_I64_MAX
-        filled = np.where(sok, svals, ident)
-        out = np.full(n, ident, dtype=filled.dtype)
-        cnt = np.zeros(n, dtype=np.int64)
         op = np.minimum if name == "min" else np.maximum
-        for off in range(-prec, fol + 1):
-            j = idx + off
-            valid = (j >= lo) & (j < hi_excl) & (j >= 0) & (j < n)
-            jj = np.clip(j, 0, max(n - 1, 0))
-            out = np.where(valid, op(out, filled[jj]), out)
-            cnt += valid & sok[np.clip(j, 0, max(n - 1, 0))]
-        return out, cnt == 0
+        filled = np.where(sok, svals, ident)
+        levels = [filled]                      # levels[j][i] = op over
+        j = 0                                  # [i, i+2^j) clipped to n
+        while (1 << (j + 1)) <= max(n, 1):
+            prev = levels[j]
+            step = 1 << j
+            nxt = prev.copy()
+            nxt[: n - step] = op(prev[: n - step], prev[step:])
+            levels.append(nxt)
+            j += 1
+        w = np.maximum(hi_excl - lo, 1)
+        jsel = np.int64(np.floor(np.log2(w)))
+        out = np.full(n, ident, dtype=filled.dtype)
+        for jj, sp in enumerate(levels):
+            m = (jsel == jj) & ~empty
+            if m.any():
+                li = lo[m]
+                ri = hi_excl[m] - (1 << jj)
+                out[m] = op(sp[li], sp[ri])
+        cnt_cum = np.cumsum(sok.astype(np.int64))
+        hi_i = np.clip(hi_excl - 1, 0, max(n - 1, 0))
+        c = cnt_cum[hi_i] - np.where(lo > 0,
+                                     cnt_cum[np.maximum(lo - 1, 0)], 0)
+        return out, (c <= 0) | empty
 
     def _fn(self, name, d, svals, sok, seq, size, part_start, part_end,
             peer_start, peer_end, part_flag, n, ectx):

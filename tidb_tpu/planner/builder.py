@@ -385,9 +385,6 @@ class PlanBuilder:
                 return None
             if f.start == "unbounded_preceding" and f.end == "current_row":
                 return None            # default semantics
-            if f.unit != "rows":
-                raise UnsupportedError(
-                    "RANGE frames with offsets not supported yet")
 
             def bound(s, is_start):
                 if s == "current_row":
@@ -405,7 +402,7 @@ class PlanBuilder:
             n_fol = (-endb) if endb is not None else None
             if endb is not None and endb > 0:
                 n_fol = -endb               # "N preceding" as end
-            return ("rows", n_prec, n_fol)
+            return (f.unit, n_prec, n_fol)
 
         def window_mapper(node):
             frame = parse_frame(node)
@@ -600,9 +597,15 @@ class PlanBuilder:
             jt = "anti" if c.negated else "semi"
             join = self._mk_semi_join(jt, p, splan, eq_pairs, others)
             if c.negated:
-                # NOT IN: a NULL probe value compares NULL -> excluded
-                # (divergence note: an all-NULL inner side should null out
-                # every row; not modeled — matches common TPC-H-safe subset)
+                if len(join.eq_conds) == 1 and not others:
+                    # uncorrelated NOT IN: null-aware anti join (reference
+                    # pkg/planner/core null-aware anti semi join) — the
+                    # executor models the full 3-valued semantics: inner
+                    # NULL nulls out non-matching rows, empty inner keeps
+                    # NULL probes
+                    join.null_aware = True
+                    return join
+                # correlated NOT IN: NULL probe compares NULL -> excluded
                 guard = rw.mk_func("isnotnull", [outer_e2])
                 sel = Selection([guard], join)
                 sel.stats_rows = join.stats_rows

@@ -83,6 +83,7 @@ class LJoin(LogicalPlan):
         self.join_type = join_type           # inner | left | right | semi | anti | cross
         self.eq_conds: list[tuple] = []      # [(left Column, right Column)]
         self.other_conds: list[Expression] = []
+        self.null_aware = False              # NAAJ (NOT IN null semantics)
 
     def explain_info(self):
         return (f"{self.join_type}, eq:{[(repr(a), repr(b)) for a, b in self.eq_conds]}"

@@ -178,6 +178,7 @@ class PhysHashJoin(PhysPlan):
         self.build_side = build_side      # 0 = left child builds, 1 = right
         self.eq_conds = eq_conds
         self.other_conds = other_conds
+        self.null_aware = False
 
     def explain_info(self):
         return (f"{self.join_type}, build:{'left' if self.build_side == 0 else 'right'}, "
@@ -338,6 +339,7 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
             build = 0 if plan.children[0].stats_rows <= plan.children[1].stats_rows else 1
         p = PhysHashJoin(plan.join_type, build, plan.eq_conds,
                          plan.other_conds, plan.schema, left, right)
+        p.null_aware = getattr(plan, "null_aware", False)
         p.stats_rows = plan.stats_rows
         return p
     if isinstance(plan, Sort):
