@@ -234,6 +234,59 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestBindingsAndHints:
+    def test_hints_parse_and_execute(self, ftk):
+        ftk.must_exec("create table bh1 (a int, b int)")
+        ftk.must_exec("create table bh2 (a int, c int)")
+        ftk.must_exec("insert into bh1 values (1,10),(2,20)")
+        ftk.must_exec("insert into bh2 values (1,5),(2,6)")
+        # LEADING flips the join order; results must be unchanged
+        ftk.must_query(
+            "select /*+ LEADING(bh2, bh1), MAX_EXECUTION_TIME(60000) */ "
+            "bh1.b, bh2.c from bh1, bh2 where bh1.a = bh2.a "
+            "order by bh1.b").check([(10, 5), (20, 6)])
+
+    def test_global_binding_lifecycle(self, ftk):
+        ftk.must_exec("create table bg1 (a int)")
+        ftk.must_exec("create table bg2 (a int)")
+        ftk.must_exec("insert into bg1 values (1),(2)")
+        ftk.must_exec("insert into bg2 values (2),(3)")
+        ftk.must_exec(
+            "create global binding for "
+            "select count(*) from bg1, bg2 where bg1.a = bg2.a "
+            "using select /*+ LEADING(bg2), MEMORY_QUOTA(8 MB) */ "
+            "count(*) from bg1, bg2 where bg1.a = bg2.a")
+        assert len(ftk.must_query("show global bindings").rows) == 1
+        # different case/whitespace still digest-matches
+        ftk.must_query("SELECT COUNT(*) FROM bg1, bg2 "
+                       "WHERE bg1.a = bg2.a").check([(1,)])
+        ftk.must_query("select @@last_plan_from_binding").check([(1,)])
+        ftk.must_query("select count(*) from bg1").check([(2,)])
+        ftk.must_query("select @@last_plan_from_binding").check([(0,)])
+        ftk.must_exec(
+            "drop global binding for "
+            "select count(*) from bg1, bg2 where bg1.a = bg2.a")
+        assert ftk.must_query("show global bindings").rows == []
+
+    def test_session_binding_shadows(self, ftk):
+        ftk.must_exec("create table bs1 (v int)")
+        ftk.must_exec("insert into bs1 values (3),(4)")
+        ftk.must_exec("create binding for select sum(v) from bs1 "
+                      "using select /*+ HASH_AGG() */ sum(v) from bs1")
+        assert len(ftk.must_query("show bindings").rows) == 1
+        ftk.must_query("select sum(v) from bs1").check([("7",)])
+        ftk.must_query("select @@last_plan_from_binding").check([(1,)])
+        # other sessions don't see a SESSION binding
+        tk2 = ftk.new_session()
+        assert tk2.must_query("show bindings").rows == []
+
+    def test_var_reads_not_plan_cached(self, ftk):
+        ftk.must_exec("set @bv = 7")
+        ftk.must_query("select @bv").check([(7,)])
+        ftk.must_exec("set @bv = 9")
+        ftk.must_query("select @bv").check([(9,)])
+
+
 class TestNullAwareAntiJoin:
     def test_not_in_null_semantics(self, ftk):
         ftk.must_exec("create table na_a (x int)")

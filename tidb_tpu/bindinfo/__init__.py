@@ -1,0 +1,3 @@
+from .handler import BindHandle, BindRecord
+
+__all__ = ["BindHandle", "BindRecord"]

@@ -1,0 +1,62 @@
+"""Plan baselines / SQL bindings (reference pkg/bindinfo — BindHandle,
+bindRecord; re-designed: a binding maps the normalized digest of a
+statement to the optimizer-hint set extracted from the bound statement;
+at plan time the session injects those hints before optimization).
+
+GLOBAL bindings live on the Domain (shared across sessions, version-
+stamped so plan-cache keys invalidate on change); SESSION bindings live
+on the session and shadow global ones (reference bindinfo matching
+order: session > global).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..parser.digester import normalize_digest
+
+
+@dataclass
+class BindRecord:
+    original_sql: str          # normalized FOR statement
+    bind_sql: str              # the hinted USING statement text
+    digest: str
+    hints: list = field(default_factory=list)
+    status: str = "enabled"
+    source: str = "manual"
+
+
+class BindHandle:
+    def __init__(self):
+        self._binds: dict[str, BindRecord] = {}
+        self._mu = threading.Lock()
+        self.version = 0
+
+    def create(self, for_sql: str, using_sql: str, hints: list) -> BindRecord:
+        norm, digest = normalize_digest(for_sql)
+        rec = BindRecord(original_sql=norm, bind_sql=using_sql,
+                         digest=digest, hints=list(hints or ()))
+        with self._mu:
+            self._binds[digest] = rec
+            self.version += 1
+        return rec
+
+    def drop(self, for_sql: str) -> int:
+        _, digest = normalize_digest(for_sql)
+        with self._mu:
+            n = 1 if self._binds.pop(digest, None) is not None else 0
+            if n:
+                self.version += 1
+        return n
+
+    def match(self, digest: str) -> BindRecord | None:
+        rec = self._binds.get(digest)
+        if rec is not None and rec.status == "enabled":
+            return rec
+        return None
+
+    def list(self) -> list[BindRecord]:
+        return list(self._binds.values())
+
+    def __len__(self):
+        return len(self._binds)

@@ -16,16 +16,19 @@ from ..errors import QueryKilledError, MemoryQuotaExceededError
 
 
 class ExecContext:
-    def __init__(self, sess):
+    def __init__(self, sess, exec_hints=None):
         import time as _time
         self.sess = sess
         self.sv = sess.vars
         self.copr = sess.domain.copr
         self.killed = False
         self.warnings = []
-        self.mem_tracker = sess.domain.mem_tracker_factory(
-            self.sv.mem_quota_query)
-        limit_ms = int(self.sv.get("max_execution_time"))
+        eh = exec_hints or {}
+        self.force_mpp = eh.get("force_mpp")   # None = follow sysvar
+        quota = eh.get("mem_quota", self.sv.mem_quota_query)
+        self.mem_tracker = sess.domain.mem_tracker_factory(quota)
+        limit_ms = eh.get("max_exec_ms",
+                          int(self.sv.get("max_execution_time")))
         self.deadline = (_time.time() + limit_ms / 1000.0) if limit_ms else None
 
     def check_killed(self):

@@ -106,10 +106,13 @@ class TableReaderExec(Executor):
         sv = self.ctx.sv
         out = []
         for dag in self._part_dags():
+            fm = getattr(self.ctx, "force_mpp", None)
             out.extend(self.ctx.copr.execute(
                 dag, self._overlay(dag), self.ctx.read_ts(),
-                use_mpp=bool(sv.get("tidb_enable_mpp")),
-                mpp_min_rows=int(sv.get("tidb_mpp_min_rows"))))
+                use_mpp=bool(sv.get("tidb_enable_mpp")) if fm is None
+                else fm,
+                mpp_min_rows=0 if fm
+                else int(sv.get("tidb_mpp_min_rows"))))
         return out
 
 

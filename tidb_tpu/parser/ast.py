@@ -233,6 +233,20 @@ class StmtNode(Node):
 
 
 @dataclass
+class CreateBindingStmt(StmtNode):
+    is_global: bool = False
+    for_sql: str = ""          # original statement text
+    using_sql: str = ""        # hinted statement text
+    hints: list = field(default_factory=list)   # parsed from using_sql
+
+
+@dataclass
+class DropBindingStmt(StmtNode):
+    is_global: bool = False
+    for_sql: str = ""
+
+
+@dataclass
 class SelectField(Node):
     expr: ExprNode
     alias: str = ""

@@ -1,0 +1,74 @@
+"""Optimizer hint parsing (reference pkg/parser/hintparser.y +
+pkg/util/hint/hint.go — re-designed as a tiny regex grammar over the
+`/*+ ... */` comment text the lexer surfaces as HINT tokens).
+
+A hint list is `NAME(args), NAME, ...`; args may be identifiers
+(`LEADING(t1, t2)`), sized values (`MEMORY_QUOTA(64 MB)`), numbers
+(`MAX_EXECUTION_TIME(1000)`), or storage selectors
+(`READ_FROM_STORAGE(TIFLASH[t1, t2])`).
+"""
+from __future__ import annotations
+
+import re
+
+_HINT_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)\s*(?:\(([^)]*)\))?")
+
+# hints the engine acts on; anything else is accepted and ignored with a
+# warning-free pass (reference behavior: unknown hints warn, don't error)
+EFFECTIVE = {"leading", "memory_quota", "max_execution_time",
+             "read_from_storage", "hash_join", "merge_join", "inl_join",
+             "hash_agg", "stream_agg", "agg_to_cop", "use_index",
+             "ignore_index", "no_decorrelate", "set_var"}
+
+
+def parse_hints(text: str) -> list:
+    """'/*+' body text -> [(name_lower, [arg, ...]), ...]."""
+    out = []
+    for m in _HINT_RE.finditer(text or ""):
+        name = m.group(1).lower()
+        raw = m.group(2)
+        args = []
+        if raw:
+            for part in raw.split(","):
+                part = part.strip().strip("`")
+                if part:
+                    args.append(part)
+        out.append((name, args))
+    return out
+
+
+def exec_hints(hints: list) -> dict:
+    """Extract execution-time overrides from a parsed hint list."""
+    out = {}
+    for name, args in hints or ():
+        if name == "memory_quota" and args:
+            m = re.match(r"(\d+)\s*([MG]B?)?", args[0], re.I)
+            if m:
+                n = int(m.group(1))
+                unit = (m.group(2) or "").upper()
+                mult = 1 << 30 if unit.startswith("G") else 1 << 20
+                out["mem_quota"] = n * mult
+        elif name == "max_execution_time" and args:
+            try:
+                out["max_exec_ms"] = int(args[0])
+            except ValueError:
+                pass
+        elif name == "read_from_storage" and args:
+            engine = args[0].split("[")[0].strip().lower()
+            if engine == "tiflash":
+                out["force_mpp"] = True
+            elif engine == "tikv":
+                out["force_mpp"] = False
+        elif name == "set_var" and args:
+            kv = args[0].split("=", 1)
+            if len(kv) == 2:
+                out.setdefault("set_vars", {})[
+                    kv[0].strip().lower()] = kv[1].strip().strip("'\"")
+    return out
+
+
+def leading_order(hints: list) -> list:
+    for name, args in hints or ():
+        if name == "leading" and args:
+            return [a.lower() for a in args]
+    return []

@@ -30,7 +30,11 @@ _TIME_UNITS = {"microsecond", "second", "minute", "hour", "day", "week",
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
-        self.toks = tokenize(sql)
+        toks = tokenize(sql)
+        # pull optimizer hints out of the stream (they may sit after
+        # SELECT/UPDATE/... keywords); parse_stmt attaches them
+        self.hint_texts = [t.text for t in toks if t.kind == "HINT"]
+        self.toks = [t for t in toks if t.kind != "HINT"]
         self.i = 0
         self.n_params = 0
 
@@ -98,8 +102,15 @@ class Parser:
         return stmts
 
     def parse_stmt(self) -> ast.StmtNode:
-        while self.peek().kind == "HINT":
-            self.next()  # statement-level hints: accepted, currently unused
+        node = self._parse_stmt_inner()
+        if self.hint_texts and not getattr(node, "hints", None) and \
+                isinstance(node, (ast.SelectStmt, ast.InsertStmt,
+                                  ast.UpdateStmt, ast.DeleteStmt)):
+            from .hints import parse_hints
+            node.hints = parse_hints(" ".join(self.hint_texts))
+        return node
+
+    def _parse_stmt_inner(self) -> ast.StmtNode:
         t = self.peek()
         if t.kind == "OP" and t.text == "(":
             return self.parse_select()
@@ -653,6 +664,29 @@ class Parser:
 
     def parse_create(self):
         self.expect_kw("create")
+        if (self.at_kw("global", "session") and
+                self.peek(1).kind == "IDENT" and
+                self.peek(1).text.lower() == "binding") or \
+                self.at_kw("binding"):
+            is_global = False
+            if self.at_kw("global", "session"):
+                is_global = self.next().text.lower() == "global"
+            self.expect_kw("binding")
+            self.expect_kw("for")
+            start = self.peek().pos
+            self._parse_stmt_inner()
+            end = self.peek().pos
+            for_sql = self.sql[start:end].strip()
+            self.expect_kw("using")
+            ustart = self.peek().pos
+            self._parse_stmt_inner()
+            uend = self.peek().pos if not self.at_op(";") \
+                and self.peek().kind != "EOF" else len(self.sql)
+            using_sql = self.sql[ustart:uend].rstrip("; \t\n")
+            from .hints import parse_hints
+            return ast.CreateBindingStmt(
+                is_global=is_global, for_sql=for_sql, using_sql=using_sql,
+                hints=parse_hints(" ".join(self.hint_texts)))
         if self.accept_kw("sequence"):
             ine = False
             if self.accept_kw("if"):
@@ -988,6 +1022,21 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if (self.at_kw("global", "session") and
+                self.peek(1).kind == "IDENT" and
+                self.peek(1).text.lower() == "binding") or \
+                self.at_kw("binding"):
+            is_global = False
+            if self.at_kw("global", "session"):
+                is_global = self.next().text.lower() == "global"
+            self.expect_kw("binding")
+            self.expect_kw("for")
+            start = self.peek().pos
+            self._parse_stmt_inner()
+            end = self.peek().pos if not self.at_op(";") \
+                and self.peek().kind != "EOF" else len(self.sql)
+            return ast.DropBindingStmt(is_global=is_global,
+                                       for_sql=self.sql[start:end].strip())
         if self.accept_kw("sequence"):
             ie = False
             if self.accept_kw("if"):
@@ -1143,7 +1192,9 @@ class Parser:
             stmt.is_global = True
         else:
             self.accept_kw("session")
-        if self.accept_kw("table") and self.accept_kw("status"):
+        if self.accept_kw("bindings"):
+            stmt.kind = "bindings"
+        elif self.accept_kw("table") and self.accept_kw("status"):
             stmt.kind = "table_status"
             if self.accept_kw("from") or self.accept_kw("in"):
                 stmt.db = self.ident()

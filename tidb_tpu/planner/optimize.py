@@ -71,12 +71,18 @@ class PlanContext:
 def optimize(stmt, pctx: PlanContext):
     """AST statement -> physical plan (SELECT) or DML plan descriptor."""
     builder = PlanBuilder(pctx)
+    hints = getattr(stmt, "hints", None) or []
     if isinstance(stmt, ast.SelectStmt):
         logical = builder.build_select(stmt)
-        logical = optimize_logical(logical)
+        logical = optimize_logical(logical, hints=hints)
         phys = to_physical(logical, pctx.sess_vars)
         phys.read_tables = frozenset(pctx.read_tables)
         phys.for_update = stmt.for_update
+        if hints:
+            from ..parser.hints import exec_hints
+            eh = exec_hints(hints)
+            if eh:
+                phys.exec_hints = eh
         return phys
     if isinstance(stmt, ast.InsertStmt):
         plan = builder.build_insert(stmt)

@@ -140,6 +140,8 @@ class Rewriter:
         self.schema.resolve(node.name, node.table, node.db)
 
     def _rw_VariableExpr(self, node: ast.VariableExpr):
+        # folded at plan time from mutable session state: never cache
+        self.pctx.cacheable = False
         if node.is_system:
             v = self.pctx.sess_vars.get(node.name)
             if isinstance(v, bool):
@@ -367,6 +369,15 @@ class Rewriter:
             return const_from_py("root@%")
         if name == "connection_id":
             return const_from_py(self.pctx.conn_id)
+        if name == "charset" and node.args:
+            return const_from_py("utf8mb4")
+        if name == "collation" and node.args:
+            arg = self.rewrite(node.args[0])
+            coll = getattr(getattr(arg, "ft", None), "collate", None)
+            return const_from_py(coll or "utf8mb4_bin")
+        if name == "coercibility" and node.args:
+            arg = node.args[0]
+            return const_from_py(4 if isinstance(arg, ast.Literal) else 2)
         if name == "last_insert_id" and not node.args:
             return const_from_py(self.pctx.sess_vars.last_insert_id)
         if name in ("nextval", "lastval") and node.args:

@@ -10,9 +10,14 @@ from .logical import (LogicalPlan, DataSource, Selection, Projection,
 from .builder import ProjShell
 
 
-def optimize_logical(plan: LogicalPlan, keep_handles=False) -> LogicalPlan:
+def optimize_logical(plan: LogicalPlan, keep_handles=False,
+                     hints=None) -> LogicalPlan:
+    leading = []
+    if hints:
+        from ..parser.hints import leading_order
+        leading = leading_order(hints)
     plan = push_down_predicates(plan, [])
-    plan = reorder_joins(plan)
+    plan = reorder_joins(plan, leading)
     used = {sc.col.idx for sc in plan.schema.cols}
     prune_columns(plan, used)
     plan = build_topn(plan)
@@ -21,21 +26,56 @@ def optimize_logical(plan: LogicalPlan, keep_handles=False) -> LogicalPlan:
 
 # ---------------- join reordering (greedy) ----------------
 
-def reorder_joins(plan: LogicalPlan) -> LogicalPlan:
+def reorder_joins(plan: LogicalPlan, leading=None) -> LogicalPlan:
     """Greedy reorder of maximal inner-join regions by estimated rows
     (reference planner/core/rule_join_reorder.go greedy solver). Outer/
-    semi/anti joins are barriers; their children reorder independently."""
+    semi/anti joins are barriers; their children reorder independently.
+    A LEADING(t1, t2, ...) hint pins the front of the join order
+    (reference hint_utils.go leading hint)."""
     if isinstance(plan, LJoin) and plan.join_type == "inner":
         rels, eqs, others = [], [], []
         _flatten_inner(plan, rels, eqs, others)
-        rels = [reorder_joins(r) for r in rels]
+        rels = [reorder_joins(r, leading) for r in rels]
+        if leading:
+            rels = _apply_leading(rels, leading)
+            if len(rels) >= 2:
+                # rebuild so eq-cond sides follow the new child order
+                return _greedy_build(rels, eqs, others,
+                                     pinned=len(leading))
         if len(rels) > 2:
             return _greedy_build(rels, eqs, others)
         # two relations: nothing to reorder; rebuild with recursed children
         plan.children = rels
         return plan
-    plan.children = [reorder_joins(c) for c in plan.children]
+    plan.children = [reorder_joins(c, leading) for c in plan.children]
     return plan
+
+
+def _rel_names(rel):
+    """Names a LEADING hint can address a relation by."""
+    from .logical import DataSource
+    names = set()
+    node = rel
+    while node is not None:
+        if isinstance(node, DataSource):
+            if node.alias:
+                names.add(str(node.alias).lower())
+            names.add(str(node.table_info.name).lower())
+            break
+        node = node.children[0] if len(node.children) == 1 else None
+    return names
+
+
+def _apply_leading(rels, leading):
+    """Stable-move hinted relations to the front in hint order."""
+    picked, rest = [], list(rels)
+    for want in leading:
+        for r in rest:
+            if want in _rel_names(r):
+                picked.append(r)
+                rest.remove(r)
+                break
+    return picked + rest
 
 
 def _flatten_inner(plan: LJoin, rels, eqs, others):
@@ -48,7 +88,7 @@ def _flatten_inner(plan: LJoin, rels, eqs, others):
     others.extend(plan.other_conds)
 
 
-def _greedy_build(rels, eqs, others):
+def _greedy_build(rels, eqs, others, pinned=0):
     id_of = {}
     for i, r in enumerate(rels):
         for sc in r.schema.cols:
@@ -60,16 +100,25 @@ def _greedy_build(rels, eqs, others):
         return owners
 
     remaining = set(range(len(rels)))
-    start = min(remaining, key=lambda i: rels[i].stats_rows)
+    pinned = min(pinned, len(rels))
+    start = 0 if pinned else min(remaining,
+                                 key=lambda i: rels[i].stats_rows)
     joined_set = {start}
     remaining.discard(start)
     current = rels[start]
     pending_eqs = list(eqs)
     pending_others = list(others)
+    forced = list(range(1, pinned))       # LEADING-pinned join order
     while remaining:
         # candidates connected by an eq cond to the joined set
         best = None
-        for i in remaining:
+        if forced:
+            i = forced.pop(0)
+            best = ((0, 0), i, True)
+            remaining_iter = ()
+        else:
+            remaining_iter = remaining
+        for i in remaining_iter:
             connected = False
             for a, b in pending_eqs:
                 oa, ob = rel_of(a), rel_of(b)

@@ -60,6 +60,8 @@ class Domain:
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256
+        from ..bindinfo import BindHandle
+        self.bind_handle = BindHandle()   # GLOBAL plan baselines
         if data_dir:
             self._open_wal(data_dir)
 

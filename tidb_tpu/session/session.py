@@ -55,6 +55,8 @@ class Session:
         self._next_stmt_id = 0
         self.temp_tables: dict = {}  # name -> TableInfo (negative id)
         self._next_temp_id = [-2]
+        from ..bindinfo import BindHandle
+        self.session_binds = BindHandle()
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -260,6 +262,16 @@ class Session:
             return ResultSet()
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
+        if isinstance(stmt, ast.CreateBindingStmt):
+            h = self.domain.bind_handle if stmt.is_global \
+                else self.session_binds
+            h.create(stmt.for_sql, stmt.using_sql, stmt.hints)
+            return ResultSet()
+        if isinstance(stmt, ast.DropBindingStmt):
+            h = self.domain.bind_handle if stmt.is_global \
+                else self.session_binds
+            h.drop(stmt.for_sql)
+            return ResultSet()
         if isinstance(stmt, ast.ShowStmt):
             from .show import exec_show
             return exec_show(self, stmt)
@@ -397,7 +409,28 @@ class Session:
 
     def _plan_cache_key(self, sql_key):
         return (sql_key, self.vars.current_db,
-                self.domain.infoschema().version, self.vars.tpu_exec)
+                self.domain.infoschema().version, self.vars.tpu_exec,
+                self.domain.bind_handle.version, self.session_binds.version)
+
+    def _apply_binding(self, stmt, sql_text):
+        """Session-then-global binding match by normalized digest
+        (reference pkg/bindinfo matching); on hit the binding's hint set
+        replaces the statement's own."""
+        if not sql_text or (not len(self.session_binds) and
+                            not len(self.domain.bind_handle)):
+            return
+        from ..parser.digester import normalize_digest
+        _, digest = normalize_digest(sql_text)
+        rec = self.session_binds.match(digest) or \
+            self.domain.bind_handle.match(digest)
+        if rec is not None:
+            stmt.hints = list(rec.hints)
+            self.vars.set("last_plan_from_binding", 1)
+            self.domain.inc_metric("plan_from_binding")
+        elif getattr(stmt, "from_clause", True) is not None:
+            # table-less probes (`select @@last_plan_from_binding`) keep
+            # the previous statement's flag
+            self.vars.set("last_plan_from_binding", 0)
 
     def _write_outfile(self, path, names, chunks):
         import csv as _csv
@@ -415,6 +448,7 @@ class Session:
         plan = None
         ck = None
         dom = self.domain
+        self._apply_binding(stmt, sql_key or self._cur_sql)
         if sql_key and params is None:
             ck = self._plan_cache_key(sql_key)
             plan = dom.plan_cache.get(ck)
@@ -431,7 +465,7 @@ class Session:
                 while len(dom.plan_cache_order) > dom.plan_cache_cap:
                     old = dom.plan_cache_order.pop(0)
                     dom.plan_cache.pop(old, None)
-        ectx = ExecContext(self)
+        ectx = ExecContext(self, getattr(plan, "exec_hints", None))
         self.domain.register_exec(self.conn_id, ectx)
         ex = build_executor(ectx, plan)
         ex.open()

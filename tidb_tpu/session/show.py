@@ -104,6 +104,18 @@ def exec_show(sess, stmt):
         ddl = (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +
                "\n) ENGINE=InnoDB DEFAULT CHARSET=utf8mb4")
         return _str_chunk(["Table", "Create Table"], [(tbl.name, ddl)])
+    if kind == "bindings":
+        h = sess.domain.bind_handle if stmt.is_global \
+            else sess.session_binds
+        rows = []
+        for rec in h.list():
+            hint_txt = ", ".join(
+                n.upper() + ("(" + ", ".join(a) + ")" if a else "")
+                for n, a in rec.hints)
+            rows.append((rec.original_sql, rec.bind_sql, "", rec.status,
+                         rec.source, rec.digest[:16], hint_txt))
+        return _str_chunk(["Original_sql", "Bind_sql", "Default_db",
+                           "Status", "Source", "Sql_digest", "Hints"], rows)
     if kind == "index":
         db = stmt.table.db or sess.vars.current_db
         tbl = ischema.table_by_name(db, stmt.table.name)

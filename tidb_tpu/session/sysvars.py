@@ -89,6 +89,7 @@ for _v in [
     SysVar("tidb_mpp_min_rows", SCOPE_BOTH, 1 << 16, "int", 0, None),
     SysVar("tidb_join_exec", SCOPE_BOTH, "auto", "enum",
            enum_vals=["auto", "host", "device"]),
+    SysVar("last_plan_from_binding", SCOPE_SESSION, False, "bool"),
     SysVar("max_execution_time", SCOPE_BOTH, 0, "int", 0, None),
     SysVar("tidb_allow_mpp", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH, 100 << 20, "int", 0, None),
