@@ -50,3 +50,40 @@ def test_auth(tk):
     assert tk.domain.priv.auth("dave", "%", "secret")
     assert not tk.domain.priv.auth("dave", "%", "wrong")
     assert not tk.domain.priv.auth("nobody", "%", "")
+
+
+def test_rbac_roles(tk):
+    """CREATE ROLE / GRANT role / SET ROLE / default roles (reference
+    pkg/privilege RBAC; MySQL role accounts + role_edges)."""
+    tk.must_exec("create table pr1 (v int)")
+    tk.must_exec("insert into pr1 values (42)")
+    tk.must_exec("create role 'analyst'")
+    tk.must_exec("grant select on test.* to 'analyst'")
+    tk.must_exec("create user 'carol' identified by 'pw'")
+    tk.must_exec("grant 'analyst' to 'carol'")
+    carol = _as_user(tk, "carol")
+    # granted but not active
+    with pytest.raises(errors.PrivilegeCheckFailError):
+        carol.must_query("select * from pr1")
+    carol.must_exec("set role all")
+    carol.must_query("select * from pr1").check([(42,)])
+    carol.must_exec("set role none")
+    with pytest.raises(errors.PrivilegeCheckFailError):
+        carol.must_query("select * from pr1")
+    # default roles activate in new sessions
+    tk.must_exec("set default role all to 'carol'")
+    carol2 = _as_user(tk, "carol")
+    carol2.must_query("select * from pr1").check([(42,)])
+    # role accounts cannot authenticate
+    assert not tk.domain.priv.auth("analyst", "%", "")
+    # SET ROLE of an ungranted role errors
+    tk.must_exec("create role 'admin_r'")
+    with pytest.raises(errors.TiDBError):
+        carol.must_exec("set role 'admin_r'")
+    # revoke cuts access
+    tk.must_exec("revoke 'analyst' from 'carol'")
+    carol3 = _as_user(tk, "carol")
+    carol3.must_exec("set role all")
+    with pytest.raises(errors.PrivilegeCheckFailError):
+        carol3.must_query("select * from pr1")
+    tk.must_exec("drop role 'analyst', 'admin_r'")

@@ -247,6 +247,38 @@ class DropBindingStmt(StmtNode):
 
 
 @dataclass
+class CreateRoleStmt(StmtNode):
+    roles: list = field(default_factory=list)    # [(name, host)]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRoleStmt(StmtNode):
+    roles: list = field(default_factory=list)
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRoleStmt(StmtNode):
+    roles: list = field(default_factory=list)    # [(name, host)]
+    users: list = field(default_factory=list)    # [(user, host)]
+    is_revoke: bool = False
+
+
+@dataclass
+class SetRoleStmt(StmtNode):
+    mode: str = "list"          # all | none | default | list
+    roles: list = field(default_factory=list)
+
+
+@dataclass
+class SetDefaultRoleStmt(StmtNode):
+    mode: str = "list"          # all | none | list
+    roles: list = field(default_factory=list)
+    users: list = field(default_factory=list)
+
+
+@dataclass
 class SelectField(Node):
     expr: ExprNode
     alias: str = ""

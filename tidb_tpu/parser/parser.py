@@ -629,6 +629,7 @@ class Parser:
 
     def parse_grant(self, is_revoke):
         self.next()
+        mark = self.i
         stmt = ast.GrantStmt(is_revoke=is_revoke)
         while True:
             name = self.next().text.lower()
@@ -642,6 +643,18 @@ class Parser:
                 stmt.privs.append(name)
             if not self.accept_op(","):
                 break
+        if not self.at_kw("on"):
+            # GRANT role[, role] TO user / REVOKE role FROM user
+            self.i = mark
+            rstmt = ast.GrantRoleStmt(is_revoke=is_revoke)
+            rstmt.roles.append(self.parse_user_spec())
+            while self.accept_op(","):
+                rstmt.roles.append(self.parse_user_spec())
+            self.expect_kw("from") if is_revoke else self.expect_kw("to")
+            rstmt.users.append(self.parse_user_spec())
+            while self.accept_op(","):
+                rstmt.users.append(self.parse_user_spec())
+            return rstmt
         self.expect_kw("on")
         if self.accept_op("*"):
             if self.accept_op("."):
@@ -687,6 +700,17 @@ class Parser:
             return ast.CreateBindingStmt(
                 is_global=is_global, for_sql=for_sql, using_sql=using_sql,
                 hints=parse_hints(" ".join(self.hint_texts)))
+        if self.accept_kw("role"):
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            stmt = ast.CreateRoleStmt(if_not_exists=ine)
+            stmt.roles.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.roles.append(self.parse_user_spec())
+            return stmt
         if self.accept_kw("sequence"):
             ine = False
             if self.accept_kw("if"):
@@ -1022,6 +1046,16 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("role"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            stmt = ast.DropRoleStmt(if_exists=ie)
+            stmt.roles.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.roles.append(self.parse_user_spec())
+            return stmt
         if (self.at_kw("global", "session") and
                 self.peek(1).kind == "IDENT" and
                 self.peek(1).text.lower() == "binding") or \
@@ -1144,6 +1178,38 @@ class Parser:
     # ---- SET / SHOW / EXPLAIN ----------------------------------------
     def parse_set(self):
         self.expect_kw("set")
+        if self.at_kw("role"):
+            self.next()
+            stmt = ast.SetRoleStmt()
+            if self.accept_kw("all"):
+                stmt.mode = "all"
+            elif self.accept_kw("none"):
+                stmt.mode = "none"
+            elif self.accept_kw("default"):
+                stmt.mode = "default"
+            else:
+                stmt.roles.append(self.parse_user_spec())
+                while self.accept_op(","):
+                    stmt.roles.append(self.parse_user_spec())
+            return stmt
+        if self.at_kw("default") and self.peek(1).kind == "IDENT" and \
+                self.peek(1).text.lower() == "role":
+            self.next()
+            self.next()
+            stmt = ast.SetDefaultRoleStmt()
+            if self.accept_kw("all"):
+                stmt.mode = "all"
+            elif self.accept_kw("none"):
+                stmt.mode = "none"
+            else:
+                stmt.roles.append(self.parse_user_spec())
+                while self.accept_op(","):
+                    stmt.roles.append(self.parse_user_spec())
+            self.expect_kw("to")
+            stmt.users.append(self.parse_user_spec())
+            while self.accept_op(","):
+                stmt.users.append(self.parse_user_spec())
+            return stmt
         stmt = ast.SetStmt()
         if self.accept_kw("names"):
             self.next()

@@ -28,6 +28,9 @@ class PrivManager:
         self.db_privs: dict = {}     # (user,host,db) -> set
         self.table_privs: dict = {}  # (user,host,db,tbl) -> set
         self.enabled = False         # flips on once a non-root user exists
+        self.roles: set = set()      # role account keys (RBAC)
+        self.role_edges: dict = {}   # user key -> set of role keys
+        self.default_roles: dict = {}  # user key -> "all" | [role keys]
         self.users[_key("root")] = {"password": ""}
         self.global_privs[_key("root")] = set(ALL_PRIVS)
 
@@ -92,29 +95,106 @@ class PrivManager:
                 self.table_privs.get(k + (db.lower(), tbl.lower()), set())\
                     .difference_update(privs)
 
+    # ---- RBAC roles (reference privilege/privileges RBAC; MySQL role
+    # accounts are locked users + role_edges) ---------------------------
+    def create_role(self, name, host, if_not_exists=False):
+        with self._mu:
+            k = _key(name, host)
+            if k in self.users or k in self.roles:
+                if if_not_exists:
+                    return
+                raise TiDBError("Operation CREATE ROLE failed for '%s'@'%s'",
+                                name, host)
+            self.roles.add(k)
+            self.users[k] = {"password": "", "locked": True}
+            self.global_privs.setdefault(k, set())
+
+    def drop_role(self, name, host, if_exists=False):
+        with self._mu:
+            k = _key(name, host)
+            if k not in self.roles:
+                if if_exists:
+                    return
+                raise TiDBError("Operation DROP ROLE failed for '%s'@'%s'",
+                                name, host)
+            self.roles.discard(k)
+            self.users.pop(k, None)
+            self.global_privs.pop(k, None)
+            for edges in self.role_edges.values():
+                edges.discard(k)
+
+    def grant_role(self, roles, users):
+        with self._mu:
+            for rn, rh in roles:
+                rk = _key(rn, rh)
+                if rk not in self.roles:
+                    raise TiDBError("Unknown role '%s'@'%s'", rn, rh)
+            for un, uh in users:
+                uk = _key(un, uh)
+                if uk not in self.users:
+                    raise TiDBError("Unknown user '%s'@'%s'", un, uh)
+                self.role_edges.setdefault(uk, set()).update(
+                    _key(rn, rh) for rn, rh in roles)
+
+    def revoke_role(self, roles, users):
+        with self._mu:
+            for un, uh in users:
+                edges = self.role_edges.get(_key(un, uh), set())
+                for rn, rh in roles:
+                    edges.discard(_key(rn, rh))
+
+    def roles_of(self, user, host):
+        uk = _key(user, host)
+        if uk not in self.users:
+            uk = _key(user)
+        return sorted(self.role_edges.get(uk, set()))
+
+    def set_default_roles(self, mode, roles, users):
+        with self._mu:
+            for un, uh in users:
+                uk = _key(un, uh)
+                if mode == "all":
+                    self.default_roles[uk] = "all"
+                elif mode == "none":
+                    self.default_roles.pop(uk, None)
+                else:
+                    self.default_roles[uk] = [_key(rn, rh)
+                                              for rn, rh in roles]
+
+    def default_roles_of(self, user, host):
+        uk = _key(user, host)
+        if uk not in self.users:
+            uk = _key(user)
+        d = self.default_roles.get(uk)
+        if d == "all":
+            return self.roles_of(user, host)
+        return list(d or ())
+
     # ---- checks -------------------------------------------------------
     def auth(self, user, host, password) -> bool:
         k = _key(user, host)
         info = self.users.get(k) or self.users.get(_key(user))
-        if info is None:
-            return False
+        if info is None or info.get("locked"):
+            return False          # role accounts cannot log in
         return info["password"] == "" or info["password"] == password
 
-    def check(self, user, host, priv, db="", tbl=""):
-        """Raise unless `user` holds `priv` at the narrowest matching scope."""
+    def check(self, user, host, priv, db="", tbl="", roles=()):
+        """Raise unless `user` (or one of its active `roles`) holds `priv`
+        at the narrowest matching scope."""
         if not self.enabled:
             return
         k = _key(user, host)
         if k not in self.users:
             k = _key(user)
         priv = priv.lower()
-        if priv in self.global_privs.get(k, ()):  # global scope
-            return
-        if db and priv in self.db_privs.get(k + (db.lower(),), ()):
-            return
-        if db and tbl and priv in self.table_privs.get(
-                k + (db.lower(), tbl.lower()), ()):
-            return
+        for kk in (k, *roles):
+            if priv in self.global_privs.get(kk, ()):  # global scope
+                return
+            if db and priv in self.db_privs.get(kk + (db.lower(),), ()):
+                return
+            if db and tbl and priv in self.table_privs.get(
+                    kk + (db.lower(), tbl.lower()), ()):
+                return
         raise PrivilegeCheckFailError(
             "%s command denied to user '%s'@'%s' for table '%s'",
             priv.upper(), user, host, tbl or db)

@@ -57,6 +57,7 @@ class Session:
         self._next_temp_id = [-2]
         from ..bindinfo import BindHandle
         self.session_binds = BindHandle()
+        self.active_roles = None     # None = defaults not applied yet
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -207,7 +208,11 @@ class Session:
         self.stmt_handles.pop(stmt_id, None)
 
     def check_priv(self, priv, db="", tbl=""):
-        self.domain.priv.check(self.user, self.host, priv, db, tbl)
+        if self.active_roles is None:
+            self.active_roles = self.domain.priv.default_roles_of(
+                self.user, self.host)
+        self.domain.priv.check(self.user, self.host, priv, db, tbl,
+                               roles=self.active_roles)
 
     def _check_read(self, db, tbl):
         if db.lower() == "information_schema":
@@ -262,6 +267,53 @@ class Session:
             return ResultSet()
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
+        if isinstance(stmt, ast.CreateRoleStmt):
+            self.check_priv("create_user")
+            for sp in stmt.roles:
+                self.domain.priv.create_role(sp.user, sp.host,
+                                             stmt.if_not_exists)
+            return ResultSet()
+        if isinstance(stmt, ast.DropRoleStmt):
+            self.check_priv("create_user")
+            for sp in stmt.roles:
+                self.domain.priv.drop_role(sp.user, sp.host,
+                                           stmt.if_exists)
+            return ResultSet()
+        if isinstance(stmt, ast.GrantRoleStmt):
+            self.check_priv("grant")
+            roles = [(sp.user, sp.host) for sp in stmt.roles]
+            users = [(sp.user, sp.host) for sp in stmt.users]
+            if stmt.is_revoke:
+                self.domain.priv.revoke_role(roles, users)
+            else:
+                self.domain.priv.grant_role(roles, users)
+            return ResultSet()
+        if isinstance(stmt, ast.SetRoleStmt):
+            priv = self.domain.priv
+            if stmt.mode == "all":
+                self.active_roles = priv.roles_of(self.user, self.host)
+            elif stmt.mode == "none":
+                self.active_roles = []
+            elif stmt.mode == "default":
+                self.active_roles = priv.default_roles_of(self.user,
+                                                          self.host)
+            else:
+                granted = set(priv.roles_of(self.user, self.host))
+                want = []
+                for sp in stmt.roles:
+                    k = (sp.user.lower(), sp.host)
+                    if k not in granted:
+                        raise TiDBError(
+                            "Role '%s'@'%s' has not been granted to %s",
+                            sp.user, sp.host, self.user)
+                    want.append(k)
+                self.active_roles = want
+            return ResultSet()
+        if isinstance(stmt, ast.SetDefaultRoleStmt):
+            self.domain.priv.set_default_roles(
+                stmt.mode, [(sp.user, sp.host) for sp in stmt.roles],
+                [(sp.user, sp.host) for sp in stmt.users])
+            return ResultSet()
         if isinstance(stmt, ast.CreateBindingStmt):
             h = self.domain.bind_handle if stmt.is_global \
                 else self.session_binds
