@@ -189,11 +189,35 @@ def _cond_selectivity(ds, cond) -> float:
             cs = stats.columns.get(name) if name else None
             if cs is not None and stats.row_count > 0:
                 if op == "=":
+                    # TopN exact count / CM-sketch estimate beats the
+                    # uniform-NDV guess for skewed columns
+                    if not const.value.is_null:
+                        cnt = cs.eq_count(str(const.value.val)) \
+                            if hasattr(cs, "eq_count") else None
+                        if cnt is not None:
+                            return max(cnt / stats.row_count,
+                                       1.0 / stats.row_count)
                     return max(1.0 / max(cs.ndv, 1), 1.0 / stats.row_count)
                 if op in ("<", "<=", ">", ">=") and cs.min_val is not None \
                         and not const.value.is_null:
                     try:
                         v = float(const.value.val)
+                        # equal-depth histogram: full buckets below v plus
+                        # a linear fraction of the straddling bucket
+                        if cs.histogram is not None and len(cs.histogram[1]):
+                            import numpy as _np
+                            bounds, counts = cs.histogram
+                            tot = max(int(counts.sum()), 1)
+                            k = int(_np.searchsorted(bounds[1:], v))
+                            below = float(counts[:k].sum())
+                            if k < len(counts):
+                                blo, bhi = float(bounds[k]), float(bounds[k + 1])
+                                if bhi > blo:
+                                    below += float(counts[k]) * \
+                                        min(max((v - blo) / (bhi - blo),
+                                                0.0), 1.0)
+                            frac = min(max(below / tot, 0.0), 1.0)
+                            return frac if op in ("<", "<=") else 1.0 - frac
                         lo, hi = float(cs.min_val), float(cs.max_val)
                         if hi > lo:
                             frac = min(max((v - lo) / (hi - lo), 0.0), 1.0)

@@ -1,17 +1,51 @@
 """ANALYZE TABLE: column statistics for the planner (reference
-pkg/statistics — histograms, CM-sketch, TopN; round 1 collects the
-vectorizable core: row count, NDV, null count, min/max, equal-depth
-histogram from numpy — TPU-offload of sketch building is an ops/ roadmap
-item)."""
+pkg/statistics — histograms, CM-sketch, TopN: row count, NDV, null
+count, min/max, equal-depth histogram, exact TopN values, count-min
+sketch for the long tail; built vectorized from numpy)."""
 from __future__ import annotations
+
+import hashlib
 
 import numpy as np
 
 from ..types.field_type import TypeClass
 
+_TOPN = 20
+
+
+class CMSketch:
+    """Count-min sketch (reference pkg/statistics/cmsketch.go). Built
+    from the exact (unique value, count) pairs ANALYZE already computes;
+    queried with the min-over-rows estimate for equality selectivity of
+    values outside the TopN."""
+    DEPTH = 4
+    WIDTH = 2048
+
+    def __init__(self):
+        self.table = np.zeros((self.DEPTH, self.WIDTH), dtype=np.int64)
+        self.total = 0
+
+    @classmethod
+    def _rows(cls, key: str):
+        d = hashlib.blake2b(key.encode("utf-8", "replace"),
+                            digest_size=16).digest()
+        h1 = int.from_bytes(d[:8], "little")
+        h2 = int.from_bytes(d[8:], "little") | 1
+        return [(h1 + i * h2) % cls.WIDTH for i in range(cls.DEPTH)]
+
+    def insert(self, key: str, count: int):
+        for i, j in enumerate(self._rows(key)):
+            self.table[i, j] += count
+        self.total += count
+
+    def query(self, key: str) -> int:
+        return int(min(self.table[i, j]
+                       for i, j in enumerate(self._rows(key))))
+
 
 class ColumnStats:
-    __slots__ = ("ndv", "null_count", "min_val", "max_val", "histogram")
+    __slots__ = ("ndv", "null_count", "min_val", "max_val", "histogram",
+                 "topn", "cmsketch")
 
     def __init__(self, ndv=0, null_count=0, min_val=None, max_val=None,
                  histogram=None):
@@ -20,6 +54,17 @@ class ColumnStats:
         self.min_val = min_val
         self.max_val = max_val
         self.histogram = histogram   # (bucket_bounds, counts)
+        self.topn = {}               # str(value) -> exact count
+        self.cmsketch = None         # CMSketch over non-TopN values
+
+    def eq_count(self, key: str):
+        """Estimated row count for `col = value`; None if unknown."""
+        cnt = self.topn.get(key)
+        if cnt is not None:
+            return cnt
+        if self.cmsketch is not None:
+            return self.cmsketch.query(key)
+        return None
 
 
 class TableStats:
@@ -46,10 +91,28 @@ def analyze_tables(sess, table_names):
                 nn = data[~nulls]
                 cs = ColumnStats(null_count=int(nulls.sum()))
                 if len(nn):
-                    uniq = np.unique(nn)
+                    uniq, counts = np.unique(nn, return_counts=True)
                     cs.ndv = len(uniq)
                     cs.min_val = uniq[0]
                     cs.max_val = uniq[-1]
+                    # exact TopN + CM-sketch over the remainder; string
+                    # columns are dict codes here — decode so sketch keys
+                    # match query-time constants
+                    if len(uniq) <= 200_000:
+                        sd = ctab.dicts.get(ci.id)
+                        keys = sd.decode(uniq.astype(np.int64)) \
+                            if sd is not None and uniq.dtype.kind in "iu" \
+                            else uniq
+                        order = np.argsort(counts)[::-1]
+                        top = order[:_TOPN]
+                        cs.topn = {str(keys[i]): int(counts[i])
+                                   for i in top}
+                        rest = order[_TOPN:]
+                        if len(rest):
+                            sk = CMSketch()
+                            for i in rest:
+                                sk.insert(str(keys[i]), int(counts[i]))
+                            cs.cmsketch = sk
                     if nn.dtype.kind in "if" and len(nn) > 1:
                         qs = np.linspace(0, 1, min(65, max(len(uniq), 2)))
                         bounds = np.quantile(nn, qs)
