@@ -234,6 +234,34 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestIndexMerge:
+    def test_union_type_index_merge(self, ftk):
+        ftk.must_exec("create table im (a int, b int, c int, "
+                      "key ia (a), key ib (b))")
+        ftk.must_exec("insert into im values " + ",".join(
+            f"({i}, {i * 2}, {i % 5})" for i in range(1000)))
+        ftk.must_exec("analyze table im")
+        r = ftk.must_query("explain select * from im where a = 3 or b = 10")
+        assert any("IndexMerge" in row[0] for row in r.rows), r.rows
+        ftk.must_query("select a, b from im where a = 3 or b = 10 "
+                       "order by a").check([(3, 6), (5, 10)])
+        # overlapping branches dedup by handle
+        ftk.must_query("select count(*) from im "
+                       "where a = 5 or b = 10").check([(1,)])
+        # range branches
+        ftk.must_query("select count(*) from im "
+                       "where a < 3 or b > 1990").check([(7,)])
+        # txn memBuffer rows visible through the merge
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into im values (2000, 4000, 1)")
+        ftk.must_query("select a from im where a = 2000 or b = 10 "
+                       "order by a").check([(5,), (2000,)])
+        ftk.must_exec("rollback")
+        ftk.must_exec("delete from im where a = 3")
+        ftk.must_query("select a, b from im where a = 3 or b = 10").check(
+            [(5, 10)])
+
+
 class TestBindingsAndHints:
     def test_hints_parse_and_execute(self, ftk):
         ftk.must_exec("create table bh1 (a int, b int)")

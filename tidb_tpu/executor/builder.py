@@ -1,7 +1,7 @@
 """Executor builder (reference pkg/executor/builder.go:193)."""
 from __future__ import annotations
 
-from ..planner.physical import (PhysBatchPointGet, PhysIndexRange, PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
+from ..planner.physical import (PhysBatchPointGet, PhysIndexMerge, PhysIndexRange, PhysPointGet, PhysTableReader, PhysSelection, PhysProjection,
                                 PhysHashAgg, PhysHashJoin, PhysSort, PhysTopN,
                                 PhysLimit, PhysUnion, PhysDual, PhysShell,
                                 PhysWindow)
@@ -23,6 +23,9 @@ def build_executor(ctx, plan):
 def _build(ctx, plan):
     if isinstance(plan, PhysPointGet):
         return PointGetExec(ctx, plan)
+    if isinstance(plan, PhysIndexMerge):
+        from .executors import IndexMergeExec
+        return IndexMergeExec(ctx, plan)
     if isinstance(plan, PhysIndexRange):
         return IndexRangeExec(ctx, plan)
     if isinstance(plan, PhysBatchPointGet):

@@ -203,36 +203,27 @@ class IndexRangeExec(Executor):
     def open(self):
         pass
 
-    def next(self):
-        if self._done:
-            return None
-        self._done = True
-        plan = self.plan
-        tbl = plan.table_info
-        sess = self.ctx.sess
+    def _scan_index_handles(self, index, low, high, low_inc, high_inc):
+        """Scan one index KV range at the read ts (memBuffer-merged when
+        the txn is dirty); -> (handles, dirty, txn)."""
         from ..codec.tablecodec import index_prefix, index_key_handle
         from ..codec.codec import encode_datums_key
         from .exec_base import expr_to_datum, coerce_datum
-        ctab = sess.domain.columnar.tables.get(tbl.id)
-        empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
-        if ctab is None:
-            return empty
-        if ctab.bulk_rows:
-            # safety net: planner shouldn't pick this path, but fall back
-            return self._fallback_scan()
-        ci = tbl.find_column(plan.index.columns[0])
-        pref = index_prefix(tbl.id, plan.index.id)
+        tbl = self.plan.table_info
+        sess = self.ctx.sess
+        ci = tbl.find_column(index.columns[0])
+        pref = index_prefix(tbl.id, index.id)
         lo = pref
-        if plan.low is not None:
-            d = coerce_datum(expr_to_datum(plan.low), ci.ft)
+        if low is not None:
+            d = coerce_datum(expr_to_datum(low), ci.ft)
             lo = pref + encode_datums_key([d])
-            if not plan.low_inc:
+            if not low_inc:
                 lo += b"\xff"
         hi = pref + b"\xff" * 9
-        if plan.high is not None:
-            d = coerce_datum(expr_to_datum(plan.high), ci.ft)
+        if high is not None:
+            d = coerce_datum(expr_to_datum(high), ci.ft)
             hi = pref + encode_datums_key([d])
-            hi = hi + (b"\xff" * 9 if plan.high_inc else b"")
+            hi = hi + (b"\xff" * 9 if high_inc else b"")
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
             and txn.is_dirty()
@@ -244,10 +235,32 @@ class IndexRangeExec(Executor):
             entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts)
         handles = []
         for k, v in entries:
-            if plan.index.unique and v not in (b"",):
+            if index.unique and v not in (b"",):
                 handles.append(int(v))
             else:
                 handles.append(index_key_handle(k))
+        return handles, dirty, txn
+
+    def _collect_handles(self):
+        p = self.plan
+        return self._scan_index_handles(p.index, p.low, p.high,
+                                        p.low_inc, p.high_inc)
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        tbl = plan.table_info
+        sess = self.ctx.sess
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
+        if ctab is None:
+            return empty
+        if ctab.bulk_rows:
+            # safety net: planner shouldn't pick this path, but fall back
+            return self._fallback_scan()
+        handles, dirty, txn = self._collect_handles()
         if not handles:
             return empty
         from ..codec.tablecodec import record_key
@@ -319,6 +332,36 @@ class IndexRangeExec(Executor):
             dag.host_filters.append(ScalarFunc(
                 "<=" if self.plan.high_inc else "<", [col, self.plan.high],
                 new_bigint_type()))
+        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
+        return Chunk.concat_all(chunks) or Chunk.empty(
+            [sc.col.ft for sc in self.schema.cols])
+
+
+class IndexMergeExec(IndexRangeExec):
+    """Union-type index merge (reference index_merge_reader.go): every
+    branch scans its own index range; the handle sets union (dedup);
+    rows gather once and the original OR predicate re-applies as the
+    residual filter."""
+
+    def _collect_handles(self):
+        seen = set()
+        handles = []
+        dirty = False
+        txn = None
+        for idx, low, high, low_inc, high_inc in self.plan.branches:
+            hs, dirty, txn = self._scan_index_handles(
+                idx, low, high, low_inc, high_inc)
+            for h in hs:
+                if h not in seen:
+                    seen.add(h)
+                    handles.append(h)
+        return handles, dirty, txn
+
+    def _fallback_scan(self):
+        from ..planner.physical import CoprDAG
+        dag = CoprDAG(table_info=self.plan.table_info,
+                      db_name=self.plan.db_name, cols=self.plan.cols,
+                      host_filters=list(self.plan.residual))
         chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
         return Chunk.concat_all(chunks) or Chunk.empty(
             [sc.col.ft for sc in self.schema.cols])

@@ -102,6 +102,27 @@ class PhysIndexRange(PhysPlan):
                 f"range:{rng}")
 
 
+class PhysIndexMerge(PhysPlan):
+    """Union-type index merge (reference pkg/executor/index_merge_reader.go
+    + planner/core/indexmerge_path.go): each OR-disjunct scans its own
+    index range; handle sets union; the original predicate re-applies as
+    a residual filter over the gathered rows."""
+
+    def __init__(self, table_info, db_name, cols, branches, residual,
+                 schema):
+        super().__init__([], schema)
+        self.table_info = table_info
+        self.db_name = db_name
+        self.cols = cols
+        # [(index, low, high, low_inc, high_inc)]
+        self.branches = branches
+        self.residual = residual
+
+    def explain_info(self):
+        parts = ", ".join(b[0].name for b in self.branches)
+        return f"table:{self.table_info.name}, union of: {parts}"
+
+
 class PhysBatchPointGet(PhysPlan):
     """pk IN (consts) -> batched handle lookups (reference
     batch_point_get.go)."""
@@ -424,6 +445,62 @@ def _try_index_range(ds: DataSource) -> PhysPlan | None:
                           low_inc, high_inc, residual, Schema(list(cols)))
 
 
+def _flatten_or(c, out):
+    if isinstance(c, ScalarFunc) and c.op == "or":
+        for a in c.args:
+            _flatten_or(a, out)
+    else:
+        out.append(c)
+
+
+def _try_index_merge(ds: DataSource) -> PhysPlan | None:
+    """OR of simple ranges, each covered by some index -> union-type
+    index merge."""
+    tbl = ds.table_info
+    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds:
+        return None
+    indexed_cols = {}
+    for idx in tbl.indexes:
+        if len(idx.columns) >= 1:
+            indexed_cols.setdefault(idx.columns[0].lower(), idx)
+    if not indexed_cols:
+        return None
+    for c in ds.pushed_conds:
+        disj = []
+        _flatten_or(c, disj)
+        if len(disj) < 2:
+            continue
+        branches = []
+        for d in disj:
+            if not (isinstance(d, ScalarFunc) and len(d.args) == 2 and
+                    isinstance(d.args[0], Column) and
+                    isinstance(d.args[1], Constant) and
+                    d.op in ("=", "<", "<=", ">", ">=")):
+                branches = None
+                break
+            name = getattr(ds, "col_name_of", {}).get(d.args[0].idx, "")
+            idx = indexed_cols.get(name.lower())
+            if idx is None:
+                branches = None
+                break
+            v = d.args[1]
+            low = high = None
+            low_inc = high_inc = True
+            if d.op == "=":
+                low = high = v
+            elif d.op in (">", ">="):
+                low, low_inc = v, d.op == ">="
+            else:
+                high, high_inc = v, d.op == "<="
+            branches.append((idx, low, high, low_inc, high_inc))
+        if branches:
+            cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
+            return PhysIndexMerge(tbl, ds.db_name, cols, branches,
+                                  list(ds.pushed_conds),
+                                  Schema(list(cols)))
+    return None
+
+
 def _mk_reader(ds: DataSource) -> PhysPlan:
     pg = _try_point_get(ds)
     if pg is not None:
@@ -435,6 +512,11 @@ def _mk_reader(ds: DataSource) -> PhysPlan:
         if ir is not None:
             ir.stats_rows = ds.stats_rows
             return ir
+    if ds.stats_rows > 0 and raw and ds.stats_rows <= max(raw * 0.05, 50):
+        im = _try_index_merge(ds)
+        if im is not None:
+            im.stats_rows = ds.stats_rows
+            return im
     cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
     dag = CoprDAG(table_info=ds.table_info, db_name=ds.db_name,
                   cols=list(cols))

@@ -224,6 +224,13 @@ def _cond_selectivity(ds, cond) -> float:
                             return frac if op in ("<", "<=") else 1.0 - frac
                     except (TypeError, ValueError):
                         pass
+    if isinstance(cond, ScalarFunc) and cond.op == "or":
+        return min(sum(_cond_selectivity(ds, a) for a in cond.args), 1.0)
+    if isinstance(cond, ScalarFunc) and cond.op == "and":
+        out = 1.0
+        for a in cond.args:
+            out *= _cond_selectivity(ds, a)
+        return out
     if isinstance(cond, ScalarFunc) and cond.op == "=":
         return 0.1
     if isinstance(cond, ScalarFunc) and cond.op == "in":
