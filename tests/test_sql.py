@@ -234,6 +234,51 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestResourceControl:
+    def test_group_lifecycle_and_accounting(self, ftk):
+        ftk.must_exec("create table rcg (v int)")
+        ftk.must_exec("insert into rcg values (1),(2)")
+        ftk.must_exec("create resource group rg1 RU_PER_SEC = 100")
+        ftk.must_query(
+            "select name, ru_per_sec from information_schema"
+            ".resource_groups where name = 'rg1'").check([("rg1", 100)])
+        ftk.must_exec("set resource group rg1")
+        ftk.must_query("select * from rcg order by v").check([(1,), (2,)])
+        g = ftk.domain.resource_groups.get("rg1")
+        assert g.consumed_ru > 0
+        # deficit throttles the next statement (cooperative admission)
+        import time as _t
+        g.tokens = -5.0
+        t0 = _t.time()
+        ftk.must_query("select 1").check([(1,)])
+        assert _t.time() - t0 >= 0.04
+        assert g.throttled_stmts == 1
+        ftk.must_exec("set resource group default")
+        ftk.must_exec("alter resource group rg1 RU_PER_SEC = 500 BURSTABLE")
+        ftk.must_query(
+            "select ru_per_sec, burstable from information_schema"
+            ".resource_groups where name = 'rg1'").check([(500, "YES")])
+        ftk.must_exec("drop resource group rg1")
+        import pytest as _pt
+        from tidb_tpu import errors as _e
+        with _pt.raises(_e.TiDBError):
+            ftk.must_exec("set resource group rg1")
+
+    def test_runaway_query_limit_kills(self, ftk):
+        ftk.must_exec("create resource group rk RU_PER_SEC = 10000 "
+                      "QUERY_LIMIT=(EXEC_ELAPSED='1ms', ACTION=KILL)")
+        ftk.must_exec("create table rkt (v int)")
+        ftk.must_exec("insert into rkt values " + ",".join(
+            f"({i})" for i in range(50)))
+        ftk.must_exec("set resource group rk")
+        import pytest as _pt
+        from tidb_tpu import errors as _e
+        with _pt.raises(_e.TiDBError, match="interrupted"):
+            # cross joins are slow enough to overrun 1ms
+            ftk.must_query("select count(*) from rkt a, rkt b, rkt c")
+        ftk.must_exec("set resource group default")
+
+
 class TestIndexMerge:
     def test_union_type_index_merge(self, ftk):
         ftk.must_exec("create table im (a int, b int, c int, "

@@ -30,6 +30,13 @@ class ExecContext:
         limit_ms = eh.get("max_exec_ms",
                           int(self.sv.get("max_execution_time")))
         self.deadline = (_time.time() + limit_ms / 1000.0) if limit_ms else None
+        rg = sess.domain.resource_groups.groups.get(
+            getattr(sess, "resource_group", "default"))
+        if rg is not None and rg.exec_elapsed_ms and \
+                rg.query_limit_action == "kill":
+            rd = _time.time() + rg.exec_elapsed_ms / 1000.0
+            self.deadline = rd if self.deadline is None \
+                else min(self.deadline, rd)
 
     def check_killed(self):
         if self.killed:

@@ -83,6 +83,21 @@ def _gen_metrics(domain):
         yield (k, float(v))
 
 
+def _gen_resource_groups(domain):
+    for g in domain.resource_groups.groups.values():
+        limit = ""
+        if g.exec_elapsed_ms:
+            limit = (f"EXEC_ELAPSED='{g.exec_elapsed_ms}ms', "
+                     f"ACTION={g.query_limit_action.upper()}")
+        yield (g.name,
+               -1 if g.ru_per_sec is None else int(g.ru_per_sec),
+               "MEDIUM",
+               "YES" if g.burstable else "NO",
+               limit,
+               round(g.consumed_ru, 3),
+               g.throttled_stmts)
+
+
 def _gen_engines(domain):
     yield ("InnoDB", "DEFAULT", "TPU-native columnar + MVCC row engine",
            "YES", "YES", "YES")
@@ -200,6 +215,12 @@ VIRTUAL_DEFS = {
                            _gen_stmt_summary),
     "metrics_summary": (_cols(("metrics_name", _S()), ("sum_value", _F())),
                         _gen_metrics),
+    "resource_groups": (_cols(("name", _S()), ("ru_per_sec", _I()),
+                              ("priority", _S()), ("burstable", _S()),
+                              ("query_limit", _S()),
+                              ("consumed_ru", _F()),
+                              ("throttled_statements", _I())),
+                        _gen_resource_groups),
     "engines": (_cols(("engine", _S()), ("support", _S()), ("comment", _S()),
                       ("transactions", _S()), ("xa", _S()),
                       ("savepoints", _S())), _gen_engines),

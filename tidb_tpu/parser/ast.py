@@ -272,6 +272,23 @@ class SetRoleStmt(StmtNode):
 
 
 @dataclass
+class ResourceGroupStmt(StmtNode):
+    action: str = "create"      # create | alter | drop
+    name: str = ""
+    ru_per_sec: int | None = None
+    burstable: bool | None = None
+    exec_elapsed_ms: int | None = None   # QUERY_LIMIT EXEC_ELAPSED
+    query_limit_action: str = ""         # kill | cooldown | dryrun
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclass
+class SetResourceGroupStmt(StmtNode):
+    name: str = ""
+
+
+@dataclass
 class SetDefaultRoleStmt(StmtNode):
     mode: str = "list"          # all | none | list
     roles: list = field(default_factory=list)

@@ -675,8 +675,63 @@ class Parser:
             stmt.users.append(self.parse_user_spec())
         return stmt
 
+    def _parse_resource_group_options(self, stmt):
+        while True:
+            t = self.peek()
+            if t.kind != "IDENT":
+                break
+            w = t.text.lower()
+            if w == "ru_per_sec":
+                self.next()
+                self.accept_op("=")
+                stmt.ru_per_sec = int(self.next().text)
+            elif w == "burstable":
+                self.next()
+                if self.accept_op("="):
+                    stmt.burstable = self.next().text.lower() in (
+                        "true", "1", "on")
+                else:
+                    stmt.burstable = True
+            elif w == "priority":
+                self.next()
+                self.accept_op("=")
+                self.next()              # accepted, unused (single node)
+            elif w == "query_limit":
+                self.next()
+                self.accept_op("=")
+                self.expect_op("(")
+                while not self.accept_op(")"):
+                    k = self.next().text.lower()
+                    self.accept_op("=")
+                    v = self.next().text
+                    if k == "exec_elapsed":
+                        vv = v.strip("'\"").lower()
+                        mult = 1000
+                        if vv.endswith("ms"):
+                            vv, mult = vv[:-2], 1
+                        elif vv.endswith("s"):
+                            vv = vv[:-1]
+                        elif vv.endswith("m"):
+                            vv, mult = vv[:-1], 60_000
+                        stmt.exec_elapsed_ms = int(float(vv) * mult)
+                    elif k == "action":
+                        stmt.query_limit_action = v.lower()
+                    self.accept_op(",")
+            else:
+                break
+        return stmt
+
     def parse_create(self):
         self.expect_kw("create")
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            stmt = ast.ResourceGroupStmt(action="create")
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                stmt.if_not_exists = True
+            stmt.name = self.ident().lower()
+            return self._parse_resource_group_options(stmt)
         if (self.at_kw("global", "session") and
                 self.peek(1).kind == "IDENT" and
                 self.peek(1).text.lower() == "binding") or \
@@ -1046,6 +1101,14 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            stmt = ast.ResourceGroupStmt(action="drop")
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                stmt.if_exists = True
+            stmt.name = self.ident().lower()
+            return stmt
         if self.accept_kw("role"):
             ie = False
             if self.accept_kw("if"):
@@ -1112,6 +1175,11 @@ class Parser:
 
     def parse_alter(self):
         self.expect_kw("alter")
+        if self.accept_kw("resource"):
+            self.expect_kw("group")
+            stmt = ast.ResourceGroupStmt(action="alter")
+            stmt.name = self.ident().lower()
+            return self._parse_resource_group_options(stmt)
         if self.accept_kw("user"):
             stmt = ast.AlterUserStmt()
             stmt.users.append(self.parse_user_spec())
@@ -1192,6 +1260,10 @@ class Parser:
                 while self.accept_op(","):
                     stmt.roles.append(self.parse_user_spec())
             return stmt
+        if self.at_kw("resource"):
+            self.next()
+            self.expect_kw("group")
+            return ast.SetResourceGroupStmt(name=self.ident().lower())
         if self.at_kw("default") and self.peek(1).kind == "IDENT" and \
                 self.peek(1).text.lower() == "role":
             self.next()

@@ -62,6 +62,8 @@ class Domain:
         self.plan_cache_cap = 256
         from ..bindinfo import BindHandle
         self.bind_handle = BindHandle()   # GLOBAL plan baselines
+        from .resource_group import ResourceGroupManager
+        self.resource_groups = ResourceGroupManager()
         if data_dir:
             self._open_wal(data_dir)
 

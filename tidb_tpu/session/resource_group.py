@@ -1,0 +1,114 @@
+"""Resource control (reference pkg/resourcemanager + the resource-control
+path of pkg/domain — TiKV-side RU token buckets collapsed to an
+in-process token bucket per group).
+
+A resource group holds a token bucket refilled at `ru_per_sec`. Each
+statement settles its RU cost (a blend of execution time and rows
+produced, mirroring the spirit of the request-unit model) against the
+bucket; when a non-burstable bucket is in deficit the NEXT statement in
+that group sleeps until the bucket recovers (cooperative throttling —
+there is no mid-kernel preemption on an XLA device anyway, so admission
+control is the TPU-native shape of this feature).
+
+QUERY_LIMIT(EXEC_ELAPSED=..., ACTION=KILL) marks runaway queries: the
+per-statement deadline is clamped and overruns raise the standard
+query-killed error (reference runaway.go).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..errors import TiDBError
+
+_MAX_THROTTLE_S = 1.0      # cap per-statement admission wait
+
+
+class ResourceGroup:
+    def __init__(self, name, ru_per_sec=None, burstable=False,
+                 exec_elapsed_ms=None, query_limit_action=""):
+        self.name = name
+        self.ru_per_sec = ru_per_sec        # None = unlimited
+        self.burstable = bool(burstable)
+        self.exec_elapsed_ms = exec_elapsed_ms
+        self.query_limit_action = query_limit_action or "kill"
+        self.tokens = float(ru_per_sec or 0)
+        self.last_refill = time.time()
+        self.consumed_ru = 0.0              # lifetime accounting
+        self.throttled_stmts = 0
+        self._mu = threading.Lock()
+
+    def _refill(self, now):
+        if self.ru_per_sec:
+            self.tokens = min(
+                self.tokens + (now - self.last_refill) * self.ru_per_sec,
+                float(self.ru_per_sec))     # burst capacity = 1s of RU
+        self.last_refill = now
+
+    def admit(self):
+        """Called before a statement runs; sleeps while the bucket is in
+        deficit (non-burstable groups only)."""
+        if not self.ru_per_sec or self.burstable:
+            return 0.0
+        with self._mu:
+            now = time.time()
+            self._refill(now)
+            deficit = -self.tokens
+        if deficit > 0:
+            wait = min(deficit / self.ru_per_sec, _MAX_THROTTLE_S)
+            self.throttled_stmts += 1
+            time.sleep(wait)
+            return wait
+        return 0.0
+
+    def settle(self, ru: float):
+        with self._mu:
+            self._refill(time.time())
+            self.consumed_ru += ru
+            if self.ru_per_sec:
+                self.tokens -= ru
+
+
+class ResourceGroupManager:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.groups = {"default": ResourceGroup("default")}
+
+    def create(self, stmt):
+        with self._mu:
+            if stmt.name in self.groups:
+                if stmt.if_not_exists:
+                    return
+                raise TiDBError("resource group '%s' exists", stmt.name)
+            self.groups[stmt.name] = ResourceGroup(
+                stmt.name, stmt.ru_per_sec, stmt.burstable or False,
+                stmt.exec_elapsed_ms, stmt.query_limit_action)
+
+    def alter(self, stmt):
+        with self._mu:
+            g = self.groups.get(stmt.name)
+            if g is None:
+                raise TiDBError("resource group '%s' not found", stmt.name)
+            if stmt.ru_per_sec is not None:
+                g.ru_per_sec = stmt.ru_per_sec
+                g.tokens = min(g.tokens, float(stmt.ru_per_sec))
+            if stmt.burstable is not None:
+                g.burstable = stmt.burstable
+            if stmt.exec_elapsed_ms is not None:
+                g.exec_elapsed_ms = stmt.exec_elapsed_ms
+            if stmt.query_limit_action:
+                g.query_limit_action = stmt.query_limit_action
+
+    def drop(self, stmt):
+        with self._mu:
+            if stmt.name == "default":
+                raise TiDBError("can't drop the default resource group")
+            if self.groups.pop(stmt.name, None) is None and \
+                    not stmt.if_exists:
+                raise TiDBError("resource group '%s' not found", stmt.name)
+
+    def get(self, name) -> ResourceGroup:
+        g = self.groups.get(name)
+        if g is None:
+            raise TiDBError("resource group '%s' not found", name)
+        return g

@@ -58,6 +58,7 @@ class Session:
         from ..bindinfo import BindHandle
         self.session_binds = BindHandle()
         self.active_roles = None     # None = defaults not applied yet
+        self.resource_group = "default"
 
     # ---- txn lifecycle ------------------------------------------------
     def txn(self):
@@ -109,20 +110,27 @@ class Session:
                       if t.startswith("__cte_final_")]:
             self.drop_temp_table(tname)
         self._cur_sql = sql if cacheable else ""
+        rg = self.domain.resource_groups.groups.get(self.resource_group)
+        if rg is not None:
+            rg.admit()               # token-bucket admission control
         start = time.time()
         try:
             rs = self._dispatch(stmt, params)
-            self._observe(stmt, sql, start, ok=True)
+            self._observe(stmt, sql, start, ok=True, rgroup=rg)
             return rs
         except TiDBError:
-            self._observe(stmt, sql, start, ok=False)
+            self._observe(stmt, sql, start, ok=False, rgroup=rg)
             self._finish_stmt(error=True)
             raise
 
-    def _observe(self, stmt, sql, start, ok):
+    def _observe(self, stmt, sql, start, ok, rgroup=None):
         """Slow log + statement summary (reference slow_log.go:373 +
-        pkg/util/stmtsummary)."""
+        pkg/util/stmtsummary) + RU settlement."""
         dur_ms = (time.time() - start) * 1000.0
+        if rgroup is not None:
+            # request-unit blend: ~1 RU per 3ms of statement time + a
+            # per-request base (reference resource_control RU model)
+            rgroup.settle(dur_ms / 3.0 + 0.125)
         threshold = int(self.vars.get("tidb_slow_log_threshold"))
         if threshold >= 0 and dur_ms > threshold:
             self.domain.slow_log.append({
@@ -267,6 +275,22 @@ class Session:
             return ResultSet()
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
+        if isinstance(stmt, ast.ResourceGroupStmt):
+            mgr = self.domain.resource_groups
+            if stmt.action == "create":
+                self.check_priv("super")
+                mgr.create(stmt)
+            elif stmt.action == "alter":
+                self.check_priv("super")
+                mgr.alter(stmt)
+            else:
+                self.check_priv("super")
+                mgr.drop(stmt)
+            return ResultSet()
+        if isinstance(stmt, ast.SetResourceGroupStmt):
+            self.domain.resource_groups.get(stmt.name)   # must exist
+            self.resource_group = stmt.name
+            return ResultSet()
         if isinstance(stmt, ast.CreateRoleStmt):
             self.check_priv("create_user")
             for sp in stmt.roles:
