@@ -234,6 +234,37 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestPluginsAndTopSQL:
+    def test_audit_plugin_and_show(self, ftk):
+        from tidb_tpu.plugin import Plugin
+        events = []
+        ftk.domain.plugins.load(Plugin(
+            name="audit_demo", kind="audit",
+            hooks={"audit": lambda sess, ev: events.append(ev)}))
+        ftk.must_exec("create table plg (v int)")
+        ftk.must_exec("insert into plg values (1)")
+        assert events and events[-1]["ok"] and \
+            "insert into plg" in events[-1]["sql"]
+        ftk.must_query("show plugins").check(
+            [("audit_demo", "ENABLE", "audit", "", "", "1.0")])
+        # plugin errors never fail the statement
+        ftk.domain.plugins.load(Plugin(
+            name="bad", kind="audit",
+            hooks={"audit": lambda *a: 1 / 0}))
+        ftk.must_query("select * from plg").check([(1,)])
+        ftk.domain.plugins.unload("audit_demo")
+        ftk.domain.plugins.unload("bad")
+
+    def test_top_sql_table(self, ftk):
+        ftk.must_exec("create table tsq (v int)")
+        for _ in range(3):
+            ftk.must_query("select * from tsq")
+        rows = ftk.must_query(
+            "select sql_text, exec_count from information_schema"
+            ".tidb_top_sql where sql_text like '%tsq%'").rows
+        assert ("select * from tsq", 3) in rows
+
+
 class TestResourceControl:
     def test_group_lifecycle_and_accounting(self, ftk):
         ftk.must_exec("create table rcg (v int)")

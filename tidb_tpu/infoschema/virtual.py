@@ -83,6 +83,18 @@ def _gen_metrics(domain):
         yield (k, float(v))
 
 
+def _gen_top_sql(domain):
+    """Top resource-consuming statements by total time (reference
+    TopSQL's per-digest CPU attribution, surfaced as a table instead of
+    the dashboard agent)."""
+    rows = sorted(domain.stmt_summary_map.values(),
+                  key=lambda s: -s["sum_ms"])[:30]
+    for s_ in rows:
+        cnt = max(s_["exec_count"], 1)
+        yield (s_["digest"], s_["normalized"], s_["sum_ms"] / 1000.0,
+               s_["exec_count"], s_["sum_ms"] / cnt / 1000.0)
+
+
 def _gen_resource_groups(domain):
     for g in domain.resource_groups.groups.values():
         limit = ""
@@ -215,6 +227,9 @@ VIRTUAL_DEFS = {
                            _gen_stmt_summary),
     "metrics_summary": (_cols(("metrics_name", _S()), ("sum_value", _F())),
                         _gen_metrics),
+    "tidb_top_sql": (_cols(("sql_digest", _S()), ("sql_text", _S()),
+                           ("cpu_time_total", _F()), ("exec_count", _I()),
+                           ("cpu_time_avg", _F())), _gen_top_sql),
     "resource_groups": (_cols(("name", _S()), ("ru_per_sec", _I()),
                               ("priority", _S()), ("burstable", _S()),
                               ("query_limit", _S()),

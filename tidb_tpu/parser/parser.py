@@ -1330,7 +1330,9 @@ class Parser:
             stmt.is_global = True
         else:
             self.accept_kw("session")
-        if self.accept_kw("bindings"):
+        if self.accept_kw("plugins"):
+            stmt.kind = "plugins"
+        elif self.accept_kw("bindings"):
             stmt.kind = "bindings"
         elif self.accept_kw("table") and self.accept_kw("status"):
             stmt.kind = "table_status"

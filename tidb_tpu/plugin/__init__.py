@@ -1,0 +1,3 @@
+from .manager import PluginManager, Plugin
+
+__all__ = ["PluginManager", "Plugin"]

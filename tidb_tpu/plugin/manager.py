@@ -1,0 +1,57 @@
+"""Plugin framework (reference pkg/plugin — audit / authentication /
+schema plugin points loaded as Go shared objects; re-designed as python
+entry points registered on the domain, called synchronously at the same
+seams the reference fires its hooks).
+
+Hook points:
+- ``audit``        (session, event dict)  — after every statement
+- ``connection``   (event dict)           — wire connect/disconnect
+- ``bootstrap``    (domain)               — once at domain start
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Plugin:
+    name: str
+    kind: str                    # audit | authentication | schema | daemon
+    version: str = "1.0"
+    hooks: dict = field(default_factory=dict)   # hook point -> callable
+    enabled: bool = True
+
+
+class PluginManager:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.plugins: dict[str, Plugin] = {}
+
+    def load(self, plugin: Plugin):
+        with self._mu:
+            if plugin.name in self.plugins:
+                raise ValueError(f"plugin {plugin.name!r} already loaded")
+            self.plugins[plugin.name] = plugin
+        return plugin
+
+    def unload(self, name: str):
+        with self._mu:
+            self.plugins.pop(name, None)
+
+    def fire(self, hook: str, *args):
+        """Invoke every enabled plugin registered for `hook`. Plugin errors
+        never fail the statement (reference plugin.Audit semantics)."""
+        for p in list(self.plugins.values()):
+            fn = p.hooks.get(hook) if p.enabled else None
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:               # noqa: BLE001
+                pass
+
+    def list(self):
+        return [(p.name, p.kind, p.version,
+                 "ENABLE" if p.enabled else "DISABLE")
+                for p in self.plugins.values()]

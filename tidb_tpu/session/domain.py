@@ -64,6 +64,8 @@ class Domain:
         self.bind_handle = BindHandle()   # GLOBAL plan baselines
         from .resource_group import ResourceGroupManager
         self.resource_groups = ResourceGroupManager()
+        from ..plugin import PluginManager
+        self.plugins = PluginManager()
         if data_dir:
             self._open_wal(data_dir)
 

@@ -150,6 +150,10 @@ class Session:
         summ["max_ms"] = max(summ["max_ms"], dur_ms)
         if not ok:
             summ["errors"] += 1
+        self.domain.plugins.fire("audit", self, {
+            "sql": sql, "digest": digest, "ok": ok, "duration_ms": dur_ms,
+            "user": self.user, "db": self.vars.current_db,
+            "conn_id": self.conn_id})
 
     def _plan_ctx(self, params=None) -> PlanContext:
         return PlanContext(

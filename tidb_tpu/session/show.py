@@ -104,6 +104,11 @@ def exec_show(sess, stmt):
         ddl = (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +
                "\n) ENGINE=InnoDB DEFAULT CHARSET=utf8mb4")
         return _str_chunk(["Table", "Create Table"], [(tbl.name, ddl)])
+    if kind == "plugins":
+        return _str_chunk(["Name", "Status", "Type", "Library", "License",
+                           "Version"],
+                          [(n, st, k, "", "", v)
+                           for n, k, v, st in sess.domain.plugins.list()])
     if kind == "bindings":
         h = sess.domain.bind_handle if stmt.is_global \
             else sess.session_binds
