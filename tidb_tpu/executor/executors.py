@@ -1340,26 +1340,33 @@ class HashJoinExec(Executor):
                 self.ctx.sess.domain.inc_metric("device_join_fallback")
         border = np.argsort(bv, kind="stable")
         sbv = bv[border]
-        lo = np.searchsorted(sbv, pv, side="left")
-        hi = np.searchsorted(sbv, pv, side="right")
-        counts = hi - lo
-        counts[pnull] = 0
-        # exclude null build keys (they sit grouped; mark via bnull sorted)
-        if bnull.any():
-            sbnull = bnull[border]
-            # zero out ranges fully of nulls: since NULL keys have data 0 via
-            # coercion they may equal real 0 keys; guard by filtering matches
-            # after expansion below
-            pass
-        total = int(counts.sum())
-        pi = np.repeat(np.arange(len(probe)), counts)
-        starts = np.repeat(lo, counts)
-        base = np.repeat(np.cumsum(counts) - counts, counts)
-        intra = np.arange(total) - base
-        bi = border[starts + intra]
-        if bnull.any():
-            keep = ~bnull[bi]
-            pi, bi = pi[keep], bi[keep]
+        if len(sbv) and (len(sbv) == 1 or bool(np.all(sbv[1:] > sbv[:-1]))):
+            # unique build keys (PK/unique-index side — the common case):
+            # one binary search + equality check replaces the second
+            # searchsorted and the whole range-expansion machinery
+            lo = np.searchsorted(sbv, pv, side="left")
+            loc = np.minimum(lo, len(sbv) - 1)
+            matched = (sbv[loc] == pv) & ~pnull
+            if bnull.any():
+                matched &= ~bnull[border[loc]]
+            pi = np.nonzero(matched)[0]
+            bi = border[loc[matched]]
+        else:
+            lo = np.searchsorted(sbv, pv, side="left")
+            hi = np.searchsorted(sbv, pv, side="right")
+            counts = hi - lo
+            counts[pnull] = 0
+            total = int(counts.sum())
+            pi = np.repeat(np.arange(len(probe)), counts)
+            starts = np.repeat(lo, counts)
+            base = np.repeat(np.cumsum(counts) - counts, counts)
+            intra = np.arange(total) - base
+            bi = border[starts + intra]
+            # exclude null build keys (they sit grouped; NULL keys coerce
+            # to 0 and may collide with real 0 keys, so filter matches)
+            if bnull.any():
+                keep = ~bnull[bi]
+                pi, bi = pi[keep], bi[keep]
 
         # other conditions filter matched pairs
         if plan.other_conds:
