@@ -66,6 +66,8 @@ class Domain:
         self.resource_groups = ResourceGroupManager()
         from ..plugin import PluginManager
         self.plugins = PluginManager()
+        self.ast_cache: dict = {}         # sql -> parsed stmt list
+        self.digest_cache: dict = {}      # sql -> (normalized, digest)
         if data_dir:
             self._open_wal(data_dir)
 

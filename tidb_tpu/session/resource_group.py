@@ -62,11 +62,15 @@ class ResourceGroup:
         return 0.0
 
     def settle(self, ru: float):
+        if not self.ru_per_sec:
+            # unlimited group: plain add, no bucket to maintain — skipping
+            # the mutex keeps the default group off the OLTP hot path
+            self.consumed_ru += ru
+            return
         with self._mu:
             self._refill(time.time())
             self.consumed_ru += ru
-            if self.ru_per_sec:
-                self.tokens -= ru
+            self.tokens -= ru
 
 
 class ResourceGroupManager:

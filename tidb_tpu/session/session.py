@@ -96,7 +96,15 @@ class Session:
 
     # ---- public entry --------------------------------------------------
     def execute(self, sql: str, params=None) -> ResultSet:
-        stmts = parse(sql)
+        # AST cache: same reuse contract as prepared statements (the
+        # planner treats parsed trees as read-only)
+        dom = self.domain
+        stmts = dom.ast_cache.get(sql)
+        if stmts is None:
+            stmts = parse(sql)
+            if len(dom.ast_cache) > 512:
+                dom.ast_cache.clear()
+            dom.ast_cache[sql] = stmts
         result = ResultSet()
         cache_key_ok = len(stmts) == 1   # multi-stmt text can't key the cache
         for stmt in stmts:
@@ -137,11 +145,17 @@ class Session:
                 "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
                 "stmt": type(stmt).__name__, "conn": self.conn_id,
                 "db": self.vars.current_db, "success": ok})
-        try:
-            from ..parser import normalize_digest
-            norm, digest = normalize_digest(sql) if sql else ("", "")
-        except Exception:
-            norm, digest = "", ""
+        nd = self.domain.digest_cache.get(sql)
+        if nd is None:
+            try:
+                from ..parser import normalize_digest
+                nd = normalize_digest(sql) if sql else ("", "")
+            except Exception:
+                nd = ("", "")
+            if len(self.domain.digest_cache) > 1024:
+                self.domain.digest_cache.clear()
+            self.domain.digest_cache[sql] = nd
+        norm, digest = nd
         summ = self.domain.stmt_summary_map.setdefault(digest, {
             "digest": digest, "normalized": norm[:1024],
             "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0})
@@ -505,8 +519,14 @@ class Session:
             self.domain.bind_handle.match(digest)
         if rec is not None:
             stmt.hints = list(rec.hints)
+            stmt._hints_from_binding = True
             self.vars.set("last_plan_from_binding", 1)
             self.domain.inc_metric("plan_from_binding")
+        elif getattr(stmt, "_hints_from_binding", False):
+            # cached AST carries hints from a since-dropped binding
+            stmt.hints = []
+            stmt._hints_from_binding = False
+            self.vars.set("last_plan_from_binding", 0)
         elif getattr(stmt, "from_clause", True) is not None:
             # table-less probes (`select @@last_plan_from_binding`) keep
             # the previous statement's flag
