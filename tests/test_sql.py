@@ -518,6 +518,31 @@ class TestDDL:
         # index creation rolled back: inserts still work
         ftk.must_exec("insert into ub values (1)")
 
+    def test_online_index_states(self, ftk):
+        """F1 state ladder (reference ddl/index.go): non-public indexes
+        are invisible to the planner but maintained by writes."""
+        from tidb_tpu.models.schema import SchemaState
+        ftk.must_exec("create table ois (id int primary key, a int)")
+        ftk.must_exec("insert into ois values (1, 10), (2, 20)")
+        ftk.must_exec("create index ia on ois (a)")
+        tbl = ftk.domain.infoschema().table_by_name("test", "ois")
+        idx = tbl.find_index("ia")
+        assert idx.state == SchemaState.PUBLIC
+        # force write-only: planner must not use it, writes must maintain it
+        idx.state = SchemaState.WRITE_ONLY
+        assert tbl.public_indexes() == []
+        assert tbl.writable_indexes() == [idx]
+        ftk.must_exec("insert into ois values (3, 30)")
+        idx.state = SchemaState.PUBLIC
+        # the write-only insert kept the index complete
+        ftk.must_query("select id from ois where a = 30").check([(3,)])
+        # delete-only still removes entries
+        idx.state = SchemaState.DELETE_ONLY
+        assert tbl.deletable_indexes() == [idx]
+        ftk.must_exec("delete from ois where id = 3")
+        idx.state = SchemaState.PUBLIC
+        ftk.must_query("select id from ois where a = 30").check([])
+
     def test_truncate_rename(self, ftk):
         ftk.must_exec("create table tr (a int)")
         ftk.must_exec("insert into tr values (1)")

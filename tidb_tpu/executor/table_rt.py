@@ -61,7 +61,7 @@ def add_record(txn, tbl, handle: int, row: list, skip_check=False):
 
 def remove_record(txn, tbl, handle: int, row: list):
     txn.delete(record_key(physical_id(tbl, row), handle))
-    for idx in tbl.writable_indexes():
+    for idx in tbl.deletable_indexes():
         datums = _index_datums(tbl, idx, row)
         if idx.unique and not any(d.is_null for d in datums):
             txn.delete(index_key(tbl.id, idx.id, datums))
@@ -84,7 +84,7 @@ def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
     for ci, d in zip(tbl.columns, new_row):
         if d.is_null and ci.ft.not_null:
             raise BadNullError("Column '%s' cannot be null", ci.name)
-    for idx in tbl.writable_indexes():
+    for idx in tbl.deletable_indexes():
         od = _index_datums(tbl, idx, old_row)
         nd = _index_datums(tbl, idx, new_row)
         if [d.sort_key() for d in od] == [d.sort_key() for d in nd]:
@@ -93,6 +93,9 @@ def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
             txn.delete(index_key(tbl.id, idx.id, od))
         elif not idx.unique:
             txn.delete(index_key(tbl.id, idx.id, od, handle))
+        from ..models.schema import SchemaState
+        if idx.state < SchemaState.WRITE_ONLY:
+            continue           # delete-only: old entry gone, no new entry
         if idx.unique and not any(d.is_null for d in nd):
             ik = index_key(tbl.id, idx.id, nd)
             if txn.get(ik) is not None:

@@ -127,6 +127,12 @@ class TableInfo:
     def writable_indexes(self) -> list[IndexInfo]:
         return [i for i in self.indexes if i.state >= SchemaState.WRITE_ONLY]
 
+    def deletable_indexes(self) -> list[IndexInfo]:
+        return [i for i in self.indexes if i.state >= SchemaState.DELETE_ONLY]
+
+    def public_indexes(self) -> list[IndexInfo]:
+        return [i for i in self.indexes if i.state == SchemaState.PUBLIC]
+
     def to_json(self):
         return {
             "id": self.id, "name": self.name,

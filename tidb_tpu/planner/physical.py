@@ -303,7 +303,7 @@ def _try_point_get(ds: DataSource) -> PhysPlan | None:
     if tbl.pk_is_handle and set(eqs) == {tbl.pk_col_name.lower()}:
         return PhysPointGet(tbl, ds.db_name, cols,
                             eqs[tbl.pk_col_name.lower()], None, None, schema)
-    for idx in tbl.indexes:
+    for idx in tbl.public_indexes():
         if idx.unique and set(eqs) == {c.lower() for c in idx.columns}:
             vals = [eqs[c.lower()] for c in idx.columns]
             return PhysPointGet(tbl, ds.db_name, cols, None, idx, vals,
@@ -411,7 +411,7 @@ def _try_index_range(ds: DataSource) -> PhysPlan | None:
     base_rows = None
     # selective enough? (post-selectivity estimate vs a fraction)
     indexed_cols = {}
-    for idx in tbl.indexes:
+    for idx in tbl.public_indexes():
         if len(idx.columns) >= 1:
             indexed_cols.setdefault(idx.columns[0].lower(), idx)
     low = high = None
@@ -460,7 +460,7 @@ def _try_index_merge(ds: DataSource) -> PhysPlan | None:
     if tbl.id < 0 or tbl.partitions or not ds.pushed_conds:
         return None
     indexed_cols = {}
-    for idx in tbl.indexes:
+    for idx in tbl.public_indexes():
         if len(idx.columns) >= 1:
             indexed_cols.setdefault(idx.columns[0].lower(), idx)
     if not indexed_cols:

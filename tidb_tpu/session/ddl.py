@@ -432,17 +432,40 @@ class DDLExecutor:
         self._alter_add_index(tn, idx_def)
 
     def drop_index(self, stmt: ast.DropIndexStmt):
+        """Drop through the reverse F1 ladder (reference ddl/index.go
+        onDropIndex): public -> write-only (reads stop) -> delete-only
+        (writes stop) -> absent, then purge the index key range."""
+        from ..models.schema import SchemaState
         tn = stmt.table
 
-        def fn(m):
+        def check(m):
             db, tbl = self._get_table(m, tn)
             idx = tbl.find_index(stmt.index_name)
             if idx is None:
                 raise IndexNotExistsError("index %s doesn't exist",
                                           stmt.index_name)
-            tbl.indexes = [i for i in tbl.indexes if i is not idx]
-            m.update_table(db.id, tbl)
+            return db, tbl, idx
+        _, tbl, idx = self._with_meta(check)
+        self._set_index_state(tn, idx.name, SchemaState.WRITE_ONLY)
+        self._set_index_state(tn, idx.name, SchemaState.DELETE_ONLY)
+
+        def fn(m):
+            db, tbl2 = self._get_table(m, tn)
+            tbl2.indexes = [i for i in tbl2.indexes
+                            if i.name.lower() != idx.name.lower()]
+            m.update_table(db.id, tbl2)
         self._with_meta(fn)
+        # purge index KV range (reference: delete-range worker)
+        from ..codec.tablecodec import index_prefix
+        pref = index_prefix(tbl.id, idx.id)
+        txn = self.domain.storage.begin()
+        try:
+            for k, _v in txn.scan(pref, pref + b"\xff" * 9):
+                txn.delete(k)
+            txn.commit()
+        except BaseException:
+            txn.rollback()
+            raise
 
     def alter_table(self, stmt: ast.AlterTableStmt):
         for action, payload in stmt.actions:
@@ -511,9 +534,27 @@ class DDLExecutor:
             m.update_table(db.id, tbl)
         self._with_meta(fn)
 
+    def _set_index_state(self, tn, idx_name, state):
+        """One F1 state transition = one meta txn = one schema version
+        bump (reference ddl/index.go onCreateIndex state ladder)."""
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            idx = tbl.find_index(idx_name)
+            if idx is not None:
+                idx.state = state
+                m.update_table(db.id, tbl)
+            return db, tbl, idx
+        return self._with_meta(fn)
+
     def _alter_add_index(self, tn, idx_def):
-        """Add index + synchronous backfill (reference: write-reorg state +
-        backfill workers, ddl/backfilling*.go — here one transaction)."""
+        """Add index through the F1 online states (reference
+        ddl/index.go onCreateIndex + backfilling*.go): none ->
+        delete-only -> write-only -> write-reorg (snapshot backfill while
+        concurrent DML maintains the index) -> public. Each transition is
+        its own schema version, so concurrent sessions never skip a
+        state."""
+        from ..models.schema import SchemaState
+
         def fn(m):
             db, tbl = self._get_table(m, tn)
             if tbl.find_index(idx_def.name) is not None:
@@ -525,7 +566,8 @@ class DDLExecutor:
             idx = IndexInfo(
                 id=max((i.id for i in tbl.indexes), default=0) + 1,
                 name=idx_def.name, columns=list(idx_def.columns),
-                unique=idx_def.unique, primary=idx_def.primary)
+                unique=idx_def.unique, primary=idx_def.primary,
+                state=SchemaState.DELETE_ONLY)
             tbl.indexes.append(idx)
             m.update_table(db.id, tbl)
             return db, tbl, idx
@@ -533,9 +575,13 @@ class DDLExecutor:
         if result is None:
             return
         db, tbl, idx = result
+        self._set_index_state(tn, idx.name, SchemaState.WRITE_ONLY)
+        _, tbl, idx = self._set_index_state(tn, idx.name,
+                                            SchemaState.WRITE_REORG)
         # backfill from columnar snapshot
         ctab = self.domain.columnar.tables.get(tbl.id)
         if ctab is None or ctab.live_count() == 0:
+            self._set_index_state(tn, idx.name, SchemaState.PUBLIC)
             return
         txn = self.domain.storage.begin()
         try:
@@ -552,13 +598,18 @@ class DDLExecutor:
                 if idx.unique and not any(d.is_null for d in datums):
                     ik = index_key(tbl.id, idx.id, datums)
                     existing = txn.get(ik)
-                    if existing is not None:
+                    if existing is not None and \
+                            existing not in (str(handle).encode(), b""):
+                        # a concurrent write-only writer may have written
+                        # this very row's entry already; only a different
+                        # handle is a duplicate
                         raise DuplicateKeyError(
                             "Duplicate entry for key '%s'", idx.name)
                     txn.set(ik, str(handle).encode())
                 else:
                     txn.set(index_key(tbl.id, idx.id, datums, handle), b"")
             txn.commit()
+            self._set_index_state(tn, idx.name, SchemaState.PUBLIC)
         except BaseException:
             txn.rollback()
             # roll back the meta change
