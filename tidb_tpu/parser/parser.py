@@ -159,6 +159,8 @@ class Parser:
             if self.accept_kw("show"):
                 self.expect_kw("ddl")
                 return ast.AdminStmt(kind="show_ddl")
+            if self.accept_kw("checkpoint"):
+                return ast.AdminStmt(kind="checkpoint")
             self.error("unsupported ADMIN command")
         if kw == "trace":
             self.next()

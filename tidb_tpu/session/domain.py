@@ -72,10 +72,30 @@ class Domain:
             self._open_wal(data_dir)
 
     def _open_wal(self, data_dir):
-        """Replay the commit log, then attach the writer (durability for
-        the row/meta engines; bulk columnar loads persist via BR)."""
+        """Restore the latest checkpoint (if any), replay the WAL tail,
+        then attach the writer (durability for the row/meta engines; bulk
+        columnar loads persist via BR). Recovery cost is bounded by
+        checkpointing (ADMIN CHECKPOINT / auto): snapshot + truncated
+        WAL, the reference's RocksDB-snapshot + raft-log-GC shape."""
         import os
+        import pickle
         from ..storage.wal import WalWriter, replay
+        ckpt = os.path.join(data_dir, "checkpoint.snap")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                ckpt_ts, triples = pickle.load(f)
+            # re-apply versions in commit order so the engine hooks
+            # rebuild columnar/schema state exactly like a WAL replay
+            triples.sort(key=lambda t: t[0])
+            i = 0
+            while i < len(triples):
+                ts = triples[i][0]
+                muts = []
+                while i < len(triples) and triples[i][0] == ts:
+                    muts.append((triples[i][1], triples[i][2]))
+                    i += 1
+                self.storage.oracle.fast_forward(ts)
+                self.storage.mvcc.apply_replay(ts, muts)
         path = os.path.join(data_dir, "commit.wal")
         for commit_ts, mutations in replay(path):
             # keep the oracle ahead of replayed commits so the engine hooks
@@ -84,6 +104,50 @@ class Domain:
             self.storage.mvcc.apply_replay(commit_ts, mutations)
         self.is_cache._cached = None     # reload schema from replayed meta
         self.storage.mvcc.wal = WalWriter(path)
+
+    def checkpoint(self) -> int:
+        """Write a consistent snapshot of the MVCC store and truncate the
+        WAL (commits pause for the duration; single-node trade, like a
+        RocksDB checkpoint). Returns the checkpoint ts."""
+        import os
+        import pickle
+        if not self.data_dir:
+            from ..errors import TiDBError
+            raise TiDBError("checkpoint requires --data-dir")
+        mvcc = self.storage.mvcc
+        with mvcc._mu:
+            ts = self.storage.current_ts()
+            triples = []
+            for k, vers in mvcc._kv.scan(b"", None):
+                for vts, val in zip(vers.ts_list, vers.values):
+                    triples.append((vts, k, val))
+            tmp = os.path.join(self.data_dir, "checkpoint.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump((ts, triples), f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.data_dir, "checkpoint.snap"))
+            if mvcc.wal is not None:
+                mvcc.wal.close()
+                wal_path = mvcc.wal.path
+                open(wal_path, "wb").close()     # truncate: all frames
+                from ..storage.wal import WalWriter  # are in the snapshot
+                mvcc.wal = WalWriter(wal_path)
+        self.inc_metric("checkpoints")
+        return ts
+
+    def maybe_checkpoint(self, wal_limit=32 << 20):
+        """Auto-checkpoint once the WAL outgrows `wal_limit` bytes."""
+        import os
+        w = self.storage.mvcc.wal
+        if w is None:
+            return
+        try:
+            if os.path.getsize(w.path) > wal_limit:
+                self.checkpoint()
+        except OSError:
+            pass
 
     def seq_nextval(self, db_name: str, name: str) -> int:
         """Sequence allocation with cache chunks persisted via meta
@@ -152,6 +216,8 @@ class Domain:
         self.timer.register("auto_analyze", analyze_interval,
                             self.auto_analyze_once)
         self.timer.register("gc", gc_interval, self.run_gc)
+        self.timer.register("checkpoint", gc_interval,
+                            self.maybe_checkpoint)
 
     def auto_analyze_once(self, stale_ratio=0.5):
         """Re-ANALYZE tables whose row count drifted vs collected stats

@@ -273,6 +273,9 @@ class Session:
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
         if isinstance(stmt, ast.AdminStmt):
+            if stmt.kind == "checkpoint":
+                ts = self.domain.checkpoint()
+                return ResultSet(affected=ts)
             if stmt.kind == "check_table":
                 from ..executor.admin import check_table
                 total = 0
