@@ -234,6 +234,32 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestStaleRead:
+    def test_as_of_timestamp(self, ftk):
+        import time as _t
+        from tidb_tpu.types.time_types import micros_to_str
+        ftk.must_exec("create table sr (id int primary key, v int)")
+        ftk.must_exec("insert into sr values (1, 10)")
+        _t.sleep(0.05)
+        mid = micros_to_str(int(_t.time() * 1e6), 6)
+        _t.sleep(0.05)
+        ftk.must_exec("update sr set v = 99 where id = 1")
+        ftk.must_exec("insert into sr values (2, 20)")
+        ftk.must_query("select * from sr order by id").check(
+            [(1, 99), (2, 20)])
+        # snapshot before the update/insert
+        ftk.must_query(f"select * from sr as of timestamp '{mid}' "
+                       "order by id").check([(1, 10)])
+        # stale point get takes the same snapshot
+        ftk.must_query(f"select v from sr as of timestamp '{mid}' "
+                       "where id = 1").check([(10,)])
+        import pytest as _pt
+        from tidb_tpu import errors as _e
+        with _pt.raises(_e.TiDBError, match="future"):
+            ftk.must_query("select * from sr as of timestamp "
+                           "'2099-01-01 00:00:00'")
+
+
 class TestPluginsAndTopSQL:
     def test_audit_plugin_and_show(self, ftk):
         from tidb_tpu.plugin import Plugin

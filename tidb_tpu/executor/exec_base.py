@@ -50,13 +50,25 @@ class ExecContext:
                     "execution time exceeded")
 
     def read_ts(self):
-        """Snapshot ts for scans: the session txn's start_ts when inside an
-        explicit transaction; None (read-latest) for autocommit reads."""
+        """Snapshot ts for scans: AS OF TIMESTAMP ts when set, the session
+        txn's start_ts inside an explicit transaction, a staleness-shifted
+        ts under tidb_read_staleness, else None (read-latest)."""
+        if getattr(self, "stale_read_ts", 0):
+            return self.stale_read_ts
         sess = self.sess
         txn = getattr(sess, "_txn", None)
         if txn is not None and not txn.committed and not txn.aborted and \
                 getattr(sess, "_explicit_txn", False):
             return txn.start_ts
+        try:
+            staleness = int(self.sv.get("tidb_read_staleness"))
+        except Exception:               # noqa: BLE001
+            staleness = 0
+        if staleness < 0:
+            import time as _time
+            ts = sess.domain.storage.oracle.ts_for_time(
+                _time.time() + staleness)
+            return ts or None
         return None
 
 

@@ -209,6 +209,7 @@ class TableName(Node):
     db: str = ""
     alias: str = ""
     index_hints: list = field(default_factory=list)
+    as_of: ExprNode | None = None      # AS OF TIMESTAMP (stale read)
 
 
 @dataclass

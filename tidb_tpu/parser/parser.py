@@ -484,6 +484,12 @@ class Parser:
                 alias = self.ident()
             return ast.SubqueryTable(select=sel, alias=alias)
         tn = self.parse_table_name()
+        if self.at_kw("as") and self.peek(1).kind == "IDENT" and \
+                self.peek(1).text.lower() == "of":
+            self.next()
+            self.next()
+            self.expect_kw("timestamp")
+            tn.as_of = self.parse_expr()
         if self.accept_kw("as"):
             tn.alias = self.ident()
         elif self.peek().kind in ("IDENT", "QIDENT") and \

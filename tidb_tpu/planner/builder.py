@@ -136,7 +136,39 @@ class PlanBuilder:
         ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
         return ds
 
+    def _resolve_as_of(self, tn):
+        """AS OF TIMESTAMP expr -> snapshot ts (reference stale-read,
+        planner/core/preprocess.go TimestampBoundReadTS). All tables in a
+        statement share one stale ts (last one wins, matching the
+        single-ts restriction)."""
+        from ..errors import TiDBError
+        rw = self._rewriter(Schema([]))
+        e = rw.rewrite(tn.as_of)
+        if not isinstance(e, Constant) or e.value.is_null:
+            raise TiDBError("AS OF TIMESTAMP requires a constant timestamp")
+        v = e.value.val
+        from ..types.field_type import TypeClass
+        if isinstance(v, str):
+            from ..types.time_types import parse_datetime
+            micros = parse_datetime(v)
+        else:
+            micros = int(v)
+        wall = micros / 1e6
+        import time as _time
+        if wall > _time.time() + 1:
+            raise TiDBError("cannot set read timestamp to a future time")
+        if self.pctx.ts_for_time is None:
+            raise TiDBError("stale read not available in this context")
+        ts = self.pctx.ts_for_time(wall)
+        if ts <= 0:
+            raise TiDBError(
+                "stale read timestamp predates recorded history")
+        self.pctx.stale_read_ts = ts
+        self.pctx.cacheable = False
+
     def build_datasource(self, tn: ast.TableName) -> DataSource:
+        if tn.as_of is not None:
+            self._resolve_as_of(tn)
         if not tn.db and tn.name.lower() in self.ctes:
             entry = self.ctes[tn.name.lower()]
             if entry[0] == "temp":

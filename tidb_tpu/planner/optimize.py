@@ -17,7 +17,8 @@ class PlanContext:
                  run_subquery=None, table_rows=None, user_vars=None,
                  now_micros=0, conn_id=1, params=None, table_stats=None,
                  check_read=None, temp_tables=None, make_temp_table=None,
-                 drop_temp_table=None, seq_nextval=None, seq_lastval=None):
+                 drop_temp_table=None, seq_nextval=None, seq_lastval=None,
+                 ts_for_time=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
@@ -30,6 +31,8 @@ class PlanContext:
         self.drop_temp_table = drop_temp_table
         self.seq_nextval = seq_nextval
         self.seq_lastval = seq_lastval
+        self.ts_for_time = ts_for_time
+        self.stale_read_ts = 0       # set by AS OF TIMESTAMP table refs
         self.user_vars = user_vars or {}
         self.now_micros = now_micros
         self.conn_id = conn_id
@@ -78,6 +81,8 @@ def optimize(stmt, pctx: PlanContext):
         phys = to_physical(logical, pctx.sess_vars)
         phys.read_tables = frozenset(pctx.read_tables)
         phys.for_update = stmt.for_update
+        if pctx.stale_read_ts:
+            phys.stale_read_ts = pctx.stale_read_ts
         if hints:
             from ..parser.hints import exec_hints
             eh = exec_hints(hints)

@@ -187,6 +187,7 @@ class Session:
             drop_temp_table=self.drop_temp_table,
             seq_nextval=self.domain.seq_nextval,
             seq_lastval=self.domain.seq_lastval,
+            ts_for_time=self.domain.storage.oracle.ts_for_time,
         )
 
     def make_temp_table(self, name: str, fts, col_names, rows):
@@ -569,6 +570,7 @@ class Session:
                     old = dom.plan_cache_order.pop(0)
                     dom.plan_cache.pop(old, None)
         ectx = ExecContext(self, getattr(plan, "exec_hints", None))
+        ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
         self.domain.register_exec(self.conn_id, ectx)
         ex = build_executor(ectx, plan)
         ex.open()

@@ -22,10 +22,30 @@ class Oracle:
     def __init__(self):
         self._counter = itertools.count(1)
         self._mu = threading.Lock()
+        # (wallclock, ts) samples for stale reads (AS OF TIMESTAMP /
+        # tidb_read_staleness): logical ts <-> physical time mapping
+        from collections import deque
+        self._history = deque(maxlen=1 << 16)
 
     def get_ts(self) -> int:
+        import time as _time
         with self._mu:
-            return next(self._counter)
+            ts = next(self._counter)
+            self._history.append((_time.time(), ts))
+            return ts
+
+    def ts_for_time(self, wall: float) -> int:
+        """Largest allocated ts whose wallclock <= wall (stale reads).
+        Returns 0 when `wall` predates recorded history."""
+        import bisect
+        with self._mu:
+            hist = list(self._history)
+        if not hist:
+            return 0
+        i = bisect.bisect_right(hist, (wall, float("inf")))
+        if i == 0:
+            return 0
+        return hist[i - 1][1]
 
     def fast_forward(self, ts: int):
         """Advance past `ts` (WAL replay)."""
