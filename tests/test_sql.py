@@ -234,6 +234,41 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestVectorType:
+    def test_vector_column_and_functions(self, ftk):
+        ftk.must_exec("create table emb (id int primary key, v vector(3))")
+        ftk.must_exec("insert into emb values (1,'[1,0,0]'),(2,'[0,1,0]'),"
+                      "(3,'[0.5,0.5,0]'),(4,null)")
+        ftk.must_query(
+            "select id, vec_dims(v), round(vec_l2_norm(v), 4) from emb "
+            "order by id").check([
+                (1, 3, "1"), (2, 3, "1"), (3, 3, "0.7071"), (4, None, None)])
+        # nearest neighbors by cosine distance
+        ftk.must_query(
+            "select id from emb where v is not null order by "
+            "vec_cosine_distance(v, '[1,0,0]') limit 2").check([(1,), (3,)])
+        ftk.must_query(
+            "select round(vec_l2_distance(v, '[0,1,0]'), 4) from emb "
+            "where id = 3").check([("0.7071",)])
+        ftk.must_query(
+            "select round(vec_negative_inner_product(v, '[2,2,0]'), 1) "
+            "from emb where id = 3").check([("-2",)])
+        # scalar forms + canonicalization
+        ftk.must_query("select vec_l1_distance('[1,2]', '[3,1]')").check(
+            [("3",)])
+        ftk.must_query("select vec_from_text('[1.0, 2.5,3]')").check(
+            [("[1,2.5,3]",)])
+        # dimension + parse enforcement on write
+        assert ftk.exec_err("insert into emb values (9, '[1,2]')")
+        assert ftk.exec_err("insert into emb values (9, 'oops')")
+        # vectors survive the full storage path (txn + scan)
+        ftk.must_exec("begin")
+        ftk.must_exec("insert into emb values (5, '[0,0,1]')")
+        ftk.must_query("select vec_dims(v) from emb where id = 5").check(
+            [(3,)])
+        ftk.must_exec("commit")
+
+
 class TestStaleRead:
     def test_as_of_timestamp(self, ftk):
         import time as _t

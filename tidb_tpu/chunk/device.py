@@ -44,7 +44,7 @@ def shape_bucket(n: int) -> int:
 class StringDict:
     """Per-column string dictionary: code <-> str, append-only."""
 
-    __slots__ = ("values", "index", "sort_keys")
+    __slots__ = ("values", "index", "sort_keys", "_vec_cache")
 
     def __init__(self):
         self.values: list[str] = []

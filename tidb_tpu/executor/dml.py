@@ -137,6 +137,12 @@ class InsertExec:
                 if ci.ft.tp in ("char", "varchar"):
                     raise DataTooLongError(
                         "Data too long for column '%s'", ci.name)
+            if ci.ft.tp == "vector" and not d.is_null:
+                from ..expression.vec import vec_text_normalize
+                from ..types.datum import Datum as _D, Kind as _K
+                d = _D(_K.STRING, vec_text_normalize(
+                    str(d.val), ci.ft.flen if ci.ft.flen > 0 else None,
+                    ci.name))
             if ci.ft.tp == "enum" and not d.is_null and ci.ft.elems and \
                     str(d.val) not in ci.ft.elems:
                 from ..errors import TruncatedWrongValueError

@@ -220,7 +220,13 @@ def is_device_safe(expr: Expression) -> bool:
 
 _HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
               "connection_id", "get_var", "found_rows", "row_count",
-              "last_insert_id"}
+              "last_insert_id",
+              # vector funcs compute over the distinct-value dictionary on
+              # host and gather; the matrix kernels are numpy (MXU offload
+              # of the stacked matrix is the ops/ roadmap)
+              "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
+              "vec_negative_inner_product", "vec_dims", "vec_l2_norm",
+              "vec_from_text", "vec_as_text"}
 
 
 # ---------------- string helpers ----------------
@@ -1877,3 +1883,188 @@ def op_json_length(ctx, expr):
         return len(v) if isinstance(v, (list, dict)) else 1
     return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
                          out_is_string=False)
+
+
+# ---------------- VECTOR (reference pkg/types VectorFloat32 +
+# expression builtin_vec.go — TiDB VECTOR columns; text-stored like JSON,
+# dictionary-deduplicated; distance kernels run vectorized over the
+# stacked (distinct x dim) float32 matrix and gather per row) ------------
+
+def vec_text_normalize(s: str, dim: int | None = None,
+                       col_name: str = "") -> str:
+    """Parse + canonicalize '[1,2,3]'; enforce declared dimension."""
+    import json as _json
+    from ..errors import TiDBError
+    try:
+        v = _json.loads(s)
+        arr = np.asarray(v, dtype=np.float32)
+        assert arr.ndim == 1
+    except Exception:
+        raise TiDBError("Invalid vector text: '%s'", s[:64])
+    if dim and len(arr) != dim:
+        raise TiDBError(
+            "vector has %d dimensions, expected %d for column '%s'",
+            len(arr), dim, col_name)
+    return "[" + ",".join(_fmt_vec_f(x) for x in arr.tolist()) + "]"
+
+
+def _fmt_vec_f(x: float) -> str:
+    return str(int(x)) if x == int(x) else repr(x)
+
+
+def _parse_vec_text(s: str):
+    import json as _json
+    try:
+        return np.asarray(_json.loads(s), dtype=np.float32)
+    except Exception:
+        return None
+
+
+def _vec_matrix(sdict):
+    """(distinct x dim) float32 matrix for a dict column, cached per dict
+    length (dicts are append-only). Invalid/ragged rows -> NaN rows."""
+    cache = getattr(sdict, "_vec_cache", None)
+    u = len(sdict.values)
+    if cache is not None and cache[0] == u:
+        return cache[1]
+    vecs = [_parse_vec_text(s) for s in sdict.values]
+    d = max((len(v) for v in vecs if v is not None), default=0)
+    mat = np.full((max(u, 1), max(d, 1)), np.nan, dtype=np.float32)
+    for i, v in enumerate(vecs):
+        if v is not None and len(v) == d:
+            mat[i, :len(v)] = v
+    sdict._vec_cache = (u, mat)
+    return mat
+
+
+def _vec_binary(ctx, expr, kernel):
+    """Distance between a vector column and a constant (either side), two
+    constants, or two columns. kernel(M (u,d), q (d,)) -> float64 (u,)."""
+    a = eval_expr(ctx, expr.args[0])
+    b = eval_expr(ctx, expr.args[1])
+    qa, qb = _as_str_scalar(a), _as_str_scalar(b)
+    if qa is not None and qb is not None:
+        va, vb = _parse_vec_text(qa), _parse_vec_text(qb)
+        if va is None or vb is None or len(va) != len(vb):
+            return 0.0, True, None
+        r = float(kernel(va.reshape(1, -1), vb)[0])
+        return r, bool(np.isnan(r)), None
+    if qa is not None or qb is not None:
+        q = _parse_vec_text(qa if qa is not None else qb)
+        col = b if qa is not None else a
+        data, nulls, sd = col
+        if q is None:
+            return np.zeros(ctx.n), np.ones(ctx.n, dtype=bool), None
+        if sd is not None:
+            mat = _vec_matrix(sd)
+            if mat.shape[1] != len(q):
+                tab = np.full(len(mat), np.nan)
+            else:
+                tab = kernel(mat, q)
+            vals = tab[np.asarray(data)]
+            nm = np.asarray(materialize_nulls(ctx, nulls))
+            return np.nan_to_num(vals), nm | np.isnan(vals), None
+        # host object array of strings
+        out = np.zeros(ctx.n)
+        bad = np.zeros(ctx.n, dtype=bool)
+        for i, txt in enumerate(np.asarray(data)):
+            v = _parse_vec_text(txt) if txt is not None else None
+            if v is None or len(v) != len(q):
+                bad[i] = True
+            else:
+                out[i] = float(kernel(v.reshape(1, -1), q)[0])
+        nm = np.asarray(materialize_nulls(ctx, nulls))
+        return out, nm | bad, None
+    # column vs column: row-wise
+    da, na, sda = a
+    db_, nb, sdb = b
+
+    def row_text(col, i):
+        data, _n, sd = col
+        c = np.asarray(data)[i]
+        return sd.values[int(c)] if sd is not None else c
+    out = np.zeros(ctx.n)
+    bad = np.zeros(ctx.n, dtype=bool)
+    for i in range(ctx.n):
+        va = _parse_vec_text(row_text(a, i))
+        vb = _parse_vec_text(row_text(b, i))
+        if va is None or vb is None or len(va) != len(vb):
+            bad[i] = True
+        else:
+            out[i] = float(kernel(va.reshape(1, -1), vb)[0])
+    nm = np.asarray(materialize_nulls(ctx, na)) | \
+        np.asarray(materialize_nulls(ctx, nb))
+    return out, nm | bad, None
+
+
+@op("vec_cosine_distance")
+def op_vec_cos(ctx, expr):
+    def kernel(M, q):
+        num = M.astype(np.float64) @ q.astype(np.float64)
+        den = np.linalg.norm(M.astype(np.float64), axis=1) * \
+            np.linalg.norm(q.astype(np.float64))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return 1.0 - num / den     # zero vector -> NaN -> NULL
+    return _vec_binary(ctx, expr, kernel)
+
+
+@op("vec_l2_distance")
+def op_vec_l2(ctx, expr):
+    def kernel(M, q):
+        d = M.astype(np.float64) - q.astype(np.float64)
+        return np.sqrt((d * d).sum(axis=1))
+    return _vec_binary(ctx, expr, kernel)
+
+
+@op("vec_l1_distance")
+def op_vec_l1(ctx, expr):
+    def kernel(M, q):
+        return np.abs(M.astype(np.float64) -
+                      q.astype(np.float64)).sum(axis=1)
+    return _vec_binary(ctx, expr, kernel)
+
+
+@op("vec_negative_inner_product")
+def op_vec_nip(ctx, expr):
+    def kernel(M, q):
+        return -(M.astype(np.float64) @ q.astype(np.float64))
+    return _vec_binary(ctx, expr, kernel)
+
+
+@op("vec_dims")
+def op_vec_dims(ctx, expr):
+    def f(s):
+        v = _parse_vec_text(s)
+        return len(v) if v is not None else 0
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)
+
+
+@op("vec_l2_norm")
+def op_vec_l2_norm(ctx, expr):
+    a = eval_expr(ctx, expr.args[0])
+    data, nulls, sd = a
+    if sd is not None:
+        mat = _vec_matrix(sd).astype(np.float64)
+        tab = np.sqrt((mat * mat).sum(axis=1))
+        vals = tab[np.asarray(data)]
+        nm = np.asarray(materialize_nulls(ctx, nulls))
+        return np.nan_to_num(vals), nm | np.isnan(vals), None
+
+    def f(s):
+        v = _parse_vec_text(s)
+        return float(np.linalg.norm(v)) if v is not None else 0.0
+    out = _string_elementwise(ctx, np.asarray(data), f, dtype=np.float64)
+    return out, nulls, None
+
+
+@op("vec_from_text")
+def op_vec_from_text(ctx, expr):
+    def f(s):
+        return vec_text_normalize(s)
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("vec_as_text")
+def op_vec_as_text(ctx, expr):
+    return eval_expr(ctx, expr.args[0])

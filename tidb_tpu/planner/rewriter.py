@@ -30,17 +30,21 @@ _STRING_FUNCS = {"lower", "lcase", "upper", "ucase", "concat", "substring",
                  "replace", "reverse", "lpad", "rpad", "cast_char",
                  "hex", "unhex", "bin", "oct", "repeat", "space", "md5",
                  "sha1", "sha", "format", "conv", "elt", "char",
-                 "json_extract", "json_unquote"}
+                 "json_extract", "json_unquote",
+                 "vec_from_text", "vec_as_text"}
 _INT_FUNCS = {"length", "octet_length", "char_length", "character_length",
               "locate", "instr", "year", "month", "day", "dayofmonth",
               "quarter", "dayofweek", "weekday", "dayofyear", "hour",
               "minute", "second", "week", "datediff", "sign",
               "unix_timestamp", "cast_signed", "cast_unsigned", "ceil",
               "ceiling", "floor", "extract", "ascii", "ord", "crc32",
-              "strcmp", "field", "json_valid", "json_length"}
+              "strcmp", "field", "json_valid", "json_length",
+              "vec_dims"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
                 "cast_double", "rand", "pi", "degrees", "radians", "sin",
-                "cos", "tan", "asin", "acos", "atan", "atan2"}
+                "cos", "tan", "asin", "acos", "atan", "atan2",
+                "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
+                "vec_negative_inner_product", "vec_l2_norm"}
 
 
 def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,

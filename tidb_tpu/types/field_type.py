@@ -46,6 +46,7 @@ MYSQL_TYPE_NAMES = {
     "date": TypeClass.DATE, "datetime": TypeClass.DATETIME,
     "timestamp": TypeClass.TIMESTAMP, "time": TypeClass.DURATION,
     "json": TypeClass.JSON, "bit": TypeClass.BIT,
+    "vector": TypeClass.STRING,   # text-stored, dict-encoded (VEC_* funcs)
     "enum": TypeClass.ENUM, "set": TypeClass.SET,
 }
 
