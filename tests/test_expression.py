@@ -299,3 +299,93 @@ class TestFold:
         e = ScalarFunc("+", [const_from_py(1), const_null()], ft_i)
         f = fold_constants(e)
         assert isinstance(f, Constant) and f.value.is_null
+
+
+@pytest.fixture()
+def tk():
+    from tidb_tpu.testkit import TestKit
+    return TestKit()
+
+
+class TestBuiltinLongTail:
+    """Batch parity checks for the long-tail builtins (reference
+    pkg/expression builtin_{string,time,math,miscellaneous,json}.go)."""
+
+    def test_string_misc(self, tk):
+        q = tk.must_query
+        q("select find_in_set('b','a,b,c'), find_in_set('z','a,b')").check(
+            [(2, 0)])
+        q("select substring_index('a.b.c','.',2), "
+          "substring_index('a.b.c','.',-1)").check([("a.b", "c")])
+        q("select insert('abcdef',2,3,'XY')").check([("aXYef",)])
+        q("select soundex('Robert'), soundex('Rupert')").check(
+            [("R163", "R163")])
+        q("select to_base64('ab'), from_base64('YWI=')").check(
+            [("YWI=", "ab")])
+        q("select sha2('', 256)").check([(
+            "e3b0c44298fc1c149afbf4c8996fb924"
+            "27ae41e4649b934ca495991b7852b855",)])
+        q("select bit_count(7), bit_count(255), bit_count(0)").check(
+            [(3, 8, 0)])
+        q("select interval(5, 1, 3, 7)").check([(2,)])
+        q("select inet_aton('1.2.3.4'), inet_ntoa(16909060)").check(
+            [(16909060, "1.2.3.4")])
+        q("select is_ipv4('1.2.3.4'), is_ipv4('x'), is_ipv6('::1')").check(
+            [(1, 0, 1)])
+        q("select make_set(5,'a','b','c'), "
+          "export_set(5,'Y','N',',',4)").check([("a,c", "Y,N,Y,N")])
+
+    def test_temporal_tail(self, tk):
+        q = tk.must_query
+        q("select date_format('2024-03-05 14:07:09', "
+          "'%Y/%m/%d %H:%i %W')").check([("2024/03/05 14:07 Tuesday",)])
+        q("select str_to_date('05,3,2024','%d,%m,%Y')").check(
+            [("2024-03-05 00:00:00",)])
+        q("select dayname('2024-03-05'), monthname('2024-03-05')").check(
+            [("Tuesday", "March")])
+        q("select last_day('2024-02-05'), last_day('2023-02-05')").check(
+            [("2024-02-29", "2023-02-28")])
+        q("select to_days('2024-01-01')").check([(739251,)])
+        q("select from_days(739251)").check([("2024-01-01",)])
+        q("select from_unixtime(86400), from_unixtime(0,'%Y')").check(
+            [("1970-01-02 00:00:00", "1970")])
+        q("select microsecond('2024-01-01 12:00:00.5')").check([(500000,)])
+        q("select yearweek('2024-03-05')").check([(202409,)])
+        q("select timestampdiff(day,'2024-01-01','2024-02-01'), "
+          "timestampdiff(month,'2024-01-31','2024-02-28'), "
+          "timestampdiff(year,'2020-06-01','2024-05-31')").check(
+            [(31, 0, 3)])
+        q("select period_add(202401, 2), "
+          "period_diff(202403, 202312)").check([(202403, 3)])
+        q("select time_to_sec('01:01:01'), sec_to_time(3661)").check(
+            [(3661, "01:01:01")])
+        q("select maketime(1,2,3), makedate(2024, 60)").check(
+            [("01:02:03", "2024-02-29")])
+
+    def test_json_tail(self, tk):
+        q = tk.must_query
+        q("select json_type('[1]'), json_type('{}'), "
+          "json_type('3')").check([("ARRAY", "OBJECT", "INTEGER")])
+        q("select json_keys('{\"a\":1,\"b\":2}')").check([('["a", "b"]',)])
+        q("select json_depth('[[1]]'), json_depth('1')").check([(3, 1)])
+        q("select json_contains('[1,2,3]','2'), "
+          "json_contains('[1]','9')").check([(1, 0)])
+        q("select json_array(1,'a')").check([('[1, "a"]',)])
+        q("select json_object('k', 7)").check([('{"k": 7}',)])
+        q("select json_set('{\"a\":1}','$.a',2)").check([('{"a": 2}',)])
+        q("select json_insert('{\"a\":1}','$.a',2)").check([('{"a": 1}',)])
+        q("select json_remove('{\"a\":1,\"b\":2}','$.a')").check(
+            [('{"b": 2}',)])
+        q("select json_merge_patch('{\"a\":1}','{\"b\":2,\"a\":null}')"
+          ).check([('{"b": 2}',)])
+        q("select json_contains_path('{\"a\":1}','one','$.a','$.z'), "
+          "json_contains_path('{\"a\":1}','all','$.a','$.z')").check(
+            [(1, 0)])
+
+    def test_tail_over_columns(self, tk):
+        tk.must_exec("create table bt (d date, s varchar(32), n int)")
+        tk.must_exec("insert into bt values "
+                     "('2024-03-05','a,b,c',7),('2024-03-06','x,y',255)")
+        tk.must_query("select dayname(d), find_in_set('b', s), "
+                      "bit_count(n) from bt order by d").check([
+                          ("Tuesday", 2, 3), ("Wednesday", 0, 8)])

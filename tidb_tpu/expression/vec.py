@@ -226,7 +226,15 @@ _HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
               # of the stacked matrix is the ops/ roadmap)
               "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
               "vec_negative_inner_product", "vec_dims", "vec_l2_norm",
-              "vec_from_text", "vec_as_text"}
+              "vec_from_text", "vec_as_text",
+              # row-wise host tail (mixed string/number args)
+              "find_in_set", "substring_index", "insert", "inet_aton",
+              "inet_ntoa", "is_ipv4", "is_ipv6", "make_set", "export_set",
+              "date_format", "str_to_date", "dayname", "monthname",
+              "from_unixtime", "time_to_sec", "sec_to_time", "maketime",
+              "json_array", "json_object", "json_set", "json_insert",
+              "json_replace", "json_remove", "json_merge_patch",
+              "json_contains_path"}
 
 
 # ---------------- string helpers ----------------
@@ -2068,3 +2076,851 @@ def op_vec_from_text(ctx, expr):
 @op("vec_as_text")
 def op_vec_as_text(ctx, expr):
     return eval_expr(ctx, expr.args[0])
+
+
+# ---------------- builtin long tail (reference pkg/expression
+# builtin_string.go / builtin_time.go / builtin_math.go /
+# builtin_miscellaneous.go / builtin_json.go) ----------------------------
+
+def _rows_as_str(ctx, val):
+    """Materialize a string value to (object array | scalar str, nulls)."""
+    data, nulls, sd = val
+    if isinstance(data, str):
+        return data, nulls
+    if sd is not None:
+        return sd.decode(np.asarray(data).astype(np.int64)), nulls
+    return np.asarray(data), nulls
+
+
+def _rowwise(ctx, expr, fn, dtype=object):
+    """Evaluate all args, apply python fn per row on host (tail funcs that
+    mix strings and numbers; device offload not worth a kernel)."""
+    vals = [eval_expr(ctx, a) for a in expr.args]
+    mats = []
+    nmask = np.zeros(ctx.n, dtype=bool)
+    for (d, nl, sd), a in zip(vals, expr.args):
+        if sd is not None:
+            mats.append(sd.decode(np.asarray(d).astype(np.int64)))
+        elif isinstance(d, (str, int, float)) or d is None:
+            mats.append(np.full(ctx.n, d, dtype=object))
+        else:
+            mats.append(np.asarray(d))
+        nmask |= np.asarray(materialize_nulls(ctx, nl))
+    out = np.empty(ctx.n, dtype=dtype)
+    bad = np.zeros(ctx.n, dtype=bool)
+    fill = "" if dtype == object else 0
+    for i in range(ctx.n):
+        if nmask[i]:
+            out[i] = fill
+            continue
+        try:
+            r = fn(*(m[i] for m in mats))
+        except Exception:               # noqa: BLE001
+            r = None
+        if r is None:
+            bad[i] = True
+            out[i] = fill
+        else:
+            out[i] = r
+    return out, nmask | bad, None
+
+
+@op("find_in_set")
+def op_find_in_set(ctx, expr):
+    def f(s, lst):
+        parts = str(lst).split(",") if lst != "" else []
+        return parts.index(str(s)) + 1 if str(s) in parts else 0
+    return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("substring_index")
+def op_substring_index(ctx, expr):
+    def f(s, delim, cnt):
+        s, delim, cnt = str(s), str(delim), int(cnt)
+        if not delim:
+            return ""
+        parts = s.split(delim)
+        if cnt > 0:
+            return delim.join(parts[:cnt])
+        if cnt < 0:
+            return delim.join(parts[cnt:])
+        return ""
+    return _rowwise(ctx, expr, f)
+
+
+@op("insert")
+def op_insert_str(ctx, expr):
+    def f(s, pos, ln, new):
+        s, pos, ln = str(s), int(pos), int(ln)
+        if pos < 1 or pos > len(s):
+            return s
+        return s[:pos - 1] + str(new) + s[pos - 1 + max(ln, 0):]
+    return _rowwise(ctx, expr, f)
+
+
+@op("quote")
+def op_quote(ctx, expr):
+    def f(s):
+        s = str(s).replace("\\", "\\\\").replace("'", "\\'") \
+            .replace("\0", "\\0").replace("\x1a", "\\Z")
+        return "'" + s + "'"
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("soundex")
+def op_soundex(ctx, expr):
+    _SDX = {**{c: d for cs, d in (("BFPV", "1"), ("CGJKQSXZ", "2"),
+                                  ("DT", "3"), ("L", "4"), ("MN", "5"),
+                                  ("R", "6")) for c in cs}}
+
+    def f(s):
+        s = "".join(c for c in str(s).upper() if c.isalpha())
+        if not s:
+            return ""
+        out = s[0]
+        prev = _SDX.get(s[0], "")
+        for c in s[1:]:
+            d = _SDX.get(c, "")
+            if d and d != prev:
+                out += d
+            prev = d
+        return (out + "000")[:4]
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("to_base64")
+def op_to_base64(ctx, expr):
+    import base64
+
+    def f(s):
+        return base64.b64encode(str(s).encode()).decode()
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("from_base64")
+def op_from_base64(ctx, expr):
+    import base64
+
+    def f(s):
+        try:
+            return base64.b64decode(str(s)).decode("utf-8", "replace")
+        except Exception:               # noqa: BLE001
+            return ""
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("sha2")
+def op_sha2(ctx, expr):
+    import hashlib
+    bits_c = eval_expr(ctx, expr.args[1])[0]
+    bits = int(bits_c) if np.isscalar(bits_c) else 256
+    algo = {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384",
+            512: "sha512"}.get(bits)
+
+    def f(s):
+        if algo is None:
+            return ""
+        return getattr(hashlib, algo)(str(s).encode()).hexdigest()
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("cot")
+def op_cot(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    t = ctx.xp.tan(_to_float(ctx, a, expr.args[0].ft))
+    return 1.0 / t, an, None
+
+
+@op("bit_count")
+def op_bit_count(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    xp = ctx.xp
+    v = xp.asarray(a).astype(xp.uint64)
+    # SWAR popcount (device-safe: no loops, pure vector arithmetic)
+    m1 = xp.uint64(0x5555555555555555)
+    m2 = xp.uint64(0x3333333333333333)
+    m4 = xp.uint64(0x0F0F0F0F0F0F0F0F)
+    h = xp.uint64(0x0101010101010101)
+    v = v - ((v >> xp.uint64(1)) & m1)
+    v = (v & m2) + ((v >> xp.uint64(2)) & m2)
+    v = (v + (v >> xp.uint64(4))) & m4
+    return ((v * h) >> xp.uint64(56)).astype(xp.int64), an, None
+
+
+@op("interval")
+def op_interval(ctx, expr):
+    n, nn, _ = eval_expr(ctx, expr.args[0])
+    xp = ctx.xp
+    out = xp.zeros(ctx.n, dtype=xp.int64) if not np.isscalar(n) \
+        else np.int64(0)
+    for a in expr.args[1:]:
+        v, vn, _ = eval_expr(ctx, a)
+        out = out + (xp.asarray(n) >= xp.asarray(v)).astype(xp.int64)
+    return out, nn, None
+
+
+@op("inet_aton")
+def op_inet_aton(ctx, expr):
+    def f(s):
+        parts = str(s).split(".")
+        if not 1 <= len(parts) <= 4 or \
+                not all(p.isdigit() and int(p) < 256 for p in parts):
+            return None
+        v = 0
+        for p in parts[:-1]:
+            v = (v << 8) | int(p)
+        v = (v << (8 * (4 - len(parts) + 1))) | int(parts[-1]) \
+            if len(parts) < 4 else (v << 8) | int(parts[-1])
+        return v
+    return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("inet_ntoa")
+def op_inet_ntoa(ctx, expr):
+    def f(v):
+        v = int(v)
+        if not 0 <= v <= 0xFFFFFFFF:
+            return None
+        return ".".join(str((v >> s) & 0xFF) for s in (24, 16, 8, 0))
+    return _rowwise(ctx, expr, f)
+
+
+@op("is_ipv4")
+def op_is_ipv4(ctx, expr):
+    def f(s):
+        parts = str(s).split(".")
+        return 1 if len(parts) == 4 and all(
+            p.isdigit() and p and int(p) < 256 for p in parts) else 0
+    return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("is_ipv6")
+def op_is_ipv6(ctx, expr):
+    import ipaddress
+
+    def f(s):
+        try:
+            ipaddress.IPv6Address(str(s))
+            return 1
+        except Exception:               # noqa: BLE001
+            return 0
+    return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("make_set")
+def op_make_set(ctx, expr):
+    def f(bits, *items):
+        bits = int(bits)
+        return ",".join(str(it) for i, it in enumerate(items)
+                        if it is not None and bits & (1 << i))
+    return _rowwise(ctx, expr, f)
+
+
+@op("export_set")
+def op_export_set(ctx, expr):
+    def f(bits, on, off, *rest):
+        sep = str(rest[0]) if len(rest) >= 1 else ","
+        nbits = int(rest[1]) if len(rest) >= 2 else 64
+        bits = int(bits)
+        return sep.join(str(on) if bits & (1 << i) else str(off)
+                        for i in range(min(nbits, 64)))
+    return _rowwise(ctx, expr, f)
+
+
+# ---- temporal tail ----
+
+_MONTH_NAMES = ["January", "February", "March", "April", "May", "June",
+                "July", "August", "September", "October", "November",
+                "December"]
+_DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday"]
+
+
+def _format_datetime_py(micros, fmt):
+    from ..types.time_types import days_to_ymd
+    micros = int(micros)
+    days, rem = divmod(micros, MICROS_PER_DAY)
+    y, mo, d = days_to_ymd(days)
+    sec, us = divmod(rem, 1_000_000)
+    hh, rs = divmod(sec, 3600)
+    mi, ss = divmod(rs, 60)
+    wd = (days + 3) % 7                  # 0=Monday
+    out = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c != "%" or i + 1 >= len(fmt):
+            out.append(c)
+            i += 1
+            continue
+        sp = fmt[i + 1]
+        i += 2
+        if sp == "Y":
+            out.append("%04d" % y)
+        elif sp == "y":
+            out.append("%02d" % (y % 100))
+        elif sp == "m":
+            out.append("%02d" % mo)
+        elif sp == "c":
+            out.append(str(mo))
+        elif sp == "M":
+            out.append(_MONTH_NAMES[mo - 1])
+        elif sp == "b":
+            out.append(_MONTH_NAMES[mo - 1][:3])
+        elif sp == "d":
+            out.append("%02d" % d)
+        elif sp == "e":
+            out.append(str(d))
+        elif sp == "H":
+            out.append("%02d" % hh)
+        elif sp == "k":
+            out.append(str(hh))
+        elif sp in ("h", "I"):
+            out.append("%02d" % (hh % 12 or 12))
+        elif sp == "l":
+            out.append(str(hh % 12 or 12))
+        elif sp == "i":
+            out.append("%02d" % mi)
+        elif sp in ("S", "s"):
+            out.append("%02d" % ss)
+        elif sp == "f":
+            out.append("%06d" % us)
+        elif sp == "p":
+            out.append("AM" if hh < 12 else "PM")
+        elif sp == "W":
+            out.append(_DAY_NAMES[wd])
+        elif sp == "a":
+            out.append(_DAY_NAMES[wd][:3])
+        elif sp == "w":
+            out.append(str((wd + 1) % 7))
+        elif sp == "j":
+            from ..types.time_types import ymd_to_days
+            out.append("%03d" % (days - ymd_to_days(y, 1, 1) + 1))
+        elif sp == "T":
+            out.append("%02d:%02d:%02d" % (hh, mi, ss))
+        elif sp == "D":
+            sfx = "th" if 11 <= d % 100 <= 13 else \
+                {1: "st", 2: "nd", 3: "rd"}.get(d % 10, "th")
+            out.append("%d%s" % (d, sfx))
+        else:
+            out.append(sp)
+    return "".join(out)
+
+
+def _arg_micros(ctx, expr_arg):
+    """Temporal arg -> (micros int64, nulls)."""
+    a, an, sd = eval_expr(ctx, expr_arg)
+    tc = expr_arg.ft.tclass
+    if sd is not None or isinstance(a, str) or \
+            (hasattr(a, "dtype") and a.dtype == object):
+        from ..types.time_types import parse_datetime
+        r = _apply_str_fn(ctx, (a, an, sd), parse_datetime,
+                          out_is_string=False)
+        return r[0], r[1]
+    if tc == TypeClass.DATE:
+        return a * MICROS_PER_DAY, an
+    return a, an
+
+
+@op("date_format")
+def op_date_format(ctx, expr):
+    fmt = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if fmt is None:
+        raise UnknownFunctionError("non-constant DATE_FORMAT format")
+    micros, an = _arg_micros(ctx, expr.args[0])
+    if np.isscalar(micros) or getattr(micros, "ndim", 1) == 0:
+        return _format_datetime_py(int(micros), fmt), an, None
+    arr = np.asarray(micros)
+    out = np.empty(len(arr), dtype=object)
+    for i, us in enumerate(arr):
+        out[i] = _format_datetime_py(us, fmt)
+    return out, an, None
+
+
+@op("str_to_date")
+def op_str_to_date(ctx, expr):
+    fmt = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if fmt is None:
+        raise UnknownFunctionError("non-constant STR_TO_DATE format")
+    import re as _re
+    pat, fields = "", []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            sp = fmt[i + 1]
+            i += 2
+            grp = {"Y": r"(\d{4})", "y": r"(\d{1,2})", "m": r"(\d{1,2})",
+                   "c": r"(\d{1,2})", "d": r"(\d{1,2})", "e": r"(\d{1,2})",
+                   "H": r"(\d{1,2})", "k": r"(\d{1,2})", "i": r"(\d{1,2})",
+                   "s": r"(\d{1,2})", "S": r"(\d{1,2})"}.get(sp)
+            if grp is None:
+                pat += _re.escape("%" + sp)
+            else:
+                pat += grp
+                fields.append(sp)
+        else:
+            pat += _re.escape(c)
+            i += 1
+
+    def f(s):
+        m = _re.match(pat + r"\s*$", str(s))
+        if m is None:
+            return None
+        vals = {"Y": 0, "m": 1, "d": 1, "H": 0, "i": 0, "s": 0}
+        for sp, g in zip(fields, m.groups()):
+            key = {"y": "Y", "c": "m", "e": "d", "k": "H", "S": "s"}.get(
+                sp, sp)
+            v = int(g)
+            if sp == "y":
+                v += 2000 if v < 70 else 1900
+            vals[key] = v
+        from ..types.time_types import ymd_to_days
+        try:
+            days = ymd_to_days(vals["Y"], vals["m"], vals["d"])
+        except Exception:               # noqa: BLE001
+            return None
+        return days * MICROS_PER_DAY + \
+            (vals["H"] * 3600 + vals["i"] * 60 + vals["s"]) * 1_000_000
+    out, nulls, _sd = _rowwise(
+        ctx, type("E", (), {"args": [expr.args[0]]})(), f, dtype=np.int64)
+    return out, nulls, None
+
+
+@op("dayname")
+def op_dayname(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    arr = np.atleast_1d(np.asarray(days)).astype(np.int64)
+    tab = np.array(_DAY_NAMES, dtype=object)
+    out = tab[(arr + 3) % 7]
+    return (out if np.ndim(days) else str(out[0])), an, None
+
+
+@op("monthname")
+def op_monthname(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    y, m, d = civil_from_days(
+        np, np.atleast_1d(np.asarray(days)).astype(np.int64))
+    tab = np.array(_MONTH_NAMES, dtype=object)
+    out = tab[np.asarray(m) - 1]
+    return (out if np.ndim(days) else str(out[0])), an, None
+
+
+@op("last_day")
+def op_last_day(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    xp = ctx.xp
+    y, m, d = civil_from_days(xp, days)
+    ny = xp.where(m == 12, y + 1, y)
+    nm = xp.where(m == 12, 1, m + 1)
+    return days_from_civil(xp, ny, nm, xp.asarray(1)) - 1, an, None
+
+
+@op("to_days")
+def op_to_days(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    return days + 719528, an, None
+
+
+@op("from_days")
+def op_from_days(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    return a - 719528, an, None
+
+
+@op("from_unixtime")
+def op_from_unixtime(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    micros = (ctx.xp.asarray(a).astype(ctx.xp.float64) *
+              1_000_000).astype(ctx.xp.int64) if not np.isscalar(a) \
+        else np.int64(float(a) * 1_000_000)
+    if len(expr.args) > 1:
+        fmt = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+        arr = np.atleast_1d(np.asarray(micros))
+        out = np.empty(len(arr), dtype=object)
+        for i, us in enumerate(arr):
+            out[i] = _format_datetime_py(us, fmt)
+        return (out if not np.isscalar(a) else out[0]), an, None
+    return micros, an, None
+
+
+@op("microsecond")
+def op_microsecond(ctx, expr):
+    micros, an = _arg_micros(ctx, expr.args[0])
+    return micros % 1_000_000, an, None
+
+
+@op("yearweek")
+def op_yearweek(ctx, expr):
+    days, an = _days_of(ctx, expr.args[0])
+    xp = ctx.xp
+    y, m, d = civil_from_days(xp, days)
+    jan1 = days_from_civil(xp, y, xp.asarray(1), xp.asarray(1))
+    wk = (days - jan1 + ((jan1 + 4) % 7 + 1)) // 7
+    y = xp.where(wk == 0, y - 1, y)
+    wk = xp.where(wk == 0, 52, wk)       # roll into prior year (mode 0)
+    return y * 100 + wk, an, None
+
+
+_TSD_UNITS = {"second": 1_000_000, "minute": 60_000_000,
+              "hour": 3_600_000_000, "day": MICROS_PER_DAY,
+              "week": 7 * MICROS_PER_DAY}
+
+
+@op("timestampdiff")
+def op_timestampdiff(ctx, expr):
+    unit = expr.args[0].value.val if hasattr(expr.args[0], "value") else ""
+    unit = str(unit).lower()
+    a, an = _arg_micros(ctx, expr.args[1])
+    b, bn = _arg_micros(ctx, expr.args[2])
+    xp = ctx.xp
+    nulls = or_nulls(xp, an, bn)
+    if unit in _TSD_UNITS:
+        return (xp.asarray(b) - xp.asarray(a)) // _TSD_UNITS[unit], \
+            nulls, None
+    ya, ma, da = civil_from_days(xp, xp.asarray(a) // MICROS_PER_DAY)
+    yb, mb, db_ = civil_from_days(xp, xp.asarray(b) // MICROS_PER_DAY)
+    months = (yb * 12 + mb) - (ya * 12 + ma)
+    # not a full month if b's day-of-month/time is earlier than a's
+    ta = xp.asarray(a) % MICROS_PER_DAY + da * MICROS_PER_DAY
+    tb = xp.asarray(b) % MICROS_PER_DAY + db_ * MICROS_PER_DAY
+    months = months - ((months > 0) & (tb < ta)) + ((months < 0) & (tb > ta))
+    if unit == "month":
+        return months, nulls, None
+    if unit == "quarter":
+        return months // 3, nulls, None
+    if unit == "year":
+        return months // 12, nulls, None
+    raise UnknownFunctionError("TIMESTAMPDIFF unit %s", unit)
+
+
+@op("period_add")
+def op_period_add(ctx, expr):
+    p, pn, _ = eval_expr(ctx, expr.args[0])
+    n, nn, _ = eval_expr(ctx, expr.args[1])
+    xp = ctx.xp
+    months = (p // 100) * 12 + (p % 100) - 1 + n
+    return (months // 12) * 100 + months % 12 + 1, \
+        or_nulls(xp, pn, nn), None
+
+
+@op("period_diff")
+def op_period_diff(ctx, expr):
+    a, an, _ = eval_expr(ctx, expr.args[0])
+    b, bn, _ = eval_expr(ctx, expr.args[1])
+    ma = (a // 100) * 12 + a % 100
+    mb = (b // 100) * 12 + b % 100
+    return ma - mb, or_nulls(ctx.xp, an, bn), None
+
+
+@op("time_to_sec")
+def op_time_to_sec(ctx, expr):
+    def f(s):
+        s = str(s)
+        neg = s.startswith("-")
+        parts = s.lstrip("-").split(":")
+        try:
+            parts = [float(p) for p in parts]
+        except ValueError:
+            return 0
+        while len(parts) < 3:
+            parts.insert(0, 0.0)
+        sec = int(parts[0] * 3600 + parts[1] * 60 + parts[2])
+        return -sec if neg else sec
+    return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("sec_to_time")
+def op_sec_to_time(ctx, expr):
+    def f(v):
+        v = int(v)
+        sign = "-" if v < 0 else ""
+        v = abs(v)
+        return "%s%02d:%02d:%02d" % (sign, v // 3600, v // 60 % 60, v % 60)
+    return _rowwise(ctx, expr, f)
+
+
+@op("maketime")
+def op_maketime(ctx, expr):
+    def f(h, m, s):
+        return "%02d:%02d:%02d" % (int(h), int(m), int(float(s)))
+    return _rowwise(ctx, expr, f)
+
+
+@op("makedate")
+def op_makedate(ctx, expr):
+    y, yn, _ = eval_expr(ctx, expr.args[0])
+    n, nn, _ = eval_expr(ctx, expr.args[1])
+    xp = ctx.xp
+    base = days_from_civil(xp, xp.asarray(y), xp.asarray(1), xp.asarray(1))
+    out = base + xp.asarray(n) - 1
+    return out, or_nulls(xp, yn, nn, xp.asarray(n) < 1), None
+
+
+# ---- JSON tail ----
+
+def _json_load(s):
+    import json as _json
+    try:
+        return _json.loads(s)
+    except Exception:               # noqa: BLE001
+        return None
+
+
+@op("json_type")
+def op_json_type(ctx, expr):
+    def f(s):
+        v = _json_load(s)
+        if isinstance(v, bool):
+            return "BOOLEAN"
+        if v is None and str(s).strip() == "null":
+            return "NULL"
+        if isinstance(v, dict):
+            return "OBJECT"
+        if isinstance(v, list):
+            return "ARRAY"
+        if isinstance(v, int):
+            return "INTEGER"
+        if isinstance(v, float):
+            return "DOUBLE"
+        if isinstance(v, str):
+            return "STRING"
+        return "UNKNOWN"
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("json_keys")
+def op_json_keys(ctx, expr):
+    import json as _json
+
+    def f(s):
+        v = _json_load(s)
+        return _json.dumps(list(v.keys())) if isinstance(v, dict) else ""
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("json_depth")
+def op_json_depth(ctx, expr):
+    def depth(v):
+        if isinstance(v, dict):
+            return 1 + max((depth(x) for x in v.values()), default=0)
+        if isinstance(v, list):
+            return 1 + max((depth(x) for x in v), default=0)
+        return 1
+
+    def f(s):
+        v = _json_load(s)
+        return depth(v) if v is not None or str(s).strip() == "null" else 0
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)
+
+
+@op("json_contains")
+def op_json_contains(ctx, expr):
+    cand_txt = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if cand_txt is None:
+        raise UnknownFunctionError("non-constant JSON_CONTAINS candidate")
+    cand = _json_load(cand_txt)
+
+    def contains(doc, c):
+        if isinstance(doc, list):
+            if isinstance(c, list):
+                return all(contains(doc, x) for x in c)
+            return any(contains(x, c) if isinstance(x, (dict, list))
+                       else x == c for x in doc)
+        if isinstance(doc, dict) and isinstance(c, dict):
+            return all(k in doc and (contains(doc[k], v)
+                                     if isinstance(v, (dict, list))
+                                     else doc[k] == v)
+                       for k, v in c.items())
+        return doc == c
+
+    def f(s):
+        v = _json_load(s)
+        return 1 if contains(v, cand) else 0
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)
+
+
+@op("json_quote")
+def op_json_quote(ctx, expr):
+    import json as _json
+
+    def f(s):
+        return _json.dumps(str(s))
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("json_array")
+def op_json_array(ctx, expr):
+    import json as _json
+
+    def f(*items):
+        return _json.dumps([_maybe_num(x) for x in items])
+    return _rowwise(ctx, expr, f)
+
+
+@op("json_object")
+def op_json_object(ctx, expr):
+    import json as _json
+
+    def f(*items):
+        return _json.dumps({str(items[i]): _maybe_num(items[i + 1])
+                            for i in range(0, len(items) - 1, 2)})
+    return _rowwise(ctx, expr, f)
+
+
+def _maybe_num(x):
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    return x
+
+
+def _json_set_path(doc, path, val, mode):
+    """mode: set|insert|replace. Supports $.a.b and $[i] paths."""
+    import re as _re
+    parts = _re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]", path[1:])
+    cur = doc
+    for j, (name, idx) in enumerate(parts):
+        last = j == len(parts) - 1
+        key = name if name else int(idx)
+        if last:
+            if isinstance(cur, dict) and name:
+                exists = key in cur
+                if (mode == "insert" and exists) or \
+                        (mode == "replace" and not exists):
+                    return
+                cur[key] = val
+            elif isinstance(cur, list) and not name:
+                if key < len(cur):
+                    if mode != "insert":
+                        cur[key] = val
+                elif mode != "replace":
+                    cur.append(val)
+            return
+        nxt = None
+        if isinstance(cur, dict) and name:
+            nxt = cur.get(key)
+            if nxt is None and mode != "replace":
+                nxt = cur[key] = {}
+        elif isinstance(cur, list) and not name and int(idx) < len(cur):
+            nxt = cur[int(idx)]
+        if not isinstance(nxt, (dict, list)):
+            return
+        cur = nxt
+
+
+def _op_json_modify(ctx, expr, mode):
+    import json as _json
+    args = expr.args
+
+    def f(s, *pv):
+        doc = _json_load(s)
+        if doc is None and str(s).strip() != "null":
+            return None
+        for i in range(0, len(pv) - 1, 2):
+            path, val = str(pv[i]), _maybe_num(pv[i + 1])
+            if isinstance(val, str):
+                v2 = _json_load(val)
+                val = v2 if v2 is not None and val.strip().startswith(
+                    ("[", "{", '"')) else val
+            if not path.startswith("$"):
+                return None
+            if path == "$":
+                if mode != "insert":
+                    doc = val
+                continue
+            _json_set_path(doc, path, val, mode)
+        return _json.dumps(doc)
+    return _rowwise(ctx, expr, f)
+
+
+@op("json_set")
+def op_json_set(ctx, expr):
+    return _op_json_modify(ctx, expr, "set")
+
+
+@op("json_insert")
+def op_json_insert(ctx, expr):
+    return _op_json_modify(ctx, expr, "insert")
+
+
+@op("json_replace")
+def op_json_replace(ctx, expr):
+    return _op_json_modify(ctx, expr, "replace")
+
+
+@op("json_remove")
+def op_json_remove(ctx, expr):
+    import json as _json
+    import re as _re
+
+    def f(s, *paths):
+        doc = _json_load(s)
+        if doc is None:
+            return None
+        for p in paths:
+            p = str(p)
+            parts = _re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]",
+                                p[1:])
+            cur = doc
+            okpath = True
+            for name, idx in parts[:-1]:
+                key = name if name else int(idx)
+                try:
+                    cur = cur[key]
+                except Exception:       # noqa: BLE001
+                    okpath = False
+                    break
+            if okpath and parts:
+                name, idx = parts[-1]
+                try:
+                    del cur[name if name else int(idx)]
+                except Exception:       # noqa: BLE001
+                    pass
+        return _json.dumps(doc)
+    return _rowwise(ctx, expr, f)
+
+
+@op("json_merge_patch")
+def op_json_merge_patch(ctx, expr):
+    import json as _json
+
+    def merge(a, b):
+        if not isinstance(b, dict):
+            return b
+        if not isinstance(a, dict):
+            a = {}
+        out = dict(a)
+        for k, v in b.items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = merge(out.get(k), v)
+        return out
+
+    def f(*docs):
+        cur = _json_load(docs[0])
+        for d in docs[1:]:
+            cur = merge(cur, _json_load(d))
+        return _json.dumps(cur)
+    return _rowwise(ctx, expr, f)
+
+
+@op("json_contains_path")
+def op_json_contains_path(ctx, expr):
+    import re as _re
+
+    def f(s, mode, *paths):
+        doc = _json_load(s)
+        hits = 0
+        for p in paths:
+            v = _json_path_get(str(s), str(p))
+            if v is not None:
+                hits += 1
+        if str(mode).lower() == "all":
+            return 1 if hits == len(paths) else 0
+        return 1 if hits > 0 else 0
+    return _rowwise(ctx, expr, f, dtype=np.int64)

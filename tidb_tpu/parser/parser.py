@@ -1678,6 +1678,8 @@ class Parser:
             if low == "cast" and nxt.kind == "OP" and nxt.text == "(":
                 return self.parse_cast()
             if low == "interval" and t.kind == "IDENT":
+                if nxt.kind == "OP" and nxt.text == "(":
+                    return self.parse_func_call()   # INTERVAL(n, a, b, ...)
                 self.next()
                 val = self.parse_bitor()
                 unit = self.ident().lower()

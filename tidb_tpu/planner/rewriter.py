@@ -44,7 +44,20 @@ _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
                 "cast_double", "rand", "pi", "degrees", "radians", "sin",
                 "cos", "tan", "asin", "acos", "atan", "atan2",
                 "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
-                "vec_negative_inner_product", "vec_l2_norm"}
+                "vec_negative_inner_product", "vec_l2_norm", "cot"}
+_STRING_FUNCS |= {"substring_index", "insert", "quote", "soundex",
+                  "to_base64", "from_base64", "sha2", "make_set",
+                  "export_set", "inet_ntoa", "dayname", "monthname",
+                  "date_format", "sec_to_time", "maketime",
+                  "json_type", "json_keys", "json_quote", "json_array",
+                  "json_object", "json_set", "json_insert", "json_replace",
+                  "json_remove", "json_merge_patch"}
+_INT_FUNCS |= {"find_in_set", "bit_count", "interval", "inet_aton",
+               "is_ipv4", "is_ipv6", "to_days", "yearweek", "microsecond",
+               "timestampdiff", "period_add", "period_diff", "time_to_sec",
+               "json_depth", "json_contains", "json_contains_path"}
+_DATE_RET_FUNCS = {"from_days", "last_day", "makedate"}
+_DATETIME_RET_FUNCS = {"str_to_date", "from_unixtime"}
 
 
 def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,
@@ -91,7 +104,12 @@ class Rewriter:
 
     def mk_func(self, op: str, args: list, ft: FieldType | None = None) -> Expression:
         if ft is None:
-            if op in _STRING_FUNCS:
+            if op in _DATE_RET_FUNCS:
+                ft = new_date_type()
+            elif op in _DATETIME_RET_FUNCS:
+                ft = new_string_type() if op == "from_unixtime" \
+                    and len(args) > 1 else new_datetime_type()
+            elif op in _STRING_FUNCS:
                 ft = new_string_type()
             elif op in _INT_FUNCS:
                 ft = new_bigint_type()
@@ -354,6 +372,13 @@ class Rewriter:
 
     def _rw_FuncCall(self, node: ast.FuncCall):
         name = node.name
+        if name in ("timestampdiff", "timestampadd") and node.args and \
+                isinstance(node.args[0], ast.ColumnRef) and \
+                not node.args[0].table:
+            # unit keyword parses as a bare identifier
+            node = ast.FuncCall(name=name, args=[
+                ast.Literal(value=node.args[0].name.lower())]
+                + list(node.args[1:]))
         # statement-time constants
         if name in ("now", "current_timestamp", "sysdate"):
             self.pctx.cacheable = False
