@@ -389,3 +389,20 @@ class TestBuiltinLongTail:
         tk.must_query("select dayname(d), find_in_set('b', s), "
                       "bit_count(n) from bt order by d").check([
                           ("Tuesday", 2, 3), ("Wednesday", 0, 8)])
+
+    def test_time_funcs(self, tk):
+        q = tk.must_query
+        q("select timestampadd(day, 3, '2024-01-30'), "
+          "timestampadd(month, 1, '2024-01-31')").check(
+            [("2024-02-02 00:00:00", "2024-02-29 00:00:00")])
+        q("select addtime('10:00:00','01:30:00'), "
+          "subtime('10:00:00','01:30:00')").check(
+            [("11:30:00", "08:30:00")])
+        q("select addtime('2024-01-01 10:00:00','14:30:00')").check(
+            [("2024-01-02 00:30:00",)])
+        q("select timediff('10:00:00','08:30:00'), "
+          "timediff('2024-01-02 00:00:00','2024-01-01 22:00:00')").check(
+            [("01:30:00", "02:00:00")])
+        q("select time('2024-01-01 10:11:12'), "
+          "time_format('10:05:00','%H %i')").check(
+            [("10:11:12", "10 05")])

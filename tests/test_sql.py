@@ -234,6 +234,26 @@ class TestSortLimit:
             (1,), (3,), (2,)])
 
 
+class TestIndexAdvisor:
+    def test_recommend_index(self, ftk):
+        ftk.must_exec("create table adv (id int primary key, a int, b int)")
+        ftk.must_exec("insert into adv values " + ",".join(
+            f"({i},{i % 100},{i % 7})" for i in range(200)))
+        for _ in range(3):
+            ftk.must_query("select * from adv where a = 42")
+        rows = ftk.must_query("recommend index run").rows
+        assert any(r[1] == "adv" and r[3] == "a" for r in rows), rows
+        # targeted form
+        rows = ftk.must_query(
+            "recommend index run for 'select * from adv where b = 1'").rows
+        assert any(r[3] == "b" for r in rows), rows
+        # existing indexes suppress the suggestion
+        ftk.must_exec("create index idx_a on adv (a)")
+        rows = ftk.must_query(
+            "recommend index run for 'select * from adv where a = 1'").rows
+        assert not any(r[3] == "a" for r in rows), rows
+
+
 class TestVectorType:
     def test_vector_column_and_functions(self, ftk):
         ftk.must_exec("create table emb (id int primary key, v vector(3))")

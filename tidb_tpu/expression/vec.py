@@ -234,7 +234,8 @@ _HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
               "from_unixtime", "time_to_sec", "sec_to_time", "maketime",
               "json_array", "json_object", "json_set", "json_insert",
               "json_replace", "json_remove", "json_merge_patch",
-              "json_contains_path"}
+              "json_contains_path", "addtime", "subtime", "timediff",
+              "time", "time_format"}
 
 
 # ---------------- string helpers ----------------
@@ -2924,3 +2925,138 @@ def op_json_contains_path(ctx, expr):
             return 1 if hits == len(paths) else 0
         return 1 if hits > 0 else 0
     return _rowwise(ctx, expr, f, dtype=np.int64)
+
+
+@op("timestampadd")
+def op_timestampadd(ctx, expr):
+    unit = expr.args[0].value.val if hasattr(expr.args[0], "value") else ""
+    unit = str(unit).lower()
+    n, nn, _ = eval_expr(ctx, expr.args[1])
+    micros, an = _arg_micros(ctx, expr.args[2])
+    xp = ctx.xp
+    nulls = or_nulls(xp, an, nn)
+    if unit in _TSD_UNITS:
+        return xp.asarray(micros) + xp.asarray(n) * _TSD_UNITS[unit], \
+            nulls, None
+    mult = {"month": 1, "quarter": 3, "year": 12}.get(unit)
+    if mult is None:
+        raise UnknownFunctionError("TIMESTAMPADD unit %s", unit)
+    days = xp.asarray(micros) // MICROS_PER_DAY
+    tod = xp.asarray(micros) % MICROS_PER_DAY
+    y, m, d = civil_from_days(xp, days)
+    tot = y * 12 + (m - 1) + xp.asarray(n) * mult
+    ny, nm = tot // 12, tot % 12 + 1
+    # clamp day to the target month's length
+    my, mm = xp.where(nm == 12, ny + 1, ny), xp.where(nm == 12, 1, nm + 1)
+    mlen = days_from_civil(xp, my, mm, xp.asarray(1)) - \
+        days_from_civil(xp, ny, nm, xp.asarray(1))
+    nd = xp.minimum(d, mlen)
+    return days_from_civil(xp, ny, nm, nd) * MICROS_PER_DAY + tod, \
+        nulls, None
+
+
+def _dur_micros(s):
+    s = str(s)
+    neg = s.startswith("-")
+    body = s.lstrip("-")
+    frac = 0
+    if "." in body:
+        body, fr = body.split(".", 1)
+        frac = int((fr + "000000")[:6])
+    parts = body.split(":")
+    try:
+        parts = [int(p) for p in parts]
+    except ValueError:
+        return None
+    while len(parts) < 3:
+        parts.insert(0, 0)
+    us = (parts[0] * 3600 + parts[1] * 60 + parts[2]) * 1_000_000 + frac
+    return -us if neg else us
+
+
+def _us_to_dur(us):
+    sign = "-" if us < 0 else ""
+    us = abs(int(us))
+    sec, frac = divmod(us, 1_000_000)
+    base = "%s%02d:%02d:%02d" % (sign, sec // 3600, sec // 60 % 60,
+                                 sec % 60)
+    return base + (".%06d" % frac).rstrip("0").rstrip(".") if frac else base
+
+
+@op("addtime")
+def op_addtime(ctx, expr):
+    def f(a, b):
+        if ":" in str(a) or "-" in str(a)[1:]:
+            # datetime or time base
+            pass
+        da = _dur_micros(a) if "-" not in str(a)[1:] else None
+        db_ = _dur_micros(b)
+        if db_ is None:
+            return None
+        if da is not None and ":" in str(a) and " " not in str(a):
+            return _us_to_dur(da + db_)
+        from ..types.time_types import parse_datetime, micros_to_str
+        try:
+            return micros_to_str(parse_datetime(str(a)) + db_, 0)
+        except Exception:               # noqa: BLE001
+            return None
+    return _rowwise(ctx, expr, f)
+
+
+@op("subtime")
+def op_subtime(ctx, expr):
+    def f(a, b):
+        db_ = _dur_micros(b)
+        if db_ is None:
+            return None
+        if ":" in str(a) and " " not in str(a) and "-" not in str(a)[1:]:
+            da = _dur_micros(a)
+            return _us_to_dur(da - db_) if da is not None else None
+        from ..types.time_types import parse_datetime, micros_to_str
+        try:
+            return micros_to_str(parse_datetime(str(a)) - db_, 0)
+        except Exception:               # noqa: BLE001
+            return None
+    return _rowwise(ctx, expr, f)
+
+
+@op("timediff")
+def op_timediff(ctx, expr):
+    def f(a, b):
+        sa, sb = str(a), str(b)
+        if " " in sa or " " in sb:
+            from ..types.time_types import parse_datetime
+            try:
+                return _us_to_dur(parse_datetime(sa) - parse_datetime(sb))
+            except Exception:           # noqa: BLE001
+                return None
+        da, db_ = _dur_micros(sa), _dur_micros(sb)
+        if da is None or db_ is None:
+            return None
+        return _us_to_dur(da - db_)
+    return _rowwise(ctx, expr, f)
+
+
+@op("time")
+def op_time_fn(ctx, expr):
+    def f(a):
+        s = str(a)
+        if " " in s:
+            s = s.split(" ", 1)[1]
+        us = _dur_micros(s)
+        return _us_to_dur(us) if us is not None else None
+    return _rowwise(ctx, expr, f)
+
+
+@op("time_format")
+def op_time_format(ctx, expr):
+    fmt = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+    if fmt is None:
+        raise UnknownFunctionError("non-constant TIME_FORMAT format")
+
+    def f(a):
+        us = _dur_micros(str(a))
+        if us is None:
+            return None
+        return _format_datetime_py(abs(us), fmt)
+    return _rowwise(ctx, type("E", (), {"args": [expr.args[0]]})(), f)

@@ -290,6 +290,11 @@ class SetResourceGroupStmt(StmtNode):
 
 
 @dataclass
+class RecommendIndexStmt(StmtNode):
+    sql: str = ""          # empty = whole summarized workload
+
+
+@dataclass
 class SetDefaultRoleStmt(StmtNode):
     mode: str = "list"          # all | none | list
     roles: list = field(default_factory=list)

@@ -148,6 +148,14 @@ class Parser:
             return self.parse_show()
         if kw in ("explain", "desc", "describe"):
             return self.parse_explain()
+        if kw == "recommend":
+            self.next()
+            self.expect_kw("index")
+            self.expect_kw("run")
+            sql = ""
+            if self.accept_kw("for"):
+                sql = self.next().text
+            return ast.RecommendIndexStmt(sql=sql)
         if kw == "admin":
             self.next()
             if self.accept_kw("check"):

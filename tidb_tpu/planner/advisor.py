@@ -1,0 +1,123 @@
+"""Index advisor (reference pkg/planner/indexadvisor — RECOMMEND INDEX;
+re-designed: instead of hypothetical-index what-if probing, walk the
+optimized plans of the target workload, collect filter/join columns per
+table, score by frequency x distinct-count, and suggest indexes the
+schema doesn't already cover)."""
+from __future__ import annotations
+
+from ..expression import Column, Constant, ScalarFunc
+
+
+def _walk_exprs(e, out):
+    if isinstance(e, ScalarFunc):
+        if e.op in ("=", "<", "<=", ">", ">=", "in") and len(e.args) >= 2 \
+                and isinstance(e.args[0], Column) and \
+                all(isinstance(a, Constant) for a in e.args[1:]):
+            out.append((e.args[0].idx, e.op))
+            return
+        for a in e.args:
+            _walk_exprs(a, out)
+
+
+def _collect_plan(plan, acc):
+    """acc: list of (table_info, db, {col_name: op})."""
+    from .physical import PhysTableReader, PhysHashJoin, PhysIndexRange
+    if isinstance(plan, PhysTableReader):
+        dag = plan.dag
+        name_of = {sc.col.idx: sc.name for sc in dag.cols}
+        cols = {}
+        pairs = []
+        for f in list(dag.filters) + list(dag.host_filters):
+            _walk_exprs(f, pairs)
+        for idx, op_ in pairs:
+            n = name_of.get(idx)
+            if n and not n.startswith("_"):
+                cols[n] = op_
+        if cols:
+            acc.append((dag.table_info, dag.db_name, cols))
+    if isinstance(plan, PhysHashJoin):
+        for side in (0, 1):
+            child = plan.children[side]
+            if isinstance(child, PhysTableReader):
+                name_of = {sc.col.idx: sc.name
+                           for sc in child.dag.cols}
+                for a, b in plan.eq_conds:
+                    e = a if side == 0 else b
+                    if isinstance(e, Column):
+                        n = name_of.get(e.idx)
+                        if n and not n.startswith("_"):
+                            acc.append((child.dag.table_info,
+                                        child.dag.db_name, {n: "join"}))
+    for c in plan.children:
+        _collect_plan(c, acc)
+
+
+def recommend_indexes(sess, sql: str | None = None, top: int = 10):
+    """-> [(db, table, suggested index cols, reason, score)]."""
+    from ..parser import parse, ast
+    from . import optimize
+
+    texts = []
+    if sql:
+        texts.append((sql, 1))
+    else:
+        for s in sess.domain.stmt_summary_map.values():
+            t = s.get("normalized", "")
+            if t.startswith("select") and "?" in t:
+                texts.append((t.replace("?", "1"), s["exec_count"]))
+            elif t.startswith("select"):
+                texts.append((t, s["exec_count"]))
+
+    suggestions: dict = {}   # (db, tbl, cols tuple) -> [score, reasons]
+    for text, weight in texts:
+        try:
+            stmts = parse(text)
+        except Exception:               # noqa: BLE001
+            continue
+        for stmt in stmts:
+            if not isinstance(stmt, ast.SelectStmt):
+                continue
+            try:
+                plan = optimize(stmt, sess._plan_ctx())
+            except Exception:           # noqa: BLE001
+                continue
+            acc = []
+            _collect_plan(plan, acc)
+            for tbl, db, cols in acc:
+                if tbl.id < 0:
+                    continue
+                # equality columns first (composite prefix), then ranges
+                eqs = sorted(n for n, o in cols.items()
+                             if o in ("=", "in", "join"))
+                rngs = sorted(n for n, o in cols.items()
+                              if o in ("<", "<=", ">", ">="))
+                cand = tuple((eqs + rngs)[:3])
+                if not cand:
+                    continue
+                if _covered(tbl, cand):
+                    continue
+                key = (db, tbl.name, cand)
+                ent = suggestions.setdefault(key, [0.0, set()])
+                ent[0] += weight
+                ent[1].add("filters: " + ", ".join(
+                    f"{n} {o}" for n, o in sorted(cols.items())))
+    out = []
+    for (db, tname, cand), (score, reasons) in suggestions.items():
+        iname = "idx_" + "_".join(cand)
+        out.append((db, tname, iname, ",".join(cand),
+                    "; ".join(sorted(reasons)[:2]), score))
+    out.sort(key=lambda r: -r[5])
+    return out[:top]
+
+
+def _covered(tbl, cand):
+    """Already served by the pk or an existing index's leading prefix?"""
+    if tbl.pk_is_handle and cand[0].lower() == \
+            (tbl.pk_col_name or "").lower():
+        return True
+    for idx in tbl.indexes:
+        lead = [c.lower() for c in idx.columns[:len(cand)]]
+        if lead == [c.lower() for c in cand] or \
+                (idx.columns and idx.columns[0].lower() == cand[0].lower()):
+            return True
+    return False

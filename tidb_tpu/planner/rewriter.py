@@ -56,7 +56,10 @@ _INT_FUNCS |= {"find_in_set", "bit_count", "interval", "inet_aton",
                "is_ipv4", "is_ipv6", "to_days", "yearweek", "microsecond",
                "timestampdiff", "period_add", "period_diff", "time_to_sec",
                "json_depth", "json_contains", "json_contains_path"}
+_STRING_FUNCS |= {"addtime", "subtime", "timediff", "time",
+                  "time_format"}
 _DATE_RET_FUNCS = {"from_days", "last_day", "makedate"}
+_DATETIME_RET_FUNCS_EXTRA = {"timestampadd"}
 _DATETIME_RET_FUNCS = {"str_to_date", "from_unixtime"}
 
 
@@ -106,6 +109,8 @@ class Rewriter:
         if ft is None:
             if op in _DATE_RET_FUNCS:
                 ft = new_date_type()
+            elif op in _DATETIME_RET_FUNCS_EXTRA:
+                ft = new_datetime_type()
             elif op in _DATETIME_RET_FUNCS:
                 ft = new_string_type() if op == "from_unixtime" \
                     and len(args) > 1 else new_datetime_type()

@@ -297,6 +297,13 @@ class Session:
             return ResultSet()
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
+        if isinstance(stmt, ast.RecommendIndexStmt):
+            from ..planner.advisor import recommend_indexes
+            rows = recommend_indexes(self, stmt.sql or None)
+            from .show import _str_chunk
+            return _str_chunk(
+                ["Database", "Table", "Index_name", "Index_columns",
+                 "Reason", "Score"], rows)
         if isinstance(stmt, ast.ResourceGroupStmt):
             mgr = self.domain.resource_groups
             if stmt.action == "create":
