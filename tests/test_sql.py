@@ -1700,3 +1700,29 @@ class TestMiscStatements:
         r = ftk.must_query("select id, command from "
                            "information_schema.processlist")
         assert any(int(row[0]) == ftk.sess.conn_id for row in r.rows)
+
+
+class TestCompatStatements:
+    def test_show_variants(self, ftk):
+        assert ftk.must_query("show engines").rows
+        assert ftk.must_query("show charset").rows
+        assert ftk.must_query("show collation").rows
+        ftk.must_query("show errors").check([])
+        ftk.must_query("show profiles").check([])
+        assert any(r[0] == "Uptime"
+                   for r in ftk.must_query("show status").rows)
+        ftk.must_query("show create database test").check_contain(
+            "CREATE DATABASE")
+        ftk.must_query("select @@version_comment").check_contain("tidb-tpu")
+
+    def test_table_values_checksum(self, ftk):
+        ftk.must_exec("create table cvt (id int primary key, v int)")
+        ftk.must_exec("insert into cvt values (1,10),(2,20)")
+        ftk.must_query("table cvt").check([(1, 10), (2, 20)])
+        ftk.must_query("values row(7, 8)").check([(7, 8)])
+        ftk.must_query("select * from (values row(1,2), row(3,4)) v "
+                       "order by column_0 desc").check([(3, 4), (1, 2)])
+        r = ftk.must_query("checksum table cvt").rows
+        assert r[0][0] == "test.cvt" and int(r[0][1]) != 0
+        assert ftk.must_query("show table cvt regions").rows
+        ftk.must_query("help 'select'").check([])

@@ -290,6 +290,16 @@ class SetResourceGroupStmt(StmtNode):
 
 
 @dataclass
+class ChecksumTableStmt(StmtNode):
+    tables: list = field(default_factory=list)
+
+
+@dataclass
+class HelpStmt(StmtNode):
+    pass
+
+
+@dataclass
 class RecommendIndexStmt(StmtNode):
     sql: str = ""          # empty = whole summarized workload
 

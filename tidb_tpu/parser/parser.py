@@ -148,6 +148,29 @@ class Parser:
             return self.parse_show()
         if kw in ("explain", "desc", "describe"):
             return self.parse_explain()
+        if kw == "table":
+            # TABLE t [ORDER BY col] [LIMIT n] (MySQL 8.0.19 sugar)
+            self.next()
+            tn = self.parse_table_name()
+            stmt = ast.SelectStmt(fields=[ast.Wildcard()], from_clause=tn)
+            stmt.order_by = self.parse_order_by()
+            stmt.limit = self.parse_limit()
+            return stmt
+        if kw == "values" and self.peek(1).kind == "IDENT" and \
+                self.peek(1).text.lower() == "row":
+            return self.parse_values_constructor()
+        if kw == "checksum":
+            self.next()
+            self.expect_kw("table")
+            stmt = ast.ChecksumTableStmt()
+            stmt.tables.append(self.parse_table_name())
+            while self.accept_op(","):
+                stmt.tables.append(self.parse_table_name())
+            return stmt
+        if kw == "help":
+            self.next()
+            self.next()
+            return ast.HelpStmt()
         if kw == "recommend":
             self.next()
             self.expect_kw("index")
@@ -473,6 +496,14 @@ class Parser:
 
     def parse_table_factor(self):
         if self.accept_op("("):
+            if self.at_kw("values"):
+                sel = self.parse_values_constructor()
+                self.expect_op(")")
+                alias = ""
+                self.accept_kw("as")
+                if self.peek().kind in ("IDENT", "QIDENT"):
+                    alias = self.ident()
+                return ast.SubqueryTable(select=sel, alias=alias)
             if self.at_kw("select") or self.at_op("("):
                 sel = self.parse_select()
                 self.expect_op(")")
@@ -484,6 +515,14 @@ class Parser:
             refs = self.parse_table_refs()
             self.expect_op(")")
             return refs
+        if self.at_kw("values"):
+            sel = self.parse_values_constructor()
+            alias = ""
+            if self.accept_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind in ("IDENT", "QIDENT"):
+                alias = self.ident()
+            return ast.SubqueryTable(select=sel, alias=alias)
         if self.at_kw("select"):
             # bare subquery (nonstandard but common in tests)
             sel = self.parse_select()
@@ -1338,6 +1377,33 @@ class Parser:
                 break
         return stmt
 
+    def parse_values_constructor(self):
+        """VALUES ROW(a, b), ROW(c, d) -> UNION ALL of projections
+        (MySQL 8.0.19 table value constructor)."""
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_kw("row")
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.accept_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+
+        def mk_select(row):
+            return ast.SelectStmt(fields=[
+                ast.SelectField(expr=e, alias=f"column_{i}")
+                for i, e in enumerate(row)])
+        stmt = mk_select(rows[0])
+        for row in rows[1:]:
+            stmt.setops.append(("union all", mk_select(row)))
+        stmt.order_by = self.parse_order_by()
+        stmt.limit = self.parse_limit()
+        return stmt
+
     def parse_show(self):
         self.expect_kw("show")
         stmt = ast.ShowStmt()
@@ -1350,6 +1416,12 @@ class Parser:
             stmt.kind = "plugins"
         elif self.accept_kw("bindings"):
             stmt.kind = "bindings"
+        elif self.at_kw("table") and not (
+                self.peek(1).kind == "IDENT" and
+                self.peek(1).text.lower() == "status") and self.next():
+            stmt.table = self.parse_table_name()
+            self.expect_kw("regions")
+            stmt.kind = "table_regions"
         elif self.accept_kw("table") and self.accept_kw("status"):
             stmt.kind = "table_status"
             if self.accept_kw("from") or self.accept_kw("in"):
@@ -1367,9 +1439,16 @@ class Parser:
             if self.accept_kw("from") or self.accept_kw("in"):
                 stmt.db = self.ident()
         elif self.accept_kw("create"):
-            self.expect_kw("table")
-            stmt.kind = "create_table"
-            stmt.table = self.parse_table_name()
+            if self.accept_kw("database") or self.accept_kw("schema"):
+                stmt.kind = "create_database"
+                stmt.db = self.ident()
+            elif self.accept_kw("view"):
+                stmt.kind = "create_table"
+                stmt.table = self.parse_table_name()
+            else:
+                self.expect_kw("table")
+                stmt.kind = "create_table"
+                stmt.table = self.parse_table_name()
         elif self.accept_kw("variables"):
             stmt.kind = "variables"
         elif self.accept_kw("index") or self.accept_kw("indexes") or self.accept_kw("keys"):
@@ -1383,8 +1462,23 @@ class Parser:
                 stmt.like = f"{spec.user}@{spec.host}"
         elif self.accept_kw("warnings"):
             stmt.kind = "warnings"
+        elif self.accept_kw("errors"):
+            stmt.kind = "errors"
         elif self.accept_kw("processlist"):
             stmt.kind = "processlist"
+        elif self.accept_kw("status"):
+            stmt.kind = "status"
+        elif self.accept_kw("engines"):
+            stmt.kind = "engines"
+        elif self.accept_kw("charset"):
+            stmt.kind = "charset"
+        elif self.accept_kw("character"):
+            self.expect_kw("set")
+            stmt.kind = "charset"
+        elif self.accept_kw("collation"):
+            stmt.kind = "collation"
+        elif self.accept_kw("profiles"):
+            stmt.kind = "profiles"
         else:
             self.error("unsupported SHOW")
         if self.accept_kw("like"):

@@ -37,6 +37,8 @@ class _Allocator:
 
 class Domain:
     def __init__(self, data_dir: str | None = None):
+        import time as _time
+        self._start_time = _time.time()
         self.data_dir = data_dir
         self.storage = Storage()
         self.is_cache = InfoSchemaCache(self.storage)

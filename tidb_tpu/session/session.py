@@ -297,6 +297,23 @@ class Session:
             return ResultSet()
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
+        if isinstance(stmt, ast.ChecksumTableStmt):
+            import zlib
+            from .show import _str_chunk
+            rows = []
+            for tn in stmt.tables:
+                db = tn.db or self.vars.current_db
+                tbl = self.domain.infoschema().table_by_name(db, tn.name)
+                rs = self._exec_select(self._parse_one_cached(
+                    f"select * from `{db}`.`{tn.name}`"), None)
+                crc = 0
+                for row in rs.rows:
+                    crc = zlib.crc32(repr(row).encode(), crc)
+                rows.append((f"{db}.{tn.name}", crc))
+            return _str_chunk(["Table", "Checksum"], rows)
+        if isinstance(stmt, ast.HelpStmt):
+            from .show import _str_chunk
+            return _str_chunk(["name", "description", "example"], [])
         if isinstance(stmt, ast.RecommendIndexStmt):
             from ..planner.advisor import recommend_indexes
             rows = recommend_indexes(self, stmt.sql or None)
@@ -511,6 +528,14 @@ class Session:
             return ResultSet()
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
+
+    def _parse_one_cached(self, sql):
+        from ..parser import parse
+        stmts = self.domain.ast_cache.get(sql)
+        if stmts is None:
+            stmts = parse(sql)
+            self.domain.ast_cache[sql] = stmts
+        return stmts[0]
 
     def _plan_cache_key(self, sql_key):
         return (sql_key, self.vars.current_db,

@@ -1,6 +1,8 @@
 """SHOW / DESCRIBE statements (reference pkg/executor/show.go)."""
 from __future__ import annotations
 
+import time
+
 import fnmatch
 
 import numpy as np
@@ -104,6 +106,44 @@ def exec_show(sess, stmt):
         ddl = (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +
                "\n) ENGINE=InnoDB DEFAULT CHARSET=utf8mb4")
         return _str_chunk(["Table", "Create Table"], [(tbl.name, ddl)])
+    if kind == "status":
+        rows = [(k, str(v)) for k, v in sorted(sess.domain.metrics.items())]
+        rows.append(("Uptime", str(int(time.time() -
+                                       getattr(sess.domain, "_start_time",
+                                               time.time())))))
+        return _str_chunk(["Variable_name", "Value"], rows)
+    if kind == "errors" or kind == "profiles":
+        return _str_chunk(["Level", "Code", "Message"] if kind == "errors"
+                          else ["Query_ID", "Duration", "Query"], [])
+    if kind == "engines":
+        from ..infoschema.virtual import _gen_engines
+        return _str_chunk(["Engine", "Support", "Comment", "Transactions",
+                           "XA", "Savepoints"],
+                          list(_gen_engines(sess.domain)))
+    if kind == "charset":
+        from ..infoschema.virtual import _gen_character_sets
+        return _str_chunk(["Charset", "Default collation", "Description",
+                           "Maxlen"],
+                          list(_gen_character_sets(sess.domain)))
+    if kind == "collation":
+        from ..infoschema.virtual import _gen_collations
+        return _str_chunk(["Collation", "Charset", "Id", "Default",
+                           "Compiled", "Sortlen"],
+                          list(_gen_collations(sess.domain)))
+    if kind == "create_database":
+        db = stmt.db or sess.vars.current_db
+        sess.domain.infoschema().schema_by_name(db)
+        return _str_chunk(["Database", "Create Database"], [(
+            db, f"CREATE DATABASE `{db}` /*!40100 DEFAULT CHARACTER SET "
+            "utf8mb4 */")])
+    if kind == "table_regions":
+        db = stmt.table.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, stmt.table.name)
+        # single-node: one region spanning the table's key range
+        return _str_chunk(
+            ["REGION_ID", "START_KEY", "END_KEY", "LEADER_ID",
+             "LEADER_STORE_ID", "PEERS", "SCATTERING"],
+            [(1, f"t_{tbl.id}_", f"t_{tbl.id + 1}_", 1, 1, "1", 0)])
     if kind == "plugins":
         return _str_chunk(["Name", "Status", "Type", "Library", "License",
                            "Version"],

@@ -91,6 +91,8 @@ for _v in [
            enum_vals=["auto", "host", "device"]),
     SysVar("last_plan_from_binding", SCOPE_SESSION, False, "bool"),
     SysVar("tidb_read_staleness", SCOPE_SESSION, 0, "int", -86400, 0),
+    SysVar("version_comment", SCOPE_BOTH, "tidb-tpu (MXU-native TiDB)",
+           "str"),
     SysVar("max_execution_time", SCOPE_BOTH, 0, "int", 0, None),
     SysVar("tidb_allow_mpp", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH, 100 << 20, "int", 0, None),
