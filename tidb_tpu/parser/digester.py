@@ -20,6 +20,9 @@ def normalize_digest(sql: str):
         t = toks[i]
         if t.kind == EOF:
             break
+        if t.kind == "OP" and t.text == ";":
+            i += 1
+            continue           # statement terminators don't change identity
         if t.kind in ("NUMBER", "STRING", "HEX"):
             # collapse literal lists: ?, ?, ? -> ... ?
             if (out and out[-1] == "?" and i >= 1):
