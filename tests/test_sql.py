@@ -1726,3 +1726,18 @@ class TestCompatStatements:
         assert r[0][0] == "test.cvt" and int(r[0][1]) != 0
         assert ftk.must_query("show table cvt regions").rows
         ftk.must_query("help 'select'").check([])
+
+
+class TestDistinctAggSpill:
+    def test_spill_matches_in_memory(self, ftk):
+        ftk.must_exec("create table dsp (g int, v int, pad varchar(32))")
+        ftk.must_exec("insert into dsp values " + ",".join(
+            f"({i % 7},{i % 23},'pad{i % 5}')" for i in range(20000)))
+        q = ("select g, count(distinct v), sum(distinct v), avg(distinct v)"
+             " from dsp group by g order by g")
+        expected = ftk.must_query(q).rows
+        ftk.must_exec("set tidb_mem_quota_query = 262144")
+        got = ftk.must_query(q).rows
+        assert got == expected
+        assert ftk.domain.metrics.get("agg_spill_count", 0) >= 1
+        ftk.must_exec("set tidb_mem_quota_query = 1073741824")

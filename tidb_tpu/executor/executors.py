@@ -945,10 +945,59 @@ class HashAggExec(Executor):
 
     def _complete_distinct(self):
         """DISTINCT aggs: materialize (group key, arg) pairs, dedup, then
-        aggregate (reference agg fallback path for distinct)."""
+        aggregate (reference agg fallback path for distinct). Oversized
+        grouped inputs grace-partition to disk by group-key hash
+        (reference agg_spill.go) — a group never spans partitions, so each
+        partition aggregates independently."""
         plan = self.plan
         chunks = self.child.all_chunks()
+
+        def chunks_bytes(chs):
+            return sum(getattr(c.data, "nbytes", 0)
+                       for ch in chs for c in ch.columns)
+        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
+        if plan.group_items and chunks_bytes(chunks) > quota:
+            return self._distinct_spill(chunks)
         merged = Chunk.concat_all(chunks)
+        return self._distinct_of(merged)
+
+    def _distinct_spill(self, chunks, nparts=8):
+        from ..utils.chunk_disk import ChunkSpool
+        self.ctx.sess.domain.inc_metric("agg_spill_count")
+        plan = self.plan
+        spools = [ChunkSpool(f"agg_d{i}") for i in range(nparts)]
+        for ch in chunks:
+            if not len(ch):
+                continue
+            cols = bind_chunk(self.child.schema, ch)
+            ectx = EvalCtx(np, len(ch), cols, host=True)
+            h = np.zeros(len(ch), dtype=np.uint64)
+            for g in plan.group_items:
+                d, nl, sd = eval_expr(ectx, g)
+                if np.isscalar(d):
+                    d = np.full(len(ch), d)
+                nm = np.asarray(materialize_nulls(ectx, nl))
+                k = np.where(nm, -(1 << 62),
+                             np.asarray(d).astype(np.int64))
+                h = h * np.uint64(0x9E3779B97F4A7C15) + k.astype(np.uint64)
+            part = (h % np.uint64(nparts)).astype(np.int64)
+            for i in range(nparts):
+                sub = ch.filter(part == i)
+                if len(sub):
+                    spools[i].append(sub)
+        results = []
+        for sp in spools:
+            part = Chunk.concat_all([sp.load(j)
+                                     for j in range(sp.num_chunks)])
+            sp.close()
+            if part is not None and len(part):
+                results.append(self._distinct_of(part))
+        out = Chunk.concat_all(results)
+        return out if out is not None else Chunk.empty(
+            [sc.col.ft for sc in self.schema.cols])
+
+    def _distinct_of(self, merged):
+        plan = self.plan
         ngk = len(plan.group_items)
         if merged is None:
             if ngk == 0:
