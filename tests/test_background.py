@@ -67,3 +67,51 @@ def test_auto_analyze():
     assert ts is not None and ts.row_count == 100
     # fresh stats: no re-run
     assert tk.domain.auto_analyze_once() == 0
+
+
+def test_durable_task_resume(tmp_path):
+    """DXF checkpoint/resume (reference dxf/framework/storage): task +
+    subtask rows persist in system tables; after a restart only
+    not-yet-succeeded subtasks re-run."""
+    from tidb_tpu.session import new_store, Session
+    from tidb_tpu.dxf.framework import register_task_type
+
+    runs = []
+
+    def planner(domain, meta):
+        def mk(i):
+            def fn(cancel):
+                runs.append((meta, i))
+                return i
+            return fn
+        return [mk(i) for i in range(4)]
+    register_task_type("bg_demo", planner)
+
+    d = str(tmp_path / "data")
+    dom = new_store(d)
+    t = dom.durable_tasks.submit("bg_demo", "t1")
+    assert dom.dxf.wait(t, 10)
+    assert t.state.value == "succeeded"
+    assert sorted(runs) == [("t1", i) for i in range(4)]
+
+    # simulate a crash mid-task: persisted running task, 2 subtasks done
+    s = Session(dom)
+    s.vars.current_db = "mysql"
+    s.execute("insert into tidb_global_task values "
+              "(99, 'k99', 'bg_demo', 'running', 't2', 2)")
+    for i, st in ((0, "succeeded"), (1, "succeeded"),
+                  (2, "pending"), (3, "pending")):
+        s.execute(f"insert into tidb_background_subtask values "
+                  f"({99000 + i}, 99, {i}, '{st}')")
+    dom.storage.mvcc.wal.close()
+
+    runs.clear()
+    dom2 = new_store(d)
+    resumed = dom2.durable_tasks.resume_all()
+    for t2 in resumed:
+        assert dom2.dxf.wait(t2, 10)
+    assert sorted(runs) == [("t2", 2), ("t2", 3)]
+    s2 = Session(dom2)
+    s2.vars.current_db = "mysql"
+    assert s2.execute("select state from tidb_global_task "
+                      "where id = 99").rows == [("succeeded",)]

@@ -162,3 +162,91 @@ class Timer:
             for ev in self._timers.values():
                 ev.set()
             self._timers.clear()
+
+
+# ---- durable tasks (reference dxf/framework/storage — task + subtask
+# rows in system tables; here they ride the WAL/checkpoint durability of
+# mysql.tidb_global_task / mysql.tidb_background_subtask) ----------------
+
+_TASK_TYPES: dict = {}      # kind -> planner(domain, meta) -> [fn, ...]
+
+
+def register_task_type(kind: str, planner):
+    """planner(domain, meta) must return the FULL ordered subtask list;
+    on resume, already-succeeded ordinals are skipped (the done-list is
+    the checkpoint)."""
+    _TASK_TYPES[kind] = planner
+
+
+class DurableTasks:
+    """Persistence + resume layer over TaskManager (owner side)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+
+    def _sql(self, q):
+        from ..session import Session
+        s = Session(self.domain)
+        s.vars.current_db = "mysql"
+        return s.execute(q)
+
+    def submit(self, kind: str, meta: str, concurrency: int = 4):
+        import json as _json
+        planner = _TASK_TYPES[kind]
+        fns = planner(self.domain, meta)
+        tid = int(time.time() * 1000) % (1 << 40)
+        esc = meta.replace("'", "''")
+        self._sql(f"insert into tidb_global_task values "
+                  f"({tid}, 'k{tid}', '{kind}', 'running', '{esc}', "
+                  f"{concurrency})")
+        for i in range(len(fns)):
+            self._sql(f"insert into tidb_background_subtask values "
+                      f"({tid * 1000 + i}, {tid}, {i}, 'pending')")
+        return self._run(tid, kind, fns, list(range(len(fns))),
+                         concurrency)
+
+    def _run(self, tid, kind, fns, ordinals, concurrency):
+        def wrap(i, fn):
+            def go(cancel):
+                r = fn(cancel)
+                self._sql(f"update tidb_background_subtask set "
+                          f"state = 'succeeded' where id = "
+                          f"{tid * 1000 + i}")
+                return r
+            return go
+
+        def done(t):
+            st = "succeeded" if t.state == TaskState.SUCCEEDED \
+                else t.state.value
+            self._sql(f"update tidb_global_task set state = '{st}' "
+                      f"where id = {tid}")
+        task = self.domain.dxf.submit(
+            kind, [wrap(i, fn) for i, fn in zip(ordinals, fns)],
+            concurrency, on_done=done)
+        task.durable_id = tid
+        return task
+
+    def resume_all(self):
+        """Re-dispatch unfinished durable tasks after a restart; only
+        not-yet-succeeded subtasks run again (checkpoint/resume)."""
+        rs = self._sql("select id, type, meta, concurrency from "
+                       "tidb_global_task where state = 'running'")
+        resumed = []
+        for tid, kind, meta, conc in rs.rows:
+            planner = _TASK_TYPES.get(kind)
+            if planner is None:
+                continue
+            fns = planner(self.domain, meta)
+            done_rs = self._sql(
+                f"select ordinal from tidb_background_subtask where "
+                f"task_id = {tid} and state = 'succeeded'")
+            done = {r[0] for r in done_rs.rows}
+            todo = [(i, fn) for i, fn in enumerate(fns) if i not in done]
+            if not todo:
+                self._sql(f"update tidb_global_task set state = "
+                          f"'succeeded' where id = {tid}")
+                continue
+            resumed.append(self._run(
+                tid, kind, [fn for _, fn in todo], [i for i, _ in todo],
+                int(conc)))
+        return resumed

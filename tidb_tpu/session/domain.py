@@ -68,6 +68,8 @@ class Domain:
         self.resource_groups = ResourceGroupManager()
         from ..plugin import PluginManager
         self.plugins = PluginManager()
+        from ..dxf.framework import DurableTasks
+        self.durable_tasks = DurableTasks(self)
         self.ast_cache: dict = {}         # sql -> parsed stmt list
         self.digest_cache: dict = {}      # sql -> (normalized, digest)
         if data_dir:
@@ -220,6 +222,10 @@ class Domain:
         self.timer.register("gc", gc_interval, self.run_gc)
         self.timer.register("checkpoint", gc_interval,
                             self.maybe_checkpoint)
+        try:
+            self.durable_tasks.resume_all()
+        except Exception:               # noqa: BLE001
+            pass
 
     def auto_analyze_once(self, stale_ratio=0.5):
         """Re-ANALYZE tables whose row count drifted vs collected stats

@@ -850,6 +850,21 @@ def bootstrap(domain: Domain) -> None:
         CREATE TABLE global_variables (
           variable_name VARCHAR(64) NOT NULL PRIMARY KEY,
           variable_value VARCHAR(1024))""")
+    sess.execute("""
+        CREATE TABLE tidb_global_task (
+          id BIGINT NOT NULL PRIMARY KEY,
+          task_key VARCHAR(256),
+          type VARCHAR(64),
+          state VARCHAR(32),
+          meta VARCHAR(4096),
+          concurrency INT)""")
+    sess.execute("""
+        CREATE TABLE tidb_background_subtask (
+          id BIGINT NOT NULL PRIMARY KEY,
+          task_id BIGINT,
+          ordinal INT,
+          state VARCHAR(32),
+          KEY idx_task (task_id))""")
     sess.execute(
         "INSERT INTO tidb VALUES ('bootstrapped', 'True', 'Bootstrap flag'), "
         "('tidb_server_version', '1', 'Bootstrap version')")
