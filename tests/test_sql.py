@@ -1741,3 +1741,23 @@ class TestDistinctAggSpill:
         assert got == expected
         assert ftk.domain.metrics.get("agg_spill_count", 0) >= 1
         ftk.must_exec("set tidb_mem_quota_query = 1073741824")
+
+
+class TestPlanReplayer:
+    def test_dump(self, ftk):
+        import json
+        import zipfile
+        ftk.must_exec("create table prz (a int, b int, key ia (a))")
+        ftk.must_exec("insert into prz values (1,2),(3,4)")
+        ftk.must_exec("analyze table prz")
+        r = ftk.must_query(
+            "plan replayer dump explain select * from prz where a = 1")
+        path = r.rows[0][0]
+        z = zipfile.ZipFile(path)
+        names = set(z.namelist())
+        assert {"sql/sql.sql", "explain.txt", "schema/schema.sql",
+                "stats/stats.json", "variables.json"} <= names
+        assert "prz" in z.read("explain.txt").decode()
+        assert "CREATE TABLE `prz`" in z.read("schema/schema.sql").decode()
+        assert json.loads(z.read("stats/stats.json"))[
+            "test.prz"]["row_count"] == 2

@@ -305,6 +305,12 @@ class RecommendIndexStmt(StmtNode):
 
 
 @dataclass
+class PlanReplayerStmt(StmtNode):
+    stmt: StmtNode = None
+    sql: str = ""
+
+
+@dataclass
 class SetDefaultRoleStmt(StmtNode):
     mode: str = "list"          # all | none | list
     roles: list = field(default_factory=list)

@@ -171,6 +171,15 @@ class Parser:
             self.next()
             self.next()
             return ast.HelpStmt()
+        if kw == "plan":
+            self.next()
+            self.expect_kw("replayer")
+            self.expect_kw("dump")
+            self.accept_kw("explain")
+            start = self.peek().pos
+            inner = self._parse_stmt_inner()
+            return ast.PlanReplayerStmt(stmt=inner,
+                                        sql=self.sql[start:].strip())
         if kw == "recommend":
             self.next()
             self.expect_kw("index")

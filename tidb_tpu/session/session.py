@@ -314,6 +314,10 @@ class Session:
         if isinstance(stmt, ast.HelpStmt):
             from .show import _str_chunk
             return _str_chunk(["name", "description", "example"], [])
+        if isinstance(stmt, ast.PlanReplayerStmt):
+            from .show import _str_chunk
+            path = self._plan_replayer_dump(stmt)
+            return _str_chunk(["File_token"], [(path,)])
         if isinstance(stmt, ast.RecommendIndexStmt):
             from ..planner.advisor import recommend_indexes
             rows = recommend_indexes(self, stmt.sql or None)
@@ -528,6 +532,55 @@ class Session:
             return ResultSet()
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
+
+    def _plan_replayer_dump(self, stmt):
+        """PLAN REPLAYER DUMP EXPLAIN <sql> (reference
+        pkg/domain/plan_replayer.go): zip of schema DDL, table stats,
+        sysvars, the statement, and its plan — everything needed to
+        reproduce the plan elsewhere."""
+        import io
+        import json
+        import os
+        import time as _time
+        import zipfile
+        pctx = self._plan_ctx(None)
+        plan = optimize(stmt.stmt, pctx)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("sql/sql.sql", stmt.sql)
+            z.writestr("explain.txt", "\n".join(
+                "\t".join(map(str, row)) for row in explain_text(plan)))
+            ddls, stats = [], {}
+            for db, tname in sorted(getattr(plan, "read_tables", ())):
+                try:
+                    rs = self._dispatch(ast.ShowStmt(
+                        kind="create_table",
+                        table=ast.TableName(name=tname, db=db)), None)
+                    ddls.append(rs.rows[0][1] + ";")
+                except Exception:       # noqa: BLE001
+                    continue
+                tbl = self.domain.infoschema().table_by_name(db, tname)
+                ts = self.domain.stats.get(tbl.id)
+                if ts is not None:
+                    stats[f"{db}.{tname}"] = {
+                        "row_count": ts.row_count,
+                        "columns": {n: {"ndv": cs.ndv,
+                                        "nulls": cs.null_count,
+                                        "topn": dict(list(
+                                            cs.topn.items())[:5])}
+                                    for n, cs in ts.columns.items()}}
+            z.writestr("schema/schema.sql", "\n".join(ddls))
+            z.writestr("stats/stats.json", json.dumps(stats, default=str))
+            z.writestr("variables.json", json.dumps({
+                v: str(self.vars.get(v)) for v in
+                ("tidb_enable_mpp", "tidb_mpp_min_rows",
+                 "tidb_join_exec", "max_execution_time")}))
+        os.makedirs("/tmp/plan_replayer", exist_ok=True)
+        token = f"replayer_{int(_time.time() * 1000)}.zip"
+        path = os.path.join("/tmp/plan_replayer", token)
+        with open(path, "wb") as f:
+            f.write(buf.getvalue())
+        return path
 
     def _parse_one_cached(self, sql):
         from ..parser import parse
