@@ -647,9 +647,10 @@ class KillStmt(StmtNode):
 class BRStmt(StmtNode):
     """BACKUP/RESTORE DATABASE db TO/FROM 'path' (reference br/ + BRIE SQL,
     pkg/executor/brie.go)."""
-    kind: str = "backup"       # backup | restore
+    kind: str = "backup"       # backup | restore | backup_log
     db: str = ""               # empty = all user databases
     path: str = ""
+    until: str = ""            # RESTORE ... UNTIL TIMESTAMP (PITR)
 
 
 @dataclass

@@ -296,6 +296,11 @@ class Parser:
         if kw in ("backup", "restore"):
             self.next()
             stmt = ast.BRStmt(kind=kw)
+            if kw == "backup" and self.accept_kw("log"):
+                stmt.kind = "backup_log"
+                self.expect_kw("to")
+                stmt.path = self.next().text
+                return stmt
             if self.accept_kw("database") or self.accept_kw("schema"):
                 if not self.at_op("*"):
                     stmt.db = self.ident()
@@ -303,6 +308,9 @@ class Parser:
                     self.next()
             self.expect_kw("to") if kw == "backup" else self.expect_kw("from")
             stmt.path = self.next().text
+            if kw == "restore" and self.accept_kw("until"):
+                self.expect_kw("timestamp")
+                stmt.until = self.next().text
             return stmt
         self.error(f"unsupported statement '{kw}'")
 

@@ -101,7 +101,7 @@ class Domain:
                 self.storage.oracle.fast_forward(ts)
                 self.storage.mvcc.apply_replay(ts, muts)
         path = os.path.join(data_dir, "commit.wal")
-        for commit_ts, mutations in replay(path):
+        for commit_ts, mutations, _wall in replay(path):
             # keep the oracle ahead of replayed commits so the engine hooks
             # (schema cache reads) see them
             self.storage.oracle.fast_forward(commit_ts)

@@ -505,8 +505,14 @@ class Session:
         if isinstance(stmt, ast.BRStmt):
             from ..tools import br
             self.commit()
-            if stmt.kind == "backup":
+            if stmt.kind == "backup_log":
+                n = br.backup_log(self.domain, stmt.path)
+            elif stmt.kind == "backup":
                 n = br.backup(self.domain, stmt.db, stmt.path)
+            elif stmt.until:
+                from ..types.time_types import parse_datetime
+                n = br.restore_pitr(self.domain, stmt.path,
+                                    parse_datetime(stmt.until) / 1e6)
             else:
                 n = br.restore(self.domain, stmt.db, stmt.path)
             return ResultSet(affected=n)

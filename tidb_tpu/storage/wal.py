@@ -2,7 +2,7 @@
 RocksDB WAL collapsed to a single-node commit log).
 
 Frame format: u32 length + u32 crc32 + payload, payload = pickled
-(commit_ts, [(key, value|None)]). Commits append a frame before the engine
+(commit_ts, [(key, value|None)], wallclock). Commits append a frame before the engine
 hooks run; on open, replay reconstructs MVCC versions and (through the
 normal commit hooks) the columnar engine. Torn tails are truncated.
 
@@ -26,7 +26,8 @@ class WalWriter:
         self._f = open(path, "ab")
 
     def append(self, commit_ts: int, mutations: list):
-        payload = pickle.dumps((commit_ts, mutations),
+        import time
+        payload = pickle.dumps((commit_ts, mutations, time.time()),
                                protocol=pickle.HIGHEST_PROTOCOL)
         frame = struct.pack("<II", len(payload),
                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
@@ -56,4 +57,6 @@ def replay(path: str):
             if len(payload) < ln or \
                     (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                 return
-            yield pickle.loads(payload)
+            rec = pickle.loads(payload)
+            # v1 frames had no wallclock; normalize to 3-tuples
+            yield rec if len(rec) == 3 else (rec[0], rec[1], 0.0)

@@ -145,3 +145,79 @@ def _create_from_info(sess, db, t: TableInfo):
         else:
             cols.append(f"KEY `{idx.name}` ({colstr})")
     sess.execute(f"create table `{db}`.`{t.name}` ({', '.join(cols)})")
+
+
+# ---- PITR (reference br/pkg/stream — log backup + point-in-time
+# restore; here the commit WAL is the log: BACKUP LOG copies it, RESTORE
+# ... UNTIL TIMESTAMP replays frames whose commit wallclock <= target
+# into a fresh store) -----------------------------------------------------
+
+def backup_log(domain, path: str) -> int:
+    """Copy the WAL (and checkpoint snapshot, if any) to path/log/."""
+    import shutil
+    import time
+    if not domain.data_dir:
+        from ..errors import TiDBError
+        raise TiDBError("BACKUP LOG requires a --data-dir store")
+    dst = os.path.join(path, "log")
+    os.makedirs(dst, exist_ok=True)
+    wal = os.path.join(domain.data_dir, "commit.wal")
+    n = 0
+    w = domain.storage.mvcc.wal
+    if w is not None:
+        w._f.flush()
+    if os.path.exists(wal):
+        shutil.copy2(wal, os.path.join(dst, "commit.wal"))
+        from ..storage.wal import replay as _replay
+        n = sum(1 for _ in _replay(os.path.join(dst, "commit.wal")))
+    ckpt = os.path.join(domain.data_dir, "checkpoint.snap")
+    meta = {"backup_wall": time.time(), "has_checkpoint": False}
+    if os.path.exists(ckpt):
+        shutil.copy2(ckpt, os.path.join(dst, "checkpoint.snap"))
+        meta["has_checkpoint"] = True
+        meta["checkpoint_mtime"] = os.path.getmtime(ckpt)
+    with open(os.path.join(dst, "pitr_meta.json"), "w") as f:
+        json.dump(meta, f)
+    return n
+
+
+def restore_pitr(domain, path: str, until_wall: float) -> int:
+    """Replay the log backup into `domain` up to `until_wall` (intended
+    for a fresh store — the reference restores PITR into a new cluster)."""
+    import pickle
+    from ..errors import TiDBError
+    dst = os.path.join(path, "log")
+    meta_path = os.path.join(dst, "pitr_meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    ckpt = os.path.join(dst, "checkpoint.snap")
+    applied = 0
+    if meta.get("has_checkpoint"):
+        if until_wall < meta.get("checkpoint_mtime", 0):
+            raise TiDBError(
+                "PITR target predates the checkpoint in this log backup")
+        with open(ckpt, "rb") as f:
+            ckpt_ts, triples = pickle.load(f)
+        triples.sort(key=lambda t: t[0])
+        i = 0
+        while i < len(triples):
+            ts = triples[i][0]
+            muts = []
+            while i < len(triples) and triples[i][0] == ts:
+                muts.append((triples[i][1], triples[i][2]))
+                i += 1
+            domain.storage.oracle.fast_forward(ts)
+            domain.storage.mvcc.apply_replay(ts, muts)
+            applied += 1
+    from ..storage.wal import replay as _replay
+    for commit_ts, mutations, wall in _replay(
+            os.path.join(dst, "commit.wal")):
+        if wall and wall > until_wall:
+            break
+        domain.storage.oracle.fast_forward(commit_ts)
+        domain.storage.mvcc.apply_replay(commit_ts, mutations)
+        applied += 1
+    domain.is_cache._cached = None
+    return applied
