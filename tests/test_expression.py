@@ -406,3 +406,28 @@ class TestBuiltinLongTail:
         q("select time('2024-01-01 10:11:12'), "
           "time_format('10:05:00','%H %i')").check(
             [("10:11:12", "10 05")])
+
+    def test_misc_tail(self, tk):
+        q = tk.must_query
+        q("select truncate(1.999, 1), truncate(-1.999, 1), "
+          "truncate(1234.5, -2)").check([("1.9", "-1.9", "1200")])
+        q("select weekofyear('2024-03-05'), weekofyear('2024-01-01')"
+          ).check([(10, 1)])
+        q("select convert('5', signed) + 1, convert(65, char)").check(
+            [(6, "65")])
+        q("select convert('abc' using utf8mb4)").check([("abc",)])
+        q("select get_lock('tl', 1), is_free_lock('tl'), "
+          "release_lock('tl'), release_lock('tl')").check([(1, 0, 1, 0)])
+        q("select name_const('x', 42), current_role()").check(
+            [(42, "NONE")])
+        q("select format_bytes(1024), format_bytes(500)").check(
+            [("1.00 KiB", "500 Bytes")])
+        q("select json_storage_size('{}'), weight_string('ab')").check(
+            [(2, "ab")])
+        tk.must_exec("create table avt (g int, v int)")
+        tk.must_exec("insert into avt values (1,10),(1,20),(2,30)")
+        q("select g, any_value(v) from avt group by g order by g").check(
+            [(1, 10), (2, 30)])
+        tk.must_exec("create table rct (v int)")
+        tk.must_exec("insert into rct values (1),(2),(3)")
+        q("select row_count()").check([(3,)])

@@ -235,7 +235,7 @@ _HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
               "json_array", "json_object", "json_set", "json_insert",
               "json_replace", "json_remove", "json_merge_patch",
               "json_contains_path", "addtime", "subtime", "timediff",
-              "time", "time_format"}
+              "time", "time_format", "weekofyear", "format_bytes"}
 
 
 # ---------------- string helpers ----------------
@@ -1137,8 +1137,12 @@ def op_truncate(ctx, expr):
         s = _scale_of(ft)
         if d >= s:
             return a, an, None
+        # result is declared at scale min(max(d,0), s): truncate at digit
+        # d, then re-scale the representation to match
+        tgt = min(max(d, 0), s)
         k = _POW10[s - d]
-        return (xp.sign(a)) * ((xp.abs(a) // k) * k), an, None
+        t = xp.sign(a) * (xp.abs(a) // k)      # value * 10^d
+        return t * _POW10[tgt - d], an, None
     if _dataclass_of(ft) == "float":
         m = 10.0 ** d
         return xp.trunc(a * m) / m, an, None
@@ -1567,7 +1571,8 @@ def op_cast_char(ctx, expr):
     from ..types.time_types import days_to_str, micros_to_str
     cls = _dataclass_of(ft)
     tc = ft.tclass
-    a_np = np.asarray(a)
+    scalar_in = np.isscalar(a) or np.ndim(a) == 0
+    a_np = np.atleast_1d(np.asarray(a))
     out = np.empty(len(a_np), dtype=object)
     for i, v in enumerate(a_np):
         if tc == TypeClass.DATE:
@@ -1580,6 +1585,8 @@ def op_cast_char(ctx, expr):
             out[i] = repr(float(v))
         else:
             out[i] = str(int(v))
+    if scalar_in:
+        return out[0], an, None
     return out, an, None
 
 
@@ -3060,3 +3067,55 @@ def op_time_format(ctx, expr):
             return None
         return _format_datetime_py(abs(us), fmt)
     return _rowwise(ctx, type("E", (), {"args": [expr.args[0]]})(), f)
+
+
+@op("weekofyear")
+def op_weekofyear(ctx, expr):
+    def f(s):
+        import datetime
+        try:
+            y, m, d = (int(x) for x in str(s).split(" ")[0].split("-"))
+            return datetime.date(y, m, d).isocalendar()[1]
+        except Exception:               # noqa: BLE001
+            return None
+    return _rowwise(ctx, type("E", (), {"args": [expr.args[0]]})(), f,
+                    dtype=np.int64)
+
+
+@op("format_bytes")
+def op_format_bytes(ctx, expr):
+    def f(v):
+        v = float(v)
+        for unit in ("Bytes", "KiB", "MiB", "GiB", "TiB", "PiB"):
+            if abs(v) < 1024 or unit == "PiB":
+                return ("%d %s" % (v, unit)) if unit == "Bytes" \
+                    else ("%.2f %s" % (v, unit))
+            v /= 1024
+    return _rowwise(ctx, expr, f)
+
+
+@op("json_pretty")
+def op_json_pretty(ctx, expr):
+    import json as _json
+
+    def f(s):
+        v = _json_load(s)
+        if v is None and str(s).strip() != "null":
+            return None
+        return _json.dumps(v, indent=2)
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+
+
+@op("json_storage_size")
+def op_json_storage_size(ctx, expr):
+    def f(s):
+        return len(str(s).encode())
+    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f,
+                         out_is_string=False)
+
+
+@op("weight_string")
+def op_weight_string(ctx, expr):
+    # binary-collation sort key = the string itself (reference
+    # pkg/util/collate binary collator)
+    return eval_expr(ctx, expr.args[0])

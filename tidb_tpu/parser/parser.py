@@ -1796,6 +1796,27 @@ class Parser:
                 return self.parse_case()
             if low == "cast" and nxt.kind == "OP" and nxt.text == "(":
                 return self.parse_cast()
+            if low == "convert" and nxt.kind == "OP" and nxt.text == "(":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                if self.accept_kw("using"):
+                    self.ident()               # charset: no-op (utf8mb4)
+                    self.expect_op(")")
+                    return e
+                self.expect_op(",")
+                tname = self.ident().lower()
+                flen = dec = -1
+                if self.accept_op("("):
+                    flen = int(self.next().text)
+                    if self.accept_op(","):
+                        dec = int(self.next().text)
+                    self.expect_op(")")
+                if tname == "character" or tname == "char":
+                    tname = "char"
+                self.expect_op(")")
+                return ast.Cast(expr=e, to_type=tname, flen=flen,
+                                decimal=dec)
             if low == "interval" and t.kind == "IDENT":
                 if nxt.kind == "OP" and nxt.text == "(":
                     return self.parse_func_call()   # INTERVAL(n, a, b, ...)

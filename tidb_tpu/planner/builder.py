@@ -310,6 +310,8 @@ class PlanBuilder:
             args = [rw_inner.rewrite(a) for a in node.args
                     if not isinstance(a, ast.Wildcard)]
             name = node.name
+            if name == "any_value":
+                name = "first_row"
             if name == "count" and not args:
                 args = []
             desc = AggDesc(name=name, args=args, distinct=node.distinct)
@@ -831,7 +833,9 @@ class PlanBuilder:
             rw_inner = self._rewriter(p.schema)
             args = [rw_inner.rewrite(a) for a in node.args
                     if not isinstance(a, ast.Wildcard)]
-            desc = AggDesc(name=node.name, args=args, distinct=node.distinct)
+            desc = AggDesc(name="first_row" if node.name == "any_value"
+                           else node.name, args=args,
+                           distinct=node.distinct)
             desc.ft = agg_result_ft(node.name, args, node.distinct)
             fp = desc.fingerprint()
             if fp in agg_map:

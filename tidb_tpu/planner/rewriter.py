@@ -57,7 +57,9 @@ _INT_FUNCS |= {"find_in_set", "bit_count", "interval", "inet_aton",
                "timestampdiff", "period_add", "period_diff", "time_to_sec",
                "json_depth", "json_contains", "json_contains_path"}
 _STRING_FUNCS |= {"addtime", "subtime", "timediff", "time",
-                  "time_format"}
+                  "time_format", "format_bytes", "json_pretty",
+                  "weight_string"}
+_INT_FUNCS |= {"weekofyear", "json_storage_size"}
 _DATE_RET_FUNCS = {"from_days", "last_day", "makedate"}
 _DATETIME_RET_FUNCS_EXTRA = {"timestampadd"}
 _DATETIME_RET_FUNCS = {"str_to_date", "from_unixtime"}
@@ -414,6 +416,37 @@ class Rewriter:
             return const_from_py(4 if isinstance(arg, ast.Literal) else 2)
         if name == "last_insert_id" and not node.args:
             return const_from_py(self.pctx.sess_vars.last_insert_id)
+        if name == "found_rows":
+            self.pctx.cacheable = False
+            return const_from_py(self.pctx.sess_vars.found_rows)
+        if name == "row_count":
+            self.pctx.cacheable = False
+            return const_from_py(
+                getattr(self.pctx.sess_vars, "last_affected", 0))
+        if name == "tidb_version":
+            return const_from_py(
+                "Release Version: v8.0.11-tidb-tpu-0.1.0\n"
+                "Edition: TPU-native\nStore: embedded columnar+MVCC")
+        if name == "current_role":
+            return const_from_py("NONE")
+        if name == "name_const" and len(node.args) == 2:
+            return self.rewrite(node.args[1])
+        if name in ("get_lock", "release_lock", "is_free_lock") and \
+                node.args:
+            # advisory locks (reference builtin_miscellaneous.go): session
+            # side effect at plan time; single-process semantics
+            self.pctx.cacheable = False
+            arg0 = node.args[0]
+            lock_name = str(arg0.value).lower() \
+                if isinstance(arg0, ast.Literal) else ""
+            locks = self.pctx.user_vars.setdefault("__advisory_locks", {})
+            if name == "get_lock":
+                locks[lock_name] = self.pctx.conn_id
+                return const_from_py(1)
+            if name == "is_free_lock":
+                return const_from_py(0 if lock_name in locks else 1)
+            held = locks.pop(lock_name, None)
+            return const_from_py(1 if held is not None else 0)
         if name in ("nextval", "lastval") and node.args:
             arg = node.args[0]
             if isinstance(arg, ast.ColumnRef):

@@ -736,7 +736,9 @@ class Session:
         attempt = 0
         while True:
             try:
-                return self._exec_dml_once(stmt, params)
+                rs = self._exec_dml_once(stmt, params)
+                self.vars.last_affected = rs.affected
+                return rs
             except (WriteConflictError, TxnRetryableError):
                 attempt += 1
                 if self._explicit_txn or attempt > retries:

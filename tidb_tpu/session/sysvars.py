@@ -122,6 +122,7 @@ class SessionVars:
         self.last_insert_id = 0
         self.affected_rows = 0
         self.found_rows = 0
+        self.last_affected = 0
         self.warnings: list = []
 
     def get(self, name: str):
