@@ -10,21 +10,31 @@ from tidb_tpu.server import protocol as P
 
 
 class MiniClient:
-    def __init__(self, port, db=""):
+    def __init__(self, port, db="", user="root", password="",
+                 expect_ok=True):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
         self.io = P.PacketIO(self.sock)
         greeting = self.io.read_packet()
         assert greeting[0] == 10
+        # salt: 8 bytes after conn_id+version, 12 more before auth name
+        ver_end = greeting.index(b"\x00", 1)
+        salt = greeting[ver_end + 5:ver_end + 13] + \
+            greeting[ver_end + 13 + 1 + 2 + 1 + 2 + 2 + 1 + 10:
+                     ver_end + 13 + 1 + 2 + 1 + 2 + 2 + 1 + 10 + 12]
         caps = P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
         if db:
             caps |= P.CLIENT_CONNECT_WITH_DB
+        token = P.native_password_token(password, salt)
         resp = struct.pack("<IIB", caps, 1 << 24, 46) + b"\x00" * 23
-        resp += b"root\x00" + b"\x00"
+        resp += user.encode() + b"\x00"
+        resp += bytes([len(token)]) + token
         if db:
             resp += db.encode() + b"\x00"
         self.io.write_packet(resp)
         ok = self.io.read_packet()
-        assert ok[0] == 0x00, ok
+        self.auth_ok = ok[0] == 0x00
+        if expect_ok:
+            assert self.auth_ok, ok
 
     def _read_lenenc(self, data, pos):
         b = data[pos]
@@ -201,3 +211,40 @@ def test_binary_protocol_prepared(server):
         c.io.write_packet(bytes([P.COM_STMT_CLOSE]) + struct.pack("<I", sid))
     finally:
         c.close()
+
+
+def test_wire_auth(server):
+    """Handshake must verify the native-password scramble and bind the
+    session to the authenticated user (ADVICE r1: every client ran as
+    root before)."""
+    root = MiniClient(server.port, db="test")
+    try:
+        root.query("create user if not exists 'alice'@'%' "
+                   "identified by 'sekrit'")
+        root.query("grant select on *.* to 'alice'@'%'")
+    finally:
+        root.close()
+    # correct password
+    c = MiniClient(server.port, user="alice", password="sekrit")
+    try:
+        r = c.query("select current_user()")
+        assert r["rows"][0][0].startswith("alice")
+    finally:
+        c.close()
+    # wrong password rejected
+    bad = MiniClient(server.port, user="alice", password="wrong",
+                     expect_ok=False)
+    assert not bad.auth_ok
+    bad.sock.close()
+    # unknown user rejected
+    nob = MiniClient(server.port, user="nobody", password="",
+                     expect_ok=False)
+    assert not nob.auth_ok
+    nob.sock.close()
+    # authenticated non-root user is privilege-checked
+    c2 = MiniClient(server.port, user="alice", password="sekrit", db="test")
+    try:
+        with pytest.raises(RuntimeError, match="1142|denied"):
+            c2.query("create table alice_t (a int)")
+    finally:
+        c2.close()

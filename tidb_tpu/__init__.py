@@ -31,3 +31,30 @@ Layer map (mirrors reference SURVEY.md §1, re-architected TPU-first):
 """
 
 __version__ = "0.1.0"
+
+
+def force_cpu_backend():
+    """Pin jax to the host-CPU backend, unregistering accelerator PJRT
+    plugins. A wedged/busy TPU tunnel blocks backend *initialization*
+    even under JAX_PLATFORMS=cpu (the registered plugin factory still
+    runs), so the factory itself must go. Safe to call before any jax
+    device op; used by the CLI (--cpu), tests, and bench fallback."""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+    try:
+        import jax._src.xla_bridge as _xb
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+    except Exception:
+        pass
+
+
+import os as _os
+if _os.environ.get("TIDB_TPU_PLATFORM", "").lower() == "cpu":
+    force_cpu_backend()
+del _os

@@ -51,7 +51,12 @@ def main(argv=None):
     ap.add_argument("-e", "--execute", help="run one statement and exit")
     ap.add_argument("--data-dir", default=None,
                     help="persist commits to a WAL in this directory")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the jax CPU backend (no TPU init)")
     args = ap.parse_args(argv)
+    if args.cpu:
+        from . import force_cpu_backend
+        force_cpu_backend()
     from .session import new_store
     domain = new_store(args.data_dir)
     if args.serve:

@@ -367,6 +367,50 @@ class IndexMergeExec(IndexRangeExec):
             [sc.col.ft for sc in self.schema.cols])
 
 
+def _columnar_unique_probe(ctab, tbl, index, datums, read_ts):
+    """Handle of the row matching a unique-index key, found by scanning
+    the columnar arrays (bulk-loaded rows carry no index KV)."""
+    n = ctab.n
+    mask = ctab.valid_at(read_ts, n)
+    for d, cn in zip(datums, index.columns):
+        ci = tbl.find_column(cn)
+        arr = ctab.data[ci.id][:n]
+        nulls = ctab.nulls[ci.id][:n]
+        if d.is_null:
+            mask = mask & nulls
+            continue
+        if ci.id in ctab.dicts:
+            code = ctab.dicts[ci.id].lookup(str(d.val))
+            if code < 0:
+                return None
+            mask = mask & (arr == code) & ~nulls
+        else:
+            v = float(d.val) if arr.dtype == np.float64 else int(d.val)
+            mask = mask & (arr == v) & ~nulls
+    idxs = np.nonzero(mask)[0]
+    if not len(idxs):
+        return None
+    return int(ctab.handles[idxs[-1]])
+
+
+def _row_matches_index(tbl, index, row, datums):
+    """Does a decoded row still carry the queried unique-key values?
+    (An in-txn UPDATE can move a row off the key the probe found it by.)"""
+    name_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
+    for d, cn in zip(datums, index.columns):
+        off = name_off.get(cn.lower())
+        if off is None or off >= len(row):
+            return False
+        rd = row[off]
+        if d.is_null or rd.is_null:
+            if d.is_null != rd.is_null:
+                return False
+            continue
+        if rd.val != d.val and str(rd.val) != str(d.val):
+            return False
+    return True
+
+
 class PointGetExec(Executor):
     """O(1) point read: clustered-PK handle -> columnar handle index (or
     row KV for txn-buffered rows); unique index -> index KV -> handle."""
@@ -403,6 +447,40 @@ class PointGetExec(Executor):
             for e, cn in zip(plan.index_vals, plan.index.columns):
                 ci = tbl.find_column(cn)
                 datums.append(coerce_datum(expr_to_datum(e), ci.ft))
+            bctab = sess.domain.columnar.tables.get(tbl.id)
+            if bctab is not None and bctab.bulk_rows:
+                # safety net (stale cached plan after IMPORT/restore):
+                # bulk rows have no index KV — but in-txn writes DO
+                # maintain index KV in the mem buffer, so that wins
+                ik = index_key(tbl.id, plan.index.id, datums)
+                if dirty and ik in txn.mem_buffer:
+                    v = txn.mem_buffer.get(ik)
+                    if v is None:     # txn removed this unique value
+                        return Chunk.empty(
+                            [sc.col.ft for sc in self.schema.cols])
+                    handle = int(v)
+                else:
+                    handle = _columnar_unique_probe(
+                        bctab, tbl, plan.index, datums, self.ctx.read_ts())
+                    if handle is None:
+                        return Chunk.empty(
+                            [sc.col.ft for sc in self.schema.cols])
+                if dirty:
+                    rk = record_key(tbl.id, handle)
+                    if rk in txn.mem_buffer:
+                        rv = txn.mem_buffer.get(rk)
+                        if rv is None:
+                            return Chunk.empty(
+                                [sc.col.ft for sc in self.schema.cols])
+                        row = decode_row_value(rv)
+                        # the buffered row may have been updated past the
+                        # probed (committed) key value — re-verify
+                        if not _row_matches_index(tbl, plan.index, row,
+                                                  datums):
+                            return Chunk.empty(
+                                [sc.col.ft for sc in self.schema.cols])
+                        return self._from_row(row)
+                return self._gather_one(bctab, handle)
             ik = index_key(tbl.id, plan.index.id, datums)
             v = (txn.get(ik) if dirty else
                  sess.domain.storage.mvcc.get(
@@ -421,10 +499,16 @@ class PointGetExec(Executor):
                 row = decode_row_value(rv)
                 return self._from_row(row)
         ctab = sess.domain.columnar.tables.get(tbl.id)
+        return self._gather_one(ctab, handle)
+
+    def _gather_one(self, ctab, handle):
+        tbl = self.plan.table_info
         pos = None if ctab is None else ctab.handle_pos.get(handle)
-        if pos is None or ctab.delete_ts[pos] != 0:
-            return Chunk.empty([sc.col.ft for sc in self.schema.cols])
         rts = self.ctx.read_ts()
+        if pos is None or (rts is None and ctab.delete_ts[pos] != 0):
+            # deleted-latest still needs the stale-read version rescan
+            # below when rts is set (an older version may be visible)
+            return Chunk.empty([sc.col.ft for sc in self.schema.cols])
         if rts is not None and not (
                 ctab.insert_ts[pos] <= rts and
                 (ctab.delete_ts[pos] == 0 or ctab.delete_ts[pos] > rts)):

@@ -52,8 +52,11 @@ def exec_import(sess, stmt) -> ResultSet:
             else:
                 columns[ci.name] = res
                 n = len(res)
-        ctab.bulk_append(columns, n,
+        handles = _bulk_handles(tbl, columns)
+        _check_bulk_handles(ctab, handles)
+        ctab.bulk_append(columns, n, handles=handles,
                          commit_ts=sess.domain.storage.current_ts())
+        sess.domain.invalidate_plan_cache()
         return ResultSet(affected=n)
 
     raw = [[] for _ in cols]
@@ -66,8 +69,39 @@ def exec_import(sess, stmt) -> ResultSet:
     columns = {}
     for ci, vals in zip(cols, raw):
         columns[ci.name] = convert_text_column(ci.ft, vals)
-    ctab.bulk_append(columns, n, commit_ts=sess.domain.storage.current_ts())
+    handles = _bulk_handles(tbl, columns)
+    _check_bulk_handles(ctab, handles)
+    ctab.bulk_append(columns, n, handles=handles,
+                     commit_ts=sess.domain.storage.current_ts())
+    sess.domain.invalidate_plan_cache()
     return ResultSet(affected=n)
+
+
+def _bulk_handles(tbl, columns):
+    """Clustered-PK tables must use the PK value as the row handle —
+    arange handles would make PointGet-by-PK return the wrong row.
+    Duplicate PKs in the file are an error (reference IMPORT INTO
+    rejects duplicate keys), not a silent double-row."""
+    if tbl.pk_is_handle:
+        pk = columns.get(tbl.pk_col_name)
+        if pk is None:
+            for name, arr in columns.items():
+                if name.lower() == tbl.pk_col_name.lower():
+                    pk = arr
+                    break
+        if pk is not None:
+            h = np.asarray(pk, dtype=np.int64)
+            if len(np.unique(h)) != len(h):
+                raise TiDBError(
+                    "duplicate primary-key values in import file")
+            return h
+    return None
+
+
+def _check_bulk_handles(ctab, handles):
+    if handles is not None and ctab.n and \
+            bool(np.isin(handles, ctab.handles[:ctab.n]).any()):
+        raise TiDBError("import rows collide with existing primary keys")
 
 
 def convert_text_column(ft, vals: list):

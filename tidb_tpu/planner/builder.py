@@ -133,6 +133,7 @@ class PlanBuilder:
         ds = DataSource(info, "", alias, schema, handle_col)
         ds.stats_rows = max(float(self.pctx.table_rows("", info)), 1.0)
         ds.tbl_stats = None
+        ds.bulk_only = self.pctx.table_bulk_rows(info.id) > 0
         ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
         return ds
 
@@ -206,6 +207,7 @@ class PlanBuilder:
         ds = DataSource(tbl, db, alias, schema, handle_col)
         ds.stats_rows = max(float(self.pctx.table_rows(db, tbl)), 1.0)
         ds.tbl_stats = self.pctx.table_stats(tbl.id)
+        ds.bulk_only = self.pctx.table_bulk_rows(tbl.id) > 0
         ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
         return ds
 

@@ -18,13 +18,15 @@ class PlanContext:
                  now_micros=0, conn_id=1, params=None, table_stats=None,
                  check_read=None, temp_tables=None, make_temp_table=None,
                  drop_temp_table=None, seq_nextval=None, seq_lastval=None,
-                 ts_for_time=None):
+                 ts_for_time=None, table_bulk_rows=None, user=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
         self._run_subquery = run_subquery
         self._table_rows = table_rows
         self._table_stats = table_stats
+        self._table_bulk_rows = table_bulk_rows
+        self.user = user
         self.check_read = check_read
         self.temp_tables = temp_tables or {}
         self.make_temp_table = make_temp_table
@@ -69,6 +71,13 @@ class PlanContext:
         if self._table_stats is None:
             return None
         return self._table_stats(table_id)
+
+    def table_bulk_rows(self, table_id) -> int:
+        """Rows without row/index KV (IMPORT INTO / BR restore): index-
+        driven access paths would silently miss them."""
+        if self._table_bulk_rows is None:
+            return 0
+        return self._table_bulk_rows(table_id)
 
 
 def optimize(stmt, pctx: PlanContext):

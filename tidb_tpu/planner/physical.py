@@ -303,6 +303,11 @@ def _try_point_get(ds: DataSource) -> PhysPlan | None:
     if tbl.pk_is_handle and set(eqs) == {tbl.pk_col_name.lower()}:
         return PhysPointGet(tbl, ds.db_name, cols,
                             eqs[tbl.pk_col_name.lower()], None, None, schema)
+    if getattr(ds, "bulk_only", False):
+        # bulk-loaded rows have no index KV: unique-index lookups would
+        # silently miss them (clustered-PK lookups above are fine — bulk
+        # handles ARE the PK values)
+        return None
     for idx in tbl.public_indexes():
         if idx.unique and set(eqs) == {c.lower() for c in idx.columns}:
             vals = [eqs[c.lower()] for c in idx.columns]
@@ -405,7 +410,8 @@ def _try_index_range(ds: DataSource) -> PhysPlan | None:
     """Range conds on a single-column index -> index range scan, when the
     table is fully KV-backed and the range is selective."""
     tbl = ds.table_info
-    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds:
+    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds or \
+            getattr(ds, "bulk_only", False):
         return None
     stats_rows = getattr(ds, "stats_rows", 0)
     base_rows = None
@@ -457,7 +463,8 @@ def _try_index_merge(ds: DataSource) -> PhysPlan | None:
     """OR of simple ranges, each covered by some index -> union-type
     index merge."""
     tbl = ds.table_info
-    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds:
+    if tbl.id < 0 or tbl.partitions or not ds.pushed_conds or \
+            getattr(ds, "bulk_only", False):
         return None
     indexed_cols = {}
     for idx in tbl.public_indexes():

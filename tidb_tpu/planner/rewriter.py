@@ -402,7 +402,8 @@ class Rewriter:
         if name == "version":
             return const_from_py("8.0.11-tidb-tpu-0.1.0")
         if name in ("user", "current_user"):
-            return const_from_py("root@%")
+            return const_from_py(getattr(self.pctx, "user", None) or
+                                 "root@%")
         if name == "connection_id":
             return const_from_py(self.pctx.conn_id)
         if name == "charset" and node.args:

@@ -178,6 +178,21 @@ class PrivManager:
             return False          # role accounts cannot log in
         return info["password"] == "" or info["password"] == password
 
+    def auth_native(self, user, host, salt: bytes, token: bytes) -> bool:
+        """Verify a mysql_native_password scramble against the stored
+        password (reference pkg/server/conn.go openSessionAndDoAuth +
+        parser/auth/mysql_native_password.go)."""
+        from ..server.protocol import native_password_token
+        k = _key(user, host)
+        info = self.users.get(k) or self.users.get(_key(user))
+        if info is None or info.get("locked"):
+            return False
+        pwd = info["password"]
+        if pwd == "":
+            return token == b""
+        return len(token) == 20 and \
+            token == native_password_token(pwd, salt)
+
     def check(self, user, host, priv, db="", tbl="", roles=()):
         """Raise unless `user` (or one of its active `roles`) holds `priv`
         at the narrowest matching scope."""

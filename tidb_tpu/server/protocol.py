@@ -107,6 +107,8 @@ def handshake_packet(conn_id: int, salt: bytes, server_version: str) -> bytes:
 
 
 def parse_handshake_response(data: bytes):
+    """-> (user, db, caps, auth_token) — auth_token is the 20-byte
+    mysql_native_password scramble (empty for empty-password logins)."""
     caps, max_packet, charset = struct.unpack_from("<IIB", data, 0)
     pos = 32
     end = data.index(b"\x00", pos)
@@ -114,9 +116,11 @@ def parse_handshake_response(data: bytes):
     pos = end + 1
     if caps & CLIENT_SECURE_CONNECTION:
         alen = data[pos]
+        token = data[pos + 1:pos + 1 + alen]
         pos += 1 + alen
     else:
         end = data.index(b"\x00", pos)
+        token = data[pos:end]
         pos = end + 1
     db = ""
     if caps & CLIENT_CONNECT_WITH_DB and pos < len(data):
@@ -124,7 +128,19 @@ def parse_handshake_response(data: bytes):
         if end < 0:
             end = len(data)
         db = data[pos:end].decode()
-    return user, db, caps
+    return user, db, caps, token
+
+
+def native_password_token(password: str, salt: bytes) -> bytes:
+    """Client-side mysql_native_password scramble:
+    SHA1(pwd) XOR SHA1(salt + SHA1(SHA1(pwd))) (MySQL 4.1 auth)."""
+    import hashlib
+    if not password:
+        return b""
+    stage1 = hashlib.sha1(password.encode()).digest()
+    stage2 = hashlib.sha1(stage1).digest()
+    mix = hashlib.sha1(salt + stage2).digest()
+    return bytes(a ^ b for a, b in zip(stage1, mix))
 
 
 def ok_packet(affected=0, last_insert_id=0, status=2, warnings=0) -> bytes:

@@ -61,7 +61,20 @@ class Server:
             io.write_packet(P.handshake_packet(
                 sess.conn_id, salt, "8.0.11-tidb-tpu-0.1.0"))
             resp = io.read_packet()
-            user, db, caps = P.parse_handshake_response(resp)
+            user, db, caps, token = P.parse_handshake_response(resp)
+            try:
+                peer_host = sock.getpeername()[0]
+            except OSError:
+                peer_host = "%"
+            if not sess.domain.priv.auth_native(user, peer_host, salt,
+                                                token):
+                io.write_packet(P.err_packet(
+                    1045, "28000",
+                    f"Access denied for user '{user}'@'{peer_host}' "
+                    f"(using password: {'YES' if token else 'NO'})"))
+                return
+            sess.user = user
+            sess.host = peer_host
             if db:
                 try:
                     sess.domain.infoschema().schema_by_name(db)

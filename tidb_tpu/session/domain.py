@@ -36,10 +36,14 @@ class _Allocator:
 
 
 class Domain:
-    def __init__(self, data_dir: str | None = None):
+    def __init__(self, data_dir: str | None = None,
+                 wal_sync: bool = False):
         import time as _time
         self._start_time = _time.time()
         self.data_dir = data_dir
+        # fsync every commit frame (power-loss durability; default off —
+        # the single-node trade is process-crash durability)
+        self.wal_sync = wal_sync
         self.storage = Storage()
         self.is_cache = InfoSchemaCache(self.storage)
         self.columnar = ColumnarEngine(self.storage, self._table_info_by_id)
@@ -82,12 +86,11 @@ class Domain:
         checkpointing (ADMIN CHECKPOINT / auto): snapshot + truncated
         WAL, the reference's RocksDB-snapshot + raft-log-GC shape."""
         import os
-        import pickle
-        from ..storage.wal import WalWriter, replay
+        from ..storage.wal import WalWriter, replay, decode_checkpoint
         ckpt = os.path.join(data_dir, "checkpoint.snap")
         if os.path.exists(ckpt):
             with open(ckpt, "rb") as f:
-                ckpt_ts, triples = pickle.load(f)
+                ckpt_ts, triples = decode_checkpoint(f.read())
             # re-apply versions in commit order so the engine hooks
             # rebuild columnar/schema state exactly like a WAL replay
             triples.sort(key=lambda t: t[0])
@@ -107,14 +110,20 @@ class Domain:
             self.storage.oracle.fast_forward(commit_ts)
             self.storage.mvcc.apply_replay(commit_ts, mutations)
         self.is_cache._cached = None     # reload schema from replayed meta
-        self.storage.mvcc.wal = WalWriter(path)
+        self.storage.mvcc.wal = WalWriter(path, sync=self.wal_sync)
+
+    def invalidate_plan_cache(self):
+        """Drop all cached plans (bulk loads change which access paths
+        are valid for a table without bumping the schema version)."""
+        self.plan_cache.clear()
+        self.plan_cache_order.clear()
 
     def checkpoint(self) -> int:
         """Write a consistent snapshot of the MVCC store and truncate the
         WAL (commits pause for the duration; single-node trade, like a
         RocksDB checkpoint). Returns the checkpoint ts."""
         import os
-        import pickle
+        from ..storage.wal import encode_checkpoint
         if not self.data_dir:
             from ..errors import TiDBError
             raise TiDBError("checkpoint requires --data-dir")
@@ -127,8 +136,7 @@ class Domain:
                     triples.append((vts, k, val))
             tmp = os.path.join(self.data_dir, "checkpoint.tmp")
             with open(tmp, "wb") as f:
-                pickle.dump((ts, triples), f,
-                            protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(encode_checkpoint(ts, triples))
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.data_dir, "checkpoint.snap"))
@@ -137,7 +145,7 @@ class Domain:
                 wal_path = mvcc.wal.path
                 open(wal_path, "wb").close()     # truncate: all frames
                 from ..storage.wal import WalWriter  # are in the snapshot
-                mvcc.wal = WalWriter(wal_path)
+                mvcc.wal = WalWriter(wal_path, sync=self.wal_sync)
         self.inc_metric("checkpoints")
         return ts
 

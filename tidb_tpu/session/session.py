@@ -188,7 +188,13 @@ class Session:
             seq_nextval=self.domain.seq_nextval,
             seq_lastval=self.domain.seq_lastval,
             ts_for_time=self.domain.storage.oracle.ts_for_time,
+            table_bulk_rows=self._table_bulk_rows,
+            user=f"{self.user}@{self.host}",
         )
+
+    def _table_bulk_rows(self, table_id: int) -> int:
+        t = self.domain.columnar.tables.get(table_id)
+        return t.bulk_rows if t is not None else 0
 
     def make_temp_table(self, name: str, fts, col_names, rows):
         """Materialize rows into a session temp table backed by the
@@ -533,11 +539,49 @@ class Session:
         }
         fn = ddl_map.get(type(stmt))
         if fn is not None:
+            self._check_ddl_priv(stmt)
             self.commit()
             fn(stmt)
             return ResultSet()
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
+
+    def _check_ddl_priv(self, stmt):
+        """DDL privilege gate (reference pkg/planner/core/planbuilder.go
+        visitInfo for DDL): CREATE/DROP/ALTER/INDEX at db or table scope.
+        Each stmt type names its priv and the TableName(s) it touches."""
+        def tn_target(tn):
+            return ((tn.db or self.vars.current_db), tn.name)
+
+        targets = []     # (priv, db, tbl)
+        if isinstance(stmt, ast.CreateDatabaseStmt):
+            targets.append(("create", stmt.name, ""))
+        elif isinstance(stmt, ast.DropDatabaseStmt):
+            targets.append(("drop", stmt.name, ""))
+        elif isinstance(stmt, ast.CreateTableStmt):
+            targets.append(("create", *tn_target(stmt.table)))
+        elif isinstance(stmt, ast.CreateViewStmt):
+            targets.append(("create", *tn_target(stmt.view)))
+        elif isinstance(stmt, (ast.CreateSequenceStmt,
+                               ast.DropSequenceStmt)):
+            priv = "create" if isinstance(stmt, ast.CreateSequenceStmt) \
+                else "drop"
+            targets.append((priv, *tn_target(stmt.name)))
+        elif isinstance(stmt, ast.DropTableStmt):
+            for tn in stmt.tables:
+                targets.append(("drop", *tn_target(tn)))
+        elif isinstance(stmt, ast.TruncateTableStmt):
+            targets.append(("drop", *tn_target(stmt.table)))
+        elif isinstance(stmt, ast.RenameTableStmt):
+            for old, new in stmt.pairs:
+                targets.append(("alter", *tn_target(old)))
+                targets.append(("create", *tn_target(new)))
+        elif isinstance(stmt, (ast.CreateIndexStmt, ast.DropIndexStmt)):
+            targets.append(("index", *tn_target(stmt.table)))
+        elif isinstance(stmt, ast.AlterTableStmt):
+            targets.append(("alter", *tn_target(stmt.table)))
+        for priv, db, tbl in targets:
+            self.check_priv(priv, db, tbl)
 
     def _plan_replayer_dump(self, stmt):
         """PLAN REPLAYER DUMP EXPLAIN <sql> (reference
@@ -931,10 +975,11 @@ def bootstrap(domain: Domain) -> None:
         "('tidb_server_version', '1', 'Bootstrap version')")
 
 
-def new_store(data_dir: str | None = None) -> Domain:
+def new_store(data_dir: str | None = None,
+              wal_sync: bool = False) -> Domain:
     """Create a bootstrapped in-process store (reference
     testkit.CreateMockStore). With data_dir, commits persist to a WAL and
-    replay on reopen."""
-    domain = Domain(data_dir)
+    replay on reopen; wal_sync=True fsyncs every commit frame."""
+    domain = Domain(data_dir, wal_sync=wal_sync)
     bootstrap(domain)
     return domain

@@ -181,6 +181,10 @@ class ColumnarTable:
             else:
                 arr[start:start + n] = np.asarray(src, dtype=arr.dtype)
         self.n += n
+        # bulk rows never get row/index KV: index-driven read paths must
+        # not be trusted for this table (planner gates on bulk_rows == 0,
+        # executors fall back to columnar scans)
+        self.bulk_rows += n
         self.version += 1
 
     def gc(self, safepoint: int) -> int:

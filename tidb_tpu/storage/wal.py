@@ -1,10 +1,17 @@
 """Write-ahead log for the row engine (reference role: TiKV's raft log /
 RocksDB WAL collapsed to a single-node commit log).
 
-Frame format: u32 length + u32 crc32 + payload, payload = pickled
-(commit_ts, [(key, value|None)], wallclock). Commits append a frame before the engine
-hooks run; on open, replay reconstructs MVCC versions and (through the
-normal commit hooks) the columnar engine. Torn tails are truncated.
+Frame format: u32 length + u32 crc32 + payload. The payload is a
+self-describing binary encoding (magic ``WAL2``) — NOT pickle: a data
+dir or PITR log backup from an untrusted source must never be able to
+execute code on open.  Payload layout:
+
+    b"WAL2"  u64 commit_ts  f64 wallclock  u32 nmut
+    nmut x ( u32 klen  key  i32 vlen|-1  value )      (vlen -1 == delete)
+
+Commits append a frame before the engine hooks run; on open, replay
+reconstructs MVCC versions and (through the normal commit hooks) the
+columnar engine. Torn tails are truncated.
 
 Bulk-imported columnar rows bypass the KV layer and therefore the WAL;
 their durability story is BR snapshots (documented trade, like
@@ -13,9 +20,86 @@ TiFlash-only tables).
 from __future__ import annotations
 
 import os
-import pickle
 import struct
 import zlib
+
+_MAGIC = b"WAL2"
+_CKPT_MAGIC = b"CKP2"
+
+
+def encode_frame_payload(commit_ts: int, mutations, wall: float) -> bytes:
+    out = [_MAGIC, struct.pack("<Qd I", commit_ts, wall, len(mutations))]
+    for key, value in mutations:
+        out.append(struct.pack("<I", len(key)))
+        out.append(bytes(key))
+        if value is None:
+            out.append(struct.pack("<i", -1))
+        else:
+            out.append(struct.pack("<i", len(value)))
+            out.append(bytes(value))
+    return b"".join(out)
+
+
+def decode_frame_payload(payload: bytes):
+    """-> (commit_ts, mutations, wall) or None for unknown format."""
+    if not payload.startswith(_MAGIC):
+        return None
+    commit_ts, wall, nmut = struct.unpack_from("<Qd I", payload, 4)
+    pos = 4 + struct.calcsize("<Qd I")
+    muts = []
+    for _ in range(nmut):
+        (klen,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        key = payload[pos:pos + klen]
+        pos += klen
+        (vlen,) = struct.unpack_from("<i", payload, pos)
+        pos += 4
+        if vlen < 0:
+            muts.append((key, None))
+        else:
+            muts.append((key, payload[pos:pos + vlen]))
+            pos += vlen
+    return commit_ts, muts, wall
+
+
+def encode_checkpoint(ts: int, triples) -> bytes:
+    """triples: [(version_ts, key, value|None)] -> bytes (magic CKP2)."""
+    out = [_CKPT_MAGIC, struct.pack("<QQ", ts, len(triples))]
+    for vts, key, value in triples:
+        out.append(struct.pack("<QI", vts, len(key)))
+        out.append(bytes(key))
+        if value is None:
+            out.append(struct.pack("<i", -1))
+        else:
+            out.append(struct.pack("<i", len(value)))
+            out.append(bytes(value))
+    return b"".join(out)
+
+
+def decode_checkpoint(data: bytes):
+    """-> (ts, triples). Raises ValueError on unknown format (legacy
+    pickle checkpoints are refused — pickle from disk is code
+    execution)."""
+    if not data.startswith(_CKPT_MAGIC):
+        raise ValueError(
+            "unrecognized checkpoint format (legacy/foreign snapshot); "
+            "re-create with ADMIN CHECKPOINT")
+    ts, n = struct.unpack_from("<QQ", data, 4)
+    pos = 4 + 16
+    triples = []
+    for _ in range(n):
+        vts, klen = struct.unpack_from("<QI", data, pos)
+        pos += 12
+        key = data[pos:pos + klen]
+        pos += klen
+        (vlen,) = struct.unpack_from("<i", data, pos)
+        pos += 4
+        if vlen < 0:
+            triples.append((vts, key, None))
+        else:
+            triples.append((vts, key, data[pos:pos + vlen]))
+            pos += vlen
+    return ts, triples
 
 
 class WalWriter:
@@ -27,8 +111,7 @@ class WalWriter:
 
     def append(self, commit_ts: int, mutations: list):
         import time
-        payload = pickle.dumps((commit_ts, mutations, time.time()),
-                               protocol=pickle.HIGHEST_PROTOCOL)
+        payload = encode_frame_payload(commit_ts, mutations, time.time())
         frame = struct.pack("<II", len(payload),
                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
         self._f.write(frame)
@@ -44,7 +127,11 @@ class WalWriter:
 
 
 def replay(path: str):
-    """Yield (commit_ts, mutations) frames; stop at a torn/corrupt tail."""
+    """Yield (commit_ts, mutations, wall) frames; stop at a torn/corrupt
+    tail (short read or crc mismatch). A crc-VALID frame in an unknown
+    format is a legacy/foreign WAL and raises — silently dropping it
+    would lose every commit in the file and let new frames be appended
+    after unreadable ones."""
     if not os.path.exists(path):
         return
     with open(path, "rb") as f:
@@ -57,6 +144,9 @@ def replay(path: str):
             if len(payload) < ln or \
                     (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                 return
-            rec = pickle.loads(payload)
-            # v1 frames had no wallclock; normalize to 3-tuples
-            yield rec if len(rec) == 3 else (rec[0], rec[1], 0.0)
+            rec = decode_frame_payload(payload)
+            if rec is None:
+                raise ValueError(
+                    "unrecognized WAL frame format (legacy/foreign WAL "
+                    "at %s); migrate or remove the file" % path)
+            yield rec

@@ -34,6 +34,11 @@ def backup(domain, db_name: str, path: str) -> int:
             manifest = json.load(f)
     done = set(tuple(x) for x in manifest.get("done", []))
     manifest["dbs"] = [{"name": d.name} for d in dbs]
+    # one backup_ts for the whole run: every table filters to versions
+    # visible at this ts, so concurrent writes can't produce a backup
+    # where table A and table B reflect different moments
+    backup_ts = manifest.get("backup_ts") or domain.storage.current_ts()
+    manifest["backup_ts"] = backup_ts
     tables_meta = []
     count = 0
     for d in dbs:
@@ -42,7 +47,7 @@ def backup(domain, db_name: str, path: str) -> int:
             key = (d.name, t.name)
             if key in [tuple(k) for k in done]:
                 continue
-            _backup_table(domain, d.name, t, path)
+            _backup_table(domain, d.name, t, path, backup_ts)
             manifest.setdefault("done", []).append([d.name, t.name])
             count += 1
             manifest["tables"] = tables_meta
@@ -54,21 +59,24 @@ def backup(domain, db_name: str, path: str) -> int:
     return count
 
 
-def _backup_table(domain, db_name, t, path):
+def _backup_table(domain, db_name, t, path, backup_ts=None):
     ctab = domain.columnar.tables.get(t.id)
     base = os.path.join(path, f"{db_name}.{t.name}")
     arrays = {}
     dicts = {}
     if ctab is not None and ctab.n:
-        n = ctab.n
-        arrays["__handles"] = ctab.handles[:n]
-        arrays["__insert_ts"] = ctab.insert_ts[:n]
-        arrays["__delete_ts"] = ctab.delete_ts[:n]
-        for ci in t.columns:
-            arrays[f"d_{ci.id}"] = ctab.data[ci.id][:n]
-            arrays[f"n_{ci.id}"] = ctab.nulls[ci.id][:n]
-            if ci.id in ctab.dicts:
-                dicts[str(ci.id)] = ctab.dicts[ci.id].values
+        # hold the apply lock so a concurrent commit can't interleave
+        # a half-applied mutation batch into the captured arrays
+        with domain.columnar._apply_mu:
+            idx = np.nonzero(ctab.valid_at(backup_ts))[0]
+            arrays["__handles"] = ctab.handles[idx].copy()
+            arrays["__insert_ts"] = ctab.insert_ts[idx].copy()
+            arrays["__delete_ts"] = np.zeros(len(idx), dtype=np.int64)
+            for ci in t.columns:
+                arrays[f"d_{ci.id}"] = ctab.data[ci.id][idx].copy()
+                arrays[f"n_{ci.id}"] = ctab.nulls[ci.id][idx].copy()
+                if ci.id in ctab.dicts:
+                    dicts[str(ci.id)] = list(ctab.dicts[ci.id].values)
     np.savez_compressed(base + ".npz", **arrays)
     with open(base + ".dicts.json", "w") as f:
         json.dump(dicts, f)
@@ -118,8 +126,12 @@ def restore(domain, db_name: str, path: str) -> int:
             ctab.n = n
             ctab.handle_pos = {int(h): i
                                for i, h in enumerate(z["__handles"].tolist())}
+            # restored rows have no row/index KV backing — flag them so
+            # index-driven read paths aren't chosen for this table
+            ctab.bulk_rows = n
             ctab.version += 1
         count += 1
+    domain.invalidate_plan_cache()
     return count
 
 
@@ -184,8 +196,8 @@ def backup_log(domain, path: str) -> int:
 def restore_pitr(domain, path: str, until_wall: float) -> int:
     """Replay the log backup into `domain` up to `until_wall` (intended
     for a fresh store — the reference restores PITR into a new cluster)."""
-    import pickle
     from ..errors import TiDBError
+    from ..storage.wal import decode_checkpoint
     dst = os.path.join(path, "log")
     meta_path = os.path.join(dst, "pitr_meta.json")
     meta = {}
@@ -199,7 +211,7 @@ def restore_pitr(domain, path: str, until_wall: float) -> int:
             raise TiDBError(
                 "PITR target predates the checkpoint in this log backup")
         with open(ckpt, "rb") as f:
-            ckpt_ts, triples = pickle.load(f)
+            ckpt_ts, triples = decode_checkpoint(f.read())
         triples.sort(key=lambda t: t[0])
         i = 0
         while i < len(triples):
@@ -212,10 +224,12 @@ def restore_pitr(domain, path: str, until_wall: float) -> int:
             domain.storage.mvcc.apply_replay(ts, muts)
             applied += 1
     from ..storage.wal import replay as _replay
+    # skip (not break on) out-of-range frames: commit wallclocks are not
+    # guaranteed monotonic, so a later frame may still precede the target
     for commit_ts, mutations, wall in _replay(
             os.path.join(dst, "commit.wal")):
-        if wall and wall > until_wall:
-            break
+        if wall > until_wall:
+            continue
         domain.storage.oracle.fast_forward(commit_ts)
         domain.storage.mvcc.apply_replay(commit_ts, mutations)
         applied += 1
