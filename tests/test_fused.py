@@ -1,0 +1,139 @@
+"""Fused scan->join->agg pipeline (copr/pipeline.py): routing, parity
+with the conventional HashJoin subtree, and runtime fallbacks."""
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+import tidb_tpu.planner.physical as pp
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table dim_a (id int primary key, grp int, "
+                 "name varchar(16), val int)")
+    tk.must_exec("create table dim_b (id int primary key, tag varchar(8))")
+    tk.must_exec("create table fact (k int primary key, a_id int, "
+                 "b_id int, amt decimal(10,2), q int)")
+    rng = np.random.RandomState(3)
+    rows = []
+    for i in range(1, 41):
+        rows.append(f"({i}, {i % 7}, 'n{i % 5}', {i * 3})")
+    tk.must_exec("insert into dim_a values " + ",".join(rows))
+    rows = [f"({i}, 't{i % 3}')" for i in range(1, 21)]
+    tk.must_exec("insert into dim_b values " + ",".join(rows))
+    rows = []
+    for i in range(1, 501):
+        a = rng.randint(1, 45)       # some misses -> inner join drops
+        b = rng.randint(1, 21)
+        rows.append(f"({i}, {a}, {b}, {rng.randint(1, 9999) / 100.0}, "
+                    f"{rng.randint(0, 50)})")
+    tk.must_exec("insert into fact values " + ",".join(rows))
+    return tk
+
+
+Q = ("select dim_a.grp, sum(fact.amt), count(*), min(fact.q) "
+     "from fact, dim_a, dim_b "
+     "where fact.a_id = dim_a.id and fact.b_id = dim_b.id "
+     "and fact.q < 40 and dim_b.tag <> 't2' "
+     "group by dim_a.grp order by dim_a.grp")
+
+Q_POS = ("select fact.a_id, dim_a.name, sum(fact.q) "
+         "from fact, dim_a where fact.a_id = dim_a.id "
+         "group by fact.a_id, dim_a.name order by fact.a_id")
+
+
+def _conventional(tk, sql):
+    orig = pp._try_fuse_agg
+    pp._try_fuse_agg = lambda *a, **k: None
+    tk.domain.invalidate_plan_cache()
+    try:
+        return tk.must_query(sql).rs.rows
+    finally:
+        pp._try_fuse_agg = orig
+        tk.domain.invalidate_plan_cache()
+
+
+def test_fused_routed_and_matches(tk):
+    plan = tk.must_query("explain " + Q).rs.rows
+    assert any("FusedPipeline" in r[0] for r in plan), plan
+    before = tk.domain.metrics.get("fused_pipeline_hit", 0)
+    got = tk.must_query(Q).rs.rows
+    assert tk.domain.metrics.get("fused_pipeline_hit", 0) == before + 1
+    assert got == _conventional(tk, Q)
+
+
+def test_fused_position_dense_group_matches(tk):
+    """Group by FK + dependent dim column -> position-dense agg path."""
+    got = tk.must_query(Q_POS).rs.rows
+    assert got == _conventional(tk, Q_POS)
+    assert len(got) > 30
+
+
+def test_fused_dirty_txn_falls_back(tk):
+    tk.must_exec("begin")
+    tk.must_exec("insert into fact values (1001, 1, 1, 5.00, 1)")
+    before = tk.domain.metrics.get("fused_pipeline_fallback", 0)
+    got = tk.must_query(Q_POS).rs.rows
+    assert tk.domain.metrics.get("fused_pipeline_fallback", 0) == before + 1
+    tk.must_exec("rollback")
+    base = tk.must_query(Q_POS).rs.rows
+    # the uncommitted row contributed to group a_id=1
+    g1_dirty = next(r for r in got if r[0] == 1)
+    g1_base = next(r for r in base if r[0] == 1)
+    assert int(g1_dirty[2]) == int(g1_base[2]) + 1
+
+
+def test_fused_nonunique_dim_falls_back(tk):
+    """Join keyed on a NON-unique dim column must not use the fused
+    probe (planner prefers unique, but a query can force it)."""
+    sql = ("select sum(fact.q) from fact, dim_a "
+           "where fact.a_id = dim_a.grp")
+    got = tk.must_query(sql).rs.rows
+    assert got == _conventional(tk, sql)
+
+
+def test_fused_empty_dim(tk):
+    tk.must_exec("create table dim_empty (id int primary key, x int)")
+    sql = ("select count(*), sum(fact.q) from fact, dim_empty "
+           "where fact.b_id = dim_empty.id")
+    got = tk.must_query(sql).rs.rows
+    assert got[0][0] == 0
+
+
+def test_fused_null_probe_rows_drop(tk):
+    """NULL FK values must not match any dim row (inner join)."""
+    tk.must_exec("create table f2 (k int primary key, a_id int, v int)")
+    tk.must_exec("insert into f2 values (1, 1, 10), (2, null, 20), "
+                 "(3, 2, 30), (4, null, 40)")
+    sql = ("select sum(f2.v) from f2, dim_a where f2.a_id = dim_a.id")
+    got = tk.must_query(sql).rs.rows
+    assert got == _conventional(tk, sql)
+    assert int(got[0][0]) == 40
+
+
+def test_fused_sees_dim_updates(tk):
+    """Fused path must see committed dim mutations (version-keyed caches
+    invalidate on write) and must STAY on the fused path: MVCC keeps the
+    old version row, which must not read as a duplicate key."""
+    sql = "select sum(dim_a.val) from fact, dim_a where fact.a_id = dim_a.id"
+    before = tk.must_query(sql).rs.rows
+    tk.must_exec("update dim_a set val = val + 1000 where id = 1")
+    hits = tk.domain.metrics.get("fused_pipeline_hit", 0)
+    got = tk.must_query(sql).rs.rows
+    assert tk.domain.metrics.get("fused_pipeline_hit", 0) == hits + 1
+    assert got == _conventional(tk, sql)
+    assert int(got[0][0]) > int(before[0][0])
+
+
+def test_fused_dim_insert_invalidates_kernel(tk):
+    """New dim rows after a cached kernel must join (kernel cache keys
+    include dim row counts)."""
+    sql = ("select count(*) from fact, dim_a where fact.a_id = dim_a.id")
+    n1 = int(tk.must_query(sql).rs.rows[0][0])
+    # fact rows reference a_id up to 44; dim_a has 1..40 -> add 41..44
+    tk.must_exec("insert into dim_a values (41, 1, 'x', 1), "
+                 "(42, 2, 'y', 2), (43, 3, 'z', 3), (44, 4, 'w', 4)")
+    n2 = int(tk.must_query(sql).rs.rows[0][0])
+    assert n2 > n1
+    assert n2 == int(_conventional(tk, sql)[0][0])

@@ -46,6 +46,9 @@ class CoprExecutor:
         self._dev_cache_order: list = []
         self._dev_cache_bytes = 0
         self._dev_cache_budget = dev_cache_bytes
+        # host-side per-version metadata: dim sort orders, learned group
+        # bucket sizes (so the regrow loop doesn't re-run every query)
+        self._host_cache: dict = {}
 
     def _dev_put(self, key, arr_np, pad_fill=0):
         """Upload (padded) with LRU caching; returns the device array."""
@@ -530,6 +533,10 @@ class CoprExecutor:
     def _run_agg_partition(self, dag, tbl, cols, v, m, cap,
                            group_bucket=1024):
         """Device partial aggregation; returns PartialAggResult."""
+        gbkey = ("gb", tbl.uid,
+                 tuple(g.fingerprint() for g in dag.group_items),
+                 tuple(a.fingerprint() for a in dag.aggs))
+        group_bucket = max(group_bucket, self._host_cache.get(gbkey, 0))
         while True:
             kd, sd = capture_agg_dicts(dag, cols)
             # dense fast path: group keys span a small combined domain
@@ -564,6 +571,7 @@ class CoprExecutor:
             ngroups = int(res["ngroups"])
             if ngroups > group_bucket:
                 group_bucket = shape_bucket(ngroups)
+                self._host_cache[gbkey] = group_bucket
                 continue
             return PartialAggResult(
                 ngroups=ngroups,
@@ -693,14 +701,78 @@ def _dense_strides(dag, key_dicts, cols=None, n=0):
     return layout
 
 
+def dense_agg_body(ctx, mask, group_items, aggs, sizes, cap):
+    """Dense scatter-add partial agg over an eval ctx + row mask: direct
+    segment ops into the dense key-product table. Shared by the copr
+    reader kernel and the fused scan-join-agg pipeline kernel."""
+    nslots = 1
+    for s, _off in sizes:
+        nslots *= s
+    slot = jnp.zeros(cap, dtype=jnp.int64)
+    for g, (size, off) in zip(group_items, sizes):
+        d, nl, _ = eval_expr(ctx, g)
+        if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+            d = jnp.full(cap, d)
+        nm = materialize_nulls(ctx, nl)
+        code = jnp.clip(jnp.where(nm, 0, d.astype(jnp.int64) - off + 1),
+                        0, size - 1)
+        slot = slot * size + code
+    slot = jnp.where(mask, slot, nslots)      # invalid rows -> spill slot
+    return dense_agg_states(ctx, mask, aggs, slot, nslots, cap)
+
+
+def dense_agg_states(ctx, mask, aggs, slot, nslots, cap):
+    """Scatter the agg states into a precomputed dense slot array (slot
+    == nslots means masked-out). Used with key-product slots and with
+    join-POSITION slots (group-by-FK in the fused pipeline)."""
+    states = []
+    for a in aggs:
+        if a.args:
+            d, nl, _ = eval_expr(ctx, a.args[0])
+            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                d = jnp.full(cap, d)
+            nm = materialize_nulls(ctx, nl)
+            row_ok = mask & ~nm
+        else:
+            d = jnp.ones(cap, dtype=jnp.int64)
+            row_ok = mask
+        cnt = jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
+                                  num_segments=nslots + 1)[:nslots]
+        if a.name == "count":
+            states.append([cnt])
+        elif a.name in ("sum", "avg"):
+            s = jax.ops.segment_sum(jnp.where(row_ok, d, 0), slot,
+                                    num_segments=nslots + 1)[:nslots]
+            states.append([s, cnt])
+        elif a.name == "min":
+            big = (jnp.asarray(np.inf) if d.dtype.kind == "f"
+                   else jnp.asarray(_I64_MAX)).astype(d.dtype)
+            s = jax.ops.segment_min(jnp.where(row_ok, d, big), slot,
+                                    num_segments=nslots + 1)[:nslots]
+            states.append([s, cnt])
+        elif a.name == "max":
+            small = (jnp.asarray(-np.inf) if d.dtype.kind == "f"
+                     else jnp.asarray(-_I64_MAX)).astype(d.dtype)
+            s = jax.ops.segment_max(jnp.where(row_ok, d, small), slot,
+                                    num_segments=nslots + 1)[:nslots]
+            states.append([s, cnt])
+        elif a.name == "first_row":
+            fi = jax.ops.segment_min(
+                jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
+                num_segments=nslots + 1)[:nslots]
+            states.append([d[jnp.minimum(fi, cap - 1)], cnt])
+        else:
+            raise NotImplementedError(a.name)
+    present = jax.ops.segment_sum(mask.astype(jnp.int64), slot,
+                                  num_segments=nslots + 1)[:nslots]
+    return {"present": present, "states": states}
+
+
 def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
     """Partial agg via direct scatter-add into the dense key-product table."""
     sdicts = {k: c[2] for k, c in sample_cols.items()}
     group_items = list(dag.group_items)
     aggs = list(dag.aggs)
-    nslots = 1
-    for s, _off in sizes:
-        nslots *= s
 
     @jax.jit
     def kern(jc, vv):
@@ -709,57 +781,7 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
         mask = vv
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
-        slot = jnp.zeros(cap, dtype=jnp.int64)
-        for g, (size, off) in zip(group_items, sizes):
-            d, nl, _ = eval_expr(ctx, g)
-            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                d = jnp.full(cap, d)
-            nm = materialize_nulls(ctx, nl)
-            code = jnp.clip(jnp.where(nm, 0, d.astype(jnp.int64) - off + 1),
-                            0, size - 1)
-            slot = slot * size + code
-        slot = jnp.where(mask, slot, nslots)      # invalid rows -> spill slot
-        states = []
-        for a in aggs:
-            if a.args:
-                d, nl, _ = eval_expr(ctx, a.args[0])
-                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                    d = jnp.full(cap, d)
-                nm = materialize_nulls(ctx, nl)
-                row_ok = mask & ~nm
-            else:
-                d = jnp.ones(cap, dtype=jnp.int64)
-                row_ok = mask
-            cnt = jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
-                                      num_segments=nslots + 1)[:nslots]
-            if a.name == "count":
-                states.append([cnt])
-            elif a.name in ("sum", "avg"):
-                s = jax.ops.segment_sum(jnp.where(row_ok, d, 0), slot,
-                                        num_segments=nslots + 1)[:nslots]
-                states.append([s, cnt])
-            elif a.name == "min":
-                big = (jnp.asarray(np.inf) if d.dtype.kind == "f"
-                       else jnp.asarray(_I64_MAX)).astype(d.dtype)
-                s = jax.ops.segment_min(jnp.where(row_ok, d, big), slot,
-                                        num_segments=nslots + 1)[:nslots]
-                states.append([s, cnt])
-            elif a.name == "max":
-                small = (jnp.asarray(-np.inf) if d.dtype.kind == "f"
-                         else jnp.asarray(-_I64_MAX)).astype(d.dtype)
-                s = jax.ops.segment_max(jnp.where(row_ok, d, small), slot,
-                                        num_segments=nslots + 1)[:nslots]
-                states.append([s, cnt])
-            elif a.name == "first_row":
-                fi = jax.ops.segment_min(
-                    jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
-                    num_segments=nslots + 1)[:nslots]
-                states.append([d[jnp.minimum(fi, cap - 1)], cnt])
-            else:
-                raise NotImplementedError(a.name)
-        present = jax.ops.segment_sum(mask.astype(jnp.int64), slot,
-                                      num_segments=nslots + 1)[:nslots]
-        return {"present": present, "states": states}
+        return dense_agg_body(ctx, mask, group_items, aggs, sizes, cap)
     return kern
 
 
@@ -923,32 +945,70 @@ def _build_agg_kernel(dag, sample_cols, cap, group_bucket):
         mask = vv
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
+        return sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket)
+    return kern
 
-        # ---- group keys ----
-        keys = []
-        key_nulls = []
-        for g in group_items:
-            d, nl, sd = eval_expr(ctx, g)
-            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                d = jnp.full(cap, d)
-            d = d.astype(jnp.int64) if d.dtype != jnp.int64 else d
-            nm = materialize_nulls(ctx, nl)
-            keys.append(jnp.where(nm, 0, d))
-            key_nulls.append(nm)
 
-        if not keys:
-            # global aggregation: one group
-            seg = jnp.zeros(cap, dtype=jnp.int64)
-            ngroups = jnp.asarray(1, dtype=jnp.int64)
-            order = jnp.arange(cap)
-            sorted_mask = mask
-            first_idx = jnp.zeros(group_bucket, dtype=jnp.int64)
-        else:
-            # lexsort: last key first (stable)
-            order = jnp.argsort(
-                jnp.where(mask, key_nulls[-1].astype(jnp.int64), 0),
-                stable=True)
-            # build combined ordering via repeated stable sorts
+def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
+    """Sort-based partial agg over an eval ctx + row mask (general group
+    domains). Shared by the copr reader kernel and the fused pipeline.
+
+    Fast path: all group keys packed into ONE int64 sort key using
+    runtime min/max spans (values are data-dependent — fine for XLA;
+    only SHAPES must be static), so grouping costs a single argsort.
+    A compiled lax.cond falls back to stable lexicographic multi-sort
+    when the combined span overflows 62 bits."""
+    # ---- group keys ----
+    keys = []
+    key_nulls = []
+    for g in group_items:
+        d, nl, sd = eval_expr(ctx, g)
+        if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+            d = jnp.full(cap, d)
+        d = d.astype(jnp.int64) if d.dtype != jnp.int64 else d
+        nm = materialize_nulls(ctx, nl)
+        keys.append(jnp.where(nm, 0, d))
+        key_nulls.append(nm)
+
+    if not keys:
+        # global aggregation: one group
+        seg = jnp.zeros(cap, dtype=jnp.int64)
+        ngroups = jnp.asarray(1, dtype=jnp.int64)
+        order = jnp.arange(cap)
+        sorted_mask = mask
+        first_idx = jnp.zeros(group_bucket, dtype=jnp.int64)
+    else:
+        # per-key codes: NULL -> 0, value -> (v - min + 1); span per key
+        codes, spans = [], []
+        fits = jnp.asarray(True)
+        for k, kn in zip(keys, key_nulls):
+            live = jnp.where(mask & ~kn, k, _I64_MAX)
+            lo = jnp.min(live)
+            lo = jnp.where(lo == _I64_MAX, 0, lo)       # no live rows
+            hi = jnp.max(jnp.where(mask & ~kn, k, -_I64_MAX))
+            hi = jnp.where(hi == -_I64_MAX, 0, hi)
+            raw = hi - lo + 2
+            # int64 wraparound (keys near +-2^62) -> raw <= 0: packing
+            # would corrupt codes, force the multisort branch
+            fits = fits & (raw > 0)
+            codes.append(jnp.where(kn, 0, k - lo + 1))
+            spans.append(jnp.maximum(raw, 1))
+        total_bits = jnp.zeros((), dtype=jnp.float64)
+        for s in spans:
+            total_bits = total_bits + jnp.log2(s.astype(jnp.float64))
+        fits = fits & (total_bits < 61.0)
+
+        def packed_order(_):
+            packed = jnp.zeros(cap, dtype=jnp.int64)
+            for c, s in zip(codes, spans):
+                packed = packed * s + c
+            packed = jnp.where(mask, packed, _I64_MAX)
+            order = jnp.argsort(packed, stable=True)
+            sp = packed[order]
+            change = (sp != jnp.roll(sp, 1)).at[0].set(True)
+            return order, change
+
+        def multisort_order(_):
             def sort_by(order, arr):
                 vals = arr[order]
                 idx = jnp.argsort(vals, stable=True)
@@ -957,94 +1017,98 @@ def _build_agg_kernel(dag, sample_cols, cap, group_bucket):
             # sort so invalid rows go last: key = (~mask, keys..., )
             for k, kn in zip(reversed(keys), reversed(key_nulls)):
                 order = sort_by(order, jnp.where(mask, k, _I64_MAX))
-                order = sort_by(order, jnp.where(mask, kn.astype(jnp.int64), 2))
+                order = sort_by(order,
+                                jnp.where(mask, kn.astype(jnp.int64), 2))
             order = sort_by(order, (~mask).astype(jnp.int64))
-            sorted_mask = mask[order]
-            # boundaries
             change = jnp.zeros(cap, dtype=bool)
             for k, kn in zip(keys, key_nulls):
                 sk = jnp.where(mask, k, _I64_MAX)[order]
                 skn = jnp.where(mask, kn.astype(jnp.int64), 2)[order]
-                change = change | (sk != jnp.roll(sk, 1)) | (skn != jnp.roll(skn, 1))
+                change = change | (sk != jnp.roll(sk, 1)) | \
+                    (skn != jnp.roll(skn, 1))
             change = change.at[0].set(True)
-            change = change & sorted_mask
-            seg = jnp.cumsum(change.astype(jnp.int64)) - 1
-            seg = jnp.where(sorted_mask, seg, group_bucket)  # overflow slot
-            ngroups = jnp.max(jnp.where(sorted_mask, seg, -1)) + 1
-            seg = jnp.minimum(seg, group_bucket)   # clamp; detect on host
-            first_idx = jax.ops.segment_min(
-                jnp.arange(cap), seg, num_segments=group_bucket + 1,
-                indices_are_sorted=True)[:group_bucket]
-            first_idx = jnp.minimum(first_idx, cap - 1)
+            return order, change
 
-        out_keys = []
-        out_key_nulls = []
-        if keys:
-            for k, kn in zip(keys, key_nulls):
-                out_keys.append(k[order][first_idx])
-                out_key_nulls.append(kn[order][first_idx])
+        order, change = jax.lax.cond(fits, packed_order, multisort_order,
+                                     operand=None)
+        sorted_mask = mask[order]
+        change = change & sorted_mask
+        seg = jnp.cumsum(change.astype(jnp.int64)) - 1
+        seg = jnp.where(sorted_mask, seg, group_bucket)  # overflow slot
+        ngroups = jnp.max(jnp.where(sorted_mask, seg, -1)) + 1
+        seg = jnp.minimum(seg, group_bucket)   # clamp; detect on host
+        first_idx = jax.ops.segment_min(
+            jnp.arange(cap), seg, num_segments=group_bucket + 1,
+            indices_are_sorted=True)[:group_bucket]
+        first_idx = jnp.minimum(first_idx, cap - 1)
 
-        # ---- agg states ----
-        states = []
-        for a in aggs:
-            if a.args:
-                d, nl, sd = eval_expr(ctx, a.args[0])
-                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                    d = jnp.full(cap, d)
-                nm = materialize_nulls(ctx, nl)
-                dv = d[order] if keys else d
-                nv = nm[order] if keys else nm
-                row_ok = sorted_mask & ~nv
-            else:   # count(*)
-                dv = jnp.ones(cap, dtype=jnp.int64)
-                row_ok = sorted_mask
-            segN = group_bucket + 1
-            if a.name == "count":
-                st = [jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
-                                          num_segments=segN,
-                                          indices_are_sorted=True)[:group_bucket]]
-            elif a.name in ("sum", "avg", "first_row"):
-                zero = jnp.zeros((), dtype=dv.dtype)
-                vals = jnp.where(row_ok, dv, zero)
-                s = jax.ops.segment_sum(vals, seg, num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
-                                        num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                if a.name == "first_row":
-                    fi = jax.ops.segment_min(
-                        jnp.where(row_ok, jnp.arange(cap), cap - 1), seg,
-                        num_segments=segN,
-                        indices_are_sorted=True)[:group_bucket]
-                    st = [dv[jnp.minimum(fi, cap - 1)], c]
-                else:
-                    st = [s, c]
-            elif a.name == "min":
-                big = (jnp.asarray(np.float64(np.inf))
-                       if dv.dtype.kind == "f" else jnp.asarray(_I64_MAX))
-                vals = jnp.where(row_ok, dv, big.astype(dv.dtype))
-                s = jax.ops.segment_min(vals, seg, num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
-                                        num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                st = [s, c]
-            elif a.name == "max":
-                small = (jnp.asarray(np.float64(-np.inf))
-                         if dv.dtype.kind == "f" else jnp.asarray(-_I64_MAX))
-                vals = jnp.where(row_ok, dv, small.astype(dv.dtype))
-                s = jax.ops.segment_max(vals, seg, num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
-                                        num_segments=segN,
-                                        indices_are_sorted=True)[:group_bucket]
-                st = [s, c]
+    out_keys = []
+    out_key_nulls = []
+    if keys:
+        for k, kn in zip(keys, key_nulls):
+            out_keys.append(k[order][first_idx])
+            out_key_nulls.append(kn[order][first_idx])
+
+    # ---- agg states ----
+    states = []
+    for a in aggs:
+        if a.args:
+            d, nl, sd = eval_expr(ctx, a.args[0])
+            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                d = jnp.full(cap, d)
+            nm = materialize_nulls(ctx, nl)
+            dv = d[order] if keys else d
+            nv = nm[order] if keys else nm
+            row_ok = sorted_mask & ~nv
+        else:   # count(*)
+            dv = jnp.ones(cap, dtype=jnp.int64)
+            row_ok = sorted_mask
+        segN = group_bucket + 1
+        if a.name == "count":
+            st = [jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
+                                      num_segments=segN,
+                                      indices_are_sorted=True)[:group_bucket]]
+        elif a.name in ("sum", "avg", "first_row"):
+            zero = jnp.zeros((), dtype=dv.dtype)
+            vals = jnp.where(row_ok, dv, zero)
+            s = jax.ops.segment_sum(vals, seg, num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
+                                    num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            if a.name == "first_row":
+                fi = jax.ops.segment_min(
+                    jnp.where(row_ok, jnp.arange(cap), cap - 1), seg,
+                    num_segments=segN,
+                    indices_are_sorted=True)[:group_bucket]
+                st = [dv[jnp.minimum(fi, cap - 1)], c]
             else:
-                raise NotImplementedError(a.name)
-            states.append(st)
-        return {"ngroups": ngroups, "keys": out_keys,
-                "key_nulls": out_key_nulls, "states": states}
-    return kern
+                st = [s, c]
+        elif a.name == "min":
+            big = (jnp.asarray(np.float64(np.inf))
+                   if dv.dtype.kind == "f" else jnp.asarray(_I64_MAX))
+            vals = jnp.where(row_ok, dv, big.astype(dv.dtype))
+            s = jax.ops.segment_min(vals, seg, num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
+                                    num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            st = [s, c]
+        elif a.name == "max":
+            small = (jnp.asarray(np.float64(-np.inf))
+                     if dv.dtype.kind == "f" else jnp.asarray(-_I64_MAX))
+            vals = jnp.where(row_ok, dv, small.astype(dv.dtype))
+            s = jax.ops.segment_max(vals, seg, num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            c = jax.ops.segment_sum(row_ok.astype(jnp.int64), seg,
+                                    num_segments=segN,
+                                    indices_are_sorted=True)[:group_bucket]
+            st = [s, c]
+        else:
+            raise NotImplementedError(a.name)
+        states.append(st)
+    return {"ngroups": ngroups, "keys": out_keys,
+            "key_nulls": out_key_nulls, "states": states}
 
 
 def _host_partial_agg(ctx, dag, valid):

@@ -32,6 +32,10 @@ def _build(ctx, plan):
         return BatchPointGetExec(ctx, plan)
     if isinstance(plan, PhysTableReader):
         return TableReaderExec(ctx, plan)
+    from ..planner.physical import PhysFusedPipeline
+    if isinstance(plan, PhysFusedPipeline):
+        from .executors import FusedPipelineExec
+        return FusedPipelineExec(ctx, plan)
     if isinstance(plan, PhysSelection):
         return SelectionExec(ctx, plan, build_executor(ctx, plan.child))
     if isinstance(plan, PhysProjection):

@@ -116,6 +116,69 @@ class TableReaderExec(Executor):
         return out
 
 
+class FusedPipelineExec(Executor):
+    """Drives a PhysFusedPipeline: the whole scan->join->agg subtree as
+    one device kernel per fact partition (copr/pipeline.py). Falls back
+    to the conventional HashJoin subtree (plan.fallback) + a host partial
+    agg when runtime eligibility fails — dirty transactions, non-unique/
+    NULL build keys, device errors — so results are always correct."""
+
+    def __init__(self, ctx, plan):
+        super().__init__(ctx, plan.schema)
+        self.plan = plan
+
+    def open(self):
+        pass
+
+    def next(self):
+        raise RuntimeError("fused pipeline must be driven by HashAgg")
+
+    def _any_dirty(self):
+        sess = self.ctx.sess
+        txn = getattr(sess, "_txn", None)
+        if txn is None or txn.committed or txn.aborted or not txn.is_dirty():
+            return False
+        from ..codec.tablecodec import record_prefix
+        tables = [self.plan.fact_dag.table_info] + \
+            [d.dag.table_info for d in self.plan.dims]
+        for t in tables:
+            pref = record_prefix(t.id)
+            for _k, _v in txn.mem_buffer.scan(pref, pref + b"\xff" * 9):
+                return True
+        return False
+
+    def partials(self):
+        sess = self.ctx.sess
+        if not self._any_dirty():
+            from ..copr.pipeline import fused_partials
+            try:
+                res = fused_partials(self.ctx.copr, self.plan,
+                                     self.ctx.read_ts())
+                if res is not None:
+                    sess.domain.inc_metric("fused_pipeline_hit")
+                    return res
+            except Exception:           # noqa: BLE001
+                sess.domain.inc_metric("fused_pipeline_error")
+        sess.domain.inc_metric("fused_pipeline_fallback")
+        return self._fallback_partials()
+
+    def _fallback_partials(self):
+        from .builder import build_executor
+        from ..copr.dag_exec import _host_partial_agg
+        from ..copr.pipeline import _AggShim
+        fb = build_executor(self.ctx, self.plan.fallback)
+        shim = _AggShim(self.plan.group_items, self.plan.aggs)
+        out = []
+        for chunk in fb.all_chunks():        # partial-agg per chunk: no
+            if not len(chunk):               # full-join materialization
+                continue
+            cols = bind_chunk(self.plan.fallback.schema, chunk)
+            ectx = EvalCtx(np, len(chunk), cols, host=True)
+            out.append(_host_partial_agg(
+                ectx, shim, np.ones(len(chunk), dtype=bool)))
+        return out
+
+
 class BatchPointGetExec(Executor):
     """Vectorized multi-handle lookup via the columnar handle index."""
 

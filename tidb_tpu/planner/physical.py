@@ -11,6 +11,7 @@ from dataclasses import dataclass, field
 
 from ..expression import Expression, Column, Constant, ScalarFunc, AggDesc
 from ..expression.vec import is_device_safe
+from ..types.field_type import new_bigint_type
 from .schema import Schema, SchemaCol
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
                       Aggregation, LJoin, Sort, LimitOp, TopN, Dual, UnionOp,
@@ -75,6 +76,57 @@ class PhysTableReader(PhysPlan):
         if self.dag.aggs:
             s += (f", partial_agg:[{', '.join(map(repr, self.dag.aggs))}] "
                   f"group:[{', '.join(map(repr, self.dag.group_items))}]")
+        return s
+
+
+@dataclass
+class DimJoin:
+    """One dimension join stage of a fused pipeline: probe the (sorted)
+    build-key column of `dag`'s table with `probe_expr` evaluated over the
+    pipeline columns; gather payload columns on match."""
+
+    dag: object = None          # CoprDAG: dim scan cols + device filters
+    build_key: object = None    # SchemaCol in dag.cols — must be unique
+    probe_expr: object = None   # Expression over pipeline columns
+    join_type: str = "inner"    # inner | semi
+
+
+class PhysFusedPipeline(PhysPlan):
+    """Whole-query device pipeline: fact scan -> chain of unique-key
+    dimension joins (searchsorted + gather, static shapes at fact
+    cardinality) -> residual filters -> partial aggregation, compiled as
+    ONE jit kernel per fact partition. The TPU-native re-design of the
+    reference's per-operator pipeline (join/hash_join_v2.go:608 build/
+    probe stages + tipb partial agg): instead of streaming chunks
+    between operators through host memory, the whole subtree fuses into
+    a single XLA program; the join "hash table" is the dimension's
+    sorted key column, resident in HBM across queries.
+
+    `fallback` keeps the conventional HashAgg-over-HashJoin subtree: the
+    executor reverts to it when runtime eligibility fails (non-unique or
+    NULL build keys, dirty transaction overlays, partitioned tables)."""
+
+    def __init__(self, fact_dag, dims, post_filters, group_items, aggs,
+                 schema, fallback):
+        super().__init__([], schema)
+        self.fact_dag = fact_dag
+        self.dims = dims
+        self.post_filters = post_filters
+        self.group_items = group_items
+        self.aggs = aggs
+        self.fallback = fallback
+
+    def explain_info(self):
+        dims = ", ".join(
+            f"{d.dag.table_info.name}[{d.build_key.name} = "
+            f"{d.probe_expr!r}]" + ("" if d.join_type == "inner"
+                                    else f" ({d.join_type})")
+            for d in self.dims)
+        s = (f"fact:{self.fact_dag.table_info.name}, dims:[{dims}], "
+             f"group:[{', '.join(map(repr, self.group_items))}], "
+             f"aggs:[{', '.join(map(repr, self.aggs))}]")
+        if self.post_filters:
+            s += f", residual:[{', '.join(map(repr, self.post_filters))}]"
         return s
 
 
@@ -350,6 +402,9 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
             agg.stats_rows = plan.stats_rows
             child.stats_rows = plan.stats_rows
             return agg
+        fused = _try_fuse_agg(plan, child)
+        if fused is not None:
+            return fused
         agg = PhysHashAgg(plan.group_items, plan.aggs, "complete",
                           plan.schema, child)
         agg.stats_rows = plan.stats_rows
@@ -546,6 +601,150 @@ def _absorb_filters(dag: CoprDAG, conds):
             # caller guarantees pruning kept filter cols in ds.used_cols;
             # this is a safety net for directly-absorbed selections
             pass
+
+
+def _collect_join_tree(p, leaves, eqs, filters):
+    """Flatten an inner-join tree into leaves + eq pairs + residual
+    filters; -> False when any node is outside the fusable shape."""
+    if isinstance(p, PhysShell):
+        return _collect_join_tree(p.child, leaves, eqs, filters)
+    if isinstance(p, PhysSelection):
+        filters.extend(p.conds)
+        return _collect_join_tree(p.child, leaves, eqs, filters)
+    if isinstance(p, PhysHashJoin):
+        if p.join_type != "inner" or getattr(p, "null_aware", False):
+            return False
+        eqs.extend(p.eq_conds)
+        filters.extend(p.other_conds)
+        return (_collect_join_tree(p.children[0], leaves, eqs, filters) and
+                _collect_join_tree(p.children[1], leaves, eqs, filters))
+    if isinstance(p, PhysTableReader):
+        dag = p.dag
+        if dag.aggs or dag.topn is not None or dag.limit >= 0 or \
+                dag.host_filters or dag.table_info.partitions or \
+                dag.table_info.id < 0:
+            return False
+        leaves.append(p)
+        return True
+    return False
+
+
+def _is_unique_col(tbl, name):
+    nm = name.lower()
+    if tbl.pk_is_handle and tbl.pk_col_name.lower() == nm:
+        return True
+    for idx in tbl.public_indexes():
+        if (idx.unique or idx.primary) and len(idx.columns) == 1 and \
+                idx.columns[0].lower() == nm:
+            return True
+    return False
+
+
+def _cols_of(expr):
+    s = set()
+    expr.collect_columns(s)
+    return s
+
+
+def _fusable_key_ft(ft):
+    """Join keys the fused pipeline compares as raw int64 (strings would
+    need cross-dictionary translation; floats bitwise-compare unsafely)."""
+    from ..types.field_type import TypeClass as TC
+    return ft.tclass in (TC.INT, TC.UINT, TC.DATE, TC.DATETIME,
+                         TC.TIMESTAMP, TC.DURATION)
+
+
+def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
+    """Aggregation over an inner-join tree of plain table scans ->
+    PhysHashAgg(final) over a PhysFusedPipeline, when every expression is
+    device-safe and every join can be oriented as probe(pipeline) ->
+    build(bare int column of an unused scan). The conventional subtree is
+    kept as the runtime fallback."""
+    for a in plan.aggs:
+        if a.name not in _PUSHABLE_AGGS or a.distinct:
+            return None
+        if not all(is_device_safe(arg) for arg in a.args):
+            return None
+    for g in plan.group_items:
+        if not is_device_safe(g):
+            return None
+    leaves, eqs, filters = [], [], []
+    if not _collect_join_tree(child, leaves, eqs, filters) or \
+            len(leaves) < 2 or not eqs:
+        return None
+    for f in filters:
+        if not is_device_safe(f):
+            return None
+    owner = {}                      # col idx -> leaf reader
+    for leaf in leaves:
+        for sc in leaf.dag.cols:
+            owner[sc.col.idx] = leaf
+    fact = max(leaves, key=lambda p: p.stats_rows)
+    pipe = {sc.col.idx for sc in fact.dag.cols}
+    used = {id(fact)}
+    dims = []
+    post = []
+    remaining = list(eqs)
+    ft_i64 = new_bigint_type()
+
+    def try_join(l, r, unique_only):
+        for b, pexp in ((l, r), (r, l)):
+            if not isinstance(b, Column):
+                continue
+            leaf = owner.get(b.idx)
+            if leaf is None or id(leaf) in used:
+                continue
+            if not (_cols_of(pexp) <= pipe and is_device_safe(pexp)):
+                continue
+            if not (_fusable_key_ft(b.ft) and _fusable_key_ft(pexp.ft)):
+                continue
+            sc = next(s for s in leaf.dag.cols if s.col.idx == b.idx)
+            if unique_only and not _is_unique_col(leaf.dag.table_info,
+                                                  sc.name):
+                continue
+            dims.append(DimJoin(leaf.dag, sc, pexp, "inner"))
+            used.add(id(leaf))
+            pipe.update(s.col.idx for s in leaf.dag.cols)
+            return True
+        return False
+
+    progress = True
+    while remaining and progress:
+        progress = False
+        for unique_only in (True, False):
+            nxt = []
+            for l, r in remaining:
+                if _cols_of(l) <= pipe and _cols_of(r) <= pipe:
+                    if not (is_device_safe(l) and is_device_safe(r)):
+                        return None
+                    post.append(ScalarFunc("=", [l, r], ft_i64))
+                    progress = True
+                elif try_join(l, r, unique_only):
+                    progress = True
+                else:
+                    nxt.append((l, r))
+            remaining = nxt
+            if progress:
+                break                # re-prefer unique keys next round
+    if remaining or len(used) != len(leaves):
+        return None
+    for f in filters:
+        if not (_cols_of(f) <= pipe):
+            return None
+    post.extend(filters)
+    for e in list(plan.group_items) + [a0 for a in plan.aggs
+                                       for a0 in a.args]:
+        if not (_cols_of(e) <= pipe):
+            return None
+    fused = PhysFusedPipeline(fact.dag, dims, post,
+                              list(plan.group_items),
+                              [_to_partial(a) for a in plan.aggs],
+                              plan.schema, child)
+    fused.stats_rows = plan.stats_rows
+    agg = PhysHashAgg(plan.group_items, plan.aggs, "final", plan.schema,
+                      fused)
+    agg.stats_rows = plan.stats_rows
+    return agg
 
 
 def _can_push_agg(agg: Aggregation, reader: PhysTableReader) -> bool:
