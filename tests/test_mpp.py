@@ -48,3 +48,73 @@ def test_mpp_grouped_with_filters(tk):
     got = tk.must_query(q).rows
     assert got == want
     assert len(got) > 0
+
+
+@needs_mesh
+def test_fragment_plan_explain(tk):
+    """EXPLAIN shows Fragment/Exchange nodes when MPP is on
+    (reference fragment.go:49,78 — PassThrough + Broadcast types)."""
+    tk.must_exec("create table fx_d (id int primary key, g varchar(8))")
+    tk.must_exec("insert into fx_d values (1,'a'),(2,'b'),(3,'c')")
+    tk.must_exec("create table fx_f (k int primary key, d int, v int)")
+    tk.must_exec("insert into fx_f values (1,1,10),(2,2,20),(3,3,30),"
+                  "(4,1,40)")
+    rows = tk.must_query(
+        "explain select fx_d.g, sum(fx_f.v) from fx_f, fx_d "
+        "where fx_f.d = fx_d.id group by fx_d.g").rs.rows
+    txt = "\n".join(r[0] + "\t" + r[2] for r in rows)
+    assert "ExchangeSender" in txt and "ExchangeReceiver" in txt
+    assert "PassThrough" in txt and "Broadcast" in txt
+    assert "FusedPipeline" in txt
+
+
+@needs_mesh
+def test_fused_mpp_matches_single_chip(tk):
+    """Join+group-by through the fused pipeline on the 8-device mesh
+    equals the single-chip result."""
+    import numpy as np
+    tk.must_exec("create table md (id int primary key, g varchar(8), "
+                  "w int)")
+    rows = ",".join(f"({i}, 'g{i % 5}', {i % 11})" for i in range(1, 301))
+    tk.must_exec(f"insert into md values {rows}")
+    tk.must_exec("create table mf (k int primary key, d int, v int)")
+    rng = np.random.RandomState(9)
+    rows = ",".join(f"({i}, {rng.randint(1, 340)}, {rng.randint(0, 50)})"
+                    for i in range(1, 2001))
+    tk.must_exec(f"insert into mf values {rows}")
+    sql = ("select md.g, sum(mf.v), count(*), max(mf.v) from mf, md "
+           "where mf.d = md.id and mf.v > 3 group by md.g order by md.g")
+    tk.must_exec("set tidb_mpp_min_rows = 0")
+    hits = tk.domain.metrics.get("fused_pipeline_mpp_hit", 0)
+    mesh_rows = tk.must_query(sql).rs.rows
+    assert tk.domain.metrics.get("fused_pipeline_mpp_hit", 0) == hits + 1
+    tk.must_exec("set tidb_enable_mpp = 0")
+    single = tk.must_query(sql).rs.rows
+    tk.must_exec("set tidb_enable_mpp = 1")
+    assert mesh_rows == single
+
+
+@needs_mesh
+def test_shuffle_join_from_sql(tk):
+    """A large build side routes over the HASH exchange (all_to_all
+    shuffle) instead of Broadcast, reachable from plain SQL."""
+    import numpy as np
+    tk.must_exec("create table sd (id int primary key, g varchar(8))")
+    rows = ",".join(f"({i}, 'x{i % 4}')" for i in range(1, 1201))
+    tk.must_exec(f"insert into sd values {rows}")
+    tk.must_exec("create table sf (k int primary key, d int, v int)")
+    rng = np.random.RandomState(13)
+    rows = ",".join(f"({i}, {rng.randint(1, 1500)}, {rng.randint(0, 30)})"
+                    for i in range(1, 2501))
+    tk.must_exec(f"insert into sf values {rows}")
+    sql = ("select sd.g, sum(sf.v), count(*) from sf, sd "
+           "where sf.d = sd.id group by sd.g order by sd.g")
+    tk.must_exec("set tidb_mpp_min_rows = 0")
+    base = tk.must_query(sql).rs.rows              # broadcast
+    tk.must_exec("set tidb_broadcast_join_threshold_count = 100")
+    tk.domain.invalidate_plan_cache()
+    n0 = tk.domain.metrics.get("fused_shuffle_join", 0)
+    got = tk.must_query(sql).rs.rows               # hash/shuffle
+    assert tk.domain.metrics.get("fused_shuffle_join", 0) == n0 + 1
+    assert got == base
+    tk.must_exec("set tidb_broadcast_join_threshold_count = 1024000")

@@ -44,6 +44,9 @@ class CoprExecutor:
         # reuse; invalidated by the columnar version counter
         self._dev_cache: dict = {}
         self._dev_cache_order: list = []
+        self._dev_cache_sizes: dict = {}  # key -> charged bytes (a
+        # replicated entry costs size*ndev; evictions must refund what
+        # was charged, not the logical array size)
         self._dev_cache_bytes = 0
         self._dev_cache_budget = dev_cache_bytes
         # host-side per-version metadata: dim sort orders, learned group
@@ -67,10 +70,11 @@ class CoprExecutor:
         while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
                and self._dev_cache_order):
             old = self._dev_cache_order.pop(0)
-            ev = self._dev_cache.pop(old)
-            self._dev_cache_bytes -= ev.size * ev.dtype.itemsize
+            self._dev_cache.pop(old)
+            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
         self._dev_cache[key] = dev
         self._dev_cache_order.append(key)
+        self._dev_cache_sizes[key] = nbytes
         self._dev_cache_bytes += nbytes
         return dev
 
@@ -347,10 +351,38 @@ class CoprExecutor:
         while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
                and self._dev_cache_order):
             old = self._dev_cache_order.pop(0)
-            ev = self._dev_cache.pop(old)
-            self._dev_cache_bytes -= ev.size * ev.dtype.itemsize
+            self._dev_cache.pop(old)
+            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
         self._dev_cache[key] = dev
         self._dev_cache_order.append(key)
+        self._dev_cache_sizes[key] = nbytes
+        self._dev_cache_bytes += nbytes
+        return dev
+
+    def _dev_put_replicated(self, key, arr_np, mesh, cap, pad_fill=0):
+        """Broadcast-exchange upload: the array replicates to every mesh
+        device (NamedSharding with an empty spec)."""
+        hit = self._dev_cache.get(key)
+        if hit is not None:
+            self._dev_cache_order.remove(key)
+            self._dev_cache_order.append(key)
+            return hit
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if len(arr_np) != cap:
+            arr_np = np.concatenate(
+                [arr_np, np.full(cap - len(arr_np), pad_fill,
+                                 dtype=arr_np.dtype)])
+        dev = jax.device_put(arr_np, NamedSharding(mesh, P()))
+        nbytes = dev.size * dev.dtype.itemsize * mesh.devices.size
+        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
+               and self._dev_cache_order):
+            old = self._dev_cache_order.pop(0)
+            self._dev_cache.pop(old)
+            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
+        self._dev_cache[key] = dev
+        self._dev_cache_order.append(key)
+        self._dev_cache_sizes[key] = nbytes
         self._dev_cache_bytes += nbytes
         return dev
 
@@ -785,6 +817,41 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
     return kern
 
 
+def _psum_first(lv, lc, axis):
+    """Exact cross-shard first_row merge: take the value from the FIRST
+    shard (by axis index) that has any rows per slot. (The previous
+    pmax-with-sentinel trick was wrong for values equal to the
+    sentinel.)"""
+    my = jax.lax.axis_index(axis)
+    first = jax.lax.pmin(jnp.where(lc > 0, my, 1 << 30), axis)
+    return jax.lax.psum(
+        jnp.where(my == first, lv, jnp.zeros((), lv.dtype)), axis)
+
+
+def psum_dense_result(res, aggs, axis):
+    """Merge per-shard dense_agg_states outputs with one allreduce per
+    state array (the MPP hash exchange collapsed into psum)."""
+    out = []
+    for a, st in zip(aggs, res["states"]):
+        if a.name == "count":
+            out.append([jax.lax.psum(st[0], axis)])
+        elif a.name in ("sum", "avg"):
+            out.append([jax.lax.psum(st[0], axis),
+                        jax.lax.psum(st[1], axis)])
+        elif a.name == "min":
+            out.append([jax.lax.pmin(st[0], axis),
+                        jax.lax.psum(st[1], axis)])
+        elif a.name == "max":
+            out.append([jax.lax.pmax(st[0], axis),
+                        jax.lax.psum(st[1], axis)])
+        elif a.name == "first_row":
+            out.append([_psum_first(st[0], st[1], axis),
+                        jax.lax.psum(st[1], axis)])
+        else:
+            raise NotImplementedError(a.name)
+    return {"present": jax.lax.psum(res["present"], axis), "states": out}
+
+
 def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
                                 names, has_nulls):
     """The dense partial-agg kernel wrapped in shard_map: each device
@@ -827,68 +894,8 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
                             0, size - 1)
             slot = slot * size + code
         slot = jnp.where(mask, slot, nslots)
-        states = []
-        for a in aggs:
-            if a.args:
-                d, nl, _ = eval_expr(ctx, a.args[0])
-                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                    d = jnp.full(cap, d)
-                nm = materialize_nulls(ctx, nl)
-                row_ok = mask & ~nm
-            else:
-                d = jnp.ones(cap, dtype=jnp.int64)
-                row_ok = mask
-            cnt = jax.lax.psum(
-                jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
-                                    num_segments=nslots + 1)[:nslots], "dp")
-            if a.name == "count":
-                states.append([cnt])
-            elif a.name in ("sum", "avg"):
-                s = jax.lax.psum(
-                    jax.ops.segment_sum(jnp.where(row_ok, d, 0), slot,
-                                        num_segments=nslots + 1)[:nslots],
-                    "dp")
-                states.append([s, cnt])
-            elif a.name == "min":
-                big = (jnp.asarray(np.inf) if d.dtype.kind == "f"
-                       else jnp.asarray(_I64_MAX)).astype(d.dtype)
-                s = jax.lax.pmin(
-                    jax.ops.segment_min(jnp.where(row_ok, d, big), slot,
-                                        num_segments=nslots + 1)[:nslots],
-                    "dp")
-                states.append([s, cnt])
-            elif a.name == "max":
-                small = (jnp.asarray(-np.inf) if d.dtype.kind == "f"
-                         else jnp.asarray(-_I64_MAX)).astype(d.dtype)
-                s = jax.lax.pmax(
-                    jax.ops.segment_max(jnp.where(row_ok, d, small), slot,
-                                        num_segments=nslots + 1)[:nslots],
-                    "dp")
-                states.append([s, cnt])
-            elif a.name == "first_row":
-                fi = jax.lax.pmin(
-                    jax.ops.segment_min(
-                        jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
-                        num_segments=nslots + 1)[:nslots], "dp")
-                # value at the globally-first index of the LOCAL shard is
-                # approximated by the local value (first_row is
-                # order-agnostic per SQL semantics)
-                lv = d[jnp.minimum(
-                    jax.ops.segment_min(
-                        jnp.where(row_ok, jnp.arange(cap), cap - 1), slot,
-                        num_segments=nslots + 1)[:nslots], cap - 1)]
-                lc = jax.ops.segment_sum(row_ok.astype(jnp.int64), slot,
-                                         num_segments=nslots + 1)[:nslots]
-                # pick the value from some shard that has rows: max over
-                # shards of (has_rows, value) pairs via where+pmax on value
-                v = jax.lax.pmax(jnp.where(lc > 0, lv, -_I64_MAX), "dp")
-                states.append([v, cnt])
-            else:
-                raise NotImplementedError(a.name)
-        present = jax.lax.psum(
-            jax.ops.segment_sum(mask.astype(jnp.int64), slot,
-                                num_segments=nslots + 1)[:nslots], "dp")
-        return {"present": present, "states": states}
+        local = dense_agg_states(ctx, mask, aggs, slot, nslots, cap)
+        return psum_dense_result(local, aggs, "dp")
 
     nargs = sum(1 + (1 if has_nulls[k] else 0) for k in names) + 1
     fn = shard_map(frag, mesh=mesh,

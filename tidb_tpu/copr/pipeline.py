@@ -111,42 +111,52 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
     return out
 
 
-def _upload_dim(copr, dim, meta, cap, read_ts):
+def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
     """Pad + upload dim arrays through the HBM buffer pool; -> pytree of
-    device arrays for the kernel plus (has_nulls, sdict) layout info."""
+    device arrays for the kernel plus (has_nulls, sdict) layout info.
+    With a mesh, every array replicates to all devices (the Broadcast
+    exchange of the dim fragment)."""
     tbl = meta["tbl"]
     n = meta["n"]
     ver = tbl.version
+    mk = () if mesh is None else ("bcast", mesh.devices.size)
+
+    def put(tag, arr, length, acap, fill=0, ts_keyed=False):
+        # plain column data depends only on the table version; only the
+        # MVCC-derived arrays (valid mask, lut/sort built over the valid
+        # set) vary with the snapshot ts — keying data by ts would
+        # re-upload every dim column once per transaction
+        key = (tbl.uid, tag, ver, read_ts if ts_keyed else None, length,
+               acap) + mk
+        if mesh is None:
+            return copr._dev_put(key, arr, pad_fill=fill)
+        return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill)
+
     args = {
-        # MVCC visibility depends on the snapshot ts -> part of the key
-        "valid": copr._dev_put((tbl.uid, "valid", ver, read_ts, n, cap),
-                               meta["valid"], pad_fill=False),
+        "valid": put("valid", meta["valid"], n, cap, False, ts_keyed=True),
         "cols": {},
     }
     if meta["mode"] == "direct":
         lcap = shape_bucket(len(meta["lut"]))
-        args["lut"] = copr._dev_put((tbl.uid, "lut", ver, read_ts,
-                                     len(meta["lut"]), lcap),
-                                    meta["lut"], pad_fill=n)
+        args["lut"] = put("lut", meta["lut"], len(meta["lut"]), lcap,
+                          fill=n, ts_keyed=True)
         args["lo"] = jnp.asarray(meta["lo"], dtype=jnp.int64)
     else:
         ns = meta["n_sorted"]
         scap = shape_bucket(ns)
-        args["sk"] = copr._dev_put((tbl.uid, "sk", ver, read_ts, ns, scap),
-                                   meta["skeys"], pad_fill=_I64_MAX)
-        args["ord"] = copr._dev_put((tbl.uid, "ord", ver, read_ts, ns,
-                                     scap), meta["order"])
+        args["sk"] = put("sk", meta["skeys"], ns, scap, fill=_I64_MAX,
+                         ts_keyed=True)
+        args["ord"] = put("ord", meta["order"], ns, scap, ts_keyed=True)
     layout = {}
     for sc in dim.dag.cols:
         cid = _cid_of(dim.dag, sc)
         if cid == -1:
             continue
         data, nulls, sdict = meta["arrays"][cid]
-        jd = copr._dev_put((tbl.uid, cid, ver, "fp", n, cap), data)
+        jd = put(("fp", cid), data, n, cap)
         jn = None
         if nulls is not None:
-            jn = copr._dev_put((tbl.uid, cid, ver, "fpn", n, cap), nulls,
-                               pad_fill=True)
+            jn = put(("fpn", cid), nulls, n, cap, fill=True)
         args["cols"][sc.col.idx] = (jd, jn)
         layout[sc.col.idx] = (nulls is not None, sdict)
     return args, layout
@@ -217,19 +227,19 @@ def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
                             key_dicts=key_dicts, state_dicts=sd)
 
 
-def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
+def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                         dim_sns, dim_layouts, agg_kind, agg_param):
-    """Compile the whole pipeline for one (fact bucket, dim buckets,
-    agg layout) combination. dim_ns = full (padded-source) row counts,
-    dim_sns = valid sorted-key counts for searchsorted bounds."""
+    """The traced pipeline: filter fact -> dim probes/gathers -> residual
+    filters -> partial agg. fact_cap is the (local, for MPP shards) fact
+    partition capacity; dim_ns = full dim row counts, dim_sns = valid
+    sorted-key counts for searchsorted bounds."""
     fact_filters = list(plan.fact_dag.filters)
     dims = list(plan.dims)
     post = list(plan.post_filters)
     group_items = list(plan.group_items)
     aggs = list(plan.aggs)
 
-    @jax.jit
-    def kern(fjc, fvv, dargs):
+    def body(fjc, fvv, dargs):
         cols = {k: (d, nl, fact_sdicts[k]) for k, (d, nl) in fjc.items()}
         ctx = EvalCtx(jnp, fact_cap, cols, host=False)
         mask = fvv
@@ -289,13 +299,59 @@ def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                                   fact_cap)
         return sort_agg_body(ctx, mask, group_items, aggs, fact_cap,
                              agg_param)
-    return kern
+    return body
 
 
-def fused_partials(copr, plan, read_ts):
+def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
+                        dim_sns, dim_layouts, agg_kind, agg_param):
+    body = _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps,
+                               dim_ns, dim_sns, dim_layouts, agg_kind,
+                               agg_param)
+    return jax.jit(body)
+
+
+def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
+                            dim_ns, dim_sns, dim_layouts, agg_kind,
+                            agg_param, mesh):
+    """The fused pipeline as ONE shard_map program: fact shards ride the
+    'dp' mesh axis (PassThrough exchange from the scan), dims are
+    replicated (Broadcast exchange), and the partial aggregation merges
+    across shards — psum/pmin/pmax allreduces for dense layouts, stacked
+    per-shard partials (host merge) for the general sort layout."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from .dag_exec import psum_dense_result
+
+    body = _make_pipeline_body(plan, local_cap, fact_sdicts, dim_caps,
+                               dim_ns, dim_sns, dim_layouts, agg_kind,
+                               agg_param)
+    aggs = list(plan.aggs)
+    dense = agg_kind in ("dense", "posdense")
+
+    def frag(fjc, fvv, dargs):
+        res = body(fjc, fvv, dargs)
+        if dense:
+            return psum_dense_result(res, aggs, "dp")
+        # sort layout: per-shard partials, stacked along the mesh axis
+        res["ngroups"] = res["ngroups"][None]
+        return res
+
+    if dense:
+        out_spec = P()
+    else:
+        out_spec = P("dp")
+    fn = shard_map(frag, mesh=mesh, in_specs=(P("dp"), P("dp"), P()),
+                   out_specs=out_spec, check_rep=False)
+    return jax.jit(fn)
+
+
+def fused_partials(copr, plan, read_ts, mesh=None,
+                   bcast_threshold=1 << 20):
     """Execute a PhysFusedPipeline -> [PartialAggResult] (one per fact
-    partition), or None when runtime-ineligible (caller falls back to the
-    conventional subtree)."""
+    partition; one per mesh shard for the MPP sort layout), or None when
+    runtime-ineligible (caller falls back to the conventional subtree).
+    With a mesh, the whole pipeline runs as one shard_map program: fact
+    sharded over 'dp', dims broadcast, aggregation allreduced."""
     engine = copr.engine
     fact_tbl = engine.table(plan.fact_dag.table_info)
     dim_metas = []
@@ -308,17 +364,6 @@ def fused_partials(copr, plan, read_ts):
             return None
         dim_metas.append(meta)
 
-    # upload dims once (shared across fact partitions)
-    dim_args, dim_layouts, dim_caps, dim_ns, dim_sns = [], [], [], [], []
-    for dim, meta in zip(plan.dims, dim_metas):
-        dcap = shape_bucket(meta["n"])
-        da, layout = _upload_dim(copr, dim, meta, dcap, read_ts)
-        dim_args.append(da)
-        dim_layouts.append(layout)
-        dim_caps.append(dcap)
-        dim_ns.append(meta["n"])
-        dim_sns.append(meta["n_sorted"])
-
     fact_arrays, fact_valid = fact_tbl.snapshot(
         [cid for cid in (_cid_of(plan.fact_dag, sc)
                          for sc in plan.fact_dag.cols) if cid != -1],
@@ -329,6 +374,26 @@ def fused_partials(copr, plan, read_ts):
     handles = fact_tbl.handle_array()
     if len(handles) > n:
         handles = handles[:n]
+
+    if mesh is not None:
+        # a build side too large to replicate routes through the HASH
+        # exchange (all_to_all shuffle) instead of Broadcast
+        sh = _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl,
+                                fact_arrays, fact_valid, n, handles,
+                                bcast_threshold)
+        if sh is not None:
+            return sh
+
+    # upload dims once (shared across fact partitions)
+    dim_args, dim_layouts, dim_caps, dim_ns, dim_sns = [], [], [], [], []
+    for dim, meta in zip(plan.dims, dim_metas):
+        dcap = shape_bucket(meta["n"])
+        da, layout = _upload_dim(copr, dim, meta, dcap, read_ts, mesh)
+        dim_args.append(da)
+        dim_layouts.append(layout)
+        dim_caps.append(dcap)
+        dim_ns.append(meta["n"])
+        dim_sns.append(meta["n_sorted"])
 
     # 1-row host ctx over ALL pipeline columns: learn output dicts and
     # whether a dense group layout applies (dict-coded keys only here —
@@ -367,6 +432,12 @@ def fused_partials(copr, plan, read_ts):
              tuple(g.fingerprint() for g in plan.group_items),
              tuple(a.fingerprint() for a in plan.aggs))
     group_bucket = max(1024, copr._host_cache.get(gbkey, 0))
+    if mesh is not None:
+        return _run_fused_mpp(
+            copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
+            handles, dim_args, dim_metas, dim_caps, dim_ns, dim_sns,
+            dim_layouts, fact_sdicts, pos_spec, sizes, shim, kd, sd,
+            gbkey, group_bucket, read_ts)
     for start in range(0, n, step):
         sl = slice(start, min(start + step, n))
         m = sl.stop - sl.start
@@ -417,6 +488,206 @@ def fused_partials(copr, plan, read_ts):
                 key_dicts=kd, state_dicts=sd))
             break
     return out
+
+
+def _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl, fact_arrays,
+                       fact_valid, n, handles, threshold):
+    """Hash-exchange path (reference ExchangeType_Hash,
+    fragment.go:168): single huge dimension + group-by a dim column +
+    sum/count/avg over fact expressions -> both sides all_to_all by join
+    key, local merge join + dense agg, psum (mpp/exec.py
+    mpp_shuffle_join_agg). Returns [PartialAggResult] or None when the
+    shape doesn't match (caller broadcasts instead)."""
+    from ..expression import Column
+    from ..mpp.exec import mpp_shuffle_join_agg
+    if len(plan.dims) != 1 or plan.post_filters:
+        return None
+    dim, meta = plan.dims[0], dim_metas[0]
+    if dim.join_type != "inner" or meta["n"] <= threshold:
+        return None
+    if len(plan.group_items) != 1 or not isinstance(plan.group_items[0],
+                                                    Column):
+        return None
+    g = plan.group_items[0]
+    gcid = None
+    for sc in dim.dag.cols:
+        if sc.col.idx == g.idx:
+            gcid = _cid_of(dim.dag, sc)
+    if gcid is None or gcid == -1:
+        return None
+    nd = meta["n"]
+    pdata, pnulls, psdict = meta["arrays"][gcid]
+    if pnulls is not None and pnulls[:nd].any():
+        return None
+    if psdict is not None:
+        lo, size = 0, len(psdict.values) + 1
+    else:
+        if pdata.dtype.kind not in "iu" or nd == 0:
+            return None
+        lo = int(pdata[:nd].min())
+        size = int(pdata[:nd].max()) - lo + 1
+    if size > (1 << 18):
+        return None
+    fact_idxs = {sc.col.idx for sc in plan.fact_dag.cols}
+    vals = []
+    for a in plan.aggs:
+        if a.name not in ("sum", "count", "avg"):
+            return None
+        if a.args:
+            if not (_expr_idxs(a.args[0]) <= fact_idxs):
+                return None
+            vals.append(a.args[0])
+        else:
+            vals.append(None)
+    # host-side prep: masks + probe keys + agg args (numpy, vectorized)
+    key_cid = _cid_of(dim.dag, dim.build_key)
+    bk = meta["arrays"][key_cid][0][:nd].astype(np.int64)
+    dcols = {sc.col.idx: (meta["arrays"][_cid_of(dim.dag, sc)][0][:nd],
+                          meta["arrays"][_cid_of(dim.dag, sc)][1],
+                          meta["arrays"][_cid_of(dim.dag, sc)][2])
+             for sc in dim.dag.cols if _cid_of(dim.dag, sc) != -1}
+    dctx = EvalCtx(np, nd, dcols, host=True)
+    dmask = meta["valid"][:nd].copy()
+    for f in dim.dag.filters:
+        dmask &= np.asarray(eval_bool_mask(dctx, f))
+    payload = (pdata[:nd].astype(np.int64) - lo)
+    fcols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays,
+                            slice(0, n), handles)
+    fctx = EvalCtx(np, n, fcols, host=True)
+    fmask = fact_valid[:n].copy()
+    for f in plan.fact_dag.filters:
+        fmask &= np.asarray(eval_bool_mask(fctx, f))
+    pk, pnl, _ = eval_expr(fctx, dim.probe_expr)
+    if np.isscalar(pk):
+        pk = np.full(n, pk)
+    pk = np.asarray(pk).astype(np.int64)
+    pnm = np.asarray(materialize_nulls(fctx, pnl))
+    fmask &= ~pnm
+    val_arrays = []
+    for a, v in zip(plan.aggs, vals):
+        if v is None:
+            val_arrays.append(np.ones(n, dtype=np.int64))
+        else:
+            d, nl, _ = eval_expr(fctx, v)
+            if np.isscalar(d):
+                d = np.full(n, d)
+            nm = np.asarray(materialize_nulls(fctx, nl))
+            if nm.any():
+                return None               # per-val null masks unsupported
+            val_arrays.append(np.asarray(d))
+    ndev = int(mesh.devices.size)
+    lane = 128 * ndev
+
+    def pad(arr, m, fill=0):
+        p = ((m + lane - 1) // lane) * lane
+        if p == m:
+            return arr
+        return np.concatenate([arr, np.full(p - m, fill, dtype=arr.dtype)])
+
+    sums, cnts = mpp_shuffle_join_agg(
+        mesh, pad(pk, n), [pad(v, n) for v in val_arrays],
+        pad(fmask, n, False), pad(bk, nd), pad(payload, nd),
+        pad(dmask, nd, False), n_groups=size)
+    cnts = np.asarray(cnts)
+    slots = np.nonzero(cnts > 0)[0]
+    keys = [(slots + lo).astype(np.int64)]
+    states = []
+    for a, s in zip(plan.aggs, sums):
+        s = np.asarray(s)[slots]
+        if a.name == "count":
+            states.append([cnts[slots]])
+        else:
+            states.append([s, cnts[slots]])
+    if getattr(copr, "domain", None) is not None:
+        copr.domain.inc_metric("fused_shuffle_join")
+    return [PartialAggResult(
+        ngroups=len(slots), keys=keys,
+        key_nulls=[np.zeros(len(slots), dtype=bool)],
+        states=states, key_dicts=[psdict], state_dicts=[None] * len(states))]
+
+
+def _expr_idxs(e):
+    s = set()
+    e.collect_columns(s)
+    return s
+
+
+def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
+                   n, handles, dim_args, dim_metas, dim_caps, dim_ns,
+                   dim_sns, dim_layouts, fact_sdicts, pos_spec, sizes,
+                   shim, kd, sd, gbkey, group_bucket, read_ts):
+    """Mesh execution: ONE shard_map call over the whole fact table."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ndev = int(mesh.devices.size)
+    lane = 128 * ndev
+    padded = ((n + lane - 1) // lane) * lane
+    local = padded // ndev
+    cols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays,
+                           slice(0, n), handles)
+    fjc = {}
+    ver = fact_tbl.version
+    for sc in plan.fact_dag.cols:
+        cid = _cid_of(plan.fact_dag, sc)
+        data, nulls, _sd = cols[sc.col.idx]
+        jd = copr._dev_put_sharded(
+            (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded, "d"),
+            data, mesh, padded)
+        jn = None
+        if nulls is not None:
+            jn = copr._dev_put_sharded(
+                (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded,
+                 "n"), nulls, mesh, padded, pad_fill=True)
+        fjc[sc.col.idx] = (jd, jn)
+    vpad = fact_valid[:n] if padded == n else np.concatenate(
+        [fact_valid[:n], np.zeros(padded - n, dtype=bool)])
+    fvv = _jax.device_put(vpad, NamedSharding(mesh, P("dp")))
+    while True:
+        if pos_spec is not None:
+            agg_kind = "posdense"
+            agg_param = (tuple(pos_spec[1]), pos_spec[2])
+        elif sizes is not None:
+            agg_kind, agg_param = "dense", tuple(sizes)
+        else:
+            agg_kind, agg_param = "sort", group_bucket
+        key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, local,
+                               tuple(dim_caps), tuple(dim_ns),
+                               tuple(dim_sns), agg_kind, agg_param) + \
+            ("mpp", ndev, padded)
+        kern = copr._kernel_cache.get(key)
+        if kern is None:
+            kern = _build_fused_kernel_mpp(
+                plan, local, fact_sdicts, tuple(dim_caps), tuple(dim_ns),
+                tuple(dim_sns), tuple(dim_layouts), agg_kind, agg_param,
+                mesh)
+            copr._kernel_cache[key] = kern
+        res = kern(fjc, fvv, dim_args)
+        if pos_spec is not None:
+            return [_compact_pos_dense(plan, res, pos_spec[0],
+                                       pos_spec[1], dim_metas, sd)]
+        if sizes is not None:
+            return [_compact_dense(shim, res, sizes, kd, sd)]
+        ngroups_arr = np.asarray(res["ngroups"])     # [ndev]
+        if int(ngroups_arr.max()) > group_bucket:
+            group_bucket = shape_bucket(int(ngroups_arr.max()))
+            copr._host_cache[gbkey] = group_bucket
+            continue
+        # unstack the per-shard partials
+        out = []
+        for si in range(ndev):
+            ng = int(ngroups_arr[si])
+            if ng <= 0:
+                continue
+            sl = slice(si * group_bucket, (si + 1) * group_bucket)
+            out.append(PartialAggResult(
+                ngroups=ng,
+                keys=[np.asarray(k)[sl][:ng] for k in res["keys"]],
+                key_nulls=[np.asarray(kn)[sl][:ng]
+                           for kn in res["key_nulls"]],
+                states=[[np.asarray(s)[sl][:ng] for s in st]
+                        for st in res["states"]],
+                key_dicts=kd, state_dicts=sd))
+        return out
 
 
 def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,

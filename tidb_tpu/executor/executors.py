@@ -116,6 +116,25 @@ class TableReaderExec(Executor):
         return out
 
 
+class ExchangeReceiverExec(Executor):
+    """Consumer side of a fragment boundary: forwards to the fragment
+    body, which executes on the mesh when one exists (partial results
+    returned over the PassThrough exchange) and single-chip otherwise."""
+
+    def __init__(self, ctx, plan, inner):
+        super().__init__(ctx, plan.schema, [inner])
+        self.plan = plan
+
+    def open(self):
+        self.children[0].open()
+
+    def next(self):
+        return self.children[0].next()
+
+    def partials(self):
+        return self.children[0].partials()
+
+
 class FusedPipelineExec(Executor):
     """Drives a PhysFusedPipeline: the whole scan->join->agg subtree as
     one device kernel per fact partition (copr/pipeline.py). Falls back
@@ -151,14 +170,41 @@ class FusedPipelineExec(Executor):
         sess = self.ctx.sess
         if not self._any_dirty():
             from ..copr.pipeline import fused_partials
+            mesh = None
+            if getattr(self.plan, "mpp", False):
+                fm = getattr(self.ctx, "force_mpp", None)
+                want = bool(self.ctx.sv.get("tidb_enable_mpp")) \
+                    if fm is None else fm
+                min_rows = 0 if fm else int(
+                    self.ctx.sv.get("tidb_mpp_min_rows"))
+                fact = sess.domain.columnar.tables.get(
+                    self.plan.fact_dag.table_info.id)
+                if want and fact is not None and fact.n >= min_rows:
+                    mesh = self.ctx.copr._get_mesh()
             try:
+                bt = int(self.ctx.sv.get(
+                    "tidb_broadcast_join_threshold_count"))
                 res = fused_partials(self.ctx.copr, self.plan,
-                                     self.ctx.read_ts())
+                                     self.ctx.read_ts(), mesh,
+                                     bcast_threshold=bt)
                 if res is not None:
-                    sess.domain.inc_metric("fused_pipeline_hit")
+                    sess.domain.inc_metric(
+                        "fused_pipeline_mpp_hit" if mesh is not None
+                        else "fused_pipeline_hit")
                     return res
             except Exception:           # noqa: BLE001
                 sess.domain.inc_metric("fused_pipeline_error")
+                if mesh is not None:
+                    # mesh path failed: retry single-chip before falling
+                    # all the way back to the host join
+                    try:
+                        res = fused_partials(self.ctx.copr, self.plan,
+                                             self.ctx.read_ts(), None)
+                        if res is not None:
+                            sess.domain.inc_metric("fused_pipeline_hit")
+                            return res
+                    except Exception:   # noqa: BLE001
+                        pass
         sess.domain.inc_metric("fused_pipeline_fallback")
         return self._fallback_partials()
 

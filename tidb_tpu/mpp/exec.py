@@ -118,47 +118,59 @@ def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
     key % n_devices so matching keys land on the same device, then a local
     sort-merge join feeds a grouped aggregation on the build payload,
     merged with psum. This is the TiFlash shuffle-join fragment
-    (ExchangeType_Hash) as XLA collectives.
+    (ExchangeType_Hash) as XLA collectives — chosen over a Broadcast
+    exchange when the build side is too large to replicate.
 
     Local shapes are static: each device keeps ceil(n/ndev) slots per peer
     (padding with invalid rows), the all_to_all is a single ICI collective.
-    Returns (sums[n_groups], counts[n_groups]) replicated."""
+    probe_vals may be one array or a list (multi-agg); returns
+    (sums[n_groups] per val, counts[n_groups]) replicated."""
     ndev = mesh.devices.size
+    single = not isinstance(probe_vals, (list, tuple))
+    pvals = [probe_vals] if single else list(probe_vals)
+    nvals = len(pvals)
 
     def exchange(keys, vals, ok):
-        """Route rows to device (key % ndev) via one all_to_all."""
+        """Route rows to device (key % ndev) via one all_to_all each."""
         local_n = keys.shape[0]
         cap = local_n  # per-peer slot budget
         dest = (keys % ndev).astype(jnp.int32)
         dest = jnp.where(ok, dest, ndev)        # invalid -> dropped bucket
         # stable sort rows by destination, slot i*cap..(i+1)*cap per peer
         order = jnp.argsort(dest, stable=True)
-        skeys, svals, sok, sdest = (keys[order], vals[order], ok[order],
-                                    dest[order])
+        skeys, sok, sdest = keys[order], ok[order], dest[order]
+        svals = [v[order] for v in vals]
         # position within destination bucket
         onehot = (sdest[:, None] == jnp.arange(ndev + 1)[None, :])
         pos_in_bucket = jnp.cumsum(onehot, axis=0)[jnp.arange(local_n),
                                                    sdest] - 1
         slot = jnp.where(sdest < ndev, pos_in_bucket, cap)
         keep = (slot < cap) & sok
-        # scatter into [ndev, cap] frames
-        fk = jnp.zeros((ndev, cap), dtype=keys.dtype)
-        fv = jnp.zeros((ndev, cap), dtype=vals.dtype)
-        fo = jnp.zeros((ndev, cap), dtype=bool)
-        didx = jnp.where(keep, sdest, 0)
+        # scatter into [ndev, cap] frames; dropped rows go to a scratch
+        # row (ndev) sliced off afterwards — writing them to (0, 0)
+        # would clobber the real row in that slot
+        didx = jnp.where(keep, sdest, ndev)
         sidx = jnp.where(keep, slot, 0)
-        fk = fk.at[didx, sidx].set(jnp.where(keep, skeys, 0))
-        fv = fv.at[didx, sidx].set(jnp.where(keep, svals, 0))
-        fo = fo.at[didx, sidx].max(keep)
-        # one collective: swap frames so device d receives bucket d of all
+        fk = jnp.zeros((ndev + 1, cap), dtype=keys.dtype)
+        fk = fk.at[didx, sidx].set(jnp.where(keep, skeys, 0))[:ndev]
+        fo = jnp.zeros((ndev + 1, cap), dtype=bool)
+        fo = fo.at[didx, sidx].max(keep)[:ndev]
+        fvs = []
+        for v in svals:
+            fv = jnp.zeros((ndev + 1, cap), dtype=v.dtype)
+            fvs.append(fv.at[didx, sidx].set(
+                jnp.where(keep, v, 0))[:ndev])
+        # one collective per frame: device d receives bucket d of all
         fk = jax.lax.all_to_all(fk, axis, 0, 0, tiled=False)
-        fv = jax.lax.all_to_all(fv, axis, 0, 0, tiled=False)
         fo = jax.lax.all_to_all(fo, axis, 0, 0, tiled=False)
-        return fk.reshape(-1), fv.reshape(-1), fo.reshape(-1)
+        fvs = [jax.lax.all_to_all(fv, axis, 0, 0, tiled=False)
+               for fv in fvs]
+        return (fk.reshape(-1), [fv.reshape(-1) for fv in fvs],
+                fo.reshape(-1))
 
-    def frag(pk, pv, pok, bk, bp, bok):
-        pk2, pv2, pok2 = exchange(pk, pv, pok)
-        bk2, bp2, bok2 = exchange(bk, bp, bok)
+    def frag(pk, pok, bk, bp, bok, *pvs):
+        pk2, pv2s, pok2 = exchange(pk, list(pvs), pok)
+        bk2, (bp2,), bok2 = exchange(bk, [bp], bok)
         # local sort-merge equi-join: probe rows find matching build rows
         border = jnp.argsort(jnp.where(bok2, bk2, jnp.iinfo(jnp.int64).max),
                              stable=True)
@@ -170,15 +182,20 @@ def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
         payload = sbp[idx]
         # grouped agg on build payload (e.g. nation of matched supplier)
         seg = jnp.clip(payload, 0, n_groups - 1)
-        sums = jax.ops.segment_sum(jnp.where(matched, pv2, 0), seg,
-                                   num_segments=n_groups)
+        sums = tuple(
+            jax.lax.psum(jax.ops.segment_sum(jnp.where(matched, pv2, 0),
+                                             seg, num_segments=n_groups),
+                         axis) for pv2 in pv2s)
         cnts = jax.ops.segment_sum(matched.astype(jnp.int64), seg,
                                    num_segments=n_groups)
-        return jax.lax.psum(sums, axis), jax.lax.psum(cnts, axis)
+        return sums + (jax.lax.psum(cnts, axis),)
 
     fn = shard_map(frag, mesh=mesh,
-                   in_specs=(P(axis), P(axis), P(axis),
-                             P(axis), P(axis), P(axis)),
-                   out_specs=(P(), P()), check_rep=False)
-    return jax.jit(fn)(probe_keys, probe_vals, probe_valid,
-                       build_keys, build_payload, build_valid)
+                   in_specs=tuple(P(axis) for _ in range(5 + nvals)),
+                   out_specs=tuple(P() for _ in range(nvals + 1)),
+                   check_rep=False)
+    res = jax.jit(fn)(probe_keys, probe_valid, build_keys, build_payload,
+                      build_valid, *pvals)
+    if single:
+        return res[0], res[-1]
+    return list(res[:-1]), res[-1]

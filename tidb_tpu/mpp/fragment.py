@@ -1,0 +1,101 @@
+"""MPP fragment planner (reference
+pkg/planner/core/operator/physicalop/fragment.go:49 — a TiFlash plan
+splits into Fragments at Exchange operators; exchange types PassThrough /
+Broadcast / Hash, fragment.go:78,168).
+
+TPU-native redesign: a fragment is a shard_map program over the device
+mesh and an exchange is an XLA collective (or a sharded/replicated
+device_put at the leaves):
+
+    PassThrough  partial results -> coordinator     out_specs P("dp") or
+                                                    psum + host merge
+    Broadcast    replicate build side everywhere    NamedSharding P()
+                                                    (dims of a fused
+                                                    pipeline)
+    Hash         re-key rows across devices         all_to_all (shuffle
+                                                    join) or collapsed
+                                                    into psum for small
+                                                    group domains
+
+The fragmenter is a physical-plan rewrite: it inserts
+ExchangeSender/ExchangeReceiver nodes so EXPLAIN shows the fragment
+structure, and flags the wrapped operators for mesh execution. Plans stay
+executable without a mesh — every receiver degrades to its child's
+single-chip path."""
+from __future__ import annotations
+
+from ..planner.physical import (PhysPlan, PhysHashAgg, PhysTableReader,
+                                PhysFusedPipeline)
+
+
+class PhysExchangeSender(PhysPlan):
+    """Fragment boundary, producer side (fragment.go:78 ExchangeSender)."""
+
+    def __init__(self, child, exch_type: str, keys=(), fragment=0):
+        super().__init__([child], child.schema)
+        self.exch_type = exch_type      # PassThrough | Broadcast | Hash
+        self.keys = list(keys)
+        self.fragment = fragment
+        self.stats_rows = child.stats_rows
+
+    def explain_info(self):
+        s = f"type:{self.exch_type}, fragment:{self.fragment}"
+        if self.keys:
+            s += f", keys:[{', '.join(map(repr, self.keys))}]"
+        return s
+
+
+class PhysExchangeReceiver(PhysPlan):
+    """Fragment boundary, consumer side."""
+
+    def __init__(self, child):
+        super().__init__([child], child.schema)
+        self.stats_rows = child.stats_rows
+
+    def explain_info(self):
+        return ""
+
+
+def fragment_plan(plan: PhysPlan, n_devices_hint: int = 0) -> PhysPlan:
+    """Insert exchange boundaries into a physical plan. Applied when
+    tidb_enable_mpp is on; the wrapped operators execute on the mesh
+    when one exists and fall back to their single-chip paths otherwise."""
+    counter = [0]
+
+    def walk(p):
+        if isinstance(p, PhysHashAgg) and p.mode == "final" and p.children:
+            child = p.children[0]
+            if isinstance(child, PhysFusedPipeline):
+                counter[0] += 1
+                frag_id = counter[0]
+                child.mpp = True
+                # each dimension arrives over a Broadcast exchange: the
+                # build side replicates to every device (all_gather role)
+                dim_nodes = []
+                for d in child.dims:
+                    from ..planner.schema import Schema
+                    rd = PhysTableReader(d.dag, Schema(list(d.dag.cols)))
+                    counter[0] += 1
+                    snd = PhysExchangeSender(rd, "Broadcast",
+                                             fragment=counter[0])
+                    dim_nodes.append(PhysExchangeReceiver(snd))
+                child.children = dim_nodes     # display-only: the fused
+                # kernel reads dims directly; executor ignores children
+                snd = PhysExchangeSender(child, "PassThrough",
+                                         fragment=frag_id)
+                p.children = [PhysExchangeReceiver(snd)]
+                return p
+            if isinstance(child, PhysTableReader) and child.dag.aggs:
+                counter[0] += 1
+                # hash exchange on the group keys collapses into the
+                # dense-psum allreduce (mpp/exec.py) for small domains;
+                # general domains return per-shard partials (PassThrough)
+                snd = PhysExchangeSender(child, "Hash",
+                                         keys=list(child.dag.group_items),
+                                         fragment=counter[0])
+                p.children = [PhysExchangeReceiver(snd)]
+                return p
+        p.children = [walk(c) for c in p.children]
+        return p
+
+    return walk(plan)

@@ -88,6 +88,13 @@ def optimize(stmt, pctx: PlanContext):
         logical = builder.build_select(stmt)
         logical = optimize_logical(logical, hints=hints)
         phys = to_physical(logical, pctx.sess_vars)
+        try:
+            mpp_on = bool(pctx.sess_vars.get("tidb_enable_mpp"))
+        except Exception:
+            mpp_on = False
+        if mpp_on:
+            from ..mpp.fragment import fragment_plan
+            phys = fragment_plan(phys)
         phys.read_tables = frozenset(pctx.read_tables)
         phys.for_update = stmt.for_update
         if pctx.stale_read_ts:

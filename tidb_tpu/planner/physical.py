@@ -586,6 +586,8 @@ def _mk_reader(ds: DataSource) -> PhysPlan:
     schema = Schema(list(cols))
     rd = PhysTableReader(dag, schema)
     rd.stats_rows = ds.stats_rows
+    rd.raw_rows = float(getattr(ds, "pre_filter_rows", None) or
+                        ds.stats_rows)
     return rd
 
 
@@ -679,7 +681,20 @@ def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
     for leaf in leaves:
         for sc in leaf.dag.cols:
             owner[sc.col.idx] = leaf
-    fact = max(leaves, key=lambda p: p.stats_rows)
+    # fact candidates by RAW size (filtered stats can make the true fact
+    # look smaller than a dimension); try each until one orients
+    candidates = sorted(
+        leaves, key=lambda p: getattr(p, "raw_rows", p.stats_rows),
+        reverse=True)
+    for fact in candidates:
+        r = _orient_pipeline(plan, child, leaves, eqs, filters, owner,
+                             fact)
+        if r is not None:
+            return r
+    return None
+
+
+def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact):
     pipe = {sc.col.idx for sc in fact.dag.cols}
     used = {id(fact)}
     dims = []

@@ -641,9 +641,14 @@ class Session:
         return stmts[0]
 
     def _plan_cache_key(self, sql_key):
+        # any session var that changes plan SHAPE or semantics must key
+        # the cache (VERDICT r1: stale plans served across var changes)
         return (sql_key, self.vars.current_db,
                 self.domain.infoschema().version, self.vars.tpu_exec,
-                self.domain.bind_handle.version, self.session_binds.version)
+                self.domain.bind_handle.version, self.session_binds.version,
+                bool(self.vars.get("tidb_enable_mpp")),
+                str(self.vars.get("div_precision_increment")),
+                str(self.vars.get("tidb_join_exec")))
 
     def _apply_binding(self, stmt, sql_text):
         """Session-then-global binding match by normalized digest

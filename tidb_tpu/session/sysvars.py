@@ -96,6 +96,7 @@ for _v in [
     SysVar("max_execution_time", SCOPE_BOTH, 0, "int", 0, None),
     SysVar("tidb_allow_mpp", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH, 100 << 20, "int", 0, None),
+    SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH, 10240 * 100, "int", 0, None),
     SysVar("tidb_device_batch_rows", SCOPE_BOTH, 1 << 22, "int", 1 << 10, 1 << 26),
     SysVar("tidb_txn_mode", SCOPE_BOTH, "pessimistic", "enum",
            enum_vals=["optimistic", "pessimistic"]),
