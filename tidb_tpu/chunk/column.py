@@ -154,9 +154,13 @@ class Column:
             return Datum(Kind.STRING, self.dict.values[int(v)])
         tc = self.ft.tclass
         if tc in (TypeClass.INT, TypeClass.BIT, TypeClass.ENUM, TypeClass.SET):
+            if self.ft.unsigned:
+                return Datum(Kind.UINT, int(v) & 0xFFFFFFFFFFFFFFFF)
             return Datum(Kind.INT, int(v))
         if tc == TypeClass.UINT:
-            return Datum(Kind.UINT, int(v))
+            # int64 storage: negative bit patterns are the upper half of
+            # the unsigned domain (BIT_AND identity ~0 == 2^64-1)
+            return Datum(Kind.UINT, int(v) & 0xFFFFFFFFFFFFFFFF)
         if tc == TypeClass.FLOAT:
             return Datum(Kind.FLOAT, float(v))
         if tc == TypeClass.DECIMAL:
@@ -187,7 +191,10 @@ class Column:
             return micros_to_str(int(v), max(self.ft.decimal, 0))
         if tc == TypeClass.DURATION:
             return duration_to_str(int(v), max(self.ft.decimal, 0))
-        if tc in (TypeClass.INT, TypeClass.UINT):
+        if tc == TypeClass.UINT or (tc == TypeClass.INT and
+                                    self.ft.unsigned):
+            return int(v) & 0xFFFFFFFFFFFFFFFF
+        if tc == TypeClass.INT:
             return int(v)
         if tc == TypeClass.FLOAT:
             return float(v)

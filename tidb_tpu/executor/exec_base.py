@@ -179,12 +179,19 @@ def coerce_datum(d: Datum, ft) -> Datum:
             return Datum(Kind.FLOAT, d.val / 10 ** d.scale)
         return py_to_datum_fast(str(d.to_py()), ft)
     if tc in (TypeClass.INT, TypeClass.UINT, TypeClass.BIT):
+        unsigned = tc == TypeClass.UINT or ft.unsigned
         if d.kind in (Kind.INT, Kind.UINT):
+            if unsigned and d.val > 0x7FFFFFFFFFFFFFFF:
+                # store the unsigned upper half as its int64 bit pattern
+                return Datum(Kind.UINT, d.val)
+            if unsigned and d.kind == Kind.INT:
+                return Datum(Kind.UINT, d.val)
             return d
         if d.kind == Kind.FLOAT:
-            return Datum(Kind.INT, round(d.val))
+            return Datum(Kind.UINT if unsigned else Kind.INT, round(d.val))
         if d.kind == Kind.DECIMAL:
-            return Datum(Kind.INT, dec_round_scaled(d.val, d.scale, 0))
+            return Datum(Kind.UINT if unsigned else Kind.INT,
+                         dec_round_scaled(d.val, d.scale, 0))
         return py_to_datum_fast(str(d.to_py()), ft)
     if tc in (TypeClass.STRING, TypeClass.JSON):
         if d.kind in (Kind.STRING, Kind.BYTES):

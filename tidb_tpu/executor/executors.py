@@ -14,7 +14,7 @@ from ..expression.vec import materialize_nulls, eval_bool_mask
 from ..types.field_type import TypeClass, new_bigint_type
 from ..types.datum import Datum, Kind, NULL
 from ..types.decimal import scaled_int_to_str, _POW10
-from ..errors import UnsupportedError
+from ..errors import UnsupportedError, TiDBError
 from .exec_base import Executor, bind_chunk, eval_to_column
 
 _I64_MAX = np.iinfo(np.int64).max
@@ -168,7 +168,7 @@ class FusedPipelineExec(Executor):
 
     def partials(self):
         sess = self.ctx.sess
-        if not self._any_dirty():
+        if self.ctx.copr.use_device and not self._any_dirty():
             from ..copr.pipeline import fused_partials
             mesh = None
             if getattr(self.plan, "mpp", False):
@@ -1111,10 +1111,16 @@ class HashAggExec(Executor):
         return Column(ft, out, nulls if nulls.any() else None)
 
     # ---- complete: host aggregation over child chunks ----
+    _DECOMPOSABLE = frozenset({"count", "sum", "avg", "min", "max",
+                               "first_row"})
+
     def _complete(self):
         from ..copr.dag_exec import _host_partial_agg
         plan = self.plan
-        if any(d.distinct or d.name == "group_concat" for d in plan.aggs):
+        if any(d.distinct or d.name not in self._DECOMPOSABLE
+               for d in plan.aggs):
+            # non-decomposable aggs (group_concat, stddev family, bit_*,
+            # json_*agg, percentiles) need all rows of a group together
             return self._complete_distinct()
 
         class _FakeDag:
@@ -1325,6 +1331,113 @@ class HashAggExec(Executor):
             for gi in range(g):
                 out[gi] = sep.join(strs_sorted[inv_sorted == gi])
             return Column(ft, out, (cnt == 0) if (cnt == 0).any() else None)
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            iv = vals.astype(np.int64)
+            if name == "bit_and":
+                s = np.full(g, -1, dtype=np.int64)     # ~0 identity
+                np.bitwise_and.at(s, inv2, iv)
+            elif name == "bit_or":
+                s = np.zeros(g, dtype=np.int64)
+                np.bitwise_or.at(s, inv2, iv)
+            else:
+                s = np.zeros(g, dtype=np.int64)
+                np.bitwise_xor.at(s, inv2, iv)
+            return Column(ft, s)
+        if name in ("std", "stddev", "stddev_pop", "var_pop", "variance",
+                    "stddev_samp", "var_samp"):
+            fv = vals.astype(np.float64)
+            s1 = np.zeros(g)
+            s2 = np.zeros(g)
+            np.add.at(s1, inv2, fv)
+            np.add.at(s2, inv2, fv * fv)
+            c = np.maximum(cnt, 1).astype(np.float64)
+            mean = s1 / c
+            if name in ("stddev_samp", "var_samp"):
+                denom = np.maximum(cnt - 1, 1).astype(np.float64)
+                var = np.maximum(s2 - c * mean * mean, 0) / denom
+                nulls = cnt <= 1
+            else:
+                var = np.maximum(s2 / c - mean * mean, 0)
+                nulls = cnt == 0
+            out = np.sqrt(var) if name in ("std", "stddev", "stddev_pop",
+                                           "stddev_samp") else var
+            return Column(ft, out, nulls if nulls.any() else None)
+        if name == "approx_count_distinct":
+            # exact on a single node (reference: HyperLogLog sketch)
+            if vals.dtype.kind == "f":
+                iv = vals.view(np.int64)    # bit pattern keeps distinctness
+            elif vals.dtype == object:
+                raise UnsupportedError(
+                    "approx_count_distinct over raw strings")
+            else:
+                iv = vals.astype(np.int64)
+            pairs = np.stack([inv2.astype(np.int64), iv], axis=1)
+            uniqp = np.unique(pairs, axis=0)
+            s = np.zeros(g, dtype=np.int64)
+            np.add.at(s, uniqp[:, 0], 1)
+            return Column(ft, s)
+        if name == "approx_percentile":
+            from ..expression import Constant as _C
+            if len(desc.args) > 1 and not isinstance(desc.args[1], _C):
+                raise UnsupportedError(
+                    "approx_percentile percent must be a constant")
+            pct = int(desc.args[1].value.val) if len(desc.args) > 1 else 50
+            if not (0 <= pct <= 100):
+                raise TiDBError(
+                    "Percentage value %d is out of range [0, 100]", pct)
+            out = np.zeros(g, dtype=np.float64)
+            for gi in range(g):
+                gv = vals[inv2 == gi]
+                out[gi] = np.percentile(gv.astype(np.float64), pct) \
+                    if len(gv) else 0.0
+            data = out.astype(np.int64) if ft.tclass != TypeClass.FLOAT \
+                else out
+            return Column(ft, data,
+                          (cnt == 0) if (cnt == 0).any() else None)
+        if name in ("json_arrayagg", "json_objectagg"):
+            import json as _json
+            if desc.distinct:
+                raise UnsupportedError("DISTINCT is not supported in %s",
+                                       name)
+
+            def render(arr, nulls, sdict):
+                out = []
+                for i in range(len(arr)):
+                    if nulls[i]:
+                        out.append(None)
+                    elif sdict is not None:
+                        out.append(sdict.values[int(arr[i])])
+                    elif arr.dtype == object:
+                        out.append(str(arr[i]))
+                    elif arr.dtype.kind == "f":
+                        out.append(float(arr[i]))
+                    else:
+                        out.append(int(arr[i]))
+                return out
+            # MySQL includes NULL values: aggregate over ALL group rows
+            pv = render(d, nm, sd)
+            out = np.empty(g, dtype=object)
+            if name == "json_arrayagg":
+                for gi in range(g):
+                    out[gi] = _json.dumps(
+                        [v for v, iv in zip(pv, inverse) if iv == gi])
+            else:
+                d2, nl2, sd2 = eval_expr(ectx, desc.args[1])
+                if np.isscalar(d2):
+                    d2 = np.full(n, d2)
+                d2 = np.asarray(d2)
+                nm2 = np.asarray(materialize_nulls(ectx, nl2))
+                pv2 = render(d2, nm2, sd2)
+                for gi in range(g):
+                    # NULL keys are an error in MySQL; skip them here
+                    out[gi] = _json.dumps(
+                        {str(k): v for k, v, km, iv in
+                         zip(pv, pv2, nm, inverse)
+                         if iv == gi and not km})
+            gcnt = np.zeros(g, dtype=np.int64)
+            np.add.at(gcnt, inverse, 1)
+            return Column(ft, out,
+                          (gcnt == 0) if (gcnt == 0).any() else None)
         raise UnsupportedError("agg %s unsupported", name)
 
 

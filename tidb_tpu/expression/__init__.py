@@ -1,6 +1,7 @@
 from .expr import (Expression, Column, Constant, ScalarFunc, AggDesc,
                    const_from_py, const_null)
 from .vec import EvalCtx, eval_expr, eval_bool_mask
+from . import builtins_ext  # noqa: F401  (registers the builtin long tail)
 from .fold import fold_constants
 
 __all__ = ["Expression", "Column", "Constant", "ScalarFunc", "AggDesc",

@@ -14,7 +14,9 @@ from ..errors import ParseError
 
 AGG_FUNCS = {"count", "sum", "avg", "min", "max", "group_concat",
              "bit_and", "bit_or", "bit_xor", "std", "stddev", "stddev_pop",
-             "var_pop", "variance", "any_value"}
+             "var_pop", "variance", "any_value", "stddev_samp", "var_samp",
+             "approx_count_distinct", "approx_percentile", "json_arrayagg",
+             "json_objectagg"}
 
 WINDOW_ONLY_FUNCS = {"row_number", "rank", "dense_rank", "ntile", "lag",
                      "lead", "first_value", "last_value", "nth_value",
@@ -1585,7 +1587,8 @@ class Parser:
             if self.at_kw("not"):
                 if self.peek(1).kind == "IDENT" and \
                         self.peek(1).text.lower() in ("between", "in", "like",
-                                                      "regexp", "rlike"):
+                                                      "ilike", "regexp",
+                                                      "rlike"):
                     self.next()
                     neg = True
                 else:
@@ -1615,6 +1618,11 @@ class Parser:
                 if self.accept_kw("escape"):
                     esc = self.next().text
                 left = ast.Like(left, pat, negated=neg, escape=esc)
+                continue
+            if self.accept_kw("ilike"):
+                pat = self.parse_bitor()
+                e = ast.FuncCall(name="ilike", args=[left, pat])
+                left = ast.UnaryOp("not", e) if neg else e
                 continue
             if self.accept_kw("regexp") or self.accept_kw("rlike"):
                 left = ast.RegexpExpr(left, self.parse_bitor(), negated=neg)

@@ -50,8 +50,15 @@ def agg_result_ft(name: str, args, distinct):
         return new_string_type()
     if name in ("bit_and", "bit_or", "bit_xor"):
         return new_bigint_type(unsigned=True)
-    if name in ("std", "stddev", "stddev_pop", "var_pop", "variance"):
+    if name in ("std", "stddev", "stddev_pop", "var_pop", "variance",
+                "stddev_samp", "var_samp"):
         return new_double_type()
+    if name == "approx_count_distinct":
+        return new_bigint_type()
+    if name == "approx_percentile":
+        return args[0].ft.clone() if args else new_double_type()
+    if name in ("json_arrayagg", "json_objectagg"):
+        return new_string_type()
     return new_double_type()
 
 

@@ -60,8 +60,34 @@ _STRING_FUNCS |= {"addtime", "subtime", "timediff", "time",
                   "time_format", "format_bytes", "json_pretty",
                   "weight_string"}
 _INT_FUNCS |= {"weekofyear", "json_storage_size"}
+# builtin long tail (expression/builtins_ext.py)
+_STRING_FUNCS |= {"concat_ws", "translate", "regexp_substr",
+                  "regexp_replace", "sm3", "aes_encrypt", "aes_decrypt",
+                  "compress", "uncompress", "password", "random_bytes",
+                  "encode", "decode", "uuid", "uuid_v4", "uuid_v7",
+                  "uuid_to_bin", "bin_to_uuid", "inet6_aton",
+                  "inet6_ntoa", "json_array_append", "json_array_insert",
+                  "json_merge", "json_merge_preserve", "json_search",
+                  "get_format", "tidb_parse_tso",
+                  "tidb_encode_sql_digest", "tidb_decode_sql_digests",
+                  "tidb_decode_key", "tidb_decode_base64_key",
+                  "tidb_decode_plan", "tidb_decode_binary_plan",
+                  "tidb_mvcc_info", "tidb_bounded_staleness",
+                  "format_nano_time"}
+_INT_FUNCS |= {"position", "bit_length", "ilike", "regexp_like",
+               "regexp_instr", "uncompressed_length",
+               "validate_password_strength", "uuid_short", "is_uuid",
+               "uuid_version", "is_ipv4_compat", "is_ipv4_mapped",
+               "json_overlaps", "json_memberof", "member_of",
+               "json_schema_valid", "json_storage_free", "to_seconds",
+               "sleep", "benchmark", "vitess_hash", "tidb_shard",
+               "tidb_parse_tso_logical", "tidb_current_tso",
+               "tidb_is_ddl_owner", "tidb_row_checksum", "get_lock",
+               "release_lock", "is_free_lock", "is_used_lock",
+               "release_all_locks"}
+_FLOAT_FUNCS |= {"uuid_timestamp"}
 _DATE_RET_FUNCS = {"from_days", "last_day", "makedate"}
-_DATETIME_RET_FUNCS_EXTRA = {"timestampadd"}
+_DATETIME_RET_FUNCS_EXTRA = {"timestampadd", "convert_tz", "timestamp"}
 _DATETIME_RET_FUNCS = {"str_to_date", "from_unixtime"}
 
 
@@ -387,21 +413,27 @@ class Rewriter:
                 ast.Literal(value=node.args[0].name.lower())]
                 + list(node.args[1:]))
         # statement-time constants
-        if name in ("now", "current_timestamp", "sysdate"):
+        if name in ("now", "current_timestamp", "sysdate", "localtime",
+                    "localtimestamp", "utc_timestamp"):
             self.pctx.cacheable = False
             return Constant(value=Datum(Kind.DATETIME, self.pctx.now_micros),
                             ft=new_datetime_type())
-        if name in ("curdate", "current_date"):
+        if name in ("curdate", "current_date", "utc_date"):
             self.pctx.cacheable = False
             return Constant(value=Datum(Kind.DATE,
                                         self.pctx.now_micros // 86_400_000_000),
                             ft=new_date_type())
-        if name == "database":
+        if name in ("curtime", "current_time", "utc_time"):
+            self.pctx.cacheable = False
+            us = self.pctx.now_micros % 86_400_000_000
+            h, rem = divmod(us // 1_000_000, 3600)
+            return const_from_py(f"{h:02d}:{rem // 60:02d}:{rem % 60:02d}")
+        if name in ("database", "schema"):
             db = self.pctx.current_db
             return const_from_py(db) if db else const_null()
         if name == "version":
             return const_from_py("8.0.11-tidb-tpu-0.1.0")
-        if name in ("user", "current_user"):
+        if name in ("user", "current_user", "session_user", "system_user"):
             return const_from_py(getattr(self.pctx, "user", None) or
                                  "root@%")
         if name == "connection_id":
@@ -477,6 +509,15 @@ class Rewriter:
                     base.ft.tclass == TypeClass.DATE:
                 out_ft = new_datetime_type()
             return self.mk_func(name, [base, iv], out_ft)
+        if name == "get_format" and node.args:
+            # GET_FORMAT(DATE|TIME|DATETIME|TIMESTAMP, region): the unit
+            # is a keyword, parsed as a bare column ref
+            a0 = node.args[0]
+            if isinstance(a0, ast.ColumnRef) and not a0.table and \
+                    a0.name.lower() in ("date", "time", "datetime",
+                                        "timestamp"):
+                node = ast.FuncCall(name=name, args=[
+                    ast.Literal(a0.name.lower()), *node.args[1:]])
         if name == "extract":
             unit = node.args[0].value
             inner = self.rewrite(node.args[1])

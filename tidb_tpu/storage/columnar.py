@@ -142,7 +142,10 @@ class ColumnarTable:
             elif arr.dtype == np.float64:
                 arr[pos] = float(d.val)
             else:
-                arr[pos] = int(d.val)
+                v = int(d.val)
+                if v > 0x7FFFFFFFFFFFFFFF:
+                    v -= 1 << 64       # unsigned upper half as bit pattern
+                arr[pos] = v
         self.n = pos + 1
         self.handle_pos[handle] = pos
         self.version += 1
