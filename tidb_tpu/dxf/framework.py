@@ -97,12 +97,14 @@ class TaskManager:
                 else:
                     t.state = TaskState.SUCCEEDED
             finally:
-                t.done_event.set()
+                # persist final state BEFORE waking waiters: wait()
+                # returning must imply the task row is already updated
                 if on_done is not None:
                     try:
                         on_done(t)
                     except Exception:
                         pass
+                t.done_event.set()
         threading.Thread(target=run, daemon=True).start()
         return t
 

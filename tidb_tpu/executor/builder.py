@@ -55,6 +55,16 @@ def _build(ctx, plan):
     if isinstance(plan, PhysHashJoin):
         return HashJoinExec(ctx, plan, build_executor(ctx, plan.children[0]),
                             build_executor(ctx, plan.children[1]))
+    from ..planner.physical import PhysIndexLookupJoin, PhysMergeJoin
+    if isinstance(plan, PhysIndexLookupJoin):
+        from .executors import IndexLookupJoinExec
+        return IndexLookupJoinExec(ctx, plan,
+                                   build_executor(ctx, plan.children[0]))
+    if isinstance(plan, PhysMergeJoin):
+        from .executors import MergeJoinExec
+        return MergeJoinExec(ctx, plan,
+                             build_executor(ctx, plan.children[0]),
+                             build_executor(ctx, plan.children[1]))
     if isinstance(plan, PhysSort):
         return SortExec(ctx, plan, build_executor(ctx, plan.child))
     if isinstance(plan, PhysTopN):

@@ -1817,6 +1817,294 @@ class HashJoinExec(Executor):
         return Chunk(out)
 
 
+class IndexLookupJoinExec(Executor):
+    """Index-driven join (reference index_lookup_join.go: outer batches
+    feed inner point lookups; no inner scan). The inner side resolves
+    through the columnar handle index (clustered PK) or unique-index KV;
+    dirty transactions, stale reads and bulk tables fall back to the
+    conventional hash join (plan.fallback)."""
+
+    def __init__(self, ctx, plan, outer):
+        super().__init__(ctx, plan.schema, [outer])
+        self.plan = plan
+        self._out = None
+
+    def _eligible(self):
+        sess = self.ctx.sess
+        tbl = self.plan.inner_dag.table_info
+        if self.ctx.read_ts() is not None:
+            return False                      # stale read: version rescan
+        txn = getattr(sess, "_txn", None)
+        if txn is not None and not txn.committed and not txn.aborted and \
+                txn.is_dirty():
+            return False
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        if ctab is None:
+            return True                       # empty inner
+        if ctab.bulk_rows:
+            # bulk rows lack index KV AND may carry colliding arange
+            # handles — no index-driven path is trustworthy
+            return False
+        return True
+
+    def next(self):
+        if self._out is None:
+            if self._eligible():
+                self._out = [self._join()]
+            else:
+                from .builder import build_executor
+                fb = build_executor(self.ctx, self.plan.fallback)
+                out = Chunk.concat_all(fb.all_chunks())
+                self._out = [out if out is not None else Chunk.empty(
+                    [sc.col.ft for sc in self.schema.cols])]
+                self.ctx.sess.domain.inc_metric("index_join_fallback")
+        if not self._out:
+            return None
+        return self._out.pop(0)
+
+    def _lookup_handles(self, keys, key_nulls):
+        """join key values -> inner row positions (-1 = miss)."""
+        sess = self.ctx.sess
+        plan = self.plan
+        tbl = plan.inner_dag.table_info
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        pos = np.full(len(keys), -1, dtype=np.int64)
+        if ctab is None:
+            return pos, ctab
+        if plan.inner_index is None:
+            hp = ctab.handle_pos
+            del_ts = ctab.delete_ts
+            for i, k in enumerate(keys.tolist()):
+                if key_nulls[i]:
+                    continue
+                p = hp.get(k)
+                if p is not None and del_ts[p] == 0:
+                    pos[i] = p
+        else:
+            from ..codec.tablecodec import index_key
+            from .exec_base import coerce_datum
+            mvcc = sess.domain.storage.mvcc
+            ts = sess.domain.storage.current_ts()
+            cache = {}
+            # the index key encoding is TYPED (UINT_FLAG/DURATION_FLAG
+            # differ from ints): coerce through the column's field type
+            ci = tbl.find_column(plan.inner_index.columns[0])
+            for i, k in enumerate(keys.tolist()):
+                if key_nulls[i]:
+                    continue
+                h = cache.get(k)
+                if h is None:
+                    kk = k + (1 << 64) if (k < 0 and ci.ft.unsigned) else k
+                    ik = index_key(tbl.id, plan.inner_index.id,
+                                   [coerce_datum(Datum(Kind.INT, kk),
+                                                 ci.ft)])
+                    v = mvcc.get(ik, ts)
+                    h = int(v) if v is not None else -1
+                    cache[k] = h
+                if h >= 0:
+                    p = ctab.handle_pos.get(h)
+                    if p is not None and ctab.delete_ts[p] == 0:
+                        pos[i] = p
+        return pos, ctab
+
+    def _join(self):
+        plan = self.plan
+        sess = self.ctx.sess
+        outer_exec = self.children[0]
+        sess.domain.inc_metric("index_join_exec")
+        parts = []
+        out_fts = [sc.col.ft for sc in self.schema.cols]
+        while True:
+            ch = outer_exec.next()
+            if ch is None:
+                break
+            if not len(ch):
+                continue
+            parts.append(self._join_batch(ch))
+        out = Chunk.concat_all(parts)
+        return out if out is not None else Chunk.empty(out_fts)
+
+    def _join_batch(self, ch):
+        plan = self.plan
+        n = len(ch)
+        cols = bind_chunk(self.children[0].schema, ch)
+        ectx = EvalCtx(np, n, cols, host=True)
+        d, nl, sd = eval_expr(ectx, plan.outer_key)
+        if np.isscalar(d):
+            d = np.full(n, d)
+        keys = np.asarray(d).astype(np.int64)
+        knull = np.asarray(materialize_nulls(ectx, nl))
+        pos, ctab = self._lookup_handles(keys, knull)
+        matched = pos >= 0
+        oi = np.nonzero(matched)[0]
+        ip = pos[matched]
+        # gather inner columns for matched rows; apply residual filters
+        inner_cols = {}
+        tbl = plan.inner_dag.table_info
+        for sc in plan.inner_dag.cols:
+            if ctab is None:                # never-written inner table
+                inner_cols[sc.col.idx] = _null_column(sc.col.ft, 0)
+                continue
+            ci = tbl.find_column(sc.name)
+            if ci is None:
+                inner_cols[sc.col.idx] = Column(
+                    sc.col.ft, ctab.handles[ip].copy())
+            else:
+                inner_cols[sc.col.idx] = ctab.column_for(ci, ip)
+        if plan.inner_dag.filters or plan.inner_dag.host_filters:
+            ictx = EvalCtx(np, len(oi),
+                           {k: (c.data, c.nulls, c.dict)
+                            for k, c in inner_cols.items()}, host=True)
+            keep = np.ones(len(oi), dtype=bool)
+            for f in plan.inner_dag.filters + plan.inner_dag.host_filters:
+                keep &= np.asarray(eval_bool_mask(ictx, f))
+            oi = oi[keep]
+            inner_cols = {k: c.take(np.nonzero(keep)[0])
+                          for k, c in inner_cols.items()}
+        pieces = {}
+        for sc, col in zip(self.children[0].schema.cols, ch.columns):
+            pieces[sc.col.idx] = col.take(oi)
+        pieces.update(inner_cols)
+        if plan.other_conds:
+            m = len(oi)
+            jctx = EvalCtx(np, m,
+                           {k: (c.data, c.nulls, c.dict)
+                            for k, c in pieces.items()}, host=True)
+            keep = np.ones(m, dtype=bool)
+            for c in plan.other_conds:
+                keep &= np.asarray(eval_bool_mask(jctx, c))
+            kidx = np.nonzero(keep)[0]
+            oi = oi[kidx]
+            pieces = {k: c.take(kidx) for k, c in pieces.items()}
+        rows = [Chunk([self._piece(pieces, sc, len(oi))
+                       for sc in self.schema.cols])]
+        if plan.join_type == "left":
+            um = np.ones(n, dtype=bool)
+            um[oi] = False
+            ui = np.nonzero(um)[0]
+            if len(ui):
+                outer_pieces = {
+                    sc.col.idx: col.take(ui)
+                    for sc, col in zip(self.children[0].schema.cols,
+                                       ch.columns)}
+                rows.append(Chunk([
+                    outer_pieces.get(sc.col.idx) if sc.col.idx
+                    in outer_pieces else _null_column(sc.col.ft, len(ui))
+                    for sc in self.schema.cols]))
+        out = Chunk.concat_all(rows)
+        return out if out is not None else Chunk.empty(
+            [sc.col.ft for sc in self.schema.cols])
+
+    @staticmethod
+    def _piece(pieces, sc, n):
+        c = pieces.get(sc.col.idx)
+        return c if c is not None else _null_column(sc.col.ft, n)
+
+
+class MergeJoinExec(Executor):
+    """Sort-merge join (reference merge_join.go): both inputs ordered by
+    the join key, matched by a linear merge; output arrives in key
+    order."""
+
+    def __init__(self, ctx, plan, left, right):
+        super().__init__(ctx, plan.schema, [left, right])
+        self.plan = plan
+        self._out = None
+
+    def next(self):
+        if self._out is None:
+            self._out = [self._join()]
+        if not self._out:
+            return None
+        return self._out.pop(0)
+
+    def _keys(self, schema, chunk, exprs):
+        n = len(chunk)
+        cols = bind_chunk(schema, chunk)
+        ectx = EvalCtx(np, n, cols, host=True)
+        d, nl, sd = eval_expr(ectx, exprs[0])
+        if np.isscalar(d):
+            d = np.full(n, d)
+        d = np.asarray(d)
+        if sd is not None:
+            d = sd.ranks()[d].astype(np.int64)
+        elif d.dtype.kind == "f":
+            d = d.view(np.int64)
+        else:
+            d = d.astype(np.int64)
+        return d, np.asarray(materialize_nulls(ectx, nl))
+
+    def _join(self):
+        plan = self.plan
+        lexec, rexec = self.children
+        lchunk = Chunk.concat_all(lexec.all_chunks())
+        rchunk = Chunk.concat_all(rexec.all_chunks())
+        out_fts = [sc.col.ft for sc in self.schema.cols]
+        if lchunk is None or (rchunk is None and plan.join_type != "left"):
+            if plan.join_type == "left" and lchunk is not None:
+                rchunk = Chunk.empty(
+                    [sc.col.ft for sc in rexec.schema.cols])
+            else:
+                return Chunk.empty(out_fts)
+        if rchunk is None:
+            rchunk = Chunk.empty([sc.col.ft for sc in rexec.schema.cols])
+        lk, lnull = self._keys(lexec.schema, lchunk, [plan.eq_conds[0][0]])
+        rk, rnull = self._keys(rexec.schema, rchunk, [plan.eq_conds[0][1]])
+        lmask = np.where(lnull, _I64_MAX, lk)
+        rmask = np.where(rnull, _I64_MAX, rk)
+        lorder = np.argsort(lmask, kind="stable")
+        rorder = np.argsort(rmask, kind="stable")
+        slk = lmask[lorder]        # masked values stay sorted (NULLs last)
+        srk = rmask[rorder]
+        # linear merge: per left row, matching right run via searchsorted
+        lo = np.searchsorted(srk, slk, side="left")
+        hi = np.searchsorted(srk, slk, side="right")
+        cnt = hi - lo
+        cnt[lnull[lorder]] = 0
+        rvalid = ~rnull[rorder]
+        total = int(cnt.sum())
+        li = np.repeat(np.arange(len(slk)), cnt)
+        starts = np.repeat(lo, cnt)
+        base = np.repeat(np.cumsum(cnt) - cnt, cnt)
+        ri = starts + (np.arange(total) - base)
+        keep = rvalid[ri]
+        li, ri = li[keep], ri[keep]
+        lidx = lorder[li]
+        ridx = rorder[ri]
+        pieces = {}
+        for sc, col in zip(lexec.schema.cols, lchunk.columns):
+            pieces[sc.col.idx] = col.take(lidx)
+        for sc, col in zip(rexec.schema.cols, rchunk.columns):
+            pieces[sc.col.idx] = col.take(ridx)
+        if plan.other_conds:
+            m = len(lidx)
+            jctx = EvalCtx(np, m,
+                           {k: (c.data, c.nulls, c.dict)
+                            for k, c in pieces.items()}, host=True)
+            keepm = np.ones(m, dtype=bool)
+            for c in plan.other_conds:
+                keepm &= np.asarray(eval_bool_mask(jctx, c))
+            kidx = np.nonzero(keepm)[0]
+            lidx = lidx[kidx]
+            pieces = {k: c.take(kidx) for k, c in pieces.items()}
+        rows = [Chunk([pieces.get(sc.col.idx,
+                                  _null_column(sc.col.ft, len(lidx)))
+                       for sc in self.schema.cols])]
+        if plan.join_type == "left":
+            um = np.ones(len(lchunk), dtype=bool)
+            um[lidx] = False
+            ui = np.nonzero(um)[0]
+            if len(ui):
+                op = {sc.col.idx: col.take(ui)
+                      for sc, col in zip(lexec.schema.cols,
+                                         lchunk.columns)}
+                rows.append(Chunk([
+                    op.get(sc.col.idx, _null_column(sc.col.ft, len(ui)))
+                    for sc in self.schema.cols]))
+        out = Chunk.concat_all(rows)
+        return out if out is not None else Chunk.empty(out_fts)
+
+
 def _null_column(ft, n) -> Column:
     if ft.tclass in (TypeClass.STRING, TypeClass.JSON):
         data = np.empty(n, dtype=object)

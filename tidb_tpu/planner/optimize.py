@@ -87,7 +87,7 @@ def optimize(stmt, pctx: PlanContext):
     if isinstance(stmt, ast.SelectStmt):
         logical = builder.build_select(stmt)
         logical = optimize_logical(logical, hints=hints)
-        phys = to_physical(logical, pctx.sess_vars)
+        phys = to_physical(logical, pctx.sess_vars, hints=hints)
         try:
             mpp_on = bool(pctx.sess_vars.get("tidb_enable_mpp"))
         except Exception:

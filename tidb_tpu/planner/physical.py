@@ -258,6 +258,50 @@ class PhysHashJoin(PhysPlan):
                 f"eq:{[(repr(a), repr(b)) for a, b in self.eq_conds]}")
 
 
+class PhysIndexLookupJoin(PhysPlan):
+    """Index-driven join (reference executor/join/index_lookup_join.go):
+    the outer side streams in batches; each batch's join keys become
+    point lookups into the inner table's clustered PK / unique index —
+    an OLTP-selective join never scans the inner table. The inner side
+    here is a table descriptor, not a child executor (the lookups ARE
+    the scan); `fallback` keeps the hash join for runtime ineligibility
+    (dirty txn, stale reads, bulk tables)."""
+
+    def __init__(self, join_type, outer, inner_dag, inner_key_sc,
+                 inner_index, outer_key, other_conds, schema, fallback):
+        super().__init__([outer], schema)
+        self.join_type = join_type        # inner | left (outer preserved)
+        self.inner_dag = inner_dag        # CoprDAG: cols + residual filters
+        self.inner_key_sc = inner_key_sc  # SchemaCol of the inner join key
+        self.inner_index = inner_index    # IndexInfo | None (None = PK)
+        self.outer_key = outer_key        # Expression over outer schema
+        self.other_conds = other_conds
+        self.fallback = fallback
+
+    def explain_info(self):
+        via = "handle" if self.inner_index is None else \
+            f"index:{self.inner_index.name}"
+        return (f"{self.join_type}, inner:{self.inner_dag.table_info.name}"
+                f"({via}), outer key:{self.outer_key!r}")
+
+
+class PhysMergeJoin(PhysPlan):
+    """Sort-merge join (reference executor/join/merge_join.go): both
+    sides ordered by the join key, linear merge; output arrives in key
+    order (downstream sorts on the key can elide)."""
+
+    def __init__(self, join_type, eq_conds, other_conds, schema, left,
+                 right):
+        super().__init__([left, right], schema)
+        self.join_type = join_type
+        self.eq_conds = eq_conds
+        self.other_conds = other_conds
+
+    def explain_info(self):
+        return (f"{self.join_type}, "
+                f"eq:{[(repr(a), repr(b)) for a, b in self.eq_conds]}")
+
+
 class PhysSort(PhysPlan):
     def __init__(self, items, child):
         super().__init__([child], child.schema)
@@ -316,9 +360,27 @@ class PhysShell(PhysPlan):
         super().__init__([child], schema)
 
 
-def to_physical(plan: LogicalPlan, sess_vars=None) -> PhysPlan:
-    p = _phys(plan)
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def to_physical(plan: LogicalPlan, sess_vars=None, hints=None) -> PhysPlan:
+    _TLS.hints = list(hints or ())
+    try:
+        p = _phys(plan)
+    finally:
+        _TLS.hints = []
     return p
+
+
+def _hint_tables(name):
+    """Lowercased table args of the first matching join hint."""
+    for hname, args in getattr(_TLS, "hints", None) or ():
+        if hname in (name, "tidb_inlj" if name == "inl_join" else name,
+                     "sm_join" if name == "merge_join" else name):
+            return [a.lower() for a in args] or ["*"]
+    return None
 
 
 def _try_point_get(ds: DataSource) -> PhysPlan | None:
@@ -422,6 +484,9 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
                          plan.other_conds, plan.schema, left, right)
         p.null_aware = getattr(plan, "null_aware", False)
         p.stats_rows = plan.stats_rows
+        alt = _try_join_strategy(plan, left, right, p)
+        if alt is not None:
+            return alt
         return p
     if isinstance(plan, Sort):
         p = PhysSort(plan.items, _phys(plan.child))
@@ -654,6 +719,102 @@ def _fusable_key_ft(ft):
     from ..types.field_type import TypeClass as TC
     return ft.tclass in (TC.INT, TC.UINT, TC.DATE, TC.DATETIME,
                          TC.TIMESTAMP, TC.DURATION)
+
+
+def _inner_key_info(leaf: PhysTableReader, col_idx):
+    """-> (SchemaCol, IndexInfo|None) when col_idx is the leaf table's
+    clustered PK or a single-column unique index; None otherwise."""
+    tbl = leaf.dag.table_info
+    sc = next((s for s in leaf.dag.cols if s.col.idx == col_idx), None)
+    if sc is None:
+        return None
+    nm = sc.name.lower()
+    if tbl.pk_is_handle and tbl.pk_col_name.lower() == nm:
+        return sc, None
+    for idx in tbl.public_indexes():
+        if (idx.unique or idx.primary) and len(idx.columns) == 1 and \
+                idx.columns[0].lower() == nm:
+            return sc, idx
+    return None
+
+
+def _try_join_strategy(plan: LJoin, left, right, hash_plan):
+    """Hint- and cost-driven alternatives to the hash join (reference
+    find_best_task.go physical property enumeration, collapsed to a
+    direct choice): INL_JOIN -> PhysIndexLookupJoin when the inner side
+    is a plain scan with a PK/unique key on the join column and the
+    outer side is selective; MERGE_JOIN -> PhysMergeJoin."""
+    inl = _hint_tables("inl_join")
+    mj = _hint_tables("merge_join")
+    hj = _hint_tables("hash_join")
+
+    def _subtree_tables(p):
+        out = set()
+        if isinstance(p, PhysTableReader):
+            out.add(p.dag.table_info.name.lower())
+        for c in p.children:
+            out |= _subtree_tables(c)
+        return out
+
+    join_tables = _subtree_tables(left) | _subtree_tables(right)
+    if mj is not None and ("*" in mj or join_tables & set(mj)) and \
+            plan.join_type in ("inner", "left") and \
+            len(plan.eq_conds) == 1 and \
+            not getattr(plan, "null_aware", False) and \
+            all(_fusable_key_ft(a.ft) and _fusable_key_ft(b.ft)
+                for a, b in plan.eq_conds):
+        p = PhysMergeJoin(plan.join_type, plan.eq_conds, plan.other_conds,
+                          plan.schema, left, right)
+        p.stats_rows = plan.stats_rows
+        return p
+    if hj is not None and ("*" in hj or join_tables & set(hj)):
+        return None                    # user asked for the hash join
+    if plan.join_type not in ("inner", "left") or len(plan.eq_conds) != 1 \
+            or getattr(plan, "null_aware", False):
+        return None
+    l_expr, r_expr = plan.eq_conds[0]
+    if not (_fusable_key_ft(l_expr.ft) and _fusable_key_ft(r_expr.ft)):
+        return None
+
+    def try_side(inner_phys, outer_phys, inner_eq, outer_eq, outer_is_left):
+        if not isinstance(inner_phys, PhysTableReader):
+            return None
+        dag = inner_phys.dag
+        if dag.aggs or dag.topn is not None or dag.limit >= 0 or \
+                dag.table_info.partitions or dag.table_info.id < 0:
+            return None
+        if not isinstance(inner_eq, Column):
+            return None
+        ki = _inner_key_info(inner_phys, inner_eq.idx)
+        if ki is None:
+            return None
+        # left outer join preserves the LEFT side: inner must be right
+        if plan.join_type == "left" and not outer_is_left:
+            return None
+        alias = dag.table_info.name.lower()
+        if inl is not None:
+            if "*" not in inl and alias not in inl:
+                return None
+        else:
+            # cost gate: selective outer, non-trivial inner
+            outer_rows = outer_phys.stats_rows or 1.0
+            inner_raw = getattr(inner_phys, "raw_rows",
+                                inner_phys.stats_rows) or 1.0
+            if not (outer_rows <= 128 and inner_raw >= outer_rows * 16):
+                return None
+        sc, idx = ki
+        p = PhysIndexLookupJoin(
+            plan.join_type, outer_phys, dag, sc, idx, outer_eq,
+            plan.other_conds, plan.schema, hash_plan)
+        p.outer_is_left = outer_is_left
+        p.stats_rows = plan.stats_rows
+        return p
+
+    # orientation: inner side = the one whose eq expr is a keyed column
+    r = try_side(right, left, r_expr, l_expr, True)
+    if r is None:
+        r = try_side(left, right, l_expr, r_expr, False)
+    return r
 
 
 def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
