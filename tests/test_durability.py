@@ -1,0 +1,196 @@
+"""Durability: LSM flush/compaction, bulk segment persistence, and
+failpoint-injected crash recovery (VERDICT r1 items 6+8: an injected
+crash between prewrite and commit must leave no orphan locks; kill -9
+mid-commit must lose zero ACKNOWLEDGED transactions)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+
+
+def _tk(domain):
+    tk = TestKit.__new__(TestKit)
+    tk.domain = domain
+    tk.sess = Session(domain)
+    tk.sess.vars.current_db = "test"
+    return tk
+
+
+def test_lsm_flush_and_recovery(tmp_path):
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 10), (2, 20)")
+    assert dom.flush_wal() > 0
+    tk.must_exec("insert into t values (3, 30)")
+    assert dom.flush_wal() > 0
+    tk.must_exec("update t set b = 99 where a = 1")
+    from tidb_tpu.storage import sst
+    assert len(sst.run_files(d)) == 2
+    assert os.path.getsize(os.path.join(d, "commit.wal")) > 0
+    dom.storage.mvcc.wal.close()
+    # reopen: runs + wal tail replay
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select a, b from t order by a").rs.rows == [
+        (1, 99), (2, 20), (3, 30)]
+
+
+def test_lsm_compaction(tmp_path):
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table t (a int primary key, b int)")
+    for i in range(6):
+        tk.must_exec(f"insert into t values ({i}, {i * 10})")
+        dom.flush_wal()
+    from tidb_tpu.storage import sst
+    assert len(sst.run_files(d)) <= 4      # compaction merged
+    assert dom.metrics.get("lsm_compactions", 0) >= 1
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select count(*) from t").rs.rows == [(6,)]
+
+
+def test_bulk_segment_persistence(tmp_path):
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table imp (id int primary key, s varchar(8), "
+                 "v int)")
+    csv = tmp_path / "x.csv"
+    csv.write_text("1,aa,10\n2,bb,20\n3,aa,30\n")
+    tk.must_exec(f"import into imp from '{csv}' with force_python")
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select s, sum(v) from imp group by s "
+                          "order by s").rs.rows == [("aa", "40"),
+                                                    ("bb", "20")]
+    assert tk2.must_query("select v from imp where id = 2").rs.rows == \
+        [(20,)]
+
+
+def test_failpoint_prewrite_crash_no_orphan_locks():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 1)")
+    failpoint.enable("2pc-prewrite-done", "error")
+    try:
+        err = tk.exec_err("update t set b = 2 where a = 1")
+        assert "injected" in str(err)
+    finally:
+        failpoint.disable("2pc-prewrite-done")
+    # the failed txn must have rolled its locks back: next write works
+    assert not tk.domain.storage.mvcc._locks
+    tk.must_exec("update t set b = 3 where a = 1")
+    assert tk.must_query("select b from t").rs.rows == [(3,)]
+
+
+_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+import tidb_tpu
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.utils import failpoint
+dom = new_store({dd!r}, wal_sync=True)
+s = Session(dom)
+s.vars.current_db = "test"
+s.execute("create table t (a int primary key, b int)")
+for i in range(5):
+    s.execute(f"insert into t values ({{i}}, {{i * 10}})")
+    print(f"ACK {{i}}", flush=True)
+failpoint.enable("2pc-commit-after-wal", "crash")
+try:
+    s.execute("insert into t values (99, 990)")
+except SystemExit:
+    raise
+print("UNREACHED", flush=True)
+"""
+
+
+def test_kill9_mid_commit_loses_no_acked_txns(tmp_path):
+    """Crash AT the WAL-durable point mid-commit: every acknowledged
+    transaction survives; the in-flight one may or may not (it was never
+    acked), and recovery leaves no locks behind."""
+    d = str(tmp_path / "dd")
+    script = _CRASH_CHILD.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        dd=d)
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, timeout=120)
+    acked = [line for line in r.stdout.decode().splitlines()
+             if line.startswith("ACK")]
+    assert len(acked) == 5
+    assert b"UNREACHED" not in r.stdout
+    assert r.returncode == 137
+    dom = new_store(d)
+    tk = _tk(dom)
+    rows = tk.must_query("select a, b from t where a < 90 "
+                         "order by a").rs.rows
+    assert rows == [(i, i * 10) for i in range(5)]
+    assert not dom.storage.mvcc._locks
+    # the crashed txn hit the failpoint AFTER the WAL append, so it is
+    # durable too (crash-at-durability-point semantics)
+    assert tk.must_query("select b from t where a = 99").rs.rows == \
+        [(990,)]
+
+
+def test_failpoint_ddl_ladder():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 5)")
+    seen = []
+    failpoint.enable("ddl-index-write-only", lambda: seen.append("wo"))
+    try:
+        tk.must_exec("alter table t add index ib (b)")
+    finally:
+        failpoint.disable("ddl-index-write-only")
+    assert seen == ["wo"]
+    assert tk.must_query("select a from t where b = 5").rs.rows == [(1,)]
+
+
+def test_bulk_segment_survives_delete_and_ddl(tmp_path):
+    """Review findings (reproduced): replayed DELETEs of imported rows
+    must not resurrect on restart, and ADD COLUMN after an import must
+    not break recovery."""
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table imp (id int primary key, v int)")
+    csv = tmp_path / "y.csv"
+    csv.write_text("1,10\n2,20\n3,30\n")
+    tk.must_exec(f"import into imp from '{csv}' with force_python")
+    tk.must_exec("delete from imp where id = 2")
+    tk.must_exec("update imp set v = 99 where id = 3")
+    tk.must_exec("alter table imp add column c int")
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select id, v, c from imp order by id"
+                          ).rs.rows == [(1, 10, None), (3, 99, None)]
+
+
+def test_bulk_segment_stale_read_across_restart(tmp_path):
+    """Import commit_ts persists: AS OF reads predate the import the
+    same way after a restart."""
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table imp (id int primary key, v int)")
+    csv = tmp_path / "z.csv"
+    csv.write_text("1,10\n")
+    tk.must_exec(f"import into imp from '{csv}' with force_python")
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    info = dom2.infoschema().table_by_name("test", "imp")
+    ctab = dom2.columnar.tables[info.id]
+    assert int(ctab.insert_ts[0]) > 1      # not flattened to ts=1

@@ -56,6 +56,7 @@ def exec_import(sess, stmt) -> ResultSet:
         _check_bulk_handles(ctab, handles)
         ctab.bulk_append(columns, n, handles=handles,
                          commit_ts=sess.domain.storage.current_ts())
+        sess.domain.persist_bulk_segment(tbl, ctab, ctab.n - n, n)
         sess.domain.invalidate_plan_cache()
         return ResultSet(affected=n)
 
@@ -73,6 +74,7 @@ def exec_import(sess, stmt) -> ResultSet:
     _check_bulk_handles(ctab, handles)
     ctab.bulk_append(columns, n, handles=handles,
                      commit_ts=sess.domain.storage.current_ts())
+    sess.domain.persist_bulk_segment(tbl, ctab, ctab.n - n, n)
     sess.domain.invalidate_plan_cache()
     return ResultSet(affected=n)
 

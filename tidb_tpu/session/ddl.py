@@ -575,9 +575,13 @@ class DDLExecutor:
         if result is None:
             return
         db, tbl, idx = result
+        from ..utils import failpoint
+        failpoint.inject("ddl-index-delete-only")
         self._set_index_state(tn, idx.name, SchemaState.WRITE_ONLY)
+        failpoint.inject("ddl-index-write-only")
         _, tbl, idx = self._set_index_state(tn, idx.name,
                                             SchemaState.WRITE_REORG)
+        failpoint.inject("ddl-index-write-reorg")
         # backfill from columnar snapshot
         ctab = self.domain.columnar.tables.get(tbl.id)
         if ctab is None or ctab.live_count() == 0:

@@ -87,6 +87,9 @@ class Domain:
         WAL, the reference's RocksDB-snapshot + raft-log-GC shape."""
         import os
         from ..storage.wal import WalWriter, replay, decode_checkpoint
+        # columnar effects buffer until segments load: a replayed DELETE
+        # of an imported row must see the segment's handle
+        self.columnar._replay_buffer = []
         ckpt = os.path.join(data_dir, "checkpoint.snap")
         if os.path.exists(ckpt):
             with open(ckpt, "rb") as f:
@@ -103,6 +106,15 @@ class Domain:
                     i += 1
                 self.storage.oracle.fast_forward(ts)
                 self.storage.mvcc.apply_replay(ts, muts)
+        # LSM runs: flushed WAL segments between checkpoints (storage/sst)
+        from ..storage import sst
+        for rp in sst.run_files(data_dir):
+            by_ts: dict = {}
+            for ts, k, v in sst.read_run(rp):
+                by_ts.setdefault(ts, []).append((k, v))
+            for ts in sorted(by_ts):
+                self.storage.oracle.fast_forward(ts)
+                self.storage.mvcc.apply_replay(ts, by_ts[ts])
         path = os.path.join(data_dir, "commit.wal")
         for commit_ts, mutations, _wall in replay(path):
             # keep the oracle ahead of replayed commits so the engine hooks
@@ -111,6 +123,122 @@ class Domain:
             self.storage.mvcc.apply_replay(commit_ts, mutations)
         self.is_cache._cached = None     # reload schema from replayed meta
         self.storage.mvcc.wal = WalWriter(path, sync=self.wal_sync)
+        self._load_bulk_segments()
+        buf = self.columnar._replay_buffer
+        self.columnar._replay_buffer = None
+        for ts, muts in buf:
+            self.columnar.apply_commit(ts, muts)
+
+    def flush_wal(self) -> int:
+        """LSM flush: rewrite the WAL as one sorted immutable run and
+        truncate it (reference: memtable flush to L0; the C++ memtable
+        itself stays in memory — the run IS its durable image). Compacts
+        when runs accumulate. Returns entries flushed."""
+        from ..storage import sst
+        from ..storage.wal import replay, WalWriter
+        mvcc = self.storage.mvcc
+        n = 0
+        with mvcc._mu:
+            w = mvcc.wal
+            if w is None or not self.data_dir:
+                return 0
+            w._f.flush()
+            triples = []
+            for ts, muts, _wall in replay(w.path):
+                triples.extend((ts, k, v) for k, v in muts)
+            if not triples:
+                return 0
+            n = sst.write_run(sst.next_run_path(self.data_dir), triples)
+            w.close()
+            open(w.path, "wb").close()
+            mvcc.wal = WalWriter(w.path, sync=self.wal_sync)
+            self.inc_metric("lsm_flushes")
+            if len(sst.run_files(self.data_dir)) > 4:
+                safepoint = getattr(self, "gc_safepoint", 0)
+                sst.compact(self.data_dir, safepoint)
+                self.inc_metric("lsm_compactions")
+        return n
+
+    # ---- bulk columnar segments (lightning-loaded data has no row KV;
+    # its durability is segment files, reference: TiFlash stable layer) --
+    def persist_bulk_segment(self, table_info, ctab, start, n):
+        if not self.data_dir or n <= 0:
+            return
+        import json
+        import os
+        import time as _time
+        import numpy as np
+        segdir = os.path.join(self.data_dir, "segments")
+        os.makedirs(segdir, exist_ok=True)
+        seq = int(_time.time() * 1e6)
+        base = os.path.join(segdir, f"seg_{table_info.id}_{seq}")
+        arrays = {"__handles": ctab.handles[start:start + n]}
+        dicts = {}
+        for ci in table_info.columns:
+            arrays[f"d_{ci.id}"] = ctab.data[ci.id][start:start + n]
+            arrays[f"n_{ci.id}"] = ctab.nulls[ci.id][start:start + n]
+            if ci.id in ctab.dicts:
+                dicts[str(ci.id)] = list(ctab.dicts[ci.id].values)
+        # npz first, json LAST, both atomic+fsynced: the loader keys off
+        # the .json, so a crash can never leave a loadable half-segment
+        for suffix, writer in ((".npz", lambda f: np.savez_compressed(
+                f, **arrays)),
+                               (".json", lambda f: f.write(json.dumps(
+                                   {"table_id": table_info.id, "n": n,
+                                    "commit_ts": int(
+                                        ctab.insert_ts[start]),
+                                    "dicts": dicts}).encode()))):
+            tmp = base + suffix + ".tmp"
+            with open(tmp, "wb") as f:
+                writer(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, base + suffix)
+
+    def _load_bulk_segments(self):
+        import json
+        import os
+        import re
+        import numpy as np
+        segdir = os.path.join(self.data_dir, "segments")
+        if not os.path.isdir(segdir):
+            return
+        segs = []
+        for name in os.listdir(segdir):
+            m = re.fullmatch(r"seg_(\d+)_(\d+)\.json", name)
+            if m:
+                segs.append((int(m.group(2)), int(m.group(1)),
+                             os.path.join(segdir, name)))
+        for _seq, tid, meta_path in sorted(segs):
+            info = self._table_info_by_id(tid)
+            npz_path = meta_path[:-5] + ".npz"
+            if info is None:           # dropped/truncated table: orphan
+                for p in (meta_path, npz_path):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            z = np.load(npz_path, allow_pickle=False)
+            ctab = self.columnar.table(info)
+            columns = {}
+            for ci in info.columns:
+                key = f"d_{ci.id}"
+                if key not in z:
+                    continue       # column added by DDL after the import
+                data = z[key]
+                if str(ci.id) in meta["dicts"]:
+                    d = ctab.dicts[ci.id]
+                    mapping = np.array(
+                        [d.encode_one(v) for v in meta["dicts"][str(ci.id)]]
+                        or [0], dtype=np.int32)
+                    data = mapping[data]
+                columns[ci.name] = data
+            ctab.bulk_append(columns, int(meta["n"]),
+                             handles=z["__handles"],
+                             commit_ts=int(meta.get("commit_ts", 1)))
 
     def invalidate_plan_cache(self):
         """Drop all cached plans (bulk loads change which access paths
@@ -140,6 +268,9 @@ class Domain:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.data_dir, "checkpoint.snap"))
+            from ..storage import sst
+            for rp in sst.run_files(self.data_dir):
+                os.remove(rp)          # snapshot supersedes all runs
             if mvcc.wal is not None:
                 mvcc.wal.close()
                 wal_path = mvcc.wal.path
@@ -150,14 +281,16 @@ class Domain:
         return ts
 
     def maybe_checkpoint(self, wal_limit=32 << 20):
-        """Auto-checkpoint once the WAL outgrows `wal_limit` bytes."""
+        """Auto-flush the WAL to an LSM run once it outgrows `wal_limit`
+        (bounded recovery without the full-snapshot pause of ADMIN
+        CHECKPOINT)."""
         import os
         w = self.storage.mvcc.wal
         if w is None:
             return
         try:
             if os.path.getsize(w.path) > wal_limit:
-                self.checkpoint()
+                self.flush_wal()
         except OSError:
             pass
 
@@ -263,9 +396,12 @@ class Domain:
         return n
 
     def run_gc(self, safepoint=None) -> int:
-        """MVCC GC across columnar tables (safepoint default: now)."""
+        """MVCC GC across columnar tables (safepoint default: now).
+        Also advances the LSM compaction safepoint: the next compaction
+        drops row versions unreachable below it."""
         if safepoint is None:
             safepoint = self.storage.current_ts()
+        self.gc_safepoint = safepoint
         total = 0
         for ctab in self.columnar.tables.values():
             total += ctab.gc(safepoint)

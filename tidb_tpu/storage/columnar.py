@@ -127,6 +127,20 @@ class ColumnarTable:
         self.insert_ts[pos] = commit_ts
         self.delete_ts[pos] = 0
         cols = self.table_info.columns
+        for ci in cols[len(datums):]:
+            # row encoded under an older schema (e.g. WAL replay of a
+            # pre-ADD COLUMN write): later columns get default/NULL
+            arr = self.data[ci.id]
+            nl = self.nulls[ci.id]
+            default = ci.ft.default_value
+            if default is None:
+                nl[pos] = True
+                arr[pos] = 0
+            else:
+                d0 = py_to_datum_fast(default, ci.ft)
+                nl[pos] = False
+                arr[pos] = (self.dicts[ci.id].encode_one(str(d0.val))
+                            if ci.id in self.dicts else d0.val)
         for ci, d in zip(cols, datums):
             arr = self.data[ci.id]
             nl = self.nulls[ci.id]
@@ -269,6 +283,9 @@ class ColumnarEngine:
         # commit hooks run outside the MVCC mutex; concurrent committers
         # must not interleave put_row/_ensure on the same arrays
         self._apply_mu = threading.Lock()
+        # recovery: mutations buffer here until bulk segments are loaded,
+        # so replayed DELETEs/UPDATEs of imported rows find their handles
+        self._replay_buffer = None
         storage.mvcc.commit_hooks.append(self.apply_commit)
 
     def table(self, table_info) -> ColumnarTable:
@@ -284,6 +301,9 @@ class ColumnarEngine:
         self.tables.pop(table_id, None)
 
     def apply_commit(self, commit_ts: int, mutations: list):
+        if self._replay_buffer is not None:
+            self._replay_buffer.append((commit_ts, mutations))
+            return
         with self._apply_mu:
             self._apply_locked(commit_ts, mutations)
 

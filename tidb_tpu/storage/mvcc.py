@@ -16,6 +16,7 @@ import threading
 from .kv import MemKV
 from ..native.memtable import new_memkv
 from ..errors import WriteConflictError, LockWaitTimeoutError
+from ..utils import failpoint
 
 
 class _Versions:
@@ -115,6 +116,7 @@ class MVCCStore:
             for key, value in mutations:
                 op = "del" if value is None else "put"
                 self._locks[key] = Lock(primary, start_ts, op)
+        failpoint.inject("2pc-prewrite-done")
 
     def commit(self, mutations: list, start_ts: int, commit_ts: int):
         with self._mu:
@@ -123,6 +125,14 @@ class MVCCStore:
                 if lock is None or lock.start_ts != start_ts:
                     raise WriteConflictError(
                         "commit failed: lock missing for txn %d", start_ts)
+            failpoint.inject("2pc-commit-before-wal")
+            # WAL first: once the frame is durable the commit survives a
+            # crash even if the in-memory apply below never runs (replay
+            # reconstructs it); a crash before the append loses only an
+            # un-acknowledged transaction
+            if self.wal is not None:
+                self.wal.append(commit_ts, mutations)
+            failpoint.inject("2pc-commit-after-wal")
             for key, value in mutations:
                 vers = self._kv.get(key)
                 if vers is None:
@@ -130,8 +140,6 @@ class MVCCStore:
                     self._kv.put(key, vers)
                 vers.add(commit_ts, value)
                 del self._locks[key]
-            if self.wal is not None:
-                self.wal.append(commit_ts, mutations)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 

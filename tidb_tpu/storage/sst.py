@@ -1,0 +1,120 @@
+"""Sorted immutable runs + compaction — the LSM shape of the storage
+engine (reference role: TiKV/RocksDB SST files + compaction,
+badger in unistore; single-node re-design: the WAL is the memtable's
+redo log, a flush rewrites it as one sorted run, compaction merges
+runs).
+
+Run file format (magic SST2, self-describing binary — never pickle):
+
+    b"SST2"  u64 n_entries
+    n x ( u64 commit_ts  u32 klen  key  i32 vlen|-1  value )
+
+Entries are sorted by (key, commit_ts). Recovery applies runs oldest
+file first; version lists are ts-ordered internally so replay order
+between runs only matters for identical (key, ts) pairs, which
+compaction dedups."""
+from __future__ import annotations
+
+import os
+import re
+import struct
+
+_MAGIC = b"SST2"
+
+
+def write_run(path: str, triples) -> int:
+    """triples: iterable of (commit_ts, key, value|None). Atomic
+    (tmp+rename), fsynced. Returns entry count."""
+    rows = sorted(triples, key=lambda t: (t[1], t[0]))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC + struct.pack("<Q", len(rows)))
+        for ts, key, value in rows:
+            f.write(struct.pack("<QI", ts, len(key)))
+            f.write(bytes(key))
+            if value is None:
+                f.write(struct.pack("<i", -1))
+            else:
+                f.write(struct.pack("<i", len(value)))
+                f.write(bytes(value))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(rows)
+
+
+def read_run(path: str):
+    """Yield (commit_ts, key, value|None); raises on foreign format."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(_MAGIC):
+        raise ValueError(f"unrecognized run file format: {path}")
+    (n,) = struct.unpack_from("<Q", data, 4)
+    pos = 12
+    for _ in range(n):
+        ts, klen = struct.unpack_from("<QI", data, pos)
+        pos += 12
+        key = data[pos:pos + klen]
+        pos += klen
+        (vlen,) = struct.unpack_from("<i", data, pos)
+        pos += 4
+        if vlen < 0:
+            yield ts, key, None
+        else:
+            yield ts, key, data[pos:pos + vlen]
+            pos += vlen
+
+
+def run_files(data_dir: str) -> list:
+    """Existing run files, oldest (lowest sequence) first."""
+    out = []
+    if not os.path.isdir(data_dir):
+        return out
+    for name in os.listdir(data_dir):
+        m = re.fullmatch(r"run_(\d+)\.sst", name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(data_dir, name)))
+    return [p for _, p in sorted(out)]
+
+
+def next_run_path(data_dir: str) -> str:
+    runs = run_files(data_dir)
+    seq = 0
+    if runs:
+        seq = max(int(re.search(r"run_(\d+)\.sst", p).group(1))
+                  for p in runs)
+    return os.path.join(data_dir, f"run_{seq + 1:06d}.sst")
+
+
+def compact(data_dir: str, keep_latest_only_below: int = 0) -> int:
+    """Merge every run into one, deduplicating identical (key, ts)
+    entries; with a GC safepoint, versions strictly older than the
+    newest version at-or-below the safepoint can be dropped per key
+    (reference: RocksDB compaction filter + TiKV GC). Returns the number
+    of entries written."""
+    runs = run_files(data_dir)
+    if len(runs) <= 1 and not keep_latest_only_below:
+        return 0
+    merged: dict = {}
+    for path in runs:                       # later files win on (k, ts)
+        for ts, key, value in read_run(path):
+            merged[(key, ts)] = value
+    entries = [(ts, k, v) for (k, ts), v in merged.items()]
+    if keep_latest_only_below:
+        sp = keep_latest_only_below
+        by_key: dict = {}
+        for ts, k, v in entries:
+            by_key.setdefault(k, []).append((ts, v))
+        entries = []
+        for k, vers in by_key.items():
+            vers.sort()
+            # newest version at-or-below the safepoint survives; older
+            # ones are unreachable by any snapshot >= safepoint
+            below = [t for t, _ in vers if t <= sp]
+            cut = below[-1] if below else 0
+            entries.extend((t, k, v) for t, v in vers if t >= cut)
+    out = next_run_path(data_dir)
+    n = write_run(out, entries)
+    for path in runs:
+        os.remove(path)
+    return n

@@ -1,0 +1,70 @@
+"""Failpoint injection (reference pingcap/failpoint — `failpoint.Inject`
+at 277 sites, e.g. pkg/session/session.go:2497; here an env- or
+API-keyed callback registry compiled to a near-zero-cost check).
+
+Usage at a site:      failpoint.inject("commit-after-wal")
+Enable in tests:      failpoint.enable("commit-after-wal", fn)
+                      failpoint.enable("x", failpoint.CRASH)  # os._exit
+Enable for children:  TIDB_TPU_FAILPOINTS="commit-after-wal=crash;y=error"
+"""
+from __future__ import annotations
+
+import os
+
+from ..errors import TiDBError
+
+_ACTIVE: dict = {}
+
+
+class FailpointError(TiDBError):
+    """Raised by the 'error' action; a TiDBError so the session's normal
+    statement-failure path (txn rollback, lock release) handles it."""
+
+
+def CRASH():
+    os._exit(137)          # simulates kill -9 at the injection site
+
+
+def _ERROR():
+    raise FailpointError("injected")
+
+
+_ACTIONS = {"crash": CRASH, "error": _ERROR}
+
+
+def _load_env():
+    spec = os.environ.get("TIDB_TPU_FAILPOINTS", "")
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, action = part.split("=", 1)
+        fn = _ACTIONS.get(action.strip())
+        if fn is not None:
+            _ACTIVE[name.strip()] = fn
+
+
+_load_env()
+
+
+def enable(name: str, fn) -> None:
+    if isinstance(fn, str):
+        fn = _ACTIONS[fn]
+    _ACTIVE[name] = fn
+
+
+def disable(name: str) -> None:
+    _ACTIVE.pop(name, None)
+
+
+def disable_all() -> None:
+    _ACTIVE.clear()
+    _load_env()
+
+
+def inject(name: str, *args):
+    """No-op unless enabled; enabled callbacks may raise or crash."""
+    cb = _ACTIVE.get(name)
+    if cb is not None:
+        return cb(*args) if args else cb()
+    return None
