@@ -194,3 +194,37 @@ def test_bulk_segment_stale_read_across_restart(tmp_path):
     info = dom2.infoschema().table_by_name("test", "imp")
     ctab = dom2.columnar.tables[info.id]
     assert int(ctab.insert_ts[0]) > 1      # not flattened to ts=1
+
+
+def test_pitr_includes_flushed_runs(tmp_path):
+    """BACKUP LOG must carry flushed LSM runs; RESTORE ... UNTIL
+    replays them with the same wallclock cutoff as WAL frames."""
+    d = str(tmp_path / "dd")
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 10), (2, 20)")
+    dom.flush_wal()                       # moves commits out of the WAL
+    tk.must_exec("insert into t values (3, 30)")
+    bdir = str(tmp_path / "bk")
+    tk.must_exec(f"backup log to '{bdir}'")
+    import time
+    until = time.time() + 1
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store()
+    tk2 = _tk(dom2)
+    tk2.must_exec(f"restore from '{bdir}' until timestamp "
+                  f"'{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(until))}'")
+    assert tk2.must_query("select a, b from t order by a").rs.rows == [
+        (1, 10), (2, 20), (3, 30)]
+
+
+def test_maxvalue_partition_forms():
+    tk = TestKit()
+    tk.must_exec("create table mp (id int primary key, v int) "
+                 "partition by range (id) "
+                 "(partition p0 values less than (10), "
+                 "partition p1 values less than (maxvalue))")
+    tk.must_exec("insert into mp values (5, 1), (500, 2)")
+    assert tk.must_query("select v from mp where id = 500").rs.rows == \
+        [(2,)]

@@ -66,6 +66,13 @@ class StringDict:
             codes[i] = c
         return codes
 
+    def translate_codes(self, values: list, codes: np.ndarray) -> np.ndarray:
+        """Codes minted against a FOREIGN dictionary (given as its value
+        list) -> codes in THIS dictionary, extending it as needed."""
+        mapping = np.array([self.encode_one(v) for v in values] or [0],
+                           dtype=np.int32)
+        return mapping[codes]
+
     def encode_one(self, s: str) -> int:
         c = self.index.get(s)
         if c is None:

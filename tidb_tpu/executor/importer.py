@@ -44,10 +44,8 @@ def exec_import(sess, stmt) -> ResultSet:
         for ci, res in zip(cols, parsed):
             if isinstance(res, tuple):
                 codes, values = res
-                d = ctab.dicts[ci.id]
-                mapping = np.array([d.encode_one(v) for v in values] or [0],
-                                   dtype=np.int32)
-                columns[ci.name] = mapping[codes]
+                columns[ci.name] = ctab.dicts[ci.id].translate_codes(
+                    values, codes)
                 n = len(codes)
             else:
                 columns[ci.name] = res

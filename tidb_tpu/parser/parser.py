@@ -1013,8 +1013,14 @@ class Parser:
                     else:
                         self.expect_op("(")
                         t = self.next()
-                        lt = (int(t.text) if t.kind == "NUMBER"
-                              else t.text)
+                        if t.kind == "IDENT" and \
+                                t.text.lower() == "maxvalue":
+                            lt = None      # keyword form: (MAXVALUE);
+                            # a quoted 'maxvalue' is kind STRING and
+                            # stays a literal bound
+                        else:
+                            lt = (int(t.text) if t.kind == "NUMBER"
+                                  else t.text)
                         self.expect_op(")")
                     pdef["parts"].append({"name": pname, "less_than": lt})
                     if not self.accept_op(","):

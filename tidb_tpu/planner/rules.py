@@ -88,6 +88,83 @@ def _flatten_inner(plan: LJoin, rels, eqs, others):
     others.extend(plan.other_conds)
 
 
+def _rel_datasource(rel):
+    from .logical import DataSource
+    node = rel
+    while node is not None:
+        if isinstance(node, DataSource):
+            return node
+        node = node.children[0] if len(node.children) == 1 else None
+    return None
+
+
+def _col_ndv(rels, id_of, col_idx):
+    """NDV of a bare column via the owning DataSource's ANALYZE stats;
+    None when unknown."""
+    owner = id_of.get(col_idx)
+    if owner is None:
+        return None
+    ds = _rel_datasource(rels[owner])
+    if ds is None or getattr(ds, "tbl_stats", None) is None:
+        return None
+    name = getattr(ds, "col_name_of", {}).get(col_idx)
+    if name is None:
+        return None
+    cs = ds.tbl_stats.columns.get(name)
+    return cs.ndv if cs is not None and cs.ndv else None
+
+
+def _greedy_order(rels, eqs, id_of, rel_of, start, ndv_cache=None):
+    """Simulate the greedy join from `start`; -> (order, total cost).
+    Each step scores candidates by ESTIMATED JOIN OUTPUT: |cur join R|
+    ~= |cur| * |R| / max(ndv(key_cur), ndv(key_R)) — the classic
+    cardinality model (reference find_best_task.go / cardinality pkg),
+    so a small relation with a skewed (low-NDV) key no longer wins over
+    a bigger one whose key is selective."""
+    from ..expression import Column as _Col
+    if ndv_cache is None:
+        ndv_cache = {}
+
+    def cached_ndv(idx):
+        if idx not in ndv_cache:
+            ndv_cache[idx] = _col_ndv(rels, id_of, idx)
+        return ndv_cache[idx]
+    remaining = set(range(len(rels))) - {start}
+    joined_set = {start}
+    cur_est = max(float(rels[start].stats_rows), 1.0)
+    total = cur_est
+    order = [start]
+    while remaining:
+        best = None
+        for i in remaining:
+            connected = False
+            ndv = None
+            for a, b in eqs:
+                side_sets = rel_of(a) | rel_of(b)
+                if i in side_sets and side_sets - {i} <= joined_set:
+                    connected = True
+                    for e in (a, b):
+                        if isinstance(e, _Col):
+                            n = cached_ndv(e.idx)
+                            if n is not None:
+                                ndv = max(ndv or 1, n)
+            ri = max(float(rels[i].stats_rows), 1.0)
+            if connected:
+                est = cur_est * ri / max(float(ndv or ri), 1.0)
+                score = (0, est)
+            else:
+                est = cur_est * ri
+                score = (1, ri)
+            if best is None or score < best[0]:
+                best = (score, i, est)
+        _, nxt, cur_est = best
+        total += cur_est
+        order.append(nxt)
+        joined_set.add(nxt)
+        remaining.discard(nxt)
+    return order, total
+
+
 def _greedy_build(rels, eqs, others, pinned=0):
     id_of = {}
     for i, r in enumerate(rels):
@@ -99,42 +176,33 @@ def _greedy_build(rels, eqs, others, pinned=0):
         owners = {id_of.get(i, -1) for i in s}
         return owners
 
-    remaining = set(range(len(rels)))
     pinned = min(pinned, len(rels))
-    start = 0 if pinned else min(remaining,
-                                 key=lambda i: rels[i].stats_rows)
+    ndv_cache: dict = {}
+    if pinned:
+        # LEADING-pinned prefix, then the greedy tail over the rest
+        tail = [i for i in _greedy_order(rels, eqs, id_of, rel_of, 0,
+                                         ndv_cache)[0] if i >= pinned]
+        order = list(range(pinned)) + tail
+    else:
+        # the start choice matters as much as each step: simulate every
+        # start and keep the cheapest cumulative plan (n <= ~10 rels)
+        best = None
+        for s in range(len(rels)):
+            order_s, cost = _greedy_order(rels, eqs, id_of, rel_of, s,
+                                          ndv_cache)
+            if best is None or cost < best[1]:
+                best = (order_s, cost)
+        order = best[0]
+    start = order[0]
     joined_set = {start}
-    remaining.discard(start)
     current = rels[start]
     pending_eqs = list(eqs)
     pending_others = list(others)
-    forced = list(range(1, pinned))       # LEADING-pinned join order
-    while remaining:
-        # candidates connected by an eq cond to the joined set
-        best = None
-        if forced:
-            i = forced.pop(0)
-            best = ((0, 0), i, True)
-            remaining_iter = ()
-        else:
-            remaining_iter = remaining
-        for i in remaining_iter:
-            connected = False
-            for a, b in pending_eqs:
-                oa, ob = rel_of(a), rel_of(b)
-                side_sets = oa | ob
-                if i in side_sets and side_sets - {i} <= joined_set:
-                    connected = True
-                    break
-            score = (0 if connected else 1, rels[i].stats_rows)
-            if best is None or score < best[0]:
-                best = (score, i, connected)
-        _, nxt, connected = best
+    for nxt in order[1:]:
         right = rels[nxt]
         schema = Schema_(list(current.schema.cols) + list(right.schema.cols))
         join = LJoin("inner", current, right, schema)
         joined_set.add(nxt)
-        remaining.discard(nxt)
         cur_ids = {sc.col.idx for sc in schema.cols}
         still_eq = []
         for a, b in pending_eqs:

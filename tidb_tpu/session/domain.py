@@ -76,6 +76,7 @@ class Domain:
         self.durable_tasks = DurableTasks(self)
         self.ast_cache: dict = {}         # sql -> parsed stmt list
         self.digest_cache: dict = {}      # sql -> (normalized, digest)
+        self._syncload_attempted: set = set()
         if data_dir:
             self._open_wal(data_dir)
 
@@ -110,7 +111,7 @@ class Domain:
         from ..storage import sst
         for rp in sst.run_files(data_dir):
             by_ts: dict = {}
-            for ts, k, v in sst.read_run(rp):
+            for ts, k, v, _wall in sst.read_run(rp):
                 by_ts.setdefault(ts, []).append((k, v))
             for ts in sorted(by_ts):
                 self.storage.oracle.fast_forward(ts)
@@ -144,8 +145,8 @@ class Domain:
                 return 0
             w._f.flush()
             triples = []
-            for ts, muts, _wall in replay(w.path):
-                triples.extend((ts, k, v) for k, v in muts)
+            for ts, muts, wall in replay(w.path):
+                triples.extend((ts, k, v, wall) for k, v in muts)
             if not triples:
                 return 0
             n = sst.write_run(sst.next_run_path(self.data_dir), triples)
@@ -170,7 +171,10 @@ class Domain:
         import numpy as np
         segdir = os.path.join(self.data_dir, "segments")
         os.makedirs(segdir, exist_ok=True)
-        seq = int(_time.time() * 1e6)
+        # wall micros + per-domain counter: two imports in the same tick
+        # (or a clock step) must not collide and clobber a segment
+        self._seg_seq = getattr(self, "_seg_seq", 0) + 1
+        seq = int(_time.time() * 1e6) * 1000 + self._seg_seq % 1000
         base = os.path.join(segdir, f"seg_{table_info.id}_{seq}")
         arrays = {"__handles": ctab.handles[start:start + n]}
         dicts = {}
@@ -224,21 +228,23 @@ class Domain:
             z = np.load(npz_path, allow_pickle=False)
             ctab = self.columnar.table(info)
             columns = {}
+            nulls = {}
             for ci in info.columns:
                 key = f"d_{ci.id}"
                 if key not in z:
                     continue       # column added by DDL after the import
                 data = z[key]
                 if str(ci.id) in meta["dicts"]:
-                    d = ctab.dicts[ci.id]
-                    mapping = np.array(
-                        [d.encode_one(v) for v in meta["dicts"][str(ci.id)]]
-                        or [0], dtype=np.int32)
-                    data = mapping[data]
+                    data = ctab.dicts[ci.id].translate_codes(
+                        meta["dicts"][str(ci.id)], data)
                 columns[ci.name] = data
+                nk = f"n_{ci.id}"
+                if nk in z and z[nk].any():
+                    nulls[ci.name] = z[nk]
             ctab.bulk_append(columns, int(meta["n"]),
                              handles=z["__handles"],
-                             commit_ts=int(meta.get("commit_ts", 1)))
+                             commit_ts=int(meta.get("commit_ts", 1)),
+                             nulls=nulls or None)
 
     def invalidate_plan_cache(self):
         """Drop all cached plans (bulk loads change which access paths
@@ -394,6 +400,30 @@ class Domain:
         if n:
             self.inc_metric("auto_analyze_runs", n)
         return n
+
+    def stats_or_syncload(self, table_id: int):
+        """Planner stats accessor with SYNC LOAD (reference
+        statistics/handle/syncload/stats_syncload.go:154 — a plan that
+        needs missing stats loads them synchronously instead of planning
+        blind): an un-analyzed table above a row floor gets a quick
+        sampled ANALYZE inline, once."""
+        ts = self.stats.get(table_id)
+        if ts is not None:
+            return ts
+        if table_id in self._syncload_attempted or table_id < 0:
+            return None
+        info = self._table_info_by_id(table_id)
+        ctab = self.columnar.tables.get(table_id)
+        if info is None or ctab is None or ctab.live_count() < 2048:
+            return None          # too small NOW — retry when it grows
+        self._syncload_attempted.add(table_id)
+        try:
+            from ..stats.analyze import analyze_one
+            ts = analyze_one(self, info)
+            self.inc_metric("stats_syncload")
+            return ts
+        except Exception:               # noqa: BLE001
+            return None
 
     def run_gc(self, safepoint=None) -> int:
         """MVCC GC across columnar tables (safepoint default: now).

@@ -180,7 +180,7 @@ class Session:
             now_micros=int(time.time() * 1_000_000),
             conn_id=self.conn_id,
             params=params,
-            table_stats=lambda tid: self.domain.stats.get(tid),
+            table_stats=self.domain.stats_or_syncload,
             check_read=self._check_read,
             temp_tables=self.temp_tables,
             make_temp_table=self.make_temp_table,

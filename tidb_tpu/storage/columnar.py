@@ -171,10 +171,11 @@ class ColumnarTable:
             self.version += 1
 
     def bulk_append(self, columns: dict, n: int, handles=None,
-                    commit_ts: int = 1):
+                    commit_ts: int = 1, nulls=None):
         """Fast import path: columns maps column NAME -> numpy array (or
-        list). String arrays are dict-encoded here. Nulls via np.ma or None
-        not supported in bulk (import data is dense)."""
+        list). String arrays are dict-encoded here. `nulls` optionally
+        maps column NAME -> bool mask (segment reload); import data is
+        otherwise dense."""
         self._ensure(n)
         start = self.n
         if handles is None:
@@ -197,6 +198,8 @@ class ColumnarTable:
                 arr[start:start + n] = src
             else:
                 arr[start:start + n] = np.asarray(src, dtype=arr.dtype)
+            if nulls and ci.name in nulls:
+                self.nulls[ci.id][start:start + n] = nulls[ci.name]
         self.n += n
         # bulk rows never get row/index KV: index-driven read paths must
         # not be trusted for this table (planner gates on bulk_rows == 0,

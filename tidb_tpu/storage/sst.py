@@ -4,33 +4,39 @@ badger in unistore; single-node re-design: the WAL is the memtable's
 redo log, a flush rewrites it as one sorted run, compaction merges
 runs).
 
-Run file format (magic SST2, self-describing binary — never pickle):
+Run file format (magic SST3, self-describing binary — never pickle):
 
-    b"SST2"  u64 n_entries
-    n x ( u64 commit_ts  u32 klen  key  i32 vlen|-1  value )
+    b"SST3"  u64 n_entries
+    n x ( u64 commit_ts  f64 wallclock  u32 klen  key  i32 vlen|-1  value )
 
-Entries are sorted by (key, commit_ts). Recovery applies runs oldest
-file first; version lists are ts-ordered internally so replay order
-between runs only matters for identical (key, ts) pairs, which
-compaction dedups."""
+The wallclock rides along so PITR (RESTORE ... UNTIL TIMESTAMP) can
+filter flushed commits the same way it filters WAL frames. Entries are
+sorted by (key, commit_ts). Recovery applies runs oldest file first;
+version lists are ts-ordered internally so replay order between runs
+only matters for identical (key, ts) pairs, which compaction dedups."""
 from __future__ import annotations
 
 import os
 import re
 import struct
 
-_MAGIC = b"SST2"
+_MAGIC = b"SST3"
 
 
-def write_run(path: str, triples) -> int:
-    """triples: iterable of (commit_ts, key, value|None). Atomic
-    (tmp+rename), fsynced. Returns entry count."""
-    rows = sorted(triples, key=lambda t: (t[1], t[0]))
+def write_run(path: str, entries) -> int:
+    """entries: iterable of (commit_ts, key, value|None[, wall]).
+    Atomic (tmp+rename), fsynced. Returns entry count."""
+    rows = []
+    for e in entries:
+        ts, key, value = e[0], e[1], e[2]
+        wall = e[3] if len(e) > 3 else 0.0
+        rows.append((ts, key, value, wall))
+    rows.sort(key=lambda t: (t[1], t[0]))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC + struct.pack("<Q", len(rows)))
-        for ts, key, value in rows:
-            f.write(struct.pack("<QI", ts, len(key)))
+        for ts, key, value, wall in rows:
+            f.write(struct.pack("<QdI", ts, wall, len(key)))
             f.write(bytes(key))
             if value is None:
                 f.write(struct.pack("<i", -1))
@@ -44,7 +50,8 @@ def write_run(path: str, triples) -> int:
 
 
 def read_run(path: str):
-    """Yield (commit_ts, key, value|None); raises on foreign format."""
+    """Yield (commit_ts, key, value|None, wall); raises on foreign
+    format."""
     with open(path, "rb") as f:
         data = f.read()
     if not data.startswith(_MAGIC):
@@ -52,16 +59,16 @@ def read_run(path: str):
     (n,) = struct.unpack_from("<Q", data, 4)
     pos = 12
     for _ in range(n):
-        ts, klen = struct.unpack_from("<QI", data, pos)
-        pos += 12
+        ts, wall, klen = struct.unpack_from("<QdI", data, pos)
+        pos += 20
         key = data[pos:pos + klen]
         pos += klen
         (vlen,) = struct.unpack_from("<i", data, pos)
         pos += 4
         if vlen < 0:
-            yield ts, key, None
+            yield ts, key, None, wall
         else:
-            yield ts, key, data[pos:pos + vlen]
+            yield ts, key, data[pos:pos + vlen], wall
             pos += vlen
 
 
@@ -97,22 +104,22 @@ def compact(data_dir: str, keep_latest_only_below: int = 0) -> int:
         return 0
     merged: dict = {}
     for path in runs:                       # later files win on (k, ts)
-        for ts, key, value in read_run(path):
-            merged[(key, ts)] = value
-    entries = [(ts, k, v) for (k, ts), v in merged.items()]
+        for ts, key, value, wall in read_run(path):
+            merged[(key, ts)] = (value, wall)
+    entries = [(ts, k, v, w) for (k, ts), (v, w) in merged.items()]
     if keep_latest_only_below:
         sp = keep_latest_only_below
         by_key: dict = {}
-        for ts, k, v in entries:
-            by_key.setdefault(k, []).append((ts, v))
+        for ts, k, v, w in entries:
+            by_key.setdefault(k, []).append((ts, v, w))
         entries = []
         for k, vers in by_key.items():
-            vers.sort()
+            vers.sort(key=lambda t: t[0])
             # newest version at-or-below the safepoint survives; older
             # ones are unreachable by any snapshot >= safepoint
-            below = [t for t, _ in vers if t <= sp]
+            below = [t for t, _, _ in vers if t <= sp]
             cut = below[-1] if below else 0
-            entries.extend((t, k, v) for t, v in vers if t >= cut)
+            entries.extend((t, k, v, w) for t, v, w in vers if t >= cut)
     out = next_run_path(data_dir)
     n = write_run(out, entries)
     for path in runs:

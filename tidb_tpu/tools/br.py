@@ -178,10 +178,16 @@ def backup_log(domain, path: str) -> int:
     w = domain.storage.mvcc.wal
     if w is not None:
         w._f.flush()
+    # flushed LSM runs hold commits the WAL no longer does — they are
+    # part of the log backup (each entry carries its commit wallclock)
+    from ..storage import sst
+    for rp in sst.run_files(domain.data_dir):
+        shutil.copy2(rp, os.path.join(dst, os.path.basename(rp)))
+        n += 1
     if os.path.exists(wal):
         shutil.copy2(wal, os.path.join(dst, "commit.wal"))
         from ..storage.wal import replay as _replay
-        n = sum(1 for _ in _replay(os.path.join(dst, "commit.wal")))
+        n += sum(1 for _ in _replay(os.path.join(dst, "commit.wal")))
     ckpt = os.path.join(domain.data_dir, "checkpoint.snap")
     meta = {"backup_wall": time.time(), "has_checkpoint": False}
     if os.path.exists(ckpt):
@@ -223,9 +229,21 @@ def restore_pitr(domain, path: str, until_wall: float) -> int:
             domain.storage.oracle.fast_forward(ts)
             domain.storage.mvcc.apply_replay(ts, muts)
             applied += 1
+    # flushed runs first (older commits), then the WAL tail; both filter
+    # by commit wallclock. Skip (not break on) out-of-range entries:
+    # wallclocks are not guaranteed monotonic
+    from ..storage import sst
+    for rp in sst.run_files(dst):
+        by_ts: dict = {}
+        for ts, k, v, wall in sst.read_run(rp):
+            if wall > until_wall:
+                continue
+            by_ts.setdefault(ts, []).append((k, v))
+        for ts in sorted(by_ts):
+            domain.storage.oracle.fast_forward(ts)
+            domain.storage.mvcc.apply_replay(ts, by_ts[ts])
+            applied += 1
     from ..storage.wal import replay as _replay
-    # skip (not break on) out-of-range frames: commit wallclocks are not
-    # guaranteed monotonic, so a later frame may still precede the target
     for commit_ts, mutations, wall in _replay(
             os.path.join(dst, "commit.wal")):
         if wall > until_wall:
