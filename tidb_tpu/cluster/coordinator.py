@@ -1,0 +1,201 @@
+"""Cluster coordinator (reference roles: tidb-server's distsql/MPP
+dispatch — pkg/kv/mpp.go:183 DispatchMPPTasks — plus PD's TSO service
+consumed by every node). The coordinator owns the schema, broadcasts
+DDL to workers, shards bulk data, fans aggregation fragments out over
+the RPC seam, and merges the returned partials with the same final-agg
+machinery the single-process engine uses."""
+from __future__ import annotations
+
+import socket
+
+from .rpc import send_msg, recv_msg, deserialize_partials
+
+
+class _WorkerClient:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=60)
+
+    def call(self, msg, arrays=None):
+        send_msg(self.sock, msg, arrays)
+        out, arrs = recv_msg(self.sock)
+        if "err" in out:
+            raise RuntimeError(out["err"])
+        return out, arrs
+
+
+class Cluster:
+    """Coordinator session over N worker processes."""
+
+    def __init__(self, ports):
+        from ..session import new_store, Session
+        self.workers = [_WorkerClient(p) for p in ports]
+        # local schema-only domain: plans are built here, data lives on
+        # the workers
+        self.domain = new_store()
+        self.sess = Session(self.domain)
+        self.sess.vars.current_db = "test"
+
+    def ddl(self, sql: str):
+        self.sess.execute(sql)
+        for w in self.workers:
+            w.call({"op": "load_sql", "sqls": [sql]})
+
+    def load_shards(self, table: str, csv_path: str):
+        total = 0
+        for i, w in enumerate(self.workers):
+            out, _ = w.call({"op": "load_shard", "table": table,
+                             "csv": csv_path, "shard": i,
+                             "nshards": len(self.workers)})
+            total += out["rows"]
+        return total
+
+    def tso(self, worker=0) -> int:
+        out, _ = self.workers[worker].call({"op": "tso"})
+        return out["ts"]
+
+    def query_agg(self, sql: str):
+        """Fan the aggregation fragment out to every worker, merge the
+        partials locally, run the plan's post-agg operators."""
+        import threading
+        from ..parser import parse
+        from ..planner.optimize import optimize
+        from ..planner.physical import PhysHashAgg
+        from ..executor.exec_base import ExecContext
+        from ..executor.executors import HashAggExec
+        stmt = parse(sql)[0]
+        plan = optimize(stmt, self.sess._plan_ctx())
+        node = plan
+        while node is not None and not isinstance(node, PhysHashAgg):
+            node = node.children[0] if node.children else None
+        if node is None:
+            raise ValueError("query has no aggregation fragment")
+        # fan out in parallel (independent sockets), merge with ONE set
+        # of shared dictionaries so codes stay comparable across workers
+        results = [None] * len(self.workers)
+        errs = []
+
+        def fetch(i, w):
+            try:
+                results[i] = w.call({"op": "partial", "sql": sql})
+            except Exception as e:          # noqa: BLE001
+                errs.append(e)
+        threads = [threading.Thread(target=fetch, args=(i, w))
+                   for i, w in enumerate(self.workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        partials = []
+        shared_dicts: dict = {}
+        for out, arrs in results:
+            partials.extend(deserialize_partials(out, arrs,
+                                                 shared_dicts))
+
+        class _RemoteReader:
+            """Stands in for the TableReader: partials() returns what
+            the exchange delivered from the workers."""
+
+            def __init__(self, inner):
+                self._partials = inner
+
+            def partials(self):
+                return self._partials
+
+            def open(self):
+                pass
+
+            def close(self):
+                pass
+        ectx = ExecContext(self.sess)
+        agg = HashAggExec(ectx, _FinalPlanView(node),
+                          _RemoteReader(partials))
+        # rebuild the operators ABOVE the agg on the merged result
+        chunk = agg.next()
+        return self._apply_tail(plan, node, chunk, ectx)
+
+    def _apply_tail(self, plan, agg_node, chunk, ectx):
+        """Run post-agg operators (sort/topn/projection) on the merged
+        chunk by swapping the agg subtree for a static chunk source."""
+        class _ChunkSource:
+            def __init__(self, schema, ch):
+                self.schema = schema
+                self._ch = [ch] if ch is not None and len(ch) else []
+                self.children = []
+
+            def open(self):
+                pass
+
+            def next(self):
+                return self._ch.pop(0) if self._ch else None
+
+            def close(self):
+                pass
+
+            def all_chunks(self):
+                out = list(self._ch)
+                self._ch = []
+                return out
+        src = _ChunkSource(agg_node.schema, chunk)
+        path = []
+        node = plan
+        while node is not agg_node:
+            path.append(node)
+            node = node.children[0]
+        ex = src
+        for p in reversed(path):
+            ex = _shallow_with_child(ectx, p, ex)
+        out = []
+        ch = ex.next()
+        while ch is not None:
+            if len(ch):
+                out.append(ch)
+            ch = ex.next()
+        rows = []
+        for c in out:
+            for i in range(len(c)):
+                rows.append(c.row_py(i))
+        return rows
+
+    def query(self, sql: str, worker=0):
+        out, _ = self.workers[worker].call({"op": "query", "sql": sql})
+        return [tuple(r) for r in out["rows"]]
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                w.call({"op": "stop"})
+            except Exception:           # noqa: BLE001
+                pass
+
+
+class _FinalPlanView:
+    """HashAggExec-compatible view of a PhysHashAgg forced into final
+    mode (remote partials are always partial results)."""
+
+    def __init__(self, agg_node):
+        self.group_items = agg_node.group_items
+        self.aggs = agg_node.aggs
+        self.mode = "final"
+        self.schema = agg_node.schema
+
+
+def _shallow_with_child(ectx, plan, child_exec):
+    """Build a one-level executor for `plan` with child_exec as input."""
+    from ..executor import executors as X
+    from ..planner import physical as pp
+    if isinstance(plan, pp.PhysProjection):
+        return X.ProjectionExec(ectx, plan, child_exec)
+    if isinstance(plan, pp.PhysSort):
+        return X.SortExec(ectx, plan, child_exec)
+    if isinstance(plan, pp.PhysTopN):
+        return X.TopNExec(ectx, plan, child_exec)
+    if isinstance(plan, pp.PhysLimit):
+        return X.LimitExec(ectx, plan, child_exec)
+    if isinstance(plan, pp.PhysSelection):
+        return X.SelectionExec(ectx, plan, child_exec)
+    if isinstance(plan, pp.PhysShell):
+        return X.ShellExec(ectx, plan, child_exec)
+    raise ValueError(f"unsupported tail op {type(plan).__name__}")

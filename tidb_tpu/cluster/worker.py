@@ -1,0 +1,166 @@
+"""Cluster worker: one process = one store shard + copr executor
+(reference role: a TiKV/TiFlash node serving coprocessor/MPP requests
+over gRPC — pkg/store/copr server side; here the transport is
+cluster/rpc.py and the compute is the same CoprDAG device path the
+embedded engine runs).
+
+Ops:
+  load_sql     {sqls: [...]}                 bootstrap DDL/DML
+  load_shard   {table, csv, shard, nshards}  round-robin shard of a file
+  partial      {sql}                         plan locally, run the
+                                             pushed partial agg, return
+                                             serialized partials
+  tso          {}                            timestamp from this node's
+                                             oracle (PD role when the
+                                             worker is the TSO owner)
+  prewrite     {muts}/commit {start,commit}  the 2PC seam crossed by RPC
+  stop         {}
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from .rpc import send_msg, recv_msg, serialize_partials
+
+
+class WorkerServer:
+    def __init__(self, port=0):
+        from ..session import new_store, Session
+        self.domain = new_store()
+        self.sess = Session(self.domain)
+        self.sess.vars.current_db = "test"
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        self._pending: dict = {}       # start_ts -> prewritten mutations
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg, arrays = recv_msg(conn)
+                op = msg.get("op")
+                if op == "stop":
+                    send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    self._sock.close()
+                    return
+                try:
+                    out, out_arrays = self._handle(op, msg, arrays)
+                except Exception as e:          # noqa: BLE001
+                    out, out_arrays = {"err": f"{type(e).__name__}: {e}"}, {}
+                send_msg(conn, out, out_arrays)
+        except (ConnectionError, OSError):
+            pass
+
+    def _handle(self, op, msg, arrays):
+        if op == "load_sql":
+            for sql in msg["sqls"]:
+                self.sess.execute(sql)
+            return {"ok": True}, {}
+        if op == "load_shard":
+            n = self._load_shard(msg)
+            return {"ok": True, "rows": n}, {}
+        if op == "partial":
+            partials = self._partials(msg["sql"])
+            meta, arrs = serialize_partials(partials)
+            return {"ok": True, **meta}, arrs
+        if op == "tso":
+            return {"ok": True,
+                    "ts": self.domain.storage.oracle.get_ts()}, {}
+        if op == "prewrite":
+            muts = [(bytes(k), bytes(v) if v is not None else None)
+                    for k, v in zip(
+                        [arrays[f"k{i}"].tobytes()
+                         for i in range(msg["n"])],
+                        [arrays[f"v{i}"].tobytes()
+                         if msg["has_v"][i] else None
+                         for i in range(msg["n"])])]
+            self.domain.storage.mvcc.prewrite(
+                muts, muts[0][0], msg["start_ts"])
+            self._pending[msg["start_ts"]] = muts
+            return {"ok": True}, {}
+        if op == "commit":
+            muts = self._pending.pop(msg["start_ts"], None)
+            if muts is None:
+                raise ValueError(
+                    f"commit without prewrite (start_ts "
+                    f"{msg['start_ts']})")
+            self.domain.storage.mvcc.commit(
+                muts, msg["start_ts"], msg["commit_ts"])
+            self.domain.storage.oracle.fast_forward(msg["commit_ts"])
+            return {"ok": True}, {}
+        if op == "query":
+            rows = self.sess.execute(msg["sql"]).rows
+            return {"ok": True, "rows": [list(map(_py, r))
+                                         for r in rows]}, {}
+        raise ValueError(f"unknown op {op}")
+
+    def _load_shard(self, msg):
+        """Round-robin rows of a CSV into this worker's shard of the
+        table (the data-placement role of PD + region split)."""
+        shard, nshards = msg["shard"], msg["nshards"]
+        rows = []
+        with open(msg["csv"]) as f:
+            for i, line in enumerate(f):
+                if i % nshards == shard and line.strip():
+                    rows.append(line.strip())
+        if not rows:
+            return 0
+        vals = ",".join(f"({r})" for r in rows)
+        self.sess.execute(f"insert into {msg['table']} values {vals}")
+        return len(rows)
+
+    def _partials(self, sql):
+        """Plan the statement locally and drive the pushed partial-agg
+        reader over THIS shard (the coprocessor-request role)."""
+        from ..parser import parse
+        from ..planner.optimize import optimize
+        from ..planner.physical import PhysHashAgg
+        from ..executor.builder import build_executor
+        from ..executor.exec_base import ExecContext
+        stmt = parse(sql)[0]
+        plan = optimize(stmt, self.sess._plan_ctx())
+        node = plan
+        while node is not None and not isinstance(node, PhysHashAgg):
+            node = node.children[0] if node.children else None
+        if node is None:
+            raise ValueError("no aggregation in fragment sql")
+        ectx = ExecContext(self.sess)
+        agg = build_executor(ectx, node)
+        return agg.children[0].partials()
+
+
+def _py(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def serve_worker(port):
+    """Entry for `python -m tidb_tpu.cluster.worker PORT`."""
+    w = WorkerServer(port)
+    print(f"WORKER_READY {w.port}", flush=True)
+    w.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+    serve_worker(int(sys.argv[1]) if len(sys.argv) > 1 else 0)

@@ -193,9 +193,21 @@ def encode_row_value(datums: list) -> bytes:
             buf.append(3)
             buf += struct.pack("<d", d.val)
         elif k == Kind.DECIMAL:
-            buf.append(4)
-            buf.append(d.scale & 0xFF)
-            buf += struct.pack("<q", d.val)
+            v = int(d.val)
+            if -(1 << 63) <= v < (1 << 63):
+                buf.append(4)
+                buf.append(d.scale & 0xFF)
+                buf += struct.pack("<q", v)
+            else:
+                # big decimal (precision > 18): sign + variable-length
+                # magnitude (reference MyDecimal is exact to 65 digits)
+                buf.append(7)
+                buf.append(d.scale & 0xFF)
+                buf.append(1 if v < 0 else 0)
+                mag = abs(v).to_bytes((abs(v).bit_length() + 7) // 8,
+                                      "big")
+                buf += struct.pack("<I", len(mag))
+                buf += mag
         elif k in (Kind.STRING, Kind.BYTES):
             raw = d.val.encode("utf-8", "surrogateescape") if k == Kind.STRING else d.val
             buf.append(5 if k == Kind.STRING else 6)
@@ -233,6 +245,13 @@ def decode_row_value(b: bytes) -> list:
             (v,) = struct.unpack_from("<q", b, pos + 1)
             out.append(Datum(Kind.DECIMAL, v, scale))
             pos += 9
+        elif tag == 7:
+            scale = b[pos]
+            neg = b[pos + 1]
+            (ln,) = struct.unpack_from("<I", b, pos + 2)
+            mag = int.from_bytes(b[pos + 6:pos + 6 + ln], "big")
+            out.append(Datum(Kind.DECIMAL, -mag if neg else mag, scale))
+            pos += 6 + ln
         elif tag in (5, 6):
             (ln,) = struct.unpack_from("<I", b, pos)
             raw = b[pos + 4:pos + 4 + ln]

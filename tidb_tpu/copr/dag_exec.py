@@ -665,6 +665,9 @@ def capture_agg_dicts(dag, cols):
 
 def _dag_device_ready(dag) -> bool:
     from ..expression.vec import is_device_safe
+    for sc in dag.cols:
+        if not is_device_safe(sc.col):
+            return False           # e.g. big-decimal object columns
     for f in dag.filters:
         if not is_device_safe(f):
             return False

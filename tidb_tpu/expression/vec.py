@@ -99,14 +99,29 @@ def _scale_of(ft: FieldType):
 
 
 def _rescale_up(xp, v, k):
-    return v * _POW10[k] if k > 0 else v
+    if k <= 0:
+        return v
+    if k >= len(_POW10):
+        # big-decimal scales (>18 digits): exact python-int arithmetic
+        # over object arrays (host path only — device-safety gates these)
+        if hasattr(v, "astype"):
+            v = v.astype(object)
+        return v * (10 ** k)
+    if hasattr(v, "dtype") and v.dtype == object:
+        return v * (10 ** k)
+    return v * _POW10[k]
 
 
 def _rescale_down_round(xp, v, k):
     """Divide scaled int by 10^k, rounding half away from zero."""
     if k <= 0:
         return v
-    d = _POW10[k]
+    d = 10 ** k if k >= len(_POW10) else _POW10[k]
+    if hasattr(v, "dtype") and v.dtype == object:
+        out = np.array([(x + d // 2) // d if x >= 0
+                        else -((-x + d // 2) // d) for x in v],
+                       dtype=object)
+        return out
     h = d // 2
     pos = (v + h) // d
     neg = -((-v + h) // d)
@@ -120,7 +135,10 @@ def _to_float(ctx, data, ft):
         return xp.asarray(data, dtype=ctx.float_dtype) if not np.isscalar(data) else data
     if cls == "decimal":
         s = _scale_of(ft)
-        return xp.asarray(data, dtype=ctx.float_dtype) / _POW10[s]
+        p = 10 ** s if s >= len(_POW10) else _POW10[s]
+        if hasattr(data, "dtype") and data.dtype == object:
+            data = np.array([float(x) for x in data])
+        return xp.asarray(data, dtype=ctx.float_dtype) / float(p)
     return xp.asarray(data, dtype=ctx.float_dtype) if not np.isscalar(data) \
         else float(data)
 
@@ -182,7 +200,9 @@ def eval_bool_mask(ctx: EvalCtx, expr: Expression):
         if nulls is not None and nulls is not True and nulls is not False:
             m = m & ~nulls
         return m
-    if data.dtype != bool:
+    if data.dtype == object:
+        data = np.array([bool(v) for v in data], dtype=bool)
+    elif data.dtype != bool:
         data = data != 0
     if nulls is None or nulls is False:
         return data
@@ -206,14 +226,27 @@ def op(*names):
 
 def is_device_safe(expr: Expression) -> bool:
     """Can this expression run inside a jit kernel? String ops qualify via
-    dict tables; only explicitly host-bound ops are excluded."""
-    if isinstance(expr, (Column, Constant)):
+    dict tables; only explicitly host-bound ops are excluded. Big
+    decimals (precision > 18) live in python-int object arrays — exact,
+    host-only (reference MyDecimal semantics; hi/lo limb kernels are the
+    device roadmap)."""
+    if isinstance(expr, Column):
+        ft = expr.ft
+        if ft is not None and ft.tclass == TypeClass.DECIMAL and \
+                max(ft.decimal, 0) > 18:
+            return False
+        return True
+    if isinstance(expr, Constant):
         return True
     if isinstance(expr, ScalarFunc):
         if expr.op in _HOST_ONLY:
             return False
         if expr.op not in _REGISTRY:
             return False
+        ft = expr.ft
+        if ft is not None and ft.tclass == TypeClass.DECIMAL and \
+                max(ft.decimal, 0) > 18:
+            return False       # result scale needs >int64 precision
         return all(is_device_safe(a) for a in expr.args)
     return False
 
@@ -331,8 +364,17 @@ def op_mul(ctx, expr):
         return r, or_nulls(xp, an, bn), None
     if "decimal" in (ca, cb):
         s = _scale_of(aft) + _scale_of(bft)
-        r = a * b
         ts = _scale_of(expr.ft)
+        if ts > 18 and ctx.host:
+            # result scale beyond int64: exact python-int multiply
+            # (small-scale int64 operands would silently overflow)
+            def _obj(v):
+                if hasattr(v, "astype"):
+                    return v.astype(object)
+                return int(v) if not isinstance(v, float) else v
+            r = _obj(a) * _obj(b)
+        else:
+            r = a * b
         if ts != s:
             r = _rescale_up(xp, r, ts - s) if ts > s else \
                 _rescale_down_round(xp, r, s - ts)
@@ -348,13 +390,36 @@ def op_div(ctx, expr):
     aft, bft = expr.args[0].ft, expr.args[1].ft
     xp = ctx.xp
     if expr.ft.tclass == TypeClass.DECIMAL:
+        ts = _scale_of(expr.ft)
+        if ctx.host and ts > 18:
+            # big-decimal result: exact python-int long division
+            # (host path only; MySQL rounds half away from zero)
+            sa, sb = _scale_of(aft), _scale_of(bft)
+            av = a if hasattr(a, "__len__") else np.full(ctx.n, a,
+                                                         dtype=object)
+            bv = b if hasattr(b, "__len__") else np.full(ctx.n, b,
+                                                         dtype=object)
+            out = np.zeros(ctx.n, dtype=object)
+            zmask = np.zeros(ctx.n, dtype=bool)
+            mul = 10 ** (ts - sa + sb)
+            for i in range(ctx.n):
+                bi = int(bv[i])
+                if bi == 0:
+                    zmask[i] = True
+                    continue
+                num = int(av[i]) * mul
+                q, r = divmod(abs(num), abs(bi))
+                if 2 * r >= abs(bi):
+                    q += 1
+                out[i] = q if (num >= 0) == (bi >= 0) else -q
+            return out, or_nulls(xp, an, bn,
+                                 zmask if zmask.any() else None), None
         # Compute in float64 and round back to the target scale grid:
         # rescaling the numerator in int64 overflows once
         # |a| * 10^(ts-sa+sb) exceeds 2^63 (e.g. Q14's percentage over
         # SF-scale revenue sums). float64 keeps ~15 significant digits,
         # comfortably above DECIMAL display needs here; the exact integer
         # path remains in AVG finalization (host, python ints).
-        ts = _scale_of(expr.ft)
         fa = _to_float(ctx, a, aft)
         fb = _to_float(ctx, b, bft)
         bz = fb == 0

@@ -104,19 +104,17 @@ def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,
             sa = max(lft.decimal, 0) if lft.tclass == TypeClass.DECIMAL else 0
             sb = max(rft.decimal, 0) if rft.tclass == TypeClass.DECIMAL else 0
             scale = sa + sb if op == "*" else max(sa, sb)
-            if scale > 18:
-                return new_double_type()
-            return new_decimal_type(38, scale)
+            # MySQL caps result scale at 30 (exact beyond 18 via the
+            # big-decimal object path; reference mydecimal.go)
+            return new_decimal_type(65, min(scale, 30))
         return m
     if op == "/":
         lc, rc = lft.tclass, rft.tclass
         if TypeClass.FLOAT in (lc, rc) or TypeClass.STRING in (lc, rc):
             return new_double_type()
         sa = max(lft.decimal, 0) if lc == TypeClass.DECIMAL else 0
-        scale = sa + div_incr
-        if scale > 18:
-            return new_double_type()
-        return new_decimal_type(38, scale)
+        scale = min(sa + div_incr, 30)
+        return new_decimal_type(65, scale)
     if op in ("%",):
         m = merge_field_type(lft, rft)
         return m

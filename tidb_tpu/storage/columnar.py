@@ -24,6 +24,12 @@ from ..types.datum import Datum, Kind
 _CTAB_UID = [0]
 
 
+def _is_big_decimal(ft) -> bool:
+    # scale > 18 cannot ride the scaled-int64 fast path; precision <= 38
+    # with small scale keeps int64 (the documented money-scale trade)
+    return ft.tclass == TypeClass.DECIMAL and max(ft.decimal, 0) > 18
+
+
 class ColumnarTable:
     """Row-versioned columnar store: per-row (insert_ts, delete_ts) arrays
     give MVCC snapshot scans (TiFlash delta-tree role). delete_ts == 0 means
@@ -57,6 +63,12 @@ class ColumnarTable:
                 self.dicts[ci.id] = StringDict()
             elif ci.ft.tclass == TypeClass.FLOAT:
                 self.data[ci.id] = np.zeros(self.cap, dtype=np.float64)
+            elif _is_big_decimal(ci.ft):
+                # precision > 18: python-int object array — EXACT host
+                # arithmetic (reference MyDecimal's 65 digits); such
+                # columns are host-path-only (expression/vec.py
+                # is_device_safe routes around them)
+                self.data[ci.id] = np.zeros(self.cap, dtype=object)
             else:
                 self.data[ci.id] = np.zeros(self.cap, dtype=np.int64)
             self.nulls[ci.id] = np.zeros(self.cap, dtype=bool)
@@ -73,6 +85,8 @@ class ColumnarTable:
                     self.dicts[ci.id] = StringDict()
                 elif ci.ft.tclass == TypeClass.FLOAT:
                     arr = np.zeros(self.cap, dtype=np.float64)
+                elif _is_big_decimal(ci.ft):
+                    arr = np.zeros(self.cap, dtype=object)
                 else:
                     arr = np.zeros(self.cap, dtype=np.int64)
                 nulls = np.zeros(self.cap, dtype=bool)
@@ -157,7 +171,7 @@ class ColumnarTable:
                 arr[pos] = float(d.val)
             else:
                 v = int(d.val)
-                if v > 0x7FFFFFFFFFFFFFFF:
+                if arr.dtype != object and v > 0x7FFFFFFFFFFFFFFF:
                     v -= 1 << 64       # unsigned upper half as bit pattern
                 arr[pos] = v
         self.n = pos + 1
