@@ -132,3 +132,20 @@ def test_string_group_keys_cross_worker(cluster):
                             "order by name")
     assert [tuple(r) for r in got] == [
         ("apple", "1"), ("banana", "6"), ("cherry", "8")]
+
+
+def test_owner_election_over_rpc(cluster):
+    """Two coordinators campaign through the worker's lease authority:
+    one DDL owner at a time, failover on resign (owner/manager.go)."""
+    from tidb_tpu.owner import OwnerManager
+    from tidb_tpu.owner.manager import remote_store
+    store = remote_store(cluster.workers[0])
+    a = OwnerManager(store, "ddl-owner", "coord-a", ttl=1.0)
+    b = OwnerManager(store, "ddl-owner", "coord-b", ttl=1.0)
+    assert a.campaign()
+    assert not b.campaign()
+    assert store.holder("ddl-owner") == "coord-a"
+    a.resign()
+    assert b.campaign()
+    assert store.holder("ddl-owner") == "coord-b"
+    b.resign()

@@ -248,3 +248,66 @@ def test_wire_auth(server):
             c2.query("create table alice_t (a int)")
     finally:
         c2.close()
+
+
+def _self_signed(tmpdir):
+    """Self-signed cert via openssl (baked into the image)."""
+    import os
+    import subprocess
+    cert = os.path.join(tmpdir, "cert.pem")
+    key = os.path.join(tmpdir, "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1", "-subj",
+         "/CN=localhost"], check=True, capture_output=True)
+    return cert, key
+
+
+def test_wire_tls(tmp_path):
+    """TLS upgrade: SSLRequest packet -> wrapped socket -> normal
+    handshake + queries over TLS (reference server.go onConn TLS)."""
+    import ssl
+    import struct as _struct
+    from tidb_tpu.session import new_store
+    cert, key = _self_signed(str(tmp_path))
+    domain = new_store()
+    srv = Server(domain, port=0, tls_cert=cert, tls_key=key).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        io = P.PacketIO(sock)
+        greeting = io.read_packet()
+        caps_lo = _struct.unpack_from(
+            "<H", greeting, greeting.index(b"\x00", 1) + 13 + 1)[0]
+        assert caps_lo & P.CLIENT_SSL        # server advertises TLS
+        caps = (P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION |
+                P.CLIENT_SSL)
+        # SSLRequest: caps header only, then upgrade
+        io.write_packet(_struct.pack("<IIB", caps, 1 << 24, 46) +
+                        b"\x00" * 23)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        tsock = ctx.wrap_socket(sock)
+        tio = P.PacketIO(tsock)
+        tio.seq = io.seq
+        resp = (_struct.pack("<IIB", caps, 1 << 24, 46) + b"\x00" * 23 +
+                b"root\x00" + b"\x00")
+        tio.write_packet(resp)
+        ok = tio.read_packet()
+        assert ok[0] == 0x00, ok
+        tio.reset_seq()
+        tio.write_packet(bytes([P.COM_QUERY]) + b"select 40 + 2")
+        first = tio.read_packet()
+        assert first[0] == 1                 # one column
+        tio.read_packet()                    # col def
+        tio.read_packet()                    # eof
+        row = tio.read_packet()
+        assert row.endswith(b"42")
+        tsock.close()
+        # plaintext connections still work alongside TLS
+        c = MiniClient(srv.port, db="test")
+        assert c.query("select 1")["rows"] == [("1",)]
+        c.close()
+    finally:
+        srv.shutdown()

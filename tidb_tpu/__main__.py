@@ -53,6 +53,9 @@ def main(argv=None):
                     help="persist commits to a WAL in this directory")
     ap.add_argument("--cpu", action="store_true",
                     help="force the jax CPU backend (no TPU init)")
+    ap.add_argument("--tls-cert", default=None,
+                    help="PEM certificate enabling TLS on the wire")
+    ap.add_argument("--tls-key", default=None)
     args = ap.parse_args(argv)
     if args.cpu:
         from . import force_cpu_backend
@@ -62,7 +65,8 @@ def main(argv=None):
     if args.serve:
         domain.start_background()
         from .server import Server
-        srv = Server(domain, port=args.port).start()
+        srv = Server(domain, port=args.port, tls_cert=args.tls_cert,
+                     tls_key=args.tls_key).start()
         print(f"listening on 127.0.0.1:{srv.port} (MySQL protocol)")
         import time
         try:

@@ -13,6 +13,7 @@ from .rpc import send_msg, recv_msg, deserialize_partials
 
 class _WorkerClient:
     def __init__(self, port):
+        self.port = port
         self.sock = socket.create_connection(("127.0.0.1", port),
                                              timeout=60)
 

@@ -39,6 +39,8 @@ class WorkerServer:
         self._sock.listen(16)
         self._stop = threading.Event()
         self._pending: dict = {}       # start_ts -> prewritten mutations
+        from ..owner import LocalLeaseStore
+        self._leases = LocalLeaseStore()
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -109,6 +111,22 @@ class WorkerServer:
             rows = self.sess.execute(msg["sql"]).rows
             return {"ok": True, "rows": [list(map(_py, r))
                                          for r in rows]}, {}
+        if op == "lease":
+            # owner-election authority (PD role; reference
+            # owner/manager.go etcd campaign)
+            ls = self._leases
+            act = msg["action"]
+            if act == "acquire":
+                return {"ok": True, "granted": ls.acquire(
+                    msg["key"], msg["node"], msg["ttl"])}, {}
+            if act == "renew":
+                return {"ok": True, "granted": ls.renew(
+                    msg["key"], msg["node"], msg["ttl"])}, {}
+            if act == "resign":
+                ls.resign(msg["key"], msg["node"])
+                return {"ok": True}, {}
+            if act == "holder":
+                return {"ok": True, "holder": ls.holder(msg["key"])}, {}
         raise ValueError(f"unknown op {op}")
 
     def _load_shard(self, msg):

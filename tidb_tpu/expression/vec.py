@@ -975,6 +975,9 @@ def op_char_length(ctx, expr):
 @op("concat")
 def op_concat(ctx, expr):
     vals = [eval_expr(ctx, a) for a in expr.args]
+    # a constant-NULL argument nullifies every row (MySQL semantics)
+    if any(v[1] is True for v in vals):
+        return "", True, None
     # all-scalar fast path
     if all(isinstance(v[0], str) for v in vals):
         return "".join(v[0] for v in vals), or_nulls(ctx.xp, *[v[1] for v in vals]), None
@@ -1552,10 +1555,23 @@ def op_week(ctx, expr):
 
 @op("unix_timestamp")
 def op_unix_ts(ctx, expr):
-    a, an, _ = eval_expr(ctx, expr.args[0])
+    a, an, sd = eval_expr(ctx, expr.args[0])
     tc = expr.args[0].ft.tclass
     if tc == TypeClass.DATE:
         return a * 86400, an, None
+    if isinstance(a, str) or sd is not None or \
+            (hasattr(a, "dtype") and a.dtype == object):
+        from ..types.time_types import parse_datetime, parse_date
+
+        def p(s):
+            s = str(s)
+            try:
+                return (parse_date(s) * 86400 if len(s) == 10
+                        else parse_datetime(s) // MICROS_PER_SEC)
+            except Exception:           # noqa: BLE001
+                return 0
+        r = _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
+        return r[0], r[1], None
     return a // MICROS_PER_SEC, an, None
 
 

@@ -1,0 +1,3 @@
+from .manager import OwnerManager, LocalLeaseStore
+
+__all__ = ["OwnerManager", "LocalLeaseStore"]

@@ -340,6 +340,7 @@ class Limit(Node):
 class SelectStmt(StmtNode):
     # set via INTO OUTFILE 'path'
     into_outfile: str = ""
+    straight_join: bool = False      # SELECT STRAIGHT_JOIN: no reorder
     fields: list = field(default_factory=list)    # [SelectField|Wildcard]
     distinct: bool = False
     from_clause: Node | None = None

@@ -352,6 +352,21 @@ class Parser:
             sel = ast.SelectStmt()
             sel.distinct = bool(self.accept_kw("distinct"))
             self.accept_kw("all")
+            # select modifiers in ANY order (STRAIGHT_JOIN pins the
+            # writer's join order; cache/priority modifiers are accepted
+            # no-ops like the reference)
+            _mods = ("sql_no_cache", "sql_cache", "high_priority",
+                     "sql_calc_found_rows", "sql_small_result",
+                     "sql_big_result", "sql_buffer_result")
+            progress = True
+            while progress:
+                progress = False
+                if self.accept_kw("straight_join"):
+                    sel.straight_join = True
+                    progress = True
+                for kw in _mods:
+                    if self.accept_kw(kw):
+                        progress = True
             sel.fields = self.parse_select_fields()
             if self.accept_kw("from"):
                 sel.from_clause = self.parse_table_refs()

@@ -86,7 +86,9 @@ def optimize(stmt, pctx: PlanContext):
     hints = getattr(stmt, "hints", None) or []
     if isinstance(stmt, ast.SelectStmt):
         logical = builder.build_select(stmt)
-        logical = optimize_logical(logical, hints=hints)
+        logical = optimize_logical(
+            logical, hints=hints,
+            no_reorder=getattr(stmt, "straight_join", False))
         phys = to_physical(logical, pctx.sess_vars, hints=hints)
         try:
             mpp_on = bool(pctx.sess_vars.get("tidb_enable_mpp"))
@@ -108,8 +110,11 @@ def optimize(stmt, pctx: PlanContext):
     if isinstance(stmt, ast.InsertStmt):
         plan = builder.build_insert(stmt)
         if plan.select_plan is not None:
-            plan.select_plan = to_physical(optimize_logical(plan.select_plan),
-                                           pctx.sess_vars)
+            nr = getattr(getattr(stmt, "select", None), "straight_join",
+                         False)
+            plan.select_plan = to_physical(
+                optimize_logical(plan.select_plan, no_reorder=nr),
+                pctx.sess_vars)
         return plan
     if isinstance(stmt, ast.UpdateStmt):
         plan = builder.build_update(stmt)

@@ -11,13 +11,14 @@ from .builder import ProjShell
 
 
 def optimize_logical(plan: LogicalPlan, keep_handles=False,
-                     hints=None) -> LogicalPlan:
+                     hints=None, no_reorder=False) -> LogicalPlan:
     leading = []
     if hints:
         from ..parser.hints import leading_order
         leading = leading_order(hints)
     plan = push_down_predicates(plan, [])
-    plan = reorder_joins(plan, leading)
+    if not no_reorder:
+        plan = reorder_joins(plan, leading)
     used = {sc.col.idx for sc in plan.schema.cols}
     prune_columns(plan, used)
     plan = build_topn(plan)

@@ -15,6 +15,7 @@ CLIENT_FOUND_ROWS = 0x2
 CLIENT_LONG_FLAG = 0x4
 CLIENT_CONNECT_WITH_DB = 0x8
 CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SSL = 0x800
 CLIENT_TRANSACTIONS = 0x2000
 CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
@@ -89,16 +90,18 @@ class PacketIO:
         self.seq = 0
 
 
-def handshake_packet(conn_id: int, salt: bytes, server_version: str) -> bytes:
+def handshake_packet(conn_id: int, salt: bytes, server_version: str,
+                     with_tls: bool = False) -> bytes:
+    caps = SERVER_CAPS | (CLIENT_SSL if with_tls else 0)
     out = bytearray()
     out.append(10)                                        # protocol version
     out += server_version.encode() + b"\x00"
     out += struct.pack("<I", conn_id)
     out += salt[:8] + b"\x00"
-    out += struct.pack("<H", SERVER_CAPS & 0xFFFF)
+    out += struct.pack("<H", caps & 0xFFFF)
     out.append(46)                                        # charset utf8mb4
     out += struct.pack("<H", 2)                           # status: autocommit
-    out += struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+    out += struct.pack("<H", (caps >> 16) & 0xFFFF)
     out.append(21)                                        # auth data len
     out += b"\x00" * 10
     out += salt[8:20] + b"\x00"

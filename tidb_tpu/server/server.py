@@ -13,13 +13,20 @@ from . import protocol as P
 
 
 class Server:
-    def __init__(self, domain: Domain, host="127.0.0.1", port=4000):
+    def __init__(self, domain: Domain, host="127.0.0.1", port=4000,
+                 tls_cert=None, tls_key=None):
         self.domain = domain
         self.host = host
         self.port = port
         self._sock = None
         self._threads: list = []
         self._running = False
+        self._ssl_ctx = None
+        if tls_cert and tls_key:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._ssl_ctx = ctx
 
     def start(self):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -59,8 +66,21 @@ class Server:
         try:
             salt = os.urandom(20)
             io.write_packet(P.handshake_packet(
-                sess.conn_id, salt, "8.0.11-tidb-tpu-0.1.0"))
+                sess.conn_id, salt, "8.0.11-tidb-tpu-0.1.0",
+                with_tls=self._ssl_ctx is not None))
             resp = io.read_packet()
+            caps0 = int.from_bytes(resp[:4], "little") if len(resp) >= 4 \
+                else 0
+            if self._ssl_ctx is not None and (caps0 & P.CLIENT_SSL) and \
+                    len(resp) <= 32:
+                # SSL request packet: upgrade the connection, then read
+                # the real handshake response over TLS (reference
+                # server/conn.go upgradeToTLS)
+                sock = self._ssl_ctx.wrap_socket(sock, server_side=True)
+                seq = io.seq
+                io = P.PacketIO(sock)
+                io.seq = seq
+                resp = io.read_packet()
             user, db, caps, token = P.parse_handshake_response(resp)
             try:
                 peer_host = sock.getpeername()[0]

@@ -501,16 +501,35 @@ class DDLExecutor:
         self._with_meta(fn)
 
     def _alter_drop_column(self, tn, name):
+        # MySQL drops SINGLE-column indexes on the dropped column
+        # automatically; multi-column indexes refuse (reference
+        # ddl/column.go checkDropColumnWithIndex). ALL validation runs
+        # BEFORE the index drops: a failing ALTER must not leave
+        # committed schema mutations behind.
+        db_name = tn.db or self.sess.vars.current_db
+        tbl0 = self.domain.infoschema().table_by_name(db_name, tn.name)
+        if tbl0.find_column(name) is None:
+            raise ColumnNotExistsError("Unknown column '%s'", name)
+        if tbl0.pk_is_handle and tbl0.pk_col_name.lower() == name.lower():
+            raise UnsupportedError("cannot drop the primary key column")
+        to_drop = []
+        for idx in tbl0.indexes:
+            cols = [c.lower() for c in idx.columns]
+            if name.lower() in cols:
+                if len(cols) > 1:
+                    raise UnsupportedError(
+                        "cannot drop column '%s' covered by multi-column "
+                        "index '%s'", name, idx.name)
+                to_drop.append(idx.name)
+        for iname in to_drop:
+            self.drop_index(ast.DropIndexStmt(index_name=iname,
+                                              table=tn))
+
         def fn(m):
             db, tbl = self._get_table(m, tn)
             ci = tbl.find_column(name)
             if ci is None:
                 raise ColumnNotExistsError("Unknown column '%s'", name)
-            for idx in tbl.indexes:
-                if name.lower() in [c.lower() for c in idx.columns]:
-                    raise UnsupportedError(
-                        "cannot drop column '%s' covered by index '%s'",
-                        name, idx.name)
             if tbl.pk_is_handle and tbl.pk_col_name.lower() == name.lower():
                 raise UnsupportedError("cannot drop the primary key column")
             tbl.columns = [c for c in tbl.columns if c is not ci]
