@@ -227,3 +227,26 @@ def test_digest():
     assert d1 == d2
     n3, d3 = normalize_digest("select * from t where a = 5 and c in (1)")
     assert d3 != d1
+
+
+def test_select_modifiers():
+    from tidb_tpu.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create table sm (sql_cache int, a int)")
+    tk.must_exec("insert into sm values (1, 2)")
+    # non-reserved modifier words stay usable as column names
+    assert tk.must_query("select sql_cache from sm").rs.rows == [(1,)]
+    assert tk.must_query("select sql_cache, a from sm").rs.rows == [(1, 2)]
+    # modifier forms, any order
+    assert tk.must_query("select sql_no_cache a from sm").rs.rows == [(2,)]
+    assert tk.must_query(
+        "select straight_join distinct a from sm").rs.rows == [(2,)]
+    assert tk.must_query(
+        "select high_priority straight_join a from sm").rs.rows == [(2,)]
+
+
+def test_unix_timestamp_invalid_null():
+    from tidb_tpu.testkit import TestKit
+    tk = TestKit()
+    assert tk.must_query("select unix_timestamp('garbage')").rs.rows == \
+        [(None,)]

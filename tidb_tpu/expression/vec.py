@@ -1565,13 +1565,10 @@ def op_unix_ts(ctx, expr):
 
         def p(s):
             s = str(s)
-            try:
-                return (parse_date(s) * 86400 if len(s) == 10
-                        else parse_datetime(s) // MICROS_PER_SEC)
-            except Exception:           # noqa: BLE001
-                return 0
-        r = _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
-        return r[0], r[1], None
+            # unparseable -> None (NULL), matching MySQL 8.0
+            return (parse_date(s) * 86400 if len(s) == 10
+                    else parse_datetime(s) // MICROS_PER_SEC)
+        return _rowwise(ctx, expr, p, dtype=np.int64)
     return a // MICROS_PER_SEC, an, None
 
 

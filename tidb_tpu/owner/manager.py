@@ -96,22 +96,22 @@ class OwnerManager:
 
     def campaign(self) -> bool:
         ok = self.store.acquire(self.key, self.node_id, self.ttl)
-        if ok and (self._thread is None or not self._thread.is_alive()):
-            # a previous renew loop may have exited on a lost lease; a
-            # re-won campaign needs a FRESH renewer or ownership lapses
-            # after one ttl
-            self._stop = threading.Event()
-            self._thread = threading.Thread(target=self._renew_loop,
-                                            daemon=True)
+        if ok:
+            # ALWAYS swap in a fresh renewer: the old loop (if any) may
+            # be mid-exit after a lost lease — checking is_alive() races
+            # with it and can leave a won lease with no renewer
+            self._stop.set()
+            stop = threading.Event()
+            self._stop = stop
+
+            def loop():
+                while not stop.wait(self.ttl / 3.0):
+                    if not self.store.renew(self.key, self.node_id,
+                                            self.ttl):
+                        return
+            self._thread = threading.Thread(target=loop, daemon=True)
             self._thread.start()
         return ok
-
-    def _renew_loop(self):
-        while not self._stop.wait(self.ttl / 3.0):
-            if not self.store.renew(self.key, self.node_id, self.ttl):
-                # lost the lease (partition/pause): stop renewing; a
-                # later campaign() may re-acquire
-                return
 
     def is_owner(self) -> bool:
         return self.store.holder(self.key) == self.node_id

@@ -350,23 +350,36 @@ class Parser:
             while self.peek().kind == "HINT":
                 self.next()
             sel = ast.SelectStmt()
-            sel.distinct = bool(self.accept_kw("distinct"))
-            self.accept_kw("all")
-            # select modifiers in ANY order (STRAIGHT_JOIN pins the
-            # writer's join order; cache/priority modifiers are accepted
-            # no-ops like the reference)
-            _mods = ("sql_no_cache", "sql_cache", "high_priority",
-                     "sql_calc_found_rows", "sql_small_result",
-                     "sql_big_result", "sql_buffer_result")
+            # select modifiers in ANY order. STRAIGHT_JOIN/DISTINCT/ALL
+            # are reserved; the cache/priority words are NOT (they can
+            # name columns), so only consume one when the next token
+            # could still start a select list — `select sql_cache from
+            # t` must keep sql_cache as a column reference
+            _soft_mods = ("sql_no_cache", "sql_cache", "high_priority",
+                          "sql_calc_found_rows", "sql_small_result",
+                          "sql_big_result", "sql_buffer_result")
             progress = True
             while progress:
                 progress = False
                 if self.accept_kw("straight_join"):
                     sel.straight_join = True
                     progress = True
-                for kw in _mods:
-                    if self.accept_kw(kw):
-                        progress = True
+                if self.accept_kw("distinct") or \
+                        self.accept_kw("distinctrow"):
+                    sel.distinct = True
+                    progress = True
+                if self.accept_kw("all"):
+                    progress = True
+                nxt = self.peek(1)
+                if not (nxt.kind == "OP" and nxt.text in (",", ";")) \
+                        and not (nxt.kind == "IDENT" and
+                                 nxt.text.lower() == "from") \
+                        and nxt.kind != "EOF":
+                    for kw in _soft_mods:
+                        if self.at_kw(kw):
+                            self.next()
+                            progress = True
+                            break
             sel.fields = self.parse_select_fields()
             if self.accept_kw("from"):
                 sel.from_clause = self.parse_table_refs()

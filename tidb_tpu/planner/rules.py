@@ -166,6 +166,88 @@ def _greedy_order(rels, eqs, id_of, rel_of, start, ndv_cache=None):
     return order, total
 
 
+def _dp_order(rels, eqs, id_of, ndv_cache):
+    """Exact join-order search by dynamic programming over relation
+    subsets (reference planner/core/rule_join_reorder_dp.go): for every
+    subset, the cheapest way to build it from two joined halves, cost =
+    cumulative intermediate cardinality under the NDV model. Returns a
+    binary order tree ('leaf', i) | ('join', l, r, est) or None when
+    too many relations (2^n blowup — caller falls back to greedy)."""
+    from ..expression import Column as _Col
+    n = len(rels)
+    if n > 8:
+        return None
+
+    def cached_ndv(idx):
+        if idx not in ndv_cache:
+            ndv_cache[idx] = _col_ndv(rels, id_of, idx)
+        return ndv_cache[idx]
+
+    # eq conds as (bitmask_left, bitmask_right, max ndv of bare keys)
+    edges = []
+    for a, b in eqs:
+        ma = 0
+        for ci in _cols_of(a):
+            o = id_of.get(ci)
+            if o is not None:
+                ma |= 1 << o
+        mb = 0
+        for ci in _cols_of(b):
+            o = id_of.get(ci)
+            if o is not None:
+                mb |= 1 << o
+        ndv = None
+        for e in (a, b):
+            if isinstance(e, _Col):
+                v = cached_ndv(e.idx)
+                if v is not None:
+                    ndv = max(ndv or 1, v)
+        edges.append((ma, mb, ndv))
+
+    rows = [max(float(r.stats_rows), 1.0) for r in rels]
+    # best[mask] = (cost, out_rows, tree)
+    best = {1 << i: (0.0, rows[i], ("leaf", i)) for i in range(n)}
+    for mask in range(1, 1 << n):
+        if mask in best or mask & (mask - 1) == 0:
+            continue
+        acc = None
+        s1 = (mask - 1) & mask
+        while s1:
+            s2 = mask ^ s1
+            if s1 < s2:              # each split once
+                s1 = (s1 - 1) & mask
+                continue
+            b1, b2 = best.get(s1), best.get(s2)
+            if b1 is not None and b2 is not None:
+                ndv = None
+                connected = False
+                for ma, mb, en in edges:
+                    if ma and mb and \
+                            (((ma | s1) == s1 and (mb | s2) == s2) or
+                             ((ma | s2) == s2 and (mb | s1) == s1)):
+                        connected = True
+                        if en is not None:
+                            ndv = max(ndv or 1, en)
+                if not connected:
+                    # connected splits only: the row-count cost model
+                    # undervalues cartesian products whose real executor
+                    # constants are much worse (greedy handles the rare
+                    # genuinely-disconnected query)
+                    s1 = (s1 - 1) & mask
+                    continue
+                est = b1[1] * b2[1] / max(float(ndv or
+                                                min(b1[1], b2[1])),
+                                          1.0)
+                cost = b1[0] + b2[0] + est
+                if acc is None or cost < acc[0]:
+                    acc = (cost, est, ("join", b1[2], b2[2], est))
+            s1 = (s1 - 1) & mask
+        if acc is not None:
+            best[mask] = acc
+    full = best.get((1 << n) - 1)
+    return full[2] if full is not None else None
+
+
 def _greedy_build(rels, eqs, others, pinned=0):
     id_of = {}
     for i, r in enumerate(rels):
@@ -179,6 +261,10 @@ def _greedy_build(rels, eqs, others, pinned=0):
 
     pinned = min(pinned, len(rels))
     ndv_cache: dict = {}
+    if not pinned:
+        tree = _dp_order(rels, eqs, id_of, ndv_cache)
+        if tree is not None:
+            return _build_tree(tree, rels, eqs, others)
     if pinned:
         # LEADING-pinned prefix, then the greedy tail over the rest
         tail = [i for i in _greedy_order(rels, eqs, id_of, rel_of, 0,
@@ -234,6 +320,50 @@ def _greedy_build(rels, eqs, others, pinned=0):
     leftovers = [ScalarFunc("=", [a, b], new_bigint_type())
                  for a, b in pending_eqs] + pending_others
     return _wrap_sel(current, leftovers)
+
+
+def _build_tree(tree, rels, eqs, others):
+    """Materialize a DP order tree into LJoin nodes, attaching each
+    eq/other cond at the lowest join whose schema covers it."""
+    pending_eqs = list(eqs)
+    pending_others = list(others)
+
+    def build(t):
+        nonlocal pending_eqs, pending_others
+        if t[0] == "leaf":
+            return rels[t[1]]
+        left = build(t[1])
+        right = build(t[2])
+        schema = Schema_(list(left.schema.cols) + list(right.schema.cols))
+        join = LJoin("inner", left, right, schema)
+        cur_ids = {sc.col.idx for sc in schema.cols}
+        left_ids = {sc.col.idx for sc in left.schema.cols}
+        still_eq = []
+        for a, b in pending_eqs:
+            ca, cb = _cols_of(a), _cols_of(b)
+            if ca | cb <= cur_ids:
+                if ca <= left_ids:
+                    join.eq_conds.append((a, b))
+                else:
+                    join.eq_conds.append((b, a))
+            else:
+                still_eq.append((a, b))
+        pending_eqs = still_eq
+        still_others = []
+        for c in pending_others:
+            if _cols_of(c) <= cur_ids:
+                join.other_conds.append(c)
+            else:
+                still_others.append(c)
+        pending_others = still_others
+        join.stats_rows = t[3] if len(t) > 3 else \
+            max(left.stats_rows, right.stats_rows)
+        return join
+    out = build(tree)
+    from ..types.field_type import new_bigint_type
+    leftovers = [ScalarFunc("=", [a, b], new_bigint_type())
+                 for a, b in pending_eqs] + pending_others
+    return _wrap_sel(out, leftovers)
 
 
 from .schema import Schema as Schema_  # noqa: E402

@@ -512,18 +512,12 @@ class DDLExecutor:
             raise ColumnNotExistsError("Unknown column '%s'", name)
         if tbl0.pk_is_handle and tbl0.pk_col_name.lower() == name.lower():
             raise UnsupportedError("cannot drop the primary key column")
-        to_drop = []
         for idx in tbl0.indexes:
             cols = [c.lower() for c in idx.columns]
-            if name.lower() in cols:
-                if len(cols) > 1:
-                    raise UnsupportedError(
-                        "cannot drop column '%s' covered by multi-column "
-                        "index '%s'", name, idx.name)
-                to_drop.append(idx.name)
-        for iname in to_drop:
-            self.drop_index(ast.DropIndexStmt(index_name=iname,
-                                              table=tn))
+            if name.lower() in cols and len(cols) > 1:
+                raise UnsupportedError(
+                    "cannot drop column '%s' covered by multi-column "
+                    "index '%s'", name, idx.name)
 
         def fn(m):
             db, tbl = self._get_table(m, tn)
@@ -532,6 +526,11 @@ class DDLExecutor:
                 raise ColumnNotExistsError("Unknown column '%s'", name)
             if tbl.pk_is_handle and tbl.pk_col_name.lower() == name.lower():
                 raise UnsupportedError("cannot drop the primary key column")
+            # ONE meta mutation drops the column AND its single-column
+            # indexes — a crash can never observe one without the other
+            tbl.indexes = [idx for idx in tbl.indexes
+                           if name.lower() not in
+                           [c.lower() for c in idx.columns]]
             tbl.columns = [c for c in tbl.columns if c is not ci]
             for i, c in enumerate(tbl.columns):
                 c.offset = i
