@@ -15,6 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_ENABLE_X64"] = "1"
 
 try:
+    # pallas registers TPU lowering rules at import; that registration
+    # needs the tpu platform to still be KNOWN — import before popping
+    # the factories or interpret-mode kernels can never load
+    from jax.experimental import pallas as _pl  # noqa: F401
+except Exception:
+    pass
+
+try:
     import jax._src.xla_bridge as _xb
     for _name in list(getattr(_xb, "_backend_factories", {})):
         if _name != "cpu":

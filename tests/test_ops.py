@@ -24,3 +24,41 @@ def test_masked_sums_empty_mask():
     a = np.arange(n)
     sums, count = masked_sums([a], np.zeros(n, dtype=bool), interpret=True)
     assert int(count) == 0 and int(sums[0]) == 0
+
+
+def test_range_filter_sums_kernel():
+    """Whole-Q6 pallas program: in-kernel predicates + masked sums."""
+    import numpy as np
+    from tidb_tpu.ops import range_filter_sums
+    rng = np.random.RandomState(4)
+    n = 20000
+    ship = rng.randint(8000, 9000, n)
+    disc = rng.randint(0, 11, n)
+    price = rng.randint(100, 100000, n)
+    valid = rng.rand(n) < 0.9
+    sums, cnt = range_filter_sums(
+        [price * disc], [ship, disc],
+        [(8200, 8799), (3, 7)], valid, interpret=True)
+    m = valid & (ship >= 8200) & (ship <= 8799) & (disc >= 3) & (disc <= 7)
+    assert int(cnt) == int(m.sum())
+    assert int(sums[0]) == int((price[m] * disc[m]).sum())
+
+
+def test_dense_group_sums_kernel():
+    """Q1-shape grouped sums as one-hot MXU matmuls."""
+    import numpy as np
+    from tidb_tpu.ops import dense_group_sums
+    rng = np.random.RandomState(5)
+    n = 30000
+    nslots = 12
+    slots = rng.randint(0, nslots, n)
+    v1 = rng.randint(0, 5000, n)
+    v2 = rng.randint(0, 300, n)
+    valid = rng.rand(n) < 0.8
+    sums, cnts = dense_group_sums([v1, v2], slots, nslots, valid,
+                                  interpret=True)
+    for g in range(nslots):
+        m = valid & (slots == g)
+        assert int(cnts[g]) == int(m.sum())
+        assert int(sums[0][g]) == int(v1[m].sum())
+        assert int(sums[1][g]) == int(v2[m].sum())

@@ -43,6 +43,12 @@ def force_cpu_backend():
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.setdefault("JAX_ENABLE_X64", "1")
     try:
+        # pallas lowering registration needs the tpu platform still
+        # known; import before unregistering the factories
+        from jax.experimental import pallas as _pl  # noqa: F401
+    except Exception:
+        pass
+    try:
         import jax._src.xla_bridge as _xb
         for _name in list(getattr(_xb, "_backend_factories", {})):
             if _name != "cpu":

@@ -6,6 +6,8 @@ tidb_tpu/copr/dag_exec.py). This package holds hand-written Pallas TPU
 kernels for the paths where explicit VMEM control beats XLA's scheduling;
 they run in interpret mode on CPU for tests.
 """
-from .pallas_scan import masked_sums, pallas_available
+from .pallas_scan import (masked_sums, pallas_available,
+                          range_filter_sums, dense_group_sums)
 
-__all__ = ["masked_sums", "pallas_available"]
+__all__ = ["masked_sums", "pallas_available",
+           "range_filter_sums", "dense_group_sums"]
