@@ -137,3 +137,50 @@ def test_fused_dim_insert_invalidates_kernel(tk):
     n2 = int(tk.must_query(sql).rs.rows[0][0])
     assert n2 > n1
     assert n2 == int(_conventional(tk, sql)[0][0])
+
+
+def test_fused_semi_join(tk):
+    """EXISTS/IN subqueries decorrelate to semi joins; the fused kernel
+    masks on key existence (duplicate build keys allowed)."""
+    sql = ("select dim_a.grp, count(*) from dim_a "
+           "where exists (select 1 from fact "
+           "where fact.a_id = dim_a.id and fact.q > 25) "
+           "group by dim_a.grp order by dim_a.grp")
+    plan = "\n".join(r[0] for r in tk.must_query("explain " + sql).rs.rows)
+    assert "FusedPipeline" in plan, plan
+    hits = tk.domain.metrics.get("fused_pipeline_hit", 0)
+    got = tk.must_query(sql).rs.rows
+    # the FILTERED, duplicate-key semi dim must actually run fused
+    # (prefiltered meta), not silently fall back
+    assert tk.domain.metrics.get("fused_pipeline_hit", 0) == hits + 1
+    assert got == _conventional(tk, sql)
+
+
+def test_fused_left_join(tk):
+    sql = ("select dim_a.grp, count(fact.k), count(*) from dim_a "
+           "left join fact on fact.a_id = dim_a.id "
+           "group by dim_a.grp order by dim_a.grp")
+    got = tk.must_query(sql).rs.rows
+    assert got == _conventional(tk, sql)
+
+
+def test_fused_left_join_fact_preserved(tk):
+    """fact LEFT JOIN dim: unmatched fact rows keep NULL dim payload."""
+    sql = ("select dim_b.tag, count(*), sum(fact.q) from fact "
+           "left join dim_b on fact.b_id = dim_b.id "
+           "group by dim_b.tag order by dim_b.tag")
+    plan = "\n".join(r[0] for r in tk.must_query("explain " + sql).rs.rows)
+    assert "FusedPipeline" in plan, plan
+    got = tk.must_query(sql).rs.rows
+    assert got == _conventional(tk, sql)
+
+
+def test_fused_left_join_empty_dim(tk):
+    """LEFT over an EMPTY dim preserves fact rows with NULL payload
+    (review finding: the empty-dim early-exit returned [])."""
+    tk.must_exec("create table dim_e2 (id int primary key, g varchar(8))")
+    sql = ("select dim_e2.g, count(*) from fact left join dim_e2 "
+           "on fact.b_id = dim_e2.id group by dim_e2.g")
+    got = tk.must_query(sql).rs.rows
+    assert got == _conventional(tk, sql)
+    assert got[0][0] is None and int(got[0][1]) == 500

@@ -115,7 +115,8 @@ class CoprExecutor:
         if not self.use_device or dag.table_info.id <= -1000 or \
                 not _dag_device_ready(dag):
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
-        if use_mpp and dag.aggs and not overlay and not dag.host_filters \
+        if use_mpp and (dag.aggs or dag.group_items) and not overlay \
+                and not dag.host_filters \
                 and n >= mpp_min_rows:
             try:
                 res = self._try_execute_mpp(dag, tbl, arrays, valid, n,
@@ -229,7 +230,7 @@ class CoprExecutor:
             ctx = EvalCtx(np, m, cols, host=True)
             for f in dag.filters + dag.host_filters:
                 v &= np.asarray(eval_bool_mask(ctx, f))
-            if dag.aggs:
+            if dag.aggs or dag.group_items:
                 out.append(_host_partial_agg(ctx, dag, v))
                 continue
             idx = np.nonzero(v)[0]
@@ -262,7 +263,7 @@ class CoprExecutor:
             cols = self._bind_cols(dag, tbl, arrays, sl, handles,
                                    cacheable=(n == tbl.n))
             v = valid[sl]
-            if dag.aggs:
+            if dag.aggs or dag.group_items:
                 res = self._run_agg_partition(dag, tbl, cols, v, m, cap)
                 out.append(res)
                 continue
@@ -405,11 +406,16 @@ class CoprExecutor:
         local = padded // ndev
         cols = cols_full
         names = sorted(cols.keys())
+        # cache by STORAGE column id, never plan column idx: idxs are
+        # per-plan and collide across statements (a scalar subquery
+        # priming the cache poisoned the outer query's columns)
+        cid_of_idx = {sc.col.idx: self._cid(dag, sc) for sc in dag.cols}
         args = []
         has_nulls = {}
         for k in names:
             data, nulls, sdict = cols[k]
-            ck_base = (tbl.uid, k, tbl.version, "mpp", ndev, padded)
+            ck_base = (tbl.uid, "mppcol", cid_of_idx.get(k, -1),
+                       tbl.version, ndev, padded)
             args.append(self._dev_put_sharded(ck_base + ("d",), data, mesh,
                                               padded))
             has_nulls[k] = nulls is not None
@@ -1127,12 +1133,22 @@ def _host_partial_agg(ctx, dag, valid):
     xp = np
     keys = []
     key_nulls = []
-    for g in dag.group_items:
+    key_dict_override = {}
+    for gi, g in enumerate(dag.group_items):
         d, nl, sd = eval_expr(ctx, g)
         if np.isscalar(d):
             d = np.full(ctx.n, d)
-        d = np.asarray(d, dtype=np.int64)
+        d = np.asarray(d)
         nm = np.asarray(materialize_nulls(ctx, nl))
+        if d.dtype == object and sd is None:
+            # raw strings (e.g. null-padded columns from a left join
+            # fallback): encode into a local dict so keys stay int64
+            from ..chunk.device import StringDict
+            sd2 = StringDict()
+            d = np.array([0 if m else sd2.encode_one(str(v))
+                          for v, m in zip(d, nm)], dtype=np.int64)
+            key_dict_override[gi] = sd2
+        d = d.astype(np.int64)
         keys.append(np.where(nm, 0, d))
         key_nulls.append(nm)
     idx = np.nonzero(mask)[0]
@@ -1194,6 +1210,8 @@ def _host_partial_agg(ctx, dag, valid):
         else:
             raise NotImplementedError(a.name)
     kd, sd = capture_agg_dicts(dag, ctx.cols)
+    for gi, sd2 in key_dict_override.items():
+        kd[gi] = sd2
     return PartialAggResult(ngroups=ngroups, keys=out_keys,
                             key_nulls=out_key_nulls, states=states,
                             key_dicts=kd, state_dicts=sd)

@@ -67,6 +67,13 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
     if ksdict is not None or kdata.dtype.kind == "f":
         return None                      # int64-comparable keys only
     host_cache = copr._host_cache
+    if dim.join_type == "semi":
+        # SEMI only tests key EXISTENCE: fold the dim's filters on the
+        # host and dedup, so duplicate keys and filtered dims (Q4's
+        # EXISTS over lineitem) still ride the fused probe. The kernel
+        # then skips this dim's mask entirely ("pre" mode).
+        return _semi_prefiltered_meta(copr, dim, tbl, arrays, valid, n,
+                                      key_cid, read_ts)
     # built over VALID rows only (old MVCC versions of an updated key
     # would otherwise look like duplicates); visibility depends on
     # read_ts, so it keys the cache; older versions are evicted
@@ -86,24 +93,74 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
             lo = int(keys_v.min())
             hi = int(keys_v.max())
             span = hi - lo + 1
+            unique = len(np.unique(keys_v)) == nv
             if span <= max(4 * nv, 1 << 12) and span <= _DIRECT_SPAN_BUDGET:
-                if len(np.unique(keys_v)) != nv:
-                    meta = (None, None, None, False, 0)
-                else:
-                    lut = np.full(span, n, dtype=np.int64)   # n == miss
-                    lut[keys_v - lo] = vidx
-                    meta = ("direct", lut, lo, True, nv)
+                lut = np.full(span, n, dtype=np.int64)   # n == miss
+                lut[keys_v - lo] = vidx     # dup keys: one survivor
+                meta = ("direct", lut, lo, unique, nv)
             else:
                 o = np.argsort(keys_v, kind="stable")
                 skeys = keys_v[o]
-                unique = nv <= 1 or bool(np.all(skeys[1:] > skeys[:-1]))
                 meta = ("sorted", (vidx[o], skeys), None, unique, nv)
         host_cache[hkey] = meta
     mode, payload, lo, unique, n_sorted = meta
-    if not unique:
+    if mode is None or not unique:
         return None
     out = {"arrays": arrays, "valid": valid, "n": n, "tbl": tbl,
            "mode": mode, "lo": lo, "n_sorted": n_sorted}
+    if mode == "direct":
+        out["lut"] = payload
+    else:
+        out["order"], out["skeys"] = payload
+    return out
+
+
+def _semi_prefiltered_meta(copr, dim, tbl, arrays, valid, n, key_cid,
+                           read_ts):
+    fps = tuple(f.fingerprint() for f in dim.dag.filters)
+    hkey = (tbl.uid, key_cid, "semidim", tbl.version, n, read_ts, fps)
+    meta = copr._host_cache.get(hkey)
+    if meta is None:
+        prev = copr._host_cache.pop((tbl.uid, key_cid, "semicur"), None)
+        if prev is not None:
+            copr._host_cache.pop(prev, None)
+        copr._host_cache[(tbl.uid, key_cid, "semicur")] = hkey
+        mask = valid.copy()
+        if dim.dag.filters:
+            cols = {}
+            for sc in dim.dag.cols:
+                cid = _cid_of(dim.dag, sc)
+                if cid == -1:
+                    continue
+                d, nl, sd = arrays[cid]
+                cols[sc.col.idx] = (d, nl, sd)
+            ectx = EvalCtx(np, n, cols, host=True)
+            for f in dim.dag.filters:
+                mask &= np.asarray(eval_bool_mask(ectx, f))
+        kdata, knulls, _ = arrays[key_cid]
+        if knulls is not None:
+            mask &= ~knulls[:n]
+        keys = np.unique(kdata[:n][mask])
+        nv = len(keys)
+        if nv == 0:
+            # nothing passes: a 1-row always-miss structure
+            meta = ("direct", np.array([1], dtype=np.int64), 0, True, 0)
+        else:
+            lo = int(keys.min())
+            span = int(keys.max()) - lo + 1
+            if span <= max(4 * nv, 1 << 12) and \
+                    span <= _DIRECT_SPAN_BUDGET:
+                lut = np.full(span, n, dtype=np.int64)
+                lut[keys - lo] = 0       # any representative: hit test
+                meta = ("direct", lut, lo, True, nv)
+            else:
+                meta = ("sorted", (np.zeros(nv, dtype=np.int64), keys),
+                        None, True, nv)
+        copr._host_cache[hkey] = meta
+    mode, payload, lo, _unique, n_sorted = meta
+    out = {"arrays": arrays, "valid": valid, "n": n, "tbl": tbl,
+           "mode": mode, "lo": lo, "n_sorted": n_sorted, "pre": True,
+           "ukey": ("pre",) + fps}
     if mode == "direct":
         out["lut"] = payload
     else:
@@ -119,15 +176,17 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
     tbl = meta["tbl"]
     n = meta["n"]
     ver = tbl.version
-    mk = () if mesh is None else ("bcast", mesh.devices.size)
+    mk = (() if mesh is None else ("bcast", mesh.devices.size)) + \
+        tuple(meta.get("ukey", ()))
 
     def put(tag, arr, length, acap, fill=0, ts_keyed=False):
         # plain column data depends only on the table version; only the
         # MVCC-derived arrays (valid mask, lut/sort built over the valid
         # set) vary with the snapshot ts — keying data by ts would
-        # re-upload every dim column once per transaction
-        key = (tbl.uid, tag, ver, read_ts if ts_keyed else None, length,
-               acap) + mk
+        # re-upload every dim column once per transaction. _dev_put
+        # reads the pad capacity from key[-1]: acap stays LAST.
+        key = (tbl.uid, tag, ver, read_ts if ts_keyed else None,
+               length) + mk + (acap,)
         if mesh is None:
             return copr._dev_put(key, arr, pad_fill=fill)
         return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill)
@@ -176,8 +235,8 @@ def _pos_group_map(plan, dim_metas):
     for g in plan.group_items:
         m = None
         for di, dim in enumerate(plan.dims):
-            if dim.join_type == "semi":
-                continue
+            if dim.join_type != "inner":
+                continue       # left-dim pos is garbage on misses
             if isinstance(g, Column):
                 for sc in dim.dag.cols:
                     if sc.col.idx == g.idx:
@@ -228,7 +287,8 @@ def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
 
 
 def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
-                        dim_sns, dim_layouts, agg_kind, agg_param):
+                        dim_sns, dim_layouts, agg_kind, agg_param,
+                        dim_pres=()):
     """The traced pipeline: filter fact -> dim probes/gathers -> residual
     filters -> partial agg. fact_cap is the (local, for MPP shards) fact
     partition capacity; dim_ns = full dim row counts, dim_sns = valid
@@ -248,13 +308,18 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
         dim_pos = {}
         for dim_i, (dim, da, dcap, dn, dsn, layout) in enumerate(
                 zip(dims, dargs, dim_caps, dim_ns, dim_sns, dim_layouts)):
-            dcols = {}
-            for idx, (jd, jn) in da["cols"].items():
-                dcols[idx] = (jd, jn, layout[idx][1])
-            dctx = EvalCtx(jnp, dcap, dcols, host=False)
-            dmask = da["valid"]
-            for f in dim.dag.filters:
-                dmask = dmask & eval_bool_mask(dctx, f)
+            pre = bool(dim_pres[dim_i]) if dim_i < len(dim_pres) else False
+            if pre:
+                dmask = None       # filters/visibility folded at meta
+                                   # time (prefiltered semi dims)
+            else:
+                dcols = {}
+                for idx, (jd, jn) in da["cols"].items():
+                    dcols[idx] = (jd, jn, layout[idx][1])
+                dctx = EvalCtx(jnp, dcap, dcols, host=False)
+                dmask = da["valid"]
+                for f in dim.dag.filters:
+                    dmask = dmask & eval_bool_mask(dctx, f)
             pv, pnl, _ = eval_expr(ctx, dim.probe_expr)
             if np.isscalar(pv) or getattr(pv, "ndim", 1) == 0:
                 pv = jnp.full(fact_cap, pv)
@@ -268,21 +333,31 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                 pos = da["lut"][jnp.clip(idx, 0, lsize - 1)]
                 pos = jnp.minimum(pos, dcap - 1)
                 hit = inb & (da["lut"][jnp.clip(idx, 0, lsize - 1)] < dn) \
-                    & ~pnm & dmask[pos]
+                    & ~pnm
+                if dmask is not None:
+                    hit = hit & dmask[pos]
             else:
                 scap = da["sk"].shape[0]
                 loc = jnp.searchsorted(da["sk"], pv)
                 locc = jnp.minimum(loc, scap - 1)
                 pos = da["ord"][locc]
-                hit = (da["sk"][locc] == pv) & ~pnm & (loc < dsn) & \
-                    dmask[pos]
-            mask = mask & hit
-            dim_pos[dim_i] = jnp.minimum(pos, dn - 1)
-            if dim.join_type != "semi":
+                hit = (da["sk"][locc] == pv) & ~pnm & (loc < dsn)
+                if dmask is not None:
+                    hit = hit & dmask[pos]
+            if dim.join_type == "left":
+                # preserved side: misses keep the row, payload is NULL
                 for idx, (jd, jn) in da["cols"].items():
                     g = jd[pos]
-                    gn = jn[pos] if jn is not None else None
+                    gn = ~hit if jn is None else (~hit | jn[pos])
                     cols[idx] = (g, gn, layout[idx][1])
+            else:
+                mask = mask & hit
+                if dim.join_type != "semi":
+                    for idx, (jd, jn) in da["cols"].items():
+                        g = jd[pos]
+                        gn = jn[pos] if jn is not None else None
+                        cols[idx] = (g, gn, layout[idx][1])
+            dim_pos[dim_i] = jnp.minimum(pos, dn - 1)
             ctx = EvalCtx(jnp, fact_cap, cols, host=False)
         for f in post:
             mask = mask & eval_bool_mask(ctx, f)
@@ -303,16 +378,17 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
 
 
 def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
-                        dim_sns, dim_layouts, agg_kind, agg_param):
+                        dim_sns, dim_layouts, agg_kind, agg_param,
+                        dim_pres=()):
     body = _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps,
                                dim_ns, dim_sns, dim_layouts, agg_kind,
-                               agg_param)
+                               agg_param, dim_pres)
     return jax.jit(body)
 
 
 def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
                             dim_ns, dim_sns, dim_layouts, agg_kind,
-                            agg_param, mesh):
+                            agg_param, mesh, dim_pres=()):
     """The fused pipeline as ONE shard_map program: fact shards ride the
     'dp' mesh axis (PassThrough exchange from the scan), dims are
     replicated (Broadcast exchange), and the partial aggregation merges
@@ -324,7 +400,7 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
 
     body = _make_pipeline_body(plan, local_cap, fact_sdicts, dim_caps,
                                dim_ns, dim_sns, dim_layouts, agg_kind,
-                               agg_param)
+                               agg_param, dim_pres)
     aggs = list(plan.aggs)
     dense = agg_kind in ("dense", "posdense")
 
@@ -358,7 +434,23 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     for dim in plan.dims:
         tbl = engine.table(dim.dag.table_info)
         if tbl.n == 0:
-            return []                     # inner join with empty dim
+            if dim.join_type != "left":
+                return []         # inner/semi with empty dim: no rows
+            # LEFT over an empty dim preserves the fact side with NULL
+            # payload: a 1-row always-miss dim keeps every shape static
+            arrays = {}
+            for sc in dim.dag.cols:
+                cid = _cid_of(dim.dag, sc)
+                if cid == -1:
+                    continue
+                arrays[cid] = (np.zeros(1, dtype=tbl.data[cid].dtype),
+                               None, tbl.dicts.get(cid))
+            dim_metas.append({
+                "arrays": arrays, "valid": np.zeros(1, dtype=bool),
+                "n": 1, "tbl": tbl, "mode": "direct",
+                "lut": np.array([1], dtype=np.int64), "lo": 0,
+                "n_sorted": 0})
+            continue
         meta = _dim_sort_meta(copr, dim, tbl, read_ts)
         if meta is None:
             return None
@@ -394,6 +486,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         dim_caps.append(dcap)
         dim_ns.append(meta["n"])
         dim_sns.append(meta["n_sorted"])
+    dim_pres = tuple(bool(m.get("pre")) for m in dim_metas)
 
     # 1-row host ctx over ALL pipeline columns: learn output dicts and
     # whether a dense group layout applies (dict-coded keys only here —
@@ -437,7 +530,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
             handles, dim_args, dim_metas, dim_caps, dim_ns, dim_sns,
             dim_layouts, fact_sdicts, pos_spec, sizes, shim, kd, sd,
-            gbkey, group_bucket, read_ts)
+            gbkey, group_bucket, read_ts, dim_pres)
     for start in range(0, n, step):
         sl = slice(start, min(start + step, n))
         m = sl.stop - sl.start
@@ -461,7 +554,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 kern = _build_fused_kernel(
                     plan, cap, fact_sdicts, tuple(dim_caps),
                     tuple(dim_ns), tuple(dim_sns), tuple(dim_layouts),
-                    agg_kind, agg_param)
+                    agg_kind, agg_param, dim_pres)
                 copr._kernel_cache[key] = kern
             fjc_full, fvv = copr._pad_upload(cols, v, m, cap)
             fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
@@ -615,7 +708,8 @@ def _expr_idxs(e):
 def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                    n, handles, dim_args, dim_metas, dim_caps, dim_ns,
                    dim_sns, dim_layouts, fact_sdicts, pos_spec, sizes,
-                   shim, kd, sd, gbkey, group_bucket, read_ts):
+                   shim, kd, sd, gbkey, group_bucket, read_ts,
+                   dim_pres=()):
     """Mesh execution: ONE shard_map call over the whole fact table."""
     import jax as _jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -659,7 +753,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
             kern = _build_fused_kernel_mpp(
                 plan, local, fact_sdicts, tuple(dim_caps), tuple(dim_ns),
                 tuple(dim_sns), tuple(dim_layouts), agg_kind, agg_param,
-                mesh)
+                mesh, dim_pres)
             copr._kernel_cache[key] = kern
         res = kern(fjc, fvv, dim_args)
         if pos_spec is not None:
@@ -713,4 +807,5 @@ def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
                           for sc in plan.fact_dag.cols))
     return ("fused", fact_tbl.uid, cap, dim_caps, dim_ns, dim_sns, fps,
             dimsig, postfps, gfps, afps, tuple(dict_vers), colsig,
-            agg_kind, agg_param)
+            agg_kind, agg_param,
+            tuple(bool(m.get("pre")) for m in dim_metas))

@@ -88,7 +88,7 @@ class TableReaderExec(Executor):
                 for pid in pids]
 
     def next(self):
-        if self.dag.aggs:
+        if self.dag.aggs or self.dag.group_items:
             raise RuntimeError("partial-agg reader must be driven by HashAgg")
         if self._chunks is None:
             self._chunks = []

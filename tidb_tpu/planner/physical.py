@@ -670,27 +670,47 @@ def _absorb_filters(dag: CoprDAG, conds):
             pass
 
 
-def _collect_join_tree(p, leaves, eqs, filters):
-    """Flatten an inner-join tree into leaves + eq pairs + residual
-    filters; -> False when any node is outside the fusable shape."""
+def _fusable_leaf(p):
+    if not isinstance(p, PhysTableReader):
+        return False
+    dag = p.dag
+    return not (dag.aggs or dag.topn is not None or dag.limit >= 0 or
+                dag.host_filters or dag.table_info.partitions or
+                dag.table_info.id < 0)
+
+
+def _collect_join_tree(p, leaves, eqs, filters, outer_dims):
+    """Flatten a join tree into leaves + eq pairs + residual filters.
+    Inner joins flatten freely; LEFT/SEMI joins whose non-preserved side
+    is a plain leaf become `outer_dims` entries [(leaf, join_type,
+    eq_conds)] — they attach after the inner orientation (a left dim
+    never filters the pipeline; a semi dim only masks).
+    -> False when any node is outside the fusable shape."""
     if isinstance(p, PhysShell):
-        return _collect_join_tree(p.child, leaves, eqs, filters)
+        return _collect_join_tree(p.child, leaves, eqs, filters,
+                                  outer_dims)
     if isinstance(p, PhysSelection):
         filters.extend(p.conds)
-        return _collect_join_tree(p.child, leaves, eqs, filters)
+        return _collect_join_tree(p.child, leaves, eqs, filters,
+                                  outer_dims)
     if isinstance(p, PhysHashJoin):
-        if p.join_type != "inner" or getattr(p, "null_aware", False):
+        if getattr(p, "null_aware", False):
             return False
-        eqs.extend(p.eq_conds)
-        filters.extend(p.other_conds)
-        return (_collect_join_tree(p.children[0], leaves, eqs, filters) and
-                _collect_join_tree(p.children[1], leaves, eqs, filters))
-    if isinstance(p, PhysTableReader):
-        dag = p.dag
-        if dag.aggs or dag.topn is not None or dag.limit >= 0 or \
-                dag.host_filters or dag.table_info.partitions or \
-                dag.table_info.id < 0:
-            return False
+        if p.join_type == "inner":
+            eqs.extend(p.eq_conds)
+            filters.extend(p.other_conds)
+            return (_collect_join_tree(p.children[0], leaves, eqs,
+                                       filters, outer_dims) and
+                    _collect_join_tree(p.children[1], leaves, eqs,
+                                       filters, outer_dims))
+        if p.join_type in ("left", "semi") and len(p.eq_conds) == 1 and \
+                not p.other_conds and _fusable_leaf(p.children[1]):
+            outer_dims.append((p.children[1], p.join_type,
+                               list(p.eq_conds)))
+            return _collect_join_tree(p.children[0], leaves, eqs,
+                                      filters, outer_dims)
+        return False
+    if _fusable_leaf(p):
         leaves.append(p)
         return True
     return False
@@ -831,9 +851,10 @@ def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
     for g in plan.group_items:
         if not is_device_safe(g):
             return None
-    leaves, eqs, filters = [], [], []
-    if not _collect_join_tree(child, leaves, eqs, filters) or \
-            len(leaves) < 2 or not eqs:
+    leaves, eqs, filters, outer_dims = [], [], [], []
+    if not _collect_join_tree(child, leaves, eqs, filters, outer_dims) \
+            or not leaves or (len(leaves) < 2 and not outer_dims) or \
+            (not eqs and not outer_dims):
         return None
     for f in filters:
         if not is_device_safe(f):
@@ -849,13 +870,14 @@ def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
         reverse=True)
     for fact in candidates:
         r = _orient_pipeline(plan, child, leaves, eqs, filters, owner,
-                             fact)
+                             fact, outer_dims)
         if r is not None:
             return r
     return None
 
 
-def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact):
+def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
+                     outer_dims=()):
     pipe = {sc.col.idx for sc in fact.dag.cols}
     used = {id(fact)}
     dims = []
@@ -904,6 +926,26 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact):
                 break                # re-prefer unique keys next round
     if remaining or len(used) != len(leaves):
         return None
+    # LEFT/SEMI dims attach after the inner orientation: their probe
+    # exprs may use any pipeline column; a left dim contributes columns,
+    # a semi dim only masks. Collection order is outermost-first —
+    # attach innermost-first so an outer dim can probe an inner one
+    for leaf, jt, econds in reversed(outer_dims):
+        (l_e, r_e) = econds[0]
+        build, probe = None, None
+        for b, pexp in ((l_e, r_e), (r_e, l_e)):
+            if isinstance(b, Column) and \
+                    any(s.col.idx == b.idx for s in leaf.dag.cols) and \
+                    _cols_of(pexp) <= pipe and is_device_safe(pexp) and \
+                    _fusable_key_ft(b.ft) and _fusable_key_ft(pexp.ft):
+                build, probe = b, pexp
+                break
+        if build is None:
+            return None
+        sc = next(s for s in leaf.dag.cols if s.col.idx == build.idx)
+        dims.append(DimJoin(leaf.dag, sc, probe, jt))
+        if jt == "left":
+            pipe.update(s.col.idx for s in leaf.dag.cols)
     for f in filters:
         if not (_cols_of(f) <= pipe):
             return None

@@ -453,11 +453,66 @@ def _subst(e: Expression, mapping: dict) -> Expression:
     return e
 
 
+def _factor_common_or(cond):
+    """OR(AND(c, a...), AND(c, b...)) -> [c, OR(AND(a...), AND(b...))]:
+    hoisting conjuncts common to every disjunct exposes join keys buried
+    in DNF (Q19's p_partkey = l_partkey lives inside each OR branch —
+    without this the join planned as a CARTESIAN product; reference
+    expression/constraint_propagation + ranger DNF handling)."""
+    if not (isinstance(cond, ScalarFunc) and cond.op == "or"):
+        return [cond]
+    disjuncts = []
+
+    def flat_or(e, out):
+        if isinstance(e, ScalarFunc) and e.op == "or":
+            for a in e.args:
+                flat_or(a, out)
+        else:
+            out.append(e)
+    flat_or(cond, disjuncts)
+
+    def conjuncts(e):
+        out = []
+
+        def rec(x):
+            if isinstance(x, ScalarFunc) and x.op == "and":
+                for a in x.args:
+                    rec(a)
+            else:
+                out.append(x)
+        rec(e)
+        return out
+    branches = [conjuncts(d) for d in disjuncts]
+    common_fps = set(c.fingerprint() for c in branches[0])
+    for b in branches[1:]:
+        common_fps &= {c.fingerprint() for c in b}
+    if not common_fps:
+        return [cond]
+    out = [c for c in branches[0] if c.fingerprint() in common_fps]
+    rest_branches = []
+    for b in branches:
+        rest = [c for c in b if c.fingerprint() not in common_fps]
+        if not rest:
+            return out          # a branch became TRUE: OR is TRUE
+        acc = rest[0]
+        for c in rest[1:]:
+            acc = ScalarFunc("and", [acc, c], acc.ft)
+        rest_branches.append(acc)
+    acc = rest_branches[0]
+    for c in rest_branches[1:]:
+        acc = ScalarFunc("or", [acc, c], acc.ft)
+    out.append(acc)
+    return out
+
+
 def push_down_predicates(plan: LogicalPlan, conds: list) -> LogicalPlan:
     """Push `conds` into plan; returns new plan with remaining conds applied
     on top."""
     if isinstance(plan, Selection):
-        child = push_down_predicates(plan.child, conds + plan.conds)
+        # factor once, where conds enter the walk (idempotent — no need
+        # to re-factor at every tree level)
+        new = [f for c in plan.conds for f in _factor_common_or(c)]
+        child = push_down_predicates(plan.child, conds + new)
         return child
     if isinstance(plan, DataSource):
         plan.pushed_conds.extend(conds)
