@@ -184,3 +184,51 @@ def test_fused_left_join_empty_dim(tk):
     got = tk.must_query(sql).rs.rows
     assert got == _conventional(tk, sql)
     assert got[0][0] is None and int(got[0][1]) == 500
+
+
+def test_fused_semi_filter_rejects_all_key_zero(tk):
+    """EXISTS whose filter rejects EVERY build row matches nothing —
+    including probe key 0 (review finding: the always-miss lut used
+    sentinel 1, which the kernel's `lut[idx] < n` hit test read as a
+    real hit for probe key == lo when the dim had >= 2 rows)."""
+    tk.must_exec("insert into dim_a values (0, 0, 'nz', 0)")
+    sql = ("select count(*) from dim_a "
+           "where exists (select 1 from fact "
+           "where fact.a_id = dim_a.id and fact.q > 9999)")
+    assert tk.must_query(sql).rs.rows == [(0,)]
+    assert _conventional(tk, sql) == [(0,)]
+
+
+def test_host_partial_agg_shared_dicts():
+    """Raw-string group keys aggregated chunk-by-chunk must encode
+    through ONE shared dict: per-chunk dicts give colliding int64 codes
+    that _merge_partials cannot tell apart (review finding)."""
+    from tidb_tpu.copr.dag_exec import _host_partial_agg
+    from tidb_tpu.copr.pipeline import _AggShim
+    from tidb_tpu.expression import EvalCtx
+    from tidb_tpu.expression.expr import Column
+    from tidb_tpu.types.field_type import new_string_type
+
+    class Agg:
+        name = "count"
+        args = []
+        distinct = False
+    col = Column(0, new_string_type(16))
+    shim = _AggShim([col], [Agg()])
+    shared = {}
+    outs = []
+    for chunk_vals in (["x", "x", "y"], ["y", "z"]):
+        data = np.array(chunk_vals, dtype=object)
+        ctx = EvalCtx(np, len(data), {0: (data, None, None)}, host=True)
+        outs.append(_host_partial_agg(
+            ctx, shim, np.ones(len(data), dtype=bool),
+            shared_dicts=shared))
+    # codes from both chunks decode through the SAME dict
+    d0 = outs[0].key_dicts[0]
+    assert outs[1].key_dicts[0] is d0
+    decode = {}
+    for out in outs:
+        for code, cnt in zip(out.keys[0], out.states[0][0]):
+            decode.setdefault(d0.values[int(code)], 0)
+            decode[d0.values[int(code)]] += int(cnt)
+    assert decode == {"x": 2, "y": 2, "z": 1}

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls, or_nulls
+from ..utils.fetch import prefetch
 from ..chunk.device import shape_bucket
 from ..chunk.column import Column
 from ..chunk.chunk import Chunk
@@ -222,6 +223,7 @@ class CoprExecutor:
         out = []
         step = self.device_rows
         produced = 0
+        shared_dicts = {}
         for start in range(0, n, step):
             sl = slice(start, min(start + step, n))
             cols = self._bind_cols(dag, tbl, arrays, sl, handles)
@@ -231,7 +233,8 @@ class CoprExecutor:
             for f in dag.filters + dag.host_filters:
                 v &= np.asarray(eval_bool_mask(ctx, f))
             if dag.aggs or dag.group_items:
-                out.append(_host_partial_agg(ctx, dag, v))
+                out.append(_host_partial_agg(ctx, dag, v,
+                                             shared_dicts=shared_dicts))
                 continue
             idx = np.nonzero(v)[0]
             if dag.limit >= 0:
@@ -528,7 +531,7 @@ class CoprExecutor:
             hmp = np.concatenate([hm, np.zeros(cap - m, dtype=bool)]) \
                 if m != cap else hm
             vv = vv & jnp.asarray(hmp)
-        top_idx, cnt = kern(jc, vv)
+        top_idx, cnt = prefetch(kern(jc, vv))
         return np.asarray(top_idx)[:int(cnt)]
 
     def _topn_host(self, dag, cols, v, m):
@@ -603,7 +606,7 @@ class CoprExecutor:
                 hmp = np.concatenate([hm, np.zeros(cap - m, dtype=bool)]) \
                     if m != cap else hm
                 vv = vv & jnp.asarray(hmp)
-            res = kern(jc, vv)
+            res = prefetch(kern(jc, vv))
             if strides is not None:
                 return _compact_dense(dag, res, strides, kd, sd)
             ngroups = int(res["ngroups"])
@@ -919,6 +922,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
 
 def _compact_dense(dag, res, sizes, key_dicts, state_dicts):
     """Compact the dense slot table (host side; <= _DENSE_MAX slots)."""
+    prefetch(res)
     present = np.asarray(res["present"])
     slots = np.nonzero(present > 0)[0]
     ngroups = len(slots)
@@ -1127,8 +1131,13 @@ def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
             "key_nulls": out_key_nulls, "states": states}
 
 
-def _host_partial_agg(ctx, dag, valid):
-    """numpy fallback with identical output layout."""
+def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
+    """numpy fallback with identical output layout.
+
+    shared_dicts: when the caller aggregates chunk-by-chunk, pass ONE
+    dict ({group_idx: StringDict}) for the whole loop — raw-string keys
+    must encode through a dict shared across chunks or the int64 codes
+    are not comparable when the partials merge."""
     mask = valid
     xp = np
     keys = []
@@ -1142,9 +1151,12 @@ def _host_partial_agg(ctx, dag, valid):
         nm = np.asarray(materialize_nulls(ctx, nl))
         if d.dtype == object and sd is None:
             # raw strings (e.g. null-padded columns from a left join
-            # fallback): encode into a local dict so keys stay int64
+            # fallback): encode into a dict so keys stay int64
             from ..chunk.device import StringDict
-            sd2 = StringDict()
+            if shared_dicts is not None:
+                sd2 = shared_dicts.setdefault(gi, StringDict())
+            else:
+                sd2 = StringDict()
             d = np.array([0 if m else sd2.encode_one(str(v))
                           for v, m in zip(d, nm)], dtype=np.int64)
             key_dict_override[gi] = sd2

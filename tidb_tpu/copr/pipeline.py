@@ -26,6 +26,7 @@ from ..chunk.device import shape_bucket
 from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
                        dense_agg_body, dense_agg_states, sort_agg_body,
                        _compact_dense, _I64_MAX)
+from ..utils.fetch import prefetch
 
 _POS_DENSE_MAX = 1 << 22
 
@@ -87,16 +88,19 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
         vidx = np.nonzero(valid)[0]
         keys_v = kdata[:n][vidx]
         nv = len(keys_v)
-        if nv == 0 or (knulls is not None and knulls[:n][vidx].any()):
+        unique = nv > 0 and len(np.unique(keys_v)) == nv
+        if nv == 0 or not unique or \
+                (knulls is not None and knulls[:n][vidx].any()):
+            # dup-key / null-key dims are rejected below on every use:
+            # cache a tombstone, don't build the (possibly huge) lut
             meta = (None, None, None, False, 0)
         else:
             lo = int(keys_v.min())
             hi = int(keys_v.max())
             span = hi - lo + 1
-            unique = len(np.unique(keys_v)) == nv
             if span <= max(4 * nv, 1 << 12) and span <= _DIRECT_SPAN_BUDGET:
                 lut = np.full(span, n, dtype=np.int64)   # n == miss
-                lut[keys_v - lo] = vidx     # dup keys: one survivor
+                lut[keys_v - lo] = vidx
                 meta = ("direct", lut, lo, unique, nv)
             else:
                 o = np.argsort(keys_v, kind="stable")
@@ -143,8 +147,10 @@ def _semi_prefiltered_meta(copr, dim, tbl, arrays, valid, n, key_cid,
         keys = np.unique(kdata[:n][mask])
         nv = len(keys)
         if nv == 0:
-            # nothing passes: a 1-row always-miss structure
-            meta = ("direct", np.array([1], dtype=np.int64), 0, True, 0)
+            # nothing passes: a 1-slot always-miss lut (the kernel's hit
+            # test is lut[idx] < n, so the sentinel must be n itself —
+            # any smaller value is a false hit for probe key == lo)
+            meta = ("direct", np.array([n], dtype=np.int64), 0, True, 0)
         else:
             lo = int(keys.min())
             span = int(keys.max()) - lo + 1
@@ -191,10 +197,14 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
             return copr._dev_put(key, arr, pad_fill=fill)
         return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill)
 
-    args = {
-        "valid": put("valid", meta["valid"], n, cap, False, ts_keyed=True),
-        "cols": {},
-    }
+    pre = bool(meta.get("pre"))
+    args = {"cols": {}}
+    if not pre:
+        # prefiltered semi dims fold visibility+filters into the lut at
+        # meta time; the kernel never reads valid/cols for them — don't
+        # upload dead copies into the HBM pool
+        args["valid"] = put("valid", meta["valid"], n, cap, False,
+                            ts_keyed=True)
     if meta["mode"] == "direct":
         lcap = shape_bucket(len(meta["lut"]))
         args["lut"] = put("lut", meta["lut"], len(meta["lut"]), lcap,
@@ -207,17 +217,18 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
                          ts_keyed=True)
         args["ord"] = put("ord", meta["order"], ns, scap, ts_keyed=True)
     layout = {}
-    for sc in dim.dag.cols:
-        cid = _cid_of(dim.dag, sc)
-        if cid == -1:
-            continue
-        data, nulls, sdict = meta["arrays"][cid]
-        jd = put(("fp", cid), data, n, cap)
-        jn = None
-        if nulls is not None:
-            jn = put(("fpn", cid), nulls, n, cap, fill=True)
-        args["cols"][sc.col.idx] = (jd, jn)
-        layout[sc.col.idx] = (nulls is not None, sdict)
+    if not pre:
+        for sc in dim.dag.cols:
+            cid = _cid_of(dim.dag, sc)
+            if cid == -1:
+                continue
+            data, nulls, sdict = meta["arrays"][cid]
+            jd = put(("fp", cid), data, n, cap)
+            jn = None
+            if nulls is not None:
+                jn = put(("fpn", cid), nulls, n, cap, fill=True)
+            args["cols"][sc.col.idx] = (jd, jn)
+            layout[sc.col.idx] = (nulls is not None, sdict)
     return args, layout
 
 
@@ -263,6 +274,7 @@ def _pos_group_map(plan, dim_metas):
 
 def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
     """Decode dim positions back into group-key values (host side)."""
+    prefetch(res)
     present = np.asarray(res["present"])
     slots = np.nonzero(present > 0)[0]
     rem = slots.copy()
@@ -558,7 +570,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 copr._kernel_cache[key] = kern
             fjc_full, fvv = copr._pad_upload(cols, v, m, cap)
             fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
-            res = kern(fjc, fvv, dim_args)
+            res = prefetch(kern(fjc, fvv, dim_args))
             if pos_spec is not None:
                 out.append(_compact_pos_dense(plan, res, pos_spec[0],
                                               pos_spec[1], dim_metas, sd))
@@ -755,7 +767,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                 tuple(dim_sns), tuple(dim_layouts), agg_kind, agg_param,
                 mesh, dim_pres)
             copr._kernel_cache[key] = kern
-        res = kern(fjc, fvv, dim_args)
+        res = prefetch(kern(fjc, fvv, dim_args))
         if pos_spec is not None:
             return [_compact_pos_dense(plan, res, pos_spec[0],
                                        pos_spec[1], dim_metas, sd)]

@@ -215,13 +215,15 @@ class FusedPipelineExec(Executor):
         fb = build_executor(self.ctx, self.plan.fallback)
         shim = _AggShim(self.plan.group_items, self.plan.aggs)
         out = []
+        shared_dicts = {}
         for chunk in fb.all_chunks():        # partial-agg per chunk: no
             if not len(chunk):               # full-join materialization
                 continue
             cols = bind_chunk(self.plan.fallback.schema, chunk)
             ectx = EvalCtx(np, len(chunk), cols, host=True)
             out.append(_host_partial_agg(
-                ectx, shim, np.ones(len(chunk), dtype=bool)))
+                ectx, shim, np.ones(len(chunk), dtype=bool),
+                shared_dicts=shared_dicts))
         return out
 
 
@@ -1129,6 +1131,7 @@ class HashAggExec(Executor):
             group_items = plan.group_items
             aggs = plan.aggs
         partials = []
+        shared_dicts = {}
         while True:
             ch = self.child.next()
             if ch is None:
@@ -1139,7 +1142,8 @@ class HashAggExec(Executor):
             cols = bind_chunk(self.child.schema, ch)
             ectx = EvalCtx(np, n, cols, host=True)
             partials.append(_host_partial_agg(ectx, _FakeDag,
-                                              np.ones(n, dtype=bool)))
+                                              np.ones(n, dtype=bool),
+                                              shared_dicts=shared_dicts))
         return self._merge_partials(partials)
 
     def _complete_distinct(self):

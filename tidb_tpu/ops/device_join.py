@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..chunk.device import shape_bucket
+from ..utils.fetch import prefetch
 
 _I64_MAX = np.iinfo(np.int64).max
 
@@ -81,6 +82,7 @@ def device_join_index(bk: np.ndarray, bnull: np.ndarray,
         _EXPAND_CACHE[(out_cap, cp)] = expand
     pi, bpos, valid = expand(counts, lo, border,
                              jnp.asarray(total, dtype=jnp.int64))
+    prefetch(pi, bpos)
     pi = np.asarray(pi)[:total]
     bpos = np.asarray(bpos)[:total]
     return pi, bpos

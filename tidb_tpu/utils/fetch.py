@@ -1,0 +1,35 @@
+"""One-round-trip device->host result fetching.
+
+The TPU link (axon tunnel) has a large fixed latency per *synchronized*
+host fetch (~65-95ms measured on chip) while transfers issued with
+``copy_to_host_async()`` overlap: N results prefetched together cost one
+round trip instead of N.  Every query-result collection point must call
+:func:`prefetch` on the whole result tree before the first
+``np.asarray`` — sequential materialization of a 17-array aggregate
+result otherwise costs ~1.1s of pure link latency.
+
+Reference analog: pkg/store/copr/coprocessor.go's copIterator overlaps
+region responses the same way (streamed, not lock-step).
+"""
+
+
+def prefetch(*trees):
+    """Issue async device->host copies for every jax array found in the
+    given pytrees (dict/list/tuple nests, scalars pass through).  After
+    this, ``np.asarray()`` on each array materializes from the already
+    overlapped transfer instead of paying its own link round trip."""
+    stack = list(trees)
+    while stack:
+        x = stack.pop()
+        if isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+        else:
+            start = getattr(x, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:       # noqa: BLE001 - committed arrays only
+                    pass
+    return trees[0] if len(trees) == 1 else trees
