@@ -62,3 +62,119 @@ def test_dense_group_sums_kernel():
         assert int(cnts[g]) == int(m.sum())
         assert int(sums[0][g]) == int(v1[m].sum())
         assert int(sums[1][g]) == int(v2[m].sum())
+
+
+def test_dense_agg_sorted_matches_scatter():
+    """The TPU lowering of dense_agg_states (shared argsort + segmented
+    scans, no scatter) must match the scatter lowering state-for-state:
+    sums/counts exactly, min/max/first_row, NULL args, empty slots."""
+    import jax
+    import jax.numpy as jnp
+    import tidb_tpu.copr.dag_exec as de
+    from tidb_tpu.expression import EvalCtx
+    from tidb_tpu.expression.expr import Column
+    from tidb_tpu.types.field_type import new_bigint_type, new_double_type
+
+    rng = np.random.RandomState(7)
+    cap = 4096
+    nslots = 11
+    mask = rng.rand(cap) < 0.7
+    slot = np.where(mask, rng.randint(0, nslots - 2, cap), nslots)
+    # slot nslots-2 and nslots-1 stay EMPTY
+    ints = rng.randint(-50, 50, cap).astype(np.int64)
+    flts = rng.randn(cap)
+    fnull = rng.rand(cap) < 0.2
+
+    class A:
+        def __init__(self, name, args):
+            self.name, self.args, self.distinct = name, args, False
+    ci = Column(0, new_bigint_type())
+    cf = Column(1, new_double_type())
+    aggs = [A("count", []), A("sum", [ci]), A("avg", [cf]),
+            A("min", [ci]), A("max", [cf]), A("first_row", [ci]),
+            A("count", [cf])]
+    cols = {0: (jnp.asarray(ints), None, None),
+            1: (jnp.asarray(flts), jnp.asarray(fnull), None)}
+    ctx = EvalCtx(jnp, cap, cols, host=False)
+    jm = jnp.asarray(mask)
+    js = jnp.asarray(slot)
+
+    outs = {}
+    for impl in ("scatter", "sorted"):
+        de._FORCE_SEGMENT_IMPL = impl
+        try:
+            r = de.dense_agg_states(ctx, jm, aggs, js, nslots, cap)
+        finally:
+            de._FORCE_SEGMENT_IMPL = None
+        outs[impl] = jax.device_get(r)
+    a, b = outs["scatter"], outs["sorted"]
+    np.testing.assert_array_equal(a["present"], b["present"])
+    assert a["present"][nslots - 1] == 0 and a["present"][nslots - 2] == 0
+    for st_a, st_b, agg in zip(a["states"], b["states"], aggs):
+        for s_a, s_b in zip(st_a, st_b):
+            if s_a.dtype.kind == "f":
+                np.testing.assert_allclose(s_a, s_b, rtol=1e-12)
+            else:
+                np.testing.assert_array_equal(s_a, s_b)
+
+
+@pytest.mark.parametrize("shape", ["keyed", "global", "wide_keys"])
+def test_sort_agg_sorted_matches_scatter(shape):
+    """sort_agg_body's TPU lowering (segmented scans over the already
+    sorted rows) must match the scatter lowering: packed and multisort
+    key branches, null group keys, masked rows, all agg kinds."""
+    import jax
+    import jax.numpy as jnp
+    import tidb_tpu.copr.dag_exec as de
+    from tidb_tpu.expression import EvalCtx
+    from tidb_tpu.expression.expr import Column
+    from tidb_tpu.types.field_type import new_bigint_type, new_double_type
+
+    rng = np.random.RandomState(11)
+    cap = 2048
+    group_bucket = 64
+    mask = rng.rand(cap) < 0.8
+    gvals = rng.randint(0, 9, cap).astype(np.int64)
+    if shape == "wide_keys":
+        # keys spanning ~2^62 force the multisort lax.cond branch
+        gvals = np.where(gvals < 4, gvals - (1 << 61), gvals + (1 << 61))
+    gnull = rng.rand(cap) < 0.15
+    ints = rng.randint(-100, 100, cap).astype(np.int64)
+    flts = rng.randn(cap)
+    fnull = rng.rand(cap) < 0.2
+
+    class A:
+        def __init__(self, name, args):
+            self.name, self.args, self.distinct = name, args, False
+    ci = Column(1, new_bigint_type())
+    cf = Column(2, new_double_type())
+    aggs = [A("count", []), A("sum", [ci]), A("avg", [cf]),
+            A("min", [cf]), A("max", [ci]), A("first_row", [ci]),
+            A("count", [cf])]
+    group_items = [] if shape == "global" else [Column(0, new_bigint_type())]
+    cols = {0: (jnp.asarray(gvals), jnp.asarray(gnull), None),
+            1: (jnp.asarray(ints), None, None),
+            2: (jnp.asarray(flts), jnp.asarray(fnull), None)}
+    ctx = EvalCtx(jnp, cap, cols, host=False)
+    jm = jnp.asarray(mask)
+
+    outs = {}
+    for impl in ("scatter", "sorted"):
+        de._FORCE_SEGMENT_IMPL = impl
+        try:
+            r = de.sort_agg_body(ctx, jm, group_items, aggs, cap,
+                                 group_bucket)
+        finally:
+            de._FORCE_SEGMENT_IMPL = None
+        outs[impl] = jax.device_get(r)
+    a, b = outs["scatter"], outs["sorted"]
+    ng = int(a["ngroups"])
+    assert ng == int(b["ngroups"])
+    for ka, kb in zip(a["keys"], b["keys"]):
+        np.testing.assert_array_equal(ka[:ng], kb[:ng])
+    for st_a, st_b in zip(a["states"], b["states"]):
+        for s_a, s_b in zip(st_a, st_b):
+            if s_a.dtype.kind == "f":
+                np.testing.assert_allclose(s_a[:ng], s_b[:ng], rtol=1e-12)
+            else:
+                np.testing.assert_array_equal(s_a[:ng], s_b[:ng])

@@ -817,7 +817,8 @@ def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
     afps = tuple(a.fingerprint() for a in plan.aggs)
     colsig = tuple(sorted((sc.col.idx, sc.name)
                           for sc in plan.fact_dag.cols))
+    from .dag_exec import _use_sorted_segments
     return ("fused", fact_tbl.uid, cap, dim_caps, dim_ns, dim_sns, fps,
             dimsig, postfps, gfps, afps, tuple(dict_vers), colsig,
-            agg_kind, agg_param,
+            agg_kind, agg_param, _use_sorted_segments(),
             tuple(bool(m.get("pre")) for m in dim_metas))
