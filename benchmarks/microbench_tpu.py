@@ -1,0 +1,120 @@
+"""TPU primitive microbenchmarks for the copr kernel design.
+
+Honest timing on the axon platform: `block_until_ready` is a no-op
+there, so every sample forces a host fetch (np.asarray) — the same
+round trip a real query result pays. Run directly:
+
+    python benchmarks/microbench_tpu.py [section ...]
+
+Sections: io, reduce, group, sort, scatter (scatter can take minutes
+to COMPILE on the axon backend — run it last, with a long timeout).
+
+Design inputs these numbers feed (copr/dag_exec.py lowering choice):
+- dispatch+fetch round-trip floor
+- masked reductions (no-group aggs)
+- broadcast-compare-reduce (tiny group domains)
+- blocked one-hot matmul (medium dense domains, MXU)
+- cumsum + boundary extraction (pre-clustered group keys)
+- sort / argsort / top_k (compaction, ordered output)
+- segment_sum scatter (the fallback the others replace)
+"""
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+N = 1 << 20
+
+
+def fetch(r):
+    for leaf in jax.tree_util.tree_leaves(r):
+        np.asarray(leaf)
+
+
+def bench(label, fn, *args, reps=5):
+    t0 = time.time()
+    r = fn(*args)
+    fetch(r)
+    print(f"{label}: compile+1st {time.time() - t0:.1f}s", flush=True)
+    t0 = time.time()
+    for _ in range(reps):
+        fetch(fn(*args))
+    print(f"{label}: {(time.time() - t0) / reps * 1000:.2f} ms/op",
+          flush=True)
+
+
+def main(sections):
+    rng = np.random.default_rng(0)
+    v64 = jnp.asarray(rng.integers(0, 1 << 22, N), dtype=jnp.int64)
+    all_s = not sections
+
+    if all_s or "io" in sections:
+        h32 = rng.integers(0, 1 << 22, 1 << 22).astype(np.int64)
+        t0 = time.time()
+        d = jax.device_put(h32)
+        np.asarray(d[:1])
+        print(f"upload 32MB {time.time() - t0:.2f}s", flush=True)
+        t0 = time.time()
+        np.asarray(d)
+        print(f"download 32MB {time.time() - t0:.2f}s", flush=True)
+        bench("roundtrip tiny", jax.jit(lambda a: jnp.sum(a[:8])), v64)
+
+    if all_s or "reduce" in sections:
+        def q6like(a, b, c, d):
+            m = (a > 100) & (b < (1 << 21)) & (c > 50)
+            return (jnp.sum(jnp.where(m, a, 0)),
+                    jnp.sum(jnp.where(m, a * d, 0)), jnp.sum(m))
+        bench("q6-like masked sums 1M", jax.jit(q6like),
+              v64, v64 + 1, v64 + 2, v64 + 3)
+
+    if all_s or "group" in sections:
+        slots6 = jnp.asarray(rng.integers(0, 6, N), dtype=jnp.int64)
+
+        def bcr(v, s):
+            oh = s[None, :] == jnp.arange(6)[:, None]
+            return jnp.sum(jnp.where(oh, v[None, :], 0), axis=1)
+        bench("bcast-cmp-reduce 1M->6 i64", jax.jit(bcr), v64, slots6)
+
+        bench("cumsum 1M i64", jax.jit(jnp.cumsum), v64)
+
+        slots256 = jnp.asarray(rng.integers(0, 256, N), dtype=jnp.int64)
+
+        def ohmm(v, s):
+            blk = v.reshape(-1, 4096).astype(jnp.float32)
+            oh = (s.reshape(-1, 4096)[:, :, None] ==
+                  jnp.arange(256)[None, None, :]).astype(jnp.float32)
+            p = jnp.einsum("bn,bns->bs", blk, oh)
+            return jnp.sum(p.astype(jnp.int64), axis=0)
+        bench("onehot-matmul blocked 1M->256", jax.jit(ohmm), v64,
+              slots256)
+
+        keys_clustered = jnp.asarray(np.sort(np.asarray(slots256)))
+
+        def boundary_sums(v, key):
+            cum = jnp.cumsum(v)
+            last = jnp.concatenate(
+                [key[1:] != key[:-1], jnp.ones((1,), bool)])
+            return jnp.where(last, cum, 0), last
+        bench("cumsum+boundary 1M", jax.jit(boundary_sums), v64,
+              keys_clustered)
+
+    if all_s or "sort" in sections:
+        bench("sort 1M i64", jax.jit(jnp.sort), v64)
+        bench("sort 1M i32", jax.jit(jnp.sort), v64.astype(jnp.int32))
+        bench("argsort 1M i64", jax.jit(jnp.argsort), v64)
+        bench("topk1024 1M", jax.jit(lambda v: jax.lax.top_k(v, 1024)),
+              v64)
+
+    if "scatter" in sections:          # never in the default set
+        slots = jnp.asarray(rng.integers(0, 150_000, N), dtype=jnp.int64)
+        bench("segment_sum 1M->150k i64",
+              jax.jit(lambda v, s: jax.ops.segment_sum(
+                  v, s, num_segments=150_000)), v64, slots)
+
+
+if __name__ == "__main__":
+    main(set(sys.argv[1:]))

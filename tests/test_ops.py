@@ -100,22 +100,25 @@ def test_dense_agg_sorted_matches_scatter():
     js = jnp.asarray(slot)
 
     outs = {}
-    for impl in ("scatter", "sorted"):
+    for impl in ("scatter", "sorted", "runs"):
+        # "runs" at nslots=11 exercises the broadcast-compare lowering
         de._FORCE_SEGMENT_IMPL = impl
         try:
             r = de.dense_agg_states(ctx, jm, aggs, js, nslots, cap)
         finally:
             de._FORCE_SEGMENT_IMPL = None
         outs[impl] = jax.device_get(r)
-    a, b = outs["scatter"], outs["sorted"]
-    np.testing.assert_array_equal(a["present"], b["present"])
+    a = outs["scatter"]
     assert a["present"][nslots - 1] == 0 and a["present"][nslots - 2] == 0
-    for st_a, st_b, agg in zip(a["states"], b["states"], aggs):
-        for s_a, s_b in zip(st_a, st_b):
-            if s_a.dtype.kind == "f":
-                np.testing.assert_allclose(s_a, s_b, rtol=1e-12)
-            else:
-                np.testing.assert_array_equal(s_a, s_b)
+    for other in ("sorted", "runs"):
+        b = outs[other]
+        np.testing.assert_array_equal(a["present"], b["present"])
+        for st_a, st_b, agg in zip(a["states"], b["states"], aggs):
+            for s_a, s_b in zip(st_a, st_b):
+                if s_a.dtype.kind == "f":
+                    np.testing.assert_allclose(s_a, s_b, rtol=1e-12)
+                else:
+                    np.testing.assert_array_equal(s_a, s_b)
 
 
 @pytest.mark.parametrize("shape", ["keyed", "global", "wide_keys"])
@@ -178,3 +181,113 @@ def test_sort_agg_sorted_matches_scatter(shape):
                 np.testing.assert_allclose(s_a[:ng], s_b[:ng], rtol=1e-12)
             else:
                 np.testing.assert_array_equal(s_a[:ng], s_b[:ng])
+
+
+def _merge_partials(res, aggs, nkeys):
+    """Fold a sort-layout agg result into {key_tuple: merged_states} —
+    the host-side merge the executor applies across partitions, used
+    here to compare group orders and duplicate-key partials (the runs
+    lowering emits one partial per contiguous run)."""
+    ng = int(res["ngroups"])
+    groups = {}
+    for j in range(ng):
+        key = tuple(
+            (bool(res["key_nulls"][i][j]),
+             None if res["key_nulls"][i][j] else int(res["keys"][i][j]))
+            for i in range(nkeys))
+        st = groups.get(key)
+        if st is None:
+            groups[key] = [[s[j] for s in stt] for stt in res["states"]]
+            continue
+        for (acc, stt, a) in zip(st, res["states"], aggs):
+            cnt_new = stt[-1][j] if len(stt) > 1 else stt[0][j]
+            if a.name == "count":
+                acc[0] += stt[0][j]
+            elif a.name in ("sum", "avg"):
+                acc[0] += stt[0][j]
+                acc[1] += stt[1][j]
+            elif a.name == "min":
+                if cnt_new > 0:
+                    acc[0] = min(acc[0], stt[0][j]) if acc[1] > 0 \
+                        else stt[0][j]
+                acc[1] += cnt_new
+            elif a.name == "max":
+                if cnt_new > 0:
+                    acc[0] = max(acc[0], stt[0][j]) if acc[1] > 0 \
+                        else stt[0][j]
+                acc[1] += cnt_new
+            elif a.name == "first_row":
+                if acc[1] == 0 and cnt_new > 0:
+                    acc[0] = stt[0][j]
+                acc[1] += cnt_new
+    return groups
+
+
+@pytest.mark.parametrize("shape", ["clustered", "unclustered", "global"])
+def test_runs_agg_matches_scatter(shape):
+    """The runs lowering (contiguous-run partials: cumsum + boundary
+    gathers, no sort, no scatter) must agree with the scatter oracle
+    after the host partial merge — clustered keys (one run per group),
+    unclustered keys (many duplicate-key partials), NULL keys, masked
+    runs, all agg kinds."""
+    import jax
+    import jax.numpy as jnp
+    import tidb_tpu.copr.dag_exec as de
+    from tidb_tpu.expression import EvalCtx
+    from tidb_tpu.expression.expr import Column
+    from tidb_tpu.types.field_type import new_bigint_type, new_double_type
+
+    rng = np.random.RandomState(23)
+    cap = 2048
+    mask = rng.rand(cap) < 0.75
+    gvals = rng.randint(0, 40, cap).astype(np.int64)
+    gnull = rng.rand(cap) < 0.1
+    if shape == "clustered":
+        order = np.lexsort((gvals, gnull))
+        gvals, gnull = gvals[order], gnull[order]
+
+    class A:
+        def __init__(self, name, args):
+            self.name, self.args, self.distinct = name, args, False
+    ci = Column(1, new_bigint_type())
+    cf = Column(2, new_double_type())
+    aggs = [A("count", []), A("sum", [ci]), A("avg", [cf]),
+            A("min", [cf]), A("max", [ci]), A("first_row", [ci]),
+            A("count", [cf])]
+    group_items = [] if shape == "global" else \
+        [Column(0, new_bigint_type())]
+    nkeys = len(group_items)
+    ints = rng.randint(-100, 100, cap).astype(np.int64)
+    flts = rng.randn(cap)
+    fnull = rng.rand(cap) < 0.2
+    cols = {0: (jnp.asarray(gvals), jnp.asarray(gnull), None),
+            1: (jnp.asarray(ints), None, None),
+            2: (jnp.asarray(flts), jnp.asarray(fnull), None)}
+    ctx = EvalCtx(jnp, cap, cols, host=False)
+    jm = jnp.asarray(mask)
+
+    outs = {}
+    for impl in ("scatter", "runs"):
+        bucket = cap if impl == "runs" else 64
+        de._FORCE_SEGMENT_IMPL = impl
+        try:
+            r = de.sort_agg_body(ctx, jm, group_items, aggs, cap, bucket)
+        finally:
+            de._FORCE_SEGMENT_IMPL = None
+        outs[impl] = jax.device_get(r)
+    if shape == "clustered":
+        # one run per group: no duplicate partials even pre-merge
+        assert int(outs["runs"]["ngroups"]) == \
+            int(outs["scatter"]["ngroups"])
+    ga = _merge_partials(outs["scatter"], aggs, nkeys)
+    gb = _merge_partials(outs["runs"], aggs, nkeys)
+    assert set(ga) == set(gb)
+    for key, st_a in ga.items():
+        st_b = gb[key]
+        for sa, sb, a in zip(st_a, st_b, aggs):
+            for x, y in zip(sa, sb):
+                if getattr(x, "dtype", np.int64) == np.float64 or \
+                        isinstance(x, float):
+                    np.testing.assert_allclose(x, y, rtol=1e-9)
+                else:
+                    assert int(x) == int(y), (key, a.name)

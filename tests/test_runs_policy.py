@@ -1,0 +1,105 @@
+"""End-to-end checks of the TPU "runs" segment lowering on CPU.
+
+The full suite runs with the CPU default (scatter oracle); this module
+re-runs the headline query shapes with the TPU policy forced so the
+scatter-free kernels (reduce / broadcast-compare / contiguous-run
+partials) stay covered in CI. See dag_exec._segment_impl for the
+measured numbers behind the policy.
+"""
+import numpy as np
+import pytest
+
+import tidb_tpu.copr.dag_exec as de
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.bench.tpch import load_tpch, QUERIES
+
+
+@pytest.fixture
+def runs_impl():
+    de._FORCE_SEGMENT_IMPL = "runs"
+    try:
+        yield
+    finally:
+        de._FORCE_SEGMENT_IMPL = None
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    load_tpch(tk, sf=0.003, seed=7)
+    return tk
+
+
+@pytest.mark.parametrize("q", ["q1", "q3", "q5", "q6"])
+def test_tpch_headline_runs_vs_host(tk, runs_impl, q):
+    tk.domain.copr.use_device = True
+    dev = tk.must_query(QUERIES[q]).rows
+    tk.domain.copr.use_device = False
+    host = tk.must_query(QUERIES[q]).rows
+    tk.domain.copr.use_device = True
+    assert len(dev) == len(host)
+    for rd, rh in zip(dev, host):
+        for a, b in zip(rd, rh):
+            if isinstance(a, float) or isinstance(b, float):
+                np.testing.assert_allclose(float(a), float(b), rtol=1e-9)
+            else:
+                assert a == b, (q, rd, rh)
+
+
+def test_first_row_skips_empty_partials(runs_impl):
+    """A run (or partition) whose rows all have NULL agg args emits a
+    cnt=0 first_row partial whose value slot is garbage; the merge must
+    take the first partial that actually saw a value."""
+    tk = TestKit()
+    tk.must_exec("create table t (k int, v int)")
+    tk.must_exec("insert into t values (1, null), (1, null), (2, 7), "
+                 "(2, 8), (1, 42), (1, 43)")
+    got = tk.must_query("select k, v from t group by k order by k").rows
+    assert [(int(r[0]), int(r[1])) for r in got] == [(1, 42), (2, 7)]
+
+
+def test_runs_degradation_pins_sorted(runs_impl, monkeypatch):
+    """Unclustered keys explode into ~per-row runs: the guard must pin
+    the query shape to the sorted lowering and still answer exactly."""
+    monkeypatch.setattr(de, "_RUNS_DEGRADE_MIN", 8)
+    tk = TestKit()
+    tk.must_exec("create table t (k bigint, v int)")
+    rng = np.random.RandomState(5)
+    # wide key span: not BCR-eligible, so the general runs path runs
+    ks = rng.randint(0, 1 << 40, 800)
+    rows = ",".join(f"({k},{i})" for i, k in enumerate(ks))
+    tk.must_exec(f"insert into t values {rows}")
+    got = tk.must_query(
+        "select k, count(*) from t group by k order by k").rows
+    assert len(got) == len(set(ks.tolist()))
+    for row in got:
+        assert int(row[1]) == int((ks == int(row[0])).sum())
+    pinned = [v for key, v in tk.domain.copr._host_cache.items()
+              if key and key[0] == "aggimpl"]
+    assert "sorted" in pinned
+
+
+def test_unclustered_group_by_runs(runs_impl):
+    """Unclustered keys produce duplicate-run partials; the merge must
+    still return exact aggregates (bucket regrow path included)."""
+    tk = TestKit()
+    tk.must_exec("create table t (k int, v int, f double)")
+    rng = np.random.RandomState(3)
+    ks = rng.randint(0, 50, 600)
+    vs = rng.randint(-1000, 1000, 600)
+    rows = ",".join(
+        f"({k},{v},{v / 7.0})" for k, v in zip(ks, vs))
+    tk.must_exec(f"insert into t values {rows}")
+    got = tk.must_query(
+        "select k, count(*), sum(v), min(v), max(v), avg(f) from t "
+        "group by k order by k").rows
+    assert len(got) == len(set(ks.tolist()))
+    for row in got:
+        k = row[0]
+        m = ks == k
+        assert int(row[1]) == int(m.sum())
+        assert int(row[2]) == int(vs[m].sum())
+        assert int(row[3]) == int(vs[m].min())
+        assert int(row[4]) == int(vs[m].max())
+        np.testing.assert_allclose(float(row[5]),
+                                   float((vs[m] / 7.0).mean()), rtol=1e-9)

@@ -405,6 +405,12 @@ class CoprExecutor:
         strides = _dense_strides(dag, kd, cols_full, n)
         if strides is None:
             return None
+        if _segment_impl() == "runs" and \
+                _dense_nslots(strides) > _BCR_MAX:
+            # no scatter-free dense lowering at this size: let the
+            # caller fall through to the single-chip runs path rather
+            # than hit the argsort fallback inside dense_agg_states
+            return None
         ndev = int(mesh.devices.size)
         lane = 128 * ndev
         padded = ((n + lane - 1) // lane) * lane
@@ -452,7 +458,7 @@ class CoprExecutor:
         afps = tuple(a.fingerprint() for a in dag.aggs)
         colsig = tuple(sorted((sc.col.idx, sc.name) for sc in dag.cols))
         return (kind, tbl.uid, cap, fps, gfps, afps, dict_vers, colsig,
-                _use_sorted_segments(), extra)
+                _segment_impl(), extra)
 
     def _run_filter_partition(self, dag, tbl, cols, v, m, cap):
         key = self._cache_key(dag, tbl, "filter", cap)
@@ -581,12 +587,20 @@ class CoprExecutor:
                  tuple(g.fingerprint() for g in dag.group_items),
                  tuple(a.fingerprint() for a in dag.aggs))
         group_bucket = max(group_bucket, self._host_cache.get(gbkey, 0))
+        impl_key = ("aggimpl",) + gbkey
         while True:
+            impl = self._host_cache.get(impl_key) or _segment_impl()
             kd, sd = capture_agg_dicts(dag, cols)
             # dense fast path: group keys span a small combined domain
             # (dict codes, or int keys after a runtime min/max pass) ->
             # direct scatter-add, no sort (Q1 / year()-grouping shapes)
             strides = _dense_strides(dag, kd, cols, m)
+            if strides is not None and impl == "runs" and \
+                    _dense_nslots(strides) > _BCR_MAX:
+                # dense-but-big domains have no scatter-free dense
+                # lowering on TPU: take the general path, which runs
+                # runs_agg_body (contiguous-run partials)
+                strides = None
             if strides is not None:
                 key = self._cache_key(dag, tbl, "dagg", cap, tuple(strides))
                 kern = self._kernel_cache.get(key)
@@ -594,10 +608,12 @@ class CoprExecutor:
                     kern = _build_dense_agg_kernel(dag, cols, cap, strides)
                     self._kernel_cache[key] = kern
             else:
-                key = self._cache_key(dag, tbl, "agg", cap, (group_bucket,))
+                key = self._cache_key(dag, tbl, "agg", cap,
+                                      (group_bucket, impl))
                 kern = self._kernel_cache.get(key)
                 if kern is None:
-                    kern = _build_agg_kernel(dag, cols, cap, group_bucket)
+                    kern = _build_agg_kernel(dag, cols, cap, group_bucket,
+                                             impl)
                     self._kernel_cache[key] = kern
             jcols, vv = self._pad_upload(cols, v, m, cap)
             jc = {k: (d, nl) for k, (d, nl, _) in jcols.items()}
@@ -613,6 +629,13 @@ class CoprExecutor:
             if strides is not None:
                 return _compact_dense(dag, res, strides, kd, sd)
             ngroups = int(res["ngroups"])
+            if impl == "runs" and ngroups > max(_RUNS_DEGRADE_MIN, m // 4):
+                # keys uncorrelated with storage order: runs exploded
+                # into ~per-row partials. Pin this (table, group, agg)
+                # shape to the sorted lowering (one partial per group)
+                # before the regrow loop learns the inflated bucket.
+                self._host_cache[impl_key] = "sorted"
+                continue
             if ngroups > group_bucket:
                 group_bucket = shape_bucket(ngroups)
                 self._host_cache[gbkey] = group_bucket
@@ -773,17 +796,26 @@ def dense_agg_states(ctx, mask, aggs, slot, nslots, cap):
     nslots means masked-out). Used with key-product slots and with
     join-POSITION slots (group-by-FK in the fused pipeline).
 
-    Two lowerings:
+    Lowerings:
     - scatter (segment ops): good on CPU, but on TPU the int64 values
       emulate as u32 pairs and the variadic scatter-add serializes
       (~16KB of vreg traffic PER ROW measured: a 655k-row Q6 kernel
       read 10.8GB and ran 145ms).
-    - sorted: ONE shared argsort of the slot array (TPU sorts 655k in
-      ~0.1ms) + segmented scans; no scatter at all. Per-segment sums
-      accumulate sequentially inside the scan (no cumsum-diff
-      cancellation), so results match the scatter path bit-for-bit
-      for ints and to normal float rounding for floats."""
-    if _use_sorted_segments():
+    - sorted: ONE shared argsort of the slot array + segmented scans;
+      no scatter, but argsort itself is ~855ms/1M on the v5e.
+    - reduce/bcr (via the "runs" policy): plain masked reductions for
+      the global case, [nslots, cap] broadcast-compare reductions for
+      tiny domains — no sort AND no scatter; larger domains are routed
+      to runs_agg_body by the callers before reaching here."""
+    impl = _segment_impl()
+    if impl == "runs":
+        if nslots == 1:
+            return _dense_agg_states_reduce(ctx, mask, aggs, cap)
+        if nslots <= _BCR_MAX:
+            return _dense_agg_states_bcr(ctx, mask, aggs, slot, nslots,
+                                         cap)
+        impl = "sorted"      # callers route big domains to runs_agg_body
+    if impl == "sorted":
         return _dense_agg_states_sorted(ctx, mask, aggs, slot, nslots, cap)
     states = []
     for a in aggs:
@@ -828,15 +860,232 @@ def dense_agg_states(ctx, mask, aggs, slot, nslots, cap):
     return {"present": present, "states": states}
 
 
-_FORCE_SEGMENT_IMPL = None      # tests: "sorted" | "scatter" | None (auto)
+_FORCE_SEGMENT_IMPL = None  # tests: "scatter"|"sorted"|"runs"|None (auto)
+
+# broadcast-compare-reduce ceiling: a [nslots, cap] fused compare+reduce
+# reads each value column nslots times, so it only wins for tiny group
+# domains (Q1's flag x status = 12, Q5's 25 nations)
+_BCR_MAX = int(os.environ.get("TIDB_TPU_BCR_MAX", "64"))
+
+# if the runs lowering yields more partials than this (and more than a
+# quarter of the partition's rows), the group key is uncorrelated with
+# storage order — pin the query shape to the sorted lowering instead
+_RUNS_DEGRADE_MIN = int(os.environ.get("TIDB_TPU_RUNS_DEGRADE", "65536"))
 
 
-def _use_sorted_segments():
+def _segment_impl():
+    """How segment aggregations lower: "scatter" | "sorted" | "runs".
+
+    Measured on the v5e through the axon tunnel
+    (benchmarks/microbench_tpu.py):
+    - scatter (jax.ops.segment_*): XLA variadic scatter serializes row
+      by row on TPU AND its compile takes minutes on this backend —
+      never use it in a TPU kernel.
+    - sorted (argsort + segmented scans): argsort(1M i64) is ~855ms a
+      call; sort compiles are 25-40s.
+    - runs (cumsum + boundary gathers, this round): no sort, no
+      scatter; contiguous equal-key runs become partial groups that the
+      existing partial-agg merge combines, which is exact for any input
+      and compact whenever the data is clustered by the group key
+      (TPC-H lineitem by l_orderkey, dict codes from sorted loads, ...).
+    CPU keeps scatter: it is fast there and serves as the oracle the
+    device lowerings are tested against."""
     impl = _FORCE_SEGMENT_IMPL or \
         os.environ.get("TIDB_TPU_SEGMENT_IMPL")
-    if impl:
-        return impl == "sorted"
-    return jax.default_backend() != "cpu"
+    if impl and impl != "auto":
+        if impl not in ("scatter", "sorted", "runs"):
+            raise ValueError(
+                f"TIDB_TPU_SEGMENT_IMPL={impl!r}: expected one of "
+                "scatter|sorted|runs|auto")
+        return impl
+    return "runs" if jax.default_backend() != "cpu" else "scatter"
+
+
+def _dense_nslots(sizes):
+    n = 1
+    for s, _off in sizes:
+        n *= s
+    return n
+
+
+def _minmax_sentinel(name, dtype):
+    """-> (sentinel, combine) for a min/max agg over arrays of dtype:
+    the identity the masked-out rows take and the elementwise combiner.
+    Shared by every lowering so they cannot diverge from the oracle."""
+    is_f = dtype.kind == "f"
+    if name == "min":
+        return (jnp.asarray(np.inf if is_f else _I64_MAX).astype(dtype),
+                jnp.minimum)
+    return (jnp.asarray(-np.inf if is_f else -_I64_MAX).astype(dtype),
+            jnp.maximum)
+
+
+def _agg_eval_rows(ctx, a, mask, cap):
+    """-> (d, row_ok) for one agg over the eval ctx (count(*) -> ones)."""
+    if a.args:
+        d, nl, _ = eval_expr(ctx, a.args[0])
+        if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+            d = jnp.full(cap, d)
+        nm = materialize_nulls(ctx, nl)
+        return d, mask & ~nm
+    return jnp.ones(cap, dtype=jnp.int64), mask
+
+
+def _dense_agg_states_reduce(ctx, mask, aggs, cap):
+    """Global aggregation (nslots == 1) as plain masked reductions —
+    no segment ops of any kind."""
+    states = []
+    for a in aggs:
+        d, ok = _agg_eval_rows(ctx, a, mask, cap)
+        cnt = jnp.sum(ok.astype(jnp.int64))[None]
+        if a.name == "count":
+            states.append([cnt])
+        elif a.name in ("sum", "avg"):
+            z = jnp.zeros((), d.dtype)
+            states.append([jnp.sum(jnp.where(ok, d, z))[None], cnt])
+        elif a.name in ("min", "max"):
+            sent, _ = _minmax_sentinel(a.name, d.dtype)
+            red = jnp.min if a.name == "min" else jnp.max
+            states.append([red(jnp.where(ok, d, sent))[None], cnt])
+        elif a.name == "first_row":
+            fpos = jnp.argmax(ok)       # first True; 0 when none (cnt=0)
+            states.append([d[fpos][None], cnt])
+        else:
+            raise NotImplementedError(a.name)
+    return {"present": jnp.sum(mask.astype(jnp.int64))[None],
+            "states": states}
+
+
+def _dense_agg_states_bcr(ctx, mask, aggs, slot, nslots, cap):
+    """Tiny dense domains: one [nslots, cap] broadcast compare fused by
+    XLA into per-slot reductions. Exact for every dtype and agg kind;
+    reads each column nslots times, so gated by _BCR_MAX."""
+    eq = slot[None, :] == jnp.arange(nslots)[:, None]     # [nslots, cap]
+    iota = jnp.arange(cap)
+    states = []
+    for a in aggs:
+        d, ok = _agg_eval_rows(ctx, a, mask, cap)
+        sel = eq & ok[None, :]
+        cnt = jnp.sum(sel.astype(jnp.int64), axis=1)
+        if a.name == "count":
+            states.append([cnt])
+        elif a.name in ("sum", "avg"):
+            z = jnp.zeros((), d.dtype)
+            states.append([jnp.sum(jnp.where(sel, d[None, :], z), axis=1),
+                           cnt])
+        elif a.name in ("min", "max"):
+            sent, _ = _minmax_sentinel(a.name, d.dtype)
+            red = jnp.min if a.name == "min" else jnp.max
+            states.append([red(jnp.where(sel, d[None, :], sent), axis=1),
+                           cnt])
+        elif a.name == "first_row":
+            fi = jnp.min(jnp.where(sel, iota[None, :], cap - 1), axis=1)
+            states.append([d[fi], cnt])
+        else:
+            raise NotImplementedError(a.name)
+    return {"present": jnp.sum(eq.astype(jnp.int64), axis=1),
+            "states": states}
+
+
+def _runs_agg_core(keys, key_nulls, mask, ctx, aggs, cap, bucket):
+    """Contiguous-run partial aggregation: every maximal run of equal
+    group keys becomes one partial group, extracted with cumulative
+    sums + monotone searchsorted gathers — no sort, no scatter.
+
+    Exactness: int sums/counts via prefix-sum differences (exact);
+    float sums and min/max via a segmented associative scan that resets
+    at run starts (no cross-group cancellation). Runs wholly masked out
+    are dropped on device, so the returned ngroups counts only groups
+    with visible rows. Unclustered inputs stay CORRECT (duplicate keys
+    appear as multiple partials; the partial-agg merge combines them)
+    but degrade to ~one run per row — callers should prefer this
+    lowering when storage order clusters the key, which TPC-H fact
+    tables and join positions do."""
+    idx = jnp.arange(cap)
+    if keys:
+        neq = jnp.zeros(cap - 1, dtype=bool)
+        for k, kn in zip(keys, key_nulls):
+            neq = neq | (k[1:] != k[:-1]) | (kn[1:] != kn[:-1])
+        change = jnp.concatenate([jnp.ones(1, dtype=bool), neq])
+    else:
+        change = jnp.concatenate([jnp.ones(1, dtype=bool),
+                                  jnp.zeros(cap - 1, dtype=bool)])
+    cs_change = jnp.cumsum(change.astype(jnp.int64))      # run ordinal
+    run_start = jax.lax.cummax(jnp.where(change, idx, -1))
+    mi = mask.astype(jnp.int64)
+    mask_cs = jnp.cumsum(mi)
+    mask_before_run = (mask_cs - mi)[run_start]
+    vstart = mask & (mask_cs == mask_before_run + 1)      # first valid row
+    vcs = jnp.cumsum(vstart.astype(jnp.int64))
+    ngroups = vcs[cap - 1]
+    pos = jnp.searchsorted(vcs, jnp.arange(1, bucket + 1))
+    posc = jnp.minimum(pos, cap - 1)
+    rs = run_start[posc]                                  # run start
+    rid = cs_change[posc]
+    re = jnp.minimum(jnp.searchsorted(cs_change, rid + 1), cap) - 1
+
+    out_keys = [k[posc] for k in keys]
+    out_key_nulls = [kn[posc] for kn in key_nulls]
+
+    def seg_at_end(vals, combine):
+        return _seg_scan(change, vals, combine)[re]
+
+    states = []
+    for a in aggs:
+        d, ok = _agg_eval_rows(ctx, a, mask, cap)
+        is_f = d.dtype.kind == "f"
+        oki = ok.astype(jnp.int64)
+        ok_cs = jnp.cumsum(oki)
+        cnt = ok_cs[re] - (ok_cs - oki)[rs]
+        if a.name == "count":
+            states.append([cnt])
+        elif a.name in ("sum", "avg"):
+            z = jnp.zeros((), d.dtype)
+            v0 = jnp.where(ok, d, z)
+            if is_f:
+                s = seg_at_end(v0, jnp.add)
+                s = jnp.where(cnt > 0, s, z)
+            else:
+                scs = jnp.cumsum(v0)
+                s = scs[re] - (scs - v0)[rs]
+            states.append([s, cnt])
+        elif a.name in ("min", "max"):
+            sent, comb = _minmax_sentinel(a.name, d.dtype)
+            s = seg_at_end(jnp.where(ok, d, sent), comb)
+            s = jnp.where(cnt > 0, s, sent)
+            states.append([s, cnt])
+        elif a.name == "first_row":
+            ford = (ok_cs - oki)[rs] + 1
+            fpos = jnp.minimum(jnp.searchsorted(ok_cs, ford), cap - 1)
+            states.append([d[fpos], cnt])
+        else:
+            raise NotImplementedError(a.name)
+    return {"ngroups": ngroups, "keys": out_keys,
+            "key_nulls": out_key_nulls, "states": states}
+
+
+def runs_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
+    """sort_agg_body's TPU lowering without the sort: group keys are
+    evaluated, contiguous equal-key runs become partial groups
+    (_runs_agg_core). Same output contract as sort_agg_body, except
+    groups appear in first-occurrence order (downstream merge is
+    order-insensitive) and unclustered duplicate keys yield multiple
+    partials for the merge to combine."""
+    if not group_items:
+        r = _dense_agg_states_reduce(ctx, mask, aggs, cap)
+        return {"ngroups": jnp.asarray(1, dtype=jnp.int64), "keys": [],
+                "key_nulls": [], "states": r["states"]}
+    keys, key_nulls = [], []
+    for g in group_items:
+        d, nl, _sd = eval_expr(ctx, g)
+        if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+            d = jnp.full(cap, d)
+        d = d.astype(jnp.int64) if d.dtype != jnp.int64 else d
+        nm = materialize_nulls(ctx, nl)
+        keys.append(jnp.where(nm, 0, d))
+        key_nulls.append(nm)
+    return _runs_agg_core(keys, key_nulls, mask, ctx, aggs, cap,
+                          group_bucket)
 
 
 def _seg_scan(flags, vals, combine):
@@ -873,7 +1122,6 @@ def _segscan_states(aggs, make_row, fi_vals, seg_start, last, cap,
     sum_rows, sum_slots = [], []
     for a in aggs:
         base, d_s, ok_s = make_row(a)
-        is_f = d_s.dtype.kind == "f"
         cnt_row = ok_s.astype(jnp.int64)
         if a.name == "count":
             sum_slots.append((len(states), 0))
@@ -886,14 +1134,7 @@ def _segscan_states(aggs, make_row, fi_vals, seg_start, last, cap,
             sum_rows.append(cnt_row)
             states.append([None, None])
         elif a.name in ("min", "max"):
-            if a.name == "min":
-                sent = jnp.asarray(
-                    np.inf if is_f else _I64_MAX).astype(d_s.dtype)
-                comb = jnp.minimum
-            else:
-                sent = jnp.asarray(
-                    -np.inf if is_f else -_I64_MAX).astype(d_s.dtype)
-                comb = jnp.maximum
+            sent, comb = _minmax_sentinel(a.name, d_s.dtype)
             s = seg_reduce(jnp.where(ok_s, d_s, sent), comb, sent)
             sum_slots.append((len(states), 1))
             sum_rows.append(cnt_row)
@@ -930,15 +1171,7 @@ def _dense_agg_states_sorted(ctx, mask, aggs, slot, nslots, cap):
     present = ends - jnp.searchsorted(ss, sl_ids, side="left")
 
     def make_row(a):
-        if a.args:
-            d, nl, _ = eval_expr(ctx, a.args[0])
-            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                d = jnp.full(cap, d)
-            nm = materialize_nulls(ctx, nl)
-            row_ok = mask & ~nm
-        else:
-            d = jnp.ones(cap, dtype=jnp.int64)
-            row_ok = mask
+        d, row_ok = _agg_eval_rows(ctx, a, mask, cap)
         return d, d[order], row_ok[order]
 
     states = _segscan_states(aggs, make_row, order, seg_start, last,
@@ -1086,7 +1319,7 @@ def _agg_identity(name):
     return 0
 
 
-def _build_agg_kernel(dag, sample_cols, cap, group_bucket):
+def _build_agg_kernel(dag, sample_cols, cap, group_bucket, impl=None):
     """Compile the partial-agg kernel for this dag/bucket."""
     sdicts = {k: c[2] for k, c in sample_cols.items()}
     group_items = list(dag.group_items)
@@ -1099,11 +1332,13 @@ def _build_agg_kernel(dag, sample_cols, cap, group_bucket):
         mask = vv
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
-        return sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket)
+        return sort_agg_body(ctx, mask, group_items, aggs, cap,
+                             group_bucket, impl=impl)
     return kern
 
 
-def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
+def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket,
+                  impl=None):
     """Sort-based partial agg over an eval ctx + row mask (general group
     domains). Shared by the copr reader kernel and the fused pipeline.
 
@@ -1111,7 +1346,16 @@ def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
     runtime min/max spans (values are data-dependent — fine for XLA;
     only SHAPES must be static), so grouping costs a single argsort.
     A compiled lax.cond falls back to stable lexicographic multi-sort
-    when the combined span overflows 62 bits."""
+    when the combined span overflows 62 bits.
+
+    Under the "runs" policy (TPU default) the sort is skipped entirely:
+    contiguous equal-key runs become partial groups (runs_agg_body).
+    `impl` overrides the policy (the runs-degradation guard pins
+    unclustered query shapes to "sorted")."""
+    impl = impl or _segment_impl()
+    if impl == "runs":
+        return runs_agg_body(ctx, mask, group_items, aggs, cap,
+                             group_bucket)
     # ---- group keys ----
     keys = []
     key_nulls = []
@@ -1205,7 +1449,7 @@ def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
             out_key_nulls.append(kn[order][first_idx])
 
     # ---- agg states ----
-    if _use_sorted_segments():
+    if impl == "sorted":
         # seg is sorted by construction: segmented scans, no scatter
         # (the TPU variadic-scatter serialization — see
         # dense_agg_states)
@@ -1214,18 +1458,10 @@ def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket):
                                             side="right") - 1, 0)
 
         def make_row(a):
-            if a.args:
-                d, nl, _sd = eval_expr(ctx, a.args[0])
-                if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
-                    d = jnp.full(cap, d)
-                nm = materialize_nulls(ctx, nl)
-                dv = d[order] if keys else d
-                nv = nm[order] if keys else nm
-                row_ok = sorted_mask & ~nv
-            else:   # count(*)
-                dv = jnp.ones(cap, dtype=jnp.int64)
-                row_ok = sorted_mask
-            return dv, dv, row_ok
+            d, row_ok = _agg_eval_rows(ctx, a, mask, cap)
+            dv = d[order] if keys else d
+            ok = row_ok[order] if keys else row_ok
+            return dv, dv, ok
 
         states = _segscan_states(aggs, make_row, jnp.arange(cap),
                                  change, last, cap)

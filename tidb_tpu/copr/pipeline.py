@@ -25,7 +25,8 @@ from ..expression.vec import materialize_nulls
 from ..chunk.device import shape_bucket
 from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
                        dense_agg_body, dense_agg_states, sort_agg_body,
-                       _compact_dense, _I64_MAX)
+                       _compact_dense, _I64_MAX, _segment_impl,
+                       _dense_nslots, _BCR_MAX, _RUNS_DEGRADE_MIN)
 from ..utils.fetch import prefetch
 
 _POS_DENSE_MAX = 1 << 22
@@ -384,8 +385,9 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
         if agg_kind == "dense":
             return dense_agg_body(ctx, mask, group_items, aggs, agg_param,
                                   fact_cap)
-        return sort_agg_body(ctx, mask, group_items, aggs, fact_cap,
-                             agg_param)
+        gb, agg_impl = agg_param
+        return sort_agg_body(ctx, mask, group_items, aggs, fact_cap, gb,
+                             impl=agg_impl)
     return body
 
 
@@ -528,6 +530,17 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     kd, sd = capture_agg_dicts(shim, one)
     pos_spec = _pos_group_map(plan, dim_metas)
     sizes = None if pos_spec is not None else _dense_strides(shim, kd)
+    if _segment_impl() == "runs":
+        # big dense/position domains have no scatter-free dense
+        # lowering: fall to the "sort" agg kind, which lowers to
+        # runs_agg_body (contiguous-run partials) on TPU. Join
+        # positions inherit the fact table's clustering, so Q3-shaped
+        # group-by-FK stays compact.
+        if pos_spec is not None and pos_spec[2] > _BCR_MAX:
+            pos_spec = None
+            sizes = _dense_strides(shim, kd)
+        if sizes is not None and _dense_nslots(sizes) > _BCR_MAX:
+            sizes = None
 
     fact_sdicts = {k: v[2] for k, v in one.items()
                    if k in {sc.col.idx for sc in plan.fact_dag.cols}}
@@ -537,6 +550,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
              tuple(g.fingerprint() for g in plan.group_items),
              tuple(a.fingerprint() for a in plan.aggs))
     group_bucket = max(1024, copr._host_cache.get(gbkey, 0))
+    implk = ("aggimpl",) + gbkey
     if mesh is not None:
         return _run_fused_mpp(
             copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
@@ -557,7 +571,8 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             elif sizes is not None:
                 agg_kind, agg_param = "dense", tuple(sizes)
             else:
-                agg_kind, agg_param = "sort", group_bucket
+                agg_impl = copr._host_cache.get(implk) or _segment_impl()
+                agg_kind, agg_param = "sort", (group_bucket, agg_impl)
             key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
                                    tuple(dim_caps), tuple(dim_ns),
                                    tuple(dim_sns), agg_kind, agg_param)
@@ -579,6 +594,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 out.append(_compact_dense(shim, res, sizes, kd, sd))
                 break
             ngroups = int(res["ngroups"])
+            if agg_param[1] == "runs" and \
+                    ngroups > max(_RUNS_DEGRADE_MIN, m // 4):
+                # unclustered group keys: pin this query shape to the
+                # sorted lowering before learning an inflated bucket
+                copr._host_cache[implk] = "sorted"
+                continue
             if ngroups > group_bucket:
                 group_bucket = shape_bucket(ngroups)
                 copr._host_cache[gbkey] = group_bucket
@@ -755,7 +776,9 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         elif sizes is not None:
             agg_kind, agg_param = "dense", tuple(sizes)
         else:
-            agg_kind, agg_param = "sort", group_bucket
+            agg_impl = copr._host_cache.get(("aggimpl",) + gbkey) or \
+                _segment_impl()
+            agg_kind, agg_param = "sort", (group_bucket, agg_impl)
         key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, local,
                                tuple(dim_caps), tuple(dim_ns),
                                tuple(dim_sns), agg_kind, agg_param) + \
@@ -774,8 +797,15 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         if sizes is not None:
             return [_compact_dense(shim, res, sizes, kd, sd)]
         ngroups_arr = np.asarray(res["ngroups"])     # [ndev]
-        if int(ngroups_arr.max()) > group_bucket:
-            group_bucket = shape_bucket(int(ngroups_arr.max()))
+        ng_max = int(ngroups_arr.max())
+        if agg_param[1] == "runs" and \
+                ng_max > max(_RUNS_DEGRADE_MIN, local // 4):
+            # unclustered group keys on this shard layout: pin to the
+            # sorted lowering before learning an inflated bucket
+            copr._host_cache[("aggimpl",) + gbkey] = "sorted"
+            continue
+        if ng_max > group_bucket:
+            group_bucket = shape_bucket(ng_max)
             copr._host_cache[gbkey] = group_bucket
             continue
         # unstack the per-shard partials
@@ -817,8 +847,7 @@ def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
     afps = tuple(a.fingerprint() for a in plan.aggs)
     colsig = tuple(sorted((sc.col.idx, sc.name)
                           for sc in plan.fact_dag.cols))
-    from .dag_exec import _use_sorted_segments
     return ("fused", fact_tbl.uid, cap, dim_caps, dim_ns, dim_sns, fps,
             dimsig, postfps, gfps, afps, tuple(dict_vers), colsig,
-            agg_kind, agg_param, _use_sorted_segments(),
+            agg_kind, agg_param, _segment_impl(),
             tuple(bool(m.get("pre")) for m in dim_metas))

@@ -1065,11 +1065,17 @@ class HashAggExec(Executor):
             return Column(ft, s, (cnt == 0) if (cnt == 0).any() else None,
                           sdict)
         if name == "first_row":
+            # only partials that SAW a value (cnt>0) may contribute: a
+            # cnt=0 partial's value slot is garbage (runs lowering: a
+            # gather past the run's end; scatter: row cap-1) — taking
+            # min index over all partials returned another group's value
             firsts = np.full(g, _I64_MAX, dtype=np.int64)
-            np.minimum.at(firsts, inverse, np.arange(len(inverse)))
-            data = states[0][firsts]
+            idx = np.arange(len(inverse))
+            has = states[1] > 0
+            np.minimum.at(firsts, inverse[has], idx[has])
             cnt = np.zeros(g, dtype=np.int64)
             np.add.at(cnt, inverse, states[1])
+            data = states[0][np.minimum(firsts, len(states[0]) - 1)]
             return Column(ft, data, (cnt == 0) if (cnt == 0).any() else None,
                           sdict)
         raise UnsupportedError("agg %s merge unsupported", name)
