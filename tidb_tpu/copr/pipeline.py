@@ -23,10 +23,11 @@ import jax.numpy as jnp
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
 from ..chunk.device import shape_bucket
+from . import dag_exec as _de
 from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
                        dense_agg_body, dense_agg_states, sort_agg_body,
                        _compact_dense, _I64_MAX, _segment_impl,
-                       _dense_nslots, _BCR_MAX, _RUNS_DEGRADE_MIN)
+                       _dense_nslots)
 from ..utils.fetch import prefetch
 
 _POS_DENSE_MAX = 1 << 22
@@ -233,6 +234,121 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
     return args, layout
 
 
+def _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd):
+    """Validate the planner's topn_spec against runtime state ->
+    spec tuple or None. Device-side top-k over per-run partials is
+    exact only when every group lives in at most one partial per
+    partition, which requires:
+    - an ANCHOR group item: a fact column (or dim probe key) whose
+      storage order is verified monotone (ColumnarTable.is_clustered) —
+      equal keys adjacent, at most ONE group split per partition edge;
+    - every other group item a function of columns reachable from the
+      anchor through inner/left unique-key dims (constant within a run);
+    - an integer, non-dict primary metric (exact comparisons between
+      the kernel's top-k and the host safety check — float metrics
+      would risk ulp-level disagreement at the cut boundary)."""
+    spec = getattr(plan, "topn_spec", None)
+    if spec is None or copr._host_cache.get(("ftopn_off",) + gbkey):
+        return None
+    kind, ai, desc, k_total = spec
+    from ..expression import Column
+    from ..types.field_type import TypeClass
+    if kind == "agg":
+        if ai >= len(plan.aggs):
+            return None
+        a = plan.aggs[ai]
+        if a.name not in ("sum", "count", "min", "max"):
+            return None
+        if a.args:
+            if a.args[0].ft.tclass == TypeClass.FLOAT or sd[ai] is not None:
+                return None
+    else:
+        if ai >= len(plan.group_items):
+            return None
+        if kd[ai] is not None or \
+                plan.group_items[ai].ft.tclass == TypeClass.FLOAT:
+            return None
+    cid_by_idx = {}
+    for sc in plan.fact_dag.cols:
+        cid = _cid_of(plan.fact_dag, sc)
+        if cid != -1:
+            cid_by_idx[sc.col.idx] = cid
+    anchor = None
+    for g in plan.group_items:
+        if isinstance(g, Column) and g.idx in cid_by_idx and \
+                fact_tbl.is_clustered(cid_by_idx[g.idx]):
+            anchor = g.idx
+            break
+    if anchor is None:
+        return None
+    closure = {anchor}
+    for _ in range(len(plan.dims) + 1):
+        grew = False
+        for dim in plan.dims:
+            if dim.join_type == "semi":
+                continue
+            pidx = _expr_idxs(dim.probe_expr)
+            if pidx and pidx <= closure:
+                for sc in dim.dag.cols:
+                    if sc.col.idx not in closure:
+                        closure.add(sc.col.idx)
+                        grew = True
+        if not grew:
+            break
+    for g in plan.group_items:
+        gi = _expr_idxs(g)
+        if not gi or not (gi <= closure):
+            return None
+    return spec
+
+
+def _topn_metric_host(spec, aggs, keys, key_nulls, states):
+    """Numpy mirror of the kernel's transformed metric (larger = better)
+    for the tie-boundary safety check; must stay formula-identical to
+    _topn_select."""
+    kind, ai, desc, _k = spec
+    if kind == "group":
+        v = np.asarray(keys[ai]).astype(np.int64)
+        nul = np.asarray(key_nulls[ai])
+    else:
+        st = states[ai]
+        v = np.asarray(st[0]).astype(np.int64)
+        nul = (np.asarray(st[-1]) == 0) if aggs[ai].name != "count" \
+            else np.zeros(len(v), dtype=bool)
+    m = v if desc else ~v      # ~v = -v-1: wrap-free order reversal
+    # MySQL null ordering: first on ASC (best), last on DESC (worst)
+    return np.where(nul, (-_I64_MAX) if desc else (_I64_MAX - 1), m)
+
+
+def _topn_select(res, aggs, topn, bucket):
+    """In-kernel candidate selection over the partial-group arrays:
+    transformed int64 metric (larger = better), empty slots forced last,
+    the partition-boundary groups (run 0 and run ngroups-1, whose
+    totals may continue in the neighbouring partition) forced FIRST so
+    the host merge always sees both halves. Returns the res contract
+    with arrays trimmed to kprime rows plus the selected run ids."""
+    kind, ai, desc, kprime = topn
+    ng = res["ngroups"]
+    if kind == "group":
+        v = res["keys"][ai].astype(jnp.int64)
+        nul = res["key_nulls"][ai]
+    else:
+        st = res["states"][ai]
+        v = st[0].astype(jnp.int64)
+        nul = (st[-1] == 0) if aggs[ai].name != "count" \
+            else jnp.zeros(v.shape, dtype=bool)
+    m = v if desc else ~v      # ~v = -v-1: wrap-free order reversal
+    m = jnp.where(nul, (-_I64_MAX) if desc else (_I64_MAX - 1), m)
+    iota = jnp.arange(bucket)
+    m = jnp.where(iota < ng, m, -_I64_MAX - 1)
+    m = jnp.where((iota == 0) | (iota == ng - 1), _I64_MAX, m)
+    _, sel = jax.lax.top_k(m, kprime)
+    return {"ngroups": ng, "sel": sel,
+            "keys": [k[sel] for k in res["keys"]],
+            "key_nulls": [kn[sel] for kn in res["key_nulls"]],
+            "states": [[s[sel] for s in st] for st in res["states"]]}
+
+
 def _pos_group_map(plan, dim_metas):
     """Group-by-FK detection: when every group item is either a column of
     an (inner, unique) dimension or the probe key of one, the join
@@ -385,9 +501,12 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
         if agg_kind == "dense":
             return dense_agg_body(ctx, mask, group_items, aggs, agg_param,
                                   fact_cap)
-        gb, agg_impl = agg_param
-        return sort_agg_body(ctx, mask, group_items, aggs, fact_cap, gb,
-                             impl=agg_impl)
+        gb, agg_impl, topn = agg_param
+        res = sort_agg_body(ctx, mask, group_items, aggs, fact_cap, gb,
+                            impl=agg_impl)
+        if topn is not None:
+            res = _topn_select(res, aggs, topn, gb)
+        return res
     return body
 
 
@@ -536,10 +655,10 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         # runs_agg_body (contiguous-run partials) on TPU. Join
         # positions inherit the fact table's clustering, so Q3-shaped
         # group-by-FK stays compact.
-        if pos_spec is not None and pos_spec[2] > _BCR_MAX:
+        if pos_spec is not None and pos_spec[2] > _de._BCR_MAX:
             pos_spec = None
             sizes = _dense_strides(shim, kd)
-        if sizes is not None and _dense_nslots(sizes) > _BCR_MAX:
+        if sizes is not None and _dense_nslots(sizes) > _de._BCR_MAX:
             sizes = None
 
     fact_sdicts = {k: v[2] for k, v in one.items()
@@ -551,6 +670,10 @@ def fused_partials(copr, plan, read_ts, mesh=None,
              tuple(a.fingerprint() for a in plan.aggs))
     group_bucket = max(1024, copr._host_cache.get(gbkey, 0))
     implk = ("aggimpl",) + gbkey
+    offk = ("ftopn_off",) + gbkey
+    ts = None
+    if mesh is None:
+        ts = _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd)
     if mesh is not None:
         return _run_fused_mpp(
             copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
@@ -572,7 +695,19 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 agg_kind, agg_param = "dense", tuple(sizes)
             else:
                 agg_impl = copr._host_cache.get(implk) or _segment_impl()
-                agg_kind, agg_param = "sort", (group_bucket, agg_impl)
+                topn_k = None
+                # candidate pruning is sound ONLY under the runs
+                # lowering: its run order is storage order, so the
+                # partition-edge (possibly split) groups are exactly
+                # runs 0 and ngroups-1, which _topn_select forces into
+                # the candidate set. sorted/scatter order groups by
+                # key rank, where the edge groups can sit anywhere.
+                if ts is not None and agg_impl == "runs" and \
+                        not copr._host_cache.get(offk):
+                    topn_k = (ts[0], ts[1], ts[2],
+                              min(ts[3] + 66, group_bucket))
+                agg_kind, agg_param = "sort", (group_bucket, agg_impl,
+                                               topn_k)
             key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
                                    tuple(dim_caps), tuple(dim_ns),
                                    tuple(dim_sns), agg_kind, agg_param)
@@ -595,7 +730,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 break
             ngroups = int(res["ngroups"])
             if agg_param[1] == "runs" and \
-                    ngroups > max(_RUNS_DEGRADE_MIN, m // 4):
+                    ngroups > max(_de._RUNS_DEGRADE_MIN, m // 4):
                 # unclustered group keys: pin this query shape to the
                 # sorted lowering before learning an inflated bucket
                 copr._host_cache[implk] = "sorted"
@@ -604,6 +739,38 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 group_bucket = shape_bucket(ngroups)
                 copr._host_cache[gbkey] = group_bucket
                 continue
+            topn_k = agg_param[2]
+            if topn_k is not None:
+                # candidate partials only: verify the candidate set
+                # provably covers the true top k before trusting it
+                kprime = topn_k[3]
+                ncand = min(ngroups, kprime)
+                ckeys = [np.asarray(k)[:ncand] for k in res["keys"]]
+                cnulls = [np.asarray(kn)[:ncand]
+                          for kn in res["key_nulls"]]
+                cstates = [[np.asarray(s)[:ncand] for s in st]
+                           for st in res["states"]]
+                if ngroups > kprime:
+                    sel = np.asarray(res["sel"])[:ncand]
+                    real_m = _topn_metric_host(ts, plan.aggs, ckeys,
+                                               cnulls, cstates)
+                    nf = ~((sel == 0) | (sel == ngroups - 1))
+                    # the coverage proof may count only COMPLETE groups
+                    # (non-forced candidates): a forced partition-edge
+                    # partial's metric is not its merged total, so it
+                    # cannot vouch for excluding other groups
+                    mnf = real_m[nf]
+                    safe = len(mnf) > 0 and \
+                        int((mnf > mnf.min()).sum()) >= ts[3]
+                    if not safe:
+                        # boundary ties could hide true top-k members:
+                        # permanently disable topn for this query shape
+                        copr._host_cache[offk] = True
+                        continue
+                out.append(PartialAggResult(
+                    ngroups=ncand, keys=ckeys, key_nulls=cnulls,
+                    states=cstates, key_dicts=kd, state_dicts=sd))
+                break
             out.append(PartialAggResult(
                 ngroups=ngroups,
                 keys=[np.asarray(k)[:ngroups] for k in res["keys"]],
@@ -778,7 +945,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         else:
             agg_impl = copr._host_cache.get(("aggimpl",) + gbkey) or \
                 _segment_impl()
-            agg_kind, agg_param = "sort", (group_bucket, agg_impl)
+            agg_kind, agg_param = "sort", (group_bucket, agg_impl, None)
         key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, local,
                                tuple(dim_caps), tuple(dim_ns),
                                tuple(dim_sns), agg_kind, agg_param) + \
@@ -799,7 +966,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         ngroups_arr = np.asarray(res["ngroups"])     # [ndev]
         ng_max = int(ngroups_arr.max())
         if agg_param[1] == "runs" and \
-                ng_max > max(_RUNS_DEGRADE_MIN, local // 4):
+                ng_max > max(_de._RUNS_DEGRADE_MIN, local // 4):
             # unclustered group keys on this shard layout: pin to the
             # sorted lowering before learning an inflated bucket
             copr._host_cache[("aggimpl",) + gbkey] = "sorted"

@@ -97,6 +97,8 @@ def optimize(stmt, pctx: PlanContext):
         if mpp_on:
             from ..mpp.fragment import fragment_plan
             phys = fragment_plan(phys)
+        from .physical import attach_fused_topn
+        phys = attach_fused_topn(phys)
         phys.read_tables = frozenset(pctx.read_tables)
         phys.for_update = stmt.for_update
         if pctx.stale_read_ts:

@@ -52,6 +52,9 @@ class ColumnarTable:
         self.delete_ts = np.empty(0, dtype=np.int64)
         self.handle_pos: dict[int, int] = {}
         self.bulk_rows = 0           # rows without row-KV/index entries
+        # cid -> [rows_checked, still_clustered]: lazy monotone-order
+        # tracker behind is_clustered()
+        self._clustered: dict[int, list] = {}
         self._init_columns()
 
     def _init_columns(self):
@@ -221,6 +224,33 @@ class ColumnarTable:
         self.bulk_rows += n
         self.version += 1
 
+    def is_clustered(self, cid: int) -> bool:
+        """True when the column is non-NULL and monotone non-decreasing
+        in STORAGE ORDER across every version row — equal values are
+        then contiguous, so contiguous-run aggregation partials
+        (copr/dag_exec runs lowering) are exact per-group within a
+        partition. TPC-H lineitem.l_orderkey and orders.o_orderkey hold
+        this by construction of the load order.
+
+        Verified, not assumed: checked over the data array itself,
+        incrementally (only rows appended since the last call), and
+        permanently demoted on the first violation (updates append new
+        versions at the tail, which breaks monotonicity naturally).
+        gc() rebuilds arrays and resets the tracker."""
+        arr = self.data.get(cid)
+        n = self.n
+        if arr is None or arr.dtype == object or n == 0:
+            return False
+        st = self._clustered.setdefault(cid, [0, True])
+        upto, ok = st
+        if ok and n > upto:
+            lo = max(upto - 1, 0)
+            seg = arr[lo:n]
+            ok = bool(np.all(seg[1:] >= seg[:-1])) and \
+                not bool(self.nulls[cid][upto:n].any())
+            st[0], st[1] = n, ok
+        return st[1]
+
     def gc(self, safepoint: int) -> int:
         """Compact away versions deleted before `safepoint` (reference: TiKV
         GC under gc_life_time). Rebuilds arrays densely; dictionaries keep
@@ -240,6 +270,7 @@ class ColumnarTable:
         self.insert_ts[:m] = self.insert_ts[idx]
         self.delete_ts[:m] = self.delete_ts[idx]
         self.n = m
+        self._clustered.clear()    # rows moved: re-verify from scratch
         self.handle_pos = {}
         live = self.delete_ts[:m] == 0
         for i in np.nonzero(live)[0].tolist():
