@@ -2,13 +2,20 @@
 """Benchmark driver: TPC-H on the TPU-native engine vs the CPU-only path.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "backend": "tpu"|"cpu-fallback", "queries": {per-query ms + backend}}
 
 value       = rows/sec scanned through the full SQL stack on the device path
 vs_baseline = CPU-only-path wall time / TPU-path wall time (geomean across
               queries) — the engine's own `tidb_enable_tpu_exec`-off mode is
               the baseline, mirroring BASELINE.md's "vs CPU-only tidb-server"
               target on the same host.
+
+Resilience (round-2 verdict): the axon tunnel can wedge or refuse the
+device grant. The probe retries with a budget spread across the run, the
+XLA compile cache persists across invocations (a recovered tunnel never
+re-pays compiles), and results degrade per-query (each row tagged with
+the backend that produced it) instead of all-or-nothing.
 """
 import json
 import math
@@ -17,7 +24,16 @@ import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+# persistent XLA compile cache: survives driver invocations so a flaky
+# tunnel only ever pays each kernel compile once
+_CACHE_DIR = os.environ.get(
+    "BENCH_JAX_CACHE", os.path.join(_REPO, ".cache", "jax"))
+os.makedirs(_CACHE_DIR, exist_ok=True)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 
 _PROBE_SRC = """
@@ -29,41 +45,42 @@ print(ds[0].platform)
 """
 
 
-def _ensure_live_backend(attempts=None, probe_timeout=None):
+def _probe_once(timeout_s):
+    """One child-process probe: device init + compile + matmul."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout_s, check=True, capture_output=True,
+            env=dict(os.environ))
+        platform = r.stdout.decode().strip().splitlines()[-1].strip()
+        return platform if platform and platform != "cpu" else None
+    except Exception:                               # noqa: BLE001
+        return None
+
+
+def _ensure_live_backend():
     """The axon TPU tunnel can wedge (device grant held by a dead
     session); backend init then blocks indefinitely. Probe device init
     AND a real compile+matmul in a child process, retrying on timeout (a
     slow first init is indistinguishable from a wedge on one attempt).
     On persistent failure, pin this process to CPU and mark the run
     LOUDLY — a CPU number must never masquerade as a TPU number."""
-    attempts = attempts or int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-    probe_timeout = probe_timeout or int(
-        os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
             os.environ.get("TIDB_TPU_PLATFORM", "").lower() == "cpu":
         from tidb_tpu import force_cpu_backend
         force_cpu_backend()
         return False
     for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                timeout=probe_timeout, check=True, capture_output=True)
-            platform = r.stdout.decode().strip()
-            if platform and platform != "cpu":
-                print(f"# TPU backend live ({platform})", file=sys.stderr)
-                return True
-            print(f"# probe returned platform={platform!r}; not a TPU",
-                  file=sys.stderr)
-            break
-        except subprocess.TimeoutExpired:
-            print(f"# TPU probe attempt {i + 1}/{attempts} timed out "
-                  f"after {probe_timeout}s (wedged tunnel or slow init); "
-                  f"{'retrying' if i + 1 < attempts else 'giving up'}",
-                  file=sys.stderr)
-        except Exception as e:                      # noqa: BLE001
-            print(f"# TPU probe failed: {e}", file=sys.stderr)
-            break
+        platform = _probe_once(probe_timeout)
+        if platform:
+            print(f"# TPU backend live ({platform})", file=sys.stderr)
+            return True
+        print(f"# TPU probe attempt {i + 1}/{attempts} failed "
+              f"(wedged tunnel, refused grant, or slow init); "
+              f"{'retrying' if i + 1 < attempts else 'giving up'}",
+              file=sys.stderr)
     from tidb_tpu import force_cpu_backend
     force_cpu_backend()
     print("# !! TPU BACKEND UNAVAILABLE — all numbers below are "
@@ -143,12 +160,17 @@ def main():
     live = _ensure_live_backend()
     if os.environ.get("BENCH_MODE") == "htap":
         return htap_main(live)
-    sf = float(os.environ.get("BENCH_SF", "0.1"))
-    queries = os.environ.get("BENCH_QUERIES", "q6,q1,q3,q5").split(",")
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    qenv = os.environ.get("BENCH_QUERIES", "all")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
 
     from tidb_tpu.testkit import TestKit
-    from tidb_tpu.bench.tpch import load_tpch, QUERIES
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+
+    if qenv == "all":
+        queries = sorted(ALL_QUERIES, key=lambda q: int(q[1:]))
+    else:
+        queries = qenv.split(",")
 
     tk = TestKit()
     t0 = time.time()
@@ -156,30 +178,62 @@ def main():
     load_s = time.time() - t0
     li = tk.domain.infoschema().table_by_name("test", "lineitem")
     n_rows = tk.domain.columnar.tables[li.id].live_count()
+    print(f"# lineitem rows={n_rows} load={load_s:.1f}s", file=sys.stderr)
 
     def run(q, use_device):
         tk.domain.copr.use_device = use_device
-        tk.must_query(QUERIES[q])           # warmup (compile)
+        tk.must_query(ALL_QUERIES[q])       # warmup (compile)
         best = math.inf
         for _ in range(repeats):
             t = time.time()
-            tk.must_query(QUERIES[q])
+            tk.must_query(ALL_QUERIES[q])
             best = min(best, time.time() - t)
         return best
 
     speedups = []
+    per_query = {}
     tpu_times = {}
     for q in queries:
-        t_tpu = run(q, True)
-        t_cpu = run(q, False)
+        try:
+            t_tpu = run(q, True)
+        except Exception as e:                      # noqa: BLE001
+            print(f"# {q}: DEVICE PATH ERROR {e}", file=sys.stderr)
+            per_query[q] = {"error": str(e)[:120]}
+            continue
+        try:
+            t_cpu = run(q, False)
+        except Exception as e:                      # noqa: BLE001
+            print(f"# {q}: CPU BASELINE ERROR {e}", file=sys.stderr)
+            per_query[q] = {"ms": round(t_tpu * 1000, 1),
+                            "cpu_error": str(e)[:120],
+                            "backend": "tpu" if live else "cpu"}
+            tpu_times[q] = t_tpu
+            continue
+        finally:
+            tk.domain.copr.use_device = True
         tpu_times[q] = t_tpu
         speedups.append(t_cpu / t_tpu)
+        per_query[q] = {
+            "ms": round(t_tpu * 1000, 1),
+            "cpu_ms": round(t_cpu * 1000, 1),
+            "speedup": round(t_cpu / t_tpu, 2),
+            "backend": "tpu" if live else "cpu",
+        }
         print(f"# {q}: tpu={t_tpu*1000:.1f}ms cpu={t_cpu*1000:.1f}ms "
               f"speedup={t_cpu/t_tpu:.2f}x", file=sys.stderr)
+    if not speedups:
+        print(json.dumps({"metric": f"tpch_sf{sf}", "value": 0,
+                          "unit": "no query completed", "vs_baseline": 0,
+                          "backend": "error", "queries": per_query}))
+        return
     geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
-    q6_rows_per_s = n_rows / tpu_times.get("q6", list(tpu_times.values())[0])
-    print(f"# lineitem rows={n_rows} load={load_s:.1f}s", file=sys.stderr)
-    unit = "rows/s/chip (Q6 full-stack)"
+    if "q6" in tpu_times:
+        hq, ht = "q6", tpu_times["q6"]
+    else:                    # no q6: slowest survivor (never inflates)
+        hq = max(tpu_times, key=tpu_times.get)
+        ht = tpu_times[hq]
+    q6_rows_per_s = n_rows / ht
+    unit = f"rows/s/chip ({hq} full-stack, {len(speedups)}q geomean)"
     if not live:
         unit += " [CPU FALLBACK — not a TPU measurement]"
     print(json.dumps({
@@ -188,6 +242,7 @@ def main():
         "unit": unit,
         "vs_baseline": round(geo, 3),
         "backend": "tpu" if live else "cpu-fallback",
+        "queries": per_query,
     }))
 
 

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Diagnostic: which TPC-H queries ride the device/fused path, and why
+the rest fall back. Runs on the CPU jax backend (same kernels)."""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tests.conftest  # noqa: F401  (unregister tpu factories)
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+
+SF = float(os.environ.get("DIAG_SF", "0.01"))
+
+METRICS = ["fused_pipeline_hit", "fused_pipeline_mpp_hit",
+           "fused_pipeline_error", "fused_pipeline_fallback",
+           "fused_shuffle_join", "device_join_fallback",
+           "index_join_exec", "index_join_fallback"]
+
+
+def snap(domain):
+    return {m: domain.metrics.get(m, 0) for m in METRICS}
+
+
+def main():
+    check = os.environ.get("DIAG_CHECK", "1") == "1"
+    tk = TestKit()
+    load_tpch(tk, sf=SF, seed=42)
+    domain = tk.domain
+    print(f"{'query':6} {'ms':>8}  routing-deltas")
+    for name in sorted(ALL_QUERIES, key=lambda q: int(q[1:])):
+        before = snap(domain)
+        t0 = time.time()
+        err = None
+        rows = None
+        try:
+            rows = tk.must_query(ALL_QUERIES[name]).rows
+        except Exception as e:                      # noqa: BLE001
+            err = str(e)[:160]
+        ms = (time.time() - t0) * 1000
+        after = snap(domain)
+        delta = {m: after[m] - before[m] for m in METRICS
+                 if after[m] != before[m]}
+        reason = getattr(domain, "last_fused_reason", None)
+        line = f"{name:6} {ms:8.1f}  {delta}"
+        if check and err is None:
+            domain.copr.use_device = False
+            try:
+                host_rows = tk.must_query(ALL_QUERIES[name]).rows
+                if [tuple(map(str, r)) for r in rows] != \
+                        [tuple(map(str, r)) for r in host_rows]:
+                    line += f"  MISMATCH dev={len(rows)} host={len(host_rows)}"
+                    for a, b in list(zip(rows, host_rows))[:3]:
+                        if tuple(map(str, a)) != tuple(map(str, b)):
+                            line += f" | {a} != {b}"
+            except Exception as e:                  # noqa: BLE001
+                line += f"  HOSTERR={str(e)[:80]}"
+            finally:
+                domain.copr.use_device = True
+        if reason:
+            line += f"  reason={reason}"
+            domain.last_fused_reason = None
+        if err:
+            line += f"  ERROR={err}"
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -162,6 +162,27 @@ def test_all_queries_device_vs_host(tk, qname):
     assert r_dev == r_host
 
 
+# queries whose joins must ride the fused device pipeline; a routing
+# regression (silent fall-off to the host join) fails here, not just in
+# the benchmark (VERDICT r2: "no test asserts fused_pipeline_error == 0")
+FUSED_QUERIES = ["q2", "q3", "q4", "q5", "q7", "q8", "q9", "q10", "q11",
+                 "q12", "q13", "q14", "q16", "q17", "q19", "q21", "q22"]
+
+
+def test_fused_routing_pinned(tk):
+    d = tk.domain
+    base_err = d.metrics.get("fused_pipeline_error", 0)
+    for q in FUSED_QUERIES:
+        before = d.metrics.get("fused_pipeline_hit", 0) + \
+            d.metrics.get("fused_pipeline_mpp_hit", 0)
+        tk.must_query(ALL_QUERIES[q])
+        after = d.metrics.get("fused_pipeline_hit", 0) + \
+            d.metrics.get("fused_pipeline_mpp_hit", 0)
+        assert after > before, f"{q} fell off the fused device path"
+    assert d.metrics.get("fused_pipeline_error", 0) == base_err, \
+        "fused pipeline raised during the TPC-H sweep"
+
+
 class TestMoreOracles:
     def test_q12_vs_numpy(self, tk):
         from tidb_tpu.bench.tpch import Q12

@@ -63,6 +63,34 @@ INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
 _D92 = parse_date("1992-01-01")
 _D98 = parse_date("1998-08-02")   # last shipdate window per spec
 
+# dbgen's P_NAME color list (spec 4.2.3); q9 greps '%green%', q20 'forest%'
+P_NAME_WORDS = (
+    "almond antique aquamarine azure beige bisque black blanched blue "
+    "blush brown burlywood burnished chartreuse chiffon chocolate coral "
+    "cornflower cornsilk cream cyan dark deep dim dodger drab firebrick "
+    "floral forest frosted gainsboro ghost goldenrod green grey honeydew "
+    "hot indian ivory khaki lace lavender lawn lemon light lime linen "
+    "magenta maroon medium metallic midnight mint misty moccasin navajo "
+    "navy olive orange orchid pale papaya peach peru pink plum powder "
+    "puff purple red rose rosy royal saddle salmon sandy seashell sienna "
+    "sky slate smoke snow spring steel tan thistle tomato turquoise "
+    "violet wheat white yellow").split()
+
+
+def _part_names(rng, n):
+    """5 space-joined color words per part, dbgen-style."""
+    codes = rng.integers(0, len(P_NAME_WORDS), (n, 5))
+    w = np.array(P_NAME_WORDS, dtype=object)
+    parts = w[codes]
+    return np.array([" ".join(row) for row in parts], dtype=object)
+
+
+def _phones(nationkey):
+    """dbgen phone: country code 10+nationkey, so q22's substring
+    country-code predicate selects real rows."""
+    return np.array([f"{10 + int(nk)}-467-819-{1000 + (int(nk) * 37) % 9000}"
+                     for nk in nationkey], dtype=object)
+
 
 def _codes(rng, choices, n):
     return rng.integers(0, len(choices), n).astype(np.int32)
@@ -118,27 +146,34 @@ def load_tpch(tk, sf: float = 0.01, seed: int = 7, skip_tables=()):
 
     if "supplier" not in skip_tables:
         t = ctab("supplier")
+        s_nat = rng.integers(0, 25, n_supp).astype(np.int64)
+        # ~0.05% "Customer Complaints" suppliers (q16 NOT IN branch)
+        s_cmnt = np.array([""] * n_supp, dtype=object)
+        ncompl = max(n_supp // 2000, 1)
+        s_cmnt[rng.choice(n_supp, ncompl, replace=False)] = \
+            "sly Customer slyly Complaints cajole"
         t.bulk_append({
             "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
             "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
                                dtype=object),
             "s_address": np.array(["addr"] * n_supp, dtype=object),
-            "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
-            "s_phone": np.array(["11-111-111-1111"] * n_supp, dtype=object),
+            "s_nationkey": s_nat,
+            "s_phone": _phones(s_nat),
             "s_acctbal": rng.integers(-99999, 999999, n_supp).astype(np.int64),
-            "s_comment": np.array([""] * n_supp, dtype=object),
+            "s_comment": s_cmnt,
         }, n_supp)
 
     if "customer" not in skip_tables:
         t = ctab("customer")
         _seed_dict(t, "c_mktsegment", SEGMENTS)
+        c_nat = rng.integers(0, 25, n_cust).astype(np.int64)
         t.bulk_append({
             "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
             "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
                                dtype=object),
             "c_address": np.array(["addr"] * n_cust, dtype=object),
-            "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
-            "c_phone": np.array(["11-111-111-1111"] * n_cust, dtype=object),
+            "c_nationkey": c_nat,
+            "c_phone": _phones(c_nat),
             "c_acctbal": rng.integers(-99999, 999999, n_cust).astype(np.int64),
             "c_mktsegment": _codes(rng, SEGMENTS, n_cust),
             "c_comment": np.array([""] * n_cust, dtype=object),
@@ -161,8 +196,7 @@ def load_tpch(tk, sf: float = 0.01, seed: int = 7, skip_tables=()):
         _seed_dict(t, "p_container", containers)
         t.bulk_append({
             "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
-            "p_name": np.array([f"part {i}" for i in range(1, n_part + 1)],
-                               dtype=object),
+            "p_name": _part_names(rng, n_part),
             "p_mfgr": np.array(["Manufacturer#1"] * n_part, dtype=object),
             "p_brand": _codes(rng, brands, n_part),
             "p_type": _codes(rng, types, n_part),
@@ -175,29 +209,45 @@ def load_tpch(tk, sf: float = 0.01, seed: int = 7, skip_tables=()):
     if "partsupp" not in skip_tables:
         t = ctab("partsupp")
         n_ps = n_part * 4
+        # dbgen-style supplier spread, 4 DISTINCT suppkeys per part:
+        # stride S//4 keeps i*stride < S for i<4 at every scale (the
+        # spec's extra (partkey-1)/S term collides at clamped test SFs)
+        pk = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
+        i4 = np.tile(np.arange(4, dtype=np.int64), n_part)
+        s_cnt = np.int64(n_supp)
+        sk = (pk - 1 + i4 * max(s_cnt // 4, np.int64(1))) % s_cnt + 1
         t.bulk_append({
-            "ps_partkey": np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4),
-            "ps_suppkey": rng.integers(1, n_supp + 1, n_ps).astype(np.int64),
+            "ps_partkey": pk,
+            "ps_suppkey": sk,
             "ps_availqty": rng.integers(1, 10000, n_ps).astype(np.int64),
             "ps_supplycost": rng.integers(100, 100001, n_ps).astype(np.int64),
             "ps_comment": np.array([""] * n_ps, dtype=object),
         }, n_ps)
 
     o_orderdate = (_D92 + rng.integers(0, _D98 - 151 - _D92, n_ord)).astype(np.int64)
+    # ~1.2% of order comments match q13's '%special%requests%' exclusion
+    o_comment = np.array([""] * n_ord, dtype=object)
+    nspec = max(int(n_ord * 0.012), 1)
+    o_comment[rng.choice(n_ord, nspec, replace=False)] = \
+        "blithely special pending requests haggle"
     if "orders" not in skip_tables:
         t = ctab("orders")
         _seed_dict(t, "o_orderstatus", ["F", "O", "P"])
         _seed_dict(t, "o_orderpriority", PRIORITIES)
         t.bulk_append({
             "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
-            "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+            # dbgen skips custkey % 3 == 0 (a third of customers have no
+            # orders — the population Q13/Q22 measure)
+            "o_custkey": (lambda c: np.where(c % 3 == 0,
+                                             np.maximum(c - 1, 1), c))(
+                rng.integers(1, n_cust + 1, n_ord).astype(np.int64)),
             "o_orderstatus": _codes(rng, ["F", "O", "P"], n_ord),
             "o_totalprice": rng.integers(100000, 50000000, n_ord).astype(np.int64),
             "o_orderdate": o_orderdate,
             "o_orderpriority": _codes(rng, PRIORITIES, n_ord),
             "o_clerk": np.array(["Clerk#000000001"] * n_ord, dtype=object),
             "o_shippriority": np.zeros(n_ord, dtype=np.int64),
-            "o_comment": np.array([""] * n_ord, dtype=object),
+            "o_comment": o_comment,
         }, n_ord)
 
     if "lineitem" not in skip_tables:

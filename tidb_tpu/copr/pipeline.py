@@ -58,44 +58,50 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
     - direct: key span fits the budget -> dense position array, probe is
       ONE gather (pos = lut[key - lo]). TPC-H PKs are dense 1..N, so
       this is the common case and the TPU-friendly one.
-    - sorted: argsort + binary search (jnp.searchsorted) otherwise."""
+    - sorted: argsort + binary search (jnp.searchsorted) otherwise.
+
+    Composite keys (dim.extra_keys, Q9 partsupp) pack into one int64 by
+    per-column stride before either form; the pack layout ships to the
+    kernel so the probe packs the same way."""
     col_ids = [cid for cid in (_cid_of(dim.dag, sc) for sc in dim.dag.cols)
                if cid != -1]
     arrays, valid = tbl.snapshot(col_ids, read_ts)
     n = len(valid)
-    key_cid = _cid_of(dim.dag, dim.build_key)
-    if key_cid == -1 or n == 0:
+    key_cids = [_cid_of(dim.dag, sc) for sc, _ in dim.all_keys()]
+    if any(cid == -1 for cid in key_cids) or n == 0:
         return None
-    kdata, knulls, ksdict = arrays[key_cid]
-    if ksdict is not None or kdata.dtype.kind == "f":
-        return None                      # int64-comparable keys only
+    for cid in key_cids:
+        kdata, _kn, ksdict = arrays[cid]
+        if ksdict is not None or kdata.dtype.kind == "f":
+            return None                  # int64-comparable keys only
     host_cache = copr._host_cache
-    if dim.join_type == "semi":
-        # SEMI only tests key EXISTENCE: fold the dim's filters on the
-        # host and dedup, so duplicate keys and filtered dims (Q4's
-        # EXISTS over lineitem) still ride the fused probe. The kernel
-        # then skips this dim's mask entirely ("pre" mode).
+    if dim.join_type in ("semi", "anti") and not dim.extra_keys:
+        # SEMI/ANTI only test key EXISTENCE: fold the dim's filters on
+        # the host and dedup, so duplicate keys and filtered dims (Q4's
+        # EXISTS, Q22's NOT EXISTS over orders) still ride the fused
+        # probe. The kernel then skips this dim's mask entirely
+        # ("pre" mode).
         return _semi_prefiltered_meta(copr, dim, tbl, arrays, valid, n,
-                                      key_cid, read_ts)
+                                      key_cids[0], read_ts)
     # built over VALID rows only (old MVCC versions of an updated key
     # would otherwise look like duplicates); visibility depends on
     # read_ts, so it keys the cache; older versions are evicted
-    hkey = (tbl.uid, key_cid, "dim", tbl.version, n, read_ts)
+    ck = tuple(key_cids)
+    hkey = (tbl.uid, ck, "dim", tbl.version, n, read_ts)
     meta = host_cache.get(hkey)
     if meta is None:
-        prev = host_cache.pop((tbl.uid, key_cid, "dimcur"), None)
+        prev = host_cache.pop((tbl.uid, ck, "dimcur"), None)
         if prev is not None:
             host_cache.pop(prev, None)
-        host_cache[(tbl.uid, key_cid, "dimcur")] = hkey
+        host_cache[(tbl.uid, ck, "dimcur")] = hkey
         vidx = np.nonzero(valid)[0]
-        keys_v = kdata[:n][vidx]
-        nv = len(keys_v)
+        keys_v, pack = _packed_keys(arrays, key_cids, n, vidx)
+        nv = 0 if keys_v is None else len(keys_v)
         unique = nv > 0 and len(np.unique(keys_v)) == nv
-        if nv == 0 or not unique or \
-                (knulls is not None and knulls[:n][vidx].any()):
+        if keys_v is None or nv == 0 or not unique:
             # dup-key / null-key dims are rejected below on every use:
             # cache a tombstone, don't build the (possibly huge) lut
-            meta = (None, None, None, False, 0)
+            meta = (None, None, None, False, 0, None)
         else:
             lo = int(keys_v.min())
             hi = int(keys_v.max())
@@ -103,22 +109,141 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
             if span <= max(4 * nv, 1 << 12) and span <= _DIRECT_SPAN_BUDGET:
                 lut = np.full(span, n, dtype=np.int64)   # n == miss
                 lut[keys_v - lo] = vidx
-                meta = ("direct", lut, lo, unique, nv)
+                meta = ("direct", lut, lo, unique, nv, pack)
             else:
                 o = np.argsort(keys_v, kind="stable")
                 skeys = keys_v[o]
-                meta = ("sorted", (vidx[o], skeys), None, unique, nv)
+                meta = ("sorted", (vidx[o], skeys), None, unique, nv, pack)
         host_cache[hkey] = meta
-    mode, payload, lo, unique, n_sorted = meta
+    mode, payload, lo, unique, n_sorted, pack = meta
     if mode is None or not unique:
         return None
     out = {"arrays": arrays, "valid": valid, "n": n, "tbl": tbl,
-           "mode": mode, "lo": lo, "n_sorted": n_sorted}
+           "mode": mode, "lo": lo, "n_sorted": n_sorted, "pack": pack}
     if mode == "direct":
         out["lut"] = payload
     else:
         out["order"], out["skeys"] = payload
     return out
+
+
+_MAT_SEQ = [0]
+
+
+class _MatTbl:
+    """Shim standing in for a ColumnarTable for materialized dims: only
+    the attributes the upload/caching paths read. A fresh uid per
+    materialization means device uploads never alias across queries
+    (the HBM pool evicts LRU)."""
+
+    __slots__ = ("uid", "version", "n", "dicts")
+
+    def __init__(self, n):
+        _MAT_SEQ[0] += 1
+        self.uid = ("mat", _MAT_SEQ[0])
+        self.version = 0
+        self.n = n
+        self.dicts = {}
+
+
+def _materialized_dim_meta(copr, ctx, dim, read_ts):
+    """Execute dim.subplan (Q17's decorrelated per-key aggregate, Q18's
+    grouped IN-subquery) and shape its output like a dim table: arrays
+    keyed by output POSITION, every row valid, group keys unique by
+    construction (still verified). -> meta dict or None."""
+    if ctx is None:
+        return None
+    from ..executor.builder import build_executor
+    ex = build_executor(ctx, dim.subplan)
+    ex.open()
+    chunks = ex.all_chunks()
+    ex.close()
+    ncols = len(dim.dag.cols)
+    n = sum(len(ch) for ch in chunks)
+    if n == 0:
+        return None                   # caller's empty-dim handling differs
+    arrays = {}
+    for i in range(ncols):
+        parts = [ch.columns[i] for ch in chunks]
+        data = np.concatenate([np.asarray(p.data) for p in parts])
+        if data.dtype.kind not in "iufb":
+            return None               # object arrays can't ride the kernel
+        sdicts = {id(p.dict) for p in parts if p.dict is not None}
+        if len(sdicts) > 1:
+            return None               # inconsistent dicts across chunks
+        sdict = next((p.dict for p in parts if p.dict is not None), None)
+        nulls = None
+        if any(p.nulls is not None for p in parts):
+            nulls = np.concatenate(
+                [p.nulls if p.nulls is not None
+                 else np.zeros(len(p), dtype=bool) for p in parts])
+        arrays[i] = (data, nulls, sdict)
+    key_cids = [_cid_of(dim.dag, sc) for sc, _ in dim.all_keys()]
+    if any(cid == -1 for cid in key_cids):
+        return None
+    for cid in key_cids:
+        kdata, _kn, ksdict = arrays[cid]
+        if ksdict is not None or kdata.dtype.kind == "f":
+            return None
+    valid = np.ones(n, dtype=bool)
+    vidx = np.arange(n)
+    keys_v, pack = _packed_keys(arrays, key_cids, n, vidx)
+    if keys_v is None or len(np.unique(keys_v)) != n:
+        return None
+    lo = int(keys_v.min())
+    span = int(keys_v.max()) - lo + 1
+    out = {"arrays": arrays, "valid": valid, "n": n, "tbl": _MatTbl(n),
+           "pack": pack,
+           "dictsig": tuple(sorted(
+               (i, len(sd.values)) for i, (_d, _nl, sd) in arrays.items()
+               if sd is not None))}
+    if span <= max(4 * n, 1 << 12) and span <= _DIRECT_SPAN_BUDGET:
+        lut = np.full(span, n, dtype=np.int64)
+        lut[keys_v - lo] = vidx
+        out.update(mode="direct", lo=lo, lut=lut, n_sorted=n)
+    else:
+        o = np.argsort(keys_v, kind="stable")
+        out.update(mode="sorted", lo=None, order=vidx[o],
+                   skeys=keys_v[o], n_sorted=n)
+    return out
+
+
+def _packed_keys(arrays, key_cids, n, vidx):
+    """-> (packed int64 key per valid row, pack layout) or (None, None).
+    Single keys pass through (pack=None). Composite keys pack as
+    sum((k_i - lo_i) * stride_i); the layout is (los, spans, strides),
+    rejected when the combined span overflows int63 or any key is
+    NULL."""
+    if len(key_cids) == 1:
+        kdata, knulls, _ = arrays[key_cids[0]]
+        if knulls is not None and knulls[:n][vidx].any():
+            return None, None
+        return kdata[:n][vidx], None
+    cols = []
+    for cid in key_cids:
+        kdata, knulls, _ = arrays[cid]
+        if knulls is not None and knulls[:n][vidx].any():
+            return None, None
+        cols.append(kdata[:n][vidx].astype(np.int64))
+    if len(cols[0]) == 0:
+        return None, None
+    los = [int(c.min()) for c in cols]
+    spans = [int(c.max()) - lo + 1 for c, lo in zip(cols, los)]
+    total = 1
+    for s in spans:
+        total *= s
+        if total > (1 << 62):
+            return None, None
+    strides = []
+    acc = 1
+    for s in reversed(spans):
+        strides.append(acc)
+        acc *= s
+    strides = list(reversed(strides))
+    packed = np.zeros(len(cols[0]), dtype=np.int64)
+    for c, lo, st in zip(cols, los, strides):
+        packed += (c - lo) * st
+    return packed, (tuple(los), tuple(spans), tuple(strides))
 
 
 def _semi_prefiltered_meta(copr, dim, tbl, arrays, valid, n, key_cid,
@@ -201,6 +326,11 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
 
     pre = bool(meta.get("pre"))
     args = {"cols": {}}
+    if meta.get("pack") is not None:
+        los, spans, strides = meta["pack"]
+        args["plo"] = jnp.asarray(los, dtype=jnp.int64)
+        args["pspan"] = jnp.asarray(spans, dtype=jnp.int64)
+        args["pstride"] = jnp.asarray(strides, dtype=jnp.int64)
     if not pre:
         # prefiltered semi dims fold visibility+filters into the lut at
         # meta time; the kernel never reads valid/cols for them — don't
@@ -285,9 +415,11 @@ def _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd):
     for _ in range(len(plan.dims) + 1):
         grew = False
         for dim in plan.dims:
-            if dim.join_type == "semi":
+            if dim.join_type in ("semi", "anti"):
                 continue
-            pidx = _expr_idxs(dim.probe_expr)
+            pidx = set()
+            for _, pe in dim.all_keys():
+                pidx |= _expr_idxs(pe)
             if pidx and pidx <= closure:
                 for sc in dim.dag.cols:
                     if sc.col.idx not in closure:
@@ -449,11 +581,31 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                 dmask = da["valid"]
                 for f in dim.dag.filters:
                     dmask = dmask & eval_bool_mask(dctx, f)
-            pv, pnl, _ = eval_expr(ctx, dim.probe_expr)
-            if np.isscalar(pv) or getattr(pv, "ndim", 1) == 0:
-                pv = jnp.full(fact_cap, pv)
-            pv = pv.astype(jnp.int64)
-            pnm = materialize_nulls(ctx, pnl)
+            if dim.extra_keys:
+                # composite key: pack probes with the build-side layout;
+                # out-of-range components force a miss (a clipped index
+                # could otherwise alias a live packed key)
+                pv = jnp.zeros(fact_cap, dtype=jnp.int64)
+                pnm = jnp.zeros(fact_cap, dtype=bool)
+                inb_pack = jnp.ones(fact_cap, dtype=bool)
+                for ki, (_, pe) in enumerate(dim.all_keys()):
+                    v, nl, _ = eval_expr(ctx, pe)
+                    if np.isscalar(v) or getattr(v, "ndim", 1) == 0:
+                        v = jnp.full(fact_cap, v)
+                    v = v.astype(jnp.int64)
+                    pnm = pnm | materialize_nulls(ctx, nl)
+                    idx = v - da["plo"][ki]
+                    inb_pack = inb_pack & (idx >= 0) & \
+                        (idx < da["pspan"][ki])
+                    idx = jnp.clip(idx, 0, da["pspan"][ki] - 1)
+                    pv = pv + idx * da["pstride"][ki]
+                pnm = pnm | ~inb_pack
+            else:
+                pv, pnl, _ = eval_expr(ctx, dim.probe_expr)
+                if np.isscalar(pv) or getattr(pv, "ndim", 1) == 0:
+                    pv = jnp.full(fact_cap, pv)
+                pv = pv.astype(jnp.int64)
+                pnm = materialize_nulls(ctx, pnl)
             if "lut" in da:
                 # dense key domain: the join is ONE gather
                 lsize = da["lut"].shape[0]
@@ -479,6 +631,11 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                     g = jd[pos]
                     gn = ~hit if jn is None else (~hit | jn[pos])
                     cols[idx] = (g, gn, layout[idx][1])
+            elif dim.join_type == "anti":
+                # NOT EXISTS: keep only rows with NO match (NULL probe
+                # keys never match, so they survive — EXISTS-derived
+                # anti semantics; null-aware NOT IN never plans here)
+                mask = mask & ~hit
             else:
                 mask = mask & hit
                 if dim.join_type != "semi":
@@ -555,7 +712,7 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
 
 
 def fused_partials(copr, plan, read_ts, mesh=None,
-                   bcast_threshold=1 << 20):
+                   bcast_threshold=1 << 20, ctx=None):
     """Execute a PhysFusedPipeline -> [PartialAggResult] (one per fact
     partition; one per mesh shard for the MPP sort layout), or None when
     runtime-ineligible (caller falls back to the conventional subtree).
@@ -565,12 +722,19 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     fact_tbl = engine.table(plan.fact_dag.table_info)
     dim_metas = []
     for dim in plan.dims:
+        if dim.subplan is not None:
+            meta = _materialized_dim_meta(copr, ctx, dim, read_ts)
+            if meta is None:
+                return None
+            dim_metas.append(meta)
+            continue
         tbl = engine.table(dim.dag.table_info)
         if tbl.n == 0:
-            if dim.join_type != "left":
+            if dim.join_type in ("inner", "semi"):
                 return []         # inner/semi with empty dim: no rows
-            # LEFT over an empty dim preserves the fact side with NULL
-            # payload: a 1-row always-miss dim keeps every shape static
+            # LEFT/ANTI over an empty dim preserve the fact side (NULL
+            # payload / all-miss): a 1-row always-miss dim keeps every
+            # shape static
             arrays = {}
             for sc in dim.dag.cols:
                 cid = _cid_of(dim.dag, sc)
@@ -582,7 +746,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 "arrays": arrays, "valid": np.zeros(1, dtype=bool),
                 "n": 1, "tbl": tbl, "mode": "direct",
                 "lut": np.array([1], dtype=np.int64), "lo": 0,
-                "n_sorted": 0})
+                "n_sorted": 0, "pack": None})
             continue
         meta = _dim_sort_meta(copr, dim, tbl, read_ts)
         if meta is None:
@@ -636,7 +800,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             one[sc.col.idx] = (data[:1] if len(data)
                                else np.zeros(1, data.dtype), None, sdict)
     for dim, meta in zip(plan.dims, dim_metas):
-        if dim.join_type == "semi":
+        if dim.join_type in ("semi", "anti"):
             continue
         for sc in dim.dag.cols:
             cid = _cid_of(dim.dag, sc)
@@ -796,7 +960,8 @@ def _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl, fact_arrays,
     if len(plan.dims) != 1 or plan.post_filters:
         return None
     dim, meta = plan.dims[0], dim_metas[0]
-    if dim.join_type != "inner" or meta["n"] <= threshold:
+    if dim.join_type != "inner" or dim.extra_keys or \
+            dim.subplan is not None or meta["n"] <= threshold:
         return None
     if len(plan.group_items) != 1 or not isinstance(plan.group_items[0],
                                                     Column):
@@ -1007,7 +1172,9 @@ def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
          d.probe_expr.fingerprint(), m["mode"],
          len(m["lut"]) if m["mode"] == "direct" else 0,
          tuple(f.fingerprint() for f in d.dag.filters),
-         tuple(sorted((sc.col.idx, sc.name) for sc in d.dag.cols)))
+         tuple(sorted((sc.col.idx, sc.name) for sc in d.dag.cols)),
+         tuple((sc.col.idx, pe.fingerprint()) for sc, pe in d.extra_keys),
+         m.get("dictsig", ()))
         for d, m in zip(plan.dims, dim_metas))
     postfps = tuple(f.fingerprint() for f in plan.post_filters)
     gfps = tuple(g.fingerprint() for g in plan.group_items)

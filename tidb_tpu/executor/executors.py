@@ -186,7 +186,7 @@ class FusedPipelineExec(Executor):
                     "tidb_broadcast_join_threshold_count"))
                 res = fused_partials(self.ctx.copr, self.plan,
                                      self.ctx.read_ts(), mesh,
-                                     bcast_threshold=bt)
+                                     bcast_threshold=bt, ctx=self.ctx)
                 if res is not None:
                     sess.domain.inc_metric(
                         "fused_pipeline_mpp_hit" if mesh is not None
@@ -199,7 +199,8 @@ class FusedPipelineExec(Executor):
                     # all the way back to the host join
                     try:
                         res = fused_partials(self.ctx.copr, self.plan,
-                                             self.ctx.read_ts(), None)
+                                             self.ctx.read_ts(), None,
+                                             ctx=self.ctx)
                         if res is not None:
                             sess.domain.inc_metric("fused_pipeline_hit")
                             return res

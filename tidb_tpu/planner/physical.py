@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..expression import Expression, Column, Constant, ScalarFunc, AggDesc
+from ..expression import (Expression, Column, Constant, ScalarFunc,
+                          AggDesc, const_from_py)
 from ..expression.vec import is_device_safe
 from ..types.field_type import new_bigint_type
 from .schema import Schema, SchemaCol
@@ -83,12 +84,258 @@ class PhysTableReader(PhysPlan):
 class DimJoin:
     """One dimension join stage of a fused pipeline: probe the (sorted)
     build-key column of `dag`'s table with `probe_expr` evaluated over the
-    pipeline columns; gather payload columns on match."""
+    pipeline columns; gather payload columns on match.
+
+    `extra_keys` widens the join to a composite key (Q9's lineitem ⋈
+    partsupp on (l_partkey, l_suppkey)): the runtime packs all key
+    columns into one int64 by per-column stride (spans measured from the
+    data), so the probe stays ONE searchsorted/gather — uniqueness is
+    verified on the packed value."""
 
     dag: object = None          # CoprDAG: dim scan cols + device filters
     build_key: object = None    # SchemaCol in dag.cols — must be unique
     probe_expr: object = None   # Expression over pipeline columns
     join_type: str = "inner"    # inner | semi
+    extra_keys: tuple = ()      # ((SchemaCol, Expression), ...) composite
+    subplan: object = None      # PhysPlan: materialized dim (agg leaf)
+
+    def all_keys(self):
+        return ((self.build_key, self.probe_expr),) + tuple(self.extra_keys)
+
+
+class _MatCol:
+    __slots__ = ("id",)
+
+    def __init__(self, i):
+        self.id = i
+
+
+class _MatTableInfo:
+    """Synthetic table_info for a materialized (subplan) dim: columns
+    address by POSITION in the subplan's output schema. Ambiguous
+    display names resolve to nothing (the runtime then rejects and the
+    query falls back)."""
+
+    def __init__(self, name, cols):
+        self.id = -4242
+        self.name = name
+        self.partitions = []
+        self.pk_is_handle = False
+        self.pk_col_name = ""
+        self.dicts = {}
+        by_name = {}
+        dropped = set()
+        for i, sc in enumerate(cols):
+            nm = (sc.name or f"_c{i}").lower()
+            if nm in by_name:
+                dropped.add(nm)
+            by_name[nm] = _MatCol(i)
+        for nm in dropped:
+            del by_name[nm]
+        self._by_name = by_name
+
+    def find_column(self, name):
+        return self._by_name.get(name.lower())
+
+    def public_indexes(self):
+        return []
+
+
+class _AggLeaf:
+    """Join-tree leaf that is itself an aggregation subtree (Q17's
+    decorrelated per-partkey AVG, Q18's IN (... GROUP BY ... HAVING)):
+    the runtime executes the subtree, and the group keys — unique by
+    construction — become the dim build keys. Reference analog: TiFlash
+    executing the subquery fragment and shipping its result as the
+    build side (fragment.go Broadcast exchange)."""
+
+    def __init__(self, plan, agg):
+        self.plan = plan
+        self.agg = agg
+        cols = list(plan.schema.cols)
+        self.dag = CoprDAG(table_info=_MatTableInfo("subquery", cols),
+                           db_name="", cols=cols)
+        self.stats_rows = plan.stats_rows
+        self.raw_rows = plan.stats_rows
+
+    def unique_on(self, col_idx):
+        """Unique iff the column IS the sole group key of the root agg
+        (projection-wrapped roots decline; the runtime still verifies)."""
+        if self.plan is not self.agg or len(self.agg.group_items) != 1:
+            return False
+        cols = self.agg.schema.cols
+        return bool(cols) and cols[0].col.idx == col_idx
+
+
+def _try_agg_leaf(p):
+    q = p
+    while isinstance(q, (PhysShell, PhysSelection, PhysProjection)) \
+            and q.children:
+        q = q.children[0]
+    if isinstance(q, PhysHashAgg):
+        return _AggLeaf(p, q)
+    return None
+
+
+import itertools as _itertools
+
+_SYN_IDS = _itertools.count(1 << 40)    # synthesized col ids: disjoint
+                                        # from the builder's allocator
+
+
+def _swap_join_build(root, joinnode, subagg):
+    """Clone the path from root down to `joinnode`, replacing that join's
+    build (right) side with the pre-agg subtree; the new join's schema is
+    left cols + subagg cols. Every cloned ANCESTOR's schema is rebuilt
+    from its new children (the original schemas list the removed dim
+    payload columns — binding against them would miss the synthetic
+    subagg columns). -> new root or None if joinnode not found or an
+    ancestor node kind can't be re-schemed."""
+    import copy as _copy
+    if root is joinnode:
+        nj = PhysHashJoin(joinnode.join_type, 1, joinnode.eq_conds, [],
+                          Schema(list(joinnode.children[0].schema.cols) +
+                                 list(subagg.schema.cols)),
+                          joinnode.children[0], subagg)
+        nj.stats_rows = joinnode.stats_rows
+        return nj
+    for i, c in enumerate(root.children):
+        r = _swap_join_build(c, joinnode, subagg)
+        if r is not None:
+            clone = _copy.copy(root)
+            clone.children = list(root.children)
+            clone.children[i] = r
+            if isinstance(clone, (PhysSelection, PhysShell)):
+                clone.schema = r.schema
+            elif isinstance(clone, PhysHashJoin):
+                if clone.join_type in ("semi", "anti"):
+                    clone.schema = Schema(
+                        list(clone.children[0].schema.cols))
+                else:
+                    clone.schema = Schema(
+                        list(clone.children[0].schema.cols) +
+                        list(clone.children[1].schema.cols))
+            else:
+                return None   # unexpected ancestor: decline the rewrite
+            return clone
+    return None
+
+
+def _eager_agg_outer_dims(outer_dims, group_items, aggs, other_refs):
+    """Eager aggregation (reference: TiDB's aggregation push-down rule,
+    planner/core/rule_aggregation_push_down.go, re-shaped for the fused
+    pipeline): a LEFT outer dim with a NON-unique join key (Q13's
+    orders-per-customer) pre-aggregates BY the join key, making the dim
+    unique so the probe stays one gather. The outer aggs rewrite:
+      count(dim e)  -> sum(ifnull(sub_count_e, 0))
+      sum(dim e)    -> sum(sub_sum_e)         (miss -> NULL, skipped)
+      min/max(dim e)-> min/max(sub_min/max_e)
+      count(*)      -> sum(ifnull(sub_count_star, 1))
+      min/max(fact) -> unchanged (multiplicity-free)
+    -> (new_outer_dims, new_aggs, (joinnode, subagg)) or None when not
+    applicable; the caller swaps the join node's build side for the
+    pre-agg subtree in the runtime-fallback tree so the rewritten aggs
+    stay evaluable there."""
+    idx = None
+    for i, (leaf, jt, econds, _node) in enumerate(outer_dims):
+        if jt != "left" or not isinstance(leaf, PhysTableReader):
+            continue
+        (l_e, r_e) = econds[0]
+        b = None
+        leaf_idxs = {sc.col.idx for sc in leaf.dag.cols}
+        for cand in (l_e, r_e):
+            if isinstance(cand, Column) and cand.idx in leaf_idxs:
+                b = cand
+                break
+        if b is None or _is_unique_col(leaf.dag.table_info,
+                                       next(s.name for s in leaf.dag.cols
+                                            if s.col.idx == b.idx)):
+            continue
+        # dim cols may appear ONLY inside agg args (group keys / filters /
+        # other probes needing raw dim rows block the transform)
+        if other_refs & (leaf_idxs - {b.idx}):
+            continue
+        if idx is not None:
+            return None        # two multiplying dims: k-factors compose,
+        idx = i                # out of scope
+    if idx is None:
+        return None
+    leaf, jt, econds, joinnode = outer_dims[idx]
+    leaf_idxs = {sc.col.idx for sc in leaf.dag.cols}
+    (l_e, r_e) = econds[0]
+    b = l_e if isinstance(l_e, Column) and l_e.idx in leaf_idxs else r_e
+    ft_i64 = new_bigint_type()
+    sub_aggs = []
+    sub_cols = []
+
+    def sub_out(name, args, out_ft):
+        for j, a in enumerate(sub_aggs):
+            if a.name == name and \
+                    [x.fingerprint() for x in a.args] == \
+                    [x.fingerprint() for x in args]:
+                return sub_cols[j]
+        c = Column(next(_SYN_IDS), out_ft, f"agg${len(sub_aggs)}")
+        sub_aggs.append(AggDesc(name, args, ft=out_ft))
+        sub_cols.append(c)
+        return c
+
+    new_aggs = []
+    for a in aggs:
+        arg_idxs = set()
+        for x in a.args:
+            arg_idxs |= _cols_of(x)
+        dim_side = bool(arg_idxs & leaf_idxs)
+        if dim_side and not (arg_idxs <= leaf_idxs):
+            return None                      # mixed fact*dim arg
+        if a.distinct:
+            return None
+        if not dim_side:
+            if a.name == "count" and not a.args:
+                cnt = sub_out("count", [], ft_i64)
+                one = const_from_py(1, ft_i64)
+                new_aggs.append(AggDesc(
+                    "sum", [ScalarFunc("ifnull", [cnt, one], ft_i64)],
+                    ft=a.ft))
+            elif a.name in ("min", "max"):
+                new_aggs.append(a)           # multiplicity-free
+            else:
+                return None
+            continue
+        if not all(is_device_safe(x) for x in a.args):
+            return None
+        if a.name == "count":
+            cnt = sub_out("count", list(a.args), ft_i64)
+            zero = const_from_py(0, ft_i64)
+            new_aggs.append(AggDesc(
+                "sum", [ScalarFunc("ifnull", [cnt, zero], ft_i64)],
+                ft=a.ft))
+        elif a.name in ("sum", "min", "max"):
+            sc = sub_out(a.name, list(a.args), a.ft)
+            new_aggs.append(AggDesc(a.name, [sc], ft=a.ft))
+        else:
+            return None                      # avg: two-state decompose
+    if not sub_aggs:
+        return None
+    import dataclasses
+    key_sc = next(s for s in leaf.dag.cols if s.col.idx == b.idx)
+    sub_schema = Schema([SchemaCol(Column(b.idx, b.ft, key_sc.name),
+                                   key_sc.name)] +
+                        [SchemaCol(c, c.name) for c in sub_cols])
+    dag2 = dataclasses.replace(
+        leaf.dag, cols=list(leaf.dag.cols),
+        filters=list(leaf.dag.filters),
+        host_filters=list(leaf.dag.host_filters),
+        group_items=[Column(b.idx, b.ft, key_sc.name)],
+        aggs=[_to_partial(a) for a in sub_aggs])
+    reader2 = PhysTableReader(dag2, leaf.schema)
+    reader2.stats_rows = leaf.stats_rows
+    subagg = PhysHashAgg([Column(b.idx, b.ft, key_sc.name)], sub_aggs,
+                         "final", sub_schema, reader2)
+    subagg.stats_rows = max(leaf.stats_rows / 4.0, 1.0)
+    wrapper = _AggLeaf(subagg, subagg)
+    out = list(outer_dims)
+    out[idx] = (wrapper, jt, econds, joinnode)
+    return out, new_aggs, (joinnode, subagg)
 
 
 class PhysFusedPipeline(PhysPlan):
@@ -119,9 +366,9 @@ class PhysFusedPipeline(PhysPlan):
 
     def explain_info(self):
         dims = ", ".join(
-            f"{d.dag.table_info.name}[{d.build_key.name} = "
-            f"{d.probe_expr!r}]" + ("" if d.join_type == "inner"
-                                    else f" ({d.join_type})")
+            f"{d.dag.table_info.name}["
+            + ", ".join(f"{sc.name} = {pe!r}" for sc, pe in d.all_keys())
+            + "]" + ("" if d.join_type == "inner" else f" ({d.join_type})")
             for d in self.dims)
         s = (f"fact:{self.fact_dag.table_info.name}, dims:[{dims}], "
              f"group:[{', '.join(map(repr, self.group_items))}], "
@@ -466,6 +713,8 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
             child.stats_rows = plan.stats_rows
             return agg
         fused = _try_fuse_agg(plan, child)
+        if fused is None:
+            fused = _try_fuse_distinct(plan, child)
         if fused is not None:
             return fused
         agg = PhysHashAgg(plan.group_items, plan.aggs, "complete",
@@ -694,6 +943,12 @@ def _collect_join_tree(p, leaves, eqs, filters, outer_dims):
         filters.extend(p.conds)
         return _collect_join_tree(p.child, leaves, eqs, filters,
                                   outer_dims)
+    if isinstance(p, PhysIndexLookupJoin):
+        # the ILJ keeps its hash-join equivalent as `fallback`: fuse from
+        # that shape (the fused kernel replaces the whole subtree; the
+        # runtime fallback tree keeps the ILJ node itself)
+        return _collect_join_tree(p.fallback, leaves, eqs, filters,
+                                  outer_dims)
     if isinstance(p, PhysHashJoin):
         if getattr(p, "null_aware", False):
             return False
@@ -704,17 +959,124 @@ def _collect_join_tree(p, leaves, eqs, filters, outer_dims):
                                        filters, outer_dims) and
                     _collect_join_tree(p.children[1], leaves, eqs,
                                        filters, outer_dims))
-        if p.join_type in ("left", "semi") and len(p.eq_conds) == 1 and \
-                not p.other_conds and _fusable_leaf(p.children[1]):
-            outer_dims.append((p.children[1], p.join_type,
-                               list(p.eq_conds)))
-            return _collect_join_tree(p.children[0], leaves, eqs,
-                                      filters, outer_dims)
+        if p.join_type in ("left", "semi", "anti") and \
+                len(p.eq_conds) == 1:
+            inner = p.children[1]
+            crossing = []
+            if p.other_conds:
+                # ON filters over the inner side only pre-filter the dim
+                # (exact for LEFT/SEMI: Q13's `on ... and o_comment not
+                # like ...`); conds crossing sides go to the pair-count
+                # rewrite below
+                if not _fusable_leaf(inner):
+                    return False
+                inner_cols = {sc.col.idx for sc in inner.dag.cols}
+                absorb = [c for c in p.other_conds
+                          if _cols_of(c) <= inner_cols and
+                          is_device_safe(c)]
+                crossing = [c for c in p.other_conds if c not in absorb]
+                if absorb:
+                    import dataclasses
+                    dag2 = dataclasses.replace(
+                        inner.dag, filters=inner.dag.filters + absorb)
+                    inner2 = PhysTableReader(dag2, inner.schema)
+                    inner2.stats_rows = inner.stats_rows
+                    inner2.raw_rows = getattr(inner, "raw_rows",
+                                              inner.stats_rows)
+                    inner = inner2
+            if crossing:
+                if p.join_type in ("semi", "anti") and \
+                        len(crossing) == 1 and \
+                        isinstance(inner, PhysTableReader) and \
+                        _pair_count_rewrite(p, inner, crossing[0],
+                                            filters, outer_dims):
+                    return _collect_join_tree(p.children[0], leaves, eqs,
+                                              filters, outer_dims)
+                return False
+            if not _fusable_leaf(inner):
+                inner = _try_agg_leaf(inner)
+            if inner is not None:
+                outer_dims.append((inner, p.join_type, list(p.eq_conds),
+                                   p))
+                return _collect_join_tree(p.children[0], leaves, eqs,
+                                          filters, outer_dims)
         return False
     if _fusable_leaf(p):
         leaves.append(p)
         return True
+    al = _try_agg_leaf(p)
+    if al is not None:
+        leaves.append(al)
+        return True
     return False
+
+
+def _pair_count_rewrite(p, inner, cross, filters, outer_dims):
+    """EXISTS/NOT EXISTS with a same-key inequality correlation (Q21's
+    `l2.l_orderkey = l1.l_orderkey and l2.l_suppkey <> l1.l_suppkey`)
+    -> two per-key COUNT dims:
+      exists(T: T.k = o.k and T.c <> o.c and P(T))
+        <=> cnt_k(o.k) - cnt_kc(o.k, o.c) > 0      (NOT EXISTS: == 0)
+    where cnt_k counts filtered T rows per k and cnt_kc per (k, c) —
+    both group-by results have unique keys, so they ride the fused
+    probe as LEFT materialized dims (ifnull(cnt, 0) on miss) and the
+    comparison becomes a device post filter. This is the classic Q21
+    decorrelation, here produced mechanically so the whole query stays
+    one device kernel."""
+    inner_cols = {sc.col.idx for sc in inner.dag.cols}
+    if not (isinstance(cross, ScalarFunc) and cross.op == "!=" and
+            len(cross.args) == 2):
+        return False
+    a, b_out = cross.args
+    if not (isinstance(a, Column) and a.idx in inner_cols):
+        a, b_out = b_out, a
+    if not (isinstance(a, Column) and a.idx in inner_cols):
+        return False
+    if (_cols_of(b_out) & inner_cols) or not is_device_safe(b_out):
+        return False
+    l_e, r_e = p.eq_conds[0]
+    k_in, k_out = (l_e, r_e) if isinstance(l_e, Column) and \
+        l_e.idx in inner_cols else (r_e, l_e)
+    if not (isinstance(k_in, Column) and k_in.idx in inner_cols) or \
+            (_cols_of(k_out) & inner_cols):
+        return False
+    if not (_fusable_key_ft(k_in.ft) and _fusable_key_ft(a.ft) and
+            _fusable_key_ft(b_out.ft)):
+        return False
+    import dataclasses
+    ft_i64 = new_bigint_type()
+    k_sc = next(s for s in inner.dag.cols if s.col.idx == k_in.idx)
+    a_sc = next(s for s in inner.dag.cols if s.col.idx == a.idx)
+    k_col = Column(k_in.idx, k_in.ft, k_sc.name)
+    a_col = Column(a.idx, a.ft, a_sc.name)
+    cnt_cols = []
+    for gi, gcols in enumerate(([k_col], [k_col, a_col])):
+        cnt_col = Column(next(_SYN_IDS), ft_i64, f"cnt${gi}")
+        sub_aggs = [AggDesc("count", [], ft=ft_i64)]
+        dag2 = dataclasses.replace(
+            inner.dag, cols=list(inner.dag.cols),
+            filters=list(inner.dag.filters),
+            host_filters=list(inner.dag.host_filters),
+            group_items=list(gcols),
+            aggs=[_to_partial(x) for x in sub_aggs])
+        rd = PhysTableReader(dag2, inner.schema)
+        rd.stats_rows = inner.stats_rows
+        schema = Schema([SchemaCol(g, g.name) for g in gcols] +
+                        [SchemaCol(cnt_col, cnt_col.name)])
+        sp = PhysHashAgg(list(gcols), sub_aggs, "final", schema, rd)
+        sp.stats_rows = max(inner.stats_rows / 4.0, 1.0)
+        econds = [(k_col, k_out)]
+        if gi == 1:
+            econds.append((a_col, b_out))
+        outer_dims.append((_AggLeaf(sp, sp), "left", econds, p))
+        cnt_cols.append(cnt_col)
+    zero = const_from_py(0, ft_i64)
+    diff = ScalarFunc("-", [
+        ScalarFunc("ifnull", [cnt_cols[0], zero], ft_i64),
+        ScalarFunc("ifnull", [cnt_cols[1], zero], ft_i64)], ft_i64)
+    filters.append(ScalarFunc(">" if p.join_type == "semi" else "=",
+                              [diff, zero], ft_i64))
+    return True
 
 
 def _is_unique_col(tbl, name):
@@ -838,47 +1200,120 @@ def _try_join_strategy(plan: LJoin, left, right, hash_plan):
     return r
 
 
+def _subst_cols(e, mapping):
+    """Replace Column refs per mapping {idx: Expression}; shares untouched
+    subtrees (expressions are immutable by convention)."""
+    if isinstance(e, Column):
+        return mapping.get(e.idx, e)
+    if isinstance(e, ScalarFunc):
+        na = [_subst_cols(a, mapping) for a in e.args]
+        if all(x is y for x, y in zip(na, e.args)):
+            return e
+        return ScalarFunc(e.op, na, e.ft)
+    return e
+
+
 def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
     """Aggregation over an inner-join tree of plain table scans ->
     PhysHashAgg(final) over a PhysFusedPipeline, when every expression is
     device-safe and every join can be oriented as probe(pipeline) ->
     build(bare int column of an unused scan). The conventional subtree is
-    kept as the runtime fallback."""
-    for a in plan.aggs:
+    kept as the runtime fallback.
+
+    Derived tables (Q7/Q8/Q9's `from (select ...) as x`) put
+    Shell/Projection layers between the agg and the join tree; they peel
+    here by substituting each projection's exprs into the group items,
+    agg args and any filters collected above it, so the fused plan's
+    expressions reference leaf columns directly."""
+    group_items = list(plan.group_items)
+    agg_args = [list(a.args) for a in plan.aggs]
+    peeled_filters = []
+    substituted = False
+    p = child
+    while True:
+        if isinstance(p, PhysShell):
+            p = p.children[0]
+        elif isinstance(p, PhysProjection):
+            m = {sc.col.idx: e
+                 for sc, e in zip(p.schema.cols, p.exprs)}
+            group_items = [_subst_cols(g, m) for g in group_items]
+            agg_args = [[_subst_cols(a, m) for a in args]
+                        for args in agg_args]
+            peeled_filters = [_subst_cols(f, m) for f in peeled_filters]
+            substituted = True
+            p = p.children[0]
+        elif isinstance(p, PhysSelection):
+            peeled_filters.extend(p.conds)
+            p = p.children[0]
+        else:
+            break
+    aggs = list(plan.aggs)
+    if substituted:
+        aggs = [AggDesc(a.name, args, a.distinct, a.ft, a.mode,
+                        a.order_by, a.separator)
+                for a, args in zip(plan.aggs, agg_args)]
+    for a in aggs:
         if a.name not in _PUSHABLE_AGGS or a.distinct:
             return None
         if not all(is_device_safe(arg) for arg in a.args):
             return None
-    for g in plan.group_items:
+    for g in group_items:
         if not is_device_safe(g):
             return None
-    leaves, eqs, filters, outer_dims = [], [], [], []
-    if not _collect_join_tree(child, leaves, eqs, filters, outer_dims) \
+    leaves, eqs, filters, outer_dims = list(), [], list(peeled_filters), []
+    if not _collect_join_tree(p, leaves, eqs, filters, outer_dims) \
             or not leaves or (len(leaves) < 2 and not outer_dims) or \
             (not eqs and not outer_dims):
         return None
     for f in filters:
         if not is_device_safe(f):
             return None
+    if outer_dims:
+        other_refs = set()
+        for e in list(group_items) + list(filters):
+            other_refs |= _cols_of(e)
+        for l, r in eqs:
+            other_refs |= _cols_of(l) | _cols_of(r)
+        for _leaf, _jt, ec, _node in outer_dims:
+            for l, r in ec:
+                other_refs |= _cols_of(l) | _cols_of(r)
+        eager = _eager_agg_outer_dims(outer_dims, group_items, aggs,
+                                      other_refs)
+        if eager is not None:
+            outer_dims, aggs, (joinnode, subagg) = eager
+            p2 = _swap_join_build(p, joinnode, subagg)
+            if p2 is None:
+                return None
+            p = p2
     owner = {}                      # col idx -> leaf reader
     for leaf in leaves:
         for sc in leaf.dag.cols:
             owner[sc.col.idx] = leaf
     # fact candidates by RAW size (filtered stats can make the true fact
     # look smaller than a dimension); try each until one orients
+    # the runtime fallback is the PEELED join tree: the fused plan's
+    # exprs are substituted to leaf columns, so the fallback must expose
+    # leaf columns too (the projection layers above only rename/compute
+    # what the partial-agg shim now computes itself); filters that sat
+    # above a projection re-apply via a Selection wrapper
+    fallback = p if not peeled_filters else PhysSelection(
+        list(peeled_filters), p)
     candidates = sorted(
-        leaves, key=lambda p: getattr(p, "raw_rows", p.stats_rows),
+        (c for c in leaves if not isinstance(c, _AggLeaf)),
+        key=lambda c: getattr(c, "raw_rows", c.stats_rows),
         reverse=True)
     for fact in candidates:
-        r = _orient_pipeline(plan, child, leaves, eqs, filters, owner,
-                             fact, outer_dims)
+        r = _orient_pipeline(plan, fallback, leaves, eqs, filters, owner,
+                             fact, outer_dims, group_items, aggs)
         if r is not None:
             return r
     return None
 
 
 def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
-                     outer_dims=()):
+                     outer_dims=(), group_items=None, aggs=None):
+    group_items = plan.group_items if group_items is None else group_items
+    aggs = plan.aggs if aggs is None else aggs
     pipe = {sc.col.idx for sc in fact.dag.cols}
     used = {id(fact)}
     dims = []
@@ -898,31 +1333,74 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
             if not (_fusable_key_ft(b.ft) and _fusable_key_ft(pexp.ft)):
                 continue
             sc = next(s for s in leaf.dag.cols if s.col.idx == b.idx)
-            if unique_only and not _is_unique_col(leaf.dag.table_info,
-                                                  sc.name):
+            if unique_only and not (
+                    leaf.unique_on(b.idx) if isinstance(leaf, _AggLeaf)
+                    else _is_unique_col(leaf.dag.table_info, sc.name)):
                 continue
-            dims.append(DimJoin(leaf.dag, sc, pexp, "inner"))
+            dims.append(DimJoin(leaf.dag, sc, pexp, "inner",
+                                subplan=getattr(leaf, "plan", None)))
             used.add(id(leaf))
             pipe.update(s.col.idx for s in leaf.dag.cols)
+            return True
+        return False
+
+    def try_composite():
+        # two or more eq conds against one unattached leaf -> composite
+        # packed-key dim (Q9 partsupp on (ps_partkey, ps_suppkey)); the
+        # runtime verifies packed uniqueness and falls back otherwise
+        by_leaf = {}
+        for eq in remaining:
+            l, r = eq
+            for b, pexp in ((l, r), (r, l)):
+                if isinstance(b, Column):
+                    leaf = owner.get(b.idx)
+                    if leaf is not None and id(leaf) not in used and \
+                            _cols_of(pexp) <= pipe and \
+                            is_device_safe(pexp) and \
+                            _fusable_key_ft(b.ft) and \
+                            _fusable_key_ft(pexp.ft):
+                        by_leaf.setdefault(id(leaf), []).append(
+                            (leaf, b, pexp, eq))
+                        break
+        for entries in by_leaf.values():
+            if len(entries) < 2:
+                continue
+            leaf = entries[0][0]
+            pairs = []
+            for _, b, pexp, _eq in entries:
+                sc = next(s for s in leaf.dag.cols if s.col.idx == b.idx)
+                pairs.append((sc, pexp))
+            dims.append(DimJoin(leaf.dag, pairs[0][0], pairs[0][1],
+                                "inner", tuple(pairs[1:]),
+                                subplan=getattr(leaf, "plan", None)))
+            used.add(id(leaf))
+            pipe.update(s.col.idx for s in leaf.dag.cols)
+            for _, _, _, eq in entries:
+                remaining.remove(eq)
             return True
         return False
 
     progress = True
     while remaining and progress:
         progress = False
-        for unique_only in (True, False):
-            nxt = []
-            for l, r in remaining:
-                if _cols_of(l) <= pipe and _cols_of(r) <= pipe:
-                    if not (is_device_safe(l) and is_device_safe(r)):
-                        return None
-                    post.append(ScalarFunc("=", [l, r], ft_i64))
-                    progress = True
-                elif try_join(l, r, unique_only):
-                    progress = True
-                else:
-                    nxt.append((l, r))
-            remaining = nxt
+        # unique singles first, then composite (so a 2-eq leaf packs
+        # instead of attaching one non-unique column), then any single
+        for phase in ("unique", "composite", "any"):
+            if phase == "composite":
+                progress = try_composite()
+            else:
+                nxt = []
+                for l, r in remaining:
+                    if _cols_of(l) <= pipe and _cols_of(r) <= pipe:
+                        if not (is_device_safe(l) and is_device_safe(r)):
+                            return None
+                        post.append(ScalarFunc("=", [l, r], ft_i64))
+                        progress = True
+                    elif try_join(l, r, phase == "unique"):
+                        progress = True
+                    else:
+                        nxt.append((l, r))
+                remaining = nxt
             if progress:
                 break                # re-prefer unique keys next round
     if remaining or len(used) != len(leaves):
@@ -931,39 +1409,77 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
     # exprs may use any pipeline column; a left dim contributes columns,
     # a semi dim only masks. Collection order is outermost-first —
     # attach innermost-first so an outer dim can probe an inner one
-    for leaf, jt, econds in reversed(outer_dims):
-        (l_e, r_e) = econds[0]
-        build, probe = None, None
-        for b, pexp in ((l_e, r_e), (r_e, l_e)):
-            if isinstance(b, Column) and \
-                    any(s.col.idx == b.idx for s in leaf.dag.cols) and \
-                    _cols_of(pexp) <= pipe and is_device_safe(pexp) and \
-                    _fusable_key_ft(b.ft) and _fusable_key_ft(pexp.ft):
-                build, probe = b, pexp
-                break
-        if build is None:
-            return None
-        sc = next(s for s in leaf.dag.cols if s.col.idx == build.idx)
-        dims.append(DimJoin(leaf.dag, sc, probe, jt))
+    for leaf, jt, econds, _node in reversed(outer_dims):
+        pairs = []
+        for l_e, r_e in econds:       # >1 pair: composite outer dim
+            build, probe = None, None
+            for b, pexp in ((l_e, r_e), (r_e, l_e)):
+                if isinstance(b, Column) and \
+                        any(s.col.idx == b.idx for s in leaf.dag.cols) and \
+                        _cols_of(pexp) <= pipe and is_device_safe(pexp) and \
+                        _fusable_key_ft(b.ft) and _fusable_key_ft(pexp.ft):
+                    build, probe = b, pexp
+                    break
+            if build is None:
+                return None
+            sc = next(s for s in leaf.dag.cols if s.col.idx == build.idx)
+            pairs.append((sc, probe))
+        dims.append(DimJoin(leaf.dag, pairs[0][0], pairs[0][1], jt,
+                            tuple(pairs[1:]),
+                            subplan=getattr(leaf, "plan", None)))
         if jt == "left":
             pipe.update(s.col.idx for s in leaf.dag.cols)
     for f in filters:
         if not (_cols_of(f) <= pipe):
             return None
     post.extend(filters)
-    for e in list(plan.group_items) + [a0 for a in plan.aggs
-                                       for a0 in a.args]:
+    for e in list(group_items) + [a0 for a in aggs for a0 in a.args]:
         if not (_cols_of(e) <= pipe):
             return None
     fused = PhysFusedPipeline(fact.dag, dims, post,
-                              list(plan.group_items),
-                              [_to_partial(a) for a in plan.aggs],
+                              list(group_items),
+                              [_to_partial(a) for a in aggs],
                               plan.schema, child)
     fused.stats_rows = plan.stats_rows
-    agg = PhysHashAgg(plan.group_items, plan.aggs, "final", plan.schema,
-                      fused)
+    agg = PhysHashAgg(group_items, aggs, "final", plan.schema, fused)
     agg.stats_rows = plan.stats_rows
     return agg
+
+
+def _try_fuse_distinct(plan: Aggregation, child: PhysPlan):
+    """COUNT(DISTINCT x) over a join tree (Q16) -> two stages: the fused
+    pipeline groups by (G..., x) — deduplication IS aggregation on
+    device — then a host complete-agg counts pair rows per G. Reference:
+    the distinct spill path in agg_hash_executor.go, re-shaped so the
+    heavy dedup runs as the device group-by."""
+    if len(plan.aggs) != 1:
+        return None
+    a = plan.aggs[0]
+    if not (a.distinct and a.name == "count" and len(a.args) == 1):
+        return None
+    x = a.args[0]
+    ft_i64 = new_bigint_type()
+
+    class _Inner:
+        pass
+    inner = _Inner()
+    inner.group_items = list(plan.group_items) + [x]
+    inner.aggs = [AggDesc("count", [], ft=ft_i64)]
+    mid_cols = [Column(next(_SYN_IDS), g.ft, f"g${i}")
+                for i, g in enumerate(inner.group_items)]
+    mid_cols.append(Column(next(_SYN_IDS), ft_i64, "cnt$"))
+    inner.schema = Schema([SchemaCol(c, c.name) for c in mid_cols])
+    inner.stats_rows = plan.stats_rows * 4
+    fused = _try_fuse_agg(inner, child)
+    if fused is None:
+        return None
+    ngi = len(plan.group_items)
+    outer = PhysHashAgg(
+        [mid_cols[i] for i in range(ngi)],
+        [AggDesc("count", [mid_cols[ngi]], ft=a.ft)],
+        "complete", plan.schema, fused)
+    outer.stats_rows = plan.stats_rows
+    return outer
 
 
 def attach_fused_topn(plan: PhysPlan) -> PhysPlan:
