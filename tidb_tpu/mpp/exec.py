@@ -111,9 +111,41 @@ def mpp_filter_agg(mesh: Mesh, key_arr, val_arr, valid, n_groups: int,
     return jax.jit(fn)(key_arr, val_arr, valid)
 
 
+def _shuffle_capacity(keys, ok, ndev):
+    """Exact per-(sender, destination) bucket maximum for a hash
+    exchange, computed on host before tracing. Sizing the exchange
+    frames to this bound makes overflow *impossible by construction*
+    (reference fragment.go:78 hash exchange never drops rows): a skewed
+    key distribution grows the frame instead of silently spilling rows.
+    Returns 0 for an empty side."""
+    keys = np.asarray(keys)
+    ok = np.asarray(ok)
+    n = keys.shape[0]
+    local = n // ndev
+    mx = 0
+    for d in range(ndev):
+        sl = slice(d * local, (d + 1) * local)
+        dk = keys[sl][ok[sl]] % ndev
+        if dk.size:
+            mx = max(mx, int(np.bincount(dk, minlength=ndev).max()))
+    return mx
+
+
+def _round_capacity(cap):
+    """Quarter-pow2 bucketing (same policy as the copr buffer pool) so
+    repeated runs with similar skew reuse one compiled kernel."""
+    if cap <= 128:
+        return 128
+    p = 1 << (int(cap - 1).bit_length())
+    for q in (p // 2 + p // 4, p // 2 + p // 2):
+        if cap <= q:
+            return q
+    return p
+
+
 def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
                          build_keys, build_payload, build_valid,
-                         n_groups: int, axis: str = "dp"):
+                         n_groups: int, axis: str = "dp", cap=None):
     """Fragment pair with a HASH exchange: both sides all_to_all'd by
     key % n_devices so matching keys land on the same device, then a local
     sort-merge join feeds a grouped aggregation on the build payload,
@@ -121,19 +153,26 @@ def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
     (ExchangeType_Hash) as XLA collectives — chosen over a Broadcast
     exchange when the build side is too large to replicate.
 
-    Local shapes are static: each device keeps ceil(n/ndev) slots per peer
-    (padding with invalid rows), the all_to_all is a single ICI collective.
-    probe_vals may be one array or a list (multi-agg); returns
-    (sums[n_groups] per val, counts[n_groups]) replicated."""
+    Local shapes are static: each device keeps `cap` slots per peer, where
+    `cap` is the exact maximum per-(sender, destination) bucket count
+    measured on host before tracing (pow2-bucketed for kernel-cache
+    reuse) — so a hot key grows the frame rather than overflowing it,
+    and the all_to_all payload shrinks from ndev*local_n to ndev*cap
+    when the hash is balanced. probe_vals may be one array or a list
+    (multi-agg); returns (sums[n_groups] per val, counts[n_groups])
+    replicated."""
     ndev = mesh.devices.size
     single = not isinstance(probe_vals, (list, tuple))
     pvals = [probe_vals] if single else list(probe_vals)
     nvals = len(pvals)
+    if cap is None:
+        cap = _round_capacity(max(
+            _shuffle_capacity(probe_keys, probe_valid, ndev),
+            _shuffle_capacity(build_keys, build_valid, ndev), 1))
 
     def exchange(keys, vals, ok):
         """Route rows to device (key % ndev) via one all_to_all each."""
         local_n = keys.shape[0]
-        cap = local_n  # per-peer slot budget
         dest = (keys % ndev).astype(jnp.int32)
         dest = jnp.where(ok, dest, ndev)        # invalid -> dropped bucket
         # stable sort rows by destination, slot i*cap..(i+1)*cap per peer
