@@ -14,15 +14,15 @@ x = jnp.ones((256,256), jnp.bfloat16)
 np.asarray(x @ x)
 print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
     echo "$(date +%H:%M:%S) TPU LIVE — quick bench" >> "$LOG"
-    BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 BENCH_SF=1 \
-      BENCH_QUERIES=q1,q3,q5,q6 BENCH_REPEATS=3 \
+    BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 \
+      BENCH_SF=1 BENCH_QUERIES=q1,q3,q5,q6 BENCH_REPEATS=3 \
       timeout 1800 python bench.py > /tmp/bench_quick_try.json 2>>"$LOG"
     if grep -q '"backend": "tpu"' /tmp/bench_quick_try.json 2>/dev/null; then
       cp /tmp/bench_quick_try.json /root/repo/BENCH_TPU_quick.json
       echo "$(date +%H:%M:%S) quick TPU bench SAVED" >> "$LOG"
       echo "$(date +%H:%M:%S) full bench start" >> "$LOG"
-      BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=240 BENCH_SF=1 \
-        timeout 5400 python bench.py > /tmp/bench_full_try.json 2>>"$LOG"
+      BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=240 \
+        BENCH_SF=1 timeout 5400 python bench.py > /tmp/bench_full_try.json 2>>"$LOG"
       if grep -q '"backend": "tpu"' /tmp/bench_full_try.json 2>/dev/null; then
         cp /tmp/bench_full_try.json /root/repo/BENCH_TPU_full.json
         echo "$(date +%H:%M:%S) full TPU bench SAVED — exiting" >> "$LOG"
