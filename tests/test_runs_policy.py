@@ -35,8 +35,10 @@ def test_tpch_headline_runs_vs_host(tk, runs_impl, q):
     tk.domain.copr.use_device = True
     dev = tk.must_query(QUERIES[q]).rows
     tk.domain.copr.use_device = False
-    host = tk.must_query(QUERIES[q]).rows
-    tk.domain.copr.use_device = True
+    try:
+        host = tk.must_query(QUERIES[q]).rows
+    finally:
+        tk.domain.copr.use_device = True
     assert len(dev) == len(host)
     for rd, rh in zip(dev, host):
         for a, b in zip(rd, rh):

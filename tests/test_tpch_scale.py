@@ -37,6 +37,50 @@ def test_device_vs_host_at_scale(tk, q):
     assert dev == host, (q, dev[:3], host[:3])
 
 
+# Expected device placement per TPC-H query (VERDICT r2 items 2/8: pin
+# routing so a silent device->host regression fails CI, reference
+# pkg/util/execdetails). "fused" = the agg-over-join tree ran as one
+# fused device pipeline; "scan" = no join to fuse (q1/q6) or the join
+# is a few-row residual over device-computed aggs (q15/q20) — the heavy
+# scans/aggs still run as device copr kernels.
+EXPECTED_ROUTING = {
+    "q1": "scan", "q2": "fused", "q3": "fused", "q4": "fused",
+    "q5": "fused", "q6": "scan", "q7": "fused", "q8": "fused",
+    "q9": "fused", "q10": "fused", "q11": "fused", "q12": "fused",
+    "q13": "fused", "q14": "fused", "q15": "scan", "q16": "fused",
+    "q17": "fused", "q18": "fused", "q19": "fused", "q20": "scan",
+    "q21": "fused", "q22": "fused",
+}
+
+
+def test_tpch_device_routing_pinned(tk):
+    """Every TPC-H query executes its heavy operators on the device:
+    18/22 through the fused join pipeline, the rest as device scan/agg
+    kernels. Zero fused-pipeline errors and zero host copr scans across
+    the suite — a broken device kernel must fail here, not silently
+    degrade to a slower host query."""
+    m = tk.domain.metrics
+    got, problems = {}, []
+    for q in sorted(ALL_QUERIES, key=lambda s: int(s[1:])):
+        before = dict(m)
+        tk.must_query(ALL_QUERIES[q])
+        d = {k: m.get(k, 0) - before.get(k, 0) for k in m}
+        fused = d.get("fused_pipeline_hit", 0) + \
+            d.get("fused_pipeline_mpp_hit", 0)
+        device = d.get("copr_device_exec", 0) + d.get("copr_mpp_exec", 0)
+        got[q] = "fused" if fused else ("scan" if device else "host")
+        if d.get("fused_pipeline_error", 0):
+            problems.append(f"{q}: fused_pipeline_error")
+        if d.get("fused_pipeline_fallback", 0):
+            problems.append(f"{q}: fused_pipeline_fallback")
+        if d.get("copr_host_exec", 0):
+            problems.append(f"{q}: copr_host_exec={d['copr_host_exec']}")
+    assert got == EXPECTED_ROUTING, {
+        q: (got[q], EXPECTED_ROUTING[q]) for q in got
+        if got[q] != EXPECTED_ROUTING[q]}
+    assert not problems, problems
+
+
 def test_boundaries_crossed(tk):
     """The scale run must have exercised the paths the small oracle
     can't: fused pipeline hits and >1024-group sort aggs (bucket

@@ -117,6 +117,8 @@ class CoprExecutor:
                                       self._overlay_handles])
         if not self.use_device or dag.table_info.id <= -1000 or \
                 not _dag_device_ready(dag):
+            if dag.table_info.id > -1000:
+                self._bump("copr_host_exec")
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
         if use_mpp and (dag.aggs or dag.group_items) and not overlay \
                 and not dag.host_filters \
@@ -127,8 +129,18 @@ class CoprExecutor:
             except Exception:               # noqa: BLE001
                 res = None                  # single-chip path always works
             if res is not None:
+                self._bump("copr_mpp_exec")
                 return res
+        self._bump("copr_device_exec")
         return self._execute_device(dag, tbl, arrays, valid, n, handles)
+
+    def _bump(self, name):
+        """Routing metrics (reference pkg/util/execdetails): which copr
+        backend actually ran — the observable the golden routing tests
+        pin so a silent device->host regression fails CI."""
+        dom = getattr(self, "domain", None)
+        if dom is not None:
+            dom.inc_metric(name)
 
     def _apply_overlay(self, dag, tbl, arrays, valid, n, overlay):
         valid = valid.copy()

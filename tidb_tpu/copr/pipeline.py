@@ -364,7 +364,7 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
     return args, layout
 
 
-def _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd):
+def _fused_topn_state(copr, plan, fact_tbl, offk, kd, sd):
     """Validate the planner's topn_spec against runtime state ->
     spec tuple or None. Device-side top-k over per-run partials is
     exact only when every group lives in at most one partial per
@@ -378,7 +378,7 @@ def _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd):
       the kernel's top-k and the host safety check — float metrics
       would risk ulp-level disagreement at the cut boundary)."""
     spec = getattr(plan, "topn_spec", None)
-    if spec is None or copr._host_cache.get(("ftopn_off",) + gbkey):
+    if spec is None or copr._host_cache.get(offk):
         return None
     kind, ai, desc, k_total = spec
     from ..expression import Column
@@ -448,6 +448,11 @@ def _topn_metric_host(spec, aggs, keys, key_nulls, states):
         nul = (np.asarray(st[-1]) == 0) if aggs[ai].name != "count" \
             else np.zeros(len(v), dtype=bool)
     m = v if desc else ~v      # ~v = -v-1: wrap-free order reversal
+    # reserve the sentinel ranges: +-(I64_MAX-1).. are taken by the
+    # null/empty/forced-boundary markers below and in _topn_select; a
+    # metric at int64 extremes clamps, the resulting tie degrades into
+    # the coverage check's safe (off) verdict rather than colliding
+    m = np.clip(m, -_I64_MAX + 2, _I64_MAX - 2)
     # MySQL null ordering: first on ASC (best), last on DESC (worst)
     return np.where(nul, (-_I64_MAX) if desc else (_I64_MAX - 1), m)
 
@@ -470,6 +475,7 @@ def _topn_select(res, aggs, topn, bucket):
         nul = (st[-1] == 0) if aggs[ai].name != "count" \
             else jnp.zeros(v.shape, dtype=bool)
     m = v if desc else ~v      # ~v = -v-1: wrap-free order reversal
+    m = jnp.clip(m, -_I64_MAX + 2, _I64_MAX - 2)   # keep sentinels unique
     m = jnp.where(nul, (-_I64_MAX) if desc else (_I64_MAX - 1), m)
     iota = jnp.arange(bucket)
     m = jnp.where(iota < ng, m, -_I64_MAX - 1)
@@ -833,11 +839,13 @@ def fused_partials(copr, plan, read_ts, mesh=None,
              tuple(g.fingerprint() for g in plan.group_items),
              tuple(a.fingerprint() for a in plan.aggs))
     group_bucket = max(1024, copr._host_cache.get(gbkey, 0))
-    implk = ("aggimpl",) + gbkey
-    offk = ("ftopn_off",) + gbkey
+    # pins are per gc-epoch: a compaction that restores clustering lets
+    # a shape re-try the runs lowering / device top-N it had pinned off
+    implk = ("aggimpl", fact_tbl.gc_epoch) + gbkey
+    offk = ("ftopn_off", fact_tbl.gc_epoch) + gbkey
     ts = None
     if mesh is None:
-        ts = _fused_topn_state(copr, plan, fact_tbl, gbkey, kd, sd)
+        ts = _fused_topn_state(copr, plan, fact_tbl, offk, kd, sd)
     if mesh is not None:
         return _run_fused_mpp(
             copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
@@ -866,7 +874,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 # runs 0 and ngroups-1, which _topn_select forces into
                 # the candidate set. sorted/scatter order groups by
                 # key rank, where the edge groups can sit anywhere.
+                # the coverage proof needs >= k complete groups strictly
+                # above the candidate min: with group_bucket < k+2 it can
+                # never pass, so don't burn a kernel compile + permanent
+                # off-pin on a shape that cannot verify
                 if ts is not None and agg_impl == "runs" and \
+                        group_bucket >= ts[3] + 2 and \
                         not copr._host_cache.get(offk):
                     topn_k = (ts[0], ts[1], ts[2],
                               min(ts[3] + 66, group_bucket))
@@ -1108,8 +1121,8 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         elif sizes is not None:
             agg_kind, agg_param = "dense", tuple(sizes)
         else:
-            agg_impl = copr._host_cache.get(("aggimpl",) + gbkey) or \
-                _segment_impl()
+            agg_impl = copr._host_cache.get(
+                ("aggimpl", fact_tbl.gc_epoch) + gbkey) or _segment_impl()
             agg_kind, agg_param = "sort", (group_bucket, agg_impl, None)
         key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, local,
                                tuple(dim_caps), tuple(dim_ns),
@@ -1134,7 +1147,8 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                 ng_max > max(_de._RUNS_DEGRADE_MIN, local // 4):
             # unclustered group keys on this shard layout: pin to the
             # sorted lowering before learning an inflated bucket
-            copr._host_cache[("aggimpl",) + gbkey] = "sorted"
+            copr._host_cache[("aggimpl", fact_tbl.gc_epoch) + gbkey] = \
+                "sorted"
             continue
         if ng_max > group_bucket:
             group_bucket = shape_bucket(ng_max)

@@ -929,6 +929,31 @@ def _fusable_leaf(p):
                 dag.table_info.id < 0)
 
 
+def _bpg_to_reader(p):
+    """Re-open a BatchPointGet as a plain scan with a device-safe
+    `pk IN (consts)` filter so it can serve as a fused-pipeline dim
+    (Q18: `o_orderkey in (<plan-time subquery result>)` picks the
+    point-get access path, but inside an agg-over-join tree the fused
+    kernel wants a scan leaf — the IN mask evaluates on device and the
+    columnar scan reuses the HBM-resident buffers, so the handle list
+    costs one fused filter instead of a host lookup join)."""
+    tbl = p.table_info
+    pk_name = (tbl.pk_col_name or "").lower()
+    pk_sc = next((sc for sc in p.cols if sc.name == pk_name), None)
+    if pk_sc is None or not p.handles:
+        return None
+    cond = ScalarFunc("in", [pk_sc.col] + list(p.handles),
+                      new_bigint_type())
+    if not is_device_safe(cond):
+        return None
+    dag = CoprDAG(table_info=tbl, db_name=p.db_name, cols=list(p.cols),
+                  filters=[cond])
+    rd = PhysTableReader(dag, Schema(list(p.cols)))
+    rd.stats_rows = p.stats_rows
+    rd.raw_rows = p.stats_rows
+    return rd
+
+
 def _collect_join_tree(p, leaves, eqs, filters, outer_dims):
     """Flatten a join tree into leaves + eq pairs + residual filters.
     Inner joins flatten freely; LEFT/SEMI joins whose non-preserved side
@@ -1000,6 +1025,12 @@ def _collect_join_tree(p, leaves, eqs, filters, outer_dims):
                                    p))
                 return _collect_join_tree(p.children[0], leaves, eqs,
                                           filters, outer_dims)
+        return False
+    if isinstance(p, PhysBatchPointGet):
+        rd = _bpg_to_reader(p)
+        if rd is not None:
+            leaves.append(rd)
+            return True
         return False
     if _fusable_leaf(p):
         leaves.append(p)

@@ -44,6 +44,9 @@ class ColumnarTable:
         self.n = 0
         self.cap = 0
         self.version = 0          # bumped on every mutation batch
+        self.gc_epoch = 0         # bumped only by gc() compaction: host
+        # caches that pinned an optimization OFF for unclustered/tie-heavy
+        # data retry after a reorganization restores clustering
         self.data: dict[int, np.ndarray] = {}    # col_id -> array
         self.nulls: dict[int, np.ndarray] = {}
         self.dicts: dict[int, StringDict] = {}
@@ -271,6 +274,7 @@ class ColumnarTable:
         self.delete_ts[:m] = self.delete_ts[idx]
         self.n = m
         self._clustered.clear()    # rows moved: re-verify from scratch
+        self.gc_epoch += 1
         self.handle_pos = {}
         live = self.delete_ts[:m] == 0
         for i in np.nonzero(live)[0].tolist():
