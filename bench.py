@@ -194,9 +194,15 @@ def main():
         return
     if os.environ.get("BENCH_MODE") == "htap":
         return htap_main(live)
-    sf = float(os.environ.get("BENCH_SF", "1"))
+    # default scale: SF10 on a live chip (BASELINE stage 3-4 territory);
+    # SF1 on CPU fallback so a missing grant still records a full
+    # 22-query artifact instead of timing out mid-run
+    sf = float(os.environ.get("BENCH_SF", "10" if live else "1"))
     qenv = os.environ.get("BENCH_QUERIES", "all")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    # the single-threaded numpy baseline can take minutes/query at SF10;
+    # cap total baseline time so it can't starve the device measurement
+    cpu_budget = float(os.environ.get("BENCH_CPU_BUDGET", "900"))
 
     from tidb_tpu.testkit import TestKit
     from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
@@ -214,11 +220,12 @@ def main():
     n_rows = tk.domain.columnar.tables[li.id].live_count()
     print(f"# lineitem rows={n_rows} load={load_s:.1f}s", file=sys.stderr)
 
-    def run(q, use_device):
+    def run(q, use_device, n_runs=None, warmup=True):
         tk.domain.copr.use_device = use_device
-        tk.must_query(ALL_QUERIES[q])       # warmup (compile)
+        if warmup:
+            tk.must_query(ALL_QUERIES[q])   # warmup (compile)
         best = math.inf
-        for _ in range(repeats):
+        for _ in range(n_runs if n_runs is not None else repeats):
             t = time.time()
             tk.must_query(ALL_QUERIES[q])
             best = min(best, time.time() - t)
@@ -227,6 +234,7 @@ def main():
     speedups = []
     per_query = {}
     tpu_times = {}
+    cpu_spent = 0.0
     for q in queries:
         try:
             t_tpu = run(q, True)
@@ -234,8 +242,18 @@ def main():
             print(f"# {q}: DEVICE PATH ERROR {e}", file=sys.stderr)
             per_query[q] = {"error": str(e)[:120]}
             continue
+        if cpu_spent > cpu_budget:
+            per_query[q] = {"ms": round(t_tpu * 1000, 1),
+                            "cpu_skipped": "baseline budget exhausted",
+                            "backend": "tpu" if live else "cpu"}
+            tpu_times[q] = t_tpu
+            continue
         try:
-            t_cpu = run(q, False)
+            t0 = time.time()
+            # no compile on the host path: one un-warmed run per query,
+            # so the budget covers as many queries as possible
+            t_cpu = run(q, False, n_runs=1, warmup=False)
+            cpu_spent += time.time() - t0
         except Exception as e:                      # noqa: BLE001
             print(f"# {q}: CPU BASELINE ERROR {e}", file=sys.stderr)
             per_query[q] = {"ms": round(t_tpu * 1000, 1),

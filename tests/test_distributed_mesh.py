@@ -1,0 +1,165 @@
+"""Multi-host SPMD mesh (VERDICT r2 item 4): two real processes form ONE
+jax process group (jax.distributed.initialize + gloo CPU collectives),
+each binds its LOCAL store shard into a global mesh array
+(make_array_from_single_device_arrays), the coordinator broadcasts the
+pickled CoprDAG (the DispatchMPPTask seam, reference copr/mpp.go:94),
+and both hosts launch the IDENTICAL collective program — the exchange
+is a psum/all_to_all over the process group, not an RPC stream.
+
+Covers: global agg fragment, grouped (dense-psum) fragment, and the
+hash-shuffle join with a 90%-hot-key skew across hosts."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    procs, ports = [], []
+    env = dict(os.environ, TIDB_TPU_PLATFORM="cpu", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    for _ in range(2):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True)
+        line = p.stdout.readline().strip()
+        assert line.startswith("WORKER_READY"), line
+        ports.append(int(line.split()[1]))
+        procs.append(p)
+    from tidb_tpu.cluster import Cluster
+    cl = Cluster(ports)
+    outs = cl.spmd_init(port=17843)
+    # 2 processes x 2 virtual devices = one 4-device global mesh
+    assert all(o["global_devices"] == 4 for o in outs), outs
+    assert all(o["local_devices"] == 2 for o in outs), outs
+    yield cl
+    cl.stop()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+ROWS = 600
+
+
+def _rows(seed=11):
+    rng = np.random.RandomState(seed)
+    k = rng.randint(0, 100, ROWS)
+    g = rng.randint(0, 8, ROWS)
+    v = rng.randint(0, 1000, ROWS)
+    return k, g, v
+
+
+@pytest.fixture(scope="module")
+def loaded(cluster):
+    k, g, v = _rows()
+    cluster.ddl("create table t (id int primary key, k int, g int, "
+                "v int)")
+    for w in range(2):
+        sl = slice(w * ROWS // 2, (w + 1) * ROWS // 2)
+        vals = ",".join(
+            f"({i + 1},{k[i]},{g[i]},{v[i]})"
+            for i in range(sl.start, sl.stop))
+        cluster.workers[w].call(
+            {"op": "load_sql", "sqls": [f"insert into t values {vals}"]})
+    return cluster
+
+
+def _scalar(x):
+    a = np.asarray(x).ravel()
+    assert a.size == 1, a.shape
+    return int(a[0])
+
+
+def test_spmd_global_agg_fragment(loaded):
+    """Broadcast DAG, per-host shard binding, psum exchange: the global
+    SUM/COUNT over both hosts' shards equals the host oracle, and every
+    host returned the identical replicated result."""
+    k, g, v = _rows()
+    res = loaded.spmd_agg("select sum(v), count(*) from t where k < 50")
+    m = k < 50
+    assert _scalar(res["sums"][0]) == int(v[m].sum())
+    assert _scalar(res["sums"][1]) == int(m.sum())
+    assert _scalar(res["counts"]) == int(m.sum())
+
+
+def test_spmd_grouped_fragment(loaded):
+    """Dense-psum grouped fragment across hosts (Q1 class)."""
+    k, g, v = _rows()
+    res = loaded.spmd_agg("select g, sum(v) from t group by g",
+                          n_groups=8)
+    want = np.zeros(8, dtype=np.int64)
+    np.add.at(want, g, v)
+    assert res["sums"][0].tolist() == want.tolist()
+    cnt = np.zeros(8, dtype=np.int64)
+    np.add.at(cnt, g, 1)
+    assert res["counts"].tolist() == cnt.tolist()
+
+
+def test_spmd_shuffle_join_hot_key_across_hosts(loaded):
+    """Hash-exchange join fragment across the process group with 90% of
+    probe rows on one key: the all_to_all frames are sized by the
+    coordinator-computed capacity, so the hot host receives every row
+    (no silent drop) and both hosts agree on the exact group counts."""
+    from tidb_tpu.mpp.exec import _shuffle_capacity, _round_capacity
+    rng = np.random.RandomState(77)
+    n, nd, n_groups = 512, 64, 7
+    hot = 13
+    pk = np.where(rng.rand(n) < 0.9, hot,
+                  rng.randint(0, nd, size=n)).astype(np.int64)
+    pv = rng.randint(0, 100, size=n).astype(np.int64)
+    pok = rng.rand(n) < 0.95
+    bk = np.arange(nd, dtype=np.int64)
+    bp = rng.randint(0, n_groups, size=nd).astype(np.int64)
+    bok = np.ones(nd, dtype=bool)
+    ndev = 4
+    cap = _round_capacity(max(_shuffle_capacity(pk, pok, ndev),
+                              _shuffle_capacity(bk, bok, ndev), 1))
+    half, bhalf = n // 2, nd // 2
+
+    def call(i, w):
+        arrs = {"pk": pk[i * half:(i + 1) * half],
+                "pv": pv[i * half:(i + 1) * half],
+                "pok": pok[i * half:(i + 1) * half],
+                "bk": bk[i * bhalf:(i + 1) * bhalf],
+                "bp": bp[i * bhalf:(i + 1) * bhalf],
+                "bok": bok[i * bhalf:(i + 1) * bhalf]}
+        return w.call({"op": "spmd_shuffle", "local_cap": half,
+                       "local_cap_build": bhalf,
+                       "n_groups": n_groups, "cap": cap}, arrs)
+    outs = loaded._fanout(call)
+    want_s = np.zeros(n_groups, dtype=np.int64)
+    want_c = np.zeros(n_groups, dtype=np.int64)
+    payload_of = {int(kk): int(gg) for kk, gg in zip(bk, bp)}
+    for kk, vv, ok in zip(pk, pv, pok):
+        if ok and int(kk) in payload_of:
+            want_s[payload_of[int(kk)]] += int(vv)
+            want_c[payload_of[int(kk)]] += 1
+    for _meta, arrs in outs:
+        assert arrs["counts"].tolist() == want_c.tolist()
+        assert arrs["sums"].tolist() == want_s.tolist()
+
+
+def test_spmd_after_update_version_rows(loaded):
+    """An UPDATE appends a new version row (physical rows > live rows):
+    the broadcast capacity must cover what snapshot() binds, and the
+    fragment must aggregate the NEW value only."""
+    k, g, v = _rows()
+    loaded.workers[0].call(
+        {"op": "load_sql", "sqls": ["update t set v = v + 1000 "
+                                    "where id = 1"]})
+    res = loaded.spmd_agg("select sum(v), count(*) from t where k < 50")
+    m = k < 50
+    want = int(v[m].sum()) + (1000 if m[0] else 0)
+    assert _scalar(res["sums"][0]) == want
+    assert _scalar(res["counts"]) == int(m.sum())

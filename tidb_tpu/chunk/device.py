@@ -52,19 +52,37 @@ class StringDict:
         self.sort_keys = None  # lazily computed rank array for ordered compares
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
-        """Encode an object array of strings to int32 codes, extending dict."""
-        codes = np.empty(len(arr), dtype=np.int32)
+        """Encode an object array of strings to int32 codes, extending dict.
+        Unique-first: the O(n log n) dedup runs in C, the Python dict is
+        touched once per DISTINCT value (bulk loads repeat values
+        heavily; the all-distinct case degenerates to one dict op per
+        row, same as the naive loop)."""
         idx = self.index
         vals = self.values
-        for i, s in enumerate(arr):
+        try:
+            uniq, inv = np.unique(np.asarray(arr, dtype=object),
+                                  return_inverse=True)
+        except TypeError:        # non-comparable mixed types: row loop
+            codes = np.empty(len(arr), dtype=np.int32)
+            for i, s in enumerate(arr):
+                c = idx.get(s)
+                if c is None:
+                    c = len(vals)
+                    idx[s] = c
+                    vals.append(s)
+                    self.sort_keys = None
+                codes[i] = c
+            return codes
+        m = np.empty(len(uniq), dtype=np.int32)
+        for j, s in enumerate(uniq):
             c = idx.get(s)
             if c is None:
                 c = len(vals)
                 idx[s] = c
                 vals.append(s)
                 self.sort_keys = None
-            codes[i] = c
-        return codes
+            m[j] = c
+        return m[inv].astype(np.int32, copy=False)
 
     def translate_codes(self, values: list, codes: np.ndarray) -> np.ndarray:
         """Codes minted against a FOREIGN dictionary (given as its value

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import socket
 
+import numpy as np
+
 from .rpc import send_msg, recv_msg, deserialize_partials
 
 
@@ -37,6 +39,29 @@ class Cluster:
         self.sess = Session(self.domain)
         self.sess.vars.current_db = "test"
 
+    def _fanout(self, fn):
+        """Run fn(i, worker) concurrently for every worker (independent
+        sockets); returns results in worker order, raising the first
+        error only after every thread joined."""
+        import threading
+        outs = [None] * len(self.workers)
+        errs = []
+
+        def run(i, w):
+            try:
+                outs[i] = fn(i, w)
+            except Exception as e:      # noqa: BLE001
+                errs.append(e)
+        ts = [threading.Thread(target=run, args=(i, w))
+              for i, w in enumerate(self.workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return outs
+
     def ddl(self, sql: str):
         self.sess.execute(sql)
         for w in self.workers:
@@ -58,7 +83,6 @@ class Cluster:
     def query_agg(self, sql: str):
         """Fan the aggregation fragment out to every worker, merge the
         partials locally, run the plan's post-agg operators."""
-        import threading
         from ..parser import parse
         from ..planner.optimize import optimize
         from ..planner.physical import PhysHashAgg
@@ -71,24 +95,10 @@ class Cluster:
             node = node.children[0] if node.children else None
         if node is None:
             raise ValueError("query has no aggregation fragment")
-        # fan out in parallel (independent sockets), merge with ONE set
-        # of shared dictionaries so codes stay comparable across workers
-        results = [None] * len(self.workers)
-        errs = []
-
-        def fetch(i, w):
-            try:
-                results[i] = w.call({"op": "partial", "sql": sql})
-            except Exception as e:          # noqa: BLE001
-                errs.append(e)
-        threads = [threading.Thread(target=fetch, args=(i, w))
-                   for i, w in enumerate(self.workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errs:
-            raise errs[0]
+        # fan out in parallel, merge with ONE set of shared dictionaries
+        # so codes stay comparable across workers
+        results = self._fanout(
+            lambda i, w: w.call({"op": "partial", "sql": sql}))
         partials = []
         shared_dicts: dict = {}
         for out, arrs in results:
@@ -159,6 +169,69 @@ class Cluster:
             for i in range(len(c)):
                 rows.append(c.row_py(i))
         return rows
+
+    def spmd_init(self, port: int = 17841):
+        """Form the jax process group: worker i = process i of one
+        global mesh (worker 0 hosts the group coordinator service).
+        initialize() blocks until every peer joins, so the calls fan
+        out in parallel threads. Returns per-worker device counts."""
+        coord = f"127.0.0.1:{port}"
+        outs = [o for o, _ in self._fanout(
+            lambda i, w: w.call({"op": "spmd_init", "coordinator": coord,
+                                 "nproc": len(self.workers),
+                                 "pid": i}))]
+        self._spmd_local_devices = [o["local_devices"] for o in outs]
+        return outs
+
+    def spmd_agg(self, sql: str, n_groups=None):
+        """Plan locally, extract the pushed scan->filter->partial-agg
+        CoprDAG, broadcast it (pickled — the tipb.DAGRequest analog) to
+        every host, and launch the collective fragment: one SPMD
+        program over the global mesh, psum as the exchange. Returns
+        {"sums": [...], "counts": ...} (replicated; worker 0's copy),
+        and asserts every host returned the same result — the SPMD
+        invariant made observable."""
+        import math
+        import pickle
+        from ..parser import parse
+        from ..planner.optimize import optimize
+        from ..planner.physical import PhysTableReader
+        stmt = parse(sql)[0]
+        plan = optimize(stmt, self.sess._plan_ctx())
+        node, stack = None, [plan]
+        while stack:
+            p = stack.pop()
+            if isinstance(p, PhysTableReader) and p.dag.aggs:
+                node = p
+                break
+            stack.extend(p.children)
+        if node is None:
+            raise ValueError("no pushed partial-agg fragment in plan")
+        dag = node.dag
+        # one static per-host row capacity: max PHYSICAL rows over
+        # workers (snapshot() binds closed version rows too, so the
+        # live count would under-size after updates/deletes), rounded
+        # to the lcm of local device counts
+        tname = dag.table_info.name
+        rows = [o["rows"] for o, _ in self._fanout(
+            lambda i, w: w.call({"op": "table_rows", "table": tname,
+                                 "db": dag.db_name or "test"}))]
+        lcm = 1
+        for ld in getattr(self, "_spmd_local_devices",
+                          [1] * len(self.workers)):
+            lcm = lcm * ld // math.gcd(lcm, ld)
+        cap = -(-max(max(rows), 1) // lcm) * lcm
+        blob = np.frombuffer(pickle.dumps(dag), dtype=np.uint8)
+        outs = self._fanout(
+            lambda i, w: w.call({"op": "spmd_frag", "local_cap": cap,
+                                 "n_groups": n_groups}, {"dag": blob}))
+        ref_meta, ref = outs[0]
+        for meta, arrs in outs[1:]:
+            for k in ref:
+                assert np.array_equal(ref[k], arrs[k]), \
+                    f"SPMD divergence on {k}"
+        return {"sums": [ref[f"s{i}"] for i in range(ref_meta["nsums"])],
+                "counts": ref["counts"]}
 
     def query(self, sql: str, worker=0):
         out, _ = self.workers[worker].call({"op": "query", "sql": sql})

@@ -82,6 +82,14 @@ class WorkerServer:
             partials = self._partials(msg["sql"])
             meta, arrs = serialize_partials(partials)
             return {"ok": True, **meta}, arrs
+        if op == "table_rows":
+            # PHYSICAL row count (includes closed version rows): the
+            # SPMD row capacity must cover what snapshot() binds, not
+            # just the live rows
+            ti = self.domain.infoschema().table_by_name(
+                msg.get("db", "test"), msg["table"])
+            ctab = self.domain.columnar.table(ti)
+            return {"ok": True, "rows": int(ctab.n)}, {}
         if op == "tso":
             return {"ok": True,
                     "ts": self.domain.storage.oracle.get_ts()}, {}
@@ -111,6 +119,54 @@ class WorkerServer:
             rows = self.sess.execute(msg["sql"]).rows
             return {"ok": True, "rows": [list(map(_py, r))
                                          for r in rows]}, {}
+        if op == "spmd_init":
+            # join the jax process group: every worker becomes one host
+            # of a single global mesh (DISTRIBUTED.md section 1; the
+            # reference's "one MPP task per store" topology becomes one
+            # process per host in an SPMD program group). Blocks until
+            # all peers join — the coordinator fans these out in
+            # parallel.
+            from ..parallel.dist import init_distributed
+            init_distributed(msg["coordinator"], msg["nproc"],
+                             msg["pid"])
+            import jax
+            return {"ok": True, "global_devices": len(jax.devices()),
+                    "local_devices": len(jax.local_devices())}, {}
+        if op == "spmd_frag":
+            # coordinator-broadcast CoprDAG (the DispatchMPPTask seam,
+            # copr/mpp.go:94): deserialize the fragment, bind the LOCAL
+            # store shard into the global mesh, launch the identical
+            # XLA program on every host.
+            import pickle
+            from ..parallel.dist import global_mesh
+            from ..mpp.spmd import run_dag_spmd
+            dag = pickle.loads(arrays["dag"].tobytes())
+            mesh = global_mesh()
+            out = run_dag_spmd(self.domain, dag, mesh,
+                               int(msg["local_cap"]),
+                               msg.get("n_groups"))
+            arrs = {f"s{i}": np.asarray(a)
+                    for i, a in enumerate(out["sums"])}
+            arrs["counts"] = np.asarray(out["counts"])
+            return {"ok": True, "nsums": len(out["sums"])}, arrs
+        if op == "spmd_shuffle":
+            # hash-exchange join fragment across hosts: both sides bound
+            # per-host, all_to_all rides the process group; `cap` (the
+            # per-peer frame size, skew-safe by construction) comes from
+            # the coordinator so every host traces the same program.
+            from ..parallel.dist import global_mesh, bind_host_rows
+            from ..mpp.exec import mpp_shuffle_join_agg
+            mesh = global_mesh()
+            lc = int(msg["local_cap"])
+            lb = int(msg["local_cap_build"])
+            b = lambda name, cap: bind_host_rows(    # noqa: E731
+                mesh, arrays[name], cap)
+            sums, cnts = mpp_shuffle_join_agg(
+                mesh, b("pk", lc), b("pv", lc), b("pok", lc),
+                b("bk", lb), b("bp", lb), b("bok", lb),
+                n_groups=int(msg["n_groups"]), cap=int(msg["cap"]))
+            return {"ok": True}, {"sums": np.asarray(sums),
+                                  "counts": np.asarray(cnts)}
         if op == "lease":
             # owner-election authority (PD role; reference
             # owner/manager.go etcd campaign)

@@ -11,6 +11,8 @@ writes no per-row KV; such tables serve the OLAP path.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..chunk.column import Column, py_to_datum_fast
@@ -53,7 +55,8 @@ class ColumnarTable:
         self.handles = np.empty(0, dtype=np.int64)
         self.insert_ts = np.empty(0, dtype=np.int64)
         self.delete_ts = np.empty(0, dtype=np.int64)
-        self.handle_pos: dict[int, int] = {}
+        self._hpos: dict[int, int] | None = {}
+        self._hpos_mu = threading.Lock()   # serializes lazy rebuilds
         self.bulk_rows = 0           # rows without row-KV/index entries
         # cid -> [rows_checked, still_clustered]: lazy monotone-order
         # tracker behind is_clustered()
@@ -132,6 +135,33 @@ class ColumnarTable:
             setattr(self, attr, na)
         self.cap = new_cap
 
+    @property
+    def handle_pos(self) -> dict:
+        """handle -> position of its NEWEST version row (which may be a
+        closed/deleted version; readers check delete_ts themselves).
+        Later rows win in storage order, so last-occurrence via
+        dict(zip) reproduces the incrementally-maintained mapping.
+        Invalidated (None) by bulk_append/gc, rebuilt on first access.
+        The rebuild is double-check-locked: concurrent readers must not
+        each build and publish their own dict, or a committer's
+        incremental `handle_pos[h] = pos` written into the losing copy
+        would vanish (rows are immutable once written and self.n is
+        bumped after the row data, so a locked rebuild always sees a
+        consistent prefix)."""
+        hp = self._hpos
+        if hp is None:
+            with self._hpos_mu:
+                hp = self._hpos
+                if hp is None:
+                    hp = dict(zip(self.handles[:self.n].tolist(),
+                                  range(self.n)))
+                    self._hpos = hp
+        return hp
+
+    @handle_pos.setter
+    def handle_pos(self, v):
+        self._hpos = v
+
     # ---- mutations ----------------------------------------------------
     def put_row(self, handle: int, datums: list, commit_ts: int = 1):
         """Insert/overwrite one row; an existing version is closed at
@@ -203,8 +233,9 @@ class ColumnarTable:
         self.handles[start:start + n] = handles
         self.insert_ts[start:start + n] = commit_ts
         self.delete_ts[start:start + n] = 0
-        for i, h in enumerate(handles.tolist()):
-            self.handle_pos[h] = start + i
+        self._hpos = None     # rebuilt lazily on first point access: a
+        # bulk load of N rows must not pay N Python dict inserts when
+        # the workload never point-reads the table
         for ci in self.table_info.columns:
             src = columns.get(ci.name)
             arr = self.data[ci.id]
@@ -275,10 +306,7 @@ class ColumnarTable:
         self.n = m
         self._clustered.clear()    # rows moved: re-verify from scratch
         self.gc_epoch += 1
-        self.handle_pos = {}
-        live = self.delete_ts[:m] == 0
-        for i in np.nonzero(live)[0].tolist():
-            self.handle_pos[int(self.handles[i])] = i
+        self._hpos = None          # positions changed: lazy rebuild
         self.version += 1
         return ndead
 
