@@ -22,17 +22,21 @@ def cluster(tmp_path_factory):
     env = dict(os.environ, TIDB_TPU_PLATFORM="cpu",
                PYTHONPATH=REPO + os.pathsep + os.environ.get(
                    "PYTHONPATH", ""))
-    for _ in range(2):
+
+    def spawn():
         p = subprocess.Popen(
             [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             env=env, cwd=REPO, text=True)
         line = p.stdout.readline().strip()
         assert line.startswith("WORKER_READY"), line
-        ports.append(int(line.split()[1]))
         procs.append(p)
+        return int(line.split()[1])
+    for _ in range(2):
+        ports.append(spawn())
     from tidb_tpu.cluster import Cluster
-    cl = Cluster(ports)
+    cl = Cluster(ports, spawn_worker=spawn)
+    cl.procs = procs
     csv = str(tmp_path_factory.mktemp("data") / "li.csv")
     _csv(csv)
     cl.ddl(DDL)
@@ -149,3 +153,24 @@ def test_owner_election_over_rpc(cluster):
     assert b.campaign()
     assert store.holder("ddl-owner") == "coord-b"
     b.resign()
+
+
+def test_worker_death_recovers_and_query_completes(cluster):
+    """Storage fault path (VERDICT r2 item 9; reference
+    copr/coprocessor.go:525 retry + dxf rebalance off dead executors):
+    kill one worker, run an aggregation — the coordinator detects the
+    dead peer, spawns a replacement, replays DDL, reloads that shard
+    from the durable source, re-runs ONLY the lost fragment, and the
+    query returns the exact pre-failure answer. LAST in this module:
+    the replacement only restores DDL + bulk shards."""
+    sql = ("select discount, count(*), sum(quantity) from li "
+           "group by discount order by discount")
+    want = _oracle(cluster, sql)
+    victim = cluster.procs[1]
+    victim.kill()
+    victim.wait(timeout=30)
+    got = cluster.query_agg(sql)
+    assert [tuple(r) for r in got] == [tuple(r) for r in want]
+    # the replacement is a full member: serves follow-up queries
+    got2 = cluster.query_agg(sql)
+    assert [tuple(r) for r in got2] == [tuple(r) for r in want]

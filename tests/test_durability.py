@@ -228,3 +228,39 @@ def test_maxvalue_partition_forms():
     tk.must_exec("insert into mp values (5, 1), (500, 2)")
     assert tk.must_query("select v from mp where id = 500").rs.rows == \
         [(2,)]
+
+
+def test_ci_index_key_format_migration(tmp_path, monkeypatch):
+    """A store persisted BEFORE collation-aware index keys holds _ci
+    entries raw; the FORMAT-marker migration reindexes them once at
+    open so the folding read paths keep finding pre-existing rows."""
+    d = str(tmp_path / "old")
+    from tidb_tpu.executor import table_rt
+    # simulate the old engine: index keys written unfolded
+    monkeypatch.setattr(table_rt, "fold_ci_datums",
+                        lambda tbl, idx, datums: datums)
+    dom = new_store(d)
+    tk = _tk(dom)
+    tk.must_exec("create table m (id int primary key, "
+                 "name varchar(20) collate utf8mb4_general_ci, "
+                 "unique key un (name))")
+    tk.must_exec("insert into m values (1,'Beta'), (2,'Gamma')")
+    dom.storage.mvcc.wal.close()
+    monkeypatch.undo()
+    os.remove(os.path.join(d, "FORMAT"))    # pre-format-marker store
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    # folded probes find rows whose keys were written unfolded
+    tk2.must_query("select id from m where name = 'BETA'").check([(1,)])
+    tk2.must_query("select id from m where name = 'gamma '").check(
+        [(2,)])
+    # unique enforcement sees the migrated keys too
+    import pytest as _pytest
+    from tidb_tpu.errors import TiDBError
+    with _pytest.raises(Exception):
+        tk2.must_exec("insert into m values (3, 'beta')")
+    # second open: marker present, no re-migration needed
+    dom2.storage.mvcc.wal.close()
+    dom3 = new_store(d)
+    _tk(dom3).must_query("select id from m where name = 'BETA'").check(
+        [(1,)])

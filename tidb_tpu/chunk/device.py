@@ -44,12 +44,19 @@ def shape_bucket(n: int) -> int:
 class StringDict:
     """Per-column string dictionary: code <-> str, append-only."""
 
-    __slots__ = ("values", "index", "sort_keys", "_vec_cache")
+    __slots__ = ("values", "index", "sort_keys", "_vec_cache",
+                 "_ci_norm", "_ci_fold", "_ci_ranks", "_rank_codes")
 
     def __init__(self):
         self.values: list[str] = []
         self.index: dict[str, int] = {}
         self.sort_keys = None  # lazily computed rank array for ordered compares
+        # utf8mb4_general_ci support (reference pkg/util/collate):
+        # collation-aware key tables, host-computed per dict version
+        self._ci_norm = None   # code -> canonical code (same dict)
+        self._ci_fold = None   # (fold_codes, fold_dict)
+        self._ci_ranks = None  # code -> ci sort rank
+        self._rank_codes = None  # ((ci, n), (code_map, sorted dict))
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
         """Encode an object array of strings to int32 codes, extending dict.
@@ -111,12 +118,91 @@ class StringDict:
             out[i] = vals[c] if 0 <= c < len(vals) else None
         return out
 
+    @staticmethod
+    def ci_fold(s):
+        """utf8mb4_general_ci + PAD SPACE normal form: casefold, strip
+        trailing spaces (reference pkg/util/collate general_ci collator
+        with the pre-0900 PAD SPACE attribute)."""
+        return s.casefold().rstrip(" ") if isinstance(s, str) else s
+
+    def ci_norm_table(self) -> np.ndarray:
+        """code -> canonical code: the FIRST value sharing the ci+pad
+        normal form. Grouping/DISTINCT through this table merges
+        case/padding variants while still decoding to an original
+        representative (MySQL shows a witness row's value)."""
+        if self._ci_norm is None or len(self._ci_norm) != len(self.values):
+            seen: dict = {}
+            t = np.empty(max(len(self.values), 1), dtype=np.int64)
+            for i, v in enumerate(self.values):
+                f = self.ci_fold(v)
+                t[i] = seen.setdefault(f, i)
+            self._ci_norm = t[:len(self.values)] if self.values else t
+        return self._ci_norm
+
+    def ci_fold_codes(self):
+        """-> (codes, fold_dict): every value re-encoded by its normal
+        form into a dict OF normal forms — join keys translated by
+        VALUE then match across sides regardless of case/padding."""
+        if self._ci_fold is None or \
+                len(self._ci_fold[0]) != len(self.values):
+            fd = StringDict()
+            codes = np.array([fd.encode_one(self.ci_fold(v))
+                              for v in self.values] or [0],
+                             dtype=np.int64)
+            self._ci_fold = (codes, fd)
+        return self._ci_fold
+
+    def ci_ranks(self) -> np.ndarray:
+        """rank[code] under ci ordering: sorted by normal form, original
+        bytes as deterministic tiebreak."""
+        if self._ci_ranks is None or \
+                len(self._ci_ranks) != len(self.values):
+            keyed = sorted(range(len(self.values)),
+                           key=lambda i: (self.ci_fold(self.values[i])
+                                          if self.values[i] is not None
+                                          else "",
+                                          self.values[i] or ""))
+            ranks = np.empty(max(len(self.values), 1), dtype=np.int64)
+            for r, i in enumerate(keyed):
+                ranks[i] = r
+            self._ci_ranks = ranks[:len(self.values)] if self.values \
+                else ranks
+        return self._ci_ranks
+
+    def rank_codes(self, ci: bool = False):
+        """-> (code_map, rank_ordered_dict): values re-encoded into a
+        dict whose CODE ORDER equals the collation sort order, so
+        numeric MIN/MAX over the mapped codes is string MIN/MAX and the
+        result decodes through the new dict. Cached per dict version."""
+        key = (ci, len(self.values))
+        hit = self._rank_codes
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        ranks = self.ci_ranks() if ci else self.ranks()
+        sorted_dict = StringDict()
+        order = np.argsort(ranks[:len(self.values)]) if self.values \
+            else np.array([], dtype=np.int64)
+        for i in order.tolist():
+            sorted_dict.encode_one(self.values[i])
+        code_map = np.asarray(ranks[:len(self.values)]
+                              if self.values else [0], dtype=np.int64)
+        # keep only the LATEST version (same policy as the sibling
+        # _ci_* caches): stale per-length entries would leak O(n) each
+        self._rank_codes = (key, (code_map, sorted_dict))
+        return self._rank_codes[1]
+
     def ranks(self) -> np.ndarray:
         """rank[code] = position in sorted order — makes <,>,ORDER BY on
         dict codes a gather + int compare (collation sort keys precomputed
         on host; reference pkg/util/collate)."""
         if self.sort_keys is None or len(self.sort_keys) != len(self.values):
-            order = np.argsort(np.array(self.values, dtype=object), kind="stable")
+            # a None can be dict-encoded (e.g. a NULL branch of a UNION
+            # merged into a shared dict); it doesn't compare against str,
+            # and its rank never matters — readers order NULL rows via
+            # the null mask — so sort it as the empty string
+            vals = np.array([v if v is not None else "" for v in
+                             self.values], dtype=object)
+            order = np.argsort(vals, kind="stable")
             ranks = np.empty(len(self.values), dtype=np.int64)
             ranks[order] = np.arange(len(self.values))
             self.sort_keys = ranks

@@ -16,12 +16,41 @@ from .rpc import send_msg, recv_msg, deserialize_partials
 class _WorkerClient:
     def __init__(self, port):
         self.port = port
-        self.sock = socket.create_connection(("127.0.0.1", port),
+        self._connect()
+
+    def _connect(self):
+        self.sock = socket.create_connection(("127.0.0.1", self.port),
                                              timeout=60)
 
-    def call(self, msg, arrays=None):
-        send_msg(self.sock, msg, arrays)
-        out, arrs = recv_msg(self.sock)
+    # ops safe to blindly re-send after a reconnect: reads/TSO are
+    # idempotent, prewrite/commit are idempotent per start_ts
+    # (Percolator). load_sql/load_shard EXECUTE on the worker before
+    # the ack — a re-send would double rows or replay DDL, so they
+    # never auto-retry.
+    _IDEMPOTENT = {"partial", "query", "tso", "prewrite", "commit",
+                   "table_rows", "lease", "spmd_frag", "spmd_shuffle"}
+
+    def call(self, msg, arrays=None, retries=2):
+        """RPC with reconnect + exponential backoff on transport errors
+        (reference store/driver/backoff + copr region retry). A worker
+        that stays unreachable raises to the caller, which may replace
+        it (Cluster._recover_worker)."""
+        import time
+        if msg.get("op") not in self._IDEMPOTENT:
+            retries = 0
+        for attempt in range(retries + 1):
+            try:
+                send_msg(self.sock, msg, arrays)
+                out, arrs = recv_msg(self.sock)
+                break
+            except (ConnectionError, OSError):
+                if attempt == retries:
+                    raise
+                time.sleep(0.05 * (2 ** attempt))
+                try:
+                    self._connect()
+                except OSError:
+                    continue
         if "err" in out:
             raise RuntimeError(out["err"])
         return out, arrs
@@ -30,7 +59,7 @@ class _WorkerClient:
 class Cluster:
     """Coordinator session over N worker processes."""
 
-    def __init__(self, ports):
+    def __init__(self, ports, spawn_worker=None):
         from ..session import new_store, Session
         self.workers = [_WorkerClient(p) for p in ports]
         # local schema-only domain: plans are built here, data lives on
@@ -38,6 +67,13 @@ class Cluster:
         self.domain = new_store()
         self.sess = Session(self.domain)
         self.sess.vars.current_db = "test"
+        # recovery state (reference: stateless store nodes reload from
+        # durable storage; DXF rebalances subtasks off dead executors —
+        # dxf/framework/doc.go:30-33): the coordinator remembers enough
+        # to rebuild a worker's shard on a replacement process
+        self.spawn_worker = spawn_worker   # () -> port of a new worker
+        self._ddl_log: list = []
+        self._loads: list = []             # [(table, csv_path)]
 
     def _fanout(self, fn):
         """Run fn(i, worker) concurrently for every worker (independent
@@ -64,10 +100,12 @@ class Cluster:
 
     def ddl(self, sql: str):
         self.sess.execute(sql)
+        self._ddl_log.append(sql)
         for w in self.workers:
             w.call({"op": "load_sql", "sqls": [sql]})
 
     def load_shards(self, table: str, csv_path: str):
+        self._loads.append((table, csv_path))
         total = 0
         for i, w in enumerate(self.workers):
             out, _ = w.call({"op": "load_shard", "table": table,
@@ -75,6 +113,23 @@ class Cluster:
                              "nshards": len(self.workers)})
             total += out["rows"]
         return total
+
+    def _recover_worker(self, i):
+        """Replace dead worker i: spawn a fresh process, replay the DDL
+        log, reload its shard of every bulk load (the durable source of
+        the OLAP data — BR manifests play this role in production).
+        The recovered node then serves the same fragments."""
+        if self.spawn_worker is None:
+            return None
+        port = self.spawn_worker()
+        w = _WorkerClient(port)
+        if self._ddl_log:
+            w.call({"op": "load_sql", "sqls": list(self._ddl_log)})
+        for table, csv_path in self._loads:
+            w.call({"op": "load_shard", "table": table, "csv": csv_path,
+                    "shard": i, "nshards": len(self.workers)})
+        self.workers[i] = w
+        return w
 
     def tso(self, worker=0) -> int:
         out, _ = self.workers[worker].call({"op": "tso"})
@@ -96,9 +151,18 @@ class Cluster:
         if node is None:
             raise ValueError("query has no aggregation fragment")
         # fan out in parallel, merge with ONE set of shared dictionaries
-        # so codes stay comparable across workers
-        results = self._fanout(
-            lambda i, w: w.call({"op": "partial", "sql": sql}))
+        # so codes stay comparable across workers; a worker that died
+        # mid-query is replaced and ONLY its fragment re-runs
+        # (reference copr/coprocessor.go:525 retry loop per cop task)
+        def fetch(i, w):
+            try:
+                return w.call({"op": "partial", "sql": sql})
+            except OSError:
+                nw = self._recover_worker(i)
+                if nw is None:
+                    raise
+                return nw.call({"op": "partial", "sql": sql})
+        results = self._fanout(fetch)
         partials = []
         shared_dicts: dict = {}
         for out, arrs in results:

@@ -77,15 +77,11 @@ class TableReaderExec(Executor):
         tbl = self.dag.table_info
         if not tbl.partitions:
             return [self.dag]
-        from ..storage.partition import (prune_partitions,
-                                         partition_table_info)
+        from ..storage.partition import prune_for_dag, partition_table_info
         import dataclasses
-        col_name_of = {sc.col.idx: sc.name for sc in self.dag.cols}
-        pids = prune_partitions(tbl, self.dag.filters + self.dag.host_filters,
-                                col_name_of)
         return [dataclasses.replace(self.dag,
                                     table_info=partition_table_info(tbl, pid))
-                for pid in pids]
+                for pid in prune_for_dag(self.dag)]
 
     def next(self):
         if self.dag.aggs or self.dag.group_items:
@@ -325,16 +321,21 @@ class IndexRangeExec(Executor):
         sess = self.ctx.sess
         ci = tbl.find_column(index.columns[0])
         pref = index_prefix(tbl.id, index.id)
+        from .table_rt import fold_ci_datums
+
+        def probe_datum(e):
+            # _ci index KV stores the collation normal form: probe
+            # constants must fold the same way or exact matches miss
+            d = coerce_datum(expr_to_datum(e), ci.ft)
+            return fold_ci_datums(tbl, index, [d])[0]
         lo = pref
         if low is not None:
-            d = coerce_datum(expr_to_datum(low), ci.ft)
-            lo = pref + encode_datums_key([d])
+            lo = pref + encode_datums_key([probe_datum(low)])
             if not low_inc:
                 lo += b"\xff"
         hi = pref + b"\xff" * 9
         if high is not None:
-            d = coerce_datum(expr_to_datum(high), ci.ft)
-            hi = pref + encode_datums_key([d])
+            hi = pref + encode_datums_key([probe_datum(high)])
             hi = hi + (b"\xff" * 9 if high_inc else b"")
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
@@ -492,10 +493,21 @@ def _columnar_unique_probe(ctab, tbl, index, datums, read_ts):
             mask = mask & nulls
             continue
         if ci.id in ctab.dicts:
-            code = ctab.dicts[ci.id].lookup(str(d.val))
-            if code < 0:
-                return None
-            mask = mask & (arr == code) & ~nulls
+            from ..expression.vec import _is_ci
+            sd = ctab.dicts[ci.id]
+            if _is_ci(ci.ft):
+                # the query datum arrives FOLDED (fold_ci_datums):
+                # match any stored code sharing the normal form
+                codes, fd = sd.ci_fold_codes()
+                target = fd.lookup(str(d.val))
+                if target < 0:
+                    return None
+                mask = mask & (codes[arr] == target) & ~nulls
+            else:
+                code = sd.lookup(str(d.val))
+                if code < 0:
+                    return None
+                mask = mask & (arr == code) & ~nulls
         else:
             v = float(d.val) if arr.dtype == np.float64 else int(d.val)
             mask = mask & (arr == v) & ~nulls
@@ -518,7 +530,13 @@ def _row_matches_index(tbl, index, row, datums):
             if d.is_null != rd.is_null:
                 return False
             continue
-        if rd.val != d.val and str(rd.val) != str(d.val):
+        rv = rd.val
+        off_ci = tbl.columns[off]
+        if isinstance(rv, str):
+            from ..expression.vec import _is_ci
+            if _is_ci(off_ci.ft):
+                rv = StringDict.ci_fold(rv)  # probe datums arrive folded
+        if rv != d.val and str(rv) != str(d.val):
             return False
     return True
 
@@ -559,6 +577,8 @@ class PointGetExec(Executor):
             for e, cn in zip(plan.index_vals, plan.index.columns):
                 ci = tbl.find_column(cn)
                 datums.append(coerce_datum(expr_to_datum(e), ci.ft))
+            from .table_rt import fold_ci_datums
+            datums = fold_ci_datums(tbl, plan.index, datums)
             bctab = sess.domain.columnar.tables.get(tbl.id)
             if bctab is not None and bctab.bulk_rows:
                 # safety net (stale cached plan after IMPORT/restore):
@@ -753,9 +773,15 @@ def _sort_key_arrays(schema, chunk, items):
             data = np.full(n, data if not isinstance(data, str) else 0)
         data = np.asarray(data)
         if sdict is not None:
-            ranks = sdict.ranks()
+            from ..expression.vec import _is_ci
+            ranks = sdict.ci_ranks() if _is_ci(e.ft) else sdict.ranks()
             data = ranks[data]
         elif data.dtype == object:
+            if nm.any():
+                # raw Nones don't compare; any placeholder works — the
+                # null-order sentinel below overrides these positions
+                data = data.copy()
+                data[nm] = data[~nm][0] if (~nm).any() else 0
             order = np.argsort(data, kind="stable")
             r = np.empty(n, dtype=np.int64)
             r[order] = np.arange(n)

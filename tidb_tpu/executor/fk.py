@@ -46,8 +46,10 @@ def check_parent_exists(sess, txn, tbl, row):
         idx = next(i for i in parent.indexes if i.unique and
                    [c.lower() for c in i.columns] == fk["ref_cols"])
         from .exec_base import coerce_datum
-        pd = [coerce_datum(v, parent.find_column(c).ft)
-              for v, c in zip(vals, fk["ref_cols"])]
+        from .table_rt import fold_ci_datums
+        pd = fold_ci_datums(parent, idx,
+                            [coerce_datum(v, parent.find_column(c).ft)
+                             for v, c in zip(vals, fk["ref_cols"])])
         if txn.get(index_key(parent.id, idx.id, pd)) is None:
             raise FKViolationError(
                 "Cannot add or update a child row: a foreign key "
@@ -84,8 +86,10 @@ def on_parent_delete(sess, txn, parent_tbl, parent_db, row):
         if idx is None:
             continue
         from .exec_base import coerce_datum
-        cd = [coerce_datum(v, child.find_column(c).ft)
-              for v, c in zip(key_vals, fk["cols"])]
+        from .table_rt import fold_ci_datums
+        cd = fold_ci_datums(child, idx,
+                            [coerce_datum(v, child.find_column(c).ft)
+                             for v, c in zip(key_vals, fk["cols"])])
         pref = index_prefix(child.id, idx.id) + encode_datums_key(cd)
         hits = [(k, v) for k, v in txn.scan(pref, pref + b"\xff")]
         if not hits:

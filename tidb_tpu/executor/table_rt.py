@@ -26,9 +26,38 @@ def physical_id(tbl, row) -> int:
                            else int(d.val))
 
 
+def fold_ci_datums(tbl, idx, datums):
+    """Index keys store the utf8mb4_general_ci + PAD SPACE normal form
+    for _ci columns (reference pkg/util/collate collate.Key): unique
+    enforcement and index lookups then match case/padding variants,
+    while the row value keeps the original string. Applied on BOTH the
+    write path (_index_datums) and every read-side key construction."""
+    from ..types.field_type import TypeClass
+    from ..chunk.device import StringDict
+    from ..expression.vec import _is_ci
+    name_to_col = {c.name.lower(): c for c in tbl.columns}
+    out = list(datums)
+    for i, cname in enumerate(idx.columns):
+        ci = name_to_col.get(cname.lower())
+        d = out[i]
+        if ci is not None and d is not None and not d.is_null and \
+                ci.ft.tclass == TypeClass.STRING and _is_ci(ci.ft) and \
+                isinstance(d.val, (str, bytes)):
+            from ..types.datum import Datum
+            if isinstance(d.val, bytes):    # decoded index key datum
+                v = StringDict.ci_fold(
+                    d.val.decode("utf-8", "surrogateescape"))
+                v = v.encode("utf-8", "surrogateescape")
+            else:
+                v = StringDict.ci_fold(d.val)
+            out[i] = Datum(d.kind, v, d.scale)
+    return out
+
+
 def _index_datums(tbl, idx, row):
     name_to_off = {c.name.lower(): i for i, c in enumerate(tbl.columns)}
-    return [row[name_to_off[c.lower()]] for c in idx.columns]
+    return fold_ci_datums(
+        tbl, idx, [row[name_to_off[c.lower()]] for c in idx.columns])
 
 
 def _handle_bytes(h: int) -> bytes:

@@ -499,9 +499,10 @@ def _cmp_strings(ctx, expr, op_name, aval, bval):
     (a, an, ad), (b, bn, bd) = aval, bval
     ci = _is_ci(expr.args[0].ft) or _is_ci(expr.args[1].ft)
     if ci:
-        # case-insensitive: compare casefolded values via dict tables
-        def fold(s):
-            return s.casefold()
+        # case-insensitive + PAD SPACE: compare normal forms via dict
+        # tables ('beta ' = 'BETA' under utf8mb4_general_ci); ONE
+        # definition of the normal form lives on StringDict
+        fold = StringDict.ci_fold
         if isinstance(a, str) and isinstance(b, str):
             return (_cmp_core(xp, op_name, fold(a), fold(b)),
                     or_nulls(xp, an, bn), None)
@@ -949,6 +950,57 @@ def op_regexp(ctx, expr):
 
 
 # ---------------- string functions ----------------
+
+@op("_collkey")
+def op_collkey(ctx, expr):
+    """Collation canonical key (internal; planner-injected around GROUP
+    BY / DISTINCT items on _ci columns): dict codes map to the code of
+    the FIRST value sharing the utf8mb4_general_ci+PAD normal form, so
+    grouping merges case/padding variants and still decodes to an
+    original representative (reference pkg/util/collate)."""
+    d, nl, sd = eval_expr(ctx, expr.args[0])
+    if sd is None:
+        if isinstance(d, str):
+            return StringDict.ci_fold(d), nl, None
+        if hasattr(d, "dtype") and d.dtype == object:
+            out = np.array([StringDict.ci_fold(v) for v in d],
+                           dtype=object)
+            return out, nl, None
+        return d, nl, sd
+    t = sd.ci_norm_table()
+    tt = ctx.xp.asarray(t) if not ctx.host else t
+    return tt[d], nl, sd
+
+
+@op("_collkey_fold")
+def op_collkey_fold(ctx, expr):
+    """Collation join key (internal; planner-injected around _ci join
+    eq keys): values re-encode by NORMAL FORM into a dict of normal
+    forms — the hash-join shared-dict translation then matches rows
+    across sides regardless of case/padding."""
+    d, nl, sd = eval_expr(ctx, expr.args[0])
+    if sd is None:
+        return op_collkey(ctx, expr)
+    codes, fd = sd.ci_fold_codes()
+    tt = ctx.xp.asarray(codes) if not ctx.host else codes
+    return tt[d], nl, fd
+
+
+@op("_minmaxkey")
+def op_minmaxkey(ctx, expr):
+    """Rank-ordered recode (internal; planner-injected around MIN/MAX
+    string args): dict codes map into a dict whose code order IS the
+    collation order, so the agg kernel's numeric min/max computes
+    string min/max and the state decodes to the right value. Dict codes
+    are otherwise insertion-ordered — numeric min over them is
+    first-inserted, not smallest."""
+    d, nl, sd = eval_expr(ctx, expr.args[0])
+    if sd is None:
+        return d, nl, sd          # host object arrays compare by value
+    code_map, sorted_dict = sd.rank_codes(_is_ci(expr.ft))
+    tt = ctx.xp.asarray(code_map) if not ctx.host else code_map
+    return tt[d], nl, sorted_dict
+
 
 @op("lower", "lcase")
 def op_lower(ctx, expr):

@@ -346,6 +346,7 @@ class SelectStmt(StmtNode):
     from_clause: Node | None = None
     where: ExprNode | None = None
     group_by: list = field(default_factory=list)
+    with_rollup: bool = False
     having: ExprNode | None = None
     order_by: list = field(default_factory=list)  # [OrderItem]
     limit: Limit | None = None

@@ -390,7 +390,9 @@ class Parser:
                 sel.group_by.append(self.parse_expr())
                 while self.accept_op(","):
                     sel.group_by.append(self.parse_expr())
-                self.accept_kw("with")  # WITH ROLLUP: parse, unsupported later
+                if self.accept_kw("with"):
+                    self.expect_kw("rollup")
+                    sel.with_rollup = True
             if self.accept_kw("having"):
                 sel.having = self.parse_expr()
             sel.order_by = self.parse_order_by()

@@ -26,6 +26,41 @@ def split_conjuncts(e: Expression) -> list:
     return [e]
 
 
+def _ast_expr_transform(node, fn):
+    """Bottom-up rewrite of an AST expression tree: fn(node) returns a
+    replacement (stopping descent) or None to recurse. Dataclass nodes
+    rebuild only when a child changed; SelectStmt subtrees (subqueries)
+    are left untouched — their name scope is their own."""
+    import dataclasses as dc
+    if not isinstance(node, ast.Node) or isinstance(node, ast.SelectStmt):
+        return node
+    r = fn(node)
+    if r is not None:
+        return r
+    if not dc.is_dataclass(node):
+        return node
+    changes = {}
+    for f in dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, ast.Node):
+            nv = _ast_expr_transform(v, fn)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, list) and any(isinstance(x, ast.Node)
+                                         for x in v):
+            nv = [_ast_expr_transform(x, fn)
+                  if isinstance(x, ast.Node) else x for x in v]
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and any(isinstance(x, ast.Node)
+                                          for x in v):
+            nv = tuple(_ast_expr_transform(x, fn)
+                       if isinstance(x, ast.Node) else x for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+    return dc.replace(node, **changes) if changes else node
+
+
 def agg_result_ft(name: str, args, distinct):
     if name == "count":
         return new_bigint_type(not_null=True)
@@ -293,9 +328,124 @@ class PlanBuilder:
             if saved_ctes is not None:
                 self.ctes = saved_ctes
 
+    def _build_rollup(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        """GROUP BY ... WITH ROLLUP -> UNION ALL of the N+1 grouping
+        levels (reference: the Expand operator replicates every input
+        row once per grouping set — parser.y:7011,
+        logical_plan_builder.go:144). Redesigned for the device path:
+        each level is an independent aggregation over the SAME scan, so
+        every level rides the fused pipeline and the HBM-resident
+        column buffers instead of multiplying exchange rows by N+1.
+        grouping(expr) folds to a per-level constant, exact by
+        construction."""
+        import dataclasses as dc
+        gb = []
+        for g in stmt.group_by:
+            # resolve positional refs (GROUP BY 2) before matching
+            if isinstance(g, ast.Literal) and isinstance(g.value, int) \
+                    and not isinstance(g.value, bool):
+                idx = g.value - 1
+                if 0 <= idx < len(stmt.fields) and \
+                        isinstance(stmt.fields[idx], ast.SelectField):
+                    g = stmt.fields[idx].expr
+            gb.append(g)
+        n = len(gb)
+        for f in stmt.fields:
+            if not isinstance(f, ast.SelectField):
+                raise UnsupportedError("SELECT * WITH ROLLUP")
+        alias_of = {}
+        for i, f in enumerate(stmt.fields):
+            if f.alias:
+                alias_of[f.alias.lower()] = i
+        def make_fn(collapsed):
+            def fn(x):
+                if isinstance(x, ast.AggFunc):
+                    # a super-aggregate row still aggregates the REAL
+                    # column values (sum(a) at the total level is the
+                    # grand total); only bare references collapse
+                    return x
+                if isinstance(x, ast.FuncCall) and \
+                        x.name.lower() == "grouping":
+                    if len(x.args) != 1:
+                        raise UnsupportedError("grouping() takes one "
+                                               "argument")
+                    return ast.Literal(1 if x.args[0] in collapsed
+                                       else 0)
+                if isinstance(x, ast.ExprNode) and x in collapsed:
+                    return ast.Literal(None)
+                return None
+            return fn
+
+        branches = []
+        for lvl in range(n + 1):
+            collapsed = gb[n - lvl:]
+            null_fields = set()
+            for g in collapsed:
+                if isinstance(g, ast.ColumnRef) and not g.table and \
+                        g.name.lower() in alias_of:
+                    null_fields.add(alias_of[g.name.lower()])
+            fn = make_fn(collapsed)
+            fields = []
+            for i, f in enumerate(stmt.fields):
+                if i in null_fields:
+                    fields.append(dc.replace(f, expr=ast.Literal(None)))
+                else:
+                    fields.append(dc.replace(
+                        f, expr=_ast_expr_transform(f.expr, fn)))
+            branches.append(dc.replace(
+                stmt, with_rollup=False, group_by=list(gb[:n - lvl]),
+                fields=fields,
+                having=(None if stmt.having is None
+                        else _ast_expr_transform(stmt.having, fn)),
+                order_by=[], limit=None, setops=[], ctes=[]))
+        # the union tail resolves ORDER BY against output columns only:
+        # map order exprs that match a select field (or its alias) to
+        # positional refs; exprs that don't match any field — e.g.
+        # ORDER BY grouping(x), which folds to a DIFFERENT constant per
+        # branch — ride as hidden trailing fields, projected away after
+        # the union
+        order_by, hidden = [], []
+        for item in stmt.order_by or []:
+            oe = item.expr
+            pos = None
+            for i, f in enumerate(stmt.fields):
+                if f.expr == oe or (
+                        isinstance(oe, ast.ColumnRef) and not oe.table
+                        and f.alias and
+                        f.alias.lower() == oe.name.lower()):
+                    pos = i
+                    break
+            if pos is None and not (isinstance(oe, ast.Literal) or
+                                    isinstance(oe, ast.ColumnRef)):
+                pos = len(stmt.fields) + len(hidden)
+                hidden.append(oe)
+            order_by.append(dc.replace(item, expr=ast.Literal(pos + 1))
+                            if pos is not None else item)
+        if hidden:
+            for lvl, br in enumerate(branches):
+                fn = make_fn(gb[n - lvl:])
+                br.fields.extend(
+                    ast.SelectField(expr=_ast_expr_transform(h, fn),
+                                    alias=f"__rollup_ord{k}")
+                    for k, h in enumerate(hidden))
+        top = dc.replace(branches[0],
+                         setops=[("union all", b)
+                                 for b in branches[1:]],
+                         order_by=order_by, limit=stmt.limit)
+        plan = self.build_setops(top)
+        if hidden:
+            keep = plan.schema.cols[:len(stmt.fields)]
+            plan = Projection([sc.col for sc in keep],
+                              Schema(list(keep)), plan)
+        return plan
+
     def _build_select_inner(self, stmt: ast.SelectStmt) -> LogicalPlan:
         if stmt.setops:
+            if stmt.with_rollup:
+                raise UnsupportedError("ROLLUP inside a set operation")
             return self.build_setops(stmt)
+        if stmt.with_rollup:
+            return self._build_rollup(stmt)
         p = self.build_from(stmt.from_clause)
 
         # WHERE (conjunct-wise: correlated subquery predicates decorrelate

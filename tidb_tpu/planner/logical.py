@@ -4,7 +4,32 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from .schema import Schema, SchemaCol
-from ..expression import Expression, AggDesc, Column
+from ..expression import Expression, AggDesc, Column, ScalarFunc
+
+
+def _minmax_key(e: Expression) -> Expression:
+    """MIN/MAX over a string column compares dict CODES numerically;
+    wrap string args so codes re-map into collation rank order
+    (expression/vec.py op_minmaxkey); identity for everything else."""
+    from ..types.field_type import TypeClass
+    ft = getattr(e, "ft", None)
+    if ft is not None and ft.tclass == TypeClass.STRING and \
+            not (isinstance(e, ScalarFunc) and e.op == "_minmaxkey"):
+        return ScalarFunc("_minmaxkey", [e], ft)
+    return e
+
+
+def _ci_canon(e: Expression) -> Expression:
+    """Wrap a _ci string expression in the collation canonical-key op
+    (expression/vec.py op_collkey); identity for everything else."""
+    from ..types.field_type import TypeClass
+    from ..expression.vec import _is_ci
+    ft = getattr(e, "ft", None)
+    if ft is not None and ft.tclass == TypeClass.STRING and \
+            _is_ci(ft) and \
+            not (isinstance(e, ScalarFunc) and e.op == "_collkey"):
+        return ScalarFunc("_collkey", [e], ft)
+    return e
 
 
 class LogicalPlan:
@@ -69,7 +94,18 @@ class Aggregation(LogicalPlan):
     def __init__(self, group_items: list[Expression], aggs: list[AggDesc],
                  schema: Schema, child: LogicalPlan):
         super().__init__([child], schema)
-        self.group_items = group_items
+        # collation: _ci string group keys evaluate through the
+        # canonical-key table so case/padding variants share a group
+        # while the output decodes to an original representative
+        # (reference pkg/util/collate; wrap once here so every
+        # downstream path — host agg, device dag, fused pipeline —
+        # inherits it)
+        self.group_items = [_ci_canon(g) for g in group_items]
+        for a in aggs:
+            if a.distinct:
+                a.args = [_ci_canon(x) for x in a.args]
+            if a.name in ("min", "max") and a.args:
+                a.args = [_minmax_key(a.args[0])]
         self.aggs = aggs
 
     def explain_info(self):

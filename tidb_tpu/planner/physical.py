@@ -72,6 +72,15 @@ class PhysTableReader(PhysPlan):
 
     def explain_info(self):
         s = f"table:{self.dag.table_info.name}"
+        tbl = self.dag.table_info
+        if tbl.partitions:
+            # plan-time pruning display (reference
+            # rule_partition_processor.go); same prune as execution
+            from ..storage.partition import prune_for_dag
+            pids = prune_for_dag(self.dag)
+            names = {p["pid"]: p["name"] for p in
+                     tbl.partitions["parts"]}
+            s += ", partition:" + ",".join(names[p] for p in pids)
         if self.dag.filters or self.dag.host_filters:
             s += f", filters:{self.dag.filters + self.dag.host_filters}"
         if self.dag.aggs:
@@ -722,6 +731,7 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         agg.stats_rows = plan.stats_rows
         return agg
     if isinstance(plan, LJoin):
+        plan.eq_conds = [_ci_join_pair(a, b) for a, b in plan.eq_conds]
         left = _phys(plan.children[0])
         right = _phys(plan.children[1])
         if plan.join_type in ("left", "semi", "anti"):
@@ -927,6 +937,35 @@ def _fusable_leaf(p):
     return not (dag.aggs or dag.topn is not None or dag.limit >= 0 or
                 dag.host_filters or dag.table_info.partitions or
                 dag.table_info.id < 0)
+
+
+def _ci_join_pair(a, b):
+    """Join keys on _ci strings compare by collation normal form: both
+    sides wrap in _collkey_fold (a dict OF normal forms), so the join's
+    shared-dict translation matches case/padding variants across sides
+    (reference pkg/util/collate; MySQL collation coercion picks the
+    non-binary collation when the sides disagree). Non-string or _bin
+    pairs pass through — a wrapped key also keeps such a dim out of the
+    raw-code fused path, which would otherwise compare codes binary."""
+    from ..expression.vec import _is_ci
+    from ..types.field_type import TypeClass
+
+    def is_ci_str(e):
+        ft = getattr(e, "ft", None)
+        return ft is not None and ft.tclass == TypeClass.STRING and \
+            _is_ci(ft)
+
+    def is_str(e):
+        ft = getattr(e, "ft", None)
+        return ft is not None and ft.tclass == TypeClass.STRING
+
+    if (is_ci_str(a) or is_ci_str(b)) and is_str(a) and is_str(b):
+        def wrap(e):
+            if isinstance(e, ScalarFunc) and e.op == "_collkey_fold":
+                return e
+            return ScalarFunc("_collkey_fold", [e], e.ft)
+        return wrap(a), wrap(b)
+    return a, b
 
 
 def _bpg_to_reader(p):

@@ -617,6 +617,8 @@ class DDLExecutor:
                 for ci in cols:
                     col = ctab.column_for(ci)
                     datums.append(col.get_datum(i))
+                from ..executor.table_rt import fold_ci_datums
+                datums = fold_ci_datums(tbl, idx, datums)
                 if idx.unique and not any(d.is_null for d in datums):
                     ik = index_key(tbl.id, idx.id, datums)
                     existing = txn.get(ik)

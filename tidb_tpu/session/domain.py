@@ -129,6 +129,69 @@ class Domain:
         self.columnar._replay_buffer = None
         for ts, muts in buf:
             self.columnar.apply_commit(ts, muts)
+        # store-format migrations: a FORMAT marker records which on-disk
+        # encodings this store has been upgraded to. Format 2 = _ci
+        # index keys hold the collation normal form; older stores (or
+        # markerless pre-format stores with data) reindex once here.
+        fmt_path = os.path.join(data_dir, "FORMAT")
+        have_data = os.path.exists(path) or os.path.exists(ckpt)
+        fmt = None
+        if os.path.exists(fmt_path):
+            with open(fmt_path) as f:
+                fmt = f.read().strip()
+        if fmt != "2" and have_data:
+            self._migrate_ci_index_keys()
+        with open(fmt_path, "w") as f:
+            f.write("2")
+
+    def _migrate_ci_index_keys(self):
+        """One-time reindex for stores written before collation-aware
+        index keys: every index entry over a _ci string column moves
+        from the raw value encoding to the ci+PAD normal form, so the
+        folding read paths (PointGet/IndexRange/FK/unique checks) keep
+        finding pre-existing rows (reference: collate.Key change shipped
+        with the new-collation framework's reindex requirement)."""
+        from ..codec.tablecodec import (index_prefix, decode_index_key,
+                                        index_key)
+        from ..executor.table_rt import fold_ci_datums
+        from ..expression.vec import _is_ci
+        from ..types.field_type import TypeClass
+        mvcc = self.storage.mvcc
+        read_ts = self.storage.current_ts()
+        muts = []
+        isch = self.infoschema()
+        for db in isch.all_schemas():
+            if db.name.lower() in ("mysql", "information_schema"):
+                continue
+            for tbl in isch.tables_in_schema(db.name):
+                for idx in tbl.indexes:
+                    cols = [tbl.find_column(c) for c in idx.columns]
+                    if not any(c is not None and
+                               c.ft.tclass == TypeClass.STRING and
+                               _is_ci(c.ft) for c in cols):
+                        continue
+                    pref = index_prefix(tbl.id, idx.id)
+                    for k, v in mvcc.scan(pref, pref + b"\xff" * 9,
+                                          read_ts):
+                        try:
+                            _t, _i, datums, rest = decode_index_key(
+                                k, len(idx.columns))
+                        except Exception:       # noqa: BLE001
+                            continue
+                        nk = index_key(tbl.id, idx.id,
+                                       fold_ci_datums(tbl, idx, datums))
+                        nk += rest
+                        if nk != k:
+                            muts.append((k, None))
+                            muts.append((nk, v))
+        if muts:
+            # apply AND log: the reindex must survive the next restart —
+            # apply_replay skips the WAL, so append the frame explicitly
+            # (the writer is attached before migrations run)
+            ts = self.storage.oracle.get_ts()
+            if mvcc.wal is not None:
+                mvcc.wal.append(ts, muts)
+            mvcc.apply_replay(ts, muts)
 
     def flush_wal(self) -> int:
         """LSM flush: rewrite the WAL as one sorted immutable run and

@@ -43,6 +43,15 @@ def route_partition(tbl, part_val) -> int:
     raise TiDBError("Table has no partition for value %s", part_val)
 
 
+def prune_for_dag(dag) -> list:
+    """Partition pruning for a CoprDAG: ONE definition shared by the
+    executor's partition expansion and the planner's EXPLAIN display,
+    so what EXPLAIN shows is exactly what execution scans."""
+    col_name_of = {sc.col.idx: sc.name for sc in dag.cols}
+    return prune_partitions(dag.table_info,
+                            dag.filters + dag.host_filters, col_name_of)
+
+
 def prune_partitions(tbl, conds, col_name_of) -> list:
     """Range-partition pruning from pushed conds of form pcol cmp const
     (reference partition pruning rule). Returns pids to scan."""

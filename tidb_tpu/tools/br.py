@@ -12,6 +12,7 @@ recorded in the manifest's `done` list; a re-run of the same backup skips
 completed tables, a restore skips already-restored ones."""
 from __future__ import annotations
 
+import io
 import json
 import os
 
@@ -19,19 +20,18 @@ import numpy as np
 
 from ..errors import TiDBError, DatabaseNotExistsError
 from ..models import TableInfo
+from .objstore import open_storage, LocalStorage
 
 
 def backup(domain, db_name: str, path: str) -> int:
-    os.makedirs(path, exist_ok=True)
+    store = open_storage(path)
     ischema = domain.infoschema()
     dbs = ([ischema.schema_by_name(db_name)] if db_name
            else [d for d in ischema.all_schemas()
                  if d.name.lower() not in ("mysql", "information_schema")])
-    meta_path = os.path.join(path, "backupmeta.json")
     manifest = {"version": 1, "dbs": [], "tables": [], "done": []}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            manifest = json.load(f)
+    if store.exists("backupmeta.json"):
+        manifest = json.loads(store.read("backupmeta.json"))
     done = set(tuple(x) for x in manifest.get("done", []))
     manifest["dbs"] = [{"name": d.name} for d in dbs]
     # one backup_ts for the whole run: every table filters to versions
@@ -47,21 +47,21 @@ def backup(domain, db_name: str, path: str) -> int:
             key = (d.name, t.name)
             if key in [tuple(k) for k in done]:
                 continue
-            _backup_table(domain, d.name, t, path, backup_ts)
+            _backup_table(domain, d.name, t, store, backup_ts)
             manifest.setdefault("done", []).append([d.name, t.name])
             count += 1
             manifest["tables"] = tables_meta
-            with open(meta_path, "w") as f:      # checkpoint after each table
-                json.dump(manifest, f)
+            # checkpoint after each table
+            store.write("backupmeta.json",
+                        json.dumps(manifest).encode())
     manifest["tables"] = tables_meta
-    with open(meta_path, "w") as f:
-        json.dump(manifest, f)
+    store.write("backupmeta.json", json.dumps(manifest).encode())
     return count
 
 
-def _backup_table(domain, db_name, t, path, backup_ts=None):
+def _backup_table(domain, db_name, t, store, backup_ts=None):
     ctab = domain.columnar.tables.get(t.id)
-    base = os.path.join(path, f"{db_name}.{t.name}")
+    base = f"{db_name}.{t.name}"
     arrays = {}
     dicts = {}
     if ctab is not None and ctab.n:
@@ -77,17 +77,17 @@ def _backup_table(domain, db_name, t, path, backup_ts=None):
                 arrays[f"n_{ci.id}"] = ctab.nulls[ci.id][idx].copy()
                 if ci.id in ctab.dicts:
                     dicts[str(ci.id)] = list(ctab.dicts[ci.id].values)
-    np.savez_compressed(base + ".npz", **arrays)
-    with open(base + ".dicts.json", "w") as f:
-        json.dump(dicts, f)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    store.write(base + ".npz", buf.getvalue())
+    store.write(base + ".dicts.json", json.dumps(dicts).encode())
 
 
 def restore(domain, db_name: str, path: str) -> int:
-    meta_path = os.path.join(path, "backupmeta.json")
-    if not os.path.exists(meta_path):
+    store = open_storage(path)
+    if not store.exists("backupmeta.json"):
         raise TiDBError("backupmeta.json not found in %s", path)
-    with open(meta_path) as f:
-        manifest = json.load(f)
+    manifest = json.loads(store.read("backupmeta.json"))
     from ..session import Session
     sess = Session(domain)
     count = 0
@@ -103,12 +103,12 @@ def restore(domain, db_name: str, path: str) -> int:
         _create_from_info(sess, src_db, t)
         new_t = domain.infoschema().table_by_name(src_db, t.name)
         ctab = domain.columnar.table(new_t)
-        base = os.path.join(path, f"{src_db}.{t.name}")
-        if not os.path.exists(base + ".npz"):
+        base = f"{src_db}.{t.name}"
+        if not store.exists(base + ".npz"):
             continue
-        z = np.load(base + ".npz", allow_pickle=False)
-        with open(base + ".dicts.json") as f:
-            dicts = json.load(f)
+        z = np.load(io.BytesIO(store.read(base + ".npz")),
+                    allow_pickle=False)
+        dicts = json.loads(store.read(base + ".dicts.json"))
         if "__handles" in z:
             n = len(z["__handles"])
             ctab._ensure(n)
@@ -165,14 +165,16 @@ def _create_from_info(sess, db, t: TableInfo):
 # into a fresh store) -----------------------------------------------------
 
 def backup_log(domain, path: str) -> int:
-    """Copy the WAL (and checkpoint snapshot, if any) to path/log/."""
-    import shutil
+    """Copy the WAL (and checkpoint snapshot, if any) to <store>/log/."""
     import time
     if not domain.data_dir:
         from ..errors import TiDBError
         raise TiDBError("BACKUP LOG requires a --data-dir store")
-    dst = os.path.join(path, "log")
-    os.makedirs(dst, exist_ok=True)
+    store = open_storage(path)
+
+    def put_file(src, name):
+        with open(src, "rb") as f:
+            store.write("log/" + name, f.read())
     wal = os.path.join(domain.data_dir, "commit.wal")
     n = 0
     w = domain.storage.mvcc.wal
@@ -182,29 +184,52 @@ def backup_log(domain, path: str) -> int:
     # part of the log backup (each entry carries its commit wallclock)
     from ..storage import sst
     for rp in sst.run_files(domain.data_dir):
-        shutil.copy2(rp, os.path.join(dst, os.path.basename(rp)))
+        put_file(rp, os.path.basename(rp))
         n += 1
     if os.path.exists(wal):
-        shutil.copy2(wal, os.path.join(dst, "commit.wal"))
+        put_file(wal, "commit.wal")
         from ..storage.wal import replay as _replay
-        n += sum(1 for _ in _replay(os.path.join(dst, "commit.wal")))
+        n += sum(1 for _ in _replay(wal))
     ckpt = os.path.join(domain.data_dir, "checkpoint.snap")
     meta = {"backup_wall": time.time(), "has_checkpoint": False}
     if os.path.exists(ckpt):
-        shutil.copy2(ckpt, os.path.join(dst, "checkpoint.snap"))
+        put_file(ckpt, "checkpoint.snap")
         meta["has_checkpoint"] = True
         meta["checkpoint_mtime"] = os.path.getmtime(ckpt)
-    with open(os.path.join(dst, "pitr_meta.json"), "w") as f:
-        json.dump(meta, f)
+    store.write("log/pitr_meta.json", json.dumps(meta).encode())
     return n
 
 
 def restore_pitr(domain, path: str, until_wall: float) -> int:
     """Replay the log backup into `domain` up to `until_wall` (intended
-    for a fresh store — the reference restores PITR into a new cluster)."""
+    for a fresh store — the reference restores PITR into a new cluster).
+    Non-local object stores spool to a temp dir first: WAL/run replay
+    reads files, and a log restore is a rare, whole-artifact download
+    anyway (reference br restores pull the log segments down too)."""
+    store = open_storage(path)
+    spool = None
+    if isinstance(store, LocalStorage):
+        dst = os.path.join(store.root, "log")
+    else:
+        import tempfile
+        spool = tempfile.mkdtemp(prefix="pitr_spool_")
+        dst = os.path.join(spool, "log")
+        os.makedirs(dst, exist_ok=True)
+        for name in store.list("log/"):
+            with open(os.path.join(dst, name.split("/", 1)[1]),
+                      "wb") as f:
+                f.write(store.read(name))
+    try:
+        return _restore_pitr_dir(domain, dst, until_wall)
+    finally:
+        if spool is not None:
+            import shutil
+            shutil.rmtree(spool, ignore_errors=True)
+
+
+def _restore_pitr_dir(domain, dst: str, until_wall: float) -> int:
     from ..errors import TiDBError
     from ..storage.wal import decode_checkpoint
-    dst = os.path.join(path, "log")
     meta_path = os.path.join(dst, "pitr_meta.json")
     meta = {}
     if os.path.exists(meta_path):
