@@ -719,7 +719,8 @@ class TestObservability:
         ftk.must_exec("create table ea (a int, b int)")
         ftk.must_exec("insert into ea values (1,1),(2,2),(3,3)")
         r = ftk.must_query("explain analyze select sum(b) from ea where a > 1")
-        assert r.names == ["id", "estRows", "actRows", "time", "operator info"]
+        assert r.names == ["id", "estRows", "actRows", "time", "backend",
+                           "operator info"]
         # the reader's actRows reflects the filtered partials and the agg
         ids = [row[0] for row in r.rows]
         assert any("HashAgg" in i for i in ids)

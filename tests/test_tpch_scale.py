@@ -81,6 +81,25 @@ def test_tpch_device_routing_pinned(tk):
     assert not problems, problems
 
 
+def test_explain_analyze_backend_column(tk):
+    """EXPLAIN ANALYZE exposes per-operator placement (reference
+    pkg/util/execdetails storeType): the fused pipeline row says
+    device(fused[-mpp]), scan rows say device with a kernel-cache
+    hit/miss delta, and rows folded into a parent kernel show '-'."""
+    rs = tk.must_query("explain analyze " + ALL_QUERIES["q3"])
+    assert "backend" in rs.names
+    by_op = {}
+    for r in rs.rows:
+        op = str(r[0]).lstrip(" │└├─").rsplit("_", 1)[0]
+        by_op.setdefault(op, str(r[4]))
+    assert by_op.get("FusedPipeline", "").startswith("device(fused"), \
+        by_op
+    rs6 = tk.must_query("explain analyze " + ALL_QUERIES["q6"])
+    tr = [str(r[4]) for r in rs6.rows
+          if "TableReader" in str(r[0])]
+    assert tr and tr[0].startswith("device"), rs6.rows
+
+
 def test_boundaries_crossed(tk):
     """The scale run must have exercised the paths the small oracle
     can't: fused pipeline hits and >1024-group sort aggs (bucket

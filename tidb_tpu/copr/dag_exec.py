@@ -32,6 +32,25 @@ from ..types.field_type import TypeClass, new_bigint_type
 _I64_MAX = np.iinfo(np.int64).max
 
 
+class _KernelCache(dict):
+    """Compiled-kernel cache with hit/miss counters (reference
+    coprocessor_cache.go metrics; surfaced per-operator by
+    EXPLAIN ANALYZE's backend column)."""
+
+    def __init__(self):
+        super().__init__()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, default=None):
+        v = super().get(key, default)
+        if v is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return v
+
+
 class CoprExecutor:
     """Executes CoprDAGs against ColumnarTables; caches compiled kernels."""
 
@@ -40,7 +59,8 @@ class CoprExecutor:
         self.engine = engine            # ColumnarEngine
         self.device_rows = device_rows  # partition size (rows per jit call)
         self.use_device = use_device
-        self._kernel_cache = {}
+        self._kernel_cache = _KernelCache()
+        self.last_backend = ""          # backend of the latest execute()
         # device buffer pool: column slices resident in HBM across queries,
         # keyed by (table, column, version, slice, cap) — the "per-query
         # device buffer pool" of SURVEY.md §5 generalized to cross-query
@@ -91,6 +111,10 @@ class CoprExecutor:
         memBuffer — UnionScan semantics (reference executor/builder.go:1473):
         deleted/updated committed rows are masked out, buffered rows are
         appended before filters run."""
+        # reset per call: empty-snapshot / virtual-table paths return
+        # early without running a backend — a stale tag from the
+        # previous execute must not leak into EXPLAIN ANALYZE
+        self.last_backend = ""
         if dag.table_info.id <= -1000:      # INFORMATION_SCHEMA virtual
             tbl = self._materialize_virtual(dag.table_info)
             read_ts = None
@@ -138,6 +162,9 @@ class CoprExecutor:
         """Routing metrics (reference pkg/util/execdetails): which copr
         backend actually ran — the observable the golden routing tests
         pin so a silent device->host regression fails CI."""
+        self.last_backend = {"copr_device_exec": "device",
+                             "copr_mpp_exec": "device-mpp",
+                             "copr_host_exec": "host"}.get(name, "")
         dom = getattr(self, "domain", None)
         if dom is not None:
             dom.inc_metric(name)

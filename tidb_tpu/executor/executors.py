@@ -48,6 +48,30 @@ class TableReaderExec(Executor):
         self.dag = plan.dag
         self._chunks = None
         self._i = 0
+        self._backends: set = set()
+        self._kc = [0, 0]       # kernel-cache hits, misses
+
+    def _copr_exec(self, dag, *args, **kw):
+        """Run one copr (sub)dag recording which backend served it and
+        the kernel-cache hit/miss delta — EXPLAIN ANALYZE's per-operator
+        placement observable (reference pkg/util/execdetails)."""
+        copr = self.ctx.copr
+        kc = copr._kernel_cache
+        h0, m0 = kc.hits, kc.misses
+        res = copr.execute(dag, *args, **kw)
+        if copr.last_backend:
+            self._backends.add(copr.last_backend)
+        self._kc[0] += kc.hits - h0
+        self._kc[1] += kc.misses - m0
+        return res
+
+    def backend_info(self):
+        if not self._backends:
+            return ""
+        s = "+".join(sorted(self._backends))
+        if self._kc[0] or self._kc[1]:
+            s += f" kcache:{self._kc[0]}/{self._kc[1]}"
+        return s
 
     def open(self):
         pass
@@ -89,7 +113,7 @@ class TableReaderExec(Executor):
         if self._chunks is None:
             self._chunks = []
             for dag in self._part_dags():
-                self._chunks.extend(self.ctx.copr.execute(
+                self._chunks.extend(self._copr_exec(
                     dag, self._overlay(dag), self.ctx.read_ts()))
             self._i = 0
         if self._i >= len(self._chunks):
@@ -103,7 +127,7 @@ class TableReaderExec(Executor):
         out = []
         for dag in self._part_dags():
             fm = getattr(self.ctx, "force_mpp", None)
-            out.extend(self.ctx.copr.execute(
+            out.extend(self._copr_exec(
                 dag, self._overlay(dag), self.ctx.read_ts(),
                 use_mpp=bool(sv.get("tidb_enable_mpp")) if fm is None
                 else fm,
@@ -141,6 +165,10 @@ class FusedPipelineExec(Executor):
     def __init__(self, ctx, plan):
         super().__init__(ctx, plan.schema)
         self.plan = plan
+        self.backend = ""
+
+    def backend_info(self):
+        return self.backend
 
     def open(self):
         pass
@@ -187,6 +215,9 @@ class FusedPipelineExec(Executor):
                     sess.domain.inc_metric(
                         "fused_pipeline_mpp_hit" if mesh is not None
                         else "fused_pipeline_hit")
+                    self.backend = ("device(fused-mpp)"
+                                    if mesh is not None
+                                    else "device(fused)")
                     return res
             except Exception:           # noqa: BLE001
                 sess.domain.inc_metric("fused_pipeline_error")
@@ -199,10 +230,12 @@ class FusedPipelineExec(Executor):
                                              ctx=self.ctx)
                         if res is not None:
                             sess.domain.inc_metric("fused_pipeline_hit")
+                            self.backend = "device(fused)"
                             return res
                     except Exception:   # noqa: BLE001
                         pass
         sess.domain.inc_metric("fused_pipeline_fallback")
+        self.backend = "host(fallback)"
         return self._fallback_partials()
 
     def _fallback_partials(self):

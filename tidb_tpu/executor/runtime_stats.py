@@ -67,10 +67,21 @@ class TimedExec:
 
 
 def wrapped_children_stats(ex):
-    """Collect (act_rows, wall_ms) tree matching the plan tree shape."""
-    me = (ex.act_rows, ex.wall_ms) if isinstance(ex, TimedExec) else (0, 0.0)
-    kids = []
+    """Collect (act_rows, wall_ms, backend) tree matching the plan tree
+    shape. `backend` (reference pkg/util/execdetails storeType) says
+    which engine served the operator — device / device-mpp /
+    device(fused) / host — plus its kernel-cache hit/miss delta."""
     inner = ex.inner if isinstance(ex, TimedExec) else ex
+    backend = ""
+    bi = getattr(inner, "backend_info", None)
+    if callable(bi):
+        backend = bi() or ""
+    opname = type(inner).__name__
+    if opname.endswith("Exec"):
+        opname = opname[:-4]
+    me = (ex.act_rows, ex.wall_ms, backend, opname) \
+        if isinstance(ex, TimedExec) else (0, 0.0, backend, opname)
+    kids = []
     for c in inner.children:
         kids.append(wrapped_children_stats(c))
     return (me, kids)

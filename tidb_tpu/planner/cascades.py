@@ -1,0 +1,164 @@
+"""Cascades-style memo optimizer (reference pkg/planner/cascades —
+dispatch at pkg/planner/core/optimizer.go:335-341, memo structures in
+pkg/planner/memo).
+
+Compact TPU-first redesign, not a port of the reference's task
+scheduler: in this engine everything below/above a join region lowers
+deterministically to fused device pipelines, so the search space that
+matters is the inner-join region. The memo explores exactly that:
+
+- GROUPS are keyed by the SET (bitmask) of base relations an expression
+  joins — the semantic equivalence class under commutativity and
+  associativity, so deduplication is exact rather than
+  fingerprint-approximate.
+- RULES: JoinCommute and JoinAssociate fire to fixpoint (or budget),
+  reaching every bushy tree over the region (the DPhyp space) while
+  the memo shares subtrees between alternatives.
+- COST: each group memoizes its cheapest expression bottom-up under the
+  SAME NDV cardinality model the DP reorder uses — one cost model, two
+  search strategies, so a plan difference is always a search
+  difference, never a model disagreement. Disconnected joins cost the
+  full cartesian product, which prices them out without forbidding the
+  rare genuinely-disconnected query.
+- EXTRACTION re-materializes the winner through rules._build_tree, so
+  eq/other conds attach by schema coverage exactly like every other
+  planning path.
+
+Enabled per session: `set tidb_enable_cascades_planner = 1`.
+"""
+from __future__ import annotations
+
+from .logical import LogicalPlan, LJoin
+
+MAX_RELS = 12          # beyond this the region falls back to greedy
+EXPR_BUDGET = 6000     # total memo expressions across one region
+
+
+class Memo:
+    """groups: bitmask -> set of expressions. An expression is either
+    ("leaf", i) or (left_mask, right_mask)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.groups: dict[int, set] = {}
+        self.n_exprs = 0
+
+    def add(self, mask: int, expr) -> bool:
+        g = self.groups.setdefault(mask, set())
+        if expr in g:
+            return False
+        if self.n_exprs >= EXPR_BUDGET:
+            return False
+        g.add(expr)
+        self.n_exprs += 1
+        return True
+
+
+def _explore(memo: Memo):
+    """Fire JoinCommute + JoinAssociate to fixpoint (or budget).
+    Associate: g = (l, r) and l = (a, b)  =>  g gains (a, b|r) and the
+    (possibly new) group b|r gains (b, r). With commute closing both
+    orientations, the two rules generate every bushy shape."""
+    dirty = True
+    while dirty and memo.n_exprs < EXPR_BUDGET:
+        dirty = False
+        for mask in list(memo.groups):
+            for expr in list(memo.groups[mask]):
+                if expr[0] == "leaf":
+                    continue
+                l, r = expr
+                if memo.add(mask, (r, l)):          # commute
+                    dirty = True
+                for sub in list(memo.groups.get(l, ())):
+                    if sub[0] == "leaf":
+                        continue
+                    a, b = sub
+                    nr = b | r
+                    if memo.add(nr, (b, r)):
+                        dirty = True
+                    if memo.add(mask, (a, nr)):
+                        dirty = True
+
+
+def _cost_group(memo: Memo, mask: int, rows, edges, cache):
+    """Cheapest implementation of a group: min over its expressions of
+    cost(l) + cost(r) + |out|, |out| from the SHARED NDV model
+    (rules.join_out_rows). Returns (cost, out_rows, tree) with tree in
+    rules._build_tree's format."""
+    from .rules import join_out_rows
+    hit = cache.get(mask)
+    if hit is not None:
+        return hit
+    exprs = memo.groups.get(mask, ())
+    best = None
+    for expr in exprs:
+        if expr[0] == "leaf":
+            i = expr[1]
+            best = (0.0, rows[i], ("leaf", i))
+            break
+        l, r = expr
+        bl = _cost_group(memo, l, rows, edges, cache)
+        br = _cost_group(memo, r, rows, edges, cache)
+        if bl is None or br is None:
+            continue
+        out = join_out_rows(bl[1], br[1], l, r, edges)
+        if out is None:
+            out = bl[1] * br[1]         # cartesian: priced, not banned
+        cost = bl[0] + br[0] + out
+        if best is None or cost < best[0]:
+            best = (cost, out, ("join", bl[2], br[2], out))
+    cache[mask] = best
+    return best
+
+
+def memo_search(rels, eqs, others):
+    """One inner-join region -> the memo-chosen LJoin tree, or None
+    when the region is too large (caller falls back to greedy)."""
+    from .rules import build_join_edges, _build_tree
+    n = len(rels)
+    if n > MAX_RELS:
+        return None
+    id_of = {}
+    for i, rel in enumerate(rels):
+        for sc in rel.schema.cols:
+            id_of[sc.col.idx] = i
+    edges = build_join_edges(rels, eqs, id_of, {})
+    rows = [max(float(r.stats_rows), 1.0) for r in rels]
+
+    memo = Memo(n)
+    full = (1 << n) - 1
+    # seed a left-deep chain; exploration reaches the rest of the
+    # bushy space from any single seed tree
+    for i in range(n):
+        memo.add(1 << i, ("leaf", i))
+    acc = 1
+    for i in range(1, n):
+        memo.add(acc | (1 << i), (acc, 1 << i))
+        acc |= 1 << i
+    _explore(memo)
+    best = _cost_group(memo, full, rows, edges, {})
+    if best is None:
+        return None
+    return _build_tree(best[2], rels, eqs, others)
+
+
+def cascades_reorder(plan: LogicalPlan, leading=None) -> LogicalPlan:
+    """Memo-search every maximal inner-join region (outer/semi/anti
+    joins are barriers, mirrors rules.reorder_joins); LEADING hints pin
+    an order the user chose — respect them via the classic path."""
+    from .rules import reorder_joins, _flatten_inner, _greedy_build
+    if leading:
+        return reorder_joins(plan, leading)
+    if isinstance(plan, LJoin) and plan.join_type == "inner":
+        rels, eqs, others = [], [], []
+        _flatten_inner(plan, rels, eqs, others)
+        rels = [cascades_reorder(r) for r in rels]
+        if len(rels) >= 2:
+            out = memo_search(rels, eqs, others)
+            if out is not None:
+                return out
+            return _greedy_build(rels, eqs, others)
+        plan.children = rels
+        return plan
+    plan.children = [cascades_reorder(c) for c in plan.children]
+    return plan

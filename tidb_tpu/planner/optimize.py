@@ -86,9 +86,15 @@ def optimize(stmt, pctx: PlanContext):
     hints = getattr(stmt, "hints", None) or []
     if isinstance(stmt, ast.SelectStmt):
         logical = builder.build_select(stmt)
+        try:
+            cascades = bool(pctx.sess_vars.get(
+                "tidb_enable_cascades_planner"))
+        except Exception:               # noqa: BLE001
+            cascades = False
         logical = optimize_logical(
             logical, hints=hints,
-            no_reorder=getattr(stmt, "straight_join", False))
+            no_reorder=getattr(stmt, "straight_join", False),
+            cascades=cascades)
         phys = to_physical(logical, pctx.sess_vars, hints=hints)
         try:
             mpp_on = bool(pctx.sess_vars.get("tidb_enable_mpp"))

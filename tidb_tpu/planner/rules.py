@@ -11,14 +11,19 @@ from .builder import ProjShell
 
 
 def optimize_logical(plan: LogicalPlan, keep_handles=False,
-                     hints=None, no_reorder=False) -> LogicalPlan:
+                     hints=None, no_reorder=False,
+                     cascades=False) -> LogicalPlan:
     leading = []
     if hints:
         from ..parser.hints import leading_order
         leading = leading_order(hints)
     plan = push_down_predicates(plan, [])
     if not no_reorder:
-        plan = reorder_joins(plan, leading)
+        if cascades:
+            from .cascades import cascades_reorder
+            plan = cascades_reorder(plan, leading)
+        else:
+            plan = reorder_joins(plan, leading)
     used = {sc.col.idx for sc in plan.schema.cols}
     prune_columns(plan, used)
     plan = build_topn(plan)
@@ -166,24 +171,17 @@ def _greedy_order(rels, eqs, id_of, rel_of, start, ndv_cache=None):
     return order, total
 
 
-def _dp_order(rels, eqs, id_of, ndv_cache):
-    """Exact join-order search by dynamic programming over relation
-    subsets (reference planner/core/rule_join_reorder_dp.go): for every
-    subset, the cheapest way to build it from two joined halves, cost =
-    cumulative intermediate cardinality under the NDV model. Returns a
-    binary order tree ('leaf', i) | ('join', l, r, est) or None when
-    too many relations (2^n blowup — caller falls back to greedy)."""
+def build_join_edges(rels, eqs, id_of, ndv_cache):
+    """Eq conds as (bitmask_left, bitmask_right, max bare-key NDV) —
+    the cardinality-model input shared by the DP search here and the
+    cascades memo search (planner/cascades.py), so the two strategies
+    can never disagree on cost, only on what they explore."""
     from ..expression import Column as _Col
-    n = len(rels)
-    if n > 8:
-        return None
 
     def cached_ndv(idx):
         if idx not in ndv_cache:
             ndv_cache[idx] = _col_ndv(rels, id_of, idx)
         return ndv_cache[idx]
-
-    # eq conds as (bitmask_left, bitmask_right, max ndv of bare keys)
     edges = []
     for a, b in eqs:
         ma = 0
@@ -203,7 +201,37 @@ def _dp_order(rels, eqs, id_of, ndv_cache):
                 if v is not None:
                     ndv = max(ndv or 1, v)
         edges.append((ma, mb, ndv))
+    return edges
 
+
+def join_out_rows(rows_l, rows_r, s1, s2, edges):
+    """|L join R| under the NDV model; cartesian when no edge connects
+    the sides (shared with planner/cascades.py)."""
+    ndv = None
+    connected = False
+    for ma, mb, en in edges:
+        if ma and mb and \
+                (((ma | s1) == s1 and (mb | s2) == s2) or
+                 ((ma | s2) == s2 and (mb | s1) == s1)):
+            connected = True
+            if en is not None:
+                ndv = max(ndv or 1, en)
+    if not connected:
+        return None
+    return rows_l * rows_r / max(float(ndv or min(rows_l, rows_r)), 1.0)
+
+
+def _dp_order(rels, eqs, id_of, ndv_cache):
+    """Exact join-order search by dynamic programming over relation
+    subsets (reference planner/core/rule_join_reorder_dp.go): for every
+    subset, the cheapest way to build it from two joined halves, cost =
+    cumulative intermediate cardinality under the NDV model. Returns a
+    binary order tree ('leaf', i) | ('join', l, r, est) or None when
+    too many relations (2^n blowup — caller falls back to greedy)."""
+    n = len(rels)
+    if n > 8:
+        return None
+    edges = build_join_edges(rels, eqs, id_of, ndv_cache)
     rows = [max(float(r.stats_rows), 1.0) for r in rels]
     # best[mask] = (cost, out_rows, tree)
     best = {1 << i: (0.0, rows[i], ("leaf", i)) for i in range(n)}
@@ -219,25 +247,14 @@ def _dp_order(rels, eqs, id_of, ndv_cache):
                 continue
             b1, b2 = best.get(s1), best.get(s2)
             if b1 is not None and b2 is not None:
-                ndv = None
-                connected = False
-                for ma, mb, en in edges:
-                    if ma and mb and \
-                            (((ma | s1) == s1 and (mb | s2) == s2) or
-                             ((ma | s2) == s2 and (mb | s1) == s1)):
-                        connected = True
-                        if en is not None:
-                            ndv = max(ndv or 1, en)
-                if not connected:
+                est = join_out_rows(b1[1], b2[1], s1, s2, edges)
+                if est is None:
                     # connected splits only: the row-count cost model
                     # undervalues cartesian products whose real executor
                     # constants are much worse (greedy handles the rare
                     # genuinely-disconnected query)
                     s1 = (s1 - 1) & mask
                     continue
-                est = b1[1] * b2[1] / max(float(ndv or
-                                                min(b1[1], b2[1])),
-                                          1.0)
                 cost = b1[0] + b2[0] + est
                 if acc is None or cost < acc[0]:
                     acc = (cost, est, ("join", b1[2], b2[2], est))

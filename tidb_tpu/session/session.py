@@ -648,7 +648,8 @@ class Session:
                 self.domain.bind_handle.version, self.session_binds.version,
                 bool(self.vars.get("tidb_enable_mpp")),
                 str(self.vars.get("div_precision_increment")),
-                str(self.vars.get("tidb_join_exec")))
+                str(self.vars.get("tidb_join_exec")),
+                bool(self.vars.get("tidb_enable_cascades_planner")))
 
     def _apply_binding(self, stmt, sql_text):
         """Session-then-global binding match by normalized digest
@@ -881,17 +882,56 @@ class Session:
             rows = []
             base = explain_text(plan)
 
-            def flat(st, out):
-                out.append(st[0])
-                for k in st[1]:
-                    flat(k, out)
-            flat_stats = []
-            flat(stats, flat_stats)
-            for (pid, est, info), (arows, ms) in zip(base, flat_stats):
-                rows.append((pid, est, str(arows), f"{ms:.2f}ms", info))
-            names = ["id", "estRows", "actRows", "time", "operator info"]
+            # tree-aware pairing of plan rows to executor stats: walk
+            # both trees in parallel, matching children by operator
+            # name IN POSITION — a display-only subtree (a fused
+            # pipeline's dim rows have no executors) pairs with None
+            # for its whole subtree instead of stealing a later
+            # sibling's stats. Plan rows without an executor ran inside
+            # their parent's kernel and show "-".
+            stats_by_row = []
+
+            def reaches(p, st):
+                # p matches st directly, or is a chain of plan-only
+                # single-child wrappers (e.g. ExchangeSender) above a
+                # matching descendant
+                while True:
+                    if p.name() == st[0][3]:
+                        return True
+                    if len(p.children) == 1:
+                        p = p.children[0]
+                        continue
+                    return False
+
+            def pair_through(p, st):
+                if p.name() == st[0][3]:
+                    pair(p, st)
+                else:
+                    stats_by_row.append(None)   # wrapper row: "-"
+                    pair_through(p.children[0], st)
+
+            def pair(p, st):
+                stats_by_row.append(st[0] if st is not None else None)
+                kids = list(st[1]) if st is not None else []
+                si = 0
+                for c in p.children:
+                    if si < len(kids) and reaches(c, kids[si]):
+                        pair_through(c, kids[si])
+                        si += 1
+                    else:
+                        pair(c, None)
+            pair_through(plan, stats)
+            for (pid, est, info), st in zip(base, stats_by_row):
+                if st is not None:
+                    arows, ms, backend, _ = st
+                    rows.append((pid, est, str(arows), f"{ms:.2f}ms",
+                                 backend, info))
+                else:
+                    rows.append((pid, est, "-", "-", "", info))
+            names = ["id", "estRows", "actRows", "time", "backend",
+                     "operator info"]
             cols = []
-            for j in range(5):
+            for j in range(6):
                 arr = np.array([r[j] for r in rows], dtype=object)
                 cols.append(Column(new_string_type(), arr))
             self._finish_stmt()

@@ -86,6 +86,9 @@ for _v in [
     SysVar("tidb_distsql_scan_concurrency", SCOPE_BOTH, 8, "int", 1, 256),
     SysVar("tidb_opt_agg_push_down", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_enable_mpp", SCOPE_BOTH, True, "bool"),
+    # memo-based join search (reference cascades dispatch
+    # optimizer.go:335-341); default off like the reference
+    SysVar("tidb_enable_cascades_planner", SCOPE_BOTH, False, "bool"),
     SysVar("tidb_mpp_min_rows", SCOPE_BOTH, 1 << 16, "int", 0, None),
     SysVar("tidb_join_exec", SCOPE_BOTH, "auto", "enum",
            enum_vals=["auto", "host", "device"]),
