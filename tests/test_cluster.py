@@ -155,6 +155,42 @@ def test_owner_election_over_rpc(cluster):
     b.resign()
 
 
+def test_dxf_multinode_dispatch_and_balance(cluster):
+    """Multi-node DXF (VERDICT r2 item: DXF balancer — reference
+    dxf/framework/doc.go:30-33): subtasks fan out over both workers;
+    after an executor is stopped, its subtasks rebalance to the
+    survivor and the task still completes with correct results."""
+    res = cluster.dxf_run(
+        "sql_agg", [{"sql": "select count(*) from li where discount"
+                            f" = {d}"} for d in range(6)])
+    # every subtask returns ITS OWN shard's count: both workers
+    # together hold all rows, each subtask ran on one of them
+    assert all(len(r) == 1 for r in res)
+    # checksums are stable across re-runs (crc32, not salted hash):
+    # re-running the same subtask on the same worker set must agree
+    cs = cluster.dxf_run("checksum_range", [{"table": "li"}] * 2)
+    cs2 = cluster.dxf_run("checksum_range", [{"table": "li"}] * 2)
+    assert sorted(c["checksum"] for c in cs) == \
+        sorted(c["checksum"] for c in cs2)
+    assert all(c["rows"] > 0 for c in cs)
+    # kill worker 0's PROCESS (the real death mode: no goodbye): the
+    # NEXT task dispatches subtasks to it (the alive-set starts full),
+    # hits the dead executor mid-task, and rebalances those subtasks
+    # to the survivor
+    cluster.procs[0].kill()
+    cluster.procs[0].wait(timeout=30)
+    res2 = cluster.dxf_run(
+        "sql_agg", [{"sql": "select count(*) from li where discount"
+                            f" = {d}"} for d in range(6)])
+    assert all(len(r) == 1 for r in res2)
+    # worker 1 alone holds only ITS shard: the failover counts come
+    # from the survivor's shard (strictly fewer rows than the total)
+    total_w1 = sum(int(r[0][0]) for r in res2)
+    assert 0 < total_w1 < 2000
+    # recover worker 0 for the death-recovery test below
+    cluster._recover_worker(0)
+
+
 def test_worker_death_recovers_and_query_completes(cluster):
     """Storage fault path (VERDICT r2 item 9; reference
     copr/coprocessor.go:525 retry + dxf rebalance off dead executors):

@@ -15,7 +15,12 @@ from .rpc import send_msg, recv_msg, deserialize_partials
 
 class _WorkerClient:
     def __init__(self, port):
+        import threading
         self.port = port
+        # one socket per worker: concurrent callers (dxf_run fans out
+        # per-SUBTASK threads) must serialize send+recv or interleave
+        # each other's frames
+        self._call_mu = threading.Lock()
         self._connect()
 
     def _connect(self):
@@ -38,19 +43,20 @@ class _WorkerClient:
         import time
         if msg.get("op") not in self._IDEMPOTENT:
             retries = 0
-        for attempt in range(retries + 1):
-            try:
-                send_msg(self.sock, msg, arrays)
-                out, arrs = recv_msg(self.sock)
-                break
-            except (ConnectionError, OSError):
-                if attempt == retries:
-                    raise
-                time.sleep(0.05 * (2 ** attempt))
+        with self._call_mu:
+            for attempt in range(retries + 1):
                 try:
-                    self._connect()
-                except OSError:
-                    continue
+                    send_msg(self.sock, msg, arrays)
+                    out, arrs = recv_msg(self.sock)
+                    break
+                except (ConnectionError, OSError):
+                    if attempt == retries:
+                        raise
+                    time.sleep(0.05 * (2 ** attempt))
+                    try:
+                        self._connect()
+                    except OSError:
+                        continue
         if "err" in out:
             raise RuntimeError(out["err"])
         return out, arrs
@@ -296,6 +302,47 @@ class Cluster:
                     f"SPMD divergence on {k}"
         return {"sums": [ref[f"s{i}"] for i in range(ref_meta["nsums"])],
                 "counts": ref["counts"]}
+
+    def dxf_run(self, kind: str, payloads: list, concurrency: int = 4):
+        """Multi-node DXF (reference dxf/framework scheduler +
+        balancer, doc.go:30-33): dispatch {kind, payload} subtasks
+        round-robin over the workers; when an executor dies mid-task,
+        its unfinished subtasks re-assign to survivors, so the task
+        completes as long as one node lives. Returns results in
+        payload order; raises if every worker is gone or a subtask
+        fails on a LIVE worker.
+
+        CONTRACT (same as the reference's subtask model): handlers
+        must be idempotent/re-runnable — a subtask whose executor died
+        after executing but before replying is re-run on a survivor,
+        exactly like the reference re-dispatches subtasks of dead
+        executors. The dead-set is per task: a worker that timed out
+        here is retried fresh by the next task."""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        alive = set(range(len(self.workers)))
+        alive_mu = threading.Lock()
+
+        def run_one(i):
+            attempt = 0
+            while True:
+                with alive_mu:
+                    live = sorted(alive)
+                if not live:
+                    raise RuntimeError("dxf: no live executors")
+                widx = live[(i + attempt) % len(live)]
+                try:
+                    out, _ = self.workers[widx].call(
+                        {"op": "dxf_subtask", "kind": kind,
+                         "payload": payloads[i]})
+                    return out["result"]
+                except OSError:
+                    # executor death: balance this subtask away
+                    with alive_mu:
+                        alive.discard(widx)
+                    attempt += 1
+        with ThreadPoolExecutor(max_workers=max(concurrency, 1)) as ex:
+            return list(ex.map(run_one, range(len(payloads))))
 
     def query(self, sql: str, worker=0):
         out, _ = self.workers[worker].call({"op": "query", "sql": sql})

@@ -48,6 +48,14 @@ class WorkerServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            if self._stop.is_set():
+                # the wake-up poke from the stop handler (or a client
+                # racing shutdown): never serve it
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
@@ -60,7 +68,20 @@ class WorkerServer:
                 if op == "stop":
                     send_msg(conn, {"ok": True})
                     self._stop.set()
-                    self._sock.close()
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    # closing a listener does NOT wake a thread already
+                    # blocked in accept() (the kernel pins the open file
+                    # for the syscall's duration, so the port would stay
+                    # accepting forever); poke one connection through to
+                    # unblock it — serve_forever sees _stop and exits
+                    try:
+                        socket.create_connection(
+                            ("127.0.0.1", self.port), timeout=1).close()
+                    except OSError:
+                        pass
                     return
                 try:
                     out, out_arrays = self._handle(op, msg, arrays)
@@ -69,6 +90,14 @@ class WorkerServer:
                 send_msg(conn, out, out_arrays)
         except (ConnectionError, OSError):
             pass
+        finally:
+            # close EXPLICITLY: a lingering reference would withhold the
+            # FIN and leave peers blocking a full socket timeout before
+            # they notice this worker is gone
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _handle(self, op, msg, arrays):
         if op == "load_sql":
@@ -82,6 +111,15 @@ class WorkerServer:
             partials = self._partials(msg["sql"])
             meta, arrs = serialize_partials(partials)
             return {"ok": True, **meta}, arrs
+        if op == "dxf_subtask":
+            # per-node DXF task executor (reference
+            # dxf/framework/taskexecutor): run a registered task kind
+            # against this worker's shard
+            from ..dxf.remote import HANDLERS
+            fn = HANDLERS.get(msg["kind"])
+            if fn is None:
+                raise ValueError(f"unknown dxf kind {msg['kind']}")
+            return {"ok": True, "result": fn(self, msg["payload"])}, {}
         if op == "table_rows":
             # PHYSICAL row count (includes closed version rows): the
             # SPMD row capacity must cover what snapshot() binds, not

@@ -1,0 +1,52 @@
+"""Distributed DXF executors (reference pkg/dxf/framework: owner-side
+scheduler + per-NODE taskexecutor + the balancer that moves subtasks
+off dead executors, framework/doc.go:30-33).
+
+The single-process TaskManager (framework.py) runs subtask closures on
+a thread pool; across a cluster, closures can't travel — the reference
+registers task TYPES and ships (kind, meta). Same here: HANDLERS maps a
+kind to a worker-side function `fn(worker, payload) -> json-able`; the
+coordinator dispatches {kind, payload} subtasks over cluster RPC
+(worker op `dxf_subtask`) and Cluster.dxf_run balances them across
+live workers, re-assigning a dead executor's subtasks to survivors.
+"""
+from __future__ import annotations
+
+HANDLERS: dict = {}
+
+
+def register(kind: str):
+    def deco(fn):
+        HANDLERS[kind] = fn
+        return fn
+    return deco
+
+
+@register("sql_agg")
+def _sql_agg(worker, payload):
+    """Run one SQL statement against the worker's shard; returns rows
+    as JSON-able lists (the building block for distributed ANALYZE /
+    TTL / backfill scans — each node computes over ITS shard)."""
+    rows = worker.sess.execute(payload["sql"]).rows
+    out = []
+    for r in rows:
+        out.append([v if isinstance(v, (int, float, str, type(None)))
+                    else str(v) for v in r])
+    return out
+
+
+@register("checksum_range")
+def _checksum_range(worker, payload):
+    """ADMIN CHECKSUM-style shard pass (reference dxf example app
+    framework/example/doc.go): fold the worker's rows of a table into
+    one integer so the coordinator can cheaply verify shard coverage.
+    crc32, NOT hash(): Python's hash is salted per process, and these
+    values must compare across workers and runs."""
+    import zlib
+    rows = worker.sess.execute(
+        f"select * from {payload['table']}").rows
+    acc = 0
+    for r in rows:
+        # order-independent fold (workers scan in their own order)
+        acc ^= zlib.crc32("\x1f".join(map(str, r)).encode())
+    return {"rows": len(rows), "checksum": acc}
