@@ -1,0 +1,67 @@
+"""Span tracing + flight recorder + error catalog + structured log
+(VERDICT r2 observability gaps; reference pkg/util/tracing,
+pkg/util/traceevent, pkg/errno + errors.toml, pkg/util/logutil)."""
+import json
+
+from tidb_tpu.testkit import TestKit
+
+
+def test_trace_events_ring_and_slow_trigger():
+    tk = TestKit()
+    tk.must_exec("create table tr (a int)")
+    tk.must_exec("insert into tr values (1),(2),(3)")
+    tk.must_query("select sum(a) from tr")
+    spans = [r for r in tk.must_query(
+        "select depth, span, attrs from "
+        "information_schema.tidb_trace_events").rows]
+    names = {s[1] for s in spans}
+    # the statement stage tree: statement -> plan/execute -> copr
+    assert {"statement", "plan", "execute", "copr"} <= names, names
+    copr = [s for s in spans if s[1] == "copr" and "table=tr" in s[2]]
+    assert copr and any("backend=" in s[2] for s in copr), spans
+    # nesting depths recorded
+    assert any(int(s[0]) == 2 for s in copr), copr
+    # flight-recorder trigger: slow statements tag their spans
+    tk.must_exec("set tidb_slow_log_threshold = 0")
+    tk.must_query("select count(*) from tr")
+    tagged = tk.must_query(
+        "select count(*) from information_schema.tidb_trace_events "
+        "where attrs like '%slow=1%'").rows
+    assert int(tagged[0][0]) >= 1
+
+
+def test_error_catalog_unique_codes():
+    from tidb_tpu.errors import catalog
+    cat = catalog()
+    assert len(cat) > 25
+    codes = [c for _n, c, _s in cat]
+    assert len(codes) == len(set(codes)), "duplicate error codes"
+    tk = TestKit()
+    rows = tk.must_query("select error, code, sqlstate from "
+                         "information_schema.tidb_errors "
+                         "where error = 'DuplicateKeyError'").rows
+    assert rows == [("DuplicateKeyError", 1062, "23000")]
+
+
+def test_structured_log_redacts_literals(tmp_path, monkeypatch):
+    from tidb_tpu.utils import logutil
+    assert logutil.redact_sql(
+        "select * from t where secret = 'hunter2' and id = 42"
+    ).count("hunter2") == 0
+    # slow query logs the NORMALIZED statement, never raw literals;
+    # pin the sink to a private file (another test's durable store may
+    # have redirected the process-wide sink)
+    sink = open(tmp_path / "log.jsonl", "a", buffering=1)
+    monkeypatch.setattr(logutil, "_SINK", sink)
+    tk = TestKit()
+    tk.must_exec("create table lg (a int, s varchar(20))")
+    tk.must_exec("set tidb_slow_log_threshold = 0")
+    tk.must_query("select * from lg where s = 'topsecretvalue'")
+    sink.flush()
+    recs = [json.loads(l) for l in
+            open(tmp_path / "log.jsonl").read().splitlines()
+            if l.startswith("{")]
+    slow = [r for r in recs if r.get("event") == "slow_query"]
+    assert slow, recs
+    assert all("topsecretvalue" not in json.dumps(r) for r in slow)
+    assert any("?" in r.get("sql", "") for r in slow)

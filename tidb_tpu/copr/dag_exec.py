@@ -115,6 +115,17 @@ class CoprExecutor:
         # early without running a backend — a stale tag from the
         # previous execute must not leak into EXPLAIN ANALYZE
         self.last_backend = ""
+        dom = getattr(self, "domain", None)
+        if dom is not None:
+            with dom.tracer.span("copr",
+                                 table=dag.table_info.name):
+                return self._execute_inner(dag, overlay, read_ts,
+                                           use_mpp, mpp_min_rows)
+        return self._execute_inner(dag, overlay, read_ts, use_mpp,
+                                   mpp_min_rows)
+
+    def _execute_inner(self, dag, overlay, read_ts, use_mpp,
+                       mpp_min_rows):
         if dag.table_info.id <= -1000:      # INFORMATION_SCHEMA virtual
             tbl = self._materialize_virtual(dag.table_info)
             read_ts = None
@@ -168,6 +179,9 @@ class CoprExecutor:
         dom = getattr(self, "domain", None)
         if dom is not None:
             dom.inc_metric(name)
+            # the copr span covers this (sub)dag's scan+kernel stage:
+            # tag it with the backend that actually served it
+            dom.tracer.tag(backend=self.last_backend)
 
     def _apply_overlay(self, dag, tbl, arrays, valid, n, overlay):
         valid = valid.copy()

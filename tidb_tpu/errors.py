@@ -68,3 +68,14 @@ QueryKilledError = _err("QueryKilledError", 1317, "70100")
 # Privilege
 AccessDeniedError = _err("AccessDeniedError", 1045, "28000")
 PrivilegeCheckFailError = _err("PrivilegeCheckFailError", 1142, "42000")
+
+def catalog() -> list:
+    """Every registered error: (name, code, sqlstate) — the queryable
+    analog of the reference's errors.toml (surfaced as
+    information_schema.tidb_errors; uniqueness of codes is CI-tested)."""
+    out = []
+    for name, obj in sorted(globals().items()):
+        if isinstance(obj, type) and issubclass(obj, TiDBError) and \
+                obj is not TiDBError:   # the abstract base is not a
+            out.append((obj.__name__, obj.code, obj.sqlstate))  # registered error
+    return out

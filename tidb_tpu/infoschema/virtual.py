@@ -83,6 +83,21 @@ def _gen_metrics(domain):
         yield (k, float(v))
 
 
+def _gen_errors(domain):
+    from ..errors import catalog
+    for name, code, sqlstate in catalog():
+        yield (name, code, sqlstate)
+
+
+def _gen_trace_events(domain):
+    """Flight-recorder ring (reference pkg/util/traceevent dumped on
+    triggers; here queryable directly): recent spans with nesting depth,
+    duration, and attributes — slow statements tag theirs slow=1."""
+    for wall, conn_id, depth, name, dur_ms, attrs in \
+            domain.flight_recorder.events():
+        yield (wall, conn_id, depth, name, dur_ms, attrs)
+
+
 def _gen_top_sql(domain):
     """Top resource-consuming statements by total time (reference
     TopSQL's per-digest CPU attribution, surfaced as a table instead of
@@ -227,6 +242,12 @@ VIRTUAL_DEFS = {
                            _gen_stmt_summary),
     "metrics_summary": (_cols(("metrics_name", _S()), ("sum_value", _F())),
                         _gen_metrics),
+    "tidb_errors": (_cols(("error", _S()), ("code", _I()),
+                          ("sqlstate", _S())), _gen_errors),
+    "tidb_trace_events": (_cols(("time", _F()), ("conn_id", _I()),
+                                ("depth", _I()), ("span", _S()),
+                                ("duration_ms", _F()), ("attrs", _S())),
+                          _gen_trace_events),
     "tidb_top_sql": (_cols(("sql_digest", _S()), ("sql_text", _S()),
                            ("cpu_time_total", _F()), ("exec_count", _I()),
                            ("cpu_time_avg", _F())), _gen_top_sql),

@@ -86,13 +86,18 @@ class Server:
                 peer_host = sock.getpeername()[0]
             except OSError:
                 peer_host = "%"
+            from ..utils import logutil
             if not sess.domain.priv.auth_native(user, peer_host, salt,
                                                 token):
+                logutil.warn("auth_failed", user=user, host=peer_host,
+                             conn=sess.conn_id)
                 io.write_packet(P.err_packet(
                     1045, "28000",
                     f"Access denied for user '{user}'@'{peer_host}' "
                     f"(using password: {'YES' if token else 'NO'})"))
                 return
+            logutil.info("conn_open", user=user, host=peer_host,
+                         conn=sess.conn_id)
             sess.user = user
             sess.host = peer_host
             if db:

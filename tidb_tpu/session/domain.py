@@ -59,6 +59,9 @@ class Domain:
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}
         self.metrics: dict = {}   # counter name -> value (prometheus analog)
+        from ..utils.tracing import FlightRecorder, Tracer
+        self.flight_recorder = FlightRecorder()
+        self.tracer = Tracer(self.flight_recorder)
         from ..privilege import PrivManager
         self.priv = PrivManager(self)
         self._live_execs: dict = {}       # conn_id -> [ExecContext]
@@ -78,6 +81,9 @@ class Domain:
         self.digest_cache: dict = {}      # sql -> (normalized, digest)
         self._syncload_attempted: set = set()
         if data_dir:
+            from ..utils import logutil
+            logutil.set_sink_dir(data_dir)
+            logutil.info("store_open", data_dir=data_dir)
             self._open_wal(data_dir)
 
     def _open_wal(self, data_dir):

@@ -122,14 +122,16 @@ class Session:
         if rg is not None:
             rg.admit()               # token-bucket admission control
         start = time.time()
-        try:
-            rs = self._dispatch(stmt, params)
-            self._observe(stmt, sql, start, ok=True, rgroup=rg)
-            return rs
-        except TiDBError:
-            self._observe(stmt, sql, start, ok=False, rgroup=rg)
-            self._finish_stmt(error=True)
-            raise
+        with self.domain.tracer.span("statement", conn_id=self.conn_id,
+                                     stmt=type(stmt).__name__):
+            try:
+                rs = self._dispatch(stmt, params)
+                self._observe(stmt, sql, start, ok=True, rgroup=rg)
+                return rs
+            except TiDBError:
+                self._observe(stmt, sql, start, ok=False, rgroup=rg)
+                self._finish_stmt(error=True)
+                raise
 
     def _observe(self, stmt, sql, start, ok, rgroup=None):
         """Slow log + statement summary (reference slow_log.go:373 +
@@ -139,12 +141,6 @@ class Session:
             # request-unit blend: ~1 RU per 3ms of statement time + a
             # per-request base (reference resource_control RU model)
             rgroup.settle(dur_ms / 3.0 + 0.125)
-        threshold = int(self.vars.get("tidb_slow_log_threshold"))
-        if threshold >= 0 and dur_ms > threshold:
-            self.domain.slow_log.append({
-                "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
-                "stmt": type(stmt).__name__, "conn": self.conn_id,
-                "db": self.vars.current_db, "success": ok})
         nd = self.domain.digest_cache.get(sql)
         if nd is None:
             try:
@@ -156,6 +152,24 @@ class Session:
                 self.domain.digest_cache.clear()
             self.domain.digest_cache[sql] = nd
         norm, digest = nd
+        threshold = int(self.vars.get("tidb_slow_log_threshold"))
+        if threshold >= 0 and dur_ms > threshold:
+            # flight-recorder trigger (reference session.go:2417-2423
+            # dumps the traceevent ring on slow statements): tag the
+            # open statement span AND reach back for its already-closed
+            # stage spans (plan/execute/copr finished before the
+            # statement knew it was slow)
+            self.domain.tracer.tag(slow=1)
+            self.domain.flight_recorder.tag_recent(self.conn_id, start)
+            self.domain.slow_log.append({
+                "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
+                "stmt": type(stmt).__name__, "conn": self.conn_id,
+                "db": self.vars.current_db, "success": ok})
+            from ..utils import logutil
+            # the digest normalization IS the redaction (one parse,
+            # shared with the statement summary below)
+            logutil.warn("slow_query", conn=self.conn_id,
+                         ms=round(dur_ms, 1), ok=ok, sql=norm[:2048])
         summ = self.domain.stmt_summary_map.setdefault(digest, {
             "digest": digest, "normalized": norm[:1024],
             "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0})
@@ -703,7 +717,8 @@ class Session:
                     self._check_read(rdb, rtbl)
         if plan is None:
             pctx = self._plan_ctx(params)
-            plan = optimize(stmt, pctx)
+            with dom.tracer.span("plan", conn_id=self.conn_id):
+                plan = optimize(stmt, pctx)
             if ck is not None and pctx.cacheable:
                 dom.plan_cache[ck] = plan
                 dom.plan_cache_order.append(ck)
@@ -714,12 +729,13 @@ class Session:
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
         self.domain.register_exec(self.conn_id, ectx)
         ex = build_executor(ectx, plan)
-        ex.open()
-        try:
-            chunks = ex.all_chunks()
-        finally:
-            ex.close()
-            self.domain.unregister_exec(self.conn_id, ectx)
+        with dom.tracer.span("execute", conn_id=self.conn_id):
+            ex.open()
+            try:
+                chunks = ex.all_chunks()
+            finally:
+                ex.close()
+                self.domain.unregister_exec(self.conn_id, ectx)
         if getattr(plan, "for_update", False) and self._explicit_txn:
             self._lock_for_update(plan, chunks)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]

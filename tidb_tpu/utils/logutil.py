@@ -1,0 +1,88 @@
+"""Structured logging with redaction (reference pkg/util/logutil —
+zap JSON logs — plus the tidb_redact_log behavior: user data never
+reaches log files; statements are logged in normalized form with
+literals replaced by '?').
+
+One process-wide JSONL sink: stderr by default, or <data_dir>/tidb.log
+when the store is durable. Every line is one event object:
+    {"ts": ..., "level": "...", "event": "...", ...fields}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_MU = threading.Lock()
+_SINK = None          # file object or None -> stderr
+_ENABLED = os.environ.get("TIDB_TPU_LOG", "1") != "0"
+
+
+def set_sink_dir(data_dir: str):
+    """Durable stores log to <data_dir>/tidb.log (append). The sink is
+    process-wide (one log stream per process, like the reference's
+    global zap logger); opening a NEW durable store redirects it and
+    CLOSES the previous file — a torn-down store's sink must not keep
+    swallowing later domains' lines into an unlinked file."""
+    global _SINK
+    with _MU:
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except OSError:
+                pass
+        os.makedirs(data_dir, exist_ok=True)
+        _SINK = open(os.path.join(data_dir, "tidb.log"), "a",
+                     buffering=1)
+
+
+def reset_sink():
+    """Back to stderr (in-memory domains, tests)."""
+    global _SINK
+    with _MU:
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except OSError:
+                pass
+        _SINK = None
+
+
+def redact_sql(sql: str) -> str:
+    """Literals out, shape in: the digest normalizer already computes
+    the redacted form (reference: tidb_redact_log=ON logs normalized
+    statements)."""
+    try:
+        from ..parser import normalize_digest
+        norm, _ = normalize_digest(sql)
+        return norm[:2048]
+    except Exception:               # noqa: BLE001
+        return "<unparseable>"
+
+
+def log(level: str, event: str, **fields):
+    if not _ENABLED:
+        return
+    rec = {"ts": round(time.time(), 3), "level": level, "event": event}
+    rec.update(fields)
+    line = json.dumps(rec, default=str)
+    with _MU:
+        out = _SINK if _SINK is not None else sys.stderr
+        try:
+            print(line, file=out)
+        except (ValueError, OSError):
+            pass                     # closed sink during shutdown
+
+
+def info(event: str, **fields):
+    log("info", event, **fields)
+
+
+def warn(event: str, **fields):
+    log("warn", event, **fields)
+
+
+def error(event: str, **fields):
+    log("error", event, **fields)

@@ -1,0 +1,98 @@
+"""Span tracing + flight recorder (reference pkg/util/tracing — span
+regions around statement stages, rendered by TRACE — and
+pkg/util/traceevent — an in-memory ring of recent events that survives
+until something goes wrong and is then inspectable).
+
+Redesign notes: the reference pushes spans to OpenTracing and dumps the
+flight-recorder ring to a file on triggers (session.go:2417-2423).
+Here the ring IS the queryable surface — every span lands in a bounded
+deque exposed as `information_schema.tidb_trace_events`, so "dump on
+trigger" becomes "SELECT after the fact", and slow statements tag their
+spans so the interesting flights are findable. Overhead when idle: one
+perf_counter pair and a deque append per span."""
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+
+
+class FlightRecorder:
+    """Bounded ring of finished spans (reference traceevent ring)."""
+
+    def __init__(self, cap: int = 4096):
+        self.ring: collections.deque = collections.deque(maxlen=cap)
+        self._mu = threading.Lock()
+
+    def record(self, ev: tuple):
+        with self._mu:
+            self.ring.append(ev)
+
+    def events(self) -> list:
+        with self._mu:
+            return list(self.ring)
+
+    def tag_recent(self, conn_id: int, since: float, tag: str = "slow=1"):
+        """Retroactively mark a connection's spans recorded since
+        `since` — child spans (plan/execute/copr) finish BEFORE the
+        statement span decides it was slow, so the trigger reaches back
+        into the ring (the reference's ring dump captures the same
+        already-finished events)."""
+        with self._mu:
+            for i, ev in enumerate(self.ring):
+                if ev[0] >= since and ev[1] == conn_id and \
+                        tag not in ev[5]:
+                    self.ring[i] = ev[:5] + (
+                        (ev[5] + ";" + tag) if ev[5] else tag,)
+
+    def clear(self):
+        with self._mu:
+            self.ring.clear()
+
+
+class _Span:
+    __slots__ = ("name", "depth", "start", "attrs", "conn_id")
+
+    def __init__(self, name, depth, attrs, conn_id):
+        self.name = name
+        self.depth = depth
+        self.start = time.perf_counter()
+        self.attrs = attrs
+        self.conn_id = conn_id
+
+
+class Tracer:
+    """Per-domain tracer; span nesting tracked per thread."""
+
+    def __init__(self, recorder: FlightRecorder):
+        self.recorder = recorder
+        self._tls = threading.local()
+        self.enabled = True
+
+    @contextlib.contextmanager
+    def span(self, name: str, conn_id: int | None = None, **attrs):
+        if not self.enabled:
+            yield None
+            return
+        parent = getattr(self._tls, "cur", None)
+        if conn_id is None:      # inherit: child spans (copr kernels)
+            conn_id = parent.conn_id if parent else 0
+        sp = _Span(name, (parent.depth + 1) if parent else 0, attrs,
+                   conn_id)
+        self._tls.cur = sp
+        try:
+            yield sp
+        finally:
+            self._tls.cur = parent
+            dur_ms = (time.perf_counter() - sp.start) * 1000.0
+            self.recorder.record((
+                time.time(), conn_id, sp.depth, name, dur_ms,
+                ";".join(f"{k}={v}" for k, v in sp.attrs.items())))
+
+    def tag(self, **attrs):
+        """Attach attributes to the innermost open span (e.g. the slow
+        trigger marking a statement's spans as interesting)."""
+        sp = getattr(self._tls, "cur", None)
+        if sp is not None:
+            sp.attrs.update(attrs)
