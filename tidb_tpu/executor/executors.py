@@ -1620,6 +1620,85 @@ class HashJoinExec(Executor):
             return bv, pv
         return _void_view(bk), _void_view(pk)
 
+    def _push_runtime_filter(self, plan, build_exec, build_chunks,
+                             probe_exec):
+        """Build-side key bounds pushed into the probe scan (reference
+        pkg/planner/core/runtime_filter_generator.go — there planned
+        into TiFlash scans; here applied at execution, when the build
+        values are KNOWN, onto the probe TableReader's device filters).
+        Only join types whose probe side emits nothing without a match
+        (inner/semi) can filter the probe; only bare int columns keyed
+        on a plain reader qualify — everything else just runs as-is."""
+        if plan.join_type not in ("inner", "semi") or not plan.eq_conds \
+                or getattr(plan, "null_aware", False):
+            return
+        reader = probe_exec
+        while not isinstance(reader, TableReaderExec):
+            inner = getattr(reader, "inner", None)   # TimedExec wrapper
+            if inner is not None:
+                reader = inner
+                continue
+            return
+        if reader.dag.aggs or reader.dag.group_items:
+            return
+        from ..expression import ScalarFunc, const_from_py
+        Column = ExprCol
+        dag_idxs = {sc.col.idx: sc.col for sc in reader.dag.cols}
+        build_schema = self.children[plan.build_side].schema
+        new_filters = []
+        for a, b in plan.eq_conds:
+            probe_e, build_e = (a, b) if plan.build_side == 1 else (b, a)
+            if not isinstance(probe_e, Column) or \
+                    probe_e.idx not in dag_idxs:
+                continue
+            col = dag_idxs[probe_e.idx]
+            # BOTH sides must be plain ints: a DECIMAL build key
+            # evaluates to scaled ints (value * 10^scale) on host, and
+            # pushing those against an unscaled probe column would
+            # filter out every real match
+            if col.ft.tclass not in (TypeClass.INT, TypeClass.UINT) or \
+                    build_e.ft is None or \
+                    build_e.ft.tclass not in (TypeClass.INT,
+                                              TypeClass.UINT):
+                continue
+            vals = []
+            for ch in build_chunks:
+                cols = bind_chunk(build_schema, ch)
+                ectx = EvalCtx(np, len(ch), cols, host=True)
+                d, nl, sd = eval_expr(ectx, build_e)
+                if sd is not None:
+                    vals = None
+                    break
+                nm = np.asarray(materialize_nulls(ectx, nl))
+                arr = np.asarray(d)
+                if arr.dtype.kind not in "iu":
+                    vals = None
+                    break
+                vals.append(arr[~nm] if nm.any() else arr)
+            if vals is None or not vals:
+                continue
+            allv = np.concatenate(vals)
+            if not len(allv):
+                continue
+            uniq = np.unique(allv)
+            if len(uniq) <= 512:
+                new_filters.append(ScalarFunc(
+                    "in", [col] + [const_from_py(int(v), col.ft)
+                                   for v in uniq.tolist()],
+                    new_bigint_type()))
+            else:
+                new_filters.append(ScalarFunc(
+                    ">=", [col, const_from_py(int(allv.min()), col.ft)],
+                    new_bigint_type()))
+                new_filters.append(ScalarFunc(
+                    "<=", [col, const_from_py(int(allv.max()), col.ft)],
+                    new_bigint_type()))
+        if new_filters:
+            import dataclasses
+            reader.dag = dataclasses.replace(
+                reader.dag, filters=reader.dag.filters + new_filters)
+            self.ctx.sess.domain.inc_metric("runtime_filter_pushed")
+
     def _join(self):
         """Collect inputs; in-memory join, or grace hash partitioning to
         disk when the inputs exceed the memory quota (reference
@@ -1628,6 +1707,11 @@ class HashJoinExec(Executor):
         build_exec = self.children[plan.build_side]
         probe_exec = self.children[1 - plan.build_side]
         build_chunks = build_exec.all_chunks()
+        # runtime filter (reference runtime_filter_generator.go): the
+        # build side ran first — derive key bounds (or a small IN set)
+        # and push them into the probe side's device scan BEFORE it runs
+        self._push_runtime_filter(plan, build_exec, build_chunks,
+                                  probe_exec)
         probe_chunks = probe_exec.all_chunks()
 
         def chunks_bytes(chs):
