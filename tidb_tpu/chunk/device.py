@@ -45,7 +45,8 @@ class StringDict:
     """Per-column string dictionary: code <-> str, append-only."""
 
     __slots__ = ("values", "index", "sort_keys", "_vec_cache",
-                 "_ci_norm", "_ci_fold", "_ci_ranks", "_rank_codes")
+                 "_ci_norm", "_ci_fold", "_ci_ranks", "_ci_fold_ranks",
+                 "_rank_codes")
 
     def __init__(self):
         self.values: list[str] = []
@@ -56,6 +57,7 @@ class StringDict:
         self._ci_norm = None   # code -> canonical code (same dict)
         self._ci_fold = None   # (fold_codes, fold_dict)
         self._ci_ranks = None  # code -> ci sort rank
+        self._ci_fold_ranks = None  # (nvalues, code -> folded ci rank)
         self._rank_codes = None  # ((ci, n), (code_map, sorted dict))
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
@@ -168,6 +170,22 @@ class StringDict:
             self._ci_ranks = ranks[:len(self.values)] if self.values \
                 else ranks
         return self._ci_ranks
+
+    def ci_fold_ranks(self) -> np.ndarray:
+        """rank[code] under ci EQUALITY + order: values sharing the
+        ci+pad normal form get the SAME rank (MySQL: 'aa' = 'AA' —
+        peers in window frames, equal sort keys), ranks ascend in ci
+        order. ci_ranks() keeps a byte tiebreak and is for ORDER-only
+        uses (min/max code remap)."""
+        if self._ci_fold_ranks is None or \
+                self._ci_fold_ranks[0] != len(self.values):
+            folded = [self.ci_fold(v) if v is not None else ""
+                      for v in self.values]
+            pos = {f: r for r, f in enumerate(sorted(set(folded)))}
+            ranks = np.array([pos[f] for f in folded] or [0],
+                             dtype=np.int64)
+            self._ci_fold_ranks = (len(self.values), ranks)
+        return self._ci_fold_ranks[1]
 
     def rank_codes(self, ci: bool = False):
         """-> (code_map, rank_ordered_dict): values re-encoded into a

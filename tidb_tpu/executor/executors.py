@@ -807,7 +807,11 @@ def _sort_key_arrays(schema, chunk, items):
         data = np.asarray(data)
         if sdict is not None:
             from ..expression.vec import _is_ci
-            ranks = sdict.ci_ranks() if _is_ci(e.ft) else sdict.ranks()
+            # folded ranks: ci-equal spellings share a key value, so
+            # sort order AND equality (window peers/partitions) both
+            # follow the collation
+            ranks = sdict.ci_fold_ranks() if _is_ci(e.ft) \
+                else sdict.ranks()
             data = ranks[data]
         elif data.dtype == object:
             if nm.any():
@@ -815,10 +819,10 @@ def _sort_key_arrays(schema, chunk, items):
                 # null-order sentinel below overrides these positions
                 data = data.copy()
                 data[nm] = data[~nm][0] if (~nm).any() else 0
-            order = np.argsort(data, kind="stable")
-            r = np.empty(n, dtype=np.int64)
-            r[order] = np.arange(n)
-            data = r
+            # dense ranks: EQUAL values must share a rank — these keys
+            # also drive window partition/peer boundary equality
+            _, inv = np.unique(data, return_inverse=True)
+            data = inv.astype(np.int64)
         if data.dtype == bool:
             data = data.astype(np.int64)
         if desc:

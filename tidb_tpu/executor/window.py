@@ -54,28 +54,47 @@ class WindowExec(Executor):
 
     def _one_desc(self, d, ectx, chunk, n) -> Column:
         items = [(e, False) for e in d.partition_by] + list(d.order_by)
-        if items:
-            keys = _sort_key_arrays(self.child.schema, chunk, items)
-            order = np.lexsort(list(reversed(keys)))
+        keys = _sort_key_arrays(self.child.schema, chunk, items) \
+            if items else []
+        name = d.name
+        if d.args:
+            adata, anulls, asd = eval_expr(ectx, d.args[0])
+            nm = np.asarray(materialize_nulls(ectx, anulls))
+            vals = np.asarray(adata) if not np.isscalar(adata) \
+                else np.full(n, adata)
+            if name in ("min", "max") and asd is not None:
+                # dict codes are insertion-ordered: numeric MIN/MAX over
+                # raw codes returns first-inserted, not smallest — remap
+                # through the rank-ordered dict (same fix as the agg
+                # path's _minmaxkey)
+                from ..expression.vec import _is_ci
+                code_map, asd = asd.rank_codes(_is_ci(d.ft))
+                vals = code_map[vals.astype(np.int64)]
+            vals0, ok0 = vals, ~nm
         else:
-            order = np.arange(n)
-        # partition boundaries in sorted order
+            vals0 = np.ones(n, dtype=np.int64)
+            ok0 = np.ones(n, dtype=bool)
+            asd = None
+        # device first: the kernel sorts on device, so the host lexsort
+        # and boundary passes below would be thrown-away work on a hit
+        dres = self._try_device(d, name, keys, vals0, ok0, asd, n)
+        if dres is not None:
+            out, nulls, out_dict = dres
+            return Column(d.ft, out, nulls, out_dict)
+        order = np.lexsort(list(reversed(keys))) if items \
+            else np.arange(n)
+        # boundary flags come from the SAME key arrays the sort (and the
+        # device kernel) use — collation ranks, float keys, and NULL
+        # sentinels all share one equality notion, so host and device
+        # can't disagree across the size threshold
+        npart = len(d.partition_by)
         part_start_flag = np.zeros(n, dtype=bool)
         if n:
             part_start_flag[0] = True
-        for e in d.partition_by:
-            data, nulls, sd = eval_expr(ectx, e)
-            nm = np.asarray(materialize_nulls(ectx, nulls))
-            arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
-            if arr.dtype == object:
-                sarr = arr[order]
-                chg = np.ones(n, dtype=bool)
-                chg[1:] = sarr[1:] != sarr[:-1]
-            else:
-                key = np.where(nm, -(1 << 62), arr.astype(np.int64))
-                skey = key[order]
-                chg = np.ones(n, dtype=bool)
-                chg[1:] = skey[1:] != skey[:-1]
+        for key in keys[:npart]:
+            skey = key[order]
+            chg = np.ones(n, dtype=bool)
+            chg[1:] = skey[1:] != skey[:-1]
             part_start_flag |= chg
         part_id = np.cumsum(part_start_flag) - 1 if n else part_start_flag
         part_start = np.zeros(n, dtype=np.int64)
@@ -87,21 +106,10 @@ class WindowExec(Executor):
         part_end = ends[part_id] if n else part_start
         # peer groups: order-key change within partition
         peer_start_flag = part_start_flag.copy()
-        for e, _desc in d.order_by:
-            data, nulls, sd = eval_expr(ectx, e)
-            nm = np.asarray(materialize_nulls(ectx, nulls))
-            arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
-            if arr.dtype == object:
-                sarr = arr[order]
-                chg = np.ones(n, dtype=bool)
-                chg[1:] = sarr[1:] != sarr[:-1]
-            else:
-                key = np.where(nm, -(1 << 62),
-                               arr.view(np.int64) if arr.dtype.kind == "f"
-                               else arr.astype(np.int64))
-                skey = key[order]
-                chg = np.ones(n, dtype=bool)
-                chg[1:] = skey[1:] != skey[:-1]
+        for key in keys[npart:]:
+            skey = key[order]
+            chg = np.ones(n, dtype=bool)
+            chg[1:] = skey[1:] != skey[:-1]
             peer_start_flag |= chg
         peer_id = np.cumsum(peer_start_flag) - 1 if n else peer_start_flag
         pstarts = np.nonzero(peer_start_flag)[0]
@@ -111,19 +119,8 @@ class WindowExec(Executor):
 
         seq = np.arange(n) - part_start          # 0-based row num in partition
         size = part_end - part_start
-
-        name = d.name
-        if d.args:
-            adata, anulls, asd = eval_expr(ectx, d.args[0])
-            nm = np.asarray(materialize_nulls(ectx, anulls))
-            vals = np.asarray(adata) if not np.isscalar(adata) \
-                else np.full(n, adata)
-            svals = vals[order]
-            sok = (~nm)[order]
-        else:
-            svals = np.ones(n, dtype=np.int64)
-            sok = np.ones(n, dtype=bool)
-            asd = None
+        svals = vals0[order]
+        sok = ok0[order]
 
         if d.frame is not None and name in ("sum", "avg", "count", "min",
                                             "max", "first_value",
@@ -151,6 +148,75 @@ class WindowExec(Executor):
                 nulls = None
         return Column(d.ft, out, nulls, asd if name in (
             "lag", "lead", "first_value", "last_value", "min", "max") else None)
+
+    @staticmethod
+    def _lag_args(d):
+        """Parse lag/lead (expr [, offset [, default]]) once for both
+        paths. -> (offset | None if non-constant, raw default | None)."""
+        from ..expression import Constant
+        offset, default = 1, None
+        if len(d.args) > 1:
+            if not isinstance(d.args[1], Constant):
+                offset = None
+            else:
+                offset = int(d.args[1].value.val)
+        if len(d.args) > 2 and isinstance(d.args[2], Constant) and \
+                not d.args[2].value.is_null:
+            default = d.args[2].value.val
+        return offset, default
+
+    def _try_device(self, d, name, keys, vals0, ok0, asd, n):
+        """Route an eligible window spec to the device kernel
+        (executor/window_device.py): unbounded-frame rank/agg/lag
+        functions over int-comparable keys, above a size floor (tiny
+        windows aren't worth a device round trip). -> (out, nulls,
+        out_dict) in input-row order, or None to run the host path."""
+        import os
+        min_rows = int(os.environ.get("TIDB_TPU_WINDOW_MIN", 1 << 14))
+        from .window_device import DEVICE_FNS, run_window_device
+        if (d.frame is not None or name not in DEVICE_FNS or
+                not self.ctx.copr.use_device or n < min_rows):
+            return None
+        if vals0.dtype == object:            # big decimals: host-exact
+            return None
+        if name == "avg" and d.ft.tclass == TypeClass.DECIMAL:
+            return None                       # exact rounding on host
+        shift, default, out_dict = 0, None, None
+        if name in ("lag", "lead"):
+            offset, dv = self._lag_args(d)
+            if offset is None:                # non-constant offset
+                return None
+            if dv is not None:
+                if asd is not None:           # dict default needs encode
+                    return None
+                if not isinstance(dv, (int, float)):
+                    return None
+                if d.ft.tclass == TypeClass.DECIMAL:
+                    # column values are SCALED ints: scale the default
+                    # the same way (mirrors the host path)
+                    from ..types.decimal import dec_to_scaled_int
+                    dv = dec_to_scaled_int(dv, max(d.ft.decimal, 0))
+                default = dv
+            shift = -offset if name == "lag" else offset
+            out_dict = asd
+        if name in ("min", "max") and asd is not None:
+            # codes arrive already remapped into rank order by
+            # _one_desc (host/device share the same pre-map)
+            out_dict = asd
+        try:
+            res = run_window_device(
+                name, keys, len(d.partition_by), bool(d.order_by),
+                vals0, ok0, n, shift=shift, default=default)
+        except Exception:                     # noqa: BLE001
+            self.ctx.sess.domain.inc_metric("window_device_error")
+            return None
+        if res is None:
+            return None
+        out, nulls = res
+        self.ctx.sess.domain.inc_metric("window_device")
+        if name == "sum":
+            out = self._sum_scale(d, out)
+        return out, nulls, out_dict
 
     def _rows_bounds(self, d, part_start, part_end, n):
         """ROWS frame: [i-prec, i+fol] clipped to the partition."""
@@ -307,7 +373,6 @@ class WindowExec(Executor):
         if name == "cume_dist":
             return (peer_end - part_start) / np.maximum(size, 1), None
         if name == "ntile":
-            from ..expression import Constant
             nt = int(d.args[0].value.val) if d.args else 1
             q, r = np.divmod(size, max(nt, 1))
             # first r buckets get q+1 rows
@@ -317,17 +382,9 @@ class WindowExec(Executor):
                               r + (seq - big) // np.maximum(q, 1))
             return bucket + 1, None
         if name in ("lag", "lead"):
-            offset = 1
-            default = None
-            if len(d.args) > 1:
-                from ..expression import Constant
-                if isinstance(d.args[1], Constant):
-                    offset = int(d.args[1].value.val)
-            if len(d.args) > 2:
-                from ..expression import Constant
-                if isinstance(d.args[2], Constant) and \
-                        not d.args[2].value.is_null:
-                    default = d.args[2].value.val
+            offset, default = self._lag_args(d)
+            if offset is None:
+                offset = 1                    # non-constant: legacy host default
             shift = -offset if name == "lag" else offset
             idx = np.arange(n) + shift
             valid = (idx >= part_start) & (idx < part_end)
