@@ -82,6 +82,10 @@ def test_failpoint_prewrite_crash_no_orphan_locks():
     tk = TestKit()
     tk.must_exec("create table t (a int primary key, b int)")
     tk.must_exec("insert into t values (1, 1)")
+    # pin the classic prewrite/commit path (1PC/async skip the
+    # prewrite failpoint)
+    tk.must_exec("set @@tidb_enable_1pc = 0")
+    tk.must_exec("set @@tidb_enable_async_commit = 0")
     failpoint.enable("2pc-prewrite-done", "error")
     try:
         err = tk.exec_err("update t set b = 2 where a = 1")
@@ -104,6 +108,8 @@ from tidb_tpu.utils import failpoint
 dom = new_store({dd!r}, wal_sync=True)
 s = Session(dom)
 s.vars.current_db = "test"
+s.execute("set @@tidb_enable_1pc = 0")        # pin the classic 2PC path
+s.execute("set @@tidb_enable_async_commit = 0")
 s.execute("create table t (a int primary key, b int)")
 for i in range(5):
     s.execute(f"insert into t values ({{i}}, {{i * 10}})")
@@ -142,6 +148,121 @@ def test_kill9_mid_commit_loses_no_acked_txns(tmp_path):
     # durable too (crash-at-durability-point semantics)
     assert tk.must_query("select b from t where a = 99").rs.rows == \
         [(990,)]
+
+
+_ASYNC_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+import tidb_tpu
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.utils import failpoint
+dom = new_store({dd!r}, wal_sync=True)
+s = Session(dom)
+s.vars.current_db = "test"
+s.execute({setup!r})
+s.execute("create table t (a int primary key, b int)")
+print("READY", flush=True)
+failpoint.enable({fp!r}, "crash")
+try:
+    s.execute("insert into t values (7, 70)")
+except SystemExit:
+    raise
+print("UNREACHED", flush=True)
+"""
+
+
+def _run_crash_child(tmp_path, fp, setup="select 1"):
+    d = str(tmp_path / "dd")
+    script = _ASYNC_CRASH_CHILD.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        dd=d, fp=fp, setup=setup)
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, timeout=120)
+    assert b"READY" in r.stdout and b"UNREACHED" not in r.stdout
+    assert r.returncode == 137
+    return d
+
+
+def test_async_commit_crash_after_prewrite_is_committed(tmp_path):
+    """Async commit: the durable prewrite IS the commit point
+    (reference async-commit design) — a crash before finalize still
+    recovers the transaction, and recovery leaves no locks."""
+    d = _run_crash_child(tmp_path, "async-commit-prewrite-durable",
+                         setup="set @@tidb_enable_1pc = 0")
+    dom = new_store(d)
+    tk = _tk(dom)
+    assert tk.must_query("select b from t where a = 7").rs.rows == \
+        [(70,)]
+    assert not dom.storage.mvcc._locks
+
+
+def test_1pc_crash_before_wal_loses_only_unacked(tmp_path):
+    """1PC: a crash before the WAL append loses exactly the un-acked
+    transaction; the store recovers clean."""
+    d = _run_crash_child(tmp_path, "1pc-before-wal")
+    dom = new_store(d)
+    tk = _tk(dom)
+    assert tk.must_query("select count(*) from t where a = 7"
+                         ).rs.rows == [(0,)]
+    assert not dom.storage.mvcc._locks
+    tk.must_exec("insert into t values (7, 71)")   # store still writable
+    assert tk.must_query("select b from t where a = 7").rs.rows == \
+        [(71,)]
+
+
+def test_async_prewrite_abort_leaves_no_durable_frame(tmp_path):
+    """An error injected DURING an async prewrite aborts the txn
+    before its commit point: live state and post-restart state must
+    agree the write never happened (review finding: the WAL append
+    must be the last fallible step)."""
+    d = str(tmp_path / "dd")
+    dom = new_store(d, wal_sync=True)
+    tk = _tk(dom)
+    tk.must_exec("set @@tidb_enable_1pc = 0")   # force the async path
+    tk.must_exec("create table t (a int primary key, b int)")
+    failpoint.enable("2pc-prewrite-done", "error")
+    try:
+        err = tk.exec_err("insert into t values (5, 50)")
+        assert "injected" in str(err)
+    finally:
+        failpoint.disable("2pc-prewrite-done")
+    assert tk.must_query("select count(*) from t").rs.rows == [(0,)]
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select count(*) from t").rs.rows == [(0,)]
+    assert not dom2.storage.mvcc._locks
+
+
+def test_commit_mode_selection_and_metrics():
+    """Mode ladder: 1PC when enabled, async when 1PC off, classic 2PC
+    when both off or the txn exceeds the async keys cap."""
+    tk = TestKit()
+    tk.must_exec("create table m (a int primary key)")
+    dom = tk.domain
+
+    def delta(name, fn):
+        before = dom.metrics.get(name, 0)
+        fn()
+        return dom.metrics.get(name, 0) - before
+
+    assert delta("txn_1pc",
+                 lambda: tk.must_exec("insert into m values (1)")) >= 1
+    tk.must_exec("set @@tidb_enable_1pc = 0")
+    assert delta("txn_async_commit",
+                 lambda: tk.must_exec("insert into m values (2)")) >= 1
+    tk.must_exec("set @@tidb_enable_async_commit = 0")
+    assert delta("txn_2pc",
+                 lambda: tk.must_exec("insert into m values (3)")) >= 1
+    # big txn busts the keys cap even with the fast paths on
+    tk.must_exec("set @@tidb_enable_1pc = 1")
+    tk.must_exec("set @@tidb_enable_async_commit = 1")
+    tk.must_exec("set @@tidb_async_commit_keys_limit = 4")
+    many = ",".join(f"({i})" for i in range(10, 40))
+    assert delta("txn_2pc",
+                 lambda: tk.must_exec(f"insert into m values {many}")) \
+        >= 1
 
 
 def test_failpoint_ddl_ladder():

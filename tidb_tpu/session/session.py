@@ -67,6 +67,25 @@ class Session:
                 pessimistic=self.vars.get("tidb_txn_mode") == "pessimistic")
         return self._txn
 
+    def _commit_txn(self):
+        """Commit with the session's fast-path policy (reference
+        twoPhaseCommitter mode selection): 1PC > async commit > 2PC,
+        gated by sysvars and the async-commit size caps; the taken
+        path lands in metrics (txn_1pc / txn_async_commit / txn_2pc)."""
+        t = self._txn
+        t.commit(
+            async_commit=bool(self.vars.get("tidb_enable_async_commit")),
+            one_pc=bool(self.vars.get("tidb_enable_1pc")),
+            keys_limit=int(self.vars.get("tidb_async_commit_keys_limit")),
+            size_limit=int(self.vars.get(
+                "tidb_async_commit_total_key_size_limit")))
+        if t.commit_mode == "1pc":
+            self.domain.inc_metric("txn_1pc")
+        elif t.commit_mode == "async":
+            self.domain.inc_metric("txn_async_commit")
+        elif t.commit_mode == "2pc":
+            self.domain.inc_metric("txn_2pc")
+
     def _finish_stmt(self, error=False):
         if self._explicit_txn:
             if error and self._txn is not None:
@@ -77,13 +96,13 @@ class Session:
             if error:
                 self._txn.rollback()
             else:
-                self._txn.commit()
+                self._commit_txn()
         self._txn = None
 
     def commit(self):
         if self._txn is not None and not self._txn.committed and \
                 not self._txn.aborted:
-            self._txn.commit()
+            self._commit_txn()
         self._txn = None
         self._explicit_txn = False
 

@@ -103,6 +103,14 @@ for _v in [
     SysVar("tidb_device_batch_rows", SCOPE_BOTH, 1 << 22, "int", 1 << 10, 1 << 26),
     SysVar("tidb_txn_mode", SCOPE_BOTH, "pessimistic", "enum",
            enum_vals=["optimistic", "pessimistic"]),
+    # commit fast paths (reference vardef/tidb_vars.go:815
+    # TiDBEnableAsyncCommit / TiDBEnable1PC + the async-commit caps)
+    SysVar("tidb_enable_async_commit", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_enable_1pc", SCOPE_BOTH, True, "bool"),
+    SysVar("tidb_async_commit_keys_limit", SCOPE_BOTH, 256, "int",
+           1, None),
+    SysVar("tidb_async_commit_total_key_size_limit", SCOPE_BOTH,
+           4 << 10, "int", 1, None),
     SysVar("tidb_retry_limit", SCOPE_BOTH, 10, "int", 0, 100),
     SysVar("autocommit", SCOPE_BOTH, True, "bool"),
     SysVar("sql_mode", SCOPE_BOTH, "STRICT_TRANS_TABLES", "str"),

@@ -100,23 +100,87 @@ class MVCCStore:
             self._locks[key] = Lock(primary, start_ts, "lock")
 
     # ---- 2PC ----------------------------------------------------------
-    def prewrite(self, mutations: list, primary: bytes, start_ts: int):
-        """mutations: [(key, value|None)]; value None = delete."""
-        with self._mu:
-            for key, _ in mutations:
+    def _check_conflicts(self, mutations: list, start_ts: int):
+        """Lock + write-conflict check for every mutated key.
+        Caller holds self._mu."""
+        for key, _ in mutations:
+            lock = self._locks.get(key)
+            if lock is not None and lock.start_ts != start_ts:
+                raise LockWaitTimeoutError(
+                    "key is locked by txn %d", lock.start_ts)
+            vers = self._kv.get(key)
+            if vers is not None and vers.latest_ts() > start_ts:
+                raise WriteConflictError(
+                    "write conflict: key committed at ts %d > start_ts %d",
+                    vers.latest_ts(), start_ts)
+
+    def _apply(self, mutations: list, commit_ts: int,
+               release_start_ts: int | None = None):
+        """Write versions; optionally release that txn's locks on the
+        written keys. Caller holds self._mu."""
+        for key, value in mutations:
+            vers = self._kv.get(key)
+            if vers is None:
+                vers = _Versions()
+                self._kv.put(key, vers)
+            vers.add(commit_ts, value)
+            if release_start_ts is not None:
                 lock = self._locks.get(key)
-                if lock is not None and lock.start_ts != start_ts:
-                    raise LockWaitTimeoutError(
-                        "key is locked by txn %d", lock.start_ts)
-                vers = self._kv.get(key)
-                if vers is not None and vers.latest_ts() > start_ts:
-                    raise WriteConflictError(
-                        "write conflict: key committed at ts %d > start_ts %d",
-                        vers.latest_ts(), start_ts)
+                if lock is not None and lock.start_ts == release_start_ts:
+                    del self._locks[key]
+
+    def prewrite(self, mutations: list, primary: bytes, start_ts: int,
+                 min_commit_ts: int = 0):
+        """mutations: [(key, value|None)]; value None = delete.
+
+        With ``min_commit_ts`` set this is an ASYNC-COMMIT prewrite
+        (reference tidb_enable_async_commit,
+        vardef/tidb_vars.go TiDBEnableAsyncCommit; tikv async commit
+        design): the WAL frame is appended INSIDE the prewrite — once
+        it is durable the transaction is committed at min_commit_ts
+        even if the process dies before finalize_async runs (replay
+        applies the frame). The reference's cross-node secondary-lock
+        check collapses here because one mutex makes the prewrite of
+        all keys atomic. The WAL append is the LAST fallible step:
+        failpoints and conflict errors all fire before it, so an
+        aborted prewrite can never leave a durable frame behind."""
+        with self._mu:
+            self._check_conflicts(mutations, start_ts)
             for key, value in mutations:
                 op = "del" if value is None else "put"
                 self._locks[key] = Lock(primary, start_ts, op)
-        failpoint.inject("2pc-prewrite-done")
+            failpoint.inject("2pc-prewrite-done")
+            if min_commit_ts and self.wal is not None:
+                # the commit point: after this append, crash recovery
+                # commits the txn
+                self.wal.append(min_commit_ts, mutations)
+
+    def finalize_async(self, mutations: list, start_ts: int,
+                       commit_ts: int):
+        """Second half of an async commit: apply versions and release
+        locks. No WAL append (the prewrite's frame already made the
+        commit durable) and no raise sites — past the commit point the
+        transaction must not abort."""
+        with self._mu:
+            self._apply(mutations, commit_ts, release_start_ts=start_ts)
+        for hook in self.commit_hooks:
+            hook(commit_ts, mutations)
+
+    def one_pc(self, mutations: list, start_ts: int, commit_ts: int):
+        """1PC (reference tidb_enable_1pc): conflict check + WAL +
+        apply fused into ONE mutex pass — no prewrite lock round, no
+        lock window for readers to trip on. Only valid when every
+        mutation lives in this store (the cluster 2PC path never
+        routes here)."""
+        with self._mu:
+            self._check_conflicts(mutations, start_ts)
+            failpoint.inject("1pc-before-wal")
+            if self.wal is not None:
+                self.wal.append(commit_ts, mutations)
+            # release_start_ts also clears pessimistic locks we held
+            self._apply(mutations, commit_ts, release_start_ts=start_ts)
+        for hook in self.commit_hooks:
+            hook(commit_ts, mutations)
 
     def commit(self, mutations: list, start_ts: int, commit_ts: int):
         with self._mu:
@@ -133,25 +197,14 @@ class MVCCStore:
             if self.wal is not None:
                 self.wal.append(commit_ts, mutations)
             failpoint.inject("2pc-commit-after-wal")
-            for key, value in mutations:
-                vers = self._kv.get(key)
-                if vers is None:
-                    vers = _Versions()
-                    self._kv.put(key, vers)
-                vers.add(commit_ts, value)
-                del self._locks[key]
+            self._apply(mutations, commit_ts, release_start_ts=start_ts)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 
     def apply_replay(self, commit_ts: int, mutations: list):
         """WAL replay: apply a committed frame directly (no locks/WAL)."""
         with self._mu:
-            for key, value in mutations:
-                vers = self._kv.get(key)
-                if vers is None:
-                    vers = _Versions()
-                    self._kv.put(key, vers)
-                vers.add(commit_ts, value)
+            self._apply(mutations, commit_ts)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 

@@ -13,6 +13,7 @@ import threading
 from .kv import MemKV
 from ..native.memtable import new_memkv
 from .mvcc import MVCCStore
+from ..utils import failpoint
 
 
 class Oracle:
@@ -84,6 +85,7 @@ class Transaction:
         self._dirty = False
         self.committed = False
         self.aborted = False
+        self.commit_mode = None       # set by commit(): 1pc|async|2pc
         self._savepoints: list = []   # [(name, undo_len)]
         self._undo: list = []         # [(key, had_key, prev_value)]
         self._locked_keys: list = []  # pessimistic locks to release
@@ -185,17 +187,46 @@ class Transaction:
             self.storage.mvcc.rollback(leftover, self.start_ts)
         self._locked_keys = []
 
-    def commit(self):
+    def commit(self, async_commit=False, one_pc=False,
+               keys_limit=256, size_limit=4 << 10):
+        """Commit the memBuffer. Mode selection mirrors the reference
+        (tidb_enable_1pc / tidb_enable_async_commit with the
+        tidb_async_commit_keys_limit caps): small txns take the fused
+        1PC pass or the prewrite-is-the-commit-point async protocol;
+        everything else (and every caller that passes no flags —
+        bootstrap, meta txns, the cluster 2PC seam) runs classic
+        prewrite/commit. self.commit_mode records the path taken."""
         if not self._dirty:
             self._release_locks()
             self.committed = True
+            self.commit_mode = "read_only"
             return
         mutations = [(k, v) for k, v in self.mem_buffer.scan(b"")]
         primary = mutations[0][0]
         mvcc = self.storage.mvcc
-        mvcc.prewrite(mutations, primary, self.start_ts)
-        commit_ts = self.storage.oracle.get_ts()
-        mvcc.commit(mutations, self.start_ts, commit_ts)
+        small = (len(mutations) <= keys_limit and
+                 sum(len(k) for k, _ in mutations) <= size_limit)
+        if one_pc and small:
+            commit_ts = self.storage.oracle.get_ts()
+            mvcc.one_pc(mutations, self.start_ts, commit_ts)
+            self.commit_mode = "1pc"
+        elif async_commit and small:
+            # min_commit_ts doubles as the commit_ts: the oracle is
+            # centralized, so max(per-key min_commit_ts) == the one ts
+            commit_ts = self.storage.oracle.get_ts()
+            mvcc.prewrite(mutations, primary, self.start_ts,
+                          min_commit_ts=commit_ts)
+            # commit point passed (durable frame). The crash failpoint
+            # sits here; finalize_async itself has no raise sites, so
+            # the commit can no longer abort.
+            failpoint.inject("async-commit-prewrite-durable")
+            mvcc.finalize_async(mutations, self.start_ts, commit_ts)
+            self.commit_mode = "async"
+        else:
+            mvcc.prewrite(mutations, primary, self.start_ts)
+            commit_ts = self.storage.oracle.get_ts()
+            mvcc.commit(mutations, self.start_ts, commit_ts)
+            self.commit_mode = "2pc"
         self._release_locks(written={k for k, _ in mutations})
         self.committed = True
         return commit_ts
