@@ -303,6 +303,103 @@ class Cluster:
         return {"sums": [ref[f"s{i}"] for i in range(ref_meta["nsums"])],
                 "counts": ref["counts"]}
 
+    def add_index_distributed(self, table, index, columns, unique=False,
+                              db="test"):
+        """Distributed ADD INDEX (reference
+        pkg/ddl/backfilling_dist_scheduler.go + the DXF add-index app):
+        the coordinator drives the F1 ladder as cluster-wide barriers —
+        every node reaches delete-only, then write-only, then
+        write-reorg (a per-state broadcast = the schema-version sync) —
+        and dispatches one backfill subtask per shard. A shard's
+        subtask is PINNED to its node (data locality); if the node dies
+        mid-reorg the coordinator respawns it, replays the ladder, and
+        re-runs just that shard's backfill. Cross-shard UNIQUE
+        duplicates are caught by merging per-shard key hashes; on
+        conflict every node aborts the index meta."""
+        from ..errors import DuplicateKeyError
+        base = {"db": db, "table": table, "index": index,
+                "columns": list(columns), "unique": unique}
+        applied: list = []          # ladder states every node reached
+        backfilled = False
+
+        def ladder(w, state):
+            w.call({"op": "dxf_subtask", "kind": "index_ladder",
+                    "payload": {**base, "state": state}})
+
+        def backfill(w):
+            out, _ = w.call({"op": "dxf_subtask",
+                             "kind": "index_backfill",
+                             "payload": dict(base)})
+            return out["result"]
+
+        def with_recovery(i, fn):
+            """Run fn against worker i; if the executor is dead,
+            respawn it, replay the reorg work it missed (ladder
+            states, plus its shard's backfill once that stage has
+            passed), then retry fn."""
+            try:
+                return fn(self.workers[i])
+            except OSError:
+                w = self._recover_worker(i)
+                if w is None:
+                    raise
+                for st in applied:
+                    ladder(w, st)
+                if backfilled:
+                    backfill(w)
+                return fn(w)
+
+        def abort_all():
+            """Best-effort abort on every reachable node: drop the
+            index meta AND purge committed backfill KVs (index ids are
+            recycled). A freshly respawned worker replayed only the
+            DDL log, which has no trace of this index — nothing to do
+            there."""
+            def ab(i, w):
+                try:
+                    ladder(w, "abort")
+                except OSError:
+                    self._recover_worker(i)
+            self._fanout(ab)
+
+        try:
+            for st in ("delete_only", "write_only", "write_reorg"):
+                self._fanout(lambda i, w, st=st:
+                             with_recovery(i, lambda ww: ladder(ww, st)))
+                applied.append(st)
+            outs = self._fanout(lambda i, w: with_recovery(i, backfill))
+        except OSError:
+            raise               # executor dead and no spawner: stuck
+        except BaseException:
+            abort_all()
+            raise
+        dup = next((o["dup"] for o in outs if o.get("dup")), None)
+        if dup is None and unique:
+            seen: set = set()
+            for out in outs:
+                for h in out.get("key_hashes") or []:
+                    if h in seen:
+                        dup = f"duplicate key across shards ({index})"
+                        break
+                    seen.add(h)
+                if dup:
+                    break
+        if dup is not None:
+            abort_all()
+            raise DuplicateKeyError("Duplicate entry for key '%s': %s",
+                                    index, dup)
+        backfilled = True
+        self._fanout(lambda i, w:
+                     with_recovery(i, lambda ww: ladder(ww, "public")))
+        # coordinator's schema-only domain + the recovery DDL log (a
+        # replacement worker rebuilds the index by replaying this)
+        sql = (f"alter table {table} add "
+               f"{'unique ' if unique else ''}index {index} "
+               f"({', '.join(columns)})")
+        self.sess.execute(sql)
+        self._ddl_log.append(sql)
+        return sum(out["rows"] for out in outs)
+
     def dxf_run(self, kind: str, payloads: list, concurrency: int = 4):
         """Multi-node DXF (reference dxf/framework scheduler +
         balancer, doc.go:30-33): dispatch {kind, payload} subtasks

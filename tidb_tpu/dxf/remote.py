@@ -35,6 +35,65 @@ def _sql_agg(worker, payload):
     return out
 
 
+@register("index_ladder")
+def _index_ladder(worker, payload):
+    """One F1 state transition of a distributed ADD INDEX on this
+    node's schema (reference ddl/backfilling_dist_scheduler.go: the
+    owner drives the ladder, every node converges per state before the
+    next). States: delete_only (creates the index meta) -> write_only
+    -> write_reorg -> public; 'abort' removes the meta."""
+    from ..parser import ast
+    from ..session.ddl import DDLExecutor
+    from ..models.schema import SchemaState
+    d = DDLExecutor(worker.sess)
+    tn = ast.TableName(db=payload.get("db", "test"),
+                       name=payload["table"])
+    state = payload["state"]
+    if state == "delete_only":
+        idx_def = ast.IndexDef(name=payload["index"],
+                               columns=list(payload["columns"]),
+                               unique=bool(payload.get("unique")),
+                               primary=False)
+        d.add_index_prepare(tn, idx_def)
+    elif state == "abort":
+        from ..session.ddl import purge_index_range
+        dom = worker.sess.domain
+        info = dom.infoschema().table_by_name(
+            payload.get("db", "test"), payload["table"])
+        idx = info.find_index(payload["index"])
+        d.drop_index_meta(tn, payload["index"])
+        if idx is not None:
+            # erase committed backfill KVs: index ids are recycled, a
+            # later index on this table must start from a clean range
+            purge_index_range(dom, info.id, idx.id)
+    else:
+        d._set_index_state(tn, payload["index"],
+                           getattr(SchemaState, state.upper()))
+    return {"ok": True}
+
+
+@register("index_backfill")
+def _index_backfill(worker, payload):
+    """Backfill subtask: build index KVs for THIS node's shard
+    (reference dxf add-index app read-index step). Returns the row
+    count plus unique-key digests for the coordinator's cross-shard
+    duplicate merge. A shard-LOCAL duplicate comes back as data
+    ("dup"), not an exception — the coordinator must run its abort
+    broadcast and surface a typed DuplicateKeyError either way."""
+    from ..errors import DuplicateKeyError
+    from ..session.ddl import backfill_index_shard
+    dom = worker.sess.domain
+    info = dom.infoschema().table_by_name(
+        payload.get("db", "test"), payload["table"])
+    idx = info.find_index(payload["index"])
+    try:
+        rows, hashes = backfill_index_shard(
+            dom, info, idx, collect_keys=bool(idx.unique))
+    except DuplicateKeyError as e:
+        return {"rows": 0, "key_hashes": None, "dup": str(e)}
+    return {"rows": rows, "key_hashes": hashes, "dup": None}
+
+
 @register("checksum_range")
 def _checksum_range(worker, payload):
     """ADMIN CHECKSUM-style shard pass (reference dxf example app

@@ -3,6 +3,8 @@ to single-step transitions since DDL is in-process and transactional here;
 the SchemaState fields exist so the staged path can be distributed later)."""
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 
 from ..parser import ast
@@ -456,16 +458,7 @@ class DDLExecutor:
             m.update_table(db.id, tbl2)
         self._with_meta(fn)
         # purge index KV range (reference: delete-range worker)
-        from ..codec.tablecodec import index_prefix
-        pref = index_prefix(tbl.id, idx.id)
-        txn = self.domain.storage.begin()
-        try:
-            for k, _v in txn.scan(pref, pref + b"\xff" * 9):
-                txn.delete(k)
-            txn.commit()
-        except BaseException:
-            txn.rollback()
-            raise
+        purge_index_range(self.domain, tbl.id, idx.id)
 
     def alter_table(self, stmt: ast.AlterTableStmt):
         for action, payload in stmt.actions:
@@ -564,13 +557,10 @@ class DDLExecutor:
             return db, tbl, idx
         return self._with_meta(fn)
 
-    def _alter_add_index(self, tn, idx_def):
-        """Add index through the F1 online states (reference
-        ddl/index.go onCreateIndex + backfilling*.go): none ->
-        delete-only -> write-only -> write-reorg (snapshot backfill while
-        concurrent DML maintains the index) -> public. Each transition is
-        its own schema version, so concurrent sessions never skip a
-        state."""
+    def add_index_prepare(self, tn, idx_def):
+        """First F1 step: create the index meta in DELETE_ONLY (one
+        schema version). Shared by the local ladder and the
+        distributed reorg driver (cluster add_index)."""
         from ..models.schema import SchemaState
 
         def fn(m):
@@ -589,7 +579,26 @@ class DDLExecutor:
             tbl.indexes.append(idx)
             m.update_table(db.id, tbl)
             return db, tbl, idx
-        result = self._with_meta(fn)
+        return self._with_meta(fn)
+
+    def drop_index_meta(self, tn, idx_name):
+        """Remove an index's meta entirely (abort path of a reorg)."""
+        def undo(m):
+            db2, tbl2 = self._get_table(m, tn)
+            tbl2.indexes = [i for i in tbl2.indexes
+                            if i.name.lower() != idx_name.lower()]
+            m.update_table(db2.id, tbl2)
+        self._with_meta(undo)
+
+    def _alter_add_index(self, tn, idx_def):
+        """Add index through the F1 online states (reference
+        ddl/index.go onCreateIndex + backfilling*.go): none ->
+        delete-only -> write-only -> write-reorg (snapshot backfill while
+        concurrent DML maintains the index) -> public. Each transition is
+        its own schema version, so concurrent sessions never skip a
+        state."""
+        from ..models.schema import SchemaState
+        result = self.add_index_prepare(tn, idx_def)
         if result is None:
             return
         db, tbl, idx = result
@@ -600,49 +609,11 @@ class DDLExecutor:
         _, tbl, idx = self._set_index_state(tn, idx.name,
                                             SchemaState.WRITE_REORG)
         failpoint.inject("ddl-index-write-reorg")
-        # backfill from columnar snapshot
-        ctab = self.domain.columnar.tables.get(tbl.id)
-        if ctab is None or ctab.live_count() == 0:
-            self._set_index_state(tn, idx.name, SchemaState.PUBLIC)
-            return
-        txn = self.domain.storage.begin()
         try:
-            from ..codec.tablecodec import index_key
-            valid = ctab.valid_at()
-            idxs = np.nonzero(valid)[0]
-            cols = [tbl.find_column(c) for c in idx.columns]
-            for i in idxs.tolist():
-                handle = int(ctab.handles[i])
-                datums = []
-                for ci in cols:
-                    col = ctab.column_for(ci)
-                    datums.append(col.get_datum(i))
-                from ..executor.table_rt import fold_ci_datums
-                datums = fold_ci_datums(tbl, idx, datums)
-                if idx.unique and not any(d.is_null for d in datums):
-                    ik = index_key(tbl.id, idx.id, datums)
-                    existing = txn.get(ik)
-                    if existing is not None and \
-                            existing not in (str(handle).encode(), b""):
-                        # a concurrent write-only writer may have written
-                        # this very row's entry already; only a different
-                        # handle is a duplicate
-                        raise DuplicateKeyError(
-                            "Duplicate entry for key '%s'", idx.name)
-                    txn.set(ik, str(handle).encode())
-                else:
-                    txn.set(index_key(tbl.id, idx.id, datums, handle), b"")
-            txn.commit()
+            backfill_index_shard(self.domain, tbl, idx)
             self._set_index_state(tn, idx.name, SchemaState.PUBLIC)
         except BaseException:
-            txn.rollback()
-            # roll back the meta change
-            def undo(m):
-                db2, tbl2 = self._get_table(m, tn)
-                tbl2.indexes = [i for i in tbl2.indexes
-                                if i.name.lower() != idx.name.lower()]
-                m.update_table(db2.id, tbl2)
-            self._with_meta(undo)
+            self.drop_index_meta(tn, idx.name)
             raise
 
     # ---- helpers ------------------------------------------------------
@@ -660,6 +631,75 @@ class DDLExecutor:
             if t.name.lower() == tn.name.lower():
                 return db, t
         raise TableNotExistsError("Unknown table '%s'", tn.name)
+
+
+def purge_index_range(domain, table_id, index_id):
+    """Delete every KV in an index's key range (reference
+    delete-range worker; used by DROP INDEX and by the abort path of
+    a distributed reorg, which must erase already-committed backfill
+    KVs so a recycled index id never inherits ghost entries)."""
+    from ..codec.tablecodec import index_prefix
+    pref = index_prefix(table_id, index_id)
+    txn = domain.storage.begin()
+    try:
+        for k, _v in txn.scan(pref, pref + b"\xff" * 9):
+            txn.delete(k)
+        txn.commit()
+    except BaseException:
+        txn.rollback()
+        raise
+
+
+def backfill_index_shard(domain, tbl, idx, collect_keys=False):
+    """Snapshot backfill of THIS node's rows into index KVs (reference
+    ddl/backfilling*.go read-index step; dispatched per shard by the
+    distributed reorg, pkg/ddl/backfilling_dist_scheduler.go). The
+    index must already be in WRITE_REORG so concurrent DML maintains
+    it. Returns (rows_backfilled, key_hashes): key_hashes is non-None
+    only for collect_keys — the coordinator merges per-shard hashes of
+    UNIQUE index keys to detect cross-shard duplicates (shard-local
+    dups are caught here against the txn view)."""
+    from ..codec.tablecodec import index_key
+    from ..executor.table_rt import fold_ci_datums
+    ctab = domain.columnar.tables.get(tbl.id)
+    if ctab is None or ctab.live_count() == 0:
+        return 0, ([] if collect_keys else None)
+    txn = domain.storage.begin()
+    try:
+        valid = ctab.valid_at()
+        idxs = np.nonzero(valid)[0]
+        cols = [tbl.find_column(c) for c in idx.columns]
+        key_hashes = [] if collect_keys else None
+        for i in idxs.tolist():
+            handle = int(ctab.handles[i])
+            datums = []
+            for ci in cols:
+                col = ctab.column_for(ci)
+                datums.append(col.get_datum(i))
+            datums = fold_ci_datums(tbl, idx, datums)
+            if idx.unique and not any(d.is_null for d in datums):
+                ik = index_key(tbl.id, idx.id, datums)
+                existing = txn.get(ik)
+                if existing is not None and \
+                        existing not in (str(handle).encode(), b""):
+                    # a concurrent write-only writer may have written
+                    # this very row's entry already; only a different
+                    # handle is a duplicate
+                    raise DuplicateKeyError(
+                        "Duplicate entry for key '%s'", idx.name)
+                txn.set(ik, str(handle).encode())
+                if collect_keys:
+                    # 128-bit digest: cross-shard dup detection must
+                    # never false-positive on hash collisions
+                    key_hashes.append(
+                        hashlib.blake2b(ik, digest_size=16).hexdigest())
+            else:
+                txn.set(index_key(tbl.id, idx.id, datums, handle), b"")
+        txn.commit()
+        return len(idxs), key_hashes
+    except BaseException:
+        txn.rollback()
+        raise
 
 
 def _zero_default(ft):
