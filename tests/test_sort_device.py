@@ -1,0 +1,87 @@
+"""Device ORDER BY permutation vs host np.lexsort (VERDICT r2 weak
+item 9): identical rows for int/float/decimal/ci-string keys with
+NULLs, DESC mixes, ties (both sorts are stable), non-pow2 sizes, and
+the external (spill) path."""
+import os
+
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    os.environ["TIDB_TPU_SORT_MIN"] = "1"
+    tk = TestKit()
+    rng = np.random.RandomState(7)
+    rows = []
+    for i in range(941):                     # non-pow2: padding exercised
+        a = rng.randint(0, 9)
+        f = round(float(rng.uniform(-5, 5)), 3)
+        s = ["aa", "BB", "cc", "AA", None][rng.randint(0, 5)]
+        v = rng.randint(0, 1000)
+        rows.append(f"({i},{a},{f},"
+                    f"{'null' if s is None else repr(s)},{v})")
+    tk.must_exec("create table s (id int primary key, a int, f double, "
+                 "s varchar(4) collate utf8mb4_general_ci, v int)")
+    tk.must_exec("insert into s values " + ",".join(rows))
+    yield tk
+    os.environ.pop("TIDB_TPU_SORT_MIN", None)
+
+
+QUERIES = [
+    "select id from s order by a, id",
+    "select id, a from s order by a desc, v, id",
+    "select id, f from s order by f, id",
+    "select id, f from s order by f desc, id",
+    "select id, s from s order by s, id",
+    "select id, s from s order by s desc, v desc, id",
+    "select a, v from s order by a, v",          # ties: stability
+    "select id from s order by v % 7, a desc, id",
+]
+
+
+def _host_rows(tk, sql):
+    os.environ["TIDB_TPU_SORT_MIN"] = str(1 << 60)
+    try:
+        return tk.must_query(sql)._norm()
+    finally:
+        os.environ["TIDB_TPU_SORT_MIN"] = "1"
+
+
+@pytest.mark.parametrize("i", range(len(QUERIES)))
+def test_device_sort_matches_host(tk, i):
+    sql = QUERIES[i]
+    n0 = tk.domain.metrics.get("sort_device", 0)
+    dev = tk.must_query(sql)._norm()
+    assert tk.domain.metrics.get("sort_device_error", 0) == 0
+    assert tk.domain.metrics.get("sort_device", 0) > n0, \
+        f"query {i} did not route to device"
+    assert dev == _host_rows(tk, sql), sql
+
+
+def test_device_sort_external_spill(tk):
+    """Spilled external sort: the device permutation drives the
+    disk-gather path too."""
+    rng = np.random.RandomState(13)
+    tk.must_exec("create table sb (id int primary key, v int, f double)")
+    for base in range(0, 12000, 3000):
+        vals = ",".join(
+            f"({base + j},{rng.randint(0, 997)},"
+            f"{round(float(rng.uniform(-9, 9)), 4)})"
+            for j in range(3000))
+        tk.must_exec("insert into sb values " + vals)
+    old = tk.sess.vars.get("tidb_mem_quota_query")
+    tk.must_exec("set @@tidb_mem_quota_query = 131072")
+    try:
+        n0 = tk.domain.metrics.get("sort_spill_count", 0)
+        sql = "select id, v, f from sb order by v, f desc, id"
+        dev = tk.must_query(sql)._norm()
+        assert tk.domain.metrics.get("sort_spill_count", 0) > n0, \
+            "quota did not force a spill"
+        assert tk.domain.metrics.get("sort_device_error", 0) == 0
+        host = _host_rows(tk, sql)
+        assert dev == host
+    finally:
+        tk.must_exec(f"set @@tidb_mem_quota_query = {old}")

@@ -897,14 +897,14 @@ class SortExec(Executor):
             merged = Chunk.concat_all(in_mem)
             keys = [np.concatenate([kp[i] for kp in key_parts])
                     for i in range(len(self.items))]
-            order = np.lexsort(list(reversed(keys)))
+            order = self._order(keys, len(merged))
             self._out = [merged.take(order)]
             return
         # external path: global order over in-memory keys; gather payload
         # from disk chunk by chunk
         keys = [np.concatenate([kp[i] for kp in key_parts])
                 for i in range(len(self.items))]
-        order = np.lexsort(list(reversed(keys)))
+        order = self._order(keys, sum(spool.rows))
         chunk_of = np.concatenate(
             [np.full(n, i, dtype=np.int64)
              for i, n in enumerate(spool.rows)])
@@ -932,6 +932,23 @@ class SortExec(Executor):
             out.append(part.take(inv))
         spool.close()
         self._out = out
+
+    def _order(self, keys, n):
+        """Sort permutation: device jnp.lexsort kernel above the size
+        floor (executor/sort_device.py), host np.lexsort otherwise.
+        Both are stable, so device==host row order for integer-keyed
+        sorts (incl. dict/collation ranks)."""
+        if self.ctx.copr.use_device and keys:
+            from .sort_device import device_sort_permutation
+            try:
+                o = device_sort_permutation(keys, n)
+                if o is not None:
+                    self.ctx.sess.domain.inc_metric("sort_device")
+                    return o
+            except Exception:                 # noqa: BLE001
+                self.ctx.sess.domain.inc_metric("sort_device_error")
+        return np.lexsort(list(reversed(keys))) if keys \
+            else np.arange(n)
 
 
 class TopNExec(Executor):
