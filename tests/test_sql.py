@@ -1024,6 +1024,133 @@ class TestPartitionedTables:
         ftk.must_query("select a from ph where a in (3, 11) order by a")\
             .check([(3,), (11,)])
 
+    def test_exchange_partition(self, ftk):
+        """ALTER TABLE ... EXCHANGE PARTITION (reference
+        ddl/partition.go onExchangeTablePartition): partition data and
+        table data swap; validation rejects out-of-range rows."""
+        ftk.must_exec("""create table pe (a int, v int)
+            partition by range (a)
+            (partition p0 values less than (10),
+             partition p1 values less than maxvalue)""")
+        ftk.must_exec("insert into pe values (1,10),(2,20),(50,500)")
+        ftk.must_exec("create table pe_x (a int, v int)")
+        ftk.must_exec("insert into pe_x values (7,70),(8,80)")
+        ftk.must_exec("alter table pe exchange partition p0 "
+                      "with table pe_x")
+        ftk.must_query("select a, v from pe order by a").check(
+            [(7, 70), (8, 80), (50, 500)])
+        ftk.must_query("select a, v from pe_x order by a").check(
+            [(1, 10), (2, 20)])
+        # pruning still scans only p0 for a < 10 after the swap
+        ftk.must_query("select sum(v) from pe where a < 10").check(
+            [("150",)])
+        # validation: a row outside the partition range refuses
+        ftk.must_exec("insert into pe_x values (500, 1)")
+        err = ftk.exec_err("alter table pe exchange partition p0 "
+                           "with table pe_x")
+        assert "does not match the partition" in str(err)
+        # WITHOUT VALIDATION skips the check (MySQL semantics)
+        ftk.must_exec("alter table pe exchange partition p0 "
+                      "with table pe_x without validation")
+        ftk.must_query("select count(*) from pe_x").check([(2,)])
+        # schema mismatch refuses
+        ftk.must_exec("create table pe_y (a int, v varchar(4))")
+        err = ftk.exec_err("alter table pe exchange partition p1 "
+                           "with table pe_y")
+        assert "different definitions" in str(err)
+
+    def test_reorganize_partition(self, ftk):
+        """ALTER TABLE ... REORGANIZE PARTITION: split/merge range
+        partitions; rows re-route; covered range must be preserved."""
+        ftk.must_exec("""create table pro (a int, v int)
+            partition by range (a)
+            (partition p0 values less than (100),
+             partition pmax values less than maxvalue)""")
+        ftk.must_exec("insert into pro values (5,1),(50,2),(95,3),"
+                      "(500,4)")
+        ftk.must_exec("alter table pro reorganize partition p0 into "
+                      "(partition p0a values less than (10), "
+                      "partition p0b values less than (100))")
+        tbl = ftk.domain.infoschema().table_by_name("test", "pro")
+        assert [p["name"] for p in tbl.partitions["parts"]] == \
+            ["p0a", "p0b", "pmax"]
+        ftk.must_query("select a from pro order by a").check(
+            [(5,), (50,), (95,), (500,)])
+        # rows landed in the right new partitions (pruning-backed)
+        ftk.must_query("select count(*) from pro where a < 10").check(
+            [(1,)])
+        ftk.must_query("select count(*) from pro where a >= 10 "
+                       "and a < 100").check([(2,)])
+        # merge back
+        ftk.must_exec("alter table pro reorganize partition p0a, p0b "
+                      "into (partition p0 values less than (100))")
+        ftk.must_query("select count(*) from pro where a < 100").check(
+            [(3,)])
+        # range-coverage violation refuses
+        err = ftk.exec_err("alter table pro reorganize partition p0 "
+                           "into (partition q values less than (50))")
+        assert "covered range" in str(err)
+        # non-consecutive sources refuse
+        err = ftk.exec_err("alter table pro reorganize partition p0, "
+                           "pmax2 into (partition q values less than "
+                           "maxvalue)")
+        assert "Unknown partition" in str(err)
+        # duplicate name vs an untouched partition refuses (review
+        # probe: would leave ['pmax', ..., 'pmax'])
+        err = ftk.exec_err("alter table pro reorganize partition p0 "
+                           "into (partition pmax values less than "
+                           "(100))")
+        assert "Duplicate partition name" in str(err)
+        # overlap with the preceding untouched partition refuses
+        # (review probe: bounds [100, 50, ...] break pruning)
+        ftk.must_exec("alter table pro reorganize partition pmax into "
+                      "(partition p1 values less than (200), "
+                      "partition pmax values less than maxvalue)")
+        err = ftk.exec_err("alter table pro reorganize partition p1 "
+                           "into (partition qa values less than (50), "
+                           "partition qb values less than (200))")
+        assert "ascending" in str(err)
+        # all rows still present after every refused attempt
+        ftk.must_query("select count(*) from pro").check([(4,)])
+
+    def test_placement_policy_detach_via_default(self, ftk):
+        """PLACEMENT POLICY = DEFAULT detaches (review probe: an
+        attached policy was permanently undroppable)."""
+        ftk.must_exec("create placement policy pdet followers=1")
+        ftk.must_exec("create table pdt (a int)")
+        ftk.must_exec("alter table pdt placement policy = pdet")
+        err = ftk.exec_err("drop placement policy pdet")
+        assert "in use" in str(err)
+        ftk.must_exec("alter table pdt placement policy = default")
+        ftk.must_exec("drop placement policy pdet")
+
+    def test_placement_policies(self, ftk):
+        """CREATE/ALTER/DROP PLACEMENT POLICY + table attachment
+        (reference pkg/ddl/placement_policy.go)."""
+        ftk.must_exec("create placement policy pp1 "
+                      "primary_region='us-east-1' regions='us-east-1,"
+                      "us-west-1' followers=2")
+        ftk.must_exec("create table ppt (a int)")
+        ftk.must_exec("alter table ppt placement policy = pp1")
+        r = ftk.must_query(
+            "select policy_name, attached_tables from "
+            "information_schema.placement_policies")
+        assert r.rows == [("pp1", "test.ppt")]
+        # drop refuses while attached
+        err = ftk.exec_err("drop placement policy pp1")
+        assert "in use" in str(err)
+        ftk.must_exec("alter placement policy pp1 followers=3")
+        r = ftk.must_query("select settings from "
+                           "information_schema.placement_policies")
+        assert '"followers": 3' in r.rows[0][0]
+        ftk.must_exec("drop table ppt")
+        ftk.must_exec("drop placement policy pp1")
+        ftk.must_exec("create placement policy if not exists pp1 "
+                      "followers=1")
+        ftk.must_exec("drop placement policy if exists pp1")
+        err = ftk.exec_err("alter table pe placement policy = nope")
+        assert "Unknown placement policy" in str(err)
+
     def test_partition_txn(self, ftk):
         ftk.must_exec("""create table pt2 (a int, v int)
             partition by range (a)

@@ -125,6 +125,33 @@ def _gen_resource_groups(domain):
                g.throttled_stmts)
 
 
+def _gen_placement_policies(domain):
+    """Policies from mysql.placement_policies + the tables attached to
+    each (reference information_schema.placement_policies)."""
+    isc = domain.infoschema()
+    mysql_db = isc.table_by_name("mysql", "placement_policies") \
+        if isc.has_table("mysql", "placement_policies") else None
+    if mysql_db is None:
+        return
+    ctab = domain.columnar.tables.get(mysql_db.id)
+    if ctab is None:
+        return
+    attached: dict = {}
+    for db in isc.all_schemas():
+        for t in isc.tables_in_schema(db.name):
+            if t.placement_policy:
+                attached.setdefault(t.placement_policy.lower(), []) \
+                    .append(f"{db.name}.{t.name}")
+    valid = ctab.valid_at()
+    import numpy as np
+    cols = mysql_db.columns
+    for i in np.nonzero(valid)[0].tolist():
+        name = ctab.column_for(cols[0]).get_datum(i).to_py()
+        settings = ctab.column_for(cols[1]).get_datum(i).to_py()
+        yield (name, settings,
+               ",".join(sorted(attached.get(str(name).lower(), []))))
+
+
 def _gen_engines(domain):
     yield ("InnoDB", "DEFAULT", "TPU-native columnar + MVCC row engine",
            "YES", "YES", "YES")
@@ -251,6 +278,10 @@ VIRTUAL_DEFS = {
     "tidb_top_sql": (_cols(("sql_digest", _S()), ("sql_text", _S()),
                            ("cpu_time_total", _F()), ("exec_count", _I()),
                            ("cpu_time_avg", _F())), _gen_top_sql),
+    "placement_policies": (_cols(("policy_name", _S()),
+                                 ("settings", _S()),
+                                 ("attached_tables", _S())),
+                           _gen_placement_policies),
     "resource_groups": (_cols(("name", _S()), ("ru_per_sec", _I()),
                               ("priority", _S()), ("burstable", _S()),
                               ("query_limit", _S()),

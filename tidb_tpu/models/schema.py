@@ -106,6 +106,7 @@ class TableInfo:
     checks: list = field(default_factory=list)   # CHECK constraint SQL texts
     # sequence object: {"start","increment","cache","value"(next unalloc)}
     sequence: dict | None = None
+    placement_policy: str = ""     # attached PLACEMENT POLICY name
 
     def find_column(self, name: str) -> ColumnInfo | None:
         name = name.lower()
@@ -146,6 +147,7 @@ class TableInfo:
             "foreign_keys": self.foreign_keys,
             "checks": self.checks,
             "sequence": self.sequence,
+            "placement_policy": self.placement_policy,
         }
 
     @classmethod
@@ -162,7 +164,8 @@ class TableInfo:
             partitions=j.get("partitions"),
             foreign_keys=j.get("foreign_keys", []),
             checks=j.get("checks", []),
-            sequence=j.get("sequence"))
+            sequence=j.get("sequence"),
+            placement_policy=j.get("placement_policy", ""))
 
     def serialize(self) -> bytes:
         return json.dumps(self.to_json()).encode()

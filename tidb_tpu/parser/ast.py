@@ -273,6 +273,18 @@ class SetRoleStmt(StmtNode):
 
 
 @dataclass
+class PlacementPolicyStmt(StmtNode):
+    """CREATE/ALTER/DROP PLACEMENT POLICY (reference
+    pkg/ddl/placement_policy.go; options like PRIMARY_REGION/REGIONS/
+    FOLLOWERS are free-form key=value pairs)."""
+    action: str = "create"      # create | alter | drop
+    name: str = ""
+    options: dict = field(default_factory=dict)
+    if_not_exists: bool = False
+    if_exists: bool = False
+
+
+@dataclass
 class ResourceGroupStmt(StmtNode):
     action: str = "create"      # create | alter | drop
     name: str = ""

@@ -827,6 +827,15 @@ class Parser:
 
     def parse_create(self):
         self.expect_kw("create")
+        if self.accept_kw("placement"):
+            self.expect_kw("policy")
+            stmt = ast.PlacementPolicyStmt(action="create")
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                stmt.if_not_exists = True
+            stmt.name = self.ident().lower()
+            return self._parse_placement_options(stmt)
         if self.accept_kw("resource"):
             self.expect_kw("group")
             stmt = ast.ResourceGroupStmt(action="create")
@@ -1031,31 +1040,7 @@ class Parser:
                 self.expect_kw("partitions")
                 pdef["num"] = int(self.next().text)
             else:
-                self.expect_op("(")
-                while True:
-                    self.expect_kw("partition")
-                    pname = self.ident()
-                    self.expect_kw("values")
-                    self.expect_kw("less")
-                    self.expect_kw("than")
-                    if self.accept_kw("maxvalue"):
-                        lt = None
-                    else:
-                        self.expect_op("(")
-                        t = self.next()
-                        if t.kind == "IDENT" and \
-                                t.text.lower() == "maxvalue":
-                            lt = None      # keyword form: (MAXVALUE);
-                            # a quoted 'maxvalue' is kind STRING and
-                            # stays a literal bound
-                        else:
-                            lt = (int(t.text) if t.kind == "NUMBER"
-                                  else t.text)
-                        self.expect_op(")")
-                    pdef["parts"].append({"name": pname, "less_than": lt})
-                    if not self.accept_op(","):
-                        break
-                self.expect_op(")")
+                pdef["parts"] = self._parse_range_partition_list()
             stmt.options["partition_by"] = pdef
         # table options: ENGINE=..., CHARSET=..., COMMENT=..., TTL=col+INTERVAL n unit
         while self.peek().kind == "IDENT":
@@ -1211,6 +1196,14 @@ class Parser:
 
     def parse_drop(self):
         self.expect_kw("drop")
+        if self.accept_kw("placement"):
+            self.expect_kw("policy")
+            stmt = ast.PlacementPolicyStmt(action="drop")
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                stmt.if_exists = True
+            stmt.name = self.ident().lower()
+            return stmt
         if self.accept_kw("resource"):
             self.expect_kw("group")
             stmt = ast.ResourceGroupStmt(action="drop")
@@ -1283,8 +1276,54 @@ class Parser:
             tables.append(self.parse_table_name())
         return ast.DropTableStmt(tables=tables, if_exists=ie)
 
+    def _parse_range_partition_list(self):
+        """( PARTITION name VALUES LESS THAN (bound|MAXVALUE), ... )
+        — shared by CREATE TABLE and REORGANIZE PARTITION."""
+        parts = []
+        self.expect_op("(")
+        while True:
+            self.expect_kw("partition")
+            pname = self.ident()
+            self.expect_kw("values")
+            self.expect_kw("less")
+            self.expect_kw("than")
+            if self.accept_kw("maxvalue"):
+                lt = None
+            else:
+                self.expect_op("(")
+                t = self.next()
+                if t.kind == "IDENT" and t.text.lower() == "maxvalue":
+                    lt = None      # keyword form: (MAXVALUE);
+                    # a quoted 'maxvalue' is kind STRING and
+                    # stays a literal bound
+                else:
+                    lt = (int(t.text) if t.kind == "NUMBER"
+                          else t.text)
+                self.expect_op(")")
+            parts.append({"name": pname, "less_than": lt})
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return parts
+
+    def _parse_placement_options(self, stmt):
+        """IDENT [=] value pairs: PRIMARY_REGION='..' REGIONS='..'
+        FOLLOWERS=n ... (reference parser.y placement option list)."""
+        while self.peek().kind == "IDENT":
+            opt = self.next().text.lower()
+            self.accept_op("=")
+            t = self.next()
+            stmt.options[opt] = (int(t.text) if t.kind == "NUMBER"
+                                 else t.text)
+        return stmt
+
     def parse_alter(self):
         self.expect_kw("alter")
+        if self.accept_kw("placement"):
+            self.expect_kw("policy")
+            stmt = ast.PlacementPolicyStmt(action="alter",
+                                           name=self.ident().lower())
+            return self._parse_placement_options(stmt)
         if self.accept_kw("resource"):
             self.expect_kw("group")
             stmt = ast.ResourceGroupStmt(action="alter")
@@ -1335,6 +1374,34 @@ class Parser:
             elif self.accept_kw("rename"):
                 self.accept_kw("to") or self.accept_kw("as")
                 stmt.actions.append(("rename", self.parse_table_name()))
+            elif self.accept_kw("exchange"):
+                self.expect_kw("partition")
+                pname = self.ident()
+                self.expect_kw("with")
+                self.expect_kw("table")
+                nt = self.parse_table_name()
+                validation = True
+                if self.accept_kw("with"):
+                    self.expect_kw("validation")
+                elif self.accept_kw("without"):
+                    self.expect_kw("validation")
+                    validation = False
+                stmt.actions.append(("exchange_partition", {
+                    "partition": pname, "table": nt,
+                    "validation": validation}))
+            elif self.accept_kw("reorganize"):
+                self.expect_kw("partition")
+                names = [self.ident()]
+                while self.accept_op(","):
+                    names.append(self.ident())
+                self.expect_kw("into")
+                parts = self._parse_range_partition_list()
+                stmt.actions.append(("reorganize_partition", {
+                    "from": names, "parts": parts}))
+            elif self.accept_kw("placement"):
+                self.expect_kw("policy")
+                self.accept_op("=")
+                stmt.actions.append(("placement_policy", self.ident()))
             else:
                 self.error("unsupported ALTER action")
             if not self.accept_op(","):

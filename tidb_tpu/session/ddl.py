@@ -3,6 +3,7 @@ to single-step transitions since DDL is in-process and transactional here;
 the SchemaState fields exist so the staged path can be distributed later)."""
 from __future__ import annotations
 
+import copy
 import hashlib
 
 import numpy as np
@@ -16,7 +17,7 @@ from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
                       TableExistsError, TableNotExistsError,
                       DuplicateColumnError, ColumnNotExistsError,
                       IndexExistsError, IndexNotExistsError,
-                      UnsupportedError)
+                      UnsupportedError, TiDBError)
 from ..executor import table_rt
 
 
@@ -476,6 +477,12 @@ class DDLExecutor:
             elif action == "rename":
                 self.rename_table(ast.RenameTableStmt(
                     pairs=[(stmt.table, payload)]))
+            elif action == "exchange_partition":
+                self._alter_exchange_partition(stmt.table, payload)
+            elif action == "reorganize_partition":
+                self._alter_reorganize_partition(stmt.table, payload)
+            elif action == "placement_policy":
+                self._alter_table_placement(stmt.table, payload)
             else:
                 raise UnsupportedError("unsupported ALTER action %s", action)
 
@@ -615,6 +622,262 @@ class DDLExecutor:
         except BaseException:
             self.drop_index_meta(tn, idx.name)
             raise
+
+    # ---- partition maintenance DDL ------------------------------------
+    def _snapshot_rows(self, phys_tbl, cols):
+        """[(handle, [Datum per column])] for the live rows of one
+        PHYSICAL table (a partition pid or a plain table id)."""
+        ctab = self.domain.columnar.tables.get(phys_tbl.id)
+        if ctab is None or ctab.live_count() == 0:
+            return []
+        valid = ctab.valid_at()
+        out = []
+        for i in np.nonzero(valid)[0].tolist():
+            row = [ctab.column_for(ci).get_datum(i) for ci in cols]
+            out.append((int(ctab.handles[i]), row))
+        return out
+
+    def _new_handle(self, tbl, row, alloc):
+        if tbl.pk_is_handle:
+            off = next(i for i, c in enumerate(tbl.columns)
+                       if c.name.lower() == tbl.pk_col_name.lower())
+            return int(row[off].val)
+        return alloc.next_handle()
+
+    def _alter_exchange_partition(self, tn, payload):
+        """ALTER TABLE pt EXCHANGE PARTITION p WITH TABLE nt
+        (reference ddl/partition.go onExchangeTablePartition). The
+        reference swaps physical table ids in meta (O(1)); here
+        indexes live under the LOGICAL table id, so the swap moves the
+        rows through the normal write path — same observable contract
+        (schemas must match, rows must fit the partition unless
+        WITHOUT VALIDATION), row counts bounded by the two sides."""
+        from ..storage.partition import partition_table_info, \
+            route_partition
+        db_name = tn.db or self.sess.vars.current_db
+        pt = self.domain.infoschema().table_by_name(db_name, tn.name)
+        nt_tn = payload["table"]
+        nt = self.domain.infoschema().table_by_name(
+            nt_tn.db or db_name, nt_tn.name)
+        if not pt.partitions:
+            raise UnsupportedError("%s is not partitioned", pt.name)
+        if nt.partitions:
+            raise UnsupportedError(
+                "EXCHANGE target %s must not be partitioned", nt.name)
+        part = next((p for p in pt.partitions["parts"]
+                     if p["name"].lower() ==
+                     payload["partition"].lower()), None)
+        if part is None:
+            raise TiDBError("Unknown partition '%s'",
+                            payload["partition"])
+        sig = lambda t: [(c.name.lower(), c.ft.tclass, c.ft.flen,  # noqa: E731
+                          c.ft.decimal) for c in t.columns]
+        if sig(pt) != sig(nt):
+            raise UnsupportedError(
+                "Tables have different definitions")
+        rows_p = self._snapshot_rows(
+            partition_table_info(pt, part["pid"]), pt.columns)
+        rows_n = self._snapshot_rows(nt, nt.columns)
+        if payload.get("validation", True):
+            pcol_off = next(i for i, c in enumerate(pt.columns)
+                            if c.name.lower() ==
+                            pt.partitions["col"].lower())
+            for _h, row in rows_n:
+                d = row[pcol_off]
+                pid = route_partition(
+                    pt, None if d.is_null else int(d.val))
+                if pid != part["pid"]:
+                    raise TiDBError(
+                        "Found a row that does not match the partition")
+        txn = self.domain.storage.begin()
+        try:
+            for h, row in rows_p:
+                table_rt.remove_record(txn, pt, h, row)
+            for h, row in rows_n:
+                table_rt.remove_record(txn, nt, h, row)
+            pt_alloc = self.domain.allocator(pt)
+            nt_alloc = self.domain.allocator(nt)
+            for _h, row in rows_n:
+                table_rt.add_record(
+                    txn, pt, self._new_handle(pt, row, pt_alloc), row)
+            for _h, row in rows_p:
+                table_rt.add_record(
+                    txn, nt, self._new_handle(nt, row, nt_alloc), row)
+            txn.commit()
+        except BaseException:
+            txn.rollback()
+            raise
+        # schema version bump: concurrent readers refresh their caches
+        self._with_meta(lambda m: None)
+
+    def _alter_reorganize_partition(self, tn, payload):
+        """ALTER TABLE pt REORGANIZE PARTITION p1[,p2..] INTO (...)
+        (reference ddl/partition.go onReorganizePartition): the named
+        partitions must be consecutive; the new ones must cover
+        exactly the same bound interval. Rows of the old partitions
+        re-route through the normal write path into the new layout."""
+        from ..storage.partition import partition_table_info
+        from ..chunk.column import py_to_datum_fast
+        db_name = tn.db or self.sess.vars.current_db
+        pt = self.domain.infoschema().table_by_name(db_name, tn.name)
+        if not pt.partitions or pt.partitions["type"] != "range":
+            raise UnsupportedError(
+                "REORGANIZE PARTITION requires a RANGE-partitioned table")
+        parts = pt.partitions["parts"]
+        names = [n.lower() for n in payload["from"]]
+        offs = [i for i, p in enumerate(parts)
+                if p["name"].lower() in names]
+        if len(offs) != len(names):
+            raise TiDBError("Unknown partition in REORGANIZE")
+        if offs != list(range(offs[0], offs[0] + len(offs))):
+            raise TiDBError(
+                "REORGANIZE PARTITION source partitions must be "
+                "consecutive")
+        pcol = pt.find_column(pt.partitions["col"])
+        new_defs = []
+        for pd in payload["parts"]:
+            lt = pd["less_than"]
+            if lt is not None:
+                lt = py_to_datum_fast(lt, pcol.ft).val
+            new_defs.append({"name": pd["name"], "less_than": lt})
+        for i in range(1, len(new_defs)):
+            a, b = new_defs[i - 1]["less_than"], new_defs[i]["less_than"]
+            if a is None or (b is not None and b <= a):
+                raise TiDBError(
+                    "Partition bounds must be strictly ascending")
+        if new_defs[-1]["less_than"] != parts[offs[-1]]["less_than"]:
+            raise TiDBError(
+                "REORGANIZE must keep the covered range: last new "
+                "bound must equal the last old bound")
+        # name/bound validation against the UNTOUCHED partitions
+        # (MySQL rejects duplicate names and non-monotonic bounds,
+        # and prune_partitions assumes ascending bounds)
+        kept_names = {p["name"].lower() for j, p in enumerate(parts)
+                      if j not in offs}
+        new_names = [d["name"].lower() for d in new_defs]
+        if len(set(new_names)) != len(new_names) or \
+                kept_names & set(new_names):
+            raise TiDBError("Duplicate partition name in REORGANIZE")
+        if offs[0]:
+            prev_bound = parts[offs[0] - 1]["less_than"]
+            first = new_defs[0]["less_than"]
+            if first is not None and first <= prev_bound:
+                raise TiDBError(
+                    "Partition bounds must be strictly ascending")
+        rows = []
+        for i in offs:
+            rows.extend(self._snapshot_rows(
+                partition_table_info(pt, parts[i]["pid"]), pt.columns))
+        old_pids = [parts[i]["pid"] for i in offs]
+        # ONE transaction for meta + data: a crash either keeps the
+        # old layout with every row, or lands the new one — the
+        # removed rows are never durable without their re-inserts
+        # (meta rows live in the same KV store as data)
+        txn = self.domain.storage.begin()
+        try:
+            m = Mutator(txn)
+            db, tbl = self._get_table(m, tn)
+            old_view = copy.copy(tbl)
+            old_view.partitions = dict(tbl.partitions)
+            old_view.partitions["parts"] = list(parts)
+            newp = [{"name": d["name"], "pid": m.gen_global_id(),
+                     "less_than": d["less_than"]} for d in new_defs]
+            tbl.partitions = dict(tbl.partitions)
+            tbl.partitions["parts"] = \
+                parts[:offs[0]] + newp + parts[offs[-1] + 1:]
+            m.update_table(db.id, tbl)
+            m.gen_schema_version()
+            for h, row in rows:
+                table_rt.remove_record(txn, old_view, h, row)
+            alloc = self.domain.allocator(tbl)
+            for _h, row in rows:
+                table_rt.add_record(
+                    txn, tbl, self._new_handle(tbl, row, alloc), row)
+            txn.commit()
+        except BaseException:
+            txn.rollback()
+            raise
+        for pid in old_pids:
+            self.domain.columnar.tables.pop(pid, None)
+
+    # ---- placement policies -------------------------------------------
+    def _policy_table(self):
+        """One internal session per domain, with the backing system
+        table bootstrapped on first use."""
+        s = getattr(self.domain, "_placement_sess", None)
+        if s is None:
+            from . import Session
+            s = Session(self.domain)
+            s.vars.current_db = "mysql"
+            s.execute(
+                "create table if not exists placement_policies ("
+                "name varchar(64) primary key, settings varchar(512))")
+            self.domain._placement_sess = s
+        return s
+
+    def placement_policy(self, stmt):
+        """CREATE/ALTER/DROP PLACEMENT POLICY (reference
+        pkg/ddl/placement_policy.go). Policies are named option bags
+        persisted in mysql.placement_policies; attachment via ALTER
+        TABLE ... PLACEMENT POLICY=. Single-host build: placement is
+        recorded and queryable (information_schema), enforcement is
+        the cluster layer's round-robin until multi-region exists."""
+        import json as _json
+        s = self._policy_table()
+        esc = stmt.name.replace("'", "''")
+        rs = s.execute("select settings from placement_policies "
+                       f"where name = '{esc}'")
+        exists = bool(rs.rows)
+        if stmt.action == "create":
+            if exists:
+                if stmt.if_not_exists:
+                    return
+                raise TiDBError("Placement policy '%s' exists",
+                                stmt.name)
+            opts = _json.dumps(stmt.options).replace("'", "''")
+            s.execute(f"insert into placement_policies values "
+                      f"('{esc}', '{opts}')")
+        elif stmt.action == "alter":
+            if not exists:
+                raise TiDBError("Unknown placement policy '%s'",
+                                stmt.name)
+            old = _json.loads(rs.rows[0][0])
+            old.update(stmt.options)
+            opts = _json.dumps(old).replace("'", "''")
+            s.execute(f"update placement_policies set settings = "
+                      f"'{opts}' where name = '{esc}'")
+        else:
+            if not exists and not stmt.if_exists:
+                raise TiDBError("Unknown placement policy '%s'",
+                                stmt.name)
+            # refuse while referenced (reference: ErrPlacementPolicyInUse)
+            isc = self.domain.infoschema()
+            for db in isc.all_schemas():
+                for t in isc.tables_in_schema(db.name):
+                    if t.placement_policy.lower() == stmt.name.lower():
+                        raise TiDBError(
+                            "Placement policy '%s' is still in use by "
+                            "table %s", stmt.name, t.name)
+            s.execute(f"delete from placement_policies "
+                      f"where name = '{esc}'")
+
+    def _alter_table_placement(self, tn, policy_name):
+        esc = policy_name.replace("'", "''").lower()
+        if esc == "default":
+            esc = ""        # PLACEMENT POLICY = DEFAULT detaches
+        else:
+            s = self._policy_table()
+            rs = s.execute("select 1 from placement_policies "
+                           f"where name = '{esc}'")
+            if not rs.rows:
+                raise TiDBError("Unknown placement policy '%s'",
+                                policy_name)
+
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            tbl.placement_policy = esc
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
 
     # ---- helpers ------------------------------------------------------
     def _db_by_name(self, m, name):

@@ -364,6 +364,11 @@ class Session:
             return _str_chunk(
                 ["Database", "Table", "Index_name", "Index_columns",
                  "Reason", "Score"], rows)
+        if isinstance(stmt, ast.PlacementPolicyStmt):
+            self.check_priv("super")
+            self.commit()
+            self.ddl.placement_policy(stmt)
+            return ResultSet()
         if isinstance(stmt, ast.ResourceGroupStmt):
             mgr = self.domain.resource_groups
             if stmt.action == "create":
