@@ -581,6 +581,111 @@ class TestDDL:
         ftk.must_exec("alter table ad drop column a")
         ftk.must_query("select * from ad order by b").check([(5,), (7,)])
 
+    def test_alter_column_forms(self, ftk):
+        """RENAME/CHANGE COLUMN, SET/DROP DEFAULT, FIRST/AFTER
+        positions, table options (reference ddl/column.go +
+        parser.y AlterTableSpec breadth)."""
+        ftk.must_exec("create table af (a int primary key, "
+                      "b varchar(8), c int)")
+        ftk.must_exec("insert into af values (1,'x',10),(2,'y',20)")
+        ftk.must_exec("alter table af rename column b to bb")
+        ftk.must_query("select bb from af where a = 1").check([("x",)])
+        # rename follows into indexes
+        ftk.must_exec("create index i_bb on af (bb)")
+        ftk.must_exec("alter table af rename column bb to b3")
+        ftk.must_query("select a from af where b3 = 'y'").check([(2,)])
+        ftk.must_exec("alter table af rename index i_bb to i_b3")
+        # CHANGE = rename + modify
+        ftk.must_exec("alter table af change column b3 b varchar(20)")
+        ftk.must_query("select b from af order by a").check(
+            [("x",), ("y",)])
+        # defaults
+        ftk.must_exec("alter table af alter column c set default 7")
+        ftk.must_exec("insert into af (a, b) values (3, 'z')")
+        ftk.must_query("select c from af where a = 3").check([(7,)])
+        ftk.must_exec("alter table af alter column c drop default")
+        # positions rewrite rows positionally
+        ftk.must_exec("alter table af add column d int after a")
+        ftk.must_query("select * from af where a = 1").check(
+            [(1, None, "x", 10)])
+        ftk.must_exec("alter table af add column e int first")
+        ftk.must_query("select * from af where a = 2").check(
+            [(None, 2, None, "y", 20)])
+        # duplicate rename refuses
+        e = ftk.exec_err("alter table af rename column b to c")
+        assert "Duplicate column" in str(e)
+        # table options
+        ftk.must_exec("alter table af comment = 'hello'")
+        ftk.must_exec("alter table af auto_increment = 500")
+        # CHANGE after a positional rewrite: column offsets must have
+        # been renumbered (regression: stale offsets made modify
+        # clobber a different column and corrupt row/columnar parity)
+        ftk.must_exec("alter table af change column b bz varchar(30)")
+        r = ftk.must_query("check table af")
+        assert r.rows[0][3] == "OK", r.rows
+        ftk.must_query("select bz from af where a = 1").check([("x",)])
+
+    def test_alter_column_edge_cases(self, ftk):
+        """Review regressions: failed AFTER must not half-apply; FK
+        ref_cols in child tables follow a parent rename; generated
+        columns block renames of their dependencies; float/negative
+        defaults parse."""
+        ftk.must_exec("create table ae (a int)")
+        e = ftk.exec_err("alter table ae add column d int after nosuch")
+        assert "Unknown column" in str(e)
+        assert ftk.exec_err("select d from ae") is not None
+        ftk.must_exec("create table aep (a int primary key)")
+        ftk.must_exec("create table aec (x int, "
+                      "foreign key (x) references aep (a))")
+        ftk.must_exec("insert into aep values (1)")
+        ftk.must_exec("alter table aep rename column a to a2")
+        ftk.must_exec("insert into aec values (1)")
+        assert ftk.exec_err("insert into aec values (99)") is not None
+        ftk.must_exec("create table aeg (a int, b int as (a + 1) "
+                      "stored)")
+        e = ftk.exec_err("alter table aeg rename column a to az")
+        assert "generated" in str(e)
+        ftk.must_exec("create table aed (a int, f double, g int)")
+        ftk.must_exec("alter table aed alter column f set default 1.5")
+        ftk.must_exec("alter table aed alter column g set default -3")
+        ftk.must_exec("insert into aed (a) values (1)")
+        ftk.must_query("select f, g from aed").check([(1.5, -3)])
+        ftk.must_exec("alter database `test` charset utf8mb4")
+
+    def test_rename_role_follows_grantees(self, ftk):
+        ftk.must_exec("create role rr1")
+        ftk.must_exec("create user ru identified by 'p'")
+        ftk.must_exec("grant select on test.* to rr1")
+        ftk.must_exec("grant rr1 to ru")
+        ftk.must_exec("rename user rr1 to rr2")
+        pm = ftk.domain.priv
+        assert ("rr2", "%") in pm.roles and ("rr1", "%") not in pm.roles
+        assert ("rr2", "%") in pm.role_edges[("ru", "%")]
+        assert pm.db_privs.get(("rr2", "%", "test")) == {"select"}
+
+    def test_maintain_statements(self, ftk):
+        """CHECK/OPTIMIZE/REPAIR TABLE return MySQL-style maintenance
+        rows; CHECK runs the index<->row consistency pass."""
+        ftk.must_exec("create table mt (a int primary key, b int, "
+                      "key ib (b))")
+        ftk.must_exec("insert into mt values (1, 10)")
+        r = ftk.must_query("check table mt")
+        assert r.rows[0][2:] == ("status", "OK")
+        r = ftk.must_query("optimize table mt")
+        assert r.rows[0][1] == "optimize"
+        r = ftk.must_query("repair table mt")
+        assert r.rows[0][3] == "OK"
+
+    def test_rename_user_moves_grants(self, ftk):
+        ftk.must_exec("create user ru1 identified by 'p'")
+        ftk.must_exec("grant select on test.* to ru1")
+        ftk.must_exec("rename user ru1 to ru2")
+        r = ftk.must_query("show grants for ru2")
+        assert any("SELECT" in row[0] for row in r.rows)
+        e = ftk.exec_err("rename user ru1 to ru3")
+        assert "RENAME USER failed" in str(e)
+        ftk.must_exec("drop user ru2")
+
     def test_index_lifecycle(self, ftk):
         ftk.must_exec("create table il (a int, b int)")
         ftk.must_exec("insert into il values (1,1),(2,2)")

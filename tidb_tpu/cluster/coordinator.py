@@ -65,9 +65,11 @@ class _WorkerClient:
 class Cluster:
     """Coordinator session over N worker processes."""
 
-    def __init__(self, ports, spawn_worker=None):
+    def __init__(self, ports, spawn_worker=None, regions=None):
         from ..session import new_store, Session
         self.workers = [_WorkerClient(p) for p in ports]
+        # region label per worker (PD store labels); None = unlabeled
+        self.worker_regions = list(regions) if regions else None
         # local schema-only domain: plans are built here, data lives on
         # the workers
         self.domain = new_store()
@@ -110,13 +112,50 @@ class Cluster:
         for w in self.workers:
             w.call({"op": "load_sql", "sqls": [sql]})
 
+    def _placement_workers(self, table: str) -> list:
+        """Worker indexes eligible to hold this table's shards — the
+        PD region-aware placement decision (reference PD placement
+        rules driven by PLACEMENT POLICY, pkg/ddl/placement_policy.go)
+        collapsed to: a table attached to a policy places its shards
+        only on workers whose region label is in the policy's
+        primary_region/regions; unlabeled clusters and unattached
+        tables place round-robin on every worker."""
+        everyone = list(range(len(self.workers)))
+        if not self.worker_regions:
+            return everyone
+        try:
+            t = self.domain.infoschema().table_by_name("test", table)
+        except Exception:                     # noqa: BLE001
+            return everyone
+        pol = getattr(t, "placement_policy", "")
+        if not pol:
+            return everyone
+        import json as _json
+        try:
+            rs = self.sess.execute(
+                "select settings from mysql.placement_policies "
+                f"where name = '{pol}'")
+        except Exception:                     # noqa: BLE001
+            return everyone
+        if not rs.rows:
+            return everyone
+        opts = _json.loads(rs.rows[0][0])
+        regions = {r.strip() for r in
+                   str(opts.get("regions", "")).split(",") if r.strip()}
+        if opts.get("primary_region"):
+            regions.add(str(opts["primary_region"]))
+        eligible = [i for i in everyone
+                    if self.worker_regions[i] in regions]
+        return eligible or everyone
+
     def load_shards(self, table: str, csv_path: str):
-        self._loads.append((table, csv_path))
+        eligible = self._placement_workers(table)
+        self._loads.append((table, csv_path, eligible))
         total = 0
-        for i, w in enumerate(self.workers):
-            out, _ = w.call({"op": "load_shard", "table": table,
-                             "csv": csv_path, "shard": i,
-                             "nshards": len(self.workers)})
+        for pos, i in enumerate(eligible):
+            out, _ = self.workers[i].call(
+                {"op": "load_shard", "table": table, "csv": csv_path,
+                 "shard": pos, "nshards": len(eligible)})
             total += out["rows"]
         return total
 
@@ -131,9 +170,11 @@ class Cluster:
         w = _WorkerClient(port)
         if self._ddl_log:
             w.call({"op": "load_sql", "sqls": list(self._ddl_log)})
-        for table, csv_path in self._loads:
-            w.call({"op": "load_shard", "table": table, "csv": csv_path,
-                    "shard": i, "nshards": len(self.workers)})
+        for table, csv_path, eligible in self._loads:
+            if i in eligible:
+                w.call({"op": "load_shard", "table": table,
+                        "csv": csv_path, "shard": eligible.index(i),
+                        "nshards": len(eligible)})
         self.workers[i] = w
         return w
 

@@ -78,6 +78,9 @@ class Mutator:
         self.txn.set(meta_key(b"DB", str(db.id).encode(), b"TableList"),
                      json.dumps([]).encode())
 
+    def update_database(self, db: DBInfo):
+        self.txn.set(meta_key(b"DB", str(db.id).encode()), db.serialize())
+
     def drop_database(self, dbid: int):
         ids = [i for i in self._db_ids() if i != dbid]
         self._set_db_ids(ids)

@@ -273,6 +273,25 @@ class SetRoleStmt(StmtNode):
 
 
 @dataclass
+class MaintainTableStmt(StmtNode):
+    """CHECK / OPTIMIZE / REPAIR TABLE — MySQL maintenance statements
+    returning (Table, Op, Msg_type, Msg_text) rows."""
+    kind: str = "check"
+    tables: list = field(default_factory=list)
+
+
+@dataclass
+class RenameUserStmt(StmtNode):
+    pairs: list = field(default_factory=list)   # [(from_spec, to_spec)]
+
+
+@dataclass
+class AlterDatabaseStmt(StmtNode):
+    name: str = ""               # empty = current database
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class PlacementPolicyStmt(StmtNode):
     """CREATE/ALTER/DROP PLACEMENT POLICY (reference
     pkg/ddl/placement_policy.go; options like PRIMARY_REGION/REGIONS/
@@ -415,6 +434,7 @@ class ColumnDef(Node):
     collate: str = ""
     generated: str = ""          # stored generated column expr text
     enum_vals: list = field(default_factory=list)
+    position: object = None      # None | "first" | ("after", col)
 
 
 @dataclass

@@ -169,6 +169,14 @@ class Parser:
             while self.accept_op(","):
                 stmt.tables.append(self.parse_table_name())
             return stmt
+        if kw in ("check", "optimize", "repair"):
+            self.next()
+            self.expect_kw("table")
+            stmt = ast.MaintainTableStmt(kind=kw)
+            stmt.tables.append(self.parse_table_name())
+            while self.accept_op(","):
+                stmt.tables.append(self.parse_table_name())
+            return stmt
         if kw == "help":
             self.next()
             self.next()
@@ -200,6 +208,7 @@ class Parser:
                 return ast.AdminStmt(kind="check_table", tables=tables)
             if self.accept_kw("show"):
                 self.expect_kw("ddl")
+                self.accept_kw("jobs")
                 return ast.AdminStmt(kind="show_ddl")
             if self.accept_kw("checkpoint"):
                 return ast.AdminStmt(kind="checkpoint")
@@ -1335,6 +1344,28 @@ class Parser:
             while self.accept_op(","):
                 stmt.users.append(self.parse_user_spec())
             return stmt
+        if self.accept_kw("database") or self.accept_kw("schema"):
+            has_name = (self.peek().kind == "QIDENT" or
+                        (self.peek().kind == "IDENT" and
+                         not self.at_kw("default", "character",
+                                        "charset", "collate")))
+            stmt = ast.AlterDatabaseStmt(
+                name=self.ident() if has_name else "")
+            while True:
+                self.accept_kw("default")
+                if self.accept_kw("character"):
+                    self.expect_kw("set")
+                    self.accept_op("=")
+                    stmt.options["charset"] = self.ident().lower()
+                elif self.accept_kw("charset"):
+                    self.accept_op("=")
+                    stmt.options["charset"] = self.ident().lower()
+                elif self.accept_kw("collate"):
+                    self.accept_op("=")
+                    stmt.options["collate"] = self.ident().lower()
+                else:
+                    break
+            return stmt
         self.expect_kw("table")
         stmt = ast.AlterTableStmt(table=self.parse_table_name())
         while True:
@@ -1358,7 +1389,12 @@ class Parser:
                         name="PRIMARY", columns=cols, unique=True, primary=True)))
                 else:
                     self.accept_kw("column")
-                    stmt.actions.append(("add_column", self.parse_column_def()))
+                    cd = self.parse_column_def()
+                    if self.accept_kw("first"):
+                        cd.position = "first"
+                    elif self.accept_kw("after"):
+                        cd.position = ("after", self.ident())
+                    stmt.actions.append(("add_column", cd))
             elif self.accept_kw("drop"):
                 if self.accept_kw("index") or self.accept_kw("key"):
                     stmt.actions.append(("drop_index", self.ident()))
@@ -1371,9 +1407,49 @@ class Parser:
             elif self.accept_kw("modify"):
                 self.accept_kw("column")
                 stmt.actions.append(("modify_column", self.parse_column_def()))
+            elif self.accept_kw("change"):
+                self.accept_kw("column")
+                old = self.ident()
+                stmt.actions.append(("change_column",
+                                     (old, self.parse_column_def())))
+            elif self.accept_kw("alter"):
+                self.accept_kw("column")
+                cname = self.ident()
+                if self.accept_kw("set"):
+                    self.expect_kw("default")
+                    neg = self.accept_op("-")
+                    t = self.next()
+                    if t.kind == "NUMBER":
+                        dv = (float(t.text) if "." in t.text
+                              or "e" in t.text.lower()
+                              else int(t.text))
+                        if neg:
+                            dv = -dv
+                    elif neg:
+                        self.error("expected a number after '-'")
+                    else:
+                        dv = (None if t.text.lower() == "null"
+                              else t.text)
+                    stmt.actions.append(("set_default", (cname, dv)))
+                else:
+                    self.expect_kw("drop")
+                    self.expect_kw("default")
+                    stmt.actions.append(("set_default", (cname, "\0DROP")))
             elif self.accept_kw("rename"):
-                self.accept_kw("to") or self.accept_kw("as")
-                stmt.actions.append(("rename", self.parse_table_name()))
+                if self.accept_kw("column"):
+                    old = self.ident()
+                    self.expect_kw("to")
+                    stmt.actions.append(("rename_column",
+                                         (old, self.ident())))
+                elif self.accept_kw("index") or self.accept_kw("key"):
+                    old = self.ident()
+                    self.expect_kw("to")
+                    stmt.actions.append(("rename_index",
+                                         (old, self.ident())))
+                else:
+                    self.accept_kw("to") or self.accept_kw("as")
+                    stmt.actions.append(("rename",
+                                         self.parse_table_name()))
             elif self.accept_kw("exchange"):
                 self.expect_kw("partition")
                 pname = self.ident()
@@ -1402,6 +1478,15 @@ class Parser:
                 self.expect_kw("policy")
                 self.accept_op("=")
                 stmt.actions.append(("placement_policy", self.ident()))
+            elif self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() in ("comment",
+                                                 "auto_increment",
+                                                 "engine", "charset"):
+                opt = self.next().text.lower()
+                self.accept_op("=")
+                t = self.next()
+                v = int(t.text) if t.kind == "NUMBER" else t.text
+                stmt.actions.append(("table_option", (opt, v)))
             else:
                 self.error("unsupported ALTER action")
             if not self.accept_op(","):
@@ -1410,6 +1495,15 @@ class Parser:
 
     def parse_rename(self):
         self.expect_kw("rename")
+        if self.accept_kw("user"):
+            stmt = ast.RenameUserStmt()
+            while True:
+                frm = self.parse_user_spec()
+                self.expect_kw("to")
+                stmt.pairs.append((frm, self.parse_user_spec()))
+                if not self.accept_op(","):
+                    break
+            return stmt
         self.expect_kw("table")
         pairs = []
         while True:

@@ -62,6 +62,59 @@ class PrivManager:
                 for kk in [x for x in d if x[0] == k[0] and x[1] == k[1]]:
                     d.pop(kk, None)
 
+    def rename_user(self, pairs):
+        """RENAME USER a TO b[, ...]: the account and every priv set
+        move; grants keep working under the new name (reference
+        executor/simple.go executeRenameUser)."""
+        with self._mu:
+            for (u1, h1), (u2, h2) in pairs:
+                if _key(u1, h1) not in self.users:
+                    raise TiDBError(
+                        "Operation RENAME USER failed for '%s'@'%s'",
+                        u1, h1)
+                if _key(u2, h2) in self.users:
+                    raise TiDBError(
+                        "Operation RENAME USER failed: '%s'@'%s' exists",
+                        u2, h2)
+            for (u1, h1), (u2, h2) in pairs:
+                k1, k2 = _key(u1, h1), _key(u2, h2)
+                self.users[k2] = self.users.pop(k1)
+                if k1 in self.global_privs:
+                    self.global_privs[k2] = self.global_privs.pop(k1)
+                for d in (self.db_privs, self.table_privs):
+                    for kk in [x for x in d
+                               if x[0] == k1[0] and x[1] == k1[1]]:
+                        d[(k2[0], k2[1]) + kk[2:]] = d.pop(kk)
+                if k1 in self.role_edges:
+                    self.role_edges[k2] = self.role_edges.pop(k1)
+                if k1 in self.default_roles:
+                    self.default_roles[k2] = self.default_roles.pop(k1)
+                # the renamed account may BE a role: follow every
+                # reference to it (grantees' edge sets, default-role
+                # lists, the role registry)
+                if k1 in self.roles:
+                    self.roles.discard(k1)
+                    self.roles.add(k2)
+                for edges in self.role_edges.values():
+                    if k1 in edges:
+                        edges.discard(k1)
+                        edges.add(k2)
+                for uk, dr in self.default_roles.items():
+                    if isinstance(dr, list) and k1 in dr:
+                        self.default_roles[uk] = \
+                            [k2 if r == k1 else r for r in dr]
+                pw = self.users[k2].get("password", "")
+                try:
+                    from ..session import Session
+                    sess = Session(self.domain)
+                    sess.user = "root"
+                    sess.vars.current_db = "mysql"
+                    sess.execute(f"delete from user where user = '{u1}' "
+                                 f"and host = '{h1}'")
+                except TiDBError:
+                    pass
+                self._persist_user(u2, h2, pw)
+
     def grant(self, privs, db, tbl, user, host):
         with self._mu:
             k = _key(user, host)

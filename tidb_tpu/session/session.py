@@ -364,6 +364,61 @@ class Session:
             return _str_chunk(
                 ["Database", "Table", "Index_name", "Index_columns",
                  "Reason", "Score"], rows)
+        if isinstance(stmt, ast.MaintainTableStmt):
+            from .show import _str_chunk
+            rows = []
+            for tn in stmt.tables:
+                db = tn.db or self.vars.current_db
+                tbl = self.domain.infoschema().table_by_name(db, tn.name)
+                name = f"{db}.{tbl.name}"
+                if stmt.kind == "check":
+                    from ..executor.admin import check_table, \
+                        AdminCheckError
+                    try:
+                        check_table(self, tbl, db)
+                        rows.append((name, "check", "status", "OK"))
+                    except AdminCheckError as e:
+                        rows.append((name, "check", "error", str(e)))
+                elif stmt.kind == "optimize":
+                    # embedded engine: GC closed versions — the
+                    # closest analog of OPTIMIZE's space reclaim
+                    self.domain.run_gc()
+                    rows.append((name, "optimize", "status", "OK"))
+                else:          # repair: WAL-first engine, nothing to do
+                    rows.append((name, "repair", "status", "OK"))
+            return _str_chunk(["Table", "Op", "Msg_type", "Msg_text"],
+                              rows)
+        if isinstance(stmt, ast.RenameUserStmt):
+            self.check_priv("create_user")
+            self.domain.priv.rename_user(
+                [((f.user, f.host), (t.user, t.host))
+                 for f, t in stmt.pairs])
+            return ResultSet()
+        if isinstance(stmt, ast.AlterDatabaseStmt):
+            self.check_priv("alter", stmt.name or self.vars.current_db)
+            name = stmt.name or self.vars.current_db
+            self.commit()
+            txn = self.domain.storage.begin()
+            try:
+                from ..meta import Mutator
+                m = Mutator(txn)
+                db = next((d for d in m.list_databases()
+                           if d.name.lower() == name.lower()), None)
+                if db is None:
+                    from ..errors import DatabaseNotExistsError
+                    raise DatabaseNotExistsError(
+                        "Unknown database '%s'", name)
+                if "charset" in stmt.options:
+                    db.charset = stmt.options["charset"]
+                if "collate" in stmt.options:
+                    db.collate = stmt.options["collate"]
+                m.update_database(db)
+                m.gen_schema_version()
+                txn.commit()
+            except BaseException:
+                txn.rollback()
+                raise
+            return ResultSet()
         if isinstance(stmt, ast.PlacementPolicyStmt):
             self.check_priv("super")
             self.commit()
