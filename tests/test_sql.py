@@ -1256,6 +1256,135 @@ class TestPartitionedTables:
         err = ftk.exec_err("alter table pe placement policy = nope")
         assert "Unknown placement policy" in str(err)
 
+    def test_partition_selection_clause(self, ftk):
+        """SELECT/DELETE ... FROM t PARTITION (p, ...) restricts the
+        scan to the named partitions (reference parser.y
+        PartitionNameListOpt + partition pruning)."""
+        ftk.must_exec("""create table psel (a int, v int)
+            partition by range (a)
+            (partition p0 values less than (10),
+             partition p1 values less than maxvalue)""")
+        ftk.must_exec("insert into psel values (1,10),(5,50),(50,500)")
+        ftk.must_query("select a from psel partition (p0) order by a")\
+            .check([(1,), (5,)])
+        ftk.must_query("select sum(v) from psel partition (p1)").check(
+            [("500",)])
+        ftk.must_query("select count(*) from psel partition (p0, p1)")\
+            .check([(3,)])
+        e = ftk.exec_err("select * from psel partition (nope)")
+        assert "Unknown partition" in str(e)
+        ftk.must_query("select count(*) from psel partition (p0) "
+                       "where a >= 10").check([(0,)])   # sel ∩ prune
+        ftk.must_exec("delete from psel partition (p0) where a = 1")
+        ftk.must_query("select count(*) from psel").check([(2,)])
+
+    def test_multi_table_update(self, ftk):
+        """UPDATE t1, t2 SET ... (reference executor/update.go): one
+        joined read, each target row updates once even with multiple
+        join matches."""
+        ftk.must_exec("create table mua (id int primary key, v int)")
+        ftk.must_exec("create table mub (id int primary key, aid int, "
+                      "w int)")
+        ftk.must_exec("insert into mua values (1, 10), (2, 20)")
+        ftk.must_exec("insert into mub values (1,1,100),(2,1,200),"
+                      "(3,2,300)")
+        ftk.must_exec("update mua, mub set mua.v = mua.v + 1, "
+                      "mub.w = mub.w * 2 where mua.id = mub.aid")
+        ftk.must_query("select id, v from mua order by id").check(
+            [(1, 11), (2, 21)])     # +1 once despite two matches
+        ftk.must_query("select id, w from mub order by id").check(
+            [(1, 200), (2, 400), (3, 600)])
+        ftk.must_exec("update mua join mub on mua.id = mub.aid "
+                      "set mua.v = 0 where mub.w > 500")
+        ftk.must_query("select v from mua order by id").check(
+            [(11,), (0,)])
+        # aliases + unqualified unambiguous assignment column
+        ftk.must_exec("update mua as x, mub as y set w = 1 "
+                      "where x.id = y.aid and x.id = 2")
+        ftk.must_query("select w from mub where id = 3").check([(1,)])
+
+    def test_multi_update_outer_join_skips_nonmatches(self, ftk):
+        """Review regression: outer-join rows with a NULL handle must
+        not update a phantom record."""
+        ftk.must_exec("create table moa (id int primary key, v int)")
+        ftk.must_exec("create table mob (id int primary key, aid int, "
+                      "w int)")
+        ftk.must_exec("insert into moa values (1,10),(2,20),(5,50)")
+        ftk.must_exec("insert into mob values (1,1,100)")
+        ftk.must_exec("update moa left join mob on moa.id = mob.aid "
+                      "set moa.v = moa.v + 1, mob.w = 0")
+        ftk.must_query("select id, v from moa order by id").check(
+            [(1, 11), (2, 21), (5, 51)])
+        ftk.must_query("select id, w from mob").check([(1, 0)])
+
+    def test_insert_partition_selection_enforced(self, ftk):
+        """INSERT INTO t PARTITION (p): rows routing elsewhere refuse
+        (MySQL ER_ROW_DOES_NOT_MATCH_GIVEN_PARTITION_SET)."""
+        ftk.must_exec("""create table ipe (x int, y int)
+            partition by range (x)
+            (partition p0 values less than (5),
+             partition p1 values less than maxvalue)""")
+        ftk.must_exec("insert into ipe partition (p1) values (7, 7)")
+        e = ftk.exec_err("insert into ipe partition (p1) values (1, 1)")
+        assert "not matching the given partition" in str(e)
+        e = ftk.exec_err("insert into ipe partition (nope) "
+                         "values (1, 1)")
+        assert "Unknown partition" in str(e)
+
+    def test_pointget_skip_locked(self, ftk):
+        from tidb_tpu.session import Session
+        ftk.must_exec("create table psl (a int primary key, b int)")
+        ftk.must_exec("insert into psl values (1,10),(2,20)")
+        s1 = Session(ftk.domain)
+        s1.vars.current_db = "test"
+        s2 = Session(ftk.domain)
+        s2.vars.current_db = "test"
+        try:
+            s1.execute("begin")
+            s1.execute("select * from psl where a = 2 for update")
+            s2.execute("begin")
+            rs = s2.execute("select * from psl where a = 2 "
+                            "for update skip locked")
+            assert rs.rows == []
+        finally:
+            s1.execute("rollback")
+            s2.execute("rollback")
+
+    def test_select_into_var(self, ftk):
+        ftk.must_exec("create table siv (a int primary key, b int)")
+        ftk.must_exec("insert into siv values (1,10),(2,20)")
+        ftk.must_exec("select b into @sv from siv where a = 2")
+        ftk.must_query("select @sv * 2").check([(40,)])
+        ftk.must_exec("select a, b into @sa, @sb from siv where a = 1")
+        ftk.must_query("select @sa + @sb").check([(11,)])
+        e = ftk.exec_err("select b into @sz from siv")
+        assert "more than one row" in str(e)
+
+    def test_for_update_skip_locked_nowait(self, ftk):
+        """FOR UPDATE SKIP LOCKED drops conflicting rows; NOWAIT (and
+        plain FOR UPDATE — no wait queue here) errors immediately; the
+        planner keeps the row handle so scan-shaped FOR UPDATE
+        actually locks."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.errors import LockWaitTimeoutError
+        ftk.must_exec("create table fsl (a int primary key, b int)")
+        ftk.must_exec("insert into fsl values (1,10),(2,20),(3,30)")
+        s1 = Session(ftk.domain)
+        s1.vars.current_db = "test"
+        s2 = Session(ftk.domain)
+        s2.vars.current_db = "test"
+        try:
+            s1.execute("begin")
+            s1.execute("select * from fsl where a = 2 for update")
+            s2.execute("begin")
+            rs = s2.execute("select a from fsl for update skip locked")
+            assert [r[0] for r in rs.rows] == [1, 3]
+            with pytest.raises(LockWaitTimeoutError):
+                s2.execute("select b from fsl for update nowait")
+        finally:
+            s1.execute("rollback")
+            s2.execute("rollback")
+
     def test_partition_txn(self, ftk):
         ftk.must_exec("""create table pt2 (a int, v int)
             partition by range (a)

@@ -49,6 +49,11 @@ class InsertExec:
                 else:
                     alloc.rebase(int(d.val))
             handle = self._handle_for(tbl, cols, row, alloc)
+            if plan.part_sel is not None and \
+                    table_rt.physical_id(tbl, row) not in plan.part_sel:
+                from ..errors import TiDBError
+                raise TiDBError(
+                    "Found a row not matching the given partition set")
             if any(c.generated for c in cols):
                 row = compute_generated(sess, tbl, row)
             if tbl.foreign_keys:
@@ -294,8 +299,11 @@ def _multi_delete_rows(schema, chunks, offs, hidx):
     out = []
     seen = set()
     for ch in chunks:
+        hcol = ch.columns[pos[hidx]]
         for i in range(len(ch)):
-            h = int(ch.columns[pos[hidx]].data[i])
+            if hcol.nulls is not None and hcol.nulls[i]:
+                continue         # outer-join non-match: no such row
+            h = int(hcol.data[i])
             if h in seen:
                 continue
             seen.add(h)
@@ -316,6 +324,67 @@ def _datum_to_np(d: Datum):
     return np.full(1, int(d.val), dtype=np.int64), None, None
 
 
+def _eval_assignments(schema, ch, assigns):
+    """Evaluate SET expressions over one chunk ->
+    [(col_offset, values, null_mask, dict, expr_ft)]."""
+    n = len(ch)
+    ectx = EvalCtx(np, n, bind_chunk(schema, ch), host=True)
+    new_vals = []
+    for off, expr in assigns:
+        data, nulls, sd = eval_expr(ectx, expr)
+        nm = np.asarray(materialize_nulls(ectx, nulls))
+        if np.isscalar(data) or getattr(data, "ndim", 1) == 0:
+            if isinstance(data, str):
+                arr = np.empty(n, dtype=object)
+                arr[:] = data
+                data = arr
+            else:
+                data = np.full(n, data)
+        new_vals.append((off, np.asarray(data), nm, sd, expr.ft))
+    return new_vals
+
+
+def _apply_row_update(sess, txn, tbl, db, cols, handle, old,
+                      new_vals, i):
+    """One row's update pipeline, shared by single- and multi-table
+    UPDATE: coerce assignments, skip no-ops, recompute generated
+    columns, enforce FK/CHECK, move the handle on pk change. Returns
+    1 if a record was written."""
+    new = list(old)
+    changed = False
+    for off, data, nm, sd, eft in new_vals:
+        d = datum_from_value(data[i], bool(nm[i]), sd, eft)
+        d = coerce_datum(d, cols[off].ft)
+        if d.sort_key() != old[off].sort_key() or \
+                d.is_null != old[off].is_null:
+            changed = True
+        new[off] = d
+    if not changed:
+        return 0
+    if any(c.generated for c in cols):
+        new = compute_generated(sess, tbl, new)
+    from .fk import check_parent_exists, referencing_fks, \
+        on_parent_delete
+    if tbl.foreign_keys:
+        check_parent_exists(sess, txn, tbl, new)
+    if tbl.checks:
+        _enforce_checks(sess, tbl, new)
+    if referencing_fks(sess, tbl, db):
+        # key change on a referenced parent: treat as delete-check
+        if any(o.sort_key() != nn.sort_key()
+               for o, nn in zip(old, new)):
+            on_parent_delete(sess, txn, tbl, db, old)
+    new_handle = None
+    if tbl.pk_is_handle:
+        pk_off = next(j for j, c in enumerate(cols)
+                      if c.name.lower() == tbl.pk_col_name.lower())
+        nh = int(new[pk_off].val)
+        if nh != handle:
+            new_handle = nh
+    table_rt.update_record(txn, tbl, handle, old, new, new_handle)
+    return 1
+
+
 class UpdateExec:
     def __init__(self, ctx, plan, sess):
         self.ctx = ctx
@@ -323,6 +392,8 @@ class UpdateExec:
         self.sess = sess
 
     def execute(self) -> int:
+        if self.plan.multi:
+            return self._execute_multi()
         plan = self.plan
         tbl = plan.table_info
         sess = self.sess
@@ -334,62 +405,54 @@ class UpdateExec:
         cols = tbl.public_columns()
         schema = plan.select_plan.schema
         affected = 0
-        alloc = sess.domain.allocator(tbl)
         for ch in chunks:
-            n = len(ch)
-            ectx = EvalCtx(np, n, bind_chunk(schema, ch), host=True)
-            new_vals = []
-            for off, expr in plan.assignments:
-                data, nulls, sd = eval_expr(ectx, expr)
-                nm = np.asarray(materialize_nulls(ectx, nulls))
-                if np.isscalar(data) or getattr(data, "ndim", 1) == 0:
-                    if isinstance(data, str):
-                        arr = np.empty(n, dtype=object)
-                        arr[:] = data
-                        data = arr
-                    else:
-                        data = np.full(n, data)
-                new_vals.append((off, np.asarray(data), nm, sd, expr.ft))
+            new_vals = _eval_assignments(schema, ch, plan.assignments)
             handle_idx = len(schema.cols) - 1
-            for i in range(n):
+            for i in range(len(ch)):
                 handle = int(ch.columns[handle_idx].data[i])
-                old = [ch.columns[j].get_datum(i) for j in range(len(cols))]
-                new = list(old)
-                changed = False
-                for off, data, nm, sd, eft in new_vals:
-                    d = datum_from_value(data[i], bool(nm[i]), sd, eft)
-                    d = coerce_datum(d, cols[off].ft)
-                    if d.sort_key() != old[off].sort_key() or \
-                            d.is_null != old[off].is_null:
-                        changed = True
-                    new[off] = d
-                if not changed:
-                    continue
-                if any(c.generated for c in cols):
-                    new = compute_generated(sess, tbl, new)
-                if tbl.foreign_keys:
-                    from .fk import check_parent_exists
-                    check_parent_exists(sess, txn, tbl, new)
-                if tbl.checks:
-                    _enforce_checks(sess, tbl, new)
-                from .fk import referencing_fks, on_parent_delete
-                if referencing_fks(sess, tbl, plan.db_name):
-                    # key change on a referenced parent: treat as delete-check
-                    changed_ref = any(
-                        o.sort_key() != nn.sort_key()
-                        for o, nn in zip(old, new))
-                    if changed_ref:
-                        on_parent_delete(sess, txn, tbl, plan.db_name, old)
-                new_handle = None
-                if tbl.pk_is_handle:
-                    pk_off = next(j for j, c in enumerate(cols)
-                                  if c.name.lower() == tbl.pk_col_name.lower())
-                    nh = int(new[pk_off].val)
-                    if nh != handle:
-                        new_handle = nh
-                table_rt.update_record(txn, tbl, handle, old, new, new_handle)
-                affected += 1
+                old = [ch.columns[j].get_datum(i)
+                       for j in range(len(cols))]
+                affected += _apply_row_update(
+                    sess, txn, tbl, plan.db_name, cols, handle, old,
+                    new_vals, i)
         return affected
+
+
+def _update_execute_multi(self):
+    """Multi-table UPDATE over one joined read (reference
+    executor/update.go): per target table, each row updates at most
+    once — the first join match wins (MySQL semantics). The single-
+    table coercion/generated/FK/CHECK pipeline applies per target."""
+    plan = self.plan
+    sess = self.sess
+    txn = sess.txn()
+    ex = build_executor(self.ctx, plan.select_plan)
+    ex.open()
+    chunks = ex.all_chunks()
+    ex.close()
+    schema = plan.select_plan.schema
+    pos = {sc.col.idx: i for i, sc in enumerate(schema.cols)}
+    affected = 0
+    for tbl, db, offs, hidx, assigns in plan.multi:
+        cols = tbl.public_columns()
+        seen: set = set()
+        for ch in chunks:
+            new_vals = _eval_assignments(schema, ch, assigns)
+            hcol = ch.columns[pos[hidx]]
+            for i in range(len(ch)):
+                if hcol.nulls is not None and hcol.nulls[i]:
+                    continue     # outer-join non-match: no such row
+                handle = int(hcol.data[i])
+                if handle in seen:
+                    continue
+                seen.add(handle)
+                old = [ch.columns[pos[j]].get_datum(i) for j in offs]
+                affected += _apply_row_update(
+                    sess, txn, tbl, db, cols, handle, old, new_vals, i)
+    return affected
+
+
+UpdateExec._execute_multi = _update_execute_multi
 
 
 class DeleteExec:

@@ -210,6 +210,7 @@ class TableName(Node):
     alias: str = ""
     index_hints: list = field(default_factory=list)
     as_of: ExprNode | None = None      # AS OF TIMESTAMP (stale read)
+    partitions: list = field(default_factory=list)  # PARTITION (p, ..)
 
 
 @dataclass
@@ -371,6 +372,7 @@ class Limit(Node):
 class SelectStmt(StmtNode):
     # set via INTO OUTFILE 'path'
     into_outfile: str = ""
+    into_vars: list = field(default_factory=list)   # INTO @a, @b
     straight_join: bool = False      # SELECT STRAIGHT_JOIN: no reorder
     fields: list = field(default_factory=list)    # [SelectField|Wildcard]
     distinct: bool = False
@@ -382,6 +384,7 @@ class SelectStmt(StmtNode):
     order_by: list = field(default_factory=list)  # [OrderItem]
     limit: Limit | None = None
     for_update: bool = False
+    lock_wait: str = ""              # "" | "nowait" | "skip locked"
     # set operations chain: [('union'|'union all'|'except'|'intersect', SelectStmt)]
     setops: list = field(default_factory=list)
     # WITH clause: [(name, [col aliases], SelectStmt)]

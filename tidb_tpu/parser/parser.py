@@ -390,6 +390,16 @@ class Parser:
                             progress = True
                             break
             sel.fields = self.parse_select_fields()
+            if self.at_kw("into") and self.peek(1).kind == "USERVAR":
+                # SELECT ... INTO @a[, @b] FROM ... (pre-FROM form)
+                self.next()
+                while True:
+                    t = self.next()
+                    if t.kind != "USERVAR":
+                        self.error("expected @var after INTO")
+                    sel.into_vars.append(t.text.lower())
+                    if not self.accept_op(","):
+                        break
             if self.accept_kw("from"):
                 sel.from_clause = self.parse_table_refs()
             if self.accept_kw("where"):
@@ -407,11 +417,25 @@ class Parser:
             sel.order_by = self.parse_order_by()
             sel.limit = self.parse_limit()
             if self.accept_kw("into"):
-                self.expect_kw("outfile")
-                sel.into_outfile = self.next().text
+                if self.accept_kw("outfile"):
+                    sel.into_outfile = self.next().text
+                else:
+                    # INTO @a[, @b ...] (the lexer yields USERVAR)
+                    while True:
+                        t = self.next()
+                        if t.kind != "USERVAR":
+                            self.error("expected @var after INTO")
+                        sel.into_vars.append(t.text.lower())
+                        if not self.accept_op(","):
+                            break
             if self.accept_kw("for"):
                 self.expect_kw("update")
                 sel.for_update = True
+                if self.accept_kw("nowait"):
+                    sel.lock_wait = "nowait"
+                elif self.accept_kw("skip"):
+                    self.expect_kw("locked")
+                    sel.lock_wait = "skip locked"
             elif self.accept_kw("lock"):
                 self.expect_kw("in")
                 self.expect_kw("share")
@@ -621,9 +645,18 @@ class Parser:
 
     def parse_table_name(self) -> ast.TableName:
         a = self.ident()
-        if self.accept_op("."):
-            return ast.TableName(db=a, name=self.ident())
-        return ast.TableName(name=a)
+        tn = ast.TableName(db=a, name=self.ident()) \
+            if self.accept_op(".") else ast.TableName(name=a)
+        if self.at_kw("partition") and self.peek(1).text == "(":
+            # PARTITION (p0 [, p1 ...]) selection — the paren
+            # lookahead keeps `partition` usable as an alias
+            self.next()
+            self.expect_op("(")
+            tn.partitions.append(self.ident())
+            while self.accept_op(","):
+                tn.partitions.append(self.ident())
+            self.expect_op(")")
+        return tn
 
     # ---- DML ----------------------------------------------------------
     def parse_insert(self) -> ast.InsertStmt:

@@ -108,6 +108,7 @@ class InsertPlan:
     ignore: bool = False
     on_dup: list = field(default_factory=list)       # [(offset, Expression, sel_schema)]
     on_dup_new_schema: object = None                 # VALUES(col) bindings
+    part_sel: list | None = None     # INSERT INTO t PARTITION (p) pids
 
 
 @dataclass
@@ -116,6 +117,9 @@ class UpdatePlan:
     db_name: str = ""
     select_plan: object = None      # outputs all cols + handle (last)
     assignments: list = field(default_factory=list)  # [(col_offset, Expression)]
+    # multi-table form: [(table_info, db, col offsets in select schema,
+    #   handle col offset, [(offset in table, Expression)])]
+    multi: list = field(default_factory=list)
 
 
 @dataclass
@@ -247,6 +251,20 @@ class PlanBuilder:
         schema.append(SchemaCol(handle_col, "_tidb_rowid", alias, db,
                                 hidden=True))
         ds = DataSource(tbl, db, alias, schema, handle_col)
+        if tn.partitions:
+            if not tbl.partitions:
+                raise UnsupportedError(
+                    "PARTITION () clause on nonpartitioned table")
+            by_name = {p["name"].lower(): p["pid"]
+                       for p in tbl.partitions["parts"]}
+            from ..errors import TiDBError
+            sel = []
+            for pn in tn.partitions:
+                pid = by_name.get(pn.lower())
+                if pid is None:
+                    raise TiDBError("Unknown partition '%s'", pn)
+                sel.append(pid)
+            ds.part_sel = sel
         ds.stats_rows = max(float(self.pctx.table_rows(db, tbl)), 1.0)
         ds.tbl_stats = self.pctx.table_stats(tbl.id)
         ds.bulk_only = self.pctx.table_bulk_rows(tbl.id) > 0
@@ -447,6 +465,11 @@ class PlanBuilder:
         if stmt.with_rollup:
             return self._build_rollup(stmt)
         p = self.build_from(stmt.from_clause)
+        # FOR UPDATE on a single-table read must keep the row handle
+        # visible at the plan root so the session can lock the result
+        # rows (hidden from the wire output)
+        lock_ds = p if getattr(stmt, "for_update", False) and \
+            isinstance(p, DataSource) else None
 
         # WHERE (conjunct-wise: correlated subquery predicates decorrelate
         # into semi/anti/inner joins — reference rule_decorrelate.go)
@@ -684,6 +707,14 @@ class PlanBuilder:
         proj = Projection(proj_exprs, proj_schema, p)
         proj.stats_rows = p.stats_rows
         result: LogicalPlan = proj
+        if lock_ds is not None and not has_agg and not windows and \
+                not stmt.distinct and lock_ds.handle_col is not None \
+                and all(sc.name != "_tidb_rowid"
+                        for sc in proj_schema.cols):
+            proj.exprs.append(lock_ds.handle_col)
+            proj_schema.append(SchemaCol(
+                lock_ds.handle_col, "_tidb_rowid", lock_ds.alias,
+                lock_ds.db_name, hidden=True))
 
         if stmt.distinct:
             dag_schema = Schema([SchemaCol(sc.col, sc.name, sc.table)
@@ -1181,6 +1212,20 @@ class PlanBuilder:
     def build_insert(self, stmt: ast.InsertStmt) -> InsertPlan:
         db = self._resolve_db(stmt.table.db)
         tbl = self.pctx.infoschema.table_by_name(db, stmt.table.name)
+        part_sel = None
+        if stmt.table.partitions:
+            from ..errors import TiDBError
+            if not tbl.partitions:
+                raise UnsupportedError(
+                    "PARTITION () clause on nonpartitioned table")
+            by_name = {p["name"].lower(): p["pid"]
+                       for p in tbl.partitions["parts"]}
+            part_sel = []
+            for pn in stmt.table.partitions:
+                pid = by_name.get(pn.lower())
+                if pid is None:
+                    raise TiDBError("Unknown partition '%s'", pn)
+                part_sel.append(pid)
         cols = tbl.public_columns()
         if stmt.columns:
             name_to_off = {c.name.lower(): i for i, c in enumerate(cols)}
@@ -1192,7 +1237,8 @@ class PlanBuilder:
         else:
             offsets = list(range(len(cols)))
         plan = InsertPlan(table_info=tbl, db_name=db, col_offsets=offsets,
-                          is_replace=stmt.is_replace, ignore=stmt.ignore)
+                          is_replace=stmt.is_replace, ignore=stmt.ignore,
+                          part_sel=part_sel)
         if stmt.select is not None:
             plan.select_plan = self.build_select(stmt.select)
         else:
@@ -1272,6 +1318,8 @@ class PlanBuilder:
         return ds, p
 
     def build_update(self, stmt: ast.UpdateStmt) -> UpdatePlan:
+        if not isinstance(stmt.table_refs, ast.TableName):
+            return self._build_multi_update(stmt)
         ds, p = self._build_write_source(stmt.table_refs, stmt.where,
                                          stmt.order_by, stmt.limit)
         tbl = ds.table_info
@@ -1288,6 +1336,78 @@ class PlanBuilder:
                 raise ColumnNotExistsError("Unknown column '%s'", colref.name)
             plan.assignments.append((off, rw.rewrite(e)))
         return plan
+
+    def _build_multi_update(self, stmt: ast.UpdateStmt) -> UpdatePlan:
+        """UPDATE t1 [JOIN|,] t2 SET t1.c = ..., t2.d = ... WHERE ...
+        (reference executor/update.go multi-table update): one joined
+        read; each assigned table's rows update once — the FIRST join
+        match wins, like MySQL."""
+        p = self.build_from(stmt.table_refs)
+        if stmt.where is not None:
+            p = self._apply_where(stmt.where, p)
+        if stmt.order_by or stmt.limit is not None:
+            raise UnsupportedError(
+                "multi-table UPDATE cannot have ORDER BY or LIMIT")
+        rw = self._rewriter(p.schema)
+        ischema = self.pctx.infoschema
+        plan = UpdatePlan(select_plan=p)
+        by_alias: dict = {}
+        for colref, e in stmt.assignments:
+            alias = colref.table.lower()
+            if not alias:
+                owners = {sc.table for sc in p.schema.cols
+                          if sc.name == colref.name.lower() and
+                          not sc.hidden}
+                if len(owners) != 1:
+                    raise ColumnNotExistsError(
+                        "Column '%s' is ambiguous", colref.name)
+                alias = next(iter(owners))
+            by_alias.setdefault(alias, []).append((colref, e))
+        for alias, assigns in by_alias.items():
+            cols = [sc for sc in p.schema.cols if sc.table == alias]
+            if not cols:
+                raise UnsupportedError(
+                    "Unknown target table %s in UPDATE", alias)
+            handle_sc = next((sc for sc in cols
+                              if sc.name == "_tidb_rowid"), None)
+            if handle_sc is None:
+                raise UnsupportedError(
+                    "target %s is not an updatable table", alias)
+            db = next((sc.db for sc in cols if sc.db),
+                      self.pctx.current_db)
+            # alias may differ from the real table name: resolve via
+            # the source table ref that produced these schema cols
+            tbl = None
+            for tn2 in self._update_source_tables(stmt.table_refs):
+                if (tn2.alias or tn2.name).lower() == alias:
+                    tbl = ischema.table_by_name(
+                        tn2.db or self.pctx.current_db, tn2.name)
+                    break
+            if tbl is None:
+                raise UnsupportedError(
+                    "Unknown target table %s in UPDATE", alias)
+            offs = []
+            for ci in tbl.public_columns():
+                sc = next(s for s in cols if s.name == ci.name.lower())
+                offs.append(sc.col.idx)
+            table_assigns = []
+            pub = tbl.public_columns()
+            for colref, e in assigns:
+                off = next((i for i, c in enumerate(pub)
+                            if c.name.lower() == colref.name.lower()),
+                           None)
+                if off is None:
+                    raise ColumnNotExistsError(
+                        "Unknown column '%s'", colref.name)
+                table_assigns.append((off, rw.rewrite(e)))
+            plan.multi.append((tbl, db, offs, handle_sc.col.idx,
+                               table_assigns))
+        return plan
+
+    def _update_source_tables(self, refs):
+        out: list = []
+        self._collect_sources(refs, out)
+        return out
 
     def build_delete(self, stmt: ast.DeleteStmt) -> DeletePlan:
         if stmt.targets:

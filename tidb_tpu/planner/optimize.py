@@ -107,6 +107,7 @@ def optimize(stmt, pctx: PlanContext):
         phys = attach_fused_topn(phys)
         phys.read_tables = frozenset(pctx.read_tables)
         phys.for_update = stmt.for_update
+        phys.lock_wait = getattr(stmt, "lock_wait", "")
         if pctx.stale_read_ts:
             phys.stale_read_ts = pctx.stale_read_ts
         if hints:

@@ -63,6 +63,7 @@ class CoprDAG:
     aggs: list = field(default_factory=list)        # partial AggDescs
     limit: int = -1                                 # scan-level limit
     topn: tuple | None = None                       # ((expr, desc), k)
+    part_sel: list | None = None    # explicit PARTITION (p, ...) pids
 
 
 class PhysTableReader(PhysPlan):
@@ -906,7 +907,8 @@ def _mk_reader(ds: DataSource) -> PhysPlan:
             return im
     cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
     dag = CoprDAG(table_info=ds.table_info, db_name=ds.db_name,
-                  cols=list(cols))
+                  cols=list(cols),
+                  part_sel=getattr(ds, "part_sel", None))
     _absorb_filters(dag, ds.pushed_conds)
     schema = Schema(list(cols))
     rd = PhysTableReader(dag, schema)

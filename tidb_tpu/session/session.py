@@ -816,7 +816,7 @@ class Session:
                 ex.close()
                 self.domain.unregister_exec(self.conn_id, ectx)
         if getattr(plan, "for_update", False) and self._explicit_txn:
-            self._lock_for_update(plan, chunks)
+            chunks = self._lock_for_update(plan, chunks)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
         names = [plan.schema.cols[i].name for i in vis]
         out_chunks = []
@@ -824,6 +824,21 @@ class Session:
         for ch in chunks:
             out_chunks.append(Chunk([ch.columns[i] for i in vis]))
         self._finish_stmt()
+        if getattr(stmt, "into_vars", None):
+            total = sum(len(c) for c in out_chunks)
+            if total > 1:
+                raise TiDBError(
+                    "Result consisted of more than one row")   # 1172
+            if len(stmt.into_vars) != len(names):
+                raise TiDBError(
+                    "The used SELECT statements have a different "
+                    "number of columns")
+            if total:
+                ch = next(c for c in out_chunks if len(c))
+                for i, v in enumerate(stmt.into_vars):
+                    self.domain.user_vars[v] = \
+                        ch.columns[i].get_datum(0).to_py()
+            return ResultSet(affected=total)
         if getattr(stmt, "into_outfile", ""):
             import os as _os
             if _os.path.exists(stmt.into_outfile):
@@ -837,11 +852,18 @@ class Session:
     def _lock_for_update(self, plan, chunks):
         """SELECT ... FOR UPDATE: acquire pessimistic locks on the result
         rows' record keys. PointGet plans lock the computed handle; reader
-        plans lock via the hidden _tidb_rowid column when present."""
+        plans lock via the hidden _tidb_rowid column when present.
+        Lock conflicts surface immediately (this engine has no lock
+        WAIT queue, so plain FOR UPDATE already behaves like NOWAIT);
+        SKIP LOCKED instead drops the conflicting rows from the
+        result (reference executor point_get/lock with
+        tidb_lock_wait_policy). Returns the (possibly filtered)
+        chunks."""
         from ..codec.tablecodec import record_key
         from ..planner.physical import PhysPointGet
         from ..executor.exec_base import expr_to_datum
         keys = []
+        key_handles = []       # handle per key (PointGet path)
 
         def walk(p):
             if isinstance(p, PhysPointGet):
@@ -849,6 +871,7 @@ class Session:
                     d = expr_to_datum(p.handle_expr)
                     if not d.is_null:
                         keys.append(record_key(p.table_info.id, int(d.val)))
+                        key_handles.append(int(d.val))
                 else:
                     # lock via the row just read (chunks carry it if found)
                     for ch in chunks:
@@ -857,14 +880,39 @@ class Session:
                 walk(c)
         walk(plan)
         tables = list(getattr(plan, "read_tables", ()))
+        skip = getattr(plan, "lock_wait", "") == "skip locked"
+        if keys and skip:
+            return self._skip_locked_point(plan, chunks, keys,
+                                           key_handles, tables)
+        hidx = None
         if not keys and len(tables) == 1:
             db, tname = tables[0]
             tbl = self.domain.infoschema().table_by_name(db, tname)
             if tbl.id > 0 and not tbl.partitions:
-                hidx = None
                 for i, sc in enumerate(plan.schema.cols):
                     if sc.name == "_tidb_rowid":
                         hidx = i
+                if hidx is not None and skip:
+                    # per-row locks; conflicting rows drop out
+                    from ..errors import LockWaitTimeoutError
+                    out = []
+                    for ch in chunks:
+                        keep = []
+                        for i in range(len(ch)):
+                            k = record_key(
+                                tbl.id, int(ch.columns[hidx].data[i]))
+                            try:
+                                self.txn().lock_keys([k])
+                                keep.append(i)
+                            except LockWaitTimeoutError:
+                                pass
+                        if len(keep) == len(ch):
+                            out.append(ch)
+                        elif keep:
+                            import numpy as _np
+                            out.append(ch.take(
+                                _np.asarray(keep, dtype=_np.int64)))
+                    return out
                 if hidx is not None:
                     for ch in chunks:
                         for i in range(len(ch)):
@@ -872,6 +920,47 @@ class Session:
                                 tbl.id, int(ch.columns[hidx].data[i])))
         if keys:
             self.txn().lock_keys(keys)
+        return chunks
+
+    def _skip_locked_point(self, plan, chunks, keys, key_handles,
+                           tables):
+        """SKIP LOCKED for PointGet-shaped plans: lock per key; rows
+        of keys another txn holds drop out of the result."""
+        from ..errors import LockWaitTimeoutError
+        failed = set()
+        first_err = None
+        for k, h in zip(keys, key_handles):
+            try:
+                self.txn().lock_keys([k])
+            except LockWaitTimeoutError as e:
+                failed.add(h)
+                first_err = e
+        if not failed:
+            return chunks
+        if len(failed) == len(keys):
+            return []
+        # partial failure: filter rows via the pk-as-handle column
+        if len(tables) == 1:
+            db, tname = tables[0]
+            tbl = self.domain.infoschema().table_by_name(db, tname)
+            if tbl.pk_is_handle:
+                pidx = next(
+                    (i for i, sc in enumerate(plan.schema.cols)
+                     if sc.name == tbl.pk_col_name.lower()), None)
+                if pidx is not None:
+                    import numpy as _np
+                    out = []
+                    for ch in chunks:
+                        keep = [i for i in range(len(ch))
+                                if int(ch.columns[pidx].data[i])
+                                not in failed]
+                        if len(keep) == len(ch):
+                            out.append(ch)
+                        elif keep:
+                            out.append(ch.take(
+                                _np.asarray(keep, dtype=_np.int64)))
+                    return out
+        raise first_err       # rows can't be mapped to keys: surface it
 
     def _exec_dml(self, stmt, params=None) -> ResultSet:
         """DML with autocommit retry on write conflict (reference
@@ -900,7 +989,12 @@ class Session:
                 self.check_priv("insert", plan.db_name, plan.table_info.name)
                 affected = InsertExec(ectx, plan, self).execute()
             elif isinstance(plan, UpdatePlan):
-                self.check_priv("update", plan.db_name, plan.table_info.name)
+                if plan.multi:
+                    for tbl, db, _offs, _h, _a in plan.multi:
+                        self.check_priv("update", db, tbl.name)
+                else:
+                    self.check_priv("update", plan.db_name,
+                                    plan.table_info.name)
                 affected = UpdateExec(ectx, plan, self).execute()
             elif isinstance(plan, DeletePlan):
                 if plan.multi:

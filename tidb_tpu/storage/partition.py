@@ -46,10 +46,16 @@ def route_partition(tbl, part_val) -> int:
 def prune_for_dag(dag) -> list:
     """Partition pruning for a CoprDAG: ONE definition shared by the
     executor's partition expansion and the planner's EXPLAIN display,
-    so what EXPLAIN shows is exactly what execution scans."""
+    so what EXPLAIN shows is exactly what execution scans. An explicit
+    PARTITION (p, ...) selection (dag.part_sel) narrows the candidate
+    set before predicate pruning."""
     col_name_of = {sc.col.idx: sc.name for sc in dag.cols}
-    return prune_partitions(dag.table_info,
+    pids = prune_partitions(dag.table_info,
                             dag.filters + dag.host_filters, col_name_of)
+    sel = getattr(dag, "part_sel", None)
+    if sel is not None:
+        pids = [p for p in pids if p in sel]
+    return pids
 
 
 def prune_partitions(tbl, conds, col_name_of) -> list:
