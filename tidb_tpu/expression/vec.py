@@ -306,22 +306,25 @@ def _string_elementwise(ctx, data, fn, dtype=object):
     return out
 
 
-def _apply_str_fn(ctx, val, fn, out_is_string=True):
+def _apply_str_fn(ctx, val, fn, out_is_string=True, out_dtype=None):
     """Apply python str->x over a string value (dict column, object array,
-    or scalar)."""
+    or scalar). out_dtype picks the non-string result dtype (int64
+    default; float fns MUST pass float64 or values truncate)."""
     data, nulls, sdict = val
+    if out_dtype is None:
+        out_dtype = np.int64
     if isinstance(data, str):
         r = fn(data)
         return (r, nulls, None)
     if sdict is not None:
         if out_is_string:
             return _dict_transform(ctx, data, nulls, sdict, fn)
-        tbl = _dict_table(ctx, sdict, fn, np.int64)
+        tbl = _dict_table(ctx, sdict, fn, out_dtype)
         return tbl[data], nulls, None
     # host object array
     if out_is_string:
         return _string_elementwise(ctx, data, fn), nulls, None
-    return _string_elementwise(ctx, data, fn, dtype=np.int64), nulls, None
+    return _string_elementwise(ctx, data, fn, dtype=out_dtype), nulls, None
 
 
 def _as_str_scalar(val):
@@ -333,15 +336,47 @@ def _as_str_scalar(val):
 
 # ---------------- arithmetic ----------------
 
-def _binary_vals(ctx, expr):
+_NUM_PREFIX_RE = re.compile(
+    r"^\s*[-+]?(\d+(\.\d*)?|\.\d+)([eE][-+]?\d+)?")
+
+
+def mysql_str_to_float(s) -> float:
+    """MySQL string->number: parse the longest numeric prefix, 0 when
+    none ('3abc' -> 3.0, 'abc' -> 0.0, '  8 ' -> 8.0)."""
+    if s is None:
+        return 0.0
+    m = _NUM_PREFIX_RE.match(str(s))
+    return float(m.group(0)) if m else 0.0
+
+
+def _numify(ctx, val, ft):
+    """String operand in numeric context -> float (prefix parse).
+    Handles scalar constants, object arrays, and dict columns (codes
+    must NEVER reach arithmetic as numbers)."""
+    if _dataclass_of(ft) != "string":
+        return val
+    data, nulls, sd = val
+    if sd is None and not isinstance(data, str) and \
+            not (hasattr(data, "dtype") and data.dtype == object):
+        return val                       # already numeric
+    out, n2, _ = _apply_str_fn(ctx, val, mysql_str_to_float,
+                               out_is_string=False,
+                               out_dtype=np.float64)
+    return out, n2, None
+
+
+def _binary_vals(ctx, expr, numeric=False):
     a = eval_expr(ctx, expr.args[0])
     b = eval_expr(ctx, expr.args[1])
+    if numeric:
+        a = _numify(ctx, a, expr.args[0].ft)
+        b = _numify(ctx, b, expr.args[1].ft)
     return a, b
 
 
 @op("+", "-")
 def op_addsub(ctx, expr):
-    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr, numeric=True)
     aft, bft = expr.args[0].ft, expr.args[1].ft
     a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
     r = a2 + b2 if expr.op == "+" else a2 - b2
@@ -355,7 +390,7 @@ def op_addsub(ctx, expr):
 
 @op("*")
 def op_mul(ctx, expr):
-    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr, numeric=True)
     aft, bft = expr.args[0].ft, expr.args[1].ft
     ca, cb = _dataclass_of(aft), _dataclass_of(bft)
     xp = ctx.xp
@@ -386,7 +421,7 @@ def op_mul(ctx, expr):
 def op_div(ctx, expr):
     """Division -> float result unless expr.ft says decimal (then exact
     scaled arithmetic with div_precision_increment)."""
-    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr, numeric=True)
     aft, bft = expr.args[0].ft, expr.args[1].ft
     xp = ctx.xp
     if expr.ft.tclass == TypeClass.DECIMAL:
@@ -437,7 +472,7 @@ def op_div(ctx, expr):
 
 @op("div")
 def op_intdiv(ctx, expr):
-    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr, numeric=True)
     aft, bft = expr.args[0].ft, expr.args[1].ft
     xp = ctx.xp
     a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
@@ -455,7 +490,7 @@ def op_intdiv(ctx, expr):
 
 @op("%", "mod")
 def op_mod(ctx, expr):
-    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr)
+    (a, an, _), (b, bn, _) = _binary_vals(ctx, expr, numeric=True)
     aft, bft = expr.args[0].ft, expr.args[1].ft
     xp = ctx.xp
     a2, b2, cls, s = coerce_numeric_pair(ctx, a, aft, b, bft)
@@ -494,15 +529,40 @@ def _cmp_core(xp, op_name, a, b):
     raise ValueError(op_name)
 
 
+def _pad_fold(s):
+    """PAD SPACE normal form (no case fold): every non-binary MySQL
+    collation ignores trailing spaces in comparisons — 'a' = 'a  '
+    (reference pkg/util/collate/collate.go PadSpace attribute;
+    utf8mb4_bin included)."""
+    return s.rstrip(" ") if isinstance(s, str) else s
+
+
+def _is_nopad(ft) -> bool:
+    """Only the binary 'collation' (BINARY/VARBINARY/BLOB types or an
+    explicit binary collate) compares trailing spaces."""
+    if ft is None:
+        return False
+    if str(getattr(ft, "collate", "")).lower() == "binary":
+        return True
+    return (getattr(ft, "tp", "") or "").lower() in (
+        "binary", "varbinary", "blob", "tinyblob", "mediumblob",
+        "longblob")
+
+
 def _cmp_strings(ctx, expr, op_name, aval, bval):
     xp = ctx.xp
     (a, an, ad), (b, bn, bd) = aval, bval
-    ci = _is_ci(expr.args[0].ft) or _is_ci(expr.args[1].ft)
-    if ci:
-        # case-insensitive + PAD SPACE: compare normal forms via dict
-        # tables ('beta ' = 'BETA' under utf8mb4_general_ci); ONE
-        # definition of the normal form lives on StringDict
-        fold = StringDict.ci_fold
+    aft, bft = expr.args[0].ft, expr.args[1].ft
+    ci = _is_ci(aft) or _is_ci(bft)
+    nopad = _is_nopad(aft) or _is_nopad(bft)
+    # normal-form comparison: case fold + PAD SPACE for _ci
+    # collations, PAD SPACE alone for everything else but binary
+    # ('beta ' = 'BETA' under general_ci, 'a ' = 'a' under
+    # utf8mb4_bin); ONE definition of each normal form lives on
+    # StringDict / _pad_fold. fold is None only for binary.
+    fold = StringDict.ci_fold if ci else \
+        (None if nopad else _pad_fold)
+    if fold is not None:
         if isinstance(a, str) and isinstance(b, str):
             return (_cmp_core(xp, op_name, fold(a), fold(b)),
                     or_nulls(xp, an, bn), None)
@@ -544,8 +604,12 @@ def _cmp_strings(ctx, expr, op_name, aval, bval):
             tbl = _dict_table(ctx, ad, lambda s: _cmp_core(np, op_name, s, b),
                               np.bool_)
             return tbl[a], or_nulls(xp, an, bn), None
-        r = _string_elementwise(ctx, a, lambda s: _cmp_core(np, op_name, s, b),
-                                dtype=np.bool_)
+        fb = fold(b) if fold else b
+        r = _string_elementwise(
+            ctx, a,
+            lambda s: _cmp_core(np, op_name,
+                                fold(s) if fold else s, fb),
+            dtype=np.bool_)
         return r, or_nulls(xp, an, bn), None
     if isinstance(a, str):
         flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
@@ -575,7 +639,10 @@ def _cmp_strings(ctx, expr, op_name, aval, bval):
     # host object arrays
     out = np.empty(ctx.n, dtype=np.bool_)
     for i in range(ctx.n):
-        out[i] = _cmp_core(np, op_name, a[i], b[i])
+        av, bv = a[i], b[i]
+        if fold is not None:
+            av, bv = fold(av), fold(bv)
+        out[i] = _cmp_core(np, op_name, av, bv)
     return out, or_nulls(xp, an, bn), None
 
 
@@ -588,7 +655,10 @@ def op_cmp(ctx, expr):
         b_is = bft.tclass in (TypeClass.STRING, TypeClass.JSON)
         if a_is and b_is:
             return _cmp_strings(ctx, expr, expr.op, aval, bval)
-        # mixed string/numeric: numeric context (host parse already applied)
+        # mixed string/numeric: the string side compares as a NUMBER
+        # (prefix parse — dict codes must never reach _cmp_core)
+        aval = _numify(ctx, aval, aft)
+        bval = _numify(ctx, bval, bft)
     (a, an, _), (b, bn, _) = aval, bval
     a2, b2, _, _ = coerce_numeric_pair(ctx, a, expr.args[0].ft, b,
                                        expr.args[1].ft)
@@ -1140,10 +1210,21 @@ def op_locate(ctx, expr):
     if expr.op == "instr":
         sv = eval_expr(ctx, expr.args[0])
         sub = _as_str_scalar(eval_expr(ctx, expr.args[1]))
+        pos = 1
     else:
         sub = _as_str_scalar(eval_expr(ctx, expr.args[0]))
         sv = eval_expr(ctx, expr.args[1])
-    return _apply_str_fn(ctx, sv, lambda s: s.find(sub) + 1,
+        # LOCATE(substr, str, pos): 1-based; pos < 1 -> 0 (MySQL)
+        pos = _const_int(ctx, expr.args[2]) \
+            if len(expr.args) > 2 else 1
+    if pos < 1:
+        data, nulls, _ = sv
+        n = len(data) if hasattr(data, "__len__") and \
+            not isinstance(data, str) else None
+        out = np.zeros(n, dtype=np.int64) if n is not None else 0
+        return out, nulls, None
+    return _apply_str_fn(ctx, sv,
+                         lambda s: s.find(sub, pos - 1) + 1,
                          out_is_string=False)
 
 
@@ -1634,10 +1715,10 @@ def op_cast_int(ctx, expr):
     if sd is not None or (hasattr(a, "dtype") and a.dtype == object) or \
             isinstance(a, str):
         def p(s):
-            try:
-                return int(float(s))
-            except (ValueError, TypeError):
-                return 0
+            # MySQL: numeric prefix, rounded (CAST('123.6' AS
+            # SIGNED) -> 124)
+            v = mysql_str_to_float(s)
+            return int(v + 0.5) if v >= 0 else int(v - 0.5)
         return _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
     cls = _dataclass_of(ft)
     if cls == "float":
@@ -1653,12 +1734,10 @@ def op_cast_double(ctx, expr):
     ft = expr.args[0].ft
     if sd is not None or (hasattr(a, "dtype") and a.dtype == object) or \
             isinstance(a, str):
-        def p(s):
-            try:
-                return float(s)
-            except (ValueError, TypeError):
-                return 0.0
-        data, nulls, _ = _apply_str_fn(ctx, (a, an, sd), p, out_is_string=False)
+        data, nulls, _ = _apply_str_fn(ctx, (a, an, sd),
+                                       mysql_str_to_float,
+                                       out_is_string=False,
+                                       out_dtype=np.float64)
         return ctx.xp.asarray(data, dtype=ctx.float_dtype), nulls, None
     return _to_float(ctx, a, ft), an, None
 
@@ -1803,7 +1882,23 @@ def op_conv(ctx, expr):
             out = digits[n % to] + out
             n //= to
         return ("-" if v < 0 else "") + (out or "0")
-    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+    val = eval_expr(ctx, expr.args[0])
+    aft = expr.args[0].ft
+    if aft.tclass != TypeClass.STRING:
+        # CONV(255, 10, 16): numeric first arg — floats truncate,
+        # decimals unscale from their int storage first
+        data, nulls, _sd = val
+        if aft.tclass == TypeClass.DECIMAL:
+            p = _POW10[_scale_of(aft)]
+            conv1 = lambda x: f(int(x) // int(p))       # noqa: E731
+        else:
+            conv1 = lambda x: f(int(x))                  # noqa: E731
+        if np.isscalar(data):
+            return conv1(data), nulls, None
+        out = np.array([conv1(x) for x in np.asarray(data)],
+                       dtype=object)
+        return out, nulls, None
+    return _apply_str_fn(ctx, val, f)
 
 
 # ---------------- more string/byte functions ----------------
@@ -2618,6 +2713,9 @@ def op_str_to_date(ctx, expr):
             days = ymd_to_days(vals["Y"], vals["m"], vals["d"])
         except Exception:               # noqa: BLE001
             return None
+        if expr.ft.tclass == TypeClass.DATE:
+            # date-only format: the result TYPE is DATE (days encoding)
+            return days
         return days * MICROS_PER_DAY + \
             (vals["H"] * 3600 + vals["i"] * 60 + vals["s"]) * 1_000_000
     out, nulls, _sd = _rowwise(

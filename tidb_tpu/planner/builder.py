@@ -496,6 +496,14 @@ class PlanBuilder:
                 name = "first_row"
             if name == "count" and not args:
                 args = []
+            if name in ("sum", "avg") and args and \
+                    args[0].ft.tclass in (TypeClass.STRING,
+                                          TypeClass.JSON):
+                # MySQL sums strings as doubles (numeric-prefix parse);
+                # the implicit cast here makes every backend (device
+                # partials, host, spill) inherit that semantics
+                args = [ScalarFunc("cast_double", [args[0]],
+                                   new_double_type())] + args[1:]
             desc = AggDesc(name=name, args=args, distinct=node.distinct)
             if name == "group_concat":
                 if getattr(node, "order_by", None):

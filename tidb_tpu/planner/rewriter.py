@@ -96,8 +96,11 @@ def infer_binop_ft(op: str, lft: FieldType, rft: FieldType,
     if op in ("=", "!=", "<", "<=", ">", ">=", "<=>", "and", "or", "xor",
               "not", "like", "in", "regexp"):
         return _BOOL_FT.clone()
-    if op in ("&", "|", "^", "<<", ">>", "div"):
+    if op in ("&", "|", "^", "<<", ">>"):
         return new_bigint_type(unsigned=True)
+    if op == "div":
+        # MySQL: DIV is signed unless an operand is unsigned
+        return new_bigint_type(unsigned=lft.unsigned or rft.unsigned)
     if op in ("+", "-", "*"):
         m = merge_field_type(lft, rft)
         if m.tclass == TypeClass.DECIMAL:
@@ -138,8 +141,18 @@ class Rewriter:
             elif op in _DATETIME_RET_FUNCS_EXTRA:
                 ft = new_datetime_type()
             elif op in _DATETIME_RET_FUNCS:
-                ft = new_string_type() if op == "from_unixtime" \
-                    and len(args) > 1 else new_datetime_type()
+                if op == "from_unixtime" and len(args) > 1:
+                    ft = new_string_type()
+                elif op == "str_to_date" and len(args) > 1 and \
+                        isinstance(args[1], Constant) and \
+                        not args[1].value.is_null and not any(
+                            ("%" + c) in str(args[1].value.val)
+                            for c in "HkisSTrpfhIl"):
+                    # no time specifiers in the format: MySQL returns
+                    # a DATE
+                    ft = new_date_type()
+                else:
+                    ft = new_datetime_type()
             elif op in _STRING_FUNCS:
                 ft = new_string_type()
             elif op in _INT_FUNCS:
@@ -373,7 +386,10 @@ class Rewriter:
                                 new_decimal_type(node.flen if node.flen > 0 else 10,
                                                  scale))
         if t in ("char", "binary", "varchar", "nchar"):
-            return self.mk_func("cast_char", [a], new_string_type(node.flen))
+            ft = new_string_type(node.flen)
+            if t == "binary":
+                ft.collate = "binary"   # no-pad comparisons
+            return self.mk_func("cast_char", [a], ft)
         if t == "date":
             if src in (TypeClass.STRING, TypeClass.JSON):
                 return self.mk_func("cast_str_to_date", [a], new_date_type())
