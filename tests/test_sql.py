@@ -663,6 +663,54 @@ class TestDDL:
         assert ("rr2", "%") in pm.role_edges[("ru", "%")]
         assert pm.db_privs.get(("rr2", "%", "test")) == {"select"}
 
+    def test_lock_tables(self, ftk):
+        """LOCK TABLES behind tidb_enable_table_lock (reference
+        enable-table-lock gate): READ blocks other sessions' writes,
+        WRITE blocks their reads and conflicting locks; the gate off
+        makes the statements no-ops."""
+        from tidb_tpu.session import Session
+        from tidb_tpu.errors import TiDBError
+        ftk.must_exec("create table ltk (a int primary key)")
+        ftk.must_exec("lock tables ltk write")   # gate off: no-op
+        ftk.must_exec("unlock tables")
+        ftk.must_exec("set @@tidb_enable_table_lock = 1")
+        s2 = Session(ftk.domain)
+        s2.vars.current_db = "test"
+        try:
+            ftk.must_exec("lock tables ltk read")
+            s2.execute("select * from ltk")      # reads fine
+            with pytest.raises(TiDBError):
+                s2.execute("insert into ltk values (1)")
+            ftk.must_exec("unlock tables")
+            s2.execute("set @@tidb_enable_table_lock = 1")
+            s2.execute("lock tables ltk write")
+            with pytest.raises(TiDBError):
+                ftk.must_query("select * from ltk")
+            with pytest.raises(TiDBError):
+                ftk.must_exec("lock tables ltk read")
+            s2.execute("unlock tables")
+            ftk.must_exec("insert into ltk values (2)")
+            # review regressions: own READ lock forbids writing (1099),
+            # DML-internal reads and DDL respect other sessions' locks,
+            # dropping a locked table purges its registry entry
+            ftk.must_exec("create table ltk2 (a int primary key)")
+            ftk.must_exec("lock tables ltk read")
+            with pytest.raises(TiDBError):
+                ftk.must_exec("insert into ltk values (3)")
+            ftk.must_exec("unlock tables")
+            ftk.must_exec("lock tables ltk write")
+            with pytest.raises(TiDBError):
+                s2.execute("insert into ltk2 select a from ltk")
+            with pytest.raises(TiDBError):
+                s2.execute("drop table ltk")
+            ftk.must_exec("drop table ltk")   # holder may; purges entry
+            s2.execute("create table ltk (a int)")
+            s2.execute("insert into ltk values (7)")
+        finally:
+            s2.execute("unlock tables")
+            ftk.must_exec("unlock tables")
+            ftk.must_exec("set @@tidb_enable_table_lock = 0")
+
     def test_maintain_statements(self, ftk):
         """CHECK/OPTIMIZE/REPAIR TABLE return MySQL-style maintenance
         rows; CHECK runs the index<->row consistency pass."""

@@ -274,6 +274,18 @@ class SetRoleStmt(StmtNode):
 
 
 @dataclass
+class LockTablesStmt(StmtNode):
+    """LOCK TABLES t READ|WRITE [, ...] (reference pkg/ddl table lock,
+    gated by enable-table-lock)."""
+    locks: list = field(default_factory=list)   # [(TableName, mode)]
+
+
+@dataclass
+class UnlockTablesStmt(StmtNode):
+    pass
+
+
+@dataclass
 class MaintainTableStmt(StmtNode):
     """CHECK / OPTIMIZE / REPAIR TABLE — MySQL maintenance statements
     returning (Table, Op, Msg_type, Msg_text) rows."""

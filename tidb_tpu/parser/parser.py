@@ -169,6 +169,35 @@ class Parser:
             while self.accept_op(","):
                 stmt.tables.append(self.parse_table_name())
             return stmt
+        if kw == "lock":
+            self.next()
+            if not self.accept_kw("tables"):
+                self.expect_kw("table")
+            stmt = ast.LockTablesStmt()
+            while True:
+                tn = self.parse_table_name()
+                if self.accept_kw("as"):
+                    tn.alias = self.ident()
+                elif self.peek().kind == "QIDENT" or (
+                        self.peek().kind == "IDENT" and
+                        not self.at_kw("read", "write",
+                                       "low_priority")):
+                    tn.alias = self.ident()
+                self.accept_kw("low_priority")
+                mode = self.next().text.lower()
+                if mode not in ("read", "write"):
+                    self.error("expected READ or WRITE")
+                if mode == "read":
+                    self.accept_kw("local")
+                stmt.locks.append((tn, mode))
+                if not self.accept_op(","):
+                    break
+            return stmt
+        if kw == "unlock":
+            self.next()
+            if not self.accept_kw("tables"):
+                self.expect_kw("table")
+            return ast.UnlockTablesStmt()
         if kw in ("check", "optimize", "repair"):
             self.next()
             self.expect_kw("table")

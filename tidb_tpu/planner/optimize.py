@@ -124,15 +124,18 @@ def optimize(stmt, pctx: PlanContext):
             plan.select_plan = to_physical(
                 optimize_logical(plan.select_plan, no_reorder=nr),
                 pctx.sess_vars)
+        plan.read_tables = frozenset(pctx.read_tables)
         return plan
     if isinstance(stmt, ast.UpdateStmt):
         plan = builder.build_update(stmt)
         plan.select_plan = to_physical(optimize_logical(plan.select_plan),
                                        pctx.sess_vars)
+        plan.read_tables = frozenset(pctx.read_tables)
         return plan
     if isinstance(stmt, ast.DeleteStmt):
         plan = builder.build_delete(stmt)
         plan.select_plan = to_physical(optimize_logical(plan.select_plan),
                                        pctx.sess_vars)
+        plan.read_tables = frozenset(pctx.read_tables)
         return plan
     return stmt   # DDL / utility statements execute from the AST directly

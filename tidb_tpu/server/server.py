@@ -112,6 +112,8 @@ class Server:
             pass
         finally:
             sess.rollback()
+            # a dropped client must not strand its LOCK TABLES set
+            sess._release_table_locks()
             try:
                 sock.close()
             except OSError:

@@ -66,6 +66,10 @@ class Domain:
         self.priv = PrivManager(self)
         self._live_execs: dict = {}       # conn_id -> [ExecContext]
         self.sessions: dict = {}          # conn_id -> weakref(Session)
+        # LOCK TABLES registry: (db, table) -> (mode, conn_id)
+        # (reference pkg/ddl table locks, gated by enable-table-lock)
+        self.table_locks: dict = {}
+        self.table_locks_mu = threading.Lock()
         self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
         self.plan_cache_order: list = []
         self.plan_cache_cap = 256

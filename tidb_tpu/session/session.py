@@ -364,6 +364,11 @@ class Session:
             return _str_chunk(
                 ["Database", "Table", "Index_name", "Index_columns",
                  "Reason", "Score"], rows)
+        if isinstance(stmt, ast.LockTablesStmt):
+            return self._exec_lock_tables(stmt)
+        if isinstance(stmt, ast.UnlockTablesStmt):
+            self._release_table_locks()
+            return ResultSet()
         if isinstance(stmt, ast.MaintainTableStmt):
             from .show import _str_chunk
             rows = []
@@ -633,16 +638,38 @@ class Session:
         fn = ddl_map.get(type(stmt))
         if fn is not None:
             self._check_ddl_priv(stmt)
+            if self.domain.table_locks:
+                # DDL respects table locks too (the reference's table
+                # locks live IN pkg/ddl)
+                self._check_table_locks(
+                    [(db, tbl) for _p, db, tbl in
+                     self._ddl_targets(stmt) if tbl], write=True)
             self.commit()
             fn(stmt)
+            if self.domain.table_locks and isinstance(
+                    stmt, (ast.DropTableStmt, ast.RenameTableStmt)):
+                # purge registry entries for names that no longer exist
+                gone = stmt.tables if isinstance(
+                    stmt, ast.DropTableStmt) else \
+                    [old for old, _new in stmt.pairs]
+                with self.domain.table_locks_mu:
+                    for tn in gone:
+                        self.domain.table_locks.pop(
+                            ((tn.db or self.vars.current_db).lower(),
+                             tn.name.lower()), None)
             return ResultSet()
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
 
     def _check_ddl_priv(self, stmt):
         """DDL privilege gate (reference pkg/planner/core/planbuilder.go
-        visitInfo for DDL): CREATE/DROP/ALTER/INDEX at db or table scope.
-        Each stmt type names its priv and the TableName(s) it touches."""
+        visitInfo for DDL)."""
+        for priv, db, tbl in self._ddl_targets(stmt):
+            self.check_priv(priv, db, tbl)
+
+    def _ddl_targets(self, stmt):
+        """(priv, db, table) triples a DDL statement touches — shared
+        by the privilege gate and the table-lock check."""
         def tn_target(tn):
             return ((tn.db or self.vars.current_db), tn.name)
 
@@ -673,8 +700,7 @@ class Session:
             targets.append(("index", *tn_target(stmt.table)))
         elif isinstance(stmt, ast.AlterTableStmt):
             targets.append(("alter", *tn_target(stmt.table)))
-        for priv, db, tbl in targets:
-            self.check_priv(priv, db, tbl)
+        return targets
 
     def _plan_replayer_dump(self, stmt):
         """PLAN REPLAYER DUMP EXPLAIN <sql> (reference
@@ -804,6 +830,11 @@ class Session:
                 while len(dom.plan_cache_order) > dom.plan_cache_cap:
                     old = dom.plan_cache_order.pop(0)
                     dom.plan_cache.pop(old, None)
+        if dom.table_locks:
+            # before register_exec: a raise here must not leak an
+            # ExecContext into _live_execs
+            self._check_table_locks(
+                list(getattr(plan, "read_tables", ())), write=False)
         ectx = ExecContext(self, getattr(plan, "exec_hints", None))
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
         self.domain.register_exec(self.conn_id, ectx)
@@ -848,6 +879,69 @@ class Session:
             total = sum(len(c) for c in out_chunks)
             return ResultSet(affected=total)
         return ResultSet(names=names, chunks=out_chunks)
+
+    def _exec_lock_tables(self, stmt):
+        """LOCK TABLES (reference pkg/ddl table locks + the
+        enable-table-lock config gate): when the gate is off the
+        statement parses and no-ops, like the reference. Acquiring
+        releases this session's previous set first (MySQL
+        semantics); conflicts error immediately (no wait queue)."""
+        if not bool(self.vars.get("tidb_enable_table_lock")):
+            return ResultSet()
+        dom = self.domain
+        want = []
+        for tn, mode in stmt.locks:
+            db = tn.db or self.vars.current_db
+            dom.infoschema().table_by_name(db, tn.name)  # must exist
+            want.append(((db.lower(), tn.name.lower()), mode))
+        with dom.table_locks_mu:
+            self._release_table_locks_locked()
+            for key, mode in want:
+                held = dom.table_locks.get(key)
+                if held is not None and held[1] != self.conn_id and \
+                        ("write" in (mode, held[0])):
+                    raise TiDBError(
+                        "Table '%s' was locked in %s by connection %d",
+                        key[1], held[0].upper(), held[1])
+            for key, mode in want:
+                dom.table_locks[key] = (mode, self.conn_id)
+        return ResultSet()
+
+    def _release_table_locks_locked(self):
+        dom = self.domain
+        for key in [k for k, v in dom.table_locks.items()
+                    if v[1] == self.conn_id]:
+            del dom.table_locks[key]
+
+    def _release_table_locks(self):
+        with self.domain.table_locks_mu:
+            self._release_table_locks_locked()
+
+    def _check_table_locks(self, targets, write):
+        """Error when another connection's table lock forbids this
+        access: WRITE locks block everything, READ locks block writes
+        (reference ErrTableLocked 8020)."""
+        dom = self.domain
+        if not dom.table_locks:
+            return
+        with dom.table_locks_mu:
+            for db, tname in targets:
+                held = dom.table_locks.get(
+                    ((db or self.vars.current_db).lower(),
+                     tname.lower()))
+                if held is None:
+                    continue
+                if held[1] == self.conn_id:
+                    if write and held[0] == "read":
+                        # MySQL 1099: own READ lock forbids writing
+                        raise TiDBError(
+                            "Table '%s' was locked with a READ lock "
+                            "and can't be updated", tname)
+                    continue
+                if held[0] == "write" or write:
+                    raise TiDBError(
+                        "Table '%s' was locked in %s by connection %d",
+                        tname, held[0].upper(), held[1])
 
     def _lock_for_update(self, plan, chunks):
         """SELECT ... FOR UPDATE: acquire pessimistic locks on the result
@@ -984,6 +1078,20 @@ class Session:
         plan = optimize(stmt, self._plan_ctx(params))
         ectx = ExecContext(self)
         txn = self.txn()   # ensure txn exists before write
+        if self.domain.table_locks:
+            targets = []
+            if isinstance(plan, InsertPlan):
+                targets = [(plan.db_name, plan.table_info.name)]
+            elif isinstance(plan, (UpdatePlan, DeletePlan)):
+                if plan.multi:
+                    targets = [(m[1], m[0].name) for m in plan.multi]
+                else:
+                    targets = [(plan.db_name, plan.table_info.name)]
+            self._check_table_locks(targets, write=True)
+            # reads inside DML (INSERT...SELECT, joined UPDATE) honor
+            # other sessions' WRITE locks too
+            self._check_table_locks(
+                list(getattr(plan, "read_tables", ())), write=False)
         try:
             if isinstance(plan, InsertPlan):
                 self.check_priv("insert", plan.db_name, plan.table_info.name)

@@ -105,6 +105,7 @@ for _v in [
            enum_vals=["optimistic", "pessimistic"]),
     # commit fast paths (reference vardef/tidb_vars.go:815
     # TiDBEnableAsyncCommit / TiDBEnable1PC + the async-commit caps)
+    SysVar("tidb_enable_table_lock", SCOPE_BOTH, False, "bool"),
     SysVar("tidb_enable_async_commit", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_enable_1pc", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_async_commit_keys_limit", SCOPE_BOTH, 256, "int",
