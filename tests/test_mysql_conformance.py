@@ -139,6 +139,28 @@ def test_review_probe_regressions(tk):
     tk.must_query("select s > 1 from conf_r").check([(1,)])
 
 
+def test_correlated_not_in_three_valued(tk):
+    """Correlated NOT IN evaluates MySQL's 3-valued semantics PER
+    correlation group (roadmap item closed): empty group keeps every
+    probe (even NULL x); a NULL y in the group nulls out non-matching
+    rows; NULL x with a non-empty group is excluded."""
+    tk.must_exec("create table cni_t (k int, x int)")
+    tk.must_exec("create table cni_s (k int, y int)")
+    tk.must_exec("insert into cni_t values (1,10),(1,99),(1,null),"
+                 "(2,20),(2,99),(2,null),(3,7),(3,null)")
+    tk.must_exec("insert into cni_s values (1,10),(1,null),(2,20),"
+                 "(null,99)")
+    tk.must_query(
+        "select k, x from cni_t where x not in "
+        "(select y from cni_s where cni_s.k = cni_t.k) "
+        "order by k, x is null, x").check(
+        [(2, 99), (3, 7), (3, "<nil>")])
+    tk.must_exec("delete from cni_s")
+    tk.must_query(
+        "select count(*) from cni_t where x not in "
+        "(select y from cni_s where cni_s.k = cni_t.k)").check([(8,)])
+
+
 def test_pad_space_on_columns(tk):
     tk.must_exec("create table conf_p (s varchar(8))")
     tk.must_exec("insert into conf_p values ('x'), ('x  '), ('y')")

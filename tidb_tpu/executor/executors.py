@@ -1557,11 +1557,14 @@ class HashJoinExec(Executor):
         self.plan = plan
         self._out = None
 
-    def _keys_of(self, schema, chunk, exprs, shared_dicts):
+    def _keys_of(self, schema, chunk, exprs, shared_dicts,
+                 want_col_nulls=False):
         n = len(chunk)
         cols = bind_chunk(schema, chunk)
         ectx = EvalCtx(np, n, cols, host=True)
         keys = np.empty((n, len(exprs)), dtype=np.int64)
+        col_nulls = np.zeros((n, len(exprs)), dtype=bool) \
+            if want_col_nulls else None
         nulls = np.zeros(n, dtype=bool)
         for j, e in enumerate(exprs):
             d, nl, sd = eval_expr(ectx, e)
@@ -1586,7 +1589,11 @@ class HashJoinExec(Executor):
             elif e.ft.tclass == TypeClass.DECIMAL:
                 d = d.astype(np.int64)
             keys[:, j] = d.astype(np.int64)
+            if col_nulls is not None:
+                col_nulls[:, j] = nm
             nulls |= nm
+        if want_col_nulls:
+            return keys, nulls, col_nulls
         return keys, nulls
 
     def _align_key_fts(self):
@@ -1837,6 +1844,15 @@ class HashJoinExec(Executor):
                 return self._semi_result(probe, pi, jt)
             return self._emit(probe, pi, build, bi)
 
+        naaj = jt == "anti" and getattr(plan, "null_aware", False)
+        naaj_corr = getattr(plan, "naaj_corr", 0) if naaj else 0
+        if naaj_corr:
+            # dispatch BEFORE the generic key pass: the correlated
+            # null-aware path needs per-column null masks and its own
+            # set tests
+            return self._naaj_correlated(
+                plan, probe, build, build_exec, probe_exec,
+                build_keys_e, probe_keys_e, naaj_corr)
         shared = [None] * len(plan.eq_conds)
         bk, bnull = self._keys_of(build_exec.schema, build, build_keys_e,
                                   shared)
@@ -1850,7 +1866,6 @@ class HashJoinExec(Executor):
         else:
             bv, pv = self._combine_keys(bk, pk)
 
-        naaj = jt == "anti" and getattr(plan, "null_aware", False)
         if naaj and bnull.any():
             # inner side contains NULL: x NOT IN S is FALSE (match) or
             # NULL (no match) for every x -> empty result
@@ -1939,6 +1954,44 @@ class HashJoinExec(Executor):
                 inner = self._emit(probe, pi, build, bi)
                 return inner.concat(self._emit(probe, un, None, None))
         return self._emit(probe, pi, build, bi)
+
+    def _naaj_correlated(self, plan, probe, build, build_exec,
+                         probe_exec, build_keys_e, probe_keys_e, ncorr):
+        """Correlated null-aware anti join — `x NOT IN (SELECT y FROM s
+        WHERE s.k = t.k)` with full 3-valued semantics evaluated PER
+        correlation group (reference null-aware anti semi join,
+        pkg/planner/core): a probe row survives iff its group S_k is
+        empty, or x is non-NULL, matches nothing in S_k, and S_k has
+        no NULL y. eq_conds order the correlation keys first; the
+        value pair is last."""
+        shared = [None] * len(plan.eq_conds)
+        bk, _bn, bcn = self._keys_of(build_exec.schema, build,
+                                     build_keys_e, shared,
+                                     want_col_nulls=True)
+        pk, _pn, pcn = self._keys_of(probe_exec.schema, probe,
+                                     probe_keys_e, shared,
+                                     want_col_nulls=True)
+        bcorr_null = bcn[:, :ncorr].any(axis=1)
+        pcorr_null = pcn[:, :ncorr].any(axis=1)
+        bval_null = bcn[:, -1]
+        pval_null = pcn[:, -1]
+
+        def combine(mat):
+            return mat[:, 0] if mat.shape[1] == 1 else _void_view(mat)
+        bcorr = combine(bk[:, :ncorr])
+        pcorr = combine(pk[:, :ncorr])
+        valid_b = ~bcorr_null          # NULL corr keys join no group
+        group_exists = np.isin(pcorr, bcorr[valid_b]) & ~pcorr_null
+        group_has_null = np.isin(
+            pcorr, bcorr[valid_b & bval_null]) & ~pcorr_null
+        full_b = combine(bk)
+        full_p = combine(pk)
+        ok_b = valid_b & ~bval_null
+        matched = np.isin(full_p, full_b[ok_b]) & ~pcorr_null & \
+            ~pval_null
+        keep = (~group_exists) | (~pval_null & ~matched &
+                                  ~group_has_null)
+        return self._emit(probe, np.nonzero(keep)[0], None, None)
 
     def _semi_result(self, probe, pi, jt, exclude_null=None):
         matched = np.zeros(len(probe), dtype=bool)

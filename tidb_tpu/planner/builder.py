@@ -837,7 +837,20 @@ class PlanBuilder:
                     # NULL probes
                     join.null_aware = True
                     return join
-                # correlated NOT IN: NULL probe compares NULL -> excluded
+                if not others and not (_stmt_has_agg(c.subquery) or
+                                       c.subquery.group_by):
+                    # correlated NOT IN: full 3-valued semantics per
+                    # correlation group (executor _naaj_correlated) —
+                    # eq_conds keep correlation pairs first, value
+                    # last. Aggregate subqueries stay on the guard
+                    # path: the decorrelated Aggregation makes empty
+                    # groups unrepresentable (a scalar agg yields one
+                    # NULL/0 row), so "empty group" tests would lie.
+                    join.null_aware = True
+                    join.naaj_corr = len(join.eq_conds) - 1
+                    return join
+                # residual conditions / aggregates: conservative
+                # NULL-probe guard
                 guard = rw.mk_func("isnotnull", [outer_e2])
                 sel = Selection([guard], join)
                 sel.stats_rows = join.stats_rows

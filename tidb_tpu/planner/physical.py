@@ -744,6 +744,7 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         p = PhysHashJoin(plan.join_type, build, plan.eq_conds,
                          plan.other_conds, plan.schema, left, right)
         p.null_aware = getattr(plan, "null_aware", False)
+        p.naaj_corr = getattr(plan, "naaj_corr", 0)
         p.stats_rows = plan.stats_rows
         alt = _try_join_strategy(plan, left, right, p)
         if alt is not None:
