@@ -1721,6 +1721,76 @@ class TestWindowFrames:
             "and 2 following) from wr where g = 1 and k is not null "
             "order by k").check([(1, 20), (2, 40), (4, 40), (8, 80)])
 
+    def test_range_frames_interval_units(self, ftk):
+        """RANGE INTERVAL n unit frames over temporal ORDER keys
+        (reference range framer + types.Interval): fixed units add a
+        constant in key space; MONTH walks the civil calendar with
+        MySQL's day clamping (Mar 31 - 1 month = Feb 29)."""
+        ftk.must_exec("create table wri (d date, dt datetime, v int)")
+        ftk.must_exec("""insert into wri values
+            ('2024-01-01','2024-01-01 10:00:00',1),
+            ('2024-01-03','2024-01-01 11:30:00',2),
+            ('2024-01-05','2024-01-01 13:00:00',3),
+            ('2024-02-28','2024-01-02 10:00:00',4),
+            ('2024-03-31','2024-01-02 10:30:00',5)""")
+        ftk.must_query(
+            "select d, sum(v) over (order by d range between interval "
+            "2 day preceding and current row) from wri order by d")\
+            .check([("2024-01-01", "1"), ("2024-01-03", "3"),
+                    ("2024-01-05", "5"), ("2024-02-28", "4"),
+                    ("2024-03-31", "5")])
+        ftk.must_query(
+            "select dt, sum(v) over (order by dt range between interval "
+            "90 minute preceding and current row) from wri order by dt")\
+            .check([("2024-01-01 10:00:00", "1"),
+                    ("2024-01-01 11:30:00", "3"),
+                    ("2024-01-01 13:00:00", "5"),
+                    ("2024-01-02 10:00:00", "4"),
+                    ("2024-01-02 10:30:00", "9")])
+        # calendar month: 2024-03-31 - 1 month = 2024-02-29 > 02-28
+        ftk.must_query(
+            "select d, sum(v) over (order by d range between interval "
+            "1 month preceding and current row) from wri order by d")\
+            .check([("2024-01-01", "1"), ("2024-01-03", "3"),
+                    ("2024-01-05", "6"), ("2024-02-28", "4"),
+                    ("2024-03-31", "5")])
+        # DESC: preceding runs along the iteration direction
+        ftk.must_query(
+            "select d, sum(v) over (order by d desc range between "
+            "interval 2 day preceding and current row) from wri "
+            "order by d").check(
+            [("2024-01-01", "3"), ("2024-01-03", "5"),
+             ("2024-01-05", "3"), ("2024-02-28", "4"),
+             ("2024-03-31", "5")])
+        # following side + year unit
+        ftk.must_query(
+            "select d, count(*) over (order by d range between current "
+            "row and interval 1 year following) from wri order by d")\
+            .check([("2024-01-01", 5), ("2024-01-03", 4),
+                    ("2024-01-05", 3), ("2024-02-28", 2),
+                    ("2024-03-31", 1)])
+        # review regressions: non-temporal keys refuse, ROWS+INTERVAL
+        # refuses, fractional counts round (1.5 DAY = 2 DAY),
+        # compound literals refuse cleanly
+        e = ftk.exec_err(
+            "select sum(v) over (order by v range between interval "
+            "1 day preceding and current row) from wri")
+        assert "temporal" in str(e)
+        e = ftk.exec_err(
+            "select sum(v) over (order by d rows between interval "
+            "1 day preceding and current row) from wri")
+        assert "RANGE" in str(e)
+        ftk.must_query(
+            "select d, sum(v) over (order by d range between interval "
+            "1.5 day preceding and current row) from wri "
+            "where d < '2024-01-10' order by d").check(
+            [("2024-01-01", "1"), ("2024-01-03", "3"),
+             ("2024-01-05", "5")])
+        e = ftk.exec_err(
+            "select sum(v) over (order by d range between interval "
+            "'1 10' day_hour preceding and current row) from wri")
+        assert "INTERVAL literal" in str(e)
+
 
 class TestRecursiveCTE:
     def test_numbers(self, ftk):

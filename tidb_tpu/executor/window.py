@@ -22,6 +22,54 @@ from .executors import _sort_key_arrays
 
 _I64_MAX = np.iinfo(np.int64).max
 
+_UNIT_MICROS = {"microsecond": 1.0, "second": 1e6, "minute": 6e7,
+                "hour": 3.6e9, "day": 8.64e10, "week": 6.048e11}
+
+
+def _interval_shift(real, n, unit, ft):
+    """Shift temporal key values by n units (n may be negative).
+    Keys are DAYS for DATE columns, MICROS otherwise. Fixed-width
+    units add a constant; MONTH/QUARTER/YEAR walk the civil calendar
+    with MySQL's day-of-month clamping (Jan 31 + 1 month = Feb 29)."""
+    from ..types.time_types import MICROS_PER_DAY
+    from ..expression.vec import civil_from_days, days_from_civil
+    unit = unit.lower().rstrip("s")
+    is_date = ft.tclass == TypeClass.DATE
+    if unit not in ("second", "microsecond"):
+        # MySQL: only SECOND counts keep a decimal fraction; other
+        # units coerce decimal -> int with rounding (1.5 DAY = 2 DAY)
+        n = int(round(n))
+    if unit in _UNIT_MICROS:
+        if is_date:
+            days = _UNIT_MICROS[unit] * n / 8.64e10
+            if days != int(days):
+                raise UnsupportedError(
+                    "INTERVAL %s frames need a DATETIME ORDER key", unit)
+            return real + int(days)
+        return real + _UNIT_MICROS[unit] * n
+    if unit in ("month", "quarter", "year"):
+        # fractional counts round like MySQL's decimal->int coercion
+        months = int(round(n * {"month": 1, "quarter": 3,
+                                "year": 12}[unit]))
+        if is_date:
+            days, tod = real.astype(np.int64), None
+        else:
+            ri = real.astype(np.int64)
+            days = ri // MICROS_PER_DAY
+            tod = ri - days * MICROS_PER_DAY
+        y, m, dd = civil_from_days(np, days)
+        m0 = np.asarray(m) + months - 1
+        y2 = np.asarray(y) + m0 // 12
+        m2 = m0 % 12 + 1
+        first_this = days_from_civil(np, y2, m2, np.asarray(1))
+        ny = np.where(m2 == 12, y2 + 1, y2)
+        nm = np.where(m2 == 12, 1, m2 + 1)
+        dim = days_from_civil(np, ny, nm, np.asarray(1)) - first_this
+        days2 = first_this + np.minimum(np.asarray(dd), dim) - 1
+        out = days2 if is_date else days2 * MICROS_PER_DAY + tod
+        return out.astype(np.float64)
+    raise UnsupportedError("unsupported INTERVAL unit %s in frame", unit)
+
 
 class WindowExec(Executor):
     def __init__(self, ctx, plan, child):
@@ -229,12 +277,15 @@ class WindowExec(Executor):
         return lo, hi_excl
 
     def _range_bounds(self, d, part_start, part_end, n, ectx, order):
-        """RANGE frame with numeric offsets (reference
+        """RANGE frame with numeric OR INTERVAL offsets (reference
         pkg/executor/internal/vecgroupchecker + range framer semantics):
         frame = rows in the partition whose single ORDER BY key lies within
         [cur-prec, cur+fol] along the sort direction. NULL-key rows form
-        their own peer frame; numeric bounds never reach them. Per-partition
-        searchsorted over the (already sorted) key block."""
+        their own peer frame; bounds never reach them. Per-partition
+        searchsorted over the (already sorted) key block. INTERVAL
+        units shift temporal keys (days for DATE, micros otherwise);
+        MONTH/QUARTER/YEAR shift through civil-calendar arithmetic
+        with MySQL's day-of-month clamping."""
         _, n_prec, n_fol = d.frame
         if len(d.order_by) != 1:
             raise UnsupportedError(
@@ -245,6 +296,15 @@ class WindowExec(Executor):
         arr = np.asarray(data) if not np.isscalar(data) else np.full(n, data)
         if sd is not None or arr.dtype == object:
             raise UnsupportedError("RANGE frame ORDER BY key must be numeric")
+        has_ival = isinstance(n_prec, tuple) or isinstance(n_fol, tuple)
+        if has_ival and e.ft.tclass not in (
+                TypeClass.DATE, TypeClass.DATETIME,
+                TypeClass.TIMESTAMP):
+            # MySQL rejects INTERVAL frames over non-temporal keys;
+            # silently shifting an INT/DECIMAL key by "microseconds"
+            # would degrade to a running total
+            raise UnsupportedError(
+                "INTERVAL frame bounds require a temporal ORDER BY key")
         scale = 1
         if e.ft.tclass == TypeClass.DECIMAL:
             scale = int(_POW10[max(e.ft.decimal, 0)])
@@ -252,6 +312,24 @@ class WindowExec(Executor):
         sign = -1.0 if desc else 1.0
         k = (keys * sign)[order]
         knull = nm[order]
+
+        def target(seg, amount, forward):
+            """Bound values in SIGN space for each row of seg.
+            amount: int (numeric, key units) or ("ival", count, unit);
+            count is the magnitude in the named direction (preceding
+            for the low bound, following for the high), negative =
+            opposite direction."""
+            if not isinstance(amount, tuple):
+                delta = amount * scale * 1.0
+                return seg + (delta if forward else -delta)
+            _tag, cnt, unit = amount
+            # shift happens in REAL key space: iteration order is
+            # sign space, so preceding = real -sign*cnt units
+            step = cnt if forward else -cnt
+            real = seg * sign
+            shifted = _interval_shift(real, step if sign > 0 else -step,
+                                      unit, e.ft)
+            return shifted * sign
         lo = np.empty(n, dtype=np.int64)
         hi = np.empty(n, dtype=np.int64)
         starts = np.unique(part_start) if n else np.array([], dtype=np.int64)
@@ -271,17 +349,16 @@ class WindowExec(Executor):
                 vlo, vhi = s0, e0
             if vhi > vlo:
                 seg = k[vlo:vhi]
-                cur = seg
                 if n_prec is None:
                     lo[vlo:vhi] = s0      # unbounded: includes NULL block
                 else:
                     lo[vlo:vhi] = vlo + np.searchsorted(
-                        seg, cur - n_prec * scale * 1.0, side="left")
+                        seg, target(seg, n_prec, False), side="left")
                 if n_fol is None:
                     hi[vlo:vhi] = e0
                 else:
                     hi[vlo:vhi] = vlo + np.searchsorted(
-                        seg, cur + n_fol * scale * 1.0, side="right")
+                        seg, target(seg, n_fol, True), side="right")
         return lo, hi
 
     def _frame_eval(self, d, svals, sok, lo, hi_excl, n):

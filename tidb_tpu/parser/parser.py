@@ -2128,6 +2128,12 @@ class Parser:
                 if self.accept_kw("current"):
                     self.expect_kw("row")
                     return "current_row"
+                if self.accept_kw("interval"):
+                    # RANGE INTERVAL n unit PRECEDING (temporal keys)
+                    n = self.next().text
+                    iunit = self.ident().lower()
+                    which = self.next().text.lower()
+                    return f"i:{n}:{iunit}_{which}"
                 n = self.next().text
                 which = self.next().text.lower()
                 return f"{n}_{which}"

@@ -618,14 +618,32 @@ class PlanBuilder:
                 if s == "unbounded_following":
                     return None
                 n, which = s.rsplit("_", 1)
+                if n.startswith("i:"):
+                    # interval bound: (count, unit), sign via a
+                    # wrapper tuple ("ival", +/-count, unit)
+                    if f.unit == "rows":
+                        raise UnsupportedError(
+                            "INTERVAL bounds require a RANGE frame")
+                    _tag, cnt, iu = n.split(":")
+                    try:
+                        v = float(cnt)
+                    except ValueError:
+                        raise UnsupportedError(
+                            "unsupported INTERVAL literal '%s' in "
+                            "frame", cnt) from None
+                    v = int(v) if v == int(v) else v
+                    return ("ival", v if which == "preceding" else -v,
+                            iu)
                 v = int(n)
                 return v if which == "preceding" else -v
             start = bound(f.start, True)    # rows preceding (None=unbounded)
             endb = bound(f.end, False)
+
+            def neg(b):
+                return ("ival", -b[1], b[2]) if isinstance(b, tuple) \
+                    else -b
             n_prec = start
-            n_fol = (-endb) if endb is not None else None
-            if endb is not None and endb > 0:
-                n_fol = -endb               # "N preceding" as end
+            n_fol = neg(endb) if endb is not None else None
             return (f.unit, n_prec, n_fol)
 
         def window_mapper(node):
