@@ -1398,6 +1398,30 @@ class TestPartitionedTables:
             s1.execute("rollback")
             s2.execute("rollback")
 
+    def test_tablesample_and_rand(self, ftk):
+        """TABLESAMPLE BERNOULLI/SYSTEM (pct): deterministic
+        Knuth-hash Bernoulli over the row handle (reproducible runs,
+        pushes down as an int filter); RAND([seed]) uniform rows."""
+        ftk.must_exec("create table tsmp (id int primary key, v int)")
+        ftk.must_exec("insert into tsmp values " +
+                      ",".join(f"({i},{i})" for i in range(1, 2001)))
+        n25 = ftk.must_query("select count(*) from tsmp tablesample "
+                             "bernoulli (25)").rs.rows[0][0]
+        assert 350 <= n25 <= 650
+        ftk.must_query("select count(*) from tsmp tablesample "
+                       "system (0)").check([(0,)])
+        ftk.must_query("select count(*) from tsmp tablesample "
+                       "bernoulli (100)").check([(2000,)])
+        a = ftk.must_query("select sum(v) from tsmp tablesample "
+                           "bernoulli (25)").rs.rows
+        b = ftk.must_query("select sum(v) from tsmp tablesample "
+                           "bernoulli (25)").rs.rows
+        assert a == b                      # deterministic
+        r = ftk.must_query("select rand(), rand(5), rand(5)").rs.rows[0]
+        assert 0 <= r[0] < 1 and r[1] == r[2]
+        rows = ftk.must_query("select rand(7) from tsmp limit 5").rs.rows
+        assert len({x[0] for x in rows}) > 1   # varies per row
+
     def test_select_into_var(self, ftk):
         ftk.must_exec("create table siv (a int primary key, b int)")
         ftk.must_exec("insert into siv values (1,10),(2,20)")

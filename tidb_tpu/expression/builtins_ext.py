@@ -19,6 +19,7 @@ import zlib
 
 import numpy as np
 
+from .expr import Constant
 from .vec import (op, _rowwise, _apply_str_fn, eval_expr, _HOST_ONLY,
                   materialize_nulls)
 
@@ -339,6 +340,50 @@ def op_decode(ctx, expr):
         return bytes(c ^ key[i % len(key)]
                      for i, c in enumerate(raw)).decode("utf-8", "replace")
     return _rowwise(ctx, expr, f)
+
+
+_RAND_STATES: dict = {}
+
+
+def reset_rand_states():
+    """Statement boundary: RAND(N) restarts its sequence per
+    statement (MySQL), while continuing ACROSS chunks within one —
+    the session calls this before each statement."""
+    _RAND_STATES.clear()
+
+
+def _seed_int(v):
+    try:
+        return int(float(v)) & 0x7FFFFFFF
+    except (TypeError, ValueError):
+        return 0        # MySQL coerces bad seeds to 0 with a warning
+
+
+@hop("rand")
+def op_rand(ctx, expr):
+    """RAND([seed]): uniform [0,1) per row (reference
+    builtin_math.go randFunctionClass). A constant seed gives a
+    repeatable per-statement sequence; a column seed reseeds per row,
+    both like MySQL."""
+    if expr.args:
+        d, _nl, _sd = eval_expr(ctx, expr.args[0])
+        if not np.isscalar(d) and np.asarray(d).ndim and \
+                len(np.asarray(d)) == ctx.n and ctx.n > 1 and \
+                not isinstance(expr.args[0], Constant):
+            # per-row seeds (column argument)
+            return np.array(
+                [np.random.RandomState(_seed_int(s)).random_sample()
+                 for s in np.asarray(d)]), None, None
+        seed = _seed_int(d if np.isscalar(d)
+                         else np.asarray(d).reshape(-1)[0])
+        # keyed per CALL SITE: two RAND(5) in one statement each run
+        # their own sequence (MySQL); chunks of one statement continue
+        key = (seed, id(expr))
+        rng = _RAND_STATES.get(key)
+        if rng is None:
+            rng = _RAND_STATES[key] = np.random.RandomState(seed)
+        return rng.random_sample(ctx.n), None, None
+    return np.random.random(ctx.n), None, None
 
 
 # ---------------- uuid family (builtin_miscellaneous.go) ---------------

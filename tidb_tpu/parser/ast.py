@@ -211,6 +211,7 @@ class TableName(Node):
     index_hints: list = field(default_factory=list)
     as_of: ExprNode | None = None      # AS OF TIMESTAMP (stale read)
     partitions: list = field(default_factory=list)  # PARTITION (p, ..)
+    sample: float | None = None   # TABLESAMPLE BERNOULLI|SYSTEM (pct)
 
 
 @dataclass

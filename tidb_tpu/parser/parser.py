@@ -685,6 +685,19 @@ class Parser:
             while self.accept_op(","):
                 tn.partitions.append(self.ident())
             self.expect_op(")")
+        if self.at_kw("tablesample") and \
+                self.peek(1).kind == "IDENT" and \
+                self.peek(1).text.lower() in ("bernoulli", "system"):
+            # the method lookahead keeps `tablesample` usable as an
+            # alias, like the PARTITION clause above
+            self.next()
+            self.ident()
+            self.expect_op("(")
+            t = self.next()
+            if t.kind != "NUMBER":
+                self.error("expected a sampling percentage")
+            tn.sample = float(t.text)
+            self.expect_op(")")
         return tn
 
     # ---- DML ----------------------------------------------------------

@@ -216,6 +216,12 @@ class PlanBuilder:
     def build_datasource(self, tn: ast.TableName) -> DataSource:
         if tn.as_of is not None:
             self._resolve_as_of(tn)
+        if tn.sample is not None and (
+                (not tn.db and tn.name.lower() in self.ctes) or
+                (not tn.db and
+                 tn.name.lower() in self.pctx.temp_tables)):
+            raise UnsupportedError(
+                "TABLESAMPLE is only supported on base tables")
         if not tn.db and tn.name.lower() in self.ctes:
             entry = self.ctes[tn.name.lower()]
             if entry[0] == "temp":
@@ -231,6 +237,9 @@ class PlanBuilder:
         if self.pctx.check_read is not None:
             self.pctx.check_read(db, tbl.name)
         if tbl.view_select:
+            if tn.sample is not None:
+                raise UnsupportedError(
+                    "TABLESAMPLE is only supported on base tables")
             self._view_depth += 1
             if self._view_depth > 16:
                 raise UnsupportedError("view nesting too deep (cycle?)")
@@ -269,6 +278,29 @@ class PlanBuilder:
         ds.tbl_stats = self.pctx.table_stats(tbl.id)
         ds.bulk_only = self.pctx.table_bulk_rows(tbl.id) > 0
         ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
+        if tn.sample is not None:
+            # TABLESAMPLE pct: deterministic Knuth-hash Bernoulli over
+            # the row handle — reproducible, pushes down like any
+            # int filter (device-safe: wrap-around multiply + mod).
+            # SYSTEM (page-level in other engines) samples rows here.
+            from ..types.datum import Datum, Kind
+            frac = min(max(tn.sample, 0.0), 100.0) / 100.0
+
+            def ic(v):
+                return Constant(Datum(Kind.INT, v), new_bigint_type())
+            mul = ScalarFunc("*", [handle_col, ic(2654435761)],
+                             new_bigint_type())
+            # clear the sign bit: MySQL % keeps the dividend's sign,
+            # and the wrap-around product may be negative
+            pos = ScalarFunc("&", [mul, ic(0x7FFFFFFFFFFFFFFF)],
+                             new_bigint_type())
+            mod = ScalarFunc("%", [pos, ic(1_000_000)],
+                             new_bigint_type())
+            cond = ScalarFunc("<", [mod, ic(int(frac * 1_000_000))],
+                              new_bigint_type())
+            sel = Selection([cond], ds)
+            sel.stats_rows = max(ds.stats_rows * frac, 1.0)
+            return sel
         return ds
 
     def build_from(self, node) -> LogicalPlan:

@@ -137,6 +137,8 @@ class Session:
                       if t.startswith("__cte_final_")]:
             self.drop_temp_table(tname)
         self._cur_sql = sql if cacheable else ""
+        from ..expression.builtins_ext import reset_rand_states
+        reset_rand_states()     # RAND(N) restarts per statement
         rg = self.domain.resource_groups.groups.get(self.resource_group)
         if rg is not None:
             rg.admit()               # token-bucket admission control
