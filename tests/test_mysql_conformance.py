@@ -161,6 +161,40 @@ def test_correlated_not_in_three_valued(tk):
         "(select y from cni_s where cni_s.k = cni_t.k)").check([(8,)])
 
 
+def test_aes_block_encryption_modes(tk):
+    """block_encryption_mode drives AES_ENCRYPT/AES_DECRYPT
+    (reference builtin_encryption.go): ECB/CBC padded, OFB/CFB128
+    stream; IV-required modes return NULL without one."""
+    tk.must_query(
+        "select aes_decrypt(aes_encrypt('secret', 'k1'), 'k1')")\
+        .check([("secret",)])
+    tk.must_exec("set @@block_encryption_mode = 'aes-256-cbc'")
+    try:
+        tk.must_query(
+            "select aes_decrypt(aes_encrypt('hello', 'key', "
+            "'0123456789abcdef'), 'key', '0123456789abcdef')")\
+            .check([("hello",)])
+        tk.must_query("select aes_encrypt('x', 'k')").check(
+            [("<nil>",)])     # IV required
+        tk.must_exec("set @@block_encryption_mode = 'aes-128-ofb'")
+        tk.must_query(
+            "select aes_decrypt(aes_encrypt('stream', 'k', "
+            "'aaaaaaaaaaaaaaaa'), 'k', 'aaaaaaaaaaaaaaaa')")\
+            .check([("stream",)])
+        tk.must_exec("set @@block_encryption_mode = 'aes-256-cfb128'")
+        tk.must_query(
+            "select aes_decrypt(aes_encrypt('feedback', 'k', "
+            "'bbbbbbbbbbbbbbbb'), 'k', 'bbbbbbbbbbbbbbbb')")\
+            .check([("feedback",)])
+        # wrong key under a padded mode: NULL, never garbage
+        tk.must_exec("set @@block_encryption_mode = 'aes-128-ecb'")
+        tk.must_query(
+            "select aes_decrypt(aes_encrypt('secret', 'right'), "
+            "'wrong')").check([("<nil>",)])
+    finally:
+        tk.must_exec("set @@block_encryption_mode = 'aes-128-ecb'")
+
+
 def test_pad_space_on_columns(tk):
     tk.must_exec("create table conf_p (s varchar(8))")
     tk.must_exec("insert into conf_p values ('x'), ('x  '), ('y')")

@@ -203,45 +203,86 @@ def op_sm3(ctx, expr):
     return _rowwise(ctx, expr, f)
 
 
-def _aes_ecb(key: bytes, enc: bool, data: bytes):
-    """MySQL aes-128-ecb default via a pure-python AES (small, host tail).
-    cryptography isn't in the image; use the stdlib-only fallback."""
+def set_encryption_mode(mode: str):
+    """Statement hook: MySQL's block_encryption_mode sysvar selects
+    the AES variant for AES_ENCRYPT/AES_DECRYPT (thread-local: one
+    connection per thread)."""
+    _STMT_STATE.aes_mode = str(mode or "aes-128-ecb").lower()
+
+
+def _encryption_mode() -> str:
+    return getattr(_STMT_STATE, "aes_mode", "aes-128-ecb")
+
+
+def _aes_crypt(key: bytes, enc: bool, data: bytes, iv: bytes | None):
+    """AES per block_encryption_mode (reference builtin_encryption.go:
+    ECB/CBC padded, OFB/CFB128 stream; key XOR-folds to the key
+    length, MySQL style)."""
     try:
         from cryptography.hazmat.primitives.ciphers import (Cipher,
                                                             algorithms,
                                                             modes)
     except Exception:
         return None
-    k = bytearray(16)
+    try:
+        _a, bits, mname = _encryption_mode().split("-")
+        klen = int(bits) // 8
+    except ValueError:
+        return None
+    k = bytearray(klen)
     for i, b in enumerate(key):
-        k[i % 16] ^= b
-    c = Cipher(algorithms.AES(bytes(k)), modes.ECB())
+        k[i % klen] ^= b
+    padded = mname in ("ecb", "cbc")
+    if mname == "ecb":
+        m = modes.ECB()
+    else:
+        if iv is None or len(iv) < 16:
+            return None      # MySQL: these modes require a 16B+ IV
+        iv16 = iv[:16]
+        m = {"cbc": modes.CBC, "ofb": modes.OFB,
+             "cfb128": modes.CFB}.get(mname, lambda _: None)(iv16)
+        if m is None:
+            return None
+    c = Cipher(algorithms.AES(bytes(k)), m)
     if enc:
-        pad = 16 - len(data) % 16
-        data += bytes([pad]) * pad
+        if padded:
+            pad = 16 - len(data) % 16
+            data += bytes([pad]) * pad
         e = c.encryptor()
         return e.update(data) + e.finalize()
     d = c.decryptor()
     out = d.update(data) + d.finalize()
-    return out[:-out[-1]] if out else out
+    if padded:
+        # validate PKCS#7: a wrong key yields random padding — MySQL
+        # returns NULL, never empty/truncated garbage
+        if not out:
+            return out
+        pad = out[-1]
+        if not 1 <= pad <= 16 or pad > len(out) or \
+                out[-pad:] != bytes([pad]) * pad:
+            return None
+        return out[:-pad]
+    return out
 
 
 @hop("aes_encrypt")
 def op_aes_encrypt(ctx, expr):
-    def f(s, key):
-        r = _aes_ecb(str(key).encode(), True, str(s).encode())
+    def f(s, key, iv=None):
+        r = _aes_crypt(str(key).encode(), True, str(s).encode(),
+                       str(iv).encode() if iv is not None else None)
         return r.hex() if r is not None else None
     return _rowwise(ctx, expr, f)
 
 
 @hop("aes_decrypt")
 def op_aes_decrypt(ctx, expr):
-    def f(s, key):
+    def f(s, key, iv=None):
         try:
             raw = bytes.fromhex(str(s))
         except ValueError:
             return None
-        r = _aes_ecb(str(key).encode(), False, raw)
+        r = _aes_crypt(str(key).encode(), False, raw,
+                       str(iv).encode() if iv is not None else None)
         return r.decode("utf-8", "replace") if r is not None else None
     return _rowwise(ctx, expr, f)
 
@@ -342,14 +383,27 @@ def op_decode(ctx, expr):
     return _rowwise(ctx, expr, f)
 
 
-_RAND_STATES: dict = {}
+import threading as _threading
+
+# per-THREAD statement state: one connection = one thread, so
+# concurrent sessions never clobber each other's RAND sequences or
+# AES mode (cluster workers run their own sessions on their own
+# threads and set their own state)
+_STMT_STATE = _threading.local()
+
+
+def _rand_states() -> dict:
+    d = getattr(_STMT_STATE, "rand", None)
+    if d is None:
+        d = _STMT_STATE.rand = {}
+    return d
 
 
 def reset_rand_states():
     """Statement boundary: RAND(N) restarts its sequence per
     statement (MySQL), while continuing ACROSS chunks within one —
     the session calls this before each statement."""
-    _RAND_STATES.clear()
+    _rand_states().clear()
 
 
 def _seed_int(v):
@@ -379,9 +433,10 @@ def op_rand(ctx, expr):
         # keyed per CALL SITE: two RAND(5) in one statement each run
         # their own sequence (MySQL); chunks of one statement continue
         key = (seed, id(expr))
-        rng = _RAND_STATES.get(key)
+        states = _rand_states()
+        rng = states.get(key)
         if rng is None:
-            rng = _RAND_STATES[key] = np.random.RandomState(seed)
+            rng = states[key] = np.random.RandomState(seed)
         return rng.random_sample(ctx.n), None, None
     return np.random.random(ctx.n), None, None
 

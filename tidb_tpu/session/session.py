@@ -137,8 +137,10 @@ class Session:
                       if t.startswith("__cte_final_")]:
             self.drop_temp_table(tname)
         self._cur_sql = sql if cacheable else ""
-        from ..expression.builtins_ext import reset_rand_states
+        from ..expression.builtins_ext import (reset_rand_states,
+                                               set_encryption_mode)
         reset_rand_states()     # RAND(N) restarts per statement
+        set_encryption_mode(self.vars.get("block_encryption_mode"))
         rg = self.domain.resource_groups.groups.get(self.resource_group)
         if rg is not None:
             rg.admit()               # token-bucket admission control

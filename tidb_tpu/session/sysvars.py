@@ -105,6 +105,12 @@ for _v in [
            enum_vals=["optimistic", "pessimistic"]),
     # commit fast paths (reference vardef/tidb_vars.go:815
     # TiDBEnableAsyncCommit / TiDBEnable1PC + the async-commit caps)
+    SysVar("block_encryption_mode", SCOPE_BOTH, "aes-128-ecb", "enum",
+           enum_vals=["aes-128-ecb", "aes-192-ecb", "aes-256-ecb",
+                      "aes-128-cbc", "aes-192-cbc", "aes-256-cbc",
+                      "aes-128-ofb", "aes-192-ofb", "aes-256-ofb",
+                      "aes-128-cfb128", "aes-192-cfb128",
+                      "aes-256-cfb128"]),
     SysVar("tidb_enable_table_lock", SCOPE_BOTH, False, "bool"),
     SysVar("tidb_enable_async_commit", SCOPE_BOTH, True, "bool"),
     SysVar("tidb_enable_1pc", SCOPE_BOTH, True, "bool"),
