@@ -92,7 +92,98 @@ CASES = [
      ("b", 2)),
     ("select abs(-3.5), sign(-2), power(2, 10), mod(10, 3)",
      ("3.5", -1, 1024.0, 1)),
+    # ---- JSON (second sweep)
+    ("select json_type('[1,2]'), json_type('{\"a\":1}'), "
+     "json_type('3')", ("ARRAY", "OBJECT", "INTEGER")),
+    ("select json_length('[1,2,3]'), json_valid('nope')", (3, 0)),
+    ("select json_array(1, 'a', null), json_object('k', null)",
+     ('[1, "a", null]', '{"k": null}')),
+    ("select json_set('{\"a\":1}', '$.b', 2), "
+     "json_remove('{\"a\":1,\"b\":2}', '$.a')",
+     ('{"a": 1, "b": 2}', '{"b": 2}')),
+    ("select json_merge_patch('{\"a\":1}', '{\"a\":null,\"b\":2}')",
+     '{"b": 2}'),
+    ("select '{\"a\": 5}' -> '$.a', '{\"a\": \"x\"}' ->> '$.a'",
+     ("5", "x")),
+    # ---- temporal (second sweep)
+    ("select dayofyear('2024-12-31'), quarter('2024-07-30')",
+     (366, 3)),
+    ("select time_to_sec('01:30:30'), sec_to_time(5430)",
+     (5430, "01:30:30")),
+    ("select addtime('2024-01-01 10:00:00', '01:30:00')",
+     "2024-01-01 11:30:00"),
+    ("select period_add(202401, 2), period_diff(202403, 202401)",
+     (202403, 2)),
+    ("select to_days('2024-01-01'), from_days(739251)",
+     (739251, "2024-01-01")),
+    ("select makedate(2024, 60), maketime(10, 30, 5)",
+     ("2024-02-29", "10:30:05")),
+    ("select convert_tz('2024-01-01 12:00:00', '+00:00', '+05:30')",
+     "2024-01-01 17:30:00"),
+    # ---- numeric (second sweep)
+    ("select round(1234.5678, -2), format(1234567.891, 0)",
+     ("1200", "1,234,568")),
+    ("select ln(exp(2)), log2(8), log10(1000)", (2.0, 3.0, 3.0)),
+    ("select degrees(pi()), crc32('MySQL')", (180.0, 3259397556)),
+    ("select oct(12), unhex('4D7953514C')", ("14", "MySQL")),
+    # ---- string (second sweep)
+    ("select quote(null), quote('ab''c')", ("NULL", "'ab\\'c'")),
+    ("select concat(1, 2.5, 'x')", "12.5x"),
+    ("select trim(both 'x' from 'xxaxx'), "
+     "trim(leading 'x' from 'xxa')", ("a", "a")),
+    ("select replace('www.mysql.com', 'w', 'W')", "WWW.mysql.com"),
+    ("select substring_index('a.b.c', '.', 0), "
+     "substring_index('abc', 'z', 2)", ("", "abc")),
+    ("select bit_length('abc'), octet_length('abc')", (24, 3)),
+    ("select position('b' in 'abc'), left('abc', -1)", (2, "")),
+    ("select make_set(5, 'a', 'b', 'c')", "a,c"),
+    # ---- aggregates (second sweep)
+    ("select bit_and(v), bit_or(v), bit_xor(v) from "
+     "(select 12 v union all select 10) t", (8, 14, 6)),
+    ("select group_concat(v order by v desc separator '|') from "
+     "(select 1 v union all select 3 union all select 2) t", "3|2|1"),
+    ("select std(v), variance(v) from "
+     "(select 2 v union all select 4) t", (1.0, 1.0)),
+    ("select json_arrayagg(v) from "
+     "(select 1 v union all select 2) t", "[1, 2]"),
 ]
+
+
+def test_concat_renders_typed_values(tk):
+    """CONCAT over numeric/temporal COLUMNS renders MySQL string
+    forms — decimal scale and date text, never raw storage ints
+    (review probe: scaled ints leaked)."""
+    tk.must_exec("create table conf_c (d decimal(5,2), dt date)")
+    tk.must_exec("insert into conf_c values (3.50, '2024-05-01')")
+    tk.must_query("select concat('v=', d), concat('on ', dt) "
+                  "from conf_c").check(
+        [("v=3.50", "on 2024-05-01")])
+
+
+def test_typed_rendering_in_string_and_json_contexts(tk):
+    """Review regressions: unsigned renders full-domain in CONCAT;
+    decimals/dates reach QUOTE/JSON/CONCAT_WS as values, never raw
+    storage ints; JSON operators accept numeric operands."""
+    tk.must_exec("create table conf_tr (b bigint unsigned, "
+                 "d decimal(5,2), dt date)")
+    tk.must_exec("insert into conf_tr values "
+                 "(18446744073709551615, 1.25, '2024-05-01')")
+    tk.must_query("select concat('x', b) from conf_tr").check(
+        [("x18446744073709551615",)])
+    tk.must_query("select json_array(d), quote(d), "
+                  "concat_ws(',', d, dt) from conf_tr").check(
+        [("[1.25]", "'1.25'", "1.25,2024-05-01")])
+    tk.must_query("select json_object('k', d) from conf_tr").check(
+        [('{"k": 1.25}',)])
+    tk.must_query("select (-1) -> '$'").check([("-1",)])
+
+
+def test_json_arrow_on_columns(tk):
+    tk.must_exec("create table conf_j (doc varchar(64))")
+    tk.must_exec('insert into conf_j values '
+                 '(\'{"a": {"b": 7}}\')')
+    tk.must_query("select doc -> '$.a.b', doc ->> '$.a.b' "
+                  "from conf_j").check([("7", "7")])
 
 
 @pytest.mark.parametrize("i", range(len(CASES)))

@@ -39,7 +39,9 @@ def hop(*names):
 def op_concat_ws(ctx, expr):
     # NULL separator -> NULL; NULL args are skipped (MySQL semantics),
     # so evaluate manually rather than via _rowwise's null propagation
-    vals = [eval_expr(ctx, a) for a in expr.args]
+    from .vec import _to_str_val
+    vals = [_to_str_val(ctx, eval_expr(ctx, a), a.ft)
+            for a in expr.args]
     mats, nulls = [], []
     for (d, nl, sd), a in zip(vals, expr.args):
         if sd is not None:

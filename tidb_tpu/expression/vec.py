@@ -507,7 +507,8 @@ def op_mod(ctx, expr):
 
 @op("unary-")
 def op_neg(ctx, expr):
-    a, an, _ = eval_expr(ctx, expr.args[0])
+    a, an, _ = _numify(ctx, eval_expr(ctx, expr.args[0]),
+                       expr.args[0].ft)
     return -a, an, None
 
 
@@ -1094,9 +1095,84 @@ def op_char_length(ctx, expr):
                          out_is_string=False)
 
 
+def _to_str_val(ctx, val, ft):
+    """Numeric/temporal operand in STRING context -> its MySQL string
+    form (decimal scale, date/time rendering — never raw storage
+    ints). String scalars and dict columns pass through."""
+    d, nl, sd = val
+    if sd is not None or isinstance(d, str):
+        return val
+    from ..types.decimal import scaled_int_to_str
+    from ..types.time_types import days_to_str, micros_to_str
+
+    def fmt(x):
+        if x is None:
+            return ""
+        tc = ft.tclass
+        if tc == TypeClass.DECIMAL:
+            return scaled_int_to_str(int(x), max(ft.decimal, 0))
+        if tc == TypeClass.DATE:
+            return days_to_str(int(x))
+        if tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            return micros_to_str(int(x), max(ft.decimal, 0))
+        if tc == TypeClass.FLOAT or isinstance(x, (float, np.floating)):
+            f = float(x)
+            return str(int(f)) if f == int(f) and abs(f) < 1e15 \
+                else repr(f)
+        if tc == TypeClass.UINT or (tc == TypeClass.INT and
+                                    ft.unsigned):
+            # unsigned storage is int64 bit patterns
+            return str(int(x) & 0xFFFFFFFFFFFFFFFF)
+        return str(int(x))
+    if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+        return fmt(d), nl, None
+    arr = np.asarray(d)
+    if arr.dtype == object:
+        return val
+    out = np.array([fmt(x) for x in arr], dtype=object)
+    return out, nl, None
+
+
+def _typed_py_val(ctx, val, ft):
+    """Storage values -> MySQL-typed python values (JSON contexts):
+    decimals become numbers, temporals become their strings, unsigned
+    reinterprets; strings/dicts pass through."""
+    d, nl, sd = val
+    if sd is not None or isinstance(d, str):
+        return val
+    tc = ft.tclass
+
+    def conv(x):
+        if x is None:
+            return None
+        if tc == TypeClass.DECIMAL:
+            return float(int(x)) / float(_POW10[max(ft.decimal, 0)])
+        if tc in (TypeClass.DATE, TypeClass.DATETIME,
+                  TypeClass.TIMESTAMP):
+            from ..types.decimal import scaled_int_to_str  # noqa: F401
+            from ..types.time_types import (days_to_str,
+                                            micros_to_str)
+            return days_to_str(int(x)) if tc == TypeClass.DATE \
+                else micros_to_str(int(x), max(ft.decimal, 0))
+        if tc == TypeClass.UINT or (tc == TypeClass.INT and
+                                    ft.unsigned):
+            return int(x) & 0xFFFFFFFFFFFFFFFF
+        return x
+    if tc not in (TypeClass.DECIMAL, TypeClass.DATE,
+                  TypeClass.DATETIME, TypeClass.TIMESTAMP,
+                  TypeClass.UINT) and not (tc == TypeClass.INT and
+                                           ft.unsigned):
+        return val
+    if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+        return conv(d), nl, None
+    out = np.array([conv(x) for x in np.asarray(d)], dtype=object)
+    return out, nl, None
+
+
 @op("concat")
 def op_concat(ctx, expr):
-    vals = [eval_expr(ctx, a) for a in expr.args]
+    vals = [_to_str_val(ctx, eval_expr(ctx, a), a.ft)
+            for a in expr.args]
     # a constant-NULL argument nullifies every row (MySQL semantics)
     if any(v[1] is True for v in vals):
         return "", True, None
@@ -2079,9 +2155,11 @@ def op_json_extract(ctx, expr):
         raise UnknownFunctionError("non-constant JSON path unsupported")
 
     def f(s):
-        v = _json_path_get(s, path)
+        v = _json_path_get(str(s), path)   # numbers are JSON scalars
         return "" if v is None else _json.dumps(v)
-    data, nulls, sd = _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+    val = _to_str_val(ctx, eval_expr(ctx, expr.args[0]),
+                      expr.args[0].ft)
+    data, nulls, sd = _apply_str_fn(ctx, val, f)
     return data, nulls, sd
 
 
@@ -2325,11 +2403,25 @@ def _rows_as_str(ctx, val):
     return np.asarray(data), nulls
 
 
-def _rowwise(ctx, expr, fn, dtype=object):
+def _rowwise(ctx, expr, fn, dtype=object, null_ok=False,
+             str_args=False, typed_args=False):
     """Evaluate all args, apply python fn per row on host (tail funcs that
-    mix strings and numbers; device offload not worth a kernel)."""
+    mix strings and numbers; device offload not worth a kernel).
+    null_ok: NULL args reach fn as None instead of nulling the row
+    (JSON constructors, QUOTE); the row is NULL only if fn returns
+    None. str_args: numeric/temporal args arrive as their MySQL
+    string forms (never raw storage ints); typed_args: decimals ->
+    floats, temporals -> strings, unsigned reinterpreted (JSON
+    value semantics)."""
     vals = [eval_expr(ctx, a) for a in expr.args]
+    if str_args:
+        vals = [_to_str_val(ctx, v, a.ft)
+                for v, a in zip(vals, expr.args)]
+    elif typed_args:
+        vals = [_typed_py_val(ctx, v, a.ft)
+                for v, a in zip(vals, expr.args)]
     mats = []
+    arg_nulls = []
     nmask = np.zeros(ctx.n, dtype=bool)
     for (d, nl, sd), a in zip(vals, expr.args):
         if sd is not None:
@@ -2338,16 +2430,22 @@ def _rowwise(ctx, expr, fn, dtype=object):
             mats.append(np.full(ctx.n, d, dtype=object))
         else:
             mats.append(np.asarray(d))
-        nmask |= np.asarray(materialize_nulls(ctx, nl))
+        anm = np.asarray(materialize_nulls(ctx, nl))
+        arg_nulls.append(anm)
+        nmask |= anm
     out = np.empty(ctx.n, dtype=dtype)
     bad = np.zeros(ctx.n, dtype=bool)
     fill = "" if dtype == object else 0
     for i in range(ctx.n):
-        if nmask[i]:
+        if nmask[i] and not null_ok:
             out[i] = fill
             continue
         try:
-            r = fn(*(m[i] for m in mats))
+            if null_ok:
+                r = fn(*(None if arg_nulls[j][i] else mats[j][i]
+                         for j in range(len(mats))))
+            else:
+                r = fn(*(m[i] for m in mats))
         except Exception:               # noqa: BLE001
             r = None
         if r is None:
@@ -2355,7 +2453,8 @@ def _rowwise(ctx, expr, fn, dtype=object):
             out[i] = fill
         else:
             out[i] = r
-    return out, nmask | bad, None
+    nulls = bad if null_ok else (nmask | bad)
+    return out, nulls, None
 
 
 @op("find_in_set")
@@ -2393,11 +2492,22 @@ def op_insert_str(ctx, expr):
 
 @op("quote")
 def op_quote(ctx, expr):
-    def f(s):
+    def q(s):
         s = str(s).replace("\\", "\\\\").replace("'", "\\'") \
             .replace("\0", "\\0").replace("\x1a", "\\Z")
         return "'" + s + "'"
-    return _apply_str_fn(ctx, eval_expr(ctx, expr.args[0]), f)
+    val = _to_str_val(ctx, eval_expr(ctx, expr.args[0]),
+                      expr.args[0].ft)
+    nl = val[1]
+    has_null = nl is True or (
+        nl is not None and nl is not False and
+        bool(np.asarray(materialize_nulls(ctx, nl)).any()))
+    if not has_null:
+        # fast path: dict columns transform O(distinct), not O(rows)
+        return _apply_str_fn(ctx, val, q)
+    return _rowwise(ctx, expr,
+                    lambda s: "NULL" if s is None else q(s),
+                    null_ok=True, str_args=True)
 
 
 @op("soundex")
@@ -2992,8 +3102,10 @@ def op_json_array(ctx, expr):
     import json as _json
 
     def f(*items):
-        return _json.dumps([_maybe_num(x) for x in items])
-    return _rowwise(ctx, expr, f)
+        # SQL NULL embeds as JSON null (MySQL)
+        return _json.dumps([_maybe_num(x) if x is not None else None
+                            for x in items])
+    return _rowwise(ctx, expr, f, null_ok=True, typed_args=True)
 
 
 @op("json_object")
@@ -3001,9 +3113,13 @@ def op_json_object(ctx, expr):
     import json as _json
 
     def f(*items):
-        return _json.dumps({str(items[i]): _maybe_num(items[i + 1])
+        if any(items[i] is None for i in range(0, len(items) - 1, 2)):
+            return None          # NULL key: error in MySQL -> NULL row
+        return _json.dumps({str(items[i]):
+                            (_maybe_num(items[i + 1])
+                             if items[i + 1] is not None else None)
                             for i in range(0, len(items) - 1, 2)})
-    return _rowwise(ctx, expr, f)
+    return _rowwise(ctx, expr, f, null_ok=True, typed_args=True)
 
 
 def _maybe_num(x):

@@ -9,7 +9,8 @@ from __future__ import annotations
 from ..errors import ParseError
 
 _OPERATORS = [
-    "<=>", "<<", ">>", "<>", "!=", ">=", "<=", ":=", "||", "&&",
+    "<=>", "->>", "->", "<<", ">>", "<>", "!=", ">=", "<=", ":=",
+    "||", "&&",
     "(", ")", ",", ";", "+", "-", "*", "/", "%", "=", ">", "<",
     ".", "|", "&", "^", "~", "!", "?", "@",
 ]

@@ -1989,10 +1989,22 @@ class Parser:
         return self.parse_pow()
 
     def parse_pow(self):
-        left = self.parse_primary()
+        left = self._parse_json_arrow(self.parse_primary())
         while self.at_op("^"):
             self.next()
-            left = ast.BinaryOp("^", left, self.parse_primary())
+            left = ast.BinaryOp(
+                "^", left, self._parse_json_arrow(self.parse_primary()))
+        return left
+
+    def _parse_json_arrow(self, left):
+        """expr -> '$.path' = JSON_EXTRACT; ->> also unquotes
+        (MySQL column-path operators)."""
+        while self.at_op("->", "->>"):
+            op_txt = self.next().text
+            path = self.parse_primary()
+            left = ast.FuncCall(name="json_extract", args=[left, path])
+            if op_txt == "->>":
+                left = ast.FuncCall(name="json_unquote", args=[left])
         return left
 
     def parse_column_ref(self) -> ast.ColumnRef:
