@@ -711,6 +711,43 @@ class TestDDL:
             ftk.must_exec("unlock tables")
             ftk.must_exec("set @@tidb_enable_table_lock = 0")
 
+    def test_show_breadth(self, ftk):
+        """SHOW statement long tail (reference pkg/executor/show.go):
+        stats/analyze/placement/config/next_row_id carry real data;
+        MySQL-compat replication/trigger/event forms return empty sets
+        with the right headers."""
+        ftk.must_exec("create table shb (a int primary key)")
+        ftk.must_exec("insert into shb values (1), (2), (3)")
+        ftk.must_exec("analyze table shb")
+        r = ftk.must_query("show stats_meta")
+        assert any(row[1] == "shb" and str(row[5]) == "3"
+                   for row in r.rows)
+        r = ftk.must_query("show stats_histograms")
+        assert any(row[1] == "shb" and row[2] == "a" for row in r.rows)
+        r = ftk.must_query("show analyze status")
+        assert any(row[1] == "shb" and row[5] == "finished"
+                   for row in r.rows)
+        r = ftk.must_query("show table shb next_row_id")
+        assert r.rows[0][1] == "shb" and int(r.rows[0][3]) >= 4
+        assert len(ftk.must_query("show privileges").rs.rows) > 5
+        assert len(ftk.must_query("show config").rs.rows) >= 2
+        for s in ("show master status", "show slave status",
+                  "show open tables", "show triggers", "show events",
+                  "show function status", "show procedure status",
+                  "show placement labels"):
+            ftk.must_query(s)          # parse + empty-compat result
+        # review regressions: LIKE filters apply; slave headers are
+        # slave-shaped; deleted max handles are not reissued
+        assert ftk.must_query("show stats_meta like 'zzz%'").rs.rows \
+            == []
+        assert len(ftk.must_query("show privileges like 'Sel%'")
+                   .rs.rows) == 1
+        assert "Seconds_Behind_Master" in \
+            ftk.must_query("show slave status").rs.names
+        ftk.must_exec("delete from shb where a = 3")
+        r = ftk.must_query("show table shb next_row_id")
+        assert int(r.rows[0][3]) >= 4
+
     def test_maintain_statements(self, ftk):
         """CHECK/OPTIMIZE/REPAIR TABLE return MySQL-style maintenance
         rows; CHECK runs the index<->row consistency pass."""

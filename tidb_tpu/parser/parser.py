@@ -1711,8 +1711,11 @@ class Parser:
                 self.peek(1).kind == "IDENT" and
                 self.peek(1).text.lower() == "status") and self.next():
             stmt.table = self.parse_table_name()
-            self.expect_kw("regions")
-            stmt.kind = "table_regions"
+            if self.accept_kw("next_row_id"):
+                stmt.kind = "table_next_row_id"
+            else:
+                self.expect_kw("regions")
+                stmt.kind = "table_regions"
         elif self.accept_kw("table") and self.accept_kw("status"):
             stmt.kind = "table_status"
             if self.accept_kw("from") or self.accept_kw("in"):
@@ -1770,6 +1773,36 @@ class Parser:
             stmt.kind = "collation"
         elif self.accept_kw("profiles"):
             stmt.kind = "profiles"
+        elif self.accept_kw("master"):
+            self.expect_kw("status")
+            stmt.kind = "master_status"
+        elif self.accept_kw("slave") or self.accept_kw("replica"):
+            self.expect_kw("status")
+            stmt.kind = "slave_status"
+        elif self.accept_kw("open"):
+            self.expect_kw("tables")
+            stmt.kind = "open_tables"
+        elif self.accept_kw("triggers"):
+            stmt.kind = "triggers"
+        elif self.accept_kw("events"):
+            stmt.kind = "events"
+        elif self.accept_kw("function") or self.accept_kw("procedure"):
+            self.expect_kw("status")
+            stmt.kind = "routine_status"
+        elif self.accept_kw("privileges"):
+            stmt.kind = "privileges"
+        elif self.accept_kw("stats_meta"):
+            stmt.kind = "stats_meta"
+        elif self.accept_kw("stats_histograms"):
+            stmt.kind = "stats_histograms"
+        elif self.accept_kw("analyze"):
+            self.expect_kw("status")
+            stmt.kind = "analyze_status"
+        elif self.accept_kw("config"):
+            stmt.kind = "config"
+        elif self.accept_kw("placement"):
+            stmt.kind = "placement_labels" \
+                if self.accept_kw("labels") else "placement"
         else:
             self.error("unsupported SHOW")
         if self.accept_kw("like"):

@@ -226,6 +226,110 @@ def exec_show(sess, stmt):
                          "Query" if busy else "Sleep", 0, "", None))
         return _str_chunk(["Id", "User", "Host", "db", "Command", "Time",
                            "State", "Info"], rows)
+    if kind == "master_status":
+        # single-process store: no replication channel (empty set,
+        # MySQL-compat headers so drivers don't choke)
+        return _str_chunk(["File", "Position", "Binlog_Do_DB",
+                           "Binlog_Ignore_DB", "Executed_Gtid_Set"], [])
+    if kind == "slave_status":
+        return _str_chunk(["Slave_IO_State", "Master_Host",
+                           "Master_User", "Slave_IO_Running",
+                           "Slave_SQL_Running",
+                           "Seconds_Behind_Master"], [])
+    if kind == "open_tables":
+        return _str_chunk(["Database", "Table", "In_use",
+                           "Name_locked"], [])
+    if kind == "triggers":
+        return _str_chunk(["Trigger", "Event", "Table", "Statement",
+                           "Timing", "Created"], [])
+    if kind == "events":
+        return _str_chunk(["Db", "Name", "Definer", "Time zone",
+                           "Type", "Status"], [])
+    if kind == "routine_status":
+        return _str_chunk(["Db", "Name", "Type", "Definer",
+                           "Modified", "Created"], [])
+    if kind == "privileges":
+        from ..privilege.privileges import ALL_PRIVS
+        rows = sorted((p.capitalize(), "Databases,Tables", "")
+                      for p in ALL_PRIVS)
+        return _str_chunk(["Privilege", "Context", "Comment"],
+                          _like_filter(rows, stmt.like))
+    if kind in ("stats_meta", "stats_histograms", "analyze_status"):
+        rows = []
+        for db in ischema.all_schemas():
+            if db.name in ("information_schema",):
+                continue
+            for t in ischema.tables_in_schema(db.name):
+                st = sess.domain.stats.get(t.id)
+                if st is None:
+                    continue
+                if kind == "stats_meta":
+                    rows.append((db.name, t.name, "", st.version, 0,
+                                 st.row_count))
+                elif kind == "analyze_status":
+                    rows.append((db.name, t.name, "",
+                                 "analyze table all columns",
+                                 st.row_count, "finished"))
+                else:
+                    for cname, cs in sorted(st.columns.items()):
+                        rows.append((db.name, t.name, cname,
+                                     cs.ndv, cs.null_count))
+        rows = _like_filter(rows, stmt.like, col=1)   # by table name
+        if kind == "stats_meta":
+            return _str_chunk(["Db_name", "Table_name",
+                               "Partition_name", "Version",
+                               "Modify_count", "Row_count"], rows)
+        if kind == "analyze_status":
+            return _str_chunk(["Table_schema", "Table_name",
+                               "Partition_name", "Job_info",
+                               "Processed_rows", "State"], rows)
+        return _str_chunk(["Db_name", "Table_name", "Column_name",
+                           "Distinct_count", "Null_count"], rows)
+    if kind == "config":
+        rows = [("tidb", "localhost", "store.data-dir",
+                 str(getattr(sess.domain, "data_dir", "") or
+                     "<in-memory>")),
+                ("tidb", "localhost", "enable-table-lock",
+                 str(bool(sess.vars.get("tidb_enable_table_lock")))
+                 .lower())]
+        return _str_chunk(["Type", "Instance", "Name", "Value"],
+                          _like_filter(rows, stmt.like, col=2))
+    if kind == "placement_labels":
+        return _str_chunk(["Key", "Values"], [])
+    if kind == "placement":
+        rows = []
+        if ischema.has_table("mysql", "placement_policies"):
+            pt = ischema.table_by_name("mysql", "placement_policies")
+            ctab = sess.domain.columnar.tables.get(pt.id)
+            if ctab is not None:
+                valid = ctab.valid_at()
+                cols = pt.columns
+                for i in np.nonzero(valid)[0].tolist():
+                    name = ctab.column_for(cols[0]).get_datum(i).to_py()
+                    setting = ctab.column_for(
+                        cols[1]).get_datum(i).to_py()
+                    rows.append((f"POLICY {name}", str(setting),
+                                 "SCHEDULED"))
+        return _str_chunk(["Target", "Placement",
+                           "Scheduling_State"],
+                          _like_filter(rows, stmt.like))
+    if kind == "table_next_row_id":
+        tn = stmt.table
+        db = tn.db or sess.vars.current_db
+        tbl = ischema.table_by_name(db, tn.name)
+        alloc = sess.domain.allocator(tbl)
+        nxt = alloc._next
+        ctab = sess.domain.columnar.tables.get(tbl.id)
+        if ctab is not None and ctab.n:
+            # ALL version rows, incl. deleted-not-yet-GC'd: a deleted
+            # max handle was still allocated and must not be reissued
+            hmax = int(np.asarray(ctab.handles[:ctab.n]).max())
+            nxt = max(nxt, hmax + 1)
+        rows = [(db, tbl.name, tbl.pk_col_name or "_tidb_rowid",
+                 nxt, "_TIDB_ROWID" if not tbl.pk_col_name
+                 else "AUTO_INCREMENT")]
+        return _str_chunk(["DB_NAME", "TABLE_NAME", "COLUMN_NAME",
+                           "NEXT_GLOBAL_ROW_ID", "ID_TYPE"], rows)
     from ..errors import UnsupportedError
     raise UnsupportedError("SHOW %s not supported", kind)
 
