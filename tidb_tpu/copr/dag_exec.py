@@ -1290,7 +1290,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
     aggregates its row shard into the dense table; one psum merges —
     the MPP hash exchange as an allreduce (tidb_tpu/mpp/exec.py design)."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     sdicts = {k: c[2] for k, c in sample_cols.items()}
     group_items = list(dag.group_items)
@@ -1336,7 +1336,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
                               "states": [[P() for _ in range(
                                   2 if a.name != "count" else 1)]
                                   for a in aggs]},
-                   check_rep=False)
+                   check_vma=False)
     return jax.jit(fn)
 
 

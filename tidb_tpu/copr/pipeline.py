@@ -14,6 +14,8 @@ bottleneck: Q3/Q5 lost all join output to host numpy between operators).
 """
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from ..utils import jaxcfg  # noqa: F401
@@ -46,6 +48,14 @@ def _cid_of(dag, sc):
     return -1 if ci is None else ci.id
 
 
+def _set_reason(copr, msg):
+    """Record why the fused path declined, for EXPLAIN ANALYZE and
+    scripts/diag_routing.py (reference: pkg/util/execdetails)."""
+    dom = getattr(copr, "domain", None)
+    if dom is not None:
+        dom.last_fused_reason = msg
+
+
 _DIRECT_SPAN_BUDGET = 1 << 24
 
 
@@ -68,11 +78,19 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
     arrays, valid = tbl.snapshot(col_ids, read_ts)
     n = len(valid)
     key_cids = [_cid_of(dim.dag, sc) for sc, _ in dim.all_keys()]
-    if any(cid == -1 for cid in key_cids) or n == 0:
+    if any(cid == -1 for cid in key_cids):
+        _set_reason(copr, f"dim {dim.dag.table_info.name}: join key is "
+                    "not a stored column")
+        return None
+    if n == 0:
+        _set_reason(copr, f"dim {dim.dag.table_info.name}: no visible "
+                    "rows at this snapshot")
         return None
     for cid in key_cids:
         kdata, _kn, ksdict = arrays[cid]
         if ksdict is not None or kdata.dtype.kind == "f":
+            _set_reason(copr, f"dim {dim.dag.table_info.name}: join key "
+                        "is not int64-comparable (string/float)")
             return None                  # int64-comparable keys only
     host_cache = copr._host_cache
     if dim.join_type in ("semi", "anti") and not dim.extra_keys:
@@ -117,6 +135,8 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
         host_cache[hkey] = meta
     mode, payload, lo, unique, n_sorted, pack = meta
     if mode is None or not unique:
+        _set_reason(copr, f"dim {dim.dag.table_info.name}: build keys "
+                    "are duplicated or NULL (non-unique build side)")
         return None
     out = {"arrays": arrays, "valid": valid, "n": n, "tbl": tbl,
            "mode": mode, "lo": lo, "n_sorted": n_sorted, "pack": pack}
@@ -124,6 +144,120 @@ def _dim_sort_meta(copr, dim, tbl, read_ts):
         out["lut"] = payload
     else:
         out["order"], out["skeys"] = payload
+    return out
+
+
+_VOLATILE_RE = re.compile(
+    r"rand\(|now\(|current_|sysdate\(|uuid|connection_id\(|sleep\(|"
+    r"last_insert_id\(|benchmark\(|@", re.IGNORECASE)
+
+
+# node types whose semantic content is FULLY captured by explain_info
+# plus the per-type extras appended in _plan_fp below. Any other node
+# kind refuses fingerprinting (-> no caching) rather than risk two
+# different subplans aliasing one cache entry.
+_FP_SAFE_NODES = frozenset([
+    "PhysTableReader", "PhysFusedPipeline", "PhysHashAgg",
+    "PhysHashJoin", "PhysMergeJoin", "PhysSelection", "PhysProjection",
+    "PhysShell", "PhysSort", "PhysTopN", "PhysLimit", "PhysUnion",
+    "PhysDual", "PhysIndexRange", "PhysIndexMerge", "PhysPointGet",
+    "PhysBatchPointGet", "PhysIndexLookupJoin",
+])
+
+
+def _plan_fp(plan):
+    """Structural fingerprint of a physical plan: node type +
+    explain_info (filters/aggs/keys print with literal values) + output
+    schema, recursively; -> None when any node's content can't be fully
+    pinned. Keys the materialized-dim cache, so under-discrimination
+    here would serve one subquery's rows to a different subquery —
+    node types append every field their explain_info omits."""
+    tname = type(plan).__name__
+    if tname not in _FP_SAFE_NODES:
+        return None
+    parts = [tname, plan.explain_info(),
+             ",".join(sc.name or "" for sc in plan.schema.cols)]
+    oc = getattr(plan, "other_conds", None)
+    if oc:
+        parts.append("oc:" + ";".join(map(repr, oc)))
+    # explain_info gaps, per node kind:
+    if tname == "PhysBatchPointGet":       # prints only len(handles)
+        parts.append("h:" + ";".join(map(repr, plan.handles)))
+    elif tname == "PhysIndexRange":        # omits residual conjuncts
+        parts.append("res:" + ";".join(map(repr, plan.residual)))
+    elif tname == "PhysIndexMerge":        # omits ranges + residual
+        parts.append("br:" + ";".join(
+            f"{ix.name}[{lo!r},{hi!r},{li},{hi_i}]"
+            for ix, lo, hi, li, hi_i in plan.branches))
+        parts.append("res:" + ";".join(map(repr, plan.residual)))
+    elif tname == "PhysIndexLookupJoin":   # omits inner residuals
+        parts.append("inres:" + ";".join(map(repr, plan.inner_dag.filters +
+                                             plan.inner_dag.host_filters)))
+        parts.append("incols:" + ",".join(sc.name or ""
+                                          for sc in plan.inner_dag.cols))
+    elif tname == "PhysHashAgg":
+        parts.append("agg:" + ";".join(
+            f"{a.name}/{getattr(a, 'distinct', False)}" for a in plan.aggs))
+    elif tname == "PhysTableReader":       # omits limit/topn pushdowns
+        parts.append(f"lim:{plan.dag.limit},topn:{plan.dag.topn!r},"
+                     f"psel:{plan.dag.part_sel!r}")
+    elif tname == "PhysFusedPipeline":     # omits fact filters/pushdowns
+        parts.append("ff:" + ";".join(map(repr, plan.fact_dag.filters +
+                                          plan.fact_dag.host_filters)))
+        parts.append(f"lim:{plan.fact_dag.limit},"
+                     f"topn:{plan.fact_dag.topn!r},"
+                     f"ts:{plan.topn_spec!r}")
+    dims = getattr(plan, "dims", None)
+    if dims:
+        for d in dims:
+            parts.append(f"jt:{d.join_type}")
+            parts.append(";".join(map(repr, d.dag.filters + d.dag.host_filters)))
+            if d.subplan is not None:
+                sub = _plan_fp(d.subplan)
+                if sub is None:
+                    return None
+                parts.append(sub)
+    fb = getattr(plan, "fallback", None)
+    if fb is not None and type(fb).__name__ not in _FP_SAFE_NODES:
+        return None
+    for c in plan.children:
+        sub = _plan_fp(c)
+        if sub is None:
+            return None
+        parts.append(sub)
+    return "|".join(parts)
+
+
+def _plan_base_tables(engine, plan, out=None):
+    """Collect the ColumnarTables a plan reads. -> list or None when any
+    referenced table can't be pinned (unknown id, partitioned) — the
+    caller then skips caching rather than risk a stale reuse."""
+    if out is None:
+        out = []
+    infos = []
+    for attr in ("dag", "fact_dag", "inner_dag"):
+        dag = getattr(plan, attr, None)
+        if dag is not None and getattr(dag, "table_info", None) is not None:
+            infos.append(dag.table_info)
+    ti = getattr(plan, "table_info", None)
+    if ti is not None:
+        infos.append(ti)
+    for d in getattr(plan, "dims", None) or ():
+        if d.dag is not None and d.dag.table_info is not None:
+            infos.append(d.dag.table_info)
+        if d.subplan is not None and \
+                _plan_base_tables(engine, d.subplan, out) is None:
+            return None
+    for info in infos:
+        if getattr(info, "partitions", None):
+            return None
+        tbl = engine.tables.get(info.id)
+        if tbl is None:
+            return None
+        out.append(tbl)
+    for c in plan.children:
+        if _plan_base_tables(engine, c, out) is None:
+            return None
     return out
 
 
@@ -152,7 +286,42 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
     keyed by output POSITION, every row valid, group keys unique by
     construction (still verified). -> meta dict or None."""
     if ctx is None:
+        _set_reason(copr, "materialized dim: no execution context")
         return None
+    # cache across queries/snapshots: subplans are deterministic over
+    # their base-table contents, so (structural fingerprint, base-table
+    # versions) pins the result; reuse is sound when no base row was
+    # committed after either snapshot (max_commit_ts <= both read_ts).
+    # q21/q18-class queries re-run their decorrelated subqueries
+    # verbatim every execution — this turns those from the dominant
+    # per-run cost into a dict hit.
+    # an active dirty transaction can see uncommitted rows through the
+    # subplan's scans (UnionScan merge) without bumping any table
+    # version — both caching such a result and serving a committed-data
+    # result to the writer would be wrong, so dirty sessions bypass the
+    # cache entirely in both directions
+    txn = getattr(getattr(ctx, "sess", None), "_txn", None)
+    dirty = txn is not None and not txn.committed and not txn.aborted \
+        and txn.is_dirty()
+    ck = base = None
+    fp = None if dirty else _plan_fp(dim.subplan)
+    if fp is not None and not _VOLATILE_RE.search(fp):
+        base = _plan_base_tables(copr.engine, dim.subplan)
+    if base:
+        try:
+            tz = (str(ctx.sv.get("time_zone")), str(ctx.sv.get("sql_mode")))
+        except Exception:               # noqa: BLE001
+            tz = ()
+        ck = ("matdim", fp, tz)
+        vers = tuple((t.uid, t.version) for t in base)
+        maxts = max(t.max_commit_ts for t in base)
+        ent = copr._host_cache.get(ck)
+        if ent is not None:
+            evers, ets, cached = ent
+            # read_ts None = latest snapshot (sees every committed row)
+            if evers == vers and (ets is None or maxts <= ets) and \
+                    (read_ts is None or maxts <= read_ts):
+                return cached
     from ..executor.builder import build_executor
     ex = build_executor(ctx, dim.subplan)
     ex.open()
@@ -161,15 +330,18 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
     ncols = len(dim.dag.cols)
     n = sum(len(ch) for ch in chunks)
     if n == 0:
+        _set_reason(copr, "materialized dim: subplan produced no rows")
         return None                   # caller's empty-dim handling differs
     arrays = {}
     for i in range(ncols):
         parts = [ch.columns[i] for ch in chunks]
         data = np.concatenate([np.asarray(p.data) for p in parts])
         if data.dtype.kind not in "iufb":
+            _set_reason(copr, "materialized dim: non-numeric column")
             return None               # object arrays can't ride the kernel
         sdicts = {id(p.dict) for p in parts if p.dict is not None}
         if len(sdicts) > 1:
+            _set_reason(copr, "materialized dim: inconsistent dicts")
             return None               # inconsistent dicts across chunks
         sdict = next((p.dict for p in parts if p.dict is not None), None)
         nulls = None
@@ -180,15 +352,18 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
         arrays[i] = (data, nulls, sdict)
     key_cids = [_cid_of(dim.dag, sc) for sc, _ in dim.all_keys()]
     if any(cid == -1 for cid in key_cids):
+        _set_reason(copr, "materialized dim: join key not in output")
         return None
     for cid in key_cids:
         kdata, _kn, ksdict = arrays[cid]
         if ksdict is not None or kdata.dtype.kind == "f":
+            _set_reason(copr, "materialized dim: non-int64 join key")
             return None
     valid = np.ones(n, dtype=bool)
     vidx = np.arange(n)
     keys_v, pack = _packed_keys(arrays, key_cids, n, vidx)
     if keys_v is None or len(np.unique(keys_v)) != n:
+        _set_reason(copr, "materialized dim: non-unique or NULL keys")
         return None
     lo = int(keys_v.min())
     span = int(keys_v.max()) - lo + 1
@@ -205,6 +380,8 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
         o = np.argsort(keys_v, kind="stable")
         out.update(mode="sorted", lo=None, order=vidx[o],
                    skeys=keys_v[o], n_sorted=n)
+    if ck is not None:
+        copr._host_cache[ck] = (vers, read_ts, out)
     return out
 
 
@@ -691,7 +868,7 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
     across shards — psum/pmin/pmax allreduces for dense layouts, stacked
     per-shard partials (host merge) for the general sort layout."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from .dag_exec import psum_dense_result
 
     body = _make_pipeline_body(plan, local_cap, fact_sdicts, dim_caps,
@@ -713,7 +890,7 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
     else:
         out_spec = P("dp")
     fn = shard_map(frag, mesh=mesh, in_specs=(P("dp"), P("dp"), P()),
-                   out_specs=out_spec, check_rep=False)
+                   out_specs=out_spec, check_vma=False)
     return jax.jit(fn)
 
 

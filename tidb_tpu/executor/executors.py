@@ -192,7 +192,13 @@ class FusedPipelineExec(Executor):
 
     def partials(self):
         sess = self.ctx.sess
-        if self.ctx.copr.use_device and not self._any_dirty():
+        sess.domain.last_fused_reason = None
+        if not self.ctx.copr.use_device:
+            sess.domain.last_fused_reason = "device execution disabled"
+        elif self._any_dirty():
+            sess.domain.last_fused_reason = \
+                "transaction has uncommitted writes to a pipeline table"
+        else:
             from ..copr.pipeline import fused_partials
             mesh = None
             if getattr(self.plan, "mpp", False):
@@ -218,9 +224,16 @@ class FusedPipelineExec(Executor):
                     self.backend = ("device(fused-mpp)"
                                     if mesh is not None
                                     else "device(fused)")
+                    sess.domain.last_fused_reason = None
                     return res
-            except Exception:           # noqa: BLE001
+            except Exception as exc:    # noqa: BLE001
                 sess.domain.inc_metric("fused_pipeline_error")
+                sess.domain.last_fused_reason = (
+                    f"fused kernel error: {type(exc).__name__}: "
+                    f"{str(exc)[:200]}")
+                from ..utils.logutil import log
+                log("warn", "fused_fallback",
+                    reason=sess.domain.last_fused_reason)
                 if mesh is not None:
                     # mesh path failed: retry single-chip before falling
                     # all the way back to the host join
@@ -231,6 +244,7 @@ class FusedPipelineExec(Executor):
                         if res is not None:
                             sess.domain.inc_metric("fused_pipeline_hit")
                             self.backend = "device(fused)"
+                            sess.domain.last_fused_reason = None
                             return res
                     except Exception:   # noqa: BLE001
                         pass

@@ -2583,11 +2583,17 @@ def op_bit_count(ctx, expr):
     m1 = xp.uint64(0x5555555555555555)
     m2 = xp.uint64(0x3333333333333333)
     m4 = xp.uint64(0x0F0F0F0F0F0F0F0F)
-    h = xp.uint64(0x0101010101010101)
     v = v - ((v >> xp.uint64(1)) & m1)
     v = (v & m2) + ((v >> xp.uint64(2)) & m2)
     v = (v + (v >> xp.uint64(4))) & m4
-    return ((v * h) >> xp.uint64(56)).astype(xp.int64), an, None
+    # horizontal byte sum via shift-adds: the classic `v * 0x0101..01`
+    # multiply wraps uint64 by design, which numpy reports as an
+    # overflow warning on the host path — shift-adds sum the same bytes
+    # warning-free on both backends
+    v = v + (v >> xp.uint64(8))
+    v = v + (v >> xp.uint64(16))
+    v = v + (v >> xp.uint64(32))
+    return (v & xp.uint64(0x7F)).astype(xp.int64), an, None
 
 
 @op("interval")

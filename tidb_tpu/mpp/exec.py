@@ -28,7 +28,7 @@ from ..utils import jaxcfg  # noqa: F401
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
@@ -86,7 +86,7 @@ def mpp_global_sum(mesh: Mesh, cols_sharded: dict, sdicts: dict,
     fn = shard_map(lambda *a: frag(names_static, *a), mesh=mesh,
                    in_specs=tuple(in_specs),
                    out_specs=tuple(P() for _ in range(len(sum_exprs) + 1)),
-                   check_rep=False)
+                   check_vma=False)
     return jax.jit(fn)(*args)
 
 
@@ -107,7 +107,7 @@ def mpp_filter_agg(mesh: Mesh, key_arr, val_arr, valid, n_groups: int,
 
     fn = shard_map(frag, mesh=mesh,
                    in_specs=(P(axis), P(axis), P(axis)),
-                   out_specs=(P(), P()), check_rep=False)
+                   out_specs=(P(), P()), check_vma=False)
     return jax.jit(fn)(key_arr, val_arr, valid)
 
 
@@ -232,7 +232,7 @@ def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
     fn = shard_map(frag, mesh=mesh,
                    in_specs=tuple(P(axis) for _ in range(5 + nvals)),
                    out_specs=tuple(P() for _ in range(nvals + 1)),
-                   check_rep=False)
+                   check_vma=False)
     res = jax.jit(fn)(probe_keys, probe_valid, build_keys, build_payload,
                       build_valid, *pvals)
     if single:

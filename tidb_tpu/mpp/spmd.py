@@ -24,7 +24,7 @@ from ..utils import jaxcfg  # noqa: F401
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
@@ -149,7 +149,7 @@ def run_dag_spmd(domain, dag, mesh, local_cap, n_groups=None,
     nouts = len(aggs) + 1
     fn = shard_map(frag, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=tuple(P() for _ in range(nouts)),
-                   check_rep=False)
+                   check_vma=False)
     res = jax.jit(fn)(*flat_args)
     return {"sums": [np.asarray(r) for r in res[:-1]],
             "counts": np.asarray(res[-1])}

@@ -187,10 +187,21 @@ def _try_agg_leaf(p):
     return None
 
 
-import itertools as _itertools
+import hashlib as _hashlib
 
-_SYN_IDS = _itertools.count(1 << 40)    # synthesized col ids: disjoint
-                                        # from the builder's allocator
+
+def _syn_id(*parts):
+    """Content-derived synthesized column id in [2^40, 2^62) — disjoint
+    from the builder's allocator AND deterministic across plan rebuilds
+    of the same SQL. A global counter here leaked a fresh id into every
+    expression fingerprint, so every execution produced a brand-new
+    fused-kernel cache key and re-paid the XLA compile (the round-3 q21
+    'warm' runs were one compile per run). Identical content hashing to
+    identical ids is sound: the columns then carry identical values."""
+    s = "\x1f".join(str(p) for p in parts)
+    h = int.from_bytes(
+        _hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+    return (1 << 40) | (h >> 2)
 
 
 def _swap_join_build(root, joinnode, subagg):
@@ -284,7 +295,10 @@ def _eager_agg_outer_dims(outer_dims, group_items, aggs, other_refs):
                     [x.fingerprint() for x in a.args] == \
                     [x.fingerprint() for x in args]:
                 return sub_cols[j]
-        c = Column(next(_SYN_IDS), out_ft, f"agg${len(sub_aggs)}")
+        c = Column(_syn_id("agg", leaf.dag.table_info.id, b.fingerprint(),
+                           name, *(x.fingerprint() for x in args),
+                           out_ft.tp, out_ft.decimal),
+                   out_ft, f"agg${len(sub_aggs)}")
         sub_aggs.append(AggDesc(name, args, ft=out_ft))
         sub_cols.append(c)
         return c
@@ -1124,7 +1138,11 @@ def _pair_count_rewrite(p, inner, cross, filters, outer_dims):
     a_col = Column(a.idx, a.ft, a_sc.name)
     cnt_cols = []
     for gi, gcols in enumerate(([k_col], [k_col, a_col])):
-        cnt_col = Column(next(_SYN_IDS), ft_i64, f"cnt${gi}")
+        cnt_col = Column(
+            _syn_id("cntpair", inner.dag.table_info.id, k_in.idx, a.idx,
+                    gi, p.join_type,
+                    *(f.fingerprint() for f in inner.dag.filters)),
+            ft_i64, f"cnt${gi}")
         sub_aggs = [AggDesc("count", [], ft=ft_i64)]
         dag2 = dataclasses.replace(
             inner.dag, cols=list(inner.dag.cols),
@@ -1538,9 +1556,13 @@ def _try_fuse_distinct(plan: Aggregation, child: PhysPlan):
     inner = _Inner()
     inner.group_items = list(plan.group_items) + [x]
     inner.aggs = [AggDesc("count", [], ft=ft_i64)]
-    mid_cols = [Column(next(_SYN_IDS), g.ft, f"g${i}")
+    mid_cols = [Column(_syn_id("cdist-g", i, g.fingerprint()), g.ft,
+                       f"g${i}")
                 for i, g in enumerate(inner.group_items)]
-    mid_cols.append(Column(next(_SYN_IDS), ft_i64, "cnt$"))
+    mid_cols.append(Column(
+        _syn_id("cdist-cnt", x.fingerprint(),
+                *(g.fingerprint() for g in plan.group_items)),
+        ft_i64, "cnt$"))
     inner.schema = Schema([SchemaCol(c, c.name) for c in mid_cols])
     inner.stats_rows = plan.stats_rows * 4
     fused = _try_fuse_agg(inner, child)

@@ -59,6 +59,10 @@ class Domain:
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}
         self.metrics: dict = {}   # counter name -> value (prometheus analog)
+        # why the most recent query declined / fell off the fused device
+        # pipeline (None = fused OK); read by EXPLAIN ANALYZE and
+        # scripts/diag_routing.py (reference: pkg/util/execdetails)
+        self.last_fused_reason: str | None = None
         from ..utils.tracing import FlightRecorder, Tracer
         self.flight_recorder = FlightRecorder()
         self.tracer = Tracer(self.flight_recorder)

@@ -1170,6 +1170,10 @@ class Session:
         import numpy as np
         is_dml = isinstance(plan, (InsertPlan, UpdatePlan, DeletePlan))
         if stmt.analyze and not is_dml:
+            # the reason is per-statement diagnostics: clear it so a
+            # statement with no fused pipeline can't inherit the
+            # previous query's fallback note
+            self.domain.last_fused_reason = None
             ectx = ExecContext(self)
             ectx.collect_stats = True
             ex = build_executor(ectx, plan)
@@ -1229,6 +1233,12 @@ class Session:
                                  backend, info))
                 else:
                     rows.append((pid, est, "-", "-", "", info))
+            reason = self.domain.last_fused_reason
+            if reason:
+                # why the device pipeline declined this execution
+                # (reference pkg/util/execdetails runtime stats notes)
+                rows.append(("note", "-", "-", "-", "",
+                             f"fused fallback: {reason}"))
             names = ["id", "estRows", "actRows", "time", "backend",
                      "operator info"]
             cols = []

@@ -46,6 +46,10 @@ class ColumnarTable:
         self.n = 0
         self.cap = 0
         self.version = 0          # bumped on every mutation batch
+        self.max_commit_ts = 0    # newest insert/delete ts ever applied:
+        # a snapshot at read_ts >= max_commit_ts sees every row — lets
+        # host-side derived results (materialized dims) be reused across
+        # later snapshots when the table hasn't changed
         self.gc_epoch = 0         # bumped only by gc() compaction: host
         # caches that pinned an optimization OFF for unclustered/tie-heavy
         # data retry after a reorganization restores clustering
@@ -213,12 +217,16 @@ class ColumnarTable:
         self.n = pos + 1
         self.handle_pos[handle] = pos
         self.version += 1
+        if commit_ts > self.max_commit_ts:
+            self.max_commit_ts = commit_ts
 
     def delete_row(self, handle: int, commit_ts: int = 1):
         pos = self.handle_pos.get(handle)
         if pos is not None and self.delete_ts[pos] == 0:
             self.delete_ts[pos] = commit_ts
             self.version += 1
+            if commit_ts > self.max_commit_ts:
+                self.max_commit_ts = commit_ts
 
     def bulk_append(self, columns: dict, n: int, handles=None,
                     commit_ts: int = 1, nulls=None):
@@ -233,6 +241,8 @@ class ColumnarTable:
         self.handles[start:start + n] = handles
         self.insert_ts[start:start + n] = commit_ts
         self.delete_ts[start:start + n] = 0
+        if commit_ts > self.max_commit_ts:
+            self.max_commit_ts = commit_ts
         self._hpos = None     # rebuilt lazily on first point access: a
         # bulk load of N rows must not pay N Python dict inserts when
         # the workload never point-reads the table
