@@ -1,0 +1,104 @@
+"""WAL replication between cluster workers (VERDICT r3 missing #2 /
+next #4; reference: TiKV's raft log shipped to followers, collapsed to
+a synchronous primary->follower chain). The acked-durability contract
+under test: kill -9 the ONLY process holding a shard's primary while
+writes continue — no acknowledged transaction is lost; the promoted
+replacement serves the same rows."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def cluster():
+    procs = []
+    env = dict(os.environ, TIDB_TPU_PLATFORM="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True)
+        line = p.stdout.readline().strip()
+        assert line.startswith("WORKER_READY"), line
+        procs.append(p)
+        return int(line.split()[1])
+
+    ports = [spawn(), spawn()]
+    from tidb_tpu.cluster import Cluster
+    cl = Cluster(ports, spawn_worker=spawn)
+    cl.procs = procs
+    yield cl
+    cl.stop()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_acked_writes_survive_primary_kill(cluster):
+    cluster.enable_replication()
+    cluster.ddl("create table wr (a int primary key, b int)")
+    # acked transactional writes on worker 0 ONLY (its shard's primary
+    # copy is the only one in the cluster)
+    cluster.workers[0].call(
+        {"op": "load_sql",
+         "sqls": ["insert into wr values (1, 10), (2, 20)",
+                  "update wr set b = 11 where a = 1",
+                  "insert into wr values (3, 30)",
+                  "delete from wr where a = 2"]})
+    want = [(1, 11), (3, 30)]
+    assert cluster.query("select a, b from wr order by a") == want
+    # kill -9 the primary; its in-memory store is gone
+    victim = cluster.procs[0]
+    victim.kill()
+    victim.wait(timeout=30)
+    # writes continue on the surviving worker while 0 is down
+    cluster.workers[1].call(
+        {"op": "load_sql", "sqls": ["insert into wr values (100, 1)"]})
+    # promotion: replay DDL + the follower's shipped WAL on a fresh
+    # process — every acked write is back, including the update/delete
+    assert cluster._recover_worker(0) is not None
+    assert cluster.query("select a, b from wr order by a") == want
+    # the replacement is a full chain member: new acked writes on it
+    # survive a SECOND kill of the same slot
+    cluster.workers[0].call(
+        {"op": "load_sql", "sqls": ["insert into wr values (4, 40)"]})
+    victim2 = cluster.procs[-1]
+    victim2.kill()
+    victim2.wait(timeout=30)
+    assert cluster._recover_worker(0) is not None
+    assert cluster.query("select a, b from wr order by a") == \
+        [(1, 11), (3, 30), (4, 40)]
+
+
+def test_replicated_fragment_query_completes_after_kill(cluster):
+    """End-to-end: sharded data + aggregation fan-out; the primary of
+    shard 0 dies mid-workload; query_agg recovers it from the
+    replicated WAL (not the CSV) and returns the exact answer."""
+    import numpy as np
+    cluster.enable_replication()
+    cluster.ddl("create table li2 (id int primary key, v int)")
+    rng = np.random.RandomState(7)
+    vals = [(i + 1, int(rng.randint(0, 1000))) for i in range(400)]
+    for w, frac in ((0, vals[:200]), (1, vals[200:])):
+        cluster.workers[w].call(
+            {"op": "load_sql",
+             "sqls": ["insert into li2 values " +
+                      ",".join(f"({a},{b})" for a, b in frac)]})
+    want = [(str(sum(b for _a, b in vals)), 400)]    # SUM(int) renders
+    sql = "select sum(v), count(*) from li2"         # as DECIMAL
+    got = cluster.query_agg(sql)
+    assert [(str(a), b) for a, b in got] == want
+    victim = cluster.procs[0]
+    victim.kill()
+    victim.wait(timeout=30)
+    got = cluster.query_agg(sql)       # triggers recovery via WAL
+    assert [(str(a), b) for a, b in got] == want

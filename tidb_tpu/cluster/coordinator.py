@@ -82,6 +82,7 @@ class Cluster:
         self.spawn_worker = spawn_worker   # () -> port of a new worker
         self._ddl_log: list = []
         self._loads: list = []             # [(table, csv_path)]
+        self._replicated = False           # WAL chain active
 
     def _fanout(self, fn):
         """Run fn(i, worker) concurrently for every worker (independent
@@ -150,7 +151,11 @@ class Cluster:
 
     def load_shards(self, table: str, csv_path: str):
         eligible = self._placement_workers(table)
-        self._loads.append((table, csv_path, eligible))
+        # loads after enable_replication() reach the followers' WAL via
+        # the INSERT commit hook; earlier ones exist only in the bulk
+        # source, so recovery must replay them from there even when WAL
+        # frames exist (flag: was the chain active at load time?)
+        self._loads.append((table, csv_path, eligible, self._replicated))
         total = 0
         for pos, i in enumerate(eligible):
             out, _ = self.workers[i].call(
@@ -159,10 +164,28 @@ class Cluster:
             total += out["rows"]
         return total
 
+    def enable_replication(self):
+        """Form the WAL chain: worker i ships every commit's data
+        mutations to worker (i+1) % N before acking (reference: TiKV
+        raft replication, collapsed to one synchronous follower).
+        After this, _recover_worker promotes the follower's shipped
+        log instead of re-reading bulk sources — an acked transactional
+        write survives kill -9 of the only process that held it."""
+        n = len(self.workers)
+        if n < 2:
+            raise ValueError("replication needs >= 2 workers")
+        for i, w in enumerate(self.workers):
+            w.call({"op": "set_follower",
+                    "port": self.workers[(i + 1) % n].port,
+                    "primary": i})
+        self._replicated = True
+
     def _recover_worker(self, i):
         """Replace dead worker i: spawn a fresh process, replay the DDL
-        log, reload its shard of every bulk load (the durable source of
-        the OLAP data — BR manifests play this role in production).
+        log (same fresh-store sequence -> same table ids), then restore
+        the shard data. With replication on, the data comes from the
+        follower's shipped WAL (no acked txn lost); otherwise it is
+        re-read from the durable bulk sources (BR-manifest role).
         The recovered node then serves the same fragments."""
         if self.spawn_worker is None:
             return None
@@ -170,12 +193,34 @@ class Cluster:
         w = _WorkerClient(port)
         if self._ddl_log:
             w.call({"op": "load_sql", "sqls": list(self._ddl_log)})
-        for table, csv_path, eligible in self._loads:
-            if i in eligible:
+        frames = None
+        if self._replicated:
+            follower = self.workers[(i + 1) % len(self.workers)]
+            out, arrs = follower.call({"op": "wal_fetch", "primary": i})
+            if out["n"]:
+                frames = {f"f{j}": arrs[f"f{j}"]
+                          for j in range(out["n"])}
+        for table, csv_path, eligible, replicated in self._loads:
+            # loads made under replication live in the WAL frames;
+            # pre-replication loads only in the bulk source. Without
+            # frames, everything reloads from the source.
+            if i in eligible and not (replicated and frames is not None):
                 w.call({"op": "load_shard", "table": table,
                         "csv": csv_path, "shard": eligible.index(i),
                         "nshards": len(eligible)})
+        if frames is not None:
+            w.call({"op": "wal_replay", "n": len(frames)}, frames)
         self.workers[i] = w
+        if self._replicated:
+            # repair the chain around the replacement: predecessor ships
+            # to the new process; the new process ships to its successor
+            n = len(self.workers)
+            self.workers[(i - 1) % n].call(
+                {"op": "set_follower", "port": w.port,
+                 "primary": (i - 1) % n})
+            w.call({"op": "set_follower",
+                    "port": self.workers[(i + 1) % n].port,
+                    "primary": i})
         return w
 
     def tso(self, worker=0) -> int:

@@ -41,6 +41,32 @@ class WorkerServer:
         self._pending: dict = {}       # start_ts -> prewritten mutations
         from ..owner import LocalLeaseStore
         self._leases = LocalLeaseStore()
+        # WAL replication (reference: TiKV raft log shipped to
+        # followers; here a primary->follower chain assigned by the
+        # coordinator). As the PRIMARY: every mvcc commit's data
+        # mutations are WAL2-encoded and shipped SYNCHRONOUSLY to the
+        # follower inside the commit hook — the commit does not ack
+        # until the follower holds the frame, so an acked transaction
+        # survives this process's death. As a FOLLOWER: frames are
+        # stored per-primary (raft-learner log, NOT applied — this
+        # worker's own shard data must not double-count) and handed to
+        # the coordinator at promotion time.
+        self._follower_sock = None
+        self._follower_mu = threading.Lock()
+        self._ship_suppressed = False
+        self._replica: dict = {}       # primary id -> [frame bytes]
+        self._ship_hook_installed = False
+        # frames committed while the follower was unreachable (degraded
+        # mode — a 2-node chain can't block writes on a dead follower
+        # the way a raft majority could); flushed on reconnect
+        self._unshipped: list = []
+        self._follower_port = None
+        self._reconnect_after = 0.0    # monotonic deadline for retry
+        # full shipped history, retained so a REPLACED follower can be
+        # re-seeded from scratch (its in-memory replica log died with
+        # it); bounded by the same in-memory-store lifetime as the data
+        # itself
+        self._shipped: list = []
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -205,6 +231,45 @@ class WorkerServer:
                 n_groups=int(msg["n_groups"]), cap=int(msg["cap"]))
             return {"ok": True}, {"sums": np.asarray(sums),
                                   "counts": np.asarray(cnts)}
+        if op == "set_follower":
+            self._set_follower(int(msg["port"]), int(msg["primary"]))
+            return {"ok": True}, {}
+        if op == "wal_append":
+            self._replica.setdefault(int(msg["primary"]), []).append(
+                arrays["frame"].tobytes())
+            return {"ok": True}, {}
+        if op == "wal_reset":
+            self._replica[int(msg["primary"])] = []
+            return {"ok": True}, {}
+        if op == "wal_fetch":
+            frames = self._replica.get(int(msg["primary"]), [])
+            return {"ok": True, "n": len(frames)}, {
+                f"f{i}": np.frombuffer(fr, dtype=np.uint8)
+                for i, fr in enumerate(frames)}
+        if op == "wal_replay":
+            from ..storage.wal import decode_frame_payload
+            applied = 0
+            maxts = 0
+            self._ship_suppressed = True
+            try:
+                for i in range(int(msg["n"])):
+                    frame = arrays[f"f{i}"].tobytes()
+                    rec = decode_frame_payload(frame)
+                    if rec is None:
+                        raise ValueError("unrecognized replicated frame")
+                    commit_ts, muts, _wall = rec
+                    self.domain.storage.mvcc.apply_replay(commit_ts, muts)
+                    # promoted history is OURS now: a later chain repair
+                    # re-seeds the follower from _shipped, which must
+                    # cover everything this store holds
+                    self._shipped.append(frame)
+                    maxts = max(maxts, commit_ts)
+                    applied += 1
+            finally:
+                self._ship_suppressed = False
+            if maxts:
+                self.domain.storage.oracle.fast_forward(maxts)
+            return {"ok": True, "applied": applied}, {}
         if op == "lease":
             # owner-election authority (PD role; reference
             # owner/manager.go etcd campaign)
@@ -222,6 +287,121 @@ class WorkerServer:
             if act == "holder":
                 return {"ok": True, "holder": ls.holder(msg["key"])}, {}
         raise ValueError(f"unknown op {op}")
+
+    def _set_follower(self, port: int, primary: int):
+        """Designate the follower this worker ships its commit WAL to,
+        and install the ship hook (once). Only DATA mutations (record/
+        index keys) ship: the replacement rebuilds schema by replaying
+        the coordinator's DDL log, which allocates the same table ids
+        from a fresh store — shipping meta KVs too would collide with
+        that replay. The follower's log is RESET and re-seeded from this
+        primary's full shipped history: a freshly replaced follower
+        holds nothing, and a stale one may hold a divergent prefix."""
+        from ..codec.tablecodec import TABLE_PREFIX
+        with self._follower_mu:
+            if self._follower_sock is not None:
+                try:
+                    self._follower_sock.close()
+                except OSError:
+                    pass
+            self._follower_port = port
+            self._follower_sock = socket.create_connection(
+                ("127.0.0.1", port), timeout=30)
+            self._primary_id = primary
+            self._seed_follower_locked()
+        if self._ship_hook_installed:
+            return
+
+        def ship(commit_ts, mutations):
+            if self._ship_suppressed:
+                return
+            data = [(bytes(k), bytes(v) if v is not None else None)
+                    for k, v in mutations
+                    if bytes(k).startswith(TABLE_PREFIX)]
+            if not data:
+                return
+            from ..storage.wal import encode_frame_payload
+            import time as _t
+            payload = encode_frame_payload(commit_ts, data, _t.time())
+            with self._follower_mu:
+                if self._follower_sock is None:
+                    # degraded: keep acking writes, queue the frame, and
+                    # periodically retry the follower — a transient
+                    # socket error must not silence replication forever
+                    self._unshipped.append(payload)
+                    self._try_reconnect_locked()
+                    return
+                try:
+                    self._ship_locked(payload)
+                    self._shipped.append(payload)
+                except (ConnectionError, OSError):
+                    self._enter_degraded_locked(payload)
+
+        self.domain.storage.mvcc.commit_hooks.append(ship)
+        self._ship_hook_installed = True
+
+    def _enter_degraded_locked(self, payload: bytes):
+        from ..utils.logutil import log
+        try:
+            self._follower_sock.close()
+        except OSError:
+            pass
+        self._follower_sock = None
+        self._unshipped.append(payload)
+        import time as _t
+        self._reconnect_after = _t.monotonic() + 1.0
+        log("warn", "wal_replication_degraded",
+            follower_port=self._follower_port,
+            queued=len(self._unshipped))
+
+    def _try_reconnect_locked(self):
+        import time as _t
+        if self._follower_port is None or \
+                _t.monotonic() < self._reconnect_after:
+            return
+        self._reconnect_after = _t.monotonic() + 1.0
+        try:
+            self._follower_sock = socket.create_connection(
+                ("127.0.0.1", self._follower_port), timeout=5)
+            self._seed_follower_locked()
+            from ..utils.logutil import log
+            log("info", "wal_replication_restored",
+                follower_port=self._follower_port)
+        except OSError:
+            self._follower_sock = None
+
+    def _seed_follower_locked(self):
+        """Reset the follower's log for this primary and stream the full
+        shipped history + any degraded-mode backlog (follower_mu held).
+        On failure the backlog stays queued and we re-enter degraded."""
+        try:
+            send_msg(self._follower_sock,
+                     {"op": "wal_reset", "primary": self._primary_id})
+            out, _ = recv_msg(self._follower_sock)
+            if "err" in out:
+                raise RuntimeError(out["err"])
+            for payload in self._shipped:
+                self._ship_locked(payload)
+            while self._unshipped:
+                payload = self._unshipped[0]
+                self._ship_locked(payload)
+                self._shipped.append(payload)
+                self._unshipped.pop(0)
+        except (ConnectionError, OSError):
+            try:
+                self._follower_sock.close()
+            except OSError:
+                pass
+            self._follower_sock = None
+
+    def _ship_locked(self, payload: bytes):
+        """Send one WAL frame to the follower (follower_mu held)."""
+        send_msg(self._follower_sock, {"op": "wal_append",
+                                       "primary": self._primary_id},
+                 {"frame": np.frombuffer(payload, dtype=np.uint8)})
+        out, _ = recv_msg(self._follower_sock)
+        if "err" in out:
+            raise RuntimeError(f"wal replication failed: {out['err']}")
 
     def _load_shard(self, msg):
         """Round-robin rows of a CSV into this worker's shard of the

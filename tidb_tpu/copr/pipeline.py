@@ -180,6 +180,8 @@ def _plan_fp(plan):
     oc = getattr(plan, "other_conds", None)
     if oc:
         parts.append("oc:" + ";".join(map(repr, oc)))
+    if getattr(plan, "null_aware", False):
+        parts.append("naaj")       # NOT IN vs NOT EXISTS anti semantics
     # explain_info gaps, per node kind:
     if tname == "PhysBatchPointGet":       # prints only len(handles)
         parts.append("h:" + ";".join(map(repr, plan.handles)))
@@ -261,6 +263,50 @@ def _plan_base_tables(engine, plan, out=None):
     return out
 
 
+def _compact_policy(copr, compk, ccap, nvalid, denom):
+    """Learn/regrow policy for the compact-then-aggregate lowering,
+    shared by the single-chip and MPP loops so the thresholds cannot
+    drift. -> "retry" when the kernel must rebuild with a larger
+    compact buffer; None otherwise (first sight of a shape learns the
+    bucket when survivors are <= 1/8 of the partition, else pins
+    compaction off)."""
+    if ccap is not None and nvalid > ccap:
+        copr._host_cache[compk] = shape_bucket(nvalid)
+        return "retry"
+    if ccap is None and copr._host_cache.get(compk) != "off":
+        if nvalid <= denom // 8:
+            copr._host_cache[compk] = shape_bucket(max(nvalid, 1))
+        else:
+            copr._host_cache[compk] = "off"
+    return None
+
+
+_MATDIM_MAX_BYTES = 1 << 29     # 512MB of cached subquery results
+
+
+def _matdim_cache(copr):
+    """Per-copr LRU for materialized-dim results, byte-bounded — unlike
+    the metadata entries in _host_cache, these hold full result arrays
+    (the device pool analog: _dev_put charges an HBM budget)."""
+    c = getattr(copr, "_matdim_lru", None)
+    if c is None:
+        from collections import OrderedDict
+        c = copr._matdim_lru = OrderedDict()
+        copr._matdim_bytes = 0
+    return c
+
+
+def _matdim_nbytes(out):
+    total = 0
+    for d, nl, _sd in out["arrays"].values():
+        total += getattr(d, "nbytes", 0)
+        total += getattr(nl, "nbytes", 0) if nl is not None else 0
+    for k in ("lut", "order", "skeys"):
+        if k in out:
+            total += getattr(out[k], "nbytes", 0)
+    return total
+
+
 _MAT_SEQ = [0]
 
 
@@ -315,12 +361,14 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
         ck = ("matdim", fp, tz)
         vers = tuple((t.uid, t.version) for t in base)
         maxts = max(t.max_commit_ts for t in base)
-        ent = copr._host_cache.get(ck)
+        lru = _matdim_cache(copr)
+        ent = lru.get(ck)
         if ent is not None:
-            evers, ets, cached = ent
+            evers, ets, cached, _nb = ent
             # read_ts None = latest snapshot (sees every committed row)
             if evers == vers and (ets is None or maxts <= ets) and \
                     (read_ts is None or maxts <= read_ts):
+                lru.move_to_end(ck)
                 return cached
     from ..executor.builder import build_executor
     ex = build_executor(ctx, dim.subplan)
@@ -381,7 +429,16 @@ def _materialized_dim_meta(copr, ctx, dim, read_ts):
         out.update(mode="sorted", lo=None, order=vidx[o],
                    skeys=keys_v[o], n_sorted=n)
     if ck is not None:
-        copr._host_cache[ck] = (vers, read_ts, out)
+        lru = _matdim_cache(copr)
+        nb = _matdim_nbytes(out)
+        old = lru.pop(ck, None)
+        if old is not None:
+            copr._matdim_bytes -= old[3]
+        lru[ck] = (vers, read_ts, out, nb)
+        copr._matdim_bytes += nb
+        while copr._matdim_bytes > _MATDIM_MAX_BYTES and len(lru) > 1:
+            _k, (_v, _t, _o, onb) = lru.popitem(last=False)
+            copr._matdim_bytes -= onb
     return out
 
 
@@ -658,10 +715,13 @@ def _topn_select(res, aggs, topn, bucket):
     m = jnp.where(iota < ng, m, -_I64_MAX - 1)
     m = jnp.where((iota == 0) | (iota == ng - 1), _I64_MAX, m)
     _, sel = jax.lax.top_k(m, kprime)
-    return {"ngroups": ng, "sel": sel,
-            "keys": [k[sel] for k in res["keys"]],
-            "key_nulls": [kn[sel] for kn in res["key_nulls"]],
-            "states": [[s[sel] for s in st] for st in res["states"]]}
+    out = {"ngroups": ng, "sel": sel,
+           "keys": [k[sel] for k in res["keys"]],
+           "key_nulls": [kn[sel] for kn in res["key_nulls"]],
+           "states": [[s[sel] for s in st] for st in res["states"]]}
+    if "nvalid" in res:
+        out["nvalid"] = res["nvalid"]
+    return out
 
 
 def _pos_group_map(plan, dim_metas):
@@ -841,9 +901,34 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
         if agg_kind == "dense":
             return dense_agg_body(ctx, mask, group_items, aggs, agg_param,
                                   fact_cap)
-        gb, agg_impl, topn = agg_param
-        res = sort_agg_body(ctx, mask, group_items, aggs, fact_cap, gb,
-                            impl=agg_impl)
+        gb, agg_impl, topn, ccap = agg_param
+        csum = jnp.cumsum(mask.astype(jnp.int64))
+        nvalid = csum[fact_cap - 1]
+        if ccap is not None:
+            # compact-then-aggregate (selective pipelines, the
+            # Q18/Q21 class): the sort-based agg pays O(cap log cap)
+            # on the FULL padded partition even when a semi/anti dim
+            # kills almost every row. Gather the survivors into a
+            # small learned-capacity buffer first — cumsum +
+            # searchsorted + gather only (the scatter-free kernel
+            # policy) — and aggregate that. The caller verifies
+            # nvalid <= ccap (an overflow regrows the bucket and
+            # reruns, the group_bucket retry pattern).
+            src = jnp.searchsorted(
+                csum, jnp.arange(1, ccap + 1, dtype=jnp.int64))
+            src = jnp.minimum(src, fact_cap - 1)
+            ok = jnp.arange(ccap, dtype=jnp.int64) < nvalid
+            ccols = {}
+            for cidx, (d, nl, sd) in cols.items():
+                ccols[cidx] = (d[src],
+                               None if nl is None else nl[src], sd)
+            cctx = EvalCtx(jnp, ccap, ccols, host=False)
+            res = sort_agg_body(cctx, ok, group_items, aggs, ccap, gb,
+                                impl=agg_impl)
+        else:
+            res = sort_agg_body(ctx, mask, group_items, aggs, fact_cap,
+                                gb, impl=agg_impl)
+        res["nvalid"] = nvalid
         if topn is not None:
             res = _topn_select(res, aggs, topn, gb)
         return res
@@ -883,6 +968,8 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
             return psum_dense_result(res, aggs, "dp")
         # sort layout: per-shard partials, stacked along the mesh axis
         res["ngroups"] = res["ngroups"][None]
+        if "nvalid" in res:
+            res["nvalid"] = res["nvalid"][None]
         return res
 
     if dense:
@@ -1020,6 +1107,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     # a shape re-try the runs lowering / device top-N it had pinned off
     implk = ("aggimpl", fact_tbl.gc_epoch) + gbkey
     offk = ("ftopn_off", fact_tbl.gc_epoch) + gbkey
+    compk = ("fcompact", fact_tbl.gc_epoch) + gbkey
     ts = None
     if mesh is None:
         ts = _fused_topn_state(copr, plan, fact_tbl, offk, kd, sd)
@@ -1060,8 +1148,10 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                         not copr._host_cache.get(offk):
                     topn_k = (ts[0], ts[1], ts[2],
                               min(ts[3] + 66, group_bucket))
-                agg_kind, agg_param = "sort", (group_bucket, agg_impl,
-                                               topn_k)
+                ccap = copr._host_cache.get(compk)
+                agg_kind, agg_param = "sort", (
+                    group_bucket, agg_impl, topn_k,
+                    ccap if isinstance(ccap, int) else None)
             key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
                                    tuple(dim_caps), tuple(dim_ns),
                                    tuple(dim_sns), agg_kind, agg_param)
@@ -1083,6 +1173,9 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 out.append(_compact_dense(shim, res, sizes, kd, sd))
                 break
             ngroups = int(res["ngroups"])
+            if _compact_policy(copr, compk, agg_param[3],
+                               int(res["nvalid"]), cap) == "retry":
+                continue
             if agg_param[1] == "runs" and \
                     ngroups > max(_de._RUNS_DEGRADE_MIN, m // 4):
                 # unclustered group keys: pin this query shape to the
@@ -1291,6 +1384,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
     vpad = fact_valid[:n] if padded == n else np.concatenate(
         [fact_valid[:n], np.zeros(padded - n, dtype=bool)])
     fvv = _jax.device_put(vpad, NamedSharding(mesh, P("dp")))
+    compk = ("fcompact", fact_tbl.gc_epoch) + gbkey
     while True:
         if pos_spec is not None:
             agg_kind = "posdense"
@@ -1300,7 +1394,10 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         else:
             agg_impl = copr._host_cache.get(
                 ("aggimpl", fact_tbl.gc_epoch) + gbkey) or _segment_impl()
-            agg_kind, agg_param = "sort", (group_bucket, agg_impl, None)
+            ccap = copr._host_cache.get(compk)
+            agg_kind, agg_param = "sort", (
+                group_bucket, agg_impl, None,
+                ccap if isinstance(ccap, int) else None)
         key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, local,
                                tuple(dim_caps), tuple(dim_ns),
                                tuple(dim_sns), agg_kind, agg_param) + \
@@ -1320,6 +1417,10 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
             return [_compact_dense(shim, res, sizes, kd, sd)]
         ngroups_arr = np.asarray(res["ngroups"])     # [ndev]
         ng_max = int(ngroups_arr.max())
+        if _compact_policy(copr, compk, agg_param[3],
+                           int(np.asarray(res["nvalid"]).max()),
+                           local) == "retry":
+            continue
         if agg_param[1] == "runs" and \
                 ng_max > max(_de._RUNS_DEGRADE_MIN, local // 4):
             # unclustered group keys on this shard layout: pin to the

@@ -1304,6 +1304,14 @@ def _subst_cols(e, mapping):
     return e
 
 
+# plan-time device-routing cost gate (see the comment at the
+# PhysFusedPipeline construction): decline fusing when the estimated
+# group count is BOTH above this absolute floor and above this fraction
+# of the fact cardinality
+_FUSE_MAX_GROUPS_ABS = 1 << 18
+_FUSE_MAX_GROUP_RATIO = 0.10
+
+
 def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
     """Aggregation over an inner-join tree of plain table scans ->
     PhysHashAgg(final) over a PhysFusedPipeline, when every expression is
@@ -1527,6 +1535,21 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
     for e in list(group_items) + [a0 for a in aggs for a0 in a.args]:
         if not (_cols_of(e) <= pipe):
             return None
+    # cost gate: a near-per-row group domain (Q18's GROUP BY o_orderkey
+    # class) gains nothing from the device — the sort-based agg lowering
+    # pays O(n log n) on ~n groups, every group ships back to the host
+    # merge, and the measured on-chip sort is the weakest primitive
+    # (ROADMAP §0). The host hash agg wins these outright (r4 measured:
+    # q18@SF1 device 17.7s vs host 5.8s), so route them to the
+    # conventional subtree at PLAN time — the same engine-choice call
+    # the reference makes between TiKV and TiFlash by cost.
+    est_groups = plan.stats_rows
+    est_fact = max(fact.raw_rows
+                   if getattr(fact, "raw_rows", 0) else fact.stats_rows,
+                   1.0)
+    if est_groups > _FUSE_MAX_GROUPS_ABS and \
+            est_groups > _FUSE_MAX_GROUP_RATIO * est_fact:
+        return None
     fused = PhysFusedPipeline(fact.dag, dims, post,
                               list(group_items),
                               [_to_partial(a) for a in aggs],
