@@ -34,6 +34,9 @@ _CACHE_DIR = os.environ.get(
 os.makedirs(_CACHE_DIR, exist_ok=True)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# perf run: measured write paths must match a real deployment, not the
+# testing build with the row<->index mutation checker enabled
+os.environ.setdefault("TIDB_TPU_MUTATION_CHECK", "0")
 
 
 _PROBE_SRC = """

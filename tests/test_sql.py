@@ -2037,6 +2037,60 @@ class TestCollation:
         ftk.must_query("select count(*) from cl2 where s < 'M'")\
             .check([(2,)])
 
+    def test_unicode_ci_accent_insensitive(self, ftk):
+        """utf8mb4_unicode_ci (MySQL-verified semantics): accent- and
+        case-insensitive, German sharp s equals 'ss' (unlike
+        general_ci, where ss != the sharp s's casefold in MySQL), PAD
+        SPACE. Reference pkg/util/collate/collate.go:462 unicode_ci
+        collator registration."""
+        ftk.must_exec("create table clu (s varchar(20) collate "
+                      "utf8mb4_unicode_ci)")
+        ftk.must_exec("insert into clu values ('café'), ('CAFE'), "
+                      "('resume'), ('résumé'), ('straße'), ('STRASSE'), "
+                      "('pad ')")
+        # MySQL 8.0: SELECT 'café' = 'CAFE' COLLATE utf8mb4_unicode_ci -> 1
+        ftk.must_query("select count(*) from clu where s = 'cafe'")\
+            .check([(2,)])
+        ftk.must_query("select count(*) from clu where s = 'RÉSUMÉ'")\
+            .check([(2,)])
+        # MySQL: 'straße' = 'STRASSE' under unicode_ci -> 1
+        ftk.must_query("select count(*) from clu where s = 'strasse'")\
+            .check([(2,)])
+        # PAD SPACE: trailing spaces ignored
+        ftk.must_query("select count(*) from clu where s = 'pad'")\
+            .check([(1,)])
+        # grouping merges accent/case variants (witness value shown)
+        ftk.must_query("select count(*) from (select s from clu "
+                       "group by s) t").check([(4,)])
+
+    def test_0900_ai_ci_no_pad(self, ftk):
+        """utf8mb4_0900_ai_ci (MySQL-verified): accent/case-insensitive
+        like unicode_ci but NO PAD — trailing spaces are significant
+        (MySQL 8.0 manual, NO PAD collations)."""
+        ftk.must_exec("create table cl9 (s varchar(20) collate "
+                      "utf8mb4_0900_ai_ci)")
+        ftk.must_exec("insert into cl9 values ('café'), ('CAFE'), "
+                      "('pad '), ('pad')")
+        ftk.must_query("select count(*) from cl9 where s = 'Cafe'")\
+            .check([(2,)])
+        # NO PAD: 'pad ' <> 'pad'
+        ftk.must_query("select count(*) from cl9 where s = 'pad'")\
+            .check([(1,)])
+        ftk.must_query("select count(*) from cl9 where s = 'pad '")\
+            .check([(1,)])
+
+    def test_unicode_ci_order_and_minmax(self, ftk):
+        ftk.must_exec("create table clo (s varchar(20) collate "
+                      "utf8mb4_unicode_ci)")
+        ftk.must_exec("insert into clo values ('zeta'), ('Émile'), "
+                      "('apple'), ('École')")
+        # accent-insensitive order: École sorts with E, Émile with E
+        got = [r[0] for r in ftk.must_query(
+            "select s from clo order by s").rows]
+        assert got == ["apple", "École", "Émile", "zeta"], got
+        ftk.must_query("select min(s), max(s) from clo")\
+            .check([("apple", "zeta")])
+
 
 class TestJoinSpill:
     def test_grace_join(self, ftk):

@@ -24,6 +24,56 @@ from ..types import FieldType, TypeClass
 BUCKET_MIN = 1024
 
 
+# ---- collation normal forms (reference pkg/util/collate/collate.go) ----
+# Each _ci collation is a host-side fold to its normal form; all the
+# device-side machinery (norm tables, fold codes, ranks) is generic over
+# the fold. unicode_ci / 0900_ai_ci weights are computed from Unicode
+# decomposition (NFD, combining marks stripped) + casefold, which
+# reproduces MySQL's primary-weight behavior for these collations:
+# accent-insensitive, case-insensitive, 'ss' == U+00DF. PAD semantics
+# differ: pre-0900 collations PAD SPACE (trailing spaces ignored),
+# 0900_* are NO PAD.
+
+def _fold_general(s):
+    """utf8mb4_general_ci + PAD SPACE: casefold, strip trailing
+    spaces (reference pkg/util/collate general_ci collator)."""
+    return s.casefold().rstrip(" ") if isinstance(s, str) else s
+
+
+def _strip_marks(s):
+    import unicodedata
+    d = unicodedata.normalize("NFD", s)
+    return "".join(ch for ch in d if not unicodedata.combining(ch))
+
+
+def _fold_unicode(s):
+    """utf8mb4_unicode_ci (UCA primary weights) + PAD SPACE."""
+    return _strip_marks(s.casefold()).rstrip(" ") \
+        if isinstance(s, str) else s
+
+
+def _fold_0900_ai(s):
+    """utf8mb4_0900_ai_ci: UCA 9.0 primary weights, NO PAD."""
+    return _strip_marks(s.casefold()) if isinstance(s, str) else s
+
+
+_COLLATION_FOLDS = {
+    "utf8mb4_general_ci": _fold_general,
+    "utf8_general_ci": _fold_general,
+    "latin1_general_ci": _fold_general,
+    "utf8mb4_unicode_ci": _fold_unicode,
+    "utf8_unicode_ci": _fold_unicode,
+    "utf8mb4_unicode_520_ci": _fold_unicode,
+    "utf8mb4_0900_ai_ci": _fold_0900_ai,
+}
+
+
+def collation_fold(coll):
+    """Fold function for a _ci collation name (general_ci fallback for
+    unregistered _ci collations, matching the previous behavior)."""
+    return _COLLATION_FOLDS.get(str(coll).lower(), _fold_general)
+
+
 def shape_bucket(n: int) -> int:
     """Round row count up to a quarter-power-of-two step (>= BUCKET_MIN).
 
@@ -52,13 +102,13 @@ class StringDict:
         self.values: list[str] = []
         self.index: dict[str, int] = {}
         self.sort_keys = None  # lazily computed rank array for ordered compares
-        # utf8mb4_general_ci support (reference pkg/util/collate):
-        # collation-aware key tables, host-computed per dict version
-        self._ci_norm = None   # code -> canonical code (same dict)
-        self._ci_fold = None   # (fold_codes, fold_dict)
-        self._ci_ranks = None  # code -> ci sort rank
-        self._ci_fold_ranks = None  # (nvalues, code -> folded ci rank)
-        self._rank_codes = None  # ((ci, n), (code_map, sorted dict))
+        # collation-aware key tables (reference pkg/util/collate),
+        # host-computed per (collation, dict version)
+        self._ci_norm = {}   # coll -> (n, code -> canonical code)
+        self._ci_fold = {}   # coll -> (n, fold_codes, fold_dict)
+        self._ci_ranks = {}  # coll -> (n, code -> ci sort rank)
+        self._ci_fold_ranks = {}  # coll -> (n, code -> folded ci rank)
+        self._rank_codes = None  # ((coll, n), (code_map, sorted dict))
 
     def encode(self, arr: np.ndarray) -> np.ndarray:
         """Encode an object array of strings to int32 codes, extending dict.
@@ -122,81 +172,101 @@ class StringDict:
 
     @staticmethod
     def ci_fold(s):
-        """utf8mb4_general_ci + PAD SPACE normal form: casefold, strip
-        trailing spaces (reference pkg/util/collate general_ci collator
-        with the pre-0900 PAD SPACE attribute)."""
-        return s.casefold().rstrip(" ") if isinstance(s, str) else s
+        """utf8mb4_general_ci + PAD SPACE normal form (the default _ci
+        fold; parametrized collations go through collation_fold)."""
+        return _fold_general(s)
 
-    def ci_norm_table(self) -> np.ndarray:
-        """code -> canonical code: the FIRST value sharing the ci+pad
-        normal form. Grouping/DISTINCT through this table merges
-        case/padding variants while still decoding to an original
-        representative (MySQL shows a witness row's value)."""
-        if self._ci_norm is None or len(self._ci_norm) != len(self.values):
+    @staticmethod
+    def _coll_name(coll) -> str:
+        """Normalize the coll argument call sites pass: True/False
+        booleans (legacy) or a collation name string."""
+        if coll is True or coll is None:
+            return "utf8mb4_general_ci"
+        return str(coll).lower()
+
+    def ci_norm_table(self, coll=True) -> np.ndarray:
+        """code -> canonical code: the FIRST value sharing the
+        collation's normal form. Grouping/DISTINCT through this table
+        merges case/accent/padding variants while still decoding to an
+        original representative (MySQL shows a witness row's value)."""
+        cn = self._coll_name(coll)
+        hit = self._ci_norm.get(cn)
+        if hit is None or hit[0] != len(self.values):
+            fold = collation_fold(cn)
             seen: dict = {}
             t = np.empty(max(len(self.values), 1), dtype=np.int64)
             for i, v in enumerate(self.values):
-                f = self.ci_fold(v)
-                t[i] = seen.setdefault(f, i)
-            self._ci_norm = t[:len(self.values)] if self.values else t
-        return self._ci_norm
+                t[i] = seen.setdefault(fold(v), i)
+            t = t[:len(self.values)] if self.values else t
+            self._ci_norm[cn] = (len(self.values), t)
+        return self._ci_norm[cn][1]
 
-    def ci_fold_codes(self):
+    def ci_fold_codes(self, coll=True):
         """-> (codes, fold_dict): every value re-encoded by its normal
         form into a dict OF normal forms — join keys translated by
-        VALUE then match across sides regardless of case/padding."""
-        if self._ci_fold is None or \
-                len(self._ci_fold[0]) != len(self.values):
+        VALUE then match across sides regardless of case/accents/
+        padding (per the collation's rules)."""
+        cn = self._coll_name(coll)
+        hit = self._ci_fold.get(cn)
+        if hit is None or hit[0] != len(self.values):
+            fold = collation_fold(cn)
             fd = StringDict()
-            codes = np.array([fd.encode_one(self.ci_fold(v))
+            codes = np.array([fd.encode_one(fold(v))
                               for v in self.values] or [0],
                              dtype=np.int64)
-            self._ci_fold = (codes, fd)
-        return self._ci_fold
+            self._ci_fold[cn] = (len(self.values), codes, fd)
+        hit = self._ci_fold[cn]
+        return hit[1], hit[2]
 
-    def ci_ranks(self) -> np.ndarray:
-        """rank[code] under ci ordering: sorted by normal form, original
-        bytes as deterministic tiebreak."""
-        if self._ci_ranks is None or \
-                len(self._ci_ranks) != len(self.values):
+    def ci_ranks(self, coll=True) -> np.ndarray:
+        """rank[code] under the collation's ordering: sorted by normal
+        form, original bytes as deterministic tiebreak."""
+        cn = self._coll_name(coll)
+        hit = self._ci_ranks.get(cn)
+        if hit is None or hit[0] != len(self.values):
+            fold = collation_fold(cn)
             keyed = sorted(range(len(self.values)),
-                           key=lambda i: (self.ci_fold(self.values[i])
+                           key=lambda i: (fold(self.values[i])
                                           if self.values[i] is not None
                                           else "",
                                           self.values[i] or ""))
             ranks = np.empty(max(len(self.values), 1), dtype=np.int64)
             for r, i in enumerate(keyed):
                 ranks[i] = r
-            self._ci_ranks = ranks[:len(self.values)] if self.values \
-                else ranks
-        return self._ci_ranks
+            ranks = ranks[:len(self.values)] if self.values else ranks
+            self._ci_ranks[cn] = (len(self.values), ranks)
+        return self._ci_ranks[cn][1]
 
-    def ci_fold_ranks(self) -> np.ndarray:
-        """rank[code] under ci EQUALITY + order: values sharing the
-        ci+pad normal form get the SAME rank (MySQL: 'aa' = 'AA' —
-        peers in window frames, equal sort keys), ranks ascend in ci
+    def ci_fold_ranks(self, coll=True) -> np.ndarray:
+        """rank[code] under collation EQUALITY + order: values sharing
+        the normal form get the SAME rank (MySQL: 'aa' = 'AA' — peers
+        in window frames, equal sort keys), ranks ascend in collation
         order. ci_ranks() keeps a byte tiebreak and is for ORDER-only
         uses (min/max code remap)."""
-        if self._ci_fold_ranks is None or \
-                self._ci_fold_ranks[0] != len(self.values):
-            folded = [self.ci_fold(v) if v is not None else ""
+        cn = self._coll_name(coll)
+        hit = self._ci_fold_ranks.get(cn)
+        if hit is None or hit[0] != len(self.values):
+            fold = collation_fold(cn)
+            folded = [fold(v) if v is not None else ""
                       for v in self.values]
             pos = {f: r for r, f in enumerate(sorted(set(folded)))}
             ranks = np.array([pos[f] for f in folded] or [0],
                              dtype=np.int64)
-            self._ci_fold_ranks = (len(self.values), ranks)
-        return self._ci_fold_ranks[1]
+            self._ci_fold_ranks[cn] = (len(self.values), ranks)
+        return self._ci_fold_ranks[cn][1]
 
-    def rank_codes(self, ci: bool = False):
+    def rank_codes(self, ci=False):
         """-> (code_map, rank_ordered_dict): values re-encoded into a
         dict whose CODE ORDER equals the collation sort order, so
         numeric MIN/MAX over the mapped codes is string MIN/MAX and the
-        result decodes through the new dict. Cached per dict version."""
-        key = (ci, len(self.values))
+        result decodes through the new dict. Cached per dict version.
+        `ci` is False (binary order) or a collation truthy/name."""
+        cn = False if not ci else self._coll_name(ci)
+        key = (cn, len(self.values))
         hit = self._rank_codes
         if hit is not None and hit[0] == key:
             return hit[1]
-        ranks = self.ci_ranks() if ci else self.ranks()
+        ranks = self.ci_ranks(cn) if cn else self.ranks()
         sorted_dict = StringDict()
         order = np.argsort(ranks[:len(self.values)]) if self.values \
             else np.array([], dtype=np.int64)

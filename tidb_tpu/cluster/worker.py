@@ -334,7 +334,11 @@ class WorkerServer:
                 try:
                     self._ship_locked(payload)
                     self._shipped.append(payload)
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, RuntimeError):
+                    # RuntimeError = follower replied {err}: same
+                    # degraded handling — the frame must land in the
+                    # backlog, never vanish (an acked commit whose
+                    # frame was dropped would be lost on promotion)
                     self._enter_degraded_locked(payload)
 
         self.domain.storage.mvcc.commit_hooks.append(ship)
@@ -387,7 +391,7 @@ class WorkerServer:
                 self._ship_locked(payload)
                 self._shipped.append(payload)
                 self._unshipped.pop(0)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, RuntimeError):
             try:
                 self._follower_sock.close()
             except OSError:

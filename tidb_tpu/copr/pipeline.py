@@ -271,7 +271,14 @@ def _compact_policy(copr, compk, ccap, nvalid, denom):
     bucket when survivors are <= 1/8 of the partition, else pins
     compaction off)."""
     if ccap is not None and nvalid > ccap:
-        copr._host_cache[compk] = shape_bucket(nvalid)
+        if nvalid > denom // 4:
+            # selectivity drifted: survivors are no longer a small
+            # fraction — compaction would gather ~the whole partition
+            # just to sort the same size again. Pin it off instead of
+            # regrowing toward cap forever.
+            copr._host_cache[compk] = "off"
+        else:
+            copr._host_cache[compk] = shape_bucket(nvalid)
         return "retry"
     if ccap is None and copr._host_cache.get(compk) != "off":
         if nvalid <= denom // 8:

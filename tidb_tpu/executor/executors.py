@@ -540,12 +540,12 @@ def _columnar_unique_probe(ctab, tbl, index, datums, read_ts):
             mask = mask & nulls
             continue
         if ci.id in ctab.dicts:
-            from ..expression.vec import _is_ci
+            from ..expression.vec import _is_ci, _coll_arg
             sd = ctab.dicts[ci.id]
             if _is_ci(ci.ft):
                 # the query datum arrives FOLDED (fold_ci_datums):
                 # match any stored code sharing the normal form
-                codes, fd = sd.ci_fold_codes()
+                codes, fd = sd.ci_fold_codes(_coll_arg(ci.ft))
                 target = fd.lookup(str(d.val))
                 if target < 0:
                     return None
@@ -580,9 +580,11 @@ def _row_matches_index(tbl, index, row, datums):
         rv = rd.val
         off_ci = tbl.columns[off]
         if isinstance(rv, str):
-            from ..expression.vec import _is_ci
+            from ..expression.vec import _is_ci, _coll_arg
             if _is_ci(off_ci.ft):
-                rv = StringDict.ci_fold(rv)  # probe datums arrive folded
+                from ..chunk.device import collation_fold
+                rv = collation_fold(_coll_arg(off_ci.ft) or True)(rv)
+                # probe datums arrive folded
         if rv != d.val and str(rv) != str(d.val):
             return False
     return True
@@ -820,12 +822,12 @@ def _sort_key_arrays(schema, chunk, items):
             data = np.full(n, data if not isinstance(data, str) else 0)
         data = np.asarray(data)
         if sdict is not None:
-            from ..expression.vec import _is_ci
+            from ..expression.vec import _is_ci, _coll_arg
             # folded ranks: ci-equal spellings share a key value, so
             # sort order AND equality (window peers/partitions) both
             # follow the collation
-            ranks = sdict.ci_fold_ranks() if _is_ci(e.ft) \
-                else sdict.ranks()
+            ranks = sdict.ci_fold_ranks(_coll_arg(e.ft)) \
+                if _is_ci(e.ft) else sdict.ranks()
             data = ranks[data]
         elif data.dtype == object:
             if nm.any():

@@ -4,13 +4,59 @@ against the snapshot + buffer."""
 from __future__ import annotations
 
 from ..codec.tablecodec import record_key, index_key
-from ..codec.codec import encode_row_value
+from ..codec.codec import encode_row_value, decode_row_value
 from ..types.datum import Datum, Kind, NULL
-from ..errors import DuplicateKeyError, BadNullError
+from ..errors import DuplicateKeyError, BadNullError, TiDBError
 from ..models import SchemaState
 from ..storage.partition import route_partition
+from ..utils import failpoint
 
 TOMBSTONE = object()
+
+# row<->index mutation self-check (reference
+# pkg/table/tables/mutation_checker.go, design
+# docs/design/2021-09-22-data-consistency.md): after every write, the
+# index entries derivable from the row bytes JUST WRITTEN must exist in
+# the transaction buffer — an encode/derive divergence is caught at
+# write time, not by a later ADMIN CHECK TABLE. Enabled in testing
+# builds (testkit turns it on); ~one buffer get per index per row.
+MUTATION_CHECK = [False]
+
+
+class InconsistentMutationError(TiDBError):
+    """Write-time row/index divergence (error 8141 analog)."""
+
+
+def check_mutation(txn, tbl, handle: int, row: list):
+    if not MUTATION_CHECK[0]:
+        return
+    rk = record_key(physical_id(tbl, row), handle)
+    raw = txn.get(rk)
+    if raw is None:
+        raise InconsistentMutationError(
+            "mutation check: row key missing after write (table %s "
+            "handle %s)", tbl.name, handle)
+    decoded = decode_row_value(raw)
+    # PUBLIC indexes only: during a reorg (write-only state) rows
+    # written before the index existed legitimately lack entries until
+    # the backfill lands — the reference checker likewise validates only
+    # this statement's mutations, not global consistency
+    for idx in tbl.public_indexes():
+        # derive the index entry from the DECODED row bytes: if the
+        # written index KV came from different datums, the derived key
+        # is absent from the buffer
+        datums = _index_datums(tbl, idx, decoded[:len(tbl.columns)])
+        if idx.unique and not any(d.is_null for d in datums):
+            ik = index_key(tbl.id, idx.id, datums)
+            val = txn.get(ik)
+            ok = val is not None and val == _handle_bytes(handle)
+        else:
+            ik = index_key(tbl.id, idx.id, datums, handle)
+            ok = txn.get(ik) is not None
+        if not ok:
+            raise InconsistentMutationError(
+                "mutation check: index '%s' entry inconsistent with row "
+                "(table %s handle %s)", idx.name, tbl.name, handle)
 
 
 def physical_id(tbl, row) -> int:
@@ -33,8 +79,8 @@ def fold_ci_datums(tbl, idx, datums):
     while the row value keeps the original string. Applied on BOTH the
     write path (_index_datums) and every read-side key construction."""
     from ..types.field_type import TypeClass
-    from ..chunk.device import StringDict
-    from ..expression.vec import _is_ci
+    from ..chunk.device import StringDict, collation_fold
+    from ..expression.vec import _is_ci, _coll_arg
     name_to_col = {c.name.lower(): c for c in tbl.columns}
     out = list(datums)
     for i, cname in enumerate(idx.columns):
@@ -44,12 +90,12 @@ def fold_ci_datums(tbl, idx, datums):
                 ci.ft.tclass == TypeClass.STRING and _is_ci(ci.ft) and \
                 isinstance(d.val, (str, bytes)):
             from ..types.datum import Datum
+            fold = collation_fold(_coll_arg(ci.ft) or True)
             if isinstance(d.val, bytes):    # decoded index key datum
-                v = StringDict.ci_fold(
-                    d.val.decode("utf-8", "surrogateescape"))
+                v = fold(d.val.decode("utf-8", "surrogateescape"))
                 v = v.encode("utf-8", "surrogateescape")
             else:
-                v = StringDict.ci_fold(d.val)
+                v = fold(d.val)
             out[i] = Datum(d.kind, v, d.scale)
     return out
 
@@ -75,6 +121,9 @@ def add_record(txn, tbl, handle: int, row: list, skip_check=False):
             "Duplicate entry '%s' for key 'PRIMARY'", handle)
     for idx in tbl.writable_indexes():
         datums = _index_datums(tbl, idx, row)
+        # test hook: a registered callback may corrupt the derived
+        # index datums — the mutation checker below must catch it
+        failpoint.inject("mutation-corrupt-index", datums)
         if idx.unique and not any(d.is_null for d in datums):
             ik = index_key(tbl.id, idx.id, datums)
             if not skip_check and txn.get(ik) is not None:
@@ -86,6 +135,7 @@ def add_record(txn, tbl, handle: int, row: list, skip_check=False):
             ik = index_key(tbl.id, idx.id, datums, handle)
             txn.set(ik, b"")
     txn.set(rk, encode_row_value(row))
+    check_mutation(txn, tbl, handle, row)
 
 
 def remove_record(txn, tbl, handle: int, row: list):
@@ -96,6 +146,11 @@ def remove_record(txn, tbl, handle: int, row: list):
             txn.delete(index_key(tbl.id, idx.id, datums))
         else:
             txn.delete(index_key(tbl.id, idx.id, datums, handle))
+    if MUTATION_CHECK[0]:
+        if txn.get(record_key(physical_id(tbl, row), handle)) is not None:
+            raise InconsistentMutationError(
+                "mutation check: row key visible after delete (table %s "
+                "handle %s)", tbl.name, handle)
 
 
 def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
@@ -136,3 +191,4 @@ def update_record(txn, tbl, handle: int, old_row: list, new_row: list,
             txn.set(index_key(tbl.id, idx.id, nd, handle), b"")
     txn.set(record_key(physical_id(tbl, new_row), handle),
             encode_row_value(new_row))
+    check_mutation(txn, tbl, handle, new_row)

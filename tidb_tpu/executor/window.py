@@ -115,8 +115,8 @@ class WindowExec(Executor):
                 # raw codes returns first-inserted, not smallest — remap
                 # through the rank-ordered dict (same fix as the agg
                 # path's _minmaxkey)
-                from ..expression.vec import _is_ci
-                code_map, asd = asd.rank_codes(_is_ci(d.ft))
+                from ..expression.vec import _coll_arg
+                code_map, asd = asd.rank_codes(_coll_arg(d.ft))
                 vals = code_map[vals.astype(np.int64)]
             vals0, ok0 = vals, ~nm
         else:

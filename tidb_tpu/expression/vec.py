@@ -556,13 +556,18 @@ def _cmp_strings(ctx, expr, op_name, aval, bval):
     aft, bft = expr.args[0].ft, expr.args[1].ft
     ci = _is_ci(aft) or _is_ci(bft)
     nopad = _is_nopad(aft) or _is_nopad(bft)
-    # normal-form comparison: case fold + PAD SPACE for _ci
-    # collations, PAD SPACE alone for everything else but binary
-    # ('beta ' = 'BETA' under general_ci, 'a ' = 'a' under
-    # utf8mb4_bin); ONE definition of each normal form lives on
-    # StringDict / _pad_fold. fold is None only for binary.
-    fold = StringDict.ci_fold if ci else \
-        (None if nopad else _pad_fold)
+    # normal-form comparison: the _ci collation's fold (case/accent/
+    # pad per its rules — general_ci, unicode_ci, 0900_ai_ci differ),
+    # PAD SPACE alone for everything else but binary ('beta ' = 'BETA'
+    # under general_ci, 'a ' = 'a' under utf8mb4_bin); ONE definition
+    # of each normal form lives in chunk.device / _pad_fold. fold is
+    # None only for binary.
+    if ci:
+        from ..chunk.device import collation_fold
+        cn = _coll_arg(aft) or _coll_arg(bft)
+        fold = collation_fold(cn)
+    else:
+        fold = None if nopad else _pad_fold
     if fold is not None:
         if isinstance(a, str) and isinstance(b, str):
             return (_cmp_core(xp, op_name, fold(a), fold(b)),
@@ -994,6 +999,12 @@ def _is_ci(ft) -> bool:
     return ft is not None and str(getattr(ft, "collate", "")).endswith("_ci")
 
 
+def _coll_arg(ft):
+    """StringDict coll argument for a field type: the collation name
+    when it is a _ci collation, else False (binary/byte order)."""
+    return str(ft.collate).lower() if _is_ci(ft) else False
+
+
 @op("like")
 def op_like(ctx, expr):
     av = eval_expr(ctx, expr.args[0])
@@ -1029,16 +1040,17 @@ def op_collkey(ctx, expr):
     the FIRST value sharing the utf8mb4_general_ci+PAD normal form, so
     grouping merges case/padding variants and still decodes to an
     original representative (reference pkg/util/collate)."""
+    from ..chunk.device import collation_fold
+    fold = collation_fold(_coll_arg(expr.args[0].ft) or True)
     d, nl, sd = eval_expr(ctx, expr.args[0])
     if sd is None:
         if isinstance(d, str):
-            return StringDict.ci_fold(d), nl, None
+            return fold(d), nl, None
         if hasattr(d, "dtype") and d.dtype == object:
-            out = np.array([StringDict.ci_fold(v) for v in d],
-                           dtype=object)
+            out = np.array([fold(v) for v in d], dtype=object)
             return out, nl, None
         return d, nl, sd
-    t = sd.ci_norm_table()
+    t = sd.ci_norm_table(_coll_arg(expr.args[0].ft) or True)
     tt = ctx.xp.asarray(t) if not ctx.host else t
     return tt[d], nl, sd
 
@@ -1052,7 +1064,7 @@ def op_collkey_fold(ctx, expr):
     d, nl, sd = eval_expr(ctx, expr.args[0])
     if sd is None:
         return op_collkey(ctx, expr)
-    codes, fd = sd.ci_fold_codes()
+    codes, fd = sd.ci_fold_codes(_coll_arg(expr.args[0].ft) or True)
     tt = ctx.xp.asarray(codes) if not ctx.host else codes
     return tt[d], nl, fd
 
@@ -1068,7 +1080,7 @@ def op_minmaxkey(ctx, expr):
     d, nl, sd = eval_expr(ctx, expr.args[0])
     if sd is None:
         return d, nl, sd          # host object arrays compare by value
-    code_map, sorted_dict = sd.rank_codes(_is_ci(expr.ft))
+    code_map, sorted_dict = sd.rank_codes(_coll_arg(expr.ft))
     tt = ctx.xp.asarray(code_map) if not ctx.host else code_map
     return tt[d], nl, sorted_dict
 

@@ -13,6 +13,14 @@ class TestKit:
         self.domain = domain or new_store()
         self.sess = Session(self.domain)
         self.sess.vars.current_db = "test"
+        # write-time row<->index self-check in testing builds (reference
+        # intest.EnableInternalCheck + mutation_checker.go); perf
+        # harnesses opt out (TIDB_TPU_MUTATION_CHECK=0) so measured
+        # write paths match a real deployment
+        import os as _os
+        from .executor.table_rt import MUTATION_CHECK
+        MUTATION_CHECK[0] = _os.environ.get(
+            "TIDB_TPU_MUTATION_CHECK", "1") != "0"
 
     def must_exec(self, sql: str, params=None):
         return self.sess.execute(sql, params)
