@@ -14,9 +14,20 @@ import pytest
 def bench(monkeypatch, tmp_path):
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # importing the bench driver sets perf-mode env defaults
+    # (mutation checker off, persistent JAX compile cache); restore
+    # the PRE-import state so none leak into the rest of the suite
+    keys = ("TIDB_TPU_MUTATION_CHECK", "JAX_COMPILATION_CACHE_DIR",
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS")
+    prior = {k: os.environ.get(k) for k in keys}
     mod = importlib.import_module("bench")
     monkeypatch.setattr(mod, "_REPO", str(tmp_path))
-    return mod
+    yield mod
+    for k, v in prior.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
 
 
 def _write(tmp_path, name, doc):

@@ -293,3 +293,49 @@ def test_pad_space_on_columns(tk):
         [(2,)])
     tk.must_query("select count(*) from conf_p where s = 'x '").check(
         [(2,)])
+
+
+def test_compound_interval_units(tk):
+    """'D H:M:S'-style compound INTERVAL literals (MySQL 8.0 manual
+    "Temporal Intervals"; reference parser.y TimeUnit): fields
+    right-align to the unit list, a microsecond field left-justifies
+    to 6 digits, and sub-day intervals keep a string literal's time of
+    day."""
+    cases = [
+        ("select date_add('2024-01-01', interval '1:30' minute_second)",
+         "2024-01-01 00:01:30"),
+        ("select date_add('2024-01-01 10:00:00', "
+         "interval '2:15' hour_minute)", "2024-01-01 12:15:00"),
+        ("select date_add('2024-01-01', interval '1 6' day_hour)",
+         "2024-01-02 06:00:00"),
+        ("select date_add('2024-01-01', interval '1-6' year_month)",
+         "2025-07-01"),
+        ("select date_add('2024-01-31', interval '0-1' year_month)",
+         "2024-02-29"),                       # day-of-month clamp
+        ("select date_sub('2024-01-01 00:02:00', "
+         "interval '1:30' minute_second)", "2024-01-01 00:00:30"),
+        # MySQL quirk: the fraction left-justifies ('1.5' = 1s 500000us)
+        ("select date_add('2024-01-01', "
+         "interval '1.5' second_microsecond)",
+         "2024-01-01 00:00:01.500000"),
+        ("select date_add('2024-01-01', "
+         "interval '-1 2:00:00' day_second)", "2023-12-30 22:00:00"),
+    ]
+    for sql, want in cases:
+        got = tk.must_query(sql).rows[0][0]
+        assert str(got) == want, (sql, got, want)
+
+
+def test_compound_interval_window_frame(tk):
+    tk.must_exec("drop table if exists wfci")
+    tk.must_exec("create table wfci (ts datetime, v int)")
+    tk.must_exec("insert into wfci values "
+                 "('2024-01-01 00:00:00', 1), ('2024-01-01 00:01:00', 2),"
+                 "('2024-01-01 00:02:30', 3), ('2024-01-01 00:10:00', 4)")
+    rows = tk.must_query(
+        "select v, sum(v) over (order by ts range between "
+        "interval '1:30' minute_second preceding and current row) "
+        "from wfci order by ts").rows
+    # 90s window: row3 (00:02:30) covers 00:01:00.. -> 2+3
+    assert [(r[0], str(r[1])) for r in rows] == \
+        [(1, "1"), (2, "3"), (3, "5"), (4, "4")], rows

@@ -1847,10 +1847,28 @@ class TestWindowFrames:
             "where d < '2024-01-10' order by d").check(
             [("2024-01-01", "1"), ("2024-01-03", "3"),
              ("2024-01-05", "5")])
+        # compound units normalize to the finest single unit: a
+        # sub-day remainder over a DATE key refuses (34h != whole
+        # days), a whole-day count works
         e = ftk.exec_err(
             "select sum(v) over (order by d range between interval "
             "'1 10' day_hour preceding and current row) from wri")
-        assert "INTERVAL literal" in str(e)
+        assert "DATETIME" in str(e)
+        ftk.must_query(
+            "select d, sum(v) over (order by d range between interval "
+            "'2 0' day_hour preceding and current row) from wri "
+            "where d < '2024-01-10' order by d").check(
+            [("2024-01-01", "1"), ("2024-01-03", "3"),
+             ("2024-01-05", "5")])
+        ftk.must_query(
+            "select dt, sum(v) over (order by dt range between interval "
+            "'1:30' hour_minute preceding and current row) from wri "
+            "order by dt").check(
+            [("2024-01-01 10:00:00", "1"),
+             ("2024-01-01 11:30:00", "3"),
+             ("2024-01-01 13:00:00", "5"),
+             ("2024-01-02 10:00:00", "4"),
+             ("2024-01-02 10:30:00", "9")])
 
 
 class TestRecursiveCTE:

@@ -26,7 +26,10 @@ _CMP_OPS = {"=", "<=>", "<", "<=", ">", ">=", "!=", "<>"}
 
 _TIME_UNITS = {"microsecond", "second", "minute", "hour", "day", "week",
                "month", "quarter", "year", "second_microsecond",
-               "minute_second", "hour_minute", "day_hour", "year_month"}
+               "minute_second", "minute_microsecond", "hour_minute",
+               "hour_second", "hour_microsecond", "day_hour",
+               "day_minute", "day_second", "day_microsecond",
+               "year_month"}
 
 
 class Parser:
@@ -2187,11 +2190,13 @@ class Parser:
                     self.expect_kw("row")
                     return "current_row"
                 if self.accept_kw("interval"):
-                    # RANGE INTERVAL n unit PRECEDING (temporal keys)
+                    # RANGE INTERVAL n unit PRECEDING (temporal keys);
+                    # colon-separated so compound units (MINUTE_SECOND)
+                    # don't collide with the _{which} suffix
                     n = self.next().text
                     iunit = self.ident().lower()
                     which = self.next().text.lower()
-                    return f"i:{n}:{iunit}_{which}"
+                    return f"i:{n}:{iunit}:{which}"
                 n = self.next().text
                 which = self.next().text.lower()
                 return f"{n}_{which}"

@@ -649,23 +649,32 @@ class PlanBuilder:
                     return None if is_start else None
                 if s == "unbounded_following":
                     return None
-                n, which = s.rsplit("_", 1)
-                if n.startswith("i:"):
-                    # interval bound: (count, unit), sign via a
-                    # wrapper tuple ("ival", +/-count, unit)
+                if s.startswith("i:"):
+                    # interval bound i:{literal}:{unit}:{which} ->
+                    # ("ival", +/-count, unit)
                     if f.unit == "rows":
                         raise UnsupportedError(
                             "INTERVAL bounds require a RANGE frame")
-                    _tag, cnt, iu = n.split(":")
-                    try:
-                        v = float(cnt)
-                    except ValueError:
-                        raise UnsupportedError(
-                            "unsupported INTERVAL literal '%s' in "
-                            "frame", cnt) from None
-                    v = int(v) if v == int(v) else v
+                    parts = s.split(":")
+                    which = parts[-1]
+                    iu = parts[-2]
+                    cnt = ":".join(parts[1:-2])
+                    from ..types.time_types import (
+                        _COMPOUND_INTERVALS, compound_interval_value)
+                    if iu in _COMPOUND_INTERVALS:
+                        # 'M:S'-style literal -> finest single unit
+                        v, iu = compound_interval_value(cnt, iu)
+                    else:
+                        try:
+                            v = float(cnt)
+                        except ValueError:
+                            raise UnsupportedError(
+                                "unsupported INTERVAL literal '%s' in "
+                                "frame", cnt) from None
+                        v = int(v) if v == int(v) else v
                     return ("ival", v if which == "preceding" else -v,
                             iu)
+                n, which = s.rsplit("_", 1)
                 v = int(n)
                 return v if which == "preceding" else -v
             start = bound(f.start, True)    # rows preceding (None=unbounded)

@@ -411,6 +411,24 @@ class Rewriter:
             value_expr = fold_constants(value_expr)
         if not isinstance(value_expr, Constant):
             raise UnsupportedError("non-constant INTERVAL value")
+        from ..types.time_types import (_COMPOUND_INTERVALS,
+                                        compound_interval_value)
+        if unit in _COMPOUND_INTERVALS:
+            # 'D H:M:S'-style literal normalizes to the finest unit at
+            # plan time; the executor only ever sees single units.
+            # NULL propagates (MySQL: DATE_ADD(x, INTERVAL NULL u) is
+            # NULL), it must not normalize to zero
+            base_unit = _COMPOUND_INTERVALS[unit][0]
+            if value_expr.value.is_null:
+                return Constant(value=value_expr.value,
+                                ft=new_bigint_type().clone(
+                                    tp=f"interval_{base_unit}"))
+            total, unit = compound_interval_value(
+                value_expr.value.to_py(), unit)
+            c = const_from_py(total)
+            return Constant(value=c.value,
+                            ft=new_bigint_type().clone(
+                                tp=f"interval_{unit}"))
         ft = new_bigint_type().clone(tp=f"interval_{unit}")
         return Constant(value=value_expr.value, ft=ft)
 
@@ -515,13 +533,28 @@ class Rewriter:
                 iv = self._rw_IntervalExpr(ivnode)
             else:
                 iv = self._mk_interval(self.rewrite(ivnode), "day")
-            if base.ft.tclass in (TypeClass.STRING, TypeClass.JSON):
-                base = self.mk_func("cast_str_to_date", [base], new_date_type())
             unit = iv.ft.tp.replace("interval_", "")
+            subday = unit in ("hour", "minute", "second", "microsecond")
+            if base.ft.tclass in (TypeClass.STRING, TypeClass.JSON):
+                # keep a literal's time of day whenever it HAS one
+                # (MySQL: '... 10:00:00' + INTERVAL 1 DAY keeps the
+                # time); sub-day intervals always need datetime space
+                has_time = isinstance(base, Constant) and \
+                    not base.value.is_null and \
+                    (":" in str(base.value.to_py()))
+                if subday or has_time:
+                    base = self.mk_func("cast_str_to_datetime", [base],
+                                        new_datetime_type())
+                else:
+                    base = self.mk_func("cast_str_to_date", [base],
+                                        new_date_type())
             out_ft = base.ft.clone()
-            if unit in ("hour", "minute", "second", "microsecond") and \
-                    base.ft.tclass == TypeClass.DATE:
+            if subday and base.ft.tclass == TypeClass.DATE:
                 out_ft = new_datetime_type()
+            if unit == "microsecond" and \
+                    out_ft.tclass in (TypeClass.DATETIME,
+                                      TypeClass.TIMESTAMP):
+                out_ft = out_ft.clone(decimal=6)   # show the fraction
             return self.mk_func(name, [base, iv], out_ft)
         if name == "get_format" and node.args:
             # GET_FORMAT(DATE|TIME|DATETIME|TIMESTAMP, region): the unit

@@ -291,6 +291,47 @@ class Session:
 
     def _run_subquery(self, select_stmt, limit_one=False):
         plan = optimize(select_stmt, self._plan_ctx())
+        # plan-time subquery results are data-dependent (they make the
+        # enclosing plan uncacheable), but the RESULT itself is
+        # deterministic over the base tables: cache it keyed by the
+        # subplan's structural fingerprint + base-table versions, the
+        # same soundness rule as the fused pipeline's materialized-dim
+        # cache. q20-class queries re-execute a multi-join subquery on
+        # every statement execution without this.
+        from ..copr.pipeline import (_plan_fp, _plan_base_tables,
+                                     _VOLATILE_RE)
+        ck = None
+        txn = self._txn
+        dirty = txn is not None and not txn.committed \
+            and not txn.aborted and txn.is_dirty()
+        if not dirty:
+            fp = _plan_fp(plan)
+            if fp is not None and not _VOLATILE_RE.search(fp):
+                base = _plan_base_tables(self.domain.copr.engine, plan)
+                if base:
+                    vers = tuple((t.uid, t.version) for t in base)
+                    maxts = max(t.max_commit_ts for t in base)
+                    try:
+                        tz = (str(self.vars.get("time_zone")),
+                              str(self.vars.get("sql_mode")))
+                    except Exception:       # noqa: BLE001
+                        tz = ()
+                    ck = ("subq", fp, bool(limit_one), tz)
+                    cache = getattr(self.domain, "_subq_cache", None)
+                    if cache is None:
+                        from collections import OrderedDict
+                        cache = self.domain._subq_cache = OrderedDict()
+                    ent = cache.get(ck)
+                    if ent is not None:
+                        evers, ets, cached = ent
+                        # current snapshot must ALSO see every row the
+                        # cached result saw (a txn that started before
+                        # those commits must re-execute)
+                        rts = ExecContext(self).read_ts()
+                        if evers == vers and maxts <= ets and \
+                                (rts is None or maxts <= rts):
+                            cache.move_to_end(ck)
+                            return cached
         ectx = ExecContext(self)
         ex = build_executor(ectx, plan)
         ex.open()
@@ -301,11 +342,31 @@ class Session:
         rows = []
         fts = [sc.col.ft for sc in plan.schema.visible()]
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
+        done = False
         for ch in chunks:
             for i in range(len(ch)):
                 rows.append(tuple(ch.columns[j].get_datum(i) for j in vis))
                 if limit_one and rows:
-                    return rows, fts
+                    done = True
+                    break
+            if done:
+                break
+        if ck is not None and len(rows) <= 2_000_000:
+            # ets = the snapshot the result was computed at (a stale
+            # reader must not poison the cache for fresh readers); the
+            # budget is byte-estimated like the matdim cache
+            ets = ectx.read_ts()
+            if ets is None:
+                ets = self.domain.storage.current_ts()
+            nb = 64 * (1 + len(rows)) * max(1, len(fts))
+            cache[ck] = (vers, ets, (rows, fts))
+            total = getattr(self.domain, "_subq_cache_bytes", 0) + nb
+            self.domain._subq_cache_bytes = total
+            while (total > (1 << 28) or len(cache) > 64) and \
+                    len(cache) > 1:
+                _k, (_v, _t, (orows, ofts)) = cache.popitem(last=False)
+                total -= 64 * (1 + len(orows)) * max(1, len(ofts))
+                self.domain._subq_cache_bytes = total
         return rows, fts
 
     # ---- dispatch -------------------------------------------------------

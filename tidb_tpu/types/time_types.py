@@ -58,6 +58,64 @@ def days_to_ymd(days: int):
     return y, m, rem + 1
 
 
+# compound INTERVAL units (reference parser.y TimeUnit productions;
+# MySQL 8.0 manual "Temporal Intervals"): 'D H:M:S'-style literals
+# normalize to a count of the FINEST unit, so every downstream interval
+# consumer (date arithmetic, window RANGE frames) stays single-unit.
+_COMPOUND_INTERVALS = {
+    "year_month": ("month", ("year", "month")),
+    "day_hour": ("hour", ("day", "hour")),
+    "day_minute": ("minute", ("day", "hour", "minute")),
+    "day_second": ("second", ("day", "hour", "minute", "second")),
+    "day_microsecond": ("microsecond",
+                        ("day", "hour", "minute", "second",
+                         "microsecond")),
+    "hour_minute": ("minute", ("hour", "minute")),
+    "hour_second": ("second", ("hour", "minute", "second")),
+    "hour_microsecond": ("microsecond",
+                         ("hour", "minute", "second", "microsecond")),
+    "minute_second": ("second", ("minute", "second")),
+    "minute_microsecond": ("microsecond",
+                           ("minute", "second", "microsecond")),
+    "second_microsecond": ("microsecond", ("second", "microsecond")),
+}
+
+_UNIT_TO_FINEST = {
+    ("year", "month"): 12, ("month", "month"): 1,
+    ("day", "hour"): 24, ("hour", "hour"): 1,
+    ("day", "minute"): 1440, ("hour", "minute"): 60,
+    ("minute", "minute"): 1,
+    ("day", "second"): 86400, ("hour", "second"): 3600,
+    ("minute", "second"): 60, ("second", "second"): 1,
+    ("day", "microsecond"): 86400 * MICROS_PER_SEC,
+    ("hour", "microsecond"): 3600 * MICROS_PER_SEC,
+    ("minute", "microsecond"): 60 * MICROS_PER_SEC,
+    ("second", "microsecond"): MICROS_PER_SEC,
+    ("microsecond", "microsecond"): 1,
+}
+
+
+def compound_interval_value(raw, unit: str):
+    """'1:30' MINUTE_SECOND -> (90, 'second'). Fields split on any
+    non-digit run and RIGHT-align to the unit's field list (MySQL:
+    missing leading fields are zero); a leading '-' negates the whole;
+    a microsecond field left-justifies to 6 digits ('1.5'
+    SECOND_MICROSECOND = 1s 500000us, the documented MySQL quirk)."""
+    import re as _re
+    base_unit, fields = _COMPOUND_INTERVALS[unit]
+    s = str(raw).strip()
+    neg = s.startswith("-")
+    parts = [p for p in _re.split(r"[^0-9]+", s.lstrip("-+")) if p]
+    if len(parts) > len(fields):
+        parts = parts[-len(fields):]
+    parts = ["0"] * (len(fields) - len(parts)) + parts
+    total = 0
+    for fname, p in zip(fields, parts):
+        v = int(p.ljust(6, "0")) if fname == "microsecond" else int(p)
+        total += v * _UNIT_TO_FINEST[(fname, base_unit)]
+    return (-total if neg else total), base_unit
+
+
 def parse_date(s: str) -> int:
     """'YYYY-MM-DD' (also YYYYMMDD, Y/M/D) -> days since epoch."""
     s = s.strip()
