@@ -988,8 +988,52 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
     return jax.jit(fn)
 
 
+def _delta_partition(plan, fact_tbl, fact_arrays, delta_rows):
+    """Shape a transaction's uncommitted INSERT rows like one more fact
+    partition (reference UnionScan's txn-buffer merge, re-designed as a
+    device overlay): {plan col idx -> (data, nulls, sdict)} + valid.
+    Null-array presence mirrors the committed snapshot so the kernel's
+    pytree (and its compiled program) is unchanged."""
+    n = len(delta_rows)
+    handles = np.array([h for h, _ in delta_rows], dtype=np.int64)
+    info = fact_tbl.table_info
+    off_of = {ci.id: off for off, ci in enumerate(info.columns)}
+    cols = {}
+    for sc in plan.fact_dag.cols:
+        cid = _cid_of(plan.fact_dag, sc)
+        if cid == -1:
+            cols[sc.col.idx] = (handles, None, None)
+            continue
+        snap_data, snap_nulls, sdict = fact_arrays[cid]
+        off = off_of[cid]
+        data = np.zeros(n, dtype=snap_data.dtype)
+        nulls = np.zeros(n, dtype=bool)
+        for r, (_h, datums) in enumerate(delta_rows):
+            d = datums[off] if off < len(datums) else None
+            if d is None or d.is_null:
+                nulls[r] = True
+                continue
+            if sdict is not None:
+                v = d.val
+                data[r] = sdict.encode_one(
+                    v if isinstance(v, str) else str(v))
+            elif data.dtype == np.float64:
+                data[r] = float(d.val)
+            elif data.dtype == object:
+                data[r] = d.val
+            else:
+                v = int(d.val)
+                if v > 0x7FFFFFFFFFFFFFFF:
+                    v -= 1 << 64
+                data[r] = v
+        nl = nulls if snap_nulls is not None else (
+            None if not nulls.any() else nulls)
+        cols[sc.col.idx] = (data, nl, sdict)
+    return cols, np.ones(n, dtype=bool)
+
+
 def fused_partials(copr, plan, read_ts, mesh=None,
-                   bcast_threshold=1 << 20, ctx=None):
+                   bcast_threshold=1 << 20, ctx=None, delta_rows=None):
     """Execute a PhysFusedPipeline -> [PartialAggResult] (one per fact
     partition; one per mesh shard for the MPP sort layout), or None when
     runtime-ineligible (caller falls back to the conventional subtree).
@@ -1035,7 +1079,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                          for sc in plan.fact_dag.cols) if cid != -1],
         read_ts)
     n = len(fact_valid)
-    if n == 0:
+    if n == 0 and not delta_rows:
         return []
     handles = fact_tbl.handle_array()
     if len(handles) > n:
@@ -1124,13 +1168,24 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             handles, dim_args, dim_metas, dim_caps, dim_ns, dim_sns,
             dim_layouts, fact_sdicts, pos_spec, sizes, shim, kd, sd,
             gbkey, group_bucket, read_ts, dim_pres)
-    for start in range(0, n, step):
-        sl = slice(start, min(start + step, n))
-        m = sl.stop - sl.start
+    def _partitions():
+        for start in range(0, n, step):
+            sl = slice(start, min(start + step, n))
+            pm = sl.stop - sl.start
+            pcols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays,
+                                    sl, handles,
+                                    cacheable=(n == fact_tbl.n))
+            yield pcols, fact_valid[sl], pm
+        if delta_rows:
+            # the transaction's uncommitted inserts as one more fact
+            # partition through the SAME kernel (device UnionScan)
+            dcols, dv = _delta_partition(plan, fact_tbl, fact_arrays,
+                                         delta_rows)
+            copr._bind_keys = {}        # never device-cache dirty rows
+            yield dcols, dv, len(dv)
+
+    for cols, v, m in _partitions():
         cap = shape_bucket(m)
-        cols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays, sl,
-                               handles, cacheable=(n == fact_tbl.n))
-        v = fact_valid[sl]
         while True:
             if pos_spec is not None:
                 agg_kind = "posdense"

@@ -176,32 +176,88 @@ class FusedPipelineExec(Executor):
     def next(self):
         raise RuntimeError("fused pipeline must be driven by HashAgg")
 
-    def _any_dirty(self):
+    def _dirty_state(self):
+        """Classify the transaction's uncommitted writes against this
+        pipeline (reference UnionScan, builder.go:1473, re-designed as
+        a device overlay): -> ("clean", None) | ("fact_insert", rows) |
+        ("fallback", None). fact_insert = ONLY the fact table is dirty
+        and every mutation is an insert of a NEW handle — those rows
+        mount as one extra device partition, keeping the fused path
+        under concurrent OLTP writes. Updates/deletes, dim-table
+        writes, and subplan-base writes fall back (correct, slower)."""
         sess = self.ctx.sess
         txn = getattr(sess, "_txn", None)
-        if txn is None or txn.committed or txn.aborted or not txn.is_dirty():
-            return False
-        from ..codec.tablecodec import record_prefix
-        tables = [self.plan.fact_dag.table_info] + \
-            [d.dag.table_info for d in self.plan.dims]
-        for t in tables:
+        if txn is None or txn.committed or txn.aborted or \
+                not txn.is_dirty():
+            return "clean", None
+        from ..codec.tablecodec import record_prefix, decode_record_key
+        from ..codec.codec import decode_row_value
+        fact_info = self.plan.fact_dag.table_info
+        others = []
+        fact_in_dims = False
+        for d in self.plan.dims:
+            if d.subplan is not None:
+                from ..copr.pipeline import _plan_base_tables
+                base = _plan_base_tables(
+                    self.ctx.copr.engine, d.subplan)
+                if base is None:
+                    return "fallback", None
+                for t in base:
+                    if t.table_info.id == fact_info.id:
+                        fact_in_dims = True
+                    else:
+                        others.append(t.table_info)
+            if d.dag.table_info.id == fact_info.id:
+                fact_in_dims = True
+            else:
+                others.append(d.dag.table_info)
+        for t in others:
             pref = record_prefix(t.id)
             for _k, _v in txn.mem_buffer.scan(pref, pref + b"\xff" * 9):
-                return True
-        return False
+                return "fallback", None
+        pref = record_prefix(fact_info.id)
+        muts = list(txn.mem_buffer.scan(pref, pref + b"\xff" * 9))
+        if not muts:
+            return "clean", None
+        if fact_in_dims or fact_info.partitions:
+            # the fact also feeds a dim/subplan (self-join shapes): an
+            # overlay on one side only would be inconsistent
+            return "fallback", None
+        ctab = self.ctx.copr.engine.tables.get(fact_info.id)
+        if ctab is None:
+            return "fallback", None
+        rows = []
+        hp = ctab.handle_pos
+        for k, v in muts:
+            if v is None:
+                return "fallback", None        # delete
+            try:
+                _tid, handle = decode_record_key(k)
+            except Exception:                  # noqa: BLE001
+                return "fallback", None
+            if handle in hp:
+                return "fallback", None        # update of existing row
+            rows.append((handle, decode_row_value(v)))
+        return "fact_insert", rows
 
     def partials(self):
         sess = self.ctx.sess
         sess.domain.last_fused_reason = None
+        dkind, drows = ("clean", None)
+        if self.ctx.copr.use_device:
+            dkind, drows = self._dirty_state()
         if not self.ctx.copr.use_device:
             sess.domain.last_fused_reason = "device execution disabled"
-        elif self._any_dirty():
+        elif dkind == "fallback":
             sess.domain.last_fused_reason = \
-                "transaction has uncommitted writes to a pipeline table"
+                "transaction has uncommitted updates/deletes or dim " \
+                "writes (insert-only fact deltas stay on device)"
         else:
             from ..copr.pipeline import fused_partials
             mesh = None
-            if getattr(self.plan, "mpp", False):
+            if getattr(self.plan, "mpp", False) and drows is None:
+                # the delta overlay runs single-chip: the extra
+                # partition is tiny and not worth a mesh program
                 fm = getattr(self.ctx, "force_mpp", None)
                 want = bool(self.ctx.sv.get("tidb_enable_mpp")) \
                     if fm is None else fm
@@ -216,11 +272,15 @@ class FusedPipelineExec(Executor):
                     "tidb_broadcast_join_threshold_count"))
                 res = fused_partials(self.ctx.copr, self.plan,
                                      self.ctx.read_ts(), mesh,
-                                     bcast_threshold=bt, ctx=self.ctx)
+                                     bcast_threshold=bt, ctx=self.ctx,
+                                     delta_rows=drows)
                 if res is not None:
                     sess.domain.inc_metric(
                         "fused_pipeline_mpp_hit" if mesh is not None
                         else "fused_pipeline_hit")
+                    if drows is not None:
+                        sess.domain.inc_metric(
+                            "fused_pipeline_dirty_overlay")
                     self.backend = ("device(fused-mpp)"
                                     if mesh is not None
                                     else "device(fused)")
@@ -240,7 +300,8 @@ class FusedPipelineExec(Executor):
                     try:
                         res = fused_partials(self.ctx.copr, self.plan,
                                              self.ctx.read_ts(), None,
-                                             ctx=self.ctx)
+                                             ctx=self.ctx,
+                                             delta_rows=drows)
                         if res is not None:
                             sess.domain.inc_metric("fused_pipeline_hit")
                             self.backend = "device(fused)"

@@ -208,6 +208,21 @@ class MVCCStore:
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 
+    def ingest(self, mutations: list, commit_ts: int):
+        """Bulk ingest of pre-built, sorted KV artifacts (reference
+        pkg/ingestor SST build+ingest / lightning local backend): ONE
+        WAL frame + direct version apply — no prewrite lock round and
+        no per-key conflict check, because the caller owns the key
+        range exclusively (an index in WRITE_REORG being backfilled, an
+        IMPORT INTO chunk). Commit hooks still run, so the columnar
+        engine and WAL replication see the rows like any commit."""
+        with self._mu:
+            if self.wal is not None:
+                self.wal.append(commit_ts, mutations)
+            self._apply(mutations, commit_ts)
+        for hook in self.commit_hooks:
+            hook(commit_ts, mutations)
+
     def rollback(self, keys: list, start_ts: int):
         with self._mu:
             for key in keys:
