@@ -1,0 +1,25 @@
+"""Force jax onto CPU in an image whose sitecustomize registers the axon
+TPU PJRT plugin in every interpreter (its init can block on a wedged
+tunnel even under JAX_PLATFORMS=cpu). Import FIRST in any CPU-only
+script: pops every non-cpu backend factory before the first backend
+init, mirroring tests/conftest.py."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+try:
+    from jax.experimental import pallas as _pl  # noqa: F401
+except Exception:                               # noqa: BLE001
+    pass
+try:
+    import jax._src.xla_bridge as _xb
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+    import jax
+    # sitecustomize sets the jax_platforms CONFIG (not just the env
+    # var) to "axon,cpu"; the env assignment above cannot override it
+    jax.config.update("jax_platforms", "cpu")
+except Exception:                               # noqa: BLE001
+    pass

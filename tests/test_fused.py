@@ -70,18 +70,85 @@ def test_fused_position_dense_group_matches(tk):
     assert len(got) > 30
 
 
-def test_fused_dirty_txn_falls_back(tk):
+def test_fused_dirty_txn_insert_overlay(tk):
+    """Insert-only fact delta stays on the fused device path: the
+    uncommitted row mounts as one extra device partition."""
     tk.must_exec("begin")
     tk.must_exec("insert into fact values (1001, 1, 1, 5.00, 1)")
-    before = tk.domain.metrics.get("fused_pipeline_fallback", 0)
+    before = tk.domain.metrics.get("fused_pipeline_dirty_overlay", 0)
     got = tk.must_query(Q_POS).rs.rows
-    assert tk.domain.metrics.get("fused_pipeline_fallback", 0) == before + 1
+    assert tk.domain.metrics.get(
+        "fused_pipeline_dirty_overlay", 0) == before + 1
     tk.must_exec("rollback")
     base = tk.must_query(Q_POS).rs.rows
     # the uncommitted row contributed to group a_id=1
     g1_dirty = next(r for r in got if r[0] == 1)
     g1_base = next(r for r in base if r[0] == 1)
     assert int(g1_dirty[2]) == int(g1_base[2]) + 1
+
+
+def test_fused_dirty_txn_update_overlay(tk):
+    """UPDATE of committed fact rows stays fused: the old version is
+    validity-masked and the new values ride the delta partition."""
+    base = tk.must_query(Q_POS).rs.rows
+    # pick a fact row whose a_id actually joins (a_id goes to 44 but
+    # dim_a ids stop at 40)
+    k = tk.must_query(
+        "select min(k) from fact where a_id <= 40").rs.rows[0][0]
+    tk.must_exec("begin")
+    tk.must_exec(f"update fact set q = q + 10 where k = {k}")
+    before = tk.domain.metrics.get("fused_pipeline_dirty_overlay", 0)
+    got = tk.must_query(Q_POS).rs.rows
+    assert tk.domain.metrics.get(
+        "fused_pipeline_dirty_overlay", 0) == before + 1
+    assert got == _conventional(tk, Q_POS)
+    tk.must_exec("rollback")
+    # exactly one group's sum moved by +10
+    diffs = [(b[0], int(g[2]) - int(b[2]))
+             for g, b in zip(got, base) if int(g[2]) != int(b[2])]
+    assert diffs and all(d == 10 for _, d in diffs)
+    assert tk.must_query(Q_POS).rs.rows == base
+
+
+def test_fused_dirty_txn_delete_overlay(tk):
+    """DELETE of committed fact rows stays fused via validity mask."""
+    tk.must_exec("begin")
+    tk.must_exec("delete from fact where q >= 45")
+    before = tk.domain.metrics.get("fused_pipeline_dirty_overlay", 0)
+    got = tk.must_query(Q_POS).rs.rows
+    assert tk.domain.metrics.get(
+        "fused_pipeline_dirty_overlay", 0) == before + 1
+    assert got == _conventional(tk, Q_POS)
+    tk.must_exec("rollback")
+
+
+def test_fused_dirty_txn_mixed_overlay(tk):
+    """Mixed insert+update+delete in one txn, plus insert-then-delete
+    of the same handle (a no-op against the committed snapshot)."""
+    tk.must_exec("begin")
+    tk.must_exec("insert into fact values (1002, 2, 1, 7.00, 3)")
+    tk.must_exec("update fact set q = 0 where k in (2, 3)")
+    tk.must_exec("delete from fact where k = 4")
+    tk.must_exec("insert into fact values (1003, 3, 1, 1.00, 1)")
+    tk.must_exec("delete from fact where k = 1003")
+    before = tk.domain.metrics.get("fused_pipeline_dirty_overlay", 0)
+    got = tk.must_query(Q_POS).rs.rows
+    assert tk.domain.metrics.get(
+        "fused_pipeline_dirty_overlay", 0) == before + 1
+    assert got == _conventional(tk, Q_POS)
+    tk.must_exec("rollback")
+
+
+def test_fused_dirty_dim_write_falls_back(tk):
+    """Writes to a dim table still drop the query to the host path."""
+    tk.must_exec("begin")
+    tk.must_exec("update dim_a set val = val + 1 where id = 1")
+    before = tk.domain.metrics.get("fused_pipeline_fallback", 0)
+    got = tk.must_query(Q_POS).rs.rows
+    assert tk.domain.metrics.get(
+        "fused_pipeline_fallback", 0) == before + 1
+    assert got == _conventional(tk, Q_POS)
+    tk.must_exec("rollback")
 
 
 def test_fused_nonunique_dim_falls_back(tk):
@@ -287,14 +354,16 @@ class TestDirtyOverlay:
         # rolled back: clean again
         assert tk.must_query(self.SQL).rows == want_clean
 
-    def test_update_delta_falls_back_correctly(self, tk):
+    def test_update_delta_stays_fused(self, tk):
         self._setup(tk)
         m = tk.domain.metrics
         tk.must_exec("begin")
         tk.must_exec("update fo_f set v = 0 where id = 1")
-        before = m.get("fused_pipeline_fallback", 0)
+        before = (m.get("fused_pipeline_dirty_overlay", 0),
+                  m.get("fused_pipeline_fallback", 0))
         got = tk.must_query(self.SQL).rows
-        assert m.get("fused_pipeline_fallback", 0) == before + 1
+        assert m.get("fused_pipeline_dirty_overlay", 0) == before[0] + 1
+        assert m.get("fused_pipeline_fallback", 0) == before[1]
         tk.must_exec("rollback")
         clean = tk.must_query(self.SQL).rows
         b_dirty = next(r for r in got if r[0] == "b")   # id 1 -> did 2

@@ -14,6 +14,7 @@ NULL-aware throughout (masks). Strings ride as dict codes.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls, or_nulls
 from ..utils.fetch import prefetch
+from ..utils import phase
 from ..chunk.device import shape_bucket
 from ..chunk.column import Column
 from ..chunk.chunk import Chunk
@@ -35,12 +37,26 @@ _I64_MAX = np.iinfo(np.int64).max
 class _KernelCache(dict):
     """Compiled-kernel cache with hit/miss counters (reference
     coprocessor_cache.go metrics; surfaced per-operator by
-    EXPLAIN ANALYZE's backend column)."""
+    EXPLAIN ANALYZE's backend column). Every inserted kernel is
+    wrapped with phase accounting (utils/phase.py): dispatch counts
+    and per-kind time feed the bench sidecar artifact."""
 
     def __init__(self):
         super().__init__()
         self.hits = 0
         self.misses = 0
+
+    def __setitem__(self, key, fn):
+        kind = key[0] if isinstance(key, tuple) and key and \
+            isinstance(key[0], str) else "kern"
+        dict.__setitem__(self, key, phase.timed_kernel(kind, fn))
+
+    def put(self, key, fn):
+        """Insert and return the phase-wrapped kernel — call sites must
+        dispatch the returned callable, not the raw one, or the first
+        (compiling) call vanishes from the phase stats."""
+        self[key] = fn
+        return dict.__getitem__(self, key)
 
     def get(self, key, default=None):
         v = super().get(key, default)
@@ -82,13 +98,18 @@ class CoprExecutor:
         if hit is not None:
             self._dev_cache_order.remove(key)
             self._dev_cache_order.append(key)
+            phase.inc("upload_hits")
             return hit
+        t0 = time.perf_counter()
         cap = key[-1]
         if len(arr_np) != cap:
             arr_np = np.concatenate(
                 [arr_np, np.full(cap - len(arr_np), pad_fill,
                                  dtype=arr_np.dtype)])
         dev = jnp.asarray(arr_np)
+        phase.add("upload_s", time.perf_counter() - t0)
+        phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
+        phase.inc("uploads")
         nbytes = dev.size * dev.dtype.itemsize
         while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
                and self._dev_cache_order):
@@ -275,6 +296,15 @@ class CoprExecutor:
 
     # ---- host (numpy) fallback ---------------------------------------
     def _execute_host(self, dag, tbl, arrays, valid, n, handles):
+        t0 = time.perf_counter()
+        try:
+            return self._execute_host_inner(dag, tbl, arrays, valid, n,
+                                            handles)
+        finally:
+            phase.add("host_exec_s", time.perf_counter() - t0)
+            phase.inc("host_execs")
+
+    def _execute_host_inner(self, dag, tbl, arrays, valid, n, handles):
         out = []
         step = self.device_rows
         produced = 0
@@ -499,7 +529,7 @@ class CoprExecutor:
         if kern is None:
             kern = _build_dense_agg_kernel_mpp(
                 dag, cols, local, strides, mesh, names, has_nulls)
-            self._kernel_cache[key] = kern
+            kern = self._kernel_cache.put(key, kern)
         res = kern(*args)
         return [_compact_dense(dag, res, strides, kd, sd)]
 
@@ -527,7 +557,7 @@ class CoprExecutor:
                 for f in filters:
                     mask = mask & eval_bool_mask(ctx, f)
                 return mask
-            self._kernel_cache[key] = kern
+            kern = self._kernel_cache.put(key, kern)
         jcols, vv = self._pad_upload(cols, v, m, cap)
         jc = {k: (d, nl) for k, (d, nl, _) in jcols.items()}
         mask = kern(jc, vv)
@@ -582,7 +612,7 @@ class CoprExecutor:
                 _, top_idx = jax.lax.top_k(kv, min(k, cap))
                 cnt = jnp.minimum(jnp.sum(mask.astype(jnp.int64)), k)
                 return top_idx, cnt
-            self._kernel_cache[key] = kern
+            kern = self._kernel_cache.put(key, kern)
         jcols, vv = self._pad_upload(cols, v, m, cap)
         jc = {kk: (d, nl) for kk, (d, nl, _) in jcols.items()}
         if dag.host_filters:
@@ -659,7 +689,7 @@ class CoprExecutor:
                 kern = self._kernel_cache.get(key)
                 if kern is None:
                     kern = _build_dense_agg_kernel(dag, cols, cap, strides)
-                    self._kernel_cache[key] = kern
+                    kern = self._kernel_cache.put(key, kern)
             else:
                 key = self._cache_key(dag, tbl, "agg", cap,
                                       (group_bucket, impl))
@@ -667,7 +697,7 @@ class CoprExecutor:
                 if kern is None:
                     kern = _build_agg_kernel(dag, cols, cap, group_bucket,
                                              impl)
-                    self._kernel_cache[key] = kern
+                    kern = self._kernel_cache.put(key, kern)
             jcols, vv = self._pad_upload(cols, v, m, cap)
             jc = {k: (d, nl) for k, (d, nl, _) in jcols.items()}
             if dag.host_filters:
@@ -861,9 +891,12 @@ def dense_agg_states(ctx, mask, aggs, slot, nslots, cap):
       tiny domains — no sort AND no scatter; larger domains are routed
       to runs_agg_body by the callers before reaching here."""
     impl = _segment_impl()
+    if nslots == 1:
+        # global aggregation: a scatter into one slot is never better
+        # than a plain masked reduce, on ANY backend (on the CPU proxy
+        # segment_sum lowers to a serial scatter — q6 lost 40% to it)
+        return _dense_agg_states_reduce(ctx, mask, aggs, cap)
     if impl == "runs":
-        if nslots == 1:
-            return _dense_agg_states_reduce(ctx, mask, aggs, cap)
         if nslots <= _BCR_MAX:
             return _dense_agg_states_bcr(ctx, mask, aggs, slot, nslots,
                                          cap)

@@ -1033,7 +1033,8 @@ def _delta_partition(plan, fact_tbl, fact_arrays, delta_rows):
 
 
 def fused_partials(copr, plan, read_ts, mesh=None,
-                   bcast_threshold=1 << 20, ctx=None, delta_rows=None):
+                   bcast_threshold=1 << 20, ctx=None, delta_rows=None,
+                   dead_handles=None):
     """Execute a PhysFusedPipeline -> [PartialAggResult] (one per fact
     partition; one per mesh shard for the MPP sort layout), or None when
     runtime-ineligible (caller falls back to the conventional subtree).
@@ -1084,6 +1085,13 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     handles = fact_tbl.handle_array()
     if len(handles) > n:
         handles = handles[:n]
+    if dead_handles:
+        # txn updated/deleted committed fact rows: mask their old
+        # versions out of the base snapshot (new versions, if any,
+        # arrive via the delta partition). & makes a fresh array —
+        # the snapshot's validity may be cached/shared.
+        fact_valid = fact_valid & ~np.isin(
+            handles, np.asarray(dead_handles, dtype=np.int64))
 
     if mesh is not None:
         # a build side too large to replicate routes through the HASH
@@ -1223,7 +1231,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                     plan, cap, fact_sdicts, tuple(dim_caps),
                     tuple(dim_ns), tuple(dim_sns), tuple(dim_layouts),
                     agg_kind, agg_param, dim_pres)
-                copr._kernel_cache[key] = kern
+                kern = copr._kernel_cache.put(key, kern)
             fjc_full, fvv = copr._pad_upload(cols, v, m, cap)
             fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
             res = prefetch(kern(fjc, fvv, dim_args))
@@ -1470,7 +1478,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                 plan, local, fact_sdicts, tuple(dim_caps), tuple(dim_ns),
                 tuple(dim_sns), tuple(dim_layouts), agg_kind, agg_param,
                 mesh, dim_pres)
-            copr._kernel_cache[key] = kern
+            kern = copr._kernel_cache.put(key, kern)
         res = prefetch(kern(fjc, fvv, dim_args))
         if pos_spec is not None:
             return [_compact_pos_dense(plan, res, pos_spec[0],

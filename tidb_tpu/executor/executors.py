@@ -179,12 +179,14 @@ class FusedPipelineExec(Executor):
     def _dirty_state(self):
         """Classify the transaction's uncommitted writes against this
         pipeline (reference UnionScan, builder.go:1473, re-designed as
-        a device overlay): -> ("clean", None) | ("fact_insert", rows) |
-        ("fallback", None). fact_insert = ONLY the fact table is dirty
-        and every mutation is an insert of a NEW handle — those rows
-        mount as one extra device partition, keeping the fused path
-        under concurrent OLTP writes. Updates/deletes, dim-table
-        writes, and subplan-base writes fall back (correct, slower)."""
+        a device overlay): -> ("clean", None) |
+        ("fact_delta", (rows, dead_handles)) | ("fallback", reason).
+        fact_delta = ONLY the fact table is dirty: inserted/updated
+        row values mount as one extra device partition and the
+        committed versions of updated/deleted handles are masked out
+        of the base snapshot's validity array, keeping the fused path
+        under concurrent OLTP writes. Dim-table writes and
+        subplan-base writes still fall back (correct, slower)."""
         sess = self.ctx.sess
         txn = getattr(sess, "_txn", None)
         if txn is None or txn.committed or txn.aborted or \
@@ -201,7 +203,9 @@ class FusedPipelineExec(Executor):
                 base = _plan_base_tables(
                     self.ctx.copr.engine, d.subplan)
                 if base is None:
-                    return "fallback", None
+                    return "fallback", ("dirty transaction and a dim "
+                                        "subplan whose base tables "
+                                        "cannot be determined")
                 for t in base:
                     if t.table_info.id == fact_info.id:
                         fact_in_dims = True
@@ -214,7 +218,10 @@ class FusedPipelineExec(Executor):
         for t in others:
             pref = record_prefix(t.id)
             for _k, _v in txn.mem_buffer.scan(pref, pref + b"\xff" * 9):
-                return "fallback", None
+                return "fallback", (f"transaction has uncommitted "
+                                    f"writes to joined table "
+                                    f"{t.name!r} (fact-only deltas "
+                                    f"stay on device)")
         pref = record_prefix(fact_info.id)
         muts = list(txn.mem_buffer.scan(pref, pref + b"\xff" * 9))
         if not muts:
@@ -222,23 +229,31 @@ class FusedPipelineExec(Executor):
         if fact_in_dims or fact_info.partitions:
             # the fact also feeds a dim/subplan (self-join shapes): an
             # overlay on one side only would be inconsistent
-            return "fallback", None
+            return "fallback", ("transaction wrote the fact table and "
+                                "the fact also feeds a dim/subplan or "
+                                "is partitioned — overlay would be "
+                                "one-sided")
         ctab = self.ctx.copr.engine.tables.get(fact_info.id)
         if ctab is None:
-            return "fallback", None
+            return "fallback", "fact table has no columnar image"
         rows = []
+        dead = []
         hp = ctab.handle_pos
         for k, v in muts:
-            if v is None:
-                return "fallback", None        # delete
             try:
                 _tid, handle = decode_record_key(k)
             except Exception:                  # noqa: BLE001
-                return "fallback", None
+                return "fallback", ("undecodable record key in the "
+                                    "transaction buffer")
+            if v is None:                      # delete
+                if handle in hp:
+                    dead.append(handle)
+                # else: insert-then-delete within this txn — no-op
+                continue
             if handle in hp:
-                return "fallback", None        # update of existing row
+                dead.append(handle)            # update: mask old version
             rows.append((handle, decode_row_value(v)))
-        return "fact_insert", rows
+        return "fact_delta", (rows, dead)
 
     def partials(self):
         sess = self.ctx.sess
@@ -249,9 +264,7 @@ class FusedPipelineExec(Executor):
         if not self.ctx.copr.use_device:
             sess.domain.last_fused_reason = "device execution disabled"
         elif dkind == "fallback":
-            sess.domain.last_fused_reason = \
-                "transaction has uncommitted updates/deletes or dim " \
-                "writes (insert-only fact deltas stay on device)"
+            sess.domain.last_fused_reason = drows   # the reason string
         else:
             from ..copr.pipeline import fused_partials
             mesh = None
@@ -273,7 +286,9 @@ class FusedPipelineExec(Executor):
                 res = fused_partials(self.ctx.copr, self.plan,
                                      self.ctx.read_ts(), mesh,
                                      bcast_threshold=bt, ctx=self.ctx,
-                                     delta_rows=drows)
+                                     delta_rows=drows[0] if drows else None,
+                                     dead_handles=drows[1] if drows
+                                     else None)
                 if res is not None:
                     sess.domain.inc_metric(
                         "fused_pipeline_mpp_hit" if mesh is not None
@@ -298,10 +313,11 @@ class FusedPipelineExec(Executor):
                     # mesh path failed: retry single-chip before falling
                     # all the way back to the host join
                     try:
-                        res = fused_partials(self.ctx.copr, self.plan,
-                                             self.ctx.read_ts(), None,
-                                             ctx=self.ctx,
-                                             delta_rows=drows)
+                        res = fused_partials(
+                            self.ctx.copr, self.plan,
+                            self.ctx.read_ts(), None, ctx=self.ctx,
+                            delta_rows=drows[0] if drows else None,
+                            dead_handles=drows[1] if drows else None)
                         if res is not None:
                             sess.domain.inc_metric("fused_pipeline_hit")
                             self.backend = "device(fused)"
@@ -314,6 +330,18 @@ class FusedPipelineExec(Executor):
         return self._fallback_partials()
 
     def _fallback_partials(self):
+        import time as _time
+        from ..utils import phase
+        t0 = _time.perf_counter()
+        try:
+            return self._fallback_partials_inner()
+        finally:
+            # wall time of the whole fallback subtree; overlaps the
+            # host_exec_s/dispatch_s its children record themselves
+            phase.add("fallback_s", _time.perf_counter() - t0)
+            phase.inc("fused_fallbacks")
+
+    def _fallback_partials_inner(self):
         from .builder import build_executor
         from ..copr.dag_exec import _host_partial_agg
         from ..copr.pipeline import _AggShim

@@ -1,0 +1,77 @@
+"""Per-query phase accounting: where does a device query's wall time go?
+
+The reference surfaces per-operator runtime stats through
+pkg/util/execdetails (EXPLAIN ANALYZE's execution info column); this is
+the TPU-engine analog at the *backend* altitude: counters accumulated by
+the copr layer while a statement runs — kernel dispatch count and time,
+kernel builds (trace+compile), host<->device upload time/bytes, device
+buffer-pool hits, host-path execution time.
+
+Collection points are central (one wrapper around every cached kernel,
+one inside the device buffer pool), so new operators are covered for
+free. Reset/snapshot is explicit: bench.py and EXPLAIN ANALYZE bracket
+each statement with reset()/snap().
+
+Timing a dispatch measures the *call* (async on TPU: the host returns
+before the kernel finishes). With TIDB_TPU_PHASE_SYNC=1 each kernel
+call blocks until its outputs are ready, attributing true device time
+per kernel kind — a diagnostic mode; it serializes the host/device
+overlap the production path relies on, so bench numbers must come from
+a non-sync run.
+"""
+import os
+import time
+
+
+STATS: dict = {}
+SYNC = os.environ.get("TIDB_TPU_PHASE_SYNC") == "1"
+
+
+def reset():
+    STATS.clear()
+
+
+def add(key, val):
+    STATS[key] = STATS.get(key, 0) + val
+
+
+def inc(key):
+    STATS[key] = STATS.get(key, 0) + 1
+
+
+def snap():
+    """-> {phase: value} with times in ms (rounded), counters as-is."""
+    out = {}
+    for k, v in sorted(STATS.items()):
+        out[k] = round(v * 1000, 2) if k.endswith("_s") else v
+    return out
+
+
+def timed_kernel(kind, fn):
+    """Wrap a compiled kernel callable with dispatch accounting.
+    First call is recorded separately (it pays the XLA trace+compile)."""
+    state = {"first": True}
+
+    def wrapped(*args, **kw):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        if SYNC:
+            try:
+                import jax
+                jax.block_until_ready(out)
+            except Exception:           # noqa: BLE001
+                pass
+        dt = time.perf_counter() - t0
+        inc("dispatches")
+        if state["first"]:
+            state["first"] = False
+            inc("kernel_builds")
+            add("compile_s", dt)
+            add(f"compile_{kind}_s", dt)
+        else:
+            add("dispatch_s", dt)
+            add(f"k_{kind}_s", dt)
+        return out
+
+    wrapped.__wrapped__ = fn
+    return wrapped
