@@ -304,13 +304,17 @@ def main():
         except Exception as e:                      # noqa: BLE001
             print(f"# sidecar write failed: {e}", file=sys.stderr)
 
-    if not speedups:
+    if not speedups and not tpu_times:
         write_sidecar()
         print(json.dumps({"metric": f"tpch_sf{sf}", "value": 0,
                           "unit": "no query completed", "vs_baseline": 0,
                           "backend": "error", "queries": per_query}))
         return
-    geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    # vs_baseline is 0 when every CPU baseline was skipped (stage-0
+    # micro capture: BENCH_CPU_BUDGET<0 spends the whole window on the
+    # device measurement; the geomean comes from a later full stage)
+    geo = math.exp(sum(math.log(s) for s in speedups)
+                   / len(speedups)) if speedups else 0.0
     if "q6" in tpu_times:
         hq, ht = "q6", tpu_times["q6"]
     else:                    # no q6: slowest survivor (never inflates)

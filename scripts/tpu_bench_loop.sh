@@ -1,19 +1,26 @@
 #!/bin/bash
 # Opportunistic TPU bench: the axon tunnel grants the device
 # intermittently. Poll with a cheap probe; whenever a grant appears,
-# run the NEXT missing stage (quick 4-query -> full 22-query -> HTAP
-# mix), each saved to the repo the moment it lands on-chip. Stages are
-# independent: a window that closes mid-way costs only the stage in
-# flight, and the loop keeps polling until every artifact exists.
+# run the NEXT missing stage, each saved to the repo the moment it
+# lands on-chip. Stages are independent: a window that closes mid-way
+# costs only the stage in flight, and the loop keeps polling until
+# every artifact exists.
+#
+# Stage 0 (round-5 verdict #1) is sized for a ~3-minute grant window:
+# Q6+Q1 @ SF0.1, 1 repeat, no CPU baseline (BENCH_CPU_BUDGET=-1 skips
+# the host timing), saved the instant both queries complete. The poll
+# log lives IN THE REPO (TPU_POLL_LOG.txt) so a grant-less round is
+# provably environmental, not a harness gap.
 cd /root/repo || exit 1
-LOG=/tmp/tpu_bench_loop.log
+LOG=/root/repo/TPU_POLL_LOG.txt
+M=/root/repo/BENCH_TPU_micro.json
 Q=/root/repo/BENCH_TPU_quick.json
 F=/root/repo/BENCH_TPU_full.json
 H=/root/repo/BENCH_TPU_htap.json
-echo "$(date +%H:%M:%S) loop start" >> "$LOG"
+echo "$(date +%F' '%H:%M:%S) loop start (pid $$)" >> "$LOG"
 while true; do
-  if [ -s "$Q" ] && [ -s "$F" ] && [ -s "$H" ]; then
-    echo "$(date +%H:%M:%S) all three TPU artifacts saved — exiting" >> "$LOG"
+  if [ -s "$M" ] && [ -s "$Q" ] && [ -s "$F" ] && [ -s "$H" ]; then
+    echo "$(date +%F' '%H:%M:%S) all four TPU artifacts saved — exiting" >> "$LOG"
     exit 0
   fi
   if timeout 150 python -c "
@@ -21,31 +28,41 @@ import jax, jax.numpy as jnp, numpy as np
 x = jnp.ones((256,256), jnp.bfloat16)
 np.asarray(x @ x)
 print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
-    echo "$(date +%H:%M:%S) TPU LIVE" >> "$LOG"
-    if [ ! -s "$Q" ]; then
+    echo "$(date +%F' '%H:%M:%S) TPU LIVE" >> "$LOG"
+    if [ ! -s "$M" ]; then
+      # stage 0: smallest possible on-chip artifact, ~2-3 min all-in
+      BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=180 \
+        BENCH_SF=0.1 BENCH_QUERIES=q6,q1 BENCH_REPEATS=1 \
+        BENCH_CPU_BUDGET=-1 BENCH_PHASES_PATH=/root/repo/BENCH_TPU_micro_phases.json \
+        timeout 600 python bench.py > /tmp/bench_micro_try.json 2>>"$LOG"
+      grep -q '"backend": "tpu"' /tmp/bench_micro_try.json 2>/dev/null && \
+        cp /tmp/bench_micro_try.json "$M" && \
+        echo "$(date +%F' '%H:%M:%S) micro TPU bench SAVED" >> "$LOG"
+    elif [ ! -s "$Q" ]; then
       BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 \
         BENCH_SF=1 BENCH_QUERIES=q1,q3,q5,q6 BENCH_REPEATS=3 \
+        BENCH_PHASES_PATH=/root/repo/BENCH_TPU_quick_phases.json \
         timeout 1800 python bench.py > /tmp/bench_quick_try.json 2>>"$LOG"
       grep -q '"backend": "tpu"' /tmp/bench_quick_try.json 2>/dev/null && \
         cp /tmp/bench_quick_try.json "$Q" && \
-        echo "$(date +%H:%M:%S) quick TPU bench SAVED" >> "$LOG"
+        echo "$(date +%F' '%H:%M:%S) quick TPU bench SAVED" >> "$LOG"
     elif [ ! -s "$F" ]; then
       BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=240 \
-        BENCH_SF=1 timeout 5400 python bench.py \
-        > /tmp/bench_full_try.json 2>>"$LOG"
+        BENCH_SF=1 BENCH_PHASES_PATH=/root/repo/BENCH_TPU_full_phases.json \
+        timeout 5400 python bench.py > /tmp/bench_full_try.json 2>>"$LOG"
       grep -q '"backend": "tpu"' /tmp/bench_full_try.json 2>/dev/null && \
         cp /tmp/bench_full_try.json "$F" && \
-        echo "$(date +%H:%M:%S) full TPU bench SAVED" >> "$LOG"
+        echo "$(date +%F' '%H:%M:%S) full TPU bench SAVED" >> "$LOG"
     else
       BENCH_NO_REPLAY=1 BENCH_MODE=htap BENCH_SF=0.1 BENCH_SECONDS=20 \
         BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 \
         timeout 1200 python bench.py > /tmp/bench_htap_try.json 2>>"$LOG"
       grep -q '"backend": "tpu"' /tmp/bench_htap_try.json 2>/dev/null && \
         cp /tmp/bench_htap_try.json "$H" && \
-        echo "$(date +%H:%M:%S) htap TPU bench SAVED" >> "$LOG"
+        echo "$(date +%F' '%H:%M:%S) htap TPU bench SAVED" >> "$LOG"
     fi
   else
-    echo "$(date +%H:%M:%S) no grant" >> "$LOG"
+    echo "$(date +%F' '%H:%M:%S) no grant" >> "$LOG"
   fi
   sleep 75
 done

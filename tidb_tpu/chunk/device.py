@@ -57,6 +57,36 @@ def _fold_0900_ai(s):
     return _strip_marks(s.casefold()) if isinstance(s, str) else s
 
 
+_ASCII_UPPER = str.maketrans(
+    "abcdefghijklmnopqrstuvwxyz", "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+
+
+def _fold_gbk(s):
+    """gbk_chinese_ci + PAD SPACE (reference
+    pkg/util/collate/gbk_chinese_ci.go): ASCII letters weigh as their
+    uppercase, Chinese characters by their GBK code. The normal form
+    maps each char's GBK encoding to latin-1 code units, so ordinary
+    lexicographic comparison of folded strings IS the GBK byte order
+    ('啊' 0xB0A1 < '文' 0xCEC4 < '中' 0xD6D0) — one fold serves
+    equality, GROUP BY merging, and ORDER BY ranks. Characters outside
+    GBK weigh as '?' (MySQL legacy-charset behavior)."""
+    if not isinstance(s, str):
+        return s
+    return s.upper().rstrip(" ").encode(
+        "gbk", errors="replace").decode("latin-1")
+
+
+def _fold_gb18030(s):
+    """gb18030_chinese_ci + PAD SPACE (reference
+    pkg/util/collate/gb18030_chinese_ci.go): like gbk but over the full
+    GB18030 plane (4-byte forms included, so every Unicode char has a
+    weight)."""
+    if not isinstance(s, str):
+        return s
+    return s.translate(_ASCII_UPPER).rstrip(" ").encode(
+        "gb18030", errors="replace").decode("latin-1")
+
+
 _COLLATION_FOLDS = {
     "utf8mb4_general_ci": _fold_general,
     "utf8_general_ci": _fold_general,
@@ -65,6 +95,8 @@ _COLLATION_FOLDS = {
     "utf8_unicode_ci": _fold_unicode,
     "utf8mb4_unicode_520_ci": _fold_unicode,
     "utf8mb4_0900_ai_ci": _fold_0900_ai,
+    "gbk_chinese_ci": _fold_gbk,
+    "gb18030_chinese_ci": _fold_gb18030,
 }
 
 

@@ -162,6 +162,9 @@ _FP_SAFE_NODES = frozenset([
     "PhysShell", "PhysSort", "PhysTopN", "PhysLimit", "PhysUnion",
     "PhysDual", "PhysIndexRange", "PhysIndexMerge", "PhysPointGet",
     "PhysBatchPointGet", "PhysIndexLookupJoin",
+    # fragment boundaries are pure pass-throughs: Sender prints
+    # type/fragment/keys in explain_info, Receiver's content is its child
+    "PhysExchangeSender", "PhysExchangeReceiver",
 ])
 
 
@@ -799,11 +802,24 @@ def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
 
 def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                         dim_sns, dim_layouts, agg_kind, agg_param,
-                        dim_pres=()):
+                        dim_pres=(), ecap=None, want_fnvalid=False):
     """The traced pipeline: filter fact -> dim probes/gathers -> residual
     filters -> partial agg. fact_cap is the (local, for MPP shards) fact
     partition capacity; dim_ns = full dim row counts, dim_sns = valid
-    sorted-key counts for searchsorted bounds."""
+    sorted-key counts for searchsorted bounds.
+
+    ecap: early-compaction capacity. Selective fact filters (the
+    q14/q19 class: a date-range predicate keeps ~1% of lineitem) make
+    every downstream probe gather and agg pass pay full-partition cost
+    for mostly-dead lanes. With ecap set, survivors of the FACT-local
+    filters are gathered into an ecap-row buffer (cumsum + searchsorted
+    + gather — the scatter-free kernel policy) and the joins/post
+    filters/aggregation run at ecap instead of fact_cap. The caller
+    learns ecap per query shape and verifies fnvalid <= ecap (overflow
+    regrows the bucket and reruns — the group_bucket retry pattern).
+    want_fnvalid: single-chip callers get res["fnvalid"] (the
+    fact-filter survivor count) for that policy; the MPP wrapper keeps
+    the result pytree unchanged."""
     fact_filters = list(plan.fact_dag.filters)
     dims = list(plan.dims)
     post = list(plan.post_filters)
@@ -811,11 +827,25 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
     aggs = list(plan.aggs)
 
     def body(fjc, fvv, dargs):
+        cap = fact_cap
         cols = {k: (d, nl, fact_sdicts[k]) for k, (d, nl) in fjc.items()}
-        ctx = EvalCtx(jnp, fact_cap, cols, host=False)
+        ctx = EvalCtx(jnp, cap, cols, host=False)
         mask = fvv
         for f in fact_filters:
             mask = mask & eval_bool_mask(ctx, f)
+        if ecap is not None:
+            csum0 = jnp.cumsum(mask.astype(jnp.int64))
+            fnvalid = csum0[cap - 1]
+            src = jnp.searchsorted(
+                csum0, jnp.arange(1, ecap + 1, dtype=jnp.int64))
+            src = jnp.minimum(src, cap - 1)
+            cols = {k: (d[src], None if nl is None else nl[src], sd)
+                    for k, (d, nl, sd) in cols.items()}
+            cap = ecap
+            mask = jnp.arange(ecap, dtype=jnp.int64) < fnvalid
+            ctx = EvalCtx(jnp, cap, cols, host=False)
+        elif want_fnvalid:
+            fnvalid = jnp.sum(mask.astype(jnp.int64))
         dim_pos = {}
         for dim_i, (dim, da, dcap, dn, dsn, layout) in enumerate(
                 zip(dims, dargs, dim_caps, dim_ns, dim_sns, dim_layouts)):
@@ -835,13 +865,13 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                 # composite key: pack probes with the build-side layout;
                 # out-of-range components force a miss (a clipped index
                 # could otherwise alias a live packed key)
-                pv = jnp.zeros(fact_cap, dtype=jnp.int64)
-                pnm = jnp.zeros(fact_cap, dtype=bool)
-                inb_pack = jnp.ones(fact_cap, dtype=bool)
+                pv = jnp.zeros(cap, dtype=jnp.int64)
+                pnm = jnp.zeros(cap, dtype=bool)
+                inb_pack = jnp.ones(cap, dtype=bool)
                 for ki, (_, pe) in enumerate(dim.all_keys()):
                     v, nl, _ = eval_expr(ctx, pe)
                     if np.isscalar(v) or getattr(v, "ndim", 1) == 0:
-                        v = jnp.full(fact_cap, v)
+                        v = jnp.full(cap, v)
                     v = v.astype(jnp.int64)
                     pnm = pnm | materialize_nulls(ctx, nl)
                     idx = v - da["plo"][ki]
@@ -853,7 +883,7 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
             else:
                 pv, pnl, _ = eval_expr(ctx, dim.probe_expr)
                 if np.isscalar(pv) or getattr(pv, "ndim", 1) == 0:
-                    pv = jnp.full(fact_cap, pv)
+                    pv = jnp.full(cap, pv)
                 pv = pv.astype(jnp.int64)
                 pnm = materialize_nulls(ctx, pnl)
             if "lut" in da:
@@ -894,23 +924,28 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                         gn = jn[pos] if jn is not None else None
                         cols[idx] = (g, gn, layout[idx][1])
             dim_pos[dim_i] = jnp.minimum(pos, dn - 1)
-            ctx = EvalCtx(jnp, fact_cap, cols, host=False)
+            ctx = EvalCtx(jnp, cap, cols, host=False)
         for f in post:
             mask = mask & eval_bool_mask(ctx, f)
         if agg_kind == "posdense":
             pos_dims, nslots = agg_param
-            slot = jnp.zeros(fact_cap, dtype=jnp.int64)
+            slot = jnp.zeros(cap, dtype=jnp.int64)
             for di in pos_dims:
                 slot = slot * dim_ns[di] + dim_pos[di]
             slot = jnp.where(mask, slot, nslots)
-            return dense_agg_states(ctx, mask, aggs, slot, nslots,
-                                    fact_cap)
+            res = dense_agg_states(ctx, mask, aggs, slot, nslots, cap)
+            if ecap is not None or want_fnvalid:
+                res["fnvalid"] = fnvalid
+            return res
         if agg_kind == "dense":
-            return dense_agg_body(ctx, mask, group_items, aggs, agg_param,
-                                  fact_cap)
+            res = dense_agg_body(ctx, mask, group_items, aggs, agg_param,
+                                 cap)
+            if ecap is not None or want_fnvalid:
+                res["fnvalid"] = fnvalid
+            return res
         gb, agg_impl, topn, ccap = agg_param
         csum = jnp.cumsum(mask.astype(jnp.int64))
-        nvalid = csum[fact_cap - 1]
+        nvalid = csum[cap - 1]
         if ccap is not None:
             # compact-then-aggregate (selective pipelines, the
             # Q18/Q21 class): the sort-based agg pays O(cap log cap)
@@ -923,7 +958,7 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
             # reruns, the group_bucket retry pattern).
             src = jnp.searchsorted(
                 csum, jnp.arange(1, ccap + 1, dtype=jnp.int64))
-            src = jnp.minimum(src, fact_cap - 1)
+            src = jnp.minimum(src, cap - 1)
             ok = jnp.arange(ccap, dtype=jnp.int64) < nvalid
             ccols = {}
             for cidx, (d, nl, sd) in cols.items():
@@ -933,21 +968,24 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
             res = sort_agg_body(cctx, ok, group_items, aggs, ccap, gb,
                                 impl=agg_impl)
         else:
-            res = sort_agg_body(ctx, mask, group_items, aggs, fact_cap,
+            res = sort_agg_body(ctx, mask, group_items, aggs, cap,
                                 gb, impl=agg_impl)
         res["nvalid"] = nvalid
         if topn is not None:
             res = _topn_select(res, aggs, topn, gb)
+        if ecap is not None or want_fnvalid:
+            res["fnvalid"] = fnvalid
         return res
     return body
 
 
 def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                         dim_sns, dim_layouts, agg_kind, agg_param,
-                        dim_pres=()):
+                        dim_pres=(), ecap=None):
     body = _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps,
                                dim_ns, dim_sns, dim_layouts, agg_kind,
-                               agg_param, dim_pres)
+                               agg_param, dim_pres, ecap=ecap,
+                               want_fnvalid=True)
     return jax.jit(body)
 
 
@@ -1167,6 +1205,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     implk = ("aggimpl", fact_tbl.gc_epoch) + gbkey
     offk = ("ftopn_off", fact_tbl.gc_epoch) + gbkey
     compk = ("fcompact", fact_tbl.gc_epoch) + gbkey
+    ecapk = ("fecompact", fact_tbl.gc_epoch) + gbkey
     ts = None
     if mesh is None:
         ts = _fused_topn_state(copr, plan, fact_tbl, offk, kd, sd)
@@ -1222,19 +1261,33 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 agg_kind, agg_param = "sort", (
                     group_bucket, agg_impl, topn_k,
                     ccap if isinstance(ccap, int) else None)
+            ec = copr._host_cache.get(ecapk)
+            ecap = ec if isinstance(ec, int) and ec < cap else None
+            if ecap is not None and agg_kind == "sort":
+                # survivors are already compacted: the late (post-join)
+                # compact stage would re-gather the same buffer
+                agg_param = agg_param[:3] + (None,)
             key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
                                    tuple(dim_caps), tuple(dim_ns),
-                                   tuple(dim_sns), agg_kind, agg_param)
+                                   tuple(dim_sns), agg_kind, agg_param,
+                                   ecap)
             kern = copr._kernel_cache.get(key)
             if kern is None:
                 kern = _build_fused_kernel(
                     plan, cap, fact_sdicts, tuple(dim_caps),
                     tuple(dim_ns), tuple(dim_sns), tuple(dim_layouts),
-                    agg_kind, agg_param, dim_pres)
+                    agg_kind, agg_param, dim_pres, ecap=ecap)
                 kern = copr._kernel_cache.put(key, kern)
             fjc_full, fvv = copr._pad_upload(cols, v, m, cap)
             fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
             res = prefetch(kern(fjc, fvv, dim_args))
+            # early-compaction policy: learn the survivor bucket on
+            # first sight, regrow + rerun on overflow (fnvalid is the
+            # fact-filter survivor count BEFORE any compaction loss, so
+            # an overflowed run is incorrect and must not be consumed)
+            if _compact_policy(copr, ecapk, ecap,
+                               int(res["fnvalid"]), cap) == "retry":
+                continue
             if pos_spec is not None:
                 out.append(_compact_pos_dense(plan, res, pos_spec[0],
                                               pos_spec[1], dim_metas, sd))
@@ -1521,7 +1574,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
 
 
 def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
-                     dim_ns, dim_sns, agg_kind, agg_param):
+                     dim_ns, dim_sns, agg_kind, agg_param, ecap=None):
     dict_vers = [tuple(sorted((cid, len(d.values))
                               for cid, d in fact_tbl.dicts.items()))]
     for meta in dim_metas:
@@ -1545,5 +1598,5 @@ def _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap, dim_caps,
                           for sc in plan.fact_dag.cols))
     return ("fused", fact_tbl.uid, cap, dim_caps, dim_ns, dim_sns, fps,
             dimsig, postfps, gfps, afps, tuple(dict_vers), colsig,
-            agg_kind, agg_param, _segment_impl(),
+            agg_kind, agg_param, ecap, _segment_impl(),
             tuple(bool(m.get("pre")) for m in dim_metas))

@@ -447,31 +447,47 @@ class IndexRangeExec(Executor):
     def open(self):
         pass
 
-    def _scan_index_handles(self, index, low, high, low_inc, high_inc):
+    def _scan_index_handles(self, index, low, high, low_inc, high_inc,
+                            eq_prefix=()):
         """Scan one index KV range at the read ts (memBuffer-merged when
-        the txn is dirty); -> (handles, dirty, txn)."""
+        the txn is dirty); -> (handles, dirty, txn). eq_prefix: constant
+        values for the index's leading columns; the range (if any)
+        applies to the column after them — together they encode to one
+        contiguous memcomparable key interval (reference
+        ranger/detacher.go point-prefix x interval composition)."""
         from ..codec.tablecodec import index_prefix, index_key_handle
         from ..codec.codec import encode_datums_key
         from .exec_base import expr_to_datum, coerce_datum
         tbl = self.plan.table_info
         sess = self.ctx.sess
-        ci = tbl.find_column(index.columns[0])
         pref = index_prefix(tbl.id, index.id)
         from .table_rt import fold_ci_datums
 
-        def probe_datum(e):
+        def probe_datums(exprs):
             # _ci index KV stores the collation normal form: probe
-            # constants must fold the same way or exact matches miss
-            d = coerce_datum(expr_to_datum(e), ci.ft)
-            return fold_ci_datums(tbl, index, [d])[0]
-        lo = pref
+            # constants must fold the same way or exact matches miss.
+            # each value coerces to ITS index column's type
+            ds = []
+            for off, e in enumerate(exprs):
+                ci = tbl.find_column(index.columns[off])
+                ds.append(coerce_datum(expr_to_datum(e), ci.ft))
+            return fold_ci_datums(tbl, index, ds)
+        epfx = b""
+        if eq_prefix:
+            epfx = encode_datums_key(probe_datums(eq_prefix))
+        np_ = len(eq_prefix)
+
+        def range_datum(e):
+            # folded at position np_ (the first non-eq index column)
+            return probe_datums(list(eq_prefix) + [e])[np_]
+        lo = pref + epfx
         if low is not None:
-            lo = pref + encode_datums_key([probe_datum(low)])
+            lo = pref + epfx + encode_datums_key([range_datum(low)])
             if not low_inc:
                 lo += b"\xff"
-        hi = pref + b"\xff" * 9
+        hi = pref + epfx + b"\xff" * 9
         if high is not None:
-            hi = pref + encode_datums_key([probe_datum(high)])
+            hi = pref + epfx + encode_datums_key([range_datum(high)])
             hi = hi + (b"\xff" * 9 if high_inc else b"")
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
@@ -493,7 +509,8 @@ class IndexRangeExec(Executor):
     def _collect_handles(self):
         p = self.plan
         return self._scan_index_handles(p.index, p.low, p.high,
-                                        p.low_inc, p.high_inc)
+                                        p.low_inc, p.high_inc,
+                                        getattr(p, "prefix", ()))
 
     def next(self):
         if self._done:
@@ -568,19 +585,25 @@ class IndexRangeExec(Executor):
         dag = CoprDAG(table_info=self.plan.table_info,
                       db_name=self.plan.db_name, cols=self.plan.cols,
                       host_filters=list(self.plan.residual))
-        # re-apply the range as filters
+        # re-apply the prefix equalities + range as filters
         from ..expression import ScalarFunc
         from ..types.field_type import new_bigint_type
-        col = next(sc.col for sc in self.plan.cols
-                   if sc.name == self.plan.index.columns[0].lower())
+
+        def col_at(off):
+            return next(sc.col for sc in self.plan.cols
+                        if sc.name == self.plan.index.columns[off].lower())
+        for off, v in enumerate(getattr(self.plan, "prefix", ())):
+            dag.host_filters.append(ScalarFunc(
+                "=", [col_at(off), v], new_bigint_type()))
+        rng_off = len(getattr(self.plan, "prefix", ()))
         if self.plan.low is not None:
             dag.host_filters.append(ScalarFunc(
-                ">=" if self.plan.low_inc else ">", [col, self.plan.low],
-                new_bigint_type()))
+                ">=" if self.plan.low_inc else ">",
+                [col_at(rng_off), self.plan.low], new_bigint_type()))
         if self.plan.high is not None:
             dag.host_filters.append(ScalarFunc(
-                "<=" if self.plan.high_inc else "<", [col, self.plan.high],
-                new_bigint_type()))
+                "<=" if self.plan.high_inc else "<",
+                [col_at(rng_off), self.plan.high], new_bigint_type()))
         chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
         return Chunk.concat_all(chunks) or Chunk.empty(
             [sc.col.ft for sc in self.schema.cols])

@@ -83,7 +83,9 @@ def fold_ci_datums(tbl, idx, datums):
     from ..expression.vec import _is_ci, _coll_arg
     name_to_col = {c.name.lower(): c for c in tbl.columns}
     out = list(datums)
-    for i, cname in enumerate(idx.columns):
+    # datums may cover only a leading prefix of the index's columns
+    # (composite range probes): fold just the provided positions
+    for i, cname in enumerate(idx.columns[:len(out)]):
         ci = name_to_col.get(cname.lower())
         d = out[i]
         if ci is not None and d is not None and not d.is_null and \

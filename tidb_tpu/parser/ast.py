@@ -448,6 +448,7 @@ class ColumnDef(Node):
     has_default: bool = False
     comment: str = ""
     collate: str = ""
+    charset: str = ""
     generated: str = ""          # stored generated column expr text
     enum_vals: list = field(default_factory=list)
     position: object = None      # None | "first" | ("after", col)

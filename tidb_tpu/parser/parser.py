@@ -1198,6 +1198,17 @@ class Parser:
                 return
             self.next()
 
+    def _column_charset(self, cd, cs):
+        # record the charset (DDL must not override an explicit column
+        # charset with the table-level default) and map it to its
+        # MySQL default collation (reference pkg/parser/charset)
+        from ..utils.charsets import CHARSET_DEFAULT_COLLATE
+        cd.charset = cs
+        if not cd.collate:
+            dflt = CHARSET_DEFAULT_COLLATE.get(cs)
+            if dflt is not None:
+                cd.collate = dflt
+
     def parse_column_def(self) -> ast.ColumnDef:
         name = self.ident()
         tname = self.ident().lower()
@@ -1248,10 +1259,10 @@ class Parser:
             elif self.at_kw("character"):
                 self.next()
                 self.expect_kw("set")
-                self.next()
+                self._column_charset(cd, self.next().text.lower())
             elif self.at_kw("charset"):
                 self.next()
-                self.next()
+                self._column_charset(cd, self.next().text.lower())
             elif self.at_kw("as") and self.peek(1).kind == "OP" and \
                     self.peek(1).text == "(":
                 self.next()

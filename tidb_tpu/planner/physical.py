@@ -404,16 +404,21 @@ class PhysFusedPipeline(PhysPlan):
 
 class PhysIndexRange(PhysPlan):
     """Index range scan -> handle gather (reference IndexReader/IndexLookUp
-    executor/distsql.go; single-column leading prefix ranges, round 1)."""
+    executor/distsql.go). Composite ranges compose an equality PREFIX
+    over the index's leading columns with one range on the next column
+    (reference ranger/detacher.go:1033 DetachCondAndBuildRangeForIndex):
+    index (a, b, c) with a=1 AND b=2 AND c>5 scans
+    [enc(1,2,5)..enc(1,2,+inf))."""
 
     def __init__(self, table_info, db_name, cols, index, low, high,
-                 low_inc, high_inc, residual, schema):
+                 low_inc, high_inc, residual, schema, prefix=()):
         super().__init__([], schema)
         self.table_info = table_info
         self.db_name = db_name
         self.cols = cols
         self.index = index
-        self.low = low          # Constant|None
+        self.prefix = list(prefix)   # [Constant] leading = values
+        self.low = low          # Constant|None (on column len(prefix))
         self.high = high
         self.low_inc = low_inc
         self.high_inc = high_inc
@@ -422,6 +427,11 @@ class PhysIndexRange(PhysPlan):
     def explain_info(self):
         rng = f"{'[' if self.low_inc else '('}{self.low!r}, " \
               f"{self.high!r}{']' if self.high_inc else ')'}"
+        if self.prefix:
+            eqs = ", ".join(map(repr, self.prefix))
+            rng = f"[{eqs}] x {rng}" if (
+                self.low is not None or self.high is not None) \
+                else f"[{eqs}]"
         return (f"table:{self.table_info.name}, index:{self.index.name}, "
                 f"range:{rng}")
 
@@ -803,48 +813,77 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
 
 
 def _try_index_range(ds: DataSource) -> PhysPlan | None:
-    """Range conds on a single-column index -> index range scan, when the
-    table is fully KV-backed and the range is selective."""
+    """Range/point conds composed over an index's column prefix ->
+    index range scan, when the table is fully KV-backed and the range
+    is selective (reference ranger/detacher.go:1033: point-prefix x one
+    interval; later index columns after the interval cannot constrain
+    the key range and stay residual)."""
     tbl = ds.table_info
     if tbl.id < 0 or tbl.partitions or not ds.pushed_conds or \
             getattr(ds, "bulk_only", False):
         return None
-    stats_rows = getattr(ds, "stats_rows", 0)
-    base_rows = None
-    # selective enough? (post-selectivity estimate vs a fraction)
-    indexed_cols = {}
-    for idx in tbl.public_indexes():
-        if len(idx.columns) >= 1:
-            indexed_cols.setdefault(idx.columns[0].lower(), idx)
-    low = high = None
-    low_inc = high_inc = True
-    target_idx = None
-    residual = []
+    # per-column simple conds: name -> [(op, Constant, cond)]
+    by_col = {}
     for c in ds.pushed_conds:
-        used = False
         if isinstance(c, ScalarFunc) and len(c.args) == 2 and \
                 isinstance(c.args[0], Column) and \
                 isinstance(c.args[1], Constant) and \
                 c.op in ("=", "<", "<=", ">", ">="):
             name = getattr(ds, "col_name_of", {}).get(c.args[0].idx, "")
-            idx = indexed_cols.get(name.lower())
-            if idx is not None and (target_idx is None or idx is target_idx):
-                target_idx = idx
-                v = c.args[1]
-                if c.op == "=":
-                    low = high = v
-                elif c.op in (">", ">="):
-                    low, low_inc = v, c.op == ">="
-                else:
-                    high, high_inc = v, c.op == "<="
-                used = True
-        if not used:
-            residual.append(c)
-    if target_idx is None or (low is None and high is None):
+            by_col.setdefault(name.lower(), []).append((c.op, c.args[1], c))
+    if not by_col:
         return None
+    best = None     # (n_prefix, has_range, index, prefix, lo..hi, used)
+    for idx in tbl.public_indexes():
+        prefix, used = [], []
+        low = high = None
+        low_inc = high_inc = True
+        for col in idx.columns:
+            conds = by_col.get(col.lower())
+            if not conds:
+                break
+            eq = next((t for t in conds if t[0] == "="), None)
+            if eq is not None:
+                # only the encoded cond counts as used: a second,
+                # conflicting cond on the same column (a=3 AND a=4,
+                # a=3 AND a>5) must stay residual or wrong rows return
+                prefix.append(eq[1])
+                used.append(eq[2])
+                continue
+            # first non-eq column: one lower + one upper bound encode;
+            # any further range conds stay residual
+            for op, v, cond in conds:
+                if op in (">", ">=") and low is None:
+                    low, low_inc = v, op == ">="
+                    used.append(cond)
+                elif op in ("<", "<=") and high is None:
+                    high, high_inc = v, op == "<="
+                    used.append(cond)
+            break
+        if not used:
+            continue
+        has_range = low is not None or high is not None
+        cand = (len(prefix), has_range, idx, prefix, low, high,
+                low_inc, high_inc, used)
+        if best is None or (cand[0], cand[1]) > (best[0], best[1]):
+            best = cand
+    if best is None:
+        return None
+    n_prefix, has_range, target_idx, prefix, low, high, \
+        low_inc, high_inc, used = best
+    if not has_range and n_prefix == 0:
+        return None
+    used_ids = {id(c) for c in used}
+    residual = [c for c in ds.pushed_conds if id(c) not in used_ids]
+    # the prefix equality on a column with range conds too (a=1 and a>0):
+    # unused extra conds stay residual via used_ids filtering above
+    if not has_range:
+        low = high = None
+        low_inc = high_inc = True
     cols = getattr(ds, "used_cols", None) or list(ds.schema.cols)
     return PhysIndexRange(tbl, ds.db_name, cols, target_idx, low, high,
-                          low_inc, high_inc, residual, Schema(list(cols)))
+                          low_inc, high_inc, residual, Schema(list(cols)),
+                          prefix=prefix)
 
 
 def _flatten_or(c, out):

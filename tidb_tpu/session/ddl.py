@@ -119,6 +119,22 @@ class DDLExecutor:
         if "as_select" in stmt.options:
             return self._create_table_as(stmt, db_name)
 
+        # table-level CHARSET default collation flows to string columns
+        # without their own charset OR collation (reference ddl: column
+        # charset resolution; gbk/gb18030 must not silently sort as
+        # utf8 — but an explicit column CHARACTER SET wins)
+        from ..utils.charsets import CHARSET_DEFAULT_COLLATE
+        tbl_cs = str(stmt.options.get("charset", "")).lower()
+        tbl_coll = CHARSET_DEFAULT_COLLATE.get(tbl_cs)
+        tbl_coll = str(stmt.options.get("collate", "") or tbl_coll or "")
+        if tbl_coll:
+            for cd in stmt.columns:
+                if not cd.collate and not cd.charset and \
+                        cd.type_name.lower() in (
+                            "char", "varchar", "text", "tinytext",
+                            "mediumtext", "longtext", "enum", "set"):
+                    cd.collate = tbl_coll
+
         def fn(m):
             db = self._db_by_name(m, db_name)
             for t in m.list_tables(db.id):

@@ -1,0 +1,9 @@
+"""Charset registry bits shared by the parser and DDL (reference
+pkg/parser/charset): the default collation for charsets whose default
+is NOT the engine-wide utf8mb4 one. Kept dependency-free — the parser
+imports this and must stay light (no jax)."""
+
+CHARSET_DEFAULT_COLLATE = {
+    "gbk": "gbk_chinese_ci",
+    "gb18030": "gb18030_chinese_ci",
+}
