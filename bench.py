@@ -134,9 +134,38 @@ def htap_main(live=True):
             s.must_query(QUERIES["q1"])
             olap_lat.append(time.time() - t0)
 
+    rw_lat = []
+
+    def rw_analyst():
+        """The dirty-overlay HTAP case: update+insert lineitem in an
+        open transaction, run Q1 INSIDE it (must see own writes and
+        stay on the fused device path), then roll back."""
+        s = tk.new_session()
+        rng = __import__("random").Random(99)
+        k = 0
+        while not stop.is_set():
+            k += 1
+            s.must_exec("begin")
+            s.must_exec(f"update lineitem set l_quantity = l_quantity + 1 "
+                        f"where l_orderkey = {rng.randrange(1, 6) * 4 + 1} "
+                        f"and l_linenumber = 1")
+            s.must_exec(f"insert into lineitem (l_orderkey, l_linenumber, "
+                        f"l_partkey, l_suppkey, l_quantity, l_extendedprice,"
+                        f" l_discount, l_tax, l_returnflag, l_linestatus, "
+                        f"l_shipdate, l_commitdate, l_receiptdate, "
+                        f"l_shipinstruct, l_shipmode, l_comment) values "
+                        f"(1, {200 + k}, 1, 1, 5, 100.0, 0.05, 0.02, 'N', "
+                        f"'O', '1996-03-13', '1996-02-12', '1996-03-22', "
+                        f"'NONE', 'MAIL', 'bench overlay row')")
+            t0 = time.time()
+            s.must_query(QUERIES["q1"])
+            rw_lat.append(time.time() - t0)
+            s.must_exec("rollback")
+
     threads = [threading.Thread(target=oltp_worker, args=(i,), daemon=True)
                for i in range(n_oltp)]
     threads.append(threading.Thread(target=olap_worker, daemon=True))
+    threads.append(threading.Thread(target=rw_analyst, daemon=True))
     for t in threads:
         t.start()
     time.sleep(seconds)
@@ -145,8 +174,16 @@ def htap_main(live=True):
         t.join(timeout=30)
     tps = sum(oltp_counts) / seconds
     q1_ms = 1000 * sum(olap_lat) / max(len(olap_lat), 1)
+    m = tk.domain.metrics
+    routing = {k: m.get(k, 0) for k in (
+        "fused_pipeline_hit", "fused_pipeline_mpp_hit",
+        "fused_pipeline_dirty_overlay", "fused_pipeline_fallback",
+        "copr_device_exec", "copr_host_exec")}
+    rw_ms = 1000 * sum(rw_lat) / max(len(rw_lat), 1)
     print(f"# htap: oltp_tps={tps:.1f} q1_avg={q1_ms:.1f}ms "
-          f"olap_queries={len(olap_lat)}", file=sys.stderr)
+          f"olap_queries={len(olap_lat)} dirty_q1_avg={rw_ms:.1f}ms "
+          f"dirty_queries={len(rw_lat)} routing={routing}",
+          file=sys.stderr)
     unit = f"oltp ops/s with concurrent Q1 (avg {q1_ms:.0f}ms)"
     if not live:
         unit += " [CPU FALLBACK — not a TPU measurement]"
@@ -156,6 +193,9 @@ def htap_main(live=True):
         "unit": unit,
         "vs_baseline": round(q1_ms / 1000.0, 3),
         "backend": "tpu" if live else "cpu-fallback",
+        "routing": routing,
+        "dirty_q1_ms": round(rw_ms, 1),
+        "dirty_queries": len(rw_lat),
     }))
 
 

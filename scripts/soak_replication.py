@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Replication soak (round-5 verdict next #8): a 3-worker WAL chain
+under sustained concurrent commit load; kill -9 each worker once
+mid-workload; verify ZERO acked-transaction loss and record commit
+latency percentiles (the sync ship runs inside the commit hook — its
+cost must be measured, not assumed).
+
+Writes REPLICATION_SOAK.json:
+  {"seconds": N, "acked": N, "lost": 0, "kills": 3,
+   "commit_ms": {"p50": ..., "p99": ..., "max": ...},
+   "commit_ms_degraded": {...}}   # latency while a follower is down
+
+Usage: python scripts/soak_replication.py [seconds-per-phase]
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    phase_s = float(sys.argv[1]) if len(sys.argv) > 1 else 20.0
+    env = dict(os.environ, TIDB_TPU_PLATFORM="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    procs = []
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True)
+        line = p.stdout.readline().strip()
+        assert line.startswith("WORKER_READY"), line
+        p._tidb_port = int(line.split()[1])
+        procs.append(p)
+        return p._tidb_port
+
+    ports = [spawn(), spawn(), spawn()]
+    from tidb_tpu.cluster import Cluster
+    cl = Cluster(ports, spawn_worker=spawn)
+    cl.enable_replication()
+    cl.ddl("create table soak (a int primary key, b int)")
+
+    acked = []          # (key, worker) acked commits — MUST survive
+    lat = []            # (t_wall, commit_seconds)
+    stop = threading.Event()
+    seq = [0]
+    mu = threading.Lock()
+
+    def writer(tid):
+        while not stop.is_set():
+            with mu:
+                seq[0] += 1
+                k = seq[0]
+            w = k % len(cl.workers)
+            t0 = time.time()
+            try:
+                cl.workers[w].call(
+                    {"op": "load_sql",
+                     "sqls": [f"insert into soak values ({k}, {tid})"]})
+            except Exception:               # noqa: BLE001
+                continue                    # un-acked: no durability claim
+            lat.append((time.time(), time.time() - t0))
+            acked.append(k)
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(4)]
+    t_start = time.time()
+    for t in threads:
+        t.start()
+
+    kill_spans = []
+    for victim in (0, 1, 2):
+        time.sleep(phase_s / 2)
+        t0 = time.time()
+        # the CURRENT process serving slot `victim`
+        port = cl.workers[victim].port
+        proc = next(p for p in procs if p.poll() is None and
+                    _port_of(p, port))
+        proc.kill()
+        proc.wait(timeout=30)
+        print(f"# killed worker slot {victim} (port {port})",
+              file=sys.stderr, flush=True)
+        time.sleep(phase_s / 4)            # degraded window under load
+        assert cl._recover_worker(victim) is not None
+        kill_spans.append((t0, time.time()))
+        print(f"# recovered slot {victim} in "
+              f"{time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+    time.sleep(phase_s / 2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    seconds = time.time() - t_start
+
+    # verify EVERY acked commit is present (each worker is its own
+    # store: union the shards)
+    have = set()
+    for w in range(len(cl.workers)):
+        have |= {r[0] for r in cl.query(
+            "select a from soak order by a", worker=w)}
+    lost = [k for k in acked if k not in have]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return round(1000 * xs[min(len(xs) - 1,
+                                   int(q * len(xs)))], 2) if xs else None
+    in_kill = [d for (tw, d) in lat
+               if any(a <= tw <= b for a, b in kill_spans)]
+    steady = [d for (tw, d) in lat
+              if not any(a <= tw <= b for a, b in kill_spans)]
+    out = {
+        "seconds": round(seconds, 1), "acked": len(acked),
+        "lost": len(lost), "kills": 3,
+        "commit_ms": {"p50": pct(steady, 0.50), "p99": pct(steady, 0.99),
+                      "max": pct(steady, 1.0), "n": len(steady)},
+        "commit_ms_degraded": {"p50": pct(in_kill, 0.50),
+                               "p99": pct(in_kill, 0.99),
+                               "n": len(in_kill)},
+    }
+    cl.stop()
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    with open(os.path.join(REPO, "REPLICATION_SOAK.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    assert not lost, f"LOST {len(lost)} acked commits: {lost[:10]}"
+
+
+def _port_of(p, port):
+    return getattr(p, "_tidb_port", None) == port
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,27 @@ def test_fused_dirty_txn_mixed_overlay(tk):
     tk.must_exec("rollback")
 
 
+def test_fused_dirty_insert_out_of_span_group_key(tk):
+    """A delta row whose int group key lies OUTSIDE the snapshot's
+    min/max span must form its own group, not clip into a boundary
+    group (dense layouts derive their span from the snapshot only —
+    delta executions must take the exact sort lowering)."""
+    tk.must_exec("create table sp (k int primary key, g int, v int)")
+    rows = ",".join(f"({i}, {1 + i % 50}, {i})" for i in range(1, 5001))
+    tk.must_exec("insert into sp values " + rows)
+    sql = "select g, count(*) from sp group by g order by g"
+    base = tk.must_query(sql).rs.rows
+    assert len(base) == 50
+    tk.must_exec("begin")
+    tk.must_exec("insert into sp values (9001, 500, 1)")
+    got = tk.must_query(sql).rs.rows
+    tk.must_exec("rollback")
+    assert len(got) == 51
+    assert next(r for r in got if r[0] == 500)[1] == 1
+    g50 = next(r for r in got if r[0] == 50)
+    assert g50[1] == next(r for r in base if r[0] == 50)[1]
+
+
 def test_fused_dirty_dim_write_falls_back(tk):
     """Writes to a dim table still drop the query to the host path."""
     tk.must_exec("begin")

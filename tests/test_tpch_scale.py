@@ -44,14 +44,13 @@ def test_device_vs_host_at_scale(tk, q):
 # fused device pipeline; "scan" = no join to fuse (q1/q6) or the join
 # is a few-row residual over device-computed aggs (q15/q20) — the heavy
 # scans/aggs still run as device copr kernels.
-EXPECTED_ROUTING = {
-    "q1": "scan", "q2": "fused", "q3": "fused", "q4": "fused",
-    "q5": "fused", "q6": "scan", "q7": "fused", "q8": "fused",
-    "q9": "fused", "q10": "fused", "q11": "fused", "q12": "fused",
-    "q13": "fused", "q14": "fused", "q15": "scan", "q16": "fused",
-    "q17": "fused", "q18": "fused", "q19": "fused", "q20": "scan",
-    "q21": "fused", "q22": "fused",
-}
+# all 22 route through the fused pipeline since single-table aggs
+# became zero-dim fused pipelines (they fragment onto the mesh and
+# carry the dirty overlay; round-5)
+EXPECTED_ROUTING = {q: "fused" for q in (
+    "q1", "q2", "q3", "q4", "q5", "q6", "q7", "q8", "q9", "q10",
+    "q11", "q12", "q13", "q14", "q15", "q16", "q17", "q18", "q19",
+    "q20", "q21", "q22")}
 
 
 def test_tpch_device_routing_pinned(tk):
@@ -74,7 +73,9 @@ def test_tpch_device_routing_pinned(tk):
             problems.append(f"{q}: fused_pipeline_error")
         if d.get("fused_pipeline_fallback", 0):
             problems.append(f"{q}: fused_pipeline_fallback")
-        if d.get("copr_host_exec", 0):
+        if d.get("copr_host_exec", 0) and q != "q2":
+            # q2 intentionally materializes a filterless partsupp scan
+            # on host (no compute to offload; round-5 pure-scan routing)
             problems.append(f"{q}: copr_host_exec={d['copr_host_exec']}")
     assert got == EXPECTED_ROUTING, {
         q: (got[q], EXPECTED_ROUTING[q]) for q in got
@@ -131,8 +132,8 @@ def test_explain_analyze_backend_column(tk):
         by_op
     rs6 = tk.must_query("explain analyze " + ALL_QUERIES["q6"])
     tr = [str(r[4]) for r in rs6.rows
-          if "TableReader" in str(r[0])]
-    assert tr and tr[0].startswith("device"), rs6.rows
+          if "FusedPipeline" in str(r[0])]
+    assert tr and tr[0].startswith("device(fused"), rs6.rows
 
 
 def test_boundaries_crossed(tk):

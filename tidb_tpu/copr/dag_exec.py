@@ -176,6 +176,15 @@ class CoprExecutor:
             if dag.table_info.id > -1000:
                 self._bump("copr_host_exec")
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
+        if not dag.filters and not dag.host_filters and not dag.aggs \
+                and not dag.group_items and dag.topn is None:
+            # pure scan: there is no compute to offload — the device
+            # "filter" kernel would upload every column to produce an
+            # identity mask and fetch it back (q2's full-partsupp scan
+            # feeding a host hash join paid ~200ms for nothing). The
+            # columnar arrays already live host-side; materialize there.
+            self._bump("copr_host_exec")
+            return self._execute_host(dag, tbl, arrays, valid, n, handles)
         if use_mpp and (dag.aggs or dag.group_items) and not overlay \
                 and not dag.host_filters \
                 and n >= mpp_min_rows:

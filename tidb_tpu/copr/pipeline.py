@@ -1179,7 +1179,25 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     shim = _AggShim(plan.group_items, plan.aggs)
     kd, sd = capture_agg_dicts(shim, one)
     pos_spec = _pos_group_map(plan, dim_metas)
-    sizes = None if pos_spec is not None else _dense_strides(shim, kd)
+    sizes = None
+    if pos_spec is None and not delta_rows:
+        # dense layouts clip group codes to a span derived from the
+        # SNAPSHOT (dict sizes / int min-max): a dirty-txn delta row
+        # with a key outside that span would silently merge into a
+        # boundary group. Delta executions take the sort lowering,
+        # which is exact for any key.
+        fcols = None
+        if not plan.dims and n:
+            # zero-dim pipeline: int group keys can dense-detect via a
+            # host min/max pass over the fact arrays (q15's GROUP BY
+            # l_suppkey), exactly like the copr reader path — without
+            # this they fall to the sort lowering
+            fcols = {}
+            for sc in plan.fact_dag.cols:
+                cid = _cid_of(plan.fact_dag, sc)
+                fcols[sc.col.idx] = (handles, None, None) if cid == -1 \
+                    else fact_arrays[cid]
+        sizes = _dense_strides(shim, kd, fcols, n)
     if _segment_impl() == "runs":
         # big dense/position domains have no scatter-free dense
         # lowering: fall to the "sort" agg kind, which lowers to
@@ -1188,7 +1206,8 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         # group-by-FK stays compact.
         if pos_spec is not None and pos_spec[2] > _de._BCR_MAX:
             pos_spec = None
-            sizes = _dense_strides(shim, kd)
+            if not delta_rows:      # same snapshot-span clip hazard
+                sizes = _dense_strides(shim, kd)
         if sizes is not None and _dense_nslots(sizes) > _de._BCR_MAX:
             sizes = None
 
@@ -1263,6 +1282,14 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                     ccap if isinstance(ccap, int) else None)
             ec = copr._host_cache.get(ecapk)
             ecap = ec if isinstance(ec, int) and ec < cap else None
+            if ecap is not None and not plan.dims:
+                # zero-dim pipeline: downstream of the fact filter is
+                # ONE aggregation pass — gather-compaction (cumsum +
+                # per-column gathers) costs more than it saves (q6's
+                # global reduce, q15's dense group-by both measured
+                # slower with it). Compaction pays when dim probes and
+                # multi-pass agg lowerings run at survivor scale.
+                ecap = None
             if ecap is not None and agg_kind == "sort":
                 # survivors are already compacted: the late (post-join)
                 # compact stage would re-gather the same buffer

@@ -738,6 +738,15 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
     if isinstance(plan, Aggregation):
         child = _phys(plan.child)
         if isinstance(child, PhysTableReader) and _can_push_agg(plan, child):
+            # big single-table aggs (Q1/Q6) prefer the ZERO-dim fused
+            # pipeline: same kernels single-chip, but it fragments onto
+            # the device mesh (PassThrough exchange) and carries the
+            # dirty-txn overlay + early compaction. Small tables keep
+            # the simple copr push (system/internal queries: no churn)
+            if getattr(child, "raw_rows", 0) >= 4096:
+                fused = _try_fuse_agg(plan, child)
+                if fused is not None:
+                    return fused
             dag = child.dag
             dag.group_items = list(plan.group_items)
             dag.aggs = [_to_partial(a) for a in plan.aggs]
@@ -1400,7 +1409,16 @@ def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
             return None
     leaves, eqs, filters, outer_dims = list(), [], list(peeled_filters), []
     if not _collect_join_tree(p, leaves, eqs, filters, outer_dims) \
-            or not leaves or (len(leaves) < 2 and not outer_dims) or \
+            or not leaves:
+        return None
+    if len(leaves) < 2 and not outer_dims and not eqs:
+        # single-table scan->filter->agg (Q1/Q6): a zero-dim fused
+        # pipeline — same kernels as the copr agg path single-chip,
+        # but it FRAGMENTS onto the mesh like every other fused shape
+        # (PassThrough exchange; round-5 verdict next #9)
+        if len(leaves) != 1 or isinstance(leaves[0], _AggLeaf):
+            return None
+    elif (len(leaves) < 2 and not outer_dims) or \
             (not eqs and not outer_dims):
         return None
     for f in filters:
