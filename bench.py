@@ -263,6 +263,13 @@ def main():
     n_rows = tk.domain.columnar.tables[li.id].live_count()
     print(f"# lineitem rows={n_rows} load={load_s:.1f}s", file=sys.stderr)
 
+    def peak_rss_gb():
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KB, darwin reports bytes
+        div = (1 << 30) if sys.platform == "darwin" else (1 << 20)
+        return round(rss / div, 2)
+
     from tidb_tpu.utils import phase as _phase
     phases = {}
 
@@ -371,6 +378,8 @@ def main():
         "unit": unit,
         "vs_baseline": round(geo, 3),
         "backend": "tpu" if live else "cpu-fallback",
+        "load_s": round(load_s, 1),
+        "peak_rss_gb": peak_rss_gb(),
         "queries": per_query,
     }))
 

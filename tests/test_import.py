@@ -99,3 +99,93 @@ def test_bulk_table_index_range_falls_back(tk, tmp_path):
     # include the bulk rows
     tk.must_exec("analyze table bir")
     tk.must_query("select count(*) from bir where k >= 980").check([(3,)])
+
+
+def _mk_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from decimal import Decimal
+    import datetime as dt
+    t = pa.table({
+        "a": pa.array([1, 2, 3], pa.int64()),
+        "f": pa.array([7.5, -1.25, 0.0], pa.float64()),
+        "d": pa.array([Decimal("12.34"), Decimal("0.05"),
+                       Decimal("-3.30")], pa.decimal128(10, 2)),
+        "dt": pa.array([dt.date(1994, 2, 3), dt.date(1999, 12, 31),
+                        dt.date(1970, 1, 1)], pa.date32()),
+        "s": pa.array(["hello", "world", "hello"], pa.string()),
+        "ts": pa.array([dt.datetime(1994, 2, 3, 10, 20, 30),
+                        dt.datetime(1999, 12, 31, 23, 59, 59),
+                        dt.datetime(1970, 1, 1)], pa.timestamp("us")),
+    })
+    p = tmp_path / "data.parquet"
+    pq.write_table(t, str(p))
+    return str(p)
+
+
+def test_import_parquet(tk, tmp_path):
+    """Parquet IMPORT INTO (reference pkg/dumpformat/parquetfile +
+    lightning parquet reader): arrow date32/timestamp/decimal128 map
+    exactly onto the engine's day/micro/scaled-int representations."""
+    pytest.importorskip("pyarrow")
+    tk.must_exec("create table imp (a int, f double, d decimal(10,2), "
+                 "dt date, s varchar(20), ts datetime)")
+    p = _mk_parquet(tmp_path)
+    tk.must_exec(f"import into imp from '{p}'")
+    tk.must_query("select * from imp order by a").check([
+        (1, 7.5, "12.34", "1994-02-03", "hello", "1994-02-03 10:20:30"),
+        (2, -1.25, "0.05", "1999-12-31", "world", "1999-12-31 23:59:59"),
+        (3, 0, "-3.30", "1970-01-01", "hello", "1970-01-01 00:00:00"),
+    ])
+    # imported rows aggregate on the device path like any bulk rows
+    assert tk.must_query(
+        "select s, count(*) from imp group by s order by s").rs.rows == \
+        [("hello", 2), ("world", 1)]
+
+
+def test_import_parquet_pk_dedup(tk, tmp_path):
+    """Clustered-PK parquet import takes PK handles + duplicate
+    detection, same as the CSV path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    tk.must_exec("create table ppk (k bigint primary key, v int)")
+    tk.must_exec("insert into ppk values (2, 99)")
+    t = pa.table({"k": pa.array([1, 2, 3], pa.int64()),
+                  "v": pa.array([10, 20, 30], pa.int64())})
+    p = str(tmp_path / "pk.parquet")
+    pq.write_table(t, p)
+    import pytest as _pt
+    from tidb_tpu.errors import TiDBError
+    with _pt.raises(TiDBError):
+        tk.must_exec(f"import into ppk from '{p}'")
+    r = tk.must_exec(f"import into ppk from '{p}' "
+                     f"with on_duplicate = skip")
+    assert r.affected == 2 and r.skipped == 1
+    assert tk.must_query("select v from ppk where k = 2").rs.rows == \
+        [(99,)]
+
+
+def test_import_parquet_null_rejected(tk, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from tidb_tpu.errors import TiDBError
+    tk.must_exec("create table pnull (a int, s varchar(8))")
+    t = pa.table({"a": pa.array([1, None], pa.int64()),
+                  "s": pa.array(["x", None], pa.string())})
+    p = str(tmp_path / "n.parquet")
+    pq.write_table(t, p)
+    with pytest.raises(TiDBError):
+        tk.must_exec(f"import into pnull from '{p}'")
+
+
+def test_import_parquet_by_position_when_names_differ(tk, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    tk.must_exec("create table ppos (a int, b int)")
+    t = pa.table({"c0": pa.array([1, 2], pa.int64()),
+                  "c1": pa.array([10, 20], pa.int64())})
+    p = str(tmp_path / "pos.parquet")
+    pq.write_table(t, p)
+    tk.must_exec(f"import into ppos from '{p}'")
+    assert tk.must_query("select a, b from ppos order by a").rs.rows == \
+        [(1, 10), (2, 20)]

@@ -111,7 +111,12 @@ def test_device_path_never_pathologically_slower(tk):
             host = _best_of(2, lambda: tk.must_query(sql))
         finally:
             tk.domain.copr.use_device = True
-        if dev > max(2.0 * host, host + 0.25):
+        # the absolute slack only absorbs scheduler noise at tiny SFs
+        # where every query is milliseconds; above SF0.2 it would make
+        # the fence vacuous (round-4 verdict weak #3) — there 2x alone
+        # must hold
+        slack = 0.25 if SF <= 0.2 else 0.0
+        if dev > max(2.0 * host, host + slack):
             violations[q] = f"device {dev * 1e3:.0f}ms vs host " \
                             f"{host * 1e3:.0f}ms"
     assert not violations, violations

@@ -169,7 +169,12 @@ def _save_progress(domain, tbl, path, ckpt, chunk_rows, ctab, total):
 
 def _parse_source(stmt, path, cols, ctab, delim):
     """-> ({col name -> full array}, n) via the native C++ loader when
-    eligible, else the Python csv fallback."""
+    eligible, else the Python csv fallback; .parquet files read through
+    pyarrow (reference pkg/dumpformat/parquetfile + lightning mydump
+    parquet readers)."""
+    fmt = str(stmt.options.get("format", "")).lower()
+    if fmt == "parquet" or (not fmt and path.endswith(".parquet")):
+        return _parse_parquet(path, cols)
     from ..native import loader as nl
     parsed = None
     if not stmt.options.get("force_python"):
@@ -196,6 +201,97 @@ def _parse_source(stmt, path, cols, ctab, delim):
     n = len(raw[0]) if raw else 0
     for ci, vals in zip(cols, raw):
         columns[ci.name] = convert_text_column(ci.ft, vals)
+    return columns, n
+
+
+def _parse_parquet(path, cols):
+    """Columnar parquet -> engine arrays. Arrow types map directly:
+    date32 == days-since-epoch, timestamps -> micros, decimals scale to
+    the column's fixed-point ints, strings stay object arrays (dict-
+    encoded by bulk_append). Column mapping is decided ONCE for the
+    whole file: by (case-insensitive) name when every table column has
+    a name match, else purely by position — a per-column mix could
+    silently bind one file column twice."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:                        # pragma: no cover
+        raise TiDBError("parquet import needs pyarrow: %s", e)
+    t = pq.read_table(path)
+    by_name = {n.lower(): t.column(i)
+               for i, n in enumerate(t.column_names)}
+    if all(ci.name.lower() in by_name for ci in cols):
+        file_cols = [by_name[ci.name.lower()] for ci in cols]
+    elif t.num_columns >= len(cols):
+        file_cols = [t.column(i) for i in range(len(cols))]
+    else:
+        missing = [ci.name for ci in cols
+                   if ci.name.lower() not in by_name]
+        raise TiDBError(
+            "parquet file has %d columns for %d table columns and no "
+            "name match for %s", t.num_columns, len(cols),
+            ", ".join(missing))
+    columns = {}
+    n = t.num_rows
+
+    def text_fallback(ci, col):
+        return convert_text_column(
+            ci.ft, [str(v) for v in col.to_pylist()])
+
+    for ci, col in zip(cols, file_cols):
+        col = col.combine_chunks()
+        tc = ci.ft.tclass
+        at = col.type
+        if col.null_count and tc not in (TypeClass.STRING,
+                                         TypeClass.JSON):
+            # the bulk columnar format carries no null mask (the CSV
+            # path cannot express NULL either); silent NaN->INT64_MIN
+            # garbage must never load
+            raise TiDBError(
+                "parquet column %r contains NULLs; bulk import "
+                "requires non-null values for non-string columns",
+                ci.name)
+        if tc in (TypeClass.STRING, TypeClass.JSON):
+            vals = col.cast(pa.string()).to_pylist()
+            columns[ci.name] = np.asarray(
+                ["" if v is None else v for v in vals], dtype=object)
+        elif tc == TypeClass.FLOAT:
+            columns[ci.name] = np.asarray(
+                col.cast(pa.float64()).to_numpy(zero_copy_only=False),
+                dtype=np.float64)
+        elif tc == TypeClass.DECIMAL:
+            scale = max(ci.ft.decimal, 0)
+            if pa.types.is_decimal(at):
+                try:
+                    # exact: rescale unscaled ints, no float round-trip
+                    resc = col.cast(pa.decimal128(38, scale))
+                    columns[ci.name] = np.asarray(
+                        [int(v.scaleb(scale).to_integral_exact())
+                         for v in resc.to_pylist()], dtype=np.int64)
+                    continue
+                except pa.ArrowInvalid:
+                    pass        # scale narrowing: round like the
+                                # float path below (MySQL rounds too)
+            f = col.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            columns[ci.name] = np.round(f * (10 ** scale)) \
+                .astype(np.int64)
+        elif tc == TypeClass.DATE:
+            if pa.types.is_date(at):
+                columns[ci.name] = col.cast(pa.date32()) \
+                    .cast(pa.int32()).to_numpy(zero_copy_only=False) \
+                    .astype(np.int64)
+            else:
+                columns[ci.name] = text_fallback(ci, col)
+        elif tc in (TypeClass.DATETIME, TypeClass.TIMESTAMP):
+            if pa.types.is_timestamp(at):
+                columns[ci.name] = col.cast(
+                    pa.timestamp("us")).cast(pa.int64()) \
+                    .to_numpy(zero_copy_only=False)
+            else:
+                columns[ci.name] = text_fallback(ci, col)
+        else:
+            columns[ci.name] = col.cast(pa.int64()) \
+                .to_numpy(zero_copy_only=False).astype(np.int64)
     return columns, n
 
 
