@@ -1,0 +1,241 @@
+"""HANDLER statement execution (reference pkg/parser HandlerStmt;
+MySQL's low-level cursor API over a table or one of its indexes).
+
+Session-scoped cursors: HANDLER t OPEN registers a cursor; READ moves
+it over the table in handle order (no index) or index-key order (named
+index), vectorized: the ordered position sequence is computed once per
+(read-snapshot, index) and the cursor is an offset into it. Comparison
+reads (= / >= / > / <= / <) position by binary search over the packed
+sort keys. WHERE filters returned rows (the cursor scans forward past
+non-matching rows, like MySQL); LIMIT bounds one READ's output.
+
+Reads see the LATEST committed data (MySQL HANDLER ignores the current
+transaction snapshot for InnoDB too — it is a dirty-read interface)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import TiDBError
+from ..chunk.chunk import Chunk
+from ..expression import EvalCtx, eval_bool_mask
+from ..planner.schema import Schema, SchemaCol
+
+
+class _Cursor:
+    __slots__ = ("tbl", "db", "pos", "dir", "version", "index",
+                 "order", "keys", "wkey", "wmask")
+
+    def __init__(self, tbl, db):
+        self.tbl = tbl
+        self.db = db
+        self.pos = -1           # offset into the current order
+        self.dir = 1
+        self.version = None     # (ctab.version, index name) the order
+        self.index = None       # was computed for
+        self.order = None       # row positions in cursor order
+        self.keys = None        # per-index-col arrays in that order
+        self.wkey = None        # WHERE cache: (version, index, fp)
+        self.wmask = None
+
+
+def _handlers(sess):
+    hs = getattr(sess, "_handler_cursors", None)
+    if hs is None:
+        hs = sess._handler_cursors = {}
+    return hs
+
+
+def exec_handler(sess, stmt):
+    from ..session.session import ResultSet
+    name = (stmt.alias or stmt.table.name).lower()
+    hs = _handlers(sess)
+    if stmt.action == "open":
+        db = stmt.table.db or sess.vars.current_db
+        tbl = sess.domain.infoschema().table_by_name(db, stmt.table.name)
+        sess.check_priv("select", db, tbl.name)
+        hs[name] = _Cursor(tbl, db)
+        return ResultSet()
+    if stmt.action == "close":
+        hs.pop(name, None)
+        return ResultSet()
+    cur = hs.get(name)
+    if cur is None:
+        raise TiDBError("Unknown table '%s' in HANDLER", name)
+    return _read(sess, cur, stmt)
+
+
+def _refresh(sess, cur, index_name):
+    """(Re)compute the ordered position sequence when the table version
+    or the requested index changed since the last read."""
+    ctab = sess.domain.columnar.table(cur.tbl)
+    ver = (ctab.version, index_name)
+    if cur.version == ver and cur.order is not None:
+        return ctab
+    read_ts = sess.domain.storage.current_ts()
+    arrays, valid = ctab.snapshot(
+        [c.id for c in cur.tbl.public_columns()], read_ts)
+    live = np.nonzero(valid)[0]
+    keys = None
+    if index_name:
+        idx = next((ix for ix in cur.tbl.public_indexes()
+                    if ix.name.lower() == index_name.lower()), None)
+        if idx is None:
+            raise TiDBError("Key '%s' doesn't exist in table '%s'",
+                            index_name, cur.tbl.name)
+        cols = []
+        for cn in idx.columns:
+            ci = cur.tbl.find_column(cn)
+            data, nulls, _ = arrays[ci.id]
+            d = data[live]
+            sd = ctab.dicts.get(ci.id)
+            if sd is not None:
+                d = sd.ranks()[d]       # code order != string order
+            d = np.asarray(d, dtype=np.int64) \
+                if d.dtype.kind in "iu" else np.asarray(d)
+            if nulls is not None:
+                # NULL keys sort FIRST (MySQL index order); pinned to
+                # int64 min so real-literal searches never land in the
+                # null block
+                nm = nulls[live]
+                if d.dtype.kind in "iu":
+                    d = np.where(nm, np.iinfo(np.int64).min, d)
+                else:
+                    d = np.where(nm, -np.inf, d)
+            cols.append(d)
+        ordr = np.lexsort(tuple(reversed(cols)))
+        cur.order = live[ordr]
+        cur.keys = [c[ordr] for c in cols]
+    else:
+        cur.order = live
+        cur.keys = None
+    cur.version = ver
+    cur.index = index_name
+    cur.pos = -1
+    return ctab
+
+
+def _search_pos(cur, op, vals):
+    """Binary-search the packed key prefix -> (start offset, dir)."""
+    n = len(cur.order)
+    lo, hi = 0, n
+    for kc, v in zip(cur.keys, vals):
+        lo = lo + int(np.searchsorted(kc[lo:hi], v, side="left"))
+        hi = lo + int(np.searchsorted(kc[lo:hi], v, side="right"))
+        if lo >= hi:
+            break
+    if op == "=":
+        return (lo if lo < hi else n), 1, hi
+    if op == ">=":
+        return lo, 1, None
+    if op == ">":
+        return hi, 1, None
+    if op == "<=":
+        return hi - 1, -1, None
+    return lo - 1, -1, None             # "<"
+
+
+def _read(sess, cur, stmt):
+    tbl = cur.tbl
+    ctab = _refresh(sess, cur, stmt.index)
+    n = len(cur.order)
+    eq_end = None
+    if stmt.read_op in ("first", "last"):
+        cur.pos = 0 if stmt.read_op == "first" else n - 1
+        cur.dir = 1 if stmt.read_op == "first" else -1
+    elif stmt.read_op == "next":
+        cur.pos = cur.pos + 1 if cur.pos >= 0 else 0
+        cur.dir = 1
+    elif stmt.read_op == "prev":
+        cur.pos = cur.pos - 1 if cur.pos >= 0 else n - 1
+        cur.dir = -1
+    else:
+        if not cur.keys:
+            raise TiDBError("HANDLER comparison read requires an index")
+        idx = next(ix for ix in tbl.public_indexes()
+                   if ix.name.lower() == stmt.index.lower())
+        if len(stmt.values) > len(idx.columns):
+            raise TiDBError("Too many key parts specified; max %d parts",
+                            len(idx.columns))
+        vals = [_literal_val(sess, v, tbl, idx, i)
+                for i, v in enumerate(stmt.values)]
+        cur.pos, cur.dir, eq_end = _search_pos(cur, stmt.read_op, vals)
+
+    cols_info = tbl.public_columns()
+    out_pos = []
+    where_mask = _where_mask(sess, cur, stmt, ctab) \
+        if stmt.where is not None else None
+    skip = max(getattr(stmt, "offset", 0), 0)
+    pos = cur.pos
+    while 0 <= pos < n and len(out_pos) < stmt.limit:
+        if eq_end is not None and pos >= eq_end:
+            break
+        if where_mask is None or where_mask[pos]:
+            if skip:
+                skip -= 1
+            else:
+                out_pos.append(cur.order[pos])
+        cur.pos = pos           # rest on the last examined row
+        pos += cur.dir
+    from ..chunk.column import Column
+    chunk_cols = []
+    sel = np.asarray(out_pos, dtype=np.int64)
+    for ci in cols_info:
+        chunk_cols.append(ctab.column_for(ci, sel))
+    ch = Chunk(chunk_cols)
+    from ..session.session import ResultSet
+    return ResultSet(chunks=[ch], names=[c.name for c in cols_info])
+
+
+def _where_mask(sess, cur, stmt, ctab):
+    """WHERE over the cursor-ordered rows, vectorized and cached per
+    (table version, index, predicate) — a LIMIT-1 read loop must stay
+    O(rows) overall, not O(rows^2)."""
+    from ..planner.rewriter import Rewriter
+    from ..expression import Column as ECol
+    wkey = (cur.version, repr(stmt.where))
+    if cur.wkey == wkey and cur.wmask is not None:
+        return cur.wmask
+    tbl = cur.tbl
+    cols_info = tbl.public_columns()
+    schema_cols = []
+    cols = {}
+    read_ts = sess.domain.storage.current_ts()
+    arrays, _valid = ctab.snapshot([c.id for c in cols_info], read_ts)
+    pctx = sess._plan_ctx()
+    for ci in cols_info:
+        ec = ECol(pctx.alloc_id(), ci.ft, ci.name)
+        schema_cols.append(SchemaCol(col=ec, name=ci.name))
+        data, nulls, _ = arrays[ci.id]
+        cols[ec.idx] = (data[cur.order],
+                        None if nulls is None else nulls[cur.order],
+                        ctab.dicts.get(ci.id))
+    cond = Rewriter(pctx, Schema(schema_cols)).rewrite(stmt.where)
+    ectx = EvalCtx(np, len(cur.order), cols, host=True)
+    cur.wkey = wkey
+    cur.wmask = np.asarray(eval_bool_mask(ectx, cond))
+    return cur.wmask
+
+
+def _literal_val(sess, expr, tbl, idx, i):
+    """Key literal -> the engine's comparable form for index column i
+    (dict rank for strings, scaled int for decimals, days for dates)."""
+    from .exec_base import expr_to_datum, coerce_datum
+    from ..planner.rewriter import Rewriter
+    import bisect
+    e = Rewriter(sess._plan_ctx(), Schema([])).rewrite(expr)
+    ci = tbl.find_column(idx.columns[i])
+    d = coerce_datum(expr_to_datum(e), ci.ft)
+    if d is None or d.is_null:
+        raise TiDBError("HANDLER key part %d cannot be NULL", i + 1)
+    ctab = sess.domain.columnar.table(tbl)
+    sd = ctab.dicts.get(ci.id)
+    if sd is not None:
+        v = d.val if isinstance(d.val, str) else str(d.val)
+        code = sd.lookup(v)
+        if code >= 0:
+            return int(sd.ranks()[code])
+        # unseen string: its RANK INSERTION POINT minus a half keeps
+        # range reads correct (never equal to any real key, positioned
+        # between the ranks it falls between)
+        return bisect.bisect_left(sorted(sd.values), v) - 0.5
+    return int(d.val) if not isinstance(d.val, float) else d.val

@@ -340,6 +340,21 @@ class ChecksumTableStmt(StmtNode):
 
 
 @dataclass
+class HandlerStmt(StmtNode):
+    """HANDLER t OPEN/READ/CLOSE (reference pkg/parser HandlerStmt;
+    MySQL's low-level cursor interface over a table or index)."""
+    table: object = None
+    action: str = "open"        # open | read | close
+    alias: str = ""
+    index: str = ""             # "" = natural (handle) order
+    read_op: str = "first"      # first|next|prev|last|=|>=|>|<=|<
+    values: list = field(default_factory=list)   # key prefix literals
+    where: object = None
+    limit: int = 1
+    offset: int = 0
+
+
+@dataclass
 class HelpStmt(StmtNode):
     pass
 

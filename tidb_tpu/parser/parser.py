@@ -106,6 +106,52 @@ class Parser:
                 self.expect_op(";")
         return stmts
 
+    def parse_handler(self) -> ast.StmtNode:
+        """HANDLER t OPEN [AS a] | t READ [idx] op ... | t CLOSE
+        (reference pkg/parser/parser.y HandlerStmt)."""
+        self.expect_kw("handler")
+        stmt = ast.HandlerStmt(table=self.parse_table_name())
+        if self.accept_kw("open"):
+            stmt.action = "open"
+            if self.accept_kw("as"):
+                stmt.alias = self.ident()
+            return stmt
+        if self.accept_kw("close"):
+            stmt.action = "close"
+            return stmt
+        self.expect_kw("read")
+        stmt.action = "read"
+        t = self.peek()
+        dir_kws = ("first", "next", "prev", "last")
+        if t.kind == "IDENT" and t.text.lower() not in dir_kws:
+            stmt.index = self.ident()
+        t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() in dir_kws:
+            stmt.read_op = self.next().text.lower()
+        elif t.kind == "OP" and t.text in ("=", ">=", ">", "<=", "<"):
+            if not stmt.index:
+                self.error("HANDLER comparison read requires an index")
+            stmt.read_op = self.next().text
+            self.expect_op("(")
+            stmt.values.append(self.parse_expr())
+            while self.accept_op(","):
+                stmt.values.append(self.parse_expr())
+            self.expect_op(")")
+        else:
+            self.error()
+        if self.accept_kw("where"):
+            stmt.where = self.parse_expr()
+        lim = self.parse_limit()
+        if lim is not None:
+            if not isinstance(lim.count, ast.Literal) or (
+                    lim.offset is not None and
+                    not isinstance(lim.offset, ast.Literal)):
+                self.error("HANDLER LIMIT must be literal")
+            stmt.limit = int(lim.count.value)
+            if lim.offset is not None:
+                stmt.offset = int(lim.offset.value)
+        return stmt
+
     def parse_stmt(self) -> ast.StmtNode:
         node = self._parse_stmt_inner()
         if self.hint_texts and not getattr(node, "hints", None) and \
@@ -164,6 +210,8 @@ class Parser:
         if kw == "values" and self.peek(1).kind == "IDENT" and \
                 self.peek(1).text.lower() == "row":
             return self.parse_values_constructor()
+        if kw == "handler":
+            return self.parse_handler()
         if kw == "checksum":
             self.next()
             self.expect_kw("table")

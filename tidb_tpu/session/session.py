@@ -395,6 +395,9 @@ class Session:
             # (reference executor/trace.go renders span trees the same way)
             return self._exec_explain(ast.ExplainStmt(stmt=stmt.stmt,
                                                       analyze=True))
+        if isinstance(stmt, ast.HandlerStmt):
+            from ..executor.handler_stmt import exec_handler
+            return exec_handler(self, stmt)
         if isinstance(stmt, ast.UseStmt):
             self.domain.infoschema().schema_by_name(stmt.db)
             self.vars.current_db = stmt.db
