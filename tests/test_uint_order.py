@@ -1,0 +1,41 @@
+"""UINT ORDER BY above 2^63 (ROADMAP item; round-4 attempt reverted)."""
+import pytest
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table u (id int primary key, v bigint unsigned)")
+    tk.must_exec("insert into u values (1, 18446744073709551615), "
+                 "(2, 0), (3, 9223372036854775808), (4, 42), "
+                 "(5, 9223372036854775807), (6, null), (7, 1)")
+    return tk
+
+
+def test_uint_order_asc(tk):
+    got = [r[0] for r in tk.must_query(
+        "select id from u order by v, id").rs.rows]
+    assert got == [6, 2, 7, 4, 5, 3, 1]     # NULL first, then uint order
+
+
+def test_uint_order_desc(tk):
+    got = [r[0] for r in tk.must_query(
+        "select id from u order by v desc, id").rs.rows]
+    assert got == [1, 3, 5, 4, 7, 2, 6]     # NULL last on desc
+
+
+def test_uint_topn(tk):
+    got = [r[0] for r in tk.must_query(
+        "select id from u order by v desc limit 3").rs.rows]
+    assert got == [1, 3, 5]
+    got = [r[0] for r in tk.must_query(
+        "select id from u order by v limit 2").rs.rows]
+    assert got == [6, 2]
+
+
+def test_uint_values_render(tk):
+    got = [r[0] for r in tk.must_query(
+        "select v from u where id in (1, 3) order by v desc").rs.rows]
+    assert [str(x) for x in got] == ["18446744073709551615",
+                                    "9223372036854775808"]

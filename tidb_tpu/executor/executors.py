@@ -953,6 +953,22 @@ def _sort_key_arrays(schema, chunk, items):
             data = inv.astype(np.int64)
         if data.dtype == bool:
             data = data.astype(np.int64)
+        if sdict is None and data.dtype.kind in "iu" and \
+                getattr(e.ft, "unsigned", False):
+            # unsigned BIGINT above 2^63 stores as wrapped int64: flip
+            # the sign bit so uint64 order becomes int64 order (exact,
+            # no overflow), and carry NULL order as a SEPARATE lexsort
+            # key — the in-band ±_I64_MAX sentinels of the signed path
+            # collide with real keys here (the round-4 revert)
+            key = data.astype(np.int64) ^ np.int64(-(1 << 63))
+            if desc:
+                key = ~key                    # order-inverting, safe
+                flag = np.where(nm, 1, 0)     # NULLs last on desc
+            else:
+                flag = np.where(nm, 0, 1)     # NULLs first on asc
+            keys.append(flag.astype(np.int64))
+            keys.append(key)
+            continue
         if desc:
             if data.dtype.kind == "f":
                 data = -data

@@ -792,7 +792,11 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         if isinstance(child, PhysTableReader) and not child.dag.aggs and \
                 child.dag.limit < 0 and len(plan.items) == 1 and \
                 plan.offset + plan.count <= 16384 and \
-                is_device_safe(plan.items[0][0]):
+                is_device_safe(plan.items[0][0]) and \
+                not getattr(plan.items[0][0].ft, "unsigned", False):
+            # unsigned keys above 2^63 wrap negative: the copr top-k
+            # kernel's in-band sentinels cannot express them — the
+            # host TopN (sentinel-free unsigned keys) owns the shape
             # per-partition device top-k; the root TopN merges partitions
             # (reference: copr-pushed TopN under the root TopN)
             child.dag.topn = (plan.items[0], plan.offset + plan.count)
