@@ -7,7 +7,9 @@ and both hosts launch the IDENTICAL collective program — the exchange
 is a psum/all_to_all over the process group, not an RPC stream.
 
 Covers: global agg fragment, grouped (dense-psum) fragment, and the
-hash-shuffle join with a 90%-hot-key skew across hosts."""
+hash-shuffle join with a 90%-hot-key skew across hosts. N_PROCS=3
+(round-5: the comms data plane must scale past the 2-process pair the
+earlier rounds proved)."""
 import os
 import subprocess
 import sys
@@ -16,6 +18,7 @@ import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_PROCS = 3
 
 
 @pytest.fixture(scope="module")
@@ -25,7 +28,7 @@ def cluster():
                XLA_FLAGS="--xla_force_host_platform_device_count=2",
                PYTHONPATH=REPO + os.pathsep + os.environ.get(
                    "PYTHONPATH", ""))
-    for _ in range(2):
+    for _ in range(N_PROCS):
         p = subprocess.Popen(
             [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
@@ -37,8 +40,8 @@ def cluster():
     from tidb_tpu.cluster import Cluster
     cl = Cluster(ports)
     outs = cl.spmd_init(port=17843)
-    # 2 processes x 2 virtual devices = one 4-device global mesh
-    assert all(o["global_devices"] == 4 for o in outs), outs
+    # N processes x 2 virtual devices = one 2N-device global mesh
+    assert all(o["global_devices"] == 2 * N_PROCS for o in outs), outs
     assert all(o["local_devices"] == 2 for o in outs), outs
     yield cl
     cl.stop()
@@ -65,8 +68,8 @@ def loaded(cluster):
     k, g, v = _rows()
     cluster.ddl("create table t (id int primary key, k int, g int, "
                 "v int)")
-    for w in range(2):
-        sl = slice(w * ROWS // 2, (w + 1) * ROWS // 2)
+    for w in range(N_PROCS):
+        sl = slice(w * ROWS // N_PROCS, (w + 1) * ROWS // N_PROCS)
         vals = ",".join(
             f"({i + 1},{k[i]},{g[i]},{v[i]})"
             for i in range(sl.start, sl.stop))
@@ -113,7 +116,7 @@ def test_spmd_shuffle_join_hot_key_across_hosts(loaded):
     (no silent drop) and both hosts agree on the exact group counts."""
     from tidb_tpu.mpp.exec import _shuffle_capacity, _round_capacity
     rng = np.random.RandomState(77)
-    n, nd, n_groups = 512, 64, 7
+    n, nd, n_groups = 480, 60, 7   # divisible by N_PROCS
     hot = 13
     pk = np.where(rng.rand(n) < 0.9, hot,
                   rng.randint(0, nd, size=n)).astype(np.int64)
@@ -122,10 +125,10 @@ def test_spmd_shuffle_join_hot_key_across_hosts(loaded):
     bk = np.arange(nd, dtype=np.int64)
     bp = rng.randint(0, n_groups, size=nd).astype(np.int64)
     bok = np.ones(nd, dtype=bool)
-    ndev = 4
+    ndev = 2 * N_PROCS
     cap = _round_capacity(max(_shuffle_capacity(pk, pok, ndev),
                               _shuffle_capacity(bk, bok, ndev), 1))
-    half, bhalf = n // 2, nd // 2
+    half, bhalf = n // N_PROCS, nd // N_PROCS
 
     def call(i, w):
         arrs = {"pk": pk[i * half:(i + 1) * half],
