@@ -1362,6 +1362,12 @@ def _subst_cols(e, mapping):
 # of the fact cardinality
 _FUSE_MAX_GROUPS_ABS = 1 << 18
 _FUSE_MAX_GROUP_RATIO = 0.10
+# combined dim build MASS (aggregate-subquery dims count their input
+# rows) above BOTH bounds -> conventional host join. q18's one
+# fact-sized IN-subquery dim lands ~1.3x fact and stays fused; q21's
+# FOUR pair-count dims land ~4x fact and route to host.
+_FUSE_MAX_DIM_MASS_ABS = 1 << 21
+_FUSE_MAX_DIM_MASS_RATIO = 2.0
 
 
 def _try_fuse_agg(plan: Aggregation, child: PhysPlan):
@@ -1610,6 +1616,31 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
                    1.0)
     if est_groups > _FUSE_MAX_GROUPS_ABS and \
             est_groups > _FUSE_MAX_GROUP_RATIO * est_fact:
+        return None
+    # build-side mass gate (Q21's EXISTS/NOT-EXISTS class): four
+    # per-orderkey aggregate dims each MATERIALIZE an aggregation over
+    # ~the whole fact — their builds + sort metas dominate and blow the
+    # matdim budget (SF10 measured: fused 313s vs host semi-joins 38s).
+    # The host hash join owns shapes whose dim mass rivals the fact.
+    # Aggregate-subquery dims count their INPUT mass (output stats are
+    # unreliable); plain dims their raw size.
+    def build_mass(leaf):
+        if isinstance(leaf, _AggLeaf):
+            total = 0.0
+            stack = [leaf.plan]
+            while stack:
+                p0 = stack.pop()
+                if isinstance(p0, (PhysTableReader, PhysFusedPipeline)):
+                    total += max(getattr(p0, "raw_rows", 0.0) or 0.0,
+                                 p0.stats_rows or 0.0)
+                stack.extend(getattr(p0, "children", []))
+            return total
+        return max(getattr(leaf, "raw_rows", 0.0) or 0.0,
+                   getattr(leaf, "stats_rows", 0.0) or 0.0)
+    dim_rows = sum(build_mass(l) for l in leaves if l is not fact) + \
+        sum(build_mass(l) for l, _jt, _ec, _n in outer_dims)
+    if dim_rows > _FUSE_MAX_DIM_MASS_ABS and \
+            dim_rows > _FUSE_MAX_DIM_MASS_RATIO * est_fact:
         return None
     fused = PhysFusedPipeline(fact.dag, dims, post,
                               list(group_items),
