@@ -189,3 +189,22 @@ def test_import_parquet_by_position_when_names_differ(tk, tmp_path):
     tk.must_exec(f"import into ppos from '{p}'")
     assert tk.must_query("select a, b from ppos order by a").rs.rows == \
         [(1, 10), (2, 20)]
+
+
+def test_import_conflict_report(tk, tmp_path):
+    """Skipped duplicates are queryable in
+    information_schema.tidb_import_conflicts (reference lightning
+    conflict detection), not silently dropped."""
+    tk.must_exec("create table cr (k bigint primary key, v int)")
+    tk.must_exec("insert into cr values (2, 99), (3, 98)")
+    p = tmp_path / "cr.csv"
+    p.write_text("1,10\n2,20\n3,30\n4,40\n")
+    r = tk.must_exec(f"import into cr from '{p}' "
+                     f"with on_duplicate = skip")
+    assert r.affected == 2 and r.skipped == 2
+    rows = tk.must_query(
+        "select table_name, handle, conflict from "
+        "information_schema.tidb_import_conflicts order by handle"
+    ).rs.rows
+    assert [(r0[0], r0[1]) for r0 in rows] == [("cr", 2), ("cr", 3)]
+    assert all(r0[2] == "duplicate primary key" for r0 in rows)

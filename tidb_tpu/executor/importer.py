@@ -138,6 +138,8 @@ def exec_import(sess, stmt) -> ResultSet:
                         "import rows collide with existing primary keys")
                 keep = ~dup_mask
                 skipped += int(dup_mask.sum())
+                _record_conflicts(domain, tbl, path, handles, dup_mask,
+                                  chunk_cols)
                 m = int(keep.sum())
                 if m == 0:
                     _save_progress(domain, tbl, path, ckpt, chunk_rows,
@@ -158,6 +160,29 @@ def exec_import(sess, stmt) -> ResultSet:
     rs = ResultSet(affected=loaded)
     rs.skipped = skipped
     return rs
+
+
+_CONFLICT_CAP = 10_000
+
+
+def _record_conflicts(domain, tbl, path, handles, dup_mask, chunk_cols):
+    """Duplicate-resolution report (reference lightning conflict
+    detection): skipped rows land in the queryable
+    information_schema.tidb_import_conflicts ring, never silently
+    vanish."""
+    import time as _t
+    out = getattr(domain, "_import_conflicts", None)
+    if out is None:
+        out = domain._import_conflicts = []
+    now = _t.time()
+    names = list(chunk_cols)
+    for i in np.nonzero(dup_mask)[0][:200]:      # per-chunk cap
+        if len(out) >= _CONFLICT_CAP:
+            out.pop(0)
+        preview = ", ".join(
+            f"{nm}={chunk_cols[nm][i]!r}" for nm in names[:4])
+        out.append((tbl.name, path, int(handles[i]),
+                    "duplicate primary key", preview[:200], now))
 
 
 def _save_progress(domain, tbl, path, ckpt, chunk_rows, ctab, total):

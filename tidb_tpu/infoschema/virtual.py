@@ -325,6 +325,13 @@ VIRTUAL_DEFS = {
         ("referenced_table_name", _S())), _gen_referential_constraints),
     "partitions": (_cols(("table_schema", _S()), ("table_name", _S()),
                          ("partition_name", _S())), _gen_partitions),
+    # duplicate-resolution report for IMPORT INTO ... on_duplicate=skip
+    # (reference lightning conflict detection: skipped rows are
+    # queryable, not silently dropped)
+    "tidb_import_conflicts": (_cols(
+        ("table_name", _S()), ("source", _S()), ("handle", _I()),
+        ("conflict", _S()), ("row_preview", _S()), ("time", _F())),
+        lambda domain: list(getattr(domain, "_import_conflicts", []))),
 }
 
 _VIRT_INFO_CACHE: dict = {}
