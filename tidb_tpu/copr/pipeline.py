@@ -1070,6 +1070,53 @@ def _delta_partition(plan, fact_tbl, fact_arrays, delta_rows):
     return cols, np.ones(n, dtype=bool)
 
 
+def _delta_in_span(shim, sizes, delta_part):
+    """Do the delta rows' group keys fall inside the dense layout's
+    span? Evaluated on host over the (tiny) delta partition: group item
+    i must land in [off, off + size - 2] (dense_agg_body maps value d
+    to code d - off + 1, clipped to size - 1; NULLs take slot 0).
+    Group items referencing DIM columns can't be checked here — the
+    delta probes dims inside the kernel — so only fact-only group
+    expressions qualify; anything else keeps the sort lowering."""
+    dcols, dv = delta_part
+    nd = len(dv)
+    if nd == 0:
+        return True
+    ctx = EvalCtx(np, nd, dcols, host=True)
+    for g, (size, off) in zip(shim.group_items, sizes):
+        if not all(c in dcols for c in
+                   (cc.idx for cc in _cols_of_expr(g))):
+            return False
+        try:
+            d, nl, sdict = eval_expr(ctx, g)
+        except Exception:               # noqa: BLE001
+            return False
+        if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+            d = np.full(nd, d)
+        d = np.asarray(d)
+        if d.dtype.kind not in "iu":
+            return False
+        nm = np.asarray(materialize_nulls(ctx, nl))
+        live = d[~nm] if nm.any() else d
+        if len(live) and (int(live.min()) < off or
+                          int(live.max()) > off + size - 2):
+            return False
+    return True
+
+
+def _cols_of_expr(e):
+    from ..expression import Column as _EC
+    out = []
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, _EC):
+            out.append(x)
+        for a in getattr(x, "args", []) or []:
+            stack.append(a)
+    return out
+
+
 def fused_partials(copr, plan, read_ts, mesh=None,
                    bcast_threshold=1 << 20, ctx=None, delta_rows=None,
                    dead_handles=None):
@@ -1176,16 +1223,19 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             data, nulls, sdict = meta["arrays"][cid]
             one[sc.col.idx] = (data[:1] if len(data)
                                else np.zeros(1, data.dtype), None, sdict)
+    # the delta partition builds BEFORE layout decisions: its dict
+    # encodes extend the shared dicts, so dict-derived dense sizes
+    # already cover delta codes (the HTAP overlay must not lose the
+    # dense lowering for every in-span write)
+    delta_part = None
+    if delta_rows:
+        delta_part = _delta_partition(plan, fact_tbl, fact_arrays,
+                                      delta_rows)
     shim = _AggShim(plan.group_items, plan.aggs)
     kd, sd = capture_agg_dicts(shim, one)
     pos_spec = _pos_group_map(plan, dim_metas)
     sizes = None
-    if pos_spec is None and not delta_rows:
-        # dense layouts clip group codes to a span derived from the
-        # SNAPSHOT (dict sizes / int min-max): a dirty-txn delta row
-        # with a key outside that span would silently merge into a
-        # boundary group. Delta executions take the sort lowering,
-        # which is exact for any key.
+    if pos_spec is None:
         fcols = None
         if not plan.dims and n:
             # zero-dim pipeline: int group keys can dense-detect via a
@@ -1198,6 +1248,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 fcols[sc.col.idx] = (handles, None, None) if cid == -1 \
                     else fact_arrays[cid]
         sizes = _dense_strides(shim, kd, fcols, n)
+        if sizes is not None and delta_part is not None and \
+                not _delta_in_span(shim, sizes, delta_part):
+            # dense layouts clip group codes to the derived span: a
+            # delta key OUTSIDE it would silently merge into a boundary
+            # group — those executions take the exact sort lowering
+            sizes = None
     if _segment_impl() == "runs":
         # big dense/position domains have no scatter-free dense
         # lowering: fall to the "sort" agg kind, which lowers to
@@ -1206,8 +1262,10 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         # group-by-FK stays compact.
         if pos_spec is not None and pos_spec[2] > _de._BCR_MAX:
             pos_spec = None
-            if not delta_rows:      # same snapshot-span clip hazard
-                sizes = _dense_strides(shim, kd)
+            sizes = _dense_strides(shim, kd)
+            if sizes is not None and delta_part is not None and \
+                    not _delta_in_span(shim, sizes, delta_part):
+                sizes = None
         if sizes is not None and _dense_nslots(sizes) > _de._BCR_MAX:
             sizes = None
 
@@ -1242,11 +1300,10 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                                     sl, handles,
                                     cacheable=(n == fact_tbl.n))
             yield pcols, fact_valid[sl], pm
-        if delta_rows:
+        if delta_part is not None:
             # the transaction's uncommitted inserts as one more fact
             # partition through the SAME kernel (device UnionScan)
-            dcols, dv = _delta_partition(plan, fact_tbl, fact_arrays,
-                                         delta_rows)
+            dcols, dv = delta_part
             copr._bind_keys = {}        # never device-cache dirty rows
             yield dcols, dv, len(dv)
 
