@@ -492,12 +492,14 @@ class IndexRangeExec(Executor):
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
             and txn.is_dirty()
+        lim = getattr(self.plan, "scan_limit", -1)
         if dirty:
-            entries = txn.scan(lo, hi)     # memBuffer merged over snapshot
+            entries = txn.scan(lo, hi, limit=lim)  # memBuffer merged
         else:
             read_ts = self.ctx.read_ts() or \
                 sess.domain.storage.current_ts()
-            entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts)
+            entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts,
+                                                    limit=lim)
         handles = []
         for k, v in entries:
             if index.unique and v not in (b"",):

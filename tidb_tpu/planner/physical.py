@@ -423,6 +423,7 @@ class PhysIndexRange(PhysPlan):
         self.low_inc = low_inc
         self.high_inc = high_inc
         self.residual = residual   # remaining filter conjuncts (host eval)
+        self.scan_limit = -1       # LIMIT pushed into the index KV scan
 
     def explain_info(self):
         rng = f"{'[' if self.low_inc else '('}{self.low!r}, " \
@@ -809,6 +810,29 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
                 not child.dag.filters and not child.dag.host_filters and \
                 plan.count >= 0:
             child.dag.limit = plan.offset + plan.count
+        # LIMIT without intervening filters bounds the index KV scan
+        # itself (sysbench index_range: a half-open range over a big
+        # index must stop after offset+count entries, not materialize
+        # half the index per statement)
+        if plan.count >= 0:
+            holder = None
+            ir = child
+            while isinstance(ir, (PhysProjection, PhysShell)):
+                holder = ir
+                ir = ir.children[0]
+            if isinstance(ir, PhysIndexRange) and not ir.residual:
+                ir.scan_limit = plan.offset + plan.count
+            elif isinstance(ir, PhysTableReader):
+                # unselective range + LIMIT: the 2% selectivity gate
+                # rejected the index path, but a LIMITed index scan
+                # reads <= offset+count entries no matter the range
+                conv = _limit_to_index_range(
+                    ir, plan.offset + plan.count)
+                if conv is not None:
+                    if holder is not None:
+                        holder.children[0] = conv
+                    else:
+                        child = conv
         p = PhysLimit(plan.offset, plan.count, child)
         p.stats_rows = plan.stats_rows
         return p
@@ -897,6 +921,37 @@ def _try_index_range(ds: DataSource) -> PhysPlan | None:
     return PhysIndexRange(tbl, ds.db_name, cols, target_idx, low, high,
                           low_inc, high_inc, residual, Schema(list(cols)),
                           prefix=prefix)
+
+
+class _ReaderDS:
+    """Duck-typed DataSource view of a PhysTableReader so the range
+    extractor can run at the LIMIT boundary."""
+
+    def __init__(self, rd):
+        self.table_info = rd.dag.table_info
+        self.db_name = rd.dag.db_name
+        self.pushed_conds = list(rd.dag.filters)
+        self.col_name_of = {sc.col.idx: sc.name for sc in rd.dag.cols}
+        self.used_cols = list(rd.dag.cols)
+        self.schema = rd.schema
+        self.stats_rows = rd.stats_rows
+        self.bulk_only = False
+
+
+def _limit_to_index_range(rd, scan_limit):
+    """TableReader + LIMIT (no intervening operators) -> LIMITed index
+    range scan when EVERY filter folds into one index's key range (a
+    residual would make the limit cut filtered rows)."""
+    if rd.dag.aggs or rd.dag.group_items or rd.dag.topn is not None \
+            or rd.dag.host_filters or not rd.dag.filters \
+            or rd.dag.limit >= 0:
+        return None
+    ir = _try_index_range(_ReaderDS(rd))
+    if ir is None or ir.residual:
+        return None
+    ir.scan_limit = scan_limit
+    ir.stats_rows = float(scan_limit)
+    return ir
 
 
 def _flatten_or(c, out):

@@ -148,15 +148,19 @@ class Transaction:
                 return True
         return False
 
-    def scan(self, start: bytes, end: bytes | None = None):
+    def scan(self, start: bytes, end: bytes | None = None,
+             limit: int = -1):
         """Merge memBuffer over snapshot (UnionScan semantics,
-        reference pkg/executor/union_scan.go)."""
-        snap = self.snapshot.scan(start, end)
+        reference pkg/executor/union_scan.go). With a limit, the
+        snapshot side over-fetches by the buffered-entry count in
+        range (each buffered delete/overwrite can cancel at most one
+        snapshot entry) so the merged prefix is never short."""
         buf = list(self.mem_buffer.scan(start, end))
+        snap_lim = -1 if limit < 0 else limit + len(buf)
+        snap = self.snapshot.scan(start, end, snap_lim)
         if not buf:
-            return snap
+            return snap if limit < 0 else snap[:limit]
         merged = []
-        bi = 0
         overlay = dict(buf)
         for k, v in snap:
             if k in overlay:
@@ -166,7 +170,7 @@ class Transaction:
             if v is not None:
                 merged.append((k, v))
         merged.sort(key=lambda kv: kv[0])
-        return merged
+        return merged if limit < 0 else merged[:limit]
 
     def lock_keys(self, keys, for_update_ts=None):
         if for_update_ts is None:
