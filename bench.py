@@ -222,16 +222,28 @@ def oltp_main(live=True):
             for i in range(start, min(start + 5000, n_rows)))
         tk.must_exec(f"insert into sbtest values {vals}")
 
+    errors = {}
+
     def bench_op(name, fn):
         stop = threading.Event()
         counts = [0] * nthreads
+        errs = [0] * nthreads
 
         def worker(i):
             s = tk.new_session()
             r = random.Random(i)
             while not stop.is_set():
-                fn(s, r)
-                counts[i] += 1
+                try:
+                    fn(s, r)
+                    counts[i] += 1
+                except Exception as e:          # noqa: BLE001
+                    # a dead worker silently deflates QPS: count and
+                    # keep going, surface the tally in the artifact
+                    errs[i] += 1
+                    if errs[i] == 1:
+                        print(f"# oltp {name} thread {i} error: "
+                              f"{type(e).__name__}: {str(e)[:120]}",
+                              file=sys.stderr)
         ths = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(nthreads)]
         for t in ths:
@@ -241,7 +253,9 @@ def oltp_main(live=True):
         for t in ths:
             t.join(timeout=30)
         qps = sum(counts) / seconds
-        print(f"# oltp {name}: {qps:.1f} ops/s", file=sys.stderr)
+        errors[name] = sum(errs)
+        print(f"# oltp {name}: {qps:.1f} ops/s "
+              f"({errors[name]} errors)", file=sys.stderr)
         return round(qps, 1)
 
     res = {
@@ -264,6 +278,7 @@ def oltp_main(live=True):
         "vs_baseline": 0,
         "backend": "tpu" if live else "cpu-fallback",
         "ops": res,
+        "errors": errors,
     }))
 
 

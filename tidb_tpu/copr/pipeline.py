@@ -1084,8 +1084,9 @@ def _delta_in_span(shim, sizes, delta_part):
         return True
     ctx = EvalCtx(np, nd, dcols, host=True)
     for g, (size, off) in zip(shim.group_items, sizes):
-        if not all(c in dcols for c in
-                   (cc.idx for cc in _cols_of_expr(g))):
+        refs = set()
+        g.collect_columns(refs)
+        if not refs <= set(dcols):
             return False
         try:
             d, nl, sdict = eval_expr(ctx, g)
@@ -1102,19 +1103,6 @@ def _delta_in_span(shim, sizes, delta_part):
                           int(live.max()) > off + size - 2):
             return False
     return True
-
-
-def _cols_of_expr(e):
-    from ..expression import Column as _EC
-    out = []
-    stack = [e]
-    while stack:
-        x = stack.pop()
-        if isinstance(x, _EC):
-            out.append(x)
-        for a in getattr(x, "args", []) or []:
-            stack.append(a)
-    return out
 
 
 def fused_partials(copr, plan, read_ts, mesh=None,

@@ -587,6 +587,12 @@ class IndexRangeExec(Executor):
         dag = CoprDAG(table_info=self.plan.table_info,
                       db_name=self.plan.db_name, cols=self.plan.cols,
                       host_filters=list(self.plan.residual))
+        # a LIMITed index scan falling back (bulk rows carry no index
+        # KV) keeps its bound: with zero residual beyond the re-applied
+        # range, the post-filter limit equals the scan limit
+        sl = getattr(self.plan, "scan_limit", -1)
+        if sl > 0 and not self.plan.residual:
+            dag.limit = sl
         # re-apply the prefix equalities + range as filters
         from ..expression import ScalarFunc
         from ..types.field_type import new_bigint_type

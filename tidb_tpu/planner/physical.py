@@ -814,7 +814,7 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         # itself (sysbench index_range: a half-open range over a big
         # index must stop after offset+count entries, not materialize
         # half the index per statement)
-        if plan.count >= 0:
+        if plan.count > 0:      # LIMIT 0 must not read as "unlimited"
             holder = None
             ir = child
             while isinstance(ir, (PhysProjection, PhysShell)):
