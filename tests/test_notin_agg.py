@@ -1,0 +1,61 @@
+"""Correlated NOT IN over SCALAR aggregate subqueries: MySQL's
+3-valued semantics (ROADMAP tail item). The subquery yields exactly one
+row per correlation value — agg over an empty group is NULL (count: 0),
+so `x NOT IN (select max(...) where corr)` is x <> that value under
+3VL, NEVER an empty-set TRUE."""
+import pytest
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table o (id int primary key, k int, x int)")
+    tk.must_exec("create table i (id int primary key, k int, b int)")
+    # k=1: max(b)=5; k=2: max(b)=NULL (all-null b); k=3: no rows
+    tk.must_exec("insert into o values (1, 1, 5), (2, 1, 7), "
+                 "(3, 2, 9), (4, 3, 9), (5, 1, null)")
+    tk.must_exec("insert into i values (10, 1, 5), (11, 1, 3), "
+                 "(12, 2, null)")
+    return tk
+
+
+def q(tk, sql):
+    return [r[0] for r in tk.must_query(sql).rs.rows]
+
+
+def test_not_in_scalar_max(tk):
+    # MySQL semantics per outer row:
+    # id=1 (k=1, x=5):  5 NOT IN {5}    -> FALSE -> drop
+    # id=2 (k=1, x=7):  7 NOT IN {5}    -> TRUE  -> keep
+    # id=3 (k=2, x=9):  9 NOT IN {NULL} -> NULL  -> drop
+    # id=4 (k=3, x=9):  9 NOT IN {NULL} -> NULL  -> drop (max over
+    #                   EMPTY group is NULL, not an empty set!)
+    # id=5 (k=1, x=NULL): NULL NOT IN {5} -> NULL -> drop
+    got = q(tk, "select id from o where x not in "
+               "(select max(b) from i where i.k = o.k) order by id")
+    assert got == [2], got
+
+
+def test_not_in_scalar_count(tk):
+    # count over an empty group is 0, not NULL:
+    # id=1 (k=1, x=5):  5 NOT IN {2} -> TRUE keep
+    # id=2 (k=1, x=7):  7 NOT IN {2} -> TRUE keep
+    # id=3 (k=2, x=9):  9 NOT IN {1} -> TRUE keep
+    # id=4 (k=3, x=9):  9 NOT IN {0} -> TRUE keep
+    # id=5 (k=1, x=NULL): NULL NOT IN {2} -> NULL drop
+    got = q(tk, "select id from o where x not in "
+               "(select count(*) from i where i.k = o.k) order by id")
+    assert got == [1, 2, 3, 4], got
+    # and a count value that DOES match drops the row: k=3 count=0
+    tk.must_exec("update o set x = 0 where id = 4")
+    got = q(tk, "select id from o where x not in "
+               "(select count(*) from i where i.k = o.k) order by id")
+    assert got == [1, 2, 3], got
+
+
+def test_in_scalar_max_unchanged(tk):
+    # positive IN keeps its existing semantics
+    got = q(tk, "select id from o where x in "
+               "(select max(b) from i where i.k = o.k) order by id")
+    assert got == [1], got

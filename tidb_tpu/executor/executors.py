@@ -2037,7 +2037,11 @@ class HashJoinExec(Executor):
                 self.ctx.sess.domain.inc_metric("device_join_fallback")
         border = np.argsort(bv, kind="stable")
         sbv = bv[border]
-        if len(sbv) and (len(sbv) == 1 or bool(np.all(sbv[1:] > sbv[:-1]))):
+        if len(sbv) and sbv.dtype.kind != "V" and \
+                (len(sbv) == 1 or bool(np.all(sbv[1:] > sbv[:-1]))):
+            # (void-packed multi-keys have no ufunc '>': they take the
+            # range-expansion path below, whose searchsorted handles
+            # structured compares)
             # unique build keys (PK/unique-index side — the common case):
             # one binary search + equality check replaces the second
             # searchsorted and the whole range-expansion machinery

@@ -908,8 +908,43 @@ class PlanBuilder:
                     join.null_aware = True
                     join.naaj_corr = len(join.eq_conds) - 1
                     return join
-                # residual conditions / aggregates: conservative
-                # NULL-probe guard
+                if not others and _stmt_has_agg(c.subquery) and \
+                        not c.subquery.group_by:
+                    # correlated NOT IN over a SCALAR aggregate
+                    # subquery: MySQL's subquery yields exactly ONE row
+                    # per correlation value — agg over an empty group
+                    # is NULL (count: 0), never an empty set. A LEFT
+                    # join on the correlation keys reproduces that
+                    # exactly (absent group -> NULL agg), and NOT IN
+                    # {v} == (x <> v) under 3VL: the Selection keeps
+                    # only rows where the inequality is TRUE.
+                    schema = Schema(list(p.schema.cols) +
+                                    list(splan.schema.cols))
+                    ljoin = LJoin("left", p, splan, schema)
+                    ljoin.stats_rows = p.stats_rows
+                    for a, b in eq_pairs[:-1]:      # correlation keys
+                        ljoin.eq_conds.append((a, b))
+                    val = inner_e2
+                    if isinstance(splan, Aggregation) and \
+                            isinstance(val, Column):
+                        agg_cols = splan.schema.cols[
+                            len(splan.group_items):]
+                        for desc, sc in zip(splan.aggs, agg_cols):
+                            if sc.col.idx == val.idx and \
+                                    desc.name == "count":
+                                # count over an empty group is 0
+                                rw0 = self._rewriter(schema)
+                                val = rw0.mk_func(
+                                    "ifnull", [val, const_from_py(0)],
+                                    val.ft)
+                                break
+                    rw1 = self._rewriter(schema)
+                    neq = rw1.mk_func("!=", [outer_e2, val])
+                    sel = Selection([neq], ljoin)
+                    sel.stats_rows = ljoin.stats_rows
+                    return sel
+                # residual conditions / grouped aggregates:
+                # conservative NULL-probe guard
                 guard = rw.mk_func("isnotnull", [outer_e2])
                 sel = Selection([guard], join)
                 sel.stats_rows = join.stats_rows
