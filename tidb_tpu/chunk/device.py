@@ -87,6 +87,28 @@ def _fold_gb18030(s):
         "gb18030", errors="replace").decode("latin-1")
 
 
+def _fold_pad(s):
+    """PAD SPACE, case-sensitive (utf8mb4_bin-class collations: in
+    MySQL 8 only *_0900_* and binary are NO PAD — trailing spaces are
+    insignificant under every legacy collation, including the _bin
+    ones)."""
+    return s.rstrip(" ") if isinstance(s, str) else s
+
+
+def _fold_gbk_bin(s):
+    """gbk_bin: GBK code order + PAD SPACE, case-sensitive."""
+    if not isinstance(s, str):
+        return s
+    return s.rstrip(" ").encode("gbk", errors="replace").decode("latin-1")
+
+
+def _fold_gb18030_bin(s):
+    if not isinstance(s, str):
+        return s
+    return s.rstrip(" ").encode(
+        "gb18030", errors="replace").decode("latin-1")
+
+
 _COLLATION_FOLDS = {
     "utf8mb4_general_ci": _fold_general,
     "utf8_general_ci": _fold_general,
@@ -97,6 +119,11 @@ _COLLATION_FOLDS = {
     "utf8mb4_0900_ai_ci": _fold_0900_ai,
     "gbk_chinese_ci": _fold_gbk,
     "gb18030_chinese_ci": _fold_gb18030,
+    "utf8mb4_bin": _fold_pad,
+    "utf8_bin": _fold_pad,
+    "latin1_bin": _fold_pad,
+    "gbk_bin": _fold_gbk_bin,
+    "gb18030_bin": _fold_gb18030_bin,
 }
 
 

@@ -942,12 +942,13 @@ def _sort_key_arrays(schema, chunk, items):
             data = np.full(n, data if not isinstance(data, str) else 0)
         data = np.asarray(data)
         if sdict is not None:
-            from ..expression.vec import _is_ci, _coll_arg
-            # folded ranks: ci-equal spellings share a key value, so
+            from ..expression.vec import _needs_fold, _coll_arg
+            # folded ranks: collation-equal spellings share a key value
+            # (ci case folds; PAD-SPACE _bin folds trailing spaces), so
             # sort order AND equality (window peers/partitions) both
             # follow the collation
             ranks = sdict.ci_fold_ranks(_coll_arg(e.ft)) \
-                if _is_ci(e.ft) else sdict.ranks()
+                if _needs_fold(e.ft) else sdict.ranks()
             data = ranks[data]
         elif data.dtype == object:
             if nm.any():

@@ -1017,10 +1017,26 @@ def _is_ci(ft) -> bool:
     return ft is not None and str(getattr(ft, "collate", "")).endswith("_ci")
 
 
+# PAD SPACE case-sensitive collations: trailing spaces are
+# insignificant for grouping/joins/ordering, but case still matters
+# (MySQL 8: every non-0900, non-binary collation PADs)
+_PAD_BIN_COLLATIONS = frozenset((
+    "utf8mb4_bin", "utf8_bin", "latin1_bin", "gbk_bin", "gb18030_bin"))
+
+
+def _needs_fold(ft) -> bool:
+    """Does the collation require a canonical-key fold for grouping/
+    join/order equality? _ci collations and the PAD-SPACE _bin ones."""
+    if ft is None:
+        return False
+    coll = str(getattr(ft, "collate", "")).lower()
+    return coll.endswith("_ci") or coll in _PAD_BIN_COLLATIONS
+
+
 def _coll_arg(ft):
     """StringDict coll argument for a field type: the collation name
-    when it is a _ci collation, else False (binary/byte order)."""
-    return str(ft.collate).lower() if _is_ci(ft) else False
+    when it folds (_ci or pad-space _bin), else False (byte order)."""
+    return str(ft.collate).lower() if _needs_fold(ft) else False
 
 
 @op("like")

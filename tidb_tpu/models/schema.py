@@ -180,7 +180,7 @@ class DBInfo:
     id: int
     name: str
     charset: str = "utf8mb4"
-    collate: str = "utf8mb4_bin"
+    collate: str = "utf8mb4_0900_bin"   # NO PAD (see types/field_type.py)
     state: SchemaState = SchemaState.PUBLIC
 
     def to_json(self):

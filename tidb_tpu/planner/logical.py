@@ -23,10 +23,10 @@ def _ci_canon(e: Expression) -> Expression:
     """Wrap a _ci string expression in the collation canonical-key op
     (expression/vec.py op_collkey); identity for everything else."""
     from ..types.field_type import TypeClass
-    from ..expression.vec import _is_ci
+    from ..expression.vec import _needs_fold
     ft = getattr(e, "ft", None)
     if ft is not None and ft.tclass == TypeClass.STRING and \
-            _is_ci(ft) and \
+            _needs_fold(ft) and \
             not (isinstance(e, ScalarFunc) and e.op == "_collkey"):
         return ScalarFunc("_collkey", [e], ft)
     return e

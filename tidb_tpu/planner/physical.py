@@ -1071,13 +1071,13 @@ def _ci_join_pair(a, b):
     non-binary collation when the sides disagree). Non-string or _bin
     pairs pass through — a wrapped key also keeps such a dim out of the
     raw-code fused path, which would otherwise compare codes binary."""
-    from ..expression.vec import _is_ci
+    from ..expression.vec import _needs_fold
     from ..types.field_type import TypeClass
 
     def is_ci_str(e):
         ft = getattr(e, "ft", None)
         return ft is not None and ft.tclass == TypeClass.STRING and \
-            _is_ci(ft)
+            _needs_fold(ft)
 
     def is_str(e):
         ft = getattr(e, "ft", None)

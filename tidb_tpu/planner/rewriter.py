@@ -475,7 +475,7 @@ class Rewriter:
         if name == "collation" and node.args:
             arg = self.rewrite(node.args[0])
             coll = getattr(getattr(arg, "ft", None), "collate", None)
-            return const_from_py(coll or "utf8mb4_bin")
+            return const_from_py(coll or "utf8mb4_0900_bin")
         if name == "coercibility" and node.args:
             arg = node.args[0]
             return const_from_py(4 if isinstance(arg, ast.Literal) else 2)

@@ -69,7 +69,11 @@ class FieldType:
     unsigned: bool = False
     not_null: bool = False
     charset: str = "utf8mb4"
-    collate: str = "utf8mb4_bin"
+    # NO PAD byte order — the engine's untyped-string semantics. An
+    # EXPLICIT utf8mb4_bin is a PAD SPACE collation in MySQL (only
+    # *_0900_* and binary are NO PAD) and folds trailing spaces for
+    # grouping/joins/ordering; the default must not.
+    collate: str = "utf8mb4_0900_bin"
     elems: list = field(default_factory=list)  # enum/set values
     auto_increment: bool = False
     primary_key: bool = False
