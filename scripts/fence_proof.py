@@ -1,0 +1,37 @@
+"""Prove the SF1 perf fence: (a) trips under an injected per-execution
+recompile, (b) passes clean."""
+import sys; sys.path.insert(0, "/root/repo/scripts"); import cpuforce
+import sys, time; sys.path.insert(0, "/root/repo")
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+tk = TestKit()
+load_tpch(tk, sf=1.0, seed=42)
+
+def best(n, fn):
+    b = 9e9
+    for _ in range(n):
+        t = time.perf_counter(); fn(); b = min(b, time.perf_counter()-t)
+    return b
+
+q = "q3"
+sql = ALL_QUERIES[q]
+tk.must_query(sql)
+dev = best(2, lambda: tk.must_query(sql))
+tk.domain.copr.use_device = False
+tk.must_query(sql)
+host = best(2, lambda: tk.must_query(sql))
+tk.domain.copr.use_device = True
+print(f"clean: dev {dev*1e3:.0f}ms host {host*1e3:.0f}ms "
+      f"fence_ok={dev <= 2.0*host}", flush=True)
+assert dev <= 2.0 * host, "clean run must pass the fence"
+
+# inject the regression the fence exists for: per-execution recompile
+def dirty_query():
+    tk.domain.copr._kernel_cache.clear()
+    tk.must_query(sql)
+dirty_query()
+dev_bad = best(2, dirty_query)
+print(f"injected recompile: dev {dev_bad*1e3:.0f}ms "
+      f"fence_trips={dev_bad > 2.0*host}", flush=True)
+assert dev_bad > 2.0 * host, "fence must trip on per-run recompiles"
+print("FENCE PROOF OK")
