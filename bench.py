@@ -322,10 +322,12 @@ def main():
         return htap_main(live)
     if os.environ.get("BENCH_MODE") == "oltp":
         return oltp_main(live)
-    # default scale: SF10 on a live chip (BASELINE stage 3-4 territory);
-    # SF1 on CPU fallback so a missing grant still records a full
-    # 22-query artifact instead of timing out mid-run
-    sf = float(os.environ.get("BENCH_SF", "10" if live else "1"))
+    # default scale: SF1 either way — a first-ever on-chip run must
+    # finish inside whatever grant window exists (cold sort/agg
+    # compiles at SF10 shapes can take minutes each); the bench loop's
+    # staged escalation owns SF10, and the committed
+    # BENCH_SF10_cpu.json artifact covers BASELINE stages 3-4 evidence
+    sf = float(os.environ.get("BENCH_SF", "1"))
     qenv = os.environ.get("BENCH_QUERIES", "all")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     # the single-threaded numpy baseline can take minutes/query at SF10;
