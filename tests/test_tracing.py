@@ -65,3 +65,19 @@ def test_structured_log_redacts_literals(tmp_path, monkeypatch):
     assert slow, recs
     assert all("topsecretvalue" not in json.dumps(r) for r in slow)
     assert any("?" in r.get("sql", "") for r in slow)
+
+
+def test_slow_log_carries_phase_counters():
+    """A slow statement's record attributes its backend time (dispatch/
+    upload/host counters from utils/phase.py) without a rerun."""
+    from tidb_tpu.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create table ph (a int primary key, b int)")
+    tk.must_exec("insert into ph values " + ",".join(
+        f"({i}, {i % 7})" for i in range(1, 3001)))
+    tk.must_exec("set @@tidb_slow_log_threshold = 0")
+    tk.must_query("select b, count(*) from ph group by b order by b")
+    entry = tk.domain.slow_log[-1]
+    assert isinstance(entry.get("phases"), dict)
+    # the group-by ran a backend: at least one counter is present
+    assert entry["phases"], entry

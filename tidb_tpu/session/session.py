@@ -144,6 +144,11 @@ class Session:
         rg = self.domain.resource_groups.groups.get(self.resource_group)
         if rg is not None:
             rg.admit()               # token-bucket admission control
+        # per-statement backend phase counters: reset at the OUTERMOST
+        # statement only (internal SQL fired mid-statement — stats sync
+        # load, TTL — accumulates into its triggering statement)
+        from ..utils import phase as _phase
+        _phase.stmt_enter()
         start = time.time()
         with self.domain.tracer.span("statement", conn_id=self.conn_id,
                                      stmt=type(stmt).__name__):
@@ -155,6 +160,8 @@ class Session:
                 self._observe(stmt, sql, start, ok=False, rgroup=rg)
                 self._finish_stmt(error=True)
                 raise
+            finally:
+                _phase.stmt_leave()
 
     def _observe(self, stmt, sql, start, ok, rgroup=None):
         """Slow log + statement summary (reference slow_log.go:373 +
@@ -184,10 +191,16 @@ class Session:
             # statement knew it was slow)
             self.domain.tracer.tag(slow=1)
             self.domain.flight_recorder.tag_recent(self.conn_id, start)
+            # backend phase counters (utils/phase.py) ride along: a slow
+            # statement's record says WHERE its time went (dispatch/
+            # compile/upload/host) without a rerun — reference
+            # execdetails in the slow log (slow_log.go:373)
+            from ..utils import phase as _phase
             self.domain.slow_log.append({
                 "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
                 "stmt": type(stmt).__name__, "conn": self.conn_id,
-                "db": self.vars.current_db, "success": ok})
+                "db": self.vars.current_db, "success": ok,
+                "phases": _phase.snap()})
             from ..utils import logutil
             # the digest normalization IS the redaction (one parse,
             # shared with the statement summary below)

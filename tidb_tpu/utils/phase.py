@@ -25,10 +25,24 @@ import time
 
 STATS: dict = {}
 SYNC = os.environ.get("TIDB_TPU_PHASE_SYNC") == "1"
+_DEPTH = [0]        # statement nesting: internal SQL fired inside a
+                    # user statement must not clobber its counters
 
 
 def reset():
     STATS.clear()
+
+
+def stmt_enter():
+    """Called at statement start: reset ONLY for the outermost
+    statement; nested (internal-SQL) statements accumulate into it."""
+    if _DEPTH[0] == 0:
+        STATS.clear()
+    _DEPTH[0] += 1
+
+
+def stmt_leave():
+    _DEPTH[0] = max(_DEPTH[0] - 1, 0)
 
 
 def add(key, val):
