@@ -896,15 +896,17 @@ class PlanBuilder:
                     # NULL probes
                     join.null_aware = True
                     return join
-                if not others and not (_stmt_has_agg(c.subquery) or
-                                       c.subquery.group_by):
+                if not others and not (_stmt_has_agg(c.subquery) and
+                                       not c.subquery.group_by):
                     # correlated NOT IN: full 3-valued semantics per
                     # correlation group (executor _naaj_correlated) —
                     # eq_conds keep correlation pairs first, value
-                    # last. Aggregate subqueries stay on the guard
-                    # path: the decorrelated Aggregation makes empty
-                    # groups unrepresentable (a scalar agg yields one
-                    # NULL/0 row), so "empty group" tests would lie.
+                    # last. GROUPED subqueries (with or without aggs)
+                    # qualify: an absent correlation has no grouped
+                    # rows, so "empty set" is representable. Only
+                    # SCALAR aggregates (one row always, NULL/0 over
+                    # empty) are different — they take the LEFT-join
+                    # rewrite below.
                     join.null_aware = True
                     join.naaj_corr = len(join.eq_conds) - 1
                     return join
@@ -1116,9 +1118,9 @@ class PlanBuilder:
                     outs = [rw.rewrite(f.expr)]
             return p, eq_pairs, others, outs
         # aggregation: group by the correlated inner columns
-        if stmt.group_by:
+        if stmt.having is not None:
             raise UnsupportedError(
-                "correlated subquery with explicit GROUP BY")
+                "correlated subquery with HAVING")
         for e in others:
             # general correlated conds under an aggregate change semantics
             raise UnsupportedError(
@@ -1131,6 +1133,24 @@ class PlanBuilder:
                 seen_group.add(inner.idx)
                 group_items.append(inner)
                 agg_schema.append(SchemaCol(inner, inner.name or "gk"))
+        # explicit GROUP BY: the user's (uncorrelated) group exprs join
+        # the correlation keys — per correlation value the subquery then
+        # yields one row per present user-group, and an absent
+        # correlation has NO rows (empty set), so semi/anti/naaj joins
+        # keep their exact semantics
+        for ge in stmt.group_by or ():
+            rwg = self._rewriter(p.schema)
+            rwg.outer_schemas = [outer_schema]
+            g = rwg.rewrite(ge)
+            if rwg.outer_used:
+                raise UnsupportedError(
+                    "outer reference in subquery GROUP BY")
+            if isinstance(g, Column) and g.idx in seen_group:
+                continue
+            group_items.append(g)
+            agg_schema.append(SchemaCol(
+                g if isinstance(g, Column)
+                else self._new_col(g.ft, repr(g)), repr(g)))
         aggs = []
         agg_map = {}
 
@@ -1154,6 +1174,24 @@ class PlanBuilder:
         rw = self._rewriter(p.schema, agg_mapper)
         f = stmt.fields[0]
         out_expr = rw.rewrite(f.expr)
+        # the selected field must resolve over the AGGREGATED schema:
+        # aggs map via agg_mapper, plain group columns are in the
+        # schema, and a non-column group EXPRESSION field maps to its
+        # output column by fingerprint (select i.id % 2 ... group by
+        # i.id % 2); anything else cannot decorrelate
+        schema_ids = {sc.col.idx for sc in agg_schema.cols}
+        refs = set()
+        out_expr.collect_columns(refs)
+        if not refs <= schema_ids:
+            for gi, sc in zip(group_items, agg_schema.cols):
+                if not isinstance(gi, Column) and \
+                        gi.fingerprint() == out_expr.fingerprint():
+                    out_expr = sc.col
+                    break
+            else:
+                raise UnsupportedError(
+                    "subquery select field is neither an aggregate "
+                    "nor a GROUP BY expression")
         agg = Aggregation(group_items, aggs, agg_schema, p)
         agg.stats_rows = min(p.stats_rows, max(p.stats_rows * 0.1, 1.0))
         return agg, eq_pairs, others, [out_expr]
