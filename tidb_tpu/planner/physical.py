@@ -1673,27 +1673,27 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
             est_groups > _FUSE_MAX_GROUP_RATIO * est_fact:
         return None
     # build-side mass gate (Q21's EXISTS/NOT-EXISTS class): four
-    # per-orderkey aggregate dims each MATERIALIZE an aggregation over
-    # ~the whole fact — their builds + sort metas dominate and blow the
-    # matdim budget (SF10 measured: fused 313s vs host semi-joins 38s).
-    # The host hash join owns shapes whose dim mass rivals the fact.
-    # Aggregate-subquery dims count their INPUT mass (output stats are
-    # unreliable); plain dims their raw size.
-    def build_mass(leaf):
-        if isinstance(leaf, _AggLeaf):
-            total = 0.0
-            stack = [leaf.plan]
-            while stack:
-                p0 = stack.pop()
-                if isinstance(p0, (PhysTableReader, PhysFusedPipeline)):
-                    total += max(getattr(p0, "raw_rows", 0.0) or 0.0,
-                                 p0.stats_rows or 0.0)
-                stack.extend(getattr(p0, "children", []))
-            return total
-        return max(getattr(leaf, "raw_rows", 0.0) or 0.0,
-                   getattr(leaf, "stats_rows", 0.0) or 0.0)
-    dim_rows = sum(build_mass(l) for l in leaves if l is not fact) + \
-        sum(build_mass(l) for l, _jt, _ec, _n in outer_dims)
+    # per-orderkey AGGREGATE dims each MATERIALIZE an aggregation over
+    # ~the whole fact, and those results rebuild whenever the byte-
+    # bounded matdim cache evicts them (SF10 measured: fused 313s vs
+    # host semi-joins 38s). ONLY aggregate-subquery dims count — a
+    # plain table dim (q4's lineitem semi) sorts once per version and
+    # is cached by the engine itself, and gating it cost q4 its 6x win.
+    # Input mass is used (aggregate output stats are unreliable).
+    def agg_mass(leaf):
+        if not isinstance(leaf, _AggLeaf):
+            return 0.0
+        total = 0.0
+        stack = [leaf.plan]
+        while stack:
+            p0 = stack.pop()
+            if isinstance(p0, (PhysTableReader, PhysFusedPipeline)):
+                total += max(getattr(p0, "raw_rows", 0.0) or 0.0,
+                             p0.stats_rows or 0.0)
+            stack.extend(getattr(p0, "children", []))
+        return total
+    dim_rows = sum(agg_mass(l) for l in leaves if l is not fact) + \
+        sum(agg_mass(l) for l, _jt, _ec, _n in outer_dims)
     if dim_rows > _FUSE_MAX_DIM_MASS_ABS and \
             dim_rows > _FUSE_MAX_DIM_MASS_RATIO * est_fact:
         return None
