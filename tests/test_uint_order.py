@@ -39,3 +39,18 @@ def test_uint_values_render(tk):
         "select v from u where id in (1, 3) order by v desc").rs.rows]
     assert [str(x) for x in got] == ["18446744073709551615",
                                     "9223372036854775808"]
+
+
+def test_compound_interval_window_frame():
+    """Pin: RANGE frames accept compound fixed-width units
+    (DAY_HOUR '1 2' = 26 hours; landed round 4, README said rejected)."""
+    from tidb_tpu.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create table wf (id int primary key, t datetime, v int)")
+    tk.must_exec("insert into wf values (1,'2020-01-01 00:00:00',1),"
+                 "(2,'2020-01-02 01:00:00',2),(3,'2020-01-03 03:00:00',3)")
+    rows = tk.must_query(
+        "select id, sum(v) over (order by t range between "
+        "interval '1 2' day_hour preceding and current row) as s "
+        "from wf order by id").rs.rows
+    assert [(r[0], int(r[1])) for r in rows] == [(1, 1), (2, 3), (3, 5)]
