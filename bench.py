@@ -333,6 +333,39 @@ def main():
     # the single-threaded numpy baseline can take minutes/query at SF10;
     # cap total baseline time so it can't starve the device measurement
     cpu_budget = float(os.environ.get("BENCH_CPU_BUDGET", "900"))
+    # BENCH_CPU_FROM=<artifact.json>: take per-query cpu_ms from a
+    # committed clean-host artifact instead of re-running the host path
+    # in-process. Under the axon tunnel the host path is distorted
+    # ~100x — the columnar store is device-resident, so even
+    # use_device=False pays a tunnel round-trip per column fetch
+    # (measured 2026-07-31: q3 host 140.7s under axon vs 475ms on the
+    # cpu backend). The honest baseline is the same engine + dataset
+    # (same sf/seed) on the JAX cpu backend, which is exactly what the
+    # committed BENCH_SF*_cpu.json artifacts record.
+    cpu_from = os.environ.get("BENCH_CPU_FROM")
+    cpu_ref = {}
+    if cpu_from:
+        # when the reference artifact is unusable, still NEVER run the
+        # in-process baseline: the caller asked for an external baseline
+        # precisely because the in-process one is distorted here, and a
+        # silent fallback would publish ~100x-inflated speedups
+        cpu_budget = -1.0
+        try:
+            with open(cpu_from) as f:
+                ref = json.load(f)
+            import re
+            m = re.search(r"sf([0-9.]+)", ref.get("metric", ""))
+            want_sf = float(os.environ.get("BENCH_SF", "1"))
+            if not m or abs(float(m.group(1)) - want_sf) > 1e-9:
+                print(f"# BENCH_CPU_FROM sf mismatch "
+                      f"({ref.get('metric')} vs sf{want_sf}): baselines "
+                      "skipped", file=sys.stderr)
+            else:
+                cpu_ref = {q: v["cpu_ms"] for q, v in
+                           ref.get("queries", {}).items() if "cpu_ms" in v}
+        except Exception as e:                      # noqa: BLE001
+            print(f"# BENCH_CPU_FROM unreadable ({e}): baselines skipped",
+                  file=sys.stderr)
 
     from tidb_tpu.testkit import TestKit
     from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
@@ -393,9 +426,25 @@ def main():
             print(f"# {q}: DEVICE PATH ERROR {e}", file=sys.stderr)
             per_query[q] = {"error": str(e)[:120]}
             continue
+        if cpu_ref:
+            tpu_times[q] = t_tpu
+            per_query[q] = {"ms": round(t_tpu * 1000, 1),
+                            "backend": "tpu" if live else "cpu"}
+            if q in cpu_ref:
+                t_cpu = cpu_ref[q] / 1000.0
+                speedups.append(t_cpu / t_tpu)
+                per_query[q].update({
+                    "cpu_ms": cpu_ref[q],
+                    "cpu_ms_src": os.path.basename(cpu_from),
+                    "speedup": round(t_cpu / t_tpu, 2)})
+                print(f"# {q}: tpu={t_tpu*1000:.1f}ms "
+                      f"cpu[ref]={cpu_ref[q]:.1f}ms "
+                      f"speedup={t_cpu/t_tpu:.2f}x", file=sys.stderr)
+            continue
         if cpu_spent > cpu_budget:
             per_query[q] = {"ms": round(t_tpu * 1000, 1),
-                            "cpu_skipped": "baseline budget exhausted",
+                            "cpu_skipped": "BENCH_CPU_FROM unusable"
+                            if cpu_from else "baseline budget exhausted",
                             "backend": "tpu" if live else "cpu"}
             tpu_times[q] = t_tpu
             continue
@@ -459,7 +508,7 @@ def main():
     if not live:
         unit += " [CPU FALLBACK — not a TPU measurement]"
     write_sidecar()
-    print(json.dumps({
+    out = {
         "metric": f"tpch_sf{sf}_scan_agg_throughput",
         "value": round(q6_rows_per_s, 1),
         "unit": unit,
@@ -468,7 +517,14 @@ def main():
         "load_s": round(load_s, 1),
         "peak_rss_gb": peak_rss_gb(),
         "queries": per_query,
-    }))
+    }
+    if cpu_ref:
+        out["baseline_source"] = (
+            f"{os.path.basename(cpu_from)}: same engine+dataset "
+            "(sf/seed) host path on the JAX cpu backend; in-process "
+            "host runs under the axon tunnel are distorted by per-op "
+            "round-trips (device-resident columnar store)")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
     elif [ ! -s "$Q" ]; then
       BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 \
         BENCH_SF=1 BENCH_QUERIES=q1,q3,q5,q6 BENCH_REPEATS=3 \
+        BENCH_CPU_FROM=/root/repo/BENCH_SF1_cpu.json \
         BENCH_PHASES_PATH=/root/repo/BENCH_TPU_quick_phases.json \
         timeout 1800 python bench.py > /tmp/bench_quick_try.json 2>>"$LOG"
       grep -q '"backend": "tpu"' /tmp/bench_quick_try.json 2>/dev/null && \
@@ -48,7 +49,8 @@ print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
         echo "$(date +%F' '%H:%M:%S) quick TPU bench SAVED" >> "$LOG"
     elif [ ! -s "$F" ]; then
       BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=240 \
-        BENCH_SF=1 BENCH_PHASES_PATH=/root/repo/BENCH_TPU_full_phases.json \
+        BENCH_SF=1 BENCH_CPU_FROM=/root/repo/BENCH_SF1_cpu.json \
+        BENCH_PHASES_PATH=/root/repo/BENCH_TPU_full_phases.json \
         timeout 5400 python bench.py > /tmp/bench_full_try.json 2>>"$LOG"
       grep -q '"backend": "tpu"' /tmp/bench_full_try.json 2>/dev/null && \
         cp /tmp/bench_full_try.json "$F" && \
