@@ -876,6 +876,9 @@ class PlanBuilder:
         if isinstance(c, ast.ExistsSubquery):
             splan, eq_pairs, others, _ = self.build_corr_subquery(
                 c.subquery, p.schema, out_fields=False)
+            mm = self._try_minmax_exists(c, p, splan, eq_pairs, others)
+            if mm is not None:
+                return mm
             jt = "anti" if c.negated else "semi"
             return self._mk_semi_join(jt, p, splan, eq_pairs, others)
         if isinstance(c, ast.InSubquery):
@@ -1056,6 +1059,83 @@ class PlanBuilder:
                 repl[id(node)] = out
                 p = join
         return p
+
+    def _try_minmax_exists(self, c, p, splan, eq_pairs, others):
+        """EXISTS (SELECT … FROM t WHERE t.k = outer.k AND t.c <op> e):
+        only the extreme inner values per correlation key can decide a
+        single monotone comparison, so decorrelate into a LEFT join
+        against GROUP BY k → MIN/MAX(c) instead of a semi/anti join
+        carrying the whole inner table (the classic Q21 self-join
+        reduction; the reference keeps the semi join and pays for it —
+        rule_decorrelate.go). Exact under 3VL: MIN/MAX ignore NULL c,
+        an absent key yields NULL extremes, and NOT EXISTS keeps rows
+        where the EXISTS predicate is not TRUE (NOT(IFNULL(P, 0)))."""
+        if isinstance(splan, Aggregation) or len(others) != 1:
+            return None
+        cond = others[0]
+        if not (isinstance(cond, ScalarFunc) and len(cond.args) == 2 and
+                cond.op in ("!=", "<", "<=", ">", ">=")):
+            return None
+        inner_ids = {sc.col.idx for sc in splan.schema.cols}
+        if not eq_pairs or not all(isinstance(i, Column) and
+                                   i.idx in inner_ids
+                                   for _, i in eq_pairs):
+            return None
+
+        def cols_of(e):
+            s = set()
+            e.collect_columns(s)
+            return s
+
+        l, r = cond.args
+        lc, rc = cols_of(l), cols_of(r)
+        if lc and lc <= inner_ids and not (rc & inner_ids):
+            inner_e, outer_e, op = l, r, cond.op
+        elif rc and rc <= inner_ids and not (lc & inner_ids):
+            inner_e, outer_e, op = r, l, {"<": ">", "<=": ">=", ">": "<",
+                                          ">=": "<=", "!=": "!="}[cond.op]
+        else:
+            return None
+        group_items, agg_schema, seen = [], Schema(), set()
+        for _, inner in eq_pairs:
+            if inner.idx not in seen:
+                seen.add(inner.idx)
+                group_items.append(inner)
+                agg_schema.append(SchemaCol(inner, inner.name or "gk",
+                                            hidden=True))
+        aggs, acols = [], {}
+        need = ("min", "max") if op == "!=" else \
+            (("min",) if op in ("<", "<=") else ("max",))
+        for name in need:
+            desc = AggDesc(name=name, args=[inner_e], distinct=False)
+            desc.ft = agg_result_ft(name, [inner_e], False)
+            col = self._new_col(desc.ft, repr(desc))
+            aggs.append(desc)
+            acols[name] = col
+            agg_schema.append(SchemaCol(col, repr(desc), hidden=True))
+        agg = Aggregation(group_items, aggs, agg_schema, splan)
+        agg.stats_rows = min(splan.stats_rows,
+                             max(splan.stats_rows * 0.1, 1.0))
+        schema = Schema(list(p.schema.cols) + list(agg_schema.cols))
+        join = LJoin("left", p, agg, schema)
+        join.stats_rows = p.stats_rows
+        for o, i in eq_pairs:
+            join.eq_conds.append((o, i))
+        rw = self._rewriter(schema)
+        if op == "!=":
+            pred = rw.mk_func(
+                "or", [rw.mk_func("!=", [acols["min"], outer_e]),
+                       rw.mk_func("!=", [acols["max"], outer_e])])
+        elif op in ("<", "<="):
+            pred = rw.mk_func(op, [acols["min"], outer_e])
+        else:
+            pred = rw.mk_func(op, [acols["max"], outer_e])
+        if c.negated:
+            pred = rw.mk_func(
+                "not", [rw.mk_func("ifnull", [pred, const_from_py(0)])])
+        sel = Selection([pred], join)
+        sel.stats_rows = max(p.stats_rows * 0.5, 1.0)
+        return sel
 
     def _mk_semi_join(self, jt, p, splan, eq_pairs, others):
         schema = Schema(list(p.schema.cols))
