@@ -61,6 +61,64 @@ def snap():
     return out
 
 
+def _install_fetch_timer():
+    """Time every device->host materialization centrally by wrapping
+    jax.Array's host-conversion dunders: __array__ (bulk fetches via
+    np.asarray) as fetch_s/fetch_bytes, and scalar conversions
+    (__bool__/__int__/__float__/__index__) as sync_s — each of those is
+    a blocking device round-trip (on the axon tunnel, a network one).
+    The round-4 verdict's missing column: dispatch was accounted, the
+    result fetch was not, and on TPU the fetch is where a small query's
+    wall time lives."""
+    try:
+        from jax._src.array import ArrayImpl
+    except Exception as e:                          # noqa: BLE001
+        # never silent: without this the fetch_s/sync_s columns the
+        # bench sidecar documents just vanish (e.g. a jax upgrade
+        # moving jax._src.array)
+        import sys
+        print(f"# phase: fetch timer NOT installed ({e}); "
+              "fetch_s/sync_s will be absent", file=sys.stderr)
+        return
+    if getattr(ArrayImpl, "_tidb_fetch_timed", False):
+        return
+
+    orig_array = ArrayImpl.__array__
+
+    def timed_array(self, *a, **kw):
+        t0 = time.perf_counter()
+        out = orig_array(self, *a, **kw)
+        add("fetch_s", time.perf_counter() - t0)
+        add("fetch_bytes", getattr(out, "nbytes", 0))
+        inc("fetches")
+        return out
+
+    ArrayImpl.__array__ = timed_array
+
+    for name in ("__bool__", "__int__", "__float__", "__index__"):
+        orig = getattr(ArrayImpl, name, None)
+        if orig is None:
+            continue
+
+        def timed_scalar(self, _orig=orig):
+            t0 = time.perf_counter()
+            out = _orig(self)
+            add("sync_s", time.perf_counter() - t0)
+            inc("syncs")
+            return out
+
+        setattr(ArrayImpl, name, timed_scalar)
+    ArrayImpl._tidb_fetch_timed = True
+
+
+try:
+    _install_fetch_timer()
+except Exception as _e:                             # noqa: BLE001
+    import sys as _sys
+    print(f"# phase: fetch timer NOT installed ({_e}); "
+          "fetch_s/sync_s will be absent", file=_sys.stderr)
+
+
 def timed_kernel(kind, fn):
     """Wrap a compiled kernel callable with dispatch accounting.
     First call is recorded separately (it pays the XLA trace+compile)."""
