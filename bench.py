@@ -419,7 +419,98 @@ def main():
     per_query = {}
     tpu_times = {}
     cpu_spent = 0.0
+
+    def write_sidecar():
+        # per-query phase decomposition (dispatch counts, kernel/
+        # compile/upload/host ms): a losing query's time is
+        # attributable without a rerun (round-4 verdict weak #2)
+        side = os.environ.get(
+            "BENCH_PHASES_PATH", os.path.join(_REPO, "BENCH_PHASES.json"))
+        try:
+            with open(side, "w") as f:
+                json.dump({"sf": sf, "backend": "tpu" if live
+                           else "cpu-fallback", "phases": phases}, f,
+                          indent=1, sort_keys=True)
+        except Exception as e:                      # noqa: BLE001
+            print(f"# sidecar write failed: {e}", file=sys.stderr)
+
+    emitted = []
+
+    def finish(stalled_at=None):
+        if emitted:
+            return
+        emitted.append(True)
+        if not speedups and not tpu_times:
+            write_sidecar()
+            print(json.dumps({"metric": f"tpch_sf{sf}", "value": 0,
+                              "unit": "no query completed",
+                              "vs_baseline": 0,
+                              "backend": "error", "queries": per_query}))
+            return
+        # vs_baseline is 0 when every CPU baseline was skipped (stage-0
+        # micro capture: BENCH_CPU_BUDGET<0 spends the whole window on
+        # the device measurement; the geomean comes from a later stage)
+        geo = math.exp(sum(math.log(s) for s in speedups)
+                       / len(speedups)) if speedups else 0.0
+        if "q6" in tpu_times:
+            hq, ht = "q6", tpu_times["q6"]
+        else:                # no q6: slowest survivor (never inflates)
+            hq = max(tpu_times, key=tpu_times.get)
+            ht = tpu_times[hq]
+        q6_rows_per_s = n_rows / ht
+        unit = f"rows/s/chip ({hq} full-stack, {len(speedups)}q geomean)"
+        if not live:
+            unit += " [CPU FALLBACK — not a TPU measurement]"
+        write_sidecar()
+        out = {
+            "metric": f"tpch_sf{sf}_scan_agg_throughput",
+            "value": round(q6_rows_per_s, 1),
+            "unit": unit,
+            "vs_baseline": round(geo, 3),
+            "backend": "tpu" if live else "cpu-fallback",
+            "load_s": round(load_s, 1),
+            "peak_rss_gb": peak_rss_gb(),
+            "queries": per_query,
+        }
+        if stalled_at is not None:
+            out["stalled_at"] = stalled_at
+            out["unit"] += (f" [PARTIAL: device stalled at {stalled_at}"
+                            " — grant lost mid-run]")
+        if cpu_ref:
+            out["baseline_source"] = (
+                f"{os.path.basename(cpu_from)}: same engine+dataset "
+                "(sf/seed) host path on the JAX cpu backend; in-process "
+                "host runs under the axon tunnel are distorted by "
+                "per-op round-trips (device-resident columnar store)")
+        print(json.dumps(out))
+
+    # a revoked device grant blocks the in-flight jax call forever; the
+    # watchdog emits whatever completed as a PARTIAL artifact and hard-
+    # exits so the capture loop can re-probe instead of burning the
+    # stage timeout stuck (grant windows are the scarce resource here)
+    progress = {"t": time.time(), "q": None}
+    stall_s = float(os.environ.get("BENCH_STALL_S", "600"))
+
+    def watchdog():
+        import threading as _t            # noqa: F401  (doc only)
+        while not emitted:
+            time.sleep(10)
+            if time.time() - progress["t"] > stall_s:
+                print(f"# WATCHDOG: no progress for {stall_s:.0f}s "
+                      f"(stuck in {progress['q']}); emitting partial "
+                      "artifact", file=sys.stderr)
+                finish(stalled_at=progress["q"])
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(0)
+
+    if live and stall_s > 0:
+        import threading
+        threading.Thread(target=watchdog, daemon=True).start()
+
     for q in queries:
+        progress["q"] = q
+        progress["t"] = time.time()
         try:
             t_tpu = run(q, True)
         except Exception as e:                      # noqa: BLE001
@@ -450,6 +541,7 @@ def main():
             continue
         try:
             t0 = time.time()
+            progress["t"] = t0        # baseline runs restart the clock
             # no compile on the host path: one un-warmed run per query,
             # so the budget covers as many queries as possible
             t_cpu = run(q, False, n_runs=1, warmup=False)
@@ -473,58 +565,7 @@ def main():
         }
         print(f"# {q}: tpu={t_tpu*1000:.1f}ms cpu={t_cpu*1000:.1f}ms "
               f"speedup={t_cpu/t_tpu:.2f}x", file=sys.stderr)
-    def write_sidecar():
-        # per-query phase decomposition (dispatch counts, kernel/
-        # compile/upload/host ms): a losing query's time is
-        # attributable without a rerun (round-4 verdict weak #2)
-        side = os.environ.get(
-            "BENCH_PHASES_PATH", os.path.join(_REPO, "BENCH_PHASES.json"))
-        try:
-            with open(side, "w") as f:
-                json.dump({"sf": sf, "backend": "tpu" if live
-                           else "cpu-fallback", "phases": phases}, f,
-                          indent=1, sort_keys=True)
-        except Exception as e:                      # noqa: BLE001
-            print(f"# sidecar write failed: {e}", file=sys.stderr)
-
-    if not speedups and not tpu_times:
-        write_sidecar()
-        print(json.dumps({"metric": f"tpch_sf{sf}", "value": 0,
-                          "unit": "no query completed", "vs_baseline": 0,
-                          "backend": "error", "queries": per_query}))
-        return
-    # vs_baseline is 0 when every CPU baseline was skipped (stage-0
-    # micro capture: BENCH_CPU_BUDGET<0 spends the whole window on the
-    # device measurement; the geomean comes from a later full stage)
-    geo = math.exp(sum(math.log(s) for s in speedups)
-                   / len(speedups)) if speedups else 0.0
-    if "q6" in tpu_times:
-        hq, ht = "q6", tpu_times["q6"]
-    else:                    # no q6: slowest survivor (never inflates)
-        hq = max(tpu_times, key=tpu_times.get)
-        ht = tpu_times[hq]
-    q6_rows_per_s = n_rows / ht
-    unit = f"rows/s/chip ({hq} full-stack, {len(speedups)}q geomean)"
-    if not live:
-        unit += " [CPU FALLBACK — not a TPU measurement]"
-    write_sidecar()
-    out = {
-        "metric": f"tpch_sf{sf}_scan_agg_throughput",
-        "value": round(q6_rows_per_s, 1),
-        "unit": unit,
-        "vs_baseline": round(geo, 3),
-        "backend": "tpu" if live else "cpu-fallback",
-        "load_s": round(load_s, 1),
-        "peak_rss_gb": peak_rss_gb(),
-        "queries": per_query,
-    }
-    if cpu_ref:
-        out["baseline_source"] = (
-            f"{os.path.basename(cpu_from)}: same engine+dataset "
-            "(sf/seed) host path on the JAX cpu backend; in-process "
-            "host runs under the axon tunnel are distorted by per-op "
-            "round-trips (device-resident columnar store)")
-    print(json.dumps(out))
+    finish()
 
 
 if __name__ == "__main__":

@@ -2,8 +2,10 @@
 Usage: python scripts/perf_shell.py <sf>  — then feed python statements, one
 compound block per '---' separated chunk, via a FIFO or here-doc."""
 import os, sys, time
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"     # hard-set: the image env says axon
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import tests.conftest  # noqa: F401,E402  (unregister the axon factory)
 from tidb_tpu.testkit import TestKit
 from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
 sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0

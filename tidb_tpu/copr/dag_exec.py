@@ -1656,19 +1656,31 @@ def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
         keys.append(np.where(nm, 0, d))
         key_nulls.append(nm)
     idx = np.nonzero(mask)[0]
+    starts = None       # run starts when keys arrive pre-sorted
     if keys:
-        kmat = np.stack([np.where(kn, -1, k)[idx]
-                         for k, kn in zip(keys, key_nulls)], axis=1)
-        uniq, inverse = np.unique(kmat, axis=0, return_inverse=True)
-        ngroups = len(uniq)
-        seg_of_row = np.full(ctx.n, -1, dtype=np.int64)
-        seg_of_row[idx] = inverse
-        first = np.zeros(ngroups, dtype=np.int64)
-        seen = np.full(ngroups, -1, dtype=np.int64)
-        np.maximum.at(seen, inverse, idx)
-        # first occurrence: use minimum
-        firsts = np.full(ngroups, np.iinfo(np.int64).max, dtype=np.int64)
-        np.minimum.at(firsts, inverse, idx)
+        kvecs = [np.where(kn, -1, k)[idx] for k, kn in zip(keys, key_nulls)]
+        if len(kvecs) == 1 and len(kvecs[0]) > 1024 and \
+                bool(np.all(kvecs[0][:-1] <= kvecs[0][1:])):
+            # pre-sorted single key (clustered-PK order, e.g. GROUP BY
+            # l_orderkey over lineitem): group boundaries are run
+            # boundaries — no argsort, and the agg loop below uses
+            # exact dtype-preserving ufunc.reduceat instead of the
+            # unbuffered (slow) ufunc.at scatters
+            kv = kvecs[0]
+            change = np.empty(len(kv), dtype=bool)
+            change[0] = True
+            np.not_equal(kv[1:], kv[:-1], out=change[1:])
+            starts = np.nonzero(change)[0]
+            ngroups = len(starts)
+            inverse = np.cumsum(change) - 1
+            firsts = idx[starts]
+        else:
+            kmat = np.stack(kvecs, axis=1)
+            uniq, inverse = np.unique(kmat, axis=0, return_inverse=True)
+            ngroups = len(uniq)
+            firsts = np.full(ngroups, np.iinfo(np.int64).max,
+                             dtype=np.int64)
+            np.minimum.at(firsts, inverse, idx)
         out_keys = [k[firsts] for k in keys]
         out_key_nulls = [kn[firsts] for kn in key_nulls]
     else:
@@ -1688,28 +1700,53 @@ def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
         else:
             dv = np.ones(len(idx), dtype=np.int64)
             ok = np.ones(len(idx), dtype=bool)
-        cnt = np.zeros(ngroups, dtype=np.int64)
-        np.add.at(cnt, inverse, ok.astype(np.int64))
+        if starts is not None:
+            cnt = np.add.reduceat(ok.astype(np.int64), starts)
+        else:
+            cnt = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(cnt, inverse, ok.astype(np.int64))
         if a.name == "count":
             states.append([cnt])
         elif a.name in ("sum", "avg"):
-            s = np.zeros(ngroups, dtype=dv.dtype)
-            np.add.at(s, inverse, np.where(ok, dv, 0))
+            if starts is not None:
+                s = np.add.reduceat(np.where(ok, dv, 0), starts)
+            else:
+                s = np.zeros(ngroups, dtype=dv.dtype)
+                np.add.at(s, inverse, np.where(ok, dv, 0))
             states.append([s, cnt])
         elif a.name == "first_row":
-            fi = np.full(ngroups, np.iinfo(np.int64).max, dtype=np.int64)
-            np.minimum.at(fi, inverse[ok], idx[ok])
-            fi = np.minimum(fi, max(ctx.n - 1, 0))
+            if starts is not None:
+                pos = np.where(ok, np.arange(len(idx)),
+                               np.iinfo(np.int64).max)
+                fp = np.minimum.reduceat(pos, starts)
+                fi = idx[np.minimum(fp, max(len(idx) - 1, 0))]
+                fi = np.where(fp == np.iinfo(np.int64).max,
+                              max(ctx.n - 1, 0), fi)
+            else:
+                fi = np.full(ngroups, np.iinfo(np.int64).max,
+                             dtype=np.int64)
+                np.minimum.at(fi, inverse[ok], idx[ok])
+                fi = np.minimum(fi, max(ctx.n - 1, 0))
             states.append([np.asarray(d)[fi], cnt])
         elif a.name == "min":
             big = np.inf if dv.dtype.kind == "f" else _I64_MAX
-            s = np.full(ngroups, big, dtype=dv.dtype)
-            np.minimum.at(s, inverse, np.where(ok, dv, big))
+            if starts is not None:
+                s = np.minimum.reduceat(
+                    np.where(ok, dv, np.asarray(big, dtype=dv.dtype)),
+                    starts)
+            else:
+                s = np.full(ngroups, big, dtype=dv.dtype)
+                np.minimum.at(s, inverse, np.where(ok, dv, big))
             states.append([s, cnt])
         elif a.name == "max":
             small = -np.inf if dv.dtype.kind == "f" else -_I64_MAX
-            s = np.full(ngroups, small, dtype=dv.dtype)
-            np.maximum.at(s, inverse, np.where(ok, dv, small))
+            if starts is not None:
+                s = np.maximum.reduceat(
+                    np.where(ok, dv, np.asarray(small, dtype=dv.dtype)),
+                    starts)
+            else:
+                s = np.full(ngroups, small, dtype=dv.dtype)
+                np.maximum.at(s, inverse, np.where(ok, dv, small))
             states.append([s, cnt])
         else:
             raise NotImplementedError(a.name)

@@ -1228,16 +1228,33 @@ class HashAggExec(Executor):
                 for i in range(ngk)]
         key_nulls = [np.concatenate([p.key_nulls[i] for p in live])
                      for i in range(ngk)]
+        starts = None      # run starts when partial keys arrive sorted
         if ngk:
-            kmat = np.stack([np.where(kn, -(1 << 62), k)
-                             for k, kn in zip(keys, key_nulls)], axis=1)
-            uniq, inverse = np.unique(kmat, axis=0, return_inverse=True)
-            g = len(uniq)
+            kvecs = [np.where(kn, -(1 << 62), k)
+                     for k, kn in zip(keys, key_nulls)]
+            if ngk == 1 and len(kvecs[0]) > 1024 and \
+                    bool(np.all(kvecs[0][:-1] <= kvecs[0][1:])):
+                # partials over range partitions of a clustered key
+                # concatenate in key order: merge by runs, no argsort
+                kv = kvecs[0]
+                change = np.empty(len(kv), dtype=bool)
+                change[0] = True
+                np.not_equal(kv[1:], kv[:-1], out=change[1:])
+                starts = np.nonzero(change)[0]
+                g = len(starts)
+                inverse = np.cumsum(change) - 1
+                firsts = starts
+            else:
+                kmat = np.stack(kvecs, axis=1)
+                uniq, inverse = np.unique(kmat, axis=0,
+                                          return_inverse=True)
+                g = len(uniq)
         else:
             g = 1
             inverse = np.zeros(sum(p.ngroups for p in live), dtype=np.int64)
-        firsts = np.full(g, _I64_MAX, dtype=np.int64)
-        np.minimum.at(firsts, inverse, np.arange(len(inverse)))
+        if starts is None:
+            firsts = np.full(g, _I64_MAX, dtype=np.int64)
+            np.minimum.at(firsts, inverse, np.arange(len(inverse)))
         out_cols = []
         for i, gi in enumerate(plan.group_items):
             data = keys[i][firsts]
@@ -1249,7 +1266,7 @@ class HashAggExec(Executor):
             st = [np.concatenate([p.states[ai][si] for p in live])
                   for si in range(len(live[0].states[ai]))]
             out_cols.append(self._finalize(desc, st, inverse, g,
-                                           state_dicts[ai]))
+                                           state_dicts[ai], starts))
         return Chunk(out_cols)
 
     def _empty_global(self):
@@ -1263,18 +1280,22 @@ class HashAggExec(Executor):
                                    np.ones(1, dtype=bool)))
         return Chunk(cols)
 
-    def _finalize(self, desc, states, inverse, g, sdict):
+    def _finalize(self, desc, states, inverse, g, sdict, starts=None):
         name = desc.name
         ft = desc.ft
+
+        def seg_add(vals, out_dtype=None):
+            if starts is not None:
+                return np.add.reduceat(vals, starts)
+            o = np.zeros(g, dtype=out_dtype or vals.dtype)
+            np.add.at(o, inverse, vals)
+            return o
+
         if name == "count":
-            cnt = np.zeros(g, dtype=np.int64)
-            np.add.at(cnt, inverse, states[0])
-            return Column(ft, cnt)
+            return Column(ft, seg_add(states[0]))
         if name in ("sum", "avg"):
-            s = np.zeros(g, dtype=states[0].dtype)
-            np.add.at(s, inverse, states[0])
-            cnt = np.zeros(g, dtype=np.int64)
-            np.add.at(cnt, inverse, states[1])
+            s = seg_add(states[0])
+            cnt = seg_add(states[1])
             if name == "sum":
                 arg_ft = desc.args[0].ft if desc.args else ft
                 data = self._sum_to_ft(s, arg_ft, ft)
@@ -1284,13 +1305,16 @@ class HashAggExec(Executor):
             ident = (np.inf if states[0].dtype.kind == "f" else _I64_MAX)
             if name == "max":
                 ident = -ident if states[0].dtype.kind == "f" else -_I64_MAX
-            s = np.full(g, ident, dtype=states[0].dtype)
-            if name == "min":
-                np.minimum.at(s, inverse, states[0])
+            if starts is not None:
+                red = np.minimum if name == "min" else np.maximum
+                s = red.reduceat(states[0], starts)
             else:
-                np.maximum.at(s, inverse, states[0])
-            cnt = np.zeros(g, dtype=np.int64)
-            np.add.at(cnt, inverse, states[1])
+                s = np.full(g, ident, dtype=states[0].dtype)
+                if name == "min":
+                    np.minimum.at(s, inverse, states[0])
+                else:
+                    np.maximum.at(s, inverse, states[0])
+            cnt = seg_add(states[1])
             if sdict is not None:
                 # codes were reduced by rank? no — min/max on raw codes is
                 # wrong unless dict is sorted; handled by planner keeping
@@ -2036,8 +2060,15 @@ class HashJoinExec(Executor):
                 # device kernels unavailable/failed: host path is always
                 # correct; record and continue
                 self.ctx.sess.domain.inc_metric("device_join_fallback")
-        border = np.argsort(bv, kind="stable")
-        sbv = bv[border]
+        if len(bv) and bv.dtype.kind != "V" and \
+                (len(bv) == 1 or bool(np.all(bv[:-1] <= bv[1:]))):
+            # pre-sorted build keys (clustered-PK scans, grouped-agg
+            # outputs): O(n) check beats the O(n log n) argsort
+            border = np.arange(len(bv))
+            sbv = bv
+        else:
+            border = np.argsort(bv, kind="stable")
+            sbv = bv[border]
         if len(sbv) and sbv.dtype.kind != "V" and \
                 (len(sbv) == 1 or bool(np.all(sbv[1:] > sbv[:-1]))):
             # (void-packed multi-keys have no ufunc '>': they take the
