@@ -1,0 +1,41 @@
+#!/bin/bash
+# Follow-on capture: once the four primary TPU artifacts exist
+# (tpu_bench_loop.sh exits at that point), chase the stretch goal —
+# the full 22-query suite at SF10 on the real chip, where per-dispatch
+# tunnel latency amortizes over 60M-row columns. Saved the moment it
+# lands; clean host baselines come from BENCH_SF10_cpu.json.
+cd /root/repo || exit 1
+LOG=/root/repo/TPU_POLL_LOG.txt
+M=/root/repo/BENCH_TPU_micro.json
+Q=/root/repo/BENCH_TPU_quick.json
+F=/root/repo/BENCH_TPU_full.json
+H=/root/repo/BENCH_TPU_htap.json
+S=/root/repo/BENCH_TPU_SF10.json
+echo "$(date +%F' '%H:%M:%S) sf10 loop start (pid $$)" >> "$LOG"
+while true; do
+  if [ -s "$S" ]; then
+    echo "$(date +%F' '%H:%M:%S) SF10 TPU artifact saved — exiting" >> "$LOG"
+    exit 0
+  fi
+  # wait for the primary loop to finish its four stages first
+  if [ -s "$M" ] && [ -s "$Q" ] && [ -s "$F" ] && [ -s "$H" ]; then
+    if timeout 150 python -c "
+import jax, jax.numpy as jnp, numpy as np
+x = jnp.ones((256,256), jnp.bfloat16)
+np.asarray(x @ x)
+print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
+      echo "$(date +%F' '%H:%M:%S) TPU LIVE (sf10 stage)" >> "$LOG"
+      BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=300 \
+        BENCH_SF=10 BENCH_REPEATS=2 \
+        BENCH_CPU_FROM=/root/repo/BENCH_SF10_cpu.json \
+        BENCH_PHASES_PATH=/root/repo/BENCH_TPU_SF10_phases.json \
+        timeout 9000 python bench.py > /tmp/bench_sf10_try.json 2>>"$LOG"
+      grep -q '"backend": "tpu"' /tmp/bench_sf10_try.json 2>/dev/null && \
+        cp /tmp/bench_sf10_try.json "$S" && \
+        echo "$(date +%F' '%H:%M:%S) SF10 TPU bench SAVED" >> "$LOG"
+    else
+      echo "$(date +%F' '%H:%M:%S) no grant (sf10 stage)" >> "$LOG"
+    fi
+  fi
+  sleep 120
+done

@@ -400,3 +400,32 @@ class TestDirtyOverlay:
         tk.must_query(self.SQL)
         assert m.get("fused_pipeline_fallback", 0) == before + 1
         tk.must_exec("rollback")
+
+
+def test_pipelined_partitions_regrow(monkeypatch):
+    """Depth-2 partition pipelining with a consume-time group-bucket
+    regrow: partition 0's retry must re-upload ITS OWN buffers (not the
+    speculatively dispatched partition 1's, whose _bind_cols call
+    overwrote copr._bind_keys), and a successor dispatched with the
+    stale smaller bucket must re-run (ngroups is checked against the
+    bucket its kernel was BUILT with, agg_param[0], not the regrown
+    nonlocal)."""
+    monkeypatch.setenv("TIDB_TPU_DEVICE_ROWS", "2048")
+    tk = TestKit()
+    tk.must_exec("create table wide (id bigint primary key, g bigint, "
+                 "v int)")
+    n, ngroups = 12000, 5000            # > the 1024 initial bucket
+    rows = ",".join(
+        f"({i}, {(i % ngroups) * 1000003}, {i % 101})"
+        for i in range(n))
+    tk.must_exec(f"insert into wide values {rows}")
+    got = tk.must_query(
+        "select g, sum(v), count(*) from wide group by g "
+        "order by g").rs.rows
+    exp = {}
+    for i in range(n):
+        k = (i % ngroups) * 1000003
+        a, b = exp.get(k, (0, 0))
+        exp[k] = (a + i % 101, b + 1)
+    assert [(r[0], int(r[1]), int(r[2])) for r in got] == \
+        [(k, *exp[k]) for k in sorted(exp)]

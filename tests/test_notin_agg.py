@@ -104,3 +104,41 @@ def test_in_grouped_agg_and_exists(tk):
                "(select b from i where i.k = o.k group by b) "
                "order by id")
     assert got == [1, 2, 3, 5], got
+
+
+def test_not_in_residual_conds_exact(tk):
+    """Residual correlated conditions (here `i.b < o.x`) make S_k(t)
+    probe-dependent; the pair-expansion path in _naaj_correlated must
+    keep full 3VL semantics instead of the old isnotnull guard.
+    Per outer row for `x NOT IN (select b from i where i.k = o.k and
+    i.id > o.id)` — i rows: (10,1,5),(11,1,3),(12,2,NULL):
+      id=1 (k=1, x=5):  S = {5,3}       -> 5 in S    -> FALSE -> drop
+      id=2 (k=1, x=7):  S = {5,3}       -> TRUE      -> keep
+      id=3 (k=2, x=9):  S = {NULL}      -> NULL      -> drop
+      id=4 (k=3, x=9):  S = {}          -> TRUE      -> keep
+      id=5 (k=1, x=NULL): S = {5,3}     -> NULL      -> drop
+    """
+    got = q(tk, "select id from o where x not in "
+               "(select b from i where i.k = o.k and i.id > o.id) "
+               "order by id")
+    assert got == [2, 4], got
+
+
+def test_not_in_residual_null_probe_empty_group(tk):
+    # the case the old guard got wrong: NULL probe value whose
+    # residual-filtered group is EMPTY must be KEPT (NOT IN over the
+    # empty set is TRUE even for NULL x)
+    tk.must_exec("update o set k = 4 where id = 5")   # k=4: no i rows
+    got = q(tk, "select id from o where x not in "
+               "(select b from i where i.k = o.k and i.id > o.id) "
+               "order by id")
+    assert got == [2, 4, 5], got
+
+
+def test_not_in_residual_excludes_null_values(tk):
+    # residual cond filters the NULL b row out of k=2's set: S becomes
+    # empty -> id=3 must now be kept
+    got = q(tk, "select id from o where x not in "
+               "(select b from i where i.k = o.k and i.b is not null "
+               "and i.id > o.id) order by id")
+    assert got == [2, 3, 4], got

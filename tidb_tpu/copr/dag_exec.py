@@ -70,10 +70,17 @@ class _KernelCache(dict):
 class CoprExecutor:
     """Executes CoprDAGs against ColumnarTables; caches compiled kernels."""
 
-    def __init__(self, engine, device_rows=1 << 22, use_device=True,
+    def __init__(self, engine, device_rows=None, use_device=True,
                  dev_cache_bytes=8 << 30):
         self.engine = engine            # ColumnarEngine
-        self.device_rows = device_rows  # partition size (rows per jit call)
+        if device_rows is None:
+            # partition size (rows per jit call): on the axon tunnel
+            # every partition costs a fixed ~65-95ms round trip, so
+            # fewer/bigger partitions win until HBM pressure; tunable
+            # for on-chip experiments without an engine rebuild
+            device_rows = int(os.environ.get("TIDB_TPU_DEVICE_ROWS",
+                                             str(1 << 22)))
+        self.device_rows = device_rows
         self.use_device = use_device
         self._kernel_cache = _KernelCache()
         self.last_backend = ""          # backend of the latest execute()
@@ -396,9 +403,13 @@ class CoprExecutor:
                 break
         return out
 
-    def _pad_upload(self, cols, v, m, cap):
+    def _pad_upload(self, cols, v, m, cap, bind_keys=None):
         jcols = {}
-        bind_keys = getattr(self, "_bind_keys", {})
+        if bind_keys is None:
+            # instance state is only valid for the MOST RECENT
+            # _bind_cols call: pipelined/retried partitions must pass
+            # their own captured keys or wrong cached buffers bind
+            bind_keys = getattr(self, "_bind_keys", {})
         for k, (data, nulls, sdict) in cols.items():
             ck = bind_keys.get(k)
             if ck is not None:
@@ -1672,7 +1683,6 @@ def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
             np.not_equal(kv[1:], kv[:-1], out=change[1:])
             starts = np.nonzero(change)[0]
             ngroups = len(starts)
-            inverse = np.cumsum(change) - 1
             firsts = idx[starts]
         else:
             kmat = np.stack(kvecs, axis=1)

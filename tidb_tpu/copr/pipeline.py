@@ -14,6 +14,7 @@ bottleneck: Q3/Q5 lost all join output to host numpy between operators).
 """
 from __future__ import annotations
 
+import os
 import re
 
 import numpy as np
@@ -1287,99 +1288,120 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             pcols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays,
                                     sl, handles,
                                     cacheable=(n == fact_tbl.n))
-            yield pcols, fact_valid[sl], pm
+            # capture this partition's device-cache keys: the pipelined
+            # loop dispatches the NEXT partition (overwriting
+            # copr._bind_keys) before this one's consume-time retries
+            yield pcols, fact_valid[sl], pm, dict(copr._bind_keys)
         if delta_part is not None:
             # the transaction's uncommitted inserts as one more fact
-            # partition through the SAME kernel (device UnionScan)
+            # partition through the SAME kernel (device UnionScan);
+            # empty bind keys: never device-cache dirty rows
             dcols, dv = delta_part
-            copr._bind_keys = {}        # never device-cache dirty rows
-            yield dcols, dv, len(dv)
+            yield dcols, dv, len(dv), {}
 
-    for cols, v, m in _partitions():
+    def _dispatch_part(cols, v, m, bind_keys):
+        """Upload + async-dispatch one fact partition with the
+        currently learned lowering parameters. Returns everything the
+        consume step needs to validate the run."""
         cap = shape_bucket(m)
+        if pos_spec is not None:
+            agg_kind = "posdense"
+            agg_param = (tuple(pos_spec[1]), pos_spec[2])
+        elif sizes is not None:
+            agg_kind, agg_param = "dense", tuple(sizes)
+        else:
+            agg_impl = copr._host_cache.get(implk) or _segment_impl()
+            topn_k = None
+            # candidate pruning is sound ONLY under the runs
+            # lowering: its run order is storage order, so the
+            # partition-edge (possibly split) groups are exactly
+            # runs 0 and ngroups-1, which _topn_select forces into
+            # the candidate set. sorted/scatter order groups by
+            # key rank, where the edge groups can sit anywhere.
+            # the coverage proof needs >= k complete groups strictly
+            # above the candidate min: with group_bucket < k+2 it can
+            # never pass, so don't burn a kernel compile + permanent
+            # off-pin on a shape that cannot verify
+            if ts is not None and agg_impl == "runs" and \
+                    group_bucket >= ts[3] + 2 and \
+                    not copr._host_cache.get(offk):
+                topn_k = (ts[0], ts[1], ts[2],
+                          min(ts[3] + 66, group_bucket))
+            ccap = copr._host_cache.get(compk)
+            agg_kind, agg_param = "sort", (
+                group_bucket, agg_impl, topn_k,
+                ccap if isinstance(ccap, int) else None)
+        ec = copr._host_cache.get(ecapk)
+        ecap = ec if isinstance(ec, int) and ec < cap else None
+        if ecap is not None and not plan.dims:
+            # zero-dim pipeline: downstream of the fact filter is
+            # ONE aggregation pass — gather-compaction (cumsum +
+            # per-column gathers) costs more than it saves (q6's
+            # global reduce, q15's dense group-by both measured
+            # slower with it). Compaction pays when dim probes and
+            # multi-pass agg lowerings run at survivor scale.
+            ecap = None
+        if ecap is not None and agg_kind == "sort":
+            # survivors are already compacted: the late (post-join)
+            # compact stage would re-gather the same buffer
+            agg_param = agg_param[:3] + (None,)
+        key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
+                               tuple(dim_caps), tuple(dim_ns),
+                               tuple(dim_sns), agg_kind, agg_param,
+                               ecap)
+        kern = copr._kernel_cache.get(key)
+        if kern is None:
+            kern = _build_fused_kernel(
+                plan, cap, fact_sdicts, tuple(dim_caps),
+                tuple(dim_ns), tuple(dim_sns), tuple(dim_layouts),
+                agg_kind, agg_param, dim_pres, ecap=ecap)
+            kern = copr._kernel_cache.put(key, kern)
+        fjc_full, fvv = copr._pad_upload(cols, v, m, cap,
+                                         bind_keys=bind_keys)
+        fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
+        res = prefetch(kern(fjc, fvv, dim_args))
+        return res, cap, agg_param, ecap
+
+    def _consume_part(state, cols, v, m, bind_keys):
+        nonlocal group_bucket
         while True:
-            if pos_spec is not None:
-                agg_kind = "posdense"
-                agg_param = (tuple(pos_spec[1]), pos_spec[2])
-            elif sizes is not None:
-                agg_kind, agg_param = "dense", tuple(sizes)
-            else:
-                agg_impl = copr._host_cache.get(implk) or _segment_impl()
-                topn_k = None
-                # candidate pruning is sound ONLY under the runs
-                # lowering: its run order is storage order, so the
-                # partition-edge (possibly split) groups are exactly
-                # runs 0 and ngroups-1, which _topn_select forces into
-                # the candidate set. sorted/scatter order groups by
-                # key rank, where the edge groups can sit anywhere.
-                # the coverage proof needs >= k complete groups strictly
-                # above the candidate min: with group_bucket < k+2 it can
-                # never pass, so don't burn a kernel compile + permanent
-                # off-pin on a shape that cannot verify
-                if ts is not None and agg_impl == "runs" and \
-                        group_bucket >= ts[3] + 2 and \
-                        not copr._host_cache.get(offk):
-                    topn_k = (ts[0], ts[1], ts[2],
-                              min(ts[3] + 66, group_bucket))
-                ccap = copr._host_cache.get(compk)
-                agg_kind, agg_param = "sort", (
-                    group_bucket, agg_impl, topn_k,
-                    ccap if isinstance(ccap, int) else None)
-            ec = copr._host_cache.get(ecapk)
-            ecap = ec if isinstance(ec, int) and ec < cap else None
-            if ecap is not None and not plan.dims:
-                # zero-dim pipeline: downstream of the fact filter is
-                # ONE aggregation pass — gather-compaction (cumsum +
-                # per-column gathers) costs more than it saves (q6's
-                # global reduce, q15's dense group-by both measured
-                # slower with it). Compaction pays when dim probes and
-                # multi-pass agg lowerings run at survivor scale.
-                ecap = None
-            if ecap is not None and agg_kind == "sort":
-                # survivors are already compacted: the late (post-join)
-                # compact stage would re-gather the same buffer
-                agg_param = agg_param[:3] + (None,)
-            key = _fused_cache_key(copr, plan, fact_tbl, dim_metas, cap,
-                                   tuple(dim_caps), tuple(dim_ns),
-                                   tuple(dim_sns), agg_kind, agg_param,
-                                   ecap)
-            kern = copr._kernel_cache.get(key)
-            if kern is None:
-                kern = _build_fused_kernel(
-                    plan, cap, fact_sdicts, tuple(dim_caps),
-                    tuple(dim_ns), tuple(dim_sns), tuple(dim_layouts),
-                    agg_kind, agg_param, dim_pres, ecap=ecap)
-                kern = copr._kernel_cache.put(key, kern)
-            fjc_full, fvv = copr._pad_upload(cols, v, m, cap)
-            fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
-            res = prefetch(kern(fjc, fvv, dim_args))
+            res, cap, agg_param, ecap = state
             # early-compaction policy: learn the survivor bucket on
             # first sight, regrow + rerun on overflow (fnvalid is the
             # fact-filter survivor count BEFORE any compaction loss, so
             # an overflowed run is incorrect and must not be consumed)
             if _compact_policy(copr, ecapk, ecap,
                                int(res["fnvalid"]), cap) == "retry":
+                state = _dispatch_part(cols, v, m, bind_keys)
                 continue
             if pos_spec is not None:
                 out.append(_compact_pos_dense(plan, res, pos_spec[0],
                                               pos_spec[1], dim_metas, sd))
-                break
+                return
             if sizes is not None:
                 out.append(_compact_dense(shim, res, sizes, kd, sd))
-                break
+                return
             ngroups = int(res["ngroups"])
             if _compact_policy(copr, compk, agg_param[3],
                                int(res["nvalid"]), cap) == "retry":
+                state = _dispatch_part(cols, v, m, bind_keys)
                 continue
             if agg_param[1] == "runs" and \
                     ngroups > max(_de._RUNS_DEGRADE_MIN, m // 4):
                 # unclustered group keys: pin this query shape to the
                 # sorted lowering before learning an inflated bucket
                 copr._host_cache[implk] = "sorted"
+                state = _dispatch_part(cols, v, m, bind_keys)
                 continue
-            if ngroups > group_bucket:
-                group_bucket = shape_bucket(ngroups)
+            if ngroups > agg_param[0]:
+                # compare against the bucket THIS kernel was built
+                # with (agg_param[0]), not the nonlocal possibly grown
+                # by an earlier partition after this one's speculative
+                # dispatch: an overflowed run truncated its key/state
+                # buffers and must re-run at the larger bucket
+                group_bucket = max(group_bucket, shape_bucket(ngroups))
                 copr._host_cache[gbkey] = group_bucket
+                state = _dispatch_part(cols, v, m, bind_keys)
                 continue
             topn_k = agg_param[2]
             if topn_k is not None:
@@ -1408,11 +1430,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                         # boundary ties could hide true top-k members:
                         # permanently disable topn for this query shape
                         copr._host_cache[offk] = True
+                        state = _dispatch_part(cols, v, m, bind_keys)
                         continue
                 out.append(PartialAggResult(
                     ngroups=ncand, keys=ckeys, key_nulls=cnulls,
                     states=cstates, key_dicts=kd, state_dicts=sd))
-                break
+                return
             out.append(PartialAggResult(
                 ngroups=ngroups,
                 keys=[np.asarray(k)[:ngroups] for k in res["keys"]],
@@ -1421,7 +1444,26 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 states=[[np.asarray(s)[:ngroups] for s in st]
                         for st in res["states"]],
                 key_dicts=kd, state_dicts=sd))
-            break
+            return
+
+    # partition pipelining: partition i+1's padding/upload/dispatch is
+    # issued BEFORE partition i's results are consumed, so the fixed
+    # per-round-trip link latency (~65-95ms on the axon tunnel)
+    # overlaps device compute instead of adding up across partitions.
+    # A consume-time policy retry re-dispatches only its own partition
+    # with the freshly learned state; a speculatively dispatched
+    # successor then self-corrects the same way (one extra kernel run
+    # on the rare learning executions, steady state unchanged).
+    depth = max(1, int(os.environ.get("TIDB_TPU_PIPELINE_DEPTH", "2")))
+    pending = []
+    for cols, v, m, bkeys in _partitions():
+        pending.append((_dispatch_part(cols, v, m, bkeys),
+                        cols, v, m, bkeys))
+        if len(pending) >= depth:
+            st, c0, v0, m0, b0 = pending.pop(0)
+            _consume_part(st, c0, v0, m0, b0)
+    for st, c0, v0, m0, b0 in pending:
+        _consume_part(st, c0, v0, m0, b0)
     return out
 
 

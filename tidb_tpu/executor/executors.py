@@ -2002,13 +2002,7 @@ class HashJoinExec(Executor):
             bi = np.tile(np.arange(nb), np_)
             pi = np.repeat(np.arange(np_), nb)
             if plan.other_conds:
-                joined = self._emit(probe, pi, build, bi, raw=True)
-                n = len(joined)
-                cols = bind_chunk(self._joined_schema(), joined)
-                ectx = EvalCtx(np, n, cols, host=True)
-                mask = np.ones(n, dtype=bool)
-                for c in plan.other_conds:
-                    mask &= np.asarray(eval_bool_mask(ectx, c))
+                mask = self._pair_conds_mask(probe, pi, build, bi)
                 pi, bi = pi[mask], bi[mask]
                 if outer:
                     matched = np.zeros(len(probe), dtype=bool)
@@ -2103,13 +2097,7 @@ class HashJoinExec(Executor):
 
         # other conditions filter matched pairs
         if plan.other_conds:
-            joined = self._emit(probe, pi, build, bi, raw=True)
-            n = len(joined)
-            cols = bind_chunk(self._joined_schema(), joined)
-            ectx = EvalCtx(np, n, cols, host=True)
-            mask = np.ones(n, dtype=bool)
-            for c in plan.other_conds:
-                mask &= np.asarray(eval_bool_mask(ectx, c))
+            mask = self._pair_conds_mask(probe, pi, build, bi)
             pi, bi = pi[mask], bi[mask]
 
         if jt in ("semi", "anti"):
@@ -2124,6 +2112,17 @@ class HashJoinExec(Executor):
                 outer_part = self._emit(probe, un, None, None)
                 return inner.concat(outer_part)
         return self._emit(probe, pi, build, bi)
+
+    def _pair_conds_mask(self, probe, pi, build, bi):
+        """Evaluate plan.other_conds over matched (probe, build) row
+        pairs -> boolean keep mask (WHERE semantics: NULL excludes)."""
+        joined = self._emit(probe, pi, build, bi, raw=True)
+        cols = bind_chunk(self._joined_schema(), joined)
+        ectx = EvalCtx(np, len(joined), cols, host=True)
+        mask = np.ones(len(joined), dtype=bool)
+        for c in self.plan.other_conds:
+            mask &= np.asarray(eval_bool_mask(ectx, c))
+        return mask
 
     def _device_join(self, plan, jt, outer, probe, build, bv, bnull,
                      pv, pnull):
@@ -2169,6 +2168,38 @@ class HashJoinExec(Executor):
         bcorr = combine(bk[:, :ncorr])
         pcorr = combine(pk[:, :ncorr])
         valid_b = ~bcorr_null          # NULL corr keys join no group
+        if plan.other_conds:
+            # residual correlated conditions make the set S_k(t)
+            # probe-dependent: expand correlation-matching pairs,
+            # keep only pairs where every residual evaluates TRUE
+            # (WHERE semantics: NULL excludes), then take the same
+            # per-probe 3VL verdict over the surviving pairs
+            vb_idx = np.nonzero(valid_b)[0]
+            order = np.argsort(bcorr[vb_idx], kind="stable")
+            vb_idx = vb_idx[order]
+            sb = bcorr[vb_idx]
+            lo = np.searchsorted(sb, pcorr, side="left")
+            hi = np.searchsorted(sb, pcorr, side="right")
+            counts = hi - lo
+            counts[pcorr_null] = 0
+            total = int(counts.sum())
+            pi = np.repeat(np.arange(len(probe)), counts)
+            starts = np.repeat(lo, counts)
+            base = np.repeat(np.cumsum(counts) - counts, counts)
+            bi = vb_idx[starts + (np.arange(total) - base)]
+            mask = self._pair_conds_mask(probe, pi, build, bi)
+            pi, bi = pi[mask], bi[mask]
+            group_exists = np.zeros(len(probe), dtype=bool)
+            group_exists[pi] = True
+            group_has_null = np.zeros(len(probe), dtype=bool)
+            group_has_null[pi[bval_null[bi]]] = True
+            val_eq = (bk[bi, -1] == pk[pi, -1]) & \
+                ~bval_null[bi] & ~pval_null[pi]
+            matched = np.zeros(len(probe), dtype=bool)
+            matched[pi[val_eq]] = True
+            keep = (~group_exists) | (~pval_null & ~matched &
+                                      ~group_has_null)
+            return self._emit(probe, np.nonzero(keep)[0], None, None)
         group_exists = np.isin(pcorr, bcorr[valid_b]) & ~pcorr_null
         group_has_null = np.isin(
             pcorr, bcorr[valid_b & bval_null]) & ~pcorr_null

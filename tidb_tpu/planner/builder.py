@@ -899,14 +899,19 @@ class PlanBuilder:
                     # NULL probes
                     join.null_aware = True
                     return join
-                if not others and not (_stmt_has_agg(c.subquery) and
-                                       not c.subquery.group_by):
+                if len(join.eq_conds) > 1 and \
+                        not (_stmt_has_agg(c.subquery) and
+                             not c.subquery.group_by):
                     # correlated NOT IN: full 3-valued semantics per
                     # correlation group (executor _naaj_correlated) —
                     # eq_conds keep correlation pairs first, value
                     # last. GROUPED subqueries (with or without aggs)
                     # qualify: an absent correlation has no grouped
-                    # rows, so "empty set" is representable. Only
+                    # rows, so "empty set" is representable. Residual
+                    # correlated conditions ride along as other_conds:
+                    # the executor expands correlation-matching pairs
+                    # and keeps only pairs where every residual is
+                    # TRUE, so S_k(t) is exact per probe row. Only
                     # SCALAR aggregates (one row always, NULL/0 over
                     # empty) are different — they take the LEFT-join
                     # rewrite below.
