@@ -109,6 +109,63 @@ def main(sections):
         bench("topk1024 1M", jax.jit(lambda v: jax.lax.top_k(v, 1024)),
               v64)
 
+    if "probe" in sections:
+        # dim-probe primitives at fused-kernel scale: 4M fact rows
+        # against a 2M-row build side (q5/q9/q10 shapes)
+        n4 = 1 << 22
+        lut = jnp.asarray(rng.permutation(1 << 21), dtype=jnp.int64)
+        idx4 = jnp.asarray(rng.integers(0, 1 << 21, n4), dtype=jnp.int64)
+        bench("gather 4M from 2M lut", jax.jit(lambda lu, i: lu[i]),
+              lut, idx4)
+        skeys = jnp.asarray(np.sort(rng.choice(1 << 24, 1 << 21,
+                                               replace=False)),
+                            dtype=jnp.int64)
+        bench("searchsorted 2M x 4M probes",
+              jax.jit(lambda t, q: jnp.searchsorted(t, q)), skeys, idx4)
+        bench("5x gather 4M (multi-dim probe)",
+              jax.jit(lambda lu, i: sum(lu[(i + k) & ((1 << 21) - 1)]
+                                        for k in range(5))), lut, idx4)
+
+    if "sort4m" in sections:
+        n4 = 1 << 22
+        w4 = jnp.asarray(rng.integers(0, 1 << 40, n4), dtype=jnp.int64)
+        bench("sort 4M i64", jax.jit(jnp.sort), w4, reps=2)
+        bench("argsort 4M i64", jax.jit(jnp.argsort), w4, reps=2)
+
+    if "mxu" in sections:
+        # exact segment-sum via one-hot int8 matmul: 7-bit value limbs
+        # x one-hot -> int32 MXU accumulation (per-group row count must
+        # stay < 2^24 for exactness of the recombination in f32-free
+        # int32 adds; partitions cap n at 4M so it holds)
+        n4 = 1 << 22
+        vals = jnp.asarray(rng.integers(0, 1 << 34, n4), dtype=jnp.int64)
+        s256 = jnp.asarray(rng.integers(0, 256, n4), dtype=jnp.int64)
+
+        def oh_s8(v, s):
+            blk = 8192
+            vb = jnp.stack([(v >> (7 * i)) & 0x7F for i in range(5)],
+                           axis=1).astype(jnp.int8).reshape(-1, blk, 5)
+            ohb = (s.reshape(-1, blk)[:, :, None] ==
+                   jnp.arange(256)[None, None, :]).astype(jnp.int8)
+            p = jnp.einsum("bns,bnl->sl", ohb, vb,
+                           preferred_element_type=jnp.int32)
+            return p
+        bench("onehot-s8-matmul 4M->256x5limb", jax.jit(oh_s8),
+              vals, s256, reps=3)
+
+        s2k = jnp.asarray(rng.integers(0, 2048, n4), dtype=jnp.int64)
+
+        def oh_s8_2k(v, s):
+            blk = 8192
+            vb = jnp.stack([(v >> (7 * i)) & 0x7F for i in range(5)],
+                           axis=1).astype(jnp.int8).reshape(-1, blk, 5)
+            ohb = (s.reshape(-1, blk)[:, :, None] ==
+                   jnp.arange(2048)[None, None, :]).astype(jnp.int8)
+            return jnp.einsum("bns,bnl->sl", ohb, vb,
+                              preferred_element_type=jnp.int32)
+        bench("onehot-s8-matmul 4M->2048x5limb", jax.jit(oh_s8_2k),
+              vals, s2k, reps=3)
+
     if "scatter" in sections:          # never in the default set
         slots = jnp.asarray(rng.integers(0, 150_000, N), dtype=jnp.int64)
         bench("segment_sum 1M->150k i64",
