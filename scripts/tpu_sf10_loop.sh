@@ -1,41 +1,60 @@
 #!/bin/bash
-# Follow-on capture: once the four primary TPU artifacts exist
-# (tpu_bench_loop.sh exits at that point), chase the stretch goal —
-# the full 22-query suite at SF10 on the real chip, where per-dispatch
-# tunnel latency amortizes over 60M-row columns. Saved the moment it
-# lands; clean host baselines come from BENCH_SF10_cpu.json.
+# Follow-on capture: the full 22-query suite at SF10 on the real chip,
+# where per-dispatch tunnel latency amortizes over 60M-row columns.
+# Cold kernel compiles at SF10 dim shapes can take many minutes EACH on
+# the axon tunnel, so (a) the stall watchdog gets a 2400s budget, and
+# (b) every attempt persists its compiles to .cache/jax — a watchdogged
+# attempt still pushes the next one further. An attempt replaces
+# BENCH_TPU_SF10.json only when it covers MORE queries (or equal
+# queries with a better geomean). Clean host baselines come from the
+# committed BENCH_SF10_cpu.json.
 cd /root/repo || exit 1
 LOG=/root/repo/TPU_POLL_LOG.txt
-M=/root/repo/BENCH_TPU_micro.json
-Q=/root/repo/BENCH_TPU_quick.json
-F=/root/repo/BENCH_TPU_full.json
-H=/root/repo/BENCH_TPU_htap.json
 S=/root/repo/BENCH_TPU_SF10.json
 echo "$(date +%F' '%H:%M:%S) sf10 loop start (pid $$)" >> "$LOG"
 while true; do
-  if [ -s "$S" ]; then
-    echo "$(date +%F' '%H:%M:%S) SF10 TPU artifact saved — exiting" >> "$LOG"
+  if [ -s "$S" ] && python - << 'EOF'
+import json, sys
+d = json.loads(open("/root/repo/BENCH_TPU_SF10.json").read().strip().splitlines()[-1])
+ok = "stalled_at" not in d and sum(1 for v in d.get("queries", {}).values() if "ms" in v) >= 22
+sys.exit(0 if ok else 1)
+EOF
+  then
+    echo "$(date +%F' '%H:%M:%S) SF10 complete (22q, no stall) — exiting" >> "$LOG"
     exit 0
   fi
-  # wait for the primary loop to finish its four stages first
-  if [ -s "$M" ] && [ -s "$Q" ] && [ -s "$F" ] && [ -s "$H" ]; then
-    if timeout 150 python -c "
+  if timeout 150 python -c "
 import jax, jax.numpy as jnp, numpy as np
 x = jnp.ones((256,256), jnp.bfloat16)
 np.asarray(x @ x)
 print(jax.devices()[0].platform)" 2>/dev/null | grep -qv cpu; then
-      echo "$(date +%F' '%H:%M:%S) TPU LIVE (sf10 stage)" >> "$LOG"
-      BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=300 \
-        BENCH_SF=10 BENCH_REPEATS=2 \
-        BENCH_CPU_FROM=/root/repo/BENCH_SF10_cpu.json \
-        BENCH_PHASES_PATH=/root/repo/BENCH_TPU_SF10_phases.json \
-        timeout 9000 python bench.py > /tmp/bench_sf10_try.json 2>>"$LOG"
-      grep -q '"backend": "tpu"' /tmp/bench_sf10_try.json 2>/dev/null && \
-        cp /tmp/bench_sf10_try.json "$S" && \
-        echo "$(date +%F' '%H:%M:%S) SF10 TPU bench SAVED" >> "$LOG"
-    else
-      echo "$(date +%F' '%H:%M:%S) no grant (sf10 stage)" >> "$LOG"
-    fi
+    echo "$(date +%F' '%H:%M:%S) TPU LIVE (sf10 stage)" >> "$LOG"
+    BENCH_NO_REPLAY=1 BENCH_PROBE_ATTEMPTS=2 BENCH_PROBE_TIMEOUT=300 \
+      BENCH_SF=10 BENCH_REPEATS=2 BENCH_STALL_S=2400 \
+      BENCH_CPU_FROM=/root/repo/BENCH_SF10_cpu.json \
+      BENCH_PHASES_PATH=/tmp/bench_sf10_phases_try.json \
+      timeout 14000 python bench.py > /tmp/bench_sf10_try.json 2>>"$LOG"
+    grep -q '"backend": "tpu"' /tmp/bench_sf10_try.json 2>/dev/null && \
+      python - << 'EOF' >> "$LOG"
+import json, shutil
+new = json.loads(open("/tmp/bench_sf10_try.json").read().strip().splitlines()[-1])
+nq = sum(1 for v in new.get("queries", {}).values() if "ms" in v)
+try:
+    old = json.loads(open("/root/repo/BENCH_TPU_SF10.json").read().strip().splitlines()[-1])
+    oq = sum(1 for v in old.get("queries", {}).values() if "ms" in v)
+    og = old.get("vs_baseline", 0)
+except Exception:
+    oq, og = -1, 0
+if nq > oq or (nq == oq and new.get("vs_baseline", 0) > og):
+    shutil.copy("/tmp/bench_sf10_try.json", "/root/repo/BENCH_TPU_SF10.json")
+    shutil.copy("/tmp/bench_sf10_phases_try.json",
+                "/root/repo/BENCH_TPU_SF10_phases.json")
+    print(f"# sf10 attempt SAVED ({nq} queries, geomean {new.get('vs_baseline')})")
+else:
+    print(f"# sf10 attempt kept old ({nq} <= {oq} queries)")
+EOF
+  else
+    echo "$(date +%F' '%H:%M:%S) no grant (sf10 stage)" >> "$LOG"
   fi
   sleep 120
 done

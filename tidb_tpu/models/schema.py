@@ -71,17 +71,21 @@ class IndexInfo:
     unique: bool = False
     primary: bool = False
     state: SchemaState = SchemaState.PUBLIC
+    # ALTER TABLE ... ALTER INDEX ... INVISIBLE: still maintained by
+    # every write, skipped by the planner's access-path search
+    invisible: bool = False
 
     def to_json(self):
         return {"id": self.id, "name": self.name, "columns": self.columns,
                 "unique": self.unique, "primary": self.primary,
-                "state": int(self.state)}
+                "state": int(self.state), "invisible": self.invisible}
 
     @classmethod
     def from_json(cls, j):
         return cls(id=j["id"], name=j["name"], columns=j["columns"],
                    unique=j["unique"], primary=j["primary"],
-                   state=SchemaState(j["state"]))
+                   state=SchemaState(j["state"]),
+                   invisible=j.get("invisible", False))
 
 
 @dataclass

@@ -80,6 +80,17 @@ class WindowFunc(ExprNode):
     order_by: list = field(default_factory=list)   # [OrderItem]
     frame: WindowFrame | None = None
     distinct: bool = False
+    # OVER w / OVER (w ...): named-window reference resolved against the
+    # SELECT's WINDOW clause at the end of parse_select
+    window_ref: str = ""
+
+
+@dataclass
+class Collate(ExprNode):
+    """expr COLLATE name — explicit collation override (reference
+    pkg/parser/ast SetCollationExpr)."""
+    expr: ExprNode
+    collation: str = ""
 
 
 @dataclass
@@ -417,6 +428,9 @@ class SelectStmt(StmtNode):
     setops: list = field(default_factory=list)
     # WITH clause: [(name, [col aliases], SelectStmt)]
     ctes: list = field(default_factory=list)
+    # WINDOW name AS (spec), ...: name -> WindowFunc carrying only the
+    # spec (partition_by/order_by/frame [+ window_ref base])
+    named_windows: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -428,6 +442,9 @@ class InsertStmt(StmtNode):
     is_replace: bool = False
     on_duplicate: list = field(default_factory=list)  # [(col, expr)]
     ignore: bool = False
+    # MySQL 8.0.19 `VALUES ... AS alias [(col aliases)]`
+    row_alias: str = ""
+    row_col_aliases: list = field(default_factory=list)
 
 
 @dataclass

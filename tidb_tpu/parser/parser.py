@@ -32,6 +32,15 @@ _TIME_UNITS = {"microsecond", "second", "minute", "hour", "day", "week",
                "year_month"}
 
 
+# string-literal charset introducers (MySQL `_charset'...'`): only
+# these underscore-names are consumed as introducers, so ordinary
+# `_foo`-named columns keep their column semantics
+_CHARSET_INTRODUCERS = frozenset(
+    "_utf8 _utf8mb3 _utf8mb4 _latin1 _ascii _binary _ucs2 _utf16 "
+    "_utf16le _utf32 _gbk _gb18030 _big5 _cp1250 _cp1251 _cp1256 "
+    "_cp1257 _cp850 _cp852 _cp866 _cp932".split())
+
+
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
@@ -480,6 +489,12 @@ class Parser:
                     sel.into_vars.append(t.text.lower())
                     if not self.accept_op(","):
                         break
+            elif self.at_kw("into") and self.peek(1).kind == "IDENT" and \
+                    self.peek(1).text.lower() == "outfile":
+                # SELECT ... INTO OUTFILE 'f' FROM ... (pre-FROM form)
+                self.next()
+                self.next()
+                sel.into_outfile = self.next().text
             if self.accept_kw("from"):
                 sel.from_clause = self.parse_table_refs()
             if self.accept_kw("where"):
@@ -494,6 +509,21 @@ class Parser:
                     sel.with_rollup = True
             if self.accept_kw("having"):
                 sel.having = self.parse_expr()
+            if self.accept_kw("window"):
+                # WINDOW w AS (spec) [, w2 AS (spec)] — named windows
+                # (reference parser.y WindowClauseOptional)
+                while True:
+                    wname = self.ident().lower()
+                    if wname in sel.named_windows:
+                        self.error(f"window '{wname}' is defined twice")
+                    self.expect_kw("as")
+                    self.expect_op("(")
+                    spec = ast.WindowFunc(name="")
+                    self._window_spec(spec)
+                    self.expect_op(")")
+                    sel.named_windows[wname] = spec
+                    if not self.accept_op(","):
+                        break
             sel.order_by = self.parse_order_by()
             sel.limit = self.parse_limit()
             if self.accept_kw("into"):
@@ -511,6 +541,12 @@ class Parser:
             if self.accept_kw("for"):
                 self.expect_kw("update")
                 sel.for_update = True
+                if self.accept_kw("of"):
+                    # FOR UPDATE OF t1[, t2]: lock scope subset — the
+                    # statement-level lock here covers a superset
+                    self.ident()
+                    while self.accept_op(","):
+                        self.ident()
                 if self.accept_kw("nowait"):
                     sel.lock_wait = "nowait"
                 elif self.accept_kw("skip"):
@@ -520,6 +556,7 @@ class Parser:
                 self.expect_kw("in")
                 self.expect_kw("share")
                 self.expect_kw("mode")
+        self._resolve_named_windows(sel)
         if allow_setops:
             while self.at_kw("union", "except", "intersect"):
                 op = self.next().text.lower()
@@ -545,6 +582,71 @@ class Parser:
                     sel.limit = lm
         return sel
 
+    def _resolve_named_windows(self, sel):
+        """Substitute WINDOW-clause specs into every OVER w /
+        OVER (w ...) reference of this select body (MySQL inheritance:
+        a referencing spec takes the base's PARTITION BY, and the
+        base's ORDER BY / frame unless it declares its own)."""
+        if not sel.named_windows and \
+                not getattr(self, "_saw_window_ref", False):
+            return      # common case: no WINDOW clause, no OVER w refs
+        import dataclasses as _dc
+
+        def resolve(name, seen=()):
+            spec = sel.named_windows.get(name)
+            if spec is None:
+                self.error(f"window '{name}' is not defined")
+            if name in seen:
+                self.error(f"window '{name}' circularly references "
+                           "itself")
+            if spec.window_ref:
+                base = resolve(spec.window_ref, seen + (name,))
+                if not spec.partition_by:
+                    spec.partition_by = list(base.partition_by)
+                if not spec.order_by:
+                    spec.order_by = list(base.order_by)
+                if spec.frame is None:
+                    spec.frame = base.frame
+                spec.window_ref = ""
+            return spec
+
+        def walk(n):
+            if isinstance(n, ast.WindowFunc):
+                if n.window_ref:
+                    base = resolve(n.window_ref)
+                    if not n.partition_by:
+                        n.partition_by = list(base.partition_by)
+                    if not n.order_by:
+                        n.order_by = list(base.order_by)
+                    if n.frame is None:
+                        n.frame = base.frame
+                    n.window_ref = ""
+                for a in n.args:
+                    walk(a)
+                return
+            if isinstance(n, ast.SelectStmt):
+                return          # nested scope resolved by its own parse
+            if _dc.is_dataclass(n) and not isinstance(n, type):
+                for f in _dc.fields(n):
+                    v = getattr(n, f.name, None)
+                    if isinstance(v, list):
+                        for x in v:
+                            if _dc.is_dataclass(x) and \
+                                    not isinstance(x, type):
+                                walk(x)
+                            elif isinstance(x, tuple):
+                                for y in x:
+                                    if _dc.is_dataclass(y) and \
+                                            not isinstance(y, type):
+                                        walk(y)
+                    elif _dc.is_dataclass(v) and not isinstance(v, type):
+                        walk(v)
+
+        for f in sel.fields:
+            walk(f)
+        for o in sel.order_by:
+            walk(o)
+
     def parse_select_fields(self) -> list:
         fields = []
         while True:
@@ -565,6 +667,9 @@ class Parser:
                 if self.accept_kw("as"):
                     t = self.peek()
                     alias = t.text if t.kind == "STRING" and not self.next() else self.ident() if t.kind != "STRING" else alias
+                elif self.peek().kind == "STRING":
+                    # implicit string alias: SELECT x 'col' FROM t
+                    alias = self.next().text
                 elif self.peek().kind in ("IDENT", "QIDENT") and \
                         not self.at_kw("from", "where", "group", "having",
                                        "order", "limit", "union", "for",
@@ -706,7 +811,8 @@ class Parser:
                                "limit", "union", "inner", "left", "right",
                                "cross", "join", "set", "for", "using",
                                "natural", "straight_join", "except",
-                               "intersect", "lock", "partition"):
+                               "intersect", "lock", "partition",
+                               "use", "ignore", "force", "window"):
             tn.alias = self.ident()
         # USE/IGNORE/FORCE INDEX hints
         while self.at_kw("use", "ignore", "force"):
@@ -783,6 +889,16 @@ class Parser:
                 stmt.values.append(row)
                 if not self.accept_op(","):
                     break
+            if self.accept_kw("as"):
+                # MySQL 8.0.19 row alias: VALUES ... AS new [(c1, ...)]
+                # — ON DUPLICATE refs `new.x` denote the proposed row,
+                # rewritten below onto the VALUES(x) mechanism
+                stmt.row_alias = self.ident().lower()
+                if self.accept_op("("):
+                    stmt.row_col_aliases.append(self.ident().lower())
+                    while self.accept_op(","):
+                        stmt.row_col_aliases.append(self.ident().lower())
+                    self.expect_op(")")
         elif self.at_kw("select") or self.at_op("("):
             stmt.select = self.parse_select()
         elif self.accept_kw("set"):
@@ -1507,7 +1623,16 @@ class Parser:
         stmt = ast.AlterTableStmt(table=self.parse_table_name())
         while True:
             if self.accept_kw("add"):
-                if self.accept_kw("index") or self.accept_kw("key"):
+                if self.accept_kw("fulltext"):
+                    # parsed and IGNORED with a warning, exactly like
+                    # the reference (TiDB accepts FULLTEXT syntax but
+                    # creates no fulltext index)
+                    self.accept_kw("index") or self.accept_kw("key")
+                    if not self.at_op("("):
+                        self.ident()
+                    self._parse_paren_cols()
+                    stmt.actions.append(("ignore_fulltext", None))
+                elif self.accept_kw("index") or self.accept_kw("key"):
                     name = self.ident() if not self.at_op("(") else ""
                     cols = self._parse_paren_cols()
                     stmt.actions.append(("add_index", ast.IndexDef(
@@ -1550,6 +1675,18 @@ class Parser:
                 stmt.actions.append(("change_column",
                                      (old, self.parse_column_def())))
             elif self.accept_kw("alter"):
+                if self.accept_kw("index") or self.accept_kw("key"):
+                    iname = self.ident()
+                    if self.accept_kw("invisible"):
+                        vis = False
+                    else:
+                        self.expect_kw("visible")
+                        vis = True
+                    stmt.actions.append(("alter_index_visibility",
+                                         (iname, vis)))
+                    if not self.accept_op(","):
+                        break
+                    continue
                 self.accept_kw("column")
                 cname = self.ident()
                 if self.accept_kw("set"):
@@ -1994,6 +2131,17 @@ class Parser:
             if self.accept_kw("regexp") or self.accept_kw("rlike"):
                 left = ast.RegexpExpr(left, self.parse_bitor(), negated=neg)
                 continue
+            if not neg and self.at_kw("member"):
+                # value MEMBER OF (json_array) — maps onto the existing
+                # json_memberof builtin
+                self.next()
+                self.expect_kw("of")
+                self.expect_op("(")
+                arr = self.parse_expr()
+                self.expect_op(")")
+                left = ast.FuncCall(name="json_memberof",
+                                    args=[left, arr])
+                continue
             if neg:
                 self.i = save
                 break
@@ -2085,6 +2233,9 @@ class Parser:
 
     def parse_pow(self):
         left = self._parse_json_arrow(self.parse_primary())
+        while self.at_kw("collate"):
+            self.next()
+            left = ast.Collate(left, self.ident().lower())
         while self.at_op("^"):
             self.next()
             left = ast.BinaryOp(
@@ -2167,6 +2318,32 @@ class Parser:
         if t.kind in ("IDENT", "QIDENT"):
             low = t.text.lower()
             nxt = self.peek(1)
+            if t.kind == "IDENT" and nxt.kind == "STRING" and \
+                    ((low in ("x", "b", "n") and
+                      nxt.pos == t.pos + len(t.text)) or
+                     low in _CHARSET_INTRODUCERS):
+                # hex/bit string literals and charset introducers:
+                # x'4D' = 'M', b'01001101' = 'M', N'...' national,
+                # _utf8mb4'...' (all stored utf8mb4 internally).
+                # x/b/n require the quote ADJACENT (MySQL: `x '4D'` is
+                # a column aliased '4D'); '_' names only when they are
+                # real charset introducers, so `select _id 'alias'`
+                # keeps its column semantics
+                self.next()
+                s = self.next().text
+                if low == "x":
+                    if len(s) % 2 or not all(
+                            c in "0123456789abcdefABCDEF" for c in s):
+                        self.error("invalid hex string literal")
+                    return ast.Literal(bytes.fromhex(s).decode("latin-1"))
+                if low == "b":
+                    if s and not all(c in "01" for c in s):
+                        self.error("invalid bit string literal")
+                    nb = (len(s) + 7) // 8
+                    return ast.Literal(
+                        int(s, 2).to_bytes(nb, "big").decode("latin-1")
+                        if s else "")
+                return ast.Literal(s)
             if low == "null" and t.kind == "IDENT":
                 self.next()
                 return ast.Literal(None)
@@ -2229,8 +2406,26 @@ class Parser:
 
     def parse_over(self, name, args, distinct):
         self.expect_kw("over")
-        self.expect_op("(")
         w = ast.WindowFunc(name=name, args=args, distinct=distinct)
+        if not self.at_op("("):
+            # OVER w — bare named-window reference (WINDOW clause)
+            w.window_ref = self.ident().lower()
+            self._saw_window_ref = True
+            return w
+        self.expect_op("(")
+        self._window_spec(w)
+        if w.window_ref:
+            self._saw_window_ref = True
+        self.expect_op(")")
+        return w
+
+    def _window_spec(self, w):
+        """Parse the inside of a window spec into `w`: optional base
+        window name, PARTITION BY, ORDER BY, frame (MySQL 8 WINDOW
+        clause; reference grammar WindowSpecDetails in parser.y)."""
+        if self.peek().kind in ("IDENT", "QIDENT") and \
+                not self.at_kw("partition", "order", "rows", "range"):
+            w.window_ref = self.ident().lower()
         if self.accept_kw("partition"):
             self.expect_kw("by")
             w.partition_by.append(self.parse_expr())
@@ -2267,7 +2462,6 @@ class Parser:
                 frame.start = bound()
                 frame.end = "current_row"
             w.frame = frame
-        self.expect_op(")")
         return w
 
     def parse_case(self):

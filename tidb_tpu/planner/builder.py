@@ -277,6 +277,20 @@ class PlanBuilder:
         ds.stats_rows = max(float(self.pctx.table_rows(db, tbl)), 1.0)
         ds.tbl_stats = self.pctx.table_stats(tbl.id)
         ds.bulk_only = self.pctx.table_bulk_rows(tbl.id) > 0
+        if tn.index_hints:
+            # MySQL 1176: hint names must exist; referring to an
+            # INVISIBLE index is likewise an error (MySQL 8 semantics)
+            from ..errors import IndexNotExistsError
+            known = {i.name.lower(): i for i in tbl.public_indexes()}
+            known.setdefault("primary", None)
+            for _kind, names in tn.index_hints:
+                for nm2 in names:
+                    hit2 = known.get(nm2.lower(), "?")
+                    if hit2 == "?" or getattr(hit2, "invisible", False):
+                        raise IndexNotExistsError(
+                            "Key '%s' doesn't exist in table '%s'",
+                            nm2, tbl.name)
+            ds.index_hints = list(tn.index_hints)
         ds.col_name_of = {sc.col.idx: sc.name for sc in schema.cols}
         if tn.sample is not None:
             # TABLESAMPLE pct: deterministic Knuth-hash Bernoulli over
@@ -1493,6 +1507,10 @@ class PlanBuilder:
                     else:
                         exprs.append(rw.rewrite(e))
                 plan.rows.append(exprs)
+        if stmt.on_duplicate and stmt.row_alias:
+            # column aliases map positionally onto the statement's
+            # INSERT column list (offsets), not the table's columns
+            _subst_row_alias(stmt, [cols[o] for o in offsets])
         if stmt.on_duplicate:
             # assignments eval against current row schema; VALUES(col)
             # resolves to the to-be-inserted row via a parallel schema
@@ -1801,3 +1819,51 @@ def _stmt_has_agg(stmt: ast.SelectStmt) -> bool:
     for o in stmt.order_by or []:
         walk(o)
     return found[0]
+
+def _subst_row_alias(stmt, cols):
+    """MySQL 8.0.19 insert row alias: rewrite `alias.col` (and, with
+    column aliases, bare alias names) inside ON DUPLICATE KEY UPDATE
+    values onto the VALUES(col) mechanism. Column aliases map
+    positionally onto the resolved insert column list, so both the
+    explicit-column and all-columns forms work."""
+    import dataclasses as _dc
+    amap = {}
+    if stmt.row_col_aliases:
+        if len(stmt.row_col_aliases) != len(cols):
+            raise UnsupportedError(
+                "row alias column count must match the insert columns")
+        amap = {a: ci.name for a, ci in zip(stmt.row_col_aliases, cols)}
+
+    def mk(ref):
+        name = amap.get(ref.name.lower(), ref.name)
+        return ast.FuncCall(name="values",
+                            args=[ast.ColumnRef(name=name)])
+
+    def hit(x):
+        if not isinstance(x, ast.ColumnRef):
+            return False
+        if x.table.lower() == stmt.row_alias:
+            return True
+        return not x.table and x.name.lower() in amap
+
+    def walk(n):
+        if not (_dc.is_dataclass(n) and not isinstance(n, type)):
+            return
+        for f in _dc.fields(n):
+            v = getattr(n, f.name, None)
+            if hit(v):
+                setattr(n, f.name, mk(v))
+            elif isinstance(v, list):
+                for i, x in enumerate(v):
+                    if hit(x):
+                        v[i] = mk(x)
+                    else:
+                        walk(x)
+            else:
+                walk(v)
+
+    for i, (col, e) in enumerate(stmt.on_duplicate):
+        if hit(e):
+            stmt.on_duplicate[i] = (col, mk(e))
+        else:
+            walk(e)

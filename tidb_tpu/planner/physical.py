@@ -705,7 +705,7 @@ def _try_point_get(ds: DataSource) -> PhysPlan | None:
         # silently miss them (clustered-PK lookups above are fine — bulk
         # handles ARE the PK values)
         return None
-    for idx in tbl.public_indexes():
+    for idx in _candidate_indexes(ds, tbl):
         if idx.unique and set(eqs) == {c.lower() for c in idx.columns}:
             vals = [eqs[c.lower()] for c in idx.columns]
             return PhysPointGet(tbl, ds.db_name, cols, None, idx, vals,
@@ -871,7 +871,7 @@ def _try_index_range(ds: DataSource) -> PhysPlan | None:
     if not by_col:
         return None
     best = None     # (n_prefix, has_range, index, prefix, lo..hi, used)
-    for idx in tbl.public_indexes():
+    for idx in _candidate_indexes(ds, tbl):
         prefix, used = [], []
         low = high = None
         low_inc = high_inc = True
@@ -954,6 +954,28 @@ def _limit_to_index_range(rd, scan_limit):
     return ir
 
 
+def _candidate_indexes(ds, tbl):
+    """Access-path-visible indexes: drops INVISIBLE indexes (still
+    write-maintained) and applies table-level USE/FORCE/IGNORE INDEX
+    hints by name (reference pkg/planner/core access-path filtering;
+    FORCE approximated as USE — candidates restrict, cost picks)."""
+    idxs = [i for i in tbl.public_indexes()
+            if not getattr(i, "invisible", False)]
+    hints = getattr(ds, "index_hints", None) or []
+    allowed, ignored = None, set()
+    for kind, names in hints:
+        low = {n.lower() for n in names}
+        if kind in ("use", "force"):
+            allowed = low if allowed is None else (allowed | low)
+        else:
+            ignored |= low
+    if allowed is not None:
+        idxs = [i for i in idxs if i.name.lower() in allowed]
+    if ignored:
+        idxs = [i for i in idxs if i.name.lower() not in ignored]
+    return idxs
+
+
 def _flatten_or(c, out):
     if isinstance(c, ScalarFunc) and c.op == "or":
         for a in c.args:
@@ -970,7 +992,7 @@ def _try_index_merge(ds: DataSource) -> PhysPlan | None:
             getattr(ds, "bulk_only", False):
         return None
     indexed_cols = {}
-    for idx in tbl.public_indexes():
+    for idx in _candidate_indexes(ds, tbl):
         if len(idx.columns) >= 1:
             indexed_cols.setdefault(idx.columns[0].lower(), idx)
     if not indexed_cols:
@@ -1313,6 +1335,8 @@ def _inner_key_info(leaf: PhysTableReader, col_idx):
     if tbl.pk_is_handle and tbl.pk_col_name.lower() == nm:
         return sc, None
     for idx in tbl.public_indexes():
+        if getattr(idx, "invisible", False):
+            continue        # invisible indexes serve no read path
         if (idx.unique or idx.primary) and len(idx.columns) == 1 and \
                 idx.columns[0].lower() == nm:
             return sc, idx

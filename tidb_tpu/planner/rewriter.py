@@ -369,6 +369,15 @@ class Rewriter:
         ft = agg_field_type([r.ft for r in results]) if results else new_null_type()
         return self.mk_func("case_when", args, ft)
 
+    def _rw_Collate(self, node: ast.Collate):
+        """expr COLLATE name: string identity cast whose result type
+        carries the explicit collation, so comparison/group/sort folds
+        pick it up (reference pkg/expression collation coercion)."""
+        a = self.rewrite(node.expr)
+        ft = new_string_type(getattr(a.ft, "flen", -1))
+        ft.collate = node.collation
+        return self.mk_func("cast_char", [a], ft)
+
     def _rw_Cast(self, node: ast.Cast):
         a = self.rewrite(node.expr)
         t = node.to_type

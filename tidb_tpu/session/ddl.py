@@ -499,6 +499,16 @@ class DDLExecutor:
                 self._alter_rename_column(stmt.table, *payload)
             elif action == "rename_index":
                 self._alter_rename_index(stmt.table, *payload)
+            elif action == "alter_index_visibility":
+                self._alter_index_visibility(stmt.table, *payload)
+            elif action == "ignore_fulltext":
+                # reference behavior: FULLTEXT syntax accepted, no
+                # index created (warning 1214)
+                if self.sess is not None:
+                    self.sess.vars.warnings.append({
+                        "level": "Warning", "code": 1214,
+                        "msg": "FULLTEXT index is not supported; "
+                               "the clause was parsed and ignored"})
             elif action == "set_default":
                 self._alter_set_default(stmt.table, *payload)
             elif action == "table_option":
@@ -658,6 +668,21 @@ class DDLExecutor:
             if tbl.find_index(new) is not None:
                 raise IndexExistsError("Duplicate key name '%s'", new)
             idx.name = new
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+
+    def _alter_index_visibility(self, tn, iname, visible):
+        """ALTER INDEX i VISIBLE|INVISIBLE — meta-only flip; writes
+        keep maintaining the index, the planner's access-path search
+        skips it (reference ddl AlterIndexVisibility,
+        planner invisible-index pruning)."""
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            idx = tbl.find_index(iname)
+            if idx is None:
+                raise IndexNotExistsError("index %s doesn't exist",
+                                          iname)
+            idx.invisible = not visible
             m.update_table(db.id, tbl)
         self._with_meta(fn)
 
