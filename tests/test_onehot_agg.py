@@ -1,0 +1,96 @@
+"""One-hot MXU segment-aggregation lowering (copr/dag_exec
+onehot_agg_body): a host-learned slot table + int8 limb matmuls replace
+the device argsort for small group domains under the TPU segment
+policy. Exactness guards: miss detection on new/out-of-span keys,
+zero-slot drop for deletes, arbitrary-precision limb recombination.
+Forced on here via TIDB_TPU_SEGMENT_IMPL=runs + TIDB_TPU_ONEHOT_FORCE
+(the CPU backend's scatter impl would otherwise skip it)."""
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_SEGMENT_IMPL", "runs")
+    monkeypatch.setenv("TIDB_TPU_ONEHOT_FORCE", "1")
+    tk = TestKit()
+    tk.must_exec("create table f (id bigint primary key, g bigint, "
+                 "h bigint, v bigint, w bigint)")
+    rng = np.random.RandomState(7)
+    rows = []
+    for i in range(30000):
+        rows.append(
+            f"({i},{int(rng.randint(0, 40)) * 977},"
+            f"{int(rng.randint(0, 5))},"
+            f"{int(rng.randint(-1000000, 1000000))},"
+            f"{int(rng.randint(0, 1 << 40))})")
+    tk.must_exec("insert into f values " + ",".join(rows))
+    return tk
+
+
+Q = ("select g, h, count(*), sum(v), sum(w), avg(v) from f "
+     "where v > -900000 group by g, h order by g, h")
+
+
+def test_onehot_learns_and_matches(tk):
+    r1 = tk.must_query(Q).rs.rows          # learns from sorted/runs
+    m0 = tk.domain.metrics.get("fused_onehot_agg", 0)
+    r2 = tk.must_query(Q).rs.rows          # one-hot path
+    assert tk.domain.metrics.get("fused_onehot_agg", 0) > m0
+    assert len(r1) == len(r2) == 200
+    for a, b in zip(r1, r2):
+        assert list(a) == list(b)
+
+
+def test_onehot_miss_invalidates(tk):
+    tk.must_query(Q)
+    tk.must_query(Q)
+    assert tk.domain.metrics.get("fused_onehot_agg", 0) > 0
+    # a brand-new group key must be a miss -> exact fallback + relearn
+    tk.must_exec("insert into f values (100000, 99991, 9, 5, 5)")
+    r3 = tk.must_query(Q).rs.rows
+    assert len(r3) == 201
+    r4 = tk.must_query(Q).rs.rows
+    assert [list(x) for x in r3] == [list(x) for x in r4]
+
+
+def test_onehot_zero_slot_drop(tk):
+    tk.must_query(Q)
+    tk.must_query(Q)
+    tk.must_exec("delete from f where g = 0")
+    r = tk.must_query(Q).rs.rows
+    assert 0 not in {x[0] for x in r}
+    assert len(r) == 195 or len(r) == 196      # 5 h-groups under g=0
+
+
+def test_onehot_negative_and_wide_sums(tk):
+    # sums with negatives (sign-bit limb) and 40-bit values must be
+    # bit-exact vs the host oracle
+    dev = tk.must_query("select g, sum(v), sum(w) from f group by g "
+                        "order by g").rs.rows
+    dev2 = tk.must_query("select g, sum(v), sum(w) from f group by g "
+                         "order by g").rs.rows
+    tk.domain.copr.use_device = False
+    host = tk.must_query("select g, sum(v), sum(w) from f group by g "
+                         "order by g").rs.rows
+    tk.domain.copr.use_device = True
+    assert [list(x) for x in dev] == [list(x) for x in host]
+    assert [list(x) for x in dev2] == [list(x) for x in host]
+
+
+def test_onehot_pipelined_miss_on_one_partition(tk, monkeypatch):
+    """A new key whose rows land in only ONE partition: the sibling
+    pipelined partition consumes its dispatched one-hot state cleanly
+    while the miss pops the cache — must fall back, not crash."""
+    tk.domain.copr.device_rows = 8192      # ~4 partitions
+    tk.must_query(Q)
+    tk.must_query(Q)
+    assert tk.domain.metrics.get("fused_onehot_agg", 0) > 0
+    # key 99991*977 only ever lands in the last partition
+    tk.must_exec("insert into f values (100001, 97661207, 0, 1, 1)")
+    r = tk.must_query(Q).rs.rows
+    assert len(r) == 201
+    r2 = tk.must_query(Q).rs.rows
+    assert [list(x) for x in r] == [list(x) for x in r2]

@@ -178,3 +178,23 @@ def test_index_hint_error_code_1176(tk):
         assert False, "expected error"
     except Exception as e:
         assert getattr(e, "code", None) == 1176
+
+
+def test_adjacent_string_literal_concat(tk):
+    # MySQL concatenates adjacent string literals (the implicit alias
+    # rule must not hijack them)
+    got = rows(tk, "select 'a' 'b', concat('x' 'y', 'z')")
+    assert list(got[0]) == ["ab", "xyz"]
+
+
+def test_row_alias_inside_case(tk):
+    tk.must_exec("create table t (id int primary key, a int)")
+    tk.must_exec("insert into t values (1, 10)")
+    tk.must_exec("insert into t values (1, 30) as new on duplicate "
+                 "key update a = case when new.a > 5 then new.a "
+                 "else 0 end")
+    assert [int(r[0]) for r in rows(tk, "select a from t")] == [30]
+    tk.must_exec("insert into t values (1, 3) as new on duplicate "
+                 "key update a = case when new.a > 5 then new.a "
+                 "else 0 end")
+    assert [int(r[0]) for r in rows(tk, "select a from t")] == [0]

@@ -944,6 +944,15 @@ def _make_pipeline_body(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
             if ecap is not None or want_fnvalid:
                 res["fnvalid"] = fnvalid
             return res
+        if agg_kind == "onehot":
+            (scap_oh,) = agg_param
+            sargs = dargs[len(dims)]
+            res = _de.onehot_agg_body(ctx, mask, group_items, aggs,
+                                      cap, scap_oh, sargs)
+            res["nvalid"] = jnp.sum(mask.astype(jnp.int64))
+            if ecap is not None or want_fnvalid:
+                res["fnvalid"] = fnvalid
+            return res
         gb, agg_impl, topn, ccap = agg_param
         csum = jnp.cumsum(mask.astype(jnp.int64))
         nvalid = csum[cap - 1]
@@ -1104,6 +1113,56 @@ def _delta_in_span(shim, sizes, delta_part):
                           int(live.max()) > off + size - 2):
             return False
     return True
+
+
+def _oh_learn_table(copr, ohk, plan, oh_learn):
+    """Build the one-hot slot table from a completed sorted/runs
+    execution's partials: union the per-partition group keys, pack them
+    with host-chosen offsets/spans (the kernel range-checks each code,
+    so any later out-of-span value is a miss, never an alias), and
+    store the sorted packed table + per-slot key columns."""
+    K = len(plan.group_items)
+    kcols = [np.concatenate([e[0][i] for e in oh_learn])
+             for i in range(K)]
+    knulls = [np.concatenate([e[1][i] for e in oh_learn])
+              for i in range(K)]
+    los, spans = [], []
+    packed = np.zeros(len(kcols[0]), dtype=np.int64)
+    total_bits = 0.0
+    for i in range(K):
+        vals = kcols[i]
+        if vals.dtype.kind not in "iu":
+            copr._host_cache[ohk] = False
+            return
+        nn = vals[~knulls[i]]
+        lo = int(nn.min()) if len(nn) else 0
+        hi = int(nn.max()) if len(nn) else 0
+        span = hi - lo + 2
+        total_bits += np.log2(max(span, 1))
+        los.append(lo)
+        spans.append(span)
+        code = np.where(knulls[i], 0, vals.astype(np.int64) - lo + 1)
+        packed = packed * span + code
+    if total_bits >= 61.0:
+        copr._host_cache[ohk] = False
+        return
+    uniq, idx = np.unique(packed, return_index=True)
+    nslots = len(uniq)
+    if nslots == 0 or nslots > _de._ONEHOT_MAX:
+        copr._host_cache[ohk] = False
+        return
+    scap = 128
+    while scap < nslots:
+        scap <<= 1
+    skeys = np.full(scap, _I64_MAX, dtype=np.int64)
+    skeys[:nslots] = uniq
+    copr._host_cache[ohk] = {
+        "skeys": skeys, "los": np.asarray(los, dtype=np.int64),
+        "spans": np.asarray(spans, dtype=np.int64),
+        "nslots": nslots, "scap": scap,
+        "key_vals": [kcols[i][idx] for i in range(K)],
+        "key_nulls": [knulls[i][idx] for i in range(K)],
+    }
 
 
 def fused_partials(copr, plan, read_ts, mesh=None,
@@ -1275,6 +1334,41 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     ts = None
     if mesh is None:
         ts = _fused_topn_state(copr, plan, fact_tbl, offk, kd, sd)
+    # one-hot MXU lowering state: a host-learned slot table replaces
+    # the device argsort for small group domains (dag_exec
+    # onehot_agg_body). Learned from the first sorted/runs execution,
+    # invalidated by misses (new/changed keys) at consume time.
+    ohk = ("onehot", fact_tbl.gc_epoch) + gbkey
+    oh_learn = []
+    oh_parts = []
+
+    def _oh_eligible():
+        if not plan.group_items or pos_spec is not None or \
+                sizes is not None or delta_rows or mesh is not None:
+            return False
+        if copr._host_cache.get(ohk) is False:
+            return False
+        if jax.default_backend() == "cpu" and \
+                not os.environ.get("TIDB_TPU_ONEHOT_FORCE"):
+            # the one-hot matmul is O(cap*scap*limbs): ~0.5ms on the
+            # MXU at q10's SF1 shape but SECONDS on a host core — this
+            # lowering exists for real accelerators only
+            return False
+        for a in plan.aggs:
+            if a.name == "count":
+                continue
+            if a.name not in ("sum", "avg"):
+                return False
+            try:
+                ectx1 = EvalCtx(np, 1, one, host=True)
+                d1, _nl1, _sd1 = eval_expr(ectx1, a.args[0])
+                dt = getattr(d1, "dtype", None)
+                if dt is None or dt.kind != "i":
+                    return False    # exact limb sums are int64-only
+            except Exception:       # noqa: BLE001
+                return False
+        return True
+    oh_elig = _oh_eligible()
     if mesh is not None:
         return _run_fused_mpp(
             copr, plan, mesh, fact_tbl, fact_arrays, fact_valid, n,
@@ -1309,6 +1403,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             agg_param = (tuple(pos_spec[1]), pos_spec[2])
         elif sizes is not None:
             agg_kind, agg_param = "dense", tuple(sizes)
+        elif isinstance(copr._host_cache.get(ohk), dict) and \
+                cap <= (1 << 23):
+            # learned slot table: one-hot MXU aggregation (int32 limb
+            # exactness needs cap*127 < 2^31, hence the cap guard).
+            agg_kind, agg_param = "onehot", \
+                (copr._host_cache[ohk]["scap"],)
         else:
             agg_impl = copr._host_cache.get(implk) or _segment_impl()
             topn_k = None
@@ -1359,13 +1459,30 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         fjc_full, fvv = copr._pad_upload(cols, v, m, cap,
                                          bind_keys=bind_keys)
         fjc = {k: (d, nl) for k, (d, nl, _) in fjc_full.items()}
-        res = prefetch(kern(fjc, fvv, dim_args))
-        return res, cap, agg_param, ecap
+        kargs = dim_args
+        oh_table = None
+        if agg_kind == "onehot":
+            # carry the table in the dispatch state: a sibling
+            # pipelined partition's miss may pop the cache entry
+            # before this partition consumes, so consume must never
+            # re-read copr._host_cache
+            oh_table = copr._host_cache[ohk]
+            dev = oh_table.get("dev")
+            if dev is None:
+                dev = {"skeys": jnp.asarray(oh_table["skeys"]),
+                       "los": jnp.asarray(oh_table["los"]),
+                       "spans": jnp.asarray(oh_table["spans"]),
+                       "nslots": jnp.asarray([oh_table["nslots"]],
+                                             dtype=jnp.int64)}
+                oh_table["dev"] = dev
+            kargs = list(dim_args) + [dev]
+        res = prefetch(kern(fjc, fvv, kargs))
+        return res, cap, agg_kind, agg_param, ecap, oh_table
 
     def _consume_part(state, cols, v, m, bind_keys):
         nonlocal group_bucket
         while True:
-            res, cap, agg_param, ecap = state
+            res, cap, agg_kind, agg_param, ecap, oh_table = state
             # early-compaction policy: learn the survivor bucket on
             # first sight, regrow + rerun on overflow (fnvalid is the
             # fact-filter survivor count BEFORE any compaction loss, so
@@ -1380,6 +1497,28 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 return
             if sizes is not None:
                 out.append(_compact_dense(shim, res, sizes, kd, sd))
+                return
+            if agg_kind == "onehot":
+                if int(res["miss"]) > 0:
+                    # new/changed keys since the table was learned:
+                    # fall back to the sorted lowering and relearn
+                    if getattr(copr, "domain", None) is not None:
+                        copr.domain.inc_metric("fused_onehot_miss")
+                    copr._host_cache.pop(ohk, None)
+                    state = _dispatch_part(cols, v, m, bind_keys)
+                    continue
+                OH = oh_table
+                if getattr(copr, "domain", None) is not None:
+                    copr.domain.inc_metric("fused_onehot_agg")
+                acc = np.asarray(res["oh_acc"])
+                states, rowcnt = _de.onehot_decode_states(
+                    acc, plan.aggs, OH["nslots"])
+                oh_parts.append((len(out), rowcnt))
+                out.append(PartialAggResult(
+                    ngroups=OH["nslots"],
+                    keys=[k.copy() for k in OH["key_vals"]],
+                    key_nulls=[kn.copy() for kn in OH["key_nulls"]],
+                    states=states, key_dicts=kd, state_dicts=sd))
                 return
             ngroups = int(res["ngroups"])
             if _compact_policy(copr, compk, agg_param[3],
@@ -1436,13 +1575,21 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                     ngroups=ncand, keys=ckeys, key_nulls=cnulls,
                     states=cstates, key_dicts=kd, state_dicts=sd))
                 return
+            ks = [np.asarray(k)[:ngroups] for k in res["keys"]]
+            kns = [np.asarray(kn)[:ngroups] for kn in res["key_nulls"]]
+            sts = [[np.asarray(s)[:ngroups] for s in st]
+                   for st in res["states"]]
+            if oh_elig and copr._host_cache.get(ohk) is None:
+                # runs partials may repeat a key once per run, so the
+                # slot-count limit applies AFTER the union dedupes
+                # (_oh_learn_table); this bound only caps the transient
+                if ngroups > (1 << 20):
+                    copr._host_cache[ohk] = False
+                    oh_learn.clear()
+                else:
+                    oh_learn.append((ks, kns))
             out.append(PartialAggResult(
-                ngroups=ngroups,
-                keys=[np.asarray(k)[:ngroups] for k in res["keys"]],
-                key_nulls=[np.asarray(kn)[:ngroups]
-                           for kn in res["key_nulls"]],
-                states=[[np.asarray(s)[:ngroups] for s in st]
-                        for st in res["states"]],
+                ngroups=ngroups, keys=ks, key_nulls=kns, states=sts,
                 key_dicts=kd, state_dicts=sd))
             return
 
@@ -1464,6 +1611,27 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             _consume_part(st, c0, v0, m0, b0)
     for st, c0, v0, m0, b0 in pending:
         _consume_part(st, c0, v0, m0, b0)
+    if oh_parts:
+        # drop slots with zero rows across every one-hot partition:
+        # stale learned keys (deletes, older read_ts) must not emit
+        # phantom groups; keys live only in sorted partials still
+        # merge normally
+        total = np.zeros(len(oh_parts[0][1]), dtype=np.int64)
+        for _i, rc in oh_parts:
+            total += rc
+        if (total == 0).any():
+            keep = np.nonzero(total > 0)[0]
+            for i, _rc in oh_parts:
+                p0 = out[i]
+                out[i] = PartialAggResult(
+                    ngroups=len(keep),
+                    keys=[k[keep] for k in p0.keys],
+                    key_nulls=[kn[keep] for kn in p0.key_nulls],
+                    states=[[s[keep] for s in st] for st in p0.states],
+                    key_dicts=p0.key_dicts, state_dicts=p0.state_dicts)
+    if oh_elig and oh_learn and len(oh_learn) == len(out) and \
+            copr._host_cache.get(ohk) is None:
+        _oh_learn_table(copr, ohk, plan, oh_learn)
     return out
 
 

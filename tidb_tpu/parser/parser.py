@@ -2277,7 +2277,13 @@ class Parser:
             return ast.Literal(int(t.text, 16))
         if t.kind == "STRING":
             self.next()
-            return ast.Literal(t.text)
+            txt = t.text
+            # MySQL concatenates ADJACENT string literals: 'a' 'b' is
+            # the literal 'ab' (also keeps the implicit string-alias
+            # rule in parse_select_fields from hijacking it)
+            while self.peek().kind == "STRING":
+                txt += self.next().text
+            return ast.Literal(txt)
         if t.kind == "SYSVAR":
             self.next()
             name = t.text

@@ -1857,6 +1857,14 @@ def _subst_row_alias(stmt, cols):
                 for i, x in enumerate(v):
                     if hit(x):
                         v[i] = mk(x)
+                    elif isinstance(x, tuple):
+                        # tuple-structured fields (Case when-clauses):
+                        # rebuild the tuple with substituted members
+                        if any(hit(y) for y in x):
+                            v[i] = tuple(mk(y) if hit(y) else y
+                                         for y in x)
+                        for y in v[i]:
+                            walk(y)
                     else:
                         walk(x)
             else:
