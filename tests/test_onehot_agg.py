@@ -94,3 +94,17 @@ def test_onehot_pipelined_miss_on_one_partition(tk, monkeypatch):
     assert len(r) == 201
     r2 = tk.must_query(Q).rs.rows
     assert [list(x) for x in r] == [list(x) for x in r2]
+
+
+def test_onehot_full_range_keys_rejected(tk):
+    # key spans beyond the 61-bit pack budget must be rejected BEFORE
+    # packing (no OverflowError), falling back to the exact lowering
+    tk.must_exec("create table wide (id bigint primary key, g bigint, "
+                 "v int)")
+    tk.must_exec(f"insert into wide values (1, {-(1 << 62)}, 1), "
+                 f"(2, {1 << 62}, 2), (3, 0, 3)")
+    q = "select g, sum(v) from wide group by g order by g"
+    r1 = tk.must_query(q).rs.rows
+    r2 = tk.must_query(q).rs.rows
+    assert [list(x) for x in r1] == [list(x) for x in r2]
+    assert len(r1) == 3

@@ -1126,8 +1126,10 @@ def _oh_learn_table(copr, ohk, plan, oh_learn):
              for i in range(K)]
     knulls = [np.concatenate([e[1][i] for e in oh_learn])
               for i in range(K)]
+    # derive spans and REJECT before packing: a full-range key column
+    # would otherwise overflow the int64 pack multiply (the kernel has
+    # the same <61-bit bound, so such shapes can never one-hot anyway)
     los, spans = [], []
-    packed = np.zeros(len(kcols[0]), dtype=np.int64)
     total_bits = 0.0
     for i in range(K):
         vals = kcols[i]
@@ -1141,11 +1143,14 @@ def _oh_learn_table(copr, ohk, plan, oh_learn):
         total_bits += np.log2(max(span, 1))
         los.append(lo)
         spans.append(span)
-        code = np.where(knulls[i], 0, vals.astype(np.int64) - lo + 1)
-        packed = packed * span + code
     if total_bits >= 61.0:
         copr._host_cache[ohk] = False
         return
+    packed = np.zeros(len(kcols[0]), dtype=np.int64)
+    for i in range(K):
+        code = np.where(knulls[i], 0,
+                        kcols[i].astype(np.int64) - los[i] + 1)
+        packed = packed * spans[i] + code
     uniq, idx = np.unique(packed, return_index=True)
     nslots = len(uniq)
     if nslots == 0 or nslots > _de._ONEHOT_MAX:
@@ -1582,8 +1587,13 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             if oh_elig and copr._host_cache.get(ohk) is None:
                 # runs partials may repeat a key once per run, so the
                 # slot-count limit applies AFTER the union dedupes
-                # (_oh_learn_table); this bound only caps the transient
-                if ngroups > (1 << 20):
+                # (_oh_learn_table). The CUMULATIVE row bound caps the
+                # staged host copies: runs-degrade already limits each
+                # partition to ~65k partials, so only very-many-
+                # partition shapes (which could never learn a small
+                # table anyway) hit it
+                if sum(len(e[0][0]) for e in oh_learn) + ngroups \
+                        > (1 << 21):
                     copr._host_cache[ohk] = False
                     oh_learn.clear()
                 else:

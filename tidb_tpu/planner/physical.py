@@ -7,6 +7,7 @@ executor/internal/builder/builder_utils.go:64), and which operators run as
 host-orchestrated device ops above the readers."""
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 from ..expression import (Expression, Column, Constant, ScalarFunc,
@@ -1446,6 +1447,8 @@ _FUSE_MAX_GROUP_RATIO = 0.10
 # fact-sized IN-subquery dim lands ~1.3x fact and stays fused; q21's
 # FOUR pair-count dims land ~4x fact and route to host.
 _FUSE_MAX_DIM_MASS_ABS = 1 << 21
+_FUSE_DEV_DIM_MASS_ABS = float(os.environ.get(
+    "TIDB_TPU_FUSE_DEV_DIM_MASS_ABS", str(1 << 26)))
 _FUSE_MAX_DIM_MASS_RATIO = 2.0
 
 
@@ -1720,7 +1723,17 @@ def _orient_pipeline(plan, child, leaves, eqs, filters, owner, fact,
         sum(agg_mass(l) for l, _jt, _ec, _n in outer_dims)
     if dim_rows > _FUSE_MAX_DIM_MASS_ABS and \
             dim_rows > _FUSE_MAX_DIM_MASS_RATIO * est_fact:
-        return None
+        # the host-semi-join alternative only wins on an actual CPU
+        # backend: on the real chip the conventional subtree pays a
+        # tunnel round trip per op against the device-resident store
+        # (q21@SF1 measured >600s host-gated on-chip vs seconds fused),
+        # while the aggregate dims materialize through device kernels.
+        # The accelerator keeps an ABSOLUTE ceiling as the HBM escape
+        # hatch: dims beyond it cannot all be resident.
+        import jax as _jax
+        if _jax.default_backend() == "cpu" or \
+                dim_rows > _FUSE_DEV_DIM_MASS_ABS:
+            return None
     fused = PhysFusedPipeline(fact.dag, dims, post,
                               list(group_items),
                               [_to_partial(a) for a in aggs],
