@@ -1,0 +1,14 @@
+#!/bin/bash
+# One-shot on-chip OLTP capture (sysbench-style point select / index
+# range / update-by-PK — the reference's own headline benchmark class,
+# BASELINE.md stage 5 sibling). Short workload: fits any window.
+cd /root/repo || exit 1
+LOG=/root/repo/TPU_POLL_LOG.txt
+O=/root/repo/BENCH_TPU_oltp.json
+echo "$(date +%F' '%H:%M:%S) oltp capture start" >> "$LOG"
+BENCH_NO_REPLAY=1 BENCH_MODE=oltp BENCH_SF=0.1 BENCH_SECONDS=15 \
+  BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=240 \
+  timeout 1800 python bench.py > /tmp/bench_oltp_try.json 2>>"$LOG"
+grep -q '"backend": "tpu"' /tmp/bench_oltp_try.json && \
+  cp /tmp/bench_oltp_try.json "$O" && \
+  echo "$(date +%F' '%H:%M:%S) oltp TPU bench SAVED" >> "$LOG"

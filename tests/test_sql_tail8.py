@@ -198,3 +198,23 @@ def test_row_alias_inside_case(tk):
                  "key update a = case when new.a > 5 then new.a "
                  "else 0 end")
     assert [int(r[0]) for r in rows(tk, "select a from t")] == [0]
+
+
+def test_signal_and_get_diagnostics(tk):
+    with pytest.raises(Exception) as ei:
+        tk.must_exec("signal sqlstate '45000' set message_text = "
+                     "'my oops', mysql_errno = 30001")
+    assert getattr(ei.value, "code", None) == 30001
+    assert "my oops" in str(ei.value)
+    with pytest.raises(Exception) as ei:
+        tk.must_exec("resignal")
+    assert getattr(ei.value, "code", None) == 1645
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1), (2), (3)")
+    tk.must_exec("get diagnostics @n = number, @rc = row_count")
+    got = rows(tk, "select @n, @rc")
+    assert [int(got[0][0]), int(got[0][1])] == [0, 3]
+    tk.must_exec("alter table t add fulltext index ft (a)")
+    tk.must_exec("get diagnostics condition 1 @m = message_text, "
+                 "@e = mysql_errno")
+    assert int(rows(tk, "select @e")[0][0]) == 1214

@@ -1790,6 +1790,23 @@ def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket,
             "key_nulls": out_key_nulls, "states": states}
 
 
+
+def sorted_run_starts(kvecs, min_rows=1024):
+    """Pre-sorted single-key fast path shared by the host partial agg
+    and the partial MERGE (executors.HashAggExec): when the one key
+    vector is already non-decreasing, group boundaries are run
+    boundaries — no argsort / np.unique. -> (starts, change) or
+    (None, None). Callers pick their own null sentinel BEFORE calling
+    (the two sites differ) and derive inverse/firsts as needed."""
+    if len(kvecs) != 1 or len(kvecs[0]) <= min_rows or \
+            not bool(np.all(kvecs[0][:-1] <= kvecs[0][1:])):
+        return None, None
+    kv = kvecs[0]
+    change = np.empty(len(kv), dtype=bool)
+    change[0] = True
+    np.not_equal(kv[1:], kv[:-1], out=change[1:])
+    return np.nonzero(change)[0], change
+
 def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
     """numpy fallback with identical output layout.
 
@@ -1826,18 +1843,13 @@ def _host_partial_agg(ctx, dag, valid, shared_dicts=None):
     starts = None       # run starts when keys arrive pre-sorted
     if keys:
         kvecs = [np.where(kn, -1, k)[idx] for k, kn in zip(keys, key_nulls)]
-        if len(kvecs) == 1 and len(kvecs[0]) > 1024 and \
-                bool(np.all(kvecs[0][:-1] <= kvecs[0][1:])):
+        starts, _change = sorted_run_starts(kvecs)
+        if starts is not None:
             # pre-sorted single key (clustered-PK order, e.g. GROUP BY
             # l_orderkey over lineitem): group boundaries are run
             # boundaries — no argsort, and the agg loop below uses
             # exact dtype-preserving ufunc.reduceat instead of the
             # unbuffered (slow) ufunc.at scatters
-            kv = kvecs[0]
-            change = np.empty(len(kv), dtype=bool)
-            change[0] = True
-            np.not_equal(kv[1:], kv[:-1], out=change[1:])
-            starts = np.nonzero(change)[0]
             ngroups = len(starts)
             firsts = idx[starts]
         else:

@@ -1232,15 +1232,11 @@ class HashAggExec(Executor):
         if ngk:
             kvecs = [np.where(kn, -(1 << 62), k)
                      for k, kn in zip(keys, key_nulls)]
-            if ngk == 1 and len(kvecs[0]) > 1024 and \
-                    bool(np.all(kvecs[0][:-1] <= kvecs[0][1:])):
+            from ..copr.dag_exec import sorted_run_starts
+            starts, change = sorted_run_starts(kvecs)
+            if starts is not None:
                 # partials over range partitions of a clustered key
                 # concatenate in key order: merge by runs, no argsort
-                kv = kvecs[0]
-                change = np.empty(len(kv), dtype=bool)
-                change[0] = True
-                np.not_equal(kv[1:], kv[:-1], out=change[1:])
-                starts = np.nonzero(change)[0]
                 g = len(starts)
                 inverse = np.cumsum(change) - 1
                 firsts = starts
@@ -2081,14 +2077,8 @@ class HashJoinExec(Executor):
         else:
             lo = np.searchsorted(sbv, pv, side="left")
             hi = np.searchsorted(sbv, pv, side="right")
-            counts = hi - lo
-            counts[pnull] = 0
-            total = int(counts.sum())
-            pi = np.repeat(np.arange(len(probe)), counts)
-            starts = np.repeat(lo, counts)
-            base = np.repeat(np.cumsum(counts) - counts, counts)
-            intra = np.arange(total) - base
-            bi = border[starts + intra]
+            pi, pos = _expand_ranges(lo, hi, pnull)
+            bi = border[pos]
             # exclude null build keys (they sit grouped; NULL keys coerce
             # to 0 and may collide with real 0 keys, so filter matches)
             if bnull.any():
@@ -2180,13 +2170,8 @@ class HashJoinExec(Executor):
             sb = bcorr[vb_idx]
             lo = np.searchsorted(sb, pcorr, side="left")
             hi = np.searchsorted(sb, pcorr, side="right")
-            counts = hi - lo
-            counts[pcorr_null] = 0
-            total = int(counts.sum())
-            pi = np.repeat(np.arange(len(probe)), counts)
-            starts = np.repeat(lo, counts)
-            base = np.repeat(np.cumsum(counts) - counts, counts)
-            bi = vb_idx[starts + (np.arange(total) - base)]
+            pi, pos = _expand_ranges(lo, hi, pcorr_null)
+            bi = vb_idx[pos]
             mask = self._pair_conds_mask(probe, pi, build, bi)
             pi, bi = pi[mask], bi[mask]
             group_exists = np.zeros(len(probe), dtype=bool)
@@ -2506,14 +2491,8 @@ class MergeJoinExec(Executor):
         # linear merge: per left row, matching right run via searchsorted
         lo = np.searchsorted(srk, slk, side="left")
         hi = np.searchsorted(srk, slk, side="right")
-        cnt = hi - lo
-        cnt[lnull[lorder]] = 0
         rvalid = ~rnull[rorder]
-        total = int(cnt.sum())
-        li = np.repeat(np.arange(len(slk)), cnt)
-        starts = np.repeat(lo, cnt)
-        base = np.repeat(np.cumsum(cnt) - cnt, cnt)
-        ri = starts + (np.arange(total) - base)
+        li, ri = _expand_ranges(lo, hi, lnull[lorder])
         keep = rvalid[ri]
         li, ri = li[keep], ri[keep]
         lidx = lorder[li]
@@ -2551,6 +2530,21 @@ class MergeJoinExec(Executor):
         out = Chunk.concat_all(rows)
         return out if out is not None else Chunk.empty(out_fts)
 
+
+
+def _expand_ranges(lo, hi, null_mask=None):
+    """Ragged searchsorted range-expansion shared by the join probe,
+    the correlated NAAJ pair builder, and the merge-join: per probe i,
+    emit (pi=i, pos=lo[i]..hi[i]-1). null_mask zeroes those probes.
+    -> (pi, pos) index arrays."""
+    counts = hi - lo
+    if null_mask is not None:
+        counts[null_mask] = 0
+    total = int(counts.sum())
+    pi = np.repeat(np.arange(len(lo)), counts)
+    starts = np.repeat(lo, counts)
+    base = np.repeat(np.cumsum(counts) - counts, counts)
+    return pi, starts + (np.arange(total) - base)
 
 def _null_column(ft, n) -> Column:
     if ft.tclass in (TypeClass.STRING, TypeClass.JSON):

@@ -727,6 +727,23 @@ class KillStmt(StmtNode):
 
 
 @dataclass
+class SignalStmt(StmtNode):
+    """SIGNAL/RESIGNAL SQLSTATE 'xxxxx' SET item = v, ... (reference
+    pkg/parser signal grammar; standalone RESIGNAL is error 1645)."""
+    sqlstate: str = "45000"
+    is_resignal: bool = False
+    items: dict = field(default_factory=dict)  # message_text/mysql_errno
+
+
+@dataclass
+class GetDiagnosticsStmt(StmtNode):
+    """GET [CURRENT] DIAGNOSTICS @v = NUMBER|ROW_COUNT, ... and
+    CONDITION n @v = MESSAGE_TEXT|RETURNED_SQLSTATE|MYSQL_ERRNO."""
+    condition: ExprNode | None = None          # None = statement area
+    items: list = field(default_factory=list)  # [(var, what)]
+
+
+@dataclass
 class BRStmt(StmtNode):
     """BACKUP/RESTORE DATABASE db TO/FROM 'path' (reference br/ + BRIE SQL,
     pkg/executor/brie.go)."""

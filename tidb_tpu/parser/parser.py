@@ -412,6 +412,44 @@ class Parser:
                 self.expect_kw("timestamp")
                 stmt.until = self.next().text
             return stmt
+        if kw in ("signal", "resignal"):
+            self.next()
+            stmt = ast.SignalStmt(is_resignal=(kw == "resignal"))
+            if self.accept_kw("sqlstate"):
+                self.accept_kw("value")
+                stmt.sqlstate = self.next().text
+            if self.accept_kw("set"):
+                while True:
+                    item = self.ident().lower()
+                    self.expect_op("=")
+                    t2 = self.next()
+                    if t2.kind == "NUMBER":
+                        if "." in t2.text or "e" in t2.text.lower():
+                            self.error("signal item values must be "
+                                       "integers or strings")
+                        stmt.items[item] = int(t2.text)
+                    else:
+                        stmt.items[item] = t2.text
+                    if not self.accept_op(","):
+                        break
+            return stmt
+        if kw == "get":
+            self.next()
+            self.accept_kw("current") or self.accept_kw("stacked")
+            self.expect_kw("diagnostics")
+            stmt = ast.GetDiagnosticsStmt()
+            if self.accept_kw("condition"):
+                stmt.condition = self.parse_expr()
+            while True:
+                t2 = self.next()
+                if t2.kind != "USERVAR":
+                    self.error("expected @var in GET DIAGNOSTICS")
+                self.expect_op("=")
+                stmt.items.append((t2.text.lower(),
+                                   self.ident().lower()))
+                if not self.accept_op(","):
+                    break
+            return stmt
         self.error(f"unsupported statement '{kw}'")
 
     def parse_with_select(self) -> ast.SelectStmt:

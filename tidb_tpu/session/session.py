@@ -149,6 +149,13 @@ class Session:
         # load, TTL — accumulates into its triggering statement)
         from ..utils import phase as _phase
         _phase.stmt_enter()
+        # MySQL diagnostics-area lifecycle: each statement RESETS the
+        # area; SHOW WARNINGS/ERRORS and GET DIAGNOSTICS read the
+        # PREVIOUS statement's area so they are exempt
+        if not (isinstance(stmt, ast.GetDiagnosticsStmt) or
+                (isinstance(stmt, ast.ShowStmt) and
+                 stmt.kind in ("warnings", "errors"))):
+            self.vars.warnings = []
         start = time.time()
         with self.domain.tracer.span("statement", conn_id=self.conn_id,
                                      stmt=type(stmt).__name__):
@@ -156,7 +163,15 @@ class Session:
                 rs = self._dispatch(stmt, params)
                 self._observe(stmt, sql, start, ok=True, rgroup=rg)
                 return rs
-            except TiDBError:
+            except TiDBError as e:
+                # the error becomes the statement's diagnostics area
+                # (SHOW WARNINGS / GET DIAGNOSTICS after a failed
+                # statement see it, like MySQL)
+                self.vars.warnings = [{
+                    "level": "Error",
+                    "code": getattr(e, "code", 1105),
+                    "sqlstate": getattr(e, "sqlstate", "HY000"),
+                    "msg": e.msg}]
                 self._observe(stmt, sql, start, ok=False, rgroup=rg)
                 self._finish_stmt(error=True)
                 raise
@@ -622,6 +637,58 @@ class Session:
         if isinstance(stmt, ast.ImportStmt):
             from ..executor.importer import exec_import
             return exec_import(self, stmt)
+        if isinstance(stmt, ast.SignalStmt):
+            # reference pkg/parser signal grammar; standalone RESIGNAL
+            # has no active handler -> 1645; SIGNAL raises the
+            # user-defined condition (1644 unless MYSQL_ERRNO given)
+            if stmt.is_resignal:
+                e = TiDBError("RESIGNAL when handler not active")
+                e.code = 1645
+                e.sqlstate = "0K000"
+                raise e
+            msg = stmt.items.get(
+                "message_text",
+                "Unhandled user-defined exception condition")
+            e = TiDBError("%s", str(msg))
+            e.code = int(stmt.items.get("mysql_errno", 1644))
+            e.sqlstate = stmt.sqlstate
+            raise e
+        if isinstance(stmt, ast.GetDiagnosticsStmt):
+            warns = list(self.vars.warnings)
+            if stmt.condition is not None:
+                from ..planner.rewriter import Rewriter
+                from ..planner.schema import Schema
+                ce = Rewriter(self._plan_ctx(), Schema()).rewrite(
+                    stmt.condition)
+                from ..expression import EvalCtx as _ECtx, \
+                    eval_expr as _eval
+                import numpy as _np
+                cv, _n, _s = _eval(_ECtx(_np, 1, {}, host=True), ce)
+                ci = int(cv if _np.isscalar(cv) else _np.asarray(cv)[0])
+                if ci < 1 or ci > len(warns):
+                    raise TiDBError("Invalid condition number")
+                w = warns[ci - 1]
+                for var, what in stmt.items:
+                    val = {"message_text": w.get("msg", ""),
+                           "mysql_errno": w.get("code", 0),
+                           "returned_sqlstate":
+                               w.get("sqlstate", "HY000"),
+                           "class_origin": "ISO 9075",
+                           "condition_number": ci}.get(what)
+                    if val is None:
+                        raise UnsupportedError(
+                            "unknown diagnostics item %s", what)
+                    self.domain.user_vars[var] = val
+            else:
+                for var, what in stmt.items:
+                    val = {"number": len(warns),
+                           "row_count": self.vars.last_affected}.get(
+                               what)
+                    if val is None:
+                        raise UnsupportedError(
+                            "unknown diagnostics item %s", what)
+                    self.domain.user_vars[var] = val
+            return ResultSet()
         if isinstance(stmt, ast.DoStmt):
             from ..planner.rewriter import Rewriter
             from ..planner.schema import Schema
