@@ -420,13 +420,20 @@ def main():
     from tidb_tpu.utils import phase as _phase
     phases = {}
 
-    def run(q, use_device, n_runs=None, warmup=True):
+    def run(q, use_device, n_runs=None, warmup=True, sess=None,
+            hb=None):
+        """sess/hb: the per-query watchdog runs this in a worker thread
+        on its OWN session (the main loop may move on after a wedge —
+        two statements must never share one Session) with its OWN
+        heartbeat dict (a zombie's beats must not mask later stalls)."""
+        sess = sess if sess is not None else tk
+        hb = hb if hb is not None else progress
         tk.domain.copr.use_device = use_device
         if warmup:
-            progress["t"] = time.time()
+            hb["t"] = time.time()
             _phase.reset()
             t = time.time()
-            tk.must_query(ALL_QUERIES[q])   # warmup (compile)
+            sess.must_query(ALL_QUERIES[q])   # warmup (compile)
             w = _phase.snap()
             w["total_ms"] = round((time.time() - t) * 1000, 1)
             phases.setdefault(q, {})["warmup"] = w
@@ -436,10 +443,10 @@ def main():
             # (cold SF10 compiles run minutes) must not read as a lost
             # grant — only a repeat that ITSELF exceeds the stall
             # budget trips the watchdog
-            progress["t"] = time.time()
+            hb["t"] = time.time()
             _phase.reset()
             t = time.time()
-            tk.must_query(ALL_QUERIES[q])
+            sess.must_query(ALL_QUERIES[q])
             dt = time.time() - t
             if dt < best and use_device:
                 s = _phase.snap()
@@ -541,14 +548,85 @@ def main():
         import threading
         threading.Thread(target=watchdog, daemon=True).start()
 
+    # per-query watchdog (first line of defense, before the global
+    # stall watchdog hard-exits): a wedged device query becomes a
+    # recorded {"error": ..., "fallback": true} row — with a host-twin
+    # measurement when the host path still works — and the run
+    # CONTINUES to the next query instead of timing out the artifact
+    # (round-5: BENCH_r05 rc=124 at q12, SF10 stalled forever at q21).
+    import threading as _threading
+    qto = float(os.environ.get(
+        "BENCH_QUERY_TIMEOUT_S", str(stall_s * 0.8) if live else "0"))
+
+    def run_with_budget(q):
+        """-> ('ok', best_seconds) | ('wedged', None). Wedge = no
+        per-repeat heartbeat for qto seconds (a long-but-alive repeat
+        keeps beating; only a truly stuck dispatch trips). The worker
+        runs on its own session with its own heartbeat dict, so an
+        abandoned wedged thread can neither corrupt the next query's
+        session nor mask a later genuine stall (a stuck XLA call
+        cannot be cancelled — supervision happens around it)."""
+        if not qto or qto <= 0:
+            return "ok", run(q, True)
+        box = {}
+        done = _threading.Event()
+        qs = tk.new_session()
+        hb = {"t": time.time()}
+
+        def _r():
+            try:
+                box["v"] = run(q, True, sess=qs, hb=hb)
+            except BaseException as e:              # noqa: BLE001
+                box["e"] = e
+            finally:
+                done.set()
+
+        th = _threading.Thread(target=_r, daemon=True)
+        th.start()
+        while not done.wait(2.0):
+            if time.time() - hb["t"] > qto:
+                return "wedged", None
+            # forward live heartbeats to the global stall watchdog
+            progress["t"] = max(progress["t"], hb["t"])
+        if "e" in box:
+            raise box["e"]
+        return "ok", box["v"]
+
+    def host_twin_ms(q):
+        """Host-path measurement on a FRESH session after a device
+        wedge (the wedged thread may still hold the main session)."""
+        s = tk.new_session()
+        tk.domain.copr.use_device = False
+        try:
+            t0 = time.time()
+            s.must_query(ALL_QUERIES[q])
+            return round((time.time() - t0) * 1000, 1)
+        finally:
+            tk.domain.copr.use_device = True
+
     for q in queries:
         progress["q"] = q
         progress["t"] = time.time()
         try:
-            t_tpu = run(q, True)
+            status, t_tpu = run_with_budget(q)
         except Exception as e:                      # noqa: BLE001
             print(f"# {q}: DEVICE PATH ERROR {e}", file=sys.stderr)
             per_query[q] = {"error": str(e)[:120]}
+            continue
+        if status == "wedged":
+            print(f"# {q}: DEVICE WEDGED (> {qto:.0f}s); recording "
+                  "fallback row and continuing", file=sys.stderr)
+            row = {"error": f"device wedged (> {qto:.0f}s)",
+                   "fallback": True}
+            try:
+                row["ms"] = host_twin_ms(q)
+                row["backend"] = "host-fallback"
+            except Exception as e2:                 # noqa: BLE001
+                row["host_error"] = str(e2)[:120]
+            # host-fallback times never enter tpu_times/speedups — a
+            # degraded number must not inflate (or deflate) the geomean
+            per_query[q] = row
+            progress["t"] = time.time()
             continue
         if cpu_ref:
             tpu_times[q] = t_tpu

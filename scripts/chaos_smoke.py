@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Chaos smoke: grant-loss injected at EVERY device dispatch site, all
+22 TPC-H queries at SF0.05 must return rows identical to the pure-host
+path — no stall, no rc=124 (ISSUE 1 acceptance; ROADMAP verify notes).
+
+The failpoint spec rides the TIDB_TPU_FAILPOINTS env (the same channel
+a chaos harness would use against a live server) and is installed
+BEFORE the engine imports. Per-query wall budget turns a stall into a
+loud failure instead of a hung CI stage.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [--error-class C]
+Env:    CHAOS_SF (0.05), CHAOS_QUERY_BUDGET_S (120), CHAOS_ERROR (grant_lost)
+Exit:   0 all queries host-identical; 1 mismatch/stall/error.
+"""
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SITES = ("copr/agg", "copr/filter", "copr/topn", "copr/mpp",
+         "fused/kernel", "sort", "window", "join")
+
+
+def main():
+    err = os.environ.get("CHAOS_ERROR", "grant_lost")
+    if "--error-class" in sys.argv:
+        err = sys.argv[sys.argv.index("--error-class") + 1]
+    sf = float(os.environ.get("CHAOS_SF", "0.05"))
+    budget = float(os.environ.get("CHAOS_QUERY_BUDGET_S", "120"))
+    os.environ["TIDB_TPU_FAILPOINTS"] = ";".join(
+        f"device_guard/{s}=error:{err}" for s in SITES)
+    # drag the small-input device paths into the blast radius too
+    os.environ.setdefault("TIDB_TPU_SORT_MIN", "1")
+    os.environ.setdefault("TIDB_TPU_WINDOW_MIN", "1")
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+    from tidb_tpu.utils import failpoint
+
+    queries = sorted(ALL_QUERIES, key=lambda q: int(q[1:]))
+    tk = TestKit()
+    print(f"# chaos_smoke: sf={sf} error={err} sites={len(SITES)}",
+          file=sys.stderr)
+    load_tpch(tk, sf=sf, seed=42)
+
+    chaos, failures = {}, []
+    for q in queries:
+        t0 = time.time()
+        try:
+            chaos[q] = tk.must_query(ALL_QUERIES[q]).rows
+        except Exception as e:              # noqa: BLE001
+            failures.append(f"{q}: chaos run error "
+                            f"{type(e).__name__}: {str(e)[:120]}")
+            continue
+        dt = time.time() - t0
+        if dt > budget:
+            failures.append(f"{q}: exceeded {budget:.0f}s budget "
+                            f"({dt:.1f}s) — supervision did not "
+                            "preempt the stall")
+        print(f"# {q}: chaos {dt*1000:.0f}ms "
+              f"retries={tk.domain.metrics.get('device_retry', 0)} "
+              f"fallbacks={tk.domain.metrics.get('device_fallback', 0)}",
+              file=sys.stderr)
+
+    failpoint.disable_all()
+    os.environ.pop("TIDB_TPU_FAILPOINTS", None)
+    tk.domain.copr.use_device = False
+    for q, rows in sorted(chaos.items(), key=lambda kv: int(kv[0][1:])):
+        try:
+            host = tk.must_query(ALL_QUERIES[q]).rows
+        except Exception as e:              # noqa: BLE001
+            failures.append(f"{q}: host run error {e}")
+            continue
+        if rows != host:
+            failures.append(f"{q}: chaos rows != host rows "
+                            f"({len(rows)} vs {len(host)})")
+
+    m = tk.domain.metrics
+    print(f"# metrics: device_retry={m.get('device_retry', 0)} "
+          f"device_fallback={m.get('device_fallback', 0)} "
+          f"breaker_open={m.get('device_breaker_open', 0)} "
+          f"short_circuit={m.get('device_breaker_short_circuit', 0)}",
+          file=sys.stderr)
+    if failures:
+        print("CHAOS SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"CHAOS SMOKE OK: {len(chaos)}/{len(queries)} queries "
+          "host-identical under injected device failure at every "
+          "dispatch site", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,446 @@
+"""Chaos suite for the device supervision layer (utils/device_guard):
+failpoint-inject each error class at each guarded dispatch site and
+assert (a) retryable errors retry then succeed, (b) exhausted retries
+fall back to the host twin with identical rows, (c) fatal errors
+surface as clean statement errors with txn rollback, (d) the circuit
+breaker trips and SHOW WARNINGS + metrics record the degradation."""
+import time
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.errors import TiDBError, DeviceUnavailableError
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils import device_guard
+from tidb_tpu.utils.device_guard import (
+    classify, guarded_dispatch, CircuitBreaker, DeviceDegradedError,
+    GrantLostError, DeviceResourceExhausted, DeviceCompileError,
+    DeviceWedgedError)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    device_guard.reset()
+    failpoint.disable_all()
+    yield
+    failpoint.disable_all()
+    device_guard.reset()
+
+
+# ---- unit: classification --------------------------------------------
+
+def test_classify_simulated_classes():
+    assert classify(GrantLostError("x")) == "grant_lost"
+    assert classify(DeviceResourceExhausted("x")) == "resource_exhausted"
+    assert classify(DeviceCompileError("x")) == "compile"
+    assert classify(DeviceWedgedError("x")) == "wedged"
+
+
+def test_classify_semantic_errors_fatal():
+    assert classify(TiDBError("boom")) == "fatal"
+    assert classify(failpoint.FailpointError("injected")) == "fatal"
+
+
+def test_classify_xla_by_name_and_message():
+    Xla = type("XlaRuntimeError", (Exception,), {})
+    assert classify(Xla("RESOURCE_EXHAUSTED: hbm oom")) == \
+        "resource_exhausted"
+    assert classify(Xla("UNAVAILABLE: grant revoked")) == "grant_lost"
+    assert classify(Xla("DEADLINE_EXCEEDED: stuck")) == "wedged"
+    assert classify(Xla("INVALID_ARGUMENT: bad lowering")) == "compile"
+    assert classify(Xla("INTERNAL: hiccup")) == "transient"
+    assert classify(RuntimeError("numpy bug")) == "generic"
+    assert classify(MemoryError()) == "resource_exhausted"
+
+
+# ---- unit: failpoint action DSL --------------------------------------
+
+def test_failpoint_nth_gates_first_k_hits():
+    failpoint.enable("fp-nth", "nth:2->error:grant_lost")
+    for _ in range(2):
+        with pytest.raises(GrantLostError):
+            failpoint.inject("fp-nth")
+    assert failpoint.inject("fp-nth") is None      # hit 3: no-op
+    assert failpoint.inject("fp-nth") is None
+
+
+def test_failpoint_sleep_and_error_chain():
+    failpoint.enable("fp-chain", "sleep:30->error:resource_exhausted")
+    t0 = time.time()
+    with pytest.raises(DeviceResourceExhausted):
+        failpoint.inject("fp-chain")
+    assert time.time() - t0 >= 0.025
+
+
+def test_failpoint_unknown_error_name_is_failpoint_error():
+    failpoint.enable("fp-unknown", "error:no_such_class")
+    with pytest.raises(failpoint.FailpointError):
+        failpoint.inject("fp-unknown")
+
+
+def test_failpoint_bad_action_spec_is_loud():
+    with pytest.raises(ValueError):
+        failpoint.enable("fp-bad", "frobnicate:9")
+
+
+# ---- unit: guarded_dispatch ------------------------------------------
+
+def test_retry_then_succeed():
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        if calls[0] == 1:
+            raise GrantLostError("first attempt loses the grant")
+        return 42
+
+    assert guarded_dispatch(fn, site="unit/op", retry_limit=2,
+                            backoff_base_s=0.001) == 42
+    assert calls[0] == 2
+    assert device_guard.METRICS.get("device_retry", 0) == 1
+
+
+def test_exhausted_retries_use_host_fallback():
+    def fn():
+        raise GrantLostError("gone for good")
+
+    out = guarded_dispatch(fn, site="unit/op", retry_limit=2,
+                           backoff_base_s=0.001,
+                           host_fallback=lambda: "host")
+    assert out == "host"
+    assert device_guard.METRICS.get("device_retry", 0) == 2
+    assert device_guard.METRICS.get("device_fallback", 0) == 1
+
+
+def test_nonretryable_degrades_without_retry():
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise DeviceCompileError("deterministic")
+
+    with pytest.raises(DeviceDegradedError) as ei:
+        guarded_dispatch(fn, site="unit/op", retry_limit=5,
+                         backoff_base_s=0.001)
+    assert calls[0] == 1                  # compile errors never retry
+    assert ei.value.err_class == "compile"
+    assert isinstance(ei.value, DeviceUnavailableError)  # clean code 9013
+    assert device_guard.METRICS.get("device_retry", 0) == 0
+
+
+def test_fatal_reraises_unchanged():
+    def fn():
+        raise TiDBError("semantic")
+
+    with pytest.raises(TiDBError) as ei:
+        guarded_dispatch(fn, site="unit/op", retry_limit=3,
+                         host_fallback=lambda: "host")
+    assert not isinstance(ei.value, DeviceDegradedError)
+    assert device_guard.METRICS.get("device_fallback", 0) == 0
+
+
+def test_watchdog_classifies_wedge():
+    def fn():
+        time.sleep(0.5)
+        return "late"
+
+    out = guarded_dispatch(fn, site="unit/wedge", retry_limit=0,
+                           timeout_ms=50, host_fallback=lambda: "host")
+    assert out == "host"
+
+
+def test_retries_clamped_to_statement_deadline():
+    class _Ectx:
+        sv = None
+        deadline = time.time() + 0.15
+        class sess:                        # noqa: N801
+            domain = None
+
+        def check_killed(self):
+            pass
+
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise GrantLostError("always")
+
+    t0 = time.time()
+    with pytest.raises(DeviceDegradedError):
+        guarded_dispatch(fn, site="unit/deadline", ectx=_Ectx(),
+                         retry_limit=50, backoff_base_s=0.08)
+    # 50 retries at 80ms+ base would take > 4s; the deadline clamp must
+    # degrade well before max_execution_time is blown
+    assert time.time() - t0 < 1.0
+    assert calls[0] < 10
+
+
+def test_breaker_trips_and_half_opens(monkeypatch):
+    b = CircuitBreaker(threshold=2, cooldown_s=0.1)
+    assert b.allow()
+    assert not b.record_failure()
+    assert b.record_failure()              # trips on the 2nd
+    assert not b.allow()                   # open: short-circuit
+    time.sleep(0.12)
+    assert b.allow()                       # half-open trial
+    assert b.record_failure()              # trial failed: re-trips...
+    assert not b.allow()                   # ...and re-opens immediately
+    time.sleep(0.12)
+    b.record_success()                     # trial success closes it
+    assert b.allow()
+    assert b.trips == 2
+
+
+def test_breaker_short_circuits_dispatch(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_DEVICE_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("TIDB_TPU_DEVICE_BREAKER_COOLDOWN_S", "60")
+    calls = [0]
+
+    def fn():
+        calls[0] += 1
+        raise DeviceCompileError("nope")
+
+    for _ in range(2):
+        guarded_dispatch(fn, site="bk/op", retry_limit=0,
+                         host_fallback=lambda: "host")
+    assert device_guard.METRICS.get("device_breaker_open", 0) == 1
+    out = guarded_dispatch(fn, site="bk/op", retry_limit=0,
+                           host_fallback=lambda: "host")
+    assert out == "host"
+    assert calls[0] == 2                   # 3rd dispatch never ran fn
+    assert device_guard.METRICS.get(
+        "device_breaker_short_circuit", 0) == 1
+
+
+# ---- engine sites -----------------------------------------------------
+
+AGG_SQL = "select b, sum(c), count(*) from t group by b order by b"
+N_ROWS = 400
+
+
+def _tk():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int, c int)")
+    vals = ",".join(f"({i}, {i % 7}, {i % 13})" for i in range(N_ROWS))
+    tk.must_exec(f"insert into t values {vals}")
+    return tk
+
+
+def _host_rows(tk, sql):
+    tk.domain.copr.use_device = False
+    try:
+        return tk.must_query(sql).rows
+    finally:
+        tk.domain.copr.use_device = True
+
+
+def test_copr_agg_retry_then_succeed():
+    tk = _tk()
+    failpoint.enable("device_guard/copr/agg", "nth:1->error:grant_lost")
+    rows = tk.must_query(AGG_SQL).rows
+    backend = tk.domain.copr.last_backend
+    failpoint.disable_all()
+    assert backend == "device"            # retry won: stayed on device
+    assert rows == _host_rows(tk, AGG_SQL)
+    assert tk.domain.metrics.get("device_retry", 0) >= 1
+    assert tk.domain.metrics.get("device_fallback", 0) == 0
+
+
+def test_copr_agg_exhausted_falls_back_identical():
+    tk = _tk()
+    failpoint.enable("device_guard/copr/agg", "error:grant_lost")
+    rows = tk.must_query(AGG_SQL).rows
+    warns = tk.must_query("show warnings").rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, AGG_SQL)
+    assert tk.domain.metrics.get("device_fallback", 0) >= 1
+    assert tk.domain.metrics.get("device_retry", 0) >= 1
+    assert any(str(w[1]) == str(DeviceUnavailableError.code) and
+               "copr/agg" in w[2] for w in warns), warns
+
+
+@pytest.mark.parametrize("err", ["resource_exhausted", "compile",
+                                 "generic"])
+def test_copr_agg_every_class_degrades_identical(err):
+    tk = _tk()
+    failpoint.enable("device_guard/copr/agg", f"error:{err}")
+    rows = tk.must_query(AGG_SQL).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, AGG_SQL)
+    assert tk.domain.metrics.get("device_fallback", 0) >= 1
+
+
+def test_copr_filter_grant_loss_falls_back_identical():
+    tk = _tk()
+    sql = "select a, c from t where c > 6 and b < 5 order by a"
+    failpoint.enable("device_guard/copr/filter", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+    assert tk.domain.metrics.get("device_fallback", 0) >= 1
+
+
+def test_copr_topn_degrades_to_host_topn():
+    tk = _tk()
+    # unique sort key: LIMIT over ties is legitimately nondeterministic
+    # across backends, which would make row comparison meaningless
+    sql = "select a, c from t order by a desc limit 5"
+    failpoint.enable("device_guard/copr/topn", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+
+
+def test_copr_dispatch_watchdog_turns_wedge_into_fallback():
+    tk = _tk()
+    tk.must_exec("set tidb_tpu_device_dispatch_timeout_ms = 100")
+    tk.must_exec("set tidb_tpu_device_retry_limit = 0")
+    failpoint.enable("device_guard/copr/agg", "sleep:3000")
+    t0 = time.time()
+    rows = tk.must_query(AGG_SQL).rows
+    dt = time.time() - t0
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, AGG_SQL)
+    # the statement must not have waited out the injected 3s wedge
+    assert dt < 2.5, f"watchdog did not preempt the wedge ({dt:.1f}s)"
+    assert tk.domain.metrics.get("device_fallback", 0) >= 1
+
+
+def test_fatal_is_clean_statement_error_with_txn_rollback():
+    tk = _tk()
+    tk.must_exec("create table sink (b int primary key, s int)")
+    failpoint.enable("device_guard/copr/agg", "error:fatal")
+    err = tk.exec_err("insert into sink select b, sum(c) from t "
+                      "group by b")
+    assert isinstance(err, TiDBError)
+    failpoint.disable_all()
+    # autocommit statement failure rolled the implicit txn back: the
+    # partial insert must not be visible
+    assert tk.must_query("select count(*) from sink").rows == [(0,)]
+    # and the session is healthy afterwards
+    assert tk.must_query(AGG_SQL).rows == _host_rows(tk, AGG_SQL)
+
+
+def test_breaker_trips_in_engine_and_recovers_rows():
+    tk = _tk()
+    tk.must_exec("set tidb_tpu_device_breaker_threshold = 2")
+    tk.must_exec("set tidb_tpu_device_retry_limit = 0")
+    failpoint.enable("device_guard/copr/agg", "error:grant_lost")
+    want = None
+    for _ in range(4):          # every statement correct throughout
+        rows = tk.must_query(AGG_SQL).rows
+        want = want or rows
+        assert rows == want
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, AGG_SQL)
+    assert tk.domain.metrics.get("device_breaker_open", 0) >= 1
+    assert tk.domain.metrics.get("device_breaker_short_circuit", 0) >= 1
+
+
+def test_sort_site_grant_loss_identical_order(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_SORT_MIN", "1")
+    tk = _tk()
+    sql = "select a from t order by b desc, c, a"
+    failpoint.enable("device_guard/sort", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+    assert tk.domain.metrics.get("sort_device_error", 0) >= 1
+
+
+def test_window_site_grant_loss_identical(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_WINDOW_MIN", "1")
+    tk = _tk()
+    sql = ("select a, sum(c) over (partition by b order by a) from t "
+           "order by a")
+    failpoint.enable("device_guard/window", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+    assert tk.domain.metrics.get("window_device_error", 0) >= 1
+
+
+def test_join_site_grant_loss_identical():
+    tk = _tk()
+    tk.must_exec("create table d (id int primary key, tag int)")
+    tk.must_exec("insert into d values " + ",".join(
+        f"({i}, {i % 3})" for i in range(7)))
+    tk.must_exec("set tidb_join_exec = 'device'")
+    sql = ("select t.a, d.tag from t, d where t.b = d.id "
+           "order by t.a")
+    failpoint.enable("device_guard/join", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    tk.must_exec("set tidb_join_exec = 'host'")
+    host = tk.must_query(sql).rows
+    tk.must_exec("set tidb_join_exec = 'auto'")
+    assert rows == host
+    assert tk.domain.metrics.get("device_join_fallback", 0) >= 1
+
+
+def test_fused_site_grant_loss_identical():
+    tk = TestKit()
+    tk.must_exec("create table dim (id int primary key, grp int)")
+    tk.must_exec("insert into dim values " + ",".join(
+        f"({i}, {i % 5})" for i in range(1, 41)))
+    tk.must_exec("create table fact (k int primary key, d_id int, "
+                 "q int)")
+    tk.must_exec("insert into fact values " + ",".join(
+        f"({i}, {i % 45}, {i % 50})" for i in range(1, 501)))
+    sql = ("select dim.grp, sum(fact.q), count(*) from fact, dim "
+           "where fact.d_id = dim.id and fact.q < 40 "
+           "group by dim.grp order by dim.grp")
+    failpoint.enable("device_guard/fused/kernel", "error:grant_lost")
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+    # the fused pipeline degraded but the statement survived
+    assert tk.domain.metrics.get("fused_pipeline_error", 0) >= 1
+    assert tk.domain.metrics.get("device_retry", 0) >= 1
+
+
+def test_fused_site_retry_then_succeed():
+    tk = TestKit()
+    tk.must_exec("create table dim (id int primary key, grp int)")
+    tk.must_exec("insert into dim values " + ",".join(
+        f"({i}, {i % 5})" for i in range(1, 41)))
+    tk.must_exec("create table fact (k int primary key, d_id int, "
+                 "q int)")
+    tk.must_exec("insert into fact values " + ",".join(
+        f"({i}, {i % 45}, {i % 50})" for i in range(1, 501)))
+    sql = ("select dim.grp, sum(fact.q) from fact, dim "
+           "where fact.d_id = dim.id group by dim.grp "
+           "order by dim.grp")
+    failpoint.enable("device_guard/fused/kernel",
+                     "nth:1->error:grant_lost")
+    before = tk.domain.metrics.get("fused_pipeline_hit", 0)
+    rows = tk.must_query(sql).rows
+    failpoint.disable_all()
+    assert rows == _host_rows(tk, sql)
+    assert tk.domain.metrics.get("fused_pipeline_hit", 0) == before + 1
+    assert tk.domain.metrics.get("device_retry", 0) >= 1
+
+
+def test_tpch_queries_under_grant_loss_everywhere(monkeypatch):
+    """Acceptance slice: grant-loss injected at EVERY device dispatch
+    site; a batch of TPC-H queries must return host-identical rows with
+    no stall (scripts/chaos_smoke.py runs the full 22 at SF0.05)."""
+    monkeypatch.setenv("TIDB_TPU_SORT_MIN", "1")
+    monkeypatch.setenv("TIDB_TPU_WINDOW_MIN", "1")
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+    tk = TestKit()
+    load_tpch(tk, sf=0.01, seed=42)
+    for site in ("copr/agg", "copr/filter", "copr/topn", "copr/mpp",
+                 "fused/kernel", "sort", "window", "join"):
+        failpoint.enable("device_guard/" + site, "error:grant_lost")
+    chaos = {}
+    for q in ("q1", "q3", "q6", "q12", "q21"):
+        chaos[q] = tk.must_query(ALL_QUERIES[q]).rows
+    failpoint.disable_all()
+    tk.domain.copr.use_device = False
+    try:
+        for q, rows in chaos.items():
+            assert rows == tk.must_query(ALL_QUERIES[q]).rows, q
+    finally:
+        tk.domain.copr.use_device = True
+    assert tk.domain.metrics.get("device_fallback", 0) >= 1

@@ -64,6 +64,7 @@ def test_dense_group_sums_kernel():
         assert int(sums[1][g]) == int(v2[m].sum())
 
 
+@pytest.mark.slow          # ~50s: keeps tier-1 inside its wall budget
 def test_dense_agg_sorted_matches_scatter():
     """The TPU lowering of dense_agg_states (shared argsort + segmented
     scans, no scatter) must match the scatter lowering state-for-state:

@@ -57,6 +57,7 @@ if SF >= 0.2:
     EXPECTED_ROUTING["q21"] = "scan"
 
 
+@pytest.mark.slow          # ~40s: keeps tier-1 inside its wall budget
 def test_tpch_device_routing_pinned(tk):
     """Every TPC-H query executes its heavy operators on the device:
     18/22 through the fused join pipeline, the rest as device scan/agg
@@ -104,6 +105,7 @@ def _best_of(n, fn):
     return best
 
 
+@pytest.mark.slow          # ~35s: keeps tier-1 inside its wall budget
 def test_device_path_never_pathologically_slower(tk):
     """Perf regression fence (VERDICT r3 weak #1): the device path lost
     to its own host path on 10/22 TPC-H queries at SF1 — q21 by 39×,

@@ -37,22 +37,28 @@ class _WorkerClient:
 
     def call(self, msg, arrays=None, retries=2):
         """RPC with reconnect + exponential backoff on transport errors
-        (reference store/driver/backoff + copr region retry). A worker
-        that stays unreachable raises to the caller, which may replace
-        it (Cluster._recover_worker)."""
+        (reference store/driver/backoff + copr region retry; the
+        backoff/jitter policy is shared with the device supervision
+        layer — utils/device_guard). A worker that stays unreachable
+        raises to the caller, which may replace it
+        (Cluster._recover_worker). Chaos: failpoint 'cluster/rpc' fires
+        before every send (inject conn_reset to exercise the retry)."""
         import time
+        from ..utils import failpoint
+        from ..utils.device_guard import backoff_delay
         if msg.get("op") not in self._IDEMPOTENT:
             retries = 0
         with self._call_mu:
             for attempt in range(retries + 1):
                 try:
+                    failpoint.inject("cluster/rpc")
                     send_msg(self.sock, msg, arrays)
                     out, arrs = recv_msg(self.sock)
                     break
                 except (ConnectionError, OSError):
                     if attempt == retries:
                         raise
-                    time.sleep(0.05 * (2 ** attempt))
+                    time.sleep(backoff_delay(attempt))
                     try:
                         self._connect()
                     except OSError:

@@ -26,6 +26,8 @@ from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls, or_nulls
 from ..utils.fetch import prefetch
 from ..utils import phase
+from ..utils import device_guard
+from ..errors import TiDBError
 from ..chunk.device import shape_bucket
 from ..chunk.column import Column
 from ..chunk.chunk import Chunk
@@ -131,7 +133,7 @@ class CoprExecutor:
 
     # ---- public -------------------------------------------------------
     def execute(self, dag, overlay=None, read_ts=None, use_mpp=False,
-                mpp_min_rows=1 << 16) -> list:
+                mpp_min_rows=1 << 16, ectx=None) -> list:
         """-> list of host Chunks (schema = dag.cols, or partial agg layout:
         [group_keys..., group_nullflags..., agg_states...]).
 
@@ -148,12 +150,12 @@ class CoprExecutor:
             with dom.tracer.span("copr",
                                  table=dag.table_info.name):
                 return self._execute_inner(dag, overlay, read_ts,
-                                           use_mpp, mpp_min_rows)
+                                           use_mpp, mpp_min_rows, ectx)
         return self._execute_inner(dag, overlay, read_ts, use_mpp,
-                                   mpp_min_rows)
+                                   mpp_min_rows, ectx)
 
     def _execute_inner(self, dag, overlay, read_ts, use_mpp,
-                       mpp_min_rows):
+                       mpp_min_rows, ectx=None):
         if dag.table_info.id <= -1000:      # INFORMATION_SCHEMA virtual
             tbl = self._materialize_virtual(dag.table_info)
             read_ts = None
@@ -196,15 +198,25 @@ class CoprExecutor:
                 and not dag.host_filters \
                 and n >= mpp_min_rows:
             try:
-                res = self._try_execute_mpp(dag, tbl, arrays, valid, n,
-                                            handles)
+                # supervised mesh dispatch: retryable classes retry with
+                # backoff, anything else degrades to None so the
+                # single-chip path (which always works) takes over
+                res = device_guard.guarded_dispatch(
+                    lambda: self._try_execute_mpp(dag, tbl, arrays,
+                                                  valid, n, handles),
+                    site="copr/mpp", ectx=ectx,
+                    domain=getattr(self, "domain", None),
+                    host_fallback=lambda: None)
+            except TiDBError:
+                raise                       # kill/quota: statement error
             except Exception:               # noqa: BLE001
                 res = None                  # single-chip path always works
             if res is not None:
                 self._bump("copr_mpp_exec")
                 return res
         self._bump("copr_device_exec")
-        return self._execute_device(dag, tbl, arrays, valid, n, handles)
+        return self._execute_device(dag, tbl, arrays, valid, n, handles,
+                                    ectx)
 
     def _bump(self, name):
         """Routing metrics (reference pkg/util/execdetails): which copr
@@ -356,10 +368,27 @@ class CoprExecutor:
         return out
 
     # ---- device path --------------------------------------------------
-    def _execute_device(self, dag, tbl, arrays, valid, n, handles):
+    def _execute_device(self, dag, tbl, arrays, valid, n, handles,
+                        ectx=None):
+        """Supervised device execution: each partition kernel dispatch
+        runs under device_guard (classified retry/backoff, watchdog).
+        An exhausted dispatch degrades the whole (sub)dag to the host
+        twin mid-query — correctness over placement (the TQP CPU-twin
+        rationale)."""
+        try:
+            return self._execute_device_inner(dag, tbl, arrays, valid,
+                                              n, handles, ectx)
+        except device_guard.DeviceDegradedError:
+            self._bump("copr_host_exec")
+            return self._execute_host(dag, tbl, arrays, valid, n,
+                                      handles)
+
+    def _execute_device_inner(self, dag, tbl, arrays, valid, n, handles,
+                              ectx=None):
         out = []
         step = self.device_rows
         produced = 0
+        dom = getattr(self, "domain", None)
         for start in range(0, n, step):
             sl = slice(start, min(start + step, n))
             m = sl.stop - sl.start
@@ -368,14 +397,19 @@ class CoprExecutor:
                                    cacheable=(n == tbl.n))
             v = valid[sl]
             if dag.aggs or dag.group_items:
-                res = self._run_agg_partition(dag, tbl, cols, v, m, cap)
+                res = device_guard.guarded_dispatch(
+                    lambda: self._run_agg_partition(dag, tbl, cols, v,
+                                                    m, cap),
+                    site="copr/agg", ectx=ectx, domain=dom)
                 out.append(res)
                 continue
             if dag.topn is not None:
-                try:
-                    idx = self._run_topn_partition(dag, tbl, cols, v, m, cap)
-                except Exception:           # noqa: BLE001
-                    idx = self._topn_host(dag, cols, v, m)
+                idx = device_guard.guarded_dispatch(
+                    lambda: self._run_topn_partition(dag, tbl, cols, v,
+                                                     m, cap),
+                    site="copr/topn", ectx=ectx, domain=dom,
+                    host_fallback=lambda: self._topn_host(dag, cols, v,
+                                                          m))
                 chunk_cols = []
                 for sc in dag.cols:
                     data, nulls, sdict = cols[sc.col.idx]
@@ -384,7 +418,10 @@ class CoprExecutor:
                         None if nulls is None else nulls[idx], sdict))
                 out.append(Chunk(chunk_cols))
                 continue
-            mask = self._run_filter_partition(dag, tbl, cols, v, m, cap)
+            mask = device_guard.guarded_dispatch(
+                lambda: self._run_filter_partition(dag, tbl, cols, v,
+                                                   m, cap),
+                site="copr/filter", ectx=ectx, domain=dom)
             idx = np.nonzero(np.asarray(mask)[:m])[0]
             if dag.limit >= 0:
                 remain = dag.limit - produced
@@ -1499,7 +1536,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
     aggregates its row shard into the dense table; one psum merges —
     the MPP hash exchange as an allreduce (tidb_tpu/mpp/exec.py design)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..utils.jaxcfg import compat_shard_map as shard_map
 
     sdicts = {k: c[2] for k, c in sample_cols.items()}
     group_items = list(dag.group_items)

@@ -32,6 +32,7 @@ from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
                        _compact_dense, _I64_MAX, _segment_impl,
                        _dense_nslots)
 from ..utils.fetch import prefetch
+from ..utils import failpoint
 
 _POS_DENSE_MAX = 1 << 22
 
@@ -1008,7 +1009,7 @@ def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
     across shards — psum/pmin/pmax allreduces for dense layouts, stacked
     per-shard partials (host merge) for the general sort layout."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..utils.jaxcfg import compat_shard_map as shard_map
     from .dag_exec import psum_dense_result
 
     body = _make_pipeline_body(plan, local_cap, fact_sdicts, dim_caps,
@@ -1139,6 +1140,13 @@ def _oh_learn_table(copr, ohk, plan, oh_learn):
         nn = vals[~knulls[i]]
         lo = int(nn.min()) if len(nn) else 0
         hi = int(nn.max()) if len(nn) else 0
+        if vals.dtype.kind == "u" and (lo > _I64_MAX or hi > _I64_MAX):
+            # uint64 keys above int63: np.asarray(los, int64) below
+            # would raise an uncaught OverflowError, and the kernel's
+            # int64 packing could never represent them anyway — pin the
+            # shape off the one-hot path like non-integer dtypes
+            copr._host_cache[ohk] = False
+            return
         span = hi - lo + 2
         total_bits += np.log2(max(span, 1))
         los.append(lo)
@@ -1481,6 +1489,11 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                                              dtype=jnp.int64)}
                 oh_table["dev"] = dev
             kargs = list(dim_args) + [dev]
+        # chaos hook: per-partition kernel dispatch. The supervised
+        # retry lives one level up (executors.FusedPipeline.partials
+        # wraps the whole fused_partials call in device_guard) — the
+        # kernel cache makes a whole-call retry cheap.
+        failpoint.inject("device_guard/fused/kernel")
         res = prefetch(kern(fjc, fvv, kargs))
         return res, cap, agg_kind, agg_param, ecap, oh_table
 

@@ -62,9 +62,21 @@ DeadlockError = _err("DeadlockError", 1213, "40001")
 # Variables
 UnknownSystemVariableError = _err("UnknownSystemVariableError", 1193, "HY000")
 WrongValueForVarError = _err("WrongValueForVarError", 1231, "42000")
+# Windows (MySQL 8 named-window inheritance constraints)
+WindowNoChildPartitioningError = _err("WindowNoChildPartitioningError",
+                                      3581, "HY000")
+WindowNoInheritFrameError = _err("WindowNoInheritFrameError", 3582, "HY000")
+WindowNoRedefineOrderByError = _err("WindowNoRedefineOrderByError",
+                                    3583, "HY000")
+# Collation
+CollationCharsetMismatchError = _err("CollationCharsetMismatchError",
+                                     1253, "42000")
 # Resource
 MemoryQuotaExceededError = _err("MemoryQuotaExceededError", 8175)
 QueryKilledError = _err("QueryKilledError", 1317, "70100")
+# Device supervision (utils/device_guard): the accelerator analog of the
+# reference's TiFlash-unavailable class (errno 9012/9013 family)
+DeviceUnavailableError = _err("DeviceUnavailableError", 9013)
 # Privilege
 AccessDeniedError = _err("AccessDeniedError", 1045, "28000")
 PrivilegeCheckFailError = _err("PrivilegeCheckFailError", 1142, "42000")

@@ -58,6 +58,7 @@ class TableReaderExec(Executor):
         copr = self.ctx.copr
         kc = copr._kernel_cache
         h0, m0 = kc.hits, kc.misses
+        kw.setdefault("ectx", self.ctx)
         res = copr.execute(dag, *args, **kw)
         if copr.last_backend:
             self._backends.add(copr.last_backend)
@@ -280,51 +281,56 @@ class FusedPipelineExec(Executor):
                     self.plan.fact_dag.table_info.id)
                 if want and fact is not None and fact.n >= min_rows:
                     mesh = self.ctx.copr._get_mesh()
+            from ..utils import device_guard
+            bt = int(self.ctx.sv.get(
+                "tidb_broadcast_join_threshold_count"))
+
+            def _run_fused(m):
+                return fused_partials(
+                    self.ctx.copr, self.plan, self.ctx.read_ts(), m,
+                    bcast_threshold=bt, ctx=self.ctx,
+                    delta_rows=drows[0] if drows else None,
+                    dead_handles=drows[1] if drows else None)
+
             try:
-                bt = int(self.ctx.sv.get(
-                    "tidb_broadcast_join_threshold_count"))
-                res = fused_partials(self.ctx.copr, self.plan,
-                                     self.ctx.read_ts(), mesh,
-                                     bcast_threshold=bt, ctx=self.ctx,
-                                     delta_rows=drows[0] if drows else None,
-                                     dead_handles=drows[1] if drows
-                                     else None)
+                # supervised dispatch (classified retry/backoff +
+                # watchdog); a degraded mesh run retries single-chip
+                # before falling all the way back to the host join
+                used_mesh = mesh is not None
+                if mesh is not None:
+                    try:
+                        res = device_guard.guarded_dispatch(
+                            lambda: _run_fused(mesh), site="fused/mpp",
+                            ectx=self.ctx)
+                    except device_guard.DeviceDegradedError:
+                        used_mesh = False
+                        res = device_guard.guarded_dispatch(
+                            lambda: _run_fused(None), site="fused",
+                            ectx=self.ctx)
+                else:
+                    res = device_guard.guarded_dispatch(
+                        lambda: _run_fused(None), site="fused",
+                        ectx=self.ctx)
                 if res is not None:
                     sess.domain.inc_metric(
-                        "fused_pipeline_mpp_hit" if mesh is not None
+                        "fused_pipeline_mpp_hit" if used_mesh
                         else "fused_pipeline_hit")
                     if drows is not None:
                         sess.domain.inc_metric(
                             "fused_pipeline_dirty_overlay")
-                    self.backend = ("device(fused-mpp)"
-                                    if mesh is not None
+                    self.backend = ("device(fused-mpp)" if used_mesh
                                     else "device(fused)")
                     sess.domain.last_fused_reason = None
                     return res
-            except Exception as exc:    # noqa: BLE001
+            except device_guard.DeviceDegradedError as exc:
                 sess.domain.inc_metric("fused_pipeline_error")
+                cause = exc.cause if exc.cause is not None else exc
                 sess.domain.last_fused_reason = (
-                    f"fused kernel error: {type(exc).__name__}: "
-                    f"{str(exc)[:200]}")
+                    f"fused kernel error: {type(cause).__name__}: "
+                    f"{str(cause)[:200]}")
                 from ..utils.logutil import log
                 log("warn", "fused_fallback",
                     reason=sess.domain.last_fused_reason)
-                if mesh is not None:
-                    # mesh path failed: retry single-chip before falling
-                    # all the way back to the host join
-                    try:
-                        res = fused_partials(
-                            self.ctx.copr, self.plan,
-                            self.ctx.read_ts(), None, ctx=self.ctx,
-                            delta_rows=drows[0] if drows else None,
-                            dead_handles=drows[1] if drows else None)
-                        if res is not None:
-                            sess.domain.inc_metric("fused_pipeline_hit")
-                            self.backend = "device(fused)"
-                            sess.domain.last_fused_reason = None
-                            return res
-                    except Exception:   # noqa: BLE001
-                        pass
         sess.domain.inc_metric("fused_pipeline_fallback")
         self.backend = "host(fallback)"
         return self._fallback_partials()
@@ -612,7 +618,8 @@ class IndexRangeExec(Executor):
             dag.host_filters.append(ScalarFunc(
                 "<=" if self.plan.high_inc else "<",
                 [col_at(rng_off), self.plan.high], new_bigint_type()))
-        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
+        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts(),
+                                       ectx=self.ctx)
         return Chunk.concat_all(chunks) or Chunk.empty(
             [sc.col.ft for sc in self.schema.cols])
 
@@ -642,7 +649,8 @@ class IndexMergeExec(IndexRangeExec):
         dag = CoprDAG(table_info=self.plan.table_info,
                       db_name=self.plan.db_name, cols=self.plan.cols,
                       host_filters=list(self.plan.residual))
-        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts())
+        chunks = self.ctx.copr.execute(dag, None, self.ctx.read_ts(),
+                                       ectx=self.ctx)
         return Chunk.concat_all(chunks) or Chunk.empty(
             [sc.col.ft for sc in self.schema.cols])
 
@@ -1093,12 +1101,15 @@ class SortExec(Executor):
         sorts (incl. dict/collation ranks)."""
         if self.ctx.copr.use_device and keys:
             from .sort_device import device_sort_permutation
+            from ..utils import device_guard
             try:
-                o = device_sort_permutation(keys, n)
+                o = device_guard.guarded_dispatch(
+                    lambda: device_sort_permutation(keys, n),
+                    site="sort", ectx=self.ctx)
                 if o is not None:
                     self.ctx.sess.domain.inc_metric("sort_device")
                     return o
-            except Exception:                 # noqa: BLE001
+            except device_guard.DeviceDegradedError:
                 self.ctx.sess.domain.inc_metric("sort_device_error")
         return np.lexsort(list(reversed(keys))) if keys \
             else np.arange(n)
@@ -2043,12 +2054,17 @@ class HashJoinExec(Executor):
                       (mode == "auto" and _backend_is_accel()))
         if use_device and not naaj and bv.dtype == np.int64 \
                 and pv.dtype == np.int64 and not plan.other_conds:
+            from ..utils import device_guard
             try:
-                return self._device_join(plan, jt, outer, probe, build,
-                                         bv, bnull, pv, pnull)
-            except Exception:               # noqa: BLE001
-                # device kernels unavailable/failed: host path is always
-                # correct; record and continue
+                return device_guard.guarded_dispatch(
+                    lambda: self._device_join(plan, jt, outer, probe,
+                                              build, bv, bnull, pv,
+                                              pnull),
+                    site="join", ectx=self.ctx)
+            except device_guard.DeviceDegradedError:
+                # device kernels unavailable/failed after supervised
+                # retries: host path is always correct; record and
+                # continue
                 self.ctx.sess.domain.inc_metric("device_join_fallback")
         if len(bv) and bv.dtype.kind != "V" and \
                 (len(bv) == 1 or bool(np.all(bv[:-1] <= bv[1:]))):

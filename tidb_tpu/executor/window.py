@@ -251,11 +251,14 @@ class WindowExec(Executor):
             # codes arrive already remapped into rank order by
             # _one_desc (host/device share the same pre-map)
             out_dict = asd
+        from ..utils import device_guard
         try:
-            res = run_window_device(
-                name, keys, len(d.partition_by), bool(d.order_by),
-                vals0, ok0, n, shift=shift, default=default)
-        except Exception:                     # noqa: BLE001
+            res = device_guard.guarded_dispatch(
+                lambda: run_window_device(
+                    name, keys, len(d.partition_by), bool(d.order_by),
+                    vals0, ok0, n, shift=shift, default=default),
+                site="window", ectx=self.ctx)
+        except device_guard.DeviceDegradedError:
             self.ctx.sess.domain.inc_metric("window_device_error")
             return None
         if res is None:

@@ -562,7 +562,13 @@ def op_inet6_ntoa(ctx, expr):
             if len(raw) == 4:
                 return str(ipaddress.IPv4Address(raw))
             if len(raw) == 16:
-                return str(ipaddress.IPv6Address(raw))
+                v6 = ipaddress.IPv6Address(raw)
+                # MySQL prints IPv4-mapped addresses dotted-quad
+                # (::ffff:1.2.3.4); python < 3.13 str() gives the raw
+                # hex groups (::ffff:102:304), so format explicitly
+                if v6.ipv4_mapped is not None:
+                    return f"::ffff:{v6.ipv4_mapped}"
+                return str(v6)
         except Exception:               # noqa: BLE001
             pass
         return None

@@ -24,7 +24,7 @@ from ..utils import jaxcfg  # noqa: F401
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ..utils.jaxcfg import compat_shard_map as shard_map
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls

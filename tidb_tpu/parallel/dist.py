@@ -27,8 +27,17 @@ def init_distributed(coordinator_address: str, num_processes: int,
     device op (a wedged TPU tunnel blocks backend init indefinitely,
     even under JAX_PLATFORMS=cpu) and cross-process collectives ride
     gloo. Idempotent per process."""
-    if jax.distributed.is_initialized():
-        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return
+    else:                       # jax < 0.6: probe the global client
+        try:
+            from jax._src import distributed as _dist
+            if _dist.global_state.client is not None:
+                return
+        except Exception:       # noqa: BLE001
+            pass
     plat = (os.environ.get("TIDB_TPU_PLATFORM") or
             os.environ.get("JAX_PLATFORMS") or "")
     if plat.lower() == "cpu":

@@ -428,8 +428,14 @@ class Parser:
                             self.error("signal item values must be "
                                        "integers or strings")
                         stmt.items[item] = int(t2.text)
-                    else:
+                    elif t2.kind == "STRING":
                         stmt.items[item] = t2.text
+                    else:
+                        # MySQL restricts signal items to simple
+                        # literals; consuming one token from @v or
+                        # CONCAT(...) would silently truncate the value
+                        self.error("signal item values must be literal "
+                                   "numbers or strings")
                     if not self.accept_op(","):
                         break
             return stmt
@@ -624,11 +630,47 @@ class Parser:
         """Substitute WINDOW-clause specs into every OVER w /
         OVER (w ...) reference of this select body (MySQL inheritance:
         a referencing spec takes the base's PARTITION BY, and the
-        base's ORDER BY / frame unless it declares its own)."""
+        base's ORDER BY / frame unless it declares its own).
+
+        Inherited OrderItem/WindowFrame objects are DEEP-copied: two
+        referencing specs must never alias one mutable base object
+        (planner rewrites would leak across windows). MySQL's
+        inheritance constraints apply to every non-bare reference
+        (WINDOW w2 AS (w1 ...) and OVER (w1 ...), not bare OVER w1):
+        a referencing spec cannot declare its own PARTITION BY
+        (ER_WINDOW_NO_CHILD_PARTITIONING), cannot reference a framed
+        window (ER_WINDOW_NO_INHERIT_FRAME), and cannot redefine
+        ORDER BY (ER_WINDOW_NO_REDEFINE_ORDER_BY)."""
         if not sel.named_windows and \
                 not getattr(self, "_saw_window_ref", False):
             return      # common case: no WINDOW clause, no OVER w refs
+        import copy as _copy
         import dataclasses as _dc
+        from ..errors import (WindowNoChildPartitioningError,
+                              WindowNoInheritFrameError,
+                              WindowNoRedefineOrderByError)
+
+        def inherit(spec, base, ref, bare=False):
+            if not bare:
+                if spec.partition_by:
+                    raise WindowNoChildPartitioningError(
+                        "Cannot override PARTITION BY clause of "
+                        "window '%s'", ref)
+                if base.frame is not None:
+                    raise WindowNoInheritFrameError(
+                        "Window '%s' has a frame definition, so cannot "
+                        "be referenced by another window", ref)
+                if spec.order_by and base.order_by:
+                    raise WindowNoRedefineOrderByError(
+                        "Cannot override ORDER BY clause of "
+                        "window '%s'", ref)
+            if not spec.partition_by:
+                spec.partition_by = _copy.deepcopy(base.partition_by)
+            if not spec.order_by:
+                spec.order_by = _copy.deepcopy(base.order_by)
+            if spec.frame is None:
+                spec.frame = _copy.deepcopy(base.frame)
+            spec.window_ref = ""
 
         def resolve(name, seen=()):
             spec = sel.named_windows.get(name)
@@ -638,27 +680,17 @@ class Parser:
                 self.error(f"window '{name}' circularly references "
                            "itself")
             if spec.window_ref:
-                base = resolve(spec.window_ref, seen + (name,))
-                if not spec.partition_by:
-                    spec.partition_by = list(base.partition_by)
-                if not spec.order_by:
-                    spec.order_by = list(base.order_by)
-                if spec.frame is None:
-                    spec.frame = base.frame
-                spec.window_ref = ""
+                ref = spec.window_ref
+                base = resolve(ref, seen + (name,))
+                inherit(spec, base, ref)
             return spec
 
         def walk(n):
             if isinstance(n, ast.WindowFunc):
                 if n.window_ref:
                     base = resolve(n.window_ref)
-                    if not n.partition_by:
-                        n.partition_by = list(base.partition_by)
-                    if not n.order_by:
-                        n.order_by = list(base.order_by)
-                    if n.frame is None:
-                        n.frame = base.frame
-                    n.window_ref = ""
+                    inherit(n, base, n.window_ref,
+                            bare=getattr(n, "bare_ref", False))
                 for a in n.args:
                     walk(a)
                 return
@@ -2452,8 +2484,11 @@ class Parser:
         self.expect_kw("over")
         w = ast.WindowFunc(name=name, args=args, distinct=distinct)
         if not self.at_op("("):
-            # OVER w — bare named-window reference (WINDOW clause)
+            # OVER w — bare named-window reference (WINDOW clause).
+            # bare_ref exempts it from the OVER (w ...) inheritance
+            # constraints: direct use MAY name a framed window
             w.window_ref = self.ident().lower()
+            w.bare_ref = True
             self._saw_window_ref = True
             return w
         self.expect_op("(")

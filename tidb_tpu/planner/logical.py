@@ -153,8 +153,9 @@ class TopN(LogicalPlan):
         self.count = count
 
     def explain_info(self):
-        return (f"{', '.join(f'{e!r}{' desc' if d else ''}' for e, d in self.items)}"
-                f", offset:{self.offset}, count:{self.count}")
+        items = ", ".join(f"{e!r}" + (" desc" if d else "")
+                          for e, d in self.items)
+        return f"{items}, offset:{self.offset}, count:{self.count}"
 
 
 class WindowDesc:

@@ -372,8 +372,16 @@ class Rewriter:
     def _rw_Collate(self, node: ast.Collate):
         """expr COLLATE name: string identity cast whose result type
         carries the explicit collation, so comparison/group/sort folds
-        pick it up (reference pkg/expression collation coercion)."""
+        pick it up (reference pkg/expression collation coercion).
+        COLLATE only applies to string-class operands — `1 COLLATE
+        utf8mb4_bin` is ER_COLLATION_CHARSET_MISMATCH in MySQL, not a
+        silent cast to char."""
         a = self.rewrite(node.expr)
+        if a.ft.tclass not in (TypeClass.STRING, TypeClass.NULLT):
+            from ..errors import CollationCharsetMismatchError
+            raise CollationCharsetMismatchError(
+                "COLLATION '%s' is not valid for CHARACTER SET "
+                "'binary'", node.collation)
         ft = new_string_type(getattr(a.ft, "flen", -1))
         ft.collate = node.collation
         return self.mk_func("cast_char", [a], ft)

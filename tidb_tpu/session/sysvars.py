@@ -4,6 +4,7 @@ vardef/tidb_vars.go). Scopes: GLOBAL / SESSION / both. The TPU toggle
 `tidb_enable_vectorized_expression` pattern (vardef/tidb_vars.go:672)."""
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -56,6 +57,13 @@ class SysVar:
                     "Variable '%s' can't be set to the value of '%s'", self.name, value)
             return s
         return str(value)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 _REGISTRY: dict[str, SysVar] = {}
@@ -126,6 +134,17 @@ for _v in [
     SysVar("div_precision_increment", SCOPE_BOTH, 4, "int", 0, 30),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, 300, "int", -1, None),
     SysVar("tidb_enable_collect_execution_info", SCOPE_BOTH, True, "bool"),
+    # device supervision (utils/device_guard; env seeds the defaults so
+    # harnesses configure child processes before any session exists; a
+    # malformed env value falls back rather than killing the import)
+    SysVar("tidb_tpu_device_retry_limit", SCOPE_BOTH,
+           _env_int("TIDB_TPU_DEVICE_RETRY_LIMIT", 2), "int", 0, 64),
+    SysVar("tidb_tpu_device_dispatch_timeout_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_DEVICE_DISPATCH_TIMEOUT_MS", 0),
+           "int", 0, 3_600_000),
+    SysVar("tidb_tpu_device_breaker_threshold", SCOPE_BOTH,
+           _env_int("TIDB_TPU_DEVICE_BREAKER_THRESHOLD", 8),
+           "int", 1, 1 << 20),
 ]:
     register(_v)
 

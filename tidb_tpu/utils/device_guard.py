@@ -1,0 +1,395 @@
+"""Device-failure supervision (reference: tikv client-go retry/backoff +
+region reroute, applied to the accelerator instead of a region server).
+
+The TPU is an unreliable remote resource: the axon tunnel drops grants
+mid-dispatch (BENCH_TPU_SF10: q21 stalled forever), kernels wedge
+(BENCH_r05: rc=124 at q12), and HBM fills up. Every device dispatch
+site routes through `guarded_dispatch`, which
+
+  1. CLASSIFIES the error (grant loss / RESOURCE_EXHAUSTED / compile
+     failure / wedge / generic) into retryable vs degradeable vs fatal,
+  2. RETRIES retryable classes with exponential backoff + jitter,
+     clamped to the statement deadline (`ExecContext.deadline`) so
+     retries never outlive `max_execution_time`,
+  3. optionally runs the dispatch under a WATCHDOG timeout
+     (`tidb_tpu_device_dispatch_timeout_ms`) so a stalled kernel
+     becomes a classified `wedged` error instead of a hung process, and
+  4. on exhausted retries DEGRADES to the host/numpy twin (TQP-style:
+     every operator keeps a CPU implementation), recording a SHOW
+     WARNINGS note + `device_retry`/`device_fallback` metrics; after N
+     consecutive failures a per-family CIRCUIT BREAKER short-circuits
+     straight to the host for a cooldown window.
+
+Chaos hooks: each site checks failpoint `device_guard/<site>` before
+every attempt; `utils/failpoint.py` actions (`error:<class>`,
+`sleep:ms`, `nth:k`) inject each error class at each site.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from . import failpoint
+from .logutil import log
+from ..errors import TiDBError, DeviceUnavailableError
+
+
+# ---- error taxonomy ---------------------------------------------------
+
+class DeviceError(Exception):
+    """Base for simulated/internal device-path errors. Deliberately NOT
+    a TiDBError: classification must see these before the fatal
+    (semantic-error) check."""
+    err_class = "generic"
+
+
+class GrantLostError(DeviceError):
+    """Device grant revoked / connection to the accelerator lost."""
+    err_class = "grant_lost"
+
+
+class DeviceResourceExhausted(DeviceError):
+    """HBM / RESOURCE_EXHAUSTED class — retryable (caches may free)."""
+    err_class = "resource_exhausted"
+
+
+class DeviceCompileError(DeviceError):
+    """Kernel compile failure — deterministic, degrade without retry."""
+    err_class = "compile"
+
+
+class DeviceWedgedError(DeviceError):
+    """Watchdog timeout: the dispatch exceeded its budget."""
+    err_class = "wedged"
+
+
+class DeviceDegradedError(DeviceUnavailableError):
+    """A dispatch exhausted its supervision budget. Callers catch this
+    and take the host path; uncaught it surfaces as a clean statement
+    error (code 9013), never a hang."""
+
+    def __init__(self, site, err_class, cause, attempts):
+        cs = "" if cause is None else \
+            f": {type(cause).__name__}: {str(cause)[:160]}"
+        super().__init__(
+            "device dispatch at %s degraded after %d attempt(s) [%s]%s",
+            site, attempts, err_class, cs)
+        self.site = site
+        self.err_class = err_class
+        self.cause = cause
+        self.attempts = attempts
+
+
+# retryable: transient by nature — a later attempt can succeed.
+RETRYABLE = frozenset({"grant_lost", "resource_exhausted", "wedged",
+                       "transient"})
+# degradeable = retryable + deterministic device failures; the host twin
+# is always correct, so everything non-fatal degrades.
+_XLA_NAMES = frozenset({"XlaRuntimeError", "JaxRuntimeError",
+                        "InternalError", "FailedPreconditionError",
+                        "UnavailableError", "AbortedError",
+                        "JaxStackTraceBeforeTransformation"})
+
+
+def classify(exc) -> str:
+    """Map an exception from a device dispatch to an error class:
+    grant_lost | resource_exhausted | wedged | transient | compile |
+    generic | fatal. `fatal` (semantic TiDBErrors — kill, quota,
+    constraint) is never retried and never degraded."""
+    if isinstance(exc, DeviceError):
+        return exc.err_class
+    if isinstance(exc, TiDBError):
+        return "fatal"
+    if isinstance(exc, MemoryError):
+        return "resource_exhausted"
+    name = type(exc).__name__
+    mod = getattr(type(exc), "__module__", "") or ""
+    if name in _XLA_NAMES or mod.startswith(("jaxlib", "jax.")) \
+            or mod == "jax":
+        up = str(exc).upper()
+        if "RESOURCE_EXHAUSTED" in up or "OUT OF MEMORY" in up:
+            return "resource_exhausted"
+        if ("UNAVAILABLE" in up or "ABORTED" in up or "CANCELLED" in up
+                or "GRANT" in up or "CONNECTION" in up
+                or "SOCKET" in up or "DISCONNECT" in up):
+            return "grant_lost"
+        if "DEADLINE_EXCEEDED" in up:
+            return "wedged"
+        if ("INVALID_ARGUMENT" in up or "UNIMPLEMENTED" in up
+                or "COMPILATION" in up or "MOSAIC" in up):
+            return "compile"
+        return "transient"
+    return "generic"
+
+
+# ---- circuit breaker --------------------------------------------------
+
+class CircuitBreaker:
+    """Consecutive-failure breaker per site family ('copr', 'fused',
+    'sort', ...). `threshold` consecutive degraded dispatches open the
+    breaker for `cooldown_s`; while open every dispatch in the family
+    short-circuits straight to the host twin. After the cooldown the
+    next dispatch is a half-open trial: success closes the breaker,
+    failure re-opens it immediately."""
+
+    def __init__(self, threshold: int = 8, cooldown_s: float = 30.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.consecutive = 0
+        self.open_until = 0.0
+        self.trips = 0
+        self._mu = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._mu:
+            return time.time() >= self.open_until
+
+    def record_success(self):
+        with self._mu:
+            self.consecutive = 0
+            self.open_until = 0.0
+
+    def record_failure(self) -> bool:
+        """-> True when this failure newly opened the breaker."""
+        with self._mu:
+            self.consecutive += 1
+            if self.consecutive >= self.threshold:
+                was_open = time.time() < self.open_until
+                self.open_until = time.time() + self.cooldown_s
+                if not was_open:
+                    self.trips += 1
+                    return True
+            return False
+
+
+_BREAKERS: dict = {}
+_BREAKERS_MU = threading.Lock()
+METRICS: dict = {}          # module-level mirror for siteless dispatches
+_METRICS_MU = threading.Lock()
+
+
+def _breaker_for(family: str, threshold: int,
+                 cooldown_s: float) -> CircuitBreaker:
+    with _BREAKERS_MU:
+        b = _BREAKERS.get(family)
+        if b is None:
+            b = CircuitBreaker(threshold, cooldown_s)
+            _BREAKERS[family] = b
+        else:
+            b.threshold = threshold      # sysvar changes apply live
+            b.cooldown_s = cooldown_s
+        return b
+
+
+def breakers() -> dict:
+    return dict(_BREAKERS)
+
+
+def reset():
+    """Test hook: clear breaker state and module metrics."""
+    with _BREAKERS_MU:
+        _BREAKERS.clear()
+    with _METRICS_MU:
+        METRICS.clear()
+
+
+def _bump(domain, name: str, v: int = 1):
+    with _METRICS_MU:
+        METRICS[name] = METRICS.get(name, 0) + v
+    if domain is not None:
+        try:
+            domain.inc_metric(name, v)
+        except Exception:           # noqa: BLE001
+            pass
+
+
+# ---- knobs ------------------------------------------------------------
+
+def _knob(sv, name: str, env: str, default: int) -> int:
+    if sv is not None:
+        try:
+            return int(sv.get(name))
+        except Exception:           # noqa: BLE001
+            pass
+    try:
+        return int(os.environ.get(env, default))
+    except ValueError:
+        return default
+
+
+def backoff_delay(attempt: int, base: float = 0.05,
+                  cap: float = 2.0) -> float:
+    """Exponential backoff with +0-25% jitter, capped. attempt is
+    0-based (first retry sleeps ~base)."""
+    return min(base * (2 ** attempt), cap) * (1.0 + 0.25 * random.random())
+
+
+# ---- watchdog ---------------------------------------------------------
+
+def _with_watchdog(fn, timeout_ms: int, site: str):
+    """Run fn, bounding it to timeout_ms when > 0. A dispatch that
+    exceeds the budget raises DeviceWedgedError (classified retryable);
+    the wedged worker thread is abandoned — a truly stuck XLA call
+    cannot be cancelled, only supervised around."""
+    if not timeout_ms or timeout_ms <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["v"] = fn()
+        except BaseException as e:      # noqa: BLE001
+            box["e"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"device-dispatch:{site}")
+    t.start()
+    if not done.wait(timeout_ms / 1000.0):
+        raise DeviceWedgedError(
+            f"device dispatch at {site} exceeded {timeout_ms}ms watchdog")
+    if "e" in box:
+        raise box["e"]
+    return box.get("v")
+
+
+# ---- the supervisor ---------------------------------------------------
+
+def _note_fallback(ectx, domain, site, err_class, exc, attempts):
+    _bump(domain, "device_fallback")
+    detail = "" if exc is None else \
+        f": {type(exc).__name__}: {str(exc)[:120]}"
+    msg = (f"device dispatch at {site} fell back to host after "
+           f"{attempts} attempt(s) [{err_class}]{detail}")
+    log("warn", "device_fallback", site=site, err_class=err_class,
+        attempts=attempts)
+    if ectx is not None:
+        try:
+            ectx.sess.vars.warnings.append({
+                "level": "Warning",
+                "code": DeviceUnavailableError.code,
+                "sqlstate": DeviceUnavailableError.sqlstate,
+                "msg": msg})
+        except Exception:           # noqa: BLE001
+            pass
+
+
+def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
+                     host_fallback=None, retry_limit=None,
+                     timeout_ms=None, backoff_base_s: float = 0.05):
+    """Supervise one device dispatch.
+
+    fn            — the dispatch (upload + kernel + fetch); called once
+                    per attempt.
+    site          — 'family/op' label ('copr/agg', 'fused', 'join', ...);
+                    the family keys the circuit breaker, the full site
+                    keys the failpoint 'device_guard/<site>'.
+    ectx          — ExecContext when available: supplies sysvars, the
+                    statement deadline clamp, check_killed, and the
+                    session whose diagnostics area gets the fallback
+                    note.
+    host_fallback — optional zero-arg host twin; called (once) when the
+                    dispatch degrades. Without it, degrade raises
+                    DeviceDegradedError for the caller's host path.
+    retry_limit / timeout_ms — override the sysvars
+                    tidb_tpu_device_retry_limit /
+                    tidb_tpu_device_dispatch_timeout_ms (env-seeded
+                    defaults when no session is attached).
+
+    Fatal errors (TiDBError: kill, quota, constraint, injected fatal)
+    always re-raise unchanged — they are statement semantics, not
+    device health.
+    """
+    sv = getattr(ectx, "sv", None) if ectx is not None else None
+    if domain is None and ectx is not None:
+        domain = ectx.sess.domain
+    if retry_limit is None:
+        retry_limit = _knob(sv, "tidb_tpu_device_retry_limit",
+                            "TIDB_TPU_DEVICE_RETRY_LIMIT", 2)
+    if timeout_ms is None:
+        timeout_ms = _knob(sv, "tidb_tpu_device_dispatch_timeout_ms",
+                           "TIDB_TPU_DEVICE_DISPATCH_TIMEOUT_MS", 0)
+    threshold = _knob(sv, "tidb_tpu_device_breaker_threshold",
+                      "TIDB_TPU_DEVICE_BREAKER_THRESHOLD", 8)
+    cooldown = float(os.environ.get(
+        "TIDB_TPU_DEVICE_BREAKER_COOLDOWN_S", "30"))
+    family = site.split("/", 1)[0]
+    breaker = _breaker_for(family, threshold, cooldown)
+    fp_name = "device_guard/" + site
+
+    def attempt():
+        failpoint.inject(fp_name)
+        return fn()
+
+    if not breaker.allow():
+        _bump(domain, "device_breaker_short_circuit")
+        if host_fallback is not None:
+            return host_fallback()
+        raise DeviceDegradedError(site, "breaker_open", None, 0)
+
+    attempts = 0
+    while True:
+        if ectx is not None:
+            ectx.check_killed()
+        try:
+            out = _with_watchdog(attempt, timeout_ms, site)
+            breaker.record_success()
+            return out
+        except TiDBError:
+            raise
+        except (KeyboardInterrupt, SystemExit, GeneratorExit):
+            raise                       # process control, not device health
+        except BaseException as exc:    # noqa: BLE001
+            err_class = classify(exc)
+            attempts += 1
+            _bump(domain, "device_dispatch_error")
+            if err_class in RETRYABLE and attempts <= retry_limit:
+                delay = backoff_delay(attempts - 1, base=backoff_base_s)
+                remain = None
+                if ectx is not None and ectx.deadline is not None:
+                    remain = ectx.deadline - time.time()
+                if remain is None or remain > delay:
+                    _bump(domain, "device_retry")
+                    log("warn", "device_retry", site=site,
+                        err_class=err_class, attempt=attempts,
+                        err=f"{type(exc).__name__}: {str(exc)[:120]}")
+                    time.sleep(delay)
+                    continue
+                # too close to the statement deadline: degrade now so
+                # retries never outlive max_execution_time
+            tripped = breaker.record_failure()
+            if tripped:
+                _bump(domain, "device_breaker_open")
+                log("warn", "device_breaker_open", family=family,
+                    threshold=breaker.threshold,
+                    cooldown_s=breaker.cooldown_s)
+            _note_fallback(ectx, domain, site, err_class, exc, attempts)
+            if host_fallback is not None:
+                return host_fallback()
+            raise DeviceDegradedError(site, err_class, exc,
+                                      attempts) from exc
+
+
+# ---- chaos: register the injectable error classes ---------------------
+
+failpoint.register_error(
+    "grant_lost", lambda: GrantLostError(
+        "injected grant loss (device connection dropped mid-dispatch)"))
+failpoint.register_error(
+    "resource_exhausted", lambda: DeviceResourceExhausted(
+        "injected RESOURCE_EXHAUSTED (HBM allocation failed)"))
+failpoint.register_error(
+    "compile", lambda: DeviceCompileError(
+        "injected kernel compile failure"))
+failpoint.register_error(
+    "generic", lambda: RuntimeError("injected generic device error"))
+failpoint.register_error(
+    "fatal", lambda: failpoint.FailpointError(
+        "injected fatal device error"))
+failpoint.register_error(
+    "conn_reset", lambda: ConnectionResetError(
+        "injected connection reset"))

@@ -6,19 +6,46 @@ Usage at a site:      failpoint.inject("commit-after-wal")
 Enable in tests:      failpoint.enable("commit-after-wal", fn)
                       failpoint.enable("x", failpoint.CRASH)  # os._exit
 Enable for children:  TIDB_TPU_FAILPOINTS="commit-after-wal=crash;y=error"
+
+Action DSL (pingcap's failpoint term language, pared down) — terms
+chain with '->' and run in order on each hit:
+
+    crash                os._exit(137) at the site
+    error                raise FailpointError("injected")
+    error:NAME           raise the exception registered under NAME via
+                         register_error() (utils/device_guard registers
+                         the device error classes: grant_lost,
+                         resource_exhausted, compile, generic, fatal,
+                         conn_reset); an unregistered NAME raises
+                         FailpointError(NAME)
+    sleep:MS             time.sleep(MS/1000) — simulates a wedged kernel
+    nth:K                gate: only the first K hits of this failpoint
+                         run the remaining terms (hit K+1 onward is a
+                         no-op) — 'fail twice then succeed' chaos shape
+
+Examples:  "nth:1->error:grant_lost"   first dispatch fails, retry wins
+           "sleep:500->error:generic"  slow failure
 """
 from __future__ import annotations
 
 import os
+import time
 
 from ..errors import TiDBError
 
 _ACTIVE: dict = {}
+_ERROR_FACTORIES: dict = {}
 
 
 class FailpointError(TiDBError):
     """Raised by the 'error' action; a TiDBError so the session's normal
     statement-failure path (txn rollback, lock release) handles it."""
+
+
+def register_error(name: str, factory) -> None:
+    """Register `error:name` -> raise factory(). Lookup is late-bound:
+    env-spec actions compile before the registering module imports."""
+    _ERROR_FACTORIES[name.lower()] = factory
 
 
 def CRASH():
@@ -29,7 +56,51 @@ def _ERROR():
     raise FailpointError("injected")
 
 
-_ACTIONS = {"crash": CRASH, "error": _ERROR}
+def _compile_action(spec: str):
+    """Compile an action-spec string ('nth:2->sleep:50->error:grant_lost')
+    to a stateful callback. Raises ValueError on an unknown term so a
+    typo in TIDB_TPU_FAILPOINTS is loud in tests, silent-skipped for
+    env specs (a worker must not die to a bad chaos spec)."""
+    steps = []
+    limit = None
+    for part in spec.split("->"):
+        part = part.strip()
+        if not part:
+            continue
+        low = part.lower()
+        if low == "crash":
+            steps.append(("crash", None))
+        elif low == "error":
+            steps.append(("error", None))
+        elif low.startswith("error:"):
+            steps.append(("error", part[6:].strip().lower()))
+        elif low.startswith("sleep:"):
+            steps.append(("sleep", float(part[6:])))
+        elif low.startswith("nth:"):
+            limit = int(part[4:])
+        else:
+            raise ValueError(f"unknown failpoint action '{part}'")
+    hits = [0]
+
+    def cb(*_args):
+        hits[0] += 1
+        if limit is not None and hits[0] > limit:
+            return None
+        for kind, arg in steps:
+            if kind == "sleep":
+                time.sleep(arg / 1000.0)
+            elif kind == "crash":
+                CRASH()
+            else:
+                if arg is None:
+                    raise FailpointError("injected")
+                factory = _ERROR_FACTORIES.get(arg)
+                if factory is not None:
+                    raise factory()
+                raise FailpointError(arg)
+        return None
+
+    return cb
 
 
 def _load_env():
@@ -39,9 +110,10 @@ def _load_env():
         if not part or "=" not in part:
             continue
         name, action = part.split("=", 1)
-        fn = _ACTIONS.get(action.strip())
-        if fn is not None:
-            _ACTIVE[name.strip()] = fn
+        try:
+            _ACTIVE[name.strip()] = _compile_action(action.strip())
+        except ValueError:
+            continue
 
 
 _load_env()
@@ -49,7 +121,7 @@ _load_env()
 
 def enable(name: str, fn) -> None:
     if isinstance(fn, str):
-        fn = _ACTIONS[fn]
+        fn = _compile_action(fn)
     _ACTIVE[name] = fn
 
 

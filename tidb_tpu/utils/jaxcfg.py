@@ -5,3 +5,21 @@ TPU via the copr layer's dtype policy when profitable."""
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+
+def compat_shard_map(f, **kw):
+    """shard_map across jax versions: the public `jax.shard_map` with
+    `check_vma` (>= 0.5) vs `jax.experimental.shard_map` with
+    `check_rep` (0.4.x). Every engine call site routes through here."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    if "check_vma" in kw:
+        try:
+            return _sm(f, **kw)
+        except TypeError:
+            kw = dict(kw)
+            kw["check_rep"] = kw.pop("check_vma")
+            return _sm(f, **kw)
+    return _sm(f, **kw)
