@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Metrics smoke: run a short TPC-H slice, scrape /metrics over HTTP,
+parse it with the strict Prometheus text parser (utils/metrics
+.parse_text), and fail on malformed lines or histogram invariant
+violations (`_count` == +Inf bucket, `_sum` >= 0, cumulative buckets
+monotone). Also checks the labeled statement-latency histogram exists
+and that information_schema.tidb_top_sql attributed device (or host)
+time per digest. The pytest fast mode lives in tests/test_metrics.py.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
+Env:    SMOKE_SF (0.02), SMOKE_QUERIES (q1,q3,q6,q14)
+Exit:   0 clean scrape + nonzero per-digest attribution; 1 otherwise.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main():
+    sf = float(os.environ.get("SMOKE_SF", "0.02"))
+    qnames = os.environ.get("SMOKE_QUERIES", "q1,q3,q6,q14").split(",")
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+    from tidb_tpu.utils import metrics
+    from tidb_tpu.server.status import start_status_server
+    import urllib.request
+
+    failures = []
+    tk = TestKit()
+    print(f"# metrics_smoke: sf={sf} queries={qnames}", file=sys.stderr)
+    load_tpch(tk, sf=sf, seed=42)
+    for q in qnames:
+        q = q.strip()
+        if q not in ALL_QUERIES:
+            failures.append(f"unknown query {q!r}")
+            continue
+        tk.must_query(ALL_QUERIES[q])
+        print(f"# {q}: ok", file=sys.stderr)
+
+    st = start_status_server(tk.domain, port=0)
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{st.bound_port}/metrics", timeout=30)
+        ctype = resp.headers.get("Content-Type", "")
+        body = resp.read().decode()
+    finally:
+        st.shutdown()
+
+    if not ctype.startswith("text/plain") or "version=0.0.4" not in ctype:
+        failures.append(f"bad Content-Type: {ctype!r}")
+    families, errors = metrics.parse_text(body)
+    for e in errors:
+        failures.append(f"exposition: {e}")
+    print(f"# scraped {len(body)} bytes, {len(families)} families, "
+          f"{len(errors)} format errors", file=sys.stderr)
+
+    qd = families.get("tidb_tpu_query_duration_seconds")
+    if qd is None or qd["type"] != "histogram":
+        failures.append("tidb_tpu_query_duration_seconds histogram missing")
+    elif not any(lb.get("stmt_type") == "select"
+                 for _n, lb, _v in qd["samples"]):
+        failures.append("query_duration histogram has no "
+                        "stmt_type=select series")
+
+    # per-digest attribution: the TPC-H slice must have charged device
+    # (or, on a CPU backend under chaos, host-twin) time to digests
+    rows = tk.must_query(
+        "select sql_text, exec_count, sum_device_ms, sum_host_ms "
+        "from information_schema.tidb_top_sql "
+        "order by sum_device_ms desc limit 5").rows
+    if not rows:
+        failures.append("tidb_top_sql is empty after the TPC-H slice")
+    elif all(r[2] <= 0 and r[3] <= 0 for r in rows):
+        failures.append("tidb_top_sql attributed no device or host time")
+    for text, cnt, dev, host in rows:
+        print(f"# top_sql: dev={dev:.1f}ms host={host:.1f}ms n={cnt} "
+              f"{text[:60]!r}", file=sys.stderr)
+
+    if failures:
+        print("METRICS SMOKE FAIL", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("METRICS SMOKE PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,3 +34,20 @@ try:
     jax.config.update("jax_enable_x64", True)
 except Exception:
     pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _metrics_isolation():
+    """Metric-state isolation: the process-global registry
+    (utils/metrics), device_guard breakers/module counters, and phase
+    counters all outlive a Domain — without a reset, any assertion on
+    absolute metric values is test-order-dependent. Zeroed at each test
+    START (module-scoped TestKit fixtures may legitimately accumulate
+    WITHIN a test)."""
+    from tidb_tpu.utils import metrics, phase, device_guard
+    metrics.reset_all()
+    device_guard.reset()
+    phase.reset()
+    yield

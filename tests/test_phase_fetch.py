@@ -16,11 +16,11 @@ def test_scalar_sync_and_fetch_counters():
     assert bool(x[0] == 0)
     assert int(x.sum()) == 1024 * 1023 // 2
     np.asarray(x)
-    s = ph.STATS
+    s = ph.current()
     assert s.get("syncs") == 2 and s.get("sync_s", 0) >= 0
     assert s.get("fetches", 0) in (0, 1)    # 0: zero-copy cpu alias
     ph.reset()
-    assert ph.STATS == {}
+    assert ph.current() == {}
 
 
 def test_nested_statements_accumulate():
@@ -31,4 +31,4 @@ def test_nested_statements_accumulate():
     ph.add("dispatch_s", 0.25)
     ph.stmt_leave()
     ph.stmt_leave()
-    assert ph.STATS["dispatch_s"] == 0.75
+    assert ph.current()["dispatch_s"] == 0.75

@@ -48,6 +48,9 @@ def main(argv=None):
     ap.add_argument("--serve", action="store_true",
                     help="run the MySQL-protocol server")
     ap.add_argument("--port", type=int, default=4000)
+    ap.add_argument("--status-port", type=int, default=10080,
+                    help="HTTP status/metrics port for --serve "
+                         "(/metrics Prometheus exposition; -1 disables)")
     ap.add_argument("-e", "--execute", help="run one statement and exit")
     ap.add_argument("--data-dir", default=None,
                     help="persist commits to a WAL in this directory")
@@ -68,6 +71,16 @@ def main(argv=None):
         srv = Server(domain, port=args.port, tls_cert=args.tls_cert,
                      tls_key=args.tls_key).start()
         print(f"listening on 127.0.0.1:{srv.port} (MySQL protocol)")
+        if args.status_port >= 0:
+            from .server.status import start_status_server
+            try:
+                st = start_status_server(domain, port=args.status_port)
+                print(f"status/metrics on 127.0.0.1:{st.bound_port}")
+            except OSError as e:
+                # a busy status port (second instance on the default
+                # 10080) must not take the SQL server down with it
+                print(f"status port {args.status_port} unavailable "
+                      f"({e}); /metrics disabled")
         import time
         try:
             while True:

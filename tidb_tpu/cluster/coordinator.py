@@ -45,19 +45,25 @@ class _WorkerClient:
         before every send (inject conn_reset to exercise the retry)."""
         import time
         from ..utils import failpoint
+        from ..utils import metrics as _metrics
         from ..utils.device_guard import backoff_delay
+        op = str(msg.get("op"))
         if msg.get("op") not in self._IDEMPOTENT:
             retries = 0
         with self._call_mu:
             for attempt in range(retries + 1):
                 try:
                     failpoint.inject("cluster/rpc")
+                    t0 = time.perf_counter()
                     send_msg(self.sock, msg, arrays)
                     out, arrs = recv_msg(self.sock)
+                    _metrics.RPC_SECONDS.labels(op).observe(
+                        time.perf_counter() - t0)
                     break
                 except (ConnectionError, OSError):
                     if attempt == retries:
                         raise
+                    _metrics.RPC_RETRIES.labels(op).inc()
                     time.sleep(backoff_delay(attempt))
                     try:
                         self._connect()

@@ -27,6 +27,7 @@ from ..expression.vec import materialize_nulls, or_nulls
 from ..utils.fetch import prefetch
 from ..utils import phase
 from ..utils import device_guard
+from ..utils import metrics as _metrics
 from ..errors import TiDBError
 from ..chunk.device import shape_bucket
 from ..chunk.column import Column
@@ -64,8 +65,10 @@ class _KernelCache(dict):
         v = super().get(key, default)
         if v is None:
             self.misses += 1
+            _metrics.KERNEL_CACHE.labels("miss").inc()
         else:
             self.hits += 1
+            _metrics.KERNEL_CACHE.labels("hit").inc()
         return v
 
 
@@ -108,7 +111,9 @@ class CoprExecutor:
             self._dev_cache_order.remove(key)
             self._dev_cache_order.append(key)
             phase.inc("upload_hits")
+            _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
+        _metrics.DEV_BUFFER_POOL.labels("miss").inc()
         t0 = time.perf_counter()
         cap = key[-1]
         if len(arr_np) != cap:
@@ -146,13 +151,21 @@ class CoprExecutor:
         # previous execute must not leak into EXPLAIN ANALYZE
         self.last_backend = ""
         dom = getattr(self, "domain", None)
-        if dom is not None:
-            with dom.tracer.span("copr",
-                                 table=dag.table_info.name):
-                return self._execute_inner(dag, overlay, read_ts,
-                                           use_mpp, mpp_min_rows, ectx)
-        return self._execute_inner(dag, overlay, read_ts, use_mpp,
-                                   mpp_min_rows, ectx)
+        t0 = time.perf_counter()
+        try:
+            if dom is not None:
+                with dom.tracer.span("copr",
+                                     table=dag.table_info.name):
+                    return self._execute_inner(dag, overlay, read_ts,
+                                               use_mpp, mpp_min_rows, ectx)
+            return self._execute_inner(dag, overlay, read_ts, use_mpp,
+                                       mpp_min_rows, ectx)
+        finally:
+            # labeled by the backend that actually served the DAG
+            # ("none" = early return: empty snapshot / virtual table)
+            _metrics.COPR_DISPATCH_SECONDS.labels(
+                self.last_backend or "none").observe(
+                time.perf_counter() - t0)
 
     def _execute_inner(self, dag, overlay, read_ts, use_mpp,
                        mpp_min_rows, ectx=None):
@@ -201,12 +214,17 @@ class CoprExecutor:
                 # supervised mesh dispatch: retryable classes retry with
                 # backoff, anything else degrades to None so the
                 # single-chip path (which always works) takes over
+                t_mpp = time.perf_counter()
                 res = device_guard.guarded_dispatch(
                     lambda: self._try_execute_mpp(dag, tbl, arrays,
                                                   valid, n, handles),
                     site="copr/mpp", ectx=ectx,
                     domain=getattr(self, "domain", None),
-                    host_fallback=lambda: None)
+                    host_fallback=lambda: None,
+                    fallback_is_host=False)
+                if res is not None:
+                    _metrics.MPP_DISPATCH_SECONDS.observe(
+                        time.perf_counter() - t_mpp)
             except TiDBError:
                 raise                       # kill/quota: statement error
             except Exception:               # noqa: BLE001

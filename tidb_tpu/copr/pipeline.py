@@ -52,10 +52,14 @@ def _cid_of(dag, sc):
 
 def _set_reason(copr, msg):
     """Record why the fused path declined, for EXPLAIN ANALYZE and
-    scripts/diag_routing.py (reference: pkg/util/execdetails)."""
+    scripts/diag_routing.py (reference: pkg/util/execdetails). Also
+    counted by reason class (tidb_tpu_fused_decline_total) so fleet
+    dashboards see decline-mix shifts without per-query EXPLAINs."""
     dom = getattr(copr, "domain", None)
     if dom is not None:
         dom.last_fused_reason = msg
+    from ..utils import metrics as _metrics
+    _metrics.FUSED_DECLINE.labels(_metrics.reason_code(msg)).inc()
 
 
 _DIRECT_SPAN_BUDGET = 1 << 24

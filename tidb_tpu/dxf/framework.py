@@ -189,6 +189,7 @@ class DurableTasks:
     def _sql(self, q):
         from ..session import Session
         s = Session(self.domain)
+        s.is_internal = True
         s.vars.current_db = "mysql"
         return s.execute(q)
 

@@ -259,6 +259,7 @@ class FusedPipelineExec(Executor):
     def partials(self):
         sess = self.ctx.sess
         sess.domain.last_fused_reason = None
+        fused_errored = False
         dkind, drows = ("clean", None)
         if self.ctx.copr.use_device:
             dkind, drows = self._dirty_state()
@@ -301,7 +302,7 @@ class FusedPipelineExec(Executor):
                     try:
                         res = device_guard.guarded_dispatch(
                             lambda: _run_fused(mesh), site="fused/mpp",
-                            ectx=self.ctx)
+                            ectx=self.ctx, fallback_is_host=False)
                     except device_guard.DeviceDegradedError:
                         used_mesh = False
                         res = device_guard.guarded_dispatch(
@@ -312,6 +313,9 @@ class FusedPipelineExec(Executor):
                         lambda: _run_fused(None), site="fused",
                         ectx=self.ctx)
                 if res is not None:
+                    from ..utils import metrics as _mtr
+                    _mtr.FUSED_PIPELINE.labels(
+                        "mpp_hit" if used_mesh else "hit").inc()
                     sess.domain.inc_metric(
                         "fused_pipeline_mpp_hit" if used_mesh
                         else "fused_pipeline_hit")
@@ -323,6 +327,7 @@ class FusedPipelineExec(Executor):
                     sess.domain.last_fused_reason = None
                     return res
             except device_guard.DeviceDegradedError as exc:
+                fused_errored = True
                 sess.domain.inc_metric("fused_pipeline_error")
                 cause = exc.cause if exc.cause is not None else exc
                 sess.domain.last_fused_reason = (
@@ -331,6 +336,11 @@ class FusedPipelineExec(Executor):
                 from ..utils.logutil import log
                 log("warn", "fused_fallback",
                     reason=sess.domain.last_fused_reason)
+        from ..utils import metrics as _mtr
+        # 'outcome' partitions executions: error_fallback = kernel
+        # degraded then host ran; fallback = declined before dispatch
+        _mtr.FUSED_PIPELINE.labels(
+            "error_fallback" if fused_errored else "fallback").inc()
         sess.domain.inc_metric("fused_pipeline_fallback")
         self.backend = "host(fallback)"
         return self._fallback_partials()

@@ -67,7 +67,8 @@ def _gen_slow_query(domain):
     for e in domain.slow_log:
         yield (e.get("time", 0.0), e.get("time_ms", 0.0) / 1000.0,
                e.get("sql", ""), e.get("db", ""), e.get("conn", 0),
-               1 if e.get("success") else 0)
+               1 if e.get("success") else 0,
+               e.get("digest", ""), int(e.get("is_internal", 0)))
 
 
 def _gen_stmt_summary(domain):
@@ -75,12 +76,20 @@ def _gen_stmt_summary(domain):
         cnt = max(s["exec_count"], 1)
         yield (s["digest"], s["normalized"], s["exec_count"],
                s["sum_ms"] / 1000.0, s["max_ms"] / 1000.0,
-               s["sum_ms"] / cnt / 1000.0, s["errors"])
+               s["sum_ms"] / cnt / 1000.0, s["errors"],
+               s.get("sum_device_ms", 0.0), s.get("fallback_count", 0))
 
 
 def _gen_metrics(domain):
+    """Flat per-store counters + every typed registry sample (labels
+    rendered `k="v"`), one SQL-queryable surface for both."""
+    from ..utils import metrics as metrics_util
     for k, v in sorted(domain.metrics.items()):
-        yield (k, float(v))
+        yield (k, "", float(v))
+    metrics_util.update_runtime_gauges(domain)
+    for name, labels, value in metrics_util.REGISTRY.samples(
+            include_compat=False):
+        yield (name, metrics_util.render_labels(labels), float(value))
 
 
 def _gen_errors(domain):
@@ -99,15 +108,22 @@ def _gen_trace_events(domain):
 
 
 def _gen_top_sql(domain):
-    """Top resource-consuming statements by total time (reference
-    TopSQL's per-digest CPU attribution, surfaced as a table instead of
-    the dashboard agent)."""
-    rows = sorted(domain.stmt_summary_map.values(),
-                  key=lambda s: -s["sum_ms"])[:30]
-    for s_ in rows:
-        cnt = max(s_["exec_count"], 1)
-        yield (s_["digest"], s_["normalized"], s_["sum_ms"] / 1000.0,
-               s_["exec_count"], s_["sum_ms"] / cnt / 1000.0)
+    """Per-digest device-time attribution (reference TopSQL's CPU
+    attribution, surfaced as a table instead of the dashboard agent):
+    each statement's phase snapshot (utils/phase) — device dispatch ms,
+    XLA compile ms, host-path ms, fetch ms, kernel builds, upload/fetch
+    bytes, device fallbacks — folded into a bounded ring by
+    Session._observe. `ORDER BY sum_device_ms DESC` answers "what is
+    the TPU doing"."""
+    for e in domain.top_sql.rows():
+        cnt = max(e["exec_count"], 1)
+        yield (e["digest"], e["normalized"], e["exec_count"],
+               e["sum_ms"], e["sum_ms"] / cnt,
+               e["sum_device_ms"], e["sum_compile_ms"],
+               e["sum_host_ms"], e["sum_fetch_ms"], e["sum_upload_ms"],
+               e["kernel_builds"], e["dispatches"],
+               e["upload_bytes"], e["fetch_bytes"],
+               e["fallback_count"], e["sum_errors"])
 
 
 def _gen_resource_groups(domain):
@@ -261,13 +277,17 @@ VIRTUAL_DEFS = {
                    _gen_statistics),
     "slow_query": (_cols(("time", _F()), ("query_time", _F()),
                          ("query", _S()), ("db", _S()), ("conn_id", _I()),
-                         ("succ", _I())), _gen_slow_query),
+                         ("succ", _I()), ("digest", _S()),
+                         ("is_internal", _I())), _gen_slow_query),
     "statements_summary": (_cols(("digest", _S()), ("digest_text", _S()),
                                  ("exec_count", _I()),
                                  ("sum_latency", _F()), ("max_latency", _F()),
-                                 ("avg_latency", _F()), ("sum_errors", _I())),
+                                 ("avg_latency", _F()), ("sum_errors", _I()),
+                                 ("sum_device_ms", _F()),
+                                 ("fallback_count", _I())),
                            _gen_stmt_summary),
-    "metrics_summary": (_cols(("metrics_name", _S()), ("sum_value", _F())),
+    "metrics_summary": (_cols(("metrics_name", _S()), ("labels", _S()),
+                              ("sum_value", _F())),
                         _gen_metrics),
     "tidb_errors": (_cols(("error", _S()), ("code", _I()),
                           ("sqlstate", _S())), _gen_errors),
@@ -276,8 +296,19 @@ VIRTUAL_DEFS = {
                                 ("duration_ms", _F()), ("attrs", _S())),
                           _gen_trace_events),
     "tidb_top_sql": (_cols(("sql_digest", _S()), ("sql_text", _S()),
-                           ("cpu_time_total", _F()), ("exec_count", _I()),
-                           ("cpu_time_avg", _F())), _gen_top_sql),
+                           ("exec_count", _I()),
+                           ("sum_ms", _F()), ("avg_ms", _F()),
+                           ("sum_device_ms", _F()),
+                           ("sum_compile_ms", _F()),
+                           ("sum_host_ms", _F()),
+                           ("sum_fetch_ms", _F()),
+                           ("sum_upload_ms", _F()),
+                           ("kernel_builds", _I()),
+                           ("dispatches", _I()),
+                           ("upload_bytes", _I()),
+                           ("fetch_bytes", _I()),
+                           ("fallback_count", _I()),
+                           ("sum_errors", _I())), _gen_top_sql),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

@@ -24,12 +24,31 @@ def start_status_server(domain, host="127.0.0.1", port=10080):
         def do_GET(self):
             path = self.path.split("?")[0]
             if path == "/metrics":
-                lines = []
-                for k, v in sorted(domain.metrics.items()):
-                    name = f"tidb_tpu_{k}"
-                    lines.append(f"# TYPE {name} counter")
-                    lines.append(f"{name} {v}")
-                self._send("\n".join(lines) + "\n", "text/plain")
+                from ..utils import metrics as metrics_util
+                metrics_util.update_runtime_gauges(domain)
+                body = metrics_util.REGISTRY.expose()
+                # defensive compat tail: domain.metrics keys mutated
+                # without inc_metric (so absent from the registry) still
+                # surface, sanitized to the Prometheus charset — raw
+                # dict keys must never make the page unscrapable
+                exposed = {inst.name for inst
+                           in metrics_util.REGISTRY.instruments()}
+                merged: dict = {}
+                for k, v in domain.metrics.items():
+                    name = "tidb_tpu_" + metrics_util.sanitize_name(k)
+                    if name in exposed:
+                        continue
+                    # distinct raw keys may sanitize identically: sum,
+                    # never drop (a duplicate series is a format error)
+                    merged[name] = merged.get(name, 0) + v
+                extra = []
+                for name, v in sorted(merged.items()):
+                    extra.append(f"# TYPE {name} counter")
+                    extra.append(
+                        f"{name} {metrics_util.format_value(v)}")
+                if extra:
+                    body += "\n".join(extra) + "\n"
+                self._send(body, "text/plain; version=0.0.4")
             elif path == "/status":
                 self._send(json.dumps({
                     "connections": len(domain._live_execs),

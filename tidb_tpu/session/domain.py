@@ -11,6 +11,7 @@ from ..copr import CoprExecutor
 from ..dxf import TaskManager
 from ..dxf.framework import Timer
 from ..utils.memory import Tracker
+from ..utils import metrics as metrics_util
 
 
 class _Allocator:
@@ -58,7 +59,14 @@ class Domain:
         self.stats = {}        # table_id -> stats (module stats/, ANALYZE)
         self.slow_log: list = []
         self.stmt_summary_map: dict = {}
-        self.metrics: dict = {}   # counter name -> value (prometheus analog)
+        # flat counter dict, kept as the per-store compat view; the
+        # typed/labeled registry is utils/metrics.REGISTRY and every
+        # inc_metric mirrors into it (see inc_metric below)
+        self.metrics: dict = {}
+        # per-digest device-time attribution ring fed by Session._observe
+        # (information_schema.tidb_top_sql)
+        self.top_sql = metrics_util.TopSQL()
+        metrics_util.track_domain(self)
         # why the most recent query declined / fell off the fused device
         # pipeline (None = fused OK); read by EXPLAIN ANALYZE and
         # scripts/diag_routing.py (reference: pkg/util/execdetails)
@@ -212,10 +220,12 @@ class Domain:
         truncate it (reference: memtable flush to L0; the C++ memtable
         itself stays in memory — the run IS its durable image). Compacts
         when runs accumulate. Returns entries flushed."""
+        import time as _time
         from ..storage import sst
         from ..storage.wal import replay, WalWriter
         mvcc = self.storage.mvcc
         n = 0
+        t0 = _time.perf_counter()
         with mvcc._mu:
             w = mvcc.wal
             if w is None or not self.data_dir:
@@ -231,10 +241,13 @@ class Domain:
             open(w.path, "wb").close()
             mvcc.wal = WalWriter(w.path, sync=self.wal_sync)
             self.inc_metric("lsm_flushes")
+            metrics_util.LSM_FLUSH_SECONDS.observe(
+                _time.perf_counter() - t0)
             if len(sst.run_files(self.data_dir)) > 4:
                 safepoint = getattr(self, "gc_safepoint", 0)
                 sst.compact(self.data_dir, safepoint)
                 self.inc_metric("lsm_compactions")
+                metrics_util.LSM_COMPACTIONS.inc()
         return n
 
     # ---- bulk columnar segments (lightning-loaded data has no row KV;
@@ -458,6 +471,7 @@ class Domain:
         from ..parser import ast
         from ..session import Session
         sess = Session(self)
+        sess.is_internal = True
         ischema = self.infoschema()
         n = 0
         for db in ischema.all_schemas():
@@ -516,7 +530,13 @@ class Domain:
         return total
 
     def inc_metric(self, name: str, v=1):
+        """Compat shim over the typed registry (utils/metrics): the flat
+        per-store dict stays for existing readers (tests, chaos_smoke),
+        and the same bump lands in the process registry as a sanitized
+        unlabeled counter so /metrics exposes every legacy call site.
+        New instrumentation should use registry instruments directly."""
         self.metrics[name] = self.metrics.get(name, 0) + v
+        metrics_util.compat_counter(name).inc(v)
 
     def _table_info_by_id(self, tid: int):
         info = self.infoschema().table_by_id(tid)

@@ -48,6 +48,10 @@ class Session:
         self.ddl = DDLExecutor(self)
         self.user = "root"
         self.host = "%"
+        # internal SQL (bootstrap, sysvar persistence, auto-analyze,
+        # TTL) tags its slow-log rows so operator queries can filter it
+        # (information_schema.slow_query.is_internal)
+        self.is_internal = False
         self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
         import weakref
         domain.sessions[self.conn_id] = weakref.ref(self)
@@ -180,8 +184,27 @@ class Session:
 
     def _observe(self, stmt, sql, start, ok, rgroup=None):
         """Slow log + statement summary (reference slow_log.go:373 +
-        pkg/util/stmtsummary) + RU settlement."""
+        pkg/util/stmtsummary) + RU settlement + registry instruments +
+        Top SQL phase-snapshot fold (utils/metrics)."""
         dur_ms = (time.time() - start) * 1000.0
+        from ..utils import metrics as metrics_util
+        from ..utils import phase as _phase
+        # nested internal SQL (depth > 1) is a subset of the outer
+        # statement's wall time — observing it too would make the
+        # histogram sum exceed real elapsed time. Top-level system
+        # sessions (TTL, sysvar persistence) are real load but not user
+        # traffic: recorded under internal="1" so dashboards can filter.
+        if _phase.depth() <= 1:
+            stmt_type = type(stmt).__name__
+            if stmt_type.endswith("Stmt"):
+                stmt_type = stmt_type[:-4]
+            stmt_type = stmt_type.lower()
+            internal = "1" if self.is_internal else "0"
+            metrics_util.QUERY_DURATION.labels(stmt_type, internal) \
+                .observe(dur_ms / 1000.0)
+            if not ok:
+                metrics_util.QUERY_ERRORS.labels(stmt_type,
+                                                 internal).inc()
         if rgroup is not None:
             # request-unit blend: ~1 RU per 3ms of statement time + a
             # per-request base (reference resource_control RU model)
@@ -210,11 +233,15 @@ class Session:
             # statement's record says WHERE its time went (dispatch/
             # compile/upload/host) without a rerun — reference
             # execdetails in the slow log (slow_log.go:373)
-            from ..utils import phase as _phase
             self.domain.slow_log.append({
                 "time": time.time(), "time_ms": dur_ms, "sql": sql[:4096],
                 "stmt": type(stmt).__name__, "conn": self.conn_id,
                 "db": self.vars.current_db, "success": ok,
+                # digest joins slow rows against statements_summary;
+                # is_internal marks nested/system-session SQL
+                "digest": digest,
+                "is_internal": int(self.is_internal or
+                                   _phase.depth() > 1),
                 "phases": _phase.snap()})
             from ..utils import logutil
             # the digest normalization IS the redaction (one parse,
@@ -223,12 +250,23 @@ class Session:
                          ms=round(dur_ms, 1), ok=ok, sql=norm[:2048])
         summ = self.domain.stmt_summary_map.setdefault(digest, {
             "digest": digest, "normalized": norm[:1024],
-            "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0})
+            "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0,
+            "sum_device_ms": 0.0, "fallback_count": 0})
         summ["exec_count"] += 1
         summ["sum_ms"] += dur_ms
         summ["max_ms"] = max(summ["max_ms"], dur_ms)
         if not ok:
             summ["errors"] += 1
+        # phase counters are statement-scoped but reset only at the
+        # OUTERMOST statement: fold them at depth 1 exactly once, so
+        # internal SQL never re-attributes the outer statement's device
+        # time to its own digest
+        if _phase.depth() == 1:
+            ph = _phase.snap()
+            summ["sum_device_ms"] += metrics_util.phase_device_ms(ph)
+            summ["fallback_count"] += ph.get("device_fallbacks", 0)
+            self.domain.top_sql.record(digest, norm[:1024], dur_ms, ph,
+                                       ok=ok)
         self.domain.plugins.fire("audit", self, {
             "sql": sql, "digest": digest, "ok": ok, "duration_ms": dur_ms,
             "user": self.user, "db": self.vars.current_db,
@@ -1296,6 +1334,7 @@ class Session:
         domain/sysvar_cache.go)."""
         try:
             s = Session(self.domain)
+            s.is_internal = True
             s.vars.current_db = "mysql"
             val = str(int(v)) if isinstance(v, bool) else str(v)
             s.execute(

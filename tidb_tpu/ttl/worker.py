@@ -30,6 +30,7 @@ def run_ttl_once(domain) -> int:
     def one(db_name, t):
         def fn(cancel):
             sess = Session(domain)
+            sess.is_internal = True
             sess.vars.current_db = db_name
             unit = _UNIT_SQL.get(t.ttl["unit"], "day")
             sql = (f"delete from `{db_name}`.`{t.name}` where "

@@ -32,6 +32,8 @@ import threading
 import time
 
 from . import failpoint
+from . import metrics as _metrics
+from . import phase as _phase
 from .logutil import log
 from ..errors import TiDBError, DeviceUnavailableError
 
@@ -237,8 +239,15 @@ def _with_watchdog(fn, timeout_ms: int, site: str):
         return fn()
     box: dict = {}
     done = threading.Event()
+    # phase state is thread-local; the worker records into a PRIVATE
+    # dict that is folded into the statement's counters only when the
+    # dispatch finishes inside its budget — an abandoned (wedged)
+    # worker that later unwedges writes into garbage, never into a
+    # subsequent statement's attribution
+    worker_stats: dict = {}
 
     def run():
+        _phase.adopt(worker_stats)
         try:
             box["v"] = fn()
         except BaseException as e:      # noqa: BLE001
@@ -252,6 +261,8 @@ def _with_watchdog(fn, timeout_ms: int, site: str):
     if not done.wait(timeout_ms / 1000.0):
         raise DeviceWedgedError(
             f"device dispatch at {site} exceeded {timeout_ms}ms watchdog")
+    for k, v in worker_stats.items():
+        _phase.add(k, v)
     if "e" in box:
         raise box["e"]
     return box.get("v")
@@ -259,11 +270,24 @@ def _with_watchdog(fn, timeout_ms: int, site: str):
 
 # ---- the supervisor ---------------------------------------------------
 
-def _note_fallback(ectx, domain, site, err_class, exc, attempts):
+def _note_fallback(ectx, domain, site, err_class, exc, attempts,
+                   fallback_is_host=True):
     _bump(domain, "device_fallback")
+    if fallback_is_host:
+        # only a degrade that actually lands on the host twin counts in
+        # the labeled/per-digest fallback signals — an MPP degrade that
+        # the single-chip DEVICE path then serves is a topology retreat,
+        # not a host fallback (the flat device_fallback above keeps its
+        # historical any-degrade semantics)
+        _metrics.DEVICE_FALLBACKS.labels(site.split("/", 1)[0],
+                                         err_class).inc()
+        # statement-scoped: Session._observe folds this into the
+        # digest's statements_summary / tidb_top_sql fallback_count
+        _phase.inc("device_fallbacks")
     detail = "" if exc is None else \
         f": {type(exc).__name__}: {str(exc)[:120]}"
-    msg = (f"device dispatch at {site} fell back to host after "
+    target = "host" if fallback_is_host else "single-chip device path"
+    msg = (f"device dispatch at {site} fell back to {target} after "
            f"{attempts} attempt(s) [{err_class}]{detail}")
     log("warn", "device_fallback", site=site, err_class=err_class,
         attempts=attempts)
@@ -280,7 +304,8 @@ def _note_fallback(ectx, domain, site, err_class, exc, attempts):
 
 def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
                      host_fallback=None, retry_limit=None,
-                     timeout_ms=None, backoff_base_s: float = 0.05):
+                     timeout_ms=None, backoff_base_s: float = 0.05,
+                     fallback_is_host: bool = True):
     """Supervise one device dispatch.
 
     fn            — the dispatch (upload + kernel + fetch); called once
@@ -295,6 +320,10 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
     host_fallback — optional zero-arg host twin; called (once) when the
                     dispatch degrades. Without it, degrade raises
                     DeviceDegradedError for the caller's host path.
+    fallback_is_host — False when this site's degrade is served by
+                    another DEVICE path (MPP -> single-chip): such
+                    degrades are excluded from the labeled fallback
+                    counters and per-digest fallback_count.
     retry_limit / timeout_ms — override the sysvars
                     tidb_tpu_device_retry_limit /
                     tidb_tpu_device_dispatch_timeout_ms (env-seeded
@@ -327,6 +356,13 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
 
     if not breaker.allow():
         _bump(domain, "device_breaker_short_circuit")
+        _metrics.BREAKER_SHORT_CIRCUIT.labels(family).inc()
+        if fallback_is_host:
+            # a short-circuited dispatch IS a degrade: without these the
+            # per-digest fallback_count reads 0 during the exact window
+            # when every dispatch in the family runs on the host twin
+            _metrics.DEVICE_FALLBACKS.labels(family, "breaker_open").inc()
+            _phase.inc("device_fallbacks")
         if host_fallback is not None:
             return host_fallback()
         raise DeviceDegradedError(site, "breaker_open", None, 0)
@@ -347,6 +383,8 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
             err_class = classify(exc)
             attempts += 1
             _bump(domain, "device_dispatch_error")
+            _metrics.DEVICE_DISPATCH_ERRORS.labels(family,
+                                                   err_class).inc()
             if err_class in RETRYABLE and attempts <= retry_limit:
                 delay = backoff_delay(attempts - 1, base=backoff_base_s)
                 remain = None
@@ -354,6 +392,8 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
                     remain = ectx.deadline - time.time()
                 if remain is None or remain > delay:
                     _bump(domain, "device_retry")
+                    _metrics.DEVICE_RETRIES.labels(family,
+                                                   err_class).inc()
                     log("warn", "device_retry", site=site,
                         err_class=err_class, attempt=attempts,
                         err=f"{type(exc).__name__}: {str(exc)[:120]}")
@@ -364,10 +404,12 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
             tripped = breaker.record_failure()
             if tripped:
                 _bump(domain, "device_breaker_open")
+                _metrics.BREAKER_OPEN.labels(family).inc()
                 log("warn", "device_breaker_open", family=family,
                     threshold=breaker.threshold,
                     cooldown_s=breaker.cooldown_s)
-            _note_fallback(ectx, domain, site, err_class, exc, attempts)
+            _note_fallback(ectx, domain, site, err_class, exc, attempts,
+                           fallback_is_host=fallback_is_host)
             if host_fallback is not None:
                 return host_fallback()
             raise DeviceDegradedError(site, err_class, exc,

@@ -12,6 +12,15 @@ one inside the device buffer pool), so new operators are covered for
 free. Reset/snapshot is explicit: bench.py and EXPLAIN ANALYZE bracket
 each statement with reset()/snap().
 
+State is THREAD-LOCAL: each connection/background thread accumulates
+into its own dict, so concurrent statements attribute their device time
+to their own digest (Top SQL) instead of blurring into whichever
+statement folds first. Nested internal SQL runs on its outer
+statement's thread and accumulates into it by design (see
+stmt_enter/depth). A worker thread doing a statement's dispatch on its
+behalf (device_guard's watchdog) calls adopt(current()) to record into
+the owning statement's dict.
+
 Timing a dispatch measures the *call* (async on TPU: the host returns
 before the kernel finishes). With TIDB_TPU_PHASE_SYNC=1 each kernel
 call blocks until its outputs are ready, attributing true device time
@@ -20,43 +29,75 @@ overlap the production path relies on, so bench numbers must come from
 a non-sync run.
 """
 import os
+import threading
 import time
 
 
-STATS: dict = {}
 SYNC = os.environ.get("TIDB_TPU_PHASE_SYNC") == "1"
-_DEPTH = [0]        # statement nesting: internal SQL fired inside a
-                    # user statement must not clobber its counters
+_TLS = threading.local()
+
+
+def _cur() -> dict:
+    d = getattr(_TLS, "stats", None)
+    if d is None:
+        d = _TLS.stats = {}
+    return d
+
+
+def current() -> dict:
+    """The calling thread's live stats dict — hand it to a worker
+    thread via adopt() so dispatch done on this statement's behalf
+    still lands on this statement."""
+    return _cur()
+
+
+def adopt(stats: dict):
+    """Record this thread's phase counters into another thread's dict
+    (device_guard watchdog workers)."""
+    _TLS.stats = stats
 
 
 def reset():
-    STATS.clear()
+    _cur().clear()
 
 
 def stmt_enter():
     """Called at statement start: reset ONLY for the outermost
-    statement; nested (internal-SQL) statements accumulate into it."""
-    if _DEPTH[0] == 0:
-        STATS.clear()
-    _DEPTH[0] += 1
+    statement; nested (internal-SQL) statements accumulate into it.
+    Nesting is per-thread — a statement on another connection's thread
+    neither clears nor inherits this one's counters."""
+    dep = getattr(_TLS, "depth", 0)
+    if dep == 0:
+        _cur().clear()
+    _TLS.depth = dep + 1
 
 
 def stmt_leave():
-    _DEPTH[0] = max(_DEPTH[0] - 1, 0)
+    _TLS.depth = max(getattr(_TLS, "depth", 0) - 1, 0)
+
+
+def depth() -> int:
+    """Statement nesting depth on this thread (1 = inside the outermost
+    statement). Top SQL folds phase snapshots only at depth 1 so
+    internal SQL never double-attributes the outer statement's
+    accumulated counters."""
+    return getattr(_TLS, "depth", 0)
 
 
 def add(key, val):
-    STATS[key] = STATS.get(key, 0) + val
+    d = _cur()
+    d[key] = d.get(key, 0) + val
 
 
 def inc(key):
-    STATS[key] = STATS.get(key, 0) + 1
+    d = _cur()
+    d[key] = d.get(key, 0) + 1
 
 
 def snap():
     """-> {phase: value} with times in ms (rounded), counters as-is."""
     out = {}
-    for k, v in sorted(STATS.items()):
+    for k, v in sorted(_cur().items()):
         out[k] = round(v * 1000, 2) if k.endswith("_s") else v
     return out
 
