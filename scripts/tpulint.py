@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""PR gate: tpulint --strict + compileall + unused-import sweep.
+
+    JAX_PLATFORMS=cpu python scripts/tpulint.py --strict          # gate
+    python scripts/tpulint.py --json                              # CI
+    python scripts/tpulint.py tidb_tpu/utils --rules jit-purity   # spot
+
+Exit 0: no new findings, no stale baseline entries, package compiles.
+Exit 1: any of the above failed — the PR reintroduced a bug class that
+ISSUE 1 (device supervision) / ISSUE 2 (phase accounting, metrics)
+already paid to fix once. See docs/STATIC_ANALYSIS.md.
+
+tpulint never imports the engine (pure AST), so this script runs in
+any interpreter without jax initialization cost or TPU access.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tidb_tpu.tools.tpulint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,31 @@ def test_nonretryable_degrades_without_retry():
     assert device_guard.METRICS.get("device_retry", 0) == 0
 
 
+def test_nested_guards_compose():
+    """An inner guarded_dispatch that exhausts its budget (the
+    mpp/exec + fused/mpp shape after ISSUE 3 routed the exchange
+    kernels through their own guards) must degrade the OUTER guard to
+    its host fallback — not re-raise as `fatal` (which would skip the
+    host twin) and not re-retry (the inner guard already retried)."""
+    inner_calls = [0]
+
+    def inner():
+        inner_calls[0] += 1
+        return guarded_dispatch(
+            lambda: (_ for _ in ()).throw(GrantLostError("drop")),
+            site="inner/op", retry_limit=1, backoff_base_s=0.001)
+
+    out = guarded_dispatch(inner, site="outer/op", retry_limit=5,
+                           backoff_base_s=0.001,
+                           host_fallback=lambda: "host")
+    assert out == "host"
+    # outer saw class `degraded` (non-retryable): exactly one outer
+    # attempt; the inner guard did its own 1+1 attempts
+    assert inner_calls[0] == 1
+    assert classify(DeviceDegradedError("s", "grant_lost", None, 2)) \
+        == "degraded"
+
+
 def test_fatal_reraises_unchanged():
     def fn():
         raise TiDBError("semantic")

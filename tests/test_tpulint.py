@@ -1,0 +1,684 @@
+"""tpulint (tools/tpulint): per-rule positive/negative fixtures, waiver
+and baseline semantics, reporters, and the whole-package strict gate.
+
+Fixtures are SOURCE SNIPPETS linted in-memory (lint_source) — tpulint
+never imports analyzed code, so fixtures don't need to be runnable."""
+import json
+import io
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tidb_tpu.tools.tpulint import (          # noqa: E402
+    Baseline, LintConfig, lint_paths, lint_source)
+from tidb_tpu.tools.tpulint.reporters import (  # noqa: E402
+    report_json, report_text)
+
+
+def run_lint(src, rules=None, **cfg_kw):
+    config = LintConfig(root=REPO, enabled=rules, **cfg_kw)
+    return lint_source(textwrap.dedent(src), "fixture.py", config)
+
+
+def rule_hits(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---- unguarded-dispatch ----------------------------------------------
+
+DISPATCH_POS = """
+    import jax
+
+    @jax.jit
+    def _kern(x):
+        return x + 1
+
+    def run(x):
+        return _kern(x)                      # naked dispatch
+"""
+
+DISPATCH_NEG = """
+    import jax
+    from ..utils import device_guard
+
+    @jax.jit
+    def _kern(x):
+        return x + 1
+
+    def run(x, ectx):
+        return device_guard.guarded_dispatch(
+            lambda: _kern(x), site="fixture/run", ectx=ectx)
+"""
+
+
+def test_dispatch_positive():
+    hits = rule_hits(run_lint(DISPATCH_POS), "unguarded-dispatch")
+    assert len(hits) == 1 and hits[0].context == "run"
+    assert hits[0].severity == "error"
+
+
+def test_dispatch_negative():
+    assert not rule_hits(run_lint(DISPATCH_NEG), "unguarded-dispatch")
+
+
+def test_dispatch_immediate_invocation_and_assignment():
+    src = """
+        import jax
+        def a(fn, x):
+            return jax.jit(fn)(x)            # immediate invocation
+        def b(fn, x):
+            k = jax.jit(fn)
+            return k(x)                      # via assignment alias
+    """
+    hits = rule_hits(run_lint(src), "unguarded-dispatch")
+    assert len(hits) == 2
+
+
+def test_dispatch_builder_taint():
+    # a function RETURNING jax.jit(...) taints names assigned from it
+    src = """
+        import jax
+        def _build():
+            def kern(x):
+                return x
+            return jax.jit(kern)
+        def run(x):
+            kern = _build()
+            return kern(x)
+    """
+    hits = rule_hits(run_lint(src), "unguarded-dispatch")
+    assert len(hits) == 1 and hits[0].context == "run"
+
+
+def test_dispatch_guarded_by_name_reference():
+    # `lambda: self._run(...)` inside guarded_dispatch supervises the
+    # dispatches INSIDE _run (the dag_exec idiom)
+    src = """
+        import jax
+        from ..utils import device_guard
+
+        @jax.jit
+        def _kern(x):
+            return x
+
+        class C:
+            def _run(self, x):
+                return _kern(x)
+            def outer(self, x):
+                return device_guard.guarded_dispatch(
+                    lambda: self._run(x), site="c/run")
+    """
+    assert not rule_hits(run_lint(src), "unguarded-dispatch")
+
+
+def test_dispatch_eager_argument_still_flagged():
+    # guarded_dispatch(kern(x)) evaluates BEFORE supervision begins
+    src = """
+        import jax
+        from ..utils import device_guard
+
+        @jax.jit
+        def kern(x):
+            return x
+
+        def run(x):
+            return device_guard.guarded_dispatch(kern(x), site="s")
+    """
+    assert len(rule_hits(run_lint(src), "unguarded-dispatch")) == 1
+
+
+def test_dispatch_kernel_composition_not_flagged():
+    src = """
+        import jax
+
+        @jax.jit
+        def inner(x):
+            return x + 1
+
+        @jax.jit
+        def outer(x):
+            return inner(x) * 2              # traced call, not dispatch
+    """
+    assert not rule_hits(run_lint(src), "unguarded-dispatch")
+
+
+def test_dispatch_data_arg_name_does_not_exempt():
+    # a guarded call passing `kern` as DATA must not exempt a function
+    # named `kern` elsewhere in the file (only call-position names and
+    # bare callable references in fn/host_fallback are supervised)
+    src = """
+        import jax
+        from ..utils import device_guard
+
+        @jax.jit
+        def _jk(x):
+            return x
+
+        def other(cache, key, kern):
+            return device_guard.guarded_dispatch(
+                lambda: cache.put(key, kern), site="s")
+
+        def put(x):
+            return _jk(x)                    # NOT supervised anywhere
+    """
+    hits = rule_hits(run_lint(src), "unguarded-dispatch")
+    assert len(hits) == 1 and hits[0].context == "put"
+
+
+def test_dispatch_bare_callable_and_host_fallback_references():
+    src = """
+        import jax
+        from ..utils import device_guard
+
+        @jax.jit
+        def _jk(x):
+            return x
+
+        def primary(x):
+            return _jk(x)
+
+        def twin(x):
+            return _jk(x)
+
+        def run(x):
+            return device_guard.guarded_dispatch(
+                primary, site="s", host_fallback=twin)
+    """
+    assert not rule_hits(run_lint(src), "unguarded-dispatch")
+
+
+# ---- jit-purity -------------------------------------------------------
+
+def test_purity_host_effects_flagged():
+    src = """
+        import jax
+        from ..utils import failpoint
+        from ..utils import metrics as _metrics
+
+        @jax.jit
+        def kern(x):
+            failpoint.inject("site")
+            _metrics.FOO.labels("a").inc()
+            print("tracing")
+            return x
+    """
+    hits = rule_hits(run_lint(src), "jit-purity")
+    assert len(hits) == 3
+    assert all(h.severity == "error" for h in hits)
+
+
+def test_purity_host_sync_flagged():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            y = np.asarray(x)                # host materialization
+            z = float(x)                     # tracer concretization
+            return y, z, x.item()            # .item() sync
+    """
+    hits = rule_hits(run_lint(src), "jit-purity")
+    assert len(hits) == 3
+
+
+def test_purity_scope_and_closure_mutation():
+    src = """
+        import jax
+
+        STATE = {}
+
+        @jax.jit
+        def kern(x):
+            global STATE
+            STATE["k"] = 1
+            return x
+    """
+    hits = rule_hits(run_lint(src), "jit-purity")
+    kinds = {h.detail.split(":")[1] for h in hits}
+    assert "scope" in kinds and "mutate" in kinds
+
+
+def test_purity_clean_kernel_and_shard_map():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from ..utils.jaxcfg import compat_shard_map as shard_map
+
+        def frag(a, b):
+            local = {}
+            local["s"] = jnp.sum(jnp.asarray(a))   # jnp is device-side
+            return local["s"] + jax.lax.psum(b, "dp")
+
+        def launch(mesh, a, b):
+            return shard_map(frag, mesh=mesh)(a, b)
+    """
+    assert not rule_hits(run_lint(src), "jit-purity")
+
+
+def test_purity_shard_map_target_checked():
+    src = """
+        from ..utils.jaxcfg import compat_shard_map as shard_map
+
+        def frag(a):
+            print(a)
+            return a
+
+        def launch(mesh, a):
+            return shard_map(frag, mesh=mesh)(a)
+    """
+    assert len(rule_hits(run_lint(src), "jit-purity")) == 1
+
+
+# ---- shared-state-race ------------------------------------------------
+
+def test_race_unlocked_mutation_flagged():
+    src = """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """
+    hits = rule_hits(run_lint(src), "shared-state-race")
+    assert len(hits) == 1 and "_CACHE" in hits[0].message
+
+
+def test_race_locked_mutation_passes():
+    src = """
+        import threading
+        _CACHE = {}
+        _MU = threading.Lock()
+
+        def put(k, v):
+            with _MU:
+                _CACHE[k] = v
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+def test_race_threading_local_exempt():
+    src = """
+        import threading
+        _TLS = threading.local()
+
+        def put(v):
+            _TLS.stats = v
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+def test_race_import_time_mutation_exempt():
+    src = """
+        _REG = {}
+        _REG["a"] = 1                        # module level: fine
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+def test_race_method_mutations_flagged():
+    src = """
+        _SEEN = set()
+        _ORDER = []
+
+        def note(x):
+            _SEEN.add(x)
+            _ORDER.append(x)
+    """
+    assert len(rule_hits(run_lint(src), "shared-state-race")) == 2
+
+
+def test_race_chained_receiver_mutation_flagged():
+    # `_QUEUES[name].append(x)` mutates the shared value graph exactly
+    # like a subscript write
+    src = """
+        _QUEUES = {}
+
+        def push(name, x):
+            _QUEUES[name].append(x)
+    """
+    assert len(rule_hits(run_lint(src), "shared-state-race")) == 1
+
+
+# ---- metrics-hygiene --------------------------------------------------
+
+def test_hygiene_missing_help_and_dynamic_labels():
+    src = """
+        REGISTRY = object()
+
+        C1 = REGISTRY.counter("tidb_tpu_good_total", "documented", ("a",))
+        C2 = REGISTRY.counter("tidb_tpu_bad_total")
+        C3 = REGISTRY.counter("tidb_tpu_worse_total", "", ("a",))
+
+        def bump(site, err):
+            C1.labels(site, err).inc()               # fine
+            C1.labels(f"{site}/x", err).inc()        # f-string
+            C1.labels(str(err)).inc()                # str()
+    """
+    hits = rule_hits(run_lint(src), "metrics-hygiene")
+    details = sorted(h.detail for h in hits)
+    assert any("help:tidb_tpu_bad_total" in d for d in details)
+    assert any("help:tidb_tpu_worse_total" in d for d in details)
+    assert sum("labelvalue" in d for d in details) == 2
+
+
+def test_hygiene_nonliteral_labelnames():
+    src = """
+        REGISTRY = object()
+        NAMES = ("a", "b")
+        C = REGISTRY.histogram("tidb_tpu_h_seconds", "help text", NAMES)
+    """
+    hits = rule_hits(run_lint(src), "metrics-hygiene")
+    assert any("labelnames" in h.detail for h in hits)
+
+
+# ---- error-code-validity ---------------------------------------------
+
+ERRCAT = {"TiDBError", "DuplicateKeyError", "ParseError", "catalog"}
+SYSVARS = {"tidb_enable_tpu_exec", "max_execution_time"}
+
+
+def test_codes_unknown_error_attr():
+    src = """
+        from .. import errors
+
+        def boom():
+            raise errors.DupKeyError("x")    # typo: DuplicateKeyError
+    """
+    hits = rule_hits(run_lint(src, known_errors=ERRCAT),
+                     "error-code-validity")
+    assert len(hits) == 1 and "DupKeyError" in hits[0].message
+
+
+def test_codes_known_error_attr_passes():
+    src = """
+        from .. import errors
+
+        def boom():
+            raise errors.DuplicateKeyError("x")
+    """
+    assert not rule_hits(run_lint(src, known_errors=ERRCAT),
+                         "error-code-validity")
+
+
+def test_codes_stale_from_import():
+    src = "from ..errors import DuplicateKeyError, NotARealError\n"
+    hits = rule_hits(run_lint(src, known_errors=ERRCAT),
+                     "error-code-validity")
+    assert len(hits) == 1 and "NotARealError" in hits[0].message
+
+
+def test_codes_unknown_sysvar():
+    src = """
+        def knobs(sv):
+            a = sv.get("tidb_enable_tpu_exec")       # registered
+            b = sv.get("tidb_tpu_no_such_knob")      # not registered
+            c = sv.get(compute_name())               # non-literal: skip
+            d = {"tidb_fake": 1}.get("tidb_fake")    # not a sv receiver
+            return a, b, c, d
+    """
+    hits = rule_hits(run_lint(src, known_sysvars=SYSVARS),
+                     "error-code-validity")
+    assert len(hits) == 1 and "tidb_tpu_no_such_knob" in hits[0].message
+
+
+def test_codes_duplicate_error_code():
+    from tidb_tpu.tools.tpulint.rules.codes import parse_error_catalog
+    names, dups = parse_error_catalog(textwrap.dedent("""
+        A = _err("A", 1062)
+        B = _err("B", 1062)
+        C = _err("C", 1063)
+    """))
+    assert {"A", "B", "C"} <= names
+    assert len(dups) == 1 and dups[0][2] == 1062
+
+
+# ---- unused-import ----------------------------------------------------
+
+def test_unused_import_flagged_and_noqa_respected():
+    src = """
+        import os
+        import sys                            # noqa: F401
+        from ..utils import jaxcfg  # noqa: F401
+        import json
+
+        def f():
+            return json.dumps({})
+    """
+    hits = rule_hits(run_lint(src), "unused-import")
+    assert len(hits) == 1 and "'os'" in hits[0].message
+
+
+def test_unused_import_all_export_exempt():
+    src = """
+        from .exec import mpp_global_sum
+
+        __all__ = ["mpp_global_sum"]
+    """
+    assert not rule_hits(run_lint(src), "unused-import")
+
+
+# ---- waiver semantics -------------------------------------------------
+
+def test_waiver_same_line():
+    src = """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v  # tpulint: disable=shared-state-race
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+def test_waiver_standalone_comment_covers_next_code_line():
+    src = """
+        _CACHE = {}
+
+        def put(k, v):
+            # single-threaded by construction (import-time only)
+            # tpulint: disable=shared-state-race
+            # (second explanatory line)
+            _CACHE[k] = v
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+def test_waiver_is_rule_scoped():
+    src = """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v  # tpulint: disable=unused-import
+    """
+    assert len(rule_hits(run_lint(src), "shared-state-race")) == 1
+
+
+def test_waiver_file_level():
+    src = """
+        # tpulint: disable-file=shared-state-race
+        _A = {}
+        _B = []
+
+        def f(x):
+            _A[x] = 1
+            _B.append(x)
+    """
+    assert not rule_hits(run_lint(src), "shared-state-race")
+
+
+# ---- baseline semantics ----------------------------------------------
+
+def test_baseline_absorbs_matching_finding_line_independent():
+    findings = run_lint(DISPATCH_POS)
+    f = rule_hits(findings, "unguarded-dispatch")[0]
+    entry = {"rule": f.rule, "file": f.path, "context": f.context,
+             "detail": f.detail, "reason": "fixture"}
+    bl = Baseline(entries=[entry])
+    cfg = LintConfig(root=REPO, baseline=bl)
+    # shift line numbers: baseline must still match (identity is
+    # line-independent)
+    shifted = "\n\n\n" + textwrap.dedent(DISPATCH_POS)
+    out = lint_source(shifted, "fixture.py", cfg)
+    hit = rule_hits(out, "unguarded-dispatch")[0]
+    assert hit.baselined and hit.reason == "fixture"
+    assert not bl.stale_entries()
+
+
+def test_baseline_unmatched_entry_is_stale():
+    bl = Baseline(entries=[{"rule": "unguarded-dispatch",
+                            "file": "fixture.py", "context": "gone",
+                            "detail": "dispatch:gone"}])
+    cfg = LintConfig(root=REPO, baseline=bl)
+    lint_source("x = 1\n", "fixture.py", cfg)
+    assert len(bl.stale_entries()) == 1
+
+
+def test_baseline_write_and_load_roundtrip(tmp_path):
+    findings = run_lint(DISPATCH_POS)
+    path = str(tmp_path / "bl.json")
+    n = Baseline.write(path, findings)
+    assert n == 1
+    bl = Baseline.load(path)
+    cfg = LintConfig(root=REPO, baseline=bl)
+    out = lint_source(textwrap.dedent(DISPATCH_POS), "fixture.py", cfg)
+    assert all(f.baselined for f in out)
+
+
+def test_baseline_rewrite_preserves_matched_entries(tmp_path):
+    # --write-baseline must carry forward still-live entries (with
+    # their reasons), not erase them because they were absorbed
+    findings = run_lint(DISPATCH_POS)
+    f = rule_hits(findings, "unguarded-dispatch")[0]
+    kept = {"rule": f.rule, "file": f.path, "context": f.context,
+            "detail": f.detail, "reason": "justified"}
+    bl = Baseline(entries=[kept])
+    cfg = LintConfig(root=REPO, baseline=bl)
+    out = lint_source(textwrap.dedent(DISPATCH_POS), "fixture.py", cfg)
+    assert all(x.baselined for x in out)
+    path = str(tmp_path / "bl.json")
+    n = Baseline.write(path, [x for x in out if not x.baselined],
+                       keep_entries=bl.matched_entries())
+    assert n == 1
+    reloaded = Baseline.load(path)
+    assert reloaded.entries[0]["reason"] == "justified"
+
+
+def test_baseline_stale_scoped_to_run_paths():
+    # a subset run must not report rows outside its paths as stale,
+    # but scope is by path prefix (an entry for a DELETED file under
+    # the scanned tree still goes stale)
+    bl = Baseline(entries=[
+        {"rule": "unguarded-dispatch", "file": "other/file.py",
+         "context": "f", "detail": "dispatch:k"},
+        {"rule": "unguarded-dispatch", "file": "pkg/deleted.py",
+         "context": "g", "detail": "dispatch:j"}])
+    cfg = LintConfig(root=REPO, baseline=bl)
+    lint_source("x = 1\n", "pkg/fixture.py", cfg)
+    under_pkg = lambda f: f == "pkg" or f.startswith("pkg/")  # noqa: E731
+    stale = bl.stale_entries(in_scope=under_pkg)
+    assert [e["file"] for e in stale] == ["pkg/deleted.py"]
+    assert len(bl.stale_entries()) == 2          # full-tree semantics
+
+
+# ---- reporters --------------------------------------------------------
+
+def test_reporters_text_and_json():
+    findings = run_lint(DISPATCH_POS)
+    buf = io.StringIO()
+    report_text(findings, buf)
+    assert "unguarded-dispatch" in buf.getvalue()
+    assert "1 finding(s)" in buf.getvalue()
+    jbuf = io.StringIO()
+    report_json(findings, jbuf)
+    doc = json.loads(jbuf.getvalue())
+    assert doc["summary"]["new"] == 1
+    assert doc["findings"][0]["rule"] == "unguarded-dispatch"
+    assert doc["summary"]["by_rule"]["unguarded-dispatch"] == 1
+
+
+def test_syntax_error_is_a_finding():
+    out = run_lint("def broken(:\n")
+    assert out and out[0].rule == "syntax-error"
+
+
+# ---- the whole-package gate ------------------------------------------
+
+def test_whole_package_zero_nonbaselined_findings():
+    """The acceptance invariant: tpulint over the entire tidb_tpu
+    package, with the checked-in baseline, reports ZERO new findings —
+    every shipped violation was fixed or carries a justified waiver."""
+    bl = Baseline.load(os.path.join(REPO, "tpulint_baseline.json"))
+    cfg = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                 root=REPO, baseline=bl)
+    findings = lint_paths([os.path.join(REPO, "tidb_tpu")], cfg)
+    new = [f for f in findings if not f.baselined]
+    assert new == [], "\n".join(
+        f"{f.path}:{f.line} [{f.rule}] {f.message}" for f in new)
+    assert not bl.stale_entries()
+
+
+def test_package_catalogs_parsed():
+    cfg = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                 root=REPO)
+    assert "DuplicateKeyError" in cfg.known_errors
+    assert "tidb_tpu_device_retry_limit" in cfg.known_sysvars
+    assert not cfg.error_dups, "duplicate error codes in errors.py"
+
+
+def test_strict_cli_catches_injected_violation(tmp_path):
+    """scripts/tpulint.py --strict exits 0 on the clean tree and
+    nonzero once a fixture violation lands inside tidb_tpu/."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    inj = os.path.join(REPO, "tidb_tpu", "_tpulint_fixture_inj.py")
+    assert not os.path.exists(inj)
+    try:
+        with open(inj, "w") as f:
+            f.write(textwrap.dedent(DISPATCH_POS))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "tpulint.py"),
+             "--strict", "--no-compile"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode != 0, r.stdout + r.stderr
+        assert "unguarded-dispatch" in r.stdout
+    finally:
+        os.unlink(inj)
+
+
+def test_strict_cli_rules_subset_ignores_other_rules_baseline(tmp_path):
+    """`--rules <subset> --strict` must not report baseline rows of
+    DISABLED rules as stale — the spot run never re-checked them."""
+    bl = str(tmp_path / "bl.json")
+    with open(bl, "w") as f:
+        json.dump({"version": 1, "entries": [{
+            "rule": "unguarded-dispatch", "file": "tidb_tpu/x.py",
+            "context": "f", "detail": "dispatch:k",
+            "reason": "r"}]}, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpulint.py"),
+         "--strict", "--no-compile", "--baseline", bl,
+         "--rules", "jit-purity"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the full run DOES treat that row as stale (file gone)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpulint.py"),
+         "--strict", "--no-compile", "--baseline", bl],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode != 0 and "stale" in r.stdout
+
+
+@pytest.mark.slow
+def test_strict_cli_clean_tree_exits_zero():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpulint.py"),
+         "--strict"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -4,7 +4,6 @@ or `--serve [--port N]` to run the MySQL-protocol server
 from __future__ import annotations
 
 import argparse
-import sys
 
 
 def repl(domain):

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import struct
 
-from .codec import encode_datums_key, decode_datum_key, decode_int
+from .codec import encode_datums_key, decode_datum_key
 
 TABLE_PREFIX = b"t"
 META_PREFIX = b"m"

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
-from ..expression.vec import materialize_nulls, or_nulls
+from ..expression.vec import materialize_nulls
 from ..utils.fetch import prefetch
 from ..utils import phase
 from ..utils import device_guard
@@ -32,7 +32,6 @@ from ..errors import TiDBError
 from ..chunk.device import shape_bucket
 from ..chunk.column import Column
 from ..chunk.chunk import Chunk
-from ..types.field_type import TypeClass, new_bigint_type
 
 _I64_MAX = np.iinfo(np.int64).max
 

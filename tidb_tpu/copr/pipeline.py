@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import re
+import threading
 
 import numpy as np
 
@@ -324,6 +325,7 @@ def _matdim_nbytes(out):
 
 
 _MAT_SEQ = [0]
+_MAT_SEQ_MU = threading.Lock()  # materializations on any conn thread
 
 
 class _MatTbl:
@@ -335,8 +337,9 @@ class _MatTbl:
     __slots__ = ("uid", "version", "n", "dicts")
 
     def __init__(self, n):
-        _MAT_SEQ[0] += 1
-        self.uid = ("mat", _MAT_SEQ[0])
+        with _MAT_SEQ_MU:
+            _MAT_SEQ[0] += 1
+            self.uid = ("mat", _MAT_SEQ[0])
         self.version = 0
         self.n = n
         self.dicts = {}
@@ -1248,7 +1251,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         # exchange (all_to_all shuffle) instead of Broadcast
         sh = _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl,
                                 fact_arrays, fact_valid, n, handles,
-                                bcast_threshold)
+                                bcast_threshold, ectx=ctx)
         if sh is not None:
             return sh
 
@@ -1498,6 +1501,9 @@ def fused_partials(copr, plan, read_ts, mesh=None,
         # wraps the whole fused_partials call in device_guard) — the
         # kernel cache makes a whole-call retry cheap.
         failpoint.inject("device_guard/fused/kernel")
+        # tpulint: disable=unguarded-dispatch — the supervised retry
+        # lives one level up (executors.FusedPipeline wraps the whole
+        # fused_partials call in guarded_dispatch site="fused")
         res = prefetch(kern(fjc, fvv, kargs))
         return res, cap, agg_kind, agg_param, ecap, oh_table
 
@@ -1663,7 +1669,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
 
 
 def _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl, fact_arrays,
-                       fact_valid, n, handles, threshold):
+                       fact_valid, n, handles, threshold, ectx=None):
     """Hash-exchange path (reference ExchangeType_Hash,
     fragment.go:168): single huge dimension + group-by a dim column +
     sum/count/avg over fact expressions -> both sides all_to_all by join
@@ -1760,7 +1766,7 @@ def _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl, fact_arrays,
     sums, cnts = mpp_shuffle_join_agg(
         mesh, pad(pk, n), [pad(v, n) for v in val_arrays],
         pad(fmask, n, False), pad(bk, nd), pad(payload, nd),
-        pad(dmask, nd, False), n_groups=size)
+        pad(dmask, nd, False), n_groups=size, ectx=ectx)
     cnts = np.asarray(cnts)
     slots = np.nonzero(cnts > 0)[0]
     keys = [(slots + lo).astype(np.int64)]
@@ -1841,6 +1847,9 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                 tuple(dim_sns), tuple(dim_layouts), agg_kind, agg_param,
                 mesh, dim_pres)
             kern = copr._kernel_cache.put(key, kern)
+        # tpulint: disable=unguarded-dispatch — supervised by
+        # executors.FusedPipeline's guarded_dispatch site="fused/mpp"
+        # (a degraded mesh run retries single-chip there)
         res = prefetch(kern(fjc, fvv, dim_args))
         if pos_spec is not None:
             return [_compact_pos_dense(plan, res, pos_spec[0],

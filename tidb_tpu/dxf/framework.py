@@ -177,6 +177,9 @@ def register_task_type(kind: str, planner):
     """planner(domain, meta) must return the FULL ordered subtask list;
     on resume, already-succeeded ordinals are skipped (the done-list is
     the checkpoint)."""
+    # import-time registration (module-level decorator/call sites only):
+    # single-threaded by construction
+    # tpulint: disable=shared-state-race
     _TASK_TYPES[kind] = planner
 
 
@@ -194,7 +197,6 @@ class DurableTasks:
         return s.execute(q)
 
     def submit(self, kind: str, meta: str, concurrency: int = 4):
-        import json as _json
         planner = _TASK_TYPES[kind]
         fns = planner(self.domain, meta)
         tid = int(time.time() * 1000) % (1 << 40)

@@ -17,6 +17,9 @@ HANDLERS: dict = {}
 
 def register(kind: str):
     def deco(fn):
+        # import-time registration (module-level @register decorators):
+        # single-threaded by construction
+        # tpulint: disable=shared-state-race
         HANDLERS[kind] = fn
         return fn
     return deco

@@ -5,11 +5,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..chunk.chunk import Chunk
-from ..expression import EvalCtx, eval_expr, Constant
+from ..expression import EvalCtx, eval_expr
 from ..expression.vec import materialize_nulls
 from ..types.datum import Datum, Kind, NULL
-from ..errors import DuplicateKeyError, BadNullError, DataOutOfRangeError
+from ..errors import DuplicateKeyError
 from . import table_rt
 from .exec_base import (bind_chunk, coerce_datum, expr_to_datum,
                         datum_from_value)

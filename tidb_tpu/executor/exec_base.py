@@ -12,7 +12,7 @@ from ..expression import EvalCtx, eval_expr
 from ..expression.vec import materialize_nulls
 from ..types.field_type import TypeClass
 from ..types.datum import Datum, Kind, NULL
-from ..errors import QueryKilledError, MemoryQuotaExceededError
+from ..errors import QueryKilledError
 
 
 class ExecContext:

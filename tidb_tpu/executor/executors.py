@@ -9,11 +9,11 @@ import numpy as np
 from ..chunk.chunk import Chunk
 from ..chunk.column import Column
 from ..chunk.device import StringDict
-from ..expression import EvalCtx, eval_expr, Constant, Column as ExprCol
+from ..expression import EvalCtx, eval_expr, Column as ExprCol
 from ..expression.vec import materialize_nulls, eval_bool_mask
 from ..types.field_type import TypeClass, new_bigint_type
-from ..types.datum import Datum, Kind, NULL
-from ..types.decimal import scaled_int_to_str, _POW10
+from ..types.datum import Datum, Kind
+from ..types.decimal import _POW10
 from ..errors import UnsupportedError, TiDBError
 from .exec_base import Executor, bind_chunk, eval_to_column
 
@@ -85,8 +85,7 @@ class TableReaderExec(Executor):
         txn = getattr(sess, "_txn", None)
         if txn is None or txn.committed or txn.aborted or not txn.is_dirty():
             return None
-        from ..codec.tablecodec import (record_prefix, decode_record_key,
-                                        table_prefix)
+        from ..codec.tablecodec import record_prefix, decode_record_key
         from ..codec.codec import decode_row_value
         pref = record_prefix(dag.table_info.id)
         end = pref + b"\xff" * 9
@@ -1798,7 +1797,6 @@ class HashJoinExec(Executor):
             lft, rft = l.ft, r.ft
             le, re_ = l, r
             if lft.tclass == TypeClass.DECIMAL or rft.tclass == TypeClass.DECIMAL:
-                from ..planner.rewriter import Rewriter
                 sa = max(lft.decimal, 0) if lft.tclass == TypeClass.DECIMAL else 0
                 sb = max(rft.decimal, 0) if rft.tclass == TypeClass.DECIMAL else 0
                 s = max(sa, sb)

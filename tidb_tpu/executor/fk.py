@@ -101,7 +101,6 @@ def on_parent_delete(sess, txn, parent_tbl, parent_db, row):
             for k, v in hits:
                 h = int(v) if idx.unique and v not in (b"",) \
                     else index_key_handle(k)
-                from .table_rt import physical_id
                 rv = txn.get(record_key(child.id, h))
                 if rv is None and child.partitions:
                     continue

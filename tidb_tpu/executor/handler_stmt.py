@@ -176,7 +176,6 @@ def _read(sess, cur, stmt):
                 out_pos.append(cur.order[pos])
         cur.pos = pos           # rest on the last examined row
         pos += cur.dir
-    from ..chunk.column import Column
     chunk_cols = []
     sel = np.asarray(out_pos, dtype=np.int64)
     for ci in cols_info:

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from ..codec.tablecodec import record_key, index_key
 from ..codec.codec import encode_row_value, decode_row_value
-from ..types.datum import Datum, Kind, NULL
+from ..types.datum import Datum
 from ..errors import DuplicateKeyError, BadNullError, TiDBError
 from ..models import SchemaState
 from ..storage.partition import route_partition
@@ -79,7 +79,7 @@ def fold_ci_datums(tbl, idx, datums):
     while the row value keeps the original string. Applied on BOTH the
     write path (_index_datums) and every read-side key construction."""
     from ..types.field_type import TypeClass
-    from ..chunk.device import StringDict, collation_fold
+    from ..chunk.device import collation_fold
     from ..expression.vec import _is_ci, _coll_arg
     name_to_col = {c.name.lower(): c for c in tbl.columns}
     out = list(datums)

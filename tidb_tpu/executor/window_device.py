@@ -16,6 +16,8 @@ function — those fall back to the host path transparently.
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..utils import jaxcfg  # noqa: F401
@@ -28,6 +30,9 @@ DEVICE_FNS = {"row_number", "rank", "dense_rank", "sum", "count",
               "avg", "min", "max", "lag", "lead"}
 
 _KERN_CACHE: dict = {}
+# concurrent window statements on different connections share the
+# compiled-kernel cache; build-under-lock also dedups the jit wrapper
+_KERN_MU = threading.Lock()
 
 
 def _seg_scan_minmax(filled, resets, is_min):
@@ -184,13 +189,18 @@ def run_window_device(name, key_arrays, n_part_keys, has_order, svals,
         [np.asarray(sok), np.zeros(pad, dtype=bool)])
     key = (name, len(keys), n_part_keys, bool(has_order), cap,
            val_float, default is not None, svp.dtype.str)
-    kern = _KERN_CACHE.get(key)
-    if kern is None:
-        kern = _build_kernel(name, len(keys), n_part_keys,
-                             bool(has_order), cap, val_float,
-                             default is not None)
-        _KERN_CACHE[key] = kern
+    with _KERN_MU:
+        kern = _KERN_CACHE.get(key)
+        if kern is None:
+            kern = _build_kernel(name, len(keys), n_part_keys,
+                                 bool(has_order), cap, val_float,
+                                 default is not None)
+            _KERN_CACHE[key] = kern
     dv = default if default is not None else 0
+    # supervised by the caller: executor/window.py wraps
+    # run_window_device in guarded_dispatch(site="window") and handles
+    # DeviceDegradedError with the host window path
+    # tpulint: disable=unguarded-dispatch
     out, nulls = kern([jnp.asarray(k) for k in keys], jnp.asarray(svp),
                       jnp.asarray(okp), dv, jnp.int64(shift))
     out = np.asarray(out)[:n]

@@ -9,7 +9,6 @@ appear in projections and residual filters, not in the copr hot path.
 """
 from __future__ import annotations
 
-import base64
 import hashlib
 import json
 import re
@@ -28,6 +27,9 @@ _HOST = set()
 
 def hop(*names):
     """Register + mark host-only in one step."""
+    # import-time registration (module-level @hop decorators):
+    # single-threaded by construction
+    # tpulint: disable=shared-state-race
     _HOST.update(names)
     _HOST_ONLY.update(names)
     return op(*names)

@@ -11,8 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..types import FieldType
-from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
-                                new_null_type)
+from ..types.field_type import new_bigint_type, new_double_type, new_null_type
 from ..types.datum import Datum, Kind, NULL, datum_from_py
 
 

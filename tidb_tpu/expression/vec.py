@@ -26,7 +26,7 @@ import re
 
 import numpy as np
 
-from ..types.field_type import TypeClass, FieldType, new_double_type
+from ..types.field_type import TypeClass, FieldType
 from ..types.datum import Kind
 from ..types.time_types import MICROS_PER_DAY, MICROS_PER_SEC
 from ..errors import UnknownFunctionError
@@ -219,6 +219,9 @@ _REGISTRY = {}
 def op(*names):
     def deco(fn):
         for n in names:
+            # import-time registration (module-level @op decorators):
+            # single-threaded by construction
+            # tpulint: disable=shared-state-race
             _REGISTRY[n] = fn
         return fn
     return deco

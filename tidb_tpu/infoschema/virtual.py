@@ -6,19 +6,23 @@ materialize on demand and then flow through the normal host copr path, so
 filters/joins/aggregation all work over them."""
 from __future__ import annotations
 
-import time
+import threading
 
 from ..models import TableInfo, ColumnInfo
-from ..types.field_type import (new_bigint_type, new_double_type,
-                                new_string_type, new_datetime_type)
+from ..types.field_type import (new_bigint_type,
+                                new_double_type,
+                                new_string_type)
 
 _VIRTUAL_ID = {}
 _next_vid = [-1000]
 
 
 def _vt(name, cols, gen):
+    # import-time registration only (every _vt call is a module-level
+    # statement in this file): single-threaded by construction
+    # tpulint: disable=shared-state-race
     _next_vid[0] -= 1
-    VIRTUAL_TABLES[name] = (cols, gen)
+    VIRTUAL_TABLES[name] = (cols, gen)  # tpulint: disable=shared-state-race
 
 
 VIRTUAL_TABLES: dict = {}
@@ -366,6 +370,7 @@ VIRTUAL_DEFS = {
 }
 
 _VIRT_INFO_CACHE: dict = {}
+_VIRT_INFO_MU = threading.Lock()  # info reads race from any connection
 
 
 def virtual_table_info(name: str) -> TableInfo | None:
@@ -373,7 +378,7 @@ def virtual_table_info(name: str) -> TableInfo | None:
     d = VIRTUAL_DEFS.get(name)
     if d is None:
         return None
-    ti = _VIRT_INFO_CACHE.get(name)
+    ti = _VIRT_INFO_CACHE.get(name)     # lockless fast path
     if ti is not None:
         return ti
     cols_spec, _ = d
@@ -381,8 +386,8 @@ def virtual_table_info(name: str) -> TableInfo | None:
     cols = [ColumnInfo(id=i + 1, name=cn, offset=i, ft=ft)
             for i, (cn, ft) in enumerate(cols_spec)]
     ti = TableInfo(id=vid, name=name, columns=cols)
-    _VIRT_INFO_CACHE[name] = ti
-    return ti
+    with _VIRT_INFO_MU:
+        return _VIRT_INFO_CACHE.setdefault(name, ti)
 
 
 def virtual_rows(domain, table_info) -> list:

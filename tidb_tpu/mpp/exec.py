@@ -20,7 +20,6 @@ changing the final-merge code.
 """
 from __future__ import annotations
 
-from functools import partial
 
 import numpy as np
 
@@ -32,6 +31,7 @@ from ..utils.jaxcfg import compat_shard_map as shard_map
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
+from ..utils import device_guard
 
 
 def _local_ctx(cols, n):
@@ -39,7 +39,8 @@ def _local_ctx(cols, n):
 
 
 def mpp_global_sum(mesh: Mesh, cols_sharded: dict, sdicts: dict,
-                   filters: list, sum_exprs: list, axis: str = "dp"):
+                   filters: list, sum_exprs: list, axis: str = "dp",
+                   ectx=None):
     """Fragment: sharded scan -> fused filter -> local masked sums -> psum.
     Returns (sums per expr, count) replicated on every device."""
 
@@ -87,11 +88,21 @@ def mpp_global_sum(mesh: Mesh, cols_sharded: dict, sdicts: dict,
                    in_specs=tuple(in_specs),
                    out_specs=tuple(P() for _ in range(len(sum_exprs) + 1)),
                    check_vma=False)
-    return jax.jit(fn)(*args)
+    # supervised: these exchange fragments are invoked naked by the
+    # cluster worker control plane; under the fused pipeline the outer
+    # "fused/mpp" guard composes (inner degrade -> outer fallback, see
+    # device_guard.classify 'degraded')
+    # ectx (when a session drives this fragment) supplies the
+    # statement-deadline clamp, kill checks, and per-session retry/
+    # timeout sysvars — the supervision contract the outer guard used
+    # to provide before these sites grew their own
+    return device_guard.guarded_dispatch(
+        lambda: jax.jit(fn)(*args), site="mpp/global_sum", ectx=ectx,
+        fallback_is_host=False)
 
 
 def mpp_filter_agg(mesh: Mesh, key_arr, val_arr, valid, n_groups: int,
-                   axis: str = "dp"):
+                   axis: str = "dp", ectx=None):
     """Fragment: sharded grouped aggregation over a SMALL group domain.
     Hash exchange replaced by dense partial tables + psum: each device
     scatter-adds into its local [n_groups] table, one allreduce merges.
@@ -108,7 +119,9 @@ def mpp_filter_agg(mesh: Mesh, key_arr, val_arr, valid, n_groups: int,
     fn = shard_map(frag, mesh=mesh,
                    in_specs=(P(axis), P(axis), P(axis)),
                    out_specs=(P(), P()), check_vma=False)
-    return jax.jit(fn)(key_arr, val_arr, valid)
+    return device_guard.guarded_dispatch(
+        lambda: jax.jit(fn)(key_arr, val_arr, valid),
+        site="mpp/filter_agg", ectx=ectx, fallback_is_host=False)
 
 
 def _shuffle_capacity(keys, ok, ndev):
@@ -145,7 +158,8 @@ def _round_capacity(cap):
 
 def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
                          build_keys, build_payload, build_valid,
-                         n_groups: int, axis: str = "dp", cap=None):
+                         n_groups: int, axis: str = "dp", cap=None,
+                         ectx=None):
     """Fragment pair with a HASH exchange: both sides all_to_all'd by
     key % n_devices so matching keys land on the same device, then a local
     sort-merge join feeds a grouped aggregation on the build payload,
@@ -233,8 +247,10 @@ def mpp_shuffle_join_agg(mesh: Mesh, probe_keys, probe_vals, probe_valid,
                    in_specs=tuple(P(axis) for _ in range(5 + nvals)),
                    out_specs=tuple(P() for _ in range(nvals + 1)),
                    check_vma=False)
-    res = jax.jit(fn)(probe_keys, probe_valid, build_keys, build_payload,
-                      build_valid, *pvals)
+    res = device_guard.guarded_dispatch(
+        lambda: jax.jit(fn)(probe_keys, probe_valid, build_keys,
+                            build_payload, build_valid, *pvals),
+        site="mpp/shuffle_join", ectx=ectx, fallback_is_host=False)
     if single:
         return res[0], res[-1]
     return list(res[:-1]), res[-1]

@@ -29,6 +29,7 @@ from ..utils.jaxcfg import compat_shard_map as shard_map
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
 from ..parallel.dist import bind_host_rows
+from ..utils import device_guard
 
 
 def run_dag_spmd(domain, dag, mesh, local_cap, n_groups=None,
@@ -150,6 +151,15 @@ def run_dag_spmd(domain, dag, mesh, local_cap, n_groups=None,
     fn = shard_map(frag, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=tuple(P() for _ in range(nouts)),
                    check_vma=False)
-    res = jax.jit(fn)(*flat_args)
+    # supervised mesh launch: the worker control plane (cluster/worker
+    # spmd_frag) calls this NAKED — without the guard a dropped grant
+    # mid-collective is an unclassified worker crash instead of a
+    # retryable error the coordinator can reason about
+    # fallback_is_host=False: a degrade here propagates to the
+    # coordinator, which retries on another DEVICE path (single-chip) —
+    # a topology retreat, not a host fallback (PR 2 exclusion contract)
+    res = device_guard.guarded_dispatch(
+        lambda: jax.jit(fn)(*flat_args), site="mpp/spmd", domain=domain,
+        fallback_is_host=False)
     return {"sums": [np.asarray(r) for r in res[:-1]],
             "counts": np.asarray(res[-1])}

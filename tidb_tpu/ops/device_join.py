@@ -15,6 +15,7 @@ bucket of the true total (host reads one scalar between phases).
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as np
 
@@ -55,6 +56,7 @@ def _phase2(out_cap):
 
 
 _EXPAND_CACHE: dict = {}
+_EXPAND_MU = threading.Lock()   # joins run on per-connection threads
 
 
 def device_join_index(bk: np.ndarray, bnull: np.ndarray,
@@ -69,6 +71,10 @@ def device_join_index(bk: np.ndarray, bnull: np.ndarray,
     pkd = jnp.asarray(np.concatenate([pk, np.full(cp - npr, _I64_MAX,
                                                   dtype=np.int64)]))
     pvd = jnp.asarray(np.concatenate([~pnull, np.zeros(cp - npr, dtype=bool)]))
+    # supervised by the caller: executors.HashJoinExec wraps
+    # device_join_index in guarded_dispatch(site="join") with the host
+    # hash-join fallback on DeviceDegradedError
+    # tpulint: disable=unguarded-dispatch
     counts, lo, border = _phase1(bkd, bvd, pkd, pvd)
     if semi_only:
         return np.asarray(counts)[:npr] > 0, None
@@ -76,10 +82,13 @@ def device_join_index(bk: np.ndarray, bnull: np.ndarray,
     if total == 0:
         return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
     out_cap = shape_bucket(total)
-    expand = _EXPAND_CACHE.get((out_cap, cp))
-    if expand is None:
-        expand = _phase2(out_cap)
-        _EXPAND_CACHE[(out_cap, cp)] = expand
+    with _EXPAND_MU:
+        expand = _EXPAND_CACHE.get((out_cap, cp))
+        if expand is None:
+            expand = _phase2(out_cap)
+            _EXPAND_CACHE[(out_cap, cp)] = expand
+    # same supervision as _phase1 above (guarded at the executors site)
+    # tpulint: disable=unguarded-dispatch
     pi, bpos, valid = expand(counts, lo, border,
                              jnp.asarray(total, dtype=jnp.int64))
     prefetch(pi, bpos)

@@ -13,11 +13,12 @@ from __future__ import annotations
 
 import functools
 
-import numpy as np
 
 from ..utils import jaxcfg  # noqa: F401
 import jax
 import jax.numpy as jnp
+
+from ..utils import device_guard
 
 try:
     from jax.experimental import pallas as pl
@@ -85,7 +86,12 @@ def masked_sums(columns, mask, interpret: bool | None = None):
         jnp.pad(jnp.asarray(c, dtype=jnp.int64), (0, padded - n))
         for c in columns])
     m = jnp.pad(jnp.asarray(mask, dtype=bool), (0, padded - n))
-    out = _masked_sums_impl(data, m, interpret)
+    # supervised: pallas entry points are library kernels with no host
+    # twin of their own — a Mosaic compile failure or grant loss must
+    # surface as a classified DeviceDegradedError the caller can route
+    out = device_guard.guarded_dispatch(
+        lambda: _masked_sums_impl(data, m, interpret),
+        site="pallas/masked_sums")
     return out[:k], out[k]
 
 
@@ -158,7 +164,9 @@ def range_filter_sums(sum_cols, pred_cols, bounds, valid,
         for c in pred_cols])
     v = jnp.pad(jnp.asarray(valid, dtype=jnp.int64), (0, padded - n))
     b = jnp.asarray(bounds, dtype=jnp.int64).reshape(npred, 2)
-    out = _range_filter_sums_impl(data, preds, b, v, interpret)
+    out = device_guard.guarded_dispatch(
+        lambda: _range_filter_sums_impl(data, preds, b, v, interpret),
+        site="pallas/range_filter")
     return out[:k], out[k]
 
 
@@ -224,5 +232,7 @@ def dense_group_sums(value_cols, slots, nslots, valid,
         for c in value_cols])
     s = jnp.pad(jnp.asarray(slots, dtype=jnp.int64), (0, padded - n))
     v = jnp.pad(jnp.asarray(valid, dtype=jnp.int64), (0, padded - n))
-    out = _group_sums_impl(vals, s, v, int(nslots), interpret)
+    out = device_guard.guarded_dispatch(
+        lambda: _group_sums_impl(vals, s, v, int(nslots), interpret),
+        site="pallas/group_sums")
     return out[:k], out[k]

@@ -19,7 +19,6 @@ def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
 
 def shard_rows(mesh: Mesh, arr, axis: str = "dp"):
     """Place a host array row-sharded across the mesh (pads to divisor)."""
-    import jax.numpy as jnp
     n = len(mesh.devices.flat)
     rows = arr.shape[0]
     pad = (-rows) % n

@@ -21,7 +21,7 @@ def _walk_exprs(e, out):
 
 def _collect_plan(plan, acc):
     """acc: list of (table_info, db, {col_name: op})."""
-    from .physical import PhysTableReader, PhysHashJoin, PhysIndexRange
+    from .physical import PhysTableReader, PhysHashJoin
     if isinstance(plan, PhysTableReader):
         dag = plan.dag
         name_of = {sc.col.idx: sc.name for sc in dag.cols}

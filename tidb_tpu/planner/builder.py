@@ -10,9 +10,10 @@ from ..expression import (Expression, Column, Constant, ScalarFunc, AggDesc,
 from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
                                 new_decimal_type, new_string_type,
                                 agg_field_type)
-from ..errors import (UnsupportedError, NoDatabaseSelectedError,
-                      ColumnNotExistsError, NonUniqTableError,
-                      MixOfGroupFuncAndFieldsError)
+from ..errors import (UnsupportedError,
+                      NoDatabaseSelectedError,
+                      ColumnNotExistsError,
+                      NonUniqTableError)
 from .schema import Schema, SchemaCol
 from .logical import (LogicalPlan, DataSource, Selection, Projection,
                       Aggregation, LJoin, Sort, LimitOp, Dual, UnionOp,

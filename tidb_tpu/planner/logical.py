@@ -1,10 +1,9 @@
 """Logical plan operators (reference pkg/planner/core/operator/logicalop)."""
 from __future__ import annotations
 
-from dataclasses import dataclass, field
 
-from .schema import Schema, SchemaCol
-from ..expression import Expression, AggDesc, Column, ScalarFunc
+from .schema import Schema
+from ..expression import Expression, AggDesc, ScalarFunc
 
 
 def _minmax_key(e: Expression) -> Expression:

@@ -4,9 +4,9 @@ from __future__ import annotations
 import itertools
 
 from ..parser import ast
-from .builder import PlanBuilder, InsertPlan, UpdatePlan, DeletePlan
+from .builder import PlanBuilder
 from .rules import optimize_logical
-from .physical import to_physical, PhysPlan
+from .physical import to_physical
 
 
 class PlanContext:

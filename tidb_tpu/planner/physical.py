@@ -10,8 +10,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
-from ..expression import (Expression, Column, Constant, ScalarFunc,
-                          AggDesc, const_from_py)
+from ..expression import Column, Constant, ScalarFunc, AggDesc, const_from_py
 from ..expression.vec import is_device_safe
 from ..types.field_type import new_bigint_type
 from .schema import Schema, SchemaCol

@@ -9,8 +9,11 @@ scalar subqueries). Correlated subqueries are a planned round-2 item
 from __future__ import annotations
 
 from ..parser import ast
-from ..expression import (Expression, Column, Constant, ScalarFunc, AggDesc,
-                          const_from_py, const_null)
+from ..expression import (Expression,
+                          Constant,
+                          ScalarFunc,
+                          const_from_py,
+                          const_null)
 from ..expression.fold import fold_constants
 from ..types import FieldType
 from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
@@ -19,8 +22,7 @@ from ..types.field_type import (TypeClass, new_bigint_type, new_double_type,
                                 new_null_type, merge_field_type,
                                 agg_field_type)
 from ..types.datum import Datum, Kind
-from ..errors import (UnsupportedError, UnknownFunctionError,
-                      WrongArgCountError)
+from ..errors import UnsupportedError, WrongArgCountError
 from ..parser.parser import _DecimalLiteral
 
 _BOOL_FT = new_bigint_type()

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import threading
 
-from ..errors import (AccessDeniedError, PrivilegeCheckFailError, TiDBError)
+from ..errors import PrivilegeCheckFailError, TiDBError
 
 ALL_PRIVS = frozenset({
     "select", "insert", "update", "delete", "create", "drop", "alter",

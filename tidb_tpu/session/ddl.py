@@ -377,7 +377,7 @@ class DDLExecutor:
         if created is None:
             return
         # populate via the executor (fresh plan context/schema version)
-        from ..executor import build_executor, ExecContext
+        from ..executor import ExecContext
         from ..executor.dml import InsertExec
         from ..planner.builder import InsertPlan
         new_tbl = self.domain.infoschema().table_by_name(db_name,

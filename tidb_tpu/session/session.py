@@ -11,8 +11,8 @@ from ..planner.builder import InsertPlan, UpdatePlan, DeletePlan
 from ..planner.physical import explain_text
 from ..executor import build_executor, ExecContext
 from ..executor.dml import InsertExec, UpdateExec, DeleteExec
-from ..errors import TiDBError, UnsupportedError, NoDatabaseSelectedError
-from .sysvars import SessionVars, all_sysvars
+from ..errors import TiDBError, UnsupportedError
+from .sysvars import SessionVars
 from .domain import Domain
 from .ddl import DDLExecutor
 
@@ -303,7 +303,6 @@ class Session:
         """Materialize rows into a session temp table backed by the
         columnar engine (negative table id; read-latest)."""
         from ..models import TableInfo, ColumnInfo
-        from ..chunk.column import py_to_datum_fast
         tid = self._next_temp_id[0]
         self._next_temp_id[0] -= 1
         cols = [ColumnInfo(id=i + 1, name=cn, offset=i, ft=ft.clone())

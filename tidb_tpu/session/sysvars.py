@@ -5,6 +5,7 @@ vardef/tidb_vars.go). Scopes: GLOBAL / SESSION / both. The TPU toggle
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -67,10 +68,14 @@ def _env_int(name: str, default: int) -> int:
 
 
 _REGISTRY: dict[str, SysVar] = {}
+# plugins register sysvars after startup, concurrently with sessions
+# resolving them; reads stay lockless (GIL-atomic dict get)
+_REGISTRY_MU = threading.Lock()
 
 
 def register(var: SysVar):
-    _REGISTRY[var.name.lower()] = var
+    with _REGISTRY_MU:
+        _REGISTRY[var.name.lower()] = var
 
 
 def get_sysvar(name: str) -> SysVar:

@@ -8,7 +8,6 @@ import hashlib
 
 import numpy as np
 
-from ..types.field_type import TypeClass
 
 _TOPN = 20
 

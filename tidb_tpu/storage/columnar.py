@@ -20,10 +20,10 @@ from ..chunk.device import StringDict
 from ..codec.tablecodec import decode_record_key, TABLE_PREFIX, RECORD_PREFIX_SEP
 from ..codec.codec import decode_row_value
 from ..types.field_type import TypeClass
-from ..types.datum import Datum, Kind
 
 
 _CTAB_UID = [0]
+_CTAB_UID_MU = threading.Lock()  # concurrent CREATE TABLE / CTAS
 
 
 def _is_big_decimal(ft) -> bool:
@@ -40,8 +40,9 @@ class ColumnarTable:
     recycles addresses and the kernel/buffer caches would collide)."""
 
     def __init__(self, table_info):
-        _CTAB_UID[0] += 1
-        self.uid = _CTAB_UID[0]
+        with _CTAB_UID_MU:
+            _CTAB_UID[0] += 1
+            self.uid = _CTAB_UID[0]
         self.table_info = table_info
         self.n = 0
         self.cap = 0

@@ -13,7 +13,6 @@ from __future__ import annotations
 import bisect
 import threading
 
-from .kv import MemKV
 from ..native.memtable import new_memkv
 from ..errors import WriteConflictError, LockWaitTimeoutError
 from ..utils import failpoint

@@ -5,22 +5,25 @@ logical table id)."""
 from __future__ import annotations
 
 import copy
+import threading
 
 _PART_INFO_CACHE: dict = {}
+_PART_INFO_MU = threading.Lock()  # scans of one partitioned table can
+# run on several connection threads at once
 
 
 def partition_table_info(tbl, pid: int):
     """TableInfo clone with id=pid (cached) — the physical table handed to
     the columnar engine / copr for one partition."""
     key = (id(tbl), pid)
-    hit = _PART_INFO_CACHE.get(key)
+    hit = _PART_INFO_CACHE.get(key)     # lockless fast path
     if hit is not None:
         return hit
     clone = copy.copy(tbl)
     clone.id = pid
     clone.partitions = None
-    _PART_INFO_CACHE[key] = clone
-    return clone
+    with _PART_INFO_MU:
+        return _PART_INFO_CACHE.setdefault(key, clone)
 
 
 def partition_ids(tbl) -> list:

@@ -10,7 +10,6 @@ from __future__ import annotations
 import itertools
 import threading
 
-from .kv import MemKV
 from ..native.memtable import new_memkv
 from .mvcc import MVCCStore
 from ..utils import failpoint

@@ -18,7 +18,7 @@ import os
 
 import numpy as np
 
-from ..errors import TiDBError, DatabaseNotExistsError
+from ..errors import TiDBError
 from ..models import TableInfo
 from .objstore import open_storage, LocalStorage
 

@@ -17,6 +17,7 @@ call site is already written against the interface.
 from __future__ import annotations
 
 import os
+import threading
 
 
 class ExternalStorage:
@@ -81,11 +82,13 @@ class LocalStorage(ExternalStorage):
 
 # process-wide buckets: backup in one session, restore in another
 _MEM_BUCKETS: dict = {}
+_MEM_BUCKETS_MU = threading.Lock()
 
 
 class MemS3Storage(ExternalStorage):
     def __init__(self, bucket: str, prefix: str = ""):
-        self._objs = _MEM_BUCKETS.setdefault(bucket, {})
+        with _MEM_BUCKETS_MU:
+            self._objs = _MEM_BUCKETS.setdefault(bucket, {})
         self.prefix = prefix.strip("/")
 
     def _k(self, name):

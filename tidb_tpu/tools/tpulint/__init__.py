@@ -1,0 +1,35 @@
+"""tpulint — AST-based invariant analyzer for the tidb_tpu engine.
+
+Locks in, as machine-checked rules, the contracts that PR 1
+(device-failure supervision) and PR 2 (thread-local phase accounting,
+unified metrics registry) established by hand:
+
+  unguarded-dispatch   every device dispatch routes through
+                       device_guard.guarded_dispatch
+  jit-purity           traced/compiled functions stay pure: no host
+                       sync, no metrics/failpoint/log calls, no
+                       closure mutation
+  shared-state-race    module-level mutable state is mutated only
+                       under a lock (or lives in threading.local)
+  metrics-hygiene      instruments carry HELP text + static label
+                       sets; no interpolated label values
+  error-code-validity  referenced error attrs / sysvar names exist in
+                       their registries
+  unused-import        imports are referenced (the compileall + F401
+                       sweep of the PR gate)
+
+One AST walk per file (context.FileContext) feeds every rule; inline
+`# tpulint: disable=<rule>` waivers and a checked-in baseline file keep
+pre-existing, justified findings from blocking the strict gate.
+
+Usage:  python scripts/tpulint.py [--strict] [--json] [paths...]
+API:    from tidb_tpu.tools.tpulint import lint_paths, lint_source
+"""
+from .core import Finding, Rule, all_rules, get_rule, register_rule
+from .engine import LintConfig, lint_file, lint_paths, lint_source
+from .baseline import Baseline
+
+__all__ = [
+    "Finding", "Rule", "all_rules", "get_rule", "register_rule",
+    "LintConfig", "lint_file", "lint_paths", "lint_source", "Baseline",
+]

@@ -1,0 +1,111 @@
+"""Engine: file discovery, per-file lint, waiver/baseline application.
+
+The engine never imports the code under analysis — catalogs (error
+names, sysvar names) are themselves parsed from source, so tpulint runs
+without jax, without a TPU, and without executing package import-time
+side effects.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import rules as _rules  # noqa: F401 — rule registration
+from .baseline import Baseline
+from .context import FileContext
+from .core import Finding, all_rules
+from .rules.codes import parse_error_catalog, parse_sysvar_catalog
+
+
+class LintConfig:
+    def __init__(self, root=None, enabled=None, baseline=None,
+                 known_errors=None, known_sysvars=None, error_dups=None):
+        self.root = root or os.getcwd()
+        self.enabled = set(enabled) if enabled is not None else None
+        self.baseline = baseline or Baseline()
+        self.known_errors = known_errors
+        self.known_sysvars = known_sysvars
+        self.error_dups = error_dups
+
+    @classmethod
+    def for_package(cls, pkg_dir: str, root: str = None,
+                    baseline: Baseline = None,
+                    enabled=None) -> "LintConfig":
+        """Build catalogs by PARSING the package's registries."""
+        root = root or os.path.dirname(os.path.abspath(pkg_dir))
+        known_errors = known_sysvars = error_dups = None
+        epath = os.path.join(pkg_dir, "errors.py")
+        if os.path.exists(epath):
+            with open(epath, "r", encoding="utf-8") as f:
+                known_errors, error_dups = parse_error_catalog(f.read())
+        spath = os.path.join(pkg_dir, "session", "sysvars.py")
+        if os.path.exists(spath):
+            with open(spath, "r", encoding="utf-8") as f:
+                known_sysvars = parse_sysvar_catalog(f.read())
+        return cls(root=root, baseline=baseline, enabled=enabled,
+                   known_errors=known_errors,
+                   known_sysvars=known_sysvars, error_dups=error_dups)
+
+    def rules(self):
+        out = []
+        for name, rule in sorted(all_rules().items()):
+            if self.enabled is None or name in self.enabled:
+                out.append(rule)
+        return out
+
+
+def lint_source(src: str, relpath: str, config: LintConfig,
+                path: str = "") -> list:
+    """Lint one file's source -> [Finding] (waivers applied; findings
+    matching the baseline are KEPT but marked .baselined)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=relpath, line=e.lineno or 0,
+            col=e.offset or 0, severity="error",
+            message=f"syntax error: {e.msg}", context="<module>",
+            detail=f"syntax:{e.msg}")]
+    ctx = FileContext(path or relpath, relpath, src, tree)
+    ctx.config = config
+    findings = []
+    for rule in config.rules():
+        for f in rule.run(ctx):
+            if ctx.waived(f):
+                continue
+            config.baseline.absorb(f)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, config: LintConfig) -> list:
+    rel = os.path.relpath(path, config.root)
+    if rel.startswith(".."):
+        rel = os.path.basename(path)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, rel, config, path=path)
+
+
+def discover(paths) -> list:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and
+                           not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def lint_paths(paths, config: LintConfig) -> list:
+    findings = []
+    for path in discover(paths):
+        findings.extend(lint_file(path, config))
+    return findings

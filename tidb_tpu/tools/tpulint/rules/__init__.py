@@ -1,0 +1,7 @@
+"""Rule modules register themselves on import."""
+from . import dispatch     # noqa: F401
+from . import purity       # noqa: F401
+from . import race         # noqa: F401
+from . import hygiene      # noqa: F401
+from . import codes        # noqa: F401
+from . import imports      # noqa: F401

@@ -1,0 +1,68 @@
+"""unused-import: the F401 sweep of the PR gate.
+
+Unused imports in this codebase are not just noise: importing jax (or
+anything that transitively imports it) pays backend-registration cost
+in every worker process, and a stale `from ..errors import X` hides the
+moment X leaves the catalog. Conservative by design:
+
+  * `__init__.py` files are skipped wholesale (re-export surface);
+  * lines carrying `# noqa` are skipped (the `from ..utils import
+    jaxcfg  # noqa: F401` import-for-side-effect idiom);
+  * names in `__all__`, and `from __future__ import …`, are exempt;
+  * usage counts Name loads anywhere, including decorators, type
+    annotations, and nested scopes.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register_rule
+
+
+@register_rule
+class UnusedImport(Rule):
+    name = "unused-import"
+    severity = "warning"
+    doc = "imported name is never referenced"
+
+    def run(self, ctx):
+        if ctx.is_init:
+            return
+        used: set = set()
+        exported: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                used.add(node.id)
+        all_node = ctx.module_assigns.get("__all__")
+        if isinstance(all_node, (ast.List, ast.Tuple)):
+            for e in all_node.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    exported.add(e.value)
+        for alias, dotted, node in ctx.import_nodes:
+            if dotted.startswith("__future__"):
+                continue
+            if getattr(node, "lineno", 0) in ctx.noqa_lines:
+                continue
+            root = alias.split(".")[0]
+            if root in used or root in exported:
+                continue
+            # only module-level and function-level imports of THIS
+            # file's scope; conditional (try/except ImportError)
+            # imports often exist purely to probe availability
+            if self._in_try(ctx, node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"'{alias}' imported but unused (gate runs the "
+                f"compileall + F401 sweep; delete it or mark the "
+                f"side-effect import with # noqa)",
+                detail=f"import:{alias}")
+
+    @staticmethod
+    def _in_try(ctx, node):
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Try):
+                return True
+        return False

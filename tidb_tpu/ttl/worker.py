@@ -3,7 +3,6 @@ expired rows via internal SQL, paced by the timer framework; jobs run as
 DXF subtasks here)."""
 from __future__ import annotations
 
-import time
 
 _UNIT_SQL = {"second": "second", "minute": "minute", "hour": "hour",
              "day": "day", "week": "week", "month": "month", "year": "year"}

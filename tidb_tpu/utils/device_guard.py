@@ -98,8 +98,15 @@ _XLA_NAMES = frozenset({"XlaRuntimeError", "JaxRuntimeError",
 def classify(exc) -> str:
     """Map an exception from a device dispatch to an error class:
     grant_lost | resource_exhausted | wedged | transient | compile |
-    generic | fatal. `fatal` (semantic TiDBErrors — kill, quota,
-    constraint) is never retried and never degraded."""
+    degraded | generic | fatal. `fatal` (semantic TiDBErrors — kill,
+    quota, constraint) is never retried and never degraded.
+    `degraded` makes nested guards COMPOSE: an inner guarded_dispatch
+    that exhausted its own budget raises DeviceDegradedError, and the
+    outer guard must take its fallback immediately (no re-retry — the
+    inner guard already retried; and not `fatal`, which would skip the
+    outer host twin entirely)."""
+    if isinstance(exc, DeviceDegradedError):
+        return "degraded"
     if isinstance(exc, DeviceError):
         return exc.err_class
     if isinstance(exc, TiDBError):
@@ -375,11 +382,12 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
             out = _with_watchdog(attempt, timeout_ms, site)
             breaker.record_success()
             return out
-        except TiDBError:
-            raise
         except (KeyboardInterrupt, SystemExit, GeneratorExit):
             raise                       # process control, not device health
         except BaseException as exc:    # noqa: BLE001
+            if isinstance(exc, TiDBError) and \
+                    not isinstance(exc, DeviceDegradedError):
+                raise                   # statement semantics, not health
             err_class = classify(exc)
             attempts += 1
             _bump(domain, "device_dispatch_error")

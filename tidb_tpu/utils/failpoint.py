@@ -29,10 +29,16 @@ Examples:  "nth:1->error:grant_lost"   first dispatch fails, retry wins
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from ..errors import TiDBError
 
+# registry mutations hold _MU (chaos harnesses enable/disable from a
+# control thread while worker threads hit inject()); the hot-path read
+# in inject() stays lockless — dict.get is atomic under the GIL and a
+# stale read during enable/disable is inherent to async injection
+_MU = threading.Lock()
 _ACTIVE: dict = {}
 _ERROR_FACTORIES: dict = {}
 
@@ -45,7 +51,8 @@ class FailpointError(TiDBError):
 def register_error(name: str, factory) -> None:
     """Register `error:name` -> raise factory(). Lookup is late-bound:
     env-spec actions compile before the registering module imports."""
-    _ERROR_FACTORIES[name.lower()] = factory
+    with _MU:
+        _ERROR_FACTORIES[name.lower()] = factory
 
 
 def CRASH():
@@ -111,9 +118,11 @@ def _load_env():
             continue
         name, action = part.split("=", 1)
         try:
-            _ACTIVE[name.strip()] = _compile_action(action.strip())
+            cb = _compile_action(action.strip())
         except ValueError:
             continue
+        with _MU:
+            _ACTIVE[name.strip()] = cb
 
 
 _load_env()
@@ -122,15 +131,18 @@ _load_env()
 def enable(name: str, fn) -> None:
     if isinstance(fn, str):
         fn = _compile_action(fn)
-    _ACTIVE[name] = fn
+    with _MU:
+        _ACTIVE[name] = fn
 
 
 def disable(name: str) -> None:
-    _ACTIVE.pop(name, None)
+    with _MU:
+        _ACTIVE.pop(name, None)
 
 
 def disable_all() -> None:
-    _ACTIVE.clear()
+    with _MU:
+        _ACTIVE.clear()
     _load_env()
 
 

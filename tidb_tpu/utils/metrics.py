@@ -609,30 +609,43 @@ class TopSQL:
 
 _TRACKED_DOMAINS = weakref.WeakSet()
 _COMPAT_COUNTERS: dict = {}
+# WeakSet/compat-map mutation lock: domains register from whatever
+# thread constructs them, compat counters materialize lazily on the
+# first inc_metric of a name — both race with a concurrent scrape
+_DOMAINS_MU = threading.Lock()
 
 
 def track_domain(domain):
-    _TRACKED_DOMAINS.add(domain)
+    with _DOMAINS_MU:
+        _TRACKED_DOMAINS.add(domain)
 
 
 def compat_counter(name: str):
     """Unlabeled mirror counter for legacy `domain.inc_metric` names —
     the shim that puts every pre-registry call site on the /metrics
     page (sanitized) without touching its flat-dict readers."""
-    child = _COMPAT_COUNTERS.get(name)
+    child = _COMPAT_COUNTERS.get(name)   # lockless fast path
     if child is None:
-        base = "tidb_tpu_" + sanitize_name(name)
-        with REGISTRY._mu:
-            taken = base in REGISTRY._instruments
-        if taken:
-            # a typed instrument owns this name (e.g. a flat
-            # 'connections' vs the connections Gauge): a kind/label
-            # clash must park the legacy series, never crash the bump
-            base += "_legacy"
-        inst = REGISTRY.counter(
-            base, f"legacy flat counter {name!r} (domain.inc_metric)")
-        inst._compat = True
-        child = _COMPAT_COUNTERS[name] = inst.labels()
+        with _DOMAINS_MU:
+            child = _COMPAT_COUNTERS.get(name)
+            if child is not None:
+                return child
+            base = "tidb_tpu_" + sanitize_name(name)
+            with REGISTRY._mu:
+                taken = base in REGISTRY._instruments
+            if taken:
+                # a typed instrument owns this name (e.g. a flat
+                # 'connections' vs the connections Gauge): a kind/label
+                # clash must park the legacy series, never crash the bump
+                base += "_legacy"
+            # tpulint: disable=metrics-hygiene — the compat shim's name
+            # and HELP are dynamic BY DESIGN: it mirrors the bounded set
+            # of legacy domain.inc_metric slugs (code constants, never
+            # user data) onto the exposition page
+            inst = REGISTRY.counter(
+                base, f"legacy flat counter {name!r} (domain.inc_metric)")
+            inst._compat = True
+            child = _COMPAT_COUNTERS[name] = inst.labels()
     return child
 
 
@@ -660,8 +673,10 @@ def reset_all():
     """Test hook: zero the registry and every live Domain's flat metric
     dict + Top SQL ring (fixture in tests/conftest.py)."""
     REGISTRY.reset()
-    _COMPAT_COUNTERS.clear()
-    for d in list(_TRACKED_DOMAINS):
+    with _DOMAINS_MU:
+        _COMPAT_COUNTERS.clear()
+        domains = list(_TRACKED_DOMAINS)
+    for d in domains:
         try:
             d.metrics.clear()
             d.top_sql.clear()
