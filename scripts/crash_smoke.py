@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Crash smoke: kill -9 (failpoint CRASH) at EVERY transaction
+failpoint site x every commit mode {2PC, 1PC, async}, then restart from
+checkpoint+WAL, run a lock-resolver sweep, and assert atomic
+all-or-nothing visibility across the row store, the columnar engine,
+and secondary indexes — with zero orphaned locks and a monotonic
+oracle (ISSUE 4 acceptance; ROADMAP verify notes).
+
+Each case runs a child process that opens a durable store, commits
+acknowledged baseline rows, arms one crash failpoint, and drives a
+multi-key explicit transaction into it (rc=137). The parent reopens the
+data dir in-process and checks:
+
+  * the doomed txn is ALL-or-NOTHING: either every effect (update of 3
+    rows + insert + delete, and their index entries) or none;
+  * sites past the durability point (2pc-commit-after-wal,
+    async-commit-prewrite-durable) recovered COMMITTED, sites before it
+    recovered LOST;
+  * ``ADMIN CHECK TABLE`` passes (row store == indexes == columnar);
+  * the resolver sweep finds nothing and no locks linger;
+  * a post-recovery commit allocates a fresh ts (no reuse) and is
+    visible.
+
+A randomized mode rides the ``prob:P`` failpoint term, seeded via
+TIDB_TPU_FAILPOINT_SEED so a failing run replays bit-identically.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/crash_smoke.py [--quick]
+Env:    CRASH_SMOKE_SEED (4 — a seed whose first draw fires, so the
+        default run exercises a real randomized crash),
+        CRASH_SMOKE_TIMEOUT_S (180)
+Exit:   0 all cases atomic+clean; 1 any violation.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# (mode, failpoint site, expected recovery, extra setup). The
+# 2pc-commit-after-wal case cuts an ADMIN CHECKPOINT first, so its
+# recovery replays checkpoint + WAL tail instead of WAL alone.
+CASES = [
+    ("2pc", "2pc-prewrite-done", "lost", []),
+    ("2pc", "2pc-commit-before-wal", "lost", []),
+    ("2pc", "2pc-commit-after-wal", "committed", ["admin checkpoint"]),
+    ("1pc", "1pc-before-wal", "lost", []),
+    ("async", "2pc-prewrite-done", "lost", []),
+    ("async", "async-commit-prewrite-durable", "committed", []),
+]
+
+MODE_SETUP = {
+    "2pc": ["set @@tidb_enable_1pc = 0",
+            "set @@tidb_enable_async_commit = 0"],
+    "1pc": [],                                  # default ladder picks 1PC
+    "async": ["set @@tidb_enable_1pc = 0"],
+}
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.utils import failpoint
+dom = new_store({dd!r}, wal_sync=True)
+s = Session(dom)
+s.vars.current_db = "test"
+for stmt in {setup!r}:
+    s.execute(stmt)
+print("ACK-SETUP", flush=True)
+failpoint.enable({fp!r}, {action!r})
+try:
+    for stmt in {doomed!r}:
+        s.execute(stmt)
+except SystemExit:
+    raise
+except Exception as e:
+    print("ERR " + type(e).__name__ + ": " + str(e)[:200], flush=True)
+print("SURVIVED", flush=True)
+"""
+
+BASE_SETUP = [
+    "create table t (a int primary key, b int, key ib (b))",
+    "insert into t values (0, 0), (1, 10), (2, 20), (3, 30)",
+]
+
+# one explicit multi-key txn: 3-row update + insert + delete, all of it
+# hitting the secondary index too — the atomicity unit under test
+DOOMED = [
+    "begin",
+    "update t set b = b + 1 where a between 1 and 3",
+    "insert into t values (99, 990)",
+    "delete from t where a = 0",
+    "commit",
+]
+
+ORIG = [(0, 0), (1, 10), (2, 20), (3, 30)]
+COMMITTED = [(1, 11), (2, 21), (3, 31), (99, 990)]
+
+
+def run_child(dd, setup, fp, action, timeout):
+    script = _CHILD.format(repo=_REPO, dd=dd, setup=setup, fp=fp,
+                           action=action, doomed=DOOMED)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, timeout=timeout, env=env)
+
+
+def check_recovered(dd, expect, label, failures):
+    from tidb_tpu.session import new_store, Session
+    dom = new_store(dd)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    mvcc = dom.storage.mvcc
+    swept = mvcc.resolver.sweep(force=True)
+    if mvcc._locks:
+        failures.append(f"{label}: {len(mvcc._locks)} orphaned locks "
+                        f"after restart+sweep (swept={swept})")
+    rows = s.execute("select a, b from t order by a").rows
+    state = ("committed" if rows == COMMITTED
+             else "lost" if rows == ORIG else "TORN")
+    if state == "TORN":
+        failures.append(f"{label}: torn txn visible: {rows}")
+    elif expect != "either" and state != expect:
+        failures.append(f"{label}: expected {expect} after recovery, "
+                        f"got {state} ({rows})")
+    # secondary index agrees with the row store, both states
+    probe_b = 990 if state == "committed" else 0
+    want_a = 99 if state == "committed" else 0
+    via_idx = s.execute(
+        f"select a from t where b = {probe_b}").rows
+    if via_idx != [(want_a,)]:
+        failures.append(f"{label}: index probe b={probe_b} -> {via_idx}")
+    try:
+        s.execute("admin check table t")
+    except Exception as e:                      # noqa: BLE001
+        failures.append(f"{label}: ADMIN CHECK TABLE failed: {e}")
+    # oracle monotonicity: a fresh commit must win a fresh ts and stick
+    pre = dom.storage.current_ts()
+    s.execute("insert into t values (500, 5000)")
+    if s.execute("select b from t where a = 500").rows != [(5000,)]:
+        failures.append(f"{label}: post-recovery commit not visible")
+    if dom.storage.current_ts() <= pre:
+        failures.append(f"{label}: oracle went backwards")
+    mvcc.wal.close()
+    return state
+
+
+def main():
+    quick = "--quick" in sys.argv
+    timeout = float(os.environ.get("CRASH_SMOKE_TIMEOUT_S", "180"))
+    seed = os.environ.get("CRASH_SMOKE_SEED", "4")
+    failures = []
+    cases = CASES[:3] if quick else CASES
+    with tempfile.TemporaryDirectory(prefix="crash_smoke_") as tmp:
+        for i, (mode, fp, expect, extra) in enumerate(cases):
+            dd = os.path.join(tmp, f"dd_{i}")
+            label = f"{mode}/{fp}"
+            t0 = time.time()
+            r = run_child(dd, BASE_SETUP + extra + MODE_SETUP[mode], fp,
+                          "crash", timeout)
+            out = r.stdout.decode()
+            if "ACK-SETUP" not in out:
+                failures.append(f"{label}: child setup failed: "
+                                f"{r.stderr.decode()[-300:]}")
+                continue
+            if r.returncode != 137 or "SURVIVED" in out:
+                failures.append(
+                    f"{label}: crash failpoint did not fire "
+                    f"(rc={r.returncode}, out={out[-200:]!r}) — site "
+                    f"not on this commit mode's path")
+                continue
+            state = check_recovered(dd, expect, label, failures)
+            print(f"# {label}: crashed rc=137, recovered {state} "
+                  f"({time.time() - t0:.1f}s)", file=sys.stderr)
+
+        if not quick:
+            # randomized mode: prob:P crash over repeated autocommit
+            # txns; whatever the (seeded, reproducible) dice decide,
+            # recovery must be consistent
+            dd = os.path.join(tmp, "dd_rand")
+            env_seed = dict(os.environ)
+            os.environ["TIDB_TPU_FAILPOINT_SEED"] = seed
+            try:
+                r = run_child(
+                    dd, BASE_SETUP + MODE_SETUP["2pc"],
+                    "2pc-commit-before-wal", "prob:0.4->crash", timeout)
+            finally:
+                os.environ.clear()
+                os.environ.update(env_seed)
+            label = f"random(seed={seed})"
+            if "ACK-SETUP" not in r.stdout.decode():
+                failures.append(f"{label}: child setup failed")
+            else:
+                state = check_recovered(dd, "either", label, failures)
+                print(f"# {label}: rc={r.returncode}, recovered {state}",
+                      file=sys.stderr)
+
+    if failures:
+        print("CRASH SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    n = len(cases) + (0 if quick else 1)
+    print(f"CRASH SMOKE OK: {n} crash-point cases atomic "
+          "all-or-nothing across row store + columnar + indexes, zero "
+          "orphaned locks, oracle monotonic", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Deadlock detection (ISSUE 4 tentpole): two-session cycles over the
+pessimistic DML path, youngest-txn victim selection (ER 1213 / 40001),
+InnoDB-style whole-txn rollback of the victim, survivor progress, and
+the information_schema.deadlocks / data_lock_waits surfaces."""
+import threading
+import time
+
+from tidb_tpu.errors import DeadlockError
+from tidb_tpu.testkit import TestKit
+
+
+def _two_sessions():
+    tk = TestKit()
+    tk.must_exec("create table dl (a int primary key, b int)")
+    tk.must_exec("insert into dl values (1, 10), (2, 20)")
+    s1 = tk.new_session()
+    s2 = tk.new_session()
+    for s in (s1, s2):
+        s.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 5000")
+    return tk, s1, s2
+
+
+def test_two_session_deadlock_youngest_victim_requester():
+    """s1 (older) holds r1 and waits for r2; s2 (younger) holds r2 and
+    requests r1, closing the cycle — s2 IS the youngest, gets ER 1213
+    immediately, and the survivor commits."""
+    tk, s1, s2 = _two_sessions()
+    s1.must_exec("begin")
+    s1.must_exec("update dl set b = 11 where a = 1")      # lock r1
+    s2.must_exec("begin")
+    s2.must_exec("update dl set b = 21 where a = 2")      # lock r2
+    done = {}
+
+    def s1_second():
+        try:
+            s1.must_exec("update dl set b = 12 where a = 2")  # waits on s2
+            done["s1"] = "ok"
+        except Exception as e:                  # noqa: BLE001
+            done["s1"] = type(e).__name__
+    th = threading.Thread(target=s1_second)
+    th.start()
+    time.sleep(0.2)                # let s1 enqueue its wait edge
+    e = s2.exec_err("update dl set b = 22 where a = 1")   # closes cycle
+    assert isinstance(e, DeadlockError)
+    assert e.code == 1213 and e.sqlstate == "40001"
+    th.join(timeout=10)
+    assert done.get("s1") == "ok"  # survivor's wait was released
+    s1.must_exec("commit")
+    assert tk.must_query("select a, b from dl order by a").rs.rows == \
+        [(1, 11), (2, 12)]
+    # exactly one victim: s2's txn was rolled back wholesale (InnoDB
+    # semantics) — its earlier update is gone, and the session can
+    # start fresh
+    s2.must_exec("update dl set b = 99 where a = 2")
+    assert tk.must_query("select b from dl where a = 2").rs.rows == \
+        [(99,)]
+
+
+def test_two_session_deadlock_remote_victim():
+    """Cycle closed by the OLDER txn: the youngest (already waiting) is
+    flagged as victim and its wait raises ER 1213; the older requester
+    proceeds once the victim's locks release."""
+    tk, s1, s2 = _two_sessions()
+    s1.must_exec("begin")          # s1 begins first -> older
+    s1.must_exec("update dl set b = 11 where a = 1")
+    s2.must_exec("begin")          # s2 younger
+    s2.must_exec("update dl set b = 21 where a = 2")
+    done = {}
+
+    def s2_second():
+        try:
+            s2.must_exec("update dl set b = 22 where a = 1")  # waits on s1
+            done["s2"] = "ok"
+        except Exception as e:                  # noqa: BLE001
+            done["s2"] = e
+    th = threading.Thread(target=s2_second)
+    th.start()
+    time.sleep(0.2)
+    # s1 closes the cycle; the younger s2 (waiting in the thread) is
+    # chosen as victim, so s1's own wait succeeds
+    s1.must_exec("update dl set b = 12 where a = 2")
+    th.join(timeout=10)
+    assert isinstance(done.get("s2"), DeadlockError)
+    assert done["s2"].code == 1213
+    s1.must_exec("commit")
+    assert tk.must_query("select a, b from dl order by a").rs.rows == \
+        [(1, 11), (2, 12)]
+
+
+def test_deadlock_recorded_in_information_schema():
+    tk, s1, s2 = _two_sessions()
+    s1.must_exec("begin")
+    s1.must_exec("update dl set b = 1 where a = 1")
+    s2.must_exec("begin")
+    s2.must_exec("update dl set b = 2 where a = 2")
+    th = threading.Thread(
+        target=lambda: s1.must_exec("update dl set b = 1 where a = 2"))
+    th.start()
+    time.sleep(0.2)
+    e = s2.exec_err("update dl set b = 2 where a = 1")
+    assert isinstance(e, DeadlockError)
+    th.join(timeout=10)
+    s1.must_exec("commit")
+    rows = tk.must_query(
+        "select deadlock_id, try_lock_trx_id, trx_holding_lock "
+        "from information_schema.deadlocks").rs.rows
+    assert rows, "deadlock cycle not recorded"
+    # the cycle's rows share one deadlock id and include both txns
+    did = rows[-1][0]
+    cycle = [r for r in rows if r[0] == did]
+    assert len(cycle) == 2
+    waiters = {r[1] for r in cycle}
+    holders = {r[2] for r in cycle}
+    assert waiters == holders and len(waiters) == 2
+
+
+def test_data_lock_waits_snapshot():
+    tk, s1, s2 = _two_sessions()
+    s1.must_exec("begin")
+    s1.must_exec("update dl set b = 1 where a = 1")
+    seen = {}
+
+    def blocked():
+        try:
+            s2.must_exec("update dl set b = 2 where a = 1")
+            seen["out"] = "ok"
+        except Exception as e:                  # noqa: BLE001
+            seen["out"] = e
+    s2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 5000")
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.3)               # s2 is parked in the wait queue
+    rows = tk.must_query(
+        "select trx_id, current_holding_trx_id from "
+        "information_schema.data_lock_waits").rs.rows
+    assert len(rows) == 1
+    waiter, holder = rows[0]
+    assert holder == s1.sess._txn.start_ts and waiter != holder
+    s1.must_exec("rollback")      # release -> s2 acquires and finishes
+    th.join(timeout=10)
+    assert seen.get("out") == "ok"
+    # queue drained
+    assert tk.must_query(
+        "select count(*) from information_schema.data_lock_waits"
+    ).rs.rows == [(0,)]
+
+
+def test_select_for_update_deadlock():
+    """The cycle forms through SELECT ... FOR UPDATE locks too."""
+    tk, s1, s2 = _two_sessions()
+    s1.must_exec("begin")
+    s1.must_query("select * from dl where a = 1 for update")
+    s2.must_exec("begin")
+    s2.must_query("select * from dl where a = 2 for update")
+    th = threading.Thread(
+        target=lambda: s1.must_query(
+            "select * from dl where a = 2 for update"))
+    th.start()
+    time.sleep(0.2)
+    e = s2.exec_err("select * from dl where a = 1 for update")
+    assert isinstance(e, DeadlockError) and e.code == 1213
+    th.join(timeout=10)
+    s1.must_exec("commit")
+
+
+def test_deadlock_metrics():
+    from tidb_tpu.utils import metrics as metrics_util
+    tk, s1, s2 = _two_sessions()
+    before = metrics_util.DEADLOCKS._default().value
+    s1.must_exec("begin")
+    s1.must_exec("update dl set b = 1 where a = 1")
+    s2.must_exec("begin")
+    s2.must_exec("update dl set b = 2 where a = 2")
+    th = threading.Thread(
+        target=lambda: s1.must_exec("update dl set b = 1 where a = 2"))
+    th.start()
+    time.sleep(0.2)
+    assert isinstance(s2.exec_err("update dl set b = 2 where a = 1"),
+                      DeadlockError)
+    th.join(timeout=10)
+    s1.must_exec("commit")
+    assert metrics_util.DEADLOCKS._default().value == before + 1

@@ -1,0 +1,378 @@
+"""Percolator lock resolution (ISSUE 4 tentpole): check_txn_status,
+TTL expiry + heartbeat, rollback tombstones, secondary resolution, the
+lock-wait queue's ER 1205 deadline, and orphaned-lock liveness — a
+reader AND a writer both make progress over a crashed writer's locks."""
+import time
+
+import pytest
+
+from tidb_tpu.storage import Storage
+from tidb_tpu.storage.lock_resolver import LockCtx
+from tidb_tpu.errors import (WriteConflictError, LockWaitTimeoutError,
+                             DeadlockError)
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+
+
+def _seed(s):
+    t = s.begin()
+    t.set(b"k1", b"v1")
+    t.set(b"k2", b"v2")
+    return t.commit()
+
+
+# ---- txn status oracle ------------------------------------------------
+
+def test_check_txn_status_committed():
+    s = Storage()
+    t = s.begin()
+    t.set(b"k1", b"v1")
+    commit_ts = t.commit()
+    st = s.mvcc.resolver.check_txn_status(b"k1", t.start_ts)
+    assert st.state == "committed" and st.commit_ts == commit_ts
+
+
+def test_check_txn_status_alive_then_expired():
+    s = Storage()
+    _seed(s)
+    dead = s.begin()
+    s.mvcc.prewrite([(b"k1", b"n1")], b"k1", dead.start_ts,
+                    ctx=LockCtx(ttl_ms=120))
+    st = s.mvcc.resolver.check_txn_status(b"k1", dead.start_ts)
+    assert st.state == "alive"
+    time.sleep(0.15)
+    st = s.mvcc.resolver.check_txn_status(b"k1", dead.start_ts)
+    assert st.state == "rolled_back"
+    assert b"k1" not in s.mvcc._locks          # primary rolled back
+    assert dead.start_ts in s.mvcc._rolled_back
+
+
+def test_check_txn_status_rolled_back_after_user_rollback():
+    s = Storage()
+    _seed(s)
+    t = s.begin()
+    t.set(b"k1", b"x")
+    t.rollback()
+    st = s.mvcc.resolver.check_txn_status(b"k1", t.start_ts)
+    assert st.state == "rolled_back"
+
+
+# ---- rollback tombstones ---------------------------------------------
+
+def test_late_commit_of_resolved_txn_fails():
+    """A txn the resolver rolled back must NOT resurrect: its late
+    commit()/prewrite() hit the rollback tombstone."""
+    s = Storage()
+    _seed(s)
+    dead = s.begin()
+    muts = [(b"k1", b"n1")]
+    s.mvcc.prewrite(muts, b"k1", dead.start_ts, ctx=LockCtx(ttl_ms=60))
+    time.sleep(0.08)
+    s.mvcc.resolver.check_txn_status(b"k1", dead.start_ts)  # expires it
+    with pytest.raises(WriteConflictError):
+        s.mvcc.commit(muts, dead.start_ts, s.oracle.get_ts())
+    with pytest.raises(WriteConflictError):
+        s.mvcc.prewrite(muts, b"k1", dead.start_ts)
+    assert s.begin().get(b"k1") == b"v1"       # old value intact
+
+
+def test_post_commit_leftover_release_writes_no_tombstone():
+    """Pure FOR UPDATE locks released after a successful commit must
+    not mark the committed txn as rolled back."""
+    s = Storage()
+    _seed(s)
+    t = s.begin()
+    t.lock_keys([b"k2"])        # never written
+    t.set(b"k1", b"w")
+    t.commit()
+    st = s.mvcc.resolver.check_txn_status(b"k1", t.start_ts)
+    assert st.state == "committed"
+    assert t.start_ts not in s.mvcc._rolled_back
+
+
+# ---- secondary resolution --------------------------------------------
+
+def test_resolver_commits_secondary_of_committed_primary():
+    """A secondary lock whose primary committed is resolved by APPLYING
+    the prewritten value at the primary's commit_ts (TiKV short-value
+    resolution), not by dropping it."""
+    s = Storage()
+    _seed(s)
+    t = s.begin()
+    s.mvcc.prewrite([(b"k1", b"c1"), (b"k2", b"c2")], b"k1", t.start_ts)
+    commit_ts = s.oracle.get_ts()
+    # commit ONLY the primary (simulates dying between the two commit
+    # halves of a distributed 2PC)
+    s.mvcc.commit([(b"k1", b"c1")], t.start_ts, commit_ts)
+    assert b"k2" in s.mvcc._locks
+    # a reader trips on the k2 lock and resolves it forward
+    assert s.mvcc.get(b"k2", s.oracle.get_ts()) == b"c2"
+    assert b"k2" not in s.mvcc._locks
+
+
+def test_resolver_sweep_counts():
+    s = Storage()
+    _seed(s)
+    dead = s.begin()
+    s.mvcc.prewrite([(b"k1", b"n1"), (b"k2", b"n2")], b"k1",
+                    dead.start_ts, ctx=LockCtx(ttl_ms=40))
+    time.sleep(0.06)
+    out = s.mvcc.resolver.sweep()
+    assert not s.mvcc._locks
+    assert out.get("rolled_back", 0) >= 1
+    # live locks survive a non-forced sweep
+    t2 = s.begin()
+    s.mvcc.acquire_pessimistic_lock(b"k1", b"k1", t2.start_ts,
+                                    t2.for_update_ts)
+    assert s.mvcc.resolver.sweep() == {}
+    assert b"k1" in s.mvcc._locks
+
+
+# ---- TTL heartbeat ----------------------------------------------------
+
+def test_txn_heartbeat_extends_ttl():
+    s = Storage()
+    _seed(s)
+    t = s.begin()
+    s.mvcc.prewrite([(b"k1", b"h1")], b"k1", t.start_ts,
+                    ctx=LockCtx(ttl_ms=150))
+    for _ in range(3):
+        time.sleep(0.08)
+        assert s.mvcc.txn_heartbeat(t.start_ts, 150) == 1
+    # 0.24s elapsed > original 150ms TTL, but heartbeats kept it alive
+    st = s.mvcc.resolver.check_txn_status(b"k1", t.start_ts)
+    assert st.state == "alive"
+
+
+def test_session_statement_heartbeat():
+    """Each statement in an explicit txn bumps the lock deadlines."""
+    tk = TestKit()
+    tk.must_exec("create table hb (a int primary key, b int)")
+    tk.must_exec("insert into hb values (1, 10)")
+    tk.must_exec("set @@tidb_tpu_lock_ttl_ms = 200")
+    tk.must_exec("begin")
+    tk.must_query("select * from hb where a = 1 for update")
+    txn = tk.sess._txn
+    for _ in range(3):
+        time.sleep(0.12)
+        tk.must_query("select 1")      # statement-driven heartbeat
+    st = tk.domain.storage.mvcc.resolver.check_txn_status(
+        next(iter(tk.domain.storage.mvcc._locks)), txn.start_ts)
+    assert st.state == "alive"
+    tk.must_exec("commit")
+
+
+# ---- orphaned-lock liveness (acceptance criterion) --------------------
+
+def test_orphan_liveness_reader_and_writer_recover():
+    """Writer 'crashes' after prewrite (locks left, no commit): a
+    concurrent reader and a concurrent writer BOTH complete within the
+    statement budget via TTL expiry + check_txn_status — no permanent
+    ER 1205."""
+    tk = TestKit()
+    tk.must_exec("create table ol (a int primary key, b int)")
+    tk.must_exec("insert into ol values (1, 10), (2, 20)")
+    dom = tk.domain
+    store = dom.storage
+    info = dom.infoschema().table_by_name("test", "ol")
+    from tidb_tpu.codec.tablecodec import record_key
+    from tidb_tpu.codec.codec import encode_row_value
+    from tidb_tpu.types.datum import Datum, Kind
+    k1 = record_key(info.id, 1)
+    crashed = store.begin()
+    val = encode_row_value([Datum(Kind.INT, 1), Datum(Kind.INT, 99)])
+    store.mvcc.prewrite([(k1, val)], k1, crashed.start_ts,
+                        ctx=LockCtx(ttl_ms=150))
+    assert store.mvcc._locks
+    tk.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 3000")
+    t0 = time.time()
+    # reader: blocks until TTL expiry, resolves, returns the OLD value
+    assert tk.must_query("select b from ol where a = 1").rs.rows == \
+        [(10,)]
+    assert time.time() - t0 < 3.0
+    # writer: the lock is already resolved; plain update goes through
+    tk.must_exec("update ol set b = 11 where a = 1")
+    assert tk.must_query("select b from ol where a = 1").rs.rows == \
+        [(11,)]
+    assert not store.mvcc._locks
+    # the crashed txn can never resurrect
+    with pytest.raises(WriteConflictError):
+        store.mvcc.commit([(k1, val)], crashed.start_ts,
+                          store.oracle.get_ts())
+
+
+# ---- wait-queue deadline / ER 1205 ------------------------------------
+
+def test_lock_wait_timeout_code_and_sqlstate():
+    tk = TestKit()
+    tk.must_exec("create table lw (a int primary key, b int)")
+    tk.must_exec("insert into lw values (1, 10)")
+    tk2 = tk.new_session()
+    tk2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 120")
+    tk.must_exec("begin")
+    tk.must_query("select * from lw where a = 1 for update")
+    t0 = time.time()
+    e = tk2.exec_err("update lw set b = 2 where a = 1")
+    assert isinstance(e, LockWaitTimeoutError)
+    assert e.code == 1205 and e.sqlstate == "HY000"
+    assert 0.1 < time.time() - t0 < 2.0
+    tk.must_exec("rollback")
+
+
+def test_writer_waits_through_holder_commit():
+    """A blocked writer whose holder COMMITS mid-wait retries and wins
+    (write-conflict retry loop) instead of timing out."""
+    import threading
+    tk = TestKit()
+    tk.must_exec("create table ww (a int primary key, b int)")
+    tk.must_exec("insert into ww values (1, 0)")
+    tk2 = tk.new_session()
+    tk2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 4000")
+    tk.must_exec("begin")
+    tk.must_exec("update ww set b = 1 where a = 1")
+
+    def release():
+        time.sleep(0.2)
+        tk.must_exec("commit")
+    th = threading.Thread(target=release)
+    th.start()
+    tk2.must_exec("update ww set b = 2 where a = 1")   # blocks, then wins
+    th.join()
+    assert tk.must_query("select b from ww").rs.rows == [(2,)]
+
+
+# ---- pessimistic lock expiry dooms the holder -------------------------
+
+def test_expired_pessimistic_txn_cannot_commit():
+    """s1 FOR UPDATE + buffered write, TTL expires, s2 resolves the
+    lock and writes; s1's commit must fail (tombstone), not resurrect."""
+    tk = TestKit()
+    tk.must_exec("create table pe (a int primary key, b int)")
+    tk.must_exec("insert into pe values (1, 10)")
+    tk.must_exec("set @@tidb_tpu_lock_ttl_ms = 100")
+    tk.must_exec("begin")
+    tk.must_query("select * from pe where a = 1 for update")
+    tk.must_exec("update pe set b = 50 where a = 1")
+    time.sleep(0.15)          # idle past the TTL, no heartbeat
+    tk2 = tk.new_session()
+    tk2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 2000")
+    tk2.must_exec("update pe set b = 77 where a = 1")  # resolves s1
+    e = tk.exec_err("commit")
+    assert isinstance(e, WriteConflictError)
+    assert tk.must_query("select b from pe").rs.rows == [(77,)]
+
+
+# ---- failpoint prob:P term (satellite) --------------------------------
+
+def test_failpoint_prob_seeded_reproducible(monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_FAILPOINT_SEED", "1234")
+
+    def pattern():
+        failpoint.enable("prob-test", "prob:0.5->error")
+        hits = []
+        for _ in range(32):
+            try:
+                failpoint.inject("prob-test")
+                hits.append(0)
+            except failpoint.FailpointError:
+                hits.append(1)
+        failpoint.disable("prob-test")
+        return hits
+
+    a = pattern()
+    b = pattern()
+    assert a == b                      # same seed -> same firing pattern
+    assert 0 < sum(a) < 32             # actually probabilistic
+    monkeypatch.setenv("TIDB_TPU_FAILPOINT_SEED", "5678")
+    c = pattern()
+    assert c != a                      # seed participates in the stream
+
+
+def test_failpoint_prob_validation():
+    with pytest.raises(ValueError):
+        failpoint.enable("bad-prob", "prob:1.5->error")
+
+
+# ---- error-path hygiene ----------------------------------------------
+
+def test_deadlock_error_catalog_entry():
+    assert DeadlockError.code == 1213
+    assert DeadlockError.sqlstate == "40001"
+    assert LockWaitTimeoutError.code == 1205
+    assert LockWaitTimeoutError.sqlstate == "HY000"
+
+
+# ---- async commit point is irreversible -------------------------------
+
+def test_async_orphan_resolves_committed_not_rolled_back():
+    """An orphaned async-commit lock (min_commit_ts set — the durable
+    prewrite already happened) must resolve as COMMITTED, never rolled
+    back: crash replay would commit it, and live state must agree."""
+    s = Storage()
+    _seed(s)
+    t = s.begin()
+    commit_ts = s.oracle.get_ts()
+    s.mvcc.prewrite([(b"k1", b"a1"), (b"k2", b"a2")], b"k1",
+                    t.start_ts, min_commit_ts=commit_ts,
+                    ctx=LockCtx(ttl_ms=50))
+    time.sleep(0.07)          # even past TTL: still committed
+    st = s.mvcc.resolver.check_txn_status(b"k1", t.start_ts)
+    assert st.state == "committed" and st.commit_ts == commit_ts
+    # a reader resolves both keys FORWARD to the new values
+    rts = s.oracle.get_ts()
+    assert s.mvcc.get(b"k1", rts) == b"a1"
+    assert s.mvcc.get(b"k2", rts) == b"a2"
+    assert not s.mvcc._locks
+    # rollback of a past-commit-point txn is a refused no-op
+    t2 = s.begin()
+    cts2 = s.oracle.get_ts()
+    s.mvcc.prewrite([(b"k1", b"z1")], b"k1", t2.start_ts,
+                    min_commit_ts=cts2)
+    s.mvcc.rollback([b"k1"], t2.start_ts)
+    assert b"k1" in s.mvcc._locks
+    assert t2.start_ts not in s.mvcc._rolled_back
+
+
+def test_async_error_after_commit_point_still_commits():
+    """An injected (non-crash) failure at the async durability point
+    must surface the error WITHOUT aborting: live state matches what
+    crash replay would rebuild (review finding: live/restart
+    divergence)."""
+    tk = TestKit()
+    tk.must_exec("create table ac (a int primary key, b int)")
+    tk.must_exec("set @@tidb_enable_1pc = 0")    # pin the async path
+    failpoint.enable("async-commit-prewrite-durable", "error")
+    try:
+        err = tk.exec_err("insert into ac values (1, 10)")
+        assert "injected" in str(err)
+    finally:
+        failpoint.disable("async-commit-prewrite-durable")
+    # past the commit point: the txn IS committed despite the error
+    assert tk.must_query("select b from ac where a = 1").rs.rows == \
+        [(10,)]
+    assert not tk.domain.storage.mvcc._locks
+
+
+def test_nowait_resolves_expired_orphan():
+    """NOWAIT / SKIP LOCKED must resolve a DECIDED or EXPIRED holder
+    (and then succeed) rather than fast-failing forever on an orphaned
+    lock — only an ALIVE holder earns ER 3572 (review finding)."""
+    from tidb_tpu.errors import LockNowaitError
+    s = Storage()
+    _seed(s)
+    dead = s.begin()
+    s.mvcc.acquire_pessimistic_lock(b"k1", b"k1", dead.start_ts,
+                                    dead.for_update_ts,
+                                    ctx=LockCtx(ttl_ms=60))
+    time.sleep(0.08)              # orphan expires
+    t = s.begin()
+    # nowait acquire resolves the expired orphan and wins immediately
+    s.mvcc.acquire_pessimistic_lock(b"k1", b"k1", t.start_ts,
+                                    t.for_update_ts, nowait=True)
+    assert s.mvcc._locks[b"k1"].start_ts == t.start_ts
+    # an ALIVE holder still fast-fails with ER 3572
+    t2 = s.begin()
+    with pytest.raises(LockNowaitError) as ei:
+        s.mvcc.acquire_pessimistic_lock(b"k1", b"k1", t2.start_ts,
+                                        t2.for_update_ts, nowait=True)
+    assert ei.value.code == 3572

@@ -342,3 +342,38 @@ def test_compound_interval_window_frame(tk):
     # 90s window: row3 (00:02:30) covers 00:01:00.. -> 2+3
     assert [(r[0], str(r[1])) for r in rows] == \
         [(1, "1"), (2, "3"), (3, "5"), (4, "4")], rows
+
+
+def test_lock_error_codes_and_sqlstates(tk):
+    """MySQL-compatible lock failure surface (ISSUE 4 satellite):
+    deadlock victim -> ER 1213 / SQLSTATE 40001, lock-wait deadline ->
+    ER 1205 / HY000 — asserted on LIVE raised errors and the catalog
+    (information_schema.tidb_errors)."""
+    rows = dict((code, state) for _n, code, state in tk.must_query(
+        "select error, code, sqlstate from information_schema.tidb_errors"
+        " where code in (1205, 1213, 3572)").rows)
+    assert rows == {1205: "HY000", 1213: "40001", 3572: "HY000"}
+    tk.must_exec("drop table if exists lkc")
+    tk.must_exec("create table lkc (a int primary key, b int)")
+    tk.must_exec("insert into lkc values (1, 10)")
+    s2 = tk.new_session()
+    tk.must_exec("begin")
+    tk.must_query("select * from lkc where a = 1 for update")
+    s2.must_exec("begin")
+    # live ER 3572 (ER_LOCK_NOWAIT): NOWAIT fails fast with its own
+    # code, distinct from a genuine wait-deadline 1205
+    e = s2.exec_err("select * from lkc where a = 1 for update nowait")
+    assert e.code == 3572 and e.sqlstate == "HY000"
+    # the failed statement's diagnostics area carries the same pair
+    warn = s2.must_query("show warnings").rows[0]
+    assert int(warn[1]) == 3572
+    # live ER 1205: the same conflict through the wait queue times out
+    s2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 100")
+    e = s2.exec_err("select * from lkc where a = 1 for update")
+    assert e.code == 1205 and e.sqlstate == "HY000"
+    s2.must_exec("rollback")
+    tk.must_exec("rollback")
+    # live ER 1213 is exercised end-to-end in tests/test_deadlock.py;
+    # here pin the class contract the wire protocol serializes
+    from tidb_tpu.errors import DeadlockError
+    assert (DeadlockError.code, DeadlockError.sqlstate) == (1213, "40001")

@@ -564,11 +564,26 @@ class TestTxn:
         ftk.must_exec("create table wc (id int primary key, v int)")
         ftk.must_exec("insert into wc values (1, 0)")
         tk2 = ftk.new_session()
+        # optimistic mode: no DML locks — first committer wins, the
+        # explicit txn sees the conflict at commit time
+        ftk.must_exec("set @@tidb_txn_mode = 'optimistic'")
         ftk.must_exec("begin")
         ftk.must_exec("update wc set v = 1 where id = 1")
         tk2.must_exec("update wc set v = 2 where id = 1")
         with pytest.raises(errors.TiDBError):
             ftk.must_exec("commit")
+        # pessimistic mode (default): the explicit txn's UPDATE takes a
+        # row lock, so the second writer BLOCKS on the lock-wait queue
+        # (ER 1205 at the wait deadline) instead of overtaking
+        ftk.must_exec("set @@tidb_txn_mode = 'pessimistic'")
+        tk2.must_exec("set @@tidb_tpu_lock_wait_timeout_ms = 100")
+        ftk.must_exec("begin")
+        ftk.must_exec("update wc set v = 3 where id = 1")
+        e = tk2.exec_err("update wc set v = 4 where id = 1")
+        assert e.code == 1205
+        ftk.must_exec("commit")
+        tk2.must_exec("update wc set v = 4 where id = 1")
+        tk2.must_query("select v from wc").check([(4,)])
 
 
 class TestDDL:
@@ -2374,3 +2389,42 @@ class TestPlanReplayer:
         assert "CREATE TABLE `prz`" in z.read("schema/schema.sql").decode()
         assert json.loads(z.read("stats/stats.json"))[
             "test.prz"]["row_count"] == 2
+
+
+class TestStatementAtomicity:
+    def test_failed_dml_statement_rolls_back_wholly(self, ftk):
+        """A DML statement that fails mid-way inside an explicit txn
+        (CHECK violation on a later row) must not leave its earlier
+        rows buffered for COMMIT to persist — implicit statement
+        savepoint (ISSUE 4 review finding)."""
+        ftk.must_exec("create table sa (a int primary key, b int, "
+                      "check (b < 100))")
+        ftk.must_exec("insert into sa values (1, 1), (2, 95)")
+        ftk.must_exec("begin")
+        e = ftk.exec_err("update sa set b = b + 10")  # row 2 -> 105
+        assert e.code == 3819
+        ftk.must_exec("commit")
+        ftk.must_query("select a, b from sa order by a").check(
+            [(1, 1), (2, 95)])
+        # the txn itself stays usable after the statement rollback
+        ftk.must_exec("begin")
+        ftk.must_exec("update sa set b = b + 1 where a = 1")
+        ftk.must_exec("commit")
+        ftk.must_query("select b from sa where a = 1").check([(2,)])
+
+    def test_pessimistic_lock_conflict_fails_statement_not_commit(
+            self, ftk):
+        """A pessimistic txn whose target committed past its snapshot
+        gets the write conflict AT THE STATEMENT (restartable), not a
+        guaranteed-doomed lock that only explodes at COMMIT."""
+        import tidb_tpu.errors as errors
+        ftk.must_exec("create table pc (a int primary key, b int)")
+        ftk.must_exec("insert into pc values (1, 0)")
+        tk2 = ftk.new_session()
+        ftk.must_exec("begin")
+        ftk.must_query("select 1")            # pin the snapshot
+        tk2.must_exec("update pc set b = 7 where a = 1")
+        e = ftk.exec_err("update pc set b = 8 where a = 1")
+        assert isinstance(e, errors.WriteConflictError)
+        ftk.must_exec("commit")               # nothing buffered: clean
+        ftk.must_query("select b from pc").check([(7,)])

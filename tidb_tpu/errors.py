@@ -59,6 +59,10 @@ WriteConflictError = _err("WriteConflictError", 9007)
 TxnRetryableError = _err("TxnRetryableError", 8002)
 LockWaitTimeoutError = _err("LockWaitTimeoutError", 1205, "HY000")
 DeadlockError = _err("DeadlockError", 1213, "40001")
+# NOWAIT failure (MySQL 8 ER_LOCK_NOWAIT): a SUBCLASS of the wait-
+# timeout class so wait-tolerant callers (SKIP LOCKED) catch both
+LockNowaitError = type("LockNowaitError", (LockWaitTimeoutError,),
+                       {"code": 3572, "sqlstate": "HY000"})
 # Variables
 UnknownSystemVariableError = _err("UnknownSystemVariableError", 1193, "HY000")
 WrongValueForVarError = _err("WrongValueForVarError", 1231, "42000")

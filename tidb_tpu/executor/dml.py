@@ -293,6 +293,23 @@ def _enforce_checks(sess, tbl, row):
             raise err
 
 
+def _pessimistic_lock_rows(sess, txn, tbl, rows):
+    """Pessimistic DML lock acquisition (reference executor pessimistic
+    path / SelectLockExec): an EXPLICIT pessimistic transaction locks
+    the record keys it is about to mutate before buffering the writes,
+    so conflicts surface here — through the lock-wait queue with
+    deadlock detection (ER 1213 victim) — instead of as a commit-time
+    write conflict. Autocommit DML skips it: the commit is immediate
+    and the optimistic conflict-retry loop already covers it.
+    rows: [(handle, row_datums)]."""
+    if not rows or not txn.pessimistic or \
+            not getattr(sess, "_explicit_txn", False):
+        return
+    from ..codec.tablecodec import record_key
+    txn.lock_keys([record_key(table_rt.physical_id(tbl, row), h)
+                   for h, row in rows])
+
+
 def _multi_delete_rows(schema, chunks, offs, hidx):
     pos = {sc.col.idx: i for i, sc in enumerate(schema.cols)}
     out = []
@@ -407,10 +424,15 @@ class UpdateExec:
         for ch in chunks:
             new_vals = _eval_assignments(schema, ch, plan.assignments)
             handle_idx = len(schema.cols) - 1
+            pend = []
             for i in range(len(ch)):
                 handle = int(ch.columns[handle_idx].data[i])
                 old = [ch.columns[j].get_datum(i)
                        for j in range(len(cols))]
+                pend.append((i, handle, old))
+            _pessimistic_lock_rows(sess, txn, tbl,
+                                   [(h, o) for _i, h, o in pend])
+            for i, handle, old in pend:
                 affected += _apply_row_update(
                     sess, txn, tbl, plan.db_name, cols, handle, old,
                     new_vals, i)
@@ -438,6 +460,7 @@ def _update_execute_multi(self):
         for ch in chunks:
             new_vals = _eval_assignments(schema, ch, assigns)
             hcol = ch.columns[pos[hidx]]
+            pend = []
             for i in range(len(ch)):
                 if hcol.nulls is not None and hcol.nulls[i]:
                     continue     # outer-join non-match: no such row
@@ -446,6 +469,10 @@ def _update_execute_multi(self):
                     continue
                 seen.add(handle)
                 old = [ch.columns[pos[j]].get_datum(i) for j in offs]
+                pend.append((i, handle, old))
+            _pessimistic_lock_rows(sess, txn, tbl,
+                                   [(h, o) for _i, h, o in pend])
+            for i, handle, old in pend:
                 affected += _apply_row_update(
                     sess, txn, tbl, db, cols, handle, old, new_vals, i)
     return affected
@@ -477,9 +504,13 @@ class DeleteExec:
         from .fk import referencing_fks, on_parent_delete
         has_children = bool(referencing_fks(self.sess, tbl, plan.db_name))
         for ch in chunks:
+            pend = []
             for i in range(len(ch)):
                 handle = int(ch.columns[handle_idx].data[i])
                 row = [ch.columns[j].get_datum(i) for j in range(len(cols))]
+                pend.append((handle, row))
+            _pessimistic_lock_rows(self.sess, txn, tbl, pend)
+            for handle, row in pend:
                 if has_children:
                     on_parent_delete(self.sess, txn, tbl, plan.db_name, row)
                 table_rt.remove_record(txn, tbl, handle, row)
@@ -499,7 +530,9 @@ def _delete_execute_multi(self):
     affected = 0
     for tbl, db, offs, hidx in plan.multi:
         has_children = bool(referencing_fks(self.sess, tbl, db))
-        for h, row in _multi_delete_rows(schema, chunks, offs, hidx):
+        rows = _multi_delete_rows(schema, chunks, offs, hidx)
+        _pessimistic_lock_rows(self.sess, txn, tbl, rows)
+        for h, row in rows:
             if has_children:
                 on_parent_delete(self.sess, txn, tbl, db, row)
             table_rt.remove_record(txn, tbl, h, row)

@@ -37,6 +37,18 @@ class ExecContext:
             rd = _time.time() + rg.exec_elapsed_ms / 1000.0
             self.deadline = rd if self.deadline is None \
                 else min(self.deadline, rd)
+        # lock-wait knobs for DIRECT mvcc reads from executors (index
+        # range scans, index point-gets, index-join inner lookups):
+        # the session's tidb_tpu_lock_* sysvars clamped to THIS
+        # statement's deadline, observing its kill flag — without this,
+        # index-path reads that trip on a foreign lock would wait under
+        # the env defaults, uninterruptible
+        lc = None
+        if hasattr(sess, "_lock_ctx"):
+            from dataclasses import replace as _replace
+            lc = _replace(sess._lock_ctx(), deadline=self.deadline,
+                          check_interrupt=self.check_killed)
+        self.lock_ctx = lc
 
     def check_killed(self):
         if self.killed:

@@ -513,8 +513,8 @@ class IndexRangeExec(Executor):
         else:
             read_ts = self.ctx.read_ts() or \
                 sess.domain.storage.current_ts()
-            entries = sess.domain.storage.mvcc.scan(lo, hi, read_ts,
-                                                    limit=lim)
+            entries = sess.domain.storage.mvcc.scan(
+                lo, hi, read_ts, limit=lim, ctx=self.ctx.lock_ctx)
         handles = []
         for k, v in entries:
             if index.unique and v not in (b"",):
@@ -803,7 +803,8 @@ class PointGetExec(Executor):
             v = (txn.get(ik) if dirty else
                  sess.domain.storage.mvcc.get(
                      ik, self.ctx.read_ts()
-                     or sess.domain.storage.current_ts()))
+                     or sess.domain.storage.current_ts(),
+                     ctx=self.ctx.lock_ctx))
             if v is None:
                 return Chunk.empty([sc.col.ft for sc in self.schema.cols])
             handle = int(v)
@@ -2354,7 +2355,7 @@ class IndexLookupJoinExec(Executor):
                     ik = index_key(tbl.id, plan.inner_index.id,
                                    [coerce_datum(Datum(Kind.INT, kk),
                                                  ci.ft)])
-                    v = mvcc.get(ik, ts)
+                    v = mvcc.get(ik, ts, ctx=self.ctx.lock_ctx)
                     h = int(v) if v is not None else -1
                     cache[k] = h
                 if h >= 0:

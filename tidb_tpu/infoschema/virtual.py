@@ -130,6 +130,28 @@ def _gen_top_sql(domain):
                e["fallback_count"], e["sum_errors"])
 
 
+def _gen_deadlocks(domain):
+    """Deadlock history ring (reference information_schema.deadlocks,
+    pkg/deadlockhistory): one row per wait-for edge of each detected
+    cycle, sharing a deadlock_id. try_lock_trx_id is the waiter's
+    start_ts, trx_holding_lock the holder it waited on; the victim is
+    the cycle's youngest txn (max start_ts)."""
+    for (did, wall, retryable, waiter, key_hex, holder) in \
+            domain.storage.mvcc.waits.history_rows():
+        yield (did, wall, retryable, waiter, key_hex, holder)
+
+
+def _gen_data_lock_waits(domain):
+    """Live lock-wait queue (reference information_schema.data_lock_waits):
+    which TRANSACTION is blocked on which key held by whom, right now.
+    Like the reference, only txn (write/FOR UPDATE) waits appear —
+    blocked snapshot readers hold no locks, take no wait-for edge, and
+    resolve without queueing."""
+    for key, waiter, holder in \
+            domain.storage.mvcc.waits.current_waits():
+        yield (key.hex(), waiter, holder)
+
+
 def _gen_resource_groups(domain):
     for g in domain.resource_groups.groups.values():
         limit = ""
@@ -313,6 +335,13 @@ VIRTUAL_DEFS = {
                            ("fetch_bytes", _I()),
                            ("fallback_count", _I()),
                            ("sum_errors", _I())), _gen_top_sql),
+    "deadlocks": (_cols(("deadlock_id", _I()), ("occur_time", _F()),
+                        ("retryable", _I()), ("try_lock_trx_id", _I()),
+                        ("key", _S()), ("trx_holding_lock", _I())),
+                  _gen_deadlocks),
+    "data_lock_waits": (_cols(("key", _S()), ("trx_id", _I()),
+                              ("current_holding_trx_id", _I())),
+                        _gen_data_lock_waits),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

@@ -117,6 +117,11 @@ class Domain:
         if os.path.exists(ckpt):
             with open(ckpt, "rb") as f:
                 ckpt_ts, triples = decode_checkpoint(f.read())
+            # the snapshot header ts was ALLOCATED before the snapshot
+            # was cut: the oracle must advance past it too, not just
+            # past the replayed versions, or the first post-recovery
+            # commit could reuse a pre-crash timestamp
+            self.storage.oracle.fast_forward(ckpt_ts)
             # re-apply versions in commit order so the engine hooks
             # rebuild columnar/schema state exactly like a WAL replay
             triples.sort(key=lambda t: t[0])
@@ -526,6 +531,9 @@ class Domain:
         total = 0
         for ctab in self.columnar.tables.values():
             total += ctab.gc(safepoint)
+        # rollback tombstones / commit records for txns older than the
+        # safepoint can never see a late commit attempt again
+        self.storage.mvcc.gc_resolved(safepoint)
         self.inc_metric("gc_compacted_rows", total)
         return total
 

@@ -69,7 +69,29 @@ class Session:
         if self._txn is None or self._txn.committed or self._txn.aborted:
             self._txn = self.domain.storage.begin(
                 pessimistic=self.vars.get("tidb_txn_mode") == "pessimistic")
+            self._txn.set_lock_ctx(self._lock_ctx())
         return self._txn
+
+    def _lock_ctx(self):
+        """Lock-lifecycle knobs for this session's transactions
+        (storage/lock_resolver.LockCtx from the tidb_tpu_lock_* sysvars)."""
+        from ..storage.lock_resolver import LockCtx
+        return LockCtx(
+            ttl_ms=int(self.vars.get("tidb_tpu_lock_ttl_ms")),
+            wait_timeout_ms=int(self.vars.get(
+                "tidb_tpu_lock_wait_timeout_ms")),
+            backoff_ms=int(self.vars.get("tidb_tpu_lock_wait_backoff_ms")))
+
+    def _stmt_lock_guard(self, txn, ectx):
+        """Scope the txn's lock waits to THIS statement: its deadline
+        and KILL flag (ectx=None clears a previous statement's — a new
+        statement must never inherit an already-expired clock)."""
+        from dataclasses import replace as _replace
+        txn.set_lock_ctx(_replace(
+            txn.lock_ctx,
+            deadline=ectx.deadline if ectx is not None else None,
+            check_interrupt=ectx.check_killed if ectx is not None
+            else None))
 
     def _commit_txn(self):
         """Commit with the session's fast-path policy (reference
@@ -77,6 +99,10 @@ class Session:
         gated by sysvars and the async-commit size caps; the taken
         path lands in metrics (txn_1pc / txn_async_commit / txn_2pc)."""
         t = self._txn
+        # no guard reset here: an autocommit DML commit runs inside its
+        # statement's still-current guard; the explicit COMMIT statement
+        # installs a fresh one in _dispatch, and every statement start
+        # clears stale guards (_execute_stmt)
         t.commit(
             async_commit=bool(self.vars.get("tidb_enable_async_commit")),
             one_pc=bool(self.vars.get("tidb_enable_1pc")),
@@ -104,11 +130,19 @@ class Session:
         self._txn = None
 
     def commit(self):
-        if self._txn is not None and not self._txn.committed and \
-                not self._txn.aborted:
-            self._commit_txn()
-        self._txn = None
-        self._explicit_txn = False
+        try:
+            if self._txn is not None and not self._txn.committed and \
+                    not self._txn.aborted:
+                self._commit_txn()
+        finally:
+            # a failed COMMIT still ENDS the transaction (MySQL
+            # semantics): roll back the leftover state so its locks are
+            # released/tombstoned instead of dangling on the session
+            if self._txn is not None and not self._txn.committed and \
+                    not self._txn.aborted:
+                self._txn.rollback()
+            self._txn = None
+            self._explicit_txn = False
 
     def rollback(self):
         if self._txn is not None and not self._txn.committed and \
@@ -160,6 +194,17 @@ class Session:
                 (isinstance(stmt, ast.ShowStmt) and
                  stmt.kind in ("warnings", "errors"))):
             self.vars.warnings = []
+        # session-driven TTL heartbeat: every statement inside an
+        # explicit txn extends its locks' wall deadline, so a long
+        # interactive transaction isn't resolved out from under the
+        # session (reference client-go txnHeartBeat); an IDLE txn still
+        # expires after tidb_tpu_lock_ttl_ms by design. The PREVIOUS
+        # statement's deadline/kill hook is dropped here — each
+        # statement that can block installs its own (_stmt_lock_guard)
+        if self._explicit_txn and self._txn is not None and \
+                not self._txn.committed and not self._txn.aborted:
+            self._txn.heartbeat()
+            self._stmt_lock_guard(self._txn, None)
         start = time.time()
         with self.domain.tracer.span("statement", conn_id=self.conn_id,
                                      stmt=type(stmt).__name__):
@@ -177,7 +222,14 @@ class Session:
                     "sqlstate": getattr(e, "sqlstate", "HY000"),
                     "msg": e.msg}]
                 self._observe(stmt, sql, start, ok=False, rgroup=rg)
-                self._finish_stmt(error=True)
+                from ..errors import DeadlockError
+                if isinstance(e, DeadlockError):
+                    # InnoDB semantics: the deadlock victim's WHOLE
+                    # transaction rolls back (not just the statement),
+                    # releasing its locks so the survivor can proceed
+                    self.rollback()
+                else:
+                    self._finish_stmt(error=True)
                 raise
             finally:
                 _phase.stmt_leave()
@@ -647,7 +699,22 @@ class Session:
             self.txn()
             return ResultSet()
         if isinstance(stmt, ast.CommitStmt):
-            self.commit()
+            txn = self._txn
+            if txn is not None and not txn.committed and \
+                    not txn.aborted:
+                # COMMIT is a statement: its lock waits get their own
+                # fresh deadline (max_execution_time from NOW) and a
+                # registered ExecContext so KILL reaches a commit
+                # blocked on a foreign lock
+                ectx = ExecContext(self)
+                self._stmt_lock_guard(txn, ectx)
+                self.domain.register_exec(self.conn_id, ectx)
+                try:
+                    self.commit()
+                finally:
+                    self.domain.unregister_exec(self.conn_id, ectx)
+            else:
+                self.commit()
             return ResultSet()
         if isinstance(stmt, ast.RollbackStmt):
             if stmt.to_savepoint:
@@ -1022,6 +1089,11 @@ class Session:
                 list(getattr(plan, "read_tables", ())), write=False)
         ectx = ExecContext(self, getattr(plan, "exec_hints", None))
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
+        if self._txn is not None and not self._txn.committed and \
+                not self._txn.aborted:
+            # snapshot reads through the open txn that trip on a
+            # foreign lock wait under THIS statement's clock and KILL
+            self._stmt_lock_guard(self._txn, ectx)
         self.domain.register_exec(self.conn_id, ectx)
         ex = build_executor(ectx, plan)
         with dom.tracer.span("execute", conn_id=self.conn_id):
@@ -1032,7 +1104,7 @@ class Session:
                 ex.close()
                 self.domain.unregister_exec(self.conn_id, ectx)
         if getattr(plan, "for_update", False) and self._explicit_txn:
-            chunks = self._lock_for_update(plan, chunks)
+            chunks = self._lock_for_update(plan, chunks, ectx)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
         names = [plan.schema.cols[i].name for i in vis]
         out_chunks = []
@@ -1128,7 +1200,7 @@ class Session:
                         "Table '%s' was locked in %s by connection %d",
                         tname, held[0].upper(), held[1])
 
-    def _lock_for_update(self, plan, chunks):
+    def _lock_for_update(self, plan, chunks, ectx=None):
         """SELECT ... FOR UPDATE: acquire pessimistic locks on the result
         rows' record keys. PointGet plans lock the computed handle; reader
         plans lock via the hidden _tidb_rowid column when present.
@@ -1138,6 +1210,11 @@ class Session:
         result (reference executor point_get/lock with
         tidb_lock_wait_policy). Returns the (possibly filtered)
         chunks."""
+        if ectx is not None:
+            # FOR UPDATE lock waits get THIS statement's deadline and
+            # KILL hook (the txn may have been created just now, or
+            # carry a previous write statement's guard)
+            self._stmt_lock_guard(self.txn(), ectx)
         from ..codec.tablecodec import record_key
         from ..planner.physical import PhysPointGet
         from ..executor.exec_base import expr_to_datum
@@ -1160,6 +1237,7 @@ class Session:
         walk(plan)
         tables = list(getattr(plan, "read_tables", ()))
         skip = getattr(plan, "lock_wait", "") == "skip locked"
+        nowait = getattr(plan, "lock_wait", "") == "nowait"
         if keys and skip:
             return self._skip_locked_point(plan, chunks, keys,
                                            key_handles, tables)
@@ -1181,7 +1259,7 @@ class Session:
                             k = record_key(
                                 tbl.id, int(ch.columns[hidx].data[i]))
                             try:
-                                self.txn().lock_keys([k])
+                                self.txn().lock_keys([k], nowait=True)
                                 keep.append(i)
                             except LockWaitTimeoutError:
                                 pass
@@ -1198,7 +1276,9 @@ class Session:
                             keys.append(record_key(
                                 tbl.id, int(ch.columns[hidx].data[i])))
         if keys:
-            self.txn().lock_keys(keys)
+            # NOWAIT fails fast; plain FOR UPDATE enters the lock-wait
+            # queue (bounded by tidb_tpu_lock_wait_timeout_ms -> ER 1205)
+            self.txn().lock_keys(keys, nowait=nowait)
         return chunks
 
     def _skip_locked_point(self, plan, chunks, keys, key_handles,
@@ -1210,7 +1290,7 @@ class Session:
         first_err = None
         for k, h in zip(keys, key_handles):
             try:
-                self.txn().lock_keys([k])
+                self.txn().lock_keys([k], nowait=True)
             except LockWaitTimeoutError as e:
                 failed.add(h)
                 first_err = e
@@ -1263,6 +1343,10 @@ class Session:
         plan = optimize(stmt, self._plan_ctx(params))
         ectx = ExecContext(self)
         txn = self.txn()   # ensure txn exists before write
+        # lock waits inside this statement (pessimistic DML, commit
+        # conflicts) are clamped to the statement deadline and observe
+        # KILL, like every other blocking site since PR 1
+        self._stmt_lock_guard(txn, ectx)
         if self.domain.table_locks:
             targets = []
             if isinstance(plan, InsertPlan):
@@ -1277,6 +1361,12 @@ class Session:
             # other sessions' WRITE locks too
             self._check_table_locks(
                 list(getattr(plan, "read_tables", ())), write=False)
+        # implicit statement savepoint (reference statement-level
+        # atomicity over the memBuffer's staging): a DML statement that
+        # fails mid-way — FK/CHECK violation, lock-wait timeout on a
+        # later chunk — must not leave its earlier rows buffered in an
+        # open explicit transaction for COMMIT to persist
+        txn.savepoint("__stmt_atomic__")
         try:
             if isinstance(plan, InsertPlan):
                 self.check_priv("insert", plan.db_name, plan.table_info.name)
@@ -1300,8 +1390,11 @@ class Session:
             else:
                 raise UnsupportedError("bad DML plan")
         except TiDBError:
+            txn.rollback_to_savepoint("__stmt_atomic__")
+            txn.release_savepoint("__stmt_atomic__")
             self._finish_stmt(error=True)
             raise
+        txn.release_savepoint("__stmt_atomic__")
         self.vars.affected_rows = affected
         self._finish_stmt()
         return ResultSet(affected=affected,

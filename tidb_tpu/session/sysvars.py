@@ -4,7 +4,6 @@ vardef/tidb_vars.go). Scopes: GLOBAL / SESSION / both. The TPU toggle
 `tidb_enable_vectorized_expression` pattern (vardef/tidb_vars.go:672)."""
 from __future__ import annotations
 
-import os
 import threading
 from dataclasses import dataclass, field
 from typing import Callable
@@ -60,11 +59,7 @@ class SysVar:
         return str(value)
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+from ..utils import env_int as _env_int  # shared with storage lock knobs
 
 
 _REGISTRY: dict[str, SysVar] = {}
@@ -150,6 +145,17 @@ for _v in [
     SysVar("tidb_tpu_device_breaker_threshold", SCOPE_BOTH,
            _env_int("TIDB_TPU_DEVICE_BREAKER_THRESHOLD", 8),
            "int", 1, 1 << 20),
+    # transaction lock lifecycle (storage/lock_resolver): TTL on locks a
+    # txn creates (heartbeat-extended per statement), how long a blocked
+    # statement waits on a foreign lock before ER 1205, and the wait
+    # queue's poll backoff. Env seeds mirror lock_resolver defaults.
+    SysVar("tidb_tpu_lock_ttl_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_LOCK_TTL_MS", 3000), "int", 50, 3_600_000),
+    SysVar("tidb_tpu_lock_wait_timeout_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_LOCK_WAIT_MS", 1000), "int",
+           0, 3_600_000),
+    SysVar("tidb_tpu_lock_wait_backoff_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_LOCK_WAIT_BACKOFF_MS", 10), "int", 1, 1000),
 ]:
     register(_v)
 

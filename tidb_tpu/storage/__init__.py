@@ -1,5 +1,7 @@
 from .kv import MemKV, KVIter
 from .mvcc import MVCCStore
+from .lock_resolver import LockCtx, LockResolver, WaitManager
 from .txn import Oracle, Transaction, Storage
 
-__all__ = ["MemKV", "KVIter", "MVCCStore", "Oracle", "Transaction", "Storage"]
+__all__ = ["MemKV", "KVIter", "MVCCStore", "Oracle", "Transaction",
+           "Storage", "LockCtx", "LockResolver", "WaitManager"]

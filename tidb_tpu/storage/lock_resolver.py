@@ -1,0 +1,253 @@
+"""Percolator lock resolution + deadlock detection (reference
+pkg/store/tikv lock resolver / client-go resolveLocks + TiKV's
+waiter-manager/deadlock detector, collapsed to one process).
+
+The MVCC layer (storage/mvcc.py) plants real locks at the 2PC seams, so
+a transaction that dies between prewrite and commit leaves them behind.
+Before this module, readers ignored locks and writers insta-failed with
+ER 1205 — an orphaned lock was permanent. The pieces here give locks a
+lifecycle:
+
+  * ``LockCtx`` — per-transaction knobs (TTL for locks it creates, how
+    long it waits on foreign locks, poll backoff, statement deadline).
+    Session wires these from the ``tidb_tpu_lock_*`` sysvars.
+  * ``LockResolver.check_txn_status(primary, start_ts)`` — the txn
+    status oracle: committed (commit record found) / rolled_back
+    (tombstone or expired-primary rollback) / alive (unexpired lock).
+    Expired primaries are rolled back *here*, writing a rollback
+    tombstone so a late ``commit()`` of the resolved txn fails instead
+    of resurrecting it (reference: CheckTxnStatus writing rollback
+    records).
+  * ``LockResolver.resolve_lock`` — applies the verdict to a secondary:
+    committed txns get their prewritten value applied at commit_ts,
+    rolled-back txns get the lock removed + tombstoned.
+  * ``WaitManager`` — the lock-wait queue's wait-for graph. A waiter
+    registers ``waiter_start_ts -> holder_start_ts`` before blocking;
+    edge insertion runs cycle detection and picks the YOUNGEST txn in
+    the cycle (max start_ts) as victim (ER 1213), recording the cycle
+    for ``information_schema.deadlocks``. A remote victim is flagged
+    and observes the verdict on its next wait poll.
+
+Blocking/resolution is orchestrated by MVCCStore (the wait loop lives
+there, next to the mutex it must not hold while sleeping); this module
+holds the protocol state machines.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque, namedtuple
+from dataclasses import dataclass
+
+from ..utils import env_int
+from ..utils import metrics as metrics_util
+
+# env seeds mirror the sysvar defaults (session/sysvars.py) so harnesses
+# configure child processes before any session exists
+DEFAULT_LOCK_TTL_MS = env_int("TIDB_TPU_LOCK_TTL_MS", 3000)
+DEFAULT_LOCK_WAIT_MS = env_int("TIDB_TPU_LOCK_WAIT_MS", 1000)
+DEFAULT_LOCK_BACKOFF_MS = env_int("TIDB_TPU_LOCK_WAIT_BACKOFF_MS", 10)
+
+
+@dataclass
+class LockCtx:
+    """Lock-lifecycle knobs a transaction carries into the MVCC layer.
+
+    ``deadline``/``check_interrupt`` are statement-scoped (wired from
+    ExecContext): a lock wait never outlives the statement budget and
+    observes KILL. ``nowait`` is the NOWAIT / SKIP LOCKED fast-fail."""
+
+    ttl_ms: int = DEFAULT_LOCK_TTL_MS
+    wait_timeout_ms: int = DEFAULT_LOCK_WAIT_MS
+    backoff_ms: int = DEFAULT_LOCK_BACKOFF_MS
+    deadline: float | None = None
+    check_interrupt: object = None      # callable () -> None, may raise
+    nowait: bool = False
+
+
+TxnStatus = namedtuple("TxnStatus", ["state", "commit_ts"])
+# state: 'committed' | 'rolled_back' | 'alive'
+
+
+class WaitManager:
+    """Wait-for graph + deadlock history (reference TiKV waiter-manager
+    + detector, minus the RPC: one process, one graph)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # waiter start_ts -> (holder start_ts, key)
+        self._edges: dict[int, tuple[int, bytes]] = {}
+        # remote victims flagged by a cycle-closing waiter; the victim's
+        # own poll loop consumes the flag and raises ER 1213
+        self._victims: dict[int, int] = {}
+        # rows for information_schema.deadlocks:
+        # (deadlock_id, occur_time, retryable, try_lock_trx_id,
+        #  key_hex, trx_holding_lock)
+        self.history: deque = deque(maxlen=128)
+        self._next_id = 0
+
+    def add_edge(self, waiter: int, holder: int, key: bytes) -> str:
+        """Register waiter->holder. Returns 'victim' when the edge would
+        close a cycle and the YOUNGEST txn in it is the waiter itself
+        (caller raises ER 1213 without ever blocking); 'wait' otherwise
+        (a remote youngest txn gets flagged instead)."""
+        with self._mu:
+            cycle = self._find_cycle(waiter, holder)
+            if cycle is None:
+                self._edges[waiter] = (holder, key)
+                return "wait"
+            victim = max(cycle)
+            self._next_id += 1
+            did = self._next_id
+            now = time.time()
+            edges = dict(self._edges)
+            edges[waiter] = (holder, key)
+            for ts in cycle:
+                h, k = edges[ts]
+                self.history.append(
+                    (did, now, 0, ts, k.hex(), h))
+            metrics_util.DEADLOCKS.inc()
+            if victim == waiter:
+                return "victim"
+            self._victims[victim] = did
+            self._edges[waiter] = (holder, key)
+            return "wait"
+
+    def _find_cycle(self, waiter: int, holder: int):
+        """Follow wait-for edges from holder; a path back to waiter is a
+        cycle (returned as the list of txn start_ts in it)."""
+        path = [waiter]
+        cur = holder
+        seen = {waiter}
+        while True:
+            if cur in seen:
+                # cycle not through waiter (shouldn't happen: victims
+                # break cycles as they form) — treat as no cycle
+                return path if cur == waiter else None
+            path.append(cur)
+            seen.add(cur)
+            nxt = self._edges.get(cur)
+            if nxt is None:
+                return None
+            cur = nxt[0]
+
+    def remove_edge(self, waiter: int) -> None:
+        with self._mu:
+            self._edges.pop(waiter, None)
+
+    def consume_victim(self, waiter: int) -> bool:
+        with self._mu:
+            return self._victims.pop(waiter, None) is not None
+
+    def current_waits(self):
+        """[(key, waiter_start_ts, holder_start_ts)] — live queue
+        snapshot for information_schema.data_lock_waits."""
+        with self._mu:
+            return [(key, waiter, holder)
+                    for waiter, (holder, key) in self._edges.items()]
+
+    def history_rows(self):
+        with self._mu:
+            return list(self.history)
+
+
+class LockResolver:
+    """Resolves foreign locks by consulting the primary's txn status.
+
+    Reaches into MVCCStore internals by design (same package, same
+    process — the Domain does too for checkpoints); every mutation
+    happens under the store mutex, never while sleeping."""
+
+    def __init__(self, store):
+        self.store = store
+
+    # ---- txn status oracle -------------------------------------------
+    def check_txn_status(self, primary: bytes, start_ts: int,
+                         now: float | None = None) -> TxnStatus:
+        """committed / rolled_back / alive for the txn that owns
+        ``primary``. An EXPIRED primary lock is rolled back here
+        (tombstoned) — the lazy-cleanup half of Percolator. A txn with
+        no lock and no commit record is tombstoned too, so a crashed
+        writer that never reached its primary can't prewrite late."""
+        store = self.store
+        if now is None:
+            now = time.time()
+        with store._mu:
+            commit_ts = store._committed.get(start_ts)
+            if commit_ts is not None:
+                return TxnStatus("committed", commit_ts)
+            if start_ts in store._rolled_back:
+                return TxnStatus("rolled_back", 0)
+            lock = store._locks.get(primary)
+            if lock is not None and lock.start_ts == start_ts:
+                if lock.min_commit_ts:
+                    # async commit: the durable prewrite (WAL frame
+                    # appended atomically with this lock) IS the commit
+                    # point — the txn is committed at min_commit_ts no
+                    # matter what happened to its finalize half; crash
+                    # replay would agree
+                    store._record_commit_locked(start_ts,
+                                                lock.min_commit_ts)
+                    return TxnStatus("committed", lock.min_commit_ts)
+                if now <= lock.deadline:
+                    return TxnStatus("alive", 0)
+                # TTL expired: roll the primary back
+                del store._locks[primary]
+                store._tombstone_locked(primary, start_ts)
+                metrics_util.LOCK_RESOLUTIONS.labels("expired").inc()
+                return TxnStatus("rolled_back", 0)
+            store._tombstone_locked(primary, start_ts)
+            metrics_util.LOCK_RESOLUTIONS.labels("no_lock").inc()
+            return TxnStatus("rolled_back", 0)
+
+    # ---- secondary resolution ----------------------------------------
+    def resolve_lock(self, key: bytes, lock, status: TxnStatus) -> str:
+        """Apply a txn-status verdict to one (possibly secondary) lock.
+        Returns the outcome applied ('committed'/'rolled_back'/'stale'
+        when the lock changed under us — nothing to do)."""
+        store = self.store
+        with store._mu:
+            cur = store._locks.get(key)
+            if cur is None or cur.start_ts != lock.start_ts:
+                metrics_util.LOCK_RESOLUTIONS.labels("stale").inc()
+                return "stale"
+            del store._locks[key]
+            if status.state == "committed":
+                if cur.op in ("put", "del"):
+                    # the prewritten value rides in the lock (TiKV
+                    # short-value); apply it at the primary's commit_ts
+                    # and log it — replay must see the secondary too.
+                    # Async locks skip the append: their prewrite
+                    # already wrote the whole txn's durable frame.
+                    if store.wal is not None and not cur.min_commit_ts:
+                        store.wal.append(status.commit_ts,
+                                         [(key, cur.value)])
+                    store._apply([(key, cur.value)], status.commit_ts)
+                metrics_util.LOCK_RESOLUTIONS.labels("committed").inc()
+                return "committed"
+            store._tombstone_locked(key, lock.start_ts)
+            metrics_util.LOCK_RESOLUTIONS.labels("rolled_back").inc()
+            return "rolled_back"
+
+    # ---- store-wide sweep --------------------------------------------
+    def sweep(self, force: bool = False) -> dict:
+        """Resolve every lock whose owning txn is no longer alive
+        (crash-recovery sweeps, scripts/crash_smoke.py). With ``force``
+        an alive-but-expired check is skipped — every lock's status is
+        consulted regardless of TTL. Returns outcome counts."""
+        store = self.store
+        now = time.time()
+        out: dict[str, int] = {}
+        with store._mu:
+            snapshot = list(store._locks.items())
+        for key, lock in snapshot:
+            if not force and now <= lock.deadline:
+                continue
+            status = self.check_txn_status(lock.primary, lock.start_ts,
+                                           now=now)
+            if status.state == "alive":
+                out["alive"] = out.get("alive", 0) + 1
+                continue
+            o = self.resolve_lock(key, lock, status)
+            out[o] = out.get(o, 0) + 1
+        return out

@@ -7,15 +7,32 @@ append-only list of (commit_ts, value|None); None is a delete tombstone.
 The prewrite/commit split is preserved so the seam to a distributed/C++
 engine stays intact — locks are real, conflicts are detected, but network
 hops are function calls.
+
+Locks carry a full lifecycle (storage/lock_resolver.py): a TTL wall
+deadline (heartbeat-extendable), the prewritten value (TiKV
+short-value), and min_commit_ts for async commit. Readers and writers
+that meet a foreign lock no longer ignore/insta-fail: they consult the
+primary's txn status, resolve expired/decided txns, and otherwise block
+on a lock-wait queue with wait-for-graph deadlock detection (youngest
+txn is the ER 1213 victim). Rolled-back txns leave per-key rollback
+tombstones so a late commit fails instead of resurrecting.
 """
 from __future__ import annotations
 
 import bisect
 import threading
+import time
 
 from ..native.memtable import new_memkv
-from ..errors import WriteConflictError, LockWaitTimeoutError
+from ..errors import (WriteConflictError, LockWaitTimeoutError,
+                      LockNowaitError, DeadlockError)
 from ..utils import failpoint
+from ..utils import metrics as metrics_util
+from .lock_resolver import LockCtx, LockResolver, WaitManager
+
+# resolved-txn bookkeeping caps (pruned oldest-first; far above any
+# live-txn population, only bounds a long-lived process)
+_COMMITTED_CAP = 1 << 16
 
 
 class _Versions:
@@ -42,12 +59,18 @@ class _Versions:
 
 
 class Lock:
-    __slots__ = ("primary", "start_ts", "op")
+    __slots__ = ("primary", "start_ts", "op", "value", "ttl_ms",
+                 "deadline", "min_commit_ts")
 
-    def __init__(self, primary: bytes, start_ts: int, op: str):
+    def __init__(self, primary: bytes, start_ts: int, op: str,
+                 value=None, ttl_ms: int = 3000, min_commit_ts: int = 0):
         self.primary = primary
         self.start_ts = start_ts
         self.op = op  # 'put' | 'del' | 'lock' (pessimistic)
+        self.value = value           # prewritten value (short-value)
+        self.ttl_ms = ttl_ms
+        self.deadline = time.time() + ttl_ms / 1000.0
+        self.min_commit_ts = min_commit_ts
 
 
 class MVCCStore:
@@ -58,26 +81,211 @@ class MVCCStore:
         self._mu = threading.Lock()
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
         self.wal = None              # optional WalWriter
+        # resolved-txn state (caller holds _mu for every access):
+        # per-key rollback tombstones + the derived rolled-back set, and
+        # start_ts -> commit_ts records for check_txn_status
+        self._rollbacks: dict[bytes, set] = {}
+        self._rolled_back: set = set()
+        self._committed: dict[int, int] = {}
+        self.waits = WaitManager()
+        self.resolver = LockResolver(self)
+        self.default_lock_ctx = LockCtx()
+
+    # ---- resolved-txn bookkeeping (caller holds self._mu) -------------
+    def _tombstone_locked(self, key: bytes, start_ts: int):
+        self._rollbacks.setdefault(key, set()).add(start_ts)
+        self._rolled_back.add(start_ts)
+
+    def _record_commit_locked(self, start_ts: int, commit_ts: int):
+        self._committed[start_ts] = commit_ts
+        if len(self._committed) > _COMMITTED_CAP:
+            for k in list(self._committed)[:1024]:
+                del self._committed[k]
+
+    def _assert_not_resolved_locked(self, keys, start_ts: int):
+        """A txn the resolver rolled back must never commit late: its
+        start_ts is tombstoned globally and per resolved key."""
+        if start_ts in self._rolled_back:
+            raise WriteConflictError(
+                "txn %d was rolled back by the lock resolver "
+                "(TTL expired or resolved by a conflicting txn)",
+                start_ts)
+        for key in keys:
+            rb = self._rollbacks.get(key)
+            if rb is not None and start_ts in rb:
+                raise WriteConflictError(
+                    "txn %d holds a rollback tombstone on a mutated key",
+                    start_ts)
+
+    # ---- lock waiting / resolution ------------------------------------
+    def _resolve_or_wait(self, blockers, waiter_ts: int, ctx: LockCtx):
+        """Called OUTSIDE the store mutex with the foreign locks that
+        blocked an operation: decided/expired txns resolve immediately,
+        alive ones are waited on (bounded, deadlock-checked). Returning
+        normally means every blocker was dealt with — the caller
+        re-attempts its operation."""
+        for key, lock in blockers:
+            status = self.resolver.check_txn_status(lock.primary,
+                                                    lock.start_ts)
+            if status.state != "alive":
+                self.resolver.resolve_lock(key, lock, status)
+                continue
+            if ctx.nowait:
+                metrics_util.LOCK_WAITS.labels("nowait").inc()
+                raise LockNowaitError(
+                    "Statement aborted because lock(s) could not be "
+                    "acquired immediately and NOWAIT is set (key held "
+                    "by txn %d)", lock.start_ts)
+            self._wait_for_lock(key, lock, waiter_ts, ctx)
+
+    def _wait_for_lock(self, key: bytes, lock: Lock, waiter_ts: int,
+                       ctx: LockCtx):
+        """Block until the holder's lock on ``key`` is released or
+        resolved. waiter_ts == 0 marks a reader: readers hold no locks,
+        so they take no wait-for edge (they cannot deadlock)."""
+        holder = lock.start_ts
+        waits = self.waits
+        t0 = time.time()
+        deadline = t0 + ctx.wait_timeout_ms / 1000.0
+        if ctx.deadline is not None:
+            deadline = min(deadline, ctx.deadline)
+        if waiter_ts:
+            if waits.add_edge(waiter_ts, holder, key) == "victim":
+                metrics_util.LOCK_WAITS.labels("deadlock").inc()
+                raise DeadlockError(
+                    "Deadlock found when trying to get lock; try "
+                    "restarting transaction (txn %d waits for txn %d)",
+                    waiter_ts, holder)
+        try:
+            while True:
+                if ctx.check_interrupt is not None:
+                    ctx.check_interrupt()
+                if waiter_ts and waits.consume_victim(waiter_ts):
+                    metrics_util.LOCK_WAITS.labels("deadlock").inc()
+                    raise DeadlockError(
+                        "Deadlock found when trying to get lock; try "
+                        "restarting transaction (txn %d chosen as "
+                        "victim)", waiter_ts)
+                now = time.time()
+                with self._mu:
+                    cur = self._locks.get(key)
+                    if cur is None or cur.start_ts != holder:
+                        metrics_util.LOCK_WAITS.labels("acquired").inc()
+                        metrics_util.LOCK_WAIT_SECONDS.observe(now - t0)
+                        return
+                if now > cur.deadline:
+                    status = self.resolver.check_txn_status(cur.primary,
+                                                            holder)
+                    if status.state != "alive":
+                        self.resolver.resolve_lock(key, cur, status)
+                        metrics_util.LOCK_WAITS.labels("resolved").inc()
+                        metrics_util.LOCK_WAIT_SECONDS.observe(
+                            time.time() - t0)
+                        return
+                if now > deadline:
+                    metrics_util.LOCK_WAITS.labels("timeout").inc()
+                    raise LockWaitTimeoutError(
+                        "Lock wait timeout exceeded; try restarting "
+                        "transaction (key held by txn %d)", holder)
+                time.sleep(max(1, ctx.backoff_ms) / 1000.0)
+        finally:
+            if waiter_ts:
+                waits.remove_edge(waiter_ts)
+                # a victim flag we exited WITHOUT consuming (lock
+                # acquired / timeout / kill broke the cycle by
+                # progress) must not doom this txn's next wait
+                waits.consume_victim(waiter_ts)
+
+    def txn_heartbeat(self, start_ts: int, ttl_ms: int,
+                      keys=None) -> int:
+        """Extend the wall deadline of every lock this txn holds
+        (reference client-go txnHeartBeat keeping long txns alive).
+        Session-driven: each statement in an explicit txn bumps it.
+        With ``keys`` (the txn's own tracked lock set) the scan is
+        O(own locks); without, the whole lock table is swept — keep
+        that for direct store use only."""
+        nd = time.time() + ttl_ms / 1000.0
+        n = 0
+        with self._mu:
+            if keys is not None:
+                for key in keys:
+                    lk = self._locks.get(key)
+                    if lk is not None and lk.start_ts == start_ts:
+                        lk.deadline = max(lk.deadline, nd)
+                        n += 1
+            else:
+                for lk in self._locks.values():
+                    if lk.start_ts == start_ts:
+                        lk.deadline = max(lk.deadline, nd)
+                        n += 1
+        return n
+
+    def gc_resolved(self, safepoint_ts: int) -> int:
+        """Drop rollback tombstones / commit records for txns older
+        than the GC safepoint — they can no longer attempt a commit."""
+        n = 0
+        with self._mu:
+            for key in list(self._rollbacks):
+                s = self._rollbacks[key]
+                s -= {ts for ts in s if ts < safepoint_ts}
+                if not s:
+                    del self._rollbacks[key]
+            stale = {ts for ts in self._rolled_back if ts < safepoint_ts}
+            self._rolled_back -= stale
+            n += len(stale)
+            for ts in [t for t in self._committed if t < safepoint_ts]:
+                del self._committed[ts]
+        return n
 
     # ---- reads --------------------------------------------------------
     # Reads take the same mutex as commits: the sorted memtable (C++
     # std::map or python bisect list) is not safe under concurrent
-    # write+read, and ctypes calls release the GIL.
-    def get(self, key: bytes, read_ts: int):
-        with self._mu:
-            vers = self._kv.get(key)
-            return vers.get(read_ts) if vers is not None else None
+    # write+read, and ctypes calls release the GIL. A value-bearing
+    # foreign lock at or below read_ts blocks the read (the txn may
+    # commit below read_ts — ignoring it would miss the write);
+    # pessimistic locks and async-commit locks with min_commit_ts >
+    # read_ts cannot, and are skipped.
+    def _read_blocker_locked(self, key: bytes, read_ts: int):
+        lk = self._locks.get(key)
+        if lk is None or lk.op == "lock" or lk.start_ts > read_ts:
+            return None
+        if lk.min_commit_ts and lk.min_commit_ts > read_ts:
+            return None
+        return lk
 
-    def scan(self, start: bytes, end: bytes | None, read_ts: int, limit: int = -1):
-        out = []
-        with self._mu:
-            for k, vers in self._kv.scan(start, end):
-                v = vers.get(read_ts)
-                if v is not None:
-                    out.append((k, v))
-                    if 0 < limit <= len(out):
-                        break
-        return out
+    def get(self, key: bytes, read_ts: int, ctx: LockCtx | None = None):
+        while True:
+            with self._mu:
+                blk = self._read_blocker_locked(key, read_ts) \
+                    if self._locks else None
+                if blk is None:
+                    vers = self._kv.get(key)
+                    return vers.get(read_ts) if vers is not None else None
+            self._resolve_or_wait([(key, blk)], 0,
+                                  ctx or self.default_lock_ctx)
+
+    def scan(self, start: bytes, end: bytes | None, read_ts: int,
+             limit: int = -1, ctx: LockCtx | None = None):
+        while True:
+            out = []
+            blockers = []
+            with self._mu:
+                if self._locks:
+                    for k, lk in self._locks.items():
+                        if k < start or (end is not None and k >= end):
+                            continue
+                        if self._read_blocker_locked(k, read_ts) is lk:
+                            blockers.append((k, lk))
+                if not blockers:
+                    for k, vers in self._kv.scan(start, end):
+                        v = vers.get(read_ts)
+                        if v is not None:
+                            out.append((k, v))
+                            if 0 < limit <= len(out):
+                                break
+                    return out
+            self._resolve_or_wait(blockers, 0,
+                                  ctx or self.default_lock_ctx)
 
     def latest_commit_ts(self, key: bytes) -> int:
         vers = self._kv.get(key)
@@ -85,28 +293,65 @@ class MVCCStore:
 
     # ---- pessimistic locks -------------------------------------------
     def acquire_pessimistic_lock(self, key: bytes, primary: bytes,
-                                 start_ts: int, for_update_ts: int):
-        with self._mu:
-            lock = self._locks.get(key)
-            if lock is not None and lock.start_ts != start_ts:
-                raise LockWaitTimeoutError(
-                    "lock wait timeout on key held by txn %d", lock.start_ts)
-            vers = self._kv.get(key)
-            if vers is not None and vers.latest_ts() > for_update_ts:
-                raise WriteConflictError(
-                    "write conflict on pessimistic lock, key committed at %d > %d",
-                    vers.latest_ts(), for_update_ts)
-            self._locks[key] = Lock(primary, start_ts, "lock")
+                                 start_ts: int, for_update_ts: int,
+                                 ctx: LockCtx | None = None,
+                                 nowait: bool = False):
+        ctx = ctx or self.default_lock_ctx
+        if nowait and not ctx.nowait:
+            from dataclasses import replace as _replace
+            ctx = _replace(ctx, nowait=True)
+        while True:
+            with self._mu:
+                self._assert_not_resolved_locked((key,), start_ts)
+                lock = self._locks.get(key)
+                if lock is None or lock.start_ts == start_ts:
+                    vers = self._kv.get(key)
+                    if vers is not None and \
+                            vers.latest_ts() > for_update_ts:
+                        raise WriteConflictError(
+                            "write conflict on pessimistic lock, key "
+                            "committed at %d > %d",
+                            vers.latest_ts(), for_update_ts)
+                    if vers is not None and \
+                            vers.latest_ts() > start_ts:
+                        # the key committed AFTER this txn's snapshot
+                        # (e.g. we waited out the holder): this engine
+                        # reads at start_ts, so granting the lock would
+                        # only doom the txn at COMMIT — and silently
+                        # computing from the stale snapshot would be a
+                        # lost update. Fail the STATEMENT now; the
+                        # client (or the autocommit retry loop)
+                        # restarts on a fresh snapshot.
+                        raise WriteConflictError(
+                            "write conflict in pessimistic txn: key "
+                            "committed at %d > txn start_ts %d — "
+                            "restart transaction",
+                            vers.latest_ts(), start_ts)
+                    self._locks[key] = Lock(primary, start_ts, "lock",
+                                            ttl_ms=ctx.ttl_ms)
+                    return
+                blocker = (key, lock)
+            # NOWAIT rides through _resolve_or_wait too: a DECIDED or
+            # EXPIRED holder is resolved and the acquire retried —
+            # only an alive holder fast-fails (ER 3572). Otherwise an
+            # orphaned lock would starve NOWAIT/SKIP LOCKED workloads
+            # forever.
+            self._resolve_or_wait([blocker], start_ts, ctx)
 
     # ---- 2PC ----------------------------------------------------------
-    def _check_conflicts(self, mutations: list, start_ts: int):
-        """Lock + write-conflict check for every mutated key.
-        Caller holds self._mu."""
+    def _foreign_locks_locked(self, mutations, start_ts: int):
+        """Blocking locks for the mutated keys. Caller holds self._mu."""
+        if not self._locks:
+            return []
+        out = []
         for key, _ in mutations:
             lock = self._locks.get(key)
             if lock is not None and lock.start_ts != start_ts:
-                raise LockWaitTimeoutError(
-                    "key is locked by txn %d", lock.start_ts)
+                out.append((key, lock))
+        return out
+
+    def _check_write_conflicts_locked(self, mutations, start_ts: int):
+        for key, _ in mutations:
             vers = self._kv.get(key)
             if vers is not None and vers.latest_ts() > start_ts:
                 raise WriteConflictError(
@@ -129,7 +374,7 @@ class MVCCStore:
                     del self._locks[key]
 
     def prewrite(self, mutations: list, primary: bytes, start_ts: int,
-                 min_commit_ts: int = 0):
+                 min_commit_ts: int = 0, ctx: LockCtx | None = None):
         """mutations: [(key, value|None)]; value None = delete.
 
         With ``min_commit_ts`` set this is an ASYNC-COMMIT prewrite
@@ -143,16 +388,28 @@ class MVCCStore:
         all keys atomic. The WAL append is the LAST fallible step:
         failpoints and conflict errors all fire before it, so an
         aborted prewrite can never leave a durable frame behind."""
-        with self._mu:
-            self._check_conflicts(mutations, start_ts)
-            for key, value in mutations:
-                op = "del" if value is None else "put"
-                self._locks[key] = Lock(primary, start_ts, op)
-            failpoint.inject("2pc-prewrite-done")
-            if min_commit_ts and self.wal is not None:
-                # the commit point: after this append, crash recovery
-                # commits the txn
-                self.wal.append(min_commit_ts, mutations)
+        ctx = ctx or self.default_lock_ctx
+        while True:
+            with self._mu:
+                self._assert_not_resolved_locked(
+                    [k for k, _ in mutations], start_ts)
+                blockers = self._foreign_locks_locked(mutations, start_ts)
+                if not blockers:
+                    self._check_write_conflicts_locked(mutations,
+                                                       start_ts)
+                    for key, value in mutations:
+                        op = "del" if value is None else "put"
+                        self._locks[key] = Lock(
+                            primary, start_ts, op, value=value,
+                            ttl_ms=ctx.ttl_ms,
+                            min_commit_ts=min_commit_ts)
+                    failpoint.inject("2pc-prewrite-done")
+                    if min_commit_ts and self.wal is not None:
+                        # the commit point: after this append, crash
+                        # recovery commits the txn
+                        self.wal.append(min_commit_ts, mutations)
+                    return
+            self._resolve_or_wait(blockers, start_ts, ctx)
 
     def finalize_async(self, mutations: list, start_ts: int,
                        commit_ts: int):
@@ -161,28 +418,44 @@ class MVCCStore:
         commit durable) and no raise sites — past the commit point the
         transaction must not abort."""
         with self._mu:
+            self._record_commit_locked(start_ts, commit_ts)
             self._apply(mutations, commit_ts, release_start_ts=start_ts)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 
-    def one_pc(self, mutations: list, start_ts: int, commit_ts: int):
+    def one_pc(self, mutations: list, start_ts: int, commit_ts: int,
+               ctx: LockCtx | None = None):
         """1PC (reference tidb_enable_1pc): conflict check + WAL +
         apply fused into ONE mutex pass — no prewrite lock round, no
         lock window for readers to trip on. Only valid when every
         mutation lives in this store (the cluster 2PC path never
         routes here)."""
-        with self._mu:
-            self._check_conflicts(mutations, start_ts)
-            failpoint.inject("1pc-before-wal")
-            if self.wal is not None:
-                self.wal.append(commit_ts, mutations)
-            # release_start_ts also clears pessimistic locks we held
-            self._apply(mutations, commit_ts, release_start_ts=start_ts)
+        ctx = ctx or self.default_lock_ctx
+        while True:
+            with self._mu:
+                self._assert_not_resolved_locked(
+                    [k for k, _ in mutations], start_ts)
+                blockers = self._foreign_locks_locked(mutations, start_ts)
+                if not blockers:
+                    self._check_write_conflicts_locked(mutations,
+                                                       start_ts)
+                    failpoint.inject("1pc-before-wal")
+                    if self.wal is not None:
+                        self.wal.append(commit_ts, mutations)
+                    self._record_commit_locked(start_ts, commit_ts)
+                    # release_start_ts also clears pessimistic locks we
+                    # held
+                    self._apply(mutations, commit_ts,
+                                release_start_ts=start_ts)
+                    break
+            self._resolve_or_wait(blockers, start_ts, ctx)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 
     def commit(self, mutations: list, start_ts: int, commit_ts: int):
         with self._mu:
+            self._assert_not_resolved_locked(
+                [k for k, _ in mutations], start_ts)
             for key, value in mutations:
                 lock = self._locks.get(key)
                 if lock is None or lock.start_ts != start_ts:
@@ -196,6 +469,7 @@ class MVCCStore:
             if self.wal is not None:
                 self.wal.append(commit_ts, mutations)
             failpoint.inject("2pc-commit-after-wal")
+            self._record_commit_locked(start_ts, commit_ts)
             self._apply(mutations, commit_ts, release_start_ts=start_ts)
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
@@ -222,9 +496,30 @@ class MVCCStore:
         for hook in self.commit_hooks:
             hook(commit_ts, mutations)
 
-    def rollback(self, keys: list, start_ts: int):
+    def rollback(self, keys: list, start_ts: int,
+                 tombstone: bool = True):
+        """Release this txn's locks on ``keys``. With ``tombstone``
+        (every abort path) a rollback record is written per key + the
+        txn is marked rolled back, so a late commit fails; the
+        post-commit leftover-lock release passes tombstone=False (the
+        txn committed — it must stay committable in the status maps).
+
+        A txn holding ASYNC-COMMIT locks (min_commit_ts set) is past
+        its commit point — the durable WAL frame written inside its
+        prewrite replays as committed — so it is NOT abortable: the
+        call is a no-op and the resolver finalizes it forward via
+        check_txn_status instead."""
         with self._mu:
+            for key in keys:
+                lock = self._locks.get(key)
+                if lock is not None and lock.start_ts == start_ts and \
+                        lock.min_commit_ts:
+                    return
             for key in keys:
                 lock = self._locks.get(key)
                 if lock is not None and lock.start_ts == start_ts:
                     del self._locks[key]
+                if tombstone:
+                    self._rollbacks.setdefault(key, set()).add(start_ts)
+            if tombstone:
+                self._rolled_back.add(start_ts)

@@ -12,6 +12,7 @@ import threading
 
 from ..native.memtable import new_memkv
 from .mvcc import MVCCStore
+from .lock_resolver import LockCtx
 from ..utils import failpoint
 
 
@@ -58,17 +59,20 @@ class Oracle:
 
 
 class Snapshot:
-    __slots__ = ("store", "read_ts")
+    __slots__ = ("store", "read_ts", "lock_ctx")
 
-    def __init__(self, store: MVCCStore, read_ts: int):
+    def __init__(self, store: MVCCStore, read_ts: int,
+                 lock_ctx: LockCtx | None = None):
         self.store = store
         self.read_ts = read_ts
+        self.lock_ctx = lock_ctx     # None -> store default (env-seeded)
 
     def get(self, key: bytes):
-        return self.store.get(key, self.read_ts)
+        return self.store.get(key, self.read_ts, ctx=self.lock_ctx)
 
     def scan(self, start: bytes, end: bytes | None = None, limit: int = -1):
-        return self.store.scan(start, end, self.read_ts, limit)
+        return self.store.scan(start, end, self.read_ts, limit,
+                               ctx=self.lock_ctx)
 
 
 class Transaction:
@@ -79,7 +83,8 @@ class Transaction:
         self.start_ts = start_ts
         self.for_update_ts = start_ts
         self.pessimistic = pessimistic
-        self.snapshot = Snapshot(storage.mvcc, start_ts)
+        self.lock_ctx = storage.mvcc.default_lock_ctx
+        self.snapshot = Snapshot(storage.mvcc, start_ts, self.lock_ctx)
         self.mem_buffer = new_memkv() # key -> value|None (None = delete)
         self._dirty = False
         self.committed = False
@@ -87,7 +92,7 @@ class Transaction:
         self.commit_mode = None       # set by commit(): 1pc|async|2pc
         self._savepoints: list = []   # [(name, undo_len)]
         self._undo: list = []         # [(key, had_key, prev_value)]
-        self._locked_keys: list = []  # pessimistic locks to release
+        self._locked_keys: set = set()  # pessimistic locks to release
 
     # ---- buffered reads/writes ---------------------------------------
     def get(self, key: bytes):
@@ -171,24 +176,53 @@ class Transaction:
         merged.sort(key=lambda kv: kv[0])
         return merged if limit < 0 else merged[:limit]
 
-    def lock_keys(self, keys, for_update_ts=None):
+    def set_lock_ctx(self, ctx: LockCtx):
+        """Install the session's lock knobs (TTL/wait/deadline) for every
+        subsequent lock acquisition and snapshot read."""
+        self.lock_ctx = ctx
+        self.snapshot.lock_ctx = ctx
+
+    def heartbeat(self) -> int:
+        """Extend this txn's lock TTLs (session calls it per statement
+        so long explicit transactions outlive the base TTL). Only the
+        txn's own tracked keys are touched — O(own locks), not a sweep
+        of the whole lock table (prewrite locks exist only inside
+        commit(), so between statements _locked_keys is the lot)."""
+        if not self._locked_keys:
+            return 0
+        return self.storage.mvcc.txn_heartbeat(self.start_ts,
+                                               self.lock_ctx.ttl_ms,
+                                               keys=self._locked_keys)
+
+    def lock_keys(self, keys, for_update_ts=None, nowait=False):
+        """Acquire pessimistic locks. A key that committed past this
+        txn's start_ts raises WriteConflictError at the statement (this
+        engine reads at start_ts — granting the lock would either lose
+        the newer update or doom the txn at COMMIT); the caller
+        restarts on a fresh snapshot."""
         if for_update_ts is None:
             for_update_ts = self.storage.oracle.get_ts()
         self.for_update_ts = for_update_ts
         primary = keys[0] if keys else b""
         for k in keys:
             self.storage.mvcc.acquire_pessimistic_lock(
-                k, primary, self.start_ts, for_update_ts)
-            self._locked_keys.append(k)
+                k, primary, self.start_ts, for_update_ts,
+                ctx=self.lock_ctx, nowait=nowait)
+            self._locked_keys.add(k)
 
     # ---- 2PC ----------------------------------------------------------
-    def _release_locks(self, written=()):
+    def _release_locks(self, written=(), committed=False):
         if not self._locked_keys:
             return
         leftover = [k for k in self._locked_keys if k not in written]
         if leftover:
-            self.storage.mvcc.rollback(leftover, self.start_ts)
-        self._locked_keys = []
+            # after a successful commit the leftover pessimistic locks
+            # (FOR UPDATE keys never written) are released WITHOUT
+            # rollback tombstones — the txn committed and must stay
+            # committed in the resolver's status maps
+            self.storage.mvcc.rollback(leftover, self.start_ts,
+                                       tombstone=not committed)
+        self._locked_keys = set()
 
     def commit(self, async_commit=False, one_pc=False,
                keys_limit=256, size_limit=4 << 10):
@@ -200,37 +234,59 @@ class Transaction:
         bootstrap, meta txns, the cluster 2PC seam) runs classic
         prewrite/commit. self.commit_mode records the path taken."""
         if not self._dirty:
-            self._release_locks()
+            self._release_locks(committed=True)
             self.committed = True
             self.commit_mode = "read_only"
             return
         mutations = [(k, v) for k, v in self.mem_buffer.scan(b"")]
+        if not mutations:
+            # dirty flag set but the buffer emptied again (statement
+            # savepoint / ROLLBACK TO undid every write)
+            self._release_locks(committed=True)
+            self.committed = True
+            self.commit_mode = "read_only"
+            return
         primary = mutations[0][0]
         mvcc = self.storage.mvcc
         small = (len(mutations) <= keys_limit and
                  sum(len(k) for k, _ in mutations) <= size_limit)
         if one_pc and small:
             commit_ts = self.storage.oracle.get_ts()
-            mvcc.one_pc(mutations, self.start_ts, commit_ts)
+            mvcc.one_pc(mutations, self.start_ts, commit_ts,
+                        ctx=self.lock_ctx)
             self.commit_mode = "1pc"
         elif async_commit and small:
             # min_commit_ts doubles as the commit_ts: the oracle is
             # centralized, so max(per-key min_commit_ts) == the one ts
             commit_ts = self.storage.oracle.get_ts()
             mvcc.prewrite(mutations, primary, self.start_ts,
-                          min_commit_ts=commit_ts)
+                          min_commit_ts=commit_ts, ctx=self.lock_ctx)
             # commit point passed (durable frame). The crash failpoint
             # sits here; finalize_async itself has no raise sites, so
             # the commit can no longer abort.
-            failpoint.inject("async-commit-prewrite-durable")
+            try:
+                failpoint.inject("async-commit-prewrite-durable")
+            except BaseException:
+                # an injected (non-crash) failure past the commit point
+                # must NOT abort: the WAL frame is durable, so crash
+                # replay WOULD commit this txn — finalize live state to
+                # match, then surface the failure
+                mvcc.finalize_async(mutations, self.start_ts, commit_ts)
+                self.commit_mode = "async"
+                self._release_locks(written={k for k, _ in mutations},
+                                    committed=True)
+                self.committed = True
+                raise
             mvcc.finalize_async(mutations, self.start_ts, commit_ts)
             self.commit_mode = "async"
         else:
-            mvcc.prewrite(mutations, primary, self.start_ts)
+            mvcc.prewrite(mutations, primary, self.start_ts,
+                          ctx=self.lock_ctx)
             commit_ts = self.storage.oracle.get_ts()
             mvcc.commit(mutations, self.start_ts, commit_ts)
             self.commit_mode = "2pc"
-        self._release_locks(written={k for k, _ in mutations})
+        self._release_locks(written={k for k, _ in mutations},
+                            committed=True)
         self.committed = True
         return commit_ts
 

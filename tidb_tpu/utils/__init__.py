@@ -1,0 +1,15 @@
+"""Shared small helpers for the utils package."""
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer from the environment, falling back on missing OR
+    malformed values — a bad harness env must never kill an import.
+    Shared by the sysvar registry defaults and the storage lock
+    knobs so the two parses can't drift."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

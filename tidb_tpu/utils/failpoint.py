@@ -22,13 +22,20 @@ chain with '->' and run in order on each hit:
     nth:K                gate: only the first K hits of this failpoint
                          run the remaining terms (hit K+1 onward is a
                          no-op) — 'fail twice then succeed' chaos shape
+    prob:P               gate: each hit runs the remaining terms with
+                         probability P (0..1). The RNG is seeded from
+                         TIDB_TPU_FAILPOINT_SEED + the spec text, so a
+                         randomized chaos run replays bit-identically
+                         under the same seed (crash_smoke --random).
 
 Examples:  "nth:1->error:grant_lost"   first dispatch fails, retry wins
            "sleep:500->error:generic"  slow failure
+           "prob:0.3->crash"           die on ~30% of hits, seeded
 """
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 
@@ -83,18 +90,33 @@ def _compile_action(spec: str):
             steps.append(("error", part[6:].strip().lower()))
         elif low.startswith("sleep:"):
             steps.append(("sleep", float(part[6:])))
+        elif low.startswith("prob:"):
+            p = float(part[5:])
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"prob term out of [0,1]: '{part}'")
+            steps.append(("prob", p))
         elif low.startswith("nth:"):
             limit = int(part[4:])
         else:
             raise ValueError(f"unknown failpoint action '{part}'")
     hits = [0]
+    # deterministic per-action stream: the seed env + the spec text key
+    # the RNG, so two runs with the same TIDB_TPU_FAILPOINT_SEED fire
+    # the same hits — reproducible randomized chaos
+    rng = None
+    if any(kind == "prob" for kind, _ in steps):
+        rng = random.Random("%s|%s" % (
+            os.environ.get("TIDB_TPU_FAILPOINT_SEED", "0"), spec))
 
     def cb(*_args):
         hits[0] += 1
         if limit is not None and hits[0] > limit:
             return None
         for kind, arg in steps:
-            if kind == "sleep":
+            if kind == "prob":
+                if rng.random() >= arg:
+                    return None
+            elif kind == "sleep":
                 time.sleep(arg / 1000.0)
             elif kind == "crash":
                 CRASH()

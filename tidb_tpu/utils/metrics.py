@@ -766,6 +766,21 @@ RPC_RETRIES = REGISTRY.counter(
     "tidb_tpu_rpc_retry_total",
     "Cluster RPC transport retries by op", ("op",))
 
+LOCK_RESOLUTIONS = REGISTRY.counter(
+    "tidb_tpu_lock_resolution_total",
+    "Foreign-lock resolutions by the lock resolver, by outcome "
+    "(committed/rolled_back/expired/no_lock/stale)", ("outcome",))
+LOCK_WAITS = REGISTRY.counter(
+    "tidb_tpu_lock_wait_total",
+    "Lock-wait queue outcomes (acquired/resolved/timeout/deadlock/"
+    "nowait)", ("outcome",))
+DEADLOCKS = REGISTRY.counter(
+    "tidb_tpu_deadlock_total",
+    "Deadlock cycles detected by the wait-for graph")
+LOCK_WAIT_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_lock_wait_seconds",
+    "Time spent blocked on foreign locks before acquire/resolution")
+
 LSM_FLUSH_SECONDS = REGISTRY.histogram(
     "tidb_tpu_lsm_flush_seconds",
     "WAL -> immutable-run flush latency",
