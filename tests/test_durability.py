@@ -471,3 +471,46 @@ def test_ci_index_key_format_migration(tmp_path, monkeypatch):
     dom3 = new_store(d)
     _tk(dom3).must_query("select id from m where name = 'BETA'").check(
         [(1,)])
+
+
+def test_wal_torn_tail_truncated_on_reopen(tmp_path):
+    """Regression (ISSUE 5 satellite): frames appended AFTER a
+    crash-torn tail used to be unrecoverable — replay() stops at the
+    first bad frame, and the old writer opened 'ab' and appended past
+    it. The writer must truncate to the last valid frame boundary on
+    open so the log stays a clean prefix."""
+    from tidb_tpu.storage import wal as walmod
+    path = os.path.join(str(tmp_path), "commit.wal")
+    w = walmod.WalWriter(path)
+    w.append(10, [(b"k1", b"v1")])
+    w.append(11, [(b"k2", b"v2")])
+    w.close()
+    good = os.path.getsize(path)
+    # crash-torn tail: a frame header + partial payload
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefgarbage")
+    assert walmod.valid_prefix(path) == good
+    # reopen (the crash-recovery path) and append a new frame
+    w2 = walmod.WalWriter(path)
+    assert w2.position() == good           # tail truncated
+    w2.append(12, [(b"k3", b"v3")])
+    w2.close()
+    frames = list(walmod.replay(path))
+    assert [f[0] for f in frames] == [10, 11, 12]
+    assert frames[2][1] == [(b"k3", b"v3")]
+
+
+def test_wal_torn_tail_mid_header(tmp_path):
+    from tidb_tpu.storage import wal as walmod
+    path = os.path.join(str(tmp_path), "commit.wal")
+    w = walmod.WalWriter(path)
+    w.append(5, [(b"a", None)])
+    w.close()
+    good = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x03")                   # 1-byte torn header
+    w2 = walmod.WalWriter(path)
+    w2.append(6, [(b"b", b"1")])
+    w2.close()
+    assert [f[0] for f in walmod.replay(path)] == [5, 6]
+    assert walmod.valid_prefix(path) > good

@@ -1,0 +1,27 @@
+"""Change data capture: commit-ts-ordered changefeeds over the MVCC
+commit stream (reference TiCDC collapsed to the in-process engine).
+
+Pieces: capture (commit hook + WAL/version catch-up + resolved-ts
+watermark), sorter + lifecycle (changefeed), sinks (blackhole / ndjson
+file / mirror table sink). Protocol and contracts: docs/CDC.md.
+"""
+from .capture import Capture
+from .changefeed import Changefeed, ChangefeedManager
+from .events import DDLEvent, RowEvent
+from .sinks import (BlackholeSink, NdjsonSink, SinkContractError,
+                    TableSink, make_sink)
+
+__all__ = ["Capture", "Changefeed", "ChangefeedManager", "DDLEvent",
+           "RowEvent", "BlackholeSink", "NdjsonSink",
+           "SinkContractError", "TableSink", "make_sink",
+           "current_resolved_ts"]
+
+
+def current_resolved_ts(domain) -> int:
+    """Domain-level resolved-ts (SHOW MASTER STATUS, bootstrap for
+    external consumers): works with or without live changefeeds."""
+    mgr = getattr(domain, "cdc", None)
+    if mgr is not None:
+        return mgr.capture.resolved_ts()
+    now_ts = domain.storage.oracle.get_ts()
+    return domain.storage.mvcc.resolved_floor(now_ts)

@@ -1,0 +1,414 @@
+"""Changefeed lifecycle: commit-ts sorter + resolved-ts emission +
+worker supervision (reference TiCDC owner/processor collapsed to one
+process: a feed is a worker thread pulling from the capture fan-out).
+
+Feed states (information_schema.tidb_changefeeds):
+
+    normal   — streaming; worker polls, emits, checkpoints
+    paused   — detached from capture; resume re-attaches and catch-up
+               scans the gap from checkpoint_ts
+    error    — last poll failed with a retryable class; worker is in
+               classified backoff (device_guard-style) and will retry
+    failed   — retry budget exhausted or a fatal error class; worker
+               stopped, checkpoint preserved (RESUME restarts it)
+    removed  — gone; persisted state deleted
+
+Emission protocol per poll (the order is what makes the watermark
+exact — see storage/mvcc.resolved_floor):
+
+    1. r = capture.resolved_ts()        — barrier FIRST
+    2. drain pending hook batches       — all commits <= r are now here
+    3. sort-merge into the commit-ts buffer, emit every whole txn with
+       commit_ts <= r in ascending order, DDL barriers first
+    4. sink.flush_resolved(r); checkpoint_ts = r; persist
+
+Checkpoint persistence: ``<data_dir>/cdc/<name>.json`` (atomic
+tmp+rename). A restarted domain resumes every persisted feed
+at-least-once from min(checkpoint_ts, sink.resume_ts()); the table
+sink's applied_ts skip makes its apply exactly-once.
+"""
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import threading
+import time
+
+from ..errors import TiDBError
+from ..utils import device_guard, failpoint
+from ..utils import metrics as metrics_util
+from .capture import Capture
+from .events import DDLEvent
+from .sinks import make_sink, observe_sink_delivery
+
+STATES = ("normal", "paused", "error", "failed", "removed")
+
+# classified backoff knobs (device_guard-style: retryable classes get
+# exponential backoff; fatal semantic errors stop the feed)
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 5.0
+_MAX_CONSECUTIVE_ERRORS = 16
+
+
+class Changefeed:
+    def __init__(self, manager, name: str, sink_uri: str,
+                 start_ts: int = 0, checkpoint_ts: int | None = None):
+        self.manager = manager
+        self.domain = manager.domain
+        self.name = name
+        self.sink_uri = sink_uri
+        self.start_ts = start_ts
+        self.checkpoint_ts = checkpoint_ts if checkpoint_ts is not None \
+            else start_ts
+        self.resolved = self.checkpoint_ts
+        self.state = "normal"
+        self.error = ""
+        self.consecutive_errors = 0
+        self.emitted_txns = 0
+        self.emitted_rows = 0
+        self._mu = threading.Lock()
+        self._persist_mu = threading.Lock()
+        self._buffer: list = []        # heap of (commit_ts, mutations)
+        self._buffered: set = set()    # commit_ts present in the heap
+        self._sub = None
+        self._resume_floor = 0         # hook batches at/below were sunk
+                                       # by a previous incarnation
+        self._catchup_seen: set = set()  # commit_ts the catch-up scan
+                                         # delivered (live dups dropped)
+        self._stop = threading.Event()
+        self._worker = None
+        self.sink = make_sink(sink_uri, self.domain)
+
+    # ---- attach / catch-up -------------------------------------------
+    def _attach(self):
+        """Subscribe to live commits, then catch-up scan the gap from
+        the resume point up to a fresh scan barrier. Subscription
+        happens FIRST, so every commit is either (a) published before
+        the subscribe — applied before its publication, hence visible
+        to the scan — or (b) fanned out to our queue by the hook.
+        Overlap (in both sources) is dropped by the exact set of
+        commit_ts the scan delivered, NOT by a ts floor: a floor would
+        silently eat a hook event that an unrelated open transaction
+        happens to sit below."""
+        cap = self.manager.capture
+        self._detach()               # never leak a prior subscription
+        self._sub = cap.subscribe()
+        sr = self.sink.resume_ts()
+        if sr is None:
+            resume = self.checkpoint_ts      # stateless sink: trust feed
+        else:
+            resume = min(self.checkpoint_ts, max(sr, self.start_ts))
+        barrier = cap.scan_barrier()
+        batches = cap.catchup_batches(resume, barrier)
+        with self._mu:
+            self._resume_floor = resume
+            self._catchup_seen = {ts for ts, _ in batches}
+        for ts, muts in batches:
+            self._push(ts, muts)
+
+    def _detach(self):
+        if self._sub is not None:
+            self.manager.capture.unsubscribe(self._sub)
+            self._sub = None
+
+    def _push(self, ts: int, muts: list):
+        with self._mu:
+            if ts in self._buffered:
+                return
+            self._buffered.add(ts)
+            heapq.heappush(self._buffer, (ts, muts))
+
+    # ---- the sorter + emission pass ----------------------------------
+    def poll_once(self) -> int:
+        """One capture->sort->emit->checkpoint pass; returns the number
+        of transactions emitted. Raises on sink/decode failure (the
+        worker classifies and backs off)."""
+        failpoint.inject("cdc-poll")
+        sub = self._sub
+        if sub is None:
+            # detached (paused, or a resume that has not re-attached
+            # yet): advancing the watermark here would publish a
+            # resolved ts past commits this feed never received
+            return 0
+        cap = self.manager.capture
+        r = cap.resolved_ts()
+        for ts, muts in cap.drain(sub):
+            if ts <= self._resume_floor or ts in self._catchup_seen:
+                continue
+            self._push(ts, muts)
+        emitted = 0
+        while True:
+            with self._mu:
+                if not self._buffer or self._buffer[0][0] > r:
+                    break
+                ts, muts = heapq.heappop(self._buffer)
+                self._buffered.discard(ts)
+            try:
+                failpoint.inject("cdc-emit")
+                events = cap.decode_batch(ts, muts)
+                rows = [e for e in events if not isinstance(e, DDLEvent)]
+                for e in events:
+                    if isinstance(e, DDLEvent):
+                        self.sink.emit_ddl(e)
+                if rows:
+                    self.sink.emit_txn(rows)
+                    observe_sink_delivery(self.name, self.sink.name,
+                                          len(rows))
+                    self.emitted_txns += 1
+                    self.emitted_rows += len(rows)
+            except BaseException:
+                # a popped-but-unemitted batch must survive the worker
+                # error (redelivered on retry — at-least-once)
+                self._push(ts, muts)
+                raise
+            emitted += 1
+        if self._sub is not sub:
+            # a concurrent PAUSE (or pause+resume) detached us mid-poll:
+            # the freed queue may have held published batches <= r that
+            # drain() never saw. Advancing the checkpoint past them would
+            # lose them for stateless sinks; the re-attach catch-up from
+            # the UNADVANCED checkpoint redelivers everything instead.
+            # (Events published after our drain are > r — resolved_floor
+            # guarantees commits <= r reached the hooks before r was
+            # computed — so skipping the advance is always sufficient.)
+            return emitted
+        if r > self.resolved:
+            self.sink.flush_resolved(r)
+            self.resolved = r
+            self.checkpoint_ts = r
+            self.manager.persist(self)
+        metrics_util.CDC_RESOLVED_TS.labels(self.name).set(self.resolved)
+        metrics_util.CDC_CHECKPOINT_TS.labels(self.name).set(
+            self.checkpoint_ts)
+        lag = self.resolved_lag_seconds()
+        if lag is not None:
+            metrics_util.CDC_RESOLVED_LAG_SECONDS.labels(
+                self.name).observe(lag)
+        return emitted
+
+    def resolved_lag_seconds(self) -> float | None:
+        wall = self.domain.storage.oracle.wall_for_ts(self.resolved)
+        if wall is None:
+            return None
+        return max(0.0, time.time() - wall)
+
+    # ---- worker supervision ------------------------------------------
+    def _run(self, poll_interval_s: float):
+        while not self._stop.is_set():
+            if self.state == "paused":
+                self._stop.wait(poll_interval_s)
+                continue
+            try:
+                self.poll_once()
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except BaseException as exc:       # noqa: BLE001
+                err_class = device_guard.classify(exc)
+                metrics_util.CDC_WORKER_ERRORS.labels(
+                    self.name, err_class).inc()
+                self.error = f"{type(exc).__name__}: {exc}"[:200]
+                self.consecutive_errors += 1
+                retryable = err_class != "fatal" or \
+                    isinstance(exc, failpoint.FailpointError)
+                if not retryable or \
+                        self.consecutive_errors > _MAX_CONSECUTIVE_ERRORS:
+                    self.state = "failed"
+                    # release the fan-out subscription: a dead feed
+                    # must not accumulate an unbounded queue (RESUME
+                    # re-attaches from the checkpoint)
+                    self._detach()
+                    return
+                if self.state not in ("paused", "removed"):
+                    # never overwrite a concurrent PAUSE/REMOVE — the
+                    # user's verb wins over the worker's retry loop
+                    self.state = "error"
+                backoff = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S *
+                              (2 ** min(self.consecutive_errors, 10)))
+                self._stop.wait(backoff)
+                continue
+            if self.state == "error":
+                self.state = "normal"
+            self.error = ""
+            self.consecutive_errors = 0
+            self._stop.wait(poll_interval_s)
+
+    def start(self, poll_interval_s: float | None = None):
+        if self._worker is not None and self._worker.is_alive():
+            return
+        if poll_interval_s is None:
+            poll_interval_s = self.manager.poll_interval_s()
+        self._attach()
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._run, args=(poll_interval_s,),
+            name=f"cdc-{self.name}", daemon=True)
+        self._worker.start()
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        w = self._worker
+        if w is not None and w.is_alive() and \
+                w is not threading.current_thread():
+            w.join(timeout)
+        self._worker = None
+        self._detach()
+
+    # ---- lifecycle verbs ---------------------------------------------
+    def pause(self):
+        if self.state in ("failed", "removed"):
+            raise TiDBError("changefeed '%s' is %s; cannot pause",
+                            self.name, self.state)
+        self.state = "paused"
+        self._detach()
+        self.manager.persist(self)
+
+    def resume(self):
+        if self.state == "removed":
+            raise TiDBError("changefeed '%s' is removed", self.name)
+        self.error = ""
+        self.consecutive_errors = 0
+        # re-attach BEFORE flipping the state: a live worker freed by
+        # the state change must never run a detached poll (it would
+        # publish a resolved ts past the paused-era commits it is about
+        # to catch up on). poll_once also refuses to run detached.
+        if self._worker is None or not self._worker.is_alive():
+            self.state = "normal"
+            self.start()
+        else:
+            if self._sub is None:
+                self._attach()      # paused in a live worker: re-attach
+            self.state = "normal"
+        # persist the state transition unconditionally: a paused or
+        # failed feed that was resumed must come back RUNNING after a
+        # domain restart, not in its pre-resume state
+        self.manager.persist(self)
+
+    def remove(self):
+        self.state = "removed"
+        self.stop()
+        try:
+            self.sink.close()
+        except OSError:
+            pass
+        self.manager.unpersist(self)
+
+
+class ChangefeedManager:
+    """Domain-scoped registry of changefeeds (reference TiCDC owner)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self.capture = Capture(domain)
+        self.feeds: dict[str, Changefeed] = {}
+        self._mu = threading.Lock()
+
+    def poll_interval_s(self) -> float:
+        from ..utils import env_int
+        v = self.domain.global_vars.get("tidb_tpu_cdc_poll_interval_ms")
+        if v is None:
+            v = env_int("TIDB_TPU_CDC_POLL_INTERVAL_MS", 50)
+        return max(1, int(v)) / 1000.0
+
+    # ---- lifecycle ----------------------------------------------------
+    def create(self, name: str, sink_uri: str, start_ts: int = 0,
+               auto_start: bool = True) -> Changefeed:
+        with self._mu:
+            if name in self.feeds and \
+                    self.feeds[name].state != "removed":
+                raise TiDBError("changefeed '%s' already exists", name)
+            feed = Changefeed(self, name, sink_uri, start_ts=start_ts)
+            self.feeds[name] = feed
+        self.persist(feed)
+        if auto_start:
+            feed.start()
+        return feed
+
+    def get(self, name: str) -> Changefeed:
+        feed = self.feeds.get(name)
+        if feed is None or feed.state == "removed":
+            raise TiDBError("changefeed '%s' does not exist", name)
+        return feed
+
+    def pause(self, name: str):
+        self.get(name).pause()
+
+    def resume(self, name: str):
+        self.get(name).resume()
+
+    def remove(self, name: str):
+        feed = self.get(name)
+        feed.remove()
+        with self._mu:
+            self.feeds.pop(name, None)
+
+    def shutdown(self):
+        for feed in list(self.feeds.values()):
+            feed.stop()
+
+    # ---- persistence --------------------------------------------------
+    def _cdc_dir(self):
+        if not self.domain.data_dir:
+            return None
+        return os.path.join(self.domain.data_dir, "cdc")
+
+    def persist(self, feed: Changefeed):
+        d = self._cdc_dir()
+        if d is None:
+            return
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{feed.name}.json")
+        tmp = path + ".tmp"
+        # serialized per feed, with the live fields read UNDER the lock:
+        # the worker's checkpoint persist races the SQL thread's
+        # lifecycle persist, and an unsynchronized last-replace-wins
+        # could land a stale state (e.g. "normal" over a PAUSE) —
+        # whichever persist runs second re-reads the current state
+        with feed._persist_mu:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"name": feed.name, "sink_uri": feed.sink_uri,
+                           "start_ts": feed.start_ts,
+                           "checkpoint_ts": feed.checkpoint_ts,
+                           "state": feed.state}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+    def unpersist(self, feed: Changefeed):
+        d = self._cdc_dir()
+        if d is None:
+            return
+        try:
+            os.remove(os.path.join(d, f"{feed.name}.json"))
+        except OSError:
+            pass
+
+    def resume_persisted(self):
+        """Domain open: re-create persisted feeds from their checkpoint
+        (at-least-once resume; paused/failed feeds come back in their
+        saved state and do not stream until resumed)."""
+        d = self._cdc_dir()
+        if d is None or not os.path.isdir(d):
+            return
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, fn), encoding="utf-8") as f:
+                    cfg = json.load(f)
+            except (OSError, ValueError):
+                continue
+            name = cfg.get("name")
+            if not name or name in self.feeds:
+                continue
+            feed = Changefeed(self, name, cfg.get("sink_uri", ""),
+                              start_ts=int(cfg.get("start_ts", 0)),
+                              checkpoint_ts=int(cfg.get(
+                                  "checkpoint_ts", 0)))
+            saved = cfg.get("state", "normal")
+            with self._mu:
+                self.feeds[name] = feed
+            if saved in ("paused", "failed"):
+                feed.state = saved
+            else:
+                feed.start()

@@ -1,0 +1,69 @@
+"""CDC event model (reference TiCDC's cdc/model: RowChangedEvent,
+DDLEvent, ResolvedTs — collapsed to the in-process engine's shapes).
+
+A changefeed emits three event kinds, all ordered by ``commit_ts``:
+
+  * ``RowEvent`` — one row mutation with old-value capture: the decoded
+    datums before and after the change plus the raw KV pair, so SQL-ish
+    sinks (ndjson) and KV-level sinks (the mirror table sink) both have
+    what they need without re-reading the store.
+  * ``DDLEvent`` — a schema-change barrier: a commit that touched the
+    meta namespace (``m`` keys). Sinks use it to re-sync schemas before
+    any later row event.
+  * resolved-ts — not an event object; sinks receive it via
+    ``Sink.flush_resolved(ts)`` after every batch (the watermark
+    contract: no later ``emit_txn`` will carry commit_ts <= ts).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+OP_INSERT = "insert"
+OP_UPDATE = "update"
+OP_DELETE = "delete"
+
+
+@dataclass
+class RowEvent:
+    commit_ts: int
+    db: str
+    table: str
+    table_id: int
+    handle: int
+    op: str                     # insert | update | delete
+    col_names: list             # column names, positional with datums
+    before: list | None         # datums (old value) or None for insert
+    after: list | None          # datums (new value) or None for delete
+    key: bytes                  # raw record key (source encoding)
+    value: bytes | None         # raw row value (None = delete)
+    table_info: object = None   # source TableInfo at capture time
+
+    def to_wire(self) -> dict:
+        """Canal-ish dict (old + new value) for textual sinks."""
+        def _cols(datums):
+            if datums is None:
+                return None
+            out = {}
+            for name, d in zip(self.col_names, datums):
+                out[name] = None if d is None else d.to_py()
+            return out
+        return {
+            "ts": self.commit_ts,
+            "db": self.db,
+            "table": self.table,
+            "type": self.op,
+            "handle": self.handle,
+            "old": _cols(self.before),
+            "data": _cols(self.after),
+        }
+
+
+@dataclass
+class DDLEvent:
+    commit_ts: int
+    schema_version: int = 0
+
+    def to_wire(self) -> dict:
+        return {"ts": self.commit_ts, "type": "ddl",
+                "schema_version": self.schema_version}

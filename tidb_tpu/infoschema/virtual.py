@@ -152,6 +152,23 @@ def _gen_data_lock_waits(domain):
         yield (key.hex(), waiter, holder)
 
 
+def _gen_changefeeds(domain):
+    """Live changefeed registry (reference TiCDC `cdc cli changefeed
+    list`, surfaced as a table): state, sink, checkpoint/resolved ts,
+    resolved-ts lag in seconds, delivery counters, last error."""
+    mgr = getattr(domain, "cdc", None)
+    if mgr is None:
+        return
+    for name in sorted(mgr.feeds):
+        f = mgr.feeds.get(name)    # racing ADMIN CHANGEFEED REMOVE
+        if f is None or f.state == "removed":
+            continue
+        lag = f.resolved_lag_seconds()
+        yield (f.name, f.state, f.sink_uri, f.start_ts, f.checkpoint_ts,
+               f.resolved, round(lag, 6) if lag is not None else None,
+               f.emitted_txns, f.emitted_rows, f.error or "")
+
+
 def _gen_resource_groups(domain):
     for g in domain.resource_groups.groups.values():
         limit = ""
@@ -342,6 +359,14 @@ VIRTUAL_DEFS = {
     "data_lock_waits": (_cols(("key", _S()), ("trx_id", _I()),
                               ("current_holding_trx_id", _I())),
                         _gen_data_lock_waits),
+    "tidb_changefeeds": (_cols(("changefeed", _S()), ("state", _S()),
+                               ("sink", _S()), ("start_ts", _I()),
+                               ("checkpoint_ts", _I()),
+                               ("resolved_ts", _I()),
+                               ("resolved_ts_lag_s", _F()),
+                               ("emitted_txns", _I()),
+                               ("emitted_rows", _I()),
+                               ("error", _S())), _gen_changefeeds),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

@@ -701,6 +701,16 @@ class AdminStmt(StmtNode):
 
 
 @dataclass
+class ChangefeedStmt(StmtNode):
+    """ADMIN CHANGEFEED {CREATE name SINK 'uri' [FROM ts] | PAUSE name
+    | RESUME name | REMOVE name | LIST} (tidb_tpu/cdc)."""
+    action: str = "list"          # create | pause | resume | remove | list
+    name: str = ""
+    sink_uri: str = ""
+    start_ts: int = 0
+
+
+@dataclass
 class TraceStmt(StmtNode):
     stmt: StmtNode = None
     format: str = "row"

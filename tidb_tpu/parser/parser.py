@@ -301,6 +301,33 @@ class Parser:
                 return ast.AdminStmt(kind="show_ddl")
             if self.accept_kw("checkpoint"):
                 return ast.AdminStmt(kind="checkpoint")
+            if self.accept_kw("changefeed"):
+                if self.accept_kw("create"):
+                    name = self.ident()
+                    self.expect_kw("sink")
+                    t = self.peek()
+                    if t.kind != "STRING":
+                        self.error("expected sink uri string")
+                    self.next()
+                    start_ts = 0
+                    if self.accept_kw("from"):
+                        ts_tok = self.peek()
+                        if ts_tok.kind != "NUMBER" or \
+                                not ts_tok.text.isdigit():
+                            self.error("expected integer start ts")
+                        self.next()
+                        start_ts = int(ts_tok.text)
+                    return ast.ChangefeedStmt(action="create", name=name,
+                                              sink_uri=t.text,
+                                              start_ts=start_ts)
+                for verb in ("pause", "resume", "remove"):
+                    if self.accept_kw(verb):
+                        return ast.ChangefeedStmt(action=verb,
+                                                  name=self.ident())
+                if self.accept_kw("list"):
+                    return ast.ChangefeedStmt(action="list")
+                self.error("expected CREATE/PAUSE/RESUME/REMOVE/LIST "
+                           "after ADMIN CHANGEFEED")
             self.error("unsupported ADMIN command")
         if kw == "trace":
             self.next()

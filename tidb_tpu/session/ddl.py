@@ -1213,7 +1213,15 @@ def backfill_index_shard(domain, tbl, idx, collect_keys=False,
                     if ka == kb and va != vb:
                         raise DuplicateKeyError(
                             "Duplicate entry for key '%s'", idx.name)
-            mvcc.ingest(muts, domain.storage.current_ts())
+            # commit-intent bracket around the ts allocation: the CDC
+            # resolved-ts floor must not pass the ingest frame's ts
+            # before the frame publishes (storage/mvcc resolved_floor)
+            pre_ts = domain.storage.current_ts()
+            intent = mvcc.begin_commit_intent(pre_ts)
+            try:
+                mvcc.ingest(muts, domain.storage.current_ts())
+            finally:
+                mvcc.end_commit_intent(intent)
         return len(idxs), key_hashes
     except BaseException:
         if txn is not None:

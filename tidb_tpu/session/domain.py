@@ -101,6 +101,14 @@ class Domain:
             logutil.set_sink_dir(data_dir)
             logutil.info("store_open", data_dir=data_dir)
             self._open_wal(data_dir)
+        # change data capture (tidb_tpu/cdc): changefeed registry +
+        # commit-stream capture; persisted feeds resume from their
+        # checkpoint-ts once the WAL/checkpoint replay above has the
+        # store consistent
+        from ..cdc import ChangefeedManager
+        self.cdc = ChangefeedManager(self)
+        if data_dir:
+            self.cdc.resume_persisted()
 
     def _open_wal(self, data_dir):
         """Restore the latest checkpoint (if any), replay the WAL tail,

@@ -507,6 +507,8 @@ class Session:
                     total += check_table(self, tbl, db)
                 return ResultSet(affected=total)
             return ResultSet()
+        if isinstance(stmt, ast.ChangefeedStmt):
+            return self._exec_changefeed(stmt)
         if isinstance(stmt, ast.TraceStmt):
             # span-style trace = EXPLAIN ANALYZE over the wrapped statement
             # (reference executor/trace.go renders span trees the same way)
@@ -912,6 +914,35 @@ class Session:
             return ResultSet()
         raise UnsupportedError("statement %s not supported",
                                type(stmt).__name__)
+
+    def _exec_changefeed(self, stmt) -> ResultSet:
+        """ADMIN CHANGEFEED ... (tidb_tpu/cdc lifecycle; SUPER-class
+        surface like the reference's cdc cli, so gate on a admin-ish
+        privilege)."""
+        from .show import _str_chunk
+        self.check_priv("super")
+        mgr = self.domain.cdc
+        if stmt.action == "create":
+            feed = mgr.create(stmt.name, stmt.sink_uri,
+                              start_ts=stmt.start_ts)
+            feeds = [feed]
+        elif stmt.action == "pause":
+            mgr.pause(stmt.name)
+            feeds = [mgr.get(stmt.name)]
+        elif stmt.action == "resume":
+            mgr.resume(stmt.name)
+            feeds = [mgr.get(stmt.name)]
+        elif stmt.action == "remove":
+            mgr.remove(stmt.name)
+            feeds = []
+        else:                       # list
+            feeds = sorted(mgr.feeds.values(), key=lambda f: f.name)
+        rows = [(f.name, f.state, f.sink_uri, f.start_ts,
+                 f.checkpoint_ts, f.resolved, f.error or None)
+                for f in feeds if f.state != "removed"]
+        return _str_chunk(["Changefeed", "State", "Sink", "Start_ts",
+                           "Checkpoint_ts", "Resolved_ts", "Error"],
+                          rows)
 
     def _check_ddl_priv(self, stmt):
         """DDL privilege gate (reference pkg/planner/core/planbuilder.go

@@ -227,10 +227,22 @@ def exec_show(sess, stmt):
         return _str_chunk(["Id", "User", "Host", "db", "Command", "Time",
                            "State", "Info"], rows)
     if kind == "master_status":
-        # single-process store: no replication channel (empty set,
-        # MySQL-compat headers so drivers don't choke)
-        return _str_chunk(["File", "Position", "Binlog_Do_DB",
-                           "Binlog_Ignore_DB", "Executed_Gtid_Set"], [])
+        # the commit log IS the binlog here: report the real WAL
+        # append position and the current resolved-ts so an external
+        # consumer can bootstrap a changefeed (ADMIN CHANGEFEED CREATE
+        # ... FROM <resolved_ts>) with a consistent starting point
+        from ..cdc import current_resolved_ts
+        import os as _os
+        wal = sess.domain.storage.mvcc.wal
+        fname, pos = "", 0
+        if wal is not None:
+            fname = _os.path.basename(wal.path)
+            pos = wal.position()
+        resolved = current_resolved_ts(sess.domain)
+        return _str_chunk(
+            ["File", "Position", "Binlog_Do_DB", "Binlog_Ignore_DB",
+             "Executed_Gtid_Set"],
+            [(fname, pos, "", "", f"resolved_ts:{resolved}")])
     if kind == "slave_status":
         return _str_chunk(["Slave_IO_State", "Master_Host",
                            "Master_User", "Slave_IO_Running",

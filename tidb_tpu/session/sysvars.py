@@ -156,6 +156,11 @@ for _v in [
            0, 3_600_000),
     SysVar("tidb_tpu_lock_wait_backoff_ms", SCOPE_BOTH,
            _env_int("TIDB_TPU_LOCK_WAIT_BACKOFF_MS", 10), "int", 1, 1000),
+    # changefeed worker poll cadence (tidb_tpu/cdc): how often each
+    # feed advances its resolved-ts watermark and drains to its sink
+    SysVar("tidb_tpu_cdc_poll_interval_ms", SCOPE_GLOBAL,
+           _env_int("TIDB_TPU_CDC_POLL_INTERVAL_MS", 50), "int",
+           1, 60_000),
 ]:
     register(_v)
 

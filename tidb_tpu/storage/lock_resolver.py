@@ -219,6 +219,18 @@ class LockResolver:
                     # and log it — replay must see the secondary too.
                     # Async locks skip the append: their prewrite
                     # already wrote the whole txn's durable frame.
+                    #
+                    # Deliberately NOT published to the commit hooks:
+                    # every committed txn has exactly ONE canonical
+                    # publication (one_pc / commit / finalize_async /
+                    # replay), and a resolver-applied secondary would be
+                    # a PARTIAL duplicate at the same commit_ts — the
+                    # CDC sorter dedups whole transactions by ts, so the
+                    # partial batch would shadow the full one. The
+                    # committing thread's commit INTENT holds the CDC
+                    # watermark below this commit_ts until its own
+                    # finalize publishes; a crashed committer's txn is
+                    # published by WAL replay on restart.
                     if store.wal is not None and not cur.min_commit_ts:
                         store.wal.append(status.commit_ts,
                                          [(key, cur.value)])

@@ -81,6 +81,17 @@ class MVCCStore:
         self._mu = threading.Lock()
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
         self.wal = None              # optional WalWriter
+        # resolved-ts bookkeeping (CDC, storage/../cdc): a commit is
+        # invisible to the watermark only while BOTH of these are empty
+        # for it. An *intent* covers the window from before its
+        # commit_ts allocation until its locks/publication exist (keyed
+        # by start_ts — commit_ts is always allocated later, so floor <=
+        # start_ts < commit_ts); a *publication* covers the window
+        # between the in-mutex apply and the commit hooks finishing on
+        # the committing thread (keyed by commit_ts).
+        self._commit_intents: dict[int, int] = {}   # token -> start_ts
+        self._publishing: dict[int, int] = {}       # token -> commit_ts
+        self._token_seq = 0
         # resolved-txn state (caller holds _mu for every access):
         # per-key rollback tombstones + the derived rolled-back set, and
         # start_ts -> commit_ts records for check_txn_status
@@ -116,6 +127,87 @@ class MVCCStore:
                 raise WriteConflictError(
                     "txn %d holds a rollback tombstone on a mutated key",
                     start_ts)
+
+    # ---- resolved-ts floor (CDC watermark) ----------------------------
+    def begin_commit_intent(self, start_ts: int) -> int:
+        """Announce an imminent commit attempt BEFORE its commit_ts is
+        allocated. Until end_commit_intent the resolved-ts floor cannot
+        pass ``start_ts``, closing the 1PC/async window where a commit
+        has a ts but no lock and no publication yet."""
+        with self._mu:
+            self._token_seq += 1
+            token = self._token_seq
+            self._commit_intents[token] = start_ts
+            return token
+
+    def end_commit_intent(self, token: int):
+        with self._mu:
+            self._commit_intents.pop(token, None)
+
+    def _begin_publish_locked(self, commit_ts: int) -> int:
+        """Caller holds self._mu, right after the in-mutex apply: the
+        commit is visible to readers but its hooks have not run."""
+        self._token_seq += 1
+        token = self._token_seq
+        self._publishing[token] = commit_ts
+        return token
+
+    def _publish(self, token: int, commit_ts: int, mutations: list):
+        """Run the commit hooks outside the mutex, then retire the
+        publication token. Every hook-calling path funnels through here
+        so subscribers (columnar engine, CDC capture) observe commits
+        exactly once each, in publication order per key."""
+        try:
+            for hook in self.commit_hooks:
+                hook(commit_ts, mutations)
+        finally:
+            with self._mu:
+                self._publishing.pop(token, None)
+
+    def resolved_floor(self, now_ts: int) -> int:
+        """Largest ts R <= now_ts such that every commit with
+        commit_ts <= R has already been published to the commit hooks
+        and no future commit can land at or below R. Three things hold
+        it down: live locks (an open txn's eventual commit_ts is
+        > lock.start_ts — pessimistic txns and async-commit finalize
+        windows), commit intents (pre-allocation windows), and in-flight
+        publications (applied, hooks still running)."""
+        with self._mu:
+            floor = now_ts
+            for lk in self._locks.values():
+                if lk.start_ts < floor:
+                    floor = lk.start_ts
+            for sts in self._commit_intents.values():
+                if sts < floor:
+                    floor = sts
+            for cts in self._publishing.values():
+                if cts - 1 < floor:
+                    floor = cts - 1
+            return floor
+
+    def value_before(self, key: bytes, commit_ts: int):
+        """Latest committed value strictly below ``commit_ts`` (CDC
+        old-value capture; None = absent or delete tombstone)."""
+        with self._mu:
+            vers = self._kv.get(key)
+            if vers is None:
+                return None
+            return vers.get(commit_ts - 1)
+
+    def version_scan(self, after_ts: int, upto_ts: int) -> list:
+        """[(commit_ts, key, value)] for every version in
+        (after_ts, upto_ts], ordered by (commit_ts, key) — the CDC
+        catch-up source of last resort when the WAL has been truncated
+        past ``after_ts`` (or never existed). Versions are append-only
+        in this engine, so the scan is complete for any retained ts."""
+        out = []
+        with self._mu:
+            for k, vers in self._kv.scan(b"", None):
+                for ts, v in zip(vers.ts_list, vers.values):
+                    if after_ts < ts <= upto_ts:
+                        out.append((ts, k, v))
+        out.sort(key=lambda t: (t[0], t[1]))
+        return out
 
     # ---- lock waiting / resolution ------------------------------------
     def _resolve_or_wait(self, blockers, waiter_ts: int, ctx: LockCtx):
@@ -420,8 +512,8 @@ class MVCCStore:
         with self._mu:
             self._record_commit_locked(start_ts, commit_ts)
             self._apply(mutations, commit_ts, release_start_ts=start_ts)
-        for hook in self.commit_hooks:
-            hook(commit_ts, mutations)
+            token = self._begin_publish_locked(commit_ts)
+        self._publish(token, commit_ts, mutations)
 
     def one_pc(self, mutations: list, start_ts: int, commit_ts: int,
                ctx: LockCtx | None = None):
@@ -447,10 +539,10 @@ class MVCCStore:
                     # held
                     self._apply(mutations, commit_ts,
                                 release_start_ts=start_ts)
+                    token = self._begin_publish_locked(commit_ts)
                     break
             self._resolve_or_wait(blockers, start_ts, ctx)
-        for hook in self.commit_hooks:
-            hook(commit_ts, mutations)
+        self._publish(token, commit_ts, mutations)
 
     def commit(self, mutations: list, start_ts: int, commit_ts: int):
         with self._mu:
@@ -471,15 +563,15 @@ class MVCCStore:
             failpoint.inject("2pc-commit-after-wal")
             self._record_commit_locked(start_ts, commit_ts)
             self._apply(mutations, commit_ts, release_start_ts=start_ts)
-        for hook in self.commit_hooks:
-            hook(commit_ts, mutations)
+            token = self._begin_publish_locked(commit_ts)
+        self._publish(token, commit_ts, mutations)
 
     def apply_replay(self, commit_ts: int, mutations: list):
         """WAL replay: apply a committed frame directly (no locks/WAL)."""
         with self._mu:
             self._apply(mutations, commit_ts)
-        for hook in self.commit_hooks:
-            hook(commit_ts, mutations)
+            token = self._begin_publish_locked(commit_ts)
+        self._publish(token, commit_ts, mutations)
 
     def ingest(self, mutations: list, commit_ts: int):
         """Bulk ingest of pre-built, sorted KV artifacts (reference
@@ -493,8 +585,8 @@ class MVCCStore:
             if self.wal is not None:
                 self.wal.append(commit_ts, mutations)
             self._apply(mutations, commit_ts)
-        for hook in self.commit_hooks:
-            hook(commit_ts, mutations)
+            token = self._begin_publish_locked(commit_ts)
+        self._publish(token, commit_ts, mutations)
 
     def rollback(self, keys: list, start_ts: int,
                  tombstone: bool = True):

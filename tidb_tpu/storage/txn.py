@@ -48,6 +48,23 @@ class Oracle:
             return 0
         return hist[i - 1][1]
 
+    def wall_for_ts(self, ts: int) -> float | None:
+        """Wallclock at which ``ts`` (or the nearest later ts) was
+        allocated — the inverse of ts_for_time, used for resolved-ts
+        lag in seconds. None when ts postdates recorded history.
+        Called per changefeed poll (~20Hz), so bisect with a key
+        instead of rebuilding a ts list from the 64k-entry ring."""
+        import bisect
+        with self._mu:
+            hist = list(self._history)
+        if not hist:
+            return None
+        # history is sorted by ts too (allocation order)
+        i = bisect.bisect_left(hist, ts, key=lambda h: h[1])
+        if i >= len(hist):
+            return None
+        return hist[i][0]
+
     def fast_forward(self, ts: int):
         """Advance past `ts` (WAL replay)."""
         with self._mu:
@@ -250,6 +267,24 @@ class Transaction:
         mvcc = self.storage.mvcc
         small = (len(mutations) <= keys_limit and
                  sum(len(k) for k, _ in mutations) <= size_limit)
+        # commit intent: from before the commit_ts allocation until the
+        # locks/publication exist, the CDC resolved-ts floor must not
+        # pass this txn (commit_ts is always > start_ts, so holding the
+        # floor at start_ts is sufficient). Without it a 1PC/async
+        # commit could land below an already-published watermark.
+        intent = mvcc.begin_commit_intent(self.start_ts)
+        try:
+            commit_ts = self._commit_modes(mvcc, mutations, primary,
+                                           one_pc, async_commit, small)
+        finally:
+            mvcc.end_commit_intent(intent)
+        self._release_locks(written={k for k, _ in mutations},
+                            committed=True)
+        self.committed = True
+        return commit_ts
+
+    def _commit_modes(self, mvcc, mutations, primary, one_pc,
+                      async_commit, small):
         if one_pc and small:
             commit_ts = self.storage.oracle.get_ts()
             mvcc.one_pc(mutations, self.start_ts, commit_ts,
@@ -285,9 +320,6 @@ class Transaction:
             commit_ts = self.storage.oracle.get_ts()
             mvcc.commit(mutations, self.start_ts, commit_ts)
             self.commit_mode = "2pc"
-        self._release_locks(written={k for k, _ in mutations},
-                            committed=True)
-        self.committed = True
         return commit_ts
 
     def rollback(self):

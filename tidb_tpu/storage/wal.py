@@ -102,12 +102,49 @@ def decode_checkpoint(data: bytes):
     return ts, triples
 
 
+def valid_prefix(path: str) -> int:
+    """Byte offset just past the last structurally valid frame (length
+    header complete, payload complete, crc matches). Everything beyond
+    is a crash-torn tail."""
+    if not os.path.exists(path):
+        return 0
+    good = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                return good
+            ln, crc = struct.unpack("<II", hdr)
+            payload = f.read(ln)
+            if len(payload) < ln or \
+                    (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                return good
+            good += 8 + ln
+
+
 class WalWriter:
     def __init__(self, path: str, sync: bool = False):
         self.path = path
         self.sync = sync
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # torn-tail repair BEFORE appending: replay() stops at the first
+        # bad frame, so a frame appended after a crash-torn tail would
+        # be silently unrecoverable. Truncate to the last valid frame
+        # boundary so the log stays a clean prefix.
+        if os.path.exists(path):
+            good = valid_prefix(path)
+            if good < os.path.getsize(path):
+                with open(path, "r+b") as tf:
+                    tf.truncate(good)
         self._f = open(path, "ab")
+
+    def position(self) -> int:
+        """Current append offset (end of the last durable frame) —
+        the SHOW MASTER STATUS binlog position analog."""
+        return self._f.tell()
+
+    def flush(self):
+        self._f.flush()
 
     def append(self, commit_ts: int, mutations: list):
         import time
