@@ -787,3 +787,28 @@ LSM_FLUSH_SECONDS = REGISTRY.histogram(
     buckets=exponential_buckets(0.001, 2.0, 16))
 LSM_COMPACTIONS = REGISTRY.counter(
     "tidb_tpu_lsm_compaction_total", "LSM run compactions")
+
+CDC_RESOLVED_LAG_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_cdc_resolved_ts_lag_seconds",
+    "Changefeed resolved-ts watermark age (wallclock now minus the "
+    "allocation time of the resolved ts), sampled per worker poll",
+    ("changefeed",),
+    buckets=exponential_buckets(0.001, 2.0, 18))
+CDC_SINK_ROWS = REGISTRY.counter(
+    "tidb_tpu_cdc_sink_row_total",
+    "Row events delivered to a changefeed sink", ("changefeed", "sink"))
+CDC_SINK_TXNS = REGISTRY.counter(
+    "tidb_tpu_cdc_sink_txn_total",
+    "Whole transactions delivered to a changefeed sink",
+    ("changefeed", "sink"))
+CDC_WORKER_ERRORS = REGISTRY.counter(
+    "tidb_tpu_cdc_worker_error_total",
+    "Changefeed worker poll failures by error class",
+    ("changefeed", "error_class"))
+CDC_CHECKPOINT_TS = REGISTRY.gauge(
+    "tidb_tpu_cdc_checkpoint_ts",
+    "Changefeed checkpoint ts (persisted resume point)",
+    ("changefeed",))
+CDC_RESOLVED_TS = REGISTRY.gauge(
+    "tidb_tpu_cdc_resolved_ts",
+    "Changefeed resolved ts (emission watermark)", ("changefeed",))
