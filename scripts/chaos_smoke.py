@@ -34,6 +34,7 @@ def main():
     # drag the small-input device paths into the blast radius too
     os.environ.setdefault("TIDB_TPU_SORT_MIN", "1")
     os.environ.setdefault("TIDB_TPU_WINDOW_MIN", "1")
+    os.environ.setdefault("TIDB_TPU_FRAGMENT_MIN_ROWS", "0")
 
     from tidb_tpu.testkit import TestKit
     from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES

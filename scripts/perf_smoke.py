@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Perf smoke: the whole-query single-dispatch contract, enforced.
+
+All 22 TPC-H queries at SF0.05 (CPU backend — the contract is about
+dispatch STRUCTURE, not device speed) must, at steady state:
+
+  * cross the host<->device boundary at most twice:
+    phase `dispatches` <= 2 and `syncs` <= 1 per query
+    (docs/PERFORMANCE.md sync budget; ISSUE 6 acceptance);
+  * re-upload ZERO bytes — every base-table buffer is resident in the
+    device store from the warmup pass (`upload_bytes` == 0);
+  * return rows identical to the pure-host path.
+
+The warmup pass pays compiles and uploads; the measured pass is the
+steady state a dashboard workload lives in. A fast slice runs in
+tier-1 (tests/test_device_residency.py::test_perf_smoke_fast_slice);
+this script is the full gate.
+
+Usage:  python scripts/perf_smoke.py
+Env:    PERF_SF (0.05), PERF_QUERIES (comma list, default all),
+        PERF_MAX_DISPATCHES (2), PERF_MAX_SYNCS (1)
+Exit:   0 every query within budget and host-identical; 1 otherwise.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# structure gate, not a speed gate: never burn a TPU grant on it
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
+        out=sys.stderr):
+    """-> list of failure strings (empty = gate green). Importable so
+    the tier-1 fast slice reuses the exact gate predicate."""
+    sf = float(os.environ.get("PERF_SF", "0.05")) if sf is None else sf
+    max_dispatches = int(os.environ.get("PERF_MAX_DISPATCHES", "2")) \
+        if max_dispatches is None else max_dispatches
+    max_syncs = int(os.environ.get("PERF_MAX_SYNCS", "1")) \
+        if max_syncs is None else max_syncs
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+    from tidb_tpu.utils import phase
+
+    if queries is None:
+        qenv = os.environ.get("PERF_QUERIES", "")
+        queries = qenv.split(",") if qenv else \
+            sorted(ALL_QUERIES, key=lambda q: int(q[1:]))
+
+    tk = TestKit()
+    print(f"# perf_smoke: sf={sf} queries={len(queries)} "
+          f"budget: dispatches<={max_dispatches} syncs<={max_syncs} "
+          f"upload_bytes==0", file=out)
+    load_tpch(tk, sf=sf, seed=42)
+
+    host = {}
+    tk.domain.copr.use_device = False
+    try:
+        for q in queries:
+            host[q] = tk.must_query(ALL_QUERIES[q]).rows
+    finally:
+        tk.domain.copr.use_device = True
+
+    for q in queries:                    # warmup: compiles + uploads
+        tk.must_query(ALL_QUERIES[q])
+
+    failures = []
+    for q in queries:
+        phase.reset()
+        try:
+            rows = tk.must_query(ALL_QUERIES[q]).rows
+        except Exception as e:           # noqa: BLE001
+            failures.append(f"{q}: error {type(e).__name__}: "
+                            f"{str(e)[:120]}")
+            continue
+        s = phase.snap()
+        d = s.get("dispatches", 0)
+        sy = s.get("syncs", 0)
+        ub = s.get("upload_bytes", 0)
+        line = (f"{q}: dispatches={d} syncs={sy} upload_bytes={ub} "
+                f"upload_hits={s.get('upload_hits', 0)}")
+        print(f"# {line}", file=out)
+        if d > max_dispatches:
+            failures.append(f"{q}: {d} dispatches > {max_dispatches}")
+        if sy > max_syncs:
+            failures.append(f"{q}: {sy} host syncs > {max_syncs}")
+        if ub > 0:
+            failures.append(f"{q}: re-uploaded {ub} bytes on a warm "
+                            "statement (residency broken)")
+        if rows != host[q]:
+            failures.append(f"{q}: device rows != host rows "
+                            f"({len(rows)} vs {len(host[q])})")
+    return failures
+
+
+def main():
+    failures = run()
+    if failures:
+        print("perf_smoke: FAIL", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("perf_smoke: OK — every query within the dispatch/sync "
+          "budget, zero warm re-uploads, host-identical rows",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -297,6 +297,10 @@ def test_copr_agg_every_class_degrades_identical(err):
 
 def test_copr_filter_grant_loss_falls_back_identical():
     tk = _tk()
+    # fragment selection would route this 400-row filter fragment to
+    # the host before any dispatch; force the device path so the
+    # injected failure exercises the copr/filter supervision site
+    tk.must_exec("set tidb_tpu_fragment_min_rows = 0")
     sql = "select a, c from t where c > 6 and b < 5 order by a"
     failpoint.enable("device_guard/copr/filter", "error:grant_lost")
     rows = tk.must_query(sql).rows
@@ -307,6 +311,7 @@ def test_copr_filter_grant_loss_falls_back_identical():
 
 def test_copr_topn_degrades_to_host_topn():
     tk = _tk()
+    tk.must_exec("set tidb_tpu_fragment_min_rows = 0")
     # unique sort key: LIMIT over ties is legitimately nondeterministic
     # across backends, which would make row comparison meaningless
     sql = "select a, c from t order by a desc limit 5"
@@ -454,6 +459,7 @@ def test_tpch_queries_under_grant_loss_everywhere(monkeypatch):
     monkeypatch.setenv("TIDB_TPU_WINDOW_MIN", "1")
     from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
     tk = TestKit()
+    tk.must_exec("set tidb_tpu_fragment_min_rows = 0")
     load_tpch(tk, sf=0.01, seed=42)
     for site in ("copr/agg", "copr/filter", "copr/topn", "copr/mpp",
                  "fused/kernel", "sort", "window", "join"):

@@ -1,0 +1,242 @@
+"""Device-resident columnar store (copr/residency.py) + donation guard
+(utils/jaxcfg.guard_donation): the PR-6 whole-query-dispatch contract.
+
+Pins the three invariants docs/PERFORMANCE.md documents:
+  * a second statement over an unchanged table re-uploads ZERO bytes
+    (phase upload_bytes == 0, upload_hits > 0) — residency;
+  * a DML commit (version bump) and a dirty-transaction overlay never
+    serve stale buffers — invalidation;
+  * a donated buffer is never handed to a second dispatch — donation.
+"""
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.copr.residency import DeviceResidentStore
+from tidb_tpu.utils import jaxcfg, phase
+from tidb_tpu.utils import metrics as _metrics
+
+N_ROWS = 600
+
+
+def _tk():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int, c int)")
+    vals = ",".join(f"({i}, {i % 7}, {i % 13})" for i in range(N_ROWS))
+    tk.must_exec(f"insert into t values {vals}")
+    return tk
+
+
+AGG_SQL = "select b, sum(c), count(*) from t group by b order by b"
+
+
+def _host_rows(tk, sql):
+    tk.domain.copr.use_device = False
+    try:
+        return tk.must_query(sql).rows
+    finally:
+        tk.domain.copr.use_device = True
+
+
+def _run_snap(tk, sql):
+    phase.reset()
+    rows = tk.must_query(sql).rows
+    return rows, phase.snap()
+
+
+# ---- unit: DeviceResidentStore ---------------------------------------
+
+def test_store_put_get_and_len():
+    st = DeviceResidentStore(1 << 20)
+    a = np.arange(8)
+    st.put(("u1", "c", 3), a, a.nbytes, uid="u1", version=3)
+    assert st.get(("u1", "c", 3)) is a
+    assert st.get(("u1", "c", 4)) is None
+    assert len(st) == 1 and st.bytes == a.nbytes
+
+
+def test_store_lru_eviction_refunds_charged_bytes():
+    st = DeviceResidentStore(100)
+    a = np.zeros(10, np.int8)
+    # replicated entries charge size * ndev: charge 60 for a 10-byte
+    # array; eviction must refund the 60, not the 10
+    st.put(("u", "a"), a, 60, uid="u", version=1)
+    st.put(("u", "b"), np.zeros(30, np.int8), 30, uid="u", version=1)
+    assert st.bytes == 90
+    st.put(("u", "c"), np.zeros(40, np.int8), 40, uid="u", version=1)
+    assert st.get(("u", "a")) is None        # LRU victim
+    assert st.bytes == 70                    # 30 + 40: 60 refunded
+
+
+def test_store_get_refreshes_lru_order():
+    st = DeviceResidentStore(100)
+    st.put(("u", "a"), np.zeros(1), 40, uid="u", version=1)
+    st.put(("u", "b"), np.zeros(1), 40, uid="u", version=1)
+    st.get(("u", "a"))                       # a is now most-recent
+    st.put(("u", "c"), np.zeros(1), 40, uid="u", version=1)
+    assert st.get(("u", "b")) is None
+    assert st.get(("u", "a")) is not None
+
+
+def test_store_version_invalidation_is_per_uid():
+    st = DeviceResidentStore(1 << 20)
+    st.put(("u1", "x"), np.zeros(1), 8, uid="u1", version=1)
+    st.put(("u1", "y"), np.zeros(1), 8, uid="u1", version=2)
+    st.put(("u2", "z"), np.zeros(1), 8, uid="u2", version=1)
+    dropped = st.invalidate("u1", keep_version=2)
+    assert dropped == 1
+    assert st.get(("u1", "x")) is None       # stale version died
+    assert st.get(("u1", "y")) is not None   # current version kept
+    assert st.get(("u2", "z")) is not None   # other table untouched
+    assert st.invalidate("u1", keep_version=None) == 1  # drop-all
+    assert len(st) == 1 and st.bytes == 8
+
+
+def test_store_invalidation_metric_cause():
+    st = DeviceResidentStore(1 << 20)
+    before = _metrics.DEV_BUFFER_EVICTIONS.labels("version").value
+    st.put(("u9", "x"), np.zeros(1), 8, uid="u9", version=1)
+    st.invalidate("u9", keep_version=2)
+    assert _metrics.DEV_BUFFER_EVICTIONS.labels("version").value \
+        == before + 1
+
+
+# ---- statement-level residency ---------------------------------------
+
+def test_second_statement_uploads_zero_bytes():
+    tk = _tk()
+    rows1, s1 = _run_snap(tk, AGG_SQL)
+    assert s1.get("uploads", 0) > 0          # cold: data went up
+    assert s1.get("upload_bytes", 0) > 0
+    rows2, s2 = _run_snap(tk, AGG_SQL)
+    assert rows2 == rows1
+    assert s2.get("upload_bytes", 0) == 0    # warm: fully resident
+    assert s2.get("uploads", 0) == 0
+    assert s2.get("upload_hits", 0) > 0
+    assert rows1 == _host_rows(tk, AGG_SQL)  # device == host
+
+
+def test_residency_shared_across_statement_shapes():
+    """Different statements over the same columns reuse the same
+    buffers (keying is (table, column, version, slice), not query)."""
+    tk = _tk()
+    tk.must_query(AGG_SQL)
+    _, s = _run_snap(tk, "select b, avg(c) from t group by b")
+    assert s.get("upload_bytes", 0) == 0
+    assert s.get("upload_hits", 0) > 0
+
+
+def test_dml_commit_invalidates_and_reuploads():
+    tk = _tk()
+    tk.must_query(AGG_SQL)
+    ver_evicts = _metrics.DEV_BUFFER_EVICTIONS.labels("version").value
+    tk.must_exec("update t set c = c + 1 where a = 0")
+    rows, s = _run_snap(tk, AGG_SQL)
+    # the commit bumped the version: stale buffers dropped eagerly,
+    # fresh data uploaded, and the answer reflects the write
+    assert s.get("upload_bytes", 0) > 0
+    assert _metrics.DEV_BUFFER_EVICTIONS.labels("version").value \
+        > ver_evicts
+    assert rows == _host_rows(tk, AGG_SQL)
+
+
+def test_dirty_overlay_never_serves_stale_buffers():
+    tk = _tk()
+    base = tk.must_query(AGG_SQL).rows
+    tk.must_exec("begin")
+    tk.must_exec("update t set c = c + 100 where a < 50")
+    dirty = tk.must_query(AGG_SQL).rows      # reads its own writes
+    assert dirty != base
+    assert dirty == _host_rows(tk, AGG_SQL)
+    tk.must_exec("rollback")
+    after, s = _run_snap(tk, AGG_SQL)
+    # rollback: committed version unchanged — the resident buffers are
+    # still valid and the overlay run must not have poisoned them
+    assert after == base
+    assert s.get("upload_bytes", 0) == 0
+
+
+def test_row_growth_reuploads_changed_slice_only_counters():
+    tk = _tk()
+    tk.must_query(AGG_SQL)
+    tk.must_exec(f"insert into t values ({N_ROWS}, 1, 1)")
+    rows, s = _run_snap(tk, AGG_SQL)
+    assert s.get("upload_bytes", 0) > 0      # new version: re-upload
+    assert rows == _host_rows(tk, AGG_SQL)
+    _, s2 = _run_snap(tk, AGG_SQL)
+    assert s2.get("upload_bytes", 0) == 0    # resident again
+
+
+# ---- donation guard --------------------------------------------------
+
+def test_guard_donation_blocks_buffer_reuse():
+    import jax.numpy as jnp
+
+    calls = []
+
+    def kern(x, mask):
+        calls.append(1)
+        return x
+
+    guarded = jaxcfg.guard_donation(kern, (1,))
+    m1 = jnp.ones(4, bool)
+    guarded(jnp.arange(4), m1)
+    with pytest.raises(RuntimeError, match="donated buffer reused"):
+        guarded(jnp.arange(4), m1)           # m1's HBM is dead
+    guarded(jnp.arange(4), jnp.ones(4, bool))  # fresh scratch: fine
+    assert len(calls) == 2                   # reuse failed BEFORE call
+
+
+def test_guard_donation_empty_argnums_passthrough():
+    def kern(x):
+        return x
+    assert jaxcfg.guard_donation(kern, ()) is kern
+
+
+def test_guard_donation_recycled_id_not_false_positive():
+    """A collected donated buffer's id() may be recycled by a fresh
+    array; the weakref check must not misfire on it."""
+    import gc
+    import jax.numpy as jnp
+
+    guarded = jaxcfg.guard_donation(lambda x, m: x, (1,))
+    m = jnp.ones(8, bool)
+    stale_id = id(m)
+    guarded(jnp.arange(8), m)
+    del m
+    gc.collect()
+    # the table may still hold stale_id -> dead weakref; any fresh
+    # buffer (whatever its id) must dispatch fine
+    from tidb_tpu.utils.jaxcfg import _DONATED
+    assert stale_id not in _DONATED or _DONATED[stale_id]() is None
+    guarded(jnp.arange(8), jnp.ones(8, bool))
+
+
+def test_perf_smoke_fast_slice():
+    """Tier-1 slice of scripts/perf_smoke.py: the single-dispatch
+    contract (dispatches <= 2, syncs <= 1, zero warm re-uploads,
+    host-identical rows) on a representative query subset at SF0.01 —
+    the full 22-query SF0.05 gate runs as the script."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "perf_smoke", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "perf_smoke.py"))
+    perf_smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_smoke)
+    # q1 scan-agg, q3 fused join-agg, q6 minimum slice, q18 group-topn
+    failures = perf_smoke.run(queries=["q1", "q3", "q6", "q18"],
+                              sf=0.01, out=open(os.devnull, "w"))
+    assert failures == []
+
+
+def test_donation_argnums_off_on_cpu_auto(monkeypatch):
+    monkeypatch.delenv("TIDB_TPU_DONATE", raising=False)
+    import jax
+    if jax.default_backend() == "cpu":
+        assert jaxcfg.donation_argnums(1) == ()
+    monkeypatch.setenv("TIDB_TPU_DONATE", "1")
+    assert jaxcfg.donation_argnums(1) == (1,)
+    monkeypatch.setenv("TIDB_TPU_DONATE", "0")
+    assert jaxcfg.donation_argnums(1) == ()

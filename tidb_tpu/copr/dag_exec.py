@@ -18,13 +18,15 @@ import time
 
 import numpy as np
 
-from ..utils import jaxcfg  # noqa: F401
+from ..utils import jaxcfg
 import jax
 import jax.numpy as jnp
 
 from ..expression import EvalCtx, eval_expr, eval_bool_mask
 from ..expression.vec import materialize_nulls
-from ..utils.fetch import prefetch
+from ..utils import env_int
+from ..utils.fetch import prefetch, host_array, host_int
+from .residency import DeviceResidentStore
 from ..utils import phase
 from ..utils import device_guard
 from ..utils import metrics as _metrics
@@ -86,29 +88,33 @@ class CoprExecutor:
                                              str(1 << 22)))
         self.device_rows = device_rows
         self.use_device = use_device
+        # fragment selection (docs/PERFORMANCE.md): a filter/top-n-only
+        # fragment below this many rows runs the host twin — its kernel
+        # computes in µs what the host↔device round trip costs in ms
+        # (~65-95ms on the axon tunnel), so dispatching it can only
+        # lose. Aggregation fragments always dispatch: their partials
+        # shrink the fetch to group cardinality, which is the thesis.
+        self.fragment_min_rows = env_int("TIDB_TPU_FRAGMENT_MIN_ROWS",
+                                         1 << 21)
         self._kernel_cache = _KernelCache()
         self.last_backend = ""          # backend of the latest execute()
-        # device buffer pool: column slices resident in HBM across queries,
-        # keyed by (table, column, version, slice, cap) — the "per-query
-        # device buffer pool" of SURVEY.md §5 generalized to cross-query
-        # reuse; invalidated by the columnar version counter
-        self._dev_cache: dict = {}
-        self._dev_cache_order: list = []
-        self._dev_cache_sizes: dict = {}  # key -> charged bytes (a
-        # replicated entry costs size*ndev; evictions must refund what
-        # was charged, not the logical array size)
-        self._dev_cache_bytes = 0
-        self._dev_cache_budget = dev_cache_bytes
+        # device-resident columnar store: column buffers stay in HBM
+        # across statements, keyed by (table, ..., version, ...) and
+        # eagerly invalidated when a DML commit bumps the version —
+        # the "per-query device buffer pool" of SURVEY.md §5
+        # generalized to cross-statement residency (copr/residency.py)
+        self._dev_store = DeviceResidentStore(dev_cache_bytes)
         # host-side per-version metadata: dim sort orders, learned group
         # bucket sizes (so the regrow loop doesn't re-run every query)
         self._host_cache: dict = {}
 
-    def _dev_put(self, key, arr_np, pad_fill=0):
-        """Upload (padded) with LRU caching; returns the device array."""
-        hit = self._dev_cache.get(key)
+    def _dev_put(self, key, arr_np, pad_fill=0, uid=None, version=None):
+        """Upload (padded) into the resident store; returns the device
+        array. uid/version feed eager invalidation (defaults: key[0] is
+        the table uid by every caller's key layout; version None means
+        LRU/uid-wide eviction only)."""
+        hit = self._dev_store.get(key)
         if hit is not None:
-            self._dev_cache_order.remove(key)
-            self._dev_cache_order.append(key)
             phase.inc("upload_hits")
             _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
@@ -123,16 +129,9 @@ class CoprExecutor:
         phase.add("upload_s", time.perf_counter() - t0)
         phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
         phase.inc("uploads")
-        nbytes = dev.size * dev.dtype.itemsize
-        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
-               and self._dev_cache_order):
-            old = self._dev_cache_order.pop(0)
-            self._dev_cache.pop(old)
-            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
-        self._dev_cache[key] = dev
-        self._dev_cache_order.append(key)
-        self._dev_cache_sizes[key] = nbytes
-        self._dev_cache_bytes += nbytes
+        self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
+                            uid=key[0] if uid is None else uid,
+                            version=version)
         return dev
 
     # ---- public -------------------------------------------------------
@@ -175,6 +174,10 @@ class CoprExecutor:
             tbl = self.engine.table(dag.table_info)
             if dag.table_info.id < 0:
                 read_ts = None              # session temp table: read latest
+            # eager residency invalidation: a DML commit bumped the
+            # version — drop the stale HBM buffers NOW instead of
+            # letting dead arrays age out by LRU pressure
+            self._dev_store.invalidate(tbl.uid, tbl.version)
         arrays, valid = tbl.snapshot(
             [cid for cid in (self._cid(dag, sc) for sc in dag.cols)
              if cid != -1], read_ts)
@@ -206,6 +209,26 @@ class CoprExecutor:
             # columnar arrays already live host-side; materialize there.
             self._bump("copr_host_exec")
             return self._execute_host(dag, tbl, arrays, valid, n, handles)
+        frag_min = self.fragment_min_rows
+        if ectx is not None:
+            try:
+                frag_min = int(ectx.sv.get("tidb_tpu_fragment_min_rows"))
+            except Exception:               # noqa: BLE001
+                pass
+        if not dag.aggs and not dag.group_items and n < frag_min:
+            # fragment selection: a filter/top-n-only fragment this
+            # small computes in µs what its dispatch round trip costs
+            # in ms, and its output (a row subset) is consumed by a
+            # host operator anyway — whole-query single-dispatch keeps
+            # the device program budget for the fragments that shrink
+            # data (aggregations). docs/PERFORMANCE.md.
+            _metrics.FRAGMENT_ROUTING.labels("host_small").inc()
+            dom = getattr(self, "domain", None)
+            if dom is not None:
+                dom.inc_metric("copr_fragment_gated")
+            self._bump("copr_host_exec")
+            return self._execute_host(dag, tbl, arrays, valid, n, handles)
+        _metrics.FRAGMENT_ROUTING.labels("device").inc()
         if use_mpp and (dag.aggs or dag.group_items) and not overlay \
                 and not dag.host_filters \
                 and n >= mpp_min_rows:
@@ -467,10 +490,14 @@ class CoprExecutor:
         for k, (data, nulls, sdict) in cols.items():
             ck = bind_keys.get(k)
             if ck is not None:
-                jd = self._dev_put(ck + ("d", cap), data)
+                # _bind_cols key layout: (uid, cid, version, start, stop)
+                jd = self._dev_put(ck + ("d", cap), data,
+                                   uid=ck[0], version=ck[2])
                 jn = None
                 if nulls is not None:
-                    jn = self._dev_put(ck + ("n", cap), nulls, pad_fill=True)
+                    jn = self._dev_put(ck + ("n", cap), nulls,
+                                       pad_fill=True,
+                                       uid=ck[0], version=ck[2])
             else:
                 d = data
                 if len(d) != cap:
@@ -497,56 +524,58 @@ class CoprExecutor:
                 self._mesh = make_mesh()
         return self._mesh or None
 
-    def _dev_put_sharded(self, key, arr_np, mesh, cap, pad_fill=0):
-        hit = self._dev_cache.get(key)
+    def _dev_put_sharded(self, key, arr_np, mesh, cap, pad_fill=0,
+                         uid=None, version=None):
+        hit = self._dev_store.get(key)
         if hit is not None:
-            self._dev_cache_order.remove(key)
-            self._dev_cache_order.append(key)
+            phase.inc("upload_hits")
+            _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
+        _metrics.DEV_BUFFER_POOL.labels("miss").inc()
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        t0 = time.perf_counter()
         if len(arr_np) != cap:
             arr_np = np.concatenate(
                 [arr_np, np.full(cap - len(arr_np), pad_fill,
                                  dtype=arr_np.dtype)])
         dev = jax.device_put(arr_np, NamedSharding(mesh, P("dp")))
-        nbytes = dev.size * dev.dtype.itemsize
-        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
-               and self._dev_cache_order):
-            old = self._dev_cache_order.pop(0)
-            self._dev_cache.pop(old)
-            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
-        self._dev_cache[key] = dev
-        self._dev_cache_order.append(key)
-        self._dev_cache_sizes[key] = nbytes
-        self._dev_cache_bytes += nbytes
+        phase.add("upload_s", time.perf_counter() - t0)
+        phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
+        phase.inc("uploads")
+        self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
+                            uid=key[0] if uid is None else uid,
+                            version=version)
         return dev
 
-    def _dev_put_replicated(self, key, arr_np, mesh, cap, pad_fill=0):
+    def _dev_put_replicated(self, key, arr_np, mesh, cap, pad_fill=0,
+                            uid=None, version=None):
         """Broadcast-exchange upload: the array replicates to every mesh
-        device (NamedSharding with an empty spec)."""
-        hit = self._dev_cache.get(key)
+        device (NamedSharding with an empty spec); charged at
+        size * ndev (evictions must refund what was charged)."""
+        hit = self._dev_store.get(key)
         if hit is not None:
-            self._dev_cache_order.remove(key)
-            self._dev_cache_order.append(key)
+            phase.inc("upload_hits")
+            _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
+        _metrics.DEV_BUFFER_POOL.labels("miss").inc()
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        t0 = time.perf_counter()
         if len(arr_np) != cap:
             arr_np = np.concatenate(
                 [arr_np, np.full(cap - len(arr_np), pad_fill,
                                  dtype=arr_np.dtype)])
         dev = jax.device_put(arr_np, NamedSharding(mesh, P()))
-        nbytes = dev.size * dev.dtype.itemsize * mesh.devices.size
-        while (self._dev_cache_bytes + nbytes > self._dev_cache_budget
-               and self._dev_cache_order):
-            old = self._dev_cache_order.pop(0)
-            self._dev_cache.pop(old)
-            self._dev_cache_bytes -= self._dev_cache_sizes.pop(old, 0)
-        self._dev_cache[key] = dev
-        self._dev_cache_order.append(key)
-        self._dev_cache_sizes[key] = nbytes
-        self._dev_cache_bytes += nbytes
+        phase.add("upload_s", time.perf_counter() - t0)
+        phase.add("upload_bytes",
+                  dev.size * dev.dtype.itemsize * mesh.devices.size)
+        phase.inc("uploads")
+        self._dev_store.put(key, dev,
+                            dev.size * dev.dtype.itemsize *
+                            mesh.devices.size,
+                            uid=key[0] if uid is None else uid,
+                            version=version)
         return dev
 
     def _try_execute_mpp(self, dag, tbl, arrays, valid, n, handles):
@@ -585,12 +614,15 @@ class CoprExecutor:
             ck_base = (tbl.uid, "mppcol", cid_of_idx.get(k, -1),
                        tbl.version, ndev, padded)
             args.append(self._dev_put_sharded(ck_base + ("d",), data, mesh,
-                                              padded))
+                                              padded, uid=tbl.uid,
+                                              version=tbl.version))
             has_nulls[k] = nulls is not None
             if nulls is not None:
                 args.append(self._dev_put_sharded(ck_base + ("n",), nulls,
                                                   mesh, padded,
-                                                  pad_fill=True))
+                                                  pad_fill=True,
+                                                  uid=tbl.uid,
+                                                  version=tbl.version))
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         vpad = np.concatenate([valid[:n], np.zeros(padded - n, dtype=bool)]) \
@@ -623,26 +655,30 @@ class CoprExecutor:
         sdicts = {k: c[2] for k, c in cols.items()}
         filters = list(dag.filters)
         if kern is None:
-            @jax.jit
-            def kern(jc, vv):
+            def _filter_body(jc, vv):
                 full = {k: (d, nl, sdicts[k]) for k, (d, nl) in jc.items()}
                 ctx = EvalCtx(jnp, cap, full, host=False)
                 mask = vv
                 for f in filters:
                     mask = mask & eval_bool_mask(ctx, f)
                 return mask
+            # the validity mask is per-dispatch scratch (rebuilt by
+            # _pad_upload every call, never pooled): donate its HBM
+            dn = jaxcfg.donation_argnums(1)
+            kern = jaxcfg.guard_donation(
+                jax.jit(_filter_body, donate_argnums=dn), dn)
             kern = self._kernel_cache.put(key, kern)
         jcols, vv = self._pad_upload(cols, v, m, cap)
         jc = {k: (d, nl) for k, (d, nl, _) in jcols.items()}
-        mask = kern(jc, vv)
+        mask = host_array(prefetch(kern(jc, vv)))
         # host-only filters applied on host afterwards
         if dag.host_filters:
             ctx = EvalCtx(np, m, cols, host=True)
-            hm = np.asarray(mask)[:m].copy()
+            hm = mask[:m].copy()
             for f in dag.host_filters:
                 hm &= np.asarray(eval_bool_mask(ctx, f))
             return hm
-        return np.asarray(mask)
+        return mask
 
     def _run_topn_partition(self, dag, tbl, cols, v, m, cap):
         """Fused filter + device top-k over the single sort key; returns
@@ -658,8 +694,7 @@ class CoprExecutor:
         if kern is None:
             filters = list(dag.filters)
 
-            @jax.jit
-            def kern(jc, vv):
+            def _topn_body(jc, vv):
                 full = {kk: (d, nl, sdicts[kk]) for kk, (d, nl) in jc.items()}
                 ctx = EvalCtx(jnp, cap, full, host=False)
                 mask = vv
@@ -686,6 +721,9 @@ class CoprExecutor:
                 _, top_idx = jax.lax.top_k(kv, min(k, cap))
                 cnt = jnp.minimum(jnp.sum(mask.astype(jnp.int64)), k)
                 return top_idx, cnt
+            dn = jaxcfg.donation_argnums(1)
+            kern = jaxcfg.guard_donation(
+                jax.jit(_topn_body, donate_argnums=dn), dn)
             kern = self._kernel_cache.put(key, kern)
         jcols, vv = self._pad_upload(cols, v, m, cap)
         jc = {kk: (d, nl) for kk, (d, nl, _) in jcols.items()}
@@ -698,7 +736,7 @@ class CoprExecutor:
                 if m != cap else hm
             vv = vv & jnp.asarray(hmp)
         top_idx, cnt = prefetch(kern(jc, vv))
-        return np.asarray(top_idx)[:int(cnt)]
+        return host_array(top_idx)[:host_int(cnt)]
 
     def _topn_host(self, dag, cols, v, m):
         (expr, desc), k = dag.topn
@@ -785,7 +823,7 @@ class CoprExecutor:
             res = prefetch(kern(jc, vv))
             if strides is not None:
                 return _compact_dense(dag, res, strides, kd, sd)
-            ngroups = int(res["ngroups"])
+            ngroups = host_int(res["ngroups"])
             if impl == "runs" and ngroups > max(_RUNS_DEGRADE_MIN, m // 4):
                 # keys uncorrelated with storage order: runs exploded
                 # into ~per-row partials. Pin this (table, group, agg)
@@ -799,9 +837,10 @@ class CoprExecutor:
                 continue
             return PartialAggResult(
                 ngroups=ngroups,
-                keys=[np.asarray(k)[:ngroups] for k in res["keys"]],
-                key_nulls=[np.asarray(kn)[:ngroups] for kn in res["key_nulls"]],
-                states=[[np.asarray(s)[:ngroups] for s in st]
+                keys=[host_array(k)[:ngroups] for k in res["keys"]],
+                key_nulls=[host_array(kn)[:ngroups]
+                           for kn in res["key_nulls"]],
+                states=[[host_array(s)[:ngroups] for s in st]
                         for st in res["states"]],
                 key_dicts=kd, state_dicts=sd,
             )
@@ -1501,15 +1540,16 @@ def _build_dense_agg_kernel(dag, sample_cols, cap, sizes):
     group_items = list(dag.group_items)
     aggs = list(dag.aggs)
 
-    @jax.jit
-    def kern(jc, vv):
+    def _dense_body(jc, vv):
         full = {k: (d, nl, sdicts[k]) for k, (d, nl) in jc.items()}
         ctx = EvalCtx(jnp, cap, full, host=False)
         mask = vv
         for f in dag.filters:
             mask = mask & eval_bool_mask(ctx, f)
         return dense_agg_body(ctx, mask, group_items, aggs, sizes, cap)
-    return kern
+    dn = jaxcfg.donation_argnums(1)
+    return jaxcfg.guard_donation(
+        jax.jit(_dense_body, donate_argnums=dn), dn)
 
 
 def _psum_first(lv, lc, axis):
@@ -1606,7 +1646,7 @@ def _build_dense_agg_kernel_mpp(dag, sample_cols, local_cap, sizes, mesh,
 def _compact_dense(dag, res, sizes, key_dicts, state_dicts):
     """Compact the dense slot table (host side; <= _DENSE_MAX slots)."""
     prefetch(res)
-    present = np.asarray(res["present"])
+    present = host_array(res["present"])
     slots = np.nonzero(present > 0)[0]
     ngroups = len(slots)
     keys = []
@@ -1619,7 +1659,7 @@ def _compact_dense(dag, res, sizes, key_dicts, state_dicts):
         key_nulls.append(code == 0)
     keys.reverse()
     key_nulls.reverse()
-    states = [[np.asarray(s)[slots] for s in st] for st in res["states"]]
+    states = [[host_array(s)[slots] for s in st] for st in res["states"]]
     return PartialAggResult(ngroups=ngroups, keys=keys, key_nulls=key_nulls,
                             states=states, key_dicts=key_dicts,
                             state_dicts=state_dicts)
@@ -1641,8 +1681,7 @@ def _build_agg_kernel(dag, sample_cols, cap, group_bucket, impl=None):
     group_items = list(dag.group_items)
     aggs = list(dag.aggs)
 
-    @jax.jit
-    def kern(jc, vv):
+    def _agg_body(jc, vv):
         full = {k: (d, nl, sdicts[k]) for k, (d, nl) in jc.items()}
         ctx = EvalCtx(jnp, cap, full, host=False)
         mask = vv
@@ -1650,7 +1689,9 @@ def _build_agg_kernel(dag, sample_cols, cap, group_bucket, impl=None):
             mask = mask & eval_bool_mask(ctx, f)
         return sort_agg_body(ctx, mask, group_items, aggs, cap,
                              group_bucket, impl=impl)
-    return kern
+    dn = jaxcfg.donation_argnums(1)
+    return jaxcfg.guard_donation(
+        jax.jit(_agg_body, donate_argnums=dn), dn)
 
 
 def sort_agg_body(ctx, mask, group_items, aggs, cap, group_bucket,

@@ -32,8 +32,9 @@ from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
                        dense_agg_body, dense_agg_states, sort_agg_body,
                        _compact_dense, _I64_MAX, _segment_impl,
                        _dense_nslots)
-from ..utils.fetch import prefetch
+from ..utils.fetch import prefetch, host_array, host_int
 from ..utils import failpoint
+from ..utils import jaxcfg
 
 _POS_DENSE_MAX = 1 << 22
 
@@ -574,8 +575,10 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
         key = (tbl.uid, tag, ver, read_ts if ts_keyed else None,
                length) + mk + (acap,)
         if mesh is None:
-            return copr._dev_put(key, arr, pad_fill=fill)
-        return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill)
+            return copr._dev_put(key, arr, pad_fill=fill,
+                                 uid=tbl.uid, version=ver)
+        return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill,
+                                        uid=tbl.uid, version=ver)
 
     pre = bool(meta.get("pre"))
     args = {"cols": {}}
@@ -786,7 +789,7 @@ def _pos_group_map(plan, dim_metas):
 def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
     """Decode dim positions back into group-key values (host side)."""
     prefetch(res)
-    present = np.asarray(res["present"])
+    present = host_array(res["present"])
     slots = np.nonzero(present > 0)[0]
     rem = slots.copy()
     poses = {}
@@ -803,7 +806,7 @@ def _compact_pos_dense(plan, res, group_map, pos_dims, dim_metas, sd):
                                         nulls is not None)
                          else np.zeros(len(pos), dtype=bool))
         key_dicts.append(sdict)
-    states = [[np.asarray(s)[slots] for s in st] for st in res["states"]]
+    states = [[host_array(s)[slots] for s in st] for st in res["states"]]
     return PartialAggResult(ngroups=len(slots), keys=keys,
                             key_nulls=key_nulls, states=states,
                             key_dicts=key_dicts, state_dicts=sd)
@@ -1004,7 +1007,11 @@ def _build_fused_kernel(plan, fact_cap, fact_sdicts, dim_caps, dim_ns,
                                dim_ns, dim_sns, dim_layouts, agg_kind,
                                agg_param, dim_pres, ecap=ecap,
                                want_fnvalid=True)
-    return jax.jit(body)
+    # donate the fact validity mask: per-dispatch scratch rebuilt by
+    # _pad_upload every call; dim args and fact columns ride the
+    # resident pool and must never be donated
+    dn = jaxcfg.donation_argnums(1)
+    return jaxcfg.guard_donation(jax.jit(body, donate_argnums=dn), dn)
 
 
 def _build_fused_kernel_mpp(plan, local_cap, fact_sdicts, dim_caps,
@@ -1195,6 +1202,9 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     sharded over 'dp', dims broadcast, aggregation allreduced."""
     engine = copr.engine
     fact_tbl = engine.table(plan.fact_dag.table_info)
+    # eager residency invalidation for every table the fragment binds:
+    # stale-version HBM buffers die here, not under LRU pressure
+    copr._dev_store.invalidate(fact_tbl.uid, fact_tbl.version)
     dim_metas = []
     for dim in plan.dims:
         if dim.subplan is not None:
@@ -1204,6 +1214,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             dim_metas.append(meta)
             continue
         tbl = engine.table(dim.dag.table_info)
+        copr._dev_store.invalidate(tbl.uid, tbl.version)
         if tbl.n == 0:
             if dim.join_type in ("inner", "semi"):
                 return []         # inner/semi with empty dim: no rows
@@ -1516,7 +1527,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             # fact-filter survivor count BEFORE any compaction loss, so
             # an overflowed run is incorrect and must not be consumed)
             if _compact_policy(copr, ecapk, ecap,
-                               int(res["fnvalid"]), cap) == "retry":
+                               host_int(res["fnvalid"]), cap) == "retry":
                 state = _dispatch_part(cols, v, m, bind_keys)
                 continue
             if pos_spec is not None:
@@ -1527,7 +1538,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 out.append(_compact_dense(shim, res, sizes, kd, sd))
                 return
             if agg_kind == "onehot":
-                if int(res["miss"]) > 0:
+                if host_int(res["miss"]) > 0:
                     # new/changed keys since the table was learned:
                     # fall back to the sorted lowering and relearn
                     if getattr(copr, "domain", None) is not None:
@@ -1538,7 +1549,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 OH = oh_table
                 if getattr(copr, "domain", None) is not None:
                     copr.domain.inc_metric("fused_onehot_agg")
-                acc = np.asarray(res["oh_acc"])
+                acc = host_array(res["oh_acc"])
                 states, rowcnt = _de.onehot_decode_states(
                     acc, plan.aggs, OH["nslots"])
                 oh_parts.append((len(out), rowcnt))
@@ -1548,9 +1559,9 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                     key_nulls=[kn.copy() for kn in OH["key_nulls"]],
                     states=states, key_dicts=kd, state_dicts=sd))
                 return
-            ngroups = int(res["ngroups"])
+            ngroups = host_int(res["ngroups"])
             if _compact_policy(copr, compk, agg_param[3],
-                               int(res["nvalid"]), cap) == "retry":
+                               host_int(res["nvalid"]), cap) == "retry":
                 state = _dispatch_part(cols, v, m, bind_keys)
                 continue
             if agg_param[1] == "runs" and \
@@ -1576,13 +1587,13 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 # provably covers the true top k before trusting it
                 kprime = topn_k[3]
                 ncand = min(ngroups, kprime)
-                ckeys = [np.asarray(k)[:ncand] for k in res["keys"]]
-                cnulls = [np.asarray(kn)[:ncand]
+                ckeys = [host_array(k)[:ncand] for k in res["keys"]]
+                cnulls = [host_array(kn)[:ncand]
                           for kn in res["key_nulls"]]
-                cstates = [[np.asarray(s)[:ncand] for s in st]
+                cstates = [[host_array(s)[:ncand] for s in st]
                            for st in res["states"]]
                 if ngroups > kprime:
-                    sel = np.asarray(res["sel"])[:ncand]
+                    sel = host_array(res["sel"])[:ncand]
                     real_m = _topn_metric_host(ts, plan.aggs, ckeys,
                                                cnulls, cstates)
                     nf = ~((sel == 0) | (sel == ngroups - 1))
@@ -1603,9 +1614,9 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                     ngroups=ncand, keys=ckeys, key_nulls=cnulls,
                     states=cstates, key_dicts=kd, state_dicts=sd))
                 return
-            ks = [np.asarray(k)[:ngroups] for k in res["keys"]]
-            kns = [np.asarray(kn)[:ngroups] for kn in res["key_nulls"]]
-            sts = [[np.asarray(s)[:ngroups] for s in st]
+            ks = [host_array(k)[:ngroups] for k in res["keys"]]
+            kns = [host_array(kn)[:ngroups] for kn in res["key_nulls"]]
+            sts = [[host_array(s)[:ngroups] for s in st]
                    for st in res["states"]]
             if oh_elig and copr._host_cache.get(ohk) is None:
                 # runs partials may repeat a key once per run, so the
@@ -1812,12 +1823,13 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         data, nulls, _sd = cols[sc.col.idx]
         jd = copr._dev_put_sharded(
             (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded, "d"),
-            data, mesh, padded)
+            data, mesh, padded, uid=fact_tbl.uid, version=ver)
         jn = None
         if nulls is not None:
             jn = copr._dev_put_sharded(
                 (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded,
-                 "n"), nulls, mesh, padded, pad_fill=True)
+                 "n"), nulls, mesh, padded, pad_fill=True,
+                uid=fact_tbl.uid, version=ver)
         fjc[sc.col.idx] = (jd, jn)
     vpad = fact_valid[:n] if padded == n else np.concatenate(
         [fact_valid[:n], np.zeros(padded - n, dtype=bool)])
@@ -1856,10 +1868,10 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                                        pos_spec[1], dim_metas, sd)]
         if sizes is not None:
             return [_compact_dense(shim, res, sizes, kd, sd)]
-        ngroups_arr = np.asarray(res["ngroups"])     # [ndev]
+        ngroups_arr = host_array(res["ngroups"])     # [ndev]
         ng_max = int(ngroups_arr.max())
         if _compact_policy(copr, compk, agg_param[3],
-                           int(np.asarray(res["nvalid"]).max()),
+                           int(host_array(res["nvalid"]).max()),
                            local) == "retry":
             continue
         if agg_param[1] == "runs" and \
@@ -1882,10 +1894,10 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
             sl = slice(si * group_bucket, (si + 1) * group_bucket)
             out.append(PartialAggResult(
                 ngroups=ng,
-                keys=[np.asarray(k)[sl][:ng] for k in res["keys"]],
-                key_nulls=[np.asarray(kn)[sl][:ng]
+                keys=[host_array(k)[sl][:ng] for k in res["keys"]],
+                key_nulls=[host_array(kn)[sl][:ng]
                            for kn in res["key_nulls"]],
-                states=[[np.asarray(s)[sl][:ng] for s in st]
+                states=[[host_array(s)[sl][:ng] for s in st]
                         for st in res["states"]],
                 key_dicts=kd, state_dicts=sd))
         return out

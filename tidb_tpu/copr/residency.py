@@ -1,0 +1,109 @@
+"""Device-resident columnar store: the HBM buffer pool behind every
+upload seam (copr column slices, fused-pipeline dim tables, MPP shards).
+
+Base-table column buffers are keyed by (table uid, ..., version, ...)
+so repeated analytic statements over an unchanged table upload ZERO
+bytes — the PystachIO thesis (PAPERS.md): accelerator query engines win
+only when data stays resident in device memory across operators and
+statements. The store adds the two behaviors the old ad-hoc LRU dict
+lacked:
+
+* EAGER VERSION INVALIDATION: a DML commit bumps the table version;
+  the next bind drops every buffer recorded under an older version
+  instead of letting dead HBM age out by LRU pressure (a steady write
+  trickle would otherwise keep the pool full of unreachable buffers).
+* a per-table key index, so invalidation is O(buffers of that table),
+  not O(pool).
+
+Padding is bucketed (chunk.device.shape_bucket) BEFORE keying: growth
+within a bucket re-uploads the changed data but reuses the compiled
+kernel (same static shape); only growth past a bucket boundary
+re-pads. Dirty-transaction overlays never enter the pool (their keys
+are never cacheable — see _partitions' empty bind_keys).
+
+Thread safety: one store is shared by every connection thread of a
+domain; all internal state mutates under one lock (the get/put fast
+paths are a few dict ops)."""
+from __future__ import annotations
+
+import threading
+
+from ..utils import metrics as _metrics
+
+
+class DeviceResidentStore:
+    """LRU + version-indexed pool of device arrays, byte-budgeted."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.bytes = 0
+        self._mu = threading.Lock()
+        self._entries: dict = {}       # key -> device array
+        self._sizes: dict = {}         # key -> charged bytes (replicated
+        #                                entries charge size * ndev)
+        self._order: dict = {}         # key -> None; insertion order IS
+        #                                LRU order (py3.7 dicts), so
+        #                                touch/evict are O(1) — no list
+        #                                scan under the lock on the
+        #                                per-column hot path
+        self._uid_of: dict = {}        # key -> uid it was indexed under
+        self._by_uid: dict = {}        # uid -> {key: version}
+
+    def __len__(self):
+        return len(self._entries)
+
+    def get(self, key):
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._order.pop(key)
+                self._order[key] = None      # move to MRU end
+            return hit
+
+    def put(self, key, dev, nbytes: int, uid=None, version=None):
+        """Insert a buffer charged at nbytes; evicts LRU entries past
+        the byte budget. uid/version feed the invalidation index —
+        unversioned entries (version None) are dropped whenever their
+        uid invalidates."""
+        with self._mu:
+            if key in self._entries:
+                return
+            while self.bytes + nbytes > self.budget and self._order:
+                self._drop_locked(next(iter(self._order)), "lru")
+            self._entries[key] = dev
+            self._sizes[key] = nbytes
+            self._order[key] = None
+            self.bytes += nbytes
+            if uid is not None:
+                self._uid_of[key] = uid
+                self._by_uid.setdefault(uid, {})[key] = version
+
+    def invalidate(self, uid, keep_version=None) -> int:
+        """Drop every buffer of `uid` whose recorded version differs
+        from keep_version (None keep_version drops them all). Called at
+        bind time with the table's current version: a DML commit or
+        schema change leaves no stale HBM behind. -> buffers dropped."""
+        with self._mu:
+            keys = self._by_uid.get(uid)
+            if not keys:
+                return 0
+            stale = [k for k, v in keys.items()
+                     if keep_version is None or v != keep_version]
+            for k in stale:
+                self._drop_locked(k, "version")
+            return len(stale)
+
+    def _drop_locked(self, key, cause: str):
+        self._entries.pop(key, None)
+        self.bytes -= self._sizes.pop(key, 0)
+        self._order.pop(key, None)
+        # unindex under the uid put() recorded, NOT key[0] — a caller
+        # may index under an explicit uid, and a mismatch here would
+        # leave a dangling _by_uid row that inflates invalidate counts
+        uid = self._uid_of.pop(key, None)
+        idx = self._by_uid.get(uid)
+        if idx is not None:
+            idx.pop(key, None)
+            if not idx:
+                self._by_uid.pop(uid, None)
+        _metrics.DEV_BUFFER_EVICTIONS.labels(cause).inc()

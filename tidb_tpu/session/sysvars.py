@@ -62,6 +62,24 @@ class SysVar:
 from ..utils import env_int as _env_int  # shared with storage lock knobs
 
 
+def _jax_cache_dir_default() -> str:
+    """The ACTUAL persistent-cache directory ('' = disabled or
+    degraded). Read from jaxcfg when it is already loaded — its
+    persistent_cache_dir is None when setup failed (read-only home) or
+    was disabled, and SHOW VARIABLES must report that reality. Via
+    sys.modules only: this module stays jax-import-free. When jaxcfg
+    loads later it publishes the real outcome into this var itself
+    (jaxcfg._publish_cache_sysvar)."""
+    import sys
+    jc = sys.modules.get("tidb_tpu.utils.jaxcfg")
+    if jc is not None:
+        return getattr(jc, "persistent_cache_dir", None) or ""
+    # jaxcfg not loaded yet: report the env intent; the publish hook
+    # overwrites it with the configured outcome at jaxcfg import
+    from ..utils import resolve_jax_cache_dir
+    return resolve_jax_cache_dir()
+
+
 _REGISTRY: dict[str, SysVar] = {}
 # plugins register sysvars after startup, concurrently with sessions
 # resolving them; reads stay lockless (GIL-atomic dict get)
@@ -161,6 +179,22 @@ for _v in [
     SysVar("tidb_tpu_cdc_poll_interval_ms", SCOPE_GLOBAL,
            _env_int("TIDB_TPU_CDC_POLL_INTERVAL_MS", 50), "int",
            1, 60_000),
+    # fragment selection (copr/dag_exec, docs/PERFORMANCE.md): a
+    # filter/top-n-only copr fragment below this many rows runs the
+    # host twin instead of paying a whole host<->device round trip for
+    # microseconds of kernel work; 0 dispatches every fragment
+    SysVar("tidb_tpu_fragment_min_rows", SCOPE_BOTH,
+           _env_int("TIDB_TPU_FRAGMENT_MIN_ROWS", 1 << 21), "int",
+           0, 1 << 40),
+    # persistent XLA compilation cache (utils/jaxcfg): the directory
+    # warmup compiles amortize into across processes. Surfaced as a
+    # GLOBAL sysvar (SHOW VARIABLES / dashboards), resolved with the
+    # same precedence jaxcfg applies at import time (without importing
+    # jax here); '' means disabled. Process-global jax config: a
+    # changed value applies via jaxcfg at the next process start, not
+    # mid-session.
+    SysVar("tidb_tpu_jax_cache_dir", SCOPE_GLOBAL,
+           _jax_cache_dir_default(), "str"),
 ]:
     register(_v)
 

@@ -13,3 +13,17 @@ def env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def resolve_jax_cache_dir() -> str:
+    """Persistent XLA compile-cache directory precedence (jax-import
+    free — shared by jaxcfg's setup and the sysvar registry so the two
+    resolutions can't drift): TIDB_TPU_JAX_CACHE_DIR, else
+    JAX_COMPILATION_CACHE_DIR, else ~/.cache/tidb_tpu/xla; '' means
+    explicitly disabled."""
+    d = os.environ.get("TIDB_TPU_JAX_CACHE_DIR")
+    if d is None:
+        d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+            os.path.join(os.path.expanduser("~"), ".cache", "tidb_tpu",
+                         "xla")
+    return d

@@ -13,6 +13,31 @@ region responses the same way (streamed, not lock-step).
 """
 
 
+def host_array(x):
+    """THE designated device->host materialization seam (tpulint rule
+    host-sync-in-device-path): turn a (prefetched) device array into
+    numpy through ``__array__`` — one overlapped bulk transfer — never
+    through the scalar dunders (``__int__``/``__bool__``/``.item()``),
+    each of which is its own blocking link round trip."""
+    import numpy as np
+    return np.asarray(x)
+
+
+def host_scalar(x):
+    """Fetch-seam scalar read: materialize through the bulk-transfer
+    path and hand back a numpy scalar. Call prefetch() on the enclosing
+    result tree first so every scalar of a result rides ONE round
+    trip."""
+    return host_array(x)[()]
+
+
+def host_int(x) -> int:
+    """Fetch-seam int read (sizes, group counts, miss counters):
+    ``int(device_array)`` is a per-value blocking sync; this routes
+    through the prefetched bulk copy instead."""
+    return int(host_array(x))
+
+
 def prefetch(*trees):
     """Issue async device->host copies for every jax array found in the
     given pytrees (dict/list/tuple nests, scalars pass through).  After

@@ -734,6 +734,15 @@ DEV_BUFFER_POOL = REGISTRY.counter(
     "tidb_tpu_device_buffer_pool_total",
     "Device buffer-pool (HBM-resident column) lookups by result",
     ("result",))
+XLA_CACHE = REGISTRY.counter(
+    "tidb_tpu_xla_cache_total",
+    "Persistent XLA compilation-cache lookups by result", ("result",))
+DEV_BUFFER_EVICTIONS = REGISTRY.counter(
+    "tidb_tpu_device_buffer_evict_total",
+    "Device-resident buffers dropped by cause", ("cause",))
+FRAGMENT_ROUTING = REGISTRY.counter(
+    "tidb_tpu_fragment_routing_total",
+    "Copr fragment placement decisions by outcome", ("outcome",))
 FUSED_DECLINE = REGISTRY.counter(
     "tidb_tpu_fused_decline_total",
     "Fused-pipeline declines by reason class", ("reason",))
