@@ -11,14 +11,27 @@ dispatch STRUCTURE, not device speed) must, at steady state:
     device store from the warmup pass (`upload_bytes` == 0);
   * return rows identical to the pure-host path.
 
-The warmup pass pays compiles and uploads; the measured pass is the
-steady state a dashboard workload lives in. A fast slice runs in
-tier-1 (tests/test_device_residency.py::test_perf_smoke_fast_slice);
-this script is the full gate.
+MESH MODE (PERF_MESH=1, ISSUE 7 acceptance): the same budget on an
+8-virtual-device mesh with MPP exchanges on. Every query that routes
+through a mesh path (fused-mpp pipeline / copr mpp fragment) must hold
+dispatches <= 2, syncs <= 1, and zero warm re-uploads — the collective
+exchanges (psum/all_gather/all_to_all) and the mesh-sharded residency
+store may not smuggle host round trips or re-upload sharded columns.
+The gate also requires a minimum number of mesh-routed queries so a
+silent mpp->single-chip routing regression can't make it vacuous.
+
+The warmup pass pays compiles, uploads, and capacity learning; the
+measured pass is the steady state a dashboard workload lives in. A fast
+slice runs in tier-1
+(tests/test_device_residency.py::test_perf_smoke_fast_slice, and
+::test_perf_smoke_mesh_fast_slice for mesh mode); this script is the
+full gate.
 
 Usage:  python scripts/perf_smoke.py
 Env:    PERF_SF (0.05), PERF_QUERIES (comma list, default all),
-        PERF_MAX_DISPATCHES (2), PERF_MAX_SYNCS (1)
+        PERF_MAX_DISPATCHES (2), PERF_MAX_SYNCS (1),
+        PERF_MESH (0; 1 = 8-device mesh mode),
+        PERF_MESH_MIN_ELIGIBLE (12)
 Exit:   0 every query within budget and host-identical; 1 otherwise.
 """
 import os
@@ -29,17 +42,30 @@ sys.path.insert(0, _REPO)
 
 # structure gate, not a speed gate: never burn a TPU grant on it
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("PERF_MESH") == "1" and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # must land before the first jax import: the device count is read
+    # at backend init
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count"
+                               "=8").strip()
 
 
 def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
-        out=sys.stderr):
+        out=sys.stderr, mesh=None, mesh_min_eligible=None):
     """-> list of failure strings (empty = gate green). Importable so
-    the tier-1 fast slice reuses the exact gate predicate."""
+    the tier-1 fast slices reuse the exact gate predicate."""
     sf = float(os.environ.get("PERF_SF", "0.05")) if sf is None else sf
     max_dispatches = int(os.environ.get("PERF_MAX_DISPATCHES", "2")) \
         if max_dispatches is None else max_dispatches
     max_syncs = int(os.environ.get("PERF_MAX_SYNCS", "1")) \
         if max_syncs is None else max_syncs
+    if mesh is None:
+        mesh = os.environ.get("PERF_MESH") == "1"
+    if mesh_min_eligible is None:
+        mesh_min_eligible = int(os.environ.get("PERF_MESH_MIN_ELIGIBLE",
+                                               "12"))
 
     from tidb_tpu.testkit import TestKit
     from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
@@ -50,11 +76,26 @@ def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
         queries = qenv.split(",") if qenv else \
             sorted(ALL_QUERIES, key=lambda q: int(q[1:]))
 
+    failures = []
+    if mesh:
+        import jax
+        ndev = len(jax.devices())
+        if ndev < 2:
+            return [f"mesh mode needs >= 2 devices, have {ndev} "
+                    "(set XLA_FLAGS=--xla_force_host_platform_device_"
+                    "count=8 before jax imports)"]
+
     tk = TestKit()
     print(f"# perf_smoke: sf={sf} queries={len(queries)} "
+          f"mesh={'on' if mesh else 'off'} "
           f"budget: dispatches<={max_dispatches} syncs<={max_syncs} "
           f"upload_bytes==0", file=out)
     load_tpch(tk, sf=sf, seed=42)
+    if mesh:
+        # route everything eligible over the mesh: the gate is about
+        # the exchange/residency structure, not the row-count heuristic
+        tk.must_exec("set @@tidb_enable_mpp = on")
+        tk.must_exec("set @@tidb_mpp_min_rows = 0")
 
     host = {}
     tk.domain.copr.use_device = False
@@ -64,11 +105,17 @@ def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
     finally:
         tk.domain.copr.use_device = True
 
-    for q in queries:                    # warmup: compiles + uploads
-        tk.must_query(ALL_QUERIES[q])
+    for q in queries:                    # warmup: compiles + uploads +
+        tk.must_query(ALL_QUERIES[q])    # learned shuffle capacities
 
-    failures = []
+    def _mpp_marks(m):
+        return (m.get("fused_pipeline_mpp_hit", 0),
+                m.get("copr_mpp_exec", 0),
+                m.get("fused_shuffle_join", 0))
+
+    eligible = []
     for q in queries:
+        before = _mpp_marks(tk.domain.metrics)
         phase.reset()
         try:
             rows = tk.must_query(ALL_QUERIES[q]).rows
@@ -77,11 +124,16 @@ def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
                             f"{str(e)[:120]}")
             continue
         s = phase.snap()
+        on_mesh = mesh and _mpp_marks(tk.domain.metrics) != before
+        if on_mesh:
+            eligible.append(q)
         d = s.get("dispatches", 0)
         sy = s.get("syncs", 0)
         ub = s.get("upload_bytes", 0)
-        line = (f"{q}: dispatches={d} syncs={sy} upload_bytes={ub} "
-                f"upload_hits={s.get('upload_hits', 0)}")
+        line = (f"{q}:{' mesh' if on_mesh else ''} dispatches={d} "
+                f"syncs={sy} upload_bytes={ub} "
+                f"upload_hits={s.get('upload_hits', 0)} "
+                f"exchanges={s.get('mpp_exchanges', 0)}")
         print(f"# {line}", file=out)
         if d > max_dispatches:
             failures.append(f"{q}: {d} dispatches > {max_dispatches}")
@@ -93,6 +145,11 @@ def run(queries=None, sf=None, max_dispatches=None, max_syncs=None,
         if rows != host[q]:
             failures.append(f"{q}: device rows != host rows "
                             f"({len(rows)} vs {len(host[q])})")
+    if mesh and len(eligible) < mesh_min_eligible:
+        failures.append(
+            f"only {len(eligible)} of {len(queries)} queries routed "
+            f"over the mesh ({','.join(eligible) or 'none'}); "
+            f"expected >= {mesh_min_eligible} — mpp routing regressed")
     return failures
 
 
@@ -103,9 +160,11 @@ def main():
         for f in failures:
             print(f"  {f}", file=sys.stderr)
         return 1
-    print("perf_smoke: OK — every query within the dispatch/sync "
-          "budget, zero warm re-uploads, host-identical rows",
-          file=sys.stderr)
+    mode = "mesh (8-device)" if os.environ.get("PERF_MESH") == "1" \
+        else "single-chip"
+    print(f"perf_smoke: OK — every query within the dispatch/sync "
+          f"budget on the {mode} path, zero warm re-uploads, "
+          "host-identical rows", file=sys.stderr)
     return 0
 
 

@@ -1,15 +1,21 @@
 """Device-resident columnar store (copr/residency.py) + donation guard
-(utils/jaxcfg.guard_donation): the PR-6 whole-query-dispatch contract.
+(utils/jaxcfg.guard_donation): the PR-6 whole-query-dispatch contract,
+plus the PR-7 mesh-sharded residency slice.
 
-Pins the three invariants docs/PERFORMANCE.md documents:
+Pins the invariants docs/PERFORMANCE.md documents:
   * a second statement over an unchanged table re-uploads ZERO bytes
-    (phase upload_bytes == 0, upload_hits > 0) — residency;
+    (phase upload_bytes == 0, upload_hits > 0) — residency, on one
+    chip AND partitioned across a mesh;
   * a DML commit (version bump) and a dirty-transaction overlay never
-    serve stale buffers — invalidation;
+    serve stale buffers — invalidation, placement-blind;
+  * sharded entries charge their own bytes (1/ndev per device),
+    replicated entries charge size x ndev — the spec charging policy;
   * a donated buffer is never handed to a second dispatch — donation.
 """
 import numpy as np
 import pytest
+
+import jax
 
 from tidb_tpu.testkit import TestKit
 from tidb_tpu.copr.residency import DeviceResidentStore
@@ -17,6 +23,9 @@ from tidb_tpu.utils import jaxcfg, phase
 from tidb_tpu.utils import metrics as _metrics
 
 N_ROWS = 600
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 2,
+                                reason="needs multi-device mesh")
 
 
 def _tk():
@@ -165,6 +174,152 @@ def test_row_growth_reuploads_changed_slice_only_counters():
     assert rows == _host_rows(tk, AGG_SQL)
     _, s2 = _run_snap(tk, AGG_SQL)
     assert s2.get("upload_bytes", 0) == 0    # resident again
+
+
+# ---- mesh-sharded residency (ISSUE 7) --------------------------------
+
+def test_store_charged_bytes_policy():
+    """THE spec charging policy: sharded = aggregate HBM equals the
+    array's own bytes (per-shard x ndev), replicated = a full copy per
+    device, local = single chip."""
+    cb = DeviceResidentStore.charged_bytes
+    assert cb(100) == 100
+    assert cb(100, "local", 1) == 100
+    assert cb(100, "sharded", 8) == 100
+    assert cb(100, "replicated", 8) == 800
+    with pytest.raises(ValueError):
+        cb(100, "bogus", 8)
+
+
+def test_store_spec_accounting_and_stats():
+    st = DeviceResidentStore(1 << 20)
+    # the gauge is process-global and shared by every store (e.g. a
+    # CDC mirror domain's): assert DELTAS, not absolute values, so
+    # entries left resident by earlier tests can't fail this one
+    repl0 = _metrics.DEV_RESIDENT_BYTES.labels("replicated").value
+    shard0 = _metrics.DEV_RESIDENT_BYTES.labels("sharded").value
+    st.put(("u", "s"), np.zeros(10, np.int8), 10, uid="u", version=1,
+           spec="sharded", ndev=8)
+    st.put(("u", "r"), np.zeros(10, np.int8), 10, uid="u", version=1,
+           spec="replicated", ndev=8)
+    st.put(("u", "l"), np.zeros(10, np.int8), 10, uid="u", version=1)
+    s = st.stats()
+    assert s["entries"] == 3
+    assert s["bytes"] == 10 + 80 + 10
+    assert s["bytes_by_spec"] == {"local": 10, "sharded": 10,
+                                  "replicated": 80}
+    assert st.spec_of(("u", "s")) == "sharded"
+    assert st.spec_of(("u", "r")) == "replicated"
+    assert st.spec_of(("u", "l")) == "local"
+    # the per-spec gauge mirrors the accounting
+    repl1 = _metrics.DEV_RESIDENT_BYTES.labels("replicated").value
+    assert repl1 - repl0 == 80
+    # drops refund the CHARGED bytes per spec
+    st.invalidate("u", keep_version=None)
+    s = st.stats()
+    assert s["bytes"] == 0
+    assert all(v == 0 for v in s["bytes_by_spec"].values())
+    assert _metrics.DEV_RESIDENT_BYTES.labels("sharded").value == shard0
+    assert _metrics.DEV_RESIDENT_BYTES.labels("replicated").value == repl0
+
+
+def test_invalidation_drops_only_that_uids_entries_all_specs():
+    """A DML commit drops the uid's sharded AND replicated entries
+    alike (placement-blind invalidation) and nothing of any other
+    uid."""
+    st = DeviceResidentStore(1 << 20)
+    st.put(("u1", "s"), np.zeros(4), 32, uid="u1", version=1,
+           spec="sharded", ndev=8)
+    st.put(("u1", "r"), np.zeros(4), 32, uid="u1", version=1,
+           spec="replicated", ndev=8)
+    st.put(("u2", "s"), np.zeros(4), 32, uid="u2", version=5,
+           spec="sharded", ndev=8)
+    assert st.invalidate("u1", keep_version=2) == 2
+    assert st.get(("u1", "s")) is None
+    assert st.get(("u1", "r")) is None
+    assert st.get(("u2", "s")) is not None
+    assert st.stats()["bytes_by_spec"]["sharded"] == 32
+
+
+def test_store_replicated_lru_eviction_refunds_ndev_charge():
+    """A replicated entry charged size x ndev must refund the full
+    charge when LRU-evicted, or the pool budget leaks ndev-fold."""
+    st = DeviceResidentStore(100)
+    st.put(("u", "r"), np.zeros(10, np.int8), 10, uid="u", version=1,
+           spec="replicated", ndev=8)          # charged 80
+    assert st.bytes == 80
+    st.put(("u", "l"), np.zeros(50, np.int8), 50, uid="u", version=1)
+    assert st.get(("u", "r")) is None           # evicted: 80 > budget
+    assert st.bytes == 50
+    assert st.stats()["bytes_by_spec"]["replicated"] == 0
+
+
+def _mesh_tk():
+    tk = _tk()
+    tk.must_exec("set @@tidb_enable_mpp = on")
+    tk.must_exec("set @@tidb_mpp_min_rows = 0")
+    return tk
+
+
+@needs_mesh
+def test_mesh_second_statement_uploads_zero_bytes():
+    """Sharded residency end to end: the first mesh statement uploads
+    the table partitioned over the mesh; the second re-uploads NOTHING
+    (the shards stayed in aggregate HBM between statements)."""
+    tk = _mesh_tk()
+    mpp0 = tk.domain.metrics.get("copr_mpp_exec", 0)
+    rows1, s1 = _run_snap(tk, AGG_SQL)
+    assert tk.domain.metrics.get("copr_mpp_exec", 0) > mpp0  # on mesh
+    assert s1.get("upload_bytes", 0) > 0
+    st = tk.domain.copr._dev_store.stats()
+    assert st["bytes_by_spec"]["sharded"] > 0   # partitioned entries
+    rows2, s2 = _run_snap(tk, AGG_SQL)
+    assert rows2 == rows1
+    assert s2.get("upload_bytes", 0) == 0       # warm: fully resident
+    assert s2.get("uploads", 0) == 0
+    assert s2.get("upload_hits", 0) > 0
+    assert rows1 == _host_rows(tk, AGG_SQL)     # mesh == host
+
+
+@needs_mesh
+def test_mesh_dml_commit_invalidates_sharded_entries():
+    """A DML commit drops ONLY the written table's sharded entries:
+    the next mesh statement re-uploads that table (fresh answer) while
+    another table's shards stay resident."""
+    tk = _mesh_tk()
+    tk.must_exec("create table u (a int primary key, b int)")
+    tk.must_exec("insert into u values " + ",".join(
+        f"({i}, {i % 5})" for i in range(200)))
+    other_sql = "select b, count(*) from u group by b order by b"
+    tk.must_query(AGG_SQL)
+    tk.must_query(other_sql)
+    tk.must_exec("update t set c = c + 7 where a = 3")
+    rows, s = _run_snap(tk, AGG_SQL)
+    assert s.get("upload_bytes", 0) > 0         # t re-uploaded fresh
+    assert rows == _host_rows(tk, AGG_SQL)
+    _, s2 = _run_snap(tk, other_sql)
+    assert s2.get("upload_bytes", 0) == 0       # u untouched: resident
+
+
+def test_perf_smoke_mesh_fast_slice():
+    """Tier-1 slice of the ISSUE 7 mesh gate: on the 8-virtual-device
+    mesh with MPP on, the single-dispatch budget holds for the
+    mesh-routed queries (and the slice must actually route them)."""
+    import importlib.util
+    import os
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    spec = importlib.util.spec_from_file_location(
+        "perf_smoke_mesh", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "perf_smoke.py"))
+    perf_smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_smoke)
+    # q1 scan-agg, q3 fused join-agg, q6 global agg, q12 two-table agg
+    failures = perf_smoke.run(queries=["q1", "q3", "q6", "q12"],
+                              sf=0.01, out=open(os.devnull, "w"),
+                              mesh=True, mesh_min_eligible=4)
+    assert failures == []
 
 
 # ---- donation guard --------------------------------------------------

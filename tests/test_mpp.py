@@ -121,6 +121,170 @@ def test_shuffle_join_from_sql(tk):
 
 
 @needs_mesh
+def test_exchange_kernel_cache_no_retrace():
+    """A repeated exchange fragment reuses the compiled program: jit
+    keys on the function object, so the old per-call shard_map closure
+    retraced every statement. The cache must make the second call a
+    pure dispatch (no kernel_builds) — the mesh half of the
+    single-dispatch contract."""
+    from jax.sharding import Mesh
+    from tidb_tpu.mpp.exec import mpp_filter_agg
+    from tidb_tpu.utils import phase
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    ndev = len(jax.devices())
+    n, g = 128 * ndev, 9                   # distinctive shape/n_groups
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, g, n).astype(np.int64)
+    vals = rng.randint(0, 100, n).astype(np.int64)
+    ok = np.ones(n, dtype=bool)
+    from tidb_tpu.parallel import shard_rows
+    a = (shard_rows(mesh, keys), shard_rows(mesh, vals),
+         shard_rows(mesh, ok))
+    phase.reset()
+    s1, _c1 = mpp_filter_agg(mesh, *a, g)
+    snap1 = phase.snap()
+    phase.reset()
+    s2, _c2 = mpp_filter_agg(mesh, *a, g)
+    snap2 = phase.snap()
+    assert snap1.get("kernel_builds", 0) == 1      # cold: traced once
+    assert snap2.get("kernel_builds", 0) == 0      # warm: pure dispatch
+    assert snap2.get("dispatches", 0) == 1
+    assert np.asarray(s1).tolist() == np.asarray(s2).tolist()
+
+
+@needs_mesh
+def test_shuffle_capacity_cache_and_overflow_retrace():
+    """Device-sized hash exchange: the first call guesses a balanced
+    capacity, the fragment returns the exact device-computed bound, an
+    overflowing guess re-traces ONCE, and the learned capacity lands in
+    the per-cap_key cache so the repeat is a single dispatch with no
+    host histogram."""
+    from jax.sharding import Mesh
+    from tidb_tpu.mpp import exec as mexec
+    from tidb_tpu.utils import phase
+
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n, nd, n_groups = 128 * ndev * 4, 128 * ndev, 7
+    rng = np.random.RandomState(31)
+    hot = 2 * ndev + 1                     # all hot rows to one peer
+    pk = np.where(rng.rand(n) < 0.9, hot,
+                  rng.randint(0, nd, size=n)).astype(np.int64)
+    pv = rng.randint(0, 100, size=n).astype(np.int64)
+    pok = np.ones(n, dtype=bool)
+    bk = np.arange(nd, dtype=np.int64)
+    bp = rng.randint(0, n_groups, size=nd).astype(np.int64)
+    bok = np.ones(nd, dtype=bool)
+    cap_key = ("test-shufcap", 1, ndev)
+    mexec._CAP_CACHE.pop(cap_key, None)
+
+    def run():
+        return mexec.mpp_shuffle_join_agg(
+            mesh, pk, pv, pok, bk, bp, bok, n_groups=n_groups,
+            cap_key=cap_key)
+
+    calls = []
+    orig = mexec._shuffle_capacity
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    mexec._shuffle_capacity = counting
+    try:
+        phase.reset()
+        sums1, cnts1 = run()
+        snap1 = phase.snap()
+        # 90% skew overflows the balanced first guess: exactly one
+        # re-trace at the device-returned exact bound (dispatches
+        # counts every kernel call, builds count the compiling ones)
+        assert snap1.get("dispatches", 0) == 2
+        assert snap1.get("kernel_builds", 0) == 2
+        learned = mexec._CAP_CACHE.get(cap_key)
+        assert learned is not None
+        assert learned >= orig(pk, pok, ndev)   # covers the hot bucket
+        phase.reset()
+        sums2, cnts2 = run()
+        snap2 = phase.snap()
+        assert snap2.get("dispatches", 0) == 1  # warm: cap cache hit
+        assert snap2.get("kernel_builds", 0) == 0
+    finally:
+        mexec._shuffle_capacity = orig
+    assert calls == []                          # no host histogram ever
+    # correctness under the learned capacity vs exact host join+agg
+    want_s = np.zeros(n_groups, dtype=np.int64)
+    want_c = np.zeros(n_groups, dtype=np.int64)
+    payload_of = {int(k): int(g) for k, g in zip(bk, bp)}
+    for k, v, ok in zip(pk, pv, pok):
+        if ok and int(k) in payload_of:
+            g = payload_of[int(k)]
+            want_s[g] += int(v)
+            want_c[g] += 1
+    assert np.asarray(cnts1).tolist() == want_c.tolist()
+    assert np.asarray(sums1).tolist() == want_s.tolist()
+    assert np.asarray(sums2).tolist() == want_s.tolist()
+
+
+@needs_mesh
+def test_shuffle_host_sizing_path_is_cap_cached(monkeypatch):
+    """TIDB_TPU_MPP_HOST_CAP=1 (the fallback host-sizing path) still
+    lands its result in the capacity cache: the second call never
+    recomputes the host histogram."""
+    from jax.sharding import Mesh
+    from tidb_tpu.mpp import exec as mexec
+
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n, nd, n_groups = 128 * ndev, 64, 5
+    rng = np.random.RandomState(7)
+    pk = rng.randint(0, nd, size=n).astype(np.int64)
+    pv = rng.randint(0, 10, size=n).astype(np.int64)
+    pok = np.ones(n, dtype=bool)
+    bk = np.arange(nd, dtype=np.int64)
+    bp = rng.randint(0, n_groups, size=nd).astype(np.int64)
+    bok = np.ones(nd, dtype=bool)
+    cap_key = ("test-hostcap", 1, ndev)
+    mexec._CAP_CACHE.pop(cap_key, None)
+    monkeypatch.setenv("TIDB_TPU_MPP_HOST_CAP", "1")
+
+    calls = []
+    orig = mexec._shuffle_capacity
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mexec, "_shuffle_capacity", counting)
+    mexec.mpp_shuffle_join_agg(mesh, pk, pv, pok, bk, bp, bok,
+                               n_groups=n_groups, cap_key=cap_key)
+    assert len(calls) == 2                 # probe + build side, once
+    mexec.mpp_shuffle_join_agg(mesh, pk, pv, pok, bk, bp, bok,
+                               n_groups=n_groups, cap_key=cap_key)
+    assert len(calls) == 2                 # second call: cache hit
+
+
+@needs_mesh
+def test_mpp_exchange_metrics_counted(tk):
+    """Exchange observability: a mesh statement lands passthrough
+    exchange counts + bytes in the registry and phase counters."""
+    from tidb_tpu.utils import metrics as _metrics
+    from tidb_tpu.utils import phase
+    tk.must_exec("set @@tidb_mpp_min_rows = 0")
+    tk.must_exec("set @@tidb_enable_mpp = on")
+    before = _metrics.MPP_EXCHANGE.labels("passthrough").value
+    bbytes = _metrics.MPP_EXCHANGE_BYTES.labels("passthrough").value
+    phase.reset()
+    tk.must_query(Q1)
+    snap = phase.snap()
+    assert _metrics.MPP_EXCHANGE.labels("passthrough").value > before
+    assert _metrics.MPP_EXCHANGE_BYTES.labels("passthrough").value \
+        > bbytes
+    assert snap.get("mpp_exchanges", 0) >= 1
+    assert snap.get("mpp_exchange_bytes", 0) > 0
+
+
+@needs_mesh
 def test_shuffle_join_hot_key_skew():
     """One join key owning 90% of the probe rows must not lose rows in
     the hash exchange: frame capacity is sized from the measured

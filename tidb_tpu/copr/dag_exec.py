@@ -239,7 +239,8 @@ class CoprExecutor:
                 t_mpp = time.perf_counter()
                 res = device_guard.guarded_dispatch(
                     lambda: self._try_execute_mpp(dag, tbl, arrays,
-                                                  valid, n, handles),
+                                                  valid, n, handles,
+                                                  read_ts),
                     site="copr/mpp", ectx=ectx,
                     domain=getattr(self, "domain", None),
                     host_fallback=lambda: None,
@@ -526,6 +527,10 @@ class CoprExecutor:
 
     def _dev_put_sharded(self, key, arr_np, mesh, cap, pad_fill=0,
                          uid=None, version=None):
+        """Mesh-sharded upload: the padded array partitions over the
+        row axis (parallel.row_sharding) and STAYS partitioned across
+        statements — each device holds 1/ndev, so the store charges
+        the aggregate (per-shard x ndev), never x ndev."""
         hit = self._dev_store.get(key)
         if hit is not None:
             phase.inc("upload_hits")
@@ -533,26 +538,28 @@ class CoprExecutor:
             return hit
         _metrics.DEV_BUFFER_POOL.labels("miss").inc()
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel import row_sharding
         t0 = time.perf_counter()
         if len(arr_np) != cap:
             arr_np = np.concatenate(
                 [arr_np, np.full(cap - len(arr_np), pad_fill,
                                  dtype=arr_np.dtype)])
-        dev = jax.device_put(arr_np, NamedSharding(mesh, P("dp")))
+        dev = jax.device_put(arr_np, row_sharding(mesh))
         phase.add("upload_s", time.perf_counter() - t0)
         phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
         phase.inc("uploads")
         self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
                             uid=key[0] if uid is None else uid,
-                            version=version)
+                            version=version, spec="sharded",
+                            ndev=int(mesh.devices.size))
         return dev
 
     def _dev_put_replicated(self, key, arr_np, mesh, cap, pad_fill=0,
                             uid=None, version=None):
-        """Broadcast-exchange upload: the array replicates to every mesh
-        device (NamedSharding with an empty spec); charged at
-        size * ndev (evictions must refund what was charged)."""
+        """Broadcast-exchange upload: the array replicates to every
+        mesh device (parallel.replicated_sharding); the store charges
+        size * ndev (evictions refund what was charged). Counted as a
+        Broadcast exchange on the actual upload, not on pool hits."""
         hit = self._dev_store.get(key)
         if hit is not None:
             phase.inc("upload_hits")
@@ -560,29 +567,36 @@ class CoprExecutor:
             return hit
         _metrics.DEV_BUFFER_POOL.labels("miss").inc()
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel import replicated_sharding
         t0 = time.perf_counter()
         if len(arr_np) != cap:
             arr_np = np.concatenate(
                 [arr_np, np.full(cap - len(arr_np), pad_fill,
                                  dtype=arr_np.dtype)])
-        dev = jax.device_put(arr_np, NamedSharding(mesh, P()))
+        dev = jax.device_put(arr_np, replicated_sharding(mesh))
+        ndev = int(mesh.devices.size)
+        moved = dev.size * dev.dtype.itemsize * ndev
         phase.add("upload_s", time.perf_counter() - t0)
-        phase.add("upload_bytes",
-                  dev.size * dev.dtype.itemsize * mesh.devices.size)
+        phase.add("upload_bytes", moved)
         phase.inc("uploads")
-        self._dev_store.put(key, dev,
-                            dev.size * dev.dtype.itemsize *
-                            mesh.devices.size,
+        _metrics.MPP_EXCHANGE.labels("broadcast").inc()
+        _metrics.MPP_EXCHANGE_BYTES.labels("broadcast").inc(moved)
+        self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
                             uid=key[0] if uid is None else uid,
-                            version=version)
+                            version=version, spec="replicated",
+                            ndev=ndev)
         return dev
 
-    def _try_execute_mpp(self, dag, tbl, arrays, valid, n, handles):
+    def _try_execute_mpp(self, dag, tbl, arrays, valid, n, handles,
+                         read_ts=None):
         """MPP fragment path: shard rows across the mesh, run the dense
         partial-agg kernel per shard inside shard_map, merge with psum
         (the hash exchange collapsed into an allreduce over the dense key
-        domain — tidb_tpu/mpp design). Returns None when ineligible."""
+        domain — tidb_tpu/mpp design). Returns None when ineligible.
+
+        Every input — column data AND the MVCC validity mask — rides the
+        sharded residency store, so a repeated statement over an
+        unchanged table uploads zero bytes to the mesh."""
         mesh = self._get_mesh()
         if mesh is None:
             return None
@@ -623,11 +637,15 @@ class CoprExecutor:
                                                   pad_fill=True,
                                                   uid=tbl.uid,
                                                   version=tbl.version))
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        vpad = np.concatenate([valid[:n], np.zeros(padded - n, dtype=bool)]) \
-            if padded != n else valid[:n]
-        args.append(jax.device_put(vpad, NamedSharding(mesh, P("dp"))))
+        # the MVCC validity mask is version+snapshot-keyed (same policy
+        # as _upload_dim's ts_keyed entries): within one (version,
+        # read_ts) it is immutable, so it stays resident too — the old
+        # raw device_put here was an uncounted warm re-upload per
+        # statement
+        args.append(self._dev_put_sharded(
+            (tbl.uid, "mppvalid", tbl.version, read_ts, ndev, padded),
+            valid[:n], mesh, padded, pad_fill=False, uid=tbl.uid,
+            version=tbl.version))
         key = self._cache_key(dag, tbl, "mpp", padded,
                               (tuple(strides), ndev,
                                tuple(sorted(has_nulls.items()))))
@@ -637,6 +655,8 @@ class CoprExecutor:
                 dag, cols, local, strides, mesh, names, has_nulls)
             kern = self._kernel_cache.put(key, kern)
         res = kern(*args)
+        from ..mpp.exec import exchange_observed, tree_nbytes
+        exchange_observed("passthrough", tree_nbytes(res))
         return [_compact_dense(dag, res, strides, kd, sd)]
 
     def _cache_key(self, dag, tbl, kind, cap, extra=()):

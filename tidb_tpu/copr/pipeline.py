@@ -1774,10 +1774,27 @@ def _try_fused_shuffle(copr, plan, mesh, dim_metas, fact_tbl, fact_arrays,
             return arr
         return np.concatenate([arr, np.full(p - m, fill, dtype=arr.dtype)])
 
+    cap_hint = 0
+    if ectx is not None:
+        try:
+            cap_hint = int(ectx.sv.get("tidb_tpu_mpp_shuffle_cap"))
+        except Exception:               # noqa: BLE001
+            pass
+    # capacity cache key: both tables' uid+version (either side's DML
+    # invalidates the learned bound) + the probe expression + BOTH
+    # sides' filters (a selective query's small learned cap must not
+    # leak to an unfiltered query over the same tables, nor the
+    # reverse permanently oversize the selective one) + topology
+    cap_key = (fact_tbl.uid, fact_tbl.version, meta["tbl"].uid,
+               meta["tbl"].version, dim.probe_expr.fingerprint(),
+               tuple(f.fingerprint() for f in plan.fact_dag.filters),
+               tuple(f.fingerprint() for f in dim.dag.filters),
+               key_cid, ndev)
     sums, cnts = mpp_shuffle_join_agg(
         mesh, pad(pk, n), [pad(v, n) for v in val_arrays],
         pad(fmask, n, False), pad(bk, nd), pad(payload, nd),
-        pad(dmask, nd, False), n_groups=size, ectx=ectx)
+        pad(dmask, nd, False), n_groups=size, ectx=ectx,
+        cap_key=cap_key, cap_hint=cap_hint)
     cnts = np.asarray(cnts)
     slots = np.nonzero(cnts > 0)[0]
     keys = [(slots + lo).astype(np.int64)]
@@ -1808,8 +1825,7 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                    shim, kd, sd, gbkey, group_bucket, read_ts,
                    dim_pres=()):
     """Mesh execution: ONE shard_map call over the whole fact table."""
-    import jax as _jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..mpp.exec import exchange_observed, tree_nbytes
     ndev = int(mesh.devices.size)
     lane = 128 * ndev
     padded = ((n + lane - 1) // lane) * lane
@@ -1831,9 +1847,13 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                  "n"), nulls, mesh, padded, pad_fill=True,
                 uid=fact_tbl.uid, version=ver)
         fjc[sc.col.idx] = (jd, jn)
-    vpad = fact_valid[:n] if padded == n else np.concatenate(
-        [fact_valid[:n], np.zeros(padded - n, dtype=bool)])
-    fvv = _jax.device_put(vpad, NamedSharding(mesh, P("dp")))
+    # the fact validity mask is (version, read_ts)-immutable: residency
+    # (same contract as the sharded columns above) instead of a raw
+    # device_put, which re-uploaded it warm on every statement
+    fvv = copr._dev_put_sharded(
+        (fact_tbl.uid, "mppfv", ver, read_ts, ndev, padded),
+        fact_valid[:n], mesh, padded, pad_fill=False, uid=fact_tbl.uid,
+        version=ver)
     compk = ("fcompact", fact_tbl.gc_epoch) + gbkey
     while True:
         if pos_spec is not None:
@@ -1863,6 +1883,10 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
         # executors.FusedPipeline's guarded_dispatch site="fused/mpp"
         # (a degraded mesh run retries single-chip there)
         res = prefetch(kern(fjc, fvv, dim_args))
+        # PassThrough exchange: dense layouts merge via psum ON the
+        # mesh (the result tree is already global); the sort layout
+        # ships per-shard partials to the coordinator in one fetch
+        exchange_observed("passthrough", tree_nbytes(res))
         if pos_spec is not None:
             return [_compact_pos_dense(plan, res, pos_spec[0],
                                        pos_spec[1], dim_metas, sd)]

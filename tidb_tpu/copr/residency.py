@@ -15,6 +15,23 @@ lacked:
 * a per-table key index, so invalidation is O(buffers of that table),
   not O(pool).
 
+MESH-SHARDED entries: a multi-chip mesh holds base tables partitioned
+over the row axis (`NamedSharding` with `PartitionSpec("dp")`), so the
+pool speaks placement too. Every entry records a placement `spec` and
+the store owns the charging policy:
+
+  spec="sharded"     the global array is split across the mesh — each
+                     device holds 1/ndev of it, so the AGGREGATE HBM
+                     cost is the array's own bytes. Charged nbytes
+                     (per-shard x ndev == nbytes), never x ndev.
+  spec="replicated"  a Broadcast-exchange build side: every device
+                     holds a full copy. Charged nbytes * ndev.
+  spec="local"       single-chip entry (the default). Charged nbytes.
+
+Invalidation is placement-blind: a DML commit drops the stale sharded,
+replicated, and local entries of that uid alike (they all index under
+the uid), so a mesh and a single chip share one invalidation contract.
+
 Padding is bucketed (chunk.device.shape_bucket) BEFORE keying: growth
 within a bucket re-uploads the changed data but reuses the compiled
 kernel (same static shape); only growth past a bucket boundary
@@ -30,17 +47,20 @@ import threading
 
 from ..utils import metrics as _metrics
 
+SPECS = ("local", "sharded", "replicated")
+
 
 class DeviceResidentStore:
-    """LRU + version-indexed pool of device arrays, byte-budgeted."""
+    """LRU + version-indexed pool of device arrays, byte-budgeted,
+    placement(spec)-aware."""
 
     def __init__(self, budget_bytes: int):
         self.budget = budget_bytes
         self.bytes = 0
         self._mu = threading.Lock()
         self._entries: dict = {}       # key -> device array
-        self._sizes: dict = {}         # key -> charged bytes (replicated
-        #                                entries charge size * ndev)
+        self._sizes: dict = {}         # key -> charged bytes (the spec
+        #                                charging policy, see module doc)
         self._order: dict = {}         # key -> None; insertion order IS
         #                                LRU order (py3.7 dicts), so
         #                                touch/evict are O(1) — no list
@@ -48,9 +68,23 @@ class DeviceResidentStore:
         #                                per-column hot path
         self._uid_of: dict = {}        # key -> uid it was indexed under
         self._by_uid: dict = {}        # uid -> {key: version}
+        self._spec_of: dict = {}       # key -> placement spec
+        self._bytes_by_spec = {s: 0 for s in SPECS}
 
     def __len__(self):
         return len(self._entries)
+
+    def __del__(self):
+        # the per-spec gauge is process-global and delta-maintained: a
+        # store dropped with entries still charged (a removed CDC
+        # mirror domain, a discarded test domain) must hand its charge
+        # back or the gauge drifts upward forever
+        try:
+            for s, b in self._bytes_by_spec.items():
+                if b:
+                    _metrics.DEV_RESIDENT_BYTES.labels(s).dec(b)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def get(self, key):
         with self._mu:
@@ -60,20 +94,38 @@ class DeviceResidentStore:
                 self._order[key] = None      # move to MRU end
             return hit
 
-    def put(self, key, dev, nbytes: int, uid=None, version=None):
-        """Insert a buffer charged at nbytes; evicts LRU entries past
-        the byte budget. uid/version feed the invalidation index —
-        unversioned entries (version None) are dropped whenever their
-        uid invalidates."""
+    @staticmethod
+    def charged_bytes(nbytes: int, spec: str = "local",
+                      ndev: int = 1) -> int:
+        """THE charging policy: replicated entries cost a full copy per
+        device; sharded entries cost their own bytes in aggregate HBM
+        (per-shard x ndev), exactly like a local entry on one chip."""
+        if spec not in SPECS:
+            raise ValueError(f"unknown placement spec {spec!r}")
+        return nbytes * ndev if spec == "replicated" else nbytes
+
+    def put(self, key, dev, nbytes: int, uid=None, version=None,
+            spec: str = "local", ndev: int = 1):
+        """Insert a buffer; the store charges it by placement spec
+        (charged_bytes) and evicts LRU entries past the byte budget.
+        uid/version feed the invalidation index — unversioned entries
+        (version None) are dropped whenever their uid invalidates."""
+        charged = self.charged_bytes(nbytes, spec, ndev)
         with self._mu:
             if key in self._entries:
                 return
-            while self.bytes + nbytes > self.budget and self._order:
+            while self.bytes + charged > self.budget and self._order:
                 self._drop_locked(next(iter(self._order)), "lru")
             self._entries[key] = dev
-            self._sizes[key] = nbytes
+            self._sizes[key] = charged
             self._order[key] = None
-            self.bytes += nbytes
+            self.bytes += charged
+            self._spec_of[key] = spec
+            self._bytes_by_spec[spec] += charged
+            # delta, not set(): several stores share the process-global
+            # gauge (the CDC TableSink mirror runs a second Domain with
+            # its own store) — last-writer-wins set() would flap
+            _metrics.DEV_RESIDENT_BYTES.labels(spec).inc(charged)
             if uid is not None:
                 self._uid_of[key] = uid
                 self._by_uid.setdefault(uid, {})[key] = version
@@ -82,7 +134,10 @@ class DeviceResidentStore:
         """Drop every buffer of `uid` whose recorded version differs
         from keep_version (None keep_version drops them all). Called at
         bind time with the table's current version: a DML commit or
-        schema change leaves no stale HBM behind. -> buffers dropped."""
+        schema change leaves no stale HBM behind — on a mesh this
+        drops the uid's sharded AND replicated entries (all placements
+        index under the uid), and nothing of any other uid.
+        -> buffers dropped."""
         with self._mu:
             keys = self._by_uid.get(uid)
             if not keys:
@@ -93,10 +148,26 @@ class DeviceResidentStore:
                 self._drop_locked(k, "version")
             return len(stale)
 
+    def spec_of(self, key):
+        """Recorded placement spec of a live entry, else None."""
+        with self._mu:
+            return self._spec_of.get(key)
+
+    def stats(self) -> dict:
+        """Point-in-time accounting: total charged bytes and the
+        per-placement split (information_schema / debugging surface)."""
+        with self._mu:
+            return {"entries": len(self._entries), "bytes": self.bytes,
+                    "bytes_by_spec": dict(self._bytes_by_spec)}
+
     def _drop_locked(self, key, cause: str):
         self._entries.pop(key, None)
-        self.bytes -= self._sizes.pop(key, 0)
+        freed = self._sizes.pop(key, 0)
+        self.bytes -= freed
         self._order.pop(key, None)
+        spec = self._spec_of.pop(key, "local")
+        self._bytes_by_spec[spec] -= freed
+        _metrics.DEV_RESIDENT_BYTES.labels(spec).dec(freed)
         # unindex under the uid put() recorded, NOT key[0] — a caller
         # may index under an explicit uid, and a mismatch here would
         # leave a dangling _by_uid row that inflates invalidate counts

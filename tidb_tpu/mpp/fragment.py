@@ -5,16 +5,26 @@ Broadcast / Hash, fragment.go:78,168).
 
 TPU-native redesign: a fragment is a shard_map program over the device
 mesh and an exchange is an XLA collective (or a sharded/replicated
-device_put at the leaves):
+resident placement at the leaves — docs/PERFORMANCE.md "Exchange
+lowering"):
 
-    PassThrough  partial results -> coordinator     out_specs P("dp") or
-                                                    psum + host merge
-    Broadcast    replicate build side everywhere    NamedSharding P()
-                                                    (dims of a fused
-                                                    pipeline)
-    Hash         re-key rows across devices         all_to_all (shuffle
-                                                    join) or collapsed
-                                                    into psum for small
+    PassThrough  partial results -> coordinator     psum merges dense
+                                                    partials ON-mesh;
+                                                    the sort layout
+                                                    ships per-shard
+                                                    partials in one
+                                                    prefetched fetch
+    Broadcast    replicate build side everywhere    replicated_sharding
+                                                    entries in the
+                                                    residency store
+                                                    (no per-statement
+                                                    device_put)
+    Hash         re-key rows across devices         all_to_all with
+                                                    device-sized frame
+                                                    capacity (cached
+                                                    per uid+version),
+                                                    or collapsed into
+                                                    psum for small
                                                     group domains
 
 The fragmenter is a physical-plan rewrite: it inserts

@@ -1,3 +1,5 @@
 from .mesh import make_mesh, shard_rows, replicate
+from .dist import row_sharding, replicated_sharding, sharding_tree
 
-__all__ = ["make_mesh", "shard_rows", "replicate"]
+__all__ = ["make_mesh", "shard_rows", "replicate", "row_sharding",
+           "replicated_sharding", "sharding_tree"]

@@ -20,6 +20,35 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def row_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """THE row-partitioned placement: one shard of the row axis per
+    mesh device (SNIPPETS.md [2] get_naive_sharding, at the engine's
+    column altitude). Every sharded upload seam (copr mpp columns,
+    shuffle inputs, validity masks) builds its NamedSharding here so
+    the residency store's "sharded" entries all mean the same thing."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Broadcast-exchange placement: a full copy on every mesh device
+    (SNIPPETS.md [2] get_empty_sharding)."""
+    return NamedSharding(mesh, P())
+
+
+def sharding_tree(tree, mesh: Mesh, axis: str = "dp"):
+    """Per-leaf placement for a pytree of column arrays (SNIPPETS.md
+    [2] get_sharding_tree): row arrays (ndim >= 1) partition over the
+    row axis, scalars/0-d leaves replicate. Used to device_put a whole
+    bound-column tree in one call."""
+    import jax.tree_util as jtu
+
+    def leaf_sharding(x):
+        nd = getattr(x, "ndim", 0)
+        return row_sharding(mesh, axis) if nd else replicated_sharding(
+            mesh)
+    return jtu.tree_map(leaf_sharding, tree)
+
+
 def init_distributed(coordinator_address: str, num_processes: int,
                      process_id: int) -> None:
     """jax.distributed.initialize with the axon-wedge guard: on the CPU

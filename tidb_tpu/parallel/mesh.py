@@ -7,7 +7,7 @@ import numpy as np
 
 from ..utils import jaxcfg  # noqa: F401
 import jax
-from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+from jax.sharding import Mesh
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
@@ -19,14 +19,16 @@ def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
 
 def shard_rows(mesh: Mesh, arr, axis: str = "dp"):
     """Place a host array row-sharded across the mesh (pads to divisor)."""
+    from .dist import row_sharding
     n = len(mesh.devices.flat)
     rows = arr.shape[0]
     pad = (-rows) % n
     if pad:
         arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:],
                                             dtype=arr.dtype)])
-    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+    return jax.device_put(arr, row_sharding(mesh, axis))
 
 
 def replicate(mesh: Mesh, arr):
-    return jax.device_put(np.asarray(arr), NamedSharding(mesh, P()))
+    from .dist import replicated_sharding
+    return jax.device_put(np.asarray(arr), replicated_sharding(mesh))

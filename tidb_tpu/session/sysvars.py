@@ -116,6 +116,14 @@ for _v in [
     # optimizer.go:335-341); default off like the reference
     SysVar("tidb_enable_cascades_planner", SCOPE_BOTH, False, "bool"),
     SysVar("tidb_mpp_min_rows", SCOPE_BOTH, 1 << 16, "int", 0, None),
+    # hash-exchange frame capacity FIRST GUESS (slots per (sender,
+    # destination) peer) for the all_to_all shuffle join. 0 = auto:
+    # balanced-load estimate, corrected by the device-computed exact
+    # bound with one re-trace on overflow (mpp/exec.py). A nonzero pin
+    # seeds the guess only — overflow is still detected and re-traced,
+    # so a too-small pin can never drop rows.
+    SysVar("tidb_tpu_mpp_shuffle_cap", SCOPE_BOTH,
+           _env_int("TIDB_TPU_MPP_SHUFFLE_CAP", 0), "int", 0, 1 << 24),
     SysVar("tidb_join_exec", SCOPE_BOTH, "auto", "enum",
            enum_vals=["auto", "host", "device"]),
     SysVar("last_plan_from_binding", SCOPE_SESSION, False, "bool"),

@@ -30,8 +30,11 @@ Detection is taint-based, so host-side numpy stays unflagged:
   * SEAM — `host_array` / `host_scalar` / `host_int` consume taint;
     their results are host data.
 
-Scope: files under `tidb_tpu/copr/` (the dispatch path). The seam
-module itself lives in utils/ and is out of scope by construction.
+Scope: files under `tidb_tpu/copr/` (the single-chip dispatch path)
+AND `tidb_tpu/mpp/` (the mesh/exchange path — a blocking sync there
+serializes every device in the collective, so the mesh path holds the
+same budget with no baseline). The seam module itself lives in utils/
+and is out of scope by construction.
 """
 from __future__ import annotations
 
@@ -39,13 +42,14 @@ import ast
 
 from ..core import Rule, register_rule
 
-SCOPE_PREFIXES = ("tidb_tpu/copr/",)
+SCOPE_PREFIXES = ("tidb_tpu/copr/", "tidb_tpu/mpp/")
 
 PREFETCH = ("prefetch", "fetch.prefetch", "utils.fetch.prefetch")
 SEAM = ("host_array", "host_scalar", "host_int",
         "fetch.host_array", "fetch.host_scalar", "fetch.host_int")
 KERNEL_MAKERS = ("jax.jit", "jaxcfg.guard_donation", "guard_donation",
-                 "phase.timed_kernel", "timed_kernel")
+                 "phase.timed_kernel", "timed_kernel",
+                 "_cached_kernel", "exec._cached_kernel")
 HOST_NUMPY = ("numpy.asarray", "numpy.array")
 SCALAR_BUILTINS = {"int", "float", "bool"}
 SYNC_METHODS = {"item", "tolist"}

@@ -727,6 +727,15 @@ COPR_DISPATCH_SECONDS = REGISTRY.histogram(
 MPP_DISPATCH_SECONDS = REGISTRY.histogram(
     "tidb_tpu_mpp_dispatch_seconds",
     "Multi-chip MPP dispatch latency (mesh fan-out + merge)")
+MPP_EXCHANGE = REGISTRY.counter(
+    "tidb_tpu_mpp_exchange_total",
+    "MPP exchanges lowered to on-mesh collectives by exchange type "
+    "(passthrough=psum/all_gather partial merge, broadcast=replicated "
+    "build side, hash=all_to_all shuffle)", ("type",))
+MPP_EXCHANGE_BYTES = REGISTRY.counter(
+    "tidb_tpu_mpp_exchange_bytes_total",
+    "Bytes moved across the mesh by exchange collectives by exchange "
+    "type (aggregate over devices, not per-chip)", ("type",))
 KERNEL_CACHE = REGISTRY.counter(
     "tidb_tpu_kernel_cache_total",
     "Compiled-kernel cache lookups by result", ("result",))
@@ -740,6 +749,12 @@ XLA_CACHE = REGISTRY.counter(
 DEV_BUFFER_EVICTIONS = REGISTRY.counter(
     "tidb_tpu_device_buffer_evict_total",
     "Device-resident buffers dropped by cause", ("cause",))
+DEV_RESIDENT_BYTES = REGISTRY.gauge(
+    "tidb_tpu_device_resident_bytes",
+    "Charged bytes live in the device-resident store by placement "
+    "spec (local=single chip, sharded=1/ndev per device so charged "
+    "once, replicated=full copy per device so charged x ndev)",
+    ("spec",))
 FRAGMENT_ROUTING = REGISTRY.counter(
     "tidb_tpu_fragment_routing_total",
     "Copr fragment placement decisions by outcome", ("outcome",))
