@@ -199,16 +199,35 @@ def htap_main(live=True):
     }))
 
 
+def _percentiles(lat_s):
+    """p50/p95/p99 in ms from a list of per-op seconds."""
+    if not lat_s:
+        return {}
+    xs = sorted(lat_s)
+    n = len(xs)
+
+    def pct(p):
+        return round(1000.0 * xs[min(n - 1, int(n * p))], 3)
+    return {"p50_ms": pct(0.50), "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "max_ms": round(1000.0 * xs[-1], 3)}
+
+
 def oltp_main(live=True):
     """sysbench-style OLTP benchmark (the reference's headline numbers
     are TPC-C/sysbench — docs/design cites +27-54% QPS pushdown gains):
     point SELECT by PK, UPDATE by PK, and a small secondary-index range
-    read, each measured separately and mixed, multi-threaded."""
+    read, each run across a thread-count sweep (BENCH_OLTP_THREADS, a
+    comma list — the serving-tier question is how throughput and tail
+    latency hold up as sessions pile on, not one fixed concurrency)
+    with p50/p95/p99 latency capture per (op, thread-count) cell."""
     import threading
     import random
     sf = float(os.environ.get("BENCH_SF", "0.1"))
     seconds = float(os.environ.get("BENCH_SECONDS", "10"))
-    nthreads = int(os.environ.get("BENCH_OLTP_THREADS", "4"))
+    sweep = [int(x) for x in
+             os.environ.get("BENCH_OLTP_THREADS", "4,64,256").split(",")
+             if x.strip()]
 
     from tidb_tpu.testkit import TestKit
     tk = TestKit()
@@ -222,20 +241,23 @@ def oltp_main(live=True):
             for i in range(start, min(start + 5000, n_rows)))
         tk.must_exec(f"insert into sbtest values {vals}")
 
-    errors = {}
-
-    def bench_op(name, fn):
+    def bench_op(name, fn, nthreads):
         stop = threading.Event()
         counts = [0] * nthreads
         errs = [0] * nthreads
+        lats = [None] * nthreads
+        perf = time.perf_counter
 
         def worker(i):
             s = tk.new_session()
             r = random.Random(i)
+            mylat = []
             while not stop.is_set():
+                t0 = perf()
                 try:
                     fn(s, r)
                     counts[i] += 1
+                    mylat.append(perf() - t0)
                 except Exception as e:          # noqa: BLE001
                     # a dead worker silently deflates QPS: count and
                     # keep going, surface the tally in the artifact
@@ -244,6 +266,7 @@ def oltp_main(live=True):
                         print(f"# oltp {name} thread {i} error: "
                               f"{type(e).__name__}: {str(e)[:120]}",
                               file=sys.stderr)
+            lats[i] = mylat
         ths = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(nthreads)]
         for t in ths:
@@ -253,22 +276,36 @@ def oltp_main(live=True):
         for t in ths:
             t.join(timeout=30)
         qps = sum(counts) / seconds
-        errors[name] = sum(errs)
-        print(f"# oltp {name}: {qps:.1f} ops/s "
-              f"({errors[name]} errors)", file=sys.stderr)
-        return round(qps, 1)
+        all_lat = [x for ls in lats if ls for x in ls]
+        cell = {"ops_s": round(qps, 1), "errors": sum(errs),
+                **_percentiles(all_lat)}
+        print(f"# oltp {name} x{nthreads}: {qps:.1f} ops/s "
+              f"p99={cell.get('p99_ms', 0)}ms "
+              f"({cell['errors']} errors)", file=sys.stderr)
+        return cell
 
-    res = {
-        "point_select": bench_op("point_select", lambda s, r: s.must_query(
+    ops = [
+        ("point_select", lambda s, r: s.must_query(
             f"select c from sbtest where id = {r.randrange(n_rows)}")),
-        "index_range": bench_op("index_range", lambda s, r: s.must_query(
+        ("index_range", lambda s, r: s.must_query(
             f"select id from sbtest where k >= {r.randrange(n_rows)} "
             f"limit 10")),
-        "update_pk": bench_op("update_pk", lambda s, r: s.must_exec(
+        ("update_pk", lambda s, r: s.must_exec(
             f"update sbtest set k = k + 1 "
             f"where id = {r.randrange(n_rows)}")),
-    }
-    unit = "point-select ops/s (sysbench-style, %d threads)" % nthreads
+    ]
+    sweep_res = {}
+    for nthreads in sweep:
+        sweep_res[str(nthreads)] = {
+            name: bench_op(name, fn, nthreads) for name, fn in ops}
+    # headline cell: point selects at the highest swept concurrency —
+    # the serving-tier claim under test. `errors` describes the SAME
+    # cell as `ops` (per-cell tallies live in sweep), matching the
+    # seed artifact's pairing.
+    top = str(sweep[-1])
+    res = {name: sweep_res[top][name]["ops_s"] for name, _ in ops}
+    errors = {name: sweep_res[top][name]["errors"] for name, _ in ops}
+    unit = "point-select ops/s (sysbench-style, %s threads)" % top
     if not live:
         unit += " [CPU FALLBACK — not a TPU measurement]"
     print(json.dumps({
@@ -279,6 +316,8 @@ def oltp_main(live=True):
         "backend": "tpu" if live else "cpu-fallback",
         "ops": res,
         "errors": errors,
+        "threads": sweep,
+        "sweep": sweep_res,
     }))
 
 

@@ -45,10 +45,24 @@ sys.path.insert(0, _REPO)
 CASES = [
     ("2pc", "2pc-prewrite-done", "lost", []),
     ("2pc", "2pc-commit-before-wal", "lost", []),
-    ("2pc", "2pc-commit-after-wal", "committed", ["admin checkpoint"]),
+    # commit-durable = past the covering group fsync: recovery replays
+    # checkpoint + WAL tail and must surface the commit even though
+    # the in-process hooks never ran
+    ("2pc", "commit-durable", "committed", ["admin checkpoint"]),
+    # with group commit the append only BUFFERS the frame — the
+    # durability point moved to the covering fsync, so a crash right
+    # after the append recovers LOST (the commit was never acked)
+    ("2pc", "2pc-commit-after-wal", "lost", []),
     ("1pc", "1pc-before-wal", "lost", []),
     ("async", "2pc-prewrite-done", "lost", []),
+    # fires AFTER prewrite returns, i.e. after wait_durable — durable
     ("async", "async-commit-prewrite-durable", "committed", []),
+    # group-commit LEADER seam (ISSUE 8): dies after collecting the
+    # batch but BEFORE the fsync — committers are parked in
+    # wait_durable, nothing was acked, so recovery must be LOST
+    # (ack-then-lose is the group-commit bug class)
+    ("2pc", "group-commit-leader", "lost", []),
+    ("1pc", "group-commit-leader", "lost", []),
 ]
 
 MODE_SETUP = {

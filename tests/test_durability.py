@@ -124,9 +124,9 @@ print("UNREACHED", flush=True)
 
 
 def test_kill9_mid_commit_loses_no_acked_txns(tmp_path):
-    """Crash AT the WAL-durable point mid-commit: every acknowledged
-    transaction survives; the in-flight one may or may not (it was never
-    acked), and recovery leaves no locks behind."""
+    """Crash just after the WAL append mid-commit: every acknowledged
+    transaction survives; the in-flight one was never acked and is
+    lost, and recovery leaves no locks behind."""
     d = str(tmp_path / "dd")
     script = _CRASH_CHILD.format(
         repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -144,10 +144,12 @@ def test_kill9_mid_commit_loses_no_acked_txns(tmp_path):
                          "order by a").rs.rows
     assert rows == [(i, i * 10) for i in range(5)]
     assert not dom.storage.mvcc._locks
-    # the crashed txn hit the failpoint AFTER the WAL append, so it is
-    # durable too (crash-at-durability-point semantics)
-    assert tk.must_query("select b from t where a = 99").rs.rows == \
-        [(990,)]
+    # with group commit the append only BUFFERS the frame — the
+    # durability point is the covering group fsync (wait_durable),
+    # which this crash never reached, so the un-acked txn is LOST.
+    # test_group_commit_crash_after_fsync_is_committed asserts the
+    # far side of the same seam.
+    assert tk.must_query("select b from t where a = 99").rs.rows == []
 
 
 _ASYNC_CRASH_CHILD = r"""
@@ -514,3 +516,155 @@ def test_wal_torn_tail_mid_header(tmp_path):
     w2.close()
     assert [f[0] for f in walmod.replay(path)] == [5, 6]
     assert walmod.valid_prefix(path) > good
+
+
+# ---- WAL group commit (ISSUE 8) ---------------------------------------
+
+
+def test_group_commit_batches_concurrent_commits(tmp_path):
+    """N sessions committing concurrently share flush/fsync passes:
+    with the leader stalled, followers pile into one batch — the
+    histogram must record a multi-frame sync — and every acked commit
+    is durable after reopen."""
+    import threading
+    from tidb_tpu.utils import metrics as metrics_util
+    d = str(tmp_path / "dd")
+    dom = new_store(d, wal_sync=True)
+    tk = _tk(dom)
+    tk.must_exec("create table t (a int primary key, b int)")
+    failpoint.enable("group-commit-leader", "sleep:20")
+    errs = []
+
+    def worker(i):
+        try:
+            s = Session(dom)
+            s.vars.current_db = "test"
+            for j in range(4):
+                s.execute(f"insert into t values ({i * 10 + j}, {j})")
+        except Exception as e:              # noqa: BLE001
+            errs.append(e)
+    try:
+        ths = [__import__("threading").Thread(target=worker, args=(i,))
+               for i in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+    finally:
+        failpoint.disable("group-commit-leader")
+    assert not errs
+    counts, total, n_syncs = \
+        metrics_util.WAL_GROUP_COMMIT_SIZE._default().read()
+    assert n_syncs > 0
+    # 32 frames in fewer syncs = at least one batch covered > 1 frame
+    assert total > n_syncs, (total, n_syncs)
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select count(*) from t").rs.rows == [(32,)]
+    assert not dom2.storage.mvcc._locks
+
+
+def test_group_commit_leader_crash_before_fsync_loses_only_unacked(
+        tmp_path):
+    """kill -9 at the group-commit leader seam (batch collected, fsync
+    not yet issued): the parked commit was never acked, so recovery
+    must NOT surface it — ack-then-lose is the group-commit bug
+    class."""
+    d = _run_crash_child(tmp_path, "group-commit-leader")
+    dom = new_store(d)
+    tk = _tk(dom)
+    assert tk.must_query("select count(*) from t where a = 7"
+                         ).rs.rows == [(0,)]
+    assert not dom.storage.mvcc._locks
+    tk.must_exec("insert into t values (7, 71)")   # store still writable
+    assert tk.must_query("select b from t where a = 7").rs.rows == \
+        [(71,)]
+
+
+def test_group_commit_crash_after_fsync_is_committed(tmp_path):
+    """kill -9 just past the covering fsync (commit-durable): the frame
+    is on disk, recovery must surface the commit even though the
+    in-process hooks never ran."""
+    d = _run_crash_child(tmp_path, "commit-durable")
+    dom = new_store(d)
+    tk = _tk(dom)
+    assert tk.must_query("select b from t where a = 7").rs.rows == \
+        [(70,)]
+    assert not dom.storage.mvcc._locks
+
+
+def test_group_commit_disabled_restores_sync_append(tmp_path):
+    """group_commit=False (TIDB_TPU_WAL_GROUP_COMMIT=0): a defer
+    append is durable before append() returns — wait_durable becomes a
+    no-op check, the pre-ISSUE-8 semantics."""
+    from tidb_tpu.storage import wal as walmod
+    path = os.path.join(str(tmp_path), "commit.wal")
+    w = walmod.WalWriter(path, sync=True, group_commit=False)
+    seq = w.append(10, [(b"k", b"v")], defer=True)
+    assert w._durable_seq >= seq           # durable at return
+    w.wait_durable(seq)                    # returns immediately
+    w.close()
+    assert [f[0] for f in walmod.replay(path)] == [10]
+
+
+def test_group_commit_survives_writer_swap(tmp_path):
+    """flush_wal swaps mvcc.wal while a committer is parked in
+    wait_durable on the OLD writer: the swap's close() makes every
+    buffered frame durable and releases the waiter — the commit must
+    complete (not wedge on the fresh writer's restarted seq counter)
+    and survive reopen."""
+    import threading
+    d = str(tmp_path / "dd")
+    dom = new_store(d, wal_sync=True)
+    tk = _tk(dom)
+    tk.must_exec("create table t (a int primary key, b int)")
+    failpoint.enable("group-commit-leader", "sleep:150")
+    errs = []
+
+    def committer():
+        try:
+            s = Session(dom)
+            s.vars.current_db = "test"
+            s.execute("insert into t values (1, 10)")
+        except Exception as e:              # noqa: BLE001
+            errs.append(e)
+    t = threading.Thread(target=committer)
+    try:
+        t.start()
+        import time as _t
+        _t.sleep(0.05)                     # let it reach the leader seam
+        dom.flush_wal()                    # swaps the writer underneath
+        t.join(timeout=30)
+    finally:
+        failpoint.disable("group-commit-leader")
+    assert not t.is_alive(), "committer wedged across the writer swap"
+    assert not errs
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select b from t where a = 1").rs.rows == \
+        [(10,)]
+
+
+def test_group_commit_sysvar_applies_at_writer_swap(tmp_path):
+    """SET GLOBAL tidb_tpu_wal_group_commit = 0 takes effect at the
+    next writer construction (flush_wal/checkpoint/open), per the
+    sysvar's contract."""
+    d = str(tmp_path / "dd")
+    dom = new_store(d, wal_sync=True)
+    tk = _tk(dom)
+    assert dom.storage.mvcc.wal.group_commit is True     # env default
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("set global tidb_tpu_wal_group_commit = 0")
+    tk.must_exec("insert into t values (1)")
+    dom.flush_wal()                                      # swaps writer
+    assert dom.storage.mvcc.wal.group_commit is False
+    tk.must_exec("insert into t values (2)")             # strict path
+    tk.must_exec("set global tidb_tpu_wal_group_commit = 1")
+    dom.flush_wal()
+    assert dom.storage.mvcc.wal.group_commit is True
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(d)
+    tk2 = _tk(dom2)
+    assert tk2.must_query("select count(*) from t").rs.rows == [(2,)]

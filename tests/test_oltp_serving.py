@@ -1,0 +1,499 @@
+"""OLTP serving fast path (ISSUE 8): parameterized plan-cache point
+templates, cache invalidation on DDL/binding change, the OLAP-vs-OLTP
+admission split, and the bounded domain caches. The heavy concurrency
+gate lives in scripts/oltp_smoke.py; this is the tier-1 slice."""
+import threading
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import metrics as metrics_util
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table kv (id bigint primary key, "
+                 "v varchar(32), n int)")
+    tk.must_exec("insert into kv values (1,'a',10),(2,'b',20),"
+                 "(3,'c',30),(4,'d',null)")
+    return tk
+
+
+# ---- fast-path correctness --------------------------------------------
+
+
+def test_point_literal_and_warm_hit(tk):
+    assert tk.must_query("select * from kv where id = 2").rows == \
+        [(2, "b", 20)]
+    hits0 = tk.domain.metrics.get("plan_cache_hit", 0)
+    assert tk.must_query("select * from kv where id = 3").rows == \
+        [(3, "c", 30)]
+    assert tk.domain.metrics.get("plan_cache_hit", 0) > hits0
+    assert metrics_util.PLAN_CACHE.labels("hit").value > 0
+
+
+def test_execute_with_params_skips_optimize(tk, monkeypatch):
+    """The acceptance contract: a warm prepared EXECUTE with params is
+    a plan-cache hit and never enters the planner."""
+    sid, n = tk.sess.prepare_wire("select v, n from kv where id = ?")
+    assert n == 1
+    assert tk.sess.execute_wire(sid, [2]).rows == [("b", 20)]  # cold
+    from tidb_tpu import planner
+
+    def boom(*a, **k):
+        raise AssertionError("optimize() called on the warm path")
+    monkeypatch.setattr(planner, "optimize", boom)
+    hits0 = tk.domain.metrics.get("plan_cache_hit", 0)
+    assert tk.sess.execute_wire(sid, [1]).rows == [("a", 10)]
+    assert tk.sess.execute_wire(sid, [4]).rows == [("d", None)]
+    assert tk.domain.metrics.get("plan_cache_hit", 0) == hits0 + 2
+
+
+def test_textual_prepare_execute(tk):
+    tk.must_exec("prepare p1 from 'select n from kv where id = ?'")
+    tk.must_exec("set @h = 3")
+    assert tk.must_query("execute p1 using @h").rows == [(30,)]
+    hits0 = tk.domain.metrics.get("plan_cache_hit", 0)
+    tk.must_exec("set @h = 1")
+    assert tk.must_query("execute p1 using @h").rows == [(10,)]
+    assert tk.domain.metrics.get("plan_cache_hit", 0) > hits0
+
+
+def test_batch_point_in_list(tk):
+    assert tk.must_query("select n from kv where id in (1, 3)").rows \
+        == [(10,), (30,)]
+    # warm, different values, subset missing
+    assert tk.must_query("select n from kv where id in (3, 99)").rows \
+        == [(30,)]
+    sid, _ = tk.sess.prepare_wire(
+        "select n from kv where id in (?, ?)")
+    assert tk.sess.execute_wire(sid, [2, 1]).rows == [(20,), (10,)]
+
+
+def test_fastpath_shapes_fall_back_correctly(tk):
+    # non-point shapes: the full pipeline answers, no wrong results
+    assert tk.must_query("select count(*) from kv").rows == [(4,)]
+    assert tk.must_query(
+        "select n from kv where id = 1 or id = 2 order by n").rows == \
+        [(10,), (20,)]
+    assert tk.must_query("select * from kv where n = 10").rows == \
+        [(1, "a", 10)]
+    # pk = NULL matches nothing (planner folds it the same way)
+    sid, _ = tk.sess.prepare_wire("select n from kv where id = ?")
+    assert tk.sess.execute_wire(sid, [None]).rows == []
+    # non-integer param falls back to full-path coercion
+    assert tk.sess.execute_wire(sid, ["2"]).rows == [(20,)]
+    assert tk.sess.execute_wire(sid, ["abc"]).rows == []
+    # FOR UPDATE never rides the template (it must take locks)
+    tk.must_exec("begin")
+    assert tk.must_query(
+        "select n from kv where id = 2 for update").rows == [(20,)]
+    tk.must_exec("rollback")
+
+
+def test_odd_first_param_does_not_poison_shape(tk, monkeypatch):
+    """A NULL/odd first EXECUTE must not cache a negative verdict for
+    the shape: later integer-param executions still fast-path."""
+    sid, _ = tk.sess.prepare_wire("select n from kv where id = ?")
+    assert tk.sess.execute_wire(sid, [None]).rows == []      # odd first
+    assert tk.sess.execute_wire(sid, [2]).rows == [(20,)]    # builds tpl
+    from tidb_tpu import planner
+
+    def boom(*a, **k):
+        raise AssertionError("optimize() called on the warm path")
+    monkeypatch.setattr(planner, "optimize", boom)
+    assert tk.sess.execute_wire(sid, [3]).rows == [(30,)]    # warm
+
+
+def test_textual_execute_olap_takes_admission_slot(tk):
+    """PREPARE/EXECUTE of an analytic statement must queue like the
+    plain statement would (the EXECUTE wrapper is not a bypass)."""
+    rg = tk.domain.resource_groups.groups.get("default")
+    rg.olap_slots = 1
+    rg.acquire_olap(1)
+    got = []
+    try:
+        s2 = tk.new_session()
+        s2.must_exec("prepare pa from 'select count(*) from kv'")
+
+        def olap():
+            got.append(s2.must_query("execute pa").rows)
+        t = threading.Thread(target=olap)
+        q0 = rg.queued_stmts
+        t.start()
+        import time
+        deadline = time.perf_counter() + 10
+        while rg.queued_stmts == q0 and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert rg.queued_stmts > q0      # parked behind the slot
+        rg.release_olap()
+        t.join(timeout=30)
+        assert got == [[(4,)]]
+    finally:
+        rg.olap_slots = None
+
+
+def test_fastpath_dirty_txn_sees_own_writes(tk):
+    tk.must_query("select n from kv where id = 2")   # warm template
+    tk.must_exec("begin")
+    tk.must_exec("update kv set n = 999 where id = 2")
+    assert tk.must_query("select n from kv where id = 2").rows == \
+        [(999,)]
+    tk.must_exec("rollback")
+    assert tk.must_query("select n from kv where id = 2").rows == \
+        [(20,)]
+
+
+def test_fastpath_repeatable_read_in_txn(tk):
+    tk.must_query("select n from kv where id = 1")   # warm template
+    tk.must_exec("begin")
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]
+    other = tk.new_session()
+    other.must_exec("update kv set n = 11 where id = 1")
+    # snapshot read at the txn's start_ts: still the old value
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]
+    tk.must_exec("commit")
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(11,)]
+
+
+def test_fastpath_statements_heartbeat_explicit_txn(tk):
+    """A stream of fast-path reads inside an explicit transaction
+    must keep heartbeating its pessimistic locks, exactly like
+    full-path statements — an ACTIVE txn's locks must not expire."""
+    import time
+    tk.must_query("select n from kv where id = 1")   # warm template
+    tk.must_exec("begin")
+    tk.must_query("select n from kv where id = 2 for update")  # lock
+    txn = tk.sess._txn
+    mvcc = tk.domain.storage.mvcc
+    [key] = list(txn._locked_keys)
+    d0 = mvcc._locks[key].deadline
+    time.sleep(0.05)
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]                                      # fast-path read
+    assert mvcc._locks[key].deadline > d0            # lock extended
+    tk.must_exec("commit")
+
+
+def test_fastpath_unique_index_point(tk):
+    tk.must_exec("create table u (id bigint primary key, "
+                 "uq bigint unique, x int)")
+    tk.must_exec("insert into u values (1,100,7),(2,200,8)")
+    assert tk.must_query("select x from u where uq = 200").rows == \
+        [(8,)]
+    assert tk.must_query("select x from u where uq = 100").rows == \
+        [(7,)]
+    assert tk.must_query("select x from u where uq = 404").rows == []
+    # the probe answers through index KV: an update moves it
+    tk.must_exec("update u set uq = 300 where id = 1")
+    assert tk.must_query("select x from u where uq = 100").rows == []
+    assert tk.must_query("select x from u where uq = 300").rows == \
+        [(7,)]
+
+
+def test_view_point_select_never_templates(tk):
+    """A point select THROUGH A VIEW must not cache a base-table
+    template: the warm path's temp-shadow and privilege checks would
+    bind to the wrong name (and CREATE TEMPORARY TABLE bumps no
+    schema epoch to fence it)."""
+    tk.must_exec("create view pv as select id, n from kv")
+    assert tk.must_query("select n from pv where id = 1").rows == \
+        [(10,)]
+    assert tk.must_query("select n from pv where id = 2").rows == \
+        [(20,)]
+    from tidb_tpu.session.fastpath import PointTemplate
+    for v in tk.domain.point_plans._d.values():
+        if isinstance(v, PointTemplate):
+            assert v.tbl_name != "kv" or True  # base-table tpls fine
+    assert not any(isinstance(v, PointTemplate) and k[0].startswith(
+        "select n from pv") for k, v in tk.domain.point_plans._d.items())
+
+
+def test_fastpath_sysvar_off(tk):
+    tk.must_query("select n from kv where id = 1")   # warm
+    tk.must_exec("set @@tidb_tpu_plan_fastpath = 0")
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]
+    tk.must_exec("set @@tidb_tpu_plan_fastpath = 1")
+
+
+# ---- invalidation ------------------------------------------------------
+
+
+def test_ddl_invalidates_templates(tk):
+    tk.must_query("select * from kv where id = 1")   # warm
+    epoch0 = tk.domain.schema_epoch
+    tk.must_exec("alter table kv add column z int default 5")
+    assert tk.domain.schema_epoch > epoch0
+    # rebuilt template carries the new schema
+    assert tk.must_query("select * from kv where id = 1").rows == \
+        [(1, "a", 10, 5)]
+    assert tk.must_query("select z from kv where id = 2").rows == [(5,)]
+    # drop + recreate under the same name: no stale table_info serves
+    tk.must_exec("drop table kv")
+    tk.must_exec("create table kv (id bigint primary key, w int)")
+    tk.must_exec("insert into kv values (1, 77)")
+    assert tk.must_query("select * from kv where id = 1").rows == \
+        [(1, 77)]
+
+
+def test_binding_version_fences_templates(tk):
+    tk.must_query("select n from kv where id = 1")   # warm
+    key0 = set(tk.domain.point_plans._d)
+    tk.must_exec("create global binding for select n from kv where "
+                 "id = 1 using select /*+ MAX_EXECUTION_TIME(60000) */ "
+                 "n from kv where id = 1")
+    try:
+        # version bumped -> old key unusable, a fresh key is built
+        assert tk.must_query("select n from kv where id = 1").rows == \
+            [(10,)]
+        assert set(tk.domain.point_plans._d) != key0
+    finally:
+        tk.must_exec("drop global binding for select n from kv "
+                     "where id = 1")
+    # session bindings fence the same way
+    tk.must_exec("create binding for select n from kv where id = 1 "
+                 "using select /*+ MAX_EXECUTION_TIME(60000) */ n "
+                 "from kv where id = 1")
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]
+
+
+def test_bulk_load_invalidation(tk):
+    tk.must_query("select n from kv where id = 1")   # warm
+    tk.domain.invalidate_plan_cache()
+    assert len(tk.domain.point_plans) == 0
+    assert tk.must_query("select n from kv where id = 1").rows == \
+        [(10,)]
+
+
+def test_concurrent_prepare_execute_across_sessions(tk):
+    errs = []
+    hits0 = tk.domain.metrics.get("plan_cache_hit", 0)
+
+    def worker(i):
+        try:
+            s = tk.new_session().sess
+            sid, _ = s.prepare_wire("select v from kv where id = ?")
+            for j in range(30):
+                want = [("a", "b", "c", "d")[j % 4]]
+                got = [r[0] for r in s.execute_wire(
+                    sid, [j % 4 + 1]).rows]
+                assert got == want, (got, want)
+        except Exception as e:                  # noqa: BLE001
+            errs.append(e)
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    assert not errs
+    assert tk.domain.metrics.get("plan_cache_hit", 0) > hits0
+
+
+# ---- plan-cache LRU + bounded domain caches ---------------------------
+
+
+def test_lru_cache_eviction_order():
+    from tidb_tpu.utils import LRUCache
+    c = LRUCache(3)
+    for i in range(3):
+        c.put(i, i * 10)
+    assert c.get(0) == 0
+    c.put(0, 0)                   # re-put = exact MRU touch
+    c.put(3, 30)                  # evicts 1 (oldest), not 0
+    assert c.get(1) is None
+    assert c.get(0) == 0 and c.get(3) == 30
+    assert len(c) == 3
+    # the amortized hit-touch serializes every 32nd hit without
+    # corrupting the map
+    for _ in range(200):
+        assert c.get(3) == 30
+    assert len(c) == 3
+
+
+def test_ast_cache_bounded(tk):
+    for i in range(600):
+        tk.must_query(f"select {i} + 0")
+    assert len(tk.domain.ast_cache) <= 512
+    assert len(tk.domain.digest_cache) <= 1024
+
+
+def test_plan_cache_metric_breakdown(tk):
+    # miss (cold plan, cached), then hit
+    tk.must_query("select n from kv where id = 1 order by n")
+    tk.must_query("select n from kv where id = 1 order by n")
+    assert metrics_util.PLAN_CACHE.labels("hit").value >= 1
+    assert metrics_util.PLAN_CACHE.labels("miss").value >= 1
+
+
+# ---- admission control -------------------------------------------------
+
+
+def test_stmt_class_classifier():
+    from tidb_tpu.parser import parse
+    from tidb_tpu.session.session import _stmt_class
+
+    def klass(sql):
+        return _stmt_class(parse(sql)[0])
+    assert klass("select v from kv where id = 1") == "oltp"
+    assert klass("insert into kv values (9,'x',1)") == "oltp"
+    assert klass("update kv set n = 1 where id = 2") == "oltp"
+    assert klass("select * from kv limit 10") == "oltp"
+    assert klass("select count(*) from kv") == "olap"
+    assert klass("select * from kv") == "olap"   # unbounded scan
+    assert klass("select 1") == "oltp"           # no FROM at all
+    assert klass("select sum(n) from kv group by v") == "olap"
+    assert klass("select a.n from kv a, kv b where a.id = b.id") == \
+        "olap"
+    assert klass("select distinct v from kv") == "olap"
+    assert klass("with c as (select 1) select * from c") == "olap"
+
+
+def test_olap_admission_slots_queue():
+    from tidb_tpu.session.resource_group import ResourceGroup
+    rg = ResourceGroup("rg_t", ru_per_sec=0)
+    order = []
+    rg.acquire_olap(1)
+    done = threading.Event()
+
+    def second():
+        rg.acquire_olap(1)          # must park until release
+        order.append("acquired")
+        rg.release_olap()
+        done.set()
+    t = threading.Thread(target=second)
+    t.start()
+    import time
+    time.sleep(0.15)
+    assert order == []              # parked behind the slot
+    assert rg.queued_stmts == 1
+    rg.release_olap()
+    assert done.wait(5)
+    assert order == ["acquired"]
+    t.join()
+
+
+def test_olap_statement_waits_point_does_not(tk):
+    """An analytic statement holding the single admission slot delays
+    the next analytic but never a point op."""
+    rg = tk.domain.resource_groups.groups.get("default")
+    assert rg is not None
+    rg.olap_slots = 1               # group override beats the sysvar
+    rg.acquire_olap(1)              # analytic in flight
+    try:
+        import time
+        t0 = time.perf_counter()
+        assert tk.must_query("select n from kv where id = 1").rows == \
+            [(10,)]
+        assert time.perf_counter() - t0 < 1.0   # no slot queue
+        waited = [None]
+
+        def olap():
+            s = tk.new_session()
+            t1 = time.perf_counter()
+            s.must_query("select count(*) from kv")
+            waited[0] = time.perf_counter() - t1
+        q0 = rg.queued_stmts
+        t = threading.Thread(target=olap)
+        t.start()
+        # wait until the analytic is provably parked in the queue
+        deadline = time.perf_counter() + 10
+        while rg.queued_stmts == q0 and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert rg.queued_stmts > q0
+        rg.release_olap()
+        t.join(timeout=30)
+        assert waited[0] is not None
+    finally:
+        rg.olap_slots = None
+    h = metrics_util.ADMISSION_WAIT_SECONDS.labels("default", "olap")
+    assert h.count >= 1
+
+
+def test_admission_never_wedges_nested(tk):
+    """A statement the classifier calls olap fired from inside another
+    (internal SQL / nested depth) bypasses the queue — a held slot must
+    not deadlock it."""
+    rg = tk.domain.resource_groups.groups.get("default")
+    rg.olap_slots = 1
+    rg.acquire_olap(1)
+    try:
+        s = tk.new_session()
+        s.sess.is_internal = True
+        assert s.must_query("select count(*) from kv").rows == [(4,)]
+    finally:
+        rg.release_olap()
+        rg.olap_slots = None
+
+
+def test_kill_reaches_queued_statement(tk):
+    """KILL <conn> interrupts a statement parked in the admission
+    queue (it has no ExecContext yet — the sentinel covers it)."""
+    from tidb_tpu.errors import QueryKilledError
+    rg = tk.domain.resource_groups.groups.get("default")
+    rg.olap_slots = 1
+    rg.acquire_olap(1)
+    got = []
+    s2 = tk.new_session()
+
+    def olap():
+        try:
+            s2.must_query("select count(*) from kv")
+            got.append("completed")
+        except QueryKilledError:
+            got.append("killed")
+    t = threading.Thread(target=olap)
+    try:
+        q0 = rg.queued_stmts
+        t.start()
+        import time
+        deadline = time.perf_counter() + 10
+        while rg.queued_stmts == q0 and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert rg.queued_stmts > q0
+        tk.domain.kill_conn(s2.sess.conn_id)
+        t.join(timeout=30)
+        assert got == ["killed"]
+    finally:
+        rg.release_olap()
+        rg.olap_slots = None
+
+
+# ---- smoke fast slice --------------------------------------------------
+
+
+def test_oltp_smoke_fast_slice(tk):
+    """Miniature of scripts/oltp_smoke.py gate 1/3: a brief 8-thread
+    point burst completes with zero errors and real cache hits."""
+    tk.must_exec("create table sb (id int primary key, c varchar(16))")
+    tk.must_exec("insert into sb values " + ",".join(
+        f"({i}, 'c{i}')" for i in range(500)))
+    errs = []
+    counts = [0] * 8
+
+    def worker(i):
+        import random
+        s = tk.new_session()
+        r = random.Random(i)
+        try:
+            for _ in range(120):
+                k = r.randrange(500)
+                got = s.must_query(
+                    f"select c from sb where id = {k}").rows
+                assert got == [(f"c{k}",)]
+                counts[i] += 1
+        except Exception as e:                  # noqa: BLE001
+            errs.append(e)
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    assert not errs
+    assert sum(counts) == 8 * 120
+    assert tk.domain.metrics.get("plan_cache_hit", 0) > 0

@@ -166,7 +166,7 @@ class Server:
                     io.write_packet(P.err_packet(1243, "HY000",
                                                  "Unknown stmt handler"))
                     continue
-                _, n_params = entry
+                n_params = entry[1]
                 try:
                     _, params = P.parse_execute_params(pkt[1:], n_params)
                     rs = sess.execute_wire(sid, params)

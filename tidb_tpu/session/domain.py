@@ -82,9 +82,21 @@ class Domain:
         # (reference pkg/ddl table locks, gated by enable-table-lock)
         self.table_locks: dict = {}
         self.table_locks_mu = threading.Lock()
-        self.plan_cache: dict = {}        # (sql, db, ver, flags) -> PhysPlan
-        self.plan_cache_order: list = []
-        self.plan_cache_cap = 256
+        from ..utils import LRUCache
+        # (sql, db, ver, flags) -> PhysPlan; O(1) LRU (the residency
+        # idiom) — the old list-order sidecar scanned on every insert
+        self.plan_cache = LRUCache(256)
+        # digest-shape -> point-op fast-path template (session/fastpath:
+        # PK point/batch-point lookups served without the planner).
+        # Keys embed schema_epoch + binding versions, so stale entries
+        # age out through the LRU after invalidation.
+        self.point_plans = LRUCache(512)
+        # cheap plan-validity fence for the fast path: bumped by the
+        # commit hook below on every meta-namespace commit (DDL), by
+        # invalidate_plan_cache (bulk loads), and by checkpoint/restore
+        # paths — reading an int attr per point op instead of a
+        # meta-KV schema-version probe (~17us) keeps the hot path hot
+        self.schema_epoch = 0
         from ..bindinfo import BindHandle
         self.bind_handle = BindHandle()   # GLOBAL plan baselines
         from .resource_group import ResourceGroupManager
@@ -93,8 +105,30 @@ class Domain:
         self.plugins = PluginManager()
         from ..dxf.framework import DurableTasks
         self.durable_tasks = DurableTasks(self)
-        self.ast_cache: dict = {}         # sql -> parsed stmt list
-        self.digest_cache: dict = {}      # sql -> (normalized, digest)
+        # sql -> parsed stmt list. Bounded LRU: ad-hoc SQL churn (every
+        # bench/ORM statement is unique text) used to grow the old dict
+        # without limit between 512-clears on ONE call path while
+        # _parse_one_cached inserted uncapped on another
+        self.ast_cache = LRUCache(512)
+        self.digest_cache = LRUCache(1024)  # sql -> (normalized, digest)
+        # fast-path schema fence: any commit touching the meta
+        # namespace (DDL: schema version, table defs) invalidates
+        # point templates by epoch bump — runs on the committing
+        # thread inside _publish, so the DDL session itself can never
+        # race its own next statement. The bump is locked: hooks run
+        # OUTSIDE the store mutex, and an unsynchronized += from two
+        # concurrent DDL commits could collapse two bumps into one,
+        # leaving a template built between them validly keyed
+        from ..codec.tablecodec import META_PREFIX as _MPREF
+        self._epoch_mu = threading.Lock()
+
+        def _meta_epoch_hook(_commit_ts, mutations):
+            for k, _v in mutations:
+                if k[:1] == _MPREF:
+                    with self._epoch_mu:
+                        self.schema_epoch += 1
+                    return
+        self.storage.mvcc.commit_hooks.append(_meta_epoch_hook)
         self._syncload_attempted: set = set()
         if data_dir:
             from ..utils import logutil
@@ -158,7 +192,9 @@ class Domain:
             self.storage.oracle.fast_forward(commit_ts)
             self.storage.mvcc.apply_replay(commit_ts, mutations)
         self.is_cache._cached = None     # reload schema from replayed meta
-        self.storage.mvcc.wal = WalWriter(path, sync=self.wal_sync)
+        self.storage.mvcc.wal = WalWriter(
+            path, sync=self.wal_sync,
+            group_commit=self._wal_group_commit())
         self._load_bulk_segments()
         buf = self.columnar._replay_buffer
         self.columnar._replay_buffer = None
@@ -228,6 +264,16 @@ class Domain:
                 mvcc.wal.append(ts, muts)
             mvcc.apply_replay(ts, muts)
 
+    def _wal_group_commit(self):
+        """Group-commit setting for a NEW WalWriter: the GLOBAL sysvar
+        when an operator has SET it, else None (writer falls back to
+        the TIDB_TPU_WAL_GROUP_COMMIT env default). Read at every
+        writer construction — open, flush_wal, checkpoint — so SET
+        GLOBAL takes effect at the next writer swap, as the sysvar
+        comment promises."""
+        v = self.global_vars.get("tidb_tpu_wal_group_commit")
+        return None if v is None else bool(v)
+
     def flush_wal(self) -> int:
         """LSM flush: rewrite the WAL as one sorted immutable run and
         truncate it (reference: memtable flush to L0; the C++ memtable
@@ -252,7 +298,8 @@ class Domain:
             n = sst.write_run(sst.next_run_path(self.data_dir), triples)
             w.close()
             open(w.path, "wb").close()
-            mvcc.wal = WalWriter(w.path, sync=self.wal_sync)
+            mvcc.wal = WalWriter(w.path, sync=self.wal_sync,
+                                 group_commit=self._wal_group_commit())
             self.inc_metric("lsm_flushes")
             metrics_util.LSM_FLUSH_SECONDS.observe(
                 _time.perf_counter() - t0)
@@ -351,9 +398,13 @@ class Domain:
 
     def invalidate_plan_cache(self):
         """Drop all cached plans (bulk loads change which access paths
-        are valid for a table without bumping the schema version)."""
+        are valid for a table without bumping the schema version).
+        Point fast-path templates go too: the epoch bump fences any
+        in-flight lookup keyed on the old epoch."""
         self.plan_cache.clear()
-        self.plan_cache_order.clear()
+        self.point_plans.clear()
+        with self._epoch_mu:
+            self.schema_epoch += 1
 
     def checkpoint(self) -> int:
         """Write a consistent snapshot of the MVCC store and truncate the
@@ -385,7 +436,9 @@ class Domain:
                 wal_path = mvcc.wal.path
                 open(wal_path, "wb").close()     # truncate: all frames
                 from ..storage.wal import WalWriter  # are in the snapshot
-                mvcc.wal = WalWriter(wal_path, sync=self.wal_sync)
+                mvcc.wal = WalWriter(
+                    wal_path, sync=self.wal_sync,
+                    group_commit=self._wal_group_commit())
         self.inc_metric("checkpoints")
         return ts
 

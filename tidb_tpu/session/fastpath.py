@@ -1,0 +1,540 @@
+"""OLTP point-op serving fast path (reference
+pkg/planner/core/point_get_plan.go TryFastPlan + plan_cache.go, fused).
+
+High-concurrency point lookups spend their time AROUND the read: at the
+seed, a 1.2ms point select paid ~22% parse, ~34% planner and ~30%
+statement-lifecycle overhead for ~8us of actual columnar gather. This
+module short-circuits the whole pipeline for the two shapes that
+dominate OLTP serving:
+
+    SELECT <cols|*> FROM [db.]tbl WHERE pk = <int|?>
+    SELECT <cols|*> FROM [db.]tbl WHERE pk IN (<int|?>, ...)
+
+A statement is recognized lexically (one compiled regex — both literal
+text, the sysbench shape, and the ``?``-parameterized COM_STMT_EXECUTE /
+EXECUTE shape), normalized to a digest-like SHAPE key, and served from a
+cached *template*: the table, the output column mapping, and (for
+unique-index gets) the probe index — everything the planner derived the
+first time, minus the bound value. Warm executions bind the value from
+the literal/params and gather straight from the columnar engine: no
+parse, no ``optimize()``, no executor tree.
+
+Soundness:
+  * The template is built by the REAL pipeline (parse -> binding match
+    -> optimize) and accepted only when the planner itself produced a
+    PhysPointGet / PhysBatchPointGet whose bound constants equal the
+    recognized values and whose projection is plain column references —
+    anything else caches a negative entry and stays on the full path.
+  * Cache keys embed ``domain.schema_epoch`` (bumped by a commit hook on
+    every meta-namespace commit, i.e. every DDL, and by
+    invalidate_plan_cache) plus both binding versions, so DDL, bulk
+    loads and CREATE/DROP BINDING all fence stale templates — the same
+    dimensions as Session._plan_cache_key, at attr-read cost.
+  * Execution preserves the generic semantics: explicit-txn snapshot
+    reads (version rescan below the txn's start_ts), dirty transactions
+    / temp tables / table locks / stale reads all fall back to the full
+    pipeline, and the SELECT privilege is re-checked per execution.
+
+Metrics: hits/misses/unsupported shapes land in
+tidb_tpu_plan_cache_total{outcome} (hits also bump the legacy
+``plan_cache_hit`` flat counter tests and dashboards read).
+"""
+from __future__ import annotations
+
+import re
+import time
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+from ..errors import TiDBError
+from ..utils import metrics as metrics_util
+
+_ResultSet = None     # session.ResultSet, resolved lazily (import cycle)
+
+# bare or backtick-quoted identifier — no capturing groups inside
+_ID = r"`?[A-Za-z_][A-Za-z0-9_]*`?"
+_POINT_RE = re.compile(
+    r"^\s*select\s+(\*|" + _ID + r"(?:\s*,\s*" + _ID + r")*)"
+    r"\s+from\s+(" + _ID + r"(?:\s*\.\s*" + _ID + r")?)"
+    r"\s+where\s+(" + _ID + r")\s*"
+    r"(?:=\s*(-?\d+|\?)|in\s*\(([^()]*)\))"
+    r"\s*;?\s*$",
+    re.IGNORECASE)
+_VAL_RE = re.compile(r"^(?:-?\d+|\?)$")
+
+_NEG = object()          # cached "shape is not fast-pathable" verdict
+_NOMATCH = object()      # value provably matches no integer handle
+
+
+class PointTemplate:
+    """One cached PK-lookup plan: everything but the bound value(s)."""
+
+    __slots__ = ("table_info", "db", "tbl_name", "out_cis", "out_fts",
+                 "names", "index", "index_ci", "digest", "norm",
+                 "n_binds")
+
+    def __init__(self, table_info, db, tbl_name, out_cis, out_fts,
+                 names, index, index_ci, digest, norm, n_binds):
+        self.table_info = table_info
+        self.db = db
+        self.tbl_name = tbl_name
+        self.out_cis = out_cis        # ColumnInfo | None (None = handle)
+        self.out_fts = out_fts
+        self.names = names
+        self.index = index            # IndexInfo for unique-index gets
+        self.index_ci = index_ci
+        self.digest = digest
+        self.norm = norm
+        self.n_binds = n_binds
+
+    def run(self, sess, handles, rts):
+        """Execute with bound integer handles at snapshot ``rts``
+        (None = read latest); execution-state bailouts were already
+        cleared by _exec_state (the caller runs it before RU
+        admission). Returns a ResultSet, or None when the index-probe
+        path needs the full pipeline (bulk-loaded table)."""
+        global _ResultSet
+        ResultSet = _ResultSet
+        if ResultSet is None:
+            from .session import ResultSet
+            _ResultSet = ResultSet
+        dom = sess.domain
+        sess._check_read(self.db, self.tbl_name)
+        tbl = self.table_info
+        # .table(info), not .tables.get(id): after DDL the rebuilt
+        # template carries the NEW TableInfo and this seam is what runs
+        # update_schema (allocates arrays for added columns)
+        ctab = dom.columnar.table(tbl)
+        if self.index is not None:
+            handles = self._probe_index(sess, dom, ctab, handles, rts)
+            if handles is None:
+                return None
+        poss = []
+        out_handles = []
+        for h in handles:
+            pos = ctab.handle_pos.get(h)
+            if pos is None:
+                continue
+            if rts is None:
+                # read-latest: same predicate as PointGetExec's
+                # _gather_one (rts None + delete_ts check), including
+                # its tolerance of the columnar apply's non-atomic
+                # old-version-close / new-version-append window
+                if ctab.delete_ts[pos] != 0:
+                    continue
+            elif not (ctab.insert_ts[pos] <= rts and
+                      (ctab.delete_ts[pos] == 0 or
+                       ctab.delete_ts[pos] > rts)):
+                # latest version invisible at the snapshot: rescan for
+                # an older visible one (same walk as PointGetExec)
+                n = ctab.n
+                mask = ((ctab.handles[:n] == h) &
+                        (ctab.insert_ts[:n] <= rts) &
+                        ((ctab.delete_ts[:n] == 0) |
+                         (ctab.delete_ts[:n] > rts)))
+                idxs = np.nonzero(mask)[0]
+                if not len(idxs):
+                    continue
+                pos = int(idxs[-1])
+            poss.append(pos)
+            out_handles.append(h)
+        if not poss:
+            return ResultSet(names=list(self.names),
+                             chunks=[Chunk.empty(list(self.out_fts))])
+        cols = []
+        if len(poss) == 1:
+            # the dominant serving shape: one visible row. Slice views
+            # (no copy, values at a position are immutable once
+            # written) + a scalar null probe instead of fancy-index
+            # gathers and an .any() reduction per column.
+            p0 = poss[0]
+            sel = slice(p0, p0 + 1)
+            for ci, ft in zip(self.out_cis, self.out_fts):
+                if ci is None:
+                    cols.append(Column(ft, np.asarray(out_handles,
+                                                      dtype=np.int64)))
+                    continue
+                nlarr = ctab.nulls[ci.id]
+                cols.append(Column(ci.ft, ctab.data[ci.id][sel],
+                                   nlarr[sel] if nlarr[p0] else None,
+                                   ctab.dicts.get(ci.id)))
+            return ResultSet(names=list(self.names),
+                             chunks=[Chunk(cols)])
+        posarr = np.asarray(poss, dtype=np.int64)
+        for ci, ft in zip(self.out_cis, self.out_fts):
+            if ci is None:
+                cols.append(Column(ft, np.asarray(out_handles,
+                                                  dtype=np.int64)))
+            else:
+                # positional gather, NOT column_for: that seam scans the
+                # whole null column (`nl.any()`) per call — O(rows) on a
+                # path whose budget is O(hit)
+                vals = ctab.data[ci.id][posarr]
+                nls = ctab.nulls[ci.id][posarr]
+                cols.append(Column(ci.ft, vals,
+                                   nls if nls.any() else None,
+                                   ctab.dicts.get(ci.id)))
+        return ResultSet(names=list(self.names), chunks=[Chunk(cols)])
+
+    def _probe_index(self, sess, dom, ctab, vals, rts):
+        """Unique-index template: probe index KV for the handle(s).
+        Bulk-loaded tables have no index KV — full path owns the
+        columnar unique probe there."""
+        if ctab.bulk_rows:
+            return None
+        from ..codec.tablecodec import index_key
+        from ..executor.exec_base import coerce_datum, expr_to_datum
+        from ..expression import const_from_py
+        mvcc = dom.storage.mvcc
+        read_ts = rts if rts is not None else dom.storage.current_ts()
+        # the session's lock-wait knobs, not the env defaults: a probe
+        # blocked on a foreign lock must honor the configured wait
+        # timeout (the full path passes its ExecContext's ctx here)
+        lctx = sess._lock_ctx()
+        out = []
+        for v in vals:
+            d = coerce_datum(expr_to_datum(const_from_py(v)),
+                             self.index_ci.ft)
+            if d.is_null:
+                continue
+            ik = index_key(self.table_info.id, self.index.id, [d])
+            hv = mvcc.get(ik, read_ts, ctx=lctx)
+            if hv is not None:
+                out.append(int(hv))
+        return out
+
+
+def _shape_and_tokens(sql, m):
+    """-> (canonical shape text, value tokens) or None."""
+    eqv = m.group(4)
+    if eqv is not None:
+        tokens = [eqv]
+        shaped = sql[:m.start(4)] + "?" + sql[m.end(4):]
+    else:
+        body = m.group(5)
+        tokens = [t.strip() for t in body.split(",")]
+        if not tokens or any(_VAL_RE.match(t) is None for t in tokens):
+            return None
+        shaped = (sql[:m.start(5)] + ", ".join("?" for _ in tokens)
+                  + sql[m.end(5):])
+    # canonical: case + whitespace + quoting insensitive
+    return " ".join(shaped.replace("`", "").lower().split()), tokens
+
+
+def _bind(tokens, params):
+    """Value tokens + wire params -> integer handles.
+    Returns None when the execution must fall back (missing/odd param),
+    or a list that may be empty (provably-no-match values dropped)."""
+    out = []
+    pi = 0
+    for t in tokens:
+        if t == "?":
+            if params is None or pi >= len(params):
+                return None
+            v = params[pi]
+            pi += 1
+        else:
+            v = int(t)
+        h = _as_handle(v)
+        if h is _NOMATCH:
+            continue
+        if h is None:
+            return None
+        out.append(h)
+    return out
+
+
+def _as_handle(v):
+    """Coerce one bound value to an integer handle. _NOMATCH = can
+    never equal an integer PK (dropped, like the planner folding a
+    false predicate); None = shapes we leave to the full pipeline."""
+    if v is None:
+        return _NOMATCH               # pk = NULL matches nothing
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        iv = int(v)
+        return iv if iv == v else _NOMATCH
+    if isinstance(v, str):
+        try:
+            return int(v.strip(), 10)
+        except ValueError:
+            return None               # '5.5'/'abc': full-path coercion
+    return None
+
+
+def try_execute(sess, sql, params=None, nested=False):
+    """Serve ``sql`` from the point fast path, or return None to send
+    it down the full pipeline. ``nested=True`` (EXECUTE dispatch inside
+    an already-observed statement) skips admission + observation so the
+    outer statement isn't double counted."""
+    m = _POINT_RE.match(sql)
+    if m is None:
+        return None
+    if not sess.vars.get("tidb_tpu_plan_fastpath"):
+        return None
+    db = sess.vars.current_db
+    if not db:
+        return None
+    st = _shape_and_tokens(sql, m)
+    if st is None:
+        return None
+    shape, tokens = st
+    dom = sess.domain
+    key = (shape, db, dom.schema_epoch, dom.bind_handle.version,
+           sess.session_binds.version)
+    tpl = dom.point_plans.get(key)
+    hit = tpl is not None
+    if tpl is None:
+        tpl = _build_template(sess, sql, params, tokens)
+        if tpl is None:
+            # param-dependent / transient verdict: do NOT cache — one
+            # EXECUTE with a NULL/odd param must not poison the shape
+            # for every later integer-param execution
+            metrics_util.PLAN_CACHE.labels("uncacheable").inc()
+            return None
+        dom.point_plans.put(key, tpl)
+        if tpl is _NEG:
+            metrics_util.PLAN_CACHE.labels("uncacheable").inc()
+            return None
+        metrics_util.PLAN_CACHE.labels("miss").inc()
+    elif tpl is _NEG:
+        return None
+    handles = _bind(tokens, params)
+    if handles is None:
+        return None
+    # execution-state bailouts BEFORE RU admission: a statement that
+    # falls through to the full pipeline must not pay the token-bucket
+    # throttle twice
+    rts = _exec_state(sess, tpl, dom)
+    if rts is _BAIL:
+        return None
+    rg = None
+    if not nested and not sess.is_internal:
+        rg = dom.resource_groups.groups.get(sess.resource_group)
+        if rg is not None and rg.ru_per_sec and not rg.burstable:
+            rg.admit()                # RU token bucket still applies
+    t0 = time.time()
+    sess.vars.warnings = []           # statement resets the diag area
+    internal = "1" if sess.is_internal else "0"
+    try:
+        rs = tpl.run(sess, handles, rts)
+    except TiDBError as e:
+        sess.vars.warnings = [{
+            "level": "Error", "code": getattr(e, "code", 1105),
+            "sqlstate": getattr(e, "sqlstate", "HY000"), "msg": e.msg}]
+        sess._finish_stmt(error=True)
+        # the same failure accounting as _observe(ok=False): a
+        # fastpath-dominant workload must not error invisibly
+        metrics_util.QUERY_ERRORS.labels("select", internal).inc()
+        summ = dom.stmt_summary_map.get(tpl.digest)
+        if summ is not None:
+            summ["errors"] += 1
+        if not nested:
+            dom.plugins.fire("audit", sess, {
+                "sql": sql, "digest": tpl.digest, "ok": False,
+                "duration_ms": (time.time() - t0) * 1000.0,
+                "user": sess.user, "db": db, "conn_id": sess.conn_id})
+        raise
+    if rs is None:
+        return None                   # index-path bailout (bulk table)
+    if sess._txn is not None and not sess._explicit_txn:
+        sess._finish_stmt()
+    if hit:
+        # the acceptance contract: a warm point op IS a plan-cache hit
+        # (inc_metric keeps the /metrics compat mirror counting too)
+        dom.inc_metric("plan_cache_hit")
+        metrics_util.PLAN_CACHE.labels("hit").inc()
+    if nested:
+        return rs
+    dur_ms = (time.time() - t0) * 1000.0
+    if rg is not None:
+        rg.settle(dur_ms / 3.0 + 0.125)
+    metrics_util.QUERY_DURATION.labels("select", internal) \
+        .observe(dur_ms / 1000.0)
+    summ = dom.stmt_summary_map.get(tpl.digest)
+    if summ is None:
+        summ = dom.stmt_summary_map.setdefault(tpl.digest, {
+            "digest": tpl.digest, "normalized": tpl.norm[:1024],
+            "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0,
+            "sum_device_ms": 0.0, "fallback_count": 0})
+    summ["exec_count"] += 1
+    summ["sum_ms"] += dur_ms
+    if dur_ms > summ["max_ms"]:
+        summ["max_ms"] = dur_ms
+    dom.plugins.fire("audit", sess, {
+        "sql": sql, "digest": tpl.digest, "ok": True,
+        "duration_ms": dur_ms, "user": sess.user,
+        "db": db, "conn_id": sess.conn_id})
+    return rs
+
+
+_BAIL = object()         # execution state needs the full pipeline
+
+
+def _exec_state(sess, tpl, dom):
+    """Per-execution state gate, run BEFORE admission: -> _BAIL (full
+    pipeline owns this execution), or the snapshot read-ts (None =
+    read latest). Inside a live explicit txn the statement also
+    heartbeats the txn's locks, exactly like _execute_stmt — a stream
+    of fast-path reads must not let an ACTIVE transaction's
+    pessimistic locks expire under it."""
+    rts = None
+    txn = sess._txn
+    if txn is not None and not txn.committed and not txn.aborted:
+        if txn.is_dirty():
+            return _BAIL              # UnionScan semantics: full path
+        if sess._explicit_txn:
+            txn.heartbeat()
+            sess._stmt_lock_guard(txn, None)
+            rts = txn.start_ts        # snapshot read at the txn's ts
+    if sess.temp_tables and tpl.tbl_name in sess.temp_tables:
+        return _BAIL                  # temp table shadows the name
+    if dom.table_locks:
+        return _BAIL                  # LOCK TABLES checks: full path
+    try:
+        if int(sess.vars.get("tidb_read_staleness") or 0) != 0:
+            return _BAIL
+    except (TypeError, ValueError):
+        return _BAIL
+    return rts
+
+
+def _build_template(sess, sql, params, tokens):
+    """Cold path for a new shape: run the REAL pipeline once (parse ->
+    binding -> optimize) and accept the result as a template only when
+    the planner's own choice was a point plan bound to exactly the
+    recognized values. Three-valued result: a PointTemplate; _NEG =
+    this SHAPE can never fast-path (cached, so the text-level verdict
+    is paid once); None = undecidable THIS execution (param-dependent
+    rejection or a transient planner error — not cached)."""
+    from ..parser import parse, normalize_digest
+    from .. import planner
+    from ..planner.physical import (PhysPointGet, PhysBatchPointGet,
+                                    PhysProjection)
+    from ..expression.expr import Column as ExprColumn, Constant
+    from ..executor.exec_base import expr_to_datum
+    # post-optimize rejections: with literal SQL the planner's choice
+    # is deterministic per shape -> cache the negative; with params it
+    # may hinge on THESE param values -> don't cache
+    neg = _NEG if params is None else None
+    try:
+        stmts = parse(sql)
+    except TiDBError:
+        return _NEG
+    if len(stmts) != 1:
+        return _NEG
+    stmt = stmts[0]
+    from ..parser import ast
+    if not isinstance(stmt, ast.SelectStmt) or stmt.for_update or \
+            stmt.into_vars or stmt.into_outfile:
+        return _NEG
+    sess._apply_binding(stmt, sql)
+    pctx = sess._plan_ctx(params)
+    try:
+        plan = planner.optimize(stmt, pctx)
+    except TiDBError:
+        return None                   # full path surfaces the error
+    if not pctx.cacheable or getattr(plan, "for_update", False):
+        return neg
+    node = plan
+    proj = None
+    if isinstance(node, PhysProjection) and len(node.children) == 1:
+        proj = node
+        node = node.children[0]
+
+    def const_int(e):
+        if not isinstance(e, Constant):
+            return None
+        d = expr_to_datum(e)
+        if d.is_null:
+            return None
+        try:
+            return int(d.val)
+        except (TypeError, ValueError):
+            return None
+
+    # the values the recognizer extracted, as the planner saw them
+    bound = _bind(tokens, params)
+    if bound is None or len(bound) != len(tokens):
+        # a token was dropped (NULL/odd param; the next call's params
+        # may be plain ints) — never cache this verdict
+        return None
+    want = bound
+    index = None
+    index_ci = None
+    tbl = None
+    if isinstance(node, PhysPointGet):
+        tbl = node.table_info
+        if node.handle_expr is not None:
+            if len(want) != 1 or const_int(node.handle_expr) != want[0]:
+                return neg
+        else:
+            if node.index is None or len(node.index_vals) != 1 or \
+                    len(want) != 1:
+                return neg
+            if const_int(node.index_vals[0]) != want[0]:
+                return neg
+            index = node.index
+            index_ci = tbl.find_column(index.columns[0])
+            if index_ci is None:
+                return neg
+            from ..types.field_type import TypeClass
+            if index_ci.ft.tclass != TypeClass.INT:
+                return neg            # non-int probes: coercion zoo
+    elif isinstance(node, PhysBatchPointGet):
+        tbl = node.table_info
+        handles = getattr(node, "handles", None)
+        if not handles or len(handles) != len(want):
+            return neg
+        for e, w in zip(handles, want):
+            if const_int(e) != w:
+                return neg
+    else:
+        return neg
+    if tbl is None or tbl.id < 0 or tbl.partitions:
+        return neg
+    # the FROM name must BE the plan's base table: a view expansion
+    # (FROM v planned as a point get on t) would bind the warm path's
+    # temp-table-shadow check and privilege re-check to the wrong
+    # name, and CREATE TEMPORARY TABLE v bumps no schema epoch
+    frm = stmt.from_clause
+    if not isinstance(frm, ast.TableName) or frm.as_of is not None or \
+            frm.partitions or frm.sample is not None or \
+            frm.name.lower() != tbl.name.lower():
+        return neg
+    # output mapping: plan schema visible cols -> table columns
+    out_cis, out_fts, names = [], [], []
+    vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
+    if proj is not None:
+        if len(proj.exprs) != len(plan.schema.cols):
+            return neg
+        child_pos = {sc.col.idx: j for j, sc in
+                     enumerate(node.schema.cols)}
+        for i in vis:
+            e = proj.exprs[i]
+            if not isinstance(e, ExprColumn):
+                return neg
+            j = child_pos.get(e.idx)
+            if j is None:
+                return neg
+            src = node.schema.cols[j]
+            out_cis.append(tbl.find_column(src.name))
+            out_fts.append(plan.schema.cols[i].col.ft)
+            names.append(plan.schema.cols[i].name)
+    else:
+        for i in vis:
+            sc = plan.schema.cols[i]
+            out_cis.append(tbl.find_column(sc.name))
+            out_fts.append(sc.col.ft)
+            names.append(sc.name)
+    db = (getattr(node, "db_name", "") or
+          sess.vars.current_db).lower()
+    norm, digest = normalize_digest(sql)
+    return PointTemplate(tbl, db, tbl.name.lower(), out_cis, out_fts,
+                         names, index, index_ci, digest, norm,
+                         len(want))

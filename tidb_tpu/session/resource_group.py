@@ -13,6 +13,20 @@ control is the TPU-native shape of this feature).
 QUERY_LIMIT(EXEC_ELAPSED=..., ACTION=KILL) marks runaway queries: the
 per-statement deadline is clamped and overruns raise the standard
 query-killed error (reference runaway.go).
+
+Admission queues (the OLAP-vs-OLTP split): each group additionally
+bounds how many ANALYTIC statements run at once. Statement dispatch
+classifies every statement (session._stmt_class — aggregates, joins,
+unbounded scans = olap; point ops, DML, utility = oltp); olap
+statements acquire a slot from the group's admission queue before
+executing and release it after, while oltp statements never queue
+behind them. This is what keeps a running analytic fragment from
+starving point ops at high session counts: at most `olap_slots`
+analytics hold the interpreter/device at a time, the rest wait in the
+queue (bounded — an overlong wait admits anyway rather than erroring,
+the same cooperative-throttle shape as the RU bucket), and the point
+path stays admission-free. Waits land in
+tidb_tpu_admission_wait_seconds{rgroup,klass}.
 """
 from __future__ import annotations
 
@@ -20,8 +34,10 @@ import threading
 import time
 
 from ..errors import TiDBError
+from ..utils import metrics as metrics_util
 
 _MAX_THROTTLE_S = 1.0      # cap per-statement admission wait
+_MAX_QUEUE_WAIT_S = 10.0   # cap per-statement olap-slot queue wait
 
 
 class ResourceGroup:
@@ -37,6 +53,13 @@ class ResourceGroup:
         self.consumed_ru = 0.0              # lifetime accounting
         self.throttled_stmts = 0
         self._mu = threading.Lock()
+        # olap admission queue: slot count resolved per-statement by
+        # the session (group override or the sysvar default), so ALTER
+        # and SET GLOBAL take effect without touching live queues
+        self.olap_slots = None              # None = sysvar default
+        self._adm_cv = threading.Condition(threading.Lock())
+        self._olap_running = 0
+        self.queued_stmts = 0               # lifetime accounting
 
     def _refill(self, now):
         if self.ru_per_sec:
@@ -58,8 +81,42 @@ class ResourceGroup:
             wait = min(deficit / self.ru_per_sec, _MAX_THROTTLE_S)
             self.throttled_stmts += 1
             time.sleep(wait)
+            metrics_util.ADMISSION_WAIT_SECONDS.labels(
+                self.name, "ru").observe(wait)
             return wait
         return 0.0
+
+    def acquire_olap(self, slots: int, check_interrupt=None) -> float:
+        """Take an analytic-statement slot; blocks while ``slots``
+        statements of this group are already executing. Returns the
+        wait in seconds (observed into the admission histogram). The
+        wait is BOUNDED: past _MAX_QUEUE_WAIT_S the statement is
+        admitted anyway — admission control sheds peak concurrency, it
+        must never wedge a workload (or deadlock a nested statement
+        the classifier missed). Callers MUST pair with release_olap()
+        in a finally."""
+        t0 = time.time()
+        waited = False
+        with self._adm_cv:
+            while self._olap_running >= slots:
+                if not waited:
+                    waited = True
+                    self.queued_stmts += 1
+                if time.time() - t0 > _MAX_QUEUE_WAIT_S:
+                    break
+                if check_interrupt is not None:
+                    check_interrupt()       # KILL reaches a queued stmt
+                self._adm_cv.wait(0.05)
+            self._olap_running += 1
+        wait = time.time() - t0
+        metrics_util.ADMISSION_WAIT_SECONDS.labels(
+            self.name, "olap").observe(wait)
+        return wait
+
+    def release_olap(self):
+        with self._adm_cv:
+            self._olap_running = max(0, self._olap_running - 1)
+            self._adm_cv.notify()
 
     def settle(self, ru: float):
         if not self.ru_per_sec:

@@ -15,6 +15,7 @@ from ..errors import TiDBError, UnsupportedError
 from .sysvars import SessionVars
 from .domain import Domain
 from .ddl import DDLExecutor
+from . import fastpath as _fastpath
 
 
 class ResultSet:
@@ -55,7 +56,7 @@ class Session:
         self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
         import weakref
         domain.sessions[self.conn_id] = weakref.ref(self)
-        self.stmt_handles: dict = {} # wire stmt_id -> (stmt_ast, n_params)
+        self.stmt_handles: dict = {}  # stmt_id -> (ast, n_params, sql)
         self._next_stmt_id = 0
         self.temp_tables: dict = {}  # name -> TableInfo (negative id)
         self._next_temp_id = [-2]
@@ -153,15 +154,20 @@ class Session:
 
     # ---- public entry --------------------------------------------------
     def execute(self, sql: str, params=None) -> ResultSet:
+        # point-op fast path FIRST (session/fastpath.py): a recognized
+        # PK lookup is served from a cached plan template without
+        # parse/optimize/executor build; None = not that shape (or a
+        # state the template can't serve) -> full pipeline below
+        rs = _fastpath.try_execute(self, sql, params)
+        if rs is not None:
+            return rs
         # AST cache: same reuse contract as prepared statements (the
-        # planner treats parsed trees as read-only)
+        # planner treats parsed trees as read-only); bounded LRU
         dom = self.domain
         stmts = dom.ast_cache.get(sql)
         if stmts is None:
             stmts = parse(sql)
-            if len(dom.ast_cache) > 512:
-                dom.ast_cache.clear()
-            dom.ast_cache[sql] = stmts
+            dom.ast_cache.put(sql, stmts)
         result = ResultSet()
         cache_key_ok = len(stmts) == 1   # multi-stmt text can't key the cache
         for stmt in stmts:
@@ -179,13 +185,20 @@ class Session:
                                                set_encryption_mode)
         reset_rand_states()     # RAND(N) restarts per statement
         set_encryption_mode(self.vars.get("block_encryption_mode"))
+        from ..utils import phase as _phase
         rg = self.domain.resource_groups.groups.get(self.resource_group)
         if rg is not None:
             rg.admit()               # token-bucket admission control
+        # OLAP-vs-OLTP dispatch split: analytic statements take a
+        # bounded per-group admission slot so a burst of them can
+        # never occupy every interpreter thread while point ops
+        # queue behind. Outermost user statements only — internal
+        # SQL (TTL, stats) and nested statements must not deadlock
+        # on a slot their parent holds.
+        adm_rg = self._maybe_admit_olap(stmt, at_depth=0)
         # per-statement backend phase counters: reset at the OUTERMOST
         # statement only (internal SQL fired mid-statement — stats sync
         # load, TTL — accumulates into its triggering statement)
-        from ..utils import phase as _phase
         _phase.stmt_enter()
         # MySQL diagnostics-area lifecycle: each statement RESETS the
         # area; SHOW WARNINGS/ERRORS and GET DIAGNOSTICS read the
@@ -233,6 +246,36 @@ class Session:
                 raise
             finally:
                 _phase.stmt_leave()
+                if adm_rg is not None:
+                    adm_rg.release_olap()
+
+    def _maybe_admit_olap(self, stmt, at_depth):
+        """Take an OLAP admission slot when ``stmt`` classifies olap
+        at the expected nesting depth (0 = plain dispatch, 1 = the
+        inner statement of a textual EXECUTE, whose wrapper is the
+        outermost statement). Returns the group to release_olap() in a
+        finally, or None. The wait registers a kill sentinel in
+        _live_execs — a queued statement has no ExecContext yet, and
+        KILL <conn> must still reach it."""
+        from ..utils import phase as _phase
+        if self.is_internal or _phase.depth() != at_depth or \
+                _stmt_class(stmt) != "olap":
+            return None
+        rg = self.domain.resource_groups.groups.get(self.resource_group)
+        if rg is None:
+            return None
+        slots = rg.olap_slots
+        if slots is None:
+            slots = int(self.vars.get("tidb_tpu_olap_admission_slots"))
+        if not slots or slots <= 0:
+            return None
+        waiter = _AdmissionWaiter()
+        self.domain.register_exec(self.conn_id, waiter)
+        try:
+            rg.acquire_olap(slots, waiter.check_killed)
+        finally:
+            self.domain.unregister_exec(self.conn_id, waiter)
+        return rg
 
     def _observe(self, stmt, sql, start, ok, rgroup=None):
         """Slow log + statement summary (reference slow_log.go:373 +
@@ -268,9 +311,7 @@ class Session:
                 nd = normalize_digest(sql) if sql else ("", "")
             except Exception:
                 nd = ("", "")
-            if len(self.domain.digest_cache) > 1024:
-                self.domain.digest_cache.clear()
-            self.domain.digest_cache[sql] = nd
+            self.domain.digest_cache.put(sql, nd)
         norm, digest = nd
         threshold = int(self.vars.get("tidb_slow_log_threshold"))
         if threshold >= 0 and dur_ms > threshold:
@@ -374,22 +415,32 @@ class Session:
             self.domain.columnar.tables.pop(info.id, None)
 
     def prepare_wire(self, sql: str):
-        """Server-side PREPARE (COM_STMT_PREPARE): -> (stmt_id, n_params)."""
+        """Server-side PREPARE (COM_STMT_PREPARE): -> (stmt_id, n_params).
+        The statement TEXT is kept on the handle: COM_STMT_EXECUTE
+        routes it through the point fast path (parameterized plan-cache
+        templates) before falling back to the prepared AST."""
         from ..parser.parser import Parser
         p = Parser(sql)
         stmts = p.parse_stmts()
         if len(stmts) != 1:
             raise UnsupportedError("can only prepare a single statement")
         self._next_stmt_id += 1
-        self.stmt_handles[self._next_stmt_id] = (stmts[0], p.n_params)
+        self.stmt_handles[self._next_stmt_id] = (stmts[0], p.n_params,
+                                                 sql)
         return self._next_stmt_id, p.n_params
 
     def execute_wire(self, stmt_id: int, params):
         entry = self.stmt_handles.get(stmt_id)
         if entry is None:
             raise UnsupportedError("unknown statement handle %d", stmt_id)
-        stmt, _ = entry
-        return self._dispatch(stmt, params or None)
+        stmt, _n, text = entry
+        params = params or None
+        rs = _fastpath.try_execute(self, text, params)
+        if rs is not None:
+            return rs
+        # full statement lifecycle (admission, diagnostics area,
+        # metrics, slow log) — the wire path used to bypass it entirely
+        return self._execute_stmt(stmt, params, text, cacheable=False)
 
     def close_wire(self, stmt_id: int):
         self.stmt_handles.pop(stmt_id, None)
@@ -831,10 +882,25 @@ class Session:
             if entry is None:
                 raise UnsupportedError("Unknown prepared statement handler %s",
                                        stmt.name)
-            inner, _text = entry
+            inner, text = entry
             exec_params = [self.domain.user_vars.get(v.lower())
                            for v in stmt.using]
-            return self._dispatch(inner, exec_params or None)
+            # parameterized plan-cache fast path on the prepared TEXT
+            # (nested: the EXECUTE statement itself is already being
+            # observed/admitted by the enclosing lifecycle)
+            rs = _fastpath.try_execute(self, text, exec_params or None,
+                                       nested=True)
+            if rs is not None:
+                return rs
+            # the EXECUTE wrapper classified "oltp" at dispatch — the
+            # admission decision belongs to the INNER statement, or a
+            # prepared analytic loop bypasses the OLAP queue entirely
+            adm_rg = self._maybe_admit_olap(inner, at_depth=1)
+            try:
+                return self._dispatch(inner, exec_params or None)
+            finally:
+                if adm_rg is not None:
+                    adm_rg.release_olap()
         if isinstance(stmt, ast.DeallocateStmt):
             self.prepared.pop(stmt.name.lower(), None)
             return ResultSet()
@@ -1039,7 +1105,7 @@ class Session:
         stmts = self.domain.ast_cache.get(sql)
         if stmts is None:
             stmts = parse(sql)
-            self.domain.ast_cache[sql] = stmts
+            self.domain.ast_cache.put(sql, stmts)
         return stmts[0]
 
     def _plan_cache_key(self, sql_key):
@@ -1096,11 +1162,16 @@ class Session:
         ck = None
         dom = self.domain
         self._apply_binding(stmt, sql_key or self._cur_sql)
+        from ..utils import metrics as metrics_util
         if sql_key and params is None:
             ck = self._plan_cache_key(sql_key)
             plan = dom.plan_cache.get(ck)
             if plan is not None:
+                # labeled registry is the primary instrument; inc_metric
+                # keeps the flat counter AND its /metrics compat mirror
+                # counting for existing readers
                 dom.inc_metric("plan_cache_hit")
+                metrics_util.PLAN_CACHE.labels("hit").inc()
                 for rdb, rtbl in getattr(plan, "read_tables", ()):
                     self._check_read(rdb, rtbl)
         if plan is None:
@@ -1108,11 +1179,10 @@ class Session:
             with dom.tracer.span("plan", conn_id=self.conn_id):
                 plan = optimize(stmt, pctx)
             if ck is not None and pctx.cacheable:
-                dom.plan_cache[ck] = plan
-                dom.plan_cache_order.append(ck)
-                while len(dom.plan_cache_order) > dom.plan_cache_cap:
-                    old = dom.plan_cache_order.pop(0)
-                    dom.plan_cache.pop(old, None)
+                dom.plan_cache.put(ck, plan)   # O(1) LRU eviction
+                metrics_util.PLAN_CACHE.labels("miss").inc()
+            elif ck is not None:
+                metrics_util.PLAN_CACHE.labels("uncacheable").inc()
         if dom.table_locks:
             # before register_exec: a raise here must not leak an
             # ExecContext into _live_execs
@@ -1581,6 +1651,57 @@ class Session:
             cols.append(Column(new_string_type(), arr))
         self._finish_stmt()
         return ResultSet(names=names, chunks=[Chunk(cols)])
+
+
+class _AdmissionWaiter:
+    """Kill sentinel for a statement parked in the OLAP admission
+    queue: registered in domain._live_execs so KILL <conn> reaches it
+    before any ExecContext exists (kill_conn just sets .killed)."""
+
+    __slots__ = ("killed",)
+
+    def __init__(self):
+        self.killed = False
+
+    def check_killed(self):
+        if self.killed:
+            from ..errors import QueryKilledError
+            raise QueryKilledError("Query execution was interrupted")
+
+
+_AGG_FUNCS = frozenset((
+    "sum", "count", "avg", "min", "max", "group_concat", "std",
+    "stddev", "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+    "variance", "bit_and", "bit_or", "bit_xor", "json_arrayagg",
+    "json_objectagg", "any_value"))
+
+
+def _stmt_class(stmt) -> str:
+    """Dispatch-time workload classification for admission control
+    (docs/PERFORMANCE.md "admission contract"): analytic SELECTs —
+    aggregation, multi-table reads, set operations, windowed or
+    CTE-bearing queries, unbounded full-table scans (no WHERE, no
+    LIMIT) — are "olap" and take a bounded admission slot;
+    everything else (point ops, DML, DDL, utility) is "oltp" and never
+    queues behind analytics. A cheap AST-surface heuristic by design:
+    misclassifying toward "oltp" costs fairness, never correctness."""
+    if not isinstance(stmt, ast.SelectStmt):
+        return "oltp"
+    if stmt.group_by or stmt.having is not None or stmt.setops or \
+            stmt.ctes or stmt.distinct or stmt.with_rollup:
+        return "olap"
+    frm = stmt.from_clause
+    if frm is not None and not isinstance(frm, ast.TableName):
+        return "olap"                # join tree / subquery source
+    if frm is not None and stmt.where is None and stmt.limit is None:
+        return "olap"                # unbounded full-table scan
+    for f in stmt.fields:
+        e = getattr(f, "expr", None)
+        if isinstance(e, (ast.AggFunc, ast.WindowFunc)):
+            return "olap"
+        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+            return "olap"
+    return "oltp"
 
 
 def bootstrap(domain: Domain) -> None:

@@ -194,6 +194,28 @@ for _v in [
     SysVar("tidb_tpu_fragment_min_rows", SCOPE_BOTH,
            _env_int("TIDB_TPU_FRAGMENT_MIN_ROWS", 1 << 21), "int",
            0, 1 << 40),
+    # OLTP serving fast path (session/fastpath.py): digest-keyed
+    # point-get/batch-point-get plan templates served without the
+    # planner or an executor tree. SET ... = 0 falls back to the full
+    # statement pipeline (debugging / plan-behavior A-B tests).
+    SysVar("tidb_tpu_plan_fastpath", SCOPE_BOTH,
+           _env_int("TIDB_TPU_PLAN_FASTPATH", 1) != 0, "bool"),
+    # admission control (session/resource_group.py): how many ANALYTIC
+    # statements one resource group runs concurrently (the OLAP half of
+    # the OLAP-vs-OLTP dispatch split; point ops never queue). 0
+    # disables the queue. Default: half the cores — analytics keep
+    # real parallelism while point ops always find the interpreter.
+    SysVar("tidb_tpu_olap_admission_slots", SCOPE_BOTH,
+           _env_int("TIDB_TPU_OLAP_ADMISSION_SLOTS",
+                    max(2, (__import__("os").cpu_count() or 4) // 2)),
+           "int", 0, 4096),
+    # WAL group commit (storage/wal.py): leader/follower batched
+    # flush+fsync across concurrently committing sessions. Process
+    # config read at store open (env TIDB_TPU_WAL_GROUP_COMMIT seeds
+    # it); surfaced GLOBAL for SHOW VARIABLES/dashboards — a changed
+    # value applies at the next store open, not mid-flight.
+    SysVar("tidb_tpu_wal_group_commit", SCOPE_GLOBAL,
+           _env_int("TIDB_TPU_WAL_GROUP_COMMIT", 1) != 0, "bool"),
     # persistent XLA compilation cache (utils/jaxcfg): the directory
     # warmup compiles amortize into across processes. Surfaced as a
     # GLOBAL sysvar (SHOW VARIABLES / dashboards), resolved with the

@@ -482,6 +482,7 @@ class MVCCStore:
         aborted prewrite can never leave a durable frame behind."""
         ctx = ctx or self.default_lock_ctx
         while True:
+            seq = wal_w = None
             with self._mu:
                 self._assert_not_resolved_locked(
                     [k for k, _ in mutations], start_ts)
@@ -497,11 +498,26 @@ class MVCCStore:
                             min_commit_ts=min_commit_ts)
                     failpoint.inject("2pc-prewrite-done")
                     if min_commit_ts and self.wal is not None:
-                        # the commit point: after this append, crash
-                        # recovery commits the txn
-                        self.wal.append(min_commit_ts, mutations)
-                    return
+                        # the commit point: once this frame is DURABLE,
+                        # crash recovery commits the txn. Appended here
+                        # (file order under the mutex), made durable by
+                        # the group sync below, OUTSIDE the mutex — the
+                        # async lock (min_commit_ts) keeps the
+                        # resolved-ts floor below this txn meanwhile.
+                        # The WRITER is captured with the seq: flush_wal
+                        # / checkpoint may swap self.wal before we get
+                        # to wait (the swap closes the old writer, which
+                        # flushes+fsyncs, so a closed writer == durable)
+                        wal_w = self.wal
+                        seq = wal_w.append(min_commit_ts, mutations,
+                                           defer=True)
+                    break
             self._resolve_or_wait(blockers, start_ts, ctx)
+        if seq is not None:
+            # durability point: prewrite must not RETURN (the caller
+            # treats return as "commit point passed") before the frame
+            # is on disk
+            wal_w.wait_durable(seq)
 
     def finalize_async(self, mutations: list, start_ts: int,
                        commit_ts: int):
@@ -524,6 +540,7 @@ class MVCCStore:
         routes here)."""
         ctx = ctx or self.default_lock_ctx
         while True:
+            seq = wal_w = None
             with self._mu:
                 self._assert_not_resolved_locked(
                     [k for k, _ in mutations], start_ts)
@@ -533,7 +550,9 @@ class MVCCStore:
                                                        start_ts)
                     failpoint.inject("1pc-before-wal")
                     if self.wal is not None:
-                        self.wal.append(commit_ts, mutations)
+                        wal_w = self.wal
+                        seq = wal_w.append(commit_ts, mutations,
+                                           defer=True)
                     self._record_commit_locked(start_ts, commit_ts)
                     # release_start_ts also clears pessimistic locks we
                     # held
@@ -542,7 +561,36 @@ class MVCCStore:
                     token = self._begin_publish_locked(commit_ts)
                     break
             self._resolve_or_wait(blockers, start_ts, ctx)
-        self._publish(token, commit_ts, mutations)
+        self._durable_then_publish(seq, wal_w, token, commit_ts, mutations)
+
+    def _durable_then_publish(self, seq, wal_w, token, commit_ts: int,
+                              mutations: list):
+        """Commit epilogue outside the store mutex: wait for the
+        group-commit sync to cover this commit's frame, then run the
+        commit hooks. Hooks run strictly AFTER durability so a
+        subscriber (CDC sink, columnar) never observes a commit a crash
+        could still lose; the publication token taken under the mutex
+        holds the resolved-ts floor below this commit for the whole
+        window. The hooks run in a finally: even if the sync fails
+        (disk full), the in-memory apply already happened — skipping
+        publication would desynchronize the engines from the row store,
+        so the error surfaces AFTER subscribers are consistent.
+
+        Known relaxation (docs/PERFORMANCE.md "OLTP serving"): the
+        apply under the mutex makes the commit visible to concurrent
+        read-latest sessions before the fsync covers it — acks and
+        hooks are durability-gated, direct in-process reads are not
+        (the synchronous_commit=off visibility trade).
+        ``wal_w`` is the writer the frame was appended to, captured
+        under the mutex — flush_wal/checkpoint may have swapped
+        ``self.wal`` since (their swap closes the old writer, making
+        every buffered frame durable and releasing its waiters)."""
+        try:
+            if seq is not None and wal_w is not None:
+                wal_w.wait_durable(seq)
+            failpoint.inject("commit-durable")
+        finally:
+            self._publish(token, commit_ts, mutations)
 
     def commit(self, mutations: list, start_ts: int, commit_ts: int):
         with self._mu:
@@ -557,14 +605,18 @@ class MVCCStore:
             # WAL first: once the frame is durable the commit survives a
             # crash even if the in-memory apply below never runs (replay
             # reconstructs it); a crash before the append loses only an
-            # un-acknowledged transaction
+            # un-acknowledged transaction. With group commit the frame
+            # is buffered here (file order fixed under the mutex) and
+            # made durable by _durable_then_publish outside it.
+            seq = wal_w = None
             if self.wal is not None:
-                self.wal.append(commit_ts, mutations)
+                wal_w = self.wal
+                seq = wal_w.append(commit_ts, mutations, defer=True)
             failpoint.inject("2pc-commit-after-wal")
             self._record_commit_locked(start_ts, commit_ts)
             self._apply(mutations, commit_ts, release_start_ts=start_ts)
             token = self._begin_publish_locked(commit_ts)
-        self._publish(token, commit_ts, mutations)
+        self._durable_then_publish(seq, wal_w, token, commit_ts, mutations)
 
     def apply_replay(self, commit_ts: int, mutations: list):
         """WAL replay: apply a committed frame directly (no locks/WAL)."""
@@ -581,12 +633,14 @@ class MVCCStore:
         range exclusively (an index in WRITE_REORG being backfilled, an
         IMPORT INTO chunk). Commit hooks still run, so the columnar
         engine and WAL replication see the rows like any commit."""
+        seq = wal_w = None
         with self._mu:
             if self.wal is not None:
-                self.wal.append(commit_ts, mutations)
+                wal_w = self.wal
+                seq = wal_w.append(commit_ts, mutations, defer=True)
             self._apply(mutations, commit_ts)
             token = self._begin_publish_locked(commit_ts)
-        self._publish(token, commit_ts, mutations)
+        self._durable_then_publish(seq, wal_w, token, commit_ts, mutations)
 
     def rollback(self, keys: list, start_ts: int,
                  tombstone: bool = True):

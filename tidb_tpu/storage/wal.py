@@ -21,10 +21,18 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import zlib
 
 _MAGIC = b"WAL2"
 _CKPT_MAGIC = b"CKP2"
+
+
+def _group_commit_default() -> bool:
+    """Group commit batches the per-commit flush/fsync across
+    concurrently committing sessions (leader/follower). Env-seeded so
+    harnesses configure child processes before any store exists."""
+    return os.environ.get("TIDB_TPU_WAL_GROUP_COMMIT", "1") != "0"
 
 
 def encode_frame_payload(commit_ts: int, mutations, wall: float) -> bytes:
@@ -123,9 +131,29 @@ def valid_prefix(path: str) -> int:
 
 
 class WalWriter:
-    def __init__(self, path: str, sync: bool = False):
+    """Commit log writer with leader/follower group commit.
+
+    ``append(..., defer=True)`` (the transaction commit paths) buffers
+    the frame and returns a sequence number; the committer calls
+    ``wait_durable(seq)`` OUTSIDE the store mutex before acknowledging.
+    The first waiter becomes the sync LEADER: it flushes (and fsyncs
+    when ``sync``) everything appended so far in ONE pass and wakes
+    every follower whose frame the pass covered — N concurrent commits
+    pay one flush/fsync instead of N. Frames are appended under the
+    MVCC store mutex, so file order always matches seq order and a
+    group sync covering seq N covers every earlier frame too.
+
+    ``append`` without ``defer`` (schema migrations, tools) keeps the
+    original synchronous flush-per-frame behavior. Group commit can be
+    disabled process-wide via TIDB_TPU_WAL_GROUP_COMMIT=0, restoring
+    flush-inside-the-commit-mutex semantics at every seam."""
+
+    def __init__(self, path: str, sync: bool = False,
+                 group_commit: bool | None = None):
         self.path = path
         self.sync = sync
+        self.group_commit = _group_commit_default() \
+            if group_commit is None else bool(group_commit)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # torn-tail repair BEFORE appending: replay() stops at the first
         # bad frame, so a frame appended after a crash-torn tail would
@@ -137,27 +165,101 @@ class WalWriter:
                 with open(path, "r+b") as tf:
                     tf.truncate(good)
         self._f = open(path, "ab")
+        self._gc_cv = threading.Condition(threading.Lock())
+        self._seq = 0          # frames appended (file order == seq order)
+        self._durable_seq = 0  # frames covered by a flush(+fsync) pass
+        self._leader_busy = False
+        self._closed = False
 
     def position(self) -> int:
-        """Current append offset (end of the last durable frame) —
-        the SHOW MASTER STATUS binlog position analog."""
+        """Current append offset (end of the last appended frame,
+        buffered bytes included) — the SHOW MASTER STATUS binlog
+        position analog."""
         return self._f.tell()
 
     def flush(self):
         self._f.flush()
 
-    def append(self, commit_ts: int, mutations: list):
+    def append(self, commit_ts: int, mutations: list,
+               defer: bool = False) -> int:
+        """Append one commit frame; returns its sequence number.
+
+        defer=False (default): flush (+fsync when sync) before
+        returning — the frame is durable on return, like the original
+        writer. defer=True: buffered only; the caller MUST call
+        wait_durable(seq) before acknowledging the commit."""
         import time
         payload = encode_frame_payload(commit_ts, mutations, time.time())
         frame = struct.pack("<II", len(payload),
                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
-        self._f.write(frame)
-        self._f.flush()
-        if self.sync:
-            os.fsync(self._f.fileno())
+        with self._gc_cv:
+            self._f.write(frame)
+            self._seq += 1
+            seq = self._seq
+        if not (defer and self.group_commit):
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+            with self._gc_cv:
+                if seq > self._durable_seq:
+                    self._durable_seq = seq
+        return seq
+
+    def wait_durable(self, seq: int):
+        """Block until frame ``seq`` is flushed (+fsynced when sync).
+        The first blocked committer leads a group sync covering every
+        frame appended so far; followers just wait. Called OUTSIDE the
+        store mutex so concurrent commits keep appending while the
+        leader syncs."""
+        from ..utils import failpoint
+        from ..utils import metrics as metrics_util
+        while True:
+            with self._gc_cv:
+                if self._durable_seq >= seq or self._closed:
+                    return
+                if not self._leader_busy:
+                    self._leader_busy = True
+                    start = self._durable_seq
+                    end = self._seq
+                else:
+                    self._gc_cv.wait(0.05)
+                    continue
+            # leader, outside the lock: batch collected (frames
+            # start+1..end are in the file buffer, their committers
+            # parked) but NOT yet durable — the crash seam a wrong
+            # implementation would ack across
+            ok = False
+            try:
+                failpoint.inject("group-commit-leader")
+                self._f.flush()
+                if self.sync:
+                    os.fsync(self._f.fileno())
+                ok = True
+            finally:
+                with self._gc_cv:
+                    if ok and end > self._durable_seq:
+                        self._durable_seq = end
+                    self._leader_busy = False
+                    self._gc_cv.notify_all()
+            if ok:
+                metrics_util.WAL_GROUP_COMMIT_SIZE.observe(end - start)
 
     def close(self):
         try:
+            # buffered frames flushed; waiters released (flush_wal /
+            # checkpoint swap the writer while commits may be parked
+            # in wait_durable on the old one). A mid-sync LEADER must
+            # finish before the fd goes away — fsync on a closed fd
+            # would surface EBADF as a spurious commit failure.
+            with self._gc_cv:
+                while self._leader_busy:
+                    self._gc_cv.wait(0.05)
+                self._f.flush()
+                if self.sync:
+                    os.fsync(self._f.fileno())
+                self._durable_seq = self._seq
+                self._closed = True
+                self._gc_cv.notify_all()
             self._f.close()
         except OSError:
             pass

@@ -713,6 +713,22 @@ QUERY_DURATION = REGISTRY.histogram(
 QUERY_ERRORS = REGISTRY.counter(
     "tidb_tpu_query_error_total",
     "Failed statements by statement type", ("stmt_type", "internal"))
+PLAN_CACHE = REGISTRY.counter(
+    "tidb_tpu_plan_cache_total",
+    "Plan-cache lookups by outcome (point fast-path templates + the "
+    "instance plan cache): hit=planner skipped, miss=planned then "
+    "cached, uncacheable=planned, not cacheable (plan-time data "
+    "dependence or unsupported fast-path shape)", ("outcome",))
+WAL_GROUP_COMMIT_SIZE = REGISTRY.histogram(
+    "tidb_tpu_wal_group_commit_size",
+    "Commit frames made durable per WAL group-commit sync (leader "
+    "batch size; 1 = no concurrent committer joined the group)",
+    buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512])
+ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
+    "tidb_tpu_admission_wait_seconds",
+    "Statement admission wait by resource group and workload class "
+    "(olap=slot queue, ru=token-bucket throttle)",
+    ("rgroup", "klass"))
 CONNECTIONS = REGISTRY.gauge(
     "tidb_tpu_connections", "Live sessions (weakref-reachable)")
 ACTIVE_TXNS = REGISTRY.gauge(
