@@ -49,23 +49,40 @@ class Capture:
         self.domain = domain
         self._mu = threading.Lock()
         self._subs: dict[int, deque] = {}
+        self._inline: list = []
         self._next_sub = 0
         self._hooked = False
         # table_id -> (db_name, TableInfo), invalidated per infoschema
         self._meta_cache = (None, {})
 
     # ---- subscription -------------------------------------------------
+    def _hook_locked(self):
+        if not self._hooked:
+            # the hook stays installed for the domain's lifetime
+            # (a no-op fan-out when no feeds are live)
+            self.domain.storage.mvcc.commit_hooks.append(self._on_commit)
+            self._hooked = True
+
     def subscribe(self) -> int:
         with self._mu:
-            if not self._hooked:
-                # the hook stays installed for the domain's lifetime
-                # (a no-op fan-out when no feeds are live)
-                self.domain.storage.mvcc.commit_hooks.append(self._on_commit)
-                self._hooked = True
+            self._hook_locked()
             self._next_sub += 1
             sid = self._next_sub
             self._subs[sid] = deque()
             return sid
+
+    def subscribe_inline(self, fn):
+        """Second-consumer seam (copr/delta.py, docs/CDC.md): ``fn``
+        is called with every raw ``(commit_ts, mutations)`` batch ON
+        THE COMMITTING THREAD, outside the capture mutex — unlike a
+        queued subscription it cannot grow a backlog while nothing
+        drains it (the delta maintainer is pull-based at bind time, so
+        a pure-OLTP phase must not buffer batches it will fold from
+        the columnar arrays anyway). Consumers must be O(batch) and
+        must not raise."""
+        with self._mu:
+            self._hook_locked()
+            self._inline.append(fn)
 
     def unsubscribe(self, sid: int):
         with self._mu:
@@ -77,6 +94,9 @@ class Capture:
         with self._mu:
             for q in self._subs.values():
                 q.append((commit_ts, mutations))
+            inline = list(self._inline) if self._inline else ()
+        for fn in inline:
+            fn(commit_ts, mutations)
 
     def drain(self, sid: int) -> list:
         """Pending raw batches for one subscriber (fan-out order, not

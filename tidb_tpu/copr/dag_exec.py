@@ -104,9 +104,50 @@ class CoprExecutor:
         # the "per-query device buffer pool" of SURVEY.md §5
         # generalized to cross-statement residency (copr/residency.py)
         self._dev_store = DeviceResidentStore(dev_cache_bytes)
+        # incremental HTAP (copr/delta.py): folds committed deltas
+        # into resident buffers at bind time instead of letting the
+        # version sweep drop-and-reupload them whole; also the
+        # freshness bookkeeping behind tidb_replica_freshness
+        from .delta import DeltaMaintainer
+        self.delta = DeltaMaintainer(self)
         # host-side per-version metadata: dim sort orders, learned group
         # bucket sizes (so the regrow loop doesn't re-run every query)
         self._host_cache: dict = {}
+
+    def _upload_padded(self, arr_np, cap, pad_fill=0, mesh=None,
+                       spec="local"):
+        """THE upload tail shared by every resident-store seam: pad to
+        ``cap``, place by spec (local jnp / row-sharded / replicated),
+        account upload phases and the Broadcast exchange. -> (dev,
+        ndev). Fixes to upload accounting or placement live here
+        once."""
+        import jax
+        t0 = time.perf_counter()
+        arr = arr_np
+        if len(arr) != cap:
+            arr = np.concatenate(
+                [arr, np.full(cap - len(arr), pad_fill,
+                              dtype=arr.dtype)])
+        ndev = 1
+        if mesh is None or spec == "local":
+            dev = jnp.asarray(arr)
+            moved = dev.size * dev.dtype.itemsize
+        elif spec == "sharded":
+            from ..parallel import row_sharding
+            dev = jax.device_put(arr, row_sharding(mesh))
+            ndev = int(mesh.devices.size)
+            moved = dev.size * dev.dtype.itemsize
+        else:
+            from ..parallel import replicated_sharding
+            dev = jax.device_put(arr, replicated_sharding(mesh))
+            ndev = int(mesh.devices.size)
+            moved = dev.size * dev.dtype.itemsize * ndev
+            _metrics.MPP_EXCHANGE.labels("broadcast").inc()
+            _metrics.MPP_EXCHANGE_BYTES.labels("broadcast").inc(moved)
+        phase.add("upload_s", time.perf_counter() - t0)
+        phase.add("upload_bytes", moved)
+        phase.inc("uploads")
+        return dev, ndev
 
     def _dev_put(self, key, arr_np, pad_fill=0, uid=None, version=None):
         """Upload (padded) into the resident store; returns the device
@@ -119,16 +160,8 @@ class CoprExecutor:
             _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
         _metrics.DEV_BUFFER_POOL.labels("miss").inc()
-        t0 = time.perf_counter()
-        cap = key[-1]
-        if len(arr_np) != cap:
-            arr_np = np.concatenate(
-                [arr_np, np.full(cap - len(arr_np), pad_fill,
-                                 dtype=arr_np.dtype)])
-        dev = jnp.asarray(arr_np)
-        phase.add("upload_s", time.perf_counter() - t0)
-        phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
-        phase.inc("uploads")
+        dev, _ndev = self._upload_padded(arr_np, key[-1],
+                                         pad_fill=pad_fill)
         self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
                             uid=key[0] if uid is None else uid,
                             version=version)
@@ -174,9 +207,14 @@ class CoprExecutor:
             tbl = self.engine.table(dag.table_info)
             if dag.table_info.id < 0:
                 read_ts = None              # session temp table: read latest
-            # eager residency invalidation: a DML commit bumped the
-            # version — drop the stale HBM buffers NOW instead of
-            # letting dead arrays age out by LRU pressure
+            # incremental HTAP (copr/delta.py): fold committed deltas
+            # into the resident buffers FIRST — patched entries advance
+            # their version in place and survive the sweep below —
+            # then drop whatever is still stale (derived entries:
+            # validity masks, luts; and unpatchable buffers). Without
+            # the fold this sweep was a full drop-and-reupload per
+            # DML commit.
+            self.delta.refresh(tbl, ectx)
             self._dev_store.invalidate(tbl.uid, tbl.version)
         arrays, valid = tbl.snapshot(
             [cid for cid in (self._cid(dag, sc) for sc in dag.cols)
@@ -358,9 +396,13 @@ class CoprExecutor:
                                 None if nulls is None else nulls[part_slice],
                                 sdict)
             if cacheable:
+                # append-seam bind record (consumed by _pad_upload):
+                # version/gc_epoch ride OUT of the cache key so a
+                # pure-append commit tail-patches the resident buffer
+                # instead of re-uploading it (copr/delta.py)
                 self._bind_keys[sc.col.idx] = (
-                    tbl.uid, cid, tbl.version, part_slice.start,
-                    part_slice.stop)
+                    tbl.uid, cid, tbl.gc_epoch, part_slice.start,
+                    part_slice.stop, tbl.version)
         return cols
 
     # ---- host (numpy) fallback ---------------------------------------
@@ -488,17 +530,27 @@ class CoprExecutor:
             # _bind_cols call: pipelined/retried partitions must pass
             # their own captured keys or wrong cached buffers bind
             bind_keys = getattr(self, "_bind_keys", {})
+        from .delta import append_key
         for k, (data, nulls, sdict) in cols.items():
             ck = bind_keys.get(k)
             if ck is not None:
-                # _bind_cols key layout: (uid, cid, version, start, stop)
-                jd = self._dev_put(ck + ("d", cap), data,
-                                   uid=ck[0], version=ck[2])
+                # _bind_cols record: (uid, cid, epoch, start, stop,
+                # version). Keys are version-free ("tcol" layout): the
+                # entry's rows/version advance in place under appends
+                uid, cid, epoch, start, stop, ver = ck
+                want = stop - start
+                jd = self._dev_put_append(
+                    append_key(uid, "frag", cid, "d", epoch, (start,),
+                               cap),
+                    data, want, cap, uid, ver, epoch, start,
+                    self.device_rows)
                 jn = None
                 if nulls is not None:
-                    jn = self._dev_put(ck + ("n", cap), nulls,
-                                       pad_fill=True,
-                                       uid=ck[0], version=ck[2])
+                    jn = self._dev_put_append(
+                        append_key(uid, "frag", cid, "n", epoch,
+                                   (start,), cap),
+                        nulls, want, cap, uid, ver, epoch, start,
+                        self.device_rows, pad_fill=True)
             else:
                 d = data
                 if len(d) != cap:
@@ -537,21 +589,11 @@ class CoprExecutor:
             _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
         _metrics.DEV_BUFFER_POOL.labels("miss").inc()
-        import jax
-        from ..parallel import row_sharding
-        t0 = time.perf_counter()
-        if len(arr_np) != cap:
-            arr_np = np.concatenate(
-                [arr_np, np.full(cap - len(arr_np), pad_fill,
-                                 dtype=arr_np.dtype)])
-        dev = jax.device_put(arr_np, row_sharding(mesh))
-        phase.add("upload_s", time.perf_counter() - t0)
-        phase.add("upload_bytes", dev.size * dev.dtype.itemsize)
-        phase.inc("uploads")
+        dev, ndev = self._upload_padded(arr_np, cap, pad_fill=pad_fill,
+                                        mesh=mesh, spec="sharded")
         self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
                             uid=key[0] if uid is None else uid,
-                            version=version, spec="sharded",
-                            ndev=int(mesh.devices.size))
+                            version=version, spec="sharded", ndev=ndev)
         return dev
 
     def _dev_put_replicated(self, key, arr_np, mesh, cap, pad_fill=0,
@@ -566,25 +608,55 @@ class CoprExecutor:
             _metrics.DEV_BUFFER_POOL.labels("hit").inc()
             return hit
         _metrics.DEV_BUFFER_POOL.labels("miss").inc()
-        import jax
-        from ..parallel import replicated_sharding
-        t0 = time.perf_counter()
-        if len(arr_np) != cap:
-            arr_np = np.concatenate(
-                [arr_np, np.full(cap - len(arr_np), pad_fill,
-                                 dtype=arr_np.dtype)])
-        dev = jax.device_put(arr_np, replicated_sharding(mesh))
-        ndev = int(mesh.devices.size)
-        moved = dev.size * dev.dtype.itemsize * ndev
-        phase.add("upload_s", time.perf_counter() - t0)
-        phase.add("upload_bytes", moved)
-        phase.inc("uploads")
-        _metrics.MPP_EXCHANGE.labels("broadcast").inc()
-        _metrics.MPP_EXCHANGE_BYTES.labels("broadcast").inc(moved)
+        dev, ndev = self._upload_padded(arr_np, cap, pad_fill=pad_fill,
+                                        mesh=mesh, spec="replicated")
         self._dev_store.put(key, dev, dev.size * dev.dtype.itemsize,
                             uid=key[0] if uid is None else uid,
                             version=version, spec="replicated",
                             ndev=ndev)
+        return dev
+
+    def _dev_put_append(self, key, arr_np, want, cap, uid, version,
+                        epoch, start, span, pad_fill=0, mesh=None,
+                        spec="local"):
+        """Append-aware resident upload of an append-only table-column
+        slice (docs/PERFORMANCE.md "Incremental HTAP"). ``arr_np``
+        holds rows [start, start+want) of the column; the buffer pads
+        to ``cap``. A live entry with enough rows is a pure hit; one
+        that fell behind is TAIL-PATCHED on device (O(delta) upload)
+        and advances its version in place; only a missing entry (or a
+        failed/oversized patch) pays the full upload. ``spec``/mesh
+        choose placement exactly like _dev_put/_dev_put_sharded/
+        _dev_put_replicated."""
+        store = self._dev_store
+        ent = store.get_appendable(key)
+        if ent is not None:
+            dev, rows, ver = ent
+            if rows >= want:
+                phase.inc("upload_hits")
+                _metrics.DEV_BUFFER_POOL.labels("hit").inc()
+                if ver != version:
+                    # delete/update-only version bump: data unchanged
+                    store.advance_version(key, version)
+                return dev
+            patched = self.delta.patch_entry(
+                key, dev, rows, want, cap, spec, arr_np[rows:want],
+                pad_fill, version)
+            if patched is not None:
+                phase.inc("upload_hits")
+                _metrics.DEV_BUFFER_POOL.labels("hit").inc()
+                return patched
+            store.drop(key, "delta_overflow")
+            _metrics.DELTA_APPLY.labels("fell_back_full_upload").inc()
+        _metrics.DEV_BUFFER_POOL.labels("miss").inc()
+        if mesh is None:
+            spec = "local"
+        dev, ndev = self._upload_padded(arr_np, cap, pad_fill=pad_fill,
+                                        mesh=mesh, spec=spec)
+        store.put_appendable(key, dev, dev.size * dev.dtype.itemsize,
+                             uid, version, rows=want, start=start,
+                             span=span, cap=cap, spec=spec, ndev=ndev,
+                             epoch=epoch)
         return dev
 
     def _try_execute_mpp(self, dag, tbl, arrays, valid, n, handles,
@@ -613,7 +685,13 @@ class CoprExecutor:
             return None
         ndev = int(mesh.devices.size)
         lane = 128 * ndev
-        padded = ((n + lane - 1) // lane) * lane
+        # BUCKETED lane-multiple padding (was an exact lane multiple):
+        # residency + delta maintenance need the padded capacity — and
+        # with it the compiled kernel shape and the buffer keys — to
+        # survive appends within a bucket, so a steady write stream
+        # tail-patches the sharded buffers instead of re-keying them
+        # every `lane` rows
+        padded = ((shape_bucket(n) + lane - 1) // lane) * lane
         local = padded // ndev
         cols = cols_full
         names = sorted(cols.keys())
@@ -621,22 +699,26 @@ class CoprExecutor:
         # per-plan and collide across statements (a scalar subquery
         # priming the cache poisoned the outer query's columns)
         cid_of_idx = {sc.col.idx: self._cid(dag, sc) for sc in dag.cols}
+        from .delta import append_key
         args = []
         has_nulls = {}
+        epoch = tbl.gc_epoch
         for k in names:
             data, nulls, sdict = cols[k]
-            ck_base = (tbl.uid, "mppcol", cid_of_idx.get(k, -1),
-                       tbl.version, ndev, padded)
-            args.append(self._dev_put_sharded(ck_base + ("d",), data, mesh,
-                                              padded, uid=tbl.uid,
-                                              version=tbl.version))
+            cid = cid_of_idx.get(k, -1)
+            kind = "h" if cid == -1 else "d"
+            args.append(self._dev_put_append(
+                append_key(tbl.uid, "mppcol", cid, kind, epoch,
+                           (ndev,), padded),
+                data, n, padded, tbl.uid, tbl.version, epoch, 0, None,
+                mesh=mesh, spec="sharded"))
             has_nulls[k] = nulls is not None
             if nulls is not None:
-                args.append(self._dev_put_sharded(ck_base + ("n",), nulls,
-                                                  mesh, padded,
-                                                  pad_fill=True,
-                                                  uid=tbl.uid,
-                                                  version=tbl.version))
+                args.append(self._dev_put_append(
+                    append_key(tbl.uid, "mppcol", cid, "n", epoch,
+                               (ndev,), padded),
+                    nulls, n, padded, tbl.uid, tbl.version, epoch, 0,
+                    None, pad_fill=True, mesh=mesh, spec="sharded"))
         # the MVCC validity mask is version+snapshot-keyed (same policy
         # as _upload_dim's ts_keyed entries): within one (version,
         # read_ts) it is immutable, so it stays resident too — the old

@@ -565,6 +565,12 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
     ver = tbl.version
     mk = (() if mesh is None else ("bcast", mesh.devices.size)) + \
         tuple(meta.get("ukey", ()))
+    # plain dim column data is append-only table state: it rides the
+    # delta-maintained append seam (copr/delta.py) when the meta wraps
+    # a REAL columnar table — materialized-dim shims (_MatTbl) and the
+    # fabricated empty-dim placeholder arrays must not (their arrays
+    # are not the table's columns)
+    appendable = hasattr(tbl, "gc_epoch") and not meta.get("synthetic")
 
     def put(tag, arr, length, acap, fill=0, ts_keyed=False):
         # plain column data depends only on the table version; only the
@@ -579,6 +585,18 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
                                  uid=tbl.uid, version=ver)
         return copr._dev_put_replicated(key, arr, mesh, acap, pad_fill=fill,
                                         uid=tbl.uid, version=ver)
+
+    def put_col(cid, kind, arr, acap, fill=0):
+        # append seam for raw dim columns: the whole column [0, n)
+        # padded to acap, tail-patched under appends instead of
+        # re-uploaded on every dim-table version bump
+        from .delta import append_key
+        key = append_key(tbl.uid, ("dim",) + mk, cid, kind,
+                         tbl.gc_epoch, (), acap)
+        return copr._dev_put_append(
+            key, arr, n, acap, tbl.uid, ver, tbl.gc_epoch, 0, None,
+            pad_fill=fill, mesh=mesh,
+            spec="local" if mesh is None else "replicated")
 
     pre = bool(meta.get("pre"))
     args = {"cols": {}}
@@ -611,10 +629,16 @@ def _upload_dim(copr, dim, meta, cap, read_ts, mesh=None):
             if cid == -1:
                 continue
             data, nulls, sdict = meta["arrays"][cid]
-            jd = put(("fp", cid), data, n, cap)
-            jn = None
-            if nulls is not None:
-                jn = put(("fpn", cid), nulls, n, cap, fill=True)
+            if appendable:
+                jd = put_col(cid, "d", data, cap)
+                jn = None
+                if nulls is not None:
+                    jn = put_col(cid, "n", nulls, cap, fill=True)
+            else:
+                jd = put(("fp", cid), data, n, cap)
+                jn = None
+                if nulls is not None:
+                    jn = put(("fpn", cid), nulls, n, cap, fill=True)
             args["cols"][sc.col.idx] = (jd, jn)
             layout[sc.col.idx] = (nulls is not None, sdict)
     return args, layout
@@ -1202,8 +1226,12 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     sharded over 'dp', dims broadcast, aggregation allreduced."""
     engine = copr.engine
     fact_tbl = engine.table(plan.fact_dag.table_info)
-    # eager residency invalidation for every table the fragment binds:
-    # stale-version HBM buffers die here, not under LRU pressure
+    # incremental HTAP: fold committed deltas into resident buffers
+    # FIRST (patched entries advance their version and survive), then
+    # sweep what stayed stale (derived entries, unpatchable buffers) —
+    # copr/delta.py; this used to be a full drop-and-reupload per
+    # DML commit
+    copr.delta.refresh(fact_tbl, ctx)
     copr._dev_store.invalidate(fact_tbl.uid, fact_tbl.version)
     dim_metas = []
     for dim in plan.dims:
@@ -1214,6 +1242,7 @@ def fused_partials(copr, plan, read_ts, mesh=None,
             dim_metas.append(meta)
             continue
         tbl = engine.table(dim.dag.table_info)
+        copr.delta.refresh(tbl, ctx)
         copr._dev_store.invalidate(tbl.uid, tbl.version)
         if tbl.n == 0:
             if dim.join_type in ("inner", "semi"):
@@ -1232,7 +1261,11 @@ def fused_partials(copr, plan, read_ts, mesh=None,
                 "arrays": arrays, "valid": np.zeros(1, dtype=bool),
                 "n": 1, "tbl": tbl, "mode": "direct",
                 "lut": np.array([1], dtype=np.int64), "lo": 0,
-                "n_sorted": 0, "pack": None})
+                "n_sorted": 0, "pack": None,
+                # arrays are fabricated 1-row placeholders, NOT the
+                # table's append-only columns: they must never enter
+                # the delta-maintained append seam under this uid
+                "synthetic": True})
             continue
         meta = _dim_sort_meta(copr, dim, tbl, read_ts)
         if meta is None:
@@ -1826,26 +1859,36 @@ def _run_fused_mpp(copr, plan, mesh, fact_tbl, fact_arrays, fact_valid,
                    dim_pres=()):
     """Mesh execution: ONE shard_map call over the whole fact table."""
     from ..mpp.exec import exchange_observed, tree_nbytes
+    from .delta import append_key
     ndev = int(mesh.devices.size)
     lane = 128 * ndev
-    padded = ((n + lane - 1) // lane) * lane
+    # BUCKETED lane-multiple padding (was an exact lane multiple): the
+    # sharded fact buffers and their kernel shape must survive appends
+    # within a bucket so the delta maintainer can tail-patch them
+    # on-mesh instead of re-keying every `lane` rows (copr/delta.py)
+    padded = ((shape_bucket(n) + lane - 1) // lane) * lane
     local = padded // ndev
     cols = copr._bind_cols(plan.fact_dag, fact_tbl, fact_arrays,
                            slice(0, n), handles)
     fjc = {}
     ver = fact_tbl.version
+    epoch = fact_tbl.gc_epoch
     for sc in plan.fact_dag.cols:
         cid = _cid_of(plan.fact_dag, sc)
         data, nulls, _sd = cols[sc.col.idx]
-        jd = copr._dev_put_sharded(
-            (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded, "d"),
-            data, mesh, padded, uid=fact_tbl.uid, version=ver)
+        jd = copr._dev_put_append(
+            append_key(fact_tbl.uid, "mppf",
+                       cid, "h" if cid == -1 else "d", epoch, (ndev,),
+                       padded),
+            data, n, padded, fact_tbl.uid, ver, epoch, 0, None,
+            mesh=mesh, spec="sharded")
         jn = None
         if nulls is not None:
-            jn = copr._dev_put_sharded(
-                (fact_tbl.uid, cid, ver, read_ts, "mppf", ndev, padded,
-                 "n"), nulls, mesh, padded, pad_fill=True,
-                uid=fact_tbl.uid, version=ver)
+            jn = copr._dev_put_append(
+                append_key(fact_tbl.uid, "mppf", cid, "n", epoch,
+                           (ndev,), padded),
+                nulls, n, padded, fact_tbl.uid, ver, epoch, 0, None,
+                pad_fill=True, mesh=mesh, spec="sharded")
         fjc[sc.col.idx] = (jd, jn)
     # the fact validity mask is (version, read_ts)-immutable: residency
     # (same contract as the sharded columns above) instead of a raw

@@ -38,6 +38,23 @@ kernel (same static shape); only growth past a bucket boundary
 re-pads. Dirty-transaction overlays never enter the pool (their keys
 are never cacheable — see _partitions' empty bind_keys).
 
+APPENDABLE entries (incremental HTAP, docs/PERFORMANCE.md
+"Incremental HTAP"): base-table column slices are append-only between
+gc() compactions — put_row/bulk_append only write at the tail and
+delete/update freshness rides the MVCC validity mask, never the data
+arrays. Entries put through ``put_appendable`` therefore record
+(rows, version) OUT of the cache key: when a DML commit bumps the
+table version, the delta maintainer (copr/delta.py) patches the tail
+rows in place with a jitted append program and ``apply_delta``
+advances the entry's version — the commit costs O(delta) upload
+bytes instead of an O(table) drop-and-reupload. ``invalidate(uid,
+keep_version)`` keeps such a delta-advanced entry (its recorded
+version matches) while still dropping the version/ts-keyed DERIVED
+entries (validity masks, dim luts/sort orders) the statement must
+rebuild. apply_delta/advance_version write the new version through to
+the ``_by_uid`` index — without that write-through the very next
+bind-time sweep would drop the entry the maintainer just patched.
+
 Thread safety: one store is shared by every connection thread of a
 domain; all internal state mutates under one lock (the get/put fast
 paths are a few dict ops)."""
@@ -70,6 +87,13 @@ class DeviceResidentStore:
         self._by_uid: dict = {}        # uid -> {key: version}
         self._spec_of: dict = {}       # key -> placement spec
         self._bytes_by_spec = {s: 0 for s in SPECS}
+        # key -> [rows, start, span|None, cap, ndev, epoch] for
+        # append-only table-column entries (delta maintenance,
+        # copr/delta.py); version lives in _by_uid like every other
+        # entry. epoch is the table's gc_epoch at put time: compaction
+        # rewrites positions in place, so a stale-epoch entry must be
+        # dropped, never patched or advanced.
+        self._append: dict = {}
 
     def __len__(self):
         return len(self._entries)
@@ -109,11 +133,14 @@ class DeviceResidentStore:
         """Insert a buffer; the store charges it by placement spec
         (charged_bytes) and evicts LRU entries past the byte budget.
         uid/version feed the invalidation index — unversioned entries
-        (version None) are dropped whenever their uid invalidates."""
+        (version None) are dropped whenever their uid invalidates.
+        -> True when inserted, False when the key already held a
+        buffer (the existing one wins; callers that must know — e.g.
+        put_appendable's metadata — check the return)."""
         charged = self.charged_bytes(nbytes, spec, ndev)
         with self._mu:
             if key in self._entries:
-                return
+                return False
             while self.bytes + charged > self.budget and self._order:
                 self._drop_locked(next(iter(self._order)), "lru")
             self._entries[key] = dev
@@ -129,6 +156,110 @@ class DeviceResidentStore:
             if uid is not None:
                 self._uid_of[key] = uid
                 self._by_uid.setdefault(uid, {})[key] = version
+            return True
+
+    # ---- append-only entries (delta maintenance) ----------------------
+    def put_appendable(self, key, dev, nbytes: int, uid, version,
+                       rows: int, start: int, span, cap: int,
+                       spec: str = "local", ndev: int = 1,
+                       epoch: int = 0):
+        """Insert an append-only table-column buffer. The buffer holds
+        ``rows`` valid rows of the column slice [start, start+span)
+        (span None = unbounded: the slice runs to the table tail),
+        padded to ``cap``; rows beyond ``rows`` are padding the MVCC
+        validity mask must gate off. The delta maintainer patches the
+        tail and advances (rows, version) in place via apply_delta."""
+        if not self.put(key, dev, nbytes, uid=uid, version=version,
+                        spec=spec, ndev=ndev):
+            # a concurrent bind inserted first (its buffer is equally
+            # correct); recording OUR rows against ITS buffer would
+            # overclaim coverage
+            return
+        with self._mu:
+            if key in self._entries:
+                self._append[key] = [rows, start, span, cap, ndev, epoch]
+
+    def get_appendable(self, key):
+        """-> (dev, rows, version) for a live appendable entry, else
+        None. LRU-touches like get()."""
+        with self._mu:
+            hit = self._entries.get(key)
+            meta = self._append.get(key)
+            if hit is None or meta is None:
+                return None
+            self._order.pop(key)
+            self._order[key] = None
+            uid = self._uid_of.get(key)
+            ver = self._by_uid.get(uid, {}).get(key)
+            return hit, meta[0], ver
+
+    def appendable_entries(self, uid) -> list:
+        """Snapshot of the uid's appendable entries for a maintainer
+        fold: [(key, dev, rows, version, start, span, cap, spec,
+        ndev, epoch)]."""
+        out = []
+        with self._mu:
+            keys = self._by_uid.get(uid)
+            if not keys:
+                return out
+            for k, ver in keys.items():
+                meta = self._append.get(k)
+                if meta is None:
+                    continue
+                out.append((k, self._entries[k], meta[0], ver, meta[1],
+                            meta[2], meta[3], self._spec_of.get(k, "local"),
+                            meta[4], meta[5]))
+        return out
+
+    def apply_delta(self, key, dev, rows: int, version,
+                    expect_rows: int | None = None) -> bool:
+        """Replace an appendable entry's buffer with its tail-patched
+        successor and advance (rows, version) IN PLACE — the padded
+        capacity is unchanged, so the charge is too. The version is
+        written through to the ``_by_uid`` index: ``invalidate(uid,
+        keep_version=version)`` (the bind-time sweep) must KEEP the
+        patched entry, not drop it. With ``expect_rows`` the swap is
+        compare-and-set: a concurrent fold that already advanced the
+        entry wins and this one is discarded (returns False)."""
+        with self._mu:
+            meta = self._append.get(key)
+            if meta is None or key not in self._entries:
+                return False
+            if expect_rows is not None and meta[0] != expect_rows:
+                return False
+            self._entries[key] = dev
+            meta[0] = rows
+            self._order.pop(key, None)
+            self._order[key] = None
+            uid = self._uid_of.get(key)
+            idx = self._by_uid.get(uid)
+            if idx is not None and key in idx:
+                idx[key] = version
+            return True
+
+    def advance_version(self, key, version) -> bool:
+        """Record that an appendable entry is current at ``version``
+        without touching its buffer (delete/update-only commits: the
+        data arrays did not change, only the validity mask — which is
+        derived, rebuilt per read). Write-through to _by_uid, same
+        rationale as apply_delta."""
+        with self._mu:
+            if key not in self._entries or key not in self._append:
+                return False
+            uid = self._uid_of.get(key)
+            idx = self._by_uid.get(uid)
+            if idx is not None and key in idx:
+                idx[key] = version
+                return True
+            return False
+
+    def drop(self, key, cause: str = "delta_overflow") -> bool:
+        """Drop one entry by key (delta fallback-to-full-upload)."""
+        with self._mu:
+            if key not in self._entries:
+                return False
+            self._drop_locked(key, cause)
+            return True
 
     def invalidate(self, uid, keep_version=None) -> int:
         """Drop every buffer of `uid` whose recorded version differs
@@ -162,6 +293,7 @@ class DeviceResidentStore:
 
     def _drop_locked(self, key, cause: str):
         self._entries.pop(key, None)
+        self._append.pop(key, None)
         freed = self._sizes.pop(key, 0)
         self.bytes -= freed
         self._order.pop(key, None)

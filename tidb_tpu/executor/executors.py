@@ -81,6 +81,12 @@ class TableReaderExec(Executor):
         """UnionScan overlay: uncommitted row mutations for this table from
         the session's dirty transaction."""
         dag = dag or self.dag
+        if getattr(self.ctx, "analytic_resolved", False):
+            # resolved-ts analytic read: committed-data view at the
+            # resolved floor by design — the session's uncommitted
+            # writes are invisible to it (docs/PERFORMANCE.md
+            # "Incremental HTAP"; the stale-read opt-in contract)
+            return None
         sess = self.ctx.sess
         txn = getattr(sess, "_txn", None)
         if txn is None or txn.committed or txn.aborted or not txn.is_dirty():
@@ -186,7 +192,15 @@ class FusedPipelineExec(Executor):
         committed versions of updated/deleted handles are masked out
         of the base snapshot's validity array, keeping the fused path
         under concurrent OLTP writes. Dim-table writes and
-        subplan-base writes still fall back (correct, slower)."""
+        subplan-base writes still fall back (correct, slower).
+
+        Resolved-ts analytic reads (ctx.analytic_resolved) are clean
+        BY CONTRACT: they snapshot committed data at the resolved
+        floor and never consult the session's dirty buffer — this is
+        what retires the fused_pipeline_dirty_overlay rescans for
+        committed-data freshness."""
+        if getattr(self.ctx, "analytic_resolved", False):
+            return "clean", None
         sess = self.ctx.sess
         txn = getattr(sess, "_txn", None)
         if txn is None or txn.committed or txn.aborted or \
@@ -398,7 +412,12 @@ class BatchPointGetExec(Executor):
         from ..codec.codec import decode_row_value
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
-            and txn.is_dirty()
+            and txn.is_dirty() \
+            and not getattr(self.ctx, "analytic_resolved", False)
+        # analytic_resolved: a resolved-ts read is a committed-data
+        # view by contract on EVERY plan shape — point/index paths
+        # must not merge the dirty memBuffer either, or the same
+        # statement would see different data depending on the plan
         ctab = sess.domain.columnar.tables.get(tbl.id)
         empty = Chunk.empty([sc.col.ft for sc in self.schema.cols])
         handles = []
@@ -506,7 +525,12 @@ class IndexRangeExec(Executor):
             hi = hi + (b"\xff" * 9 if high_inc else b"")
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
-            and txn.is_dirty()
+            and txn.is_dirty() \
+            and not getattr(self.ctx, "analytic_resolved", False)
+        # analytic_resolved: a resolved-ts read is a committed-data
+        # view by contract on EVERY plan shape — point/index paths
+        # must not merge the dirty memBuffer either, or the same
+        # statement would see different data depending on the plan
         lim = getattr(self.plan, "scan_limit", -1)
         if dirty:
             entries = txn.scan(lo, hi, limit=lim)  # memBuffer merged
@@ -751,7 +775,12 @@ class PointGetExec(Executor):
         from ..codec.codec import decode_row_value
         txn = getattr(sess, "_txn", None)
         dirty = txn is not None and not txn.committed and not txn.aborted \
-            and txn.is_dirty()
+            and txn.is_dirty() \
+            and not getattr(self.ctx, "analytic_resolved", False)
+        # analytic_resolved: a resolved-ts read is a committed-data
+        # view by contract on EVERY plan shape — point/index paths
+        # must not merge the dirty memBuffer either, or the same
+        # statement would see different data depending on the plan
         handle = None
         if plan.handle_expr is not None:
             d = expr_to_datum(plan.handle_expr)

@@ -127,7 +127,8 @@ def _gen_top_sql(domain):
                e["sum_host_ms"], e["sum_fetch_ms"], e["sum_upload_ms"],
                e["kernel_builds"], e["dispatches"],
                e["upload_bytes"], e["fetch_bytes"],
-               e["fallback_count"], e["sum_errors"])
+               e["fallback_count"], e["sum_errors"],
+               e.get("delta_applies", 0), e.get("delta_bytes", 0))
 
 
 def _gen_deadlocks(domain):
@@ -167,6 +168,38 @@ def _gen_changefeeds(domain):
         yield (f.name, f.state, f.sink_uri, f.start_ts, f.checkpoint_ts,
                f.resolved, round(lag, 6) if lag is not None else None,
                f.emitted_txns, f.emitted_rows, f.error or "")
+
+
+def _gen_replica_freshness(domain):
+    """Per-table analytic-replica freshness (incremental HTAP,
+    docs/PERFORMANCE.md): the resolved-ts read view every resolved-mode
+    analytic statement would snapshot at RIGHT NOW, its wallclock lag,
+    and the rows committed since the delta maintainer last reconciled
+    the table's device-resident buffers. One row per user table with a
+    columnar image. Reading the table also refreshes the lag gauge."""
+    delta = getattr(domain.copr, "delta", None)
+    if delta is None or delta._domain is None:
+        return
+    from ..utils import metrics as metrics_util
+    resolved = delta.resolved_ts()
+    lag_ms = delta.lag_ms(resolved)
+    metrics_util.REPLICA_LAG_SECONDS.set(lag_ms / 1000.0)
+    stats = delta.table_stats()
+    mode = domain.global_vars.get("tidb_tpu_analytic_read_mode")
+    if mode is None:
+        from ..session.sysvars import get_sysvar
+        mode = get_sysvar("tidb_tpu_analytic_read_mode").default
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        if db.name.lower() in ("mysql", "information_schema"):
+            continue
+        for t in ischema.tables_in_schema(db.name):
+            ctab = domain.columnar.tables.get(t.id)
+            if ctab is None:
+                continue
+            pend = stats.get(t.id, (0, 0, 0))[0]
+            yield (db.name, t.name, resolved, round(lag_ms, 3), pend,
+                   str(mode))
 
 
 def _gen_resource_groups(domain):
@@ -351,7 +384,9 @@ VIRTUAL_DEFS = {
                            ("upload_bytes", _I()),
                            ("fetch_bytes", _I()),
                            ("fallback_count", _I()),
-                           ("sum_errors", _I())), _gen_top_sql),
+                           ("sum_errors", _I()),
+                           ("delta_applies", _I()),
+                           ("delta_bytes", _I())), _gen_top_sql),
     "deadlocks": (_cols(("deadlock_id", _I()), ("occur_time", _F()),
                         ("retryable", _I()), ("try_lock_trx_id", _I()),
                         ("key", _S()), ("trx_holding_lock", _I())),
@@ -367,6 +402,13 @@ VIRTUAL_DEFS = {
                                ("emitted_txns", _I()),
                                ("emitted_rows", _I()),
                                ("error", _S())), _gen_changefeeds),
+    "tidb_replica_freshness": (_cols(("table_schema", _S()),
+                                     ("table_name", _S()),
+                                     ("resolved_ts", _I()),
+                                     ("lag_ms", _F()),
+                                     ("pending_delta_rows", _I()),
+                                     ("mode", _S())),
+                               _gen_replica_freshness),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

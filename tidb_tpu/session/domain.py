@@ -141,6 +141,11 @@ class Domain:
         # store consistent
         from ..cdc import ChangefeedManager
         self.cdc = ChangefeedManager(self)
+        # incremental HTAP (copr/delta.py): the delta maintainer is
+        # the capture seam's second consumer — per-table freshness
+        # bookkeeping behind information_schema.tidb_replica_freshness
+        # and the resolved-ts read view for analytic statements
+        self.copr.delta.attach(self)
         if data_dir:
             self.cdc.resume_persisted()
 

@@ -1190,6 +1190,11 @@ class Session:
                 list(getattr(plan, "read_tables", ())), write=False)
         ectx = ExecContext(self, getattr(plan, "exec_hints", None))
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
+        if not ectx.stale_read_ts:
+            # incremental HTAP read routing: analytic statements under
+            # tidb_tpu_analytic_read_mode='resolved' snapshot at the
+            # replica's resolved-ts floor (AS OF keeps its own ts)
+            self._maybe_resolved_read(stmt, plan, ectx)
         if self._txn is not None and not self._txn.committed and \
                 not self._txn.aborted:
             # snapshot reads through the open txn that trip on a
@@ -1237,6 +1242,64 @@ class Session:
             total = sum(len(c) for c in out_chunks)
             return ResultSet(affected=total)
         return ResultSet(names=names, chunks=out_chunks)
+
+    def _maybe_resolved_read(self, stmt, plan, ectx):
+        """Resolved-ts analytic read view (docs/PERFORMANCE.md
+        "Incremental HTAP"; the TiFlash learner/stale-read shape):
+        when the session opted into tidb_tpu_analytic_read_mode =
+        'resolved', an olap-classified SELECT snapshots at the exact
+        ``storage/mvcc.resolved_floor`` watermark — every commit
+        at/below it has reached the columnar hooks and nothing can
+        commit at/below it later, so the MVCC validity mask built at
+        that ts is a consistent committed-data view that never waits
+        on OLTP write locks. The statement also skips the session's
+        dirty-overlay rescan (executors honor ``analytic_resolved``):
+        resolved mode is an explicit staleness opt-in and does NOT
+        read the transaction's own uncommitted writes. FOR UPDATE
+        stays strict; a floor older than
+        tidb_tpu_analytic_max_staleness_ms falls back to the leader
+        path rather than serve unboundedly stale rows."""
+        if self.is_internal:
+            return
+        if self.vars.get("tidb_tpu_analytic_read_mode") != "resolved":
+            return
+        if _stmt_class(stmt) != "olap":
+            return
+        from ..utils import metrics as metrics_util
+        if getattr(plan, "for_update", False):
+            metrics_util.ANALYTIC_READS.labels("strict").inc()
+            return
+        delta = self.domain.copr.delta
+        floor = delta.resolved_ts()
+        txn = self._txn if (self._explicit_txn and self._txn is not None
+                            and not self._txn.committed
+                            and not self._txn.aborted) else None
+        clamped = txn is not None and txn.start_ts < floor
+        if clamped:
+            # REPEATABLE READ: inside an explicit transaction the view
+            # must never be FRESHER than the txn snapshot — a floor
+            # past start_ts would let two statements of one txn see
+            # different committed states. Clamping keeps the resolved
+            # contract's one difference (own uncommitted writes stay
+            # invisible: the dirty-overlay rescan is still skipped)
+            # while reads stay at the txn's own snapshot.
+            floor = txn.start_ts
+        lag_ms = delta.lag_ms(floor)
+        metrics_util.REPLICA_LAG_SECONDS.set(lag_ms / 1000.0)
+        if not clamped:
+            # the bound guards against serving arbitrarily OLD data;
+            # a clamped read is the txn's own snapshot — the leader
+            # path would read at the same ts, so falling back there
+            # gains nothing
+            bound = int(self.vars.get(
+                "tidb_tpu_analytic_max_staleness_ms"))
+            if bound and lag_ms > bound:
+                metrics_util.ANALYTIC_READS.labels(
+                    "staleness_fallback").inc()
+                return
+        ectx.stale_read_ts = floor
+        ectx.analytic_resolved = True
+        metrics_util.ANALYTIC_READS.labels("resolved").inc()
 
     def _exec_lock_tables(self, stmt):
         """LOCK TABLES (reference pkg/ddl table locks + the

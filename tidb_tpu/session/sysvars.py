@@ -80,6 +80,15 @@ def _jax_cache_dir_default() -> str:
     return resolve_jax_cache_dir()
 
 
+def _env_read_mode() -> str:
+    """TIDB_TPU_ANALYTIC_READ_MODE seed for the analytic read-mode
+    sysvar (bench/smoke harnesses flip it per process); anything but
+    'resolved' means the strict default."""
+    import os
+    v = os.environ.get("TIDB_TPU_ANALYTIC_READ_MODE", "leader").lower()
+    return v if v in ("leader", "resolved") else "leader"
+
+
 _REGISTRY: dict[str, SysVar] = {}
 # plugins register sysvars after startup, concurrently with sessions
 # resolving them; reads stay lockless (GIL-atomic dict get)
@@ -209,6 +218,30 @@ for _v in [
            _env_int("TIDB_TPU_OLAP_ADMISSION_SLOTS",
                     max(2, (__import__("os").cpu_count() or 4) // 2)),
            "int", 0, 4096),
+    # incremental HTAP read routing (docs/PERFORMANCE.md "Incremental
+    # HTAP"): 'resolved' snapshots analytic (olap-classified)
+    # statements at the replica's resolved-ts floor — committed-data
+    # freshness with no OLTP lock contention and no dirty-overlay
+    # rescans, but NOT read-your-own-uncommitted-writes (an explicit
+    # opt-in, like tidb_read_staleness); 'leader' (default) keeps the
+    # strict leader path.
+    SysVar("tidb_tpu_analytic_read_mode", SCOPE_BOTH,
+           _env_read_mode(), "enum",
+           enum_vals=["leader", "resolved"]),
+    # staleness bound for resolved-mode reads: when the resolved floor
+    # lags wallclock by more than this (a long-open transaction holds
+    # it down), the statement falls back to the strict leader path
+    # instead of serving arbitrarily stale rows. 0 = no bound.
+    SysVar("tidb_tpu_analytic_max_staleness_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_ANALYTIC_MAX_STALENESS_MS", 5000),
+           "int", 0, 1 << 31),
+    # delta fold ceiling (copr/delta.py): a per-entry delta larger
+    # than this many rows drops the buffer for a full re-upload
+    # instead of patching (past a point the patch costs more than the
+    # upload it avoids).
+    SysVar("tidb_tpu_delta_max_rows", SCOPE_BOTH,
+           _env_int("TIDB_TPU_DELTA_MAX_ROWS", 1 << 20),
+           "int", 0, 1 << 40),
     # WAL group commit (storage/wal.py): leader/follower batched
     # flush+fsync across concurrently committing sessions. Process
     # config read at store open (env TIDB_TPU_WAL_GROUP_COMMIT seeds

@@ -171,7 +171,16 @@ class MVCCStore:
         it down: live locks (an open txn's eventual commit_ts is
         > lock.start_ts — pessimistic txns and async-commit finalize
         windows), commit intents (pre-allocation windows), and in-flight
-        publications (applied, hooks still running)."""
+        publications (applied, hooks still running).
+
+        Besides the CDC watermark, this is the ANALYTIC READ VIEW of
+        the incremental-HTAP replica (copr/delta.py, sysvar
+        tidb_tpu_analytic_read_mode='resolved'): a snapshot at R is a
+        complete committed-data view — the columnar hooks have applied
+        everything at/below it — and it can never be invalidated by a
+        later commit. A holder lock with start_ts == R cannot affect
+        the view either (its commit_ts will exceed its start_ts), so
+        columnar scans at R are lock-free by construction."""
         with self._mu:
             floor = now_ts
             for lk in self._locks.values():

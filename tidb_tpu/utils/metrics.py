@@ -571,7 +571,8 @@ class TopSQL:
                     "sum_fetch_ms": 0.0, "sum_upload_ms": 0.0,
                     "kernel_builds": 0, "dispatches": 0,
                     "upload_bytes": 0, "fetch_bytes": 0,
-                    "fallback_count": 0, "sum_errors": 0}
+                    "fallback_count": 0, "sum_errors": 0,
+                    "delta_applies": 0, "delta_bytes": 0}
             e["exec_count"] += 1
             e["sum_ms"] += dur_ms
             e["sum_device_ms"] += device_ms
@@ -585,6 +586,10 @@ class TopSQL:
             e["upload_bytes"] += ph.get("upload_bytes", 0)
             e["fetch_bytes"] += ph.get("fetch_bytes", 0)
             e["fallback_count"] += ph.get("device_fallbacks", 0)
+            # freshness cost attribution (incremental HTAP): which
+            # digest's binds paid for delta folds, and how many bytes
+            e["delta_applies"] += ph.get("delta_applies", 0)
+            e["delta_bytes"] += ph.get("delta_bytes", 0)
             if not ok:
                 e["sum_errors"] += 1
 
@@ -765,6 +770,36 @@ XLA_CACHE = REGISTRY.counter(
 DEV_BUFFER_EVICTIONS = REGISTRY.counter(
     "tidb_tpu_device_buffer_evict_total",
     "Device-resident buffers dropped by cause", ("cause",))
+DELTA_APPLY = REGISTRY.counter(
+    "tidb_tpu_delta_apply_total",
+    "Incremental delta maintenance of device-resident column buffers "
+    "by outcome (applied=tail rows patched on device, advanced="
+    "version-only advance for delete/update tombstones, compacted="
+    "entry dropped after gc/bucket supersession, "
+    "fell_back_full_upload=delta overflow or patch failure — next "
+    "bind re-uploads the buffer whole)", ("outcome",))
+DELTA_APPLY_BYTES = REGISTRY.counter(
+    "tidb_tpu_delta_apply_bytes_total",
+    "Real delta bytes folded into device-resident buffers (new tail "
+    "rows only, excluding pad)")
+DELTA_REUPLOAD_AVOIDED_BYTES = REGISTRY.counter(
+    "tidb_tpu_delta_reupload_avoided_bytes_total",
+    "Buffer bytes NOT re-uploaded because a delta patch advanced the "
+    "entry in place (the O(table) invalidate-and-reupload this "
+    "replaces)")
+REPLICA_LAG_SECONDS = REGISTRY.gauge(
+    "tidb_tpu_replica_freshness_lag_seconds",
+    "Age of the analytic replica's resolved-ts read view (wallclock "
+    "now minus the allocation time of the resolved floor)")
+ANALYTIC_READS = REGISTRY.counter(
+    "tidb_tpu_analytic_read_total",
+    "Resolved-mode analytic read-view routing decisions (counted "
+    "only when tidb_tpu_analytic_read_mode='resolved': resolved="
+    "snapshot at the resolved-ts floor, staleness_fallback=floor "
+    "older than the staleness bound so the leader path served, "
+    "strict=FOR UPDATE kept strict; leader-mode statements and AS OF "
+    "statements carry their own read view and are not counted)",
+    ("outcome",))
 DEV_RESIDENT_BYTES = REGISTRY.gauge(
     "tidb_tpu_device_resident_bytes",
     "Charged bytes live in the device-resident store by placement "
