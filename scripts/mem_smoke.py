@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Mem-chaos gate (ISSUE 10 acceptance; ROADMAP "Memory verify").
+
+A TPC-H slice at SF0.05 runs under memory pressure from every
+direction at once: tight per-query quotas (the action-chain tracker),
+failpoint-injected HBM RESOURCE_EXHAUSTED at the upload/dispatch sites
+(the device_guard pressure protocol: evict -> retry -> degrade), and 8
+concurrent sessions driving the server-level limit (the global memory
+controller sheds the largest statement with ER 8175). The invariant:
+
+  * every statement either completes HOST-IDENTICAL (spill / evict /
+    degrade served it) or fails CLEANLY with ER 8175 — nothing else;
+  * zero wedged sessions (per-query wall budget);
+  * at quiesce the tracker tree balances to ZERO and the resident
+    store's byte accounting is exact (bytes == sum(sizes) ==
+    per-spec sums; a full evict leaves 0);
+  * the process survives.
+
+A no-injection, default-quota CONTROL phase runs first (anti-vacuity):
+all queries host-identical with ZERO cancels and zero pressure-protocol
+activity — proving the storm outcomes come from the storm.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/mem_smoke.py
+Env:    MEM_SF (0.05), MEM_SESSIONS (8), MEM_ROUNDS (2),
+        MEM_QUOTA (8MiB), MEM_SERVER_LIMIT (4x quota),
+        MEM_QUERY_BUDGET_S (120), MEM_QUERIES (comma list)
+Exit:   0 all invariants hold; 1 otherwise.
+"""
+import gc
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# device routing for every fragment: the pressure protocol must see
+# uploads/dispatches, not the host twin short-circuit
+os.environ.setdefault("TIDB_TPU_FRAGMENT_MIN_ROWS", "0")
+os.environ.setdefault("TIDB_TPU_SORT_MIN", "1")
+
+SITES = ("copr/agg", "copr/filter", "copr/topn", "copr/mpp",
+         "fused", "fused/mpp", "sort", "window", "join")
+DEFAULT_QUERIES = "q1,q3,q5,q6,q10,q12,q14,q18"
+
+
+def _pressure(name):
+    from tidb_tpu.utils import metrics as metrics_util
+    return metrics_util.MEM_PRESSURE.labels(name).value
+
+
+def run_phase(tk, queries, refs, sessions, rounds, budget, quota,
+              failures, tag):
+    """Concurrent query storm. -> (completed, cancelled, wedged)."""
+    done = [0, 0]
+    mu = threading.Lock()
+
+    def worker(wid):
+        s = tk.new_session()
+        if quota:
+            s.must_exec(f"set @@tidb_mem_quota_query = {quota}")
+        for _r in range(rounds):
+            for q in queries:
+                t0 = time.time()
+                try:
+                    got = s.must_query(refs["sql"][q]).rows
+                except Exception as e:              # noqa: BLE001
+                    if getattr(e, "code", None) == 8175:
+                        with mu:
+                            done[1] += 1
+                        continue
+                    failures.append(
+                        f"{tag} w{wid} {q}: unexpected "
+                        f"{type(e).__name__}: {str(e)[:160]}")
+                    continue
+                dt = time.time() - t0
+                if dt > budget:
+                    failures.append(f"{tag} w{wid} {q}: exceeded "
+                                    f"{budget:.0f}s budget ({dt:.1f}s)")
+                if got != refs["rows"][q]:
+                    failures.append(f"{tag} w{wid} {q}: rows != host "
+                                    f"({len(got)} vs "
+                                    f"{len(refs['rows'][q])})")
+                else:
+                    with mu:
+                        done[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(sessions)]
+    for t in threads:
+        t.start()
+    wedged = 0
+    deadline = time.time() + budget * rounds * len(queries) + 60
+    for t in threads:
+        t.join(timeout=max(deadline - time.time(), 1.0))
+        if t.is_alive():
+            wedged += 1
+    if wedged:
+        failures.append(f"{tag}: {wedged} wedged session(s)")
+    return done[0], done[1], wedged
+
+
+def main():
+    sf = float(os.environ.get("MEM_SF", "0.05"))
+    sessions = int(os.environ.get("MEM_SESSIONS", "8"))
+    rounds = int(os.environ.get("MEM_ROUNDS", "2"))
+    quota = int(os.environ.get("MEM_QUOTA", str(8 << 20)))
+    server_limit = int(os.environ.get("MEM_SERVER_LIMIT",
+                                      str(4 * quota)))
+    budget = float(os.environ.get("MEM_QUERY_BUDGET_S", "120"))
+    qnames = [q.strip() for q in os.environ.get(
+        "MEM_QUERIES", DEFAULT_QUERIES).split(",") if q.strip()]
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.bench.tpch import load_tpch, ALL_QUERIES
+    from tidb_tpu.utils import failpoint
+
+    tk = TestKit()
+    print(f"# mem_smoke: sf={sf} sessions={sessions} rounds={rounds} "
+          f"quota={quota} server_limit={server_limit}", file=sys.stderr)
+    load_tpch(tk, sf=sf, seed=42)
+    failures = []
+
+    # ---- host references (pure-host twin, no device, no pressure) ----
+    refs = {"sql": {q: ALL_QUERIES[q] for q in qnames}, "rows": {}}
+    tk.domain.copr.use_device = False
+    for q in qnames:
+        refs["rows"][q] = tk.must_query(refs["sql"][q]).rows
+    tk.domain.copr.use_device = True
+
+    # ---- control phase: no injection, default quotas ------------------
+    c0 = _pressure("oom_cancel") + _pressure("server_cancel")
+    ok, cancelled, _w = run_phase(tk, qnames, refs, sessions, 1,
+                                  budget, 0, failures, "control")
+    c1 = _pressure("oom_cancel") + _pressure("server_cancel")
+    if cancelled or c1 != c0:
+        failures.append(f"control: {cancelled} cancels / "
+                        f"{c1 - c0} cancel metrics (must be 0)")
+    print(f"# control: {ok} host-identical, {cancelled} cancelled",
+          file=sys.stderr)
+
+    # ---- storm: injection + tight quotas + server limit ---------------
+    for s in SITES:
+        failpoint.enable("device_guard/" + s,
+                         "prob:0.4->error:resource_exhausted")
+    tk.domain.global_vars["tidb_tpu_server_memory_limit"] = server_limit
+    ev0 = _pressure("evict") + _pressure("evict_noop")
+    try:
+        ok, cancelled, _w = run_phase(tk, qnames, refs, sessions,
+                                      rounds, budget, quota, failures,
+                                      "storm")
+    finally:
+        for s in SITES:
+            failpoint.disable("device_guard/" + s)
+        tk.domain.global_vars["tidb_tpu_server_memory_limit"] = 0
+    print(f"# storm: {ok} host-identical, {cancelled} cancelled "
+          f"(ER 8175)", file=sys.stderr)
+    print(f"# pressure: evict={_pressure('evict'):.0f} "
+          f"evict_noop={_pressure('evict_noop'):.0f} "
+          f"retry_ok={_pressure('retry_ok'):.0f} "
+          f"degrade={_pressure('degrade'):.0f} "
+          f"spill_trigger={_pressure('spill_trigger'):.0f} "
+          f"oom_cancel={_pressure('oom_cancel'):.0f} "
+          f"server_cancel={_pressure('server_cancel'):.0f}",
+          file=sys.stderr)
+    if ok == 0:
+        failures.append("storm: nothing completed host-identical "
+                        "(the engine shed everything)")
+    if _pressure("evict") + _pressure("evict_noop") <= ev0:
+        failures.append("storm: the HBM pressure protocol never ran "
+                        "(injection did not reach the guard)")
+
+    # ---- quiesce: the accounting must balance -------------------------
+    gc.collect()
+    root = tk.domain.mem_root
+    if root.consumed != 0:
+        failures.append(f"tracker imbalance at quiesce: global root "
+                        f"holds {root.consumed} bytes")
+    store = tk.domain.copr._dev_store
+    with store._mu:
+        size_sum = sum(store._sizes.values())
+        spec_sum = sum(store._bytes_by_spec.values())
+        live = store.bytes
+    if not (live == size_sum == spec_sum):
+        failures.append(f"resident-store accounting drift: bytes={live}"
+                        f" sum(sizes)={size_sum} sum(specs)={spec_sum}")
+    freed = store.evict_bytes(max(live, 1))
+    st = store.stats()
+    if freed != live or st["bytes"] != 0 or st["entries"] != 0 or \
+            any(st["bytes_by_spec"].values()):
+        failures.append(f"resident-store drain mismatch: freed={freed} "
+                        f"of {live}, residue={st}")
+
+    if failures:
+        print("MEM SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"MEM SMOKE OK: {len(qnames)} queries x {sessions} sessions "
+          f"x {rounds} rounds under quota storm + injected HBM "
+          "exhaustion — every statement host-identical or clean ER "
+          "8175, zero wedges, accounting balanced", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

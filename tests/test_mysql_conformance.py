@@ -344,6 +344,35 @@ def test_compound_interval_window_frame(tk):
         [(1, "1"), (2, "3"), (3, "5"), (4, "4")], rows
 
 
+def test_memory_quota_error_code_and_message(tk):
+    """ER 8175 surface (ISSUE 10 satellite): the memory-governance
+    cancel class — code 8175 / SQLSTATE HY000 with the reference's
+    'Out Of Memory Quota!' message prefix — pinned on the catalog
+    (information_schema.tidb_errors) AND a LIVE raised error."""
+    rows = tk.must_query(
+        "select error, code, sqlstate from "
+        "information_schema.tidb_errors where code = 8175").rows
+    assert rows == [("MemoryQuotaExceededError", 8175, "HY000")], rows
+    from tidb_tpu.errors import MemoryQuotaExceededError
+    assert (MemoryQuotaExceededError.code,
+            MemoryQuotaExceededError.sqlstate) == (8175, "HY000")
+    tk.must_exec("drop table if exists mqc")
+    tk.must_exec("create table mqc (a bigint, b bigint)")
+    rows = ",".join(f"({i}, {i * 7})" for i in range(40000))
+    tk.must_exec(f"insert into mqc values {rows}")
+    # ungrouped DISTINCT agg: no spill path, so a breach must run the
+    # chain to its cancel step (tidb_tpu_oom_action default)
+    tk.must_exec("set @@tidb_mem_quota_query = 131072")
+    e = tk.exec_err("select count(distinct a), count(distinct b) "
+                    "from mqc")
+    assert e.code == 8175 and e.sqlstate == "HY000", e
+    assert "Out Of Memory Quota!" in e.msg, e.msg
+    # the failed statement's diagnostics area carries the same pair
+    warn = tk.must_query("show warnings").rows[0]
+    assert int(warn[1]) == 8175
+    tk.must_exec("set @@tidb_mem_quota_query = 1073741824")
+
+
 def test_lock_error_codes_and_sqlstates(tk):
     """MySQL-compatible lock failure surface (ISSUE 4 satellite):
     deadlock victim -> ER 1213 / SQLSTATE 40001, lock-wait deadline ->

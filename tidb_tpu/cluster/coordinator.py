@@ -289,11 +289,14 @@ class Cluster:
             def close(self):
                 pass
         ectx = ExecContext(self.sess)
-        agg = HashAggExec(ectx, _FinalPlanView(node),
-                          _RemoteReader(partials))
-        # rebuild the operators ABOVE the agg on the merged result
-        chunk = agg.next()
-        return self._apply_tail(plan, node, chunk, ectx)
+        try:
+            agg = HashAggExec(ectx, _FinalPlanView(node),
+                              _RemoteReader(partials))
+            # rebuild the operators ABOVE the agg on the merged result
+            chunk = agg.next()
+            return self._apply_tail(plan, node, chunk, ectx)
+        finally:
+            ectx.finish()
 
     def _apply_tail(self, plan, agg_node, chunk, ectx):
         """Run post-agg operators (sort/topn/projection) on the merged

@@ -438,8 +438,11 @@ class WorkerServer:
         if node is None:
             raise ValueError("no aggregation in fragment sql")
         ectx = ExecContext(self.sess)
-        agg = build_executor(ectx, node)
-        return agg.children[0].partials()
+        try:
+            agg = build_executor(ectx, node)
+            return agg.children[0].partials()
+        finally:
+            ectx.finish()
 
 
 def _py(v):

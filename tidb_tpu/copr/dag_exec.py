@@ -27,6 +27,7 @@ from ..expression.vec import materialize_nulls
 from ..utils import env_int
 from ..utils.fetch import prefetch, host_array, host_int
 from .residency import DeviceResidentStore
+from ..utils import memory as _memory
 from ..utils import phase
 from ..utils import device_guard
 from ..utils import metrics as _metrics
@@ -104,6 +105,11 @@ class CoprExecutor:
         # the "per-query device buffer pool" of SURVEY.md §5
         # generalized to cross-statement residency (copr/residency.py)
         self._dev_store = DeviceResidentStore(dev_cache_bytes)
+        # HBM pressure protocol (utils/device_guard): a
+        # RESOURCE_EXHAUSTED dispatch sheds cold resident entries from
+        # this pool before retrying; weakly registered so discarded
+        # test/mirror domains stay collectable
+        device_guard.register_pressure_store(self._dev_store)
         # incremental HTAP (copr/delta.py): folds committed deltas
         # into resident buffers at bind time instead of letting the
         # version sweep drop-and-reupload them whole; also the
@@ -147,6 +153,10 @@ class CoprExecutor:
         phase.add("upload_s", time.perf_counter() - t0)
         phase.add("upload_bytes", moved)
         phase.inc("uploads")
+        # device bytes charge the statement's memory tracker (HBM is
+        # governed by the same quota + action chain as host memory;
+        # the statement's detach releases the charge at its end)
+        _memory.consume_current(moved)
         return dev, ndev
 
     def _dev_put(self, key, arr_np, pad_fill=0, uid=None, version=None):
@@ -183,6 +193,13 @@ class CoprExecutor:
         self.last_backend = ""
         dom = getattr(self, "domain", None)
         t0 = time.perf_counter()
+        # install the statement tracker for the upload seams (device
+        # bytes charge the statement that asked for them); only when
+        # this call carries one — a nested tracker-less call must not
+        # clear an enclosing statement's
+        tr = getattr(ectx, "mem_tracker", None) if ectx is not None \
+            else None
+        prev = _memory.push_current(tr) if tr is not None else None
         try:
             if dom is not None:
                 with dom.tracer.span("copr",
@@ -192,6 +209,8 @@ class CoprExecutor:
             return self._execute_inner(dag, overlay, read_ts, use_mpp,
                                        mpp_min_rows, ectx)
         finally:
+            if tr is not None:
+                _memory.set_current(prev)
             # labeled by the backend that actually served the DAG
             # ("none" = early return: empty snapshot / virtual table)
             _metrics.COPR_DISPATCH_SECONDS.labels(

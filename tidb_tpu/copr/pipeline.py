@@ -35,6 +35,7 @@ from .dag_exec import (PartialAggResult, capture_agg_dicts, _dense_strides,
 from ..utils.fetch import prefetch, host_array, host_int
 from ..utils import failpoint
 from ..utils import jaxcfg
+from ..utils import memory as _memory
 
 _POS_DENSE_MAX = 1 << 22
 
@@ -1224,6 +1225,26 @@ def fused_partials(copr, plan, read_ts, mesh=None,
     runtime-ineligible (caller falls back to the conventional subtree).
     With a mesh, the whole pipeline runs as one shard_map program: fact
     sharded over 'dp', dims broadcast, aggregation allreduced."""
+    # statement memory tracker for the upload seams (same install as
+    # CoprExecutor.execute: the fused path uploads through _dev_put*
+    # without passing through copr.execute)
+    tr = getattr(ctx, "mem_tracker", None) if ctx is not None else None
+    if tr is None:
+        return _fused_partials_inner(copr, plan, read_ts, mesh,
+                                     bcast_threshold, ctx, delta_rows,
+                                     dead_handles)
+    prev = _memory.push_current(tr)
+    try:
+        return _fused_partials_inner(copr, plan, read_ts, mesh,
+                                     bcast_threshold, ctx, delta_rows,
+                                     dead_handles)
+    finally:
+        _memory.set_current(prev)
+
+
+def _fused_partials_inner(copr, plan, read_ts, mesh=None,
+                          bcast_threshold=1 << 20, ctx=None,
+                          delta_rows=None, dead_handles=None):
     engine = copr.engine
     fact_tbl = engine.table(plan.fact_dag.table_info)
     # incremental HTAP: fold committed deltas into resident buffers

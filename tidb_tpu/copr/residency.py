@@ -261,6 +261,23 @@ class DeviceResidentStore:
             self._drop_locked(key, cause)
             return True
 
+    def evict_bytes(self, n: int) -> int:
+        """HBM pressure relief (utils/device_guard pressure protocol):
+        drop LRU-cold entries until at least ``n`` charged bytes are
+        freed or the pool is empty. A RESOURCE_EXHAUSTED dispatch
+        retries against the freed headroom instead of the same full
+        device memory; evicted entries are re-uploadable at the next
+        bind (cost: bytes, never correctness). -> bytes freed."""
+        if n <= 0:
+            return 0
+        with self._mu:
+            freed = 0
+            while freed < n and self._order:
+                k = next(iter(self._order))
+                freed += self._sizes.get(k, 0)
+                self._drop_locked(k, "pressure")
+            return freed
+
     def invalidate(self, uid, keep_version=None) -> int:
         """Drop every buffer of `uid` whose recorded version differs
         from keep_version (None keep_version drops them all). Called at

@@ -12,7 +12,16 @@ from ..expression import EvalCtx, eval_expr
 from ..expression.vec import materialize_nulls
 from ..types.field_type import TypeClass
 from ..types.datum import Datum, Kind, NULL
-from ..errors import QueryKilledError
+from ..errors import QueryKilledError, MemoryQuotaExceededError
+
+
+def spill_quota(ectx) -> int:
+    """THE operator spill threshold (half the statement's effective
+    memory quota — the MEMORY_QUOTA hint when present, else
+    tidb_mem_quota_query — floored at 128KiB). Sort/agg/join used to
+    re-derive this inline three times from the sysvar alone, which is
+    how the hint never reached the operators."""
+    return max(ectx.mem_quota // 2, 128 << 10)
 
 
 class ExecContext:
@@ -22,11 +31,28 @@ class ExecContext:
         self.sv = sess.vars
         self.copr = sess.domain.copr
         self.killed = False
+        self.mem_killed = None    # ER-8175 kill reason (global memory
+        #                           controller victim), else None
         self.warnings = []
         eh = exec_hints or {}
         self.force_mpp = eh.get("force_mpp")   # None = follow sysvar
-        quota = eh.get("mem_quota", self.sv.mem_quota_query)
-        self.mem_tracker = sess.domain.mem_tracker_factory(quota)
+        quota = int(eh.get("mem_quota", self.sv.mem_quota_query))
+        self.mem_quota = quota
+        # statement tracker: child of the session tracker (which roots
+        # at domain.mem_root), quota from the MEMORY_QUOTA hint or the
+        # sysvar, oom action from tidb_tpu_oom_action. finish()
+        # detaches it — that release is what balances the global
+        # accounting to zero at quiesce.
+        sess_tr = getattr(sess, "mem_tracker", None)
+        if sess_tr is not None:
+            self.mem_tracker = sess_tr.child("stmt", quota)
+        else:
+            self.mem_tracker = sess.domain.mem_tracker_factory(quota)
+        try:
+            self.mem_tracker.oom_action = str(
+                self.sv.get("tidb_tpu_oom_action"))
+        except Exception:               # noqa: BLE001
+            pass
         limit_ms = eh.get("max_exec_ms",
                           int(self.sv.get("max_execution_time")))
         self.deadline = (_time.time() + limit_ms / 1000.0) if limit_ms else None
@@ -50,8 +76,27 @@ class ExecContext:
                           check_interrupt=self.check_killed)
         self.lock_ctx = lc
 
+    def finish(self):
+        """End-of-statement: detach the memory tracker (releases every
+        byte still tracked from the session/global ancestors) and fold
+        the peak into the session's per-statement high-water mark
+        (slow_query/statements_summary mem_max). Idempotent."""
+        t = self.mem_tracker
+        if t is None or t.closed:
+            return
+        peak = t.max_consumed
+        t.detach()
+        s = self.sess
+        s._stmt_mem_max = max(getattr(s, "_stmt_mem_max", 0) or 0, peak)
+
     def check_killed(self):
         if self.killed:
+            if self.mem_killed:
+                # global memory controller victim: the statement dies
+                # with the memory error class (ER 8175), not the
+                # generic interrupt — callers distinguish shed-by-
+                # memory from KILLed-by-operator
+                raise MemoryQuotaExceededError(self.mem_killed)
             raise QueryKilledError("Query execution was interrupted")
         if self.deadline is not None:
             import time as _time

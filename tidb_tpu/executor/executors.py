@@ -15,9 +15,34 @@ from ..types.field_type import TypeClass, new_bigint_type
 from ..types.datum import Datum, Kind
 from ..types.decimal import _POW10
 from ..errors import UnsupportedError, TiDBError
-from .exec_base import Executor, bind_chunk, eval_to_column
+from .exec_base import Executor, bind_chunk, eval_to_column, spill_quota
+from ..utils import metrics as _metrics
 
 _I64_MAX = np.iinfo(np.int64).max
+
+
+def _chunk_nbytes(ch) -> int:
+    return sum(getattr(c.data, "nbytes", 0) for c in ch.columns)
+
+
+def _tracked_chunks(child, tracker, ctx, can_spill=True) -> list:
+    """Drain a child like Executor.all_chunks, consuming each chunk's
+    payload bytes into ``tracker``. With can_spill a quota breach
+    mid-drain arms the owning operator's spill trigger (the
+    memory.Tracker action chain) instead of cancelling — the operator
+    polls the trigger and sheds to disk. Without it (the operator has
+    no spill path: cross join, ungrouped DISTINCT agg) a breach runs
+    the full chain and cancels per tidb_tpu_oom_action."""
+    out = []
+    while True:
+        ctx.check_killed()
+        ch = child.next()
+        if ch is None:
+            break
+        if len(ch):
+            tracker.consume(_chunk_nbytes(ch), can_spill=can_spill)
+            out.append(ch)
+    return out
 
 
 class DualExec(Executor):
@@ -1063,12 +1088,23 @@ class SortExec(Executor):
         return self._out.pop(0)
 
     def _fill(self):
-        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
+        quota = spill_quota(self.ctx)
+        stmt_tr = self.ctx.mem_tracker
+        trig = stmt_tr.add_spill_trigger("sort")
+        op = stmt_tr.child("sort")
+        try:
+            self._fill_tracked(quota, op, trig)
+        finally:
+            stmt_tr.remove_spill_trigger(trig)
+            op.detach()
+
+    def _fill_tracked(self, quota, op, trig):
         in_mem = []
         spool = None
         key_parts = []          # per chunk: list of key arrays
         consumed = 0
         while True:
+            self.ctx.check_killed()
             ch = self.child.next()
             if ch is None:
                 break
@@ -1076,16 +1112,27 @@ class SortExec(Executor):
                 continue
             keys = _sort_key_arrays(self.child.schema, ch, self.items)
             key_parts.append(keys)
-            nbytes = sum(getattr(c.data, "nbytes", 0) for c in ch.columns)
+            nbytes = _chunk_nbytes(ch)
             consumed += nbytes
-            if spool is None and consumed > quota:
+            if spool is None:
+                # spillable: a statement-quota breach here arms `trig`
+                # through the action chain; the operator threshold
+                # below keeps the historical half-quota spill point
+                op.consume(nbytes, can_spill=True)
+            if spool is None and (consumed > quota or trig.armed):
                 from ..utils.chunk_disk import ChunkSpool
                 spool = ChunkSpool("sort")
                 self.spilled = True
                 self.ctx.sess.domain.inc_metric("sort_spill_count")
+                _metrics.SPILLS.labels("sort").inc()
                 for prev in in_mem:
                     spool.append(prev)
                 in_mem = []
+                # payloads are on disk now: hand the bytes back so the
+                # chain sees the relief (keys stay in memory by design
+                # — the external sort orders over them)
+                op.release(op.consumed)
+                trig.done = True
             if spool is not None:
                 spool.append(ch)
             else:
@@ -1463,20 +1510,31 @@ class HashAggExec(Executor):
         (reference agg_spill.go) — a group never spans partitions, so each
         partition aggregates independently."""
         plan = self.plan
-        chunks = self.child.all_chunks()
-
-        def chunks_bytes(chs):
-            return sum(getattr(c.data, "nbytes", 0)
-                       for ch in chs for c in ch.columns)
-        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
-        if plan.group_items and chunks_bytes(chunks) > quota:
-            return self._distinct_spill(chunks)
-        merged = Chunk.concat_all(chunks)
-        return self._distinct_of(merged)
+        quota = spill_quota(self.ctx)
+        stmt_tr = self.ctx.mem_tracker
+        # grace partitioning needs group keys (a group never spans
+        # partitions): an ungrouped DISTINCT agg has no spill path, so
+        # its consumption is non-spillable — over quota it cancels
+        can_spill = bool(plan.group_items)
+        trig = stmt_tr.add_spill_trigger("agg") if can_spill else None
+        op = stmt_tr.child("agg")
+        try:
+            chunks = _tracked_chunks(self.child, op, self.ctx,
+                                     can_spill=can_spill)
+            if can_spill and (op.consumed > quota or trig.armed):
+                trig.done = True
+                return self._distinct_spill(chunks)
+            merged = Chunk.concat_all(chunks)
+            return self._distinct_of(merged)
+        finally:
+            if trig is not None:
+                stmt_tr.remove_spill_trigger(trig)
+            op.detach()
 
     def _distinct_spill(self, chunks, nparts=8):
         from ..utils.chunk_disk import ChunkSpool
         self.ctx.sess.domain.inc_metric("agg_spill_count")
+        _metrics.SPILLS.labels("agg").inc()
         plan = self.plan
         spools = [ChunkSpool(f"agg_d{i}") for i in range(nparts)]
         for ch in chunks:
@@ -1956,30 +2014,42 @@ class HashJoinExec(Executor):
         plan = self.plan
         build_exec = self.children[plan.build_side]
         probe_exec = self.children[1 - plan.build_side]
-        build_chunks = build_exec.all_chunks()
-        # runtime filter (reference runtime_filter_generator.go): the
-        # build side ran first — derive key bounds (or a small IN set)
-        # and push them into the probe side's device scan BEFORE it runs
-        self._push_runtime_filter(plan, build_exec, build_chunks,
-                                  probe_exec)
-        probe_chunks = probe_exec.all_chunks()
-
-        def chunks_bytes(chs):
-            return sum(getattr(c.data, "nbytes", 0)
-                       for ch in chs for c in ch.columns)
-        quota = max(self.ctx.sv.mem_quota_query // 2, 128 << 10)
-        if plan.eq_conds and \
-                not getattr(plan, "null_aware", False) and \
-                chunks_bytes(build_chunks) + chunks_bytes(probe_chunks) > quota:
-            return self._grace_join(build_chunks, probe_chunks)
-        build = Chunk.concat_all(build_chunks)
-        probe = Chunk.concat_all(probe_chunks)
-        return self._join_pair(build, probe)
+        quota = spill_quota(self.ctx)
+        stmt_tr = self.ctx.mem_tracker
+        # grace hash partitioning needs equality keys: a cross/NA join
+        # has no spill path, so its consumption is non-spillable —
+        # over quota it cancels instead of silently overrunning
+        can_spill = bool(plan.eq_conds) and \
+            not getattr(plan, "null_aware", False)
+        trig = stmt_tr.add_spill_trigger("join") if can_spill else None
+        op = stmt_tr.child("join")
+        try:
+            build_chunks = _tracked_chunks(build_exec, op, self.ctx,
+                                           can_spill=can_spill)
+            # runtime filter (reference runtime_filter_generator.go):
+            # the build side ran first — derive key bounds (or a small
+            # IN set) and push them into the probe side's device scan
+            # BEFORE it runs
+            self._push_runtime_filter(plan, build_exec, build_chunks,
+                                      probe_exec)
+            probe_chunks = _tracked_chunks(probe_exec, op, self.ctx,
+                                           can_spill=can_spill)
+            if can_spill and (op.consumed > quota or trig.armed):
+                trig.done = True
+                return self._grace_join(build_chunks, probe_chunks)
+            build = Chunk.concat_all(build_chunks)
+            probe = Chunk.concat_all(probe_chunks)
+            return self._join_pair(build, probe)
+        finally:
+            if trig is not None:
+                stmt_tr.remove_spill_trigger(trig)
+            op.detach()
 
     def _grace_join(self, build_chunks, probe_chunks, nparts=8):
         from ..utils.chunk_disk import ChunkSpool
         plan = self.plan
         self.ctx.sess.domain.inc_metric("join_spill_count")
+        _metrics.SPILLS.labels("join").inc()
         build_exec = self.children[plan.build_side]
         probe_exec = self.children[1 - plan.build_side]
         lex, rex = self._align_key_fts()

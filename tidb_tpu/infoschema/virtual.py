@@ -72,7 +72,8 @@ def _gen_slow_query(domain):
         yield (e.get("time", 0.0), e.get("time_ms", 0.0) / 1000.0,
                e.get("sql", ""), e.get("db", ""), e.get("conn", 0),
                1 if e.get("success") else 0,
-               e.get("digest", ""), int(e.get("is_internal", 0)))
+               e.get("digest", ""), int(e.get("is_internal", 0)),
+               int(e.get("mem_max", 0)))
 
 
 def _gen_stmt_summary(domain):
@@ -81,7 +82,47 @@ def _gen_stmt_summary(domain):
         yield (s["digest"], s["normalized"], s["exec_count"],
                s["sum_ms"] / 1000.0, s["max_ms"] / 1000.0,
                s["sum_ms"] / cnt / 1000.0, s["errors"],
-               s.get("sum_device_ms", 0.0), s.get("fallback_count", 0))
+               s.get("sum_device_ms", 0.0), s.get("fallback_count", 0),
+               int(s.get("mem_max", 0)))
+
+
+def _gen_memory_usage(domain):
+    """Live memory-tracker tree (docs/ROBUSTNESS.md "Memory safety"):
+    one 'global' row for the root (quota = the server memory limit, -1
+    when unlimited), one 'session' row per live connection, one
+    'statement' row per live statement tracker (its quota = the
+    effective tidb_mem_quota_query / MEMORY_QUOTA hint, plus the
+    statement's oom action). The instance-level analog of the
+    reference's information_schema.memory_usage."""
+    root = getattr(domain, "mem_root", None)
+    if root is None:
+        return
+    ctl = getattr(domain, "mem_controller", None)
+    lim = ctl.limit_bytes() if ctl is not None else 0
+    yield (0, "global", root.label, root.consumed, root.max_consumed,
+           lim if lim else -1, "")
+    # snapshot both registries: connections register / statements
+    # start concurrently with this read, and iterating the live dicts
+    # would die on "changed size during iteration" exactly under the
+    # load this table exists to inspect
+    for cid, ref in sorted(list(getattr(domain, "sessions",
+                                        {}).items())):
+        s = ref()
+        if s is None:
+            continue
+        tr = getattr(s, "mem_tracker", None)
+        if tr is None:
+            continue
+        yield (cid, "session", tr.label, tr.consumed, tr.max_consumed,
+               -1, "")
+    for cid, lst in sorted(list(domain._live_execs.items())):
+        for ectx in list(lst):
+            tr = getattr(ectx, "mem_tracker", None)
+            if tr is None or tr.closed:
+                continue
+            yield (cid, "statement", tr.label, tr.consumed,
+                   tr.max_consumed, tr.quota,
+                   tr.oom_action or "cancel")
 
 
 def _gen_metrics(domain):
@@ -354,13 +395,15 @@ VIRTUAL_DEFS = {
     "slow_query": (_cols(("time", _F()), ("query_time", _F()),
                          ("query", _S()), ("db", _S()), ("conn_id", _I()),
                          ("succ", _I()), ("digest", _S()),
-                         ("is_internal", _I())), _gen_slow_query),
+                         ("is_internal", _I()), ("mem_max", _I())),
+                   _gen_slow_query),
     "statements_summary": (_cols(("digest", _S()), ("digest_text", _S()),
                                  ("exec_count", _I()),
                                  ("sum_latency", _F()), ("max_latency", _F()),
                                  ("avg_latency", _F()), ("sum_errors", _I()),
                                  ("sum_device_ms", _F()),
-                                 ("fallback_count", _I())),
+                                 ("fallback_count", _I()),
+                                 ("mem_max", _I())),
                            _gen_stmt_summary),
     "metrics_summary": (_cols(("metrics_name", _S()), ("labels", _S()),
                               ("sum_value", _F())),
@@ -463,6 +506,10 @@ VIRTUAL_DEFS = {
         ("table_name", _S()), ("source", _S()), ("handle", _I()),
         ("conflict", _S()), ("row_preview", _S()), ("time", _F())),
         lambda domain: list(getattr(domain, "_import_conflicts", []))),
+    "memory_usage": (_cols(("conn_id", _I()), ("scope", _S()),
+                           ("label", _S()), ("consumed", _I()),
+                           ("max_consumed", _I()), ("quota", _I()),
+                           ("oom_action", _S())), _gen_memory_usage),
 }
 
 _VIRT_INFO_CACHE: dict = {}

@@ -386,9 +386,12 @@ class DDLExecutor:
                            col_offsets=list(range(len(new_tbl.columns))),
                            select_plan=plan)
         ectx = ExecContext(self.sess)
-        self.sess.txn()
-        InsertExec(ectx, iplan, self.sess).execute()
-        self.sess.commit()
+        try:
+            self.sess.txn()
+            InsertExec(ectx, iplan, self.sess).execute()
+            self.sess.commit()
+        finally:
+            ectx.finish()
 
     def drop_table(self, stmt: ast.DropTableStmt):
         def fn(m):

@@ -36,6 +36,73 @@ class _Allocator:
                 self._next = v + 1
 
 
+class GlobalMemoryController:
+    """tidb_server_memory_limit analog (reference
+    pkg/util/memory/memstats + the server-level OOM kill in
+    session/session.go): watches the global tracker root and, when the
+    whole process exceeds ``tidb_tpu_server_memory_limit``, cancels the
+    single LARGEST-consumer live statement through the existing KILL
+    seam (_live_execs) with ER 8175 — shed one query, never wedge or
+    die. One victim at a time: the next breach picks a new one only
+    after the current victim's tracker detached (its statement
+    actually died and released)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._mu = threading.Lock()
+        self._victim_tracker = None
+
+    def limit_bytes(self) -> int:
+        v = self.domain.global_vars.get("tidb_tpu_server_memory_limit")
+        if v is None:
+            from .sysvars import get_sysvar
+            v = get_sysvar("tidb_tpu_server_memory_limit").default
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 0
+
+    def on_breach(self, root):
+        """Called by the tracker root (outside its tree lock) when
+        consumption crossed the server limit."""
+        with self._mu:
+            lim = self.limit_bytes()
+            if not lim or root.consumed <= lim:
+                return
+            vt = self._victim_tracker
+            if vt is not None and not vt.closed:
+                return          # current victim still unwinding
+            self._victim_tracker = None
+            best = None
+            best_ectx = None
+            for _cid, lst in list(self.domain._live_execs.items()):
+                for ectx in list(lst):
+                    tr = getattr(ectx, "mem_tracker", None)
+                    if tr is None or tr.closed:
+                        continue
+                    if getattr(ectx, "mem_killed", None):
+                        return  # a marked victim is already dying
+                    if best is None or tr.consumed > best.consumed:
+                        best, best_ectx = tr, ectx
+            if best is None:
+                return          # nothing cancellable is live
+            msg = ("Out Of Memory Quota! server memory limit %d bytes "
+                   "exceeded (global tracker at %d); this statement "
+                   "was the largest consumer (%d bytes) and was "
+                   "cancelled (tidb_tpu_server_memory_limit)" % (
+                       lim, root.consumed, best.consumed))
+            best_ectx.mem_killed = msg
+            best_ectx.killed = True
+            best.mark_server_kill(msg)
+            self._victim_tracker = best
+        metrics_util.MEM_PRESSURE.labels("server_cancel").inc()
+        self.domain.inc_metric("server_memory_cancel")
+        from ..utils.logutil import warn
+        warn("server_memory_cancel", limit=lim,
+             consumed=root.consumed, victim=best.label,
+             victim_bytes=best.consumed)
+
+
 class Domain:
     def __init__(self, data_dir: str | None = None,
                  wal_sync: bool = False):
@@ -54,6 +121,13 @@ class Domain:
         self.global_vars: dict[str, object] = {}
         self.user_vars: dict[str, object] = {}
         self.mem_root = Tracker("global")
+        # server-level memory governance: every consume that reaches
+        # the root checks the soft limit; breach -> the controller
+        # cancels the largest live statement (ER 8175). Wired before
+        # any session exists so the very first statement is governed.
+        self.mem_controller = GlobalMemoryController(self)
+        self.mem_root.soft_limit_fn = self.mem_controller.limit_bytes
+        self.mem_root.on_soft_breach = self.mem_controller.on_breach
         self.dxf = TaskManager(total_slots=8)
         self.timer = Timer()
         self.stats = {}        # table_id -> stats (module stats/, ANALYZE)

@@ -54,6 +54,12 @@ class Session:
         # (information_schema.slow_query.is_internal)
         self.is_internal = False
         self.prepared: dict = {}     # name -> (stmt_ast, sql_text)
+        # session-level memory tracker: statement trackers (ExecContext)
+        # child off it, so domain.mem_root sees session->statement->
+        # operator consumption and the global memory controller can
+        # attribute bytes to connections (utils/memory.py)
+        self.mem_tracker = domain.mem_root.child(f"conn {self.conn_id}")
+        self._stmt_mem_max = 0   # per-statement tracker peak (_observe)
         import weakref
         domain.sessions[self.conn_id] = weakref.ref(self)
         self.stmt_handles: dict = {}  # stmt_id -> (ast, n_params, sql)
@@ -200,6 +206,10 @@ class Session:
         # statement only (internal SQL fired mid-statement — stats sync
         # load, TTL — accumulates into its triggering statement)
         _phase.stmt_enter()
+        if _phase.depth() == 1:
+            # per-statement memory high-water mark: nested internal SQL
+            # folds its peaks into the outer statement's, like phases
+            self._stmt_mem_max = 0
         # MySQL diagnostics-area lifecycle: each statement RESETS the
         # area; SHOW WARNINGS/ERRORS and GET DIAGNOSTICS read the
         # PREVIOUS statement's area so they are exempt
@@ -335,6 +345,7 @@ class Session:
                 "digest": digest,
                 "is_internal": int(self.is_internal or
                                    _phase.depth() > 1),
+                "mem_max": int(getattr(self, "_stmt_mem_max", 0)),
                 "phases": _phase.snap()})
             from ..utils import logutil
             # the digest normalization IS the redaction (one parse,
@@ -344,10 +355,13 @@ class Session:
         summ = self.domain.stmt_summary_map.setdefault(digest, {
             "digest": digest, "normalized": norm[:1024],
             "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0,
-            "sum_device_ms": 0.0, "fallback_count": 0})
+            "sum_device_ms": 0.0, "fallback_count": 0, "mem_max": 0})
         summ["exec_count"] += 1
         summ["sum_ms"] += dur_ms
         summ["max_ms"] = max(summ["max_ms"], dur_ms)
+        if _phase.depth() <= 1:
+            summ["mem_max"] = max(summ.get("mem_max", 0),
+                                  int(getattr(self, "_stmt_mem_max", 0)))
         if not ok:
             summ["errors"] += 1
         # phase counters are statement-scoped but reset only at the
@@ -507,6 +521,7 @@ class Session:
             chunks = ex.all_chunks()
         finally:
             ex.close()
+            ectx.finish()
         rows = []
         fts = [sc.col.ft for sc in plan.schema.visible()]
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
@@ -766,6 +781,7 @@ class Session:
                     self.commit()
                 finally:
                     self.domain.unregister_exec(self.conn_id, ectx)
+                    ectx.finish()
             else:
                 self.commit()
             return ResultSet()
@@ -1209,6 +1225,7 @@ class Session:
             finally:
                 ex.close()
                 self.domain.unregister_exec(self.conn_id, ectx)
+                ectx.finish()
         if getattr(plan, "for_update", False) and self._explicit_txn:
             chunks = self._lock_for_update(plan, chunks, ectx)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
@@ -1531,6 +1548,10 @@ class Session:
         # later chunk — must not leave its earlier rows buffered in an
         # open explicit transaction for COMMIT to persist
         txn.savepoint("__stmt_atomic__")
+        # registered like the SELECT path: KILL <conn> reaches the DML's
+        # read side, and the global memory controller can see (and
+        # shed) a giant INSERT..SELECT as the largest consumer
+        self.domain.register_exec(self.conn_id, ectx)
         try:
             if isinstance(plan, InsertPlan):
                 self.check_priv("insert", plan.db_name, plan.table_info.name)
@@ -1558,6 +1579,9 @@ class Session:
             txn.release_savepoint("__stmt_atomic__")
             self._finish_stmt(error=True)
             raise
+        finally:
+            self.domain.unregister_exec(self.conn_id, ectx)
+            ectx.finish()
         txn.release_savepoint("__stmt_atomic__")
         self.vars.affected_rows = affected
         self._finish_stmt()
@@ -1621,6 +1645,7 @@ class Session:
                 ex.all_chunks()
             finally:
                 ex.close()
+                ectx.finish()
             from ..executor.runtime_stats import wrapped_children_stats
             stats = wrapped_children_stats(ex)
             rows = []

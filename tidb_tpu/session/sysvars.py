@@ -80,6 +80,15 @@ def _jax_cache_dir_default() -> str:
     return resolve_jax_cache_dir()
 
 
+def _env_oom_action() -> str:
+    """TIDB_TPU_OOM_ACTION seed for the quota-breach action sysvar
+    (smoke harnesses configure child processes before a session
+    exists); anything but 'log' means the strict 'cancel' default."""
+    import os
+    v = os.environ.get("TIDB_TPU_OOM_ACTION", "cancel").lower()
+    return v if v in ("cancel", "log") else "cancel"
+
+
 def _env_read_mode() -> str:
     """TIDB_TPU_ANALYTIC_READ_MODE seed for the analytic read-mode
     sysvar (bench/smoke harnesses flip it per process); anything but
@@ -242,6 +251,21 @@ for _v in [
     SysVar("tidb_tpu_delta_max_rows", SCOPE_BOTH,
            _env_int("TIDB_TPU_DELTA_MAX_ROWS", 1 << 20),
            "int", 0, 1 << 40),
+    # memory-governance action chain (utils/memory.py,
+    # docs/ROBUSTNESS.md "Memory safety"): what the quota-breach chain
+    # does AFTER logging and after every registered operator spill has
+    # been armed — 'cancel' kills the statement with ER 8175 (the
+    # reference tidb_mem_oom_action=CANCEL), 'log' records the breach
+    # and lets the statement proceed.
+    SysVar("tidb_tpu_oom_action", SCOPE_BOTH,
+           _env_oom_action(), "enum", enum_vals=["cancel", "log"]),
+    # server-level memory limit in bytes (the tidb_server_memory_limit
+    # analog): when the GLOBAL tracker root exceeds it, the controller
+    # cancels the single largest-consumer statement with ER 8175 —
+    # shed one query, never wedge or die. 0 disables.
+    SysVar("tidb_tpu_server_memory_limit", SCOPE_GLOBAL,
+           _env_int("TIDB_TPU_SERVER_MEMORY_LIMIT", 0), "int",
+           0, 1 << 50),
     # WAL group commit (storage/wal.py): leader/follower batched
     # flush+fsync across concurrently committing sessions. Process
     # config read at store open (env TIDB_TPU_WAL_GROUP_COMMIT seeds

@@ -30,8 +30,10 @@ import os
 import random
 import threading
 import time
+import weakref
 
 from . import failpoint
+from . import memory as _memory
 from . import metrics as _metrics
 from . import phase as _phase
 from .logutil import log
@@ -204,6 +206,52 @@ def reset():
         METRICS.clear()
 
 
+# ---- HBM pressure protocol --------------------------------------------
+# A RESOURCE_EXHAUSTED dispatch means the accelerator's memory is full
+# RIGHT NOW — retrying blindly just re-runs the same allocation against
+# the same full HBM (what PR 1 did). Before each retry of that class the
+# guard now SHEDS: every registered device-resident store (weakly held;
+# test domains must stay collectable) evicts half its charged bytes —
+# cold LRU entries a later statement can re-upload — then the retry
+# runs against the freed headroom; only if that still fails does the
+# dispatch degrade to the host twin. Outcomes land in
+# tidb_tpu_mem_pressure_total{action}.
+
+_PRESSURE_STORES: list = []
+_PRESSURE_MU = threading.Lock()
+
+
+def register_pressure_store(store):
+    """Register a DeviceResidentStore (or anything with .bytes and
+    .evict_bytes(n)) for pressure shedding. Weakly referenced."""
+    with _PRESSURE_MU:
+        _PRESSURE_STORES.append(weakref.ref(store))
+
+
+def relieve_memory_pressure() -> int:
+    """Shed cold HBM: ask every live registered store to evict half its
+    charged bytes. -> total bytes freed."""
+    with _PRESSURE_MU:
+        # prune dead refs in place under the lock (rebuilding from a
+        # pre-eviction snapshot would drop a store registered while
+        # the evictions ran, excluding it from pressure forever)
+        _PRESSURE_STORES[:] = [r for r in _PRESSURE_STORES
+                               if r() is not None]
+        refs = list(_PRESSURE_STORES)
+    freed = 0
+    for r in refs:
+        s = r()
+        if s is None:
+            continue
+        try:
+            have = int(getattr(s, "bytes", 0))
+            if have > 0:
+                freed += s.evict_bytes(max(have // 2, 1))
+        except Exception:           # noqa: BLE001 — shedding is advisory
+            pass
+    return freed
+
+
 def _bump(domain, name: str, v: int = 1):
     with _METRICS_MU:
         METRICS[name] = METRICS.get(name, 0) + v
@@ -252,9 +300,14 @@ def _with_watchdog(fn, timeout_ms: int, site: str):
     # worker that later unwedges writes into garbage, never into a
     # subsequent statement's attribution
     worker_stats: dict = {}
+    # the statement's memory tracker is thread-local like phase state:
+    # a dispatch moved onto the watchdog worker must keep charging its
+    # upload bytes to the statement that asked for them
+    mem_tracker = _memory.current_tracker()
 
     def run():
         _phase.adopt(worker_stats)
+        _memory.set_current(mem_tracker)
         try:
             box["v"] = fn()
         except BaseException as e:      # noqa: BLE001
@@ -375,12 +428,17 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
         raise DeviceDegradedError(site, "breaker_open", None, 0)
 
     attempts = 0
+    pressure_evicted = False
     while True:
         if ectx is not None:
             ectx.check_killed()
         try:
             out = _with_watchdog(attempt, timeout_ms, site)
             breaker.record_success()
+            if pressure_evicted:
+                # the shed worked: the retry that followed an HBM
+                # pressure eviction landed
+                _metrics.MEM_PRESSURE.labels("retry_ok").inc()
             return out
         except (KeyboardInterrupt, SystemExit, GeneratorExit):
             raise                       # process control, not device health
@@ -399,6 +457,20 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
                 if ectx is not None and ectx.deadline is not None:
                     remain = ectx.deadline - time.time()
                 if remain is None or remain > delay:
+                    if err_class == "resource_exhausted":
+                        # HBM pressure protocol: shed cold resident
+                        # entries BEFORE retrying — a blind retry
+                        # re-runs the same allocation against the same
+                        # full device memory
+                        freed = relieve_memory_pressure()
+                        _metrics.MEM_PRESSURE.labels(
+                            "evict" if freed > 0 else "evict_noop"
+                        ).inc()
+                        _bump(domain, "mem_pressure_evict")
+                        if freed > 0:
+                            pressure_evicted = True
+                            log("warn", "mem_pressure_evict", site=site,
+                                freed_bytes=freed, attempt=attempts)
                     _bump(domain, "device_retry")
                     _metrics.DEVICE_RETRIES.labels(family,
                                                    err_class).inc()
@@ -416,6 +488,10 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
                 log("warn", "device_breaker_open", family=family,
                     threshold=breaker.threshold,
                     cooldown_s=breaker.cooldown_s)
+            if err_class == "resource_exhausted":
+                # the pressure protocol (evict + retry) ran out of
+                # road: the statement degrades to the host twin
+                _metrics.MEM_PRESSURE.labels("degrade").inc()
             _note_fallback(ectx, domain, site, err_class, exc, attempts,
                            fallback_is_host=fallback_is_host)
             if host_fallback is not None:

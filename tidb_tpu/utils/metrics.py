@@ -672,6 +672,10 @@ def update_runtime_gauges(domain):
     start = getattr(domain, "_start_time", None)
     if start is not None:
         UPTIME.set(time.time() - start)
+    root = getattr(domain, "mem_root", None)
+    if root is not None:
+        MEM_TRACKER_BYTES.labels("consumed").set(root.consumed)
+        MEM_TRACKER_BYTES.labels("max_consumed").set(root.max_consumed)
 
 
 def reset_all():
@@ -809,6 +813,31 @@ DEV_RESIDENT_BYTES = REGISTRY.gauge(
 FRAGMENT_ROUTING = REGISTRY.counter(
     "tidb_tpu_fragment_routing_total",
     "Copr fragment placement decisions by outcome", ("outcome",))
+SPILLS = REGISTRY.counter(
+    "tidb_tpu_spill_total",
+    "Blocking-operator disk spills by operator (sort external sort, "
+    "agg distinct grace partitioning, join grace hash partitioning; "
+    "fired by the memory.Tracker action chain or the operator's "
+    "half-quota threshold — the flat sort_spill_count/agg_spill_count/"
+    "join_spill_count inc_metric counters stay as compat mirrors)",
+    ("operator",))
+MEM_PRESSURE = REGISTRY.counter(
+    "tidb_tpu_mem_pressure_total",
+    "Memory-pressure protocol outcomes (evict=resident HBM entries "
+    "shed before a resource_exhausted retry, evict_noop=pressure "
+    "eviction found an empty pool, retry_ok=dispatch succeeded after "
+    "a pressure eviction, degrade=resource_exhausted dispatch "
+    "degraded to the host twin, spill_trigger=quota breach armed an "
+    "operator spill, oom_log=breach recorded under "
+    "tidb_tpu_oom_action=log, oom_cancel=statement cancelled with "
+    "ER 8175, server_cancel=global controller cancelled the largest "
+    "statement past tidb_tpu_server_memory_limit)", ("action",))
+MEM_TRACKER_BYTES = REGISTRY.gauge(
+    "tidb_tpu_mem_tracker_bytes",
+    "Hierarchical memory-tracker accounting at the global root, "
+    "sampled at collect time (consumed=bytes currently tracked, "
+    "max_consumed=high-water mark since the domain opened)",
+    ("stat",))
 FUSED_DECLINE = REGISTRY.counter(
     "tidb_tpu_fused_decline_total",
     "Fused-pipeline declines by reason class", ("reason",))
