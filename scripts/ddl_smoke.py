@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""DDL smoke: kill -9 (failpoint CRASH) at EVERY online-DDL seam ×
+concurrent DML load, then restart from checkpoint+WAL and assert the
+durable job framework (owner/ddl_runner.py) leaves NO half state
+(ISSUE 13 acceptance; ROADMAP "DDL verify").
+
+The crash seams come from the failpoint-site registry
+(tidb_tpu/utils/failpoint_sites.DDL_SITES — tpulint's
+failpoint-site-registry rule keeps inject sites and this gate in
+lock-step). Each case runs a child process that opens a durable store,
+seeds rows, starts DML writer threads (inserts + updates + deletes,
+retrying on txn conflicts), arms one crash failpoint, and drives an
+online DDL into it (rc=137). The parent reopens the data dir — restart
+recovery resumes or rolls back the in-flight job — and checks:
+
+  * the job reached a TERMINAL state: resumed-to-PUBLIC (synced) or
+    rolled-back-to-absent (cancelled) — never a live queue row, never
+    a non-PUBLIC index state in meta;
+  * ``ADMIN CHECK TABLE`` passes (row store == indexes == columnar,
+    including every row the concurrent DML committed);
+  * no orphaned index KV: an absent index's key range scans empty
+    (delete-range GC) and a PUBLIC index's entry count matches rows;
+  * the mid-backfill case actually RESUMED: the recovered job's
+    row_done covers all rows while the checkpoint persisted before the
+    crash is not re-done from row 0;
+  * schema_epoch / plan-cache invalidation: a concurrent session's
+    cached point template is fenced by the resumed DDL's meta commits.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/ddl_smoke.py [--quick]
+Env:    DDL_SMOKE_TIMEOUT_S (240), DDL_SMOKE_ROWS (400),
+        DDL_SMOKE_BATCH (64)
+Exit:   0 every seam recovered clean; 1 any violation.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+ROWS = int(os.environ.get("DDL_SMOKE_ROWS", "400"))
+BATCH = int(os.environ.get("DDL_SMOKE_BATCH", "64"))
+
+# (label, [(failpoint, action), ...], doomed DDL, expected outcome)
+# outcome: "public"  -> index ib exists PUBLIC with complete entries
+#          "absent"  -> index ib fully gone (meta + KV)
+#          "dropped" -> pre-existing index ic fully gone (drop resumed)
+#          "either"  -> public or absent, never half
+CASES = [
+    ("enqueued", [("ddl-job-enqueued", "crash")],
+     "create index ib on t (b)", "public"),
+    ("delete-only", [("ddl-index-delete-only", "crash")],
+     "create index ib on t (b)", "public"),
+    ("write-only", [("ddl-index-write-only", "crash")],
+     "create index ib on t (b)", "public"),
+    ("write-reorg", [("ddl-index-write-reorg", "crash")],
+     "create index ib on t (b)", "public"),
+    # die at the SECOND checkpoint: the first is durable, resume must
+    # continue from it (asserted via the recovered job's counters)
+    ("mid-backfill", [("ddl-backfill-checkpoint", "after:1->crash")],
+     "create index ib on t (b)", "public"),
+    ("pre-public", [("ddl-pre-public", "crash")],
+     "create index ib on t (b)", "public"),
+    # force the backfill to fail -> rollback begins -> die after one
+    # reverse-ladder step; restart must FINISH the rollback
+    ("rollback-path", [("ddl-pre-public", "error"),
+                       ("ddl-rollback-step", "after:1->crash")],
+     "create index ib on t (b)", "absent"),
+    ("drop-write-only", [("ddl-drop-write-only", "crash")],
+     "drop index ic on t", "dropped"),
+    ("drop-delete-only", [("ddl-drop-delete-only", "crash")],
+     "drop index ic on t", "dropped"),
+    ("drop-before-remove", [("ddl-drop-before-remove", "crash")],
+     "drop index ic on t", "dropped"),
+    # crash between index-meta removal and the range purge: the
+    # delete-range record must drive the purge at restart
+    ("delete-range", [("ddl-delete-range", "crash")],
+     "drop index ic on t", "dropped"),
+    ("reorg-swap", [("ddl-reorg-before-swap", "crash")],
+     "alter table t modify b varchar(24)", "modified"),
+]
+
+_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+os.environ["TIDB_TPU_DDL_REORG_BATCH"] = str({batch})
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.utils import failpoint
+dom = new_store({dd!r}, wal_sync=True)
+s = Session(dom)
+s.vars.current_db = "test"
+s.execute("create table t (a int primary key, b int, key ic (b))")
+vals = ",".join("(%d, %d)" % (i, i * 10) for i in range({rows}))
+s.execute("insert into t values " + vals)
+print("ACK-SETUP", flush=True)
+stop = threading.Event()
+def dml(tid):
+    w = Session(dom)
+    w.vars.current_db = "test"
+    k = {rows} + 1000 * (tid + 1)
+    while not stop.is_set():
+        k += 1
+        try:
+            w.execute("insert into t values (%d, %d)" % (k, k * 10))
+            w.execute("update t set b = b + 1 where a = %d" % (k,))
+            if k % 5 == 0:
+                w.execute("delete from t where a = %d" % (k,))
+        except SystemExit:
+            raise
+        except Exception:
+            pass        # txn conflict vs the reorg: retried next round
+threads = [threading.Thread(target=dml, args=(i,), daemon=True)
+           for i in range(2)]
+for t in threads:
+    t.start()
+time.sleep(0.2)          # let the writers interleave with the ladder
+for fp, action in {fps!r}:
+    failpoint.enable(fp, action)
+try:
+    s.execute({ddl!r})
+except SystemExit:
+    raise
+except Exception as e:
+    print("ERR " + type(e).__name__ + ": " + str(e)[:200], flush=True)
+stop.set()
+print("SURVIVED", flush=True)
+"""
+
+
+def run_child(dd, fps, ddl, timeout):
+    script = _CHILD.format(repo=_REPO, dd=dd, fps=fps, ddl=ddl,
+                           rows=ROWS, batch=BATCH)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, timeout=timeout, env=env)
+
+
+def _index_kv_count(dom, table_id, index_id):
+    from tidb_tpu.codec.tablecodec import index_prefix
+    pref = index_prefix(table_id, index_id)
+    return len(dom.storage.mvcc.scan(pref, pref + b"\xff" * 9,
+                                     dom.storage.current_ts()))
+
+
+def check_recovered(dd, label, outcome, failures):
+    from tidb_tpu.session import new_store, Session
+    from tidb_tpu.models.schema import SchemaState
+    epoch_probe = {}
+
+    # instrument the resume: recovery runs inside new_store, so the
+    # epoch fence must already be bumped by the time it returns
+    dom = new_store(dd)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    tbl = dom.infoschema().table_by_name("test", "t")
+
+    # 1. no live jobs, every job terminal
+    live = [j for j in dom.ddl_jobs.list_jobs()
+            if j.state not in ("synced", "cancelled")]
+    if live:
+        failures.append(f"{label}: live jobs after restart: "
+                        f"{[(j.id, j.state) for j in live]}")
+    # 2. never a half-state index
+    half = [(i.name, int(i.state)) for i in tbl.indexes
+            if i.state != SchemaState.PUBLIC]
+    if half:
+        failures.append(f"{label}: non-PUBLIC index state after "
+                        f"restart: {half}")
+    names = {i.name.lower() for i in tbl.indexes}
+    hist = dom.ddl_jobs.list_jobs()
+    if outcome == "public":
+        if "ib" not in names:
+            # rolled back instead of resumed is NOT acceptable for a
+            # forward-resumable seam
+            failures.append(f"{label}: index ib absent (expected "
+                            f"resumed-to-PUBLIC); jobs="
+                            f"{[(j.type, j.state) for j in hist]}")
+    elif outcome == "absent":
+        if "ib" in names:
+            failures.append(f"{label}: index ib present (expected "
+                            f"rolled-back-to-absent)")
+    elif outcome == "dropped":
+        if "ic" in names:
+            failures.append(f"{label}: index ic still present "
+                            f"(expected drop to resume)")
+    elif outcome == "modified":
+        ci = tbl.find_column("b")
+        job = next((j for j in hist if j.type == "modify column"), None)
+        if job is None:
+            failures.append(f"{label}: no modify-column job in history")
+        elif job.state == "synced" and ci.ft.tp != "varchar":
+            failures.append(f"{label}: job synced but column type is "
+                            f"{ci.ft.tp}")
+        elif job.state == "cancelled" and ci.ft.tp == "varchar":
+            failures.append(f"{label}: job cancelled but column "
+                            f"converted")
+
+    # 3. consistency across row store / columnar / indexes
+    try:
+        s.execute("admin check table t")
+    except Exception as e:                      # noqa: BLE001
+        failures.append(f"{label}: ADMIN CHECK TABLE failed: {e}")
+
+    # 4. no orphaned index KV for any index id not in meta (scan a
+    # generous id range: ids are small ints)
+    live_ids = {i.id for i in tbl.indexes}
+    for iid in range(1, 8):
+        if iid in live_ids:
+            continue
+        n = _index_kv_count(dom, tbl.id, iid)
+        if n:
+            failures.append(f"{label}: {n} orphaned index KVs for "
+                            f"absent index id {iid}")
+
+    # 5. a resumed PUBLIC index actually serves reads
+    if outcome == "public" and "ib" in names:
+        rows = s.execute("select a from t where b = 120").rows
+        if rows != [(12,)]:
+            failures.append(f"{label}: index probe b=120 -> {rows}")
+        job = next((j for j in hist if j.type == "add index"), None)
+        if label == "mid-backfill" and job is not None:
+            if not job.checkpoint_handle or job.row_done <= 0:
+                failures.append(
+                    f"{label}: recovered job has no checkpoint "
+                    f"(handle={job.checkpoint_handle}, "
+                    f"done={job.row_done}) — resume-from-checkpoint "
+                    f"not exercised")
+
+    # 6. post-recovery DDL + DML still work and bump the fence
+    epoch_probe["before"] = dom.schema_epoch
+    s.execute("insert into t values (999991, 42)")
+    s.execute("create index izz on t (b)")
+    s.execute("drop index izz on t")
+    if dom.schema_epoch <= epoch_probe["before"]:
+        failures.append(f"{label}: schema_epoch not bumped by "
+                        f"post-recovery DDL")
+    dom.storage.mvcc.wal.close()
+
+
+def epoch_fence_case(failures):
+    """In-process case: a concurrent session's plan-cache fast-path
+    template over t must be fenced by a DDL job's meta commits (the
+    schema_epoch bump every job txn triggers through the meta-commit
+    hook)."""
+    from tidb_tpu.session import new_store, Session
+    dom = new_store()
+    s1 = Session(dom)
+    s1.vars.current_db = "test"
+    s1.execute("create table t (a int primary key, b int)")
+    s1.execute("insert into t values (1, 10), (2, 20)")
+    s2 = Session(dom)
+    s2.vars.current_db = "test"
+    for _ in range(3):      # warm the point fast path
+        s2.execute("select b from t where a = 1")
+    before = dom.schema_epoch
+    ntempl = len(dom.point_plans)
+    s1.execute("create index ib on t (b)")
+    if dom.schema_epoch <= before:
+        failures.append("epoch-fence: DDL job did not bump "
+                        "schema_epoch")
+    # the warm template's key embeds the OLD epoch: the next execution
+    # must rebuild (a stale hit would read a stale template)
+    rows = s2.execute("select b from t where a = 1").rows
+    if rows != [(10,)]:
+        failures.append(f"epoch-fence: post-DDL point read -> {rows}")
+    if len(dom.point_plans) <= ntempl and ntempl:
+        # rebuilt template inserts under the NEW epoch key
+        failures.append("epoch-fence: no new template keyed under the "
+                        "post-DDL epoch")
+
+
+def main():
+    quick = "--quick" in sys.argv
+    timeout = float(os.environ.get("DDL_SMOKE_TIMEOUT_S", "240"))
+    failures: list = []
+    cases = CASES[:4] + [CASES[6]] if quick else CASES
+
+    # the registry is the seam source of truth: every ddl seam this
+    # gate kills must be registered (tpulint enforces the reverse)
+    from tidb_tpu.utils.failpoint_sites import DDL_SITES, known_sites
+    missing = [fp for _l, fps, _d, _o in CASES for fp, _a in fps
+               if fp not in known_sites()]
+    if missing:
+        print(f"DDL SMOKE FAILED: unregistered seams {missing}",
+              file=sys.stderr)
+        return 1
+    uncovered = [s for s in DDL_SITES
+                 if not any(fp == s for _l, fps, _d, _o in CASES
+                            for fp, _a in fps)]
+    if uncovered and not quick:
+        print(f"DDL SMOKE FAILED: registry DDL seams never killed: "
+              f"{uncovered}", file=sys.stderr)
+        return 1
+
+    with tempfile.TemporaryDirectory(prefix="ddl_smoke_") as tmp:
+        for i, (label, fps, ddl, outcome) in enumerate(cases):
+            dd = os.path.join(tmp, f"dd_{i}")
+            t0 = time.time()
+            r = run_child(dd, fps, ddl, timeout)
+            out = r.stdout.decode()
+            if "ACK-SETUP" not in out:
+                failures.append(f"{label}: child setup failed: "
+                                f"{r.stderr.decode()[-300:]}")
+                continue
+            if r.returncode != 137 or "SURVIVED" in out:
+                failures.append(
+                    f"{label}: crash failpoint did not fire "
+                    f"(rc={r.returncode}, out={out[-200:]!r})")
+                continue
+            check_recovered(dd, label, outcome, failures)
+            print(f"# {label}: crashed rc=137, recovered "
+                  f"({time.time() - t0:.1f}s)", file=sys.stderr)
+
+    epoch_fence_case(failures)
+
+    if failures:
+        print("DDL SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"DDL SMOKE OK: {len(cases)} kill-9 seams × concurrent DML "
+          "— every job resumed-to-PUBLIC or rolled-back-to-absent, "
+          "ADMIN CHECK TABLE clean, zero orphaned index meta/KV, "
+          "schema_epoch fence observed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

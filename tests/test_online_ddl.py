@@ -1,0 +1,706 @@
+"""Crash-safe online DDL (ISSUE 13): the durable job framework
+(owner/ddl_runner.py) — F1 state-ladder visibility under concurrent
+DML per state, cancel-during-backfill through rollingback, KILL
+reaching a running reorg, resume-from-checkpoint at the recorded
+handle range, ADMIN SHOW/CANCEL DDL JOB surfaces, orphan-index sweep
+for pre-framework stores, delete-range KV cleanup, reorg jobs
+(EXCHANGE PARTITION / cross-class MODIFY COLUMN), and the distributed
+add-index abort path on coordinator restart.
+
+The kill -9 × every-seam matrix lives in scripts/ddl_smoke.py; this
+tier-1 slice pins the same contracts in-process (SystemExit at a
+failpoint simulates the process dying mid-job; reopening the data dir
+drives the same resume_pending recovery)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def ftk():
+    tk = TestKit()
+    yield tk
+    failpoint.disable_all()
+
+
+def _index_entries(domain, table_id, index_id):
+    from tidb_tpu.codec.tablecodec import index_prefix
+    pref = index_prefix(table_id, index_id)
+    return domain.storage.mvcc.scan(pref, pref + b"\xff" * 9,
+                                    domain.storage.current_ts())
+
+
+def _history(domain, typ=None):
+    jobs = domain.ddl_jobs.list_jobs()
+    return [j for j in jobs if typ is None or j.type == typ]
+
+
+# ---------------------------------------------------------------------------
+# state-ladder visibility under concurrent DML per state
+# ---------------------------------------------------------------------------
+
+def test_ladder_visibility_per_state_under_dml(ftk):
+    """At DELETE_ONLY an insert must NOT write the new index's entry;
+    from WRITE_ONLY on it must; the backfill then covers the
+    delete-only-era row, and ADMIN CHECK TABLE proves the final index
+    complete — the F1 invariant the job framework must preserve at
+    every resumable state."""
+    from tidb_tpu.models.schema import SchemaState
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values (1, 10), (2, 20), (3, 30)")
+    tk2 = ftk.new_session()
+    seen = {}
+
+    def entry_count():
+        tbl = ftk.domain.infoschema().table_by_name("test", "t")
+        idx = tbl.find_index("ib")
+        return len(_index_entries(ftk.domain, tbl.id, idx.id)), idx
+
+    def at_delete_only():
+        n0, idx = entry_count()
+        assert idx.state == SchemaState.DELETE_ONLY
+        tk2.must_exec("insert into t values (100, 1000)")
+        n1, _ = entry_count()
+        seen["delete_only"] = (n0, n1)
+
+    def at_write_only():
+        n0, idx = entry_count()
+        assert idx.state == SchemaState.WRITE_ONLY
+        tk2.must_exec("insert into t values (101, 1010)")
+        n1, _ = entry_count()
+        seen["write_only"] = (n0, n1)
+        # delete maintenance also live: removing a row with an entry
+        tk2.must_exec("delete from t where a = 101")
+        n2, _ = entry_count()
+        seen["write_only_del"] = n2
+
+    def at_write_reorg():
+        _n0, idx = entry_count()
+        assert idx.state == SchemaState.WRITE_REORG
+        tk2.must_exec("update t set b = 21 where a = 2")
+
+    failpoint.enable("ddl-index-delete-only", at_delete_only)
+    failpoint.enable("ddl-index-write-only", at_write_only)
+    failpoint.enable("ddl-index-write-reorg", at_write_reorg)
+    ftk.must_exec("create index ib on t (b)")
+
+    assert seen["delete_only"] == (0, 0)        # insert NOT maintained
+    n0, n1 = seen["write_only"]
+    assert n1 == n0 + 1                         # insert maintained
+    assert seen["write_only_del"] == n0         # delete maintained
+    ftk.must_exec("admin check table t")        # backfill covered 100
+    assert ftk.must_query("select a from t where b = 1000").rows == \
+        [(100,)]
+    assert ftk.must_query("select a from t where b = 21").rows == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# cancel / KILL during backfill -> rollingback -> clean absence
+# ---------------------------------------------------------------------------
+
+def test_cancel_during_backfill_rolls_back(ftk):
+    from tidb_tpu.errors import DDLJobCancelledError
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values " + ",".join(
+        f"({i},{i * 10})" for i in range(200)))
+    ftk.must_exec("set tidb_tpu_ddl_reorg_batch_size = 16")
+    tk2 = ftk.new_session()
+    rollback_steps = []
+    failpoint.enable("ddl-rollback-step", lambda: rollback_steps.append(1))
+    cancelled = threading.Event()
+
+    def cancel_from_peer():
+        jobs = [j for j in tk2.must_query(
+            "select job_id, state from information_schema.ddl_jobs"
+        ).rows if j[1] == "running"]
+        assert jobs, "no running job visible to the peer session"
+        tk2.must_exec(f"admin cancel ddl job {jobs[0][0]}")
+        cancelled.set()
+
+    def at_checkpoint():
+        if cancelled.is_set():
+            return
+        th = threading.Thread(target=cancel_from_peer)
+        th.start()
+        th.join()
+
+    failpoint.enable("ddl-backfill-checkpoint", at_checkpoint)
+    with pytest.raises(DDLJobCancelledError):
+        ftk.must_exec("create index ib on t (b)")
+    tbl = ftk.domain.infoschema().table_by_name("test", "t")
+    assert tbl.find_index("ib") is None
+    assert rollback_steps, "cancel did not travel through rollingback"
+    # no orphaned KV for the aborted index (delete-range ran)
+    for iid in range(1, 5):
+        assert not _index_entries(ftk.domain, tbl.id, iid)
+    job = _history(ftk.domain, "add index")[0]
+    assert job.state == "cancelled"
+    ftk.must_exec("admin check table t")
+
+
+def test_kill_during_backfill_rolls_back(ftk):
+    from tidb_tpu.errors import QueryKilledError
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values " + ",".join(
+        f"({i},{i * 10})" for i in range(100)))
+    ftk.must_exec("set tidb_tpu_ddl_reorg_batch_size = 16")
+    dom = ftk.domain
+    conn = ftk.sess.conn_id
+    failpoint.enable("ddl-backfill-checkpoint",
+                     lambda: dom.kill_conn(conn))
+    with pytest.raises(QueryKilledError):
+        ftk.must_exec("create index ib on t (b)")
+    tbl = dom.infoschema().table_by_name("test", "t")
+    assert tbl.find_index("ib") is None
+    assert _history(dom, "add index")[0].state == "cancelled"
+    ftk.must_exec("admin check table t")
+
+
+def test_cancel_drop_index_before_point_of_no_return(ftk):
+    """Cancelling a DROP INDEX at WRITE_ONLY restores PUBLIC (writes
+    still maintained the index, entries complete); once DELETE_ONLY
+    committed, cancel is refused and the job rolls forward."""
+    from tidb_tpu.errors import (DDLJobCancelledError,
+                                 CancelFinishedDDLError)
+    ftk.must_exec("create table t (a int primary key, b int, "
+                  "key ib (b))")
+    ftk.must_exec("insert into t values (1, 10), (2, 20)")
+    tk2 = ftk.new_session()
+    peer_err = []
+
+    def cancel_in_thread():
+        def go():
+            jobs = [j for j in ftk.domain.ddl_jobs.list_jobs()
+                    if j.state == "running"]
+            try:
+                tk2.must_exec(f"admin cancel ddl job {jobs[0].id}")
+            except CancelFinishedDDLError as e:
+                peer_err.append(e)
+        th = threading.Thread(target=go)
+        th.start()
+        th.join()
+
+    failpoint.enable("ddl-drop-write-only", cancel_in_thread)
+    with pytest.raises(DDLJobCancelledError):
+        ftk.must_exec("drop index ib on t")
+    failpoint.disable_all()
+    tbl = ftk.domain.infoschema().table_by_name("test", "t")
+    idx = tbl.find_index("ib")
+    assert idx is not None and int(idx.state) == 4      # PUBLIC again
+    assert not peer_err
+    ftk.must_exec("admin check table t")
+
+    # past the point of no return: cancel refused, drop completes
+    failpoint.enable("ddl-drop-delete-only", cancel_in_thread)
+    ftk.must_exec("drop index ib on t")
+    failpoint.disable_all()
+    assert ftk.domain.infoschema().table_by_name(
+        "test", "t").find_index("ib") is None
+    assert len(peer_err) == 1
+    ftk.must_exec("admin check table t")
+
+
+def test_cancel_finished_and_missing_job_errors(ftk):
+    from tidb_tpu.errors import (DDLJobNotFoundError,
+                                 CancelFinishedDDLError)
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("create index ib on t (b)")
+    jid = _history(ftk.domain, "add index")[0].id
+    with pytest.raises(CancelFinishedDDLError):
+        ftk.must_exec(f"admin cancel ddl job {jid}")
+    with pytest.raises(DDLJobNotFoundError):
+        ftk.must_exec("admin cancel ddl job 999999")
+
+
+# ---------------------------------------------------------------------------
+# crash (SystemExit) + reopen: resume from the recorded checkpoint
+# ---------------------------------------------------------------------------
+
+def test_resume_from_checkpoint_handle(tmp_path):
+    from tidb_tpu.session import new_store, Session
+    dd = str(tmp_path / "dd")
+    dom = new_store(dd, wal_sync=True)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    s.execute("create table t (a int primary key, b int)")
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i * 10})" for i in range(300)))
+    s.execute("set tidb_tpu_ddl_reorg_batch_size = 64")
+    # die at the THIRD checkpoint: two batches (128 rows) durable.
+    # In-process stand-in for os._exit: SystemExit unwinds the runner
+    # without any rollback handling (the job record stays RUNNING)
+    crashed = False
+    orig = failpoint.CRASH
+    failpoint.CRASH = lambda: (_ for _ in ()).throw(SystemExit(137))
+    try:
+        failpoint.enable("ddl-backfill-checkpoint", "after:2->crash")
+        try:
+            s.execute("create index ib on t (b)")
+        except SystemExit:
+            crashed = True
+    finally:
+        failpoint.CRASH = orig
+        failpoint.disable_all()
+    assert crashed
+    # mid-job state is durable: job RUNNING at WRITE_REORG with a
+    # checkpoint covering the first two batches
+    # the seam fires AFTER each checkpoint txn commits, so the crash
+    # on hit 3 leaves THREE durable batches (192 rows)
+    live = [j for j in dom.ddl_jobs.list_jobs() if j.state == "running"]
+    assert live and live[0].checkpoint_handle is not None
+    assert live[0].row_done == 192
+    dom.storage.mvcc.wal.close()
+
+    # reopen: resume_pending must continue AT the checkpoint, not row 0
+    resumed_batches = []
+    failpoint.enable("ddl-backfill-checkpoint",
+                     lambda: resumed_batches.append(1))
+    dom2 = new_store(dd)
+    failpoint.disable_all()
+    s2 = Session(dom2)
+    s2.vars.current_db = "test"
+    tbl = dom2.infoschema().table_by_name("test", "t")
+    idx = tbl.find_index("ib")
+    assert idx is not None and int(idx.state) == 4      # PUBLIC
+    # 300 rows - 192 done = 108 left = 2 batches of 64 (not 5 from 0)
+    assert len(resumed_batches) == 2
+    job = _history(dom2, "add index")[0]
+    assert job.state == "synced" and job.row_done == 300
+    s2.execute("admin check table t")
+    assert s2.execute("select a from t where b = 1280").rows == [(128,)]
+    dom2.storage.mvcc.wal.close()
+
+
+def test_rollingback_job_resumes_rollback_after_reopen(tmp_path):
+    """A job that was mid-ROLLBACK when the process died must finish
+    the rollback at restart — absent meta, zero KV, job cancelled."""
+    from tidb_tpu.session import new_store, Session
+    dd = str(tmp_path / "dd")
+    dom = new_store(dd, wal_sync=True)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    s.execute("create table t (a int primary key, b int)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    failpoint.enable("ddl-pre-public", "error")
+    orig = failpoint.CRASH
+    failpoint.CRASH = lambda: (_ for _ in ()).throw(SystemExit(137))
+    failpoint.enable("ddl-rollback-step", "after:1->crash")
+    try:
+        with pytest.raises(SystemExit):
+            s.execute("create index ib on t (b)")
+    finally:
+        failpoint.CRASH = orig
+        failpoint.disable_all()
+    live = [j for j in dom.ddl_jobs.list_jobs()
+            if j.state == "rollingback"]
+    assert live, "job not recorded rollingback"
+    dom.storage.mvcc.wal.close()
+    dom2 = new_store(dd)
+    tbl = dom2.infoschema().table_by_name("test", "t")
+    assert tbl.find_index("ib") is None
+    for iid in range(1, 5):
+        assert not _index_entries(dom2, tbl.id, iid)
+    assert _history(dom2, "add index")[0].state == "cancelled"
+    dom2.storage.mvcc.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# orphan sweep: pre-framework half-state meta (snapshot-restored)
+# ---------------------------------------------------------------------------
+
+def test_orphan_nonpublic_index_swept_at_restart(tmp_path):
+    """Regression for the latent orphan: a DELETE_ONLY/WRITE_ONLY index
+    in meta with NO owning job (a store written before the framework,
+    or a snapshot-restored meta) must be swept into the rollback
+    machinery at restart — not stranded forever."""
+    from tidb_tpu.session import new_store, Session
+    from tidb_tpu.meta import Mutator
+    from tidb_tpu.models import IndexInfo
+    from tidb_tpu.models.schema import SchemaState
+    from tidb_tpu.codec.tablecodec import index_key
+    from tidb_tpu.chunk.column import py_to_datum_fast
+    dd = str(tmp_path / "dd")
+    dom = new_store(dd, wal_sync=True)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    s.execute("create table t (a int primary key, b int)")
+    s.execute("insert into t values (1, 10), (2, 20)")
+    # hand-write the half state the OLD code could strand: index meta
+    # in WRITE_ONLY plus a few committed backfill KVs, NO job row
+    txn = dom.storage.begin()
+    m = Mutator(txn)
+    db = next(d for d in m.list_databases() if d.name == "test")
+    tbl = next(t for t in m.list_tables(db.id) if t.name == "t")
+    idx = IndexInfo(id=7, name="ghost", columns=["b"],
+                    state=SchemaState.WRITE_ONLY)
+    tbl.indexes.append(idx)
+    m.update_table(db.id, tbl)
+    bft = tbl.find_column("b").ft
+    txn.set(index_key(tbl.id, 7, [py_to_datum_fast(10, bft)], 1), b"")
+    m.gen_schema_version()
+    txn.commit()
+    assert dom.infoschema().table_by_name("test", "t").find_index(
+        "ghost") is not None
+    dom.storage.mvcc.wal.close()
+
+    dom2 = new_store(dd)
+    tbl2 = dom2.infoschema().table_by_name("test", "t")
+    assert tbl2.find_index("ghost") is None
+    assert not _index_entries(dom2, tbl2.id, 7)
+    swept = [j for j in dom2.ddl_jobs.list_jobs()
+             if j.args.get("orphan_sweep")]
+    assert swept and swept[0].state == "cancelled"
+    Session(dom2).execute("admin check table test.t")
+    dom2.storage.mvcc.wal.close()
+
+
+# ---------------------------------------------------------------------------
+# dropped/aborted index KV cleanup (delete-range)
+# ---------------------------------------------------------------------------
+
+def test_drop_index_purges_kv(ftk):
+    ftk.must_exec("create table t (a int primary key, b int, key ib (b))")
+    ftk.must_exec("insert into t values (1, 10), (2, 20), (3, 30)")
+    tbl = ftk.domain.infoschema().table_by_name("test", "t")
+    iid = tbl.find_index("ib").id
+    assert len(_index_entries(ftk.domain, tbl.id, iid)) == 3
+    ftk.must_exec("drop index ib on t")
+    assert not _index_entries(ftk.domain, tbl.id, iid)
+    assert _history(ftk.domain, "drop index")[0].state == "synced"
+    ftk.must_exec("admin check table t")
+
+
+def test_aborted_unique_backfill_leaves_no_kv(ftk):
+    """The satellite-2 orphan: a duplicate caught mid-backfill used to
+    drop the meta but leave committed backfill KVs behind. The job
+    rollback registers a delete-range in the removal txn."""
+    from tidb_tpu.errors import DuplicateKeyError
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values " + ",".join(
+        f"({i},{i * 10})" for i in range(50)) + ",(97, 70),(98, 70)")
+    ftk.must_exec("set tidb_tpu_ddl_reorg_batch_size = 16")
+    with pytest.raises(DuplicateKeyError):
+        ftk.must_exec("create unique index ub on t (b)")
+    tbl = ftk.domain.infoschema().table_by_name("test", "t")
+    assert tbl.find_index("ub") is None
+    for iid in range(1, 5):
+        assert not _index_entries(ftk.domain, tbl.id, iid)
+    assert _history(ftk.domain, "add index")[0].state == "cancelled"
+    ftk.must_exec("admin check table t")
+
+
+# ---------------------------------------------------------------------------
+# ADMIN / information_schema surfaces + metrics
+# ---------------------------------------------------------------------------
+
+def test_show_ddl_jobs_and_vtable(ftk):
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values (1, 10)")
+    ftk.must_exec("create index ib on t (b)")
+    rs = ftk.must_exec("admin show ddl jobs")
+    assert rs.names[0] == "JOB_ID"
+    row = rs.rows[0]
+    assert row[3] == "add index" and row[10] == "synced"
+    assert row[4] == "public"
+    rows = ftk.must_query(
+        "select job_type, state, schema_state, table_name, row_count "
+        "from information_schema.ddl_jobs").rows
+    assert ("add index", "synced", "public", "t", 1) in rows
+
+
+def test_ddl_job_metrics(ftk):
+    from tidb_tpu.utils import metrics as metrics_util
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values (1, 10), (2, 20)")
+    ftk.must_exec("create index ib on t (b)")
+    text = metrics_util.REGISTRY.expose()
+    assert 'tidb_tpu_ddl_job_total{state="synced",type="add index"}' \
+        in text or \
+        'tidb_tpu_ddl_job_total{type="add index",state="synced"}' \
+        in text
+    assert "tidb_tpu_ddl_backfill_rows" in text
+
+
+# ---------------------------------------------------------------------------
+# reorg jobs: exchange partition / cross-class modify column
+# ---------------------------------------------------------------------------
+
+def test_modify_column_cross_class_reorg(ftk):
+    ftk.must_exec("create table mc (a int primary key, b int, "
+                  "key ib (b))")
+    ftk.must_exec("insert into mc values (1, 42), (2, null), (3, 7)")
+    ftk.must_exec("alter table mc modify b varchar(16)")
+    tbl = ftk.domain.infoschema().table_by_name("test", "mc")
+    assert tbl.find_column("b").ft.tp == "varchar"
+    assert ftk.must_query("select b from mc order by a").rows == \
+        [("42",), (None,), ("7",)]
+    ftk.must_exec("admin check table mc")       # index rewritten too
+    assert _history(ftk.domain, "modify column")[0].state == "synced"
+    # and back: varchar -> int converts the digits
+    ftk.must_exec("alter table mc modify b int")
+    assert ftk.must_query("select b from mc order by a").rows == \
+        [(42,), (None,), (7,)]
+    ftk.must_exec("admin check table mc")
+
+
+def test_modify_column_conversion_failure_rolls_back(ftk):
+    ftk.must_exec("create table mc (a int primary key, b varchar(16))")
+    ftk.must_exec("insert into mc values (1, 'hello')")
+    err = ftk.exec_err("alter table mc modify b int")
+    assert getattr(err, "code", 0) in (1292, 8214)
+    tbl = ftk.domain.infoschema().table_by_name("test", "mc")
+    assert tbl.find_column("b").ft.tp == "varchar"   # nothing applied
+    assert ftk.must_query("select b from mc").rows == [("hello",)]
+    assert _history(ftk.domain, "modify column")[0].state == "cancelled"
+    ftk.must_exec("admin check table mc")
+
+
+def test_exchange_partition_rides_job(ftk):
+    ftk.must_exec("""create table pe (a int, v int)
+        partition by range (a)
+        (partition p0 values less than (10),
+         partition p1 values less than maxvalue)""")
+    ftk.must_exec("insert into pe values (1,10),(50,500)")
+    ftk.must_exec("create table pex (a int, v int)")
+    ftk.must_exec("insert into pex values (7,70)")
+    ftk.must_exec("alter table pe exchange partition p0 with table pex")
+    assert ftk.must_query("select a from pe order by a").rows == \
+        [(7,), (50,)]
+    job = _history(ftk.domain, "exchange partition")[0]
+    assert job.state == "synced"
+
+
+def test_concurrent_dml_during_backfill_consistent(ftk):
+    """Fast in-process slice of the ddl_smoke DML×reorg matrix: two
+    writer threads churn inserts/updates/deletes across every ladder
+    state and backfill batch; the finished index must be exactly
+    consistent (ADMIN CHECK TABLE compares row store, columnar and
+    every index entry)."""
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values " + ",".join(
+        f"({i},{i * 10})" for i in range(400)))
+    ftk.must_exec("set tidb_tpu_ddl_reorg_batch_size = 32")
+    stop = threading.Event()
+
+    def writer(tid):
+        tk = ftk.new_session()
+        k = 400 + 1000 * (tid + 1)
+        while not stop.is_set():
+            k += 1
+            try:
+                tk.must_exec(f"insert into t values ({k}, {k * 10})")
+                tk.must_exec(f"update t set b = b + 1 where a = {k}")
+                if k % 3 == 0:
+                    tk.must_exec(f"delete from t where a = {k}")
+            except Exception:           # noqa: BLE001
+                pass                    # conflict vs the reorg: fine
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        ftk.must_exec("create index ib on t (b)")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    ftk.must_exec("admin check table t")
+    job = _history(ftk.domain, "add index")[0]
+    assert job.state == "synced"
+
+
+def test_add_index_on_freshly_added_column(ftk):
+    """Regression (review finding): the backfill must route through
+    the columnar engine's schema refresh — the raw ctab has no array
+    for a column id added by a just-committed ADD COLUMN and used to
+    KeyError."""
+    ftk.must_exec("create table t (a int primary key, b int)")
+    ftk.must_exec("insert into t values (1,10),(2,20),(3,30)")
+    ftk.must_exec("alter table t add column c int default 5")
+    ftk.must_exec("update t set c = a * 7")
+    ftk.must_exec("create index idx_c on t (c)")
+    assert ftk.must_query("select a from t where c = 14").rows == [(2,)]
+    ftk.must_exec("admin check table t")
+
+
+def test_hooks_drained_observes_commit_intents(ftk):
+    """Regression (review finding): a 1PC/async commit between its
+    commit_ts allocation and the in-mutex apply is invisible to the
+    publication set — hooks_drained must consult the commit-intent
+    window (like resolved_floor) or the backfill could snapshot past
+    an unapplied delete and write an entry below any conflict
+    window."""
+    mvcc = ftk.domain.storage.mvcc
+    ts = ftk.domain.storage.current_ts()
+    assert mvcc.hooks_drained(ts)
+    tok = mvcc.begin_commit_intent(ts - 1)
+    assert not mvcc.hooks_drained(ts)
+    # an intent at start_ts >= ts can only land at commit_ts > ts
+    assert mvcc.hooks_drained(ts - 1)
+    mvcc.end_commit_intent(tok)
+    assert mvcc.hooks_drained(ts)
+
+
+def test_duplicate_drop_index_loser_errors(ftk):
+    """Two sessions dropping the same index: exactly one succeeds; the
+    loser gets IndexNotExistsError (1176) whether it loses at the
+    session precheck or inside the job (a live drop job over a missing
+    index is a lost race, not a resume artifact — review finding)."""
+    from tidb_tpu.errors import IndexNotExistsError
+    ftk.must_exec("create table t (a int primary key, b int, "
+                  "key ib (b))")
+    ftk.must_exec("insert into t values (1, 10)")
+    results = []
+
+    def drop():
+        s = ftk.new_session()
+        try:
+            s.must_exec("drop index ib on t")
+            results.append("ok")
+        except IndexNotExistsError:
+            results.append("missing")
+    threads = [threading.Thread(target=drop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == ["missing", "ok"]
+    assert ftk.domain.infoschema().table_by_name(
+        "test", "t").find_index("ib") is None
+    ftk.must_exec("admin check table t")
+
+
+def test_concurrent_ddl_sessions_serialize_through_queue(ftk):
+    """Two sessions submitting DDL at once race the durable queue key;
+    the enqueue retries and the owner drains FIFO — both indexes land
+    PUBLIC and consistent."""
+    ftk.must_exec("create table t (a int primary key, b int, c int)")
+    ftk.must_exec("insert into t values (1,10,100),(2,20,200)")
+    errs = []
+
+    def ddl(col, name):
+        s = ftk.new_session()
+        try:
+            s.must_exec(f"create index {name} on t ({col})")
+        except Exception as e:          # noqa: BLE001
+            errs.append(e)
+    threads = [threading.Thread(target=ddl, args=(c, f"i{c}"))
+               for c in ("b", "c")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    tbl = ftk.domain.infoschema().table_by_name("test", "t")
+    assert {i.name for i in tbl.indexes} == {"ib", "ic"}
+    assert all(int(i.state) == 4 for i in tbl.indexes)
+    ftk.must_exec("admin check table t")
+
+
+# ---------------------------------------------------------------------------
+# distributed add-index: coordinator restart aborts cleanly
+# ---------------------------------------------------------------------------
+
+def test_distributed_abort_on_coordinator_restart(tmp_path):
+    """A coordinator that dies mid-reorg leaves worker-side ladder
+    state; the durable job record in the coordinator domain drives an
+    abort at the next coordinator start — no orphaned index meta or
+    backfill KV on any worker."""
+    env = dict(os.environ, TIDB_TPU_PLATFORM="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    procs, ports = [], []
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=REPO, text=True)
+        line = p.stdout.readline().strip()
+        assert line.startswith("WORKER_READY"), line
+        procs.append(p)
+        return int(line.split()[1])
+    try:
+        from tidb_tpu.cluster import Cluster
+        for _ in range(2):
+            ports.append(spawn())
+        dd = str(tmp_path / "coord")
+        cl = Cluster(ports, data_dir=dd)
+        cl.ddl("create table dt (id int primary key, v int)")
+        cl.workers[0].call({"op": "query",
+                            "sql": "insert into dt values (1, 7)"})
+        cl.workers[1].call({"op": "query",
+                            "sql": "insert into dt values (2, 9)"})
+
+        # die (SystemExit = the process going down: no abort runs,
+        # the job record stays live) after the second barrier
+        def die():
+            if die.hits == 1:
+                raise SystemExit(137)
+            die.hits += 1
+        die.hits = 0
+        failpoint.enable("ddl-dist-barrier", die)
+        with pytest.raises(SystemExit):
+            cl.add_index_distributed("dt", "i_v", ["v"])
+        failpoint.disable_all()
+        cl.domain.storage.mvcc.wal.close()
+
+        # coordinator restart over the same data dir: init aborts the
+        # recorded job on every worker
+        cl2 = Cluster(ports, data_dir=dd)
+        for w in range(2):
+            rows = cl2.query(
+                "select count(*) from information_schema.statistics "
+                "where table_name = 'dt' and index_name = 'i_v'",
+                worker=w)
+            assert rows == [(0,)], f"worker {w} leaked ladder state"
+        jobs = [j for j in cl2._job_txn(
+            lambda m: m.list_history_ddl_jobs())
+            if j.args.get("distributed")]
+        assert jobs and jobs[0].state == "cancelled"
+        # and the cluster still works: a fresh reorg completes
+        n = cl2.add_index_distributed("dt", "i_v", ["v"])
+        assert n == 2
+
+        # ADMIN CANCEL of a distributed job is observed at the next
+        # barrier (review finding: the coordinator is the only
+        # observer — the local runner skips distributed jobs) and
+        # aborts on every worker
+        from tidb_tpu.errors import DDLJobCancelledError
+
+        def cancel_live():
+            if cancel_live.done:
+                return
+            cancel_live.done = True
+            live = [j for j in cl2._job_txn(lambda m: m.list_ddl_jobs())
+                    if j.args.get("distributed")]
+            cl2.domain.ddl_jobs.cancel(live[0].id)
+        cancel_live.done = False
+        failpoint.enable("ddl-dist-barrier", cancel_live)
+        with pytest.raises(DDLJobCancelledError):
+            cl2.add_index_distributed("dt", "i_v2", ["v"])
+        failpoint.disable_all()
+        for w in range(2):
+            rows = cl2.query(
+                "select count(*) from information_schema.statistics "
+                "where table_name = 'dt' and index_name = 'i_v2'",
+                worker=w)
+            assert rows == [(0,)], f"worker {w} kept cancelled index"
+        cl2.stop()
+    finally:
+        failpoint.disable_all()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:           # noqa: BLE001
+                pass

@@ -427,6 +427,82 @@ def test_codes_unknown_sysvar():
     assert len(hits) == 1 and "tidb_tpu_no_such_knob" in hits[0].message
 
 
+# ---- failpoint-site-registry -----------------------------------------
+
+FPSITES = {"cdc-poll", "2pc-prewrite-done"}
+
+FP_SRC = """
+    from ..utils import failpoint
+
+    def seams():
+        failpoint.inject("cdc-poll")             # registered
+        failpoint.inject("totally-new-seam")     # NOT registered
+        failpoint.inject(dynamic_name())         # non-literal: skip
+"""
+
+
+def _lint_at(src, relpath, **cfg_kw):
+    config = LintConfig(root=REPO, enabled=None, **cfg_kw)
+    return lint_source(textwrap.dedent(src), relpath, config)
+
+
+def test_failpoint_unregistered_site_flagged():
+    hits = rule_hits(
+        _lint_at(FP_SRC, "tidb_tpu/storage/fixture.py",
+                 known_failpoints=FPSITES),
+        "failpoint-site-registry")
+    assert len(hits) == 1 and "totally-new-seam" in hits[0].message
+
+
+def test_failpoint_rule_scoped_to_package():
+    """tests/ arm ad-hoc fixture failpoints by design — out of scope."""
+    assert not rule_hits(
+        _lint_at(FP_SRC, "tests/test_fixture.py",
+                 known_failpoints=FPSITES),
+        "failpoint-site-registry")
+
+
+def test_failpoint_registry_parses_annassign():
+    from tidb_tpu.tools.tpulint.rules.failpoints import \
+        parse_failpoint_registry
+    got = parse_failpoint_registry(textwrap.dedent("""
+        SITES: dict[str, str] = {"a-seam": "desc", "b-seam": "desc"}
+    """))
+    assert got == {"a-seam", "b-seam"}
+    got2 = parse_failpoint_registry('SITES = {"c-seam": "d"}\n')
+    assert got2 == {"c-seam"}
+
+
+def test_failpoint_registry_covers_every_package_site():
+    """The live registry must cover every inject literal in the
+    package (the strict gate enforces this; pinned here so a spot run
+    catches drift too)."""
+    from tidb_tpu.tools.tpulint.rules.failpoints import \
+        parse_failpoint_registry
+    import re
+    reg_path = os.path.join(REPO, "tidb_tpu", "utils",
+                            "failpoint_sites.py")
+    with open(reg_path) as f:
+        known = parse_failpoint_registry(f.read())
+    pat = re.compile(r'failpoint\.inject\(\s*"([^"]+)"')
+    missing = []
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(REPO, "tidb_tpu")):
+        # tools/tpulint and failpoint.py quote inject() in docstrings;
+        # the AST-based strict gate is the authority there
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "tpulint")]
+        for fn in filenames:
+            if not fn.endswith(".py") or fn in ("failpoint_sites.py",
+                                                "failpoint.py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                for site in pat.findall(f.read()):
+                    if site not in known:
+                        missing.append((fn, site))
+    assert not missing, f"unregistered failpoint sites: {missing}"
+
+
 def test_codes_duplicate_error_code():
     from tidb_tpu.tools.tpulint.rules.codes import parse_error_catalog
     names, dups = parse_error_catalog(textwrap.dedent("""

@@ -77,14 +77,17 @@ class _WorkerClient:
 class Cluster:
     """Coordinator session over N worker processes."""
 
-    def __init__(self, ports, spawn_worker=None, regions=None):
+    def __init__(self, ports, spawn_worker=None, regions=None,
+                 data_dir=None):
         from ..session import new_store, Session
         self.workers = [_WorkerClient(p) for p in ports]
         # region label per worker (PD store labels); None = unlabeled
         self.worker_regions = list(regions) if regions else None
         # local schema-only domain: plans are built here, data lives on
-        # the workers
-        self.domain = new_store()
+        # the workers. With data_dir the domain is durable, so the
+        # distributed-DDL job records (add_index_distributed) survive a
+        # coordinator restart and resume_ddl_jobs can abort cleanly.
+        self.domain = new_store(data_dir)
         self.sess = Session(self.domain)
         self.sess.vars.current_db = "test"
         # recovery state (reference: stateless store nodes reload from
@@ -95,6 +98,69 @@ class Cluster:
         self._ddl_log: list = []
         self._loads: list = []             # [(table, csv_path)]
         self._replicated = False           # WAL chain active
+        # a live distributed job found at construction = a previous
+        # coordinator died mid-reorg: abort it on the workers NOW,
+        # before any query can observe leaked ladder state
+        self.resume_ddl_jobs()
+
+    def _job_txn(self, fn):
+        """One meta txn against the coordinator's (durable) domain —
+        the distributed reorg's job record rides it. Delegates to the
+        domain runner's shared retrying txn wrapper (a concurrent
+        local DDL on the coordinator domain races the queue/history
+        keys)."""
+        return self.domain.ddl_jobs._retry_txn(
+            fn, what="coordinator job")
+
+    def resume_ddl_jobs(self):
+        """Coordinator-restart recovery (the distributed half of
+        owner/ddl_runner.resume_pending, which skips distributed jobs):
+        a live distributed job record means a coordinator died
+        mid-reorg. If the coordinator's OWN durable schema already has
+        the index, the crash fell between the local commit (which runs
+        AFTER every worker reached public) and the job finish — roll
+        FORWARD (record synced; aborting would strip workers of an
+        index the coordinator still plans against). Otherwise abort it
+        on every reachable worker (drop the index meta AND purge
+        committed backfill KVs) and record the job cancelled. Returns
+        the handled job ids."""
+        from ..models.job import STATE_CANCELLED, STATE_SYNCED
+        jobs = self._job_txn(
+            lambda m: [j for j in m.list_ddl_jobs()
+                       if j.args.get("distributed")])
+        handled = []
+        for job in jobs:
+            iname = job.args["index"]["name"]
+            local_has = False
+            try:
+                t = self.domain.infoschema().table_by_name(
+                    job.db_name, job.table_name)
+                local_has = t.find_index(iname) is not None
+            except Exception:               # noqa: BLE001
+                pass
+            if local_has:
+                job.state = STATE_SYNCED
+                self._job_txn(lambda m, j=job: m.finish_ddl_job(j))
+                handled.append(job.id)
+                continue
+            payload = {"db": job.db_name, "table": job.table_name,
+                       "index": iname, "state": "abort"}
+
+            def ab(_i, w):
+                try:
+                    w.call({"op": "dxf_subtask", "kind": "index_ladder",
+                            "payload": dict(payload)})
+                except (OSError, RuntimeError):
+                    pass        # dead worker: a respawn replays only
+                    #             the DDL log, which has no trace of
+                    #             the aborted index
+            self._fanout(ab)
+            job.state = STATE_CANCELLED
+            job.error = ("coordinator restarted mid-reorg; index "
+                         "aborted on workers")
+            self._job_txn(lambda m, j=job: m.finish_ddl_job(j))
+            handled.append(job.id)
+        return handled
 
     def _fanout(self, fn):
         """Run fn(i, worker) concurrently for every worker (independent
@@ -417,11 +483,52 @@ class Cluster:
         re-runs just that shard's backfill. Cross-shard UNIQUE
         duplicates are caught by merging per-shard key hashes; on
         conflict every node aborts the index meta."""
-        from ..errors import DuplicateKeyError
+        import time as _time
+        from ..errors import DuplicateKeyError, DDLJobCancelledError
+        from ..utils import failpoint
+        from ..models import DDLJob
+        from ..models.job import (TYPE_ADD_INDEX, STATE_RUNNING,
+                                  STATE_SYNCED, STATE_CANCELLED,
+                                  STATE_CANCELLING)
         base = {"db": db, "table": table, "index": index,
                 "columns": list(columns), "unique": unique}
         applied: list = []          # ladder states every node reached
         backfilled = False
+        # durable job record in the coordinator domain: each completed
+        # cluster-wide barrier persists, so a coordinator restart knows
+        # exactly what worker-side ladder state exists and aborts it
+        # (resume_ddl_jobs) instead of leaking it
+        job = DDLJob(
+            type=TYPE_ADD_INDEX, state=STATE_RUNNING, db_name=db,
+            table_name=table, start_wall=_time.time(),
+            args={"distributed": True,
+                  "index": {"name": index, "columns": list(columns),
+                            "unique": bool(unique)},
+                  "applied": []})
+        self._job_txn(lambda m: m.enqueue_ddl_job(job))
+
+        def _persist_barrier():
+            # honor ADMIN CANCEL DDL JOB transactionally at every
+            # barrier (the local runner skips distributed jobs, so the
+            # coordinator is the only observer): the raise lands in the
+            # BaseException handler below -> abort on every worker +
+            # job cancelled — and the put can never clobber a
+            # concurrent cancelling flag
+            def put(m):
+                cur = m.get_ddl_job(job.id)
+                if cur is not None and cur.state == STATE_CANCELLING:
+                    raise DDLJobCancelledError(
+                        "Cancelled DDL job %d", job.id)
+                job.args["applied"] = list(applied)
+                job.args["backfilled"] = backfilled
+                m.put_ddl_job(job)
+            self._job_txn(put)
+            failpoint.inject("ddl-dist-barrier")
+
+        def _finish(state, error=""):
+            job.state = state
+            job.error = error
+            self._job_txn(lambda m: m.finish_ddl_job(job))
 
         def ladder(w, state):
             w.call({"op": "dxf_subtask", "kind": "index_ladder",
@@ -468,11 +575,19 @@ class Cluster:
                 self._fanout(lambda i, w, st=st:
                              with_recovery(i, lambda ww: ladder(ww, st)))
                 applied.append(st)
+                _persist_barrier()
             outs = self._fanout(lambda i, w: with_recovery(i, backfill))
         except OSError:
-            raise               # executor dead and no spawner: stuck
-        except BaseException:
+            raise               # executor dead and no spawner: stuck —
+            #                     the live job record lets a restarted
+            #                     coordinator abort once workers return
+        except (SystemExit, KeyboardInterrupt):
+            raise               # process dying: can't abort now; the
+            #                     durable record drives the abort at
+            #                     the next coordinator start
+        except BaseException as e:
             abort_all()
+            _finish(STATE_CANCELLED, "%s: %s" % (type(e).__name__, e))
             raise
         dup = next((o["dup"] for o in outs if o.get("dup")), None)
         if dup is None and unique:
@@ -487,9 +602,11 @@ class Cluster:
                     break
         if dup is not None:
             abort_all()
+            _finish(STATE_CANCELLED, dup)
             raise DuplicateKeyError("Duplicate entry for key '%s': %s",
                                     index, dup)
         backfilled = True
+        _persist_barrier()
         self._fanout(lambda i, w:
                      with_recovery(i, lambda ww: ladder(ww, "public")))
         # coordinator's schema-only domain + the recovery DDL log (a
@@ -499,6 +616,7 @@ class Cluster:
                f"({', '.join(columns)})")
         self.sess.execute(sql)
         self._ddl_log.append(sql)
+        _finish(STATE_SYNCED)
         return sum(out["rows"] for out in outs)
 
     def dxf_run(self, kind: str, payloads: list, concurrency: int = 4):

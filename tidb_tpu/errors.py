@@ -78,6 +78,10 @@ CollationCharsetMismatchError = _err("CollationCharsetMismatchError",
 # Resource
 MemoryQuotaExceededError = _err("MemoryQuotaExceededError", 8175)
 QueryKilledError = _err("QueryKilledError", 1317, "70100")
+# Online DDL job framework (owner/ddl_runner; reference pkg/ddl errno)
+DDLJobNotFoundError = _err("DDLJobNotFoundError", 8211)
+CancelFinishedDDLError = _err("CancelFinishedDDLError", 8212)
+DDLJobCancelledError = _err("DDLJobCancelledError", 8214)
 # Device supervision (utils/device_guard): the accelerator analog of the
 # reference's TiFlash-unavailable class (errno 9012/9013 family)
 DeviceUnavailableError = _err("DeviceUnavailableError", 9013)

@@ -243,6 +243,23 @@ def _gen_replica_freshness(domain):
                    str(mode))
 
 
+def _gen_ddl_jobs(domain):
+    """Durable online-DDL job queue + recent history (reference ADMIN
+    SHOW DDL JOBS / mysql.tidb_ddl_job, owner/ddl_runner.py): live
+    jobs first (a running reorg shows its checkpoint handle and rows
+    done/total), then terminal history newest-first."""
+    runner = getattr(domain, "ddl_jobs", None)
+    if runner is None:
+        return
+    from ..session.ddl import schema_state_name
+    for j in runner.list_jobs():
+        yield (j.id, j.type, j.state,
+               schema_state_name(j.schema_state), j.db_name,
+               j.table_name, j.table_id, j.row_done, j.row_total,
+               j.checkpoint_handle, j.start_wall or None,
+               j.error or "")
+
+
 def _gen_resource_groups(domain):
     for g in domain.resource_groups.groups.values():
         limit = ""
@@ -452,6 +469,14 @@ VIRTUAL_DEFS = {
                                      ("pending_delta_rows", _I()),
                                      ("mode", _S())),
                                _gen_replica_freshness),
+    "ddl_jobs": (_cols(("job_id", _I()), ("job_type", _S()),
+                       ("state", _S()), ("schema_state", _S()),
+                       ("db_name", _S()), ("table_name", _S()),
+                       ("table_id", _I()), ("row_count", _I()),
+                       ("total_rows", _I()),
+                       ("checkpoint_handle", _I()),
+                       ("start_time", _F()), ("error", _S())),
+                 _gen_ddl_jobs),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

@@ -8,6 +8,19 @@ pkg/meta/meta.go:219 Mutator). Layout under the `m` prefix:
     m[DB:{id}:TableList]     -> json list of table ids
     m[DB:{id}:Table:{tid}]   -> TableInfo json
 
+Online-DDL job framework rows (reference pkg/meta job queue +
+DDLJobHistoryKey + the delete-range table; owner/ddl_runner.py):
+
+    m[DDLJobQueue]           -> json list of live job ids (FIFO)
+    m[DDLJob:{id}]           -> DDLJob json (models/job.py)
+    m[DDLJobHistory]         -> json list of finished job ids, newest
+                                first, capped at HISTORY_CAP
+    m[DDLHist:{id}]          -> finished DDLJob json
+    m[DeleteRanges]          -> json list of {"id","table_id","index_id"}
+                                pending index-KV purges (registered in
+                                the SAME txn that removes index meta, so
+                                a crash can never orphan backfilled KVs)
+
 All mutations ride the surrounding Transaction — schema changes are
 transactional exactly like the reference (meta rows live in TiKV itself).
 """
@@ -16,13 +29,26 @@ from __future__ import annotations
 import json
 
 from ..codec.tablecodec import meta_key
-from ..models import DBInfo, TableInfo
+from ..models import DBInfo, TableInfo, DDLJob
 from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
                       TableExistsError, TableNotExistsError)
 
 _K_NEXT_ID = meta_key(b"NextGlobalID")
 _K_SCHEMA_VER = meta_key(b"SchemaVersion")
 _K_DBS = meta_key(b"DBs")
+_K_DDL_QUEUE = meta_key(b"DDLJobQueue")
+_K_DDL_HIST = meta_key(b"DDLJobHistory")
+_K_DELETE_RANGES = meta_key(b"DeleteRanges")
+
+HISTORY_CAP = 64
+
+
+def _job_key(jid: int) -> bytes:
+    return meta_key(b"DDLJob", str(jid).encode())
+
+
+def _hist_key(jid: int) -> bytes:
+    return meta_key(b"DDLHist", str(jid).encode())
 
 
 class Mutator:
@@ -132,3 +158,87 @@ class Mutator:
         self._set_table_ids(dbid, [i for i in ids if i != tid])
         self.txn.delete(meta_key(b"DB", str(dbid).encode(),
                                  b"Table", str(tid).encode()))
+
+    # ---- online-DDL job queue (owner/ddl_runner.py) --------------------
+    def _json_list(self, key) -> list:
+        v = self.txn.get(key)
+        return json.loads(v) if v is not None else []
+
+    def _set_json_list(self, key, lst):
+        self.txn.set(key, json.dumps(lst).encode())
+
+    def ddl_job_queue(self) -> list[int]:
+        return self._json_list(_K_DDL_QUEUE)
+
+    def enqueue_ddl_job(self, job: DDLJob) -> DDLJob:
+        """Assign an id and append to the live queue (FIFO)."""
+        if not job.id:
+            job.id = self.gen_global_id()
+        q = self.ddl_job_queue()
+        q.append(job.id)
+        self._set_json_list(_K_DDL_QUEUE, q)
+        self.put_ddl_job(job)
+        return job
+
+    def put_ddl_job(self, job: DDLJob):
+        self.txn.set(_job_key(job.id), job.serialize())
+
+    def get_ddl_job(self, jid: int) -> DDLJob | None:
+        v = self.txn.get(_job_key(jid))
+        return DDLJob.deserialize(v) if v is not None else None
+
+    def list_ddl_jobs(self) -> list[DDLJob]:
+        out = []
+        for jid in self.ddl_job_queue():
+            j = self.get_ddl_job(jid)
+            if j is not None:
+                out.append(j)
+        return out
+
+    def finish_ddl_job(self, job: DDLJob):
+        """Move a job to history (terminal state): remove from the
+        queue, write the history row, cap history at HISTORY_CAP."""
+        self._set_json_list(
+            _K_DDL_QUEUE, [i for i in self.ddl_job_queue()
+                           if i != job.id])
+        self.txn.delete(_job_key(job.id))
+        hist = self._json_list(_K_DDL_HIST)
+        hist.insert(0, job.id)
+        for old in hist[HISTORY_CAP:]:
+            self.txn.delete(_hist_key(old))
+        self._set_json_list(_K_DDL_HIST, hist[:HISTORY_CAP])
+        self.txn.set(_hist_key(job.id), job.serialize())
+
+    def get_history_ddl_job(self, jid: int) -> DDLJob | None:
+        v = self.txn.get(_hist_key(jid))
+        return DDLJob.deserialize(v) if v is not None else None
+
+    def list_history_ddl_jobs(self, limit: int = HISTORY_CAP) \
+            -> list[DDLJob]:
+        out = []
+        for jid in self._json_list(_K_DDL_HIST)[:limit]:
+            j = self.get_history_ddl_job(jid)
+            if j is not None:
+                out.append(j)
+        return out
+
+    # ---- delete-range queue (index-KV GC, reference delete-range) ------
+    def add_delete_range(self, table_id: int, index_id: int) -> int:
+        """Register an index key range for purge. MUST ride the same
+        txn that removes the index meta: the range outlives the meta,
+        never the reverse."""
+        rid = self.gen_global_id()
+        lst = self._json_list(_K_DELETE_RANGES)
+        lst.append({"id": rid, "table_id": table_id,
+                    "index_id": index_id})
+        self._set_json_list(_K_DELETE_RANGES, lst)
+        return rid
+
+    def delete_ranges(self) -> list[dict]:
+        return self._json_list(_K_DELETE_RANGES)
+
+    def remove_delete_range(self, rid: int):
+        self._set_json_list(
+            _K_DELETE_RANGES,
+            [r for r in self._json_list(_K_DELETE_RANGES)
+             if r["id"] != rid])

@@ -1,3 +1,5 @@
 from .schema import DBInfo, TableInfo, ColumnInfo, IndexInfo, SchemaState
+from .job import DDLJob
 
-__all__ = ["DBInfo", "TableInfo", "ColumnInfo", "IndexInfo", "SchemaState"]
+__all__ = ["DBInfo", "TableInfo", "ColumnInfo", "IndexInfo", "SchemaState",
+           "DDLJob"]

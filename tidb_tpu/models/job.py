@@ -1,0 +1,104 @@
+r"""DDL job model (reference pkg/meta/model/job.go — the durable record
+every online schema change runs through).
+
+A DDLJob is a WAL-framed meta row (`m[DDLJob:{id}]`, meta/meta.py) so it
+survives restart exactly like table metadata: each state transition of
+the F1 ladder commits the job record AND the schema mutation in ONE
+storage transaction, and restart recovery (owner/ddl_runner.py) finds
+in-flight jobs in the queue and resumes or rolls them back.
+
+States (reference model/job.go JobState):
+
+    queueing ----> running ----> synced            (success, history)
+        \            |
+         \           v
+          +----> cancelling -> rollingback -> cancelled   (history)
+
+`schema_state` records how far down the F1 ladder the target object got
+(models/schema.py SchemaState) — the resume point. `checkpoint_handle`
+is the largest row handle whose index backfill batch committed, so a
+resumed WRITE_REORG continues at the recorded handle range instead of
+row 0.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .schema import SchemaState
+
+# in-flight states (live in the queue)
+STATE_QUEUEING = "queueing"
+STATE_RUNNING = "running"
+STATE_CANCELLING = "cancelling"      # ADMIN CANCEL DDL JOB requested
+STATE_ROLLINGBACK = "rollingback"    # reverse ladder in progress
+# terminal states (live in history)
+STATE_SYNCED = "synced"
+STATE_CANCELLED = "cancelled"
+
+LIVE_STATES = (STATE_QUEUEING, STATE_RUNNING, STATE_CANCELLING,
+               STATE_ROLLINGBACK)
+TERMINAL_STATES = (STATE_SYNCED, STATE_CANCELLED)
+
+# job types (reference model.ActionType strings)
+TYPE_ADD_INDEX = "add index"
+TYPE_DROP_INDEX = "drop index"
+TYPE_EXCHANGE_PARTITION = "exchange partition"
+TYPE_MODIFY_COLUMN = "modify column"
+
+
+@dataclass
+class DDLJob:
+    id: int = 0
+    type: str = TYPE_ADD_INDEX
+    state: str = STATE_QUEUEING
+    # how far down the F1 ladder the target object is (resume point)
+    schema_state: SchemaState = SchemaState.NONE
+    db_name: str = ""
+    table_name: str = ""
+    table_id: int = 0
+    # type-specific payload, JSON-able (index def, exchange target,
+    # new column json) — everything a restarted process needs to
+    # re-enter the job without the original statement
+    args: dict = field(default_factory=dict)
+    # reorg/backfill progress: largest handle whose batch committed
+    checkpoint_handle: int | None = None
+    row_done: int = 0
+    row_total: int = 0
+    error: str = ""
+    start_wall: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.id, "type": self.type, "state": self.state,
+            "schema_state": int(self.schema_state),
+            "db_name": self.db_name, "table_name": self.table_name,
+            "table_id": self.table_id, "args": self.args,
+            "checkpoint_handle": self.checkpoint_handle,
+            "row_done": self.row_done, "row_total": self.row_total,
+            "error": self.error, "start_wall": self.start_wall,
+        }
+
+    @classmethod
+    def from_json(cls, j: dict) -> "DDLJob":
+        return cls(
+            id=j["id"], type=j["type"], state=j["state"],
+            schema_state=SchemaState(j["schema_state"]),
+            db_name=j["db_name"], table_name=j["table_name"],
+            table_id=j["table_id"], args=j.get("args") or {},
+            checkpoint_handle=j.get("checkpoint_handle"),
+            row_done=j.get("row_done", 0),
+            row_total=j.get("row_total", 0),
+            error=j.get("error", ""),
+            start_wall=j.get("start_wall", 0.0))
+
+    def serialize(self) -> bytes:
+        return json.dumps(self.to_json()).encode()
+
+    @classmethod
+    def deserialize(cls, b: bytes) -> "DDLJob":
+        return cls.from_json(json.loads(b))
+
+    @property
+    def finished(self) -> bool:
+        return self.state in TERMINAL_STATES

@@ -696,8 +696,10 @@ class GrantStmt(StmtNode):
 
 @dataclass
 class AdminStmt(StmtNode):
-    kind: str = "check_table"     # check_table | show_ddl
+    # check_table | show_ddl | cancel_ddl | checkpoint
+    kind: str = "check_table"
     tables: list = field(default_factory=list)
+    job_id: int = 0               # ADMIN CANCEL DDL JOB <id>
 
 
 @dataclass

@@ -299,6 +299,15 @@ class Parser:
                 self.expect_kw("ddl")
                 self.accept_kw("jobs")
                 return ast.AdminStmt(kind="show_ddl")
+            if self.accept_kw("cancel"):
+                self.expect_kw("ddl")
+                self.expect_kw("job")
+                tok = self.peek()
+                if tok.kind != "NUMBER" or not tok.text.isdigit():
+                    self.error("expected integer DDL job id")
+                self.next()
+                return ast.AdminStmt(kind="cancel_ddl",
+                                     job_id=int(tok.text))
             if self.accept_kw("checkpoint"):
                 return ast.AdminStmt(kind="checkpoint")
             if self.accept_kw("changefeed"):

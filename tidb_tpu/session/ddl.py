@@ -1,6 +1,14 @@
-"""DDL execution (reference pkg/ddl — the F1 online state machine collapsed
-to single-step transitions since DDL is in-process and transactional here;
-the SchemaState fields exist so the staged path can be distributed later)."""
+"""DDL execution (reference pkg/ddl). Single-transaction DDLs (CREATE/
+DROP TABLE, ADD/DROP COLUMN, renames) commit one meta txn and are
+crash-atomic by construction. Multi-step DDLs — ADD INDEX, DROP INDEX,
+EXCHANGE PARTITION, cross-class MODIFY COLUMN — run through the durable
+job framework (owner/ddl_runner.py): a persisted DDLJob walks the F1
+state ladder with every transition WAL-framed, backfill checkpointed by
+handle range, and restart recovery resuming or rolling back in-flight
+jobs. The ladder/backfill PRIMITIVES (add_index_prepare,
+_set_index_state, backfill_index_shard, purge_index_range) stay here —
+the distributed reorg (cluster/coordinator + dxf/remote) drives them
+per worker while the coordinator owns the job record."""
 from __future__ import annotations
 
 import copy
@@ -10,7 +18,11 @@ import numpy as np
 
 from ..parser import ast
 from ..meta import Mutator
-from ..models import DBInfo, TableInfo, ColumnInfo, IndexInfo, SchemaState
+from ..models import (DBInfo, TableInfo, ColumnInfo, IndexInfo,
+                      SchemaState, DDLJob)
+from ..models.job import (TYPE_ADD_INDEX, TYPE_DROP_INDEX,
+                          TYPE_EXCHANGE_PARTITION, TYPE_MODIFY_COLUMN,
+                          STATE_SYNCED)
 from ..types import FieldType
 from ..types.field_type import MYSQL_TYPE_NAMES, TypeClass
 from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
@@ -453,32 +465,52 @@ class DDLExecutor:
                                unique=stmt.unique)
         self._alter_add_index(tn, idx_def)
 
+    def _submit_job(self, job: DDLJob) -> DDLJob:
+        """Drive a durable DDL job synchronously (the session's thread
+        doubles as the owner worker in-process). An ExecContext is
+        registered so KILL of this connection reaches a running reorg —
+        the runner observes it at the next ladder step / backfill
+        checkpoint and rolls back through ``rollingback`` instead of a
+        best-effort exception unwind."""
+        from ..executor.exec_base import ExecContext
+        runner = self.domain.ddl_jobs
+        sess = self.sess
+        if sess is None or getattr(sess, "conn_id", None) is None:
+            return runner.submit(job)
+        ectx = ExecContext(sess)
+        self.domain.register_exec(sess.conn_id, ectx)
+        try:
+            return runner.submit(
+                job, cancel_check=lambda: bool(ectx.killed))
+        finally:
+            self.domain.unregister_exec(sess.conn_id, ectx)
+            ectx.finish()
+
+    def _reorg_batch(self) -> int:
+        try:
+            return int(self.sess.vars.get("tidb_tpu_ddl_reorg_batch_size"))
+        except Exception:               # noqa: BLE001
+            from .sysvars import get_sysvar
+            return int(get_sysvar("tidb_tpu_ddl_reorg_batch_size").default)
+
     def drop_index(self, stmt: ast.DropIndexStmt):
         """Drop through the reverse F1 ladder (reference ddl/index.go
         onDropIndex): public -> write-only (reads stop) -> delete-only
-        (writes stop) -> absent, then purge the index key range."""
-        from ..models.schema import SchemaState
+        (writes stop) -> absent, then delete-range purges the index key
+        range. Runs as a durable job so a crash mid-ladder resumes
+        toward absence at restart instead of stranding a half state."""
         tn = stmt.table
-
-        def check(m):
-            db, tbl = self._get_table(m, tn)
-            idx = tbl.find_index(stmt.index_name)
-            if idx is None:
-                raise IndexNotExistsError("index %s doesn't exist",
-                                          stmt.index_name)
-            return db, tbl, idx
-        _, tbl, idx = self._with_meta(check)
-        self._set_index_state(tn, idx.name, SchemaState.WRITE_ONLY)
-        self._set_index_state(tn, idx.name, SchemaState.DELETE_ONLY)
-
-        def fn(m):
-            db, tbl2 = self._get_table(m, tn)
-            tbl2.indexes = [i for i in tbl2.indexes
-                            if i.name.lower() != idx.name.lower()]
-            m.update_table(db.id, tbl2)
-        self._with_meta(fn)
-        # purge index KV range (reference: delete-range worker)
-        purge_index_range(self.domain, tbl.id, idx.id)
+        db_name = tn.db or self.sess.vars.current_db
+        tbl = self.domain.infoschema().table_by_name(db_name, tn.name)
+        idx = tbl.find_index(stmt.index_name)
+        if idx is None:
+            raise IndexNotExistsError("index %s doesn't exist",
+                                      stmt.index_name)
+        job = DDLJob(type=TYPE_DROP_INDEX, db_name=db_name,
+                     table_name=tbl.name, table_id=tbl.id,
+                     schema_state=idx.state,
+                     args={"index": {"name": idx.name}})
+        self._submit_job(job)
 
     def alter_table(self, stmt: ast.AlterTableStmt):
         for action, payload in stmt.actions:
@@ -762,19 +794,43 @@ class DDLExecutor:
         self._with_meta(fn)
 
     def _alter_modify_column(self, tn, cd: ast.ColumnDef):
-        def fn(m):
-            db, tbl = self._get_table(m, tn)
-            ci = tbl.find_column(cd.name)
-            if ci is None:
-                raise ColumnNotExistsError("Unknown column '%s'", cd.name)
-            new_ci = column_def_to_info(cd, ci.id, ci.offset)
-            if new_ci.ft.tclass != ci.ft.tclass:
-                raise UnsupportedError(
-                    "column type change across classes needs reorg "
-                    "(not supported yet)")
-            tbl.columns[ci.offset] = new_ci
-            m.update_table(db.id, tbl)
-        self._with_meta(fn)
+        """Same storage class: meta-only flip in one txn. Cross-class
+        (INT -> VARCHAR, VARCHAR -> INT, ...): a reorg job — full row
+        rewrite with value conversion, the modified column re-allocated
+        under a fresh column id (the columnar engine's arrays are typed
+        per id; reference: the hidden 'changing column' of
+        ddl/column.go modify-column reorg), committed atomically with
+        the job record (owner/ddl_runner.py)."""
+        db_name = tn.db or self.sess.vars.current_db
+        tbl = self.domain.infoschema().table_by_name(db_name, tn.name)
+        ci = tbl.find_column(cd.name)
+        if ci is None:
+            raise ColumnNotExistsError("Unknown column '%s'", cd.name)
+        new_ci = column_def_to_info(cd, ci.id, ci.offset)
+        if new_ci.ft.tclass == ci.ft.tclass:
+            def fn(m):
+                db, tbl2 = self._get_table(m, tn)
+                cur = tbl2.find_column(cd.name)
+                if cur is None:
+                    raise ColumnNotExistsError("Unknown column '%s'",
+                                               cd.name)
+                tbl2.columns[cur.offset] = column_def_to_info(
+                    cd, cur.id, cur.offset)
+                m.update_table(db.id, tbl2)
+            self._with_meta(fn)
+            return
+        lo = cd.name.lower()
+        if tbl.pk_is_handle and tbl.pk_col_name.lower() == lo:
+            raise UnsupportedError(
+                "cannot change the clustered primary key column's "
+                "storage class")
+        if tbl.partitions and tbl.partitions["col"].lower() == lo:
+            raise UnsupportedError(
+                "cannot change the partition column's storage class")
+        job = DDLJob(type=TYPE_MODIFY_COLUMN, db_name=db_name,
+                     table_name=tbl.name, table_id=tbl.id,
+                     args={"column": new_ci.to_json()})
+        self._submit_job(job)
 
     def _set_index_state(self, tn, idx_name, state):
         """One F1 state transition = one meta txn = one schema version
@@ -824,53 +880,41 @@ class DDLExecutor:
     def _alter_add_index(self, tn, idx_def):
         """Add index through the F1 online states (reference
         ddl/index.go onCreateIndex + backfilling*.go): none ->
-        delete-only -> write-only -> write-reorg (snapshot backfill while
-        concurrent DML maintains the index) -> public. Each transition is
-        its own schema version, so concurrent sessions never skip a
-        state."""
-        from ..models.schema import SchemaState
-        result = self.add_index_prepare(tn, idx_def)
-        if result is None:
-            return
-        db, tbl, idx = result
-        from ..utils import failpoint
-        failpoint.inject("ddl-index-delete-only")
-        self._set_index_state(tn, idx.name, SchemaState.WRITE_ONLY)
-        failpoint.inject("ddl-index-write-only")
-        _, tbl, idx = self._set_index_state(tn, idx.name,
-                                            SchemaState.WRITE_REORG)
-        failpoint.inject("ddl-index-write-reorg")
-        try:
-            backfill_index_shard(self.domain, tbl, idx)
-            self._set_index_state(tn, idx.name, SchemaState.PUBLIC)
-        except BaseException:
-            self.drop_index_meta(tn, idx.name)
-            raise
+        delete-only -> write-only -> write-reorg (checkpointed backfill
+        while concurrent DML maintains the index) -> public. Each
+        transition is its own schema version AND its own WAL-framed job
+        record (owner/ddl_runner.py), so concurrent sessions never skip
+        a state and a kill -9 at any seam resumes from the recorded
+        state — backfill from the checkpointed handle range — or rolls
+        back to clean absence with the backfilled KVs delete-ranged."""
+        db_name = tn.db or self.sess.vars.current_db
+        tbl = self.domain.infoschema().table_by_name(db_name, tn.name)
+        # fast-fail validation (no job row for a statement that could
+        # never start); the runner re-validates inside the first txn
+        if tbl.find_index(idx_def.name) is not None:
+            raise IndexExistsError("Duplicate key name '%s'",
+                                   idx_def.name)
+        for cn in idx_def.columns:
+            if tbl.find_column(cn) is None:
+                raise ColumnNotExistsError(
+                    "Key column '%s' doesn't exist in table", cn)
+        job = DDLJob(
+            type=TYPE_ADD_INDEX, db_name=db_name, table_name=tbl.name,
+            table_id=tbl.id,
+            args={"index": {"name": idx_def.name,
+                            "columns": list(idx_def.columns),
+                            "unique": bool(idx_def.unique),
+                            "primary": bool(getattr(idx_def, "primary",
+                                                    False))},
+                  "batch": self._reorg_batch()})
+        self._submit_job(job)
 
     # ---- partition maintenance DDL ------------------------------------
     def _snapshot_rows(self, phys_tbl, cols):
-        """[(handle, [Datum per column])] for the live rows of one
-        PHYSICAL table (a partition pid or a plain table id)."""
-        if self.domain.columnar.tables.get(phys_tbl.id) is None:
-            return []
-        # route through the engine so a just-changed schema (added
-        # column) refreshes the ctab's arrays before we read
-        ctab = self.domain.columnar.table(phys_tbl)
-        if ctab.live_count() == 0:
-            return []
-        valid = ctab.valid_at()
-        out = []
-        for i in np.nonzero(valid)[0].tolist():
-            row = [ctab.column_for(ci).get_datum(i) for ci in cols]
-            out.append((int(ctab.handles[i]), row))
-        return out
+        return _snapshot_rows(self.domain, phys_tbl, cols)
 
     def _new_handle(self, tbl, row, alloc):
-        if tbl.pk_is_handle:
-            off = next(i for i, c in enumerate(tbl.columns)
-                       if c.name.lower() == tbl.pk_col_name.lower())
-            return int(row[off].val)
-        return alloc.next_handle()
+        return _new_handle(tbl, row, alloc)
 
     def _alter_exchange_partition(self, tn, payload):
         """ALTER TABLE pt EXCHANGE PARTITION p WITH TABLE nt
@@ -879,64 +923,23 @@ class DDLExecutor:
         indexes live under the LOGICAL table id, so the swap moves the
         rows through the normal write path — same observable contract
         (schemas must match, rows must fit the partition unless
-        WITHOUT VALIDATION), row counts bounded by the two sides."""
-        from ..storage.partition import partition_table_info, \
-            route_partition
+        WITHOUT VALIDATION), row counts bounded by the two sides.
+        Runs as a durable job: the swap, the schema-version bump and
+        the job completion commit as ONE transaction
+        (exchange_partition_apply), so a crash re-runs or finds it
+        done — never half-exchanged."""
         db_name = tn.db or self.sess.vars.current_db
         pt = self.domain.infoschema().table_by_name(db_name, tn.name)
         nt_tn = payload["table"]
-        nt = self.domain.infoschema().table_by_name(
-            nt_tn.db or db_name, nt_tn.name)
-        if not pt.partitions:
-            raise UnsupportedError("%s is not partitioned", pt.name)
-        if nt.partitions:
-            raise UnsupportedError(
-                "EXCHANGE target %s must not be partitioned", nt.name)
-        part = next((p for p in pt.partitions["parts"]
-                     if p["name"].lower() ==
-                     payload["partition"].lower()), None)
-        if part is None:
-            raise TiDBError("Unknown partition '%s'",
-                            payload["partition"])
-        sig = lambda t: [(c.name.lower(), c.ft.tclass, c.ft.flen,  # noqa: E731
-                          c.ft.decimal) for c in t.columns]
-        if sig(pt) != sig(nt):
-            raise UnsupportedError(
-                "Tables have different definitions")
-        rows_p = self._snapshot_rows(
-            partition_table_info(pt, part["pid"]), pt.columns)
-        rows_n = self._snapshot_rows(nt, nt.columns)
-        if payload.get("validation", True):
-            pcol_off = next(i for i, c in enumerate(pt.columns)
-                            if c.name.lower() ==
-                            pt.partitions["col"].lower())
-            for _h, row in rows_n:
-                d = row[pcol_off]
-                pid = route_partition(
-                    pt, None if d.is_null else int(d.val))
-                if pid != part["pid"]:
-                    raise TiDBError(
-                        "Found a row that does not match the partition")
-        txn = self.domain.storage.begin()
-        try:
-            for h, row in rows_p:
-                table_rt.remove_record(txn, pt, h, row)
-            for h, row in rows_n:
-                table_rt.remove_record(txn, nt, h, row)
-            pt_alloc = self.domain.allocator(pt)
-            nt_alloc = self.domain.allocator(nt)
-            for _h, row in rows_n:
-                table_rt.add_record(
-                    txn, pt, self._new_handle(pt, row, pt_alloc), row)
-            for _h, row in rows_p:
-                table_rt.add_record(
-                    txn, nt, self._new_handle(nt, row, nt_alloc), row)
-            txn.commit()
-        except BaseException:
-            txn.rollback()
-            raise
-        # schema version bump: concurrent readers refresh their caches
-        self._with_meta(lambda m: None)
+        job = DDLJob(
+            type=TYPE_EXCHANGE_PARTITION, db_name=db_name,
+            table_name=pt.name, table_id=pt.id,
+            args={"partition": payload["partition"],
+                  "nt_db": nt_tn.db or db_name,
+                  "nt_table": nt_tn.name,
+                  "validation": bool(payload.get("validation", True))})
+        exchange_precheck(self.domain, job)   # fast-fail, no job row
+        self._submit_job(job)
 
     def _alter_reorganize_partition(self, tn, payload):
         """ALTER TABLE pt REORGANIZE PARTITION p1[,p2..] INTO (...)
@@ -1122,6 +1125,287 @@ class DDLExecutor:
             if t.name.lower() == tn.name.lower():
                 return db, t
         raise TableNotExistsError("Unknown table '%s'", tn.name)
+
+
+def schema_state_name(state) -> str:
+    """Display name for a SchemaState (reference model.SchemaState
+    String(): the names ADMIN SHOW DDL JOBS / ddl_jobs print)."""
+    return {
+        SchemaState.NONE: "none",
+        SchemaState.DELETE_ONLY: "delete only",
+        SchemaState.WRITE_ONLY: "write only",
+        SchemaState.WRITE_REORG: "write reorganization",
+        SchemaState.PUBLIC: "public",
+    }.get(state, str(int(state)))
+
+
+def _wait_hooks_drained(domain, start_ts, timeout=5.0):
+    """Wait until every commit <= start_ts has reached the hook-fed
+    engines (storage/mvcc hooks_drained): the columnar apply runs
+    after durability, so a columnar snapshot taken inside a txn could
+    otherwise trail the KV state by a whole group-commit fsync —
+    commits the snapshot then misses are NOT the ones the txn's
+    writes conflict with. Bounded: on a wedged hook the caller
+    proceeds under conflict-detection alone rather than stalling the
+    DDL job."""
+    import time as _time
+    mvcc = domain.storage.mvcc
+    deadline = _time.time() + timeout
+    while not mvcc.hooks_drained(start_ts):
+        if _time.time() > deadline:
+            break
+        _time.sleep(0.0005)
+
+
+def _snapshot_rows(domain, phys_tbl, cols):
+    """[(handle, [Datum per column])] for the live rows of one
+    PHYSICAL table (a partition pid or a plain table id)."""
+    if domain.columnar.tables.get(phys_tbl.id) is None:
+        return []
+    # route through the engine so a just-changed schema (added
+    # column) refreshes the ctab's arrays before we read
+    ctab = domain.columnar.table(phys_tbl)
+    if ctab.live_count() == 0:
+        return []
+    valid = ctab.valid_at()
+    out = []
+    for i in np.nonzero(valid)[0].tolist():
+        row = [ctab.column_for(ci).get_datum(i) for ci in cols]
+        out.append((int(ctab.handles[i]), row))
+    return out
+
+
+def _new_handle(tbl, row, alloc):
+    if tbl.pk_is_handle:
+        off = next(i for i, c in enumerate(tbl.columns)
+                   if c.name.lower() == tbl.pk_col_name.lower())
+        return int(row[off].val)
+    return alloc.next_handle()
+
+
+def exchange_precheck(domain, job):
+    """EXCHANGE PARTITION static validation from the durable job args
+    (shared by the fast-fail path pre-enqueue and the runner handler
+    at apply/resume time). Returns (pt, nt, part)."""
+    a = job.args
+    isc = domain.infoschema()
+    pt = isc.table_by_name(job.db_name, job.table_name)
+    nt = isc.table_by_name(a["nt_db"], a["nt_table"])
+    if not pt.partitions:
+        raise UnsupportedError("%s is not partitioned", pt.name)
+    if nt.partitions:
+        raise UnsupportedError(
+            "EXCHANGE target %s must not be partitioned", nt.name)
+    part = next((p for p in pt.partitions["parts"]
+                 if p["name"].lower() == a["partition"].lower()), None)
+    if part is None:
+        raise TiDBError("Unknown partition '%s'", a["partition"])
+    sig = lambda t: [(c.name.lower(), c.ft.tclass, c.ft.flen,  # noqa: E731
+                      c.ft.decimal) for c in t.columns]
+    if sig(pt) != sig(nt):
+        raise UnsupportedError("Tables have different definitions")
+    return pt, nt, part
+
+
+def exchange_partition_apply(runner, job):
+    """Runner handler: snapshot, validate and swap INSIDE the terminal
+    txn body, so a WriteConflict retry (concurrent DML landed between
+    snapshot and commit) re-snapshots instead of writing stale rows.
+    The txn carries rows + schema-version bump + job completion — a
+    crash either re-runs the whole handler at resume (nothing applied)
+    or finds the job synced in history."""
+    from ..storage.partition import partition_table_info, route_partition
+    domain = runner.domain
+
+    def fn(m):
+        _wait_hooks_drained(domain, m.txn.start_ts)
+        pt, nt, part = exchange_precheck(domain, job)
+        rows_p = _snapshot_rows(
+            domain, partition_table_info(pt, part["pid"]), pt.columns)
+        rows_n = _snapshot_rows(domain, nt, nt.columns)
+        if job.args.get("validation", True):
+            pcol_off = next(i for i, c in enumerate(pt.columns)
+                            if c.name.lower() ==
+                            pt.partitions["col"].lower())
+            for _h, row in rows_n:
+                d = row[pcol_off]
+                pid = route_partition(
+                    pt, None if d.is_null else int(d.val))
+                if pid != part["pid"]:
+                    raise TiDBError(
+                        "Found a row that does not match the partition")
+        txn = m.txn
+        for h, row in rows_p:
+            table_rt.remove_record(txn, pt, h, row)
+        for h, row in rows_n:
+            table_rt.remove_record(txn, nt, h, row)
+        pt_alloc = domain.allocator(pt)
+        nt_alloc = domain.allocator(nt)
+        for _h, row in rows_n:
+            table_rt.add_record(
+                txn, pt, _new_handle(pt, row, pt_alloc), row)
+        for _h, row in rows_p:
+            table_rt.add_record(
+                txn, nt, _new_handle(nt, row, nt_alloc), row)
+        job.schema_state = SchemaState.PUBLIC
+        job.state = STATE_SYNCED
+        m.finish_ddl_job(job)
+    runner._terminal_txn(job, fn)
+
+
+def modify_column_apply(runner, job):
+    """Runner handler for the cross-class MODIFY COLUMN reorg: rewrite
+    every row converting the column's datums to the new type, with the
+    column re-created under a FRESH column id (the columnar engine
+    types its arrays per id — reference: the hidden 'changing column').
+    Snapshot + conversion live inside the terminal txn body for the
+    same retry-correctness as exchange_partition_apply. A conversion
+    failure aborts the whole txn — the job rolls back with nothing
+    applied."""
+    from ..storage.partition import partition_table_info
+    from ..chunk.column import py_to_datum_fast
+    from ..types.datum import NULL
+    from ..errors import TruncatedWrongValueError
+    domain = runner.domain
+
+    def fn(m):
+        _wait_hooks_drained(domain, m.txn.start_ts)
+        db, t2 = runner._get_tbl(m, job)
+        want = ColumnInfo.from_json(job.args["column"])
+        cur = t2.find_column(want.name)
+        if cur is None:
+            raise ColumnNotExistsError("Unknown column '%s'", want.name)
+        off = cur.offset
+        phys = [partition_table_info(t2, p["pid"])
+                for p in t2.partitions["parts"]] if t2.partitions \
+            else [t2]
+        rows = []
+        for ph in phys:
+            rows.extend(_snapshot_rows(domain, ph, t2.columns))
+        new_rows = []
+        for h, row in rows:
+            d = row[off]
+            if d.is_null:
+                nd = NULL
+            else:
+                try:
+                    nd = py_to_datum_fast(d.to_py(), want.ft)
+                except TiDBError:
+                    raise
+                except Exception:               # noqa: BLE001
+                    raise TruncatedWrongValueError(
+                        "Incorrect %s value: '%s' for column '%s' at "
+                        "row with handle %d", want.ft.tp,
+                        d.to_py(), want.name, h)
+            r = list(row)
+            r[off] = nd
+            new_rows.append((h, r))
+        old_view = copy.copy(t2)
+        old_view.columns = list(t2.columns)
+        new_ci = ColumnInfo.from_json(job.args["column"])
+        new_ci.id = max(c.id for c in t2.columns) + 1
+        new_ci.offset = off
+        t2.columns = list(t2.columns)
+        t2.columns[off] = new_ci
+        m.update_table(db.id, t2)
+        txn = m.txn
+        for h, row in rows:
+            table_rt.remove_record(txn, old_view, h, row)
+        for h, r in new_rows:
+            table_rt.add_record(txn, t2, h, r)
+        job.schema_state = SchemaState.PUBLIC
+        job.state = STATE_SYNCED
+        m.finish_ddl_job(job)
+    runner._terminal_txn(job, fn)
+
+
+def backfill_index_batch(domain, tbl, phys_tbl_id, idx, start_after=None,
+                         limit=2048):
+    """One handle-ordered backfill batch for the durable job runner
+    (owner/ddl_runner.py): index entries for up to ``limit`` live rows
+    of physical table ``phys_tbl_id`` with handle > ``start_after``,
+    committed through the NORMAL transactional write path — a
+    concurrent DML commit touching the same index keys surfaces as
+    WriteConflict and the caller retries with a fresh snapshot, so a
+    stale entry can never be resurrected the way a blind bulk ingest
+    could. Returns (rows_written, last_handle)."""
+    from ..codec.tablecodec import index_key
+    from ..executor.table_rt import fold_ci_datums
+    if domain.columnar.tables.get(phys_tbl_id) is None:
+        return 0, start_after
+    # route through the engine so a just-changed schema (ADD COLUMN
+    # followed by ADD INDEX on it) refreshes the ctab's arrays before
+    # we read — the raw tables.get ctab would KeyError on the new
+    # column id (same contract as _snapshot_rows)
+    if phys_tbl_id == tbl.id:
+        phys_info = tbl
+    else:
+        from ..storage.partition import partition_table_info
+        phys_info = partition_table_info(tbl, phys_tbl_id)
+    ctab = domain.columnar.table(phys_info)
+    if ctab.live_count() == 0:
+        return 0, start_after
+    floor = -(1 << 63) if start_after is None else int(start_after)
+    # begin BEFORE snapshotting: a row deleted/updated by a commit
+    # between the snapshot and our start_ts would not conflict at
+    # commit time, resurrecting its stale entry (caught by ddl_smoke's
+    # pre-public × concurrent-DML case — the 501-entries-for-500-rows
+    # dangling key). With begin first, any overlapping commit after
+    # start_ts trips WriteConflict and the batch retries fresh.
+    txn = domain.storage.begin()
+    try:
+        # ... and wait out in-flight hook publications <= start_ts:
+        # once drained, the snapshot is at least as fresh as start_ts
+        # and every commit it can't see is one our index-key writes
+        # conflict with. (Values must come from the columnar engine,
+        # not a positional row-KV decode — rows written before a
+        # column-set DDL keep their old layout until next touched.)
+        _wait_hooks_drained(domain, txn.start_ts)
+        mvcc = domain.storage.mvcc
+        valid = ctab.valid_at()
+        pos = np.nonzero(valid)[0]
+        handles = ctab.handles[pos]
+        keep = handles > floor
+        pos, handles = pos[keep], handles[keep]
+        if len(pos) == 0:
+            txn.rollback()
+            return 0, start_after
+        order = np.argsort(handles, kind="stable")[:limit]
+        pos, handles = pos[order], handles[order]
+        cols = [tbl.find_column(c) for c in idx.columns]
+        col_views = [ctab.column_for(ci, pos) for ci in cols]
+        from ..codec.tablecodec import record_key
+        last = floor
+        for j in range(len(pos)):
+            handle = int(handles[j])
+            if mvcc.absent_at(record_key(phys_tbl_id, handle),
+                              txn.start_ts):
+                # freshly deleted (or not yet visible) in the row KV:
+                # its own DML maintenance owns the entry — skipping
+                # here just saves a guaranteed conflict-retry
+                last = handle
+                continue
+            datums = fold_ci_datums(
+                tbl, idx, [cv.get_datum(j) for cv in col_views])
+            if idx.unique and not any(d.is_null for d in datums):
+                ik = index_key(tbl.id, idx.id, datums)
+                existing = txn.get(ik)
+                if existing is not None and \
+                        existing not in (str(handle).encode(), b""):
+                    # concurrent WRITE_ONLY maintenance may have
+                    # written this very row's entry; only a different
+                    # handle is a duplicate
+                    raise DuplicateKeyError(
+                        "Duplicate entry for key '%s'", idx.name)
+                txn.set(ik, str(handle).encode())
+            else:
+                txn.set(index_key(tbl.id, idx.id, datums, handle), b"")
+            last = handle
+        txn.commit()
+        return len(pos), last
+    except BaseException:
+        txn.rollback()
+        raise
 
 
 def purge_index_range(domain, table_id, index_id):

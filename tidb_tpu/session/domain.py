@@ -220,8 +220,18 @@ class Domain:
         # bookkeeping behind information_schema.tidb_replica_freshness
         # and the resolved-ts read view for analytic statements
         self.copr.delta.attach(self)
+        # durable online-DDL job runner (owner/ddl_runner.py): the
+        # queue lives in the meta namespace, so after checkpoint+WAL
+        # replay in-flight schema changes resume forward (from the
+        # recorded ladder state / backfill checkpoint) or roll back to
+        # clean absence, orphaned non-PUBLIC index states are swept,
+        # and leftover delete-ranges are purged — BEFORE any session
+        # can observe a half-state index
+        from ..owner.ddl_runner import DDLJobRunner
+        self.ddl_jobs = DDLJobRunner(self)
         if data_dir:
             self.cdc.resume_persisted()
+            self.ddl_jobs.resume_pending()
 
     def _open_wal(self, data_dir):
         """Restore the latest checkpoint (if any), replay the WAL tail,

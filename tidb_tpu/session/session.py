@@ -572,6 +572,30 @@ class Session:
                     tbl = self.domain.infoschema().table_by_name(db, tn.name)
                     total += check_table(self, tbl, db)
                 return ResultSet(affected=total)
+            if stmt.kind == "show_ddl":
+                from .show import _str_chunk
+                from .ddl import schema_state_name
+                rows = []
+                for j in self.domain.ddl_jobs.list_jobs():
+                    rows.append((
+                        j.id, j.db_name, j.table_name, j.type,
+                        schema_state_name(j.schema_state), j.table_id,
+                        j.row_done, j.row_total,
+                        j.checkpoint_handle,
+                        time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(
+                            j.start_wall)) if j.start_wall else None,
+                        j.state, j.error or None))
+                return _str_chunk(
+                    ["JOB_ID", "DB_NAME", "TABLE_NAME", "JOB_TYPE",
+                     "SCHEMA_STATE", "TABLE_ID", "ROW_COUNT",
+                     "TOTAL_ROWS", "CHECKPOINT_HANDLE", "START_TIME",
+                     "STATE", "ERROR"], rows)
+            if stmt.kind == "cancel_ddl":
+                from .show import _str_chunk
+                self.check_priv("super")
+                result = self.domain.ddl_jobs.cancel(stmt.job_id)
+                return _str_chunk(["JOB_ID", "RESULT"],
+                                  [(str(stmt.job_id), result)])
             return ResultSet()
         if isinstance(stmt, ast.ChangefeedStmt):
             return self._exec_changefeed(stmt)

@@ -251,6 +251,15 @@ for _v in [
     SysVar("tidb_tpu_delta_max_rows", SCOPE_BOTH,
            _env_int("TIDB_TPU_DELTA_MAX_ROWS", 1 << 20),
            "int", 0, 1 << 40),
+    # online-DDL reorg batch size (owner/ddl_runner.py): rows per
+    # backfill transaction = the checkpoint granularity. Each batch
+    # commits through the normal 2PC path and then persists the
+    # high-water handle in the job record, so a crashed reorg resumes
+    # at the recorded handle range (the reference
+    # tidb_ddl_reorg_batch_size).
+    SysVar("tidb_tpu_ddl_reorg_batch_size", SCOPE_BOTH,
+           _env_int("TIDB_TPU_DDL_REORG_BATCH", 2048),
+           "int", 16, 1 << 20),
     # memory-governance action chain (utils/memory.py,
     # docs/ROBUSTNESS.md "Memory safety"): what the quota-breach chain
     # does AFTER logging and after every registered operator spill has

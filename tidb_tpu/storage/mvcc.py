@@ -365,6 +365,43 @@ class MVCCStore:
             self._resolve_or_wait([(key, blk)], 0,
                                   ctx or self.default_lock_ctx)
 
+    def hooks_drained(self, ts: int) -> bool:
+        """True when no commit <= ts is still on its way to the hooks:
+        neither mid-publication (applied to the KV store, hooks not yet
+        finished) nor inside a commit-intent window (commit_ts may
+        already be allocated <= ts but the apply hasn't happened — the
+        same 1PC/async pre-allocation window resolved_floor guards; an
+        intent's eventual commit_ts is > its start_ts, so only intents
+        with start_ts < ts can land at/below ts). A reader that begins
+        at start_ts and then waits for hooks_drained(start_ts) sees
+        every commit <= start_ts reflected in the hook-fed engines
+        (columnar, CDC) — the DDL backfill uses this to take a
+        columnar snapshot no older than its transaction, so commits it
+        could miss are exactly the ones its index-key writes conflict
+        with."""
+        with self._mu:
+            return all(cts > ts for cts in self._publishing.values()) \
+                and all(sts >= ts
+                        for sts in self._commit_intents.values())
+
+    def absent_at(self, key: bytes, read_ts: int) -> bool:
+        """True when `key` has committed version history but reads as
+        absent at `read_ts` — a delete tombstone is the visible
+        version, or every version is newer than the snapshot. False
+        for a key with NO history at all (bulk-ingested columnar rows
+        have no row KV). Lock-blind by design: an uncommitted delete
+        that lands after `read_ts` is the caller's write-conflict to
+        detect. Used by the DDL backfill (session/ddl.py
+        backfill_index_batch) to skip columnar-snapshot rows whose row
+        KV is already gone — the columnar apply hook runs after
+        durability, so the column snapshot can trail the KV state by
+        a whole group-commit fsync."""
+        with self._mu:
+            vers = self._kv.get(key)
+            if vers is None or not vers.ts_list:
+                return False
+            return vers.get(read_ts) is None
+
     def scan(self, start: bytes, end: bytes | None, read_ts: int,
              limit: int = -1, ctx: LockCtx | None = None):
         while True:

@@ -15,17 +15,20 @@ from .baseline import Baseline
 from .context import FileContext
 from .core import Finding, all_rules
 from .rules.codes import parse_error_catalog, parse_sysvar_catalog
+from .rules.failpoints import parse_failpoint_registry
 
 
 class LintConfig:
     def __init__(self, root=None, enabled=None, baseline=None,
-                 known_errors=None, known_sysvars=None, error_dups=None):
+                 known_errors=None, known_sysvars=None, error_dups=None,
+                 known_failpoints=None):
         self.root = root or os.getcwd()
         self.enabled = set(enabled) if enabled is not None else None
         self.baseline = baseline or Baseline()
         self.known_errors = known_errors
         self.known_sysvars = known_sysvars
         self.error_dups = error_dups
+        self.known_failpoints = known_failpoints
 
     @classmethod
     def for_package(cls, pkg_dir: str, root: str = None,
@@ -34,6 +37,7 @@ class LintConfig:
         """Build catalogs by PARSING the package's registries."""
         root = root or os.path.dirname(os.path.abspath(pkg_dir))
         known_errors = known_sysvars = error_dups = None
+        known_failpoints = None
         epath = os.path.join(pkg_dir, "errors.py")
         if os.path.exists(epath):
             with open(epath, "r", encoding="utf-8") as f:
@@ -42,9 +46,14 @@ class LintConfig:
         if os.path.exists(spath):
             with open(spath, "r", encoding="utf-8") as f:
                 known_sysvars = parse_sysvar_catalog(f.read())
+        fpath = os.path.join(pkg_dir, "utils", "failpoint_sites.py")
+        if os.path.exists(fpath):
+            with open(fpath, "r", encoding="utf-8") as f:
+                known_failpoints = parse_failpoint_registry(f.read())
         return cls(root=root, baseline=baseline, enabled=enabled,
                    known_errors=known_errors,
-                   known_sysvars=known_sysvars, error_dups=error_dups)
+                   known_sysvars=known_sysvars, error_dups=error_dups,
+                   known_failpoints=known_failpoints)
 
     def rules(self):
         out = []

@@ -6,3 +6,4 @@ from . import hygiene      # noqa: F401
 from . import codes        # noqa: F401
 from . import hostsync     # noqa: F401
 from . import imports      # noqa: F401
+from . import failpoints   # noqa: F401

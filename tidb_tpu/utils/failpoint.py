@@ -22,6 +22,10 @@ chain with '->' and run in order on each hit:
     nth:K                gate: only the first K hits of this failpoint
                          run the remaining terms (hit K+1 onward is a
                          no-op) — 'fail twice then succeed' chaos shape
+    after:K              gate: the first K hits are no-ops, terms run
+                         from hit K+1 onward — 'crash at the Nth
+                         checkpoint' chaos shape (ddl_smoke mid-reorg
+                         seams)
     prob:P               gate: each hit runs the remaining terms with
                          probability P (0..1). The RNG is seeded from
                          TIDB_TPU_FAILPOINT_SEED + the spec text, so a
@@ -77,6 +81,7 @@ def _compile_action(spec: str):
     env specs (a worker must not die to a bad chaos spec)."""
     steps = []
     limit = None
+    skip = 0
     for part in spec.split("->"):
         part = part.strip()
         if not part:
@@ -97,6 +102,8 @@ def _compile_action(spec: str):
             steps.append(("prob", p))
         elif low.startswith("nth:"):
             limit = int(part[4:])
+        elif low.startswith("after:"):
+            skip = int(part[6:])
         else:
             raise ValueError(f"unknown failpoint action '{part}'")
     hits = [0]
@@ -111,6 +118,8 @@ def _compile_action(spec: str):
     def cb(*_args):
         hits[0] += 1
         if limit is not None and hits[0] > limit:
+            return None
+        if hits[0] <= skip:
             return None
         for kind, arg in steps:
             if kind == "prob":

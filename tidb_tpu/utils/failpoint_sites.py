@@ -1,0 +1,123 @@
+"""Failpoint site registry: every `failpoint.inject("<name>")` seam in
+tidb_tpu/ with the crash contract it exercises.
+
+The chaos gates ENUMERATE their seams from this registry
+(scripts/crash_smoke.py documents its cases against it;
+scripts/ddl_smoke.py drives DDL_SITES directly), and tpulint's
+`failpoint-site-registry` rule fails the strict gate when an inject
+site in the package is missing here — a crash seam can't silently
+drift away from the gates, and a registry entry documents what a
+kill -9 at that point must recover to.
+
+Ad-hoc names in tests/ (fixture failpoints) are exempt: the rule is
+scoped to tidb_tpu/.
+"""
+from __future__ import annotations
+
+# name -> (module, what a crash/error injected here must recover to)
+SITES: dict[str, str] = {
+    # ---- transaction commit seams (storage/; crash_smoke) -------------
+    "2pc-prewrite-done": (
+        "storage/mvcc.py: after every prewrite lock is in place — "
+        "recovery must resolve the locks away (LOST)"),
+    "2pc-commit-before-wal": (
+        "storage/mvcc.py: commit chosen, frame not appended — LOST"),
+    "2pc-commit-after-wal": (
+        "storage/mvcc.py: frame appended but (group commit) not yet "
+        "covered by an fsync — LOST, never acked"),
+    "commit-durable": (
+        "storage/mvcc.py: past the covering fsync — COMMITTED after "
+        "checkpoint+WAL replay"),
+    "1pc-before-wal": (
+        "storage/mvcc.py: 1PC before the frame — LOST"),
+    "async-commit-prewrite-durable": (
+        "storage/txn.py: async-commit point crossed (durable "
+        "prewrite) — COMMITTED via resolver finalize"),
+    "group-commit-leader": (
+        "storage/wal.py: leader collected the batch, fsync not issued "
+        "— every parked committer LOST, never ack-then-lose"),
+    # ---- online-DDL job seams (owner/ddl_runner.py; ddl_smoke) --------
+    "ddl-job-enqueued": (
+        "owner/ddl_runner.py: job row durable, ladder not started — "
+        "restart resumes the job from QUEUEING to PUBLIC"),
+    "ddl-index-delete-only": (
+        "owner/ddl_runner.py: ADD INDEX committed DELETE_ONLY — "
+        "resume re-enters the ladder at the recorded state"),
+    "ddl-index-write-only": (
+        "owner/ddl_runner.py: ADD INDEX committed WRITE_ONLY — resume"),
+    "ddl-index-write-reorg": (
+        "owner/ddl_runner.py: ADD INDEX committed WRITE_REORG — "
+        "resume runs the backfill"),
+    "ddl-backfill-checkpoint": (
+        "owner/ddl_runner.py: a backfill batch + its checkpoint "
+        "committed — resume continues at the recorded handle range, "
+        "not row 0"),
+    "ddl-pre-public": (
+        "owner/ddl_runner.py: backfill complete, PUBLIC not committed "
+        "— resume publishes"),
+    "ddl-rollback-step": (
+        "owner/ddl_runner.py: one reverse-ladder step committed — "
+        "restart finishes the rollback to clean absence"),
+    "ddl-drop-write-only": (
+        "owner/ddl_runner.py: DROP INDEX committed WRITE_ONLY — "
+        "resume continues the drop"),
+    "ddl-drop-delete-only": (
+        "owner/ddl_runner.py: DROP INDEX committed DELETE_ONLY (past "
+        "the cancel point of no return) — resume rolls forward"),
+    "ddl-drop-before-remove": (
+        "owner/ddl_runner.py: before the removal txn — resume removes "
+        "meta + registers the delete-range"),
+    "ddl-reorg-before-swap": (
+        "owner/ddl_runner.py: EXCHANGE PARTITION / MODIFY COLUMN "
+        "before the single swap txn — resume re-runs the whole "
+        "handler (nothing applied) or finds the job synced"),
+    "ddl-delete-range": (
+        "owner/ddl_runner.py: delete-range record pending — restart "
+        "purges the index key range (no orphaned index KV)"),
+    "ddl-dist-barrier": (
+        "cluster/coordinator.py: a distributed ladder barrier "
+        "completed on every worker — a coordinator restart must abort "
+        "the recorded job on the workers (no leaked ladder state)"),
+    # ---- device / copr seams (chaos_smoke, mem_smoke) -----------------
+    "device_guard/fused/kernel": (
+        "copr/pipeline.py: fused-kernel dispatch — injected device "
+        "errors must retry/degrade host-identical"),
+    # ---- DML / import seams -------------------------------------------
+    "mutation-corrupt-index": (
+        "executor/table_rt.py: test hook corrupting derived index "
+        "datums — the mutation checker must refuse the write"),
+    "import-crash-after-chunk": (
+        "executor/importer.py: IMPORT INTO committed a chunk — "
+        "restart resumes from the chunk checkpoint"),
+    # ---- cluster / cdc seams ------------------------------------------
+    "cluster/rpc": (
+        "cluster/coordinator.py: before every worker RPC send — "
+        "conn_reset must retry/reconnect"),
+    "cdc-poll": (
+        "cdc/changefeed.py: worker poll loop — injected errors "
+        "backoff, hard kills resume from checkpoint-ts"),
+    "cdc-emit": (
+        "cdc/changefeed.py: before sink emission — at-least-once "
+        "redelivery after checkpoint resume"),
+}
+
+# the seams scripts/ddl_smoke.py kills at (ordered; each is a child
+# process kill -9 case × concurrent DML load)
+DDL_SITES = (
+    "ddl-job-enqueued",
+    "ddl-index-delete-only",
+    "ddl-index-write-only",
+    "ddl-index-write-reorg",
+    "ddl-backfill-checkpoint",
+    "ddl-pre-public",
+    "ddl-rollback-step",
+    "ddl-drop-write-only",
+    "ddl-drop-delete-only",
+    "ddl-drop-before-remove",
+    "ddl-delete-range",
+    "ddl-reorg-before-swap",
+)
+
+
+def known_sites() -> frozenset:
+    return frozenset(SITES)

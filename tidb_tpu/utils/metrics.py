@@ -821,6 +821,19 @@ SPILLS = REGISTRY.counter(
     "half-quota threshold — the flat sort_spill_count/agg_spill_count/"
     "join_spill_count inc_metric counters stay as compat mirrors)",
     ("operator",))
+DDL_JOBS = REGISTRY.counter(
+    "tidb_tpu_ddl_job_total",
+    "Durable online-DDL job state transitions by job type and state "
+    "entered (queueing/running/cancelling/rollingback/synced/"
+    "cancelled; owner/ddl_runner.py — synced and cancelled are the "
+    "terminal outcomes, everything else is in-flight)",
+    ("type", "state"))
+DDL_BACKFILL = REGISTRY.gauge(
+    "tidb_tpu_ddl_backfill_rows",
+    "Reorg backfill progress of the currently running DDL job by stat "
+    "(done=rows whose index entries committed, total=live rows at job "
+    "start; done resumes from the durable checkpoint after a restart)",
+    ("stat",))
 MEM_PRESSURE = REGISTRY.counter(
     "tidb_tpu_mem_pressure_total",
     "Memory-pressure protocol outcomes (evict=resident HBM entries "
