@@ -1,14 +1,18 @@
 #!/usr/bin/env python
 """Replication soak (round-5 verdict next #8): a 3-worker WAL chain
 under sustained concurrent commit load; kill -9 each worker once
-mid-workload; verify ZERO acked-transaction loss and record commit
+mid-workload with MANUAL recovery; then a FAILOVER phase — kill a
+primary mid-load with heartbeat supervision engaged, the monitor runs
+the fenced failover (epoch bump + follower-log promotion) on its own.
+Verify ZERO acked-transaction loss across all phases and record commit
 latency percentiles (the sync ship runs inside the commit hook — its
 cost must be measured, not assumed).
 
 Writes REPLICATION_SOAK.json:
   {"seconds": N, "acked": N, "lost": 0, "kills": 3,
    "commit_ms": {"p50": ..., "p99": ..., "max": ...},
-   "commit_ms_degraded": {...}}   # latency while a follower is down
+   "commit_ms_degraded": {...},   # latency while a follower is down
+   "failover": {"kills": 1, "detect_promote_s": ..., "epoch": N}}
 
 Usage: python scripts/soak_replication.py [seconds-per-phase]
 """
@@ -92,6 +96,26 @@ def main():
         kill_spans.append((t0, time.time()))
         print(f"# recovered slot {victim} in "
               f"{time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+    # ---- failover phase: supervised kill, the monitor promotes --------
+    mon = cl.start_supervision(interval_s=0.25, suspect_after_s=0.6,
+                               down_after_s=1.5)
+    time.sleep(phase_s / 2)
+    t0 = time.time()
+    port = cl.workers[0].port
+    proc = next(p for p in procs if p.poll() is None and
+                _port_of(p, port))
+    f0 = mon.failovers
+    proc.kill()
+    proc.wait(timeout=30)
+    print(f"# failover phase: killed slot 0 (port {port}), "
+          f"supervision engaged", file=sys.stderr, flush=True)
+    while mon.failovers == f0 and time.time() - t0 < 90:
+        time.sleep(0.1)
+    assert mon.failovers > f0, "monitor never promoted the follower"
+    detect_promote_s = time.time() - t0
+    kill_spans.append((t0, time.time()))
+    print(f"# fenced failover in {detect_promote_s:.1f}s "
+          f"(epoch {cl.epoch})", file=sys.stderr, flush=True)
     time.sleep(phase_s / 2)
     stop.set()
     for t in threads:
@@ -122,6 +146,9 @@ def main():
         "commit_ms_degraded": {"p50": pct(in_kill, 0.50),
                                "p99": pct(in_kill, 0.99),
                                "n": len(in_kill)},
+        "failover": {"kills": 1,
+                     "detect_promote_s": round(detect_promote_s, 2),
+                     "epoch": cl.epoch},
     }
     cl.stop()
     for p in procs:

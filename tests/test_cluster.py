@@ -353,16 +353,29 @@ def test_rpc_transport_retry_chaos(cluster):
         failpoint.disable_all()
 
 
-def test_rpc_nonidempotent_never_retries(cluster):
-    """A non-idempotent op (load_sql executes before the ack) must
-    surface the transport error instead of blindly re-sending."""
+def test_rpc_nonidempotent_retries_exactly_once(cluster):
+    """A non-idempotent op (load_sql executes before the ack) IS
+    retried now — every request carries a (request_id, epoch) stamp
+    and the worker's dedup window answers a reply-lost retry from
+    cache instead of re-executing, so the retry is safe and the apply
+    stays exactly-once (supervised-RPC contract, docs/ROBUSTNESS.md
+    "Cluster fault tolerance")."""
     from tidb_tpu.utils import failpoint
-    failpoint.enable("cluster/rpc", "nth:1->error:conn_reset")
+    cluster.ddl("create table nid (a int primary key)")
+    # reply lost AFTER execution: the retried frame must be answered
+    # from the dedup window — a re-execute would hit duplicate-key.
+    # The sleep lets the worker finish + cache before the drop, making
+    # the dedup-flag assertion deterministic.
+    failpoint.enable("cluster/net/recv",
+                     "nth:1->sleep:300->error:conn_reset")
     try:
-        with pytest.raises(ConnectionError):
-            cluster.workers[0].call({"op": "load_sql", "sql": ""})
+        out, _ = cluster.workers[0].call(
+            {"op": "load_sql", "sqls": ["insert into nid values (1)"]})
     finally:
         failpoint.disable_all()
+    assert out.get("dedup") is True
+    rows = cluster.query("select count(*) from nid")
+    assert rows == [(1,)]
     assert cluster.tso() > 0            # transport healthy afterwards
 
 

@@ -199,6 +199,94 @@ def test_commit_latency_under_replication(cluster3):
     assert total == len(lat)
 
 
+def _inproc_worker(port=0, serve=False):
+    import threading
+    from tidb_tpu.cluster.worker import WorkerServer
+    w = WorkerServer(port)
+    if serve:
+        threading.Thread(target=w.serve_forever, daemon=True).start()
+    return w
+
+
+def test_degrade_reconnect_reseed_caught_up():
+    """Direct tier-1 coverage of the degraded-replication seams
+    (_enter_degraded_locked / _try_reconnect_locked /
+    _seed_follower_locked): a ship failure degrades WITHOUT losing the
+    frame, later commits keep queueing, and the reconnect re-seeds the
+    follower to an exactly-caught-up log (reset + full history + the
+    backlog, no duplicates)."""
+    from tidb_tpu.storage.wal import decode_frame_payload
+    from tidb_tpu.utils import failpoint
+    follower = _inproc_worker(serve=True)
+    primary = _inproc_worker()
+    primary._set_follower(follower.port, primary=0)
+    primary.sess.execute("create table dg (a int primary key, b int)")
+    primary.sess.execute("insert into dg values (1, 10)")
+    assert len(follower._replica.get(0, [])) == 1
+    # ship failure -> degraded: the commit still acks, the frame lands
+    # in the backlog, the follower socket is torn down
+    failpoint.enable("cluster/net/send", "error:conn_reset")
+    try:
+        primary.sess.execute("insert into dg values (2, 20)")
+    finally:
+        failpoint.disable_all()
+    assert primary._follower_sock is None
+    assert len(primary._unshipped) == 1
+    # still degraded (reconnect backoff window): commits keep queueing
+    primary.sess.execute("insert into dg values (3, 30)")
+    assert len(primary._unshipped) == 2
+    # backoff expired: the next commit reconnects and re-seeds — the
+    # follower log is RESET and rebuilt from the full shipped history
+    # plus the backlog, so it holds every frame exactly once
+    primary._reconnect_after = 0.0
+    primary.sess.execute("insert into dg values (4, 40)")
+    assert primary._follower_sock is not None
+    assert primary._unshipped == []
+    frames = follower._replica.get(0, [])
+    assert len(frames) == 4 == len(primary._shipped)
+    assert [bytes(f) for f in frames] == \
+        [bytes(f) for f in primary._shipped]
+    # promotable: frames decode in strictly increasing commit order
+    ts = [decode_frame_payload(f)[0] for f in frames]
+    assert ts == sorted(ts) and len(set(ts)) == 4
+    primary._stop.set()
+    follower._stop.set()
+    try:
+        follower._sock.close()
+    except OSError:
+        pass
+
+
+def test_stop_drains_unshipped_backlog():
+    """Satellite: a clean shutdown must not present as acked loss —
+    the stop handshake flushes the degraded-mode WAL backlog to the
+    follower before the listener closes."""
+    from tidb_tpu.cluster.coordinator import _WorkerClient
+    from tidb_tpu.utils import failpoint
+    follower = _inproc_worker(serve=True)
+    primary = _inproc_worker(serve=True)
+    primary._set_follower(follower.port, primary=0)
+    primary.sess.execute("create table sd (a int primary key)")
+    primary.sess.execute("insert into sd values (1)")
+    failpoint.enable("cluster/net/send", "error:conn_reset")
+    try:
+        primary.sess.execute("insert into sd values (2)")
+    finally:
+        failpoint.disable_all()
+    assert len(primary._unshipped) == 1     # acked, degraded, queued
+    cli = _WorkerClient(primary.port)
+    out, _ = cli.call({"op": "stop"}, retries=0)
+    # the drain flushed the backlog before the close
+    assert out.get("unshipped") == 0
+    frames = follower._replica.get(0, [])
+    assert len(frames) == 2                 # nothing lost on shutdown
+    follower._stop.set()
+    try:
+        follower._sock.close()
+    except OSError:
+        pass
+
+
 def test_replicated_fragment_query_completes_after_kill(cluster):
     """End-to-end: sharded data + aggregation fan-out; the primary of
     shard 0 dies mid-workload; query_agg recovers it from the

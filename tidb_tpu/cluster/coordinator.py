@@ -6,71 +6,133 @@ the RPC seam, and merges the returned partials with the same final-agg
 machinery the single-process engine uses."""
 from __future__ import annotations
 
+import os
 import socket
+import threading
+import time
+import uuid
 
 import numpy as np
 
-from .rpc import send_msg, recv_msg, deserialize_partials
+from .rpc import (send_msg, recv_msg, deserialize_partials,
+                  ClusterTransportError)
+from ..codec.tablecodec import meta_key
+from ..errors import ClusterEpochStaleError
+from ..utils import env_int
+
+_K_CLUSTER_EPOCH = meta_key(b"ClusterEpoch")
 
 
 class _WorkerClient:
-    def __init__(self, port):
-        import threading
+    """Supervised RPC client (docs/ROBUSTNESS.md "Cluster fault
+    tolerance"; reference store/driver/backoff + copr region retry).
+
+    Every request is stamped with a (request_id, cluster_epoch) pair:
+    the worker's dedup window answers a reply-lost retry from cache
+    instead of re-executing, so EVERY op — including non-idempotent
+    ones like load_sql, DDL ladder steps and dxf payloads — retries
+    safely. Transport errors are classified through
+    device_guard.classify (torn frames arrive as ClusterTransportError
+    -> "transient"), retried with exponential backoff inside a
+    per-call deadline, and counted against a per-worker circuit
+    breaker that fails fast while open. Replies are correlated by
+    request id, so a duplicated frame's extra reply can never shift
+    the reply stream. Chaos: failpoint 'cluster/rpc' fires before
+    every attempt; the cluster/net/* seams live inside
+    send_msg/recv_msg."""
+
+    def __init__(self, port, epoch_fn=None):
         self.port = port
+        self.epoch_fn = epoch_fn       # () -> coordinator cluster epoch
         # one socket per worker: concurrent callers (dxf_run fans out
         # per-SUBTASK threads) must serialize send+recv or interleave
         # each other's frames
         self._call_mu = threading.Lock()
+        self._rid_prefix = uuid.uuid4().hex[:12]
+        self._rid_seq = 0
+        from ..utils.device_guard import CircuitBreaker
+        self.breaker = CircuitBreaker(
+            threshold=env_int("TIDB_TPU_CLUSTER_BREAKER_THRESHOLD", 8),
+            cooldown_s=float(os.environ.get(
+                "TIDB_TPU_CLUSTER_BREAKER_COOLDOWN_S", "5")))
         self._connect()
 
     def _connect(self):
         self.sock = socket.create_connection(("127.0.0.1", self.port),
                                              timeout=60)
 
-    # ops safe to blindly re-send after a reconnect: reads/TSO are
-    # idempotent, prewrite/commit are idempotent per start_ts
-    # (Percolator). load_sql/load_shard EXECUTE on the worker before
-    # the ack — a re-send would double rows or replay DDL, so they
-    # never auto-retry.
-    _IDEMPOTENT = {"partial", "query", "tso", "prewrite", "commit",
-                   "table_rows", "lease", "spmd_frag", "spmd_shuffle"}
+    def _recv_reply(self, rid, op):
+        """Read replies until one correlates to `rid`. A stale reply
+        (the answer to a duplicated earlier frame) is discarded — it
+        must never be delivered as the answer to a later call."""
+        for _ in range(8):
+            out, arrs = recv_msg(self.sock, op=op)
+            r = out.get("rid")
+            if r is None or r == rid:
+                return out, arrs
+        raise ClusterTransportError(
+            f"no reply correlated to request {rid} (op {op})")
 
-    def call(self, msg, arrays=None, retries=2):
-        """RPC with reconnect + exponential backoff on transport errors
-        (reference store/driver/backoff + copr region retry; the
-        backoff/jitter policy is shared with the device supervision
-        layer — utils/device_guard). A worker that stays unreachable
-        raises to the caller, which may replace it
-        (Cluster._recover_worker). Chaos: failpoint 'cluster/rpc' fires
-        before every send (inject conn_reset to exercise the retry)."""
-        import time
+    def call(self, msg, arrays=None, retries=None, deadline_s=None):
         from ..utils import failpoint
         from ..utils import metrics as _metrics
-        from ..utils.device_guard import backoff_delay
+        from ..utils.device_guard import (backoff_delay, classify,
+                                          RETRYABLE)
         op = str(msg.get("op"))
-        if msg.get("op") not in self._IDEMPOTENT:
-            retries = 0
+        if retries is None:
+            retries = env_int("TIDB_TPU_CLUSTER_RPC_RETRIES", 4)
+        if deadline_s is None:
+            deadline_s = float(os.environ.get(
+                "TIDB_TPU_CLUSTER_RPC_DEADLINE_S", "60"))
+        if not self.breaker.allow():
+            _metrics.CLUSTER_RPC.labels(op, "breaker_open").inc()
+            raise ClusterTransportError(
+                f"worker {self.port} circuit breaker open (op {op})")
         with self._call_mu:
-            for attempt in range(retries + 1):
+            self._rid_seq += 1
+            rid = f"{self._rid_prefix}:{self._rid_seq}"
+            req = dict(msg)
+            req["rid"] = rid
+            if self.epoch_fn is not None:
+                req["epoch"] = self.epoch_fn()
+            deadline = time.monotonic() + deadline_s
+            attempt = 0
+            while True:
                 try:
                     failpoint.inject("cluster/rpc")
                     t0 = time.perf_counter()
-                    send_msg(self.sock, msg, arrays)
-                    out, arrs = recv_msg(self.sock)
+                    send_msg(self.sock, req, arrays, op=op)
+                    out, arrs = self._recv_reply(rid, op)
                     _metrics.RPC_SECONDS.labels(op).observe(
                         time.perf_counter() - t0)
+                    self.breaker.record_success()
                     break
-                except (ConnectionError, OSError):
-                    if attempt == retries:
+                except (ConnectionError, OSError) as exc:
+                    err_class = classify(exc)
+                    attempt += 1
+                    self.breaker.record_failure()
+                    delay = backoff_delay(attempt - 1)
+                    if err_class not in RETRYABLE or attempt > retries \
+                            or time.monotonic() + delay > deadline:
+                        _metrics.CLUSTER_RPC.labels(
+                            op, "transport_error").inc()
                         raise
                     _metrics.RPC_RETRIES.labels(op).inc()
-                    time.sleep(backoff_delay(attempt))
+                    time.sleep(delay)
                     try:
-                        self._connect()
-                    except OSError:
+                        self._connect()     # fresh stream: no stale
+                    except OSError:         # half-frames or replies
                         continue
+        if out.get("dedup"):
+            _metrics.CLUSTER_RPC_DEDUP.labels(op).inc()
+        if out.get("err_kind") == "stale_epoch":
+            _metrics.CLUSTER_RPC.labels(op, "stale_epoch").inc()
+            raise ClusterEpochStaleError(
+                "%s", out.get("err", "stale cluster epoch"))
         if "err" in out:
+            _metrics.CLUSTER_RPC.labels(op, "app_error").inc()
             raise RuntimeError(out["err"])
+        _metrics.CLUSTER_RPC.labels(op, "ok").inc()
         return out, arrs
 
 
@@ -80,13 +142,18 @@ class Cluster:
     def __init__(self, ports, spawn_worker=None, regions=None,
                  data_dir=None):
         from ..session import new_store, Session
-        self.workers = [_WorkerClient(p) for p in ports]
+        # cluster epoch: bumped (and persisted in the coordinator's
+        # meta namespace) by every fenced failover; every client call
+        # stamps it, every worker rejects mismatches
+        self.epoch = 0
+        self._topo_mu = threading.RLock()
+        self.workers = [self._client(p) for p in ports]
         # region label per worker (PD store labels); None = unlabeled
         self.worker_regions = list(regions) if regions else None
         # local schema-only domain: plans are built here, data lives on
         # the workers. With data_dir the domain is durable, so the
-        # distributed-DDL job records (add_index_distributed) survive a
-        # coordinator restart and resume_ddl_jobs can abort cleanly.
+        # distributed-DDL job records (add_index_distributed) AND the
+        # cluster epoch survive a coordinator restart.
         self.domain = new_store(data_dir)
         self.sess = Session(self.domain)
         self.sess.vars.current_db = "test"
@@ -98,10 +165,195 @@ class Cluster:
         self._ddl_log: list = []
         self._loads: list = []             # [(table, csv_path)]
         self._replicated = False           # WAL chain active
+        self._follower_port: dict = {}     # slot -> its follower's port
+        self._deposed: dict = {}           # old-primary port -> slot
+        self._standbys: dict = {}          # port -> demoted follower
+        self._aux_clients: dict = {}       # port -> cached ad-hoc client
+        self._monitor = None
+        self._load_epoch()
+        if self.epoch:
+            # durable coordinator restart: the persisted epoch outlives
+            # the (fresh, epoch-0) worker fleet — hand it out before
+            # any stamped data op is rejected as a mismatch
+            for w in self.workers:
+                try:
+                    w.call({"op": "set_epoch"})
+                except (OSError, RuntimeError):
+                    pass
         # a live distributed job found at construction = a previous
         # coordinator died mid-reorg: abort it on the workers NOW,
         # before any query can observe leaked ladder state
         self.resume_ddl_jobs()
+
+    # ---- epoch / supervision -------------------------------------------
+
+    def _client(self, port) -> _WorkerClient:
+        return _WorkerClient(port, epoch_fn=lambda: self.epoch)
+
+    def _client_for_port(self, port) -> _WorkerClient:
+        for w in self.workers:
+            if w.port == port:
+                return w
+        if port in self._standbys:
+            return self._standbys[port]
+        # cache ad-hoc clients (deposed/rejoining peers): each one owns
+        # a live socket, and failover/recovery paths look ports up
+        # repeatedly — constructing a fresh client per lookup would
+        # leak a connection per failover
+        cli = self._aux_clients.get(port)
+        if cli is None:
+            cli = self._client(port)
+            self._aux_clients[port] = cli
+        return cli
+
+    def _load_epoch(self):
+        txn = self.domain.storage.begin()
+        try:
+            v = txn.get(_K_CLUSTER_EPOCH)
+        finally:
+            txn.rollback()
+        if v is not None:
+            self.epoch = int(v)
+
+    def _persist_epoch(self):
+        # the domain runner's shared retrying meta-txn wrapper RAISES
+        # on conflict exhaustion — a silent fall-through would leave a
+        # bumped epoch in memory only, and a coordinator restart would
+        # reload + rebroadcast the stale value against newer-epoch
+        # workers (cluster-wide 9010 with no repair path)
+        self.domain.ddl_jobs._retry_txn(
+            lambda m: m.txn.set(_K_CLUSTER_EPOCH,
+                                str(self.epoch).encode()),
+            what="cluster epoch")
+
+    def start_supervision(self, interval_s=0.5, suspect_after_s=1.5,
+                          down_after_s=3.5, auto_failover=True,
+                          auto_reintegrate=True):
+        """Start the heartbeat monitor (cluster/supervision.py): lag
+        gauges, the suspect->down state machine, automatic fenced
+        failover of down workers, and rejoin-demotion of deposed
+        primaries that come back. Opt-in: tests that kill workers and
+        drive _recover_worker by hand stay deterministic without it."""
+        from .supervision import ClusterMonitor
+        if self._monitor is not None:
+            return self._monitor
+        self._monitor = ClusterMonitor(
+            self, interval_s=interval_s,
+            suspect_after_s=suspect_after_s, down_after_s=down_after_s,
+            auto_failover=auto_failover,
+            auto_reintegrate=auto_reintegrate)
+        self._monitor.start()
+        # the cluster_health vtable reads the monitor off the domain
+        self.domain.cluster_monitor = self._monitor
+        return self._monitor
+
+    def mark_down(self, slot: int):
+        """Operator/test seam: declare a worker dead (the partitioned-
+        primary case — the process may well still be running) and run
+        the fenced failover for its slot NOW."""
+        return self._failover(slot, reason="marked down")
+
+    def _failover(self, i: int, reason: str = "down"):
+        """Fenced failover of slot i (reference: raft leader election
+        collapsed to coordinator-driven promotion): bump + persist the
+        cluster epoch, move the slot's WAL-chain follower to the new
+        epoch FIRST (from that instant any late ship from the old
+        primary is rejected — it can never ack another write), then
+        promote the follower's shipped log onto a replacement process
+        and repair the chain. The deposed primary's port is remembered:
+        if it ever answers again the monitor demotes it to a follower
+        (reintegrate)."""
+        from ..utils import metrics as _metrics
+        from ..utils.logutil import log
+        with self._topo_mu:
+            old = self.workers[i]
+            self.epoch += 1
+            self._persist_epoch()
+            try:
+                n = len(self.workers)
+                fport = self._follower_port.get(
+                    i, self.workers[(i + 1) % n].port)
+                fcli = self._client_for_port(fport)
+                # fence point: the follower holding slot i's log moves
+                # to the new epoch BEFORE its log is read for promotion
+                fcli.call({"op": "set_epoch"})
+                for w in self.workers:
+                    if w is old or w is fcli:
+                        continue
+                    try:
+                        w.call({"op": "set_epoch"})
+                    except (OSError, RuntimeError):
+                        pass    # straggler: epoch-mismatch rejected
+                        #         until the monitor re-broadcasts
+                w = self._recover_worker(i)
+                if w is None:
+                    raise ClusterTransportError(
+                        f"failover of worker slot {i} impossible: "
+                        f"no spawn_worker")
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except BaseException:
+                # the epoch is already bumped + persisted: hand it to
+                # every reachable worker before surfacing the failure,
+                # or one dead follower turns a single-slot problem into
+                # a cluster-wide epoch-mismatch outage (with no monitor
+                # there is no re-broadcast to repair it)
+                for w in self.workers:
+                    if w is old:
+                        continue
+                    try:
+                        w.call({"op": "set_epoch"})
+                    except (OSError, RuntimeError):
+                        pass
+                raise
+            self._deposed[old.port] = i
+            _metrics.CLUSTER_FAILOVERS.inc()
+            log("warn", "cluster_failover", slot=i, reason=reason,
+                epoch=self.epoch, old_port=old.port, new_port=w.port)
+            return w
+
+    def reintegrate(self, port: int):
+        """Rejoin protocol: a deposed primary answered a heartbeat
+        again. Demote it (sticky fence — it may hold writes the
+        cluster never acked), then point the slot's CURRENT primary at
+        it as the WAL-chain follower: set_follower re-seeds the full
+        shipped history, so the rejoiner catches up from the new
+        primary's WAL tail and serves as a follower from then on."""
+        from ..utils.logutil import log
+        with self._topo_mu:
+            slot = self._deposed.get(port)
+            if slot is None:
+                return None
+            cli = self._client_for_port(port)
+            cli.call({"op": "demote"})
+            self.workers[slot].call(
+                {"op": "set_follower", "port": port, "primary": slot})
+            self._follower_port[slot] = port
+            self._standbys[port] = cli
+            del self._deposed[port]
+            log("info", "cluster_rejoin_demoted", slot=slot, port=port,
+                epoch=self.epoch)
+            return cli
+
+    def _wait_replacement(self, i: int, old, timeout_s: float = 20.0):
+        """Under supervision, a caller that hit a dead worker waits for
+        the monitor's failover to swap the slot instead of racing its
+        own _recover_worker against it."""
+        if self._monitor is None:
+            with self._topo_mu:
+                if self.workers[i] is not old:
+                    # a concurrent caller already replaced the slot —
+                    # recovering again would double-spawn and orphan
+                    # the first replacement
+                    return self.workers[i]
+                return self._recover_worker(i)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            cur = self.workers[i]
+            if cur is not old:
+                return cur
+            time.sleep(0.1)
+        return None
 
     def _job_txn(self, fn):
         """One meta txn against the coordinator's (durable) domain —
@@ -256,50 +508,73 @@ class Cluster:
             w.call({"op": "set_follower",
                     "port": self.workers[(i + 1) % n].port,
                     "primary": i})
+            self._follower_port[i] = self.workers[(i + 1) % n].port
         self._replicated = True
 
     def _recover_worker(self, i):
         """Replace dead worker i: spawn a fresh process, replay the DDL
         log (same fresh-store sequence -> same table ids), then restore
         the shard data. With replication on, the data comes from the
-        follower's shipped WAL (no acked txn lost); otherwise it is
-        re-read from the durable bulk sources (BR-manifest role).
-        The recovered node then serves the same fragments."""
-        if self.spawn_worker is None:
-            return None
-        port = self.spawn_worker()
-        w = _WorkerClient(port)
-        if self._ddl_log:
-            w.call({"op": "load_sql", "sqls": list(self._ddl_log)})
-        frames = None
-        if self._replicated:
-            follower = self.workers[(i + 1) % len(self.workers)]
-            out, arrs = follower.call({"op": "wal_fetch", "primary": i})
-            if out["n"]:
-                frames = {f"f{j}": arrs[f"f{j}"]
-                          for j in range(out["n"])}
-        for table, csv_path, eligible, replicated in self._loads:
-            # loads made under replication live in the WAL frames;
-            # pre-replication loads only in the bulk source. Without
-            # frames, everything reloads from the source.
-            if i in eligible and not (replicated and frames is not None):
-                w.call({"op": "load_shard", "table": table,
-                        "csv": csv_path, "shard": eligible.index(i),
-                        "nshards": len(eligible)})
-        if frames is not None:
-            w.call({"op": "wal_replay", "n": len(frames)}, frames)
-        self.workers[i] = w
-        if self._replicated:
-            # repair the chain around the replacement: predecessor ships
-            # to the new process; the new process ships to its successor
+        slot's WAL-chain follower's shipped log (no acked txn lost) —
+        the ring successor by default, a reintegrated standby when the
+        monitor rewired the chain; otherwise it is re-read from the
+        durable bulk sources (BR-manifest role). The recovered node
+        then serves the same fragments."""
+        with self._topo_mu:
+            if self.spawn_worker is None:
+                return None
+            port = self.spawn_worker()
+            w = self._client(port)
+            # a fresh process is born at epoch 0: hand it the current
+            # cluster epoch before any stamped data op reaches it
+            w.call({"op": "set_epoch"})
+            if self._ddl_log:
+                w.call({"op": "load_sql", "sqls": list(self._ddl_log)})
+            frames = None
             n = len(self.workers)
-            self.workers[(i - 1) % n].call(
-                {"op": "set_follower", "port": w.port,
-                 "primary": (i - 1) % n})
-            w.call({"op": "set_follower",
-                    "port": self.workers[(i + 1) % n].port,
-                    "primary": i})
-        return w
+            if self._replicated:
+                fport = self._follower_port.get(
+                    i, self.workers[(i + 1) % n].port)
+                follower = self._client_for_port(fport)
+                out, arrs = follower.call(
+                    {"op": "wal_fetch", "primary": i})
+                if out["n"]:
+                    frames = {f"f{j}": arrs[f"f{j}"]
+                              for j in range(out["n"])}
+            for table, csv_path, eligible, replicated in self._loads:
+                # loads made under replication live in the WAL frames;
+                # pre-replication loads only in the bulk source. Without
+                # frames, everything reloads from the source.
+                if i in eligible and \
+                        not (replicated and frames is not None):
+                    w.call({"op": "load_shard", "table": table,
+                            "csv": csv_path, "shard": eligible.index(i),
+                            "nshards": len(eligible)})
+            if frames is not None:
+                w.call({"op": "wal_replay", "n": len(frames)}, frames)
+            if self._replicated:
+                # install the replacement's ship hook BEFORE exposing
+                # it to writers: swapping it into self.workers first
+                # opened a window where a commit was acked with NO
+                # follower configured — an acked write that existed on
+                # one process only, silently lost the next time that
+                # slot died (found by scripts/cluster_smoke.py's
+                # ledger: consecutive same-slot keys lost in pairs)
+                fport = self._follower_port.get(
+                    i, self.workers[(i + 1) % n].port)
+                w.call({"op": "set_follower", "port": fport,
+                        "primary": i})
+                self._follower_port[i] = fport
+            self.workers[i] = w
+            if self._replicated:
+                # repair the chain behind the replacement: the
+                # predecessor ships to the new process (its degraded
+                # backlog toward the dead port flushes in the reseed)
+                self.workers[(i - 1) % n].call(
+                    {"op": "set_follower", "port": w.port,
+                     "primary": (i - 1) % n})
+                self._follower_port[(i - 1) % n] = w.port
+            return w
 
     def tso(self, worker=0) -> int:
         out, _ = self.workers[worker].call({"op": "tso"})
@@ -327,8 +602,13 @@ class Cluster:
         def fetch(i, w):
             try:
                 return w.call({"op": "partial", "sql": sql})
-            except OSError:
-                nw = self._recover_worker(i)
+            except (OSError, ClusterEpochStaleError):
+                # dead or fenced-away worker: under supervision wait
+                # for the monitor's failover to swap the slot (racing
+                # our own recovery against it would double-spawn);
+                # otherwise recover it ourselves, then re-run ONLY this
+                # fragment
+                nw = self._wait_replacement(i, w)
                 if nw is None:
                     raise
                 return nw.call({"op": "partial", "sql": sql})
@@ -665,11 +945,19 @@ class Cluster:
         return [tuple(r) for r in out["rows"]]
 
     def stop(self):
-        for w in self.workers:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+        # drain-then-close: every worker flushes its in-flight WAL
+        # ship + degraded backlog before the listener goes down, so a
+        # clean shutdown can never present as acked loss in the soak
+        for w in list(self.workers) + list(self._standbys.values()):
             try:
                 w.call({"op": "stop"})
             except Exception:           # noqa: BLE001
                 pass
+        self._standbys.clear()
+        self._aux_clients.clear()
 
 
 class _FinalPlanView:

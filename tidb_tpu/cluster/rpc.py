@@ -6,17 +6,41 @@ bytes so partial-agg states cross hosts without base64 bloat).
 
 Frame:  u32 json_len, json, u32 n_arrays, per array:
         u32 name_len, name, u32 dtype_len, dtype, u32 data_len, data
+
+Network fault layer (docs/ROBUSTNESS.md "Cluster fault tolerance"):
+every frame write/read passes the `cluster/net/*` failpoint seams
+(registered in utils/failpoint_sites.NET_SITES) so the chaos gate can
+inject drop, delay, duplicate, one-direction partition, trickle, and
+peer-close-mid-frame in whichever process enables them. A torn frame
+(peer closed after a partial read) surfaces as ClusterTransportError —
+a CLASSIFIED retryable error (device_guard.classify -> "transient"),
+never a bare ConnectionError the supervision layer can't reason about.
 """
 from __future__ import annotations
 
 import json
 import socket
 import struct
+import time
 
 import numpy as np
 
+from ..errors import TiDBError
+from ..utils import failpoint
+from ..utils.device_guard import DeviceError
 
-def send_msg(sock: socket.socket, obj: dict, arrays: dict | None = None):
+
+class ClusterTransportError(DeviceError, ConnectionError):
+    """A cluster frame was torn, dropped, or the peer vanished mid-RPC.
+
+    Subclasses DeviceError so `device_guard.classify` maps it straight
+    to its retryable class, and ConnectionError so every existing
+    `except (ConnectionError, OSError)` transport seam (worker serve
+    loop, WAL ship degrade, coordinator recovery) still catches it."""
+    err_class = "transient"
+
+
+def _frame_bytes(obj: dict, arrays: dict | None) -> bytes:
     arrays = arrays or {}
     payload = json.dumps(obj).encode()
     out = [struct.pack("<I", len(payload)), payload,
@@ -41,31 +65,100 @@ def send_msg(sock: socket.socket, obj: dict, arrays: dict | None = None):
         out.append(dt)
         out.append(struct.pack("<I", len(raw)))
         out.append(raw)
-    sock.sendall(b"".join(out))
+    return b"".join(out)
 
 
-def _read_exact(sock, n):
+def send_msg(sock: socket.socket, obj: dict, arrays: dict | None = None,
+             op: str = ""):
+    """Write one frame, passing the net-fault seams. `op` labels the
+    fault/error messages only — it never rides the wire."""
+    data = _frame_bytes(obj, arrays)
+    # duplicate: the frame is transmitted twice (at-least-once
+    # delivery). The receiver's request-id correlation + dedup window
+    # must keep the apply exactly-once and the reply stream in sync.
+    try:
+        failpoint.inject("cluster/net/dup")
+    except TiDBError:
+        sock.sendall(data)
+    # peer-close mid-frame: a partial prefix goes out, then the
+    # connection dies. The PEER sees a torn frame; this side sees a
+    # dead socket on its next use.
+    try:
+        failpoint.inject("cluster/net/partial-close")
+    except TiDBError:
+        try:
+            sock.sendall(data[:max(1, len(data) // 3)])
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise ClusterTransportError(
+            f"injected peer close mid-frame (op {op or '?'})")
+    # trickle: the frame dribbles out in small chunks with delays —
+    # delivered intact, just slowly.
+    trickle = False
+    try:
+        failpoint.inject("cluster/net/trickle")
+    except TiDBError:
+        trickle = True
+    # drop/delay: an error action here means the frame never went out
+    # (sustained = a one-direction partition); sleep = link delay. A
+    # plain `error` action is wrapped so the drop always surfaces as a
+    # classified transport error, whatever the action spec raised.
+    try:
+        failpoint.inject("cluster/net/send")
+    except (ConnectionError, OSError):
+        raise
+    except TiDBError as e:
+        raise ClusterTransportError(
+            f"injected send drop (op {op or '?'}): {e}") from e
+    if trickle:
+        for i in range(0, len(data), 512):
+            sock.sendall(data[i:i + 512])
+            time.sleep(0.002)
+        return
+    sock.sendall(data)
+
+
+def _read_exact(sock, n, started: bool = False, op: str = ""):
+    """Read exactly n bytes. A clean close BEFORE any byte of the frame
+    is the normal end-of-stream ConnectionError (the worker serve loop
+    exits on it); a close after a partial read is a TORN frame and
+    surfaces classified retryable with the op attached."""
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
+            if started or buf:
+                raise ClusterTransportError(
+                    f"peer closed mid-frame (op {op or '?'}: "
+                    f"{len(buf)}/{n} bytes of current field)")
             raise ConnectionError("peer closed")
         buf += chunk
     return buf
 
 
-def recv_msg(sock: socket.socket):
-    (jlen,) = struct.unpack("<I", _read_exact(sock, 4))
-    obj = json.loads(_read_exact(sock, jlen))
-    (na,) = struct.unpack("<I", _read_exact(sock, 4))
+def recv_msg(sock: socket.socket, op: str = ""):
+    # reply loss: an error action here means the peer already executed
+    # the request but this side never reads the answer — the retried
+    # request must be answered from the peer's dedup window.
+    try:
+        failpoint.inject("cluster/net/recv")
+    except (ConnectionError, OSError):
+        raise
+    except TiDBError as e:
+        raise ClusterTransportError(
+            f"injected recv drop (op {op or '?'}): {e}") from e
+    (jlen,) = struct.unpack("<I", _read_exact(sock, 4, op=op))
+    obj = json.loads(_read_exact(sock, jlen, started=True, op=op))
+    (na,) = struct.unpack("<I", _read_exact(sock, 4, started=True, op=op))
     arrays = {}
     for _ in range(na):
-        (ln,) = struct.unpack("<I", _read_exact(sock, 4))
-        name = _read_exact(sock, ln).decode()
-        (ln,) = struct.unpack("<I", _read_exact(sock, 4))
-        dt = _read_exact(sock, ln).decode()
-        (ln,) = struct.unpack("<I", _read_exact(sock, 4))
-        raw = _read_exact(sock, ln)
+        (ln,) = struct.unpack("<I", _read_exact(sock, 4, True, op))
+        name = _read_exact(sock, ln, True, op).decode()
+        (ln,) = struct.unpack("<I", _read_exact(sock, 4, True, op))
+        dt = _read_exact(sock, ln, True, op).decode()
+        (ln,) = struct.unpack("<I", _read_exact(sock, 4, True, op))
+        raw = _read_exact(sock, ln, True, op)
         # dtype.str may itself contain '|' (e.g. '|b1' for bool)
         dtype_str, shape_str = dt.rsplit("|", 1)
         if dtype_str == "pyint":

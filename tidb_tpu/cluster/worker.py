@@ -20,10 +20,26 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
+from collections import deque
 
 import numpy as np
 
 from .rpc import send_msg, recv_msg, serialize_partials
+from ..errors import ClusterEpochStaleError
+
+# replies for these ops are never cached in the dedup window: they are
+# read-only/idempotent by construction (or, for tso, must stay fresh),
+# and partial/spmd replies can be megabytes of serialized agg state
+_NO_DEDUP_OPS = frozenset({"partial", "spmd_frag", "spmd_shuffle",
+                           "spmd_init", "wal_fetch", "tso",
+                           "table_rows", "lease", "ping", "drain"})
+# ops a FENCED (demoted) worker still serves: the supervision/rejoin
+# control plane plus the follower role (frame store + promotion reads)
+_FENCED_OK_OPS = frozenset({"ping", "set_epoch", "demote", "drain",
+                            "set_follower", "wal_append", "wal_reset",
+                            "wal_fetch"})
+_DEDUP_WINDOW = 1024
 
 
 class WorkerServer:
@@ -41,6 +57,34 @@ class WorkerServer:
         self._pending: dict = {}       # start_ts -> prewritten mutations
         from ..owner import LocalLeaseStore
         self._leases = LocalLeaseStore()
+        # cluster epoch + fencing (docs/ROBUSTNESS.md "Cluster fault
+        # tolerance"): the epoch moves ONLY through coordinator control
+        # ops (set_epoch/demote/set_follower). A data request whose
+        # epoch doesn't match, a WAL ship from a stale primary, or any
+        # data op on a fenced worker raises ClusterEpochStaleError —
+        # a partitioned old primary can never ack a write after
+        # failover, because its synchronous ship is rejected.
+        self.cluster_epoch = 0
+        self._fenced = False
+        # request dedup window: a supervised client retry whose
+        # original REPLY was lost is answered from here instead of
+        # re-executed (exactly-once apply under at-least-once send).
+        # rid -> ("pending", Event) while executing, ("done", out,
+        # arrays) after; FIFO-evicted at _DEDUP_WINDOW entries.
+        self._dedup: dict = {}
+        self._dedup_order: deque = deque()
+        self._dedup_mu = threading.Lock()
+        self._dedup_hits = 0
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        # ship-RPC correlation: WAL ship/reset frames carry their own
+        # request ids so a duplicated frame's extra reply can never
+        # shift the primary's reply stream (a stale buffered {ok}
+        # would make a later FAILED ship look acked = silent loss),
+        # and the follower's dedup window absorbs the duplicate append
+        import uuid as _uuid
+        self._ship_rid_prefix = "ship-" + _uuid.uuid4().hex[:10]
+        self._ship_rid_seq = 0
         # WAL replication (reference: TiKV raft log shipped to
         # followers; here a primary->follower chain assigned by the
         # coordinator). As the PRIMARY: every mvcc commit's data
@@ -92,7 +136,11 @@ class WorkerServer:
                 msg, arrays = recv_msg(conn)
                 op = msg.get("op")
                 if op == "stop":
-                    send_msg(conn, {"ok": True})
+                    # drain-then-close handshake: wait out in-flight
+                    # handlers and flush the WAL-ship backlog so a
+                    # CLEAN shutdown can never present as acked loss
+                    unshipped = self._drain()
+                    send_msg(conn, {"ok": True, "unshipped": unshipped})
                     self._stop.set()
                     try:
                         self._sock.close()
@@ -109,11 +157,36 @@ class WorkerServer:
                     except OSError:
                         pass
                     return
+                rid = msg.get("rid")
+                dedup = rid is not None and op not in _NO_DEDUP_OPS
+                if dedup:
+                    cached = self._dedup_lookup(rid)
+                    if cached is not None:
+                        out, out_arrays = cached
+                        out = dict(out)
+                        out["rid"] = rid
+                        out["dedup"] = True
+                        send_msg(conn, out, out_arrays, op=str(op))
+                        continue
+                with self._inflight_mu:
+                    self._inflight += 1
                 try:
-                    out, out_arrays = self._handle(op, msg, arrays)
-                except Exception as e:          # noqa: BLE001
-                    out, out_arrays = {"err": f"{type(e).__name__}: {e}"}, {}
-                send_msg(conn, out, out_arrays)
+                    try:
+                        out, out_arrays = self._handle(op, msg, arrays)
+                    except Exception as e:          # noqa: BLE001
+                        out = {"err": f"{type(e).__name__}: {e}"}
+                        if isinstance(e, ClusterEpochStaleError):
+                            out["err_kind"] = "stale_epoch"
+                        out_arrays = {}
+                finally:
+                    with self._inflight_mu:
+                        self._inflight -= 1
+                if dedup:
+                    self._dedup_store(rid, out, out_arrays)
+                if rid is not None:
+                    out = dict(out)
+                    out["rid"] = rid
+                send_msg(conn, out, out_arrays, op=str(op))
         except (ConnectionError, OSError):
             pass
         finally:
@@ -125,7 +198,113 @@ class WorkerServer:
             except OSError:
                 pass
 
+    # ---- request dedup window -----------------------------------------
+
+    def _dedup_lookup(self, rid):
+        """-> cached (out, arrays) when this rid already ran (waiting
+        out a still-executing first attempt), else None and the caller
+        OWNS the execution (a pending marker is in place)."""
+        with self._dedup_mu:
+            entry = self._dedup.get(rid)
+            if entry is None:
+                self._dedup[rid] = ("pending", threading.Event())
+                return None
+        if entry[0] == "pending":
+            # a concurrent retry raced the first attempt (its reply was
+            # lost mid-execution): wait for the original to finish so
+            # the op runs ONCE, then answer from its cached reply
+            entry[1].wait(timeout=60)
+            with self._dedup_mu:
+                entry = self._dedup.get(rid)
+            if entry is None or entry[0] == "pending":
+                return {"err": "dedup wait timed out"}, {}
+        with self._dedup_mu:
+            self._dedup_hits += 1
+        return entry[1], entry[2]
+
+    def _dedup_store(self, rid, out, out_arrays):
+        with self._dedup_mu:
+            old = self._dedup.get(rid)
+            self._dedup[rid] = ("done", out, out_arrays)
+            self._dedup_order.append(rid)
+            while len(self._dedup_order) > _DEDUP_WINDOW:
+                drop = self._dedup_order.popleft()
+                e = self._dedup.get(drop)
+                if e is not None and e[0] == "done":
+                    del self._dedup[drop]
+        if old is not None and old[0] == "pending":
+            old[1].set()
+
+    def _drain(self, timeout_s: float = 5.0, own: int = 0) -> int:
+        """Satellite: drain-then-close. Wait for in-flight handlers
+        (beyond the caller's own, when the caller runs inside _handle)
+        and flush any degraded-mode WAL backlog to the follower.
+        -> frames still unshipped."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._inflight_mu:
+                n = self._inflight
+            if n <= own:
+                break
+            time.sleep(0.01)
+        with self._follower_mu:
+            if self._unshipped and self._follower_sock is None:
+                self._reconnect_after = 0.0
+                self._try_reconnect_locked()
+            return len(self._unshipped)
+
     def _handle(self, op, msg, arrays):
+        # ---- epoch fencing gate ---------------------------------------
+        ep = msg.get("epoch")
+        if op in ("wal_append", "wal_reset"):
+            # a ship OR a log reset from a stale primary is the fencing
+            # backstop: the old primary's synchronous ack path dies
+            # here, so it can never ack a write after its slot failed
+            # over — and a stale primary's reconnect reseed can never
+            # WIPE the log the promoted replacement already re-seeded
+            # (an unfenced wal_reset would truncate acked history)
+            if ep is not None and ep < self.cluster_epoch:
+                raise ClusterEpochStaleError(
+                    "%s from stale primary epoch %d "
+                    "(worker at epoch %d)", op, ep, self.cluster_epoch)
+        elif op in ("set_epoch", "demote", "set_follower"):
+            # the only ops that MOVE the epoch — coordinator control
+            # plane. Data requests never adopt: a zombie would unfence
+            # itself by receiving a current-epoch write.
+            if ep is not None and ep > self.cluster_epoch:
+                self.cluster_epoch = int(ep)
+        elif op not in _FENCED_OK_OPS:
+            if ep is not None and ep != self.cluster_epoch:
+                raise ClusterEpochStaleError(
+                    "cluster epoch mismatch: request %d, worker %d "
+                    "(topology changed — refresh and re-route)",
+                    ep, self.cluster_epoch)
+            if self._fenced:
+                raise ClusterEpochStaleError(
+                    "worker fenced (demoted at epoch %d): data "
+                    "requests refused", self.cluster_epoch)
+        if op == "ping":
+            # heartbeat probe: NEVER rejects and never adopts — the
+            # monitor must be able to observe stale/fenced workers
+            with self._dedup_mu:
+                hits = self._dedup_hits
+            with self._inflight_mu:
+                infl = self._inflight
+            return {"ok": True, "epoch": self.cluster_epoch,
+                    "fenced": bool(self._fenced), "inflight": infl - 1,
+                    "dedup_hits": hits, "port": self.port,
+                    "unshipped": len(self._unshipped)}, {}
+        if op == "set_epoch":
+            return {"ok": True, "epoch": self.cluster_epoch}, {}
+        if op == "demote":
+            # rejoin protocol: a failed-over old primary is demoted —
+            # sticky fence (only process replacement clears it); it
+            # keeps serving the follower role (wal_append/wal_fetch)
+            self._fenced = True
+            return {"ok": True, "epoch": self.cluster_epoch}, {}
+        if op == "drain":
+            return {"ok": True,
+                    "unshipped": self._drain(own=1)}, {}
         if op == "load_sql":
             for sql in msg["sqls"]:
                 self.sess.execute(sql)
@@ -324,16 +503,44 @@ class WorkerServer:
             import time as _t
             payload = encode_frame_payload(commit_ts, data, _t.time())
             with self._follower_mu:
+                if self._fenced:
+                    # demoted while degraded: a fenced worker must not
+                    # keep acking into a backlog that can never flush
+                    raise ClusterEpochStaleError(
+                        "worker fenced (demoted at epoch %d): write "
+                        "refused", self.cluster_epoch)
                 if self._follower_sock is None:
                     # degraded: keep acking writes, queue the frame, and
                     # periodically retry the follower — a transient
                     # socket error must not silence replication forever
                     self._unshipped.append(payload)
                     self._try_reconnect_locked()
+                    if self._fenced:
+                        # the reconnect discovered the follower at a
+                        # NEWER epoch (slot failed over while degraded):
+                        # refuse the triggering write un-acked and drop
+                        # it from a backlog that will never flush
+                        self._unshipped.pop()
+                        raise ClusterEpochStaleError(
+                            "worker fenced (demoted at epoch %d): "
+                            "write refused", self.cluster_epoch)
                     return
                 try:
                     self._ship_locked(payload)
                     self._shipped.append(payload)
+                except ClusterEpochStaleError:
+                    # FENCED: the follower moved to a newer cluster
+                    # epoch — this worker's slot failed over while it
+                    # was partitioned. It must NOT enter degraded mode
+                    # (degraded still acks); the commit surfaces the
+                    # fence error and is never acknowledged, and every
+                    # later data request is refused up front.
+                    self._fenced = True
+                    from ..utils.logutil import log
+                    log("warn", "wal_ship_fenced",
+                        follower_port=self._follower_port,
+                        epoch=self.cluster_epoch)
+                    raise
                 except (ConnectionError, OSError, RuntimeError):
                     # RuntimeError = follower replied {err}: same
                     # degraded handling — the frame must land in the
@@ -360,7 +567,7 @@ class WorkerServer:
 
     def _try_reconnect_locked(self):
         import time as _t
-        if self._follower_port is None or \
+        if self._fenced or self._follower_port is None or \
                 _t.monotonic() < self._reconnect_after:
             return
         self._reconnect_after = _t.monotonic() + 1.0
@@ -368,20 +575,28 @@ class WorkerServer:
             self._follower_sock = socket.create_connection(
                 ("127.0.0.1", self._follower_port), timeout=5)
             self._seed_follower_locked()
-            from ..utils.logutil import log
-            log("info", "wal_replication_restored",
-                follower_port=self._follower_port)
+            if self._follower_sock is not None and not self._fenced:
+                from ..utils.logutil import log
+                log("info", "wal_replication_restored",
+                    follower_port=self._follower_port)
         except OSError:
             self._follower_sock = None
 
     def _seed_follower_locked(self):
         """Reset the follower's log for this primary and stream the full
         shipped history + any degraded-mode backlog (follower_mu held).
-        On failure the backlog stays queued and we re-enter degraded."""
+        On failure the backlog stays queued and we re-enter degraded.
+        The reset carries this primary's epoch: a follower at a newer
+        epoch rejects it, which FENCES this primary — a deposed
+        primary's reconnect must never wipe the log the promoted
+        replacement already re-seeded."""
         try:
-            send_msg(self._follower_sock,
-                     {"op": "wal_reset", "primary": self._primary_id})
-            out, _ = recv_msg(self._follower_sock)
+            out = self._ship_rpc(
+                {"op": "wal_reset", "primary": self._primary_id,
+                 "epoch": self.cluster_epoch})
+            if out.get("err_kind") == "stale_epoch":
+                raise ClusterEpochStaleError(
+                    "wal reset rejected: %s", out.get("err", ""))
             if "err" in out:
                 raise RuntimeError(out["err"])
             for payload in self._shipped:
@@ -391,6 +606,21 @@ class WorkerServer:
                 self._ship_locked(payload)
                 self._shipped.append(payload)
                 self._unshipped.pop(0)
+        except ClusterEpochStaleError:
+            # the follower moved to a newer epoch: this worker's slot
+            # failed over while it was degraded. Fence (sticky) instead
+            # of re-entering the degraded retry loop — callers observe
+            # _fenced and refuse the triggering write.
+            self._fenced = True
+            from ..utils.logutil import log
+            log("warn", "wal_ship_fenced",
+                follower_port=self._follower_port,
+                epoch=self.cluster_epoch)
+            try:
+                self._follower_sock.close()
+            except OSError:
+                pass
+            self._follower_sock = None
         except (ConnectionError, OSError, RuntimeError):
             try:
                 self._follower_sock.close()
@@ -398,12 +628,37 @@ class WorkerServer:
                 pass
             self._follower_sock = None
 
+    def _ship_rpc(self, msg: dict, arrays: dict | None = None) -> dict:
+        """One correlated request/reply on the follower socket
+        (follower_mu held): stamp a ship rid, read until the matching
+        reply, discard strays — an injected duplicate frame's extra
+        {ok} must never be consumed as the answer to a LATER (possibly
+        failed) ship. The rid also routes the duplicate through the
+        follower's dedup window instead of double-appending."""
+        self._ship_rid_seq += 1
+        rid = f"{self._ship_rid_prefix}:{self._ship_rid_seq}"
+        msg = dict(msg)
+        msg["rid"] = rid
+        op = str(msg.get("op"))
+        send_msg(self._follower_sock, msg, arrays, op=op)
+        for _ in range(8):
+            out, _ = recv_msg(self._follower_sock, op=op)
+            r = out.get("rid")
+            if r is None or r == rid:
+                return out
+        raise RuntimeError(f"no reply correlated to ship {rid} ({op})")
+
     def _ship_locked(self, payload: bytes):
-        """Send one WAL frame to the follower (follower_mu held)."""
-        send_msg(self._follower_sock, {"op": "wal_append",
-                                       "primary": self._primary_id},
-                 {"frame": np.frombuffer(payload, dtype=np.uint8)})
-        out, _ = recv_msg(self._follower_sock)
+        """Send one WAL frame to the follower (follower_mu held). The
+        frame carries this primary's cluster epoch; a follower at a
+        NEWER epoch rejects it, which fences this primary."""
+        out = self._ship_rpc(
+            {"op": "wal_append", "primary": self._primary_id,
+             "epoch": self.cluster_epoch},
+            {"frame": np.frombuffer(payload, dtype=np.uint8)})
+        if out.get("err_kind") == "stale_epoch":
+            raise ClusterEpochStaleError(
+                "wal ship rejected: %s", out.get("err", ""))
         if "err" in out:
             raise RuntimeError(f"wal replication failed: {out['err']}")
 

@@ -85,6 +85,11 @@ DDLJobCancelledError = _err("DDLJobCancelledError", 8214)
 # Device supervision (utils/device_guard): the accelerator analog of the
 # reference's TiFlash-unavailable class (errno 9012/9013 family)
 DeviceUnavailableError = _err("DeviceUnavailableError", 9013)
+# Cluster fencing (cluster/): a request or WAL ship carrying a cluster
+# epoch that does not match the worker's — the reference's TiKV
+# stale-command class (errno 9010). NOT retryable against the same
+# worker: the topology moved; refresh the epoch/topology and re-route.
+ClusterEpochStaleError = _err("ClusterEpochStaleError", 9010)
 # Privilege
 AccessDeniedError = _err("AccessDeniedError", 1045, "28000")
 PrivilegeCheckFailError = _err("PrivilegeCheckFailError", 1142, "42000")

@@ -125,6 +125,20 @@ def _gen_memory_usage(domain):
                    tr.oom_action or "cancel")
 
 
+def _gen_cluster_health(domain):
+    """Cluster supervision view (docs/ROBUSTNESS.md "Cluster fault
+    tolerance"): one row per worker slot from the coordinator's
+    heartbeat monitor — state machine position (up/suspect/down), the
+    worker's cluster epoch, its role (primary / fenced / follower /
+    deposed), heartbeat lag, in-flight handler count and dedup-window
+    hits. Empty on a domain that isn't a cluster coordinator."""
+    mon = getattr(domain, "cluster_monitor", None)
+    if mon is None:
+        return
+    for row in mon.snapshot():
+        yield row
+
+
 def _gen_metrics(domain):
     """Flat per-store counters + every typed registry sample (labels
     rendered `k="v"`), one SQL-queryable surface for both."""
@@ -535,6 +549,13 @@ VIRTUAL_DEFS = {
                            ("label", _S()), ("consumed", _I()),
                            ("max_consumed", _I()), ("quota", _I()),
                            ("oom_action", _S())), _gen_memory_usage),
+    "cluster_health": (_cols(("worker_id", _I()), ("addr", _S()),
+                             ("state", _S()), ("epoch", _I()),
+                             ("role", _S()),
+                             ("heartbeat_lag_ms", _F()),
+                             ("inflight", _I()),
+                             ("dedup_hits", _I())),
+                       _gen_cluster_health),
 }
 
 _VIRT_INFO_CACHE: dict = {}

@@ -115,6 +115,12 @@ def classify(exc) -> str:
         return "fatal"
     if isinstance(exc, MemoryError):
         return "resource_exhausted"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        # transport-class failures (cluster RPC, WAL ship, socket
+        # timeouts): transient by nature — reconnect-and-retry can
+        # succeed. Plain OSError stays "generic": file/system errors
+        # are not made retryable wholesale.
+        return "transient"
     name = type(exc).__name__
     mod = getattr(type(exc), "__module__", "") or ""
     if name in _XLA_NAMES or mod.startswith(("jaxlib", "jax.")) \

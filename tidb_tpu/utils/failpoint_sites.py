@@ -93,6 +93,32 @@ SITES: dict[str, str] = {
     "cluster/rpc": (
         "cluster/coordinator.py: before every worker RPC send — "
         "conn_reset must retry/reconnect"),
+    # network fault layer (cluster/rpc.py send_msg/recv_msg; the
+    # cluster_smoke gate enumerates NET_SITES): each fault must leave
+    # zero acked-commit loss and zero double-applies — a lost reply is
+    # answered from the worker dedup window on retry, never re-executed
+    "cluster/net/send": (
+        "cluster/rpc.py: before a frame is written — error = the frame "
+        "is dropped (one-direction partition when sustained), sleep = "
+        "link delay; the supervised client must retry/reconnect and "
+        "the worker dedup window must absorb re-sends"),
+    "cluster/net/recv": (
+        "cluster/rpc.py: before a frame is read — error = the reply is "
+        "lost AFTER the worker executed (the dedup seam: the retried "
+        "request must be answered from the dedup cache, not re-run)"),
+    "cluster/net/dup": (
+        "cluster/rpc.py: the frame is transmitted twice (at-least-once "
+        "delivery) — request-id correlation + the dedup window must "
+        "keep the apply exactly-once and the reply stream in sync"),
+    "cluster/net/partial-close": (
+        "cluster/rpc.py: the peer closes mid-frame after a partial "
+        "write — the reader must surface a classified retryable "
+        "ClusterTransportError (torn frame), never a bare "
+        "ConnectionError or a wedge"),
+    "cluster/net/trickle": (
+        "cluster/rpc.py: the frame dribbles out in small chunks with "
+        "delays — slow links must stay correct (no torn-frame "
+        "misclassification, no double-apply), only slower"),
     "cdc-poll": (
         "cdc/changefeed.py: worker poll loop — injected errors "
         "backoff, hard kills resume from checkpoint-ts"),
@@ -116,6 +142,18 @@ DDL_SITES = (
     "ddl-drop-before-remove",
     "ddl-delete-range",
     "ddl-reorg-before-swap",
+)
+
+
+# the network seams scripts/cluster_smoke.py drives (each enabled in
+# the coordinator process, prob-gated, under sustained commit +
+# distributed-query load × a kill -9 failover)
+NET_SITES = (
+    "cluster/net/send",
+    "cluster/net/recv",
+    "cluster/net/dup",
+    "cluster/net/partial-close",
+    "cluster/net/trickle",
 )
 
 

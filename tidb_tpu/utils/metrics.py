@@ -882,6 +882,26 @@ RPC_SECONDS = REGISTRY.histogram(
 RPC_RETRIES = REGISTRY.counter(
     "tidb_tpu_rpc_retry_total",
     "Cluster RPC transport retries by op", ("op",))
+CLUSTER_RPC = REGISTRY.counter(
+    "tidb_tpu_cluster_rpc_total",
+    "Supervised cluster RPC calls by op and outcome "
+    "(ok/transport_error/stale_epoch/app_error/breaker_open)",
+    ("op", "outcome"))
+CLUSTER_RPC_DEDUP = REGISTRY.counter(
+    "tidb_tpu_cluster_rpc_dedup_total",
+    "Retried cluster RPCs answered from the worker-side dedup window "
+    "instead of re-executing", ("op",))
+CLUSTER_HB_LAG = REGISTRY.gauge(
+    "tidb_tpu_cluster_heartbeat_lag_seconds",
+    "Seconds since the last successful heartbeat per worker slot",
+    ("worker",))
+CLUSTER_BREAKER_STATE = REGISTRY.gauge(
+    "tidb_tpu_cluster_breaker_state",
+    "Per-worker RPC circuit breaker state (0 closed, 1 open)",
+    ("worker",))
+CLUSTER_FAILOVERS = REGISTRY.counter(
+    "tidb_tpu_cluster_failover_total",
+    "Fenced failovers executed by the cluster supervisor")
 
 LOCK_RESOLUTIONS = REGISTRY.counter(
     "tidb_tpu_lock_resolution_total",
