@@ -321,6 +321,120 @@ def oltp_main(live=True):
     }))
 
 
+def vector_main(live=True):
+    """Vector-search benchmark (ISSUE 15, docs/VECTOR.md): corpus-size
+    x nprobe sweep over a clustered VECTOR corpus, measuring exact
+    single-dispatch qps, IVF ANN qps, and recall@10 vs the float64
+    host oracle per cell, at the runtime seam the executor calls.
+    Emits the artifact to BENCH_VECTOR_OUT (default
+    BENCH_VECTOR_cpu.json on the cpu backend)."""
+    import numpy as np
+    dim = int(os.environ.get("BENCH_VECTOR_DIM", "32"))
+    sizes = [int(x) for x in os.environ.get(
+        "BENCH_VECTOR_ROWS", "10000,50000").split(",") if x.strip()]
+    nprobes = [int(x) for x in os.environ.get(
+        "BENCH_VECTOR_NPROBE", "4,8,16").split(",") if x.strip()]
+    nq = int(os.environ.get("BENCH_VECTOR_QUERIES", "50"))
+
+    from tidb_tpu.testkit import TestKit
+    from tidb_tpu.executor.exec_base import ExecContext
+
+    def fmt(v):
+        return "[" + ",".join(f"{x:.4f}" for x in v.tolist()) + "]"
+
+    cells = {}
+    for rows in sizes:
+        tk = TestKit()
+        tk.must_exec("create table corpus (id bigint primary key, "
+                     f"e vector({dim}))")
+        rng = np.random.RandomState(42)
+        centers = rng.randn(256, dim).astype(np.float32) * 4.0
+        mat = (centers[rng.randint(0, 256, rows)] +
+               rng.randn(rows, dim).astype(np.float32) * 0.35)
+        texts = np.array([fmt(mat[i]) for i in range(rows)],
+                         dtype=object)
+        tbl = tk.domain.infoschema().table_by_name("test", "corpus")
+        ctab = tk.domain.columnar.table(tbl)
+        ctab.bulk_append({"id": np.arange(rows, dtype=np.int64),
+                          "e": texts}, rows,
+                         handles=np.arange(1, rows + 1,
+                                           dtype=np.int64))
+        stored = np.array([np.fromstring(t[1:-1], sep=",")
+                           for t in texts], dtype=np.float32)
+        tk.must_exec("create vector index vidx on corpus (e) "
+                     "using ivf")
+        tbl = tk.domain.infoschema().table_by_name("test", "corpus")
+        rt, copr = tk.domain.vector, tk.domain.copr
+        ci = tbl.find_column("e")
+        idx = rt.index_for(tbl, "e")
+        ectx = ExecContext(tk.sess)
+        queries = (mat[rng.randint(0, rows, nq)] +
+                   rng.randn(nq, dim).astype(np.float32) * 0.15)
+
+        def oracle(q):
+            d = np.linalg.norm(
+                stored.astype(np.float64) - q.astype(np.float64),
+                axis=1)
+            return set(np.argsort(d, kind="stable")[:10].tolist())
+
+        rt.exact_topk(copr, ctab, ci.id, dim, "vec_l2_distance",
+                      queries[0], 10, None, ectx=ectx)
+        t0 = time.perf_counter()
+        for i in range(nq):
+            rt.exact_topk(copr, ctab, ci.id, dim, "vec_l2_distance",
+                          queries[i], 10, None, ectx=ectx)
+        exact_qps = nq / (time.perf_counter() - t0)
+        for nprobe in nprobes:
+            tk.must_exec(f"set @@tidb_tpu_vector_nprobe = {nprobe}")
+            ectx = ExecContext(tk.sess)
+            rt.ivf_topk(copr, ctab, idx, "vec_l2_distance",
+                        queries[0], 10, None, ectx=ectx)
+            hits = 0
+            reps = max(nq * 4, 200)
+            t0 = time.perf_counter()
+            for i in range(reps):
+                rt.ivf_topk(copr, ctab, idx, "vec_l2_distance",
+                            queries[i % nq], 10, None, ectx=ectx)
+            ivf_qps = reps / (time.perf_counter() - t0)
+            for i in range(nq):
+                cand = rt.ivf_topk(copr, ctab, idx, "vec_l2_distance",
+                                   queries[i], 10, None, ectx=ectx)[:10]
+                hits += len(oracle(queries[i]) &
+                            set(np.asarray(cand).tolist()))
+            cells[f"rows={rows},nprobe={nprobe}"] = {
+                "exact_qps": round(exact_qps, 1),
+                "ivf_qps": round(ivf_qps, 1),
+                "speedup": round(ivf_qps / max(exact_qps, 1e-9), 2),
+                "recall_at_10": round(hits / (10 * nq), 4),
+            }
+            print(f"# rows={rows} nprobe={nprobe}: "
+                  f"{cells[f'rows={rows},nprobe={nprobe}']}",
+                  file=sys.stderr)
+    headline = cells.get(f"rows={sizes[-1]},nprobe=8") or \
+        list(cells.values())[-1]
+    unit = "IVF searches/s, 50k x 32d clustered corpus, nprobe=8"
+    if not live:
+        unit += " [CPU FALLBACK — not a TPU measurement]"
+    doc = {
+        "metric": f"vector_search_dim{dim}",
+        "value": headline["ivf_qps"],
+        "unit": unit,
+        "vs_baseline": headline["speedup"],
+        "backend": "tpu" if live else "cpu-fallback",
+        "recall_at_10": headline["recall_at_10"],
+        "cells": cells,
+    }
+    out = os.environ.get(
+        "BENCH_VECTOR_OUT",
+        os.path.join(_REPO, "BENCH_VECTOR_cpu.json" if not live
+                     else "BENCH_VECTOR_tpu.json"))
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"# artifact -> {out}", file=sys.stderr)
+    print(json.dumps(doc))
+
+
 def _replay_saved_tpu_result():
     """The axon device grant is intermittent: a window may open at any
     point in a 12h round and be closed again when the driver finally
@@ -371,6 +485,8 @@ def main():
         return htap_main(live)
     if os.environ.get("BENCH_MODE") == "oltp":
         return oltp_main(live)
+    if os.environ.get("BENCH_MODE") == "vector":
+        return vector_main(live)
     # default scale: SF1 either way — a first-ever on-chip run must
     # finish inside whatever grant window exists (cold sort/agg
     # compiles at SF10 shapes can take minutes each); the bench loop's

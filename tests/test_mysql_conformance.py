@@ -406,3 +406,48 @@ def test_lock_error_codes_and_sqlstates(tk):
     # here pin the class contract the wire protocol serializes
     from tidb_tpu.errors import DeadlockError
     assert (DeadlockError.code, DeadlockError.sqlstate) == (1213, "40001")
+
+
+def test_vector_error_codes_and_sqlstates(tk):
+    """Vector ER surface (ISSUE 15 satellite): malformed vector text ->
+    ER 6138 (the MySQL 9 ER_TO_VECTOR_CONVERSION family), dimension
+    clash -> ER 6139, VECTOR in a numeric context -> ER 1235 — pinned
+    on the catalog (information_schema.tidb_errors) AND live raised
+    errors. A device shape error must never escape to the client."""
+    rows = dict((code, (name, state)) for name, code, state in
+                tk.must_query(
+        "select error, code, sqlstate from "
+        "information_schema.tidb_errors "
+        "where code in (6138, 6139)").rows)
+    assert rows == {6138: ("VectorConversionError", "22000"),
+                    6139: ("VectorDimensionError", "22000")}, rows
+    from tidb_tpu.errors import (VectorConversionError,
+                                 VectorDimensionError)
+    assert (VectorConversionError.code,
+            VectorConversionError.sqlstate) == (6138, "22000")
+    assert (VectorDimensionError.code,
+            VectorDimensionError.sqlstate) == (6139, "22000")
+    tk.must_exec("drop table if exists vconf")
+    tk.must_exec("create table vconf (id bigint primary key, "
+                 "e vector(3))")
+    tk.must_exec("insert into vconf values (1, '[1,2,3]')")
+    # live: insert wrong-k vector
+    e = tk.exec_err("insert into vconf values (2, '[1,2]')")
+    assert (e.code, e.sqlstate) == (6139, "22000")
+    warn = tk.must_query("show warnings").rows[0]
+    assert int(warn[1]) == 6139
+    # live: malformed literal
+    e = tk.exec_err("insert into vconf values (2, '{not a vector}')")
+    assert (e.code, e.sqlstate) == (6138, "22000")
+    # live: distance between mismatched dims (column + literal forms)
+    e = tk.exec_err("select vec_l2_distance(e, '[1,2]') from vconf")
+    assert (e.code, e.sqlstate) == (6139, "22000")
+    e = tk.exec_err("select vec_cosine_distance('[1,2]', '[1,2,3]')")
+    assert (e.code, e.sqlstate) == (6139, "22000")
+    # live: VECTOR in invalid contexts fails cleanly (planner-time
+    # 1235, not a runtime shape error)
+    for sql in ("select e * 2 from vconf",
+                "select sum(e) from vconf",
+                "select e - e from vconf"):
+        e = tk.exec_err(sql)
+        assert e.code == 1235, sql

@@ -96,6 +96,51 @@ def test_onehot_pipelined_miss_on_one_partition(tk, monkeypatch):
     assert [list(x) for x in r] == [list(x) for x in r2]
 
 
+def test_onehot_delta_fold_zero_rebuilds_on_append(tk):
+    """ISSUE 15 satellite (ROADMAP item #5 learned-structure tail):
+    an in-bucket append — existing keys AND a brand-new in-span key —
+    extends the learned slot table at bind time through the
+    version-advance/delta contract, with ZERO dispatch-time
+    miss-pop-relearns; the one-hot path keeps serving and stays
+    host-identical."""
+    tk.must_query(Q)
+    tk.must_query(Q)
+    m = tk.domain.metrics
+    served0 = m.get("fused_onehot_agg", 0)
+    assert served0 > 0
+    # 500 is inside the learned span (keys are 977-multiples in
+    # [0, 38103]) but not a learned key -> a genuinely new slot
+    tk.must_exec("insert into f values (100000, 500, 3, 7, 7), "
+                 "(100001, 977, 0, 1, 1)")
+    r = tk.must_query(Q).rows
+    assert m.get("fused_onehot_miss", 0) == 0
+    assert m.get("fused_onehot_rebuild", 0) == 0
+    assert m.get("fused_onehot_delta_fold", 0) == 1
+    assert m.get("fused_onehot_agg", 0) > served0   # still one-hot
+    assert len(r) == 201
+    r2 = tk.must_query(Q).rows
+    assert [list(x) for x in r] == [list(x) for x in r2]
+    # host oracle
+    tk.domain.copr.use_device = False
+    host = tk.must_query(Q).rows
+    tk.domain.copr.use_device = True
+    assert [list(x) for x in r2] == [list(x) for x in host]
+
+
+def test_onehot_delta_fold_out_of_span_relearns(tk):
+    """A key the learned packing cannot represent still relearns
+    cleanly (the only rebuild left) and stays correct."""
+    tk.must_query(Q)
+    tk.must_query(Q)
+    m = tk.domain.metrics
+    tk.must_exec("insert into f values (100002, 99999977, 3, 1, 1)")
+    r = tk.must_query(Q).rows
+    assert m.get("fused_onehot_rebuild", 0) == 1
+    assert len(r) == 201
+    r2 = tk.must_query(Q).rows
+    assert [list(x) for x in r] == [list(x) for x in r2]
+
+
 def test_onehot_full_range_keys_rejected(tk):
     # key spans beyond the 61-bit pack budget must be rejected BEFORE
     # packing (no OverflowError), falling back to the exact lowering

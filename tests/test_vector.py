@@ -1,0 +1,321 @@
+"""TPU-native vector search (ISSUE 15, tidb_tpu/vector/,
+docs/VECTOR.md): VECTOR(k) columns, distance builtins, exact
+single-dispatch top-k, the IVF ANN path with incremental delta
+maintenance, chaos parity at the vector dispatch sites, and the
+tidb_vector_indexes surface. The full-scale gate (50k rows, recall +
+qps floors) is scripts/vector_smoke.py; this is the tier-1 fast
+slice."""
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint, phase
+from tidb_tpu.utils import metrics as mu
+
+
+def _vec_text(v):
+    return "[" + ",".join(f"{x:.3f}" for x in np.asarray(v).tolist()) + "]"
+
+
+def _load(tk, n=2000, dim=8, seed=11, table="docs"):
+    tk.must_exec(f"create table {table} "
+                 f"(id bigint primary key, e vector({dim}))")
+    rng = np.random.RandomState(seed)
+    mat = rng.randn(n, dim).astype(np.float32)
+    rows = ",".join(f"({i}, '{_vec_text(mat[i])}')" for i in range(n))
+    tk.must_exec(f"insert into {table} values " + rows)
+    return mat, rng
+
+
+def _oracle_l2(mat, q, k):
+    d = np.linalg.norm(mat.astype(np.float64) - np.asarray(q), axis=1)
+    return list(np.argsort(d, kind="stable")[:k])
+
+
+@pytest.fixture()
+def tk():
+    return TestKit()
+
+
+# ---- type surface ------------------------------------------------------
+
+def test_vector_type_and_error_codes(tk):
+    tk.must_exec("create table v (id bigint primary key, e vector(4))")
+    tk.must_exec("insert into v values (1, '[1,2,3,4]'), (2, null)")
+    # canonical text round-trip
+    assert tk.must_query("select e from v where id = 1").rows == \
+        [("[1,2,3,4]",)]
+    # wrong-k insert -> ER 6139, malformed -> ER 6138 (conformance)
+    e = tk.exec_err("insert into v values (3, '[1,2]')")
+    assert (e.code, e.sqlstate) == (6139, "22000")
+    e = tk.exec_err("insert into v values (3, 'oops')")
+    assert (e.code, e.sqlstate) == (6138, "22000")
+    # distance between mismatched dims -> 6139 (declared column dim)
+    e = tk.exec_err("select vec_l2_distance(e, '[1,2]') from v")
+    assert e.code == 6139
+    e = tk.exec_err("select vec_l2_distance('[1,2]', '[1,2,3]')")
+    assert e.code == 6139
+    # VECTOR in numeric contexts -> ER 1235, never a NaN coercion
+    assert tk.exec_err("select e + 1 from v").code == 1235
+    assert tk.exec_err("select sum(e) from v").code == 1235
+    assert tk.exec_err("select avg(e) from v").code == 1235
+    # vector(0) is not a dimension
+    e = tk.exec_err("create table bad (a vector(0))")
+    assert e.code == 6139
+    # builtins still compute
+    assert tk.must_query(
+        "select vec_inner_product('[1,2]', '[3,4]'), "
+        "vec_dims(e) from v where id = 1").rows == [(11.0, 4)]
+
+
+def test_show_create_renders_vector(tk):
+    tk.must_exec("create table v (id bigint primary key, e vector(3))")
+    ddl = tk.must_query("show create table v").rows[0][1]
+    assert "`e` vector(3)" in ddl
+    tk.must_exec("create vector index vi on v (e) using ivf")
+    ddl = tk.must_query("show create table v").rows[0][1]
+    assert "VECTOR KEY `vi` (`e`) USING IVF" in ddl
+
+
+# ---- exact path --------------------------------------------------------
+
+def test_exact_topk_matches_oracle_and_single_dispatch(tk):
+    mat, rng = _load(tk)
+    q = rng.randn(8).astype(np.float32)
+    sql = (f"select id from docs order by "
+           f"vec_l2_distance(e, '{_vec_text(q)}') limit 10")
+    plan = " ".join(str(r) for r in tk.must_query("explain " + sql).rows)
+    assert "VectorSearch" in plan
+    got = [r[0] for r in tk.must_query(sql).rows]
+    # oracle over the canonicalized stored text (3-decimal round-trip)
+    stored = np.array([np.fromstring(_vec_text(mat[i])[1:-1], sep=",")
+                       for i in range(len(mat))], dtype=np.float32)
+    assert got == _oracle_l2(stored, q.astype(np.float64), 10)
+    assert mu.VECTOR_SEARCH.labels("exact").value >= 1
+    # steady state: <= 2 dispatches, <= 1 host sync by phase counters
+    tk.must_query(sql)
+    phase.reset()
+    tk.must_query(sql)
+    s = phase.snap()
+    assert s.get("dispatches", 0) <= 2, s
+    assert s.get("syncs", 0) <= 1, s
+    assert s.get("upload_bytes", 0) == 0, s   # warm: fully resident
+
+
+def test_exact_chaos_parity_and_fallback_metric(tk):
+    mat, rng = _load(tk, n=1500)
+    q = rng.randn(8)
+    sql = (f"select id, vec_cosine_distance(e, '{_vec_text(q)}') "
+           f"from docs order by vec_cosine_distance(e, '{_vec_text(q)}') "
+           "limit 7")
+    clean = tk.must_query(sql).rows
+    failpoint.enable("device_guard/vector/topk", "error:grant_lost")
+    try:
+        chaos = tk.must_query(sql).rows
+    finally:
+        failpoint.disable_all()
+    assert clean == chaos
+    assert mu.VECTOR_SEARCH.labels("host_fallback").value >= 1
+
+
+def test_null_vectors_order_first_and_ties_stable(tk):
+    tk.must_exec("create table v (id bigint primary key, e vector(2))")
+    tk.must_exec("insert into v values (1, '[1,1]'), (2, null), "
+                 "(3, '[1,1]'), (4, '[9,9]'), (5, null)")
+    rows = tk.must_query(
+        "select id from v order by vec_l2_distance(e, '[1,1]') "
+        "limit 5").rows
+    # MySQL ASC: NULLs first (in row order), then ties in row order
+    assert [r[0] for r in rows] == [2, 5, 1, 3, 4]
+
+
+def test_dirty_txn_overlay_falls_back_host(tk):
+    _load(tk, n=600)
+    tk.must_exec("begin")
+    tk.must_exec("insert into docs values (9999, '[0,0,0,0,0,0,0,0]')")
+    rows = tk.must_query(
+        "select id from docs order by "
+        "vec_l2_distance(e, '[0,0,0,0,0,0,0,0]') limit 1").rows
+    tk.must_exec("rollback")
+    assert rows[0][0] == 9999      # UnionScan semantics preserved
+    assert mu.VECTOR_SEARCH.labels("host_fallback").value >= 1
+
+
+def test_update_and_delete_visibility(tk):
+    tk.must_exec("create table v (id bigint primary key, e vector(2))")
+    tk.must_exec("insert into v values (1, '[0,0]'), (2, '[5,5]'), "
+                 "(3, '[9,9]')")
+    q = "select id from v order by vec_l2_distance(e, '[0,0]') limit 2"
+    assert [r[0] for r in tk.must_query(q).rows] == [1, 2]
+    tk.must_exec("update v set e = '[100,100]' where id = 1")
+    assert [r[0] for r in tk.must_query(q).rows] == [2, 3]
+    tk.must_exec("delete from v where id = 2")
+    assert [r[0] for r in tk.must_query(q).rows] == [3, 1]
+
+
+def test_resident_matrix_delta_patch(tk):
+    """An append after a warm search tail-patches the resident matrix
+    (O(delta) upload) instead of re-uploading it whole."""
+    mat, rng = _load(tk, n=1000)
+    q = _vec_text(rng.randn(8))
+    sql = f"select id from docs order by vec_l2_distance(e, '{q}') limit 5"
+    tk.must_query(sql)
+    tk.must_query(sql)
+    applied0 = mu.DELTA_APPLY.labels("applied").value
+    tk.must_exec("insert into docs values (5000, '[9,9,9,9,9,9,9,9]')")
+    phase.reset()
+    rows = tk.must_query(sql).rows
+    s = phase.snap()
+    assert mu.DELTA_APPLY.labels("applied").value > applied0
+    # the patch moved O(delta) bytes, nowhere near the full matrix
+    full = 1024 * 8 * 4
+    assert 0 < s.get("upload_bytes", 0) < full, s
+    assert len(rows) == 5
+    # and the new row is searchable
+    got = tk.must_query("select id from docs order by "
+                        "vec_l2_distance(e, '[9,9,9,9,9,9,9,9]') "
+                        "limit 1").rows
+    assert got[0][0] == 5000
+
+
+# ---- IVF ---------------------------------------------------------------
+
+def test_ivf_lifecycle_recall_and_delta(tk):
+    mat, rng = _load(tk, n=3000, dim=8)
+    tk.must_exec("create vector index vidx on docs (e) using ivf "
+                 "lists = 16")
+    q = rng.randn(8)
+    sql = (f"select id from docs order by "
+           f"vec_l2_distance(e, '{_vec_text(q)}') limit 10")
+    ivf = [r[0] for r in tk.must_query(sql).rows]
+    assert mu.VECTOR_SEARCH.labels("ivf").value == 1
+    assert mu.VECTOR_NPROBE_PARTITIONS.labels().value > 0
+    # nprobe=0 disables the index path -> exact
+    tk.must_exec("set @@tidb_tpu_vector_nprobe = 0")
+    exact = [r[0] for r in tk.must_query(sql).rows]
+    assert mu.VECTOR_SEARCH.labels("exact").value == 1
+    assert len(set(ivf) & set(exact)) >= 8      # recall@10 on 16 lists
+    # probing every partition is exact by construction
+    tk.must_exec("set @@tidb_tpu_vector_nprobe = 16")
+    assert [r[0] for r in tk.must_query(sql).rows] == exact
+    tk.must_exec("set @@tidb_tpu_vector_nprobe = 8")
+    # delta path: insert folds, never rebuilds
+    tk.must_exec(f"insert into docs values (8888, '{_vec_text(q)}')")
+    got = tk.must_query(sql).rows
+    assert got[0][0] == 8888
+    assert mu.VECTOR_INDEX_DELTA.labels("applied").value >= 1
+    assert mu.VECTOR_INDEX_DELTA.labels("rebuild").value == 0
+    # tombstones advance without touching postings
+    tk.must_exec("delete from docs where id = 8888")
+    got = tk.must_query(sql).rows
+    assert got[0][0] != 8888
+    assert mu.VECTOR_INDEX_DELTA.labels("advanced").value >= 1
+    assert mu.VECTOR_INDEX_DELTA.labels("rebuild").value == 0
+    # vtable surface
+    row = tk.must_query(
+        "select table_name, index_name, column_name, centroids, rows "
+        "from information_schema.tidb_vector_indexes").rows
+    assert row[0][:3] == ("docs", "vidx", "e")
+    assert row[0][3] == 16 and row[0][4] >= 3000
+    # drop: meta + runtime gone, exact serves
+    tk.must_exec("drop index vidx on docs")
+    assert tk.must_query(
+        "select count(*) from information_schema.tidb_vector_indexes"
+    ).rows == [(0,)]
+    assert [r[0] for r in tk.must_query(sql).rows][:10] != []
+
+
+def test_ivf_short_slate_falls_back_exact(tk):
+    """Probed partitions emptied by deletes must not shrink a LIMIT:
+    when the ANN slate comes back short, the exact path owns the
+    answer (review finding: a dead cluster near the query used to
+    return 0 rows over a populated table)."""
+    tk.must_exec("create table c (id bigint primary key, e vector(4))")
+    rows = [f"({i}, '[{i % 2 * 50},{i},0,0]')" for i in range(200)]
+    tk.must_exec("insert into c values " + ",".join(rows))
+    tk.must_exec("create vector index vi on c (e) using ivf lists = 2")
+    tk.must_exec("set @@tidb_tpu_vector_nprobe = 1")
+    q = "select id from c order by vec_l2_distance(e, '[0,0,0,0]') limit 5"
+    tk.must_query(q)                      # build the index
+    tk.must_exec("delete from c where id % 2 = 0")   # kill one cluster
+    got = tk.must_query(q).rows
+    assert len(got) == 5, got
+    # and the rows are the true nearest among the live ones
+    assert [r[0] for r in got] == [1, 3, 5, 7, 9]
+
+
+def test_ivf_chaos_parity_train_and_score(tk):
+    """Grant loss injected at the train AND scoring sites: the index
+    still builds (numpy Lloyd twin) and ANN answers stay valid."""
+    import os
+    mat, rng = _load(tk, n=1200)
+    os.environ["TIDB_TPU_VECTOR_DEVICE"] = "1"
+    failpoint.enable("device_guard/vector/train", "error:grant_lost")
+    failpoint.enable("device_guard/vector/ivf", "error:grant_lost")
+    try:
+        tk.must_exec("create vector index vidx on docs (e) using ivf "
+                     "lists = 8")
+        q = rng.randn(8)
+        rows = tk.must_query(
+            f"select id from docs order by "
+            f"vec_l2_distance(e, '{_vec_text(q)}') limit 5").rows
+        assert len(rows) == 5
+    finally:
+        failpoint.disable_all()
+        os.environ.pop("TIDB_TPU_VECTOR_DEVICE", None)
+    st = tk.domain.vector.indexes()
+    assert st and st[0][1].built
+
+
+def test_ivf_device_scoring_matches_host(tk):
+    """TIDB_TPU_VECTOR_DEVICE=1 routes candidate scoring through the
+    gather+top-k kernel; rows must match the host twin's."""
+    import os
+    mat, rng = _load(tk, n=1500)
+    tk.must_exec("create vector index vidx on docs (e) using ivf "
+                 "lists = 8")
+    q = rng.randn(8)
+    sql = (f"select id from docs order by "
+           f"vec_l2_distance(e, '{_vec_text(q)}') limit 10")
+    host_rows = tk.must_query(sql).rows
+    os.environ["TIDB_TPU_VECTOR_DEVICE"] = "1"
+    try:
+        dev_rows = tk.must_query(sql).rows
+    finally:
+        os.environ.pop("TIDB_TPU_VECTOR_DEVICE", None)
+    assert host_rows == dev_rows
+
+
+def test_vector_index_ddl_validation(tk):
+    tk.must_exec("create table t (id bigint primary key, s varchar(10), "
+                 "e vector(4), u vector)")
+    assert tk.exec_err(
+        "create vector index i1 on t (s) using ivf").code == 1235
+    assert tk.exec_err(
+        "create vector index i1 on t (u) using ivf").code == 6139
+    assert tk.exec_err(
+        "create vector index i1 on t (e) using hnsw").code == 1235
+    tk.must_exec("create vector index i1 on t (e) using ivf")
+    assert tk.exec_err(
+        "create vector index i1 on t (e) using ivf").code == 1061
+    # vector index never serves KV plans or write maintenance
+    tk.must_exec("insert into t values (1, 'x', '[1,2,3,4]', '[1]')")
+    tk.must_exec("admin check table t")
+    tk.must_exec("drop index i1 on t")
+    assert tk.exec_err("drop index i1 on t").code == 1176
+
+
+def test_top_sql_attributes_vector_device_ms(tk):
+    """Vector kernel time rides phase.snap() into Top SQL per-digest
+    rows (the kernels run through the copr kernel cache's phase
+    wrapper)."""
+    mat, rng = _load(tk, n=1200)
+    q = _vec_text(rng.randn(8))
+    sql = f"select id from docs order by vec_l2_distance(e, '{q}') limit 3"
+    tk.must_query(sql)
+    tk.must_query(sql)
+    rows = tk.must_query(
+        "select sql_text, sum_ms, sum_device_ms from "
+        "information_schema.tidb_top_sql").rows
+    mine = [r for r in rows if "vec_l2_distance" in r[0]]
+    assert mine and mine[0][2] > 0, rows

@@ -154,6 +154,7 @@ class StringDict:
     """Per-column string dictionary: code <-> str, append-only."""
 
     __slots__ = ("values", "index", "sort_keys", "_vec_cache",
+                 "_vecmat_cache",
                  "_ci_norm", "_ci_fold", "_ci_ranks", "_ci_fold_ranks",
                  "_rank_codes")
 

@@ -1155,12 +1155,19 @@ def _delta_in_span(shim, sizes, delta_part):
     return True
 
 
-def _oh_learn_table(copr, ohk, plan, oh_learn):
+def _oh_learn_table(copr, ohk, plan, oh_learn, rows=0, version=None):
     """Build the one-hot slot table from a completed sorted/runs
     execution's partials: union the per-partition group keys, pack them
     with host-chosen offsets/spans (the kernel range-checks each code,
     so any later out-of-span value is a miss, never an alias), and
-    store the sorted packed table + per-slot key columns."""
+    store the sorted packed table + per-slot key columns.
+
+    ``rows``/``version`` record the fact coverage watermark (the
+    version read BEFORE the snapshot, the snapshot's row count): the
+    bind-time delta fold (_oh_fold_delta) extends the table from rows
+    [rows, n) instead of letting an appended key force a
+    miss-pop-relearn — the version-advance/delta contract the vector
+    index follows (ROADMAP item #5 learned-structure tail)."""
     K = len(plan.group_items)
     kcols = [np.concatenate([e[0][i] for e in oh_learn])
              for i in range(K)]
@@ -1214,7 +1221,128 @@ def _oh_learn_table(copr, ohk, plan, oh_learn):
         "nslots": nslots, "scap": scap,
         "key_vals": [kcols[i][idx] for i in range(K)],
         "key_nulls": [knulls[i][idx] for i in range(K)],
+        "rows": rows, "version": version,
     }
+
+
+def _oh_tail_keys(copr, plan, fact_arrays, lo, hi):
+    """Group-key columns of fact rows [lo, hi) evaluated on host —
+    the delta fold's input. None when a group item reaches beyond the
+    fact columns (dim-joined keys: the fold cannot see those rows'
+    join results; the dispatch-time miss path still covers them)."""
+    cols = {}
+    for sc in plan.fact_dag.cols:
+        cid = _cid_of(plan.fact_dag, sc)
+        if cid == -1:
+            continue
+        data, nulls, sdict = fact_arrays[cid]
+        cols[sc.col.idx] = (data[lo:hi],
+                            None if nulls is None else nulls[lo:hi],
+                            sdict)
+    m = hi - lo
+    ectx = EvalCtx(np, m, cols, host=True)
+    kcols, knulls = [], []
+    try:
+        for g in plan.group_items:
+            d, nl, _sd = eval_expr(ectx, g)
+            if np.isscalar(d) or getattr(d, "ndim", 1) == 0:
+                d = np.full(m, d)
+            d = np.asarray(d)
+            if d.dtype.kind not in "iu":
+                return None
+            kcols.append(d.astype(np.int64))
+            knulls.append(np.asarray(materialize_nulls(ectx, nl)))
+    except Exception:                       # noqa: BLE001
+        return None
+    return kcols, knulls
+
+
+def _oh_fold_delta(copr, ohk, plan, fact_arrays, n, version):
+    """Version-advance/delta maintenance of a learned one-hot slot
+    table: fold the keys of appended fact rows [rows, n) into the
+    table at bind time — new in-span keys become new slots (the
+    kernel reuses the same scap program; nslots is a device operand)
+    — instead of rebuilding the whole table from a sorted re-execution
+    on the first dispatch-time miss. Out-of-span keys or slot-count
+    overflow still pop for a relearn (metered fused_onehot_rebuild);
+    an append of existing keys is a pure watermark advance."""
+    OH = copr._host_cache.get(ohk)
+    if not isinstance(OH, dict):
+        return
+    rows = OH.get("rows", 0)
+    # ``version``/``n`` are the caller's pre-snapshot version and the
+    # snapshot's row count — the fold must never claim rows past the
+    # arrays it actually reads
+    if OH.get("version") == version:
+        return
+    dom = getattr(copr, "domain", None)
+    if n <= rows:
+        # delete/update tombstones (or a shorter snapshot): slots are
+        # unaffected — zero-count slots drop at decode time
+        OH["version"] = version
+        return
+    tail = _oh_tail_keys(copr, plan, fact_arrays, rows, n)
+    if tail is None:
+        return                  # dim-joined keys: miss path owns this
+    kcols, knulls = tail
+    K = len(plan.group_items)
+    los, spans = OH["los"], OH["spans"]
+    packed = np.zeros(n - rows, dtype=np.int64)
+    for i in range(K):
+        v = kcols[i]
+        nm = knulls[i]
+        live = ~nm
+        if live.any() and (int(v[live].min()) < int(los[i]) or
+                           int(v[live].max()) > int(los[i]) +
+                           int(spans[i]) - 2):
+            # outside the learned span: the packing cannot represent
+            # it — relearn from scratch (the only rebuild left)
+            copr._host_cache.pop(ohk, None)
+            if dom is not None:
+                dom.inc_metric("fused_onehot_rebuild")
+            return
+        code = np.where(nm, 0, v - int(los[i]) + 1)
+        packed = packed * int(spans[i]) + code
+    nslots = OH["nslots"]
+    old_keys = OH["skeys"][:nslots]
+    uniq, first = np.unique(packed, return_index=True)
+    fresh = ~np.isin(uniq, old_keys)
+    if not fresh.any():
+        OH["rows"], OH["version"] = n, version
+        return
+    merged = np.concatenate([old_keys, uniq[fresh]])
+    order = np.argsort(merged, kind="stable")
+    nnew = len(merged)
+    if nnew > _de._ONEHOT_MAX:
+        copr._host_cache[ohk] = False       # pin off like the learn path
+        if dom is not None:
+            dom.inc_metric("fused_onehot_rebuild")
+        return
+    scap = OH["scap"]
+    while scap < nnew:
+        scap <<= 1
+    skeys = np.full(scap, _I64_MAX, dtype=np.int64)
+    skeys[:nnew] = merged[order]
+    fidx = first[fresh]
+    key_vals, key_nulls = [], []
+    for i in range(K):
+        kv = np.concatenate([OH["key_vals"][i],
+                             kcols[i][fidx].astype(
+                                 OH["key_vals"][i].dtype, copy=False)])
+        kn = np.concatenate([OH["key_nulls"][i], knulls[i][fidx]])
+        key_vals.append(kv[order])
+        key_nulls.append(kn[order])
+    # replace the dict wholesale: in-flight dispatches carry their own
+    # table reference (oh_table in the dispatch state) and stay
+    # consistent; the next dispatch binds the extended one
+    copr._host_cache[ohk] = {
+        "skeys": skeys, "los": los, "spans": spans,
+        "nslots": nnew, "scap": scap,
+        "key_vals": key_vals, "key_nulls": key_nulls,
+        "rows": n, "version": version,
+    }
+    if dom is not None:
+        dom.inc_metric("fused_onehot_delta_fold")
 
 
 def fused_partials(copr, plan, read_ts, mesh=None,
@@ -1293,6 +1421,9 @@ def _fused_partials_inner(copr, plan, read_ts, mesh=None,
             return None
         dim_metas.append(meta)
 
+    # version BEFORE the snapshot (delta.refresh rationale): the one-hot
+    # coverage watermark must never claim rows it did not see
+    fact_version = fact_tbl.version
     fact_arrays, fact_valid = fact_tbl.snapshot(
         [cid for cid in (_cid_of(plan.fact_dag, sc)
                          for sc in plan.fact_dag.cols) if cid != -1],
@@ -1424,6 +1555,10 @@ def _fused_partials_inner(copr, plan, read_ts, mesh=None,
     # onehot_agg_body). Learned from the first sorted/runs execution,
     # invalidated by misses (new/changed keys) at consume time.
     ohk = ("onehot", fact_tbl.gc_epoch) + gbkey
+    # fold appended rows' keys into a learned slot table BEFORE any
+    # dispatch binds it: an in-bucket append must extend slots, not
+    # force a dispatch-time miss-pop-relearn
+    _oh_fold_delta(copr, ohk, plan, fact_arrays, n, fact_version)
     oh_learn = []
     oh_parts = []
 
@@ -1729,7 +1864,8 @@ def _fused_partials_inner(copr, plan, read_ts, mesh=None,
                     key_dicts=p0.key_dicts, state_dicts=p0.state_dicts)
     if oh_elig and oh_learn and len(oh_learn) == len(out) and \
             copr._host_cache.get(ohk) is None:
-        _oh_learn_table(copr, ohk, plan, oh_learn)
+        _oh_learn_table(copr, ohk, plan, oh_learn, rows=n,
+                        version=fact_version)
     return out
 
 

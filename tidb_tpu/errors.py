@@ -54,6 +54,12 @@ AmbiguousColumnError = _err("AmbiguousColumnError", 1052, "23000")
 InvalidGroupFuncError = _err("InvalidGroupFuncError", 1111, "HY000")
 MixOfGroupFuncAndFieldsError = _err("MixOfGroupFuncAndFieldsError", 1140, "42000")
 UnsupportedError = _err("UnsupportedError", 1235, "42000")
+# Vector (TiDB vector-search surface; codes follow MySQL 9's VECTOR
+# family: 6138 = ER_TO_VECTOR_CONVERSION). A malformed literal or a
+# dimension clash must surface as a clean SQL error — never a device
+# shape error escaping to the client.
+VectorConversionError = _err("VectorConversionError", 6138, "22000")
+VectorDimensionError = _err("VectorDimensionError", 6139, "22000")
 # Transaction
 WriteConflictError = _err("WriteConflictError", 9007)
 TxnRetryableError = _err("TxnRetryableError", 8002)

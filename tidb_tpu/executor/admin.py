@@ -48,8 +48,11 @@ def check_table(sess, tbl, db_name) -> int:
                     raise AdminCheckError(
                         "row/columnar mismatch at handle %d column %s "
                         "(%r vs %r)", handle, ci.name, d.to_py(), cd.to_py())
-            # 2. index entries
+            # 2. index entries (vector indexes are columnar-derived —
+            # no KV entries to check)
             for idx in tbl.indexes:
+                if getattr(idx, "vector", False):
+                    continue
                 datums = _index_datums(tbl, idx, row)
                 if idx.unique and not any(x.is_null for x in datums):
                     ik = index_key(tbl.id, idx.id, datums)
@@ -67,6 +70,8 @@ def check_table(sess, tbl, db_name) -> int:
             checked += 1
     # 3. dangling index entries (count parity per index)
     for idx in tbl.indexes:
+        if getattr(idx, "vector", False):
+            continue
         pref = index_prefix(tbl.id, idx.id)
         entries = snapshot.scan(pref, pref + b"\xff" * 9, read_ts)
         if len(entries) > checked:

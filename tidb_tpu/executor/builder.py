@@ -65,6 +65,10 @@ def _build(ctx, plan):
         return MergeJoinExec(ctx, plan,
                              build_executor(ctx, plan.children[0]),
                              build_executor(ctx, plan.children[1]))
+    from ..planner.physical import PhysVectorSearch
+    if isinstance(plan, PhysVectorSearch):
+        from .vector_search import VectorSearchExec
+        return VectorSearchExec(ctx, plan)
     if isinstance(plan, PhysSort):
         return SortExec(ctx, plan, build_executor(ctx, plan.child))
     if isinstance(plan, PhysTopN):

@@ -261,7 +261,8 @@ _HOST_ONLY = {"rand", "uuid", "sleep", "user", "database", "version",
               # host and gather; the matrix kernels are numpy (MXU offload
               # of the stacked matrix is the ops/ roadmap)
               "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
-              "vec_negative_inner_product", "vec_dims", "vec_l2_norm",
+              "vec_negative_inner_product", "vec_inner_product",
+              "vec_dims", "vec_l2_norm",
               "vec_from_text", "vec_as_text",
               # row-wise host tail (mixed string/number args)
               "find_in_set", "substring_index", "insert", "inet_aton",
@@ -2260,17 +2261,26 @@ def op_json_length(ctx, expr):
 
 def vec_text_normalize(s: str, dim: int | None = None,
                        col_name: str = "") -> str:
-    """Parse + canonicalize '[1,2,3]'; enforce declared dimension."""
+    """Parse + canonicalize '[1,2,3]'; enforce declared dimension.
+    Errors are the conformance-pinned vector ER codes (errors.py):
+    malformed text -> 6138, dimension clash -> 6139."""
     import json as _json
-    from ..errors import TiDBError
+    from ..errors import VectorConversionError, VectorDimensionError
+    from ..types.field_type import VECTOR_MAX_DIM
     try:
         v = _json.loads(s)
         arr = np.asarray(v, dtype=np.float32)
         assert arr.ndim == 1
+        assert np.isfinite(arr).all()
     except Exception:
-        raise TiDBError("Invalid vector text: '%s'", s[:64])
+        raise VectorConversionError(
+            "Data cannot be converted to a valid vector: '%s'", s[:64])
+    if len(arr) > VECTOR_MAX_DIM:
+        raise VectorDimensionError(
+            "vector has %d dimensions, exceeding the limit %d",
+            len(arr), VECTOR_MAX_DIM)
     if dim and len(arr) != dim:
-        raise TiDBError(
+        raise VectorDimensionError(
             "vector has %d dimensions, expected %d for column '%s'",
             len(arr), dim, col_name)
     return "[" + ",".join(_fmt_vec_f(x) for x in arr.tolist()) + "]"
@@ -2305,6 +2315,31 @@ def _vec_matrix(sdict):
     return mat
 
 
+def _vec_dim_of(expr_arg, parsed=None):
+    """Definite dimension of a distance operand: a parsed constant's
+    length, or a VECTOR(k) column's declared k. None = unknown
+    (free-text vector column without a declared dimension)."""
+    if parsed is not None:
+        return len(parsed)
+    ft = getattr(expr_arg, "ft", None)
+    if ft is not None and getattr(ft, "is_vector", False) and ft.flen > 0:
+        return ft.flen
+    return None
+
+
+def _vec_check_dims(expr, va=None, vb=None):
+    """Mismatched DEFINITE dimensions are a statement error (the
+    conformance-pinned ER 6139), matching the reference: a declared
+    VECTOR(3) column against a 4-dim query must fail cleanly, never
+    silently NULL. Unknown dims keep the legacy NULL semantics."""
+    da = _vec_dim_of(expr.args[0], va)
+    db = _vec_dim_of(expr.args[1], vb)
+    if da is not None and db is not None and da != db:
+        from ..errors import VectorDimensionError
+        raise VectorDimensionError(
+            "vectors have different dimensions: %d and %d", da, db)
+
+
 def _vec_binary(ctx, expr, kernel):
     """Distance between a vector column and a constant (either side), two
     constants, or two columns. kernel(M (u,d), q (d,)) -> float64 (u,)."""
@@ -2313,12 +2348,15 @@ def _vec_binary(ctx, expr, kernel):
     qa, qb = _as_str_scalar(a), _as_str_scalar(b)
     if qa is not None and qb is not None:
         va, vb = _parse_vec_text(qa), _parse_vec_text(qb)
+        _vec_check_dims(expr, va, vb)
         if va is None or vb is None or len(va) != len(vb):
             return 0.0, True, None
         r = float(kernel(va.reshape(1, -1), vb)[0])
         return r, bool(np.isnan(r)), None
     if qa is not None or qb is not None:
         q = _parse_vec_text(qa if qa is not None else qb)
+        _vec_check_dims(expr, va=q if qa is not None else None,
+                        vb=q if qb is not None else None)
         col = b if qa is not None else a
         data, nulls, sd = col
         if q is None:
@@ -2344,6 +2382,7 @@ def _vec_binary(ctx, expr, kernel):
         nm = np.asarray(materialize_nulls(ctx, nulls))
         return out, nm | bad, None
     # column vs column: row-wise
+    _vec_check_dims(expr)
     da, na, sda = a
     db_, nb, sdb = b
 
@@ -2396,6 +2435,13 @@ def op_vec_l1(ctx, expr):
 def op_vec_nip(ctx, expr):
     def kernel(M, q):
         return -(M.astype(np.float64) @ q.astype(np.float64))
+    return _vec_binary(ctx, expr, kernel)
+
+
+@op("vec_inner_product")
+def op_vec_ip(ctx, expr):
+    def kernel(M, q):
+        return M.astype(np.float64) @ q.astype(np.float64)
     return _vec_binary(ctx, expr, kernel)
 
 

@@ -225,6 +225,34 @@ def _gen_changefeeds(domain):
                f.emitted_txns, f.emitted_rows, f.error or "")
 
 
+def _gen_vector_indexes(domain):
+    """One row per PUBLIC vector index (tidb_tpu/vector/): the durable
+    meta joined with the live IVF runtime state — centroid count, rows
+    folded into posting lists, rows committed since the last fold
+    (the delta-path backlog), and the last (re)train time. An index
+    that has never served a search shows centroids/rows 0 (lazy
+    build)."""
+    rt = getattr(domain, "vector", None)
+    if rt is None:
+        return
+    ischema = domain.infoschema()
+    for db in ischema.all_schemas():
+        if db.name.lower() in ("mysql", "information_schema"):
+            continue
+        for t in ischema.tables_in_schema(db.name):
+            for idx in t.indexes:
+                if not getattr(idx, "vector", False):
+                    continue
+                inst = rt.index_for(t, idx.columns[0]) \
+                    if idx.columns else None
+                st = inst.stats() if inst is not None else {}
+                yield (db.name, t.name, idx.name,
+                       idx.columns[0] if idx.columns else "",
+                       st.get("centroids", 0), st.get("rows", 0),
+                       rt.pending_rows(t.id),
+                       float(st.get("last_train_ts", 0.0)))
+
+
 def _gen_replica_freshness(domain):
     """Per-table analytic-replica freshness (incremental HTAP,
     docs/PERFORMANCE.md): the resolved-ts read view every resolved-mode
@@ -483,6 +511,15 @@ VIRTUAL_DEFS = {
                                      ("pending_delta_rows", _I()),
                                      ("mode", _S())),
                                _gen_replica_freshness),
+    "tidb_vector_indexes": (_cols(("table_schema", _S()),
+                                  ("table_name", _S()),
+                                  ("index_name", _S()),
+                                  ("column_name", _S()),
+                                  ("centroids", _I()),
+                                  ("rows", _I()),
+                                  ("pending_delta_rows", _I()),
+                                  ("last_train_ts", _F())),
+                            _gen_vector_indexes),
     "ddl_jobs": (_cols(("job_id", _I()), ("job_type", _S()),
                        ("state", _S()), ("schema_state", _S()),
                        ("db_name", _S()), ("table_name", _S()),

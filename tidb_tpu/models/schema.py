@@ -74,18 +74,27 @@ class IndexInfo:
     # ALTER TABLE ... ALTER INDEX ... INVISIBLE: still maintained by
     # every write, skipped by the planner's access-path search
     invisible: bool = False
+    # CREATE VECTOR INDEX ... USING IVF (tidb_tpu/vector/): derived
+    # from the columnar store — no KV entries, so it must stay out of
+    # writable/deletable/public_indexes (write maintenance, access
+    # paths, ADMIN CHECK); the vector runtime serves and maintains it
+    vector: bool = False
+    params: dict | None = None     # {"using": "ivf", "lists": n, ...}
 
     def to_json(self):
         return {"id": self.id, "name": self.name, "columns": self.columns,
                 "unique": self.unique, "primary": self.primary,
-                "state": int(self.state), "invisible": self.invisible}
+                "state": int(self.state), "invisible": self.invisible,
+                "vector": self.vector, "params": self.params}
 
     @classmethod
     def from_json(cls, j):
         return cls(id=j["id"], name=j["name"], columns=j["columns"],
                    unique=j["unique"], primary=j["primary"],
                    state=SchemaState(j["state"]),
-                   invisible=j.get("invisible", False))
+                   invisible=j.get("invisible", False),
+                   vector=j.get("vector", False),
+                   params=j.get("params"))
 
 
 @dataclass
@@ -130,13 +139,20 @@ class TableInfo:
         return [c for c in self.columns if c.state == SchemaState.PUBLIC]
 
     def writable_indexes(self) -> list[IndexInfo]:
-        return [i for i in self.indexes if i.state >= SchemaState.WRITE_ONLY]
+        return [i for i in self.indexes
+                if i.state >= SchemaState.WRITE_ONLY and not i.vector]
 
     def deletable_indexes(self) -> list[IndexInfo]:
-        return [i for i in self.indexes if i.state >= SchemaState.DELETE_ONLY]
+        return [i for i in self.indexes
+                if i.state >= SchemaState.DELETE_ONLY and not i.vector]
 
     def public_indexes(self) -> list[IndexInfo]:
-        return [i for i in self.indexes if i.state == SchemaState.PUBLIC]
+        return [i for i in self.indexes
+                if i.state == SchemaState.PUBLIC and not i.vector]
+
+    def vector_indexes(self) -> list[IndexInfo]:
+        return [i for i in self.indexes
+                if i.vector and i.state == SchemaState.PUBLIC]
 
     def to_json(self):
         return {

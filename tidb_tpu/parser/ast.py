@@ -566,6 +566,10 @@ class CreateIndexStmt(StmtNode):
     table: TableName = None
     columns: list = field(default_factory=list)
     unique: bool = False
+    # CREATE VECTOR INDEX name ON t (col) USING IVF [LISTS = n]
+    vector: bool = False
+    using: str = ""                  # index algorithm ("ivf", "btree")
+    params: dict = field(default_factory=dict)
 
 
 @dataclass

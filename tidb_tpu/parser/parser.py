@@ -1291,6 +1291,13 @@ class Parser:
         if self.accept_kw("view"):
             return self._parse_create_view(or_replace=False)
         unique = self.accept_kw("unique")
+        vector = False
+        if not unique and self.at_kw("vector") and \
+                self.peek(1).kind == "IDENT" and \
+                self.peek(1).text.lower() in ("index", "key"):
+            # CREATE VECTOR INDEX name ON t (col) USING IVF [LISTS = n]
+            self.next()
+            vector = True
         if self.accept_kw("index") or self.accept_kw("key"):
             name = self.ident()
             self.expect_kw("on")
@@ -1302,10 +1309,30 @@ class Parser:
                 cols.append(self.ident())
                 self._skip_index_col_opts()
             self.expect_op(")")
+            using = ""
+            params = {}
+            if self.accept_kw("using"):
+                using = self.ident().lower()
+            while self.peek().kind == "IDENT" and \
+                    self.peek().text.lower() in ("lists", "comment"):
+                opt = self.next().text.lower()
+                self.accept_op("=")
+                tok = self.next()
+                if opt == "lists":
+                    try:
+                        params["lists"] = int(tok.text)
+                    except ValueError:
+                        self.error("LISTS expects an integer")
+                else:
+                    params[opt] = tok.text
             return ast.CreateIndexStmt(index_name=name, table=table,
-                                       columns=cols, unique=unique)
+                                       columns=cols, unique=unique,
+                                       vector=vector, using=using,
+                                       params=params)
         if unique:
             self.error("expected INDEX after UNIQUE")
+        if vector:
+            self.error("expected INDEX after VECTOR")
         self.accept_kw("temporary")
         self.expect_kw("table")
         ine = False

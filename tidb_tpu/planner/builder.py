@@ -544,6 +544,15 @@ class PlanBuilder:
             if name == "count" and not args:
                 args = []
             if name in ("sum", "avg") and args and \
+                    getattr(args[0].ft, "is_vector", False):
+                # a vector never coerces to a float: VECTOR in a
+                # numeric aggregate is the conformance-pinned invalid
+                # context (ER 1235), not a silent NaN
+                from ..errors import UnsupportedError
+                raise UnsupportedError(
+                    "aggregate %s is not supported on VECTOR columns",
+                    name)
+            if name in ("sum", "avg") and args and \
                     args[0].ft.tclass in (TypeClass.STRING,
                                           TypeClass.JSON):
                 # MySQL sums strings as doubles (numeric-prefix parse);

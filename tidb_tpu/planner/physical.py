@@ -606,6 +606,30 @@ class PhysTopN(PhysPlan):
                 + f", offset:{self.offset}, count:{self.count}")
 
 
+class PhysVectorSearch(PhysPlan):
+    """ORDER BY vec_*_distance(col, const) LIMIT k lowered to a
+    single-dispatch top-k over the device-resident vector matrix
+    (exact brute force) or the IVF index (ANN, tidb_tpu_vector_nprobe
+    > 0 and an index exists) — tidb_tpu/vector/, docs/VECTOR.md. The
+    wrapped PhysTableReader is the host-parity fallback (dirty-txn
+    overlays, device degradation)."""
+
+    def __init__(self, items, offset, count, reader, metric, col_name,
+                 query):
+        super().__init__([reader], reader.schema)
+        self.items = items
+        self.offset = offset
+        self.count = count
+        self.reader = reader
+        self.metric = metric            # vec_* op name
+        self.col_name = col_name        # storage column name
+        self.query = query              # np.float32 query vector
+
+    def explain_info(self):
+        return (f"{self.metric}({self.col_name}), k:{self.count}, "
+                f"offset:{self.offset}, dim:{len(self.query)}")
+
+
 class PhysLimit(PhysPlan):
     def __init__(self, offset, count, child):
         super().__init__([child], child.schema)
@@ -790,6 +814,10 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         return p
     if isinstance(plan, TopN):
         child = _phys(plan.child)
+        vs = _try_vector_search(plan, child)
+        if vs is not None:
+            vs.stats_rows = plan.stats_rows
+            return vs
         if isinstance(child, PhysTableReader) and not child.dag.aggs and \
                 child.dag.limit < 0 and len(plan.items) == 1 and \
                 plan.offset + plan.count <= 16384 and \
@@ -847,6 +875,56 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
     if isinstance(plan, Dual):
         return PhysDual(plan.schema, plan.rows)
     raise NotImplementedError(f"no physical impl for {type(plan).__name__}")
+
+
+def _try_vector_search(plan: TopN, child) -> PhysVectorSearch | None:
+    """Recognize `ORDER BY vec_*_distance(vector_col, const) LIMIT k`
+    (ascending = nearest-first) over a bare table scan and lower it to
+    PhysVectorSearch (tidb_tpu/vector/). Anything the vector runtime
+    cannot serve bit-identically — filters, DESC, unknown dimension,
+    a malformed or dimension-mismatched query constant (the host path
+    owns the clean ER there), partitioned/virtual tables — keeps the
+    conventional TopN."""
+    from ..vector import METRIC_OPS
+    if not isinstance(child, PhysTableReader):
+        return None
+    dag = child.dag
+    if dag.aggs or dag.group_items or dag.filters or dag.host_filters \
+            or dag.limit >= 0 or dag.topn is not None:
+        return None
+    tbl = dag.table_info
+    if tbl.id <= 0 or tbl.partitions or tbl.view_select:
+        return None
+    if len(plan.items) != 1 or plan.count < 0 or \
+            plan.offset + plan.count > 16384:
+        return None
+    e, desc = plan.items[0]
+    if desc or not isinstance(e, ScalarFunc) or e.op not in METRIC_OPS \
+            or len(e.args) != 2:
+        return None
+    a, b = e.args
+    col, const = (a, b) if isinstance(a, Column) else (b, a)
+    if not isinstance(col, Column) or not isinstance(const, Constant):
+        return None
+    ft = col.ft
+    if ft is None or not getattr(ft, "is_vector", False) or ft.flen <= 0:
+        return None
+    name = next((sc.name for sc in dag.cols if sc.col.idx == col.idx),
+                None)
+    if name is None:
+        return None
+    ci = tbl.find_column(name)
+    if ci is None or not getattr(ci.ft, "is_vector", False):
+        return None
+    qv = const.value
+    if qv is None or qv.is_null or not isinstance(qv.val, str):
+        return None
+    from ..expression.vec import _parse_vec_text
+    q = _parse_vec_text(qv.val)
+    if q is None or len(q) != ft.flen:
+        return None
+    return PhysVectorSearch(plan.items, plan.offset, plan.count, child,
+                            e.op, ci.name, q)
 
 
 def _try_index_range(ds: DataSource) -> PhysPlan | None:

@@ -46,7 +46,8 @@ _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
                 "cast_double", "rand", "pi", "degrees", "radians", "sin",
                 "cos", "tan", "asin", "acos", "atan", "atan2",
                 "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
-                "vec_negative_inner_product", "vec_l2_norm", "cot"}
+                "vec_negative_inner_product", "vec_inner_product",
+                "vec_l2_norm", "cot"}
 _STRING_FUNCS |= {"substring_index", "insert", "quote", "soundex",
                   "to_base64", "from_base64", "sha2", "make_set",
                   "export_set", "inet_ntoa", "dayname", "monthname",
@@ -136,7 +137,33 @@ class Rewriter:
         self.outer_schemas = outer_schemas or []
         self.outer_used = False   # set when a column resolved via outer scope
 
+    # ops a VECTOR operand may legally appear under: the VEC_* family,
+    # equality/ordering comparisons (text collation, the reference
+    # semantics), NULL tests, string casts/render, and control flow.
+    # Everything numeric (arithmetic, SUM/AVG inputs) is ER 1235 —
+    # a vector must never silently coerce to a float (conformance
+    # satellite: VECTOR in an invalid context fails cleanly).
+    _VECTOR_OK_OPS = frozenset({
+        "=", "!=", "<", "<=", ">", ">=", "<=>", "in", "is_null",
+        "isnull", "isnotnull", "istrue", "isfalse",
+        "and", "or", "not", "like", "if", "ifnull", "nullif",
+        "case", "coalesce", "cast_char", "concat", "concat_ws",
+        "length", "octet_length", "char_length", "character_length",
+        "vec_cosine_distance", "vec_l2_distance", "vec_l1_distance",
+        "vec_negative_inner_product", "vec_inner_product",
+        "vec_l2_norm", "vec_dims", "vec_from_text", "vec_as_text"})
+
+    def _check_vector_context(self, op: str, args: list):
+        for a in args:
+            ft = getattr(a, "ft", None)
+            if ft is not None and getattr(ft, "is_vector", False) and \
+                    op not in self._VECTOR_OK_OPS:
+                raise UnsupportedError(
+                    "operator %s is not supported on VECTOR columns",
+                    op)
+
     def mk_func(self, op: str, args: list, ft: FieldType | None = None) -> Expression:
+        self._check_vector_context(op, args)
         if ft is None:
             if op in _DATE_RET_FUNCS:
                 ft = new_date_type()

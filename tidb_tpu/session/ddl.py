@@ -44,6 +44,13 @@ def column_def_to_info(cd: ast.ColumnDef, col_id: int, offset: int) -> ColumnInf
     ft = FieldType(tp=tname, tclass=tclass)
     ft.flen = cd.flen
     ft.decimal = cd.decimal
+    if tname == "vector":
+        from ..types.field_type import VECTOR_MAX_DIM
+        if cd.flen == 0 or cd.flen > VECTOR_MAX_DIM:
+            from ..errors import VectorDimensionError
+            raise VectorDimensionError(
+                "invalid VECTOR dimension %d for column '%s' "
+                "(1..%d)", cd.flen, cd.name, VECTOR_MAX_DIM)
     if tclass == TypeClass.DECIMAL:
         if ft.flen <= 0:
             ft.flen = 10
@@ -461,9 +468,68 @@ class DDLExecutor:
     # ---- indexes / alter ---------------------------------------------
     def create_index(self, stmt: ast.CreateIndexStmt):
         tn = stmt.table
+        if getattr(stmt, "vector", False):
+            return self.create_vector_index(stmt)
         idx_def = ast.IndexDef(name=stmt.index_name, columns=stmt.columns,
                                unique=stmt.unique)
         self._alter_add_index(tn, idx_def)
+
+    def create_vector_index(self, stmt: ast.CreateIndexStmt):
+        """CREATE VECTOR INDEX name ON t (col) USING IVF [LISTS = n]
+        (tidb_tpu/vector/, docs/VECTOR.md). The index is DERIVED state
+        — centroids + posting lists rebuilt on demand from the
+        columnar store, maintained incrementally through the capture
+        seam — so the durable change is meta-only (one IndexInfo row;
+        crash-safe by the meta txn, no backfill ladder: the first
+        search after a restart trains lazily)."""
+        from ..errors import UnsupportedError, VectorDimensionError
+        tn = stmt.table
+        using = (stmt.using or "ivf").lower()
+        if using != "ivf":
+            raise UnsupportedError(
+                "vector index algorithm %s not supported (USING IVF)",
+                using.upper())
+        if len(stmt.columns) != 1:
+            raise UnsupportedError(
+                "a vector index covers exactly one VECTOR column")
+        if stmt.unique:
+            raise UnsupportedError("vector indexes cannot be UNIQUE")
+        db_name = tn.db or self.sess.vars.current_db
+        tbl0 = self.domain.infoschema().table_by_name(db_name, tn.name)
+        ci = tbl0.find_column(stmt.columns[0])
+        if ci is None:
+            raise ColumnNotExistsError(
+                "Key column '%s' doesn't exist in table",
+                stmt.columns[0])
+        if not getattr(ci.ft, "is_vector", False):
+            raise UnsupportedError(
+                "vector index column '%s' must be a VECTOR type",
+                ci.name)
+        if ci.ft.flen <= 0:
+            raise VectorDimensionError(
+                "vector index needs a declared dimension: column "
+                "'%s' is VECTOR without (k)", ci.name)
+        if tbl0.find_index(stmt.index_name) is not None:
+            raise IndexExistsError("Duplicate key name '%s'",
+                                   stmt.index_name)
+        params = {"using": "ivf"}
+        if stmt.params.get("lists"):
+            params["lists"] = int(stmt.params["lists"])
+        col_name = ci.name
+
+        def fn(m):
+            db, tbl = self._get_table(m, tn)
+            if tbl.find_index(stmt.index_name) is not None:
+                raise IndexExistsError("Duplicate key name '%s'",
+                                       stmt.index_name)
+            tbl.indexes.append(IndexInfo(
+                id=max((i.id for i in tbl.indexes), default=0) + 1,
+                name=stmt.index_name, columns=[col_name],
+                vector=True, params=params))
+            m.update_table(db.id, tbl)
+        self._with_meta(fn)
+        # the runtime subscribes to the capture seam from here on
+        self.domain.vector.attach()
 
     def _submit_job(self, job: DDLJob) -> DDLJob:
         """Drive a durable DDL job synchronously (the session's thread
@@ -506,6 +572,19 @@ class DDLExecutor:
         if idx is None:
             raise IndexNotExistsError("index %s doesn't exist",
                                       stmt.index_name)
+        if getattr(idx, "vector", False):
+            # derived state, no KV to delete-range: meta-only removal
+            # + drop the runtime instance
+            name = idx.name
+
+            def fn(m):
+                db, t = self._get_table(m, tn)
+                t.indexes = [i for i in t.indexes
+                             if i.name.lower() != name.lower()]
+                m.update_table(db.id, t)
+            self._with_meta(fn)
+            self.domain.vector.drop_index(tbl.id, name)
+            return
         job = DDLJob(type=TYPE_DROP_INDEX, db_name=db_name,
                      table_name=tbl.name, table_id=tbl.id,
                      schema_state=idx.state,

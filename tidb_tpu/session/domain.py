@@ -215,6 +215,11 @@ class Domain:
         # store consistent
         from ..cdc import ChangefeedManager
         self.cdc = ChangefeedManager(self)
+        # vector search runtime (tidb_tpu/vector/): VECTOR(k) column
+        # residency + IVF index registry; subscribes to the capture
+        # seam lazily when the first vector index appears
+        from ..vector import VectorRuntime
+        self.vector = VectorRuntime(self)
         # incremental HTAP (copr/delta.py): the delta maintainer is
         # the capture seam's second consumer — per-table freshness
         # bookkeeping behind information_schema.tidb_replica_freshness

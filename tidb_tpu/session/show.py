@@ -101,6 +101,9 @@ def exec_show(sess, stmt):
                 lines.append(f"  PRIMARY KEY ({colstr})")
             elif idx.unique:
                 lines.append(f"  UNIQUE KEY `{idx.name}` ({colstr})")
+            elif getattr(idx, "vector", False):
+                lines.append(f"  VECTOR KEY `{idx.name}` ({colstr}) "
+                             "USING IVF")
             else:
                 lines.append(f"  KEY `{idx.name}` ({colstr})")
         ddl = (f"CREATE TABLE `{tbl.name}` (\n" + ",\n".join(lines) +

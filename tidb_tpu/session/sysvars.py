@@ -142,6 +142,12 @@ for _v in [
     # so a too-small pin can never drop rows.
     SysVar("tidb_tpu_mpp_shuffle_cap", SCOPE_BOTH,
            _env_int("TIDB_TPU_MPP_SHUFFLE_CAP", 0), "int", 0, 1 << 24),
+    # vector search (tidb_tpu/vector/, docs/VECTOR.md): IVF partitions
+    # probed per ANN query — the recall/speed trade. 0 disables the
+    # index path entirely (ORDER BY vec_*_distance LIMIT k runs the
+    # exact single-dispatch scan).
+    SysVar("tidb_tpu_vector_nprobe", SCOPE_BOTH,
+           _env_int("TIDB_TPU_VECTOR_NPROBE", 8), "int", 0, 1 << 10),
     SysVar("tidb_join_exec", SCOPE_BOTH, "auto", "enum",
            enum_vals=["auto", "host", "device"]),
     SysVar("last_plan_from_binding", SCOPE_SESSION, False, "bool"),

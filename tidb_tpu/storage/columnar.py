@@ -66,6 +66,12 @@ class ColumnarTable:
         # cid -> [rows_checked, still_clustered]: lazy monotone-order
         # tracker behind is_clustered()
         self._clustered: dict[int, list] = {}
+        # VECTOR(k) fixed-width twin: cid -> [float32[cap, k] matrix,
+        # rows_filled]; append-only like the data arrays (filled
+        # incrementally from the dict-encoded text column by
+        # vector_matrix(); gc() compaction resets it — positions move)
+        self._vecmat: dict = {}
+        self._vecmat_mu = threading.Lock()
         self._init_columns()
 
     def _init_columns(self):
@@ -316,6 +322,8 @@ class ColumnarTable:
         self.delete_ts[:m] = self.delete_ts[idx]
         self.n = m
         self._clustered.clear()    # rows moved: re-verify from scratch
+        with self._vecmat_mu:
+            self._vecmat.clear()   # row positions moved under the twin
         self.gc_epoch += 1
         self._hpos = None          # positions changed: lazy rebuild
         self.version += 1
@@ -361,6 +369,64 @@ class ColumnarTable:
                      (nl if idx is None else nl[idx]) if nl.any() else None,
                      self.dicts.get(ci.id))
         return col
+
+    # ---- VECTOR(k) fixed-width twin -----------------------------------
+    def _vec_parsed_table(self, cid: int, dim: int):
+        """Per-dict parse cache: float32[ncodes, dim] + valid mask,
+        extended only for codes added since the last call (the dict is
+        append-only). Rows that fail to parse or disagree with the
+        declared dimension are NaN/invalid."""
+        sd = self.dicts[cid]
+        vals = sd.values
+        cache = getattr(sd, "_vecmat_cache", None)
+        if cache is None or cache[2] != dim:
+            cache = [np.full((0, dim), np.nan, dtype=np.float32),
+                     0, dim]
+        tab, upto, _d = cache
+        u = len(vals)
+        if u > upto:
+            from ..expression.vec import _parse_vec_text
+            ext = np.full((u - upto, dim), np.nan, dtype=np.float32)
+            for i in range(upto, u):
+                v = _parse_vec_text(vals[i])
+                if v is not None and len(v) == dim:
+                    ext[i - upto] = v
+            tab = np.concatenate([tab, ext]) if upto else ext
+            sd._vecmat_cache = [tab, u, dim]
+        return tab
+
+    def vector_matrix(self, cid: int, dim: int):
+        """The fixed-width columnar form of a VECTOR(dim) column:
+        float32[n, dim], maintained APPEND-ONLY (only rows
+        [filled, n) are decoded per call — the delta contract the
+        device residency and the IVF index fold from). NULL/invalid
+        rows are NaN rows. -> (matrix view [:n], n)."""
+        n = self.n
+        with self._vecmat_mu:
+            st = self._vecmat.get(cid)
+            if st is not None and (st[0].shape[1] != dim):
+                st = None               # dimension changed under DDL
+            if st is None:
+                st = [np.full((max(n, 1024), dim), np.nan,
+                              dtype=np.float32), 0]
+                self._vecmat[cid] = st
+            mat, filled = st
+            if n > len(mat):
+                grown = np.full((max(n, 2 * len(mat)), dim), np.nan,
+                                dtype=np.float32)
+                grown[:filled] = mat[:filled]
+                mat = st[0] = grown
+            if n > filled:
+                tab = self._vec_parsed_table(cid, dim)
+                codes = self.data[cid][filled:n]
+                tail = tab[np.asarray(codes, dtype=np.int64)]
+                nl = self.nulls[cid][filled:n]
+                if nl.any():
+                    tail = tail.copy()
+                    tail[nl] = np.nan
+                mat[filled:n] = tail
+                st[1] = n
+            return mat[:n], n
 
 
 class ColumnarEngine:

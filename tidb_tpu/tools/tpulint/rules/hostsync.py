@@ -42,7 +42,7 @@ import ast
 
 from ..core import Rule, register_rule
 
-SCOPE_PREFIXES = ("tidb_tpu/copr/", "tidb_tpu/mpp/")
+SCOPE_PREFIXES = ("tidb_tpu/copr/", "tidb_tpu/mpp/", "tidb_tpu/vector/")
 
 PREFETCH = ("prefetch", "fetch.prefetch", "utils.fetch.prefetch")
 SEAM = ("host_array", "host_scalar", "host_int",

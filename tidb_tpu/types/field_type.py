@@ -46,7 +46,10 @@ MYSQL_TYPE_NAMES = {
     "date": TypeClass.DATE, "datetime": TypeClass.DATETIME,
     "timestamp": TypeClass.TIMESTAMP, "time": TypeClass.DURATION,
     "json": TypeClass.JSON, "bit": TypeClass.BIT,
-    "vector": TypeClass.STRING,   # text-stored, dict-encoded (VEC_* funcs)
+    # VECTOR(k): text surface ('[1,2,3]' literals, dict-encoded like
+    # JSON) with a fixed-width float32[rows, k] columnar twin behind it
+    # (storage/columnar.py vector_matrix; tidb_tpu/vector/ serves it)
+    "vector": TypeClass.STRING,
     "enum": TypeClass.ENUM, "set": TypeClass.SET,
 }
 
@@ -87,6 +90,12 @@ class FieldType:
         return ft
 
     @property
+    def is_vector(self) -> bool:
+        """VECTOR(k) column (flen holds the declared dimension k;
+        flen <= 0 = undeclared, distance funcs infer per value)."""
+        return self.tp == "vector"
+
+    @property
     def is_numeric(self) -> bool:
         return self.tclass in (TypeClass.INT, TypeClass.UINT, TypeClass.FLOAT,
                                TypeClass.DECIMAL, TypeClass.BIT)
@@ -106,7 +115,8 @@ class FieldType:
             p = self.flen if self.flen > 0 else 10
             d = self.decimal if self.decimal >= 0 else 0
             s += f"({p},{d})"
-        elif self.tp in ("char", "varchar", "binary", "varbinary") and self.flen > 0:
+        elif self.tp in ("char", "varchar", "binary", "varbinary",
+                         "vector") and self.flen > 0:
             s += f"({self.flen})"
         if self.unsigned:
             s += " unsigned"
@@ -158,6 +168,18 @@ def new_timestamp_type(fsp=0, **kw):
 
 def new_null_type():
     return _mk("null", TypeClass.NULLT)
+
+
+# VECTOR(k) dimension ceiling (the reference pkg/types vector limit)
+VECTOR_MAX_DIM = 16383
+
+
+def new_vector_type(dim: int = -1, **kw):
+    """VECTOR(k) (TiDB vector-search surface): STRING type class —
+    the text form '[1,2,3]' is the storage/wire representation — with
+    flen carrying the declared dimension for write-time validation and
+    the fixed-width float32[rows, k] columnar twin."""
+    return _mk("vector", TypeClass.STRING, flen=dim, **kw)
 
 
 _NUMERIC_ORDER = [TypeClass.INT, TypeClass.UINT, TypeClass.BIT,

@@ -82,6 +82,23 @@ SITES: dict[str, str] = {
     "device_guard/fused/kernel": (
         "copr/pipeline.py: fused-kernel dispatch — injected device "
         "errors must retry/degrade host-identical"),
+    # vector search seams (tidb_tpu/vector/; vector_smoke): every one
+    # degrades through guarded_dispatch to a numpy twin — injected
+    # grant loss must leave rows host-identical (exact) / the index
+    # consistent (train/delta)
+    "device_guard/vector/topk": (
+        "vector/runtime.py: exact brute-force top-k dispatch — "
+        "degrade = full host ranking, rows identical"),
+    "device_guard/vector/ivf": (
+        "vector/runtime.py: ANN candidate-scoring dispatch — degrade "
+        "= numpy scoring over the same candidate slate"),
+    "device_guard/vector/train": (
+        "vector/ivf.py: k-means train / centroid-assignment dispatch "
+        "— degrade = numpy Lloyd twin, index still built"),
+    "device_guard/vector/delta": (
+        "vector/runtime.py: resident-matrix tail patch — failure "
+        "drops the entry for a full re-upload (bytes, never "
+        "correctness)"),
     # ---- DML / import seams -------------------------------------------
     "mutation-corrupt-index": (
         "executor/table_rt.py: test hook corrupting derived index "

@@ -813,6 +813,24 @@ DEV_RESIDENT_BYTES = REGISTRY.gauge(
 FRAGMENT_ROUTING = REGISTRY.counter(
     "tidb_tpu_fragment_routing_total",
     "Copr fragment placement decisions by outcome", ("outcome",))
+VECTOR_SEARCH = REGISTRY.counter(
+    "tidb_tpu_vector_search_total",
+    "Vector top-k searches by serving path (exact=single-dispatch "
+    "brute-force kernel, ivf=ANN through the IVF index, "
+    "host_fallback=degraded to the numpy twin — device failure or a "
+    "dirty-transaction overlay)", ("path",))
+VECTOR_NPROBE_PARTITIONS = REGISTRY.counter(
+    "tidb_tpu_vector_nprobe_partitions_total",
+    "IVF partitions probed across ANN searches (sum of effective "
+    "nprobe; rate / search rate = average probe width)")
+VECTOR_INDEX_DELTA = REGISTRY.counter(
+    "tidb_tpu_vector_index_delta_total",
+    "IVF index maintenance by outcome (applied=appended rows "
+    "assigned + folded into posting lists O(delta), advanced="
+    "delete/update tombstones — visibility rides the MVCC mask, "
+    "nothing to fold, rebuild=gc compaction rewrote row positions "
+    "so postings rebuilt from the resident matrix; never fired by a "
+    "write)", ("outcome",))
 SPILLS = REGISTRY.counter(
     "tidb_tpu_spill_total",
     "Blocking-operator disk spills by operator (sort external sort, "

@@ -1,0 +1,23 @@
+"""TPU-native vector search (ISSUE 15; TiDB vector-search surface).
+
+VECTOR(k) columns live in the columnar engine as dict-encoded text with
+a fixed-width float32[rows, k] twin (storage/columnar.py
+vector_matrix). This package keeps that twin device-resident —
+placement-aware, delta-maintained like any base column — and serves
+`ORDER BY vec_*_distance(col, const) LIMIT k` as:
+
+  * EXACT: one tiled matmul + top-k kernel under guarded_dispatch
+    (site vector/topk) meeting the single-dispatch contract, with a
+    host twin for chaos parity;
+  * ANN: an IVF index (CREATE VECTOR INDEX ... USING IVF) — k-means
+    centroids trained on device, per-partition posting lists,
+    tidb_tpu_vector_nprobe picking the recall/speed trade — folded
+    incrementally from commits (the PR 9 delta contract; never a full
+    rebuild on write).
+
+docs/VECTOR.md is the protocol reference; scripts/vector_smoke.py the
+gate.
+"""
+from .runtime import VectorRuntime, METRIC_OPS
+
+__all__ = ["VectorRuntime", "METRIC_OPS"]
