@@ -1,0 +1,311 @@
+"""Online backup/restore + PITR (ISSUE 16; reference br/pkg/backup,
+br/pkg/restore, br/pkg/stream): resolved-ts chunked snapshots, the
+logbackup:// changefeed sink, RESTORE as a resumable DDL job, and the
+typed corruption surface."""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tidb_tpu.session import new_store
+from tidb_tpu.testkit import TestKit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bdir(tmp_path, name="bk"):
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def test_round_trip_identity_multichunk(tmp_path, monkeypatch):
+    """Multi-chunk export (chunk_rows=128 over 500 rows) with dict
+    strings + NULLs round-trips bit-exact; reruns against a complete
+    target are no-ops; the vtable and metrics record the run."""
+    monkeypatch.setenv("TIDB_TPU_BR_CHUNK_ROWS", "128")
+    tk = TestKit()
+    tk.must_exec("create table rt (id int primary key, v int, "
+                 "s varchar(32), d decimal(10,2))")
+    tk.must_exec("insert into rt values " + ",".join(
+        f"({i},{i * 2},'s{i % 5}',{i}.25)" for i in range(1, 401)))
+    tk.must_exec("insert into rt values (401,null,null,null)")
+    tk.must_exec("create table rt2 (a int primary key, b varchar(8))")
+    tk.must_exec("insert into rt2 values (1,'x'),(2,null)")
+    d = _bdir(tmp_path)
+    rs = tk.must_exec(f"backup database test to '{d}'")
+    assert rs.affected == 2            # two tables exported
+    chunks = sorted(os.path.basename(p) for p in
+                    glob.glob(os.path.join(d, "test.rt.chunk*.npz")))
+    assert len(chunks) == 4            # 401 rows / 128 per chunk
+    # re-run against the complete target: checkpointed, zero work
+    assert tk.must_exec(f"backup database test to '{d}'").affected == 0
+    from tidb_tpu.utils.metrics import REGISTRY
+    snap = REGISTRY.snapshot()
+    assert snap['tidb_tpu_backup_total'
+                '{phase="snapshot_run",outcome="ok"}'] == 2
+    assert snap['tidb_tpu_backup_total'
+                '{phase="snapshot_table",outcome="ok"}'] == 2
+    assert snap['tidb_tpu_backup_total'
+                '{phase="snapshot_table",outcome="skipped"}'] == 2
+    # the source vtable shows the backup runs
+    rows = tk.must_query(
+        "select kind, phase, state from "
+        "information_schema.tidb_backup_jobs").rows
+    assert ("backup", "complete", "done") in rows
+
+    tk2 = TestKit()
+    rs = tk2.must_exec(f"restore database test from '{d}'")
+    assert rs.affected == 403
+    assert tk2.must_query("select count(*), sum(v) from rt").rows == \
+        tk.must_query("select count(*), sum(v) from rt").rows
+    assert tk2.must_query("select * from rt where id in (4,401) "
+                          "order by id").rows == \
+        [(4, 8, "s4", "4.25"), (401, None, None, None)]
+    assert tk2.must_query("select * from rt2 order by a").rows == \
+        [(1, "x"), (2, None)]
+    tk2.must_exec("admin check table rt")
+    # restored tables accept writes (id allocators fast-forwarded)
+    tk2.must_exec("insert into rt2 values (3,'z')")
+    assert tk2.must_query("select count(*) from rt2").rows == [(3,)]
+    snap = REGISTRY.snapshot()
+    assert snap['tidb_tpu_restore_rows{stat="imported"}'] == 403
+    assert snap['tidb_tpu_backup_total'
+                '{phase="restore_run",outcome="ok"}'] == 1
+    rows = tk2.must_query(
+        "select kind, phase, state, backup_ts from "
+        "information_schema.tidb_backup_jobs").rows
+    assert any(k == "restore" and p == "done" and s == "synced"
+               and ts > 0 for k, p, s, ts in rows), rows
+
+
+def test_pitr_restores_exact_mid_stream_ts(tmp_path):
+    """Snapshot + logbackup:// changefeed; RESTORE ... UNTIL TS n lands
+    on the exact commit prefix — later inserts/updates/deletes absent,
+    earlier ones present — and a full restore replays everything."""
+    tk = TestKit()
+    tk.must_exec("create table t (id int primary key, v int)")
+    tk.must_exec("insert into t values (1,10),(2,20)")
+    d = _bdir(tmp_path)
+    feed = tk.domain.cdc.create(
+        "lb", f"logbackup://{d}/log/backup.log", auto_start=False)
+    feed._attach()
+    feed.poll_once()
+    tk.must_exec(f"backup database test to '{d}'")
+    tk.must_exec("insert into t values (3,30)")
+    feed.poll_once()
+    mid = tk.domain.storage.oracle.get_ts()
+    tk.must_exec("insert into t values (4,40)")
+    tk.must_exec("update t set v = 999 where id = 1")
+    tk.must_exec("delete from t where id = 2")
+    feed.poll_once()
+    feed.sink.close()
+
+    full = TestKit()
+    full.must_exec(f"restore database test from '{d}'")
+    assert full.must_query("select * from t order by id").rows == \
+        tk.must_query("select * from t order by id").rows
+    full.must_exec("admin check table t")
+
+    pitr = TestKit()
+    pitr.must_exec(f"restore database test from '{d}' until ts {mid}")
+    assert pitr.must_query("select * from t order by id").rows == \
+        [(1, 10), (2, 20), (3, 30)]
+    pitr.must_exec("admin check table t")
+    # replayed rows are index-consistent: point lookup via PK works
+    assert pitr.must_query("select v from t where id = 3").rows == \
+        [(30,)]
+
+
+_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+os.environ["TIDB_TPU_FAILPOINTS"] = "br-restore-checkpoint=crash"
+os.environ["TIDB_TPU_BR_CHUNK_ROWS"] = "256"
+from tidb_tpu.session import new_store
+from tidb_tpu.testkit import TestKit
+dom = new_store({dd!r})
+tk = TestKit(dom)
+tk.must_exec("create table big (id int primary key, v int)")
+for b in range(4):
+    tk.must_exec("insert into big values " + ",".join(
+        "(%d,%d)" % (i, i * 3) for i in range(b * 250, b * 250 + 250)))
+tk.must_exec("backup database test to {bd!r}")
+tk.must_exec("drop table big")
+tk.must_exec("restore database test from {bd!r}")
+print("UNREACHED", flush=True)
+"""
+
+
+def test_restore_resumes_after_kill9(tmp_path):
+    """kill -9 at the first durable restore checkpoint: reopening the
+    store re-enters the parked TYPE_RESTORE job (resume_pending) and
+    finishes it — exact row count, no duplicates, job synced."""
+    dd = str(tmp_path / "dd")
+    bd = _bdir(tmp_path)
+    script = _CRASH_CHILD.format(repo=REPO, dd=dd, bd=bd)
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, timeout=180)
+    assert r.returncode == 137, r.stderr[-800:]
+    assert b"UNREACHED" not in r.stdout
+    os.environ["TIDB_TPU_BR_CHUNK_ROWS"] = "256"
+    try:
+        dom = new_store(dd)
+    finally:
+        os.environ.pop("TIDB_TPU_BR_CHUNK_ROWS", None)
+    tk = TestKit(dom)
+    assert tk.must_query(
+        "select count(*), count(distinct id), sum(v) from big").rows \
+        == [(1000, 1000, str(3 * sum(range(1000))))]
+    tk.must_exec("admin check table big")
+    rows = tk.must_query(
+        "select phase, state from information_schema.tidb_backup_jobs "
+        "where kind = 'restore'").rows
+    assert ("done", "synced") in rows, rows
+
+
+def test_corrupt_chunk_rejected_and_rolled_back(tmp_path):
+    """A bit-flipped or truncated chunk fails with the typed
+    BackupChecksumMismatchError — and the failed restore's rollback
+    drops every table the job created (target left as it was)."""
+    from tidb_tpu.errors import BackupChecksumMismatchError
+    tk = TestKit()
+    tk.must_exec("create table c (id int primary key, v varchar(8))")
+    tk.must_exec("insert into c values (1,'a'),(2,'b')")
+    d = _bdir(tmp_path)
+    tk.must_exec(f"backup database test to '{d}'")
+    chunk = glob.glob(os.path.join(d, "*.chunk000.npz"))[0]
+    raw = open(chunk, "rb").read()
+    with open(chunk, "wb") as f:       # single flipped byte
+        f.write(raw[:100] + bytes([raw[100] ^ 0xFF]) + raw[101:])
+    tk2 = TestKit()
+    e = tk2.exec_err(f"restore database test from '{d}'")
+    assert isinstance(e, BackupChecksumMismatchError)
+    assert e.code == 8161
+    assert tk2.must_query("show tables").rows == []
+    with open(chunk, "wb") as f:       # torn mid-object
+        f.write(raw[:len(raw) // 2])
+    e = tk2.exec_err(f"restore database test from '{d}'")
+    assert isinstance(e, BackupChecksumMismatchError)
+    assert tk2.must_query("show tables").rows == []
+    # repaired artifact restores fine afterwards
+    with open(chunk, "wb") as f:
+        f.write(raw)
+    tk2.must_exec(f"restore database test from '{d}'")
+    assert tk2.must_query("select * from c order by id").rows == \
+        [(1, "a"), (2, "b")]
+
+
+def test_restore_typed_error_surface(tmp_path):
+    """RestoreTargetNotEmptyError on a name collision;
+    RestoreTsBelowBackupError when UNTIL TS predates the snapshot."""
+    from tidb_tpu.errors import (RestoreTargetNotEmptyError,
+                                 RestoreTsBelowBackupError)
+    tk = TestKit()
+    tk.must_exec("create table e1 (id int primary key)")
+    tk.must_exec("insert into e1 values (1)")
+    d = _bdir(tmp_path)
+    tk.must_exec(f"backup database test to '{d}'")
+    busy = TestKit()
+    busy.must_exec("create table e1 (id int primary key)")
+    e = busy.exec_err(f"restore database test from '{d}'")
+    assert isinstance(e, RestoreTargetNotEmptyError) and e.code == 8162
+    fresh = TestKit()
+    e = fresh.exec_err(f"restore database test from '{d}' until ts 1")
+    assert isinstance(e, RestoreTsBelowBackupError) and e.code == 8163
+
+
+def test_backup_during_ddl_storm_restores_consistent_schema(tmp_path):
+    """Schema captured once at backup time: columns dropped before the
+    export never leak into the manifest, and adds that postdate the
+    captured plan surface as NULL — the restore target's schema always
+    matches its data."""
+    tk = TestKit()
+    tk.must_exec("create table s1 (id int primary key, a int, b int)")
+    tk.must_exec("insert into s1 values (1,10,100),(2,20,200)")
+    tk.must_exec("alter table s1 drop column a")
+    tk.must_exec("alter table s1 add column c varchar(8)")
+    tk.must_exec("insert into s1 values (3,300,'x')")
+    d = _bdir(tmp_path)
+    tk.must_exec(f"backup database test to '{d}'")
+    tk2 = TestKit()
+    tk2.must_exec(f"restore database test from '{d}'")
+    assert tk2.must_query("select * from s1 order by id").rows == \
+        [(1, 100, None), (2, 200, None), (3, 300, "x")]
+    tk2.must_exec("admin check table s1")
+    # the restored table's live schema has the post-DDL column set
+    cols = [r[0] for r in tk2.must_query("show columns from s1").rows]
+    assert cols == ["id", "b", "c"]
+
+
+def test_log_backup_torn_tail_replays_to_last_whole_txn(tmp_path):
+    """Satellite (b): the log-backup file reuses the WAL2 frame format
+    and WalWriter.valid_prefix() torn-tail discipline — a crash-torn
+    tail is truncated on reopen and replay stops at the last whole
+    txn, never a partial one."""
+    from tidb_tpu.br import logformat
+    tk = TestKit()
+    tk.must_exec("create table lt (id int primary key, v int)")
+    d = _bdir(tmp_path)
+    log = os.path.join(d, "log", "backup.log")
+    feed = tk.domain.cdc.create(
+        "lb", f"logbackup://{log}", auto_start=False)
+    feed._attach()
+    feed.poll_once()
+    tk.must_exec(f"backup database test to '{d}'")
+    tk.must_exec("insert into lt values (1,10)")
+    tk.must_exec("insert into lt values (2,20)")
+    feed.poll_once()
+    feed.sink.close()
+    whole = [r for r in logformat.scan(log) if r[0] == "txn"]
+    assert len(whole) >= 2
+    # simulate a crash mid-append: garbage + half a frame at the tail
+    with open(log, "ab") as f:
+        f.write(b"\x21\x00\x00\x00\xde\xad\xbe\xefWAL2torn")
+    torn = [r for r in logformat.scan(log) if r[0] == "txn"]
+    assert torn == whole               # scan stops at the torn tail
+    # restore replays exactly the whole txns
+    tk2 = TestKit()
+    tk2.must_exec(f"restore database test from '{d}'")
+    assert tk2.must_query("select * from lt order by id").rows == \
+        [(1, 10), (2, 20)]
+    # a reopened sink truncates the torn tail (valid_prefix) and
+    # appends cleanly after it
+    from tidb_tpu.cdc.sinks import LogBackupSink
+    s2 = LogBackupSink(log)
+    assert s2.resume_ts() == feed.sink.check.last_resolved
+    s2.flush_resolved(s2.resume_ts() + 1)
+    s2.close()
+    again = [r for r in logformat.scan(log) if r[0] == "txn"]
+    assert again == whole
+
+
+def test_backup_incomplete_target_and_mixed_dbset(tmp_path):
+    """Restoring an incomplete backup fails cleanly; backing up a
+    DIFFERENT database set into a finished target is refused with the
+    typed BackupTargetExistsError."""
+    from tidb_tpu.errors import BackupTargetExistsError, TiDBError
+    import json
+    tk = TestKit()
+    tk.must_exec("create table i1 (id int primary key)")
+    d = _bdir(tmp_path)
+    tk.must_exec(f"backup database test to '{d}'")
+    mpath = os.path.join(d, "backupmeta.json")
+    m = json.load(open(mpath))
+    assert m["complete"] and int(m["version"]) >= 2
+    assert m["backup_ts"] > 0
+    # different-dbset reuse refused
+    tk.must_exec("create database other")
+    tk.must_exec("use other")
+    tk.must_exec("create table o1 (id int primary key)")
+    e = tk.exec_err(f"backup database other to '{d}'")
+    assert isinstance(e, BackupTargetExistsError) and e.code == 8160
+    # incomplete manifest -> restore refuses with a clear message
+    m["complete"] = False
+    json.dump(m, open(mpath, "w"))
+    tk2 = TestKit()
+    e = tk2.exec_err(f"restore database test from '{d}'")
+    assert isinstance(e, TiDBError) and "incomplete" in str(e)

@@ -451,3 +451,57 @@ def test_vector_error_codes_and_sqlstates(tk):
                 "select e - e from vconf"):
         e = tk.exec_err(sql)
         assert e.code == 1235, sql
+
+
+def test_backup_error_codes_and_sqlstates(tk, tmp_path):
+    """BR ER surface (ISSUE 16 satellite): finished-target reuse ->
+    ER 8160, corrupt chunk -> ER 8161, non-empty restore target ->
+    ER 8162, UNTIL TS below the snapshot -> ER 8163 — pinned on the
+    catalog (information_schema.tidb_errors) AND live raised errors."""
+    import glob
+    import os
+    rows = dict((code, (name, state)) for name, code, state in
+                tk.must_query(
+        "select error, code, sqlstate from "
+        "information_schema.tidb_errors "
+        "where code between 8160 and 8163").rows)
+    assert rows == {
+        8160: ("BackupTargetExistsError", "HY000"),
+        8161: ("BackupChecksumMismatchError", "HY000"),
+        8162: ("RestoreTargetNotEmptyError", "HY000"),
+        8163: ("RestoreTsBelowBackupError", "HY000")}, rows
+    from tidb_tpu.errors import (BackupChecksumMismatchError,
+                                 BackupTargetExistsError,
+                                 RestoreTargetNotEmptyError,
+                                 RestoreTsBelowBackupError)
+    assert (BackupTargetExistsError.code,
+            BackupChecksumMismatchError.code,
+            RestoreTargetNotEmptyError.code,
+            RestoreTsBelowBackupError.code) == (8160, 8161, 8162, 8163)
+    src = TestKit()
+    src.must_exec("create table bre (id int primary key)")
+    src.must_exec("insert into bre values (1)")
+    d = str(tmp_path / "bk")
+    src.must_exec(f"backup database test to '{d}'")
+    # live ER 8160: reusing the finished target for another db set
+    src.must_exec("create database bro")
+    src.must_exec("use bro")
+    src.must_exec("create table brx (id int primary key)")
+    e = src.exec_err(f"backup database bro to '{d}'")
+    assert (e.code, e.sqlstate) == (8160, "HY000")
+    # live ER 8163: PITR target below the snapshot consistency point
+    fresh = TestKit()
+    e = fresh.exec_err(f"restore database test from '{d}' until ts 1")
+    assert (e.code, e.sqlstate) == (8163, "HY000")
+    # live ER 8162: the target already holds a clashing table
+    fresh.must_exec("create table bre (id int primary key)")
+    e = fresh.exec_err(f"restore database test from '{d}'")
+    assert (e.code, e.sqlstate) == (8162, "HY000")
+    # live ER 8161: one flipped byte in a chunk
+    chunk = glob.glob(os.path.join(d, "*.chunk000.npz"))[0]
+    raw = open(chunk, "rb").read()
+    with open(chunk, "wb") as f:
+        f.write(raw[:50] + bytes([raw[50] ^ 0xFF]) + raw[51:])
+    clean = TestKit()
+    e = clean.exec_err(f"restore database test from '{d}'")
+    assert (e.code, e.sqlstate) == (8161, "HY000")

@@ -45,7 +45,7 @@ def test_backup_restore_via_object_storage(tk):
     tk.must_exec("backup database test to 's3://brbkt/snap'")
     objs = sorted(_MEM_BUCKETS["brbkt"])
     assert "snap/backupmeta.json" in objs, objs
-    assert "snap/test.os1.npz" in objs, objs
+    assert "snap/test.os1.chunk000.npz" in objs, objs
     tk2 = TestKit()
     tk2.must_exec("restore database test from 's3://brbkt/snap'")
     tk2.must_query("select * from os1 order by id").check(

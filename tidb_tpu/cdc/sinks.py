@@ -259,9 +259,82 @@ class TableSink:
         return rs.rows
 
 
+class LogBackupSink:
+    """Continuous log backup (reference br/pkg/stream log files +
+    TiCDC storage sink): every transaction's RECORD mutations append as
+    a WAL-framed entry to one durable log file, resolved-ts watermarks
+    interleave as marker frames, and `flush_resolved` is the
+    durability point (data frames fsync BEFORE the marker that vouches
+    for them). Opening the sink truncates a crash-torn tail with
+    `wal.valid_prefix` — the WalWriter contract reused — and resumes
+    from the largest marker in the valid prefix, so the feed
+    re-delivers anything the tail lost (PITR replay dedups on
+    commit_ts order: br/restore.py).
+
+    Pointing the path INSIDE a snapshot-backup directory
+    (`<backup>/log/backup.log`) is what arms `RESTORE ... UNTIL TS`."""
+
+    name = "logbackup"
+
+    def __init__(self, path: str, source_domain=None):
+        from ..br import logformat
+        self._fmt = logformat
+        self.path = path
+        self.source = source_domain
+        self._resume = logformat.last_resolved(path) \
+            if os.path.exists(path) else 0
+        self._f = logformat.open_for_append(path)
+        self.check = _ContractChecker()
+        self.check.last_resolved = self._resume
+
+    def _wall(self, commit_ts: int) -> float:
+        try:
+            return self.source.storage.oracle.wall_for_ts(commit_ts)
+        except Exception:
+            import time
+            return time.time()
+
+    def emit_txn(self, events):
+        from ..storage import wal as walmod
+        commit_ts = events[0].commit_ts
+        self.check.on_txn(commit_ts)
+        muts = [(ev.key, ev.value) for ev in events]
+        self._f.write(self._fmt.frame(walmod.encode_frame_payload(
+            commit_ts, muts, self._wall(commit_ts))))
+
+    def emit_ddl(self, event):
+        self._f.write(self._fmt.frame(self._fmt.encode_ddl(
+            event.commit_ts, event.schema_version)))
+
+    def flush_resolved(self, ts: int):
+        self.check.on_resolved(ts)
+        # data first, marker second, both under fsync: the marker may
+        # only ever vouch for frames that are already durable
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.write(self._fmt.frame(self._fmt.encode_resolved(ts)))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        metrics_util.BACKUP_TOTAL.labels("log_flush", "ok").inc()
+
+    def resume_ts(self) -> int:
+        """Largest resolved marker that survived in the valid prefix:
+        everything above it must be re-delivered (at-least-once; the
+        replay side dedups)."""
+        return self._resume
+
+    def close(self):
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+        except (OSError, ValueError):
+            pass
+
+
 def make_sink(uri: str, source_domain):
     """Sink factory for ADMIN CHANGEFEED CREATE ... SINK '<uri>':
-    blackhole:// | file://<path> | mirror://"""
+    blackhole:// | file://<path> | mirror:// | logbackup://<path>"""
     from ..errors import TiDBError
     u = uri.strip()
     if u in ("blackhole", "blackhole://"):
@@ -273,8 +346,16 @@ def make_sink(uri: str, source_domain):
         return NdjsonSink(path)
     if u in ("mirror", "mirror://"):
         return TableSink(source_domain)
+    if u.startswith("logbackup://"):
+        path = u[len("logbackup://"):]
+        if not path:
+            raise TiDBError(
+                "log-backup sink needs a path: logbackup:///bk/log/"
+                "backup.log")
+        return LogBackupSink(path, source_domain)
     raise TiDBError("unknown changefeed sink uri '%s' (expected "
-                    "blackhole://, file://<path> or mirror://)", uri)
+                    "blackhole://, file://<path>, mirror:// or "
+                    "logbackup://<path>)", uri)
 
 
 def observe_sink_delivery(feed_name: str, sink_name: str, n_rows: int):

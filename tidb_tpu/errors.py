@@ -96,6 +96,20 @@ DeviceUnavailableError = _err("DeviceUnavailableError", 9013)
 # stale-command class (errno 9010). NOT retryable against the same
 # worker: the topology moved; refresh the epoch/topology and re-route.
 ClusterEpochStaleError = _err("ClusterEpochStaleError", 9010)
+# Backup/restore (tidb_tpu/br; reference br/errors.go BR error class).
+# 8160: BACKUP DATABASE aimed at a target that already holds a COMPLETE
+# backup of a different database set (resuming the SAME set is the
+# checkpoint skip path, not an error).
+BackupTargetExistsError = _err("BackupTargetExistsError", 8160)
+# 8161: a chunk file failed its manifest crc32 / failed to decode
+# (truncated or bit-flipped artifact) — restore refuses loudly.
+BackupChecksumMismatchError = _err("BackupChecksumMismatchError", 8161)
+# 8162: RESTORE would recreate a table that already exists in the
+# target (or collide with an existing table id).
+RestoreTargetNotEmptyError = _err("RestoreTargetNotEmptyError", 8162)
+# 8163: RESTORE ... UNTIL TS below the snapshot's backup_ts — the log
+# only covers (backup_ts, now].
+RestoreTsBelowBackupError = _err("RestoreTsBelowBackupError", 8163)
 # Privilege
 AccessDeniedError = _err("AccessDeniedError", 1045, "28000")
 PrivilegeCheckFailError = _err("PrivilegeCheckFailError", 1142, "42000")

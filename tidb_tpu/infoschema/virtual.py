@@ -302,6 +302,31 @@ def _gen_ddl_jobs(domain):
                j.error or "")
 
 
+def _gen_backup_jobs(domain):
+    """Backup runs + restore jobs (tidb_tpu/br): backup runs are
+    in-memory records on the domain (a backup is driven by its
+    session, not the job queue); restore jobs are the durable
+    TYPE_RESTORE rows from the DDL job queue/history, with their
+    phase/checkpoint pulled out of job.args."""
+    for r in getattr(domain, "_br_runs", []):
+        yield (int(r["id"]), r["kind"], r["phase"], r["state"],
+               int(r["backup_ts"]), int(r["bytes"]),
+               str(r["checkpoint"] or ""), str(r["error"] or ""))
+    runner = getattr(domain, "ddl_jobs", None)
+    if runner is None:
+        return
+    from ..models.job import TYPE_RESTORE
+    for j in runner.list_jobs():
+        if j.type != TYPE_RESTORE:
+            continue
+        a = j.args or {}
+        ckpt = "tables=%d replay_ts=%d" % (
+            len(a.get("tables_done", [])), int(a.get("replay_ts") or 0))
+        yield (j.id, "restore", str(a.get("phase", "")), j.state,
+               int(a.get("backup_ts") or 0), int(a.get("bytes") or 0),
+               ckpt, j.error or "")
+
+
 def _gen_resource_groups(domain):
     for g in domain.resource_groups.groups.values():
         limit = ""
@@ -528,6 +553,11 @@ VIRTUAL_DEFS = {
                        ("checkpoint_handle", _I()),
                        ("start_time", _F()), ("error", _S())),
                  _gen_ddl_jobs),
+    "tidb_backup_jobs": (_cols(("job_id", _I()), ("kind", _S()),
+                               ("phase", _S()), ("state", _S()),
+                               ("backup_ts", _I()), ("bytes", _I()),
+                               ("checkpoint", _S()), ("error", _S())),
+                         _gen_backup_jobs),
     "placement_policies": (_cols(("policy_name", _S()),
                                  ("settings", _S()),
                                  ("attached_tables", _S())),

@@ -64,6 +64,14 @@ class Mutator:
         self.txn.set(_K_NEXT_ID, str(nxt).encode())
         return nxt
 
+    def ensure_global_id_above(self, floor: int):
+        """Bump the id allocator past ``floor`` (restore recreates
+        tables with their ORIGINAL ids — later DDL must never mint a
+        colliding id)."""
+        cur = self.txn.get(_K_NEXT_ID)
+        if (int(cur) if cur is not None else 0) < floor:
+            self.txn.set(_K_NEXT_ID, str(floor).encode())
+
     def schema_version(self) -> int:
         v = self.txn.get(_K_SCHEMA_VER)
         return int(v) if v is not None else 0

@@ -45,6 +45,10 @@ TYPE_ADD_INDEX = "add index"
 TYPE_DROP_INDEX = "drop index"
 TYPE_EXCHANGE_PARTITION = "exchange partition"
 TYPE_MODIFY_COLUMN = "modify column"
+# restore-as-a-job (tidb_tpu/br/restore.py): RESTORE DATABASE runs
+# through the same durable queue so kill -9 mid-restore resumes from
+# the per-table checkpoint instead of leaving a half-imported cluster
+TYPE_RESTORE = "restore"
 
 
 @dataclass

@@ -48,7 +48,7 @@ from ..models.job import (
     STATE_QUEUEING, STATE_RUNNING, STATE_CANCELLING, STATE_ROLLINGBACK,
     STATE_SYNCED, STATE_CANCELLED,
     TYPE_ADD_INDEX, TYPE_DROP_INDEX, TYPE_EXCHANGE_PARTITION,
-    TYPE_MODIFY_COLUMN)
+    TYPE_MODIFY_COLUMN, TYPE_RESTORE)
 from ..errors import (TiDBError, WriteConflictError, TableNotExistsError,
                       DatabaseNotExistsError, DDLJobCancelledError,
                       DDLJobNotFoundError, CancelFinishedDDLError,
@@ -399,6 +399,7 @@ class DDLJobRunner:
             TYPE_DROP_INDEX: self._run_drop_index,
             TYPE_EXCHANGE_PARTITION: self._run_exchange_partition,
             TYPE_MODIFY_COLUMN: self._run_modify_column,
+            TYPE_RESTORE: self._run_restore,
         }.get(job.type)
         if handler is None:
             return self._rollback(job, TiDBError(
@@ -483,6 +484,14 @@ class DDLJobRunner:
         # terminal step through its cancel-honoring core manually
         self._terminal_txn(job, publish)
         self._mark(job, STATE_SYNCED)
+
+    def _run_restore(self, job, cancel_check):
+        """RESTORE DATABASE as a resumable job — the phase machine
+        lives in br/restore.py (schema -> import -> replay); this
+        runner contributes the durable queue, the checkpointed step
+        txns and restart re-entry via resume_pending."""
+        from ..br import restore as br_restore
+        br_restore.run_restore_job(self, job, cancel_check)
 
     def _set_index_state(self, job, name, state):
         def step(m):
@@ -667,6 +676,9 @@ class DDLJobRunner:
                 self._rollback_add_index(job)
             elif job.type == TYPE_DROP_INDEX:
                 self._rollback_drop_index(job)
+            elif job.type == TYPE_RESTORE:
+                from ..br import restore as br_restore
+                br_restore.rollback_restore(self, job)
             # exchange partition / modify column apply in one terminal
             # txn — a rolling-back job has nothing durable to undo
             job.state = STATE_CANCELLED

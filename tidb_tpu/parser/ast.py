@@ -766,7 +766,8 @@ class BRStmt(StmtNode):
     kind: str = "backup"       # backup | restore | backup_log
     db: str = ""               # empty = all user databases
     path: str = ""
-    until: str = ""            # RESTORE ... UNTIL TIMESTAMP (PITR)
+    until: str = ""            # RESTORE ... UNTIL TIMESTAMP (wallclock)
+    until_ts: int = 0          # RESTORE ... UNTIL TS n (commit-ts PITR)
 
 
 @dataclass

@@ -445,8 +445,11 @@ class Parser:
             self.expect_kw("to") if kw == "backup" else self.expect_kw("from")
             stmt.path = self.next().text
             if kw == "restore" and self.accept_kw("until"):
-                self.expect_kw("timestamp")
-                stmt.until = self.next().text
+                if self.accept_kw("ts"):
+                    stmt.until_ts = int(self.next().text)
+                else:
+                    self.expect_kw("timestamp")
+                    stmt.until = self.next().text
             return stmt
         if kw in ("signal", "resignal"):
             self.next()

@@ -171,6 +171,10 @@ class Domain:
         # paths — reading an int attr per point op instead of a
         # meta-KV schema-version probe (~17us) keeps the hot path hot
         self.schema_epoch = 0
+        # backup run records (tidb_tpu/br/snapshot.py) — the in-memory
+        # half of information_schema.tidb_backup_jobs (restore jobs are
+        # durable DDLJob rows and come from the job queue instead)
+        self._br_runs: list = []
         from ..bindinfo import BindHandle
         self.bind_handle = BindHandle()   # GLOBAL plan baselines
         from .resource_group import ResourceGroupManager

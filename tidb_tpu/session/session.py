@@ -967,18 +967,26 @@ class Session:
                                            u.user, u.host)
             return ResultSet()
         if isinstance(stmt, ast.BRStmt):
-            from ..tools import br
             self.commit()
             if stmt.kind == "backup_log":
-                n = br.backup_log(self.domain, stmt.path)
+                # legacy one-shot WAL copy (wallclock PITR); the
+                # continuous log backup is the logbackup:// changefeed
+                # sink (tidb_tpu/br)
+                from ..tools import br as legacy_br
+                n = legacy_br.backup_log(self.domain, stmt.path)
             elif stmt.kind == "backup":
-                n = br.backup(self.domain, stmt.db, stmt.path)
+                from .. import br
+                n = br.run_backup(self.domain, stmt.db, stmt.path)
             elif stmt.until:
+                from ..tools import br as legacy_br
                 from ..types.time_types import parse_datetime
-                n = br.restore_pitr(self.domain, stmt.path,
-                                    parse_datetime(stmt.until) / 1e6)
+                n = legacy_br.restore_pitr(
+                    self.domain, stmt.path,
+                    parse_datetime(stmt.until) / 1e6)
             else:
-                n = br.restore(self.domain, stmt.db, stmt.path)
+                from .. import br
+                n = br.submit_restore(self.domain, stmt.db, stmt.path,
+                                      until_ts=stmt.until_ts or None)
             return ResultSet(affected=n)
         # DDL: implicit commit first (MySQL semantics)
         ddl_map = {

@@ -136,6 +136,32 @@ SITES: dict[str, str] = {
         "cluster/rpc.py: the frame dribbles out in small chunks with "
         "delays — slow links must stay correct (no torn-frame "
         "misclassification, no double-apply), only slower"),
+    # ---- backup / restore seams (tidb_tpu/br/; backup_smoke) ----------
+    "br-manifest-write": (
+        "br/snapshot.py: a table's chunks are durable, the manifest "
+        "checkpoint recording it is not — a re-run re-exports the "
+        "table (chunk puts are atomic and idempotent), never a "
+        "manifest pointing at missing chunks"),
+    "br-backup-chunk": (
+        "br/snapshot.py: one chunk object written — a crash here "
+        "leaves the table off the done-list; the re-run re-exports "
+        "every chunk of the table at the SAME backup_ts"),
+    "br-restore-pre-swap": (
+        "br/restore.py: schema recreated (original table ids), job "
+        "phase=import not yet committed — restart re-enters the "
+        "schema phase idempotently (existing ids are kept, not "
+        "duplicated)"),
+    "br-restore-replay": (
+        "br/restore.py: one log-backup transaction applied through "
+        "the ingest/apply_replay seam — restart resumes from the "
+        "replay_ts checkpoint; re-applying a frame at the same "
+        "commit_ts converges (same keys, same versions)"),
+    "br-restore-checkpoint": (
+        "br/restore.py: a chunk/table import (durable bulk segment) "
+        "or replay batch + its job checkpoint committed — restart "
+        "continues at the recorded table/row position, not from "
+        "scratch (the durable ctab row count is the truth for "
+        "chunks, replay_ts for the log)"),
     "cdc-poll": (
         "cdc/changefeed.py: worker poll loop — injected errors "
         "backoff, hard kills resume from checkpoint-ts"),
@@ -171,6 +197,18 @@ NET_SITES = (
     "cluster/net/dup",
     "cluster/net/partial-close",
     "cluster/net/trickle",
+)
+
+
+# the backup/restore seams scripts/backup_smoke.py kills at (each is a
+# child-process kill -9 case × concurrent write load; resume must end
+# row-identical to the source at the target ts)
+BR_SITES = (
+    "br-manifest-write",
+    "br-backup-chunk",
+    "br-restore-pre-swap",
+    "br-restore-replay",
+    "br-restore-checkpoint",
 )
 
 

@@ -852,6 +852,22 @@ DDL_BACKFILL = REGISTRY.gauge(
     "(done=rows whose index entries committed, total=live rows at job "
     "start; done resumes from the durable checkpoint after a restart)",
     ("stat",))
+BACKUP_TOTAL = REGISTRY.counter(
+    "tidb_tpu_backup_total",
+    "Backup/restore unit outcomes by phase (snapshot_table=one table's "
+    "chunks + manifest checkpoint committed, snapshot_run=a whole "
+    "BACKUP DATABASE statement, restore_table=one table imported and "
+    "checkpointed, restore_run=a whole RESTORE job, log_flush=a log-"
+    "backup sink resolved-ts flush) and outcome (ok/error/skipped — "
+    "skipped = the table was already in the manifest done-list)",
+    ("phase", "outcome"))
+RESTORE_ROWS = REGISTRY.gauge(
+    "tidb_tpu_restore_rows",
+    "Progress of the currently running restore job by stat (imported="
+    "rows bulk-loaded from snapshot chunks, replayed=rows applied from "
+    "the log backup, total=imported+replayed; resumes from the durable "
+    "job checkpoint after a restart)",
+    ("stat",))
 MEM_PRESSURE = REGISTRY.counter(
     "tidb_tpu_mem_pressure_total",
     "Memory-pressure protocol outcomes (evict=resident HBM entries "
