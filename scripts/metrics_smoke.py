@@ -3,15 +3,22 @@
 parse it with the strict Prometheus text parser (utils/metrics
 .parse_text), and fail on malformed lines or histogram invariant
 violations (`_count` == +Inf bucket, `_sum` >= 0, cumulative buckets
-monotone). Also checks the labeled statement-latency histogram exists
-and that information_schema.tidb_top_sql attributed device (or host)
-time per digest. The pytest fast mode lives in tests/test_metrics.py.
+monotone). Also checks the labeled statement-latency histogram exists,
+that information_schema.tidb_top_sql attributed device (or host)
+time per digest, that information_schema.tidb_plan_feedback holds
+finite cardinality drift with real actuals after the slice, and — in a
+2-worker cluster phase — that a mesh-routed query's trace carries at
+least one worker-side span correlated by trace_id (the distributed-
+tracing contract, docs/OBSERVABILITY.md). The pytest fast mode lives
+in tests/test_metrics.py.
 
 Usage:  JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
-Env:    SMOKE_SF (0.02), SMOKE_QUERIES (q1,q3,q6,q14)
-Exit:   0 clean scrape + nonzero per-digest attribution; 1 otherwise.
+Env:    SMOKE_SF (0.02), SMOKE_QUERIES (q1,q3,q6,q14),
+        SMOKE_CLUSTER (1; 0 skips the 2-worker trace phase)
+Exit:   0 clean scrape + attribution + feedback + cluster trace; 1.
 """
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -79,6 +86,34 @@ def main():
         print(f"# top_sql: dev={dev:.1f}ms host={host:.1f}ms n={cnt} "
               f"{text[:60]!r}", file=sys.stderr)
 
+    # plan feedback: the slice's statements folded their runtime-stats
+    # trees into the per-digest store — non-empty, actual rows observed,
+    # drift finite and >= 1 (the q-error contract)
+    fb = tk.must_query(
+        "select op, calls, avg_act_rows, max_drift, mean_drift "
+        "from information_schema.tidb_plan_feedback "
+        "order by max_drift desc").rows
+    if not fb:
+        failures.append("tidb_plan_feedback is empty after the slice")
+    else:
+        if not any(float(r[2]) > 0 for r in fb):
+            failures.append("tidb_plan_feedback recorded no actual rows")
+        for op, calls, act, mx, mean in fb:
+            if not (1.0 <= float(mx) < 1e12) or \
+                    not (1.0 <= float(mean) <= float(mx) + 1e-9):
+                failures.append(
+                    f"plan_feedback drift out of contract: {op} "
+                    f"max={mx} mean={mean}")
+        for op, calls, act, mx, mean in fb[:5]:
+            print(f"# plan_feedback: {op} calls={calls} act={act} "
+                  f"max_drift={mx} mean={mean}", file=sys.stderr)
+    cdh = families.get("tidb_tpu_cardinality_drift")
+    if cdh is None or cdh["type"] != "histogram":
+        failures.append("tidb_tpu_cardinality_drift histogram missing")
+
+    if os.environ.get("SMOKE_CLUSTER", "1") != "0":
+        failures.extend(cluster_trace_phase())
+
     if failures:
         print("METRICS SMOKE FAIL", file=sys.stderr)
         for f in failures:
@@ -86,6 +121,83 @@ def main():
         return 1
     print("METRICS SMOKE PASS", file=sys.stderr)
     return 0
+
+
+def cluster_trace_phase():
+    """2-worker cluster phase: a mesh-routed aggregation's trace must
+    hold >= 1 worker-side span correlated to the coordinator root by
+    trace_id, visible both in the tracer ring and through
+    information_schema.tidb_trace_events."""
+    failures = []
+    procs, ports = [], []
+    env = dict(os.environ, TIDB_TPU_PLATFORM="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tidb_tpu.cluster.worker", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=_REPO, text=True)
+        line = p.stdout.readline().strip()
+        if not line.startswith("WORKER_READY"):
+            raise RuntimeError(f"worker failed to start: {line!r}")
+        procs.append(p)
+        return int(line.split()[1])
+
+    from tidb_tpu.cluster import Cluster
+    cl = None
+    try:
+        for _ in range(2):
+            ports.append(spawn())
+        cl = Cluster(ports)
+        cl.ddl("create table smk (id int primary key, v int)")
+        cl.workers[0].call({"op": "load_sql", "sqls": [
+            "insert into smk values " + ",".join(
+                f"({i}, {i % 9})" for i in range(1, 101))]})
+        cl.workers[1].call({"op": "load_sql", "sqls": [
+            "insert into smk values " + ",".join(
+                f"({i}, {i % 9})" for i in range(101, 201))]})
+        got = cl.query_agg("select sum(v), count(*) from smk")
+        if int(got[0][1]) != 200:
+            failures.append(f"cluster agg wrong count: {got}")
+        evs = cl.domain.tracer.recorder.events()
+        roots = [e for e in evs if e.name == "query_agg"]
+        if not roots:
+            failures.append("no query_agg root span in coordinator ring")
+            return failures
+        root = roots[-1]
+        wspans = [e for e in evs if e.trace_id == root.trace_id
+                  and e.worker]
+        if not wspans:
+            failures.append(
+                "mesh-routed query's trace has no worker-side span "
+                f"(trace_id={root.trace_id})")
+        else:
+            print(f"# cluster trace: {len(wspans)} worker spans from "
+                  f"{sorted({e.worker for e in wspans})} under "
+                  f"{root.trace_id}", file=sys.stderr)
+        rows = cl.sess.execute(
+            "select count(*) from information_schema.tidb_trace_events "
+            f"where trace_id = '{root.trace_id}' and worker != ''").rows
+        if int(rows[0][0]) < 1:
+            failures.append("tidb_trace_events does not surface the "
+                            "worker-side spans")
+    except Exception as e:              # noqa: BLE001
+        failures.append(f"cluster trace phase error: "
+                        f"{type(e).__name__}: {e}")
+    finally:
+        if cl is not None:
+            try:
+                cl.stop()
+            except Exception:           # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return failures
 
 
 if __name__ == "__main__":

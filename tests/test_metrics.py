@@ -310,6 +310,35 @@ def test_concurrent_statements_both_attributed(mtk):
     assert len(rows) == 1 and rows[0][1] == 6, rows
 
 
+def test_plan_feedback_and_drift_histogram(mtk):
+    """Fast mode of the metrics_smoke plan-feedback gate: after real
+    queries, tidb_plan_feedback is non-empty with finite drift, the
+    cardinality-drift histogram observed, and tidb_top_sql carries the
+    digest-level drift summary."""
+    for _ in range(2):
+        mtk.must_query("select b, sum(a) from mt group by b order by b")
+    rows = mtk.must_query(
+        "select op, calls, avg_act_rows, max_drift, mean_drift, route "
+        "from information_schema.tidb_plan_feedback "
+        "where sql_text like '%group by%'").rows
+    assert rows, mtk.must_query(
+        "select * from information_schema.tidb_plan_feedback").rows
+    for _op, calls, act, mx, mean, _route in rows:
+        assert int(calls) >= 2
+        assert 1.0 <= float(mx) < 1e9           # finite, >= 1
+        assert 1.0 <= float(mean) <= float(mx) + 1e-9
+    assert any(float(r[2]) > 0 for r in rows)   # actuals recorded
+    snap = metrics.REGISTRY.snapshot()
+    drift_counts = [v for k, v in snap.items()
+                    if k.startswith("tidb_tpu_cardinality_drift_count")]
+    assert drift_counts and sum(drift_counts) > 0, \
+        "cardinality-drift histogram never observed"
+    top = mtk.must_query(
+        "select max_drift, mean_drift from information_schema"
+        ".tidb_top_sql where sql_text like '%group by%'").rows
+    assert top and float(top[0][0]) >= 1.0, top
+
+
 # ---- recording overhead ----------------------------------------------
 
 def test_recording_overhead_under_5_percent():

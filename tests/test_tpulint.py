@@ -377,6 +377,39 @@ def test_hygiene_nonliteral_labelnames():
     assert any("labelnames" in h.detail for h in hits)
 
 
+def test_hygiene_span_name_must_be_literal():
+    src = """
+        from ..utils import tracing as _tracing
+
+        def work(tracer, op, widget):
+            with _tracing.span(f"op_{op}"):          # f-string name
+                pass
+            with tracer.span("worker_" + op):        # concatenation
+                pass
+            with tracer.span("worker_op", op=op):    # fine: attr varies
+                pass
+            with widget.span(op):                    # not tracer-like
+                pass
+    """
+    hits = rule_hits(run_lint(src), "metrics-hygiene")
+    span_hits = [h for h in hits if "spanname" in h.detail]
+    assert len(span_hits) == 2, hits
+
+
+def test_hygiene_bare_span_helper_checked():
+    src = """
+        from ..utils.tracing import span
+
+        def work(name):
+            with span(name):                         # computed name
+                pass
+            with span("wal_group_commit", role="x"):  # fine
+                pass
+    """
+    hits = rule_hits(run_lint(src), "metrics-hygiene")
+    assert sum("spanname" in h.detail for h in hits) == 1
+
+
 # ---- error-code-validity ---------------------------------------------
 
 ERRCAT = {"TiDBError", "DuplicateKeyError", "ParseError", "catalog"}

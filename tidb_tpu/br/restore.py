@@ -133,13 +133,20 @@ def run_restore_job(runner, job, cancel_check):
         raise TiDBError("backup at %s vanished or is incomplete",
                         job.args["path"])
     entries = _entries_for(manifest, job.args.get("db") or "")
+    from ..utils import tracing as _tracing
     try:
+        # one span per restore phase, under the job's durable trace
+        # (ddljob-<id>) — a restore resumed after a crash keeps
+        # correlating with its pre-crash phase spans
         if job.args.get("phase") == "schema":
-            _phase_schema(runner, job, entries)
+            with _tracing.span("restore_schema", job=job.id):
+                _phase_schema(runner, job, entries)
         if job.args.get("phase") == "import":
-            _phase_import(runner, job, store, entries, cancel_check)
+            with _tracing.span("restore_import", job=job.id):
+                _phase_import(runner, job, store, entries, cancel_check)
         if job.args.get("phase") == "replay":
-            _phase_replay(runner, job, store, entries, cancel_check)
+            with _tracing.span("restore_replay", job=job.id):
+                _phase_replay(runner, job, store, entries, cancel_check)
     except BaseException:
         metrics_util.BACKUP_TOTAL.labels("restore_run", "error").inc()
         raise

@@ -123,7 +123,18 @@ class Changefeed:
     def poll_once(self) -> int:
         """One capture->sort->emit->checkpoint pass; returns the number
         of transactions emitted. Raises on sink/decode failure (the
-        worker classifies and backs off)."""
+        worker classifies and backs off). Each poll is a trace root;
+        only polls that emitted something flush to the recorder ring —
+        an idle feed polling every interval must not wash the ring."""
+        tracer = self.domain.tracer
+        with tracer.span("cdc_poll", changefeed=self.name) as sp:
+            emitted = self._poll_once_traced()
+            if sp is not None and emitted:
+                tracer.tag(emitted=emitted)
+                tracer.mark_sampled()
+            return emitted
+
+    def _poll_once_traced(self) -> int:
         failpoint.inject("cdc-poll")
         sub = self._sub
         if sub is None:

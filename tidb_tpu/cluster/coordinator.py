@@ -88,6 +88,12 @@ class _WorkerClient:
             _metrics.CLUSTER_RPC.labels(op, "breaker_open").inc()
             raise ClusterTransportError(
                 f"worker {self.port} circuit breaker open (op {op})")
+        # trace context rides the request next to rid/epoch: the worker
+        # installs it, records its spans under our trace_id, and hands
+        # the finished events back on the reply. Captured OUTSIDE the
+        # call lock — it belongs to the CALLING thread's open trace.
+        from ..utils import tracing as _tracing
+        tctx = _tracing.current_context()
         with self._call_mu:
             self._rid_seq += 1
             rid = f"{self._rid_prefix}:{self._rid_seq}"
@@ -95,6 +101,9 @@ class _WorkerClient:
             req["rid"] = rid
             if self.epoch_fn is not None:
                 req["epoch"] = self.epoch_fn()
+            if tctx is not None:
+                trace_id, parent_id, sampled, _state = tctx
+                req["trace"] = [trace_id, parent_id, 1 if sampled else 0]
             deadline = time.monotonic() + deadline_s
             attempt = 0
             while True:
@@ -123,6 +132,13 @@ class _WorkerClient:
                         self._connect()     # fresh stream: no stale
                     except OSError:         # half-frames or replies
                         continue
+        spans = out.pop("spans", None)
+        if spans and tctx is not None:
+            # piggybacked remote spans join the calling statement's
+            # open trace buffer (list.extend under the GIL — safe from
+            # fan-out threads, which all share the coordinator state)
+            tctx[3].buf.extend(
+                _tracing.SpanEvent(*e) for e in spans)
         if out.get("dedup"):
             _metrics.CLUSTER_RPC_DEDUP.labels(op).inc()
         if out.get("err_kind") == "stale_epoch":
@@ -417,16 +433,25 @@ class Cluster:
     def _fanout(self, fn):
         """Run fn(i, worker) concurrently for every worker (independent
         sockets); returns results in worker order, raising the first
-        error only after every thread joined."""
+        error only after every thread joined. The caller's trace
+        context is installed in each thread, so per-worker RPCs stamp
+        the statement's trace_id and their piggybacked spans land in
+        the statement's buffer (the threads join before the statement
+        span closes)."""
         import threading
+        from ..utils import tracing as _tracing
+        tctx = _tracing.current_context()
         outs = [None] * len(self.workers)
         errs = []
 
         def run(i, w):
+            _tracing.set_thread_context(tctx)
             try:
                 outs[i] = fn(i, w)
             except Exception as e:      # noqa: BLE001
                 errs.append(e)
+            finally:
+                _tracing.set_thread_context(None)
         ts = [threading.Thread(target=run, args=(i, w))
               for i, w in enumerate(self.workers)]
         for t in ts:
@@ -438,10 +463,11 @@ class Cluster:
         return outs
 
     def ddl(self, sql: str):
-        self.sess.execute(sql)
-        self._ddl_log.append(sql)
-        for w in self.workers:
-            w.call({"op": "load_sql", "sqls": [sql]})
+        with self.domain.tracer.span("cluster_ddl", sampled=True):
+            self.sess.execute(sql)
+            self._ddl_log.append(sql)
+            for w in self.workers:
+                w.call({"op": "load_sql", "sqls": [sql]})
 
     def _placement_workers(self, table: str) -> list:
         """Worker indexes eligible to hold this table's shards — the
@@ -480,6 +506,11 @@ class Cluster:
         return eligible or everyone
 
     def load_shards(self, table: str, csv_path: str):
+        with self.domain.tracer.span("load_shards", sampled=True,
+                                     table=table):
+            return self._load_shards(table, csv_path)
+
+    def _load_shards(self, table: str, csv_path: str):
         eligible = self._placement_workers(table)
         # loads after enable_replication() reach the followers' WAL via
         # the INSERT commit hook; earlier ones exist only in the bulk
@@ -582,7 +613,14 @@ class Cluster:
 
     def query_agg(self, sql: str):
         """Fan the aggregation fragment out to every worker, merge the
-        partials locally, run the plan's post-agg operators."""
+        partials locally, run the plan's post-agg operators. Runs under
+        an always-sampled trace root: the fan-out threads propagate its
+        context, so the coordinator ring ends up holding the whole
+        cross-worker tree (TRACE-equivalent for the cluster API)."""
+        with self.domain.tracer.span("query_agg", sampled=True):
+            return self._query_agg(sql)
+
+    def _query_agg(self, sql: str):
         from ..parser import parse
         from ..planner.optimize import optimize
         from ..planner.physical import PhysHashAgg
@@ -708,6 +746,10 @@ class Cluster:
         {"sums": [...], "counts": ...} (replicated; worker 0's copy),
         and asserts every host returned the same result — the SPMD
         invariant made observable."""
+        with self.domain.tracer.span("spmd_agg", sampled=True):
+            return self._spmd_agg(sql, n_groups)
+
+    def _spmd_agg(self, sql: str, n_groups=None):
         import math
         import pickle
         from ..parser import parse

@@ -53,6 +53,10 @@ class WorkerServer:
         self._sock.bind(("127.0.0.1", port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
+        # name this node in cross-worker trace trees (SpanEvent.worker;
+        # "" stays the coordinator) — the port is the worker's identity
+        # everywhere else in the cluster layer too
+        self.domain.tracer.worker = f"w{self.port}"
         self._stop = threading.Event()
         self._pending: dict = {}       # start_ts -> prewritten mutations
         from ..owner import LocalLeaseStore
@@ -170,9 +174,20 @@ class WorkerServer:
                         continue
                 with self._inflight_mu:
                     self._inflight += 1
+                # cross-worker trace adoption: install the caller's
+                # context, record this op's spans under it, piggyback
+                # the finished events on the reply (the coordinator
+                # folds them into its statement trace)
+                tctx = msg.get("trace")
+                tracer = self.domain.tracer
+                if tctx:
+                    tracer.install_remote(str(tctx[0]), str(tctx[1]),
+                                          bool(tctx[2]))
                 try:
                     try:
-                        out, out_arrays = self._handle(op, msg, arrays)
+                        with tracer.span("worker_op", op=str(op)):
+                            out, out_arrays = self._handle(op, msg,
+                                                           arrays)
                     except Exception as e:          # noqa: BLE001
                         out = {"err": f"{type(e).__name__}: {e}"}
                         if isinstance(e, ClusterEpochStaleError):
@@ -181,6 +196,11 @@ class WorkerServer:
                 finally:
                     with self._inflight_mu:
                         self._inflight -= 1
+                    if tctx:
+                        spans = tracer.uninstall_remote()
+                        if spans:
+                            out = dict(out)
+                            out["spans"] = [list(e) for e in spans]
                 if dedup:
                     self._dedup_store(rid, out, out_arrays)
                 if rid is not None:

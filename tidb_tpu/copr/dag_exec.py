@@ -293,15 +293,20 @@ class CoprExecutor:
                 # supervised mesh dispatch: retryable classes retry with
                 # backoff, anything else degrades to None so the
                 # single-chip path (which always works) takes over
+                from ..utils import tracing as _tracing
                 t_mpp = time.perf_counter()
-                res = device_guard.guarded_dispatch(
-                    lambda: self._try_execute_mpp(dag, tbl, arrays,
-                                                  valid, n, handles,
-                                                  read_ts),
-                    site="copr/mpp", ectx=ectx,
-                    domain=getattr(self, "domain", None),
-                    host_fallback=lambda: None,
-                    fallback_is_host=False)
+                with _tracing.span("mpp_dispatch",
+                                   table=dag.table_info.name, rows=n):
+                    res = device_guard.guarded_dispatch(
+                        lambda: self._try_execute_mpp(dag, tbl, arrays,
+                                                      valid, n, handles,
+                                                      read_ts),
+                        site="copr/mpp", ectx=ectx,
+                        domain=getattr(self, "domain", None),
+                        host_fallback=lambda: None,
+                        fallback_is_host=False)
+                    if res is None:
+                        _tracing.tag(degraded=1)
                 if res is not None:
                     _metrics.MPP_DISPATCH_SECONDS.observe(
                         time.perf_counter() - t_mpp)

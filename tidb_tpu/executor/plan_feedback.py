@@ -1,0 +1,149 @@
+"""Per-digest plan-quality feedback (ROADMAP #1 instrumentation half).
+
+At statement end the session folds the TimedExec runtime-stats tree
+(est_rows, act_rows, backend, wall_ms per operator) into this bounded
+per-digest store on the domain. The record is the input the
+feedback-driven cost model needs: cardinality drift per plan node
+class (the round-5 q9/q2/q11 estimate mistakes), which route actually
+served the operator tree (device / device-mpp / host), and the
+device-vs-host wall-time split — surfaced as
+`information_schema.tidb_plan_feedback`, the
+`tidb_tpu_cardinality_drift` histogram, and drift columns on
+`tidb_top_sql`.
+
+Drift is the q-error `max(est/act, act/est)` with both sides floored
+at one row — symmetric (over- and under-estimates score alike), always
+>= 1.0, and always finite (a zero-row actual against a thousand-row
+estimate is a drift of 1000, not inf)."""
+from __future__ import annotations
+
+import threading
+
+
+def qerror(est: float, act: float) -> float:
+    e = max(float(est), 1.0)
+    a = max(float(act), 1.0)
+    return e / a if e >= a else a / e
+
+
+def collect(plan, ex):
+    """Fold a finished statement's (plan, wrapped executor) pair into
+    per-operator feedback rows:
+    [(opname, est_rows, act_rows, backend, wall_ms)]. Display-only
+    plan rows (no executor ran — fused-pipeline dim subtrees, wrapper
+    rows) are skipped: they carry no actuals to learn from."""
+    from .runtime_stats import pair_plan_stats, wrapped_children_stats
+    rows = []
+    for p, st in pair_plan_stats(plan, wrapped_children_stats(ex)):
+        if st is None:
+            continue
+        act_rows, wall_ms, backend, opname = st
+        # backend_info() may append per-execution detail ("device
+        # kcache:1/0"); keep the route class only — the store keys on it
+        backend = backend.split()[0] if backend else ""
+        rows.append((opname, float(getattr(p, "stats_rows", 0.0)),
+                     int(act_rows), backend, float(wall_ms)))
+    return rows
+
+
+class PlanFeedback:
+    """Bounded per-digest store (same shape discipline as TopSQL:
+    capacity-limited dict, evict the least-executed digest)."""
+
+    def __init__(self, capacity: int = 200):
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._entries: dict[str, dict] = {}
+
+    def record(self, digest: str, normalized: str, nodes, route: str,
+               device_ms: float = 0.0, host_ms: float = 0.0):
+        """nodes: collect() output for one execution. `route` is the
+        statement-level routing outcome (backend of the access-path
+        operators: device / device-mpp / host / mixed)."""
+        if not nodes:
+            return
+        with self._mu:
+            e = self._entries.get(digest)
+            if e is None:
+                if len(self._entries) >= self.capacity:
+                    self._evict_locked()
+                e = self._entries[digest] = {
+                    "normalized": normalized[:256],
+                    "exec_count": 0,
+                    "routes": {},         # route -> count
+                    "sum_device_ms": 0.0,
+                    "sum_host_ms": 0.0,
+                    "ops": {},            # opname -> op-class feedback
+                }
+            e["exec_count"] += 1
+            e["routes"][route] = e["routes"].get(route, 0) + 1
+            e["sum_device_ms"] += device_ms
+            e["sum_host_ms"] += host_ms
+            for opname, est, act, backend, wall_ms in nodes:
+                o = e["ops"].get(opname)
+                if o is None:
+                    o = e["ops"][opname] = {
+                        "calls": 0, "sum_est": 0.0, "sum_act": 0,
+                        "sum_drift": 0.0, "max_drift": 1.0,
+                        "sum_ms": 0.0, "backends": {},
+                    }
+                d = qerror(est, act)
+                o["calls"] += 1
+                o["sum_est"] += est
+                o["sum_act"] += act
+                o["sum_drift"] += d
+                if d > o["max_drift"]:
+                    o["max_drift"] = d
+                o["sum_ms"] += wall_ms
+                if backend:
+                    o["backends"][backend] = o["backends"].get(backend, 0) + 1
+
+    def _evict_locked(self):
+        victim = min(self._entries, key=lambda k: self._entries[k]["exec_count"])
+        del self._entries[victim]
+
+    def digest_drift(self, digest: str):
+        """(max_drift, mean_drift) across the digest's op classes, or
+        None — the statement-level summary tidb_top_sql carries."""
+        with self._mu:
+            e = self._entries.get(digest)
+            if e is None or not e["ops"]:
+                return None
+            mx, tot, n = 1.0, 0.0, 0
+            for o in e["ops"].values():
+                if o["max_drift"] > mx:
+                    mx = o["max_drift"]
+                tot += o["sum_drift"]
+                n += o["calls"]
+            return (mx, tot / n if n else 1.0)
+
+    def rows(self):
+        """One row per (digest, op class) for
+        information_schema.tidb_plan_feedback."""
+        out = []
+        with self._mu:
+            for digest, e in self._entries.items():
+                route = max(e["routes"], key=e["routes"].get) \
+                    if e["routes"] else ""
+                for opname, o in sorted(e["ops"].items()):
+                    calls = o["calls"] or 1
+                    backends = ",".join(
+                        f"{b}:{c}" for b, c in sorted(o["backends"].items()))
+                    out.append((
+                        digest, e["normalized"], opname, e["exec_count"],
+                        o["calls"],
+                        round(o["sum_est"] / calls, 2),
+                        round(o["sum_act"] / calls, 2),
+                        round(o["max_drift"], 4),
+                        round(o["sum_drift"] / calls, 4),
+                        backends, route,
+                        round(e["sum_device_ms"], 3),
+                        round(e["sum_host_ms"], 3),
+                        round(o["sum_ms"], 3),
+                    ))
+        out.sort(key=lambda r: -r[7])   # worst max_drift first
+        return out
+
+    def clear(self):
+        with self._mu:
+            self._entries.clear()

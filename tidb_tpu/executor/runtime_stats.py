@@ -66,6 +66,51 @@ class TimedExec:
         return getattr(self.inner, name)
 
 
+def pair_plan_stats(plan, stats):
+    """Tree-aware pairing of plan nodes to executor stats: walk both
+    trees in parallel, matching children by operator name IN POSITION —
+    a display-only subtree (a fused pipeline's dim rows have no
+    executors) pairs with None for its whole subtree instead of
+    stealing a later sibling's stats. -> pre-order
+    [(plan_node, (act_rows, wall_ms, backend, opname) | None)] aligned
+    with explain_text(plan) rows. Shared by EXPLAIN ANALYZE rendering
+    and the statement-end plan-feedback fold."""
+    out = []
+
+    def reaches(p, st):
+        # p matches st directly, or is a chain of plan-only
+        # single-child wrappers (e.g. ExchangeSender) above a
+        # matching descendant
+        while True:
+            if p.name() == st[0][3]:
+                return True
+            if len(p.children) == 1:
+                p = p.children[0]
+                continue
+            return False
+
+    def pair_through(p, st):
+        if p.name() == st[0][3]:
+            pair(p, st)
+        else:
+            out.append((p, None))   # wrapper row: "-"
+            pair_through(p.children[0], st)
+
+    def pair(p, st):
+        out.append((p, st[0] if st is not None else None))
+        kids = list(st[1]) if st is not None else []
+        si = 0
+        for c in p.children:
+            if si < len(kids) and reaches(c, kids[si]):
+                pair_through(c, kids[si])
+                si += 1
+            else:
+                pair(c, None)
+
+    pair_through(plan, stats)
+    return out
+
+
 def wrapped_children_stats(ex):
     """Collect (act_rows, wall_ms, backend) tree matching the plan tree
     shape. `backend` (reference pkg/util/execdetails storeType) says

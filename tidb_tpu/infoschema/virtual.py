@@ -69,11 +69,15 @@ def _gen_statistics(domain):
 
 def _gen_slow_query(domain):
     for e in domain.slow_log:
+        ph = e.get("phases") or {}
         yield (e.get("time", 0.0), e.get("time_ms", 0.0) / 1000.0,
                e.get("sql", ""), e.get("db", ""), e.get("conn", 0),
                1 if e.get("success") else 0,
                e.get("digest", ""), int(e.get("is_internal", 0)),
-               int(e.get("mem_max", 0)))
+               int(e.get("mem_max", 0)),
+               # wait attribution: phase snap() keys are already ms
+               ph.get("commit_wait_s", 0.0),
+               ph.get("admission_wait_s", 0.0))
 
 
 def _gen_stmt_summary(domain):
@@ -83,7 +87,9 @@ def _gen_stmt_summary(domain):
                s["sum_ms"] / 1000.0, s["max_ms"] / 1000.0,
                s["sum_ms"] / cnt / 1000.0, s["errors"],
                s.get("sum_device_ms", 0.0), s.get("fallback_count", 0),
-               int(s.get("mem_max", 0)))
+               int(s.get("mem_max", 0)),
+               s.get("sum_commit_wait_ms", 0.0),
+               s.get("sum_admission_wait_ms", 0.0))
 
 
 def _gen_memory_usage(domain):
@@ -160,10 +166,23 @@ def _gen_errors(domain):
 def _gen_trace_events(domain):
     """Flight-recorder ring (reference pkg/util/traceevent dumped on
     triggers; here queryable directly): recent spans with nesting depth,
-    duration, and attributes — slow statements tag theirs slow=1."""
-    for wall, conn_id, depth, name, dur_ms, attrs in \
-            domain.flight_recorder.events():
-        yield (wall, conn_id, depth, name, dur_ms, attrs)
+    duration, attributes, and the distributed trace identity
+    (trace_id/span_id/parent_id/worker) that joins a mesh query's
+    coordinator and worker halves — slow statements tag theirs slow=1."""
+    for ev in domain.flight_recorder.events():
+        yield (ev.ts, ev.conn_id, ev.depth, ev.name, ev.dur_ms,
+               ev.attrs, ev.trace_id, ev.span_id, ev.parent_id,
+               ev.worker)
+
+
+def _gen_plan_feedback(domain):
+    """Per-(digest, plan-operator-class) estimate-vs-actual feedback
+    folded at statement end (executor/plan_feedback.py) — the
+    instrumentation input for the feedback-driven cost model (ROADMAP
+    #1). Drift is the symmetric q-error max(est/act, act/est), floored
+    at one row on both sides so it is always finite and >= 1."""
+    for row in domain.plan_feedback.rows():
+        yield row
 
 
 def _gen_top_sql(domain):
@@ -183,7 +202,10 @@ def _gen_top_sql(domain):
                e["kernel_builds"], e["dispatches"],
                e["upload_bytes"], e["fetch_bytes"],
                e["fallback_count"], e["sum_errors"],
-               e.get("delta_applies", 0), e.get("delta_bytes", 0))
+               e.get("delta_applies", 0), e.get("delta_bytes", 0),
+               round(e.get("max_drift", 0.0), 4),
+               round(e.get("sum_drift", 0.0) /
+                     max(e.get("drift_execs", 0), 1), 4))
 
 
 def _gen_deadlocks(domain):
@@ -479,7 +501,9 @@ VIRTUAL_DEFS = {
     "slow_query": (_cols(("time", _F()), ("query_time", _F()),
                          ("query", _S()), ("db", _S()), ("conn_id", _I()),
                          ("succ", _I()), ("digest", _S()),
-                         ("is_internal", _I()), ("mem_max", _I())),
+                         ("is_internal", _I()), ("mem_max", _I()),
+                         ("commit_wait_ms", _F()),
+                         ("admission_wait_ms", _F())),
                    _gen_slow_query),
     "statements_summary": (_cols(("digest", _S()), ("digest_text", _S()),
                                  ("exec_count", _I()),
@@ -487,7 +511,9 @@ VIRTUAL_DEFS = {
                                  ("avg_latency", _F()), ("sum_errors", _I()),
                                  ("sum_device_ms", _F()),
                                  ("fallback_count", _I()),
-                                 ("mem_max", _I())),
+                                 ("mem_max", _I()),
+                                 ("sum_commit_wait_ms", _F()),
+                                 ("sum_admission_wait_ms", _F())),
                            _gen_stmt_summary),
     "metrics_summary": (_cols(("metrics_name", _S()), ("labels", _S()),
                               ("sum_value", _F())),
@@ -496,8 +522,22 @@ VIRTUAL_DEFS = {
                           ("sqlstate", _S())), _gen_errors),
     "tidb_trace_events": (_cols(("time", _F()), ("conn_id", _I()),
                                 ("depth", _I()), ("span", _S()),
-                                ("duration_ms", _F()), ("attrs", _S())),
+                                ("duration_ms", _F()), ("attrs", _S()),
+                                ("trace_id", _S()), ("span_id", _S()),
+                                ("parent_id", _S()), ("worker", _S())),
                           _gen_trace_events),
+    "tidb_plan_feedback": (_cols(("sql_digest", _S()), ("sql_text", _S()),
+                                 ("op", _S()), ("exec_count", _I()),
+                                 ("calls", _I()),
+                                 ("avg_est_rows", _F()),
+                                 ("avg_act_rows", _F()),
+                                 ("max_drift", _F()),
+                                 ("mean_drift", _F()),
+                                 ("backends", _S()), ("route", _S()),
+                                 ("sum_device_ms", _F()),
+                                 ("sum_host_ms", _F()),
+                                 ("sum_op_ms", _F())),
+                           _gen_plan_feedback),
     "tidb_top_sql": (_cols(("sql_digest", _S()), ("sql_text", _S()),
                            ("exec_count", _I()),
                            ("sum_ms", _F()), ("avg_ms", _F()),
@@ -513,7 +553,9 @@ VIRTUAL_DEFS = {
                            ("fallback_count", _I()),
                            ("sum_errors", _I()),
                            ("delta_applies", _I()),
-                           ("delta_bytes", _I())), _gen_top_sql),
+                           ("delta_bytes", _I()),
+                           ("max_drift", _F()),
+                           ("mean_drift", _F())), _gen_top_sql),
     "deadlocks": (_cols(("deadlock_id", _I()), ("occur_time", _F()),
                         ("retryable", _I()), ("try_lock_trx_id", _I()),
                         ("key", _S()), ("trx_holding_lock", _I())),

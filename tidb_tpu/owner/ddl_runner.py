@@ -156,15 +156,22 @@ class DDLJobRunner:
 
     def _step_txn(self, job, fn, bump_version=True, honor_cancel=True):
         """One ladder step: fn(m) mutates schema meta and the in-memory
-        ``job``; the job row persists in the SAME txn."""
+        ``job``; the job row persists in the SAME txn. Each step is a
+        span under the job's trace (survives resume: the trace_id is
+        the durable job id), stamped with the schema state it left."""
+        from ..utils import tracing as _tracing
+
         def body(m):
             if honor_cancel:
                 self._cancel_guard(m, job)
             r = fn(m)
             m.put_ddl_job(job)
             return r
-        return self._retry_txn(body, bump_version=bump_version,
-                               what="job %d" % job.id)
+        with _tracing.span("ddl_step", job=job.id):
+            r = self._retry_txn(body, bump_version=bump_version,
+                                what="job %d" % job.id)
+            _tracing.tag(schema_state=str(job.schema_state))
+            return r
 
     def _get_tbl(self, m, job):
         for db in m.list_databases():
@@ -383,7 +390,18 @@ class DDLJobRunner:
     def _run_job(self, job: DDLJob):
         """Drive one job to a terminal state. Returns the error to
         surface to the submitting session (None on success); never
-        raises except for process death."""
+        raises except for process death. Runs under an always-sampled
+        trace whose trace_id is derived from the DURABLE job id
+        ("ddljob-<id>"), so a job resumed after restart keeps
+        correlating with its pre-crash spans; each ladder step records
+        a child span (_step_txn)."""
+        with self.domain.tracer.span("ddl_job", sampled=True,
+                                     trace_id=f"ddljob-{job.id}",
+                                     job=job.id, type=job.type,
+                                     state=job.state):
+            return self._run_job_traced(job)
+
+    def _run_job_traced(self, job: DDLJob):
         cancel_check = self._cancel_checks.get(job.id)
         if job.state in (STATE_CANCELLING, STATE_ROLLINGBACK):
             return self._rollback(job, None)
@@ -508,12 +526,15 @@ class DDLJobRunner:
         """Like _step_txn but fn moves the job to history itself
         (finish_ddl_job replaces the put — a put would resurrect the
         queue row)."""
+        from ..utils import tracing as _tracing
+
         def body(m):
             if honor_cancel:
                 self._cancel_guard(m, job)
             fn(m)
-        self._retry_txn(body, bump_version=True,
-                        what="job %d" % job.id)
+        with _tracing.span("ddl_terminal", job=job.id):
+            self._retry_txn(body, bump_version=True,
+                            what="job %d" % job.id)
 
     def _backfill(self, job, name, cancel_check):
         """Handle-ordered transactional backfill with durable
@@ -521,6 +542,11 @@ class DDLJobRunner:
         conflicts retry the batch with a fresh snapshot), then the job
         row records the high-water handle so a restarted job continues
         at the recorded range."""
+        from ..utils import tracing as _tracing
+        with _tracing.span("ddl_backfill", job=job.id):
+            return self._backfill_traced(job, name, cancel_check)
+
+    def _backfill_traced(self, job, name, cancel_check):
         from ..session.ddl import backfill_index_batch
         dom = self.domain
         info = dom.infoschema().table_by_id(job.table_id)

@@ -140,6 +140,11 @@ class Domain:
         # per-digest device-time attribution ring fed by Session._observe
         # (information_schema.tidb_top_sql)
         self.top_sql = metrics_util.TopSQL()
+        # per-digest estimate-vs-actual + routing feedback folded at
+        # statement end (information_schema.tidb_plan_feedback); the
+        # planner-side consumer is ROADMAP #1
+        from ..executor.plan_feedback import PlanFeedback
+        self.plan_feedback = PlanFeedback()
         metrics_util.track_domain(self)
         # why the most recent query declined / fell off the fused device
         # pipeline (None = fused OK); read by EXPLAIN ANALYZE and

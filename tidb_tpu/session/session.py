@@ -192,9 +192,11 @@ class Session:
         reset_rand_states()     # RAND(N) restarts per statement
         set_encryption_mode(self.vars.get("block_encryption_mode"))
         from ..utils import phase as _phase
+        adm_wait_s = 0.0
         rg = self.domain.resource_groups.groups.get(self.resource_group)
         if rg is not None:
-            rg.admit()               # token-bucket admission control
+            # token-bucket admission control (RU throttle)
+            adm_wait_s += rg.admit() or 0.0
         # OLAP-vs-OLTP dispatch split: analytic statements take a
         # bounded per-group admission slot so a burst of them can
         # never occupy every interpreter thread while point ops
@@ -202,10 +204,16 @@ class Session:
         # SQL (TTL, stats) and nested statements must not deadlock
         # on a slot their parent holds.
         adm_rg = self._maybe_admit_olap(stmt, at_depth=0)
+        adm_wait_s += getattr(self, "_olap_wait_s", 0.0)
+        self._olap_wait_s = 0.0
         # per-statement backend phase counters: reset at the OUTERMOST
         # statement only (internal SQL fired mid-statement — stats sync
         # load, TTL — accumulates into its triggering statement)
         _phase.stmt_enter()
+        if adm_wait_s > 0.0:
+            # attributed AFTER stmt_enter: admission ran before the
+            # phase reset, but the wait belongs to THIS statement
+            _phase.add("admission_wait_s", adm_wait_s)
         if _phase.depth() == 1:
             # per-statement memory high-water mark: nested internal SQL
             # folds its peaks into the outer statement's, like phases
@@ -229,7 +237,25 @@ class Session:
             self._txn.heartbeat()
             self._stmt_lock_guard(self._txn, None)
         start = time.time()
+        # sampling decision for the trace this statement roots (honored
+        # only when this IS the root — nested statements ride the outer
+        # trace): TRACE always samples; slow statements upgrade
+        # retroactively via mark_sampled() in _observe; everything else
+        # rolls tidb_tpu_trace_sample_rate (default 0 — the OLTP fast
+        # path never touches the recorder ring)
+        samp = isinstance(stmt, ast.TraceStmt)
+        if not samp:
+            try:
+                rate = float(self.vars.get("tidb_tpu_trace_sample_rate"))
+            except (TypeError, ValueError):
+                rate = 0.0
+            if rate >= 1.0:
+                samp = True
+            elif rate > 0.0:
+                import random
+                samp = random.random() < rate
         with self.domain.tracer.span("statement", conn_id=self.conn_id,
+                                     sampled=samp,
                                      stmt=type(stmt).__name__):
             try:
                 rs = self._dispatch(stmt, params)
@@ -282,7 +308,11 @@ class Session:
         waiter = _AdmissionWaiter()
         self.domain.register_exec(self.conn_id, waiter)
         try:
-            rg.acquire_olap(slots, waiter.check_killed)
+            # stashed for the caller: the slot wait happens before the
+            # statement's phase counters reset, so _execute_stmt folds
+            # it in as admission_wait_s right after stmt_enter
+            self._olap_wait_s = rg.acquire_olap(slots,
+                                                waiter.check_killed) or 0.0
         finally:
             self.domain.unregister_exec(self.conn_id, waiter)
         return rg
@@ -331,6 +361,11 @@ class Session:
             # stage spans (plan/execute/copr finished before the
             # statement knew it was slow)
             self.domain.tracer.tag(slow=1)
+            # slow statements are always-on regardless of the sample
+            # rate: upgrade the open trace so its buffered spans flush
+            # at root close, tagged like the statement span
+            self.domain.tracer.tag_buffered("slow=1")
+            self.domain.tracer.mark_sampled()
             self.domain.flight_recorder.tag_recent(self.conn_id, start)
             # backend phase counters (utils/phase.py) ride along: a slow
             # statement's record says WHERE its time went (dispatch/
@@ -355,7 +390,8 @@ class Session:
         summ = self.domain.stmt_summary_map.setdefault(digest, {
             "digest": digest, "normalized": norm[:1024],
             "exec_count": 0, "sum_ms": 0.0, "max_ms": 0.0, "errors": 0,
-            "sum_device_ms": 0.0, "fallback_count": 0, "mem_max": 0})
+            "sum_device_ms": 0.0, "fallback_count": 0, "mem_max": 0,
+            "sum_commit_wait_ms": 0.0, "sum_admission_wait_ms": 0.0})
         summ["exec_count"] += 1
         summ["sum_ms"] += dur_ms
         summ["max_ms"] = max(summ["max_ms"], dur_ms)
@@ -372,8 +408,36 @@ class Session:
             ph = _phase.snap()
             summ["sum_device_ms"] += metrics_util.phase_device_ms(ph)
             summ["fallback_count"] += ph.get("device_fallbacks", 0)
+            # wait attribution (satellite): time parked in WAL
+            # group-commit and admission queues, per digest (snap()
+            # already rendered the *_s keys to ms)
+            summ["sum_commit_wait_ms"] = summ.get(
+                "sum_commit_wait_ms", 0.0) + ph.get("commit_wait_s", 0.0)
+            summ["sum_admission_wait_ms"] = summ.get(
+                "sum_admission_wait_ms", 0.0) + \
+                ph.get("admission_wait_s", 0.0)
+            # plan feedback: fold the statement's runtime-stats tree
+            # (stashed by _exec_select) into the per-digest store and
+            # the drift histogram; hand the digest's running drift to
+            # Top SQL so planner misses sit next to their cost
+            drift = None
+            fb = getattr(self, "_stmt_feedback", None)
+            self._stmt_feedback = None
+            if fb:
+                from ..executor.plan_feedback import qerror
+                routes = {b for _op, _e, _a, b, _ms in fb if b}
+                route = routes.pop() if len(routes) == 1 else \
+                    ("mixed" if routes else "")
+                self.domain.plan_feedback.record(
+                    digest, norm[:1024], fb, route,
+                    device_ms=metrics_util.phase_device_ms(ph),
+                    host_ms=ph.get("host_exec_s", 0.0))
+                for opname, est, act, _backend, _ms in fb:
+                    metrics_util.CARDINALITY_DRIFT.labels(opname) \
+                        .observe(qerror(est, act))
+                drift = self.domain.plan_feedback.digest_drift(digest)
             self.domain.top_sql.record(digest, norm[:1024], dur_ms, ph,
-                                       ok=ok)
+                                       ok=ok, drift=drift)
         self.domain.plugins.fire("audit", self, {
             "sql": sql, "digest": digest, "ok": ok, "duration_ms": dur_ms,
             "user": self.user, "db": self.vars.current_db,
@@ -600,10 +664,10 @@ class Session:
         if isinstance(stmt, ast.ChangefeedStmt):
             return self._exec_changefeed(stmt)
         if isinstance(stmt, ast.TraceStmt):
-            # span-style trace = EXPLAIN ANALYZE over the wrapped statement
-            # (reference executor/trace.go renders span trees the same way)
-            return self._exec_explain(ast.ExplainStmt(stmt=stmt.stmt,
-                                                      analyze=True))
+            # span-style trace (reference executor/trace.go): run the
+            # wrapped statement under this forced-sampled trace and
+            # render the cross-worker span tree from the live buffer
+            return self._exec_trace(stmt)
         if isinstance(stmt, ast.HandlerStmt):
             from ..executor.handler_stmt import exec_handler
             return exec_handler(self, stmt)
@@ -1237,6 +1301,12 @@ class Session:
             self._check_table_locks(
                 list(getattr(plan, "read_tables", ())), write=False)
         ectx = ExecContext(self, getattr(plan, "exec_hints", None))
+        # per-operator runtime stats on every select (reference
+        # tidb_enable_collect_execution_info): the TimedExec tree feeds
+        # the statement-end plan-feedback fold. Point gets bypass
+        # _exec_select via the fast path, so OLTP stays unwrapped.
+        ectx.collect_stats = bool(
+            self.vars.get("tidb_enable_collect_execution_info"))
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
         if not ectx.stale_read_ts:
             # incremental HTAP read routing: analytic statements under
@@ -1258,6 +1328,18 @@ class Session:
                 ex.close()
                 self.domain.unregister_exec(self.conn_id, ectx)
                 ectx.finish()
+        if ectx.collect_stats:
+            from ..utils import phase as _phase
+            if _phase.depth() == 1:
+                # stash est-vs-actual per operator for _observe's
+                # plan-feedback fold (outermost statements only — a
+                # nested internal select must not overwrite the user
+                # statement's feedback with its own)
+                from ..executor import plan_feedback as _pf
+                try:
+                    self._stmt_feedback = _pf.collect(plan, ex)
+                except Exception:       # noqa: BLE001 — never fail a query
+                    self._stmt_feedback = None
         if getattr(plan, "for_update", False) and self._explicit_txn:
             chunks = self._lock_for_update(plan, chunks, ectx)
         vis = [i for i, sc in enumerate(plan.schema.cols) if not sc.hidden]
@@ -1656,6 +1738,53 @@ class Session:
         except TiDBError:
             pass
 
+    def _exec_trace(self, stmt) -> ResultSet:
+        """TRACE <stmt>: execute the inner statement as children of this
+        statement's (forced-sampled) trace root, then render the span
+        tree — including spans piggybacked from remote workers — from
+        the still-open trace buffer. Columns: operation (indented),
+        start_ms (relative to the earliest span), duration_ms, worker,
+        attrs."""
+        from .show import _str_chunk
+        tr = self.domain.tracer
+        self._dispatch(stmt.stmt, None)
+        events = tr.current_events()
+        root = tr.current_root()
+        rows = []
+        if root is None:
+            # no open trace (direct _exec_trace call outside
+            # _execute_stmt): nothing buffered to render
+            return _str_chunk(
+                ["operation", "start_ms", "duration_ms", "worker",
+                 "attrs"], rows)
+        trace_id, root_sp = root
+        ids = {e.span_id for e in events}
+        by_parent: dict = {}
+        for e in events:
+            # orphans (parent still open, or a remote parent whose
+            # event was lost) attach to the statement root
+            pid = e.parent_id if e.parent_id in ids else root_sp.span_id
+            by_parent.setdefault(pid, []).append(e)
+        t0 = min((e.start_ts for e in events), default=time.time())
+
+        def emit(pid, depth):
+            for e in sorted(by_parent.get(pid, []),
+                            key=lambda ev: ev.start_ts):
+                label = "  " * depth + "└─" + e.name
+                rows.append((label,
+                             f"{max(0.0, (e.start_ts - t0) * 1000):.3f}",
+                             f"{e.dur_ms:.3f}",
+                             e.worker or "coordinator", e.attrs))
+                emit(e.span_id, depth + 1)
+
+        rows.append((f"statement (trace_id={trace_id})", "0.000", "-",
+                     "coordinator", ""))
+        emit(root_sp.span_id, 1)
+        self._finish_stmt()
+        return _str_chunk(
+            ["operation", "start_ms", "duration_ms", "worker", "attrs"],
+            rows)
+
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         inner = stmt.stmt
         plan = optimize(inner, self._plan_ctx())
@@ -1678,50 +1807,16 @@ class Session:
             finally:
                 ex.close()
                 ectx.finish()
-            from ..executor.runtime_stats import wrapped_children_stats
+            from ..executor.runtime_stats import (pair_plan_stats,
+                                                  wrapped_children_stats)
             stats = wrapped_children_stats(ex)
             rows = []
             base = explain_text(plan)
 
-            # tree-aware pairing of plan rows to executor stats: walk
-            # both trees in parallel, matching children by operator
-            # name IN POSITION — a display-only subtree (a fused
-            # pipeline's dim rows have no executors) pairs with None
-            # for its whole subtree instead of stealing a later
-            # sibling's stats. Plan rows without an executor ran inside
-            # their parent's kernel and show "-".
-            stats_by_row = []
-
-            def reaches(p, st):
-                # p matches st directly, or is a chain of plan-only
-                # single-child wrappers (e.g. ExchangeSender) above a
-                # matching descendant
-                while True:
-                    if p.name() == st[0][3]:
-                        return True
-                    if len(p.children) == 1:
-                        p = p.children[0]
-                        continue
-                    return False
-
-            def pair_through(p, st):
-                if p.name() == st[0][3]:
-                    pair(p, st)
-                else:
-                    stats_by_row.append(None)   # wrapper row: "-"
-                    pair_through(p.children[0], st)
-
-            def pair(p, st):
-                stats_by_row.append(st[0] if st is not None else None)
-                kids = list(st[1]) if st is not None else []
-                si = 0
-                for c in p.children:
-                    if si < len(kids) and reaches(c, kids[si]):
-                        pair_through(c, kids[si])
-                        si += 1
-                    else:
-                        pair(c, None)
-            pair_through(plan, stats)
+            # tree-aware pairing (runtime_stats.pair_plan_stats, shared
+            # with the plan-feedback fold). Plan rows without an
+            # executor ran inside their parent's kernel and show "-".
+            stats_by_row = [st for _p, st in pair_plan_stats(plan, stats)]
             for (pid, est, info), st in zip(base, stats_by_row):
                 if st is not None:
                     arows, ms, backend, _ = st

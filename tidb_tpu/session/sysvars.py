@@ -49,7 +49,15 @@ class SysVar:
                 v = min(v, self.max_val)
             return v
         if self.type == "float":
-            return float(value)
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                raise WrongValueForVarError(
+                    "Variable '%s' can't be set to the value of '%s'", self.name, value)
+            if self.validate is not None and not self.validate(v):
+                raise WrongValueForVarError(
+                    "Variable '%s' can't be set to the value of '%s'", self.name, value)
+            return v
         if self.type == "enum":
             s = str(value).lower()
             if s not in self.enum_vals:
@@ -297,6 +305,11 @@ for _v in [
     # mid-session.
     SysVar("tidb_tpu_jax_cache_dir", SCOPE_GLOBAL,
            _jax_cache_dir_default(), "str"),
+    # fraction of statements whose trace flushes to the flight
+    # recorder. 0.0 keeps the OLTP fast path out of the ring entirely;
+    # TRACE <stmt> and slow statements are always captured regardless.
+    SysVar("tidb_tpu_trace_sample_rate", SCOPE_BOTH, 0.0, "float",
+           validate=lambda v: 0.0 <= float(v) <= 1.0),
 ]:
     register(_v)
 

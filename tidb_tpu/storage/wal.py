@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 
 _MAGIC = b"WAL2"
@@ -213,36 +214,56 @@ class WalWriter:
         leader syncs."""
         from ..utils import failpoint
         from ..utils import metrics as metrics_util
-        while True:
-            with self._gc_cv:
-                if self._durable_seq >= seq or self._closed:
-                    return
-                if not self._leader_busy:
-                    self._leader_busy = True
-                    start = self._durable_seq
-                    end = self._seq
-                else:
-                    self._gc_cv.wait(0.05)
-                    continue
-            # leader, outside the lock: batch collected (frames
-            # start+1..end are in the file buffer, their committers
-            # parked) but NOT yet durable — the crash seam a wrong
-            # implementation would ack across
-            ok = False
-            try:
-                failpoint.inject("group-commit-leader")
-                self._f.flush()
-                if self.sync:
-                    os.fsync(self._f.fileno())
-                ok = True
-            finally:
+        from ..utils import phase as _phase
+        from ..utils import tracing as _tracing
+        t0 = time.perf_counter()
+        role = "follower"
+        try:
+            while True:
                 with self._gc_cv:
-                    if ok and end > self._durable_seq:
-                        self._durable_seq = end
-                    self._leader_busy = False
-                    self._gc_cv.notify_all()
-            if ok:
-                metrics_util.WAL_GROUP_COMMIT_SIZE.observe(end - start)
+                    if self._durable_seq >= seq or self._closed:
+                        return
+                    if not self._leader_busy:
+                        self._leader_busy = True
+                        role = "leader"
+                        start = self._durable_seq
+                        end = self._seq
+                    else:
+                        self._gc_cv.wait(0.05)
+                        continue
+                # leader, outside the lock: batch collected (frames
+                # start+1..end are in the file buffer, their committers
+                # parked) but NOT yet durable — the crash seam a wrong
+                # implementation would ack across
+                ok = False
+                try:
+                    failpoint.inject("group-commit-leader")
+                    self._f.flush()
+                    if self.sync:
+                        os.fsync(self._f.fileno())
+                    ok = True
+                finally:
+                    with self._gc_cv:
+                        if ok and end > self._durable_seq:
+                            self._durable_seq = end
+                        self._leader_busy = False
+                        self._gc_cv.notify_all()
+                if ok:
+                    metrics_util.WAL_GROUP_COMMIT_SIZE.observe(end - start)
+        finally:
+            # per-statement wait attribution (slow_query /
+            # statements_summary commit_wait_ms) + a trace span when the
+            # committing statement is being traced: leader (led the
+            # fsync, batch = frames made durable) vs follower (parked
+            # on the leader's sync)
+            dt = time.perf_counter() - t0
+            _phase.add("commit_wait_s", dt)
+            if _tracing.active_tracer() is not None:
+                with _tracing.span("wal_group_commit", role=role,
+                                   batch=(end - start)
+                                   if role == "leader" else 0) as sp:
+                    if sp is not None:
+                        sp.start -= dt   # span covers the whole wait
 
     def close(self):
         try:

@@ -15,6 +15,11 @@ Flags:
     concatenation/%-formatting, .format(...), str(...) — the
     cardinality/leak shape. Plain names, attributes, literals, and
     bounded derivations (site.split(...)[0], reason_code(msg)) pass.
+  * span(...) call sites (utils/tracing: tracer.span / the module-level
+    helper) whose span NAME is not a string literal — span names are
+    the trace vocabulary TRACE renders and tests grep for; a computed
+    name is the same unbounded-cardinality shape as a dynamic label
+    (attributes exist for the variable part).
 """
 from __future__ import annotations
 
@@ -57,9 +62,15 @@ class MetricsHygiene(Rule):
     def run(self, ctx):
         for call in ctx.calls:
             f = call.func
+            if isinstance(f, ast.Name):
+                if f.id == "span":
+                    yield from self._check_span(ctx, call, None)
+                continue
             if not isinstance(f, ast.Attribute):
                 continue
-            if f.attr in CTOR_ATTRS:
+            if f.attr == "span":
+                yield from self._check_span(ctx, call, f)
+            elif f.attr in CTOR_ATTRS:
                 base = ctx.root_name(f.value)
                 if base in REGISTRY_BASES or (
                         isinstance(f.value, ast.Name)
@@ -100,6 +111,28 @@ class MetricsHygiene(Rule):
                     f"tuple of string constants: label sets must be "
                     f"static",
                     detail=f"hygiene:labelnames:{slug}")
+
+    def _check_span(self, ctx, call, f):
+        """Flag span(...) with a non-literal name. Attribute form only
+        fires on tracer-like receivers (tracer.span, _tracing.span,
+        domain.tracer.span) — not arbitrary objects with a .span
+        method; the bare-name form is the tracing module helper."""
+        if f is not None:
+            d = (ctx.dotted(f.value) or "").lower()
+            root = (ctx.root_name(f.value) or "").lower()
+            if "tracer" not in d and "tracing" not in d \
+                    and "tracer" not in root and "tracing" not in root:
+                return
+        name = call.args[0] if call.args else next(
+            (kw.value for kw in call.keywords if kw.arg == "name"), None)
+        if not _is_str_const(name):
+            yield self.finding(
+                ctx, call,
+                "span() name is not a string literal: span names are "
+                "the trace vocabulary (TRACE trees, tests, dashboards) "
+                "— keep the name static and put the variable part in "
+                "an attribute",
+                detail=f"hygiene:spanname:{ctx.qualname(call)}")
 
     def _check_labels(self, ctx, call):
         # only flag .labels() on metric-looking receivers: ALL_CAPS

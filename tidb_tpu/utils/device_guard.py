@@ -435,75 +435,88 @@ def guarded_dispatch(fn, *, site: str, ectx=None, domain=None,
 
     attempts = 0
     pressure_evicted = False
+    from . import tracing as _tracing
     while True:
         if ectx is not None:
             ectx.check_killed()
-        try:
-            out = _with_watchdog(attempt, timeout_ms, site)
-            breaker.record_success()
-            if pressure_evicted:
-                # the shed worked: the retry that followed an HBM
-                # pressure eviction landed
-                _metrics.MEM_PRESSURE.labels("retry_ok").inc()
-            return out
-        except (KeyboardInterrupt, SystemExit, GeneratorExit):
-            raise                       # process control, not device health
-        except BaseException as exc:    # noqa: BLE001
-            if isinstance(exc, TiDBError) and \
-                    not isinstance(exc, DeviceDegradedError):
-                raise                   # statement semantics, not health
-            err_class = classify(exc)
-            attempts += 1
-            _bump(domain, "device_dispatch_error")
-            _metrics.DEVICE_DISPATCH_ERRORS.labels(family,
-                                                   err_class).inc()
-            if err_class in RETRYABLE and attempts <= retry_limit:
-                delay = backoff_delay(attempts - 1, base=backoff_base_s)
-                remain = None
-                if ectx is not None and ectx.deadline is not None:
-                    remain = ectx.deadline - time.time()
-                if remain is None or remain > delay:
-                    if err_class == "resource_exhausted":
-                        # HBM pressure protocol: shed cold resident
-                        # entries BEFORE retrying — a blind retry
-                        # re-runs the same allocation against the same
-                        # full device memory
-                        freed = relieve_memory_pressure()
-                        _metrics.MEM_PRESSURE.labels(
-                            "evict" if freed > 0 else "evict_noop"
-                        ).inc()
-                        _bump(domain, "mem_pressure_evict")
-                        if freed > 0:
-                            pressure_evicted = True
-                            log("warn", "mem_pressure_evict", site=site,
-                                freed_bytes=freed, attempt=attempts)
-                    _bump(domain, "device_retry")
-                    _metrics.DEVICE_RETRIES.labels(family,
-                                                   err_class).inc()
-                    log("warn", "device_retry", site=site,
-                        err_class=err_class, attempt=attempts,
-                        err=f"{type(exc).__name__}: {str(exc)[:120]}")
-                    time.sleep(delay)
-                    continue
-                # too close to the statement deadline: degrade now so
-                # retries never outlive max_execution_time
-            tripped = breaker.record_failure()
-            if tripped:
-                _bump(domain, "device_breaker_open")
-                _metrics.BREAKER_OPEN.labels(family).inc()
-                log("warn", "device_breaker_open", family=family,
-                    threshold=breaker.threshold,
-                    cooldown_s=breaker.cooldown_s)
-            if err_class == "resource_exhausted":
-                # the pressure protocol (evict + retry) ran out of
-                # road: the statement degrades to the host twin
-                _metrics.MEM_PRESSURE.labels("degrade").inc()
-            _note_fallback(ectx, domain, site, err_class, exc, attempts,
-                           fallback_is_host=fallback_is_host)
-            if host_fallback is not None:
-                return host_fallback()
-            raise DeviceDegradedError(site, err_class, exc,
-                                      attempts) from exc
+        # span per dispatch attempt (no-op without an active trace):
+        # a retried/degraded statement's trace shows every attempt
+        # with its err_class, so TRACE answers "why was this slow"
+        # without a device_guard log dive
+        with _tracing.span("device_attempt", site=site,
+                           attempt=attempts + 1):
+            try:
+                out = _with_watchdog(attempt, timeout_ms, site)
+                breaker.record_success()
+                if pressure_evicted:
+                    # the shed worked: the retry that followed an HBM
+                    # pressure eviction landed
+                    _metrics.MEM_PRESSURE.labels("retry_ok").inc()
+                return out
+            except (KeyboardInterrupt, SystemExit, GeneratorExit):
+                raise                   # process control, not device health
+            except BaseException as exc:    # noqa: BLE001
+                if isinstance(exc, TiDBError) and \
+                        not isinstance(exc, DeviceDegradedError):
+                    raise               # statement semantics, not health
+                err_class = classify(exc)
+                _tracing.tag(err_class=err_class)
+                attempts += 1
+                _bump(domain, "device_dispatch_error")
+                _metrics.DEVICE_DISPATCH_ERRORS.labels(family,
+                                                       err_class).inc()
+                if err_class in RETRYABLE and attempts <= retry_limit:
+                    delay = backoff_delay(attempts - 1,
+                                          base=backoff_base_s)
+                    remain = None
+                    if ectx is not None and ectx.deadline is not None:
+                        remain = ectx.deadline - time.time()
+                    if remain is None or remain > delay:
+                        if err_class == "resource_exhausted":
+                            # HBM pressure protocol: shed cold resident
+                            # entries BEFORE retrying — a blind retry
+                            # re-runs the same allocation against the
+                            # same full device memory
+                            freed = relieve_memory_pressure()
+                            _metrics.MEM_PRESSURE.labels(
+                                "evict" if freed > 0 else "evict_noop"
+                            ).inc()
+                            _bump(domain, "mem_pressure_evict")
+                            if freed > 0:
+                                pressure_evicted = True
+                                log("warn", "mem_pressure_evict",
+                                    site=site, freed_bytes=freed,
+                                    attempt=attempts)
+                        _bump(domain, "device_retry")
+                        _metrics.DEVICE_RETRIES.labels(family,
+                                                       err_class).inc()
+                        log("warn", "device_retry", site=site,
+                            err_class=err_class, attempt=attempts,
+                            err=f"{type(exc).__name__}: "
+                                f"{str(exc)[:120]}")
+                        time.sleep(delay)
+                        continue
+                    # too close to the statement deadline: degrade now
+                    # so retries never outlive max_execution_time
+                tripped = breaker.record_failure()
+                if tripped:
+                    _bump(domain, "device_breaker_open")
+                    _metrics.BREAKER_OPEN.labels(family).inc()
+                    log("warn", "device_breaker_open", family=family,
+                        threshold=breaker.threshold,
+                        cooldown_s=breaker.cooldown_s)
+                if err_class == "resource_exhausted":
+                    # the pressure protocol (evict + retry) ran out of
+                    # road: the statement degrades to the host twin
+                    _metrics.MEM_PRESSURE.labels("degrade").inc()
+                _note_fallback(ectx, domain, site, err_class, exc,
+                               attempts,
+                               fallback_is_host=fallback_is_host)
+                if host_fallback is not None:
+                    _tracing.tag(fallback="host")
+                    return host_fallback()
+                raise DeviceDegradedError(site, err_class, exc,
+                                          attempts) from exc
 
 
 # ---- chaos: register the injectable error classes ---------------------

@@ -556,7 +556,12 @@ class TopSQL:
         self._by_digest: dict = {}
         self._mu = threading.Lock()
 
-    def record(self, digest, normalized, dur_ms, phases, ok=True):
+    def record(self, digest, normalized, dur_ms, phases, ok=True,
+               drift=None):
+        """drift: optional (max_drift, mean_drift) q-error pair from the
+        statement's plan-feedback fold — running max / running mean kept
+        per digest so a planner regression is visible next to the time
+        it cost."""
         ph = phases or {}
         device_ms = phase_device_ms(ph)
         with self._mu:
@@ -572,7 +577,8 @@ class TopSQL:
                     "kernel_builds": 0, "dispatches": 0,
                     "upload_bytes": 0, "fetch_bytes": 0,
                     "fallback_count": 0, "sum_errors": 0,
-                    "delta_applies": 0, "delta_bytes": 0}
+                    "delta_applies": 0, "delta_bytes": 0,
+                    "max_drift": 0.0, "sum_drift": 0.0, "drift_execs": 0}
             e["exec_count"] += 1
             e["sum_ms"] += dur_ms
             e["sum_device_ms"] += device_ms
@@ -590,6 +596,12 @@ class TopSQL:
             # digest's binds paid for delta folds, and how many bytes
             e["delta_applies"] += ph.get("delta_applies", 0)
             e["delta_bytes"] += ph.get("delta_bytes", 0)
+            if drift is not None:
+                mx, mean = drift
+                if mx > e["max_drift"]:
+                    e["max_drift"] = mx
+                e["sum_drift"] += mean
+                e["drift_execs"] += 1
             if not ok:
                 e["sum_errors"] += 1
 
@@ -689,6 +701,7 @@ def reset_all():
         try:
             d.metrics.clear()
             d.top_sql.clear()
+            d.plan_feedback.clear()
         except Exception:               # noqa: BLE001
             pass
 
@@ -733,6 +746,12 @@ WAL_GROUP_COMMIT_SIZE = REGISTRY.histogram(
     "Commit frames made durable per WAL group-commit sync (leader "
     "batch size; 1 = no concurrent committer joined the group)",
     buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512])
+CARDINALITY_DRIFT = REGISTRY.histogram(
+    "tidb_tpu_cardinality_drift",
+    "Per-operator estimate-vs-actual q-error max(est/act, act/est) "
+    "folded at statement end by plan operator class (always >= 1; "
+    "1 = perfect estimate)", ("op",),
+    buckets=[1, 1.5, 2, 4, 8, 16, 64, 256, 1024, 4096])
 ADMISSION_WAIT_SECONDS = REGISTRY.histogram(
     "tidb_tpu_admission_wait_seconds",
     "Statement admission wait by resource group and workload class "

@@ -1,94 +1,294 @@
-"""Span tracing + flight recorder (reference pkg/util/tracing — span
-regions around statement stages, rendered by TRACE — and
+"""Distributed span tracing + flight recorder (reference pkg/util/tracing
+— span regions around statement stages, rendered by TRACE — and
 pkg/util/traceevent — an in-memory ring of recent events that survives
 until something goes wrong and is then inspectable).
 
 Redesign notes: the reference pushes spans to OpenTracing and dumps the
 flight-recorder ring to a file on triggers (session.go:2417-2423).
-Here the ring IS the queryable surface — every span lands in a bounded
-deque exposed as `information_schema.tidb_trace_events`, so "dump on
-trigger" becomes "SELECT after the fact", and slow statements tag their
-spans so the interesting flights are findable. Overhead when idle: one
-perf_counter pair and a deque append per span."""
+Here the ring IS the queryable surface — spans land in a bounded deque
+exposed as `information_schema.tidb_trace_events`.
+
+Trace context (docs/OBSERVABILITY.md "Distributed tracing"): every
+root span mints a trace_id; child spans carry (trace_id, span_id,
+parent_id), so the ring holds renderable trees instead of a flat
+event list. A trace's events are BUFFERED in memory while it is open
+and flushed to the ring only when the trace is sampled — a sampling
+decision made at the root (the statement mints it; TRACE forces it;
+mark_sampled() upgrades it retroactively, which is how slow statements
+stay always-on without pre-paying ring writes for every fast OLTP
+statement). Context crosses the RPC seam via install_remote /
+uninstall_remote: the worker adopts the coordinator's (trace_id,
+parent_id, sampled), records its spans under it, and hands the
+finished events back to piggyback on the reply.
+
+Module-level `span()` / `tag()` / `current_context()` ride a
+thread-local "active tracer" installed by the innermost open root
+span, so deep subsystems with no Domain reference (the WAL writer,
+device_guard's retry loop, admission queues) can record spans without
+plumbing a tracer through every constructor. With no active tracer on
+the thread they are exact no-ops."""
 from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import threading
 import time
+from typing import NamedTuple
+
+
+class SpanEvent(NamedTuple):
+    """One finished span. The first six fields keep the legacy ring
+    tuple layout (time, conn_id, depth, span, dur_ms, attrs) — the
+    positional `ev[5]` surgery tag_recent used to do is now a named
+    `_replace(attrs=...)` on an immutable record."""
+
+    ts: float            # wall-clock close time
+    conn_id: int
+    depth: int
+    name: str
+    dur_ms: float
+    attrs: str           # "k=v;k=v" rendered attributes
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
+    worker: str = ""     # "" = coordinator/local domain
+
+    @property
+    def start_ts(self) -> float:
+        return self.ts - self.dur_ms / 1000.0
 
 
 class FlightRecorder:
     """Bounded ring of finished spans (reference traceevent ring)."""
 
+    # retroactive tagging never walks more than this many ring slots:
+    # the trigger fires right after the statement, so its spans sit at
+    # the tail — an O(ring) full scan per slow statement was pure waste
+    TAG_REACH_BACK = 512
+
     def __init__(self, cap: int = 4096):
         self.ring: collections.deque = collections.deque(maxlen=cap)
         self._mu = threading.Lock()
 
-    def record(self, ev: tuple):
+    def record(self, ev: SpanEvent):
         with self._mu:
             self.ring.append(ev)
+
+    def record_many(self, evs):
+        with self._mu:
+            self.ring.extend(evs)
 
     def events(self) -> list:
         with self._mu:
             return list(self.ring)
 
     def tag_recent(self, conn_id: int, since: float, tag: str = "slow=1"):
-        """Retroactively mark a connection's spans recorded since
-        `since` — child spans (plan/execute/copr) finish BEFORE the
-        statement span decides it was slow, so the trigger reaches back
-        into the ring (the reference's ring dump captures the same
-        already-finished events)."""
+        """Retroactively mark a connection's ring events recorded since
+        `since`. Newest-first with an early stop at the first event
+        older than `since` (plus the TAG_REACH_BACK hard bound), so the
+        cost is proportional to the statement's own span count, not the
+        ring size. Note: an OPEN trace's events are still buffered —
+        mark_sampled()/tag() handle those; this reaches already-flushed
+        flights only."""
         with self._mu:
-            for i, ev in enumerate(self.ring):
-                if ev[0] >= since and ev[1] == conn_id and \
-                        tag not in ev[5]:
-                    self.ring[i] = ev[:5] + (
-                        (ev[5] + ";" + tag) if ev[5] else tag,)
+            n = len(self.ring)
+            for k in range(1, min(n, self.TAG_REACH_BACK) + 1):
+                ev = self.ring[-k]
+                if ev.ts < since:
+                    break
+                if ev.conn_id == conn_id and tag not in ev.attrs:
+                    self.ring[-k] = ev._replace(
+                        attrs=(ev.attrs + ";" + tag) if ev.attrs else tag)
 
     def clear(self):
         with self._mu:
             self.ring.clear()
 
 
-class _Span:
-    __slots__ = ("name", "depth", "start", "attrs", "conn_id")
+def _render_attrs(attrs: dict) -> str:
+    return ";".join(f"{k}={v}" for k, v in attrs.items())
 
-    def __init__(self, name, depth, attrs, conn_id):
+
+class _Span:
+    __slots__ = ("name", "depth", "start", "attrs", "conn_id",
+                 "span_id", "parent_id")
+
+    def __init__(self, name, depth, attrs, conn_id, span_id, parent_id):
         self.name = name
         self.depth = depth
         self.start = time.perf_counter()
         self.attrs = attrs
         self.conn_id = conn_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+
+class _TraceState:
+    """Per-thread open-trace bookkeeping: the minted trace_id, the
+    sampled decision, and the buffer finished child events accumulate
+    in until the root closes (flush or drop)."""
+
+    __slots__ = ("trace_id", "sampled", "buf", "remote")
+
+    def __init__(self, trace_id, sampled, remote=None):
+        self.trace_id = trace_id
+        self.sampled = sampled
+        self.buf: list = []
+        self.remote = remote     # install_remote sink, or None
+
+
+# thread-local active-tracer slot for the module-level helpers
+_ACTIVE = threading.local()
 
 
 class Tracer:
-    """Per-domain tracer; span nesting tracked per thread."""
+    """Per-domain tracer; span nesting + trace state tracked per
+    thread. `worker` names this node in cross-worker trees ("" = the
+    coordinator / a local single-domain engine)."""
 
-    def __init__(self, recorder: FlightRecorder):
+    def __init__(self, recorder: FlightRecorder, worker: str = ""):
         self.recorder = recorder
+        self.worker = worker
         self._tls = threading.local()
         self.enabled = True
+        # CPython guarantees atomic __next__; ids stay unique across
+        # threads without a lock, and the worker prefix keeps them
+        # unique across processes in one trace tree
+        self._seq = itertools.count(1)
+
+    def _new_id(self, kind: str) -> str:
+        w = self.worker or "c"
+        return f"{kind}-{w}-{next(self._seq)}"
+
+    # ---- remote context (the RPC piggyback seam) ---------------------
+
+    def install_remote(self, trace_id: str, parent_id: str,
+                       sampled: bool) -> None:
+        """Adopt a caller's trace context on this thread: subsequent
+        root spans join `trace_id` under `parent_id` and collect their
+        finished events for uninstall_remote() to hand back."""
+        self._tls.remote = {"trace_id": trace_id, "parent_id": parent_id,
+                            "sampled": bool(sampled), "events": []}
+
+    def uninstall_remote(self) -> list:
+        """-> the SpanEvents recorded under the installed context (for
+        the reply piggyback); clears the context."""
+        r = getattr(self._tls, "remote", None)
+        self._tls.remote = None
+        return r["events"] if r is not None else []
+
+    def absorb(self, events) -> None:
+        """Fold remote (piggybacked) events into the current open
+        trace's buffer so they flush with it; with no open trace they
+        go straight to the ring (background jobs harvesting replies
+        after their span closed)."""
+        state = getattr(self._tls, "state", None)
+        if state is not None:
+            state.buf.extend(events)
+        else:
+            self.recorder.record_many(events)
+
+    # ---- trace state introspection -----------------------------------
+
+    def current_context(self):
+        """-> (trace_id, span_id, sampled, state) of the innermost open
+        span on this thread, or None. The state reference lets fan-out
+        threads append absorbed remote events to the owning trace."""
+        sp = getattr(self._tls, "cur", None)
+        state = getattr(self._tls, "state", None)
+        if sp is None or state is None:
+            return None
+        return (state.trace_id, sp.span_id, state.sampled, state)
+
+    def current_events(self) -> list:
+        """Finished events of the open trace (TRACE renders from here
+        while its statement span is still open)."""
+        state = getattr(self._tls, "state", None)
+        return list(state.buf) if state is not None else []
+
+    def current_root(self):
+        """(trace_id, innermost span) of the open trace, or None."""
+        state = getattr(self._tls, "state", None)
+        sp = getattr(self._tls, "cur", None)
+        if state is None or sp is None:
+            return None
+        return state.trace_id, sp
+
+    def mark_sampled(self):
+        """Upgrade the open trace to sampled (flush at root close) —
+        the slow-statement trigger and drained-something background
+        polls call this after the fact."""
+        state = getattr(self._tls, "state", None)
+        if state is not None:
+            state.sampled = True
+
+    # ---- spans -------------------------------------------------------
 
     @contextlib.contextmanager
-    def span(self, name: str, conn_id: int | None = None, **attrs):
+    def span(self, name: str, conn_id: int | None = None,
+             sampled: bool | None = None, trace_id: str | None = None,
+             **attrs):
+        """Record a span. Nesting is per-thread; the outermost span on
+        a thread is the trace ROOT: it mints (or adopts, under
+        install_remote) the trace_id and owns the sampled decision —
+        `sampled` / `trace_id` are honored only there. Child spans
+        inherit conn_id and parent linkage automatically."""
         if not self.enabled:
             yield None
             return
-        parent = getattr(self._tls, "cur", None)
-        if conn_id is None:      # inherit: child spans (copr kernels)
-            conn_id = parent.conn_id if parent else 0
-        sp = _Span(name, (parent.depth + 1) if parent else 0, attrs,
-                   conn_id)
-        self._tls.cur = sp
+        tls = self._tls
+        parent = getattr(tls, "cur", None)
+        root = parent is None
+        prev_active = None
+        remote = None
+        if root:
+            remote = getattr(tls, "remote", None)
+            if remote is not None:
+                state = _TraceState(remote["trace_id"],
+                                    remote["sampled"], remote)
+                parent_id = remote["parent_id"]
+            else:
+                state = _TraceState(trace_id or self._new_id("t"),
+                                    bool(sampled))
+                parent_id = ""
+            tls.state = state
+            prev_active = getattr(_ACTIVE, "tracer", None)
+            _ACTIVE.tracer = self
+            if conn_id is None:
+                conn_id = 0
+            depth = 0
+        else:
+            state = tls.state
+            parent_id = parent.span_id
+            if conn_id is None:      # inherit: child spans (copr kernels)
+                conn_id = parent.conn_id
+            depth = parent.depth + 1
+        sp = _Span(name, depth, attrs, conn_id, self._new_id("s"),
+                   parent_id)
+        tls.cur = sp
         try:
             yield sp
         finally:
-            self._tls.cur = parent
+            tls.cur = parent
             dur_ms = (time.perf_counter() - sp.start) * 1000.0
-            self.recorder.record((
-                time.time(), conn_id, sp.depth, name, dur_ms,
-                ";".join(f"{k}={v}" for k, v in sp.attrs.items())))
+            state.buf.append(SpanEvent(
+                time.time(), sp.conn_id, sp.depth, name, dur_ms,
+                _render_attrs(sp.attrs), state.trace_id, sp.span_id,
+                sp.parent_id, self.worker))
+            if root:
+                tls.state = None
+                _ACTIVE.tracer = prev_active
+                if remote is not None:
+                    # hand the whole subtree to the RPC reply; a
+                    # sampled remote trace ALSO lands in this worker's
+                    # own ring (locally inspectable mid-flight)
+                    remote["events"].extend(state.buf)
+                    if state.sampled:
+                        self.recorder.record_many(state.buf)
+                elif state.sampled:
+                    self.recorder.record_many(state.buf)
+                # unsampled local trace: buffer dropped, ring untouched
 
     def tag(self, **attrs):
         """Attach attributes to the innermost open span (e.g. the slow
@@ -96,3 +296,57 @@ class Tracer:
         sp = getattr(self._tls, "cur", None)
         if sp is not None:
             sp.attrs.update(attrs)
+
+    def tag_buffered(self, tag: str = "slow=1"):
+        """Tag the open trace's already-finished spans (plan/execute/
+        copr closed into the buffer before the statement knew it was
+        slow). In-place so concurrent absorb() extends stay safe."""
+        state = getattr(self._tls, "state", None)
+        if state is None:
+            return
+        buf = state.buf
+        for i, ev in enumerate(buf):
+            if tag not in ev.attrs:
+                buf[i] = ev._replace(
+                    attrs=(ev.attrs + ";" + tag) if ev.attrs else tag)
+
+
+# ---- module-level helpers (for subsystems without a Domain) -----------
+
+def active_tracer() -> Tracer | None:
+    return getattr(_ACTIVE, "tracer", None)
+
+
+def current_context():
+    """Trace context of this thread's active tracer (None when no span
+    is open). Fan-out threads receive it via set_thread_context."""
+    ctx = getattr(_ACTIVE, "ctx", None)
+    if ctx is not None:
+        return ctx
+    t = getattr(_ACTIVE, "tracer", None)
+    return t.current_context() if t is not None else None
+
+
+def set_thread_context(ctx) -> None:
+    """Install an explicit trace context on this thread (cluster
+    fan-out workers carry the coordinator statement's context across
+    the thread boundary). Pass None to clear."""
+    _ACTIVE.ctx = ctx
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Record a child span on this thread's active tracer; exact no-op
+    when none is active (background threads, untraced fast path)."""
+    t = getattr(_ACTIVE, "tracer", None)
+    if t is None:
+        yield None
+        return
+    with t.span(name, **attrs) as sp:
+        yield sp
+
+
+def tag(**attrs) -> None:
+    t = getattr(_ACTIVE, "tracer", None)
+    if t is not None:
+        t.tag(**attrs)
