@@ -62,6 +62,7 @@ RESTORE_CASES = [
 _BACKUP_CHILD = r"""
 import os, sys, threading, time
 sys.path.insert(0, {repo!r})
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ["TIDB_TPU_PLATFORM"] = "cpu"
 os.environ["TIDB_TPU_BR_CHUNK_ROWS"] = "64"
 from tidb_tpu.session import new_store, Session

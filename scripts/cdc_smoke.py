@@ -40,6 +40,7 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("TIDB_TPU_PLATFORM", "cpu")
 

@@ -13,6 +13,7 @@ Env:    CHAOS_SF (0.05), CHAOS_QUERY_BUDGET_S (120), CHAOS_ERROR (grant_lost)
 Exit:   0 all queries host-identical; 1 mismatch/stall/error.
 """
 import os
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 import sys
 import time
 

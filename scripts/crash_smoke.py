@@ -75,6 +75,7 @@ MODE_SETUP = {
 _CHILD = r"""
 import os, sys
 sys.path.insert(0, {repo!r})
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ["TIDB_TPU_PLATFORM"] = "cpu"
 from tidb_tpu.session import new_store, Session
 from tidb_tpu.utils import failpoint

@@ -85,6 +85,7 @@ CASES = [
 _CHILD = r"""
 import os, sys, threading, time
 sys.path.insert(0, {repo!r})
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ["TIDB_TPU_PLATFORM"] = "cpu"
 os.environ["TIDB_TPU_DDL_REORG_BATCH"] = str({batch})
 from tidb_tpu.session import new_store, Session

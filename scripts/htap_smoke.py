@@ -44,6 +44,7 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("TIDB_TPU_MUTATION_CHECK", "0")
 # analytics on the device path regardless of table size: XLA releases
 # the GIL there, the host twin does not (the oltp_smoke rationale)

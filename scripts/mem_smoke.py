@@ -37,6 +37,7 @@ sys.path.insert(0, _REPO)
 
 # device routing for every fragment: the pressure protocol must see
 # uploads/dispatches, not the host twin short-circuit
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("TIDB_TPU_FRAGMENT_MIN_ROWS", "0")
 os.environ.setdefault("TIDB_TPU_SORT_MIN", "1")
 

@@ -18,6 +18,7 @@ Env:    SMOKE_SF (0.02), SMOKE_QUERIES (q1,q3,q6,q14),
 Exit:   0 clean scrape + attribution + feedback + cluster trace; 1.
 """
 import os
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 import subprocess
 import sys
 

@@ -42,6 +42,7 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("TIDB_TPU_MUTATION_CHECK", "0")
 # route the Q1 analyst through the device path (XLA releases the GIL
 # during execution) regardless of table size — that IS the deployment

@@ -41,6 +41,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 # structure gate, not a speed gate: never burn a TPU grant on it
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 if os.environ.get("PERF_MESH") == "1" and \
         "xla_force_host_platform_device_count" not in \

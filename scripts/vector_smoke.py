@@ -37,6 +37,7 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")   # lock-rank sanitizer armed
 os.environ.setdefault("TIDB_TPU_MUTATION_CHECK", "0")
 
 import numpy as np  # noqa: E402

@@ -13,6 +13,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_ENABLE_X64"] = "1"
+# run the whole suite with the lock-rank sanitizer armed: any lock
+# acquisition that violates utils/lockrank_ranks.py raises
+# LockRankError at the offending acquire (utils/lockrank.py)
+os.environ.setdefault("TIDB_TPU_LOCKRANK", "1")
 
 try:
     # pallas registers TPU lowering rules at import; that registration

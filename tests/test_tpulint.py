@@ -907,3 +907,364 @@ def test_strict_cli_clean_tree_exits_zero():
          "--strict"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---- lock-order (program rule) ---------------------------------------
+
+from tidb_tpu.tools.tpulint import lint_sources  # noqa: E402
+
+CYCLE_A = """
+import threading
+from fixpkg import b
+
+MU_A = threading.Lock()
+
+
+def grab_a():
+    with MU_A:
+        pass
+
+
+def path_ab():
+    with MU_A:
+        b.grab_b()
+"""
+
+CYCLE_B = """
+import threading
+from fixpkg import a
+
+MU_B = threading.Lock()
+
+
+def grab_b():
+    with MU_B:
+        pass
+
+
+def path_ba():
+    with MU_B:
+        a.grab_a()
+"""
+
+
+def run_lint_program(sources, rules, **cfg_kw):
+    config = LintConfig(root=REPO, enabled=set(rules), **cfg_kw)
+    return lint_sources(
+        {rel: textwrap.dedent(src) for rel, src in sources.items()},
+        config)
+
+
+def test_lock_order_two_lock_cycle_via_call_edge():
+    """A->B in one file, B->A through a cross-file call edge: one
+    cycle finding naming both acquisition paths."""
+    fs = run_lint_program(
+        {"fixpkg/a.py": CYCLE_A, "fixpkg/b.py": CYCLE_B},
+        rules={"lock-order"})
+    hits = rule_hits(fs, "lock-order")
+    assert len(hits) == 1, [f.message for f in fs]
+    f = hits[0]
+    assert f.detail.startswith("cycle:")
+    assert "MU_A" in f.message and "MU_B" in f.message
+    # both edges are named with their file:line evidence
+    assert "fixpkg/a.py" in f.message and "fixpkg/b.py" in f.message
+
+
+def test_lock_order_cycle_waived_with_external_ordering_comment():
+    """Waiving ONE edge of the cycle (with the external-ordering
+    argument) suppresses the cycle — the waiver is the reviewed claim
+    that this interleaving cannot happen."""
+    a_waived = CYCLE_A.replace(
+        "        b.grab_b()",
+        "        # tpulint: disable=lock-order — external ordering:\n"
+        "        # path_ab only runs in the bootstrap thread, before\n"
+        "        # path_ba's worker pool exists\n"
+        "        b.grab_b()")
+    fs = run_lint_program(
+        {"fixpkg/a.py": a_waived, "fixpkg/b.py": CYCLE_B},
+        rules={"lock-order"})
+    assert rule_hits(fs, "lock-order") == []
+
+
+def test_lock_order_no_cycle_no_finding():
+    fs = run_lint_program(
+        {"fixpkg/a.py": CYCLE_A}, rules={"lock-order"})
+    assert rule_hits(fs, "lock-order") == []
+
+
+RANKED_USE = """
+import threading
+from tidb_tpu.utils import lockrank
+
+MU = lockrank.ranked_lock("fix.low")
+MU2 = lockrank.ranked_lock("fix.high")
+
+
+def nested():
+    with MU:
+        with MU2:
+            pass
+"""
+
+
+def test_lock_order_rank_registry_unknown_name():
+    """A ranked_lock() whose name is missing from the registry is a
+    finding — the runtime sanitizer and the static graph must share
+    one registry."""
+    fs = run_lint_program(
+        {"fixpkg/m.py": RANKED_USE}, rules={"lock-order"},
+        lock_ranks={"fix.low": 10})          # fix.high missing
+    hits = rule_hits(fs, "lock-order")
+    assert any(f.detail == "rank-registry:unknown:fix.high"
+               for f in hits), [f.detail for f in hits]
+
+
+def test_lock_order_rank_registry_call_site_drift():
+    """An explicit rank literal at the call site contradicting the
+    registry is flagged (the registry is the single source of
+    truth)."""
+    src = RANKED_USE.replace('lockrank.ranked_lock("fix.low")',
+                             'lockrank.ranked_lock("fix.low", 99)')
+    fs = run_lint_program(
+        {"fixpkg/m.py": src}, rules={"lock-order"},
+        lock_ranks={"fix.low": 10, "fix.high": 20})
+    hits = rule_hits(fs, "lock-order")
+    assert any(f.detail == "rank-registry:drift:fix.low"
+               for f in hits), [f.detail for f in hits]
+
+
+def test_lock_order_rank_drift_on_edge():
+    """Acquiring a LOWER-ranked lock while holding a higher one is a
+    finding even without a full cycle in view."""
+    fs = run_lint_program(
+        {"fixpkg/m.py": RANKED_USE}, rules={"lock-order"},
+        lock_ranks={"fix.low": 20, "fix.high": 10})  # inverted
+    hits = rule_hits(fs, "lock-order")
+    assert any(f.detail.startswith("rank-drift:") for f in hits), \
+        [f.detail for f in hits]
+
+
+def test_lock_order_rank_consistent_edge_clean():
+    fs = run_lint_program(
+        {"fixpkg/m.py": RANKED_USE}, rules={"lock-order"},
+        lock_ranks={"fix.low": 10, "fix.high": 20})
+    assert rule_hits(fs, "lock-order") == []
+
+
+# ---- blocking-under-lock (program rule) ------------------------------
+
+FSYNC_UNDER_LOCK = """
+import os
+import threading
+
+MU = threading.Lock()
+
+
+def flush(f):
+    with MU:
+        f.flush()
+        os.fsync(f.fileno())
+"""
+
+
+def test_blocking_fsync_under_mutex_flagged():
+    fs = run_lint_program({"fixpkg/w.py": FSYNC_UNDER_LOCK},
+                          rules={"blocking-under-lock"})
+    hits = rule_hits(fs, "blocking-under-lock")
+    dets = [f.detail for f in hits]
+    assert any(":fsync:" in d for d in dets) and \
+        any(":flush:" in d for d in dets), dets
+
+
+DISPATCH_UNDER_LOCK = """
+import threading
+from tidb_tpu.utils import device_guard
+
+MU = threading.Lock()
+
+
+def run(x, ectx):
+    with MU:
+        return device_guard.guarded_dispatch(
+            lambda: x, site="fix/run", ectx=ectx)
+"""
+
+
+def test_blocking_dispatch_under_lock_flagged():
+    fs = run_lint_program({"fixpkg/d.py": DISPATCH_UNDER_LOCK},
+                          rules={"blocking-under-lock"})
+    hits = rule_hits(fs, "blocking-under-lock")
+    assert any(":dispatch:" in f.detail for f in hits), \
+        [f.detail for f in hits]
+
+
+def test_blocking_transitive_through_call_edge():
+    """The blocking op is in a helper; the lock region only CALLS the
+    helper — the finding lands at the call site inside the region."""
+    src = """
+    import os
+    import threading
+
+    MU = threading.Lock()
+
+
+    def _sync(f):
+        os.fsync(f.fileno())
+
+
+    def flush(f):
+        with MU:
+            _sync(f)
+    """
+    fs = run_lint_program({"fixpkg/t.py": src},
+                          rules={"blocking-under-lock"})
+    hits = rule_hits(fs, "blocking-under-lock")
+    assert any(":fsync:" in f.detail for f in hits)
+    assert any("_sync" in f.message for f in hits)
+
+
+WAIT_FIXTURE = """
+import threading
+
+MU = threading.Lock()
+DONE = threading.Condition(threading.Lock())
+
+
+def bad():
+    with MU:
+        with DONE:
+            DONE.wait()          # untimed, under a FOREIGN lock
+
+
+def good():
+    with DONE:
+        DONE.wait(0.05)          # timed wait on its own lock
+"""
+
+
+def test_blocking_untimed_wait_flagged_timed_wait_clean():
+    fs = run_lint_program({"fixpkg/c.py": WAIT_FIXTURE},
+                          rules={"blocking-under-lock"})
+    hits = rule_hits(fs, "blocking-under-lock")
+    assert any(":wait:" in f.detail for f in hits), \
+        [f.detail for f in hits]
+    # the timed wait in good() produced nothing: every hit names bad's
+    # holder MU
+    assert all("MU" in f.detail for f in hits), \
+        [f.detail for f in hits]
+
+
+def test_blocking_hot_lock_wait_while_lock_held():
+    src = """
+    import threading
+    from tidb_tpu.utils import lockrank
+
+    MU = threading.Lock()
+    HOT = lockrank.ranked_lock("fix.hot")
+
+
+    def f():
+        with MU:
+            with HOT:
+                pass
+    """
+    fs = run_lint_program({"fixpkg/h.py": src},
+                          rules={"blocking-under-lock"},
+                          lock_ranks={"fix.hot": 10},
+                          hot_locks={"fix.hot"})
+    hits = rule_hits(fs, "blocking-under-lock")
+    assert any(f.detail.startswith("hot-wait:") for f in hits), \
+        [f.detail for f in hits]
+
+
+def test_blocking_waiver_respected():
+    waived = FSYNC_UNDER_LOCK.replace(
+        "        os.fsync(f.fileno())",
+        "        # tpulint: disable=blocking-under-lock — fixture\n"
+        "        os.fsync(f.fileno())").replace(
+        "        f.flush()",
+        "        # tpulint: disable=blocking-under-lock — fixture\n"
+        "        f.flush()")
+    fs = run_lint_program({"fixpkg/w.py": waived},
+                          rules={"blocking-under-lock"})
+    assert rule_hits(fs, "blocking-under-lock") == []
+
+
+def test_package_lock_graph_acyclic_and_rank_clean():
+    """The acceptance invariant for THIS PR: the whole package's lock
+    digraph has no cycles and no rank drift, with the real registry."""
+    cfg = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                 root=REPO)
+    assert cfg.lock_ranks, "utils/lockrank_ranks.py not parsed"
+    findings = lint_paths([os.path.join(REPO, "tidb_tpu")], cfg)
+    bad = [f for f in findings
+           if f.rule in ("lock-order", "blocking-under-lock")
+           and not f.baselined]
+    assert bad == [], "\n".join(
+        f"{f.path}:{f.line} {f.detail}" for f in bad)
+
+
+# ---- incremental cache + --jobs --------------------------------------
+
+def test_cache_hit_on_unchanged_source(tmp_path):
+    from tidb_tpu.tools.tpulint import LintCache
+    cache = LintCache(directory=str(tmp_path / "c"))
+    cfg = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                 root=REPO)
+    target = os.path.join(REPO, "tidb_tpu", "utils", "lockrank.py")
+    lint_paths([target], cfg, cache=cache)
+    assert cache.misses >= 1 and cache.hits == 0
+    cache2 = LintCache(directory=str(tmp_path / "c"))
+    cfg2 = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                  root=REPO)
+    lint_paths([target], cfg2, cache=cache2)
+    assert cache2.hits >= 1, (cache2.hits, cache2.misses)
+
+
+def test_cache_invalidated_by_rule_set_and_source_change(tmp_path):
+    from tidb_tpu.tools.tpulint.cache import (LintCache,
+                                              config_fingerprint)
+    cfg = LintConfig(root=REPO)
+    fp_all = config_fingerprint(cfg, ["a", "b"])
+    fp_sub = config_fingerprint(cfg, ["a"])
+    assert fp_all != fp_sub
+    cache = LintCache(directory=str(tmp_path / "c"))
+    assert cache.key("src1", fp_all) != cache.key("src2", fp_all)
+    assert cache.key("src1", fp_all) != cache.key("src1", fp_sub)
+
+
+def test_cached_findings_reabsorb_against_live_baseline(tmp_path):
+    """A cached finding must re-match the CURRENT baseline, not the
+    baseline state at cache-write time."""
+    from tidb_tpu.tools.tpulint import LintCache
+    fixture = tmp_path / "pkg" / "f.py"
+    fixture.parent.mkdir()
+    fixture.write_text(textwrap.dedent(DISPATCH_POS))
+    cachedir = str(tmp_path / "c")
+
+    cfg = LintConfig(root=str(tmp_path))
+    fs = lint_paths([str(fixture)], cfg,
+                    cache=LintCache(directory=cachedir))
+    new = [f for f in fs if not f.baselined]
+    assert len(new) == 1
+    bl = Baseline(entries=[{
+        "rule": new[0].rule, "file": new[0].path,
+        "context": new[0].context, "detail": new[0].detail,
+        "reason": "fixture"}])
+    cfg2 = LintConfig(root=str(tmp_path), baseline=bl)
+    fs2 = lint_paths([str(fixture)], cfg2,
+                     cache=LintCache(directory=cachedir))
+    assert all(f.baselined for f in fs2
+               if f.rule == "unguarded-dispatch")
+
+
+def test_jobs_parallel_matches_serial():
+    cfg1 = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                  root=REPO)
+    target = os.path.join(REPO, "tidb_tpu", "cluster")
+    serial = lint_paths([target], cfg1, jobs=1)
+    cfg2 = LintConfig.for_package(os.path.join(REPO, "tidb_tpu"),
+                                  root=REPO)
+    parallel = lint_paths([target], cfg2, jobs=4)
+    key = lambda f: (f.path, f.line, f.rule, f.detail)  # noqa: E731
+    assert sorted(map(key, serial)) == sorted(map(key, parallel))

@@ -24,13 +24,13 @@ happens on the changefeed worker thread, never inside the hook.
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 
 from ..codec.codec import decode_row_value
 from ..codec.tablecodec import (META_PREFIX, RECORD_PREFIX_SEP,
                                 TABLE_PREFIX, decode_record_key)
 from .events import OP_DELETE, OP_INSERT, OP_UPDATE, DDLEvent, RowEvent
+from ..utils import lockrank
 
 # databases never captured: bootstrap/system churn (sysvar persistence,
 # stats) is engine-internal, like TiCDC's default filter
@@ -47,7 +47,7 @@ class Capture:
 
     def __init__(self, domain):
         self.domain = domain
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("cdc.capture")
         self._subs: dict[int, deque] = {}
         self._inline: list = []
         self._next_sub = 0

@@ -41,6 +41,7 @@ from ..utils import metrics as metrics_util
 from .capture import Capture
 from .events import DDLEvent
 from .sinks import make_sink, observe_sink_delivery
+from ..utils import lockrank
 
 STATES = ("normal", "paused", "error", "failed", "removed")
 
@@ -67,8 +68,8 @@ class Changefeed:
         self.consecutive_errors = 0
         self.emitted_txns = 0
         self.emitted_rows = 0
-        self._mu = threading.Lock()
-        self._persist_mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("cdc.changefeed")
+        self._persist_mu = lockrank.ranked_lock("cdc.changefeed.persist")
         self._buffer: list = []        # heap of (commit_ts, mutations)
         self._buffered: set = set()    # commit_ts present in the heap
         self._sub = None
@@ -312,7 +313,7 @@ class ChangefeedManager:
         self.domain = domain
         self.capture = Capture(domain)
         self.feeds: dict[str, Changefeed] = {}
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("cdc.changefeed.registry")
 
     def poll_interval_s(self) -> float:
         from ..utils import env_int

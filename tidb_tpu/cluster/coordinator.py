@@ -19,6 +19,7 @@ from .rpc import (send_msg, recv_msg, deserialize_partials,
 from ..codec.tablecodec import meta_key
 from ..errors import ClusterEpochStaleError
 from ..utils import env_int
+from ..utils import lockrank
 
 _K_CLUSTER_EPOCH = meta_key(b"ClusterEpoch")
 
@@ -47,7 +48,7 @@ class _WorkerClient:
         # one socket per worker: concurrent callers (dxf_run fans out
         # per-SUBTASK threads) must serialize send+recv or interleave
         # each other's frames
-        self._call_mu = threading.Lock()
+        self._call_mu = lockrank.ranked_lock("cluster.coordinator.call")
         self._rid_prefix = uuid.uuid4().hex[:12]
         self._rid_seq = 0
         from ..utils.device_guard import CircuitBreaker
@@ -110,7 +111,13 @@ class _WorkerClient:
                 try:
                     failpoint.inject("cluster/rpc")
                     t0 = time.perf_counter()
+                    # socket I/O under _call_mu is the lock's PURPOSE:
+                    # one stream per worker, send+recv must be an
+                    # atomic frame exchange or concurrent callers
+                    # interleave frames (see __init__)
+                    # tpulint: disable=blocking-under-lock — per-socket
                     send_msg(self.sock, req, arrays, op=op)
+                    # tpulint: disable=blocking-under-lock — per-socket
                     out, arrs = self._recv_reply(rid, op)
                     _metrics.RPC_SECONDS.labels(op).observe(
                         time.perf_counter() - t0)
@@ -127,6 +134,10 @@ class _WorkerClient:
                             op, "transport_error").inc()
                         raise
                     _metrics.RPC_RETRIES.labels(op).inc()
+                    # backoff stays under _call_mu on purpose: a
+                    # second caller must not slip a frame onto the
+                    # half-reconnected stream between attempts
+                    # tpulint: disable=blocking-under-lock — retry gap
                     time.sleep(delay)
                     try:
                         self._connect()     # fresh stream: no stale
@@ -162,7 +173,7 @@ class Cluster:
         # meta namespace) by every fenced failover; every client call
         # stamps it, every worker rejects mismatches
         self.epoch = 0
-        self._topo_mu = threading.RLock()
+        self._topo_mu = lockrank.ranked_rlock("cluster.coordinator.topo")
         self.workers = [self._client(p) for p in ports]
         # region label per worker (PD store labels); None = unlabeled
         self.worker_regions = list(regions) if regions else None
@@ -959,7 +970,7 @@ class Cluster:
         import threading
         from concurrent.futures import ThreadPoolExecutor
         alive = set(range(len(self.workers)))
-        alive_mu = threading.Lock()
+        alive_mu = lockrank.ranked_lock("cluster.coordinator.alive")
 
         def run_one(i):
             attempt = 0

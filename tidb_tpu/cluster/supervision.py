@@ -27,6 +27,7 @@ import time
 from .rpc import send_msg, recv_msg
 from ..utils import metrics as _metrics
 from ..utils.logutil import log
+from ..utils import lockrank
 
 STATE_UP = "up"
 STATE_SUSPECT = "suspect"
@@ -47,7 +48,7 @@ class ClusterMonitor:
         self.failovers = 0
         self.reintegrations = 0
         self._stop = threading.Event()
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("cluster.supervision")
         now = time.monotonic()
         self._slots = {i: {"state": STATE_UP, "last_ok": now,
                            "lag": 0.0, "epoch": 0, "fenced": False,

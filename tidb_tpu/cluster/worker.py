@@ -27,6 +27,7 @@ import numpy as np
 
 from .rpc import send_msg, recv_msg, serialize_partials
 from ..errors import ClusterEpochStaleError
+from ..utils import lockrank
 
 # replies for these ops are never cached in the dedup window: they are
 # read-only/idempotent by construction (or, for tso, must stay fresh),
@@ -77,10 +78,10 @@ class WorkerServer:
         # arrays) after; FIFO-evicted at _DEDUP_WINDOW entries.
         self._dedup: dict = {}
         self._dedup_order: deque = deque()
-        self._dedup_mu = threading.Lock()
+        self._dedup_mu = lockrank.ranked_lock("cluster.worker.dedup")
         self._dedup_hits = 0
         self._inflight = 0
-        self._inflight_mu = threading.Lock()
+        self._inflight_mu = lockrank.ranked_lock("cluster.worker.inflight")
         # ship-RPC correlation: WAL ship/reset frames carry their own
         # request ids so a duplicated frame's extra reply can never
         # shift the primary's reply stream (a stale buffered {ok}
@@ -100,7 +101,7 @@ class WorkerServer:
         # worker's own shard data must not double-count) and handed to
         # the coordinator at promotion time.
         self._follower_sock = None
-        self._follower_mu = threading.Lock()
+        self._follower_mu = lockrank.ranked_lock("cluster.worker.follower")
         self._ship_suppressed = False
         self._replica: dict = {}       # primary id -> [frame bytes]
         self._ship_hook_installed = False
@@ -270,6 +271,11 @@ class WorkerServer:
         with self._follower_mu:
             if self._unshipped and self._follower_sock is None:
                 self._reconnect_after = 0.0
+                # the follower socket is OWNED by _follower_mu: ship,
+                # reconnect and reseed must serialize against the ship
+                # hook or frames interleave on the stream (synchronous
+                # replication design, PR 14 epoch fencing)
+                # tpulint: disable=blocking-under-lock — socket owner
                 self._try_reconnect_locked()
             return len(self._unshipped)
 
@@ -507,6 +513,11 @@ class WorkerServer:
             self._follower_sock = socket.create_connection(
                 ("127.0.0.1", port), timeout=30)
             self._primary_id = primary
+            # reseed streams the full history over the follower socket
+            # under its owner lock on purpose: a commit shipping
+            # concurrently would land MID-SEED and corrupt the reset
+            # log the replacement is rebuilding from
+            # tpulint: disable=blocking-under-lock — socket owner
             self._seed_follower_locked()
         if self._ship_hook_installed:
             return
@@ -534,6 +545,7 @@ class WorkerServer:
                     # periodically retry the follower — a transient
                     # socket error must not silence replication forever
                     self._unshipped.append(payload)
+                    # tpulint: disable=blocking-under-lock — socket owner
                     self._try_reconnect_locked()
                     if self._fenced:
                         # the reconnect discovered the follower at a

@@ -60,9 +60,9 @@ domain; all internal state mutates under one lock (the get/put fast
 paths are a few dict ops)."""
 from __future__ import annotations
 
-import threading
 
 from ..utils import metrics as _metrics
+from ..utils import lockrank
 
 SPECS = ("local", "sharded", "replicated")
 
@@ -74,7 +74,7 @@ class DeviceResidentStore:
     def __init__(self, budget_bytes: int):
         self.budget = budget_bytes
         self.bytes = 0
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("residency.device")
         self._entries: dict = {}       # key -> device array
         self._sizes: dict = {}         # key -> charged bytes (the spec
         #                                charging policy, see module doc)

@@ -57,6 +57,7 @@ from ..errors import (TiDBError, WriteConflictError, TableNotExistsError,
 from ..utils import failpoint
 from ..utils import metrics as metrics_util
 from .manager import OwnerManager, LocalLeaseStore
+from ..utils import lockrank
 
 
 class _CancelRequested(Exception):
@@ -97,7 +98,7 @@ class DDLJobRunner:
 
     def __init__(self, domain):
         self.domain = domain
-        self._mu = threading.RLock()
+        self._mu = lockrank.ranked_rlock("ddl.runner")
         self.owner = OwnerManager(LocalLeaseStore(), "ddl-owner",
                                   "domain-%x" % id(domain), ttl=10.0)
         # job_id -> callable returning True when the driving session

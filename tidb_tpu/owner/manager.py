@@ -60,12 +60,13 @@ class _RemoteLeaseStore:
     def __init__(self, worker_client):
         from ..cluster.coordinator import _WorkerClient
         self.w = _WorkerClient(worker_client.port)
-        self._mu = threading.Lock()
 
     def _call(self, action, key, node, ttl=0.0):
-        with self._mu:               # one socket: serialize calls
-            out, _ = self.w.call({"op": "lease", "action": action,
-                                  "key": key, "node": node, "ttl": ttl})
+        # no lock here: _WorkerClient._call_mu already serializes the
+        # dedicated socket — a second mutex on top only added a
+        # blocking-under-lock layer (socket I/O under OUR lock)
+        out, _ = self.w.call({"op": "lease", "action": action,
+                              "key": key, "node": node, "ttl": ttl})
         return out
 
     def acquire(self, key, node, ttl):

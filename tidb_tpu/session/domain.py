@@ -2,7 +2,6 @@
 (reference pkg/domain/domain.go:556)."""
 from __future__ import annotations
 
-import threading
 
 from ..storage import Storage
 from ..storage.columnar import ColumnarEngine
@@ -12,6 +11,7 @@ from ..dxf import TaskManager
 from ..dxf.framework import Timer
 from ..utils.memory import Tracker
 from ..utils import metrics as metrics_util
+from ..utils import lockrank
 
 
 class _Allocator:
@@ -20,7 +20,7 @@ class _Allocator:
 
     def __init__(self, start=0):
         self._next = start + 1
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("domain.alloc")
 
     def next(self) -> int:
         with self._mu:
@@ -49,7 +49,7 @@ class GlobalMemoryController:
 
     def __init__(self, domain):
         self.domain = domain
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("domain.memctl")
         self._victim_tracker = None
 
     def limit_bytes(self) -> int:
@@ -160,7 +160,7 @@ class Domain:
         # LOCK TABLES registry: (db, table) -> (mode, conn_id)
         # (reference pkg/ddl table locks, gated by enable-table-lock)
         self.table_locks: dict = {}
-        self.table_locks_mu = threading.Lock()
+        self.table_locks_mu = lockrank.ranked_lock("domain.table_locks")
         from ..utils import LRUCache
         # (sql, db, ver, flags) -> PhysPlan; O(1) LRU (the residency
         # idiom) — the old list-order sidecar scanned on every insert
@@ -203,7 +203,7 @@ class Domain:
         # concurrent DDL commits could collapse two bumps into one,
         # leaving a template built between them validly keyed
         from ..codec.tablecodec import META_PREFIX as _MPREF
-        self._epoch_mu = threading.Lock()
+        self._epoch_mu = lockrank.ranked_lock("domain.epoch")
 
         def _meta_epoch_hook(_commit_ts, mutations):
             for k, _v in mutations:

@@ -20,13 +20,13 @@ tombstones so a late commit fails instead of resurrecting.
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 
 from ..native.memtable import new_memkv
 from ..errors import (WriteConflictError, LockWaitTimeoutError,
                       LockNowaitError, DeadlockError)
 from ..utils import failpoint
+from ..utils import lockrank
 from ..utils import metrics as metrics_util
 from .lock_resolver import LockCtx, LockResolver, WaitManager
 
@@ -78,7 +78,7 @@ class MVCCStore:
         self._kv = new_memkv()       # key -> _Versions (C++ sorted memtable
                                      # when available; python fallback)
         self._locks: dict[bytes, Lock] = {}
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("mvcc.store")
         self.commit_hooks = []       # called with (commit_ts, mutations) post-commit
         self.wal = None              # optional WalWriter
         # resolved-ts bookkeeping (CDC, storage/../cdc): a commit is

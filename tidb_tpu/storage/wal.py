@@ -21,9 +21,10 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 import time
 import zlib
+
+from ..utils import lockrank
 
 _MAGIC = b"WAL2"
 _CKPT_MAGIC = b"CKP2"
@@ -166,7 +167,7 @@ class WalWriter:
                 with open(path, "r+b") as tf:
                     tf.truncate(good)
         self._f = open(path, "ab")
-        self._gc_cv = threading.Condition(threading.Lock())
+        self._gc_cv = lockrank.ranked_condition("wal.gc")
         self._seq = 0          # frames appended (file order == seq order)
         self._durable_seq = 0  # frames covered by a flush(+fsync) pass
         self._leader_busy = False
@@ -271,16 +272,29 @@ class WalWriter:
             # checkpoint swap the writer while commits may be parked
             # in wait_durable on the old one). A mid-sync LEADER must
             # finish before the fd goes away — fsync on a closed fd
-            # would surface EBADF as a spurious commit failure.
+            # would surface EBADF as a spurious commit failure. The
+            # final flush/fsync runs OUTSIDE the condition, leader
+            # style: close must not hold the group-commit lock across
+            # disk I/O (blocking-under-lock), or parked followers
+            # convoy behind the closing thread.
             with self._gc_cv:
                 while self._leader_busy:
                     self._gc_cv.wait(0.05)
+                self._leader_busy = True   # become the final leader
+                end = self._seq
+            ok = False
+            try:
                 self._f.flush()
                 if self.sync:
                     os.fsync(self._f.fileno())
-                self._durable_seq = self._seq
-                self._closed = True
-                self._gc_cv.notify_all()
+                ok = True
+            finally:
+                with self._gc_cv:
+                    if ok and end > self._durable_seq:
+                        self._durable_seq = end
+                    self._leader_busy = False
+                    self._closed = True
+                    self._gc_cv.notify_all()
             self._f.close()
         except OSError:
             pass

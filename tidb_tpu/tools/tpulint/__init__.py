@@ -25,11 +25,15 @@ pre-existing, justified findings from blocking the strict gate.
 Usage:  python scripts/tpulint.py [--strict] [--json] [paths...]
 API:    from tidb_tpu.tools.tpulint import lint_paths, lint_source
 """
-from .core import Finding, Rule, all_rules, get_rule, register_rule
-from .engine import LintConfig, lint_file, lint_paths, lint_source
+from .core import (Finding, ProgramRule, Rule, all_rules, get_rule,
+                   register_rule)
+from .engine import (LintConfig, lint_file, lint_paths, lint_source,
+                     lint_sources)
 from .baseline import Baseline
+from .cache import LintCache
 
 __all__ = [
-    "Finding", "Rule", "all_rules", "get_rule", "register_rule",
-    "LintConfig", "lint_file", "lint_paths", "lint_source", "Baseline",
+    "Finding", "Rule", "ProgramRule", "all_rules", "get_rule",
+    "register_rule", "LintConfig", "lint_file", "lint_paths",
+    "lint_source", "lint_sources", "Baseline", "LintCache",
 ]

@@ -82,7 +82,9 @@ class Baseline:
                 continue
             seen.add(k)
             entries.append(dict(e))
-        for f in sorted(findings, key=lambda f: (f.path, f.rule, f.detail)):
+        for f in sorted(findings,
+                        key=lambda f: (f.rule, f.path, f.context,
+                                       f.detail)):
             if f.key() in seen:
                 continue
             seen.add(f.key())
@@ -91,7 +93,11 @@ class Baseline:
                 "detail": f.detail,
                 "reason": f.reason or "TODO: justify or fix",
             })
-        entries.sort(key=lambda e: (e.get("file", ""), e.get("rule", ""),
+        # (rule, file, context, detail) — the finding identity tuple —
+        # so a rewritten baseline diffs stably against the previous one
+        entries.sort(key=lambda e: (e.get("rule", ""),
+                                    e.get("file", ""),
+                                    e.get("context", ""),
                                     e.get("detail", "")))
         with open(path, "w", encoding="utf-8") as fh:
             json.dump({"version": VERSION, "entries": entries}, fh,

@@ -17,6 +17,7 @@ import os
 import sys
 
 from .baseline import Baseline
+from .cache import LintCache
 from .core import all_rules
 from .engine import LintConfig, lint_paths
 from .reporters import report_json, report_text
@@ -70,6 +71,19 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--no-compile", action="store_true",
                     help="skip the compileall sweep")
+    ap.add_argument("--jobs", "-j", type=int,
+                    default=min(8, os.cpu_count() or 1),
+                    help="parallel per-file walks (default: min(8, "
+                         "cpus); program rules always run once, "
+                         "single-threaded, over the merged "
+                         "inventories)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the incremental result cache "
+                         "(~/.cache/tidb_tpu/tpulint)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="override the incremental cache directory")
+    ap.add_argument("--clear-cache", action="store_true",
+                    help="drop every cached per-file result and exit")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print baselined findings")
     args = ap.parse_args(argv)
@@ -79,13 +93,22 @@ def main(argv=None) -> int:
             print(f"{name:22s} {rule.severity:8s} {rule.doc}")
         return 0
 
+    cache = LintCache(directory=args.cache_dir,
+                      enabled=not args.no_cache)
+    if args.clear_cache:
+        n = cache.clear()
+        print(f"tpulint: cleared {n} cached result(s) from "
+              f"{cache.dir}")
+        return 0
+
     paths = args.paths or [_PKG_DIR]
     baseline = Baseline() if args.no_baseline else \
         Baseline.load(args.baseline)
     enabled = set(args.rules.split(",")) if args.rules else None
     config = LintConfig.for_package(_PKG_DIR, root=_REPO,
                                     baseline=baseline, enabled=enabled)
-    findings = lint_paths(paths, config)
+    findings = lint_paths(paths, config, jobs=max(1, args.jobs),
+                          cache=cache if cache.enabled else None)
     # stale = unmatched baseline rows UNDER the requested paths; a spot
     # run over a subset must not flag rows it never re-verified, but a
     # row whose file was deleted still goes stale on a full run

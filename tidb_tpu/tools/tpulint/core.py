@@ -50,6 +50,7 @@ class Rule:
     name = ""
     severity = "warning"
     doc = ""
+    scope = "file"                 # "file" | "program"
 
     def run(self, ctx):
         raise NotImplementedError
@@ -61,6 +62,28 @@ class Rule:
             col=getattr(node, "col_offset", 0),
             severity=severity or self.severity, message=message,
             context=ctx.qualname(node), detail=detail)
+
+
+class ProgramRule(Rule):
+    """Whole-program rule: sees every file's inventory at once through
+    a callgraph.Program. Program rules apply their OWN waivers (via
+    program.waived) — there is no FileContext at report time. run(ctx)
+    is a no-op so the per-file engine loop can skip them uniformly."""
+
+    scope = "program"
+
+    def run(self, ctx):
+        return ()
+
+    def run_program(self, program):
+        raise NotImplementedError
+
+    def finding_at(self, path, line, context, message, detail,
+                   severity=None):
+        return Finding(
+            rule=self.name, path=path, line=line, col=0,
+            severity=severity or self.severity, message=message,
+            context=context, detail=detail)
 
 
 _RULES: dict = {}

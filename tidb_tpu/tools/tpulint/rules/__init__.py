@@ -7,3 +7,4 @@ from . import codes        # noqa: F401
 from . import hostsync     # noqa: F401
 from . import imports      # noqa: F401
 from . import failpoints   # noqa: F401
+from . import locks        # noqa: F401

@@ -34,6 +34,10 @@ class UnusedImport(Rule):
             if isinstance(node, ast.Name) and \
                     isinstance(node.ctx, ast.Load):
                 used.add(node.id)
+        # string annotations (`x: "Changefeed"`, Optional["Session"])
+        # reference names without an ast.Name Load — an import (often
+        # under `if TYPE_CHECKING:`) consumed ONLY there is still used
+        used |= self._string_annotation_names(ctx.tree)
         all_node = ctx.module_assigns.get("__all__")
         if isinstance(all_node, (ast.List, ast.Tuple)):
             for e in all_node.elts:
@@ -59,6 +63,39 @@ class UnusedImport(Rule):
                 f"compileall + F401 sweep; delete it or mark the "
                 f"side-effect import with # noqa)",
                 detail=f"import:{alias}")
+
+    @staticmethod
+    def _string_annotation_names(tree) -> set:
+        """Identifiers referenced from string annotations: every str
+        Constant inside an annotation expression is parsed as an
+        expression and its Name/Attribute roots collected. Unparsable
+        strings (a Literal["a", "b"] member) contribute nothing."""
+        names: set = set()
+        anns = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AnnAssign) and node.annotation:
+                anns.append(node.annotation)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                if node.returns:
+                    anns.append(node.returns)
+                a = node.args
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                            + [a.vararg, a.kwarg]):
+                    if arg is not None and arg.annotation:
+                        anns.append(arg.annotation)
+        for ann in anns:
+            for sub in ast.walk(ann):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, str):
+                    try:
+                        expr = ast.parse(sub.value, mode="eval")
+                    except SyntaxError:
+                        continue
+                    for n in ast.walk(expr):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+        return names
 
     @staticmethod
     def _in_try(ctx, node):

@@ -34,7 +34,12 @@ MUTABLE_CTORS = ("dict", "list", "set", "collections.defaultdict",
                  "weakref.WeakSet", "weakref.WeakValueDictionary",
                  "WeakSet", "WeakValueDictionary")
 LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
-              "threading.Semaphore", "threading.BoundedSemaphore")
+              "threading.Semaphore", "threading.BoundedSemaphore",
+              # the runtime lock-rank sanitizer's constructors return
+              # (wrapped) locks — utils/lockrank.py
+              "lockrank.ranked_lock", "lockrank.ranked_rlock",
+              "lockrank.ranked_condition", "ranked_lock",
+              "ranked_rlock", "ranked_condition")
 TLOCAL_CTORS = ("threading.local",)
 MUTATING_METHODS = {"append", "add", "update", "pop", "setdefault",
                     "clear", "extend", "remove", "discard", "popitem",
@@ -58,6 +63,27 @@ def classify_module_state(ctx):
     return mutable, locks
 
 
+def _lock_aliases(func, locks) -> set:
+    """Local names bound to a lock inside `func`: `mu = _MU` or
+    `mu = mod._MU` (a module attribute aliased into a local is a lock
+    handle, not a fresh object — the `with mu:` that follows guards
+    exactly like `with mod._MU:` would)."""
+    aliases: set = set()
+    for sub in ast.walk(func):
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        t = sub.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        v = sub.value
+        if isinstance(v, ast.Name) and v.id in locks:
+            aliases.add(t.id)
+        elif isinstance(v, ast.Attribute) and not isinstance(
+                v.value, ast.Call):
+            aliases.add(t.id)
+    return aliases
+
+
 def _under_lock(ctx, node, locks) -> bool:
     for anc in ctx.ancestors(node):
         if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -66,9 +92,21 @@ def _under_lock(ctx, node, locks) -> bool:
             # is invisible here; cross-function locking needs a waiver
         if isinstance(anc, ast.With):
             for item in anc.items:
-                for sub in ast.walk(item.context_expr):
-                    if isinstance(sub, ast.Name) and sub.id in locks:
-                        return True
+                expr = item.context_expr
+                # `with self._store._mu:` — a bare attribute chain in a
+                # with is a lock handle held elsewhere (an object's own
+                # mutex guarding the module map it manages); only a
+                # CALL result (`with open(...)`) stays a non-lock
+                if isinstance(expr, ast.Attribute):
+                    return True
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name):
+                        if sub.id in locks:
+                            return True
+                        fn = ctx.enclosing_function(node)
+                        if fn is not None and \
+                                sub.id in _lock_aliases(fn, locks):
+                            return True
     return False
 
 

@@ -38,6 +38,7 @@ from . import metrics as _metrics
 from . import phase as _phase
 from .logutil import log
 from ..errors import TiDBError, DeviceUnavailableError
+from . import lockrank
 
 
 # ---- error taxonomy ---------------------------------------------------
@@ -157,7 +158,7 @@ class CircuitBreaker:
         self.consecutive = 0
         self.open_until = 0.0
         self.trips = 0
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("device_guard.breaker")
 
     def allow(self) -> bool:
         with self._mu:
@@ -182,9 +183,9 @@ class CircuitBreaker:
 
 
 _BREAKERS: dict = {}
-_BREAKERS_MU = threading.Lock()
+_BREAKERS_MU = lockrank.ranked_lock("device_guard.breakers")
 METRICS: dict = {}          # module-level mirror for siteless dispatches
-_METRICS_MU = threading.Lock()
+_METRICS_MU = lockrank.ranked_lock("device_guard.metrics")
 
 
 def _breaker_for(family: str, threshold: int,
@@ -224,7 +225,7 @@ def reset():
 # tidb_tpu_mem_pressure_total{action}.
 
 _PRESSURE_STORES: list = []
-_PRESSURE_MU = threading.Lock()
+_PRESSURE_MU = lockrank.ranked_lock("device_guard.pressure")
 
 
 def register_pressure_store(store):

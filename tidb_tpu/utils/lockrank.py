@@ -1,0 +1,185 @@
+"""Ranked locks: the runtime half of the lock-order story.
+
+tpulint's `lock-order` rule proves *statically* that the package's
+lock-acquisition digraph is acyclic; this module enforces the same
+invariant *dynamically*.  Every hot lock is created through
+``ranked_lock(name, rank)`` where ``rank`` comes from the single
+registry in `lockrank_ranks.RANKS` (the rule cross-checks call-site
+literals against the registry so the static graph and the runtime
+ranks can't drift).  Under ``TIDB_TPU_LOCKRANK=1`` (conftest and every
+smoke gate set it) each acquisition asserts rank monotonicity against
+a thread-local held-stack: acquiring rank r while holding rank >= r
+raises `LockRankError` with both names and the full held stack — the
+would-be deadlock edge, caught at its first dynamic occurrence rather
+than in a soak.
+
+Zero overhead when disabled: ``ranked_lock`` returns a *bare*
+``threading.Lock`` (no wrapper, no indirection), so production builds
+pay nothing for the sanitizer.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from . import lockrank_ranks
+
+__all__ = [
+    "LockRankError", "ranked_lock", "ranked_rlock", "ranked_condition",
+    "enabled", "held",
+]
+
+
+class LockRankError(RuntimeError):
+    """A lock was acquired out of rank order (potential deadlock edge),
+    or a ranked lock was created with a name/rank that contradicts the
+    registry in utils/lockrank_ranks.py."""
+
+
+def enabled() -> bool:
+    return os.environ.get("TIDB_TPU_LOCKRANK", "") == "1"
+
+
+_tls = threading.local()
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def held():
+    """[(rank, name)] currently held by this thread (sanitizer view)."""
+    return [(r, n) for r, n, _ in _stack()]
+
+
+def _resolve_rank(name: str, rank):
+    reg = lockrank_ranks.RANKS.get(name)
+    if reg is None:
+        if rank is None:
+            raise LockRankError(
+                f"ranked lock '{name}' has no rank: not in "
+                f"lockrank_ranks.RANKS and no explicit rank given")
+        return rank
+    if rank is not None and rank != reg:
+        raise LockRankError(
+            f"ranked lock '{name}': call-site rank {rank} contradicts "
+            f"registry rank {reg} (utils/lockrank_ranks.py is the "
+            f"single source of truth)")
+    return reg
+
+
+class _RankedMixin:
+    """Shared acquire/release bookkeeping over self._lock."""
+
+    def __init__(self, name: str, rank: int, lock):
+        self.name = name
+        self.rank = rank
+        self._lock = lock
+
+    # -- sanitizer core -------------------------------------------------
+
+    def _check_and_push(self):
+        st = _stack()
+        if st:
+            if any(i == id(self) for _, _, i in st):
+                # re-entry of an already-held lock (RLock anywhere in
+                # the stack): acquiring a lock this thread holds can
+                # never be a NEW deadlock edge
+                st.append((self.rank, self.name, id(self)))
+                return
+            top_rank, top_name, _top_id = st[-1]
+            if self.rank <= top_rank:
+                raise LockRankError(
+                    f"lock-rank inversion: acquiring '{self.name}' "
+                    f"(rank {self.rank}) while holding '{top_name}' "
+                    f"(rank {top_rank}); held stack: "
+                    f"{[(r, n) for r, n, _ in st]} — acquisition order "
+                    f"must be strictly rank-increasing "
+                    f"(utils/lockrank_ranks.py)")
+        st.append((self.rank, self.name, id(self)))
+
+    def _pop(self):
+        st = _stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][2] == id(self):
+                del st[i]
+                return
+        # release of a lock the sanitizer never saw acquired (e.g. a
+        # Condition handing the raw lock around): tolerate silently —
+        # the rank check happens on acquire, which is the edge we prove
+
+    # -- lock protocol --------------------------------------------------
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._check_and_push()
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            self._pop()
+        return ok
+
+    def release(self):
+        self._lock.release()
+        self._pop()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def _is_owned(self):
+        # threading.Condition probes ownership before wait/notify; its
+        # DEFAULT probe is a non-blocking acquire, which would run the
+        # rank check on an acquisition that isn't one. Answer from the
+        # sanitizer's own held-stack instead.
+        return any(i == id(self) for _, _, i in _stack())
+
+    def __repr__(self):
+        return (f"<ranked {type(self._lock).__name__} "
+                f"'{self.name}' rank={self.rank}>")
+
+
+class _RankedLock(_RankedMixin):
+    pass
+
+
+class _RankedRLock(_RankedMixin):
+    def locked(self):  # RLock has no .locked() before 3.12
+        got = self._lock.acquire(blocking=False)
+        if got:
+            self._lock.release()
+        return not got
+
+
+def ranked_lock(name: str, rank: int = None):
+    """A named, ranked mutex. Disabled (the default): a bare
+    ``threading.Lock`` — zero overhead. Enabled (TIDB_TPU_LOCKRANK=1):
+    a wrapper asserting rank monotonicity per thread."""
+    if not enabled():
+        return threading.Lock()
+    return _RankedLock(name, _resolve_rank(name, rank),
+                       threading.Lock())
+
+
+def ranked_rlock(name: str, rank: int = None):
+    if not enabled():
+        return threading.RLock()
+    return _RankedRLock(name, _resolve_rank(name, rank),
+                        threading.RLock())
+
+
+def ranked_condition(name: str, rank: int = None):
+    """A Condition over a ranked lock. cv.wait() releases through the
+    wrapper, so the held-stack stays truthful across waits."""
+    if not enabled():
+        return threading.Condition(threading.Lock())
+    return threading.Condition(
+        _RankedLock(name, _resolve_rank(name, rank), threading.Lock()))

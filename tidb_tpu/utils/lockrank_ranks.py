@@ -1,0 +1,68 @@
+"""The lock-rank registry: the single source of truth for lock order.
+
+Every ranked lock in the package appears here as ``name -> rank``.
+The invariant (enforced statically by tpulint's `lock-order` rule and
+dynamically by utils/lockrank under TIDB_TPU_LOCKRANK=1):
+
+    a thread only ever acquires locks in strictly INCREASING rank.
+
+Ranks are sparse (gaps of 10) so a new lock slots between two existing
+ones without a mass renumber.  The bands mirror the call direction of
+the engine: coordination / control-plane locks rank LOW (acquired
+first, at the top of a call chain), storage and leaf utility locks
+rank HIGH (acquired last, innermost).  tpulint parses this file as a
+LITERAL (never imports it), so keep RANKS / HOT plain dicts and sets.
+
+HOT marks convoy-sensitive mutexes (the PR 8 lock-holder convoy class):
+tpulint's `blocking-under-lock` rule flags any *other* lock that takes
+a HOT lock while held, and any blocking op (fsync, RPC, dispatch,
+sleep, untimed wait) reachable inside a HOT region.
+"""
+
+# name -> rank; strictly-increasing acquisition order.
+RANKS = {
+    # -- control plane / orchestration (acquired first) ---------------
+    "domain.table_locks":     110,   # LOCK TABLES registry
+    "ddl.runner":             120,   # owner/ddl_runner.py job ladder
+    "cluster.coordinator.topo": 140,  # cluster/coordinator.py topology
+    "cluster.coordinator.call": 150,  # per-worker supervised-call slot
+    "cluster.coordinator.alive": 155,  # dxf_run live-executor set
+    "cluster.supervision":    160,   # heartbeat/failover monitor state
+    "cluster.worker.follower": 170,  # follower apply/rejoin state
+    "cluster.worker.inflight": 180,
+    "cluster.worker.dedup":   190,   # exactly-once request-id window
+
+    # -- CDC / changefeeds --------------------------------------------
+    "cdc.changefeed.registry": 200,  # changefeed manager map
+    "cdc.changefeed":         210,   # one changefeed's progress state
+    "cdc.changefeed.persist":  220,  # checkpoint persist serializer
+    "cdc.capture":            230,   # capture-seam subscriber fanout
+
+    # -- session / planner services -----------------------------------
+    "domain.epoch":           240,   # schema_epoch fence
+    "domain.memctl":          250,   # global memory controller victim
+    "domain.alloc":           260,   # per-table autoid allocator
+
+    # -- storage (inner: under txn/session work) ----------------------
+    "mvcc.store":             300,   # the row-store mutex (HOT)
+    "wal.gc":                 320,   # WAL segment-GC condition
+    "residency.device":       330,   # copr/residency.py device cache
+
+    # -- leaf utilities (acquired last, never call out) ---------------
+    "device_guard.breakers":  400,
+    "device_guard.metrics":   410,
+    "device_guard.pressure":  420,
+    "device_guard.breaker":   430,   # one breaker's own state
+    "memory.tracker":         440,   # memory-tracker tree node
+    "metrics.domains":        450,   # metrics Domain registry
+    "metrics.stmts":          455,   # statements_summary table
+    "metrics.registry":       460,
+    "metrics.instrument":     465,   # one instrument's child map
+    "metrics.child":          470,   # one counter/gauge/histogram cell
+}
+
+# Convoy-sensitive mutexes: nothing slow may run while these are held,
+# and no held lock may wait on them (blocking-under-lock enforces both).
+HOT = {
+    "mvcc.store",
+}

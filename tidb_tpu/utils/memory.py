@@ -53,6 +53,7 @@ import threading
 from . import metrics as _metrics
 from .logutil import log
 from ..errors import MemoryQuotaExceededError
+from . import lockrank
 
 
 class SpillTrigger:
@@ -100,7 +101,7 @@ class Tracker:
         # ONE lock per tree: concurrent consume/release on shared
         # ancestors must serialize or updates are lost
         self._lock = parent._lock if parent is not None \
-            else threading.RLock()
+            else lockrank.ranked_rlock("memory.tracker")
 
     def child(self, label: str, quota: int = -1) -> "Tracker":
         return Tracker(label, quota, self)

@@ -32,9 +32,9 @@ from __future__ import annotations
 import bisect
 import math
 import re
-import threading
 import time
 import weakref
+from . import lockrank
 
 
 # ---- naming ----------------------------------------------------------
@@ -109,7 +109,7 @@ class _Child:
 
     def __init__(self, reg):
         self._reg = reg
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("metrics.child")
 
 
 class _CounterChild(_Child):
@@ -193,7 +193,7 @@ class Instrument:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._children: dict = {}
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("metrics.instrument")
         self._compat = False      # compat mirrors hide from metrics_summary
 
     def _new_child(self):
@@ -298,7 +298,7 @@ class Registry:
 
     def __init__(self):
         self._instruments: dict = {}
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("metrics.registry")
         self.enabled = True
 
     def _get_or_create(self, cls, name, help_text, labelnames, **kw):
@@ -554,7 +554,7 @@ class TopSQL:
     def __init__(self, capacity: int = 200):
         self.capacity = capacity
         self._by_digest: dict = {}
-        self._mu = threading.Lock()
+        self._mu = lockrank.ranked_lock("metrics.stmts")
 
     def record(self, digest, normalized, dur_ms, phases, ok=True,
                drift=None):
@@ -629,7 +629,7 @@ _COMPAT_COUNTERS: dict = {}
 # WeakSet/compat-map mutation lock: domains register from whatever
 # thread constructs them, compat counters materialize lazily on the
 # first inc_metric of a name — both race with a concurrent scrape
-_DOMAINS_MU = threading.Lock()
+_DOMAINS_MU = lockrank.ranked_lock("metrics.domains")
 
 
 def track_domain(domain):

@@ -98,6 +98,11 @@ class IVFIndex:
         like copr/delta.py)."""
         with self._mu:
             if not self.built:
+                # first build dispatches (kmeans) under _mu by design:
+                # every concurrent search needs the trained index
+                # anyway, so serializing them here IS the lazy-build
+                # contract rather than a convoy
+                # tpulint: disable=blocking-under-lock — lazy build
                 self._train_locked(copr, ctab, ectx)
                 return
             if ctab.gc_epoch != self.epoch:
