@@ -371,3 +371,38 @@ def test_recording_overhead_under_5_percent():
     # cost is a few µs/statement, far under both bounds)
     assert best_on <= best_off * 1.05 + 0.05, \
         f"registry overhead {best_on:.3f}s vs {best_off:.3f}s disabled"
+
+
+def test_replica_instruments_exposed_and_parse():
+    """Fast mode of the replica_smoke observability leg: after one
+    replica-routed statement the route counter has a labeled sample,
+    reading tidb_replica_freshness refreshes the per-replica state/lag
+    gauges, and the exposition stays strict-parser clean."""
+    tk = TestKit()
+    tk.must_exec("create table rt (a int primary key, b int)")
+    tk.must_exec("insert into rt values " +
+                 ",".join(f"({i},{i % 5})" for i in range(64)))
+    dom = tk.sess.domain
+    reps = dom.replicas.provision(1)
+    deadline = time.time() + 15
+    while time.time() < deadline and reps[0].state != "serving":
+        time.sleep(0.02)
+    assert reps[0].state == "serving"
+    tk.must_exec("set @@tidb_tpu_analytic_read_mode = 'resolved'")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        tk.must_query("select b, count(*) from rt group by b")
+        if metrics.REPLICA_ROUTE.labels("replica").value > 0:
+            break
+    assert metrics.REPLICA_ROUTE.labels("replica").value > 0
+    tk.must_query("select replica, state from information_schema"
+                  ".tidb_replica_freshness where replica = '0'")
+    snap = metrics.REGISTRY.snapshot()
+    assert snap.get('tidb_tpu_replica_state{replica="0"}') == 1.0
+    assert 'tidb_tpu_replica_lag_seconds{replica="0"}' in snap
+    ctype, body = _scrape(dom)
+    assert ctype.startswith("text/plain")
+    _, errs = metrics.parse_text(body)
+    assert not errs, errs[:3]
+    assert "tidb_tpu_replica_route_total" in body
+    dom.close()

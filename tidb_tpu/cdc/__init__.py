@@ -3,7 +3,9 @@ commit stream (reference TiCDC collapsed to the in-process engine).
 
 Pieces: capture (commit hook + WAL/version catch-up + resolved-ts
 watermark), sorter + lifecycle (changefeed), sinks (blackhole / ndjson
-file / mirror table sink). Protocol and contracts: docs/CDC.md.
+file / mirror table sink / logbackup WAL2 frames / replica-domain
+sinks for the read-replica fabric). Protocol and contracts:
+docs/CDC.md.
 """
 from .capture import Capture
 from .changefeed import Changefeed, ChangefeedManager

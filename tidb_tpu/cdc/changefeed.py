@@ -199,6 +199,36 @@ class Changefeed:
                 self.name).observe(lag)
         return emitted
 
+    def pending_rows(self) -> int:
+        """Mutations buffered but not yet emitted (sorter backlog)."""
+        with self._mu:
+            return sum(len(muts) for _, muts in self._buffer)
+
+    def drain(self, rounds: int = 50):
+        """Graceful shutdown: stop the worker, then poll inline until a
+        pass emits nothing — every batch the capture seam published
+        at/below the resolved floor is applied and flushed before the
+        subscription is released, so no acked-but-unapplied batch can
+        exist. Bounded (under write load new commits keep landing; the
+        round cap keeps close() terminating)."""
+        self._stop.set()
+        w = self._worker
+        if w is not None and w.is_alive() and \
+                w is not threading.current_thread():
+            w.join(5.0)
+        self._worker = None
+        if self._sub is None:
+            return
+        for _ in range(max(1, rounds)):
+            try:
+                if self.poll_once() == 0:
+                    break
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except BaseException:       # noqa: BLE001 — draining is
+                break                   # best-effort; detach regardless
+        self._detach()
+
     def resolved_lag_seconds(self) -> float | None:
         wall = self.domain.storage.oracle.wall_for_ts(self.resolved)
         if wall is None:

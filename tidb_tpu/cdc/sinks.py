@@ -209,8 +209,11 @@ class TableSink:
 
     def sync_schemas(self):
         """DDL barrier: make every capturable source table exist in the
-        mirror (drops are left in place — the mirror is a replica, not
-        a GC target)."""
+        mirror with the source's column set (drops are left in place —
+        the mirror is a replica, not a GC target). Column-level diff:
+        added/dropped columns replay as ALTERs in source order, so the
+        mirror's sequential column-id allocation tracks the source's
+        and the direct-KV row encodings keep decoding identically."""
         from .capture import SYSTEM_DBS
         isch = self.source.infoschema()
         for dbi in isch.all_schemas():
@@ -221,6 +224,24 @@ class TableSink:
                     continue
                 with self._mu:
                     self._mirror_tid(dbi.name, t.name, t)
+                    self._sync_columns(dbi.name, t)
+
+    def _sync_columns(self, db: str, info):
+        """Replay column add/drop onto an existing mirror table (held
+        under self._mu by sync_schemas)."""
+        mt = self.mirror.infoschema().table_by_name(db, info.name)
+        want = {c.name.lower(): c for c in info.public_columns()}
+        have = {c.name.lower() for c in mt.public_columns()}
+        for c in info.public_columns():
+            if c.name.lower() not in have:
+                spec = f"`{c.name}` {c.ft.sql_string()}"
+                if c.ft.not_null:
+                    spec += " NOT NULL"
+                self._sess.execute(
+                    f"alter table `{db}`.`{info.name}` add column {spec}")
+        for name in sorted(have - set(want)):
+            self._sess.execute(
+                f"alter table `{db}`.`{info.name}` drop column `{name}`")
 
     # ---- sink contract ------------------------------------------------
     def emit_txn(self, events):
@@ -334,9 +355,18 @@ class LogBackupSink:
 
 def make_sink(uri: str, source_domain):
     """Sink factory for ADMIN CHANGEFEED CREATE ... SINK '<uri>':
-    blackhole:// | file://<path> | mirror:// | logbackup://<path>"""
+    blackhole:// | file://<path> | mirror:// | logbackup://<path> |
+    replica://<rid> (internal: the replica fabric's persistent sink —
+    reused across feed restarts so its applied_ts survives)"""
     from ..errors import TiDBError
     u = uri.strip()
+    if u.startswith("replica://"):
+        rid = u[len("replica://"):]
+        try:
+            return source_domain.replicas.sink_for(int(rid))
+        except (TypeError, ValueError):
+            raise TiDBError("replica sink needs a numeric id: "
+                            "replica://0") from None
     if u in ("blackhole", "blackhole://"):
         return BlackholeSink()
     if u.startswith("file://"):

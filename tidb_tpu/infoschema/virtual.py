@@ -77,7 +77,10 @@ def _gen_slow_query(domain):
                int(e.get("mem_max", 0)),
                # wait attribution: phase snap() keys are already ms
                ph.get("commit_wait_s", 0.0),
-               ph.get("admission_wait_s", 0.0))
+               ph.get("admission_wait_s", 0.0),
+               # replica-routing outcome ("replica-<rid>",
+               # "leader_fallback", "degraded_midstmt", ""=leader)
+               e.get("replica", ""))
 
 
 def _gen_stmt_summary(domain):
@@ -205,7 +208,9 @@ def _gen_top_sql(domain):
                e.get("delta_applies", 0), e.get("delta_bytes", 0),
                round(e.get("max_drift", 0.0), 4),
                round(e.get("sum_drift", 0.0) /
-                     max(e.get("drift_execs", 0), 1), 4))
+                     max(e.get("drift_execs", 0), 1), 4),
+               e.get("replica_reads", 0), e.get("leader_fallbacks", 0),
+               e.get("degraded_midstmt", 0))
 
 
 def _gen_deadlocks(domain):
@@ -281,7 +286,11 @@ def _gen_replica_freshness(domain):
     analytic statement would snapshot at RIGHT NOW, its wallclock lag,
     and the rows committed since the delta maintainer last reconciled
     the table's device-resident buffers. One row per user table with a
-    columnar image. Reading the table also refreshes the lag gauge."""
+    columnar image, replica="leader". PLUS one row per replica domain
+    of the read-replica fabric (replica="<rid>", table columns empty):
+    its health state, applied watermark + lag, sorter backlog, and how
+    many statements it has served. Reading the table also refreshes
+    the leader lag gauge and the per-replica state/lag gauges."""
     delta = getattr(domain.copr, "delta", None)
     if delta is None or delta._domain is None:
         return
@@ -304,7 +313,15 @@ def _gen_replica_freshness(domain):
                 continue
             pend = stats.get(t.id, (0, 0, 0))[0]
             yield (db.name, t.name, resolved, round(lag_ms, 3), pend,
-                   str(mode))
+                   str(mode), "leader", "serving", 0)
+    rm = getattr(domain, "replicas", None)
+    if rm is None or not rm.replicas:
+        return
+    rm.refresh_gauges()
+    for (rid, state, applied, rlag_ms, pending,
+         routed) in rm.snapshot():
+        yield ("", "", applied, rlag_ms, pending, str(mode),
+               str(rid), state, routed)
 
 
 def _gen_ddl_jobs(domain):
@@ -503,7 +520,8 @@ VIRTUAL_DEFS = {
                          ("succ", _I()), ("digest", _S()),
                          ("is_internal", _I()), ("mem_max", _I()),
                          ("commit_wait_ms", _F()),
-                         ("admission_wait_ms", _F())),
+                         ("admission_wait_ms", _F()),
+                         ("replica", _S())),
                    _gen_slow_query),
     "statements_summary": (_cols(("digest", _S()), ("digest_text", _S()),
                                  ("exec_count", _I()),
@@ -555,7 +573,10 @@ VIRTUAL_DEFS = {
                            ("delta_applies", _I()),
                            ("delta_bytes", _I()),
                            ("max_drift", _F()),
-                           ("mean_drift", _F())), _gen_top_sql),
+                           ("mean_drift", _F()),
+                           ("replica_reads", _I()),
+                           ("leader_fallbacks", _I()),
+                           ("degraded_midstmt", _I())), _gen_top_sql),
     "deadlocks": (_cols(("deadlock_id", _I()), ("occur_time", _F()),
                         ("retryable", _I()), ("try_lock_trx_id", _I()),
                         ("key", _S()), ("trx_holding_lock", _I())),
@@ -576,7 +597,10 @@ VIRTUAL_DEFS = {
                                      ("resolved_ts", _I()),
                                      ("lag_ms", _F()),
                                      ("pending_delta_rows", _I()),
-                                     ("mode", _S())),
+                                     ("mode", _S()),
+                                     ("replica", _S()),
+                                     ("state", _S()),
+                                     ("routed_queries", _I())),
                                _gen_replica_freshness),
     "tidb_vector_indexes": (_cols(("table_schema", _S()),
                                   ("table_name", _S()),

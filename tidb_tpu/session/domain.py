@@ -205,11 +205,20 @@ class Domain:
         from ..codec.tablecodec import META_PREFIX as _MPREF
         self._epoch_mu = lockrank.ranked_lock("domain.epoch")
 
-        def _meta_epoch_hook(_commit_ts, mutations):
+        # replica DDL barrier: the commit_ts of the latest meta-touching
+        # commit. A replica may serve only once its applied watermark
+        # covers it (watermark >= barrier implies the feed already
+        # emitted — and the sink schema-synced — that DDL, since events
+        # <= r emit before flush_resolved(r))
+        self.ddl_barrier_ts = 0
+
+        def _meta_epoch_hook(commit_ts, mutations):
             for k, _v in mutations:
                 if k[:1] == _MPREF:
                     with self._epoch_mu:
                         self.schema_epoch += 1
+                        if commit_ts > self.ddl_barrier_ts:
+                            self.ddl_barrier_ts = commit_ts
                     return
         self.storage.mvcc.commit_hooks.append(_meta_epoch_hook)
         self._syncload_attempted: set = set()
@@ -243,9 +252,25 @@ class Domain:
         # can observe a half-state index
         from ..owner.ddl_runner import DDLJobRunner
         self.ddl_jobs = DDLJobRunner(self)
+        # elastic read-replica fabric (tidb_tpu/replica): supervised
+        # CDC-fed mirror domains + the session router's pick() seam.
+        # Created BEFORE resume_persisted so a persisted __replica_*
+        # feed can rebuild its replica through make_sink("replica://N")
+        from ..replica import ReplicaManager
+        self.replicas = ReplicaManager(self)
         if data_dir:
             self.cdc.resume_persisted()
+            self.replicas.resume()
             self.ddl_jobs.resume_pending()
+
+    def close(self):
+        """Graceful shutdown: drain the replica fabric FIRST (its
+        monitor must stop reprovisioning and every feed must apply
+        what the capture seam already published), then stop the
+        remaining changefeed workers. Idempotent; no worker thread
+        survives it and no acked-but-unapplied batch is left behind."""
+        self.replicas.shutdown()
+        self.cdc.shutdown()
 
     def _open_wal(self, data_dir):
         """Restore the latest checkpoint (if any), replay the WAL tail,

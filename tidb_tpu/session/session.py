@@ -110,12 +110,17 @@ class Session:
         # statement's still-current guard; the explicit COMMIT statement
         # installs a fresh one in _dispatch, and every statement start
         # clears stale guards (_execute_stmt)
-        t.commit(
+        cts = t.commit(
             async_commit=bool(self.vars.get("tidb_enable_async_commit")),
             one_pc=bool(self.vars.get("tidb_enable_1pc")),
             keys_limit=int(self.vars.get("tidb_async_commit_keys_limit")),
             size_limit=int(self.vars.get(
                 "tidb_async_commit_total_key_size_limit")))
+        if cts:
+            # read-your-writes floor for the replica router: a replica
+            # only qualifies for this session once its watermark covers
+            # the session's own last commit
+            self._last_commit_ts = cts
         if t.commit_mode == "1pc":
             self.domain.inc_metric("txn_1pc")
         elif t.commit_mode == "async":
@@ -218,6 +223,10 @@ class Session:
             # per-statement memory high-water mark: nested internal SQL
             # folds its peaks into the outer statement's, like phases
             self._stmt_mem_max = 0
+            # replica-routing outcome for this statement ("", "replica-
+            # <rid>", "leader_fallback", "degraded_midstmt") — consumed
+            # by _observe for the slow log + Top SQL fold
+            self._stmt_route = ""
         # MySQL diagnostics-area lifecycle: each statement RESETS the
         # area; SHOW WARNINGS/ERRORS and GET DIAGNOSTICS read the
         # PREVIOUS statement's area so they are exempt
@@ -381,6 +390,7 @@ class Session:
                 "is_internal": int(self.is_internal or
                                    _phase.depth() > 1),
                 "mem_max": int(getattr(self, "_stmt_mem_max", 0)),
+                "replica": getattr(self, "_stmt_route", ""),
                 "phases": _phase.snap()})
             from ..utils import logutil
             # the digest normalization IS the redaction (one parse,
@@ -437,7 +447,9 @@ class Session:
                         .observe(qerror(est, act))
                 drift = self.domain.plan_feedback.digest_drift(digest)
             self.domain.top_sql.record(digest, norm[:1024], dur_ms, ph,
-                                       ok=ok, drift=drift)
+                                       ok=ok, drift=drift,
+                                       route=getattr(self, "_stmt_route",
+                                                     ""))
         self.domain.plugins.fire("audit", self, {
             "sql": sql, "digest": digest, "ok": ok, "duration_ms": dur_ms,
             "user": self.user, "db": self.vars.current_db,
@@ -1309,10 +1321,26 @@ class Session:
             self.vars.get("tidb_enable_collect_execution_info"))
         ectx.stale_read_ts = getattr(plan, "stale_read_ts", 0)
         if not ectx.stale_read_ts:
-            # incremental HTAP read routing: analytic statements under
-            # tidb_tpu_analytic_read_mode='resolved' snapshot at the
-            # replica's resolved-ts floor (AS OF keeps its own ts)
-            self._maybe_resolved_read(stmt, plan, ectx)
+            pin = getattr(self, "pinned_read_ts", 0)
+            if pin:
+                # replica-domain session: every read is pinned at the
+                # replica's applied watermark (set by execute_pinned;
+                # checked BEFORE _maybe_resolved_read so an env-seeded
+                # resolved mode on the mirror cannot override the pin)
+                ectx.stale_read_ts = pin
+                ectx.analytic_resolved = True
+            else:
+                # incremental HTAP read routing: analytic statements
+                # under tidb_tpu_analytic_read_mode='resolved' snapshot
+                # at the resolved-ts floor (AS OF keeps its own ts) —
+                # and, when the replica fabric has a qualifying
+                # replica, execute on it instead of the leader
+                self._maybe_resolved_read(stmt, plan, ectx)
+                if getattr(ectx, "replica_eligible", False):
+                    rs = self._try_replica_read(stmt, plan, ectx,
+                                                params=params)
+                    if rs is not None:
+                        return rs
         if self._txn is not None and not self._txn.committed and \
                 not self._txn.aborted:
             # snapshot reads through the open txn that trip on a
@@ -1430,7 +1458,86 @@ class Session:
                 return
         ectx.stale_read_ts = floor
         ectx.analytic_resolved = True
+        # a clamped read is the explicit txn's own snapshot — replica
+        # routing would break read-your-writes/REPEATABLE READ, so only
+        # unclamped resolved reads are replica-eligible
+        ectx.replica_eligible = not clamped
         metrics_util.ANALYTIC_READS.labels("resolved").inc()
+
+    def _try_replica_read(self, stmt, plan, ectx, params=None):
+        """Route an olap resolved read to the freshest qualifying
+        replica domain (docs/ROBUSTNESS.md "Read replica fabric").
+        Returns the replica's ResultSet, or None to degrade to the
+        leader — this path NEVER raises for fabric reasons:
+
+          * no replica within tidb_tpu_replica_max_lag_ms (or none
+            past the DDL barrier / the session's last commit) ->
+            leader_fallback, run on the leader at the resolved floor
+          * the chosen replica dies mid-statement (classified through
+            device_guard, reported to supervision) -> degraded_midstmt,
+            one transparent leader retry via the normal leader path
+        """
+        from ..utils import metrics as metrics_util
+        from ..utils import phase as _phase
+        rm = getattr(self.domain, "replicas", None)
+        if rm is None or not rm.replicas:
+            return None
+        sql = self._cur_sql
+        if not sql or params is not None or _phase.depth() != 1 or \
+                getattr(stmt, "into_vars", None) or \
+                getattr(stmt, "into_outfile", ""):
+            return None         # leader handles the exotic shapes
+        from ..cdc.capture import SYSTEM_DBS
+        for rdb, _rtbl in getattr(plan, "read_tables", ()):
+            if (rdb or "").lower() in SYSTEM_DBS or \
+                    _rtbl in self.temp_tables:
+                # system schemas are not replicated and a temp table
+                # exists only in THIS session — leader serves both
+                return None
+        try:
+            max_lag = int(self.vars.get("tidb_tpu_replica_max_lag_ms"))
+            picked = rm.pick(max_lag,
+                             min_ts=getattr(self, "_last_commit_ts", 0))
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except BaseException:   # noqa: BLE001 — route-pick seam: degrade
+            picked = None
+        if picked is None:
+            metrics_util.REPLICA_ROUTE.labels("leader_fallback").inc()
+            self._stmt_route = "leader_fallback"
+            return None
+        rep, pin_ts = picked
+        # served-read SLA audit, measured at route time (the moment the
+        # pin is fixed): re-verify the bound pick saw, and keep the
+        # worst served staleness for the chaos gate's SLA assert
+        served_lag = 0.0
+        wall = self.domain.storage.oracle.wall_for_ts(pin_ts)
+        if wall is not None:
+            import time as _time
+            served_lag = max(0.0, (_time.time() - wall) * 1000.0)
+        if max_lag > 0 and served_lag > max_lag:
+            metrics_util.REPLICA_ROUTE.labels("leader_fallback").inc()
+            self._stmt_route = "leader_fallback"
+            return None
+        try:
+            rs = rep.execute_pinned(sql, self.vars.current_db)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except BaseException as exc:   # noqa: BLE001 — degrade, never err
+            rm.report_failure(rep, exc)
+            metrics_util.REPLICA_ROUTE.labels("degraded_midstmt").inc()
+            self._stmt_route = "degraded_midstmt"
+            return None
+        rep.routed_queries += 1
+        metrics_util.REPLICA_ROUTE.labels("replica").inc()
+        self._stmt_route = f"replica-{rep.rid}"
+        ectx.stale_read_ts = pin_ts
+        m = self.domain.metrics
+        if served_lag > m.get("replica_served_max_lag_ms", 0.0):
+            m["replica_served_max_lag_ms"] = served_lag
+        ectx.finish()
+        self._finish_stmt()
+        return rs
 
     def _exec_lock_tables(self, stmt):
         """LOCK TABLES (reference pkg/ddl table locks + the

@@ -258,6 +258,14 @@ for _v in [
     SysVar("tidb_tpu_analytic_max_staleness_ms", SCOPE_BOTH,
            _env_int("TIDB_TPU_ANALYTIC_MAX_STALENESS_MS", 5000),
            "int", 0, 1 << 31),
+    # read-replica routing SLA (tidb_tpu/replica): an olap resolved
+    # read is served by a replica domain only when the replica's
+    # applied watermark lags wallclock by at most this; otherwise the
+    # statement transparently degrades to the leader. 0 = any serving
+    # replica qualifies regardless of lag.
+    SysVar("tidb_tpu_replica_max_lag_ms", SCOPE_BOTH,
+           _env_int("TIDB_TPU_REPLICA_MAX_LAG_MS", 5000),
+           "int", 0, 1 << 31),
     # delta fold ceiling (copr/delta.py): a per-entry delta larger
     # than this many rows drops the buffer for a full re-upload
     # instead of patching (past a point the patch costs more than the

@@ -168,6 +168,29 @@ SITES: dict[str, str] = {
     "cdc-emit": (
         "cdc/changefeed.py: before sink emission — at-least-once "
         "redelivery after checkpoint resume"),
+    "replica/apply": (
+        "replica/manager.py: before a replica sink applies one "
+        "transaction — the feed redelivers after classified backoff; "
+        "applied_ts keeps the retry exactly-once"),
+    "replica/route-pick": (
+        "replica/manager.py: replica selection for an olap resolved "
+        "read — an error here degrades the statement to the leader "
+        "path (leader_fallback), never to the client"),
+    "replica/mid-stmt": (
+        "replica/manager.py: after routing, before the replica "
+        "executes — simulates the chosen replica dying mid-statement; "
+        "the router classifies via device_guard, reports to "
+        "supervision, and transparently retries on the leader"),
+    "replica/reprovision": (
+        "replica/manager.py: before a down replica's feed resumes "
+        "from its checkpoint — an error here retries on the next "
+        "monitor tick with backoff; the replica stays down (routed "
+        "around) until the resume succeeds and it catches up"),
+    "replica/ddl-barrier": (
+        "replica/manager.py: before the replica sink schema-syncs at "
+        "a DDL event — the feed redelivers; the router refuses to "
+        "serve below the barrier, so a replica that has not applied "
+        "the DDL is never picked"),
 }
 
 # the seams scripts/ddl_smoke.py kills at (ordered; each is a child
@@ -209,6 +232,18 @@ BR_SITES = (
     "br-restore-pre-swap",
     "br-restore-replay",
     "br-restore-checkpoint",
+)
+
+
+# the replica-fabric chaos seams scripts/replica_smoke.py drives
+# (error bursts at every seam × serving-replica kills in rotation,
+# under htap load with analytics replica-pinned; zero query errors)
+REPLICA_SITES = (
+    "replica/apply",
+    "replica/route-pick",
+    "replica/mid-stmt",
+    "replica/reprovision",
+    "replica/ddl-barrier",
 )
 
 

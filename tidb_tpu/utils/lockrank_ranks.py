@@ -31,6 +31,10 @@ RANKS = {
     "cluster.worker.follower": 170,  # follower apply/rejoin state
     "cluster.worker.inflight": 180,
     "cluster.worker.dedup":   190,   # exactly-once request-id window
+    "replica.manager":        195,   # replica-fabric registry/cursor
+                                     # (below the cdc band: feed
+                                     # lifecycle may be entered with it
+                                     # held, though slow ops stay out)
 
     # -- CDC / changefeeds --------------------------------------------
     "cdc.changefeed.registry": 200,  # changefeed manager map

@@ -557,11 +557,14 @@ class TopSQL:
         self._mu = lockrank.ranked_lock("metrics.stmts")
 
     def record(self, digest, normalized, dur_ms, phases, ok=True,
-               drift=None):
+               drift=None, route=None):
         """drift: optional (max_drift, mean_drift) q-error pair from the
         statement's plan-feedback fold — running max / running mean kept
         per digest so a planner regression is visible next to the time
-        it cost."""
+        it cost. route: the replica-routing outcome ("replica-<rid>",
+        "leader_fallback", "degraded_midstmt", ""/None = leader-only)
+        folded per digest, so a digest's fallback rate sits next to its
+        cost."""
         ph = phases or {}
         device_ms = phase_device_ms(ph)
         with self._mu:
@@ -578,7 +581,9 @@ class TopSQL:
                     "upload_bytes": 0, "fetch_bytes": 0,
                     "fallback_count": 0, "sum_errors": 0,
                     "delta_applies": 0, "delta_bytes": 0,
-                    "max_drift": 0.0, "sum_drift": 0.0, "drift_execs": 0}
+                    "max_drift": 0.0, "sum_drift": 0.0, "drift_execs": 0,
+                    "replica_reads": 0, "leader_fallbacks": 0,
+                    "degraded_midstmt": 0}
             e["exec_count"] += 1
             e["sum_ms"] += dur_ms
             e["sum_device_ms"] += device_ms
@@ -602,6 +607,15 @@ class TopSQL:
                     e["max_drift"] = mx
                 e["sum_drift"] += mean
                 e["drift_execs"] += 1
+            if route:
+                if route.startswith("replica"):
+                    e["replica_reads"] = e.get("replica_reads", 0) + 1
+                elif route == "leader_fallback":
+                    e["leader_fallbacks"] = \
+                        e.get("leader_fallbacks", 0) + 1
+                elif route == "degraded_midstmt":
+                    e["degraded_midstmt"] = \
+                        e.get("degraded_midstmt", 0) + 1
             if not ok:
                 e["sum_errors"] += 1
 
@@ -823,6 +837,21 @@ ANALYTIC_READS = REGISTRY.counter(
     "strict=FOR UPDATE kept strict; leader-mode statements and AS OF "
     "statements carry their own read view and are not counted)",
     ("outcome",))
+REPLICA_ROUTE = REGISTRY.counter(
+    "tidb_tpu_replica_route_total",
+    "Read-replica router decisions (replica=served by a replica "
+    "domain pinned at its applied watermark, leader_fallback=no "
+    "replica within the freshness SLA / DDL barrier / own-write "
+    "floor, degraded_midstmt=the chosen replica died mid-statement "
+    "and the leader transparently retried)", ("outcome",))
+REPLICA_STATE = REGISTRY.gauge(
+    "tidb_tpu_replica_state",
+    "Replica health state machine (0=provisioning 1=serving "
+    "2=lagging 3=down)", ("replica",))
+REPLICA_LAG = REGISTRY.gauge(
+    "tidb_tpu_replica_lag_seconds",
+    "Per-replica applied-watermark staleness (wallclock now minus "
+    "the allocation time of the applied resolved-ts)", ("replica",))
 DEV_RESIDENT_BYTES = REGISTRY.gauge(
     "tidb_tpu_device_resident_bytes",
     "Charged bytes live in the device-resident store by placement "
