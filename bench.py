@@ -346,17 +346,18 @@ def vector_main(live=True):
     for rows in sizes:
         tk = TestKit()
         tk.must_exec("create table corpus (id bigint primary key, "
-                     f"e vector({dim}))")
+                     f"grp bigint, e vector({dim}))")
         rng = np.random.RandomState(42)
         centers = rng.randn(256, dim).astype(np.float32) * 4.0
         mat = (centers[rng.randint(0, 256, rows)] +
                rng.randn(rows, dim).astype(np.float32) * 0.35)
         texts = np.array([fmt(mat[i]) for i in range(rows)],
                          dtype=object)
+        grp = (np.arange(rows, dtype=np.int64) * 7919) % 1000
         tbl = tk.domain.infoschema().table_by_name("test", "corpus")
         ctab = tk.domain.columnar.table(tbl)
         ctab.bulk_append({"id": np.arange(rows, dtype=np.int64),
-                          "e": texts}, rows,
+                          "grp": grp, "e": texts}, rows,
                          handles=np.arange(1, rows + 1,
                                            dtype=np.int64))
         stored = np.array([np.fromstring(t[1:-1], sep=",")
@@ -409,6 +410,51 @@ def vector_main(live=True):
             }
             print(f"# rows={rows} nprobe={nprobe}: "
                   f"{cells[f'rows={rows},nprobe={nprobe}']}",
+                  file=sys.stderr)
+        # hybrid cells (ISSUE 20, docs/ML.md): scalar predicate +
+        # ORDER BY distance LIMIT k through the full statement path —
+        # the predicate mask gates candidates BEFORE top-k, so recall
+        # is vs the MASKED float64 oracle at each selectivity
+        from tidb_tpu.utils import phase as _phase
+        tk.must_exec("set @@tidb_tpu_vector_nprobe = 8")
+        for lbl, pred, maskfn in (
+                ("0.1%", "grp = 7", lambda g: g == 7),
+                ("1%", "grp < 10", lambda g: g < 10),
+                ("10%", "grp < 100", lambda g: g < 100)):
+            mask = maskfn(grp)
+
+            def hsql(q):
+                return (f"select id from corpus where {pred} order "
+                        f"by vec_l2_distance(e, '{fmt(q)}') limit 10")
+
+            def horacle(q):
+                d = np.linalg.norm(stored.astype(np.float64) -
+                                   q.astype(np.float64), axis=1)
+                d = np.where(mask, d, np.inf)
+                return set(
+                    int(i) for i in np.argsort(d, kind="stable")[:10]
+                    if d[i] < np.inf)
+
+            tk.must_query(hsql(queries[0]))         # warm
+            hits = ideal = 0
+            _phase.reset()
+            t0 = time.perf_counter()
+            for i in range(nq):
+                got = {r[0] for r in
+                       tk.must_query(hsql(queries[i])).rows}
+                want = horacle(queries[i])
+                hits += len(got & want)
+                ideal += len(want)
+            dt = time.perf_counter() - t0
+            snap = _phase.snap()
+            cells[f"rows={rows},hybrid={lbl}"] = {
+                "qps": round(nq / dt, 1),
+                "recall_at_10": round(hits / max(ideal, 1), 4),
+                "dispatches_per_query": round(
+                    snap.get("dispatches", 0) / nq, 2),
+            }
+            print(f"# rows={rows} hybrid={lbl}: "
+                  f"{cells[f'rows={rows},hybrid={lbl}']}",
                   file=sys.stderr)
     headline = cells.get(f"rows={sizes[-1]},nprobe=8") or \
         list(cells.values())[-1]

@@ -82,6 +82,20 @@ CASES = [
      "alter table t modify b varchar(24)", "modified"),
 ]
 
+# CREATE MODEL kill cases (tidb_tpu/ml/ddl.py ladder; ISSUE 20):
+# (label, [(failpoint, action), ...], expected outcome)
+# outcome: "public" -> model m1 PUBLIC and serving predict()
+#          "absent" -> model gone, ZERO orphaned weight blobs
+ML_CASES = [
+    ("ml-weights", [("ml-weights-write", "crash")], "public"),
+    ("ml-registry", [("ml-registry-commit", "crash")], "public"),
+    ("ml-pre-public", [("ml-pre-public", "crash")], "public"),
+    # backfill-equivalent failure -> rollback begins -> die after the
+    # reverse txn committed; restart must finish to clean absence
+    ("ml-rollback", [("ml-pre-public", "error"),
+                     ("ddl-rollback-step", "crash")], "absent"),
+]
+
 _CHILD = r"""
 import os, sys, threading, time
 sys.path.insert(0, {repo!r})
@@ -131,9 +145,39 @@ print("SURVIVED", flush=True)
 """
 
 
-def run_child(dd, fps, ddl, timeout):
-    script = _CHILD.format(repo=_REPO, dd=dd, fps=fps, ddl=ddl,
-                           rows=ROWS, batch=BATCH)
+_ML_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["TIDB_TPU_PLATFORM"] = "cpu"
+import numpy as np
+np.savez({npz!r}, W0=np.ones((2, 4), dtype=np.float32),
+         b0=np.zeros(4, dtype=np.float32),
+         W1=np.ones((4, 1), dtype=np.float32),
+         b1=np.zeros(1, dtype=np.float32))
+from tidb_tpu.session import new_store, Session
+from tidb_tpu.utils import failpoint
+dom = new_store({dd!r}, wal_sync=True)
+s = Session(dom)
+s.vars.current_db = "test"
+s.execute("create table t (a int primary key, b double)")
+s.execute("insert into t values (1, 1.0), (2, 2.0)")
+print("ACK-SETUP", flush=True)
+for fp, action in {fps!r}:
+    failpoint.enable(fp, action)
+try:
+    s.execute("create model m1 from " + repr({npz!r}))
+except SystemExit:
+    raise
+except Exception as e:
+    print("ERR " + type(e).__name__ + ": " + str(e)[:200], flush=True)
+print("SURVIVED", flush=True)
+"""
+
+
+def run_child(dd, fps, ddl, timeout, template=None, **extra):
+    script = (template or _CHILD).format(repo=_REPO, dd=dd, fps=fps,
+                                         ddl=ddl, rows=ROWS,
+                                         batch=BATCH, **extra)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.run([sys.executable, "-c", script],
@@ -242,6 +286,58 @@ def check_recovered(dd, label, outcome, failures):
     dom.storage.mvcc.wal.close()
 
 
+def check_model_recovered(dd, label, outcome, failures):
+    """CREATE MODEL kill cases: the reopened store must show the job
+    terminal and the model either PUBLIC-and-serving or fully absent
+    with ZERO orphaned weight blobs (tidb_tpu/ml/ddl.py ladder)."""
+    from tidb_tpu.session import new_store, Session
+    from tidb_tpu.meta import Mutator
+    dom = new_store(dd)
+    s = Session(dom)
+    s.vars.current_db = "test"
+    live = [j for j in dom.ddl_jobs.list_jobs()
+            if j.state not in ("synced", "cancelled")]
+    if live:
+        failures.append(f"{label}: live jobs after restart: "
+                        f"{[(j.id, j.state) for j in live]}")
+    hist = dom.ddl_jobs.list_jobs()
+    job = next((j for j in hist if j.type == "create model"), None)
+    h = dom.ml.lookup("m1")
+    if outcome == "public":
+        if h is None:
+            failures.append(
+                f"{label}: model m1 absent (expected resumed-to-"
+                f"PUBLIC); jobs={[(j.type, j.state) for j in hist]}")
+        else:
+            # the resumed model must actually serve: ones-MLP over
+            # (b, b) with b=1.0 -> relu(2*ones(4)) @ ones = 8.0
+            rows = s.execute(
+                "select predict(m1, b, b) from t where a = 1").rows
+            if not rows or abs(rows[0][0] - 8.0) > 1e-5:
+                failures.append(f"{label}: resumed model predict -> "
+                                f"{rows} (want 8.0)")
+    else:
+        if h is not None:
+            failures.append(f"{label}: model m1 present (expected "
+                            f"rolled-back-to-absent)")
+        # zero orphaned weight blobs: the job knows its model id; the
+        # rollback txn must have removed meta AND weights
+        mid = ((job.args or {}).get("model") or {}).get("model_id") \
+            if job is not None else None
+        txn = dom.storage.begin()
+        try:
+            m = Mutator(txn)
+            if m.list_models():
+                failures.append(f"{label}: model meta rows survived "
+                                f"rollback: {m.list_models()}")
+            if mid and m.get_model_weights(mid) is not None:
+                failures.append(f"{label}: orphaned weight blob for "
+                                f"model id {mid}")
+        finally:
+            txn.rollback()
+    dom.storage.mvcc.wal.close()
+
+
 def epoch_fence_case(failures):
     """In-process case: a concurrent session's plan-cache fast-path
     template over t must be fenced by a DDL job's meta commits (the
@@ -282,16 +378,17 @@ def main():
 
     # the registry is the seam source of truth: every ddl seam this
     # gate kills must be registered (tpulint enforces the reverse)
-    from tidb_tpu.utils.failpoint_sites import DDL_SITES, known_sites
-    missing = [fp for _l, fps, _d, _o in CASES for fp, _a in fps
-               if fp not in known_sites()]
+    from tidb_tpu.utils.failpoint_sites import (DDL_SITES, ML_SITES,
+                                                known_sites)
+    all_fps = [fp for _l, fps, _d, _o in CASES for fp, _a in fps] + \
+        [fp for _l, fps, _o in ML_CASES for fp, _a in fps]
+    missing = [fp for fp in all_fps if fp not in known_sites()]
     if missing:
         print(f"DDL SMOKE FAILED: unregistered seams {missing}",
               file=sys.stderr)
         return 1
-    uncovered = [s for s in DDL_SITES
-                 if not any(fp == s for _l, fps, _d, _o in CASES
-                            for fp, _a in fps)]
+    uncovered = [s for s in DDL_SITES + ML_SITES
+                 if s not in all_fps]
     if uncovered and not quick:
         print(f"DDL SMOKE FAILED: registry DDL seams never killed: "
               f"{uncovered}", file=sys.stderr)
@@ -316,6 +413,26 @@ def main():
             print(f"# {label}: crashed rc=137, recovered "
                   f"({time.time() - t0:.1f}s)", file=sys.stderr)
 
+        ml_cases = [ML_CASES[0], ML_CASES[-1]] if quick else ML_CASES
+        for i, (label, fps, outcome) in enumerate(ml_cases):
+            dd = os.path.join(tmp, f"mldd_{i}")
+            t0 = time.time()
+            r = run_child(dd, fps, "", timeout, template=_ML_CHILD,
+                          npz=dd + ".npz")
+            out = r.stdout.decode()
+            if "ACK-SETUP" not in out:
+                failures.append(f"{label}: child setup failed: "
+                                f"{r.stderr.decode()[-300:]}")
+                continue
+            if r.returncode != 137 or "SURVIVED" in out:
+                failures.append(
+                    f"{label}: crash failpoint did not fire "
+                    f"(rc={r.returncode}, out={out[-200:]!r})")
+                continue
+            check_model_recovered(dd, label, outcome, failures)
+            print(f"# {label}: crashed rc=137, recovered "
+                  f"({time.time() - t0:.1f}s)", file=sys.stderr)
+
     epoch_fence_case(failures)
 
     if failures:
@@ -323,10 +440,12 @@ def main():
         for f in failures:
             print(f"  {f}", file=sys.stderr)
         return 1
+    nml = 2 if quick else len(ML_CASES)
     print(f"DDL SMOKE OK: {len(cases)} kill-9 seams × concurrent DML "
+          f"+ {nml} CREATE MODEL kill seams "
           "— every job resumed-to-PUBLIC or rolled-back-to-absent, "
-          "ADMIN CHECK TABLE clean, zero orphaned index meta/KV, "
-          "schema_epoch fence observed", file=sys.stderr)
+          "ADMIN CHECK TABLE clean, zero orphaned index meta/KV or "
+          "weight blobs, schema_epoch fence observed", file=sys.stderr)
     return 0
 
 

@@ -69,6 +69,10 @@ def _build(ctx, plan):
     if isinstance(plan, PhysVectorSearch):
         from .vector_search import VectorSearchExec
         return VectorSearchExec(ctx, plan)
+    from ..planner.physical import PhysMLPredict
+    if isinstance(plan, PhysMLPredict):
+        from .ml_predict import MLPredictExec
+        return MLPredictExec(ctx, plan)
     if isinstance(plan, PhysSort):
         return SortExec(ctx, plan, build_executor(ctx, plan.child))
     if isinstance(plan, PhysTopN):

@@ -82,35 +82,88 @@ class VectorSearchExec(Executor):
         copr._dev_store.invalidate(ctab.uid, ctab.version)
         k = plan.offset + plan.count
         served = {}
+        prefilter = filter_fp = None
+        if plan.filters:
+            # hybrid search (docs/VECTOR.md): scalar predicates become a
+            # row mask ANDed into MVCC validity BEFORE top-k selection
+            try:
+                prefilter, filter_fp = self._filter_mask(
+                    ctab, dag, read_ts)
+            except Exception:                   # noqa: BLE001
+                # predicate not maskable over the snapshot (exotic
+                # expr): conventional subtree owns it
+                return self._fallback("host_fallback")
         index = rt.index_for(dag.table_info, plan.col_name)
         nprobe = _nprobe_of(ctx)
         try:
             if index is not None and nprobe > 0:
                 cand = rt.ivf_topk(copr, ctab, index, plan.metric,
-                                   plan.query, k, read_ts, ectx=ctx)
+                                   plan.query, k, read_ts, ectx=ctx,
+                                   prefilter=prefilter)
                 path = "ivf"
                 if len(cand) < k:
                     # probed partitions hold fewer live rows than the
                     # statement asked for (dead clusters, tiny
-                    # postings): ANN may not silently shrink a LIMIT —
-                    # the exact scan owns the answer
+                    # postings, or a selective hybrid predicate): ANN
+                    # may not silently shrink a LIMIT — the exact scan
+                    # owns the answer
                     cand = rt.exact_topk(copr, ctab, ci.id, ci.ft.flen,
                                          plan.metric, plan.query, k,
                                          read_ts, ectx=ctx,
-                                         served=served)
+                                         served=served,
+                                         prefilter=prefilter,
+                                         filter_fp=filter_fp)
                     path = "host_fallback" if served.get("host") \
                         else "exact"
             else:
                 cand = rt.exact_topk(copr, ctab, ci.id, ci.ft.flen,
                                      plan.metric, plan.query, k,
-                                     read_ts, ectx=ctx, served=served)
+                                     read_ts, ectx=ctx, served=served,
+                                     prefilter=prefilter,
+                                     filter_fp=filter_fp)
                 path = "host_fallback" if served.get("host") else "exact"
         except DeviceDegradedError:
             return self._fallback("host_fallback")
+        if prefilter is not None:
+            path = "hybrid_" + path
         _metrics.VECTOR_SEARCH.labels(path).inc()
         self._backend = "vector/" + path
         return [self._gather(ctab, dag, read_ts, np.asarray(
             cand, dtype=np.int64))]
+
+    def _filter_mask(self, ctab, dag, read_ts):
+        """Hybrid search: evaluate the statement's scalar predicates
+        host-side over the full columnar snapshot -> (bool[n] mask,
+        fingerprint). Same EvalCtx + eval_bool_mask loop (NULL->False)
+        the conventional subtree runs, so the pre-filtered slate is
+        row-for-row what TopN-over-filtered-scan would admit. The
+        fingerprint keys the device-resident combined validity mask per
+        predicate set: a warm repeat of the same hybrid statement at
+        the same snapshot re-uses the resident mask (zero uploads)."""
+        import zlib
+        from ..expression.vec import EvalCtx, eval_bool_mask
+        copr = self.ctx.copr
+        cids = [cid for cid in (copr._cid(dag, sc) for sc in dag.cols)
+                if cid != -1]
+        arrays, valid = ctab.snapshot(cids, read_ts)
+        n = len(valid)
+        handles = ctab.handle_array()[:n]
+        cols = {}
+        for sc in dag.cols:
+            cid = copr._cid(dag, sc)
+            if cid == -1:
+                cols[sc.col.idx] = (handles, None, None)
+                continue
+            data, nulls, sdict = arrays[cid]
+            cols[sc.col.idx] = (
+                data[:n], None if nulls is None else nulls[:n], sdict)
+        ectx = EvalCtx(np, n, cols, host=True)
+        mask = np.ones(n, dtype=bool)
+        for f in self.plan.filters:
+            mask &= np.asarray(eval_bool_mask(ectx, f))
+        fp = "%08x" % zlib.crc32("|".join(
+            sorted(repr(f) for f in self.plan.filters)).encode())
+        return mask, fp
 
     def _gather(self, ctab, dag, read_ts, cand):
         """Gather the slate rows and re-rank on host (module

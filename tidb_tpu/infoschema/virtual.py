@@ -280,6 +280,23 @@ def _gen_vector_indexes(domain):
                        float(st.get("last_train_ts", 0.0)))
 
 
+def _gen_tidb_models(domain):
+    """One row per PUBLIC model (tidb_tpu/ml/, docs/ML.md): the durable
+    meta (uri, parsed shape params, weight bytes, create time) joined
+    with live serving state — device-resident weight bytes (0 until the
+    first device-path statement uploads them) and the predict()/embed()
+    call + row counters accumulated by this process."""
+    ml = getattr(domain, "ml", None)
+    if ml is None:
+        return
+    import json
+    for h in ml.handles():
+        yield (h.name, h.info.uri, h.kind,
+               json.dumps(h.info.params, sort_keys=True),
+               h.info.nbytes, h.version, float(h.info.created_ts) / 1e6,
+               ml.device_nbytes(h.id), h.predict_calls, h.predict_rows)
+
+
 def _gen_replica_freshness(domain):
     """Per-table analytic-replica freshness (incremental HTAP,
     docs/PERFORMANCE.md): the resolved-ts read view every resolved-mode
@@ -611,6 +628,17 @@ VIRTUAL_DEFS = {
                                   ("pending_delta_rows", _I()),
                                   ("last_train_ts", _F())),
                             _gen_vector_indexes),
+    "tidb_models": (_cols(("model_name", _S()),
+                          ("uri", _S()),
+                          ("kind", _S()),
+                          ("params", _S()),
+                          ("weight_bytes", _I()),
+                          ("version", _I()),
+                          ("created_ts", _F()),
+                          ("device_resident_bytes", _I()),
+                          ("predict_calls", _I()),
+                          ("predict_rows", _I())),
+                    _gen_tidb_models),
     "ddl_jobs": (_cols(("job_id", _I()), ("job_type", _S()),
                        ("state", _S()), ("schema_state", _S()),
                        ("db_name", _S()), ("table_name", _S()),

@@ -29,7 +29,7 @@ from __future__ import annotations
 import json
 
 from ..codec.tablecodec import meta_key
-from ..models import DBInfo, TableInfo, DDLJob
+from ..models import DBInfo, TableInfo, DDLJob, ModelInfo
 from ..errors import (DatabaseExistsError, DatabaseNotExistsError,
                       TableExistsError, TableNotExistsError)
 
@@ -39,6 +39,7 @@ _K_DBS = meta_key(b"DBs")
 _K_DDL_QUEUE = meta_key(b"DDLJobQueue")
 _K_DDL_HIST = meta_key(b"DDLJobHistory")
 _K_DELETE_RANGES = meta_key(b"DeleteRanges")
+_K_MODELS = meta_key(b"Models")
 
 HISTORY_CAP = 64
 
@@ -166,6 +167,56 @@ class Mutator:
         self._set_table_ids(dbid, [i for i in ids if i != tid])
         self.txn.delete(meta_key(b"DB", str(dbid).encode(),
                                  b"Table", str(tid).encode()))
+
+    # ---- models (tidb_tpu/ml/) ----------------------------------------
+    # m[Models]              -> json list of model ids
+    # m[Model:{id}]          -> ModelInfo json
+    # m[Model:{id}:Weights]  -> raw npz bytes (the weight blob)
+    def _model_ids(self) -> list[int]:
+        v = self.txn.get(_K_MODELS)
+        return json.loads(v) if v is not None else []
+
+    def _set_model_ids(self, ids):
+        self.txn.set(_K_MODELS, json.dumps(ids).encode())
+
+    def list_models(self) -> list[ModelInfo]:
+        out = []
+        for mid in self._model_ids():
+            v = self.txn.get(meta_key(b"Model", str(mid).encode()))
+            if v is not None:
+                out.append(ModelInfo.deserialize(v))
+        return out
+
+    def get_model(self, mid: int) -> ModelInfo | None:
+        v = self.txn.get(meta_key(b"Model", str(mid).encode()))
+        return ModelInfo.deserialize(v) if v is not None else None
+
+    def create_model(self, info: ModelInfo):
+        ids = self._model_ids()
+        if info.id not in ids:
+            ids.append(info.id)
+            self._set_model_ids(ids)
+        self.update_model(info)
+
+    def update_model(self, info: ModelInfo):
+        self.txn.set(meta_key(b"Model", str(info.id).encode()),
+                     info.serialize())
+
+    def drop_model(self, mid: int):
+        self._set_model_ids([i for i in self._model_ids() if i != mid])
+        self.txn.delete(meta_key(b"Model", str(mid).encode()))
+        self.delete_model_weights(mid)
+
+    def put_model_weights(self, mid: int, blob: bytes):
+        self.txn.set(meta_key(b"Model", str(mid).encode(), b"Weights"),
+                     blob)
+
+    def get_model_weights(self, mid: int) -> bytes | None:
+        return self.txn.get(meta_key(b"Model", str(mid).encode(),
+                                     b"Weights"))
+
+    def delete_model_weights(self, mid: int):
+        self.txn.delete(meta_key(b"Model", str(mid).encode(), b"Weights"))
 
     # ---- online-DDL job queue (owner/ddl_runner.py) --------------------
     def _json_list(self, key) -> list:

@@ -1,0 +1,151 @@
+"""ML runtime: device-resident weights + the standalone full-table
+forward path.
+
+Two serving shapes share the kernels (ml/kernels.py):
+
+  * FUSED — predict() inside a filter/agg fragment traces through the
+    expression registry into the pipeline body (ml/lowering.py); the
+    runtime is not involved per-statement.
+  * STANDALONE — `SELECT predict(m, ...) FROM t` over a bare table
+    scan lowers to PhysMLPredict (planner/physical.py): the feature
+    matrix and the weights are device-resident (features under the
+    table uid like every column buffer; weights under their own
+    ("mlw", model_id) uid so they upload ONCE, never per statement),
+    and the whole chain is one dispatch + one fetch sync. Guarded via
+    guarded_dispatch site="ml/predict" with the numpy twin as host
+    fallback — chaos-injected grant loss degrades, never errors.
+
+Placement mirrors the vector runtime: the numpy twin wins on the CPU
+backend unless TIDB_TPU_ML_DEVICE forces the device path (the gates
+force it to exercise residency + phase budgets).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils import jaxcfg  # noqa: F401  (jax import order contract)
+import jax
+import jax.numpy as jnp
+
+from ..utils import device_guard, phase
+from . import kernels
+from .registry import ModelRegistry
+
+
+def _device_inference() -> bool:
+    """Standalone forward placement: same contract as the vector
+    runtime's `_device_scoring` — numpy twin on the CPU backend, device
+    on real accelerators or under the force env the gates use."""
+    mode = os.environ.get("TIDB_TPU_ML_DEVICE", "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def _cap_of(n: int) -> int:
+    """Power-of-2 row bucket for the padded feature matrix, so one
+    compiled kernel serves a growing table."""
+    cap = 1024
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class MLRuntime:
+    """Model registry + device residency + standalone inference."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self.registry = ModelRegistry(domain)
+        self._dev_nbytes: dict = {}    # model id -> resident bytes
+
+    # ---- registry passthrough -----------------------------------------
+    def lookup(self, name: str):
+        return self.registry.lookup(name)
+
+    def handles(self):
+        return self.registry.handles()
+
+    def device_nbytes(self, mid: int) -> int:
+        return self._dev_nbytes.get(mid, 0)
+
+    def invalidate(self, mid: int):
+        """DROP MODEL / replacement: evict the weight buffers."""
+        copr = self.domain.copr
+        copr._dev_store.invalidate(("mlw", mid))
+        self._dev_nbytes.pop(mid, None)
+
+    # ---- device residency ---------------------------------------------
+    def device_weights(self, copr, h):
+        """Weight/bias arrays resident under uid ("mlw", id): exact
+        shapes (matmul operands must NOT be padded), uploaded once —
+        warm statements take pool hits only."""
+        store = copr._dev_store
+        out = []
+        total = 0
+        for i, arr in enumerate(list(h.weights) + list(h.biases)):
+            key = ("mlw", h.id, h.version, i)
+            dev = store.get(key)
+            if dev is None:
+                a32 = np.asarray(arr, dtype=np.float32)
+                dev = jnp.asarray(a32)
+                store.put(key, dev, a32.nbytes, uid=("mlw", h.id),
+                          version=h.version)
+                phase.inc("uploads")
+                phase.add("upload_bytes", a32.nbytes)
+            else:
+                phase.inc("upload_hits")
+            total += int(arr.nbytes)
+            out.append(dev)
+        self._dev_nbytes[h.id] = total
+        nw = len(h.weights)
+        return out[:nw], out[nw:]
+
+    # ---- standalone full-table forward --------------------------------
+    def predict_rows(self, copr, ctab, h, feats_np, read_ts, fids,
+                     ectx=None, served=None):
+        """Forward the [n, nf] float32 feature matrix through model h.
+        -> float32 [n]. Device path: resident padded features (keyed
+        like every derived snapshot buffer: version + read_ts + gc
+        epoch) + resident weights + ONE jitted chain; host twin on
+        degrade/CPU."""
+        n, nf = feats_np.shape
+
+        def host():
+            if served is not None:
+                served["host"] = True
+            return kernels.host_forward(feats_np, h.weights, h.biases)
+
+        if n == 0 or not _device_inference():
+            return host()
+
+        def dev():
+            cap = _cap_of(n)
+            # pre-pad: the shared upload tail pads 1-D buffers only
+            Xp = np.asarray(feats_np, dtype=np.float32)
+            if len(Xp) != cap:
+                Xp = np.concatenate(
+                    [Xp, np.zeros((cap - n, nf), dtype=np.float32)])
+            dX = copr._dev_put(
+                (ctab.uid, "mlfeat", fids, ctab.version, read_ts,
+                 ctab.gc_epoch, cap),
+                Xp, pad_fill=0, uid=ctab.uid,
+                version=ctab.version)
+            ws, bs = self.device_weights(copr, h)
+            kc = copr._kernel_cache
+            shapes = tuple(tuple(w.shape) for w in h.weights)
+            ck = ("ml_fwd", h.fingerprint(), cap, nf, shapes)
+            kern = kc.get(ck) or kc.put(
+                ck, kernels.build_forward_kernel(len(h.weights)))
+            from ..utils.fetch import host_array, prefetch
+            y = prefetch(kern(dX, *ws, *bs))
+            return host_array(y)[:n]
+
+        out = device_guard.guarded_dispatch(
+            dev, site="ml/predict", ectx=ectx, domain=self.domain,
+            host_fallback=host)
+        return np.asarray(out, dtype=np.float32)

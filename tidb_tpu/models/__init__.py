@@ -1,5 +1,6 @@
 from .schema import DBInfo, TableInfo, ColumnInfo, IndexInfo, SchemaState
 from .job import DDLJob
+from .mlmodel import ModelInfo
 
 __all__ = ["DBInfo", "TableInfo", "ColumnInfo", "IndexInfo", "SchemaState",
-           "DDLJob"]
+           "DDLJob", "ModelInfo"]

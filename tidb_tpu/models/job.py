@@ -49,6 +49,11 @@ TYPE_MODIFY_COLUMN = "modify column"
 # through the same durable queue so kill -9 mid-restore resumes from
 # the per-table checkpoint instead of leaving a half-imported cluster
 TYPE_RESTORE = "restore"
+# CREATE MODEL runs as a durable job too (tidb_tpu/ml/ddl.py): the
+# weight blob + registry rows commit in staged meta txns, so kill -9
+# between them resumes forward to PUBLIC or rolls back leaving zero
+# orphaned weight rows
+TYPE_CREATE_MODEL = "create model"
 
 
 @dataclass

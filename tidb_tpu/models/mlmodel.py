@@ -1,0 +1,49 @@
+"""Durable metadata for in-SQL ML models (`CREATE MODEL`).
+
+A model is a schema object exactly like a table: its row lives in the
+meta namespace (`m[Model:{id}]`), its weights ride a sibling blob row
+(`m[Model:{id}:Weights]`, the serialized npz bytes), and every mutation
+goes through a transactional Mutator — so models are WAL-durable,
+replicated to read replicas, captured by backup, and fenced by the same
+schema-version/epoch machinery that fences plan-cache templates.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ModelInfo:
+    id: int = 0
+    name: str = ""
+    uri: str = ""
+    kind: str = ""               # "linear" | "mlp" | "embedding"
+    params: dict = field(default_factory=dict)
+    nbytes: int = 0              # raw weight bytes (sum of array nbytes)
+    created_ts: int = 0          # commit ts of the publishing txn
+    version: int = 1             # bumped if a model is ever replaced
+    public: bool = False         # visible to lookups only once True
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "name": self.name, "uri": self.uri,
+                "kind": self.kind, "params": self.params,
+                "nbytes": self.nbytes, "created_ts": self.created_ts,
+                "version": self.version, "public": self.public}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ModelInfo":
+        return cls(id=d.get("id", 0), name=d.get("name", ""),
+                   uri=d.get("uri", ""), kind=d.get("kind", ""),
+                   params=d.get("params", {}) or {},
+                   nbytes=d.get("nbytes", 0),
+                   created_ts=d.get("created_ts", 0),
+                   version=d.get("version", 1),
+                   public=bool(d.get("public", False)))
+
+    def serialize(self) -> bytes:
+        return json.dumps(self.to_json()).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "ModelInfo":
+        return cls.from_json(json.loads(raw))

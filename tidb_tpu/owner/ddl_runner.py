@@ -48,7 +48,7 @@ from ..models.job import (
     STATE_QUEUEING, STATE_RUNNING, STATE_CANCELLING, STATE_ROLLINGBACK,
     STATE_SYNCED, STATE_CANCELLED,
     TYPE_ADD_INDEX, TYPE_DROP_INDEX, TYPE_EXCHANGE_PARTITION,
-    TYPE_MODIFY_COLUMN, TYPE_RESTORE)
+    TYPE_MODIFY_COLUMN, TYPE_RESTORE, TYPE_CREATE_MODEL)
 from ..errors import (TiDBError, WriteConflictError, TableNotExistsError,
                       DatabaseNotExistsError, DDLJobCancelledError,
                       DDLJobNotFoundError, CancelFinishedDDLError,
@@ -419,6 +419,7 @@ class DDLJobRunner:
             TYPE_EXCHANGE_PARTITION: self._run_exchange_partition,
             TYPE_MODIFY_COLUMN: self._run_modify_column,
             TYPE_RESTORE: self._run_restore,
+            TYPE_CREATE_MODEL: self._run_create_model,
         }.get(job.type)
         if handler is None:
             return self._rollback(job, TiDBError(
@@ -511,6 +512,13 @@ class DDLJobRunner:
         txns and restart re-entry via resume_pending."""
         from ..br import restore as br_restore
         br_restore.run_restore_job(self, job, cancel_check)
+
+    def _run_create_model(self, job, cancel_check):
+        """CREATE MODEL as a resumable job — the weight-blob/registry/
+        publish ladder lives in ml/ddl.py; this runner contributes the
+        durable queue, the step txns and restart re-entry."""
+        from ..ml import ddl as ml_ddl
+        ml_ddl.run_create_model_job(self, job, cancel_check)
 
     def _set_index_state(self, job, name, state):
         def step(m):
@@ -706,6 +714,9 @@ class DDLJobRunner:
             elif job.type == TYPE_RESTORE:
                 from ..br import restore as br_restore
                 br_restore.rollback_restore(self, job)
+            elif job.type == TYPE_CREATE_MODEL:
+                from ..ml import ddl as ml_ddl
+                ml_ddl.rollback_create_model(self, job)
             # exchange partition / modify column apply in one terminal
             # txn — a rolling-back job has nothing durable to undo
             job.state = STATE_CANCELLED

@@ -530,6 +530,21 @@ class DropSequenceStmt(StmtNode):
 
 
 @dataclass
+class CreateModelStmt(StmtNode):
+    """CREATE MODEL name FROM '<uri>' — weights npz registered as a
+    schema object (tidb_tpu/ml/)."""
+    name: str = ""
+    uri: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropModelStmt(StmtNode):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclass
 class CreateViewStmt(StmtNode):
     view: TableName = None
     columns: list = field(default_factory=list)

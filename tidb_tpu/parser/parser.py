@@ -1265,6 +1265,19 @@ class Parser:
                 elif w in ("nocycle", "cycle", "nocache"):
                     pass
             return stmt
+        if self.accept_kw("model"):
+            ine = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            name = self.ident()
+            self.expect_kw("from")
+            tok = self.next()
+            if tok.kind != "STRING":
+                self.error("CREATE MODEL requires a quoted weights uri")
+            return ast.CreateModelStmt(name=name, uri=tok.text,
+                                       if_not_exists=ine)
         if self.accept_kw("user"):
             ine = False
             if self.accept_kw("if"):
@@ -1642,6 +1655,12 @@ class Parser:
                 ie = True
             return ast.DropSequenceStmt(name=self.parse_table_name(),
                                         if_exists=ie)
+        if self.accept_kw("model"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            return ast.DropModelStmt(name=self.ident(), if_exists=ie)
         if self.accept_kw("user"):
             ie = False
             if self.accept_kw("if"):
@@ -2135,6 +2154,8 @@ class Parser:
             stmt.kind = "analyze_status"
         elif self.accept_kw("config"):
             stmt.kind = "config"
+        elif self.accept_kw("models"):
+            stmt.kind = "models"
         elif self.accept_kw("placement"):
             stmt.kind = "placement_labels" \
                 if self.accept_kw("labels") else "placement"

@@ -18,7 +18,8 @@ class PlanContext:
                  now_micros=0, conn_id=1, params=None, table_stats=None,
                  check_read=None, temp_tables=None, make_temp_table=None,
                  drop_temp_table=None, seq_nextval=None, seq_lastval=None,
-                 ts_for_time=None, table_bulk_rows=None, user=None):
+                 ts_for_time=None, table_bulk_rows=None, user=None,
+                 model_lookup=None):
         self.infoschema = infoschema
         self.sess_vars = sess_vars
         self.current_db = current_db
@@ -33,6 +34,9 @@ class PlanContext:
         self.drop_temp_table = drop_temp_table
         self.seq_nextval = seq_nextval
         self.seq_lastval = seq_lastval
+        # domain ModelRegistry lookup (epoch-fenced): predict()/embed()
+        # resolve their model handle through this at rewrite time
+        self.model_lookup = model_lookup
         self.ts_for_time = ts_for_time
         self.stale_read_ts = 0       # set by AS OF TIMESTAMP table refs
         self.user_vars = user_vars or {}

@@ -615,7 +615,7 @@ class PhysVectorSearch(PhysPlan):
     overlays, device degradation)."""
 
     def __init__(self, items, offset, count, reader, metric, col_name,
-                 query):
+                 query, filters=None):
         super().__init__([reader], reader.schema)
         self.items = items
         self.offset = offset
@@ -624,10 +624,38 @@ class PhysVectorSearch(PhysPlan):
         self.metric = metric            # vec_* op name
         self.col_name = col_name        # storage column name
         self.query = query              # np.float32 query vector
+        # hybrid search: scalar predicates applied BEFORE top-k (the
+        # mask ANDs into MVCC validity — pre-filtered exact scan, or
+        # pre-filtered IVF probing with selectivity-widened nprobe).
+        # The same exprs stay on the reader dag for the fallback path.
+        self.filters = filters or []
 
     def explain_info(self):
-        return (f"{self.metric}({self.col_name}), k:{self.count}, "
+        info = (f"{self.metric}({self.col_name}), k:{self.count}, "
                 f"offset:{self.offset}, dim:{len(self.query)}")
+        if self.filters:
+            info += ", prefilter:" + \
+                ", ".join(repr(f) for f in self.filters)
+        return info
+
+
+class PhysMLPredict(PhysPlan):
+    """`SELECT ..., predict(m, f...) FROM t [WHERE ...]` lowered to
+    ONE batched device forward pass over the streamed scan result
+    (tidb_tpu/ml/, docs/ML.md): the executor drains the wrapped
+    reader, extracts the feature matrix host-side, and runs the whole
+    matmul chain through MLRuntime.predict_rows — resident weights +
+    resident padded features, one dispatch, one fetch sync. The
+    per-chunk host evaluation of ProjectionExec is the parity twin
+    (dirty-txn overlays and device degradation fall back to it)."""
+
+    def __init__(self, exprs, schema, reader):
+        super().__init__([reader], schema)
+        self.exprs = exprs
+        self.reader = reader
+
+    def explain_info(self):
+        return "batched, " + ", ".join(map(repr, self.exprs))
 
 
 class PhysLimit(PhysPlan):
@@ -752,6 +780,10 @@ def _phys(plan: LogicalPlan) -> PhysPlan:
         return p
     if isinstance(plan, Projection):
         child = _phys(plan.child)
+        mlp = _try_ml_predict(plan, child)
+        if mlp is not None:
+            mlp.stats_rows = plan.stats_rows
+            return mlp
         p = PhysProjection(plan.exprs, plan.schema, child)
         p.stats_rows = plan.stats_rows
         return p
@@ -889,9 +921,13 @@ def _try_vector_search(plan: TopN, child) -> PhysVectorSearch | None:
     if not isinstance(child, PhysTableReader):
         return None
     dag = child.dag
-    if dag.aggs or dag.group_items or dag.filters or dag.host_filters \
-            or dag.limit >= 0 or dag.topn is not None:
+    if dag.aggs or dag.group_items or dag.limit >= 0 \
+            or dag.topn is not None:
         return None
+    # scalar predicates are welcome: hybrid search applies them as a
+    # pre-top-k mask (they also STAY on the dag so the conventional
+    # fallback subtree filters identically)
+    filters = list(dag.filters) + list(dag.host_filters)
     tbl = dag.table_info
     if tbl.id <= 0 or tbl.partitions or tbl.view_select:
         return None
@@ -924,7 +960,26 @@ def _try_vector_search(plan: TopN, child) -> PhysVectorSearch | None:
     if q is None or len(q) != ft.flen:
         return None
     return PhysVectorSearch(plan.items, plan.offset, plan.count, child,
-                            e.op, ci.name, q)
+                            e.op, ci.name, q, filters=filters)
+
+
+def _try_ml_predict(plan: Projection, child) -> PhysMLPredict | None:
+    """Recognize a projection with top-level predict() calls directly
+    over a table scan and lower it to PhysMLPredict (batched
+    standalone inference). The reader keeps its own filters — rows are
+    filtered BEFORE feature extraction, so the batch is exactly the
+    result set. Aggregated/fused shapes keep the conventional plan
+    (there predict traces into the fragment body instead)."""
+    from ..ml.lowering import MLFunc
+    if not isinstance(child, PhysTableReader):
+        return None
+    dag = child.dag
+    if dag.aggs or dag.group_items or dag.topn is not None:
+        return None
+    if not any(isinstance(e, MLFunc) and e.op == "predict"
+               for e in plan.exprs):
+        return None
+    return PhysMLPredict(plan.exprs, plan.schema, child)
 
 
 def _try_index_range(ds: DataSource) -> PhysPlan | None:

@@ -558,6 +558,13 @@ class Rewriter:
                 return const_from_py(0 if lock_name in locks else 1)
             held = locks.pop(lock_name, None)
             return const_from_py(1 if held is not None else 0)
+        if name in ("predict", "embed"):
+            # in-SQL inference: resolve the model handle NOW (rewrite
+            # time) through the domain's epoch-fenced registry; the
+            # bound MLFunc carries name#version in fingerprint/repr so
+            # fragment and plan caches fence on model replacement
+            from ..ml.lowering import resolve_ml_call
+            return resolve_ml_call(self, node)
         if name in ("nextval", "lastval") and node.args:
             arg = node.args[0]
             if isinstance(arg, ast.ColumnRef):

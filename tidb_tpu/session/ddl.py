@@ -319,6 +319,43 @@ class DDLExecutor:
                                           stmt.name.name)
         self._with_meta(fn)
 
+    # ---- models (tidb_tpu/ml/) ----------------------------------------
+    def create_model(self, stmt: ast.CreateModelStmt):
+        """CREATE MODEL name FROM '<uri>'. Fail-fast validation (name
+        collision, uri readable, npz layout parseable) happens on the
+        session thread; the durable writes run as a TYPE_CREATE_MODEL
+        job through the owner runner so kill -9 mid-create resumes to
+        PUBLIC or rolls back with zero orphaned weight rows."""
+        from ..ml import parse_npz
+        from ..ml.ddl import read_model_uri
+        if self.domain.ml.lookup(stmt.name) is not None:
+            if stmt.if_not_exists:
+                return
+            raise TiDBError("Model '%s' already exists", stmt.name)
+        parse_npz(read_model_uri(stmt.uri))   # layout errors fail here
+        from ..models.job import TYPE_CREATE_MODEL
+        job = DDLJob(type=TYPE_CREATE_MODEL, table_name=stmt.name,
+                     args={"model": {"name": stmt.name,
+                                     "uri": stmt.uri}})
+        self._submit_job(job)
+
+    def drop_model(self, stmt: ast.DropModelStmt):
+        """DROP MODEL: one meta txn removes the registry row + weight
+        blob (like dropping a vector index — no reorg ladder needed),
+        then the device-resident weight buffers are evicted."""
+        def fn(m):
+            for info in m.list_models():
+                if info.name.lower() == stmt.name.lower() and \
+                        info.public:
+                    m.drop_model(info.id)
+                    return info.id
+            if not stmt.if_exists:
+                raise TiDBError("Model '%s' doesn't exist", stmt.name)
+            return None
+        mid = self._with_meta(fn)
+        if mid is not None:
+            self.domain.ml.invalidate(mid)
+
     def create_view(self, stmt: ast.CreateViewStmt):
         db_name = stmt.view.db or self.sess.vars.current_db
         # validate the definition by planning it now

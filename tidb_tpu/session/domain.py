@@ -238,6 +238,12 @@ class Domain:
         # seam lazily when the first vector index appears
         from ..vector import VectorRuntime
         self.vector = VectorRuntime(self)
+        # in-SQL model inference (tidb_tpu/ml/): epoch-fenced model
+        # registry + device-resident weights + forward kernels.
+        # Attached BEFORE the DDL runner so a restart-resumed CREATE
+        # MODEL job publishes into a live registry
+        from ..ml import MLRuntime
+        self.ml = MLRuntime(self)
         # incremental HTAP (copr/delta.py): the delta maintainer is
         # the capture seam's second consumer — per-table freshness
         # bookkeeping behind information_schema.tidb_replica_freshness

@@ -476,6 +476,7 @@ class Session:
             ts_for_time=self.domain.storage.oracle.ts_for_time,
             table_bulk_rows=self._table_bulk_rows,
             user=f"{self.user}@{self.host}",
+            model_lookup=self.domain.ml.lookup,
         )
 
     def _table_bulk_rows(self, table_id: int) -> int:
@@ -1078,6 +1079,8 @@ class Session:
             ast.CreateIndexStmt: self.ddl.create_index,
             ast.DropIndexStmt: self.ddl.drop_index,
             ast.AlterTableStmt: self.ddl.alter_table,
+            ast.CreateModelStmt: self.ddl.create_model,
+            ast.DropModelStmt: self.ddl.drop_model,
         }
         fn = ddl_map.get(type(stmt))
         if fn is not None:
@@ -1173,6 +1176,13 @@ class Session:
             targets.append(("index", *tn_target(stmt.table)))
         elif isinstance(stmt, ast.AlterTableStmt):
             targets.append(("alter", *tn_target(stmt.table)))
+        elif isinstance(stmt, (ast.CreateModelStmt, ast.DropModelStmt)):
+            # models are cluster-scoped schema objects; gate on the
+            # session's current db like other non-table DDL
+            priv = "create" if isinstance(stmt, ast.CreateModelStmt) \
+                else "drop"
+            targets.append((priv, self.vars.current_db or "test",
+                            stmt.name))
         return targets
 
     def _plan_replayer_dump(self, stmt):
@@ -2022,6 +2032,15 @@ def _stmt_class(stmt) -> str:
         if isinstance(e, (ast.AggFunc, ast.WindowFunc)):
             return "olap"
         if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+            return "olap"
+    for ob in stmt.order_by:
+        e = getattr(ob, "expr", None)
+        if isinstance(e, ast.FuncCall) and e.name.startswith("vec_") \
+                and e.name.endswith("_distance"):
+            # vector retrieval ranks the whole table no matter how
+            # small the LIMIT: analytic by construction, and the
+            # resolved-mode hybrid-scan contract (docs/ML.md) depends
+            # on the olap classification
             return "olap"
     return "oltp"
 

@@ -72,6 +72,12 @@ def exec_show(sess, stmt):
                          "auto_increment" if c.ft.auto_increment else ""))
         return _str_chunk(["Field", "Type", "Null", "Key", "Default", "Extra"],
                           _like_filter(rows, stmt.like))
+    if kind == "models":
+        rows = sorted(
+            [(h.name, h.kind, h.info.uri, h.info.nbytes, h.version)
+             for h in sess.domain.ml.handles()])
+        return _str_chunk(["Model", "Kind", "Uri", "Bytes", "Version"],
+                          _like_filter(rows, stmt.like))
     if kind == "variables":
         seen = {}
         for name, var in sorted(all_sysvars().items()):

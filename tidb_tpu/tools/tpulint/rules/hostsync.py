@@ -42,14 +42,16 @@ import ast
 
 from ..core import Rule, register_rule
 
-SCOPE_PREFIXES = ("tidb_tpu/copr/", "tidb_tpu/mpp/", "tidb_tpu/vector/")
+SCOPE_PREFIXES = ("tidb_tpu/copr/", "tidb_tpu/mpp/", "tidb_tpu/vector/",
+                  "tidb_tpu/ml/")
 
 PREFETCH = ("prefetch", "fetch.prefetch", "utils.fetch.prefetch")
 SEAM = ("host_array", "host_scalar", "host_int",
         "fetch.host_array", "fetch.host_scalar", "fetch.host_int")
 KERNEL_MAKERS = ("jax.jit", "jaxcfg.guard_donation", "guard_donation",
                  "phase.timed_kernel", "timed_kernel",
-                 "_cached_kernel", "exec._cached_kernel")
+                 "_cached_kernel", "exec._cached_kernel",
+                 "build_forward_kernel", "kernels.build_forward_kernel")
 HOST_NUMPY = ("numpy.asarray", "numpy.array")
 SCALAR_BUILTINS = {"int", "float", "bool"}
 SYNC_METHODS = {"item", "tolist"}

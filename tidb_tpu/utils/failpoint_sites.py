@@ -99,6 +99,22 @@ SITES: dict[str, str] = {
         "vector/runtime.py: resident-matrix tail patch — failure "
         "drops the entry for a full re-upload (bytes, never "
         "correctness)"),
+    # ---- CREATE MODEL seams (tidb_tpu/ml/ddl.py; ddl_smoke) -----------
+    "ml-weights-write": (
+        "ml/ddl.py: weight blob committed into the meta namespace, "
+        "ModelInfo not — resume re-enters the ladder at the meta rung "
+        "(the blob write is recorded in job args, never repeated)"),
+    "ml-registry-commit": (
+        "ml/ddl.py: non-public ModelInfo committed — resume publishes; "
+        "the registry skips non-public rows, so no session ever sees "
+        "the half-created model"),
+    "ml-pre-public": (
+        "ml/ddl.py: weights + meta durable, PUBLIC not committed — "
+        "resume publishes (or a rollback drops meta AND weights: zero "
+        "orphaned weight blobs)"),
+    "device_guard/ml/predict": (
+        "ml/runtime.py: standalone batched forward dispatch — degrade "
+        "= numpy forward twin, values identical"),
     # ---- DML / import seams -------------------------------------------
     "mutation-corrupt-index": (
         "executor/table_rt.py: test hook corrupting derived index "
@@ -208,6 +224,17 @@ DDL_SITES = (
     "ddl-drop-before-remove",
     "ddl-delete-range",
     "ddl-reorg-before-swap",
+)
+
+
+# the CREATE MODEL seams scripts/ddl_smoke.py kills at (separate from
+# DDL_SITES: these cases need an npz weights file staged in the child;
+# resume must end PUBLIC, rollback must leave zero orphaned weight
+# blobs)
+ML_SITES = (
+    "ml-weights-write",
+    "ml-registry-commit",
+    "ml-pre-public",
 )
 
 

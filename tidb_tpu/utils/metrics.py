@@ -581,6 +581,7 @@ class TopSQL:
                     "upload_bytes": 0, "fetch_bytes": 0,
                     "fallback_count": 0, "sum_errors": 0,
                     "delta_applies": 0, "delta_bytes": 0,
+                    "ml_predicts": 0, "ml_rows": 0,
                     "max_drift": 0.0, "sum_drift": 0.0, "drift_execs": 0,
                     "replica_reads": 0, "leader_fallbacks": 0,
                     "degraded_midstmt": 0}
@@ -601,6 +602,11 @@ class TopSQL:
             # digest's binds paid for delta folds, and how many bytes
             e["delta_applies"] += ph.get("delta_applies", 0)
             e["delta_bytes"] += ph.get("delta_bytes", 0)
+            # in-SQL inference attribution: which digest's statements
+            # ran model forwards, and over how many rows
+            e["ml_predicts"] = e.get("ml_predicts", 0) + \
+                ph.get("ml_predicts", 0)
+            e["ml_rows"] = e.get("ml_rows", 0) + ph.get("ml_rows", 0)
             if drift is not None:
                 mx, mean = drift
                 if mx > e["max_drift"]:
@@ -867,6 +873,18 @@ VECTOR_SEARCH = REGISTRY.counter(
     "brute-force kernel, ivf=ANN through the IVF index, "
     "host_fallback=degraded to the numpy twin — device failure or a "
     "dirty-transaction overlay)", ("path",))
+ML_PREDICT = REGISTRY.counter(
+    "tidb_tpu_ml_predict_total",
+    "In-SQL model inference calls by outcome (device=standalone "
+    "full-table forward kernel, host=numpy twin / host eval, "
+    "fused=forward chain traced into a copr fragment program — "
+    "counted once per compile, the per-dispatch cost rides the "
+    "fragment's phase counters, host_fallback=device path degraded "
+    "to the twin mid-statement)", ("outcome",))
+ML_ROWS = REGISTRY.counter(
+    "tidb_tpu_ml_rows_total",
+    "Rows scored/embedded by in-SQL model inference (host-observable "
+    "paths; fused in-fragment rows ride the fragment row counters)")
 VECTOR_NPROBE_PARTITIONS = REGISTRY.counter(
     "tidb_tpu_vector_nprobe_partitions_total",
     "IVF partitions probed across ANN searches (sum of effective "

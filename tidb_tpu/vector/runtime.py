@@ -288,15 +288,23 @@ class VectorRuntime:
     # ---- search entries ------------------------------------------------
     def exact_topk(self, copr, ctab, cid: int, dim: int, metric: str,
                    q: np.ndarray, k: int, read_ts, ectx=None,
-                   served=None):
+                   served=None, prefilter=None, filter_fp=None):
         """Exact brute-force top-k: ONE kernel dispatch over the
         resident matrix (distances + lax.top_k), one bulk fetch, zero
         host scalar syncs — the single-dispatch contract. -> candidate
         row positions (np.int64, best-first, may exceed k by slack).
         Degrades to the full numpy twin under device failure (chaos
-        parity: the executor re-ranks either slate identically)."""
+        parity: the executor re-ranks either slate identically).
+
+        prefilter: optional bool[n] predicate mask (hybrid search) —
+        ANDed into MVCC validity BEFORE selection, so the kernel never
+        spends its k-slots on non-matching rows. filter_fp keys the
+        device-resident combined mask per predicate set (a warm repeat
+        of the same hybrid query re-uses it: zero upload bytes)."""
         mat, n = ctab.vector_matrix(cid, dim)
         valid = self._valid_for(ctab, read_ts, n)
+        if prefilter is not None:
+            valid = valid & prefilter[:n]     # copy: never mutate cache
         kcap = _kcap(k, n)
         q32 = np.asarray(q, dtype=np.float32)
 
@@ -312,7 +320,7 @@ class VectorRuntime:
             # validity mask
             dvalid = copr._dev_put(
                 (ctab.uid, "vecvalid", ctab.version, read_ts,
-                 ctab.gc_epoch, cap),
+                 ctab.gc_epoch, filter_fp, cap),
                 pv, pad_fill=False, uid=ctab.uid, version=ctab.version)
             kc = copr._kernel_cache
             ck = ("vec_topk", metric, cap, dim, kcap)
@@ -333,22 +341,42 @@ class VectorRuntime:
             host_fallback=host)
 
     def ivf_topk(self, copr, ctab, index: IVFIndex, metric: str,
-                 q: np.ndarray, k: int, read_ts, ectx=None):
+                 q: np.ndarray, k: int, read_ts, ectx=None,
+                 prefilter=None):
         """ANN: probe nprobe partitions, score their postings.
         -> candidate positions (best-first) or None when the index
         cannot serve (unbuilt and untrainable); the caller then runs
-        the exact path."""
+        the exact path.
+
+        prefilter (hybrid search): bool[n] predicate mask ANDed into
+        MVCC validity before scoring — and, crucially, BEFORE probing:
+        nprobe widens by ~1/selectivity so a 1% filter still probes
+        enough partitions to surface k matching rows (candidates()
+        clamps to the centroid count). Candidates failing the combined
+        mask are dropped pre-upload: the scoring kernel only sees rows
+        that could appear in the result."""
         index.refresh(copr, ctab, ectx)
         self.clear_pending(ctab.table_info.id)
         nprobe = _nprobe(ectx)
         q32 = np.asarray(q, dtype=np.float32)
-        cand = index.candidates(q32, metric, nprobe)
-        if not len(cand):
-            return np.empty(0, dtype=np.int64)
         mat, n = ctab.vector_matrix(cid := self._cid_of(ctab, index),
                                     index.dim)
         valid = self._valid_for(ctab, read_ts, n)
+        if prefilter is not None:
+            valid = valid & prefilter[:n]     # copy: never mutate cache
+            live = int(valid.sum())
+            sel = live / n if n else 1.0
+            if 0.0 < sel < 1.0:
+                nprobe = max(nprobe, min(int(nprobe / sel) + 1, 4096))
+        cand = index.candidates(q32, metric, nprobe)
+        if not len(cand):
+            return np.empty(0, dtype=np.int64)
         cand = cand[cand < n]
+        if prefilter is not None:
+            # pre-shrink: only rows passing predicate + MVCC get scored
+            cand = cand[valid[cand]]
+            if not len(cand):
+                return np.empty(0, dtype=np.int64)
         kcap = _kcap(k, len(cand))
         if _device_scoring():
             ccap = shape_bucket(len(cand))
